@@ -1,0 +1,23 @@
+"""kuberay_tpu — a TPU-native pod-slice orchestration framework.
+
+A brand-new framework with the capabilities of ray-project/kuberay, re-designed
+TPU-first: the atomic unit of scheduling, scaling, and repair is the multi-host
+TPU *slice* (not the pod), worker identity/topology env injection
+(``TPU_WORKER_ID`` / ``TPU_WORKER_HOSTNAMES``) is native (not webhook-delegated),
+and the runtime path is JAX/XLA/pjit/Pallas rather than GPU/NCCL.
+
+Layout (mirrors the reference's layer map, SURVEY.md §1):
+
+- ``api/``          CRD-equivalent typed specs (TpuCluster/TpuJob/TpuService/...)
+- ``builders/``     pure functions spec -> pod/service/job objects
+- ``controlplane/`` object store + level-triggered reconcilers
+- ``scheduler/``    gang-admission plugin framework
+- ``parallel/``     device-mesh / sharding / ring-attention machinery
+- ``models/``       flagship model families (Llama, Mixtral)
+- ``ops/``          Pallas TPU kernels with portable fallbacks
+- ``train/``        pjit train step, checkpointing, data
+- ``serve/``        continuous-batching inference engine
+- ``utils/``        constants, validation, hashing, metrics, feature gates
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,263 @@
+"""TPU topology math: the core primitive of the framework.
+
+The reference treats the pod as the scheduling unit and bolts multi-host
+atomicity on top (``NumOfHosts`` at raycluster_types.go:414-417, atomic group
+reconcile at raycluster_controller.go:1246-1410).  Here the *slice* is
+first-class: a worker group declares an accelerator generation + ICI topology
+(e.g. ``v5p`` / ``4x4x4``) and everything else — hosts per slice, chips per
+host, ring order, node selectors, mesh shapes — is derived, never free-form.
+
+Public data:
+- ``TpuGeneration``: per-generation hardware facts (chips/host, ICI dims).
+- ``SliceTopology``: parsed+validated topology with derived host math.
+
+No JAX imports here: this module is shared by the control plane (which must
+run without an accelerator) and the runtime.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence, Tuple
+
+
+class TopologyError(ValueError):
+    """Raised for invalid accelerator/topology combinations."""
+
+
+# GKE node-pool catalog for 2D generations (v5e/v6e), dims sorted ascending.
+_VALID_2D_TOPOLOGIES = {
+    (1, 1), (1, 2), (2, 2), (2, 4), (4, 4), (4, 8), (8, 8), (8, 16), (16, 16),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class TpuGeneration:
+    """Hardware facts for one TPU generation.
+
+    ``max_chips_per_host`` is the VM-attachment unit: a multi-host slice is
+    carved into hosts of exactly ``chips_per_host(topology)`` chips, so host
+    count is always ``total_chips / chips_per_host`` — the quantum of
+    scheduling the control plane must treat atomically.
+    """
+
+    name: str
+    ici_dims: int                 # 2 => XxY topologies, 3 => XxYxZ
+    max_chips_per_host: int       # largest single-host attachment
+    cores_per_chip: int
+    hbm_gib_per_chip: float
+    bf16_tflops_per_chip: float   # peak dense MXU throughput
+    # GKE node-selector value for gke-tpu-accelerator (what the builders stamp)
+    gke_accelerator: str
+    # Multi-host node pools attach 4 chips per VM on every generation
+    # (ct5lp-hightpu-4t / ct6e-standard-4t / v4+v5p boards); only single-host
+    # pools offer larger attachments (reference sample
+    # ray-job.tpu-v6e-16-multihost.yaml: numOfHosts: 4, google.com/tpu: "4").
+    multihost_chips_per_host: int = 4
+
+    def chips_per_host(self, total_chips: int) -> int:
+        """Chips attached to each host VM for a slice of ``total_chips``."""
+        if total_chips <= self.max_chips_per_host:
+            return total_chips
+        return self.multihost_chips_per_host
+
+
+# Generation table. bf16 TFLOPs from public spec sheets; v5litepod (v5e) has
+# no 3D ICI, v4/v5p do. v6e (Trillium) is 2D like v5e.
+GENERATIONS = {
+    "v4": TpuGeneration("v4", 3, 4, 2, 32.0, 275.0, "tpu-v4-podslice"),
+    "v5e": TpuGeneration("v5e", 2, 8, 1, 16.0, 197.0, "tpu-v5-lite-podslice"),
+    "v5p": TpuGeneration("v5p", 3, 4, 2, 95.0, 459.0, "tpu-v5p-slice"),
+    "v6e": TpuGeneration("v6e", 2, 8, 1, 32.0, 918.0, "tpu-v6e-slice"),
+}
+
+_ALIASES = {
+    "v5litepod": "v5e",
+    "v5lite": "v5e",
+    "v5 lite": "v5e",
+    "trillium": "v6e",
+}
+
+
+def get_generation(name: str) -> TpuGeneration:
+    key = name.strip().lower()
+    key = _ALIASES.get(key, key)
+    gen = GENERATIONS.get(key)
+    if gen is None:
+        raise TopologyError(
+            f"unknown TPU generation {name!r}; known: {sorted(GENERATIONS)}"
+        )
+    return gen
+
+
+def parse_topology(topology: str) -> Tuple[int, ...]:
+    """Parse ``"4x4"`` / ``"2x2x2"`` into an int tuple."""
+    parts = topology.lower().replace(" ", "").split("x")
+    try:
+        dims = tuple(int(p) for p in parts)
+    except ValueError:
+        raise TopologyError(f"malformed topology {topology!r}") from None
+    if not dims or any(d < 1 for d in dims):
+        raise TopologyError(f"malformed topology {topology!r}")
+    return dims
+
+
+@dataclasses.dataclass(frozen=True)
+class SliceTopology:
+    """A validated (generation, topology) pair with all derived facts.
+
+    This is what a worker group resolves to.  The reference exposes raw
+    ``NumOfHosts`` and leaves topology to node selectors in samples
+    (config/samples/ray-job.tpu-v6e-16-multihost.yaml); here ``num_hosts``
+    is *derived* so a spec can never declare an impossible slice.
+    """
+
+    generation: TpuGeneration
+    dims: Tuple[int, ...]
+
+    @classmethod
+    def create(cls, accelerator: str, topology: str) -> "SliceTopology":
+        gen = get_generation(accelerator)
+        dims = parse_topology(topology)
+        if len(dims) != gen.ici_dims:
+            raise TopologyError(
+                f"{gen.name} uses {gen.ici_dims}D ICI topologies, got "
+                f"{topology!r} ({len(dims)}D)"
+            )
+        chips = math.prod(dims)
+        if chips > gen.max_chips_per_host:
+            # Multi-host: chip count must divide into whole host VMs.
+            if chips % gen.multihost_chips_per_host != 0:
+                raise TopologyError(
+                    f"{gen.name}-{chips} is not divisible into "
+                    f"{gen.multihost_chips_per_host}-chip hosts"
+                )
+        if gen.ici_dims == 2:
+            # 2D generations (v5e/v6e) ship a fixed GKE topology catalog;
+            # orderings are canonical (ascending) — '8x4' matches no pool.
+            if dims not in _VALID_2D_TOPOLOGIES:
+                raise TopologyError(
+                    f"{gen.name} has no {topology!r} node pool; valid: "
+                    + ", ".join("x".join(map(str, t)) for t in sorted(_VALID_2D_TOPOLOGIES))
+                )
+        else:
+            # 3D generations (v4/v5p): cuboids whose dims are 1, 2, or a
+            # multiple of 4 (the board edge), per the GKE topology tables.
+            for d in dims:
+                if d not in (1, 2) and d % 4 != 0:
+                    raise TopologyError(
+                        f"{gen.name} topology dims must be 1, 2, or a "
+                        f"multiple of 4; got {topology!r}"
+                    )
+        return cls(gen, dims)
+
+    @property
+    def topology_str(self) -> str:
+        return "x".join(str(d) for d in self.dims)
+
+    @property
+    def num_chips(self) -> int:
+        return math.prod(self.dims)
+
+    @property
+    def chips_per_host(self) -> int:
+        return self.generation.chips_per_host(self.num_chips)
+
+    @property
+    def num_hosts(self) -> int:
+        return self.num_chips // self.chips_per_host
+
+    @property
+    def is_multi_host(self) -> bool:
+        return self.num_hosts > 1
+
+    @property
+    def short_name(self) -> str:
+        return f"{self.generation.name}-{self.num_chips}"
+
+    @property
+    def bf16_tflops(self) -> float:
+        return self.num_chips * self.generation.bf16_tflops_per_chip
+
+    @property
+    def hbm_gib(self) -> float:
+        return self.num_chips * self.generation.hbm_gib_per_chip
+
+    def host_grid_dims(self) -> Tuple[int, ...]:
+        """Host-grid shape: topology dims with chips-per-host divided out of
+        the innermost axes (the platform packs a host's chips along the last
+        topology axis first).  Falls back to a 1-D grid if packing is
+        irregular."""
+        n = self.num_hosts
+        rem = self.chips_per_host
+        host_dims = []
+        for d in reversed(self.dims):
+            if rem >= d:
+                if rem % d != 0:
+                    return (n,)
+                rem //= d
+            else:
+                if d % rem != 0:
+                    return (n,)
+                host_dims.append(d // rem)
+                rem = 1
+        host_dims.reverse()
+        if math.prod(host_dims) != n:
+            return (n,)
+        return tuple(host_dims) if host_dims else (1,)
+
+    def host_ring_order(self) -> Sequence[int]:
+        """Deterministic ring order of host indices for SP/ring attention.
+
+        A generalized boustrophedon (snake) path over the N-D host grid:
+        every consecutive hop differs in exactly one grid coordinate by 1,
+        i.e. is an ICI neighbor — what ring attention needs (SURVEY.md §5.7:
+        ring order must be stable and neighbor-wise).  The closing wrap hop
+        rides the torus wrap link where the hardware has one.
+        """
+        n = self.num_hosts
+        if n <= 2:
+            return list(range(n))
+        host_dims = [d for d in self.host_grid_dims() if d > 1]
+        if len(host_dims) <= 1:
+            return list(range(n))
+
+        # N-D snake: innermost axis sweeps forward/backward depending on the
+        # parity of the sum of all outer coordinates, recursively — each step
+        # changes exactly one coordinate by +/-1.
+        def snake(dims):
+            if len(dims) == 1:
+                return [(i,) for i in range(dims[0])]
+            outer = snake(dims[:-1])
+            path = []
+            for k, coord in enumerate(outer):
+                inner = range(dims[-1]) if k % 2 == 0 else range(dims[-1] - 1, -1, -1)
+                for i in inner:
+                    path.append(coord + (i,))
+            return path
+
+        strides = [1] * len(host_dims)
+        for i in range(len(host_dims) - 2, -1, -1):
+            strides[i] = strides[i + 1] * host_dims[i + 1]
+        return [sum(c * s for c, s in zip(coord, strides)) for coord in snake(host_dims)]
+
+
+def mesh_shape_for(
+    topo: SliceTopology,
+    num_slices: int = 1,
+    model_parallelism: Optional[int] = None,
+) -> Tuple[int, int]:
+    """Default (data, model) 2D logical mesh for a slice group.
+
+    Model axis rides ICI within the slice, data axis spans slices over DCN —
+    the scaling-book recipe.  ``model_parallelism`` defaults to the whole
+    slice (pure TP/FSDP inside the slice).
+    """
+    if num_slices < 1:
+        raise TopologyError(f"num_slices must be >= 1, got {num_slices}")
+    chips = topo.num_chips
+    mp = chips if model_parallelism is None else model_parallelism
+    if mp < 1 or chips % mp != 0:
+        raise TopologyError(f"model parallelism {mp} must divide {chips} chips")
+    return (num_slices * (chips // mp), mp)

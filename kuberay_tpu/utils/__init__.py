@@ -1,0 +1,1 @@
+"""Shared utilities: constants, hashing, validation, metrics, feature gates."""

@@ -1,0 +1,106 @@
+"""Well-known labels, env vars, ports, and annotations.
+
+TPU-native equivalent of the reference's utils/constant.go:38-48 (labels,
+incl. the multi-host replica/host-index trio), :112-120 (ports), :136-182
+(env names).  Names use the ``tpu.dev/`` prefix instead of ``ray.io/``; the
+env-var surface is the union of what the reference's pod builder sets and
+what GKE's external TPU webhook injects today (SURVEY.md §5.7) — injection
+is native here.
+"""
+
+# --- API group ---------------------------------------------------------------
+GROUP = "tpu.dev"
+VERSION = "v1"
+API_VERSION = f"{GROUP}/{VERSION}"
+
+KIND_CLUSTER = "TpuCluster"
+KIND_JOB = "TpuJob"
+KIND_SERVICE = "TpuService"
+KIND_CRONJOB = "TpuCronJob"
+
+# --- Labels (ref constant.go:38-48) ------------------------------------------
+LABEL_CLUSTER = "tpu.dev/cluster"                 # ray.io/cluster
+LABEL_NODE_TYPE = "tpu.dev/node-type"             # ray.io/node-type (head|worker)
+LABEL_GROUP = "tpu.dev/group"                     # ray.io/group
+LABEL_IDENTIFIER = "tpu.dev/identifier"           # <cluster>-<type>
+LABEL_CREATED_BY = "tpu.dev/created-by"           # app.kubernetes.io/created-by
+LABEL_ORIGINATED_FROM_CR_NAME = "tpu.dev/originated-from-cr-name"
+LABEL_ORIGINATED_FROM_CRD = "tpu.dev/originated-from-crd"
+# Multi-host slice identity trio (ref constant.go:46-48, pod.go:493-500):
+LABEL_SLICE_NAME = "tpu.dev/slice-name"           # worker-group-replica-name
+LABEL_SLICE_INDEX = "tpu.dev/slice-index"         # replica-index (int)
+LABEL_HOST_INDEX = "tpu.dev/host-index"           # replica-host-index (int)
+# Serving (ref rayservice_controller.go:2065 serve-label):
+LABEL_SERVE = "tpu.dev/serve"                     # "true"|"false" on head pods
+
+NODE_TYPE_HEAD = "head"
+NODE_TYPE_WORKER = "worker"
+CREATED_BY_OPERATOR = "kuberay-tpu-operator"
+
+# --- Annotations (ref constant.go:64-69) -------------------------------------
+ANNOTATION_OVERWRITE_CONTAINER_CMD = "tpu.dev/overwrite-container-cmd"
+ANNOTATION_FT_ENABLED = "tpu.dev/ft-enabled"
+ANNOTATION_FT_DELETION_TIMEOUT = "tpu.dev/ft-deletion-timeout"
+
+# --- GKE TPU node selectors (ref kubectl-plugin/pkg/util/constant.go:13-19) --
+NODE_SELECTOR_GKE_ACCELERATOR = "cloud.google.com/gke-tpu-accelerator"
+NODE_SELECTOR_GKE_TOPOLOGY = "cloud.google.com/gke-tpu-topology"
+RESOURCE_TPU = "google.com/tpu"
+RESOURCE_NVIDIA_GPU = "nvidia.com/gpu"
+
+# --- TPU runtime env (injected natively by the pod builder) ------------------
+# Identity within the slice; consumed by libtpu/XLA to wire the ICI mesh.
+ENV_TPU_WORKER_ID = "TPU_WORKER_ID"
+ENV_TPU_WORKER_HOSTNAMES = "TPU_WORKER_HOSTNAMES"
+ENV_TPU_TOPOLOGY = "TPU_TOPOLOGY"
+ENV_TPU_CHIPS_PER_HOST_BOUNDS = "TPU_CHIPS_PER_HOST_BOUNDS"
+ENV_TPU_ACCELERATOR_TYPE = "TPU_ACCELERATOR_TYPE"
+# Multi-slice (DCN) coordination — JAX megascale (SURVEY.md §5.8):
+ENV_MEGASCALE_COORDINATOR_ADDRESS = "MEGASCALE_COORDINATOR_ADDRESS"
+ENV_MEGASCALE_NUM_SLICES = "MEGASCALE_NUM_SLICES"
+ENV_MEGASCALE_SLICE_ID = "MEGASCALE_SLICE_ID"
+# JAX distributed init (coordinator = head service, analogous RAY_ADDRESS):
+ENV_COORDINATOR_ADDRESS = "TPU_COORDINATOR_ADDRESS"   # ~ RAY_ADDRESS
+ENV_FQ_HEAD_IP = "FQ_TPU_HEAD_IP"                     # ~ FQ_RAY_IP
+ENV_CLUSTER_NAME = "TPU_CLUSTER_NAME"                 # ~ RAY_CLUSTER_NAME
+ENV_NUM_PROCESSES = "TPU_NUM_PROCESSES"
+ENV_PROCESS_ID = "TPU_PROCESS_ID"
+
+# --- Ports (ref constant.go:112-120) -----------------------------------------
+PORT_COORDINATOR = 8476         # jax.distributed coordinator (~GCS 6379)
+PORT_DASHBOARD = 8265           # runtime dashboard / job API (same as Ray's)
+PORT_METRICS = 8080             # Prometheus metrics on every node
+PORT_SERVE = 8000               # inference HTTP
+PORT_MXLA = 8081                # MXLA coordinator (multi-slice samples)
+PORT_CLIENT = 10001
+
+DEFAULT_COORDINATOR_PORT_NAME = "coordinator"
+DEFAULT_DASHBOARD_PORT_NAME = "dashboard"
+DEFAULT_METRICS_PORT_NAME = "metrics"
+DEFAULT_SERVE_PORT_NAME = "serve"
+
+# --- Head service suffixes ---------------------------------------------------
+HEAD_SVC_SUFFIX = "head-svc"
+HEADLESS_SVC_SUFFIX = "headless"
+SERVE_SVC_SUFFIX = "serve-svc"
+
+# --- Finalizers --------------------------------------------------------------
+FINALIZER_GCS_FT = f"{GROUP}/gcs-ft-finalizer"
+FINALIZER_JOB = f"{GROUP}/tpujob-finalizer"
+FINALIZER_SERVICE = f"{GROUP}/tpuservice-finalizer"
+
+# --- Event reasons (ref constant.go EventType section) -----------------------
+EVENT_CREATED_POD = "CreatedPod"
+EVENT_DELETED_POD = "DeletedPod"
+EVENT_CREATED_SLICE = "CreatedSlice"
+EVENT_DELETED_SLICE = "DeletedSlice"
+EVENT_CREATED_SERVICE = "CreatedService"
+EVENT_FAILED_TO_CREATE = "FailedToCreate"
+EVENT_UNHEALTHY_SLICE = "UnhealthySlice"
+EVENT_INVALID_SPEC = "InvalidSpec"
+
+# --- Behavior knobs (ref §5.6 env escape hatches) ----------------------------
+ENV_ENABLE_RANDOM_POD_DELETE = "ENABLE_RANDOM_POD_DELETE"
+ENV_DEFAULT_REQUEUE_SECONDS = "TPUCLUSTER_DEFAULT_REQUEUE_SECONDS"
+DEFAULT_REQUEUE_SECONDS = 300
+DEFAULT_RECONCILE_REQUEUE_SECONDS = 2.0

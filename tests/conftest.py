@@ -1,0 +1,17 @@
+"""Test harness: force an 8-device virtual CPU mesh before JAX initializes.
+
+Mirrors the reference's envtest strategy (SURVEY.md §4 tier 2): multi-host
+behavior is tested without real hardware — there, a real kube-apiserver with
+hand-set pod phases; here, a virtual 8-device CPU platform so every sharding
+and collective path compiles and executes exactly as it would on a slice.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

@@ -1,0 +1,135 @@
+"""Topology math: the invariant base for slice-atomic scheduling."""
+
+import pytest
+
+from kuberay_tpu.topology import (
+    SliceTopology,
+    TopologyError,
+    get_generation,
+    mesh_shape_for,
+    parse_topology,
+)
+
+
+def test_parse_topology():
+    assert parse_topology("4x4") == (4, 4)
+    assert parse_topology("2x2x2") == (2, 2, 2)
+    assert parse_topology("16x16") == (16, 16)
+    with pytest.raises(TopologyError):
+        parse_topology("4xx4")
+    with pytest.raises(TopologyError):
+        parse_topology("")
+    with pytest.raises(TopologyError):
+        parse_topology("0x4")
+
+
+def test_generation_aliases():
+    assert get_generation("v5litepod").name == "v5e"
+    assert get_generation("Trillium").name == "v6e"
+    with pytest.raises(TopologyError):
+        get_generation("v99")
+
+
+@pytest.mark.parametrize(
+    "gen,topo,chips,hosts,chips_per_host",
+    [
+        ("v5e", "2x2", 4, 1, 4),        # single-host v5e-4 (BASELINE config #2)
+        ("v5e", "2x4", 8, 1, 8),        # single-host 8-chip attachment
+        ("v5e", "4x4", 16, 4, 4),       # v5e-16 (BASELINE config #4)
+        ("v5e", "16x16", 256, 64, 4),
+        ("v5p", "2x2x2", 8, 2, 4),
+        ("v5p", "4x4x4", 64, 16, 4),    # v5p-64 (BASELINE config #3: 4x4 PodSlice)
+        ("v5p", "2x2x4", 16, 4, 4),     # v5p-32-ish two-group EP (config #5)
+        # ray-job.tpu-v6e-16-multihost.yaml: numOfHosts: 4, google.com/tpu: 4
+        ("v6e", "4x4", 16, 4, 4),
+        ("v4", "2x2x4", 16, 4, 4),
+    ],
+)
+def test_slice_math(gen, topo, chips, hosts, chips_per_host):
+    s = SliceTopology.create(gen, topo)
+    assert s.num_chips == chips
+    assert s.num_hosts == hosts
+    assert s.chips_per_host == chips_per_host
+    assert s.is_multi_host == (hosts > 1)
+
+
+def test_dims_mismatch():
+    with pytest.raises(TopologyError):
+        SliceTopology.create("v5e", "2x2x2")   # v5e is 2D
+    with pytest.raises(TopologyError):
+        SliceTopology.create("v5p", "4x4")     # v5p is 3D
+
+
+def test_ring_order_is_permutation():
+    for gen, topo in [("v5e", "4x4"), ("v5p", "4x4x4"), ("v5e", "16x16")]:
+        s = SliceTopology.create(gen, topo)
+        order = s.host_ring_order()
+        assert sorted(order) == list(range(s.num_hosts))
+
+
+def test_ring_order_3d_host_grid_neighborwise():
+    # v5p 8x8x8: 512 chips / 4 per host = 128 hosts, host grid (8, 8, 2).
+    s = SliceTopology.create("v5p", "8x8x8")
+    grid = s.host_grid_dims()
+    assert s.num_hosts == 128 and grid == (8, 8, 2)
+    order = list(s.host_ring_order())
+    assert sorted(order) == list(range(128))
+    # Every consecutive hop moves exactly one grid coordinate by 1.
+    strides = (grid[1] * grid[2], grid[2], 1)
+
+    def coords(i):
+        return (i // strides[0], (i // strides[1]) % grid[1], i % grid[2])
+
+    for a, b in zip(order, order[1:]):
+        ca, cb = coords(a), coords(b)
+        assert sum(abs(x - y) for x, y in zip(ca, cb)) == 1, (ca, cb)
+
+
+def test_invalid_gke_topologies_rejected():
+    with pytest.raises(TopologyError):
+        SliceTopology.create("v5e", "2x12")   # divisible by 8 but no such pool
+    with pytest.raises(TopologyError):
+        SliceTopology.create("v5e", "1x8")
+    with pytest.raises(TopologyError):
+        SliceTopology.create("v5p", "2x2x6")  # 6 is not 1, 2, or mult of 4
+
+
+def test_ring_order_snake_is_neighborwise():
+    # 64 hosts of a v5e 16x16: host grid is 16 rows x 4 cols -> snake path.
+    s = SliceTopology.create("v5e", "16x16")
+    assert s.host_grid_dims() == (16, 4)
+    order = list(s.host_ring_order())
+    assert len(order) == 64
+    # Consecutive entries differ by a single grid step (row or col neighbor).
+    cols = 4
+    for a, b in zip(order, order[1:]):
+        ra, ca = divmod(a, cols)
+        rb, cb = divmod(b, cols)
+        assert abs(ra - rb) + abs(ca - cb) == 1
+
+
+def test_host_grid_single_host():
+    assert SliceTopology.create("v5e", "2x2").host_grid_dims() == (1,)
+
+
+def test_transposed_2d_topology_rejected():
+    with pytest.raises(TopologyError):
+        SliceTopology.create("v5e", "8x4")   # only canonical '4x8' exists
+
+
+def test_mesh_shape_bad_num_slices():
+    s = SliceTopology.create("v5p", "4x4x4")
+    with pytest.raises(TopologyError):
+        mesh_shape_for(s, num_slices=0)
+
+
+def test_mesh_shape():
+    s = SliceTopology.create("v5p", "4x4x4")
+    assert mesh_shape_for(s) == (1, 64)
+    assert mesh_shape_for(s, num_slices=2, model_parallelism=16) == (8, 16)
+    with pytest.raises(TopologyError):
+        mesh_shape_for(s, model_parallelism=7)
+    with pytest.raises(TopologyError):
+        mesh_shape_for(s, model_parallelism=0)
+    with pytest.raises(TopologyError):
+        mesh_shape_for(s, model_parallelism=-4)
