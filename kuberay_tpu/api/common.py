@@ -1,0 +1,200 @@
+"""Common object model: metadata, pod templates, conditions.
+
+K8s-shaped but dependency-free.  Dataclasses serialize to/from plain dicts
+(``to_dict``/``from_dict``) so objects round-trip through the store, the REST
+gateway, and YAML manifests exactly like CRs do through the K8s API.
+
+Mirrors the role of k8s apimachinery for the reference's apis/ray/v1 types.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional
+
+
+def _prune(d):
+    """Drop None values and empty containers recursively (K8s-style JSON)."""
+    if isinstance(d, dict):
+        out = {k: _prune(v) for k, v in d.items()}
+        return {k: v for k, v in out.items() if v not in (None, {}, [])}
+    if isinstance(d, list):
+        return [_prune(v) for v in d]
+    return d
+
+
+class Serializable:
+    """dict round-tripping for nested dataclasses."""
+
+    def to_dict(self) -> Dict[str, Any]:
+        return _prune(dataclasses.asdict(self))
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]):
+        if d is None:
+            return None
+        kwargs = {}
+        for f in dataclasses.fields(cls):
+            if f.name not in d:
+                continue
+            v = d[f.name]
+            ftype = cls._nested_types().get(f.name)
+            if ftype is not None and v is not None:
+                if isinstance(v, list):
+                    v = [ftype.from_dict(x) if isinstance(x, dict) else x for x in v]
+                elif isinstance(v, dict):
+                    v = ftype.from_dict(v)
+            kwargs[f.name] = copy.deepcopy(v)
+        return cls(**kwargs)
+
+    @classmethod
+    def _nested_types(cls) -> Dict[str, type]:
+        """Map field name -> nested Serializable type (overridden as needed)."""
+        return {}
+
+
+@dataclasses.dataclass
+class OwnerReference(Serializable):
+    apiVersion: str = ""
+    kind: str = ""
+    name: str = ""
+    uid: str = ""
+    controller: bool = True
+    blockOwnerDeletion: bool = True
+
+
+@dataclasses.dataclass
+class ObjectMeta(Serializable):
+    name: str = ""
+    namespace: str = "default"
+    uid: str = ""
+    resourceVersion: int = 0
+    generation: int = 0
+    creationTimestamp: float = 0.0
+    deletionTimestamp: Optional[float] = None
+    labels: Dict[str, str] = dataclasses.field(default_factory=dict)
+    annotations: Dict[str, str] = dataclasses.field(default_factory=dict)
+    finalizers: List[str] = dataclasses.field(default_factory=list)
+    ownerReferences: List[OwnerReference] = dataclasses.field(default_factory=list)
+
+    @classmethod
+    def _nested_types(cls):
+        return {"ownerReferences": OwnerReference}
+
+
+@dataclasses.dataclass
+class EnvVar(Serializable):
+    name: str = ""
+    value: str = ""
+
+
+@dataclasses.dataclass
+class ContainerPort(Serializable):
+    name: str = ""
+    containerPort: int = 0
+
+
+@dataclasses.dataclass
+class ResourceRequirements(Serializable):
+    # {"cpu": "4", "memory": "16Gi", "google.com/tpu": "4"}
+    requests: Dict[str, str] = dataclasses.field(default_factory=dict)
+    limits: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class Container(Serializable):
+    name: str = ""
+    image: str = ""
+    command: List[str] = dataclasses.field(default_factory=list)
+    args: List[str] = dataclasses.field(default_factory=list)
+    env: List[EnvVar] = dataclasses.field(default_factory=list)
+    ports: List[ContainerPort] = dataclasses.field(default_factory=list)
+    resources: ResourceRequirements = dataclasses.field(default_factory=ResourceRequirements)
+    workingDir: str = ""
+
+    @classmethod
+    def _nested_types(cls):
+        return {"env": EnvVar, "ports": ContainerPort,
+                "resources": ResourceRequirements}
+
+
+@dataclasses.dataclass
+class PodSpec(Serializable):
+    containers: List[Container] = dataclasses.field(default_factory=list)
+    initContainers: List[Container] = dataclasses.field(default_factory=list)
+    nodeSelector: Dict[str, str] = dataclasses.field(default_factory=dict)
+    tolerations: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+    restartPolicy: str = ""
+    serviceAccountName: str = ""
+    subdomain: str = ""
+    hostname: str = ""
+    schedulerName: str = ""
+
+    @classmethod
+    def _nested_types(cls):
+        return {"containers": Container, "initContainers": Container}
+
+
+@dataclasses.dataclass
+class PodTemplateSpec(Serializable):
+    metadata: ObjectMeta = dataclasses.field(default_factory=ObjectMeta)
+    spec: PodSpec = dataclasses.field(default_factory=PodSpec)
+
+    @classmethod
+    def _nested_types(cls):
+        return {"metadata": ObjectMeta, "spec": PodSpec}
+
+
+@dataclasses.dataclass
+class Condition(Serializable):
+    """K8s-style status condition (metav1.Condition shape)."""
+
+    type: str = ""
+    status: str = "Unknown"     # "True" | "False" | "Unknown"
+    reason: str = ""
+    message: str = ""
+    lastTransitionTime: float = 0.0
+    observedGeneration: int = 0
+
+
+def set_condition(conditions: List[Condition], cond: Condition) -> bool:
+    """Upsert by type; preserves lastTransitionTime when status unchanged.
+
+    Returns True when the condition *meaningfully* changed
+    (status/reason/message — drives status-update throttling, the
+    reference's consistency.go:16 pattern).  ``observedGeneration`` is
+    always refreshed on the stored condition (k8s meta.SetStatusCondition
+    behavior) but does not by itself count as a change.  The input is
+    copied, never aliased.
+    """
+    cond = copy.deepcopy(cond)
+    for i, existing in enumerate(conditions):
+        if existing.type == cond.type:
+            if (existing.status == cond.status and existing.reason == cond.reason
+                    and existing.message == cond.message):
+                existing.observedGeneration = cond.observedGeneration
+                return False
+            if existing.status == cond.status:
+                cond.lastTransitionTime = existing.lastTransitionTime
+            elif not cond.lastTransitionTime:
+                cond.lastTransitionTime = time.time()
+            conditions[i] = cond
+            return True
+    if not cond.lastTransitionTime:
+        cond.lastTransitionTime = time.time()
+    conditions.append(cond)
+    return True
+
+
+def get_condition(conditions: List[Condition], ctype: str) -> Optional[Condition]:
+    for c in conditions:
+        if c.type == ctype:
+            return c
+    return None
+
+
+def is_condition_true(conditions: List[Condition], ctype: str) -> bool:
+    c = get_condition(conditions, ctype)
+    return c is not None and c.status == "True"

@@ -1,0 +1,44 @@
+"""Operator configuration (ref apis/config/v1alpha1/configuration_types.go:18-78).
+
+Three config layers like the reference (§5.6): CLI flags ⊕ this structured
+config ⊕ feature gates.  Env-var escape hatches are read at use sites.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+from kuberay_tpu.api.common import Serializable
+
+
+@dataclasses.dataclass
+class OperatorConfiguration(Serializable):
+    metricsAddr: str = ":8080"
+    probeAddr: str = ":8082"
+    enableLeaderElection: bool = True
+    leaderElectionNamespace: str = "default"
+    reconcileConcurrency: int = 1
+    watchNamespaces: List[str] = dataclasses.field(default_factory=list)
+    logLevel: str = "info"
+    logFile: str = ""
+    logStdoutEncoder: str = "json"      # json | console
+    # Gang scheduler plugin name ("" = builtin, or volcano|yunikorn|kai|
+    # scheduler-plugins — ref batch-scheduler name in config):
+    batchScheduler: str = ""
+    enableBatchScheduler: bool = False
+    # Injected into every built pod (ref default envs/labels/annotations):
+    defaultPodEnv: Dict[str, str] = dataclasses.field(default_factory=dict)
+    defaultPodLabels: Dict[str, str] = dataclasses.field(default_factory=dict)
+    defaultPodAnnotations: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # Client-side rate limits (ref QPS/burst):
+    clientQps: float = 50.0
+    clientBurst: int = 100
+    # Requeue cadences:
+    requeueSeconds: float = 2.0
+    unconditionalRequeueSeconds: float = 300.0
+    # Feature gates, e.g. {"TpuMultiHostIndexing": True}:
+    featureGates: Dict[str, bool] = dataclasses.field(default_factory=dict)
+    # Head sidecars to inject (ref sidecar containers config):
+    headSidecarContainers: List[dict] = dataclasses.field(default_factory=list)
+    workerSidecarContainers: List[dict] = dataclasses.field(default_factory=list)

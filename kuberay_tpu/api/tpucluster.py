@@ -1,0 +1,219 @@
+"""TpuCluster CRD-equivalent types.
+
+TPU-first re-design of the reference's RayClusterSpec
+(apis/ray/v1/raycluster_types.go:14-62) and WorkerGroupSpec (:374-418):
+
+- A worker group declares ``accelerator`` + ``topology`` and ``replicas``
+  counts *slices*, not pods.  ``numHosts`` is derived from the topology
+  (never free-form like the reference's ``NumOfHosts``), so a spec cannot
+  describe a slice the hardware can't form.
+- The autoscaler contract (:421-424 Replicas/ScaleStrategy.WorkersToDelete)
+  becomes slice-granular: ``scaleStrategy.slicesToDelete`` names whole
+  slices.
+- Head fault tolerance (GcsFaultToleranceOptions :131) maps to coordinator
+  state persistence options.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+from kuberay_tpu.api.common import (
+    Condition,
+    ObjectMeta,
+    PodTemplateSpec,
+    Serializable,
+)
+from kuberay_tpu.topology import SliceTopology
+from kuberay_tpu.utils import constants as C
+
+
+# --- enums -------------------------------------------------------------------
+
+class ClusterState:
+    """Status.state values (ref raycluster_types.go state enum)."""
+
+    READY = "ready"
+    SUSPENDED = "suspended"
+    FAILED = "failed"
+
+
+class ClusterConditionType:
+    """Status condition types (ref raycluster_types.go:500-610)."""
+
+    HEAD_POD_READY = "HeadPodReady"
+    PROVISIONED = "TpuClusterProvisioned"      # all slices ready at least once
+    REPLICA_FAILURE = "ReplicaFailure"
+    SUSPENDING = "TpuClusterSuspending"
+    SUSPENDED = "TpuClusterSuspended"
+
+
+class UpgradeStrategyType:
+    """In-place upgrade behavior when the pod spec hash changes."""
+
+    RECREATE = "Recreate"         # delete all pods, rebuild (ref Recreate path)
+    NONE = "None"                 # ignore spec changes for existing pods
+
+
+# --- spec --------------------------------------------------------------------
+
+@dataclasses.dataclass
+class AutoscalerOptions(Serializable):
+    """Ref AutoscalerOptions (raycluster_types.go:427-476), slice-granular."""
+
+    idleTimeoutSeconds: int = 60
+    upscalingMode: str = "Default"      # Default | Aggressive | Conservative
+    imagePullPolicy: str = ""
+    image: str = ""
+    # v2-style per-group overrides land on the group spec, not here.
+
+
+@dataclasses.dataclass
+class HeadStateOptions(Serializable):
+    """Coordinator fault tolerance (ref GcsFaultToleranceOptions
+    raycluster_types.go:131 + gcs_ft.go:17 embedded variant).
+
+    ``backend`` 'memory' keeps cluster metadata in-process (workers die with
+    the head); 'external' points at a Redis-compatible store; 'persistent'
+    provisions a PVC the coordinator journals to (embedded-RocksDB analogue).
+    """
+
+    backend: str = "memory"             # memory | external | persistent
+    externalStorageAddress: str = ""
+    externalStorageNamespace: str = ""
+    storageSize: str = "10Gi"
+    storageClassName: str = ""
+
+
+@dataclasses.dataclass
+class HeadGroupSpec(Serializable):
+    template: PodTemplateSpec = dataclasses.field(default_factory=PodTemplateSpec)
+    serviceType: str = "ClusterIP"
+    enableIngress: bool = False
+    startParams: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+    @classmethod
+    def _nested_types(cls):
+        return {"template": PodTemplateSpec}
+
+
+@dataclasses.dataclass
+class ScaleStrategy(Serializable):
+    """Autoscaler downscale contract: names whole slices (ref
+    ScaleStrategy.WorkersToDelete raycluster_types.go:421-424, expanded to
+    groups at raycluster_controller.go:1293-1322 — here it is group-native)."""
+
+    slicesToDelete: List[str] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class WorkerGroupSpec(Serializable):
+    groupName: str = ""
+    accelerator: str = "v5e"            # TPU generation
+    topology: str = "2x2"               # ICI topology, e.g. "4x4" / "4x4x4"
+    replicas: int = 1                   # number of slices
+    minReplicas: int = 0
+    maxReplicas: int = 1
+    suspend: bool = False
+    scaleStrategy: ScaleStrategy = dataclasses.field(default_factory=ScaleStrategy)
+    template: PodTemplateSpec = dataclasses.field(default_factory=PodTemplateSpec)
+    startParams: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+    @classmethod
+    def _nested_types(cls):
+        return {"scaleStrategy": ScaleStrategy, "template": PodTemplateSpec}
+
+    def slice_topology(self) -> SliceTopology:
+        return SliceTopology.create(self.accelerator, self.topology)
+
+    @property
+    def num_hosts(self) -> int:
+        """Pods per slice — derived, never declared."""
+        return self.slice_topology().num_hosts
+
+
+@dataclasses.dataclass
+class NetworkPolicySpec(Serializable):
+    """Ref raycluster_types.go:254-311 (NetworkPolicy modes)."""
+
+    enabled: bool = False
+    mode: str = "DenyAll"               # DenyAll | DenyAllEgress
+    allowNamespaces: List[str] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class TpuClusterSpec(Serializable):
+    headGroupSpec: HeadGroupSpec = dataclasses.field(default_factory=HeadGroupSpec)
+    workerGroupSpecs: List[WorkerGroupSpec] = dataclasses.field(default_factory=list)
+    suspend: bool = False
+    enableInTreeAutoscaling: bool = False
+    autoscalerOptions: Optional[AutoscalerOptions] = None
+    headStateOptions: Optional[HeadStateOptions] = None
+    networkPolicy: Optional[NetworkPolicySpec] = None
+    upgradeStrategy: str = UpgradeStrategyType.NONE
+    # Kueue-style handoff (ref ManagedBy raycluster_types.go:25-34):
+    managedBy: str = ""
+    # Gang scheduler selection (ref batchscheduler labels):
+    schedulerName: str = ""
+    gangSchedulingQueue: str = ""
+
+    @classmethod
+    def _nested_types(cls):
+        return {
+            "headGroupSpec": HeadGroupSpec,
+            "workerGroupSpecs": WorkerGroupSpec,
+            "autoscalerOptions": AutoscalerOptions,
+            "headStateOptions": HeadStateOptions,
+            "networkPolicy": NetworkPolicySpec,
+        }
+
+
+# --- status ------------------------------------------------------------------
+
+@dataclasses.dataclass
+class WorkerGroupStatus(Serializable):
+    groupName: str = ""
+    desiredSlices: int = 0
+    readySlices: int = 0
+    desiredHosts: int = 0
+    readyHosts: int = 0
+    desiredTpuChips: int = 0
+
+
+@dataclasses.dataclass
+class TpuClusterStatus(Serializable):
+    state: str = ""
+    reason: str = ""
+    observedGeneration: int = 0
+    conditions: List[Condition] = dataclasses.field(default_factory=list)
+    readyWorkerHosts: int = 0
+    desiredWorkerHosts: int = 0
+    readySlices: int = 0
+    desiredSlices: int = 0
+    desiredTpuChips: int = 0
+    groups: List[WorkerGroupStatus] = dataclasses.field(default_factory=list)
+    headServiceName: str = ""
+    headPodName: str = ""
+    headPodIP: str = ""
+    coordinatorAddress: str = ""
+    lastResumeTime: float = 0.0
+    stateTransitionTimes: Dict[str, float] = dataclasses.field(default_factory=dict)
+
+    @classmethod
+    def _nested_types(cls):
+        return {"conditions": Condition, "groups": WorkerGroupStatus}
+
+
+@dataclasses.dataclass
+class TpuCluster(Serializable):
+    apiVersion: str = C.API_VERSION
+    kind: str = C.KIND_CLUSTER
+    metadata: ObjectMeta = dataclasses.field(default_factory=ObjectMeta)
+    spec: TpuClusterSpec = dataclasses.field(default_factory=TpuClusterSpec)
+    status: TpuClusterStatus = dataclasses.field(default_factory=TpuClusterStatus)
+
+    @classmethod
+    def _nested_types(cls):
+        return {"metadata": ObjectMeta, "spec": TpuClusterSpec,
+                "status": TpuClusterStatus}

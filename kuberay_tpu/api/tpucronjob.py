@@ -1,0 +1,57 @@
+"""TpuCronJob CRD-equivalent types (ref apis/ray/v1/raycronjob_types.go)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List
+
+from kuberay_tpu.api.common import Condition, ObjectMeta, Serializable
+from kuberay_tpu.api.tpujob import TpuJobSpec
+from kuberay_tpu.utils import constants as C
+
+
+class ConcurrencyPolicy:
+    ALLOW = "Allow"
+    FORBID = "Forbid"
+    REPLACE = "Replace"
+
+
+@dataclasses.dataclass
+class TpuCronJobSpec(Serializable):
+    schedule: str = ""                  # standard 5-field cron
+    concurrencyPolicy: str = ConcurrencyPolicy.ALLOW
+    suspend: bool = False
+    startingDeadlineSeconds: int = 0    # missed-run catch-up window
+    successfulJobsHistoryLimit: int = 3
+    failedJobsHistoryLimit: int = 1
+    jobTemplate: TpuJobSpec = dataclasses.field(default_factory=TpuJobSpec)
+
+    @classmethod
+    def _nested_types(cls):
+        return {"jobTemplate": TpuJobSpec}
+
+
+@dataclasses.dataclass
+class TpuCronJobStatus(Serializable):
+    lastScheduleTime: float = 0.0
+    lastSuccessfulTime: float = 0.0
+    activeJobNames: List[str] = dataclasses.field(default_factory=list)
+    conditions: List[Condition] = dataclasses.field(default_factory=list)
+
+    @classmethod
+    def _nested_types(cls):
+        return {"conditions": Condition}
+
+
+@dataclasses.dataclass
+class TpuCronJob(Serializable):
+    apiVersion: str = C.API_VERSION
+    kind: str = C.KIND_CRONJOB
+    metadata: ObjectMeta = dataclasses.field(default_factory=ObjectMeta)
+    spec: TpuCronJobSpec = dataclasses.field(default_factory=TpuCronJobSpec)
+    status: TpuCronJobStatus = dataclasses.field(default_factory=TpuCronJobStatus)
+
+    @classmethod
+    def _nested_types(cls):
+        return {"metadata": ObjectMeta, "spec": TpuCronJobSpec,
+                "status": TpuCronJobStatus}

@@ -1,0 +1,164 @@
+"""TpuJob CRD-equivalent types.
+
+Job lifecycle state machine mirroring the reference's RayJob
+(apis/ray/v1/rayjob_types.go): submission modes (:80-87), deletion strategy
+(:108), backoff/deadlines (:209-217,283).  The payload a submitter launches
+is a JAX program against the cluster coordinator instead of ``ray job
+submit`` against a dashboard.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+from kuberay_tpu.api.common import Condition, ObjectMeta, PodTemplateSpec, Serializable
+from kuberay_tpu.api.tpucluster import TpuClusterSpec
+from kuberay_tpu.utils import constants as C
+
+
+class JobSubmissionMode:
+    """Ref rayjob_types.go:80-87."""
+
+    K8S_JOB = "K8sJobMode"            # operator creates a submitter Job
+    HTTP = "HTTPMode"                 # operator submits via coordinator HTTP
+    SIDECAR = "SidecarMode"           # submitter container in head pod
+    INTERACTIVE = "InteractiveMode"   # user submits manually
+
+
+class JobDeploymentStatus:
+    """Ref rayjob_controller.go:165-462 state machine states."""
+
+    NEW = "New"
+    INITIALIZING = "Initializing"
+    WAITING = "Waiting"               # interactive mode: cluster up, no job
+    RUNNING = "Running"
+    COMPLETE = "Complete"
+    FAILED = "Failed"
+    SUSPENDING = "Suspending"
+    SUSPENDED = "Suspended"
+    RETRYING = "Retrying"
+
+
+class JobStatus:
+    """Application-level job status (ref rayv1.JobStatus)."""
+
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    STOPPED = "STOPPED"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+
+    TERMINAL = (STOPPED, SUCCEEDED, FAILED)
+
+
+class JobFailedReason:
+    SUBMISSION_FAILED = "SubmissionFailed"
+    DEADLINE_EXCEEDED = "DeadlineExceeded"
+    APP_FAILED = "AppFailed"
+    VALIDATION_FAILED = "ValidationFailed"
+
+
+class DeletionPolicyType:
+    """Ref DeletionStrategy (rayjob_types.go:108): what to delete when."""
+
+    DELETE_CLUSTER = "DeleteCluster"    # delete the TpuCluster CR
+    DELETE_WORKERS = "DeleteWorkers"    # keep head, delete worker slices
+    DELETE_SELF = "DeleteSelf"          # delete the TpuJob CR itself
+    DELETE_NONE = "DeleteNone"
+
+
+@dataclasses.dataclass
+class DeletionRule(Serializable):
+    """Apply ``policy`` ``ttlSeconds`` after the job reaches ``condition``."""
+
+    policy: str = DeletionPolicyType.DELETE_NONE
+    condition: str = "Succeeded"        # Succeeded | Failed
+    ttlSeconds: int = 0
+
+
+@dataclasses.dataclass
+class DeletionStrategy(Serializable):
+    rules: List[DeletionRule] = dataclasses.field(default_factory=list)
+
+    @classmethod
+    def _nested_types(cls):
+        return {"rules": DeletionRule}
+
+
+@dataclasses.dataclass
+class SubmitterConfig(Serializable):
+    """Submitter pod knobs (ref SubmitterPodTemplate + backoff)."""
+
+    template: Optional[PodTemplateSpec] = None
+    backoffLimit: int = 2
+
+    @classmethod
+    def _nested_types(cls):
+        return {"template": PodTemplateSpec}
+
+
+@dataclasses.dataclass
+class TpuJobSpec(Serializable):
+    entrypoint: str = ""
+    # runtime env: pip/env-vars/working-dir, serialized dict like the ref's
+    # RuntimeEnvYAML (rayjob_types.go):
+    runtimeEnv: Dict[str, str] = dataclasses.field(default_factory=dict)
+    metadata: Dict[str, str] = dataclasses.field(default_factory=dict)
+    entrypointNumTpuChips: int = 0      # chips the entrypoint step consumes
+    clusterSpec: Optional[TpuClusterSpec] = None
+    clusterSelector: Dict[str, str] = dataclasses.field(default_factory=dict)
+    submissionMode: str = JobSubmissionMode.K8S_JOB
+    submitterConfig: SubmitterConfig = dataclasses.field(default_factory=SubmitterConfig)
+    suspend: bool = False
+    shutdownAfterJobFinishes: bool = True
+    ttlSecondsAfterFinished: int = 0
+    activeDeadlineSeconds: int = 0      # whole-job deadline (:209)
+    preRunningDeadlineSeconds: int = 0  # deadline to *reach* Running (:283)
+    backoffLimit: int = 0               # retries with fresh clusters (:213-217)
+    deletionStrategy: Optional[DeletionStrategy] = None
+    managedBy: str = ""
+    schedulerName: str = ""
+    gangSchedulingQueue: str = ""
+
+    @classmethod
+    def _nested_types(cls):
+        return {
+            "clusterSpec": TpuClusterSpec,
+            "submitterConfig": SubmitterConfig,
+            "deletionStrategy": DeletionStrategy,
+        }
+
+
+@dataclasses.dataclass
+class TpuJobStatus(Serializable):
+    jobId: str = ""
+    clusterName: str = ""
+    jobStatus: str = ""                  # application-level (JobStatus)
+    jobDeploymentStatus: str = JobDeploymentStatus.NEW
+    reason: str = ""
+    message: str = ""
+    startTime: float = 0.0
+    endTime: float = 0.0
+    succeeded: int = 0
+    failed: int = 0                      # retry attempts that failed
+    observedGeneration: int = 0
+    conditions: List[Condition] = dataclasses.field(default_factory=list)
+    clusterStatus: Dict[str, object] = dataclasses.field(default_factory=dict)
+
+    @classmethod
+    def _nested_types(cls):
+        return {"conditions": Condition}
+
+
+@dataclasses.dataclass
+class TpuJob(Serializable):
+    apiVersion: str = C.API_VERSION
+    kind: str = C.KIND_JOB
+    metadata: ObjectMeta = dataclasses.field(default_factory=ObjectMeta)
+    spec: TpuJobSpec = dataclasses.field(default_factory=TpuJobSpec)
+    status: TpuJobStatus = dataclasses.field(default_factory=TpuJobStatus)
+
+    @classmethod
+    def _nested_types(cls):
+        return {"metadata": ObjectMeta, "spec": TpuJobSpec, "status": TpuJobStatus}

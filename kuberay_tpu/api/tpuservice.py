@@ -1,0 +1,133 @@
+"""TpuService CRD-equivalent types: zero-downtime serving.
+
+Mirrors the reference's RayService (apis/ray/v1/rayservice_types.go):
+upgrade strategies (:22-33), ClusterUpgradeOptions (:64-77), active/pending
+two-cluster status.  The serve payload is a continuous-batching JAX
+inference engine (kuberay_tpu.serve) instead of Ray Serve; "roll TPU slices
+without breaking ICI rings" means upgrades replace whole slices behind
+weighted routes, never individual hosts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+from kuberay_tpu.api.common import Condition, ObjectMeta, Serializable
+from kuberay_tpu.api.tpucluster import TpuClusterSpec
+from kuberay_tpu.utils import constants as C
+
+
+class ServiceUpgradeType:
+    """Ref RayServiceUpgradeType (rayservice_types.go:22-33)."""
+
+    NEW_CLUSTER = "NewCluster"                  # blue/green: full pending cluster
+    INCREMENTAL = "NewClusterWithIncrementalUpgrade"  # weighted traffic stepping
+    NONE = "None"                               # never upgrade automatically
+
+
+class ServiceStatusName:
+    """Per-cluster serve application health."""
+
+    RUNNING = "RUNNING"
+    DEPLOYING = "DEPLOYING"
+    UNHEALTHY = "UNHEALTHY"
+    NOT_STARTED = "NOT_STARTED"
+
+
+class ServiceConditionType:
+    """Ref rayservice conditions (:776)."""
+
+    READY = "Ready"
+    UPGRADE_IN_PROGRESS = "UpgradeInProgress"
+    ROLLING_BACK = "RollingBack"
+
+
+@dataclasses.dataclass
+class ClusterUpgradeOptions(Serializable):
+    """Ref ClusterUpgradeOptions (rayservice_types.go:64-77).
+
+    Slice-quantized: ``stepSizePercent`` of traffic is shifted every
+    ``intervalSeconds`` once the pending cluster's target capacity covers it;
+    capacity moves in whole-slice units (SURVEY.md §7 hard part 3).
+    """
+
+    stepSizePercent: int = 10
+    intervalSeconds: int = 30
+    maxSurgePercent: int = 100          # extra capacity allowed during roll
+
+
+@dataclasses.dataclass
+class TpuServiceSpec(Serializable):
+    # Serve config: model/apps description consumed by the inference engine
+    # (analogue of the ref's ServeConfigV2 multi-app YAML blob).
+    serveConfig: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    clusterSpec: TpuClusterSpec = dataclasses.field(default_factory=TpuClusterSpec)
+    upgradeStrategy: str = ServiceUpgradeType.NEW_CLUSTER
+    upgradeOptions: Optional[ClusterUpgradeOptions] = None
+    suspend: bool = False
+    # Seconds to keep the retired active cluster after promotion
+    # (ref RayClusterDeletionDelaySeconds, cleanUpRayClusterInstance :1247):
+    clusterDeletionDelaySeconds: int = 60
+    serviceUnhealthySecondThreshold: int = 900
+    deploymentUnhealthySecondThreshold: int = 300
+    excludeHeadPodFromServe: bool = False
+
+    @classmethod
+    def _nested_types(cls):
+        return {"clusterSpec": TpuClusterSpec,
+                "upgradeOptions": ClusterUpgradeOptions}
+
+
+@dataclasses.dataclass
+class ServeApplicationStatus(Serializable):
+    name: str = ""
+    status: str = ServiceStatusName.NOT_STARTED
+    message: str = ""
+    lastUpdateTime: float = 0.0
+
+
+@dataclasses.dataclass
+class ServiceClusterStatus(Serializable):
+    """Status of one (active or pending) cluster in the pair."""
+
+    clusterName: str = ""
+    specHash: str = ""
+    applications: List[ServeApplicationStatus] = dataclasses.field(default_factory=list)
+    trafficWeightPercent: int = 0
+    targetCapacityPercent: int = 100
+
+    @classmethod
+    def _nested_types(cls):
+        return {"applications": ServeApplicationStatus}
+
+
+@dataclasses.dataclass
+class TpuServiceStatus(Serializable):
+    serviceStatus: str = ""
+    observedGeneration: int = 0
+    conditions: List[Condition] = dataclasses.field(default_factory=list)
+    activeServiceStatus: Optional[ServiceClusterStatus] = None
+    pendingServiceStatus: Optional[ServiceClusterStatus] = None
+    numServeEndpoints: int = 0
+    lastUpgradeStepTime: float = 0.0
+
+    @classmethod
+    def _nested_types(cls):
+        return {"conditions": Condition,
+                "activeServiceStatus": ServiceClusterStatus,
+                "pendingServiceStatus": ServiceClusterStatus}
+
+
+@dataclasses.dataclass
+class TpuService(Serializable):
+    apiVersion: str = C.API_VERSION
+    kind: str = C.KIND_SERVICE
+    metadata: ObjectMeta = dataclasses.field(default_factory=ObjectMeta)
+    spec: TpuServiceSpec = dataclasses.field(default_factory=TpuServiceSpec)
+    status: TpuServiceStatus = dataclasses.field(default_factory=TpuServiceStatus)
+
+    @classmethod
+    def _nested_types(cls):
+        return {"metadata": ObjectMeta, "spec": TpuServiceSpec,
+                "status": TpuServiceStatus}

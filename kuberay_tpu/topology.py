@@ -184,28 +184,39 @@ class SliceTopology:
     def hbm_gib(self) -> float:
         return self.num_chips * self.generation.hbm_gib_per_chip
 
+    def host_block_dims(self) -> Tuple[int, ...]:
+        """Per-host chip block within the slice topology.
+
+        Multi-host attachments are physically square-ish boards: ct5lp/ct6e
+        4-chip VMs own a 2x2 block of the 2D torus; v4/v5p boards are
+        2x2x1 of the 3D torus.  Derived from
+        ``generation.multihost_chips_per_host`` so the host-count math and
+        the block geometry cannot drift apart.
+        """
+        if not self.is_multi_host:
+            return self.dims
+        cph = self.generation.multihost_chips_per_host
+        if self.generation.ici_dims == 2:
+            return (2, cph // 2) if cph % 2 == 0 else (1, cph)
+        if cph % 4 == 0:
+            return (2, 2, cph // 4)
+        return (1, 1, cph)
+
     def host_grid_dims(self) -> Tuple[int, ...]:
-        """Host-grid shape: topology dims with chips-per-host divided out of
-        the innermost axes (the platform packs a host's chips along the last
-        topology axis first).  Falls back to a 1-D grid if packing is
-        irregular."""
+        """Host-grid shape: topology dims divided by the per-host chip
+        block.  Falls back to a 1-D grid if packing is irregular."""
         n = self.num_hosts
-        rem = self.chips_per_host
+        if not self.is_multi_host:
+            return (1,)
+        block = self.host_block_dims()
         host_dims = []
-        for d in reversed(self.dims):
-            if rem >= d:
-                if rem % d != 0:
-                    return (n,)
-                rem //= d
-            else:
-                if d % rem != 0:
-                    return (n,)
-                host_dims.append(d // rem)
-                rem = 1
-        host_dims.reverse()
+        for d, b in zip(self.dims, block):
+            if d % b != 0:
+                return (n,)
+            host_dims.append(d // b)
         if math.prod(host_dims) != n:
             return (n,)
-        return tuple(host_dims) if host_dims else (1,)
+        return tuple(host_dims)
 
     def host_ring_order(self) -> Sequence[int]:
         """Deterministic ring order of host indices for SP/ring attention.

@@ -1,0 +1,146 @@
+"""Minimal 5-field cron parser + next-run math (no external deps).
+
+Vixie-cron semantics matching robfig/cron (what the reference uses at
+raycronjob_controller.go:93-135): ``*``, lists, ranges, steps, weekday 0-7
+(both 0 and 7 are Sunday), and the day-of-month/day-of-week OR rule — when
+both fields are restricted, a time matches if *either* matches.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import FrozenSet, List, Optional
+
+
+class CronError(ValueError):
+    pass
+
+
+_FIELDS = [
+    ("minute", 0, 59),
+    ("hour", 0, 23),
+    ("day", 1, 31),
+    ("month", 1, 12),
+    ("weekday", 0, 7),   # 0 and 7 both mean Sunday; normalized to 0 post-parse
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class CronSchedule:
+    minute: FrozenSet[int]
+    hour: FrozenSet[int]
+    day: FrozenSet[int]
+    month: FrozenSet[int]
+    weekday: FrozenSet[int]
+    day_restricted: bool      # day field was not "*"
+    weekday_restricted: bool  # weekday field was not "*"
+
+
+def _parse_field(expr: str, name: str, lo: int, hi: int) -> FrozenSet[int]:
+    vals = set()
+    for part in expr.split(","):
+        if part == "":
+            raise CronError(f"{name}: empty list element in {expr!r}")
+        step = 1
+        if "/" in part:
+            part, step_s = part.split("/", 1)
+            try:
+                step = int(step_s)
+            except ValueError:
+                raise CronError(f"{name}: bad step {step_s!r}") from None
+            if step < 1:
+                raise CronError(f"{name}: step must be >= 1")
+        if part == "*":
+            start, end = lo, hi
+        elif "-" in part:
+            a, b = part.split("-", 1)
+            try:
+                start, end = int(a), int(b)
+            except ValueError:
+                raise CronError(f"{name}: bad range {part!r}") from None
+        else:
+            try:
+                start = end = int(part)
+            except ValueError:
+                raise CronError(f"{name}: bad value {part!r}") from None
+            if step > 1:
+                # Vixie/robfig: 'N/step' means the range N..max stepped.
+                end = hi
+        if not (lo <= start <= hi and lo <= end <= hi and start <= end):
+            raise CronError(f"{name}: {part!r} out of range [{lo},{hi}]")
+        vals.update(range(start, end + 1, step))
+    if not vals:
+        raise CronError(f"{name}: empty field")
+    return frozenset(vals)
+
+
+def parse_cron(schedule: str) -> CronSchedule:
+    parts = schedule.split()
+    if len(parts) != 5:
+        raise CronError(f"schedule must have 5 fields, got {len(parts)}: {schedule!r}")
+    sets = [
+        _parse_field(p, name, lo, hi)
+        for p, (name, lo, hi) in zip(parts, _FIELDS)
+    ]
+    # Normalize weekday 7 -> 0 (both mean Sunday).
+    weekday = frozenset(v % 7 for v in sets[4])
+    return CronSchedule(
+        minute=sets[0], hour=sets[1], day=sets[2], month=sets[3],
+        weekday=weekday,
+        day_restricted=parts[2] != "*",
+        weekday_restricted=parts[4] != "*",
+    )
+
+
+def matches(sched: CronSchedule, t: float) -> bool:
+    st = time.localtime(t)
+    if st.tm_min not in sched.minute or st.tm_hour not in sched.hour \
+            or st.tm_mon not in sched.month:
+        return False
+    day_ok = st.tm_mday in sched.day
+    # tm_wday: Monday=0; cron: Sunday=0.
+    wday_ok = (st.tm_wday + 1) % 7 in sched.weekday
+    # Vixie OR rule: both restricted -> either may match.
+    if sched.day_restricted and sched.weekday_restricted:
+        return day_ok or wday_ok
+    return day_ok and wday_ok
+
+
+def next_run_after(schedule: str, after: float, horizon_days: int = 366) -> Optional[float]:
+    """First scheduled time strictly after ``after`` (minute resolution)."""
+    sched = schedule if isinstance(schedule, CronSchedule) else parse_cron(schedule)
+    t = (int(after) // 60 + 1) * 60
+    end = after + horizon_days * 86400
+    while t <= end:
+        if matches(sched, t):
+            return float(t)
+        t += 60
+    return None
+
+
+def missed_runs(
+    schedule: str,
+    last: float,
+    now: float,
+    limit: int = 100,
+    horizon_seconds: float = 86400.0,
+) -> List[float]:
+    """Scheduled times in (last, now] — the catch-up set
+    (ref raycronjob_controller.go LastScheduleTime comparison).
+
+    Single parse + single forward scan, capped at ``limit`` results and
+    bounded below by ``now - horizon_seconds`` so an epoch-zero
+    lastScheduleTime (a brand-new CR) cannot trigger a multi-decade scan —
+    the CronJob-controller startingDeadlineSeconds pattern; pass that value
+    here when the spec sets it.
+    """
+    sched = parse_cron(schedule)
+    last = max(last, now - horizon_seconds)
+    out: List[float] = []
+    t = (int(last) // 60 + 1) * 60
+    while t <= now and len(out) < limit:
+        if matches(sched, t):
+            out.append(float(t))
+        t += 60
+    return out

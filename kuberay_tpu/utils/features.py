@@ -1,0 +1,86 @@
+"""Feature gates (ref pkg/features/features.go:14-90).
+
+Same alpha/beta/GA discipline as the reference's component-base gates; the
+gate set maps the reference's 8 gates onto their TPU-native equivalents.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+
+class _Gate:
+    def __init__(self, default: bool, stage: str):
+        self.default = default
+        self.stage = stage  # alpha | beta | ga
+
+
+# Gate name -> (default, stage). Mirrors features.go:
+#   RayMultiHostIndexing (beta, on) -> TpuMultiHostIndexing
+#   RayServiceIncrementalUpgrade    -> TpuServiceIncrementalUpgrade
+#   RayCronJob                      -> TpuCronJob
+#   RayClusterNetworkPolicy         -> TpuClusterNetworkPolicy
+#   GCSFaultToleranceEmbeddedStorage-> CoordinatorPersistentState
+_DEFINITIONS: Dict[str, _Gate] = {
+    "TpuMultiHostIndexing": _Gate(True, "beta"),
+    "TpuServiceIncrementalUpgrade": _Gate(False, "alpha"),
+    "TpuCronJob": _Gate(False, "alpha"),
+    "TpuClusterNetworkPolicy": _Gate(False, "alpha"),
+    "CoordinatorPersistentState": _Gate(False, "alpha"),
+    "WarmSlicePools": _Gate(False, "alpha"),         # podpool analogue
+    "SliceAutoscalerV2": _Gate(False, "alpha"),
+    "DeletionRules": _Gate(True, "beta"),
+}
+
+_lock = threading.Lock()
+_overrides: Dict[str, bool] = {}
+
+
+class FeatureGateError(ValueError):
+    pass
+
+
+def enabled(name: str) -> bool:
+    gate = _DEFINITIONS.get(name)
+    if gate is None:
+        raise FeatureGateError(f"unknown feature gate {name!r}")
+    with _lock:
+        return _overrides.get(name, gate.default)
+
+
+def set_gates(gates: Dict[str, bool]) -> None:
+    """Apply overrides (ref featureGates.Set main.go:188)."""
+    for name in gates:
+        if name not in _DEFINITIONS:
+            raise FeatureGateError(
+                f"unknown feature gate {name!r}; known: {sorted(_DEFINITIONS)}"
+            )
+    with _lock:
+        _overrides.update(gates)
+
+
+def parse_and_set(spec: str) -> None:
+    """Parse ``"Gate1=true,Gate2=false"`` (the --feature-gates flag format)."""
+    if not spec:
+        return
+    gates = {}
+    for part in spec.split(","):
+        if "=" not in part:
+            raise FeatureGateError(f"malformed feature gate {part!r}")
+        k, v = part.split("=", 1)
+        if v.lower() not in ("true", "false"):
+            raise FeatureGateError(f"feature gate {k!r} value must be true/false")
+        gates[k.strip()] = v.lower() == "true"
+    set_gates(gates)
+
+
+def reset() -> None:
+    """Test helper: drop all overrides."""
+    with _lock:
+        _overrides.clear()
+
+
+def all_gates() -> Dict[str, bool]:
+    with _lock:
+        return {n: _overrides.get(n, g.default) for n, g in _DEFINITIONS.items()}

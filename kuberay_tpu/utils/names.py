@@ -1,0 +1,94 @@
+"""Name/hash generation (ref controllers/ray/utils/util.go).
+
+- DNS-1123 truncation with stable hash suffixes (ref CheckName/TrimName).
+- ``spec_hash_without_scale``: the upgrade-decision hash that ignores
+  replica counts and slicesToDelete (ref
+  GenerateHashWithoutReplicasAndWorkersToDelete util.go:645) so autoscaling
+  never looks like a spec change.
+"""
+
+from __future__ import annotations
+
+import copy
+import hashlib
+import json
+from typing import Any, Dict
+
+MAX_NAME_LEN = 63  # DNS-1123 label
+
+
+def _short_hash(s: str, n: int = 8) -> str:
+    return hashlib.sha256(s.encode()).hexdigest()[:n]
+
+
+def truncate_name(name: str, max_len: int = MAX_NAME_LEN) -> str:
+    """Truncate to a valid label length, keeping a stable suffix hash."""
+    if len(name) <= max_len:
+        return name
+    h = _short_hash(name)
+    return name[: max_len - len(h) - 1] + "-" + h
+
+
+def head_pod_name(cluster: str) -> str:
+    return truncate_name(f"{cluster}-head")
+
+
+def head_service_name(cluster: str) -> str:
+    return truncate_name(f"{cluster}-head-svc")
+
+
+def headless_service_name(cluster: str) -> str:
+    return truncate_name(f"{cluster}-headless")
+
+
+def serve_service_name(cluster: str) -> str:
+    return truncate_name(f"{cluster}-serve-svc")
+
+
+def slice_name(cluster: str, group: str, slice_index: int) -> str:
+    """Stable per-slice identity (ref worker-group-replica-name label).
+
+    The reference generates random replica names (GenerateRayWorkerReplicaName);
+    deterministic names make reconcile decisions replayable and testable.
+    """
+    return truncate_name(f"{cluster}-{group}-{slice_index}")
+
+
+def worker_pod_name(cluster: str, group: str, slice_index: int, host_index: int) -> str:
+    return truncate_name(f"{cluster}-{group}-{slice_index}-{host_index}")
+
+
+def submitter_job_name(job: str) -> str:
+    return truncate_name(f"{job}-submitter")
+
+
+def cluster_name_for_job(job: str, attempt: int = 0) -> str:
+    """Fresh cluster per retry attempt (ref getOrCreateRayClusterInstance)."""
+    suffix = f"-{attempt}" if attempt else ""
+    return truncate_name(f"{job}-cluster{suffix}")
+
+
+def _strip_scale_fields(spec: Dict[str, Any]) -> Dict[str, Any]:
+    spec = copy.deepcopy(spec)
+    for group in spec.get("workerGroupSpecs", []):
+        group.pop("replicas", None)
+        group.pop("minReplicas", None)
+        group.pop("maxReplicas", None)
+        ss = group.get("scaleStrategy")
+        if ss:
+            ss.pop("slicesToDelete", None)
+            if not ss:
+                group.pop("scaleStrategy", None)
+    return spec
+
+
+def spec_hash_without_scale(cluster_spec: Dict[str, Any]) -> str:
+    """Hash of a TpuClusterSpec dict ignoring scale-only fields
+    (ref util.go:645).  Drives in-place-vs-new-cluster upgrade decisions."""
+    stripped = _strip_scale_fields(cluster_spec)
+    blob = json.dumps(stripped, sort_keys=True, separators=(",", ":"))
+    return _short_hash(blob, 16)
+
+
+def spec_hash(obj: Dict[str, Any]) -> str:
+    return _short_hash(json.dumps(obj, sort_keys=True, separators=(",", ":")), 16)

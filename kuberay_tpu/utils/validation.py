@@ -1,0 +1,211 @@
+"""Spec validation for all four CR kinds (ref utils/validation.go:23-831).
+
+Called at the head of each reconcile (and by the admission webhooks) exactly
+like the reference; invalid specs get a status condition, not a crash.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List
+
+from kuberay_tpu.api.tpucluster import TpuCluster, TpuClusterSpec, UpgradeStrategyType
+from kuberay_tpu.api.tpucronjob import ConcurrencyPolicy, TpuCronJob
+from kuberay_tpu.api.tpujob import (
+    DeletionPolicyType,
+    JobSubmissionMode,
+    TpuJob,
+)
+from kuberay_tpu.api.tpuservice import ServiceUpgradeType, TpuService
+from kuberay_tpu.topology import TopologyError
+from kuberay_tpu.utils import features
+from kuberay_tpu.utils.cron import CronError, parse_cron
+
+_DNS1123 = re.compile(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?$")
+
+
+class ValidationError(ValueError):
+    pass
+
+
+def _check(cond: bool, msg: str, errs: List[str]):
+    if not cond:
+        errs.append(msg)
+
+
+def validate_metadata(name: str, errs: List[str], max_len: int = 63):
+    _check(bool(name), "metadata.name must be set", errs)
+    if name:
+        _check(len(name) <= max_len, f"metadata.name {name!r} exceeds {max_len} chars", errs)
+        _check(bool(_DNS1123.match(name)),
+               f"metadata.name {name!r} is not a valid DNS-1123 label", errs)
+
+
+def validate_cluster_spec(spec: TpuClusterSpec, errs: List[str]):
+    # Head group: a head container must exist (ref ValidateRayClusterSpec
+    # head template checks).
+    _check(bool(spec.headGroupSpec.template.spec.containers),
+           "headGroupSpec.template must have at least one container", errs)
+
+    seen = set()
+    for i, g in enumerate(spec.workerGroupSpecs):
+        prefix = f"workerGroupSpecs[{i}]"
+        _check(bool(g.groupName), f"{prefix}.groupName must be set", errs)
+        if g.groupName:
+            _check(bool(_DNS1123.match(g.groupName)),
+                   f"{prefix}.groupName {g.groupName!r} is not a valid DNS-1123 label", errs)
+            _check(g.groupName not in seen,
+                   f"{prefix}.groupName {g.groupName!r} is duplicated", errs)
+            seen.add(g.groupName)
+        try:
+            g.slice_topology()
+        except TopologyError as e:
+            errs.append(f"{prefix}: {e}")
+        _check(g.replicas >= 0, f"{prefix}.replicas must be >= 0", errs)
+        _check(g.minReplicas >= 0, f"{prefix}.minReplicas must be >= 0", errs)
+        _check(g.maxReplicas >= g.minReplicas,
+               f"{prefix}.maxReplicas must be >= minReplicas", errs)
+        if spec.enableInTreeAutoscaling:
+            _check(g.minReplicas <= g.replicas <= g.maxReplicas,
+                   f"{prefix}.replicas must be within [minReplicas, maxReplicas] "
+                   "when autoscaling is enabled", errs)
+        _check(bool(g.template.spec.containers),
+               f"{prefix}.template must have at least one container", errs)
+
+    _check(spec.upgradeStrategy in (UpgradeStrategyType.RECREATE, UpgradeStrategyType.NONE),
+           f"upgradeStrategy must be Recreate or None, got {spec.upgradeStrategy!r}", errs)
+
+    if spec.headStateOptions is not None:
+        hso = spec.headStateOptions
+        _check(hso.backend in ("memory", "external", "persistent"),
+               f"headStateOptions.backend {hso.backend!r} invalid", errs)
+        if hso.backend == "external":
+            _check(bool(hso.externalStorageAddress),
+                   "headStateOptions.externalStorageAddress required for external backend",
+                   errs)
+        if hso.backend == "persistent":
+            _check(features.enabled("CoordinatorPersistentState"),
+                   "headStateOptions.backend=persistent requires the "
+                   "CoordinatorPersistentState feature gate", errs)
+
+    if spec.managedBy:
+        _check(spec.managedBy in ("kuberay-tpu-operator", "kueue.x-k8s.io/multikueue"),
+               f"managedBy {spec.managedBy!r} not recognized", errs)
+
+
+def validate_cluster(cluster: TpuCluster) -> List[str]:
+    errs: List[str] = []
+    validate_metadata(cluster.metadata.name, errs)
+    validate_cluster_spec(cluster.spec, errs)
+    return errs
+
+
+def validate_job(job: TpuJob) -> List[str]:
+    errs: List[str] = []
+    validate_metadata(job.metadata.name, errs)
+    spec = job.spec
+
+    has_spec = spec.clusterSpec is not None
+    has_selector = bool(spec.clusterSelector)
+    _check(has_spec or has_selector,
+           "one of clusterSpec or clusterSelector must be set", errs)
+    _check(not (has_spec and has_selector),
+           "clusterSpec and clusterSelector are mutually exclusive", errs)
+    if has_spec:
+        validate_cluster_spec(spec.clusterSpec, errs)
+
+    _check(spec.submissionMode in (
+        JobSubmissionMode.K8S_JOB, JobSubmissionMode.HTTP,
+        JobSubmissionMode.SIDECAR, JobSubmissionMode.INTERACTIVE),
+        f"submissionMode {spec.submissionMode!r} invalid", errs)
+    if spec.submissionMode != JobSubmissionMode.INTERACTIVE:
+        _check(bool(spec.entrypoint),
+               "entrypoint must be set unless submissionMode is InteractiveMode", errs)
+    if spec.submissionMode == JobSubmissionMode.INTERACTIVE:
+        _check(not spec.entrypoint,
+               "entrypoint must be empty in InteractiveMode", errs)
+    # Sidecar mode cannot be combined with a selected (pre-existing) cluster:
+    if spec.submissionMode == JobSubmissionMode.SIDECAR:
+        _check(not has_selector,
+               "SidecarMode requires clusterSpec (submitter rides the head pod)", errs)
+
+    # Selector-mode constraints (ref validation.go:409,423,438): a job on a
+    # pre-existing shared cluster cannot suspend it or retry with fresh ones.
+    if has_selector:
+        _check(not spec.suspend,
+               "suspend cannot be used with clusterSelector", errs)
+        _check(spec.backoffLimit == 0,
+               "backoffLimit cannot be used with clusterSelector "
+               "(retries mint fresh clusters)", errs)
+    if spec.suspend:
+        _check(spec.shutdownAfterJobFinishes,
+               "suspend requires shutdownAfterJobFinishes", errs)
+
+    _check(spec.backoffLimit >= 0, "backoffLimit must be >= 0", errs)
+    _check(spec.activeDeadlineSeconds >= 0, "activeDeadlineSeconds must be >= 0", errs)
+    _check(spec.preRunningDeadlineSeconds >= 0,
+           "preRunningDeadlineSeconds must be >= 0", errs)
+    _check(spec.ttlSecondsAfterFinished >= 0,
+           "ttlSecondsAfterFinished must be >= 0", errs)
+    if spec.ttlSecondsAfterFinished and not spec.shutdownAfterJobFinishes:
+        errs.append("ttlSecondsAfterFinished requires shutdownAfterJobFinishes")
+
+    if spec.deletionStrategy is not None:
+        _check(features.enabled("DeletionRules"),
+               "deletionStrategy requires the DeletionRules feature gate", errs)
+        for i, rule in enumerate(spec.deletionStrategy.rules):
+            _check(rule.policy in (
+                DeletionPolicyType.DELETE_CLUSTER, DeletionPolicyType.DELETE_WORKERS,
+                DeletionPolicyType.DELETE_SELF, DeletionPolicyType.DELETE_NONE),
+                f"deletionStrategy.rules[{i}].policy {rule.policy!r} invalid", errs)
+            _check(rule.condition in ("Succeeded", "Failed"),
+                   f"deletionStrategy.rules[{i}].condition must be Succeeded|Failed", errs)
+            _check(rule.ttlSeconds >= 0,
+                   f"deletionStrategy.rules[{i}].ttlSeconds must be >= 0", errs)
+        if spec.shutdownAfterJobFinishes and spec.deletionStrategy.rules:
+            errs.append("deletionStrategy and shutdownAfterJobFinishes are mutually exclusive")
+    return errs
+
+
+def validate_service(svc: TpuService) -> List[str]:
+    errs: List[str] = []
+    validate_metadata(svc.metadata.name, errs, max_len=50)  # room for cluster suffixes
+    validate_cluster_spec(svc.spec.clusterSpec, errs)
+    _check(svc.spec.upgradeStrategy in (
+        ServiceUpgradeType.NEW_CLUSTER, ServiceUpgradeType.INCREMENTAL,
+        ServiceUpgradeType.NONE),
+        f"upgradeStrategy {svc.spec.upgradeStrategy!r} invalid", errs)
+    if svc.spec.upgradeStrategy == ServiceUpgradeType.INCREMENTAL:
+        _check(features.enabled("TpuServiceIncrementalUpgrade"),
+               "incremental upgrade requires the TpuServiceIncrementalUpgrade gate", errs)
+        opts = svc.spec.upgradeOptions
+        if opts is not None:
+            _check(0 < opts.stepSizePercent <= 100,
+                   "upgradeOptions.stepSizePercent must be in (0, 100]", errs)
+            _check(opts.intervalSeconds > 0,
+                   "upgradeOptions.intervalSeconds must be > 0", errs)
+            _check(0 <= opts.maxSurgePercent <= 100,
+                   "upgradeOptions.maxSurgePercent must be in [0, 100]", errs)
+    _check(bool(svc.spec.serveConfig), "serveConfig must be set", errs)
+    _check(svc.spec.clusterDeletionDelaySeconds >= 0,
+           "clusterDeletionDelaySeconds must be >= 0", errs)
+    return errs
+
+
+def validate_cronjob(cron: TpuCronJob) -> List[str]:
+    errs: List[str] = []
+    validate_metadata(cron.metadata.name, errs)
+    _check(features.enabled("TpuCronJob"),
+           "TpuCronJob requires the TpuCronJob feature gate", errs)
+    try:
+        parse_cron(cron.spec.schedule)
+    except CronError as e:
+        errs.append(f"schedule: {e}")
+    _check(cron.spec.concurrencyPolicy in (
+        ConcurrencyPolicy.ALLOW, ConcurrencyPolicy.FORBID, ConcurrencyPolicy.REPLACE),
+        f"concurrencyPolicy {cron.spec.concurrencyPolicy!r} invalid", errs)
+    # Validate the template as a job (minus metadata).
+    tmpl_job = TpuJob(spec=cron.spec.jobTemplate)
+    tmpl_job.metadata.name = cron.metadata.name or "template"
+    errs.extend(f"jobTemplate: {e}" for e in validate_job(tmpl_job))
+    return errs

@@ -1,0 +1,115 @@
+"""API types: serialization round-trips, derived fields, conditions."""
+
+from kuberay_tpu.api.common import Condition, ObjectMeta, set_condition
+from kuberay_tpu.api.tpucluster import (
+    HeadGroupSpec,
+    TpuCluster,
+    TpuClusterSpec,
+    WorkerGroupSpec,
+)
+from kuberay_tpu.api.tpujob import TpuJob, TpuJobSpec
+from kuberay_tpu.api.tpuservice import TpuService
+from kuberay_tpu.api.common import Container, PodSpec, PodTemplateSpec
+from kuberay_tpu.utils.names import (
+    slice_name,
+    spec_hash_without_scale,
+    truncate_name,
+    worker_pod_name,
+)
+
+
+def make_template(image="tpu-runtime:latest"):
+    return PodTemplateSpec(
+        spec=PodSpec(containers=[Container(name="worker", image=image)])
+    )
+
+
+def make_cluster(name="demo", accelerator="v5p", topology="2x2x2", replicas=1):
+    return TpuCluster(
+        metadata=ObjectMeta(name=name),
+        spec=TpuClusterSpec(
+            headGroupSpec=HeadGroupSpec(template=make_template()),
+            workerGroupSpecs=[
+                WorkerGroupSpec(
+                    groupName="workers",
+                    accelerator=accelerator,
+                    topology=topology,
+                    replicas=replicas,
+                    maxReplicas=max(replicas, 1),
+                    template=make_template(),
+                )
+            ],
+        ),
+    )
+
+
+def test_cluster_roundtrip():
+    c = make_cluster()
+    d = c.to_dict()
+    c2 = TpuCluster.from_dict(d)
+    assert c2.to_dict() == d
+    assert c2.spec.workerGroupSpecs[0].num_hosts == 2
+    assert c2.spec.workerGroupSpecs[0].groupName == "workers"
+
+
+def test_none_fields_pruned():
+    c = make_cluster()
+    d = c.to_dict()
+    assert "autoscalerOptions" not in d["spec"]
+    assert "deletionTimestamp" not in d["metadata"]
+
+
+def test_job_roundtrip():
+    j = TpuJob(
+        metadata=ObjectMeta(name="train"),
+        spec=TpuJobSpec(entrypoint="python -m train", clusterSpec=make_cluster().spec),
+    )
+    d = j.to_dict()
+    j2 = TpuJob.from_dict(d)
+    assert j2.spec.clusterSpec.workerGroupSpecs[0].accelerator == "v5p"
+    assert j2.to_dict() == d
+
+
+def test_worker_group_num_hosts_derived():
+    g = WorkerGroupSpec(groupName="g", accelerator="v5e", topology="4x4")
+    assert g.num_hosts == 4  # GKE multi-host v5e: 4-chip VMs
+    g2 = WorkerGroupSpec(groupName="g", accelerator="v5e", topology="2x2")
+    assert g2.num_hosts == 1
+
+
+def test_set_condition_transitions():
+    conds = []
+    changed = set_condition(conds, Condition(type="Ready", status="True", reason="AllUp"))
+    assert changed and len(conds) == 1
+    t0 = conds[0].lastTransitionTime
+    # Same status+reason+message: no change, timestamp preserved.
+    assert not set_condition(conds, Condition(type="Ready", status="True", reason="AllUp"))
+    assert conds[0].lastTransitionTime == t0
+    # Same status, new reason: changed but transition time preserved.
+    assert set_condition(conds, Condition(type="Ready", status="True", reason="Other"))
+    assert conds[0].lastTransitionTime == t0
+    # Status flip: transition time moves.
+    assert set_condition(conds, Condition(type="Ready", status="False", reason="Down"))
+    assert conds[0].lastTransitionTime >= t0
+
+
+def test_truncate_name_stable():
+    long = "a" * 100
+    t1, t2 = truncate_name(long), truncate_name(long)
+    assert t1 == t2 and len(t1) == 63
+    assert truncate_name("short") == "short"
+    assert len(worker_pod_name("c" * 60, "group", 10, 3)) <= 63
+    assert slice_name("c", "g", 0) == "c-g-0"
+
+
+def test_spec_hash_ignores_scale():
+    c1 = make_cluster(replicas=1)
+    c2 = make_cluster(replicas=5)
+    c2.spec.workerGroupSpecs[0].scaleStrategy.slicesToDelete = ["x"]
+    assert spec_hash_without_scale(c1.spec.to_dict()) == \
+        spec_hash_without_scale(c2.spec.to_dict())
+    # But a real spec change (image) changes the hash.
+    c3 = make_cluster()
+    c3.spec.workerGroupSpecs[0].template.spec.containers[0].image = "other:img"
+    assert spec_hash_without_scale(c1.spec.to_dict()) != \
+        spec_hash_without_scale(c3.spec.to_dict())

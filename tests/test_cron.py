@@ -1,0 +1,65 @@
+"""Cron parser + catch-up math (ref raycronjob_controller.go:93-135)."""
+
+import time
+
+import pytest
+
+from kuberay_tpu.utils.cron import CronError, missed_runs, next_run_after, parse_cron
+
+
+def test_parse_basic():
+    s = parse_cron("*/15 3 * * 1-5")
+    assert s.minute == {0, 15, 30, 45}
+    assert s.hour == {3}
+    assert s.weekday == {1, 2, 3, 4, 5}
+    assert not s.day_restricted and s.weekday_restricted
+
+
+def test_parse_errors():
+    for bad in ("* * * *", "61 * * * *", "*/0 * * * *", "a * * * *",
+                "1-60 * * * *", "1-5, * * * *", ",1 * * * *"):
+        with pytest.raises(CronError):
+            parse_cron(bad)
+
+
+def test_sunday_as_7():
+    assert parse_cron("0 0 * * 7").weekday == {0}
+    # Ranges through 7 are valid and include Sunday (robfig compat).
+    assert parse_cron("0 0 * * 1-7").weekday == {0, 1, 2, 3, 4, 5, 6}
+    assert parse_cron("0 0 * * 5-7").weekday == {0, 5, 6}
+
+
+def test_dom_dow_or_rule():
+    from kuberay_tpu.utils.cron import matches
+    # '0 0 13 * 5': both restricted -> fires on the 13th OR any Friday.
+    s = parse_cron("0 0 13 * 5")
+    fri = time.mktime((2026, 1, 2, 0, 0, 0, 0, 0, -1))    # Fri Jan 2 2026
+    thirteenth = time.mktime((2026, 1, 13, 0, 0, 0, 0, 0, -1))  # Tue Jan 13
+    other = time.mktime((2026, 1, 5, 0, 0, 0, 0, 0, -1))  # Mon Jan 5
+    assert matches(s, fri) and matches(s, thirteenth) and not matches(s, other)
+    # Only DOM restricted -> AND semantics (weekday wildcard).
+    s2 = parse_cron("0 0 13 * *")
+    assert matches(s2, thirteenth) and not matches(s2, fri)
+
+
+def test_next_run():
+    # 2026-01-01 00:00:00 local.
+    base = time.mktime((2026, 1, 1, 0, 0, 0, 0, 0, -1))
+    nxt = next_run_after("30 2 * * *", base)
+    st = time.localtime(nxt)
+    assert (st.tm_hour, st.tm_min) == (2, 30)
+    assert nxt > base
+
+
+def test_missed_runs_catchup():
+    base = time.mktime((2026, 1, 1, 0, 0, 30, 0, 0, -1))
+    runs = missed_runs("*/10 * * * *", base, base + 3600)
+    assert len(runs) == 6
+    mins = [time.localtime(r).tm_min for r in runs]
+    assert mins == [10, 20, 30, 40, 50, 0]
+
+
+def test_missed_runs_limit():
+    base = time.mktime((2026, 1, 1, 0, 0, 0, 0, 0, -1))
+    runs = missed_runs("* * * * *", base, base + 86400, limit=10)
+    assert len(runs) == 10

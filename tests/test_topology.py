@@ -68,10 +68,11 @@ def test_ring_order_is_permutation():
 
 
 def test_ring_order_3d_host_grid_neighborwise():
-    # v5p 8x8x8: 512 chips / 4 per host = 128 hosts, host grid (8, 8, 2).
+    # v5p 8x8x8: 512 chips / 4 per host = 128 hosts; hosts own 2x2x1 chip
+    # blocks, so the host grid is (4, 4, 8).
     s = SliceTopology.create("v5p", "8x8x8")
     grid = s.host_grid_dims()
-    assert s.num_hosts == 128 and grid == (8, 8, 2)
+    assert s.num_hosts == 128 and grid == (4, 4, 8)
     order = list(s.host_ring_order())
     assert sorted(order) == list(range(128))
     # Every consecutive hop moves exactly one grid coordinate by 1.
@@ -95,13 +96,13 @@ def test_invalid_gke_topologies_rejected():
 
 
 def test_ring_order_snake_is_neighborwise():
-    # 64 hosts of a v5e 16x16: host grid is 16 rows x 4 cols -> snake path.
+    # 64 hosts of a v5e 16x16: hosts own 2x2 chip blocks -> host grid (8, 8).
     s = SliceTopology.create("v5e", "16x16")
-    assert s.host_grid_dims() == (16, 4)
+    assert s.host_grid_dims() == (8, 8)
     order = list(s.host_ring_order())
     assert len(order) == 64
     # Consecutive entries differ by a single grid step (row or col neighbor).
-    cols = 4
+    cols = 8
     for a, b in zip(order, order[1:]):
         ra, ca = divmod(a, cols)
         rb, cb = divmod(b, cols)

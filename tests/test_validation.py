@@ -1,0 +1,160 @@
+"""Validation coverage modeled on utils/validation_test.go's table style."""
+
+import pytest
+
+from kuberay_tpu.api.common import ObjectMeta
+from kuberay_tpu.api.tpucronjob import TpuCronJob, TpuCronJobSpec
+from kuberay_tpu.api.tpujob import (
+    DeletionRule,
+    DeletionStrategy,
+    JobSubmissionMode,
+    TpuJob,
+    TpuJobSpec,
+)
+from kuberay_tpu.api.tpuservice import (
+    ClusterUpgradeOptions,
+    ServiceUpgradeType,
+    TpuService,
+    TpuServiceSpec,
+)
+from kuberay_tpu.utils import features
+from kuberay_tpu.utils.validation import (
+    validate_cluster,
+    validate_cronjob,
+    validate_job,
+    validate_service,
+)
+from tests.test_api_types import make_cluster, make_template
+
+
+@pytest.fixture(autouse=True)
+def reset_gates():
+    features.reset()
+    yield
+    features.reset()
+
+
+def test_valid_cluster_passes():
+    assert validate_cluster(make_cluster()) == []
+
+
+def test_bad_metadata_name():
+    c = make_cluster(name="Bad_Name!")
+    errs = validate_cluster(c)
+    assert any("DNS-1123" in e for e in errs)
+    c2 = make_cluster(name="")
+    assert any("must be set" in e for e in validate_cluster(c2))
+
+
+def test_duplicate_group_names():
+    c = make_cluster()
+    c.spec.workerGroupSpecs.append(c.spec.workerGroupSpecs[0])
+    assert any("duplicated" in e for e in validate_cluster(c))
+
+
+def test_bad_topology_reported():
+    c = make_cluster(accelerator="v5e", topology="3x3")
+    assert any("not divisible" in e for e in validate_cluster(c))
+    c2 = make_cluster(accelerator="v5e", topology="2x12")
+    assert any("node pool" in e for e in validate_cluster(c2))
+
+
+def test_autoscaler_replica_bounds():
+    c = make_cluster(replicas=5)
+    c.spec.enableInTreeAutoscaling = True
+    c.spec.workerGroupSpecs[0].maxReplicas = 3
+    errs = validate_cluster(c)
+    assert any("within" in e for e in errs)
+
+
+def test_missing_head_container():
+    c = make_cluster()
+    c.spec.headGroupSpec.template.spec.containers = []
+    assert any("headGroupSpec" in e for e in validate_cluster(c))
+
+
+def make_job(**kw):
+    spec = TpuJobSpec(entrypoint="python -m x", clusterSpec=make_cluster().spec)
+    for k, v in kw.items():
+        setattr(spec, k, v)
+    return TpuJob(metadata=ObjectMeta(name="job"), spec=spec)
+
+
+def test_valid_job_passes():
+    assert validate_job(make_job()) == []
+
+
+def test_job_cluster_spec_xor_selector():
+    j = make_job()
+    j.spec.clusterSelector = {"tpu.dev/cluster": "x"}
+    assert any("mutually exclusive" in e for e in validate_job(j))
+    j2 = make_job()
+    j2.spec.clusterSpec = None
+    assert any("one of" in e for e in validate_job(j2))
+
+
+def test_job_interactive_mode_entrypoint():
+    j = make_job(submissionMode=JobSubmissionMode.INTERACTIVE)
+    assert any("empty in InteractiveMode" in e for e in validate_job(j))
+    j2 = make_job(submissionMode=JobSubmissionMode.K8S_JOB, entrypoint="")
+    assert any("entrypoint must be set" in e for e in validate_job(j2))
+
+
+def test_job_deletion_rules_vs_shutdown():
+    j = make_job(
+        shutdownAfterJobFinishes=True,
+        deletionStrategy=DeletionStrategy(
+            rules=[DeletionRule(policy="DeleteCluster", condition="Succeeded")]
+        ),
+    )
+    assert any("mutually exclusive" in e for e in validate_job(j))
+
+
+def test_job_ttl_requires_shutdown():
+    j = make_job(ttlSecondsAfterFinished=60, shutdownAfterJobFinishes=False)
+    assert any("requires shutdownAfterJobFinishes" in e for e in validate_job(j))
+
+
+def make_service(strategy=ServiceUpgradeType.NEW_CLUSTER):
+    return TpuService(
+        metadata=ObjectMeta(name="svc"),
+        spec=TpuServiceSpec(
+            serveConfig={"model": "llama3-8b"},
+            clusterSpec=make_cluster().spec,
+            upgradeStrategy=strategy,
+        ),
+    )
+
+
+def test_valid_service_passes():
+    assert validate_service(make_service()) == []
+
+
+def test_service_incremental_requires_gate():
+    s = make_service(ServiceUpgradeType.INCREMENTAL)
+    assert any("gate" in e for e in validate_service(s))
+    features.set_gates({"TpuServiceIncrementalUpgrade": True})
+    assert validate_service(s) == []
+
+
+def test_service_upgrade_options_bounds():
+    features.set_gates({"TpuServiceIncrementalUpgrade": True})
+    s = make_service(ServiceUpgradeType.INCREMENTAL)
+    s.spec.upgradeOptions = ClusterUpgradeOptions(stepSizePercent=0)
+    assert any("stepSizePercent" in e for e in validate_service(s))
+
+
+def test_cronjob_requires_gate_and_schedule():
+    cj = TpuCronJob(
+        metadata=ObjectMeta(name="nightly"),
+        spec=TpuCronJobSpec(
+            schedule="0 3 * * *",
+            jobTemplate=make_job().spec,
+        ),
+    )
+    errs = validate_cronjob(cj)
+    assert any("feature gate" in e for e in errs)
+    features.set_gates({"TpuCronJob": True})
+    assert validate_cronjob(cj) == []
+    cj.spec.schedule = "not a cron"
+    assert any("schedule" in e for e in validate_cronjob(cj))
