@@ -41,12 +41,17 @@ class Serializable:
                 continue
             v = d[f.name]
             ftype = cls._nested_types().get(f.name)
+            converted = False
             if ftype is not None and v is not None:
                 if isinstance(v, list):
                     v = [ftype.from_dict(x) if isinstance(x, dict) else x for x in v]
+                    converted = all(not isinstance(x, dict) for x in v)
                 elif isinstance(v, dict):
                     v = ftype.from_dict(v)
-            kwargs[f.name] = copy.deepcopy(v)
+                    converted = True
+            # Freshly-built nested objects are already ours; only raw
+            # dict/list values need the defensive copy.
+            kwargs[f.name] = v if converted else copy.deepcopy(v)
         return cls(**kwargs)
 
     @classmethod

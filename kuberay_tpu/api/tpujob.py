@@ -111,7 +111,9 @@ class TpuJobSpec(Serializable):
     submissionMode: str = JobSubmissionMode.K8S_JOB
     submitterConfig: SubmitterConfig = dataclasses.field(default_factory=SubmitterConfig)
     suspend: bool = False
-    shutdownAfterJobFinishes: bool = True
+    # Default False like the reference's RayJob, so deletionStrategy works
+    # without explicitly opting out of shutdown.
+    shutdownAfterJobFinishes: bool = False
     ttlSecondsAfterFinished: int = 0
     activeDeadlineSeconds: int = 0      # whole-job deadline (:209)
     preRunningDeadlineSeconds: int = 0  # deadline to *reach* Running (:283)

@@ -1,0 +1,79 @@
+"""Submitter builders for TpuJob (ref controllers/ray/common/job.go).
+
+The submitter is a K8s Job that launches the user's entrypoint against the
+cluster coordinator.  The command wrapper is idempotent like the
+reference's (job.go:120-125 ``ray job submit --no-wait || ray job logs``):
+if a prior attempt already registered the job id with the coordinator, it
+re-attaches instead of double-submitting.
+"""
+
+from __future__ import annotations
+
+import shlex
+from typing import Any, Dict
+
+from kuberay_tpu.api.tpucluster import TpuCluster
+from kuberay_tpu.api.tpujob import TpuJob
+from kuberay_tpu.builders.pod import coordinator_address
+from kuberay_tpu.utils import constants as C
+from kuberay_tpu.utils.names import submitter_job_name
+
+
+def build_submit_command(job: TpuJob, cluster: TpuCluster) -> str:
+    """Idempotent submit wrapper (ref BuildJobSubmitCommand job.go:90)."""
+    addr = coordinator_address(cluster)
+    jid = job.status.jobId or job.metadata.name
+    submit = (f"python -m kuberay_tpu.runtime.submit --address {addr} "
+              f"--job-id {shlex.quote(jid)} --no-wait -- "
+              f"{job.spec.entrypoint}")
+    attach = (f"python -m kuberay_tpu.runtime.submit --address {addr} "
+              f"--job-id {shlex.quote(jid)} --tail-logs")
+    return f"if ! {submit} ; then {attach} ; else {attach} ; fi"
+
+
+def build_submitter_job(job: TpuJob, cluster: TpuCluster) -> Dict[str, Any]:
+    """K8s Job wrapping the submitter pod (ref createK8sJobIfNeed
+    rayjob_controller.go:560)."""
+    tmpl = (job.spec.submitterConfig.template.to_dict()
+            if job.spec.submitterConfig.template else None)
+    image = ""
+    if cluster.spec.headGroupSpec.template.spec.containers:
+        image = cluster.spec.headGroupSpec.template.spec.containers[0].image
+    pod_spec = (tmpl or {}).get("spec") or {
+        "containers": [{"name": "submitter", "image": image}],
+        "restartPolicy": "Never",
+    }
+    container = pod_spec["containers"][0]
+    container["command"] = ["/bin/sh", "-c", build_submit_command(job, cluster)]
+    env = container.setdefault("env", [])
+    env.append({"name": C.ENV_COORDINATOR_ADDRESS,
+                "value": coordinator_address(cluster)})
+    for k, v in (job.spec.runtimeEnv or {}).items():
+        env.append({"name": k, "value": str(v)})
+    pod_spec.setdefault("restartPolicy", "Never")
+
+    return {
+        "apiVersion": "batch/v1",
+        "kind": "Job",
+        "metadata": {
+            "name": submitter_job_name(job.metadata.name),
+            "namespace": job.metadata.namespace,
+            "labels": {
+                C.LABEL_ORIGINATED_FROM_CR_NAME: job.metadata.name,
+                C.LABEL_ORIGINATED_FROM_CRD: C.KIND_JOB,
+            },
+            "ownerReferences": [{
+                "apiVersion": C.API_VERSION,
+                "kind": C.KIND_JOB,
+                "name": job.metadata.name,
+                "uid": job.metadata.uid,
+                "controller": True,
+                "blockOwnerDeletion": True,
+            }],
+        },
+        "spec": {
+            "backoffLimit": job.spec.submitterConfig.backoffLimit,
+            "template": {"spec": pod_spec},
+        },
+        "status": {},
+    }

@@ -1,0 +1,246 @@
+"""Pod builders: TpuCluster spec -> pod objects (pure functions).
+
+The TPU-native union of the reference's ``BuildPod``/``DefaultWorkerPodTemplate``
+(controllers/ray/common/pod.go:414,639 — env wiring, probes, multi-host
+labels at :493-500) and what GKE's external TPU webhook injects today
+(SURVEY.md §5.7): ``TPU_WORKER_ID``, ``TPU_WORKER_HOSTNAMES``,
+``TPU_TOPOLOGY``, node selectors, megascale (multi-slice DCN) coordination
+env.  Injection is native here — no webhook in the loop.
+
+Pure: no store access, no clock; fully unit-testable like the reference's
+common/ package.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, List, Optional
+
+from kuberay_tpu.api.tpucluster import TpuCluster, WorkerGroupSpec
+from kuberay_tpu.topology import SliceTopology
+from kuberay_tpu.utils import constants as C
+from kuberay_tpu.utils.names import (
+    head_pod_name,
+    head_service_name,
+    headless_service_name,
+    slice_name,
+    worker_pod_name,
+)
+
+
+def _base_labels(cluster: TpuCluster, node_type: str) -> Dict[str, str]:
+    return {
+        C.LABEL_CLUSTER: cluster.metadata.name,
+        C.LABEL_NODE_TYPE: node_type,
+        C.LABEL_IDENTIFIER: f"{cluster.metadata.name}-{node_type}",
+        C.LABEL_CREATED_BY: C.CREATED_BY_OPERATOR,
+    }
+
+
+def _owner_ref(cluster: TpuCluster) -> Dict[str, Any]:
+    return {
+        "apiVersion": C.API_VERSION,
+        "kind": C.KIND_CLUSTER,
+        "name": cluster.metadata.name,
+        "uid": cluster.metadata.uid,
+        "controller": True,
+        "blockOwnerDeletion": True,
+    }
+
+
+def _set_env(container: Dict[str, Any], env: Dict[str, str]) -> None:
+    """Add env vars, user-provided values win (ref setContainerEnvVars)."""
+    existing = {e["name"] for e in container.setdefault("env", [])}
+    for k, v in env.items():
+        if k not in existing:
+            container["env"].append({"name": k, "value": v})
+
+
+def coordinator_address(cluster: TpuCluster) -> str:
+    ns = cluster.metadata.namespace
+    return (f"{head_service_name(cluster.metadata.name)}.{ns}.svc:"
+            f"{C.PORT_COORDINATOR}")
+
+
+def slice_hostnames(cluster: TpuCluster, group: WorkerGroupSpec,
+                    slice_idx: int) -> List[str]:
+    """Stable per-host DNS names via the headless service (ref
+    BuildHeadlessServiceForRayCluster service.go:299 peer DNS)."""
+    topo = group.slice_topology()
+    svc = headless_service_name(cluster.metadata.name)
+    ns = cluster.metadata.namespace
+    return [
+        f"{worker_pod_name(cluster.metadata.name, group.groupName, slice_idx, h)}"
+        f".{svc}.{ns}.svc"
+        for h in range(topo.num_hosts)
+    ]
+
+
+def build_head_pod(cluster: TpuCluster,
+                   config_env: Optional[Dict[str, str]] = None) -> Dict[str, Any]:
+    """Head pod: coordinator + dashboard + (optional) autoscaler sidecar."""
+    name = cluster.metadata.name
+    tmpl = cluster.spec.headGroupSpec.template.to_dict()
+    pod_spec = copy.deepcopy(tmpl.get("spec", {}))
+    containers = pod_spec.setdefault("containers", [{}])
+    head = containers[0]
+    head.setdefault("name", "tpu-head")
+
+    env = {
+        C.ENV_CLUSTER_NAME: name,
+        C.ENV_COORDINATOR_ADDRESS: coordinator_address(cluster),
+        C.ENV_FQ_HEAD_IP: f"{head_service_name(name)}.{cluster.metadata.namespace}.svc",
+        C.ENV_NUM_PROCESSES: "1",
+        C.ENV_PROCESS_ID: "0",
+    }
+    if cluster.spec.headStateOptions is not None:
+        hso = cluster.spec.headStateOptions
+        if hso.backend == "external":
+            env["TPU_HEAD_EXTERNAL_STORAGE_ADDRESS"] = hso.externalStorageAddress
+            env["TPU_HEAD_EXTERNAL_STORAGE_NAMESPACE"] = (
+                hso.externalStorageNamespace or cluster.metadata.uid)
+    _set_env(head, {**(config_env or {}), **env})
+
+    ports = {p.get("name") for p in head.setdefault("ports", [])}
+    for pname, pnum in [
+        (C.DEFAULT_COORDINATOR_PORT_NAME, C.PORT_COORDINATOR),
+        (C.DEFAULT_DASHBOARD_PORT_NAME, C.PORT_DASHBOARD),
+        (C.DEFAULT_METRICS_PORT_NAME, C.PORT_METRICS),
+        (C.DEFAULT_SERVE_PORT_NAME, C.PORT_SERVE),
+    ]:
+        if pname not in ports:
+            head["ports"].append({"name": pname, "containerPort": pnum})
+
+    if cluster.spec.enableInTreeAutoscaling:
+        containers.append(build_autoscaler_container(cluster))
+
+    labels = {**tmpl.get("metadata", {}).get("labels", {}),
+              **_base_labels(cluster, C.NODE_TYPE_HEAD)}
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": head_pod_name(name),
+            "namespace": cluster.metadata.namespace,
+            "labels": labels,
+            "annotations": dict(tmpl.get("metadata", {}).get("annotations", {})),
+            "ownerReferences": [_owner_ref(cluster)],
+        },
+        "spec": pod_spec,
+    }
+
+
+def build_autoscaler_container(cluster: TpuCluster) -> Dict[str, Any]:
+    """Autoscaler sidecar (ref BuildAutoscalerContainer common/pod.go:736):
+    watches job/queue state and patches worker-group replicas in slice
+    units."""
+    opts = cluster.spec.autoscalerOptions
+    image = (opts.image if opts and opts.image
+             else cluster.spec.headGroupSpec.template.spec.containers[0].image
+             if cluster.spec.headGroupSpec.template.spec.containers else "")
+    return {
+        "name": "autoscaler",
+        "image": image,
+        "command": ["python", "-m", "kuberay_tpu.autoscaler.sidecar"],
+        "args": ["--cluster", cluster.metadata.name,
+                 "--namespace", cluster.metadata.namespace],
+        "env": [{"name": "TPU_AUTOSCALER_IDLE_TIMEOUT",
+                 "value": str(opts.idleTimeoutSeconds if opts else 60)},
+                {"name": "TPU_AUTOSCALER_MODE",
+                 "value": (opts.upscalingMode if opts else "Default")}],
+    }
+
+
+def build_worker_pod(cluster: TpuCluster, group: WorkerGroupSpec,
+                     slice_idx: int, host_idx: int,
+                     config_env: Optional[Dict[str, str]] = None,
+                     num_slices_in_job: int = 1,
+                     megascale_slice_id: int = 0) -> Dict[str, Any]:
+    """One host of one slice.
+
+    Identity model (TPU-native version of ref pod.go:493-500 labels):
+    - labels: slice-name / slice-index / host-index (atomicity bookkeeping)
+    - env: TPU_WORKER_ID = host_idx, TPU_WORKER_HOSTNAMES = all peers in
+      ring order via headless DNS, TPU_TOPOLOGY, coordinator address;
+      megascale env for multi-slice (DCN) jobs.
+    """
+    name = cluster.metadata.name
+    topo = group.slice_topology()
+    tmpl = group.template.to_dict()
+    pod_spec = copy.deepcopy(tmpl.get("spec", {}))
+    containers = pod_spec.setdefault("containers", [{}])
+    worker = containers[0]
+    worker.setdefault("name", "tpu-worker")
+
+    sname = slice_name(name, group.groupName, slice_idx)
+    pod_name = worker_pod_name(name, group.groupName, slice_idx, host_idx)
+
+    # TPU resource request (ref addWellKnownAcceleratorResources pod.go:1106
+    # maps accelerators; here google.com/tpu is first-class).
+    res = worker.setdefault("resources", {})
+    for kind in ("requests", "limits"):
+        res.setdefault(kind, {})
+        res[kind].setdefault(C.RESOURCE_TPU, str(topo.chips_per_host))
+
+    env = {
+        C.ENV_CLUSTER_NAME: name,
+        C.ENV_COORDINATOR_ADDRESS: coordinator_address(cluster),
+        C.ENV_FQ_HEAD_IP: f"{head_service_name(name)}.{cluster.metadata.namespace}.svc",
+        C.ENV_TPU_WORKER_ID: str(host_idx),
+        C.ENV_TPU_WORKER_HOSTNAMES: ",".join(
+            slice_hostnames(cluster, group, slice_idx)),
+        C.ENV_TPU_TOPOLOGY: topo.topology_str,
+        C.ENV_TPU_ACCELERATOR_TYPE: topo.short_name,
+        C.ENV_TPU_CHIPS_PER_HOST_BOUNDS: "x".join(
+            str(b) for b in topo.host_block_dims()),
+        C.ENV_NUM_PROCESSES: str(topo.num_hosts),
+        C.ENV_PROCESS_ID: str(host_idx),
+    }
+    if num_slices_in_job > 1:
+        env[C.ENV_MEGASCALE_COORDINATOR_ADDRESS] = coordinator_address(cluster)
+        env[C.ENV_MEGASCALE_NUM_SLICES] = str(num_slices_in_job)
+        env[C.ENV_MEGASCALE_SLICE_ID] = str(megascale_slice_id)
+    _set_env(worker, {**(config_env or {}), **env})
+
+    # Node placement: GKE TPU node-pool selectors
+    # (ref kubectl-plugin constant.go:13-19 + TPU samples).
+    sel = pod_spec.setdefault("nodeSelector", {})
+    sel.setdefault(C.NODE_SELECTOR_GKE_ACCELERATOR, topo.generation.gke_accelerator)
+    sel.setdefault(C.NODE_SELECTOR_GKE_TOPOLOGY, topo.topology_str)
+
+    # Hostname + subdomain give each host the stable headless-service DNS
+    # name TPU_WORKER_HOSTNAMES references.
+    pod_spec["hostname"] = pod_name
+    pod_spec["subdomain"] = headless_service_name(name)
+
+    if cluster.spec.schedulerName:
+        pod_spec.setdefault("schedulerName", cluster.spec.schedulerName)
+
+    labels = {
+        **tmpl.get("metadata", {}).get("labels", {}),
+        **_base_labels(cluster, C.NODE_TYPE_WORKER),
+        C.LABEL_GROUP: group.groupName,
+        C.LABEL_SLICE_NAME: sname,
+        C.LABEL_SLICE_INDEX: str(slice_idx),
+        C.LABEL_HOST_INDEX: str(host_idx),
+    }
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": pod_name,
+            "namespace": cluster.metadata.namespace,
+            "labels": labels,
+            "annotations": dict(tmpl.get("metadata", {}).get("annotations", {})),
+            "ownerReferences": [_owner_ref(cluster)],
+        },
+        "spec": pod_spec,
+    }
+
+
+def build_slice_pods(cluster: TpuCluster, group: WorkerGroupSpec,
+                     slice_idx: int, **kw) -> List[Dict[str, Any]]:
+    """All pods of one slice — the atomic creation unit."""
+    topo = group.slice_topology()
+    return [build_worker_pod(cluster, group, slice_idx, h, **kw)
+            for h in range(topo.num_hosts)]

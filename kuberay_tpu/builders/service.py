@@ -1,0 +1,113 @@
+"""Service builders (ref controllers/ray/common/service.go).
+
+- head service (:37): stable coordinator/dashboard address, selector on
+  head labels;
+- headless service (:299): peer DNS for multi-host slices, created only
+  when a group is multi-host, publishes not-ready addresses so workers can
+  resolve each other before readiness (exactly the reference's flag);
+- serve service: selects pods with the serve label for inference traffic.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from kuberay_tpu.api.tpucluster import TpuCluster
+from kuberay_tpu.utils import constants as C
+from kuberay_tpu.utils.names import (
+    head_service_name,
+    headless_service_name,
+    serve_service_name,
+)
+
+
+def _owner_ref(cluster: TpuCluster) -> Dict[str, Any]:
+    return {
+        "apiVersion": C.API_VERSION,
+        "kind": C.KIND_CLUSTER,
+        "name": cluster.metadata.name,
+        "uid": cluster.metadata.uid,
+        "controller": True,
+        "blockOwnerDeletion": True,
+    }
+
+
+def build_head_service(cluster: TpuCluster) -> Dict[str, Any]:
+    name = cluster.metadata.name
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {
+            "name": head_service_name(name),
+            "namespace": cluster.metadata.namespace,
+            "labels": {C.LABEL_CLUSTER: name,
+                       C.LABEL_NODE_TYPE: C.NODE_TYPE_HEAD},
+            "ownerReferences": [_owner_ref(cluster)],
+        },
+        "spec": {
+            "type": cluster.spec.headGroupSpec.serviceType,
+            "selector": {C.LABEL_CLUSTER: name,
+                         C.LABEL_NODE_TYPE: C.NODE_TYPE_HEAD},
+            "ports": [
+                {"name": C.DEFAULT_COORDINATOR_PORT_NAME, "port": C.PORT_COORDINATOR},
+                {"name": C.DEFAULT_DASHBOARD_PORT_NAME, "port": C.PORT_DASHBOARD},
+                {"name": C.DEFAULT_METRICS_PORT_NAME, "port": C.PORT_METRICS},
+                {"name": C.DEFAULT_SERVE_PORT_NAME, "port": C.PORT_SERVE},
+            ],
+        },
+    }
+
+
+def needs_headless_service(cluster: TpuCluster) -> bool:
+    """Only when some group is multi-host (ref raycluster_controller.go:869)."""
+    return any(g.slice_topology().is_multi_host
+               for g in cluster.spec.workerGroupSpecs)
+
+
+def build_headless_service(cluster: TpuCluster) -> Dict[str, Any]:
+    name = cluster.metadata.name
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {
+            "name": headless_service_name(name),
+            "namespace": cluster.metadata.namespace,
+            "labels": {C.LABEL_CLUSTER: name},
+            "ownerReferences": [_owner_ref(cluster)],
+        },
+        "spec": {
+            "clusterIP": "None",
+            # Workers must resolve peers before they are Ready — the ICI
+            # bootstrap happens pre-readiness (ref PublishNotReadyAddresses).
+            "publishNotReadyAddresses": True,
+            "selector": {C.LABEL_CLUSTER: name,
+                         C.LABEL_NODE_TYPE: C.NODE_TYPE_WORKER},
+            "ports": [
+                {"name": C.DEFAULT_COORDINATOR_PORT_NAME, "port": C.PORT_COORDINATOR},
+                {"name": "mxla", "port": C.PORT_MXLA},
+            ],
+        },
+    }
+
+
+def build_serve_service(cluster: TpuCluster,
+                        service_name: str = "") -> Dict[str, Any]:
+    """Serve traffic service; selector includes the serve label so only
+    pods marked ready-for-traffic receive requests (ref serve svc +
+    updateHeadPodServeLabel rayservice_controller.go:2065)."""
+    name = cluster.metadata.name
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {
+            "name": service_name or serve_service_name(name),
+            "namespace": cluster.metadata.namespace,
+            "labels": {C.LABEL_CLUSTER: name},
+            "ownerReferences": [_owner_ref(cluster)],
+        },
+        "spec": {
+            "type": "ClusterIP",
+            "selector": {C.LABEL_CLUSTER: name, C.LABEL_SERVE: "true"},
+            "ports": [{"name": C.DEFAULT_SERVE_PORT_NAME, "port": C.PORT_SERVE}],
+        },
+    }

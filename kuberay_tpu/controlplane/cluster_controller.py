@@ -1,0 +1,533 @@
+"""TpuCluster reconciler: the only component that creates/deletes pods.
+
+Level-triggered, idempotent, slice-atomic.  The reconcile pipeline mirrors
+the reference's (raycluster_controller.go:330-341 ``reconcileFuncs`` and
+:902 ``reconcilePods``) with the multi-host invariants of
+``reconcileMultiHostWorkerGroup`` (:1246-1410) promoted to *the* scaling
+algorithm — every group scales in whole slices:
+
+1.  validation -> InvalidSpec condition (never a crash)
+2.  deletion path: state cleanup + finalizer release
+3.  services: head, headless (multi-host peer DNS), serve
+4.  pods:
+    - suspend: delete everything, mark Suspended
+    - Recreate upgrade on pod-template hash drift
+    - gang admission hook (scheduler plugin)
+    - head pod create/repair
+    - per group: clean incomplete slices -> delete unhealthy slices whole
+      -> honor autoscaler slicesToDelete -> diff in slice units
+5.  status: ready counts, conditions, throttled update
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from kuberay_tpu.api.common import Condition, set_condition
+from kuberay_tpu.api.tpucluster import (
+    ClusterConditionType,
+    ClusterState,
+    TpuCluster,
+    UpgradeStrategyType,
+    WorkerGroupSpec,
+)
+from kuberay_tpu.builders.pod import build_head_pod, build_slice_pods
+from kuberay_tpu.builders.service import (
+    build_head_service,
+    build_headless_service,
+    build_serve_service,
+    needs_headless_service,
+)
+from kuberay_tpu.controlplane.events import EventRecorder
+from kuberay_tpu.controlplane.expectations import HEAD_GROUP, ScaleExpectations
+from kuberay_tpu.controlplane.store import AlreadyExists, NotFound, ObjectStore
+from kuberay_tpu.utils import constants as C
+from kuberay_tpu.utils.names import head_pod_name, head_service_name, spec_hash
+from kuberay_tpu.utils.validation import validate_cluster
+
+POD_SPEC_HASH_ANNOTATION = "tpu.dev/pod-template-hash"
+
+
+def pod_phase(pod: Dict[str, Any]) -> str:
+    return pod.get("status", {}).get("phase", "Pending")
+
+
+def pod_failed(pod: Dict[str, Any]) -> bool:
+    # Workers/head never legitimately Succeed while the cluster lives
+    # (ref shouldDeletePod raycluster_controller.go:1464).
+    return pod_phase(pod) in ("Failed", "Succeeded")
+
+
+def pod_running(pod: Dict[str, Any]) -> bool:
+    return pod_phase(pod) == "Running"
+
+
+def pod_deleting(pod: Dict[str, Any]) -> bool:
+    return bool(pod.get("metadata", {}).get("deletionTimestamp"))
+
+
+class TpuClusterController:
+    KIND = C.KIND_CLUSTER
+
+    def __init__(self, store: ObjectStore,
+                 expectations: Optional[ScaleExpectations] = None,
+                 recorder: Optional[EventRecorder] = None,
+                 scheduler=None,
+                 config_env: Optional[Dict[str, str]] = None,
+                 metrics=None):
+        self.store = store
+        self.exp = expectations or ScaleExpectations()
+        self.recorder = recorder or EventRecorder(store)
+        self.scheduler = scheduler        # gang plugin (scheduler/ package)
+        self.config_env = config_env or {}
+        self.metrics = metrics
+
+    # ------------------------------------------------------------------
+    # entry point
+    # ------------------------------------------------------------------
+
+    def reconcile(self, name: str, namespace: str = "default") -> Optional[float]:
+        """Returns requeue-after seconds or None."""
+        raw = self.store.try_get(self.KIND, name, namespace)
+        if raw is None:
+            self.exp.forget_cluster(namespace, name)
+            return None
+        cluster = TpuCluster.from_dict(raw)
+
+        # Kueue-style external management (ref ManagedBy skip :155).
+        if cluster.spec.managedBy and cluster.spec.managedBy != C.CREATED_BY_OPERATOR:
+            return None
+
+        if cluster.metadata.deletionTimestamp:
+            return self._reconcile_deletion(cluster)
+
+        errs = validate_cluster(cluster)
+        if errs:
+            self.recorder.warning(raw, C.EVENT_INVALID_SPEC, "; ".join(errs))
+            self._set_status(cluster, state=ClusterState.FAILED,
+                             reason="; ".join(errs)[:500])
+            return None
+
+        self._ensure_finalizer(cluster)
+        self._reconcile_services(cluster)
+        requeue = self._reconcile_pods(cluster)
+        self._update_status(cluster)
+        return requeue
+
+    # ------------------------------------------------------------------
+    # deletion (ref :193-326 GCS-FT deletion path)
+    # ------------------------------------------------------------------
+
+    def _needs_cleanup_finalizer(self, cluster: TpuCluster) -> bool:
+        hso = cluster.spec.headStateOptions
+        return hso is not None and hso.backend == "external"
+
+    def _ensure_finalizer(self, cluster: TpuCluster):
+        if self._needs_cleanup_finalizer(cluster):
+            if C.FINALIZER_GCS_FT not in cluster.metadata.finalizers:
+                self.store.add_finalizer(self.KIND, cluster.metadata.name,
+                                         cluster.metadata.namespace,
+                                         C.FINALIZER_GCS_FT)
+
+    def _reconcile_deletion(self, cluster: TpuCluster) -> Optional[float]:
+        ns, name = cluster.metadata.namespace, cluster.metadata.name
+        pods = self._cluster_pods(cluster)
+        # Head-pod-first deletion so workers don't thrash reconnecting
+        # (ref head-first delete :240-ish), then the rest.
+        head = [p for p in pods if p["metadata"]["labels"].get(
+            C.LABEL_NODE_TYPE) == C.NODE_TYPE_HEAD]
+        rest = [p for p in pods if p not in head]
+        for p in head + rest:
+            self._delete_pod(p)
+        if self._needs_cleanup_finalizer(cluster):
+            # External coordinator-state cleanup (ref Redis cleanup Job):
+            # spawn a cleanup Job object; release finalizer once it succeeds
+            # or after the timeout annotation.
+            done = self._reconcile_cleanup_job(cluster)
+            if not done:
+                return 5.0
+            self.store.remove_finalizer(self.KIND, name, ns, C.FINALIZER_GCS_FT)
+        self.exp.forget_cluster(ns, name)
+        if self.scheduler is not None:
+            self.scheduler.cleanup(cluster.to_dict())
+        return None
+
+    def _reconcile_cleanup_job(self, cluster: TpuCluster) -> bool:
+        ns, name = cluster.metadata.namespace, cluster.metadata.name
+        job_name = f"{name}-state-cleanup"
+        job = self.store.try_get("Job", job_name, ns)
+        if job is None:
+            hso = cluster.spec.headStateOptions
+            self.store.create({
+                "apiVersion": "batch/v1", "kind": "Job",
+                "metadata": {
+                    "name": job_name, "namespace": ns,
+                    "labels": {C.LABEL_CLUSTER: name},
+                },
+                "spec": {"template": {"spec": {"containers": [{
+                    "name": "cleanup",
+                    "command": ["python", "-m", "kuberay_tpu.runtime.state_cleanup",
+                                "--address", hso.externalStorageAddress,
+                                "--namespace",
+                                hso.externalStorageNamespace or cluster.metadata.uid],
+                }], "restartPolicy": "Never"}}},
+                "status": {},
+            })
+            return False
+        # Timeout guard (ref gcs-ft-deletion-timeout annotation).
+        timeout = float(cluster.metadata.annotations.get(
+            C.ANNOTATION_FT_DELETION_TIMEOUT, "300"))
+        started = job["metadata"].get("creationTimestamp", 0)
+        if job.get("status", {}).get("succeeded"):
+            return True
+        return time.time() - started > timeout
+
+    # ------------------------------------------------------------------
+    # services
+    # ------------------------------------------------------------------
+
+    def _ensure(self, obj: Dict[str, Any]):
+        try:
+            self.store.create(obj)
+            self.recorder.normal(obj, C.EVENT_CREATED_SERVICE,
+                                 f"created {obj['kind']} {obj['metadata']['name']}")
+        except AlreadyExists:
+            pass
+
+    def _reconcile_services(self, cluster: TpuCluster):
+        self._ensure(build_head_service(cluster))
+        if needs_headless_service(cluster):
+            self._ensure(build_headless_service(cluster))
+
+    # ------------------------------------------------------------------
+    # pods
+    # ------------------------------------------------------------------
+
+    def _cluster_pods(self, cluster: TpuCluster) -> List[Dict[str, Any]]:
+        return self.store.list(
+            "Pod", cluster.metadata.namespace,
+            labels={C.LABEL_CLUSTER: cluster.metadata.name})
+
+    def _delete_pod(self, pod: Dict[str, Any], group: str = ""):
+        """Expectation is recorded BEFORE the API call: the store notifies
+        watchers synchronously, so recording after would lose the event and
+        wedge the group until the expectation timeout (the same ordering
+        contract the reference's expectations follow)."""
+        md = pod["metadata"]
+        cluster = md["labels"].get(C.LABEL_CLUSTER, "")
+        group = group or md["labels"].get(C.LABEL_GROUP, HEAD_GROUP)
+        self.exp.expect_delete(md["namespace"], cluster, group, md["name"])
+        try:
+            self.store.delete("Pod", md["name"], md["namespace"])
+        except NotFound:
+            self.exp.forget(md["namespace"], cluster, group, md["name"])
+
+    def _create_pod(self, pod: Dict[str, Any], group: str):
+        md = pod["metadata"]
+        cluster = md["labels"].get(C.LABEL_CLUSTER, "")
+        self.exp.expect_create(md["namespace"], cluster, group, md["name"])
+        try:
+            self.store.create(pod)
+        except AlreadyExists:
+            self.exp.forget(md["namespace"], cluster, group, md["name"])
+
+    def _template_hash(self, cluster: TpuCluster) -> str:
+        spec = cluster.spec.to_dict()
+        return spec_hash({
+            "head": spec.get("headGroupSpec"),
+            "groups": [
+                {k: v for k, v in g.items()
+                 if k in ("groupName", "accelerator", "topology", "template",
+                          "startParams")}
+                for g in spec.get("workerGroupSpecs", [])
+            ],
+        })
+
+    def _reconcile_pods(self, cluster: TpuCluster) -> Optional[float]:
+        ns, name = cluster.metadata.namespace, cluster.metadata.name
+        pods = self._cluster_pods(cluster)
+
+        # Suspend: delete all (ref :912-927), Kueue-compatible quiescence.
+        if cluster.spec.suspend:
+            for p in pods:
+                self._delete_pod(p)
+            return None
+
+        # Recreate-upgrade: template hash drift deletes everything
+        # (ref :941-954).
+        thash = self._template_hash(cluster)
+        if cluster.spec.upgradeStrategy == UpgradeStrategyType.RECREATE:
+            stale = [p for p in pods
+                     if p["metadata"].get("annotations", {}).get(
+                         POD_SPEC_HASH_ANNOTATION) not in (None, thash)]
+            if stale:
+                for p in pods:
+                    self._delete_pod(p)
+                return 1.0
+
+        # Gang admission (ref DoBatchSchedulingOnSubmission :963-971): the
+        # plugin reserves capacity for the whole cluster before pods appear.
+        if self.scheduler is not None:
+            admitted = self.scheduler.on_cluster_submission(cluster.to_dict())
+            if not admitted:
+                return 5.0
+
+        requeue = None
+        live = [p for p in pods if not pod_deleting(p)]
+
+        # --- head (ref :974-1031) ---
+        if self.exp.satisfied(ns, name, HEAD_GROUP):
+            heads = [p for p in live if p["metadata"]["labels"].get(
+                C.LABEL_NODE_TYPE) == C.NODE_TYPE_HEAD]
+            if any(pod_failed(p) for p in heads):
+                for p in heads:
+                    if pod_failed(p):
+                        self.recorder.warning(
+                            cluster.to_dict(), C.EVENT_DELETED_POD,
+                            f"restarting failed head pod {p['metadata']['name']}")
+                        self._delete_pod(p)
+                requeue = 1.0
+            elif not heads:
+                pod = build_head_pod(cluster, self.config_env)
+                pod["metadata"].setdefault("annotations", {})[
+                    POD_SPEC_HASH_ANNOTATION] = thash
+                if self.scheduler is not None:
+                    self.scheduler.add_metadata(cluster.to_dict(), pod)
+                self._create_pod(pod, HEAD_GROUP)
+                self.recorder.normal(cluster.to_dict(), C.EVENT_CREATED_POD,
+                                     f"created head pod {pod['metadata']['name']}")
+
+        # --- worker groups, slice-atomic (ref :1034 + :1246-1410) ---
+        for group in cluster.spec.workerGroupSpecs:
+            r = self._reconcile_worker_group(cluster, group, thash)
+            requeue = min(r, requeue) if (r and requeue) else (r or requeue)
+        return requeue
+
+    def _group_pods_by_slice(self, pods: List[Dict[str, Any]],
+                             group: WorkerGroupSpec
+                             ) -> Dict[int, List[Dict[str, Any]]]:
+        out: Dict[int, List[Dict[str, Any]]] = {}
+        for p in pods:
+            labels = p["metadata"]["labels"]
+            if labels.get(C.LABEL_GROUP) != group.groupName:
+                continue
+            try:
+                idx = int(labels.get(C.LABEL_SLICE_INDEX, "-1"))
+            except ValueError:
+                idx = -1
+            out.setdefault(idx, []).append(p)
+        return out
+
+    def _reconcile_worker_group(self, cluster: TpuCluster,
+                                group: WorkerGroupSpec,
+                                thash: str) -> Optional[float]:
+        ns, name = cluster.metadata.namespace, cluster.metadata.name
+        if not self.exp.satisfied(ns, name, group.groupName):
+            return 1.0
+
+        pods = [p for p in self._cluster_pods(cluster) if not pod_deleting(p)]
+        slices = self._group_pods_by_slice(pods, group)
+        topo = group.slice_topology()
+        hosts = topo.num_hosts
+
+        if group.suspend:
+            for plist in slices.values():
+                for p in plist:
+                    self._delete_pod(p, group.groupName)
+            return None
+
+        # 1. Incomplete slices are useless (no ICI ring): delete whole
+        #    (ref :1257-1267).
+        for idx, plist in list(slices.items()):
+            if idx < 0 or len(plist) != hosts or \
+                    len({p["metadata"]["labels"].get(C.LABEL_HOST_INDEX)
+                         for p in plist}) != hosts:
+                for p in plist:
+                    self._delete_pod(p, group.groupName)
+                self.recorder.warning(
+                    cluster.to_dict(), C.EVENT_DELETED_SLICE,
+                    f"deleted incomplete slice {group.groupName}/{idx} "
+                    f"({len(plist)}/{hosts} hosts)")
+                del slices[idx]
+
+        # 2. Any failed host poisons the whole slice (ref :1269-1289).
+        for idx, plist in list(slices.items()):
+            if any(pod_failed(p) for p in plist):
+                for p in plist:
+                    self._delete_pod(p, group.groupName)
+                self.recorder.warning(
+                    cluster.to_dict(), C.EVENT_UNHEALTHY_SLICE,
+                    f"deleted unhealthy slice {group.groupName}/{idx}")
+                del slices[idx]
+
+        # 3. Autoscaler-named victims expand to whole slices (ref :1293-1322;
+        #    here the contract is already slice-granular).
+        victims = set(group.scaleStrategy.slicesToDelete or [])
+        if victims:
+            for idx, plist in list(slices.items()):
+                sname = plist[0]["metadata"]["labels"].get(C.LABEL_SLICE_NAME)
+                if sname in victims:
+                    for p in plist:
+                        self._delete_pod(p, group.groupName)
+                    del slices[idx]
+
+        # 4. Diff in slice units (ref :1343-1378).
+        desired = max(0, group.replicas)
+        have = len(slices)
+        if have < desired:
+            used = set(slices.keys())
+            next_idx = 0
+            created = 0
+            while created < desired - have:
+                if next_idx in used:
+                    next_idx += 1
+                    continue
+                new_pods = build_slice_pods(cluster, group, next_idx,
+                                            config_env=self.config_env)
+                for p in new_pods:
+                    p["metadata"].setdefault("annotations", {})[
+                        POD_SPEC_HASH_ANNOTATION] = thash
+                    if self.scheduler is not None:
+                        self.scheduler.add_metadata(cluster.to_dict(), p)
+                    self._create_pod(p, group.groupName)
+                self.recorder.normal(
+                    cluster.to_dict(), C.EVENT_CREATED_SLICE,
+                    f"created slice {group.groupName}/{next_idx} ({hosts} hosts)")
+                used.add(next_idx)
+                created += 1
+        elif have > desired:
+            # Scale down: autoscaler owns victim choice when enabled
+            # (ref :1181-1239); otherwise delete highest indices first
+            # (deterministic; ENABLE_RANDOM_POD_DELETE env restores the
+            # reference's random choice).
+            excess = have - desired
+            if cluster.spec.enableInTreeAutoscaling and not victims:
+                return None     # wait for slicesToDelete
+            order = sorted(slices.keys(), reverse=True)
+            if os.environ.get(C.ENV_ENABLE_RANDOM_POD_DELETE) == "true":
+                random.shuffle(order)
+            for idx in order[:excess]:
+                for p in slices[idx]:
+                    self._delete_pod(p, group.groupName)
+                self.recorder.normal(
+                    cluster.to_dict(), C.EVENT_DELETED_SLICE,
+                    f"scaled down slice {group.groupName}/{idx}")
+        return None
+
+    # ------------------------------------------------------------------
+    # status (ref calculateStatus :1874 + consistency.go throttling)
+    # ------------------------------------------------------------------
+
+    def _update_status(self, cluster: TpuCluster):
+        pods = self._cluster_pods(cluster)
+        live = [p for p in pods if not pod_deleting(p)]
+        heads = [p for p in live if p["metadata"]["labels"].get(
+            C.LABEL_NODE_TYPE) == C.NODE_TYPE_HEAD]
+        head_ready = any(pod_running(p) for p in heads)
+
+        status = cluster.status
+        prev = status.to_dict()
+        status.observedGeneration = cluster.metadata.generation
+        status.desiredSlices = status.readySlices = 0
+        status.desiredWorkerHosts = status.readyWorkerHosts = 0
+        status.desiredTpuChips = 0
+        status.groups = []
+
+        from kuberay_tpu.api.tpucluster import WorkerGroupStatus
+        for group in cluster.spec.workerGroupSpecs:
+            topo = group.slice_topology()
+            desired = 0 if (group.suspend or cluster.spec.suspend) else group.replicas
+            slices = self._group_pods_by_slice(live, group)
+            ready_slices = sum(
+                1 for plist in slices.values()
+                if len(plist) == topo.num_hosts and all(pod_running(p) for p in plist))
+            gs = WorkerGroupStatus(
+                groupName=group.groupName,
+                desiredSlices=desired,
+                readySlices=ready_slices,
+                desiredHosts=desired * topo.num_hosts,
+                readyHosts=sum(1 for plist in slices.values()
+                               for p in plist if pod_running(p)),
+                desiredTpuChips=desired * topo.num_chips,
+            )
+            status.groups.append(gs)
+            status.desiredSlices += gs.desiredSlices
+            status.readySlices += gs.readySlices
+            status.desiredWorkerHosts += gs.desiredHosts
+            status.readyWorkerHosts += gs.readyHosts
+            status.desiredTpuChips += gs.desiredTpuChips
+
+        status.headServiceName = head_service_name(cluster.metadata.name)
+        status.headPodName = heads[0]["metadata"]["name"] if heads else ""
+        status.headPodIP = (heads[0].get("status", {}).get("podIP", "")
+                            if heads else "")
+        from kuberay_tpu.builders.pod import coordinator_address
+        status.coordinatorAddress = coordinator_address(cluster)
+
+        set_condition(status.conditions, Condition(
+            type=ClusterConditionType.HEAD_POD_READY,
+            status="True" if head_ready else "False",
+            reason="HeadPodRunning" if head_ready else "HeadPodNotRunning",
+            observedGeneration=cluster.metadata.generation))
+
+        all_ready = (head_ready and status.readySlices >= status.desiredSlices)
+        if cluster.spec.suspend:
+            new_state = ClusterState.SUSPENDED
+            set_condition(status.conditions, Condition(
+                type=ClusterConditionType.SUSPENDED,
+                status="True" if not live else "False",
+                reason="Suspended" if not live else "Suspending",
+                observedGeneration=cluster.metadata.generation))
+        elif all_ready:
+            new_state = ClusterState.READY
+        else:
+            new_state = status.state or ""
+        if all_ready:
+            # Provisioned latches once (ref RayClusterProvisioned :1930-1960).
+            set_condition(status.conditions, Condition(
+                type=ClusterConditionType.PROVISIONED, status="True",
+                reason="AllSlicesReady",
+                observedGeneration=cluster.metadata.generation))
+        if new_state and new_state != status.state:
+            status.stateTransitionTimes[new_state] = time.time()
+            if self.metrics is not None and new_state == ClusterState.READY:
+                created = cluster.metadata.creationTimestamp or time.time()
+                self.metrics.observe_provisioned(
+                    cluster.metadata.name, time.time() - created)
+        status.state = new_state
+
+        # Throttle: skip update when nothing but timestamps changed
+        # (ref consistency.go:16).
+        new = status.to_dict()
+        if self._status_equal(prev, new):
+            return
+        obj = cluster.to_dict()
+        obj["status"] = new
+        self.store.update_status(obj)
+
+    def _set_status(self, cluster: TpuCluster, state: str, reason: str = ""):
+        obj = cluster.to_dict()
+        st = obj.setdefault("status", {})
+        if st.get("state") == state and st.get("reason") == reason:
+            return
+        st["state"] = state
+        st["reason"] = reason
+        self.store.update_status(obj)
+
+    @staticmethod
+    def _status_equal(a: Dict[str, Any], b: Dict[str, Any]) -> bool:
+        def strip(d):
+            d = dict(d)
+            d.pop("stateTransitionTimes", None)
+            conds = []
+            for c in d.get("conditions", []):
+                c = dict(c)
+                c.pop("lastTransitionTime", None)
+                conds.append(c)
+            if conds:
+                d["conditions"] = conds
+            return d
+        return strip(a) == strip(b)

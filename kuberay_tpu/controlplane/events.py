@@ -1,0 +1,45 @@
+"""K8s-style Event recording (ref EventRecorder + typed reasons,
+utils/constant.go EventType section).  Events land in the store as
+``Event`` objects so clients/CLI can list them alongside CRs."""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Any, Dict
+
+from kuberay_tpu.controlplane.store import ObjectStore
+
+
+class EventRecorder:
+    def __init__(self, store: ObjectStore):
+        self._store = store
+
+    def event(self, obj: Dict[str, Any], etype: str, reason: str, message: str):
+        """etype: 'Normal' | 'Warning'."""
+        md = obj.get("metadata", {})
+        name = md.get("name", "unknown")
+        self._store.create({
+            "apiVersion": "v1",
+            "kind": "Event",
+            "metadata": {
+                "name": f"{name}.{uuid.uuid4().hex[:10]}",
+                "namespace": md.get("namespace", "default"),
+            },
+            "type": etype,
+            "reason": reason,
+            "message": message,
+            "involvedObject": {
+                "kind": obj.get("kind"),
+                "name": name,
+                "namespace": md.get("namespace", "default"),
+                "uid": md.get("uid"),
+            },
+            "eventTime": time.time(),
+        })
+
+    def normal(self, obj, reason, message):
+        self.event(obj, "Normal", reason, message)
+
+    def warning(self, obj, reason, message):
+        self.event(obj, "Warning", reason, message)

@@ -1,0 +1,84 @@
+"""Scale expectations: don't act on a stale cache (ref
+controllers/ray/expectations/scale_expectations.go:37-44).
+
+After issuing a create/delete the reconciler records an expectation; until
+the corresponding watch event arrives (or the 30 s timeout expires) further
+scale decisions for that (cluster, group) are skipped.  This is the
+mechanism that prevents double slice creation during informer lag — with
+slice-atomic groups a double create wastes an entire multi-host slice, so
+the stakes are higher than the reference's single-pod case.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Set, Tuple
+
+EXPECTATIONS_TIMEOUT_SECONDS = 30.0
+
+HEAD_GROUP = "__head__"
+
+
+class ScaleExpectations:
+    def __init__(self, timeout: float = EXPECTATIONS_TIMEOUT_SECONDS):
+        self._lock = threading.Lock()
+        self._timeout = timeout
+        # (ns, cluster, group) -> {pod_name -> (op, deadline)}
+        self._pending: Dict[Tuple[str, str, str], Dict[str, Tuple[str, float]]] = {}
+
+    def expect_create(self, ns: str, cluster: str, group: str, pod: str):
+        self._expect(ns, cluster, group, pod, "create")
+
+    def expect_delete(self, ns: str, cluster: str, group: str, pod: str):
+        self._expect(ns, cluster, group, pod, "delete")
+
+    def _expect(self, ns, cluster, group, pod, op):
+        with self._lock:
+            self._pending.setdefault((ns, cluster, group), {})[pod] = (
+                op, time.time() + self._timeout)
+
+    def observe_pod_event(self, ns: str, cluster: str, group: str,
+                          pod: str, event_type: str):
+        """Call on watch events: ADDED satisfies creates, DELETED deletes."""
+        want = {"ADDED": "create", "DELETED": "delete"}.get(event_type)
+        if want is None:
+            return
+        with self._lock:
+            bucket = self._pending.get((ns, cluster, group))
+            if not bucket:
+                return
+            cur = bucket.get(pod)
+            if cur and cur[0] == want:
+                del bucket[pod]
+                if not bucket:
+                    del self._pending[(ns, cluster, group)]
+
+    def satisfied(self, ns: str, cluster: str, group: str) -> bool:
+        """True when no live expectations remain (expired ones are dropped —
+        the reconcile falls back to observed state, ref 30 s timeout)."""
+        now = time.time()
+        with self._lock:
+            bucket = self._pending.get((ns, cluster, group))
+            if not bucket:
+                return True
+            live = {p: v for p, v in bucket.items() if v[1] > now}
+            if live:
+                self._pending[(ns, cluster, group)] = live
+                return False
+            del self._pending[(ns, cluster, group)]
+            return True
+
+    def forget(self, ns: str, cluster: str, group: str, pod: str):
+        """Roll back an expectation whose create/delete call failed."""
+        with self._lock:
+            bucket = self._pending.get((ns, cluster, group))
+            if bucket and pod in bucket:
+                del bucket[pod]
+                if not bucket:
+                    del self._pending[(ns, cluster, group)]
+
+    def forget_cluster(self, ns: str, cluster: str):
+        with self._lock:
+            for key in [k for k in self._pending if k[0] == ns and k[1] == cluster]:
+                del self._pending[key]

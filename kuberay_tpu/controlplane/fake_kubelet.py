@@ -1,0 +1,60 @@
+"""Fake kubelet: runs pods that nobody actually runs.
+
+The envtest analogue (SURVEY.md §4 tier 2: "pods are never actually run;
+tests manually flip pod phases") promoted to a reusable component: it
+watches the store and advances pod phases Pending -> Running, assigns pod
+IPs, and can be told to fail specific pods — which is also the framework's
+fault-injection hook (ref fail.py / pod-kill e2e patterns, §5.3).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, Optional, Set
+
+from kuberay_tpu.controlplane.store import NotFound, ObjectStore
+
+
+class FakeKubelet:
+    def __init__(self, store: ObjectStore, auto_run: bool = True):
+        self.store = store
+        self.auto_run = auto_run
+        self._ip = itertools.count(1)
+        self._fail_next: Set[str] = set()
+
+    def fail_pod(self, name: str, namespace: str = "default"):
+        """Inject a failure: the pod transitions to Failed."""
+        pod = self.store.try_get("Pod", name, namespace)
+        if pod is None:
+            self._fail_next.add(f"{namespace}/{name}")
+            return
+        pod["status"] = {**pod.get("status", {}), "phase": "Failed"}
+        self.store.update_status(pod)
+
+    def step(self) -> int:
+        """Advance every Pending pod one phase; returns pods touched."""
+        touched = 0
+        for pod in self.store.list("Pod"):
+            md = pod["metadata"]
+            key = f"{md['namespace']}/{md['name']}"
+            phase = pod.get("status", {}).get("phase", "Pending")
+            if md.get("deletionTimestamp"):
+                continue
+            if key in self._fail_next:
+                self._fail_next.discard(key)
+                pod["status"] = {"phase": "Failed"}
+                self.store.update_status(pod)
+                touched += 1
+                continue
+            if phase == "Pending" and self.auto_run:
+                pod["status"] = {
+                    "phase": "Running",
+                    "podIP": f"10.0.{next(self._ip) // 256}.{next(self._ip) % 256}",
+                    "conditions": [{"type": "Ready", "status": "True"}],
+                }
+                try:
+                    self.store.update_status(pod)
+                    touched += 1
+                except NotFound:
+                    pass
+        return touched

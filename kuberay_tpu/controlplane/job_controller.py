@@ -1,0 +1,450 @@
+"""TpuJob reconciler: the job lifecycle state machine.
+
+Mirrors the reference's RayJob state machine (rayjob_controller.go:165-462):
+
+    New -> Initializing -> (Waiting | Running) -> Complete | Failed
+               |                |
+               +-- Suspending <-+        (suspend flips mid-flight)
+                      v
+                  Suspended -> (resume) -> New
+    Failed attempt + backoffLimit left  -> Retrying -> New (fresh cluster)
+
+plus deadlines (active/preRunning), the deletion-rules engine
+(handleDeletionRules :1413 / selectMostImpactfulRule :1685), and the
+submitter (K8s-Job mode first; HTTP mode talks straight to the
+coordinator — ref createK8sJobIfNeed :560 / checkSubmitterAndUpdateStatus
+:1062).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Optional
+
+from kuberay_tpu.api.tpucluster import ClusterState, TpuCluster
+from kuberay_tpu.api.tpujob import (
+    DeletionPolicyType,
+    JobDeploymentStatus,
+    JobFailedReason,
+    JobStatus,
+    JobSubmissionMode,
+    TpuJob,
+)
+from kuberay_tpu.builders.job import build_submitter_job
+from kuberay_tpu.controlplane.events import EventRecorder
+from kuberay_tpu.controlplane.store import AlreadyExists, NotFound, ObjectStore
+from kuberay_tpu.runtime.coordinator_client import CoordinatorError
+from kuberay_tpu.utils import constants as C
+from kuberay_tpu.utils.names import cluster_name_for_job, submitter_job_name
+from kuberay_tpu.utils.validation import validate_job
+
+
+class TpuJobController:
+    KIND = C.KIND_JOB
+
+    def __init__(self, store: ObjectStore,
+                 recorder: Optional[EventRecorder] = None,
+                 client_provider: Optional[Callable] = None,
+                 scheduler=None,
+                 metrics=None):
+        self.store = store
+        self.recorder = recorder or EventRecorder(store)
+        self.client_provider = client_provider
+        self.scheduler = scheduler
+        self.metrics = metrics
+
+    # ------------------------------------------------------------------
+
+    def reconcile(self, name: str, namespace: str = "default") -> Optional[float]:
+        raw = self.store.try_get(self.KIND, name, namespace)
+        if raw is None:
+            return None
+        job = TpuJob.from_dict(raw)
+
+        if job.spec.managedBy and job.spec.managedBy != C.CREATED_BY_OPERATOR:
+            return None
+        if job.metadata.deletionTimestamp:
+            return self._reconcile_deletion(job)
+
+        status = job.status.jobDeploymentStatus
+        handler = {
+            JobDeploymentStatus.NEW: self._state_new,
+            JobDeploymentStatus.INITIALIZING: self._state_initializing,
+            JobDeploymentStatus.WAITING: self._state_waiting,
+            JobDeploymentStatus.RUNNING: self._state_running,
+            JobDeploymentStatus.SUSPENDING: self._state_suspending,
+            JobDeploymentStatus.SUSPENDED: self._state_suspended,
+            JobDeploymentStatus.RETRYING: self._state_retrying,
+            JobDeploymentStatus.COMPLETE: self._state_terminal,
+            JobDeploymentStatus.FAILED: self._state_terminal,
+        }.get(status)
+        if handler is None:
+            return None
+        return handler(job)
+
+    # ------------------------------------------------------------------
+    # states
+    # ------------------------------------------------------------------
+
+    def _state_new(self, job: TpuJob) -> Optional[float]:
+        errs = validate_job(job)
+        if errs:
+            self.recorder.warning(job.to_dict(), C.EVENT_INVALID_SPEC,
+                                  "; ".join(errs))
+            return self._fail(job, JobFailedReason.VALIDATION_FAILED,
+                              "; ".join(errs)[:500])
+        self.store.add_finalizer(self.KIND, job.metadata.name,
+                                 job.metadata.namespace, C.FINALIZER_JOB)
+        job = TpuJob.from_dict(self.store.get(
+            self.KIND, job.metadata.name, job.metadata.namespace))
+        # Attempt-suffixed id: each retry is a distinct submission against a
+        # fresh cluster (ref JobId init :887; suffix disambiguates attempts).
+        attempt = int(job.status.failed)
+        job.status.jobId = job.status.jobId or (
+            f"{job.metadata.name}-{job.metadata.uid[:8]}"
+            + (f"-r{attempt}" if attempt else ""))
+        if job.spec.clusterSelector:
+            matches = self.store.list(C.KIND_CLUSTER, job.metadata.namespace,
+                                      labels=job.spec.clusterSelector)
+            if not matches:
+                self._set_message(job, "no cluster matches clusterSelector")
+                self._update(job)
+                return 5.0
+            job.status.clusterName = matches[0]["metadata"]["name"]
+        else:
+            job.status.clusterName = cluster_name_for_job(
+                job.metadata.name, int(job.status.failed))
+        if job.spec.suspend:
+            job.status.jobDeploymentStatus = JobDeploymentStatus.SUSPENDED
+        else:
+            job.status.jobDeploymentStatus = JobDeploymentStatus.INITIALIZING
+            job.status.startTime = job.status.startTime or time.time()
+        self._update(job)
+        return 0.1
+
+    def _state_initializing(self, job: TpuJob) -> Optional[float]:
+        if job.spec.suspend:
+            return self._to(job, JobDeploymentStatus.SUSPENDING, requeue=0.1)
+        # preRunning deadline (ref :180-190).
+        if job.spec.preRunningDeadlineSeconds and job.status.startTime and \
+                time.time() - job.status.startTime > job.spec.preRunningDeadlineSeconds:
+            return self._fail(job, JobFailedReason.DEADLINE_EXCEEDED,
+                              "did not reach Running before preRunningDeadlineSeconds")
+
+        # Gang reservation before any pod exists (ref :192-200).
+        if self.scheduler is not None and job.spec.clusterSpec is not None:
+            if not self.scheduler.on_job_submission(job.to_dict()):
+                return 5.0
+
+        cluster = self._get_or_create_cluster(job)
+        if cluster is None:
+            return 2.0
+        job.status.clusterStatus = cluster.status.to_dict()
+        if cluster.status.state != ClusterState.READY:
+            self._update(job)
+            return 2.0
+
+        mode = job.spec.submissionMode
+        if mode == JobSubmissionMode.INTERACTIVE:
+            return self._to(job, JobDeploymentStatus.WAITING)
+        if mode == JobSubmissionMode.K8S_JOB:
+            self._ensure_submitter(job, cluster)
+        elif mode == JobSubmissionMode.HTTP:
+            client = self._client(job, cluster)
+            if client is None:
+                return 2.0
+            try:
+                client.submit_job(job.status.jobId, job.spec.entrypoint,
+                                  job.spec.runtimeEnv, job.spec.metadata)
+            except CoordinatorError as e:
+                self._set_message(job, f"submission failed: {e}")
+                self._update(job)
+                return 2.0
+        # SIDECAR: the head pod template carried the entrypoint; nothing to do.
+        job.status.jobStatus = JobStatus.PENDING
+        return self._to(job, JobDeploymentStatus.RUNNING, requeue=1.0)
+
+    def _state_waiting(self, job: TpuJob) -> Optional[float]:
+        # Interactive: user submits with the job id; once the coordinator
+        # reports it, move to Running (ref Waiting :166 area).
+        if job.spec.suspend:
+            return self._to(job, JobDeploymentStatus.SUSPENDING, requeue=0.1)
+        cluster = self._cluster(job)
+        client = self._client(job, cluster) if cluster else None
+        if client is not None:
+            try:
+                client.get_job_info(job.status.jobId)
+                return self._to(job, JobDeploymentStatus.RUNNING, requeue=1.0)
+            except CoordinatorError:
+                pass
+        return 2.0
+
+    def _state_running(self, job: TpuJob) -> Optional[float]:
+        if job.spec.suspend:
+            return self._to(job, JobDeploymentStatus.SUSPENDING, requeue=0.1)
+        if job.spec.activeDeadlineSeconds and job.status.startTime and \
+                time.time() - job.status.startTime > job.spec.activeDeadlineSeconds:
+            return self._fail(job, JobFailedReason.DEADLINE_EXCEEDED,
+                              "activeDeadlineSeconds exceeded")
+
+        cluster = self._cluster(job)
+        if cluster is None:
+            return self._fail(job, JobFailedReason.APP_FAILED,
+                              "cluster disappeared while running")
+        job.status.clusterStatus = cluster.status.to_dict()
+
+        app_status = None
+        # Submitter (K8s Job) status (ref checkSubmitterAndUpdateStatus :1062).
+        if job.spec.submissionMode == JobSubmissionMode.K8S_JOB:
+            sub = self.store.try_get("Job", submitter_job_name(job.metadata.name),
+                                     job.metadata.namespace)
+            if sub is not None:
+                st = sub.get("status", {})
+                if st.get("succeeded"):
+                    app_status = JobStatus.SUCCEEDED
+                elif st.get("failed", 0) > job.spec.submitterConfig.backoffLimit:
+                    app_status = JobStatus.FAILED
+
+        client = self._client(job, cluster)
+        if client is not None:
+            try:
+                info = client.get_job_info(job.status.jobId)
+                job.status.jobStatus = info.status
+                if info.status in JobStatus.TERMINAL:
+                    app_status = info.status
+                job.status.message = info.message
+            except CoordinatorError:
+                if app_status is None:
+                    self._update(job)
+                    return 2.0
+
+        if app_status == JobStatus.SUCCEEDED:
+            job.status.jobStatus = JobStatus.SUCCEEDED
+            job.status.succeeded = 1
+            job.status.endTime = time.time()
+            self._emit_duration(job)
+            return self._to(job, JobDeploymentStatus.COMPLETE, requeue=0.1)
+        if app_status in (JobStatus.FAILED, JobStatus.STOPPED):
+            job.status.jobStatus = app_status
+            job.status.endTime = time.time()
+            # backoffLimit retries with fresh clusters (ref :518).
+            if int(job.status.failed) < job.spec.backoffLimit:
+                job.status.failed = int(job.status.failed) + 1
+                self._emit_duration(job)
+                return self._to(job, JobDeploymentStatus.RETRYING, requeue=0.1)
+            self._emit_duration(job)
+            return self._fail(job, JobFailedReason.APP_FAILED,
+                              job.status.message or "application failed")
+        self._update(job)
+        return 2.0
+
+    def _state_suspending(self, job: TpuJob) -> Optional[float]:
+        # Delete cluster + submitter, keep the CR (ref :366-418).
+        self._teardown(job)
+        job.status.jobStatus = JobStatus.STOPPED
+        return self._to(job, JobDeploymentStatus.SUSPENDED)
+
+    def _state_suspended(self, job: TpuJob) -> Optional[float]:
+        if not job.spec.suspend:
+            # Resume: back to New with a fresh cluster (ref requeue-to-New).
+            job.status.jobDeploymentStatus = JobDeploymentStatus.NEW
+            job.status.jobStatus = ""
+            job.status.startTime = 0.0
+            self._update(job)
+            return 0.1
+        return None
+
+    def _state_retrying(self, job: TpuJob) -> Optional[float]:
+        self._teardown(job)
+        job.status.jobDeploymentStatus = JobDeploymentStatus.NEW
+        job.status.jobStatus = ""
+        job.status.jobId = ""       # fresh submission id for the new attempt
+        self._update(job)
+        return 0.1
+
+    def _state_terminal(self, job: TpuJob) -> Optional[float]:
+        return self._handle_deletion_policy(job)
+
+    # ------------------------------------------------------------------
+    # deletion engine (ref handleDeletionRules :1413)
+    # ------------------------------------------------------------------
+
+    def _handle_deletion_policy(self, job: TpuJob) -> Optional[float]:
+        now = time.time()
+        end = job.status.endTime or now
+        succeeded = job.status.jobDeploymentStatus == JobDeploymentStatus.COMPLETE
+
+        if job.spec.deletionStrategy is not None and job.spec.deletionStrategy.rules:
+            cond = "Succeeded" if succeeded else "Failed"
+            due = [r for r in job.spec.deletionStrategy.rules
+                   if r.condition == cond and now - end >= r.ttlSeconds]
+            pending = [r for r in job.spec.deletionStrategy.rules
+                       if r.condition == cond and now - end < r.ttlSeconds]
+            if due:
+                # Most impactful rule wins (ref selectMostImpactfulRule :1685).
+                rank = {DeletionPolicyType.DELETE_SELF: 3,
+                        DeletionPolicyType.DELETE_CLUSTER: 2,
+                        DeletionPolicyType.DELETE_WORKERS: 1,
+                        DeletionPolicyType.DELETE_NONE: 0}
+                rule = max(due, key=lambda r: rank.get(r.policy, 0))
+                self._apply_deletion_policy(job, rule.policy)
+            if pending:
+                return max(0.5, min(r.ttlSeconds - (now - end) for r in pending))
+            return None
+
+        if job.spec.shutdownAfterJobFinishes:
+            ttl = job.spec.ttlSecondsAfterFinished
+            if now - end >= ttl:
+                self._apply_deletion_policy(job, DeletionPolicyType.DELETE_CLUSTER)
+                return None
+            return max(0.5, ttl - (now - end))
+        return None
+
+    def _apply_deletion_policy(self, job: TpuJob, policy: str):
+        ns = job.metadata.namespace
+        if policy == DeletionPolicyType.DELETE_CLUSTER:
+            self._delete_cluster(job)
+        elif policy == DeletionPolicyType.DELETE_WORKERS:
+            cluster = self.store.try_get(C.KIND_CLUSTER, job.status.clusterName, ns)
+            if cluster is not None and not job.spec.clusterSelector:
+                for g in cluster["spec"].get("workerGroupSpecs", []):
+                    g["replicas"] = 0
+                    g["minReplicas"] = 0
+                self.store.update(cluster)
+        elif policy == DeletionPolicyType.DELETE_SELF:
+            try:
+                self.store.delete(self.KIND, job.metadata.name, ns)
+            except NotFound:
+                pass
+
+    # ------------------------------------------------------------------
+    # helpers
+    # ------------------------------------------------------------------
+
+    def _get_or_create_cluster(self, job: TpuJob) -> Optional[TpuCluster]:
+        """Ref getOrCreateRayClusterInstance :947."""
+        ns = job.metadata.namespace
+        raw = self.store.try_get(C.KIND_CLUSTER, job.status.clusterName, ns)
+        if raw is not None:
+            return TpuCluster.from_dict(raw)
+        if job.spec.clusterSelector:
+            return None
+        spec = job.spec.clusterSpec.to_dict()
+        obj = {
+            "apiVersion": C.API_VERSION,
+            "kind": C.KIND_CLUSTER,
+            "metadata": {
+                "name": job.status.clusterName,
+                "namespace": ns,
+                "labels": {
+                    C.LABEL_ORIGINATED_FROM_CR_NAME: job.metadata.name,
+                    C.LABEL_ORIGINATED_FROM_CRD: C.KIND_JOB,
+                },
+                "ownerReferences": [{
+                    "apiVersion": C.API_VERSION, "kind": C.KIND_JOB,
+                    "name": job.metadata.name, "uid": job.metadata.uid,
+                    "controller": True, "blockOwnerDeletion": True,
+                }],
+            },
+            "spec": spec,
+            "status": {},
+        }
+        if job.spec.schedulerName:
+            obj["spec"]["schedulerName"] = job.spec.schedulerName
+        if job.spec.gangSchedulingQueue:
+            obj["spec"]["gangSchedulingQueue"] = job.spec.gangSchedulingQueue
+        try:
+            self.store.create(obj)
+        except AlreadyExists:
+            pass
+        return TpuCluster.from_dict(self.store.get(
+            C.KIND_CLUSTER, job.status.clusterName, ns))
+
+    def _ensure_submitter(self, job: TpuJob, cluster: TpuCluster):
+        sub = build_submitter_job(job, cluster)
+        try:
+            self.store.create(sub)
+            self.recorder.normal(job.to_dict(), "CreatedSubmitter",
+                                 f"created submitter {sub['metadata']['name']}")
+        except AlreadyExists:
+            pass
+
+    def _cluster(self, job: TpuJob) -> Optional[TpuCluster]:
+        raw = self.store.try_get(C.KIND_CLUSTER, job.status.clusterName,
+                                 job.metadata.namespace)
+        return TpuCluster.from_dict(raw) if raw else None
+
+    def _client(self, job: TpuJob, cluster: Optional[TpuCluster]):
+        if self.client_provider is None or cluster is None:
+            return None
+        return self.client_provider(cluster.status.to_dict())
+
+    def _teardown(self, job: TpuJob):
+        ns = job.metadata.namespace
+        sub_name = submitter_job_name(job.metadata.name)
+        try:
+            self.store.delete("Job", sub_name, ns)
+        except NotFound:
+            pass
+        self._delete_cluster(job)
+
+    def _delete_cluster(self, job: TpuJob):
+        # Never delete a selector-targeted (shared) cluster (ref selector
+        # semantics).
+        if job.spec.clusterSelector:
+            return
+        try:
+            self.store.delete(C.KIND_CLUSTER, job.status.clusterName,
+                              job.metadata.namespace)
+        except NotFound:
+            pass
+
+    def _reconcile_deletion(self, job: TpuJob) -> Optional[float]:
+        # StopJob finalizer: stop the app, tear down resources (ref New :166
+        # finalizer + deletion path).
+        cluster = self._cluster(job)
+        client = self._client(job, cluster)
+        if client is not None and job.status.jobStatus == JobStatus.RUNNING:
+            try:
+                client.stop_job(job.status.jobId)
+            except CoordinatorError:
+                pass
+        self._teardown(job)
+        if self.scheduler is not None:
+            self.scheduler.cleanup(job.to_dict())
+        self.store.remove_finalizer(self.KIND, job.metadata.name,
+                                    job.metadata.namespace, C.FINALIZER_JOB)
+        return None
+
+    def _to(self, job: TpuJob, state: str, requeue: Optional[float] = None
+            ) -> Optional[float]:
+        job.status.jobDeploymentStatus = state
+        self._update(job)
+        return requeue
+
+    def _fail(self, job: TpuJob, reason: str, message: str) -> Optional[float]:
+        job.status.jobDeploymentStatus = JobDeploymentStatus.FAILED
+        job.status.jobStatus = job.status.jobStatus or JobStatus.FAILED
+        job.status.reason = reason
+        job.status.message = message
+        job.status.endTime = job.status.endTime or time.time()
+        self._update(job)
+        self.recorder.warning(job.to_dict(), reason, message)
+        return 0.1
+
+    def _set_message(self, job: TpuJob, message: str):
+        job.status.message = message
+
+    def _emit_duration(self, job: TpuJob):
+        if self.metrics is not None and job.status.startTime:
+            self.metrics.observe_job_duration(
+                job.metadata.name,
+                job.status.jobStatus,
+                (job.status.endTime or time.time()) - job.status.startTime)
+
+    def _update(self, job: TpuJob):
+        obj = job.to_dict()
+        cur = self.store.try_get(self.KIND, job.metadata.name,
+                                 job.metadata.namespace)
+        if cur is not None and cur.get("status") != obj.get("status"):
+            self.store.update_status(obj)

@@ -1,0 +1,198 @@
+"""Controller manager: watch -> work queue -> reconcile loops.
+
+Level-triggered like controller-runtime (ref main.go:309-343 registration +
+mgr.Start): store watch events map to (kind, namespace, name) keys, a
+deduplicating work queue feeds reconcilers, requeue-after is honored.
+
+Two execution modes:
+- ``run_until_idle()``: deterministic draining for tests and embedded use
+  (the envtest analogue — no sleeping threads, reproducible order);
+- ``start()/stop()``: background worker threads with timed requeues for
+  live deployments.
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from kuberay_tpu.controlplane.expectations import HEAD_GROUP, ScaleExpectations
+from kuberay_tpu.controlplane.store import Event, ObjectStore
+from kuberay_tpu.utils import constants as C
+
+Key = Tuple[str, str, str]  # (kind, namespace, name)
+
+
+class Manager:
+    def __init__(self, store: ObjectStore,
+                 expectations: Optional[ScaleExpectations] = None):
+        self.store = store
+        self.expectations = expectations or ScaleExpectations()
+        self._reconcilers: Dict[str, Callable[[str, str], Optional[float]]] = {}
+        # kinds whose owned objects (by label) map back to an owner kind:
+        self._owned_maps: List[Callable[[Event], Optional[Key]]] = []
+        self._queue: List[Key] = []
+        self._queued: Set[Key] = set()
+        self._delayed: List[Tuple[float, Key]] = []
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._threads: List[threading.Thread] = []
+        self._stop = False
+        self._cancel_watch = store.watch(self._on_event)
+
+    # -- registration ------------------------------------------------------
+
+    def register(self, kind: str,
+                 reconcile: Callable[[str, str], Optional[float]]):
+        self._reconcilers[kind] = reconcile
+
+    def map_owned(self, fn: Callable[[Event], Optional[Key]]):
+        """Map events on owned objects (pods, services, jobs) to owner keys."""
+        self._owned_maps.append(fn)
+
+    # -- event plumbing ----------------------------------------------------
+
+    def _on_event(self, ev: Event):
+        md = ev.obj.get("metadata", {})
+        # Expectations observe pod churn (ref expectations consumption at
+        # raycluster_controller.go:974,1035).
+        if ev.kind == "Pod":
+            labels = md.get("labels", {})
+            cluster = labels.get(C.LABEL_CLUSTER)
+            if cluster:
+                group = (labels.get(C.LABEL_GROUP) or HEAD_GROUP)
+                self.expectations.observe_pod_event(
+                    md.get("namespace", "default"), cluster, group,
+                    md.get("name", ""), ev.type)
+        if ev.kind in self._reconcilers:
+            self.enqueue((ev.kind, md.get("namespace", "default"),
+                          md.get("name", "")))
+        for fn in self._owned_maps:
+            key = fn(ev)
+            if key is not None and key[0] in self._reconcilers:
+                self.enqueue(key)
+
+    def enqueue(self, key: Key, after: float = 0.0):
+        with self._lock:
+            if after > 0:
+                heapq.heappush(self._delayed, (time.time() + after, key))
+            elif key not in self._queued:
+                self._queued.add(key)
+                self._queue.append(key)
+            self._wake.notify_all()
+
+    def _pop(self, block: bool) -> Optional[Key]:
+        with self._lock:
+            while True:
+                now = time.time()
+                while self._delayed and self._delayed[0][0] <= now:
+                    _, key = heapq.heappop(self._delayed)
+                    if key not in self._queued:
+                        self._queued.add(key)
+                        self._queue.append(key)
+                if self._queue:
+                    key = self._queue.pop(0)
+                    self._queued.discard(key)
+                    return key
+                if not block or self._stop:
+                    return None
+                timeout = None
+                if self._delayed:
+                    timeout = max(0.0, self._delayed[0][0] - now)
+                self._wake.wait(timeout=timeout or 1.0)
+
+    # -- execution ---------------------------------------------------------
+
+    def _process(self, key: Key):
+        kind, ns, name = key
+        fn = self._reconcilers.get(kind)
+        if fn is None:
+            return
+        try:
+            requeue = fn(name, ns)
+        except Exception as e:   # reconcile errors requeue with backoff
+            import logging
+            logging.getLogger("kuberay_tpu.manager").exception(
+                "reconcile %s %s/%s failed: %s", kind, ns, name, e)
+            requeue = 5.0
+        if requeue:
+            self.enqueue(key, after=requeue)
+
+    def flush_delayed(self):
+        """Promote ALL timed requeues immediately (tests: 'advance time')."""
+        with self._lock:
+            while self._delayed:
+                _, key = heapq.heappop(self._delayed)
+                if key not in self._queued:
+                    self._queued.add(key)
+                    self._queue.append(key)
+            self._wake.notify_all()
+
+    def run_until_idle(self, max_iterations: int = 1000,
+                       include_delayed: bool = True) -> int:
+        """Drain the queue deterministically; returns iterations used.
+
+        ``include_delayed``: promote due delayed items while draining (items
+        scheduled in the future are NOT waited for — tests advance state and
+        call again, exactly like envtest's Eventually loops).
+        """
+        n = 0
+        while n < max_iterations:
+            key = self._pop(block=False)
+            if key is None:
+                return n
+            self._process(key)
+            n += 1
+        return n
+
+    def start(self, workers: int = 1):
+        self._stop = False
+        for i in range(workers):
+            t = threading.Thread(target=self._worker, daemon=True,
+                                 name=f"reconciler-{i}")
+            t.start()
+            self._threads.append(t)
+
+    def _worker(self):
+        while not self._stop:
+            key = self._pop(block=True)
+            if key is not None:
+                self._process(key)
+
+    def stop(self):
+        self._stop = True
+        with self._lock:
+            self._wake.notify_all()
+        for t in self._threads:
+            t.join(timeout=2.0)
+        self._threads.clear()
+
+
+def owned_pod_mapper(ev: Event) -> Optional[Key]:
+    """Pods carry the cluster label -> reconcile the owning TpuCluster
+    (ref Owns(Pod) in SetupWithManager)."""
+    if ev.kind != "Pod":
+        return None
+    md = ev.obj.get("metadata", {})
+    cluster = md.get("labels", {}).get(C.LABEL_CLUSTER)
+    if not cluster:
+        return None
+    return (C.KIND_CLUSTER, md.get("namespace", "default"), cluster)
+
+
+def originated_from_mapper(owner_kind: str) -> Callable[[Event], Optional[Key]]:
+    """Objects stamped with originated-from labels reconcile their creating
+    CR (ref RayJob Owns(RayCluster/Job), RayService Owns(RayCluster):
+    main.go:319 registration)."""
+    def mapper(ev: Event) -> Optional[Key]:
+        md = ev.obj.get("metadata", {})
+        labels = md.get("labels", {})
+        if labels.get(C.LABEL_ORIGINATED_FROM_CRD) != owner_kind:
+            return None
+        name = labels.get(C.LABEL_ORIGINATED_FROM_CR_NAME)
+        if not name:
+            return None
+        return (owner_kind, md.get("namespace", "default"), name)
+    return mapper

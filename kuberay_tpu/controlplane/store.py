@@ -1,0 +1,290 @@
+"""In-memory K8s-style object store: the control plane's substrate.
+
+Plays the role the kube-apiserver + etcd play for the reference (and that
+envtest plays in its tests, SURVEY.md §4 tier 2): typed objects with
+metadata, resourceVersion-based optimistic concurrency, watch events,
+finalizers, deletionTimestamps, and owner-reference cascading GC.
+
+Controllers talk to this through the same verbs a K8s client exposes
+(get/list/create/update/patch-status/delete/watch), so a real-cluster
+backend can be slotted behind the same interface later.  Thread-safe:
+reconcilers run on worker threads.
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+import time
+import uuid
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+
+class StoreError(Exception):
+    pass
+
+
+class NotFound(StoreError):
+    pass
+
+
+class AlreadyExists(StoreError):
+    pass
+
+
+class Conflict(StoreError):
+    """resourceVersion mismatch (optimistic concurrency failure)."""
+
+
+class Invalid(StoreError):
+    pass
+
+
+def _key(kind: str, namespace: str, name: str) -> Tuple[str, str, str]:
+    return (kind, namespace, name)
+
+
+class Event:
+    ADDED = "ADDED"
+    MODIFIED = "MODIFIED"
+    DELETED = "DELETED"
+
+    __slots__ = ("type", "kind", "obj")
+
+    def __init__(self, type_: str, kind: str, obj: Dict[str, Any]):
+        self.type = type_
+        self.kind = kind
+        self.obj = obj
+
+
+class ObjectStore:
+    """Objects are plain dicts with apiVersion/kind/metadata/spec/status —
+    exactly the ``to_dict`` form of the api/ dataclasses."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._objects: Dict[Tuple[str, str, str], Dict[str, Any]] = {}
+        self._rv = 0
+        self._watchers: List[Callable[[Event], None]] = []
+
+    # -- helpers -----------------------------------------------------------
+
+    def _next_rv(self) -> int:
+        self._rv += 1
+        return self._rv
+
+    def _notify(self, ev: Event):
+        for w in list(self._watchers):
+            try:
+                w(ev)
+            except Exception:
+                pass  # watcher errors never poison the store
+
+    def watch(self, fn: Callable[[Event], None]) -> Callable[[], None]:
+        """Register a watcher; returns an unsubscribe function."""
+        with self._lock:
+            self._watchers.append(fn)
+
+        def cancel():
+            with self._lock:
+                if fn in self._watchers:
+                    self._watchers.remove(fn)
+        return cancel
+
+    # -- verbs -------------------------------------------------------------
+
+    def create(self, obj: Dict[str, Any]) -> Dict[str, Any]:
+        obj = copy.deepcopy(obj)
+        kind = obj.get("kind")
+        md = obj.setdefault("metadata", {})
+        name, ns = md.get("name"), md.get("namespace", "default")
+        if not kind or not name:
+            raise Invalid("kind and metadata.name are required")
+        md.setdefault("namespace", "default")
+        with self._lock:
+            k = _key(kind, ns, name)
+            if k in self._objects:
+                raise AlreadyExists(f"{kind} {ns}/{name} already exists")
+            md["uid"] = md.get("uid") or uuid.uuid4().hex
+            md["creationTimestamp"] = md.get("creationTimestamp") or time.time()
+            md["resourceVersion"] = self._next_rv()
+            md.setdefault("generation", 1)
+            self._objects[k] = obj
+            out = copy.deepcopy(obj)
+            self._notify(Event(Event.ADDED, kind, copy.deepcopy(obj)))
+        return out
+
+    def get(self, kind: str, name: str, namespace: str = "default") -> Dict[str, Any]:
+        with self._lock:
+            obj = self._objects.get(_key(kind, namespace, name))
+            if obj is None:
+                raise NotFound(f"{kind} {namespace}/{name} not found")
+            return copy.deepcopy(obj)
+
+    def try_get(self, kind: str, name: str, namespace: str = "default"):
+        try:
+            return self.get(kind, name, namespace)
+        except NotFound:
+            return None
+
+    def list(self, kind: str, namespace: Optional[str] = None,
+             labels: Optional[Dict[str, str]] = None) -> List[Dict[str, Any]]:
+        with self._lock:
+            out = []
+            for (k, ns, _), obj in self._objects.items():
+                if k != kind:
+                    continue
+                if namespace is not None and ns != namespace:
+                    continue
+                if labels:
+                    obj_labels = obj.get("metadata", {}).get("labels", {})
+                    if any(obj_labels.get(lk) != lv for lk, lv in labels.items()):
+                        continue
+                out.append(copy.deepcopy(obj))
+            out.sort(key=lambda o: (o["metadata"]["namespace"], o["metadata"]["name"]))
+            return out
+
+    def update(self, obj: Dict[str, Any], *, subresource: str = "") -> Dict[str, Any]:
+        """Full-object update with optimistic concurrency.
+
+        ``subresource='status'`` mimics the status subresource: spec changes
+        are ignored and generation does not bump.  Spec updates bump
+        ``metadata.generation`` (like the K8s generation contract).
+        """
+        obj = copy.deepcopy(obj)
+        kind = obj.get("kind")
+        md = obj.get("metadata", {})
+        name, ns = md.get("name"), md.get("namespace", "default")
+        with self._lock:
+            k = _key(kind, ns, name)
+            cur = self._objects.get(k)
+            if cur is None:
+                raise NotFound(f"{kind} {ns}/{name} not found")
+            cur_md = cur["metadata"]
+            if md.get("resourceVersion") and md["resourceVersion"] != cur_md["resourceVersion"]:
+                raise Conflict(
+                    f"{kind} {ns}/{name}: resourceVersion {md.get('resourceVersion')} "
+                    f"!= {cur_md['resourceVersion']}")
+            new = copy.deepcopy(cur)
+            if subresource == "status":
+                new["status"] = obj.get("status", {})
+            else:
+                # Immutable fields preserved; spec/metadata writable.
+                spec_changed = obj.get("spec") != cur.get("spec")
+                new["spec"] = obj.get("spec", cur.get("spec"))
+                new_md = copy.deepcopy(md)
+                for field in ("uid", "creationTimestamp", "generation",
+                              "deletionTimestamp"):
+                    new_md[field] = cur_md.get(field)
+                new["metadata"] = new_md
+                if spec_changed:
+                    new["metadata"]["generation"] = cur_md.get("generation", 1) + 1
+                # status only via subresource
+                new["status"] = cur.get("status", {})
+            new["metadata"]["resourceVersion"] = self._next_rv()
+            self._objects[k] = new
+            out = copy.deepcopy(new)
+            self._notify(Event(Event.MODIFIED, kind, copy.deepcopy(new)))
+        # Deleting an object is finalized outside the lock path; check here:
+        self._maybe_finalize_delete(kind, name, ns)
+        return out
+
+    def update_status(self, obj: Dict[str, Any]) -> Dict[str, Any]:
+        return self.update(obj, subresource="status")
+
+    def patch_labels(self, kind: str, name: str, namespace: str,
+                     labels: Dict[str, Optional[str]]) -> Dict[str, Any]:
+        with self._lock:
+            cur = self._objects.get(_key(kind, namespace, name))
+            if cur is None:
+                raise NotFound(f"{kind} {namespace}/{name} not found")
+            lab = cur["metadata"].setdefault("labels", {})
+            for k, v in labels.items():
+                if v is None:
+                    lab.pop(k, None)
+                else:
+                    lab[k] = v
+            cur["metadata"]["resourceVersion"] = self._next_rv()
+            self._notify(Event(Event.MODIFIED, kind, copy.deepcopy(cur)))
+            return copy.deepcopy(cur)
+
+    def delete(self, kind: str, name: str, namespace: str = "default") -> None:
+        """Graceful delete: sets deletionTimestamp; the object is removed
+        once finalizers empty (the K8s finalizer contract)."""
+        with self._lock:
+            k = _key(kind, namespace, name)
+            cur = self._objects.get(k)
+            if cur is None:
+                raise NotFound(f"{kind} {namespace}/{name} not found")
+            if not cur["metadata"].get("deletionTimestamp"):
+                cur["metadata"]["deletionTimestamp"] = time.time()
+                cur["metadata"]["resourceVersion"] = self._next_rv()
+                self._notify(Event(Event.MODIFIED, kind, copy.deepcopy(cur)))
+        self._maybe_finalize_delete(kind, name, namespace)
+
+    def remove_finalizer(self, kind: str, name: str, namespace: str,
+                         finalizer: str) -> None:
+        with self._lock:
+            cur = self._objects.get(_key(kind, namespace, name))
+            if cur is None:
+                return
+            fins = cur["metadata"].get("finalizers", [])
+            if finalizer in fins:
+                fins.remove(finalizer)
+                cur["metadata"]["resourceVersion"] = self._next_rv()
+                self._notify(Event(Event.MODIFIED, kind, copy.deepcopy(cur)))
+        self._maybe_finalize_delete(kind, name, namespace)
+
+    def add_finalizer(self, kind: str, name: str, namespace: str,
+                      finalizer: str) -> None:
+        with self._lock:
+            cur = self._objects.get(_key(kind, namespace, name))
+            if cur is None:
+                raise NotFound(f"{kind} {namespace}/{name} not found")
+            fins = cur["metadata"].setdefault("finalizers", [])
+            if finalizer not in fins:
+                fins.append(finalizer)
+                cur["metadata"]["resourceVersion"] = self._next_rv()
+                self._notify(Event(Event.MODIFIED, kind, copy.deepcopy(cur)))
+
+    def _maybe_finalize_delete(self, kind: str, name: str, namespace: str):
+        """Remove the object if it is terminating with no finalizers, then
+        cascade-delete dependents (ownerReference GC)."""
+        removed = None
+        with self._lock:
+            k = _key(kind, namespace, name)
+            cur = self._objects.get(k)
+            if (cur is not None and cur["metadata"].get("deletionTimestamp")
+                    and not cur["metadata"].get("finalizers")):
+                removed = self._objects.pop(k)
+                self._notify(Event(Event.DELETED, kind, copy.deepcopy(removed)))
+        if removed is not None:
+            self._cascade_delete(removed)
+
+    def _cascade_delete(self, owner: Dict[str, Any]):
+        uid = owner["metadata"].get("uid")
+        ns = owner["metadata"].get("namespace", "default")
+        dependents = []
+        with self._lock:
+            for (kind, ons, name), obj in list(self._objects.items()):
+                if ons != ns:
+                    continue
+                for ref in obj["metadata"].get("ownerReferences", []):
+                    if ref.get("uid") == uid:
+                        dependents.append((kind, name))
+                        break
+        for kind, name in dependents:
+            try:
+                self.delete(kind, name, ns)
+            except NotFound:
+                pass
+
+    # -- introspection -----------------------------------------------------
+
+    def count(self, kind: str) -> int:
+        with self._lock:
+            return sum(1 for (k, _, _) in self._objects if k == kind)
+
+    def resource_version(self) -> int:
+        with self._lock:
+            return self._rv

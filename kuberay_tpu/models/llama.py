@@ -1,0 +1,214 @@
+"""Llama-3-family transformer, TPU-first.
+
+Design (not a torch port):
+- pure functional: params are a pytree of arrays; ``forward(params, tokens)``
+  is jit/pjit-able with zero Python state;
+- layers are *stacked* ([n_layers, ...] leading dim) and iterated with
+  ``lax.scan`` — one compiled layer body regardless of depth (fast compiles,
+  natural remat boundary);
+- every param leaf carries logical sharding axes (``param_axes``) consumed
+  by parallel/mesh.py rules -> NamedSharding;
+- attention is the Pallas flash kernel on TPU (ops/attention.py), GQA
+  native; norms are the fused Pallas RMSNorm;
+- bfloat16 activations/params by default, f32 logits for a stable loss.
+
+The flagship config (llama3_8b) is BASELINE config #3's payload
+(RayJob Llama-3-8B pretrain); smaller presets serve tests and single-chip
+benchmarks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from kuberay_tpu.ops.attention import flash_attention
+from kuberay_tpu.ops.rmsnorm import rmsnorm
+from kuberay_tpu.ops.rope import apply_rope, rope_frequencies
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    d_ff: int = 14336
+    max_seq_len: int = 8192
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    tie_embeddings: bool = False
+    dtype: Any = jnp.bfloat16
+    attn_impl: str = "auto"      # auto | pallas | xla | pallas_interpret
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    def num_params(self) -> int:
+        d, f, v, L = self.d_model, self.d_ff, self.vocab_size, self.n_layers
+        hd = self.head_dim
+        attn = d * (self.n_heads * hd) + 2 * d * (self.n_kv_heads * hd) \
+            + (self.n_heads * hd) * d
+        mlp = 3 * d * f
+        per_layer = attn + mlp + 2 * d
+        head = 0 if self.tie_embeddings else d * v
+        return v * d + L * per_layer + d + head
+
+
+CONFIGS: Dict[str, LlamaConfig] = {
+    # Test-size: everything tiny, CPU-friendly.
+    "llama_tiny": LlamaConfig(
+        vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq_len=128, dtype=jnp.float32, attn_impl="xla",
+        remat=False),
+    # ~125M for smoke benchmarks.
+    "llama_125m": LlamaConfig(
+        vocab_size=32000, d_model=768, n_layers=12, n_heads=12, n_kv_heads=12,
+        d_ff=2048, max_seq_len=2048),
+    # ~1.2B: single-chip bench model (fits v5e 16 GiB with bf16 + adam).
+    "llama_1b": LlamaConfig(
+        vocab_size=32768, d_model=2048, n_layers=16, n_heads=16, n_kv_heads=8,
+        d_ff=8192, max_seq_len=4096),
+    # The flagship (BASELINE config #3).
+    "llama3_8b": LlamaConfig(),
+    "llama3_70b": LlamaConfig(
+        d_model=8192, n_layers=80, n_heads=64, n_kv_heads=8, d_ff=28672),
+}
+
+
+# --------------------------------------------------------------------------
+# Params: init + logical axes
+# --------------------------------------------------------------------------
+
+def param_axes(cfg: LlamaConfig) -> Dict[str, Any]:
+    """Logical sharding axes per leaf, same tree structure as params."""
+    axes = {
+        "embed": ("vocab", "embed"),
+        "layers": {
+            "attn_norm": ("layers", "norm"),
+            "wq": ("layers", "embed", "heads"),
+            "wk": ("layers", "embed", "kv_heads"),
+            "wv": ("layers", "embed", "kv_heads"),
+            "wo": ("layers", "heads", "embed"),
+            "mlp_norm": ("layers", "norm"),
+            "w_gate": ("layers", "embed", "mlp"),
+            "w_up": ("layers", "embed", "mlp"),
+            "w_down": ("layers", "mlp", "embed"),
+        },
+        "final_norm": ("norm",),
+    }
+    if not cfg.tie_embeddings:
+        axes["lm_head"] = ("embed", "vocab")
+    return axes
+
+
+def init_params(cfg: LlamaConfig, key: jax.Array) -> Dict[str, Any]:
+    """Scaled-normal init (GPT-NeoX style residual scaling on out-projs)."""
+    d, f, v, L = cfg.d_model, cfg.d_ff, cfg.vocab_size, cfg.n_layers
+    hq, hkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    k = iter(jax.random.split(key, 16))
+    std = 1.0 / math.sqrt(d)
+    out_std = std / math.sqrt(2 * L)
+
+    def norm_init(*shape):
+        return jnp.ones(shape, dtype=cfg.dtype)
+
+    def rnd(key, shape, scale):
+        return (jax.random.normal(key, shape, dtype=jnp.float32) * scale
+                ).astype(cfg.dtype)
+
+    params = {
+        "embed": rnd(next(k), (v, d), std),
+        "layers": {
+            "attn_norm": norm_init(L, d),
+            "wq": rnd(next(k), (L, d, hq * hd), std),
+            "wk": rnd(next(k), (L, d, hkv * hd), std),
+            "wv": rnd(next(k), (L, d, hkv * hd), std),
+            "wo": rnd(next(k), (L, hq * hd, d), out_std),
+            "mlp_norm": norm_init(L, d),
+            "w_gate": rnd(next(k), (L, d, f), std),
+            "w_up": rnd(next(k), (L, d, f), std),
+            "w_down": rnd(next(k), (L, f, d), out_std),
+        },
+        "final_norm": norm_init(d),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = rnd(next(k), (d, v), std)
+    return params
+
+
+# --------------------------------------------------------------------------
+# Forward
+# --------------------------------------------------------------------------
+
+def _layer(cfg: LlamaConfig, x: jax.Array, lp: Dict[str, jax.Array],
+           cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """One transformer block.  x: [B, S, d]."""
+    B, S, d = x.shape
+    hq, hkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+
+    h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+    q = (h @ lp["wq"]).reshape(B, S, hq, hd)
+    kk = (h @ lp["wk"]).reshape(B, S, hkv, hd)
+    vv = (h @ lp["wv"]).reshape(B, S, hkv, hd)
+    q = apply_rope(q, cos, sin)
+    kk = apply_rope(kk, cos, sin)
+    attn = flash_attention(q, kk, vv, causal=True, impl=cfg.attn_impl)
+    x = x + (attn.reshape(B, S, hq * hd) @ lp["wo"]).astype(x.dtype)
+
+    h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
+    gated = jax.nn.silu(h @ lp["w_gate"]) * (h @ lp["w_up"])
+    x = x + (gated @ lp["w_down"]).astype(x.dtype)
+    return x
+
+
+def forward(cfg: LlamaConfig, params: Dict[str, Any],
+            tokens: jax.Array) -> jax.Array:
+    """tokens: [B, S] int32 -> logits [B, S, vocab] float32."""
+    B, S = tokens.shape
+    x = jnp.take(params["embed"], tokens, axis=0)          # [B, S, d]
+    cos, sin = rope_frequencies(cfg.head_dim, S, cfg.rope_theta)
+
+    layer_fn = lambda x, lp: (_layer(cfg, x, lp, cos, sin), None)
+    if cfg.remat:
+        layer_fn = jax.checkpoint(layer_fn, prevent_cse=False)
+    x, _ = jax.lax.scan(layer_fn, x, params["layers"])
+
+    x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("bsd,dv->bsv", x, head,
+                        preferred_element_type=jnp.float32)
+    return logits
+
+
+def loss_fn(cfg: LlamaConfig, params: Dict[str, Any], tokens: jax.Array,
+            targets: jax.Array, mask: Optional[jax.Array] = None,
+            z_loss: float = 1e-4) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Next-token cross entropy with z-loss regularization.
+
+    tokens/targets: [B, S]; mask: [B, S] (1 = contributes to loss).
+    """
+    logits = forward(cfg, params, tokens)                  # [B,S,V] f32
+    logz = jax.nn.logsumexp(logits, axis=-1)               # [B,S]
+    true_logit = jnp.take_along_axis(
+        logits, targets[..., None], axis=-1).squeeze(-1)
+    nll = logz - true_logit
+    zl = z_loss * jnp.square(logz)
+    per_tok = nll + zl
+    if mask is None:
+        mask = jnp.ones_like(nll)
+    denom = jnp.maximum(mask.sum(), 1.0)
+    loss = (per_tok * mask).sum() / denom
+    metrics = {
+        "loss": (nll * mask).sum() / denom,
+        "z_loss": (zl * mask).sum() / denom,
+        "accuracy": ((logits.argmax(-1) == targets) * mask).sum() / denom,
+    }
+    return loss, metrics

@@ -1,0 +1,387 @@
+"""Flash attention for TPU: Pallas kernels with an XLA fallback.
+
+Memory-efficient attention (never materializes the [S, S] score matrix in
+HBM): online-softmax forward saving per-row LSE, and a two-kernel backward
+(dKV sweep, dQ sweep) recomputing P from Q/K/LSE — the standard
+flash-attention-2 decomposition, laid out for the MXU:
+
+- grid (batch, q_head, q_block, kv_block) with VMEM scratch accumulators
+  carried across the innermost (sequential) kv grid dimension;
+- all matmuls f32-accumulated via ``preferred_element_type``;
+- causal blocks that are entirely masked are skipped (no MXU work);
+- GQA folds the q-head -> kv-head mapping into the k/v BlockSpec index
+  maps, so grouped heads stream the same K/V blocks.
+
+Layout convention: [batch, heads, seq, head_dim] inside the kernels.
+Public API takes [batch, seq, heads, head_dim] (model layout) and
+transposes at the boundary.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30  # avoids NaN from (-inf) - (-inf) in online softmax
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+# --------------------------------------------------------------------------
+# XLA reference / fallback
+# --------------------------------------------------------------------------
+
+def attention_xla(q, k, v, causal: bool = True, scale: Optional[float] = None):
+    """Reference attention.  q: [B,Sq,Hq,D], k/v: [B,Skv,Hkv,D] (GQA ok)."""
+    B, Sq, Hq, D = q.shape
+    _, Skv, Hkv, _ = k.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    group = Hq // Hkv
+    kk = jnp.repeat(k, group, axis=2) if group > 1 else k
+    vv = jnp.repeat(v, group, axis=2) if group > 1 else v
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, kk,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        qi = jnp.arange(Sq)[:, None] + (Skv - Sq)
+        ki = jnp.arange(Skv)[None, :]
+        s = jnp.where(ki <= qi, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(vv.dtype), vv,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
+# --------------------------------------------------------------------------
+# Pallas forward
+# --------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr,
+                *, scale, causal, bq, bkv, num_kv):
+    i = pl.program_id(2)          # q block index
+    j = pl.program_id(3)          # kv block index (innermost, sequential)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # Causal: skip kv blocks strictly above the diagonal band.
+    visible = (j * bkv <= i * bq + bq - 1) if causal else True
+
+    @pl.when(visible)
+    def _compute():
+        q = q_ref[0, 0, :, :]                     # [bq, D]
+        k = k_ref[0, 0, :, :]                     # [bkv, D]
+        v = v_ref[0, 0, :, :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [bq, bkv]
+        if causal:
+            rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 0)
+            cols = j * bkv + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 1)
+            s = jnp.where(cols <= rows, s, _NEG_INF)
+        m_prev = m_scr[:, :1]                     # [bq, 1]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        corr = jnp.exp(m_prev - m_cur)            # [bq, 1]
+        p = jnp.exp(s - m_cur)                    # [bq, bkv]
+        l_cur = corr * l_scr[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)   # [bq, D]
+        acc_scr[:, :] = acc_scr[:, :] * corr + pv
+        m_scr[:, :] = jnp.broadcast_to(m_cur, m_scr.shape)
+        l_scr[:, :] = jnp.broadcast_to(l_cur, l_scr.shape)
+
+    @pl.when(j == num_kv - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        # Fully-masked rows (can't happen with causal self-attn) guard:
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0, :, :] = (acc_scr[:, :] / l).astype(o_ref.dtype)
+        lse_ref[0, 0, :, :] = m_scr[:, :1] + jnp.log(l)
+
+
+def _flash_fwd(q, k, v, scale, causal, bq, bkv, interpret):
+    """q: [B,Hq,Sq,D]; k/v: [B,Hkv,Skv,D] -> (out [B,Hq,Sq,D], lse [B,Hq,Sq,1])."""
+    B, Hq, Sq, D = q.shape
+    _, Hkv, Skv, _ = k.shape
+    group = Hq // Hkv
+    nq, nkv = Sq // bq, Skv // bkv
+    grid = (B, Hq, nq, nkv)
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, bq=bq, bkv=bkv, num_kv=nkv)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bkv, D), lambda b, h, i, j: (b, h // group, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bkv, D), lambda b, h, i, j: (b, h // group, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bq, 1), lambda b, h, i, j: (b, h, i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, Hq, Sq, D), q.dtype),
+            jax.ShapeDtypeStruct((B, Hq, Sq, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 128), jnp.float32),   # running max
+            pltpu.VMEM((bq, 128), jnp.float32),   # running sum
+            pltpu.VMEM((bq, D), jnp.float32),     # output accumulator
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse
+
+
+# --------------------------------------------------------------------------
+# Pallas backward (flash-attention-2 style, two sweeps)
+# --------------------------------------------------------------------------
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr,
+                    *, scale, causal, bq, bkv, num_q):
+    j = pl.program_id(2)          # kv block
+    i = pl.program_id(3)          # q block (innermost)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    visible = (j * bkv <= i * bq + bq - 1) if causal else True
+
+    @pl.when(visible)
+    def _compute():
+        q = q_ref[0, 0, :, :]                    # [bq, D]
+        k = k_ref[0, 0, :, :]                    # [bkv, D]
+        v = v_ref[0, 0, :, :]
+        do = do_ref[0, 0, :, :]                  # [bq, D]
+        lse = lse_ref[0, 0, :, :]                # [bq, 1]
+        delta = delta_ref[0, 0, :, :]            # [bq, 1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale     # [bq, bkv]
+        if causal:
+            rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 0)
+            cols = j * bkv + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 1)
+            s = jnp.where(cols <= rows, s, _NEG_INF)
+        p = jnp.exp(s - lse)                     # [bq, bkv]
+        # dV += P^T @ dO
+        dv_scr[:, :] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        # dP = dO @ V^T ; dS = P * (dP - delta) * scale
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale            # [bq, bkv]
+        # dK += dS^T @ Q
+        dk_scr[:, :] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(i == num_q - 1)
+    def _finalize():
+        dk_ref[0, 0, :, :] = dk_scr[:, :].astype(dk_ref.dtype)
+        dv_ref[0, 0, :, :] = dv_scr[:, :].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_scr,
+                   *, scale, causal, bq, bkv, num_kv):
+    i = pl.program_id(2)          # q block
+    j = pl.program_id(3)          # kv block (innermost)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    visible = (j * bkv <= i * bq + bq - 1) if causal else True
+
+    @pl.when(visible)
+    def _compute():
+        q = q_ref[0, 0, :, :]
+        k = k_ref[0, 0, :, :]
+        v = v_ref[0, 0, :, :]
+        do = do_ref[0, 0, :, :]
+        lse = lse_ref[0, 0, :, :]
+        delta = delta_ref[0, 0, :, :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 0)
+            cols = j * bkv + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 1)
+            s = jnp.where(cols <= rows, s, _NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale            # [bq, bkv]
+        dq_scr[:, :] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(j == num_kv - 1)
+    def _finalize():
+        dq_ref[0, 0, :, :] = dq_scr[:, :].astype(dq_ref.dtype)
+
+
+def _flash_bwd(q, k, v, out, lse, do, scale, causal, bq, bkv, interpret):
+    B, Hq, Sq, D = q.shape
+    _, Hkv, Skv, _ = k.shape
+    group = Hq // Hkv
+    nq, nkv = Sq // bq, Skv // bkv
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)      # [B,Hq,Sq,1]
+
+    in_specs = [
+        pl.BlockSpec((1, 1, bq, D), lambda b, h, j, i: (b, h, i, 0),
+                     memory_space=pltpu.VMEM),               # q
+        pl.BlockSpec((1, 1, bkv, D), lambda b, h, j, i: (b, h // group, j, 0),
+                     memory_space=pltpu.VMEM),               # k
+        pl.BlockSpec((1, 1, bkv, D), lambda b, h, j, i: (b, h // group, j, 0),
+                     memory_space=pltpu.VMEM),               # v
+        pl.BlockSpec((1, 1, bq, D), lambda b, h, j, i: (b, h, i, 0),
+                     memory_space=pltpu.VMEM),               # do
+        pl.BlockSpec((1, 1, bq, 1), lambda b, h, j, i: (b, h, i, 0),
+                     memory_space=pltpu.VMEM),               # lse
+        pl.BlockSpec((1, 1, bq, 1), lambda b, h, j, i: (b, h, i, 0),
+                     memory_space=pltpu.VMEM),               # delta
+    ]
+    # dKV sweep: per-q-head gradients, summed over the GQA group afterwards.
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          bq=bq, bkv=bkv, num_q=nq),
+        grid=(B, Hq, nkv, nq),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, 1, bkv, D), lambda b, h, j, i: (b, h, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bkv, D), lambda b, h, j, i: (b, h, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, Hq, Skv, D), jnp.float32),
+            jax.ShapeDtypeStruct((B, Hq, Skv, D), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bkv, D), jnp.float32),
+            pltpu.VMEM((bkv, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    if group > 1:
+        dk = dk.reshape(B, Hkv, group, Skv, D).sum(axis=2)
+        dv = dv.reshape(B, Hkv, group, Skv, D).sum(axis=2)
+
+    dq_spec_q = [
+        pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, 1, bkv, D), lambda b, h, i, j: (b, h // group, j, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, 1, bkv, D), lambda b, h, i, j: (b, h // group, j, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, 1, bq, 1), lambda b, h, i, j: (b, h, i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, 1, bq, 1), lambda b, h, i, j: (b, h, i, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          bq=bq, bkv=bkv, num_kv=nkv),
+        grid=(B, Hq, nq, nkv),
+        in_specs=dq_spec_q,
+        out_specs=pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((B, Hq, Sq, D), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+# --------------------------------------------------------------------------
+# Public API
+# --------------------------------------------------------------------------
+
+def _pick_block(seq: int, target: int = 512) -> int:
+    """Largest power-of-two block <= target that divides seq (min 8)."""
+    b = min(target, seq)
+    while seq % b != 0 and b > 8:
+        b //= 2
+    return max(b, 1)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q, k, v, scale, causal, interpret):
+    out, _ = _flash_fwd(q, k, v, scale, causal,
+                        _pick_block(q.shape[2]), _pick_block(k.shape[2]),
+                        interpret)
+    return out
+
+
+def _flash_fwd_rule(q, k, v, scale, causal, interpret):
+    out, lse = _flash_fwd(q, k, v, scale, causal,
+                          _pick_block(q.shape[2]), _pick_block(k.shape[2]),
+                          interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd_rule(scale, causal, interpret, res, do):
+    q, k, v, out, lse = res
+    return _flash_bwd(q, k, v, out, lse, do, scale, causal,
+                      _pick_block(q.shape[2]), _pick_block(k.shape[2]),
+                      interpret)
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(q, k, v, causal: bool = True,
+                    scale: Optional[float] = None,
+                    impl: str = "auto") -> jax.Array:
+    """Flash attention.  q: [B,Sq,Hq,D]; k/v: [B,Skv,Hkv,D]; GQA via Hq>Hkv.
+
+    ``impl``: 'auto' (Pallas on TPU, XLA elsewhere), 'pallas', 'xla',
+    'pallas_interpret' (for CPU tests of the kernel itself).
+    """
+    B, Sq, Hq, D = q.shape
+    _, Skv, Hkv, _ = k.shape
+    if Hq % Hkv != 0:
+        raise ValueError(f"q heads {Hq} must be a multiple of kv heads {Hkv}")
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    if impl == "auto":
+        impl = "pallas" if _on_tpu() else "xla"
+    if impl == "xla":
+        return attention_xla(q, k, v, causal, scale)
+    interpret = impl == "pallas_interpret"
+    # -> [B,H,S,D] kernel layout
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    out = _flash(qt, kt, vt, scale, causal, interpret)
+    return out.transpose(0, 2, 1, 3)

@@ -1,0 +1,96 @@
+"""Fused RMSNorm: Pallas TPU kernel with an XLA fallback.
+
+The kernel fuses the mean-square reduction, rsqrt, and scale multiply in
+VMEM — one HBM read + one write per element instead of the several a naive
+composition can incur when XLA doesn't fuse across the reduction.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def rmsnorm_xla(x: jax.Array, weight: jax.Array, eps: float = 1e-5) -> jax.Array:
+    """Reference implementation; also the CPU/GPU fallback."""
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    y = xf * jax.lax.rsqrt(var + eps)
+    return (y * weight.astype(jnp.float32)).astype(dtype)
+
+
+def _rmsnorm_kernel(x_ref, w_ref, o_ref, *, eps: float):
+    x = x_ref[:].astype(jnp.float32)
+    var = jnp.mean(x * x, axis=-1, keepdims=True)
+    y = x * jax.lax.rsqrt(var + eps)
+    o_ref[:] = (y * w_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("eps", "block_rows"))
+def rmsnorm_pallas(x: jax.Array, weight: jax.Array, eps: float = 1e-5,
+                   block_rows: int = 256) -> jax.Array:
+    """Row-blocked fused RMSNorm.  x: [..., d]; weight: [d]."""
+    orig_shape = x.shape
+    d = x.shape[-1]
+    rows = x.size // d
+    x2 = x.reshape(rows, d)
+    block_rows = min(block_rows, rows)
+    # Row count must tile; fall back for ragged shapes.
+    if rows % block_rows != 0:
+        return rmsnorm_xla(x, weight, eps)
+    grid = (rows // block_rows,)
+    out = pl.pallas_call(
+        functools.partial(_rmsnorm_kernel, eps=eps),
+        out_shape=jax.ShapeDtypeStruct((rows, d), x.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_rows, d), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((d,), lambda i: (0,), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((block_rows, d), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+    )(x2, weight)
+    return out.reshape(orig_shape)
+
+
+def rmsnorm(x: jax.Array, weight: jax.Array, eps: float = 1e-5) -> jax.Array:
+    """Backend-dispatching RMSNorm (differentiable everywhere: the Pallas
+    path is forward-only fused; gradients flow through the XLA definition
+    via custom_vjp recompute)."""
+    return _rmsnorm(x, weight, eps)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _rmsnorm(x, weight, eps):
+    return _rmsnorm_fwd_impl(x, weight, eps)
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def _rmsnorm_fwd_impl(x, weight, eps):
+    if _on_tpu():
+        return rmsnorm_pallas(x, weight, eps)
+    return rmsnorm_xla(x, weight, eps)
+
+
+def _rmsnorm_fwd(x, weight, eps):
+    return _rmsnorm_fwd_impl(x, weight, eps), (x, weight)
+
+
+def _rmsnorm_bwd(eps, res, g):
+    x, weight = res
+    _, vjp = jax.vjp(lambda xx, ww: rmsnorm_xla(xx, ww, eps), x, weight)
+    return vjp(g)
+
+
+_rmsnorm.defvjp(_rmsnorm_fwd, _rmsnorm_bwd)

@@ -1,0 +1,41 @@
+"""Rotary position embeddings (RoPE), Llama-3 style.
+
+Pure jnp: RoPE is elementwise and fuses into the surrounding matmuls under
+XLA; a Pallas kernel would add nothing (HBM-bound either way).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rope_frequencies(head_dim: int, max_len: int, theta: float = 500000.0,
+                     dtype=jnp.float32) -> tuple[jax.Array, jax.Array]:
+    """Precompute cos/sin tables: [max_len, head_dim//2]."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)
+    return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array,
+               positions: jax.Array | None = None) -> jax.Array:
+    """Apply RoPE.  x: [..., seq, heads, head_dim]; cos/sin: [max_len, hd//2].
+
+    ``positions``: optional [..., seq] absolute positions (for decode-time
+    KV-cache stepping); defaults to arange(seq).
+    """
+    seq = x.shape[-3]
+    if positions is None:
+        c = cos[:seq]                      # [seq, hd//2]
+        s = sin[:seq]
+        # broadcast over heads: [seq, 1, hd//2]
+        c = c[:, None, :]
+        s = s[:, None, :]
+    else:
+        c = jnp.take(cos, positions, axis=0)[..., :, None, :]
+        s = jnp.take(sin, positions, axis=0)[..., :, None, :]
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.astype(x.dtype)

@@ -1,0 +1,105 @@
+"""Device mesh construction and named-axis conventions.
+
+The scaling-book recipe: pick a mesh, annotate shardings, let XLA insert
+collectives.  Axis names used across the framework:
+
+- ``dp``:   pure data parallel (gradient all-reduce over DCN between slices)
+- ``fsdp``: data parallel with sharded params/optimizer (ZeRO-3 style;
+            all-gather params, reduce-scatter grads — rides ICI)
+- ``tp``:   tensor parallel (activation collectives every layer — innermost,
+            fastest ICI axis)
+- ``sp``:   sequence/context parallel for ring attention (ICI neighbors)
+- ``ep``:   expert parallel for MoE (all-to-all)
+
+A TpuCluster worker group maps to this as: slices = dp axis, hosts within a
+slice = fsdp/sp, chips within a host = tp (SURVEY.md §2.3 table).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Logical mesh shape.  Axis size -1 means 'absorb remaining devices'."""
+
+    dp: int = 1
+    fsdp: int = -1
+    tp: int = 1
+    sp: int = 1
+    ep: int = 1
+
+    AXES = ("dp", "fsdp", "tp", "sp", "ep")
+
+    def resolve(self, n_devices: int) -> Dict[str, int]:
+        sizes = {a: getattr(self, a) for a in self.AXES}
+        wildcard = [a for a, s in sizes.items() if s == -1]
+        if len(wildcard) > 1:
+            raise ValueError(f"at most one -1 axis, got {wildcard}")
+        fixed = math.prod(s for s in sizes.values() if s != -1)
+        if wildcard:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes {fixed}")
+            sizes[wildcard[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(
+                f"mesh {sizes} needs {fixed} devices, have {n_devices}")
+        return sizes
+
+    def build(self, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+        devices = list(devices if devices is not None else jax.devices())
+        sizes = self.resolve(len(devices))
+        shape = tuple(sizes[a] for a in self.AXES)
+        arr = np.array(devices).reshape(shape)
+        return Mesh(arr, self.AXES)
+
+
+def make_mesh(n_devices: Optional[int] = None, **axes) -> Mesh:
+    """Convenience: ``make_mesh(tp=4)`` uses all devices, fsdp absorbing."""
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return MeshSpec(**axes).build(devices)
+
+
+def shard(mesh: Mesh, *axes) -> NamedSharding:
+    """NamedSharding helper: ``shard(mesh, 'fsdp', None, 'tp')``."""
+    return NamedSharding(mesh, P(*axes))
+
+
+def logical_to_sharding(rules: Dict[str, Tuple], mesh: Mesh,
+                        logical_axes) -> NamedSharding:
+    """Map a tuple of logical axis names to a NamedSharding via rules.
+
+    ``rules`` maps logical axis name -> mesh axis (or None / tuple of mesh
+    axes).  Unknown logical names shard as None (replicated).
+    """
+    spec = tuple(rules.get(a) for a in logical_axes)
+    return NamedSharding(mesh, P(*spec))
+
+
+# Default logical->mesh rules for transformer params/activations.
+# Conventions: "embed" = d_model, "heads" = attention heads, "mlp" = d_ff,
+# "vocab" = vocabulary, "layers" = stacked layer dim, "batch" = batch,
+# "seq" = sequence.
+DEFAULT_RULES: Dict[str, object] = {
+    "batch": ("dp", "fsdp"),
+    "seq": "sp",
+    "embed": "fsdp",      # ZeRO-3: shard params along d_model over fsdp
+    "heads": "tp",
+    "kv_heads": "tp",
+    "mlp": "tp",
+    "vocab": "tp",
+    "layers": None,
+    "expert": "ep",
+    "head_dim": None,
+    "norm": None,
+}

@@ -1,0 +1,3 @@
+"""Runtime-side components: what runs inside the pods the operator
+launches (coordinator client/server, submitter, bootstrap) — the analogue
+of the Ray runtime surface KubeRay talks to."""

@@ -1,0 +1,168 @@
+"""Coordinator client: the operator's window into a running cluster.
+
+Plays the role of the reference's Ray-dashboard HTTP client
+(utils/dashboardclient/dashboard_httpclient.go:29 interface — SubmitJob
+:218, GetJobInfo :154, UpdateDeployments :62): job submission/status and
+serve-app deployment against the head's HTTP endpoint.
+
+The controllers depend only on this interface; tests inject
+``FakeCoordinatorClient`` (the reference's client-provider seam,
+suite_test.go:57-70).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+
+class CoordinatorError(Exception):
+    pass
+
+
+class JobInfo:
+    def __init__(self, job_id: str, status: str, message: str = "",
+                 start_time: float = 0.0, end_time: float = 0.0):
+        self.job_id = job_id
+        self.status = status          # PENDING|RUNNING|SUCCEEDED|FAILED|STOPPED
+        self.message = message
+        self.start_time = start_time
+        self.end_time = end_time
+
+
+class CoordinatorClient:
+    """HTTP client for the in-cluster coordinator API (dashboard port)."""
+
+    def __init__(self, base_url: str, timeout: float = 5.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+
+    def _req(self, method: str, path: str, body: Optional[dict] = None):
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            f"{self.base_url}{path}", data=data, method=method,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                payload = resp.read()
+                return json.loads(payload) if payload else {}
+        except urllib.error.HTTPError as e:
+            raise CoordinatorError(f"{method} {path}: HTTP {e.code}") from e
+        except Exception as e:
+            raise CoordinatorError(f"{method} {path}: {e}") from e
+
+    # job API (ref dashboard_httpclient.go SubmitJob/GetJobInfo/StopJob)
+    def submit_job(self, job_id: str, entrypoint: str,
+                   runtime_env: Optional[dict] = None,
+                   metadata: Optional[dict] = None) -> str:
+        out = self._req("POST", "/api/jobs/", {
+            "submission_id": job_id, "entrypoint": entrypoint,
+            "runtime_env": runtime_env or {}, "metadata": metadata or {}})
+        return out.get("submission_id", job_id)
+
+    def get_job_info(self, job_id: str) -> JobInfo:
+        out = self._req("GET", f"/api/jobs/{job_id}")
+        return JobInfo(job_id, out.get("status", "PENDING"),
+                       out.get("message", ""),
+                       out.get("start_time", 0.0), out.get("end_time", 0.0))
+
+    def stop_job(self, job_id: str) -> None:
+        self._req("POST", f"/api/jobs/{job_id}/stop")
+
+    def delete_job(self, job_id: str) -> None:
+        self._req("DELETE", f"/api/jobs/{job_id}")
+
+    def list_jobs(self) -> List[JobInfo]:
+        out = self._req("GET", "/api/jobs/")
+        return [JobInfo(j.get("submission_id", ""), j.get("status", "PENDING"),
+                        j.get("message", "")) for j in out.get("jobs", [])]
+
+    # serve API (ref UpdateDeployments / multi-app status)
+    def update_serve_apps(self, config: Dict[str, Any]) -> None:
+        self._req("PUT", "/api/serve/applications/", config)
+
+    def get_serve_apps(self) -> Dict[str, Any]:
+        return self._req("GET", "/api/serve/applications/")
+
+    def healthz(self) -> bool:
+        try:
+            self._req("GET", "/api/healthz")
+            return True
+        except CoordinatorError:
+            return False
+
+
+class FakeCoordinatorClient:
+    """In-memory fake (ref fake_serve_httpclient.go).
+
+    Tests drive job/app state transitions explicitly:
+    ``fake.set_job_status(jid, "SUCCEEDED")``.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.jobs: Dict[str, JobInfo] = {}
+        self.serve_config: Optional[Dict[str, Any]] = None
+        self.serve_apps: Dict[str, Any] = {}
+        self.healthy = True
+        self.submit_count = 0
+
+    def submit_job(self, job_id, entrypoint, runtime_env=None, metadata=None):
+        with self._lock:
+            self.submit_count += 1
+            if job_id not in self.jobs:
+                self.jobs[job_id] = JobInfo(job_id, "PENDING")
+            return job_id
+
+    def get_job_info(self, job_id):
+        with self._lock:
+            info = self.jobs.get(job_id)
+            if info is None:
+                raise CoordinatorError(f"job {job_id} not found")
+            return info
+
+    def stop_job(self, job_id):
+        with self._lock:
+            if job_id in self.jobs:
+                self.jobs[job_id].status = "STOPPED"
+
+    def delete_job(self, job_id):
+        with self._lock:
+            self.jobs.pop(job_id, None)
+
+    def list_jobs(self):
+        with self._lock:
+            return list(self.jobs.values())
+
+    def update_serve_apps(self, config):
+        with self._lock:
+            self.serve_config = config
+
+    def get_serve_apps(self):
+        with self._lock:
+            return dict(self.serve_apps)
+
+    def healthz(self):
+        return self.healthy
+
+    # test helpers
+    def set_job_status(self, job_id, status, message=""):
+        with self._lock:
+            self.jobs.setdefault(job_id, JobInfo(job_id, status)).status = status
+            self.jobs[job_id].message = message
+
+    def set_serve_app(self, name, status, message=""):
+        with self._lock:
+            self.serve_apps[name] = {"status": status, "message": message}
+
+
+def default_client_provider(cluster_status_dict: Dict[str, Any]):
+    """Maps a TpuCluster status -> live HTTP client (ref FetchHeadServiceURL
+    rayjob_controller.go:218)."""
+    addr = cluster_status_dict.get("coordinatorAddress", "")
+    host = addr.split(":")[0] if addr else "localhost"
+    from kuberay_tpu.utils import constants as C
+    return CoordinatorClient(f"http://{host}:{C.PORT_DASHBOARD}")

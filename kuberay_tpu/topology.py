@@ -190,17 +190,33 @@ class SliceTopology:
         Multi-host attachments are physically square-ish boards: ct5lp/ct6e
         4-chip VMs own a 2x2 block of the 2D torus; v4/v5p boards are
         2x2x1 of the 3D torus.  Derived from
-        ``generation.multihost_chips_per_host`` so the host-count math and
-        the block geometry cannot drift apart.
+        ``generation.multihost_chips_per_host`` (so host-count math and
+        block geometry cannot drift apart) by greedily doubling the block
+        along the axes with the most room — this also places the block
+        correctly on degenerate topologies with size-1 axes (e.g. v5p
+        1x4x8 -> block 1x2x2, host grid 1x2x4).
         """
         if not self.is_multi_host:
             return self.dims
         cph = self.generation.multihost_chips_per_host
-        if self.generation.ici_dims == 2:
-            return (2, cph // 2) if cph % 2 == 0 else (1, cph)
-        if cph % 4 == 0:
-            return (2, 2, cph // 4)
-        return (1, 1, cph)
+        if cph & (cph - 1):  # non-power-of-two board: pack innermost axis
+            block = [1] * (len(self.dims) - 1) + [cph]
+            return tuple(block)
+        block = [1] * len(self.dims)
+        rem = cph
+        while rem > 1:
+            # Axis with the largest remaining even ratio wins (lowest index
+            # breaks ties) — spreads the block square-ish like real boards.
+            best, best_ratio = -1, 1
+            for i, d in enumerate(self.dims):
+                ratio = d // block[i]
+                if ratio % 2 == 0 and ratio > best_ratio:
+                    best, best_ratio = i, ratio
+            if best < 0:
+                return tuple(block)  # irregular; caller falls back
+            block[best] *= 2
+            rem //= 2
+        return tuple(block)
 
     def host_grid_dims(self) -> Tuple[int, ...]:
         """Host-grid shape: topology dims divided by the per-host chip

@@ -1,0 +1,175 @@
+"""pjit train step: sharded init, AdamW, bf16 compute, donated state.
+
+The multi-chip path BASELINE config #3 exercises: params/optimizer sharded
+by the logical rules (parallel/mesh.py), batch split over (dp, fsdp), XLA
+inserts the all-gathers/reduce-scatters over ICI.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kuberay_tpu.models import llama
+from kuberay_tpu.parallel.mesh import DEFAULT_RULES, logical_to_sharding
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    learning_rate: float = 3e-4
+    warmup_steps: int = 100
+    decay_steps: int = 10000
+    weight_decay: float = 0.1
+    beta1: float = 0.9
+    beta2: float = 0.95
+    grad_clip: float = 1.0
+    z_loss: float = 1e-4
+
+
+def make_optimizer(tc: TrainConfig) -> optax.GradientTransformation:
+    schedule = optax.warmup_cosine_decay_schedule(
+        init_value=0.0, peak_value=tc.learning_rate,
+        warmup_steps=tc.warmup_steps, decay_steps=tc.decay_steps,
+        end_value=tc.learning_rate * 0.1)
+    return optax.chain(
+        optax.clip_by_global_norm(tc.grad_clip),
+        optax.adamw(schedule, b1=tc.beta1, b2=tc.beta2,
+                    weight_decay=tc.weight_decay),
+    )
+
+
+def init_train_state(cfg: llama.LlamaConfig, optimizer, key) -> Dict[str, Any]:
+    params = llama.init_params(cfg, key)
+    return {
+        "step": jnp.zeros((), jnp.int32),
+        "params": params,
+        "opt_state": optimizer.init(params),
+    }
+
+
+# --------------------------------------------------------------------------
+# Sharding of the train state
+# --------------------------------------------------------------------------
+
+def param_shardings(cfg: llama.LlamaConfig, mesh: Mesh,
+                    rules: Optional[Dict[str, Any]] = None):
+    rules = rules or DEFAULT_RULES
+    axes = llama.param_axes(cfg)
+    return jax.tree.map(
+        lambda a: logical_to_sharding(rules, mesh, a), axes,
+        is_leaf=lambda x: isinstance(x, tuple))
+
+
+def _shard_opt_like_params(opt_state, param_sh, mesh: Mesh):
+    """Optimizer-state shardings: components tree-isomorphic to params
+    (adam mu/nu) inherit param shardings; everything else replicates."""
+    pdef = jax.tree.structure(param_sh)
+    p_leaves = jax.tree.leaves(param_sh)
+    repl = NamedSharding(mesh, P())
+
+    def map_component(comp):
+        cdef = jax.tree.structure(comp)
+        if cdef == pdef:
+            return jax.tree.unflatten(cdef, p_leaves)
+        return jax.tree.map(lambda _: repl, comp)
+
+    def walk(node):
+        if isinstance(node, tuple) and hasattr(node, "_fields"):  # NamedTuple
+            return type(node)(*(map_component(f) for f in node))
+        if isinstance(node, tuple):
+            return type(node)(walk(c) for c in node)
+        return map_component(node)
+
+    return walk(opt_state)
+
+
+def state_shardings(cfg: llama.LlamaConfig, optimizer, mesh: Mesh,
+                    rules: Optional[Dict[str, Any]] = None):
+    p_sh = param_shardings(cfg, mesh, rules)
+    abstract = jax.eval_shape(
+        lambda: optax.GradientTransformation(optimizer.init, optimizer.update
+                                             ).init(
+            jax.eval_shape(functools.partial(llama.init_params, cfg),
+                           jax.random.PRNGKey(0))))
+    return {
+        "step": NamedSharding(mesh, P()),
+        "params": p_sh,
+        "opt_state": _shard_opt_like_params(abstract, p_sh, mesh),
+    }
+
+
+# --------------------------------------------------------------------------
+# Steps
+# --------------------------------------------------------------------------
+
+def make_train_step(cfg: llama.LlamaConfig, tc: TrainConfig,
+                    optimizer) -> Callable:
+    """Unsharded (single-device / auto-sharded) jitted train step."""
+
+    def step(state, batch):
+        def loss(params):
+            return llama.loss_fn(cfg, params, batch["tokens"],
+                                 batch["targets"], batch.get("mask"),
+                                 tc.z_loss)
+        (l, metrics), grads = jax.value_and_grad(loss, has_aux=True)(
+            state["params"])
+        updates, new_opt = optimizer.update(grads, state["opt_state"],
+                                            state["params"])
+        new_params = optax.apply_updates(state["params"], updates)
+        metrics["grad_norm"] = optax.global_norm(grads)
+        metrics["total_loss"] = l
+        return {
+            "step": state["step"] + 1,
+            "params": new_params,
+            "opt_state": new_opt,
+        }, metrics
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
+def make_sharded_train_fns(cfg: llama.LlamaConfig, tc: TrainConfig,
+                           mesh: Mesh,
+                           rules: Optional[Dict[str, Any]] = None):
+    """Returns (sharded_init, sharded_step, state_shardings).
+
+    ``sharded_init(key)`` materializes the state already laid out on the
+    mesh (no host-memory spike); ``sharded_step(state, batch)`` is the
+    donated pjit train step.  Batch arrays shard over (dp, fsdp).
+    """
+    optimizer = make_optimizer(tc)
+    sh = state_shardings(cfg, optimizer, mesh, rules)
+    batch_sh = NamedSharding(mesh, P(("dp", "fsdp"), None))
+
+    init = jax.jit(
+        functools.partial(init_train_state, cfg, optimizer),
+        out_shardings=sh)
+
+    def step(state, batch):
+        def loss(params):
+            return llama.loss_fn(cfg, params, batch["tokens"],
+                                 batch["targets"], None, tc.z_loss)
+        (l, metrics), grads = jax.value_and_grad(loss, has_aux=True)(
+            state["params"])
+        updates, new_opt = optimizer.update(grads, state["opt_state"],
+                                            state["params"])
+        new_params = optax.apply_updates(state["params"], updates)
+        metrics["grad_norm"] = optax.global_norm(grads)
+        metrics["total_loss"] = l
+        return {
+            "step": state["step"] + 1,
+            "params": new_params,
+            "opt_state": new_opt,
+        }, metrics
+
+    step_jit = jax.jit(
+        step,
+        in_shardings=(sh, {"tokens": batch_sh, "targets": batch_sh}),
+        out_shardings=(sh, None),
+        donate_argnums=(0,))
+    return init, step_jit, sh

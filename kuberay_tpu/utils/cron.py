@@ -37,7 +37,10 @@ class CronSchedule:
     weekday_restricted: bool  # weekday field was not "*"
 
 
-def _parse_field(expr: str, name: str, lo: int, hi: int) -> FrozenSet[int]:
+def _parse_field(expr: str, name: str, lo: int, hi: int,
+                 step_hi: Optional[int] = None) -> FrozenSet[int]:
+    """``step_hi``: implicit upper bound for 'N/step' expansion (robfig uses
+    6 for day-of-week even though literal 7 is accepted as Sunday)."""
     vals = set()
     for part in expr.split(","):
         if part == "":
@@ -52,7 +55,7 @@ def _parse_field(expr: str, name: str, lo: int, hi: int) -> FrozenSet[int]:
             if step < 1:
                 raise CronError(f"{name}: step must be >= 1")
         if part == "*":
-            start, end = lo, hi
+            start, end = lo, hi if step == 1 else (step_hi or hi)
         elif "-" in part:
             a, b = part.split("-", 1)
             try:
@@ -66,7 +69,7 @@ def _parse_field(expr: str, name: str, lo: int, hi: int) -> FrozenSet[int]:
                 raise CronError(f"{name}: bad value {part!r}") from None
             if step > 1:
                 # Vixie/robfig: 'N/step' means the range N..max stepped.
-                end = hi
+                end = step_hi if step_hi is not None else hi
         if not (lo <= start <= hi and lo <= end <= hi and start <= end):
             raise CronError(f"{name}: {part!r} out of range [{lo},{hi}]")
         vals.update(range(start, end + 1, step))
@@ -80,16 +83,18 @@ def parse_cron(schedule: str) -> CronSchedule:
     if len(parts) != 5:
         raise CronError(f"schedule must have 5 fields, got {len(parts)}: {schedule!r}")
     sets = [
-        _parse_field(p, name, lo, hi)
+        _parse_field(p, name, lo, hi, step_hi=6 if name == "weekday" else None)
         for p, (name, lo, hi) in zip(parts, _FIELDS)
     ]
     # Normalize weekday 7 -> 0 (both mean Sunday).
     weekday = frozenset(v % 7 for v in sets[4])
+    # Vixie star-bit: a field beginning with '*' (incl. '*/N') keeps the
+    # star bit, so the DOM/DOW OR rule does NOT apply to it (robfig compat).
     return CronSchedule(
         minute=sets[0], hour=sets[1], day=sets[2], month=sets[3],
         weekday=weekday,
-        day_restricted=parts[2] != "*",
-        weekday_restricted=parts[4] != "*",
+        day_restricted=not parts[2].startswith("*"),
+        weekday_restricted=not parts[4].startswith("*"),
     )
 
 

@@ -15,3 +15,9 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+# The hosting site may force jax_platforms to include a hardware plugin
+# whose init dials a tunnel; pin to cpu in-process so tests are hermetic.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,235 @@
+"""TpuCluster controller integration tests (envtest-style: real store +
+manager + fake kubelet; modeled on raycluster_controller_test.go incl.
+"multi-host worker group" :928 and suspend :736 specs)."""
+
+import pytest
+
+from kuberay_tpu.api.common import ObjectMeta
+from kuberay_tpu.api.tpucluster import TpuCluster, ClusterState
+from kuberay_tpu.controlplane.cluster_controller import TpuClusterController
+from kuberay_tpu.controlplane.fake_kubelet import FakeKubelet
+from kuberay_tpu.controlplane.manager import Manager, owned_pod_mapper
+from kuberay_tpu.controlplane.store import ObjectStore
+from kuberay_tpu.utils import constants as C
+from tests.test_api_types import make_cluster
+
+
+class Harness:
+    def __init__(self):
+        self.store = ObjectStore()
+        self.manager = Manager(self.store)
+        self.controller = TpuClusterController(
+            self.store, expectations=self.manager.expectations)
+        self.manager.register(C.KIND_CLUSTER, self.controller.reconcile)
+        self.manager.map_owned(owned_pod_mapper)
+        self.kubelet = FakeKubelet(self.store)
+
+    def settle(self, rounds: int = 6):
+        """Alternate reconcile-drain and kubelet steps until stable."""
+        for _ in range(rounds):
+            self.manager.run_until_idle()
+            self.kubelet.step()
+        self.manager.run_until_idle()
+
+    def pods(self, **labels):
+        return self.store.list("Pod", labels=labels or None)
+
+    def cluster(self, name="demo"):
+        return TpuCluster.from_dict(self.store.get(C.KIND_CLUSTER, name))
+
+
+@pytest.fixture
+def h():
+    return Harness()
+
+
+def test_single_host_cluster_provisions(h):
+    c = make_cluster(accelerator="v5e", topology="2x2", replicas=2)
+    h.store.create(c.to_dict())
+    h.settle()
+    # 1 head + 2 single-host slices.
+    assert len(h.pods()) == 3
+    got = h.cluster()
+    assert got.status.state == ClusterState.READY
+    assert got.status.readySlices == 2
+    assert got.status.desiredTpuChips == 8
+    # Head service exists.
+    assert h.store.try_get("Service", "demo-head-svc") is not None
+
+
+def test_multi_host_slice_atomic_create(h):
+    c = make_cluster(accelerator="v5p", topology="2x2x2", replicas=2)
+    h.store.create(c.to_dict())
+    h.settle()
+    workers = h.pods(**{C.LABEL_NODE_TYPE: C.NODE_TYPE_WORKER})
+    assert len(workers) == 4  # 2 slices x 2 hosts
+    # Host/slice identity labels + env:
+    by_slice = {}
+    for p in workers:
+        lab = p["metadata"]["labels"]
+        by_slice.setdefault(lab[C.LABEL_SLICE_INDEX], []).append(p)
+        env = {e["name"]: e["value"] for e in p["spec"]["containers"][0]["env"]}
+        assert env[C.ENV_TPU_WORKER_ID] == lab[C.LABEL_HOST_INDEX]
+        assert env[C.ENV_TPU_TOPOLOGY] == "2x2x2"
+        assert len(env[C.ENV_TPU_WORKER_HOSTNAMES].split(",")) == 2
+        assert env[C.ENV_NUM_PROCESSES] == "2"
+    assert sorted(by_slice) == ["0", "1"]
+    # Headless service created for multi-host.
+    assert h.store.try_get("Service", "demo-headless") is not None
+    # TPU resources requested per host.
+    res = workers[0]["spec"]["containers"][0]["resources"]["requests"]
+    assert res[C.RESOURCE_TPU] == "4"
+    # Node selectors stamp generation + topology.
+    sel = workers[0]["spec"]["nodeSelector"]
+    assert sel[C.NODE_SELECTOR_GKE_ACCELERATOR] == "tpu-v5p-slice"
+    assert sel[C.NODE_SELECTOR_GKE_TOPOLOGY] == "2x2x2"
+
+
+def test_unhealthy_slice_repaired_whole(h):
+    c = make_cluster(accelerator="v5p", topology="2x2x2", replicas=1)
+    h.store.create(c.to_dict())
+    h.settle()
+    workers = h.pods(**{C.LABEL_NODE_TYPE: C.NODE_TYPE_WORKER})
+    assert len(workers) == 2
+    # Kill ONE host of the slice -> the WHOLE slice is replaced.
+    victim = workers[0]["metadata"]["name"]
+    h.kubelet.fail_pod(victim)
+    h.settle()
+    new_workers = h.pods(**{C.LABEL_NODE_TYPE: C.NODE_TYPE_WORKER})
+    assert len(new_workers) == 2
+    assert all(p["status"]["phase"] == "Running" for p in new_workers)
+    # Replacement pods are new objects (uids differ from the killed set).
+    assert {p["metadata"]["name"] for p in new_workers} == \
+        {p["metadata"]["name"] for p in workers}  # same stable names
+    got = h.cluster()
+    assert got.status.readySlices == 1
+
+
+def test_incomplete_slice_cleaned(h):
+    c = make_cluster(accelerator="v5p", topology="2x2x2", replicas=1)
+    h.store.create(c.to_dict())
+    h.settle()
+    # Delete one host pod directly (simulating eviction mid-creation).
+    workers = h.pods(**{C.LABEL_NODE_TYPE: C.NODE_TYPE_WORKER})
+    h.store.delete("Pod", workers[0]["metadata"]["name"])
+    h.settle()
+    # Slice was rebuilt complete.
+    new_workers = h.pods(**{C.LABEL_NODE_TYPE: C.NODE_TYPE_WORKER})
+    assert len(new_workers) == 2
+    assert h.cluster().status.readySlices == 1
+
+
+def test_scale_down_whole_slices(h):
+    c = make_cluster(accelerator="v5p", topology="2x2x2", replicas=3)
+    c.spec.workerGroupSpecs[0].maxReplicas = 3
+    h.store.create(c.to_dict())
+    h.settle()
+    assert len(h.pods(**{C.LABEL_NODE_TYPE: C.NODE_TYPE_WORKER})) == 6
+    # Scale to 1 slice.
+    obj = h.store.get(C.KIND_CLUSTER, "demo")
+    obj["spec"]["workerGroupSpecs"][0]["replicas"] = 1
+    h.store.update(obj)
+    h.settle()
+    workers = h.pods(**{C.LABEL_NODE_TYPE: C.NODE_TYPE_WORKER})
+    assert len(workers) == 2
+    # Remaining pods form one complete slice (lowest index kept).
+    assert {p["metadata"]["labels"][C.LABEL_SLICE_INDEX] for p in workers} == {"0"}
+
+
+def test_autoscaler_slices_to_delete(h):
+    c = make_cluster(accelerator="v5p", topology="2x2x2", replicas=2)
+    c.spec.enableInTreeAutoscaling = True
+    c.spec.workerGroupSpecs[0].maxReplicas = 4
+    h.store.create(c.to_dict())
+    h.settle()
+    assert len(h.pods(**{C.LABEL_NODE_TYPE: C.NODE_TYPE_WORKER})) == 4
+    # Autoscaler decides: drop slice demo-workers-1, replicas -> 1.
+    obj = h.store.get(C.KIND_CLUSTER, "demo")
+    obj["spec"]["workerGroupSpecs"][0]["replicas"] = 1
+    obj["spec"]["workerGroupSpecs"][0]["scaleStrategy"] = {
+        "slicesToDelete": ["demo-workers-1"]}
+    h.store.update(obj)
+    h.settle()
+    workers = h.pods(**{C.LABEL_NODE_TYPE: C.NODE_TYPE_WORKER})
+    assert {p["metadata"]["labels"][C.LABEL_SLICE_NAME] for p in workers} == \
+        {"demo-workers-0"}
+
+
+def test_suspend_resume(h):
+    c = make_cluster(accelerator="v5e", topology="2x2", replicas=1)
+    h.store.create(c.to_dict())
+    h.settle()
+    assert len(h.pods()) == 2
+    obj = h.store.get(C.KIND_CLUSTER, "demo")
+    obj["spec"]["suspend"] = True
+    h.store.update(obj)
+    h.settle()
+    assert len(h.pods()) == 0
+    assert h.cluster().status.state == ClusterState.SUSPENDED
+    obj = h.store.get(C.KIND_CLUSTER, "demo")
+    obj["spec"]["suspend"] = False
+    h.store.update(obj)
+    h.settle()
+    assert len(h.pods()) == 2
+    assert h.cluster().status.state == ClusterState.READY
+
+
+def test_head_pod_restart_on_failure(h):
+    c = make_cluster(accelerator="v5e", topology="2x2", replicas=0)
+    h.store.create(c.to_dict())
+    h.settle()
+    head = h.pods(**{C.LABEL_NODE_TYPE: C.NODE_TYPE_HEAD})[0]
+    h.kubelet.fail_pod(head["metadata"]["name"])
+    h.settle()
+    new_head = h.pods(**{C.LABEL_NODE_TYPE: C.NODE_TYPE_HEAD})[0]
+    assert new_head["status"]["phase"] == "Running"
+
+
+def test_invalid_spec_sets_failed_state(h):
+    c = make_cluster(accelerator="v5e", topology="3x9", replicas=1)
+    h.store.create(c.to_dict())
+    h.manager.run_until_idle()
+    got = h.cluster()
+    assert got.status.state == ClusterState.FAILED
+    assert "not divisible" in got.status.reason or "node pool" in got.status.reason
+    assert len(h.pods()) == 0
+
+
+def test_recreate_upgrade_on_template_change(h):
+    c = make_cluster(accelerator="v5e", topology="2x2", replicas=1)
+    c.spec.upgradeStrategy = "Recreate"
+    h.store.create(c.to_dict())
+    h.settle()
+    old_pods = {p["metadata"]["name"]: p["metadata"]["uid"] for p in h.pods()}
+    obj = h.store.get(C.KIND_CLUSTER, "demo")
+    obj["spec"]["workerGroupSpecs"][0]["template"]["spec"]["containers"][0][
+        "image"] = "new-image:v2"
+    h.store.update(obj)
+    h.settle(rounds=10)
+    new_pods = {p["metadata"]["name"]: p["metadata"]["uid"] for p in h.pods()}
+    assert len(new_pods) == 2
+    # All pods were recreated (fresh uids).
+    assert all(old_pods.get(n) != u for n, u in new_pods.items())
+
+
+def test_deletion_cascades_to_pods(h):
+    c = make_cluster(accelerator="v5p", topology="2x2x2", replicas=1)
+    h.store.create(c.to_dict())
+    h.settle()
+    assert len(h.pods()) == 3
+    h.store.delete(C.KIND_CLUSTER, "demo")
+    h.manager.run_until_idle()
+    assert h.store.try_get(C.KIND_CLUSTER, "demo") is None
+    assert len(h.pods()) == 0  # ownerReference GC
+
+
+def test_per_group_suspend(h):
+    c = make_cluster(accelerator="v5e", topology="2x2", replicas=2)
+    h.store.create(c.to_dict())
+    h.settle()
+    obj = h.store.get(C.KIND_CLUSTER, "demo")
+    obj["spec"]["workerGroupSpecs"][0]["suspend"] = True
+    h.store.update(obj)
+    h.settle()
+    assert len(h.pods(**{C.LABEL_NODE_TYPE: C.NODE_TYPE_WORKER})) == 0
+    assert len(h.pods(**{C.LABEL_NODE_TYPE: C.NODE_TYPE_HEAD})) == 1

@@ -29,6 +29,22 @@ def test_sunday_as_7():
     assert parse_cron("0 0 * * 5-7").weekday == {0, 5, 6}
 
 
+def test_star_step_keeps_star_bit():
+    from kuberay_tpu.utils.cron import matches
+    # '*/2' in DOM keeps the star bit: AND semantics with the DOW field
+    # (robfig compat) -> Thu Jan 1 2026 (odd day, not Monday) must NOT match.
+    s = parse_cron("0 0 */2 * 1")
+    thu = time.mktime((2026, 1, 1, 0, 0, 0, 0, 0, -1))
+    assert not matches(s, thu)
+    mon5 = time.mktime((2026, 1, 5, 0, 0, 0, 0, 0, -1))   # Monday, odd day
+    assert matches(s, mon5)
+
+
+def test_weekday_step_caps_at_six():
+    # '1/2' in DOW: robfig expands to {1,3,5} (max 6), not through 7.
+    assert parse_cron("0 0 * * 1/2").weekday == {1, 3, 5}
+
+
 def test_dom_dow_or_rule():
     from kuberay_tpu.utils.cron import matches
     # '0 0 13 * 5': both restricted -> fires on the 13th OR any Friday.

@@ -1,0 +1,213 @@
+"""TpuJob state-machine tests (modeled on rayjob_controller_test.go +
+rayjob_controller_suspended_test.go specs)."""
+
+import time
+
+import pytest
+
+from kuberay_tpu.api.common import ObjectMeta
+from kuberay_tpu.api.tpujob import (
+    DeletionRule,
+    DeletionStrategy,
+    JobDeploymentStatus,
+    JobStatus,
+    JobSubmissionMode,
+    TpuJob,
+    TpuJobSpec,
+)
+from kuberay_tpu.controlplane.cluster_controller import TpuClusterController
+from kuberay_tpu.controlplane.fake_kubelet import FakeKubelet
+from kuberay_tpu.controlplane.job_controller import TpuJobController
+from kuberay_tpu.controlplane.manager import (
+    Manager,
+    originated_from_mapper,
+    owned_pod_mapper,
+)
+from kuberay_tpu.controlplane.store import ObjectStore
+from kuberay_tpu.runtime.coordinator_client import FakeCoordinatorClient
+from kuberay_tpu.utils import constants as C
+from tests.test_api_types import make_cluster
+
+
+class JobHarness:
+    def __init__(self):
+        self.store = ObjectStore()
+        self.manager = Manager(self.store)
+        self.coordinator = FakeCoordinatorClient()
+        self.cluster_ctrl = TpuClusterController(
+            self.store, expectations=self.manager.expectations)
+        self.job_ctrl = TpuJobController(
+            self.store, client_provider=lambda _status: self.coordinator)
+        self.manager.register(C.KIND_CLUSTER, self.cluster_ctrl.reconcile)
+        self.manager.register(C.KIND_JOB, self.job_ctrl.reconcile)
+        self.manager.map_owned(owned_pod_mapper)
+        self.manager.map_owned(originated_from_mapper(C.KIND_JOB))
+        self.kubelet = FakeKubelet(self.store)
+
+    def settle(self, rounds=8):
+        for _ in range(rounds):
+            self.manager.flush_delayed()
+            self.manager.run_until_idle()
+            self.kubelet.step()
+        self.manager.flush_delayed()
+        self.manager.run_until_idle()
+
+    def job(self, name="train"):
+        return TpuJob.from_dict(self.store.get(C.KIND_JOB, name))
+
+
+def make_job(name="train", **kw):
+    spec = TpuJobSpec(
+        entrypoint="python -m kuberay_tpu.train.launcher --model llama3_8b",
+        clusterSpec=make_cluster(accelerator="v5p", topology="2x2x2",
+                                 replicas=1).spec,
+        submissionMode=JobSubmissionMode.HTTP,
+        shutdownAfterJobFinishes=True,
+    )
+    for k, v in kw.items():
+        setattr(spec, k, v)
+    return TpuJob(metadata=ObjectMeta(name=name), spec=spec)
+
+
+@pytest.fixture
+def h():
+    return JobHarness()
+
+
+def drive_job(h, name="train"):
+    """Settle until the job reaches Running (cluster comes up on the way)."""
+    for _ in range(10):
+        h.settle()
+        j = h.job(name)
+        if j.status.jobDeploymentStatus == JobDeploymentStatus.RUNNING:
+            return j
+    return h.job(name)
+
+
+def test_job_happy_path(h):
+    h.store.create(make_job().to_dict())
+    j = drive_job(h)
+    assert j.status.jobDeploymentStatus == JobDeploymentStatus.RUNNING
+    assert j.status.clusterName
+    # Cluster was created and became ready.
+    cluster = h.store.get(C.KIND_CLUSTER, j.status.clusterName)
+    assert cluster["status"]["state"] == "ready"
+    assert h.coordinator.submit_count == 1
+    # App finishes -> Complete; cluster torn down (shutdownAfterJobFinishes).
+    h.coordinator.set_job_status(j.status.jobId, "SUCCEEDED")
+    h.settle()
+    j = h.job()
+    assert j.status.jobDeploymentStatus == JobDeploymentStatus.COMPLETE
+    assert j.status.jobStatus == JobStatus.SUCCEEDED
+    h.settle()
+    assert h.store.try_get(C.KIND_CLUSTER, j.status.clusterName) is None
+
+
+def test_job_retry_with_fresh_cluster(h):
+    h.store.create(make_job(backoffLimit=1).to_dict())
+    j = drive_job(h)
+    first_cluster = j.status.clusterName
+    h.coordinator.set_job_status(j.status.jobId, "FAILED", "oom")
+    h.settle()
+    j = drive_job(h)
+    assert int(j.status.failed) == 1
+    assert j.status.clusterName != first_cluster  # fresh cluster per attempt
+    assert j.status.jobDeploymentStatus == JobDeploymentStatus.RUNNING
+    # Second failure exhausts the budget.
+    h.coordinator.set_job_status(j.status.jobId, "FAILED", "oom again")
+    h.settle()
+    j = h.job()
+    assert j.status.jobDeploymentStatus == JobDeploymentStatus.FAILED
+    assert j.status.reason == "AppFailed"
+
+
+def test_job_suspend_resume(h):
+    h.store.create(make_job().to_dict())
+    j = drive_job(h)
+    cluster_name = j.status.clusterName
+    obj = h.store.get(C.KIND_JOB, "train")
+    obj["spec"]["suspend"] = True
+    h.store.update(obj)
+    h.settle()
+    j = h.job()
+    assert j.status.jobDeploymentStatus == JobDeploymentStatus.SUSPENDED
+    assert h.store.try_get(C.KIND_CLUSTER, cluster_name) is None
+    # Resume.
+    obj = h.store.get(C.KIND_JOB, "train")
+    obj["spec"]["suspend"] = False
+    h.store.update(obj)
+    j = drive_job(h)
+    assert j.status.jobDeploymentStatus == JobDeploymentStatus.RUNNING
+
+
+def test_job_active_deadline(h):
+    h.store.create(make_job(activeDeadlineSeconds=1).to_dict())
+    j = drive_job(h)
+    time.sleep(1.1)
+    h.settle()
+    j = h.job()
+    assert j.status.jobDeploymentStatus == JobDeploymentStatus.FAILED
+    assert j.status.reason == "DeadlineExceeded"
+
+
+def test_job_deletion_rules(h):
+    job = make_job(
+        shutdownAfterJobFinishes=False,
+        deletionStrategy=DeletionStrategy(rules=[
+            DeletionRule(policy="DeleteWorkers", condition="Succeeded",
+                         ttlSeconds=0),
+        ]))
+    h.store.create(job.to_dict())
+    j = drive_job(h)
+    h.coordinator.set_job_status(j.status.jobId, "SUCCEEDED")
+    h.settle()
+    j = h.job()
+    assert j.status.jobDeploymentStatus == JobDeploymentStatus.COMPLETE
+    h.settle()
+    # Cluster survives but workers scaled to zero; head remains.
+    cluster = h.store.get(C.KIND_CLUSTER, j.status.clusterName)
+    assert cluster["spec"]["workerGroupSpecs"][0]["replicas"] == 0
+    pods = h.store.list("Pod", labels={C.LABEL_NODE_TYPE: "worker"})
+    assert pods == [] or all(p["metadata"].get("deletionTimestamp") for p in pods)
+
+
+def test_job_k8s_submitter_mode(h):
+    h.store.create(make_job(submissionMode=JobSubmissionMode.K8S_JOB).to_dict())
+    j = drive_job(h)
+    sub = h.store.get("Job", "train-submitter")
+    cmd = sub["spec"]["template"]["spec"]["containers"][0]["command"]
+    assert cmd[0] == "/bin/sh" and "--job-id" in cmd[2]
+    # Submitter completion marks the job complete.
+    sub["status"] = {"succeeded": 1}
+    h.store.update_status(sub)
+    h.coordinator.set_job_status(j.status.jobId, "SUCCEEDED")
+    h.settle()
+    assert h.job().status.jobDeploymentStatus == JobDeploymentStatus.COMPLETE
+
+
+def test_job_cluster_selector_mode(h):
+    # Pre-existing shared cluster; the job must not delete it on finish.
+    shared = make_cluster(name="shared", accelerator="v5e", topology="2x2",
+                          replicas=1)
+    shared.metadata.labels = {"team": "ml"}
+    h.store.create(shared.to_dict())
+    h.settle()
+    job = make_job(clusterSelector={"team": "ml"})
+    job.spec.clusterSpec = None
+    h.store.create(job.to_dict())
+    j = drive_job(h)
+    assert j.status.clusterName == "shared"
+    h.coordinator.set_job_status(j.status.jobId, "SUCCEEDED")
+    h.settle()
+    assert h.job().status.jobDeploymentStatus == JobDeploymentStatus.COMPLETE
+    h.settle()
+    assert h.store.try_get(C.KIND_CLUSTER, "shared") is not None
+
+
+def test_job_invalid_spec_fails(h):
+    job = make_job(entrypoint="")
+    h.store.create(job.to_dict())
+    h.settle()
+    j = h.job()
+    assert j.status.jobDeploymentStatus == JobDeploymentStatus.FAILED
+    assert j.status.reason == "ValidationFailed"

@@ -113,6 +113,21 @@ def test_host_grid_single_host():
     assert SliceTopology.create("v5e", "2x2").host_grid_dims() == (1,)
 
 
+def test_host_grid_degenerate_axis():
+    # v5p 1x4x8: the 2x2 board can't straddle the size-1 axis; blocks land
+    # on the remaining axes -> grid (1, 2, 4), ring still neighbor-wise.
+    s = SliceTopology.create("v5p", "1x4x8")
+    assert s.num_hosts == 8
+    assert s.host_block_dims() == (1, 2, 2)
+    assert s.host_grid_dims() == (1, 2, 4)
+    order = list(s.host_ring_order())
+    assert sorted(order) == list(range(8))
+    for a, b in zip(order, order[1:]):
+        ra, ca = divmod(a, 4)
+        rb, cb = divmod(b, 4)
+        assert abs(ra - rb) + abs(ca - cb) == 1
+
+
 def test_transposed_2d_topology_rejected():
     with pytest.raises(TopologyError):
         SliceTopology.create("v5e", "8x4")   # only canonical '4x8' exists
