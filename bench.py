@@ -1,0 +1,93 @@
+"""Benchmark: training throughput of the flagship stack on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+
+Metric: tokens/sec/chip for a full (fwd+bwd+optimizer) train step on the
+~1.2B-parameter Llama config (the largest of the flagship family that fits
+a single 16 GiB chip with AdamW state), bf16, Pallas flash attention,
+remat, donated buffers.
+
+vs_baseline: the reference (ray-project/kuberay) publishes NO model-level
+throughput numbers (BASELINE.md — it ships no compute), so there is no
+reference value to divide by.  We report model FLOPs utilization (MFU)
+against the chip's peak bf16 TFLOPs as the baseline-relative figure: 1.0
+would be the hardware roofline.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def main():
+    import jax
+    if "--cpu" in sys.argv:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    from kuberay_tpu.models import llama
+    from kuberay_tpu.train.train_step import (
+        TrainConfig, init_train_state, make_optimizer, make_train_step)
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+
+    if on_tpu:
+        cfg = llama.CONFIGS["llama_1b"]
+        batch, seq, steps = 4, 2048, 10
+    else:  # smoke mode
+        cfg = llama.CONFIGS["llama_tiny"]
+        batch, seq, steps = 2, 128, 3
+
+    tc = TrainConfig(warmup_steps=2, decay_steps=1000)
+    optimizer = make_optimizer(tc)
+    state = init_train_state(cfg, optimizer, jax.random.PRNGKey(0))
+    step = make_train_step(cfg, tc, optimizer)
+
+    key = jax.random.PRNGKey(1)
+    tokens = jax.random.randint(key, (batch, seq), 0, cfg.vocab_size)
+    batch_data = {"tokens": tokens, "targets": jnp.roll(tokens, -1, axis=1)}
+
+    # Warmup / compile.
+    state, m = step(state, batch_data)
+    jax.block_until_ready(m["total_loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, m = step(state, batch_data)
+    jax.block_until_ready(m["total_loss"])
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = batch * seq
+    tok_s = tokens_per_step * steps / dt
+
+    # MFU: standard 6*N FLOPs/token (fwd+bwd) + attention term.
+    n_params = cfg.num_params()
+    attn_flops_per_tok = 12 * cfg.n_layers * cfg.d_model * seq  # causal ~ /2*2
+    flops_per_tok = 6 * n_params + attn_flops_per_tok
+    achieved_tflops = tok_s * flops_per_tok / 1e12
+    peak = 197.0 if on_tpu else 1.0   # v5e bf16 peak; CPU smoke has no peak
+    mfu = achieved_tflops / peak if on_tpu else 0.0
+
+    print(json.dumps({
+        "metric": "llama1b_train_tokens_per_sec_per_chip",
+        "value": round(tok_s, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu, 4),
+        "detail": {
+            "model": "llama_1b" if on_tpu else "llama_tiny(smoke)",
+            "params": n_params,
+            "batch": batch, "seq": seq, "steps": steps,
+            "achieved_tflops": round(achieved_tflops, 2),
+            "mfu_note": "vs_baseline is MFU vs chip peak; reference "
+                        "publishes no model-throughput baseline "
+                        "(BASELINE.md)",
+            "loss": float(m["total_loss"]),
+            "device": str(dev),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

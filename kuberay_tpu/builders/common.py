@@ -1,0 +1,21 @@
+"""Shared builder helpers."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from kuberay_tpu.api.tpucluster import TpuCluster
+from kuberay_tpu.utils import constants as C
+
+
+def cluster_owner_reference(cluster: TpuCluster) -> Dict[str, Any]:
+    """Controller ownerReference pointing at the TpuCluster (drives
+    cascading GC of pods/services on cluster deletion)."""
+    return {
+        "apiVersion": C.API_VERSION,
+        "kind": C.KIND_CLUSTER,
+        "name": cluster.metadata.name,
+        "uid": cluster.metadata.uid,
+        "controller": True,
+        "blockOwnerDeletion": True,
+    }

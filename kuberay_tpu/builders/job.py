@@ -20,7 +20,10 @@ from kuberay_tpu.utils.names import submitter_job_name
 
 
 def build_submit_command(job: TpuJob, cluster: TpuCluster) -> str:
-    """Idempotent submit wrapper (ref BuildJobSubmitCommand job.go:90)."""
+    """Idempotent submit wrapper (ref BuildJobSubmitCommand job.go:120-125):
+    a duplicate-submission error (job id already registered after a
+    submitter retry) is tolerated, then the attach/tail command's exit code
+    carries the job outcome either way."""
     addr = coordinator_address(cluster)
     jid = job.status.jobId or job.metadata.name
     submit = (f"python -m kuberay_tpu.runtime.submit --address {addr} "
@@ -28,7 +31,7 @@ def build_submit_command(job: TpuJob, cluster: TpuCluster) -> str:
               f"{job.spec.entrypoint}")
     attach = (f"python -m kuberay_tpu.runtime.submit --address {addr} "
               f"--job-id {shlex.quote(jid)} --tail-logs")
-    return f"if ! {submit} ; then {attach} ; else {attach} ; fi"
+    return f"({submit} || echo 'submit skipped: already submitted') && exec {attach}"
 
 
 def build_submitter_job(job: TpuJob, cluster: TpuCluster) -> Dict[str, Any]:

@@ -16,6 +16,7 @@ from __future__ import annotations
 import copy
 from typing import Any, Dict, List, Optional
 
+from kuberay_tpu.builders.common import cluster_owner_reference
 from kuberay_tpu.api.tpucluster import TpuCluster, WorkerGroupSpec
 from kuberay_tpu.topology import SliceTopology
 from kuberay_tpu.utils import constants as C
@@ -34,17 +35,6 @@ def _base_labels(cluster: TpuCluster, node_type: str) -> Dict[str, str]:
         C.LABEL_NODE_TYPE: node_type,
         C.LABEL_IDENTIFIER: f"{cluster.metadata.name}-{node_type}",
         C.LABEL_CREATED_BY: C.CREATED_BY_OPERATOR,
-    }
-
-
-def _owner_ref(cluster: TpuCluster) -> Dict[str, Any]:
-    return {
-        "apiVersion": C.API_VERSION,
-        "kind": C.KIND_CLUSTER,
-        "name": cluster.metadata.name,
-        "uid": cluster.metadata.uid,
-        "controller": True,
-        "blockOwnerDeletion": True,
     }
 
 
@@ -124,7 +114,7 @@ def build_head_pod(cluster: TpuCluster,
             "namespace": cluster.metadata.namespace,
             "labels": labels,
             "annotations": dict(tmpl.get("metadata", {}).get("annotations", {})),
-            "ownerReferences": [_owner_ref(cluster)],
+            "ownerReferences": [cluster_owner_reference(cluster)],
         },
         "spec": pod_spec,
     }
@@ -232,7 +222,7 @@ def build_worker_pod(cluster: TpuCluster, group: WorkerGroupSpec,
             "namespace": cluster.metadata.namespace,
             "labels": labels,
             "annotations": dict(tmpl.get("metadata", {}).get("annotations", {})),
-            "ownerReferences": [_owner_ref(cluster)],
+            "ownerReferences": [cluster_owner_reference(cluster)],
         },
         "spec": pod_spec,
     }

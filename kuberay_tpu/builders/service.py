@@ -12,6 +12,7 @@ from __future__ import annotations
 
 from typing import Any, Dict
 
+from kuberay_tpu.builders.common import cluster_owner_reference
 from kuberay_tpu.api.tpucluster import TpuCluster
 from kuberay_tpu.utils import constants as C
 from kuberay_tpu.utils.names import (
@@ -19,17 +20,6 @@ from kuberay_tpu.utils.names import (
     headless_service_name,
     serve_service_name,
 )
-
-
-def _owner_ref(cluster: TpuCluster) -> Dict[str, Any]:
-    return {
-        "apiVersion": C.API_VERSION,
-        "kind": C.KIND_CLUSTER,
-        "name": cluster.metadata.name,
-        "uid": cluster.metadata.uid,
-        "controller": True,
-        "blockOwnerDeletion": True,
-    }
 
 
 def build_head_service(cluster: TpuCluster) -> Dict[str, Any]:
@@ -42,7 +32,7 @@ def build_head_service(cluster: TpuCluster) -> Dict[str, Any]:
             "namespace": cluster.metadata.namespace,
             "labels": {C.LABEL_CLUSTER: name,
                        C.LABEL_NODE_TYPE: C.NODE_TYPE_HEAD},
-            "ownerReferences": [_owner_ref(cluster)],
+            "ownerReferences": [cluster_owner_reference(cluster)],
         },
         "spec": {
             "type": cluster.spec.headGroupSpec.serviceType,
@@ -73,7 +63,7 @@ def build_headless_service(cluster: TpuCluster) -> Dict[str, Any]:
             "name": headless_service_name(name),
             "namespace": cluster.metadata.namespace,
             "labels": {C.LABEL_CLUSTER: name},
-            "ownerReferences": [_owner_ref(cluster)],
+            "ownerReferences": [cluster_owner_reference(cluster)],
         },
         "spec": {
             "clusterIP": "None",
@@ -103,7 +93,7 @@ def build_serve_service(cluster: TpuCluster,
             "name": service_name or serve_service_name(name),
             "namespace": cluster.metadata.namespace,
             "labels": {C.LABEL_CLUSTER: name},
-            "ownerReferences": [_owner_ref(cluster)],
+            "ownerReferences": [cluster_owner_reference(cluster)],
         },
         "spec": {
             "type": "ClusterIP",

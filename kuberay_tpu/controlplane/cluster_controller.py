@@ -301,8 +301,10 @@ class TpuClusterController:
                                      f"created head pod {pod['metadata']['name']}")
 
         # --- worker groups, slice-atomic (ref :1034 + :1246-1410) ---
+        # One pod list serves every group (avoids O(groups x pods) store
+        # scans); per-group deletions only touch that group's own slices.
         for group in cluster.spec.workerGroupSpecs:
-            r = self._reconcile_worker_group(cluster, group, thash)
+            r = self._reconcile_worker_group(cluster, group, thash, live)
             requeue = min(r, requeue) if (r and requeue) else (r or requeue)
         return requeue
 
@@ -323,13 +325,14 @@ class TpuClusterController:
 
     def _reconcile_worker_group(self, cluster: TpuCluster,
                                 group: WorkerGroupSpec,
-                                thash: str) -> Optional[float]:
+                                thash: str,
+                                live_pods: List[Dict[str, Any]]
+                                ) -> Optional[float]:
         ns, name = cluster.metadata.namespace, cluster.metadata.name
         if not self.exp.satisfied(ns, name, group.groupName):
             return 1.0
 
-        pods = [p for p in self._cluster_pods(cluster) if not pod_deleting(p)]
-        slices = self._group_pods_by_slice(pods, group)
+        slices = self._group_pods_by_slice(live_pods, group)
         topo = group.slice_topology()
         hosts = topo.num_hosts
 

@@ -224,7 +224,14 @@ class TpuJobController:
             job.status.endTime = time.time()
             self._emit_duration(job)
             return self._to(job, JobDeploymentStatus.COMPLETE, requeue=0.1)
-        if app_status in (JobStatus.FAILED, JobStatus.STOPPED):
+        if app_status == JobStatus.STOPPED:
+            # Deliberately stopped by the user: terminal, never retried
+            # (the reference retries only on FAILED).
+            job.status.jobStatus = JobStatus.STOPPED
+            job.status.endTime = time.time()
+            self._emit_duration(job)
+            return self._fail(job, "AppStopped", "job was stopped")
+        if app_status == JobStatus.FAILED:
             job.status.jobStatus = app_status
             job.status.endTime = time.time()
             # backoffLimit retries with fresh clusters (ref :518).

@@ -130,13 +130,12 @@ class Manager:
                     self._queue.append(key)
             self._wake.notify_all()
 
-    def run_until_idle(self, max_iterations: int = 1000,
-                       include_delayed: bool = True) -> int:
+    def run_until_idle(self, max_iterations: int = 1000) -> int:
         """Drain the queue deterministically; returns iterations used.
 
-        ``include_delayed``: promote due delayed items while draining (items
-        scheduled in the future are NOT waited for — tests advance state and
-        call again, exactly like envtest's Eventually loops).
+        Due delayed items are promoted while draining; items scheduled in
+        the future are NOT waited for — tests advance state and call again
+        (or use ``flush_delayed``), like envtest's Eventually loops.
         """
         n = 0
         while n < max_iterations:

@@ -68,7 +68,7 @@ def attention_xla(q, k, v, causal: bool = True, scale: Optional[float] = None):
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                 m_scr, l_scr, acc_scr,
-                *, scale, causal, bq, bkv, num_kv):
+                *, scale, causal, bq, bkv, num_kv, offset):
     i = pl.program_id(2)          # q block index
     j = pl.program_id(3)          # kv block index (innermost, sequential)
 
@@ -78,8 +78,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    # Causal: skip kv blocks strictly above the diagonal band.
-    visible = (j * bkv <= i * bq + bq - 1) if causal else True
+    # Causal: skip kv blocks strictly above the diagonal band.  With
+    # Skv > Sq (KV-cache decode) queries sit at the END of the key axis:
+    # query row r attends keys <= r + offset, offset = Skv - Sq (matching
+    # attention_xla).
+    visible = (j * bkv <= i * bq + bq - 1 + offset) if causal else True
 
     @pl.when(visible)
     def _compute():
@@ -90,7 +93,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale   # [bq, bkv]
         if causal:
-            rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 0)
+            rows = i * bq + offset + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 0)
             cols = j * bkv + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 1)
             s = jnp.where(cols <= rows, s, _NEG_INF)
         m_prev = m_scr[:, :1]                     # [bq, 1]
@@ -122,7 +125,8 @@ def _flash_fwd(q, k, v, scale, causal, bq, bkv, interpret):
     nq, nkv = Sq // bq, Skv // bkv
     grid = (B, Hq, nq, nkv)
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal, bq=bq, bkv=bkv, num_kv=nkv)
+        _fwd_kernel, scale=scale, causal=causal, bq=bq, bkv=bkv, num_kv=nkv,
+        offset=Skv - Sq)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -160,7 +164,7 @@ def _flash_fwd(q, k, v, scale, causal, bq, bkv, interpret):
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_scr, dv_scr,
-                    *, scale, causal, bq, bkv, num_q):
+                    *, scale, causal, bq, bkv, num_q, offset):
     j = pl.program_id(2)          # kv block
     i = pl.program_id(3)          # q block (innermost)
 
@@ -169,7 +173,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    visible = (j * bkv <= i * bq + bq - 1) if causal else True
+    visible = (j * bkv <= i * bq + bq - 1 + offset) if causal else True
 
     @pl.when(visible)
     def _compute():
@@ -183,7 +187,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale     # [bq, bkv]
         if causal:
-            rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 0)
+            rows = i * bq + offset + \
+                jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 0)
             cols = j * bkv + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 1)
             s = jnp.where(cols <= rows, s, _NEG_INF)
         p = jnp.exp(s - lse)                     # [bq, bkv]
@@ -209,7 +214,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    dq_ref, dq_scr,
-                   *, scale, causal, bq, bkv, num_kv):
+                   *, scale, causal, bq, bkv, num_kv, offset):
     i = pl.program_id(2)          # q block
     j = pl.program_id(3)          # kv block (innermost)
 
@@ -217,7 +222,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    visible = (j * bkv <= i * bq + bq - 1) if causal else True
+    visible = (j * bkv <= i * bq + bq - 1 + offset) if causal else True
 
     @pl.when(visible)
     def _compute():
@@ -231,7 +236,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         if causal:
-            rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 0)
+            rows = i * bq + offset + \
+                jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 0)
             cols = j * bkv + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 1)
             s = jnp.where(cols <= rows, s, _NEG_INF)
         p = jnp.exp(s - lse)
@@ -273,7 +279,7 @@ def _flash_bwd(q, k, v, out, lse, do, scale, causal, bq, bkv, interpret):
     # dKV sweep: per-q-head gradients, summed over the GQA group afterwards.
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          bq=bq, bkv=bkv, num_q=nq),
+                          bq=bq, bkv=bkv, num_q=nq, offset=Skv - Sq),
         grid=(B, Hq, nkv, nq),
         in_specs=in_specs,
         out_specs=[
@@ -312,7 +318,7 @@ def _flash_bwd(q, k, v, out, lse, do, scale, causal, bq, bkv, interpret):
     ]
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          bq=bq, bkv=bkv, num_kv=nkv),
+                          bq=bq, bkv=bkv, num_kv=nkv, offset=Skv - Sq),
         grid=(B, Hq, nq, nkv),
         in_specs=dq_spec_q,
         out_specs=pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0),
@@ -376,6 +382,12 @@ def flash_attention(q, k, v, causal: bool = True,
     scale = scale if scale is not None else 1.0 / math.sqrt(D)
     if impl == "auto":
         impl = "pallas" if _on_tpu() else "xla"
+    if impl != "xla":
+        # Pallas grids require block sizes that tile the sequence exactly;
+        # ragged lengths fall back to the XLA path rather than silently
+        # leaving trailing rows unwritten.
+        if Sq % _pick_block(Sq) != 0 or Skv % _pick_block(Skv) != 0:
+            impl = "xla"
     if impl == "xla":
         return attention_xla(q, k, v, causal, scale)
     interpret = impl == "pallas_interpret"

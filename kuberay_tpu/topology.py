@@ -202,20 +202,27 @@ class SliceTopology:
         if cph & (cph - 1):  # non-power-of-two board: pack innermost axis
             block = [1] * (len(self.dims) - 1) + [cph]
             return tuple(block)
+        # Real boards are 2x2(x1): place factor-2s on DISTINCT even axes
+        # first (ascending index — v5p 4x4x8 -> 2x2x1, matching hardware),
+        # then double existing block axes only for degenerate shapes where
+        # fewer than log2(cph) axes are even (e.g. 1x1x8 -> 1x1x4).
         block = [1] * len(self.dims)
         rem = cph
+        for i, d in enumerate(self.dims):
+            if rem <= 1:
+                break
+            if block[i] == 1 and (d // block[i]) % 2 == 0:
+                block[i] = 2
+                rem //= 2
         while rem > 1:
-            # Axis with the largest remaining even ratio wins (lowest index
-            # breaks ties) — spreads the block square-ish like real boards.
-            best, best_ratio = -1, 1
+            grew = False
             for i, d in enumerate(self.dims):
-                ratio = d // block[i]
-                if ratio % 2 == 0 and ratio > best_ratio:
-                    best, best_ratio = i, ratio
-            if best < 0:
+                if rem > 1 and (d // block[i]) % 2 == 0 and block[i] < d:
+                    block[i] *= 2
+                    rem //= 2
+                    grew = True
+            if not grew:
                 return tuple(block)  # irregular; caller falls back
-            block[best] *= 2
-            rem //= 2
         return tuple(block)
 
     def host_grid_dims(self) -> Tuple[int, ...]:

@@ -77,6 +77,29 @@ def test_flash_attention_backward(gqa):
         np.testing.assert_allclose(a, b, rtol=5e-3, atol=5e-3)
 
 
+def test_flash_attention_kv_cache_offset():
+    """Decode-style cross-length attention: Sq < Skv, causal offset."""
+    B, Sq, Skv, H, D = 1, 8, 32, 2, 16
+    q = jax.random.normal(jax.random.PRNGKey(0), (B, Sq, H, D))
+    k = jax.random.normal(jax.random.PRNGKey(1), (B, Skv, H, D))
+    v = jax.random.normal(jax.random.PRNGKey(2), (B, Skv, H, D))
+    ref = attention_xla(q, k, v, causal=True)
+    got = flash_attention(q, k, v, causal=True, impl="pallas_interpret")
+    np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-3)
+
+
+def test_flash_attention_ragged_seq_falls_back():
+    """Non-tiling lengths must produce correct output (XLA fallback), not
+    silently-unwritten rows."""
+    B, S, H, D = 1, 17, 2, 16
+    q = jax.random.normal(jax.random.PRNGKey(0), (B, S, H, D))
+    k = jax.random.normal(jax.random.PRNGKey(1), (B, S, H, D))
+    v = jax.random.normal(jax.random.PRNGKey(2), (B, S, H, D))
+    ref = attention_xla(q, k, v, causal=True)
+    got = flash_attention(q, k, v, causal=True, impl="pallas_interpret")
+    np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-3)
+
+
 def test_flash_attention_bad_gqa():
     q = jnp.zeros((1, 8, 3, 16))
     k = jnp.zeros((1, 8, 2, 16))
