@@ -213,6 +213,9 @@ def build_worker_pod(cluster: TpuCluster, group: WorkerGroupSpec,
         C.LABEL_SLICE_NAME: sname,
         C.LABEL_SLICE_INDEX: str(slice_idx),
         C.LABEL_HOST_INDEX: str(host_idx),
+        # Workers serve by default; the serve controller flips head pods
+        # only (serve Services select on this label).
+        C.LABEL_SERVE: "true",
     }
     return {
         "apiVersion": "v1",

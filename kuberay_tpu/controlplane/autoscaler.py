@@ -1,0 +1,200 @@
+"""Slice-unit autoscaler: the Replicas/slicesToDelete protocol.
+
+The reference's contract (raycluster_types.go:421-424): the autoscaler is
+the sole scale decision-maker when enabled — it patches
+``WorkerGroupSpec.Replicas`` and names victims in
+``ScaleStrategy.WorkersToDelete``; the operator only executes.  Here the
+contract is slice-granular from the start (victims are slice names), and
+the demand signal is job/queue state rather than Ray resource bookkeeping
+(SURVEY.md §7.6): idle-slice detection is driven by what the scheduler
+knows, not by scraping the runtime.
+
+Pure decision core (``decide``) + a loop (``SliceAutoscaler``) that reads
+demand from queued TpuJobs and slice idleness from a pluggable source —
+runs in-process with the operator or as the head-pod sidecar the builders
+inject (builders/pod.py BuildAutoscalerContainer analogue).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Optional
+
+from kuberay_tpu.api.tpucluster import TpuCluster
+from kuberay_tpu.controlplane.store import Conflict, NotFound, ObjectStore
+from kuberay_tpu.utils import constants as C
+
+
+@dataclasses.dataclass
+class GroupDecision:
+    group: str
+    replicas: int                      # desired slice count (clamped)
+    slices_to_delete: List[str]        # named victims (downscale only)
+    reason: str = ""
+
+
+@dataclasses.dataclass
+class SliceInfo:
+    name: str                          # tpu.dev/slice-name label value
+    group: str
+    ready: bool
+    idle_seconds: float = 0.0
+
+
+def decide(cluster: TpuCluster,
+           demand: Dict[str, int],
+           slices: List[SliceInfo],
+           idle_timeout: float = 60.0,
+           upscaling_mode: str = "Default") -> List[GroupDecision]:
+    """Pure scaling decision.
+
+    demand: group -> slices wanted by admitted/queued work.
+    slices: observed slices with idleness.
+    Upscaling modes (ref AutoscalerOptions): Default = one slice per pass,
+    Aggressive = jump straight to demand, Conservative = never upscale.
+    """
+    out: List[GroupDecision] = []
+    by_group: Dict[str, List[SliceInfo]] = {}
+    for s in slices:
+        by_group.setdefault(s.group, []).append(s)
+
+    for g in cluster.spec.workerGroupSpecs:
+        cur = g.replicas
+        want = demand.get(g.groupName, 0)
+        lo, hi = g.minReplicas, g.maxReplicas
+        target = cur
+        victims: List[str] = []
+        reason = ""
+
+        if want > cur and upscaling_mode != "Conservative":
+            step = (want - cur) if upscaling_mode == "Aggressive" else 1
+            target = min(hi, cur + step)
+            reason = f"demand {want} > {cur}"
+        else:
+            # Downscale: idle slices beyond demand, newest-idle last.
+            idle = sorted(
+                (s for s in by_group.get(g.groupName, [])
+                 if s.ready and s.idle_seconds >= idle_timeout),
+                key=lambda s: -s.idle_seconds)
+            removable = min(len(idle), cur - max(lo, want))
+            if removable > 0:
+                victims = [s.name for s in idle[:removable]]
+                target = cur - removable
+                reason = f"{removable} slices idle >= {idle_timeout}s"
+
+        target = max(lo, min(hi, target))
+        if target != cur or victims:
+            out.append(GroupDecision(g.groupName, target, victims, reason))
+    return out
+
+
+def apply_decisions(store: ObjectStore, cluster_name: str, namespace: str,
+                    decisions: List[GroupDecision]) -> bool:
+    """Patch the CR the way the reference's autoscaler does (Replicas +
+    ScaleStrategy), with optimistic-concurrency retry."""
+    if not decisions:
+        return False
+    for _ in range(3):
+        obj = store.try_get(C.KIND_CLUSTER, cluster_name, namespace)
+        if obj is None:
+            return False
+        by_group = {d.group: d for d in decisions}
+        changed = False
+        for g in obj["spec"].get("workerGroupSpecs", []):
+            d = by_group.get(g.get("groupName"))
+            if d is None:
+                continue
+            if g.get("replicas") != d.replicas:
+                g["replicas"] = d.replicas
+                changed = True
+            ss = g.setdefault("scaleStrategy", {})
+            if sorted(ss.get("slicesToDelete", [])) != sorted(d.slices_to_delete):
+                ss["slicesToDelete"] = list(d.slices_to_delete)
+                changed = True
+        if not changed:
+            return False
+        try:
+            store.update(obj)
+            return True
+        except Conflict:
+            continue
+    return False
+
+
+class SliceAutoscaler:
+    """Demand from queued TpuJobs + idleness from a pluggable tracker.
+
+    A slice is "idle" when no running TpuJob claims its group.  The
+    idleness clock starts when the claim disappears.
+    """
+
+    def __init__(self, store: ObjectStore, idle_timeout: float = 60.0):
+        self.store = store
+        self.idle_timeout = idle_timeout
+        self._idle_since: Dict[str, float] = {}
+
+    def _demand_for(self, cluster_obj: dict) -> Dict[str, int]:
+        """Slices wanted per group = max over jobs bound to this cluster of
+        the group's spec replicas (jobs carry the desired scale in their
+        clusterSpec) — queued-work-driven, not utilization-driven."""
+        name = cluster_obj["metadata"]["name"]
+        ns = cluster_obj["metadata"]["namespace"]
+        demand: Dict[str, int] = {}
+        for job in self.store.list(C.KIND_JOB, ns):
+            st = job.get("status", {})
+            if st.get("clusterName") != name:
+                continue
+            if st.get("jobDeploymentStatus") not in (
+                    "Initializing", "Waiting", "Running"):
+                continue
+            spec_groups = (job.get("spec", {}).get("clusterSpec") or {}
+                           ).get("workerGroupSpecs", [])
+            for g in spec_groups:
+                gname = g.get("groupName", "")
+                demand[gname] = max(demand.get(gname, 0), g.get("replicas", 0))
+        return demand
+
+    def observe_slices(self, cluster_obj: dict,
+                       demand: Dict[str, int]) -> List[SliceInfo]:
+        name = cluster_obj["metadata"]["name"]
+        ns = cluster_obj["metadata"]["namespace"]
+        pods = self.store.list("Pod", ns, labels={C.LABEL_CLUSTER: name})
+        by_slice: Dict[str, List[dict]] = {}
+        for p in pods:
+            sname = p["metadata"]["labels"].get(C.LABEL_SLICE_NAME)
+            if sname:
+                by_slice.setdefault(sname, []).append(p)
+        now = time.time()
+        # Prune idle bookkeeping for slices that no longer exist — a stale
+        # entry would both leak and make a recreated same-name slice appear
+        # instantly idle.
+        for gone in set(self._idle_since) - set(by_slice):
+            del self._idle_since[gone]
+        out = []
+        for sname, plist in by_slice.items():
+            group = plist[0]["metadata"]["labels"].get(C.LABEL_GROUP, "")
+            ready = all(p.get("status", {}).get("phase") == "Running"
+                        for p in plist)
+            claimed = demand.get(group, 0) > 0
+            if claimed:
+                self._idle_since.pop(sname, None)
+                idle = 0.0
+            else:
+                self._idle_since.setdefault(sname, now)
+                idle = now - self._idle_since[sname]
+            out.append(SliceInfo(sname, group, ready, idle))
+        return out
+
+    def reconcile(self, cluster_name: str, namespace: str = "default") -> bool:
+        obj = self.store.try_get(C.KIND_CLUSTER, cluster_name, namespace)
+        if obj is None or not obj.get("spec", {}).get("enableInTreeAutoscaling"):
+            return False
+        cluster = TpuCluster.from_dict(obj)
+        opts = cluster.spec.autoscalerOptions
+        idle_timeout = opts.idleTimeoutSeconds if opts else self.idle_timeout
+        mode = opts.upscalingMode if opts else "Default"
+        demand = self._demand_for(obj)
+        slices = self.observe_slices(obj, demand)
+        decisions = decide(cluster, demand, slices, idle_timeout, mode)
+        return apply_decisions(self.store, cluster_name, namespace, decisions)

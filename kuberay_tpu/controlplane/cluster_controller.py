@@ -367,15 +367,21 @@ class TpuClusterController:
                 del slices[idx]
 
         # 3. Autoscaler-named victims expand to whole slices (ref :1293-1322;
-        #    here the contract is already slice-granular).
+        #    here the contract is already slice-granular).  Executed victims
+        #    are CLEARED from the spec: slice names are deterministic, so a
+        #    stale entry would re-kill a later recreation of the same index.
         victims = set(group.scaleStrategy.slicesToDelete or [])
         if victims:
+            executed = set()
             for idx, plist in list(slices.items()):
                 sname = plist[0]["metadata"]["labels"].get(C.LABEL_SLICE_NAME)
                 if sname in victims:
                     for p in plist:
                         self._delete_pod(p, group.groupName)
                     del slices[idx]
+                    executed.add(sname)
+            if executed:
+                self._clear_executed_victims(cluster, group.groupName, executed)
 
         # 4. Diff in slice units (ref :1343-1378).
         desired = max(0, group.replicas)
@@ -419,6 +425,27 @@ class TpuClusterController:
                     cluster.to_dict(), C.EVENT_DELETED_SLICE,
                     f"scaled down slice {group.groupName}/{idx}")
         return None
+
+    def _clear_executed_victims(self, cluster: TpuCluster, group_name: str,
+                                executed: set):
+        obj = self.store.try_get(self.KIND, cluster.metadata.name,
+                                 cluster.metadata.namespace)
+        if obj is None:
+            return
+        changed = False
+        for g in obj["spec"].get("workerGroupSpecs", []):
+            if g.get("groupName") != group_name:
+                continue
+            ss = g.get("scaleStrategy") or {}
+            remaining = [s for s in ss.get("slicesToDelete", [])
+                         if s not in executed]
+            if remaining != ss.get("slicesToDelete", []):
+                ss["slicesToDelete"] = remaining
+                g["scaleStrategy"] = ss
+                changed = True
+        if changed:
+            obj["metadata"].pop("resourceVersion", None)
+            self.store.update(obj)
 
     # ------------------------------------------------------------------
     # status (ref calculateStatus :1874 + consistency.go throttling)
@@ -509,6 +536,7 @@ class TpuClusterController:
             return
         obj = cluster.to_dict()
         obj["status"] = new
+        obj["metadata"].pop("resourceVersion", None)
         self.store.update_status(obj)
 
     def _set_status(self, cluster: TpuCluster, state: str, reason: str = ""):
@@ -518,6 +546,7 @@ class TpuClusterController:
             return
         st["state"] = state
         st["reason"] = reason
+        obj["metadata"].pop("resourceVersion", None)
         self.store.update_status(obj)
 
     @staticmethod

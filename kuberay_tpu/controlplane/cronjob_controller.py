@@ -1,0 +1,167 @@
+"""TpuCronJob reconciler (ref raycronjob_controller.go:93-135).
+
+Cron schedule -> TpuJob creation with missed-run catch-up against
+``lastScheduleTime``, concurrency policies, and history-limit pruning.
+Feature-gated (``TpuCronJob``) like the reference.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+from kuberay_tpu.api.tpucronjob import ConcurrencyPolicy, TpuCronJob
+from kuberay_tpu.api.tpujob import JobDeploymentStatus
+from kuberay_tpu.controlplane.events import EventRecorder
+from kuberay_tpu.controlplane.store import AlreadyExists, NotFound, ObjectStore
+from kuberay_tpu.utils import constants as C
+from kuberay_tpu.utils import features
+from kuberay_tpu.utils.cron import missed_runs, next_run_after
+from kuberay_tpu.utils.names import truncate_name
+from kuberay_tpu.utils.validation import validate_cronjob
+
+_TERMINAL = (JobDeploymentStatus.COMPLETE, JobDeploymentStatus.FAILED)
+
+
+class TpuCronJobController:
+    KIND = C.KIND_CRONJOB
+
+    def __init__(self, store: ObjectStore,
+                 recorder: Optional[EventRecorder] = None):
+        self.store = store
+        self.recorder = recorder or EventRecorder(store)
+
+    def reconcile(self, name: str, namespace: str = "default") -> Optional[float]:
+        raw = self.store.try_get(self.KIND, name, namespace)
+        if raw is None:
+            return None
+        if not features.enabled("TpuCronJob"):
+            return None
+        cron = TpuCronJob.from_dict(raw)
+        if cron.metadata.deletionTimestamp:
+            return None   # child jobs are GC'd via ownerReferences
+
+        errs = validate_cronjob(cron)
+        if errs:
+            self.recorder.warning(raw, C.EVENT_INVALID_SPEC, "; ".join(errs))
+            return None
+
+        now = time.time()
+        self._refresh_active(cron)
+
+        if not cron.spec.suspend:
+            horizon = cron.spec.startingDeadlineSeconds or 86400
+            last = cron.status.lastScheduleTime or cron.metadata.creationTimestamp
+            due = missed_runs(cron.spec.schedule, last, now,
+                              horizon_seconds=horizon)
+            if due:
+                # Only the most recent missed run is executed (standard
+                # CronJob catch-up semantics; the rest are logged as missed).
+                if len(due) > 1:
+                    self.recorder.warning(
+                        cron.to_dict(), "MissedRuns",
+                        f"{len(due) - 1} scheduled runs were missed")
+                if self._launch(cron, due[-1]):
+                    cron.status.lastScheduleTime = due[-1]
+                # Forbid-skipped runs keep lastScheduleTime so the run still
+                # fires once the active job finishes (standard CronJob
+                # behavior), bounded by startingDeadlineSeconds.
+
+        self._prune_history(cron)
+        self._update_status(cron)
+        nxt = next_run_after(cron.spec.schedule, now)
+        return max(1.0, nxt - now) if nxt else None
+
+    # ------------------------------------------------------------------
+
+    def _job_name(self, cron: TpuCronJob, scheduled: float) -> str:
+        # Minute-resolution schedule time makes the name deterministic, so
+        # double-reconciles cannot double-launch (create is the idempotency
+        # barrier).
+        return truncate_name(f"{cron.metadata.name}-{int(scheduled) // 60}")
+
+    def _refresh_active(self, cron: TpuCronJob):
+        active = []
+        for jname in cron.status.activeJobNames:
+            job = self.store.try_get(C.KIND_JOB, jname, cron.metadata.namespace)
+            if job is None:
+                continue
+            if job.get("status", {}).get("jobDeploymentStatus") not in _TERMINAL:
+                active.append(jname)
+        cron.status.activeJobNames = active
+
+    def _launch(self, cron: TpuCronJob, scheduled: float) -> bool:
+        """Returns True when a job was launched (or already exists)."""
+        policy = cron.spec.concurrencyPolicy
+        if cron.status.activeJobNames:
+            if policy == ConcurrencyPolicy.FORBID:
+                self.recorder.normal(cron.to_dict(), "SkippedRun",
+                                     "previous run still active (Forbid)")
+                return False
+            if policy == ConcurrencyPolicy.REPLACE:
+                for jname in cron.status.activeJobNames:
+                    try:
+                        self.store.delete(C.KIND_JOB, jname,
+                                          cron.metadata.namespace)
+                    except NotFound:
+                        pass
+                cron.status.activeJobNames = []
+
+        jname = self._job_name(cron, scheduled)
+        job = {
+            "apiVersion": C.API_VERSION,
+            "kind": C.KIND_JOB,
+            "metadata": {
+                "name": jname,
+                "namespace": cron.metadata.namespace,
+                "labels": {
+                    C.LABEL_ORIGINATED_FROM_CR_NAME: cron.metadata.name,
+                    C.LABEL_ORIGINATED_FROM_CRD: C.KIND_CRONJOB,
+                },
+                "ownerReferences": [{
+                    "apiVersion": C.API_VERSION, "kind": C.KIND_CRONJOB,
+                    "name": cron.metadata.name, "uid": cron.metadata.uid,
+                    "controller": True, "blockOwnerDeletion": True,
+                }],
+            },
+            "spec": cron.spec.jobTemplate.to_dict(),
+            "status": {},
+        }
+        try:
+            self.store.create(job)
+            cron.status.activeJobNames.append(jname)
+            self.recorder.normal(cron.to_dict(), "LaunchedJob",
+                                 f"launched {jname}")
+        except AlreadyExists:
+            pass
+        return True
+
+    def _prune_history(self, cron: TpuCronJob):
+        ns = cron.metadata.namespace
+        children = self.store.list(
+            C.KIND_JOB, ns,
+            labels={C.LABEL_ORIGINATED_FROM_CR_NAME: cron.metadata.name,
+                    C.LABEL_ORIGINATED_FROM_CRD: C.KIND_CRONJOB})
+        finished: List[tuple] = []
+        for job in children:
+            st = job.get("status", {})
+            if st.get("jobDeploymentStatus") in _TERMINAL:
+                finished.append((
+                    st.get("jobDeploymentStatus") == JobDeploymentStatus.COMPLETE,
+                    st.get("endTime", 0.0), job["metadata"]["name"]))
+        for ok, limit in ((True, cron.spec.successfulJobsHistoryLimit),
+                          (False, cron.spec.failedJobsHistoryLimit)):
+            bucket = sorted([f for f in finished if f[0] == ok],
+                            key=lambda f: f[1], reverse=True)
+            for _, _, jname in bucket[limit:]:
+                try:
+                    self.store.delete(C.KIND_JOB, jname, ns)
+                except NotFound:
+                    pass
+
+    def _update_status(self, cron: TpuCronJob):
+        obj = cron.to_dict()
+        cur = self.store.try_get(self.KIND, cron.metadata.name,
+                                 cron.metadata.namespace)
+        if cur is not None and cur.get("status") != obj.get("status"):
+            self.store.update_status(obj)

@@ -451,6 +451,9 @@ class TpuJobController:
 
     def _update(self, job: TpuJob):
         obj = job.to_dict()
+        # Single-writer status: our own finalizer/metadata writes earlier in
+        # the same pass must not conflict with this status write.
+        obj["metadata"].pop("resourceVersion", None)
         cur = self.store.try_get(self.KIND, job.metadata.name,
                                  job.metadata.namespace)
         if cur is not None and cur.get("status") != obj.get("status"):

@@ -1,0 +1,113 @@
+"""NetworkPolicy controller (ref networkpolicy_controller.go:33, spec at
+raycluster_types.go:254-311).  Feature-gated ``TpuClusterNetworkPolicy``.
+
+Creates head + worker NetworkPolicies per TpuCluster: intra-cluster traffic
+(ICI bootstrap, coordinator, metrics) always allowed; external ingress
+limited to the head's dashboard/serve ports from allowed namespaces;
+``DenyAllEgress`` additionally locks egress to in-cluster peers.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from kuberay_tpu.api.tpucluster import TpuCluster
+from kuberay_tpu.controlplane.store import AlreadyExists, NotFound, ObjectStore
+from kuberay_tpu.utils import constants as C
+from kuberay_tpu.utils import features
+from kuberay_tpu.utils.names import truncate_name
+
+
+def build_network_policies(cluster: TpuCluster) -> List[Dict[str, Any]]:
+    spec = cluster.spec.networkPolicy
+    name = cluster.metadata.name
+    ns = cluster.metadata.namespace
+    if spec is None or not spec.enabled:
+        return []
+    same_cluster = {"podSelector": {"matchLabels": {C.LABEL_CLUSTER: name}}}
+    allowed_ns = [{"namespaceSelector": {"matchLabels": {
+        "kubernetes.io/metadata.name": n}}} for n in spec.allowNamespaces]
+
+    head = {
+        "apiVersion": "networking.k8s.io/v1",
+        "kind": "NetworkPolicy",
+        "metadata": {
+            "name": truncate_name(f"{name}-head"),
+            "namespace": ns,
+            "labels": {C.LABEL_CLUSTER: name},
+            "ownerReferences": [{
+                "apiVersion": C.API_VERSION, "kind": C.KIND_CLUSTER,
+                "name": name, "uid": cluster.metadata.uid,
+                "controller": True, "blockOwnerDeletion": True,
+            }],
+        },
+        "spec": {
+            "podSelector": {"matchLabels": {
+                C.LABEL_CLUSTER: name, C.LABEL_NODE_TYPE: C.NODE_TYPE_HEAD}},
+            "policyTypes": ["Ingress"] + (
+                ["Egress"] if spec.mode == "DenyAllEgress" else []),
+            "ingress": [
+                {"from": [same_cluster]},
+                {"from": allowed_ns or [{}],
+                 "ports": [{"port": C.PORT_DASHBOARD}, {"port": C.PORT_SERVE},
+                           {"port": C.PORT_METRICS}]},
+            ],
+        },
+    }
+    worker = {
+        "apiVersion": "networking.k8s.io/v1",
+        "kind": "NetworkPolicy",
+        "metadata": {
+            "name": truncate_name(f"{name}-workers"),
+            "namespace": ns,
+            "labels": {C.LABEL_CLUSTER: name},
+            "ownerReferences": head["metadata"]["ownerReferences"],
+        },
+        "spec": {
+            "podSelector": {"matchLabels": {
+                C.LABEL_CLUSTER: name, C.LABEL_NODE_TYPE: C.NODE_TYPE_WORKER}},
+            "policyTypes": ["Ingress"] + (
+                ["Egress"] if spec.mode == "DenyAllEgress" else []),
+            # Workers only talk to each other (ICI/MXLA bootstrap) and the
+            # head; serve/metrics ingress follows the same namespace
+            # restriction as the head (an unqualified ports-only rule would
+            # admit every peer in K8s NetworkPolicy semantics).
+            "ingress": [{"from": [same_cluster]},
+                        {"from": allowed_ns or [{}],
+                         "ports": [{"port": C.PORT_SERVE},
+                                   {"port": C.PORT_METRICS}]}],
+        },
+    }
+    if spec.mode == "DenyAllEgress":
+        for pol in (head, worker):
+            pol["spec"]["egress"] = [{"to": [same_cluster]}]
+    return [head, worker]
+
+
+class NetworkPolicyController:
+    """Standalone controller like the reference's (registered separately)."""
+
+    KIND = C.KIND_CLUSTER
+
+    def __init__(self, store: ObjectStore):
+        self.store = store
+
+    def reconcile(self, name: str, namespace: str = "default") -> Optional[float]:
+        if not features.enabled("TpuClusterNetworkPolicy"):
+            return None
+        raw = self.store.try_get(self.KIND, name, namespace)
+        if raw is None or raw["metadata"].get("deletionTimestamp"):
+            return None   # policies GC via ownerReferences
+        cluster = TpuCluster.from_dict(raw)
+        for pol in build_network_policies(cluster):
+            cur = self.store.try_get("NetworkPolicy",
+                                     pol["metadata"]["name"], namespace)
+            if cur is None:
+                try:
+                    self.store.create(pol)
+                except AlreadyExists:
+                    pass
+            elif cur["spec"] != pol["spec"]:
+                cur["spec"] = pol["spec"]
+                self.store.update(cur)
+        return None

@@ -1,0 +1,488 @@
+"""TpuService reconciler: zero-downtime serving on TPU slices.
+
+Mirrors the reference's RayService orchestration
+(rayservice_controller.go): an *active* cluster serves traffic while a
+*pending* cluster with the new spec warms up; promotion repoints the
+stable serve/head services only when the pending cluster's serve apps are
+healthy (reconcileServicesToReadyCluster :559).  Spec-hash comparison
+(:1370/:1400) decides in-place update vs new-cluster upgrade — scale-only
+changes (slice counts) never trigger a roll.
+
+TPU twist ("roll slices without breaking ICI rings", SURVEY.md §7.7): a
+serving slice is never partially replaced — upgrades only ever create
+whole new clusters/slices behind the traffic switch; the incremental mode
+(feature-gated) steps traffic weights while target capacity moves in
+whole-slice quanta.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Optional
+
+from kuberay_tpu.api.common import Condition, set_condition
+from kuberay_tpu.api.tpucluster import ClusterState, TpuCluster
+from kuberay_tpu.api.tpuservice import (
+    ServiceClusterStatus,
+    ServiceConditionType,
+    ServiceStatusName,
+    ServiceUpgradeType,
+    TpuService,
+)
+from kuberay_tpu.builders.service import build_serve_service
+from kuberay_tpu.controlplane.events import EventRecorder
+from kuberay_tpu.controlplane.store import AlreadyExists, NotFound, ObjectStore
+from kuberay_tpu.runtime.coordinator_client import CoordinatorError
+from kuberay_tpu.utils import constants as C
+from kuberay_tpu.utils import features
+from kuberay_tpu.utils.names import serve_service_name, spec_hash_without_scale, truncate_name
+from kuberay_tpu.utils.validation import validate_service
+
+
+class TpuServiceController:
+    KIND = C.KIND_SERVICE
+
+    def __init__(self, store: ObjectStore,
+                 recorder: Optional[EventRecorder] = None,
+                 client_provider: Optional[Callable] = None):
+        self.store = store
+        self.recorder = recorder or EventRecorder(store)
+        self.client_provider = client_provider
+        # serve config cache per cluster (ref cacheServeConfig): avoids
+        # re-PUTting an unchanged config every pass.
+        self._submitted: Dict[str, str] = {}
+
+    # ------------------------------------------------------------------
+
+    def reconcile(self, name: str, namespace: str = "default") -> Optional[float]:
+        raw = self.store.try_get(self.KIND, name, namespace)
+        if raw is None:
+            return None
+        svc = TpuService.from_dict(raw)
+
+        if svc.metadata.deletionTimestamp:
+            return self._reconcile_deletion(svc)
+
+        errs = validate_service(svc)
+        if errs:
+            self.recorder.warning(raw, C.EVENT_INVALID_SPEC, "; ".join(errs))
+            return None
+
+        if C.FINALIZER_SERVICE not in svc.metadata.finalizers:
+            self.store.add_finalizer(self.KIND, name, namespace,
+                                     C.FINALIZER_SERVICE)
+
+        if svc.spec.suspend:
+            return self._reconcile_suspend(svc)
+
+        requeue = self._reconcile_clusters(svc)
+        self._reconcile_serve_config(svc)
+        r2 = self._reconcile_promotion(svc)
+        self._reconcile_stable_services(svc)
+        self._update_status(svc)
+        candidates = [r for r in (requeue, r2) if r]
+        return min(candidates) if candidates else 2.0
+
+    # ------------------------------------------------------------------
+    # cluster pair management (ref reconcileRayCluster :1191)
+    # ------------------------------------------------------------------
+
+    def _cluster_name(self, svc: TpuService, generation: int) -> str:
+        return truncate_name(f"{svc.metadata.name}-cluster-{generation}")
+
+    def _get_cluster(self, svc: TpuService, cname: str) -> Optional[TpuCluster]:
+        raw = self.store.try_get(C.KIND_CLUSTER, cname, svc.metadata.namespace)
+        return TpuCluster.from_dict(raw) if raw else None
+
+    def _create_cluster(self, svc: TpuService, cname: str):
+        spec = svc.spec.clusterSpec.to_dict()
+        obj = {
+            "apiVersion": C.API_VERSION,
+            "kind": C.KIND_CLUSTER,
+            "metadata": {
+                "name": cname,
+                "namespace": svc.metadata.namespace,
+                "labels": {
+                    C.LABEL_ORIGINATED_FROM_CR_NAME: svc.metadata.name,
+                    C.LABEL_ORIGINATED_FROM_CRD: C.KIND_SERVICE,
+                },
+                "ownerReferences": [{
+                    "apiVersion": C.API_VERSION, "kind": C.KIND_SERVICE,
+                    "name": svc.metadata.name, "uid": svc.metadata.uid,
+                    "controller": True, "blockOwnerDeletion": True,
+                }],
+            },
+            "spec": spec,
+            "status": {},
+        }
+        try:
+            self.store.create(obj)
+            self.recorder.normal(svc.to_dict(), "CreatedCluster",
+                                 f"created cluster {cname}")
+        except AlreadyExists:
+            pass
+
+    def _reconcile_clusters(self, svc: TpuService) -> Optional[float]:
+        desired_hash = spec_hash_without_scale(svc.spec.clusterSpec.to_dict())
+        st = svc.status
+        active = (self._get_cluster(svc, st.activeServiceStatus.clusterName)
+                  if st.activeServiceStatus else None)
+        pending = (self._get_cluster(svc, st.pendingServiceStatus.clusterName)
+                   if st.pendingServiceStatus else None)
+
+        if active is None and pending is None:
+            # First rollout: everything starts as pending; promotion makes
+            # it active once serving.
+            cname = self._cluster_name(svc, svc.metadata.generation)
+            self._create_cluster(svc, cname)
+            st.pendingServiceStatus = ServiceClusterStatus(
+                clusterName=cname, specHash=desired_hash)
+            return 1.0
+
+        if active is not None and st.activeServiceStatus is not None:
+            if st.activeServiceStatus.specHash == desired_hash:
+                # In-place: scale-only changes flow through (ref
+                # isClusterSpecHashEqual -> update replicas).
+                self._sync_scale_fields(svc, active)
+                # A pending cluster from an abandoned upgrade is rolled
+                # back (ref reconcileRollbackState :2321).
+                if pending is not None:
+                    self._abandon_pending(svc)
+                return None
+            if svc.spec.upgradeStrategy == ServiceUpgradeType.NONE:
+                return None
+            # Spec changed: prepare a pending cluster with the new spec
+            # (ref shouldPrepareNewCluster :1400).
+            if pending is None or st.pendingServiceStatus.specHash != desired_hash:
+                if pending is not None:
+                    self._abandon_pending(svc)
+                cname = self._cluster_name(svc, svc.metadata.generation)
+                if cname == st.activeServiceStatus.clusterName:
+                    cname = truncate_name(
+                        f"{svc.metadata.name}-cluster-{svc.metadata.generation}-r")
+                self._create_cluster(svc, cname)
+                st.pendingServiceStatus = ServiceClusterStatus(
+                    clusterName=cname, specHash=desired_hash)
+                set_condition(svc.status.conditions, Condition(
+                    type=ServiceConditionType.UPGRADE_IN_PROGRESS,
+                    status="True", reason="SpecChanged"))
+                return 1.0
+        return None
+
+    def _sync_scale_fields(self, svc: TpuService, cluster: TpuCluster):
+        obj = self.store.try_get(C.KIND_CLUSTER, cluster.metadata.name,
+                                 svc.metadata.namespace)
+        if obj is None:
+            return
+        desired_groups = {g.groupName: g for g in svc.spec.clusterSpec.workerGroupSpecs}
+        changed = False
+        for g in obj["spec"].get("workerGroupSpecs", []):
+            want = desired_groups.get(g.get("groupName"))
+            if want is None:
+                continue
+            for field, val in (("replicas", want.replicas),
+                               ("minReplicas", want.minReplicas),
+                               ("maxReplicas", want.maxReplicas)):
+                if g.get(field) != val:
+                    g[field] = val
+                    changed = True
+        if changed:
+            self.store.update(obj)
+
+    def _abandon_pending(self, svc: TpuService):
+        st = svc.status
+        if st.pendingServiceStatus is None:
+            return
+        cname = st.pendingServiceStatus.clusterName
+        try:
+            self.store.delete(C.KIND_CLUSTER, cname, svc.metadata.namespace)
+        except NotFound:
+            pass
+        self._submitted.pop(cname, None)
+        st.pendingServiceStatus = None
+        set_condition(svc.status.conditions, Condition(
+            type=ServiceConditionType.ROLLING_BACK, status="True",
+            reason="PendingAbandoned"))
+
+    # ------------------------------------------------------------------
+    # serve config (ref updateServeDeployment :1563 + getAndCheckServeStatus)
+    # ------------------------------------------------------------------
+
+    def _client_for(self, svc: TpuService, cluster: TpuCluster):
+        if self.client_provider is None:
+            return None
+        return self.client_provider(cluster.metadata.name,
+                                    cluster.status.to_dict())
+
+    def _reconcile_serve_config(self, svc: TpuService):
+        st = svc.status
+        cfg_hash = spec_hash_without_scale({"serve": svc.spec.serveConfig})
+        for cs in (st.pendingServiceStatus, st.activeServiceStatus):
+            if cs is None:
+                continue
+            cluster = self._get_cluster(svc, cs.clusterName)
+            if cluster is None or cluster.status.state != ClusterState.READY:
+                continue
+            client = self._client_for(svc, cluster)
+            if client is None:
+                continue
+            if self._submitted.get(cs.clusterName) != cfg_hash:
+                try:
+                    client.update_serve_apps(svc.spec.serveConfig)
+                    self._submitted[cs.clusterName] = cfg_hash
+                except CoordinatorError as e:
+                    self.recorder.warning(svc.to_dict(), "ServeConfigFailed",
+                                          str(e))
+                    continue
+            # Poll app health.  A transient poll failure keeps the previous
+            # observation — one blip must not flip a healthy service to
+            # not-ready and churn conditions.
+            try:
+                apps = client.get_serve_apps()
+            except CoordinatorError:
+                continue
+            from kuberay_tpu.api.tpuservice import ServeApplicationStatus
+            prev = {a.name: a for a in cs.applications}
+            cs.applications = []
+            for app_name, info in sorted(apps.items()):
+                status = info.get("status", "NOT_STARTED")
+                message = info.get("message", "")
+                old = prev.get(app_name)
+                # Only move the timestamp on actual transitions — a fresh
+                # timestamp every poll would make status updates churn and
+                # re-trigger reconciles forever.
+                if old and old.status == status and old.message == message:
+                    ts = old.lastUpdateTime
+                else:
+                    ts = time.time()
+                cs.applications.append(ServeApplicationStatus(
+                    name=app_name, status=status, message=message,
+                    lastUpdateTime=ts))
+
+    def _serve_ready(self, cs: Optional[ServiceClusterStatus]) -> bool:
+        return bool(cs and cs.applications and
+                    all(a.status == ServiceStatusName.RUNNING
+                        for a in cs.applications))
+
+    # ------------------------------------------------------------------
+    # promotion + traffic (ref :286-301, :559; incremental :976-1190)
+    # ------------------------------------------------------------------
+
+    def _reconcile_promotion(self, svc: TpuService) -> Optional[float]:
+        st = svc.status
+        if st.pendingServiceStatus is None:
+            return None
+        if not self._serve_ready(st.pendingServiceStatus):
+            return 2.0
+
+        incremental = (
+            svc.spec.upgradeStrategy == ServiceUpgradeType.INCREMENTAL
+            and features.enabled("TpuServiceIncrementalUpgrade")
+            and st.activeServiceStatus is not None)
+        if incremental:
+            opts = svc.spec.upgradeOptions
+            step = opts.stepSizePercent if opts else 10
+            interval = opts.intervalSeconds if opts else 30
+            if time.time() - st.lastUpgradeStepTime < interval:
+                return max(0.5, interval - (time.time() - st.lastUpgradeStepTime))
+            cs = st.pendingServiceStatus
+            cs.trafficWeightPercent = min(100, cs.trafficWeightPercent + step)
+            if st.activeServiceStatus is not None:
+                st.activeServiceStatus.trafficWeightPercent = \
+                    100 - cs.trafficWeightPercent
+            st.lastUpgradeStepTime = time.time()
+            self._reconcile_weighted_services(svc)
+            if cs.trafficWeightPercent < 100:
+                return interval
+        # Full promotion.
+        self._promote(svc)
+        return None
+
+    def _promote(self, svc: TpuService):
+        st = svc.status
+        old = st.activeServiceStatus
+        st.activeServiceStatus = st.pendingServiceStatus
+        st.activeServiceStatus.trafficWeightPercent = 100
+        st.pendingServiceStatus = None
+        # Steady state needs no weighted route; per-cluster serve Services
+        # GC with their clusters, the route object is ours to clean up.
+        try:
+            self.store.delete("TrafficRoute",
+                              truncate_name(f"{svc.metadata.name}-route"),
+                              svc.metadata.namespace)
+        except NotFound:
+            pass
+        set_condition(st.conditions, Condition(
+            type=ServiceConditionType.UPGRADE_IN_PROGRESS, status="False",
+            reason="Promoted"))
+        self.recorder.normal(svc.to_dict(), "Promoted",
+                             f"cluster {st.activeServiceStatus.clusterName} "
+                             "now serving")
+        if old is not None and old.clusterName != st.activeServiceStatus.clusterName:
+            # Retire the old cluster after the grace delay (ref
+            # cleanUpRayClusterInstance :1247).
+            self._schedule_retirement(svc, old.clusterName)
+
+    def _schedule_retirement(self, svc: TpuService, cname: str):
+        obj = self.store.try_get(C.KIND_CLUSTER, cname, svc.metadata.namespace)
+        if obj is None:
+            return
+        retire_at = time.time() + svc.spec.clusterDeletionDelaySeconds
+        obj["metadata"].setdefault("annotations", {})[
+            "tpu.dev/retire-at"] = str(retire_at)
+        self.store.update(obj)
+
+    def reap_retired_clusters(self, namespace: Optional[str] = None) -> int:
+        """Delete clusters whose retire-at has passed; called on requeue."""
+        n = 0
+        for obj in self.store.list(C.KIND_CLUSTER, namespace):
+            at = obj["metadata"].get("annotations", {}).get("tpu.dev/retire-at")
+            if at and time.time() >= float(at):
+                try:
+                    self.store.delete(C.KIND_CLUSTER, obj["metadata"]["name"],
+                                      obj["metadata"]["namespace"])
+                    n += 1
+                except NotFound:
+                    pass
+        return n
+
+    # ------------------------------------------------------------------
+    # stable services (ref per-cluster serve services :2269 + selector flip)
+    # ------------------------------------------------------------------
+
+    def _reconcile_stable_services(self, svc: TpuService):
+        st = svc.status
+        if st.activeServiceStatus is None:
+            return
+        cluster = self._get_cluster(svc, st.activeServiceStatus.clusterName)
+        if cluster is None:
+            return
+        stable_name = serve_service_name(svc.metadata.name)
+        desired = build_serve_service(cluster, service_name=stable_name)
+        # The stable service is owned by the TpuService, not the cluster —
+        # it must outlive cluster replacement.
+        desired["metadata"]["ownerReferences"] = [{
+            "apiVersion": C.API_VERSION, "kind": C.KIND_SERVICE,
+            "name": svc.metadata.name, "uid": svc.metadata.uid,
+            "controller": True, "blockOwnerDeletion": True,
+        }]
+        cur = self.store.try_get("Service", stable_name, svc.metadata.namespace)
+        if cur is None:
+            try:
+                self.store.create(desired)
+            except AlreadyExists:
+                pass
+        elif cur["spec"].get("selector") != desired["spec"]["selector"]:
+            cur["spec"] = desired["spec"]
+            self.store.update(cur)
+        # Head serve-label: heads receive serve traffic unless excluded
+        # (ref updateHeadPodServeLabel :2065).
+        serve_val = "false" if svc.spec.excludeHeadPodFromServe else "true"
+        for pod in self.store.list("Pod", svc.metadata.namespace,
+                                   labels={C.LABEL_CLUSTER: cluster.metadata.name,
+                                           C.LABEL_NODE_TYPE: C.NODE_TYPE_HEAD}):
+            if pod["metadata"]["labels"].get(C.LABEL_SERVE) != serve_val:
+                self.store.patch_labels("Pod", pod["metadata"]["name"],
+                                        svc.metadata.namespace,
+                                        {C.LABEL_SERVE: serve_val})
+
+    def _reconcile_weighted_services(self, svc: TpuService):
+        """Incremental mode: per-cluster serve services exist for both
+        clusters; an HTTPRoute-equivalent object records the weights (the
+        Gateway-API analogue, ref reconcileGateway :920)."""
+        st = svc.status
+        route = {
+            "apiVersion": C.API_VERSION, "kind": "TrafficRoute",
+            "metadata": {"name": truncate_name(f"{svc.metadata.name}-route"),
+                         "namespace": svc.metadata.namespace,
+                         "labels": {C.LABEL_ORIGINATED_FROM_CR_NAME:
+                                    svc.metadata.name}},
+            "spec": {"backends": []},
+            "status": {},
+        }
+        for cs in (st.activeServiceStatus, st.pendingServiceStatus):
+            if cs is None:
+                continue
+            cluster = self._get_cluster(svc, cs.clusterName)
+            if cluster is None:
+                continue
+            per_cluster = build_serve_service(cluster)
+            try:
+                self.store.create(per_cluster)
+            except AlreadyExists:
+                pass
+            route["spec"]["backends"].append({
+                "service": per_cluster["metadata"]["name"],
+                "weight": cs.trafficWeightPercent,
+            })
+        cur = self.store.try_get("TrafficRoute", route["metadata"]["name"],
+                                 svc.metadata.namespace)
+        if cur is None:
+            self.store.create(route)
+        elif cur["spec"] != route["spec"]:
+            cur["spec"] = route["spec"]
+            self.store.update(cur)
+
+    # ------------------------------------------------------------------
+
+    def _reconcile_suspend(self, svc: TpuService) -> Optional[float]:
+        st = svc.status
+        for cs in (st.activeServiceStatus, st.pendingServiceStatus):
+            if cs is None:
+                continue
+            try:
+                self.store.delete(C.KIND_CLUSTER, cs.clusterName,
+                                  svc.metadata.namespace)
+            except NotFound:
+                pass
+        st.activeServiceStatus = None
+        st.pendingServiceStatus = None
+        st.serviceStatus = "Suspended"
+        self._update_status(svc)
+        return None
+
+    def _reconcile_deletion(self, svc: TpuService) -> Optional[float]:
+        st = svc.status
+        for cs in (st.activeServiceStatus, st.pendingServiceStatus):
+            if cs is None:
+                continue
+            try:
+                self.store.delete(C.KIND_CLUSTER, cs.clusterName,
+                                  svc.metadata.namespace)
+            except NotFound:
+                pass
+        self.store.remove_finalizer(self.KIND, svc.metadata.name,
+                                    svc.metadata.namespace, C.FINALIZER_SERVICE)
+        return None
+
+    def _update_status(self, svc: TpuService):
+        st = svc.status
+        st.observedGeneration = svc.metadata.generation
+        ready = self._serve_ready(st.activeServiceStatus)
+        if not svc.spec.suspend:
+            st.serviceStatus = "Running" if ready else "WaitForServeDeploymentReady"
+        set_condition(st.conditions, Condition(
+            type=ServiceConditionType.READY,
+            status="True" if ready else "False",
+            reason="ServeAppsRunning" if ready else "ServeAppsNotReady",
+            observedGeneration=svc.metadata.generation))
+        st.numServeEndpoints = 0
+        if st.activeServiceStatus is not None:
+            pods = self.store.list(
+                "Pod", svc.metadata.namespace,
+                labels={C.LABEL_CLUSTER: st.activeServiceStatus.clusterName})
+            st.numServeEndpoints = sum(
+                1 for p in pods
+                if p.get("status", {}).get("phase") == "Running"
+                and p["metadata"]["labels"].get(C.LABEL_SERVE) == "true")
+        obj = svc.to_dict()
+        # Status is recomputed idempotently from observed state; drop the
+        # stale resourceVersion so mid-reconcile metadata writes (finalizer
+        # add) don't conflict with our own status write (single-writer).
+        obj["metadata"].pop("resourceVersion", None)
+        cur = self.store.try_get(self.KIND, svc.metadata.name,
+                                 svc.metadata.namespace)
+        if cur is not None and cur.get("status") != obj.get("status"):
+            self.store.update_status(obj)
+
+        self.reap_retired_clusters(svc.metadata.namespace)

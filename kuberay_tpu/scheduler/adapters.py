@@ -1,0 +1,153 @@
+"""External gang-scheduler adapters.
+
+Each stamps exactly the metadata shape its scheduler consumes, following
+the reference's plugin set (SURVEY.md §2.1):
+
+- Volcano (volcano_scheduler.go:48-120): volcano PodGroup CR + pod
+  annotations ``scheduling.k8s.io/group-name`` + queue, schedulerName.
+- YuniKorn (yunikorn_scheduler.go:41 + task groups): app-id/queue labels +
+  ``yunikorn.apache.org/task-groups`` JSON annotation; gang via placeholder
+  pods is YuniKorn-side.
+- KAI (kai_scheduler.go:38-69): schedulerName + ``kai.scheduler/queue``
+  label; rejects K8sJobMode (gang deadlock, :47).
+- scheduler-plugins (scheduler_plugins.go:48-88):
+  ``scheduling.x-k8s.io/v1alpha1`` PodGroup + pod-group label.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict
+
+from kuberay_tpu.api.tpucluster import TpuCluster
+from kuberay_tpu.controlplane.store import AlreadyExists, NotFound, ObjectStore
+from kuberay_tpu.scheduler.interface import total_cluster_demand
+from kuberay_tpu.utils import constants as C
+
+
+class VolcanoAdapter:
+    name = "volcano"
+    POD_GROUP_ANNOTATION = "scheduling.k8s.io/group-name"
+    QUEUE_ANNOTATION = "scheduling.volcano.sh/queue-name"
+
+    def __init__(self, store: ObjectStore):
+        self.store = store
+
+    def _pg_name(self, obj):
+        return f"volcano-pg-{obj['metadata']['name']}"
+
+    def on_cluster_submission(self, cluster: Dict[str, Any]) -> bool:
+        demand = total_cluster_demand(cluster)
+        ns = cluster["metadata"].get("namespace", "default")
+        pg = {
+            "apiVersion": "scheduling.volcano.sh/v1beta1",
+            "kind": "PodGroup",
+            "metadata": {"name": self._pg_name(cluster), "namespace": ns},
+            "spec": {
+                "minMember": demand["minMember"],
+                "minResources": {C.RESOURCE_TPU: demand["tpuChips"]},
+                "queue": cluster.get("spec", {}).get("gangSchedulingQueue", "default"),
+            },
+            "status": {},
+        }
+        cur = self.store.try_get("PodGroup", pg["metadata"]["name"], ns)
+        if cur is None:
+            try:
+                self.store.create(pg)
+            except AlreadyExists:
+                pass
+        elif cur["spec"] != pg["spec"]:
+            cur["spec"] = pg["spec"]
+            self.store.update(cur)
+        return True   # volcano admits asynchronously via the PodGroup
+
+    def on_job_submission(self, job: Dict[str, Any]) -> bool:
+        return True
+
+    def add_metadata(self, cluster, pod) -> None:
+        ann = pod["metadata"].setdefault("annotations", {})
+        ann[self.POD_GROUP_ANNOTATION] = self._pg_name(cluster)
+        queue = cluster.get("spec", {}).get("gangSchedulingQueue", "")
+        if queue:
+            ann[self.QUEUE_ANNOTATION] = queue
+        pod["spec"]["schedulerName"] = "volcano"
+
+    def cleanup(self, obj) -> None:
+        try:
+            self.store.delete("PodGroup", self._pg_name(obj),
+                              obj["metadata"].get("namespace", "default"))
+        except NotFound:
+            pass
+
+
+class YuniKornAdapter:
+    name = "yunikorn"
+    APP_ID_LABEL = "applicationId"
+    QUEUE_LABEL = "queue"
+    TASK_GROUPS_ANNOTATION = "yunikorn.apache.org/task-groups"
+    TASK_GROUP_NAME_ANNOTATION = "yunikorn.apache.org/task-group-name"
+
+    def __init__(self, store: ObjectStore):
+        self.store = store
+
+    def on_cluster_submission(self, cluster) -> bool:
+        return True
+
+    def on_job_submission(self, job) -> bool:
+        return True
+
+    def _task_groups(self, cluster: Dict[str, Any]) -> str:
+        c = TpuCluster.from_dict(cluster)
+        groups = [{"name": "head", "minMember": 1}]
+        for g in c.spec.workerGroupSpecs:
+            topo = g.slice_topology()
+            groups.append({
+                "name": f"group-{g.groupName}",
+                "minMember": g.replicas * topo.num_hosts,
+                "minResource": {C.RESOURCE_TPU: str(topo.chips_per_host)},
+            })
+        return json.dumps(groups)
+
+    def add_metadata(self, cluster, pod) -> None:
+        labels = pod["metadata"].setdefault("labels", {})
+        labels[self.APP_ID_LABEL] = cluster["metadata"]["name"]
+        queue = cluster.get("spec", {}).get("gangSchedulingQueue", "")
+        if queue:
+            labels[self.QUEUE_LABEL] = queue
+        ann = pod["metadata"].setdefault("annotations", {})
+        ann[self.TASK_GROUPS_ANNOTATION] = self._task_groups(cluster)
+        node_type = labels.get(C.LABEL_NODE_TYPE, C.NODE_TYPE_WORKER)
+        group = labels.get(C.LABEL_GROUP, "")
+        ann[self.TASK_GROUP_NAME_ANNOTATION] = (
+            "head" if node_type == C.NODE_TYPE_HEAD else f"group-{group}")
+        pod["spec"]["schedulerName"] = "yunikorn"
+
+    def cleanup(self, obj) -> None:
+        pass
+
+
+class KaiAdapter:
+    name = "kai"
+    QUEUE_LABEL = "kai.scheduler/queue"
+
+    def __init__(self, store: ObjectStore):
+        self.store = store
+
+    def on_cluster_submission(self, cluster) -> bool:
+        return True
+
+    def on_job_submission(self, job: Dict[str, Any]) -> bool:
+        # K8sJobMode deadlocks the gang (ref kai_scheduler.go:47): the
+        # submitter Job waits for the cluster, the gang waits for all pods.
+        from kuberay_tpu.api.tpujob import JobSubmissionMode
+        mode = job.get("spec", {}).get("submissionMode",
+                                       JobSubmissionMode.K8S_JOB)
+        return mode != JobSubmissionMode.K8S_JOB
+
+    def add_metadata(self, cluster, pod) -> None:
+        queue = cluster.get("spec", {}).get("gangSchedulingQueue", "default")
+        pod["metadata"].setdefault("labels", {})[self.QUEUE_LABEL] = queue
+        pod["spec"]["schedulerName"] = "kai-scheduler"
+
+    def cleanup(self, obj) -> None:
+        pass

@@ -1,0 +1,102 @@
+"""Builtin gang scheduler: PodGroup objects + optional capacity oracle.
+
+The slice-atomic equivalent of the reference's Volcano plugin behavior
+(volcano_scheduler.go syncPodGroup :155 / calculatePodGroupParams :200)
+without the external dependency: a ``PodGroup`` object per TpuCluster
+records the all-or-nothing quantum (minMember, TPU chips); admission asks a
+pluggable capacity oracle so tests (and a future quota manager) can model
+finite fleets.  Pods are stamped with the pod-group annotation so any
+PodGroup-aware kube scheduler can enforce the gang.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+from kuberay_tpu.controlplane.store import AlreadyExists, NotFound, ObjectStore
+from kuberay_tpu.scheduler.interface import total_cluster_demand
+from kuberay_tpu.utils import constants as C
+
+ANNOTATION_POD_GROUP = "tpu.dev/pod-group"
+LABEL_QUEUE = "tpu.dev/queue"
+
+
+class GangScheduler:
+    name = "gang"
+
+    def __init__(self, store: ObjectStore,
+                 capacity_oracle: Optional[Callable[[Dict[str, Any]], bool]] = None):
+        self.store = store
+        # oracle(demand) -> True when the fleet can host the whole gang now.
+        self.capacity_oracle = capacity_oracle
+
+    def _pod_group_name(self, obj: Dict[str, Any]) -> str:
+        return f"pg-{obj['metadata']['name']}"
+
+    def _sync_pod_group(self, cluster: Dict[str, Any]) -> Dict[str, Any]:
+        demand = total_cluster_demand(cluster)
+        ns = cluster["metadata"].get("namespace", "default")
+        name = self._pod_group_name(cluster)
+        queue = cluster.get("spec", {}).get("gangSchedulingQueue", "")
+        pg = {
+            "apiVersion": C.API_VERSION,
+            "kind": "PodGroup",
+            "metadata": {
+                "name": name, "namespace": ns,
+                "labels": ({LABEL_QUEUE: queue} if queue else {}),
+                "ownerReferences": [{
+                    "apiVersion": C.API_VERSION,
+                    "kind": cluster.get("kind", C.KIND_CLUSTER),
+                    "name": cluster["metadata"]["name"],
+                    "uid": cluster["metadata"].get("uid", ""),
+                    "controller": True, "blockOwnerDeletion": True,
+                }],
+            },
+            "spec": {
+                "minMember": demand["minMember"],
+                "minResources": {C.RESOURCE_TPU: demand["tpuChips"]},
+            },
+            "status": {},
+        }
+        cur = self.store.try_get("PodGroup", name, ns)
+        if cur is None:
+            try:
+                self.store.create(pg)
+            except AlreadyExists:
+                pass
+        elif cur["spec"] != pg["spec"]:
+            cur["spec"] = pg["spec"]
+            self.store.update(cur)
+        return demand
+
+    def on_cluster_submission(self, cluster: Dict[str, Any]) -> bool:
+        demand = self._sync_pod_group(cluster)
+        if self.capacity_oracle is not None:
+            return self.capacity_oracle(demand)
+        return True
+
+    def on_job_submission(self, job: Dict[str, Any]) -> bool:
+        spec = job.get("spec", {}).get("clusterSpec")
+        if not spec:
+            return True
+        pseudo = {"metadata": job["metadata"], "kind": C.KIND_JOB,
+                  "spec": spec}
+        demand = total_cluster_demand(pseudo)
+        self._sync_pod_group(pseudo)
+        if self.capacity_oracle is not None:
+            return self.capacity_oracle(demand)
+        return True
+
+    def add_metadata(self, cluster: Dict[str, Any], pod: Dict[str, Any]) -> None:
+        pod["metadata"].setdefault("annotations", {})[ANNOTATION_POD_GROUP] = \
+            self._pod_group_name(cluster)
+        queue = cluster.get("spec", {}).get("gangSchedulingQueue", "")
+        if queue:
+            pod["metadata"].setdefault("labels", {})[LABEL_QUEUE] = queue
+
+    def cleanup(self, obj: Dict[str, Any]) -> None:
+        ns = obj["metadata"].get("namespace", "default")
+        try:
+            self.store.delete("PodGroup", self._pod_group_name(obj), ns)
+        except NotFound:
+            pass

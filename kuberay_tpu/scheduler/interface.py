@@ -1,0 +1,67 @@
+"""Gang-scheduling plugin framework (ref batchscheduler/interface/interface.go:14-47).
+
+A half-scheduled slice has no working ICI ring, so all-or-nothing admission
+is core — the builtin gang plugin is always available (not plugin-optional
+like the reference, SURVEY.md §7.3); Volcano/YuniKorn/KAI adapters stamp
+the metadata those external schedulers consume.
+
+Interface (mirrors DoBatchSchedulingOnSubmission / AddMetadataToChildResource
+/ CleanupOnCompletion):
+- ``on_cluster_submission(cluster) -> bool``: reserve capacity for the whole
+  cluster before any pod exists; False = hold off (requeue).
+- ``on_job_submission(job) -> bool``: same, at job granularity.
+- ``add_metadata(cluster, pod)``: stamp scheduler-specific labels/annotations.
+- ``cleanup(obj)``: release reservations when the CR finishes/deletes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Protocol
+
+
+class BatchScheduler(Protocol):
+    name: str
+
+    def on_cluster_submission(self, cluster: Dict[str, Any]) -> bool: ...
+    def on_job_submission(self, job: Dict[str, Any]) -> bool: ...
+    def add_metadata(self, cluster: Dict[str, Any], pod: Dict[str, Any]) -> None: ...
+    def cleanup(self, obj: Dict[str, Any]) -> None: ...
+
+
+class SchedulerManager:
+    """Selects the configured plugin (ref schedulermanager.go:21)."""
+
+    def __init__(self):
+        self._plugins: Dict[str, BatchScheduler] = {}
+
+    def register(self, plugin: BatchScheduler):
+        self._plugins[plugin.name] = plugin
+
+    def get(self, name: str) -> Optional[BatchScheduler]:
+        if not name:
+            return None
+        plugin = self._plugins.get(name)
+        if plugin is None:
+            raise KeyError(
+                f"unknown batch scheduler {name!r}; registered: "
+                f"{sorted(self._plugins)}")
+        return plugin
+
+
+def total_cluster_demand(cluster: Dict[str, Any]) -> Dict[str, Any]:
+    """Pods + TPU chips the whole cluster needs (gang quantum).
+
+    The submitter pod is intentionally excluded, mirroring the reference's
+    deadlock avoidance (volcano_scheduler.go:48-120: submitter excluded from
+    MinMember so the gang doesn't wait on a pod that waits on the gang).
+    """
+    from kuberay_tpu.api.tpucluster import TpuCluster
+
+    c = TpuCluster.from_dict(cluster)
+    pods = 1  # head
+    chips = 0
+    for g in c.spec.workerGroupSpecs:
+        topo = g.slice_topology()
+        pods += g.replicas * topo.num_hosts
+        chips += g.replicas * topo.num_chips
+    return {"minMember": pods, "tpuChips": chips}

@@ -1,0 +1,132 @@
+"""Slice autoscaler: decision core + end-to-end protocol with the cluster
+controller (ref e2eautoscaler scale-up/down specs, in slice units)."""
+
+import pytest
+
+from kuberay_tpu.api.tpucluster import AutoscalerOptions
+from kuberay_tpu.controlplane.autoscaler import (
+    SliceAutoscaler,
+    SliceInfo,
+    apply_decisions,
+    decide,
+)
+from kuberay_tpu.utils import constants as C
+from tests.test_api_types import make_cluster
+from tests.test_cluster_controller import Harness
+
+
+def make_autoscaling_cluster(replicas=1, min_r=0, max_r=4):
+    c = make_cluster(accelerator="v5p", topology="2x2x2", replicas=replicas)
+    c.spec.enableInTreeAutoscaling = True
+    c.spec.autoscalerOptions = AutoscalerOptions(idleTimeoutSeconds=0)
+    g = c.spec.workerGroupSpecs[0]
+    g.minReplicas, g.maxReplicas = min_r, max_r
+    return c
+
+
+def test_decide_upscale_default_one_step():
+    c = make_autoscaling_cluster(replicas=1)
+    d = decide(c, demand={"workers": 4}, slices=[])
+    assert len(d) == 1 and d[0].replicas == 2  # one slice per pass
+
+
+def test_decide_upscale_aggressive():
+    c = make_autoscaling_cluster(replicas=1)
+    d = decide(c, demand={"workers": 3}, slices=[], upscaling_mode="Aggressive")
+    assert d[0].replicas == 3
+
+
+def test_decide_upscale_clamped_to_max():
+    c = make_autoscaling_cluster(replicas=3, max_r=3)
+    assert decide(c, demand={"workers": 9}, slices=[]) == []
+
+
+def test_decide_downscale_names_idle_victims():
+    c = make_autoscaling_cluster(replicas=3, min_r=1)
+    slices = [
+        SliceInfo("s0", "workers", ready=True, idle_seconds=300),
+        SliceInfo("s1", "workers", ready=True, idle_seconds=10),
+        SliceInfo("s2", "workers", ready=True, idle_seconds=600),
+    ]
+    d = decide(c, demand={"workers": 1}, slices=slices, idle_timeout=60)
+    assert d[0].replicas == 1
+    assert set(d[0].slices_to_delete) == {"s0", "s2"}  # only idle ones
+
+
+def test_decide_respects_min_replicas():
+    c = make_autoscaling_cluster(replicas=2, min_r=2)
+    slices = [SliceInfo(f"s{i}", "workers", True, 999) for i in range(2)]
+    assert decide(c, demand={}, slices=slices, idle_timeout=60) == []
+
+
+def test_end_to_end_scale_cycle():
+    """Autoscaler patches the CR; the cluster controller executes it."""
+    h = Harness()
+    c = make_autoscaling_cluster(replicas=1)
+    h.store.create(c.to_dict())
+    h.settle()
+    assert len(h.pods(**{C.LABEL_NODE_TYPE: "worker"})) == 2
+
+    auto = SliceAutoscaler(h.store)
+    # Upscale: pretend demand wants 2 slices.
+    cluster = h.cluster()
+    decisions = decide(cluster, demand={"workers": 2}, slices=[])
+    assert apply_decisions(h.store, "demo", "default", decisions)
+    h.settle()
+    assert len(h.pods(**{C.LABEL_NODE_TYPE: "worker"})) == 4
+    assert h.cluster().status.readySlices == 2
+
+    # Downscale: both slices idle, demand zero -> min (0).
+    cluster = h.cluster()
+    slices = [SliceInfo(f"demo-workers-{i}", "workers", True, 999)
+              for i in range(2)]
+    decisions = decide(cluster, demand={}, slices=slices, idle_timeout=60)
+    assert apply_decisions(h.store, "demo", "default", decisions)
+    h.settle()
+    assert len(h.pods(**{C.LABEL_NODE_TYPE: "worker"})) == 0
+
+
+def test_executed_victims_cleared_from_spec():
+    """Stale slicesToDelete entries must not re-kill recreated slices."""
+    h = Harness()
+    c = make_autoscaling_cluster(replicas=2)
+    h.store.create(c.to_dict())
+    h.settle()
+    obj = h.store.get(C.KIND_CLUSTER, "demo")
+    obj["spec"]["workerGroupSpecs"][0]["replicas"] = 1
+    obj["spec"]["workerGroupSpecs"][0]["scaleStrategy"] = {
+        "slicesToDelete": ["demo-workers-1"]}
+    h.store.update(obj)
+    h.settle()
+    spec = h.store.get(C.KIND_CLUSTER, "demo")["spec"]
+    assert spec["workerGroupSpecs"][0].get("scaleStrategy", {}).get(
+        "slicesToDelete", []) == []
+    # Scale back up: index 1 is recreated and SURVIVES (no stale victim).
+    obj = h.store.get(C.KIND_CLUSTER, "demo")
+    obj["spec"]["workerGroupSpecs"][0]["replicas"] = 2
+    h.store.update(obj)
+    h.settle()
+    assert h.cluster().status.readySlices == 2
+
+
+def test_slice_autoscaler_demand_from_jobs():
+    """Demand derives from live TpuJobs bound to the cluster."""
+    h = Harness()
+    c = make_autoscaling_cluster(replicas=1)
+    h.store.create(c.to_dict())
+    h.settle()
+    # A running job wants 3 slices of group "workers" on this cluster.
+    h.store.create({
+        "apiVersion": C.API_VERSION, "kind": C.KIND_JOB,
+        "metadata": {"name": "big", "namespace": "default"},
+        "spec": {"entrypoint": "x", "clusterSpec": {
+            "workerGroupSpecs": [{"groupName": "workers", "replicas": 3}]}},
+        "status": {"clusterName": "demo", "jobDeploymentStatus": "Running"},
+    })
+    auto = SliceAutoscaler(h.store)
+    assert auto.reconcile("demo")
+    h.settle()
+    assert h.cluster().spec.workerGroupSpecs[0].replicas == 2  # one step
+    assert auto.reconcile("demo")
+    h.settle()
+    assert h.cluster().spec.workerGroupSpecs[0].replicas == 3

@@ -27,8 +27,10 @@ class Harness:
     def settle(self, rounds: int = 6):
         """Alternate reconcile-drain and kubelet steps until stable."""
         for _ in range(rounds):
+            self.manager.flush_delayed()
             self.manager.run_until_idle()
             self.kubelet.step()
+        self.manager.flush_delayed()
         self.manager.run_until_idle()
 
     def pods(self, **labels):

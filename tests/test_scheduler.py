@@ -1,0 +1,145 @@
+"""Gang scheduler plugins + NetworkPolicy controller tests."""
+
+import json
+
+import pytest
+
+from kuberay_tpu.api.tpucluster import NetworkPolicySpec
+from kuberay_tpu.controlplane.networkpolicy_controller import (
+    NetworkPolicyController,
+    build_network_policies,
+)
+from kuberay_tpu.controlplane.store import ObjectStore
+from kuberay_tpu.scheduler.adapters import KaiAdapter, VolcanoAdapter, YuniKornAdapter
+from kuberay_tpu.scheduler.gang import GangScheduler
+from kuberay_tpu.scheduler.interface import SchedulerManager, total_cluster_demand
+from kuberay_tpu.utils import constants as C
+from kuberay_tpu.utils import features
+from tests.test_api_types import make_cluster
+from tests.test_cluster_controller import Harness
+
+
+def cluster_dict(replicas=2):
+    c = make_cluster(accelerator="v5p", topology="2x2x2", replicas=replicas)
+    c.spec.workerGroupSpecs[0].maxReplicas = replicas
+    d = c.to_dict()
+    d["metadata"]["uid"] = "uid123"
+    return d
+
+
+def test_total_demand():
+    d = total_cluster_demand(cluster_dict(replicas=2))
+    assert d == {"minMember": 5, "tpuChips": 16}  # head + 2 slices x 2 hosts
+
+
+def test_gang_creates_pod_group_and_stamps_pods():
+    store = ObjectStore()
+    gang = GangScheduler(store)
+    cd = cluster_dict()
+    assert gang.on_cluster_submission(cd)
+    pg = store.get("PodGroup", "pg-demo")
+    assert pg["spec"]["minMember"] == 5
+    assert pg["spec"]["minResources"][C.RESOURCE_TPU] == 16
+    pod = {"metadata": {"name": "p"}, "spec": {}}
+    gang.add_metadata(cd, pod)
+    assert pod["metadata"]["annotations"]["tpu.dev/pod-group"] == "pg-demo"
+    gang.cleanup(cd)
+    assert store.try_get("PodGroup", "pg-demo") is None
+
+
+def test_gang_capacity_oracle_holds_admission():
+    store = ObjectStore()
+    fleet = {"chips": 8}
+    gang = GangScheduler(store,
+                         capacity_oracle=lambda d: d["tpuChips"] <= fleet["chips"])
+    assert not gang.on_cluster_submission(cluster_dict(replicas=2))  # 16 > 8
+    assert gang.on_cluster_submission(cluster_dict(replicas=1))      # 8 <= 8
+
+
+def test_gang_blocks_cluster_controller_until_capacity():
+    h = Harness()
+    fleet = {"chips": 0}
+    h.controller.scheduler = GangScheduler(
+        h.store, capacity_oracle=lambda d: d["tpuChips"] <= fleet["chips"])
+    c = make_cluster(accelerator="v5p", topology="2x2x2", replicas=1)
+    h.store.create(c.to_dict())
+    h.settle()
+    assert h.pods() == []     # gang held: no partial slice ever exists
+    fleet["chips"] = 8
+    h.settle()
+    assert len(h.pods()) == 3  # head + whole slice admitted together
+
+
+def test_volcano_adapter_shapes():
+    store = ObjectStore()
+    v = VolcanoAdapter(store)
+    cd = cluster_dict()
+    cd["spec"]["gangSchedulingQueue"] = "research"
+    assert v.on_cluster_submission(cd)
+    pg = store.get("PodGroup", "volcano-pg-demo")
+    assert pg["apiVersion"].startswith("scheduling.volcano.sh")
+    assert pg["spec"]["queue"] == "research"
+    pod = {"metadata": {"name": "p"}, "spec": {}}
+    v.add_metadata(cd, pod)
+    assert pod["spec"]["schedulerName"] == "volcano"
+    assert pod["metadata"]["annotations"]["scheduling.k8s.io/group-name"] == \
+        "volcano-pg-demo"
+
+
+def test_yunikorn_task_groups():
+    store = ObjectStore()
+    y = YuniKornAdapter(store)
+    cd = cluster_dict()
+    pod = {"metadata": {"name": "p", "labels": {
+        C.LABEL_NODE_TYPE: "worker", C.LABEL_GROUP: "workers"}}, "spec": {}}
+    y.add_metadata(cd, pod)
+    groups = json.loads(
+        pod["metadata"]["annotations"]["yunikorn.apache.org/task-groups"])
+    assert {g["name"] for g in groups} == {"head", "group-workers"}
+    assert pod["metadata"]["annotations"][
+        "yunikorn.apache.org/task-group-name"] == "group-workers"
+    assert pod["spec"]["schedulerName"] == "yunikorn"
+
+
+def test_kai_rejects_k8s_job_mode():
+    k = KaiAdapter(ObjectStore())
+    assert not k.on_job_submission({"spec": {"submissionMode": "K8sJobMode"}})
+    assert k.on_job_submission({"spec": {"submissionMode": "HTTPMode"}})
+
+
+def test_scheduler_manager_selection():
+    m = SchedulerManager()
+    store = ObjectStore()
+    m.register(GangScheduler(store))
+    assert m.get("") is None
+    assert m.get("gang").name == "gang"
+    with pytest.raises(KeyError):
+        m.get("nope")
+
+
+def test_network_policies_built():
+    c = make_cluster()
+    c.spec.networkPolicy = NetworkPolicySpec(
+        enabled=True, mode="DenyAllEgress", allowNamespaces=["monitoring"])
+    pols = build_network_policies(c)
+    assert len(pols) == 2
+    head = next(p for p in pols if p["metadata"]["name"].endswith("head"))
+    assert "Egress" in head["spec"]["policyTypes"]
+    assert head["spec"]["egress"]
+    assert any("namespaceSelector" in f
+               for rule in head["spec"]["ingress"] for f in rule.get("from", []))
+
+
+def test_network_policy_controller_gated():
+    features.reset()
+    store = ObjectStore()
+    c = make_cluster()
+    c.spec.networkPolicy = NetworkPolicySpec(enabled=True)
+    store.create(c.to_dict())
+    ctrl = NetworkPolicyController(store)
+    ctrl.reconcile("demo")
+    assert store.list("NetworkPolicy") == []     # gate off
+    features.set_gates({"TpuClusterNetworkPolicy": True})
+    ctrl.reconcile("demo")
+    assert len(store.list("NetworkPolicy")) == 2
+    features.reset()

@@ -1,0 +1,214 @@
+"""TpuService zero-downtime upgrade tests (modeled on
+rayservice_controller_test.go + e2erayservice upgrade specs)."""
+
+import pytest
+
+from kuberay_tpu.api.common import ObjectMeta
+from kuberay_tpu.api.tpuservice import (
+    ClusterUpgradeOptions,
+    ServiceUpgradeType,
+    TpuService,
+    TpuServiceSpec,
+)
+from kuberay_tpu.controlplane.cluster_controller import TpuClusterController
+from kuberay_tpu.controlplane.fake_kubelet import FakeKubelet
+from kuberay_tpu.controlplane.manager import (
+    Manager,
+    originated_from_mapper,
+    owned_pod_mapper,
+)
+from kuberay_tpu.controlplane.service_controller import TpuServiceController
+from kuberay_tpu.controlplane.store import ObjectStore
+from kuberay_tpu.runtime.coordinator_client import FakeCoordinatorClient
+from kuberay_tpu.utils import constants as C
+from kuberay_tpu.utils import features
+from tests.test_api_types import make_cluster
+
+
+class ServiceHarness:
+    def __init__(self):
+        self.store = ObjectStore()
+        self.manager = Manager(self.store)
+        self.clients = {}   # cluster name -> FakeCoordinatorClient
+
+        def provider(cluster_name, _status):
+            client = self.clients.setdefault(cluster_name,
+                                             FakeCoordinatorClient())
+            return client
+
+        self.cluster_ctrl = TpuClusterController(
+            self.store, expectations=self.manager.expectations)
+        self.svc_ctrl = TpuServiceController(self.store,
+                                             client_provider=provider)
+        self.manager.register(C.KIND_CLUSTER, self.cluster_ctrl.reconcile)
+        self.manager.register(C.KIND_SERVICE, self.svc_ctrl.reconcile)
+        self.manager.map_owned(owned_pod_mapper)
+        self.manager.map_owned(originated_from_mapper(C.KIND_SERVICE))
+        self.kubelet = FakeKubelet(self.store)
+
+    def settle(self, rounds=10):
+        for _ in range(rounds):
+            self.manager.flush_delayed()
+            self.manager.run_until_idle()
+            self.kubelet.step()
+            # Serve apps become RUNNING once the config lands.
+            for client in self.clients.values():
+                if client.serve_config is not None and not client.serve_apps:
+                    client.set_serve_app("llm", "RUNNING")
+        self.manager.flush_delayed()
+        self.manager.run_until_idle()
+
+    def svc(self, name="svc"):
+        return TpuService.from_dict(self.store.get(C.KIND_SERVICE, name))
+
+
+@pytest.fixture
+def h():
+    return ServiceHarness()
+
+
+@pytest.fixture(autouse=True)
+def reset_gates():
+    features.reset()
+    yield
+    features.reset()
+
+
+def make_service(name="svc"):
+    return TpuService(
+        metadata=ObjectMeta(name=name),
+        spec=TpuServiceSpec(
+            serveConfig={"applications": [{"name": "llm",
+                                           "model": "llama3-8b"}]},
+            clusterSpec=make_cluster(accelerator="v5e", topology="4x4",
+                                     replicas=1).spec,
+            clusterDeletionDelaySeconds=0,
+        ),
+    )
+
+
+def test_first_rollout_promotes(h):
+    h.store.create(make_service().to_dict())
+    h.settle()
+    s = h.svc()
+    assert s.status.activeServiceStatus is not None
+    assert s.status.pendingServiceStatus is None
+    assert s.status.serviceStatus == "Running"
+    assert s.status.numServeEndpoints > 0
+    # Stable serve service points at the active cluster.
+    stable = h.store.get("Service", "svc-serve-svc")
+    assert stable["spec"]["selector"][C.LABEL_CLUSTER] == \
+        s.status.activeServiceStatus.clusterName
+
+
+def test_scale_only_change_is_in_place(h):
+    h.store.create(make_service().to_dict())
+    h.settle()
+    active = h.svc().status.activeServiceStatus.clusterName
+    obj = h.store.get(C.KIND_SERVICE, "svc")
+    obj["spec"]["clusterSpec"]["workerGroupSpecs"][0]["replicas"] = 2
+    obj["spec"]["clusterSpec"]["workerGroupSpecs"][0]["maxReplicas"] = 2
+    h.store.update(obj)
+    h.settle()
+    s = h.svc()
+    # Same cluster, no pending: scale flowed through in place.
+    assert s.status.activeServiceStatus.clusterName == active
+    assert s.status.pendingServiceStatus is None
+    cluster = h.store.get(C.KIND_CLUSTER, active)
+    assert cluster["spec"]["workerGroupSpecs"][0]["replicas"] == 2
+
+
+def test_spec_change_rolls_new_cluster(h):
+    h.store.create(make_service().to_dict())
+    h.settle()
+    old_active = h.svc().status.activeServiceStatus.clusterName
+    # Real spec change: new image.
+    obj = h.store.get(C.KIND_SERVICE, "svc")
+    obj["spec"]["clusterSpec"]["workerGroupSpecs"][0]["template"]["spec"][
+        "containers"][0]["image"] = "model:v2"
+    h.store.update(obj)
+    h.settle(rounds=14)
+    s = h.svc()
+    assert s.status.activeServiceStatus.clusterName != old_active
+    assert s.status.pendingServiceStatus is None
+    assert s.status.serviceStatus == "Running"
+    # Old cluster retired (deletion delay 0).
+    assert h.store.try_get(C.KIND_CLUSTER, old_active) is None
+    # Stable service now selects the new cluster.
+    stable = h.store.get("Service", "svc-serve-svc")
+    assert stable["spec"]["selector"][C.LABEL_CLUSTER] == \
+        s.status.activeServiceStatus.clusterName
+
+
+def test_upgrade_strategy_none_blocks_roll(h):
+    svc = make_service()
+    svc.spec.upgradeStrategy = ServiceUpgradeType.NONE
+    h.store.create(svc.to_dict())
+    h.settle()
+    active = h.svc().status.activeServiceStatus.clusterName
+    obj = h.store.get(C.KIND_SERVICE, "svc")
+    obj["spec"]["clusterSpec"]["workerGroupSpecs"][0]["template"]["spec"][
+        "containers"][0]["image"] = "model:v2"
+    h.store.update(obj)
+    h.settle()
+    s = h.svc()
+    assert s.status.activeServiceStatus.clusterName == active
+    assert s.status.pendingServiceStatus is None
+
+
+def test_suspend_deletes_clusters(h):
+    h.store.create(make_service().to_dict())
+    h.settle()
+    active = h.svc().status.activeServiceStatus.clusterName
+    obj = h.store.get(C.KIND_SERVICE, "svc")
+    obj["spec"]["suspend"] = True
+    h.store.update(obj)
+    h.settle()
+    s = h.svc()
+    assert s.status.serviceStatus == "Suspended"
+    assert h.store.try_get(C.KIND_CLUSTER, active) is None
+
+
+def test_incremental_upgrade_steps_traffic(h):
+    features.set_gates({"TpuServiceIncrementalUpgrade": True})
+    svc = make_service()
+    svc.spec.upgradeStrategy = ServiceUpgradeType.INCREMENTAL
+    svc.spec.upgradeOptions = ClusterUpgradeOptions(
+        stepSizePercent=100, intervalSeconds=1)
+    h.store.create(svc.to_dict())
+    h.settle()
+    old_active = h.svc().status.activeServiceStatus.clusterName
+    seen_routes = []
+    h.store.watch(lambda ev: seen_routes.append(ev)
+                  if ev.kind == "TrafficRoute" else None)
+    obj = h.store.get(C.KIND_SERVICE, "svc")
+    obj["spec"]["clusterSpec"]["workerGroupSpecs"][0]["template"]["spec"][
+        "containers"][0]["image"] = "model:v2"
+    h.store.update(obj)
+    h.settle(rounds=16)
+    s = h.svc()
+    # Rolled fully through the weighted steps.
+    assert s.status.activeServiceStatus.clusterName != old_active
+    # A weighted route existed during the roll and was cleaned up after.
+    assert any(ev.type == "ADDED" for ev in seen_routes)
+    assert h.store.list("TrafficRoute") == []
+
+
+def test_head_pod_serve_label(h):
+    svc = make_service()
+    svc.spec.excludeHeadPodFromServe = True
+    h.store.create(svc.to_dict())
+    h.settle()
+    s = h.svc()
+    heads = h.store.list("Pod", labels={
+        C.LABEL_CLUSTER: s.status.activeServiceStatus.clusterName,
+        C.LABEL_NODE_TYPE: C.NODE_TYPE_HEAD})
+    assert heads and all(
+        p["metadata"]["labels"].get(C.LABEL_SERVE) == "false" for p in heads)
+    # Excluded heads don't count as endpoints.
+    workers_running = h.store.list("Pod", labels={
+        C.LABEL_CLUSTER: s.status.activeServiceStatus.clusterName,
+        C.LABEL_NODE_TYPE: C.NODE_TYPE_WORKER})
+    assert s.status.numServeEndpoints == len(
+        [p for p in workers_running
+         if p["status"].get("phase") == "Running"])
