@@ -132,7 +132,8 @@ class SliceAutoscaler:
     def __init__(self, store: ObjectStore, idle_timeout: float = 60.0):
         self.store = store
         self.idle_timeout = idle_timeout
-        self._idle_since: Dict[str, float] = {}
+        # (namespace, cluster, slice-name) -> idle-since timestamp
+        self._idle_since: Dict[tuple, float] = {}
 
     def _demand_for(self, cluster_obj: dict) -> Dict[str, int]:
         """Slices wanted per group = max over jobs bound to this cluster of
@@ -166,23 +167,27 @@ class SliceAutoscaler:
             if sname:
                 by_slice.setdefault(sname, []).append(p)
         now = time.time()
-        # Prune idle bookkeeping for slices that no longer exist — a stale
-        # entry would both leak and make a recreated same-name slice appear
-        # instantly idle.
-        for gone in set(self._idle_since) - set(by_slice):
-            del self._idle_since[gone]
+        # Idle bookkeeping is keyed per (ns, cluster, slice) so one
+        # autoscaler instance can manage many clusters; prune only THIS
+        # cluster's vanished slices — a stale entry would leak and make a
+        # recreated same-name slice appear instantly idle.
+        live_keys = {(ns, name, s) for s in by_slice}
+        for key in [k for k in self._idle_since
+                    if k[0] == ns and k[1] == name and k not in live_keys]:
+            del self._idle_since[key]
         out = []
         for sname, plist in by_slice.items():
+            key = (ns, name, sname)
             group = plist[0]["metadata"]["labels"].get(C.LABEL_GROUP, "")
             ready = all(p.get("status", {}).get("phase") == "Running"
                         for p in plist)
             claimed = demand.get(group, 0) > 0
             if claimed:
-                self._idle_since.pop(sname, None)
+                self._idle_since.pop(key, None)
                 idle = 0.0
             else:
-                self._idle_since.setdefault(sname, now)
-                idle = now - self._idle_since[sname]
+                self._idle_since.setdefault(key, now)
+                idle = now - self._idle_since[key]
             out.append(SliceInfo(sname, group, ready, idle))
         return out
 

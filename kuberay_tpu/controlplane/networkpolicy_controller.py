@@ -28,6 +28,15 @@ def build_network_policies(cluster: TpuCluster) -> List[Dict[str, Any]]:
     allowed_ns = [{"namespaceSelector": {"matchLabels": {
         "kubernetes.io/metadata.name": n}}} for n in spec.allowNamespaces]
 
+    def external_rule(ports):
+        # K8s semantics: a rule with no `from` admits all peers; an empty
+        # peer `{}` is INVALID. With no allowNamespaces configured the rule
+        # intentionally opens the ports to all, by omitting `from`.
+        rule = {"ports": ports}
+        if allowed_ns:
+            rule["from"] = allowed_ns
+        return rule
+
     head = {
         "apiVersion": "networking.k8s.io/v1",
         "kind": "NetworkPolicy",
@@ -48,9 +57,9 @@ def build_network_policies(cluster: TpuCluster) -> List[Dict[str, Any]]:
                 ["Egress"] if spec.mode == "DenyAllEgress" else []),
             "ingress": [
                 {"from": [same_cluster]},
-                {"from": allowed_ns or [{}],
-                 "ports": [{"port": C.PORT_DASHBOARD}, {"port": C.PORT_SERVE},
-                           {"port": C.PORT_METRICS}]},
+                external_rule([{"port": C.PORT_DASHBOARD},
+                               {"port": C.PORT_SERVE},
+                               {"port": C.PORT_METRICS}]),
             ],
         },
     }
@@ -73,9 +82,8 @@ def build_network_policies(cluster: TpuCluster) -> List[Dict[str, Any]]:
             # restriction as the head (an unqualified ports-only rule would
             # admit every peer in K8s NetworkPolicy semantics).
             "ingress": [{"from": [same_cluster]},
-                        {"from": allowed_ns or [{}],
-                         "ports": [{"port": C.PORT_SERVE},
-                                   {"port": C.PORT_METRICS}]}],
+                        external_rule([{"port": C.PORT_SERVE},
+                                       {"port": C.PORT_METRICS}])],
         },
     }
     if spec.mode == "DenyAllEgress":
@@ -99,15 +107,18 @@ class NetworkPolicyController:
         if raw is None or raw["metadata"].get("deletionTimestamp"):
             return None   # policies GC via ownerReferences
         cluster = TpuCluster.from_dict(raw)
-        for pol in build_network_policies(cluster):
-            cur = self.store.try_get("NetworkPolicy",
-                                     pol["metadata"]["name"], namespace)
-            if cur is None:
+        desired = build_network_policies(cluster)
+        for pol in desired:
+            self.store.ensure(pol)
+        # Disabling the feature must remove previously created policies —
+        # otherwise stale DenyAll rules keep enforcing after opt-out.
+        desired_names = {p["metadata"]["name"] for p in desired}
+        for cur in self.store.list("NetworkPolicy", namespace,
+                                   labels={C.LABEL_CLUSTER: name}):
+            if cur["metadata"]["name"] not in desired_names:
                 try:
-                    self.store.create(pol)
-                except AlreadyExists:
+                    self.store.delete("NetworkPolicy",
+                                      cur["metadata"]["name"], namespace)
+                except NotFound:
                     pass
-            elif cur["spec"] != pol["spec"]:
-                cur["spec"] = pol["spec"]
-                self.store.update(cur)
         return None

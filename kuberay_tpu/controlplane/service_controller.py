@@ -322,6 +322,7 @@ class TpuServiceController:
             # Retire the old cluster after the grace delay (ref
             # cleanUpRayClusterInstance :1247).
             self._schedule_retirement(svc, old.clusterName)
+            self._submitted.pop(old.clusterName, None)
 
     def _schedule_retirement(self, svc: TpuService, cname: str):
         obj = self.store.try_get(C.KIND_CLUSTER, cname, svc.metadata.namespace)
@@ -366,15 +367,8 @@ class TpuServiceController:
             "name": svc.metadata.name, "uid": svc.metadata.uid,
             "controller": True, "blockOwnerDeletion": True,
         }]
-        cur = self.store.try_get("Service", stable_name, svc.metadata.namespace)
-        if cur is None:
-            try:
-                self.store.create(desired)
-            except AlreadyExists:
-                pass
-        elif cur["spec"].get("selector") != desired["spec"]["selector"]:
-            cur["spec"] = desired["spec"]
-            self.store.update(cur)
+        self.store.ensure(desired,
+                          compare=lambda o: o.get("spec", {}).get("selector"))
         # Head serve-label: heads receive serve traffic unless excluded
         # (ref updateHeadPodServeLabel :2065).
         serve_val = "false" if svc.spec.excludeHeadPodFromServe else "true"
@@ -415,13 +409,7 @@ class TpuServiceController:
                 "service": per_cluster["metadata"]["name"],
                 "weight": cs.trafficWeightPercent,
             })
-        cur = self.store.try_get("TrafficRoute", route["metadata"]["name"],
-                                 svc.metadata.namespace)
-        if cur is None:
-            self.store.create(route)
-        elif cur["spec"] != route["spec"]:
-            cur["spec"] = route["spec"]
-            self.store.update(cur)
+        self.store.ensure(route)
 
     # ------------------------------------------------------------------
 
@@ -435,6 +423,7 @@ class TpuServiceController:
                                   svc.metadata.namespace)
             except NotFound:
                 pass
+            self._submitted.pop(cs.clusterName, None)
         st.activeServiceStatus = None
         st.pendingServiceStatus = None
         st.serviceStatus = "Suspended"
@@ -451,6 +440,7 @@ class TpuServiceController:
                                   svc.metadata.namespace)
             except NotFound:
                 pass
+            self._submitted.pop(cs.clusterName, None)
         self.store.remove_finalizer(self.KIND, svc.metadata.name,
                                     svc.metadata.namespace, C.FINALIZER_SERVICE)
         return None

@@ -281,6 +281,28 @@ class ObjectStore:
 
     # -- introspection -----------------------------------------------------
 
+    def ensure(self, obj: Dict[str, Any], compare=None) -> bool:
+        """Create-or-converge: create if absent; update spec when the
+        compared projection differs.  ``compare`` extracts the comparable
+        part (default: the whole spec).  Returns True when a write happened.
+        """
+        md = obj["metadata"]
+        kind = obj["kind"]
+        ns = md.get("namespace", "default")
+        compare = compare or (lambda o: o.get("spec"))
+        cur = self.try_get(kind, md["name"], ns)
+        if cur is None:
+            try:
+                self.create(obj)
+                return True
+            except AlreadyExists:
+                return False
+        if compare(cur) != compare(obj):
+            cur["spec"] = obj.get("spec", cur.get("spec"))
+            self.update(cur)
+            return True
+        return False
+
     def count(self, kind: str) -> int:
         with self._lock:
             return sum(1 for (k, _, _) in self._objects if k == kind)

@@ -1,0 +1,225 @@
+"""Mixtral-family sparse MoE transformer, TPU-first.
+
+The payload of BASELINE config #5 (Mixtral-8x7B expert-parallel across two
+v5p-32 worker groups).  Same pure-pytree/scan design as models/llama.py;
+the FFN is replaced by a top-k routed expert layer built for the MXU:
+
+- GShard/Switch-style capacity dispatch: one-hot dispatch/combine einsums
+  (dense, batched matmuls — no gathers/scatters XLA can't tile);
+- expert weights carry the ``expert`` logical axis -> sharded over the
+  ``ep`` mesh axis, so dispatch/combine einsums lower to all-to-alls over
+  ICI/DCN;
+- router aux losses: load-balancing (Switch) + z-loss on router logits.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from kuberay_tpu.ops.attention import flash_attention
+from kuberay_tpu.ops.rmsnorm import rmsnorm
+from kuberay_tpu.ops.rope import apply_rope, rope_frequencies
+
+
+@dataclasses.dataclass(frozen=True)
+class MixtralConfig:
+    vocab_size: int = 32000
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    d_ff: int = 14336
+    n_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    router_aux_weight: float = 0.01
+    router_z_weight: float = 1e-3
+    max_seq_len: int = 8192
+    rope_theta: float = 1e6
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    attn_impl: str = "auto"
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+CONFIGS: Dict[str, MixtralConfig] = {
+    "mixtral_tiny": MixtralConfig(
+        vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, n_experts=4, top_k=2, max_seq_len=128,
+        dtype=jnp.float32, attn_impl="xla", remat=False),
+    "mixtral_8x7b": MixtralConfig(),
+}
+
+
+def param_axes(cfg: MixtralConfig) -> Dict[str, Any]:
+    return {
+        "embed": ("vocab", "embed"),
+        "layers": {
+            "attn_norm": ("layers", "norm"),
+            "wq": ("layers", "embed", "heads"),
+            "wk": ("layers", "embed", "kv_heads"),
+            "wv": ("layers", "embed", "kv_heads"),
+            "wo": ("layers", "heads", "embed"),
+            "mlp_norm": ("layers", "norm"),
+            "router": ("layers", "embed", "expert"),
+            "w_gate": ("layers", "expert", "embed", "mlp"),
+            "w_up": ("layers", "expert", "embed", "mlp"),
+            "w_down": ("layers", "expert", "mlp", "embed"),
+        },
+        "final_norm": ("norm",),
+        "lm_head": ("embed", "vocab"),
+    }
+
+
+def init_params(cfg: MixtralConfig, key: jax.Array) -> Dict[str, Any]:
+    d, f, v, L, E = (cfg.d_model, cfg.d_ff, cfg.vocab_size, cfg.n_layers,
+                     cfg.n_experts)
+    hq, hkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    k = iter(jax.random.split(key, 16))
+    std = 1.0 / math.sqrt(d)
+    out_std = std / math.sqrt(2 * L)
+
+    def rnd(key, shape, scale):
+        return (jax.random.normal(key, shape, dtype=jnp.float32) * scale
+                ).astype(cfg.dtype)
+
+    return {
+        "embed": rnd(next(k), (v, d), std),
+        "layers": {
+            "attn_norm": jnp.ones((L, d), cfg.dtype),
+            "wq": rnd(next(k), (L, d, hq * hd), std),
+            "wk": rnd(next(k), (L, d, hkv * hd), std),
+            "wv": rnd(next(k), (L, d, hkv * hd), std),
+            "wo": rnd(next(k), (L, hq * hd, d), out_std),
+            "mlp_norm": jnp.ones((L, d), cfg.dtype),
+            "router": rnd(next(k), (L, d, E), std),
+            "w_gate": rnd(next(k), (L, E, d, f), std),
+            "w_up": rnd(next(k), (L, E, d, f), std),
+            "w_down": rnd(next(k), (L, E, f, d), out_std),
+        },
+        "final_norm": jnp.ones((d,), cfg.dtype),
+        "lm_head": rnd(next(k), (d, v), std),
+    }
+
+
+# --------------------------------------------------------------------------
+# MoE layer
+# --------------------------------------------------------------------------
+
+def moe_ffn(cfg: MixtralConfig, x: jax.Array, lp: Dict[str, jax.Array]
+            ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Top-k routed expert FFN.  x: [B, S, d] -> (out, aux_losses).
+
+    Capacity dispatch (GShard): each expert processes at most
+    C = ceil(T * top_k / E * capacity_factor) tokens; overflow tokens drop
+    that expert assignment (their other top-k picks still apply).
+    """
+    B, S, d = x.shape
+    E, K = cfg.n_experts, cfg.top_k
+    T = B * S
+    C = max(1, int(math.ceil(T * K / E * cfg.capacity_factor)))
+    xt = x.reshape(T, d)
+
+    logits = (xt @ lp["router"]).astype(jnp.float32)           # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    topw, topi = jax.lax.top_k(probs, K)                        # [T, K]
+    topw = topw / jnp.clip(topw.sum(-1, keepdims=True), 1e-9)   # renormalize
+
+    # Aux losses: Switch load-balance + router z-loss.
+    me = probs.mean(axis=0)                                     # [E]
+    ce = jnp.zeros(E).at[topi[:, 0]].add(1.0) / T               # top-1 fraction
+    aux = {
+        "load_balance": E * jnp.sum(me * ce) * cfg.router_aux_weight,
+        "router_z": (jnp.mean(jax.nn.logsumexp(logits, -1) ** 2)
+                     * cfg.router_z_weight),
+    }
+
+    # Position of each (token, k) within its expert's capacity buffer.
+    onehot = jax.nn.one_hot(topi, E, dtype=jnp.int32)           # [T, K, E]
+    flat = onehot.reshape(T * K, E)
+    pos = jnp.cumsum(flat, axis=0) * flat - 1                   # [T*K, E]
+    pos = pos.reshape(T, K, E)
+    in_cap = (pos >= 0) & (pos < C)
+    # dispatch [T, E, C]: token t occupies slot pos in expert e.
+    disp = (jax.nn.one_hot(pos, C, dtype=x.dtype)
+            * in_cap[..., None].astype(x.dtype))               # [T, K, E, C]
+    combine = disp * topw[..., None, None].astype(x.dtype)     # [T, K, E, C]
+    disp = disp.sum(axis=1)                                     # [T, E, C]
+    combine = combine.sum(axis=1)                               # [T, E, C]
+
+    # Expert compute: batched over E (shards over the ep mesh axis; the
+    # dispatch einsum lowers to an all-to-all when T is dp/fsdp-sharded).
+    ex_in = jnp.einsum("tec,td->ecd", disp, xt)                 # [E, C, d]
+    gated = jax.nn.silu(jnp.einsum("ecd,edf->ecf", ex_in, lp["w_gate"])) \
+        * jnp.einsum("ecd,edf->ecf", ex_in, lp["w_up"])
+    ex_out = jnp.einsum("ecf,efd->ecd", gated, lp["w_down"])    # [E, C, d]
+    out = jnp.einsum("tec,ecd->td", combine, ex_out)            # [T, d]
+    return out.reshape(B, S, d).astype(x.dtype), aux
+
+
+def _layer(cfg: MixtralConfig, x, lp, cos, sin):
+    B, S, d = x.shape
+    hq, hkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+    q = (h @ lp["wq"]).reshape(B, S, hq, hd)
+    kk = (h @ lp["wk"]).reshape(B, S, hkv, hd)
+    vv = (h @ lp["wv"]).reshape(B, S, hkv, hd)
+    q = apply_rope(q, cos, sin)
+    kk = apply_rope(kk, cos, sin)
+    attn = flash_attention(q, kk, vv, causal=True, impl=cfg.attn_impl)
+    x = x + (attn.reshape(B, S, hq * hd) @ lp["wo"]).astype(x.dtype)
+
+    h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
+    moe_out, aux = moe_ffn(cfg, h, lp)
+    x = x + moe_out
+    return x, aux
+
+
+def forward(cfg: MixtralConfig, params: Dict[str, Any], tokens: jax.Array
+            ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """tokens [B,S] -> (logits [B,S,V] f32, aux losses summed over layers)."""
+    B, S = tokens.shape
+    x = jnp.take(params["embed"], tokens, axis=0)
+    cos, sin = rope_frequencies(cfg.head_dim, S, cfg.rope_theta)
+
+    def layer_fn(x, lp):
+        x, aux = _layer(cfg, x, lp, cos, sin)
+        return x, aux
+    if cfg.remat:
+        layer_fn = jax.checkpoint(layer_fn, prevent_cse=False)
+    x, aux_stack = jax.lax.scan(layer_fn, x, params["layers"])
+    aux = {k: v.sum() for k, v in aux_stack.items()}
+
+    x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"],
+                        preferred_element_type=jnp.float32)
+    return logits, aux
+
+
+def loss_fn(cfg: MixtralConfig, params, tokens, targets,
+            mask: Optional[jax.Array] = None,
+            z_loss: float = 1e-4) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    logits, aux = forward(cfg, params, tokens)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    true_logit = jnp.take_along_axis(logits, targets[..., None], -1).squeeze(-1)
+    nll = logz - true_logit
+    if mask is None:
+        mask = jnp.ones_like(nll)
+    denom = jnp.maximum(mask.sum(), 1.0)
+    ce = (nll * mask).sum() / denom
+    zl = z_loss * ((logz ** 2) * mask).sum() / denom
+    total = ce + zl + aux["load_balance"] + aux["router_z"]
+    metrics = {"loss": ce, "total_loss": total,
+               "aux_load_balance": aux["load_balance"],
+               "aux_router_z": aux["router_z"],
+               "accuracy": ((logits.argmax(-1) == targets) * mask).sum() / denom}
+    return total, metrics

@@ -50,15 +50,7 @@ class VolcanoAdapter:
             },
             "status": {},
         }
-        cur = self.store.try_get("PodGroup", pg["metadata"]["name"], ns)
-        if cur is None:
-            try:
-                self.store.create(pg)
-            except AlreadyExists:
-                pass
-        elif cur["spec"] != pg["spec"]:
-            cur["spec"] = pg["spec"]
-            self.store.update(cur)
+        self.store.ensure(pg)
         return True   # volcano admits asynchronously via the PodGroup
 
     def on_job_submission(self, job: Dict[str, Any]) -> bool:

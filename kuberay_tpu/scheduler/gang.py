@@ -58,15 +58,7 @@ class GangScheduler:
             },
             "status": {},
         }
-        cur = self.store.try_get("PodGroup", name, ns)
-        if cur is None:
-            try:
-                self.store.create(pg)
-            except AlreadyExists:
-                pass
-        elif cur["spec"] != pg["spec"]:
-            cur["spec"] = pg["spec"]
-            self.store.update(cur)
+        self.store.ensure(pg)
         return demand
 
     def on_cluster_submission(self, cluster: Dict[str, Any]) -> bool:

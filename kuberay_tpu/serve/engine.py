@@ -1,0 +1,223 @@
+"""Continuous-batching inference engine.
+
+The serving payload a TpuService runs (BASELINE config #4: continuous
+batching on v5e-16) — the role Ray Serve + vLLM play for the reference,
+built TPU-first:
+
+- fixed slot count + static-shape KV cache: exactly two compiled programs
+  (prefill, decode) regardless of traffic;
+- continuous batching: new requests prefill into free slots while existing
+  slots keep decoding; no generation stalls behind a long prompt;
+- prompt-length bucketing bounds prefill recompilation;
+- greedy or temperature sampling per request.
+
+Pure-Python scheduling around jitted steps: the host loop does bookkeeping
+only; every FLOP is inside jit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kuberay_tpu.models.llama import LlamaConfig
+from kuberay_tpu.serve.kv_cache import forward_with_cache, init_kv_cache
+
+
+@dataclasses.dataclass
+class Request:
+    request_id: str
+    prompt_tokens: List[int]
+    max_new_tokens: int = 64
+    temperature: float = 0.0          # 0 = greedy
+    eos_token: Optional[int] = None
+
+
+@dataclasses.dataclass
+class Response:
+    request_id: str
+    tokens: List[int]                 # generated tokens (no prompt)
+    finish_reason: str = "length"     # length | eos | cancelled
+    prompt_len: int = 0
+    created: float = 0.0
+
+
+def _bucket(n: int, buckets=(32, 64, 128, 256, 512, 1024, 2048)) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    return buckets[-1]
+
+
+class ServeEngine:
+    def __init__(self, cfg: LlamaConfig, params: Dict[str, Any],
+                 max_slots: int = 8, max_len: int = 2048,
+                 rng_seed: int = 0):
+        self.cfg = cfg
+        self.params = params
+        self.max_slots = max_slots
+        self.max_len = max_len
+        self.cache = init_kv_cache(cfg, max_slots, max_len)
+        self.key = jax.random.PRNGKey(rng_seed)
+
+        # Slot bookkeeping (host side).
+        self.lens = np.zeros(max_slots, dtype=np.int32)       # cache length
+        self.active: List[Optional[Request]] = [None] * max_slots
+        self.generated: List[List[int]] = [[] for _ in range(max_slots)]
+        self.budget = np.zeros(max_slots, dtype=np.int32)
+        self.queue: List[Request] = []
+        self._finished: List[Response] = []
+
+        self._prefill = jax.jit(self._prefill_impl,
+                                static_argnames=("prompt_len",),
+                                donate_argnames=("cache",))
+        self._decode = jax.jit(self._decode_impl, donate_argnames=("cache",))
+
+    # ------------------------------------------------------------------
+    # jitted kernels
+    # ------------------------------------------------------------------
+
+    def _prefill_impl(self, params, cache, tokens, slot, real_len, key,
+                      temperature, prompt_len):
+        """Prefill one request into one slot.  tokens: [prompt_len] padded."""
+        B = self.max_slots
+        row = jnp.zeros((B, prompt_len), dtype=jnp.int32).at[slot].set(tokens)
+        start = jnp.zeros((B,), jnp.int32)
+        # Only the target slot's cache row may be written — other slots are
+        # mid-decode and their caches must be untouched.
+        write_mask = jax.nn.one_hot(slot, B, dtype=jnp.float32)
+        logits, new_cache = forward_with_cache(
+            self.cfg, params, row, cache, start, write_mask)
+        last = logits[slot, real_len - 1]                     # [V]
+        tok = self._sample(last, key, temperature)
+        return tok, new_cache
+
+    def _decode_impl(self, params, cache, tokens, lens, key, temperatures,
+                     active_mask):
+        """One decode step for every active slot.  tokens: [slots]."""
+        logits, new_cache = forward_with_cache(
+            self.cfg, params, tokens[:, None], cache, lens, active_mask)
+        keys = jax.random.split(key, self.max_slots)
+        toks = jax.vmap(self._sample)(logits[:, 0], keys, temperatures)
+        return toks, new_cache
+
+    @staticmethod
+    def _sample(logits, key, temperature):
+        greedy = jnp.argmax(logits, -1).astype(jnp.int32)
+        scaled = logits / jnp.maximum(temperature, 1e-6)
+        sampled = jax.random.categorical(key, scaled).astype(jnp.int32)
+        return jnp.where(temperature <= 0.0, greedy, sampled)
+
+    # ------------------------------------------------------------------
+    # public API
+    # ------------------------------------------------------------------
+
+    def add_request(self, req: Request) -> None:
+        if len(req.prompt_tokens) >= self.max_len:
+            self._finished.append(Response(
+                req.request_id, [], "cancelled",
+                prompt_len=len(req.prompt_tokens), created=time.time()))
+            return
+        self.queue.append(req)
+
+    @property
+    def num_active(self) -> int:
+        return sum(1 for r in self.active if r is not None)
+
+    def has_work(self) -> bool:
+        return bool(self.queue) or self.num_active > 0
+
+    def step(self) -> List[Response]:
+        """One engine iteration: admit one request (prefill) if possible,
+        then decode all active slots.  Returns finished responses."""
+        # Admission: continuous batching — a free slot + queued request.
+        if self.queue:
+            free = next((i for i, r in enumerate(self.active) if r is None),
+                        None)
+            if free is not None:
+                req = self.queue.pop(0)
+                self._admit(req, free)
+
+        if self.num_active:
+            self._decode_all()
+
+        out, self._finished = self._finished, []
+        return out
+
+    def run(self, max_steps: int = 10_000) -> List[Response]:
+        """Drain: run until all queued + active requests finish."""
+        out: List[Response] = list(self._finished)   # e.g. cancelled on add
+        self._finished = []
+        for _ in range(max_steps):
+            if not self.has_work():
+                break
+            out.extend(self.step())
+        return out
+
+    # ------------------------------------------------------------------
+
+    def _admit(self, req: Request, slot: int):
+        plen = len(req.prompt_tokens)
+        bucket = _bucket(plen)
+        padded = np.zeros(bucket, dtype=np.int32)
+        padded[:plen] = req.prompt_tokens
+        self.key, sub = jax.random.split(self.key)
+        tok, self.cache = self._prefill(
+            self.params, self.cache, jnp.asarray(padded),
+            jnp.int32(slot), jnp.int32(plen), sub,
+            jnp.float32(req.temperature), prompt_len=bucket)
+        # Cache now contains bucket tokens for the slot; only plen are real.
+        self.lens[slot] = plen
+        self.active[slot] = req
+        self.generated[slot] = [int(tok)]
+        self.budget[slot] = req.max_new_tokens - 1
+        self._maybe_finish(slot)
+
+    def _decode_all(self):
+        last = np.zeros(self.max_slots, dtype=np.int32)
+        temps = np.zeros(self.max_slots, dtype=np.float32)
+        mask = np.zeros(self.max_slots, dtype=np.float32)
+        for i, req in enumerate(self.active):
+            if req is not None and self.generated[i]:
+                last[i] = self.generated[i][-1]
+                temps[i] = req.temperature
+                mask[i] = 1.0
+        self.key, sub = jax.random.split(self.key)
+        toks, self.cache = self._decode(
+            self.params, self.cache, jnp.asarray(last),
+            jnp.asarray(self.lens), sub, jnp.asarray(temps),
+            jnp.asarray(mask))
+        toks = np.asarray(toks)
+        for i, req in enumerate(self.active):
+            if req is None:
+                continue
+            self.lens[i] += 1
+            self.generated[i].append(int(toks[i]))
+            self.budget[i] -= 1
+            self._maybe_finish(i)
+
+    def _maybe_finish(self, slot: int):
+        req = self.active[slot]
+        if req is None:
+            return
+        gen = self.generated[slot]
+        reason = None
+        if req.eos_token is not None and gen and gen[-1] == req.eos_token:
+            reason = "eos"
+        elif self.budget[slot] <= 0:
+            reason = "length"
+        elif self.lens[slot] + 1 >= self.max_len:
+            reason = "length"
+        if reason:
+            self._finished.append(Response(
+                req.request_id, list(gen), reason,
+                prompt_len=len(req.prompt_tokens), created=time.time()))
+            self.active[slot] = None
+            self.generated[slot] = []
+            self.lens[slot] = 0

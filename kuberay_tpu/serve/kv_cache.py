@@ -1,0 +1,103 @@
+"""KV-cache inference path for the Llama family.
+
+Static-shape cache ([layers, slots, max_len, kv_heads, head_dim]) so every
+prefill/decode step compiles once and stays on the MXU; per-slot lengths
+drive masking (no dynamic shapes under jit).  Slot-granular updates let a
+continuous-batching engine admit/evict requests without touching other
+slots.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from kuberay_tpu.models.llama import LlamaConfig
+from kuberay_tpu.ops.rmsnorm import rmsnorm
+from kuberay_tpu.ops.rope import apply_rope, rope_frequencies
+
+_NEG_INF = -1e30
+
+
+def init_kv_cache(cfg: LlamaConfig, slots: int, max_len: int) -> Dict[str, jax.Array]:
+    shape = (cfg.n_layers, slots, max_len, cfg.n_kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
+
+
+def _cached_attention(q, ck, cv, lens, q_positions):
+    """q: [B, T, Hq, D] new queries; ck/cv: [B, max, Hkv, D] cache (already
+    containing the new tokens); lens: [B] valid lengths AFTER insertion;
+    q_positions: [B, T] absolute positions of the queries."""
+    B, T, Hq, D = q.shape
+    Hkv = ck.shape[2]
+    group = Hq // Hkv
+    if group > 1:
+        ck = jnp.repeat(ck, group, axis=2)
+        cv = jnp.repeat(cv, group, axis=2)
+    s = jnp.einsum("bthd,bkhd->bhtk", q, ck,
+                   preferred_element_type=jnp.float32) / (D ** 0.5)
+    cols = jnp.arange(ck.shape[1])[None, None, :]               # [1,1,max]
+    mask = (cols <= q_positions[:, :, None]) & \
+        (cols < lens[:, None, None])                            # [B,T,max]
+    s = jnp.where(mask[:, None, :, :], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhtk,bkhd->bthd", p.astype(cv.dtype), cv,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
+def forward_with_cache(cfg: LlamaConfig, params: Dict[str, Any],
+                       tokens: jax.Array, cache: Dict[str, jax.Array],
+                       start: jax.Array,
+                       write_mask: jax.Array = None
+                       ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Run T new tokens through the model against the cache.
+
+    tokens: [B, T] (right-padded; positions beyond a slot's real length are
+    masked out by the caller's sampling); start: [B] number of tokens
+    already in each slot's cache; write_mask: [B] 1.0 for rows whose cache
+    may be written (prefill targets ONE slot — without the mask every row
+    would scatter into positions start..start+T and corrupt its neighbors).
+    Returns (logits [B, T, V], new cache).
+    """
+    B, T = tokens.shape
+    positions = start[:, None] + jnp.arange(T)[None, :]          # [B, T]
+    x = jnp.take(params["embed"], tokens, axis=0)
+    cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+    lens = start + T
+    if write_mask is None:
+        write_mask = jnp.ones((B,), jnp.float32)
+
+    def layer_fn(x, layer_in):
+        lp, ck, cv = layer_in
+        h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+        q = (h @ lp["wq"]).reshape(B, T, cfg.n_heads, cfg.head_dim)
+        kk = (h @ lp["wk"]).reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
+        vv = (h @ lp["wv"]).reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
+        q = apply_rope(q, cos, sin, positions)
+        kk = apply_rope(kk, cos, sin, positions)
+        # Insert new K/V at each slot's offset (per-row dynamic slice via
+        # one-hot scatter keeps shapes static); masked rows write nothing.
+        slot_ids = positions                                   # [B, T]
+        onehot = (jax.nn.one_hot(slot_ids, ck.shape[1], dtype=ck.dtype)
+                  * write_mask[:, None, None].astype(ck.dtype))  # [B,T,max]
+        ck = ck * (1 - onehot.sum(1)[..., None, None]) + \
+            jnp.einsum("btm,bthd->bmhd", onehot, kk)
+        cv = cv * (1 - onehot.sum(1)[..., None, None]) + \
+            jnp.einsum("btm,bthd->bmhd", onehot, vv)
+        attn = _cached_attention(q, ck, cv, lens, positions)
+        x = x + (attn.reshape(B, T, -1) @ lp["wo"]).astype(x.dtype)
+        h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
+        gated = jax.nn.silu(h @ lp["w_gate"]) * (h @ lp["w_up"])
+        x = x + (gated @ lp["w_down"]).astype(x.dtype)
+        return x, (ck, cv)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer_fn, x, (params["layers"], cache["k"], cache["v"]))
+    x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("btd,dv->btv", x, head,
+                        preferred_element_type=jnp.float32)
+    return logits, {"k": new_k, "v": new_v}
