@@ -1,0 +1,1 @@
+"""HTTP API gateway over the control plane (SURVEY.md §2.2 L5)."""

@@ -1,0 +1,246 @@
+"""REST API server: K8s-style resource endpoints over the object store.
+
+The apiserversdk ("V2") approach from the reference (apiserversdk/proxy.go:28:
+expose native K8s-REST semantics for the CRDs rather than invent a bespoke
+RPC schema) — clients use standard list/get/create/update/delete verbs:
+
+    GET/POST   /apis/tpu.dev/v1/namespaces/{ns}/{plural}
+    GET/PUT/DELETE /apis/tpu.dev/v1/namespaces/{ns}/{plural}/{name}
+    PUT        /apis/tpu.dev/v1/namespaces/{ns}/{plural}/{name}/status
+    GET        /api/v1/namespaces/{ns}/{pods|services|events}
+    GET        /metrics | /healthz | /readyz
+
+Serves the in-memory store directly when embedded with the operator; the
+same handler shape can front a real K8s API by swapping the store.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from kuberay_tpu.controlplane.store import (
+    AlreadyExists,
+    Conflict,
+    Invalid,
+    NotFound,
+    ObjectStore,
+)
+from kuberay_tpu.utils import constants as C
+from kuberay_tpu.utils.validation import (
+    validate_cluster,
+    validate_cronjob,
+    validate_job,
+    validate_service,
+)
+from kuberay_tpu.api.tpucluster import TpuCluster
+from kuberay_tpu.api.tpucronjob import TpuCronJob
+from kuberay_tpu.api.tpujob import TpuJob
+from kuberay_tpu.api.tpuservice import TpuService
+
+PLURALS = {
+    "tpuclusters": C.KIND_CLUSTER,
+    "tpujobs": C.KIND_JOB,
+    "tpuservices": C.KIND_SERVICE,
+    "tpucronjobs": C.KIND_CRONJOB,
+}
+CORE_PLURALS = {"pods": "Pod", "services": "Service", "events": "Event",
+                "podgroups": "PodGroup", "networkpolicies": "NetworkPolicy",
+                "jobs": "Job"}
+
+_VALIDATORS = {
+    C.KIND_CLUSTER: lambda d: validate_cluster(TpuCluster.from_dict(d)),
+    C.KIND_JOB: lambda d: validate_job(TpuJob.from_dict(d)),
+    C.KIND_SERVICE: lambda d: validate_service(TpuService.from_dict(d)),
+    C.KIND_CRONJOB: lambda d: validate_cronjob(TpuCronJob.from_dict(d)),
+}
+
+_CRD_RE = re.compile(
+    r"^/apis/tpu\.dev/v1/namespaces/(?P<ns>[^/]+)/(?P<plural>[^/]+)"
+    r"(/(?P<name>[^/]+))?(/(?P<sub>status))?$")
+_CORE_RE = re.compile(
+    r"^/api/v1/namespaces/(?P<ns>[^/]+)/(?P<plural>[^/]+)(/(?P<name>[^/]+))?$")
+
+
+class ApiHandler(BaseHTTPRequestHandler):
+    store: ObjectStore = None           # injected by make_server
+    metrics = None
+    protocol_version = "HTTP/1.1"
+
+    # -- plumbing ----------------------------------------------------------
+
+    def log_message(self, fmt, *args):   # quiet by default
+        pass
+
+    def _send(self, code: int, body: Any = None):
+        data = (json.dumps(body).encode() if body is not None else b"")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _send_text(self, code: int, text: str, ctype="text/plain"):
+        data = text.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _error(self, code: int, message: str):
+        self._send(code, {"kind": "Status", "status": "Failure",
+                          "code": code, "message": message})
+
+    def _body(self) -> Dict[str, Any]:
+        n = int(self.headers.get("Content-Length", 0))
+        raw = self.rfile.read(n) if n else b"{}"
+        return json.loads(raw or b"{}")
+
+    def _route(self) -> Optional[Tuple[str, str, Optional[str], Optional[str]]]:
+        path = urlparse(self.path).path
+        m = _CRD_RE.match(path)
+        if m and m.group("plural") in PLURALS:
+            return (PLURALS[m.group("plural")], m.group("ns"),
+                    m.group("name"), m.group("sub"))
+        m = _CORE_RE.match(path)
+        if m and m.group("plural") in CORE_PLURALS:
+            return (CORE_PLURALS[m.group("plural")], m.group("ns"),
+                    m.group("name"), None)
+        return None
+
+    def _label_selector(self) -> Optional[Dict[str, str]]:
+        q = parse_qs(urlparse(self.path).query)
+        sel = q.get("labelSelector", [None])[0]
+        if not sel:
+            return None
+        out = {}
+        for part in sel.split(","):
+            if "=" in part:
+                k, v = part.split("=", 1)
+                out[k.strip()] = v.strip()
+        return out
+
+    # -- verbs -------------------------------------------------------------
+
+    def do_GET(self):
+        path = urlparse(self.path).path
+        if path == "/healthz" or path == "/readyz":
+            return self._send_text(200, "ok")
+        if path == "/metrics":
+            text = self.metrics.render() if self.metrics else ""
+            return self._send_text(200, text, "text/plain; version=0.0.4")
+        route = self._route()
+        if route is None:
+            return self._error(404, f"unknown path {path}")
+        kind, ns, name, _ = route
+        if name:
+            obj = self.store.try_get(kind, name, ns)
+            if obj is None:
+                return self._error(404, f"{kind} {ns}/{name} not found")
+            return self._send(200, obj)
+        items = self.store.list(kind, ns, labels=self._label_selector())
+        return self._send(200, {"kind": f"{kind}List", "items": items})
+
+    def do_POST(self):
+        route = self._route()
+        if route is None:
+            return self._error(404, "unknown path")
+        kind, ns, name, _ = route
+        if name:
+            return self._error(405, "POST to a named resource")
+        try:
+            obj = self._body()
+        except json.JSONDecodeError as e:
+            return self._error(400, f"bad JSON: {e}")
+        obj.setdefault("kind", kind)
+        obj.setdefault("apiVersion", C.API_VERSION)
+        obj.setdefault("metadata", {}).setdefault("namespace", ns)
+        if obj["kind"] != kind:
+            return self._error(400, f"kind mismatch: {obj['kind']} != {kind}")
+        validator = _VALIDATORS.get(kind)
+        if validator:
+            errs = validator(obj)
+            if errs:
+                return self._error(422, "; ".join(errs))
+        try:
+            created = self.store.create(obj)
+        except AlreadyExists as e:
+            return self._error(409, str(e))
+        except Invalid as e:
+            return self._error(400, str(e))
+        return self._send(201, created)
+
+    def do_PUT(self):
+        route = self._route()
+        if route is None:
+            return self._error(404, "unknown path")
+        kind, ns, name, sub = route
+        if not name:
+            return self._error(405, "PUT requires a resource name")
+        try:
+            obj = self._body()
+        except json.JSONDecodeError as e:
+            return self._error(400, f"bad JSON: {e}")
+        obj.setdefault("kind", kind)
+        obj.setdefault("metadata", {}).setdefault("namespace", ns)
+        obj["metadata"].setdefault("name", name)
+        # The path is authoritative: a body naming a different kind/name/ns
+        # must not silently mutate another object.
+        if obj["kind"] != kind:
+            return self._error(400, f"kind mismatch: {obj['kind']} != {kind}")
+        if obj["metadata"]["name"] != name:
+            return self._error(
+                400, f"name mismatch: {obj['metadata']['name']} != {name}")
+        if obj["metadata"].get("namespace", ns) != ns:
+            return self._error(400, "namespace mismatch with path")
+        if sub != "status":
+            validator = _VALIDATORS.get(kind)
+            if validator:
+                errs = validator(obj)
+                if errs:
+                    return self._error(422, "; ".join(errs))
+        try:
+            if sub == "status":
+                out = self.store.update_status(obj)
+            else:
+                out = self.store.update(obj)
+        except NotFound as e:
+            return self._error(404, str(e))
+        except Conflict as e:
+            return self._error(409, str(e))
+        return self._send(200, out)
+
+    def do_DELETE(self):
+        route = self._route()
+        if route is None:
+            return self._error(404, "unknown path")
+        kind, ns, name, _ = route
+        if not name:
+            return self._error(405, "DELETE requires a resource name")
+        try:
+            self.store.delete(kind, name, ns)
+        except NotFound as e:
+            return self._error(404, str(e))
+        return self._send(200, {"kind": "Status", "status": "Success"})
+
+
+def make_server(store: ObjectStore, host: str = "127.0.0.1", port: int = 0,
+                metrics=None) -> ThreadingHTTPServer:
+    handler = type("BoundApiHandler", (ApiHandler,),
+                   {"store": store, "metrics": metrics})
+    return ThreadingHTTPServer((host, port), handler)
+
+
+def serve_background(store: ObjectStore, host: str = "127.0.0.1",
+                     port: int = 0, metrics=None):
+    """Start in a daemon thread; returns (server, base_url)."""
+    srv = make_server(store, host, port, metrics)
+    t = threading.Thread(target=srv.serve_forever, daemon=True,
+                         name="tpu-apiserver")
+    t.start()
+    return srv, f"http://{srv.server_address[0]}:{srv.server_address[1]}"

@@ -1,0 +1,297 @@
+"""tpuctl: the kubectl-plugin analogue (ref kubectl-plugin/pkg/cmd/ray.go:46-53).
+
+Subcommands mirror `kubectl ray` with TPU flags first-class
+(generation.go:150-232 TPU resource/node-selector handling is native here):
+
+    tpuctl get clusters|jobs|services|slices|events
+    tpuctl create cluster NAME --tpu v5p --topology 4x4x4 --slices 2 ...
+    tpuctl scale NAME --group G --replicas N
+    tpuctl submit NAME --tpu ... -- python -m train ...
+    tpuctl suspend|resume (cluster|job) NAME
+    tpuctl delete (cluster|job|service) NAME
+    tpuctl status (cluster|job|service) NAME
+
+Usage: python -m kuberay_tpu.cli <subcommand> [...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Any, Dict, List
+
+from kuberay_tpu.cli.client import ApiClient, ApiError
+from kuberay_tpu.topology import SliceTopology, TopologyError
+from kuberay_tpu.utils import constants as C
+
+KIND_BY_ALIAS = {
+    "cluster": "TpuCluster", "clusters": "TpuCluster",
+    "job": "TpuJob", "jobs": "TpuJob",
+    "service": "TpuService", "services": "TpuService",
+    "cronjob": "TpuCronJob", "cronjobs": "TpuCronJob",
+    "events": "Event", "pods": "Pod", "slices": "Pod",
+}
+
+
+def _table(rows: List[List[str]], headers: List[str]) -> str:
+    widths = [max(len(str(r[i])) for r in [headers] + rows)
+              for i in range(len(headers))]
+    fmt = "  ".join(f"{{:<{w}}}" for w in widths)
+    out = [fmt.format(*headers)]
+    out += [fmt.format(*[str(c) for c in r]) for r in rows]
+    return "\n".join(out)
+
+
+def _cluster_rows(items):
+    rows = []
+    for c in items:
+        st = c.get("status", {})
+        rows.append([
+            c["metadata"]["name"],
+            st.get("state", "") or "provisioning",
+            f"{st.get('readySlices', 0)}/{st.get('desiredSlices', 0)}",
+            f"{st.get('readyWorkerHosts', 0)}/{st.get('desiredWorkerHosts', 0)}",
+            st.get("desiredTpuChips", 0),
+        ])
+    return _table(rows, ["NAME", "STATE", "SLICES", "HOSTS", "TPU-CHIPS"])
+
+
+def _job_rows(items):
+    rows = []
+    for j in items:
+        st = j.get("status", {})
+        rows.append([
+            j["metadata"]["name"],
+            st.get("jobDeploymentStatus", ""),
+            st.get("jobStatus", ""),
+            st.get("clusterName", ""),
+            int(st.get("failed", 0)),
+        ])
+    return _table(rows, ["NAME", "DEPLOYMENT", "JOB", "CLUSTER", "RETRIES"])
+
+
+def _slice_rows(items):
+    by_slice: Dict[str, List[dict]] = {}
+    for p in items:
+        sname = p["metadata"]["labels"].get(C.LABEL_SLICE_NAME)
+        if sname:
+            by_slice.setdefault(sname, []).append(p)
+    rows = []
+    for sname, pods in sorted(by_slice.items()):
+        phases = [p.get("status", {}).get("phase", "Pending") for p in pods]
+        ready = sum(1 for ph in phases if ph == "Running")
+        rows.append([sname,
+                     pods[0]["metadata"]["labels"].get(C.LABEL_CLUSTER, ""),
+                     pods[0]["metadata"]["labels"].get(C.LABEL_GROUP, ""),
+                     f"{ready}/{len(pods)}"])
+    return _table(rows, ["SLICE", "CLUSTER", "GROUP", "HOSTS-READY"])
+
+
+def build_cluster_manifest(args) -> Dict[str, Any]:
+    topo = SliceTopology.create(args.tpu, args.topology)  # validates early
+    worker = {
+        "groupName": args.group,
+        "accelerator": args.tpu,
+        "topology": args.topology,
+        "replicas": args.slices,
+        "minReplicas": args.min_slices if args.min_slices is not None else 0,
+        "maxReplicas": args.max_slices or max(args.slices, 1),
+        "template": {"spec": {"containers": [
+            {"name": "worker", "image": args.image,
+             "resources": {"requests": {"cpu": args.worker_cpu,
+                                        "memory": args.worker_memory}}}]}},
+    }
+    spec = {
+        "headGroupSpec": {"template": {"spec": {"containers": [
+            {"name": "head", "image": args.image}]}}},
+        "workerGroupSpecs": [worker],
+    }
+    if args.autoscale:
+        spec["enableInTreeAutoscaling"] = True
+    return {
+        "apiVersion": C.API_VERSION, "kind": C.KIND_CLUSTER,
+        "metadata": {"name": args.name, "namespace": args.namespace},
+        "spec": spec,
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="tpuctl",
+                                 description="TPU pod-slice orchestration CLI")
+    ap.add_argument("--server", default="http://127.0.0.1:8765")
+    ap.add_argument("-n", "--namespace", default="default")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    g = sub.add_parser("get", help="list resources")
+    g.add_argument("resource", choices=sorted(KIND_BY_ALIAS))
+    g.add_argument("-l", "--selector", default="")
+
+    st = sub.add_parser("status", help="full status of one resource")
+    st.add_argument("resource", choices=["cluster", "job", "service", "cronjob"])
+    st.add_argument("name")
+
+    cc = sub.add_parser("create", help="create a cluster")
+    cc.add_argument("what", choices=["cluster"])
+    cc.add_argument("name")
+    cc.add_argument("--tpu", default="v5e", help="TPU generation (v4/v5e/v5p/v6e)")
+    cc.add_argument("--topology", default="2x2", help="ICI topology, e.g. 4x4x4")
+    cc.add_argument("--slices", type=int, default=1)
+    cc.add_argument("--min-slices", type=int, default=None)
+    cc.add_argument("--max-slices", type=int, default=None)
+    cc.add_argument("--group", default="workers")
+    cc.add_argument("--image", default="kuberay-tpu/runtime:latest")
+    cc.add_argument("--worker-cpu", default="8")
+    cc.add_argument("--worker-memory", default="16Gi")
+    cc.add_argument("--autoscale", action="store_true")
+
+    sc = sub.add_parser("scale", help="scale a worker group (slice units)")
+    sc.add_argument("name")
+    sc.add_argument("--group", default=None)
+    sc.add_argument("--replicas", type=int, required=True)
+
+    sj = sub.add_parser("submit", help="submit a TpuJob")
+    sj.add_argument("name")
+    sj.add_argument("--tpu", default="v5e")
+    sj.add_argument("--topology", default="2x2")
+    sj.add_argument("--slices", type=int, default=1)
+    sj.add_argument("--image", default="kuberay-tpu/runtime:latest")
+    sj.add_argument("--mode", default="K8sJobMode",
+                    choices=["K8sJobMode", "HTTPMode", "SidecarMode",
+                             "InteractiveMode"])
+    sj.add_argument("--backoff-limit", type=int, default=0)
+    sj.add_argument("--shutdown-after-finish", action="store_true")
+    sj.add_argument("--wait", action="store_true",
+                    help="poll until the job reaches a terminal state")
+    # Entrypoint is everything after a literal "--" (split before argparse;
+    # REMAINDER would swallow flags that precede it).
+
+    for name in ("suspend", "resume"):
+        sp = sub.add_parser(name)
+        sp.add_argument("resource", choices=["cluster", "job"])
+        sp.add_argument("name")
+
+    dl = sub.add_parser("delete")
+    dl.add_argument("resource", choices=["cluster", "job", "service", "cronjob"])
+    dl.add_argument("name")
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    entry: List[str] = []
+    if "--" in argv:
+        split = argv.index("--")
+        argv, entry = argv[:split], argv[split + 1:]
+    args = ap.parse_args(argv)
+    args.entrypoint = entry
+    client = ApiClient(args.server)
+
+    try:
+        return _dispatch(args, client)
+    except ApiError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    except TopologyError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+
+def _dispatch(args, client: ApiClient) -> int:
+    ns = args.namespace
+    if args.cmd == "get":
+        kind = KIND_BY_ALIAS[args.resource]
+        items = client.list(kind, ns, getattr(args, "selector", ""))
+        if args.resource == "slices":
+            print(_slice_rows(items))
+        elif kind == "TpuCluster":
+            print(_cluster_rows(items))
+        elif kind == "TpuJob":
+            print(_job_rows(items))
+        else:
+            rows = [[i["metadata"]["name"],
+                     i.get("status", {}).get("serviceStatus",
+                                             i.get("reason", ""))]
+                    for i in items]
+            print(_table(rows, ["NAME", "STATUS"]))
+        return 0
+
+    if args.cmd == "status":
+        obj = client.get(KIND_BY_ALIAS[args.resource], args.name, ns)
+        print(json.dumps(obj.get("status", {}), indent=2, default=str))
+        return 0
+
+    if args.cmd == "create":
+        obj = client.create(build_cluster_manifest(args))
+        print(f"tpucluster/{obj['metadata']['name']} created")
+        return 0
+
+    if args.cmd == "scale":
+        obj = client.get(C.KIND_CLUSTER, args.name, ns)
+        groups = obj["spec"]["workerGroupSpecs"]
+        target = None
+        for g in groups:
+            if args.group in (None, g["groupName"]):
+                target = g
+                break
+        if target is None:
+            print(f"error: group {args.group!r} not found", file=sys.stderr)
+            return 1
+        target["replicas"] = args.replicas
+        target["maxReplicas"] = max(target.get("maxReplicas", 0), args.replicas)
+        client.update(obj)
+        print(f"tpucluster/{args.name} group {target['groupName']} "
+              f"scaled to {args.replicas} slices")
+        return 0
+
+    if args.cmd == "submit":
+        entry = args.entrypoint
+        if not entry and args.mode != "InteractiveMode":
+            print("error: entrypoint required (after --)", file=sys.stderr)
+            return 1
+        job = {
+            "apiVersion": C.API_VERSION, "kind": C.KIND_JOB,
+            "metadata": {"name": args.name, "namespace": ns},
+            "spec": {
+                "entrypoint": " ".join(entry),
+                "submissionMode": args.mode,
+                "backoffLimit": args.backoff_limit,
+                "shutdownAfterJobFinishes": args.shutdown_after_finish,
+                "clusterSpec": build_cluster_manifest(argparse.Namespace(
+                    name=args.name, namespace=ns, tpu=args.tpu,
+                    topology=args.topology, slices=args.slices,
+                    min_slices=None, max_slices=None, group="workers",
+                    image=args.image, worker_cpu="8", worker_memory="16Gi",
+                    autoscale=False))["spec"],
+            },
+        }
+        client.create(job)
+        print(f"tpujob/{args.name} submitted")
+        if args.wait:
+            while True:
+                st = client.get(C.KIND_JOB, args.name, ns).get("status", {})
+                state = st.get("jobDeploymentStatus", "")
+                if state in ("Complete", "Failed", "Suspended"):
+                    print(f"tpujob/{args.name}: {state} "
+                          f"({st.get('jobStatus', '')})")
+                    return 0 if state == "Complete" else 2
+                time.sleep(1.0)
+        return 0
+
+    if args.cmd in ("suspend", "resume"):
+        kind = KIND_BY_ALIAS[args.resource]
+        obj = client.get(kind, args.name, ns)
+        obj["spec"]["suspend"] = args.cmd == "suspend"
+        if args.cmd == "suspend" and kind == C.KIND_JOB:
+            obj["spec"]["shutdownAfterJobFinishes"] = True
+        client.update(obj)
+        print(f"{args.resource}/{args.name} {args.cmd}{'ed' if args.cmd == 'suspend' else 'd'}")
+        return 0
+
+    if args.cmd == "delete":
+        client.delete(KIND_BY_ALIAS[args.resource], args.name, ns)
+        print(f"{args.resource}/{args.name} deleted")
+        return 0
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,93 @@
+"""HTTP client for the apiserver (the python-client analogue,
+ref clients/python-client: RayClusterApi over the K8s API)."""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+PLURAL = {
+    "TpuCluster": "tpuclusters",
+    "TpuJob": "tpujobs",
+    "TpuService": "tpuservices",
+    "TpuCronJob": "tpucronjobs",
+    "Pod": "pods",
+    "Service": "services",
+    "Event": "events",
+}
+
+
+class ApiError(Exception):
+    def __init__(self, code: int, message: str):
+        super().__init__(f"HTTP {code}: {message}")
+        self.code = code
+
+
+class ApiClient:
+    def __init__(self, base_url: str = "http://127.0.0.1:8765",
+                 timeout: float = 10.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+
+    def _path(self, kind: str, ns: str, name: str = "") -> str:
+        plural = PLURAL[kind]
+        if kind in ("Pod", "Service", "Event"):
+            base = f"/api/v1/namespaces/{ns}/{plural}"
+        else:
+            base = f"/apis/tpu.dev/v1/namespaces/{ns}/{plural}"
+        return base + (f"/{name}" if name else "")
+
+    def _req(self, method: str, path: str, body: Optional[dict] = None):
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(self.base_url + path, data=data,
+                                     method=method,
+                                     headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                payload = resp.read()
+                if not payload:
+                    return {}
+                try:
+                    return json.loads(payload)
+                except json.JSONDecodeError:
+                    return {"raw": payload.decode(errors="replace")}
+        except urllib.error.HTTPError as e:
+            try:
+                msg = json.loads(e.read()).get("message", str(e))
+            except Exception:
+                msg = str(e)
+            raise ApiError(e.code, msg) from None
+
+    def list(self, kind: str, namespace: str = "default",
+             label_selector: str = "") -> List[Dict[str, Any]]:
+        path = self._path(kind, namespace)
+        if label_selector:
+            path += f"?labelSelector={label_selector}"
+        return self._req("GET", path).get("items", [])
+
+    def get(self, kind: str, name: str, namespace: str = "default"):
+        return self._req("GET", self._path(kind, namespace, name))
+
+    def create(self, obj: Dict[str, Any]):
+        md = obj.get("metadata", {})
+        return self._req("POST", self._path(obj["kind"],
+                                            md.get("namespace", "default")),
+                         obj)
+
+    def update(self, obj: Dict[str, Any]):
+        md = obj["metadata"]
+        return self._req("PUT", self._path(obj["kind"],
+                                           md.get("namespace", "default"),
+                                           md["name"]), obj)
+
+    def delete(self, kind: str, name: str, namespace: str = "default"):
+        return self._req("DELETE", self._path(kind, namespace, name))
+
+    def healthy(self) -> bool:
+        try:
+            self._req("GET", "/healthz")
+            return True
+        except Exception:
+            return False

@@ -191,9 +191,24 @@ class SliceAutoscaler:
             out.append(SliceInfo(sname, group, ready, idle))
         return out
 
+    def forget_cluster(self, namespace: str, cluster_name: str):
+        """Drop idle bookkeeping for a deleted cluster so a recreated
+        same-name cluster doesn't inherit stale idle clocks."""
+        for key in [k for k in self._idle_since
+                    if k[0] == namespace and k[1] == cluster_name]:
+            del self._idle_since[key]
+
+    def prune_clusters(self, live: set):
+        """Keep only bookkeeping for (ns, name) pairs in ``live``."""
+        for key in [k for k in self._idle_since if (k[0], k[1]) not in live]:
+            del self._idle_since[key]
+
     def reconcile(self, cluster_name: str, namespace: str = "default") -> bool:
         obj = self.store.try_get(C.KIND_CLUSTER, cluster_name, namespace)
-        if obj is None or not obj.get("spec", {}).get("enableInTreeAutoscaling"):
+        if obj is None:
+            self.forget_cluster(namespace, cluster_name)
+            return False
+        if not obj.get("spec", {}).get("enableInTreeAutoscaling"):
             return False
         cluster = TpuCluster.from_dict(obj)
         opts = cluster.spec.autoscalerOptions

@@ -1,0 +1,219 @@
+"""Operator entrypoint: flags/config -> wired controllers -> run loop.
+
+The main.go analogue (ref ray-operator/main.go:55: flag/config parse at
+:76-112, feature gates :188, controller registration :309-343).  Also the
+embedding API: ``Operator(...)`` with an in-memory store is a fully
+functional single-process control plane (used by tests, the CLI's demo
+mode, and the e2e harness).
+
+``python -m kuberay_tpu.operator --help`` for flags.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+import threading
+import time
+from typing import Optional
+
+from kuberay_tpu.api.config import OperatorConfiguration
+from kuberay_tpu.apiserver.server import serve_background
+from kuberay_tpu.controlplane.autoscaler import SliceAutoscaler
+from kuberay_tpu.controlplane.cluster_controller import TpuClusterController
+from kuberay_tpu.controlplane.cronjob_controller import TpuCronJobController
+from kuberay_tpu.controlplane.events import EventRecorder
+from kuberay_tpu.controlplane.fake_kubelet import FakeKubelet
+from kuberay_tpu.controlplane.job_controller import TpuJobController
+from kuberay_tpu.controlplane.manager import (
+    Manager,
+    originated_from_mapper,
+    owned_pod_mapper,
+)
+from kuberay_tpu.controlplane.networkpolicy_controller import NetworkPolicyController
+from kuberay_tpu.controlplane.service_controller import TpuServiceController
+from kuberay_tpu.controlplane.store import ObjectStore
+from kuberay_tpu.runtime.coordinator_client import default_client_provider
+from kuberay_tpu.scheduler.adapters import KaiAdapter, VolcanoAdapter, YuniKornAdapter
+from kuberay_tpu.scheduler.gang import GangScheduler
+from kuberay_tpu.scheduler.interface import SchedulerManager
+from kuberay_tpu.utils import constants as C
+from kuberay_tpu.utils import features
+from kuberay_tpu.utils.metrics import ControlPlaneMetrics
+
+
+class Operator:
+    def __init__(self, config: Optional[OperatorConfiguration] = None,
+                 store: Optional[ObjectStore] = None,
+                 client_provider=None,
+                 fake_kubelet: bool = False):
+        self.config = config or OperatorConfiguration()
+        features.set_gates(self.config.featureGates)
+        self.store = store or ObjectStore()
+        self.metrics = ControlPlaneMetrics()
+        self.recorder = EventRecorder(self.store)
+        self.manager = Manager(self.store)
+
+        self.schedulers = SchedulerManager()
+        self.schedulers.register(GangScheduler(self.store))
+        self.schedulers.register(VolcanoAdapter(self.store))
+        self.schedulers.register(YuniKornAdapter(self.store))
+        self.schedulers.register(KaiAdapter(self.store))
+        scheduler = (self.schedulers.get(self.config.batchScheduler)
+                     if self.config.enableBatchScheduler else None)
+
+        provider = client_provider
+        if provider is None:
+            provider = lambda status: default_client_provider(status)
+
+        self.cluster_controller = TpuClusterController(
+            self.store, expectations=self.manager.expectations,
+            recorder=self.recorder, scheduler=scheduler,
+            config_env=self.config.defaultPodEnv, metrics=self.metrics)
+        self.job_controller = TpuJobController(
+            self.store, recorder=self.recorder,
+            client_provider=provider,
+            scheduler=scheduler, metrics=self.metrics)
+        self.service_controller = TpuServiceController(
+            self.store, recorder=self.recorder,
+            client_provider=lambda cname, status: provider(status))
+        self.cronjob_controller = TpuCronJobController(
+            self.store, recorder=self.recorder)
+        self.networkpolicy_controller = NetworkPolicyController(self.store)
+        self.autoscaler = SliceAutoscaler(self.store)
+
+        m = self.manager
+        m.register(C.KIND_CLUSTER, self._timed(C.KIND_CLUSTER,
+                                               self.cluster_controller.reconcile))
+        m.register(C.KIND_JOB, self._timed(C.KIND_JOB,
+                                           self.job_controller.reconcile))
+        m.register(C.KIND_SERVICE, self._timed(C.KIND_SERVICE,
+                                               self.service_controller.reconcile))
+        if features.enabled("TpuCronJob"):
+            m.register(C.KIND_CRONJOB, self._timed(
+                C.KIND_CRONJOB, self.cronjob_controller.reconcile))
+        m.map_owned(owned_pod_mapper)
+        m.map_owned(originated_from_mapper(C.KIND_JOB))
+        m.map_owned(originated_from_mapper(C.KIND_SERVICE))
+        m.map_owned(originated_from_mapper(C.KIND_CRONJOB))
+        if features.enabled("TpuClusterNetworkPolicy"):
+            self._netpol_watch()
+
+        self.kubelet = FakeKubelet(self.store) if fake_kubelet else None
+        self._stop = threading.Event()
+        self.apiserver = None
+        self.api_url = ""
+
+    def _timed(self, kind, fn):
+        def wrapped(name, ns):
+            t0 = time.time()
+            try:
+                return fn(name, ns)
+            finally:
+                self.metrics.reconcile(kind, time.time() - t0)
+        return wrapped
+
+    def _netpol_watch(self):
+        def mapper(ev):
+            if ev.kind == C.KIND_CLUSTER:
+                md = ev.obj.get("metadata", {})
+                self.networkpolicy_controller.reconcile(
+                    md.get("name", ""), md.get("namespace", "default"))
+            return None
+        self.manager.map_owned(mapper)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self, api_port: int = 0, api_host: str = "127.0.0.1"):
+        """Start workers + API server; returns the API base URL."""
+        self.apiserver, self.api_url = serve_background(
+            self.store, api_host, api_port, metrics=self.metrics)
+        self.manager.start(workers=max(1, self.config.reconcileConcurrency))
+        threading.Thread(target=self._background_loops, daemon=True,
+                         name="operator-loops").start()
+        return self.api_url
+
+    def _background_loops(self):
+        """Periodic work: autoscaler passes, cron ticks, fake kubelet."""
+        log = logging.getLogger("kuberay_tpu.operator")
+        while not self._stop.is_set():
+            try:
+                clusters = self.store.list(C.KIND_CLUSTER)
+                self.autoscaler.prune_clusters(
+                    {(o["metadata"]["namespace"], o["metadata"]["name"])
+                     for o in clusters})
+                for obj in clusters:
+                    if obj.get("spec", {}).get("enableInTreeAutoscaling"):
+                        md = obj["metadata"]
+                        if self.autoscaler.reconcile(md["name"], md["namespace"]):
+                            self.manager.enqueue(
+                                (C.KIND_CLUSTER, md["namespace"], md["name"]))
+                if features.enabled("TpuCronJob"):
+                    for obj in self.store.list(C.KIND_CRONJOB):
+                        md = obj["metadata"]
+                        self.manager.enqueue(
+                            (C.KIND_CRONJOB, md["namespace"], md["name"]))
+                if self.kubelet is not None:
+                    self.kubelet.step()
+            except Exception:
+                log.exception("operator background loop iteration failed")
+            self._stop.wait(1.0)
+
+    def stop(self):
+        self._stop.set()
+        self.manager.stop()
+        if self.apiserver is not None:
+            self.apiserver.shutdown()
+
+    # test/demo helper
+    def run_until_idle(self):
+        self.manager.flush_delayed()
+        n = self.manager.run_until_idle()
+        if self.kubelet is not None:
+            self.kubelet.step()
+            self.manager.run_until_idle()
+        return n
+
+
+def load_config(path: str) -> OperatorConfiguration:
+    with open(path) as f:
+        return OperatorConfiguration.from_dict(json.load(f))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="kuberay-tpu-operator",
+        description="TPU-native pod-slice orchestration operator")
+    ap.add_argument("--config", help="operator config JSON file")
+    ap.add_argument("--feature-gates", default="",
+                    help="e.g. TpuCronJob=true,TpuClusterNetworkPolicy=true")
+    ap.add_argument("--api-port", type=int, default=8765)
+    ap.add_argument("--api-host", default="127.0.0.1")
+    ap.add_argument("--batch-scheduler", default="",
+                    help="gang | volcano | yunikorn | kai")
+    ap.add_argument("--reconcile-concurrency", type=int, default=2)
+    ap.add_argument("--fake-kubelet", action="store_true",
+                    help="run pods with the in-process fake kubelet (demo)")
+    args = ap.parse_args(argv)
+
+    cfg = load_config(args.config) if args.config else OperatorConfiguration()
+    if args.batch_scheduler:
+        cfg.batchScheduler = args.batch_scheduler
+        cfg.enableBatchScheduler = True
+    cfg.reconcileConcurrency = args.reconcile_concurrency
+    features.parse_and_set(args.feature_gates)
+
+    op = Operator(cfg, fake_kubelet=args.fake_kubelet)
+    url = op.start(api_port=args.api_port, api_host=args.api_host)
+    print(f"kuberay-tpu operator running; API at {url}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        op.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -31,10 +31,16 @@ _NEG_INF = -1e30
 def _block_attention(q, k, v, scale, q_offset, k_offset, causal):
     """Partial attention of a local q shard against ONE k/v block.
 
-    q: [B, Sq, Hq, D]; k/v: [B, Skv, Hkv, D] (GQA repeated by caller or
-    equal heads).  Returns (pv [B,Sq,Hq,D] f32, m [B,Sq,Hq,1], l [B,Sq,Hq,1])
-    — unnormalized numerator, block max, block sum, for online combination.
+    q: [B, Sq, Hq, D]; k/v: [B, Skv, Hkv, D].  GQA is expanded HERE, after
+    the ring exchange, so the ppermute carries only the Hkv-sized tensors
+    (group x less ICI traffic).  Returns (pv [B,Sq,Hq,D] f32,
+    m [B,Sq,Hq,1], l [B,Sq,Hq,1]) — unnormalized numerator, block max,
+    block sum, for online combination.
     """
+    group = q.shape[2] // k.shape[2]
+    if group > 1:
+        k = jnp.repeat(k, group, axis=2)
+        v = jnp.repeat(v, group, axis=2)
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                    preferred_element_type=jnp.float32) * scale
     if causal:
@@ -57,12 +63,7 @@ def _ring_attention_sharded(q, k, v, *, axis_name, scale, causal):
     n = lax.psum(1, axis_name)
     my = lax.axis_index(axis_name)
     B, S_local, Hq, D = q.shape
-    Hkv = k.shape[2]
-    group = Hq // Hkv
-    if group > 1:
-        k = jnp.repeat(k, group, axis=2)
-        v = jnp.repeat(v, group, axis=2)
-
+    # K/V stay at Hkv heads in the ring carry; GQA expands per-block.
     q_offset = my * S_local
 
     def step(carry, i):

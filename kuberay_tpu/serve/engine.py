@@ -48,11 +48,12 @@ class Response:
     created: float = 0.0
 
 
-def _bucket(n: int, buckets=(32, 64, 128, 256, 512, 1024, 2048)) -> int:
-    for b in buckets:
-        if n <= b:
-            return b
-    return buckets[-1]
+def _bucket(n: int, max_len: int = 2048) -> int:
+    """Smallest power-of-two bucket >= n, capped at max_len."""
+    b = 32
+    while b < n and b < max_len:
+        b *= 2
+    return min(b, max_len)
 
 
 class ServeEngine:
@@ -119,7 +120,7 @@ class ServeEngine:
     # ------------------------------------------------------------------
 
     def add_request(self, req: Request) -> None:
-        if len(req.prompt_tokens) >= self.max_len:
+        if len(req.prompt_tokens) >= self.max_len or req.max_new_tokens <= 0:
             self._finished.append(Response(
                 req.request_id, [], "cancelled",
                 prompt_len=len(req.prompt_tokens), created=time.time()))
@@ -136,13 +137,15 @@ class ServeEngine:
     def step(self) -> List[Response]:
         """One engine iteration: admit one request (prefill) if possible,
         then decode all active slots.  Returns finished responses."""
-        # Admission: continuous batching — a free slot + queued request.
-        if self.queue:
+        # Admission: continuous batching — fill every free slot before the
+        # decode pass (an underfilled batch wastes a full device step).
+        while self.queue:
             free = next((i for i, r in enumerate(self.active) if r is None),
                         None)
-            if free is not None:
-                req = self.queue.pop(0)
-                self._admit(req, free)
+            if free is None:
+                break
+            req = self.queue.pop(0)
+            self._admit(req, free)
 
         if self.num_active:
             self._decode_all()
@@ -164,7 +167,7 @@ class ServeEngine:
 
     def _admit(self, req: Request, slot: int):
         plen = len(req.prompt_tokens)
-        bucket = _bucket(plen)
+        bucket = _bucket(plen, self.max_len)
         padded = np.zeros(bucket, dtype=np.int32)
         padded[:plen] = req.prompt_tokens
         self.key, sub = jax.random.split(self.key)

@@ -1,0 +1,164 @@
+"""Control-plane metrics: Prometheus text exposition, no client library.
+
+Mirrors the reference's metric surface (controllers/ray/metrics/):
+- ``tpu_cluster_provisioned_duration_seconds`` (ref
+  kuberay_cluster_provisioned_duration_seconds, ray_cluster_metrics.go:35-37)
+- ``tpu_job_execution_duration_seconds`` (ref
+  kuberay_job_execution_duration_seconds, ray_job_metrics.go:33-35)
+- state gauges per CR kind, reconcile counters/latencies.
+
+Metrics are cleaned up when their CR disappears (ref
+raycluster_controller.go:125 cleanup on delete).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+_BUCKETS = (0.5, 1, 2, 5, 10, 30, 60, 120, 300, 600, 1800, float("inf"))
+
+
+class Histogram:
+    def __init__(self, buckets=_BUCKETS):
+        self.buckets = buckets
+        self.counts = [0] * len(buckets)
+        self.total = 0.0
+        self.n = 0
+
+    def observe(self, v: float):
+        self.n += 1
+        self.total += v
+        for i, b in enumerate(self.buckets):
+            if v <= b:
+                self.counts[i] += 1
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[Tuple[str, Tuple], float] = {}
+        self._gauges: Dict[Tuple[str, Tuple], float] = {}
+        self._hists: Dict[Tuple[str, Tuple], Histogram] = {}
+        self._help: Dict[str, str] = {}
+
+    def _labels_key(self, labels: Optional[Dict[str, str]]) -> Tuple:
+        return tuple(sorted((labels or {}).items()))
+
+    def describe(self, name: str, help_text: str):
+        self._help[name] = help_text
+
+    def inc(self, name: str, labels: Optional[Dict[str, str]] = None,
+            value: float = 1.0):
+        with self._lock:
+            key = (name, self._labels_key(labels))
+            self._counters[key] = self._counters.get(key, 0.0) + value
+
+    def set_gauge(self, name: str, value: float,
+                  labels: Optional[Dict[str, str]] = None):
+        with self._lock:
+            self._gauges[(name, self._labels_key(labels))] = value
+
+    def observe(self, name: str, value: float,
+                labels: Optional[Dict[str, str]] = None):
+        with self._lock:
+            key = (name, self._labels_key(labels))
+            if key not in self._hists:
+                self._hists[key] = Histogram()
+            self._hists[key].observe(value)
+
+    def drop_labeled(self, label_key: str, label_value: str):
+        """Remove every series carrying label=value (CR deletion cleanup)."""
+        with self._lock:
+            for d in (self._counters, self._gauges, self._hists):
+                for key in [k for k in d
+                            if (label_key, label_value) in k[1]]:
+                    del d[key]
+
+    # -- exposition --------------------------------------------------------
+
+    @staticmethod
+    def _fmt_labels(label_items: Tuple, extra: str = "") -> str:
+        parts = [f'{k}="{v}"' for k, v in label_items]
+        if extra:
+            parts.append(extra)
+        return "{" + ",".join(parts) + "}" if parts else ""
+
+    def render(self) -> str:
+        lines: List[str] = []
+        with self._lock:
+            seen = set()
+
+            def header(name, mtype):
+                if name not in seen:
+                    seen.add(name)
+                    if name in self._help:
+                        lines.append(f"# HELP {name} {self._help[name]}")
+                    lines.append(f"# TYPE {name} {mtype}")
+
+            for (name, labels), v in sorted(self._counters.items()):
+                header(name, "counter")
+                lines.append(f"{name}{self._fmt_labels(labels)} {v}")
+            for (name, labels), v in sorted(self._gauges.items()):
+                header(name, "gauge")
+                lines.append(f"{name}{self._fmt_labels(labels)} {v}")
+            for (name, labels), h in sorted(self._hists.items()):
+                header(name, "histogram")
+                cum = 0
+                for b, c in zip(h.buckets, h.counts):
+                    cum += c
+                    le = "+Inf" if b == float("inf") else str(b)
+                    lines.append(
+                        f"{name}_bucket{self._fmt_labels(labels, f'le=\"{le}\"')} {cum}")
+                lines.append(f"{name}_sum{self._fmt_labels(labels)} {h.total}")
+                lines.append(f"{name}_count{self._fmt_labels(labels)} {h.n}")
+        return "\n".join(lines) + "\n"
+
+
+class ControlPlaneMetrics:
+    """The typed facade controllers consume (matches the ``metrics``
+    parameter of the controllers)."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        self.registry = registry or MetricsRegistry()
+        r = self.registry
+        r.describe("tpu_cluster_provisioned_duration_seconds",
+                   "Seconds from TpuCluster creation to all slices ready")
+        r.describe("tpu_job_execution_duration_seconds",
+                   "Seconds from job start to terminal state")
+        r.describe("tpu_cluster_state", "TpuCluster state gauge (1 = in state)")
+        r.describe("tpu_reconcile_total", "Reconcile invocations per kind")
+        r.describe("tpu_reconcile_duration_seconds", "Reconcile latency")
+        r.describe("tpu_slice_ready_duration_seconds",
+                   "Seconds from slice creation to all hosts running "
+                   "(north-star metric)")
+
+    def observe_provisioned(self, cluster: str, seconds: float):
+        self.registry.observe("tpu_cluster_provisioned_duration_seconds",
+                              seconds, {"cluster": cluster})
+
+    def observe_job_duration(self, job: str, result: str, seconds: float):
+        self.registry.observe("tpu_job_execution_duration_seconds", seconds,
+                              {"job": job, "result": result or "unknown"})
+
+    def observe_slice_ready(self, cluster: str, group: str, seconds: float):
+        self.registry.observe("tpu_slice_ready_duration_seconds", seconds,
+                              {"cluster": cluster, "group": group})
+
+    def set_cluster_state(self, cluster: str, state: str):
+        for s in ("ready", "suspended", "failed", ""):
+            self.registry.set_gauge(
+                "tpu_cluster_state", 1.0 if s == state else 0.0,
+                {"cluster": cluster, "state": s or "provisioning"})
+
+    def reconcile(self, kind: str, seconds: float):
+        self.registry.inc("tpu_reconcile_total", {"kind": kind})
+        self.registry.observe("tpu_reconcile_duration_seconds", seconds,
+                              {"kind": kind})
+
+    def forget_cluster(self, cluster: str):
+        self.registry.drop_labeled("cluster", cluster)
+
+    def render(self) -> str:
+        return self.registry.render()

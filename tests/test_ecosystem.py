@@ -1,0 +1,193 @@
+"""Ecosystem: apiserver REST, CLI, operator wiring, metrics, data loader."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from kuberay_tpu.api.config import OperatorConfiguration
+from kuberay_tpu.cli.client import ApiClient, ApiError
+from kuberay_tpu.operator import Operator
+from kuberay_tpu.runtime.coordinator_client import FakeCoordinatorClient
+from kuberay_tpu.train.data import TokenShardLoader, native_available, synthetic_shard
+from kuberay_tpu.utils import constants as C
+from kuberay_tpu.utils.metrics import ControlPlaneMetrics
+from tests.test_api_types import make_cluster
+
+
+@pytest.fixture
+def op():
+    coord = FakeCoordinatorClient()
+    operator = Operator(OperatorConfiguration(reconcileConcurrency=2),
+                        client_provider=lambda status: coord,
+                        fake_kubelet=True)
+    operator.coordinator = coord
+    url = operator.start(api_port=0)
+    yield operator
+    operator.stop()
+
+
+def wait_for(fn, timeout=15.0, interval=0.1):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = fn()
+        if v:
+            return v
+        time.sleep(interval)
+    raise TimeoutError("condition not met")
+
+
+def test_rest_crud_and_reconcile(op):
+    client = ApiClient(op.api_url)
+    assert client.healthy()
+    manifest = make_cluster(accelerator="v5p", topology="2x2x2",
+                            replicas=1).to_dict()
+    created = client.create(manifest)
+    assert created["metadata"]["uid"]
+    # The live operator (threaded) provisions it.
+    wait_for(lambda: client.get(C.KIND_CLUSTER, "demo").get(
+        "status", {}).get("state") == "ready")
+    pods = client.list("Pod")
+    assert len(pods) == 3
+    # Invalid manifest rejected with 422.
+    bad = make_cluster(name="bad", topology="9x9").to_dict()
+    with pytest.raises(ApiError) as exc:
+        client.create(bad)
+    assert exc.value.code == 422
+    # Deletion cascades.
+    client.delete(C.KIND_CLUSTER, "demo")
+    wait_for(lambda: client.list("Pod") == [])
+
+
+def test_rest_label_selector_and_conflicts(op):
+    client = ApiClient(op.api_url)
+    c = make_cluster(name="sel")
+    c.metadata.labels = {"team": "a"}
+    client.create(c.to_dict())
+    assert client.list(C.KIND_CLUSTER, label_selector="team=a")
+    assert client.list(C.KIND_CLUSTER, label_selector="team=b") == []
+    with pytest.raises(ApiError) as exc:
+        client.create(c.to_dict())
+    assert exc.value.code == 409
+
+
+def test_metrics_endpoint(op):
+    client = ApiClient(op.api_url)
+    client.create(make_cluster(name="m1").to_dict())
+    wait_for(lambda: client.get(C.KIND_CLUSTER, "m1").get(
+        "status", {}).get("state") == "ready")
+    import urllib.request
+    text = urllib.request.urlopen(op.api_url + "/metrics").read().decode()
+    assert "tpu_reconcile_total" in text
+    assert "tpu_cluster_provisioned_duration_seconds" in text
+
+
+def run_cli(op, *argv):
+    from kuberay_tpu.cli.__main__ import main
+    import io
+    from contextlib import redirect_stdout
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = main(["--server", op.api_url, *argv])
+    return rc, buf.getvalue()
+
+
+def test_cli_create_get_scale_delete(op):
+    rc, out = run_cli(op, "create", "cluster", "c1", "--tpu", "v5p",
+                      "--topology", "2x2x2", "--slices", "1")
+    assert rc == 0 and "created" in out
+    wait_for(lambda: ApiClient(op.api_url).get(C.KIND_CLUSTER, "c1").get(
+        "status", {}).get("state") == "ready")
+    rc, out = run_cli(op, "get", "clusters")
+    assert rc == 0 and "c1" in out and "ready" in out
+    rc, out = run_cli(op, "get", "slices")
+    assert "c1-workers-0" in out and "2/2" in out
+    rc, out = run_cli(op, "scale", "c1", "--replicas", "2")
+    assert rc == 0
+    wait_for(lambda: ApiClient(op.api_url).get(C.KIND_CLUSTER, "c1").get(
+        "status", {}).get("readySlices") == 2)
+    rc, out = run_cli(op, "delete", "cluster", "c1")
+    assert rc == 0
+
+
+def test_cli_submit_and_wait(op):
+    # Job completes when the fake coordinator reports SUCCEEDED.
+    def finisher():
+        try:
+            wait_for(lambda: op.coordinator.jobs, timeout=20)
+            for jid in list(op.coordinator.jobs):
+                op.coordinator.set_job_status(jid, "SUCCEEDED")
+        except TimeoutError:
+            pass
+    import threading
+    t = threading.Thread(target=finisher, daemon=True)
+    t.start()
+    rc, out = run_cli(op, "submit", "train1", "--tpu", "v5e", "--topology",
+                      "2x2", "--mode", "HTTPMode", "--shutdown-after-finish",
+                      "--wait", "--", "python", "-m", "kuberay_tpu.train")
+    assert rc == 0, out
+    assert "Complete" in out
+
+
+def test_cli_bad_topology_fails_cleanly(op):
+    rc, _ = run_cli(op, "create", "cluster", "x", "--tpu", "v5e",
+                    "--topology", "3x3")
+    assert rc == 1
+    # Nothing was created.
+    assert ApiClient(op.api_url).list(C.KIND_CLUSTER,
+                                      label_selector="") == [] or all(
+        i["metadata"]["name"] != "x"
+        for i in ApiClient(op.api_url).list(C.KIND_CLUSTER))
+
+
+def test_invalid_path_404(op):
+    import urllib.request, urllib.error
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(op.api_url + "/apis/tpu.dev/v1/namespaces/d/nope")
+    assert e.value.code == 404
+
+
+def test_metrics_render_format():
+    m = ControlPlaneMetrics()
+    m.observe_provisioned("c1", 12.5)
+    m.observe_job_duration("j1", "SUCCEEDED", 100.0)
+    m.set_cluster_state("c1", "ready")
+    text = m.render()
+    assert '# TYPE tpu_cluster_provisioned_duration_seconds histogram' in text
+    assert 'tpu_cluster_state{cluster="c1",state="ready"} 1.0' in text
+    assert 'le="+Inf"' in text
+    m.forget_cluster("c1")
+    assert 'cluster="c1"' not in m.render()
+
+
+def test_token_shard_loader(tmp_path):
+    shard = tmp_path / "shard.bin"
+    synthetic_shard(str(shard), n_tokens=10_000, vocab=1000, seed=7)
+    loader = TokenShardLoader(str(shard), seq_len=64, batch=4, seed=1)
+    b = loader.next()
+    assert b["tokens"].shape == (4, 64)
+    assert b["targets"].shape == (4, 64)
+    # Next-token alignment.
+    np.testing.assert_array_equal(b["tokens"][:, 1:], b["targets"][:, :-1])
+    assert loader.num_windows == 10_000 // 65
+    loader.close()
+
+
+def test_native_loader_matches_numpy(tmp_path):
+    if not native_available():
+        pytest.skip("no C++ toolchain")
+    shard = tmp_path / "shard.bin"
+    synthetic_shard(str(shard), n_tokens=5_000, vocab=500, seed=3)
+    nat = TokenShardLoader(str(shard), seq_len=32, batch=2, seed=9,
+                           prefer_native=True, n_threads=1)
+    py = TokenShardLoader(str(shard), seq_len=32, batch=2, seed=9,
+                          prefer_native=False)
+    assert nat.backend == "native" and py.backend == "numpy"
+    for _ in range(5):
+        np.testing.assert_array_equal(nat.next()["tokens"],
+                                      py.next()["tokens"])
+    nat.close()
