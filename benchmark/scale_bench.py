@@ -1,0 +1,149 @@
+"""Orchestration-scale benchmark: the clusterloader2-analogue.
+
+The reference's only published performance numbers are orchestration-scale
+(BASELINE.md: 100/1,000/5,000/10,000 RayClusters all-pods-Running within
+clusterloader2 timeouts on GKE).  This harness reproduces that shape
+against our control plane: N TpuClusters (or TpuJobs) created through the
+operator, measuring wall time until every cluster reports ready — pods
+executed by the in-process fake kubelet, so the number isolates
+control-plane throughput exactly like the reference's memory/scale
+benchmarks isolate the operator.
+
+    python benchmark/scale_bench.py --clusters 1000
+    python benchmark/scale_bench.py --jobs 100
+
+Outputs one JSON line per phase (compatible with BENCH recording).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+from kuberay_tpu.api.config import OperatorConfiguration  # noqa: E402
+from kuberay_tpu.operator import Operator  # noqa: E402
+from kuberay_tpu.runtime.coordinator_client import FakeCoordinatorClient  # noqa: E402
+from kuberay_tpu.utils import constants as C  # noqa: E402
+
+
+def cluster_manifest(i: int) -> dict:
+    return {
+        "apiVersion": C.API_VERSION, "kind": C.KIND_CLUSTER,
+        "metadata": {"name": f"bench-{i}", "namespace": "default"},
+        "spec": {
+            "headGroupSpec": {"template": {"spec": {"containers": [
+                {"name": "head", "image": "rt:bench"}]}}},
+            "workerGroupSpecs": [{
+                "groupName": "workers", "accelerator": "v5e",
+                "topology": "2x2", "replicas": 1, "maxReplicas": 1,
+                "template": {"spec": {"containers": [
+                    {"name": "w", "image": "rt:bench"}]}}}],
+        },
+    }
+
+
+def job_manifest(i: int) -> dict:
+    return {
+        "apiVersion": C.API_VERSION, "kind": C.KIND_JOB,
+        "metadata": {"name": f"bench-job-{i}", "namespace": "default"},
+        "spec": {
+            "entrypoint": f"python -m noop --i {i}",
+            "submissionMode": "HTTPMode",
+            "shutdownAfterJobFinishes": True,
+            "clusterSpec": cluster_manifest(i)["spec"],
+        },
+    }
+
+
+def run_cluster_scale(n: int, timeout: float) -> dict:
+    coord = FakeCoordinatorClient()
+    op = Operator(OperatorConfiguration(reconcileConcurrency=4),
+                  client_provider=lambda s: coord, fake_kubelet=True)
+    op.start(api_port=0)
+    t0 = time.time()
+    for i in range(n):
+        op.store.create(cluster_manifest(i))
+    created = time.time() - t0
+
+    deadline = time.time() + timeout
+    ready = 0
+    while time.time() < deadline:
+        ready = sum(
+            1 for c in op.store.list(C.KIND_CLUSTER)
+            if c.get("status", {}).get("state") == "ready")
+        if ready >= n:
+            break
+        time.sleep(0.2)
+    elapsed = time.time() - t0
+    pods = op.store.count("Pod")
+    op.stop()
+    return {
+        "metric": "tpucluster_scale_all_ready_seconds",
+        "value": round(elapsed, 2),
+        "unit": "s",
+        "detail": {"clusters": n, "ready": ready, "pods": pods,
+                   "create_phase_s": round(created, 2),
+                   "clusters_per_s": round(n / elapsed, 1),
+                   "pass": ready >= n,
+                   "reference": "BASELINE.md: 100-10000 RayClusters within "
+                                "30m clusterloader2 steps"},
+    }
+
+
+def run_job_scale(n: int, timeout: float) -> dict:
+    coord = FakeCoordinatorClient()
+    op = Operator(OperatorConfiguration(reconcileConcurrency=4),
+                  client_provider=lambda s: coord, fake_kubelet=True)
+    op.start(api_port=0)
+    t0 = time.time()
+    for i in range(n):
+        op.store.create(job_manifest(i))
+    deadline = time.time() + timeout
+    done = 0
+    while time.time() < deadline:
+        jobs = op.store.list(C.KIND_JOB)
+        # Drive the fake coordinator: finish any running app jobs.
+        for j in jobs:
+            jid = j.get("status", {}).get("jobId")
+            if jid and jid in coord.jobs and \
+                    coord.jobs[jid].status == "PENDING":
+                coord.set_job_status(jid, "SUCCEEDED")
+        done = sum(1 for j in jobs
+                   if j.get("status", {}).get("jobDeploymentStatus")
+                   == "Complete")
+        if done >= n:
+            break
+        time.sleep(0.2)
+    elapsed = time.time() - t0
+    op.stop()
+    return {
+        "metric": "tpujob_scale_all_complete_seconds",
+        "value": round(elapsed, 2),
+        "unit": "s",
+        "detail": {"jobs": n, "complete": done,
+                   "jobs_per_s": round(n / elapsed, 1), "pass": done >= n,
+                   "reference": "BASELINE.md: 100-5000 RayJobs to completion"},
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--clusters", type=int, default=0)
+    ap.add_argument("--jobs", type=int, default=0)
+    ap.add_argument("--timeout", type=float, default=1800.0)
+    args = ap.parse_args(argv)
+    if not args.clusters and not args.jobs:
+        args.clusters = 100
+    if args.clusters:
+        print(json.dumps(run_cluster_scale(args.clusters, args.timeout)),
+              flush=True)
+    if args.jobs:
+        print(json.dumps(run_job_scale(args.jobs, args.timeout)), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,68 @@
+"""Web dashboard: single-file UI served at /dashboard (the Next.js
+dashboard analogue, SURVEY §2.2 — clusters/jobs/services tables over the
+API server, zero build-step)."""
+
+DASHBOARD_HTML = """<!doctype html>
+<html><head><meta charset="utf-8"><title>kuberay-tpu dashboard</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:2rem;background:#fafafa;color:#1a1a1a}
+ h1{font-size:1.3rem} h2{font-size:1.05rem;margin-top:1.6rem}
+ table{border-collapse:collapse;width:100%;background:#fff;box-shadow:0 1px 2px #0002}
+ th,td{padding:.45rem .7rem;text-align:left;border-bottom:1px solid #eee;font-size:.85rem}
+ th{background:#f0f0f0;font-weight:600}
+ .ok{color:#0a7d33;font-weight:600}.bad{color:#b3261e;font-weight:600}
+ .dim{color:#777}.mono{font-family:ui-monospace,monospace}
+ #refresh{float:right;color:#777;font-size:.8rem}
+</style></head><body>
+<h1>kuberay-tpu <span class="dim">pod-slice orchestrator</span>
+<span id="refresh"></span></h1>
+<h2>TpuClusters</h2><table id="clusters"></table>
+<h2>TpuJobs</h2><table id="jobs"></table>
+<h2>TpuServices</h2><table id="services"></table>
+<h2>Slices</h2><table id="slices"></table>
+<h2>Recent events</h2><table id="events"></table>
+<script>
+const NS='default';
+async function list(api){const r=await fetch(api);return (await r.json()).items||[]}
+// All API-sourced strings pass through esc() before hitting innerHTML —
+// status subresources are writable by any API client.
+function esc(v){return String(v??'').replace(/[&<>"']/g,
+  c=>({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[c]))}
+function row(cells,head){return '<tr>'+cells.map(c=>`<${head?'th':'td'}>${c}</${head?'th':'td'}>`).join('')+'</tr>'}
+function cls(state){return state==='ready'||state==='Running'||state==='Complete'?'ok':(state==='failed'||state==='Failed'?'bad':'dim')}
+async function tick(){
+ const C=await list(`/apis/tpu.dev/v1/namespaces/${NS}/tpuclusters`);
+ document.getElementById('clusters').innerHTML=row(['NAME','STATE','SLICES','HOSTS','TPU CHIPS'],1)+
+  C.map(c=>{const s=c.status||{};return row([esc(c.metadata.name),
+   `<span class="${cls(s.state)}">${esc(s.state||'provisioning')}</span>`,
+   `${s.readySlices||0}/${s.desiredSlices||0}`,
+   `${s.readyWorkerHosts||0}/${s.desiredWorkerHosts||0}`,s.desiredTpuChips||0])}).join('');
+ const J=await list(`/apis/tpu.dev/v1/namespaces/${NS}/tpujobs`);
+ document.getElementById('jobs').innerHTML=row(['NAME','DEPLOYMENT','JOB','CLUSTER','RETRIES'],1)+
+  J.map(j=>{const s=j.status||{};return row([esc(j.metadata.name),
+   `<span class="${cls(s.jobDeploymentStatus)}">${esc(s.jobDeploymentStatus||'')}</span>`,
+   esc(s.jobStatus||''),`<span class="mono">${esc(s.clusterName||'')}</span>`,esc(s.failed||0)])}).join('');
+ const S=await list(`/apis/tpu.dev/v1/namespaces/${NS}/tpuservices`);
+ document.getElementById('services').innerHTML=row(['NAME','STATUS','ACTIVE CLUSTER','ENDPOINTS'],1)+
+  S.map(x=>{const s=x.status||{};return row([esc(x.metadata.name),
+   `<span class="${cls(s.serviceStatus)}">${esc(s.serviceStatus||'')}</span>`,
+   `<span class="mono">${esc((s.activeServiceStatus||{}).clusterName||'')}</span>`,
+   s.numServeEndpoints||0])}).join('');
+ const P=await list(`/api/v1/namespaces/${NS}/pods`);
+ const bySlice={};
+ for(const p of P){const l=p.metadata.labels||{};const n=l['tpu.dev/slice-name'];
+  if(!n)continue;(bySlice[n]=bySlice[n]||{c:l['tpu.dev/cluster'],g:l['tpu.dev/group'],t:0,r:0});
+  bySlice[n].t++;if((p.status||{}).phase==='Running')bySlice[n].r++;}
+ document.getElementById('slices').innerHTML=row(['SLICE','CLUSTER','GROUP','HOSTS READY'],1)+
+  Object.entries(bySlice).map(([n,v])=>row([`<span class="mono">${esc(n)}</span>`,esc(v.c),esc(v.g),
+   `<span class="${v.r===v.t?'ok':'dim'}">${v.r}/${v.t}</span>`])).join('');
+ const E=await list(`/api/v1/namespaces/${NS}/events`);
+ document.getElementById('events').innerHTML=row(['TYPE','REASON','OBJECT','MESSAGE'],1)+
+  E.slice(-15).reverse().map(e=>row([esc(e.type),esc(e.reason),
+   `<span class="mono">${esc((e.involvedObject||{}).kind)}/${esc((e.involvedObject||{}).name)}</span>`,
+   esc(e.message||'')])).join('');
+ document.getElementById('refresh').textContent='updated '+new Date().toLocaleTimeString();
+}
+tick();setInterval(tick,3000);
+</script></body></html>
+"""

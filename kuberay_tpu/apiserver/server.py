@@ -19,7 +19,7 @@ from __future__ import annotations
 import json
 import re
 import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
@@ -31,6 +31,7 @@ from kuberay_tpu.controlplane.store import (
     ObjectStore,
 )
 from kuberay_tpu.utils import constants as C
+from kuberay_tpu.utils.httpjson import JsonHandler
 from kuberay_tpu.utils.validation import (
     validate_cluster,
     validate_cronjob,
@@ -66,40 +67,13 @@ _CORE_RE = re.compile(
     r"^/api/v1/namespaces/(?P<ns>[^/]+)/(?P<plural>[^/]+)(/(?P<name>[^/]+))?$")
 
 
-class ApiHandler(BaseHTTPRequestHandler):
+class ApiHandler(JsonHandler):
     store: ObjectStore = None           # injected by make_server
     metrics = None
-    protocol_version = "HTTP/1.1"
-
-    # -- plumbing ----------------------------------------------------------
-
-    def log_message(self, fmt, *args):   # quiet by default
-        pass
-
-    def _send(self, code: int, body: Any = None):
-        data = (json.dumps(body).encode() if body is not None else b"")
-        self.send_response(code)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(data)))
-        self.end_headers()
-        self.wfile.write(data)
-
-    def _send_text(self, code: int, text: str, ctype="text/plain"):
-        data = text.encode()
-        self.send_response(code)
-        self.send_header("Content-Type", ctype)
-        self.send_header("Content-Length", str(len(data)))
-        self.end_headers()
-        self.wfile.write(data)
 
     def _error(self, code: int, message: str):
         self._send(code, {"kind": "Status", "status": "Failure",
                           "code": code, "message": message})
-
-    def _body(self) -> Dict[str, Any]:
-        n = int(self.headers.get("Content-Length", 0))
-        raw = self.rfile.read(n) if n else b"{}"
-        return json.loads(raw or b"{}")
 
     def _route(self) -> Optional[Tuple[str, str, Optional[str], Optional[str]]]:
         path = urlparse(self.path).path
@@ -131,6 +105,9 @@ class ApiHandler(BaseHTTPRequestHandler):
         path = urlparse(self.path).path
         if path == "/healthz" or path == "/readyz":
             return self._send_text(200, "ok")
+        if path in ("/dashboard", "/dashboard/"):
+            from kuberay_tpu.apiserver.dashboard import DASHBOARD_HTML
+            return self._send_text(200, DASHBOARD_HTML, "text/html")
         if path == "/metrics":
             text = self.metrics.render() if self.metrics else ""
             return self._send_text(200, text, "text/plain; version=0.0.4")

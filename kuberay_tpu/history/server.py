@@ -1,0 +1,145 @@
+"""History server: post-mortem observability (ref historyserver/, SURVEY
+§2.2 — collector tails live state into object storage; server replays a
+dashboard-compatible API from storage).
+
+Two components, same shapes as the reference:
+- ``HistoryCollector``: watches the store and archives terminal CRs,
+  events, and pod summaries as JSON files under a storage root (the
+  GCS/S3 backend seam is the ``storage`` argument — local directory here,
+  same layout an object-store backend would use).
+- ``HistoryServer``: read-only HTTP API over the archive
+  (``/api/history/{kind}``, ``/api/history/{kind}/{ns}/{name}``) so
+  clusters/jobs remain inspectable after deletion.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from http.server import ThreadingHTTPServer
+from typing import Any, Dict, List, Optional
+
+from kuberay_tpu.controlplane.store import Event, ObjectStore
+from kuberay_tpu.utils.httpjson import JsonHandler
+
+_ARCHIVED_KINDS = ("TpuCluster", "TpuJob", "TpuService", "TpuCronJob")
+
+
+class LocalStorage:
+    """Directory-backed archive (object-store layout: kind/ns/name.json)."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def put(self, kind: str, ns: str, name: str, doc: Dict[str, Any]):
+        d = os.path.join(self.root, kind, ns)
+        os.makedirs(d, exist_ok=True)
+        tmp = os.path.join(d, f".{name}.tmp")
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, os.path.join(d, f"{name}.json"))
+
+    def get(self, kind: str, ns: str, name: str) -> Optional[Dict[str, Any]]:
+        path = os.path.join(self.root, kind, ns, f"{name}.json")
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None
+
+    def list(self, kind: str, ns: Optional[str] = None) -> List[Dict[str, Any]]:
+        base = os.path.join(self.root, kind)
+        out = []
+        if not os.path.isdir(base):
+            return out
+        for namespace in sorted(os.listdir(base)):
+            if ns is not None and namespace != ns:
+                continue
+            d = os.path.join(base, namespace)
+            for fn in sorted(os.listdir(d)):
+                if fn.endswith(".json"):
+                    doc = self.get(kind, namespace, fn[:-5])
+                    if doc is not None:
+                        out.append(doc)
+        return out
+
+
+class HistoryCollector:
+    """Archives CR snapshots on every modification and enriches them with
+    events + pod summaries on deletion (the fsnotify-tailing collector
+    analogue, ref collector.go:23-60)."""
+
+    def __init__(self, store: ObjectStore, storage: LocalStorage):
+        self.store = store
+        self.storage = storage
+        self._cancel = store.watch(self._on_event)
+
+    def close(self):
+        self._cancel()
+
+    def _on_event(self, ev: Event):
+        if ev.kind not in _ARCHIVED_KINDS:
+            return
+        md = ev.obj.get("metadata", {})
+        ns, name = md.get("namespace", "default"), md.get("name", "")
+        if not name:
+            return
+        doc = self.storage.get(ev.kind, ns, name) or {}
+        doc.update({
+            "kind": ev.kind,
+            "metadata": md,
+            "spec": ev.obj.get("spec", {}),
+            "status": ev.obj.get("status", {}),
+            "lastEventType": ev.type,
+            "archivedAt": time.time(),
+        })
+        if ev.type == Event.DELETED:
+            doc["deleted"] = True
+            doc["events"] = [
+                {"reason": e.get("reason"), "message": e.get("message"),
+                 "type": e.get("type"), "eventTime": e.get("eventTime")}
+                for e in self.store.list("Event", ns)
+                if e.get("involvedObject", {}).get("name") == name
+                and e.get("involvedObject", {}).get("kind") == ev.kind]
+        self.storage.put(ev.kind, ns, name, doc)
+
+
+class HistoryServer:
+    """Read-only replay API over the archive (ref router.go's
+    dashboard-compatible surface)."""
+
+    def __init__(self, storage: LocalStorage):
+        self.storage = storage
+
+    def make_server(self, host="127.0.0.1", port=0) -> ThreadingHTTPServer:
+        storage = self.storage
+
+        class Handler(JsonHandler):
+            def do_GET(self):
+                parts = [p for p in self.path.split("/") if p]
+                # /api/history/{kind}[/{ns}[/{name}]]
+                if len(parts) >= 3 and parts[:2] == ["api", "history"]:
+                    kind = parts[2]
+                    if kind not in _ARCHIVED_KINDS:
+                        return self._send(404, {"message": "unknown kind"})
+                    if len(parts) == 3:
+                        return self._send(200, {"items": storage.list(kind)})
+                    if len(parts) == 4:
+                        return self._send(
+                            200, {"items": storage.list(kind, parts[3])})
+                    doc = storage.get(kind, parts[3], parts[4])
+                    if doc is None:
+                        return self._send(404, {"message": "not archived"})
+                    return self._send(200, doc)
+                return self._send(404, {"message": "unknown path"})
+
+        return ThreadingHTTPServer((host, port), Handler)
+
+    def serve_background(self, host="127.0.0.1", port=0):
+        srv = self.make_server(host, port)
+        threading.Thread(target=srv.serve_forever, daemon=True,
+                         name="history-server").start()
+        return srv, f"http://{srv.server_address[0]}:{srv.server_address[1]}"

@@ -75,6 +75,9 @@ class CoordinatorClient:
     def delete_job(self, job_id: str) -> None:
         self._req("DELETE", f"/api/jobs/{job_id}")
 
+    def get_job_logs(self, job_id: str) -> str:
+        return self._req("GET", f"/api/jobs/{job_id}/logs").get("logs", "")
+
     def list_jobs(self) -> List[JobInfo]:
         out = self._req("GET", "/api/jobs/")
         return [JobInfo(j.get("submission_id", ""), j.get("status", "PENDING"),

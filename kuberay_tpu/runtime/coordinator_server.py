@@ -1,0 +1,417 @@
+"""Coordinator server: the head pod's control process.
+
+The head-side half of the runtime contract (the role Ray's dashboard +
+GCS play for the reference — SURVEY.md §5.8): an HTTP API for job
+submission/status/logs and serve-app config, plus cluster metadata that
+survives head restarts via pluggable state backends (the
+GcsFaultToleranceOptions analogue):
+
+- memory: in-process only (workers die with the head)
+- file:   JSON journal on a PVC path (embedded-RocksDB analogue)
+- external: Redis-protocol store (SET/GET/DEL over TCP, no client dep)
+
+Endpoints match what CoordinatorClient speaks (runtime/coordinator_client.py):
+    POST/GET/DELETE /api/jobs/[{id}] , POST /api/jobs/{id}/stop
+    PUT/GET  /api/serve/applications/
+    GET      /api/healthz , /api/cluster
+Jobs run as local subprocesses of the head (entrypoints launch the
+distributed program via train/launcher.py on every host).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shlex
+import socket
+import subprocess
+import threading
+import time
+from http.server import ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+from kuberay_tpu.utils import constants as C
+from kuberay_tpu.utils.httpjson import JsonHandler
+
+
+class StateBackend:
+    """Cluster-metadata persistence seam (§5.3 head-loss recovery)."""
+
+    def save(self, key: str, value: Dict[str, Any]):  # pragma: no cover
+        raise NotImplementedError
+
+    def load_all(self) -> Dict[str, Dict[str, Any]]:  # pragma: no cover
+        raise NotImplementedError
+
+    def delete(self, key: str):  # pragma: no cover
+        raise NotImplementedError
+
+
+class MemoryBackend(StateBackend):
+    def __init__(self):
+        self._d: Dict[str, Dict[str, Any]] = {}
+
+    def save(self, key, value):
+        self._d[key] = json.loads(json.dumps(value))
+
+    def load_all(self):
+        return dict(self._d)
+
+    def delete(self, key):
+        self._d.pop(key, None)
+
+
+class FileBackend(StateBackend):
+    """Append-free JSON-per-key directory journal (PVC-backed)."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, key):
+        safe = key.replace("/", "_")
+        return os.path.join(self.root, f"{safe}.json")
+
+    def save(self, key, value):
+        tmp = self._path(key) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(value, f)
+        os.replace(tmp, self._path(key))
+
+    def load_all(self):
+        out = {}
+        for fn in os.listdir(self.root):
+            if fn.endswith(".json"):
+                try:
+                    with open(os.path.join(self.root, fn)) as f:
+                        out[fn[:-5]] = json.load(f)
+                except (OSError, json.JSONDecodeError):
+                    continue
+        return out
+
+    def delete(self, key):
+        try:
+            os.unlink(self._path(key))
+        except FileNotFoundError:
+            pass
+
+
+class RedisBackend(StateBackend):
+    """Minimal RESP client (SET/GET/DEL/KEYS) — no redis-py dependency."""
+
+    def __init__(self, address: str, namespace: str = "tpu"):
+        host, _, port = address.partition(":")
+        self.host, self.port = host, int(port or 6379)
+        self.ns = namespace
+        self._lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None
+
+    def _cmd(self, *parts: bytes):
+        with self._lock:
+            try:
+                if self._sock is None:
+                    self._sock = socket.create_connection(
+                        (self.host, self.port), timeout=5)
+                buf = b"*%d\r\n" % len(parts)
+                for p in parts:
+                    buf += b"$%d\r\n%s\r\n" % (len(p), p)
+                self._sock.sendall(buf)
+                return self._read_reply(self._sock.makefile("rb"))
+            except (OSError, RuntimeError):
+                # A failed/half-read exchange leaves the stream unusable;
+                # drop the connection so the next command reconnects clean.
+                if self._sock is not None:
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass
+                    self._sock = None
+                raise
+
+    def _read_reply(self, f):
+        line = f.readline()
+        t, rest = line[:1], line[1:].strip()
+        if t in (b"+", b":"):
+            return rest
+        if t == b"-":
+            raise RuntimeError(rest.decode())
+        if t == b"$":
+            n = int(rest)
+            if n < 0:
+                return None
+            data = f.read(n)
+            f.read(2)
+            return data
+        if t == b"*":
+            return [self._read_reply(f) for _ in range(int(rest))]
+        raise RuntimeError(f"bad RESP reply {line!r}")
+
+    def save(self, key, value):
+        self._cmd(b"SET", f"{self.ns}:{key}".encode(),
+                  json.dumps(value).encode())
+
+    def load_all(self):
+        keys = self._cmd(b"KEYS", f"{self.ns}:*".encode()) or []
+        out = {}
+        for k in keys:
+            v = self._cmd(b"GET", k)
+            if v:
+                out[k.decode().split(":", 1)[1]] = json.loads(v)
+        return out
+
+    def delete(self, key):
+        self._cmd(b"DEL", f"{self.ns}:{key}".encode())
+
+
+def backend_from_env() -> StateBackend:
+    addr = os.environ.get("TPU_HEAD_EXTERNAL_STORAGE_ADDRESS")
+    if addr:
+        return RedisBackend(
+            addr, os.environ.get("TPU_HEAD_EXTERNAL_STORAGE_NAMESPACE", "tpu"))
+    path = os.environ.get("TPU_HEAD_STATE_PATH")
+    if path:
+        return FileBackend(path)
+    return MemoryBackend()
+
+
+class JobRecord:
+    def __init__(self, job_id: str, entrypoint: str,
+                 runtime_env: Optional[dict] = None,
+                 metadata: Optional[dict] = None):
+        self.job_id = job_id
+        self.entrypoint = entrypoint
+        self.runtime_env = runtime_env or {}
+        self.metadata = metadata or {}
+        self.status = "PENDING"
+        self.message = ""
+        self.start_time = time.time()
+        self.end_time = 0.0
+        self.proc: Optional[subprocess.Popen] = None
+        self.log_path = ""
+
+    def to_dict(self):
+        return {
+            "submission_id": self.job_id, "entrypoint": self.entrypoint,
+            "status": self.status, "message": self.message,
+            "start_time": self.start_time, "end_time": self.end_time,
+            "metadata": self.metadata,
+        }
+
+
+class CoordinatorServer:
+    def __init__(self, state: Optional[StateBackend] = None,
+                 log_dir: str = "/tmp/tpu-coordinator-logs",
+                 spawn_jobs: bool = True):
+        self.state = state or backend_from_env()
+        self.log_dir = log_dir
+        self.spawn_jobs = spawn_jobs
+        os.makedirs(log_dir, exist_ok=True)
+        self._lock = threading.Lock()
+        self.jobs: Dict[str, JobRecord] = {}
+        self.serve_config: Optional[Dict[str, Any]] = None
+        self.serve_apps: Dict[str, Any] = {}
+        self._recover()
+
+    # -- persistence -------------------------------------------------------
+
+    def _persist_job(self, rec: JobRecord):
+        self.state.save(f"job:{rec.job_id}", rec.to_dict())
+
+    def _recover(self):
+        """Head restart: reload job registry + serve config (workers and
+        their ICI mesh survive; running subprocesses do not — they are
+        marked FAILED for the operator's retry machinery to handle)."""
+        for key, val in self.state.load_all().items():
+            if key.startswith("job:"):
+                rec = JobRecord(val["submission_id"], val.get("entrypoint", ""),
+                                metadata=val.get("metadata"))
+                rec.status = val.get("status", "PENDING")
+                rec.start_time = val.get("start_time", 0.0)
+                rec.end_time = val.get("end_time", 0.0)
+                if rec.status in ("PENDING", "RUNNING"):
+                    rec.status = "FAILED"
+                    rec.message = "head restarted while job was running"
+                    rec.end_time = time.time()
+                self.jobs[rec.job_id] = rec
+                self._persist_job(rec)
+            elif key == "serve_config":
+                self.serve_config = val
+
+    # -- job lifecycle -----------------------------------------------------
+
+    def submit(self, job_id: str, entrypoint: str, runtime_env=None,
+               metadata=None) -> JobRecord:
+        with self._lock:
+            if job_id in self.jobs:
+                return self.jobs[job_id]
+            rec = JobRecord(job_id, entrypoint, runtime_env, metadata)
+            self.jobs[job_id] = rec
+            self._persist_job(rec)
+        if self.spawn_jobs:
+            self._spawn(rec)
+        return rec
+
+    def _spawn(self, rec: JobRecord):
+        rec.log_path = os.path.join(self.log_dir, f"{rec.job_id}.log")
+        env = dict(os.environ)
+        for k, v in rec.runtime_env.items():
+            env[str(k)] = str(v)
+        logf = open(rec.log_path, "ab")
+        try:
+            rec.proc = subprocess.Popen(
+                rec.entrypoint, shell=True, stdout=logf, stderr=logf, env=env)
+            rec.status = "RUNNING"
+        except OSError as e:
+            rec.status = "FAILED"
+            rec.message = str(e)
+            rec.end_time = time.time()
+        self._persist_job(rec)
+        if rec.proc is not None:
+            threading.Thread(target=self._wait, args=(rec,),
+                             daemon=True).start()
+
+    def _wait(self, rec: JobRecord):
+        code = rec.proc.wait()
+        with self._lock:
+            if rec.status == "RUNNING":
+                rec.status = "SUCCEEDED" if code == 0 else "FAILED"
+                rec.message = f"exit code {code}"
+            rec.end_time = time.time()
+            self._persist_job(rec)
+
+    def stop(self, job_id: str) -> bool:
+        with self._lock:
+            rec = self.jobs.get(job_id)
+            if rec is None:
+                return False
+            rec.status = "STOPPED"
+            rec.end_time = time.time()
+            if rec.proc is not None and rec.proc.poll() is None:
+                rec.proc.terminate()
+            self._persist_job(rec)
+            return True
+
+    def delete(self, job_id: str) -> bool:
+        self.stop(job_id)
+        with self._lock:
+            if self.jobs.pop(job_id, None) is None:
+                return False
+        self.state.delete(f"job:{job_id}")
+        return True
+
+    # -- serve -------------------------------------------------------------
+
+    def put_serve_config(self, config: Dict[str, Any]):
+        with self._lock:
+            self.serve_config = config
+            self.state.save("serve_config", config)
+            # Applications deploy asynchronously in a real cluster; status
+            # is reported by the serving processes via PUT status (or by
+            # the engine in-process).
+            for app in config.get("applications", []):
+                name = app.get("name", "default")
+                self.serve_apps.setdefault(
+                    name, {"status": "DEPLOYING", "message": ""})
+
+    def set_app_status(self, name: str, status: str, message: str = ""):
+        with self._lock:
+            self.serve_apps[name] = {"status": status, "message": message}
+
+    # -- HTTP --------------------------------------------------------------
+
+    def make_server(self, host: str = "0.0.0.0",
+                    port: int = C.PORT_DASHBOARD) -> ThreadingHTTPServer:
+        coord = self
+
+        class Handler(JsonHandler):
+            def do_GET(self):
+                if self.path == "/api/healthz":
+                    return self._send(200, {"status": "ok"})
+                if self.path == "/api/cluster":
+                    return self._send(200, {
+                        "cluster_name": os.environ.get(C.ENV_CLUSTER_NAME, ""),
+                        "num_jobs": len(coord.jobs),
+                    })
+                if self.path == "/api/jobs/":
+                    return self._send(200, {"jobs": [
+                        r.to_dict() for r in coord.jobs.values()]})
+                if self.path.endswith("/logs") and \
+                        self.path.startswith("/api/jobs/"):
+                    jid = self.path.rsplit("/", 2)[1]
+                    rec = coord.jobs.get(jid)
+                    if rec is None:
+                        return self._send(404, {"message": "not found"})
+                    text = ""
+                    if rec.log_path and os.path.exists(rec.log_path):
+                        with open(rec.log_path, "rb") as f:
+                            text = f.read().decode(errors="replace")
+                    return self._send(200, {"logs": text})
+                if self.path.startswith("/api/jobs/"):
+                    jid = self.path.rsplit("/", 1)[1]
+                    rec = coord.jobs.get(jid)
+                    if rec is None:
+                        return self._send(404, {"message": "not found"})
+                    return self._send(200, rec.to_dict())
+                if self.path == "/api/serve/applications/":
+                    return self._send(200, dict(coord.serve_apps))
+                return self._send(404, {"message": "unknown path"})
+
+            def do_POST(self):
+                if self.path == "/api/jobs/":
+                    b = self._body()
+                    rec = coord.submit(
+                        b.get("submission_id") or f"job-{int(time.time())}",
+                        b.get("entrypoint", ""), b.get("runtime_env"),
+                        b.get("metadata"))
+                    return self._send(200, {"submission_id": rec.job_id})
+                if self.path.endswith("/stop"):
+                    jid = self.path.rsplit("/", 2)[1]
+                    ok = coord.stop(jid)
+                    return self._send(200 if ok else 404,
+                                      {"stopped": ok})
+                return self._send(404, {"message": "unknown path"})
+
+            def do_PUT(self):
+                if self.path == "/api/serve/applications/":
+                    coord.put_serve_config(self._body())
+                    return self._send(200, {})
+                if self.path.startswith("/api/serve/applications/") and \
+                        self.path.endswith("/status"):
+                    name = self.path.rsplit("/", 2)[1]
+                    b = self._body()
+                    coord.set_app_status(name, b.get("status", "RUNNING"),
+                                         b.get("message", ""))
+                    return self._send(200, {})
+                return self._send(404, {"message": "unknown path"})
+
+            def do_DELETE(self):
+                if self.path.startswith("/api/jobs/"):
+                    jid = self.path.rsplit("/", 1)[1]
+                    ok = coord.delete(jid)
+                    return self._send(200 if ok else 404, {"deleted": ok})
+                return self._send(404, {"message": "unknown path"})
+
+        return ThreadingHTTPServer((host, port), Handler)
+
+    def serve_background(self, host="127.0.0.1", port=0):
+        srv = self.make_server(host, port)
+        threading.Thread(target=srv.serve_forever, daemon=True,
+                         name="coordinator-http").start()
+        return srv, f"http://{srv.server_address[0]}:{srv.server_address[1]}"
+
+
+def main(argv=None):  # pragma: no cover - thin process wrapper
+    import argparse
+    ap = argparse.ArgumentParser(prog="tpu-coordinator")
+    ap.add_argument("--port", type=int, default=C.PORT_DASHBOARD)
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--log-dir", default="/tmp/tpu-coordinator-logs")
+    args = ap.parse_args(argv)
+    coord = CoordinatorServer(log_dir=args.log_dir)
+    srv = coord.make_server(args.host, args.port)
+    print(f"coordinator serving on {args.host}:{args.port}", flush=True)
+    srv.serve_forever()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -1,0 +1,92 @@
+"""Submitter tool: what the K8s submitter Job runs (ref common/job.go:90
+``ray job submit`` wrapper).  ``python -m kuberay_tpu.runtime.submit``.
+
+Idempotent: submitting an existing job id re-attaches instead of failing,
+and ``--tail-logs`` exits with the job's final status so the K8s Job's
+success/failure mirrors the application's.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+from kuberay_tpu.runtime.coordinator_client import (
+    CoordinatorClient,
+    CoordinatorError,
+)
+from kuberay_tpu.utils import constants as C
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="tpu-submit")
+    ap.add_argument("--address", required=True,
+                    help="coordinator host[:port] (head service)")
+    ap.add_argument("--job-id", required=True)
+    ap.add_argument("--no-wait", action="store_true")
+    ap.add_argument("--tail-logs", action="store_true")
+    ap.add_argument("--poll-seconds", type=float, default=2.0)
+    ap.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    args = ap.parse_args(argv)
+
+    host, _, port = args.address.partition(":")
+    # The address usually carries the coordinator port; the job API lives
+    # on the dashboard port unless an explicit port was given.
+    port = port or str(C.PORT_DASHBOARD)
+    if port == str(C.PORT_COORDINATOR):
+        port = str(C.PORT_DASHBOARD)
+    client = CoordinatorClient(f"http://{host}:{port}")
+
+    entry = [a for a in args.entrypoint if a != "--"]
+    submitted = False
+    if entry:
+        try:
+            client.submit_job(args.job_id, " ".join(entry))
+            submitted = True
+            print(f"submitted {args.job_id}", flush=True)
+        except CoordinatorError as e:
+            print(f"submit failed: {e}", file=sys.stderr)
+            return 1
+        if args.no_wait and not args.tail_logs:
+            return 0
+
+    # Attach: poll until terminal; exit code reflects the outcome.  A job
+    # id the coordinator does not know (and that we did not just submit)
+    # is a hard error, not a retry; transient failures are bounded.
+    consecutive_errors = 0
+    log_offset = 0
+    while True:
+        try:
+            info = client.get_job_info(args.job_id)
+            consecutive_errors = 0
+        except CoordinatorError as e:
+            if "404" in str(e) and not submitted:
+                print(f"job {args.job_id} not found", file=sys.stderr)
+                return 1
+            consecutive_errors += 1
+            if consecutive_errors > 30:
+                print(f"giving up after {consecutive_errors} failed polls: {e}",
+                      file=sys.stderr)
+                return 1
+            print(f"status poll failed: {e}", file=sys.stderr, flush=True)
+            time.sleep(args.poll_seconds)
+            continue
+        if args.tail_logs:
+            try:
+                logs = client.get_job_logs(args.job_id)
+                if len(logs) > log_offset:
+                    sys.stdout.write(logs[log_offset:])
+                    sys.stdout.flush()
+                    log_offset = len(logs)
+            except CoordinatorError:
+                pass
+        if info.status in ("SUCCEEDED", "FAILED", "STOPPED"):
+            print(f"job {args.job_id}: {info.status} {info.message}",
+                  flush=True)
+            return 0 if info.status == "SUCCEEDED" else 1
+        time.sleep(args.poll_seconds)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

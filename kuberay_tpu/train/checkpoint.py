@@ -1,0 +1,65 @@
+"""Checkpoint/resume via Orbax (SURVEY.md §5.4).
+
+The reference delegates application checkpointing entirely to user code;
+here it is first-class: sharded async-capable saves of the full train
+state (params + optimizer + step), restore onto a (possibly different)
+mesh via target shardings, and retention pruning.  Control-plane
+resume-after-restart stays free (CR status in the store), exactly like
+the reference's level-triggered design.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, Optional
+
+import jax
+
+
+def _manager(directory: str, keep: int = 3):
+    import orbax.checkpoint as ocp
+    options = ocp.CheckpointManagerOptions(max_to_keep=keep, create=True)
+    return ocp.CheckpointManager(os.path.abspath(directory), options=options)
+
+
+def save(directory: str, state: Dict[str, Any], step: int,
+         keep: int = 3) -> None:
+    import orbax.checkpoint as ocp
+    mgr = _manager(directory, keep)
+    mgr.save(step, args=ocp.args.StandardSave(state))
+    mgr.wait_until_finished()
+    mgr.close()
+
+
+def latest_step(directory: str) -> Optional[int]:
+    if not os.path.isdir(directory):
+        return None
+    mgr = _manager(directory)
+    step = mgr.latest_step()
+    mgr.close()
+    return step
+
+
+def restore(directory: str, step: int, abstract_state) -> Dict[str, Any]:
+    """``abstract_state``: jax.ShapeDtypeStruct tree (with shardings) of the
+    target state — restores laid out directly on the mesh."""
+    import orbax.checkpoint as ocp
+    mgr = _manager(directory)
+    out = mgr.restore(step, args=ocp.args.StandardRestore(abstract_state))
+    mgr.close()
+    return out
+
+
+def restore_latest(directory: str, init_fn: Callable, init_key,
+                   shardings=None) -> Optional[Dict[str, Any]]:
+    """Restore the newest checkpoint, shaped like ``init_fn(init_key)``;
+    None when no checkpoint exists."""
+    step = latest_step(directory)
+    if step is None:
+        return None
+    abstract = jax.eval_shape(init_fn, init_key)
+    if shardings is not None:
+        abstract = jax.tree.map(
+            lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+            abstract, shardings)
+    return restore(directory, step, abstract)

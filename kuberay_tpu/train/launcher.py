@@ -1,0 +1,169 @@
+"""In-pod training launcher: the consumer of the operator's env contract.
+
+What a worker container actually runs.  Reads the identity the builders
+injected (TPU_WORKER_ID / TPU_WORKER_HOSTNAMES / TPU_TOPOLOGY /
+coordinator address / megascale vars — builders/pod.py), initializes
+``jax.distributed``, builds the mesh, and runs the training loop.  The
+reference's equivalent contract is RAY_ADDRESS + `ray start` inside the
+container plus GKE's TPU webhook env (SURVEY.md §5.7/§5.8) — here it is
+one first-party module:
+
+    python -m kuberay_tpu.train.launcher --model llama_1b --steps 1000 \
+        --data /data/shard.bin
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import os
+import sys
+import time
+from typing import Optional
+
+
+@dataclasses.dataclass
+class WorkerIdentity:
+    """Parsed slice identity (pure; unit-testable without hardware)."""
+
+    worker_id: int
+    num_workers: int
+    hostnames: list
+    topology: str
+    coordinator: str          # jax.distributed coordinator address
+    num_slices: int = 1
+    slice_id: int = 0
+
+    @classmethod
+    def from_env(cls, env=None) -> "WorkerIdentity":
+        from kuberay_tpu.utils import constants as C
+        e = env or os.environ
+        hostnames = [h for h in e.get(C.ENV_TPU_WORKER_HOSTNAMES, "").split(",")
+                     if h]
+        num = int(e.get(C.ENV_NUM_PROCESSES, len(hostnames) or 1))
+        # jax.distributed coordinator = worker 0 (stable DNS via headless
+        # service), on the MXLA port; single-host falls back to local.
+        coord = hostnames[0] + f":{C.PORT_MXLA}" if hostnames else ""
+        return cls(
+            worker_id=int(e.get(C.ENV_TPU_WORKER_ID, "0")),
+            num_workers=num,
+            hostnames=hostnames,
+            topology=e.get(C.ENV_TPU_TOPOLOGY, ""),
+            coordinator=coord,
+            num_slices=int(e.get(C.ENV_MEGASCALE_NUM_SLICES, "1")),
+            slice_id=int(e.get(C.ENV_MEGASCALE_SLICE_ID, "0")),
+        )
+
+    @property
+    def is_distributed(self) -> bool:
+        return self.num_workers > 1 or self.num_slices > 1
+
+    @property
+    def global_process_id(self) -> int:
+        return self.slice_id * self.num_workers + self.worker_id
+
+    @property
+    def global_process_count(self) -> int:
+        return self.num_slices * self.num_workers
+
+
+def initialize_distributed(ident: Optional[WorkerIdentity] = None):
+    """jax.distributed bootstrap from the injected env (no-op single-host)."""
+    ident = ident or WorkerIdentity.from_env()
+    if not ident.is_distributed:
+        return ident
+    import jax
+    jax.distributed.initialize(
+        coordinator_address=ident.coordinator,
+        num_processes=ident.global_process_count,
+        process_id=ident.global_process_id)
+    return ident
+
+
+def build_mesh(tp: Optional[int] = None, sp: int = 1, ep: int = 1):
+    import jax
+    from kuberay_tpu.parallel.mesh import MeshSpec
+    n = len(jax.devices())
+    tp = tp or min(n, jax.local_device_count())
+    return MeshSpec(dp=1, fsdp=-1, tp=tp, sp=sp, ep=ep).build()
+
+
+def train(args) -> int:
+    from kuberay_tpu.utils.platform import pin_platform_from_env
+    pin_platform_from_env()
+    import jax
+    import jax.numpy as jnp
+    from kuberay_tpu.models import llama
+    from kuberay_tpu.train.train_step import (
+        TrainConfig, make_sharded_train_fns)
+    from kuberay_tpu.train.data import TokenShardLoader, synthetic_shard
+    from kuberay_tpu.train import checkpoint as ckpt
+
+    ident = initialize_distributed()
+    cfg = llama.CONFIGS[args.model]
+    mesh = build_mesh(tp=args.tp, sp=args.sp)
+    tc = TrainConfig(learning_rate=args.lr,
+                     warmup_steps=min(args.warmup, max(1, args.steps // 10)),
+                     decay_steps=args.steps)
+    init, step_fn, shardings = make_sharded_train_fns(cfg, tc, mesh)
+
+    state = None
+    if args.checkpoint_dir:
+        state = ckpt.restore_latest(args.checkpoint_dir, init,
+                                    jax.random.PRNGKey(args.seed), shardings)
+    if state is None:
+        state = init(jax.random.PRNGKey(args.seed))
+
+    if args.data:
+        loader = TokenShardLoader(args.data, args.seq_len, args.batch,
+                                  seed=args.seed)
+    else:
+        # Every worker generates its own local synthetic shard (/tmp is
+        # per-host); pid suffix avoids races between co-located processes.
+        path = f"/tmp/tpu-synthetic-shard-{ident.worker_id}-{os.getpid()}.bin"
+        synthetic_shard(path, 2_000_000, cfg.vocab_size, args.seed)
+        loader = TokenShardLoader(path, args.seq_len, args.batch,
+                                  seed=args.seed)
+
+    start_step = int(state["step"])
+    t0 = time.time()
+    for i in range(start_step, args.steps):
+        batch = loader.next()
+        state, metrics = step_fn(state, {
+            "tokens": jnp.asarray(batch["tokens"]),
+            "targets": jnp.asarray(batch["targets"])})
+        if (i + 1) % args.log_every == 0 and ident.worker_id == 0:
+            loss = float(metrics["loss"])
+            tok_s = args.batch * args.seq_len * args.log_every / (
+                time.time() - t0)
+            print(f"step {i + 1} loss {loss:.4f} tok/s {tok_s:.0f}",
+                  flush=True)
+            t0 = time.time()
+        if args.checkpoint_dir and (i + 1) % args.checkpoint_every == 0:
+            ckpt.save(args.checkpoint_dir, state, i + 1)
+    if args.checkpoint_dir:
+        ckpt.save(args.checkpoint_dir, state, args.steps)
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="tpu-train-launcher")
+    ap.add_argument("--model", default="llama_1b")
+    ap.add_argument("--steps", type=int, default=100)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq-len", type=int, default=1024)
+    ap.add_argument("--lr", type=float, default=3e-4)
+    ap.add_argument("--warmup", type=int, default=100)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--tp", type=int, default=None)
+    ap.add_argument("--sp", type=int, default=1)
+    ap.add_argument("--data", default="", help="token shard path")
+    ap.add_argument("--checkpoint-dir", default="")
+    ap.add_argument("--checkpoint-every", type=int, default=500)
+    ap.add_argument("--log-every", type=int, default=10)
+    args = ap.parse_args(argv)
+    return train(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

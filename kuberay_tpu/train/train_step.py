@@ -33,9 +33,12 @@ class TrainConfig:
 
 
 def make_optimizer(tc: TrainConfig) -> optax.GradientTransformation:
+    # Short runs: warmup can never consume the whole schedule (optax
+    # requires decay_steps > warmup_steps).
+    warmup = min(tc.warmup_steps, max(0, tc.decay_steps - 1))
     schedule = optax.warmup_cosine_decay_schedule(
         init_value=0.0, peak_value=tc.learning_rate,
-        warmup_steps=tc.warmup_steps, decay_steps=tc.decay_steps,
+        warmup_steps=warmup, decay_steps=max(tc.decay_steps, warmup + 1),
         end_value=tc.learning_rate * 0.1)
     return optax.chain(
         optax.clip_by_global_norm(tc.grad_clip),

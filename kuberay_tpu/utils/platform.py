@@ -1,0 +1,19 @@
+"""Platform pinning: make JAX honor the JAX_PLATFORMS env var in-process.
+
+Some hosting environments install site hooks that force a hardware plugin
+into ``jax_platforms`` regardless of the env var; when the var names an
+explicit platform list, re-assert it through the config API so CPU-only
+runs never dial hardware tunnels."""
+
+from __future__ import annotations
+
+import os
+
+
+def pin_platform_from_env() -> None:
+    want = os.environ.get("JAX_PLATFORMS", "").strip().lower()
+    if not want:
+        return
+    import jax
+    if str(jax.config.jax_platforms or "").strip().lower() != want:
+        jax.config.update("jax_platforms", want)
