@@ -32,16 +32,8 @@ from kuberay_tpu.controlplane.store import (
 )
 from kuberay_tpu.utils import constants as C
 from kuberay_tpu.utils.httpjson import JsonHandler
-from kuberay_tpu.utils.validation import (
-    validate_cluster,
-    validate_cronjob,
-    validate_job,
-    validate_service,
-)
-from kuberay_tpu.api.tpucluster import TpuCluster
-from kuberay_tpu.api.tpucronjob import TpuCronJob
-from kuberay_tpu.api.tpujob import TpuJob
-from kuberay_tpu.api.tpuservice import TpuService
+from kuberay_tpu.utils.validation import kind_validators
+from kuberay_tpu.controlplane.webhooks import validate_admission
 
 PLURALS = {
     "tpuclusters": C.KIND_CLUSTER,
@@ -53,12 +45,7 @@ CORE_PLURALS = {"pods": "Pod", "services": "Service", "events": "Event",
                 "podgroups": "PodGroup", "networkpolicies": "NetworkPolicy",
                 "jobs": "Job"}
 
-_VALIDATORS = {
-    C.KIND_CLUSTER: lambda d: validate_cluster(TpuCluster.from_dict(d)),
-    C.KIND_JOB: lambda d: validate_job(TpuJob.from_dict(d)),
-    C.KIND_SERVICE: lambda d: validate_service(TpuService.from_dict(d)),
-    C.KIND_CRONJOB: lambda d: validate_cronjob(TpuCronJob.from_dict(d)),
-}
+_VALIDATORS = kind_validators()
 
 _CRD_RE = re.compile(
     r"^/apis/tpu\.dev/v1/namespaces/(?P<ns>[^/]+)/(?P<plural>[^/]+)"
@@ -176,9 +163,11 @@ class ApiHandler(JsonHandler):
         if obj["metadata"].get("namespace", ns) != ns:
             return self._error(400, "namespace mismatch with path")
         if sub != "status":
-            validator = _VALIDATORS.get(kind)
-            if validator:
-                errs = validator(obj)
+            # Full admission (schema + update-immutability rules, the
+            # webhook-shared surface).
+            old = self.store.try_get(kind, name, ns)
+            if kind in _VALIDATORS:
+                errs = validate_admission(obj, old)
                 if errs:
                     return self._error(422, "; ".join(errs))
         try:

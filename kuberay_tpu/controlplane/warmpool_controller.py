@@ -1,0 +1,186 @@
+"""Warm slice pools: pre-provisioned slices for slice-ready latency.
+
+The podpool analogue (ref podpool/ — a virtual-kubelet keeping pre-warmed
+pods to skip scheduling/image-pull/volume time; the reference's is an
+early scaffold with CreatePod unimplemented, manager.go:63-70).  Here the
+pool maintenance loop is functional and slice-granular, behind the
+``WarmSlicePools`` alpha gate:
+
+- a ``WarmSlicePool`` object declares (accelerator, topology, poolSize,
+  template); the controller keeps exactly poolSize healthy warm slices
+  standing (pods carry the pool label, full TPU env, no cluster identity);
+- unhealthy/incomplete warm slices are replaced whole (same invariant as
+  cluster slices);
+- ``claim()`` hands a warm slice's pods to a consumer (returns the pod
+  names and releases them from pool management) — the adoption protocol a
+  virtual-kubelet/scheduler integration builds on; the north-star metric
+  this exists for is slice-ready p50 (BASELINE.json).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from kuberay_tpu.api.common import ObjectMeta, PodTemplateSpec, Serializable
+from kuberay_tpu.api.tpucluster import TpuCluster, TpuClusterSpec, WorkerGroupSpec
+from kuberay_tpu.builders.pod import build_slice_pods
+from kuberay_tpu.controlplane.events import EventRecorder
+from kuberay_tpu.controlplane.store import AlreadyExists, NotFound, ObjectStore
+from kuberay_tpu.topology import TopologyError
+from kuberay_tpu.utils import constants as C
+from kuberay_tpu.utils import features
+
+KIND_WARM_POOL = "WarmSlicePool"
+LABEL_WARM_POOL = "tpu.dev/warm-pool"
+LABEL_WARM_CLAIMED = "tpu.dev/warm-claimed"
+
+
+import dataclasses
+
+
+@dataclasses.dataclass
+class WarmSlicePoolSpec(Serializable):
+    accelerator: str = "v5e"
+    topology: str = "2x2"
+    poolSize: int = 1
+    template: PodTemplateSpec = dataclasses.field(default_factory=PodTemplateSpec)
+
+    @classmethod
+    def _nested_types(cls):
+        return {"template": PodTemplateSpec}
+
+
+class WarmSlicePoolController:
+    KIND = KIND_WARM_POOL
+
+    def __init__(self, store: ObjectStore,
+                 recorder: Optional[EventRecorder] = None):
+        self.store = store
+        self.recorder = recorder or EventRecorder(store)
+
+    def _pool_cluster(self, obj: Dict[str, Any]) -> TpuCluster:
+        """A warm pool reuses the slice builders via a synthetic cluster
+        shell (pure construction, nothing stored)."""
+        spec = WarmSlicePoolSpec.from_dict(obj.get("spec", {}))
+        group = WorkerGroupSpec(
+            groupName="warm", accelerator=spec.accelerator,
+            topology=spec.topology, replicas=spec.poolSize,
+            maxReplicas=max(spec.poolSize, 1), template=spec.template)
+        return TpuCluster(
+            metadata=ObjectMeta(
+                name=f"warmpool-{obj['metadata']['name']}",
+                namespace=obj["metadata"].get("namespace", "default"),
+                uid=obj["metadata"].get("uid", "")),
+            spec=TpuClusterSpec(workerGroupSpecs=[group]))
+
+    def _pool_pods(self, name: str, ns: str) -> Dict[int, List[dict]]:
+        pods = self.store.list("Pod", ns, labels={LABEL_WARM_POOL: name})
+        out: Dict[int, List[dict]] = {}
+        for p in pods:
+            if p["metadata"]["labels"].get(LABEL_WARM_CLAIMED):
+                continue
+            if p["metadata"].get("deletionTimestamp"):
+                continue
+            idx = int(p["metadata"]["labels"].get(C.LABEL_SLICE_INDEX, -1))
+            out.setdefault(idx, []).append(p)
+        return out
+
+    def reconcile(self, name: str, namespace: str = "default") -> Optional[float]:
+        if not features.enabled("WarmSlicePools"):
+            return None
+        obj = self.store.try_get(self.KIND, name, namespace)
+        if obj is None or obj["metadata"].get("deletionTimestamp"):
+            return None
+        try:
+            shell = self._pool_cluster(obj)
+            group = shell.spec.workerGroupSpecs[0]
+            topo = group.slice_topology()
+        except TopologyError as e:
+            self.recorder.warning(obj, C.EVENT_INVALID_SPEC, str(e))
+            return None
+
+        spec = WarmSlicePoolSpec.from_dict(obj.get("spec", {}))
+        slices = self._pool_pods(name, namespace)
+        hosts = topo.num_hosts
+        # Replace incomplete / unhealthy warm slices whole.
+        for idx, plist in list(slices.items()):
+            bad = (idx < 0 or len(plist) != hosts or any(
+                p.get("status", {}).get("phase") in ("Failed", "Succeeded")
+                for p in plist))
+            if bad:
+                for p in plist:
+                    try:
+                        self.store.delete("Pod", p["metadata"]["name"],
+                                          namespace)
+                    except NotFound:
+                        pass
+                del slices[idx]
+
+        want = max(0, spec.poolSize)    # parsed spec: documented default 1
+        have = len(slices)
+        if have < want:
+            used = set(slices)
+            idx = 0
+            while have < want:
+                probe = build_slice_pods(shell, group, idx)[0]
+                # Claimed slices keep their (deterministic) pod names until
+                # the adopter deletes them — skip occupied indices.
+                occupied = self.store.try_get(
+                    "Pod", probe["metadata"]["name"], namespace) is not None
+                if idx in used or occupied:
+                    idx += 1
+                    continue
+                for pod in build_slice_pods(shell, group, idx):
+                    pod["metadata"]["labels"][LABEL_WARM_POOL] = name
+                    # Warm pods belong to the pool object, not a cluster.
+                    pod["metadata"]["labels"].pop(C.LABEL_CLUSTER, None)
+                    pod["metadata"]["ownerReferences"] = [{
+                        "apiVersion": C.API_VERSION, "kind": self.KIND,
+                        "name": name, "uid": obj["metadata"].get("uid", ""),
+                        "controller": True, "blockOwnerDeletion": True,
+                    }]
+                    try:
+                        self.store.create(pod)
+                    except AlreadyExists:
+                        pass
+                self.recorder.normal(obj, "WarmedSlice",
+                                     f"pre-provisioned warm slice {idx}")
+                used.add(idx)
+                have += 1
+        elif have > want:
+            for idx in sorted(slices, reverse=True)[:have - want]:
+                for p in slices[idx]:
+                    try:
+                        self.store.delete("Pod", p["metadata"]["name"],
+                                          namespace)
+                    except NotFound:
+                        pass
+
+        # Status: warm/ready counts (one post-converge scan).
+        final = self._pool_pods(name, namespace)
+        ready = sum(1 for plist in final.values()
+                    if len(plist) == hosts and all(
+                        p.get("status", {}).get("phase") == "Running"
+                        for p in plist))
+        status = {"warmSlices": len(final),
+                  "readySlices": ready, "hostsPerSlice": hosts}
+        if obj.get("status") != status:
+            obj["status"] = status
+            obj["metadata"].pop("resourceVersion", None)
+            self.store.update_status(obj)
+        return None
+
+    def claim(self, name: str, namespace: str = "default") -> Optional[List[str]]:
+        """Claim one ready warm slice: marks its pods claimed and returns
+        their names (the adopter takes over their lifecycle)."""
+        for idx, plist in sorted(self._pool_pods(name, namespace).items()):
+            if all(p.get("status", {}).get("phase") == "Running"
+                   for p in plist):
+                names = []
+                for p in plist:
+                    self.store.patch_labels(
+                        "Pod", p["metadata"]["name"], namespace,
+                        {LABEL_WARM_CLAIMED: "true"})
+                    names.append(p["metadata"]["name"])
+                return names
+        return None

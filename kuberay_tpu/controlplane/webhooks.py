@@ -1,0 +1,81 @@
+"""Admission webhooks (ref pkg/webhooks/v1: raycluster_webhook.go:20-80 +
+rayservice_webhook.go — optional validating webhooks sharing
+utils/validation).
+
+The handler speaks the K8s AdmissionReview v1 protocol so the same module
+serves a real API server's ValidatingWebhookConfiguration; embedded mode
+(our apiserver) reuses ``validate_admission`` directly — one validation
+surface, two front doors, exactly the reference's sharing arrangement.
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import ThreadingHTTPServer
+from typing import Any, Dict, List
+
+from kuberay_tpu.utils import constants as C
+from kuberay_tpu.utils.httpjson import JsonHandler
+from kuberay_tpu.utils.validation import kind_validators
+
+_VALIDATORS = kind_validators()
+
+
+def validate_admission(obj: Dict[str, Any],
+                       old_obj: Dict[str, Any] = None) -> List[str]:
+    """Validation + update-immutability rules (ref webhook Update checks:
+    worker group names must not be renamed/removed in place)."""
+    kind = obj.get("kind", "")
+    validator = _VALIDATORS.get(kind)
+    errs = validator(obj) if validator else []
+    if old_obj is not None and kind == C.KIND_CLUSTER:
+        old_groups = [g.get("groupName") for g in
+                      old_obj.get("spec", {}).get("workerGroupSpecs", [])]
+        new_groups = {g.get("groupName") for g in
+                      obj.get("spec", {}).get("workerGroupSpecs", [])}
+        for g in old_groups:
+            if g not in new_groups:
+                errs.append(
+                    f"worker group {g!r} cannot be removed or renamed "
+                    "(delete and recreate the cluster instead)")
+    return errs
+
+
+def review_response(review: Dict[str, Any]) -> Dict[str, Any]:
+    """AdmissionReview request -> AdmissionReview response."""
+    req = review.get("request", {})
+    obj = req.get("object") or {}
+    old = req.get("oldObject")
+    errs = validate_admission(obj, old)
+    resp = {
+        "uid": req.get("uid", ""),
+        "allowed": not errs,
+    }
+    if errs:
+        resp["status"] = {"code": 422, "message": "; ".join(errs)}
+    return {"apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+            "response": resp}
+
+
+class WebhookServer:
+    """HTTP endpoint for ValidatingWebhookConfiguration targets
+    (``POST /validate``)."""
+
+    def make_server(self, host="127.0.0.1", port=0) -> ThreadingHTTPServer:
+        class Handler(JsonHandler):
+            def do_POST(self):
+                if self.path.rstrip("/") != "/validate":
+                    return self._send(404, {"message": "unknown path"})
+                try:
+                    review = self._body()
+                except Exception as e:
+                    return self._send(400, {"message": f"bad body: {e}"})
+                return self._send(200, review_response(review))
+
+        return ThreadingHTTPServer((host, port), Handler)
+
+    def serve_background(self, host="127.0.0.1", port=0):
+        srv = self.make_server(host, port)
+        threading.Thread(target=srv.serve_forever, daemon=True,
+                         name="webhook-server").start()
+        return srv, f"http://{srv.server_address[0]}:{srv.server_address[1]}"

@@ -35,6 +35,10 @@ from kuberay_tpu.controlplane.manager import (
 from kuberay_tpu.controlplane.networkpolicy_controller import NetworkPolicyController
 from kuberay_tpu.controlplane.service_controller import TpuServiceController
 from kuberay_tpu.controlplane.store import ObjectStore
+from kuberay_tpu.controlplane.warmpool_controller import (
+    KIND_WARM_POOL,
+    WarmSlicePoolController,
+)
 from kuberay_tpu.runtime.coordinator_client import default_client_provider
 from kuberay_tpu.scheduler.adapters import KaiAdapter, VolcanoAdapter, YuniKornAdapter
 from kuberay_tpu.scheduler.gang import GangScheduler
@@ -82,6 +86,8 @@ class Operator:
         self.cronjob_controller = TpuCronJobController(
             self.store, recorder=self.recorder)
         self.networkpolicy_controller = NetworkPolicyController(self.store)
+        self.warmpool_controller = WarmSlicePoolController(
+            self.store, recorder=self.recorder)
         self.autoscaler = SliceAutoscaler(self.store)
 
         m = self.manager
@@ -94,6 +100,21 @@ class Operator:
         if features.enabled("TpuCronJob"):
             m.register(C.KIND_CRONJOB, self._timed(
                 C.KIND_CRONJOB, self.cronjob_controller.reconcile))
+        if features.enabled("WarmSlicePools"):
+            m.register(KIND_WARM_POOL, self._timed(
+                KIND_WARM_POOL, self.warmpool_controller.reconcile))
+            # Warm pods carry the pool label; their churn re-reconciles it.
+            from kuberay_tpu.controlplane.warmpool_controller import LABEL_WARM_POOL
+
+            def warm_pod_mapper(ev):
+                if ev.kind != "Pod":
+                    return None
+                md = ev.obj.get("metadata", {})
+                pool = md.get("labels", {}).get(LABEL_WARM_POOL)
+                if not pool:
+                    return None
+                return (KIND_WARM_POOL, md.get("namespace", "default"), pool)
+            m.map_owned(warm_pod_mapper)
         m.map_owned(owned_pod_mapper)
         m.map_owned(originated_from_mapper(C.KIND_JOB))
         m.map_owned(originated_from_mapper(C.KIND_SERVICE))

@@ -10,6 +10,7 @@ collectives.  Axis names used across the framework:
             fastest ICI axis)
 - ``sp``:   sequence/context parallel for ring attention (ICI neighbors)
 - ``ep``:   expert parallel for MoE (all-to-all)
+- ``pp``:   pipeline parallel (stage-per-slice, ppermute activation hops)
 
 A TpuCluster worker group maps to this as: slices = dp axis, hosts within a
 slice = fsdp/sp, chips within a host = tp (SURVEY.md §2.3 table).
@@ -31,12 +32,13 @@ class MeshSpec:
     """Logical mesh shape.  Axis size -1 means 'absorb remaining devices'."""
 
     dp: int = 1
+    pp: int = 1
     fsdp: int = -1
     tp: int = 1
     sp: int = 1
     ep: int = 1
 
-    AXES = ("dp", "fsdp", "tp", "sp", "ep")
+    AXES = ("dp", "pp", "fsdp", "tp", "sp", "ep")
 
     def resolve(self, n_devices: int) -> Dict[str, int]:
         sizes = {a: getattr(self, a) for a in self.AXES}

@@ -1,0 +1,113 @@
+"""Pipeline parallelism: GPipe-style stage pipelining over the ``pp`` axis.
+
+The SURVEY §2.3 PP row: the reference delegates pipeline placement to Ray
+placement groups; here PP is a framework op.  TPU-first shape:
+
+- stages = contiguous layer blocks; the stacked layer params
+  ([n_layers, ...]) shard over ``pp`` along the layer axis, so each device
+  holds exactly its stage's weights;
+- microbatches stream through the stages with ``lax.ppermute``
+  point-to-point activation transfers (ICI neighbors when the mesh is laid
+  out along the ring, which topology.host_ring_order guarantees);
+- the classic GPipe schedule: n_micro + n_stages - 1 ticks, the bubble
+  shrinking as n_micro grows; everything is a single ``lax.scan`` under
+  ``shard_map`` — one compiled program, no per-tick dispatch.
+
+Differentiable end-to-end (scan + ppermute transpose cleanly), so the same
+op serves training; the orchestration contract it needs from the control
+plane is stage-per-slice placement with stable ring order (host-index
+labels + megascale slice ids).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _pipeline_sharded(stage_params, x_micro, *, layer_fn, axis_name,
+                      n_stages):
+    """Runs INSIDE shard_map.
+
+    stage_params: this stage's layer stack [L/P, ...] (leading dim local).
+    x_micro: [n_micro, mb, ...] full microbatch set (replicated input).
+    Returns [n_micro, mb, ...] outputs (identical on every stage).
+    """
+    stage = lax.axis_index(axis_name)
+    n_micro = x_micro.shape[0]
+
+    def apply_stage(x):
+        def body(h, lp):
+            return layer_fn(h, lp), None
+        out, _ = lax.scan(body, x, stage_params)
+        return out
+
+    zero = jnp.zeros_like(x_micro[0])
+
+    def tick(carry, t):
+        recv, outputs = carry
+        # Stage 0 ingests microbatch t; others consume what arrived.
+        mb_idx = jnp.clip(t, 0, n_micro - 1)
+        x_in = jnp.where(stage == 0, x_micro[mb_idx], recv)
+        # Active window: stage s processes microbatch t-s.
+        active = jnp.logical_and(t - stage >= 0, t - stage < n_micro)
+        y = jnp.where(active, apply_stage(x_in), zero)
+        # Last stage banks its result at slot t-(P-1).
+        out_slot = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
+        bank = jnp.logical_and(active, stage == n_stages - 1)
+        outputs = jnp.where(
+            bank,
+            outputs.at[out_slot].set(y),
+            outputs)
+        # Hand activations to the next stage (ICI neighbor hop).
+        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+        recv = lax.ppermute(y, axis_name, perm)
+        return (recv, outputs), None
+
+    outputs0 = jnp.zeros_like(x_micro)
+    (_, outputs), _ = lax.scan(
+        tick, (zero, outputs0), jnp.arange(n_micro + n_stages - 1))
+    # Only the last stage holds real outputs; share them with every stage
+    # (masked psum == broadcast from last stage).
+    outputs = jnp.where(stage == n_stages - 1, outputs, jnp.zeros_like(outputs))
+    return lax.psum(outputs, axis_name)
+
+
+def pipeline_apply(layer_fn: Callable, stacked_params: Any,
+                   x: jax.Array, mesh: Mesh, axis_name: str = "pp",
+                   n_microbatches: int = None) -> jax.Array:
+    """Apply a stack of layers as a pipeline over ``axis_name``.
+
+    layer_fn(h, layer_params) -> h  (one layer; same signature the models'
+    scan bodies use).  stacked_params: pytree with leading [n_layers] dim,
+    n_layers divisible by the pp axis size.  x: [batch, ...] activations;
+    batch divisible by n_microbatches.
+    """
+    n_stages = mesh.shape[axis_name]
+    n_layers = jax.tree.leaves(stacked_params)[0].shape[0]
+    if n_layers % n_stages != 0:
+        raise ValueError(f"{n_layers} layers not divisible into "
+                         f"{n_stages} stages")
+    n_micro = n_microbatches or n_stages
+    batch = x.shape[0]
+    if batch % n_micro != 0:
+        raise ValueError(f"batch {batch} not divisible into "
+                         f"{n_micro} microbatches")
+    x_micro = x.reshape(n_micro, batch // n_micro, *x.shape[1:])
+
+    # Params shard over pp along the layer axis; activations replicate.
+    param_spec = jax.tree.map(lambda _: P(axis_name), stacked_params)
+    fn = functools.partial(_pipeline_sharded, layer_fn=layer_fn,
+                           axis_name=axis_name, n_stages=n_stages)
+    out = jax.shard_map(
+        fn, mesh=mesh,
+        in_specs=(param_spec, P()),
+        out_specs=P(),
+        check_vma=False,
+    )(stacked_params, x_micro)
+    return out.reshape(batch, *x.shape[1:])

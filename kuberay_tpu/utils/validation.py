@@ -209,3 +209,14 @@ def validate_cronjob(cron: TpuCronJob) -> List[str]:
     tmpl_job.metadata.name = cron.metadata.name or "template"
     errs.extend(f"jobTemplate: {e}" for e in validate_job(tmpl_job))
     return errs
+
+
+def kind_validators():
+    """kind -> dict-validating callable (shared by the apiserver and the
+    admission webhook — one validation surface, two front doors)."""
+    return {
+        "TpuCluster": lambda d: validate_cluster(TpuCluster.from_dict(d)),
+        "TpuJob": lambda d: validate_job(TpuJob.from_dict(d)),
+        "TpuService": lambda d: validate_service(TpuService.from_dict(d)),
+        "TpuCronJob": lambda d: validate_cronjob(TpuCronJob.from_dict(d)),
+    }

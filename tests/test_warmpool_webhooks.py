@@ -1,0 +1,174 @@
+"""Warm slice pools + admission webhooks."""
+
+import json
+import urllib.request
+
+import pytest
+
+from kuberay_tpu.controlplane.fake_kubelet import FakeKubelet
+from kuberay_tpu.controlplane.store import ObjectStore
+from kuberay_tpu.controlplane.warmpool_controller import (
+    KIND_WARM_POOL,
+    LABEL_WARM_CLAIMED,
+    LABEL_WARM_POOL,
+    WarmSlicePoolController,
+)
+from kuberay_tpu.controlplane.webhooks import (
+    WebhookServer,
+    review_response,
+    validate_admission,
+)
+from kuberay_tpu.utils import constants as C
+from kuberay_tpu.utils import features
+from tests.test_api_types import make_cluster
+
+
+@pytest.fixture(autouse=True)
+def gates():
+    features.reset()
+    features.set_gates({"WarmSlicePools": True})
+    yield
+    features.reset()
+
+
+def make_pool(store, size=2):
+    store.create({
+        "apiVersion": C.API_VERSION, "kind": KIND_WARM_POOL,
+        "metadata": {"name": "pool1", "namespace": "default"},
+        "spec": {"accelerator": "v5p", "topology": "2x2x2",
+                 "poolSize": size,
+                 "template": {"spec": {"containers": [
+                     {"name": "w", "image": "rt:warm"}]}}},
+    })
+
+
+def test_pool_maintains_warm_slices():
+    store = ObjectStore()
+    kubelet = FakeKubelet(store)
+    ctrl = WarmSlicePoolController(store)
+    make_pool(store, size=2)
+    ctrl.reconcile("pool1")
+    pods = store.list("Pod", labels={LABEL_WARM_POOL: "pool1"})
+    assert len(pods) == 4   # 2 slices x 2 hosts
+    kubelet.step()
+    ctrl.reconcile("pool1")
+    st = store.get(KIND_WARM_POOL, "pool1")["status"]
+    assert st == {"warmSlices": 2, "readySlices": 2, "hostsPerSlice": 2}
+    # Warm pods carry full TPU env but no cluster identity.
+    env = {e["name"]: e["value"]
+           for e in pods[0]["spec"]["containers"][0]["env"]}
+    assert env[C.ENV_TPU_TOPOLOGY] == "2x2x2"
+    assert C.LABEL_CLUSTER not in pods[0]["metadata"]["labels"]
+
+
+def test_pool_replaces_failed_slice():
+    store = ObjectStore()
+    kubelet = FakeKubelet(store)
+    ctrl = WarmSlicePoolController(store)
+    make_pool(store, size=1)
+    ctrl.reconcile("pool1")
+    kubelet.step()
+    victim = store.list("Pod", labels={LABEL_WARM_POOL: "pool1"})[0]
+    kubelet.fail_pod(victim["metadata"]["name"])
+    ctrl.reconcile("pool1")      # deletes the bad slice
+    ctrl.reconcile("pool1")      # re-provisions
+    kubelet.step()
+    ctrl.reconcile("pool1")
+    st = store.get(KIND_WARM_POOL, "pool1")["status"]
+    assert st["readySlices"] == 1
+
+
+def test_pool_claim_releases_slice():
+    store = ObjectStore()
+    kubelet = FakeKubelet(store)
+    ctrl = WarmSlicePoolController(store)
+    make_pool(store, size=2)
+    ctrl.reconcile("pool1")
+    kubelet.step()
+    names = ctrl.claim("pool1")
+    assert names and len(names) == 2
+    claimed = store.get("Pod", names[0])
+    assert claimed["metadata"]["labels"][LABEL_WARM_CLAIMED] == "true"
+    # Pool backfills to poolSize on next pass.
+    ctrl.reconcile("pool1")
+    unclaimed = [p for p in store.list("Pod", labels={LABEL_WARM_POOL: "pool1"})
+                 if not p["metadata"]["labels"].get(LABEL_WARM_CLAIMED)]
+    assert len(unclaimed) == 4
+
+
+def test_pool_gate_off():
+    features.reset()
+    store = ObjectStore()
+    ctrl = WarmSlicePoolController(store)
+    make_pool(store)
+    ctrl.reconcile("pool1")
+    assert store.list("Pod") == []
+
+
+def test_warmpool_wired_into_operator():
+    """Gate on -> the live operator provisions warm slices end-to-end."""
+    from kuberay_tpu.api.config import OperatorConfiguration
+    from kuberay_tpu.operator import Operator
+    op = Operator(OperatorConfiguration(
+        featureGates={"WarmSlicePools": True}), fake_kubelet=True)
+    try:
+        make_pool(op.store, size=1)
+        for _ in range(6):
+            op.run_until_idle()
+        st = op.store.get(KIND_WARM_POOL, "pool1").get("status", {})
+        assert st.get("readySlices") == 1
+    finally:
+        op.stop()
+
+
+def test_apiserver_update_enforces_immutability():
+    """The embedded API path enforces the same rules as the webhook."""
+    from kuberay_tpu.apiserver.server import serve_background
+    from kuberay_tpu.cli.client import ApiClient, ApiError
+    from kuberay_tpu.controlplane.store import ObjectStore
+    store = ObjectStore()
+    srv, url = serve_background(store)
+    try:
+        client = ApiClient(url)
+        client.create(make_cluster().to_dict())
+        obj = client.get("TpuCluster", "demo")
+        obj["spec"]["workerGroupSpecs"][0]["groupName"] = "renamed"
+        with pytest.raises(ApiError) as exc:
+            client.update(obj)
+        assert exc.value.code == 422
+        assert "renamed" in str(exc.value)
+    finally:
+        srv.shutdown()
+
+
+def test_admission_update_immutability():
+    old = make_cluster().to_dict()
+    new = make_cluster().to_dict()
+    assert validate_admission(new, old) == []
+    renamed = make_cluster().to_dict()
+    renamed["spec"]["workerGroupSpecs"][0]["groupName"] = "renamed"
+    errs = validate_admission(renamed, old)
+    assert any("cannot be removed or renamed" in e for e in errs)
+
+
+def test_webhook_server_admission_review():
+    srv, url = WebhookServer().serve_background()
+    try:
+        review = {"request": {"uid": "u1",
+                              "object": make_cluster().to_dict()}}
+        req = urllib.request.Request(
+            f"{url}/validate", data=json.dumps(review).encode(),
+            headers={"Content-Type": "application/json"})
+        out = json.load(urllib.request.urlopen(req))
+        assert out["response"]["allowed"] is True
+        assert out["response"]["uid"] == "u1"
+        bad = {"request": {"uid": "u2",
+                           "object": make_cluster(topology="9x9").to_dict()}}
+        req = urllib.request.Request(
+            f"{url}/validate", data=json.dumps(bad).encode(),
+            headers={"Content-Type": "application/json"})
+        out = json.load(urllib.request.urlopen(req))
+        assert out["response"]["allowed"] is False
+        assert out["response"]["status"]["code"] == 422
+    finally:
+        srv.shutdown()
