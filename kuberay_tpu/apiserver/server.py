@@ -32,20 +32,24 @@ from kuberay_tpu.controlplane.store import (
 )
 from kuberay_tpu.utils import constants as C
 from kuberay_tpu.utils.httpjson import JsonHandler
-from kuberay_tpu.utils.validation import kind_validators
 from kuberay_tpu.controlplane.webhooks import validate_admission
+from kuberay_tpu.utils.validation import kind_validators
 
 PLURALS = {
     "tpuclusters": C.KIND_CLUSTER,
     "tpujobs": C.KIND_JOB,
     "tpuservices": C.KIND_SERVICE,
     "tpucronjobs": C.KIND_CRONJOB,
+    "warmslicepools": "WarmSlicePool",
+    "trafficroutes": "TrafficRoute",
 }
 CORE_PLURALS = {"pods": "Pod", "services": "Service", "events": "Event",
                 "podgroups": "PodGroup", "networkpolicies": "NetworkPolicy",
                 "jobs": "Job"}
 
-_VALIDATORS = kind_validators()
+# Kinds with admission validation (the single surface lives in
+# controlplane/webhooks.validate_admission; this is membership only).
+_VALIDATED_KINDS = frozenset(kind_validators())
 
 _CRD_RE = re.compile(
     r"^/apis/tpu\.dev/v1/namespaces/(?P<ns>[^/]+)/(?P<plural>[^/]+)"
@@ -126,9 +130,8 @@ class ApiHandler(JsonHandler):
         obj.setdefault("metadata", {}).setdefault("namespace", ns)
         if obj["kind"] != kind:
             return self._error(400, f"kind mismatch: {obj['kind']} != {kind}")
-        validator = _VALIDATORS.get(kind)
-        if validator:
-            errs = validator(obj)
+        if kind in _VALIDATED_KINDS:
+            errs = validate_admission(obj, None)
             if errs:
                 return self._error(422, "; ".join(errs))
         try:
@@ -166,7 +169,7 @@ class ApiHandler(JsonHandler):
             # Full admission (schema + update-immutability rules, the
             # webhook-shared surface).
             old = self.store.try_get(kind, name, ns)
-            if kind in _VALIDATORS:
+            if kind in _VALIDATED_KINDS:
                 errs = validate_admission(obj, old)
                 if errs:
                     return self._error(422, "; ".join(errs))

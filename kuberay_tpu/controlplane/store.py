@@ -59,13 +59,41 @@ class Event:
 
 class ObjectStore:
     """Objects are plain dicts with apiVersion/kind/metadata/spec/status —
-    exactly the ``to_dict`` form of the api/ dataclasses."""
+    exactly the ``to_dict`` form of the api/ dataclasses.
+
+    Label indexing: lookups on the indexed label keys are O(matches), not
+    O(objects) — the role the reference's scoped informer caches play for
+    10k-cluster scale (internal/managercache/cache.go:18).
+    """
+
+    INDEXED_LABELS = ("tpu.dev/cluster", "tpu.dev/warm-pool",
+                      "tpu.dev/originated-from-cr-name")
 
     def __init__(self):
         self._lock = threading.RLock()
         self._objects: Dict[Tuple[str, str, str], Dict[str, Any]] = {}
         self._rv = 0
         self._watchers: List[Callable[[Event], None]] = []
+        # (label_key, label_value) -> set of object keys
+        self._label_index: Dict[Tuple[str, str], set] = {}
+
+    def _index_add(self, key, obj):
+        labels = obj.get("metadata", {}).get("labels", {}) or {}
+        for lk in self.INDEXED_LABELS:
+            lv = labels.get(lk)
+            if lv is not None:
+                self._label_index.setdefault((lk, lv), set()).add(key)
+
+    def _index_remove(self, key, obj):
+        labels = obj.get("metadata", {}).get("labels", {}) or {}
+        for lk in self.INDEXED_LABELS:
+            lv = labels.get(lk)
+            if lv is not None:
+                bucket = self._label_index.get((lk, lv))
+                if bucket is not None:
+                    bucket.discard(key)
+                    if not bucket:
+                        del self._label_index[(lk, lv)]
 
     # -- helpers -----------------------------------------------------------
 
@@ -110,6 +138,7 @@ class ObjectStore:
             md["resourceVersion"] = self._next_rv()
             md.setdefault("generation", 1)
             self._objects[k] = obj
+            self._index_add(k, obj)
             out = copy.deepcopy(obj)
             self._notify(Event(Event.ADDED, kind, copy.deepcopy(obj)))
         return out
@@ -130,18 +159,32 @@ class ObjectStore:
     def list(self, kind: str, namespace: Optional[str] = None,
              labels: Optional[Dict[str, str]] = None) -> List[Dict[str, Any]]:
         with self._lock:
+            items = None
+            if labels:
+                for lk, lv in labels.items():
+                    if lk in self.INDEXED_LABELS:
+                        bucket = self._label_index.get((lk, lv), set())
+                        items = [self._objects[k] for k in bucket
+                                 if k in self._objects]
+                        break
+            if items is None:
+                items = [obj for (k, _, _), obj in self._objects.items()
+                         if k == kind]
             out = []
-            for (k, ns, _), obj in self._objects.items():
-                if k != kind:
+            for obj in items:
+                if obj.get("kind") != kind:
                     continue
-                if namespace is not None and ns != namespace:
+                md = obj.get("metadata", {})
+                if namespace is not None and md.get("namespace") != namespace:
                     continue
                 if labels:
-                    obj_labels = obj.get("metadata", {}).get("labels", {})
-                    if any(obj_labels.get(lk) != lv for lk, lv in labels.items()):
+                    obj_labels = md.get("labels", {}) or {}
+                    if any(obj_labels.get(lk) != lv
+                           for lk, lv in labels.items()):
                         continue
                 out.append(copy.deepcopy(obj))
-            out.sort(key=lambda o: (o["metadata"]["namespace"], o["metadata"]["name"]))
+            out.sort(key=lambda o: (o["metadata"]["namespace"],
+                                    o["metadata"]["name"]))
             return out
 
     def update(self, obj: Dict[str, Any], *, subresource: str = "") -> Dict[str, Any]:
@@ -182,7 +225,9 @@ class ObjectStore:
                 # status only via subresource
                 new["status"] = cur.get("status", {})
             new["metadata"]["resourceVersion"] = self._next_rv()
+            self._index_remove(k, cur)
             self._objects[k] = new
+            self._index_add(k, new)
             out = copy.deepcopy(new)
             self._notify(Event(Event.MODIFIED, kind, copy.deepcopy(new)))
         # Deleting an object is finalized outside the lock path; check here:
@@ -198,12 +243,15 @@ class ObjectStore:
             cur = self._objects.get(_key(kind, namespace, name))
             if cur is None:
                 raise NotFound(f"{kind} {namespace}/{name} not found")
+            key = _key(kind, namespace, name)
+            self._index_remove(key, cur)
             lab = cur["metadata"].setdefault("labels", {})
             for k, v in labels.items():
                 if v is None:
                     lab.pop(k, None)
                 else:
                     lab[k] = v
+            self._index_add(key, cur)
             cur["metadata"]["resourceVersion"] = self._next_rv()
             self._notify(Event(Event.MODIFIED, kind, copy.deepcopy(cur)))
             return copy.deepcopy(cur)
@@ -257,6 +305,7 @@ class ObjectStore:
             if (cur is not None and cur["metadata"].get("deletionTimestamp")
                     and not cur["metadata"].get("finalizers")):
                 removed = self._objects.pop(k)
+                self._index_remove(k, removed)
                 self._notify(Event(Event.DELETED, kind, copy.deepcopy(removed)))
         if removed is not None:
             self._cascade_delete(removed)

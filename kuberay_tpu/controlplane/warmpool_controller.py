@@ -122,15 +122,18 @@ class WarmSlicePoolController:
             used = set(slices)
             idx = 0
             while have < want:
-                probe = build_slice_pods(shell, group, idx)[0]
+                pods = build_slice_pods(shell, group, idx)
                 # Claimed slices keep their (deterministic) pod names until
-                # the adopter deletes them — skip occupied indices.
-                occupied = self.store.try_get(
-                    "Pod", probe["metadata"]["name"], namespace) is not None
+                # the adopter deletes them — an index is occupied while ANY
+                # of its host names survives (partial teardown included).
+                occupied = any(
+                    self.store.try_get("Pod", p["metadata"]["name"],
+                                       namespace) is not None
+                    for p in pods)
                 if idx in used or occupied:
                     idx += 1
                     continue
-                for pod in build_slice_pods(shell, group, idx):
+                for pod in pods:
                     pod["metadata"]["labels"][LABEL_WARM_POOL] = name
                     # Warm pods belong to the pool object, not a cluster.
                     pod["metadata"]["labels"].pop(C.LABEL_CLUSTER, None)
@@ -172,10 +175,20 @@ class WarmSlicePoolController:
 
     def claim(self, name: str, namespace: str = "default") -> Optional[List[str]]:
         """Claim one ready warm slice: marks its pods claimed and returns
-        their names (the adopter takes over their lifecycle)."""
+        their names (the adopter takes over their lifecycle).  Only
+        COMPLETE slices qualify — a partial slice has no ICI ring."""
+        obj = self.store.try_get(self.KIND, name, namespace)
+        if obj is None:
+            return None
+        try:
+            hosts = self._pool_cluster(obj).spec.workerGroupSpecs[0] \
+                .slice_topology().num_hosts
+        except TopologyError:
+            return None
         for idx, plist in sorted(self._pool_pods(name, namespace).items()):
-            if all(p.get("status", {}).get("phase") == "Running"
-                   for p in plist):
+            if idx >= 0 and len(plist) == hosts and all(
+                    p.get("status", {}).get("phase") == "Running"
+                    for p in plist):
                 names = []
                 for p in plist:
                     self.store.patch_labels(

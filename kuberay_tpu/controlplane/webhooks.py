@@ -58,8 +58,18 @@ def review_response(review: Dict[str, Any]) -> Dict[str, Any]:
 
 
 class WebhookServer:
-    """HTTP endpoint for ValidatingWebhookConfiguration targets
-    (``POST /validate``)."""
+    """Endpoint for ValidatingWebhookConfiguration targets
+    (``POST /validate``).
+
+    Kubernetes requires webhook backends to serve HTTPS — pass
+    ``certfile``/``keyfile`` (the serving cert whose CA goes in the
+    configuration's ``caBundle``) for real-cluster use; plain HTTP is for
+    embedded/tests only.
+    """
+
+    def __init__(self, certfile: str = "", keyfile: str = ""):
+        self.certfile = certfile
+        self.keyfile = keyfile
 
     def make_server(self, host="127.0.0.1", port=0) -> ThreadingHTTPServer:
         class Handler(JsonHandler):
@@ -72,10 +82,17 @@ class WebhookServer:
                     return self._send(400, {"message": f"bad body: {e}"})
                 return self._send(200, review_response(review))
 
-        return ThreadingHTTPServer((host, port), Handler)
+        srv = ThreadingHTTPServer((host, port), Handler)
+        if self.certfile:
+            import ssl
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(self.certfile, self.keyfile or None)
+            srv.socket = ctx.wrap_socket(srv.socket, server_side=True)
+        return srv
 
     def serve_background(self, host="127.0.0.1", port=0):
         srv = self.make_server(host, port)
         threading.Thread(target=srv.serve_forever, daemon=True,
                          name="webhook-server").start()
-        return srv, f"http://{srv.server_address[0]}:{srv.server_address[1]}"
+        scheme = "https" if self.certfile else "http"
+        return srv, f"{scheme}://{srv.server_address[0]}:{srv.server_address[1]}"

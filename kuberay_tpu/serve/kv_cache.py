@@ -78,15 +78,24 @@ def forward_with_cache(cfg: LlamaConfig, params: Dict[str, Any],
         vv = (h @ lp["wv"]).reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
         q = apply_rope(q, cos, sin, positions)
         kk = apply_rope(kk, cos, sin, positions)
-        # Insert new K/V at each slot's offset (per-row dynamic slice via
-        # one-hot scatter keeps shapes static); masked rows write nothing.
-        slot_ids = positions                                   # [B, T]
-        onehot = (jax.nn.one_hot(slot_ids, ck.shape[1], dtype=ck.dtype)
-                  * write_mask[:, None, None].astype(ck.dtype))  # [B,T,max]
-        ck = ck * (1 - onehot.sum(1)[..., None, None]) + \
-            jnp.einsum("btm,bthd->bmhd", onehot, kk)
-        cv = cv * (1 - onehot.sum(1)[..., None, None]) + \
-            jnp.einsum("btm,bthd->bmhd", onehot, vv)
+        # Insert new K/V at each slot's offset; masked rows write nothing.
+        if T == 1:
+            # Decode hot path: per-row dynamic_update_slice (O(1) writes)
+            # instead of an O(max_len) one-hot contraction per token.
+            def upd(cache_row, new_row, pos, m):
+                written = jax.lax.dynamic_update_slice(
+                    cache_row, new_row.astype(cache_row.dtype), (pos, 0, 0))
+                return jnp.where(m > 0, written, cache_row)
+            ck = jax.vmap(upd)(ck, kk, start, write_mask)
+            cv = jax.vmap(upd)(cv, vv, start, write_mask)
+        else:
+            # Prefill: one-hot scatter keeps shapes static for T tokens.
+            onehot = (jax.nn.one_hot(positions, ck.shape[1], dtype=ck.dtype)
+                      * write_mask[:, None, None].astype(ck.dtype))  # [B,T,max]
+            ck = ck * (1 - onehot.sum(1)[..., None, None]) + \
+                jnp.einsum("btm,bthd->bmhd", onehot, kk)
+            cv = cv * (1 - onehot.sum(1)[..., None, None]) + \
+                jnp.einsum("btm,bthd->bmhd", onehot, vv)
         attn = _cached_attention(q, ck, cv, lens, positions)
         x = x + (attn.reshape(B, T, -1) @ lp["wo"]).astype(x.dtype)
         h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)

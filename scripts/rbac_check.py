@@ -1,0 +1,88 @@
+#!/usr/bin/env python3
+"""RBAC consistency checker (ref scripts/rbac-check.py): every object kind
+the control plane reads/writes must be granted in manifests/operator.yaml.
+
+Static scan: kinds appearing as first string literal argument to
+store.<verb>("Kind", ...) / ensure payloads across kuberay_tpu/, compared
+against the ClusterRole rules.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import re
+import sys
+
+import yaml
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+# kind -> (apiGroup, plural)
+KIND_TABLE = {
+    "TpuCluster": ("tpu.dev", "tpuclusters"),
+    "TpuJob": ("tpu.dev", "tpujobs"),
+    "TpuService": ("tpu.dev", "tpuservices"),
+    "TpuCronJob": ("tpu.dev", "tpucronjobs"),
+    "WarmSlicePool": ("tpu.dev", "warmslicepools"),
+    "PodGroup": ("scheduling.volcano.sh", "podgroups"),
+    "TrafficRoute": ("tpu.dev", "trafficroutes"),
+    "Pod": ("", "pods"),
+    "Service": ("", "services"),
+    "Event": ("", "events"),
+    "Job": ("batch", "jobs"),
+    "NetworkPolicy": ("networking.k8s.io", "networkpolicies"),
+}
+
+CALL_RE = re.compile(
+    r"""(?:store|self\.store)\.(?:get|try_get|list|create|update|delete|
+        update_status|patch_labels|add_finalizer|remove_finalizer|count)
+        \(\s*["']([A-Za-z]+)["']""", re.X)
+
+
+def used_kinds() -> set:
+    kinds = set()
+    for path in (REPO / "kuberay_tpu").rglob("*.py"):
+        for m in CALL_RE.finditer(path.read_text()):
+            kinds.add(m.group(1))
+    # Kinds created via full object dicts:
+    for path in (REPO / "kuberay_tpu").rglob("*.py"):
+        for m in re.finditer(r'"kind":\s*["\']([A-Za-z]+)["\']', path.read_text()):
+            kinds.add(m.group(1))
+    kinds.discard("Counter")   # test fixtures
+    kinds.discard("X")
+    return {k for k in kinds if k in KIND_TABLE}
+
+
+def granted() -> set:
+    out = set()
+    docs = yaml.safe_load_all((REPO / "manifests/operator.yaml").read_text())
+    for doc in docs:
+        if not doc or doc.get("kind") != "ClusterRole":
+            continue
+        for rule in doc.get("rules", []):
+            groups = rule.get("apiGroups", [])
+            for res in rule.get("resources", []):
+                res = res.split("/")[0]
+                for g in groups:
+                    out.add((g, res))
+    return out
+
+
+def main() -> int:
+    grants = granted()
+    missing = []
+    for kind in sorted(used_kinds()):
+        group, plural = KIND_TABLE[kind]
+        if (group, plural) not in grants:
+            missing.append(f"{kind} ({group or 'core'}/{plural})")
+    if missing:
+        print("RBAC MISSING for kinds the operator touches:")
+        for m in missing:
+            print(f"  - {m}")
+        return 1
+    print(f"rbac ok: {len(used_kinds())} kinds covered")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

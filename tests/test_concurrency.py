@@ -1,0 +1,145 @@
+"""Distributed-state race tests (SURVEY §5.2: the reference runs Go -race
+in CI and mitigates logical races architecturally — expectations, single
+writer, optimistic concurrency).  Here: hammer the store and controllers
+from many threads and assert the invariants hold at quiescence."""
+
+import threading
+import time
+
+import pytest
+
+from kuberay_tpu.controlplane.store import (
+    AlreadyExists,
+    Conflict,
+    NotFound,
+    ObjectStore,
+)
+from kuberay_tpu.utils import constants as C
+from tests.test_api_types import make_cluster
+from tests.test_cluster_controller import Harness
+
+
+def test_store_concurrent_updates_conflict_correctly():
+    """Optimistic concurrency: N racers increment a counter via
+    read-modify-write with rv checks; total must equal successful writes."""
+    store = ObjectStore()
+    store.create({"apiVersion": "v1", "kind": "Counter",
+                  "metadata": {"name": "c"}, "spec": {"n": 0}, "status": {}})
+    successes = []
+    lock = threading.Lock()
+
+    def racer():
+        for _ in range(50):
+            obj = store.get("Counter", "c")
+            obj["spec"]["n"] += 1
+            try:
+                store.update(obj)
+                with lock:
+                    successes.append(1)
+            except Conflict:
+                pass
+
+    threads = [threading.Thread(target=racer) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    final = store.get("Counter", "c")["spec"]["n"]
+    assert final == len(successes)
+    assert final >= 50  # at least one thread's worth made it
+
+
+def test_store_concurrent_create_exactly_once():
+    store = ObjectStore()
+    wins = []
+    lock = threading.Lock()
+
+    def creator(i):
+        try:
+            store.create({"apiVersion": "v1", "kind": "X",
+                          "metadata": {"name": "solo"}, "spec": {"by": i},
+                          "status": {}})
+            with lock:
+                wins.append(i)
+        except AlreadyExists:
+            pass
+
+    threads = [threading.Thread(target=creator, args=(i,)) for i in range(16)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(wins) == 1
+    assert store.count("X") == 1
+
+
+def test_threaded_reconcile_no_partial_slices():
+    """Concurrent reconciles + kubelet churn + scale flapping: at
+    quiescence every surviving slice is complete (the core invariant)."""
+    h = Harness()
+    c = make_cluster(accelerator="v5p", topology="2x2x2", replicas=2)
+    c.spec.workerGroupSpecs[0].maxReplicas = 4
+    h.store.create(c.to_dict())
+    h.manager.start(workers=3)
+    stop = threading.Event()
+
+    def kubelet_loop():
+        while not stop.is_set():
+            h.kubelet.step()
+            time.sleep(0.01)
+
+    def flapper():
+        for replicas in (3, 1, 4, 2, 3, 2):
+            for _ in range(5):
+                try:
+                    obj = h.store.get(C.KIND_CLUSTER, "demo")
+                    obj["spec"]["workerGroupSpecs"][0]["replicas"] = replicas
+                    h.store.update(obj)
+                    break
+                except Conflict:
+                    time.sleep(0.01)
+            time.sleep(0.08)
+
+    kt = threading.Thread(target=kubelet_loop)
+    ft = threading.Thread(target=flapper)
+    kt.start()
+    ft.start()
+    ft.join()
+    time.sleep(1.0)
+    # Let everything settle.
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        h.manager.flush_delayed()
+        time.sleep(0.3)
+        cluster = h.store.get(C.KIND_CLUSTER, "demo")
+        if cluster.get("status", {}).get("readySlices") == 2:
+            break
+    stop.set()
+    kt.join()
+    h.manager.stop()
+
+    workers = h.store.list("Pod", labels={C.LABEL_NODE_TYPE: "worker"})
+    by_slice = {}
+    for p in workers:
+        if p["metadata"].get("deletionTimestamp"):
+            continue
+        by_slice.setdefault(
+            p["metadata"]["labels"][C.LABEL_SLICE_NAME], []).append(p)
+    # Invariant: every surviving slice has exactly its full host set.
+    for sname, plist in by_slice.items():
+        hosts = {p["metadata"]["labels"][C.LABEL_HOST_INDEX] for p in plist}
+        assert hosts == {"0", "1"}, (sname, hosts)
+    assert len(by_slice) == 2
+    cluster = h.store.get(C.KIND_CLUSTER, "demo")
+    assert cluster["status"]["readySlices"] == 2
+
+
+def test_watchers_never_poison_store():
+    """A crashing watcher must not break writers (ref: informer isolation)."""
+    store = ObjectStore()
+
+    def bad_watcher(ev):
+        raise RuntimeError("boom")
+    store.watch(bad_watcher)
+    store.create(make_cluster().to_dict())     # must not raise
+    assert store.count(C.KIND_CLUSTER) == 1
