@@ -104,6 +104,9 @@ def build_head_pod(cluster: TpuCluster,
     if cluster.spec.enableInTreeAutoscaling:
         containers.append(build_autoscaler_container(cluster))
 
+    if cluster.spec.schedulerName and not pod_spec.get("schedulerName"):
+        pod_spec["schedulerName"] = cluster.spec.schedulerName
+
     labels = {**tmpl.get("metadata", {}).get("labels", {}),
               **_base_labels(cluster, C.NODE_TYPE_HEAD)}
     return {
@@ -203,8 +206,8 @@ def build_worker_pod(cluster: TpuCluster, group: WorkerGroupSpec,
     pod_spec["hostname"] = pod_name
     pod_spec["subdomain"] = headless_service_name(name)
 
-    if cluster.spec.schedulerName:
-        pod_spec.setdefault("schedulerName", cluster.spec.schedulerName)
+    if cluster.spec.schedulerName and not pod_spec.get("schedulerName"):
+        pod_spec["schedulerName"] = cluster.spec.schedulerName
 
     labels = {
         **tmpl.get("metadata", {}).get("labels", {}),

@@ -87,6 +87,11 @@ class CoordinatorClient:
     def update_serve_apps(self, config: Dict[str, Any]) -> None:
         self._req("PUT", "/api/serve/applications/", config)
 
+    def set_serve_app_status(self, name: str, status: str,
+                             message: str = "") -> None:
+        self._req("PUT", f"/api/serve/applications/{name}/status",
+                  {"status": status, "message": message})
+
     def get_serve_apps(self) -> Dict[str, Any]:
         return self._req("GET", "/api/serve/applications/")
 

@@ -1,0 +1,197 @@
+"""Inference HTTP server: the PORT_SERVE surface a TpuService fronts.
+
+What runs behind the serve Services the controller manages (the Ray
+Serve + vLLM role).  A background engine thread drains the continuous
+batcher; HTTP handlers enqueue requests and wait on per-request events:
+
+    POST /v1/completions   {"prompt_tokens": [...], "max_tokens": N,
+                            "temperature": T}  ->  {"tokens": [...], ...}
+    GET  /healthz | /stats
+
+Token-id in/out (tokenization is the client's concern here; a tokenizer
+sidecar slots in front for text APIs).  On startup the server registers
+its serve-app status with the coordinator so the TpuService controller's
+health polling sees RUNNING (runtime/coordinator_server.py PUT
+/api/serve/applications/{name}/status).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import uuid
+from http.server import ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+from kuberay_tpu.serve.engine import Request, Response, ServeEngine
+from kuberay_tpu.utils import constants as C
+from kuberay_tpu.utils.httpjson import JsonHandler
+
+
+class ServeFrontend:
+    def __init__(self, engine: ServeEngine, max_queue: int = 256):
+        self.engine = engine
+        self.max_queue = max_queue
+        self._lock = threading.Lock()
+        self._waiters: Dict[str, threading.Event] = {}
+        self._results: Dict[str, Response] = {}
+        self._stop = threading.Event()
+        self._stats = {"requests": 0, "completed": 0, "rejected": 0,
+                       "tokens_out": 0}
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="serve-engine-loop")
+        self._thread.start()
+
+    # -- engine loop -------------------------------------------------------
+
+    def _loop(self):
+        while not self._stop.is_set():
+            if not self.engine.has_work():
+                self._stop.wait(0.005)
+                continue
+            for resp in self.engine.step():
+                with self._lock:
+                    self._stats["completed"] += 1
+                    self._stats["tokens_out"] += len(resp.tokens)
+                    ev = self._waiters.pop(resp.request_id, None)
+                    if ev is not None:
+                        # Only park results someone still waits for — a
+                        # timed-out client already gave up, and an orphaned
+                        # entry would leak forever.
+                        self._results[resp.request_id] = resp
+                if ev is not None:
+                    ev.set()
+
+    def submit(self, prompt_tokens, max_tokens=64, temperature=0.0,
+               eos_token=None, timeout: float = 300.0) -> Optional[Response]:
+        rid = uuid.uuid4().hex
+        ev = threading.Event()
+        with self._lock:
+            backlog = len(self.engine.queue)
+            if backlog >= self.max_queue:
+                self._stats["rejected"] += 1
+                return None
+            self._stats["requests"] += 1
+            self._waiters[rid] = ev
+            self.engine.add_request(Request(
+                rid, list(prompt_tokens), max_new_tokens=max_tokens,
+                temperature=temperature, eos_token=eos_token))
+        if not ev.wait(timeout):
+            with self._lock:
+                self._waiters.pop(rid, None)
+            return None
+        with self._lock:
+            return self._results.pop(rid)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {**self._stats,
+                    "active_slots": self.engine.num_active,
+                    "queued": len(self.engine.queue)}
+
+    def close(self):
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+
+    # -- HTTP --------------------------------------------------------------
+
+    def make_server(self, host="0.0.0.0",
+                    port=C.PORT_SERVE) -> ThreadingHTTPServer:
+        frontend = self
+
+        class Handler(JsonHandler):
+            def do_GET(self):
+                if self.path == "/healthz":
+                    return self._send(200, {"status": "ok"})
+                if self.path == "/stats":
+                    return self._send(200, frontend.stats())
+                return self._send(404, {"message": "unknown path"})
+
+            def do_POST(self):
+                if self.path != "/v1/completions":
+                    return self._send(404, {"message": "unknown path"})
+                try:
+                    body = self._body()
+                except Exception as e:
+                    return self._send(400, {"message": f"bad body: {e}"})
+                if not isinstance(body, dict):
+                    return self._send(400, {"message": "body must be a JSON "
+                                                       "object"})
+                prompt = body.get("prompt_tokens")
+                if not isinstance(prompt, list) or not prompt or \
+                        not all(isinstance(t, int) for t in prompt):
+                    return self._send(
+                        400, {"message": "prompt_tokens must be a non-empty "
+                                         "list of token ids"})
+                try:
+                    max_tokens = int(body.get("max_tokens", 64))
+                    temperature = float(body.get("temperature", 0.0))
+                    timeout = float(body.get("timeout", 300.0))
+                except (TypeError, ValueError) as e:
+                    return self._send(400, {"message": f"bad parameter: {e}"})
+                resp = frontend.submit(
+                    prompt, max_tokens=max_tokens, temperature=temperature,
+                    eos_token=body.get("eos_token"), timeout=timeout)
+                if resp is None:
+                    return self._send(503, {"message": "overloaded or timed out"})
+                return self._send(200, {
+                    "id": resp.request_id,
+                    "tokens": resp.tokens,
+                    "finish_reason": resp.finish_reason,
+                    "prompt_len": resp.prompt_len,
+                })
+
+        return ThreadingHTTPServer((host, port), Handler)
+
+    def serve_background(self, host="127.0.0.1", port=0):
+        srv = self.make_server(host, port)
+        threading.Thread(target=srv.serve_forever, daemon=True,
+                         name="serve-http").start()
+        return srv, f"http://{srv.server_address[0]}:{srv.server_address[1]}"
+
+
+def register_with_coordinator(app_name: str, coordinator_url: str,
+                              status: str = "RUNNING") -> bool:
+    """Report serve-app health to the head coordinator (what flips the
+    TpuService controller's app status to RUNNING)."""
+    from kuberay_tpu.runtime.coordinator_client import (
+        CoordinatorClient, CoordinatorError)
+    try:
+        CoordinatorClient(coordinator_url).set_serve_app_status(
+            app_name, status)
+        return True
+    except CoordinatorError:
+        return False
+
+
+def main(argv=None):  # pragma: no cover - process wrapper
+    import argparse
+    from kuberay_tpu.utils.platform import pin_platform_from_env
+    pin_platform_from_env()
+    import jax
+    from kuberay_tpu.models import llama
+    ap = argparse.ArgumentParser(prog="tpu-serve")
+    ap.add_argument("--model", default="llama_1b")
+    ap.add_argument("--port", type=int, default=C.PORT_SERVE)
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--max-slots", type=int, default=8)
+    ap.add_argument("--max-len", type=int, default=2048)
+    ap.add_argument("--app-name", default="llm")
+    ap.add_argument("--coordinator", default="")
+    args = ap.parse_args(argv)
+
+    cfg = llama.CONFIGS[args.model]
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    engine = ServeEngine(cfg, params, max_slots=args.max_slots,
+                         max_len=args.max_len)
+    frontend = ServeFrontend(engine)
+    srv = frontend.make_server(args.host, args.port)
+    if args.coordinator:
+        register_with_coordinator(args.app_name, args.coordinator)
+    print(f"serving {args.model} on {args.host}:{args.port}", flush=True)
+    srv.serve_forever()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -1,0 +1,94 @@
+"""Serving HTTP front end: concurrent requests through the real socket."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import jax
+import pytest
+
+from kuberay_tpu.models import llama
+from kuberay_tpu.runtime.coordinator_server import CoordinatorServer, MemoryBackend
+from kuberay_tpu.serve.engine import ServeEngine
+from kuberay_tpu.serve.server import ServeFrontend, register_with_coordinator
+
+CFG = llama.CONFIGS["llama_tiny"]
+
+
+@pytest.fixture(scope="module")
+def frontend():
+    params = llama.init_params(CFG, jax.random.PRNGKey(0))
+    engine = ServeEngine(CFG, params, max_slots=2, max_len=64)
+    fe = ServeFrontend(engine)
+    srv, url = fe.serve_background()
+    yield fe, url
+    srv.shutdown()
+    fe.close()
+
+
+def post(url, body, timeout=60):
+    req = urllib.request.Request(
+        f"{url}/v1/completions", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    return json.load(urllib.request.urlopen(req, timeout=timeout))
+
+
+def test_completion_roundtrip(frontend):
+    fe, url = frontend
+    out = post(url, {"prompt_tokens": [5, 6, 7], "max_tokens": 4})
+    assert len(out["tokens"]) == 4
+    assert out["finish_reason"] == "length"
+    assert all(isinstance(t, int) for t in out["tokens"])
+
+
+def test_concurrent_requests_batched(frontend):
+    fe, url = frontend
+    results = {}
+    errs = []
+
+    def worker(i):
+        try:
+            results[i] = post(url, {"prompt_tokens": [10 + i, 20 + i],
+                                    "max_tokens": 3})
+        except Exception as e:
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(5)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    assert len(results) == 5
+    assert all(len(r["tokens"]) == 3 for r in results.values())
+    stats = json.load(urllib.request.urlopen(f"{url}/stats"))
+    assert stats["completed"] >= 5   # this test's own requests
+
+
+def test_greedy_is_deterministic(frontend):
+    fe, url = frontend
+    a = post(url, {"prompt_tokens": [1, 2, 3], "max_tokens": 5})
+    b = post(url, {"prompt_tokens": [1, 2, 3], "max_tokens": 5})
+    assert a["tokens"] == b["tokens"]
+
+
+def test_bad_request_rejected(frontend):
+    fe, url = frontend
+    for body in ({}, {"prompt_tokens": []}, {"prompt_tokens": "abc"},
+                 {"prompt_tokens": [1.5]}):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            post(url, body)
+        assert e.value.code == 400
+
+
+def test_register_with_coordinator(frontend):
+    coord = CoordinatorServer(state=MemoryBackend(), spawn_jobs=False)
+    srv, curl = coord.serve_background()
+    try:
+        coord.put_serve_config({"applications": [{"name": "llm"}]})
+        assert coord.serve_apps["llm"]["status"] == "DEPLOYING"
+        assert register_with_coordinator("llm", curl)
+        assert coord.serve_apps["llm"]["status"] == "RUNNING"
+    finally:
+        srv.shutdown()
