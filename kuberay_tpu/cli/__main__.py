@@ -167,6 +167,12 @@ def main(argv=None):
     # Entrypoint is everything after a literal "--" (split before argparse;
     # REMAINDER would swallow flags that precede it).
 
+    lg = sub.add_parser("logs", help="fetch a job's logs via its coordinator")
+    lg.add_argument("name")
+    lg.add_argument("--coordinator", default="",
+                    help="coordinator base URL (default: derived from the "
+                         "job's cluster status)")
+
     for name in ("suspend", "resume"):
         sp = sub.add_parser(name)
         sp.add_argument("resource", choices=["cluster", "job"])
@@ -274,6 +280,33 @@ def _dispatch(args, client: ApiClient) -> int:
                           f"({st.get('jobStatus', '')})")
                     return 0 if state == "Complete" else 2
                 time.sleep(1.0)
+        return 0
+
+    if args.cmd == "logs":
+        from kuberay_tpu.runtime.coordinator_client import (
+            CoordinatorClient, CoordinatorError)
+        job = client.get(C.KIND_JOB, args.name, ns)
+        st = job.get("status", {})
+        base = args.coordinator
+        if not base:
+            addr = st.get("clusterStatus", {}).get("coordinatorAddress", "")
+            host = addr.split(":")[0] if addr else ""
+            if not host:
+                print("error: no coordinator address known; pass "
+                      "--coordinator", file=sys.stderr)
+                return 1
+            base = f"http://{host}:{C.PORT_DASHBOARD}"
+        jid = st.get("jobId", "")
+        if not jid:
+            print(f"error: job {args.name} has no jobId yet "
+                  f"(state: {st.get('jobDeploymentStatus', 'unknown')})",
+                  file=sys.stderr)
+            return 1
+        try:
+            print(CoordinatorClient(base).get_job_logs(jid), end="")
+        except CoordinatorError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
         return 0
 
     if args.cmd in ("suspend", "resume"):

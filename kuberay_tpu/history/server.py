@@ -139,7 +139,5 @@ class HistoryServer:
         return ThreadingHTTPServer((host, port), Handler)
 
     def serve_background(self, host="127.0.0.1", port=0):
-        srv = self.make_server(host, port)
-        threading.Thread(target=srv.serve_forever, daemon=True,
-                         name="history-server").start()
-        return srv, f"http://{srv.server_address[0]}:{srv.server_address[1]}"
+        from kuberay_tpu.utils.httpjson import serve_background
+        return serve_background(self.make_server(host, port), "history-server")

@@ -44,7 +44,10 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     tie_embeddings: bool = False
     dtype: Any = jnp.bfloat16
-    attn_impl: str = "auto"      # auto | pallas | xla | pallas_interpret
+    # auto | pallas | xla | pallas_interpret | ring
+    # 'ring' = sequence-parallel ring attention over the mesh's sp axis
+    # (long-context training; forward() must receive the mesh).
+    attn_impl: str = "auto"
     remat: bool = True
 
     @property
@@ -149,7 +152,7 @@ def init_params(cfg: LlamaConfig, key: jax.Array) -> Dict[str, Any]:
 # --------------------------------------------------------------------------
 
 def _layer(cfg: LlamaConfig, x: jax.Array, lp: Dict[str, jax.Array],
-           cos: jax.Array, sin: jax.Array) -> jax.Array:
+           cos: jax.Array, sin: jax.Array, mesh=None) -> jax.Array:
     """One transformer block.  x: [B, S, d]."""
     B, S, d = x.shape
     hq, hkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
@@ -160,7 +163,13 @@ def _layer(cfg: LlamaConfig, x: jax.Array, lp: Dict[str, jax.Array],
     vv = (h @ lp["wv"]).reshape(B, S, hkv, hd)
     q = apply_rope(q, cos, sin)
     kk = apply_rope(kk, cos, sin)
-    attn = flash_attention(q, kk, vv, causal=True, impl=cfg.attn_impl)
+    if cfg.attn_impl == "ring":
+        if mesh is None:
+            raise ValueError("attn_impl='ring' requires forward(..., mesh=)")
+        from kuberay_tpu.parallel.ring import ring_attention
+        attn = ring_attention(q, kk, vv, mesh, causal=True)
+    else:
+        attn = flash_attention(q, kk, vv, causal=True, impl=cfg.attn_impl)
     x = x + (attn.reshape(B, S, hq * hd) @ lp["wo"]).astype(x.dtype)
 
     h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
@@ -170,13 +179,16 @@ def _layer(cfg: LlamaConfig, x: jax.Array, lp: Dict[str, jax.Array],
 
 
 def forward(cfg: LlamaConfig, params: Dict[str, Any],
-            tokens: jax.Array) -> jax.Array:
-    """tokens: [B, S] int32 -> logits [B, S, vocab] float32."""
+            tokens: jax.Array, mesh=None) -> jax.Array:
+    """tokens: [B, S] int32 -> logits [B, S, vocab] float32.
+
+    ``mesh`` is required for attn_impl='ring' (sequence parallelism over
+    its sp axis — the long-context training path)."""
     B, S = tokens.shape
     x = jnp.take(params["embed"], tokens, axis=0)          # [B, S, d]
     cos, sin = rope_frequencies(cfg.head_dim, S, cfg.rope_theta)
 
-    layer_fn = lambda x, lp: (_layer(cfg, x, lp, cos, sin), None)
+    layer_fn = lambda x, lp: (_layer(cfg, x, lp, cos, sin, mesh), None)
     if cfg.remat:
         layer_fn = jax.checkpoint(layer_fn, prevent_cse=False)
     x, _ = jax.lax.scan(layer_fn, x, params["layers"])
@@ -190,12 +202,13 @@ def forward(cfg: LlamaConfig, params: Dict[str, Any],
 
 def loss_fn(cfg: LlamaConfig, params: Dict[str, Any], tokens: jax.Array,
             targets: jax.Array, mask: Optional[jax.Array] = None,
-            z_loss: float = 1e-4) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+            z_loss: float = 1e-4,
+            mesh=None) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """Next-token cross entropy with z-loss regularization.
 
     tokens/targets: [B, S]; mask: [B, S] (1 = contributes to loss).
     """
-    logits = forward(cfg, params, tokens)                  # [B,S,V] f32
+    logits = forward(cfg, params, tokens, mesh)            # [B,S,V] f32
     logz = jax.nn.logsumexp(logits, axis=-1)               # [B,S]
     true_logit = jnp.take_along_axis(
         logits, targets[..., None], axis=-1).squeeze(-1)

@@ -394,10 +394,8 @@ class CoordinatorServer:
         return ThreadingHTTPServer((host, port), Handler)
 
     def serve_background(self, host="127.0.0.1", port=0):
-        srv = self.make_server(host, port)
-        threading.Thread(target=srv.serve_forever, daemon=True,
-                         name="coordinator-http").start()
-        return srv, f"http://{srv.server_address[0]}:{srv.server_address[1]}"
+        from kuberay_tpu.utils.httpjson import serve_background
+        return serve_background(self.make_server(host, port), "coordinator-http")
 
 
 def main(argv=None):  # pragma: no cover - thin process wrapper

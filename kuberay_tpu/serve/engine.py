@@ -132,7 +132,9 @@ class ServeEngine:
         return sum(1 for r in self.active if r is not None)
 
     def has_work(self) -> bool:
-        return bool(self.queue) or self.num_active > 0
+        # _finished counts: instantly-cancelled admissions must still be
+        # drained by the driving loop or their callers would never wake.
+        return bool(self.queue) or self.num_active > 0 or bool(self._finished)
 
     def step(self) -> List[Response]:
         """One engine iteration: admit one request (prefill) if possible,

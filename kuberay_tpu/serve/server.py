@@ -80,6 +80,9 @@ class ServeFrontend:
         if not ev.wait(timeout):
             with self._lock:
                 self._waiters.pop(rid, None)
+                # The loop may have parked the result in the same instant;
+                # reap it or it leaks forever.
+                self._results.pop(rid, None)
             return None
         with self._lock:
             return self._results.pop(rid)
@@ -130,6 +133,8 @@ class ServeFrontend:
                     timeout = float(body.get("timeout", 300.0))
                 except (TypeError, ValueError) as e:
                     return self._send(400, {"message": f"bad parameter: {e}"})
+                if max_tokens <= 0:
+                    return self._send(400, {"message": "max_tokens must be > 0"})
                 resp = frontend.submit(
                     prompt, max_tokens=max_tokens, temperature=temperature,
                     eos_token=body.get("eos_token"), timeout=timeout)
@@ -145,10 +150,8 @@ class ServeFrontend:
         return ThreadingHTTPServer((host, port), Handler)
 
     def serve_background(self, host="127.0.0.1", port=0):
-        srv = self.make_server(host, port)
-        threading.Thread(target=srv.serve_forever, daemon=True,
-                         name="serve-http").start()
-        return srv, f"http://{srv.server_address[0]}:{srv.server_address[1]}"
+        from kuberay_tpu.utils.httpjson import serve_background
+        return serve_background(self.make_server(host, port), "serve-http")
 
 
 def register_with_coordinator(app_name: str, coordinator_url: str,

@@ -147,7 +147,10 @@ def make_sharded_train_fns(cfg: llama.LlamaConfig, tc: TrainConfig,
     """
     optimizer = make_optimizer(tc)
     sh = state_shardings(cfg, optimizer, mesh, rules)
-    batch_sh = NamedSharding(mesh, P(("dp", "fsdp"), None))
+    # Sequence axis shards over sp (long-context); batch over (dp, fsdp).
+    batch_sh = NamedSharding(mesh, P(("dp", "fsdp"),
+                                     "sp" if mesh.shape.get("sp", 1) > 1
+                                     else None))
 
     init = jax.jit(
         functools.partial(init_train_state, cfg, optimizer),
@@ -156,7 +159,8 @@ def make_sharded_train_fns(cfg: llama.LlamaConfig, tc: TrainConfig,
     def step(state, batch):
         def loss(params):
             return llama.loss_fn(cfg, params, batch["tokens"],
-                                 batch["targets"], None, tc.z_loss)
+                                 batch["targets"], None, tc.z_loss,
+                                 mesh=mesh)
         (l, metrics), grads = jax.value_and_grad(loss, has_aux=True)(
             state["params"])
         updates, new_opt = optimizer.update(grads, state["opt_state"],

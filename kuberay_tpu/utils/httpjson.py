@@ -4,8 +4,9 @@
 from __future__ import annotations
 
 import json
+import threading
 from http.server import BaseHTTPRequestHandler
-from typing import Any, Dict
+from typing import Any, Dict, Tuple
 
 
 class JsonHandler(BaseHTTPRequestHandler):
@@ -34,3 +35,11 @@ class JsonHandler(BaseHTTPRequestHandler):
         n = int(self.headers.get("Content-Length", 0))
         raw = self.rfile.read(n) if n else b"{}"
         return json.loads(raw or b"{}")
+
+
+def serve_background(srv, name: str = "http-server") -> Tuple[object, str]:
+    """Run an HTTPServer in a daemon thread; returns (server, base_url)."""
+    threading.Thread(target=srv.serve_forever, daemon=True, name=name).start()
+    scheme = "https" if getattr(srv.socket, "context", None) or \
+        type(srv.socket).__module__ == "ssl" else "http"
+    return srv, f"{scheme}://{srv.server_address[0]}:{srv.server_address[1]}"

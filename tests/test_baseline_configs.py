@@ -1,0 +1,77 @@
+"""End-to-end tests for the BASELINE.json target configurations —
+the five shapes the rebuild is judged on, driven through the control
+plane exactly as a user would submit them."""
+
+import yaml
+
+import pytest
+
+from kuberay_tpu.api.tpujob import JobDeploymentStatus
+from kuberay_tpu.scheduler.gang import GangScheduler
+from kuberay_tpu.utils import constants as C
+from tests.test_job_controller import JobHarness, drive_job
+
+
+@pytest.fixture
+def h():
+    return JobHarness()
+
+
+def test_baseline5_mixtral_ep_two_groups(h):
+    """BASELINE #5: expert-parallel job across TWO v5p worker groups —
+    cross-group co-scheduling (gang covers both), per-group slice env."""
+    fleet = {"chips": 0}
+    gang = GangScheduler(h.store,
+                         capacity_oracle=lambda d: d["tpuChips"] <= fleet["chips"])
+    h.cluster_ctrl.scheduler = gang
+    h.job_ctrl.scheduler = gang
+
+    job = yaml.safe_load(open("samples/tpujob.mixtral-ep-two-groups.yaml"))
+    job["spec"]["submissionMode"] = "HTTPMode"
+    h.store.create(job)
+    h.settle()
+    # Gang holds the WHOLE job (both groups) while capacity is short.
+    assert h.store.list("Pod") == []
+    j = h.store.get(C.KIND_JOB, "mixtral-ep")
+    assert j["status"]["jobDeploymentStatus"] == JobDeploymentStatus.INITIALIZING
+
+    fleet["chips"] = 32   # 2 groups x v5p 2x2x4 = 16 + 16
+    j = drive_job(h, "mixtral-ep")
+    assert j.status.jobDeploymentStatus == JobDeploymentStatus.RUNNING
+    workers = h.store.list("Pod", labels={C.LABEL_NODE_TYPE: "worker"})
+    by_group = {}
+    for p in workers:
+        by_group.setdefault(p["metadata"]["labels"][C.LABEL_GROUP],
+                            []).append(p)
+    assert set(by_group) == {"experts-a", "experts-b"}
+    assert all(len(v) == 4 for v in by_group.values())  # 4 hosts per slice
+    # Both expert groups resolve the SAME coordinator (DCN rendezvous).
+    addrs = set()
+    for p in workers:
+        env = {e["name"]: e["value"] for e in p["spec"]["containers"][0]["env"]}
+        addrs.add(env[C.ENV_COORDINATOR_ADDRESS])
+        assert env[C.ENV_TPU_TOPOLOGY] == "2x2x4"
+    assert len(addrs) == 1
+    # PodGroup recorded the all-or-nothing quantum: 1 head + 8 workers.
+    pgs = h.store.list("PodGroup")
+    assert any(pg["spec"]["minMember"] == 9 for pg in pgs)
+
+    h.coordinator.set_job_status(j.status.jobId, "SUCCEEDED")
+    h.settle()
+    assert h.store.get(C.KIND_JOB, "mixtral-ep")["status"][
+        "jobDeploymentStatus"] == JobDeploymentStatus.COMPLETE
+
+
+def test_baseline3_llama_v5p64_shape(h):
+    """BASELINE #3: the Llama-3-8B pretrain job shape (v5p-64 = 4x4x4)."""
+    job = yaml.safe_load(open("samples/tpujob.llama3-8b-v5p-64.yaml"))
+    job["spec"]["submissionMode"] = "HTTPMode"
+    h.store.create(job)
+    j = drive_job(h, "llama3-8b-pretrain")
+    assert j.status.jobDeploymentStatus == JobDeploymentStatus.RUNNING
+    workers = h.store.list("Pod", labels={C.LABEL_NODE_TYPE: "worker"})
+    assert len(workers) == 16    # 64 chips / 4 per host
+    env = {e["name"]: e["value"]
+           for e in workers[0]["spec"]["containers"][0]["env"]}
+    assert env[C.ENV_NUM_PROCESSES] == "16"
+    assert "launcher" in j.spec.entrypoint and "llama3_8b" in j.spec.entrypoint

@@ -1,0 +1,62 @@
+"""Long-context training path: ring attention wired into the model +
+sharded train step over an sp mesh (SURVEY §5.7: SP/CP as a first-class
+framework feature)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kuberay_tpu.models import llama
+from kuberay_tpu.parallel.mesh import MeshSpec
+from kuberay_tpu.train.train_step import TrainConfig, make_sharded_train_fns
+
+BASE = llama.CONFIGS["llama_tiny"]
+RING_CFG = dataclasses.replace(BASE, attn_impl="ring")
+
+
+def make_batch(key, batch=2, seq=64):
+    tokens = jax.random.randint(key, (batch, seq), 0, BASE.vocab_size)
+    return {"tokens": tokens, "targets": jnp.roll(tokens, -1, axis=1)}
+
+
+def test_ring_forward_matches_xla():
+    mesh = MeshSpec(dp=1, fsdp=1, tp=1, sp=4).build(jax.devices()[:4])
+    params = llama.init_params(BASE, jax.random.PRNGKey(0))
+    tokens = make_batch(jax.random.PRNGKey(1))["tokens"]
+    ref = llama.forward(BASE, params, tokens)
+    got = llama.forward(RING_CFG, params, tokens, mesh=mesh)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=3e-3, atol=3e-3)
+
+
+def test_ring_requires_mesh():
+    params = llama.init_params(BASE, jax.random.PRNGKey(0))
+    tokens = make_batch(jax.random.PRNGKey(1))["tokens"]
+    with pytest.raises(ValueError):
+        llama.forward(RING_CFG, params, tokens)
+
+
+def test_sp_sharded_train_step():
+    """Full train step with the sequence sharded over sp=4: loss matches
+    the unsharded xla-attention baseline; batch arrays stay sp-sharded."""
+    mesh = MeshSpec(dp=1, fsdp=2, tp=1, sp=4).build(jax.devices()[:8])
+    tc = TrainConfig(warmup_steps=2, decay_steps=10)
+    init, step, _ = make_sharded_train_fns(RING_CFG, tc, mesh)
+    state = init(jax.random.PRNGKey(0))
+    batch = make_batch(jax.random.PRNGKey(7), batch=2, seq=64)
+    state2, metrics = step(state, batch)
+    assert bool(jnp.isfinite(metrics["total_loss"]))
+
+    # Baseline on a plain mesh with standard attention.
+    mesh0 = MeshSpec(dp=1, fsdp=2, tp=1).build(jax.devices()[:2])
+    init0, step0, _ = make_sharded_train_fns(BASE, tc, mesh0)
+    _, m0 = step0(init0(jax.random.PRNGKey(0)), batch)
+    np.testing.assert_allclose(float(metrics["loss"]), float(m0["loss"]),
+                               rtol=2e-3)
+    # Two more sp steps keep improving (optimizer + ring bwd are sane).
+    state3, m2 = step(state2, batch)
+    state4, m3 = step(state3, batch)
+    assert float(m3["loss"]) < float(metrics["loss"])
