@@ -284,18 +284,18 @@ def _dispatch(args, client: ApiClient) -> int:
 
     if args.cmd == "logs":
         from kuberay_tpu.runtime.coordinator_client import (
-            CoordinatorClient, CoordinatorError)
+            CoordinatorClient, CoordinatorError, default_client_provider)
         job = client.get(C.KIND_JOB, args.name, ns)
         st = job.get("status", {})
-        base = args.coordinator
-        if not base:
-            addr = st.get("clusterStatus", {}).get("coordinatorAddress", "")
-            host = addr.split(":")[0] if addr else ""
-            if not host:
+        if args.coordinator:
+            coord = CoordinatorClient(args.coordinator)
+        else:
+            cluster_status = st.get("clusterStatus", {})
+            if not cluster_status.get("coordinatorAddress"):
                 print("error: no coordinator address known; pass "
                       "--coordinator", file=sys.stderr)
                 return 1
-            base = f"http://{host}:{C.PORT_DASHBOARD}"
+            coord = default_client_provider(cluster_status)
         jid = st.get("jobId", "")
         if not jid:
             print(f"error: job {args.name} has no jobId yet "
@@ -303,7 +303,7 @@ def _dispatch(args, client: ApiClient) -> int:
                   file=sys.stderr)
             return 1
         try:
-            print(CoordinatorClient(base).get_job_logs(jid), end="")
+            print(coord.get_job_logs(jid), end="")
         except CoordinatorError as e:
             print(f"error: {e}", file=sys.stderr)
             return 1

@@ -51,6 +51,10 @@ class TpuServiceController:
         # serve config cache per cluster (ref cacheServeConfig): avoids
         # re-PUTting an unchanged config every pass.
         self._submitted: Dict[str, str] = {}
+        # cluster name -> first time its serve apps were observed unhealthy
+        # (drives the serviceUnhealthySecondThreshold /
+        # deploymentUnhealthySecondThreshold timers, ref rayservice spec).
+        self._unhealthy_since: Dict[str, float] = {}
 
     # ------------------------------------------------------------------
 
@@ -77,11 +81,81 @@ class TpuServiceController:
 
         requeue = self._reconcile_clusters(svc)
         self._reconcile_serve_config(svc)
+        self._reconcile_unhealthy_thresholds(svc)
         r2 = self._reconcile_promotion(svc)
         self._reconcile_stable_services(svc)
         self._update_status(svc)
         candidates = [r for r in (requeue, r2) if r]
         return min(candidates) if candidates else 2.0
+
+    def _reconcile_unhealthy_thresholds(self, svc: TpuService):
+        """Self-healing on persistent serve unhealthiness.
+
+        - pending stuck beyond deploymentUnhealthySecondThreshold: abandon
+          it (a fresh attempt is prepared on the next pass);
+        - active unhealthy beyond serviceUnhealthySecondThreshold: prepare
+          a same-spec replacement cluster that takes over via the normal
+          promotion path (whole-cluster repair — slices are never patched
+          in place).
+        """
+        now = time.time()
+        st = svc.status
+
+        def track(cs) -> float:
+            """Returns seconds-unhealthy for the cluster (0 when healthy).
+
+            The clock starts only once app status has actually been
+            observed — a cluster still provisioning (no serve config
+            submitted yet) is pending, not unhealthy."""
+            if cs is None:
+                return 0.0
+            if self._serve_ready(cs):
+                self._unhealthy_since.pop(cs.clusterName, None)
+                return 0.0
+            if not cs.applications:
+                return 0.0
+            first = self._unhealthy_since.setdefault(cs.clusterName, now)
+            return now - first
+
+        pending_bad = track(st.pendingServiceStatus)
+        if st.pendingServiceStatus is not None and \
+                pending_bad > svc.spec.deploymentUnhealthySecondThreshold:
+            self.recorder.warning(
+                svc.to_dict(), "PendingUnhealthy",
+                f"pending cluster {st.pendingServiceStatus.clusterName} not "
+                f"serving after {int(pending_bad)}s; recreating")
+            self._unhealthy_since.pop(st.pendingServiceStatus.clusterName, None)
+            self._abandon_pending(svc)
+            return
+
+        active_bad = track(st.activeServiceStatus)
+        if st.activeServiceStatus is not None and \
+                st.pendingServiceStatus is None and \
+                svc.spec.upgradeStrategy != ServiceUpgradeType.NONE and \
+                active_bad > svc.spec.serviceUnhealthySecondThreshold:
+            # Fresh, never-used name: reusing a name would silently adopt a
+            # still-retiring (possibly annotated-for-deletion) cluster.
+            base = f"{svc.metadata.name}-cluster-{svc.metadata.generation}-heal"
+            cname = truncate_name(base)
+            n = 2
+            while self.store.try_get(C.KIND_CLUSTER, cname,
+                                     svc.metadata.namespace) is not None or \
+                    cname == st.activeServiceStatus.clusterName:
+                cname = truncate_name(f"{base}{n}")
+                n += 1
+            self.recorder.warning(
+                svc.to_dict(), "ActiveUnhealthy",
+                f"active cluster {st.activeServiceStatus.clusterName} "
+                f"unhealthy for {int(active_bad)}s; preparing replacement "
+                f"{cname}")
+            self._unhealthy_since.pop(st.activeServiceStatus.clusterName, None)
+            self._create_cluster(svc, cname)
+            st.pendingServiceStatus = ServiceClusterStatus(
+                clusterName=cname,
+                specHash=spec_hash_without_scale(svc.spec.clusterSpec.to_dict()))
+            set_condition(st.conditions, Condition(
+                type=ServiceConditionType.UPGRADE_IN_PROGRESS, status="True",
+                reason="UnhealthyActive"))
 
     # ------------------------------------------------------------------
     # cluster pair management (ref reconcileRayCluster :1191)
@@ -144,9 +218,12 @@ class TpuServiceController:
                 # In-place: scale-only changes flow through (ref
                 # isClusterSpecHashEqual -> update replicas).
                 self._sync_scale_fields(svc, active)
-                # A pending cluster from an abandoned upgrade is rolled
-                # back (ref reconcileRollbackState :2321).
-                if pending is not None:
+                # A pending cluster from an ABANDONED upgrade (stale hash)
+                # is rolled back (ref reconcileRollbackState :2321); a
+                # same-hash pending is a legitimate self-heal replacement
+                # and must survive to promotion.
+                if pending is not None and \
+                        st.pendingServiceStatus.specHash != desired_hash:
                     self._abandon_pending(svc)
                 return None
             if svc.spec.upgradeStrategy == ServiceUpgradeType.NONE:
@@ -199,6 +276,7 @@ class TpuServiceController:
         except NotFound:
             pass
         self._submitted.pop(cname, None)
+        self._unhealthy_since.pop(cname, None)
         st.pendingServiceStatus = None
         set_condition(svc.status.conditions, Condition(
             type=ServiceConditionType.ROLLING_BACK, status="True",
@@ -424,6 +502,7 @@ class TpuServiceController:
             except NotFound:
                 pass
             self._submitted.pop(cs.clusterName, None)
+            self._unhealthy_since.pop(cs.clusterName, None)
         st.activeServiceStatus = None
         st.pendingServiceStatus = None
         st.serviceStatus = "Suspended"
@@ -441,6 +520,7 @@ class TpuServiceController:
             except NotFound:
                 pass
             self._submitted.pop(cs.clusterName, None)
+            self._unhealthy_since.pop(cs.clusterName, None)
         self.store.remove_finalizer(self.KIND, svc.metadata.name,
                                     svc.metadata.namespace, C.FINALIZER_SERVICE)
         return None

@@ -38,8 +38,7 @@ class JsonHandler(BaseHTTPRequestHandler):
 
 
 def serve_background(srv, name: str = "http-server") -> Tuple[object, str]:
-    """Run an HTTPServer in a daemon thread; returns (server, base_url)."""
+    """Run an HTTPServer in a daemon thread; returns (server, base_url).
+    Callers serving TLS (webhooks) format their own https URL."""
     threading.Thread(target=srv.serve_forever, daemon=True, name=name).start()
-    scheme = "https" if getattr(srv.socket, "context", None) or \
-        type(srv.socket).__module__ == "ssl" else "http"
-    return srv, f"{scheme}://{srv.server_address[0]}:{srv.server_address[1]}"
+    return srv, f"http://{srv.server_address[0]}:{srv.server_address[1]}"

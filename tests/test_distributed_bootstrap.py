@@ -1,0 +1,71 @@
+"""Multi-process distributed bootstrap: the launcher's env contract drives
+a REAL 2-process jax.distributed cluster over localhost (the comm-backend
+proof — SURVEY §5.8: control plane wires addresses, JAX forms the mesh)."""
+
+import os
+import pathlib
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+WORKER = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    sys.path.insert(0, %(repo)r)
+    from kuberay_tpu.utils.platform import pin_platform_from_env
+    pin_platform_from_env()
+    from kuberay_tpu.train.launcher import WorkerIdentity
+    import jax, jax.numpy as jnp
+    ident = WorkerIdentity.from_env()
+    jax.distributed.initialize(coordinator_address=os.environ["COORD"],
+                               num_processes=ident.num_workers,
+                               process_id=ident.worker_id)
+    from jax.experimental import multihost_utils
+    x = jnp.ones(4) * (ident.worker_id + 1)
+    total = multihost_utils.process_allgather(x)
+    print(f"RESULT {ident.worker_id} {jax.device_count()} "
+          f"{jax.process_count()} {float(total.sum())}", flush=True)
+""")
+
+
+@pytest.mark.timeout(180)
+def test_two_process_bootstrap(tmp_path):
+    repo = str(pathlib.Path(__file__).resolve().parent.parent)
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER % {"repo": repo})
+    # Free port: hardcoding one makes parallel/repeated runs collide.
+    import socket
+    with socket.socket() as sk:
+        sk.bind(("localhost", 0))
+        port = sk.getsockname()[1]
+
+    def spawn(worker_id):
+        env = dict(os.environ)
+        env.update({
+            "TPU_WORKER_HOSTNAMES": "localhost,localhost",
+            "TPU_NUM_PROCESSES": "2",
+            "TPU_WORKER_ID": str(worker_id),
+            "COORD": f"localhost:{port}",
+        })
+        return subprocess.Popen([sys.executable, str(script)], env=env,
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT, text=True)
+
+    procs = [spawn(0), spawn(1)]
+    outs = [p.communicate(timeout=170)[0] for p in procs]
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out[-2000:]
+    results = {}
+    for out in outs:
+        for line in out.splitlines():
+            if line.startswith("RESULT"):
+                _, wid, ndev, nproc, total = line.split()
+                results[int(wid)] = (int(ndev), int(nproc), float(total))
+    assert set(results) == {0, 1}
+    for ndev, nproc, total in results.values():
+        assert ndev == 4 and nproc == 2
+        # worker0 contributes 4x1, worker1 contributes 4x2.
+        assert total == 12.0

@@ -194,6 +194,64 @@ def test_incremental_upgrade_steps_traffic(h):
     assert h.store.list("TrafficRoute") == []
 
 
+def test_active_unhealthy_triggers_self_heal(h):
+    """serviceUnhealthySecondThreshold: a persistently unhealthy active
+    cluster is replaced whole via the promotion path."""
+    svc = make_service()
+    svc.spec.serviceUnhealthySecondThreshold = 0   # heal immediately
+    h.store.create(svc.to_dict())
+    h.settle()
+    s = h.svc()
+    old_active = s.status.activeServiceStatus.clusterName
+    # Break the active cluster's serve app.
+    h.clients[old_active].set_serve_app("llm", "UNHEALTHY", "oom")
+    h.settle(rounds=16)
+    s = h.svc()
+    assert s.status.activeServiceStatus.clusterName != old_active
+    assert s.status.serviceStatus == "Running"
+    events = [e for e in h.store.list("Event")
+              if e["reason"] == "ActiveUnhealthy"]
+    assert events
+
+
+def test_pending_unhealthy_recreated(h):
+    """deploymentUnhealthySecondThreshold: a pending cluster that never
+    gets healthy is torn down and retried."""
+    svc = make_service()
+    svc.spec.deploymentUnhealthySecondThreshold = 0
+    h.store.create(svc.to_dict())
+
+    # Make every new cluster's app come up UNHEALTHY instead of RUNNING.
+    broken = {"on": True}
+
+    def settle_broken(rounds=4):
+        # Bounded iterations: the broken phase churns (abandon/recreate by
+        # design) and would otherwise spin a long time per round.
+        for _ in range(rounds):
+            h.manager.flush_delayed()
+            h.manager.run_until_idle(max_iterations=40)
+            h.kubelet.step()
+            for client in h.clients.values():
+                if client.serve_config is not None and not client.serve_apps:
+                    client.set_serve_app(
+                        "llm", "UNHEALTHY" if broken["on"] else "RUNNING")
+        h.manager.flush_delayed()
+        h.manager.run_until_idle(max_iterations=40)
+
+    settle_broken()
+    first_events = [e for e in h.store.list("Event")
+                    if e["reason"] == "PendingUnhealthy"]
+    assert first_events, "stuck pending should be recreated"
+    # Heal the environment: new attempts come up RUNNING and promote.
+    broken["on"] = False
+    for client in h.clients.values():
+        client.serve_apps.clear()
+    h.settle(rounds=16)
+    s = h.svc()
+    assert s.status.serviceStatus == "Running"
+    assert s.status.activeServiceStatus is not None
+
+
 def test_head_pod_serve_label(h):
     svc = make_service()
     svc.spec.excludeHeadPodFromServe = True
