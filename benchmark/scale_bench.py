@@ -130,19 +130,104 @@ def run_job_scale(n: int, timeout: float) -> dict:
     }
 
 
+def _memory_experiment(exp: str, timeout: float) -> dict:
+    """One 150-pod shape, measured in THIS process via VmRSS delta."""
+    def vm_rss_mib():
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) / 1024.0
+        return 0.0
+
+    baseline = vm_rss_mib()
+    coord = FakeCoordinatorClient()
+    op = Operator(OperatorConfiguration(reconcileConcurrency=2),
+                  client_provider=lambda s: coord, fake_kubelet=True)
+    op.start(api_port=0)
+    if exp == "exp1":          # 150 head-only clusters
+        objs = [{**cluster_manifest(i),
+                 "spec": {**cluster_manifest(i)["spec"],
+                          "workerGroupSpecs": []}} for i in range(150)]
+        want = 150
+    elif exp == "exp2":        # 1 cluster with 150 single-host slices
+        big = cluster_manifest(9000)
+        big["spec"]["workerGroupSpecs"][0].update(replicas=150,
+                                                  maxReplicas=150)
+        objs, want = [big], 1
+    else:                      # exp3: 30 five-pod clusters (head + 4 hosts)
+        objs = []
+        for i in range(30):
+            m = cluster_manifest(9100 + i)
+            m["spec"]["workerGroupSpecs"][0].update(accelerator="v5e",
+                                                    topology="4x4")
+            objs.append(m)
+        want = 30
+    for obj in objs:
+        op.store.create(obj)
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        ready = sum(1 for c in op.store.list(C.KIND_CLUSTER)
+                    if c.get("status", {}).get("state") == "ready")
+        if ready >= want:
+            break
+        time.sleep(0.2)
+    out = {"pods": op.store.count("Pod"),
+           "rss_mib": round(vm_rss_mib() - baseline, 1)}
+    op.stop()
+    return out
+
+
+def run_memory_bench(timeout: float) -> dict:
+    """Operator memory envelope (ref benchmark/memory_benchmark: 150 Ray
+    pods across three shapes).  Each experiment runs in its OWN subprocess
+    so the measurements are independent footprints, not cumulative maxima.
+    """
+    import subprocess
+    import sys as _sys
+
+    results = {}
+    for exp in ("exp1", "exp2", "exp3"):
+        out = subprocess.run(
+            [_sys.executable, __file__, "--memory-exp", exp,
+             "--timeout", str(timeout)],
+            capture_output=True, text=True, timeout=timeout + 120)
+        data = json.loads(out.stdout.strip().splitlines()[-1])
+        results[exp + "_pods"] = data["pods"]
+        results[exp + "_rss_mib"] = data["rss_mib"]
+    return {
+        "metric": "operator_memory_envelope_mib",
+        "value": max(results["exp1_rss_mib"], results["exp2_rss_mib"],
+                     results["exp3_rss_mib"]),
+        "unit": "MiB RSS delta",
+        "detail": {**results,
+                   "reference": "BASELINE.md: 150-pod shapes on "
+                                "e2-highcpu-16 nodes (graph only)"},
+    }
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--clusters", type=int, default=0)
     ap.add_argument("--jobs", type=int, default=0)
+    ap.add_argument("--memory", action="store_true",
+                    help="run the 150-pod operator memory envelope")
+    ap.add_argument("--memory-exp", default="",
+                    help=argparse.SUPPRESS)   # internal: one experiment
     ap.add_argument("--timeout", type=float, default=1800.0)
     args = ap.parse_args(argv)
-    if not args.clusters and not args.jobs:
+    if args.memory_exp:
+        print(json.dumps(_memory_experiment(args.memory_exp, args.timeout)),
+              flush=True)
+        return
+    if not args.clusters and not args.jobs and not args.memory:
         args.clusters = 100
     if args.clusters:
         print(json.dumps(run_cluster_scale(args.clusters, args.timeout)),
               flush=True)
     if args.jobs:
         print(json.dumps(run_job_scale(args.jobs, args.timeout)), flush=True)
+    if args.memory:
+        print(json.dumps(run_memory_bench(args.timeout)), flush=True)
 
 
 if __name__ == "__main__":

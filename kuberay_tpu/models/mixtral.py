@@ -115,13 +115,46 @@ def init_params(cfg: MixtralConfig, key: jax.Array) -> Dict[str, Any]:
 # MoE layer
 # --------------------------------------------------------------------------
 
-def moe_ffn(cfg: MixtralConfig, x: jax.Array, lp: Dict[str, jax.Array]
+def moe_ffn_dropless(cfg: MixtralConfig, x: jax.Array,
+                     lp: Dict[str, jax.Array],
+                     token_mask: Optional[jax.Array] = None) -> jax.Array:
+    """Dropless top-k MoE: every token's chosen experts always run (all
+    experts computed, combined by routing weights).  E x the FFN FLOPs per
+    token — only sensible for small T (serving decode steps), where it buys
+    per-request determinism: no cross-request capacity contention.
+    """
+    B, S, d = x.shape
+    E, K = cfg.n_experts, cfg.top_k
+    T = B * S
+    xt = x.reshape(T, d)
+    logits = (xt @ lp["router"]).astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    topw, topi = jax.lax.top_k(probs, K)
+    topw = topw / jnp.clip(topw.sum(-1, keepdims=True), 1e-9)
+    weights = jnp.zeros((T, E), x.dtype).at[
+        jnp.arange(T)[:, None], topi].set(topw.astype(x.dtype))
+    if token_mask is not None:
+        weights = weights * token_mask.reshape(T, 1).astype(x.dtype)
+    gated = jax.nn.silu(jnp.einsum("td,edf->tef", xt, lp["w_gate"])) \
+        * jnp.einsum("td,edf->tef", xt, lp["w_up"])
+    all_out = jnp.einsum("tef,efd->ted", gated, lp["w_down"])   # [T, E, d]
+    out = jnp.einsum("te,ted->td", weights, all_out)
+    return out.reshape(B, S, d).astype(x.dtype)
+
+
+def moe_ffn(cfg: MixtralConfig, x: jax.Array, lp: Dict[str, jax.Array],
+            token_mask: Optional[jax.Array] = None
             ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """Top-k routed expert FFN.  x: [B, S, d] -> (out, aux_losses).
 
     Capacity dispatch (GShard): each expert processes at most
     C = ceil(T * top_k / E * capacity_factor) tokens; overflow tokens drop
     that expert assignment (their other top-k picks still apply).
+
+    ``token_mask`` [B, S] (1 = real token): masked tokens neither claim
+    expert capacity nor contribute output — essential under serving where
+    the batch mixes active requests with padding/inactive slots (a padding
+    token must never evict a real token's expert assignment).
     """
     B, S, d = x.shape
     E, K = cfg.n_experts, cfg.top_k
@@ -133,6 +166,9 @@ def moe_ffn(cfg: MixtralConfig, x: jax.Array, lp: Dict[str, jax.Array]
     probs = jax.nn.softmax(logits, axis=-1)
     topw, topi = jax.lax.top_k(probs, K)                        # [T, K]
     topw = topw / jnp.clip(topw.sum(-1, keepdims=True), 1e-9)   # renormalize
+    if token_mask is not None:
+        flat_mask = token_mask.reshape(T).astype(topw.dtype)
+        topw = topw * flat_mask[:, None]
 
     # Aux losses: Switch load-balance + router z-loss.
     me = probs.mean(axis=0)                                     # [E]
@@ -145,6 +181,9 @@ def moe_ffn(cfg: MixtralConfig, x: jax.Array, lp: Dict[str, jax.Array]
 
     # Position of each (token, k) within its expert's capacity buffer.
     onehot = jax.nn.one_hot(topi, E, dtype=jnp.int32)           # [T, K, E]
+    if token_mask is not None:
+        # Masked tokens claim no capacity slots at all.
+        onehot = onehot * token_mask.reshape(T).astype(jnp.int32)[:, None, None]
     flat = onehot.reshape(T * K, E)
     pos = jnp.cumsum(flat, axis=0) * flat - 1                   # [T*K, E]
     pos = pos.reshape(T, K, E)

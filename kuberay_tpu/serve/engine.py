@@ -27,7 +27,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from kuberay_tpu.models.llama import LlamaConfig
-from kuberay_tpu.serve.kv_cache import forward_with_cache, init_kv_cache
+from kuberay_tpu.serve.kv_cache import (
+    forward_with_cache,
+    forward_with_cache_mixtral,
+    init_kv_cache,
+)
 
 
 @dataclasses.dataclass
@@ -65,6 +69,13 @@ class ServeEngine:
         self.max_slots = max_slots
         self.max_len = max_len
         self.cache = init_kv_cache(cfg, max_slots, max_len)
+        # Model dispatch: Llama-family vs Mixtral MoE share the cache
+        # plumbing but differ in the FFN.
+        from kuberay_tpu.models.mixtral import MixtralConfig
+        if isinstance(cfg, MixtralConfig):
+            self._forward = forward_with_cache_mixtral
+        else:
+            self._forward = forward_with_cache
         self.key = jax.random.PRNGKey(rng_seed)
 
         # Slot bookkeeping (host side).
@@ -93,8 +104,13 @@ class ServeEngine:
         # Only the target slot's cache row may be written — other slots are
         # mid-decode and their caches must be untouched.
         write_mask = jax.nn.one_hot(slot, B, dtype=jnp.float32)
-        logits, new_cache = forward_with_cache(
-            self.cfg, params, row, cache, start, write_mask)
+        # Token mask: only the target slot's REAL tokens participate in
+        # routing FFNs (padding/other slots must not claim MoE capacity).
+        token_mask = (write_mask[:, None] *
+                      (jnp.arange(prompt_len)[None, :] < real_len))
+        logits, new_cache = self._forward(
+            self.cfg, params, row, cache, start, write_mask,
+            token_mask=token_mask)
         last = logits[slot, real_len - 1]                     # [V]
         tok = self._sample(last, key, temperature)
         return tok, new_cache
@@ -102,8 +118,9 @@ class ServeEngine:
     def _decode_impl(self, params, cache, tokens, lens, key, temperatures,
                      active_mask):
         """One decode step for every active slot.  tokens: [slots]."""
-        logits, new_cache = forward_with_cache(
-            self.cfg, params, tokens[:, None], cache, lens, active_mask)
+        logits, new_cache = self._forward(
+            self.cfg, params, tokens[:, None], cache, lens, active_mask,
+            token_mask=active_mask[:, None])
         keys = jax.random.split(key, self.max_slots)
         toks = jax.vmap(self._sample)(logits[:, 0], keys, temperatures)
         return toks, new_cache

@@ -21,7 +21,9 @@ from kuberay_tpu.ops.rope import apply_rope, rope_frequencies
 _NEG_INF = -1e30
 
 
-def init_kv_cache(cfg: LlamaConfig, slots: int, max_len: int) -> Dict[str, jax.Array]:
+def init_kv_cache(cfg, slots: int, max_len: int) -> Dict[str, jax.Array]:
+    """Works for any config exposing n_layers/n_kv_heads/head_dim/dtype
+    (Llama and Mixtral)."""
     shape = (cfg.n_layers, slots, max_len, cfg.n_kv_heads, cfg.head_dim)
     return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
 
@@ -48,18 +50,68 @@ def _cached_attention(q, ck, cv, lens, q_positions):
     return out.astype(q.dtype)
 
 
-def forward_with_cache(cfg: LlamaConfig, params: Dict[str, Any],
+def forward_with_cache_mixtral(cfg, params, tokens, cache, start,
+                               write_mask=None, token_mask=None):
+    """Mixtral against the cache: the shared layer plumbing with the MoE
+    FFN swapped in.  Router aux losses are irrelevant at inference.  The
+    token mask keeps padding/inactive slots out of expert routing."""
+    from kuberay_tpu.models.mixtral import (
+        MixtralConfig, moe_ffn, moe_ffn_dropless)
+
+    assert isinstance(cfg, MixtralConfig)
+    decode = tokens.shape[1] == 1
+
+    def ffn(cfg_, h, lp, mask):
+        if decode:
+            # Decode: dropless routing — other slots' tokens can never
+            # evict this request's experts (per-request determinism).
+            return moe_ffn_dropless(cfg_, h, lp, token_mask=mask)
+        # Prefill: one request per call; capacity routing contends only
+        # with the request's own tokens (masked slots claim nothing).
+        out, _aux = moe_ffn(cfg_, h, lp, token_mask=mask)
+        return out
+
+    return forward_with_cache(cfg, params, tokens, cache, start,
+                              write_mask, token_mask=token_mask, ffn=ffn)
+
+
+def _insert_kv(ck, cv, kk, vv, positions, start, write_mask, T):
+    """Shared cache insertion: dynamic-slice decode path, one-hot prefill."""
+    if T == 1:
+        def upd(cache_row, new_row, pos, m):
+            written = jax.lax.dynamic_update_slice(
+                cache_row, new_row.astype(cache_row.dtype), (pos, 0, 0))
+            return jnp.where(m > 0, written, cache_row)
+        return (jax.vmap(upd)(ck, kk, start, write_mask),
+                jax.vmap(upd)(cv, vv, start, write_mask))
+    onehot = (jax.nn.one_hot(positions, ck.shape[1], dtype=ck.dtype)
+              * write_mask[:, None, None].astype(ck.dtype))
+    ck = ck * (1 - onehot.sum(1)[..., None, None]) + \
+        jnp.einsum("btm,bthd->bmhd", onehot, kk)
+    cv = cv * (1 - onehot.sum(1)[..., None, None]) + \
+        jnp.einsum("btm,bthd->bmhd", onehot, vv)
+    return ck, cv
+
+
+def _dense_ffn(cfg, h, lp, token_mask):
+    return (jax.nn.silu(h @ lp["w_gate"]) * (h @ lp["w_up"])) @ lp["w_down"]
+
+
+def forward_with_cache(cfg, params: Dict[str, Any],
                        tokens: jax.Array, cache: Dict[str, jax.Array],
                        start: jax.Array,
-                       write_mask: jax.Array = None
-                       ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+                       write_mask: jax.Array = None,
+                       token_mask: jax.Array = None,
+                       ffn=None) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """Run T new tokens through the model against the cache.
 
     tokens: [B, T] (right-padded; positions beyond a slot's real length are
     masked out by the caller's sampling); start: [B] number of tokens
     already in each slot's cache; write_mask: [B] 1.0 for rows whose cache
     may be written (prefill targets ONE slot — without the mask every row
-    would scatter into positions start..start+T and corrupt its neighbors).
+    would scatter into positions start..start+T and corrupt its neighbors);
+    token_mask: [B, T] real-token mask consumed by routing FFNs; ``ffn``
+    customizes the feed-forward block (dense default, MoE for Mixtral).
     Returns (logits [B, T, V], new cache).
     """
     B, T = tokens.shape
@@ -69,6 +121,8 @@ def forward_with_cache(cfg: LlamaConfig, params: Dict[str, Any],
     lens = start + T
     if write_mask is None:
         write_mask = jnp.ones((B,), jnp.float32)
+    if ffn is None:
+        ffn = _dense_ffn
 
     def layer_fn(x, layer_in):
         lp, ck, cv = layer_in
@@ -78,35 +132,20 @@ def forward_with_cache(cfg: LlamaConfig, params: Dict[str, Any],
         vv = (h @ lp["wv"]).reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
         q = apply_rope(q, cos, sin, positions)
         kk = apply_rope(kk, cos, sin, positions)
-        # Insert new K/V at each slot's offset; masked rows write nothing.
-        if T == 1:
-            # Decode hot path: per-row dynamic_update_slice (O(1) writes)
-            # instead of an O(max_len) one-hot contraction per token.
-            def upd(cache_row, new_row, pos, m):
-                written = jax.lax.dynamic_update_slice(
-                    cache_row, new_row.astype(cache_row.dtype), (pos, 0, 0))
-                return jnp.where(m > 0, written, cache_row)
-            ck = jax.vmap(upd)(ck, kk, start, write_mask)
-            cv = jax.vmap(upd)(cv, vv, start, write_mask)
-        else:
-            # Prefill: one-hot scatter keeps shapes static for T tokens.
-            onehot = (jax.nn.one_hot(positions, ck.shape[1], dtype=ck.dtype)
-                      * write_mask[:, None, None].astype(ck.dtype))  # [B,T,max]
-            ck = ck * (1 - onehot.sum(1)[..., None, None]) + \
-                jnp.einsum("btm,bthd->bmhd", onehot, kk)
-            cv = cv * (1 - onehot.sum(1)[..., None, None]) + \
-                jnp.einsum("btm,bthd->bmhd", onehot, vv)
+        # Insert new K/V at each slot's offset; masked rows write nothing
+        # (dynamic-slice decode fast path, one-hot prefill scatter).
+        ck, cv = _insert_kv(ck, cv, kk, vv, positions, start, write_mask, T)
         attn = _cached_attention(q, ck, cv, lens, positions)
         x = x + (attn.reshape(B, T, -1) @ lp["wo"]).astype(x.dtype)
         h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
-        gated = jax.nn.silu(h @ lp["w_gate"]) * (h @ lp["w_up"])
-        x = x + (gated @ lp["w_down"]).astype(x.dtype)
+        x = x + ffn(cfg, h, lp, token_mask).astype(x.dtype)
         return x, (ck, cv)
 
     x, (new_k, new_v) = jax.lax.scan(
         layer_fn, x, (params["layers"], cache["k"], cache["v"]))
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
-    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    head = params["embed"].T if getattr(cfg, "tie_embeddings", False) \
+        else params["lm_head"]
     logits = jnp.einsum("btd,dv->btv", x, head,
                         preferred_element_type=jnp.float32)
     return logits, {"k": new_k, "v": new_v}
