@@ -34,24 +34,41 @@ def main():
     on_tpu = dev.platform != "cpu"
 
     if on_tpu:
-        cfg = llama.CONFIGS["llama_1b"]
-        batch, seq, steps = 4, 2048, 10
+        # Largest-first: fall back on OOM so one undersized chip still
+        # produces a number instead of a crash.
+        attempts = [("llama_1b", 4, 2048, 10), ("llama_1b", 2, 1024, 10),
+                    ("llama_125m", 8, 2048, 10)]
     else:  # smoke mode
-        cfg = llama.CONFIGS["llama_tiny"]
-        batch, seq, steps = 2, 128, 3
+        attempts = [("llama_tiny", 2, 128, 3)]
 
-    tc = TrainConfig(warmup_steps=2, decay_steps=1000)
-    optimizer = make_optimizer(tc)
-    state = init_train_state(cfg, optimizer, jax.random.PRNGKey(0))
-    step = make_train_step(cfg, tc, optimizer)
-
-    key = jax.random.PRNGKey(1)
-    tokens = jax.random.randint(key, (batch, seq), 0, cfg.vocab_size)
-    batch_data = {"tokens": tokens, "targets": jnp.roll(tokens, -1, axis=1)}
-
-    # Warmup / compile.
-    state, m = step(state, batch_data)
-    jax.block_until_ready(m["total_loss"])
+    last_err = None
+    for model_name, batch, seq, steps in attempts:
+        cfg = llama.CONFIGS[model_name]
+        tc = TrainConfig(warmup_steps=2, decay_steps=1000)
+        optimizer = make_optimizer(tc)
+        try:
+            state = init_train_state(cfg, optimizer, jax.random.PRNGKey(0))
+            step = make_train_step(cfg, tc, optimizer)
+            key = jax.random.PRNGKey(1)
+            tokens = jax.random.randint(key, (batch, seq), 0, cfg.vocab_size)
+            batch_data = {"tokens": tokens,
+                          "targets": jnp.roll(tokens, -1, axis=1)}
+            # Warmup / compile.
+            state, m = step(state, batch_data)
+            jax.block_until_ready(m["total_loss"])
+            break
+        except Exception as e:  # OOM / compile failure: try smaller
+            last_err = e
+            # Release the failed attempt's device buffers before retrying —
+            # live references would make the smaller config OOM too.
+            state = step = tokens = batch_data = m = None
+            try:
+                jax.clear_caches()
+            except Exception:
+                pass
+            continue
+    else:
+        raise SystemExit(f"all bench configs failed: {last_err}")
 
     t0 = time.perf_counter()
     for _ in range(steps):
@@ -76,7 +93,7 @@ def main():
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu, 4),
         "detail": {
-            "model": "llama_1b" if on_tpu else "llama_tiny(smoke)",
+            "model": model_name if on_tpu else f"{model_name}(smoke)",
             "params": n_params,
             "batch": batch, "seq": seq, "steps": steps,
             "achieved_tflops": round(achieved_tflops, 2),

@@ -134,6 +134,23 @@ def test_threaded_reconcile_no_partial_slices():
     assert cluster["status"]["readySlices"] == 2
 
 
+def test_expectations_timeout_expiry():
+    """A create whose watch event never arrives must unblock the group
+    after the timeout (the reference's 30s expectation expiry) — otherwise
+    a lost event wedges scaling forever."""
+    from kuberay_tpu.controlplane.expectations import ScaleExpectations
+    exp = ScaleExpectations(timeout=0.2)
+    exp.expect_create("default", "c1", "workers", "pod-a")
+    assert not exp.satisfied("default", "c1", "workers")
+    time.sleep(0.25)
+    assert exp.satisfied("default", "c1", "workers")
+    # And a fresh expectation still blocks again.
+    exp.expect_delete("default", "c1", "workers", "pod-b")
+    assert not exp.satisfied("default", "c1", "workers")
+    exp.observe_pod_event("default", "c1", "workers", "pod-b", "DELETED")
+    assert exp.satisfied("default", "c1", "workers")
+
+
 def test_watchers_never_poison_store():
     """A crashing watcher must not break writers (ref: informer isolation)."""
     store = ObjectStore()
