@@ -152,6 +152,10 @@ class TpuClusterSpec(Serializable):
     headStateOptions: Optional[HeadStateOptions] = None
     networkPolicy: Optional[NetworkPolicySpec] = None
     upgradeStrategy: str = UpgradeStrategyType.NONE
+    # Token auth for the coordinator API (ref auth secret builder +
+    # e2e raycluster_auth_test.go): the operator mints a Secret and wires
+    # it into every container; the coordinator requires Bearer auth.
+    enableTokenAuth: bool = False
     # Kueue-style handoff (ref ManagedBy raycluster_types.go:25-34):
     managedBy: str = ""
     # Gang scheduler selection (ref batchscheduler labels):

@@ -1,0 +1,66 @@
+"""Auth secret builder (ref the operator-managed auth secret consumed by
+e2e raycluster_auth_test.go): a per-cluster bearer token minted once,
+projected into every container via a secretKeyRef env, enforced by the
+coordinator API."""
+
+from __future__ import annotations
+
+import secrets
+from typing import Any, Dict
+
+from kuberay_tpu.api.tpucluster import TpuCluster
+from kuberay_tpu.builders.common import cluster_owner_reference
+from kuberay_tpu.utils import constants as C
+from kuberay_tpu.utils.names import truncate_name
+
+ENV_AUTH_TOKEN = "TPU_AUTH_TOKEN"
+
+
+def auth_secret_name(cluster_name: str) -> str:
+    return truncate_name(f"{cluster_name}-auth")
+
+
+def build_auth_secret(cluster: TpuCluster) -> Dict[str, Any]:
+    return {
+        "apiVersion": "v1",
+        "kind": "Secret",
+        "metadata": {
+            "name": auth_secret_name(cluster.metadata.name),
+            "namespace": cluster.metadata.namespace,
+            "labels": {C.LABEL_CLUSTER: cluster.metadata.name},
+            "ownerReferences": [cluster_owner_reference(cluster)],
+        },
+        "type": "Opaque",
+        # stringData: raw value (a real apiserver base64-encodes it into
+        # data; raw strings in `data` are rejected as illegal base64).
+        "stringData": {"token": secrets.token_urlsafe(32)},
+    }
+
+
+def auth_env_entry(cluster_name: str) -> Dict[str, Any]:
+    """K8s-shaped env var sourcing the token from the secret."""
+    return {
+        "name": ENV_AUTH_TOKEN,
+        "valueFrom": {"secretKeyRef": {
+            "name": auth_secret_name(cluster_name), "key": "token"}},
+    }
+
+
+def maybe_add_auth_env(container: dict, cluster) -> None:
+    """Append the secretKeyRef env once, iff the cluster enables auth —
+    the single injection path for head/worker/submitter containers."""
+    if not getattr(cluster.spec, "enableTokenAuth", False):
+        return
+    env = container.setdefault("env", [])
+    if ENV_AUTH_TOKEN not in {e.get("name") for e in env}:
+        env.append(auth_env_entry(cluster.metadata.name))
+
+
+def read_auth_token(store, cluster_name: str, namespace: str) -> str:
+    """Operator-side token lookup (controllers authenticate to the
+    coordinator with the same secret the pods consume)."""
+    secret = store.try_get("Secret", auth_secret_name(cluster_name), namespace)
+    if secret is None:
+        return ""
+    return (secret.get("stringData", {}).get("token")
+            or secret.get("data", {}).get("token", ""))

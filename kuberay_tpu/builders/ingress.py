@@ -1,0 +1,69 @@
+"""Ingress builder (ref controllers/ray/common/ingress.go + openshift.go).
+
+Exposes the head's dashboard/serve endpoints through a cluster ingress
+when ``headGroupSpec.enableIngress`` is set.  One builder emits the
+standard ``networking.k8s.io/v1`` shape; the OpenShift Route variant is a
+projection of the same inputs (the reference keeps two files; here one
+module, two emitters).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from kuberay_tpu.api.tpucluster import TpuCluster
+from kuberay_tpu.builders.common import cluster_owner_reference
+from kuberay_tpu.utils import constants as C
+from kuberay_tpu.utils.names import head_service_name, truncate_name
+
+
+def build_head_ingress(cluster: TpuCluster,
+                       ingress_class: str = "",
+                       host: str = "") -> Dict[str, Any]:
+    name = cluster.metadata.name
+    svc = head_service_name(name)
+    rule: Dict[str, Any] = {
+        "http": {"paths": [
+            {"path": f"/{name}", "pathType": "Prefix",
+             "backend": {"service": {
+                 "name": svc, "port": {"number": C.PORT_DASHBOARD}}}},
+            {"path": f"/{name}/serve", "pathType": "Prefix",
+             "backend": {"service": {
+                 "name": svc, "port": {"number": C.PORT_SERVE}}}},
+        ]},
+    }
+    if host:
+        rule["host"] = host
+    spec: Dict[str, Any] = {"rules": [rule]}
+    if ingress_class:
+        spec["ingressClassName"] = ingress_class
+    return {
+        "apiVersion": "networking.k8s.io/v1",
+        "kind": "Ingress",
+        "metadata": {
+            "name": truncate_name(f"{name}-head-ingress"),
+            "namespace": cluster.metadata.namespace,
+            "labels": {C.LABEL_CLUSTER: name},
+            "ownerReferences": [cluster_owner_reference(cluster)],
+        },
+        "spec": spec,
+    }
+
+
+def build_head_route(cluster: TpuCluster) -> Dict[str, Any]:
+    """OpenShift Route projection of the same endpoint (ref openshift.go)."""
+    name = cluster.metadata.name
+    return {
+        "apiVersion": "route.openshift.io/v1",
+        "kind": "Route",
+        "metadata": {
+            "name": truncate_name(f"{name}-head-route"),
+            "namespace": cluster.metadata.namespace,
+            "labels": {C.LABEL_CLUSTER: name},
+            "ownerReferences": [cluster_owner_reference(cluster)],
+        },
+        "spec": {
+            "to": {"kind": "Service", "name": head_service_name(name)},
+            "port": {"targetPort": C.DEFAULT_DASHBOARD_PORT_NAME},
+        },
+    }

@@ -51,6 +51,8 @@ def build_submitter_job(job: TpuJob, cluster: TpuCluster) -> Dict[str, Any]:
     env = container.setdefault("env", [])
     env.append({"name": C.ENV_COORDINATOR_ADDRESS,
                 "value": coordinator_address(cluster)})
+    from kuberay_tpu.builders.auth import maybe_add_auth_env
+    maybe_add_auth_env(container, cluster)
     for k, v in (job.spec.runtimeEnv or {}).items():
         env.append({"name": k, "value": str(v)})
     pod_spec.setdefault("restartPolicy", "Never")

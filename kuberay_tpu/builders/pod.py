@@ -90,6 +90,8 @@ def build_head_pod(cluster: TpuCluster,
             env["TPU_HEAD_EXTERNAL_STORAGE_NAMESPACE"] = (
                 hso.externalStorageNamespace or cluster.metadata.uid)
     _set_env(head, {**(config_env or {}), **env})
+    from kuberay_tpu.builders.auth import maybe_add_auth_env
+    maybe_add_auth_env(head, cluster)
 
     ports = {p.get("name") for p in head.setdefault("ports", [])}
     for pname, pnum in [
@@ -194,6 +196,8 @@ def build_worker_pod(cluster: TpuCluster, group: WorkerGroupSpec,
         env[C.ENV_MEGASCALE_NUM_SLICES] = str(num_slices_in_job)
         env[C.ENV_MEGASCALE_SLICE_ID] = str(megascale_slice_id)
     _set_env(worker, {**(config_env or {}), **env})
+    from kuberay_tpu.builders.auth import maybe_add_auth_env
+    maybe_add_auth_env(worker, cluster)
 
     # Node placement: GKE TPU node-pool selectors
     # (ref kubectl-plugin constant.go:13-19 + TPU samples).

@@ -201,6 +201,14 @@ class TpuClusterController:
         self._ensure(build_head_service(cluster))
         if needs_headless_service(cluster):
             self._ensure(build_headless_service(cluster))
+        if cluster.spec.headGroupSpec.enableIngress:
+            from kuberay_tpu.builders.ingress import build_head_ingress
+            self._ensure(build_head_ingress(cluster))
+        if cluster.spec.enableTokenAuth:
+            # _ensure never rotates: Secrets carry no spec, so the compare
+            # is always equal and only the initial create happens.
+            from kuberay_tpu.builders.auth import build_auth_secret
+            self._ensure(build_auth_secret(cluster))
 
     # ------------------------------------------------------------------
     # pods
@@ -237,6 +245,7 @@ class TpuClusterController:
     def _template_hash(self, cluster: TpuCluster) -> str:
         spec = cluster.spec.to_dict()
         return spec_hash({
+            "auth": spec.get("enableTokenAuth", False),
             "head": spec.get("headGroupSpec"),
             "groups": [
                 {k: v for k, v in g.items()

@@ -384,7 +384,14 @@ class TpuJobController:
     def _client(self, job: TpuJob, cluster: Optional[TpuCluster]):
         if self.client_provider is None or cluster is None:
             return None
-        return self.client_provider(cluster.status.to_dict())
+        client = self.client_provider(cluster.status.to_dict())
+        if cluster.spec.enableTokenAuth and hasattr(client, "auth_token"):
+            from kuberay_tpu.builders.auth import read_auth_token
+            token = read_auth_token(self.store, cluster.metadata.name,
+                                    cluster.metadata.namespace)
+            if token:
+                client.auth_token = token
+        return client
 
     def _teardown(self, job: TpuJob):
         ns = job.metadata.namespace

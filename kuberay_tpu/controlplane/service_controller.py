@@ -289,8 +289,15 @@ class TpuServiceController:
     def _client_for(self, svc: TpuService, cluster: TpuCluster):
         if self.client_provider is None:
             return None
-        return self.client_provider(cluster.metadata.name,
-                                    cluster.status.to_dict())
+        client = self.client_provider(cluster.metadata.name,
+                                      cluster.status.to_dict())
+        if cluster.spec.enableTokenAuth and hasattr(client, "auth_token"):
+            from kuberay_tpu.builders.auth import read_auth_token
+            token = read_auth_token(self.store, cluster.metadata.name,
+                                    cluster.metadata.namespace)
+            if token:
+                client.auth_token = token
+        return client
 
     def _reconcile_serve_config(self, svc: TpuService):
         st = svc.status

@@ -34,17 +34,27 @@ class JobInfo:
 
 
 class CoordinatorClient:
-    """HTTP client for the in-cluster coordinator API (dashboard port)."""
+    """HTTP client for the in-cluster coordinator API (dashboard port).
 
-    def __init__(self, base_url: str, timeout: float = 5.0):
+    ``auth_token`` (default: the TPU_AUTH_TOKEN env the operator injects)
+    is sent as a Bearer header when set."""
+
+    def __init__(self, base_url: str, timeout: float = 5.0,
+                 auth_token: Optional[str] = None):
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        import os
+        self.auth_token = (auth_token if auth_token is not None
+                           else os.environ.get("TPU_AUTH_TOKEN", ""))
 
     def _req(self, method: str, path: str, body: Optional[dict] = None):
         data = json.dumps(body).encode() if body is not None else None
+        headers = {"Content-Type": "application/json"}
+        if self.auth_token:
+            headers["Authorization"] = f"Bearer {self.auth_token}"
         req = urllib.request.Request(
             f"{self.base_url}{path}", data=data, method=method,
-            headers={"Content-Type": "application/json"})
+            headers=headers)
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
                 payload = resp.read()

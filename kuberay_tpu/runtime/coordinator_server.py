@@ -201,7 +201,12 @@ class JobRecord:
 class CoordinatorServer:
     def __init__(self, state: Optional[StateBackend] = None,
                  log_dir: str = "/tmp/tpu-coordinator-logs",
-                 spawn_jobs: bool = True):
+                 spawn_jobs: bool = True,
+                 auth_token: Optional[str] = None):
+        # Bearer auth (ref cluster token auth): token comes from the
+        # operator-minted Secret via the TPU_AUTH_TOKEN env.
+        self.auth_token = (auth_token if auth_token is not None
+                           else os.environ.get("TPU_AUTH_TOKEN", ""))
         self.state = state or backend_from_env()
         self.log_dir = log_dir
         self.spawn_jobs = spawn_jobs
@@ -324,9 +329,25 @@ class CoordinatorServer:
         coord = self
 
         class Handler(JsonHandler):
+            def _authorized(self) -> bool:
+                if not coord.auth_token:
+                    return True
+                import hmac
+                got = self.headers.get("Authorization", "")
+                return hmac.compare_digest(
+                    got, f"Bearer {coord.auth_token}")
+
+            def _guard(self) -> bool:
+                if self._authorized():
+                    return True
+                self._send(401, {"message": "unauthorized"})
+                return False
+
             def do_GET(self):
                 if self.path == "/api/healthz":
                     return self._send(200, {"status": "ok"})
+                if not self._guard():
+                    return
                 if self.path == "/api/cluster":
                     return self._send(200, {
                         "cluster_name": os.environ.get(C.ENV_CLUSTER_NAME, ""),
@@ -357,6 +378,8 @@ class CoordinatorServer:
                 return self._send(404, {"message": "unknown path"})
 
             def do_POST(self):
+                if not self._guard():
+                    return
                 if self.path == "/api/jobs/":
                     b = self._body()
                     rec = coord.submit(
@@ -372,6 +395,8 @@ class CoordinatorServer:
                 return self._send(404, {"message": "unknown path"})
 
             def do_PUT(self):
+                if not self._guard():
+                    return
                 if self.path == "/api/serve/applications/":
                     coord.put_serve_config(self._body())
                     return self._send(200, {})
@@ -385,6 +410,8 @@ class CoordinatorServer:
                 return self._send(404, {"message": "unknown path"})
 
             def do_DELETE(self):
+                if not self._guard():
+                    return
                 if self.path.startswith("/api/jobs/"):
                     jid = self.path.rsplit("/", 1)[1]
                     ok = coord.delete(jid)

@@ -1,0 +1,126 @@
+"""Token auth, ingress builder, and the coordinator-state cleanup
+(GCS-FT deletion) path — the remaining reference feature-area tests
+(ref raycluster_auth_test.go, common/ingress.go, the Redis cleanup Job
+finalizer path at raycluster_controller.go:193-326)."""
+
+import pytest
+
+from kuberay_tpu.api.tpucluster import HeadStateOptions
+from kuberay_tpu.builders.auth import ENV_AUTH_TOKEN, auth_secret_name
+from kuberay_tpu.builders.ingress import build_head_ingress, build_head_route
+from kuberay_tpu.runtime.coordinator_client import CoordinatorClient, CoordinatorError
+from kuberay_tpu.runtime.coordinator_server import CoordinatorServer, MemoryBackend
+from kuberay_tpu.utils import constants as C
+from tests.test_api_types import make_cluster
+from tests.test_cluster_controller import Harness
+
+
+def test_auth_secret_and_env_wiring():
+    h = Harness()
+    c = make_cluster(accelerator="v5p", topology="2x2x2", replicas=1)
+    c.spec.enableTokenAuth = True
+    h.store.create(c.to_dict())
+    h.settle()
+    secret = h.store.get("Secret", auth_secret_name("demo"))
+    assert len(secret["stringData"]["token"]) > 20
+    # Every container sources the token from the secret.
+    for pod in h.pods():
+        env = pod["spec"]["containers"][0]["env"]
+        entry = next(e for e in env if e["name"] == ENV_AUTH_TOKEN)
+        assert entry["valueFrom"]["secretKeyRef"]["name"] == \
+            auth_secret_name("demo")
+    # Reconciles never rotate the token.
+    token = secret["stringData"]["token"]
+    h.settle()
+    assert h.store.get("Secret", auth_secret_name("demo"))["stringData"][
+        "token"] == token
+
+
+def test_coordinator_enforces_bearer_auth():
+    server = CoordinatorServer(state=MemoryBackend(), spawn_jobs=False,
+                               auth_token="sekret")
+    srv, url = server.serve_background()
+    try:
+        anon = CoordinatorClient(url, auth_token="")
+        assert anon.healthz()                      # healthz stays open
+        with pytest.raises(CoordinatorError) as e:
+            anon.list_jobs()
+        assert "401" in str(e.value)
+        with pytest.raises(CoordinatorError):
+            anon.submit_job("j", "echo x")
+        wrong = CoordinatorClient(url, auth_token="nope")
+        with pytest.raises(CoordinatorError):
+            wrong.list_jobs()
+        ok = CoordinatorClient(url, auth_token="sekret")
+        assert ok.list_jobs() == []
+        ok.submit_job("j1", "echo x")
+        assert ok.get_job_info("j1").job_id == "j1"
+    finally:
+        srv.shutdown()
+
+
+def test_ingress_built_when_enabled():
+    h = Harness()
+    c = make_cluster(accelerator="v5e", topology="2x2", replicas=0)
+    c.spec.headGroupSpec.enableIngress = True
+    h.store.create(c.to_dict())
+    h.settle()
+    ing = h.store.get("Ingress", "demo-head-ingress")
+    paths = ing["spec"]["rules"][0]["http"]["paths"]
+    assert {p["path"] for p in paths} == {"/demo", "/demo/serve"}
+    assert paths[0]["backend"]["service"]["name"] == "demo-head-svc"
+    # Off by default.
+    h2 = Harness()
+    h2.store.create(make_cluster(accelerator="v5e", topology="2x2").to_dict())
+    h2.settle()
+    assert h2.store.try_get("Ingress", "demo-head-ingress") is None
+
+
+def test_openshift_route_shape():
+    route = build_head_route(make_cluster())
+    assert route["kind"] == "Route"
+    assert route["spec"]["to"]["name"] == "demo-head-svc"
+
+
+def test_external_state_cleanup_finalizer_flow():
+    """Deletion of an external-backend cluster: pods removed, a cleanup Job
+    is launched, and the finalizer holds the CR until the Job succeeds."""
+    h = Harness()
+    c = make_cluster(accelerator="v5e", topology="2x2", replicas=1)
+    c.spec.headStateOptions = HeadStateOptions(
+        backend="external", externalStorageAddress="redis:6379")
+    h.store.create(c.to_dict())
+    h.settle()
+    assert C.FINALIZER_GCS_FT in h.store.get(
+        "TpuCluster", "demo")["metadata"]["finalizers"]
+
+    h.store.delete("TpuCluster", "demo")
+    h.settle()
+    # CR still present (finalizer), pods gone, cleanup Job exists.
+    cr = h.store.get("TpuCluster", "demo")
+    assert cr["metadata"]["deletionTimestamp"]
+    assert h.pods() == []
+    job = h.store.get("Job", "demo-state-cleanup")
+    cmd = job["spec"]["template"]["spec"]["containers"][0]["command"]
+    assert "redis:6379" in cmd
+    # Cleanup completes -> finalizer released -> CR removed.
+    job["status"] = {"succeeded": 1}
+    h.store.update_status(job)
+    h.settle()
+    assert h.store.try_get("TpuCluster", "demo") is None
+
+
+def test_external_state_cleanup_timeout():
+    """A wedged cleanup Job must not hold the CR hostage forever: the
+    timeout annotation releases the finalizer."""
+    h = Harness()
+    c = make_cluster(accelerator="v5e", topology="2x2", replicas=0)
+    c.spec.headStateOptions = HeadStateOptions(
+        backend="external", externalStorageAddress="redis:6379")
+    c.metadata.annotations = {C.ANNOTATION_FT_DELETION_TIMEOUT: "0"}
+    h.store.create(c.to_dict())
+    h.settle()
+    h.store.delete("TpuCluster", "demo")
+    h.settle()   # first pass creates the Job; timeout=0 releases next pass
+    h.settle()
+    assert h.store.try_get("TpuCluster", "demo") is None
