@@ -45,7 +45,7 @@ PLURALS = {
 }
 CORE_PLURALS = {"pods": "Pod", "services": "Service", "events": "Event",
                 "podgroups": "PodGroup", "networkpolicies": "NetworkPolicy",
-                "jobs": "Job"}
+                "jobs": "Job", "secrets": "Secret", "ingresses": "Ingress"}
 
 # Kinds with admission validation (the single surface lives in
 # controlplane/webhooks.validate_admission; this is membership only).
@@ -55,7 +55,10 @@ _CRD_RE = re.compile(
     r"^/apis/tpu\.dev/v1/namespaces/(?P<ns>[^/]+)/(?P<plural>[^/]+)"
     r"(/(?P<name>[^/]+))?(/(?P<sub>status))?$")
 _CORE_RE = re.compile(
-    r"^/api/v1/namespaces/(?P<ns>[^/]+)/(?P<plural>[^/]+)(/(?P<name>[^/]+))?$")
+    r"^/api/v1/namespaces/(?P<ns>[^/]+)/(?P<plural>[^/]+)"
+    r"(/(?P<name>[^/]+))?(/(?P<sub>status))?$")
+_CRD_ALL_RE = re.compile(r"^/apis/tpu\.dev/v1/(?P<plural>[^/]+)$")
+_CORE_ALL_RE = re.compile(r"^/api/v1/(?P<plural>[^/]+)$")
 
 
 class ApiHandler(JsonHandler):
@@ -75,7 +78,14 @@ class ApiHandler(JsonHandler):
         m = _CORE_RE.match(path)
         if m and m.group("plural") in CORE_PLURALS:
             return (CORE_PLURALS[m.group("plural")], m.group("ns"),
-                    m.group("name"), None)
+                    m.group("name"), m.group("sub"))
+        # Cluster-scope (all-namespaces) list routes.
+        m = _CRD_ALL_RE.match(path)
+        if m and m.group("plural") in PLURALS:
+            return (PLURALS[m.group("plural")], None, None, None)
+        m = _CORE_ALL_RE.match(path)
+        if m and m.group("plural") in CORE_PLURALS:
+            return (CORE_PLURALS[m.group("plural")], None, None, None)
         return None
 
     def _label_selector(self) -> Optional[Dict[str, str]]:
@@ -119,6 +129,8 @@ class ApiHandler(JsonHandler):
         if route is None:
             return self._error(404, "unknown path")
         kind, ns, name, _ = route
+        if ns is None:
+            return self._error(405, "POST requires a namespace")
         if name:
             return self._error(405, "POST to a named resource")
         try:
@@ -147,8 +159,8 @@ class ApiHandler(JsonHandler):
         if route is None:
             return self._error(404, "unknown path")
         kind, ns, name, sub = route
-        if not name:
-            return self._error(405, "PUT requires a resource name")
+        if ns is None or not name:
+            return self._error(405, "PUT requires a namespaced resource name")
         try:
             obj = self._body()
         except json.JSONDecodeError as e:
@@ -189,8 +201,9 @@ class ApiHandler(JsonHandler):
         if route is None:
             return self._error(404, "unknown path")
         kind, ns, name, _ = route
-        if not name:
-            return self._error(405, "DELETE requires a resource name")
+        if ns is None or not name:
+            return self._error(
+                405, "DELETE requires a namespaced resource name")
         try:
             self.store.delete(kind, name, ns)
         except NotFound as e:

@@ -1,0 +1,302 @@
+"""REST-backed object store: the real-cluster seam.
+
+Same verb surface as ``ObjectStore`` (controllers are duck-typed against
+it), speaking K8s-style REST to a remote API server — ours
+(apiserver/server.py) or, with the URL scheme/paths it shares, a real
+kube-apiserver fronting the tpu.dev CRDs.  This is how the control plane
+detaches from the in-memory store without touching a single controller
+(the reference's equivalent split: controller-runtime client vs envtest).
+
+Watch is polling-based (interval configurable): lists are diffed by
+resourceVersion into ADDED/MODIFIED/DELETED events — the informer-lite
+model; a streaming watch can replace ``_poll_once`` without touching
+consumers.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Callable, Dict, List, Optional
+
+from kuberay_tpu.controlplane.store import (
+    AlreadyExists,
+    Conflict,
+    Event,
+    Invalid,
+    NotFound,
+    StoreError,
+)
+
+_CRD_PLURALS = {
+    "TpuCluster": "tpuclusters", "TpuJob": "tpujobs",
+    "TpuService": "tpuservices", "TpuCronJob": "tpucronjobs",
+    "WarmSlicePool": "warmslicepools", "TrafficRoute": "trafficroutes",
+}
+_CORE_PLURALS = {
+    "Pod": "pods", "Service": "services", "Event": "events",
+    "PodGroup": "podgroups", "NetworkPolicy": "networkpolicies",
+    "Job": "jobs", "Secret": "secrets", "Ingress": "ingresses",
+}
+# Kinds the polling watch tracks (what the manager/expectations need).
+WATCHED_KINDS = ("TpuCluster", "TpuJob", "TpuService", "TpuCronJob",
+                 "WarmSlicePool", "Pod", "Service", "Job")
+
+
+class RestObjectStore:
+    def __init__(self, base_url: str, timeout: float = 10.0,
+                 poll_interval: float = 0.2,
+                 watched_kinds=WATCHED_KINDS):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+        self.poll_interval = poll_interval
+        self.watched_kinds = tuple(watched_kinds)
+        self._watchers: List[Callable[[Event], None]] = []
+        self._lock = threading.Lock()
+        self._known: Dict[tuple, int] = {}      # (kind, ns, name) -> rv
+        self._last: Dict[tuple, dict] = {}      # last-seen objects (DELETED
+                                                # events must carry labels)
+        self._stop = threading.Event()
+        self._poll_thread: Optional[threading.Thread] = None
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _path(self, kind: str, ns: Optional[str], name: str = "",
+              sub: str = "") -> str:
+        if kind in _CRD_PLURALS:
+            plural = _CRD_PLURALS[kind]
+            base = (f"/apis/tpu.dev/v1/namespaces/{ns}/{plural}" if ns
+                    else f"/apis/tpu.dev/v1/{plural}")
+        elif kind in _CORE_PLURALS:
+            plural = _CORE_PLURALS[kind]
+            base = (f"/api/v1/namespaces/{ns}/{plural}" if ns
+                    else f"/api/v1/{plural}")
+        else:
+            raise Invalid(f"unknown kind {kind!r}")
+        if name:
+            base += f"/{name}"
+        if sub:
+            base += f"/{sub}"
+        return base
+
+    def _req(self, method: str, path: str, body: Optional[dict] = None):
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            self.base_url + path, data=data, method=method,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                payload = resp.read()
+                return json.loads(payload) if payload else {}
+        except urllib.error.HTTPError as e:
+            try:
+                msg = json.loads(e.read()).get("message", str(e))
+            except Exception:
+                msg = str(e)
+            if e.code == 404:
+                raise NotFound(msg) from None
+            if e.code == 409:
+                # The apiserver uses 409 for both exists + rv conflicts.
+                if "already exists" in msg:
+                    raise AlreadyExists(msg) from None
+                raise Conflict(msg) from None
+            if e.code in (400, 422):
+                raise Invalid(msg) from None
+            raise StoreError(f"HTTP {e.code}: {msg}") from None
+        except urllib.error.URLError as e:
+            raise StoreError(f"{method} {path}: {e}") from None
+
+    # -- verbs (ObjectStore-compatible) ------------------------------------
+
+    def create(self, obj: Dict[str, Any]) -> Dict[str, Any]:
+        md = obj.get("metadata", {})
+        return self._req("POST", self._path(obj["kind"],
+                                            md.get("namespace", "default")),
+                         obj)
+
+    def get(self, kind: str, name: str, namespace: str = "default"):
+        return self._req("GET", self._path(kind, namespace, name))
+
+    def try_get(self, kind: str, name: str, namespace: str = "default"):
+        try:
+            return self.get(kind, name, namespace)
+        except NotFound:
+            return None
+
+    def list(self, kind: str, namespace: Optional[str] = None,
+             labels: Optional[Dict[str, str]] = None) -> List[Dict[str, Any]]:
+        # namespace=None lists ALL namespaces (ObjectStore semantics).
+        path = self._path(kind, namespace)
+        if labels:
+            sel = ",".join(f"{k}={v}" for k, v in labels.items())
+            path += f"?labelSelector={sel}"
+        return self._req("GET", path).get("items", [])
+
+    def update(self, obj: Dict[str, Any], *, subresource: str = ""):
+        md = obj["metadata"]
+        return self._req("PUT", self._path(
+            obj["kind"], md.get("namespace", "default"), md["name"],
+            subresource), obj)
+
+    def update_status(self, obj: Dict[str, Any]):
+        return self.update(obj, subresource="status")
+
+    def patch_labels(self, kind: str, name: str, namespace: str,
+                     labels: Dict[str, Optional[str]]):
+        for _ in range(4):   # optimistic read-modify-write
+            cur = self.get(kind, name, namespace)
+            lab = cur["metadata"].setdefault("labels", {})
+            for k, v in labels.items():
+                if v is None:
+                    lab.pop(k, None)
+                else:
+                    lab[k] = v
+            try:
+                return self.update(cur)
+            except Conflict:
+                continue
+        raise Conflict(f"patch_labels {kind} {namespace}/{name} kept losing")
+
+    def delete(self, kind: str, name: str, namespace: str = "default"):
+        self._req("DELETE", self._path(kind, namespace, name))
+
+    def add_finalizer(self, kind: str, name: str, namespace: str,
+                      finalizer: str):
+        for _ in range(4):
+            cur = self.get(kind, name, namespace)
+            fins = cur["metadata"].setdefault("finalizers", [])
+            if finalizer in fins:
+                return
+            fins.append(finalizer)
+            try:
+                self.update(cur)
+                return
+            except Conflict:
+                continue
+        raise Conflict(f"add_finalizer {kind} {namespace}/{name} kept losing")
+
+    def remove_finalizer(self, kind: str, name: str, namespace: str,
+                         finalizer: str):
+        for _ in range(4):
+            cur = self.try_get(kind, name, namespace)
+            if cur is None:
+                return
+            fins = cur["metadata"].get("finalizers", [])
+            if finalizer not in fins:
+                return
+            fins.remove(finalizer)
+            try:
+                self.update(cur)
+                return
+            except Conflict:
+                continue
+
+    def count(self, kind: str) -> int:
+        return len(self.list(kind))
+
+    def ensure(self, obj: Dict[str, Any], compare=None) -> bool:
+        compare = compare or (lambda o: o.get("spec"))
+        md = obj["metadata"]
+        cur = self.try_get(obj["kind"], md["name"],
+                           md.get("namespace", "default"))
+        if cur is None:
+            try:
+                self.create(obj)
+                return True
+            except AlreadyExists:
+                return False
+        if compare(cur) != compare(obj):
+            cur["spec"] = obj.get("spec", cur.get("spec"))
+            self.update(cur)
+            return True
+        return False
+
+    # -- polling watch -----------------------------------------------------
+
+    def watch(self, fn: Callable[[Event], None]) -> Callable[[], None]:
+        with self._lock:
+            self._watchers.append(fn)
+            if self._poll_thread is None or not self._poll_thread.is_alive():
+                self._stop = threading.Event()
+                self._prime()
+                self._poll_thread = threading.Thread(
+                    target=self._poll_loop, daemon=True, name="rest-watch")
+                self._poll_thread.start()
+
+        def cancel():
+            with self._lock:
+                if fn in self._watchers:
+                    self._watchers.remove(fn)
+        return cancel
+
+    def close(self):
+        self._stop.set()
+        t = self._poll_thread
+        if t is not None:
+            t.join(timeout=2.0)
+        self._poll_thread = None
+
+    def _prime(self):
+        """Seed known-state without emitting events — pre-existing objects
+        are intentionally silent, matching in-memory ObjectStore.watch
+        (level-triggered consumers list on startup instead)."""
+        for kind in self.watched_kinds:
+            try:
+                for obj in self.list(kind):
+                    md = obj["metadata"]
+                    self._known[(kind, md["namespace"], md["name"])] = \
+                        md.get("resourceVersion", 0)
+            except StoreError:
+                continue
+
+    def _poll_once(self):
+        seen = set()
+        failed_kinds = set()
+        events: List[Event] = []
+        for kind in self.watched_kinds:
+            try:
+                items = self.list(kind)
+            except StoreError:
+                # A transient failure means UNKNOWN state — treating it as
+                # "everything of this kind vanished" would storm the
+                # operator with fake DELETEDs.
+                failed_kinds.add(kind)
+                continue
+            for obj in items:
+                md = obj["metadata"]
+                key = (kind, md["namespace"], md["name"])
+                seen.add(key)
+                rv = md.get("resourceVersion", 0)
+                old = self._known.get(key)
+                if old is None:
+                    events.append(Event(Event.ADDED, kind, obj))
+                elif rv != old:
+                    events.append(Event(Event.MODIFIED, kind, obj))
+                self._known[key] = rv
+                self._last[key] = obj
+        for key in [k for k in self._known if k not in seen
+                    and k[0] in self.watched_kinds
+                    and k[0] not in failed_kinds]:
+            kind, ns, name = key
+            del self._known[key]
+            gone = self._last.pop(key, None) or {
+                "kind": kind, "metadata": {"namespace": ns, "name": name,
+                                           "labels": {}}}
+            events.append(Event(Event.DELETED, kind, gone))
+        for ev in events:
+            for w in list(self._watchers):
+                try:
+                    w(ev)
+                except Exception:
+                    pass
+
+    def _poll_loop(self):
+        while not self._stop.is_set():
+            try:
+                self._poll_once()
+            except Exception:
+                pass
+            self._stop.wait(self.poll_interval)

@@ -1,0 +1,158 @@
+"""Pallas decode attention: one-token queries against the serving cache.
+
+The serving hot loop (serve/kv_cache.py) runs attention of a [slots, 1]
+query batch against a [slots, max_len] KV cache every generated token.
+The XLA path materializes full-length scores with masks; this kernel
+streams the cache in blocks with online softmax and — the real win —
+SKIPS blocks beyond each slot's live length (per-slot lengths arrive via
+scalar prefetch, so the skip is a grid-level branch, not a mask): a slot
+at position 100 of a 2048-token cache reads ~1/20th of it.
+
+Layout: q [S, Hq, D]; cache [S, max_len, Hkv, D]; lens [S].  GQA grid is
+(slot, kv_head, kv_block) with the head group computed together
+([group, D] accumulators).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def decode_attention_xla(q, ck, cv, lens, scale: Optional[float] = None):
+    """Reference/fallback.  q: [S, Hq, D]; ck/cv: [S, max, Hkv, D]."""
+    S, Hq, D = q.shape
+    Hkv = ck.shape[2]
+    group = Hq // Hkv
+    scale = scale if scale is not None else 1.0 / (D ** 0.5)
+    kk = jnp.repeat(ck, group, axis=2) if group > 1 else ck
+    vv = jnp.repeat(cv, group, axis=2) if group > 1 else cv
+    s = jnp.einsum("shd,smhd->shm", q, kk,
+                   preferred_element_type=jnp.float32) * scale
+    cols = jnp.arange(ck.shape[1])[None, None, :]
+    s = jnp.where(cols < lens[:, None, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("shm,smhd->shd", p.astype(vv.dtype), vv,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
+def _decode_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_scr, l_scr, acc_scr,
+                   *, scale, bkv, num_kv, group):
+    slot = pl.program_id(0)
+    j = pl.program_id(2)          # kv block (innermost, sequential)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # Grid-level skip: whole blocks beyond this slot's live length do no
+    # MXU work at all (the point of the kernel).
+    live = lens_ref[slot]
+
+    @pl.when(j * bkv < live)
+    def _compute():
+        q = q_ref[0, 0, :, :]                    # [group, D]
+        k = k_ref[0, :, 0, :]                    # [bkv, D]
+        v = v_ref[0, :, 0, :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [group, bkv]
+        cols = j * bkv + jax.lax.broadcasted_iota(
+            jnp.int32, (q.shape[0], bkv), 1)
+        s = jnp.where(cols < live, s, _NEG_INF)
+        m_prev = m_scr[:, :1]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        corr = jnp.exp(m_prev - m_cur)
+        p = jnp.exp(s - m_cur)
+        l_cur = corr * l_scr[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc_scr[:, :] = acc_scr[:, :] * corr + pv
+        m_scr[:, :] = jnp.broadcast_to(m_cur, m_scr.shape)
+        l_scr[:, :] = jnp.broadcast_to(l_cur, l_scr.shape)
+
+    @pl.when(j == num_kv - 1)
+    def _finalize():
+        l = jnp.where(l_scr[:, :1] == 0.0, 1.0, l_scr[:, :1])
+        o_ref[0, 0, :, :] = (acc_scr[:, :] / l).astype(o_ref.dtype)
+
+
+def decode_attention_pallas(q, ck, cv, lens, scale: Optional[float] = None,
+                            bkv: int = 256, interpret: bool = False):
+    S, Hq, D = q.shape
+    max_len = ck.shape[1]
+    Hkv = ck.shape[2]
+    group = Hq // Hkv
+    scale = scale if scale is not None else 1.0 / (D ** 0.5)
+    while max_len % bkv != 0 and bkv > 8:
+        bkv //= 2
+    if max_len % bkv != 0:
+        return decode_attention_xla(q, ck, cv, lens, scale)
+    nkv = max_len // bkv
+
+    # [S, Hkv, group, D] view of q so one grid step owns one kv head's group.
+    qg = q.reshape(S, Hkv, group, D)
+
+    def kv_index(s, h, j, lens):
+        # DMA skip: blocks beyond the slot's live length never stream from
+        # HBM — clamp to the last live block (a cheap re-read the compute
+        # branch ignores).  This, not the pl.when, is the bandwidth win.
+        last_live = jnp.maximum((lens[s] - 1) // bkv, 0)
+        return (s, jnp.minimum(j, last_live), h, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(S, Hkv, nkv),
+        in_specs=[
+            pl.BlockSpec((1, 1, group, D), lambda s, h, j, lens: (s, h, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bkv, 1, D), kv_index, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bkv, 1, D), kv_index, memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, 1, group, D),
+                               lambda s, h, j, lens: (s, h, 0, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((group, 128), jnp.float32),
+            pltpu.VMEM((group, 128), jnp.float32),
+            pltpu.VMEM((group, D), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(_decode_kernel, scale=scale, bkv=bkv,
+                               num_kv=nkv, group=group)
+
+    # k/v views with head axis after the block axis for clean BlockSpecs.
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, Hkv, group, D), q.dtype),
+        interpret=interpret,
+    )(lens.astype(jnp.int32), qg, ck, cv)
+    return out.reshape(S, Hq, D)
+
+
+def decode_attention(q, ck, cv, lens, scale: Optional[float] = None,
+                     impl: str = "auto"):
+    """Dispatching decode attention.  impl: auto|pallas|xla|pallas_interpret."""
+    if impl == "auto":
+        try:
+            on_tpu = jax.default_backend() == "tpu"
+        except Exception:
+            on_tpu = False
+        impl = "pallas" if on_tpu else "xla"
+    if impl == "xla":
+        return decode_attention_xla(q, ck, cv, lens, scale)
+    return decode_attention_pallas(q, ck, cv, lens, scale,
+                                   interpret=impl == "pallas_interpret")

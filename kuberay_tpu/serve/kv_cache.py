@@ -33,6 +33,11 @@ def _cached_attention(q, ck, cv, lens, q_positions):
     containing the new tokens); lens: [B] valid lengths AFTER insertion;
     q_positions: [B, T] absolute positions of the queries."""
     B, T, Hq, D = q.shape
+    if T == 1:
+        # Decode hot path: the Pallas kernel streams only each slot's live
+        # cache blocks (ops/decode_attention.py); GQA handled inside.
+        from kuberay_tpu.ops.decode_attention import decode_attention
+        return decode_attention(q[:, 0], ck, cv, lens)[:, None]
     Hkv = ck.shape[2]
     group = Hq // Hkv
     if group > 1:
