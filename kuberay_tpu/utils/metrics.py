@@ -30,9 +30,12 @@ class Histogram:
     def observe(self, v: float):
         self.n += 1
         self.total += v
+        # counts[i] holds observations landing in bucket i alone; render()
+        # produces the cumulative le-series (doing both would double-count).
         for i, b in enumerate(self.buckets):
             if v <= b:
                 self.counts[i] += 1
+                break
 
 
 class MetricsRegistry:

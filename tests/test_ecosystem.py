@@ -191,3 +191,21 @@ def test_native_loader_matches_numpy(tmp_path):
         np.testing.assert_array_equal(nat.next()["tokens"],
                                       py.next()["tokens"])
     nat.close()
+
+
+def test_histogram_invariants():
+    """Prometheus contract: le="+Inf" cumulative count == _count."""
+    m = ControlPlaneMetrics()
+    for v in (0.3, 0.3, 7.0, 1000.0):
+        m.observe_provisioned("c", v)
+    text = m.render()
+    inf_line = next(l for l in text.splitlines()
+                    if "tpu_cluster_provisioned_duration_seconds_bucket" in l
+                    and 'le="+Inf"' in l)
+    count_line = next(l for l in text.splitlines()
+                      if l.startswith("tpu_cluster_provisioned_duration_seconds_count"))
+    assert inf_line.rsplit(" ", 1)[1] == "4"
+    assert count_line.rsplit(" ", 1)[1] == "4"
+    # le=0.5 bucket holds exactly the two 0.3s.
+    half = next(l for l in text.splitlines() if 'le="0.5"' in l)
+    assert half.rsplit(" ", 1)[1] == "2"

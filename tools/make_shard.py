@@ -1,0 +1,54 @@
+#!/usr/bin/env python3
+"""Token-shard converter: text -> the framework's uint32 shard format
+(see kuberay_tpu/train/data.py).
+
+    python tools/make_shard.py --input corpus.txt --output shard.bin \
+        [--tokenizer gpt2 | --byte-level]
+
+--byte-level needs no model downloads (offset-256 bytes, vocab 512) and is
+the zero-dependency default; --tokenizer uses a HuggingFace tokenizer when
+the transformers cache has one.
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import sys
+
+import numpy as np
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from kuberay_tpu.train.data import write_token_shard  # noqa: E402
+
+
+def byte_level_tokens(text: bytes) -> np.ndarray:
+    # Offset so 0..255 stay free for special tokens.
+    return np.frombuffer(text, dtype=np.uint8).astype(np.uint32) + 256
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--input", required=True)
+    ap.add_argument("--output", required=True)
+    ap.add_argument("--tokenizer", default="",
+                    help="HuggingFace tokenizer name (needs cached model)")
+    ap.add_argument("--byte-level", action="store_true")
+    args = ap.parse_args(argv)
+
+    raw = pathlib.Path(args.input).read_bytes()
+    if args.tokenizer and not args.byte_level:
+        from transformers import AutoTokenizer
+        tok = AutoTokenizer.from_pretrained(args.tokenizer)
+        ids = tok(raw.decode(errors="replace"))["input_ids"]
+        tokens = np.asarray(ids, dtype=np.uint32)
+    else:
+        tokens = byte_level_tokens(raw)
+    write_token_shard(args.output, tokens)
+    print(f"wrote {len(tokens)} tokens -> {args.output}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
