@@ -128,6 +128,10 @@ def main(argv=None):
     g.add_argument("resource", choices=sorted(KIND_BY_ALIAS))
     g.add_argument("-l", "--selector", default="")
 
+    app = sub.add_parser("apply", help="apply manifest file(s)")
+    app.add_argument("-f", "--filename", action="append", required=True,
+                     help="YAML/JSON manifest (repeatable; multi-doc ok)")
+
     st = sub.add_parser("status", help="full status of one resource")
     st.add_argument("resource", choices=["cluster", "job", "service", "cronjob"])
     st.add_argument("name")
@@ -219,6 +223,53 @@ def _dispatch(args, client: ApiClient) -> int:
                     for i in items]
             print(_table(rows, ["NAME", "STATUS"]))
         return 0
+
+    if args.cmd == "apply":
+        import yaml
+        applied, errors = 0, 0
+        for fn in args.filename:
+            try:
+                with open(fn) as f:
+                    docs = [d for d in yaml.safe_load_all(f) if d]
+            except (OSError, yaml.YAMLError) as e:
+                print(f"error reading {fn}: {e}", file=sys.stderr)
+                errors += 1
+                continue
+            for doc in docs:
+                if not isinstance(doc, dict):
+                    print(f"error in {fn}: document is not a mapping",
+                          file=sys.stderr)
+                    errors += 1
+                    continue
+                doc.setdefault("metadata", {}).setdefault("namespace", ns)
+                kind = doc.get("kind", "?")
+                name = doc["metadata"].get("name", "?")
+                try:
+                    try:
+                        client.create(doc)
+                        print(f"{kind.lower()}/{name} created")
+                    except ApiError as e:
+                        if e.code != 409:
+                            raise
+                        # Exists: apply spec + metadata labels/annotations.
+                        cur = client.get(kind, name,
+                                         doc["metadata"]["namespace"])
+                        cur["spec"] = doc.get("spec", cur.get("spec"))
+                        for mkey in ("labels", "annotations"):
+                            if mkey in doc["metadata"]:
+                                cur["metadata"][mkey] = doc["metadata"][mkey]
+                        client.update(cur)
+                        print(f"{kind.lower()}/{name} configured")
+                    applied += 1
+                except ApiError as e:
+                    # kubectl semantics: report and continue the batch.
+                    print(f"error applying {kind.lower()}/{name}: {e}",
+                          file=sys.stderr)
+                    errors += 1
+        if not applied and not errors:
+            print("error: no documents found", file=sys.stderr)
+            return 1
+        return 1 if errors else 0
 
     if args.cmd == "status":
         obj = client.get(KIND_BY_ALIAS[args.resource], args.name, ns)

@@ -14,6 +14,8 @@ reconcilers run on worker threads.
 from __future__ import annotations
 
 import copy
+import json
+import os
 import threading
 import time
 import uuid
@@ -64,18 +66,105 @@ class ObjectStore:
     Label indexing: lookups on the indexed label keys are O(matches), not
     O(objects) — the role the reference's scoped informer caches play for
     10k-cluster scale (internal/managercache/cache.go:18).
+
+    ``journal_path``: optional etcd-lite durability for the standalone
+    operator — every committed state change appends a JSON line; on
+    construction the journal replays, so CRs (and the level-triggered
+    reconcile state they carry) survive operator restarts the same way CR
+    status in a real cluster does (SURVEY §5.4).  The journal compacts to
+    a snapshot when it grows past ``journal_compact_bytes``.
     """
 
     INDEXED_LABELS = ("tpu.dev/cluster", "tpu.dev/warm-pool",
                       "tpu.dev/originated-from-cr-name")
 
-    def __init__(self):
+    def __init__(self, journal_path: str = "",
+                 journal_compact_bytes: int = 64 * 1024 * 1024):
         self._lock = threading.RLock()
         self._objects: Dict[Tuple[str, str, str], Dict[str, Any]] = {}
         self._rv = 0
         self._watchers: List[Callable[[Event], None]] = []
         # (label_key, label_value) -> set of object keys
         self._label_index: Dict[Tuple[str, str], set] = {}
+        self._journal = None
+        self._journal_path = journal_path
+        self._journal_compact_bytes = journal_compact_bytes
+        if journal_path:
+            self._replay_journal()
+            self._journal = open(journal_path, "a", buffering=1)
+
+    # -- durability --------------------------------------------------------
+
+    def _replay_journal(self):
+        if not os.path.exists(self._journal_path):
+            return
+        with open(self._journal_path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    entry = json.loads(line)
+                except ValueError:
+                    continue   # torn tail write
+                op = entry.get("op")
+                if op == "put":
+                    obj = entry["obj"]
+                    md = obj.get("metadata", {})
+                    k = _key(obj.get("kind", ""), md.get("namespace", "default"),
+                             md.get("name", ""))
+                    old = self._objects.get(k)
+                    if old is not None:
+                        self._index_remove(k, old)
+                    self._objects[k] = obj
+                    self._index_add(k, obj)
+                    self._rv = max(self._rv, md.get("resourceVersion", 0))
+                elif op == "del":
+                    k = tuple(entry["key"])
+                    old = self._objects.pop(k, None)
+                    if old is not None:
+                        self._index_remove(k, old)
+                elif op == "snapshot":
+                    # Snapshot restarts the world (compaction marker); the
+                    # recorded rv counter prevents resourceVersion reuse
+                    # after deleted-object churn was compacted away.
+                    self._objects.clear()
+                    self._label_index.clear()
+                    self._rv = max(self._rv, entry.get("rv", 0))
+                    for obj in entry["objects"]:
+                        md = obj.get("metadata", {})
+                        k = _key(obj.get("kind", ""),
+                                 md.get("namespace", "default"),
+                                 md.get("name", ""))
+                        self._objects[k] = obj
+                        self._index_add(k, obj)
+                        self._rv = max(self._rv,
+                                       md.get("resourceVersion", 0))
+
+    def _journal_put(self, obj):
+        if self._journal is not None:
+            self._journal.write(json.dumps({"op": "put", "obj": obj}) + "\n")
+            self._maybe_compact()
+
+    def _journal_del(self, k):
+        if self._journal is not None:
+            self._journal.write(json.dumps({"op": "del", "key": list(k)}) + "\n")
+            self._maybe_compact()
+
+    def _maybe_compact(self):
+        try:
+            if os.path.getsize(self._journal_path) < self._journal_compact_bytes:
+                return
+        except OSError:
+            return
+        tmp = self._journal_path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(json.dumps(
+                {"op": "snapshot", "rv": self._rv,
+                 "objects": list(self._objects.values())}) + "\n")
+        self._journal.close()
+        os.replace(tmp, self._journal_path)
+        self._journal = open(self._journal_path, "a", buffering=1)
 
     def _index_add(self, key, obj):
         labels = obj.get("metadata", {}).get("labels", {}) or {}
@@ -139,6 +228,7 @@ class ObjectStore:
             md.setdefault("generation", 1)
             self._objects[k] = obj
             self._index_add(k, obj)
+            self._journal_put(obj)
             out = copy.deepcopy(obj)
             self._notify(Event(Event.ADDED, kind, copy.deepcopy(obj)))
         return out
@@ -228,6 +318,7 @@ class ObjectStore:
             self._index_remove(k, cur)
             self._objects[k] = new
             self._index_add(k, new)
+            self._journal_put(new)
             out = copy.deepcopy(new)
             self._notify(Event(Event.MODIFIED, kind, copy.deepcopy(new)))
         # Deleting an object is finalized outside the lock path; check here:
@@ -253,6 +344,7 @@ class ObjectStore:
                     lab[k] = v
             self._index_add(key, cur)
             cur["metadata"]["resourceVersion"] = self._next_rv()
+            self._journal_put(cur)
             self._notify(Event(Event.MODIFIED, kind, copy.deepcopy(cur)))
             return copy.deepcopy(cur)
 
@@ -267,6 +359,7 @@ class ObjectStore:
             if not cur["metadata"].get("deletionTimestamp"):
                 cur["metadata"]["deletionTimestamp"] = time.time()
                 cur["metadata"]["resourceVersion"] = self._next_rv()
+                self._journal_put(cur)
                 self._notify(Event(Event.MODIFIED, kind, copy.deepcopy(cur)))
         self._maybe_finalize_delete(kind, name, namespace)
 
@@ -280,6 +373,7 @@ class ObjectStore:
             if finalizer in fins:
                 fins.remove(finalizer)
                 cur["metadata"]["resourceVersion"] = self._next_rv()
+                self._journal_put(cur)
                 self._notify(Event(Event.MODIFIED, kind, copy.deepcopy(cur)))
         self._maybe_finalize_delete(kind, name, namespace)
 
@@ -293,6 +387,7 @@ class ObjectStore:
             if finalizer not in fins:
                 fins.append(finalizer)
                 cur["metadata"]["resourceVersion"] = self._next_rv()
+                self._journal_put(cur)
                 self._notify(Event(Event.MODIFIED, kind, copy.deepcopy(cur)))
 
     def _maybe_finalize_delete(self, kind: str, name: str, namespace: str):
@@ -306,6 +401,7 @@ class ObjectStore:
                     and not cur["metadata"].get("finalizers")):
                 removed = self._objects.pop(k)
                 self._index_remove(k, removed)
+                self._journal_del(k)
                 self._notify(Event(Event.DELETED, kind, copy.deepcopy(removed)))
         if removed is not None:
             self._cascade_delete(removed)

@@ -217,6 +217,9 @@ def main(argv=None):
     ap.add_argument("--reconcile-concurrency", type=int, default=2)
     ap.add_argument("--fake-kubelet", action="store_true",
                     help="run pods with the in-process fake kubelet (demo)")
+    ap.add_argument("--journal", default="",
+                    help="journal file for durable standalone state "
+                         "(CRs survive operator restarts)")
     args = ap.parse_args(argv)
 
     cfg = load_config(args.config) if args.config else OperatorConfiguration()
@@ -226,7 +229,8 @@ def main(argv=None):
     cfg.reconcileConcurrency = args.reconcile_concurrency
     features.parse_and_set(args.feature_gates)
 
-    op = Operator(cfg, fake_kubelet=args.fake_kubelet)
+    store = ObjectStore(journal_path=args.journal) if args.journal else None
+    op = Operator(cfg, store=store, fake_kubelet=args.fake_kubelet)
     url = op.start(api_port=args.api_port, api_host=args.api_host)
     print(f"kuberay-tpu operator running; API at {url}", flush=True)
     try:
