@@ -236,7 +236,8 @@ def _dispatch(args, client: ApiClient) -> int:
                 errors += 1
                 continue
             for doc in docs:
-                if not isinstance(doc, dict):
+                if not isinstance(doc, dict) or not isinstance(
+                        doc.get("metadata", {}), dict):
                     print(f"error in {fn}: document is not a mapping",
                           file=sys.stderr)
                     errors += 1
@@ -252,18 +253,28 @@ def _dispatch(args, client: ApiClient) -> int:
                         if e.code != 409:
                             raise
                         # Exists: apply spec + metadata labels/annotations.
-                        cur = client.get(kind, name,
-                                         doc["metadata"]["namespace"])
-                        cur["spec"] = doc.get("spec", cur.get("spec"))
-                        for mkey in ("labels", "annotations"):
-                            if mkey in doc["metadata"]:
-                                cur["metadata"][mkey] = doc["metadata"][mkey]
-                        client.update(cur)
+                        # Live reconcilers bump resourceVersion constantly,
+                        # so retry conflicts like kubectl does.
+                        for attempt in range(4):
+                            cur = client.get(kind, name,
+                                             doc["metadata"]["namespace"])
+                            cur["spec"] = doc.get("spec", cur.get("spec"))
+                            for mkey in ("labels", "annotations"):
+                                if mkey in doc["metadata"]:
+                                    cur["metadata"][mkey] = \
+                                        doc["metadata"][mkey]
+                            try:
+                                client.update(cur)
+                                break
+                            except ApiError as ue:
+                                if ue.code != 409 or attempt == 3:
+                                    raise
                         print(f"{kind.lower()}/{name} configured")
                     applied += 1
-                except ApiError as e:
-                    # kubectl semantics: report and continue the batch.
-                    print(f"error applying {kind.lower()}/{name}: {e}",
+                except (ApiError, KeyError, AttributeError, TypeError) as e:
+                    # kubectl semantics: report and continue the batch
+                    # (unknown kinds / malformed docs included).
+                    print(f"error applying {kind.lower()}/{name}: {e!r}",
                           file=sys.stderr)
                     errors += 1
         if not applied and not errors:

@@ -89,8 +89,10 @@ class ObjectStore:
         self._journal = None
         self._journal_path = journal_path
         self._journal_compact_bytes = journal_compact_bytes
+        self._last_snapshot_bytes = 0
         if journal_path:
             self._replay_journal()
+            self._repair_torn_tail()
             self._journal = open(journal_path, "a", buffering=1)
 
     # -- durability --------------------------------------------------------
@@ -141,6 +143,20 @@ class ObjectStore:
                         self._rv = max(self._rv,
                                        md.get("resourceVersion", 0))
 
+    def _repair_torn_tail(self):
+        """A crash mid-write can leave a final line without its newline;
+        appending straight onto it would corrupt the NEXT entry too."""
+        try:
+            with open(self._journal_path, "rb+") as f:
+                f.seek(0, 2)
+                if f.tell() == 0:
+                    return
+                f.seek(-1, 2)
+                if f.read(1) != b"\n":
+                    f.write(b"\n")
+        except OSError:
+            pass
+
     def _journal_put(self, obj):
         if self._journal is not None:
             self._journal.write(json.dumps({"op": "put", "obj": obj}) + "\n")
@@ -153,9 +169,13 @@ class ObjectStore:
 
     def _maybe_compact(self):
         try:
-            if os.path.getsize(self._journal_path) < self._journal_compact_bytes:
-                return
+            size = os.path.getsize(self._journal_path)
         except OSError:
+            return
+        # Require real growth past the last snapshot too — a live state
+        # bigger than the threshold must not re-snapshot on every write.
+        if size < max(self._journal_compact_bytes,
+                      2 * self._last_snapshot_bytes):
             return
         tmp = self._journal_path + ".tmp"
         with open(tmp, "w") as f:
@@ -164,6 +184,10 @@ class ObjectStore:
                  "objects": list(self._objects.values())}) + "\n")
         self._journal.close()
         os.replace(tmp, self._journal_path)
+        try:
+            self._last_snapshot_bytes = os.path.getsize(self._journal_path)
+        except OSError:
+            self._last_snapshot_bytes = 0
         self._journal = open(self._journal_path, "a", buffering=1)
 
     def _index_add(self, key, obj):

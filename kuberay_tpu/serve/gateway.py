@@ -1,0 +1,138 @@
+"""Weighted serve gateway: the Gateway-API consumer for TrafficRoute.
+
+Closes the incremental-upgrade loop (service_controller's
+``_reconcile_weighted_services`` records backend weights in a
+``TrafficRoute`` object — ref reconcileGateway/HTTPRoute stepping,
+rayservice_controller.go:920/:976): this process watches the route and
+forwards inference requests to the per-cluster serve backends with
+weighted random choice, so traffic genuinely shifts as the controller
+steps the weights.
+
+Backend resolution is pluggable: in a real cluster the Service name
+resolves via DNS; embedded/tests inject a name->URL map.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+import urllib.request
+from http.server import ThreadingHTTPServer
+from typing import Callable, Dict, List, Optional, Tuple
+
+from kuberay_tpu.utils import constants as C
+from kuberay_tpu.utils.httpjson import JsonHandler, serve_background
+
+
+class WeightedGateway:
+    def __init__(self, store, route_name: str, namespace: str = "default",
+                 resolver: Optional[Callable[[str], str]] = None,
+                 poll_interval: float = 1.0):
+        """``resolver(service_name) -> base_url``; defaults to cluster-DNS
+        (http://<svc>.<ns>.svc:<serve-port>)."""
+        self.store = store
+        self.route_name = route_name
+        self.namespace = namespace
+        self.resolver = resolver or (
+            lambda svc: f"http://{svc}.{namespace}.svc:{C.PORT_SERVE}")
+        self.poll_interval = poll_interval
+        self._lock = threading.Lock()
+        self._backends: List[Tuple[str, int]] = []   # (url, weight)
+        self._stats: Dict[str, int] = {}
+        self._stop = threading.Event()
+        self._refresh()
+        threading.Thread(target=self._watch_loop, daemon=True,
+                         name="gateway-route-watch").start()
+
+    # -- route sync --------------------------------------------------------
+
+    def _refresh(self):
+        route = self.store.try_get("TrafficRoute", self.route_name,
+                                   self.namespace)
+        backends = []
+        if route is not None:
+            for b in route.get("spec", {}).get("backends", []):
+                if b.get("weight", 0) > 0:
+                    backends.append((self.resolver(b["service"]),
+                                     int(b["weight"])))
+        with self._lock:
+            self._backends = backends
+
+    def _watch_loop(self):
+        while not self._stop.is_set():
+            try:
+                self._refresh()
+            except Exception:
+                pass
+            self._stop.wait(self.poll_interval)
+
+    def close(self):
+        self._stop.set()
+
+    # -- routing -----------------------------------------------------------
+
+    def pick_backend(self) -> Optional[str]:
+        with self._lock:
+            backends = list(self._backends)
+        if not backends:
+            return None
+        total = sum(w for _, w in backends)
+        r = random.uniform(0, total)
+        acc = 0.0
+        for url, w in backends:
+            acc += w
+            if r <= acc:
+                with self._lock:
+                    self._stats[url] = self._stats.get(url, 0) + 1
+                return url
+        return backends[-1][0]
+
+    def forward(self, path: str, body: bytes,
+                timeout: float = 300.0) -> Tuple[int, bytes]:
+        url = self.pick_backend()
+        if url is None:
+            return 503, json.dumps(
+                {"message": "no healthy backends in route"}).encode()
+        req = urllib.request.Request(
+            url + path, data=body,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return resp.status, resp.read()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read()
+        except Exception as e:
+            return 502, json.dumps({"message": f"backend error: {e}"}).encode()
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._stats)
+
+    # -- HTTP --------------------------------------------------------------
+
+    def make_server(self, host="0.0.0.0", port=C.PORT_SERVE):
+        gw = self
+
+        class Handler(JsonHandler):
+            def do_GET(self):
+                if self.path == "/healthz":
+                    return self._send(200, {"status": "ok"})
+                if self.path == "/stats":
+                    return self._send(200, gw.stats())
+                return self._send(404, {"message": "unknown path"})
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(n) if n else b"{}"
+                code, payload = gw.forward(self.path, body)
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+        return ThreadingHTTPServer((host, port), Handler)
+
+    def serve_background_http(self, host="127.0.0.1", port=0):
+        return serve_background(self.make_server(host, port), "serve-gateway")

@@ -1,0 +1,113 @@
+"""Weighted gateway: TrafficRoute-driven traffic shifting end to end."""
+
+import json
+import urllib.request
+
+import jax
+import pytest
+
+from kuberay_tpu.controlplane.store import ObjectStore
+from kuberay_tpu.models import llama
+from kuberay_tpu.serve.engine import ServeEngine
+from kuberay_tpu.serve.gateway import WeightedGateway
+from kuberay_tpu.serve.server import ServeFrontend
+
+CFG = llama.CONFIGS["llama_tiny"]
+
+
+@pytest.fixture(scope="module")
+def two_backends():
+    """Two real serve frontends (old/new cluster stand-ins)."""
+    params = llama.init_params(CFG, jax.random.PRNGKey(0))
+    fes, urls = [], {}
+    for name in ("svc-old", "svc-new"):
+        fe = ServeFrontend(ServeEngine(CFG, params, max_slots=2, max_len=64))
+        srv, url = fe.serve_background()
+        fes.append((fe, srv))
+        urls[name] = url
+    yield urls
+    for fe, srv in fes:
+        srv.shutdown()
+        fe.close()
+
+
+def post(url, body, timeout=120):
+    req = urllib.request.Request(
+        f"{url}/v1/completions", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    return json.load(urllib.request.urlopen(req, timeout=timeout))
+
+
+def make_route(store, weights):
+    store.create({
+        "apiVersion": "tpu.dev/v1", "kind": "TrafficRoute",
+        "metadata": {"name": "svc-route", "namespace": "default"},
+        "spec": {"backends": [
+            {"service": name, "weight": w} for name, w in weights.items()]},
+        "status": {},
+    })
+
+
+def test_weighted_routing_follows_route(two_backends):
+    store = ObjectStore()
+    make_route(store, {"svc-old": 100, "svc-new": 0})
+    gw = WeightedGateway(store, "svc-route",
+                         resolver=lambda svc: two_backends[svc],
+                         poll_interval=0.05)
+    srv, url = gw.serve_background_http()
+    try:
+        out = post(url, {"prompt_tokens": [1, 2, 3], "max_tokens": 2})
+        assert len(out["tokens"]) == 2
+        # 100/0: everything lands on old.
+        for _ in range(5):
+            post(url, {"prompt_tokens": [4, 5], "max_tokens": 1})
+        assert gw.stats().get(two_backends["svc-new"], 0) == 0
+        # Controller steps the weights -> traffic shifts to new only.
+        obj = store.get("TrafficRoute", "svc-route")
+        obj["spec"]["backends"] = [{"service": "svc-old", "weight": 0},
+                                   {"service": "svc-new", "weight": 100}]
+        store.update(obj)
+        import time
+        time.sleep(0.2)     # watch refresh
+        before_new = gw.stats().get(two_backends["svc-new"], 0)
+        for _ in range(5):
+            post(url, {"prompt_tokens": [6, 7], "max_tokens": 1})
+        assert gw.stats()[two_backends["svc-new"]] == before_new + 5
+    finally:
+        srv.shutdown()
+        gw.close()
+
+
+def test_gateway_no_backends_503(two_backends):
+    store = ObjectStore()   # no route at all
+    gw = WeightedGateway(store, "missing-route",
+                         resolver=lambda svc: two_backends[svc])
+    srv, url = gw.serve_background_http()
+    try:
+        req = urllib.request.Request(
+            f"{url}/v1/completions", data=b"{}",
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=10)
+        assert e.value.code == 503
+    finally:
+        srv.shutdown()
+        gw.close()
+
+
+def test_gateway_backend_error_502(two_backends):
+    store = ObjectStore()
+    make_route(store, {"svc-old": 100})
+    gw = WeightedGateway(store, "svc-route",
+                         resolver=lambda svc: "http://127.0.0.1:1")  # dead
+    srv, url = gw.serve_background_http()
+    try:
+        req = urllib.request.Request(
+            f"{url}/v1/completions", data=b'{"prompt_tokens": [1]}',
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=10)
+        assert e.value.code == 502
+    finally:
+        srv.shutdown()
+        gw.close()
