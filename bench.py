@@ -21,10 +21,48 @@ import sys
 import time
 
 
+def bench_attention_op():
+    """--op mode: flash attention kernel vs XLA on the local device."""
+    import jax
+    import jax.numpy as jnp
+    from kuberay_tpu.ops.attention import attention_xla, flash_attention
+
+    B, S, Hq, Hkv, D = 4, 2048, 16, 8, 128
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (B, S, Hq, D), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (B, S, Hkv, D), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (B, S, Hkv, D), jnp.bfloat16)
+    results = {}
+    for name, impl in (("pallas", "pallas"), ("xla", "xla")):
+        try:
+            fn = jax.jit(lambda q, k, v, impl=impl: flash_attention(
+                q, k, v, causal=True, impl=impl))
+            fn(q, k, v).block_until_ready()
+            t0 = time.perf_counter()
+            for _ in range(20):
+                out = fn(q, k, v)
+            out.block_until_ready()
+            dt = (time.perf_counter() - t0) / 20
+            results[name + "_ms"] = round(dt * 1e3, 3)
+        except Exception as e:
+            results[name + "_error"] = str(e)[:200]
+    speedup = None
+    if "pallas_ms" in results and "xla_ms" in results:
+        speedup = round(results["xla_ms"] / results["pallas_ms"], 2)
+    print(json.dumps({
+        "metric": "flash_attention_fwd_ms",
+        "value": results.get("pallas_ms", results.get("xla_ms", -1)),
+        "unit": "ms", "vs_baseline": speedup or 0.0,
+        "detail": {**results, "shape": f"B{B} S{S} H{Hq}/{Hkv} D{D} bf16"},
+    }))
+
+
 def main():
     import jax
     if "--cpu" in sys.argv:
         jax.config.update("jax_platforms", "cpu")
+    if "--op" in sys.argv:
+        return bench_attention_op()
     import jax.numpy as jnp
     from kuberay_tpu.models import llama
     from kuberay_tpu.train.train_step import (

@@ -127,9 +127,15 @@ def _flash_fwd(q, k, v, scale, causal, bq, bkv, interpret):
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, bq=bq, bkv=bkv, num_kv=nkv,
         offset=Skv - Sq)
+    flops_per_step = 4 * bq * bkv * D          # qk^T + pv, f32 MACs x2
+    cost = pl.CostEstimate(
+        flops=B * Hq * nq * nkv * flops_per_step,
+        bytes_accessed=(q.size + 2 * k.size + q.size) * q.dtype.itemsize,
+        transcendentals=B * Hq * Sq * Skv)       # exp in the softmax
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
+        cost_estimate=cost,
         in_specs=[
             pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0),
                          memory_space=pltpu.VMEM),

@@ -134,10 +134,15 @@ def decode_attention_pallas(q, ck, cv, lens, scale: Optional[float] = None,
                                num_kv=nkv, group=group)
 
     # k/v views with head axis after the block axis for clean BlockSpecs.
+    cost = pl.CostEstimate(
+        flops=4 * S * Hq * max_len * D,
+        bytes_accessed=(ck.size + cv.size + q.size) * q.dtype.itemsize,
+        transcendentals=S * Hq * max_len)
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((S, Hkv, group, D), q.dtype),
+        cost_estimate=cost,
         interpret=interpret,
     )(lens.astype(jnp.int32), qg, ck, cv)
     return out.reshape(S, Hq, D)
