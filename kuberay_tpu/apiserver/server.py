@@ -88,6 +88,33 @@ class ApiHandler(JsonHandler):
             return (CORE_PLURALS[m.group("plural")], None, None, None)
         return None
 
+    def _watch(self):
+        """Long-poll event stream: returns backlog events with rv > sinceRv,
+        waiting up to timeoutSeconds for the first one (the streaming-watch
+        upgrade over client-side list polling)."""
+        import time as _time
+        q = parse_qs(urlparse(self.path).query)
+        try:
+            since = int(q.get("sinceRv", ["0"])[0])
+            timeout = min(float(q.get("timeoutSeconds", ["25"])[0]), 55.0)
+        except ValueError:
+            return self._error(400, "bad sinceRv/timeoutSeconds")
+        kinds = None
+        if q.get("kinds", [""])[0]:
+            kinds = set(q["kinds"][0].split(","))
+        deadline = _time.time() + timeout
+        while True:
+            events, rv, truncated = self.store.events_since(since, kinds)
+            if events or truncated or _time.time() >= deadline:
+                return self._send(200, {
+                    "resourceVersion": rv,
+                    "truncated": truncated,
+                    "events": [{"type": ev.type, "kind": ev.kind,
+                                "rv": erv, "object": ev.obj}
+                               for erv, ev in events],
+                })
+            _time.sleep(0.05)
+
     def _label_selector(self) -> Optional[Dict[str, str]]:
         q = parse_qs(urlparse(self.path).query)
         sel = q.get("labelSelector", [None])[0]
@@ -112,6 +139,8 @@ class ApiHandler(JsonHandler):
         if path == "/metrics":
             text = self.metrics.render() if self.metrics else ""
             return self._send_text(200, text, "text/plain; version=0.0.4")
+        if path == "/watch":
+            return self._watch()
         route = self._route()
         if route is None:
             return self._error(404, f"unknown path {path}")
@@ -122,7 +151,9 @@ class ApiHandler(JsonHandler):
                 return self._error(404, f"{kind} {ns}/{name} not found")
             return self._send(200, obj)
         items = self.store.list(kind, ns, labels=self._label_selector())
-        return self._send(200, {"kind": f"{kind}List", "items": items})
+        return self._send(200, {"kind": f"{kind}List", "items": items,
+                                "resourceVersion":
+                                    self.store.resource_version()})
 
     def do_POST(self):
         route = self._route()

@@ -82,13 +82,15 @@ class RestObjectStore:
             base += f"/{sub}"
         return base
 
-    def _req(self, method: str, path: str, body: Optional[dict] = None):
+    def _req(self, method: str, path: str, body: Optional[dict] = None,
+             timeout: Optional[float] = None):
         data = json.dumps(body).encode() if body is not None else None
         req = urllib.request.Request(
             self.base_url + path, data=data, method=method,
             headers={"Content-Type": "application/json"})
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            with urllib.request.urlopen(
+                    req, timeout=timeout or self.timeout) as resp:
                 payload = resp.read()
                 return json.loads(payload) if payload else {}
         except urllib.error.HTTPError as e:
@@ -294,9 +296,70 @@ class RestObjectStore:
                     pass
 
     def _poll_loop(self):
+        # Prefer the server's long-poll /watch (immediate delivery, no
+        # per-interval full lists); fall back to list-diff polling.
+        rv = self._resync()
         while not self._stop.is_set():
+            if rv is not None:
+                try:
+                    rv = self._watch_once(rv)
+                except Exception:
+                    rv = None        # malformed response must not kill us
+                if rv is None:        # stream broken/truncated: resync
+                    rv = self._resync()
+                continue
             try:
                 self._poll_once()
             except Exception:
                 pass
             self._stop.wait(self.poll_interval)
+
+    def _resync(self):
+        """Atomic-enough resume point: capture the rv BEFORE relisting, so
+        events racing the relist get replayed (duplicates are harmless to
+        level-triggered consumers) instead of lost."""
+        rv0 = self._probe_watch_rv()
+        try:
+            self._poll_once()
+        except Exception:
+            pass
+        return rv0
+
+    def _probe_watch_rv(self):
+        """Returns the server's current rv when /watch exists, else None."""
+        try:
+            out = self._req("GET", "/watch?sinceRv=999999999&timeoutSeconds=0")
+            return int(out.get("resourceVersion", 0))
+        except (StoreError, NotFound, Invalid):
+            return None
+
+    def _watch_once(self, rv):
+        hold = 20.0
+        try:
+            out = self._req(
+                "GET",
+                f"/watch?sinceRv={rv}&timeoutSeconds={hold}"
+                f"&kinds={','.join(self.watched_kinds)}",
+                timeout=hold + 10.0)   # client must outlive the server hold
+        except StoreError:
+            return None
+        if out.get("truncated"):
+            return None
+        for entry in out.get("events", []):
+            kind = entry.get("kind", "")
+            obj = entry.get("object", {})
+            md = obj.get("metadata", {})
+            key = (kind, md.get("namespace", "default"), md.get("name", ""))
+            ev = Event(entry.get("type", "MODIFIED"), kind, obj)
+            if ev.type == Event.DELETED:
+                self._known.pop(key, None)
+                self._last.pop(key, None)
+            else:
+                self._known[key] = md.get("resourceVersion", 0)
+                self._last[key] = obj
+            for w in list(self._watchers):
+                try:
+                    w(ev)
+                except Exception:
+                    pass
+        return int(out.get("resourceVersion", rv))

@@ -89,6 +89,10 @@ class ObjectStore:
         self._journal = None
         self._journal_path = journal_path
         self._journal_compact_bytes = journal_compact_bytes
+        # Bounded event backlog for streaming watches: (rv, Event); rv is
+        # the post-commit resourceVersion so clients resume by rv.
+        self._backlog: List[Tuple[int, Event]] = []
+        self._backlog_max = 10000
         self._last_snapshot_bytes = 0
         if journal_path:
             self._replay_journal()
@@ -215,6 +219,9 @@ class ObjectStore:
         return self._rv
 
     def _notify(self, ev: Event):
+        self._backlog.append((self._rv, ev))
+        if len(self._backlog) > self._backlog_max:
+            del self._backlog[: len(self._backlog) - self._backlog_max]
         for w in list(self._watchers):
             try:
                 w(ev)
@@ -426,6 +433,9 @@ class ObjectStore:
                 removed = self._objects.pop(k)
                 self._index_remove(k, removed)
                 self._journal_del(k)
+                # DELETED gets its own rv: it must not share the preceding
+                # MODIFIED's, or resuming watchers skip it forever.
+                self._next_rv()
                 self._notify(Event(Event.DELETED, kind, copy.deepcopy(removed)))
         if removed is not None:
             self._cascade_delete(removed)
@@ -479,3 +489,18 @@ class ObjectStore:
     def resource_version(self) -> int:
         with self._lock:
             return self._rv
+
+    def events_since(self, rv: int, kinds=None):
+        """(events, latest_rv, truncated): backlog entries with rv > given.
+        ``truncated`` True when the backlog no longer reaches back to
+        ``rv`` — the client must relist (standard watch-resume contract).
+        An empty backlog with rv behind the store (journal replay,
+        restart) is also truncation: the missed span is unrecoverable."""
+        with self._lock:
+            if rv >= self._rv:
+                return [], self._rv, False     # idle fast path: no scan
+            truncated = ((bool(self._backlog) and self._backlog[0][0] > rv + 1)
+                         or (not self._backlog and rv < self._rv))
+            out = [(erv, ev) for erv, ev in self._backlog if erv > rv
+                   and (kinds is None or ev.kind in kinds)]
+            return out, self._rv, truncated

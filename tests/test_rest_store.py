@@ -113,3 +113,46 @@ def test_operator_over_rest_end_to_end(remote):
     finally:
         op.stop()
         rest.close()
+
+
+def test_streaming_watch_endpoint(remote):
+    """/watch long-poll: immediate event delivery with rv resume."""
+    import json
+    import urllib.request
+    backing, url = remote
+    rv0 = json.load(urllib.request.urlopen(
+        f"{url}/watch?sinceRv=999999999&timeoutSeconds=0"))["resourceVersion"]
+    backing.create({"apiVersion": "v1", "kind": "Pod",
+                    "metadata": {"name": "w1", "namespace": "default"},
+                    "spec": {}, "status": {}})
+    out = json.load(urllib.request.urlopen(
+        f"{url}/watch?sinceRv={rv0}&timeoutSeconds=5&kinds=Pod"))
+    types = [(e["type"], e["object"]["metadata"]["name"])
+             for e in out["events"]]
+    assert ("ADDED", "w1") in types
+    # Resume from the returned rv: nothing new -> empty after timeout 0.
+    out2 = json.load(urllib.request.urlopen(
+        f"{url}/watch?sinceRv={out['resourceVersion']}&timeoutSeconds=0"))
+    assert out2["events"] == []
+
+
+def test_rest_store_uses_streaming_watch(remote):
+    """The client consumes /watch (no interval latency): events arrive
+    well under the polling interval."""
+    import time
+    backing, url = remote
+    store = RestObjectStore(url, poll_interval=5.0)   # polling would be slow
+    got = []
+    store.watch(lambda ev: got.append((ev.type, ev.kind,
+                                       ev.obj["metadata"]["name"])))
+    time.sleep(0.3)
+    backing.create({"apiVersion": "v1", "kind": "Pod",
+                    "metadata": {"name": "fast", "namespace": "default"},
+                    "spec": {}, "status": {}})
+    deadline = time.time() + 3.0     # << poll_interval: must be streamed
+    while time.time() < deadline:
+        if ("ADDED", "Pod", "fast") in got:
+            break
+        time.sleep(0.05)
+    store.close()
+    assert ("ADDED", "Pod", "fast") in got
