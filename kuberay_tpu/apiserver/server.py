@@ -92,28 +92,28 @@ class ApiHandler(JsonHandler):
         """Long-poll event stream: returns backlog events with rv > sinceRv,
         waiting up to timeoutSeconds for the first one (the streaming-watch
         upgrade over client-side list polling)."""
-        import time as _time
+        import math
         q = parse_qs(urlparse(self.path).query)
         try:
             since = int(q.get("sinceRv", ["0"])[0])
-            timeout = min(float(q.get("timeoutSeconds", ["25"])[0]), 55.0)
+            timeout = float(q.get("timeoutSeconds", ["25"])[0])
         except ValueError:
             return self._error(400, "bad sinceRv/timeoutSeconds")
+        if not math.isfinite(timeout) or timeout < 0:
+            return self._error(400, "bad timeoutSeconds")
+        timeout = min(timeout, 55.0)
         kinds = None
         if q.get("kinds", [""])[0]:
             kinds = set(q["kinds"][0].split(","))
-        deadline = _time.time() + timeout
-        while True:
-            events, rv, truncated = self.store.events_since(since, kinds)
-            if events or truncated or _time.time() >= deadline:
-                return self._send(200, {
-                    "resourceVersion": rv,
-                    "truncated": truncated,
-                    "events": [{"type": ev.type, "kind": ev.kind,
-                                "rv": erv, "object": ev.obj}
-                               for erv, ev in events],
-                })
-            _time.sleep(0.05)
+        events, rv, truncated = self.store.wait_for_events(
+            since, kinds, timeout)
+        return self._send(200, {
+            "resourceVersion": rv,
+            "truncated": truncated,
+            "events": [{"type": ev.type, "kind": ev.kind,
+                        "rv": erv, "object": ev.obj}
+                       for erv, ev in events],
+        })
 
     def _label_selector(self) -> Optional[Dict[str, str]]:
         q = parse_qs(urlparse(self.path).query)

@@ -171,6 +171,18 @@ def main(argv=None):
     # Entrypoint is everything after a literal "--" (split before argparse;
     # REMAINDER would swallow flags that precede it).
 
+    se = sub.add_parser("session",
+                        help="forward local ports to a cluster's head "
+                             "(the port-forward analogue)")
+    se.add_argument("name")
+    se.add_argument("--target", default="",
+                    help="head host to forward to (default: derived from "
+                         "cluster status coordinatorAddress)")
+    se.add_argument("--local-dashboard", type=int, default=8265)
+    se.add_argument("--local-serve", type=int, default=8000)
+    se.add_argument("--print-only", action="store_true",
+                    help="print the endpoints without forwarding")
+
     lg = sub.add_parser("logs", help="fetch a job's logs via its coordinator")
     lg.add_argument("name")
     lg.add_argument("--coordinator", default="",
@@ -343,6 +355,22 @@ def _dispatch(args, client: ApiClient) -> int:
                     return 0 if state == "Complete" else 2
                 time.sleep(1.0)
         return 0
+
+    if args.cmd == "session":
+        from kuberay_tpu.cli.session import run_session
+        cluster = client.get(C.KIND_CLUSTER, args.name, ns)
+        target = args.target
+        if not target:
+            addr = cluster.get("status", {}).get("coordinatorAddress", "")
+            target = addr.split(":")[0] if addr else ""
+        if not target:
+            print("error: no coordinator address known; pass --target",
+                  file=sys.stderr)
+            return 1
+        return run_session(target, [
+            (args.local_dashboard, C.PORT_DASHBOARD, "dashboard"),
+            (args.local_serve, C.PORT_SERVE, "serve"),
+        ], print_only=args.print_only)
 
     if args.cmd == "logs":
         from kuberay_tpu.runtime.coordinator_client import (

@@ -1,0 +1,103 @@
+"""Session port-forwarding (ref kubectl-plugin session.go pattern):
+forward local TCP ports to the cluster head's dashboard/serve ports so
+`localhost:<port>` works from the operator's machine — a plain TCP relay
+(works wherever the head host is routable; inside K8s the kubectl
+port-forward API would slot in behind the same interface)."""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Iterable, List, Tuple
+
+
+def _pipe(a: socket.socket, b: socket.socket):
+    try:
+        while True:
+            data = a.recv(65536)
+            if not data:
+                break
+            b.sendall(data)
+    except OSError:
+        pass
+    finally:
+        for s in (a, b):
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+class PortForward:
+    """One local listener relaying to (host, port)."""
+
+    def __init__(self, local_port: int, host: str, remote_port: int):
+        self.host = host
+        self.remote_port = remote_port
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("127.0.0.1", local_port))
+        self._srv.listen(16)
+        self.local_port = self._srv.getsockname()[1]
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        daemon=True, name="port-forward")
+        self._thread.start()
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                client, _ = self._srv.accept()
+            except OSError:
+                return
+            try:
+                upstream = socket.create_connection(
+                    (self.host, self.remote_port), timeout=10)
+            except OSError:
+                client.close()
+                continue
+            threading.Thread(target=_pipe, args=(client, upstream),
+                             daemon=True).start()
+            threading.Thread(target=_pipe, args=(upstream, client),
+                             daemon=True).start()
+
+    def close(self):
+        self._stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+
+def run_session(target: str, forwards: Iterable[Tuple[int, int, str]],
+                print_only: bool = False) -> int:
+    """forwards: (local_port, remote_port, label).  Blocks until Ctrl-C."""
+    if print_only:
+        for local, remote, label in forwards:
+            print(f"{label}: http://127.0.0.1:{local} -> "
+                  f"{target}:{remote}")
+        return 0
+    import sys
+    active: List[PortForward] = []
+    try:
+        for local, remote, label in forwards:
+            try:
+                pf = PortForward(local, target, remote)
+            except OSError as e:
+                print(f"error: cannot bind 127.0.0.1:{local} ({e})",
+                      file=sys.stderr)
+                return 1
+            active.append(pf)
+            print(f"forwarding {label}: http://127.0.0.1:{pf.local_port} -> "
+                  f"{target}:{remote}", flush=True)
+        threading.Event().wait()    # until interrupted
+    except KeyboardInterrupt:
+        pass
+    finally:
+        for pf in active:
+            pf.close()
+    return 0

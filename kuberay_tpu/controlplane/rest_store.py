@@ -224,8 +224,12 @@ class RestObjectStore:
             if self._poll_thread is None or not self._poll_thread.is_alive():
                 self._stop = threading.Event()
                 self._prime()
+                # The loop captures ITS stop event: a long-poll can outlive
+                # close()'s join, and a restarted watch must not resurrect
+                # the old thread via the replaced self._stop.
                 self._poll_thread = threading.Thread(
-                    target=self._poll_loop, daemon=True, name="rest-watch")
+                    target=self._poll_loop, args=(self._stop,),
+                    daemon=True, name="rest-watch")
                 self._poll_thread.start()
 
         def cancel():
@@ -295,24 +299,32 @@ class RestObjectStore:
                 except Exception:
                     pass
 
-    def _poll_loop(self):
+    def _poll_loop(self, stop: threading.Event):
         # Prefer the server's long-poll /watch (immediate delivery, no
         # per-interval full lists); fall back to list-diff polling.
-        rv = self._resync()
-        while not self._stop.is_set():
+        try:
+            rv = self._resync()
+        except Exception:
+            rv = None
+        while not stop.is_set():
             if rv is not None:
                 try:
                     rv = self._watch_once(rv)
                 except Exception:
                     rv = None        # malformed response must not kill us
                 if rv is None:        # stream broken/truncated: resync
-                    rv = self._resync()
+                    try:
+                        rv = self._resync()
+                    except Exception:
+                        rv = None
+                    if rv is None:
+                        stop.wait(self.poll_interval)
                 continue
             try:
                 self._poll_once()
             except Exception:
                 pass
-            self._stop.wait(self.poll_interval)
+            stop.wait(self.poll_interval)
 
     def _resync(self):
         """Atomic-enough resume point: capture the rv BEFORE relisting, so

@@ -93,6 +93,7 @@ class ObjectStore:
         # the post-commit resourceVersion so clients resume by rv.
         self._backlog: List[Tuple[int, Event]] = []
         self._backlog_max = 10000
+        self._backlog_cond = threading.Condition(self._lock)
         self._last_snapshot_bytes = 0
         if journal_path:
             self._replay_journal()
@@ -222,6 +223,7 @@ class ObjectStore:
         self._backlog.append((self._rv, ev))
         if len(self._backlog) > self._backlog_max:
             del self._backlog[: len(self._backlog) - self._backlog_max]
+        self._backlog_cond.notify_all()
         for w in list(self._watchers):
             try:
                 w(ev)
@@ -489,6 +491,25 @@ class ObjectStore:
     def resource_version(self) -> int:
         with self._lock:
             return self._rv
+
+    def wait_for_events(self, rv: int, kinds=None, timeout: float = 25.0):
+        """Blocking events_since: waits on the store's condition variable
+        until something lands past ``rv`` (or timeout) — zero idle work,
+        immediate delivery for /watch long-polls."""
+        deadline = time.time() + timeout
+        with self._backlog_cond:
+            while True:
+                out = [(erv, ev) for erv, ev in self._backlog if erv > rv
+                       and (kinds is None or ev.kind in kinds)]
+                truncated = ((bool(self._backlog)
+                              and self._backlog[0][0] > rv + 1)
+                             or (not self._backlog and rv < self._rv))
+                if out or truncated:
+                    return out, self._rv, truncated
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    return [], self._rv, False
+                self._backlog_cond.wait(remaining)
 
     def events_since(self, rv: int, kinds=None):
         """(events, latest_rv, truncated): backlog entries with rv > given.

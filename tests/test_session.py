@@ -1,0 +1,50 @@
+"""tpuctl session: real TCP forwarding to a live coordinator."""
+
+import json
+import urllib.request
+
+import pytest
+
+from kuberay_tpu.cli.session import PortForward
+from kuberay_tpu.runtime.coordinator_server import CoordinatorServer, MemoryBackend
+
+
+def test_port_forward_relays_http():
+    coord = CoordinatorServer(state=MemoryBackend(), spawn_jobs=False)
+    srv, url = coord.serve_background()
+    remote_port = int(url.rsplit(":", 1)[1])
+    pf = PortForward(0, "127.0.0.1", remote_port)
+    try:
+        out = json.load(urllib.request.urlopen(
+            f"http://127.0.0.1:{pf.local_port}/api/healthz", timeout=10))
+        assert out == {"status": "ok"}
+        # POST through the tunnel too.
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{pf.local_port}/api/jobs/",
+            data=json.dumps({"submission_id": "tunneled",
+                             "entrypoint": "x"}).encode(),
+            headers={"Content-Type": "application/json"})
+        out = json.load(urllib.request.urlopen(req, timeout=10))
+        assert out["submission_id"] == "tunneled"
+        assert "tunneled" in coord.jobs
+    finally:
+        pf.close()
+        srv.shutdown()
+
+
+def test_port_forward_dead_upstream():
+    pf = PortForward(0, "127.0.0.1", 1)   # nothing listens on :1
+    try:
+        with pytest.raises(Exception):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{pf.local_port}/x", timeout=5)
+    finally:
+        pf.close()
+
+
+def test_session_print_only(capsys):
+    from kuberay_tpu.cli.session import run_session
+    rc = run_session("head.svc", [(8265, 8265, "dashboard")],
+                     print_only=True)
+    assert rc == 0
+    assert "head.svc:8265" in capsys.readouterr().out
