@@ -1,0 +1,133 @@
+"""Leader election (ref main.go:232 ``ray-operator-leader`` via
+controller-runtime's Lease-based election).
+
+A ``Lease`` object in the store is the lock: the holder renews
+``renewTime`` every ``renew_interval``; others take over once
+``lease_duration`` passes without a renewal.  Acquisition and takeover go
+through optimistic-concurrency updates, so exactly one candidate can win
+any given transition — the single-writer-per-CR guarantee multi-replica
+operators need.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Callable, Optional
+
+from kuberay_tpu.controlplane.store import (
+    AlreadyExists,
+    Conflict,
+    NotFound,
+    ObjectStore,
+)
+
+LEASE_NAME = "kuberay-tpu-operator-leader"
+
+
+class LeaderElector:
+    def __init__(self, store: ObjectStore, identity: Optional[str] = None,
+                 namespace: str = "default",
+                 lease_duration: float = 15.0,
+                 renew_interval: float = 5.0,
+                 on_started_leading: Optional[Callable[[], None]] = None,
+                 on_stopped_leading: Optional[Callable[[], None]] = None):
+        self.store = store
+        self.identity = identity or f"operator-{uuid.uuid4().hex[:8]}"
+        self.namespace = namespace
+        self.lease_duration = lease_duration
+        self.renew_interval = renew_interval
+        self.on_started_leading = on_started_leading
+        self.on_stopped_leading = on_stopped_leading
+        self._is_leader = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def is_leader(self) -> bool:
+        return self._is_leader
+
+    # ------------------------------------------------------------------
+
+    def _try_acquire_or_renew(self) -> bool:
+        now = time.time()
+        lease = self.store.try_get("Lease", LEASE_NAME, self.namespace)
+        if lease is None:
+            try:
+                self.store.create({
+                    "apiVersion": "coordination.k8s.io/v1", "kind": "Lease",
+                    "metadata": {"name": LEASE_NAME,
+                                 "namespace": self.namespace},
+                    "spec": {"holderIdentity": self.identity,
+                             "renewTime": now,
+                             "leaseDurationSeconds": self.lease_duration},
+                    "status": {},
+                })
+                return True
+            except AlreadyExists:
+                return False   # racer won; retry next tick
+        holder = lease["spec"].get("holderIdentity", "")
+        renew = float(lease["spec"].get("renewTime", 0.0))
+        expired = now - renew > self.lease_duration
+        if holder != self.identity and not expired:
+            return False
+        # Renew (ours) or take over (expired): optimistic update — exactly
+        # one contender's rv matches.
+        lease["spec"]["holderIdentity"] = self.identity
+        lease["spec"]["renewTime"] = now
+        try:
+            self.store.update(lease)
+            return True
+        except (Conflict, NotFound):
+            return False
+
+    def _loop(self, stop: threading.Event):
+        while not stop.is_set():
+            leading = False
+            try:
+                leading = self._try_acquire_or_renew()
+            except Exception:
+                leading = False
+            if leading and not self._is_leader:
+                self._is_leader = True
+                if self.on_started_leading:
+                    try:
+                        self.on_started_leading()
+                    except Exception:
+                        pass   # a callback bug must not kill renewal
+            elif not leading and self._is_leader:
+                self._is_leader = False
+                if self.on_stopped_leading:
+                    try:
+                        self.on_stopped_leading()
+                    except Exception:
+                        pass
+            stop.wait(self.renew_interval if leading
+                      else min(self.renew_interval, 2.0))
+
+    def start(self):
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop,
+                                        args=(self._stop,), daemon=True,
+                                        name="leader-elector")
+        self._thread.start()
+
+    def stop(self, release: bool = True):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+        was_leader = self._is_leader
+        self._is_leader = False
+        if release and was_leader:
+            # Graceful handoff: zero the renew time so a successor takes
+            # over immediately instead of waiting out the lease.
+            try:
+                lease = self.store.try_get("Lease", LEASE_NAME,
+                                           self.namespace)
+                if lease is not None and \
+                        lease["spec"].get("holderIdentity") == self.identity:
+                    lease["spec"]["renewTime"] = 0.0
+                    self.store.update(lease)
+            except (Conflict, NotFound):
+                pass

@@ -34,6 +34,7 @@ from kuberay_tpu.controlplane.manager import (
 )
 from kuberay_tpu.controlplane.networkpolicy_controller import NetworkPolicyController
 from kuberay_tpu.controlplane.service_controller import TpuServiceController
+from kuberay_tpu.controlplane.leader import LeaderElector
 from kuberay_tpu.controlplane.store import ObjectStore
 from kuberay_tpu.controlplane.warmpool_controller import (
     KIND_WARM_POOL,
@@ -126,6 +127,7 @@ class Operator:
         self._stop = threading.Event()
         self.apiserver = None
         self.api_url = ""
+        self.elector: Optional[LeaderElector] = None
 
     def _timed(self, kind, fn):
         def wrapped(name, ns):
@@ -147,19 +149,48 @@ class Operator:
 
     # -- lifecycle ---------------------------------------------------------
 
-    def start(self, api_port: int = 0, api_host: str = "127.0.0.1"):
-        """Start workers + API server; returns the API base URL."""
+    def start(self, api_port: int = 0, api_host: str = "127.0.0.1",
+              leader_election: bool = False):
+        """Start workers + API server; returns the API base URL.
+
+        ``leader_election``: multi-replica mode (ref main.go:232
+        'ray-operator-leader') — reconcilers only run while this replica
+        holds the Lease; the API server always serves (reads are safe).
+        """
         self.apiserver, self.api_url = serve_background(
             self.store, api_host, api_port, metrics=self.metrics)
-        self.manager.start(workers=max(1, self.config.reconcileConcurrency))
-        threading.Thread(target=self._background_loops, daemon=True,
-                         name="operator-loops").start()
+        if leader_election:
+            self.elector = LeaderElector(
+                self.store,
+                namespace=self.config.leaderElectionNamespace,
+                on_started_leading=self._start_reconcilers,
+                on_stopped_leading=self._stop_reconcilers)
+            self.elector.start()
+        else:
+            self._start_reconcilers()
         return self.api_url
 
-    def _background_loops(self):
+    def _start_reconcilers(self):
+        self.manager.start(workers=max(1, self.config.reconcileConcurrency))
+        # The loop thread captures ITS stop event: replacing self._stop for
+        # a later re-election must not leave an orphan running.
+        self._loops_thread = threading.Thread(
+            target=self._background_loops, args=(self._stop,), daemon=True,
+            name="operator-loops")
+        self._loops_thread.start()
+
+    def _stop_reconcilers(self):
+        self._stop.set()
+        self.manager.stop()
+        t = getattr(self, "_loops_thread", None)
+        if t is not None:
+            t.join(timeout=3.0)
+        self._stop = threading.Event()   # allow re-election to restart
+
+    def _background_loops(self, stop: threading.Event):
         """Periodic work: autoscaler passes, cron ticks, fake kubelet."""
         log = logging.getLogger("kuberay_tpu.operator")
-        while not self._stop.is_set():
+        while not stop.is_set():
             try:
                 clusters = self.store.list(C.KIND_CLUSTER)
                 self.autoscaler.prune_clusters(
@@ -178,13 +209,37 @@ class Operator:
                             (C.KIND_CRONJOB, md["namespace"], md["name"]))
                 if self.kubelet is not None:
                     self.kubelet.step()
+                self._gc_events()
             except Exception:
                 log.exception("operator background loop iteration failed")
-            self._stop.wait(1.0)
+            stop.wait(1.0)
+
+    _EVENT_TTL_SECONDS = 3600.0
+    _EVENT_GC_INTERVAL = 60.0
+
+    def _gc_events(self):
+        """Events expire like K8s's (~1h) — unbounded accumulation is a
+        slow leak in a long-lived store.  Swept once a minute: a per-second
+        full Event scan would contend the store lock for nothing."""
+        now = time.time()
+        if now - getattr(self, "_last_event_gc", 0.0) < self._EVENT_GC_INTERVAL:
+            return
+        self._last_event_gc = now
+        cutoff = now - self._EVENT_TTL_SECONDS
+        for ev in self.store.list("Event"):
+            if ev.get("eventTime", cutoff + 1) < cutoff:
+                try:
+                    self.store.delete("Event", ev["metadata"]["name"],
+                                      ev["metadata"]["namespace"])
+                except Exception:
+                    pass
 
     def stop(self):
-        self._stop.set()
-        self.manager.stop()
+        # Reconcilers stop BEFORE the lease is released: a successor must
+        # never overlap with our in-flight reconciles (dual-writer window).
+        self._stop_reconcilers()
+        if self.elector is not None:
+            self.elector.stop()
         if self.apiserver is not None:
             self.apiserver.shutdown()
 
@@ -217,6 +272,13 @@ def main(argv=None):
     ap.add_argument("--reconcile-concurrency", type=int, default=2)
     ap.add_argument("--fake-kubelet", action="store_true",
                     help="run pods with the in-process fake kubelet (demo)")
+    ap.add_argument("--leader-election", action="store_true",
+                    help="multi-replica mode: reconcile only while holding "
+                         "the leader Lease (requires a SHARED store — pass "
+                         "--store-url so replicas see the same Lease)")
+    ap.add_argument("--store-url", default="",
+                    help="remote API server URL; the operator runs against "
+                         "it over REST instead of an in-memory store")
     ap.add_argument("--journal", default="",
                     help="journal file for durable standalone state "
                          "(CRs survive operator restarts)")
@@ -229,9 +291,20 @@ def main(argv=None):
     cfg.reconcileConcurrency = args.reconcile_concurrency
     features.parse_and_set(args.feature_gates)
 
-    store = ObjectStore(journal_path=args.journal) if args.journal else None
+    if args.store_url:
+        from kuberay_tpu.controlplane.rest_store import RestObjectStore
+        store = RestObjectStore(args.store_url)
+    elif args.journal:
+        store = ObjectStore(journal_path=args.journal)
+    else:
+        store = None
+    if args.leader_election and not args.store_url and not args.journal:
+        print("warning: --leader-election without --store-url elects "
+              "against a private store (every replica wins); pass "
+              "--store-url for real multi-replica mode", flush=True)
     op = Operator(cfg, store=store, fake_kubelet=args.fake_kubelet)
-    url = op.start(api_port=args.api_port, api_host=args.api_host)
+    url = op.start(api_port=args.api_port, api_host=args.api_host,
+                   leader_election=args.leader_election)
     print(f"kuberay-tpu operator running; API at {url}", flush=True)
     try:
         while True:

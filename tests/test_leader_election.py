@@ -1,0 +1,93 @@
+"""Leader election: exactly one active operator; takeover on leader loss."""
+
+import time
+
+import pytest
+
+from kuberay_tpu.api.config import OperatorConfiguration
+from kuberay_tpu.controlplane.leader import LEASE_NAME, LeaderElector
+from kuberay_tpu.controlplane.store import ObjectStore
+from kuberay_tpu.operator import Operator
+from kuberay_tpu.runtime.coordinator_client import FakeCoordinatorClient
+from kuberay_tpu.utils import constants as C
+from tests.test_api_types import make_cluster
+
+
+def wait_for(fn, timeout=15.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_single_winner_and_takeover():
+    store = ObjectStore()
+    a = LeaderElector(store, identity="a", lease_duration=0.6,
+                      renew_interval=0.1)
+    b = LeaderElector(store, identity="b", lease_duration=0.6,
+                      renew_interval=0.1)
+    a.start()
+    assert wait_for(lambda: a.is_leader)
+    b.start()
+    time.sleep(0.5)
+    assert a.is_leader and not b.is_leader   # exactly one leader
+    lease = store.get("Lease", LEASE_NAME)
+    assert lease["spec"]["holderIdentity"] == "a"
+    # Leader dies WITHOUT graceful release -> b takes over after expiry.
+    a.stop(release=False)
+    assert wait_for(lambda: b.is_leader, timeout=5.0)
+    assert store.get("Lease", LEASE_NAME)["spec"]["holderIdentity"] == "b"
+    b.stop()
+
+
+def test_graceful_release_hands_over_fast():
+    store = ObjectStore()
+    a = LeaderElector(store, identity="a", lease_duration=30.0,
+                      renew_interval=0.1)
+    b = LeaderElector(store, identity="b", lease_duration=30.0,
+                      renew_interval=0.1)
+    a.start()
+    assert wait_for(lambda: a.is_leader)
+    b.start()
+    a.stop(release=True)        # graceful: zeroes renewTime
+    # Takeover well before the 30s lease would expire.
+    assert wait_for(lambda: b.is_leader, timeout=5.0)
+    b.stop()
+
+
+def test_two_operators_one_reconciles():
+    """Two full operators share a store with leader election: only the
+    leader provisions; on leader stop the standby takes over a new CR."""
+    store = ObjectStore()
+    coord = FakeCoordinatorClient()
+
+    def mk():
+        op = Operator(OperatorConfiguration(reconcileConcurrency=1),
+                      store=store, client_provider=lambda s: coord,
+                      fake_kubelet=True)
+        # Fast election for the test.
+        return op
+
+    op1, op2 = mk(), mk()
+    op1.start(api_port=0, leader_election=True)
+    op1.elector.lease_duration = 1.0
+    op1.elector.renew_interval = 0.1
+    assert wait_for(lambda: op1.elector.is_leader)
+    op2.start(api_port=0, leader_election=True)
+    op2.elector.lease_duration = 1.0
+    op2.elector.renew_interval = 0.1
+    time.sleep(0.3)
+    assert not op2.elector.is_leader
+
+    store.create(make_cluster(name="led").to_dict())
+    assert wait_for(lambda: store.get(C.KIND_CLUSTER, "led").get(
+        "status", {}).get("state") == "ready")
+
+    op1.stop()                   # leader leaves; standby must take over
+    assert wait_for(lambda: op2.elector.is_leader, timeout=10.0)
+    store.create(make_cluster(name="led2").to_dict())
+    assert wait_for(lambda: store.get(C.KIND_CLUSTER, "led2").get(
+        "status", {}).get("state") == "ready", timeout=20.0)
+    op2.stop()
