@@ -7,7 +7,7 @@ config ⊕ feature gates.  Env-var escape hatches are read at use sites.
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 from kuberay_tpu.api.common import Serializable
 

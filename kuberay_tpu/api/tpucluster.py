@@ -17,7 +17,7 @@ TPU-first re-design of the reference's RayClusterSpec
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, List, Optional
+from typing import Dict, List, Optional
 
 from kuberay_tpu.api.common import (
     Condition,

@@ -20,7 +20,7 @@ import json
 import re
 import threading
 from http.server import ThreadingHTTPServer
-from typing import Any, Dict, Optional, Tuple
+from typing import Dict, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
 from kuberay_tpu.controlplane.store import (
@@ -35,17 +35,8 @@ from kuberay_tpu.utils.httpjson import JsonHandler
 from kuberay_tpu.controlplane.webhooks import validate_admission
 from kuberay_tpu.utils.validation import kind_validators
 
-PLURALS = {
-    "tpuclusters": C.KIND_CLUSTER,
-    "tpujobs": C.KIND_JOB,
-    "tpuservices": C.KIND_SERVICE,
-    "tpucronjobs": C.KIND_CRONJOB,
-    "warmslicepools": "WarmSlicePool",
-    "trafficroutes": "TrafficRoute",
-}
-CORE_PLURALS = {"pods": "Pod", "services": "Service", "events": "Event",
-                "podgroups": "PodGroup", "networkpolicies": "NetworkPolicy",
-                "jobs": "Job", "secrets": "Secret", "ingresses": "Ingress"}
+PLURALS = {v: k for k, v in C.CRD_PLURALS.items()}
+CORE_PLURALS = {v: k for k, v in C.CORE_PLURALS.items()}
 
 # Kinds with admission validation (the single surface lives in
 # controlplane/webhooks.validate_admission; this is membership only).

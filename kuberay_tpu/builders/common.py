@@ -8,14 +8,32 @@ from kuberay_tpu.api.tpucluster import TpuCluster
 from kuberay_tpu.utils import constants as C
 
 
-def cluster_owner_reference(cluster: TpuCluster) -> Dict[str, Any]:
-    """Controller ownerReference pointing at the TpuCluster (drives
-    cascading GC of pods/services on cluster deletion)."""
+def owner_reference(kind: str, name: str, uid: str) -> Dict[str, Any]:
+    """Controller ownerReference (drives cascading GC on owner deletion)."""
     return {
         "apiVersion": C.API_VERSION,
-        "kind": C.KIND_CLUSTER,
-        "name": cluster.metadata.name,
-        "uid": cluster.metadata.uid,
+        "kind": kind,
+        "name": name,
+        "uid": uid,
         "controller": True,
         "blockOwnerDeletion": True,
     }
+
+
+def cluster_owner_reference(cluster: TpuCluster) -> Dict[str, Any]:
+    return owner_reference(C.KIND_CLUSTER, cluster.metadata.name,
+                           cluster.metadata.uid)
+
+
+def attach_cluster_auth(client, store, cluster) -> None:
+    """Decorate a coordinator client with the cluster's auth token (the
+    operator authenticates with the same secret the pods consume)."""
+    if not getattr(cluster.spec, "enableTokenAuth", False):
+        return
+    if not hasattr(client, "auth_token"):
+        return
+    from kuberay_tpu.builders.auth import read_auth_token
+    token = read_auth_token(store, cluster.metadata.name,
+                            cluster.metadata.namespace)
+    if token:
+        client.auth_token = token

@@ -14,6 +14,7 @@ from typing import Any, Dict
 
 from kuberay_tpu.api.tpucluster import TpuCluster
 from kuberay_tpu.api.tpujob import TpuJob
+from kuberay_tpu.builders.common import owner_reference
 from kuberay_tpu.builders.pod import coordinator_address
 from kuberay_tpu.utils import constants as C
 from kuberay_tpu.utils.names import submitter_job_name
@@ -67,14 +68,8 @@ def build_submitter_job(job: TpuJob, cluster: TpuCluster) -> Dict[str, Any]:
                 C.LABEL_ORIGINATED_FROM_CR_NAME: job.metadata.name,
                 C.LABEL_ORIGINATED_FROM_CRD: C.KIND_JOB,
             },
-            "ownerReferences": [{
-                "apiVersion": C.API_VERSION,
-                "kind": C.KIND_JOB,
-                "name": job.metadata.name,
-                "uid": job.metadata.uid,
-                "controller": True,
-                "blockOwnerDeletion": True,
-            }],
+            "ownerReferences": [owner_reference(
+                C.KIND_JOB, job.metadata.name, job.metadata.uid)],
         },
         "spec": {
             "backoffLimit": job.spec.submitterConfig.backoffLimit,

@@ -18,7 +18,6 @@ from typing import Any, Dict, List, Optional
 
 from kuberay_tpu.builders.common import cluster_owner_reference
 from kuberay_tpu.api.tpucluster import TpuCluster, WorkerGroupSpec
-from kuberay_tpu.topology import SliceTopology
 from kuberay_tpu.utils import constants as C
 from kuberay_tpu.utils.names import (
     head_pod_name,

@@ -8,15 +8,9 @@ import urllib.error
 import urllib.request
 from typing import Any, Dict, List, Optional
 
-PLURAL = {
-    "TpuCluster": "tpuclusters",
-    "TpuJob": "tpujobs",
-    "TpuService": "tpuservices",
-    "TpuCronJob": "tpucronjobs",
-    "Pod": "pods",
-    "Service": "services",
-    "Event": "events",
-}
+from kuberay_tpu.utils import constants as C
+
+PLURAL = {**C.CRD_PLURALS, **C.CORE_PLURALS}
 
 
 class ApiError(Exception):
@@ -33,7 +27,7 @@ class ApiClient:
 
     def _path(self, kind: str, ns: str, name: str = "") -> str:
         plural = PLURAL[kind]
-        if kind in ("Pod", "Service", "Event"):
+        if kind in C.CORE_PLURALS:
             base = f"/api/v1/namespaces/{ns}/{plural}"
         else:
             base = f"/apis/tpu.dev/v1/namespaces/{ns}/{plural}"

@@ -19,10 +19,10 @@ from __future__ import annotations
 
 import dataclasses
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 from kuberay_tpu.api.tpucluster import TpuCluster
-from kuberay_tpu.controlplane.store import Conflict, NotFound, ObjectStore
+from kuberay_tpu.controlplane.store import Conflict, ObjectStore
 from kuberay_tpu.utils import constants as C
 
 

@@ -24,7 +24,7 @@ from __future__ import annotations
 import os
 import random
 import time
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional
 
 from kuberay_tpu.api.common import Condition, set_condition
 from kuberay_tpu.api.tpucluster import (
@@ -38,14 +38,13 @@ from kuberay_tpu.builders.pod import build_head_pod, build_slice_pods
 from kuberay_tpu.builders.service import (
     build_head_service,
     build_headless_service,
-    build_serve_service,
     needs_headless_service,
 )
 from kuberay_tpu.controlplane.events import EventRecorder
 from kuberay_tpu.controlplane.expectations import HEAD_GROUP, ScaleExpectations
 from kuberay_tpu.controlplane.store import AlreadyExists, NotFound, ObjectStore
 from kuberay_tpu.utils import constants as C
-from kuberay_tpu.utils.names import head_pod_name, head_service_name, spec_hash
+from kuberay_tpu.utils.names import head_service_name, spec_hash
 from kuberay_tpu.utils.validation import validate_cluster
 
 POD_SPEC_HASH_ANNOTATION = "tpu.dev/pod-template-hash"

@@ -12,6 +12,7 @@ from typing import List, Optional
 
 from kuberay_tpu.api.tpucronjob import ConcurrencyPolicy, TpuCronJob
 from kuberay_tpu.api.tpujob import JobDeploymentStatus
+from kuberay_tpu.builders.common import owner_reference
 from kuberay_tpu.controlplane.events import EventRecorder
 from kuberay_tpu.controlplane.store import AlreadyExists, NotFound, ObjectStore
 from kuberay_tpu.utils import constants as C
@@ -118,11 +119,8 @@ class TpuCronJobController:
                     C.LABEL_ORIGINATED_FROM_CR_NAME: cron.metadata.name,
                     C.LABEL_ORIGINATED_FROM_CRD: C.KIND_CRONJOB,
                 },
-                "ownerReferences": [{
-                    "apiVersion": C.API_VERSION, "kind": C.KIND_CRONJOB,
-                    "name": cron.metadata.name, "uid": cron.metadata.uid,
-                    "controller": True, "blockOwnerDeletion": True,
-                }],
+                "ownerReferences": [owner_reference(
+                    C.KIND_CRONJOB, cron.metadata.name, cron.metadata.uid)],
             },
             "spec": cron.spec.jobTemplate.to_dict(),
             "status": {},

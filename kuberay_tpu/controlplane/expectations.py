@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Dict, Set, Tuple
+from typing import Dict, Tuple
 
 EXPECTATIONS_TIMEOUT_SECONDS = 30.0
 

@@ -19,7 +19,7 @@ coordinator — ref createK8sJobIfNeed :560 / checkSubmitterAndUpdateStatus
 from __future__ import annotations
 
 import time
-from typing import Any, Callable, Dict, Optional
+from typing import Callable, Optional
 
 from kuberay_tpu.api.tpucluster import ClusterState, TpuCluster
 from kuberay_tpu.api.tpujob import (
@@ -30,6 +30,7 @@ from kuberay_tpu.api.tpujob import (
     JobSubmissionMode,
     TpuJob,
 )
+from kuberay_tpu.builders.common import attach_cluster_auth, owner_reference
 from kuberay_tpu.builders.job import build_submitter_job
 from kuberay_tpu.controlplane.events import EventRecorder
 from kuberay_tpu.controlplane.store import AlreadyExists, NotFound, ObjectStore
@@ -347,11 +348,8 @@ class TpuJobController:
                     C.LABEL_ORIGINATED_FROM_CR_NAME: job.metadata.name,
                     C.LABEL_ORIGINATED_FROM_CRD: C.KIND_JOB,
                 },
-                "ownerReferences": [{
-                    "apiVersion": C.API_VERSION, "kind": C.KIND_JOB,
-                    "name": job.metadata.name, "uid": job.metadata.uid,
-                    "controller": True, "blockOwnerDeletion": True,
-                }],
+                "ownerReferences": [owner_reference(
+                    C.KIND_JOB, job.metadata.name, job.metadata.uid)],
             },
             "spec": spec,
             "status": {},
@@ -385,12 +383,7 @@ class TpuJobController:
         if self.client_provider is None or cluster is None:
             return None
         client = self.client_provider(cluster.status.to_dict())
-        if cluster.spec.enableTokenAuth and hasattr(client, "auth_token"):
-            from kuberay_tpu.builders.auth import read_auth_token
-            token = read_auth_token(self.store, cluster.metadata.name,
-                                    cluster.metadata.namespace)
-            if token:
-                client.auth_token = token
+        attach_cluster_auth(client, self.store, cluster)
         return client
 
     def _teardown(self, job: TpuJob):

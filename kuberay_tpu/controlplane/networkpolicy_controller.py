@@ -12,9 +12,10 @@ from __future__ import annotations
 from typing import Any, Dict, List, Optional
 
 from kuberay_tpu.api.tpucluster import TpuCluster
-from kuberay_tpu.controlplane.store import AlreadyExists, NotFound, ObjectStore
+from kuberay_tpu.controlplane.store import NotFound, ObjectStore
 from kuberay_tpu.utils import constants as C
 from kuberay_tpu.utils import features
+from kuberay_tpu.builders.common import owner_reference
 from kuberay_tpu.utils.names import truncate_name
 
 
@@ -44,11 +45,8 @@ def build_network_policies(cluster: TpuCluster) -> List[Dict[str, Any]]:
             "name": truncate_name(f"{name}-head"),
             "namespace": ns,
             "labels": {C.LABEL_CLUSTER: name},
-            "ownerReferences": [{
-                "apiVersion": C.API_VERSION, "kind": C.KIND_CLUSTER,
-                "name": name, "uid": cluster.metadata.uid,
-                "controller": True, "blockOwnerDeletion": True,
-            }],
+            "ownerReferences": [owner_reference(
+                C.KIND_CLUSTER, name, cluster.metadata.uid)],
         },
         "spec": {
             "podSelector": {"matchLabels": {

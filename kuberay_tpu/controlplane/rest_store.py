@@ -17,11 +17,11 @@ from __future__ import annotations
 
 import json
 import threading
-import time
 import urllib.error
 import urllib.request
 from typing import Any, Callable, Dict, List, Optional
 
+from kuberay_tpu.utils import constants as C
 from kuberay_tpu.controlplane.store import (
     AlreadyExists,
     Conflict,
@@ -31,16 +31,8 @@ from kuberay_tpu.controlplane.store import (
     StoreError,
 )
 
-_CRD_PLURALS = {
-    "TpuCluster": "tpuclusters", "TpuJob": "tpujobs",
-    "TpuService": "tpuservices", "TpuCronJob": "tpucronjobs",
-    "WarmSlicePool": "warmslicepools", "TrafficRoute": "trafficroutes",
-}
-_CORE_PLURALS = {
-    "Pod": "pods", "Service": "services", "Event": "events",
-    "PodGroup": "podgroups", "NetworkPolicy": "networkpolicies",
-    "Job": "jobs", "Secret": "secrets", "Ingress": "ingresses",
-}
+_CRD_PLURALS = C.CRD_PLURALS
+_CORE_PLURALS = C.CORE_PLURALS
 # Kinds the polling watch tracks (what the manager/expectations need).
 WATCHED_KINDS = ("TpuCluster", "TpuJob", "TpuService", "TpuCronJob",
                  "WarmSlicePool", "Pod", "Service", "Job")

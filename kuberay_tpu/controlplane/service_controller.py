@@ -18,7 +18,7 @@ whole-slice quanta.
 from __future__ import annotations
 
 import time
-from typing import Any, Callable, Dict, Optional
+from typing import Callable, Dict, Optional
 
 from kuberay_tpu.api.common import Condition, set_condition
 from kuberay_tpu.api.tpucluster import ClusterState, TpuCluster
@@ -29,6 +29,7 @@ from kuberay_tpu.api.tpuservice import (
     ServiceUpgradeType,
     TpuService,
 )
+from kuberay_tpu.builders.common import attach_cluster_auth, owner_reference
 from kuberay_tpu.builders.service import build_serve_service
 from kuberay_tpu.controlplane.events import EventRecorder
 from kuberay_tpu.controlplane.store import AlreadyExists, NotFound, ObjectStore
@@ -180,11 +181,8 @@ class TpuServiceController:
                     C.LABEL_ORIGINATED_FROM_CR_NAME: svc.metadata.name,
                     C.LABEL_ORIGINATED_FROM_CRD: C.KIND_SERVICE,
                 },
-                "ownerReferences": [{
-                    "apiVersion": C.API_VERSION, "kind": C.KIND_SERVICE,
-                    "name": svc.metadata.name, "uid": svc.metadata.uid,
-                    "controller": True, "blockOwnerDeletion": True,
-                }],
+                "ownerReferences": [owner_reference(
+                    C.KIND_SERVICE, svc.metadata.name, svc.metadata.uid)],
             },
             "spec": spec,
             "status": {},
@@ -291,12 +289,7 @@ class TpuServiceController:
             return None
         client = self.client_provider(cluster.metadata.name,
                                       cluster.status.to_dict())
-        if cluster.spec.enableTokenAuth and hasattr(client, "auth_token"):
-            from kuberay_tpu.builders.auth import read_auth_token
-            token = read_auth_token(self.store, cluster.metadata.name,
-                                    cluster.metadata.namespace)
-            if token:
-                client.auth_token = token
+        attach_cluster_auth(client, self.store, cluster)
         return client
 
     def _reconcile_serve_config(self, svc: TpuService):
@@ -447,11 +440,8 @@ class TpuServiceController:
         desired = build_serve_service(cluster, service_name=stable_name)
         # The stable service is owned by the TpuService, not the cluster —
         # it must outlive cluster replacement.
-        desired["metadata"]["ownerReferences"] = [{
-            "apiVersion": C.API_VERSION, "kind": C.KIND_SERVICE,
-            "name": svc.metadata.name, "uid": svc.metadata.uid,
-            "controller": True, "blockOwnerDeletion": True,
-        }]
+        desired["metadata"]["ownerReferences"] = [owner_reference(
+            C.KIND_SERVICE, svc.metadata.name, svc.metadata.uid)]
         self.store.ensure(desired,
                           compare=lambda o: o.get("spec", {}).get("selector"))
         # Head serve-label: heads receive serve traffic unless excluded

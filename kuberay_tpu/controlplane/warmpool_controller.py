@@ -23,6 +23,7 @@ from typing import Any, Dict, List, Optional
 
 from kuberay_tpu.api.common import ObjectMeta, PodTemplateSpec, Serializable
 from kuberay_tpu.api.tpucluster import TpuCluster, TpuClusterSpec, WorkerGroupSpec
+from kuberay_tpu.builders.common import owner_reference
 from kuberay_tpu.builders.pod import build_slice_pods
 from kuberay_tpu.controlplane.events import EventRecorder
 from kuberay_tpu.controlplane.store import AlreadyExists, NotFound, ObjectStore
@@ -137,11 +138,8 @@ class WarmSlicePoolController:
                     pod["metadata"]["labels"][LABEL_WARM_POOL] = name
                     # Warm pods belong to the pool object, not a cluster.
                     pod["metadata"]["labels"].pop(C.LABEL_CLUSTER, None)
-                    pod["metadata"]["ownerReferences"] = [{
-                        "apiVersion": C.API_VERSION, "kind": self.KIND,
-                        "name": name, "uid": obj["metadata"].get("uid", ""),
-                        "controller": True, "blockOwnerDeletion": True,
-                    }]
+                    pod["metadata"]["ownerReferences"] = [owner_reference(
+                        self.KIND, name, obj["metadata"].get("uid", ""))]
                     try:
                         self.store.create(pod)
                     except AlreadyExists:

@@ -16,7 +16,6 @@ from __future__ import annotations
 
 import json
 import os
-import threading
 import time
 from http.server import ThreadingHTTPServer
 from typing import Any, Dict, List, Optional

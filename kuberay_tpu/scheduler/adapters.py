@@ -20,7 +20,7 @@ import json
 from typing import Any, Dict
 
 from kuberay_tpu.api.tpucluster import TpuCluster
-from kuberay_tpu.controlplane.store import AlreadyExists, NotFound, ObjectStore
+from kuberay_tpu.controlplane.store import NotFound, ObjectStore
 from kuberay_tpu.scheduler.interface import total_cluster_demand
 from kuberay_tpu.utils import constants as C
 

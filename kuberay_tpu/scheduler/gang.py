@@ -13,7 +13,8 @@ from __future__ import annotations
 
 from typing import Any, Callable, Dict, Optional
 
-from kuberay_tpu.controlplane.store import AlreadyExists, NotFound, ObjectStore
+from kuberay_tpu.controlplane.store import NotFound, ObjectStore
+from kuberay_tpu.builders.common import owner_reference
 from kuberay_tpu.scheduler.interface import total_cluster_demand
 from kuberay_tpu.utils import constants as C
 
@@ -44,13 +45,10 @@ class GangScheduler:
             "metadata": {
                 "name": name, "namespace": ns,
                 "labels": ({LABEL_QUEUE: queue} if queue else {}),
-                "ownerReferences": [{
-                    "apiVersion": C.API_VERSION,
-                    "kind": cluster.get("kind", C.KIND_CLUSTER),
-                    "name": cluster["metadata"]["name"],
-                    "uid": cluster["metadata"].get("uid", ""),
-                    "controller": True, "blockOwnerDeletion": True,
-                }],
+                "ownerReferences": [owner_reference(
+                    cluster.get("kind", C.KIND_CLUSTER),
+                    cluster["metadata"]["name"],
+                    cluster["metadata"].get("uid", ""))],
             },
             "spec": {
                 "minMember": demand["minMember"],

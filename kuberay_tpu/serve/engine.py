@@ -18,9 +18,8 @@ only; every FLOP is inside jit.
 from __future__ import annotations
 
 import dataclasses
-import itertools
 import time
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp

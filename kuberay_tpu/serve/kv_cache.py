@@ -14,7 +14,6 @@ from typing import Any, Dict, Tuple
 import jax
 import jax.numpy as jnp
 
-from kuberay_tpu.models.llama import LlamaConfig
 from kuberay_tpu.ops.rmsnorm import rmsnorm
 from kuberay_tpu.ops.rope import apply_rope, rope_frequencies
 

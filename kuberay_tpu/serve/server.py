@@ -17,9 +17,7 @@ health polling sees RUNNING (runtime/coordinator_server.py PUT
 
 from __future__ import annotations
 
-import json
 import threading
-import time
 import uuid
 from http.server import ThreadingHTTPServer
 from typing import Any, Dict, Optional

@@ -11,7 +11,6 @@ toolchain is available the NumPy fallback provides identical batches
 from __future__ import annotations
 
 import ctypes
-import os
 import pathlib
 import subprocess
 import threading

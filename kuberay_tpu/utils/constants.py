@@ -104,3 +104,19 @@ ENV_ENABLE_RANDOM_POD_DELETE = "ENABLE_RANDOM_POD_DELETE"
 ENV_DEFAULT_REQUEUE_SECONDS = "TPUCLUSTER_DEFAULT_REQUEUE_SECONDS"
 DEFAULT_REQUEUE_SECONDS = 300
 DEFAULT_RECONCILE_REQUEUE_SECONDS = 2.0
+
+
+# --- REST plurals (shared by apiserver, REST store, clients) -----------------
+CRD_PLURALS = {
+    KIND_CLUSTER: "tpuclusters",
+    KIND_JOB: "tpujobs",
+    KIND_SERVICE: "tpuservices",
+    KIND_CRONJOB: "tpucronjobs",
+    "WarmSlicePool": "warmslicepools",
+    "TrafficRoute": "trafficroutes",
+}
+CORE_PLURALS = {
+    "Pod": "pods", "Service": "services", "Event": "events",
+    "PodGroup": "podgroups", "NetworkPolicy": "networkpolicies",
+    "Job": "jobs", "Secret": "secrets", "Ingress": "ingresses",
+}
