@@ -46,7 +46,7 @@ class Request:
 class Response:
     request_id: str
     tokens: List[int]                 # generated tokens (no prompt)
-    finish_reason: str = "length"     # length | eos | cancelled
+    finish_reason: str = "length"     # length|eos|cancelled|preempted
     prompt_len: int = 0
     created: float = 0.0
 
@@ -67,7 +67,7 @@ class ServeEngine:
         self.params = params
         self.max_slots = max_slots
         self.max_len = max_len
-        self.cache = init_kv_cache(cfg, max_slots, max_len)
+        self.cache = self._init_cache()
         # Model dispatch: Llama-family vs Mixtral MoE share the cache
         # plumbing but differ in the FFN.
         from kuberay_tpu.models.mixtral import MixtralConfig
@@ -89,6 +89,9 @@ class ServeEngine:
                                 static_argnames=("prompt_len",),
                                 donate_argnames=("cache",))
         self._decode = jax.jit(self._decode_impl, donate_argnames=("cache",))
+
+    def _init_cache(self):
+        return init_kv_cache(self.cfg, self.max_slots, self.max_len)
 
     # ------------------------------------------------------------------
     # jitted kernels
@@ -137,11 +140,14 @@ class ServeEngine:
 
     def add_request(self, req: Request) -> None:
         if len(req.prompt_tokens) >= self.max_len or req.max_new_tokens <= 0:
-            self._finished.append(Response(
-                req.request_id, [], "cancelled",
-                prompt_len=len(req.prompt_tokens), created=time.time()))
+            self._cancel(req)
             return
         self.queue.append(req)
+
+    def _cancel(self, req: Request) -> None:
+        self._finished.append(Response(
+            req.request_id, [], "cancelled",
+            prompt_len=len(req.prompt_tokens), created=time.time()))
 
     @property
     def num_active(self) -> int:
@@ -163,7 +169,8 @@ class ServeEngine:
             if free is None:
                 break
             req = self.queue.pop(0)
-            self._admit(req, free)
+            if not self._admit(req, free):
+                break               # admission blocked (e.g. paged memory)
 
         if self.num_active:
             self._decode_all()
@@ -194,7 +201,11 @@ class ServeEngine:
             jnp.int32(slot), jnp.int32(plen), sub,
             jnp.float32(req.temperature), prompt_len=bucket)
         # Cache now contains bucket tokens for the slot; only plen are real.
-        self.lens[slot] = plen
+        self._finalize_admit(req, slot, tok)
+        return True
+
+    def _finalize_admit(self, req: Request, slot: int, tok) -> None:
+        self.lens[slot] = len(req.prompt_tokens)
         self.active[slot] = req
         self.generated[slot] = [int(tok)]
         self.budget[slot] = req.max_new_tokens - 1
@@ -210,11 +221,7 @@ class ServeEngine:
                 temps[i] = req.temperature
                 mask[i] = 1.0
         self.key, sub = jax.random.split(self.key)
-        toks, self.cache = self._decode(
-            self.params, self.cache, jnp.asarray(last),
-            jnp.asarray(self.lens), sub, jnp.asarray(temps),
-            jnp.asarray(mask))
-        toks = np.asarray(toks)
+        toks = np.asarray(self._decode_call(last, temps, mask, sub))
         for i, req in enumerate(self.active):
             if req is None:
                 continue
@@ -222,6 +229,14 @@ class ServeEngine:
             self.generated[i].append(int(toks[i]))
             self.budget[i] -= 1
             self._maybe_finish(i)
+
+    def _decode_call(self, last, temps, mask, sub):
+        """The device decode step; paged subclass passes block tables."""
+        toks, self.cache = self._decode(
+            self.params, self.cache, jnp.asarray(last),
+            jnp.asarray(self.lens), sub, jnp.asarray(temps),
+            jnp.asarray(mask))
+        return toks
 
     def _maybe_finish(self, slot: int):
         req = self.active[slot]
@@ -236,9 +251,16 @@ class ServeEngine:
         elif self.lens[slot] + 1 >= self.max_len:
             reason = "length"
         if reason:
-            self._finished.append(Response(
-                req.request_id, list(gen), reason,
-                prompt_len=len(req.prompt_tokens), created=time.time()))
-            self.active[slot] = None
-            self.generated[slot] = []
-            self.lens[slot] = 0
+            self._finish(slot, reason)
+
+    def _finish(self, slot: int, reason: str) -> None:
+        """The single finish path (normal, eos, or preemption) — all
+        slot-teardown bookkeeping lives here; the paged engine hooks it
+        to release blocks."""
+        req = self.active[slot]
+        self._finished.append(Response(
+            req.request_id, list(self.generated[slot]), reason,
+            prompt_len=len(req.prompt_tokens), created=time.time()))
+        self.active[slot] = None
+        self.generated[slot] = []
+        self.lens[slot] = 0

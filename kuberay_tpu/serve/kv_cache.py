@@ -55,7 +55,8 @@ def _cached_attention(q, ck, cv, lens, q_positions):
 
 
 def forward_with_cache_mixtral(cfg, params, tokens, cache, start,
-                               write_mask=None, token_mask=None):
+                               write_mask=None, token_mask=None,
+                               kv_update=None):
     """Mixtral against the cache: the shared layer plumbing with the MoE
     FFN swapped in.  Router aux losses are irrelevant at inference.  The
     token mask keeps padding/inactive slots out of expert routing."""
@@ -76,7 +77,8 @@ def forward_with_cache_mixtral(cfg, params, tokens, cache, start,
         return out
 
     return forward_with_cache(cfg, params, tokens, cache, start,
-                              write_mask, token_mask=token_mask, ffn=ffn)
+                              write_mask, token_mask=token_mask, ffn=ffn,
+                              kv_update=kv_update)
 
 
 def _insert_kv(ck, cv, kk, vv, positions, start, write_mask, T):
@@ -106,7 +108,8 @@ def forward_with_cache(cfg, params: Dict[str, Any],
                        start: jax.Array,
                        write_mask: jax.Array = None,
                        token_mask: jax.Array = None,
-                       ffn=None) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+                       ffn=None, kv_update=None
+                       ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """Run T new tokens through the model against the cache.
 
     tokens: [B, T] (right-padded; positions beyond a slot's real length are
@@ -115,7 +118,12 @@ def forward_with_cache(cfg, params: Dict[str, Any],
     may be written (prefill targets ONE slot — without the mask every row
     would scatter into positions start..start+T and corrupt its neighbors);
     token_mask: [B, T] real-token mask consumed by routing FFNs; ``ffn``
-    customizes the feed-forward block (dense default, MoE for Mixtral).
+    customizes the feed-forward block (dense default, MoE for Mixtral);
+    ``kv_update(ck, cv, kk, vv) -> (new_ck, new_cv, ck_view, cv_view)``
+    customizes the cache layout — the default inserts into the per-slot
+    contiguous cache, the paged path (serve/paged_kv.py) scatters into a
+    block pool and gathers per-request views.  Everything else (the
+    transformer layer body) is layout-agnostic and lives only here.
     Returns (logits [B, T, V], new cache).
     """
     B, T = tokens.shape
@@ -127,6 +135,14 @@ def forward_with_cache(cfg, params: Dict[str, Any],
         write_mask = jnp.ones((B,), jnp.float32)
     if ffn is None:
         ffn = _dense_ffn
+    if kv_update is None:
+        # Default layout: insert new K/V at each slot's offset; masked
+        # rows write nothing (dynamic-slice decode fast path, one-hot
+        # prefill scatter).  The attention view IS the cache row.
+        def kv_update(ck, cv, kk, vv):
+            nk, nv = _insert_kv(ck, cv, kk, vv, positions, start,
+                                write_mask, T)
+            return nk, nv, nk, nv
 
     def layer_fn(x, layer_in):
         lp, ck, cv = layer_in
@@ -136,10 +152,8 @@ def forward_with_cache(cfg, params: Dict[str, Any],
         vv = (h @ lp["wv"]).reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
         q = apply_rope(q, cos, sin, positions)
         kk = apply_rope(kk, cos, sin, positions)
-        # Insert new K/V at each slot's offset; masked rows write nothing
-        # (dynamic-slice decode fast path, one-hot prefill scatter).
-        ck, cv = _insert_kv(ck, cv, kk, vv, positions, start, write_mask, T)
-        attn = _cached_attention(q, ck, cv, lens, positions)
+        ck, cv, ck_view, cv_view = kv_update(ck, cv, kk, vv)
+        attn = _cached_attention(q, ck_view, cv_view, lens, positions)
         x = x + (attn.reshape(B, T, -1) @ lp["wo"]).astype(x.dtype)
         h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
         x = x + ffn(cfg, h, lp, token_mask).astype(x.dtype)

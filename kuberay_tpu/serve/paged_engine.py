@@ -1,0 +1,237 @@
+"""Continuous batching over a paged KV cache with prefix reuse.
+
+Extends ServeEngine with vLLM-style memory management (see
+serve/paged_kv.py): a shared physical block pool replaces the per-slot
+``max_len`` cache rows, so HBM is sized by LIVE tokens instead of
+``slots * max_len``, and block-aligned prompt prefixes are shared across
+requests (system prompts, few-shot preambles prefill once).
+
+Supports the same model families as the dense engine (Llama and
+Mixtral — the MoE FFN is orthogonal to the cache layout since both run
+through forward_with_cache's kv_update strategy).
+
+Scheduling changes vs the dense engine:
+- admission additionally requires enough free blocks for the prompt plus
+  one decode block; otherwise the request waits in queue (paged engines
+  admit by memory, not just by slot);
+- each decode step that crosses a block boundary appends a block to the
+  slot's table; if the pool is exhausted mid-decode the engine finishes
+  the request with ``finish_reason="preempted"`` (the caller may resubmit
+  — with the prefix cache warm, its re-prefill is nearly free);
+- on finish, the request's blocks are refcount-released; full prompt
+  blocks stay published in the prefix cache until cannibalized.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kuberay_tpu.models.llama import LlamaConfig
+from kuberay_tpu.serve.engine import Request, ServeEngine, _bucket
+from kuberay_tpu.serve.paged_kv import (
+    BlockAllocator,
+    init_paged_cache,
+    make_paged_forward,
+)
+
+
+class PagedServeEngine(ServeEngine):
+    def __init__(self, cfg: LlamaConfig, params: Dict[str, Any],
+                 max_slots: int = 8, max_len: int = 2048,
+                 num_blocks: int = 0, block_size: int = 16,
+                 rng_seed: int = 0):
+        # Default pool = the dense engine's footprint; callers shrink it
+        # to realize the memory win (e.g. slots * expected_len).
+        num_blocks = num_blocks or (max_slots * max_len) // block_size
+        self.block_size = block_size
+        self.num_blocks = num_blocks
+        self.max_blocks = (max_len + block_size - 1) // block_size
+        from kuberay_tpu.models.mixtral import MixtralConfig
+        base = None
+        # Capacity-routed MoE prefill is NOT invariant to prefix reuse:
+        # running only the un-cached suffix changes which tokens contend
+        # for expert capacity, so a warm cache could alter outputs.  The
+        # paged pool/preemption still apply; only cross-request block
+        # sharing is disabled (dropless prefill would re-enable it at
+        # E x the FFN FLOPs — a round-2 kernel decision).
+        self._share_prefixes = not isinstance(cfg, MixtralConfig)
+        if isinstance(cfg, MixtralConfig):
+            from kuberay_tpu.serve.kv_cache import forward_with_cache_mixtral
+            base = forward_with_cache_mixtral
+        self._paged_fwd = make_paged_forward(block_size, base_forward=base)
+        # super().__init__ jits self._prefill_impl/_decode_impl, which
+        # resolve to the paged overrides below, and builds the cache via
+        # the _init_cache hook.
+        super().__init__(cfg, params, max_slots=max_slots, max_len=max_len,
+                         rng_seed=rng_seed)
+        self.allocator = BlockAllocator(num_blocks, block_size)
+        self.tables = np.zeros((max_slots, self.max_blocks), dtype=np.int32)
+        self.owned: List[List[int]] = [[] for _ in range(max_slots)]
+        self._wait_state = None        # (request id, num_free) at last block
+
+    def _init_cache(self):
+        return init_paged_cache(self.cfg, self.num_blocks, self.block_size)
+
+    # ------------------------------------------------------------------
+    # jitted kernels (paged signatures)
+    # ------------------------------------------------------------------
+
+    def _prefill_impl(self, params, cache, tokens, tables, slot, start,
+                      real_len, key, temperature, prompt_len):
+        """Prefill ``real_len`` NEW tokens of one request at cache offset
+        ``start`` (start > 0 when a prefix was served from cache)."""
+        B = self.max_slots
+        row = jnp.zeros((B, prompt_len), dtype=jnp.int32).at[slot].set(tokens)
+        starts = jnp.zeros((B,), jnp.int32).at[slot].set(start)
+        write_mask = jax.nn.one_hot(slot, B, dtype=jnp.float32)
+        token_mask = (write_mask[:, None] *
+                      (jnp.arange(prompt_len)[None, :] < real_len))
+        logits, new_cache = self._paged_fwd(
+            self.cfg, params, row, cache, tables, starts, write_mask,
+            token_mask=token_mask)
+        last = logits[slot, real_len - 1]
+        tok = self._sample(last, key, temperature)
+        return tok, new_cache
+
+    def _decode_impl(self, params, cache, tokens, tables, lens, key,
+                     temperatures, active_mask):
+        logits, new_cache = self._paged_fwd(
+            self.cfg, params, tokens[:, None], cache, tables, lens,
+            active_mask, token_mask=active_mask[:, None])
+        keys = jax.random.split(key, self.max_slots)
+        toks = jax.vmap(self._sample)(logits[:, 0], keys, temperatures)
+        return toks, new_cache
+
+    # ------------------------------------------------------------------
+    # block bookkeeping
+    # ------------------------------------------------------------------
+
+    def _blocks_needed(self, n_tokens: int) -> int:
+        return (n_tokens + self.block_size - 1) // self.block_size
+
+    def _grow(self, slot: int, n_blocks: int) -> bool:
+        """Append n fresh blocks to a slot's table; all-or-nothing."""
+        got: List[int] = []
+        for _ in range(n_blocks):
+            bid = self.allocator.allocate()
+            if bid is None:
+                for b in got:
+                    self.allocator.free(b)
+                return False
+            got.append(bid)
+        base = len(self.owned[slot])
+        self.owned[slot].extend(got)
+        self.tables[slot, base:base + len(got)] = got
+        return True
+
+    def _release(self, slot: int):
+        for bid in self.owned[slot]:
+            self.allocator.free(bid)
+        self.owned[slot] = []
+        self.tables[slot] = 0
+
+    # ------------------------------------------------------------------
+    # scheduling overrides
+    # ------------------------------------------------------------------
+
+    def _admit(self, req: Request, slot: int):
+        plen = len(req.prompt_tokens)
+        # A prompt the pool can NEVER hold (even with every block free)
+        # must be rejected, not retried — requeueing it would livelock
+        # the engine and head-of-line-block everything behind it.
+        if self._blocks_needed(plen + 1) > self.num_blocks:
+            self._cancel(req)
+            return True
+        # While blocked on memory, nothing changes until some block is
+        # freed — skip the O(plen) prefix re-match until num_free moves
+        # (retried every engine step otherwise).
+        if self._wait_state == (id(req), self.allocator.num_free):
+            self.queue.insert(0, req)
+            return False
+        # Prefix cache: longest block-aligned cached prefix — but at
+        # least one token must run through prefill to produce logits.
+        cached = self.allocator.match_prefix(req.prompt_tokens) \
+            if self._share_prefixes else []
+        while cached and len(cached) * self.block_size >= plen:
+            self.allocator.free(cached.pop())
+        ncached = len(cached) * self.block_size
+        new_tokens = plen - ncached
+        # Reserve capacity for the prompt AND the first decoded token
+        # (prefill samples it; the first decode step writes it at
+        # position plen) — actually allocating the headroom, instead of
+        # merely checking free counts, keeps concurrent admissions in
+        # one step() from consuming each other's spare and being
+        # preempted after a single token.
+        need = self._blocks_needed(plen + 1) - len(cached)
+        if self.allocator.num_free < need:
+            for b in cached:
+                self.allocator.free(b)
+            self._wait_state = (id(req), self.allocator.num_free)
+            self.queue.insert(0, req)       # wait for memory, keep order
+            return False
+        self._wait_state = None
+        self.owned[slot] = list(cached)
+        self.tables[slot, :len(cached)] = cached
+        ok = self._grow(slot, need)
+        assert ok, "free-count check guaranteed allocation"
+        self.allocator.count_prefix_stats(plen, len(cached))
+
+        bucket = _bucket(new_tokens, self.max_len)
+        padded = np.zeros(bucket, dtype=np.int32)
+        padded[:new_tokens] = req.prompt_tokens[ncached:]
+        self.key, sub = jax.random.split(self.key)
+        tok, self.cache = self._prefill(
+            self.params, self.cache, jnp.asarray(padded),
+            jnp.asarray(self.tables), jnp.int32(slot), jnp.int32(ncached),
+            jnp.int32(new_tokens), sub, jnp.float32(req.temperature),
+            prompt_len=bucket)
+        # Publish the prompt's full blocks for future requests.  Cached
+        # blocks re-register as no-ops; the bucket padding past
+        # ``plen`` was written to this slot's PRIVATE blocks only, and
+        # only positions < lens are ever read, so shared content is
+        # exactly the real tokens.
+        if self._share_prefixes:
+            self.allocator.register_prefix(
+                req.prompt_tokens[:plen - plen % self.block_size],
+                self.owned[slot])
+        self._finalize_admit(req, slot, tok)
+        return True
+
+    def _decode_call(self, last, temps, mask, sub):
+        toks, self.cache = self._decode(
+            self.params, self.cache, jnp.asarray(last),
+            jnp.asarray(self.tables), jnp.asarray(self.lens), sub,
+            jnp.asarray(temps), jnp.asarray(mask))
+        return toks
+
+    def _decode_all(self):
+        # Grow tables for slots whose next write crosses a block
+        # boundary; preempt (finish early) when the pool is exhausted.
+        for i, req in enumerate(self.active):
+            if req is None:
+                continue
+            if self.lens[i] >= len(self.owned[i]) * self.block_size:
+                if not self._grow(i, 1):
+                    self._finish(i, "preempted")
+        if self.num_active:
+            super()._decode_all()
+
+    def _finish(self, slot: int, reason: str) -> None:
+        super()._finish(slot, reason)
+        self._release(slot)
+
+    # ------------------------------------------------------------------
+
+    @property
+    def stats(self) -> Dict[str, Any]:
+        a = self.allocator
+        return {
+            "num_blocks": a.num_blocks,
+            "free_blocks": a.num_free,
+            "prefix_hit_tokens": a.prefix_hits,
+            "prefix_query_tokens": a.prefix_queries,
+        }

@@ -1,0 +1,236 @@
+"""Paged KV cache with prefix caching for the serve engine.
+
+The vLLM PagedAttention idea (block-table indirection + hash-based prefix
+reuse) rebuilt for TPU/XLA semantics rather than as a CUDA kernel port:
+
+- ONE flat static-shape physical pool per layer
+  (``[L, num_blocks*block_size, Hkv, D]``) so every step compiles once;
+  a request's logical cache is a row of physical block ids (its block
+  table), padded to a static ``max_blocks`` width.
+- Reads GATHER the request's live blocks into the same contiguous
+  ``[B, max, Hkv, D]`` view the non-paged path uses, so the attention
+  math (and the Pallas decode kernel in ops/decode_attention.py) is
+  shared verbatim.  Writes SCATTER into the flat pool with
+  ``mode="drop"`` — masked rows aim at an out-of-range index and write
+  nothing, the paged analogue of kv_cache.py's write_mask.
+- Prefix caching is block-aligned and read-only: a shared block is never
+  a write target (writes always start at the first private, non-cached
+  position), so no copy-on-write machinery is needed.
+- The allocator is host-side pure Python (refcounts, free list, LRU
+  reuse of refcount-0 cached blocks) — bookkeeping stays off-device,
+  every FLOP stays under jit, matching the engine's design.
+
+Capability analogue: the reference serves models via Ray Serve + vLLM
+(docs reference `ray-operator` RayService samples); the paged cache is
+what makes many concurrent long-prompt requests fit in HBM.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+# ---------------------------------------------------------------------------
+# Host-side block allocator + prefix cache
+# ---------------------------------------------------------------------------
+
+class BlockAllocator:
+    """Physical-block bookkeeping: refcounted allocation plus a
+    prefix-hash table enabling cross-request block sharing.
+
+    Blocks with refcount 0 that still hold a registered prefix stay in
+    the hash table and are reused LRU-last — a free block is only
+    scrubbed (hash entry dropped) when allocation demands it.
+    """
+
+    def __init__(self, num_blocks: int, block_size: int):
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self.refcount = [0] * num_blocks
+        # Free blocks split by cache status so allocate() is O(1): plain
+        # deque for uncached, insertion-ordered dict (= LRU) for
+        # refcount-0 blocks still holding a registered prefix.
+        self._free_uncached: collections.deque = collections.deque(
+            range(num_blocks))
+        self._free_cached: "collections.OrderedDict[int, None]" = \
+            collections.OrderedDict()
+        # prefix hash -> (block id, exact block tokens).  The tokens are
+        # compared on every match: a 64-bit chained-hash collision must
+        # degrade to a cache miss, never silently serve another prompt's
+        # KV content (the failure class vLLM's prefix cache verifies
+        # against).  block id -> hash is kept for eviction.
+        self._hash_to_block: Dict[int, tuple] = {}
+        self._block_to_hash: Dict[int, int] = {}
+        # LRU order among refcount-0 cached blocks (ids also in _free).
+        self.prefix_hits = 0          # tokens served from cache
+        self.prefix_queries = 0       # tokens eligible for caching
+
+    # -- hashing ----------------------------------------------------------
+
+    def _chain(self, parent: int, block_tokens: Sequence[int]) -> int:
+        return hash((parent, tuple(block_tokens)))
+
+    def block_hashes(self, tokens: Sequence[int]) -> List[int]:
+        """Hash chain over the FULL blocks of a token sequence."""
+        out, parent = [], 0
+        bs = self.block_size
+        for i in range(0, len(tokens) - len(tokens) % bs, bs):
+            parent = self._chain(parent, tokens[i:i + bs])
+            out.append(parent)
+        return out
+
+    # -- allocation -------------------------------------------------------
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free_uncached) + len(self._free_cached)
+
+    def allocate(self) -> Optional[int]:
+        """Pop a free block, preferring ones with no cached prefix;
+        cannibalizing a cached block evicts the LEAST-recently-freed one
+        and scrubs its hash entry.  O(1)."""
+        if self._free_uncached:
+            bid = self._free_uncached.popleft()
+        elif self._free_cached:
+            bid, _ = self._free_cached.popitem(last=False)   # LRU evict
+            h = self._block_to_hash.pop(bid)
+            self._hash_to_block.pop(h, None)
+        else:
+            return None
+        self.refcount[bid] = 1
+        return bid
+
+    def free(self, bid: int) -> None:
+        self.refcount[bid] -= 1
+        assert self.refcount[bid] >= 0, f"double free of block {bid}"
+        if self.refcount[bid] == 0:
+            if bid in self._block_to_hash:         # cached hash survives
+                self._free_cached[bid] = None      # MRU end
+            else:
+                self._free_uncached.append(bid)
+
+    # -- prefix cache -----------------------------------------------------
+
+    def match_prefix(self, tokens: Sequence[int]) -> List[int]:
+        """Longest cached block-aligned prefix; increfs every returned
+        block (caller owns them and must ``free`` each later)."""
+        ids: List[int] = []
+        bs = self.block_size
+        for i, h in enumerate(self.block_hashes(tokens)):
+            entry = self._hash_to_block.get(h)
+            if entry is None:
+                break
+            bid, blk_tokens = entry
+            if blk_tokens != tuple(tokens[i * bs:(i + 1) * bs]):
+                break                              # hash collision: miss
+            if self.refcount[bid] == 0:
+                del self._free_cached[bid]         # resurrect cached block
+            self.refcount[bid] += 1
+            ids.append(bid)
+        # Hit/query counters are the CALLER's to bump (count_prefix_stats)
+        # — an admission retried while waiting for memory would otherwise
+        # re-count the same tokens every engine step.
+        return ids
+
+    def count_prefix_stats(self, n_prompt_tokens: int,
+                           n_cached_blocks: int) -> None:
+        self.prefix_queries += (n_prompt_tokens -
+                                n_prompt_tokens % self.block_size)
+        self.prefix_hits += n_cached_blocks * self.block_size
+
+    def register_prefix(self, tokens: Sequence[int],
+                        block_ids: Sequence[int]) -> None:
+        """Publish a request's full blocks into the prefix cache (after
+        its prefill completed, so the pool contents are valid)."""
+        bs = self.block_size
+        for i, (h, bid) in enumerate(zip(self.block_hashes(tokens),
+                                         block_ids)):
+            if h in self._hash_to_block:
+                continue               # first writer wins; same content
+            if bid in self._block_to_hash:
+                continue               # block already published
+            self._hash_to_block[h] = (bid, tuple(tokens[i * bs:(i + 1) * bs]))
+            self._block_to_hash[bid] = h
+
+
+# ---------------------------------------------------------------------------
+# Device-side paged forward
+# ---------------------------------------------------------------------------
+
+def init_paged_cache(cfg, num_blocks: int, block_size: int
+                     ) -> Dict[str, jax.Array]:
+    """Flat physical pool: [L, num_blocks*block_size, Hkv, D]."""
+    shape = (cfg.n_layers, num_blocks * block_size,
+             cfg.n_kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
+
+
+def _physical_positions(block_tables, positions, block_size):
+    """[B, T] logical positions -> [B, T] flat pool indices via the
+    request's block table."""
+    blk = positions // block_size                               # [B, T]
+    phys_blk = jnp.take_along_axis(block_tables, blk, axis=1)   # [B, T]
+    return phys_blk * block_size + positions % block_size
+
+
+def make_paged_forward(block_size: int, base_forward=None):
+    """Paged counterpart of kv_cache.forward_with_cache for a fixed
+    block size (compile-time structure, like the mesh in pjit).
+
+    The transformer layer body lives ONLY in forward_with_cache; this
+    wrapper contributes a ``kv_update`` strategy that scatters new K/V
+    into the flat pool and gathers per-request contiguous views.
+    ``base_forward`` selects the model family (forward_with_cache for
+    Llama — the default — or forward_with_cache_mixtral for MoE).
+
+    The returned ``fwd(cfg, params, tokens, cache, block_tables, start,
+    write_mask, token_mask)`` takes ``block_tables: [B, max_blocks]`` of
+    physical block ids per request (entries past the live length may be
+    anything — reads are length-masked and writes past the live
+    positions never happen).  The pool axis is shared by all requests,
+    so write targets must be disjoint across rows — guaranteed because
+    each live block belongs to exactly one writer (prefix-shared blocks
+    are never written).
+    """
+    from kuberay_tpu.serve.kv_cache import forward_with_cache
+    base = base_forward or forward_with_cache
+
+    def fwd(cfg, params, tokens, cache, block_tables, start,
+            write_mask=None, token_mask=None):
+        B, T = tokens.shape
+        P = cache["k"].shape[1]                       # pool positions
+        K = block_tables.shape[1] * block_size        # logical view width
+        positions = start[:, None] + jnp.arange(T)[None, :]
+        phys = _physical_positions(block_tables, positions, block_size)
+        if write_mask is None:
+            write_mask = jnp.ones((B,), jnp.float32)
+        # Masked lanes scatter out of range -> dropped (no write).  Unlike
+        # the dense cache, padding writes CANNOT be tolerated here: a
+        # padding position's block-table lookup aliases another request's
+        # physical block, so the gate must be per-token (real tokens of
+        # writable rows only), not just per-row.
+        wgate = token_mask if token_mask is not None \
+            else jnp.broadcast_to(write_mask[:, None], (B, T))
+        wphys = jnp.where(wgate > 0, phys, P).reshape(-1)
+        # Per-request contiguous view indices: [B, K] flat pool positions;
+        # beyond-lens slots read garbage but are masked in the attention.
+        view = (block_tables[:, :, None] * block_size +
+                jnp.arange(block_size)[None, None, :]).reshape(B, K)
+
+        def kv_update(ck, cv, kk, vv):                # ck/cv: [P, Hkv, D]
+            H, D = ck.shape[-2], ck.shape[-1]
+            ck = ck.at[wphys].set(
+                kk.reshape(B * T, H, D).astype(ck.dtype), mode="drop")
+            cv = cv.at[wphys].set(
+                vv.reshape(B * T, H, D).astype(cv.dtype), mode="drop")
+            return ck, cv, jnp.take(ck, view, axis=0), \
+                jnp.take(cv, view, axis=0)
+
+        return base(cfg, params, tokens, cache, start, write_mask,
+                    token_mask=token_mask, kv_update=kv_update)
+
+    return fwd

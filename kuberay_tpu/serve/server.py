@@ -89,7 +89,9 @@ class ServeFrontend:
         with self._lock:
             return {**self._stats,
                     "active_slots": self.engine.num_active,
-                    "queued": len(self.engine.queue)}
+                    "queued": len(self.engine.queue),
+                    # Paged engines expose pool/prefix-cache counters.
+                    **getattr(self.engine, "stats", {})}
 
     def close(self):
         self._stop.set()
@@ -180,12 +182,23 @@ def main(argv=None):  # pragma: no cover - process wrapper
     ap.add_argument("--max-len", type=int, default=2048)
     ap.add_argument("--app-name", default="llm")
     ap.add_argument("--coordinator", default="")
+    ap.add_argument("--paged", action="store_true",
+                    help="paged KV cache with prefix caching")
+    ap.add_argument("--block-size", type=int, default=16)
+    ap.add_argument("--num-blocks", type=int, default=0,
+                    help="KV pool size in blocks (0 = dense-equivalent)")
     args = ap.parse_args(argv)
 
     cfg = llama.CONFIGS[args.model]
     params = llama.init_params(cfg, jax.random.PRNGKey(0))
-    engine = ServeEngine(cfg, params, max_slots=args.max_slots,
-                         max_len=args.max_len)
+    if args.paged:
+        from kuberay_tpu.serve.paged_engine import PagedServeEngine
+        engine = PagedServeEngine(
+            cfg, params, max_slots=args.max_slots, max_len=args.max_len,
+            num_blocks=args.num_blocks, block_size=args.block_size)
+    else:
+        engine = ServeEngine(cfg, params, max_slots=args.max_slots,
+                             max_len=args.max_len)
     frontend = ServeFrontend(engine)
     srv = frontend.make_server(args.host, args.port)
     if args.coordinator:
