@@ -104,7 +104,8 @@ def train(args) -> int:
     mesh = build_mesh(tp=args.tp, sp=args.sp)
     tc = TrainConfig(learning_rate=args.lr,
                      warmup_steps=min(args.warmup, max(1, args.steps // 10)),
-                     decay_steps=args.steps)
+                     decay_steps=args.steps,
+                     param_dtype=args.param_dtype, mu_dtype=args.mu_dtype)
     init, step_fn, shardings = make_sharded_train_fns(cfg, tc, mesh)
 
     state = None
@@ -155,6 +156,12 @@ def main(argv=None) -> int:
     ap.add_argument("--lr", type=float, default=3e-4)
     ap.add_argument("--warmup", type=int, default=100)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--param-dtype", default="",
+                    help="master-weight dtype (e.g. float32 with a bf16 "
+                         "model); default: model compute dtype")
+    ap.add_argument("--mu-dtype", default="",
+                    help="Adam first-moment dtype (bfloat16 halves that "
+                         "optimizer slice)")
     ap.add_argument("--tp", type=int, default=None)
     ap.add_argument("--sp", type=int, default=1)
     ap.add_argument("--data", default="", help="token shard path")
@@ -162,6 +169,20 @@ def main(argv=None) -> int:
     ap.add_argument("--checkpoint-every", type=int, default=500)
     ap.add_argument("--log-every", type=int, default=10)
     args = ap.parse_args(argv)
+    for flag in ("param_dtype", "mu_dtype"):
+        val = getattr(args, flag)
+        if val:
+            # Validate against what the runtime will actually do: must
+            # be a dtype JAX knows AND floating (int/bool would silently
+            # truncate weights to garbage).
+            try:
+                import jax.numpy as _jnp
+                ok = _jnp.issubdtype(_jnp.dtype(val), _jnp.floating)
+            except TypeError:
+                ok = False
+            if not ok:
+                ap.error(f"--{flag.replace('_', '-')}: {val!r} is not a "
+                         f"floating dtype (use e.g. float32, bfloat16)")
     return train(args)
 
 

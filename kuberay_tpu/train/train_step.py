@@ -30,6 +30,14 @@ class TrainConfig:
     beta2: float = 0.95
     grad_clip: float = 1.0
     z_loss: float = 1e-4
+    # Mixed-precision knobs.  param_dtype: master-weight dtype ("" = the
+    # model config's compute dtype).  The classic TPU recipe is fp32
+    # masters + bf16 compute: the step casts params to cfg.dtype for the
+    # forward, so gradients and Adam statistics come back in
+    # param_dtype.  mu_dtype: Adam first-moment dtype ("" = param
+    # dtype); "bfloat16" halves that slice of optimizer HBM.
+    param_dtype: str = ""
+    mu_dtype: str = ""
 
 
 def make_optimizer(tc: TrainConfig) -> optax.GradientTransformation:
@@ -43,17 +51,37 @@ def make_optimizer(tc: TrainConfig) -> optax.GradientTransformation:
     return optax.chain(
         optax.clip_by_global_norm(tc.grad_clip),
         optax.adamw(schedule, b1=tc.beta1, b2=tc.beta2,
-                    weight_decay=tc.weight_decay),
+                    weight_decay=tc.weight_decay,
+                    mu_dtype=jnp.dtype(tc.mu_dtype) if tc.mu_dtype
+                    else None),
     )
 
 
-def init_train_state(cfg: llama.LlamaConfig, optimizer, key) -> Dict[str, Any]:
+def _cast_floating(tree, dtype):
+    """Cast every floating leaf (integer/bool leaves untouched)."""
+    return jax.tree.map(
+        lambda x: x.astype(dtype)
+        if jnp.issubdtype(x.dtype, jnp.floating) else x, tree)
+
+
+def init_train_state(cfg: llama.LlamaConfig, optimizer, key,
+                     param_dtype: str = "") -> Dict[str, Any]:
     params = llama.init_params(cfg, key)
+    if param_dtype:
+        params = _cast_floating(params, param_dtype)
     return {
         "step": jnp.zeros((), jnp.int32),
         "params": params,
         "opt_state": optimizer.init(params),
     }
+
+
+def _compute_cast(cfg, tc: TrainConfig, params):
+    """Master weights -> compute dtype for the forward (no-op when they
+    already match; XLA elides the identity convert)."""
+    if not tc.param_dtype or jnp.dtype(tc.param_dtype) == jnp.dtype(cfg.dtype):
+        return params
+    return _cast_floating(params, cfg.dtype)
 
 
 # --------------------------------------------------------------------------
@@ -117,7 +145,8 @@ def make_train_step(cfg: llama.LlamaConfig, tc: TrainConfig,
 
     def step(state, batch):
         def loss(params):
-            return llama.loss_fn(cfg, params, batch["tokens"],
+            return llama.loss_fn(cfg, _compute_cast(cfg, tc, params),
+                                 batch["tokens"],
                                  batch["targets"], batch.get("mask"),
                                  tc.z_loss)
         (l, metrics), grads = jax.value_and_grad(loss, has_aux=True)(
@@ -153,12 +182,14 @@ def make_sharded_train_fns(cfg: llama.LlamaConfig, tc: TrainConfig,
                                      else None))
 
     init = jax.jit(
-        functools.partial(init_train_state, cfg, optimizer),
+        functools.partial(init_train_state, cfg, optimizer,
+                          param_dtype=tc.param_dtype),
         out_shardings=sh)
 
     def step(state, batch):
         def loss(params):
-            return llama.loss_fn(cfg, params, batch["tokens"],
+            return llama.loss_fn(cfg, _compute_cast(cfg, tc, params),
+                                 batch["tokens"],
                                  batch["targets"], None, tc.z_loss,
                                  mesh=mesh)
         (l, metrics), grads = jax.value_and_grad(loss, has_aux=True)(
