@@ -155,7 +155,10 @@ class TpuClusterSpec(Serializable):
     # Token auth for the coordinator API (ref auth secret builder +
     # e2e raycluster_auth_test.go): the operator mints a Secret and wires
     # it into every container; the coordinator requires Bearer auth.
-    enableTokenAuth: bool = False
+    # Defaults to True: the coordinator runs job entrypoints, so an
+    # unauthenticated coordinator port is remote code execution.  Set
+    # enableTokenAuth=false explicitly to opt out (trusted networks only).
+    enableTokenAuth: bool = True
     # Kueue-style handoff (ref ManagedBy raycluster_types.go:25-34):
     managedBy: str = ""
     # Gang scheduler selection (ref batchscheduler labels):

@@ -18,6 +18,7 @@ from __future__ import annotations
 import json
 import threading
 import urllib.error
+import urllib.parse
 import urllib.request
 from typing import Any, Callable, Dict, List, Optional
 
@@ -126,7 +127,7 @@ class RestObjectStore:
         path = self._path(kind, namespace)
         if labels:
             sel = ",".join(f"{k}={v}" for k, v in labels.items())
-            path += f"?labelSelector={sel}"
+            path += "?" + urllib.parse.urlencode({"labelSelector": sel})
         return self._req("GET", path).get("items", [])
 
     def update(self, obj: Dict[str, Any], *, subresource: str = ""):

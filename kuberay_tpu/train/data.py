@@ -11,6 +11,7 @@ toolchain is available the NumPy fallback provides identical batches
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import pathlib
 import subprocess
 import threading
@@ -25,15 +26,25 @@ _build_lock = threading.Lock()
 
 
 def _build_native() -> Optional[pathlib.Path]:
-    """Compile the loader once; cached next to the source."""
+    """Compile the loader once; cached next to the source.
+
+    The cache key is the sha256 of dataloader.cpp (stored in a sidecar
+    file), never mtimes: the .so that executes is always one this process
+    tree compiled from the checked-in source (binaries are not committed
+    — see .gitignore), and a stale or foreign .so is never loaded.
+    """
     with _build_lock:
-        if _SO.exists() and _SO.stat().st_mtime >= _SRC.stat().st_mtime:
+        src_sha = hashlib.sha256(_SRC.read_bytes()).hexdigest()
+        stamp = _SO.with_suffix(".src.sha256")
+        if (_SO.exists() and stamp.exists()
+                and stamp.read_text().strip() == src_sha):
             return _SO
         _SO.parent.mkdir(parents=True, exist_ok=True)
         cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
                str(_SRC), "-o", str(_SO)]
         try:
             subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            stamp.write_text(src_sha)
             return _SO
         except (subprocess.SubprocessError, FileNotFoundError):
             return None

@@ -48,7 +48,7 @@ def test_baseline5_mixtral_ep_two_groups(h):
     # Both expert groups resolve the SAME coordinator (DCN rendezvous).
     addrs = set()
     for p in workers:
-        env = {e["name"]: e["value"] for e in p["spec"]["containers"][0]["env"]}
+        env = {e["name"]: e.get("value", "") for e in p["spec"]["containers"][0]["env"]}
         addrs.add(env[C.ENV_COORDINATOR_ADDRESS])
         assert env[C.ENV_TPU_TOPOLOGY] == "2x2x4"
     assert len(addrs) == 1
@@ -71,7 +71,7 @@ def test_baseline3_llama_v5p64_shape(h):
     assert j.status.jobDeploymentStatus == JobDeploymentStatus.RUNNING
     workers = h.store.list("Pod", labels={C.LABEL_NODE_TYPE: "worker"})
     assert len(workers) == 16    # 64 chips / 4 per host
-    env = {e["name"]: e["value"]
+    env = {e["name"]: e.get("value", "")
            for e in workers[0]["spec"]["containers"][0]["env"]}
     assert env[C.ENV_NUM_PROCESSES] == "16"
     assert "launcher" in j.spec.entrypoint and "llama3_8b" in j.spec.entrypoint

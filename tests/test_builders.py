@@ -23,7 +23,7 @@ from tests.test_api_types import make_cluster
 
 
 def env_of(pod, container=0):
-    return {e["name"]: e["value"]
+    return {e["name"]: e.get("value", "")
             for e in pod["spec"]["containers"][container].get("env", [])}
 
 

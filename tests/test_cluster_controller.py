@@ -70,7 +70,7 @@ def test_multi_host_slice_atomic_create(h):
     for p in workers:
         lab = p["metadata"]["labels"]
         by_slice.setdefault(lab[C.LABEL_SLICE_INDEX], []).append(p)
-        env = {e["name"]: e["value"] for e in p["spec"]["containers"][0]["env"]}
+        env = {e["name"]: e.get("value", "") for e in p["spec"]["containers"][0]["env"]}
         assert env[C.ENV_TPU_WORKER_ID] == lab[C.LABEL_HOST_INDEX]
         assert env[C.ENV_TPU_TOPOLOGY] == "2x2x2"
         assert len(env[C.ENV_TPU_WORKER_HOSTNAMES].split(",")) == 2

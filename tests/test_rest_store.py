@@ -91,7 +91,7 @@ def test_operator_over_rest_end_to_end(remote):
             == "ready"), "cluster never became ready over REST"
         pods = backing.list("Pod")
         assert len(pods) == 3      # head + 2-host slice, created via REST
-        env = {e["name"]: e["value"]
+        env = {e["name"]: e.get("value", "")
                for e in pods[1]["spec"]["containers"][0]["env"]
                if "value" in e}
         assert env.get(C.ENV_TPU_TOPOLOGY) == "2x2x2"

@@ -55,7 +55,7 @@ def test_pool_maintains_warm_slices():
     st = store.get(KIND_WARM_POOL, "pool1")["status"]
     assert st == {"warmSlices": 2, "readySlices": 2, "hostsPerSlice": 2}
     # Warm pods carry full TPU env but no cluster identity.
-    env = {e["name"]: e["value"]
+    env = {e["name"]: e.get("value", "")
            for e in pods[0]["spec"]["containers"][0]["env"]}
     assert env[C.ENV_TPU_TOPOLOGY] == "2x2x2"
     assert C.LABEL_CLUSTER not in pods[0]["metadata"]["labels"]
