@@ -5,6 +5,7 @@ from __future__ import annotations
 
 import json
 import urllib.error
+import urllib.parse
 import urllib.request
 from typing import Any, Dict, List, Optional
 
@@ -58,7 +59,8 @@ class ApiClient:
              label_selector: str = "") -> List[Dict[str, Any]]:
         path = self._path(kind, namespace)
         if label_selector:
-            path += f"?labelSelector={label_selector}"
+            path += "?" + urllib.parse.urlencode(
+                {"labelSelector": label_selector})
         return self._req("GET", path).get("items", [])
 
     def get(self, kind: str, name: str, namespace: str = "default"):

@@ -1,0 +1,184 @@
+"""Pallas paged decode attention: block-table-native cache reads.
+
+The paged serve engine (serve/paged_engine.py) keeps one flat physical
+block pool per layer and a per-request table of physical block ids.
+Round 1 reused the dense decode kernel by GATHERING each request's live
+blocks into a contiguous ``[B, K]`` view every step — correct, but it
+copies the whole logical KV per generated token.  This kernel consumes
+the block table directly: the grid walks each request's LOGICAL blocks
+and the kv BlockSpec index map resolves them to PHYSICAL pool pages via
+scalar-prefetched tables, so pages stream HBM->VMEM exactly once, with
+no materialized gather, and — as in ops/decode_attention.py — pages past
+a request's live length are never fetched at all (index clamp) and do no
+compute (grid-level ``pl.when``).
+
+Pool layout is head-major ``[Hkv, num_blocks*block_size, D]`` so one
+page of one kv head is a contiguous ``block_size*D`` run: the indirect
+page fetch is a single dense DMA and the block tile is ``(block_size,
+D)`` — the natural mosaic shape — rather than a strided head-pick from
+a ``[P, Hkv, D]`` pool.
+
+Capability analogue: vLLM's PagedAttention CUDA kernel (the reference
+serves LLMs via RayService + vLLM, e.g.
+ray-operator/config/samples/vllm/ray-service.vllm-tpu-v6e-singlehost.yaml);
+rebuilt here as a Pallas TPU kernel over a jittable static-shape pool.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def gather_view(pool, tables, block_size: int):
+    """[Hkv, P, D] pool + [B, max_blocks] tables -> [B, K, Hkv, D]
+    contiguous per-request view (the round-1 materialized path; kept as
+    the prefill view builder and the XLA fallback)."""
+    B, nblk = tables.shape
+    K = nblk * block_size
+    flat = (tables[:, :, None] * block_size +
+            jnp.arange(block_size)[None, None, :]).reshape(B, K)
+    # [Hkv, B, K, D] -> [B, K, Hkv, D]
+    return jnp.take(pool, flat, axis=1).transpose(1, 2, 0, 3)
+
+
+def paged_decode_attention_xla(q, pk, pv, lens, tables, block_size: int,
+                               scale: Optional[float] = None):
+    """Fallback: gather the logical view, run masked dense attention.
+    q: [S, Hq, D]; pk/pv: [Hkv, P, D]; tables: [S, max_blocks]."""
+    from kuberay_tpu.ops.decode_attention import decode_attention_xla
+    ck = gather_view(pk, tables, block_size)
+    cv = gather_view(pv, tables, block_size)
+    return decode_attention_xla(q, ck, cv, lens, scale)
+
+
+def _paged_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
+                  m_scr, l_scr, acc_scr, *, scale, bs, nblk, group):
+    slot = pl.program_id(0)
+    j = pl.program_id(2)          # logical block (innermost, sequential)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    live = lens_ref[slot]
+
+    @pl.when(j * bs < live)
+    def _compute():
+        q = q_ref[0, 0, :, :]                     # [group, D]
+        k = k_ref[0, 0, :, :]                     # [bs, D]
+        v = v_ref[0, 0, :, :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale    # [group, bs]
+        cols = j * bs + jax.lax.broadcasted_iota(
+            jnp.int32, (q.shape[0], bs), 1)
+        s = jnp.where(cols < live, s, _NEG_INF)
+        m_prev = m_scr[:, :1]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        corr = jnp.exp(m_prev - m_cur)
+        p = jnp.exp(s - m_cur)
+        l_cur = corr * l_scr[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+        pv_ = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc_scr[:, :] = acc_scr[:, :] * corr + pv_
+        m_scr[:, :] = jnp.broadcast_to(m_cur, m_scr.shape)
+        l_scr[:, :] = jnp.broadcast_to(l_cur, l_scr.shape)
+
+    @pl.when(j == nblk - 1)
+    def _finalize():
+        l = jnp.where(l_scr[:, :1] == 0.0, 1.0, l_scr[:, :1])
+        o_ref[0, 0, :, :] = (acc_scr[:, :] / l).astype(o_ref.dtype)
+
+
+def paged_decode_attention_pallas(q, pk, pv, lens, tables, block_size: int,
+                                  scale: Optional[float] = None,
+                                  interpret: bool = False):
+    """q: [S, Hq, D]; pk/pv: [Hkv, P, D] head-major pool;
+    tables: [S, max_blocks] physical block ids; lens: [S]."""
+    S, Hq, D = q.shape
+    Hkv, P, _ = pk.shape
+    bs = block_size
+    assert P % bs == 0
+    num_blocks = P // bs
+    nblk = tables.shape[1]
+    group = Hq // Hkv
+    scale = scale if scale is not None else 1.0 / (D ** 0.5)
+
+    qg = q.reshape(S, Hkv, group, D)
+    # Contiguous page view of the head-major pool (free reshape).
+    pk4 = pk.reshape(Hkv, num_blocks, bs, D)
+    pv4 = pv.reshape(Hkv, num_blocks, bs, D)
+
+    def kv_index(s, h, j, tables, lens):
+        # Indirection + DMA skip in one map: resolve the LOGICAL block j
+        # to its PHYSICAL page, clamping past-live blocks to the last
+        # live one (a cheap re-read the compute branch ignores) so dead
+        # pages never stream from HBM.
+        last_live = jnp.maximum((lens[s] - 1) // bs, 0)
+        jl = jnp.minimum(j, last_live)
+        return (h, tables[s, jl], 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(S, Hkv, nblk),
+        in_specs=[
+            pl.BlockSpec((1, 1, group, D),
+                         lambda s, h, j, tables, lens: (s, h, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bs, D), kv_index, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bs, D), kv_index, memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, 1, group, D),
+                               lambda s, h, j, tables, lens: (s, h, 0, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((group, 128), jnp.float32),
+            pltpu.VMEM((group, 128), jnp.float32),
+            pltpu.VMEM((group, D), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(_paged_kernel, scale=scale, bs=bs,
+                               nblk=nblk, group=group)
+    # Bytes: worst case streams every table entry's page once per slot.
+    cost = pl.CostEstimate(
+        flops=4 * S * Hq * nblk * bs * D,
+        bytes_accessed=(q.size + 2 * S * Hkv * nblk * bs * D)
+        * q.dtype.itemsize,
+        transcendentals=S * Hq * nblk * bs)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, Hkv, group, D), q.dtype),
+        cost_estimate=cost,
+        interpret=interpret,
+    )(tables.astype(jnp.int32), lens.astype(jnp.int32), qg, pk4, pv4)
+    return out.reshape(S, Hq, D)
+
+
+def paged_decode_attention(q, pk, pv, lens, tables, block_size: int,
+                           scale: Optional[float] = None,
+                           impl: str = "auto"):
+    """Dispatching paged decode.  impl: auto|pallas|xla|pallas_interpret."""
+    if impl == "auto":
+        try:
+            on_tpu = jax.default_backend() == "tpu"
+        except Exception:
+            on_tpu = False
+        impl = "pallas" if on_tpu else "xla"
+    if impl == "xla":
+        return paged_decode_attention_xla(q, pk, pv, lens, tables,
+                                          block_size, scale)
+    return paged_decode_attention_pallas(
+        q, pk, pv, lens, tables, block_size, scale,
+        interpret=impl == "pallas_interpret")

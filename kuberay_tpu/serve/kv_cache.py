@@ -56,7 +56,7 @@ def _cached_attention(q, ck, cv, lens, q_positions):
 
 def forward_with_cache_mixtral(cfg, params, tokens, cache, start,
                                write_mask=None, token_mask=None,
-                               kv_update=None):
+                               kv_update=None, attention=None):
     """Mixtral against the cache: the shared layer plumbing with the MoE
     FFN swapped in.  Router aux losses are irrelevant at inference.  The
     token mask keeps padding/inactive slots out of expert routing."""
@@ -78,7 +78,7 @@ def forward_with_cache_mixtral(cfg, params, tokens, cache, start,
 
     return forward_with_cache(cfg, params, tokens, cache, start,
                               write_mask, token_mask=token_mask, ffn=ffn,
-                              kv_update=kv_update)
+                              kv_update=kv_update, attention=attention)
 
 
 def _insert_kv(ck, cv, kk, vv, positions, start, write_mask, T):
@@ -108,7 +108,7 @@ def forward_with_cache(cfg, params: Dict[str, Any],
                        start: jax.Array,
                        write_mask: jax.Array = None,
                        token_mask: jax.Array = None,
-                       ffn=None, kv_update=None
+                       ffn=None, kv_update=None, attention=None
                        ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """Run T new tokens through the model against the cache.
 
@@ -122,9 +122,12 @@ def forward_with_cache(cfg, params: Dict[str, Any],
     ``kv_update(ck, cv, kk, vv) -> (new_ck, new_cv, ck_view, cv_view)``
     customizes the cache layout — the default inserts into the per-slot
     contiguous cache, the paged path (serve/paged_kv.py) scatters into a
-    block pool and gathers per-request views.  Everything else (the
-    transformer layer body) is layout-agnostic and lives only here.
-    Returns (logits [B, T, V], new cache).
+    block pool and gathers per-request views; ``attention(q, ck_view,
+    cv_view, lens, positions)`` customizes the attention read (default
+    ``_cached_attention``; the block-table-native paged path passes the
+    raw pool plus a kernel that resolves the indirection itself).
+    Everything else (the transformer layer body) is layout-agnostic and
+    lives only here.  Returns (logits [B, T, V], new cache).
     """
     B, T = tokens.shape
     positions = start[:, None] + jnp.arange(T)[None, :]          # [B, T]
@@ -135,6 +138,8 @@ def forward_with_cache(cfg, params: Dict[str, Any],
         write_mask = jnp.ones((B,), jnp.float32)
     if ffn is None:
         ffn = _dense_ffn
+    if attention is None:
+        attention = _cached_attention
     if kv_update is None:
         # Default layout: insert new K/V at each slot's offset; masked
         # rows write nothing (dynamic-slice decode fast path, one-hot
@@ -153,7 +158,7 @@ def forward_with_cache(cfg, params: Dict[str, Any],
         q = apply_rope(q, cos, sin, positions)
         kk = apply_rope(kk, cos, sin, positions)
         ck, cv, ck_view, cv_view = kv_update(ck, cv, kk, vv)
-        attn = _cached_attention(q, ck_view, cv_view, lens, positions)
+        attn = attention(q, ck_view, cv_view, lens, positions)
         x = x + (attn.reshape(B, T, -1) @ lp["wo"]).astype(x.dtype)
         h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
         x = x + ffn(cfg, h, lp, token_mask).astype(x.dtype)

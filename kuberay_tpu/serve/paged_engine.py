@@ -43,7 +43,7 @@ class PagedServeEngine(ServeEngine):
     def __init__(self, cfg: LlamaConfig, params: Dict[str, Any],
                  max_slots: int = 8, max_len: int = 2048,
                  num_blocks: int = 0, block_size: int = 16,
-                 rng_seed: int = 0):
+                 rng_seed: int = 0, decode_impl: str = "auto"):
         # Default pool = the dense engine's footprint; callers shrink it
         # to realize the memory win (e.g. slots * expected_len).
         num_blocks = num_blocks or (max_slots * max_len) // block_size
@@ -62,7 +62,8 @@ class PagedServeEngine(ServeEngine):
         if isinstance(cfg, MixtralConfig):
             from kuberay_tpu.serve.kv_cache import forward_with_cache_mixtral
             base = forward_with_cache_mixtral
-        self._paged_fwd = make_paged_forward(block_size, base_forward=base)
+        self._paged_fwd = make_paged_forward(block_size, base_forward=base,
+                                             decode_impl=decode_impl)
         # super().__init__ jits self._prefill_impl/_decode_impl, which
         # resolve to the paged overrides below, and builds the cache via
         # the _init_cache hook.

@@ -163,9 +163,14 @@ class BlockAllocator:
 
 def init_paged_cache(cfg, num_blocks: int, block_size: int
                      ) -> Dict[str, jax.Array]:
-    """Flat physical pool: [L, num_blocks*block_size, Hkv, D]."""
-    shape = (cfg.n_layers, num_blocks * block_size,
-             cfg.n_kv_heads, cfg.head_dim)
+    """Flat physical pool, head-major: [L, Hkv, num_blocks*block_size, D].
+
+    Head-major so one (head, page) pair is a contiguous
+    ``block_size * head_dim`` run — the paged Pallas kernel's indirect
+    page fetch is then a single dense DMA (ops/paged_attention.py).
+    """
+    shape = (cfg.n_layers, cfg.n_kv_heads,
+             num_blocks * block_size, cfg.head_dim)
     return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
 
 
@@ -177,15 +182,28 @@ def _physical_positions(block_tables, positions, block_size):
     return phys_blk * block_size + positions % block_size
 
 
-def make_paged_forward(block_size: int, base_forward=None):
+def make_paged_forward(block_size: int, base_forward=None,
+                       decode_impl: str = "auto"):
     """Paged counterpart of kv_cache.forward_with_cache for a fixed
     block size (compile-time structure, like the mesh in pjit).
 
     The transformer layer body lives ONLY in forward_with_cache; this
     wrapper contributes a ``kv_update`` strategy that scatters new K/V
-    into the flat pool and gathers per-request contiguous views.
+    into the flat pool, plus an ``attention`` strategy:
+
+    - decode (T == 1): block-table-NATIVE — the raw pool and tables go
+      straight to the paged Pallas kernel, which resolves logical->
+      physical pages in its BlockSpec index map.  No gathered copy of
+      the logical KV is ever materialized (the round-1 gather cost one
+      full logical-cache copy per generated token).
+    - prefill (T > 1): per-request contiguous views are gathered once
+      (prefill runs once per request; the dense masked attention over
+      the gathered view stays the simplest correct thing).
+
     ``base_forward`` selects the model family (forward_with_cache for
-    Llama — the default — or forward_with_cache_mixtral for MoE).
+    Llama — the default — or forward_with_cache_mixtral for MoE);
+    ``decode_impl`` forwards to paged_decode_attention (auto|pallas|
+    xla|pallas_interpret).
 
     The returned ``fwd(cfg, params, tokens, cache, block_tables, start,
     write_mask, token_mask)`` takes ``block_tables: [B, max_blocks]`` of
@@ -197,13 +215,14 @@ def make_paged_forward(block_size: int, base_forward=None):
     are never written).
     """
     from kuberay_tpu.serve.kv_cache import forward_with_cache
+    from kuberay_tpu.ops.paged_attention import (
+        gather_view, paged_decode_attention)
     base = base_forward or forward_with_cache
 
     def fwd(cfg, params, tokens, cache, block_tables, start,
             write_mask=None, token_mask=None):
         B, T = tokens.shape
-        P = cache["k"].shape[1]                       # pool positions
-        K = block_tables.shape[1] * block_size        # logical view width
+        P = cache["k"].shape[2]                       # pool positions
         positions = start[:, None] + jnp.arange(T)[None, :]
         phys = _physical_positions(block_tables, positions, block_size)
         if write_mask is None:
@@ -216,21 +235,30 @@ def make_paged_forward(block_size: int, base_forward=None):
         wgate = token_mask if token_mask is not None \
             else jnp.broadcast_to(write_mask[:, None], (B, T))
         wphys = jnp.where(wgate > 0, phys, P).reshape(-1)
-        # Per-request contiguous view indices: [B, K] flat pool positions;
-        # beyond-lens slots read garbage but are masked in the attention.
-        view = (block_tables[:, :, None] * block_size +
-                jnp.arange(block_size)[None, None, :]).reshape(B, K)
 
-        def kv_update(ck, cv, kk, vv):                # ck/cv: [P, Hkv, D]
-            H, D = ck.shape[-2], ck.shape[-1]
-            ck = ck.at[wphys].set(
-                kk.reshape(B * T, H, D).astype(ck.dtype), mode="drop")
-            cv = cv.at[wphys].set(
-                vv.reshape(B * T, H, D).astype(cv.dtype), mode="drop")
-            return ck, cv, jnp.take(ck, view, axis=0), \
-                jnp.take(cv, view, axis=0)
+        def kv_update(ck, cv, kk, vv):                # ck/cv: [Hkv, P, D]
+            H, D = ck.shape[0], ck.shape[-1]
+            # [B, T, H, D] -> [H, B*T, D] rows for the head-major scatter.
+            krows = kk.reshape(B * T, H, D).swapaxes(0, 1)
+            vrows = vv.reshape(B * T, H, D).swapaxes(0, 1)
+            ck = ck.at[:, wphys].set(krows.astype(ck.dtype), mode="drop")
+            cv = cv.at[:, wphys].set(vrows.astype(cv.dtype), mode="drop")
+            if T == 1:
+                return ck, cv, ck, cv     # native: attention gets the pool
+            return ck, cv, gather_view(ck, block_tables, block_size), \
+                gather_view(cv, block_tables, block_size)
+
+        if T == 1:
+            def attention(q, pk, pv, lens, q_positions):
+                out = paged_decode_attention(
+                    q[:, 0], pk, pv, lens, block_tables, block_size,
+                    impl=decode_impl)
+                return out[:, None]
+        else:
+            attention = None              # dense masked attention on views
 
         return base(cfg, params, tokens, cache, start, write_mask,
-                    token_mask=token_mask, kv_update=kv_update)
+                    token_mask=token_mask, kv_update=kv_update,
+                    attention=attention)
 
     return fwd

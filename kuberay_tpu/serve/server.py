@@ -187,6 +187,9 @@ def main(argv=None):  # pragma: no cover - process wrapper
     ap.add_argument("--block-size", type=int, default=16)
     ap.add_argument("--num-blocks", type=int, default=0,
                     help="KV pool size in blocks (0 = dense-equivalent)")
+    ap.add_argument("--decode-impl", default="auto",
+                    choices=["auto", "pallas", "xla", "pallas_interpret"],
+                    help="paged decode attention path (auto: pallas on TPU)")
     args = ap.parse_args(argv)
 
     cfg = llama.CONFIGS[args.model]
@@ -195,7 +198,8 @@ def main(argv=None):  # pragma: no cover - process wrapper
         from kuberay_tpu.serve.paged_engine import PagedServeEngine
         engine = PagedServeEngine(
             cfg, params, max_slots=args.max_slots, max_len=args.max_len,
-            num_blocks=args.num_blocks, block_size=args.block_size)
+            num_blocks=args.num_blocks, block_size=args.block_size,
+            decode_impl=args.decode_impl)
     else:
         engine = ServeEngine(cfg, params, max_slots=args.max_slots,
                              max_len=args.max_len)

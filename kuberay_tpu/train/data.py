@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import ctypes
 import hashlib
+import os
 import pathlib
 import subprocess
 import threading
@@ -40,13 +41,22 @@ def _build_native() -> Optional[pathlib.Path]:
                 and stamp.read_text().strip() == src_sha):
             return _SO
         _SO.parent.mkdir(parents=True, exist_ok=True)
+        # Compile to a process-private temp path, then os.replace() both
+        # artifact and stamp atomically: concurrent builders on a shared
+        # filesystem (multi-host launch) each publish a complete .so —
+        # a reader can never load a half-written one.
+        tmp = _SO.with_name(f".{_SO.name}.{os.getpid()}")
         cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-               str(_SRC), "-o", str(_SO)]
+               str(_SRC), "-o", str(tmp)]
         try:
             subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-            stamp.write_text(src_sha)
+            os.replace(tmp, _SO)
+            tmp_stamp = stamp.with_name(f".{stamp.name}.{os.getpid()}")
+            tmp_stamp.write_text(src_sha)
+            os.replace(tmp_stamp, stamp)
             return _SO
         except (subprocess.SubprocessError, FileNotFoundError):
+            tmp.unlink(missing_ok=True)
             return None
 
 
