@@ -10,6 +10,15 @@ RPC schema) — clients use standard list/get/create/update/delete verbs:
     GET        /api/v1/namespaces/{ns}/{pods|services|events}
     GET        /metrics | /healthz | /readyz
 
+List routes speak the K8s **watch protocol** (`?watch=true`): a chunked
+stream of `{"type": ADDED|MODIFIED|DELETED|BOOKMARK|ERROR, "object":…}`
+lines resuming from `resourceVersion`, with `allowWatchBookmarks`
+progress events and the 410-Gone / relist contract when the requested
+resourceVersion has fallen out of the event backlog — the same semantics
+controller-runtime informers rely on against a real kube-apiserver.
+Optional bearer-token auth (`token=`) and TLS (`certfile=`/`keyfile=`)
+make the server a stand-in for an authenticated cluster endpoint.
+
 Serves the in-memory store directly when embedded with the operator; the
 same handler shape can front a real K8s API by swapping the store.
 """
@@ -55,10 +64,27 @@ _CORE_ALL_RE = re.compile(r"^/api/v1/(?P<plural>[^/]+)$")
 class ApiHandler(JsonHandler):
     store: ObjectStore = None           # injected by make_server
     metrics = None
+    token: Optional[str] = None         # bearer auth when set
 
-    def _error(self, code: int, message: str):
+    def _error(self, code: int, message: str, reason: str = ""):
         self._send(code, {"kind": "Status", "status": "Failure",
-                          "code": code, "message": message})
+                          "code": code, "message": message,
+                          **({"reason": reason} if reason else {})})
+
+    def _authorized(self) -> bool:
+        """Bearer check on every API verb; liveness probes stay open
+        (kubelet probes are unauthenticated against kube-apiserver too)."""
+        if not self.token:
+            return True
+        path = urlparse(self.path).path
+        if path in ("/healthz", "/readyz"):
+            return True
+        import hmac
+        got = self.headers.get("Authorization", "")
+        if hmac.compare_digest(got, f"Bearer {self.token}"):
+            return True
+        self._error(401, "Unauthorized", reason="Unauthorized")
+        return False
 
     def _route(self) -> Optional[Tuple[str, str, Optional[str], Optional[str]]]:
         path = urlparse(self.path).path
@@ -118,12 +144,120 @@ class ApiHandler(JsonHandler):
                 out[k.strip()] = v.strip()
         return out
 
+    # -- K8s-native streaming watch ---------------------------------------
+
+    def _write_chunk(self, data: bytes) -> bool:
+        try:
+            self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+            self.wfile.flush()
+            return True
+        except (BrokenPipeError, ConnectionError, OSError):
+            return False
+
+    def _k8s_watch(self, kind: str, ns: Optional[str]):
+        """Chunked watch stream on a list route (?watch=true): replays
+        the store backlog after ``resourceVersion`` then follows live
+        events, kind/namespace/label filtered.  Contract matched to
+        kube-apiserver: unknown/too-old rv -> 410 Gone Status (client
+        must relist); BOOKMARK progress events when
+        ``allowWatchBookmarks``; clean end at ``timeoutSeconds`` (client
+        reconnects from its last-seen rv)."""
+        q = parse_qs(urlparse(self.path).query)
+        try:
+            rv_s = q.get("resourceVersion", [""])[0]
+            rv = int(rv_s) if rv_s != "" else None
+            timeout = float(q.get("timeoutSeconds", ["60"])[0])
+        except ValueError:
+            return self._error(400, "bad resourceVersion/timeoutSeconds")
+        timeout = min(max(timeout, 0.0), 300.0)
+        bookmarks = q.get("allowWatchBookmarks", ["false"])[0] in (
+            "true", "1")
+        labels = self._label_selector()
+        if rv is None:
+            # No resume point given: start from now.  An EXPLICIT rv —
+            # including 0, a fresh store's list rv — is a resume point
+            # and must replay the backlog (an event squeezing between a
+            # client's list and its watch connect would otherwise be
+            # silently lost; the race that motivated rv semantics in the
+            # first place).
+            rv = self.store.resource_version()
+        else:
+            # Pre-flight checks.  Too old: the backlog no longer reaches
+            # the resume point.  Too NEW: the store restarted and its rv
+            # counter reset — without the 410 the stream would filter
+            # every event below the stale rv and the client would go
+            # permanently blind (kube-apiserver likewise rejects a
+            # future resourceVersion so informers relist).
+            if rv > self.store.resource_version():
+                return self._error(
+                    410, f"resourceVersion {rv} is in the future",
+                    reason="Expired")
+            _, _, truncated = self.store.events_since(rv, {kind})
+            if truncated:
+                return self._error(410, f"resourceVersion {rv} is too old",
+                                   reason="Expired")
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+        def emit(entry) -> bool:
+            return self._write_chunk(json.dumps(entry).encode() + b"\n")
+
+        import time as _time
+        deadline = _time.time() + timeout
+        alive = True
+        while alive:
+            remaining = deadline - _time.time()
+            if remaining <= 0:
+                break
+            events, cur, truncated = self.store.wait_for_events(
+                rv, {kind}, min(remaining, 5.0))
+            if truncated:
+                emit({"type": "ERROR", "object": {
+                    "kind": "Status", "status": "Failure", "code": 410,
+                    "reason": "Expired",
+                    "message": f"resourceVersion {rv} is too old"}})
+                break
+            matched = False
+            for erv, ev in events:
+                md = ev.obj.get("metadata", {})
+                if ns is not None and md.get("namespace") != ns:
+                    continue
+                if labels and any(md.get("labels", {}).get(k) != v
+                                  for k, v in labels.items()):
+                    continue
+                obj = dict(ev.obj)
+                obj.setdefault("kind", kind)
+                matched = True
+                if not emit({"type": ev.type, "object": obj}):
+                    alive = False
+                    break
+            rv = cur
+            if alive and not matched and bookmarks:
+                # Idle tick (or all events filtered): progress bookmark so
+                # the client's resume point advances past skipped spans.
+                if not emit({"type": "BOOKMARK", "object": {
+                        "kind": kind, "apiVersion": C.API_VERSION,
+                        "metadata": {"resourceVersion": str(rv)}}}):
+                    alive = False
+        if alive:
+            try:
+                self.wfile.write(b"0\r\n\r\n")
+                self.wfile.flush()
+            except (BrokenPipeError, ConnectionError, OSError):
+                pass
+        else:
+            self.close_connection = True
+
     # -- verbs -------------------------------------------------------------
 
     def do_GET(self):
         path = urlparse(self.path).path
         if path == "/healthz" or path == "/readyz":
             return self._send_text(200, "ok")
+        if not self._authorized():
+            return
         if path in ("/dashboard", "/dashboard/"):
             from kuberay_tpu.apiserver.dashboard import DASHBOARD_HTML
             return self._send_text(200, DASHBOARD_HTML, "text/html")
@@ -141,12 +275,20 @@ class ApiHandler(JsonHandler):
             if obj is None:
                 return self._error(404, f"{kind} {ns}/{name} not found")
             return self._send(200, obj)
+        q = parse_qs(urlparse(self.path).query)
+        if q.get("watch", ["false"])[0] in ("true", "1"):
+            return self._k8s_watch(kind, ns)
+        rv = self.store.resource_version()
         items = self.store.list(kind, ns, labels=self._label_selector())
         return self._send(200, {"kind": f"{kind}List", "items": items,
-                                "resourceVersion":
-                                    self.store.resource_version()})
+                                # K8s list shape (metadata.resourceVersion)
+                                # plus the legacy top-level field.
+                                "metadata": {"resourceVersion": str(rv)},
+                                "resourceVersion": rv})
 
     def do_POST(self):
+        if not self._authorized():
+            return
         route = self._route()
         if route is None:
             return self._error(404, "unknown path")
@@ -177,6 +319,8 @@ class ApiHandler(JsonHandler):
         return self._send(201, created)
 
     def do_PUT(self):
+        if not self._authorized():
+            return
         route = self._route()
         if route is None:
             return self._error(404, "unknown path")
@@ -219,6 +363,8 @@ class ApiHandler(JsonHandler):
         return self._send(200, out)
 
     def do_DELETE(self):
+        if not self._authorized():
+            return
         route = self._route()
         if route is None:
             return self._error(404, "unknown path")
@@ -233,18 +379,67 @@ class ApiHandler(JsonHandler):
         return self._send(200, {"kind": "Status", "status": "Success"})
 
 
+class _TlsThreadingHTTPServer(ThreadingHTTPServer):
+    """TLS where the handshake runs in the PER-CONNECTION thread.
+
+    Wrapping the listening socket (the obvious one-liner) performs every
+    handshake inside accept() — one accept loop, serialized handshakes —
+    which deadlocks the moment concurrent clients (the operator's
+    per-kind watch streams) handshake while requests are in flight.
+    """
+
+    ssl_context = None                  # set by make_server
+
+    def finish_request(self, request, client_address):
+        import ssl
+        try:
+            tls = self.ssl_context.wrap_socket(request, server_side=True)
+        except (ssl.SSLError, OSError):
+            try:
+                request.close()
+            except OSError:
+                pass
+            return
+        try:
+            self.RequestHandlerClass(tls, client_address, self)
+        finally:
+            try:
+                tls.close()
+            except OSError:
+                pass
+
+
 def make_server(store: ObjectStore, host: str = "127.0.0.1", port: int = 0,
-                metrics=None) -> ThreadingHTTPServer:
+                metrics=None, token: Optional[str] = None,
+                certfile: Optional[str] = None,
+                keyfile: Optional[str] = None) -> ThreadingHTTPServer:
+    """``token`` enables bearer auth on every API verb; ``certfile``/
+    ``keyfile`` serve TLS (the authenticated-cluster-endpoint stand-in
+    RestObjectStore's client auth is tested against)."""
     handler = type("BoundApiHandler", (ApiHandler,),
-                   {"store": store, "metrics": metrics})
-    return ThreadingHTTPServer((host, port), handler)
+                   {"store": store, "metrics": metrics, "token": token})
+    if certfile:
+        import ssl
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(certfile, keyfile)
+        srv = _TlsThreadingHTTPServer((host, port), handler)
+        srv.ssl_context = ctx
+        srv.tls = True
+    else:
+        srv = ThreadingHTTPServer((host, port), handler)
+        srv.tls = False
+    return srv
 
 
 def serve_background(store: ObjectStore, host: str = "127.0.0.1",
-                     port: int = 0, metrics=None):
+                     port: int = 0, metrics=None, token: Optional[str] = None,
+                     certfile: Optional[str] = None,
+                     keyfile: Optional[str] = None):
     """Start in a daemon thread; returns (server, base_url)."""
-    srv = make_server(store, host, port, metrics)
+    srv = make_server(store, host, port, metrics, token=token,
+                      certfile=certfile, keyfile=keyfile)
     t = threading.Thread(target=srv.serve_forever, daemon=True,
                          name="tpu-apiserver")
     t.start()
-    return srv, f"http://{srv.server_address[0]}:{srv.server_address[1]}"
+    scheme = "https" if srv.tls else "http"
+    return srv, f"{scheme}://{srv.server_address[0]}:{srv.server_address[1]}"

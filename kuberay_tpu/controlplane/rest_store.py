@@ -7,10 +7,17 @@ kube-apiserver fronting the tpu.dev CRDs.  This is how the control plane
 detaches from the in-memory store without touching a single controller
 (the reference's equivalent split: controller-runtime client vs envtest).
 
-Watch is polling-based (interval configurable): lists are diffed by
-resourceVersion into ADDED/MODIFIED/DELETED events — the informer-lite
-model; a streaming watch can replace ``_poll_once`` without touching
-consumers.
+Watch speaks the K8s protocol natively: one streaming
+``?watch=true&resourceVersion=N`` connection per kind (the informer
+model), consuming ADDED/MODIFIED/DELETED/BOOKMARK chunked events,
+reconnecting from the last-seen resourceVersion on clean timeouts, and
+relisting + rediffing on 410 Gone — so the same store fronts our
+apiserver or a real kube-apiserver.  Two fallbacks ladder down for
+older servers: the legacy ``/watch`` long-poll, then list-diff polling.
+
+Client auth: ``token=`` sends ``Authorization: Bearer`` on every
+request; ``ca_cert``/``client_cert`` configure TLS against an https
+endpoint (kubeconfig-style credentials, minus the kubeconfig file).
 """
 
 from __future__ import annotations
@@ -42,11 +49,26 @@ WATCHED_KINDS = ("TpuCluster", "TpuJob", "TpuService", "TpuCronJob",
 class RestObjectStore:
     def __init__(self, base_url: str, timeout: float = 10.0,
                  poll_interval: float = 0.2,
-                 watched_kinds=WATCHED_KINDS):
+                 watched_kinds=WATCHED_KINDS,
+                 token: Optional[str] = None,
+                 ca_cert: Optional[str] = None,
+                 client_cert: Optional[tuple] = None,
+                 insecure_skip_verify: bool = False):
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
         self.poll_interval = poll_interval
         self.watched_kinds = tuple(watched_kinds)
+        self.token = token
+        self._ssl_ctx = None
+        if self.base_url.startswith("https"):
+            import ssl
+            ctx = ssl.create_default_context(cafile=ca_cert)
+            if insecure_skip_verify:
+                ctx.check_hostname = False
+                ctx.verify_mode = ssl.CERT_NONE
+            if client_cert:
+                ctx.load_cert_chain(*client_cert)
+            self._ssl_ctx = ctx
         self._watchers: List[Callable[[Event], None]] = []
         self._lock = threading.Lock()
         self._known: Dict[tuple, int] = {}      # (kind, ns, name) -> rv
@@ -54,6 +76,9 @@ class RestObjectStore:
                                                 # events must carry labels)
         self._stop = threading.Event()
         self._poll_thread: Optional[threading.Thread] = None
+        self._kind_threads: List[threading.Thread] = []
+        self._synced = threading.Event()
+        self._sync_pending: set = set()
 
     # -- plumbing ----------------------------------------------------------
 
@@ -75,15 +100,22 @@ class RestObjectStore:
             base += f"/{sub}"
         return base
 
+    def _headers(self) -> Dict[str, str]:
+        h = {"Content-Type": "application/json"}
+        if self.token:
+            h["Authorization"] = f"Bearer {self.token}"
+        return h
+
     def _req(self, method: str, path: str, body: Optional[dict] = None,
              timeout: Optional[float] = None):
         data = json.dumps(body).encode() if body is not None else None
         req = urllib.request.Request(
             self.base_url + path, data=data, method=method,
-            headers={"Content-Type": "application/json"})
+            headers=self._headers())
         try:
             with urllib.request.urlopen(
-                    req, timeout=timeout or self.timeout) as resp:
+                    req, timeout=timeout or self.timeout,
+                    context=self._ssl_ctx) as resp:
                 payload = resp.read()
                 return json.loads(payload) if payload else {}
         except urllib.error.HTTPError as e:
@@ -209,21 +241,45 @@ class RestObjectStore:
             return True
         return False
 
-    # -- polling watch -----------------------------------------------------
+    # -- watch -------------------------------------------------------------
+    #
+    # Three tiers, best available wins (probed once per watch start):
+    #   k8s    — per-kind streaming ?watch=true (informer model)
+    #   legacy — /watch long-poll (round-1 protocol, older servers)
+    #   poll   — list-diff polling (any REST server)
 
     def watch(self, fn: Callable[[Event], None]) -> Callable[[], None]:
         with self._lock:
             self._watchers.append(fn)
-            if self._poll_thread is None or not self._poll_thread.is_alive():
+            running = (any(t.is_alive() for t in self._kind_threads)
+                       or (self._poll_thread is not None
+                           and self._poll_thread.is_alive()))
+            if not running:
                 self._stop = threading.Event()
-                self._prime()
-                # The loop captures ITS stop event: a long-poll can outlive
-                # close()'s join, and a restarted watch must not resurrect
-                # the old thread via the replaced self._stop.
-                self._poll_thread = threading.Thread(
-                    target=self._poll_loop, args=(self._stop,),
-                    daemon=True, name="rest-watch")
-                self._poll_thread.start()
+                mode, definitive = self._detect_watch_mode()
+                if mode == "k8s":
+                    self._start_kind_threads_locked()
+                else:
+                    self._prime()
+                    # The loop captures ITS stop event: a long-poll can
+                    # outlive close()'s join, and a restarted watch must
+                    # not resurrect the old thread via the replaced
+                    # self._stop.  A non-definitive probe (server down)
+                    # makes the poll loop re-probe periodically instead
+                    # of pinning the downgrade forever.
+                    self._poll_thread = threading.Thread(
+                        target=self._poll_loop,
+                        args=(self._stop, mode == "legacy",
+                              not definitive),
+                        daemon=True, name="rest-watch")
+                    self._poll_thread.start()
+
+        # WaitForCacheSync: block until every kind completed its initial
+        # relist — from that point on, any change is guaranteed to reach
+        # watchers (each stream resumes from its relist rv), the contract
+        # the in-memory store gives for free by synchronous registration.
+        if self._kind_threads:
+            self._synced.wait(timeout=15.0)
 
         def cancel():
             with self._lock:
@@ -237,6 +293,175 @@ class RestObjectStore:
         if t is not None:
             t.join(timeout=2.0)
         self._poll_thread = None
+        for t in self._kind_threads:
+            t.join(timeout=2.0)
+        self._kind_threads = []
+
+    def _start_kind_threads_locked(self):
+        """Start the per-kind k8s watch threads (caller holds _lock)."""
+        self._kind_threads = []
+        self._synced = threading.Event()
+        self._sync_pending = set(self.watched_kinds)
+        for kind in self.watched_kinds:
+            t = threading.Thread(
+                target=self._kind_loop, args=(kind, self._stop),
+                daemon=True, name=f"rest-watch-{kind}")
+            t.start()
+            self._kind_threads.append(t)
+
+    def _dispatch(self, events: List[Event]):
+        for ev in events:
+            for w in list(self._watchers):
+                try:
+                    w(ev)
+                except Exception:
+                    pass
+
+    # -- K8s-native streaming watch ---------------------------------------
+
+    def _detect_watch_mode(self) -> tuple:
+        """Probe the server's best watch dialect; returns
+        ``(mode, definitive)``.  A K8s-protocol server answers
+        ``?watch=true&timeoutSeconds=1`` with an (empty) event stream; a
+        round-1 server ignores the params and returns the full List
+        body; a bare REST server leaves only polling.  ``definitive``
+        False means the probe itself failed (server down mid-probe) and
+        the caller should re-probe later instead of pinning the fallback
+        mode forever."""
+        try:
+            path = self._path(self.watched_kinds[0], None)
+            req = urllib.request.Request(
+                self.base_url + path + "?watch=true&timeoutSeconds=1",
+                headers=self._headers())
+            with urllib.request.urlopen(
+                    req, timeout=self.timeout,
+                    context=self._ssl_ctx) as resp:
+                body = resp.read(4096)
+            if b'"items"' not in body:
+                return "k8s", True
+        except urllib.error.HTTPError:
+            pass
+        except Exception:
+            return "poll", False
+        return ("legacy", True) if self._probe_watch_rv() is not None \
+            else ("poll", True)
+
+    def _kind_loop(self, kind: str, stop: threading.Event):
+        rv: Optional[str] = None
+        first = True
+        backoff = self.poll_interval
+        while not stop.is_set():
+            try:
+                if rv is None:
+                    # Initial sync is silent (matching in-memory
+                    # ObjectStore.watch: level-triggered consumers list on
+                    # startup); post-410 relists emit the missed diff.
+                    rv = self._relist_kind(kind, silent=first)
+                    if first:
+                        first = False
+                        with self._lock:
+                            self._sync_pending.discard(kind)
+                            if not self._sync_pending:
+                                self._synced.set()
+                rv = self._stream_kind(kind, rv, stop)
+                backoff = self.poll_interval
+            except Exception:
+                # Exponential backoff per kind: a down/unauthorized server
+                # must not be hammered with a full LIST per poll_interval
+                # per kind (client-go reflector behavior).
+                rv = None
+                stop.wait(backoff)
+                backoff = min(backoff * 2, 30.0)
+
+    def _relist_kind(self, kind: str, silent: bool = False) -> str:
+        out = self._req("GET", self._path(kind, None))
+        items = out.get("items", [])
+        rv = (out.get("metadata") or {}).get("resourceVersion") \
+            or str(out.get("resourceVersion", 0))
+        events: List[Event] = []
+        with self._lock:
+            seen = set()
+            for obj in items:
+                md = obj.get("metadata", {})
+                key = (kind, md.get("namespace", "default"),
+                       md.get("name", ""))
+                seen.add(key)
+                nrv = md.get("resourceVersion", 0)
+                old = self._known.get(key)
+                if old is None:
+                    events.append(Event(Event.ADDED, kind, obj))
+                elif nrv != old:
+                    events.append(Event(Event.MODIFIED, kind, obj))
+                self._known[key] = nrv
+                self._last[key] = obj
+            for key in [k for k in self._known
+                        if k[0] == kind and k not in seen]:
+                _, ns, name = key
+                del self._known[key]
+                gone = self._last.pop(key, None) or {
+                    "kind": kind,
+                    "metadata": {"namespace": ns, "name": name,
+                                 "labels": {}}}
+                events.append(Event(Event.DELETED, kind, gone))
+        if not silent:
+            self._dispatch(events)
+        return str(rv)
+
+    def _stream_kind(self, kind: str, rv: str,
+                     stop: threading.Event) -> Optional[str]:
+        """One watch connection: consume events until the server's
+        timeoutSeconds window closes (return the resume rv) or the
+        stream expires (return None -> caller relists)."""
+        import socket
+        hold = 30
+        query = urllib.parse.urlencode({
+            "watch": "true", "resourceVersion": rv,
+            "timeoutSeconds": str(hold), "allowWatchBookmarks": "true"})
+        req = urllib.request.Request(
+            self.base_url + self._path(kind, None) + "?" + query,
+            headers=self._headers())
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=hold + 15,
+                    context=self._ssl_ctx) as resp:
+                for line in resp:
+                    if stop.is_set():
+                        return rv
+                    line = line.strip()
+                    if not line:
+                        continue
+                    entry = json.loads(line)
+                    etype = entry.get("type", "")
+                    obj = entry.get("object", {})
+                    if etype == "BOOKMARK":
+                        rv = str(obj.get("metadata", {})
+                                 .get("resourceVersion", rv))
+                        continue
+                    if etype == "ERROR":
+                        if obj.get("code") == 410:
+                            return None          # expired: relist
+                        return rv                # transient: reconnect
+                    md = obj.get("metadata", {})
+                    key = (kind, md.get("namespace", "default"),
+                           md.get("name", ""))
+                    ev = Event(etype, kind, obj)
+                    with self._lock:
+                        if etype == Event.DELETED:
+                            self._known.pop(key, None)
+                            self._last.pop(key, None)
+                        else:
+                            self._known[key] = md.get("resourceVersion", 0)
+                            self._last[key] = obj
+                    self._dispatch([ev])
+                    rv = str(md.get("resourceVersion", rv))
+        except urllib.error.HTTPError as e:
+            e.read()
+            if e.code == 410:
+                return None                      # expired before connect
+            raise StoreError(f"watch {kind}: HTTP {e.code}") from None
+        except (socket.timeout, TimeoutError):
+            return rv                            # idle socket: reconnect
+        return rv                                # clean server timeout
 
     def _prime(self):
         """Seed known-state without emitting events — pre-existing objects
@@ -292,14 +517,36 @@ class RestObjectStore:
                 except Exception:
                     pass
 
-    def _poll_loop(self, stop: threading.Event):
+    def _poll_loop(self, stop: threading.Event, try_legacy: bool = True,
+                   reprobe: bool = False):
         # Prefer the server's long-poll /watch (immediate delivery, no
         # per-interval full lists); fall back to list-diff polling.
-        try:
-            rv = self._resync()
-        except Exception:
-            rv = None
+        import time as _time
+        rv = None
+        if try_legacy:
+            try:
+                rv = self._resync()
+            except Exception:
+                rv = None
+        last_probe = _time.time()
         while not stop.is_set():
+            if reprobe and _time.time() - last_probe > 15.0:
+                # The original dialect probe failed transiently; a server
+                # that has since come back may speak the k8s protocol —
+                # upgrade instead of polling it forever.
+                last_probe = _time.time()
+                mode, definitive = self._detect_watch_mode()
+                if definitive:
+                    reprobe = False
+                    if mode == "k8s":
+                        with self._lock:
+                            self._start_kind_threads_locked()
+                        return
+                    if mode == "legacy" and rv is None:
+                        try:
+                            rv = self._resync()
+                        except Exception:
+                            rv = None
             if rv is not None:
                 try:
                     rv = self._watch_once(rv)

@@ -14,6 +14,7 @@ import ctypes
 import hashlib
 import os
 import pathlib
+import tempfile
 import subprocess
 import threading
 from typing import Dict, Iterator, Optional
@@ -41,22 +42,27 @@ def _build_native() -> Optional[pathlib.Path]:
                 and stamp.read_text().strip() == src_sha):
             return _SO
         _SO.parent.mkdir(parents=True, exist_ok=True)
-        # Compile to a process-private temp path, then os.replace() both
+        # Compile to a builder-private temp path, then os.replace() both
         # artifact and stamp atomically: concurrent builders on a shared
         # filesystem (multi-host launch) each publish a complete .so —
-        # a reader can never load a half-written one.
-        tmp = _SO.with_name(f".{_SO.name}.{os.getpid()}")
+        # a reader can never load a half-written one.  mkstemp (not pid
+        # suffixes: two hosts on shared NFS can share a pid) guarantees
+        # the temp name is unique across builders.
+        fd, tmp = tempfile.mkstemp(dir=_SO.parent, prefix=f".{_SO.name}.")
+        os.close(fd)
         cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-               str(_SRC), "-o", str(tmp)]
+               str(_SRC), "-o", tmp]
         try:
             subprocess.run(cmd, check=True, capture_output=True, timeout=120)
             os.replace(tmp, _SO)
-            tmp_stamp = stamp.with_name(f".{stamp.name}.{os.getpid()}")
-            tmp_stamp.write_text(src_sha)
+            fd, tmp_stamp = tempfile.mkstemp(dir=_SO.parent,
+                                             prefix=f".{stamp.name}.")
+            with os.fdopen(fd, "w") as f:
+                f.write(src_sha)
             os.replace(tmp_stamp, stamp)
             return _SO
         except (subprocess.SubprocessError, FileNotFoundError):
-            tmp.unlink(missing_ok=True)
+            pathlib.Path(tmp).unlink(missing_ok=True)
             return None
 
 

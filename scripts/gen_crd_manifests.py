@@ -1,0 +1,150 @@
+#!/usr/bin/env python3
+"""CRD manifest generator: emits apiextensions.k8s.io/v1
+CustomResourceDefinition YAML for the tpu.dev/v1 kinds under
+config/crd/bases/ (the registration artifact a real kube-apiserver
+needs before it will serve our resources).
+
+Counterpart of the reference's controller-gen output
+(ray-operator/config/crd/bases/ray.io_rayclusters.yaml); here the
+openAPIV3Schema is derived from the same dataclass-driven JSON schemas
+scripts/gen_schema.py writes to docs/crds/ — one source of truth for
+validation, docs, and registration.
+
+Run: python scripts/gen_crd_manifests.py   (after gen_schema.py)
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import sys
+
+import yaml
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+SCHEMAS = ROOT / "docs" / "crds"
+OUT = ROOT / "config" / "crd" / "bases"
+
+sys.path.insert(0, str(ROOT))
+from kuberay_tpu.utils import constants as C  # noqa: E402
+
+GROUP = "tpu.dev"
+VERSION = "v1"
+
+# Columns shown by `kubectl get <plural>` (mirrors the reference's
+# additionalPrinterColumns on ray.io_rayclusters.yaml).
+PRINTER_COLUMNS = {
+    "TpuCluster": [
+        {"name": "Slices", "type": "integer",
+         "jsonPath": ".status.readySlices"},
+        {"name": "State", "type": "string", "jsonPath": ".status.state"},
+    ],
+    "TpuJob": [
+        {"name": "Status", "type": "string",
+         "jsonPath": ".status.jobDeploymentStatus"},
+        {"name": "Cluster", "type": "string",
+         "jsonPath": ".status.clusterName"},
+    ],
+    "TpuService": [
+        {"name": "Status", "type": "string",
+         "jsonPath": ".status.serviceStatus"},
+    ],
+    "TpuCronJob": [
+        {"name": "Schedule", "type": "string", "jsonPath": ".spec.schedule"},
+        {"name": "Suspend", "type": "boolean", "jsonPath": ".spec.suspend"},
+    ],
+}
+
+
+def _strip_for_k8s(node):
+    """JSON Schema node -> structural-schema subset kube-apiserver
+    accepts: drop $schema/title/description metadata, keep type/
+    properties/items/enum/required; ``properties`` values (a name->schema
+    map) recurse per entry, not as a schema node themselves."""
+    out = {}
+    if "type" in node:
+        out["type"] = node["type"]
+    if "enum" in node:
+        out["enum"] = list(node["enum"])
+    if "required" in node:
+        out["required"] = list(node["required"])
+    if "properties" in node:
+        out["properties"] = {k: _strip_for_k8s(v)
+                             for k, v in node["properties"].items()}
+    if "items" in node and isinstance(node["items"], dict):
+        out["items"] = _strip_for_k8s(node["items"])
+    if isinstance(node.get("additionalProperties"), dict):
+        out["additionalProperties"] = _strip_for_k8s(
+            node["additionalProperties"])
+    for comb in ("anyOf", "oneOf"):
+        if comb in node:
+            out[comb] = [_strip_for_k8s(v) for v in node[comb]]
+    # K8s structural schemas demand a type on every node.
+    if "type" not in out and "anyOf" not in out and "oneOf" not in out:
+        out["type"] = "object"
+    # Free-form objects must be flagged, not silently pruned.
+    if out.get("type") == "object" and "properties" not in out \
+            and "additionalProperties" not in out:
+        out["x-kubernetes-preserve-unknown-fields"] = True
+    return out
+
+
+def crd_for(kind: str, schema: dict) -> dict:
+    plural = C.CRD_PLURALS[kind]
+    body = _strip_for_k8s(schema)
+    # metadata is typed by Kubernetes itself — CRDs must declare it as a
+    # plain object or the apiserver rejects the manifest.
+    if "properties" in body:
+        body["properties"]["metadata"] = {"type": "object"}
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": f"{plural}.{GROUP}"},
+        "spec": {
+            "group": GROUP,
+            "names": {
+                "kind": kind,
+                "listKind": f"{kind}List",
+                "plural": plural,
+                "singular": kind.lower(),
+            },
+            "scope": "Namespaced",
+            "versions": [{
+                "name": VERSION,
+                "served": True,
+                "storage": True,
+                "schema": {"openAPIV3Schema": body},
+                "subresources": {"status": {}},
+                "additionalPrinterColumns": PRINTER_COLUMNS.get(kind, []),
+            }],
+        },
+    }
+
+
+def main():
+    OUT.mkdir(parents=True, exist_ok=True)
+    written = []
+    for kind, plural in sorted(C.CRD_PLURALS.items()):
+        src = SCHEMAS / f"{kind.lower()}.schema.json"
+        if src.exists():
+            schema = json.loads(src.read_text())
+        else:
+            # Dict-shaped kinds (TrafficRoute, WarmSlicePool) register
+            # with free-form spec/status until they grow typed schemas.
+            schema = {"type": "object", "properties": {
+                "apiVersion": {"type": "string"},
+                "kind": {"type": "string"},
+                "metadata": {"type": "object"},
+                "spec": {"type": "object"},
+                "status": {"type": "object"},
+            }}
+        path = OUT / f"{GROUP}_{plural}.yaml"
+        path.write_text(yaml.safe_dump(crd_for(kind, schema),
+                                       sort_keys=False))
+        written.append(path)
+    for p in written:
+        print(p.relative_to(ROOT))
+
+
+if __name__ == "__main__":
+    main()
