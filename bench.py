@@ -37,11 +37,15 @@ def bench_attention_op():
         try:
             fn = jax.jit(lambda q, k, v, impl=impl: flash_attention(
                 q, k, v, causal=True, impl=impl))
-            fn(q, k, v).block_until_ready()
+            float(jnp.max(fn(q, k, v)))   # compile + reliable fence
+            # Chain iterations (out feeds the next q) so one final host
+            # fetch forces the whole sequence — the axon client's
+            # block_until_ready can return early (see main()).
             t0 = time.perf_counter()
+            out = q
             for _ in range(20):
-                out = fn(q, k, v)
-            out.block_until_ready()
+                out = fn(out, k, v)
+            float(jnp.max(out))
             dt = (time.perf_counter() - t0) / 20
             results[name + "_ms"] = round(dt * 1e3, 3)
         except Exception as e:
@@ -91,9 +95,13 @@ def main():
             tokens = jax.random.randint(key, (batch, seq), 0, cfg.vocab_size)
             batch_data = {"tokens": tokens,
                           "targets": jnp.roll(tokens, -1, axis=1)}
-            # Warmup / compile.
+            # Warmup / compile.  Force with a host fetch, not
+            # block_until_ready: the axon TPU client's block_until_ready
+            # can return before the computation finishes (measured: a
+            # 10-step llama_1b loop "completed" in 4 ms), while float()
+            # host fetches are reliable.
             state, m = step(state, batch_data)
-            jax.block_until_ready(m["total_loss"])
+            float(m["total_loss"])
             break
         except Exception as e:  # OOM / compile failure: try smaller
             last_err = e
@@ -108,14 +116,20 @@ def main():
     else:
         raise SystemExit(f"all bench configs failed: {last_err}")
 
-    t0 = time.perf_counter()
+    # Per-step timing, each step fenced by a host fetch of its loss.
+    # Step N's forward depends on step N-1's full optimizer update, so
+    # steady-state inter-fetch time IS the full step time; the median
+    # discards stragglers from tunnel round-trips.
+    dts = []
     for _ in range(steps):
+        t0 = time.perf_counter()
         state, m = step(state, batch_data)
-    jax.block_until_ready(m["total_loss"])
-    dt = time.perf_counter() - t0
+        float(m["total_loss"])
+        dts.append(time.perf_counter() - t0)
+    dt_step = sorted(dts)[len(dts) // 2]
 
     tokens_per_step = batch * seq
-    tok_s = tokens_per_step * steps / dt
+    tok_s = tokens_per_step / dt_step
 
     # MFU: standard 6*N FLOPs/token (fwd+bwd) + attention term.
     n_params = cfg.num_params()

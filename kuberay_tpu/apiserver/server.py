@@ -393,7 +393,11 @@ class _TlsThreadingHTTPServer(ThreadingHTTPServer):
     def finish_request(self, request, client_address):
         import ssl
         try:
+            # Bound the handshake: a client that connects and never
+            # handshakes must not pin this thread forever.
+            request.settimeout(10.0)
             tls = self.ssl_context.wrap_socket(request, server_side=True)
+            tls.settimeout(None)
         except (ssl.SSLError, OSError):
             try:
                 request.close()

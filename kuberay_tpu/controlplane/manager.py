@@ -146,28 +146,14 @@ class Manager:
             n += 1
         return n
 
-    def resync(self) -> Set[str]:
-        """Enqueue every existing object of every registered kind — the
-        informer initial-ADD pass.  Makes operator restart resume free
-        (pre-existing CRs reconcile without waiting for a change) and
-        closes the remote-store startup window where an object predating
-        watch sync would otherwise sit unreconciled.  Returns the kinds
-        whose list failed (callers must retry: for remote stores this
-        pass is the ONLY thing that reconciles pre-existing objects)."""
-        failed: Set[str] = set()
-        for kind in list(self._reconcilers):
-            try:
-                objs = self.store.list(kind)
-            except Exception:
-                failed.add(kind)
-                continue
-            for o in objs:
-                md = o.get("metadata", {})
-                self.enqueue((kind, md.get("namespace", "default"),
-                              md.get("name", "")))
-        return failed
-
     def _resync_until_complete(self):
+        """Enqueue every existing object of every registered kind — the
+        informer initial-ADD pass, retried until each kind lists once.
+        Makes operator restart resume free (pre-existing CRs reconcile
+        without waiting for a change) and closes the remote-store startup
+        window where an object predating watch sync would otherwise sit
+        unreconciled (for remote stores this pass is the ONLY thing that
+        reconciles pre-existing objects)."""
         pending = set(self._reconcilers)
         delay = 0.5
         while pending and not self._stop:

@@ -339,8 +339,12 @@ class RestObjectStore:
                 body = resp.read(4096)
             if b'"items"' not in body:
                 return "k8s", True
-        except urllib.error.HTTPError:
-            pass
+        except urllib.error.HTTPError as e:
+            # 5xx during the probe (server restarting, LB hiccup) is not
+            # evidence about the dialect — re-probe later rather than
+            # pinning poll mode forever.
+            if e.code >= 500:
+                return "poll", False
         except Exception:
             return "poll", False
         return ("legacy", True) if self._probe_watch_rv() is not None \

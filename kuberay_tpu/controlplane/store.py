@@ -435,10 +435,14 @@ class ObjectStore:
                 removed = self._objects.pop(k)
                 self._index_remove(k, removed)
                 self._journal_del(k)
-                # DELETED gets its own rv: it must not share the preceding
-                # MODIFIED's, or resuming watchers skip it forever.
-                self._next_rv()
-                self._notify(Event(Event.DELETED, kind, copy.deepcopy(removed)))
+                # DELETED gets its own rv, stamped onto the emitted object
+                # (kube-apiserver behavior): it must not share the
+                # preceding MODIFIED's rv or resuming watchers skip it
+                # forever, and clients that resume from the OBJECT's rv
+                # must not regress behind the event and replay it.
+                gone = copy.deepcopy(removed)
+                gone["metadata"]["resourceVersion"] = self._next_rv()
+                self._notify(Event(Event.DELETED, kind, gone))
         if removed is not None:
             self._cascade_delete(removed)
 

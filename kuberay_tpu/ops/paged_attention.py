@@ -60,9 +60,10 @@ def paged_decode_attention_xla(q, pk, pv, lens, tables, block_size: int,
 
 
 def _paged_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
-                  m_scr, l_scr, acc_scr, *, scale, bs, nblk, group):
+                  m_scr, l_scr, acc_scr, *, scale, bs, nblk, num_kv_heads,
+                  group):
     slot = pl.program_id(0)
-    j = pl.program_id(2)          # logical block (innermost, sequential)
+    j = pl.program_id(1)          # logical block (innermost, sequential)
 
     @pl.when(j == 0)
     def _init():
@@ -74,31 +75,39 @@ def _paged_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
 
     @pl.when(j * bs < live)
     def _compute():
-        q = q_ref[0, 0, :, :]                     # [group, D]
-        k = k_ref[0, 0, :, :]                     # [bs, D]
-        v = v_ref[0, 0, :, :]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale    # [group, bs]
         cols = j * bs + jax.lax.broadcasted_iota(
-            jnp.int32, (q.shape[0], bs), 1)
-        s = jnp.where(cols < live, s, _NEG_INF)
-        m_prev = m_scr[:, :1]
-        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        corr = jnp.exp(m_prev - m_cur)
-        p = jnp.exp(s - m_cur)
-        l_cur = corr * l_scr[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
-        pv_ = jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        acc_scr[:, :] = acc_scr[:, :] * corr + pv_
-        m_scr[:, :] = jnp.broadcast_to(m_cur, m_scr.shape)
-        l_scr[:, :] = jnp.broadcast_to(l_cur, l_scr.shape)
+            jnp.int32, (group, bs), 1)
+        # Static unroll over kv heads: one (Hkv, bs, D) page block serves
+        # every head, so each physical page streams from HBM exactly once
+        # per decode step (a per-head grid would cut the DMA to bs*D and
+        # multiply the grid — measured grid-step overhead dominates at
+        # serving block sizes).
+        for h in range(num_kv_heads):
+            rows = slice(h * group, (h + 1) * group)
+            q = q_ref[0, rows, :]                 # [group, D]
+            k = k_ref[h, 0, :, :]                 # [bs, D]
+            v = v_ref[h, 0, :, :]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale  # [group, bs]
+            s = jnp.where(cols < live, s, _NEG_INF)
+            m_prev = m_scr[rows, :1]
+            m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+            corr = jnp.exp(m_prev - m_cur)
+            p = jnp.exp(s - m_cur)
+            l_cur = corr * l_scr[rows, :1] + jnp.sum(p, axis=-1,
+                                                     keepdims=True)
+            pv_ = jax.lax.dot_general(
+                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            acc_scr[rows, :] = acc_scr[rows, :] * corr + pv_
+            m_scr[rows, :] = jnp.broadcast_to(m_cur, (group, 128))
+            l_scr[rows, :] = jnp.broadcast_to(l_cur, (group, 128))
 
     @pl.when(j == nblk - 1)
     def _finalize():
         l = jnp.where(l_scr[:, :1] == 0.0, 1.0, l_scr[:, :1])
-        o_ref[0, 0, :, :] = (acc_scr[:, :] / l).astype(o_ref.dtype)
+        o_ref[0, :, :] = (acc_scr[:, :] / l).astype(o_ref.dtype)
 
 
 def paged_decode_attention_pallas(q, pk, pv, lens, tables, block_size: int,
@@ -115,55 +124,55 @@ def paged_decode_attention_pallas(q, pk, pv, lens, tables, block_size: int,
     group = Hq // Hkv
     scale = scale if scale is not None else 1.0 / (D ** 0.5)
 
-    qg = q.reshape(S, Hkv, group, D)
     # Contiguous page view of the head-major pool (free reshape).
     pk4 = pk.reshape(Hkv, num_blocks, bs, D)
     pv4 = pv.reshape(Hkv, num_blocks, bs, D)
 
-    def kv_index(s, h, j, tables, lens):
+    def kv_index(s, j, tables, lens):
         # Indirection + DMA skip in one map: resolve the LOGICAL block j
         # to its PHYSICAL page, clamping past-live blocks to the last
         # live one (a cheap re-read the compute branch ignores) so dead
         # pages never stream from HBM.
         last_live = jnp.maximum((lens[s] - 1) // bs, 0)
         jl = jnp.minimum(j, last_live)
-        return (h, tables[s, jl], 0, 0)
+        return (0, tables[s, jl], 0, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(S, Hkv, nblk),
+        grid=(S, nblk),
         in_specs=[
-            pl.BlockSpec((1, 1, group, D),
-                         lambda s, h, j, tables, lens: (s, h, 0, 0),
+            pl.BlockSpec((1, Hq, D),
+                         lambda s, j, tables, lens: (s, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, bs, D), kv_index, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, bs, D), kv_index, memory_space=pltpu.VMEM),
+            pl.BlockSpec((Hkv, 1, bs, D), kv_index,
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((Hkv, 1, bs, D), kv_index,
+                         memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, 1, group, D),
-                               lambda s, h, j, tables, lens: (s, h, 0, 0),
+        out_specs=pl.BlockSpec((1, Hq, D),
+                               lambda s, j, tables, lens: (s, 0, 0),
                                memory_space=pltpu.VMEM),
         scratch_shapes=[
-            pltpu.VMEM((group, 128), jnp.float32),
-            pltpu.VMEM((group, 128), jnp.float32),
-            pltpu.VMEM((group, D), jnp.float32),
+            pltpu.VMEM((Hq, 128), jnp.float32),
+            pltpu.VMEM((Hq, 128), jnp.float32),
+            pltpu.VMEM((Hq, D), jnp.float32),
         ],
     )
     kernel = functools.partial(_paged_kernel, scale=scale, bs=bs,
-                               nblk=nblk, group=group)
+                               nblk=nblk, num_kv_heads=Hkv, group=group)
     # Bytes: worst case streams every table entry's page once per slot.
     cost = pl.CostEstimate(
         flops=4 * S * Hq * nblk * bs * D,
         bytes_accessed=(q.size + 2 * S * Hkv * nblk * bs * D)
         * q.dtype.itemsize,
         transcendentals=S * Hq * nblk * bs)
-    out = pl.pallas_call(
+    return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((S, Hkv, group, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((S, Hq, D), q.dtype),
         cost_estimate=cost,
         interpret=interpret,
-    )(tables.astype(jnp.int32), lens.astype(jnp.int32), qg, pk4, pv4)
-    return out.reshape(S, Hq, D)
+    )(tables.astype(jnp.int32), lens.astype(jnp.int32), q, pk4, pv4)
 
 
 def paged_decode_attention(q, pk, pv, lens, tables, block_size: int,

@@ -1,0 +1,17 @@
+#!/bin/bash
+# Poll TPU tunnel liveness; append one status line per probe to
+# /tmp/tpu_status.log so a build session can grab the chip the moment
+# the tunnel returns.  Usage: tools/tpu_watch.sh [interval_seconds]
+INTERVAL=${1:-120}
+while true; do
+  if timeout 60 python -c "
+import jax, jax.numpy as jnp
+x = jnp.ones((128,128), jnp.bfloat16)
+assert float((x@x).sum()) > 0
+" >/dev/null 2>&1; then
+    echo "$(date -u +%H:%M:%S) UP" >> /tmp/tpu_status.log
+  else
+    echo "$(date -u +%H:%M:%S) down" >> /tmp/tpu_status.log
+  fi
+  sleep "$INTERVAL"
+done
