@@ -39,6 +39,10 @@ class OperatorConfiguration(Serializable):
     unconditionalRequeueSeconds: float = 300.0
     # Feature gates, e.g. {"TpuMultiHostIndexing": True}:
     featureGates: Dict[str, bool] = dataclasses.field(default_factory=dict)
+    # History archive destination ("" = off): file:///path, s3://bucket
+    # ?endpoint=..., or gs://bucket?endpoint=... — the operator archives
+    # CR lifecycles there (ref historyserver collector deployment).
+    historyArchiveURL: str = ""
     # Head sidecars to inject (ref sidecar containers config):
     headSidecarContainers: List[dict] = dataclasses.field(default_factory=list)
     workerSidecarContainers: List[dict] = dataclasses.field(default_factory=list)

@@ -1,68 +1,255 @@
 """Web dashboard: single-file UI served at /dashboard (the Next.js
-dashboard analogue, SURVEY §2.2 — clusters/jobs/services tables over the
-API server, zero build-step)."""
+dashboard analogue, ref dashboard/src/app/{clusters,jobs,new,history} —
+zero build-step, hash-routed views over the REST API + the optional
+/api/history mount).
 
-DASHBOARD_HTML = """<!doctype html>
+Views:
+  #/overview            namespace-scoped tables (clusters/jobs/services/
+                        cron), slices, recent events
+  #/cluster/{ns}/{name} drill-down: status, slices, pods, events
+  #/new                 create a TpuJob or TpuCluster (form or raw JSON)
+  #/history             archived clusters (history mount), log browser
+"""
+
+DASHBOARD_HTML = r"""<!doctype html>
 <html><head><meta charset="utf-8"><title>kuberay-tpu dashboard</title>
 <style>
- body{font-family:system-ui,sans-serif;margin:2rem;background:#fafafa;color:#1a1a1a}
- h1{font-size:1.3rem} h2{font-size:1.05rem;margin-top:1.6rem}
- table{border-collapse:collapse;width:100%;background:#fff;box-shadow:0 1px 2px #0002}
- th,td{padding:.45rem .7rem;text-align:left;border-bottom:1px solid #eee;font-size:.85rem}
+ body{font-family:system-ui,sans-serif;margin:0;background:#fafafa;color:#1a1a1a}
+ header{background:#1a237e;color:#fff;padding:.6rem 1.2rem;display:flex;align-items:center;gap:1.2rem}
+ header h1{font-size:1.05rem;margin:0}
+ header a{color:#c5cae9;text-decoration:none;font-size:.9rem}
+ header a.active{color:#fff;font-weight:600;border-bottom:2px solid #fff}
+ main{padding:1rem 1.2rem;max-width:1100px}
+ h2{font-size:1.02rem;margin-top:1.4rem} h3{font-size:.95rem}
+ table{border-collapse:collapse;width:100%;background:#fff;box-shadow:0 1px 2px #0002;margin:.4rem 0}
+ th,td{padding:.42rem .65rem;text-align:left;border-bottom:1px solid #eee;font-size:.84rem}
  th{background:#f0f0f0;font-weight:600}
  .ok{color:#0a7d33;font-weight:600}.bad{color:#b3261e;font-weight:600}
  .dim{color:#777}.mono{font-family:ui-monospace,monospace}
- #refresh{float:right;color:#777;font-size:.8rem}
+ select,input,textarea{font:inherit;padding:.3rem .45rem;border:1px solid #ccc;border-radius:4px}
+ textarea{width:100%;font-family:ui-monospace,monospace;font-size:.82rem}
+ button{font:inherit;padding:.35rem .9rem;border:0;border-radius:4px;background:#1a237e;color:#fff;cursor:pointer}
+ button:hover{background:#283593}
+ .formrow{margin:.45rem 0}.formrow label{display:inline-block;width:11rem;font-size:.86rem}
+ #msg{margin:.6rem 0;font-size:.88rem}
+ pre{background:#111;color:#d8ffd8;padding:.7rem;overflow:auto;font-size:.78rem;max-height:26rem}
+ a{color:#1a237e}
+ #refresh{margin-left:auto;color:#c5cae9;font-size:.78rem}
 </style></head><body>
-<h1>kuberay-tpu <span class="dim">pod-slice orchestrator</span>
-<span id="refresh"></span></h1>
-<h2>TpuClusters</h2><table id="clusters"></table>
-<h2>TpuJobs</h2><table id="jobs"></table>
-<h2>TpuServices</h2><table id="services"></table>
-<h2>Slices</h2><table id="slices"></table>
-<h2>Recent events</h2><table id="events"></table>
+<header>
+ <h1>kuberay-tpu</h1>
+ <a href="#/overview" id="nav-overview">Overview</a>
+ <a href="#/new" id="nav-new">New</a>
+ <a href="#/history" id="nav-history">History</a>
+ <span style="font-size:.85rem">ns:
+  <select id="ns" style="padding:.1rem"></select></span>
+ <span id="refresh"></span>
+</header>
+<main id="main"></main>
 <script>
-const NS='default';
-async function list(api){const r=await fetch(api);return (await r.json()).items||[]}
 // All API-sourced strings pass through esc() before hitting innerHTML —
 // status subresources are writable by any API client.
 function esc(v){return String(v??'').replace(/[&<>"']/g,
   c=>({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[c]))}
 function row(cells,head){return '<tr>'+cells.map(c=>`<${head?'th':'td'}>${c}</${head?'th':'td'}>`).join('')+'</tr>'}
-function cls(state){return state==='ready'||state==='Running'||state==='Complete'?'ok':(state==='failed'||state==='Failed'?'bad':'dim')}
-async function tick(){
+function cls(s){return s==='ready'||s==='Running'||s==='Complete'||s==='Healthy'?'ok':(s==='failed'||s==='Failed'?'bad':'dim')}
+async function list(api){try{const r=await fetch(api);if(!r.ok)return[];return (await r.json()).items||[]}catch(e){return[]}}
+async function getj(api){try{const r=await fetch(api);if(!r.ok)return null;return await r.json()}catch(e){return null}}
+
+let NS=localStorage.getItem('ns')||'default';
+const PLURALS=['tpuclusters','tpujobs','tpuservices','tpucronjobs'];
+async function refreshNamespaces(){
+ const seen=new Set([NS,'default']);
+ for(const p of PLURALS)
+  for(const o of await list(`/apis/tpu.dev/v1/${p}`))
+   seen.add(o.metadata.namespace||'default');
+ const sel=document.getElementById('ns');
+ sel.innerHTML=[...seen].sort().map(n=>`<option${n===NS?' selected':''}>${esc(n)}</option>`).join('');
+}
+document.getElementById('ns').onchange=e=>{NS=e.target.value;localStorage.setItem('ns',NS);render()};
+
+// ---- views ----------------------------------------------------------
+async function viewOverview(el){
  const C=await list(`/apis/tpu.dev/v1/namespaces/${NS}/tpuclusters`);
- document.getElementById('clusters').innerHTML=row(['NAME','STATE','SLICES','HOSTS','TPU CHIPS'],1)+
-  C.map(c=>{const s=c.status||{};return row([esc(c.metadata.name),
-   `<span class="${cls(s.state)}">${esc(s.state||'provisioning')}</span>`,
-   `${s.readySlices||0}/${s.desiredSlices||0}`,
-   `${s.readyWorkerHosts||0}/${s.desiredWorkerHosts||0}`,s.desiredTpuChips||0])}).join('');
  const J=await list(`/apis/tpu.dev/v1/namespaces/${NS}/tpujobs`);
- document.getElementById('jobs').innerHTML=row(['NAME','DEPLOYMENT','JOB','CLUSTER','RETRIES'],1)+
-  J.map(j=>{const s=j.status||{};return row([esc(j.metadata.name),
-   `<span class="${cls(s.jobDeploymentStatus)}">${esc(s.jobDeploymentStatus||'')}</span>`,
-   esc(s.jobStatus||''),`<span class="mono">${esc(s.clusterName||'')}</span>`,esc(s.failed||0)])}).join('');
  const S=await list(`/apis/tpu.dev/v1/namespaces/${NS}/tpuservices`);
- document.getElementById('services').innerHTML=row(['NAME','STATUS','ACTIVE CLUSTER','ENDPOINTS'],1)+
-  S.map(x=>{const s=x.status||{};return row([esc(x.metadata.name),
-   `<span class="${cls(s.serviceStatus)}">${esc(s.serviceStatus||'')}</span>`,
-   `<span class="mono">${esc((s.activeServiceStatus||{}).clusterName||'')}</span>`,
-   s.numServeEndpoints||0])}).join('');
+ const CR=await list(`/apis/tpu.dev/v1/namespaces/${NS}/tpucronjobs`);
  const P=await list(`/api/v1/namespaces/${NS}/pods`);
+ const E=await list(`/api/v1/namespaces/${NS}/events`);
  const bySlice={};
  for(const p of P){const l=p.metadata.labels||{};const n=l['tpu.dev/slice-name'];
   if(!n)continue;(bySlice[n]=bySlice[n]||{c:l['tpu.dev/cluster'],g:l['tpu.dev/group'],t:0,r:0});
   bySlice[n].t++;if((p.status||{}).phase==='Running')bySlice[n].r++;}
- document.getElementById('slices').innerHTML=row(['SLICE','CLUSTER','GROUP','HOSTS READY'],1)+
+ el.innerHTML=`
+ <h2>TpuClusters</h2><table>${row(['NAME','STATE','SLICES','HOSTS','TPU CHIPS'],1)+
+  C.map(c=>{const s=c.status||{};return row([
+   `<a href="#/cluster/${esc(c.metadata.namespace||'default')}/${esc(c.metadata.name)}">${esc(c.metadata.name)}</a>`,
+   `<span class="${cls(s.state)}">${esc(s.state||'provisioning')}</span>`,
+   `${s.readySlices||0}/${s.desiredSlices||0}`,
+   `${s.readyWorkerHosts||0}/${s.desiredWorkerHosts||0}`,s.desiredTpuChips||0])}).join('')}</table>
+ <h2>TpuJobs</h2><table>${row(['NAME','DEPLOYMENT','JOB','CLUSTER','RETRIES'],1)+
+  J.map(j=>{const s=j.status||{};return row([esc(j.metadata.name),
+   `<span class="${cls(s.jobDeploymentStatus)}">${esc(s.jobDeploymentStatus||'')}</span>`,
+   esc(s.jobStatus||''),`<span class="mono">${esc(s.clusterName||'')}</span>`,esc(s.failed||0)])}).join('')}</table>
+ <h2>TpuServices</h2><table>${row(['NAME','STATUS','ACTIVE CLUSTER','ENDPOINTS'],1)+
+  S.map(x=>{const s=x.status||{};return row([esc(x.metadata.name),
+   `<span class="${cls(s.serviceStatus)}">${esc(s.serviceStatus||'')}</span>`,
+   `<span class="mono">${esc((s.activeServiceStatus||{}).clusterName||'')}</span>`,
+   s.numServeEndpoints||0])}).join('')}</table>
+ ${CR.length?`<h2>TpuCronJobs</h2><table>${row(['NAME','SCHEDULE','SUSPEND','LAST SCHEDULE'],1)+
+  CR.map(x=>row([esc(x.metadata.name),`<span class="mono">${esc((x.spec||{}).schedule||'')}</span>`,
+   esc((x.spec||{}).suspend||false),esc((x.status||{}).lastScheduleTime||'')])).join('')}</table>`:''}
+ <h2>Slices</h2><table>${row(['SLICE','CLUSTER','GROUP','HOSTS READY'],1)+
   Object.entries(bySlice).map(([n,v])=>row([`<span class="mono">${esc(n)}</span>`,esc(v.c),esc(v.g),
-   `<span class="${v.r===v.t?'ok':'dim'}">${v.r}/${v.t}</span>`])).join('');
- const E=await list(`/api/v1/namespaces/${NS}/events`);
- document.getElementById('events').innerHTML=row(['TYPE','REASON','OBJECT','MESSAGE'],1)+
+   `<span class="${v.r===v.t?'ok':'dim'}">${v.r}/${v.t}</span>`])).join('')}</table>
+ <h2>Recent events</h2><table>${row(['TYPE','REASON','OBJECT','MESSAGE'],1)+
   E.slice(-15).reverse().map(e=>row([esc(e.type),esc(e.reason),
    `<span class="mono">${esc((e.involvedObject||{}).kind)}/${esc((e.involvedObject||{}).name)}</span>`,
-   esc(e.message||'')])).join('');
+   esc(e.message||'')])).join('')}</table>`;
+}
+
+async function viewCluster(el,ns,name){
+ const c=await getj(`/apis/tpu.dev/v1/namespaces/${ns}/tpuclusters/${name}`);
+ if(!c){el.innerHTML=`<h2>TpuCluster ${esc(ns)}/${esc(name)}</h2>
+  <p class="bad">not found (deleted?) — <a href="#/history/${esc(ns)}/${esc(name)}">check history</a></p>`;return}
+ const s=c.status||{};
+ const P=await list(`/api/v1/namespaces/${ns}/pods`);
+ const mine=P.filter(p=>((p.metadata.labels||{})['tpu.dev/cluster'])===name);
+ const E=(await list(`/api/v1/namespaces/${ns}/events`))
+  .filter(e=>(e.involvedObject||{}).name===name).slice(-20).reverse();
+ const bySlice={};
+ for(const p of mine){const l=p.metadata.labels||{};const n=l['tpu.dev/slice-name']||'(head)';
+  (bySlice[n]=bySlice[n]||[]).push(p)}
+ el.innerHTML=`
+ <h2>TpuCluster <span class="mono">${esc(ns)}/${esc(name)}</span>
+  <span class="${cls(s.state)}">${esc(s.state||'provisioning')}</span></h2>
+ <table>${row(['SLICES','HOSTS','CHIPS','HEAD','CONDITIONS'],1)+
+  row([`${s.readySlices||0}/${s.desiredSlices||0}`,
+   `${s.readyWorkerHosts||0}/${s.desiredWorkerHosts||0}`,s.desiredTpuChips||0,
+   esc(s.head&&s.head.serviceName||''),
+   esc((s.conditions||[]).map(x=>x.type+'='+x.status).join(', '))])}</table>
+ <h3>Slices & pods</h3>
+ ${Object.entries(bySlice).map(([sl,pods])=>`
+  <table>${row([`<span class="mono">${esc(sl)}</span>`,'PHASE','NODE','RESTARTS'],1)+
+   pods.map(p=>row([esc(p.metadata.name),
+    `<span class="${cls((p.status||{}).phase)}">${esc((p.status||{}).phase||'')}</span>`,
+    esc((p.spec||{}).nodeName||''),
+    esc(((p.status||{}).containerStatuses||[{}])[0].restartCount||0)])).join('')}</table>`).join('')}
+ <h3>Events</h3><table>${row(['TYPE','REASON','MESSAGE'],1)+
+  E.map(e=>row([esc(e.type),esc(e.reason),esc(e.message||'')])).join('')}</table>`;
+}
+
+function viewNew(el){
+ el.innerHTML=`
+ <h2>Create</h2>
+ <div class="formrow"><label>Kind</label>
+  <select id="f-kind"><option>TpuJob</option><option>TpuCluster</option></select></div>
+ <div class="formrow"><label>Name</label><input id="f-name" value="my-job"></div>
+ <div class="formrow"><label>Namespace</label><input id="f-ns" value="${esc(NS)}"></div>
+ <div class="formrow"><label>Image</label><input id="f-image" value="tpu-trainer:latest" size="34"></div>
+ <div class="formrow"><label>Entrypoint (job)</label><input id="f-entry" value="python -m kuberay_tpu.train.launcher" size="34"></div>
+ <div class="formrow"><label>TPU version</label>
+  <select id="f-tpu"><option>v5e</option><option>v5p</option><option>v6e</option></select></div>
+ <div class="formrow"><label>Topology</label><input id="f-topo" value="2x4"></div>
+ <div class="formrow"><label>Slices</label><input id="f-slices" value="1" size="4"></div>
+ <div class="formrow"><button id="f-create">Create</button>
+  <button id="f-preview" style="background:#555">Preview JSON</button></div>
+ <div id="msg"></div>
+ <h3>Or raw JSON</h3>
+ <textarea id="f-raw" rows="12" placeholder='{"apiVersion":"tpu.dev/v1","kind":"TpuJob",...}'></textarea>
+ <div class="formrow"><button id="f-create-raw">Create from JSON</button></div>`;
+ const build=()=>{
+  const kind=document.getElementById('f-kind').value;
+  const name=document.getElementById('f-name').value;
+  const ns=document.getElementById('f-ns').value;
+  const clusterSpec={
+   headGroupSpec:{template:{spec:{containers:[{name:'head',
+     image:document.getElementById('f-image').value}]}}},
+   workerGroupSpecs:[{groupName:'workers',
+     numSlices:parseInt(document.getElementById('f-slices').value)||1,
+     tpuVersion:document.getElementById('f-tpu').value,
+     topology:document.getElementById('f-topo').value,
+     template:{spec:{containers:[{name:'worker',
+       image:document.getElementById('f-image').value}]}}}]};
+  if(kind==='TpuCluster')
+   return {apiVersion:'tpu.dev/v1',kind,metadata:{name,namespace:ns},spec:clusterSpec};
+  return {apiVersion:'tpu.dev/v1',kind,metadata:{name,namespace:ns},
+   spec:{entrypoint:document.getElementById('f-entry').value,
+         clusterSpec:clusterSpec,shutdownAfterJobFinishes:true}};
+ };
+ const submit=async(doc)=>{
+  const plural=doc.kind.toLowerCase()+'s';
+  const ns=(doc.metadata||{}).namespace||NS;
+  const r=await fetch(`/apis/tpu.dev/v1/namespaces/${ns}/${plural}`,
+   {method:'POST',headers:{'Content-Type':'application/json'},body:JSON.stringify(doc)});
+  const out=await r.json().catch(()=>({}));
+  document.getElementById('msg').innerHTML=r.ok
+   ?`<span class="ok">created ${esc(doc.kind)}/${esc(doc.metadata.name)}</span> — <a href="#/overview">overview</a>`
+   :`<span class="bad">HTTP ${r.status}: ${esc(out.message||'')}</span>`;
+ };
+ document.getElementById('f-preview').onclick=()=>{
+  document.getElementById('f-raw').value=JSON.stringify(build(),null,1)};
+ document.getElementById('f-create').onclick=()=>submit(build());
+ document.getElementById('f-create-raw').onclick=()=>{
+  try{submit(JSON.parse(document.getElementById('f-raw').value))}
+  catch(e){document.getElementById('msg').innerHTML=`<span class="bad">bad JSON: ${esc(e.message)}</span>`}};
+}
+
+// Each path segment URI-encoded, slashes between segments preserved.
+function encPath(...segs){return segs.flatMap(s=>String(s).split('/')).map(encodeURIComponent).join('/')}
+async function viewHistory(el,ns,name){
+ if(ns&&name){
+  const doc=await getj(`/api/history/TpuCluster/${encPath(ns,name)}`);
+  if(!doc){el.innerHTML=`<h2>History</h2><p class="bad">no archive for ${esc(ns)}/${esc(name)}</p>`;return}
+  const files=((await getj(`/api/history/logs/${encPath(ns,name)}`))||{}).files||[];
+  el.innerHTML=`
+  <h2>Archived TpuCluster <span class="mono">${esc(ns)}/${esc(name)}</span>
+   ${doc.deleted?'<span class="bad">deleted</span>':''}</h2>
+  <table>${row(['LAST STATE','SLICES READY','ARCHIVED AT'],1)+
+   row([esc((doc.status||{}).state||''),esc((doc.status||{}).readySlices||0),
+    esc(new Date((doc.archivedAt||0)*1000).toLocaleString())])}</table>
+  <h3>Events</h3><table>${row(['TYPE','REASON','MESSAGE'],1)+
+   (doc.events||[]).map(e=>row([esc(e.type),esc(e.reason),esc(e.message)])).join('')}</table>
+  ${doc.pods&&doc.pods.length?`<h3>Pods at deletion</h3><table>${row(['POD','PHASE'],1)+
+   doc.pods.map(p=>row([esc(p.name),esc(p.phase)])).join('')}</table>`:''}
+  <h3>Logs</h3><table>${row(['FILE',''],1)+
+   files.map(f=>row([`<span class="mono">${esc(f)}</span>`,
+    `<a href="#" data-log="${esc(f)}">view</a>`])).join('')}</table>
+  <pre id="logview" style="display:none"></pre>`;
+  el.querySelectorAll('a[data-log]').forEach(a=>a.onclick=async ev=>{
+   ev.preventDefault();
+   const r=await fetch(`/api/history/logs/${encPath(ns,name,a.dataset.log)}`);
+   const v=document.getElementById('logview');
+   v.style.display='block';v.textContent=await r.text()});
+  return;
+ }
+ const rows=((await getj('/api/history/clusters'))||{}).items;
+ if(rows===undefined){el.innerHTML=`<h2>History</h2>
+  <p class="dim">history archive not configured (set historyArchiveURL on the operator)</p>`;return}
+ el.innerHTML=`<h2>Archived clusters</h2>
+ <table>${row(['NAME','NAMESPACE','LAST STATE','DELETED','ARCHIVED'],1)+
+  rows.map(r=>row([`<a href="#/history/${esc(r.namespace)}/${esc(r.name)}">${esc(r.name)}</a>`,
+   esc(r.namespace),esc(r.state||''),r.deleted?'<span class="bad">yes</span>':'no',
+   esc(new Date((r.archivedAt||0)*1000).toLocaleString())])).join('')}</table>`;
+}
+
+// ---- router ---------------------------------------------------------
+let timer=null;
+async function render(){
+ const el=document.getElementById('main');
+ const parts=location.hash.replace(/^#\/?/,'').split('/').filter(Boolean);
+ const view=parts[0]||'overview';
+ for(const n of ['overview','new','history'])
+  document.getElementById('nav-'+n).className=view===n?'active':'';
+ if(timer){clearInterval(timer);timer=null}
+ if(view==='cluster'&&parts.length===3){await viewCluster(el,parts[1],parts[2]);
+  timer=setInterval(()=>viewCluster(el,parts[1],parts[2]),3000)}
+ else if(view==='new')viewNew(el);
+ else if(view==='history')await viewHistory(el,parts[1],parts[2]);
+ else{await viewOverview(el);timer=setInterval(()=>viewOverview(el),3000)}
  document.getElementById('refresh').textContent='updated '+new Date().toLocaleTimeString();
 }
-tick();setInterval(tick,3000);
+window.onhashchange=render;
+refreshNamespaces().then(render);setInterval(refreshNamespaces,15000);
 </script></body></html>
 """

@@ -65,6 +65,7 @@ class ApiHandler(JsonHandler):
     store: ObjectStore = None           # injected by make_server
     metrics = None
     token: Optional[str] = None         # bearer auth when set
+    history = None                      # HistoryServer mount (optional)
 
     def _error(self, code: int, message: str, reason: str = ""):
         self._send(code, {"kind": "Status", "status": "Failure",
@@ -266,6 +267,13 @@ class ApiHandler(JsonHandler):
             return self._send_text(200, text, "text/plain; version=0.0.4")
         if path == "/watch":
             return self._watch()
+        if path.startswith("/api/history/") and self.history is not None:
+            r = self.history.route(self.path)
+            if r is not None:
+                code, body, is_text = r
+                if is_text:
+                    return self._send_text(code, body)
+                return self._send(code, body)
         route = self._route()
         if route is None:
             return self._error(404, f"unknown path {path}")
@@ -416,12 +424,16 @@ class _TlsThreadingHTTPServer(ThreadingHTTPServer):
 def make_server(store: ObjectStore, host: str = "127.0.0.1", port: int = 0,
                 metrics=None, token: Optional[str] = None,
                 certfile: Optional[str] = None,
-                keyfile: Optional[str] = None) -> ThreadingHTTPServer:
+                keyfile: Optional[str] = None,
+                history=None) -> ThreadingHTTPServer:
     """``token`` enables bearer auth on every API verb; ``certfile``/
     ``keyfile`` serve TLS (the authenticated-cluster-endpoint stand-in
-    RestObjectStore's client auth is tested against)."""
+    RestObjectStore's client auth is tested against).  ``history``: a
+    ``history.server.HistoryServer`` to mount at ``/api/history/*`` so
+    the dashboard's history views work without a second endpoint."""
     handler = type("BoundApiHandler", (ApiHandler,),
-                   {"store": store, "metrics": metrics, "token": token})
+                   {"store": store, "metrics": metrics, "token": token,
+                    "history": history})
     if certfile:
         import ssl
         ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
@@ -438,10 +450,10 @@ def make_server(store: ObjectStore, host: str = "127.0.0.1", port: int = 0,
 def serve_background(store: ObjectStore, host: str = "127.0.0.1",
                      port: int = 0, metrics=None, token: Optional[str] = None,
                      certfile: Optional[str] = None,
-                     keyfile: Optional[str] = None):
+                     keyfile: Optional[str] = None, history=None):
     """Start in a daemon thread; returns (server, base_url)."""
     srv = make_server(store, host, port, metrics, token=token,
-                      certfile=certfile, keyfile=keyfile)
+                      certfile=certfile, keyfile=keyfile, history=history)
     t = threading.Thread(target=srv.serve_forever, daemon=True,
                          name="tpu-apiserver")
     t.start()

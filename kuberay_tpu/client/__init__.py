@@ -1,0 +1,24 @@
+"""Python SDK (the python-client analogue, ref clients/python-client):
+typed CR APIs with wait-helpers + a builder/director for cluster specs.
+
+    from kuberay_tpu.client import (ApiClient, TpuClusterApi, TpuJobApi,
+                                    TpuServiceApi, ClusterBuilder, Director)
+
+    api = ApiClient("http://operator:8765")
+    clusters = TpuClusterApi(api)
+    clusters.create(Director().build_small_cluster("demo"))
+    clusters.wait_until_ready("demo", timeout=300)
+"""
+
+from kuberay_tpu.cli.client import ApiClient, ApiError
+from kuberay_tpu.client.apis import (
+    TpuClusterApi,
+    TpuJobApi,
+    TpuServiceApi,
+    WaitTimeout,
+)
+from kuberay_tpu.client.builder import ClusterBuilder, Director, utils
+
+__all__ = ["ApiClient", "ApiError", "TpuClusterApi", "TpuJobApi",
+           "TpuServiceApi", "WaitTimeout", "ClusterBuilder", "Director",
+           "utils"]

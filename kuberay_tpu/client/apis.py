@@ -1,0 +1,203 @@
+"""Typed CR APIs with wait-helpers (ref kuberay_cluster_api.py
+RayClusterApi:52-282 and kuberay_job_api.py RayjobApi:58-368, rebuilt
+over this repo's REST apiserver instead of the K8s CustomObjectsApi)."""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from kuberay_tpu.cli.client import ApiClient, ApiError
+from kuberay_tpu.utils import constants as C
+
+
+class WaitTimeout(TimeoutError):
+    """A wait-helper ran out of time; carries the last observed status."""
+
+    def __init__(self, message: str, last_status: Optional[dict] = None):
+        super().__init__(message)
+        self.last_status = last_status or {}
+
+
+class _KindApi:
+    kind = ""
+
+    def __init__(self, client: Optional[ApiClient] = None):
+        self.client = client or ApiClient()
+
+    # CRUD ------------------------------------------------------------
+
+    def create(self, body: Dict[str, Any],
+               namespace: str = "default") -> Dict[str, Any]:
+        body = dict(body)
+        body.setdefault("apiVersion", "tpu.dev/v1")
+        body.setdefault("kind", self.kind)
+        md = body.setdefault("metadata", {})
+        md.setdefault("namespace", namespace)
+        return self.client.create(body)
+
+    def get(self, name: str, namespace: str = "default") -> Dict[str, Any]:
+        return self.client.get(self.kind, name, namespace)
+
+    def try_get(self, name: str,
+                namespace: str = "default") -> Optional[Dict[str, Any]]:
+        try:
+            return self.get(name, namespace)
+        except ApiError as e:
+            if e.code == 404:
+                return None
+            raise
+
+    def list(self, namespace: str = "default",
+             label_selector: str = "") -> List[Dict[str, Any]]:
+        return self.client.list(self.kind, namespace, label_selector)
+
+    def update(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        return self.client.update(body)
+
+    def delete(self, name: str, namespace: str = "default") -> bool:
+        try:
+            self.client.delete(self.kind, name, namespace)
+            return True
+        except ApiError as e:
+            if e.code == 404:
+                return False
+            raise
+
+    def status(self, name: str,
+               namespace: str = "default") -> Dict[str, Any]:
+        return self.get(name, namespace).get("status", {})
+
+    # wait plumbing ----------------------------------------------------
+
+    def _wait(self, name: str, namespace: str,
+              done: Callable[[Dict[str, Any]], bool],
+              timeout: float, poll: float, what: str) -> Dict[str, Any]:
+        deadline = time.monotonic() + timeout
+        last: Dict[str, Any] = {}
+        while time.monotonic() < deadline:
+            obj = self.try_get(name, namespace)
+            last = (obj or {}).get("status", {})
+            if obj is not None and done(last):
+                return last
+            time.sleep(poll)
+        raise WaitTimeout(
+            f"{self.kind} {namespace}/{name}: timed out waiting for {what} "
+            f"(last status: {last})", last)
+
+
+class TpuClusterApi(_KindApi):
+    """ref RayClusterApi (kuberay_cluster_api.py:20)."""
+
+    kind = C.KIND_CLUSTER
+
+    def wait_until_ready(self, name: str, namespace: str = "default",
+                         timeout: float = 600.0,
+                         poll: float = 1.0) -> Dict[str, Any]:
+        return self._wait(name, namespace,
+                          lambda s: s.get("state") == "ready",
+                          timeout, poll, "state=ready")
+
+    def scale_worker_group(self, name: str, group_name: str,
+                           num_slices: int,
+                           namespace: str = "default") -> Dict[str, Any]:
+        """Set a worker group's slice count (ref
+        update_worker_group_replicas, kuberay_cluster_utils.py:257)."""
+        obj = self.get(name, namespace)
+        groups = obj["spec"].get("workerGroupSpecs", [])
+        for g in groups:
+            if g.get("groupName") == group_name:
+                g["numSlices"] = num_slices
+                return self.update(obj)
+        raise KeyError(f"worker group {group_name!r} not in {name}")
+
+    def suspend(self, name: str, namespace: str = "default"):
+        obj = self.get(name, namespace)
+        obj["spec"]["suspend"] = True
+        return self.update(obj)
+
+    def resume(self, name: str, namespace: str = "default"):
+        obj = self.get(name, namespace)
+        obj["spec"]["suspend"] = False
+        return self.update(obj)
+
+
+class TpuJobApi(_KindApi):
+    """ref RayjobApi (kuberay_job_api.py:24)."""
+
+    kind = C.KIND_JOB
+
+    _TERMINAL = ("Complete", "Failed")
+
+    def submit(self, body: Dict[str, Any],
+               namespace: str = "default") -> Dict[str, Any]:
+        """ref submit_job (kuberay_job_api.py:58)."""
+        return self.create(body, namespace)
+
+    def wait_until_running(self, name: str, namespace: str = "default",
+                           timeout: float = 600.0,
+                           poll: float = 1.0) -> Dict[str, Any]:
+        """ref wait_until_job_running (kuberay_job_api.py:204)."""
+        return self._wait(
+            name, namespace,
+            lambda s: s.get("jobDeploymentStatus") in
+            ("Running",) + self._TERMINAL,
+            timeout, poll, "deployment Running")
+
+    def wait_until_finished(self, name: str, namespace: str = "default",
+                            timeout: float = 3600.0,
+                            poll: float = 2.0) -> Dict[str, Any]:
+        """ref wait_until_job_finished (kuberay_job_api.py:120).
+        Returns the terminal status; raises WaitTimeout otherwise."""
+        return self._wait(
+            name, namespace,
+            lambda s: s.get("jobDeploymentStatus") in self._TERMINAL,
+            timeout, poll, "terminal deployment status")
+
+    def succeeded(self, name: str, namespace: str = "default") -> bool:
+        s = self.status(name, namespace)
+        return s.get("jobDeploymentStatus") == "Complete" and \
+            s.get("jobStatus") in ("SUCCEEDED", None)
+
+    def suspend(self, name: str, namespace: str = "default"):
+        """ref suspend_job (kuberay_job_api.py:255)."""
+        obj = self.get(name, namespace)
+        obj["spec"]["suspend"] = True
+        return self.update(obj)
+
+    def resume(self, name: str, namespace: str = "default"):
+        obj = self.get(name, namespace)
+        obj["spec"]["suspend"] = False
+        return self.update(obj)
+
+    def resubmit(self, name: str, namespace: str = "default"):
+        """Delete + recreate with the same spec (ref resubmit_job,
+        kuberay_job_api.py:287)."""
+        obj = self.get(name, namespace)
+        self.delete(name, namespace)
+        fresh = {"apiVersion": obj.get("apiVersion", "tpu.dev/v1"),
+                 "kind": self.kind,
+                 "metadata": {"name": name, "namespace": namespace,
+                              "labels": obj["metadata"].get("labels", {})},
+                 "spec": obj["spec"]}
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            try:
+                return self.client.create(fresh)
+            except ApiError as e:
+                if e.code != 409:      # old object still finalizing
+                    raise
+                time.sleep(0.5)
+        raise WaitTimeout(f"resubmit {name}: old object never went away")
+
+
+class TpuServiceApi(_KindApi):
+    kind = C.KIND_SERVICE
+
+    def wait_until_healthy(self, name: str, namespace: str = "default",
+                           timeout: float = 600.0,
+                           poll: float = 1.0) -> Dict[str, Any]:
+        return self._wait(
+            name, namespace,
+            lambda s: s.get("serviceStatus") in ("Healthy", "Running"),
+            timeout, poll, "serviceStatus Healthy")

@@ -1,0 +1,217 @@
+"""Cluster builder + director presets (ref kuberay_cluster_builder.py
+ClusterBuilder/Director:48-310 and kuberay_cluster_utils.py
+ClusterUtils:21-425, re-shaped for TPU slices: worker groups are sized
+in SLICES of a (tpuVersion, topology) pair, not replica counts, and the
+presets step through real TPU slice shapes instead of cpu/memory
+tiers)."""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, List, Optional
+
+from kuberay_tpu.topology import SliceTopology
+
+
+class ClusterBuilder:
+    """Fluent spec builder; ``build()`` returns a TpuCluster dict that
+    passes utils/validation.py (topologies are validated eagerly via
+    topology.SliceTopology so mistakes fail at build time, not at
+    admission)."""
+
+    def __init__(self):
+        self._meta: Dict[str, Any] = {}
+        self._head: Optional[Dict[str, Any]] = None
+        self._groups: List[Dict[str, Any]] = []
+        self._spec_extras: Dict[str, Any] = {}
+
+    def with_meta(self, name: str, namespace: str = "default",
+                  labels: Optional[Dict[str, str]] = None,
+                  annotations: Optional[Dict[str, str]] = None
+                  ) -> "ClusterBuilder":
+        self._meta = {"name": name, "namespace": namespace}
+        if labels:
+            self._meta["labels"] = dict(labels)
+        if annotations:
+            self._meta["annotations"] = dict(annotations)
+        return self
+
+    def with_head(self, image: str = "tpu-runtime:latest",
+                  cpu: str = "2", memory: str = "4Gi",
+                  env: Optional[Dict[str, str]] = None,
+                  enable_ingress: bool = False) -> "ClusterBuilder":
+        container = {
+            "name": "head", "image": image,
+            "resources": {"requests": {"cpu": cpu, "memory": memory},
+                          "limits": {"cpu": cpu, "memory": memory}},
+        }
+        if env:
+            container["env"] = [{"name": k, "value": v}
+                                for k, v in sorted(env.items())]
+        self._head = {"template": {"spec": {"containers": [container]}}}
+        if enable_ingress:
+            self._head["enableIngress"] = True
+        return self
+
+    def with_worker_group(self, group_name: str = "workers",
+                          tpu_version: str = "v5e", topology: str = "2x4",
+                          num_slices: int = 1,
+                          image: str = "tpu-runtime:latest",
+                          env: Optional[Dict[str, str]] = None
+                          ) -> "ClusterBuilder":
+        SliceTopology.create(tpu_version, topology)   # validate eagerly
+        container = {"name": "worker", "image": image}
+        if env:
+            container["env"] = [{"name": k, "value": v}
+                                for k, v in sorted(env.items())]
+        self._groups.append({
+            "groupName": group_name,
+            "numSlices": num_slices,
+            "tpuVersion": tpu_version,
+            "topology": topology,
+            "template": {"spec": {"containers": [container]}},
+        })
+        return self
+
+    def with_suspend(self, suspend: bool = True) -> "ClusterBuilder":
+        self._spec_extras["suspend"] = suspend
+        return self
+
+    def with_autoscaling(self, min_slices: int,
+                         max_slices: int) -> "ClusterBuilder":
+        self._spec_extras["autoscalerOptions"] = {
+            "minSlices": min_slices, "maxSlices": max_slices}
+        return self
+
+    def build(self) -> Dict[str, Any]:
+        if not self._meta.get("name"):
+            raise ValueError("with_meta(name=...) is required")
+        if self._head is None:
+            self.with_head()
+        spec: Dict[str, Any] = {"headGroupSpec": self._head}
+        if self._groups:
+            spec["workerGroupSpecs"] = self._groups
+        spec.update(self._spec_extras)
+        return {"apiVersion": "tpu.dev/v1", "kind": "TpuCluster",
+                "metadata": dict(self._meta), "spec": spec}
+
+
+class Director:
+    """Size presets (ref Director.build_{basic,small,medium,large}_cluster,
+    kuberay_cluster_builder.py:195-310).  TPU sizing ladder:
+
+      basic   head only (no TPU slices — control/dev pod)
+      small   1 slice  of v5e 2x4   (8 chips, single host)
+      medium  1 slice  of v5e 4x8   (32 chips, 4 hosts)
+      large   4 slices of v6e 4x8   (128 chips, 16 hosts)
+    """
+
+    def build_basic_cluster(self, name: str, namespace: str = "default",
+                            labels: Optional[dict] = None) -> dict:
+        return (ClusterBuilder()
+                .with_meta(name, namespace, labels)
+                .with_head()
+                .build())
+
+    def build_small_cluster(self, name: str, namespace: str = "default",
+                            labels: Optional[dict] = None) -> dict:
+        return (ClusterBuilder()
+                .with_meta(name, namespace, labels)
+                .with_head()
+                .with_worker_group("workers", "v5e", "2x4", 1)
+                .build())
+
+    def build_medium_cluster(self, name: str, namespace: str = "default",
+                             labels: Optional[dict] = None) -> dict:
+        return (ClusterBuilder()
+                .with_meta(name, namespace, labels)
+                .with_head(cpu="4", memory="8Gi")
+                .with_worker_group("workers", "v5e", "4x8", 1)
+                .build())
+
+    def build_large_cluster(self, name: str, namespace: str = "default",
+                            labels: Optional[dict] = None) -> dict:
+        return (ClusterBuilder()
+                .with_meta(name, namespace, labels)
+                .with_head(cpu="8", memory="16Gi")
+                .with_worker_group("workers", "v6e", "4x8", 4)
+                .build())
+
+    def build_job(self, name: str, entrypoint: str,
+                  cluster_spec: Optional[dict] = None,
+                  namespace: str = "default",
+                  shutdown_after_finish: bool = True,
+                  backoff_limit: int = 0,
+                  deadline_seconds: int = 0,
+                  submission_mode: str = "") -> dict:
+        """TpuJob wrapper around a cluster spec (the RayJob analogue).
+        ``submission_mode``: "" (operator default: K8sJobMode submitter) |
+        HTTPMode | SidecarMode."""
+        if cluster_spec is None:
+            cluster_spec = self.build_small_cluster(name, namespace)["spec"]
+        spec: Dict[str, Any] = {
+            "entrypoint": entrypoint,
+            "clusterSpec": cluster_spec,
+            "shutdownAfterJobFinishes": shutdown_after_finish,
+        }
+        if submission_mode:
+            spec["submissionMode"] = submission_mode
+        if backoff_limit:
+            spec["backoffLimit"] = backoff_limit
+        if deadline_seconds:
+            spec["activeDeadlineSeconds"] = deadline_seconds
+        return {"apiVersion": "tpu.dev/v1", "kind": "TpuJob",
+                "metadata": {"name": name, "namespace": namespace},
+                "spec": spec}
+
+    def build_service(self, name: str, serve_config: dict,
+                      cluster_spec: Optional[dict] = None,
+                      namespace: str = "default") -> dict:
+        if cluster_spec is None:
+            cluster_spec = self.build_small_cluster(name, namespace)["spec"]
+        return {"apiVersion": "tpu.dev/v1", "kind": "TpuService",
+                "metadata": {"name": name, "namespace": namespace},
+                "spec": {"serveConfigV2": serve_config,
+                         "clusterSpec": cluster_spec}}
+
+
+class utils:
+    """Spec-surgery helpers (ref ClusterUtils, kuberay_cluster_utils.py:
+    21-425) as static functions over plain dicts."""
+
+    @staticmethod
+    def update_worker_group_slices(cluster: dict, group_name: str,
+                                   num_slices: int) -> dict:
+        out = copy.deepcopy(cluster)
+        for g in out["spec"].get("workerGroupSpecs", []):
+            if g.get("groupName") == group_name:
+                g["numSlices"] = num_slices
+                return out
+        raise KeyError(f"worker group {group_name!r} not found")
+
+    @staticmethod
+    def duplicate_worker_group(cluster: dict, group_name: str,
+                               new_name: str) -> dict:
+        """ref duplicate_worker_group (kuberay_cluster_utils.py:384)."""
+        out = copy.deepcopy(cluster)
+        groups = out["spec"].get("workerGroupSpecs", [])
+        if any(g.get("groupName") == new_name for g in groups):
+            raise ValueError(f"group {new_name!r} already exists")
+        for g in groups:
+            if g.get("groupName") == group_name:
+                dup = copy.deepcopy(g)
+                dup["groupName"] = new_name
+                groups.append(dup)
+                return out
+        raise KeyError(f"worker group {group_name!r} not found")
+
+    @staticmethod
+    def delete_worker_group(cluster: dict, group_name: str) -> dict:
+        """ref delete_worker_group (kuberay_cluster_utils.py:425)."""
+        out = copy.deepcopy(cluster)
+        groups = out["spec"].get("workerGroupSpecs", [])
+        kept = [g for g in groups if g.get("groupName") != group_name]
+        if len(kept) == len(groups):
+            raise KeyError(f"worker group {group_name!r} not found")
+        out["spec"]["workerGroupSpecs"] = kept
+        return out

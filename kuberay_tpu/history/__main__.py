@@ -1,0 +1,84 @@
+"""History CLI: the reference's two binaries in one module
+(ref historyserver/cmd/historyserver/main.go, cmd/collector/main.go).
+
+  python -m kuberay_tpu.history serve   --storage URL [--host H] [--port P]
+  python -m kuberay_tpu.history collect --storage URL --cluster NAME
+      [--namespace NS] [--node NODE] [--log-dir DIR]
+      [--coordinator URL] [--interval SEC] [--once]
+
+Storage URLs: ``file:///var/archive`` | ``s3://bucket?endpoint=...&
+region=...`` (creds via AWS_ACCESS_KEY_ID/AWS_SECRET_ACCESS_KEY) |
+``gs://bucket?endpoint=...`` (GCS_OAUTH_TOKEN).
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+from kuberay_tpu.history.collector import CoordinatorCollector, LogCollector
+from kuberay_tpu.history.server import HistoryServer
+from kuberay_tpu.history.storage import backend_from_url
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="kuberay_tpu.history")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("serve", help="replay API over the archive")
+    sp.add_argument("--storage", required=True)
+    sp.add_argument("--host", default="0.0.0.0")
+    sp.add_argument("--port", type=int, default=8090)
+
+    cp = sub.add_parser("collect", help="archive node logs / coordinator")
+    cp.add_argument("--storage", required=True)
+    cp.add_argument("--cluster", required=True)
+    cp.add_argument("--namespace", default="default")
+    cp.add_argument("--node", default="head")
+    cp.add_argument("--log-dir", default="")
+    cp.add_argument("--coordinator", default="",
+                    help="head coordinator URL (archives jobs + metadata)")
+    cp.add_argument("--interval", type=float, default=10.0)
+    cp.add_argument("--once", action="store_true")
+
+    args = ap.parse_args(argv)
+    storage = backend_from_url(args.storage)
+
+    if args.cmd == "serve":
+        srv = HistoryServer(storage).make_server(args.host, args.port)
+        print(f"history server on {args.host}:{srv.server_port}")
+        try:
+            srv.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        return 0
+
+    log_col = None
+    if args.log_dir:
+        log_col = LogCollector(storage, args.log_dir, cluster=args.cluster,
+                               namespace=args.namespace, node=args.node,
+                               poll_interval=args.interval)
+    coord_col = None
+    if args.coordinator:
+        coord_col = CoordinatorCollector(
+            storage, args.coordinator, cluster=args.cluster,
+            namespace=args.namespace)
+    if log_col is None and coord_col is None:
+        ap.error("collect needs --log-dir and/or --coordinator")
+    try:
+        while True:
+            n = 0
+            if log_col is not None:
+                n += log_col.poll_once()
+            if coord_col is not None:
+                n += coord_col.collect_once()
+            if args.once:
+                print(f"archived {n} objects")
+                return 0
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

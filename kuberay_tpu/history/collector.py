@@ -1,0 +1,168 @@
+"""Log + metadata collectors feeding the history archive.
+
+Reference shape: the historyserver ships a per-node collector sidecar
+(``historyserver/pkg/collector/logcollector/.../collector.go:23-60``)
+that tails the Ray log directory with fsnotify and uploads files to
+object storage under ``{clusterDir}/{session}/{node}/logs/...``; the
+head-node collector additionally fetches cluster metadata and dashboard
+endpoints (``FetchAndStoreClusterMetadata``, ``startup_endpoints.go``).
+
+TPU-native analogues here:
+
+- ``LogCollector`` — polling tailer over a node's log directory
+  (fsnotify has no stdlib equivalent; a (size, mtime) poll is the same
+  contract).  Changed files upload whole (object stores don't append),
+  with a final flush on ``stop()`` mirroring the reference's
+  ``processSessionLatestLogs`` shutdown pass.
+- ``CoordinatorCollector`` — head-side: scrapes the coordinator's job
+  list, per-job logs, and cluster metadata into the archive so a
+  deleted cluster's jobs remain debuggable.
+
+Archive layout (shared with server.py):
+  ``logs/{ns}/{cluster}/{node}/{relpath}``          raw node logs
+  ``logs/{ns}/{cluster}/head/jobs/{job_id}.log``    job driver logs
+  ``meta/{ns}/{cluster}/metadata.json``             cluster metadata
+  ``meta/{ns}/{cluster}/jobs.json``                 job records
+  ``{kind}/{ns}/{name}.json``                       CR snapshots
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import urllib.error
+import urllib.request
+from typing import Dict, Optional, Tuple
+
+from kuberay_tpu.history.storage import StorageBackend
+
+
+class LogCollector:
+    """Uploads a node's log directory into the archive as files change."""
+
+    def __init__(self, storage: StorageBackend, log_dir: str,
+                 cluster: str, namespace: str = "default",
+                 node: str = "head", poll_interval: float = 2.0):
+        self.storage = storage
+        self.log_dir = log_dir
+        self.prefix = f"logs/{namespace}/{cluster}/{node}"
+        self.poll_interval = poll_interval
+        self._seen: Dict[str, Tuple[int, float]] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- one poll pass (public: tests and the final flush drive it) ----
+
+    def poll_once(self) -> int:
+        """Upload files whose (size, mtime) changed; returns upload count."""
+        n = 0
+        if not os.path.isdir(self.log_dir):
+            return 0
+        for dirpath, _dirs, files in os.walk(self.log_dir):
+            for fn in files:
+                path = os.path.join(dirpath, fn)
+                rel = os.path.relpath(path, self.log_dir).replace(os.sep, "/")
+                try:
+                    st = os.stat(path)
+                except OSError:
+                    continue
+                sig = (st.st_size, st.st_mtime)
+                if self._seen.get(rel) == sig:
+                    continue
+                try:
+                    with open(path, "rb") as f:
+                        data = f.read()
+                except OSError:
+                    continue
+                self.storage.put(f"{self.prefix}/{rel}", data)
+                self._seen[rel] = sig
+                n += 1
+        return n
+
+    # -- background loop ----------------------------------------------
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="log-collector")
+        self._thread.start()
+        return self
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+            except Exception:
+                pass   # storage hiccup: retry next poll
+            self._stop.wait(self.poll_interval)
+
+    def stop(self):
+        """Stop and run the final flush (ref: processSessionLatestLogs)."""
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=10)
+        try:
+            self.poll_once()
+        except Exception:
+            pass
+
+
+class CoordinatorCollector:
+    """Head-side collector: archives the coordinator's cluster metadata,
+    job records, and per-job driver logs."""
+
+    def __init__(self, storage: StorageBackend, coordinator_url: str,
+                 cluster: str, namespace: str = "default",
+                 token: str = "", timeout: float = 5.0):
+        self.storage = storage
+        self.base = coordinator_url.rstrip("/")
+        self.cluster = cluster
+        self.namespace = namespace
+        self.token = token
+        self.timeout = timeout
+
+    def _get(self, path: str) -> Optional[bytes]:
+        headers = {}
+        if self.token:
+            headers["Authorization"] = f"Bearer {self.token}"
+        try:
+            req = urllib.request.Request(self.base + path, headers=headers)
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return resp.read()
+        except (urllib.error.URLError, OSError):
+            return None
+
+    def collect_once(self) -> int:
+        """Scrape metadata + jobs + job logs; returns archived-object count."""
+        n = 0
+        meta_prefix = f"meta/{self.namespace}/{self.cluster}"
+        raw = self._get("/api/cluster")
+        if raw is not None:
+            self.storage.put(f"{meta_prefix}/metadata.json", raw)
+            n += 1
+        raw = self._get("/api/jobs/")
+        if raw is None:
+            return n
+        self.storage.put(f"{meta_prefix}/jobs.json", raw)
+        n += 1
+        try:
+            jobs = json.loads(raw)
+        except ValueError:
+            return n
+        items = jobs if isinstance(jobs, list) else jobs.get("jobs", [])
+        for job in items:
+            jid = job.get("job_id") or job.get("submission_id")
+            if not jid:
+                continue
+            logs = self._get(f"/api/jobs/{jid}/logs")
+            if logs is None:
+                continue
+            try:
+                text = json.loads(logs).get("logs", "")
+            except ValueError:
+                text = logs.decode(errors="replace")
+            self.storage.put(
+                f"logs/{self.namespace}/{self.cluster}/head/jobs/{jid}.log",
+                text.encode())
+            n += 1
+        return n

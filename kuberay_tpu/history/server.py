@@ -1,92 +1,107 @@
 """History server: post-mortem observability (ref historyserver/, SURVEY
-§2.2 — collector tails live state into object storage; server replays a
-dashboard-compatible API from storage).
+§2.2 — collectors tail live state into object storage; the server
+replays a dashboard-compatible API from storage).
 
-Two components, same shapes as the reference:
-- ``HistoryCollector``: watches the store and archives terminal CRs,
-  events, and pod summaries as JSON files under a storage root (the
-  GCS/S3 backend seam is the ``storage`` argument — local directory here,
-  same layout an object-store backend would use).
-- ``HistoryServer``: read-only HTTP API over the archive
-  (``/api/history/{kind}``, ``/api/history/{kind}/{ns}/{name}``) so
-  clusters/jobs remain inspectable after deletion.
+Components (reference counterparts in parentheses):
+- ``HistoryCollector`` — watches the CR store and archives terminal CRs
+  + events + pod summaries (eventcollector).
+- ``history.collector.LogCollector`` / ``CoordinatorCollector`` — node
+  log dirs and coordinator job logs/metadata (logcollector).
+- ``HistoryServer`` — read-only replay API over the archive
+  (``pkg/historyserver/router.go``):
+
+  ``GET /api/history/clusters``                 summary rows (live view)
+  ``GET /api/history/{kind}``                   archived CRs of a kind
+  ``GET /api/history/{kind}/{ns}``              ... in a namespace
+  ``GET /api/history/{kind}/{ns}/{name}``       one CR + its events
+  ``GET /api/history/logs/{ns}/{cluster}``      log-file listing
+  ``GET /api/history/logs/{ns}/{cluster}/{path}`` log content (text)
+  ``GET /api/history/meta/{ns}/{cluster}``      archived metadata docs
+
+All storage goes through ``history.storage.StorageBackend`` — local
+directory, S3, or GCS (the reference's storage interface seam).
 """
 
 from __future__ import annotations
 
-import json
-import os
+import queue
+import threading
 import time
+import urllib.parse
 from http.server import ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
 
 from kuberay_tpu.controlplane.store import Event, ObjectStore
+from kuberay_tpu.history.storage import LocalStorage, StorageBackend
 from kuberay_tpu.utils.httpjson import JsonHandler
+
+__all__ = ["HistoryCollector", "HistoryServer", "LocalStorage",
+           "StorageBackend"]
 
 _ARCHIVED_KINDS = ("TpuCluster", "TpuJob", "TpuService", "TpuCronJob")
 
 
-class LocalStorage:
-    """Directory-backed archive (object-store layout: kind/ns/name.json)."""
+def _doc_key(kind: str, ns: str, name: str) -> str:
+    return f"{kind}/{ns}/{name}.json"
 
-    def __init__(self, root: str):
-        self.root = root
-        os.makedirs(root, exist_ok=True)
 
-    def put(self, kind: str, ns: str, name: str, doc: Dict[str, Any]):
-        d = os.path.join(self.root, kind, ns)
-        os.makedirs(d, exist_ok=True)
-        tmp = os.path.join(d, f".{name}.tmp")
-        with open(tmp, "w") as f:
-            json.dump(doc, f)
-        os.replace(tmp, os.path.join(d, f"{name}.json"))
-
-    def get(self, kind: str, ns: str, name: str) -> Optional[Dict[str, Any]]:
-        path = os.path.join(self.root, kind, ns, f"{name}.json")
-        try:
-            with open(path) as f:
-                return json.load(f)
-        except (OSError, json.JSONDecodeError):
-            return None
-
-    def list(self, kind: str, ns: Optional[str] = None) -> List[Dict[str, Any]]:
-        base = os.path.join(self.root, kind)
-        out = []
-        if not os.path.isdir(base):
-            return out
-        for namespace in sorted(os.listdir(base)):
-            if ns is not None and namespace != ns:
-                continue
-            d = os.path.join(base, namespace)
-            for fn in sorted(os.listdir(d)):
-                if fn.endswith(".json"):
-                    doc = self.get(kind, namespace, fn[:-5])
-                    if doc is not None:
-                        out.append(doc)
-        return out
+def list_docs(storage: StorageBackend, kind: str,
+              ns: Optional[str] = None) -> List[Dict[str, Any]]:
+    prefix = f"{kind}/{ns}/" if ns else f"{kind}/"
+    out = []
+    for key in storage.list(prefix):
+        if key.endswith(".json"):
+            doc = storage.get_doc(key)
+            if doc is not None:
+                out.append(doc)
+    return out
 
 
 class HistoryCollector:
     """Archives CR snapshots on every modification and enriches them with
-    events + pod summaries on deletion (the fsnotify-tailing collector
-    analogue, ref collector.go:23-60)."""
+    events + pod summaries on deletion (the event-collector analogue,
+    ref eventcollector.go).
 
-    def __init__(self, store: ObjectStore, storage: LocalStorage):
+    The store invokes watch callbacks while holding its lock, so the
+    callback only ENQUEUES; a worker thread does the storage I/O —
+    otherwise a slow S3/GCS endpoint would stall every store mutation
+    (API writes, all reconcilers) behind remote HTTP round-trips."""
+
+    def __init__(self, store: ObjectStore, storage: StorageBackend):
         self.store = store
         self.storage = storage
-        self._cancel = store.watch(self._on_event)
+        self._queue: "queue.Queue[Optional[Event]]" = queue.Queue()
+        self._worker = threading.Thread(target=self._drain, daemon=True,
+                                        name="history-collector")
+        self._worker.start()
+        self._cancel = store.watch(self._queue.put)
 
-    def close(self):
+    def close(self, timeout: float = 10.0):
+        """Stop watching and drain the queue (archive writes for events
+        already observed complete before close returns)."""
         self._cancel()
+        self._queue.put(None)
+        self._worker.join(timeout=timeout)
 
-    def _on_event(self, ev: Event):
+    def _drain(self):
+        while True:
+            ev = self._queue.get()
+            if ev is None:
+                return
+            try:
+                self._archive(ev)
+            except Exception:
+                pass   # storage hiccup: drop this snapshot, not the thread
+
+    def _archive(self, ev: Event):
         if ev.kind not in _ARCHIVED_KINDS:
             return
         md = ev.obj.get("metadata", {})
         ns, name = md.get("namespace", "default"), md.get("name", "")
         if not name:
             return
-        doc = self.storage.get(ev.kind, ns, name) or {}
+        key = _doc_key(ev.kind, ns, name)
+        doc = self.storage.get_doc(key) or {}
         doc.update({
             "kind": ev.kind,
             "metadata": md,
@@ -103,37 +118,106 @@ class HistoryCollector:
                 for e in self.store.list("Event", ns)
                 if e.get("involvedObject", {}).get("name") == name
                 and e.get("involvedObject", {}).get("kind") == ev.kind]
-        self.storage.put(ev.kind, ns, name, doc)
+            doc["pods"] = [
+                {"name": p["metadata"]["name"],
+                 "phase": p.get("status", {}).get("phase")}
+                for p in self.store.list("Pod", ns)
+                if p["metadata"].get("labels", {})
+                .get("tpu.dev/cluster") == name]
+        self.storage.put_doc(key, doc)
 
 
 class HistoryServer:
     """Read-only replay API over the archive (ref router.go's
     dashboard-compatible surface)."""
 
-    def __init__(self, storage: LocalStorage):
+    def __init__(self, storage: StorageBackend):
         self.storage = storage
 
+    # -- handlers (shared by the HTTP server and direct callers) -------
+
+    def clusters_summary(self) -> List[Dict[str, Any]]:
+        rows = []
+        for doc in list_docs(self.storage, "TpuCluster"):
+            md = doc.get("metadata", {})
+            rows.append({
+                "name": md.get("name"),
+                "namespace": md.get("namespace", "default"),
+                "state": doc.get("status", {}).get("state"),
+                "deleted": bool(doc.get("deleted")),
+                "archivedAt": doc.get("archivedAt"),
+            })
+        return rows
+
+    def log_files(self, ns: str, cluster: str) -> List[str]:
+        prefix = f"logs/{ns}/{cluster}/"
+        return [k[len(prefix):] for k in self.storage.list(prefix)]
+
+    def log_content(self, ns: str, cluster: str, rel: str) -> Optional[bytes]:
+        return self.storage.get(f"logs/{ns}/{cluster}/{rel}")
+
+    def meta_docs(self, ns: str, cluster: str) -> Dict[str, Any]:
+        prefix = f"meta/{ns}/{cluster}/"
+        out = {}
+        for k in self.storage.list(prefix):
+            doc = self.storage.get_doc(k)
+            if doc is not None:
+                out[k[len(prefix):]] = doc
+        return out
+
+    # -- routing (shared by the standalone server and the apiserver's
+    #    /api/history mount) ------------------------------------------
+
+    def route(self, path: str):
+        """Resolve a GET path.  Returns ``(code, body, is_text)`` for
+        history paths, or ``None`` if the path is not a history route."""
+        raw = urllib.parse.urlsplit(path).path
+        parts = [urllib.parse.unquote(p) for p in raw.split("/") if p]
+        if len(parts) < 3 or parts[:2] != ["api", "history"]:
+            return None
+        head = parts[2]
+        if head == "clusters" and len(parts) == 3:
+            return 200, {"items": self.clusters_summary()}, False
+        if head == "logs":
+            if len(parts) == 5:
+                return 200, {"files": self.log_files(parts[3],
+                                                     parts[4])}, False
+            if len(parts) > 5:
+                body = self.log_content(parts[3], parts[4],
+                                        "/".join(parts[5:]))
+                if body is None:
+                    return 404, {"message": "no such log"}, False
+                return 200, body.decode(errors="replace"), True
+            return 404, {"message": "unknown path"}, False
+        if head == "meta" and len(parts) == 5:
+            return 200, self.meta_docs(parts[3], parts[4]), False
+        kind = head
+        if kind not in _ARCHIVED_KINDS:
+            return 404, {"message": "unknown kind"}, False
+        if len(parts) == 3:
+            return 200, {"items": list_docs(self.storage, kind)}, False
+        if len(parts) == 4:
+            return 200, {"items": list_docs(self.storage, kind,
+                                            parts[3])}, False
+        doc = self.storage.get_doc(_doc_key(kind, parts[3], parts[4]))
+        if doc is None:
+            return 404, {"message": "not archived"}, False
+        return 200, doc, False
+
+    # -- HTTP ----------------------------------------------------------
+
     def make_server(self, host="127.0.0.1", port=0) -> ThreadingHTTPServer:
-        storage = self.storage
+        hs = self
 
         class Handler(JsonHandler):
             def do_GET(self):
-                parts = [p for p in self.path.split("/") if p]
-                # /api/history/{kind}[/{ns}[/{name}]]
-                if len(parts) >= 3 and parts[:2] == ["api", "history"]:
-                    kind = parts[2]
-                    if kind not in _ARCHIVED_KINDS:
-                        return self._send(404, {"message": "unknown kind"})
-                    if len(parts) == 3:
-                        return self._send(200, {"items": storage.list(kind)})
-                    if len(parts) == 4:
-                        return self._send(
-                            200, {"items": storage.list(kind, parts[3])})
-                    doc = storage.get(kind, parts[3], parts[4])
-                    if doc is None:
-                        return self._send(404, {"message": "not archived"})
-                    return self._send(200, doc)
-                return self._send(404, {"message": "unknown path"})
+                r = hs.route(self.path)
+                if r is None:
+                    return self._send(404, {"message": "unknown path"})
+                code, body, is_text = r
+                if is_text:
+                    return self._send_text(code, body)
+                return self._send(code, body)
 
         return ThreadingHTTPServer((host, port), Handler)
 

@@ -90,12 +90,20 @@ class LocalStorage(StorageBackend):
         try:
             with open(self._path(key), "rb") as f:
                 return f.read()
-        except OSError:
+        except (OSError, ValueError):   # ValueError: traversal key -> miss
             return None
 
     def list(self, prefix: str = "") -> List[str]:
+        # Walk only the subtree the prefix maps to — the key layout IS
+        # the directory layout, so a kind/namespace listing must not
+        # stat the (much larger) log archive.
+        subdir, _, _tail = prefix.rpartition("/")
+        try:
+            base = self._path(subdir) if subdir else self.root
+        except ValueError:
+            return []
         out = []
-        for dirpath, _dirs, files in os.walk(self.root):
+        for dirpath, _dirs, files in os.walk(base):
             for fn in files:
                 if fn.endswith(".tmp"):
                     continue
@@ -108,7 +116,7 @@ class LocalStorage(StorageBackend):
     def delete(self, key: str) -> None:
         try:
             os.remove(self._path(key))
-        except OSError:
+        except (OSError, ValueError):
             pass
 
 
@@ -140,7 +148,10 @@ def sigv4_headers(method: str, url: str, region: str, service: str,
     datestamp = now.strftime("%Y%m%d")
     payload_hash = _sha256(payload)
 
-    canonical_uri = urllib.parse.quote(parsed.path or "/", safe="/-_.~")
+    # The URL path arrives ALREADY percent-encoded (S3Storage._url quotes
+    # keys); the SigV4 canonical URI is that once-encoded path verbatim —
+    # re-quoting would double-encode '%' and mismatch AWS's signature.
+    canonical_uri = parsed.path or "/"
     # Canonical query: sorted, URL-encoded pairs.
     q = urllib.parse.parse_qsl(parsed.query, keep_blank_values=True)
     canonical_query = "&".join(

@@ -123,6 +123,12 @@ class Operator:
             self._netpol_watch()
 
         self.kubelet = FakeKubelet(self.store) if fake_kubelet else None
+        self.history_collector = None
+        if self.config.historyArchiveURL:
+            from kuberay_tpu.history.server import HistoryCollector
+            from kuberay_tpu.history.storage import backend_from_url
+            self.history_collector = HistoryCollector(
+                self.store, backend_from_url(self.config.historyArchiveURL))
         self._stop = threading.Event()
         self.apiserver = None
         self.api_url = ""
@@ -156,8 +162,13 @@ class Operator:
         'ray-operator-leader') — reconcilers only run while this replica
         holds the Lease; the API server always serves (reads are safe).
         """
+        history = None
+        if self.history_collector is not None:
+            from kuberay_tpu.history.server import HistoryServer
+            history = HistoryServer(self.history_collector.storage)
         self.apiserver, self.api_url = serve_background(
-            self.store, api_host, api_port, metrics=self.metrics)
+            self.store, api_host, api_port, metrics=self.metrics,
+            history=history)
         if leader_election:
             self.elector = LeaderElector(
                 self.store,
@@ -239,6 +250,8 @@ class Operator:
         self._stop_reconcilers()
         if self.elector is not None:
             self.elector.stop()
+        if self.history_collector is not None:
+            self.history_collector.close()
         if self.apiserver is not None:
             self.apiserver.shutdown()
 
@@ -281,9 +294,15 @@ def main(argv=None):
     ap.add_argument("--journal", default="",
                     help="journal file for durable standalone state "
                          "(CRs survive operator restarts)")
+    ap.add_argument("--history-archive", default="",
+                    help="archive CR lifecycles for the history server: "
+                         "file:///path | s3://bucket?endpoint=... | "
+                         "gs://bucket?endpoint=...")
     args = ap.parse_args(argv)
 
     cfg = load_config(args.config) if args.config else OperatorConfiguration()
+    if args.history_archive:
+        cfg.historyArchiveURL = args.history_archive
     if args.batch_scheduler:
         cfg.batchScheduler = args.batch_scheduler
         cfg.enableBatchScheduler = True

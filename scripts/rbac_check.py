@@ -68,6 +68,25 @@ def granted() -> set:
     return out
 
 
+def chart_granted() -> set:
+    """Grants from the Helm chart's operator ClusterRole (rendered with
+    scripts/render_chart.py — the helm-template analogue of the
+    reference's helm/kustomize rbac-check comparison)."""
+    sys.path.insert(0, str(REPO / "scripts"))
+    from render_chart import render_chart
+    out = set()
+    for doc in render_chart(str(REPO / "helm-chart/kuberay-tpu-operator")):
+        if doc.get("kind") != "ClusterRole" or \
+                "editor" in doc["metadata"]["name"] or \
+                "viewer" in doc["metadata"]["name"]:
+            continue
+        for rule in doc.get("rules", []):
+            for res in rule.get("resources", []):
+                for g in rule.get("apiGroups", []):
+                    out.add((g, res.split("/")[0]))
+    return out
+
+
 def main() -> int:
     grants = granted()
     missing = []
@@ -80,7 +99,16 @@ def main() -> int:
         for m in missing:
             print(f"  - {m}")
         return 1
-    print(f"rbac ok: {len(used_kinds())} kinds covered")
+    # Chart and raw manifest must grant the SAME operator permissions —
+    # drift between the two install paths is the failure mode the
+    # reference's rbac-check exists for.
+    drift = grants.symmetric_difference(chart_granted())
+    if drift:
+        print("RBAC DRIFT between manifests/operator.yaml and helm chart:")
+        for g, r in sorted(drift):
+            print(f"  - {g or 'core'}/{r}")
+        return 1
+    print(f"rbac ok: {len(used_kinds())} kinds covered; chart == manifest")
     return 0
 
 

@@ -1,0 +1,376 @@
+#!/usr/bin/env python
+"""Render a Helm chart without helm: a deliberate SUBSET of the Go
+template language covering what helm-chart/kuberay-tpu-operator uses.
+
+    python scripts/render_chart.py helm-chart/kuberay-tpu-operator \
+        [--set key.path=value ...] [--values extra.yaml] \
+        [--release NAME] [--namespace NS]
+
+Supported constructs (anything else raises, so chart edits that stray
+outside the subset fail loudly in CI instead of silently mis-rendering):
+  {{ .Values.a.b }}  {{ .Release.Name }}  {{ .Release.Namespace }}
+  {{ .Chart.Name }}  {{ . }}  {{ $.Values.a }}
+  pipelines: | default X   | quote   | toJson   | toYaml   | nindent N
+             | indent N
+  calls: (list "a" "b"), not EXPR, eq A B
+  blocks: {{- if EXPR }} ... {{- else }} ... {{- end }}
+          {{- range EXPR }} ... {{- end }}
+  whitespace control: {{- and -}}
+
+The rbac-check test renders the chart and compares its RBAC rules with
+manifests/operator.yaml (the reference's helm/kustomize rbac-check
+role, scripts/rbac-check.py, reimplemented for this repo's layout).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+import yaml
+
+_TOKEN = re.compile(r"\{\{-?\s*(.*?)\s*-?\}\}", re.S)
+
+
+class ChartError(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Expression evaluation
+
+
+def _split_pipeline(expr: str) -> List[str]:
+    """Split on | outside quotes/parens."""
+    parts, depth, quote, cur = [], 0, "", []
+    for ch in expr:
+        if quote:
+            cur.append(ch)
+            if ch == quote:
+                quote = ""
+            continue
+        if ch in "\"'":
+            quote = ch
+            cur.append(ch)
+        elif ch == "(":
+            depth += 1
+            cur.append(ch)
+        elif ch == ")":
+            depth -= 1
+            cur.append(ch)
+        elif ch == "|" and depth == 0:
+            parts.append("".join(cur).strip())
+            cur = []
+        else:
+            cur.append(ch)
+    parts.append("".join(cur).strip())
+    return [p for p in parts if p]
+
+
+def _split_args(s: str) -> List[str]:
+    """Split call args on spaces outside quotes/parens."""
+    out, depth, quote, cur = [], 0, "", []
+    for ch in s:
+        if quote:
+            cur.append(ch)
+            if ch == quote:
+                quote = ""
+            continue
+        if ch in "\"'":
+            quote = ch
+            cur.append(ch)
+        elif ch == "(":
+            depth += 1
+            cur.append(ch)
+        elif ch == ")":
+            depth -= 1
+            cur.append(ch)
+        elif ch.isspace() and depth == 0:
+            if cur:
+                out.append("".join(cur))
+                cur = []
+        else:
+            cur.append(ch)
+    if cur:
+        out.append("".join(cur))
+    return out
+
+
+class Context:
+    def __init__(self, root: Dict[str, Any], dot: Any):
+        self.root = root
+        self.dot = dot
+
+    def resolve(self, path: str) -> Any:
+        if path == ".":
+            return self.dot
+        if path.startswith("$."):
+            base, rest = self.root, path[2:]
+        elif path.startswith("."):
+            base, rest = self.dot if isinstance(self.dot, dict) else self.root, \
+                path[1:]
+            # Top-level names (.Values/.Release/.Chart) always root-resolve.
+            if rest.split(".")[0] in ("Values", "Release", "Chart"):
+                base = self.root
+        else:
+            raise ChartError(f"unsupported reference: {path}")
+        cur: Any = base
+        for part in rest.split("."):
+            if not part:
+                continue
+            if isinstance(cur, dict) and part in cur:
+                cur = cur[part]
+            else:
+                return None
+        return cur
+
+    def eval(self, expr: str) -> Any:
+        segs = _split_pipeline(expr)
+        val = self._eval_atom(segs[0])
+        for flt in segs[1:]:
+            val = self._apply_filter(val, flt)
+        return val
+
+    def _eval_atom(self, atom: str) -> Any:
+        atom = atom.strip()
+        if atom.startswith("(") and atom.endswith(")"):
+            return self.eval(atom[1:-1])
+        if atom.startswith('"') and atom.endswith('"'):
+            return atom[1:-1]
+        if atom.startswith("'") and atom.endswith("'"):
+            return atom[1:-1]
+        if re.fullmatch(r"-?\d+", atom):
+            return int(atom)
+        if atom in ("true", "false"):
+            return atom == "true"
+        args = _split_args(atom)
+        if len(args) > 1:
+            fn = args[0]
+            vals = [self._eval_atom(a) for a in args[1:]]
+            if fn == "list":
+                return vals
+            if fn == "not":
+                return not _truthy(vals[0])
+            if fn == "eq":
+                return vals[0] == vals[1]
+            if fn in ("toYaml", "toJson", "quote"):
+                # Call form of the single-arg filters: toYaml X == X|toYaml
+                return self._apply_filter(vals[0], fn)
+            raise ChartError(f"unsupported call: {atom}")
+        if atom.startswith(".") or atom.startswith("$."):
+            return self.resolve(atom)
+        raise ChartError(f"unsupported atom: {atom}")
+
+    def _apply_filter(self, val: Any, flt: str) -> Any:
+        args = _split_args(flt)
+        name, rest = args[0], args[1:]
+        if name == "default":
+            dflt = self._eval_atom(rest[0])
+            return val if _truthy(val) else dflt
+        if name == "quote":
+            return json.dumps("" if val is None else str(val))
+        if name == "toJson":
+            return json.dumps(val if val is not None else None)
+        if name == "toYaml":
+            return yaml.safe_dump(val, default_flow_style=False).rstrip("\n")
+        if name == "nindent":
+            n = int(rest[0])
+            pad = " " * n
+            text = "" if val is None else str(val)
+            return "\n" + "\n".join(pad + ln for ln in text.split("\n"))
+        if name == "indent":
+            n = int(rest[0])
+            pad = " " * n
+            text = "" if val is None else str(val)
+            return "\n".join(pad + ln for ln in text.split("\n"))
+        raise ChartError(f"unsupported filter: {flt}")
+
+
+def _truthy(v: Any) -> bool:
+    if v is None or v is False:
+        return False
+    if isinstance(v, (dict, list, str)) and len(v) == 0:
+        return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# Template parsing: text/action token stream -> nested blocks
+
+
+def _tokenize(src: str) -> List[Tuple[str, str]]:
+    """Yields ("text", s) and ("action", expr) with whitespace control
+    applied ({{- trims preceding whitespace, -}} trims following —
+    Go template semantics)."""
+    out: List[Tuple[str, str]] = []
+    pos = 0
+    trim_next_left = False
+    for m in _TOKEN.finditer(src):
+        text = src[pos:m.start()]
+        if trim_next_left:
+            text = text.lstrip()
+            trim_next_left = False
+        raw = src[m.start():m.end()]
+        if raw.startswith("{{-"):
+            text = text.rstrip()
+        out.append(("text", text))
+        out.append(("action", m.group(1).strip()))
+        pos = m.end()
+        if raw.endswith("-}}"):
+            trim_next_left = True
+    tail = src[pos:]
+    if trim_next_left:
+        tail = tail.lstrip()
+    out.append(("text", tail))
+    return out
+
+
+def _skip_block(tokens: List[Tuple[str, str]], i: int,
+                stop=("end",)) -> Tuple[str, int]:
+    """Find the matching end of a block WITHOUT evaluating (used to skip
+    the body of an empty range).  Returns (stop_word, index_of_stop)."""
+    depth = 0
+    while i < len(tokens):
+        kind, body = tokens[i]
+        if kind == "action":
+            word = body.split(None, 1)[0] if body else ""
+            if word in ("if", "range"):
+                depth += 1
+            elif word == "end":
+                if depth == 0 and "end" in stop:
+                    return "end", i
+                depth -= 1
+            elif word == "else" and depth == 0 and "else" in stop:
+                return "else", i
+        i += 1
+    raise ChartError("unterminated block")
+
+
+def _render_tokens(tokens: List[Tuple[str, str]], ctx: Context,
+                   i: int = 0, stop=("end",)) -> Tuple[str, int]:
+    out: List[str] = []
+    while i < len(tokens):
+        kind, body = tokens[i]
+        if kind == "text":
+            out.append(body)
+            i += 1
+            continue
+        if body.startswith("/*") or body.startswith("#"):
+            i += 1
+            continue
+        word = body.split(None, 1)[0] if body else ""
+        if word in stop:
+            return "".join(out), i
+        if word == "if":
+            cond = ctx.eval(body[2:].strip())
+            inner, i = _render_tokens(tokens, ctx, i + 1, ("end", "else"))
+            if tokens[i][1].split(None, 1)[0] == "else":
+                alt, i = _render_tokens(tokens, ctx, i + 1, ("end",))
+            else:
+                alt = ""
+            out.append(inner if _truthy(cond) else alt)
+            i += 1          # past end
+            continue
+        if word == "range":
+            seq = ctx.eval(body[5:].strip()) or []
+            start = i + 1
+            rendered = []
+            _, end_i = _skip_block(tokens, start, ("end",))
+            for item in seq:
+                sub = Context(ctx.root, item)
+                text, _ = _render_tokens(tokens, sub, start, ("end",))
+                rendered.append(text)
+            out.append("".join(rendered))
+            i = end_i + 1
+            continue
+        val = ctx.eval(body)
+        out.append("" if val is None else
+                   val if isinstance(val, str) else
+                   json.dumps(val) if isinstance(val, (dict, list))
+                   else str(val).lower() if isinstance(val, bool)
+                   else str(val))
+        i += 1
+    return "".join(out), i
+
+
+def render_template(src: str, values: Dict[str, Any],
+                    release: str, namespace: str,
+                    chart_name: str) -> str:
+    root = {"Values": values,
+            "Release": {"Name": release, "Namespace": namespace},
+            "Chart": {"Name": chart_name}}
+    tokens = _tokenize(src)
+    text, _ = _render_tokens(tokens, Context(root, root))
+    return text
+
+
+def _deep_merge(base: Dict[str, Any], over: Dict[str, Any]) -> Dict[str, Any]:
+    out = dict(base)
+    for k, v in over.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+def _set_path(values: Dict[str, Any], dotted: str, raw: str):
+    parts = dotted.split(".")
+    cur = values
+    for p in parts[:-1]:
+        cur = cur.setdefault(p, {})
+    cur[parts[-1]] = yaml.safe_load(raw)
+
+
+def render_chart(chart_dir: str, overrides: Optional[Dict[str, Any]] = None,
+                 sets: Optional[List[str]] = None,
+                 release: str = "kuberay-tpu-operator",
+                 namespace: str = "default") -> List[Dict[str, Any]]:
+    """Render all templates; returns the parsed manifest documents."""
+    with open(os.path.join(chart_dir, "Chart.yaml")) as f:
+        chart = yaml.safe_load(f)
+    with open(os.path.join(chart_dir, "values.yaml")) as f:
+        values = yaml.safe_load(f) or {}
+    if overrides:
+        values = _deep_merge(values, overrides)
+    for s in sets or []:
+        k, _, v = s.partition("=")
+        _set_path(values, k, v)
+    docs: List[Dict[str, Any]] = []
+    tdir = os.path.join(chart_dir, "templates")
+    for fn in sorted(os.listdir(tdir)):
+        if not fn.endswith((".yaml", ".yml")):
+            continue
+        with open(os.path.join(tdir, fn)) as f:
+            text = render_template(f.read(), values, release, namespace,
+                                   chart["name"])
+        for doc in yaml.safe_load_all(text):
+            if doc:
+                docs.append(doc)
+    return docs
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("chart_dir")
+    ap.add_argument("--set", action="append", default=[])
+    ap.add_argument("--values")
+    ap.add_argument("--release", default="kuberay-tpu-operator")
+    ap.add_argument("--namespace", default="default")
+    args = ap.parse_args(argv)
+    overrides = None
+    if args.values:
+        with open(args.values) as f:
+            overrides = yaml.safe_load(f)
+    docs = render_chart(args.chart_dir, overrides, args.set,
+                        args.release, args.namespace)
+    print(yaml.safe_dump_all(docs, default_flow_style=False, sort_keys=False))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

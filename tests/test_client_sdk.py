@@ -1,0 +1,160 @@
+"""Python SDK: builder/director presets, spec-surgery utils, typed APIs
+with wait-helpers driven against a live operator (ref
+clients/python-client tests + kuberay_cluster_builder.py examples)."""
+
+import threading
+
+import pytest
+
+from kuberay_tpu.api.config import OperatorConfiguration
+from kuberay_tpu.client import (
+    ApiClient,
+    ClusterBuilder,
+    Director,
+    TpuClusterApi,
+    TpuJobApi,
+    WaitTimeout,
+    utils,
+)
+from kuberay_tpu.operator import Operator
+from kuberay_tpu.utils.validation import validate_cluster
+from kuberay_tpu.api.tpucluster import TpuCluster
+
+
+# ---------------------------------------------------------------------------
+# Builder / director (no server needed)
+
+
+def test_builder_fluent_build():
+    doc = (ClusterBuilder()
+           .with_meta("b1", labels={"team": "ml"})
+           .with_head(image="img:1", env={"A": "1"}, enable_ingress=True)
+           .with_worker_group("w", "v5e", "4x4", 2, image="img:1")
+           .with_autoscaling(1, 4)
+           .build())
+    assert doc["kind"] == "TpuCluster"
+    assert doc["metadata"]["labels"] == {"team": "ml"}
+    assert doc["spec"]["headGroupSpec"]["enableIngress"] is True
+    g = doc["spec"]["workerGroupSpecs"][0]
+    assert (g["numSlices"], g["tpuVersion"], g["topology"]) == (2, "v5e", "4x4")
+    assert doc["spec"]["autoscalerOptions"] == {"minSlices": 1, "maxSlices": 4}
+    # Build output passes the admission validator.
+    assert validate_cluster(TpuCluster.from_dict(doc)) == []
+
+
+def test_builder_rejects_bad_topology():
+    with pytest.raises(ValueError):
+        ClusterBuilder().with_meta("x").with_worker_group(
+            "w", "v5e", "3x5", 1)
+
+
+def test_builder_requires_name():
+    with pytest.raises(ValueError):
+        ClusterBuilder().with_head().build()
+
+
+def test_director_presets_validate():
+    d = Director()
+    for doc in (d.build_basic_cluster("a"), d.build_small_cluster("b"),
+                d.build_medium_cluster("c"), d.build_large_cluster("d")):
+        assert validate_cluster(TpuCluster.from_dict(doc)) == [], doc["metadata"]
+    large = d.build_large_cluster("d")
+    g = large["spec"]["workerGroupSpecs"][0]
+    assert (g["tpuVersion"], g["numSlices"]) == ("v6e", 4)
+
+
+def test_spec_surgery_utils():
+    doc = Director().build_small_cluster("s")
+    doc = utils.duplicate_worker_group(doc, "workers", "workers-b")
+    assert [g["groupName"] for g in doc["spec"]["workerGroupSpecs"]] == \
+        ["workers", "workers-b"]
+    doc = utils.update_worker_group_slices(doc, "workers-b", 3)
+    assert doc["spec"]["workerGroupSpecs"][1]["numSlices"] == 3
+    doc = utils.delete_worker_group(doc, "workers")
+    assert [g["groupName"] for g in doc["spec"]["workerGroupSpecs"]] == \
+        ["workers-b"]
+    with pytest.raises(KeyError):
+        utils.delete_worker_group(doc, "nope")
+    with pytest.raises(ValueError):
+        utils.duplicate_worker_group(doc, "workers-b", "workers-b")
+
+
+# ---------------------------------------------------------------------------
+# Typed APIs against a live operator
+
+
+@pytest.fixture()
+def live_op():
+    from kuberay_tpu.runtime.coordinator_client import FakeCoordinatorClient
+
+    coord = FakeCoordinatorClient()
+    op = Operator(OperatorConfiguration(), fake_kubelet=True,
+                  client_provider=lambda _status: coord)
+    op.start(leader_election=False)
+    stop = threading.Event()
+
+    def pump():   # drive reconciles + fake kubelet while tests wait;
+        # auto-advance submitted jobs PENDING -> RUNNING -> SUCCEEDED
+        # (the fake coordinator's driver stand-in)
+        while not stop.is_set():
+            op.run_until_idle()
+            for info in coord.list_jobs():
+                if info.status == "PENDING":
+                    coord.set_job_status(info.job_id, "RUNNING")
+                elif info.status == "RUNNING":
+                    coord.set_job_status(info.job_id, "SUCCEEDED")
+            stop.wait(0.05)
+
+    t = threading.Thread(target=pump, daemon=True)
+    t.start()
+    try:
+        yield op
+    finally:
+        stop.set()
+        t.join(timeout=5)
+        op.stop()
+
+
+def test_cluster_api_lifecycle(live_op):
+    api = ApiClient(live_op.api_url)
+    clusters = TpuClusterApi(api)
+    clusters.create(Director().build_small_cluster("sdk-c1"))
+    status = clusters.wait_until_ready("sdk-c1", timeout=60)
+    assert status["state"] == "ready"
+
+    clusters.scale_worker_group("sdk-c1", "workers", 2)
+    assert clusters.get("sdk-c1")["spec"]["workerGroupSpecs"][0][
+        "numSlices"] == 2
+
+    clusters.suspend("sdk-c1")
+    assert clusters._wait("sdk-c1", "default",
+                          lambda s: s.get("state") == "suspended",
+                          30, 0.2, "suspended")["state"] == "suspended"
+    clusters.resume("sdk-c1")
+    assert clusters.wait_until_ready("sdk-c1", timeout=60)["state"] == "ready"
+
+    assert clusters.delete("sdk-c1") is True
+    assert clusters.delete("sdk-c1") is False   # already gone
+
+
+def test_job_api_submit_and_wait(live_op):
+    api = ApiClient(live_op.api_url)
+    jobs = TpuJobApi(api)
+    jobs.submit(Director().build_job("sdk-j1", "python train.py",
+                                     submission_mode="HTTPMode"))
+    status = jobs.wait_until_running("sdk-j1", timeout=60)
+    assert status["jobDeploymentStatus"] in ("Running", "Complete")
+    status = jobs.wait_until_finished("sdk-j1", timeout=120)
+    assert status["jobDeploymentStatus"] == "Complete"
+    assert jobs.succeeded("sdk-j1")
+
+
+def test_wait_timeout_carries_status(live_op):
+    api = ApiClient(live_op.api_url)
+    clusters = TpuClusterApi(api)
+    doc = Director().build_small_cluster("sdk-slow")
+    doc["spec"]["suspend"] = True          # will never reach ready
+    clusters.create(doc)
+    with pytest.raises(WaitTimeout) as ei:
+        clusters.wait_until_ready("sdk-slow", timeout=1.2, poll=0.2)
+    assert isinstance(ei.value.last_status, dict)

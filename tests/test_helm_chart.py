@@ -1,0 +1,98 @@
+"""Helm chart rendering + RBAC consistency (ref helm-chart/
+kuberay-operator + scripts/rbac-check.py).  Rendered with the in-repo
+subset renderer so CI needs no helm binary; the chart itself is
+standard helm syntax."""
+
+import json
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+CHART = str(REPO / "helm-chart/kuberay-tpu-operator")
+
+sys.path.insert(0, str(REPO / "scripts"))
+from render_chart import ChartError, render_chart, render_template  # noqa: E402
+
+
+def by_kind(docs, kind):
+    return [d for d in docs if d.get("kind") == kind]
+
+
+def test_default_render_shape():
+    docs = render_chart(CHART, namespace="kuberay-tpu-system")
+    kinds = sorted({d["kind"] for d in docs})
+    assert kinds == ["ClusterRole", "ClusterRoleBinding", "ConfigMap",
+                     "Deployment", "Role", "RoleBinding", "Service",
+                     "ServiceAccount"]
+    dep = by_kind(docs, "Deployment")[0]
+    assert dep["metadata"]["namespace"] == "kuberay-tpu-system"
+    c = dep["spec"]["template"]["spec"]["containers"][0]
+    assert c["image"] == "registry.local/kuberay-tpu/operator:latest"
+    assert "--leader-election" in c["args"]
+    # ConfigMap payload is valid operator config JSON.
+    cm = by_kind(docs, "ConfigMap")[0]
+    cfg = json.loads(cm["data"]["config.json"])
+    assert cfg["enableLeaderElection"] is True
+    # Leader election needs the Lease role.
+    role = by_kind(docs, "Role")[0]
+    assert any("leases" in r.get("resources", []) for r in role["rules"])
+
+
+def test_namespaced_mode_swaps_clusterrole_for_roles():
+    docs = render_chart(CHART, sets=["watchNamespaces=[team-a,team-b]"])
+    operator_croles = [d for d in by_kind(docs, "ClusterRole")
+                       if "editor" not in d["metadata"]["name"]
+                       and "viewer" not in d["metadata"]["name"]]
+    assert operator_croles == []
+    roles = [d for d in by_kind(docs, "Role")
+             if "leader-election" not in d["metadata"]["name"]]
+    assert sorted(r["metadata"]["namespace"] for r in roles) == \
+        ["team-a", "team-b"]
+
+
+def test_toggles():
+    docs = render_chart(CHART, sets=["metrics.serviceMonitor.enabled=true"])
+    assert len(by_kind(docs, "ServiceMonitor")) == 1
+    docs = render_chart(CHART, sets=["metrics.enabled=false"])
+    svc = by_kind(docs, "Service")[0]
+    assert [p["name"] for p in svc["spec"]["ports"]] == ["api"]
+    docs = render_chart(CHART, sets=["serviceAccount.create=false"])
+    assert by_kind(docs, "ServiceAccount") == []
+    docs = render_chart(CHART, sets=["leaderElection.enabled=false",
+                                     "historyArchiveURL=s3://arch"])
+    dep = by_kind(docs, "Deployment")[0]
+    args = dep["spec"]["template"]["spec"]["containers"][0]["args"]
+    assert "--leader-election" not in args
+    assert "--history-archive=s3://arch" in args
+
+
+def test_editor_viewer_roles_per_kind():
+    docs = render_chart(CHART)
+    names = {d["metadata"]["name"] for d in by_kind(docs, "ClusterRole")}
+    for kind in ("tpujob", "tpuservice", "tpucronjob", "tpucluster"):
+        assert f"{kind}-editor" in names and f"{kind}-viewer" in names
+
+
+def test_renderer_rejects_unsupported_syntax():
+    with pytest.raises(ChartError):
+        render_template("{{ lookup \"v1\" \"Pod\" }}", {}, "r", "ns", "c")
+
+
+def test_rbac_check_passes():
+    out = subprocess.run(
+        [sys.executable, str(REPO / "scripts/rbac_check.py")],
+        capture_output=True, text=True)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "chart == manifest" in out.stdout
+
+
+def test_crds_shipped_with_chart():
+    chart_crds = sorted(p.name for p in
+                        (REPO / "helm-chart/kuberay-tpu-operator/crds")
+                        .glob("*.yaml"))
+    base_crds = sorted(p.name for p in
+                       (REPO / "config/crd/bases").glob("*.yaml"))
+    assert chart_crds == base_crds and len(chart_crds) >= 6

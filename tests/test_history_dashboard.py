@@ -37,20 +37,20 @@ def test_collector_archives_lifecycle(tmp_path):
         "eventTime": 1.0,
     })
     store.delete(C.KIND_CLUSTER, "archived")
+    collector.close()   # drains the async archive queue
 
-    doc = storage.get(C.KIND_CLUSTER, "default", "archived")
+    doc = storage.get_doc(f"{C.KIND_CLUSTER}/default/archived.json")
     assert doc is not None
     assert doc["deleted"] is True
     assert doc["status"]["state"] == "ready"    # last status preserved
     assert any(e["reason"] == "CreatedSlice" for e in doc["events"])
-    collector.close()
 
 
 def test_history_server_replay(tmp_path):
     storage = LocalStorage(str(tmp_path / "history"))
-    storage.put(C.KIND_JOB, "default", "old-job",
-                {"kind": C.KIND_JOB, "metadata": {"name": "old-job"},
-                 "status": {"jobDeploymentStatus": "Complete"}})
+    storage.put_doc(f"{C.KIND_JOB}/default/old-job.json",
+                    {"kind": C.KIND_JOB, "metadata": {"name": "old-job"},
+                     "status": {"jobDeploymentStatus": "Complete"}})
     srv, url = HistoryServer(storage).serve_background()
     try:
         items = json.load(urllib.request.urlopen(
@@ -74,6 +74,70 @@ def test_dashboard_served():
         assert "TpuClusters" in html and "tpuclusters" in html
     finally:
         srv.shutdown()
+
+
+def test_apiserver_mounts_history(tmp_path):
+    """The dashboard's history views read /api/history from the SAME
+    apiserver endpoint (ref dashboard/src/app/history)."""
+    from kuberay_tpu.apiserver.server import serve_background
+
+    store = ObjectStore()
+    storage = LocalStorage(str(tmp_path / "arch"))
+    collector = HistoryCollector(store, storage)
+    store.create(make_cluster(name="mounted").to_dict())
+    store.delete(C.KIND_CLUSTER, "mounted")
+    collector.close()
+
+    srv, url = serve_background(store, history=HistoryServer(storage))
+    try:
+        rows = json.load(urllib.request.urlopen(
+            f"{url}/api/history/clusters"))["items"]
+        assert rows[0]["name"] == "mounted" and rows[0]["deleted"]
+        doc = json.load(urllib.request.urlopen(
+            f"{url}/api/history/TpuCluster/default/mounted"))
+        assert doc["deleted"] is True
+    finally:
+        srv.shutdown()
+
+
+def test_dashboard_create_job_flow():
+    """POST the exact document shape the dashboard's New form builds and
+    watch the operator drive it (ref dashboard/src/app/new)."""
+    from kuberay_tpu.api.config import OperatorConfiguration
+    from kuberay_tpu.operator import Operator
+
+    op = Operator(OperatorConfiguration(), fake_kubelet=True)
+    op.start(leader_election=False)
+    try:
+        doc = {
+            "apiVersion": "tpu.dev/v1", "kind": "TpuJob",
+            "metadata": {"name": "from-form", "namespace": "default"},
+            "spec": {
+                "entrypoint": "python -m kuberay_tpu.train.launcher",
+                "shutdownAfterJobFinishes": True,
+                "clusterSpec": {
+                    "headGroupSpec": {"template": {"spec": {"containers": [
+                        {"name": "head", "image": "tpu-trainer:latest"}]}}},
+                    "workerGroupSpecs": [{
+                        "groupName": "workers", "numSlices": 1,
+                        "tpuVersion": "v5e", "topology": "2x4",
+                        "template": {"spec": {"containers": [
+                            {"name": "worker",
+                             "image": "tpu-trainer:latest"}]}}}],
+                },
+            },
+        }
+        req = urllib.request.Request(
+            f"{op.api_url}/apis/tpu.dev/v1/namespaces/default/tpujobs",
+            data=json.dumps(doc).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        assert urllib.request.urlopen(req).status in (200, 201)
+        for _ in range(30):
+            op.run_until_idle()
+        job = op.store.get(C.KIND_JOB, "from-form")
+        assert job["status"].get("jobDeploymentStatus") not in (None, "New")
+    finally:
+        op.stop()
 
 
 def test_all_samples_validate_and_provision():
