@@ -1,0 +1,51 @@
+"""JSON-Schema generation from the typed API dataclasses — packaged so
+the apiserver can build its OpenAPI contract without a source checkout
+(scripts/gen_schema.py and scripts/gen_openapi.py are thin wrappers)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+
+def schema_for(cls, seen=None) -> Dict[str, Any]:
+    seen = seen or set()
+    if cls in seen:
+        return {"type": "object"}   # cycle guard
+    seen = seen | {cls}
+    props = {}
+    nested = cls._nested_types() if hasattr(cls, "_nested_types") else {}
+    for f in dataclasses.fields(cls):
+        t = f.type if isinstance(f.type, str) else getattr(
+            f.type, "__name__", str(f.type))
+        nt = nested.get(f.name)
+        if nt is not None:
+            inner = schema_for(nt, seen)
+            if "List" in str(t) or "list" in str(t):
+                props[f.name] = {"type": "array", "items": inner}
+            else:
+                props[f.name] = inner
+        elif "int" in str(t):
+            props[f.name] = {"type": "integer"}
+        elif "float" in str(t):
+            props[f.name] = {"type": "number"}
+        elif "bool" in str(t):
+            props[f.name] = {"type": "boolean"}
+        elif "Dict" in str(t) or "dict" in str(t):
+            props[f.name] = {"type": "object"}
+        elif "List" in str(t) or "list" in str(t):
+            props[f.name] = {"type": "array"}
+        else:
+            props[f.name] = {"type": "string"}
+    return {"type": "object", "properties": props}
+
+
+def crd_schema(cls) -> Dict[str, Any]:
+    """Full document for one CRD kind (what docs/crds/*.schema.json hold)."""
+    return {
+        "$schema": "https://json-schema.org/draft/2020-12/schema",
+        "title": cls.__name__,
+        "description": (cls.__doc__ or "").strip().splitlines()[0]
+        if cls.__doc__ else "",
+        **schema_for(cls),
+    }

@@ -1,0 +1,178 @@
+"""OpenAPI 3.0 spec for the REST apiserver, built from the typed API
+dataclasses (the typed client contract that closes the reference's
+proto/grpc role — ARCHITECTURE.md "API surface: REST, not gRPC";
+ref proto/cluster.proto + apiserver/cmd/main.go:97-147).
+
+Packaged (not a script) so a pip-installed operator serves
+``/openapi.json`` without a source checkout; ``scripts/gen_openapi.py``
+wraps :func:`build_spec` to write ``docs/openapi.json`` for CI."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from kuberay_tpu.api.schema import crd_schema
+
+STATUS_SCHEMA = {
+    "type": "object",
+    "description": "K8s Status object returned on errors",
+    "properties": {
+        "kind": {"type": "string"}, "status": {"type": "string"},
+        "code": {"type": "integer"}, "message": {"type": "string"},
+        "reason": {"type": "string"},
+    },
+}
+
+
+def _kinds():
+    from kuberay_tpu.api.tpucluster import TpuCluster
+    from kuberay_tpu.api.tpucronjob import TpuCronJob
+    from kuberay_tpu.api.tpujob import TpuJob
+    from kuberay_tpu.api.tpuservice import TpuService
+    return [(TpuCluster, "tpuclusters"), (TpuJob, "tpujobs"),
+            (TpuService, "tpuservices"), (TpuCronJob, "tpucronjobs")]
+
+
+def _ref(kind: str) -> dict:
+    return {"$ref": f"#/components/schemas/{kind}"}
+
+
+def _list_schema(kind: str) -> dict:
+    return {
+        "type": "object",
+        "properties": {
+            "apiVersion": {"type": "string"},
+            "kind": {"const": f"{kind}List", "type": "string"},
+            "metadata": {
+                "type": "object",
+                "properties": {"resourceVersion": {"type": "string"}}},
+            "items": {"type": "array", "items": _ref(kind)},
+        },
+    }
+
+
+def _error_responses() -> dict:
+    return {
+        "401": {"description": "missing/invalid bearer token",
+                "content": {"application/json": {
+                    "schema": {"$ref": "#/components/schemas/Status"}}}},
+        "404": {"description": "not found",
+                "content": {"application/json": {
+                    "schema": {"$ref": "#/components/schemas/Status"}}}},
+    }
+
+
+def build_spec() -> Dict[str, Any]:
+    schemas: Dict[str, Any] = {"Status": STATUS_SCHEMA}
+    paths: Dict[str, Any] = {}
+    for cls, plural in _kinds():
+        kind = cls.__name__
+        schemas[kind] = crd_schema(cls)
+        schemas[f"{kind}List"] = _list_schema(kind)
+        base = f"/apis/tpu.dev/v1/namespaces/{{namespace}}/{plural}"
+        ns_param = {"name": "namespace", "in": "path", "required": True,
+                    "schema": {"type": "string"}}
+        name_param = {"name": "name", "in": "path", "required": True,
+                      "schema": {"type": "string"}}
+        sel_param = {"name": "labelSelector", "in": "query",
+                     "schema": {"type": "string"},
+                     "description": "k=v[,k2=v2] equality selectors"}
+        watch_params = [
+            {"name": "watch", "in": "query",
+             "schema": {"type": "boolean"},
+             "description": "stream Added/Modified/Deleted/Bookmark "
+                            "events as JSON lines (K8s watch protocol)"},
+            {"name": "resourceVersion", "in": "query",
+             "schema": {"type": "string"},
+             "description": "resume the stream after this version "
+                            "(410 Gone when expired)"},
+            {"name": "timeoutSeconds", "in": "query",
+             "schema": {"type": "integer"}},
+        ]
+        paths[base] = {
+            "get": {
+                "operationId": f"list{kind}",
+                "parameters": [ns_param, sel_param] + watch_params,
+                "responses": {
+                    "200": {"description": f"{kind} list (or watch stream)",
+                            "content": {"application/json": {
+                                "schema": _ref(f"{kind}List")}}},
+                    **_error_responses()},
+            },
+            "post": {
+                "operationId": f"create{kind}",
+                "parameters": [ns_param],
+                "requestBody": {"required": True, "content": {
+                    "application/json": {"schema": _ref(kind)}}},
+                "responses": {
+                    "201": {"description": "created",
+                            "content": {"application/json": {
+                                "schema": _ref(kind)}}},
+                    "409": {"description": "already exists / conflict",
+                            "content": {"application/json": {"schema": {
+                                "$ref": "#/components/schemas/Status"}}}},
+                    "422": {"description": "validation failure",
+                            "content": {"application/json": {"schema": {
+                                "$ref": "#/components/schemas/Status"}}}},
+                    **_error_responses()},
+            },
+        }
+        paths[f"{base}/{{name}}"] = {
+            "get": {"operationId": f"get{kind}",
+                    "parameters": [ns_param, name_param],
+                    "responses": {
+                        "200": {"description": kind,
+                                "content": {"application/json": {
+                                    "schema": _ref(kind)}}},
+                        **_error_responses()}},
+            "put": {"operationId": f"replace{kind}",
+                    "parameters": [ns_param, name_param],
+                    "requestBody": {"required": True, "content": {
+                        "application/json": {"schema": _ref(kind)}}},
+                    "responses": {
+                        "200": {"description": "updated",
+                                "content": {"application/json": {
+                                    "schema": _ref(kind)}}},
+                        "409": {"description": "resourceVersion conflict",
+                                "content": {"application/json": {"schema": {
+                                    "$ref": "#/components/schemas/Status"}}}},
+                        **_error_responses()}},
+            "delete": {"operationId": f"delete{kind}",
+                       "parameters": [ns_param, name_param],
+                       "responses": {
+                           "200": {"description": "deleted (or finalizing)"},
+                           **_error_responses()}},
+        }
+        paths[f"{base}/{{name}}/status"] = {
+            "put": {"operationId": f"replace{kind}Status",
+                    "parameters": [ns_param, name_param],
+                    "requestBody": {"required": True, "content": {
+                        "application/json": {"schema": _ref(kind)}}},
+                    "responses": {
+                        "200": {"description": "status updated",
+                                "content": {"application/json": {
+                                    "schema": _ref(kind)}}},
+                        **_error_responses()}},
+        }
+    return {
+        "openapi": "3.0.3",
+        "info": {
+            "title": "kuberay-tpu apiserver",
+            "version": "v1",
+            "description":
+                "K8s-REST-verb API over the TPU CRs (the typed contract "
+                "for generated clients; REST-only by explicit decision — "
+                "see ARCHITECTURE.md \"API surface: REST, not gRPC\"). "
+                "Bearer auth optional (enabled when the server is started "
+                "with a token); /watch long-poll and K8s-native "
+                "?watch=true streams both supported.",
+        },
+        "servers": [{"url": "http://127.0.0.1:8765"}],
+        "components": {
+            "schemas": schemas,
+            "securitySchemes": {"bearerAuth": {
+                "type": "http", "scheme": "bearer"}},
+        },
+        "security": [{"bearerAuth": []}],
+        "paths": paths,
+    }

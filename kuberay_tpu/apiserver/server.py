@@ -265,6 +265,16 @@ class ApiHandler(JsonHandler):
         if path == "/metrics":
             text = self.metrics.render() if self.metrics else ""
             return self._send_text(200, text, "text/plain; version=0.0.4")
+        if path == "/openapi.json":
+            # Typed client contract (ARCHITECTURE.md "REST, not gRPC"),
+            # built in-process from the API dataclasses so it works in a
+            # pip install with no source checkout; cached per process.
+            cls = type(self)
+            if getattr(cls, "_openapi_cache", None) is None:
+                from kuberay_tpu.apiserver.openapi import build_spec
+                cls._openapi_cache = json.dumps(build_spec())
+            return self._send_text(200, cls._openapi_cache,
+                                   "application/json")
         if path == "/watch":
             return self._watch()
         if path.startswith("/api/history/") and self.history is not None:

@@ -18,8 +18,10 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
+import urllib.parse
 from typing import Any, Dict, List
 
 from kuberay_tpu.cli.client import ApiClient, ApiError
@@ -188,6 +190,21 @@ def main(argv=None):
     lg.add_argument("--coordinator", default="",
                     help="coordinator base URL (default: derived from the "
                          "job's cluster status)")
+
+    # Per-pod log download from the history archive (the kubectl-plugin
+    # `ray log` analogue, ref kubectl-plugin/pkg/cmd/log.go — downloads
+    # every node's collected log dir; works for crashed/deleted hosts).
+    dlg = sub.add_parser(
+        "download-logs",
+        help="download a cluster's per-node logs from the history archive")
+    dlg.add_argument("cluster")
+    dlg.add_argument("--out-dir", default="",
+                     help="destination (default ./<cluster>-logs)")
+    dlg.add_argument("--node", default="",
+                     help="only this node's logs (default: all nodes)")
+    dlg.add_argument("--history-url", default="",
+                     help="history API base URL (default: the apiserver's "
+                          "/api/history mount on --server)")
 
     for name in ("suspend", "resume"):
         sp = sub.add_parser(name)
@@ -397,6 +414,48 @@ def _dispatch(args, client: ApiClient) -> int:
         except CoordinatorError as e:
             print(f"error: {e}", file=sys.stderr)
             return 1
+        return 0
+
+    if args.cmd == "download-logs":
+        import urllib.request
+        base = (args.history_url or client.base_url).rstrip("/")
+        prefix = f"{base}/api/history/logs/{ns}/{args.cluster}"
+        try:
+            with urllib.request.urlopen(prefix, timeout=15) as resp:
+                files = json.load(resp).get("files", [])
+        except Exception as e:
+            print(f"error: history archive unreachable at {base}: {e}",
+                  file=sys.stderr)
+            return 1
+        if args.node:
+            files = [f for f in files if f.split("/", 1)[0] == args.node]
+        if not files:
+            print(f"no archived logs for {ns}/{args.cluster}"
+                  + (f" node {args.node}" if args.node else ""),
+                  file=sys.stderr)
+            return 1
+        out_dir = args.out_dir or f"./{args.cluster}-logs"
+        quoted = urllib.parse.quote
+        for rel in files:
+            parts = rel.split("/")
+            # The file list is server-supplied: refuse traversal segments
+            # so a hostile archive can't write outside --out-dir.
+            if any(p in ("", ".", "..") for p in parts) or rel.startswith("/"):
+                print(f"  skip {rel}: unsafe path", file=sys.stderr)
+                continue
+            url = prefix + "/" + "/".join(quoted(p) for p in parts)
+            dest = os.path.join(out_dir, *parts)
+            os.makedirs(os.path.dirname(dest), exist_ok=True)
+            try:
+                with urllib.request.urlopen(url, timeout=30) as resp:
+                    data = resp.read()
+            except Exception as e:
+                print(f"  skip {rel}: {e}", file=sys.stderr)
+                continue
+            with open(dest, "wb") as f:
+                f.write(data)
+            print(f"  {rel} ({len(data)} bytes)")
+        print(f"downloaded to {out_dir}")
         return 0
 
     if args.cmd in ("suspend", "resume"):

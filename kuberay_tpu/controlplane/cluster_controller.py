@@ -179,9 +179,22 @@ class TpuClusterController:
         # Timeout guard (ref gcs-ft-deletion-timeout annotation).
         timeout = float(cluster.metadata.annotations.get(
             C.ANNOTATION_FT_DELETION_TIMEOUT, "300"))
-        started = job["metadata"].get("creationTimestamp", 0)
         if job.get("status", {}).get("succeeded"):
             return True
+        started = job["metadata"].get("creationTimestamp", 0)
+        if not started:
+            # A store backend that omits creationTimestamp must not make
+            # the timeout instantly true (finalizer released without the
+            # cleanup having run).  Stamp the observation time into an
+            # ANNOTATION — store.update force-restores creationTimestamp
+            # from its stored copy, so writing that field would be
+            # silently discarded.
+            ann = job["metadata"].setdefault("annotations", {})
+            started = float(ann.get(C.ANNOTATION_CLEANUP_OBSERVED_AT, 0))
+            if not started:
+                ann[C.ANNOTATION_CLEANUP_OBSERVED_AT] = str(time.time())
+                self.store.update(job)
+                return False
         return time.time() - started > timeout
 
     # ------------------------------------------------------------------

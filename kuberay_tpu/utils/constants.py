@@ -41,6 +41,9 @@ CREATED_BY_OPERATOR = "kuberay-tpu-operator"
 ANNOTATION_OVERWRITE_CONTAINER_CMD = "tpu.dev/overwrite-container-cmd"
 ANNOTATION_FT_ENABLED = "tpu.dev/ft-enabled"
 ANNOTATION_FT_DELETION_TIMEOUT = "tpu.dev/ft-deletion-timeout"
+# Cleanup-Job deletion-timeout fallback clock for store backends that omit
+# creationTimestamp (see cluster_controller._reconcile_deletion):
+ANNOTATION_CLEANUP_OBSERVED_AT = "tpu.dev/cleanup-observed-at"
 
 # --- GKE TPU node selectors (ref kubectl-plugin/pkg/util/constant.go:13-19) --
 NODE_SELECTOR_GKE_ACCELERATOR = "cloud.google.com/gke-tpu-accelerator"

@@ -1,0 +1,43 @@
+#!/usr/bin/env python
+"""Write docs/openapi.json from the in-package spec builder
+(kuberay_tpu/apiserver/openapi.py — see its docstring for why the
+builder lives in the package, not here).
+
+    python scripts/gen_openapi.py          # writes docs/openapi.json
+    python scripts/gen_openapi.py --check  # verify it is up to date
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from kuberay_tpu.apiserver.openapi import build_spec  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--check", action="store_true")
+    args = ap.parse_args(argv)
+    spec = build_spec()
+    out = REPO / "docs/openapi.json"
+    text = json.dumps(spec, indent=1, sort_keys=True) + "\n"
+    if args.check:
+        if not out.exists() or out.read_text() != text:
+            print("docs/openapi.json is stale; run scripts/gen_openapi.py")
+            return 1
+        print("openapi up to date")
+        return 0
+    out.write_text(text)
+    print(f"wrote {out} ({len(spec['paths'])} paths, "
+          f"{len(spec['components']['schemas'])} schemas)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -13,7 +13,7 @@ import typing
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
-from kuberay_tpu.api.common import Serializable  # noqa: E402
+from kuberay_tpu.api.schema import crd_schema  # noqa: E402
 from kuberay_tpu.api.tpucluster import TpuCluster  # noqa: E402
 from kuberay_tpu.api.tpucronjob import TpuCronJob  # noqa: E402
 from kuberay_tpu.api.tpujob import TpuJob  # noqa: E402
@@ -22,48 +22,10 @@ from kuberay_tpu.api.tpuservice import TpuService  # noqa: E402
 OUT = pathlib.Path(__file__).resolve().parent.parent / "docs" / "crds"
 
 
-def schema_for(cls, seen=None) -> dict:
-    seen = seen or set()
-    if cls in seen:
-        return {"type": "object"}   # cycle guard
-    seen = seen | {cls}
-    props = {}
-    nested = cls._nested_types() if hasattr(cls, "_nested_types") else {}
-    for f in dataclasses.fields(cls):
-        t = f.type if isinstance(f.type, str) else getattr(
-            f.type, "__name__", str(f.type))
-        nt = nested.get(f.name)
-        if nt is not None:
-            inner = schema_for(nt, seen)
-            if "List" in str(t) or "list" in str(t):
-                props[f.name] = {"type": "array", "items": inner}
-            else:
-                props[f.name] = inner
-        elif "int" in str(t):
-            props[f.name] = {"type": "integer"}
-        elif "float" in str(t):
-            props[f.name] = {"type": "number"}
-        elif "bool" in str(t):
-            props[f.name] = {"type": "boolean"}
-        elif "Dict" in str(t) or "dict" in str(t):
-            props[f.name] = {"type": "object"}
-        elif "List" in str(t) or "list" in str(t):
-            props[f.name] = {"type": "array"}
-        else:
-            props[f.name] = {"type": "string"}
-    return {"type": "object", "properties": props}
-
-
 def main():
     OUT.mkdir(parents=True, exist_ok=True)
     for cls in (TpuCluster, TpuJob, TpuService, TpuCronJob):
-        doc = {
-            "$schema": "https://json-schema.org/draft/2020-12/schema",
-            "title": cls.__name__,
-            "description": (cls.__doc__ or "").strip().splitlines()[0]
-            if cls.__doc__ else "",
-            **schema_for(cls),
-        }
+        doc = crd_schema(cls)
         path = OUT / f"{cls.__name__.lower()}.schema.json"
         path.write_text(json.dumps(doc, indent=2) + "\n")
         print(f"wrote {path.relative_to(OUT.parent.parent)}")

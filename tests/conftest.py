@@ -21,3 +21,42 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+# ---------------------------------------------------------------------------
+# @pytest.mark.timeout fallback: pytest-timeout is not installed in this
+# image, which silently turns the marker into a no-op — a hung
+# subprocess test would stall CI forever.  SIGALRM-based stand-in
+# (POSIX; tests run in the main thread).
+
+import signal  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "timeout(seconds): fail the test if it runs longer "
+        "(conftest SIGALRM fallback for the absent pytest-timeout plugin)")
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_call(item):
+    marker = item.get_closest_marker("timeout")
+    has_plugin = item.config.pluginmanager.hasplugin("timeout")
+    if marker is None or has_plugin or not hasattr(signal, "SIGALRM"):
+        yield
+        return
+    seconds = int(marker.args[0]) if marker.args else 60
+
+    def _alarm(signum, frame):
+        raise TimeoutError(
+            f"test exceeded timeout marker ({seconds}s, conftest fallback)")
+
+    old = signal.signal(signal.SIGALRM, _alarm)
+    signal.alarm(seconds)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)

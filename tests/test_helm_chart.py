@@ -32,6 +32,10 @@ def test_default_render_shape():
     c = dep["spec"]["template"]["spec"]["containers"][0]
     assert c["image"] == "registry.local/kuberay-tpu/operator:latest"
     assert "--leader-election" in c["args"]
+    # Probes hit the pod IP, so the API must bind all interfaces, and the
+    # mounted ConfigMap must actually be consumed via --config.
+    assert "--api-host=0.0.0.0" in c["args"]
+    assert "--config=/etc/kuberay-tpu/config.json" in c["args"]
     # ConfigMap payload is valid operator config JSON.
     cm = by_kind(docs, "ConfigMap")[0]
     cfg = json.loads(cm["data"]["config.json"])
@@ -55,8 +59,11 @@ def test_namespaced_mode_swaps_clusterrole_for_roles():
 
 def test_toggles():
     docs = render_chart(CHART, sets=["metrics.serviceMonitor.enabled=true"])
-    assert len(by_kind(docs, "ServiceMonitor")) == 1
-    docs = render_chart(CHART, sets=["metrics.enabled=false"])
+    sm = by_kind(docs, "ServiceMonitor")
+    assert len(sm) == 1
+    # Metrics are served on the API port; the monitor must scrape a port
+    # that actually has a listener.
+    assert sm[0]["spec"]["endpoints"][0]["port"] == "api"
     svc = by_kind(docs, "Service")[0]
     assert [p["name"] for p in svc["spec"]["ports"]] == ["api"]
     docs = render_chart(CHART, sets=["serviceAccount.create=false"])
@@ -96,3 +103,31 @@ def test_crds_shipped_with_chart():
     base_crds = sorted(p.name for p in
                        (REPO / "config/crd/bases").glob("*.yaml"))
     assert chart_crds == base_crds and len(chart_crds) >= 6
+
+
+def test_openapi_spec_current_and_served():
+    """docs/openapi.json is generated from the CRD schemas (the typed
+    contract ratified in ARCHITECTURE.md) and served by the apiserver."""
+    import urllib.request
+
+    out = subprocess.run(
+        [sys.executable, str(REPO / "scripts/gen_openapi.py"), "--check"],
+        capture_output=True, text=True)
+    assert out.returncode == 0, out.stdout
+
+    spec = json.loads((REPO / "docs/openapi.json").read_text())
+    assert spec["openapi"].startswith("3.")
+    base = "/apis/tpu.dev/v1/namespaces/{namespace}/tpuclusters"
+    assert set(spec["paths"][base]) == {"get", "post"}
+    assert set(spec["paths"][base + "/{name}"]) == {"get", "put", "delete"}
+    assert base + "/{name}/status" in spec["paths"]
+    assert "TpuJob" in spec["components"]["schemas"]
+
+    from kuberay_tpu.apiserver.server import serve_background
+    from kuberay_tpu.controlplane.store import ObjectStore
+    srv, url = serve_background(ObjectStore())
+    try:
+        served = json.load(urllib.request.urlopen(f"{url}/openapi.json"))
+        assert served["info"]["title"] == "kuberay-tpu apiserver"
+    finally:
+        srv.shutdown()

@@ -330,3 +330,66 @@ def test_kill_cluster_then_replay_from_history(tmp_path):
         assert b"loss=0.5" in text
     finally:
         srv.shutdown()
+
+
+def test_tpuctl_download_logs(tmp_path, capsys):
+    """tpuctl download-logs pulls a (possibly dead) cluster's per-node
+    logs out of the archive (ref kubectl-plugin/pkg/cmd/log.go)."""
+    from kuberay_tpu.cli.__main__ import main as tpuctl
+
+    storage = LocalStorage(str(tmp_path / "arch"))
+    storage.put("logs/default/gone/w0/train.log", b"w0 line\n")
+    storage.put("logs/default/gone/w1/sub/gc.log", b"w1 gc\n")
+    srv, url = HistoryServer(storage).serve_background()
+    out = tmp_path / "dl"
+    try:
+        rc = tpuctl(["download-logs", "gone", "--history-url", url,
+                     "--out-dir", str(out)])
+        assert rc == 0
+        assert (out / "w0" / "train.log").read_bytes() == b"w0 line\n"
+        assert (out / "w1" / "sub" / "gc.log").read_bytes() == b"w1 gc\n"
+        # Node filter.
+        out2 = tmp_path / "dl2"
+        rc = tpuctl(["download-logs", "gone", "--history-url", url,
+                     "--out-dir", str(out2), "--node", "w1"])
+        assert rc == 0
+        assert not (out2 / "w0").exists()
+        assert (out2 / "w1" / "sub" / "gc.log").exists()
+        # Unknown cluster errors out.
+        assert tpuctl(["download-logs", "nope",
+                       "--history-url", url]) == 1
+    finally:
+        srv.shutdown()
+
+
+def test_tpuctl_download_logs_rejects_traversal(tmp_path):
+    """A hostile archive listing must not write outside --out-dir."""
+    import json as _json
+    from http.server import ThreadingHTTPServer
+    from kuberay_tpu.cli.__main__ import main as tpuctl
+    from kuberay_tpu.utils.httpjson import JsonHandler
+
+    class EvilHistory(JsonHandler):
+        def do_GET(self):
+            if self.path.endswith("/evil"):
+                return self._send(200, {"files": ["../../escape.txt",
+                                                  "/abs.txt",
+                                                  "ok/fine.log"]})
+            if self.path.endswith("/ok/fine.log"):
+                return self._send_text(200, "fine")
+            return self._send_text(200, "pwned")
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), EvilHistory)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    out = tmp_path / "safe"
+    try:
+        rc = tpuctl(["download-logs", "evil",
+                     "--history-url", f"http://127.0.0.1:{srv.server_port}",
+                     "--out-dir", str(out)])
+        assert rc == 0
+        assert (out / "ok" / "fine.log").exists()
+        assert not (tmp_path / "escape.txt").exists()
+        assert sorted(p.name for p in out.rglob("*") if p.is_file()) == \
+            ["fine.log"]
+    finally:
+        srv.shutdown()
