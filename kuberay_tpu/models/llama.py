@@ -44,9 +44,11 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     tie_embeddings: bool = False
     dtype: Any = jnp.bfloat16
-    # auto | pallas | xla | pallas_interpret | ring
+    # auto | pallas | xla | pallas_interpret | ring | ring_rdma
     # 'ring' = sequence-parallel ring attention over the mesh's sp axis
-    # (long-context training; forward() must receive the mesh).
+    # (long-context training; forward() must receive the mesh);
+    # 'ring_rdma' = same, with the Pallas make_async_remote_copy ring
+    # (parallel/ring_pallas.py) overlapping exchange with compute.
     attn_impl: str = "auto"
     remat: bool = True
 
@@ -163,11 +165,14 @@ def _layer(cfg: LlamaConfig, x: jax.Array, lp: Dict[str, jax.Array],
     vv = (h @ lp["wv"]).reshape(B, S, hkv, hd)
     q = apply_rope(q, cos, sin)
     kk = apply_rope(kk, cos, sin)
-    if cfg.attn_impl == "ring":
+    if cfg.attn_impl in ("ring", "ring_rdma"):
         if mesh is None:
-            raise ValueError("attn_impl='ring' requires forward(..., mesh=)")
+            raise ValueError(
+                f"attn_impl={cfg.attn_impl!r} requires forward(..., mesh=)")
         from kuberay_tpu.parallel.ring import ring_attention
-        attn = ring_attention(q, kk, vv, mesh, causal=True)
+        attn = ring_attention(
+            q, kk, vv, mesh, causal=True,
+            impl="rdma" if cfg.attn_impl == "ring_rdma" else "ppermute")
     else:
         attn = flash_attention(q, kk, vv, causal=True, impl=cfg.attn_impl)
     x = x + (attn.reshape(B, S, hq * hd) @ lp["wo"]).astype(x.dtype)

@@ -94,10 +94,37 @@ def _ring_attention_sharded(q, k, v, *, axis_name, scale, causal):
 
 def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
                    causal: bool = True,
-                   scale: Optional[float] = None) -> jax.Array:
+                   scale: Optional[float] = None,
+                   impl: str = "ppermute") -> jax.Array:
     """Sequence-parallel attention.  Global q/k/v: [B, S, H, D] with S
     sharded over ``axis_name``; output sharded the same way.
+
+    ``impl``: 'ppermute' (XLA collective-permute ring, any shape) |
+    'rdma' (Pallas make_async_remote_copy ring overlapping the neighbor
+    exchange with block compute — parallel/ring_pallas.py; falls back to
+    ppermute when the working set exceeds the VMEM budget) |
+    'rdma_interpret' (same kernel, interpreter — virtual-mesh tests).
     """
+    if impl.startswith("rdma"):
+        from kuberay_tpu.parallel import ring_pallas
+        n = mesh.shape[axis_name]
+        B, S, Hq, D_ = q.shape
+        interpret = impl == "rdma_interpret"
+        # The interpreter's remote-DMA discharge supports only
+        # single-axis meshes; compiled Mosaic handles the general case.
+        multi_axis = len(mesh.axis_names) > 1
+        # The kernel fully unrolls ring steps x (B, Hkv, group); cap the
+        # unroll so huge rings fall back instead of exploding the Mosaic
+        # program (a gridded kernel is future work).
+        unroll = n * B * k.shape[2] * (Hq // k.shape[2])
+        if (interpret and multi_axis) or unroll > 512 or \
+                not ring_pallas.fits_vmem(
+                    B, S // n, S // n, Hq, k.shape[2], D_,
+                    q.dtype.itemsize):
+            impl = "ppermute"
+        else:
+            return ring_pallas.ring_attention_rdma(
+                q, k, v, mesh, axis_name, causal, interpret, scale)
     D = q.shape[-1]
     scale = scale if scale is not None else 1.0 / math.sqrt(D)
     fn = functools.partial(_ring_attention_sharded, axis_name=axis_name,

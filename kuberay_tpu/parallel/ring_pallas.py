@@ -1,0 +1,258 @@
+"""Pallas ring attention: RDMA-overlapped sequence parallelism.
+
+The shard_map+ppermute ring (parallel/ring.py) is correct but exposes
+the neighbor exchange to XLA as a collective between scan steps; this
+kernel instead drives the ICI directly with
+``pltpu.make_async_remote_copy`` so the NEXT step's K/V block streams to
+the right neighbor WHILE the current block's attention runs on the MXU
+(NOTES round-1 item 4 / VERDICT round-1 next-step 8).
+
+Protocol per device (SPMD, ring of n over the ``sp`` axis):
+- K/V live in a double-buffered VMEM scratch ``[2, B, Skv, Hkv, D]``.
+- Step i computes on slot ``i % 2`` while an RDMA pushes that same block
+  to the right neighbor's slot ``(i+1) % 2``.
+- Flow control is a capacity TOKEN flowing right->left: after a device
+  finishes computing on a slot it RDMAs a tiny token to its LEFT
+  neighbor, and the sender waits for a token before overwriting a slot
+  remotely.  Without it a fast sender could clobber a slot the slow
+  receiver is still reading (the ppermute version gets this ordering
+  from XLA for free; here it is explicit).  A token DMA rather than a
+  remote semaphore_signal so the same kernel runs under interpret mode
+  (which implements remote DMA but not remote signals).
+- Send semaphores are waited before the capacity signal releases our
+  own source slot, so in-flight sends never race incoming writes.
+
+Numerics are identical to the ppermute ring: same blockwise online
+softmax, f32 accumulators, GQA expanded after the exchange (the wire
+carries Hkv-sized blocks).  The backward pass reuses the ppermute
+implementation via custom_vjp — gradients flow through the well-tested
+path while the forward gets the overlap.
+
+Works in interpret mode on the virtual CPU mesh (tests) and compiled on
+real slices.  VMEM budget guard: callers should fall back to the
+ppermute ring when ``2*kv_bytes + q/acc`` exceeds ~Mi budget (see
+``fits_vmem``).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh, PartitionSpec as P
+
+_NEG_INF = -1e30
+
+# Conservative per-core VMEM budget for the kernel's working set.
+_VMEM_BUDGET_BYTES = 96 * 1024 * 1024
+
+
+def fits_vmem(B, Sq, Skv, Hq, Hkv, D, itemsize=2) -> bool:
+    kv = 2 * 2 * B * Skv * Hkv * D * itemsize      # 2 tensors x 2 slots
+    q = B * Sq * Hq * D * itemsize
+    acc = B * Sq * Hq * D * 4                      # f32 value
+    out = B * Sq * Hq * D * itemsize
+    scores = B * Sq * Skv * 4                      # transient per (b,h)
+    return (kv + q + acc + out + scores) < _VMEM_BUDGET_BYTES
+
+
+def _mask(s, q_off, k_off):
+    Sq, Skv = s.shape
+    rows = q_off + lax.broadcasted_iota(jnp.int32, (Sq, Skv), 0)
+    cols = k_off + lax.broadcasted_iota(jnp.int32, (Sq, Skv), 1)
+    return jnp.where(cols <= rows, s, _NEG_INF)
+
+
+def _ring_kernel(q_ref, k_ref, v_ref, o_ref, kbuf, vbuf, token,
+                 send_k, send_v, recv_k, recv_v, cap_send, cap_recv,
+                 *, axis_name, n, scale, causal, batch, heads_kv, group,
+                 scalar_ids):
+    my = lax.axis_index(axis_name)
+    if scalar_ids:
+        # Interpreter path: discharge rules support only scalar device
+        # ids on a single-axis mesh (ring.py guarantees that).
+        right_id = (my + 1) % n
+        left_id = (my - 1) % n
+    else:
+        # Compiled path: MESH coordinate dicts — unspecified axes default
+        # to our own coordinates, so the ring stays inside this
+        # (dp, tp, ...) slice of a multi-axis mesh.
+        right_id = {axis_name: (my + 1) % n}
+        left_id = {axis_name: (my - 1) % n}
+
+    B, Sq, Hq, D = q_ref.shape
+    Skv = k_ref.shape[1]
+    q_off = my * Sq
+
+    # Seed slot 0 with the local shard (local DMA, immediate wait).
+    cp_k = pltpu.make_async_copy(k_ref, kbuf.at[0], recv_k.at[0])
+    cp_v = pltpu.make_async_copy(v_ref, vbuf.at[0], recv_v.at[0])
+    cp_k.start()
+    cp_v.start()
+    cp_k.wait()
+    cp_v.wait()
+
+    q = q_ref[...]
+    m = jnp.full((B, Hq, Sq, 1), _NEG_INF, jnp.float32)
+    l = jnp.zeros((B, Hq, Sq, 1), jnp.float32)
+    acc = jnp.zeros((B, Sq, Hq, D), jnp.float32)
+
+    for i in range(n):
+        slot, nxt = i % 2, (i + 1) % 2
+
+        rdma_k = rdma_v = None
+        if i < n - 1:
+            if i >= 1:
+                # Right neighbor must be done computing on its slot
+                # `nxt` (its step i-1) before we overwrite it: wait for
+                # its capacity token to land.
+                pltpu.make_async_copy(token, token, cap_recv).wait()
+            rdma_k = pltpu.make_async_remote_copy(
+                src_ref=kbuf.at[slot], dst_ref=kbuf.at[nxt],
+                send_sem=send_k, recv_sem=recv_k.at[nxt],
+                device_id=right_id,
+                device_id_type=pltpu.DeviceIdType.MESH)
+            rdma_v = pltpu.make_async_remote_copy(
+                src_ref=vbuf.at[slot], dst_ref=vbuf.at[nxt],
+                send_sem=send_v, recv_sem=recv_v.at[nxt],
+                device_id=right_id,
+                device_id_type=pltpu.DeviceIdType.MESH)
+            rdma_k.start()
+            rdma_v.start()
+
+        # ---- compute on `slot` while the RDMA streams ----------------
+        src = (my - i) % n                    # whose block we hold
+        k_off = src * Skv
+        for b in range(batch):
+            for h in range(heads_kv):
+                kb = kbuf[slot, b, :, h, :]               # [Skv, D]
+                vb = vbuf[slot, b, :, h, :]
+                for g in range(group):
+                    hq = h * group + g
+                    q2 = q[b, :, hq, :]                    # [Sq, D]
+                    s = lax.dot_general(
+                        q2, kb, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32) * scale
+                    if causal:
+                        s = _mask(s, q_off, k_off)
+                    bm = jnp.max(s, axis=-1, keepdims=True)   # [Sq,1]
+                    p = jnp.exp(s - bm)
+                    p = jnp.where(bm <= _NEG_INF / 2, 0.0, p)
+                    bl = jnp.sum(p, axis=-1, keepdims=True)
+                    pv = lax.dot_general(
+                        p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32)   # [Sq,D]
+                    m_prev = m[b, hq]                          # [Sq,1]
+                    m_new = jnp.maximum(m_prev, bm)
+                    c_old = jnp.exp(m_prev - m_new)
+                    c_new = jnp.exp(bm - m_new)
+                    acc = acc.at[b, :, hq, :].set(
+                        acc[b, :, hq, :] * c_old + pv * c_new)
+                    l = l.at[b, hq].set(l[b, hq] * c_old + bl * c_new)
+                    m = m.at[b, hq].set(m_new)
+
+        if i < n - 1:
+            # Source slot must be fully sent before we hand it back to
+            # the left neighbor (its next send writes into it).
+            rdma_k.wait_send()
+            rdma_v.wait_send()
+            if i < n - 2:
+                tok = pltpu.make_async_remote_copy(
+                    src_ref=token, dst_ref=token,
+                    send_sem=cap_send, recv_sem=cap_recv,
+                    device_id=left_id,
+                    device_id_type=pltpu.DeviceIdType.MESH)
+                tok.start()
+                tok.wait_send()
+            # Arrival of the next block (written by our left neighbor).
+            pltpu.make_async_copy(kbuf.at[nxt], kbuf.at[nxt],
+                                  recv_k.at[nxt]).wait()
+            pltpu.make_async_copy(vbuf.at[nxt], vbuf.at[nxt],
+                                  recv_v.at[nxt]).wait()
+
+    l = jnp.where(l == 0.0, 1.0, l)
+    # [B,Hq,Sq,1] -> [B,Sq,Hq,1]
+    o_ref[...] = (acc / l.transpose(0, 2, 1, 3)).astype(o_ref.dtype)
+
+
+def _ring_attention_fwd_sharded(q, k, v, *, axis_name, n, scale, causal,
+                                interpret):
+    B, Sq, Hq, D = q.shape
+    Hkv = k.shape[2]
+    kernel = functools.partial(
+        _ring_kernel, axis_name=axis_name, n=n, scale=scale,
+        causal=causal, batch=B, heads_kv=Hkv, group=Hq // Hkv,
+        scalar_ids=interpret)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 3,
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((2,) + k.shape, k.dtype),
+            pltpu.VMEM((2,) + v.shape, v.dtype),
+            pltpu.VMEM((8, 128), jnp.int32),    # capacity token
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA(()),        # cap_send
+            pltpu.SemaphoreType.DMA(()),        # cap_recv
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=7),
+        interpret=interpret,
+    )(q, k, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def ring_attention_rdma(q, k, v, mesh: Mesh, axis_name: str = "sp",
+                        causal: bool = True,
+                        interpret: bool = False,
+                        scale: Optional[float] = None) -> jax.Array:
+    """RDMA-overlapped ring attention; drop-in for
+    parallel.ring.ring_attention (same sharding contract: S over
+    ``axis_name``).
+
+    Backward re-derives gradients through the ppermute ring's VJP from
+    the saved (q, k, v): one recomputed forward plus the backward —
+    the same cost shape as flash-attention backward or a remat policy
+    (which training configs apply to attention anyway); a fused RDMA
+    backward kernel is future work."""
+    return _rdma_fwd_only(q, k, v, mesh, axis_name, causal, interpret,
+                          scale)
+
+
+def _rdma_fwd_only(q, k, v, mesh, axis_name, causal, interpret, scale=None):
+    D = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    n = mesh.shape[axis_name]
+    fn = functools.partial(
+        _ring_attention_fwd_sharded, axis_name=axis_name, n=n,
+        scale=scale, causal=causal, interpret=interpret)
+    spec = P(None, axis_name, None, None)
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)
+
+
+def _rdma_vjp_fwd(q, k, v, mesh, axis_name, causal, interpret, scale):
+    out = _rdma_fwd_only(q, k, v, mesh, axis_name, causal, interpret, scale)
+    return out, (q, k, v)
+
+
+def _rdma_vjp_bwd(mesh, axis_name, causal, interpret, scale, res, g):
+    from kuberay_tpu.parallel.ring import ring_attention as ppermute_ring
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q, k, v: ppermute_ring(q, k, v, mesh, axis_name=axis_name,
+                                      causal=causal, scale=scale), q, k, v)
+    return vjp(g)
+
+
+ring_attention_rdma.defvjp(_rdma_vjp_fwd, _rdma_vjp_bwd)
