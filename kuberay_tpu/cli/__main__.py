@@ -206,6 +206,22 @@ def main(argv=None):
                      help="history API base URL (default: the apiserver's "
                           "/api/history mount on --server)")
 
+    # Orchestration timeline (chrome://tracing JSON) + device profiling
+    # (the Ray-timeline/profile-events analogue, SURVEY §5.1).
+    tl = sub.add_parser("timeline",
+                        help="cluster lifecycle as Chrome-trace JSON "
+                             "(stdout; load in chrome://tracing/Perfetto)")
+    tl.add_argument("cluster")
+
+    pf = sub.add_parser("profile",
+                        help="capture a jax.profiler trace on a cluster's "
+                             "coordinator (archived with node logs)")
+    pf.add_argument("cluster")
+    pf.add_argument("--duration", type=float, default=5.0)
+    pf.add_argument("--coordinator", default="",
+                    help="coordinator base URL (default: derived from "
+                         "cluster status)")
+
     for name in ("suspend", "resume"):
         sp = sub.add_parser(name)
         sp.add_argument("resource", choices=["cluster", "job"])
@@ -414,6 +430,38 @@ def _dispatch(args, client: ApiClient) -> int:
         except CoordinatorError as e:
             print(f"error: {e}", file=sys.stderr)
             return 1
+        return 0
+
+    if args.cmd == "timeline":
+        from kuberay_tpu.utils.timeline import cluster_timeline
+        cluster = client.get(C.KIND_CLUSTER, args.cluster, ns)
+        events = client.list("Event", ns)
+        jobs = [j for j in client.list(C.KIND_JOB, ns)
+                if j.get("status", {}).get("clusterName") == args.cluster]
+        print(json.dumps(cluster_timeline(cluster, events, jobs)))
+        return 0
+
+    if args.cmd == "profile":
+        from kuberay_tpu.runtime.coordinator_client import (
+            CoordinatorClient, default_client_provider)
+        if args.coordinator:
+            coord = CoordinatorClient(args.coordinator)
+        else:
+            cluster = client.get(C.KIND_CLUSTER, args.cluster, ns)
+            status = cluster.get("status", {})
+            if not status.get("coordinatorAddress"):
+                print("error: no coordinator address known; pass "
+                      "--coordinator", file=sys.stderr)
+                return 1
+            coord = default_client_provider(status)
+        try:
+            out = coord.start_profile(args.duration)
+        except Exception as e:
+            print(f"error: profile start failed: {e}", file=sys.stderr)
+            return 1
+        print(f"profiling for {args.duration}s -> {out.get('trace_dir')}")
+        print("trace is archived with node logs; fetch via "
+              "`tpuctl download-logs` once collected")
         return 0
 
     if args.cmd == "download-logs":

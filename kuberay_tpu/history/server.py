@@ -191,6 +191,15 @@ class HistoryServer:
             return 404, {"message": "unknown path"}, False
         if head == "meta" and len(parts) == 5:
             return 200, self.meta_docs(parts[3], parts[4]), False
+        if head == "timeline" and len(parts) == 5:
+            doc = self.storage.get_doc(_doc_key("TpuCluster", parts[3],
+                                                parts[4]))
+            if doc is None:
+                return 404, {"message": "not archived"}, False
+            from kuberay_tpu.utils.timeline import cluster_timeline
+            jobs = [j for j in list_docs(self.storage, "TpuJob", parts[3])
+                    if j.get("status", {}).get("clusterName") == parts[4]]
+            return 200, cluster_timeline(doc, jobs=jobs), False
         kind = head
         if kind not in _ARCHIVED_KINDS:
             return 404, {"message": "unknown kind"}, False

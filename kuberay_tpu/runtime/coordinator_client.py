@@ -105,6 +105,17 @@ class CoordinatorClient:
     def get_serve_apps(self) -> Dict[str, Any]:
         return self._req("GET", "/api/serve/applications/")
 
+    # device profiling (jax.profiler traces on the head)
+    def start_profile(self, duration_s: float = 0.0) -> Dict[str, Any]:
+        return self._req("POST", "/api/profile/start",
+                         {"duration_s": duration_s})
+
+    def stop_profile(self) -> Dict[str, Any]:
+        return self._req("POST", "/api/profile/stop", {})
+
+    def list_profiles(self) -> List[str]:
+        return self._req("GET", "/api/profile/").get("profiles", [])
+
     def healthz(self) -> bool:
         try:
             self._req("GET", "/api/healthz")

@@ -214,7 +214,60 @@ class CoordinatorServer:
         self.jobs: Dict[str, JobRecord] = {}
         self.serve_config: Optional[Dict[str, Any]] = None
         self.serve_apps: Dict[str, Any] = {}
+        # Device profiling (ref: Ray dashboard profile capture; here a
+        # jax.profiler trace written under log_dir so the history log
+        # collector archives it like any node file).
+        self.profile_dir = os.path.join(log_dir, "profiles")
+        self._profiling: Optional[str] = None
         self._recover()
+
+    # -- device profiling --------------------------------------------------
+
+    def start_profile(self, duration_s: float = 0.0) -> Dict[str, Any]:
+        """Start a jax.profiler trace; auto-stops after duration_s if
+        given.  Returns {"trace_dir": ...} or {"error": ...}."""
+        with self._lock:
+            if self._profiling:
+                return {"error": "profile already running",
+                        "trace_dir": self._profiling}
+            trace_dir = os.path.join(self.profile_dir,
+                                     f"trace-{int(time.time())}")
+            try:
+                import jax
+                jax.profiler.start_trace(trace_dir)
+            except Exception as e:   # jax unavailable / no device
+                return {"error": f"profiler start failed: {e}"}
+            self._profiling = trace_dir
+        if duration_s > 0:
+            # The timer only stops ITS OWN trace: a stale timer from an
+            # earlier capture must not truncate a later one.
+            t = threading.Timer(duration_s, self.stop_profile,
+                                kwargs={"expected": trace_dir})
+            t.daemon = True
+            t.start()
+        return {"trace_dir": trace_dir}
+
+    def stop_profile(self, expected: Optional[str] = None) -> Dict[str, Any]:
+        with self._lock:
+            if not self._profiling:
+                return {"error": "no profile running"}
+            if expected is not None and self._profiling != expected:
+                return {"error": "profile generation mismatch (stale timer)"}
+            trace_dir, self._profiling = self._profiling, None
+        try:
+            import jax
+            jax.profiler.stop_trace()
+        except Exception as e:
+            return {"error": f"profiler stop failed: {e}",
+                    "trace_dir": trace_dir}
+        return {"trace_dir": trace_dir}
+
+    def list_profiles(self) -> list:
+        try:
+            return sorted(d for d in os.listdir(self.profile_dir)
+                          if d.startswith("trace-"))
+        except OSError:
+            return []
 
     # -- persistence -------------------------------------------------------
 
@@ -374,6 +427,9 @@ class CoordinatorServer:
                     return self._send(200, rec.to_dict())
                 if self.path == "/api/serve/applications/":
                     return self._send(200, dict(coord.serve_apps))
+                if self.path == "/api/profile/":
+                    return self._send(200,
+                                      {"profiles": coord.list_profiles()})
                 return self._send(404, {"message": "unknown path"})
 
             def do_POST(self):
@@ -386,6 +442,13 @@ class CoordinatorServer:
                         b.get("entrypoint", ""), b.get("runtime_env"),
                         b.get("metadata"))
                     return self._send(200, {"submission_id": rec.job_id})
+                if self.path == "/api/profile/start":
+                    out = coord.start_profile(
+                        float(self._body().get("duration_s", 0) or 0))
+                    return self._send(400 if "error" in out else 200, out)
+                if self.path == "/api/profile/stop":
+                    out = coord.stop_profile()
+                    return self._send(400 if "error" in out else 200, out)
                 if self.path.endswith("/stop"):
                     jid = self.path.rsplit("/", 2)[1]
                     ok = coord.stop(jid)

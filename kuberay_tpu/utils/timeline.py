@@ -1,0 +1,114 @@
+"""Timeline export: cluster/job lifecycle as Chrome-trace JSON.
+
+The reference's historyserver preserves Ray timeline/profile events for
+post-mortem analysis (historyserver/pkg/eventserver/eventserver.go:838
+handleTaskProfileEvent).  The TPU-native counterparts are two-level:
+
+- ORCHESTRATION timeline (this module): K8s Events + CR
+  ``stateTransitionTimes`` + job start/end times rendered as a
+  chrome://tracing / Perfetto-loadable JSON document, built from the
+  live store or from an archived history doc — "what did the control
+  plane do and when" for a (possibly deleted) cluster.
+- DEVICE profiles: ``jax.profiler`` traces captured on demand via the
+  coordinator's /api/profile endpoints (runtime/coordinator_server.py)
+  and archived by the history log collector like any other node file.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+_PHASE_COMPLETE = "X"
+_PHASE_INSTANT = "i"
+
+
+def _us(t: float) -> int:
+    return int(t * 1e6)
+
+
+def _event_rows(events: List[Dict[str, Any]], name: str,
+                pid: str) -> List[Dict[str, Any]]:
+    out = []
+    for e in events:
+        # Live Event objects carry involvedObject; history archives store
+        # events already filtered to this object with involvedObject
+        # stripped (HistoryCollector._archive) — treat absence as a match.
+        if "involvedObject" in e and \
+                e["involvedObject"].get("name") != name:
+            continue
+        ts = e.get("eventTime") or 0
+        out.append({
+            "name": f"{e.get('reason', 'Event')}",
+            "cat": e.get("type", "Normal"),
+            "ph": _PHASE_INSTANT, "s": "p",
+            "ts": _us(ts), "pid": pid, "tid": "events",
+            "args": {"message": e.get("message", "")},
+        })
+    return out
+
+
+def cluster_timeline(cluster: Dict[str, Any],
+                     events: Optional[List[Dict[str, Any]]] = None,
+                     jobs: Optional[List[Dict[str, Any]]] = None
+                     ) -> Dict[str, Any]:
+    """Chrome-trace document for one TpuCluster (live CR dict or an
+    archived history doc — both carry metadata/status/events)."""
+    md = cluster.get("metadata", {})
+    st = cluster.get("status", {})
+    name = md.get("name", "")
+    pid = f"TpuCluster/{name}"
+    trace: List[Dict[str, Any]] = []
+
+    created = md.get("creationTimestamp") or 0
+    transitions = sorted(
+        ((t, state) for state, t in
+         (st.get("stateTransitionTimes") or {}).items()),
+        key=lambda x: x[0])
+    # State spans: creation -> t1 -> t2 ... (last span open-ended: render
+    # as an instant + zero-length span at the transition).
+    prev_t, prev_state = created, "provisioning"
+    for t, state in transitions:
+        trace.append({
+            "name": prev_state, "cat": "state", "ph": _PHASE_COMPLETE,
+            "ts": _us(prev_t), "dur": max(_us(t) - _us(prev_t), 1),
+            "pid": pid, "tid": "state",
+        })
+        prev_t, prev_state = t, state
+    end = md.get("deletionTimestamp") or cluster.get("archivedAt")
+    trace.append({
+        "name": prev_state, "cat": "state", "ph": _PHASE_COMPLETE,
+        "ts": _us(prev_t),
+        "dur": max(_us(end) - _us(prev_t), 1) if end else 1,
+        "pid": pid, "tid": "state",
+    })
+
+    # Condition transitions as instants.
+    for cond in st.get("conditions", []):
+        t = cond.get("lastTransitionTime") or 0
+        trace.append({
+            "name": f"{cond.get('type')}={cond.get('status')}",
+            "cat": "condition", "ph": _PHASE_INSTANT, "s": "t",
+            "ts": _us(t), "pid": pid, "tid": "conditions",
+            "args": {"reason": cond.get("reason", "")},
+        })
+
+    trace.extend(_event_rows(events or cluster.get("events") or [], name,
+                             pid))
+
+    for job in jobs or []:
+        jst = job.get("status", {})
+        t0 = jst.get("startTime") or 0
+        t1 = jst.get("endTime") or 0
+        if t0:
+            trace.append({
+                "name": job.get("metadata", {}).get("name", "job"),
+                "cat": "job", "ph": _PHASE_COMPLETE,
+                "ts": _us(t0),
+                "dur": max(_us(t1) - _us(t0), 1) if t1 else 1,
+                "pid": pid, "tid": "jobs",
+                "args": {"deployment": jst.get("jobDeploymentStatus", ""),
+                         "job": jst.get("jobStatus", "")},
+            })
+
+    return {"traceEvents": sorted(trace, key=lambda e: e["ts"]),
+            "displayTimeUnit": "ms"}
