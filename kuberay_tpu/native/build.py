@@ -1,0 +1,57 @@
+"""Shared native-build helper: compile a C++ source in this directory to
+a shared object, content-addressed by source sha256 (never mtimes), with
+atomic publication safe for concurrent builders on shared filesystems.
+Consumers: train/data.py (dataloader), native/journal.py (journal)."""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pathlib
+import subprocess
+import tempfile
+import threading
+from typing import Optional
+
+NATIVE_DIR = pathlib.Path(__file__).resolve().parent
+_build_lock = threading.Lock()
+
+
+def build_native(src_name: str) -> Optional[pathlib.Path]:
+    """Compile ``native/<src_name>`` once; returns the .so path or None
+    when no toolchain is available (callers fall back to pure Python).
+
+    The cache key is the sha256 of the source (stored in a sidecar
+    file): the .so that executes is always one this process tree
+    compiled from the checked-in source (binaries are not committed —
+    see .gitignore), and a stale or foreign .so is never loaded."""
+    src = NATIVE_DIR / src_name
+    so = NATIVE_DIR / "build" / f"lib{src.stem}.so"
+    with _build_lock:
+        src_sha = hashlib.sha256(src.read_bytes()).hexdigest()
+        stamp = so.with_suffix(".src.sha256")
+        if (so.exists() and stamp.exists()
+                and stamp.read_text().strip() == src_sha):
+            return so
+        so.parent.mkdir(parents=True, exist_ok=True)
+        # Compile to a builder-private temp path, then os.replace() both
+        # artifact and stamp atomically: concurrent builders each publish
+        # a complete .so — a reader can never load a half-written one.
+        # mkstemp (not pid suffixes: two hosts on shared NFS can share a
+        # pid) guarantees the temp name is unique across builders.
+        fd, tmp = tempfile.mkstemp(dir=so.parent, prefix=f".{so.name}.")
+        os.close(fd)
+        cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+               str(src), "-o", tmp]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            os.replace(tmp, so)
+            fd, tmp_stamp = tempfile.mkstemp(dir=so.parent,
+                                             prefix=f".{stamp.name}.")
+            with os.fdopen(fd, "w") as f:
+                f.write(src_sha)
+            os.replace(tmp_stamp, stamp)
+            return so
+        except (subprocess.SubprocessError, FileNotFoundError):
+            pathlib.Path(tmp).unlink(missing_ok=True)
+            return None

@@ -1,0 +1,193 @@
+// Native journal engine: CRC-framed append-only log with group commit.
+//
+// The control plane's durability hot path (ObjectStore journal — the
+// etcd-lite standalone mode, SURVEY §5.4).  The reference's equivalent
+// state stores are native (etcd via kube-apiserver; Ray GCS in C++);
+// here the write path is C++ for the same reason: a Python
+// write()+fsync() per mutation caps reconcile throughput, while unsynced
+// buffered writes (round-1's journal) lose acknowledged state on crash.
+//
+// Design:
+// - Frame: [u32 len][u32 crc32(payload)][payload] little-endian.
+// - Appends enqueue into an in-memory buffer; a flusher thread drains it
+//   with one write()+fdatasync() per BATCH (group commit): many
+//   mutations share one disk sync, so durability costs O(syncs/sec),
+//   not O(mutations/sec).
+// - jrn_flush() blocks until everything enqueued so far is ON DISK
+//   (fdatasync'd) — the store calls it before acknowledging writes that
+//   must be durable.
+// - Replay validates CRCs and stops at the first bad/truncated frame
+//   (a torn tail from a crash is expected, not fatal).
+//
+// C ABI only (ctypes consumer: kuberay_tpu/native/journal.py).
+
+#include <atomic>
+#include <cerrno>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fcntl.h>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+uint32_t crc_table[256];
+struct CrcInit {
+  CrcInit() {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++)
+        c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      crc_table[i] = c;
+    }
+  }
+} crc_init;
+
+uint32_t crc32(const uint8_t* buf, size_t len) {
+  uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < len; i++)
+    c = crc_table[(c ^ buf[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+struct Journal {
+  int fd = -1;
+  std::mutex mu;
+  std::condition_variable cv_work;    // flusher wakeup
+  std::condition_variable cv_done;    // flush waiters
+  std::vector<uint8_t> pending;       // framed, not yet written
+  uint64_t enqueued_seq = 0;          // frames enqueued
+  uint64_t durable_seq = 0;           // frames fdatasync'd
+  bool stop = false;
+  bool sync_each_batch = true;
+  std::thread flusher;
+
+  void run() {
+    std::unique_lock<std::mutex> lk(mu);
+    while (true) {
+      cv_work.wait_for(lk, std::chrono::milliseconds(5), [&] {
+        return stop || !pending.empty();
+      });
+      if (pending.empty()) {
+        if (stop) return;
+        continue;
+      }
+      std::vector<uint8_t> batch;
+      batch.swap(pending);
+      uint64_t seq = enqueued_seq;
+      lk.unlock();
+      size_t off = 0;
+      while (off < batch.size()) {
+        ssize_t n = ::write(fd, batch.data() + off, batch.size() - off);
+        if (n < 0 && errno == EINTR) continue;   // signal: retry
+        if (n <= 0) break;                       // ENOSPC/EIO
+        off += (size_t)n;
+      }
+      bool ok = off == batch.size();
+      if (ok && sync_each_batch) ok = ::fdatasync(fd) == 0;
+      lk.lock();
+      if (ok) {
+        durable_seq = seq;
+        cv_done.notify_all();
+        if (stop && pending.empty()) return;
+      } else {
+        // Failed batch: REQUEUE at the front (order preserved) and never
+        // advance durable_seq — a later success must not claim these
+        // frames were synced (replay would silently restore a hole).
+        // Note: a partial write may leave a torn frame on disk; the
+        // retry appends complete frames after it and replay stops at
+        // the tear, which is why flush() waiters time out (error
+        // surfaced) rather than ack.  Back off to avoid hot-spinning on
+        // a persistent error.
+        pending.insert(pending.begin(), batch.begin(), batch.end());
+        if (stop) return;   // shutting down: give up, waiters time out
+        cv_work.wait_for(lk, std::chrono::milliseconds(50),
+                         [&] { return stop; });
+      }
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* jrn_open(const char* path, int sync_each_batch) {
+  int fd = ::open(path, O_WRONLY | O_CREAT | O_APPEND, 0644);
+  if (fd < 0) return nullptr;
+  auto* j = new Journal();
+  j->fd = fd;
+  j->sync_each_batch = sync_each_batch != 0;
+  j->flusher = std::thread([j] { j->run(); });
+  return j;
+}
+
+int jrn_append(void* h, const uint8_t* data, uint32_t len) {
+  auto* j = static_cast<Journal*>(h);
+  uint32_t crc = crc32(data, len);
+  std::lock_guard<std::mutex> lk(j->mu);
+  size_t base = j->pending.size();
+  j->pending.resize(base + 8 + len);
+  memcpy(j->pending.data() + base, &len, 4);
+  memcpy(j->pending.data() + base + 4, &crc, 4);
+  memcpy(j->pending.data() + base + 8, data, len);
+  j->enqueued_seq++;
+  j->cv_work.notify_one();
+  return 0;
+}
+
+// Block until everything appended so far is durable.  Returns 0 on
+// success, -1 on timeout (5 s — disk stall / write error).
+int jrn_flush(void* h) {
+  auto* j = static_cast<Journal*>(h);
+  std::unique_lock<std::mutex> lk(j->mu);
+  uint64_t want = j->enqueued_seq;
+  j->cv_work.notify_one();
+  bool ok = j->cv_done.wait_for(lk, std::chrono::seconds(5), [&] {
+    return j->durable_seq >= want;
+  });
+  return ok ? 0 : -1;
+}
+
+void jrn_close(void* h) {
+  auto* j = static_cast<Journal*>(h);
+  {
+    std::lock_guard<std::mutex> lk(j->mu);
+    j->stop = true;
+    j->cv_work.notify_one();
+  }
+  j->flusher.join();
+  ::close(j->fd);
+  delete j;
+}
+
+// Replay valid frames through cb; returns frame count, or -1 if the
+// file can't be opened.  Stops cleanly at a torn/corrupt tail.
+typedef void (*jrn_cb)(const uint8_t*, uint32_t);
+
+long jrn_replay(const char* path, jrn_cb cb) {
+  FILE* f = ::fopen(path, "rb");
+  if (!f) return -1;
+  long count = 0;
+  std::vector<uint8_t> buf;
+  for (;;) {
+    uint32_t hdr[2];
+    if (fread(hdr, 1, 8, f) != 8) break;
+    uint32_t len = hdr[0], crc = hdr[1];
+    if (len > (1u << 30)) break;          // implausible: corrupt header
+    buf.resize(len);
+    if (fread(buf.data(), 1, len, f) != len) break;   // torn tail
+    if (crc32(buf.data(), len) != crc) break;         // corrupt frame
+    cb(buf.data(), len);
+    count++;
+  }
+  fclose(f);
+  return count;
+}
+
+}  // extern "C"

@@ -11,59 +11,20 @@ toolchain is available the NumPy fallback provides identical batches
 from __future__ import annotations
 
 import ctypes
-import hashlib
 import os
 import pathlib
-import tempfile
-import subprocess
-import threading
 from typing import Dict, Iterator, Optional
 
 import numpy as np
 
 _NATIVE_DIR = pathlib.Path(__file__).resolve().parent.parent / "native"
 _SRC = _NATIVE_DIR / "dataloader.cpp"
-_SO = _NATIVE_DIR / "build" / "libdataloader.so"
-_build_lock = threading.Lock()
 
 
-def _build_native() -> Optional[pathlib.Path]:
-    """Compile the loader once; cached next to the source.
-
-    The cache key is the sha256 of dataloader.cpp (stored in a sidecar
-    file), never mtimes: the .so that executes is always one this process
-    tree compiled from the checked-in source (binaries are not committed
-    — see .gitignore), and a stale or foreign .so is never loaded.
-    """
-    with _build_lock:
-        src_sha = hashlib.sha256(_SRC.read_bytes()).hexdigest()
-        stamp = _SO.with_suffix(".src.sha256")
-        if (_SO.exists() and stamp.exists()
-                and stamp.read_text().strip() == src_sha):
-            return _SO
-        _SO.parent.mkdir(parents=True, exist_ok=True)
-        # Compile to a builder-private temp path, then os.replace() both
-        # artifact and stamp atomically: concurrent builders on a shared
-        # filesystem (multi-host launch) each publish a complete .so —
-        # a reader can never load a half-written one.  mkstemp (not pid
-        # suffixes: two hosts on shared NFS can share a pid) guarantees
-        # the temp name is unique across builders.
-        fd, tmp = tempfile.mkstemp(dir=_SO.parent, prefix=f".{_SO.name}.")
-        os.close(fd)
-        cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-               str(_SRC), "-o", tmp]
-        try:
-            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-            os.replace(tmp, _SO)
-            fd, tmp_stamp = tempfile.mkstemp(dir=_SO.parent,
-                                             prefix=f".{stamp.name}.")
-            with os.fdopen(fd, "w") as f:
-                f.write(src_sha)
-            os.replace(tmp_stamp, stamp)
-            return _SO
-        except (subprocess.SubprocessError, FileNotFoundError):
-            pathlib.Path(tmp).unlink(missing_ok=True)
-            return None
+def _build_native():
+    """Compile via the shared content-addressed builder (native/build.py)."""
+    from kuberay_tpu.native.build import build_native
+    return build_native("dataloader.cpp")
 
 
 def _load_native():

@@ -1,0 +1,168 @@
+"""Native journal engine (native/journal.cpp + bindings): frame
+roundtrip on both engines, torn-tail recovery, cross-engine replay,
+legacy text-journal migration, and store integration."""
+
+import json
+import os
+import struct
+import zlib
+
+import pytest
+
+from kuberay_tpu.controlplane.store import ObjectStore
+from kuberay_tpu.native import journal as J
+
+ENGINES = ["python"] + (["native"] if J.native_available() else [])
+
+
+@pytest.mark.parametrize("engine", ENGINES)
+def test_roundtrip(tmp_path, engine):
+    path = str(tmp_path / "j.bin")
+    j = J.open_journal(path, engine)
+    payloads = [b"alpha", b"b" * 10_000, json.dumps({"op": "x"}).encode()]
+    for p in payloads:
+        j.append(p)
+    j.flush()
+    j.close()
+    assert list(J.replay(path, engine)) == payloads
+
+
+@pytest.mark.parametrize("writer", ENGINES)
+@pytest.mark.parametrize("reader", ENGINES)
+def test_cross_engine_replay(tmp_path, writer, reader):
+    """Both engines share one file format."""
+    path = str(tmp_path / "x.bin")
+    j = J.open_journal(path, writer)
+    j.append(b"shared-format")
+    j.flush()
+    j.close()
+    assert list(J.replay(path, reader)) == [b"shared-format"]
+
+
+@pytest.mark.parametrize("engine", ENGINES)
+def test_torn_tail_stops_replay(tmp_path, engine):
+    path = str(tmp_path / "torn.bin")
+    j = J.open_journal(path, engine)
+    j.append(b"good-1")
+    j.append(b"good-2")
+    j.flush()
+    j.close()
+    good_len = os.path.getsize(path)
+    with open(path, "ab") as f:           # crash mid-frame
+        f.write(struct.pack("<II", 100, 0) + b"only-part")
+    assert list(J.replay(path, engine)) == [b"good-1", b"good-2"]
+    assert J.valid_prefix_len(path) == good_len
+    # Corrupt CRC also stops replay at the corruption point.
+    with open(path, "r+b") as f:
+        f.truncate(good_len)
+        f.seek(4)                          # first frame's crc field
+        f.write(b"\xde\xad\xbe\xef")
+    assert list(J.replay(path, engine)) == []
+
+
+def test_store_truncates_torn_tail_and_continues(tmp_path):
+    path = str(tmp_path / "s.journal")
+    s1 = ObjectStore(journal_path=path)
+    s1.create({"apiVersion": "v1", "kind": "Pod",
+               "metadata": {"name": "p1", "namespace": "default"}})
+    s1.flush_journal()
+    with open(path, "ab") as f:            # crash mid-frame
+        f.write(b"\xff\xff\xff\x7f GARBAGE")
+    s2 = ObjectStore(journal_path=path)
+    assert s2.get("Pod", "p1") is not None
+    # New writes after the truncation are replayable.
+    s2.create({"apiVersion": "v1", "kind": "Pod",
+               "metadata": {"name": "p2", "namespace": "default"}})
+    s2.flush_journal()
+    s3 = ObjectStore(journal_path=path)
+    assert {o["metadata"]["name"] for o in s3.list("Pod")} == {"p1", "p2"}
+
+
+def test_legacy_text_journal_migrates(tmp_path):
+    """Round-1 journals were JSON text lines; opening one replays it and
+    rewrites it as a framed snapshot."""
+    path = str(tmp_path / "legacy.journal")
+    with open(path, "w") as f:
+        f.write(json.dumps({"op": "put", "obj": {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "old", "namespace": "default",
+                         "resourceVersion": 7}}}) + "\n")
+        f.write(json.dumps({"op": "del", "key": ["Pod", "default",
+                                                 "gone"]}) + "\n")
+    s = ObjectStore(journal_path=path)
+    assert s.get("Pod", "old")["metadata"]["resourceVersion"] == 7
+    s.flush_journal()
+    # File is now framed: binary replay sees the snapshot.
+    entries = [json.loads(p) for p in J.replay(path)]
+    assert entries[0]["op"] == "snapshot"
+    # And a reopen still works.
+    s2 = ObjectStore(journal_path=path)
+    assert s2.get("Pod", "old") is not None
+
+
+@pytest.mark.skipif(not J.native_available(), reason="no C++ toolchain")
+def test_native_flush_is_durable_against_kill(tmp_path):
+    """flush() means ON DISK: a SIGKILL'd writer's flushed records
+    survive (the round-1 text journal lost these on machine crash; this
+    asserts the process-kill half, which buffering alone would also
+    lose)."""
+    import subprocess
+    import sys
+
+    path = str(tmp_path / "kill.bin")
+    code = f"""
+import os, signal
+from kuberay_tpu.native.journal import open_journal
+j = open_journal({path!r}, "native")
+for i in range(100):
+    j.append(f"rec-{{i}}".encode())
+j.flush()
+print("flushed", flush=True)
+os.kill(os.getpid(), signal.SIGKILL)
+"""
+    out = subprocess.run([sys.executable, "-c", code],
+                         capture_output=True, text=True, timeout=60)
+    assert "flushed" in out.stdout
+    assert out.returncode == -9
+    recs = list(J.replay(path))
+    assert len(recs) == 100 and recs[-1] == b"rec-99"
+
+
+def test_store_compaction_on_engine(tmp_path):
+    path = str(tmp_path / "c.journal")
+    s1 = ObjectStore(journal_path=path, journal_compact_bytes=20_000)
+    for i in range(200):
+        s1.create({"apiVersion": "v1", "kind": "Pod",
+                   "metadata": {"name": f"p{i}", "namespace": "default",
+                                "labels": {"tpu.dev/cluster": "c"}}})
+    for i in range(150):
+        s1.delete("Pod", f"p{i}")
+    s1.flush_journal()
+    s2 = ObjectStore(journal_path=path)
+    assert len(s2.list("Pod")) == 50
+    assert len(s2.list("Pod", labels={"tpu.dev/cluster": "c"})) == 50
+
+
+@pytest.mark.parametrize("engine", ENGINES)
+def test_store_acked_create_survives_sigkill(tmp_path, engine):
+    """A create() that RETURNED must be on disk — no explicit flush by
+    the caller (the public-mutator ack barrier), even if the process is
+    SIGKILL'd immediately after."""
+    import subprocess
+    import sys
+
+    path = str(tmp_path / "ack.journal")
+    code = f"""
+import os, signal
+from kuberay_tpu.controlplane.store import ObjectStore
+s = ObjectStore(journal_path={path!r}, journal_engine={engine!r})
+s.create({{"apiVersion": "v1", "kind": "Pod",
+          "metadata": {{"name": "acked", "namespace": "default"}}}})
+print("acked", flush=True)
+os.kill(os.getpid(), signal.SIGKILL)
+"""
+    out = subprocess.run([sys.executable, "-c", code],
+                         capture_output=True, text=True, timeout=60)
+    assert "acked" in out.stdout and out.returncode == -9
+    s2 = ObjectStore(journal_path=path, journal_engine=engine)
+    assert s2.get("Pod", "acked") is not None
