@@ -111,18 +111,23 @@ class ObjectStore:
     # no-toolchain fallback.  Round-1 journals were JSON text lines;
     # _replay_journal migrates them to frames on first open.
 
-    def _open_journal(self):
+    def _open_journal(self, truncate_tail: bool = True):
         from kuberay_tpu.native.journal import open_journal, valid_prefix_len
         # Truncate a torn tail: frames appended AFTER a tear would be
-        # unreachable to replay (it stops at the first bad frame).
-        try:
-            size = os.path.getsize(self._journal_path)
-            good = valid_prefix_len(self._journal_path)
-            if good < size:
-                with open(self._journal_path, "rb+") as f:
-                    f.truncate(good)
-        except OSError:
-            pass
+        # unreachable to replay (it stops at the first bad frame).  Only
+        # meaningful at construction — the post-compaction reopen passes
+        # False (the snapshot was just written and synced by this
+        # process; a full CRC re-scan under the store lock would stall
+        # every reader for nothing).
+        if truncate_tail:
+            try:
+                size = os.path.getsize(self._journal_path)
+                good = valid_prefix_len(self._journal_path)
+                if good < size:
+                    with open(self._journal_path, "rb+") as f:
+                        f.truncate(good)
+            except OSError:
+                pass
         self._journal = open_journal(self._journal_path,
                                      self._journal_engine)
 
@@ -202,8 +207,7 @@ class ObjectStore:
     def flush_journal(self):
         """Block until all acknowledged mutations are ON DISK (fdatasync
         via the native group-commit engine / fsync via the fallback)."""
-        if self._journal is not None:
-            self._journal.flush()
+        self._journal_ack()
 
     def _journal_ack(self):
         """Durable-ack barrier at the end of every public mutator, OUTSIDE
@@ -230,14 +234,20 @@ class ObjectStore:
              "objects": list(self._objects.values())}).encode())
         snap.flush()
         snap.close()
-        if self._journal is not None:
-            self._journal.close()
-        os.replace(tmp, self._journal_path)
+        old = self._journal
+        if old is not None:
+            old.close()
         try:
+            os.replace(tmp, self._journal_path)
             self._last_snapshot_bytes = os.path.getsize(self._journal_path)
+            self._open_journal(truncate_tail=False)
         except OSError:
-            self._last_snapshot_bytes = 0
-        self._open_journal()
+            # The old engine is closed (its append/flush silently no-op),
+            # which would let mutations ack without being journaled —
+            # reopen the surviving file so the journal stays live; if
+            # even that fails, surface it rather than run ack-blind.
+            self._journal = None
+            self._open_journal()   # raises on failure: mutators error out
 
     def _maybe_compact(self):
         try:

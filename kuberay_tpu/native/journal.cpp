@@ -81,6 +81,11 @@ struct Journal {
       batch.swap(pending);
       uint64_t seq = enqueued_seq;
       lk.unlock();
+      // Remember where this batch starts: a partial write must be
+      // truncated away before retrying, or the retried (complete)
+      // frames would sit BEHIND a torn frame where replay never reaches
+      // them — yet fdatasync would ack them as durable.
+      off_t batch_start = ::lseek(fd, 0, SEEK_END);
       size_t off = 0;
       while (off < batch.size()) {
         ssize_t n = ::write(fd, batch.data() + off, batch.size() - off);
@@ -90,6 +95,13 @@ struct Journal {
       }
       bool ok = off == batch.size();
       if (ok && sync_each_batch) ok = ::fdatasync(fd) == 0;
+      if (!ok && batch_start >= 0) {
+        // Cut the torn bytes so a successful retry appends at a frame
+        // boundary.  If even the truncate fails, the frames stay
+        // requeued and durable_seq never advances past them — flush()
+        // waiters time out instead of acking.
+        if (::ftruncate(fd, batch_start) != 0) { /* keep retrying */ }
+      }
       lk.lock();
       if (ok) {
         durable_seq = seq;
@@ -98,12 +110,8 @@ struct Journal {
       } else {
         // Failed batch: REQUEUE at the front (order preserved) and never
         // advance durable_seq — a later success must not claim these
-        // frames were synced (replay would silently restore a hole).
-        // Note: a partial write may leave a torn frame on disk; the
-        // retry appends complete frames after it and replay stops at
-        // the tear, which is why flush() waiters time out (error
-        // surfaced) rather than ack.  Back off to avoid hot-spinning on
-        // a persistent error.
+        // frames were synced.  Back off to avoid hot-spinning on a
+        // persistent error.
         pending.insert(pending.begin(), batch.begin(), batch.end());
         if (stop) return;   // shutting down: give up, waiters time out
         cv_work.wait_for(lk, std::chrono::milliseconds(50),

@@ -86,6 +86,8 @@ class NativeJournal:
             raise RuntimeError("native journal unavailable")
         self._lib = lib
         self._mu = threading.Lock()
+        self._cv = threading.Condition(self._mu)
+        self._inflight = 0
         self._h = lib.jrn_open(path.encode(), 1 if sync else 0)
         if not self._h:
             raise OSError(f"jrn_open failed: {path}")
@@ -102,14 +104,30 @@ class NativeJournal:
                 self._lib.jrn_append(self._h, payload, len(payload))
 
     def flush(self) -> None:
+        # jrn_flush blocks (group-commit wait, up to 5 s on a disk
+        # stall); it must run OUTSIDE _mu so concurrent flushers join
+        # the same in-flight batch instead of serializing — the C++
+        # side is thread-safe.  The refcount keeps close() from freeing
+        # the handle under us.
         with self._mu:
-            if not self._h:
+            h = self._h
+            if not h:
                 return   # closed: close() already drained + synced
-            if self._lib.jrn_flush(self._h) != 0:
-                raise OSError("journal flush timed out (disk stall/error)")
+            self._inflight += 1
+        try:
+            rc = self._lib.jrn_flush(h)
+        finally:
+            with self._mu:
+                self._inflight -= 1
+                if self._inflight == 0:
+                    self._cv.notify_all()
+        if rc != 0:
+            raise OSError("journal flush timed out (disk stall/error)")
 
     def close(self) -> None:
         with self._mu:
+            while self._inflight:
+                self._cv.wait()
             if self._h:
                 self._lib.jrn_close(self._h)
                 self._h = None

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 from typing import Optional
 
 import jax
@@ -340,6 +341,12 @@ def _flash_bwd(q, k, v, out, lse, do, scale, causal, bq, bkv, interpret):
 # Public API
 # --------------------------------------------------------------------------
 
+# Block-size targets, overridable for on-chip tuning sweeps
+# (tools/tpu_capture.py): largest power-of-two divisor <= target wins.
+_BQ_TARGET = int(os.environ.get("TPU_FLASH_BQ", "512"))
+_BKV_TARGET = int(os.environ.get("TPU_FLASH_BKV", "512"))
+
+
 def _pick_block(seq: int, target: int = 512) -> int:
     """Largest power-of-two block <= target that divides seq (min 8)."""
     b = min(target, seq)
@@ -348,25 +355,28 @@ def _pick_block(seq: int, target: int = 512) -> int:
     return max(b, 1)
 
 
+def _blocks(q, k):
+    return (_pick_block(q.shape[2], _BQ_TARGET),
+            _pick_block(k.shape[2], _BKV_TARGET))
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def _flash(q, k, v, scale, causal, interpret):
-    out, _ = _flash_fwd(q, k, v, scale, causal,
-                        _pick_block(q.shape[2]), _pick_block(k.shape[2]),
-                        interpret)
+    bq, bkv = _blocks(q, k)
+    out, _ = _flash_fwd(q, k, v, scale, causal, bq, bkv, interpret)
     return out
 
 
 def _flash_fwd_rule(q, k, v, scale, causal, interpret):
-    out, lse = _flash_fwd(q, k, v, scale, causal,
-                          _pick_block(q.shape[2]), _pick_block(k.shape[2]),
-                          interpret)
+    bq, bkv = _blocks(q, k)
+    out, lse = _flash_fwd(q, k, v, scale, causal, bq, bkv, interpret)
     return out, (q, k, v, out, lse)
 
 
 def _flash_bwd_rule(scale, causal, interpret, res, do):
     q, k, v, out, lse = res
-    return _flash_bwd(q, k, v, out, lse, do, scale, causal,
-                      _pick_block(q.shape[2]), _pick_block(k.shape[2]),
+    bq, bkv = _blocks(q, k)
+    return _flash_bwd(q, k, v, out, lse, do, scale, causal, bq, bkv,
                       interpret)
 
 

@@ -1,0 +1,170 @@
+#!/usr/bin/env python
+"""One-shot TPU measurement capture: run the full on-chip checklist the
+moment the tunnel is up (windows have been ~11 min — tools/tpu_watch.sh
+triggers this automatically on the first UP probe).
+
+Steps, in priority order (each its own subprocess with a timeout so one
+hang can't burn the window; partial results are still written):
+ 1. bench.py            — train tokens/s/chip + MFU (the BENCH_r02 line)
+ 2. bench.py --op       — flash fwd kernel vs XLA
+ 3. decode kernel       — pallas vs XLA, full + short lens
+ 4. paged kernel        — rewritten grid, vs gather-XLA
+ 5. flash block sweep   — TPU_FLASH_BQ/BKV targets on the 1b fwd+bwd shape
+ 6. flash bwd check     — fwd/bwd numerics vs XLA on-chip
+
+Results land in tpu_results/capture-<unix>.json (repo-tracked), one dict
+per step with rc/seconds/stdout-tail.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+OUT_DIR = REPO / "tpu_results"
+
+DECODE_SNIPPET = r"""
+import time, jax, jax.numpy as jnp
+from kuberay_tpu.ops.decode_attention import decode_attention
+def bench(f, *a, n=30):
+    f(*a).block_until_ready()
+    t0=time.perf_counter()
+    for _ in range(n): o = f(*a)
+    o.block_until_ready(); float(jnp.max(o))
+    return (time.perf_counter()-t0)/n*1e3
+B,K,Hq,Hkv,D = 64, 2048, 8, 4, 128
+ks = jax.random.split(jax.random.PRNGKey(0), 4)
+q  = jax.random.normal(ks[0],(B,Hq,D),jnp.bfloat16)
+ck = jax.random.normal(ks[1],(B,K,Hkv,D),jnp.bfloat16)
+cv = jax.random.normal(ks[2],(B,K,Hkv,D),jnp.bfloat16)
+fp = jax.jit(lambda *a: decode_attention(*a, impl='pallas'))
+fx = jax.jit(lambda *a: decode_attention(*a, impl='xla'))
+full = jnp.full((B,), K, jnp.int32); short = jnp.full((B,), 128, jnp.int32)
+d = float(jnp.max(jnp.abs(fp(q,ck,cv,full).astype(jnp.float32)-fx(q,ck,cv,full).astype(jnp.float32))))
+import json
+print(json.dumps({"diff": d,
+  "pallas_full_ms": bench(fp,q,ck,cv,full), "xla_full_ms": bench(fx,q,ck,cv,full),
+  "pallas_short_ms": bench(fp,q,ck,cv,short), "xla_short_ms": bench(fx,q,ck,cv,short)}))
+"""
+
+PAGED_SNIPPET = r"""
+import time, jax, jax.numpy as jnp, json
+from kuberay_tpu.ops.paged_attention import paged_decode_attention_pallas, paged_decode_attention_xla
+def bench(f, *a, n=30):
+    f(*a).block_until_ready()
+    t0=time.perf_counter()
+    for _ in range(n): o = f(*a)
+    o.block_until_ready(); float(jnp.max(o))
+    return (time.perf_counter()-t0)/n*1e3
+out = {}
+S,Hq,Hkv,D = 16, 8, 4, 128
+for bs, nblk in ((64, 16), (128, 8), (256, 4)):
+    P = 256
+    ks = jax.random.split(jax.random.PRNGKey(0), 5)
+    q  = jax.random.normal(ks[0],(S,Hq,D),jnp.bfloat16)
+    pk = jax.random.normal(ks[1],(Hkv,P*bs,D),jnp.bfloat16)
+    pv = jax.random.normal(ks[2],(Hkv,P*bs,D),jnp.bfloat16)
+    tb = jax.random.randint(ks[3],(S,nblk),0,P)
+    ln = jnp.full((S,), nblk*bs, jnp.int32)
+    p = jax.jit(lambda *a, bs=bs: paged_decode_attention_pallas(*a, block_size=bs))
+    x = jax.jit(lambda *a, bs=bs: paged_decode_attention_xla(*a, block_size=bs))
+    d = float(jnp.max(jnp.abs(p(q,pk,pv,ln,tb).astype(jnp.float32)-x(q,pk,pv,ln,tb).astype(jnp.float32))))
+    out[f"bs{bs}"] = {"diff": d, "pallas_ms": bench(p,q,pk,pv,ln,tb), "xla_ms": bench(x,q,pk,pv,ln,tb)}
+print(json.dumps(out))
+"""
+
+FLASH_CHECK_SNIPPET = r"""
+import jax, jax.numpy as jnp, json
+from kuberay_tpu.ops.attention import flash_attention
+B,S,Hq,Hkv,D = 2,2048,8,4,128
+ks = jax.random.split(jax.random.PRNGKey(0), 3)
+q = jax.random.normal(ks[0],(B,S,Hq,D),jnp.bfloat16)
+k = jax.random.normal(ks[1],(B,S,Hkv,D),jnp.bfloat16)
+v = jax.random.normal(ks[2],(B,S,Hkv,D),jnp.bfloat16)
+p = jax.jit(lambda q,k,v: flash_attention(q,k,v,causal=True,impl='pallas'))(q,k,v)
+x = jax.jit(lambda q,k,v: flash_attention(q,k,v,causal=True,impl='xla'))(q,k,v)
+fwd = float(jnp.max(jnp.abs(p.astype(jnp.float32)-x.astype(jnp.float32))))
+def lp(q,k,v,impl): return jnp.sum(flash_attention(q,k,v,causal=True,impl=impl).astype(jnp.float32)*0.01)
+gp = jax.jit(jax.grad(lambda *a: lp(*a,'pallas'), argnums=(0,1,2)))(q,k,v)
+gx = jax.jit(jax.grad(lambda *a: lp(*a,'xla'), argnums=(0,1,2)))(q,k,v)
+bwd = {n: float(jnp.max(jnp.abs(a.astype(jnp.float32)-b.astype(jnp.float32))))
+       for n,a,b in zip('qkv', gp, gx)}
+print(json.dumps({"fwd_maxdiff": fwd, "bwd_maxdiff": bwd}))
+"""
+
+BLOCK_SWEEP_SNIPPET = r"""
+import time, jax, jax.numpy as jnp, json
+from kuberay_tpu.ops.attention import flash_attention
+B,S,Hq,Hkv,D = 4,2048,16,8,128
+ks = jax.random.split(jax.random.PRNGKey(0), 3)
+q = jax.random.normal(ks[0],(B,S,Hq,D),jnp.bfloat16)
+k = jax.random.normal(ks[1],(B,S,Hkv,D),jnp.bfloat16)
+v = jax.random.normal(ks[2],(B,S,Hkv,D),jnp.bfloat16)
+fn = jax.jit(lambda q,k,v: flash_attention(q,k,v,causal=True,impl='pallas'))
+float(jnp.max(fn(q,k,v)))
+t0=time.perf_counter()
+out = q
+for _ in range(20): out = fn(out,k,v)
+float(jnp.max(out))
+print(json.dumps({"fwd_ms": (time.perf_counter()-t0)/20*1e3}))
+"""
+
+
+def run_step(name, argv, timeout, env=None):
+    t0 = time.time()
+    try:
+        out = subprocess.run(argv, capture_output=True, text=True,
+                             timeout=timeout, cwd=str(REPO),
+                             env={**os.environ, **(env or {})})
+        rc, text = out.returncode, (out.stdout + out.stderr)
+    except subprocess.TimeoutExpired as e:
+        rc, text = -99, (e.stdout or b"").decode(errors="replace") \
+            if isinstance(e.stdout, bytes) else (e.stdout or "")
+    rec = {"step": name, "rc": rc, "seconds": round(time.time() - t0, 1),
+           "tail": text.strip().splitlines()[-8:]}
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
+def main() -> int:
+    OUT_DIR.mkdir(exist_ok=True)
+    out_path = OUT_DIR / f"capture-{int(time.time())}.json"
+    results = []
+
+    def save():
+        out_path.write_text(json.dumps(results, indent=1) + "\n")
+
+    py = sys.executable
+    steps = [
+        ("bench_train", [py, "bench.py"], 560, None),
+        ("bench_op", [py, "bench.py", "--op"], 400, None),
+        ("decode_kernel", [py, "-c", DECODE_SNIPPET], 400, None),
+        ("paged_kernel", [py, "-c", PAGED_SNIPPET], 500, None),
+        ("flash_check", [py, "-c", FLASH_CHECK_SNIPPET], 400, None),
+    ]
+    for bq, bkv in ((512, 512), (1024, 512), (512, 1024), (1024, 1024),
+                    (256, 512), (1024, 256)):
+        steps.append((f"block_sweep_bq{bq}_bkv{bkv}",
+                      [py, "-c", BLOCK_SWEEP_SNIPPET], 300,
+                      {"TPU_FLASH_BQ": str(bq), "TPU_FLASH_BKV": str(bkv)}))
+
+    for name, argv, timeout, env in steps:
+        results.append(run_step(name, argv, timeout, env))
+        save()
+        # If the tunnel died mid-capture (hang/timeout), keep trying the
+        # remaining cheap steps only if something has succeeded already.
+        if results[-1]["rc"] == -99 and \
+                not any(r["rc"] == 0 for r in results):
+            break
+    save()
+    print(f"capture written: {out_path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,8 +1,14 @@
 #!/bin/bash
 # Poll TPU tunnel liveness; append one status line per probe to
-# /tmp/tpu_status.log so a build session can grab the chip the moment
-# the tunnel returns.  Usage: tools/tpu_watch.sh [interval_seconds]
+# /tmp/tpu_status.log.  On the FIRST probe that comes back UP, launch
+# tools/tpu_capture.py (once — marker file) so a short tunnel window is
+# never wasted waiting for a human.  Usage: tools/tpu_watch.sh [interval]
 INTERVAL=${1:-120}
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+MARKER=/tmp/tpu_capture.started
+# One capture per WATCHER SESSION: a stale marker from a crashed capture
+# or an earlier session must not suppress this session's launch.
+rm -f "$MARKER"
 while true; do
   if timeout 60 python -c "
 import jax, jax.numpy as jnp
@@ -10,6 +16,12 @@ x = jnp.ones((128,128), jnp.bfloat16)
 assert float((x@x).sum()) > 0
 " >/dev/null 2>&1; then
     echo "$(date -u +%H:%M:%S) UP" >> /tmp/tpu_status.log
+    if [ ! -f "$MARKER" ]; then
+      touch "$MARKER"
+      echo "$(date -u +%H:%M:%S) capture launched" >> /tmp/tpu_status.log
+      (cd "$REPO" && nohup python tools/tpu_capture.py \
+          > /tmp/tpu_capture.log 2>&1 &)
+    fi
   else
     echo "$(date -u +%H:%M:%S) down" >> /tmp/tpu_status.log
   fi
