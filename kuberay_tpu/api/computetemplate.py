@@ -1,0 +1,139 @@
+"""ComputeTemplate: named, reusable slice-shape presets.
+
+Reference capability: the apiserver v1 ComputeTemplate service
+(``proto/config.proto`` ComputeTemplate; stored as labeled ConfigMaps,
+resolved into container resources when the resource manager materializes
+a cluster).  TPU-native re-design: a template names a **slice shape** —
+TPU generation + ICI topology + per-host cpu/memory — because on TPU the
+accelerator count is a property of the (accelerator, topology) pair, not
+a free-form `gpu: N` field.  Worker groups opt in with
+``computeTemplate: <name>``; the operator resolves the template at
+reconcile time (kept resolution server-side like the reference, so every
+client — CLI, SDK, raw YAML — benefits).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+from kuberay_tpu.api.common import ObjectMeta, Serializable
+from kuberay_tpu.topology import SliceTopology
+
+KIND_COMPUTE_TEMPLATE = "ComputeTemplate"
+
+
+@dataclasses.dataclass
+class ComputeTemplateSpec(Serializable):
+    accelerator: str = "v5e"          # TPU generation (v4/v5e/v5p/v6e)
+    topology: str = "2x2"             # ICI topology of one slice
+    cpu: str = ""                     # per-host requests (optional)
+    memory: str = ""
+    nodeSelectors: Dict[str, str] = dataclasses.field(default_factory=dict)
+    tolerations: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+    description: str = ""
+
+
+@dataclasses.dataclass
+class ComputeTemplate(Serializable):
+    apiVersion: str = "tpu.dev/v1"
+    kind: str = KIND_COMPUTE_TEMPLATE
+    metadata: ObjectMeta = dataclasses.field(default_factory=ObjectMeta)
+    spec: ComputeTemplateSpec = dataclasses.field(
+        default_factory=ComputeTemplateSpec)
+
+    @classmethod
+    def _nested_types(cls):
+        return {"metadata": ObjectMeta, "spec": ComputeTemplateSpec}
+
+
+def validate_compute_template(t: ComputeTemplate) -> List[str]:
+    errs: List[str] = []
+    if not t.metadata.name:
+        errs.append("metadata.name is required")
+    try:
+        SliceTopology.create(t.spec.accelerator, t.spec.topology)
+    except Exception as e:  # noqa: BLE001 — surface as validation error
+        errs.append(f"spec: {e}")
+    return errs
+
+
+# --- builtin presets (ref python-client Director small/medium/large) ---------
+# Real slice shapes, stepping through TPU sizes rather than cpu tiers.
+
+BUILTIN_TEMPLATES: Dict[str, ComputeTemplateSpec] = {
+    "tpu-small": ComputeTemplateSpec(
+        accelerator="v5e", topology="2x2", cpu="8", memory="16Gi",
+        description="1 host, 4 chips (v5e 2x2)"),
+    "tpu-medium": ComputeTemplateSpec(
+        accelerator="v5e", topology="4x4", cpu="24", memory="48Gi",
+        description="4 hosts, 16 chips (v5e 4x4)"),
+    "tpu-large": ComputeTemplateSpec(
+        accelerator="v5p", topology="4x4x4", cpu="48", memory="96Gi",
+        description="16 hosts, 64 chips (v5p 4x4x4)"),
+}
+
+
+def builtin_template(name: str,
+                     namespace: str = "default") -> Optional[ComputeTemplate]:
+    spec = BUILTIN_TEMPLATES.get(name)
+    if spec is None:
+        return None
+    return ComputeTemplate(
+        metadata=ObjectMeta(name=name, namespace=namespace),
+        spec=dataclasses.replace(spec))
+
+
+def resolve_group_template(group, template: ComputeTemplate) -> None:
+    """Fill a WorkerGroupSpec in place from a template.
+
+    The template is authoritative for the slice shape (accelerator,
+    topology); cpu/memory/nodeSelectors/tolerations merge into the pod
+    template without overwriting anything the group set explicitly.
+    """
+    from kuberay_tpu.api.common import Container
+
+    group.accelerator = template.spec.accelerator
+    group.topology = template.spec.topology
+    pod_spec = group.template.spec               # typed PodSpec
+    if not pod_spec.containers:
+        pod_spec.containers = [Container(name="worker")]
+    c0 = pod_spec.containers[0]
+    if template.spec.cpu or template.spec.memory:
+        for slot in (c0.resources.requests, c0.resources.limits):
+            if template.spec.cpu:
+                slot.setdefault("cpu", template.spec.cpu)
+            if template.spec.memory:
+                slot.setdefault("memory", template.spec.memory)
+    for k, v in template.spec.nodeSelectors.items():
+        pod_spec.nodeSelector.setdefault(k, v)
+    for t in template.spec.tolerations:
+        if t not in pod_spec.tolerations:
+            pod_spec.tolerations.append(t)
+
+
+def resolve_compute_templates(cluster, store) -> List[str]:
+    """Resolve every ``computeTemplate`` reference in a TpuCluster spec,
+    mutating the in-memory spec only (the stored CR keeps the reference,
+    like the reference's ConfigMap indirection).  Lookup order: CR in the
+    cluster's namespace, then builtin presets.  Returns errors for
+    unknown template names."""
+    errs: List[str] = []
+    ns = cluster.metadata.namespace or "default"
+    for group in cluster.spec.workerGroupSpecs:
+        name = getattr(group, "computeTemplate", "")
+        if not name:
+            continue
+        raw = store.try_get(KIND_COMPUTE_TEMPLATE, name, ns)
+        template = (ComputeTemplate.from_dict(raw) if raw is not None
+                    else builtin_template(name, ns))
+        if template is None:
+            errs.append(f"workerGroup '{group.groupName}': unknown "
+                        f"computeTemplate '{name}'")
+            continue
+        terrs = validate_compute_template(template)
+        if terrs:
+            errs.extend(f"computeTemplate '{name}': {e}" for e in terrs)
+            continue
+        resolve_group_template(group, template)
+    return errs

@@ -112,6 +112,7 @@ class WorkerGroupSpec(Serializable):
     groupName: str = ""
     accelerator: str = "v5e"            # TPU generation
     topology: str = "2x2"               # ICI topology, e.g. "4x4" / "4x4x4"
+    computeTemplate: str = ""           # named slice preset (api/computetemplate)
     replicas: int = 1                   # number of slices
     minReplicas: int = 0
     maxReplicas: int = 1
@@ -123,6 +124,21 @@ class WorkerGroupSpec(Serializable):
     @classmethod
     def _nested_types(cls):
         return {"scaleStrategy": ScaleStrategy, "template": PodTemplateSpec}
+
+    # Friendly wire aliases accepted from clients (the SDK/dashboard speak
+    # in slices): canonical keys win when both are present.
+    _ALIASES = (("numSlices", "replicas"), ("tpuVersion", "accelerator"))
+
+    @classmethod
+    def from_dict(cls, d):
+        if d:
+            d = dict(d)
+            for alias, canon in cls._ALIASES:
+                if alias in d:
+                    if canon not in d:
+                        d[canon] = d[alias]
+                    del d[alias]
+        return super().from_dict(d)
 
     def slice_topology(self) -> SliceTopology:
         return SliceTopology.create(self.accelerator, self.topology)

@@ -166,8 +166,9 @@ function viewNew(el){
    headGroupSpec:{template:{spec:{containers:[{name:'head',
      image:document.getElementById('f-image').value}]}}},
    workerGroupSpecs:[{groupName:'workers',
-     numSlices:parseInt(document.getElementById('f-slices').value)||1,
-     tpuVersion:document.getElementById('f-tpu').value,
+     replicas:parseInt(document.getElementById('f-slices').value)||1,
+     maxReplicas:parseInt(document.getElementById('f-slices').value)||1,
+     accelerator:document.getElementById('f-tpu').value,
      topology:document.getElementById('f-topo').value,
      template:{spec:{containers:[{name:'worker',
        image:document.getElementById('f-image').value}]}}}]};

@@ -34,6 +34,8 @@ KIND_BY_ALIAS = {
     "service": "TpuService", "services": "TpuService",
     "cronjob": "TpuCronJob", "cronjobs": "TpuCronJob",
     "events": "Event", "pods": "Pod", "slices": "Pod",
+    "computetemplate": "ComputeTemplate",
+    "computetemplates": "ComputeTemplate",
 }
 
 

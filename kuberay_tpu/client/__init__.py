@@ -12,6 +12,7 @@ typed CR APIs with wait-helpers + a builder/director for cluster specs.
 
 from kuberay_tpu.cli.client import ApiClient, ApiError
 from kuberay_tpu.client.apis import (
+    ComputeTemplateApi,
     TpuClusterApi,
     TpuJobApi,
     TpuServiceApi,
@@ -19,6 +20,6 @@ from kuberay_tpu.client.apis import (
 )
 from kuberay_tpu.client.builder import ClusterBuilder, Director, utils
 
-__all__ = ["ApiClient", "ApiError", "TpuClusterApi", "TpuJobApi",
-           "TpuServiceApi", "WaitTimeout", "ClusterBuilder", "Director",
-           "utils"]
+__all__ = ["ApiClient", "ApiError", "ComputeTemplateApi", "TpuClusterApi",
+           "TpuJobApi", "TpuServiceApi", "WaitTimeout", "ClusterBuilder",
+           "Director", "utils"]

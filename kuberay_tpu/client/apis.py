@@ -68,6 +68,23 @@ class _KindApi:
                namespace: str = "default") -> Dict[str, Any]:
         return self.get(name, namespace).get("status", {})
 
+    def edit(self, name: str, namespace: str,
+             mutate: Callable[[Dict[str, Any]], None],
+             retries: int = 5) -> Dict[str, Any]:
+        """Read-modify-write with optimistic-concurrency retry: the
+        operator writes status continuously, so a bare get→update loses
+        races (HTTP 409 on resourceVersion).  Re-fetch and re-apply."""
+        for attempt in range(retries):
+            obj = self.get(name, namespace)
+            mutate(obj)
+            try:
+                return self.update(obj)
+            except ApiError as e:
+                if e.code != 409 or attempt == retries - 1:
+                    raise
+                time.sleep(0.05 * (attempt + 1))
+        raise AssertionError("unreachable")
+
     # wait plumbing ----------------------------------------------------
 
     def _wait(self, name: str, namespace: str,
@@ -103,23 +120,26 @@ class TpuClusterApi(_KindApi):
                            namespace: str = "default") -> Dict[str, Any]:
         """Set a worker group's slice count (ref
         update_worker_group_replicas, kuberay_cluster_utils.py:257)."""
-        obj = self.get(name, namespace)
-        groups = obj["spec"].get("workerGroupSpecs", [])
-        for g in groups:
-            if g.get("groupName") == group_name:
-                g["numSlices"] = num_slices
-                return self.update(obj)
-        raise KeyError(f"worker group {group_name!r} not in {name}")
+        def mutate(obj):
+            for g in obj["spec"].get("workerGroupSpecs", []):
+                if g.get("groupName") == group_name:
+                    g.pop("numSlices", None)  # stale alias must not shadow
+                    g["replicas"] = num_slices
+                    if g.get("maxReplicas", 1) < num_slices:
+                        g["maxReplicas"] = num_slices
+                    if g.get("minReplicas", 0) > num_slices:
+                        g["minReplicas"] = num_slices
+                    return
+            raise KeyError(f"worker group {group_name!r} not in {name}")
+        return self.edit(name, namespace, mutate)
 
     def suspend(self, name: str, namespace: str = "default"):
-        obj = self.get(name, namespace)
-        obj["spec"]["suspend"] = True
-        return self.update(obj)
+        return self.edit(name, namespace,
+                         lambda o: o["spec"].__setitem__("suspend", True))
 
     def resume(self, name: str, namespace: str = "default"):
-        obj = self.get(name, namespace)
-        obj["spec"]["suspend"] = False
-        return self.update(obj)
+        return self.edit(name, namespace,
+                         lambda o: o["spec"].__setitem__("suspend", False))
 
 
 class TpuJobApi(_KindApi):
@@ -161,14 +181,12 @@ class TpuJobApi(_KindApi):
 
     def suspend(self, name: str, namespace: str = "default"):
         """ref suspend_job (kuberay_job_api.py:255)."""
-        obj = self.get(name, namespace)
-        obj["spec"]["suspend"] = True
-        return self.update(obj)
+        return self.edit(name, namespace,
+                         lambda o: o["spec"].__setitem__("suspend", True))
 
     def resume(self, name: str, namespace: str = "default"):
-        obj = self.get(name, namespace)
-        obj["spec"]["suspend"] = False
-        return self.update(obj)
+        return self.edit(name, namespace,
+                         lambda o: o["spec"].__setitem__("suspend", False))
 
     def resubmit(self, name: str, namespace: str = "default"):
         """Delete + recreate with the same spec (ref resubmit_job,
@@ -201,3 +219,21 @@ class TpuServiceApi(_KindApi):
             name, namespace,
             lambda s: s.get("serviceStatus") in ("Healthy", "Running"),
             timeout, poll, "serviceStatus Healthy")
+
+
+class ComputeTemplateApi(_KindApi):
+    """CRUD for named slice presets (ref apiserver v1 ComputeTemplate
+    service; the operator resolves references server-side)."""
+
+    kind = "ComputeTemplate"
+
+    def create_template(self, name: str, accelerator: str, topology: str,
+                        cpu: str = "", memory: str = "",
+                        namespace: str = "default",
+                        description: str = "") -> Dict[str, Any]:
+        return self.create({
+            "apiVersion": "tpu.dev/v1", "kind": self.kind,
+            "metadata": {"name": name, "namespace": namespace},
+            "spec": {"accelerator": accelerator, "topology": topology,
+                     "cpu": cpu, "memory": memory,
+                     "description": description}})

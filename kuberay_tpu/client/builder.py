@@ -24,6 +24,7 @@ class ClusterBuilder:
         self._head: Optional[Dict[str, Any]] = None
         self._groups: List[Dict[str, Any]] = []
         self._spec_extras: Dict[str, Any] = {}
+        self._autoscale_band: Optional[tuple] = None
 
     def with_meta(self, name: str, namespace: str = "default",
                   labels: Optional[Dict[str, str]] = None,
@@ -57,30 +58,50 @@ class ClusterBuilder:
                           tpu_version: str = "v5e", topology: str = "2x4",
                           num_slices: int = 1,
                           image: str = "tpu-runtime:latest",
-                          env: Optional[Dict[str, str]] = None
+                          env: Optional[Dict[str, str]] = None,
+                          compute_template: str = "",
                           ) -> "ClusterBuilder":
-        SliceTopology.create(tpu_version, topology)   # validate eagerly
+        """Add a worker group.  ``compute_template`` names a ComputeTemplate
+        CR (or builtin preset) that the operator resolves server-side; when
+        set, tpu_version/topology are ignored (the template is
+        authoritative for the slice shape)."""
+        if not compute_template:
+            SliceTopology.create(tpu_version, topology)   # validate eagerly
         container = {"name": "worker", "image": image}
         if env:
             container["env"] = [{"name": k, "value": v}
                                 for k, v in sorted(env.items())]
-        self._groups.append({
+        group: Dict[str, Any] = {
             "groupName": group_name,
-            "numSlices": num_slices,
-            "tpuVersion": tpu_version,
-            "topology": topology,
+            "replicas": num_slices,
+            "maxReplicas": max(num_slices, 1),
             "template": {"spec": {"containers": [container]}},
-        })
+        }
+        if compute_template:
+            group["computeTemplate"] = compute_template
+        else:
+            group["accelerator"] = tpu_version
+            group["topology"] = topology
+        self._groups.append(group)
         return self
 
     def with_suspend(self, suspend: bool = True) -> "ClusterBuilder":
         self._spec_extras["suspend"] = suspend
         return self
 
-    def with_autoscaling(self, min_slices: int,
-                         max_slices: int) -> "ClusterBuilder":
+    def with_autoscaling(self, min_slices: int, max_slices: int,
+                         idle_timeout_seconds: int = 60,
+                         upscaling_mode: str = "Default"
+                         ) -> "ClusterBuilder":
+        """Enable the in-tree slice autoscaler.  The min/max band applies
+        to every worker group at ``build()`` time (per-group bands are
+        group-spec fields; the options object holds behavior knobs only),
+        so call order relative to with_worker_group doesn't matter."""
+        self._spec_extras["enableInTreeAutoscaling"] = True
         self._spec_extras["autoscalerOptions"] = {
-            "minSlices": min_slices, "maxSlices": max_slices}
+            "idleTimeoutSeconds": idle_timeout_seconds,
+            "upscalingMode": upscaling_mode}
+        self._autoscale_band = (min_slices, max_slices)
         return self
 
     def build(self) -> Dict[str, Any]:
@@ -88,6 +109,12 @@ class ClusterBuilder:
             raise ValueError("with_meta(name=...) is required")
         if self._head is None:
             self.with_head()
+        if getattr(self, "_autoscale_band", None):
+            lo, hi = self._autoscale_band
+            for g in self._groups:
+                g["minReplicas"] = lo
+                g["maxReplicas"] = hi
+                g["replicas"] = min(max(g.get("replicas", 1), lo), hi)
         spec: Dict[str, Any] = {"headGroupSpec": self._head}
         if self._groups:
             spec["workerGroupSpecs"] = self._groups
@@ -185,7 +212,12 @@ class utils:
         out = copy.deepcopy(cluster)
         for g in out["spec"].get("workerGroupSpecs", []):
             if g.get("groupName") == group_name:
-                g["numSlices"] = num_slices
+                g.pop("numSlices", None)   # stale alias must not shadow
+                g["replicas"] = num_slices
+                if g.get("maxReplicas", 1) < num_slices:
+                    g["maxReplicas"] = num_slices
+                if g.get("minReplicas", 0) > num_slices:
+                    g["minReplicas"] = num_slices
                 return out
         raise KeyError(f"worker group {group_name!r} not found")
 

@@ -27,6 +27,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from kuberay_tpu.api.common import Condition, set_condition
+from kuberay_tpu.api.computetemplate import resolve_compute_templates
 from kuberay_tpu.api.tpucluster import (
     ClusterConditionType,
     ClusterState,
@@ -103,7 +104,11 @@ class TpuClusterController:
         if cluster.metadata.deletionTimestamp:
             return self._reconcile_deletion(cluster)
 
-        errs = validate_cluster(cluster)
+        # Resolve named slice presets before validation so a template-filled
+        # group is validated exactly like an explicit one (server-side, so
+        # every client benefits — ref apiserver ComputeTemplate resolution).
+        errs = resolve_compute_templates(cluster, self.store)
+        errs += validate_cluster(cluster)
         if errs:
             self.recorder.warning(raw, C.EVENT_INVALID_SPEC, "; ".join(errs))
             self._set_status(cluster, state=ClusterState.FAILED,

@@ -70,9 +70,17 @@ class Manager:
             self.enqueue((ev.kind, md.get("namespace", "default"),
                           md.get("name", "")))
         for fn in self._owned_maps:
-            key = fn(ev)
-            if key is not None and key[0] in self._reconcilers:
-                self.enqueue(key)
+            keys = fn(ev)
+            if keys is None:
+                continue
+            # A mapper may fan one event out to several owners (e.g. a
+            # ComputeTemplate change re-reconciles every referencing
+            # cluster); a bare Key tuple means exactly one.
+            if isinstance(keys, tuple):
+                keys = [keys]
+            for key in keys:
+                if key[0] in self._reconcilers:
+                    self.enqueue(key)
 
     def enqueue(self, key: Key, after: float = 0.0):
         with self._lock:

@@ -65,6 +65,7 @@ struct Journal {
   uint64_t durable_seq = 0;           // frames fdatasync'd
   bool stop = false;
   bool sync_each_batch = true;
+  off_t tear_at = -1;    // torn-write offset still awaiting truncation
   std::thread flusher;
 
   void run() {
@@ -81,6 +82,22 @@ struct Journal {
       batch.swap(pending);
       uint64_t seq = enqueued_seq;
       lk.unlock();
+      // A tear from an earlier failed batch MUST be cut before anything
+      // else is written: frames appended behind a torn frame are
+      // unreachable by replay yet would be acked by fdatasync.  Until
+      // the truncate succeeds, no write happens and durable_seq stays
+      // put, so flush() waiters time out instead of acking lost state.
+      if (tear_at >= 0) {
+        if (::ftruncate(fd, tear_at) != 0) {
+          lk.lock();
+          pending.insert(pending.begin(), batch.begin(), batch.end());
+          if (stop) return;
+          cv_work.wait_for(lk, std::chrono::milliseconds(50),
+                           [&] { return stop; });
+          continue;
+        }
+        tear_at = -1;
+      }
       // Remember where this batch starts: a partial write must be
       // truncated away before retrying, or the retried (complete)
       // frames would sit BEHIND a torn frame where replay never reaches
@@ -97,10 +114,10 @@ struct Journal {
       if (ok && sync_each_batch) ok = ::fdatasync(fd) == 0;
       if (!ok && batch_start >= 0) {
         // Cut the torn bytes so a successful retry appends at a frame
-        // boundary.  If even the truncate fails, the frames stay
-        // requeued and durable_seq never advances past them — flush()
-        // waiters time out instead of acking.
-        if (::ftruncate(fd, batch_start) != 0) { /* keep retrying */ }
+        // boundary.  If even the truncate fails, record the tear: the
+        // loop above refuses to write anything until it is cut, so no
+        // later frame can land behind it and be falsely acked.
+        if (::ftruncate(fd, batch_start) != 0) tear_at = batch_start;
       }
       lk.lock();
       if (ok) {

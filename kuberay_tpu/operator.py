@@ -115,6 +115,20 @@ class Operator:
                     return None
                 return (KIND_WARM_POOL, md.get("namespace", "default"), pool)
             m.map_owned(warm_pod_mapper)
+        def compute_template_mapper(ev):
+            # A ComputeTemplate create/update re-reconciles every cluster
+            # referencing it, so a cluster that failed on a missing or
+            # broken template self-heals once the template appears/is fixed.
+            if ev.kind != "ComputeTemplate":
+                return None
+            md = ev.obj.get("metadata", {})
+            ns, tname = md.get("namespace", "default"), md.get("name", "")
+            return [(C.KIND_CLUSTER, ns, cl["metadata"]["name"])
+                    for cl in self.store.list(C.KIND_CLUSTER, namespace=ns)
+                    if any(g.get("computeTemplate") == tname
+                           for g in cl.get("spec", {}).get(
+                               "workerGroupSpecs", []))]
+        m.map_owned(compute_template_mapper)
         m.map_owned(owned_pod_mapper)
         m.map_owned(originated_from_mapper(C.KIND_JOB))
         m.map_owned(originated_from_mapper(C.KIND_SERVICE))

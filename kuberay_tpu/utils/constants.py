@@ -117,6 +117,7 @@ CRD_PLURALS = {
     KIND_CRONJOB: "tpucronjobs",
     "WarmSlicePool": "warmslicepools",
     "TrafficRoute": "trafficroutes",
+    "ComputeTemplate": "computetemplates",
 }
 CORE_PLURALS = {
     "Pod": "pods", "Service": "services", "Event": "events",

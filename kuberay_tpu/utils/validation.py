@@ -214,9 +214,15 @@ def validate_cronjob(cron: TpuCronJob) -> List[str]:
 def kind_validators():
     """kind -> dict-validating callable (shared by the apiserver and the
     admission webhook — one validation surface, two front doors)."""
+    from kuberay_tpu.api.computetemplate import (
+        ComputeTemplate,
+        validate_compute_template,
+    )
     return {
         "TpuCluster": lambda d: validate_cluster(TpuCluster.from_dict(d)),
         "TpuJob": lambda d: validate_job(TpuJob.from_dict(d)),
         "TpuService": lambda d: validate_service(TpuService.from_dict(d)),
         "TpuCronJob": lambda d: validate_cronjob(TpuCronJob.from_dict(d)),
+        "ComputeTemplate": lambda d: validate_compute_template(
+            ComputeTemplate.from_dict(d)),
     }

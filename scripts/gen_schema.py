@@ -14,6 +14,7 @@ import typing
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
 from kuberay_tpu.api.schema import crd_schema  # noqa: E402
+from kuberay_tpu.api.computetemplate import ComputeTemplate  # noqa: E402
 from kuberay_tpu.api.tpucluster import TpuCluster  # noqa: E402
 from kuberay_tpu.api.tpucronjob import TpuCronJob  # noqa: E402
 from kuberay_tpu.api.tpujob import TpuJob  # noqa: E402
@@ -24,7 +25,7 @@ OUT = pathlib.Path(__file__).resolve().parent.parent / "docs" / "crds"
 
 def main():
     OUT.mkdir(parents=True, exist_ok=True)
-    for cls in (TpuCluster, TpuJob, TpuService, TpuCronJob):
+    for cls in (TpuCluster, TpuJob, TpuService, TpuCronJob, ComputeTemplate):
         doc = crd_schema(cls)
         path = OUT / f"{cls.__name__.lower()}.schema.json"
         path.write_text(json.dumps(doc, indent=2) + "\n")

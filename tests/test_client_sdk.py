@@ -36,8 +36,10 @@ def test_builder_fluent_build():
     assert doc["metadata"]["labels"] == {"team": "ml"}
     assert doc["spec"]["headGroupSpec"]["enableIngress"] is True
     g = doc["spec"]["workerGroupSpecs"][0]
-    assert (g["numSlices"], g["tpuVersion"], g["topology"]) == (2, "v5e", "4x4")
-    assert doc["spec"]["autoscalerOptions"] == {"minSlices": 1, "maxSlices": 4}
+    assert (g["replicas"], g["accelerator"], g["topology"]) == (2, "v5e", "4x4")
+    # Autoscaling lands on the canonical knobs the operator consumes.
+    assert doc["spec"]["enableInTreeAutoscaling"] is True
+    assert (g["minReplicas"], g["maxReplicas"]) == (1, 4)
     # Build output passes the admission validator.
     assert validate_cluster(TpuCluster.from_dict(doc)) == []
 
@@ -60,7 +62,7 @@ def test_director_presets_validate():
         assert validate_cluster(TpuCluster.from_dict(doc)) == [], doc["metadata"]
     large = d.build_large_cluster("d")
     g = large["spec"]["workerGroupSpecs"][0]
-    assert (g["tpuVersion"], g["numSlices"]) == ("v6e", 4)
+    assert (g["accelerator"], g["replicas"]) == ("v6e", 4)
 
 
 def test_spec_surgery_utils():
@@ -69,7 +71,7 @@ def test_spec_surgery_utils():
     assert [g["groupName"] for g in doc["spec"]["workerGroupSpecs"]] == \
         ["workers", "workers-b"]
     doc = utils.update_worker_group_slices(doc, "workers-b", 3)
-    assert doc["spec"]["workerGroupSpecs"][1]["numSlices"] == 3
+    assert doc["spec"]["workerGroupSpecs"][1]["replicas"] == 3
     doc = utils.delete_worker_group(doc, "workers")
     assert [g["groupName"] for g in doc["spec"]["workerGroupSpecs"]] == \
         ["workers-b"]
@@ -124,7 +126,13 @@ def test_cluster_api_lifecycle(live_op):
 
     clusters.scale_worker_group("sdk-c1", "workers", 2)
     assert clusters.get("sdk-c1")["spec"]["workerGroupSpecs"][0][
-        "numSlices"] == 2
+        "replicas"] == 2
+    # The operator actually executes the scale (the old alias-keyed write
+    # was silently ignored): a second slice's pods appear.  State stays
+    # "ready" during scale-up, so wait on the slice count itself.
+    assert clusters._wait("sdk-c1", "default",
+                          lambda s: s.get("readySlices") == 2,
+                          60, 0.2, "readySlices == 2")
 
     clusters.suspend("sdk-c1")
     assert clusters._wait("sdk-c1", "default",

@@ -1,0 +1,188 @@
+"""ComputeTemplate: named slice presets resolved server-side.
+
+Reference capability: apiserver v1 ComputeTemplate service
+(proto/config.proto; templates stored as ConfigMaps, resolved by the
+resource manager when materializing clusters).  Here templates are CRs
+(or builtin presets) resolved by the cluster controller at reconcile
+time, so CLI/SDK/raw-YAML clients all benefit.
+"""
+
+import pytest
+
+from kuberay_tpu.api.common import ObjectMeta
+from kuberay_tpu.api.computetemplate import (
+    BUILTIN_TEMPLATES,
+    ComputeTemplate,
+    ComputeTemplateSpec,
+    builtin_template,
+    validate_compute_template,
+)
+from kuberay_tpu.api.config import OperatorConfiguration
+from kuberay_tpu.api.tpucluster import TpuCluster
+from kuberay_tpu.operator import Operator
+from kuberay_tpu.utils import constants as C
+from kuberay_tpu.utils import features
+from kuberay_tpu.utils.validation import validate_cluster
+
+
+@pytest.fixture(autouse=True)
+def reset_gates():
+    features.reset()
+    yield
+    features.reset()
+
+
+@pytest.fixture
+def op():
+    o = Operator(OperatorConfiguration(), fake_kubelet=True)
+    yield o
+    o.kubelet.close()
+
+
+def settle(op, rounds=8):
+    for _ in range(rounds):
+        op.run_until_idle()
+
+
+def make_templated_cluster(template_name, name="demo"):
+    return {
+        "apiVersion": "tpu.dev/v1", "kind": "TpuCluster",
+        "metadata": {"name": name},
+        "spec": {
+            "headGroupSpec": {"template": {"spec": {"containers": [
+                {"name": "head", "image": "img"}]}}},
+            "workerGroupSpecs": [{
+                "groupName": "workers",
+                "computeTemplate": template_name,
+                "replicas": 1, "maxReplicas": 2,
+                "template": {"spec": {"containers": [
+                    {"name": "worker", "image": "img"}]}},
+            }],
+        },
+    }
+
+
+def test_builtin_presets_are_valid():
+    for name in BUILTIN_TEMPLATES:
+        t = builtin_template(name)
+        assert validate_compute_template(t) == [], name
+
+
+def test_builtin_template_resolves_and_provisions(op):
+    op.store.create(make_templated_cluster("tpu-medium"))
+    settle(op)
+    got = op.store.get(C.KIND_CLUSTER, "demo")
+    assert got["status"]["state"] == "ready", got["status"]
+    # v5e 4x4 = 4 hosts per slice: 1 head + 4 workers.
+    workers = op.store.list(
+        "Pod", labels={C.LABEL_NODE_TYPE: C.NODE_TYPE_WORKER})
+    assert len(workers) == 4
+    env = {e["name"]: e.get("value", "")
+           for e in workers[0]["spec"]["containers"][0]["env"]}
+    assert env[C.ENV_TPU_TOPOLOGY] == "4x4"
+    # Template cpu/memory landed as container requests.
+    res = workers[0]["spec"]["containers"][0]["resources"]["requests"]
+    assert res["cpu"] == "24" and res["memory"] == "48Gi"
+    # The stored CR keeps the indirection (resolution is in-memory only).
+    stored_group = got["spec"]["workerGroupSpecs"][0]
+    assert stored_group["computeTemplate"] == "tpu-medium"
+    assert "accelerator" not in stored_group or \
+        stored_group["accelerator"] == "v5e"
+
+
+def test_cr_template_shadows_builtin(op):
+    op.store.create(ComputeTemplate(
+        metadata=ObjectMeta(name="tpu-medium"),
+        spec=ComputeTemplateSpec(accelerator="v5p", topology="2x2x1",
+                                 nodeSelectors={"pool": "gold"}),
+    ).to_dict())
+    op.store.create(make_templated_cluster("tpu-medium"))
+    settle(op)
+    workers = op.store.list(
+        "Pod", labels={C.LABEL_NODE_TYPE: C.NODE_TYPE_WORKER})
+    env = {e["name"]: e.get("value", "")
+           for e in workers[0]["spec"]["containers"][0]["env"]}
+    assert env[C.ENV_TPU_TOPOLOGY] == "2x2x1"
+    assert workers[0]["spec"]["nodeSelector"]["pool"] == "gold"
+
+
+def test_unknown_template_fails_validation(op):
+    op.store.create(make_templated_cluster("no-such-preset"))
+    settle(op)
+    got = op.store.get(C.KIND_CLUSTER, "demo")
+    assert got["status"]["state"] == "failed"
+    assert "no-such-preset" in got["status"].get("reason", "")
+    assert not op.store.list("Pod")
+
+
+def test_cluster_self_heals_when_template_appears(op):
+    """Cluster referencing a not-yet-created template fails, then recovers
+    as soon as the ComputeTemplate CR lands (event-mapped resync — no
+    manual touch of the cluster object)."""
+    op.store.create(make_templated_cluster("late-template"))
+    settle(op)
+    assert op.store.get(C.KIND_CLUSTER, "demo")["status"]["state"] == "failed"
+    op.store.create(ComputeTemplate(
+        metadata=ObjectMeta(name="late-template"),
+        spec=ComputeTemplateSpec(accelerator="v5e", topology="2x2"),
+    ).to_dict())
+    settle(op)
+    got = op.store.get(C.KIND_CLUSTER, "demo")
+    assert got["status"]["state"] == "ready", got["status"]
+
+
+def test_admission_rejects_invalid_template():
+    """Invalid templates are rejected at the door (shared validation
+    surface), not discovered later by referencing clusters."""
+    from kuberay_tpu.utils.validation import kind_validators
+    v = kind_validators()["ComputeTemplate"]
+    assert v({"metadata": {"name": "bad"},
+              "spec": {"accelerator": "v5e", "topology": "3x5"}})
+    assert v({"metadata": {"name": "ok"},
+              "spec": {"accelerator": "v5e", "topology": "4x4"}}) == []
+
+
+def test_sdk_create_template_payload_is_valid():
+    from kuberay_tpu.client.apis import ComputeTemplateApi
+
+    class _Capture:
+        def create(self, body):
+            self.body = body
+            return body
+    api = ComputeTemplateApi.__new__(ComputeTemplateApi)
+    api.client = _Capture()
+    body = api.create_template("t1", "v5p", "2x2x1", cpu="8", memory="16Gi")
+    t = ComputeTemplate.from_dict(body)
+    assert validate_compute_template(t) == []
+    assert t.spec.cpu == "8" and t.spec.memory == "16Gi"
+
+
+def test_group_explicit_fields_win_over_template_resources():
+    """A group that sets its own cpu requests keeps them; the template
+    only fills gaps."""
+    from kuberay_tpu.api.computetemplate import resolve_group_template
+    cluster = TpuCluster.from_dict(make_templated_cluster("tpu-small"))
+    group = cluster.spec.workerGroupSpecs[0]
+    group.template.spec.containers[0].resources.requests["cpu"] = "99"
+    resolve_group_template(group, builtin_template("tpu-small"))
+    res = group.template.spec.containers[0].resources
+    assert res.requests["cpu"] == "99"             # explicit wins
+    assert res.requests["memory"] == "16Gi"        # gap filled
+    assert group.accelerator == "v5e" and group.topology == "2x2"
+    assert validate_cluster(cluster) == []
+
+
+def test_worker_group_alias_keys_accepted():
+    """SDK/dashboard friendly keys (numSlices/tpuVersion) parse into the
+    canonical fields; canonical keys win when both appear."""
+    doc = make_templated_cluster("")
+    g = doc["spec"]["workerGroupSpecs"][0]
+    del g["computeTemplate"]
+    g.update({"numSlices": 3, "tpuVersion": "v6e", "maxReplicas": 3})
+    del g["replicas"]
+    c = TpuCluster.from_dict(doc)
+    assert c.spec.workerGroupSpecs[0].replicas == 3
+    assert c.spec.workerGroupSpecs[0].accelerator == "v6e"
+    g["replicas"] = 1          # canonical beats alias
+    c = TpuCluster.from_dict(doc)
+    assert c.spec.workerGroupSpecs[0].replicas == 1

@@ -1,0 +1,158 @@
+"""Sample-YAML conformance tier.
+
+Reference model: ``test/sampleyaml/`` + ``.github/workflows/test-sample-yamls.yml``
+apply every ``config/samples/*.yaml`` and assert the CR reaches readiness.
+Here every file under ``samples/`` is applied through the FULL operator
+(all controllers registered, fake kubelet running pods) and must reach its
+kind's ready state — so a sample that drifts from the API types or trips
+validation fails CI, not a user.
+"""
+
+import glob
+import os
+
+import pytest
+import yaml
+
+from kuberay_tpu.api.config import OperatorConfiguration
+from kuberay_tpu.operator import Operator
+from kuberay_tpu.runtime.coordinator_client import FakeCoordinatorClient
+from kuberay_tpu.utils import constants as C
+from kuberay_tpu.utils import features
+
+SAMPLES = sorted(glob.glob(
+    os.path.join(os.path.dirname(__file__), "..", "samples", "*.yaml")))
+
+
+def sample_id(path):
+    return os.path.basename(path)
+
+
+@pytest.fixture(autouse=True)
+def reset_gates():
+    features.reset()
+    yield
+    features.reset()
+
+
+class SampleHarness:
+    """Full operator + fake kubelet + per-cluster fake coordinators."""
+
+    def __init__(self):
+        self.clients = {}
+
+        def provider(status):
+            # Key fake coordinators by coordinator URL so each cluster
+            # (active/pending pair, retry clusters...) gets its own.
+            key = getattr(status, "coordinatorURL", "") or "default"
+            return self.clients.setdefault(key, FakeCoordinatorClient())
+
+        self.operator = Operator(
+            OperatorConfiguration(featureGates={"TpuCronJob": True}),
+            client_provider=provider, fake_kubelet=True)
+        self.store = self.operator.store
+
+    def settle(self, rounds=12):
+        for _ in range(rounds):
+            self.operator.run_until_idle()
+            # Serve apps report RUNNING once their config lands (the same
+            # seam rayservice envtest fakes: set_serve_app on submission).
+            for client in self.clients.values():
+                if client.serve_config is not None and not client.serve_apps:
+                    for app in client.serve_config.get("applications", []):
+                        client.set_serve_app(app.get("name", "app"), "RUNNING")
+        self.operator.run_until_idle()
+
+    def warning_events(self):
+        return [e for e in self.store.list("Event")
+                if e.get("type") == "Warning"]
+
+
+@pytest.fixture
+def h():
+    return SampleHarness()
+
+
+def load(path):
+    with open(path) as f:
+        return yaml.safe_load(f)
+
+
+def expected_slices(cluster_spec):
+    return sum(int(g.get("replicas", 0) or 0)
+               for g in cluster_spec.get("workerGroupSpecs", []))
+
+
+def test_all_kinds_are_covered():
+    """Every sample parses and no CR kind lacks a conformance branch."""
+    kinds = {load(p)["kind"] for p in SAMPLES}
+    assert kinds <= {"TpuCluster", "TpuJob", "TpuService", "TpuCronJob",
+                     "ComputeTemplate"}
+    # The four workload kinds all have at least one sample.
+    assert {"TpuCluster", "TpuJob", "TpuService", "TpuCronJob"} <= kinds
+
+
+@pytest.mark.parametrize("path", SAMPLES, ids=sample_id)
+def test_sample_reaches_ready(h, path):
+    doc = load(path)
+    kind, name = doc["kind"], doc["metadata"]["name"]
+    h.store.create(doc)
+    h.settle()
+
+    if kind == "TpuCluster":
+        got = h.store.get(C.KIND_CLUSTER, name)
+        assert got["status"]["state"] == "ready", got["status"]
+        assert got["status"]["readySlices"] == expected_slices(doc["spec"])
+        # Head pod + head service always exist.
+        assert h.store.try_get("Service", f"{name}-head-svc") is not None
+
+    elif kind == "TpuJob":
+        # Reaches Running with a ready backing cluster...
+        got = h.store.get(C.KIND_JOB, name)
+        assert got["status"]["jobDeploymentStatus"] == "Running", got["status"]
+        cluster = h.store.get(C.KIND_CLUSTER, got["status"]["clusterName"])
+        assert cluster["status"]["state"] == "ready"
+        # ... and completes when the app succeeds (submitter + coordinator).
+        for sub in h.store.list("Job"):
+            sub["status"] = {"succeeded": 1}
+            h.store.update_status(sub)
+        for client in h.clients.values():
+            for jid in list(client.jobs):
+                client.set_job_status(jid, "SUCCEEDED")
+        h.settle()
+        got = h.store.get(C.KIND_JOB, name)
+        assert got["status"]["jobDeploymentStatus"] == "Complete", got["status"]
+
+    elif kind == "TpuService":
+        got = h.store.get(C.KIND_SERVICE, name)
+        assert got["status"]["serviceStatus"] == "Running", got["status"]
+        active = got["status"]["activeServiceStatus"]["clusterName"]
+        assert h.store.get(C.KIND_CLUSTER, active)["status"]["state"] == "ready"
+        assert got["status"]["numServeEndpoints"] > 0
+
+    elif kind == "ComputeTemplate":
+        from kuberay_tpu.api.computetemplate import (
+            ComputeTemplate, validate_compute_template)
+        got = ComputeTemplate.from_dict(
+            h.store.get("ComputeTemplate", name))
+        assert validate_compute_template(got) == []
+
+    elif kind == "TpuCronJob":
+        # Nightly schedule: nothing due now — conformance is that the CR
+        # reconciles cleanly and records scheduling state.
+        got = h.store.get(C.KIND_CRONJOB, name)
+        assert "status" in got
+        # Force one due run to prove the template itself is valid.
+        got["status"]["lastScheduleTime"] = 1.0  # long before now
+        h.store.update_status(got)
+        h.operator.manager.enqueue((C.KIND_CRONJOB, "default", name))
+        h.settle()
+        jobs = h.store.list(C.KIND_JOB)
+        assert jobs, "cron job never materialized a TpuJob"
+        assert jobs[0]["metadata"]["labels"][C.LABEL_ORIGINATED_FROM_CRD] \
+            == C.KIND_CRONJOB
+
+    # No sample may trip validation or builder warnings.
+    bad = [e for e in h.warning_events()
+           if "Invalid" in e.get("reason", "")]
+    assert not bad, bad
