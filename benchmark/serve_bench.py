@@ -72,6 +72,37 @@ def run(args) -> None:
     drive(PagedServeEngine(cfg, params, max_slots=args.slots,
                            max_len=max_len, block_size=16), "paged")
 
+    def stall(chunk, label):
+        # Decode-stall probe: short requests are mid-decode when one long
+        # prompt arrives; the worst step time while its prefill is in
+        # flight IS the stall chunked prefill exists to bound.
+        long_len = max(4 * args.prefix, 128)
+        eng = ServeEngine(cfg, params, max_slots=args.slots,
+                          max_len=long_len + args.new + 8,
+                          prefill_chunk=chunk)
+        eng.add_request(Request("warm", list(range(1, long_len + 1)),
+                                max_new_tokens=2))
+        eng.run()                                   # compile all programs
+        for i in range(3):
+            eng.add_request(Request(f"bg{i}", [7 + i], max_new_tokens=500))
+        for _ in range(4):
+            eng.step()
+        eng.add_request(Request("long", list(range(1, long_len + 1)),
+                                max_new_tokens=2))
+        worst = 0.0
+        while eng.queue or eng._inflight is not None:
+            t0 = time.perf_counter()
+            eng.step()
+            worst = max(worst, time.perf_counter() - t0)
+        print(json.dumps({
+            "metric": f"serve_decode_stall_ms_{label}",
+            "value": round(worst * 1e3, 2), "unit": "ms",
+            "detail": {"long_prompt": long_len, "chunk": chunk}}),
+            flush=True)
+
+    stall(0, "whole_prefill")
+    stall(32, "chunked_prefill")
+
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="serve-bench")

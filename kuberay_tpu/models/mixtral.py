@@ -117,28 +117,31 @@ def init_params(cfg: MixtralConfig, key: jax.Array) -> Dict[str, Any]:
 
 def moe_ffn_dropless(cfg: MixtralConfig, x: jax.Array,
                      lp: Dict[str, jax.Array],
-                     token_mask: Optional[jax.Array] = None) -> jax.Array:
-    """Dropless top-k MoE: every token's chosen experts always run (all
-    experts computed, combined by routing weights).  E x the FFN FLOPs per
-    token — only sensible for small T (serving decode steps), where it buys
-    per-request determinism: no cross-request capacity contention.
+                     token_mask: Optional[jax.Array] = None,
+                     impl: str = "grouped") -> jax.Array:
+    """Dropless top-k MoE: every token's chosen experts always run.  Used
+    for serving decode steps, where it buys per-request determinism: no
+    cross-request capacity contention.
+
+    impl="grouped" (default): tokens sorted by expert, one ragged_dot per
+    weight tensor (ops/moe_matmul.py) — K·T matmul rows.
+    impl="dense": every expert runs on every token, unchosen experts
+    zero-weighted — E·T rows ((E/K)x the FLOPs); the numeric reference.
     """
+    from kuberay_tpu.ops.moe_matmul import dropless_reference, grouped_moe_ffn
+
     B, S, d = x.shape
-    E, K = cfg.n_experts, cfg.top_k
+    K = cfg.top_k
     T = B * S
     xt = x.reshape(T, d)
     logits = (xt @ lp["router"]).astype(jnp.float32)
     probs = jax.nn.softmax(logits, axis=-1)
     topw, topi = jax.lax.top_k(probs, K)
     topw = topw / jnp.clip(topw.sum(-1, keepdims=True), 1e-9)
-    weights = jnp.zeros((T, E), x.dtype).at[
-        jnp.arange(T)[:, None], topi].set(topw.astype(x.dtype))
     if token_mask is not None:
-        weights = weights * token_mask.reshape(T, 1).astype(x.dtype)
-    gated = jax.nn.silu(jnp.einsum("td,edf->tef", xt, lp["w_gate"])) \
-        * jnp.einsum("td,edf->tef", xt, lp["w_up"])
-    all_out = jnp.einsum("tef,efd->ted", gated, lp["w_down"])   # [T, E, d]
-    out = jnp.einsum("te,ted->td", weights, all_out)
+        topw = topw * token_mask.reshape(T, 1).astype(topw.dtype)
+    fn = grouped_moe_ffn if impl == "grouped" else dropless_reference
+    out = fn(xt, lp["w_gate"], lp["w_up"], lp["w_down"], topi, topw)
     return out.reshape(B, S, d).astype(x.dtype)
 
 

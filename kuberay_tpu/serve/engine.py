@@ -62,11 +62,17 @@ def _bucket(n: int, max_len: int = 2048) -> int:
 class ServeEngine:
     def __init__(self, cfg: LlamaConfig, params: Dict[str, Any],
                  max_slots: int = 8, max_len: int = 2048,
-                 rng_seed: int = 0):
+                 rng_seed: int = 0, prefill_chunk: int = 0):
         self.cfg = cfg
         self.params = params
         self.max_slots = max_slots
         self.max_len = max_len
+        # Chunked prefill (vLLM-style): >0 caps how many prompt tokens one
+        # engine step may prefill, interleaving decode steps between
+        # chunks so a long prompt never stalls other slots' generation —
+        # and every prefill call shares ONE compiled shape (the chunk).
+        self.prefill_chunk = prefill_chunk
+        self._inflight = None        # (req, slot, offset) mid-chunking
         self.cache = self._init_cache()
         # Model dispatch: Llama-family vs Mixtral MoE share the cache
         # plumbing but differ in the FFN.
@@ -98,11 +104,15 @@ class ServeEngine:
     # ------------------------------------------------------------------
 
     def _prefill_impl(self, params, cache, tokens, slot, real_len, key,
-                      temperature, prompt_len):
-        """Prefill one request into one slot.  tokens: [prompt_len] padded."""
+                      temperature, prompt_len, start_pos=0):
+        """Prefill one chunk of one request into one slot.
+        tokens: [prompt_len] padded; start_pos: tokens already in the
+        slot's cache (0 for whole-prompt prefill; the chunk offset when
+        chunked — attention masks keys at col <= query position, so a
+        chunk attends to everything the slot prefilled before it)."""
         B = self.max_slots
         row = jnp.zeros((B, prompt_len), dtype=jnp.int32).at[slot].set(tokens)
-        start = jnp.zeros((B,), jnp.int32)
+        start = jnp.zeros((B,), jnp.int32).at[slot].set(start_pos)
         # Only the target slot's cache row may be written — other slots are
         # mid-decode and their caches must be untouched.
         write_mask = jax.nn.one_hot(slot, B, dtype=jnp.float32)
@@ -156,27 +166,65 @@ class ServeEngine:
     def has_work(self) -> bool:
         # _finished counts: instantly-cancelled admissions must still be
         # drained by the driving loop or their callers would never wake.
-        return bool(self.queue) or self.num_active > 0 or bool(self._finished)
+        return (bool(self.queue) or self.num_active > 0
+                or bool(self._finished) or self._inflight is not None)
 
     def step(self) -> List[Response]:
-        """One engine iteration: admit one request (prefill) if possible,
-        then decode all active slots.  Returns finished responses."""
+        """One engine iteration: admit (prefill) if possible, then decode
+        all active slots.  Returns finished responses.
+
+        With ``prefill_chunk`` set, at most one chunk of prompt is
+        prefilled per step and a decode pass runs in between — other
+        slots keep generating while a long prompt streams in.
+        """
+        chunked_this_step = False
+        if self._inflight is not None:
+            self._chunk_step()
+            chunked_this_step = True
         # Admission: continuous batching — fill every free slot before the
         # decode pass (an underfilled batch wastes a full device step).
-        while self.queue:
+        # In chunked mode at most ONE chunk runs per step, even when the
+        # in-flight admission finished above — that bound IS the feature.
+        while self.queue and self._inflight is None \
+                and not chunked_this_step:
             free = next((i for i, r in enumerate(self.active) if r is None),
                         None)
             if free is None:
                 break
             req = self.queue.pop(0)
-            if not self._admit(req, free):
-                break               # admission blocked (e.g. paged memory)
+            if self.prefill_chunk > 0:
+                self._inflight = (req, free, 0)
+                self._chunk_step()
+                break           # one chunk per step bounds this step's cost
+            elif not self._admit(req, free):
+                break           # admission blocked (e.g. paged memory)
 
         if self.num_active:
             self._decode_all()
 
         out, self._finished = self._finished, []
         return out
+
+    def _chunk_step(self) -> None:
+        """Prefill the next chunk of the in-flight admission; the final
+        chunk samples the first generated token and activates the slot."""
+        req, slot, off = self._inflight
+        chunk = self.prefill_chunk
+        toks = req.prompt_tokens[off:off + chunk]
+        padded = np.zeros(chunk, dtype=np.int32)
+        padded[:len(toks)] = toks
+        self.key, sub = jax.random.split(self.key)
+        tok, self.cache = self._prefill(
+            self.params, self.cache, jnp.asarray(padded),
+            jnp.int32(slot), jnp.int32(len(toks)), sub,
+            jnp.float32(req.temperature), prompt_len=chunk,
+            start_pos=jnp.int32(off))
+        off += len(toks)
+        if off >= len(req.prompt_tokens):
+            self._inflight = None
+            self._finalize_admit(req, slot, tok)
+        else:
+            self._inflight = (req, slot, off)
 
     def run(self, max_steps: int = 10_000) -> List[Response]:
         """Drain: run until all queued + active requests finish."""

@@ -64,17 +64,19 @@ def forward_with_cache_mixtral(cfg, params, tokens, cache, start,
         MixtralConfig, moe_ffn, moe_ffn_dropless)
 
     assert isinstance(cfg, MixtralConfig)
-    decode = tokens.shape[1] == 1
 
     def ffn(cfg_, h, lp, mask):
-        if decode:
-            # Decode: dropless routing — other slots' tokens can never
-            # evict this request's experts (per-request determinism).
-            return moe_ffn_dropless(cfg_, h, lp, token_mask=mask)
-        # Prefill: one request per call; capacity routing contends only
-        # with the request's own tokens (masked slots claim nothing).
-        out, _aux = moe_ffn(cfg_, h, lp, token_mask=mask)
-        return out
+        # Dropless routing for BOTH decode and prefill: each token's
+        # routing depends only on its own hidden state, so outputs are
+        # invariant to batch composition, chunked-prefill boundaries, and
+        # cached-prefix reuse — the properties serving correctness rests
+        # on (capacity routing has none of them: which tokens overflow an
+        # expert depends on what else is in the call).  The grouped
+        # ragged_dot path (ops/moe_matmul.py) makes this the CHEAPER
+        # option too: K*T matmul rows vs capacity's ~K*T*capacity_factor.
+        # Capacity dispatch (moe_ffn) remains the training path, where
+        # batched one-hot einsums + fixed shapes win under pjit.
+        return moe_ffn_dropless(cfg_, h, lp, token_mask=mask)
 
     return forward_with_cache(cfg, params, tokens, cache, start,
                               write_mask, token_mask=token_mask, ffn=ffn,

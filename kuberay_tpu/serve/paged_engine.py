@@ -52,13 +52,13 @@ class PagedServeEngine(ServeEngine):
         self.max_blocks = (max_len + block_size - 1) // block_size
         from kuberay_tpu.models.mixtral import MixtralConfig
         base = None
-        # Capacity-routed MoE prefill is NOT invariant to prefix reuse:
-        # running only the un-cached suffix changes which tokens contend
-        # for expert capacity, so a warm cache could alter outputs.  The
-        # paged pool/preemption still apply; only cross-request block
-        # sharing is disabled (dropless prefill would re-enable it at
-        # E x the FFN FLOPs — a round-2 kernel decision).
-        self._share_prefixes = not isinstance(cfg, MixtralConfig)
+        # Prefix sharing is sound for Mixtral too now that serving prefill
+        # routes droplessly (kv_cache.forward_with_cache_mixtral): each
+        # token's experts depend only on its own hidden state, so running
+        # just the un-cached suffix reproduces exactly what full prefill
+        # would have written.  (The old capacity-routed prefill was not
+        # reuse-invariant and forced sharing off for MoE.)
+        self._share_prefixes = True
         if isinstance(cfg, MixtralConfig):
             from kuberay_tpu.serve.kv_cache import forward_with_cache_mixtral
             base = forward_with_cache_mixtral

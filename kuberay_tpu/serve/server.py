@@ -190,6 +190,9 @@ def main(argv=None):  # pragma: no cover - process wrapper
     ap.add_argument("--decode-impl", default="auto",
                     choices=["auto", "pallas", "xla", "pallas_interpret"],
                     help="paged decode attention path (auto: pallas on TPU)")
+    ap.add_argument("--prefill-chunk", type=int, default=0,
+                    help="chunked prefill size (dense engine only; 0 = "
+                         "whole-prompt prefill)")
     args = ap.parse_args(argv)
 
     cfg = llama.CONFIGS[args.model]
@@ -202,7 +205,8 @@ def main(argv=None):  # pragma: no cover - process wrapper
             decode_impl=args.decode_impl)
     else:
         engine = ServeEngine(cfg, params, max_slots=args.max_slots,
-                             max_len=args.max_len)
+                             max_len=args.max_len,
+                             prefill_chunk=args.prefill_chunk)
     frontend = ServeFrontend(engine)
     srv = frontend.make_server(args.host, args.port)
     if args.coordinator:

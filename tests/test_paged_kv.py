@@ -227,9 +227,10 @@ def test_paged_mixtral_matches_dense(params):
 
 
 def test_paged_mixtral_warm_cache_invariant(params):
-    """MoE outputs must not depend on cache warmth: prefix sharing is
-    disabled for capacity-routed models, so a repeat prompt after a
-    warm-up request produces exactly the cold-engine tokens."""
+    """MoE outputs must not depend on cache warmth: serving prefill
+    routes droplessly (per-token), so prefix sharing is safe for MoE —
+    a repeat prompt reuses cached blocks AND produces exactly the
+    cold-engine tokens."""
     from kuberay_tpu.models import mixtral
     mcfg = mixtral.CONFIGS["mixtral_tiny"]
     mparams = mixtral.init_params(mcfg, jax.random.PRNGKey(3))
@@ -247,4 +248,4 @@ def test_paged_mixtral_warm_cache_invariant(params):
     eng.add_request(Request("again", list(prompt), max_new_tokens=4))
     out = eng.run()
     assert out[0].tokens == expected
-    assert eng.stats["prefix_hit_tokens"] == 0   # sharing gated off
+    assert eng.stats["prefix_hit_tokens"] > 0    # sharing now on for MoE

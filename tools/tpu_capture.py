@@ -97,6 +97,29 @@ bwd = {n: float(jnp.max(jnp.abs(a.astype(jnp.float32)-b.astype(jnp.float32))))
 print(json.dumps({"fwd_maxdiff": fwd, "bwd_maxdiff": bwd}))
 """
 
+MOE_SNIPPET = r"""
+import time, jax, jax.numpy as jnp, json
+from kuberay_tpu.ops.moe_matmul import grouped_moe_ffn, dropless_reference
+T,d,f,E,K = 64, 4096, 14336, 8, 2
+ks = jax.random.split(jax.random.PRNGKey(0), 5)
+xt = jax.random.normal(ks[0],(T,d),jnp.bfloat16)
+wg = jax.random.normal(ks[1],(E,d,f),jnp.bfloat16)*0.05
+wu = jax.random.normal(ks[2],(E,d,f),jnp.bfloat16)*0.05
+wd = jax.random.normal(ks[3],(E,f,d),jnp.bfloat16)*0.05
+topw, topi = jax.lax.top_k(jax.nn.softmax(jax.random.normal(ks[4],(T,E)),-1), K)
+topw = topw / topw.sum(-1, keepdims=True)
+g = jax.jit(grouped_moe_ffn); r = jax.jit(dropless_reference)
+def bench(fn, n=30):
+    fn(xt,wg,wu,wd,topi,topw).block_until_ready()
+    t0=time.perf_counter()
+    for _ in range(n): o = fn(xt,wg,wu,wd,topi,topw)
+    float(jnp.max(jnp.abs(o)))
+    return (time.perf_counter()-t0)/n*1e3
+diff = float(jnp.max(jnp.abs(g(xt,wg,wu,wd,topi,topw).astype(jnp.float32)
+                             - r(xt,wg,wu,wd,topi,topw).astype(jnp.float32))))
+print(json.dumps({"diff": diff, "grouped_ms": bench(g), "dense_ms": bench(r)}))
+"""
+
 BLOCK_SWEEP_SNIPPET = r"""
 import time, jax, jax.numpy as jnp, json
 from kuberay_tpu.ops.attention import flash_attention
@@ -146,6 +169,7 @@ def main() -> int:
         ("decode_kernel", [py, "-c", DECODE_SNIPPET], 400, None),
         ("paged_kernel", [py, "-c", PAGED_SNIPPET], 500, None),
         ("flash_check", [py, "-c", FLASH_CHECK_SNIPPET], 400, None),
+        ("moe_grouped", [py, "-c", MOE_SNIPPET], 400, None),
     ]
     for bq, bkv in ((512, 512), (1024, 512), (512, 1024), (1024, 1024),
                     (256, 512), (1024, 256)):
