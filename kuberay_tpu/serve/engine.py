@@ -193,8 +193,9 @@ class ServeEngine:
                 break
             req = self.queue.pop(0)
             if self.prefill_chunk > 0:
-                self._inflight = (req, free, 0)
-                self._chunk_step()
+                started = self._begin_chunked(req, free)
+                if started is None:
+                    continue    # request cancelled outright; slot still free
                 break           # one chunk per step bounds this step's cost
             elif not self._admit(req, free):
                 break           # admission blocked (e.g. paged memory)
@@ -205,26 +206,45 @@ class ServeEngine:
         out, self._finished = self._finished, []
         return out
 
+    def _begin_chunked(self, req: Request, slot: int):
+        """Start a chunked admission.  Returns True when the first chunk
+        ran, False when blocked (request requeued), None when the request
+        was cancelled.  The paged subclass reserves KV blocks here."""
+        self._inflight = (req, slot, 0)
+        self._chunk_step()
+        return True
+
     def _chunk_step(self) -> None:
         """Prefill the next chunk of the in-flight admission; the final
-        chunk samples the first generated token and activates the slot."""
+        chunk samples the first generated token and activates the slot.
+        The in-flight offset is ABSOLUTE into the prompt (a cached-prefix
+        admission starts past zero), so this skeleton is shared with the
+        paged engine — only `_prefill_chunk_call` differs."""
         req, slot, off = self._inflight
         chunk = self.prefill_chunk
         toks = req.prompt_tokens[off:off + chunk]
         padded = np.zeros(chunk, dtype=np.int32)
         padded[:len(toks)] = toks
         self.key, sub = jax.random.split(self.key)
-        tok, self.cache = self._prefill(
-            self.params, self.cache, jnp.asarray(padded),
-            jnp.int32(slot), jnp.int32(len(toks)), sub,
-            jnp.float32(req.temperature), prompt_len=chunk,
-            start_pos=jnp.int32(off))
+        tok = self._prefill_chunk_call(req, slot, off, padded, len(toks),
+                                       sub)
         off += len(toks)
         if off >= len(req.prompt_tokens):
             self._inflight = None
-            self._finalize_admit(req, slot, tok)
+            self._chunk_finalize(req, slot, tok)
         else:
             self._inflight = (req, slot, off)
+
+    def _prefill_chunk_call(self, req, slot, off, padded, real_len, sub):
+        tok, self.cache = self._prefill(
+            self.params, self.cache, jnp.asarray(padded),
+            jnp.int32(slot), jnp.int32(real_len), sub,
+            jnp.float32(req.temperature), prompt_len=self.prefill_chunk,
+            start_pos=jnp.int32(off))
+        return tok
+
+    def _chunk_finalize(self, req, slot, tok) -> None:
+        self._finalize_admit(req, slot, tok)
 
     def run(self, max_steps: int = 10_000) -> List[Response]:
         """Drain: run until all queued + active requests finish."""

@@ -43,7 +43,8 @@ class PagedServeEngine(ServeEngine):
     def __init__(self, cfg: LlamaConfig, params: Dict[str, Any],
                  max_slots: int = 8, max_len: int = 2048,
                  num_blocks: int = 0, block_size: int = 16,
-                 rng_seed: int = 0, decode_impl: str = "auto"):
+                 rng_seed: int = 0, decode_impl: str = "auto",
+                 prefill_chunk: int = 0):
         # Default pool = the dense engine's footprint; callers shrink it
         # to realize the memory win (e.g. slots * expected_len).
         num_blocks = num_blocks or (max_slots * max_len) // block_size
@@ -68,7 +69,7 @@ class PagedServeEngine(ServeEngine):
         # resolve to the paged overrides below, and builds the cache via
         # the _init_cache hook.
         super().__init__(cfg, params, max_slots=max_slots, max_len=max_len,
-                         rng_seed=rng_seed)
+                         rng_seed=rng_seed, prefill_chunk=prefill_chunk)
         self.allocator = BlockAllocator(num_blocks, block_size)
         self.tables = np.zeros((max_slots, self.max_blocks), dtype=np.int32)
         self.owned: List[List[int]] = [[] for _ in range(max_slots)]
@@ -139,14 +140,19 @@ class PagedServeEngine(ServeEngine):
     # scheduling overrides
     # ------------------------------------------------------------------
 
-    def _admit(self, req: Request, slot: int):
+    def _reserve(self, req: Request, slot: int):
+        """Memory admission shared by whole-prompt and chunked prefill:
+        prefix match + all-block reservation for prompt AND first decoded
+        token.  Returns the number of tokens served from cache (int), or
+        False when blocked on memory (request requeued), or None when the
+        prompt can never fit (request cancelled)."""
         plen = len(req.prompt_tokens)
         # A prompt the pool can NEVER hold (even with every block free)
         # must be rejected, not retried — requeueing it would livelock
         # the engine and head-of-line-block everything behind it.
         if self._blocks_needed(plen + 1) > self.num_blocks:
             self._cancel(req)
-            return True
+            return None
         # While blocked on memory, nothing changes until some block is
         # freed — skip the O(plen) prefix re-match until num_free moves
         # (retried every engine step otherwise).
@@ -159,8 +165,6 @@ class PagedServeEngine(ServeEngine):
             if self._share_prefixes else []
         while cached and len(cached) * self.block_size >= plen:
             self.allocator.free(cached.pop())
-        ncached = len(cached) * self.block_size
-        new_tokens = plen - ncached
         # Reserve capacity for the prompt AND the first decoded token
         # (prefill samples it; the first decode step writes it at
         # position plen) — actually allocating the headroom, instead of
@@ -180,6 +184,29 @@ class PagedServeEngine(ServeEngine):
         ok = self._grow(slot, need)
         assert ok, "free-count check guaranteed allocation"
         self.allocator.count_prefix_stats(plen, len(cached))
+        return len(cached) * self.block_size
+
+    def _register_full_prompt(self, req: Request, slot: int) -> None:
+        """Publish the prompt's full blocks for future requests.  Cached
+        blocks re-register as no-ops; bucket/chunk padding past the
+        prompt was written to this slot's PRIVATE blocks only, and only
+        positions < lens are ever read, so shared content is exactly the
+        real tokens."""
+        plen = len(req.prompt_tokens)
+        if self._share_prefixes:
+            self.allocator.register_prefix(
+                req.prompt_tokens[:plen - plen % self.block_size],
+                self.owned[slot])
+
+    def _admit(self, req: Request, slot: int):
+        reserved = self._reserve(req, slot)
+        if reserved is None:
+            return True                     # cancelled; slot stays free
+        if reserved is False:
+            return False                    # blocked on memory
+        ncached = reserved
+        plen = len(req.prompt_tokens)
+        new_tokens = plen - ncached
 
         bucket = _bucket(new_tokens, self.max_len)
         padded = np.zeros(bucket, dtype=np.int32)
@@ -190,17 +217,35 @@ class PagedServeEngine(ServeEngine):
             jnp.asarray(self.tables), jnp.int32(slot), jnp.int32(ncached),
             jnp.int32(new_tokens), sub, jnp.float32(req.temperature),
             prompt_len=bucket)
-        # Publish the prompt's full blocks for future requests.  Cached
-        # blocks re-register as no-ops; the bucket padding past
-        # ``plen`` was written to this slot's PRIVATE blocks only, and
-        # only positions < lens are ever read, so shared content is
-        # exactly the real tokens.
-        if self._share_prefixes:
-            self.allocator.register_prefix(
-                req.prompt_tokens[:plen - plen % self.block_size],
-                self.owned[slot])
+        self._register_full_prompt(req, slot)
         self._finalize_admit(req, slot, tok)
         return True
+
+    # -- chunked prefill over the block-table path ----------------------
+
+    def _begin_chunked(self, req: Request, slot: int):
+        reserved = self._reserve(req, slot)
+        if reserved is None:
+            return None
+        if reserved is False:
+            return False
+        # Blocks are fully reserved; start past the cache-served prefix
+        # (the in-flight offset is absolute into the prompt).
+        self._inflight = (req, slot, reserved)
+        self._chunk_step()
+        return True
+
+    def _prefill_chunk_call(self, req, slot, off, padded, real_len, sub):
+        tok, self.cache = self._prefill(
+            self.params, self.cache, jnp.asarray(padded),
+            jnp.asarray(self.tables), jnp.int32(slot), jnp.int32(off),
+            jnp.int32(real_len), sub, jnp.float32(req.temperature),
+            prompt_len=self.prefill_chunk)
+        return tok
+
+    def _chunk_finalize(self, req, slot, tok) -> None:
+        self._register_full_prompt(req, slot)
+        self._finalize_admit(req, slot, tok)
 
     def _decode_call(self, last, temps, mask, sub):
         toks, self.cache = self._decode(

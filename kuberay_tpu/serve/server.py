@@ -191,8 +191,7 @@ def main(argv=None):  # pragma: no cover - process wrapper
                     choices=["auto", "pallas", "xla", "pallas_interpret"],
                     help="paged decode attention path (auto: pallas on TPU)")
     ap.add_argument("--prefill-chunk", type=int, default=0,
-                    help="chunked prefill size (dense engine only; 0 = "
-                         "whole-prompt prefill)")
+                    help="chunked prefill size (0 = whole-prompt prefill)")
     args = ap.parse_args(argv)
 
     cfg = llama.CONFIGS[args.model]
@@ -202,7 +201,7 @@ def main(argv=None):  # pragma: no cover - process wrapper
         engine = PagedServeEngine(
             cfg, params, max_slots=args.max_slots, max_len=args.max_len,
             num_blocks=args.num_blocks, block_size=args.block_size,
-            decode_impl=args.decode_impl)
+            decode_impl=args.decode_impl, prefill_chunk=args.prefill_chunk)
     else:
         engine = ServeEngine(cfg, params, max_slots=args.max_slots,
                              max_len=args.max_len,

@@ -113,6 +113,62 @@ def test_at_most_one_chunk_per_step():
     assert max(calls) <= 1
 
 
+def paged_engine(**kw):
+    from kuberay_tpu.serve.paged_engine import PagedServeEngine
+    kw.setdefault("max_slots", 4)
+    kw.setdefault("max_len", 128)
+    kw.setdefault("block_size", 8)
+    return PagedServeEngine(CFG, PARAMS, **kw)
+
+
+def test_paged_chunked_outputs_match_whole_prompt():
+    def run(chunk):
+        eng = paged_engine(prefill_chunk=chunk)
+        for i, p in enumerate(prompts()):
+            eng.add_request(Request(f"r{i}", p, max_new_tokens=8))
+        return {r.request_id: (r.tokens, r.finish_reason)
+                for r in eng.run()}
+    assert run(8) == run(0)
+
+
+def test_paged_chunked_prefix_caching_still_works():
+    """A repeat prompt under chunked prefill reuses cached blocks and
+    reproduces the cold tokens — chunk boundaries don't break sharing."""
+    shared = list(range(1, 25))                   # 3 full 8-token blocks
+    cold = paged_engine(prefill_chunk=8)
+    cold.add_request(Request("a", shared + [40], max_new_tokens=4))
+    expected = cold.run()[0].tokens
+
+    eng = paged_engine(prefill_chunk=8)
+    eng.add_request(Request("warm", shared + [40], max_new_tokens=4))
+    eng.run()
+    eng.add_request(Request("again", shared + [40], max_new_tokens=4))
+    out = eng.run()
+    assert out[0].tokens == expected
+    assert eng.stats["prefix_hit_tokens"] > 0
+
+
+def test_paged_chunked_memory_blocking_and_recovery():
+    """When the pool can't hold a new prompt, the chunked admission
+    blocks without leaking blocks, then proceeds after slots free up."""
+    # 29-token prompts need 4 blocks each; a 5-block pool forces "b" to
+    # wait until "a" finishes and releases.
+    eng = paged_engine(prefill_chunk=8, max_slots=2, num_blocks=5)
+    eng.add_request(Request("a", list(range(1, 30)), max_new_tokens=3))
+    eng.add_request(Request("b", list(range(31, 60)), max_new_tokens=3))
+    out = eng.run()
+    assert sorted(r.request_id for r in out) == ["a", "b"]
+    assert all(r.finish_reason in ("length", "eos") for r in out)
+    assert eng.stats["free_blocks"] == eng.stats["num_blocks"]
+
+
+def test_paged_chunked_impossible_prompt_cancelled():
+    eng = paged_engine(prefill_chunk=8, max_slots=1, num_blocks=4)
+    eng.add_request(Request("big", list(range(1, 100)), max_new_tokens=2))
+    out = eng.run()
+    assert out[0].finish_reason == "cancelled"
+
+
 def test_inflight_blocks_reuse_of_slot_only():
     """The chunking slot is reserved: admission of other requests resumes
     after the in-flight prefill finishes, and nothing deadlocks with a
