@@ -63,6 +63,78 @@ class MeshSpec:
         arr = np.array(devices).reshape(shape)
         return Mesh(arr, self.AXES)
 
+    def build_multislice(self,
+                         devices: Optional[Sequence[jax.Device]] = None,
+                         num_slices: Optional[int] = None,
+                         dcn_axes: Sequence[str] = ("dp",)) -> Mesh:
+        """Hybrid ICI/DCN mesh for multi-slice (megascale) training.
+
+        The named ``dcn_axes`` (default: pure data parallelism) vary
+        ACROSS slices — their collectives ride the slow DCN links — and
+        every other axis lives WITHIN a slice, so fsdp all-gathers, tp
+        matmul collectives, ring-attention ppermutes, and MoE all-to-alls
+        ride ICI (the scaling-book layout).  Slice membership comes from
+        ``device.slice_index`` when the platform provides it (real
+        multi-slice TPU), else from contiguous device order (the
+        ``jax.distributed`` host ordering the operator's
+        ``TPU_WORKER_ID`` contract guarantees; also the virtual-mesh
+        test path).
+
+        The product of the dcn axis sizes must equal ``num_slices`` (or
+        the detected slice count).
+        """
+        devices = list(devices if devices is not None else jax.devices())
+        sizes = self.resolve(len(devices))
+        for a in dcn_axes:
+            if a not in self.AXES:
+                raise ValueError(f"unknown dcn axis {a!r}")
+
+        by_slice = {}
+        if all(getattr(d, "slice_index", None) is not None for d in devices):
+            for d in devices:
+                by_slice.setdefault(d.slice_index, []).append(d)
+        if len(by_slice) > 1:
+            # Platform knows the real slice structure (multi-slice TPU).
+            detected = len(by_slice)
+            if num_slices is not None and num_slices != detected:
+                raise ValueError(
+                    f"num_slices={num_slices} but platform reports "
+                    f"{detected} slices")
+            num_slices = detected
+        else:
+            # Single- or no-slice_index platforms (CPU virtual mesh, one
+            # process per slice over DCN): slice = contiguous device
+            # range in process order, which the operator's TPU_WORKER_ID
+            # / MEGASCALE_SLICE_ID contract makes slice order.
+            by_slice = {}
+            if not num_slices:
+                raise ValueError("num_slices required when devices carry "
+                                 "no slice_index")
+            per = len(devices) // num_slices
+            if per * num_slices != len(devices):
+                raise ValueError(f"{len(devices)} devices do not divide "
+                                 f"into {num_slices} slices")
+            by_slice = {i: devices[i * per:(i + 1) * per]
+                        for i in range(num_slices)}
+
+        dcn_size = math.prod(sizes[a] for a in dcn_axes)
+        if dcn_size != num_slices:
+            raise ValueError(
+                f"dcn axes {tuple(dcn_axes)} have total size {dcn_size}, "
+                f"but there are {num_slices} slices — the cross-slice "
+                f"axes must exactly cover the slices")
+
+        # Lay out [slice, within-slice], then split into per-axis dims
+        # with dcn axes leading, and transpose back to AXES order.
+        ordered = [d for i in sorted(by_slice) for d in by_slice[i]]
+        dcn_in_order = [a for a in self.AXES if a in dcn_axes]
+        ici_in_order = [a for a in self.AXES if a not in dcn_axes]
+        arr = np.array(ordered).reshape(
+            [sizes[a] for a in dcn_in_order] +
+            [sizes[a] for a in ici_in_order])
+        perm = [(dcn_in_order + ici_in_order).index(a) for a in self.AXES]
+        return Mesh(arr.transpose(perm), self.AXES)
+
 
 def make_mesh(n_devices: Optional[int] = None, **axes) -> Mesh:
     """Convenience: ``make_mesh(tp=4)`` uses all devices, fsdp absorbing."""

@@ -80,11 +80,23 @@ def initialize_distributed(ident: Optional[WorkerIdentity] = None):
     return ident
 
 
-def build_mesh(tp: Optional[int] = None, sp: int = 1, ep: int = 1):
+def build_mesh(tp: Optional[int] = None, sp: int = 1, ep: int = 1,
+               num_slices: Optional[int] = None):
+    """Single mesh over all devices.  Under multi-slice (the operator's
+    MEGASCALE env contract, builders/pod.py:194-196) the mesh goes hybrid:
+    pure data parallelism crosses slices on DCN, everything else stays on
+    the slice's ICI (MeshSpec.build_multislice).  ``num_slices`` comes
+    from WorkerIdentity (the single parser of the env contract); the env
+    fallback serves direct library callers."""
     import jax
     from kuberay_tpu.parallel.mesh import MeshSpec
     n = len(jax.devices())
     tp = tp or min(n, jax.local_device_count())
+    if num_slices is None:
+        num_slices = WorkerIdentity.from_env().num_slices
+    if num_slices > 1:
+        return MeshSpec(dp=num_slices, fsdp=-1, tp=tp, sp=sp,
+                        ep=ep).build_multislice(num_slices=num_slices)
     return MeshSpec(dp=1, fsdp=-1, tp=tp, sp=sp, ep=ep).build()
 
 
@@ -101,7 +113,7 @@ def train(args) -> int:
 
     ident = initialize_distributed()
     cfg = llama.CONFIGS[args.model]
-    mesh = build_mesh(tp=args.tp, sp=args.sp)
+    mesh = build_mesh(tp=args.tp, sp=args.sp, num_slices=ident.num_slices)
     tc = TrainConfig(learning_rate=args.lr,
                      warmup_steps=min(args.warmup, max(1, args.steps // 10)),
                      decay_steps=args.steps,
