@@ -103,6 +103,49 @@ def run(args) -> None:
     stall(0, "whole_prefill")
     stall(32, "chunked_prefill")
 
+    def spec(gamma, label):
+        # Repetitive continuation workload — the regime prompt-lookup
+        # speculation exists for (code/quotes/structured text).  max_len
+        # is sized from the ACTUAL prompt length (24 tokens), not
+        # --prefix, so small flag values can't silently cancel requests.
+        plen = 24
+        eng = ServeEngine(cfg, params, max_slots=args.slots,
+                          max_len=plen + 2 * args.new + 8,
+                          speculative=gamma)
+        eng.add_request(Request("warm", [5, 6] * 8, max_new_tokens=4))
+        eng.run()
+        if gamma:
+            # The warm request only hits _verify if a draft happened to
+            # match; force-compile the verify program so its first
+            # compile can't land in the timed region.
+            import jax as _jax
+            import jax.numpy as _jnp
+            import numpy as _np
+            zeros = _np.zeros((args.slots, gamma + 1), _np.int32)
+            _, _, eng.cache = eng._verify(
+                eng.params, eng.cache, _jnp.asarray(zeros),
+                _jnp.asarray(eng.lens), _jax.random.PRNGKey(0),
+                _jnp.zeros(args.slots, _jnp.float32),
+                _jnp.zeros(args.slots, _jnp.float32))   # all rows masked
+        for i in range(args.requests):
+            pat = [10 + i, 11 + i, 12 + i]
+            eng.add_request(Request(f"s{i}", pat * 8,
+                                    max_new_tokens=2 * args.new))
+        t0 = time.perf_counter()
+        out = eng.run()
+        dt = time.perf_counter() - t0
+        toks = sum(len(r.tokens) for r in out)
+        rec = {"metric": f"serve_decode_tokens_per_sec_{label}",
+               "value": round(toks / dt, 1), "unit": "tokens/s",
+               "detail": {"gamma": gamma, "requests": len(out)}}
+        if gamma and eng.spec_stats["drafted"]:
+            rec["detail"]["accept_rate"] = round(
+                eng.spec_stats["accepted"] / eng.spec_stats["drafted"], 3)
+        print(json.dumps(rec), flush=True)
+
+    spec(0, "sequential")
+    spec(4, "speculative")
+
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="serve-bench")

@@ -59,10 +59,68 @@ def _bucket(n: int, max_len: int = 2048) -> int:
     return min(b, max_len)
 
 
+def prompt_lookup_draft(hist: List[int], gamma: int, ngram: int = 3,
+                        window: int = 4096) -> List[int]:
+    """Prompt-lookup drafting: if the current suffix n-gram occurred
+    earlier in the token history, propose the tokens that followed it.
+    Free (no draft model), and highly effective on the repetitive spans
+    (code, quotes, structured text) where speculation pays off.
+
+    O(len(hist)) reference scan; the engine hot loop uses the
+    incremental ``NgramIndex`` (same semantics, O(gamma) per draft)."""
+    lo = max(0, len(hist) - window)
+    for n in range(min(ngram, len(hist) - 1), 0, -1):
+        pat = hist[-n:]
+        for k in range(len(hist) - n - 1, lo - 1, -1):
+            if hist[k:k + n] == pat:
+                cont = hist[k + n:k + n + gamma]
+                if cont:
+                    return list(cont)
+    return []
+
+
+class NgramIndex:
+    """Incremental n-gram -> latest-start-position index over one slot's
+    token history.  ``extend`` amortizes to O(new tokens); ``draft`` is
+    O(gamma) — replacing the per-step O(history) rescan in the decode
+    host loop.  Matches ``prompt_lookup_draft`` exactly: longest n-gram
+    first, latest occurrence wins, occurrences end strictly before the
+    history's last position (so the suffix never matches itself)."""
+
+    def __init__(self, ngram: int = 3, window: int = 4096):
+        self.n_max = ngram
+        self.window = window
+        self.maps = {n: {} for n in range(1, ngram + 1)}
+        self.indexed = 0         # history length already processed
+
+    def extend(self, hist: List[int]) -> None:
+        L = len(hist)
+        for n, m in self.maps.items():
+            # Previously covered k <= indexed-n-1; ascending order keeps
+            # "latest occurrence wins".
+            for k in range(max(0, self.indexed - n), L - n):
+                m[tuple(hist[k:k + n])] = k
+        self.indexed = L
+
+    def draft(self, hist: List[int], gamma: int) -> List[int]:
+        for n in range(min(self.n_max, len(hist) - 1), 0, -1):
+            k = self.maps[n].get(tuple(hist[-n:]))
+            # Latest-wins index: a latest occurrence older than the
+            # window means no occurrence is within it (reference
+            # semantics: fall through to a shorter n-gram).
+            if k is not None and k >= len(hist) - self.window:
+                return list(hist[k + n:k + n + gamma])
+        return []
+
+
 class ServeEngine:
+    SPEC_MISS_LIMIT = 3        # consecutive full-rejects before backoff
+    SPEC_PROBE_EVERY = 8       # steps between probes while backed off
+
     def __init__(self, cfg: LlamaConfig, params: Dict[str, Any],
                  max_slots: int = 8, max_len: int = 2048,
-                 rng_seed: int = 0, prefill_chunk: int = 0):
+                 rng_seed: int = 0, prefill_chunk: int = 0,
+                 speculative: int = 0, kv_quant: str = "none"):
         self.cfg = cfg
         self.params = params
         self.max_slots = max_slots
@@ -73,6 +131,21 @@ class ServeEngine:
         # and every prefill call shares ONE compiled shape (the chunk).
         self.prefill_chunk = prefill_chunk
         self._inflight = None        # (req, slot, offset) mid-chunking
+        # Speculative decoding (greedy, prompt-lookup drafts): >0 sets the
+        # draft length γ — one verify forward of T=γ+1 tokens can emit up
+        # to γ+1 tokens for slots whose drafts hit.  Exact: greedy
+        # longest-prefix acceptance reproduces sequential decoding.
+        self.speculative = speculative
+        self.spec_stats = {"drafted": 0, "accepted": 0, "verify_steps": 0}
+        # Dynamic backoff: a slot whose last SPEC_MISS_LIMIT drafts were
+        # fully rejected pauses drafting for SPEC_PROBE_EVERY steps, then
+        # probes again (text can ENTER a repetitive regime later); any
+        # acceptance re-arms it fully.  Bounds the worst case near
+        # sequential cost instead of paying (γ+1)x forever.
+        self._spec_miss = np.zeros(max_slots, dtype=np.int32)
+        self._spec_cooldown = np.zeros(max_slots, dtype=np.int32)
+        self._spec_index: List[Optional[NgramIndex]] = [None] * max_slots
+        self.kv_quant = kv_quant
         self.cache = self._init_cache()
         # Model dispatch: Llama-family vs Mixtral MoE share the cache
         # plumbing but differ in the FFN.
@@ -81,6 +154,9 @@ class ServeEngine:
             self._forward = forward_with_cache_mixtral
         else:
             self._forward = forward_with_cache
+        if kv_quant != "none":
+            from kuberay_tpu.serve.kv_cache import make_quantized_forward
+            self._forward = make_quantized_forward(self._forward)
         self.key = jax.random.PRNGKey(rng_seed)
 
         # Slot bookkeeping (host side).
@@ -95,9 +171,11 @@ class ServeEngine:
                                 static_argnames=("prompt_len",),
                                 donate_argnames=("cache",))
         self._decode = jax.jit(self._decode_impl, donate_argnames=("cache",))
+        self._verify = jax.jit(self._verify_impl, donate_argnames=("cache",))
 
     def _init_cache(self):
-        return init_kv_cache(self.cfg, self.max_slots, self.max_len)
+        return init_kv_cache(self.cfg, self.max_slots, self.max_len,
+                             quant=self.kv_quant)
 
     # ------------------------------------------------------------------
     # jitted kernels
@@ -136,6 +214,23 @@ class ServeEngine:
         keys = jax.random.split(key, self.max_slots)
         toks = jax.vmap(self._sample)(logits[:, 0], keys, temperatures)
         return toks, new_cache
+
+    def _verify_impl(self, params, cache, tokens, lens, key, temperatures,
+                     active_mask):
+        """Speculative verify: run T = γ+1 tokens (last emitted + γ draft)
+        for every active slot in ONE forward.  greedy[b, j] is the model's
+        next token after consuming tokens[b, :j+1] — the host accepts the
+        longest prefix where greedy agrees with the draft.  Draft KV lands
+        at positions lens..lens+γ; rejected positions stay masked behind
+        ``lens`` and are overwritten by later steps."""
+        logits, new_cache = self._forward(
+            self.cfg, params, tokens, cache, lens, active_mask,
+            token_mask=active_mask[:, None] *
+            jnp.ones((1, tokens.shape[1]), jnp.float32))
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        keys = jax.random.split(key, self.max_slots)
+        sampled0 = jax.vmap(self._sample)(logits[:, 0], keys, temperatures)
+        return greedy, sampled0, new_cache
 
     @staticmethod
     def _sample(logits, key, temperature):
@@ -277,6 +372,8 @@ class ServeEngine:
         self.active[slot] = req
         self.generated[slot] = [int(tok)]
         self.budget[slot] = req.max_new_tokens - 1
+        self._spec_miss[slot] = 0
+        self._spec_index[slot] = None      # fresh history for the new slot
         self._maybe_finish(slot)
 
     def _decode_all(self):
@@ -288,6 +385,10 @@ class ServeEngine:
                 last[i] = self.generated[i][-1]
                 temps[i] = req.temperature
                 mask[i] = 1.0
+        if self.speculative > 0:
+            drafts = self._build_drafts()
+            if any(drafts):
+                return self._spec_decode_all(last, temps, mask, drafts)
         self.key, sub = jax.random.split(self.key)
         toks = np.asarray(self._decode_call(last, temps, mask, sub))
         for i, req in enumerate(self.active):
@@ -296,6 +397,82 @@ class ServeEngine:
             self.lens[i] += 1
             self.generated[i].append(int(toks[i]))
             self.budget[i] -= 1
+            self._maybe_finish(i)
+
+    # -- speculative decoding ------------------------------------------
+
+    def _build_drafts(self) -> List[List[int]]:
+        """Per-slot prompt-lookup drafts.  Sampling slots (temperature
+        > 0) never draft — greedy acceptance would bias their
+        distribution; they fall through to one sampled token."""
+        gamma = self.speculative
+        drafts: List[List[int]] = [[] for _ in range(self.max_slots)]
+        for i, req in enumerate(self.active):
+            if req is None or req.temperature > 0 or not self.generated[i]:
+                continue
+            if self._spec_miss[i] >= self.SPEC_MISS_LIMIT:
+                if self._spec_cooldown[i] > 0:
+                    self._spec_cooldown[i] -= 1
+                    continue            # backed off; probe when it hits 0
+            # Cache head-room: positions lens..lens+γ must stay < max_len.
+            cap = min(gamma, self.max_len - int(self.lens[i]) - 2,
+                      int(self.budget[i]))
+            if cap <= 0:
+                continue
+            hist = list(req.prompt_tokens) + self.generated[i]
+            idx = self._spec_index[i]
+            if idx is None:
+                idx = self._spec_index[i] = NgramIndex()
+            idx.extend(hist)
+            drafts[i] = idx.draft(hist, cap)
+        return drafts
+
+    def _spec_decode_all(self, last, temps, mask, drafts):
+        gamma = self.speculative
+        toks = np.zeros((self.max_slots, gamma + 1), dtype=np.int32)
+        toks[:, 0] = last
+        for i, d in enumerate(drafts):
+            toks[i, 1:1 + len(d)] = d
+        self.key, sub = jax.random.split(self.key)
+        greedy, sampled0, self.cache = self._verify(
+            self.params, self.cache, jnp.asarray(toks),
+            jnp.asarray(self.lens), sub, jnp.asarray(temps),
+            jnp.asarray(mask))
+        greedy = np.asarray(greedy)
+        sampled0 = np.asarray(sampled0)
+        self.spec_stats["verify_steps"] += 1
+        for i, req in enumerate(self.active):
+            if req is None:
+                continue
+            if req.temperature > 0:
+                emitted = [int(sampled0[i])]
+            else:
+                # Longest-prefix acceptance: greedy[i, j] both checks
+                # draft[j] and IS the correction/bonus token on exit.
+                emitted = []
+                for j in range(len(drafts[i]) + 1):
+                    emitted.append(int(greedy[i, j]))
+                    if j >= len(drafts[i]) or greedy[i, j] != drafts[i][j]:
+                        break
+                self.spec_stats["drafted"] += len(drafts[i])
+                self.spec_stats["accepted"] += len(emitted) - 1
+                if drafts[i]:
+                    if len(emitted) > 1:
+                        self._spec_miss[i] = 0
+                    else:
+                        self._spec_miss[i] += 1
+                        if self._spec_miss[i] >= self.SPEC_MISS_LIMIT:
+                            self._spec_cooldown[i] = self.SPEC_PROBE_EVERY
+            take: List[int] = []
+            for t in emitted:
+                take.append(t)
+                self.budget[i] -= 1
+                if self.budget[i] <= 0 or \
+                        (req.eos_token is not None and t == req.eos_token) \
+                        or self.lens[i] + len(take) + 1 >= self.max_len:
+                    break
+            self.lens[i] += len(take)
+            self.generated[i].extend(take)
             self._maybe_finish(i)
 
     def _decode_call(self, last, temps, mask, sub):

@@ -188,10 +188,11 @@ class PagedServeEngine(ServeEngine):
 
     def _register_full_prompt(self, req: Request, slot: int) -> None:
         """Publish the prompt's full blocks for future requests.  Cached
-        blocks re-register as no-ops; bucket/chunk padding past the
-        prompt was written to this slot's PRIVATE blocks only, and only
-        positions < lens are ever read, so shared content is exactly the
-        real tokens."""
+        blocks re-register as no-ops.  Bucket/chunk padding past the
+        prompt is never written at all — make_paged_forward's per-token
+        write gate drops padding lanes (their table lookups could alias
+        other requests' physical blocks), and only positions < lens are
+        ever read — so shared content is exactly the real tokens."""
         plen = len(req.prompt_tokens)
         if self._share_prefixes:
             self.allocator.register_prefix(

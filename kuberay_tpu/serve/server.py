@@ -192,7 +192,18 @@ def main(argv=None):  # pragma: no cover - process wrapper
                     help="paged decode attention path (auto: pallas on TPU)")
     ap.add_argument("--prefill-chunk", type=int, default=0,
                     help="chunked prefill size (0 = whole-prompt prefill)")
+    ap.add_argument("--speculative", type=int, default=0,
+                    help="prompt-lookup speculative decoding draft length "
+                         "(dense engine, greedy slots; 0 = off)")
+    ap.add_argument("--kv-quant", default="none", choices=["none", "int8"],
+                    help="KV cache storage dtype (dense engine)")
     args = ap.parse_args(argv)
+    if args.paged and args.speculative:
+        ap.error("--speculative is not supported with --paged yet "
+                 "(dense engine only)")
+    if args.paged and args.kv_quant != "none":
+        ap.error("--kv-quant is not supported with --paged yet "
+                 "(dense engine only)")
 
     cfg = llama.CONFIGS[args.model]
     params = llama.init_params(cfg, jax.random.PRNGKey(0))
@@ -205,7 +216,9 @@ def main(argv=None):  # pragma: no cover - process wrapper
     else:
         engine = ServeEngine(cfg, params, max_slots=args.max_slots,
                              max_len=args.max_len,
-                             prefill_chunk=args.prefill_chunk)
+                             prefill_chunk=args.prefill_chunk,
+                             speculative=args.speculative,
+                             kv_quant=args.kv_quant)
     frontend = ServeFrontend(engine)
     srv = frontend.make_server(args.host, args.port)
     if args.coordinator:

@@ -1,0 +1,133 @@
+"""Speculative decoding (prompt-lookup drafts, greedy acceptance)."""
+
+import jax
+import numpy as np
+import pytest
+
+from kuberay_tpu.models.llama import CONFIGS, init_params
+from kuberay_tpu.serve.engine import (
+    Request,
+    ServeEngine,
+    prompt_lookup_draft,
+)
+
+CFG = CONFIGS["llama_tiny"]
+PARAMS = init_params(CFG, jax.random.PRNGKey(0))
+
+
+def make_engine(**kw):
+    kw.setdefault("max_slots", 4)
+    kw.setdefault("max_len", 128)
+    return ServeEngine(CFG, PARAMS, **kw)
+
+
+# -- drafting ---------------------------------------------------------------
+
+def test_prompt_lookup_finds_repeats():
+    hist = [1, 2, 3, 9, 9, 1, 2, 3]
+    assert prompt_lookup_draft(hist, 3) == [9, 9, 1]
+
+
+def test_prompt_lookup_prefers_longer_ngram_and_latest_match():
+    hist = [5, 1, 2, 7, 7, 1, 2, 8, 8, 1, 2]
+    # Trigram [8, 1, 2] has no earlier occurrence; bigram [1, 2] matches
+    # latest at index 5 -> continuation [8, 8, 1].
+    assert prompt_lookup_draft(hist, 3) == [8, 8, 1]
+
+
+def test_prompt_lookup_no_match():
+    assert prompt_lookup_draft([1, 2, 3, 4], 3) == []
+    assert prompt_lookup_draft([1], 3) == []
+
+
+def test_ngram_index_matches_reference_scan():
+    """The incremental index must produce the same draft as the O(L)
+    reference scan at every history length, including across incremental
+    extends."""
+    from kuberay_tpu.serve.engine import NgramIndex
+    rng = np.random.default_rng(11)
+    hist = rng.integers(1, 6, size=200).tolist()   # small alphabet: repeats
+    idx = NgramIndex()
+    for upto in range(2, len(hist) + 1):
+        h = hist[:upto]
+        idx.extend(h)
+        assert idx.draft(h, 4) == prompt_lookup_draft(h, 4), upto
+
+
+# -- exactness --------------------------------------------------------------
+
+def repetitive_prompts():
+    """Prompts with internal repeats (drafts will hit) + random ones."""
+    rng = np.random.default_rng(7)
+    rep = ([3, 4, 5, 6] * 6)[:20]
+    rnd = rng.integers(1, CFG.vocab_size, size=15).tolist()
+    return [rep, rnd, rep[::-1] + rep, [9, 9, 9, 9, 9, 9]]
+
+
+def run_all(engine, temp=0.0, n=24):
+    for i, p in enumerate(repetitive_prompts()):
+        engine.add_request(Request(f"r{i}", p, max_new_tokens=n,
+                                   temperature=temp))
+    out = engine.run()
+    return {r.request_id: (r.tokens, r.finish_reason) for r in out}
+
+
+def test_speculative_outputs_exactly_match_sequential():
+    want = run_all(make_engine())
+    got = run_all(make_engine(speculative=4))
+    assert got == want
+
+
+def test_speculation_actually_accepts():
+    eng = make_engine(speculative=4)
+    run_all(eng)
+    assert eng.spec_stats["verify_steps"] > 0
+    assert eng.spec_stats["accepted"] > 0
+    # Fewer engine iterations than emitted tokens proves multi-emit.
+    total = eng.spec_stats["accepted"] + eng.spec_stats["drafted"]
+    assert eng.spec_stats["accepted"] <= eng.spec_stats["drafted"] <= total
+
+
+def test_sampling_slots_never_draft():
+    eng = make_engine(speculative=4)
+    run_all(eng, temp=0.9)
+    assert eng.spec_stats["drafted"] == 0
+
+
+def test_eos_respected_mid_acceptance():
+    """An eos token inside an accepted draft must end the request there,
+    exactly as sequential decode would."""
+    eng_seq = make_engine()
+    eng_spec = make_engine(speculative=4)
+    prompt = [3, 4, 5, 6] * 5
+    # Use whatever sequential decode emits 3rd as the eos token.
+    probe = make_engine()
+    probe.add_request(Request("p", list(prompt), max_new_tokens=10))
+    third = probe.run()[0].tokens[2]
+    outs = {}
+    for name, eng in (("seq", eng_seq), ("spec", eng_spec)):
+        eng.add_request(Request("x", list(prompt), max_new_tokens=10,
+                                eos_token=int(third)))
+        outs[name] = [(r.tokens, r.finish_reason) for r in eng.run()]
+    assert outs["seq"] == outs["spec"]
+
+
+def test_speculative_with_chunked_prefill_compose():
+    want = run_all(make_engine())
+    got = run_all(make_engine(speculative=4, prefill_chunk=8))
+    assert got == want
+
+
+def test_fewer_device_steps_with_speculation():
+    """On a pathologically repetitive prompt, speculation must finish in
+    materially fewer engine steps than sequential decode."""
+    def count_steps(engine):
+        engine.add_request(Request("r", [5, 6] * 8, max_new_tokens=32))
+        steps = 0
+        while engine.has_work():
+            engine.step()
+            steps += 1
+        return steps
+    seq = count_steps(make_engine())
+    spec = count_steps(make_engine(speculative=4))
+    assert spec < seq
