@@ -51,6 +51,10 @@ class LlamaConfig:
     # (parallel/ring_pallas.py) overlapping exchange with compute.
     attn_impl: str = "auto"
     remat: bool = True
+    # Vocab-chunked cross entropy (ops/xent.py): 0 = dense logits.  Set
+    # for large-vocab configs — the [B,S,V] f32 logits tensor is the
+    # single largest training activation at Llama-3 scale.
+    xent_chunk: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -81,10 +85,11 @@ CONFIGS: Dict[str, LlamaConfig] = {
     "llama_1b": LlamaConfig(
         vocab_size=32768, d_model=2048, n_layers=16, n_heads=16, n_kv_heads=8,
         d_ff=8192, max_seq_len=4096),
-    # The flagship (BASELINE config #3).
-    "llama3_8b": LlamaConfig(),
+    # The flagship (BASELINE config #3).  128k vocab -> chunked CE.
+    "llama3_8b": LlamaConfig(xent_chunk=16384),
     "llama3_70b": LlamaConfig(
-        d_model=8192, n_layers=80, n_heads=64, n_kv_heads=8, d_ff=28672),
+        d_model=8192, n_layers=80, n_heads=64, n_kv_heads=8, d_ff=28672,
+        xent_chunk=16384),
 }
 
 
@@ -183,12 +188,9 @@ def _layer(cfg: LlamaConfig, x: jax.Array, lp: Dict[str, jax.Array],
     return x
 
 
-def forward(cfg: LlamaConfig, params: Dict[str, Any],
-            tokens: jax.Array, mesh=None) -> jax.Array:
-    """tokens: [B, S] int32 -> logits [B, S, vocab] float32.
-
-    ``mesh`` is required for attn_impl='ring' (sequence parallelism over
-    its sp axis — the long-context training path)."""
+def forward_hidden(cfg: LlamaConfig, params: Dict[str, Any],
+                   tokens: jax.Array, mesh=None):
+    """tokens: [B, S] -> (final hidden [B, S, d], head [d, V])."""
     B, S = tokens.shape
     x = jnp.take(params["embed"], tokens, axis=0)          # [B, S, d]
     cos, sin = rope_frequencies(cfg.head_dim, S, cfg.rope_theta)
@@ -200,9 +202,18 @@ def forward(cfg: LlamaConfig, params: Dict[str, Any],
 
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = jnp.einsum("bsd,dv->bsv", x, head,
-                        preferred_element_type=jnp.float32)
-    return logits
+    return x, head
+
+
+def forward(cfg: LlamaConfig, params: Dict[str, Any],
+            tokens: jax.Array, mesh=None) -> jax.Array:
+    """tokens: [B, S] int32 -> logits [B, S, vocab] float32.
+
+    ``mesh`` is required for attn_impl='ring' (sequence parallelism over
+    its sp axis — the long-context training path)."""
+    x, head = forward_hidden(cfg, params, tokens, mesh)
+    return jnp.einsum("bsd,dv->bsv", x, head,
+                      preferred_element_type=jnp.float32)
 
 
 def loss_fn(cfg: LlamaConfig, params: Dict[str, Any], tokens: jax.Array,
@@ -212,7 +223,20 @@ def loss_fn(cfg: LlamaConfig, params: Dict[str, Any], tokens: jax.Array,
     """Next-token cross entropy with z-loss regularization.
 
     tokens/targets: [B, S]; mask: [B, S] (1 = contributes to loss).
+
+    With ``cfg.xent_chunk > 0`` the [B,S,V] logits tensor is never
+    materialized (ops/xent.py chunked CE — identical math).
     """
+    if cfg.xent_chunk:
+        from kuberay_tpu.ops.xent import chunked_softmax_xent_loss
+        B, S = tokens.shape
+        x, head = forward_hidden(cfg, params, tokens, mesh)
+        return chunked_softmax_xent_loss(
+            x.reshape(B * S, -1), head, targets.reshape(-1),
+            mask=None if mask is None else
+            mask.reshape(-1).astype(jnp.float32),
+            z_loss=z_loss, chunk=cfg.xent_chunk)
+
     logits = forward(cfg, params, tokens, mesh)            # [B,S,V] f32
     logz = jax.nn.logsumexp(logits, axis=-1)               # [B,S]
     true_logit = jnp.take_along_axis(

@@ -45,6 +45,7 @@ class MixtralConfig:
     dtype: Any = jnp.bfloat16
     attn_impl: str = "auto"
     remat: bool = True
+    xent_chunk: int = 0        # vocab-chunked CE (ops/xent.py); 0 = dense
 
     @property
     def head_dim(self) -> int:
@@ -56,7 +57,7 @@ CONFIGS: Dict[str, MixtralConfig] = {
         vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
         d_ff=128, n_experts=4, top_k=2, max_seq_len=128,
         dtype=jnp.float32, attn_impl="xla", remat=False),
-    "mixtral_8x7b": MixtralConfig(),
+    "mixtral_8x7b": MixtralConfig(xent_chunk=8000),
 }
 
 
@@ -229,27 +230,46 @@ def _layer(cfg: MixtralConfig, x, lp, cos, sin):
 def forward(cfg: MixtralConfig, params: Dict[str, Any], tokens: jax.Array
             ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """tokens [B,S] -> (logits [B,S,V] f32, aux losses summed over layers)."""
-    B, S = tokens.shape
-    x = jnp.take(params["embed"], tokens, axis=0)
-    cos, sin = rope_frequencies(cfg.head_dim, S, cfg.rope_theta)
-
-    def layer_fn(x, lp):
-        x, aux = _layer(cfg, x, lp, cos, sin)
-        return x, aux
-    if cfg.remat:
-        layer_fn = jax.checkpoint(layer_fn, prevent_cse=False)
-    x, aux_stack = jax.lax.scan(layer_fn, x, params["layers"])
-    aux = {k: v.sum() for k, v in aux_stack.items()}
-
-    x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    x, aux = forward_hidden(cfg, params, tokens)
     logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"],
                         preferred_element_type=jnp.float32)
     return logits, aux
 
 
+def forward_hidden(cfg: MixtralConfig, params, tokens):
+    """tokens [B,S] -> (final hidden [B,S,d], aux) without the logits."""
+    B, S = tokens.shape
+    x = jnp.take(params["embed"], tokens, axis=0)
+    cos, sin = rope_frequencies(cfg.head_dim, S, cfg.rope_theta)
+
+    def layer_fn(x, lp):
+        return _layer(cfg, x, lp, cos, sin)
+    if cfg.remat:
+        layer_fn = jax.checkpoint(layer_fn, prevent_cse=False)
+    x, aux_stack = jax.lax.scan(layer_fn, x, params["layers"])
+    aux = {k: v.sum() for k, v in aux_stack.items()}
+    return rmsnorm(x, params["final_norm"], cfg.norm_eps), aux
+
+
 def loss_fn(cfg: MixtralConfig, params, tokens, targets,
             mask: Optional[jax.Array] = None,
             z_loss: float = 1e-4) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    if cfg.xent_chunk:
+        from kuberay_tpu.ops.xent import chunked_softmax_xent_loss
+        B, S = tokens.shape
+        x, aux = forward_hidden(cfg, params, tokens)
+        ce_total, m = chunked_softmax_xent_loss(
+            x.reshape(B * S, -1), params["lm_head"], targets.reshape(-1),
+            mask=None if mask is None else
+            mask.reshape(-1).astype(jnp.float32),
+            z_loss=z_loss, chunk=cfg.xent_chunk)
+        total = ce_total + aux["load_balance"] + aux["router_z"]
+        metrics = {"loss": m["loss"], "total_loss": total,
+                   "aux_load_balance": aux["load_balance"],
+                   "aux_router_z": aux["router_z"],
+                   "accuracy": m["accuracy"]}
+        return total, metrics
+
     logits, aux = forward(cfg, params, tokens)
     logz = jax.nn.logsumexp(logits, axis=-1)
     true_logit = jnp.take_along_axis(logits, targets[..., None], -1).squeeze(-1)

@@ -1,0 +1,154 @@
+"""Chunked softmax cross-entropy: the loss without the logits.
+
+``loss_fn``'s naive path materializes [B, S, V] f32 logits — at Llama-3
+scale (V=128k, B·S=256k tokens) that is ~134 GB unsharded, the single
+largest activation in training.  This op never materializes more than
+[T, chunk] logits:
+
+- forward: online logsumexp over vocab chunks (one running (m, l) pair
+  per token — the flash-attention trick applied to the vocab axis),
+  plus the target's logit and the running argmax;
+- backward (custom_vjp): recompute each chunk's logits from the saved
+  (x, head) residuals and contract immediately into dx / dhead —
+  softmax rows never exist all at once either.
+
+A vocab that doesn't divide the chunk gets one static tail segment (the
+remainder) instead of a silently collapsed chunk size — llama3's
+V=128256 with chunk 16384 runs 7 full chunks + one 13568-wide tail, not
+501 tiny matmuls.  Matmuls keep the model dtype as operands with f32
+accumulation (``preferred_element_type``), matching the dense einsum's
+MXU rate; only the tiny running statistics live in f32.
+
+Cost: the head matmul runs twice (fwd + recompute in bwd) — the same
+FLOPs-for-memory trade as jax.checkpoint, applied where it pays most.
+
+Reference counterpart: none (KubeRay ships no compute); role analogues
+are fused/chunked CE in large-vocab training stacks.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+_NEG = -1e30
+
+
+def _dot_f32(a, b):
+    """Matmul with native-dtype operands and f32 accumulation."""
+    return jax.lax.dot_general(a, b, (((1,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def chunked_xent(x, head, targets, chunk: int = 8192):
+    """x: [T, d] hidden states; head: [d, V]; targets: [T] int32.
+    Returns (nll [T], logz [T], pred [T]) — pred is argmax (no grad).
+    """
+    nll, logz, pred, _ = _forward(x, head, targets, chunk)
+    return nll, logz, pred
+
+
+def _forward(x, head, targets, chunk):
+    T, d = x.shape
+    V = head.shape[1]
+    C = min(chunk, V)
+    nc, tail = V // C, V % C
+
+    def update(carry, logits, col0):
+        m, l, tl, bv, bi = carry
+        cols = col0 + jnp.arange(logits.shape[1])[None, :]
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
+        l = l * jnp.exp(m - m_new) + \
+            jnp.sum(jnp.exp(logits - m_new[:, None]), axis=-1)
+        tl = tl + jnp.sum(jnp.where(cols == targets[:, None], logits, 0.0),
+                          axis=-1)
+        cv = jnp.max(logits, axis=-1)
+        ci = col0 + jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        take = cv > bv
+        return (m_new, l, tl, jnp.where(take, cv, bv),
+                jnp.where(take, ci, bi))
+
+    def body(i, carry):
+        hc = jax.lax.dynamic_slice_in_dim(head, i * C, C, axis=1)
+        return update(carry, _dot_f32(x, hc), i * C)
+
+    carry = (jnp.full((T,), _NEG, jnp.float32), jnp.zeros((T,), jnp.float32),
+             jnp.zeros((T,), jnp.float32), jnp.full((T,), _NEG, jnp.float32),
+             jnp.zeros((T,), jnp.int32))
+    carry = jax.lax.fori_loop(0, nc, body, carry)
+    if tail:
+        carry = update(carry, _dot_f32(x, head[:, nc * C:]), nc * C)
+    m, l, tl, _, pred = carry
+    logz = m + jnp.log(l)
+    return logz - tl, logz, pred, (x, head, targets, logz)
+
+
+def _fwd(x, head, targets, chunk):
+    nll, logz, pred, res = _forward(x, head, targets, chunk)
+    return (nll, logz, pred), res
+
+
+def _bwd(chunk, res, cts):
+    g_nll, g_logz, _ = cts                        # pred carries no grad
+    x, head, targets, logz = res
+    T, d = x.shape
+    V = head.shape[1]
+    C = min(chunk, V)
+    nc, tail = V // C, V % C
+    # d(nll)/dlogits = softmax - onehot ; d(logz)/dlogits = softmax.
+    gp = (g_nll + g_logz).astype(jnp.float32)     # softmax coefficient
+
+    def dchunk(hc, col0):
+        logits = _dot_f32(x, hc)
+        p = jnp.exp(logits - logz[:, None])       # softmax rows, this chunk
+        cols = col0 + jnp.arange(logits.shape[1])[None, :]
+        dlog = gp[:, None] * p - jnp.where(
+            cols == targets[:, None], g_nll[:, None], 0.0)
+        dlog = dlog.astype(x.dtype)               # bf16 operands, f32 acc
+        dxc = _dot_f32(dlog, hc.T)
+        dhc = _dot_f32(x.T, dlog)
+        return dxc, dhc
+
+    def body(i, carry):
+        dx, dhead = carry
+        hc = jax.lax.dynamic_slice_in_dim(head, i * C, C, axis=1)
+        dxc, dhc = dchunk(hc, i * C)
+        dhead = jax.lax.dynamic_update_slice_in_dim(
+            dhead, dhc.astype(dhead.dtype), i * C, axis=1)
+        return dx + dxc, dhead
+
+    dx0 = jnp.zeros((T, d), jnp.float32)
+    dh0 = jnp.zeros((d, V), jnp.float32)
+    dx, dhead = jax.lax.fori_loop(0, nc, body, (dx0, dh0))
+    if tail:
+        dxc, dhc = dchunk(head[:, nc * C:], nc * C)
+        dx = dx + dxc
+        dhead = dhead.at[:, nc * C:].set(dhc.astype(dhead.dtype))
+    return dx.astype(x.dtype), dhead.astype(head.dtype), None
+
+
+chunked_xent.defvjp(_fwd, _bwd)
+
+
+def chunked_softmax_xent_loss(x, head, targets, mask=None,
+                              z_loss: float = 1e-4, chunk: int = 8192
+                              ) -> Tuple[jax.Array, dict]:
+    """Drop-in for the tail of loss_fn: hidden states + head -> masked
+    mean loss and metrics, without a [T, V] intermediate."""
+    T = x.shape[0]
+    nll, logz, pred = chunked_xent(x, head, targets, chunk)
+    zl = z_loss * jnp.square(logz)
+    if mask is None:
+        mask = jnp.ones((T,), jnp.float32)
+    denom = jnp.maximum(mask.sum(), 1.0)
+    loss = ((nll + zl) * mask).sum() / denom
+    metrics = {
+        "loss": (nll * mask).sum() / denom,
+        "z_loss": (zl * mask).sum() / denom,
+        "accuracy": ((pred == targets) * mask).sum() / denom,
+    }
+    return loss, metrics

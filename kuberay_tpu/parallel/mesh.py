@@ -101,6 +101,14 @@ class MeshSpec:
                     f"num_slices={num_slices} but platform reports "
                     f"{detected} slices")
             num_slices = detected
+            group_sizes = {len(g) for g in by_slice.values()}
+            if len(group_sizes) != 1:
+                # Uneven groups would reshape "cleanly" into a mesh whose
+                # ICI axes straddle DCN — refuse instead.
+                raise ValueError(
+                    f"slices have unequal device counts "
+                    f"{sorted(len(g) for g in by_slice.values())}; pass a "
+                    f"device subset with equal per-slice counts")
         else:
             # Single- or no-slice_index platforms (CPU virtual mesh, one
             # process per slice over DCN): slice = contiguous device

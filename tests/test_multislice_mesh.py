@@ -44,6 +44,18 @@ def test_multi_axis_dcn():
             assert np.all(g == g.flat[0])
 
 
+def test_platform_detected_slices_must_be_equal_sized():
+    """Uneven per-slice device counts would silently straddle ICI axes
+    across DCN; the builder must refuse."""
+    class FakeDev:
+        def __init__(self, i, s):
+            self.id, self.slice_index = i, s
+    devs = [FakeDev(i, 0) for i in range(3)] + \
+        [FakeDev(i + 3, 1) for i in range(5)]
+    with pytest.raises(ValueError, match="unequal device counts"):
+        MeshSpec(dp=2, fsdp=-1).build_multislice(devs)
+
+
 def test_dcn_size_must_match_slices():
     with pytest.raises(ValueError, match="must exactly cover"):
         MeshSpec(dp=2, fsdp=-1).build_multislice(num_slices=4)
