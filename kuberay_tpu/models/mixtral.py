@@ -57,7 +57,8 @@ CONFIGS: Dict[str, MixtralConfig] = {
         vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
         d_ff=128, n_experts=4, top_k=2, max_seq_len=128,
         dtype=jnp.float32, attn_impl="xla", remat=False),
-    "mixtral_8x7b": MixtralConfig(xent_chunk=8000),
+    # 8192 (lane-aligned): 3 full chunks + a 7424-wide tail over V=32000.
+    "mixtral_8x7b": MixtralConfig(xent_chunk=8192),
 }
 
 

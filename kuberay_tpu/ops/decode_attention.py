@@ -11,6 +11,12 @@ at position 100 of a 2048-token cache reads ~1/20th of it.
 Layout: q [S, Hq, D]; cache [S, max_len, Hkv, D]; lens [S].  GQA grid is
 (slot, kv_head, kv_block) with the head group computed together
 ([group, D] accumulators).
+
+One kernel serves both cache dtypes: bf16, and the int8-quantized cache
+(per-position scales in [S, Hkv, M] layout — positions on lanes) where
+scales fold into the score columns (s *= ks) and probability rows
+(p *= vs), so K/V are never dequantized to [bkv, D] and the HBM stream
+is ~half the bf16 kernel's.
 """
 
 from __future__ import annotations
@@ -24,6 +30,23 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
+
+
+def _resolve_impl(impl: str) -> str:
+    if impl != "auto":
+        return impl
+    try:
+        on_tpu = jax.default_backend() == "tpu"
+    except Exception:
+        on_tpu = False
+    return "pallas" if on_tpu else "xla"
+
+
+def dequant_lanes(x8, s, dtype):
+    """Dequantize the lane-major scale layout: x8 [..., M, H, D] int8,
+    s [..., H, M] f32 -> [..., M, H, D] in ``dtype``."""
+    return (x8.astype(jnp.float32)
+            * jnp.swapaxes(s, -2, -1)[..., None]).astype(dtype)
 
 
 def decode_attention_xla(q, ck, cv, lens, scale: Optional[float] = None):
@@ -44,9 +67,24 @@ def decode_attention_xla(q, ck, cv, lens, scale: Optional[float] = None):
     return out.astype(q.dtype)
 
 
-def _decode_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref,
-                   m_scr, l_scr, acc_scr,
-                   *, scale, bkv, num_kv, num_kv_heads, group):
+def decode_attention_quant_xla(q, kq, ks, vq, vs, lens,
+                               scale: Optional[float] = None):
+    """Reference/fallback for the int8 cache: dequantize then dense.
+    kq/vq: [S, M, Hkv, D] int8; ks/vs: [S, Hkv, M] f32."""
+    return decode_attention_xla(q, dequant_lanes(kq, ks, q.dtype),
+                                dequant_lanes(vq, vs, q.dtype), lens, scale)
+
+
+def _decode_kernel(lens_ref, q_ref, k_ref, *rest,
+                   scale, bkv, num_kv, num_kv_heads, group, quant):
+    """Shared bf16/int8 decode kernel body.  rest is (v_ref, o_ref,
+    scratches) for bf16, or (ks_ref, v_ref, vs_ref, o_ref, scratches)
+    for the quantized cache."""
+    if quant:
+        ks_ref, v_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        v_ref, o_ref, m_scr, l_scr, acc_scr = rest
+        ks_ref = vs_ref = None
     slot = pl.program_id(0)
     j = pl.program_id(1)          # kv block (innermost, sequential)
 
@@ -74,9 +112,19 @@ def _decode_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref,
             q = q_ref[0, rows, :]                # [group, D]
             k = k_ref[0, :, h, :]                # [bkv, D]
             v = v_ref[0, :, h, :]
+            if quant:
+                # int8 values <= 127 are exact in the query dtype; the
+                # per-position dequant scale folds into the score columns
+                # and probability rows instead of touching [bkv, D].
+                k = k.astype(q.dtype)
+                v = v.astype(q.dtype)
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32) * scale   # [group, bkv]
+                preferred_element_type=jnp.float32)           # [group, bkv]
+            if quant:
+                s = s * (ks_ref[0, h, :][None, :] * scale)
+            else:
+                s = s * scale
             s = jnp.where(cols < live, s, _NEG_INF)
             m_prev = m_scr[rows, :1]
             m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -84,6 +132,8 @@ def _decode_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref,
             p = jnp.exp(s - m_cur)
             l_cur = corr * l_scr[rows, :1] + jnp.sum(p, axis=-1,
                                                      keepdims=True)
+            if quant:
+                p = p * vs_ref[0, h, :][None, :]
             pv = jax.lax.dot_general(
                 p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
@@ -97,19 +147,14 @@ def _decode_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0, :, :] = (acc_scr[:, :] / l).astype(o_ref.dtype)
 
 
-def decode_attention_pallas(q, ck, cv, lens, scale: Optional[float] = None,
-                            bkv: int = 1024, interpret: bool = False):
-    # bkv=1024 measured on TPU v5e (B=64, K=2048, 8/4 heads): 6.8 ms vs
-    # 7.4 (bkv=512) / 26.6 (bkv=256) / 8.4 XLA; bkv=2048 exceeds VMEM.
+def _pallas_decode(q, lens, kv_args, scale, bkv, interpret, quant,
+                   bytes_accessed):
+    """Shared pallas_call builder for both cache dtypes."""
     S, Hq, D = q.shape
-    max_len = ck.shape[1]
-    Hkv = ck.shape[2]
+    first_kv = kv_args[0]
+    max_len = first_kv.shape[1]
+    Hkv = first_kv.shape[2]
     group = Hq // Hkv
-    scale = scale if scale is not None else 1.0 / (D ** 0.5)
-    while max_len % bkv != 0 and bkv > 8:
-        bkv //= 2
-    if max_len % bkv != 0:
-        return decode_attention_xla(q, ck, cv, lens, scale)
     nkv = max_len // bkv
 
     def kv_index(s, j, lens):
@@ -119,17 +164,23 @@ def decode_attention_pallas(q, ck, cv, lens, scale: Optional[float] = None,
         last_live = jnp.maximum((lens[s] - 1) // bkv, 0)
         return (s, jnp.minimum(j, last_live), 0, 0)
 
+    def scale_index(s, j, lens):
+        last_live = jnp.maximum((lens[s] - 1) // bkv, 0)
+        return (s, 0, jnp.minimum(j, last_live))
+
+    kv_spec = pl.BlockSpec((1, bkv, Hkv, D), kv_index,
+                           memory_space=pltpu.VMEM)
+    s_spec = pl.BlockSpec((1, Hkv, bkv), scale_index,
+                          memory_space=pltpu.VMEM)
+    in_specs = [pl.BlockSpec((1, Hq, D), lambda s, j, lens: (s, 0, 0),
+                             memory_space=pltpu.VMEM)]
+    in_specs += [kv_spec, s_spec, kv_spec, s_spec] if quant \
+        else [kv_spec, kv_spec]
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(S, nkv),
-        in_specs=[
-            pl.BlockSpec((1, Hq, D), lambda s, j, lens: (s, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bkv, Hkv, D), kv_index,
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bkv, Hkv, D), kv_index,
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, Hq, D), lambda s, j, lens: (s, 0, 0),
                                memory_space=pltpu.VMEM),
         scratch_shapes=[
@@ -139,11 +190,11 @@ def decode_attention_pallas(q, ck, cv, lens, scale: Optional[float] = None,
         ],
     )
     kernel = functools.partial(_decode_kernel, scale=scale, bkv=bkv,
-                               num_kv=nkv, num_kv_heads=Hkv, group=group)
-
+                               num_kv=nkv, num_kv_heads=Hkv, group=group,
+                               quant=quant)
     cost = pl.CostEstimate(
         flops=4 * S * Hq * max_len * D,
-        bytes_accessed=(ck.size + cv.size + q.size) * q.dtype.itemsize,
+        bytes_accessed=bytes_accessed,
         transcendentals=S * Hq * max_len)
     return pl.pallas_call(
         kernel,
@@ -151,19 +202,62 @@ def decode_attention_pallas(q, ck, cv, lens, scale: Optional[float] = None,
         out_shape=jax.ShapeDtypeStruct((S, Hq, D), q.dtype),
         cost_estimate=cost,
         interpret=interpret,
-    )(lens.astype(jnp.int32), q, ck, cv)
+    )(lens.astype(jnp.int32), q, *kv_args)
+
+
+def _fit_bkv(max_len: int, bkv: int) -> int:
+    while max_len % bkv != 0 and bkv > 8:
+        bkv //= 2
+    return bkv
+
+
+def decode_attention_pallas(q, ck, cv, lens, scale: Optional[float] = None,
+                            bkv: int = 1024, interpret: bool = False):
+    # bkv=1024 measured on TPU v5e (B=64, K=2048, 8/4 heads): 6.8 ms vs
+    # 7.4 (bkv=512) / 26.6 (bkv=256) / 8.4 XLA; bkv=2048 exceeds VMEM.
+    D = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / (D ** 0.5)
+    bkv = _fit_bkv(ck.shape[1], bkv)
+    if ck.shape[1] % bkv != 0:
+        return decode_attention_xla(q, ck, cv, lens, scale)
+    return _pallas_decode(
+        q, lens, (ck, cv), scale, bkv, interpret, quant=False,
+        bytes_accessed=(ck.size + cv.size + q.size) * q.dtype.itemsize)
+
+
+def decode_attention_quant_pallas(q, kq, ks, vq, vs, lens,
+                                  scale: Optional[float] = None,
+                                  bkv: int = 1024, interpret: bool = False):
+    """int8-cache decode attention: streams HALF the HBM bytes of the
+    bf16 kernel (int8 payload + one f32 scale per position-head)."""
+    D = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / (D ** 0.5)
+    bkv = _fit_bkv(kq.shape[1], bkv)
+    if kq.shape[1] % bkv != 0:
+        return decode_attention_quant_xla(q, kq, ks, vq, vs, lens, scale)
+    return _pallas_decode(
+        q, lens, (kq, ks, vq, vs), scale, bkv, interpret, quant=True,
+        bytes_accessed=kq.size + vq.size + (ks.size + vs.size) * 4
+        + q.size * q.dtype.itemsize)
 
 
 def decode_attention(q, ck, cv, lens, scale: Optional[float] = None,
                      impl: str = "auto"):
     """Dispatching decode attention.  impl: auto|pallas|xla|pallas_interpret."""
-    if impl == "auto":
-        try:
-            on_tpu = jax.default_backend() == "tpu"
-        except Exception:
-            on_tpu = False
-        impl = "pallas" if on_tpu else "xla"
+    impl = _resolve_impl(impl)
     if impl == "xla":
         return decode_attention_xla(q, ck, cv, lens, scale)
     return decode_attention_pallas(q, ck, cv, lens, scale,
                                    interpret=impl == "pallas_interpret")
+
+
+def decode_attention_quant(q, kq, ks, vq, vs, lens,
+                           scale: Optional[float] = None,
+                           impl: str = "auto"):
+    """Dispatching int8-cache decode attention."""
+    impl = _resolve_impl(impl)
+    if impl == "xla":
+        return decode_attention_quant_xla(q, kq, ks, vq, vs, lens, scale)
+    return decode_attention_quant_pallas(
+        q, kq, ks, vq, vs, lens, scale,
+        interpret=impl == "pallas_interpret")

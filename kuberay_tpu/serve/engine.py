@@ -120,7 +120,8 @@ class ServeEngine:
     def __init__(self, cfg: LlamaConfig, params: Dict[str, Any],
                  max_slots: int = 8, max_len: int = 2048,
                  rng_seed: int = 0, prefill_chunk: int = 0,
-                 speculative: int = 0, kv_quant: str = "none"):
+                 speculative: int = 0, kv_quant: str = "none",
+                 decode_impl: str = "auto"):
         self.cfg = cfg
         self.params = params
         self.max_slots = max_slots
@@ -156,7 +157,10 @@ class ServeEngine:
             self._forward = forward_with_cache
         if kv_quant != "none":
             from kuberay_tpu.serve.kv_cache import make_quantized_forward
-            self._forward = make_quantized_forward(self._forward)
+            # decode_impl is the operational escape hatch: "xla" routes
+            # the int8 decode read around the Pallas kernel.
+            self._forward = make_quantized_forward(self._forward,
+                                                   decode_impl=decode_impl)
         self.key = jax.random.PRNGKey(rng_seed)
 
         # Slot bookkeeping (host side).

@@ -28,7 +28,10 @@ def init_kv_cache(cfg, slots: int, max_len: int,
     rest is ~half the bytes of bf16 (vLLM kv_cache_dtype=int8 role)."""
     shape = (cfg.n_layers, slots, max_len, cfg.n_kv_heads, cfg.head_dim)
     if quant == "int8":
-        sshape = shape[:-1] + (1,)
+        # Scales live position-on-lanes ([..., Hkv, max_len]) — the
+        # layout the Pallas decode kernel streams (a [..., 1] trailing
+        # axis would violate TPU lane tiling).
+        sshape = (cfg.n_layers, slots, cfg.n_kv_heads, max_len)
         leaf = lambda: {"q": jnp.zeros(shape, jnp.int8),     # noqa: E731
                         "s": jnp.zeros(sshape, jnp.float32)}
         return {"k": leaf(), "v": leaf()}
@@ -125,14 +128,34 @@ def _insert_kv(ck, cv, kk, vv, positions, start, write_mask, T):
     return ck, cv
 
 
-def make_quantized_forward(base_forward=None):
+def _insert_scales(cs, new_s, positions, start, write_mask, T):
+    """Insert per-position scales into the [S, Hkv, M] cache layout.
+    new_s: [S, T, Hkv]."""
+    if T == 1:
+        def upd(row, new_row, pos, m):        # row: [Hkv, M]
+            written = jax.lax.dynamic_update_slice(
+                row, new_row[:, None], (0, pos))
+            return jnp.where(m > 0, written, row)
+        return jax.vmap(upd)(cs, new_s[:, 0], start, write_mask)
+    onehot = (jax.nn.one_hot(positions, cs.shape[-1], dtype=cs.dtype)
+              * write_mask[:, None, None].astype(cs.dtype))   # [S, T, M]
+    keep = 1 - onehot.sum(1)                                  # [S, M]
+    return cs * keep[:, None, :] + jnp.einsum("btm,bth->bhm", onehot, new_s)
+
+
+def make_quantized_forward(base_forward=None, decode_impl: str = "auto"):
     """Wrap a cache forward with int8 K/V storage (init_kv_cache
     quant="int8" layout).  Same seam as make_paged_forward: this wrapper
-    contributes only a ``kv_update`` strategy — quantize new K/V on
-    write, hand dequantized views to the (unchanged) attention read.
-    Phase 1: the cache at REST is int8 (half the HBM); the per-step
-    dequantized view is still materialized in compute dtype — folding
-    dequant into the Pallas decode kernel is the follow-on."""
+    contributes a ``kv_update`` that quantizes on write, and an
+    ``attention`` that consumes the int8 cache natively on the decode
+    hot path (ops/decode_attention.decode_attention_quant streams HALF
+    the bf16 kernel's HBM bytes; scales fold into score columns and
+    probability rows).  Prefill (T > 1) reads a dequantized view — it
+    runs once per prompt."""
+    from kuberay_tpu.ops.decode_attention import (
+        decode_attention_quant,
+        dequant_lanes,
+    )
     base = base_forward or forward_with_cache
 
     def fwd(cfg, params, tokens, cache, start, write_mask=None,
@@ -143,18 +166,31 @@ def make_quantized_forward(base_forward=None):
             write_mask = jnp.ones((B,), jnp.float32)
 
         def kv_update(ck, cv, kk, vv):        # ck/cv: {"q","s"} per layer
-            kq, ks = quantize_kv(kk)
+            kq, ks = quantize_kv(kk)          # ks: [S, T, Hkv, 1]
             vq, vs = quantize_kv(vv)
             nkq, nvq = _insert_kv(ck["q"], cv["q"], kq, vq, positions,
                                   start, write_mask, T)
-            nks, nvs = _insert_kv(ck["s"], cv["s"], ks, vs, positions,
-                                  start, write_mask, T)
+            nks = _insert_scales(ck["s"], ks[..., 0], positions, start,
+                                 write_mask, T)
+            nvs = _insert_scales(cv["s"], vs[..., 0], positions, start,
+                                 write_mask, T)
             nk, nv = {"q": nkq, "s": nks}, {"q": nvq, "s": nvs}
-            return nk, nv, dequantize_kv(nkq, nks, cfg.dtype), \
-                dequantize_kv(nvq, nvs, cfg.dtype)
+            return nk, nv, nk, nv             # attention reads the structs
+
+        def attention(q, ckv, cvv, lens, q_positions):
+            if q.shape[1] == 1:
+                out = decode_attention_quant(
+                    q[:, 0], ckv["q"], ckv["s"], cvv["q"], cvv["s"],
+                    lens, impl=decode_impl)
+                return out[:, None]
+            return _cached_attention(
+                q, dequant_lanes(ckv["q"], ckv["s"], cfg.dtype),
+                dequant_lanes(cvv["q"], cvv["s"], cfg.dtype),
+                lens, q_positions)
 
         return base(cfg, params, tokens, cache, start, write_mask,
-                    token_mask=token_mask, kv_update=kv_update)
+                    token_mask=token_mask, kv_update=kv_update,
+                    attention=attention)
 
     return fwd
 

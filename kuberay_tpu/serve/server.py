@@ -189,7 +189,8 @@ def main(argv=None):  # pragma: no cover - process wrapper
                     help="KV pool size in blocks (0 = dense-equivalent)")
     ap.add_argument("--decode-impl", default="auto",
                     choices=["auto", "pallas", "xla", "pallas_interpret"],
-                    help="paged decode attention path (auto: pallas on TPU)")
+                    help="decode attention path for the paged and "
+                         "int8-quantized caches (auto: pallas on TPU)")
     ap.add_argument("--prefill-chunk", type=int, default=0,
                     help="chunked prefill size (0 = whole-prompt prefill)")
     ap.add_argument("--speculative", type=int, default=0,
@@ -218,7 +219,8 @@ def main(argv=None):  # pragma: no cover - process wrapper
                              max_len=args.max_len,
                              prefill_chunk=args.prefill_chunk,
                              speculative=args.speculative,
-                             kv_quant=args.kv_quant)
+                             kv_quant=args.kv_quant,
+                             decode_impl=args.decode_impl)
     frontend = ServeFrontend(engine)
     srv = frontend.make_server(args.host, args.port)
     if args.coordinator:

@@ -120,6 +120,52 @@ diff = float(jnp.max(jnp.abs(g(xt,wg,wu,wd,topi,topw).astype(jnp.float32)
 print(json.dumps({"diff": diff, "grouped_ms": bench(g), "dense_ms": bench(r)}))
 """
 
+QUANT_DECODE_SNIPPET = r"""
+import time, jax, jax.numpy as jnp, json
+from kuberay_tpu.ops.decode_attention import (
+    decode_attention, decode_attention_quant)
+from kuberay_tpu.serve.kv_cache import quantize_kv
+B,K,Hq,Hkv,D = 64, 2048, 8, 4, 128
+ks_ = jax.random.split(jax.random.PRNGKey(0), 3)
+q  = jax.random.normal(ks_[0],(B,Hq,D),jnp.bfloat16)
+ck = jax.random.normal(ks_[1],(B,K,Hkv,D),jnp.bfloat16)
+cv = jax.random.normal(ks_[2],(B,K,Hkv,D),jnp.bfloat16)
+kq, ksc = quantize_kv(ck); vq, vsc = quantize_kv(cv)
+ksc = jnp.moveaxis(ksc[...,0], -1, 1); vsc = jnp.moveaxis(vsc[...,0], -1, 1)
+lens = jnp.full((B,), K, jnp.int32)
+fq = jax.jit(lambda: decode_attention_quant(q,kq,ksc,vq,vsc,lens,impl='pallas'))
+fb = jax.jit(lambda: decode_attention(q,ck,cv,lens,impl='pallas'))
+def bench(f, n=30):
+    f().block_until_ready()
+    t0=time.perf_counter()
+    for _ in range(n): o=f()
+    float(jnp.max(jnp.abs(o)))
+    return (time.perf_counter()-t0)/n*1e3
+d = float(jnp.max(jnp.abs(fq().astype(jnp.float32)-fb().astype(jnp.float32))))
+print(json.dumps({"diff_vs_bf16": d, "int8_ms": bench(fq),
+                  "bf16_ms": bench(fb)}))
+"""
+
+XENT_SNIPPET = r"""
+import time, dataclasses, jax, jax.numpy as jnp, json
+from kuberay_tpu.models import llama
+base = llama.CONFIGS["llama_1b"]
+out = {}
+for label, cfg in (("dense", base),
+                   ("chunked", dataclasses.replace(base, xent_chunk=8192))):
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (4, 2048), 0,
+                              cfg.vocab_size)
+    tgt = jnp.roll(toks, -1, axis=1)
+    f = jax.jit(jax.grad(lambda p: llama.loss_fn(cfg, p, toks, tgt)[0]))
+    g = f(params); jax.block_until_ready(g)
+    t0 = time.perf_counter()
+    for _ in range(5): g = f(params)
+    float(jnp.max(jnp.abs(g["lm_head"])))
+    out[label + "_ms"] = (time.perf_counter() - t0) / 5 * 1e3
+print(json.dumps(out))
+"""
+
 BLOCK_SWEEP_SNIPPET = r"""
 import time, jax, jax.numpy as jnp, json
 from kuberay_tpu.ops.attention import flash_attention
@@ -170,6 +216,8 @@ def main() -> int:
         ("paged_kernel", [py, "-c", PAGED_SNIPPET], 500, None),
         ("flash_check", [py, "-c", FLASH_CHECK_SNIPPET], 400, None),
         ("moe_grouped", [py, "-c", MOE_SNIPPET], 400, None),
+        ("xent_chunked", [py, "-c", XENT_SNIPPET], 500, None),
+        ("quant_decode", [py, "-c", QUANT_DECODE_SNIPPET], 400, None),
     ]
     for bq, bkv in ((512, 512), (1024, 512), (512, 1024), (1024, 1024),
                     (256, 512), (1024, 256)):
