@@ -26,6 +26,7 @@ import time
 REPO = pathlib.Path(__file__).resolve().parent
 
 _PROBE = ("import jax, jax.numpy as jnp; "
+          "assert jax.devices()[0].platform != 'cpu', 'cpu fallback'; "
           "x = jnp.ones((128, 128), jnp.bfloat16); "
           "assert float((x @ x).sum()) > 0")
 
@@ -46,13 +47,10 @@ def tpu_probe(timeout: int = 90) -> bool:
 
 
 def wait_for_tpu(budget_secs: float) -> bool:
-    """Bounded wait for the TPU tunnel; re-probes until the budget runs out.
-
-    Fast path: if tools/tpu_watch.sh is running, its last status line in
-    /tmp/tpu_status.log tells us the tunnel state as of <2 min ago — a
-    recent "down" still gets live probes (the window may have just opened),
-    but a recent UP means the first probe should succeed immediately.
-    """
+    """Bounded wait for the TPU tunnel; re-probes until the budget runs
+    out.  Each probe is a fresh subprocess (a dead tunnel makes the first
+    in-process backend init failure sticky), so an opening tunnel window
+    is picked up by the next probe."""
     deadline = time.time() + budget_secs
     while True:
         if tpu_probe():

@@ -121,9 +121,13 @@ class ServeEngine:
                  max_slots: int = 8, max_len: int = 2048,
                  rng_seed: int = 0, prefill_chunk: int = 0,
                  speculative: int = 0, kv_quant: str = "none",
-                 decode_impl: str = "auto"):
+                 decode_impl: str = "auto", mesh=None):
         self.cfg = cfg
         self.params = params
+        # Tensor-parallel serving: a jax.sharding.Mesh with a "tp" axis.
+        # Params/cache shard over it (serve/sharding.py) and every jitted
+        # step runs SPMD; the host scheduling loop is unchanged.
+        self.mesh = mesh
         self.max_slots = max_slots
         self.max_len = max_len
         # Chunked prefill (vLLM-style): >0 caps how many prompt tokens one
@@ -147,7 +151,9 @@ class ServeEngine:
         self._spec_cooldown = np.zeros(max_slots, dtype=np.int32)
         self._spec_index: List[Optional[NgramIndex]] = [None] * max_slots
         self.kv_quant = kv_quant
-        self.cache = self._init_cache()
+        # With a mesh the cache materializes sharded below (a flagship
+        # cache does not fit one chip); without one, build it here.
+        self.cache = self._init_cache() if mesh is None else None
         # Model dispatch: Llama-family vs Mixtral MoE share the cache
         # plumbing but differ in the FFN.
         from kuberay_tpu.models.mixtral import MixtralConfig
@@ -160,7 +166,30 @@ class ServeEngine:
             # decode_impl is the operational escape hatch: "xla" routes
             # the int8 decode read around the Pallas kernel.
             self._forward = make_quantized_forward(self._forward,
-                                                   decode_impl=decode_impl)
+                                                   decode_impl=decode_impl,
+                                                   mesh=mesh)
+        elif mesh is not None:
+            # Pallas kernels are invisible to the SPMD partitioner; route
+            # attention through the shard_map wrapper so each chip runs
+            # the stock kernel on its local head shard.
+            from kuberay_tpu.serve.sharding import make_tp_attention
+            base_fwd = self._forward
+            tp_attn = make_tp_attention(mesh)
+
+            def fwd(cfg_, params_, tokens_, cache_, start_, write_mask=None,
+                    token_mask=None):
+                return base_fwd(cfg_, params_, tokens_, cache_, start_,
+                                write_mask, token_mask=token_mask,
+                                attention=tp_attn)
+            self._forward = fwd
+        if mesh is not None:
+            from kuberay_tpu.serve.sharding import (
+                shard_engine_state, validate_tp)
+            validate_tp(cfg, mesh)
+            # Pass the cache INITIALIZER, not a materialized cache — a
+            # flagship-sized cache must come into existence sharded.
+            self.params, self.cache, self._cache_sh = shard_engine_state(
+                cfg, self.params, self._init_cache, mesh, kv_quant)
         self.key = jax.random.PRNGKey(rng_seed)
 
         # Slot bookkeeping (host side).
@@ -171,11 +200,23 @@ class ServeEngine:
         self.queue: List[Request] = []
         self._finished: List[Response] = []
 
+        # With a mesh, pin output shardings so the cache round-trips
+        # sharded (no surprise all-gathers) and sampled tokens come back
+        # replicated for the host loop.
+        pf_kw, dc_kw, vf_kw = {}, {}, {}
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            rep = NamedSharding(mesh, P())
+            cs = self._cache_sh
+            pf_kw = dc_kw = {"out_shardings": (rep, cs)}
+            vf_kw = {"out_shardings": (rep, rep, cs)}
         self._prefill = jax.jit(self._prefill_impl,
                                 static_argnames=("prompt_len",),
-                                donate_argnames=("cache",))
-        self._decode = jax.jit(self._decode_impl, donate_argnames=("cache",))
-        self._verify = jax.jit(self._verify_impl, donate_argnames=("cache",))
+                                donate_argnames=("cache",), **pf_kw)
+        self._decode = jax.jit(self._decode_impl,
+                               donate_argnames=("cache",), **dc_kw)
+        self._verify = jax.jit(self._verify_impl,
+                               donate_argnames=("cache",), **vf_kw)
 
     def _init_cache(self):
         return init_kv_cache(self.cfg, self.max_slots, self.max_len,
@@ -335,11 +376,20 @@ class ServeEngine:
             self._inflight = (req, slot, off)
 
     def _prefill_chunk_call(self, req, slot, off, padded, real_len, sub):
+        return self._prefill_device(padded, slot, real_len, sub,
+                                    req.temperature, self.prefill_chunk,
+                                    start_pos=off)
+
+    def _prefill_device(self, padded, slot, real_len, sub, temperature,
+                        bucket, start_pos=0):
+        """The prefill device call — single funnel so the multi-host
+        engine can broadcast the step plan before launching (every
+        process must execute the same SPMD program in lockstep)."""
         tok, self.cache = self._prefill(
             self.params, self.cache, jnp.asarray(padded),
             jnp.int32(slot), jnp.int32(real_len), sub,
-            jnp.float32(req.temperature), prompt_len=self.prefill_chunk,
-            start_pos=jnp.int32(off))
+            jnp.float32(temperature), prompt_len=bucket,
+            start_pos=jnp.int32(start_pos))
         return tok
 
     def _chunk_finalize(self, req, slot, tok) -> None:
@@ -363,10 +413,8 @@ class ServeEngine:
         padded = np.zeros(bucket, dtype=np.int32)
         padded[:plen] = req.prompt_tokens
         self.key, sub = jax.random.split(self.key)
-        tok, self.cache = self._prefill(
-            self.params, self.cache, jnp.asarray(padded),
-            jnp.int32(slot), jnp.int32(plen), sub,
-            jnp.float32(req.temperature), prompt_len=bucket)
+        tok = self._prefill_device(padded, slot, plen, sub,
+                                   req.temperature, bucket)
         # Cache now contains bucket tokens for the slot; only plen are real.
         self._finalize_admit(req, slot, tok)
         return True
@@ -438,10 +486,7 @@ class ServeEngine:
         for i, d in enumerate(drafts):
             toks[i, 1:1 + len(d)] = d
         self.key, sub = jax.random.split(self.key)
-        greedy, sampled0, self.cache = self._verify(
-            self.params, self.cache, jnp.asarray(toks),
-            jnp.asarray(self.lens), sub, jnp.asarray(temps),
-            jnp.asarray(mask))
+        greedy, sampled0 = self._verify_device(toks, sub, temps, mask)
         greedy = np.asarray(greedy)
         sampled0 = np.asarray(sampled0)
         self.spec_stats["verify_steps"] += 1
@@ -478,6 +523,14 @@ class ServeEngine:
             self.lens[i] += len(take)
             self.generated[i].extend(take)
             self._maybe_finish(i)
+
+    def _verify_device(self, toks, sub, temps, mask):
+        """The speculative-verify device call (multi-host funnel)."""
+        greedy, sampled0, self.cache = self._verify(
+            self.params, self.cache, jnp.asarray(toks),
+            jnp.asarray(self.lens), sub, jnp.asarray(temps),
+            jnp.asarray(mask))
+        return greedy, sampled0
 
     def _decode_call(self, last, temps, mask, sub):
         """The device decode step; paged subclass passes block tables."""

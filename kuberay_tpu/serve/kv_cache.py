@@ -143,7 +143,8 @@ def _insert_scales(cs, new_s, positions, start, write_mask, T):
     return cs * keep[:, None, :] + jnp.einsum("btm,bth->bhm", onehot, new_s)
 
 
-def make_quantized_forward(base_forward=None, decode_impl: str = "auto"):
+def make_quantized_forward(base_forward=None, decode_impl: str = "auto",
+                           mesh=None):
     """Wrap a cache forward with int8 K/V storage (init_kv_cache
     quant="int8" layout).  Same seam as make_paged_forward: this wrapper
     contributes a ``kv_update`` that quantizes on write, and an
@@ -187,6 +188,12 @@ def make_quantized_forward(base_forward=None, decode_impl: str = "auto"):
                 q, dequant_lanes(ckv["q"], ckv["s"], cfg.dtype),
                 dequant_lanes(cvv["q"], cvv["s"], cfg.dtype),
                 lens, q_positions)
+
+        if mesh is not None:
+            # Tensor-parallel: each chip runs the int8 kernel on its
+            # local kv-head shard (serve/sharding.py cache layout).
+            from kuberay_tpu.serve.sharding import make_tp_attention_quant
+            attention = make_tp_attention_quant(mesh, attention)
 
         return base(cfg, params, tokens, cache, start, write_mask,
                     token_mask=token_mask, kv_update=kv_update,

@@ -1,0 +1,163 @@
+"""Multi-host tensor-parallel serving: lockstep SPMD across a slice.
+
+A TpuService slice has one serving process per host, all joined into one
+``jax.distributed`` group (the operator injects TPU_WORKER_ID /
+TPU_WORKER_HOSTNAMES — builders/pod.py; same contract the training
+launcher consumes).  Every jitted engine step is a global SPMD program
+over the slice-wide mesh, so **all processes must launch the same
+programs with the same operands in the same order**.
+
+Protocol (the JetStream/MaxText-style driver, first-party here):
+
+- host 0 runs the HTTP frontend + the real scheduling loop
+  (``MultihostServeEngine``); before every device call it broadcasts a
+  fixed-shape *step plan* (op code + operands) via
+  ``multihost_utils.broadcast_one_to_all``;
+- every other host runs ``follower_loop``: receive plan → dispatch the
+  identical jitted call.  Followers hold their own params/cache shards
+  and no request state — scheduling lives only on host 0.
+
+The plan is a pytree of fixed-shape arrays (broadcast requires identical
+shapes on every process), sized by the engine's max_len/max_slots/γ at
+construction.  The RNG subkey rides in the plan, so sampling slots stay
+bit-identical across hosts without replaying host 0's key-split sequence.
+
+Degenerate case: with one process the broadcast is the identity, so the
+same code path serves single-host multi-chip TP unchanged.
+
+Reference parity: vLLM's multi-host TPU serving runs as a Ray placement
+group wired by the reference's RayService
+(``config/samples/vllm/ray-service.vllm-tpu-v6e-singlehost.yaml``); here
+the protocol is native to the framework.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kuberay_tpu.serve.engine import ServeEngine
+
+OP_STOP, OP_PREFILL, OP_DECODE, OP_VERIFY = 0, 1, 2, 3
+
+
+def _zero_plan(max_len: int, max_slots: int, gamma: int) -> Dict[str, Any]:
+    return {
+        "op": np.int32(0),
+        # slot, real_len, bucket, start_pos
+        "scalars": np.zeros(4, np.int32),
+        "temp": np.float32(0.0),
+        "tokens": np.zeros(max_len, np.int32),
+        "last": np.zeros(max_slots, np.int32),
+        "lens": np.zeros(max_slots, np.int32),
+        "temps": np.zeros(max_slots, np.float32),
+        "mask": np.zeros(max_slots, np.float32),
+        "vtoks": np.zeros((max_slots, gamma + 1), np.int32),
+        "key": np.zeros(2, np.uint32),
+    }
+
+
+def _broadcast(plan, is_source: bool):
+    from jax.experimental import multihost_utils
+    return multihost_utils.broadcast_one_to_all(plan, is_source=is_source)
+
+
+class MultihostServeEngine(ServeEngine):
+    """Host-0 engine: broadcasts a step plan before every device call.
+
+    Construct with the slice-wide mesh (``serve/sharding.serve_mesh`` over
+    all global devices).  Call :meth:`stop` when shutting down so
+    followers exit their loop.
+    """
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._plan0 = _zero_plan(self.max_len, self.max_slots,
+                                 self.speculative)
+
+    def _send(self, **updates) -> None:
+        plan = dict(self._plan0)
+        plan.update(updates)
+        _broadcast(plan, is_source=True)
+
+    def stop(self) -> None:
+        if jax.process_count() > 1:
+            self._send(op=np.int32(OP_STOP))
+
+    def _prefill_device(self, padded, slot, real_len, sub, temperature,
+                        bucket, start_pos=0):
+        if jax.process_count() > 1:
+            tokens = np.zeros(self.max_len, np.int32)
+            tokens[:len(padded)] = padded
+            self._send(
+                op=np.int32(OP_PREFILL),
+                scalars=np.array([slot, real_len, bucket, start_pos],
+                                 np.int32),
+                temp=np.float32(temperature),
+                tokens=tokens,
+                key=np.asarray(sub, np.uint32))
+        return super()._prefill_device(padded, slot, real_len, sub,
+                                       temperature, bucket, start_pos)
+
+    def _decode_call(self, last, temps, mask, sub):
+        if jax.process_count() > 1:
+            self._send(
+                op=np.int32(OP_DECODE),
+                last=np.asarray(last, np.int32),
+                lens=np.asarray(self.lens, np.int32),
+                temps=np.asarray(temps, np.float32),
+                mask=np.asarray(mask, np.float32),
+                key=np.asarray(sub, np.uint32))
+        return super()._decode_call(last, temps, mask, sub)
+
+    def _verify_device(self, toks, sub, temps, mask):
+        if jax.process_count() > 1:
+            self._send(
+                op=np.int32(OP_VERIFY),
+                vtoks=np.asarray(toks, np.int32),
+                lens=np.asarray(self.lens, np.int32),
+                temps=np.asarray(temps, np.float32),
+                mask=np.asarray(mask, np.float32),
+                key=np.asarray(sub, np.uint32))
+        return super()._verify_device(toks, sub, temps, mask)
+
+
+def follower_loop(engine: ServeEngine) -> int:
+    """Run on every non-zero process: replay host 0's device calls.
+
+    ``engine`` must be constructed with the SAME ctor arguments as host
+    0's ``MultihostServeEngine`` (same params init / checkpoint, same
+    mesh) so the compiled programs and shardings match.  Returns the
+    number of device calls replayed.
+    """
+    plan0 = _zero_plan(engine.max_len, engine.max_slots, engine.speculative)
+    steps = 0
+    while True:
+        plan = _broadcast(plan0, is_source=False)
+        op = int(plan["op"])
+        if op == OP_STOP:
+            return steps
+        steps += 1
+        # Engines use legacy uint32[2] PRNG keys — the raw array IS the key.
+        key = jnp.asarray(plan["key"], jnp.uint32)
+        if op == OP_PREFILL:
+            slot, real_len, bucket, start_pos = (int(x)
+                                                 for x in plan["scalars"])
+            padded = np.asarray(plan["tokens"][:bucket])
+            engine._prefill_device(padded, slot, real_len, key,
+                                   float(plan["temp"]), bucket, start_pos)
+        elif op == OP_DECODE:
+            engine.lens[:] = np.asarray(plan["lens"])
+            engine._decode_call(np.asarray(plan["last"]),
+                                np.asarray(plan["temps"]),
+                                np.asarray(plan["mask"]), key)
+        elif op == OP_VERIFY:
+            engine.lens[:] = np.asarray(plan["lens"])
+            engine._verify_device(np.asarray(plan["vtoks"]), key,
+                                  np.asarray(plan["temps"]),
+                                  np.asarray(plan["mask"]))
+        else:  # pragma: no cover - protocol error
+            raise RuntimeError(f"unknown serve op {op}")

@@ -93,9 +93,13 @@ class ServeFrontend:
                     # Paged engines expose pool/prefix-cache counters.
                     **getattr(self.engine, "stats", {})}
 
-    def close(self):
+    def close(self, timeout: Optional[float] = 2.0):
+        """Stop the engine loop.  ``timeout=None`` blocks until the
+        thread is actually dead — required before a multi-host engine
+        may broadcast STOP (a live loop thread could still be issuing
+        collectives, and two threads' broadcasts can mispair)."""
         self._stop.set()
-        self._thread.join(timeout=2.0)
+        self._thread.join(timeout=timeout)
 
     # -- HTTP --------------------------------------------------------------
 
@@ -198,6 +202,13 @@ def main(argv=None):  # pragma: no cover - process wrapper
                          "(dense engine, greedy slots; 0 = off)")
     ap.add_argument("--kv-quant", default="none", choices=["none", "int8"],
                     help="KV cache storage dtype (dense engine)")
+    ap.add_argument("--tp", type=int, default=1,
+                    help="tensor parallelism over the slice's chips "
+                         "(0 = all global devices; dense engine). "
+                         "Multi-host: every host of the TpuService slice "
+                         "runs this same command; the operator's env "
+                         "contract joins them into one jax.distributed "
+                         "group and hosts >0 become lockstep followers")
     args = ap.parse_args(argv)
     if args.paged and args.speculative:
         ap.error("--speculative is not supported with --paged yet "
@@ -205,28 +216,93 @@ def main(argv=None):  # pragma: no cover - process wrapper
     if args.paged and args.kv_quant != "none":
         ap.error("--kv-quant is not supported with --paged yet "
                  "(dense engine only)")
+    if args.paged and args.tp != 1:
+        ap.error("--tp is not supported with --paged yet "
+                 "(dense engine only)")
+
+    # Slice identity: same env contract as the training launcher
+    # (TPU_WORKER_ID / TPU_WORKER_HOSTNAMES injected by builders/pod.py).
+    from kuberay_tpu.train.launcher import (
+        WorkerIdentity, initialize_distributed)
+    ident = WorkerIdentity.from_env()
+    if ident.is_distributed:
+        initialize_distributed(ident)
+    tp = args.tp if args.tp > 0 else len(jax.devices())
+    if ident.is_distributed and args.tp == 1:
+        tp = len(jax.devices())        # multi-host implies slice-wide TP
+    if jax.process_count() > 1 and tp != len(jax.devices()):
+        # A sub-slice mesh would exclude some hosts' chips: those hosts
+        # crash before reaching follower_loop and the rest hang in their
+        # first collective.  Slice-wide TP is the only multi-host layout.
+        ap.error(f"multi-host serving requires tp == total chips "
+                 f"({len(jax.devices())}); got --tp {args.tp}. "
+                 f"Use --tp 0 (auto)")
+    if args.paged and (tp > 1 or jax.process_count() > 1):
+        # Refusing beats the alternative: a follower waiting on broadcasts
+        # a paged host 0 never sends is a silent cross-host hang.
+        ap.error("--paged does not support multi-chip/multi-host serving "
+                 "yet (dense engine only)")
 
     cfg = llama.CONFIGS[args.model]
-    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    mesh = None
+    if tp > 1:
+        from kuberay_tpu.serve.sharding import (
+            init_sharded_params, serve_mesh)
+        mesh = serve_mesh(tp, n_kv_heads=cfg.n_kv_heads)
+        # Init directly into shards — the flagship model does not fit
+        # one chip (checkpoint restore takes the same sharding tree).
+        params = init_sharded_params(cfg, jax.random.PRNGKey(0), mesh)
+    else:
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    engine_kw = dict(max_slots=args.max_slots, max_len=args.max_len,
+                     prefill_chunk=args.prefill_chunk,
+                     speculative=args.speculative, kv_quant=args.kv_quant,
+                     decode_impl=args.decode_impl, mesh=mesh)
+    if jax.process_count() > 1 and jax.process_index() > 0:
+        # Follower host: no frontend, no scheduling — replay host 0's
+        # device calls until it broadcasts STOP.
+        from kuberay_tpu.serve.multihost import follower_loop
+        engine = ServeEngine(cfg, params, **engine_kw)
+        print(f"serve follower {jax.process_index()}/"
+              f"{jax.process_count()} ready", flush=True)
+        follower_loop(engine)
+        return
+
     if args.paged:
         from kuberay_tpu.serve.paged_engine import PagedServeEngine
         engine = PagedServeEngine(
             cfg, params, max_slots=args.max_slots, max_len=args.max_len,
             num_blocks=args.num_blocks, block_size=args.block_size,
             decode_impl=args.decode_impl, prefill_chunk=args.prefill_chunk)
+    elif jax.process_count() > 1:
+        from kuberay_tpu.serve.multihost import MultihostServeEngine
+        engine = MultihostServeEngine(cfg, params, **engine_kw)
     else:
-        engine = ServeEngine(cfg, params, max_slots=args.max_slots,
-                             max_len=args.max_len,
-                             prefill_chunk=args.prefill_chunk,
-                             speculative=args.speculative,
-                             kv_quant=args.kv_quant,
-                             decode_impl=args.decode_impl)
+        engine = ServeEngine(cfg, params, **engine_kw)
     frontend = ServeFrontend(engine)
     srv = frontend.make_server(args.host, args.port)
+    if args.coordinator == "auto":
+        # Resolve from the operator-injected env (builders/pod.py):
+        # TPU_COORDINATOR_ADDRESS is host:port of the head coordinator;
+        # its HTTP API listens on the dashboard port.
+        import os as _os
+        addr = _os.environ.get(C.ENV_COORDINATOR_ADDRESS, "")
+        args.coordinator = (f"http://{addr.split(':')[0]}:"
+                            f"{C.PORT_DASHBOARD}" if addr else "")
     if args.coordinator:
         register_with_coordinator(args.app_name, args.coordinator)
-    print(f"serving {args.model} on {args.host}:{args.port}", flush=True)
-    srv.serve_forever()
+    print(f"serving {args.model} on {args.host}:{args.port} "
+          f"(tp={tp}, hosts={jax.process_count()})", flush=True)
+    try:
+        srv.serve_forever()
+    finally:
+        # Quiesce the engine-loop thread BEFORE broadcasting STOP — two
+        # threads issuing collectives concurrently can pair a follower's
+        # receive with the wrong send.  Wait for real thread death, not a
+        # bounded join: an in-flight step must finish its broadcasts.
+        frontend.close(timeout=None)
+        if hasattr(engine, "stop"):
+            engine.stop()
 
 
 if __name__ == "__main__":  # pragma: no cover

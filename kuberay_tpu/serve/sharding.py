@@ -1,0 +1,226 @@
+"""Tensor-parallel serving: mesh + shardings for the inference engines.
+
+The serving counterpart of ``parallel/mesh.py``'s training rules — the
+payload half of BASELINE config #4 (a TpuService on a v5e-16 slice serving
+Llama-3-8B, the role vLLM-on-TPU plays for the reference:
+reference ``config/samples/vllm/ray-service.vllm-tpu-v6e-singlehost.yaml``).
+
+Design: a 2-axis serving mesh ``("tp", "tpr")`` over the slice's chips.
+
+- ``tp`` — the kv-head axis: q heads, kv heads, mlp width, and vocab all
+  split here; the KV cache shards its kv-head axis on it.
+- ``tpr`` — kv replication: when the requested parallelism exceeds
+  ``n_kv_heads`` (llama3_8b has 8 kv heads but a v5e-16 slice has 16
+  chips), the extra factor goes here.  Q heads/mlp/vocab split over
+  ``(tp, tpr)`` jointly; the KV cache is *replicated* across ``tpr`` —
+  exactly GQA's memory/compute trade (kv reads are the decode bottleneck
+  and stay fully parallel; the cache costs tpr× memory vs the ideal).
+  With tp ≤ n_kv_heads, tpr is 1 and this is plain head-sharded TP.
+
+Param placement (``models/*.param_axes`` → ``SERVE_RULES``): one chip
+holds ~1/(tp·tpr) of the weights — this is what lets 8B+ models serve on
+chips they cannot fit on alone.  XLA inserts one psum per layer (after
+``wo``/``w_down``) plus the logits gather, all riding ICI.
+
+Pallas kernels (decode attention, int8 decode) are invisible to the SPMD
+partitioner, so attention is wrapped in ``shard_map``: each chip runs the
+unmodified kernel on its local head shard, no collectives inside.
+
+GQA grouping survives the split: q heads shard over (tp, tpr) in
+contiguous blocks, so the shard at mesh coordinate (i, j) holds q heads
+whose kv head is exactly i — the kv shard the cache sharding puts there.
+
+The host-side engine loop is unchanged: scheduling is data-independent of
+sharding.  Multi-host lockstep execution lives in ``serve/multihost.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kuberay_tpu.parallel.mesh import logical_to_sharding
+
+shard_map = jax.shard_map
+
+# Serving logical->mesh rules.  Differs from training DEFAULT_RULES:
+# no fsdp/sp/ep axes exist here — embed/batch/seq/expert replicate; the
+# head/width axes split over the joint (tp, tpr) parallelism except kv
+# heads, which split over tp only (replicated across tpr).
+SERVE_RULES: Dict[str, object] = {
+    "batch": None,
+    "seq": None,
+    "embed": None,
+    "heads": ("tp", "tpr"),
+    "kv_heads": "tp",
+    "mlp": ("tp", "tpr"),
+    "vocab": ("tp", "tpr"),
+    "layers": None,
+    "expert": None,
+    "head_dim": None,
+    "norm": None,
+}
+
+
+def tp_factors(tp: int, n_kv_heads: Optional[int] = None) -> tuple:
+    """Split total parallelism into (kv-shard factor, kv-replica factor)."""
+    if n_kv_heads is None or tp <= n_kv_heads:
+        return tp, 1
+    if tp % n_kv_heads:
+        raise ValueError(
+            f"tp={tp} exceeds n_kv_heads={n_kv_heads} but is not a "
+            f"multiple of it")
+    return n_kv_heads, tp // n_kv_heads
+
+
+def serve_mesh(tp: int, devices: Optional[Sequence[jax.Device]] = None,
+               n_kv_heads: Optional[int] = None) -> Mesh:
+    """A serving mesh over ``tp`` chips: axes ("tp", "tpr").
+
+    Pass the model's ``n_kv_heads`` so tp > n_kv_heads lands the excess
+    on the kv-replication axis; without it, tp must divide the model's
+    kv heads (validate_tp enforces this at engine construction).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) < tp:
+        raise ValueError(f"tp={tp} needs {tp} devices, have {len(devices)}")
+    kv, rep = tp_factors(tp, n_kv_heads)
+    arr = np.array(devices[:tp]).reshape(kv, rep)
+    return Mesh(arr, ("tp", "tpr"))
+
+
+def mesh_tp(mesh: Mesh) -> int:
+    """Total tensor parallelism of a serving mesh."""
+    return mesh.shape.get("tp", 1) * mesh.shape.get("tpr", 1)
+
+
+def validate_tp(cfg, mesh: Mesh) -> None:
+    """Serving TP needs even splits (NamedSharding requires divisibility,
+    and GQA groups must not straddle shards)."""
+    tp = mesh_tp(mesh)
+    kv = mesh.shape.get("tp", 1)
+    problems = []
+    if cfg.n_kv_heads % kv:
+        problems.append(f"n_kv_heads={cfg.n_kv_heads} by kv axis {kv}")
+    if cfg.n_heads % tp:
+        problems.append(f"n_heads={cfg.n_heads}")
+    if cfg.d_ff % tp:
+        problems.append(f"d_ff={cfg.d_ff}")
+    if cfg.vocab_size % tp:
+        problems.append(f"vocab_size={cfg.vocab_size}")
+    if problems:
+        raise ValueError(
+            f"tp={tp} does not divide {', '.join(problems)}; choose a tp "
+            f"that divides heads/d_ff/vocab (build the mesh with "
+            f"serve_mesh(tp, n_kv_heads=...) so kv replication absorbs "
+            f"tp > n_kv_heads)")
+
+
+def param_shardings(cfg, mesh: Mesh):
+    """NamedSharding tree matching the model's params tree."""
+    from kuberay_tpu.models import llama
+    try:
+        from kuberay_tpu.models import mixtral
+        is_moe = isinstance(cfg, mixtral.MixtralConfig)
+    except ImportError:  # pragma: no cover
+        is_moe = False
+    axes = mixtral.param_axes(cfg) if is_moe else llama.param_axes(cfg)
+    return jax.tree.map(
+        lambda a: logical_to_sharding(SERVE_RULES, mesh, a), axes,
+        is_leaf=lambda x: isinstance(x, tuple))
+
+
+def cache_shardings(cfg, mesh: Mesh, quant: str = "none"):
+    """Shardings for the ``init_kv_cache`` layout: kv heads on ``tp``
+    (replicated across ``tpr``).
+
+    bf16: k/v are [L, slots, max_len, Hkv, D].  int8 adds per-(slot,
+    position, head) scales in the lane-major [L, slots, Hkv, max_len]
+    layout (kv_cache.init_kv_cache).
+    """
+    kv = NamedSharding(mesh, P(None, None, None, "tp", None))
+    if quant == "int8":
+        leaf = {"q": kv, "s": NamedSharding(mesh, P(None, None, "tp", None))}
+        return {"k": leaf, "v": leaf}
+    return {"k": kv, "v": kv}
+
+
+_Q_HEADS = P(None, None, ("tp", "tpr"), None)
+_KV_HEADS = P(None, None, "tp", None)
+
+
+def make_tp_attention(mesh: Mesh):
+    """shard_map the dense cache-attention over the serving mesh.
+
+    Per-layer shapes (inside the model's layer scan): q [B, T, Hq, D];
+    ck/cv [B, max_len, Hkv, D]; lens [B]; positions [B, T].  Heads are
+    independent, so each shard runs the stock attention (including the
+    Pallas decode kernel on TPU) on its local q heads against its local
+    (or tpr-replicated) kv heads — no collective inside.
+    """
+    from kuberay_tpu.serve.kv_cache import _cached_attention
+
+    fn = shard_map(
+        _cached_attention, mesh=mesh,
+        in_specs=(_Q_HEADS, _KV_HEADS, _KV_HEADS, P(None), P(None, None)),
+        out_specs=_Q_HEADS, check_vma=False)
+
+    def attention(q, ck, cv, lens, q_positions):
+        return fn(q, ck, cv, lens, q_positions)
+
+    return attention
+
+
+def make_tp_attention_quant(mesh: Mesh, attention_fn):
+    """shard_map an int8-cache attention closure (make_quantized_forward's
+    inner ``attention``) over the serving mesh.  Cache leaves are
+    {"q": [B, M, Hkv, D] int8, "s": [B, Hkv, M] f32}."""
+    kv_struct = {"q": _KV_HEADS, "s": P(None, "tp", None)}
+    fn = shard_map(
+        attention_fn, mesh=mesh,
+        in_specs=(_Q_HEADS, kv_struct, kv_struct, P(None), P(None, None)),
+        out_specs=_Q_HEADS, check_vma=False)
+
+    def attention(q, ckv, cvv, lens, q_positions):
+        return fn(q, ckv, cvv, lens, q_positions)
+
+    return attention
+
+
+def shard_engine_state(cfg, params: Dict[str, Any], cache,
+                       mesh: Mesh, quant: str = "none"):
+    """Place params + cache onto the mesh; returns (params, cache,
+    cache_shardings).  ``cache`` may be a zero-arg callable — it is then
+    jitted with sharded outputs so the cache MATERIALIZES sharded (a
+    dense llama3_8b cache would not fit one chip; see init_sharded_params
+    for the same issue on the weights)."""
+    p_sh = param_shardings(cfg, mesh)
+    c_sh = cache_shardings(cfg, mesh, quant)
+    if callable(cache):
+        cache = jax.jit(cache, out_shardings=c_sh)()
+    else:
+        cache = jax.device_put(cache, c_sh)
+    return jax.device_put(params, p_sh), cache, c_sh
+
+
+def init_sharded_params(cfg, key, mesh: Mesh):
+    """Random-init params directly into their serving shards.
+
+    ``init_params`` + ``device_put`` would materialize the full model on
+    one chip first — an 8B bf16 model is ~16 GB and does not fit.  jit
+    with ``out_shardings`` makes XLA generate each shard in place.  Real
+    deployments restore a checkpoint instead (train/checkpoint.py's Orbax
+    sharded restore takes the same sharding tree).
+    """
+    from kuberay_tpu.models import llama
+    try:
+        from kuberay_tpu.models import mixtral
+        mod = mixtral if isinstance(cfg, mixtral.MixtralConfig) else llama
+    except ImportError:  # pragma: no cover
+        mod = llama
+    p_sh = param_shardings(cfg, mesh)
+    init = jax.jit(lambda k: mod.init_params(cfg, k), out_shardings=p_sh)
+    return init(key)

@@ -1,0 +1,49 @@
+"""Multi-host TP serving worker (spawned by test_tp_serving_multihost).
+
+Process 0 schedules (MultihostServeEngine + step-plan broadcast); process
+1+ replay via follower_loop.  Mirrors what every host of a TpuService
+slice runs through ``python -m kuberay_tpu.serve.server --tp 0``.
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> None:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+    from kuberay_tpu.train.launcher import initialize_distributed
+    initialize_distributed()
+    from kuberay_tpu.models import llama
+    from kuberay_tpu.serve.engine import Request, ServeEngine
+    from kuberay_tpu.serve.multihost import (
+        MultihostServeEngine,
+        follower_loop,
+    )
+    from kuberay_tpu.serve.sharding import serve_mesh
+
+    import dataclasses
+    # tp=4 needs 4 kv heads; widen the tiny config (matches the test's
+    # single-process reference).
+    cfg = dataclasses.replace(llama.CONFIGS["llama_tiny"],
+                              n_heads=8, n_kv_heads=4)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    mesh = serve_mesh(len(jax.devices()))
+    kw = dict(max_slots=2, max_len=64, mesh=mesh)
+    if jax.process_index() == 0:
+        eng = MultihostServeEngine(cfg, params, **kw)
+        for i, p in enumerate([[1, 2, 3, 4, 5], [9, 8, 7]]):
+            eng.add_request(Request(f"r{i}", p, max_new_tokens=8))
+        out = {r.request_id: r.tokens for r in eng.run()}
+        eng.stop()
+        print("RESULT " + json.dumps(out), flush=True)
+    else:
+        n = follower_loop(ServeEngine(cfg, params, **kw))
+        print(f"FOLLOWER replayed {n} calls", flush=True)
+
+
+if __name__ == "__main__":
+    main()
