@@ -1,0 +1,8 @@
+"""Head-pod autoscaler sidecar package.
+
+The decision logic lives in ``kuberay_tpu.controlplane.autoscaler``
+(shared with the operator's in-process mode); this package is the
+``python -m kuberay_tpu.autoscaler.sidecar`` process the pod builder
+injects (builders/pod.py build_autoscaler_container — the analogue of
+reference BuildAutoscalerContainer, common/pod.go:736).
+"""

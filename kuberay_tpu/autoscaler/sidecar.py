@@ -1,0 +1,84 @@
+"""Autoscaler sidecar: the process the head pod's autoscaler container
+runs (builders/pod.py build_autoscaler_container injects exactly this
+command).
+
+Reference parity: the Ray autoscaler sidecar the reference builds into
+the head pod (``common/pod.go:736`` BuildAutoscalerContainer) patches
+``WorkerGroupSpec.Replicas`` / ``ScaleStrategy.WorkersToDelete`` through
+the K8s API.  Here the loop is ``controlplane/autoscaler.SliceAutoscaler``
+(slice-granular decisions from queued-TpuJob demand) driven over the REST
+store, so the same binary works against the framework's apiserver in
+tests and a real kube-apiserver in-cluster (service-account token + CA
+picked up from the pod filesystem).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+def _default_apiserver(env=os.environ) -> str:
+    url = env.get("TPU_APISERVER_URL", "")
+    if url:
+        return url
+    host = env.get("KUBERNETES_SERVICE_HOST", "")
+    if host:
+        return f"https://{host}:{env.get('KUBERNETES_SERVICE_PORT', '443')}"
+    return "http://127.0.0.1:8765"
+
+
+def _sa_token() -> str:
+    try:
+        with open(os.path.join(SA_DIR, "token")) as f:
+            return f.read().strip()
+    except OSError:
+        return ""
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="tpu-autoscaler")
+    ap.add_argument("--cluster", required=True)
+    ap.add_argument("--namespace", default="default")
+    ap.add_argument("--apiserver", default="",
+                    help="API server base URL (default: TPU_APISERVER_URL "
+                         "env, then the in-cluster kubernetes service)")
+    ap.add_argument("--token", default="",
+                    help="Bearer token (default: TPU_APISERVER_TOKEN env, "
+                         "then the mounted service-account token)")
+    ap.add_argument("--interval", type=float, default=5.0)
+    ap.add_argument("--once", action="store_true",
+                    help="single reconcile pass (tests / cron)")
+    args = ap.parse_args(argv)
+
+    from kuberay_tpu.controlplane.autoscaler import SliceAutoscaler
+    from kuberay_tpu.controlplane.rest_store import RestObjectStore
+
+    url = args.apiserver or _default_apiserver()
+    token = (args.token or os.environ.get("TPU_APISERVER_TOKEN", "")
+             or _sa_token())
+    store = RestObjectStore(url, token=token or None)
+    idle_timeout = float(os.environ.get("TPU_AUTOSCALER_IDLE_TIMEOUT", "60"))
+    scaler = SliceAutoscaler(store, idle_timeout=idle_timeout)
+    print(f"autoscaler sidecar: cluster={args.cluster} ns={args.namespace} "
+          f"apiserver={url} idle_timeout={idle_timeout}s", flush=True)
+
+    while True:
+        try:
+            changed = scaler.reconcile(args.cluster, args.namespace)
+            if changed:
+                print(f"autoscaler: patched {args.cluster}", flush=True)
+        except Exception as e:  # keep the sidecar alive through API blips
+            print(f"autoscaler: reconcile error: {e}", file=sys.stderr,
+                  flush=True)
+        if args.once:
+            return 0
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
