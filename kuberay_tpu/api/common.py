@@ -118,6 +118,9 @@ class Container(Serializable):
     ports: List[ContainerPort] = dataclasses.field(default_factory=list)
     resources: ResourceRequirements = dataclasses.field(default_factory=ResourceRequirements)
     workingDir: str = ""
+    # Container-level restart policy (K8s 1.28+ native sidecars): the
+    # SidecarMode submitter sets "Never" so its termination is observable.
+    restartPolicy: str = ""
 
     @classmethod
     def _nested_types(cls):

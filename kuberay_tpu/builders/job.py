@@ -35,6 +35,44 @@ def build_submit_command(job: TpuJob, cluster: TpuCluster) -> str:
     return f"({submit} || echo 'submit skipped: already submitted') && exec {attach}"
 
 
+def build_sidecar_submitter_container(job: TpuJob,
+                                      head_image: str) -> Dict[str, Any]:
+    """SidecarMode: the submitter container the job controller injects
+    into the head pod template of the cluster it creates (ref
+    ``common/job.go:95-158`` — submitter rides the head pod, talks to the
+    coordinator over localhost, and its terminal container state is the
+    job outcome signal the controller watches).
+
+    No shell `|| attach` wrapper here: the submit tool itself waits for
+    the colocated coordinator to come up and is idempotent on re-submit
+    after a container restart.
+    """
+    jid = job.status.jobId or job.metadata.name
+    addr = f"127.0.0.1:{C.PORT_DASHBOARD}"
+    tmpl = (job.spec.submitterConfig.template.to_dict()
+            if job.spec.submitterConfig.template else None)
+    image = head_image
+    if tmpl and (tmpl.get("spec") or {}).get("containers"):
+        image = tmpl["spec"]["containers"][0].get("image") or head_image
+    submit = (f"python -m kuberay_tpu.runtime.submit --address {addr} "
+              f"--job-id {shlex.quote(jid)} --wait-for-coordinator 300 "
+              f"--tail-logs -- {job.spec.entrypoint}")
+    container = {
+        "name": C.SUBMITTER_CONTAINER_NAME,
+        "image": image,
+        "command": ["/bin/sh", "-c", submit],
+        # No container-level restartPolicy: K8s only allows that field on
+        # init containers (value "Always").  Termination observability
+        # comes from the POD-level restartPolicy "Never" the job
+        # controller sets on the head template in SidecarMode — the
+        # reference's exact mechanism (rayjob_controller.go:1035).
+        "env": [{"name": C.ENV_COORDINATOR_ADDRESS, "value": addr}],
+    }
+    for k, v in (job.spec.runtimeEnv or {}).items():
+        container["env"].append({"name": k, "value": str(v)})
+    return container
+
+
 def build_submitter_job(job: TpuJob, cluster: TpuCluster) -> Dict[str, Any]:
     """K8s Job wrapping the submitter pod (ref createK8sJobIfNeed
     rayjob_controller.go:560)."""

@@ -31,12 +31,19 @@ from kuberay_tpu.api.tpujob import (
     TpuJob,
 )
 from kuberay_tpu.builders.common import attach_cluster_auth, owner_reference
-from kuberay_tpu.builders.job import build_submitter_job
+from kuberay_tpu.builders.job import (
+    build_sidecar_submitter_container,
+    build_submitter_job,
+)
 from kuberay_tpu.controlplane.events import EventRecorder
 from kuberay_tpu.controlplane.store import AlreadyExists, NotFound, ObjectStore
 from kuberay_tpu.runtime.coordinator_client import CoordinatorError
 from kuberay_tpu.utils import constants as C
-from kuberay_tpu.utils.names import cluster_name_for_job, submitter_job_name
+from kuberay_tpu.utils.names import (
+    cluster_name_for_job,
+    head_pod_name,
+    submitter_job_name,
+)
 from kuberay_tpu.utils.validation import validate_job
 
 
@@ -161,7 +168,8 @@ class TpuJobController:
                 self._set_message(job, f"submission failed: {e}")
                 self._update(job)
                 return 2.0
-        # SIDECAR: the head pod template carried the entrypoint; nothing to do.
+        # SIDECAR: the submitter container was injected into the head pod
+        # at cluster creation (_get_or_create_cluster); nothing to do here.
         job.status.jobStatus = JobStatus.PENDING
         return self._to(job, JobDeploymentStatus.RUNNING, requeue=1.0)
 
@@ -205,6 +213,21 @@ class TpuJobController:
                     app_status = JobStatus.SUCCEEDED
                 elif st.get("failed", 0) > job.spec.submitterConfig.backoffLimit:
                     app_status = JobStatus.FAILED
+        elif job.spec.submissionMode == JobSubmissionMode.SIDECAR:
+            # The submitter container's terminal state in the head pod is
+            # the outcome signal (ref rayjob_controller.go:279,337).
+            head = self.store.try_get(
+                "Pod", head_pod_name(cluster.metadata.name),
+                job.metadata.namespace)
+            for cs in (head or {}).get("status", {}) \
+                    .get("containerStatuses", []):
+                if cs.get("name") != C.SUBMITTER_CONTAINER_NAME:
+                    continue
+                term = (cs.get("state") or {}).get("terminated")
+                if term is not None:
+                    app_status = (JobStatus.SUCCEEDED
+                                  if term.get("exitCode", 1) == 0
+                                  else JobStatus.FAILED)
 
         client = self._client(job, cluster)
         if client is not None:
@@ -338,6 +361,25 @@ class TpuJobController:
         if job.spec.clusterSelector:
             return None
         spec = job.spec.clusterSpec.to_dict()
+        if job.spec.submissionMode == JobSubmissionMode.SIDECAR:
+            # Inject the submitter container into the head pod template
+            # (ref common/job.go:95-158): it rides the head pod, submits
+            # over localhost, and its terminal container state is the
+            # outcome signal _state_running watches.
+            head_spec = spec.setdefault("headGroupSpec", {}) \
+                .setdefault("template", {}).setdefault("spec", {})
+            containers = head_spec.setdefault("containers", [])
+            head_image = (containers[0].get("image", "")
+                          if containers else "")
+            if not any(c.get("name") == C.SUBMITTER_CONTAINER_NAME
+                       for c in containers):
+                containers.append(build_sidecar_submitter_container(
+                    job, head_image))
+            # Pod-level Never (ref rayjob_controller.go:1035): the exited
+            # submitter must surface as state.terminated, not be
+            # restarted by the kubelet; head-loss repair is the cluster
+            # controller's job either way.
+            head_spec["restartPolicy"] = "Never"
         obj = {
             "apiVersion": C.API_VERSION,
             "kind": C.KIND_CLUSTER,

@@ -27,6 +27,11 @@ def main(argv=None) -> int:
     ap.add_argument("--no-wait", action="store_true")
     ap.add_argument("--tail-logs", action="store_true")
     ap.add_argument("--poll-seconds", type=float, default=2.0)
+    ap.add_argument("--wait-for-coordinator", type=float, default=0.0,
+                    help="retry the initial submit for up to N seconds "
+                         "(SidecarMode: the submitter container starts "
+                         "with the head pod, possibly before the "
+                         "coordinator listens)")
     ap.add_argument("entrypoint", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
 
@@ -41,13 +46,29 @@ def main(argv=None) -> int:
     entry = [a for a in args.entrypoint if a != "--"]
     submitted = False
     if entry:
-        try:
-            client.submit_job(args.job_id, " ".join(entry))
-            submitted = True
-            print(f"submitted {args.job_id}", flush=True)
-        except CoordinatorError as e:
-            print(f"submit failed: {e}", file=sys.stderr)
-            return 1
+        deadline = time.time() + args.wait_for_coordinator
+        while True:
+            try:
+                client.submit_job(args.job_id, " ".join(entry))
+                submitted = True
+                print(f"submitted {args.job_id}", flush=True)
+                break
+            except CoordinatorError as e:
+                msg = str(e)
+                if "HTTP 409" in msg or "already" in msg:
+                    # Duplicate submission after a submitter restart —
+                    # idempotent: fall through and attach.
+                    print(f"already submitted, attaching: {e}", flush=True)
+                    break
+                # Only a coordinator that is not LISTENING yet is worth
+                # waiting for; a reachable one rejecting the request
+                # (auth, validation — "HTTP 4xx/5xx") is a hard error.
+                if "HTTP " in msg or time.time() >= deadline:
+                    print(f"submit failed: {e}", file=sys.stderr)
+                    return 1
+                print(f"coordinator not ready, retrying: {e}",
+                      file=sys.stderr, flush=True)
+                time.sleep(min(2.0, args.poll_seconds))
         if args.no_wait and not args.tail_logs:
             return 0
 

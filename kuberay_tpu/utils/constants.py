@@ -37,6 +37,10 @@ NODE_TYPE_HEAD = "head"
 NODE_TYPE_WORKER = "worker"
 CREATED_BY_OPERATOR = "kuberay-tpu-operator"
 
+# SidecarMode submitter container injected into the head pod (ref
+# SubmitterContainerName, common/job.go:95-158 BuildSidecarContainer role).
+SUBMITTER_CONTAINER_NAME = "tpu-job-submitter"
+
 # --- Annotations (ref constant.go:64-69) -------------------------------------
 ANNOTATION_OVERWRITE_CONTAINER_CMD = "tpu.dev/overwrite-container-cmd"
 ANNOTATION_FT_ENABLED = "tpu.dev/ft-enabled"

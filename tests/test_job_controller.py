@@ -211,3 +211,77 @@ def test_job_invalid_spec_fails(h):
     j = h.job()
     assert j.status.jobDeploymentStatus == JobDeploymentStatus.FAILED
     assert j.status.reason == "ValidationFailed"
+
+
+# -- SidecarMode (ref common/job.go:95-158, e2erayjob sidecar specs) ---------
+
+def _set_submitter_terminated(h, cluster_name, exit_code):
+    from kuberay_tpu.utils.names import head_pod_name
+    pod = h.store.get("Pod", head_pod_name(cluster_name))
+    pod.setdefault("status", {})["containerStatuses"] = [
+        {"name": C.SUBMITTER_CONTAINER_NAME,
+         "state": {"terminated": {"exitCode": exit_code}}}]
+    h.store.update_status(pod)
+
+
+def _head_submitter(h, cluster_name):
+    from kuberay_tpu.utils.names import head_pod_name
+    pod = h.store.get("Pod", head_pod_name(cluster_name))
+    subs = [c for c in pod["spec"]["containers"]
+            if c["name"] == C.SUBMITTER_CONTAINER_NAME]
+    return subs[0] if subs else None
+
+
+def test_job_sidecar_mode_completes(h):
+    h.store.create(make_job(
+        submissionMode=JobSubmissionMode.SIDECAR).to_dict())
+    j = drive_job(h)
+    assert j.status.jobDeploymentStatus == JobDeploymentStatus.RUNNING
+    # The submitter container rides the head pod, localhost-addressed,
+    # waiting for the colocated coordinator.
+    sub = _head_submitter(h, j.status.clusterName)
+    assert sub is not None
+    assert "--wait-for-coordinator" in sub["command"][2]
+    assert "127.0.0.1" in sub["command"][2]
+    # Pod-level Never (ref rayjob_controller.go:1035): the exited
+    # submitter surfaces as state.terminated instead of restarting.
+    from kuberay_tpu.utils.names import head_pod_name
+    head = h.store.get("Pod", head_pod_name(j.status.clusterName))
+    assert head["spec"].get("restartPolicy") == "Never"
+    # Terminal container state drives the job outcome.
+    _set_submitter_terminated(h, j.status.clusterName, 0)
+    h.settle()
+    j = h.job()
+    assert j.status.jobDeploymentStatus == JobDeploymentStatus.COMPLETE
+    assert j.status.jobStatus == JobStatus.SUCCEEDED
+
+
+def test_job_sidecar_mode_fails_with_backoff(h):
+    h.store.create(make_job(submissionMode=JobSubmissionMode.SIDECAR,
+                            backoffLimit=1).to_dict())
+    j = drive_job(h)
+    first_cluster = j.status.clusterName
+    _set_submitter_terminated(h, first_cluster, 1)
+    h.settle()
+    j = drive_job(h)
+    # Retry on a fresh cluster whose head pod got a fresh submitter.
+    assert int(j.status.failed) == 1
+    assert j.status.clusterName != first_cluster
+    assert j.status.jobDeploymentStatus == JobDeploymentStatus.RUNNING
+    assert _head_submitter(h, j.status.clusterName) is not None
+    _set_submitter_terminated(h, j.status.clusterName, 1)
+    h.settle()
+    j = h.job()
+    assert j.status.jobDeploymentStatus == JobDeploymentStatus.FAILED
+    assert j.status.reason == "AppFailed"
+
+
+def test_job_sidecar_refuses_cluster_selector(h):
+    job = make_job(submissionMode=JobSubmissionMode.SIDECAR,
+                   clusterSelector={"team": "ml"})
+    job.spec.clusterSpec = None
+    h.store.create(job.to_dict())
+    h.settle()
+    j = h.job()
+    assert j.status.jobDeploymentStatus == JobDeploymentStatus.FAILED
+    assert j.status.reason == "ValidationFailed"

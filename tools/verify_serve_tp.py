@@ -24,11 +24,20 @@ def main() -> int:
         env=dict(os.environ), stdout=subprocess.PIPE,
         stderr=subprocess.STDOUT, text=True)
     try:
+        import queue
+        import threading
+        lines: "queue.Queue[str]" = queue.Queue()
+        threading.Thread(target=lambda: [lines.put(ln)
+                                         for ln in srv.stdout],
+                         daemon=True).start()
         deadline = time.time() + 180
         line = ""
+        # Deadline-aware read: a silently hung server must fail at the
+        # deadline, not pin this script on a blocking readline().
         while time.time() < deadline:
-            line = srv.stdout.readline()
-            if not line:
+            try:
+                line = lines.get(timeout=max(0.1, deadline - time.time()))
+            except queue.Empty:
                 break
             print("SRV:", line.rstrip(), flush=True)
             if "serving llama_tiny" in line:
