@@ -118,8 +118,11 @@ class Container(Serializable):
     ports: List[ContainerPort] = dataclasses.field(default_factory=list)
     resources: ResourceRequirements = dataclasses.field(default_factory=ResourceRequirements)
     workingDir: str = ""
-    # Container-level restart policy (K8s 1.28+ native sidecars): the
-    # SidecarMode submitter sets "Never" so its termination is observable.
+    # Container-level restart policy: K8s native-sidecar field (valid on
+    # initContainers, value "Always").  Preserved on round-trip so user
+    # templates with native sidecars don't silently lose it; nothing in
+    # this framework sets it (the SidecarMode submitter relies on the
+    # POD-level "Never" instead — see builders/job.py).
     restartPolicy: str = ""
 
     @classmethod

@@ -7,6 +7,10 @@ Views:
   #/overview            namespace-scoped tables (clusters/jobs/services/
                         cron), slices, recent events
   #/cluster/{ns}/{name} drill-down: status, slices, pods, events
+  #/job/{ns}/{name}     drill-down: status timeline, submitter, step
+                        events, LIVE driver-log tail (coordinator proxy)
+  #/service/{ns}/{name} drill-down: active/pending pair, traffic weights
+                        during a roll, per-app status
   #/new                 create a TpuJob or TpuCluster (form or raw JSON)
   #/history             archived clusters (history mount), log browser
 """
@@ -88,11 +92,13 @@ async function viewOverview(el){
    `${s.readySlices||0}/${s.desiredSlices||0}`,
    `${s.readyWorkerHosts||0}/${s.desiredWorkerHosts||0}`,s.desiredTpuChips||0])}).join('')}</table>
  <h2>TpuJobs</h2><table>${row(['NAME','DEPLOYMENT','JOB','CLUSTER','RETRIES'],1)+
-  J.map(j=>{const s=j.status||{};return row([esc(j.metadata.name),
+  J.map(j=>{const s=j.status||{};return row([
+   `<a href="#/job/${esc(j.metadata.namespace||'default')}/${esc(j.metadata.name)}">${esc(j.metadata.name)}</a>`,
    `<span class="${cls(s.jobDeploymentStatus)}">${esc(s.jobDeploymentStatus||'')}</span>`,
    esc(s.jobStatus||''),`<span class="mono">${esc(s.clusterName||'')}</span>`,esc(s.failed||0)])}).join('')}</table>
  <h2>TpuServices</h2><table>${row(['NAME','STATUS','ACTIVE CLUSTER','ENDPOINTS'],1)+
-  S.map(x=>{const s=x.status||{};return row([esc(x.metadata.name),
+  S.map(x=>{const s=x.status||{};return row([
+   `<a href="#/service/${esc(x.metadata.namespace||'default')}/${esc(x.metadata.name)}">${esc(x.metadata.name)}</a>`,
    `<span class="${cls(s.serviceStatus)}">${esc(s.serviceStatus||'')}</span>`,
    `<span class="mono">${esc((s.activeServiceStatus||{}).clusterName||'')}</span>`,
    s.numServeEndpoints||0])}).join('')}</table>
@@ -135,6 +141,77 @@ async function viewCluster(el,ns,name){
     `<span class="${cls((p.status||{}).phase)}">${esc((p.status||{}).phase||'')}</span>`,
     esc((p.spec||{}).nodeName||''),
     esc(((p.status||{}).containerStatuses||[{}])[0].restartCount||0)])).join('')}</table>`).join('')}
+ <h3>Events</h3><table>${row(['TYPE','REASON','MESSAGE'],1)+
+  E.map(e=>row([esc(e.type),esc(e.reason),esc(e.message||'')])).join('')}</table>`;
+}
+
+async function viewJob(el,ns,name){
+ const j=await getj(`/apis/tpu.dev/v1/namespaces/${ns}/tpujobs/${name}`);
+ if(!j){el.innerHTML=`<h2>TpuJob ${esc(ns)}/${esc(name)}</h2><p class="bad">not found</p>`;return}
+ const s=j.status||{},sp=j.spec||{};
+ const fmt=t=>t?new Date(t*1000).toLocaleTimeString():'—';
+ const E=(await list(`/api/v1/namespaces/${ns}/events`))
+  .filter(e=>(e.involvedObject||{}).name===name).slice(-12).reverse();
+ // Status timeline from condition transitions + start/end times.
+ const tl=(s.conditions||[]).map(c=>({t:c.lastTransitionTime,l:`${c.type}=${c.status}`}))
+  .concat(s.startTime?[{t:s.startTime,l:'started'}]:[])
+  .concat(s.endTime?[{t:s.endTime,l:`ended (${s.jobStatus||''})`}]:[])
+  .filter(x=>x.t).sort((a,b)=>a.t-b.t);
+ // Step events + live log tail ride the coordinator proxy; both degrade
+ // to a dim note when the cluster/coordinator is gone.
+ const ev=(await getj(`/api/proxy/${encPath(ns,s.clusterName||'-')}/events?job_id=${encodeURIComponent(s.jobId||'')}&limit=200`)||{}).events;
+ el.innerHTML=`
+ <h2>TpuJob <span class="mono">${esc(ns)}/${esc(name)}</span>
+  <span class="${cls(s.jobDeploymentStatus)}">${esc(s.jobDeploymentStatus||'')}</span></h2>
+ <table>${row(['JOB ID','APP STATUS','CLUSTER','MODE','RETRIES','REASON'],1)+
+  row([`<span class="mono">${esc(s.jobId||'')}</span>`,esc(s.jobStatus||''),
+   s.clusterName?`<a href="#/cluster/${esc(ns)}/${esc(s.clusterName)}"><span class="mono">${esc(s.clusterName)}</span></a>`:'—',
+   esc(sp.submissionMode||''),esc(s.failed||0),esc(s.reason||'—')])}</table>
+ ${s.message?`<p class="dim">${esc(s.message)}</p>`:''}
+ <h3>Timeline</h3><table>${row(['TIME','TRANSITION'],1)+
+  tl.map(x=>row([fmt(x.t),esc(x.l)])).join('')}</table>
+ <h3>Step events</h3>
+ ${ev===undefined||ev===null?'<p class="dim">coordinator unreachable (cluster gone? check history)</p>':
+  `<table>${row(['TIME','TYPE','NAME','DETAIL'],1)+
+   ev.slice(-15).reverse().map(e=>row([fmt(e.ts),esc(e.type),esc(e.name),
+    `<span class="mono">${esc(JSON.stringify(e.args||{}))}</span>`])).join('')}</table>`}
+ <h3>Driver log (live tail)</h3><pre id="joblog">loading…</pre>
+ <h3>K8s events</h3><table>${row(['TYPE','REASON','MESSAGE'],1)+
+  E.map(e=>row([esc(e.type),esc(e.reason),esc(e.message||'')])).join('')}</table>`;
+ const tail=async()=>{
+  const v=document.getElementById('joblog');if(!v)return;
+  const r=s.clusterName&&s.jobId?
+   await getj(`/api/proxy/${encPath(ns,s.clusterName)}/jobs/${encPath(s.jobId)}/logs`):null;
+  v.textContent=r&&r.logs!==undefined?(r.logs.split('\n').slice(-40).join('\n')||'(empty)')
+   :'coordinator unreachable — archived logs may be in #/history';
+  v.scrollTop=v.scrollHeight};
+ await tail();
+}
+
+async function viewService(el,ns,name){
+ const x=await getj(`/apis/tpu.dev/v1/namespaces/${ns}/tpuservices/${name}`);
+ if(!x){el.innerHTML=`<h2>TpuService ${esc(ns)}/${esc(name)}</h2><p class="bad">not found</p>`;return}
+ const s=x.status||{};
+ // Label-selected: the controller hash-truncates long route names.
+ const routes=await list(`/apis/tpu.dev/v1/namespaces/${ns}/trafficroutes?labelSelector=${encodeURIComponent('tpu.dev/originated-from-cr-name='+name)}`);
+ const route=routes[0]||null;
+ const E=(await list(`/api/v1/namespaces/${ns}/events`))
+  .filter(e=>(e.involvedObject||{}).name===name).slice(-12).reverse();
+ const pair=[['active',s.activeServiceStatus],['pending',s.pendingServiceStatus]]
+  .filter(([,cs])=>cs&&cs.clusterName);
+ el.innerHTML=`
+ <h2>TpuService <span class="mono">${esc(ns)}/${esc(name)}</span>
+  <span class="${cls(s.serviceStatus)}">${esc(s.serviceStatus||'')}</span></h2>
+ <h3>Cluster pair${pair.length>1?' — upgrade roll in progress':''}</h3>
+ <table>${row(['ROLE','CLUSTER','TRAFFIC %','TARGET CAPACITY %','SPEC HASH','APPS'],1)+
+  pair.map(([role,cs])=>row([role,
+   `<a href="#/cluster/${esc(ns)}/${esc(cs.clusterName)}"><span class="mono">${esc(cs.clusterName)}</span></a>`,
+   esc(cs.trafficWeightPercent??''),esc(cs.targetCapacityPercent??''),
+   `<span class="mono">${esc((cs.specHash||'').slice(0,10))}</span>`,
+   (cs.applications||[]).map(a=>`${esc(a.name)}: <span class="${cls(a.status)}">${esc(a.status)}</span>`).join(', ')||'—'])).join('')}</table>
+ ${route?`<h3>Traffic route</h3><table>${row(['BACKEND SERVICE','WEIGHT'],1)+
+  ((route.spec||{}).backends||[]).map(b=>row([`<span class="mono">${esc(b.service)}</span>`,
+   esc(b.weight)])).join('')}</table>`:''}
  <h3>Events</h3><table>${row(['TYPE','REASON','MESSAGE'],1)+
   E.map(e=>row([esc(e.type),esc(e.reason),esc(e.message||'')])).join('')}</table>`;
 }
@@ -245,6 +322,10 @@ async function render(){
  if(timer){clearInterval(timer);timer=null}
  if(view==='cluster'&&parts.length===3){await viewCluster(el,parts[1],parts[2]);
   timer=setInterval(()=>viewCluster(el,parts[1],parts[2]),3000)}
+ else if(view==='job'&&parts.length===3){await viewJob(el,parts[1],parts[2]);
+  timer=setInterval(()=>viewJob(el,parts[1],parts[2]),3000)}
+ else if(view==='service'&&parts.length===3){await viewService(el,parts[1],parts[2]);
+  timer=setInterval(()=>viewService(el,parts[1],parts[2]),3000)}
  else if(view==='new')viewNew(el);
  else if(view==='history')await viewHistory(el,parts[1],parts[2]);
  else{await viewOverview(el);timer=setInterval(()=>viewOverview(el),3000)}

@@ -133,6 +133,56 @@ class ApiHandler(JsonHandler):
                        for erv, ev in events],
         })
 
+    def _coordinator_proxy(self, path: str):
+        """Dashboard's live drill-down seam: proxy a WHITELISTED coordinator
+        endpoint for a cluster —
+
+          /api/proxy/{ns}/{cluster}/jobs/{jid}/logs   driver log tail
+          /api/proxy/{ns}/{cluster}/events[?...]      task/step events
+
+        The coordinator address comes from the cluster's status (the
+        operator wrote it), never from the request, so this cannot be
+        steered at arbitrary hosts; sub-paths are fixed, so it cannot
+        reach arbitrary coordinator endpoints either (ref: the dashboard
+        talks to the Ray dashboard API via exactly this kind of seam).
+        """
+        parts = [p for p in path.split("/") if p][2:]     # strip api/proxy
+        if len(parts) < 3:
+            return self._error(404, "unknown proxy path")
+        ns, cluster = parts[0], parts[1]
+        if parts[2] == "events" and len(parts) == 3:
+            sub = "/api/events"
+            q = urlparse(self.path).query
+            if q:
+                sub += "?" + q
+        elif parts[2] == "jobs" and len(parts) == 5 and parts[4] == "logs":
+            sub = f"/api/jobs/{parts[3]}/logs"
+        else:
+            return self._error(404, "unknown proxy path")
+        obj = self.store.try_get(C.KIND_CLUSTER, cluster, ns)
+        if obj is None:
+            return self._error(404, f"TpuCluster {ns}/{cluster} not found")
+        addr = obj.get("status", {}).get("coordinatorAddress", "")
+        if not addr:
+            return self._error(503, "cluster has no coordinator address")
+        host = addr.split(":")[0]
+        url = f"http://{host}:{C.PORT_DASHBOARD}{sub}"
+        headers = {}
+        # Auth-enabled clusters: reuse the operator-minted token the
+        # controllers/collectors use (builders/auth.read_auth_token).
+        from kuberay_tpu.builders.auth import read_auth_token
+        token = read_auth_token(self.store, cluster, ns)
+        if token:
+            headers["Authorization"] = f"Bearer {token}"
+        try:
+            import urllib.request as _rq
+            with _rq.urlopen(_rq.Request(url, headers=headers),
+                             timeout=5) as resp:
+                return self._send_text(resp.status, resp.read().decode(
+                    errors="replace"), "application/json")
+        except OSError as e:
+            return self._error(502, f"coordinator unreachable: {e}")
+
     def _label_selector(self) -> Optional[Dict[str, str]]:
         q = parse_qs(urlparse(self.path).query)
         sel = q.get("labelSelector", [None])[0]
@@ -284,6 +334,8 @@ class ApiHandler(JsonHandler):
                 if is_text:
                     return self._send_text(code, body)
                 return self._send(code, body)
+        if path.startswith("/api/proxy/"):
+            return self._coordinator_proxy(path)
         route = self._route()
         if route is None:
             return self._error(404, f"unknown path {path}")

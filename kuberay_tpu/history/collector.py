@@ -133,12 +133,19 @@ class CoordinatorCollector:
             return None
 
     def collect_once(self) -> int:
-        """Scrape metadata + jobs + job logs; returns archived-object count."""
+        """Scrape metadata + jobs + events + job logs; returns
+        archived-object count."""
         n = 0
         meta_prefix = f"meta/{self.namespace}/{self.cluster}"
         raw = self._get("/api/cluster")
         if raw is not None:
             self.storage.put(f"{meta_prefix}/metadata.json", raw)
+            n += 1
+        # Structured task/step/profile events (ref eventserver.go:838) —
+        # the post-mortem replay source for /api/history/events.
+        raw = self._get("/api/events?limit=20000")   # = full ring size
+        if raw is not None:
+            self.storage.put(f"{meta_prefix}/events.json", raw)
             n += 1
         raw = self._get("/api/jobs/")
         if raw is None:

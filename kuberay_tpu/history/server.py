@@ -165,6 +165,14 @@ class HistoryServer:
                 out[k[len(prefix):]] = doc
         return out
 
+    def task_events(self, ns: str, cluster: str) -> List[Dict[str, Any]]:
+        """Archived task/step/profile events (collector scrape of the
+        coordinator's /api/events — ref eventserver.go:838 replay)."""
+        doc = self.storage.get_doc(f"meta/{ns}/{cluster}/events.json")
+        if doc is None:
+            return []
+        return doc.get("events", doc) if isinstance(doc, dict) else doc
+
     # -- routing (shared by the standalone server and the apiserver's
     #    /api/history mount) ------------------------------------------
 
@@ -191,6 +199,9 @@ class HistoryServer:
             return 404, {"message": "unknown path"}, False
         if head == "meta" and len(parts) == 5:
             return 200, self.meta_docs(parts[3], parts[4]), False
+        if head == "events" and len(parts) == 5:
+            return 200, {"events": self.task_events(parts[3],
+                                                    parts[4])}, False
         if head == "timeline" and len(parts) == 5:
             doc = self.storage.get_doc(_doc_key("TpuCluster", parts[3],
                                                 parts[4]))
@@ -199,7 +210,9 @@ class HistoryServer:
             from kuberay_tpu.utils.timeline import cluster_timeline
             jobs = [j for j in list_docs(self.storage, "TpuJob", parts[3])
                     if j.get("status", {}).get("clusterName") == parts[4]]
-            return 200, cluster_timeline(doc, jobs=jobs), False
+            return 200, cluster_timeline(
+                doc, jobs=jobs,
+                task_events=self.task_events(parts[3], parts[4])), False
         kind = head
         if kind not in _ARCHIVED_KINDS:
             return 404, {"message": "unknown kind"}, False

@@ -116,6 +116,24 @@ class CoordinatorClient:
     def list_profiles(self) -> List[str]:
         return self._req("GET", "/api/profile/").get("profiles", [])
 
+    # structured task/step/profile events (ref eventserver ingest)
+    def post_events(self, events: List[Dict[str, Any]]) -> int:
+        return self._req("POST", "/api/events",
+                         {"events": events}).get("recorded", 0)
+
+    def get_events(self, job_id: Optional[str] = None,
+                   etype: Optional[str] = None,
+                   limit: int = 5000) -> List[Dict[str, Any]]:
+        import urllib.parse
+        q = {"limit": str(limit)}
+        if job_id:
+            q["job_id"] = job_id
+        if etype:
+            q["type"] = etype
+        return self._req(
+            "GET", "/api/events?" + urllib.parse.urlencode(q)
+        ).get("events", [])
+
     def healthz(self) -> bool:
         try:
             self._req("GET", "/api/healthz")

@@ -26,6 +26,7 @@ import socket
 import subprocess
 import threading
 import time
+from collections import deque
 from http.server import ThreadingHTTPServer
 from typing import Any, Dict, Optional
 
@@ -214,6 +215,11 @@ class CoordinatorServer:
         self.jobs: Dict[str, JobRecord] = {}
         self.serve_config: Optional[Dict[str, Any]] = None
         self.serve_apps: Dict[str, Any] = {}
+        # Structured task/step/profile events (ref eventserver.go:838
+        # handleTaskProfileEvent): jobs/engines POST them here; the
+        # history collector archives them for post-mortem replay.
+        # Bounded ring — the archive, not this buffer, is durable.
+        self.events: "deque[Dict[str, Any]]" = deque(maxlen=20000)
         # Device profiling (ref: Ray dashboard profile capture; here a
         # jax.profiler trace written under log_dir so the history log
         # collector archives it like any node file).
@@ -296,6 +302,34 @@ class CoordinatorServer:
 
     # -- job lifecycle -----------------------------------------------------
 
+    # -- structured events -------------------------------------------------
+
+    def record_events(self, events) -> int:
+        """Ingest task/step/profile events (a dict or list of dicts).
+        Each gets a server timestamp if it lacks one."""
+        if isinstance(events, dict):
+            events = [events]
+        n = 0
+        now = time.time()
+        with self._lock:
+            for ev in events:
+                if not isinstance(ev, dict):
+                    continue
+                ev.setdefault("ts", now)
+                ev.setdefault("type", "task")
+                self.events.append(ev)
+                n += 1
+        return n
+
+    def list_events(self, job_id: Optional[str] = None,
+                    etype: Optional[str] = None,
+                    limit: int = 5000) -> list:
+        with self._lock:
+            out = [e for e in self.events
+                   if (job_id is None or e.get("job_id") == job_id)
+                   and (etype is None or e.get("type") == etype)]
+        return out[-limit:]
+
     def submit(self, job_id: str, entrypoint: str, runtime_env=None,
                metadata=None) -> JobRecord:
         with self._lock:
@@ -313,11 +347,15 @@ class CoordinatorServer:
         env = dict(os.environ)
         for k, v in rec.runtime_env.items():
             env[str(k)] = str(v)
+        # Entrypoints tag their step events with this (train/launcher.py).
+        env.setdefault("TPU_JOB_ID", rec.job_id)
         logf = open(rec.log_path, "ab")
         try:
             rec.proc = subprocess.Popen(
                 rec.entrypoint, shell=True, stdout=logf, stderr=logf, env=env)
             rec.status = "RUNNING"
+            self.record_events({"type": "task", "name": "job_started",
+                                "job_id": rec.job_id})
         except OSError as e:
             rec.status = "FAILED"
             rec.message = str(e)
@@ -335,6 +373,10 @@ class CoordinatorServer:
                 rec.message = f"exit code {code}"
             rec.end_time = time.time()
             self._persist_job(rec)
+        self.record_events({"type": "task", "name": "job_finished",
+                            "job_id": rec.job_id,
+                            "args": {"status": rec.status,
+                                     "exit_code": code}})
 
     def stop(self, job_id: str) -> bool:
         with self._lock:
@@ -430,6 +472,20 @@ class CoordinatorServer:
                 if self.path == "/api/profile/":
                     return self._send(200,
                                       {"profiles": coord.list_profiles()})
+                if self.path.split("?", 1)[0] == "/api/events":
+                    import urllib.parse
+                    q = urllib.parse.parse_qs(
+                        urllib.parse.urlsplit(self.path).query)
+                    try:
+                        limit = int((q.get("limit") or [5000])[0])
+                    except ValueError:
+                        return self._send(400, {"message": "bad limit"})
+                    if limit <= 0:
+                        return self._send(200, {"events": []})
+                    return self._send(200, {"events": coord.list_events(
+                        job_id=(q.get("job_id") or [None])[0],
+                        etype=(q.get("type") or [None])[0],
+                        limit=limit)})
                 return self._send(404, {"message": "unknown path"})
 
             def do_POST(self):
@@ -454,6 +510,11 @@ class CoordinatorServer:
                     ok = coord.stop(jid)
                     return self._send(200 if ok else 404,
                                       {"stopped": ok})
+                if self.path == "/api/events":
+                    b = self._body()
+                    n = coord.record_events(
+                        b.get("events", b) if isinstance(b, dict) else b)
+                    return self._send(200, {"recorded": n})
                 return self._send(404, {"message": "unknown path"})
 
             def do_PUT(self):

@@ -138,6 +138,20 @@ def train(args) -> int:
         loader = TokenShardLoader(path, args.seq_len, args.batch,
                                   seed=args.seed)
 
+    # Step-event reporting: worker 0 posts structured step events to the
+    # colocated coordinator at each log interval (the task/profile event
+    # stream the history server replays, ref eventserver.go:838).  Off
+    # when no coordinator address was injected; never fatal.
+    from kuberay_tpu.utils import constants as C
+    event_client = None
+    if ident.worker_id == 0 and ident.slice_id == 0 and \
+            os.environ.get(C.ENV_COORDINATOR_ADDRESS):
+        from kuberay_tpu.runtime.coordinator_client import CoordinatorClient
+        host = os.environ[C.ENV_COORDINATOR_ADDRESS].split(":")[0]
+        event_client = CoordinatorClient(
+            f"http://{host}:{C.PORT_DASHBOARD}", timeout=2.0)
+    job_id = os.environ.get("TPU_JOB_ID", "train")
+
     start_step = int(state["step"])
     t0 = time.time()
     for i in range(start_step, args.steps):
@@ -147,10 +161,20 @@ def train(args) -> int:
             "targets": jnp.asarray(batch["targets"])})
         if (i + 1) % args.log_every == 0 and ident.worker_id == 0:
             loss = float(metrics["loss"])
-            tok_s = args.batch * args.seq_len * args.log_every / (
-                time.time() - t0)
+            dt = time.time() - t0
+            tok_s = args.batch * args.seq_len * args.log_every / dt
             print(f"step {i + 1} loss {loss:.4f} tok/s {tok_s:.0f}",
                   flush=True)
+            if event_client is not None:
+                try:
+                    event_client.post_events([{
+                        "type": "step", "name": "train_step",
+                        "job_id": job_id, "ts": time.time() - dt,
+                        "dur": dt,
+                        "args": {"step": i + 1, "loss": loss,
+                                 "tokens_per_sec": round(tok_s, 1)}}])
+                except Exception:
+                    event_client = None    # coordinator gone: stop trying
             t0 = time.time()
         if args.checkpoint_dir and (i + 1) % args.checkpoint_every == 0:
             ckpt.save(args.checkpoint_dir, state, i + 1)

@@ -47,9 +47,34 @@ def _event_rows(events: List[Dict[str, Any]], name: str,
     return out
 
 
+def task_event_rows(task_events: List[Dict[str, Any]],
+                    pid: str) -> List[Dict[str, Any]]:
+    """Structured task/step/profile events (the coordinator's /api/events
+    stream, ref eventserver.go:838) as trace rows: events with ``dur``
+    render as spans, others as instants; one lane per job id."""
+    out = []
+    for e in task_events:
+        ts = e.get("ts") or 0
+        tid = e.get("job_id") or e.get("type", "task")
+        row = {
+            "name": e.get("name", e.get("type", "task")),
+            "cat": e.get("type", "task"),
+            "ts": _us(ts), "pid": pid, "tid": f"tasks/{tid}",
+            "args": e.get("args", {}),
+        }
+        dur = e.get("dur")
+        if dur:
+            row.update({"ph": _PHASE_COMPLETE, "dur": max(_us(dur), 1)})
+        else:
+            row.update({"ph": _PHASE_INSTANT, "s": "t"})
+        out.append(row)
+    return out
+
+
 def cluster_timeline(cluster: Dict[str, Any],
                      events: Optional[List[Dict[str, Any]]] = None,
-                     jobs: Optional[List[Dict[str, Any]]] = None
+                     jobs: Optional[List[Dict[str, Any]]] = None,
+                     task_events: Optional[List[Dict[str, Any]]] = None
                      ) -> Dict[str, Any]:
     """Chrome-trace document for one TpuCluster (live CR dict or an
     archived history doc — both carry metadata/status/events)."""
@@ -109,6 +134,8 @@ def cluster_timeline(cluster: Dict[str, Any],
                 "args": {"deployment": jst.get("jobDeploymentStatus", ""),
                          "job": jst.get("jobStatus", "")},
             })
+
+    trace.extend(task_event_rows(task_events or [], pid))
 
     return {"traceEvents": sorted(trace, key=lambda e: e["ts"]),
             "displayTimeUnit": "ms"}

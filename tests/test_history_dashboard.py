@@ -174,3 +174,73 @@ def test_all_samples_validate_and_provision():
     finally:
         op.stop()
         features.reset()
+
+
+def test_dashboard_has_drilldown_views():
+    """Job/service drill-downs shipped in the SPA (ref
+    dashboard/src/app job + serve detail pages)."""
+    from kuberay_tpu.apiserver.dashboard import DASHBOARD_HTML
+    for marker in ("viewJob", "viewService", "Driver log (live tail)",
+                   "#/job/", "#/service/", "Step events",
+                   "/api/proxy/", "Traffic route"):
+        assert marker in DASHBOARD_HTML, marker
+
+
+@pytest.mark.timeout(60)
+def test_coordinator_proxy_live_log_and_events(tmp_path):
+    """The dashboard's live drill-down seam: the apiserver proxies
+    whitelisted coordinator endpoints for a cluster, resolving the
+    address from the cluster's status (never the request)."""
+    import sys
+    import time as _t
+
+    from kuberay_tpu.apiserver.server import serve_background
+    from kuberay_tpu.runtime.coordinator_client import CoordinatorClient
+    from kuberay_tpu.runtime.coordinator_server import (
+        CoordinatorServer,
+        MemoryBackend,
+    )
+
+    coord = CoordinatorServer(state=MemoryBackend(),
+                              log_dir=str(tmp_path / "logs"))
+    csrv, curl = coord.serve_background()
+    host, port = curl.rsplit("//", 1)[1].rsplit(":", 1)
+    store = ObjectStore()
+    srv, url = serve_background(store)
+    try:
+        client = CoordinatorClient(curl)
+        client.submit_job("j-p", f"{sys.executable} -c 'print(\"hi\")'")
+        deadline = _t.time() + 20
+        while _t.time() < deadline and \
+                client.get_job_info("j-p").status != "SUCCEEDED":
+            _t.sleep(0.1)
+        c = make_cluster(name="live").to_dict()
+        store.create(c)
+        obj = store.get(C.KIND_CLUSTER, "live")
+        # Point the proxy at the live coordinator (tests run it on an
+        # ephemeral port; production uses the standard dashboard port).
+        obj["status"] = {"coordinatorAddress": f"{host}:{port}"}
+        store.update_status(obj)
+        import kuberay_tpu.utils.constants as consts
+        orig = consts.PORT_DASHBOARD
+        consts.PORT_DASHBOARD = int(port)
+        try:
+            logs = json.load(urllib.request.urlopen(
+                f"{url}/api/proxy/default/live/jobs/j-p/logs"))
+            assert "hi" in logs["logs"]
+            evs = json.load(urllib.request.urlopen(
+                f"{url}/api/proxy/default/live/events?job_id=j-p"))["events"]
+            assert any(e["name"] == "job_finished" for e in evs)
+        finally:
+            consts.PORT_DASHBOARD = orig
+        # Whitelist: arbitrary sub-paths do not proxy.
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"{url}/api/proxy/default/live/jobs/j-p/stop")
+        # Unknown cluster -> 404, no outbound call.
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"{url}/api/proxy/default/nope/events")
+    finally:
+        srv.shutdown()
+        csrv.shutdown()

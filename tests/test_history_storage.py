@@ -4,6 +4,7 @@ path (ref historyserver/pkg/storage + pkg/collector + test/e2e)."""
 
 import json
 import threading
+import time
 import urllib.error
 import urllib.request
 import xml.sax.saxutils
@@ -393,3 +394,84 @@ def test_tpuctl_download_logs_rejects_traversal(tmp_path):
             ["fine.log"]
     finally:
         srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Event pipeline e2e (VERDICT r2 item 5; ref eventserver.go:838): run a
+# real job on a live coordinator, ingest structured step events, archive,
+# and replay them post-mortem through /api/history.
+
+
+@pytest.mark.timeout(60)
+def test_event_pipeline_run_archive_replay(tmp_path):
+    import sys
+
+    from kuberay_tpu.runtime.coordinator_client import CoordinatorClient
+    from kuberay_tpu.runtime.coordinator_server import (
+        CoordinatorServer,
+        MemoryBackend,
+    )
+
+    coord = CoordinatorServer(state=MemoryBackend(),
+                              log_dir=str(tmp_path / "logs"))
+    srv, url = coord.serve_background()
+    storage = LocalStorage(str(tmp_path / "arch"))
+    try:
+        client = CoordinatorClient(url)
+        # A real job process runs to completion -> lifecycle task events.
+        client.submit_job("j-ev", f"{sys.executable} -c 'print(42)'")
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if client.get_job_info("j-ev").status == "SUCCEEDED":
+                break
+            time.sleep(0.1)
+        assert client.get_job_info("j-ev").status == "SUCCEEDED"
+        # The payload posts structured step events (what train/launcher.py
+        # emits each log interval).
+        assert client.post_events([
+            {"type": "step", "name": "train_step", "job_id": "j-ev",
+             "ts": 10.0, "dur": 0.5, "args": {"step": 1, "loss": 2.0}},
+            {"type": "profile", "name": "trace_captured",
+             "job_id": "j-ev"},
+        ]) == 2
+        evs = client.get_events(job_id="j-ev")
+        names = [e["name"] for e in evs]
+        assert "job_started" in names and "job_finished" in names
+        assert "train_step" in names
+
+        # Archive (the head-side collector scrape), then kill everything.
+        col = CoordinatorCollector(storage, url, cluster="evc")
+        assert col.collect_once() >= 3
+    finally:
+        srv.shutdown()
+
+    # Post-mortem: the history server replays the events with the
+    # coordinator long gone.
+    hsrv, hurl = HistoryServer(storage).serve_background()
+    try:
+        evs = json.load(urllib.request.urlopen(
+            f"{hurl}/api/history/events/default/evc"))["events"]
+        names = [e["name"] for e in evs]
+        assert "train_step" in names and "job_finished" in names
+        step = next(e for e in evs if e["name"] == "train_step")
+        assert step["args"]["loss"] == 2.0
+    finally:
+        hsrv.shutdown()
+
+
+@pytest.mark.timeout(60)
+def test_timeline_includes_task_events(tmp_path):
+    """The archived timeline renders step events as spans alongside the
+    control-plane state rows."""
+    from kuberay_tpu.utils.timeline import cluster_timeline
+
+    doc = {"metadata": {"name": "c", "creationTimestamp": 1.0},
+           "status": {"stateTransitionTimes": {"ready": 2.0}},
+           "archivedAt": 50.0}
+    tl = cluster_timeline(doc, task_events=[
+        {"type": "step", "name": "train_step", "job_id": "j1",
+         "ts": 3.0, "dur": 0.5, "args": {"step": 10}}])
+    rows = [e for e in tl["traceEvents"] if e["cat"] == "step"]
+    assert len(rows) == 1
+    assert rows[0]["ph"] == "X" and rows[0]["dur"] == 500000
+    assert rows[0]["tid"] == "tasks/j1"

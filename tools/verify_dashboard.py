@@ -1,0 +1,69 @@
+"""Verify drive: live apiserver + seeded CRs + coordinator for the
+dashboard drill-down views.  Prints the URL and blocks."""
+import json
+import sys
+import time
+
+from kuberay_tpu.apiserver.server import serve_background
+from kuberay_tpu.controlplane.store import ObjectStore
+from kuberay_tpu.runtime.coordinator_client import CoordinatorClient
+from kuberay_tpu.runtime.coordinator_server import CoordinatorServer, MemoryBackend
+from kuberay_tpu.utils import constants as C
+
+sys.path.insert(0, "tests")
+from test_api_types import make_cluster  # noqa: E402
+
+
+def main():
+    coord = CoordinatorServer(state=MemoryBackend(),
+                              log_dir="/tmp/verify-dash-logs")
+    csrv, curl = coord.serve_background()
+    host, port = curl.rsplit("//", 1)[1].rsplit(":", 1)
+    C.PORT_DASHBOARD = int(port)
+    client = CoordinatorClient(curl)
+    client.submit_job("j-dash", f"{sys.executable} -c 'print(\"hello from job\")'")
+    client.post_events([{"type": "step", "name": "train_step", "job_id": "j-dash",
+                         "ts": time.time(), "dur": 0.6,
+                         "args": {"step": 100, "loss": 1.23}}])
+
+    store = ObjectStore()
+    store.create(make_cluster(name="democ").to_dict())
+    obj = store.get(C.KIND_CLUSTER, "democ")
+    obj["status"] = {"state": "ready", "readySlices": 1, "desiredSlices": 1,
+                     "coordinatorAddress": f"{host}:{port}"}
+    store.update_status(obj)
+    store.create({
+        "apiVersion": C.API_VERSION, "kind": C.KIND_JOB,
+        "metadata": {"name": "demoj", "namespace": "default"},
+        "spec": {"entrypoint": "python x.py", "submissionMode": "HTTPMode",
+                 "clusterSpec": obj["spec"]},
+        "status": {"jobId": "j-dash", "clusterName": "democ",
+                   "jobDeploymentStatus": "Running", "jobStatus": "RUNNING",
+                   "startTime": time.time() - 60,
+                   "conditions": [{"type": "Initialized", "status": "True",
+                                   "lastTransitionTime": time.time() - 50}]},
+    })
+    store.create({
+        "apiVersion": C.API_VERSION, "kind": C.KIND_SERVICE,
+        "metadata": {"name": "demos", "namespace": "default"},
+        "spec": {"serveConfig": {"applications": []},
+                 "clusterSpec": obj["spec"]},
+        "status": {"serviceStatus": "Running",
+                   "activeServiceStatus": {"clusterName": "democ",
+                                           "trafficWeightPercent": 80,
+                                           "targetCapacityPercent": 100,
+                                           "specHash": "abcdef123456",
+                                           "applications": [{"name": "llm", "status": "RUNNING"}]},
+                   "pendingServiceStatus": {"clusterName": "democ2",
+                                            "trafficWeightPercent": 20,
+                                            "targetCapacityPercent": 40,
+                                            "specHash": "fedcba654321"}},
+    })
+    srv, url = serve_background(store)
+    print(f"DASHBOARD_URL {url}/dashboard", flush=True)
+    while True:
+        time.sleep(5)
+
+
+if __name__ == "__main__":
+    main()

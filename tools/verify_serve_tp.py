@@ -26,19 +26,27 @@ def main() -> int:
     try:
         import queue
         import threading
-        lines: "queue.Queue[str]" = queue.Queue()
-        threading.Thread(target=lambda: [lines.put(ln)
-                                         for ln in srv.stdout],
-                         daemon=True).start()
+        lines: "queue.Queue" = queue.Queue()
+
+        def _reader():
+            for ln in srv.stdout:
+                lines.put(ln)
+            lines.put(None)        # EOF sentinel: server exited
+
+        threading.Thread(target=_reader, daemon=True).start()
         deadline = time.time() + 180
         line = ""
         # Deadline-aware read: a silently hung server must fail at the
-        # deadline, not pin this script on a blocking readline().
+        # deadline, a crashed one immediately — not pin this script on a
+        # blocking readline().
         while time.time() < deadline:
             try:
-                line = lines.get(timeout=max(0.1, deadline - time.time()))
+                got = lines.get(timeout=max(0.1, deadline - time.time()))
             except queue.Empty:
                 break
+            if got is None:
+                break              # server process exited
+            line = got
             print("SRV:", line.rstrip(), flush=True)
             if "serving llama_tiny" in line:
                 break
