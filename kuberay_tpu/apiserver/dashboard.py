@@ -158,8 +158,10 @@ async function viewJob(el,ns,name){
   .concat(s.endTime?[{t:s.endTime,l:`ended (${s.jobStatus||''})`}]:[])
   .filter(x=>x.t).sort((a,b)=>a.t-b.t);
  // Step events + live log tail ride the coordinator proxy; both degrade
- // to a dim note when the cluster/coordinator is gone.
- const ev=(await getj(`/api/proxy/${encPath(ns,s.clusterName||'-')}/events?job_id=${encodeURIComponent(s.jobId||'')}&limit=200`)||{}).events;
+ // to a dim note when the cluster/coordinator is gone.  No fetch before
+ // a jobId exists — an empty filter would show every job's events.
+ const ev=s.clusterName&&s.jobId?
+  (await getj(`/api/proxy/${encPath(ns,s.clusterName)}/events?job_id=${encodeURIComponent(s.jobId)}&limit=200`)||{}).events:null;
  el.innerHTML=`
  <h2>TpuJob <span class="mono">${esc(ns)}/${esc(name)}</span>
   <span class="${cls(s.jobDeploymentStatus)}">${esc(s.jobDeploymentStatus||'')}</span></h2>
@@ -181,7 +183,7 @@ async function viewJob(el,ns,name){
  const tail=async()=>{
   const v=document.getElementById('joblog');if(!v)return;
   const r=s.clusterName&&s.jobId?
-   await getj(`/api/proxy/${encPath(ns,s.clusterName)}/jobs/${encPath(s.jobId)}/logs`):null;
+   await getj(`/api/proxy/${encPath(ns,s.clusterName)}/jobs/${encPath(s.jobId)}/logs?tail=16384`):null;
   v.textContent=r&&r.logs!==undefined?(r.logs.split('\n').slice(-40).join('\n')||'(empty)')
    :'coordinator unreachable — archived logs may be in #/history';
   v.scrollTop=v.scrollHeight};

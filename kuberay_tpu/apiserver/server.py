@@ -157,6 +157,9 @@ class ApiHandler(JsonHandler):
                 sub += "?" + q
         elif parts[2] == "jobs" and len(parts) == 5 and parts[4] == "logs":
             sub = f"/api/jobs/{parts[3]}/logs"
+            q = urlparse(self.path).query
+            if q:
+                sub += "?" + q      # tail=N passes through
         else:
             return self._error(404, "unknown proxy path")
         obj = self.store.try_get(C.KIND_CLUSTER, cluster, ns)
@@ -165,8 +168,8 @@ class ApiHandler(JsonHandler):
         addr = obj.get("status", {}).get("coordinatorAddress", "")
         if not addr:
             return self._error(503, "cluster has no coordinator address")
-        host = addr.split(":")[0]
-        url = f"http://{host}:{C.PORT_DASHBOARD}{sub}"
+        from kuberay_tpu.runtime.coordinator_client import dashboard_url
+        url = dashboard_url(addr) + sub
         headers = {}
         # Auth-enabled clusters: reuse the operator-minted token the
         # controllers/collectors use (builders/auth.read_auth_token).

@@ -153,14 +153,42 @@ class RestObjectStore:
         except NotFound:
             return None
 
+    # Chunked LIST page size (client-go reflector default): a real
+    # apiserver with many objects answers `?limit=` pages with a
+    # metadata.continue token; servers without pagination return
+    # everything in the first page and the loop exits immediately.
+    LIST_PAGE_LIMIT = 500
+
+    def _list_all(self, path: str,
+                  query: Optional[Dict[str, str]] = None) -> Dict[str, Any]:
+        """Paginated LIST: follow metadata.continue until exhausted.
+        Returns the final page's body with ALL items merged (the list
+        resourceVersion of the last page is the coherent resume point —
+        apiserver semantics for paginated lists)."""
+        q = dict(query or {})
+        q["limit"] = str(self.LIST_PAGE_LIMIT)
+        items: List[Dict[str, Any]] = []
+        while True:
+            out = self._req("GET",
+                            path + "?" + urllib.parse.urlencode(q))
+            items.extend(out.get("items", []))
+            cont = (out.get("metadata") or {}).get("continue", "")
+            if not cont:
+                break
+            # All other query params must repeat verbatim (K8s contract).
+            q["continue"] = cont
+        out["items"] = items
+        return out
+
     def list(self, kind: str, namespace: Optional[str] = None,
              labels: Optional[Dict[str, str]] = None) -> List[Dict[str, Any]]:
         # namespace=None lists ALL namespaces (ObjectStore semantics).
-        path = self._path(kind, namespace)
+        query = {}
         if labels:
-            sel = ",".join(f"{k}={v}" for k, v in labels.items())
-            path += "?" + urllib.parse.urlencode({"labelSelector": sel})
-        return self._req("GET", path).get("items", [])
+            query["labelSelector"] = ",".join(
+                f"{k}={v}" for k, v in labels.items())
+        return self._list_all(self._path(kind, namespace),
+                              query).get("items", [])
 
     def update(self, obj: Dict[str, Any], *, subresource: str = ""):
         md = obj["metadata"]
@@ -378,7 +406,7 @@ class RestObjectStore:
                 backoff = min(backoff * 2, 30.0)
 
     def _relist_kind(self, kind: str, silent: bool = False) -> str:
-        out = self._req("GET", self._path(kind, None))
+        out = self._list_all(self._path(kind, None))
         items = out.get("items", [])
         rv = (out.get("metadata") or {}).get("resourceVersion") \
             or str(out.get("resourceVersion", 0))

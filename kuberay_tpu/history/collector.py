@@ -142,10 +142,27 @@ class CoordinatorCollector:
             self.storage.put(f"{meta_prefix}/metadata.json", raw)
             n += 1
         # Structured task/step/profile events (ref eventserver.go:838) —
-        # the post-mortem replay source for /api/history/events.
+        # the post-mortem replay source for /api/history/events.  Merged
+        # by event id, NOT overwritten: the coordinator's ring is lossy
+        # (eviction, head restarts) and the archive is the durable copy.
         raw = self._get("/api/events?limit=20000")   # = full ring size
         if raw is not None:
-            self.storage.put(f"{meta_prefix}/events.json", raw)
+            try:
+                fresh = json.loads(raw).get("events", [])
+            except ValueError:
+                fresh = []
+            key = f"{meta_prefix}/events.json"
+            try:
+                old = json.loads(self.storage.get(key) or b"{}")
+                existing = old.get("events", [])
+            except ValueError:
+                existing = []
+            seen = {e.get("id") for e in existing if e.get("id")}
+            merged = existing + [e for e in fresh
+                                 if not e.get("id") or e["id"] not in seen]
+            merged.sort(key=lambda e: e.get("ts") or 0)
+            merged = merged[-100_000:]     # archive cap
+            self.storage.put(key, json.dumps({"events": merged}).encode())
             n += 1
         raw = self._get("/api/jobs/")
         if raw is None:

@@ -19,6 +19,16 @@ import urllib.request
 from typing import Any, Dict, List, Optional
 
 
+def dashboard_url(coordinator_address: str) -> str:
+    """host[:port] coordinator address -> the HTTP API base URL (the job
+    API listens on the dashboard port).  THE one derivation — builders
+    inject addresses as host:coordinator-port; every consumer (launcher,
+    serve server, apiserver proxy) must agree on this mapping."""
+    from kuberay_tpu.utils import constants as C
+    host = coordinator_address.split(":")[0]
+    return f"http://{host}:{C.PORT_DASHBOARD}"
+
+
 class CoordinatorError(Exception):
     pass
 

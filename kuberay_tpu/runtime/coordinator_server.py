@@ -218,8 +218,12 @@ class CoordinatorServer:
         # Structured task/step/profile events (ref eventserver.go:838
         # handleTaskProfileEvent): jobs/engines POST them here; the
         # history collector archives them for post-mortem replay.
-        # Bounded ring — the archive, not this buffer, is durable.
+        # Bounded ring — the archive, not this buffer, is durable.  Each
+        # event gets a unique id (boot epoch + counter) so the archive
+        # can merge scrapes across ring eviction and head restarts.
         self.events: "deque[Dict[str, Any]]" = deque(maxlen=20000)
+        self._event_boot = f"{int(time.time() * 1000):x}"
+        self._event_seq = 0
         # Device profiling (ref: Ray dashboard profile capture; here a
         # jax.profiler trace written under log_dir so the history log
         # collector archives it like any node file).
@@ -317,6 +321,8 @@ class CoordinatorServer:
                     continue
                 ev.setdefault("ts", now)
                 ev.setdefault("type", "task")
+                self._event_seq += 1
+                ev["id"] = f"{self._event_boot}-{self._event_seq}"
                 self.events.append(ev)
                 n += 1
         return n
@@ -450,15 +456,27 @@ class CoordinatorServer:
                 if self.path == "/api/jobs/":
                     return self._send(200, {"jobs": [
                         r.to_dict() for r in coord.jobs.values()]})
-                if self.path.endswith("/logs") and \
+                if self.path.split("?", 1)[0].endswith("/logs") and \
                         self.path.startswith("/api/jobs/"):
-                    jid = self.path.rsplit("/", 2)[1]
+                    import urllib.parse
+                    parts = urllib.parse.urlsplit(self.path)
+                    jid = parts.path.rsplit("/", 2)[1]
                     rec = coord.jobs.get(jid)
                     if rec is None:
                         return self._send(404, {"message": "not found"})
+                    q = urllib.parse.parse_qs(parts.query)
+                    try:
+                        tail = int((q.get("tail") or ["0"])[0] or 0)
+                    except ValueError:
+                        return self._send(400, {"message": "bad tail"})
                     text = ""
                     if rec.log_path and os.path.exists(rec.log_path):
                         with open(rec.log_path, "rb") as f:
+                            if tail > 0:
+                                # Live-tail consumers poll: read only the
+                                # last N bytes, not a multi-GB log.
+                                f.seek(0, os.SEEK_END)
+                                f.seek(max(0, f.tell() - tail))
                             text = f.read().decode(errors="replace")
                     return self._send(200, {"logs": text})
                 if self.path.startswith("/api/jobs/"):

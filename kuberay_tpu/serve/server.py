@@ -282,13 +282,11 @@ def main(argv=None):  # pragma: no cover - process wrapper
     frontend = ServeFrontend(engine)
     srv = frontend.make_server(args.host, args.port)
     if args.coordinator == "auto":
-        # Resolve from the operator-injected env (builders/pod.py):
-        # TPU_COORDINATOR_ADDRESS is host:port of the head coordinator;
-        # its HTTP API listens on the dashboard port.
+        # Resolve from the operator-injected env (builders/pod.py).
         import os as _os
+        from kuberay_tpu.runtime.coordinator_client import dashboard_url
         addr = _os.environ.get(C.ENV_COORDINATOR_ADDRESS, "")
-        args.coordinator = (f"http://{addr.split(':')[0]}:"
-                            f"{C.PORT_DASHBOARD}" if addr else "")
+        args.coordinator = dashboard_url(addr) if addr else ""
     if args.coordinator:
         register_with_coordinator(args.app_name, args.coordinator)
     print(f"serving {args.model} on {args.host}:{args.port} "

@@ -146,10 +146,11 @@ def train(args) -> int:
     event_client = None
     if ident.worker_id == 0 and ident.slice_id == 0 and \
             os.environ.get(C.ENV_COORDINATOR_ADDRESS):
-        from kuberay_tpu.runtime.coordinator_client import CoordinatorClient
-        host = os.environ[C.ENV_COORDINATOR_ADDRESS].split(":")[0]
+        from kuberay_tpu.runtime.coordinator_client import (
+            CoordinatorClient, dashboard_url)
         event_client = CoordinatorClient(
-            f"http://{host}:{C.PORT_DASHBOARD}", timeout=2.0)
+            dashboard_url(os.environ[C.ENV_COORDINATOR_ADDRESS]),
+            timeout=2.0)
     job_id = os.environ.get("TPU_JOB_ID", "train")
 
     start_step = int(state["step"])

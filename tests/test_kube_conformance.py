@@ -1,0 +1,506 @@
+"""Conformance against a SIMULATED REAL kube-apiserver.
+
+The repo's own apiserver speaks the watch protocol, but testing the
+client against it alone is self-conformance.  ``SimKube`` here mimics the
+quirks a real kube-apiserver + etcd exhibits that the in-house server
+does not (ref envtest role, suite_test.go:78):
+
+- **non-contiguous string resourceVersions** (etcd revisions jump);
+- **RFC3339 creationTimestamp strings** and ``managedFields`` blobs in
+  metadata (server-side bookkeeping the client must tolerate);
+- **chunked LIST**: honors ``?limit=`` and answers with
+  ``metadata.continue`` tokens + ``remainingItemCount``;
+- **bounded watch history**: events older than the window are evicted;
+  resuming from an evicted rv yields the K8s ERROR line
+  ``{"type":"ERROR","object":{"kind":"Status","code":410}}``;
+- **bookmarks** on an interval, not only at quiet moments;
+- 409s carrying "already exists" vs rv-conflict messages.
+
+The final test drives the REAL cluster controller over a RestObjectStore
+against SimKube and forces a mid-reconcile 410 relist: the done-criterion
+is no double-created slice pods (VERDICT r2 item 7).
+"""
+
+import itertools
+import json
+import re
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from datetime import datetime, timezone
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from kuberay_tpu.controlplane.rest_store import RestObjectStore
+from kuberay_tpu.utils import constants as C
+from tests.test_api_types import make_cluster
+
+_PLURAL_TO_KIND = {**{v: k for k, v in C.CRD_PLURALS.items()},
+                   **{v: k for k, v in C.CORE_PLURALS.items()}}
+
+
+def _now_rfc3339():
+    return datetime.now(timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
+
+
+class SimKube:
+    """In-memory kube-apiserver lookalike (see module docstring)."""
+
+    def __init__(self, history_window: int = 64, bookmark_every: float = 0.2,
+                 page_limit_cap: int = 10_000):
+        self.cond = threading.Condition()
+        self.objs = {}                  # (kind, ns, name) -> obj
+        self._rv = 1000
+        self._uid = itertools.count(1)
+        self.history = []               # (rv:int, type, obj snapshot)
+        self.window = history_window
+        self.evicted_through = 0        # max rv dropped from history
+        self.bookmark_every = bookmark_every
+        self.page_limit_cap = page_limit_cap
+
+    # -- state ---------------------------------------------------------
+
+    def _bump(self) -> int:
+        # etcd revisions are shared across kinds and jump unpredictably.
+        self._rv += 3 + (self._rv % 5)
+        return self._rv
+
+    def _record(self, etype: str, obj: dict):
+        self.history.append((int(obj["metadata"]["resourceVersion"]),
+                             etype, json.loads(json.dumps(obj))))
+        while len(self.history) > self.window:
+            rv, _, _ = self.history.pop(0)
+            self.evicted_through = max(self.evicted_through, rv)
+        self.cond.notify_all()
+
+    def create(self, kind, ns, obj):
+        name = obj.get("metadata", {}).get("name", "")
+        key = (kind, ns, name)
+        with self.cond:
+            if key in self.objs:
+                return None
+            md = obj.setdefault("metadata", {})
+            md["namespace"] = ns
+            md["uid"] = f"sim-{next(self._uid)}"
+            md["resourceVersion"] = str(self._bump())
+            md["creationTimestamp"] = _now_rfc3339()
+            md["managedFields"] = [{
+                "manager": "simkube", "operation": "Update",
+                "apiVersion": obj.get("apiVersion", "v1"),
+                "time": md["creationTimestamp"]}]
+            obj["kind"] = kind
+            self.objs[key] = obj
+            self._record("ADDED", obj)
+            return obj
+
+    def update(self, kind, ns, name, body, status_only=False):
+        key = (kind, ns, name)
+        with self.cond:
+            cur = self.objs.get(key)
+            if cur is None:
+                return None, 404
+            sent_rv = body.get("metadata", {}).get("resourceVersion")
+            if sent_rv and sent_rv != cur["metadata"]["resourceVersion"]:
+                return None, 409
+            if status_only:
+                cur["status"] = body.get("status", {})
+            else:
+                preserved = {k: cur["metadata"][k] for k in
+                             ("uid", "creationTimestamp", "managedFields")}
+                cur.update({k: v for k, v in body.items()
+                            if k != "metadata"})
+                cur["metadata"] = {**body.get("metadata", {}), **preserved,
+                                   "namespace": ns}
+            cur["metadata"]["resourceVersion"] = str(self._bump())
+            self._record("MODIFIED", cur)
+            return cur, 200
+
+    def delete(self, kind, ns, name):
+        with self.cond:
+            obj = self.objs.pop((kind, ns, name), None)
+            if obj is None:
+                return False
+            obj["metadata"]["resourceVersion"] = str(self._bump())
+            self._record("DELETED", obj)
+            return True
+
+    # -- HTTP ------------------------------------------------------------
+
+    def make_server(self):
+        sim = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code, body):
+                data = json.dumps(body).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _route(self):
+                path = urllib.parse.urlsplit(self.path).path
+                m = re.match(
+                    r"^/(?:apis/tpu\.dev/v1|api/v1)"
+                    r"(?:/namespaces/(?P<ns>[^/]+))?/(?P<plural>[^/]+)"
+                    r"(?:/(?P<name>[^/]+))?(?:/(?P<sub>status))?$", path)
+                if not m or m.group("plural") not in _PLURAL_TO_KIND:
+                    return None
+                return (_PLURAL_TO_KIND[m.group("plural")], m.group("ns"),
+                        m.group("name"), m.group("sub"))
+
+            def do_GET(self):
+                r = self._route()
+                if r is None:
+                    return self._send(404, {"message": "unknown path"})
+                kind, ns, name, _ = r
+                q = urllib.parse.parse_qs(
+                    urllib.parse.urlsplit(self.path).query)
+                if name:
+                    with sim.cond:
+                        obj = sim.objs.get((kind, ns, name))
+                    if obj is None:
+                        return self._send(404, {"message": "not found"})
+                    return self._send(200, obj)
+                if q.get("watch", ["false"])[0] in ("true", "1"):
+                    return self._watch(kind, ns, q)
+                return self._list(kind, ns, q)
+
+            def _list(self, kind, ns, q):
+                sel = {}
+                for part in (q.get("labelSelector") or [""])[0].split(","):
+                    if "=" in part:
+                        k, v = part.split("=", 1)
+                        sel[k] = v
+                with sim.cond:
+                    rows = sorted(
+                        (o for (k, n, _nm), o in sim.objs.items()
+                         if k == kind and (ns is None or n == ns)
+                         and all(o["metadata"].get("labels", {})
+                                 .get(sk) == sv for sk, sv in sel.items())),
+                        key=lambda o: o["metadata"]["name"])
+                    rv = str(sim._rv)
+                limit = min(int((q.get("limit") or [0])[0] or 0)
+                            or sim.page_limit_cap, sim.page_limit_cap)
+                offset = int((q.get("continue") or ["0"])[0] or 0)
+                page = rows[offset:offset + limit]
+                meta = {"resourceVersion": rv}
+                if offset + limit < len(rows):
+                    meta["continue"] = str(offset + limit)
+                    meta["remainingItemCount"] = len(rows) - offset - limit
+                return self._send(200, {
+                    "kind": f"{kind}List", "apiVersion": "v1",
+                    "metadata": meta, "items": page})
+
+            def _watch(self, kind, ns, q):
+                try:
+                    rv = int((q.get("resourceVersion") or ["0"])[0] or 0)
+                except ValueError:
+                    return self._send(400, {"message": "bad rv"})
+                hold = float((q.get("timeoutSeconds") or ["5"])[0])
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def emit(doc) -> bool:
+                    data = json.dumps(doc).encode() + b"\n"
+                    try:
+                        self.wfile.write(
+                            f"{len(data):x}\r\n".encode() + data + b"\r\n")
+                        self.wfile.flush()
+                        return True
+                    except OSError:
+                        return False
+
+                deadline = time.time() + hold
+                last_bookmark = time.time()
+                with sim.cond:
+                    if rv and rv < sim.evicted_through:
+                        emit({"type": "ERROR", "object": {
+                            "kind": "Status", "apiVersion": "v1",
+                            "status": "Failure", "reason": "Expired",
+                            "code": 410,
+                            "message": "too old resource version"}})
+                        try:
+                            self.wfile.write(b"0\r\n\r\n")
+                        except OSError:
+                            pass
+                        return
+                    while time.time() < deadline:
+                        if rv and rv < sim.evicted_through:
+                            # Slow CONNECTED watcher fell behind the
+                            # cache window: real apiservers terminate it
+                            # with the 410 Status line mid-stream.
+                            emit({"type": "ERROR", "object": {
+                                "kind": "Status", "apiVersion": "v1",
+                                "status": "Failure", "reason": "Expired",
+                                "code": 410,
+                                "message": "too old resource version"}})
+                            break
+                        sent_any = False
+                        for erv, etype, obj in sim.history:
+                            if erv <= rv or obj["kind"] != kind:
+                                continue
+                            if not emit({"type": etype, "object": obj}):
+                                return
+                            rv = erv
+                            sent_any = True
+                        if not sent_any and \
+                                time.time() - last_bookmark >= \
+                                sim.bookmark_every:
+                            # Real apiservers bookmark on an interval
+                            # with the GLOBAL rv, not this kind's last.
+                            if not emit({"type": "BOOKMARK", "object": {
+                                    "kind": kind, "metadata": {
+                                        "resourceVersion": str(sim._rv)}}}):
+                                return
+                            rv = max(rv, sim._rv)
+                            last_bookmark = time.time()
+                        sim.cond.wait(timeout=0.05)
+                try:
+                    self.wfile.write(b"0\r\n\r\n")
+                except OSError:
+                    pass
+
+            def do_POST(self):
+                r = self._route()
+                if r is None:
+                    return self._send(404, {"message": "unknown path"})
+                kind, ns, _, _ = r
+                body = json.loads(self.rfile.read(
+                    int(self.headers.get("Content-Length", 0))))
+                obj = sim.create(kind, ns or "default", body)
+                if obj is None:
+                    return self._send(409, {
+                        "message": f"{kind} already exists"})
+                return self._send(201, obj)
+
+            def do_PUT(self):
+                r = self._route()
+                if r is None:
+                    return self._send(404, {"message": "unknown path"})
+                kind, ns, name, sub = r
+                body = json.loads(self.rfile.read(
+                    int(self.headers.get("Content-Length", 0))))
+                obj, code = sim.update(kind, ns or "default", name, body,
+                                       status_only=(sub == "status"))
+                if code == 404:
+                    return self._send(404, {"message": "not found"})
+                if code == 409:
+                    return self._send(409, {
+                        "message": "Operation cannot be fulfilled: "
+                                   "object has been modified"})
+                return self._send(200, obj)
+
+            def do_DELETE(self):
+                r = self._route()
+                if r is None:
+                    return self._send(404, {"message": "unknown path"})
+                kind, ns, name, _ = r
+                if not sim.delete(kind, ns or "default", name):
+                    return self._send(404, {"message": "not found"})
+                return self._send(200, {"status": "Success"})
+
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        return srv, f"http://127.0.0.1:{srv.server_port}"
+
+
+def wait_for(fn, timeout=30.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+@pytest.fixture
+def sim():
+    s = SimKube()
+    srv, url = s.make_server()
+    yield s, url
+    srv.shutdown()
+
+
+# -- raw-protocol conformance -------------------------------------------
+
+
+def test_chunked_list_followed_across_pages(sim):
+    s, url = sim
+    for i in range(7):
+        s.create("Pod", "default", {"apiVersion": "v1",
+                                    "metadata": {"name": f"p{i}"}})
+    # Raw: one page of 3 carries a continue token + remaining count.
+    page = json.load(urllib.request.urlopen(
+        f"{url}/api/v1/namespaces/default/pods?limit=3"))
+    assert len(page["items"]) == 3
+    assert page["metadata"]["continue"]
+    assert page["metadata"]["remainingItemCount"] == 4
+    # Client: RestObjectStore.list transparently follows the chain.
+    store = RestObjectStore(url)
+    store.LIST_PAGE_LIMIT = 3
+    names = sorted(p["metadata"]["name"] for p in store.list("Pod"))
+    assert names == [f"p{i}" for i in range(7)]
+
+
+def test_metadata_quirks_tolerated(sim):
+    """String timestamps, managedFields, non-contiguous string rvs —
+    the client must round-trip them untouched."""
+    s, url = sim
+    store = RestObjectStore(url)
+    created = store.create(make_cluster(name="quirk").to_dict())
+    md = created["metadata"]
+    assert re.match(r"\d{4}-\d{2}-\d{2}T", md["creationTimestamp"])
+    assert md["managedFields"][0]["manager"] == "simkube"
+    rv1 = int(md["resourceVersion"])
+    got = store.get(C.KIND_CLUSTER, "quirk")
+    got["spec"]["suspend"] = True
+    rv2 = int(store.update(got)["metadata"]["resourceVersion"])
+    assert rv2 > rv1 + 1          # rvs jump; nothing may assume +1
+
+
+def test_stale_rv_update_conflicts(sim):
+    from kuberay_tpu.controlplane.store import Conflict
+    s, url = sim
+    store = RestObjectStore(url)
+    store.create(make_cluster(name="cas").to_dict())
+    a = store.get(C.KIND_CLUSTER, "cas")
+    b = store.get(C.KIND_CLUSTER, "cas")
+    a["spec"]["suspend"] = True
+    store.update(a)
+    b["spec"]["suspend"] = False
+    with pytest.raises(Conflict):
+        store.update(b)            # stale rv -> 409 rv-conflict
+
+
+def test_watch_bookmarks_advance_resume_point(sim):
+    """Interval bookmarks must advance the client's resume rv so a
+    reconnect does not replay (or 410) — even with zero real events for
+    the watched kind while OTHER kinds churn the global rv."""
+    s, url = sim
+    s.bookmark_every = 0.05
+    store = RestObjectStore(url, watched_kinds=("TpuCluster",),
+                            poll_interval=0.05)
+    seen = []
+    store.watch(seen.append)    # blocks until cache sync
+    # Churn a DIFFERENT kind past the history window: without bookmark
+    # handling the TpuCluster watcher's rv would fall behind and 410.
+    for i in range(s.window + 20):
+        s.create("Pod", "default", {"apiVersion": "v1",
+                                    "metadata": {"name": f"churn{i}"}})
+    time.sleep(0.6)                # several bookmark intervals
+    s.create("TpuCluster", "default",
+             make_cluster(name="after-churn").to_dict())
+    assert wait_for(lambda: any(
+        e.obj["metadata"]["name"] == "after-churn" for e in seen))
+    store.close()
+
+
+def test_watch_410_recovery_emits_missed_diff_once(sim):
+    """An evicted resume rv must yield exactly one ADDED per missed
+    object after the relist — no duplicates, no misses."""
+    s, url = sim
+    s.window = 4                   # tiny history: easy to evict
+    s.bookmark_every = 3600        # no bookmarks: force the 410 path
+    store = RestObjectStore(url, watched_kinds=("TpuCluster",),
+                            poll_interval=0.05)
+    seen = []
+    store.watch(seen.append)    # blocks until cache sync
+    s.create("TpuCluster", "default", make_cluster(name="pre").to_dict())
+    assert wait_for(lambda: len(seen) >= 1)
+    # Hold the watcher's rv behind while evicting: churn pods far past
+    # the window, then add clusters the stream may or may not deliver
+    # before expiry — the client must converge either way.
+    for i in range(20):
+        s.create("Pod", "default", {"apiVersion": "v1",
+                                    "metadata": {"name": f"evict{i}"}})
+    s.create("TpuCluster", "default", make_cluster(name="missed").to_dict())
+    for i in range(20, 40):
+        s.create("Pod", "default", {"apiVersion": "v1",
+                                    "metadata": {"name": f"evict{i}"}})
+    assert wait_for(lambda: sum(
+        1 for e in seen if e.kind == "TpuCluster"
+        and e.obj["metadata"]["name"] == "missed") >= 1, timeout=20)
+    time.sleep(1.0)                # settle: catch any late duplicates
+    adds = [e for e in seen if e.type == "ADDED"
+            and e.obj["metadata"]["name"] == "missed"]
+    assert len(adds) == 1, f"missed object delivered {len(adds)} times"
+    store.close()
+
+
+# -- the done-criterion: full controller over SimKube through a 410 ------
+
+
+@pytest.mark.timeout(120)
+def test_cluster_controller_survives_forced_relist(sim):
+    """The REAL cluster controller reconciles a slice over SimKube; a
+    mid-reconcile watch expiry (tiny history + churn) forces a relist.
+    Slice pods must not be double-created (VERDICT r2 item 7)."""
+    from kuberay_tpu.controlplane.cluster_controller import (
+        TpuClusterController,
+    )
+    from kuberay_tpu.controlplane.fake_kubelet import FakeKubelet
+    from kuberay_tpu.controlplane.manager import Manager, owned_pod_mapper
+
+    s, url = sim
+    s.window = 6                   # aggressive eviction
+    s.bookmark_every = 3600
+    store = RestObjectStore(url, poll_interval=0.05)
+    manager = Manager(store)
+    ctrl = TpuClusterController(store,
+                                expectations=manager.expectations)
+    manager.register(C.KIND_CLUSTER, ctrl.reconcile)
+    manager.map_owned(owned_pod_mapper)
+    kubelet = FakeKubelet(store)
+
+    c = make_cluster(name="relist", accelerator="v5p", topology="2x2x2",
+                     replicas=1)       # 8 chips / 4 per host = 2-host slice
+    store.create(c.to_dict())
+
+    def settle(rounds=6):
+        for _ in range(rounds):
+            manager.flush_delayed()
+            manager.run_until_idle()
+            kubelet.step()
+
+    def worker_pods():
+        return [p for p in store.list("Pod", "default")
+                if p["metadata"].get("labels", {})
+                .get(C.LABEL_CLUSTER) == "relist"
+                and p["metadata"]["labels"]
+                .get(C.LABEL_NODE_TYPE) == "worker"]
+
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        settle()
+        # Churn: evict watch history WHILE the controller reconciles, so
+        # its informer path has to relist mid-flight.
+        for i in range(8):
+            s.create("Event", "default", {
+                "apiVersion": "v1",
+                "metadata": {"name": f"churn-{time.time()}-{i}"},
+                "reason": "Noise"})
+        obj = store.try_get(C.KIND_CLUSTER, "relist")
+        if obj and obj.get("status", {}).get("state") == "ready":
+            break
+    assert store.get(C.KIND_CLUSTER, "relist")["status"]["state"] == "ready"
+
+    # Let relists + requeues settle, then assert the invariant.
+    for _ in range(5):
+        settle()
+        time.sleep(0.2)
+    pods = worker_pods()
+    assert len(pods) == 2, [p["metadata"]["name"] for p in pods]
+    names = [p["metadata"]["name"] for p in pods]
+    assert len(set(names)) == 2
+    store.close()
