@@ -157,13 +157,24 @@ class CoordinatorCollector:
                 existing = old.get("events", [])
             except ValueError:
                 existing = []
-            seen = {e.get("id") for e in existing if e.get("id")}
-            merged = existing + [e for e in fresh
-                                 if not e.get("id") or e["id"] not in seen]
-            merged.sort(key=lambda e: e.get("ts") or 0)
-            merged = merged[-100_000:]     # archive cap
-            self.storage.put(key, json.dumps({"events": merged}).encode())
-            n += 1
+
+            def ekey(e):
+                # id when present; a content tuple otherwise (id-less
+                # events from an older coordinator must still dedup
+                # across scrapes, not re-append every interval).
+                return e.get("id") or (e.get("ts"), e.get("type"),
+                                       e.get("name"), e.get("job_id"))
+            seen = {ekey(e) for e in existing}
+            new = [e for e in fresh if ekey(e) not in seen]
+            if new:
+                merged = existing + new
+                merged.sort(key=lambda e: e.get("ts") or 0)
+                merged = merged[-100_000:]     # archive cap
+                self.storage.put(key,
+                                 json.dumps({"events": merged}).encode())
+                n += 1
+            # No fresh events: the archived copy is already current —
+            # skip the rewrite (a full 100k-event PUT per idle poll).
         raw = self._get("/api/jobs/")
         if raw is None:
             return n

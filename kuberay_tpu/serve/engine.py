@@ -93,6 +93,8 @@ class NgramIndex:
         self.maps = {n: {} for n in range(1, ngram + 1)}
         self.indexed = 0         # history length already processed
 
+    PRUNE_EVERY = 1024         # amortized out-of-window eviction cadence
+
     def extend(self, hist: List[int]) -> None:
         L = len(hist)
         for n, m in self.maps.items():
@@ -100,6 +102,15 @@ class NgramIndex:
             # "latest occurrence wins".
             for k in range(max(0, self.indexed - n), L - n):
                 m[tuple(hist[k:k + n])] = k
+        # Evict entries whose latest occurrence fell behind the lookup
+        # window — draft() already ignores them, so dropping them only
+        # bounds memory (ADVICE r2: the maps otherwise grow with the
+        # full history).  Amortized: one scan per PRUNE_EVERY tokens.
+        if L // self.PRUNE_EVERY > self.indexed // self.PRUNE_EVERY:
+            floor = L - self.window
+            for m in self.maps.values():
+                for key in [t for t, k in m.items() if k < floor]:
+                    del m[key]
         self.indexed = L
 
     def draft(self, hist: List[int], gamma: int) -> List[int]:
@@ -116,6 +127,11 @@ class NgramIndex:
 class ServeEngine:
     SPEC_MISS_LIMIT = 3        # consecutive full-rejects before backoff
     SPEC_PROBE_EVERY = 8       # steps between probes while backed off
+    # Batch-level gate: verify costs every ACTIVE slot a (γ+1)-token
+    # forward, so one repetitive request must not tax the whole batch —
+    # speculate only when at least this fraction of active slots drafted
+    # (ADVICE r2: bounds the amplification a single slot can cause).
+    SPEC_MIN_DRAFT_FRACTION = 0.25
 
     def __init__(self, cfg: LlamaConfig, params: Dict[str, Any],
                  max_slots: int = 8, max_len: int = 2048,
@@ -439,7 +455,10 @@ class ServeEngine:
                 mask[i] = 1.0
         if self.speculative > 0:
             drafts = self._build_drafts()
-            if any(drafts):
+            drafting = sum(1 for d in drafts if d)
+            active = max(1, self.num_active)
+            if drafting and \
+                    drafting >= active * self.SPEC_MIN_DRAFT_FRACTION:
                 return self._spec_decode_all(last, temps, mask, drafts)
         self.key, sub = jax.random.split(self.key)
         toks = np.asarray(self._decode_call(last, temps, mask, sub))

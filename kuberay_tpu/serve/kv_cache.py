@@ -143,20 +143,48 @@ def _insert_scales(cs, new_s, positions, start, write_mask, T):
     return cs * keep[:, None, :] + jnp.einsum("btm,bth->bhm", onehot, new_s)
 
 
+def _cached_attention_quant_multi(q, ckv, cvv, lens, q_positions):
+    """T>1 attention straight off the int8 cache (chunked prefill /
+    speculative verify hot path): scales fold into score columns and
+    probability rows, the int8→f32 converts fuse into the dots, and —
+    unlike dequantize-then-attend — no full bf16 copy of the cache is
+    ever materialized (ADVICE r2: that copy ran per chunk / per verify
+    step, negating the int8 bandwidth win).  GQA via a grouped einsum
+    instead of repeating the cache."""
+    B, T, Hq, D = q.shape
+    kq, ks = ckv["q"], ckv["s"]        # [B, M, Hkv, D] i8, [B, Hkv, M] f32
+    vq, vs = cvv["q"], cvv["s"]
+    Hkv = kq.shape[2]
+    G = Hq // Hkv
+    qg = q.reshape(B, T, Hkv, G, D)
+    s = jnp.einsum("btngd,bmnd->bntgm", qg.astype(jnp.float32),
+                   kq.astype(jnp.float32),
+                   preferred_element_type=jnp.float32)
+    s = s * ks[:, :, None, None, :] / (D ** 0.5)
+    cols = jnp.arange(kq.shape[1])[None, None, :]
+    mask = (cols <= q_positions[:, :, None]) & \
+        (cols < lens[:, None, None])                    # [B, T, M]
+    s = jnp.where(mask[:, None, :, None, :], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bntgm,bmnd->btngd",
+                     p * vs[:, :, None, None, :],
+                     vq.astype(jnp.float32),
+                     preferred_element_type=jnp.float32)
+    return out.reshape(B, T, Hq, D).astype(q.dtype)
+
+
 def make_quantized_forward(base_forward=None, decode_impl: str = "auto",
                            mesh=None):
     """Wrap a cache forward with int8 K/V storage (init_kv_cache
     quant="int8" layout).  Same seam as make_paged_forward: this wrapper
     contributes a ``kv_update`` that quantizes on write, and an
-    ``attention`` that consumes the int8 cache natively on the decode
-    hot path (ops/decode_attention.decode_attention_quant streams HALF
-    the bf16 kernel's HBM bytes; scales fold into score columns and
-    probability rows).  Prefill (T > 1) reads a dequantized view — it
-    runs once per prompt."""
-    from kuberay_tpu.ops.decode_attention import (
-        decode_attention_quant,
-        dequant_lanes,
-    )
+    ``attention`` that consumes the int8 cache natively on BOTH paths:
+    decode (T == 1) via ops/decode_attention.decode_attention_quant
+    (streams HALF the bf16 kernel's HBM bytes), and multi-token calls
+    (chunked prefill, speculative verify) via
+    ``_cached_attention_quant_multi`` — scales fold into score columns
+    and probability rows, never materializing a dequantized cache."""
+    from kuberay_tpu.ops.decode_attention import decode_attention_quant
     base = base_forward or forward_with_cache
 
     def fwd(cfg, params, tokens, cache, start, write_mask=None,
@@ -184,10 +212,8 @@ def make_quantized_forward(base_forward=None, decode_impl: str = "auto",
                     q[:, 0], ckv["q"], ckv["s"], cvv["q"], cvv["s"],
                     lens, impl=decode_impl)
                 return out[:, None]
-            return _cached_attention(
-                q, dequant_lanes(ckv["q"], ckv["s"], cfg.dtype),
-                dequant_lanes(cvv["q"], cvv["s"], cfg.dtype),
-                lens, q_positions)
+            return _cached_attention_quant_multi(q, ckv, cvv, lens,
+                                                 q_positions)
 
         if mesh is not None:
             # Tensor-parallel: each chip runs the int8 kernel on its

@@ -131,3 +131,46 @@ def test_fewer_device_steps_with_speculation():
     seq = count_steps(make_engine())
     spec = count_steps(make_engine(speculative=4))
     assert spec < seq
+
+
+def test_ngram_index_prunes_out_of_window_entries():
+    """Index memory is bounded by the lookup window, not the full
+    history (ADVICE r2): out-of-window entries are evicted on the
+    amortized prune pass, and drafting semantics are unchanged."""
+    from kuberay_tpu.serve.engine import NgramIndex, prompt_lookup_draft
+
+    idx = NgramIndex(ngram=3, window=256)
+    hist = [(i * 7 + i // 5) % 50 for i in range(4096)]   # varied tokens
+    idx.extend(hist)
+    for n, m in idx.maps.items():
+        stale = [k for k in m.values() if k < len(hist) - 256 - 1024]
+        # Everything older than window + one prune period is gone.
+        assert not stale, (n, len(stale))
+    assert idx.draft(hist, 4) == prompt_lookup_draft(hist, 4, window=256)
+
+
+def test_verify_gated_on_drafting_fraction():
+    """One repetitive request among many must not route the whole batch
+    through the (γ+1)-token verify forward (ADVICE r2 batch-level
+    amplification): below SPEC_MIN_DRAFT_FRACTION the engine decodes
+    normally and the drafts are discarded."""
+    cfg = CONFIGS["llama_tiny"]
+    params = init_params(cfg, jax.random.PRNGKey(0))
+
+    def run_with_drafts(drafting_slots):
+        eng = ServeEngine(cfg, params, max_slots=5, max_len=256,
+                          speculative=4)
+        for i in range(5):
+            eng.add_request(Request(f"r{i}", list(range(3 + i, 13 + i)),
+                                    max_new_tokens=4))
+        # Deterministic drafts (real drafting depends on the random
+        # model's repetition): the named slots always draft, others never.
+        eng._build_drafts = lambda: [
+            [1, 2] if i in drafting_slots else [] for i in range(5)]
+        eng.run()
+        return eng.spec_stats["verify_steps"]
+
+    # 1/5 = 0.2 < 0.25: gated — normal decode, drafts discarded.
+    assert run_with_drafts({0}) == 0
+    # 2/5 = 0.4 >= 0.25: verify path runs.
+    assert run_with_drafts({0, 3}) > 0
