@@ -159,11 +159,11 @@ class CoordinatorCollector:
                 existing = []
 
             def ekey(e):
-                # id when present; a content tuple otherwise (id-less
-                # events from an older coordinator must still dedup
-                # across scrapes, not re-append every interval).
-                return e.get("id") or (e.get("ts"), e.get("type"),
-                                       e.get("name"), e.get("job_id"))
+                # id when present; the full content otherwise (id-less
+                # events from an older coordinator must dedup across
+                # scrapes without dropping distinct same-timestamp
+                # events that differ only in payload).
+                return e.get("id") or json.dumps(e, sort_keys=True)
             seen = {ekey(e) for e in existing}
             new = [e for e in fresh if ekey(e) not in seen]
             if new:

@@ -51,6 +51,12 @@ class LlamaConfig:
     # (parallel/ring_pallas.py) overlapping exchange with compute.
     attn_impl: str = "auto"
     remat: bool = True
+    # Remat granularity (docs/roofline_llama1b.md): "full" checkpoints
+    # whole layers (max memory savings; re-runs the whole fwd in bwd —
+    # ~25% of reported-MFU headroom at the bench shape); "dots" saves
+    # matmul outputs and recomputes only cheap elementwise ops (less
+    # memory headroom, higher useful-FLOPs MFU).
+    remat_policy: str = "full"
     # Vocab-chunked cross entropy (ops/xent.py): 0 = dense logits.  Set
     # for large-vocab configs — the [B,S,V] f32 logits tensor is the
     # single largest training activation at Llama-3 scale.
@@ -197,7 +203,16 @@ def forward_hidden(cfg: LlamaConfig, params: Dict[str, Any],
 
     layer_fn = lambda x, lp: (_layer(cfg, x, lp, cos, sin, mesh), None)
     if cfg.remat:
-        layer_fn = jax.checkpoint(layer_fn, prevent_cse=False)
+        if cfg.remat_policy == "dots":
+            policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        elif cfg.remat_policy == "full":
+            policy = None
+        else:
+            raise ValueError(
+                f"unknown remat_policy {cfg.remat_policy!r} "
+                f"(expected 'full' or 'dots')")
+        layer_fn = jax.checkpoint(layer_fn, prevent_cse=False,
+                                  policy=policy)
     x, _ = jax.lax.scan(layer_fn, x, params["layers"])
 
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)

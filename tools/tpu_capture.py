@@ -211,6 +211,12 @@ def main() -> int:
     py = sys.executable
     steps = [
         ("bench_train", [py, "bench.py"], 560, None),
+        # The two tuning levers from docs/roofline_llama1b.md, right
+        # after the baseline so a short window still compares them:
+        ("bench_train_remat_dots", [py, "bench.py"], 560,
+         {"BENCH_REMAT_POLICY": "dots"}),
+        ("bench_train_bkv1024", [py, "bench.py"], 560,
+         {"TPU_FLASH_BKV": "1024"}),
         ("bench_op", [py, "bench.py", "--op"], 400, None),
         ("decode_kernel", [py, "-c", DECODE_SNIPPET], 400, None),
         ("paged_kernel", [py, "-c", PAGED_SNIPPET], 500, None),
