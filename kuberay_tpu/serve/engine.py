@@ -125,6 +125,12 @@ class NgramIndex:
 
 
 class ServeEngine:
+    # Subclasses that route every jitted kernel through their own forward
+    # (the paged engine's _paged_fwd) set this False so __init__ doesn't
+    # wrap self._forward with DENSE-layout tp attention specs — wrong
+    # against their cache layout if anything ever called it.
+    USES_BASE_FORWARD = True
+
     SPEC_MISS_LIMIT = 3        # consecutive full-rejects before backoff
     SPEC_PROBE_EVERY = 8       # steps between probes while backed off
     # Batch-level gate: verify costs every ACTIVE slot a (γ+1)-token
@@ -184,7 +190,7 @@ class ServeEngine:
             self._forward = make_quantized_forward(self._forward,
                                                    decode_impl=decode_impl,
                                                    mesh=mesh)
-        elif mesh is not None:
+        elif mesh is not None and self.USES_BASE_FORWARD:
             # Pallas kernels are invisible to the SPMD partitioner; route
             # attention through the shard_map wrapper so each chip runs
             # the stock kernel on its local head shard.
@@ -200,12 +206,15 @@ class ServeEngine:
             self._forward = fwd
         if mesh is not None:
             from kuberay_tpu.serve.sharding import (
-                shard_engine_state, validate_tp)
+                param_shardings, validate_tp)
             validate_tp(cfg, mesh)
-            # Pass the cache INITIALIZER, not a materialized cache — a
-            # flagship-sized cache must come into existence sharded.
-            self.params, self.cache, self._cache_sh = shard_engine_state(
-                cfg, self.params, self._init_cache, mesh, kv_quant)
+            self._cache_sh = self._cache_sharding_tree(mesh)
+            self.params = jax.device_put(self.params,
+                                         param_shardings(cfg, mesh))
+            # jit the INITIALIZER with sharded outputs — a flagship-sized
+            # cache must come into existence sharded, never whole.
+            self.cache = jax.jit(self._init_cache,
+                                 out_shardings=self._cache_sh)()
         self.key = jax.random.PRNGKey(rng_seed)
 
         # Slot bookkeeping (host side).
@@ -237,6 +246,11 @@ class ServeEngine:
     def _init_cache(self):
         return init_kv_cache(self.cfg, self.max_slots, self.max_len,
                              quant=self.kv_quant)
+
+    def _cache_sharding_tree(self, mesh):
+        """Shardings matching _init_cache's layout (paged overrides)."""
+        from kuberay_tpu.serve.sharding import cache_shardings
+        return cache_shardings(self.cfg, mesh, self.kv_quant)
 
     # ------------------------------------------------------------------
     # jitted kernels

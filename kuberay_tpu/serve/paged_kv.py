@@ -183,7 +183,7 @@ def _physical_positions(block_tables, positions, block_size):
 
 
 def make_paged_forward(block_size: int, base_forward=None,
-                       decode_impl: str = "auto"):
+                       decode_impl: str = "auto", mesh=None):
     """Paged counterpart of kv_cache.forward_with_cache for a fixed
     block size (compile-time structure, like the mesh in pjit).
 
@@ -249,11 +249,31 @@ def make_paged_forward(block_size: int, base_forward=None,
                 gather_view(cv, block_tables, block_size)
 
         if T == 1:
-            def attention(q, pk, pv, lens, q_positions):
+            def local_decode(q, pk, pv, lens, tables):
                 out = paged_decode_attention(
-                    q[:, 0], pk, pv, lens, block_tables, block_size,
+                    q[:, 0], pk, pv, lens, tables, block_size,
                     impl=decode_impl)
                 return out[:, None]
+
+            if mesh is None:
+                def attention(q, pk, pv, lens, q_positions):
+                    return local_decode(q, pk, pv, lens, block_tables)
+            else:
+                # Tensor parallel: the paged Pallas kernel is invisible
+                # to the SPMD partitioner — each chip runs it on its
+                # local kv-head shard of the pool, with the full block
+                # table (specs live in serve/sharding.py).
+                from kuberay_tpu.serve.sharding import (
+                    make_tp_paged_attention)
+                fn = make_tp_paged_attention(mesh, local_decode)
+
+                def attention(q, pk, pv, lens, q_positions):
+                    return fn(q, pk, pv, lens, block_tables)
+        elif mesh is not None:
+            # Prefill on gathered per-request views: the stock sharded
+            # dense attention (views inherit the pool's kv-head split).
+            from kuberay_tpu.serve.sharding import make_tp_attention
+            attention = make_tp_attention(mesh)
         else:
             attention = None              # dense masked attention on views
 

@@ -216,10 +216,6 @@ def main(argv=None):  # pragma: no cover - process wrapper
     if args.paged and args.kv_quant != "none":
         ap.error("--kv-quant is not supported with --paged yet "
                  "(dense engine only)")
-    if args.paged and args.tp != 1:
-        ap.error("--tp is not supported with --paged yet "
-                 "(dense engine only)")
-
     # Slice identity: same env contract as the training launcher
     # (TPU_WORKER_ID / TPU_WORKER_HOSTNAMES injected by builders/pod.py).
     from kuberay_tpu.train.launcher import (
@@ -237,11 +233,11 @@ def main(argv=None):  # pragma: no cover - process wrapper
         ap.error(f"multi-host serving requires tp == total chips "
                  f"({len(jax.devices())}); got --tp {args.tp}. "
                  f"Use --tp 0 (auto)")
-    if args.paged and (tp > 1 or jax.process_count() > 1):
+    if args.paged and jax.process_count() > 1:
         # Refusing beats the alternative: a follower waiting on broadcasts
         # a paged host 0 never sends is a silent cross-host hang.
-        ap.error("--paged does not support multi-chip/multi-host serving "
-                 "yet (dense engine only)")
+        # (Single-host multi-chip paged TP is supported.)
+        ap.error("--paged does not support multi-HOST serving yet")
 
     cfg = llama.CONFIGS[args.model]
     mesh = None
@@ -273,7 +269,8 @@ def main(argv=None):  # pragma: no cover - process wrapper
         engine = PagedServeEngine(
             cfg, params, max_slots=args.max_slots, max_len=args.max_len,
             num_blocks=args.num_blocks, block_size=args.block_size,
-            decode_impl=args.decode_impl, prefill_chunk=args.prefill_chunk)
+            decode_impl=args.decode_impl, prefill_chunk=args.prefill_chunk,
+            mesh=mesh)
     elif jax.process_count() > 1:
         from kuberay_tpu.serve.multihost import MultihostServeEngine
         engine = MultihostServeEngine(cfg, params, **engine_kw)

@@ -190,20 +190,24 @@ def make_tp_attention_quant(mesh: Mesh, attention_fn):
     return attention
 
 
-def shard_engine_state(cfg, params: Dict[str, Any], cache,
-                       mesh: Mesh, quant: str = "none"):
-    """Place params + cache onto the mesh; returns (params, cache,
-    cache_shardings).  ``cache`` may be a zero-arg callable — it is then
-    jitted with sharded outputs so the cache MATERIALIZES sharded (a
-    dense llama3_8b cache would not fit one chip; see init_sharded_params
-    for the same issue on the weights)."""
-    p_sh = param_shardings(cfg, mesh)
-    c_sh = cache_shardings(cfg, mesh, quant)
-    if callable(cache):
-        cache = jax.jit(cache, out_shardings=c_sh)()
-    else:
-        cache = jax.device_put(cache, c_sh)
-    return jax.device_put(params, p_sh), cache, c_sh
+def paged_cache_shardings(mesh: Mesh):
+    """Shardings for the paged pool layout [L, Hkv, P, D]
+    (paged_kv.init_paged_cache): kv heads on tp, replicated on tpr."""
+    kv = NamedSharding(mesh, P(None, "tp", None, None))
+    return {"k": kv, "v": kv}
+
+
+def make_tp_paged_attention(mesh: Mesh, local_decode):
+    """shard_map a block-table-native paged decode closure
+    (paged_kv.make_paged_forward's ``local_decode(q, pk, pv, lens,
+    tables)``): q [B, 1, Hq, D] heads over (tp, tpr); pool [Hkv, P, D]
+    heads over tp; tables/lens replicated — the full table is valid on
+    every shard because the pool's position axis is unsplit."""
+    return shard_map(
+        local_decode, mesh=mesh,
+        in_specs=(_Q_HEADS, P("tp", None, None), P("tp", None, None),
+                  P(None), P(None, None)),
+        out_specs=_Q_HEADS, check_vma=False)
 
 
 def init_sharded_params(cfg, key, mesh: Mesh):
