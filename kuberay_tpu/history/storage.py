@@ -4,14 +4,19 @@ Reference shape: ``historyserver/pkg/storage/interface.go`` defines a
 ``StorageWriter`` (CreateDirectory/WriteFile) + ``StorageReader``
 (List/GetContent/ListFiles) pair with GCS / S3 / AzureBlob / AliyunOSS
 implementations.  Here the seam is a single byte-level ``StorageBackend``
-(put/get/list/delete over object keys) with three implementations:
+(put/get/list/delete over object keys) with the same five:
 
-- ``LocalStorage`` — directory-backed (the reference's localtest backend).
-- ``S3Storage``   — speaks the real S3 REST protocol with AWS Signature
-  V4 request signing (ref ``pkg/storage/s3/``); works against any
+- ``LocalStorage``      — directory-backed (the reference's localtest
+  backend).
+- ``S3Storage``         — real S3 REST protocol with AWS Signature V4
+  request signing (ref ``pkg/storage/s3/``); works against any
   S3-compatible endpoint (AWS, MinIO, GCS-interop).
-- ``GCSStorage``  — speaks the GCS JSON API with bearer-token auth
+- ``GCSStorage``        — GCS JSON API with bearer-token auth
   (ref ``pkg/storage/gcs/``).
+- ``AzureBlobStorage``  — Blob REST API with Shared Key signing
+  (ref ``pkg/storage/azureblob/``).
+- ``AliyunOSSStorage``  — OSS REST API with header signing
+  (ref ``pkg/storage/aliyunoss/``).
 
 All remote protocols are stdlib-only (urllib + hmac/hashlib + ElementTree)
 so the archive works in a hermetic image; they are exercised in tests
@@ -334,6 +339,207 @@ class GCSStorage(StorageBackend):
         return sorted(keys)
 
 
+class AzureBlobStorage(StorageBackend):
+    """Azure Blob REST backend with Shared Key authorization
+    (ref ``historyserver/pkg/storage/azureblob/``).
+
+    Implements the Shared Key string-to-sign (canonicalized x-ms-*
+    headers + canonicalized resource, HMAC-SHA256 over the base64 account
+    key) from the Azure Storage auth spec; the test suite's fake endpoint
+    re-derives the signature to prove wire compatibility.
+    """
+
+    VERSION = "2020-04-08"
+
+    def __init__(self, account: str, container: str, account_key: str = "",
+                 endpoint: str = "", timeout: float = 10.0):
+        import base64
+        self.account = account
+        self.container = container
+        key = account_key or os.environ.get("AZURE_STORAGE_KEY", "")
+        self._key = base64.b64decode(key) if key else b""
+        self.endpoint = (endpoint.rstrip("/") or
+                         f"https://{account}.blob.core.windows.net")
+        self.timeout = timeout
+
+    def _auth_headers(self, method: str, path: str, query: Dict[str, str],
+                      payload: bytes, content_type: str) -> Dict[str, str]:
+        import base64
+        now = datetime.datetime.now(datetime.timezone.utc)
+        headers = {
+            "x-ms-date": now.strftime("%a, %d %b %Y %H:%M:%S GMT"),
+            "x-ms-version": self.VERSION,
+        }
+        if method == "PUT":
+            headers["x-ms-blob-type"] = "BlockBlob"
+        canon_headers = "".join(
+            f"{k}:{headers[k]}\n" for k in sorted(headers))
+        canon_resource = f"/{self.account}{path}" + "".join(
+            f"\n{k}:{v}" for k, v in sorted(query.items()))
+        content_length = str(len(payload)) if payload else ""
+        string_to_sign = "\n".join([
+            method,
+            "",                    # Content-Encoding
+            "",                    # Content-Language
+            content_length,        # Content-Length ("" when zero)
+            "",                    # Content-MD5
+            content_type,          # signed — urllib injects a default
+                                   # Content-Type on bodied requests, so
+                                   # it MUST be explicit and match
+            "",                    # Date (x-ms-date used instead)
+            "", "", "", "", "",    # If-* / Range
+            canon_headers + canon_resource])
+        sig = base64.b64encode(hmac.new(
+            self._key, string_to_sign.encode(), hashlib.sha256).digest()
+        ).decode()
+        headers["Authorization"] = f"SharedKey {self.account}:{sig}"
+        return headers
+
+    def _request(self, method: str, path: str,
+                 query: Optional[Dict[str, str]] = None,
+                 payload: bytes = b"") -> bytes:
+        query = query or {}
+        url = self.endpoint + urllib.parse.quote(path, safe="/-_.~")
+        if query:
+            url += "?" + urllib.parse.urlencode(sorted(query.items()))
+        ct = "application/octet-stream" if method == "PUT" else ""
+        headers = self._auth_headers(method, path, query, payload, ct)
+        if ct:
+            headers["Content-Type"] = ct
+        # data=b'' (NOT None) on empty PUTs: Azure requires a
+        # Content-Length header (411 otherwise).
+        req = urllib.request.Request(
+            url, data=payload if method == "PUT" else None,
+            headers=headers, method=method)
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            return resp.read()
+
+    def put(self, key: str, data: bytes) -> None:
+        self._request("PUT", f"/{self.container}/{key}", payload=data)
+
+    def get(self, key: str) -> Optional[bytes]:
+        try:
+            return self._request("GET", f"/{self.container}/{key}")
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return None
+            raise
+
+    def delete(self, key: str) -> None:
+        try:
+            self._request("DELETE", f"/{self.container}/{key}")
+        except urllib.error.HTTPError as e:
+            if e.code != 404:
+                raise
+
+    def list(self, prefix: str = "") -> List[str]:
+        keys: List[str] = []
+        marker = ""
+        while True:
+            q = {"restype": "container", "comp": "list", "prefix": prefix}
+            if marker:
+                q["marker"] = marker
+            body = self._request("GET", f"/{self.container}", query=q)
+            root = ET.fromstring(body)
+            keys.extend(el.text or "" for el in root.iter("Name"))
+            marker = next((el.text or "" for el in root.iter("NextMarker")
+                           if el.text), "")
+            if not marker:
+                break
+        return sorted(keys)
+
+
+class AliyunOSSStorage(StorageBackend):
+    """Aliyun OSS REST backend with header-based signing
+    (ref ``historyserver/pkg/storage/aliyunoss/``): Authorization is
+    ``OSS {key_id}:{base64(hmac_sha1(secret, string-to-sign))}`` over
+    VERB/MD5/Type/Date + canonicalized x-oss-* headers + resource.
+    """
+
+    def __init__(self, bucket: str, access_key_id: str = "",
+                 access_key_secret: str = "", endpoint: str = "",
+                 timeout: float = 10.0):
+        self.bucket = bucket
+        self.key_id = access_key_id or os.environ.get(
+            "OSS_ACCESS_KEY_ID", "")
+        self.secret = access_key_secret or os.environ.get(
+            "OSS_ACCESS_KEY_SECRET", "")
+        # Path-style against an explicit endpoint (testable; Aliyun's
+        # virtual-host style maps to the same canonicalized resource).
+        self.endpoint = (endpoint.rstrip("/")
+                         or "https://oss-cn-hangzhou.aliyuncs.com")
+        self.timeout = timeout
+
+    def _request(self, method: str, key: str = "",
+                 query: Optional[Dict[str, str]] = None,
+                 payload: bytes = b"") -> bytes:
+        import base64
+        query = query or {}
+        date = datetime.datetime.now(datetime.timezone.utc).strftime(
+            "%a, %d %b %Y %H:%M:%S GMT")
+        resource = f"/{self.bucket}/{key}"
+        # Content-Type is part of the OSS string-to-sign; urllib injects
+        # a default on bodied requests, so set it explicitly and sign it.
+        # (List subresources like prefix/marker are excluded from the
+        # canonicalized resource by the OSS spec — only the bare path
+        # signs.)
+        ct = "application/octet-stream" if method == "PUT" else ""
+        string_to_sign = "\n".join([method, "", ct, date, resource])
+        sig = base64.b64encode(hmac.new(
+            self.secret.encode(), string_to_sign.encode(),
+            hashlib.sha1).digest()).decode()
+        url = (f"{self.endpoint}/{self.bucket}/"
+               f"{urllib.parse.quote(key, safe='/-_.~')}")
+        if query:
+            url += "?" + urllib.parse.urlencode(sorted(query.items()))
+        headers = {"Date": date,
+                   "Authorization": f"OSS {self.key_id}:{sig}"}
+        if ct:
+            headers["Content-Type"] = ct
+        req = urllib.request.Request(
+            url, data=payload if method == "PUT" else None, method=method,
+            headers=headers)
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            return resp.read()
+
+    def put(self, key: str, data: bytes) -> None:
+        self._request("PUT", key, payload=data)
+
+    def get(self, key: str) -> Optional[bytes]:
+        try:
+            return self._request("GET", key)
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return None
+            raise
+
+    def delete(self, key: str) -> None:
+        try:
+            self._request("DELETE", key)
+        except urllib.error.HTTPError as e:
+            if e.code != 404:
+                raise
+
+    def list(self, prefix: str = "") -> List[str]:
+        keys: List[str] = []
+        marker = ""
+        while True:
+            q = {"prefix": prefix}
+            if marker:
+                q["marker"] = marker
+            body = self._request("GET", query=q)
+            root = ET.fromstring(body)
+
+            def _texts(tag):
+                return [el.text or "" for el in root.iter(tag)]
+            keys.extend(_texts("Key"))
+            truncated = next(iter(_texts("IsTruncated")), "false")
+            marker = next(iter(_texts("NextMarker")), "")
+            if truncated != "true" or not marker:
+                break
+        return sorted(keys)
+
+
 def backend_from_url(url: str) -> StorageBackend:
     """Factory: ``file:///path``, ``s3://bucket?endpoint=...&region=...``,
     ``gs://bucket?endpoint=...`` — the collector/server CLI seam."""
@@ -348,4 +554,16 @@ def backend_from_url(url: str) -> StorageBackend:
         return GCSStorage(parsed.netloc,
                           endpoint=q.get("endpoint",
                                          "https://storage.googleapis.com"))
+    if parsed.scheme == "azblob":
+        # azblob://container?account=myacct[&endpoint=...]; key from
+        # AZURE_STORAGE_KEY env.
+        if not q.get("account"):
+            raise ValueError(
+                "azblob:// URL requires ?account=<storage account>")
+        return AzureBlobStorage(q["account"], parsed.netloc,
+                                endpoint=q.get("endpoint", ""))
+    if parsed.scheme == "oss":
+        # oss://bucket[?endpoint=...]; creds from OSS_ACCESS_KEY_* env.
+        return AliyunOSSStorage(parsed.netloc,
+                                endpoint=q.get("endpoint", ""))
     raise ValueError(f"unknown storage scheme: {parsed.scheme}")

@@ -204,7 +204,8 @@ def main(argv=None):  # pragma: no cover - process wrapper
                     help="KV cache storage dtype (dense engine)")
     ap.add_argument("--tp", type=int, default=1,
                     help="tensor parallelism over the slice's chips "
-                         "(0 = all global devices; dense engine). "
+                         "(0 = all global devices; composes with all "
+                         "engine modes incl. --paged). "
                          "Multi-host: every host of the TpuService slice "
                          "runs this same command; the operator's env "
                          "contract joins them into one jax.distributed "
