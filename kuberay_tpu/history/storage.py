@@ -357,7 +357,13 @@ class AzureBlobStorage(StorageBackend):
         self.account = account
         self.container = container
         key = account_key or os.environ.get("AZURE_STORAGE_KEY", "")
-        self._key = base64.b64decode(key) if key else b""
+        if not key:
+            # Fail fast: an empty key would HMAC-sign every request
+            # wrong and surface as a stream of opaque 403s mid-run.
+            raise ValueError(
+                "Azure account key required (AZURE_STORAGE_KEY env or "
+                "account_key=)")
+        self._key = base64.b64decode(key)
         self.endpoint = (endpoint.rstrip("/") or
                          f"https://{account}.blob.core.windows.net")
         self.timeout = timeout
@@ -458,17 +464,28 @@ class AliyunOSSStorage(StorageBackend):
 
     def __init__(self, bucket: str, access_key_id: str = "",
                  access_key_secret: str = "", endpoint: str = "",
-                 timeout: float = 10.0):
+                 timeout: float = 10.0, path_style: bool = False):
         self.bucket = bucket
         self.key_id = access_key_id or os.environ.get(
             "OSS_ACCESS_KEY_ID", "")
         self.secret = access_key_secret or os.environ.get(
             "OSS_ACCESS_KEY_SECRET", "")
-        # Path-style against an explicit endpoint (testable; Aliyun's
-        # virtual-host style maps to the same canonicalized resource).
         self.endpoint = (endpoint.rstrip("/")
                          or "https://oss-cn-hangzhou.aliyuncs.com")
+        # Real OSS requires virtual-host addressing
+        # (https://{bucket}.{region-host}/{key} — path-style gets
+        # SecondLevelDomainForbidden); the canonicalized resource is
+        # "/{bucket}/{key}" in BOTH styles.  path_style=True serves
+        # test fakes and S3-compatible gateways.
+        self.path_style = path_style
         self.timeout = timeout
+
+    def _object_url(self, key: str) -> str:
+        quoted = urllib.parse.quote(key, safe="/-_.~")
+        if self.path_style:
+            return f"{self.endpoint}/{self.bucket}/{quoted}"
+        scheme, _, host = self.endpoint.partition("://")
+        return f"{scheme}://{self.bucket}.{host}/{quoted}"
 
     def _request(self, method: str, key: str = "",
                  query: Optional[Dict[str, str]] = None,
@@ -488,8 +505,7 @@ class AliyunOSSStorage(StorageBackend):
         sig = base64.b64encode(hmac.new(
             self.secret.encode(), string_to_sign.encode(),
             hashlib.sha1).digest()).decode()
-        url = (f"{self.endpoint}/{self.bucket}/"
-               f"{urllib.parse.quote(key, safe='/-_.~')}")
+        url = self._object_url(key)
         if query:
             url += "?" + urllib.parse.urlencode(sorted(query.items()))
         headers = {"Date": date,
@@ -563,7 +579,9 @@ def backend_from_url(url: str) -> StorageBackend:
         return AzureBlobStorage(q["account"], parsed.netloc,
                                 endpoint=q.get("endpoint", ""))
     if parsed.scheme == "oss":
-        # oss://bucket[?endpoint=...]; creds from OSS_ACCESS_KEY_* env.
-        return AliyunOSSStorage(parsed.netloc,
-                                endpoint=q.get("endpoint", ""))
+        # oss://bucket[?endpoint=...&path_style=1]; creds from
+        # OSS_ACCESS_KEY_* env.
+        return AliyunOSSStorage(
+            parsed.netloc, endpoint=q.get("endpoint", ""),
+            path_style=q.get("path_style", "") in ("1", "true"))
     raise ValueError(f"unknown storage scheme: {parsed.scheme}")

@@ -44,8 +44,9 @@ from kuberay_tpu.serve.engine import ServeEngine
 OP_STOP, OP_PREFILL, OP_DECODE, OP_VERIFY = 0, 1, 2, 3
 
 
-def _zero_plan(max_len: int, max_slots: int, gamma: int) -> Dict[str, Any]:
-    return {
+def _zero_plan(max_len: int, max_slots: int, gamma: int,
+               max_blocks: int = 0) -> Dict[str, Any]:
+    plan = {
         "op": np.int32(0),
         # slot, real_len, bucket, start_pos
         "scalars": np.zeros(4, np.int32),
@@ -58,6 +59,16 @@ def _zero_plan(max_len: int, max_slots: int, gamma: int) -> Dict[str, Any]:
         "vtoks": np.zeros((max_slots, gamma + 1), np.int32),
         "key": np.zeros(2, np.uint32),
     }
+    if max_blocks:
+        # Paged engines: host 0 owns the allocator; followers receive
+        # the block tables with every plan.
+        plan["tables"] = np.zeros((max_slots, max_blocks), np.int32)
+    return plan
+
+
+def _plan_shape(engine: ServeEngine) -> Dict[str, Any]:
+    return _zero_plan(engine.max_len, engine.max_slots, engine.speculative,
+                     getattr(engine, "max_blocks", 0))
 
 
 def _broadcast(plan, is_source: bool):
@@ -75,12 +86,13 @@ class MultihostServeEngine(ServeEngine):
 
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
-        self._plan0 = _zero_plan(self.max_len, self.max_slots,
-                                 self.speculative)
+        self._plan0 = _plan_shape(self)
 
     def _send(self, **updates) -> None:
         plan = dict(self._plan0)
         plan.update(updates)
+        if "tables" in plan:
+            plan["tables"] = np.asarray(self.tables, np.int32)
         _broadcast(plan, is_source=True)
 
     def stop(self) -> None:
@@ -133,7 +145,7 @@ def follower_loop(engine: ServeEngine) -> int:
     mesh) so the compiled programs and shardings match.  Returns the
     number of device calls replayed.
     """
-    plan0 = _zero_plan(engine.max_len, engine.max_slots, engine.speculative)
+    plan0 = _plan_shape(engine)
     steps = 0
     while True:
         plan = _broadcast(plan0, is_source=False)
@@ -141,6 +153,8 @@ def follower_loop(engine: ServeEngine) -> int:
         if op == OP_STOP:
             return steps
         steps += 1
+        if "tables" in plan:
+            engine.tables[:] = np.asarray(plan["tables"])
         # Engines use legacy uint32[2] PRNG keys — the raw array IS the key.
         key = jnp.asarray(plan["key"], jnp.uint32)
         if op == OP_PREFILL:
@@ -161,3 +175,14 @@ def follower_loop(engine: ServeEngine) -> int:
                                   np.asarray(plan["mask"]))
         else:  # pragma: no cover - protocol error
             raise RuntimeError(f"unknown serve op {op}")
+
+
+from kuberay_tpu.serve.paged_engine import PagedServeEngine  # noqa: E402
+
+
+class MultihostPagedServeEngine(MultihostServeEngine, PagedServeEngine):
+    """Host-0 paged engine: MultihostServeEngine's broadcast wrappers
+    compose over PagedServeEngine through the shared device funnels
+    (_prefill_device/_decode_call, MRO: broadcast first, paged kernel
+    second); block tables ride every plan, so followers replay against
+    host 0's allocator decisions without running an allocator at all."""

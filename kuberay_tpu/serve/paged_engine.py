@@ -221,11 +221,9 @@ class PagedServeEngine(ServeEngine):
         padded = np.zeros(bucket, dtype=np.int32)
         padded[:new_tokens] = req.prompt_tokens[ncached:]
         self.key, sub = jax.random.split(self.key)
-        tok, self.cache = self._prefill(
-            self.params, self.cache, jnp.asarray(padded),
-            jnp.asarray(self.tables), jnp.int32(slot), jnp.int32(ncached),
-            jnp.int32(new_tokens), sub, jnp.float32(req.temperature),
-            prompt_len=bucket)
+        tok = self._prefill_device(padded, slot, new_tokens, sub,
+                                   req.temperature, bucket,
+                                   start_pos=ncached)
         self._register_full_prompt(req, slot)
         self._finalize_admit(req, slot, tok)
         return True
@@ -245,11 +243,22 @@ class PagedServeEngine(ServeEngine):
         return True
 
     def _prefill_chunk_call(self, req, slot, off, padded, real_len, sub):
+        return self._prefill_device(padded, slot, real_len, sub,
+                                    req.temperature, self.prefill_chunk,
+                                    start_pos=off)
+
+    def _prefill_device(self, padded, slot, real_len, sub, temperature,
+                        bucket, start_pos=0):
+        """Paged prefill funnel (same signature as the dense engine's so
+        the multi-host plan protocol covers both; ``start_pos`` is the
+        absolute prompt offset — past a cache-served prefix or the chunk
+        offset).  Block tables ride ``self.tables``, which the follower
+        loop synchronizes from the broadcast plan."""
         tok, self.cache = self._prefill(
             self.params, self.cache, jnp.asarray(padded),
-            jnp.asarray(self.tables), jnp.int32(slot), jnp.int32(off),
-            jnp.int32(real_len), sub, jnp.float32(req.temperature),
-            prompt_len=self.prefill_chunk)
+            jnp.asarray(self.tables), jnp.int32(slot),
+            jnp.int32(start_pos), jnp.int32(real_len), sub,
+            jnp.float32(temperature), prompt_len=bucket)
         return tok
 
     def _chunk_finalize(self, req, slot, tok) -> None:

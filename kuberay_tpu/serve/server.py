@@ -113,6 +113,23 @@ class ServeFrontend:
                     return self._send(200, {"status": "ok"})
                 if self.path == "/stats":
                     return self._send(200, frontend.stats())
+                if self.path == "/metrics":
+                    # Prometheus text exposition (the vLLM-server
+                    # /metrics role): every numeric stat becomes a
+                    # tpu_serve_* gauge/counter.
+                    lines = []
+                    for k, v in sorted(frontend.stats().items()):
+                        if isinstance(v, bool) or \
+                                not isinstance(v, (int, float)):
+                            continue
+                        name = f"tpu_serve_{k}"
+                        kind = ("counter" if k in (
+                            "requests", "completed", "rejected",
+                            "tokens_out") else "gauge")
+                        lines.append(f"# TYPE {name} {kind}")
+                        lines.append(f"{name} {v}")
+                    return self._send_text(200, "\n".join(lines) + "\n",
+                                           "text/plain; version=0.0.4")
                 return self._send(404, {"message": "unknown path"})
 
             def do_POST(self):
@@ -234,11 +251,6 @@ def main(argv=None):  # pragma: no cover - process wrapper
         ap.error(f"multi-host serving requires tp == total chips "
                  f"({len(jax.devices())}); got --tp {args.tp}. "
                  f"Use --tp 0 (auto)")
-    if args.paged and jax.process_count() > 1:
-        # Refusing beats the alternative: a follower waiting on broadcasts
-        # a paged host 0 never sends is a silent cross-host hang.
-        # (Single-host multi-chip paged TP is supported.)
-        ap.error("--paged does not support multi-HOST serving yet")
 
     cfg = llama.CONFIGS[args.model]
     mesh = None
@@ -251,30 +263,42 @@ def main(argv=None):  # pragma: no cover - process wrapper
         params = init_sharded_params(cfg, jax.random.PRNGKey(0), mesh)
     else:
         params = llama.init_params(cfg, jax.random.PRNGKey(0))
-    engine_kw = dict(max_slots=args.max_slots, max_len=args.max_len,
-                     prefill_chunk=args.prefill_chunk,
-                     speculative=args.speculative, kv_quant=args.kv_quant,
-                     decode_impl=args.decode_impl, mesh=mesh)
+    if args.paged:
+        engine_kw = dict(max_slots=args.max_slots, max_len=args.max_len,
+                         num_blocks=args.num_blocks,
+                         block_size=args.block_size,
+                         decode_impl=args.decode_impl,
+                         prefill_chunk=args.prefill_chunk, mesh=mesh)
+    else:
+        engine_kw = dict(max_slots=args.max_slots, max_len=args.max_len,
+                         prefill_chunk=args.prefill_chunk,
+                         speculative=args.speculative,
+                         kv_quant=args.kv_quant,
+                         decode_impl=args.decode_impl, mesh=mesh)
     if jax.process_count() > 1 and jax.process_index() > 0:
         # Follower host: no frontend, no scheduling — replay host 0's
-        # device calls until it broadcasts STOP.
+        # device calls until it broadcasts STOP.  Paged followers hold a
+        # pool but no allocator state (tables ride the plan).
         from kuberay_tpu.serve.multihost import follower_loop
-        engine = ServeEngine(cfg, params, **engine_kw)
+        if args.paged:
+            from kuberay_tpu.serve.paged_engine import PagedServeEngine
+            engine = PagedServeEngine(cfg, params, **engine_kw)
+        else:
+            engine = ServeEngine(cfg, params, **engine_kw)
         print(f"serve follower {jax.process_index()}/"
               f"{jax.process_count()} ready", flush=True)
         follower_loop(engine)
         return
 
-    if args.paged:
+    if jax.process_count() > 1:
+        from kuberay_tpu.serve.multihost import (
+            MultihostPagedServeEngine, MultihostServeEngine)
+        cls = MultihostPagedServeEngine if args.paged \
+            else MultihostServeEngine
+        engine = cls(cfg, params, **engine_kw)
+    elif args.paged:
         from kuberay_tpu.serve.paged_engine import PagedServeEngine
-        engine = PagedServeEngine(
-            cfg, params, max_slots=args.max_slots, max_len=args.max_len,
-            num_blocks=args.num_blocks, block_size=args.block_size,
-            decode_impl=args.decode_impl, prefill_chunk=args.prefill_chunk,
-            mesh=mesh)
-    elif jax.process_count() > 1:
-        from kuberay_tpu.serve.multihost import MultihostServeEngine
-        engine = MultihostServeEngine(cfg, params, **engine_kw)
+        engine = PagedServeEngine(cfg, params, **engine_kw)
     else:
         engine = ServeEngine(cfg, params, **engine_kw)
     frontend = ServeFrontend(engine)

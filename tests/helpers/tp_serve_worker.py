@@ -32,16 +32,28 @@ def main() -> None:
                               n_heads=8, n_kv_heads=4)
     params = llama.init_params(cfg, jax.random.PRNGKey(0))
     mesh = serve_mesh(len(jax.devices()))
+    paged = "--paged" in sys.argv
     kw = dict(max_slots=2, max_len=64, mesh=mesh)
+    if paged:
+        kw["block_size"] = 8
     if jax.process_index() == 0:
-        eng = MultihostServeEngine(cfg, params, **kw)
+        if paged:
+            from kuberay_tpu.serve.multihost import MultihostPagedServeEngine
+            eng = MultihostPagedServeEngine(cfg, params, **kw)
+        else:
+            eng = MultihostServeEngine(cfg, params, **kw)
         for i, p in enumerate([[1, 2, 3, 4, 5], [9, 8, 7]]):
             eng.add_request(Request(f"r{i}", p, max_new_tokens=8))
         out = {r.request_id: r.tokens for r in eng.run()}
         eng.stop()
         print("RESULT " + json.dumps(out), flush=True)
     else:
-        n = follower_loop(ServeEngine(cfg, params, **kw))
+        if paged:
+            from kuberay_tpu.serve.paged_engine import PagedServeEngine
+            follower = PagedServeEngine(cfg, params, **kw)
+        else:
+            follower = ServeEngine(cfg, params, **kw)
+        n = follower_loop(follower)
         print(f"FOLLOWER replayed {n} calls", flush=True)
 
 

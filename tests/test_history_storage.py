@@ -736,7 +736,8 @@ def test_aliyun_oss_backend_wire_protocol():
     try:
         st = AliyunOSSStorage("arch", access_key_id="OSSKEY",
                               access_key_secret="OSSSECRET",
-                              endpoint=f"http://127.0.0.1:{srv.server_port}")
+                              endpoint=f"http://127.0.0.1:{srv.server_port}",
+                              path_style=True)
         st.put("meta/default/c1/doc.json", b'{"b": 2}')
         assert st.get("meta/default/c1/doc.json") == b'{"b": 2}'
         assert st.get("nope") is None
@@ -745,7 +746,8 @@ def test_aliyun_oss_backend_wire_protocol():
         assert st.get("meta/default/c1/doc.json") is None
         bad = AliyunOSSStorage("arch", access_key_id="OSSKEY",
                                access_key_secret="WRONG",
-                               endpoint=f"http://127.0.0.1:{srv.server_port}")
+                               endpoint=f"http://127.0.0.1:{srv.server_port}",
+                               path_style=True)
         with pytest.raises(urllib.error.HTTPError):
             bad.put("x", b"y")
     finally:
@@ -766,3 +768,11 @@ def test_backend_from_url_new_schemes(monkeypatch):
     oss = backend_from_url("oss://bkt?endpoint=http://y:2")
     assert isinstance(oss, AliyunOSSStorage)
     assert oss.bucket == "bkt" and oss.endpoint == "http://y:2"
+    # Virtual-host addressing by default (real OSS rejects path-style).
+    assert oss._object_url("k").startswith("http://bkt.y:2/")
+    assert backend_from_url(
+        "oss://bkt?endpoint=http://y:2&path_style=1").path_style
+    # Missing Azure key fails fast, not as per-request 403s.
+    monkeypatch.delenv("AZURE_STORAGE_KEY")
+    with pytest.raises(ValueError, match="account key"):
+        backend_from_url("azblob://cont?account=acct")

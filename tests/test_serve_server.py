@@ -92,3 +92,30 @@ def test_register_with_coordinator(frontend):
         assert coord.serve_apps["llm"]["status"] == "RUNNING"
     finally:
         srv.shutdown()
+
+
+def test_metrics_endpoint():
+    """Prometheus text exposition over the serve HTTP server."""
+    import urllib.request
+
+    import jax
+
+    from kuberay_tpu.models import llama
+    from kuberay_tpu.serve.engine import ServeEngine
+    from kuberay_tpu.serve.server import ServeFrontend
+
+    cfg = llama.CONFIGS["llama_tiny"]
+    eng = ServeEngine(cfg, llama.init_params(cfg, jax.random.PRNGKey(0)),
+                      max_slots=2, max_len=64)
+    fe = ServeFrontend(eng)
+    srv, url = fe.serve_background()
+    try:
+        resp = fe.submit([1, 2, 3], max_tokens=3, timeout=120)
+        assert resp is not None
+        text = urllib.request.urlopen(f"{url}/metrics").read().decode()
+        assert "# TYPE tpu_serve_requests counter" in text
+        assert "tpu_serve_completed 1" in text
+        assert "tpu_serve_tokens_out 3" in text
+    finally:
+        fe.close()
+        srv.shutdown()

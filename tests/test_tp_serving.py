@@ -228,3 +228,50 @@ def test_paged_tp2_token_identical(params):
     k = eng.cache["k"]
     assert not k.sharding.is_fully_replicated
     assert k.sharding.shard_shape(k.shape)[1] == CFG.n_kv_heads // 2
+
+
+@pytest.mark.timeout(300)
+def test_multihost_paged_lockstep(params):
+    """Multi-host PAGED serving: block tables ride every broadcast plan,
+    so followers replay host 0's allocator decisions without running an
+    allocator.  Host 0's tokens must equal the single-process paged
+    engine's."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    from kuberay_tpu.serve.paged_engine import PagedServeEngine
+
+    script = os.path.join(os.path.dirname(__file__), "helpers",
+                          "tp_serve_worker.py")
+
+    def spawn(worker_id):
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+            "TPU_WORKER_HOSTNAMES": "localhost,localhost",
+            "TPU_NUM_PROCESSES": "2",
+            "TPU_WORKER_ID": str(worker_id),
+        })
+        return subprocess.Popen([sys.executable, script, "--paged"],
+                                env=env, stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT, text=True)
+
+    procs = [spawn(0), spawn(1)]
+    outs = [p.communicate(timeout=280)[0] for p in procs]
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out[-2000:]
+    result = next(line for line in outs[0].splitlines()
+                  if line.startswith("RESULT "))
+    got = json.loads(result[len("RESULT "):])
+
+    cfg = dataclasses.replace(CFG, n_heads=8, n_kv_heads=4)
+    ref_params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    eng = PagedServeEngine(cfg, ref_params, max_slots=2, max_len=64,
+                           block_size=8)
+    for i, p in enumerate([[1, 2, 3, 4, 5], [9, 8, 7]]):
+        eng.add_request(Request(f"r{i}", p, max_new_tokens=8))
+    want = {r.request_id: r.tokens for r in eng.run()}
+    assert got == want
