@@ -292,6 +292,7 @@ async function viewHistory(el,ns,name){
    (doc.events||[]).map(e=>row([esc(e.type),esc(e.reason),esc(e.message)])).join('')}</table>
   ${doc.pods&&doc.pods.length?`<h3>Pods at deletion</h3><table>${row(['POD','PHASE'],1)+
    doc.pods.map(p=>row([esc(p.name),esc(p.phase)])).join('')}</table>`:''}
+  <div id="taskev"></div>
   <h3>Logs</h3><table>${row(['FILE',''],1)+
    files.map(f=>row([`<span class="mono">${esc(f)}</span>`,
     `<a href="#" data-log="${esc(f)}">view</a>`])).join('')}</table>
@@ -301,6 +302,17 @@ async function viewHistory(el,ns,name){
    const r=await fetch(`/api/history/logs/${encPath(ns,name,a.dataset.log)}`);
    const v=document.getElementById('logview');
    v.style.display='block';v.textContent=await r.text()});
+  // Archived task/step/profile events (post-mortem replay of the
+  // coordinator's event stream) + the Perfetto-loadable timeline link.
+  const tev=((await getj(`/api/history/events/${encPath(ns,name)}`))||{}).events||[];
+  if(tev.length)document.getElementById('taskev').innerHTML=
+   `<h3>Task events <a href="/api/history/timeline/${encPath(ns,name)}"
+     style="font-weight:normal;font-size:.8rem">(timeline JSON)</a></h3>
+   <table>${row(['TIME','TYPE','NAME','JOB','DETAIL'],1)+
+    tev.slice(-30).reverse().map(e=>row([
+     esc(new Date((e.ts||0)*1000).toLocaleTimeString()),esc(e.type),
+     esc(e.name),`<span class="mono">${esc(e.job_id||'')}</span>`,
+     `<span class="mono">${esc(JSON.stringify(e.args||{}))}</span>`])).join('')}</table>`;
   return;
  }
  const rows=((await getj('/api/history/clusters'))||{}).items;

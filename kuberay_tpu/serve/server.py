@@ -116,16 +116,19 @@ class ServeFrontend:
                 if self.path == "/metrics":
                     # Prometheus text exposition (the vLLM-server
                     # /metrics role): every numeric stat becomes a
-                    # tpu_serve_* gauge/counter.
+                    # tpu_serve_* gauge/counter.  Monotonic stats are
+                    # counters; point-in-time ones gauges.
+                    counters = {"requests", "completed", "rejected",
+                                "tokens_out", "prefix_hit_tokens",
+                                "prefix_query_tokens", "drafted",
+                                "accepted", "verify_steps"}
                     lines = []
                     for k, v in sorted(frontend.stats().items()):
                         if isinstance(v, bool) or \
                                 not isinstance(v, (int, float)):
                             continue
                         name = f"tpu_serve_{k}"
-                        kind = ("counter" if k in (
-                            "requests", "completed", "rejected",
-                            "tokens_out") else "gauge")
+                        kind = "counter" if k in counters else "gauge"
                         lines.append(f"# TYPE {name} {kind}")
                         lines.append(f"{name} {v}")
                     return self._send_text(200, "\n".join(lines) + "\n",
@@ -275,32 +278,33 @@ def main(argv=None):  # pragma: no cover - process wrapper
                          speculative=args.speculative,
                          kv_quant=args.kv_quant,
                          decode_impl=args.decode_impl, mesh=mesh)
+    # ONE class-pair selection for both roles: hosts and followers must
+    # construct matching engines or plan pytree shapes diverge (a
+    # cross-host hang, not an error).
+    if args.paged:
+        from kuberay_tpu.serve.multihost import MultihostPagedServeEngine
+        from kuberay_tpu.serve.paged_engine import PagedServeEngine
+        engine_cls, multihost_cls = (PagedServeEngine,
+                                     MultihostPagedServeEngine)
+    else:
+        from kuberay_tpu.serve.multihost import MultihostServeEngine
+        engine_cls, multihost_cls = ServeEngine, MultihostServeEngine
+
     if jax.process_count() > 1 and jax.process_index() > 0:
         # Follower host: no frontend, no scheduling — replay host 0's
         # device calls until it broadcasts STOP.  Paged followers hold a
         # pool but no allocator state (tables ride the plan).
         from kuberay_tpu.serve.multihost import follower_loop
-        if args.paged:
-            from kuberay_tpu.serve.paged_engine import PagedServeEngine
-            engine = PagedServeEngine(cfg, params, **engine_kw)
-        else:
-            engine = ServeEngine(cfg, params, **engine_kw)
+        engine = engine_cls(cfg, params, **engine_kw)
         print(f"serve follower {jax.process_index()}/"
               f"{jax.process_count()} ready", flush=True)
         follower_loop(engine)
         return
 
     if jax.process_count() > 1:
-        from kuberay_tpu.serve.multihost import (
-            MultihostPagedServeEngine, MultihostServeEngine)
-        cls = MultihostPagedServeEngine if args.paged \
-            else MultihostServeEngine
-        engine = cls(cfg, params, **engine_kw)
-    elif args.paged:
-        from kuberay_tpu.serve.paged_engine import PagedServeEngine
-        engine = PagedServeEngine(cfg, params, **engine_kw)
+        engine = multihost_cls(cfg, params, **engine_kw)
     else:
-        engine = ServeEngine(cfg, params, **engine_kw)
+        engine = engine_cls(cfg, params, **engine_kw)
     frontend = ServeFrontend(engine)
     srv = frontend.make_server(args.host, args.port)
     if args.coordinator == "auto":

@@ -182,7 +182,8 @@ def test_dashboard_has_drilldown_views():
     from kuberay_tpu.apiserver.dashboard import DASHBOARD_HTML
     for marker in ("viewJob", "viewService", "Driver log (live tail)",
                    "#/job/", "#/service/", "Step events",
-                   "/api/proxy/", "Traffic route"):
+                   "/api/proxy/", "Traffic route", "Task events",
+                   "/api/history/events/", "/api/history/timeline/"):
         assert marker in DASHBOARD_HTML, marker
 
 
