@@ -124,7 +124,9 @@ def run(args) -> None:
             zeros = _np.zeros((args.slots, gamma + 1), _np.int32)
             _, _, eng.cache = eng._verify(
                 eng.params, eng.cache, _jnp.asarray(zeros),
-                _jnp.asarray(eng.lens), _jax.random.PRNGKey(0),
+                _jnp.asarray(eng.lens),
+                _jnp.zeros(args.slots, _jnp.int32),     # ntok
+                _jax.random.PRNGKey(0),
                 _jnp.zeros(args.slots, _jnp.float32),
                 _jnp.zeros(args.slots, _jnp.float32))   # all rows masked
         for i in range(args.requests):

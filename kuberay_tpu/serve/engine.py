@@ -290,18 +290,25 @@ class ServeEngine:
         toks = jax.vmap(self._sample)(logits[:, 0], keys, temperatures)
         return toks, new_cache
 
-    def _verify_impl(self, params, cache, tokens, lens, key, temperatures,
-                     active_mask):
+    def _verify_impl(self, params, cache, tokens, lens, ntok, key,
+                     temperatures, active_mask):
         """Speculative verify: run T = γ+1 tokens (last emitted + γ draft)
         for every active slot in ONE forward.  greedy[b, j] is the model's
         next token after consuming tokens[b, :j+1] — the host accepts the
         longest prefix where greedy agrees with the draft.  Draft KV lands
         at positions lens..lens+γ; rejected positions stay masked behind
-        ``lens`` and are overwritten by later steps."""
+        ``lens`` and are overwritten by later steps.
+
+        ``ntok[b]`` = 1 + draft length: only each row's REAL tokens write
+        KV.  For the paged engine this is load-bearing — a position past
+        a slot's allocated blocks would alias another request's physical
+        block through the zero-filled table tail."""
+        T = tokens.shape[1]
+        token_mask = (active_mask[:, None] *
+                      (jnp.arange(T)[None, :] < ntok[:, None]))
         logits, new_cache = self._forward(
             self.cfg, params, tokens, cache, lens, active_mask,
-            token_mask=active_mask[:, None] *
-            jnp.ones((1, tokens.shape[1]), jnp.float32))
+            token_mask=token_mask)
         greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         keys = jax.random.split(key, self.max_slots)
         sampled0 = jax.vmap(self._sample)(logits[:, 0], keys, temperatures)
@@ -499,9 +506,11 @@ class ServeEngine:
                 if self._spec_cooldown[i] > 0:
                     self._spec_cooldown[i] -= 1
                     continue            # backed off; probe when it hits 0
-            # Cache head-room: positions lens..lens+γ must stay < max_len.
+            # Cache head-room: positions lens..lens+γ must stay < max_len
+            # (and, for paged engines, within the slot's allocated
+            # blocks — _extra_draft_cap).
             cap = min(gamma, self.max_len - int(self.lens[i]) - 2,
-                      int(self.budget[i]))
+                      int(self.budget[i]), self._extra_draft_cap(i))
             if cap <= 0:
                 continue
             hist = list(req.prompt_tokens) + self.generated[i]
@@ -512,14 +521,21 @@ class ServeEngine:
             drafts[i] = idx.draft(hist, cap)
         return drafts
 
+    def _extra_draft_cap(self, slot: int) -> int:
+        """Engine-specific extra bound on draft length (paged: block
+        capacity)."""
+        return self.speculative
+
     def _spec_decode_all(self, last, temps, mask, drafts):
         gamma = self.speculative
         toks = np.zeros((self.max_slots, gamma + 1), dtype=np.int32)
         toks[:, 0] = last
+        ntok = np.zeros(self.max_slots, dtype=np.int32)
         for i, d in enumerate(drafts):
             toks[i, 1:1 + len(d)] = d
+            ntok[i] = (1 + len(d)) if mask[i] > 0 else 0
         self.key, sub = jax.random.split(self.key)
-        greedy, sampled0 = self._verify_device(toks, sub, temps, mask)
+        greedy, sampled0 = self._verify_device(toks, ntok, sub, temps, mask)
         greedy = np.asarray(greedy)
         sampled0 = np.asarray(sampled0)
         self.spec_stats["verify_steps"] += 1
@@ -557,12 +573,12 @@ class ServeEngine:
             self.generated[i].extend(take)
             self._maybe_finish(i)
 
-    def _verify_device(self, toks, sub, temps, mask):
+    def _verify_device(self, toks, ntok, sub, temps, mask):
         """The speculative-verify device call (multi-host funnel)."""
         greedy, sampled0, self.cache = self._verify(
             self.params, self.cache, jnp.asarray(toks),
-            jnp.asarray(self.lens), sub, jnp.asarray(temps),
-            jnp.asarray(mask))
+            jnp.asarray(self.lens), jnp.asarray(ntok), sub,
+            jnp.asarray(temps), jnp.asarray(mask))
         return greedy, sampled0
 
     def _decode_call(self, last, temps, mask, sub):

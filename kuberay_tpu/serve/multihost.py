@@ -57,6 +57,7 @@ def _zero_plan(max_len: int, max_slots: int, gamma: int,
         "temps": np.zeros(max_slots, np.float32),
         "mask": np.zeros(max_slots, np.float32),
         "vtoks": np.zeros((max_slots, gamma + 1), np.int32),
+        "ntok": np.zeros(max_slots, np.int32),
         "key": np.zeros(2, np.uint32),
     }
     if max_blocks:
@@ -125,16 +126,17 @@ class MultihostServeEngine(ServeEngine):
                 key=np.asarray(sub, np.uint32))
         return super()._decode_call(last, temps, mask, sub)
 
-    def _verify_device(self, toks, sub, temps, mask):
+    def _verify_device(self, toks, ntok, sub, temps, mask):
         if jax.process_count() > 1:
             self._send(
                 op=np.int32(OP_VERIFY),
                 vtoks=np.asarray(toks, np.int32),
+                ntok=np.asarray(ntok, np.int32),
                 lens=np.asarray(self.lens, np.int32),
                 temps=np.asarray(temps, np.float32),
                 mask=np.asarray(mask, np.float32),
                 key=np.asarray(sub, np.uint32))
-        return super()._verify_device(toks, sub, temps, mask)
+        return super()._verify_device(toks, ntok, sub, temps, mask)
 
 
 def follower_loop(engine: ServeEngine) -> int:
@@ -170,7 +172,8 @@ def follower_loop(engine: ServeEngine) -> int:
                                 np.asarray(plan["mask"]), key)
         elif op == OP_VERIFY:
             engine.lens[:] = np.asarray(plan["lens"])
-            engine._verify_device(np.asarray(plan["vtoks"]), key,
+            engine._verify_device(np.asarray(plan["vtoks"]),
+                                  np.asarray(plan["ntok"]), key,
                                   np.asarray(plan["temps"]),
                                   np.asarray(plan["mask"]))
         else:  # pragma: no cover - protocol error

@@ -46,7 +46,7 @@ class PagedServeEngine(ServeEngine):
                  max_slots: int = 8, max_len: int = 2048,
                  num_blocks: int = 0, block_size: int = 16,
                  rng_seed: int = 0, decode_impl: str = "auto",
-                 prefill_chunk: int = 0, mesh=None):
+                 prefill_chunk: int = 0, speculative: int = 0, mesh=None):
         # Default pool = the dense engine's footprint; callers shrink it
         # to realize the memory win (e.g. slots * expected_len).
         num_blocks = num_blocks or (max_slots * max_len) // block_size
@@ -73,7 +73,7 @@ class PagedServeEngine(ServeEngine):
         # the _init_cache hook (sharded over the mesh when given).
         super().__init__(cfg, params, max_slots=max_slots, max_len=max_len,
                          rng_seed=rng_seed, prefill_chunk=prefill_chunk,
-                         mesh=mesh)
+                         speculative=speculative, mesh=mesh)
         self.allocator = BlockAllocator(num_blocks, block_size)
         self.tables = np.zeros((max_slots, self.max_blocks), dtype=np.int32)
         self.owned: List[List[int]] = [[] for _ in range(max_slots)]
@@ -115,6 +115,40 @@ class PagedServeEngine(ServeEngine):
         keys = jax.random.split(key, self.max_slots)
         toks = jax.vmap(self._sample)(logits[:, 0], keys, temperatures)
         return toks, new_cache
+
+    def _verify_impl(self, params, cache, tokens, tables, lens, ntok, key,
+                     temperatures, active_mask):
+        """Speculative verify over the block-table path.  The per-row
+        ``ntok`` write gate is what makes this safe: a position past a
+        slot's allocated blocks would resolve through the zero-filled
+        table tail into block 0 — ANOTHER request's physical block
+        (_build_drafts caps drafts to allocated capacity via
+        _extra_draft_cap, and only real tokens write)."""
+        T = tokens.shape[1]
+        token_mask = (active_mask[:, None] *
+                      (jnp.arange(T)[None, :] < ntok[:, None]))
+        logits, new_cache = self._paged_fwd(
+            self.cfg, params, tokens, cache, tables, lens, active_mask,
+            token_mask=token_mask)
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        keys = jax.random.split(key, self.max_slots)
+        sampled0 = jax.vmap(self._sample)(logits[:, 0], keys, temperatures)
+        return greedy, sampled0, new_cache
+
+    def _verify_device(self, toks, ntok, sub, temps, mask):
+        greedy, sampled0, self.cache = self._verify(
+            self.params, self.cache, jnp.asarray(toks),
+            jnp.asarray(self.tables), jnp.asarray(self.lens),
+            jnp.asarray(ntok), sub, jnp.asarray(temps), jnp.asarray(mask))
+        return greedy, sampled0
+
+    def _extra_draft_cap(self, slot: int) -> int:
+        """Drafts may only extend into ALLOCATED blocks: positions
+        lens..lens+cap must stay below the slot's block capacity
+        (_decode_all grows headroom best-effort first; a full pool just
+        shrinks the draft instead of corrupting the pool)."""
+        capacity = len(self.owned[slot]) * self.block_size
+        return capacity - int(self.lens[slot]) - 1
 
     # ------------------------------------------------------------------
     # block bookkeeping
@@ -275,12 +309,24 @@ class PagedServeEngine(ServeEngine):
     def _decode_all(self):
         # Grow tables for slots whose next write crosses a block
         # boundary; preempt (finish early) when the pool is exhausted.
+        # With speculation on, grow best-effort headroom for γ draft
+        # positions too — failure just shrinks that slot's draft
+        # (_extra_draft_cap), only the NEXT-token block is mandatory.
         for i, req in enumerate(self.active):
             if req is None:
                 continue
-            if self.lens[i] >= len(self.owned[i]) * self.block_size:
+            # Draft headroom only for slots that can actually draft —
+            # sampling and backed-off slots would hold pool blocks that
+            # are provably never written.
+            can_draft = (self.speculative > 0 and req.temperature <= 0
+                         and self._spec_miss[i] < self.SPEC_MISS_LIMIT)
+            want = int(self.lens[i]) + 1 + \
+                (self.speculative if can_draft else 0)
+            while len(self.owned[i]) * self.block_size < want:
                 if not self._grow(i, 1):
-                    self._finish(i, "preempted")
+                    break
+            if self.lens[i] >= len(self.owned[i]) * self.block_size:
+                self._finish(i, "preempted")
         if self.num_active:
             super()._decode_all()
 

@@ -90,6 +90,8 @@ class ServeFrontend:
             return {**self._stats,
                     "active_slots": self.engine.num_active,
                     "queued": len(self.engine.queue),
+                    # Speculative acceptance counters (zeros when off).
+                    **getattr(self.engine, "spec_stats", {}),
                     # Paged engines expose pool/prefix-cache counters.
                     **getattr(self.engine, "stats", {})}
 
@@ -231,9 +233,6 @@ def main(argv=None):  # pragma: no cover - process wrapper
                          "contract joins them into one jax.distributed "
                          "group and hosts >0 become lockstep followers")
     args = ap.parse_args(argv)
-    if args.paged and args.speculative:
-        ap.error("--speculative is not supported with --paged yet "
-                 "(dense engine only)")
     if args.paged and args.kv_quant != "none":
         ap.error("--kv-quant is not supported with --paged yet "
                  "(dense engine only)")
@@ -271,7 +270,8 @@ def main(argv=None):  # pragma: no cover - process wrapper
                          num_blocks=args.num_blocks,
                          block_size=args.block_size,
                          decode_impl=args.decode_impl,
-                         prefill_chunk=args.prefill_chunk, mesh=mesh)
+                         prefill_chunk=args.prefill_chunk,
+                         speculative=args.speculative, mesh=mesh)
     else:
         engine_kw = dict(max_slots=args.max_slots, max_len=args.max_len,
                          prefill_chunk=args.prefill_chunk,

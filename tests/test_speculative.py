@@ -174,3 +174,34 @@ def test_verify_gated_on_drafting_fraction():
     assert run_with_drafts({0}) == 0
     # 2/5 = 0.4 >= 0.25: verify path runs.
     assert run_with_drafts({0, 3}) > 0
+
+
+def test_paged_speculative_exact_and_capacity_capped():
+    """Speculative decoding over the block-table path: outputs exactly
+    match sequential paged decoding; drafts never write past a slot's
+    allocated blocks (a position beyond the table tail would alias
+    another request's physical block), and a near-full pool only shrinks
+    drafts, never corrupts."""
+    from kuberay_tpu.serve.paged_engine import PagedServeEngine
+
+    cfg = CONFIGS["llama_tiny"]
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    prompts = [[7, 8, 9] * 12, [4, 5] * 10, list(range(20))]
+
+    def run(**kw):
+        eng = PagedServeEngine(cfg, params, max_slots=3, max_len=128,
+                               block_size=8, **kw)
+        for i, p in enumerate(prompts):
+            eng.add_request(Request(f"r{i}", p, max_new_tokens=16))
+        return {r.request_id: r.tokens for r in eng.run()}, eng
+
+    base, _ = run()
+    spec, eng = run(speculative=4)
+    assert base == spec
+    assert eng.spec_stats["verify_steps"] > 0
+    assert eng.spec_stats["accepted"] > 0
+    # Pool sized with no draft headroom: capacity cap shrinks drafts
+    # instead of corrupting shared blocks; outputs stay exact.
+    tiny, _ = run(num_blocks=18, speculative=4)
+    tiny_base, _ = run(num_blocks=18)
+    assert tiny == tiny_base
