@@ -312,21 +312,27 @@ class PagedServeEngine(ServeEngine):
         # With speculation on, grow best-effort headroom for γ draft
         # positions too — failure just shrinks that slot's draft
         # (_extra_draft_cap), only the NEXT-token block is mandatory.
+        # Pass 1 — MANDATORY next-token blocks for every slot.  Optional
+        # draft headroom must never starve another slot's required block
+        # (that would preempt a request the non-speculative engine keeps).
         for i, req in enumerate(self.active):
             if req is None:
                 continue
-            # Draft headroom only for slots that can actually draft —
-            # sampling and backed-off slots would hold pool blocks that
-            # are provably never written.
-            can_draft = (self.speculative > 0 and req.temperature <= 0
-                         and self._spec_miss[i] < self.SPEC_MISS_LIMIT)
-            want = int(self.lens[i]) + 1 + \
-                (self.speculative if can_draft else 0)
+            if self.lens[i] >= len(self.owned[i]) * self.block_size:
+                if not self._grow(i, 1):
+                    self._finish(i, "preempted")
+        # Pass 2 — best-effort draft headroom for draft-eligible slots
+        # (sampling/backed-off slots would hold blocks they never write).
+        for i, req in enumerate(self.active):
+            if req is None or self.speculative <= 0:
+                continue
+            if req.temperature > 0 or \
+                    self._spec_miss[i] >= self.SPEC_MISS_LIMIT:
+                continue
+            want = int(self.lens[i]) + 1 + self.speculative
             while len(self.owned[i]) * self.block_size < want:
                 if not self._grow(i, 1):
                     break
-            if self.lens[i] >= len(self.owned[i]) * self.block_size:
-                self._finish(i, "preempted")
         if self.num_active:
             super()._decode_all()
 

@@ -117,7 +117,8 @@ def train(args) -> int:
     tc = TrainConfig(learning_rate=args.lr,
                      warmup_steps=min(args.warmup, max(1, args.steps // 10)),
                      decay_steps=args.steps,
-                     param_dtype=args.param_dtype, mu_dtype=args.mu_dtype)
+                     param_dtype=args.param_dtype, mu_dtype=args.mu_dtype,
+                     grad_accum=args.grad_accum)
     init, step_fn, shardings = make_sharded_train_fns(cfg, tc, mesh)
 
     state = None
@@ -193,6 +194,9 @@ def main(argv=None) -> int:
     ap.add_argument("--lr", type=float, default=3e-4)
     ap.add_argument("--warmup", type=int, default=100)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--grad-accum", type=int, default=1,
+                    help="microbatches per optimizer step (grads summed "
+                         "under lax.scan; batch must divide by it)")
     ap.add_argument("--param-dtype", default="",
                     help="master-weight dtype (e.g. float32 with a bf16 "
                          "model); default: model compute dtype")

@@ -38,6 +38,11 @@ class TrainConfig:
     # dtype); "bfloat16" halves that slice of optimizer HBM.
     param_dtype: str = ""
     mu_dtype: str = ""
+    # Gradient accumulation: >1 splits each batch into that many
+    # microbatches, sums grads over a lax.scan, and applies ONE optimizer
+    # update — large effective batches without the activation memory
+    # (composes with remat; batch size must divide by it).
+    grad_accum: int = 1
 
 
 def make_optimizer(tc: TrainConfig) -> optax.GradientTransformation:
@@ -139,18 +144,71 @@ def state_shardings(cfg: llama.LlamaConfig, optimizer, mesh: Mesh,
 # Steps
 # --------------------------------------------------------------------------
 
+def _value_and_grad_accum(loss_fn: Callable, params, batch,
+                          accum: int):
+    """value_and_grad, optionally accumulated over ``accum`` microbatches
+    (one fwd+bwd per microbatch under lax.scan, grads summed then
+    averaged — numerically the mean-loss gradient since every microbatch
+    holds batch/accum rows).  ``loss_fn(params, batch) -> (loss, aux)``.
+    """
+    if accum <= 1:
+        return jax.value_and_grad(loss_fn, has_aux=True)(params, batch)
+
+    def split(x):
+        assert x.shape[0] % accum == 0, \
+            f"batch {x.shape[0]} not divisible by grad_accum {accum}"
+        # INTERLEAVED split ([B] -> [B/A, A] -> scan axis A): microbatch
+        # k takes rows k, k+A, k+2A...  Keeping the (sharded) batch axis
+        # leading preserves its (dp, fsdp) layout — the contiguous
+        # [A, B/A] reshape would split the sharded dim and force an
+        # involuntary reshard per step.  Row partition is irrelevant to
+        # the weighted-mean math.
+        return jnp.moveaxis(
+            x.reshape(x.shape[0] // accum, accum, *x.shape[1:]), 1, 0)
+
+    micro = jax.tree.map(split, batch)
+
+    def wcount(mb):
+        # Microbatch weight = its REAL token count, so a masked batch
+        # reproduces the full-batch masked mean (equal-weight averaging
+        # would overweight sparse microbatches' tokens).
+        m = mb.get("mask")
+        if m is not None:
+            return m.astype(jnp.float32).sum()
+        return jnp.float32(mb["tokens"].shape[0] * mb["tokens"].shape[1])
+
+    def body(carry, mb):
+        gsum, lsum, wsum = carry
+        (l, aux), g = jax.value_and_grad(loss_fn, has_aux=True)(params, mb)
+        w = wcount(mb)
+        # Accumulate in f32: bf16 sums would round away small
+        # per-microbatch contributions at large accum.
+        gsum = jax.tree.map(
+            lambda s, x: s + x.astype(jnp.float32) * w, gsum, g)
+        return (gsum, lsum + l * w, wsum + w), aux
+
+    zeros = jax.tree.map(
+        lambda p: jnp.zeros(p.shape, jnp.float32), params)
+    (gsum, lsum, wsum), auxs = jax.lax.scan(
+        body, (zeros, jnp.float32(0), jnp.float32(0)), micro)
+    grads = jax.tree.map(
+        lambda s, p: (s / wsum).astype(p.dtype), gsum, params)
+    aux = jax.tree.map(lambda a: jnp.mean(a, axis=0), auxs)
+    return (lsum / wsum, aux), grads
+
+
 def make_train_step(cfg: llama.LlamaConfig, tc: TrainConfig,
                     optimizer) -> Callable:
     """Unsharded (single-device / auto-sharded) jitted train step."""
 
     def step(state, batch):
-        def loss(params):
+        def loss(params, b):
             return llama.loss_fn(cfg, _compute_cast(cfg, tc, params),
-                                 batch["tokens"],
-                                 batch["targets"], batch.get("mask"),
+                                 b["tokens"],
+                                 b["targets"], b.get("mask"),
                                  tc.z_loss)
-        (l, metrics), grads = jax.value_and_grad(loss, has_aux=True)(
-            state["params"])
+        (l, metrics), grads = _value_and_grad_accum(
+            loss, state["params"], batch, tc.grad_accum)
         updates, new_opt = optimizer.update(grads, state["opt_state"],
                                             state["params"])
         new_params = optax.apply_updates(state["params"], updates)
@@ -187,13 +245,13 @@ def make_sharded_train_fns(cfg: llama.LlamaConfig, tc: TrainConfig,
         out_shardings=sh)
 
     def step(state, batch):
-        def loss(params):
+        def loss(params, b):
             return llama.loss_fn(cfg, _compute_cast(cfg, tc, params),
-                                 batch["tokens"],
-                                 batch["targets"], None, tc.z_loss,
+                                 b["tokens"],
+                                 b["targets"], None, tc.z_loss,
                                  mesh=mesh)
-        (l, metrics), grads = jax.value_and_grad(loss, has_aux=True)(
-            state["params"])
+        (l, metrics), grads = _value_and_grad_accum(
+            loss, state["params"], batch, tc.grad_accum)
         updates, new_opt = optimizer.update(grads, state["opt_state"],
                                             state["params"])
         new_params = optax.apply_updates(state["params"], updates)
