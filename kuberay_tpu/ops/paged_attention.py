@@ -37,14 +37,21 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG_INF = -1e30
 
 
+def flat_indices(tables, block_size: int):
+    """[B, max_blocks] tables -> [B, K] flat pool positions.  THE one
+    logical->physical position map for gathered views (values and the
+    int8 scale pools must resolve identically)."""
+    B, nblk = tables.shape
+    return (tables[:, :, None] * block_size +
+            jnp.arange(block_size)[None, None, :]).reshape(
+        B, nblk * block_size)
+
+
 def gather_view(pool, tables, block_size: int):
     """[Hkv, P, D] pool + [B, max_blocks] tables -> [B, K, Hkv, D]
     contiguous per-request view (the round-1 materialized path; kept as
     the prefill view builder and the XLA fallback)."""
-    B, nblk = tables.shape
-    K = nblk * block_size
-    flat = (tables[:, :, None] * block_size +
-            jnp.arange(block_size)[None, None, :]).reshape(B, K)
+    flat = flat_indices(tables, block_size)
     # [Hkv, B, K, D] -> [B, K, Hkv, D]
     return jnp.take(pool, flat, axis=1).transpose(1, 2, 0, 3)
 

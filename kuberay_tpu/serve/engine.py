@@ -183,10 +183,11 @@ class ServeEngine:
             self._forward = forward_with_cache_mixtral
         else:
             self._forward = forward_with_cache
-        if kv_quant != "none":
+        if kv_quant != "none" and self.USES_BASE_FORWARD:
             from kuberay_tpu.serve.kv_cache import make_quantized_forward
             # decode_impl is the operational escape hatch: "xla" routes
-            # the int8 decode read around the Pallas kernel.
+            # the int8 decode read around the Pallas kernel.  (Paged
+            # engines bring their own quant forward — paged_kv.)
             self._forward = make_quantized_forward(self._forward,
                                                    decode_impl=decode_impl,
                                                    mesh=mesh)

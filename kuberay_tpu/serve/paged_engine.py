@@ -46,7 +46,8 @@ class PagedServeEngine(ServeEngine):
                  max_slots: int = 8, max_len: int = 2048,
                  num_blocks: int = 0, block_size: int = 16,
                  rng_seed: int = 0, decode_impl: str = "auto",
-                 prefill_chunk: int = 0, speculative: int = 0, mesh=None):
+                 prefill_chunk: int = 0, speculative: int = 0,
+                 kv_quant: str = "none", mesh=None):
         # Default pool = the dense engine's footprint; callers shrink it
         # to realize the memory win (e.g. slots * expected_len).
         num_blocks = num_blocks or (max_slots * max_len) // block_size
@@ -65,26 +66,34 @@ class PagedServeEngine(ServeEngine):
         if isinstance(cfg, MixtralConfig):
             from kuberay_tpu.serve.kv_cache import forward_with_cache_mixtral
             base = forward_with_cache_mixtral
-        self._paged_fwd = make_paged_forward(block_size, base_forward=base,
-                                             decode_impl=decode_impl,
-                                             mesh=mesh)
+        if kv_quant == "int8":
+            from kuberay_tpu.serve.paged_kv import make_paged_quant_forward
+            self._paged_fwd = make_paged_quant_forward(
+                block_size, base_forward=base, decode_impl=decode_impl,
+                mesh=mesh)
+        else:
+            self._paged_fwd = make_paged_forward(
+                block_size, base_forward=base, decode_impl=decode_impl,
+                mesh=mesh)
         # super().__init__ jits self._prefill_impl/_decode_impl, which
         # resolve to the paged overrides below, and builds the cache via
         # the _init_cache hook (sharded over the mesh when given).
         super().__init__(cfg, params, max_slots=max_slots, max_len=max_len,
                          rng_seed=rng_seed, prefill_chunk=prefill_chunk,
-                         speculative=speculative, mesh=mesh)
+                         speculative=speculative, kv_quant=kv_quant,
+                         mesh=mesh)
         self.allocator = BlockAllocator(num_blocks, block_size)
         self.tables = np.zeros((max_slots, self.max_blocks), dtype=np.int32)
         self.owned: List[List[int]] = [[] for _ in range(max_slots)]
         self._wait_state = None        # (request id, num_free) at last block
 
     def _init_cache(self):
-        return init_paged_cache(self.cfg, self.num_blocks, self.block_size)
+        return init_paged_cache(self.cfg, self.num_blocks, self.block_size,
+                                quant=self.kv_quant)
 
     def _cache_sharding_tree(self, mesh):
         from kuberay_tpu.serve.sharding import paged_cache_shardings
-        return paged_cache_shardings(mesh)
+        return paged_cache_shardings(mesh, self.kv_quant)
 
     # ------------------------------------------------------------------
     # jitted kernels (paged signatures)

@@ -161,16 +161,28 @@ class BlockAllocator:
 # Device-side paged forward
 # ---------------------------------------------------------------------------
 
-def init_paged_cache(cfg, num_blocks: int, block_size: int
-                     ) -> Dict[str, jax.Array]:
+def init_paged_cache(cfg, num_blocks: int, block_size: int,
+                     quant: str = "none") -> Dict[str, jax.Array]:
     """Flat physical pool, head-major: [L, Hkv, num_blocks*block_size, D].
 
     Head-major so one (head, page) pair is a contiguous
     ``block_size * head_dim`` run — the paged Pallas kernel's indirect
     page fetch is then a single dense DMA (ops/paged_attention.py).
+
+    ``quant="int8"`` stores the pool as int8 with one f32 absmax scale
+    per (head, position) vector: the pool at rest is ~half the bf16
+    bytes, which is the knob that matters — more blocks per HBM GB means
+    more concurrent requests (vLLM kv_cache_dtype=int8 role).
     """
     shape = (cfg.n_layers, cfg.n_kv_heads,
              num_blocks * block_size, cfg.head_dim)
+    if quant == "int8":
+        sshape = shape[:-1]
+        leaf = lambda: {"q": jnp.zeros(shape, jnp.int8),     # noqa: E731
+                        "s": jnp.zeros(sshape, jnp.float32)}
+        return {"k": leaf(), "v": leaf()}
+    if quant != "none":
+        raise ValueError(f"unknown kv quant {quant!r}")
     return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
 
 
@@ -180,6 +192,110 @@ def _physical_positions(block_tables, positions, block_size):
     blk = positions // block_size                               # [B, T]
     phys_blk = jnp.take_along_axis(block_tables, blk, axis=1)   # [B, T]
     return phys_blk * block_size + positions % block_size
+
+
+def gather_scales(spool, tables, block_size: int):
+    """[Hkv, P] scale pool + [B, max_blocks] tables -> [B, Hkv, K]
+    per-request view in the dense quant kernels' lane-major layout
+    (same flat_indices as gather_view — scales must resolve through the
+    identical logical->physical map as their values)."""
+    from kuberay_tpu.ops.paged_attention import flat_indices
+    flat = flat_indices(tables, block_size)
+    return jnp.take(spool, flat, axis=1).transpose(1, 0, 2)   # [B, Hkv, K]
+
+
+def make_paged_quant_forward(block_size: int, base_forward=None,
+                             decode_impl: str = "auto", mesh=None):
+    """int8 paged pool: quantize-on-write scatter + per-request gathered
+    int8 views consumed by the DENSE quant attention (decode kernel +
+    _cached_attention_quant_multi).
+
+    Deliberate design: the gather materializes an int8 logical view per
+    step — half the bytes of the round-1 bf16 gather — instead of a
+    block-native quant Pallas kernel.  The quant pool's win is HBM
+    CAPACITY (twice the blocks per GB -> more concurrent requests); a
+    table-native int8 kernel is future work gated on hardware validation
+    (round 2's lesson: interpret-mode passes do not validate lane
+    tiling).
+    """
+    from kuberay_tpu.serve.kv_cache import (
+        _cached_attention_quant_multi,
+        forward_with_cache,
+        quantize_kv,
+    )
+    from kuberay_tpu.ops.decode_attention import decode_attention_quant
+    from kuberay_tpu.ops.paged_attention import gather_view
+    base = base_forward or forward_with_cache
+
+    def fwd(cfg, params, tokens, cache, block_tables, start,
+            write_mask=None, token_mask=None):
+        B, T = tokens.shape
+        P = cache["k"]["q"].shape[2]
+        positions = start[:, None] + jnp.arange(T)[None, :]
+        phys = _physical_positions(block_tables, positions, block_size)
+        if write_mask is None:
+            write_mask = jnp.ones((B,), jnp.float32)
+        wgate = token_mask if token_mask is not None \
+            else jnp.broadcast_to(write_mask[:, None], (B, T))
+        wphys = jnp.where(wgate > 0, phys, P).reshape(-1)
+
+        def kv_update(ck, cv, kk, vv):        # ck/cv: {"q","s"} per layer
+            H, D = kk.shape[2], kk.shape[3]
+            kq, ks = quantize_kv(kk)          # [B,T,H,D] i8, [B,T,H,1]
+            vq, vs = quantize_kv(vv)
+
+            def scat(pool, rows):             # pool [H,P,...] rows [B,T,H,..]
+                r = rows.reshape(B * T, H, *rows.shape[3:]).swapaxes(0, 1)
+                return pool.at[:, wphys].set(r.astype(pool.dtype),
+                                             mode="drop")
+            nk = {"q": scat(ck["q"], kq), "s": scat(ck["s"], ks[..., 0])}
+            nv = {"q": scat(cv["q"], vq), "s": scat(cv["s"], vs[..., 0])}
+            if T == 1:
+                return nk, nv, nk, nv
+            view = lambda p: {                               # noqa: E731
+                "q": gather_view(p["q"], block_tables, block_size),
+                "s": gather_scales(p["s"], block_tables, block_size)}
+            return nk, nv, view(nk), view(nv)
+
+        if T == 1:
+            def attention(q, pk, pv, lens, q_positions):
+                kq = gather_view(pk["q"], block_tables, block_size)
+                ks = gather_scales(pk["s"], block_tables, block_size)
+                vq = gather_view(pv["q"], block_tables, block_size)
+                vs = gather_scales(pv["s"], block_tables, block_size)
+
+                def local(q_, kq_, ks_, vq_, vs_, lens_):
+                    return decode_attention_quant(
+                        q_[:, 0], kq_, ks_, vq_, vs_, lens_,
+                        impl=decode_impl)[:, None]
+
+                if mesh is None:
+                    return local(q, kq, ks, vq, vs, lens)
+                from jax.sharding import PartitionSpec as P_
+                fn = jax.shard_map(
+                    local, mesh=mesh,
+                    in_specs=(P_(None, None, ("tp", "tpr"), None),
+                              P_(None, None, "tp", None),
+                              P_(None, "tp", None),
+                              P_(None, None, "tp", None),
+                              P_(None, "tp", None), P_(None)),
+                    out_specs=P_(None, None, ("tp", "tpr"), None),
+                    check_vma=False)
+                return fn(q, kq, ks, vq, vs, lens)
+        else:
+            if mesh is None:
+                attention = _cached_attention_quant_multi
+            else:
+                from kuberay_tpu.serve.sharding import (
+                    make_tp_attention_quant)
+                attention = make_tp_attention_quant(
+                    mesh, _cached_attention_quant_multi)
+
+        return base(cfg, params, tokens, cache, start, write_mask,
+                    token_mask=token_mask, kv_update=kv_update,
+                    attention=attention)
+
+    return fwd
 
 
 def make_paged_forward(block_size: int, base_forward=None,

@@ -233,9 +233,6 @@ def main(argv=None):  # pragma: no cover - process wrapper
                          "contract joins them into one jax.distributed "
                          "group and hosts >0 become lockstep followers")
     args = ap.parse_args(argv)
-    if args.paged and args.kv_quant != "none":
-        ap.error("--kv-quant is not supported with --paged yet "
-                 "(dense engine only)")
     # Slice identity: same env contract as the training launcher
     # (TPU_WORKER_ID / TPU_WORKER_HOSTNAMES injected by builders/pod.py).
     from kuberay_tpu.train.launcher import (
@@ -271,7 +268,8 @@ def main(argv=None):  # pragma: no cover - process wrapper
                          block_size=args.block_size,
                          decode_impl=args.decode_impl,
                          prefill_chunk=args.prefill_chunk,
-                         speculative=args.speculative, mesh=mesh)
+                         speculative=args.speculative,
+                         kv_quant=args.kv_quant, mesh=mesh)
     else:
         engine_kw = dict(max_slots=args.max_slots, max_len=args.max_len,
                          prefill_chunk=args.prefill_chunk,

@@ -190,10 +190,14 @@ def make_tp_attention_quant(mesh: Mesh, attention_fn):
     return attention
 
 
-def paged_cache_shardings(mesh: Mesh):
+def paged_cache_shardings(mesh: Mesh, quant: str = "none"):
     """Shardings for the paged pool layout [L, Hkv, P, D]
-    (paged_kv.init_paged_cache): kv heads on tp, replicated on tpr."""
+    (paged_kv.init_paged_cache): kv heads on tp, replicated on tpr.
+    int8 adds per-(head, position) scale pools [L, Hkv, P]."""
     kv = NamedSharding(mesh, P(None, "tp", None, None))
+    if quant == "int8":
+        leaf = {"q": kv, "s": NamedSharding(mesh, P(None, "tp", None))}
+        return {"k": leaf, "v": leaf}
     return {"k": kv, "v": kv}
 
 

@@ -185,15 +185,18 @@ def _value_and_grad_accum(loss_fn: Callable, params, batch,
         # per-microbatch contributions at large accum.
         gsum = jax.tree.map(
             lambda s, x: s + x.astype(jnp.float32) * w, gsum, g)
-        return (gsum, lsum + l * w, wsum + w), aux
+        return (gsum, lsum + l * w, wsum + w), (aux, w)
 
     zeros = jax.tree.map(
         lambda p: jnp.zeros(p.shape, jnp.float32), params)
-    (gsum, lsum, wsum), auxs = jax.lax.scan(
+    (gsum, lsum, wsum), (auxs, ws) = jax.lax.scan(
         body, (zeros, jnp.float32(0), jnp.float32(0)), micro)
     grads = jax.tree.map(
         lambda s, p: (s / wsum).astype(p.dtype), gsum, params)
-    aux = jax.tree.map(lambda a: jnp.mean(a, axis=0), auxs)
+    # Aux metrics get the SAME token weighting as the gradients — an
+    # equal-weight mean would misreport loss/accuracy under skewed masks.
+    aux = jax.tree.map(
+        lambda a: jnp.tensordot(ws, a, axes=(0, 0)) / wsum, auxs)
     return (lsum / wsum, aux), grads
 
 

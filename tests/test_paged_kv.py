@@ -249,3 +249,44 @@ def test_paged_mixtral_warm_cache_invariant(params):
     out = eng.run()
     assert out[0].tokens == expected
     assert eng.stats["prefix_hit_tokens"] > 0    # sharing now on for MoE
+
+
+def test_int8_paged_pool_matrix():
+    """int8 paged pool (quantize-on-write scatter + gathered int8 views
+    into the dense quant attention): half the pool bytes at rest, and
+    every composition stays exact against its own int8 twin — TP,
+    chunked prefill, speculative."""
+    import jax
+
+    from kuberay_tpu.models import llama
+    from kuberay_tpu.serve.engine import Request
+    from kuberay_tpu.serve.paged_engine import PagedServeEngine
+    from kuberay_tpu.serve.sharding import serve_mesh
+
+    cfg = llama.CONFIGS["llama_tiny"]
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    prompts = [[1, 2, 3, 4, 5], [9, 8, 7], [1, 2, 3, 4, 5, 6, 7],
+               list(range(24))]
+
+    def run(**kw):
+        eng = PagedServeEngine(cfg, params, max_slots=3, max_len=64,
+                               block_size=8, kv_quant="int8",
+                               decode_impl="xla", **kw)
+        for i, p in enumerate(prompts):
+            eng.add_request(Request(f"r{i}", p, max_new_tokens=6))
+        return {r.request_id: r.tokens for r in eng.run()}, eng
+
+    base, eng = run()
+    assert eng.cache["k"]["q"].dtype.name == "int8"
+    tp, _ = run(mesh=serve_mesh(2))
+    assert base == tp
+    ck, _ = run(prefill_chunk=16)
+    ctp, _ = run(prefill_chunk=16, mesh=serve_mesh(2))
+    assert ck == ctp
+    spec, seng = run(speculative=4)
+    assert spec == base                    # greedy spec is exact
+    spec_tp, _ = run(speculative=4, mesh=serve_mesh(2))
+    assert spec_tp == base
+    spec_ck, _ = run(speculative=4, prefill_chunk=16)
+    ck_base, _ = run(prefill_chunk=16)
+    assert spec_ck == ck_base              # spec+chunk vs chunk twin
