@@ -290,3 +290,28 @@ def test_int8_paged_pool_matrix():
     spec_ck, _ = run(speculative=4, prefill_chunk=16)
     ck_base, _ = run(prefill_chunk=16)
     assert spec_ck == ck_base              # spec+chunk vs chunk twin
+
+
+def test_int8_paged_mixtral():
+    """MoE + paged + int8: the quant pool is orthogonal to the FFN (both
+    route through forward_with_cache's strategy seams)."""
+    import jax
+
+    from kuberay_tpu.models import mixtral
+    from kuberay_tpu.serve.engine import Request
+    from kuberay_tpu.serve.paged_engine import PagedServeEngine
+
+    cfg = mixtral.CONFIGS["mixtral_tiny"]
+    params = mixtral.init_params(cfg, jax.random.PRNGKey(0))
+
+    def run(**kw):
+        eng = PagedServeEngine(cfg, params, max_slots=2, max_len=64,
+                               block_size=8, **kw)
+        for i, p in enumerate([[1, 2, 3, 4, 5], [9, 8, 7]]):
+            eng.add_request(Request(f"r{i}", p, max_new_tokens=5))
+        return {r.request_id: r.tokens for r in eng.run()}
+
+    out = run(kv_quant="int8", decode_impl="xla")
+    assert all(len(t) == 5 for t in out.values())
+    # int8 twin is deterministic.
+    assert out == run(kv_quant="int8", decode_impl="xla")
