@@ -3,8 +3,9 @@
 Subcommands mirror `kubectl ray` with TPU flags first-class
 (generation.go:150-232 TPU resource/node-selector handling is native here):
 
-    tpuctl get clusters|jobs|services|slices|events
+    tpuctl get clusters|jobs|services|slices|workergroups|events
     tpuctl create cluster NAME --tpu v5p --topology 4x4x4 --slices 2 ...
+    tpuctl create workergroup NAME --cluster C --tpu v5e --topology 2x4
     tpuctl scale NAME --group G --replicas N
     tpuctl submit NAME --tpu ... -- python -m train ...
     tpuctl suspend|resume (cluster|job) NAME
@@ -34,6 +35,7 @@ KIND_BY_ALIAS = {
     "service": "TpuService", "services": "TpuService",
     "cronjob": "TpuCronJob", "cronjobs": "TpuCronJob",
     "events": "Event", "pods": "Pod", "slices": "Pod",
+    "workergroup": "TpuCluster", "workergroups": "TpuCluster",
     "computetemplate": "ComputeTemplate",
     "computetemplates": "ComputeTemplate",
 }
@@ -93,10 +95,32 @@ def _slice_rows(items):
     return _table(rows, ["SLICE", "CLUSTER", "GROUP", "HOSTS-READY"])
 
 
-def build_cluster_manifest(args) -> Dict[str, Any]:
-    topo = SliceTopology.create(args.tpu, args.topology)  # validates early
-    worker = {
-        "groupName": args.group,
+class _MutateAbort(Exception):
+    """A mutation callback found the object unsuitable; message -> stderr."""
+
+
+def _mutate_with_retry(client: ApiClient, kind: str, name: str, ns: str,
+                       fn, attempts: int = 4):
+    """GET-mutate-UPDATE with optimistic-concurrency retry: a 409 rv
+    conflict (controller wrote between our read and write) re-fetches
+    and re-applies ``fn`` — THE read-modify-write helper for every CLI
+    spec edit."""
+    for attempt in range(attempts):
+        obj = client.get(kind, name, ns)
+        fn(obj)
+        try:
+            return client.update(obj)
+        except ApiError as e:
+            if e.code != 409 or attempt == attempts - 1:
+                raise
+
+
+def build_worker_group(args, group_name: str) -> Dict[str, Any]:
+    """One WorkerGroupSpec from CLI flags (shared by `create cluster` and
+    `create workergroup` — ref kubectl-plugin generation.go:150-232)."""
+    SliceTopology.create(args.tpu, args.topology)         # validates early
+    return {
+        "groupName": group_name,
         "accelerator": args.tpu,
         "topology": args.topology,
         "replicas": args.slices,
@@ -107,6 +131,10 @@ def build_cluster_manifest(args) -> Dict[str, Any]:
              "resources": {"requests": {"cpu": args.worker_cpu,
                                         "memory": args.worker_memory}}}]}},
     }
+
+
+def build_cluster_manifest(args) -> Dict[str, Any]:
+    worker = build_worker_group(args, args.group)
     spec = {
         "headGroupSpec": {"template": {"spec": {"containers": [
             {"name": "head", "image": args.image}]}}},
@@ -140,9 +168,12 @@ def main(argv=None):
     st.add_argument("resource", choices=["cluster", "job", "service", "cronjob"])
     st.add_argument("name")
 
-    cc = sub.add_parser("create", help="create a cluster")
-    cc.add_argument("what", choices=["cluster"])
+    cc = sub.add_parser("create",
+                        help="create a cluster or add a worker group")
+    cc.add_argument("what", choices=["cluster", "workergroup"])
     cc.add_argument("name")
+    cc.add_argument("--cluster", default="",
+                    help="(workergroup) existing TpuCluster to extend")
     cc.add_argument("--tpu", default="v5e", help="TPU generation (v4/v5e/v5p/v6e)")
     cc.add_argument("--topology", default="2x2", help="ICI topology, e.g. 4x4x4")
     cc.add_argument("--slices", type=int, default=1)
@@ -257,7 +288,22 @@ def _dispatch(args, client: ApiClient) -> int:
     if args.cmd == "get":
         kind = KIND_BY_ALIAS[args.resource]
         items = client.list(kind, ns, getattr(args, "selector", ""))
-        if args.resource == "slices":
+        if args.resource in ("workergroup", "workergroups"):
+            rows = []
+            for c in items:
+                st = c.get("status", {})
+                for grp in c.get("spec", {}).get("workerGroupSpecs", []):
+                    rows.append([
+                        grp.get("groupName", ""), c["metadata"]["name"],
+                        grp.get("accelerator", ""),
+                        grp.get("topology", ""),
+                        str(grp.get("replicas", 0)),
+                        f"{grp.get('minReplicas', 0)}/"
+                        f"{grp.get('maxReplicas', 0)}",
+                        str(st.get("state", ""))])
+            print(_table(rows, ["GROUP", "CLUSTER", "ACCEL", "TOPOLOGY",
+                                "SLICES", "MIN/MAX", "CLUSTER-STATE"]))
+        elif args.resource == "slices":
             print(_slice_rows(items))
         elif kind == "TpuCluster":
             print(_cluster_rows(items))
@@ -335,25 +381,68 @@ def _dispatch(args, client: ApiClient) -> int:
         return 0
 
     if args.cmd == "create":
+        if args.what == "workergroup":
+            # Add a worker group to an EXISTING cluster (ref
+            # kubectl-plugin `kubectl ray create workergroup`), with
+            # optimistic-concurrency retry against controller writes.
+            if not args.cluster:
+                print("error: --cluster is required for workergroup",
+                      file=sys.stderr)
+                return 1
+            for flag, bad in (("--group", args.group != "workers"),
+                              ("--autoscale", args.autoscale)):
+                if bad:
+                    print(f"error: {flag} is not valid for workergroup "
+                          f"(the positional NAME names the group)",
+                          file=sys.stderr)
+                    return 1
+            group = build_worker_group(args, args.name)
+
+            def add_group(obj):
+                groups = obj["spec"].setdefault("workerGroupSpecs", [])
+                if any(g.get("groupName") == args.name for g in groups):
+                    raise _MutateAbort(
+                        f"error: group {args.name!r} already exists in "
+                        f"{args.cluster}")
+                groups.append(group)
+
+            try:
+                _mutate_with_retry(client, C.KIND_CLUSTER, args.cluster,
+                                   ns, add_group)
+            except _MutateAbort as e:
+                print(e, file=sys.stderr)
+                return 1
+            print(f"workergroup/{args.name} added to "
+                  f"tpucluster/{args.cluster}")
+            return 0
+        if args.cluster:
+            print("error: --cluster only applies to workergroup",
+                  file=sys.stderr)
+            return 1
         obj = client.create(build_cluster_manifest(args))
         print(f"tpucluster/{obj['metadata']['name']} created")
         return 0
 
     if args.cmd == "scale":
-        obj = client.get(C.KIND_CLUSTER, args.name, ns)
-        groups = obj["spec"]["workerGroupSpecs"]
-        target = None
-        for g in groups:
-            if args.group in (None, g["groupName"]):
-                target = g
-                break
-        if target is None:
-            print(f"error: group {args.group!r} not found", file=sys.stderr)
+        scaled = {}
+
+        def do_scale(obj):
+            for g in obj["spec"]["workerGroupSpecs"]:
+                if args.group in (None, g["groupName"]):
+                    g["replicas"] = args.replicas
+                    g["maxReplicas"] = max(g.get("maxReplicas", 0),
+                                           args.replicas)
+                    scaled["group"] = g["groupName"]
+                    return
+            raise _MutateAbort(f"error: group {args.group!r} not found")
+
+        try:
+            _mutate_with_retry(client, C.KIND_CLUSTER, args.name, ns,
+                               do_scale)
+        except _MutateAbort as e:
+            print(e, file=sys.stderr)
             return 1
-        target["replicas"] = args.replicas
-        target["maxReplicas"] = max(target.get("maxReplicas", 0), args.replicas)
-        client.update(obj)
-        print(f"tpucluster/{args.name} group {target['groupName']} "
+        print(f"tpucluster/{args.name} group {scaled['group']} "
               f"scaled to {args.replicas} slices")
         return 0
 

@@ -209,3 +209,29 @@ def test_histogram_invariants():
     # le=0.5 bucket holds exactly the two 0.3s.
     half = next(l for l in text.splitlines() if 'le="0.5"' in l)
     assert half.rsplit(" ", 1)[1] == "2"
+
+
+def test_cli_create_workergroup(op):
+    """`tpuctl create workergroup` extends an existing cluster (ref
+    `kubectl ray create workergroup`); the controller then provisions
+    the new group's slices; `get workergroups` lists both."""
+    rc, out = run_cli(op, "create", "cluster", "wg1", "--tpu", "v5p",
+                      "--topology", "2x2x2", "--slices", "1")
+    assert rc == 0
+    wait_for(lambda: ApiClient(op.api_url).get(C.KIND_CLUSTER, "wg1").get(
+        "status", {}).get("state") == "ready")
+    rc, out = run_cli(op, "create", "workergroup", "inference",
+                      "--cluster", "wg1", "--tpu", "v5e",
+                      "--topology", "2x2", "--slices", "2")
+    assert rc == 0 and "added" in out
+    wait_for(lambda: ApiClient(op.api_url).get(C.KIND_CLUSTER, "wg1").get(
+        "status", {}).get("readySlices") == 3)
+    rc, out = run_cli(op, "get", "workergroups")
+    assert rc == 0 and "inference" in out and "workers" in out
+    assert "2x2x2" in out and "v5e" in out   # both groups' rows render
+    # Duplicate group name refused.
+    rc, out = run_cli(op, "create", "workergroup", "inference",
+                      "--cluster", "wg1", "--tpu", "v5e",
+                      "--topology", "2x2")
+    assert rc == 1
+    run_cli(op, "delete", "cluster", "wg1")
