@@ -345,23 +345,18 @@ def _dispatch(args, client: ApiClient) -> int:
                     except ApiError as e:
                         if e.code != 409:
                             raise
-                        # Exists: apply spec + metadata labels/annotations.
-                        # Live reconcilers bump resourceVersion constantly,
-                        # so retry conflicts like kubectl does.
-                        for attempt in range(4):
-                            cur = client.get(kind, name,
-                                             doc["metadata"]["namespace"])
+                        # Exists: apply spec + metadata labels/annotations
+                        # (conflict-retried like kubectl — reconcilers
+                        # bump resourceVersion constantly).
+                        def apply_doc(cur, doc=doc):
                             cur["spec"] = doc.get("spec", cur.get("spec"))
                             for mkey in ("labels", "annotations"):
                                 if mkey in doc["metadata"]:
                                     cur["metadata"][mkey] = \
                                         doc["metadata"][mkey]
-                            try:
-                                client.update(cur)
-                                break
-                            except ApiError as ue:
-                                if ue.code != 409 or attempt == 3:
-                                    raise
+                        _mutate_with_retry(
+                            client, kind, name,
+                            doc["metadata"]["namespace"], apply_doc)
                         print(f"{kind.lower()}/{name} configured")
                     applied += 1
                 except (ApiError, KeyError, AttributeError, TypeError) as e:
@@ -389,12 +384,14 @@ def _dispatch(args, client: ApiClient) -> int:
                 print("error: --cluster is required for workergroup",
                       file=sys.stderr)
                 return 1
-            for flag, bad in (("--group", args.group != "workers"),
-                              ("--autoscale", args.autoscale)):
+            for flag, bad, why in (
+                    ("--group", args.group != "workers",
+                     "the positional NAME names the group"),
+                    ("--autoscale", args.autoscale,
+                     "autoscaling is a cluster-level field")):
                 if bad:
                     print(f"error: {flag} is not valid for workergroup "
-                          f"(the positional NAME names the group)",
-                          file=sys.stderr)
+                          f"({why})", file=sys.stderr)
                     return 1
             group = build_worker_group(args, args.name)
 
@@ -427,7 +424,13 @@ def _dispatch(args, client: ApiClient) -> int:
         scaled = {}
 
         def do_scale(obj):
-            for g in obj["spec"]["workerGroupSpecs"]:
+            groups = obj["spec"]["workerGroupSpecs"]
+            if args.group is None and len(groups) > 1:
+                raise _MutateAbort(
+                    "error: cluster has multiple worker groups "
+                    f"({', '.join(g['groupName'] for g in groups)}) — "
+                    "pass --group")
+            for g in groups:
                 if args.group in (None, g["groupName"]):
                     g["replicas"] = args.replicas
                     g["maxReplicas"] = max(g.get("maxReplicas", 0),
@@ -599,11 +602,13 @@ def _dispatch(args, client: ApiClient) -> int:
 
     if args.cmd in ("suspend", "resume"):
         kind = KIND_BY_ALIAS[args.resource]
-        obj = client.get(kind, args.name, ns)
-        obj["spec"]["suspend"] = args.cmd == "suspend"
-        if args.cmd == "suspend" and kind == C.KIND_JOB:
-            obj["spec"]["shutdownAfterJobFinishes"] = True
-        client.update(obj)
+
+        def flip(obj):
+            obj["spec"]["suspend"] = args.cmd == "suspend"
+            if args.cmd == "suspend" and kind == C.KIND_JOB:
+                obj["spec"]["shutdownAfterJobFinishes"] = True
+
+        _mutate_with_retry(client, kind, args.name, ns, flip)
         print(f"{args.resource}/{args.name} {args.cmd}{'ed' if args.cmd == 'suspend' else 'd'}")
         return 0
 
