@@ -18,6 +18,7 @@ health polling sees RUNNING (runtime/coordinator_server.py PUT
 from __future__ import annotations
 
 import threading
+import time
 import uuid
 from http.server import ThreadingHTTPServer
 from typing import Any, Dict, Optional
@@ -95,6 +96,18 @@ class ServeFrontend:
                     # Paged engines expose pool/prefix-cache counters.
                     **getattr(self.engine, "stats", {})}
 
+    def drain(self, timeout: float = 60.0) -> bool:
+        """Graceful shutdown step: let the engine loop finish queued +
+        in-flight requests (their submit() callers get real responses)
+        instead of dropping them mid-roll.  Returns True when fully
+        drained, False on timeout (remaining work is abandoned)."""
+        deadline = time.monotonic() + timeout       # wall-clock-step safe
+        while time.monotonic() < deadline:
+            if not self.engine.has_work():
+                return True
+            time.sleep(0.05)
+        return False
+
     def close(self, timeout: Optional[float] = 2.0):
         """Stop the engine loop.  ``timeout=None`` blocks until the
         thread is actually dead — required before a multi-host engine
@@ -156,7 +169,10 @@ class ServeFrontend:
                 try:
                     max_tokens = int(body.get("max_tokens", 64))
                     temperature = float(body.get("temperature", 0.0))
-                    timeout = float(body.get("timeout", 300.0))
+                    # Clamped: shutdown joins handler threads, so an
+                    # unbounded client timeout would become an unbounded
+                    # SIGTERM-to-exit time.
+                    timeout = min(float(body.get("timeout", 300.0)), 600.0)
                 except (TypeError, ValueError) as e:
                     return self._send(400, {"message": f"bad parameter: {e}"})
                 if max_tokens <= 0:
@@ -173,7 +189,14 @@ class ServeFrontend:
                     "prompt_len": resp.prompt_len,
                 })
 
-        return ThreadingHTTPServer((host, port), Handler)
+        srv = ThreadingHTTPServer((host, port), Handler)
+        # Non-daemon handler threads: socketserver only tracks (and
+        # server_close() only joins) non-daemon threads, and the
+        # graceful-drain path depends on that join — a daemonic handler
+        # can be killed at interpreter exit between its submit()
+        # returning and the response bytes hitting the socket.
+        srv.daemon_threads = False
+        return srv
 
     def serve_background(self, host="127.0.0.1", port=0):
         from kuberay_tpu.utils.httpjson import serve_background
@@ -313,11 +336,43 @@ def main(argv=None):  # pragma: no cover - process wrapper
         args.coordinator = dashboard_url(addr) if addr else ""
     if args.coordinator:
         register_with_coordinator(args.app_name, args.coordinator)
-    print(f"serving {args.model} on {args.host}:{args.port} "
+    print(f"serving {args.model} on {args.host}:{srv.server_address[1]} "
           f"(tp={tp}, hosts={jax.process_count()})", flush=True)
+    # Graceful termination (a TpuService roll SIGTERMs old-cluster pods):
+    # stop accepting, DRAIN in-flight requests to real responses, then
+    # shut the engine down.  The handler must not call srv.shutdown()
+    # inline — it runs on the thread executing serve_forever.
+    import signal
+
+    def _on_term(signum, frame):
+        print("serve: SIGTERM — draining", flush=True)
+        threading.Thread(target=srv.shutdown, daemon=True).start()
+
+    try:
+        signal.signal(signal.SIGTERM, _on_term)
+    except ValueError:  # pragma: no cover - non-main thread
+        pass
     try:
         srv.serve_forever()
     finally:
+        if args.coordinator:
+            # FIRST: flip the app status so the controller stops routing
+            # here while we drain (we already stopped accepting).
+            try:
+                register_with_coordinator(args.app_name, args.coordinator,
+                                          status="STOPPED")
+            except Exception:
+                pass
+        drained = frontend.drain(timeout=60.0)
+        # Join in-flight HTTP handler threads (non-daemon by
+        # make_server precisely so server_close tracks and joins them;
+        # a daemonic handler could die between its submit() returning
+        # and the response bytes hitting the socket).
+        try:
+            srv.server_close()
+        except OSError:
+            pass
+        print(f"serve: drained={drained}", flush=True)
         # Quiesce the engine-loop thread BEFORE broadcasting STOP — two
         # threads issuing collectives concurrently can pair a follower's
         # receive with the wrong send.  Wait for real thread death, not a

@@ -6,6 +6,8 @@ import urllib.error
 import urllib.request
 
 import jax
+import time
+
 import pytest
 
 from kuberay_tpu.models import llama
@@ -119,3 +121,93 @@ def test_metrics_endpoint():
     finally:
         fe.close()
         srv.shutdown()
+
+
+def test_frontend_drain_completes_inflight():
+    """drain() lets an in-flight request finish with a REAL response
+    (the TpuService-roll SIGTERM path must not drop work)."""
+    import threading
+
+    import jax
+
+    from kuberay_tpu.models import llama
+    from kuberay_tpu.serve.engine import ServeEngine
+    from kuberay_tpu.serve.server import ServeFrontend
+
+    cfg = llama.CONFIGS["llama_tiny"]
+    eng = ServeEngine(cfg, llama.init_params(cfg, jax.random.PRNGKey(0)),
+                      max_slots=2, max_len=64)
+    fe = ServeFrontend(eng)
+    results = {}
+
+    def client():
+        results["r"] = fe.submit([1, 2, 3], max_tokens=10, timeout=120)
+
+    t = threading.Thread(target=client)
+    t.start()
+    try:
+        deadline = time.time() + 30
+        while time.time() < deadline and not eng.has_work():
+            time.sleep(0.01)
+        assert fe.drain(timeout=120)
+        t.join(30)
+        assert results["r"] is not None
+        assert len(results["r"].tokens) == 10
+    finally:
+        fe.close()
+
+
+@pytest.mark.timeout(240)
+def test_server_sigterm_drains_then_exits():
+    """SIGTERM mid-request: the server stops accepting, finishes the
+    in-flight completion, reports drained, and exits cleanly."""
+    import json
+    import os
+    import signal
+    import subprocess
+    import sys
+    import threading
+    import urllib.request
+
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu"})
+    srv = subprocess.Popen(
+        [sys.executable, "-m", "kuberay_tpu.serve.server", "--model",
+         "llama_tiny", "--port", "0", "--host", "127.0.0.1",
+         "--max-slots", "2", "--max-len", "64"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, bufsize=1)
+    try:
+        # Ephemeral port: parse the actual bound port from the banner.
+        port = None
+        deadline = time.time() + 120
+        while time.time() < deadline and port is None:
+            line = srv.stdout.readline()
+            if not line:
+                break
+            if "serving llama_tiny" in line:
+                port = int(line.split(" on ", 1)[1].split(" ")[0]
+                           .rsplit(":", 1)[1])
+        assert port, "server never printed its banner"
+        result = {}
+
+        def request():
+            req = json.dumps({"prompt_tokens": [1, 2, 3],
+                              "max_tokens": 12}).encode()
+            r = urllib.request.urlopen(urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/completions", data=req,
+                headers={"Content-Type": "application/json"}), timeout=150)
+            result.update(json.loads(r.read()))
+
+        t = threading.Thread(target=request)
+        t.start()
+        time.sleep(0.5)                      # request in flight
+        srv.send_signal(signal.SIGTERM)
+        t.join(timeout=180)
+        out, _ = srv.communicate(timeout=120)
+        out = out or ""
+        assert srv.returncode == 0, out[-2000:]
+        assert "draining" in out and "drained=True" in out, out[-2000:]
+        assert len(result.get("tokens", [])) == 12, (result, out[-1000:])
+    finally:
+        srv.kill()
