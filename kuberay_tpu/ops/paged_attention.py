@@ -182,16 +182,30 @@ def paged_decode_attention_pallas(q, pk, pv, lens, tables, block_size: int,
     )(tables.astype(jnp.int32), lens.astype(jnp.int32), q, pk4, pv4)
 
 
+def _check_paged_kernel() -> bool:
+    """First-use on-chip self-check for the auto path (see
+    decode_attention._auto_impl — round 2's interpret-passes-but-wrong-
+    on-silicon lesson)."""
+    S, Hq, Hkv, D, bs, nblk, P = 4, 8, 4, 128, 16, 4, 32
+    ks = jax.random.split(jax.random.PRNGKey(19), 4)
+    q = jax.random.normal(ks[0], (S, Hq, D), jnp.bfloat16)
+    pk = jax.random.normal(ks[1], (Hkv, P * bs, D), jnp.bfloat16)
+    pv = jax.random.normal(ks[2], (Hkv, P * bs, D), jnp.bfloat16)
+    tables = jax.random.randint(ks[3], (S, nblk), 0, P)
+    lens = jnp.array([1, 17, 40, 64], jnp.int32)
+    from kuberay_tpu.ops.decode_attention import kernels_match
+    return kernels_match(
+        paged_decode_attention_pallas(q, pk, pv, lens, tables, bs),
+        paged_decode_attention_xla(q, pk, pv, lens, tables, bs))
+
+
 def paged_decode_attention(q, pk, pv, lens, tables, block_size: int,
                            scale: Optional[float] = None,
                            impl: str = "auto"):
     """Dispatching paged decode.  impl: auto|pallas|xla|pallas_interpret."""
     if impl == "auto":
-        try:
-            on_tpu = jax.default_backend() == "tpu"
-        except Exception:
-            on_tpu = False
-        impl = "pallas" if on_tpu else "xla"
+        from kuberay_tpu.ops.decode_attention import _auto_impl
+        impl = _auto_impl("paged_decode", _check_paged_kernel)
     if impl == "xla":
         return paged_decode_attention_xla(q, pk, pv, lens, tables,
                                           block_size, scale)

@@ -72,3 +72,100 @@ def test_engine_generation_unchanged_by_kernel_path():
     finally:
         da.decode_attention = orig
     assert kernel_out == baseline
+
+
+def test_auto_impl_self_check_caches_and_falls_back(monkeypatch):
+    """The auto path's first-use on-chip self-check: failures (wrong
+    numerics OR lowering errors) permanently fall back to XLA for the
+    process; the check runs exactly once per kernel kind."""
+    from kuberay_tpu.ops import decode_attention as da
+
+    da._AUTO_VERDICTS.clear()
+    monkeypatch.setattr(da.jax, "default_backend", lambda: "tpu")
+    try:
+        calls = []
+
+        def bad():
+            calls.append(1)
+            return False
+
+        assert da._auto_impl("k-bad", bad) == "xla"
+        assert da._auto_impl("k-bad", bad) == "xla"   # cached
+        assert len(calls) == 1
+
+        def boom():
+            raise RuntimeError("Mosaic lowering failed")
+
+        assert da._auto_impl("k-boom", boom) == "xla"
+
+        assert da._auto_impl("k-good", lambda: True) == "pallas"
+    finally:
+        da._AUTO_VERDICTS.clear()
+
+
+def test_auto_off_tpu_never_runs_checks(monkeypatch):
+    from kuberay_tpu.ops import decode_attention as da
+
+    da._AUTO_VERDICTS.clear()
+    monkeypatch.setattr(da.jax, "default_backend", lambda: "cpu")
+
+    def explode():
+        raise AssertionError("check must not run off-TPU")
+
+    assert da._auto_impl("k-cpu", explode) == "xla"
+    assert not da._AUTO_VERDICTS      # nothing cached
+
+
+def test_auto_self_check_executes_eagerly_inside_jit_trace(monkeypatch):
+    """The dispatch runs at TRACE time (the serve engine jits the step
+    that reaches it): the self-check must EXECUTE eagerly there — a
+    staged check's float() would raise ConcretizationTypeError and
+    masquerade as a kernel failure, permanently disabling Pallas."""
+    import jax
+    import jax.numpy as jnp
+
+    from kuberay_tpu.ops import decode_attention as da
+
+    da._AUTO_VERDICTS.clear()
+    monkeypatch.setattr(da.jax, "default_backend", lambda: "tpu")
+    try:
+        def check():
+            # Representative of the real checks: device compute + a
+            # host float() comparison.
+            return float(jnp.max(jnp.ones(4) * 2.0)) == 2.0
+
+        def traced(x):
+            impl = da._auto_impl("k-trace", check)
+            return x + (1.0 if impl == "pallas" else 0.0)
+
+        out = float(jax.jit(traced)(jnp.float32(0)))
+        assert out == 1.0                       # check passed -> pallas
+        assert da._AUTO_VERDICTS["k-trace"] is True
+    finally:
+        da._AUTO_VERDICTS.clear()
+
+
+def test_auto_end_to_end_degrades_not_crashes(monkeypatch):
+    """With the backend claiming to be TPU while actually CPU, the REAL
+    self-checks either pass (pallas lowers on this backend) or fail —
+    but decode_attention(auto) must return correct numbers either way."""
+    import jax
+    import jax.numpy as jnp
+
+    from kuberay_tpu.ops import decode_attention as da
+
+    da._AUTO_VERDICTS.clear()
+    monkeypatch.setattr(da.jax, "default_backend", lambda: "tpu")
+    try:
+        S, M, Hq, Hkv, D = 2, 64, 4, 2, 64
+        ks = jax.random.split(jax.random.PRNGKey(3), 3)
+        q = jax.random.normal(ks[0], (S, Hq, D), jnp.float32)
+        ck = jax.random.normal(ks[1], (S, M, Hkv, D), jnp.float32)
+        cv = jax.random.normal(ks[2], (S, M, Hkv, D), jnp.float32)
+        lens = jnp.array([10, 64], jnp.int32)
+        got = da.decode_attention(q, ck, cv, lens, impl="auto")
+        want = da.decode_attention_xla(q, ck, cv, lens)
+        assert float(jnp.max(jnp.abs(got - want))) < 5e-2
+        assert "decode" in da._AUTO_VERDICTS    # the check ran and cached
+    finally:
+        da._AUTO_VERDICTS.clear()
