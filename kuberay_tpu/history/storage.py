@@ -407,7 +407,13 @@ class AzureBlobStorage(StorageBackend):
         query = query or {}
         url = self.endpoint + urllib.parse.quote(path, safe="/-_.~")
         if query:
-            url += "?" + urllib.parse.urlencode(sorted(query.items()))
+            # Percent-encode (never '+'-for-space): Azure canonicalizes
+            # by PERCENT-decoding the query string, so a quote_plus '+'
+            # would decode to a literal '+' server-side and 403 any
+            # prefix containing a space.  With %20 the decoded value the
+            # server signs matches the raw value we sign below.
+            url += "?" + urllib.parse.urlencode(
+                sorted(query.items()), quote_via=urllib.parse.quote)
         ct = "application/octet-stream" if method == "PUT" else ""
         headers = self._auth_headers(method, path, query, payload, ct)
         if ct:

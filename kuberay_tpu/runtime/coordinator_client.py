@@ -30,7 +30,14 @@ def dashboard_url(coordinator_address: str) -> str:
 
 
 class CoordinatorError(Exception):
-    pass
+    """``code`` carries the HTTP status when the server answered (409 =
+    duplicate submit, 5xx = transient server-side) and None when the
+    request never completed (connect refused / timeout) — callers branch
+    on it instead of parsing the message text."""
+
+    def __init__(self, message: str, code: Optional[int] = None):
+        super().__init__(message)
+        self.code = code
 
 
 class JobInfo:
@@ -70,7 +77,8 @@ class CoordinatorClient:
                 payload = resp.read()
                 return json.loads(payload) if payload else {}
         except urllib.error.HTTPError as e:
-            raise CoordinatorError(f"{method} {path}: HTTP {e.code}") from e
+            raise CoordinatorError(f"{method} {path}: HTTP {e.code}",
+                                   code=e.code) from e
         except Exception as e:
             raise CoordinatorError(f"{method} {path}: {e}") from e
 
@@ -178,7 +186,7 @@ class FakeCoordinatorClient:
         with self._lock:
             info = self.jobs.get(job_id)
             if info is None:
-                raise CoordinatorError(f"job {job_id} not found")
+                raise CoordinatorError(f"job {job_id} not found", code=404)
             return info
 
     def stop_job(self, job_id):

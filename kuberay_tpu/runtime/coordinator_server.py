@@ -322,7 +322,13 @@ class CoordinatorServer:
                 ev.setdefault("ts", now)
                 ev.setdefault("type", "task")
                 self._event_seq += 1
-                ev["id"] = f"{self._event_boot}-{self._event_seq}"
+                # Honor a client-supplied id so a POST retried after a
+                # lost response dedups in the collector's archive instead
+                # of landing twice under distinct server-minted ids.
+                # Only non-empty strings: anything else (non-hashable,
+                # empty) would poison the collector's id-keyed dedup set.
+                if not (isinstance(ev.get("id"), str) and ev["id"]):
+                    ev["id"] = f"{self._event_boot}-{self._event_seq}"
                 self.events.append(ev)
                 n += 1
         return n

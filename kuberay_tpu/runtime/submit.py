@@ -54,16 +54,18 @@ def main(argv=None) -> int:
                 print(f"submitted {args.job_id}", flush=True)
                 break
             except CoordinatorError as e:
-                msg = str(e)
-                if "HTTP 409" in msg or "already" in msg:
+                if e.code == 409:
                     # Duplicate submission after a submitter restart —
                     # idempotent: fall through and attach.
                     print(f"already submitted, attaching: {e}", flush=True)
                     break
-                # Only a coordinator that is not LISTENING yet is worth
-                # waiting for; a reachable one rejecting the request
-                # (auth, validation — "HTTP 4xx/5xx") is a hard error.
-                if "HTTP " in msg or time.time() >= deadline:
+                # Retry within the wait budget on anything transient: the
+                # coordinator not listening yet (code None: connect
+                # refused/timeout) or a 5xx from a proxy fronting a
+                # still-booting head.  Definitive 4xx rejections (auth,
+                # validation) are hard errors immediately.
+                transient = e.code is None or e.code >= 500
+                if not transient or time.time() >= deadline:
                     print(f"submit failed: {e}", file=sys.stderr)
                     return 1
                 print(f"coordinator not ready, retrying: {e}",
@@ -82,7 +84,7 @@ def main(argv=None) -> int:
             info = client.get_job_info(args.job_id)
             consecutive_errors = 0
         except CoordinatorError as e:
-            if "404" in str(e) and not submitted:
+            if e.code == 404 and not submitted:
                 print(f"job {args.job_id} not found", file=sys.stderr)
                 return 1
             consecutive_errors += 1

@@ -187,6 +187,17 @@ class PagedServeEngine(ServeEngine):
         self.owned[slot] = []
         self.tables[slot] = 0
 
+    def _shrink_headroom(self, slot: int) -> None:
+        """Return unwritten draft-headroom tail blocks (beyond what
+        lens+1 needs) to the pool.  Tail blocks are always slot-private
+        (prefix-shared blocks sit at the front of ``owned``), and KV
+        past ``lens`` is semantically dead, so freeing is safe."""
+        keep = self._blocks_needed(int(self.lens[slot]) + 1)
+        while len(self.owned[slot]) > keep:
+            bid = self.owned[slot].pop()
+            self.tables[slot, len(self.owned[slot])] = 0
+            self.allocator.free(bid)
+
     # ------------------------------------------------------------------
     # scheduling overrides
     # ------------------------------------------------------------------
@@ -337,6 +348,10 @@ class PagedServeEngine(ServeEngine):
                 continue
             if req.temperature > 0 or \
                     self._spec_miss[i] >= self.SPEC_MISS_LIMIT:
+                # Slot became draft-ineligible (sampling / backed off):
+                # give its idle headroom back so other slots' mandatory
+                # blocks don't preempt while this one hoards capacity.
+                self._shrink_headroom(i)
                 continue
             want = int(self.lens[i]) + 1 + self.speculative
             while len(self.owned[i]) * self.block_size < want:

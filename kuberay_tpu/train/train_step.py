@@ -249,9 +249,12 @@ def make_sharded_train_fns(cfg: llama.LlamaConfig, tc: TrainConfig,
 
     def step(state, batch):
         def loss(params, b):
+            # mask is threaded through (not dropped) so the loss agrees
+            # with _value_and_grad_accum's token-count microbatch
+            # weighting when a masked batch reaches the sharded path.
             return llama.loss_fn(cfg, _compute_cast(cfg, tc, params),
                                  b["tokens"],
-                                 b["targets"], None, tc.z_loss,
+                                 b["targets"], b.get("mask"), tc.z_loss,
                                  mesh=mesh)
         (l, metrics), grads = _value_and_grad_accum(
             loss, state["params"], batch, tc.grad_accum)
@@ -266,9 +269,11 @@ def make_sharded_train_fns(cfg: llama.LlamaConfig, tc: TrainConfig,
             "opt_state": new_opt,
         }, metrics
 
+    # batch_sh is a pytree-prefix sharding: every batch leaf (tokens,
+    # targets, optional mask — all [B, S]) shards over (dp/fsdp, sp).
     step_jit = jax.jit(
         step,
-        in_shardings=(sh, {"tokens": batch_sh, "targets": batch_sh}),
+        in_shardings=(sh, batch_sh),
         out_shardings=(sh, None),
         donate_argnums=(0,))
     return init, step_jit, sh

@@ -559,6 +559,17 @@ def test_job_log_tail_param(tmp_path):
 # aliyunoss}): fakes re-derive the signatures with the shared secret.
 
 
+def _strict_parse_qs(rawq: str) -> dict:
+    """Strict PERCENT-decoding, exactly like real Azure: unquote()
+    leaves '+' as a literal plus, so a client that quote_plus-encodes a
+    space fails this fake the way it fails real Azure."""
+    query = {}
+    for part in rawq.split("&") if rawq else []:
+        k, _, v = part.partition("=")
+        query[urllib.parse.unquote(k)] = urllib.parse.unquote(v)
+    return query
+
+
 class _FakeAzure(BaseHTTPRequestHandler):
     objects = {}
     account, key_b64 = "acct", "c2VjcmV0LWtleQ=="     # b64("secret-key")
@@ -572,7 +583,7 @@ class _FakeAzure(BaseHTTPRequestHandler):
         if not auth.startswith(f"SharedKey {self.account}:"):
             return False
         path, _, rawq = self.path.partition("?")
-        query = dict(urllib.parse.parse_qsl(rawq))
+        query = _strict_parse_qs(rawq)
         canon_headers = "".join(
             f"{k.lower()}:{v}\n" for k, v in sorted(
                 (k, v) for k, v in self.headers.items()
@@ -608,7 +619,7 @@ class _FakeAzure(BaseHTTPRequestHandler):
             self.send_response(403), self.end_headers()
             return
         path, _, rawq = self.path.partition("?")
-        q = dict(urllib.parse.parse_qsl(rawq))
+        q = _strict_parse_qs(rawq)
         if q.get("comp") == "list":
             container = path.strip("/")
             prefix = q.get("prefix", "")
@@ -654,6 +665,13 @@ def test_azure_blob_backend_wire_protocol():
         assert st.list("meta/") == ["meta/default/c1/doc.json"]
         st.delete("meta/default/c1/doc.json")
         assert st.get("meta/default/c1/doc.json") is None
+        # Prefixes whose urlencoding rewrites characters (space, '+',
+        # '#', unicode) must still sign correctly: the fake percent-
+        # decodes strictly, so a quote_plus space would 403 here.
+        st.put("dir with space/a+b/doc#1.json", b"x")
+        assert st.list("dir with space/") == ["dir with space/a+b/doc#1.json"]
+        assert st.list("dir with space/a+b/") == \
+            ["dir with space/a+b/doc#1.json"]
         # Bad key -> server rejects the signature.
         bad = AzureBlobStorage("acct", "arch", account_key="d3Jvbmc=",
                                endpoint=f"http://127.0.0.1:{srv.server_port}")

@@ -205,3 +205,29 @@ def test_paged_speculative_exact_and_capacity_capped():
     tiny, _ = run(num_blocks=18, speculative=4)
     tiny_base, _ = run(num_blocks=18)
     assert tiny == tiny_base
+
+
+def test_draft_headroom_released_when_slot_backs_off():
+    """A slot that becomes draft-ineligible (spec-miss backoff or
+    sampling) must return its idle draft-headroom blocks to the pool
+    instead of hoarding them until it finishes."""
+    from kuberay_tpu.serve.paged_engine import PagedServeEngine
+
+    cfg = CONFIGS["llama_tiny"]
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    eng = PagedServeEngine(cfg, params, max_slots=2, max_len=128,
+                           block_size=8, speculative=4, num_blocks=32)
+    eng.add_request(Request("r0", list(range(20)), max_new_tokens=32))
+    # One step admits the request and grows best-effort draft headroom.
+    eng.step()
+    headroom = len(eng.owned[0]) * eng.block_size - int(eng.lens[0]) - 1
+    assert headroom >= 1, "precondition: slot acquired draft headroom"
+    free_before = eng.allocator.num_free
+    # Force the slot into spec-miss backoff; the next pass must shed the
+    # now-idle headroom blocks.
+    eng._spec_miss[0] = eng.SPEC_MISS_LIMIT
+    eng._decode_all()
+    assert len(eng.owned[0]) == eng._blocks_needed(int(eng.lens[0]) + 1)
+    assert eng.allocator.num_free > free_before
+    # Table tail cleared for the dropped blocks.
+    assert all(eng.tables[0, len(eng.owned[0]):] == 0)

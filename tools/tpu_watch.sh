@@ -11,7 +11,10 @@ MARKER=/tmp/tpu_capture.started
 rm -f "$MARKER"
 while true; do
   if timeout 60 python -c "
+import os
+os.environ['JAX_PLATFORMS'] = 'tpu'
 import jax, jax.numpy as jnp
+assert jax.devices()[0].platform == 'tpu', jax.devices()
 x = jnp.ones((128,128), jnp.bfloat16)
 assert float((x@x).sum()) > 0
 " >/dev/null 2>&1; then
