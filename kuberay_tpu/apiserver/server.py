@@ -435,6 +435,60 @@ class ApiHandler(JsonHandler):
             return self._error(409, str(e))
         return self._send(200, out)
 
+    # Content-Type -> store patch_type (the four kube patch MIME types;
+    # apply-patch is +yaml on the wire but JSON is a YAML subset and all
+    # our clients send JSON bodies).
+    _PATCH_TYPES = {
+        "application/merge-patch+json": "merge",
+        "application/strategic-merge-patch+json": "strategic",
+        "application/json-patch+json": "json",
+        "application/apply-patch+yaml": "apply",
+        "application/apply-patch+json": "apply",
+    }
+
+    def do_PATCH(self):
+        if not self._authorized():
+            return
+        route = self._route()
+        if route is None:
+            return self._error(404, "unknown path")
+        kind, ns, name, sub = route
+        if ns is None or not name:
+            return self._error(
+                405, "PATCH requires a namespaced resource name")
+        ctype = (self.headers.get("Content-Type", "")
+                 .split(";")[0].strip().lower())
+        patch_type = self._PATCH_TYPES.get(ctype)
+        if patch_type is None:
+            return self._error(
+                415, f"unsupported patch content type {ctype!r}",
+                reason="UnsupportedMediaType")
+        q = parse_qs(urlparse(self.path).query)
+        field_manager = q.get("fieldManager", [""])[0]
+        force = q.get("force", ["false"])[0] in ("true", "1")
+        if patch_type == "apply" and not field_manager:
+            return self._error(422, "apply requires fieldManager")
+        try:
+            body = self._body()
+        except json.JSONDecodeError as e:
+            return self._error(400, f"bad JSON: {e}")
+        validate = None
+        if kind in _VALIDATED_KINDS and sub != "status":
+            def validate(old, new):
+                return validate_admission(new, old)
+        try:
+            out = self.store.patch(
+                kind, name, ns, body, patch_type=patch_type,
+                subresource=sub or "", field_manager=field_manager,
+                force=force, validate=validate)
+        except NotFound as e:
+            return self._error(404, str(e))
+        except Conflict as e:
+            return self._error(409, str(e), reason="Conflict")
+        except Invalid as e:
+            return self._error(422, str(e), reason="Invalid")
+        return self._send(200, out)
+
     def do_DELETE(self):
         if not self._authorized():
             return

@@ -95,26 +95,6 @@ def _slice_rows(items):
     return _table(rows, ["SLICE", "CLUSTER", "GROUP", "HOSTS-READY"])
 
 
-class _MutateAbort(Exception):
-    """A mutation callback found the object unsuitable; message -> stderr."""
-
-
-def _mutate_with_retry(client: ApiClient, kind: str, name: str, ns: str,
-                       fn, attempts: int = 4):
-    """GET-mutate-UPDATE with optimistic-concurrency retry: a 409 rv
-    conflict (controller wrote between our read and write) re-fetches
-    and re-applies ``fn`` — THE read-modify-write helper for every CLI
-    spec edit."""
-    for attempt in range(attempts):
-        obj = client.get(kind, name, ns)
-        fn(obj)
-        try:
-            return client.update(obj)
-        except ApiError as e:
-            if e.code != 409 or attempt == attempts - 1:
-                raise
-
-
 def build_worker_group(args, group_name: str) -> Dict[str, Any]:
     """One WorkerGroupSpec from CLI flags (shared by `create cluster` and
     `create workergroup` — ref kubectl-plugin generation.go:150-232)."""
@@ -163,6 +143,9 @@ def main(argv=None):
     app = sub.add_parser("apply", help="apply manifest file(s)")
     app.add_argument("-f", "--filename", action="append", required=True,
                      help="YAML/JSON manifest (repeatable; multi-doc ok)")
+    app.add_argument("--force-conflicts", action="store_true",
+                     help="steal fields owned by other managers "
+                          "(Server-Side Apply force)")
 
     st = sub.add_parser("status", help="full status of one resource")
     st.add_argument("resource", choices=["cluster", "job", "service", "cronjob"])
@@ -339,25 +322,28 @@ def _dispatch(args, client: ApiClient) -> int:
                 kind = doc.get("kind", "?")
                 name = doc["metadata"].get("name", "?")
                 try:
+                    # Server-Side Apply upsert (kubectl apply --server-
+                    # side semantics): the server creates or merges our
+                    # declared fields, tracks tpuctl's ownership in
+                    # managedFields, and 409s if another manager (the
+                    # autoscaler, tpuctl-scale, ...) owns a field we
+                    # change; --force-conflicts steals ownership.  A
+                    # partial manifest against a MISSING object still
+                    # 422s — there is nothing to merge into.
+                    existed = True
                     try:
-                        client.create(doc)
-                        print(f"{kind.lower()}/{name} created")
+                        client.get(kind, name, doc["metadata"]["namespace"])
                     except ApiError as e:
-                        if e.code != 409:
+                        if e.code != 404:
                             raise
-                        # Exists: apply spec + metadata labels/annotations
-                        # (conflict-retried like kubectl — reconcilers
-                        # bump resourceVersion constantly).
-                        def apply_doc(cur, doc=doc):
-                            cur["spec"] = doc.get("spec", cur.get("spec"))
-                            for mkey in ("labels", "annotations"):
-                                if mkey in doc["metadata"]:
-                                    cur["metadata"][mkey] = \
-                                        doc["metadata"][mkey]
-                        _mutate_with_retry(
-                            client, kind, name,
-                            doc["metadata"]["namespace"], apply_doc)
-                        print(f"{kind.lower()}/{name} configured")
+                        existed = False
+                    client.patch(
+                        kind, name, doc["metadata"]["namespace"],
+                        doc, patch_type="apply",
+                        field_manager="tpuctl",
+                        force=args.force_conflicts)
+                    print(f"{kind.lower()}/{name} "
+                          f"{'configured' if existed else 'created'}")
                     applied += 1
                 except (ApiError, KeyError, AttributeError, TypeError) as e:
                     # kubectl semantics: report and continue the batch
@@ -395,20 +381,19 @@ def _dispatch(args, client: ApiClient) -> int:
                     return 1
             group = build_worker_group(args, args.name)
 
-            def add_group(obj):
-                groups = obj["spec"].setdefault("workerGroupSpecs", [])
-                if any(g.get("groupName") == args.name for g in groups):
-                    raise _MutateAbort(
-                        f"error: group {args.name!r} already exists in "
-                        f"{args.cluster}")
-                groups.append(group)
-
-            try:
-                _mutate_with_retry(client, C.KIND_CLUSTER, args.cluster,
-                                   ns, add_group)
-            except _MutateAbort as e:
-                print(e, file=sys.stderr)
+            cur = client.get(C.KIND_CLUSTER, args.cluster, ns)
+            if any(g.get("groupName") == args.name
+                   for g in cur["spec"].get("workerGroupSpecs", [])):
+                print(f"error: group {args.name!r} already exists in "
+                      f"{args.cluster}", file=sys.stderr)
                 return 1
+            # Strategic merge on workerGroupSpecs (mergeKey groupName):
+            # an unknown key APPENDS, existing groups are untouched —
+            # one round trip, no conflict loop.
+            client.patch(C.KIND_CLUSTER, args.cluster, ns,
+                         {"spec": {"workerGroupSpecs": [group]}},
+                         patch_type="strategic",
+                         field_manager="tpuctl-edit")
             print(f"workergroup/{args.name} added to "
                   f"tpucluster/{args.cluster}")
             return 0
@@ -421,31 +406,31 @@ def _dispatch(args, client: ApiClient) -> int:
         return 0
 
     if args.cmd == "scale":
-        scaled = {}
-
-        def do_scale(obj):
-            groups = obj["spec"]["workerGroupSpecs"]
-            if args.group is None and len(groups) > 1:
-                raise _MutateAbort(
-                    "error: cluster has multiple worker groups "
-                    f"({', '.join(g['groupName'] for g in groups)}) — "
-                    "pass --group")
-            for g in groups:
-                if args.group in (None, g["groupName"]):
-                    g["replicas"] = args.replicas
-                    g["maxReplicas"] = max(g.get("maxReplicas", 0),
-                                           args.replicas)
-                    scaled["group"] = g["groupName"]
-                    return
-            raise _MutateAbort(f"error: group {args.group!r} not found")
-
-        try:
-            _mutate_with_retry(client, C.KIND_CLUSTER, args.name, ns,
-                               do_scale)
-        except _MutateAbort as e:
-            print(e, file=sys.stderr)
+        # One read resolves the target group; the write is a strategic
+        # PATCH on just {replicas, maxReplicas} of that group — a
+        # concurrent controller/autoscaler edit to anything else is
+        # never clobbered and never 409s us.
+        obj = client.get(C.KIND_CLUSTER, args.name, ns)
+        groups = obj["spec"].get("workerGroupSpecs", [])
+        if args.group is None and len(groups) > 1:
+            print("error: cluster has multiple worker groups "
+                  f"({', '.join(g['groupName'] for g in groups)}) — "
+                  "pass --group", file=sys.stderr)
             return 1
-        print(f"tpucluster/{args.name} group {scaled['group']} "
+        target = next((g for g in groups
+                       if args.group in (None, g["groupName"])), None)
+        if target is None:
+            print(f"error: group {args.group!r} not found", file=sys.stderr)
+            return 1
+        client.patch(
+            C.KIND_CLUSTER, args.name, ns,
+            {"spec": {"workerGroupSpecs": [{
+                "groupName": target["groupName"],
+                "replicas": args.replicas,
+                "maxReplicas": max(target.get("maxReplicas", 0),
+                                   args.replicas)}]}},
+            patch_type="strategic", field_manager="tpuctl-scale")
+        print(f"tpucluster/{args.name} group {target['groupName']} "
               f"scaled to {args.replicas} slices")
         return 0
 
@@ -602,13 +587,11 @@ def _dispatch(args, client: ApiClient) -> int:
 
     if args.cmd in ("suspend", "resume"):
         kind = KIND_BY_ALIAS[args.resource]
-
-        def flip(obj):
-            obj["spec"]["suspend"] = args.cmd == "suspend"
-            if args.cmd == "suspend" and kind == C.KIND_JOB:
-                obj["spec"]["shutdownAfterJobFinishes"] = True
-
-        _mutate_with_retry(client, kind, args.name, ns, flip)
+        spec_patch = {"suspend": args.cmd == "suspend"}
+        if args.cmd == "suspend" and kind == C.KIND_JOB:
+            spec_patch["shutdownAfterJobFinishes"] = True
+        client.patch(kind, args.name, ns, {"spec": spec_patch},
+                     patch_type="merge", field_manager="tpuctl-edit")
         print(f"{args.resource}/{args.name} {args.cmd}{'ed' if args.cmd == 'suspend' else 'd'}")
         return 0
 

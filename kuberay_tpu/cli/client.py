@@ -34,11 +34,12 @@ class ApiClient:
             base = f"/apis/tpu.dev/v1/namespaces/{ns}/{plural}"
         return base + (f"/{name}" if name else "")
 
-    def _req(self, method: str, path: str, body: Optional[dict] = None):
+    def _req(self, method: str, path: str, body: Any = None,
+             content_type: str = "application/json"):
         data = json.dumps(body).encode() if body is not None else None
         req = urllib.request.Request(self.base_url + path, data=data,
                                      method=method,
-                                     headers={"Content-Type": "application/json"})
+                                     headers={"Content-Type": content_type})
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
                 payload = resp.read()
@@ -77,6 +78,30 @@ class ApiClient:
         return self._req("PUT", self._path(obj["kind"],
                                            md.get("namespace", "default"),
                                            md["name"]), obj)
+
+    _PATCH_CTYPES = {
+        "merge": "application/merge-patch+json",
+        "strategic": "application/strategic-merge-patch+json",
+        "json": "application/json-patch+json",
+        "apply": "application/apply-patch+yaml",
+    }
+
+    def patch(self, kind: str, name: str, namespace: str = "default",
+              body: Any = None, *, patch_type: str = "merge",
+              field_manager: str = "", force: bool = False):
+        """Wire PATCH (merge | strategic | json | apply): one round trip
+        instead of a get→update conflict loop.  ``apply`` is Server-Side
+        Apply and requires ``field_manager``."""
+        path = self._path(kind, namespace, name)
+        q = {}
+        if field_manager:
+            q["fieldManager"] = field_manager
+        if force:
+            q["force"] = "true"
+        if q:
+            path += "?" + urllib.parse.urlencode(q)
+        return self._req("PATCH", path, body,
+                         content_type=self._PATCH_CTYPES[patch_type])
 
     def delete(self, kind: str, name: str, namespace: str = "default"):
         return self._req("DELETE", self._path(kind, namespace, name))

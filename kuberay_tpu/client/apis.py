@@ -55,6 +55,30 @@ class _KindApi:
     def update(self, body: Dict[str, Any]) -> Dict[str, Any]:
         return self.client.update(body)
 
+    def patch(self, name: str, body: Any, namespace: str = "default", *,
+              patch_type: str = "merge",
+              field_manager: str = "") -> Dict[str, Any]:
+        """Wire PATCH — merge (RFC 7386), strategic (merge-key lists),
+        or json (RFC 6902 ops); no read-modify-write race window."""
+        return self.client.patch(self.kind, name, namespace, body,
+                                 patch_type=patch_type,
+                                 field_manager=field_manager)
+
+    def apply(self, body: Dict[str, Any], namespace: str = "default", *,
+              field_manager: str = "tpu-python-client",
+              force: bool = False) -> Dict[str, Any]:
+        """Server-Side Apply upsert: declares desired fields; conflicts
+        with other field managers surface as ApiError 409 unless
+        ``force``."""
+        body = dict(body)
+        body.setdefault("apiVersion", "tpu.dev/v1")
+        body.setdefault("kind", self.kind)
+        md = body.setdefault("metadata", {})
+        md.setdefault("namespace", namespace)
+        return self.client.patch(
+            self.kind, md["name"], md["namespace"], body,
+            patch_type="apply", field_manager=field_manager, force=force)
+
     def delete(self, name: str, namespace: str = "default") -> bool:
         try:
             self.client.delete(self.kind, name, namespace)

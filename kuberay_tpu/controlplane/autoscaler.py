@@ -22,7 +22,7 @@ import time
 from typing import Dict, List
 
 from kuberay_tpu.api.tpucluster import TpuCluster
-from kuberay_tpu.controlplane.store import Conflict, ObjectStore
+from kuberay_tpu.controlplane.store import Conflict, NotFound, ObjectStore
 from kuberay_tpu.utils import constants as C
 
 
@@ -91,35 +91,37 @@ def decide(cluster: TpuCluster,
 
 def apply_decisions(store: ObjectStore, cluster_name: str, namespace: str,
                     decisions: List[GroupDecision]) -> bool:
-    """Patch the CR the way the reference's autoscaler does (Replicas +
-    ScaleStrategy), with optimistic-concurrency retry."""
+    """Scale via a single strategic-merge PATCH (workerGroupSpecs merge
+    by groupName): one round trip, no read-modify-write conflict loop,
+    and concurrent spec edits to OTHER fields are never clobbered — the
+    reference autoscaler likewise patches Replicas/WorkersToDelete
+    (raycluster_types.go:421-424) rather than replacing the spec."""
     if not decisions:
         return False
-    for _ in range(3):
-        obj = store.try_get(C.KIND_CLUSTER, cluster_name, namespace)
-        if obj is None:
-            return False
-        by_group = {d.group: d for d in decisions}
-        changed = False
-        for g in obj["spec"].get("workerGroupSpecs", []):
-            d = by_group.get(g.get("groupName"))
-            if d is None:
-                continue
-            if g.get("replicas") != d.replicas:
-                g["replicas"] = d.replicas
-                changed = True
-            ss = g.setdefault("scaleStrategy", {})
-            if sorted(ss.get("slicesToDelete", [])) != sorted(d.slices_to_delete):
-                ss["slicesToDelete"] = list(d.slices_to_delete)
-                changed = True
-        if not changed:
-            return False
-        try:
-            store.update(obj)
-            return True
-        except Conflict:
-            continue
-    return False
+    obj = store.try_get(C.KIND_CLUSTER, cluster_name, namespace)
+    if obj is None:
+        return False
+    known = {g.get("groupName") for g in
+             obj["spec"].get("workerGroupSpecs", [])}
+    groups = []
+    for d in decisions:
+        if d.group not in known:
+            continue       # a merge-keyed patch would APPEND unknown groups
+        groups.append({"groupName": d.group, "replicas": d.replicas,
+                       "scaleStrategy": {
+                           "slicesToDelete": list(d.slices_to_delete)}})
+    if not groups:
+        return False
+    try:
+        store.patch(C.KIND_CLUSTER, cluster_name, namespace,
+                    {"spec": {"workerGroupSpecs": groups}},
+                    patch_type="strategic",
+                    field_manager="tpu-autoscaler")
+        return True
+    except (Conflict, NotFound):
+        # rv preconditions are not used here, so Conflict only means the
+        # object vanished/recreated mid-flight; next pass re-decides.
+        return False
 
 
 class SliceAutoscaler:

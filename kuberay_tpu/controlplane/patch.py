@@ -1,0 +1,585 @@
+"""PATCH engines: json-merge, json-patch, strategic-merge, Server-Side
+Apply.
+
+The reference's V2 apiserver is a transparent proxy, so every kube PATCH
+verb works against it (apiserversdk/proxy.go:28-40); real tooling
+(autoscalers, kubectl, controllers) mutates via PATCH rather than
+read-modify-write.  This module gives our store/apiserver the same verb
+surface:
+
+- **json-merge** (RFC 7386, ``application/merge-patch+json``): recursive
+  dict merge; ``null`` deletes a key; lists replace wholesale.
+- **json-patch** (RFC 6902, ``application/json-patch+json``): an op list
+  (add/remove/replace/move/copy/test) addressed by JSON Pointers.
+- **strategic-merge** (``application/strategic-merge-patch+json``):
+  json-merge plus per-field list semantics — lists of objects with a
+  known merge key (MERGE_KEYS) merge element-wise, ``$patch: delete``
+  removes an element, ``$patch: replace`` forces wholesale replacement.
+  Kube derives merge keys from struct tags; we carry the table for our
+  CRD and core-pod shapes.
+- **Server-Side Apply** (``application/apply-patch+yaml``): declarative
+  upsert with field ownership.  Each manager's owned field set is stored
+  in ``metadata.managedFields`` (fieldsV1 shape); applying a field owned
+  by another manager with a different value is a 409 conflict unless
+  forced; fields a manager stops applying are pruned when no one else
+  owns them.
+
+The engines are pure (object in → object out); the store commits results
+atomically under its lock.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import time
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+# Field name -> merge key for strategic list merging (kube: struct tags;
+# ours: the CRD shapes in api/ + the core-pod subset the builders emit).
+MERGE_KEYS: Dict[str, str] = {
+    "workerGroupSpecs": "groupName",
+    "containers": "name",
+    "initContainers": "name",
+    "env": "name",
+    "volumes": "name",
+    "volumeMounts": "name",
+    "ports": "name",
+    "conditions": "type",
+    "tolerations": "key",
+    "imagePullSecrets": "name",
+    "hostAliases": "ip",
+}
+
+# Lists that merge as SETS of scalars (kube patchStrategy=merge on
+# scalar lists — metadata.finalizers is the one we rely on).
+SET_MERGE_LISTS = frozenset({"finalizers"})
+
+
+class PatchError(Exception):
+    """Malformed patch document (HTTP 400/422 at the API layer)."""
+
+
+class ApplyConflict(Exception):
+    """SSA field conflict. ``conflicts`` is [(path_str, other_manager)]."""
+
+    def __init__(self, conflicts: List[Tuple[str, str]]):
+        self.conflicts = conflicts
+        msg = "; ".join(f"{p} owned by {m!r}" for p, m in conflicts)
+        super().__init__(f"Apply failed with {len(conflicts)} conflict(s): "
+                         f"{msg}")
+
+
+# ---------------------------------------------------------------------------
+# RFC 7386 json-merge
+# ---------------------------------------------------------------------------
+
+def json_merge_patch(target: Any, patch: Any) -> Any:
+    if not isinstance(patch, dict):
+        return copy.deepcopy(patch)
+    out = dict(target) if isinstance(target, dict) else {}
+    for k, v in patch.items():
+        if v is None:
+            out.pop(k, None)
+        else:
+            out[k] = json_merge_patch(out.get(k), v)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# RFC 6902 json-patch
+# ---------------------------------------------------------------------------
+
+def _ptr_tokens(pointer: str) -> List[str]:
+    if pointer == "":
+        return []
+    if not pointer.startswith("/"):
+        raise PatchError(f"bad JSON pointer {pointer!r}")
+    return [t.replace("~1", "/").replace("~0", "~")
+            for t in pointer[1:].split("/")]
+
+
+def _ptr_walk(doc: Any, tokens: List[str]):
+    """Returns (parent, final_token) for a pointer; raises on missing
+    intermediate containers."""
+    cur = doc
+    for t in tokens[:-1]:
+        cur = _ptr_step(cur, t)
+    return cur, tokens[-1]
+
+
+def _ptr_step(cur: Any, token: str):
+    if isinstance(cur, list):
+        try:
+            return cur[int(token)]
+        except (ValueError, IndexError):
+            raise PatchError(f"bad list index {token!r}") from None
+    if isinstance(cur, dict):
+        if token not in cur:
+            raise PatchError(f"path member {token!r} not found")
+        return cur[token]
+    raise PatchError(f"cannot traverse {type(cur).__name__} with {token!r}")
+
+
+def _ptr_get(doc: Any, pointer: str):
+    cur = doc
+    for t in _ptr_tokens(pointer):
+        cur = _ptr_step(cur, t)
+    return cur
+
+
+def _ptr_add(doc, tokens, value):
+    parent, last = _ptr_walk(doc, tokens)
+    if isinstance(parent, list):
+        idx = len(parent) if last == "-" else int(last)
+        if not 0 <= idx <= len(parent):
+            raise PatchError(f"list index {last} out of range")
+        parent.insert(idx, value)
+    elif isinstance(parent, dict):
+        parent[last] = value
+    else:
+        raise PatchError("add target is not a container")
+
+
+def _ptr_remove(doc, tokens):
+    parent, last = _ptr_walk(doc, tokens)
+    if isinstance(parent, list):
+        try:
+            return parent.pop(int(last))
+        except (ValueError, IndexError):
+            raise PatchError(f"bad list index {last!r}") from None
+    if isinstance(parent, dict):
+        if last not in parent:
+            raise PatchError(f"remove: {last!r} not found")
+        return parent.pop(last)
+    raise PatchError("remove target is not a container")
+
+
+def json_patch(target: Any, ops: List[Dict[str, Any]]) -> Any:
+    """Apply an RFC 6902 op list; atomic — any failing op aborts."""
+    if not isinstance(ops, list):
+        raise PatchError("json-patch body must be a list of ops")
+    doc = copy.deepcopy(target)
+    for op in ops:
+        if not isinstance(op, dict) or "op" not in op:
+            raise PatchError(f"bad op {op!r}")
+        kind = op["op"]
+        path = op.get("path")
+        if path is None:
+            raise PatchError(f"op {kind!r} missing path")
+        tokens = _ptr_tokens(path)
+        if kind == "add":
+            if not tokens:
+                doc = copy.deepcopy(op.get("value"))
+            else:
+                _ptr_add(doc, tokens, copy.deepcopy(op.get("value")))
+        elif kind == "remove":
+            if not tokens:
+                raise PatchError("cannot remove whole document")
+            _ptr_remove(doc, tokens)
+        elif kind == "replace":
+            if not tokens:
+                doc = copy.deepcopy(op.get("value"))
+            else:
+                parent, last = _ptr_walk(doc, tokens)
+                _ptr_step(parent, last)          # must exist
+                if isinstance(parent, list):
+                    parent[int(last)] = copy.deepcopy(op.get("value"))
+                else:
+                    parent[last] = copy.deepcopy(op.get("value"))
+        elif kind == "move":
+            val = _ptr_remove(doc, _ptr_tokens(op.get("from", "")))
+            _ptr_add(doc, tokens, val)
+        elif kind == "copy":
+            val = copy.deepcopy(_ptr_get(doc, op.get("from", "")))
+            _ptr_add(doc, tokens, val)
+        elif kind == "test":
+            if _ptr_get(doc, path) != op.get("value"):
+                raise PatchError(f"test failed at {path}")
+        else:
+            raise PatchError(f"unknown op {kind!r}")
+    return doc
+
+
+# ---------------------------------------------------------------------------
+# strategic-merge
+# ---------------------------------------------------------------------------
+
+def strategic_merge_patch(target: Any, patch: Any,
+                          field: str = "") -> Any:
+    if isinstance(patch, dict):
+        if patch.get("$patch") == "replace":
+            out = {k: copy.deepcopy(v) for k, v in patch.items()
+                   if k != "$patch"}
+            return out
+        out = dict(target) if isinstance(target, dict) else {}
+        for k, v in patch.items():
+            if k == "$patch":
+                continue
+            if v is None:
+                out.pop(k, None)
+            else:
+                out[k] = strategic_merge_patch(out.get(k), v, field=k)
+        return out
+    if isinstance(patch, list):
+        key = MERGE_KEYS.get(field)
+        if key and all(isinstance(e, dict) for e in patch):
+            return _merge_keyed_list(
+                target if isinstance(target, list) else [], patch, key)
+        if field in SET_MERGE_LISTS:
+            base = list(target) if isinstance(target, list) else []
+            return base + [e for e in patch if e not in base]
+        return copy.deepcopy(patch)                    # atomic replace
+    return copy.deepcopy(patch)
+
+
+def _merge_keyed_list(target: List[dict], patch: List[dict],
+                      key: str) -> List[dict]:
+    out = [copy.deepcopy(e) for e in target]
+    index = {e.get(key): i for i, e in enumerate(out)
+             if isinstance(e, dict)}
+    for e in patch:
+        kv = e.get(key)
+        if kv is None:
+            raise PatchError(
+                f"list element missing merge key {key!r}: {e!r}")
+        if e.get("$patch") == "delete":
+            if kv in index:
+                idx = index.pop(kv)
+                out[idx] = None
+            continue
+        if kv in index:
+            out[index[kv]] = strategic_merge_patch(out[index[kv]], e)
+        else:
+            index[kv] = len(out)
+            out.append(strategic_merge_patch({}, e))
+    return [e for e in out if e is not None]
+
+
+# ---------------------------------------------------------------------------
+# Server-Side Apply
+# ---------------------------------------------------------------------------
+#
+# Field sets are sets of path tuples.  A path segment is either a dict
+# key (str) or a list-item key ("k", merge_key_name, json_value) for
+# merge-keyed lists.  Only LEAVES are owned: scalars, atomic lists, and
+# empty maps.  fieldsV1 round-trips this shape for storage in
+# metadata.managedFields (kube wire format: "f:name" map keys and
+# 'k:{"name":"x"}' item keys).
+
+_TOP_IGNORED = ("apiVersion", "kind", "metadata", "status")
+
+
+def field_set(obj: Any, prefix: Tuple = ()) -> Set[Tuple]:
+    """Leaf field paths of an applied configuration.  Top-level
+    apiVersion/kind/metadata/status are identity/server-owned and not
+    tracked (we track spec + any custom top-level sections; labels and
+    annotations ARE tracked so appliers can own them)."""
+    out: Set[Tuple] = set()
+    if not prefix and isinstance(obj, dict):
+        for k, v in obj.items():
+            if k in _TOP_IGNORED:
+                continue
+            out |= field_set(v, (k,))
+        md = obj.get("metadata", {})
+        for sect in ("labels", "annotations"):
+            if isinstance(md.get(sect), dict):
+                out |= field_set(md[sect], ("metadata", sect))
+        return out
+    if isinstance(obj, dict):
+        if not obj:
+            return {prefix}
+        for k, v in obj.items():
+            out |= field_set(v, prefix + (k,))
+        return out
+    if isinstance(obj, list):
+        key = MERGE_KEYS.get(prefix[-1] if prefix else "")
+        if key and all(isinstance(e, dict) and key in e for e in obj):
+            for e in obj:
+                item = prefix + (("k", key, json.dumps(e[key])),)
+                sub = {k: v for k, v in e.items() if k != key}
+                if sub:
+                    out |= field_set(sub, item)
+                else:
+                    out.add(item)
+            return out
+        return {prefix}                                # atomic list leaf
+    return {prefix}
+
+
+def _path_str(path: Tuple) -> str:
+    parts = []
+    for seg in path:
+        if isinstance(seg, tuple):
+            parts.append(f"[{seg[1]}={json.loads(seg[2])!r}]")
+        else:
+            parts.append("." + seg if parts else seg)
+    return "".join(parts) or "."
+
+
+def fields_to_v1(paths: Set[Tuple]) -> Dict[str, Any]:
+    """Path set -> kube fieldsV1 dict ('f:' field keys, 'k:' item keys,
+    '.' self-ownership marker on interior nodes that are also leaves)."""
+    root: Dict[str, Any] = {}
+    for path in sorted(paths, key=_path_str):
+        node = root
+        for seg in path:
+            if isinstance(seg, tuple):
+                wire = "k:" + json.dumps({seg[1]: json.loads(seg[2])},
+                                         separators=(",", ":"))
+            else:
+                wire = f"f:{seg}"
+            node = node.setdefault(wire, {})
+        node["."] = {}
+    return root
+
+
+def fields_from_v1(v1: Dict[str, Any], prefix: Tuple = ()) -> Set[Tuple]:
+    out: Set[Tuple] = set()
+    for k, v in (v1 or {}).items():
+        if k == ".":
+            if prefix:
+                out.add(prefix)
+            continue
+        if k.startswith("f:"):
+            seg: Any = k[2:]
+        elif k.startswith("k:"):
+            try:
+                item = json.loads(k[2:])
+                (mk, mv), = item.items()
+            except (ValueError, AttributeError):
+                raise PatchError(f"bad fieldsV1 item key {k!r}") from None
+            seg = ("k", mk, json.dumps(mv))
+        else:
+            raise PatchError(f"bad fieldsV1 key {k!r}")
+        out |= fields_from_v1(v, prefix + (seg,))
+        if not v:
+            out.add(prefix + (seg,))
+    return out
+
+
+def _lookup(obj: Any, path: Tuple):
+    """Value at a field path, or (False, None) when absent.
+    Returns (present, value)."""
+    cur = obj
+    for seg in path:
+        if isinstance(seg, tuple):
+            _, mk, mv_json = seg
+            mv = json.loads(mv_json)
+            if not isinstance(cur, list):
+                return False, None
+            for e in cur:
+                if isinstance(e, dict) and e.get(mk) == mv:
+                    cur = e
+                    break
+            else:
+                return False, None
+        else:
+            if not isinstance(cur, dict) or seg not in cur:
+                return False, None
+            cur = cur[seg]
+    return True, cur
+
+
+def _remove_path(obj: Any, path: Tuple) -> None:
+    """Prune the value at path (no-op when absent).  Emptied parent
+    containers are left in place — harmless for merge semantics."""
+    if not path:
+        return
+    parents = []
+    cur = obj
+    for seg in path[:-1]:
+        parents.append((cur, seg))
+        if isinstance(seg, tuple):
+            _, mk, mv_json = seg
+            mv = json.loads(mv_json)
+            nxt = None
+            if isinstance(cur, list):
+                for e in cur:
+                    if isinstance(e, dict) and e.get(mk) == mv:
+                        nxt = e
+                        break
+            if nxt is None:
+                return
+            cur = nxt
+        else:
+            if not isinstance(cur, dict) or seg not in cur:
+                return
+            cur = cur[seg]
+    last = path[-1]
+    if isinstance(last, tuple):
+        _, mk, mv_json = last
+        mv = json.loads(mv_json)
+        if isinstance(cur, list):
+            cur[:] = [e for e in cur
+                      if not (isinstance(e, dict) and e.get(mk) == mv)]
+    elif isinstance(cur, dict):
+        cur.pop(last, None)
+
+
+def managed_fields(obj: Dict[str, Any]) -> List[Dict[str, Any]]:
+    return obj.get("metadata", {}).get("managedFields", []) or []
+
+
+def _manager_entry(entries: List[dict], manager: str, subresource: str):
+    for e in entries:
+        if (e.get("manager") == manager
+                and e.get("subresource", "") == subresource):
+            return e
+    return None
+
+
+def apply_ssa(live: Optional[Dict[str, Any]], applied: Dict[str, Any],
+              manager: str, *, force: bool = False,
+              subresource: str = "") -> Dict[str, Any]:
+    """Server-Side Apply: returns the new object (live may be None =
+    create).  Raises ApplyConflict on unforced conflicts.  The caller
+    stamps resourceVersion/generation and commits."""
+    if not manager:
+        raise PatchError("apply requires a fieldManager")
+    new_fields = field_set(applied)
+    now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+    if live is None:
+        out = copy.deepcopy(applied)
+        md = out.setdefault("metadata", {})
+        md["managedFields"] = [{
+            "manager": manager, "operation": "Apply",
+            "apiVersion": applied.get("apiVersion", ""),
+            "time": now, "fieldsType": "FieldsV1",
+            "fieldsV1": fields_to_v1(new_fields),
+            **({"subresource": subresource} if subresource else {}),
+        }]
+        return out
+
+    entries = copy.deepcopy(managed_fields(live))
+    mine = _manager_entry(entries, manager, subresource)
+    old_fields = (fields_from_v1(mine.get("fieldsV1", {}))
+                  if mine else set())
+
+    # Conflict scan: fields I apply, someone else owns, values differ.
+    conflicts: List[Tuple[str, str]] = []
+    others: List[Tuple[dict, Set[Tuple]]] = []
+    for e in entries:
+        if e is mine:
+            continue
+        fs = fields_from_v1(e.get("fieldsV1", {}))
+        others.append((e, fs))
+        for p in new_fields & fs:
+            present, live_val = _lookup(live, p)
+            _, want_val = _lookup(applied, p)
+            if not present or live_val != want_val:
+                conflicts.append((_path_str(p), e.get("manager", "?")))
+    if conflicts and not force:
+        raise ApplyConflict(sorted(set(conflicts)))
+
+    out = copy.deepcopy(live)
+    # Removal: fields I owned but no longer apply, and nobody else owns.
+    union_others: Set[Tuple] = set()
+    for _, fs in others:
+        union_others |= fs
+    removed = sorted(old_fields - new_fields, key=len, reverse=True)
+    for p in removed:
+        if p not in union_others:
+            _remove_path(out, p)
+    # Removing every owned leaf of a merge-keyed list item leaves a stub
+    # {mergeKey: value} element behind; prune the item itself when no
+    # surviving owner (mine or others') references anything under it —
+    # this is how dropping a worker group from an applied manifest
+    # actually deletes the group.
+    keep = new_fields | union_others
+    for prefix in sorted({p[:i + 1] for p in removed
+                          for i, seg in enumerate(p)
+                          if isinstance(seg, tuple)},
+                         key=len, reverse=True):
+        if any(q[:len(prefix)] == prefix for q in keep):
+            continue
+        present, item = _lookup(out, prefix)
+        if present and isinstance(item, dict) and \
+                set(item) == {prefix[-1][1]}:
+            _remove_path(out, prefix)
+
+    # Merge the applied config in (strategic semantics).
+    merged = strategic_merge_patch(
+        {k: v for k, v in out.items() if k not in ("metadata", "status")},
+        {k: v for k, v in applied.items()
+         if k not in ("apiVersion", "kind", "metadata", "status")})
+    for k in list(out.keys()):
+        if k not in ("apiVersion", "kind", "metadata", "status") \
+                and k not in merged:
+            del out[k]
+    out.update(merged)
+    amd = applied.get("metadata", {})
+    for sect in ("labels", "annotations"):
+        if isinstance(amd.get(sect), dict):
+            out["metadata"][sect] = strategic_merge_patch(
+                out["metadata"].get(sect, {}), amd[sect])
+
+    # Ownership bookkeeping: forced conflicts strip the loser's fields.
+    if force and conflicts:
+        lost = {p for p, _ in conflicts}
+        for e, fs in others:
+            kept = {p for p in fs if _path_str(p) not in lost}
+            if kept != fs:
+                e["fieldsV1"] = fields_to_v1(kept)
+    new_entries = [e for e in entries if e is not mine
+                   and e.get("fieldsV1")]
+    new_entries.append({
+        "manager": manager, "operation": "Apply",
+        "apiVersion": applied.get("apiVersion",
+                                  live.get("apiVersion", "")),
+        "time": now, "fieldsType": "FieldsV1",
+        "fieldsV1": fields_to_v1(new_fields),
+        **({"subresource": subresource} if subresource else {}),
+    })
+    out["metadata"]["managedFields"] = new_entries
+    return out
+
+
+def claim_update(obj: Dict[str, Any], old: Optional[Dict[str, Any]],
+                 new: Dict[str, Any], manager: str,
+                 subresource: str = "") -> None:
+    """Ownership bookkeeping for non-apply writes (kube: Update
+    operations also own the fields they set): fields whose value changed
+    move to ``manager``; other managers keep untouched fields.  Mutates
+    ``obj['metadata']['managedFields']`` in place."""
+    if not manager:
+        return
+    changed = set()
+    for p in field_set(new):
+        was, old_v = _lookup(old or {}, p)
+        _, new_v = _lookup(new, p)
+        if not was or old_v != new_v:
+            changed.add(p)
+    if old:
+        # fields removed entirely also count as "changed" for the owners
+        for p in field_set(old) - field_set(new):
+            changed.add(p)
+    if not changed:
+        return
+    entries = copy.deepcopy(managed_fields(old or {}))
+    for e in entries:
+        if e.get("manager") == manager and \
+                e.get("subresource", "") == subresource:
+            continue
+        fs = fields_from_v1(e.get("fieldsV1", {}))
+        kept = fs - changed
+        e["fieldsV1"] = fields_to_v1(kept)
+    entries = [e for e in entries if e.get("fieldsV1")]
+    mine = _manager_entry(entries, manager, subresource)
+    now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    live_fields = {p for p in changed if _lookup(new, p)[0]}
+    if mine:
+        fs = fields_from_v1(mine.get("fieldsV1", {})) | live_fields
+        mine["fieldsV1"] = fields_to_v1(fs)
+        mine["time"] = now
+        mine["operation"] = "Update"
+    elif live_fields:
+        entries.append({
+            "manager": manager, "operation": "Update",
+            "apiVersion": new.get("apiVersion", ""),
+            "time": now, "fieldsType": "FieldsV1",
+            "fieldsV1": fields_to_v1(live_fields),
+            **({"subresource": subresource} if subresource else {}),
+        })
+    obj.setdefault("metadata", {})["managedFields"] = entries

@@ -106,12 +106,16 @@ class RestObjectStore:
             h["Authorization"] = f"Bearer {self.token}"
         return h
 
-    def _req(self, method: str, path: str, body: Optional[dict] = None,
-             timeout: Optional[float] = None):
+    def _req(self, method: str, path: str, body: Any = None,
+             timeout: Optional[float] = None,
+             content_type: Optional[str] = None):
         data = json.dumps(body).encode() if body is not None else None
+        headers = self._headers()
+        if content_type:
+            headers["Content-Type"] = content_type
         req = urllib.request.Request(
             self.base_url + path, data=data, method=method,
-            headers=self._headers())
+            headers=headers)
         try:
             with urllib.request.urlopen(
                     req, timeout=timeout or self.timeout,
@@ -119,22 +123,26 @@ class RestObjectStore:
                 payload = resp.read()
                 return json.loads(payload) if payload else {}
         except urllib.error.HTTPError as e:
-            try:
-                msg = json.loads(e.read()).get("message", str(e))
-            except Exception:
-                msg = str(e)
-            if e.code == 404:
-                raise NotFound(msg) from None
-            if e.code == 409:
-                # The apiserver uses 409 for both exists + rv conflicts.
-                if "already exists" in msg:
-                    raise AlreadyExists(msg) from None
-                raise Conflict(msg) from None
-            if e.code in (400, 422):
-                raise Invalid(msg) from None
-            raise StoreError(f"HTTP {e.code}: {msg}") from None
+            self._raise_http(e)
         except urllib.error.URLError as e:
             raise StoreError(f"{method} {path}: {e}") from None
+
+    @staticmethod
+    def _raise_http(e: urllib.error.HTTPError) -> None:
+        try:
+            msg = json.loads(e.read()).get("message", str(e))
+        except Exception:
+            msg = str(e)
+        if e.code == 404:
+            raise NotFound(msg) from None
+        if e.code == 409:
+            # The apiserver uses 409 for both exists + rv conflicts.
+            if "already exists" in msg:
+                raise AlreadyExists(msg) from None
+            raise Conflict(msg) from None
+        if e.code in (400, 415, 422):
+            raise Invalid(msg) from None
+        raise StoreError(f"HTTP {e.code}: {msg}") from None
 
     # -- verbs (ObjectStore-compatible) ------------------------------------
 
@@ -199,42 +207,59 @@ class RestObjectStore:
     def update_status(self, obj: Dict[str, Any]):
         return self.update(obj, subresource="status")
 
+    # The four kube patch MIME types (server counterpart:
+    # apiserver/server.py do_PATCH; a real kube-apiserver speaks the
+    # same ones, which is the point of using the wire verb).
+    _PATCH_CTYPES = {
+        "merge": "application/merge-patch+json",
+        "strategic": "application/strategic-merge-patch+json",
+        "json": "application/json-patch+json",
+        "apply": "application/apply-patch+yaml",
+    }
+
+    def patch(self, kind: str, name: str, namespace: str = "default",
+              body: Any = None, *, patch_type: str = "merge",
+              subresource: str = "", field_manager: str = "",
+              force: bool = False) -> Dict[str, Any]:
+        """Wire PATCH (merge | strategic | json | apply) — one round
+        trip, no read-modify-write conflict loop."""
+        ctype = self._PATCH_CTYPES.get(patch_type)
+        if ctype is None:
+            raise Invalid(f"unknown patch type {patch_type!r}")
+        path = self._path(kind, namespace, name, subresource)
+        q = {}
+        if field_manager:
+            q["fieldManager"] = field_manager
+        if force:
+            q["force"] = "true"
+        if q:
+            path += "?" + urllib.parse.urlencode(q)
+        return self._req("PATCH", path, body, content_type=ctype)
+
     def patch_labels(self, kind: str, name: str, namespace: str,
                      labels: Dict[str, Optional[str]]):
-        for _ in range(4):   # optimistic read-modify-write
-            cur = self.get(kind, name, namespace)
-            lab = cur["metadata"].setdefault("labels", {})
-            for k, v in labels.items():
-                if v is None:
-                    lab.pop(k, None)
-                else:
-                    lab[k] = v
-            try:
-                return self.update(cur)
-            except Conflict:
-                continue
-        raise Conflict(f"patch_labels {kind} {namespace}/{name} kept losing")
+        # json-merge: null deletes a label — single round trip, no
+        # conflict loop (RFC 7386 semantics end-to-end).
+        return self.patch(kind, name, namespace,
+                          {"metadata": {"labels": dict(labels)}},
+                          patch_type="merge")
 
     def delete(self, kind: str, name: str, namespace: str = "default"):
         self._req("DELETE", self._path(kind, namespace, name))
 
     def add_finalizer(self, kind: str, name: str, namespace: str,
                       finalizer: str):
-        for _ in range(4):
-            cur = self.get(kind, name, namespace)
-            fins = cur["metadata"].setdefault("finalizers", [])
-            if finalizer in fins:
-                return
-            fins.append(finalizer)
-            try:
-                self.update(cur)
-                return
-            except Conflict:
-                continue
-        raise Conflict(f"add_finalizer {kind} {namespace}/{name} kept losing")
+        # Strategic set-merge on metadata.finalizers (kube
+        # patchStrategy=merge): union, idempotent, race-free.
+        self.patch(kind, name, namespace,
+                   {"metadata": {"finalizers": [finalizer]}},
+                   patch_type="strategic")
 
     def remove_finalizer(self, kind: str, name: str, namespace: str,
                          finalizer: str):
+        # Removal needs the full remaining list (merge can't subtract
+        # from a set-merge list), so it keeps the rv-guarded RMW — but
+        # via PATCH with a resourceVersion precondition, not PUT.
         for _ in range(4):
             cur = self.try_get(kind, name, namespace)
             if cur is None:
@@ -242,12 +267,20 @@ class RestObjectStore:
             fins = cur["metadata"].get("finalizers", [])
             if finalizer not in fins:
                 return
-            fins.remove(finalizer)
             try:
-                self.update(cur)
+                self.patch(
+                    kind, name, namespace,
+                    {"metadata": {
+                        "resourceVersion":
+                            cur["metadata"]["resourceVersion"],
+                        "finalizers":
+                            [f for f in fins if f != finalizer]}},
+                    patch_type="merge")
                 return
             except Conflict:
                 continue
+            except NotFound:
+                return
 
     def count(self, kind: str) -> int:
         return len(self.list(kind))

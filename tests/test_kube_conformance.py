@@ -46,6 +46,35 @@ def _now_rfc3339():
     return datetime.now(timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
 
 
+def _sim_merge(cur, patch, strategic):
+    """SimKube's own merge-patch walk (null deletes; dicts recurse;
+    lists replace — except workerGroupSpecs under strategic, which
+    merges by groupName per the kube strategic-merge spec)."""
+    if not isinstance(patch, dict) or not isinstance(cur, dict):
+        return json.loads(json.dumps(patch))
+    out = dict(cur)
+    for k, v in patch.items():
+        if v is None:
+            out.pop(k, None)
+        elif strategic and k == "workerGroupSpecs" and isinstance(v, list):
+            existing = [dict(g) for g in out.get(k) or []]
+            names = [g.get("groupName") for g in existing]
+            for e in v:
+                gn = e.get("groupName")
+                if gn in names:
+                    i = names.index(gn)
+                    existing[i] = _sim_merge(existing[i], e, strategic)
+                else:
+                    names.append(gn)
+                    existing.append(json.loads(json.dumps(e)))
+            out[k] = existing
+        elif isinstance(v, dict):
+            out[k] = _sim_merge(out.get(k) or {}, v, strategic)
+        else:
+            out[k] = json.loads(json.dumps(v))
+    return out
+
+
 class SimKube:
     """In-memory kube-apiserver lookalike (see module docstring)."""
 
@@ -126,6 +155,35 @@ class SimKube:
             obj["metadata"]["resourceVersion"] = str(self._bump())
             self._record("DELETED", obj)
             return True
+
+    def patch(self, kind, ns, name, body, strategic):
+        """Kube-style merge/strategic PATCH — implemented INDEPENDENTLY
+        of kuberay_tpu.controlplane.patch (same public spec, different
+        code) so client-vs-server agreement is real conformance, not one
+        implementation talking to itself."""
+        key = (kind, ns, name)
+        with self.cond:
+            cur = self.objs.get(key)
+            if cur is None:
+                return None, 404
+            sent_rv = (body.get("metadata") or {}).get("resourceVersion") \
+                if isinstance(body, dict) else None
+            if sent_rv and sent_rv != cur["metadata"]["resourceVersion"]:
+                return None, 409
+            merged = _sim_merge(cur, body, strategic)
+            preserved = {k: cur["metadata"][k]
+                         for k in ("uid", "creationTimestamp",
+                                   "managedFields")
+                         if k in cur["metadata"]}
+            merged["metadata"] = {**merged.get("metadata", {}),
+                                  **preserved,
+                                  "namespace": ns, "name": name}
+            merged["kind"] = kind
+            merged["status"] = cur.get("status", {})   # status subresource
+            merged["metadata"]["resourceVersion"] = str(self._bump())
+            self.objs[key] = merged
+            self._record("MODIFIED", merged)
+            return merged, 200
 
     # -- HTTP ------------------------------------------------------------
 
@@ -310,6 +368,30 @@ class SimKube:
                     return self._send(404, {"message": "not found"})
                 return self._send(200, {"status": "Success"})
 
+            def do_PATCH(self):
+                r = self._route()
+                if r is None:
+                    return self._send(404, {"message": "unknown path"})
+                kind, ns, name, _ = r
+                ctype = (self.headers.get("Content-Type", "")
+                         .split(";")[0].strip())
+                if ctype not in ("application/merge-patch+json",
+                                 "application/strategic-merge-patch+json"):
+                    return self._send(415, {
+                        "message": f"unsupported media type {ctype}"})
+                body = json.loads(self.rfile.read(
+                    int(self.headers.get("Content-Length", 0))))
+                obj, code = sim.patch(
+                    kind, ns or "default", name, body,
+                    strategic=ctype.startswith("application/strategic"))
+                if code == 404:
+                    return self._send(404, {"message": "not found"})
+                if code == 409:
+                    return self._send(409, {
+                        "message": "Operation cannot be fulfilled: "
+                                   "object has been modified"})
+                return self._send(200, obj)
+
         srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
         threading.Thread(target=srv.serve_forever, daemon=True).start()
         return srv, f"http://127.0.0.1:{srv.server_port}"
@@ -435,6 +517,120 @@ def test_watch_410_recovery_emits_missed_diff_once(sim):
     adds = [e for e in seen if e.type == "ADDED"
             and e.obj["metadata"]["name"] == "missed"]
     assert len(adds) == 1, f"missed object delivered {len(adds)} times"
+    store.close()
+
+
+def test_patches_interleaved_with_relists(sim):
+    """PATCHes landing between a watcher's 410 expiry and its relist
+    must neither be lost nor double-applied: the final object state and
+    the watcher's converged view agree (VERDICT r3 item 2)."""
+    s, url = sim
+    s.window = 4                    # tiny history: every churn evicts
+    s.bookmark_every = 3600         # no bookmarks: force the 410 path
+    store = RestObjectStore(url, watched_kinds=("TpuCluster",),
+                            poll_interval=0.05)
+    latest = {}
+    store.watch(lambda ev: latest.__setitem__(
+        ev.obj["metadata"]["name"], ev.obj))
+    c = make_cluster(name="patched", accelerator="v5e", topology="2x2",
+                     replicas=1).to_dict()
+    c["spec"]["workerGroupSpecs"][0]["maxReplicas"] = 50
+    store.create(c)
+    # Interleave: merge + strategic patches with pod churn that keeps
+    # expiring the TpuCluster watch mid-stream.
+    for i in range(1, 11):
+        store.patch(C.KIND_CLUSTER, "patched", "default",
+                    {"spec": {"workerGroupSpecs": [
+                        {"groupName": "workers", "replicas": i}]}},
+                    patch_type="strategic")
+        store.patch(C.KIND_CLUSTER, "patched", "default",
+                    {"metadata": {"annotations": {"round": str(i)}}},
+                    patch_type="merge")
+        for j in range(6):
+            s.create("Pod", "default", {
+                "apiVersion": "v1",
+                "metadata": {"name": f"churn-{i}-{j}"}})
+    final = store.get(C.KIND_CLUSTER, "patched")
+    g = final["spec"]["workerGroupSpecs"][0]
+    assert g["replicas"] == 10
+    assert g["topology"] == "2x2"                 # merged, never clobbered
+    assert final["metadata"]["annotations"]["round"] == "10"
+    # The watcher's converged view (through however many 410 relists)
+    # must reach the same state.
+    assert wait_for(lambda: latest.get("patched", {}).get(
+        "spec", {}).get("workerGroupSpecs",
+                        [{}])[0].get("replicas") == 10, timeout=20)
+    store.close()
+
+
+def test_autoscaler_scales_via_patch_under_410s(sim):
+    """The done-criterion for VERDICT r3 item 2: the slice autoscaler
+    scales a cluster via strategic PATCH against a kube-semantics server
+    while watch history keeps expiring; the controller converges to the
+    patched scale with no duplicate slice pods."""
+    from kuberay_tpu.controlplane.autoscaler import (
+        GroupDecision,
+        apply_decisions,
+    )
+    from kuberay_tpu.controlplane.cluster_controller import (
+        TpuClusterController,
+    )
+    from kuberay_tpu.controlplane.fake_kubelet import FakeKubelet
+    from kuberay_tpu.controlplane.manager import Manager, owned_pod_mapper
+
+    s, url = sim
+    s.window = 6
+    s.bookmark_every = 3600
+    store = RestObjectStore(url, poll_interval=0.05)
+    manager = Manager(store)
+    ctrl = TpuClusterController(store, expectations=manager.expectations)
+    manager.register(C.KIND_CLUSTER, ctrl.reconcile)
+    manager.map_owned(owned_pod_mapper)
+    kubelet = FakeKubelet(store)
+
+    c = make_cluster(name="asc", accelerator="v5p", topology="2x2x2",
+                     replicas=1)
+    d = c.to_dict()
+    d["spec"]["workerGroupSpecs"][0]["maxReplicas"] = 4
+    d["metadata"]["annotations"] = {"keep": "me"}
+    store.create(d)
+
+    def settle(rounds=6):
+        for _ in range(rounds):
+            manager.flush_delayed()
+            manager.run_until_idle()
+            kubelet.step()
+            for i in range(4):          # keep evicting watch history
+                s.create("Event", "default", {
+                    "apiVersion": "v1",
+                    "metadata": {"name": f"churn-{time.time()}-{i}"},
+                    "reason": "Noise"})
+
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        settle()
+        obj = store.try_get(C.KIND_CLUSTER, "asc")
+        if obj and obj.get("status", {}).get("state") == "ready":
+            break
+    # The autoscaler's write path: one strategic PATCH, no RMW loop.
+    assert apply_decisions(store, "asc", "default",
+                           [GroupDecision("workers", 2, [],
+                                          "demand 2 > 1")])
+    deadline = time.time() + 45
+    while time.time() < deadline:
+        settle()
+        obj = store.try_get(C.KIND_CLUSTER, "asc")
+        if obj and obj.get("status", {}).get("readySlices") == 2:
+            break
+    obj = store.get(C.KIND_CLUSTER, "asc")
+    assert obj["spec"]["workerGroupSpecs"][0]["replicas"] == 2
+    assert obj["metadata"]["annotations"]["keep"] == "me"
+    workers = [p for p in store.list("Pod", "default")
+               if p["metadata"].get("labels", {})
+               .get(C.LABEL_NODE_TYPE) == "worker"]
+    assert len(workers) == 4               # 2 slices x 2 hosts, no dups
+    assert len({p["metadata"]["name"] for p in workers}) == 4
+    assert obj["status"]["readySlices"] == 2
     store.close()
 
 
