@@ -33,6 +33,9 @@ class ServiceStatusName:
     DEPLOYING = "DEPLOYING"
     UNHEALTHY = "UNHEALTHY"
     NOT_STARTED = "NOT_STARTED"
+    # A multi-host serve group lost a follower (or hung in a collective):
+    # unrecoverable in place — the slice must be replaced whole.
+    DEGRADED = "DEGRADED"
 
 
 class ServiceConditionType:
@@ -41,6 +44,10 @@ class ServiceConditionType:
     READY = "Ready"
     UPGRADE_IN_PROGRESS = "UpgradeInProgress"
     ROLLING_BACK = "RollingBack"
+    # A serving slice's lockstep group failed (dead follower / stuck
+    # collective); replacement is in flight.  Serve-layer counterpart of
+    # the cluster controller's whole-slice repair invariant.
+    SERVE_GROUP_DEGRADED = "ServeGroupDegraded"
 
 
 @dataclasses.dataclass

@@ -435,14 +435,10 @@ class ApiHandler(JsonHandler):
             return self._error(409, str(e))
         return self._send(200, out)
 
-    # Content-Type -> store patch_type (the four kube patch MIME types;
-    # apply-patch is +yaml on the wire but JSON is a YAML subset and all
-    # our clients send JSON bodies).
+    # Content-Type -> store patch_type: the inverse of the shared
+    # client table, plus the +json apply alias some clients send.
     _PATCH_TYPES = {
-        "application/merge-patch+json": "merge",
-        "application/strategic-merge-patch+json": "strategic",
-        "application/json-patch+json": "json",
-        "application/apply-patch+yaml": "apply",
+        **{v: k for k, v in C.PATCH_CONTENT_TYPES.items()},
         "application/apply-patch+json": "apply",
     }
 

@@ -79,12 +79,7 @@ class ApiClient:
                                            md.get("namespace", "default"),
                                            md["name"]), obj)
 
-    _PATCH_CTYPES = {
-        "merge": "application/merge-patch+json",
-        "strategic": "application/strategic-merge-patch+json",
-        "json": "application/json-patch+json",
-        "apply": "application/apply-patch+yaml",
-    }
+    _PATCH_CTYPES = C.PATCH_CONTENT_TYPES
 
     def patch(self, kind: str, name: str, namespace: str = "default",
               body: Any = None, *, patch_type: str = "merge",

@@ -22,7 +22,12 @@ import time
 from typing import Dict, List
 
 from kuberay_tpu.api.tpucluster import TpuCluster
-from kuberay_tpu.controlplane.store import Conflict, NotFound, ObjectStore
+from kuberay_tpu.controlplane.store import (
+    Conflict,
+    Invalid,
+    NotFound,
+    ObjectStore,
+)
 from kuberay_tpu.utils import constants as C
 
 
@@ -113,14 +118,18 @@ def apply_decisions(store: ObjectStore, cluster_name: str, namespace: str,
     if not groups:
         return False
     try:
-        store.patch(C.KIND_CLUSTER, cluster_name, namespace,
-                    {"spec": {"workerGroupSpecs": groups}},
-                    patch_type="strategic",
-                    field_manager="tpu-autoscaler")
+        store.patch(
+            C.KIND_CLUSTER, cluster_name, namespace,
+            # resourceVersion precondition: the known-group check above
+            # is a read — without CAS, a group deleted between read and
+            # patch would be resurrected as a stub by the merge-keyed
+            # append.  A conflict just means the next pass re-decides.
+            {"metadata": {"resourceVersion":
+                          obj["metadata"]["resourceVersion"]},
+             "spec": {"workerGroupSpecs": groups}},
+            patch_type="strategic", field_manager="tpu-autoscaler")
         return True
-    except (Conflict, NotFound):
-        # rv preconditions are not used here, so Conflict only means the
-        # object vanished/recreated mid-flight; next pass re-decides.
+    except (Conflict, NotFound, Invalid):
         return False
 
 

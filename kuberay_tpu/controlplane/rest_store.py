@@ -210,12 +210,7 @@ class RestObjectStore:
     # The four kube patch MIME types (server counterpart:
     # apiserver/server.py do_PATCH; a real kube-apiserver speaks the
     # same ones, which is the point of using the wire verb).
-    _PATCH_CTYPES = {
-        "merge": "application/merge-patch+json",
-        "strategic": "application/strategic-merge-patch+json",
-        "json": "application/json-patch+json",
-        "apply": "application/apply-patch+yaml",
-    }
+    _PATCH_CTYPES = C.PATCH_CONTENT_TYPES
 
     def patch(self, kind: str, name: str, namespace: str = "default",
               body: Any = None, *, patch_type: str = "merge",

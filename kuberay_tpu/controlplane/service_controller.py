@@ -40,6 +40,12 @@ from kuberay_tpu.utils.names import serve_service_name, spec_hash_without_scale,
 from kuberay_tpu.utils.validation import validate_service
 
 
+def _fmt_secs(seconds: float) -> str:
+    """Event-message formatting; a degraded group reports inf (act-now)."""
+    return "DEGRADED (acting immediately)" if seconds == float("inf") \
+        else f"{int(seconds)}s"
+
+
 class TpuServiceController:
     KIND = C.KIND_SERVICE
 
@@ -102,6 +108,28 @@ class TpuServiceController:
         now = time.time()
         st = svc.status
 
+        def degraded_apps(cs):
+            if cs is None:
+                return []
+            return [a for a in cs.applications
+                    if a.status == ServiceStatusName.DEGRADED]
+
+        # ServeGroupDegraded condition: a DEGRADED app means the slice's
+        # lockstep group lost a member — it can never heal in place, so
+        # the condition both surfaces the failure and makes the
+        # unhealthy clock fire IMMEDIATELY (no threshold wait).
+        all_degraded = (degraded_apps(st.activeServiceStatus)
+                        + degraded_apps(st.pendingServiceStatus))
+        if all_degraded:
+            msg = "; ".join(f"{a.name}: {a.message}" for a in all_degraded)
+            set_condition(st.conditions, Condition(
+                type=ServiceConditionType.SERVE_GROUP_DEGRADED,
+                status="True", reason="ServeGroupFailure", message=msg))
+        else:
+            set_condition(st.conditions, Condition(
+                type=ServiceConditionType.SERVE_GROUP_DEGRADED,
+                status="False", reason="GroupsHealthy"))
+
         def track(cs) -> float:
             """Returns seconds-unhealthy for the cluster (0 when healthy).
 
@@ -115,6 +143,8 @@ class TpuServiceController:
                 return 0.0
             if not cs.applications:
                 return 0.0
+            if degraded_apps(cs):
+                return float("inf")         # unrecoverable: act now
             first = self._unhealthy_since.setdefault(cs.clusterName, now)
             return now - first
 
@@ -124,7 +154,7 @@ class TpuServiceController:
             self.recorder.warning(
                 svc.to_dict(), "PendingUnhealthy",
                 f"pending cluster {st.pendingServiceStatus.clusterName} not "
-                f"serving after {int(pending_bad)}s; recreating")
+                f"serving after {_fmt_secs(pending_bad)}; recreating")
             self._unhealthy_since.pop(st.pendingServiceStatus.clusterName, None)
             self._abandon_pending(svc)
             return
@@ -147,8 +177,8 @@ class TpuServiceController:
             self.recorder.warning(
                 svc.to_dict(), "ActiveUnhealthy",
                 f"active cluster {st.activeServiceStatus.clusterName} "
-                f"unhealthy for {int(active_bad)}s; preparing replacement "
-                f"{cname}")
+                f"unhealthy for {_fmt_secs(active_bad)}; preparing "
+                f"replacement {cname}")
             self._unhealthy_since.pop(st.activeServiceStatus.clusterName, None)
             self._create_cluster(svc, cname)
             st.pendingServiceStatus = ServiceClusterStatus(

@@ -491,6 +491,8 @@ class ObjectStore:
 
             # Identity and server-owned metadata are not patchable.
             new["kind"] = kind
+            if cur is not None and cur.get("apiVersion") is not None:
+                new["apiVersion"] = cur["apiVersion"]
             md = new.setdefault("metadata", {})
             md["name"], md["namespace"] = name, namespace
             if cur is not None:

@@ -1,0 +1,231 @@
+"""Serve-group failure detection: heartbeats + step watchdog.
+
+A multi-host serve slice runs in lockstep (serve/multihost.py); a dead
+follower leaves host 0 blocked inside a collective with **no in-process
+way to unblock** — the recovery unit is the whole slice, exactly the
+invariant the cluster controller already enforces for unhealthy slices
+(reference: unhealthy multi-host groups deleted whole,
+raycluster_controller.go:1269-1289).  What the serve layer must supply
+is *detection + drain + surfacing*:
+
+- every follower runs a :func:`heartbeat_loop` daemon thread beating a
+  tiny TCP listener on host 0 (address from the same
+  ``TPU_WORKER_HOSTNAMES`` env contract the engines already use);
+- host 0's :class:`GroupMonitor` declares the group **degraded** when a
+  follower misses beats (process death) or a device step exceeds the
+  watchdog budget (hang inside a collective — the failure mode a dead
+  peer actually produces);
+- on degradation the serve frontend fails pending waiters immediately
+  (no hanging clients), flips ``/healthz`` to 503, and reports the app
+  ``DEGRADED`` to the coordinator so the TpuService controller sets the
+  ``ServeGroupDegraded`` condition and prepares a replacement cluster —
+  whole-slice replacement, never partial repair.
+
+Single-host groups never degrade through this module (no peers, and a
+stuck step without peers is a model bug, not a group failure).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+HEARTBEAT_INTERVAL = 1.0
+
+
+class GroupMonitor:
+    """Host-0 side: follower liveness + step watchdog.
+
+    ``expected``: follower worker ids (1..n-1).  ``miss_timeout``: beats
+    older than this mark the follower lost.  ``step_timeout``: a single
+    device call running longer than this marks the group stuck (dead
+    peer mid-collective).  Degradation is one-way; recovery is slice
+    replacement, not rejoin.
+    """
+
+    def __init__(self, expected: List[int], miss_timeout: float = 10.0,
+                 step_timeout: float = 60.0,
+                 on_degraded: Optional[Callable[[str], None]] = None,
+                 grace: float = 30.0, compile_timeout: float = 900.0):
+        self.expected = list(expected)
+        self.miss_timeout = miss_timeout
+        self.step_timeout = step_timeout
+        # Budget for steps flagged as compiling (first occurrence of a
+        # program shape): XLA compilation of a large model can dwarf
+        # step_timeout, and a false DEGRADED here would put the slice in
+        # an infinite replace-recompile-replace loop.
+        self.compile_timeout = compile_timeout
+        self.on_degraded = on_degraded
+        self._lock = threading.Lock()
+        now = time.monotonic()
+        # Followers get a startup grace: they begin beating only once
+        # their engine is constructed (compile time included).
+        self._last_beat: Dict[int, float] = {
+            w: now + grace for w in self.expected}
+        self._step_started: Optional[float] = None
+        self._step_budget: float = step_timeout
+        self._degraded: Optional[str] = None
+        self._server: Optional[socket.socket] = None
+        self._stop = threading.Event()
+
+    # -- state ----------------------------------------------------------
+
+    @property
+    def degraded(self) -> Optional[str]:
+        return self._degraded
+
+    def _mark(self, reason: str) -> None:
+        fire = False
+        with self._lock:
+            if self._degraded is None:
+                self._degraded = reason
+                fire = True
+        if fire and self.on_degraded is not None:
+            try:
+                self.on_degraded(reason)
+            except Exception:
+                pass
+
+    def mark_degraded(self, reason: str) -> None:
+        """External degradation signal (e.g. a collective raised on the
+        scheduling thread before any heartbeat missed)."""
+        self._mark(reason)
+
+    def beat(self, worker_id: int) -> None:
+        with self._lock:
+            self._last_beat[worker_id] = time.monotonic()
+
+    def step_begin(self, compiling: bool = False) -> None:
+        self._step_budget = (self.compile_timeout if compiling
+                             else self.step_timeout)
+        self._step_started = time.monotonic()
+
+    def step_end(self) -> None:
+        self._step_started = None
+
+    def check(self) -> Optional[str]:
+        """One watchdog pass; returns the degradation reason (sticky)."""
+        if self._degraded:
+            return self._degraded
+        now = time.monotonic()
+        with self._lock:
+            stale = [w for w, t in self._last_beat.items()
+                     if now - t > self.miss_timeout]
+        started, budget = self._step_started, self._step_budget
+        if stale:
+            self._mark(f"follower(s) {sorted(stale)} missed heartbeats "
+                       f"for >{self.miss_timeout:.0f}s")
+        elif started is not None and now - started > budget:
+            self._mark(f"device step stuck for >{budget:.0f}s "
+                       "(peer dead mid-collective?)")
+        return self._degraded
+
+    def status(self) -> Dict[str, object]:
+        now = time.monotonic()
+        with self._lock:
+            ages = {str(w): round(max(0.0, now - t), 1)
+                    for w, t in self._last_beat.items()}
+        return {"degraded": self._degraded, "beat_age_seconds": ages,
+                "followers": self.expected}
+
+    # -- wire -----------------------------------------------------------
+
+    def listen(self, host: str = "0.0.0.0", port: int = 0) -> int:
+        """Start the heartbeat listener + watchdog thread; returns the
+        bound port.  Protocol: followers hold one persistent connection
+        and write a ``beat <worker_id>\\n`` line per interval."""
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind((host, port))
+        srv.listen(8)
+        srv.settimeout(0.5)
+        self._server = srv
+
+        def accept_loop():
+            while not self._stop.is_set():
+                try:
+                    conn, _ = srv.accept()
+                except socket.timeout:
+                    continue
+                except OSError:
+                    return
+                threading.Thread(target=self._conn_loop, args=(conn,),
+                                 daemon=True,
+                                 name="group-health-conn").start()
+
+        def watchdog_loop():
+            while not self._stop.is_set():
+                self.check()
+                self._stop.wait(min(1.0, self.miss_timeout / 3))
+
+        threading.Thread(target=accept_loop, daemon=True,
+                         name="group-health-accept").start()
+        threading.Thread(target=watchdog_loop, daemon=True,
+                         name="group-health-watchdog").start()
+        return srv.getsockname()[1]
+
+    def _conn_loop(self, conn: socket.socket) -> None:
+        conn.settimeout(self.miss_timeout)
+        buf = b""
+        try:
+            while not self._stop.is_set():
+                chunk = conn.recv(256)
+                if not chunk:
+                    return
+                buf += chunk
+                while b"\n" in buf:
+                    line, buf = buf.split(b"\n", 1)
+                    parts = line.decode(errors="replace").split()
+                    if len(parts) == 2 and parts[0] == "beat":
+                        try:
+                            self.beat(int(parts[1]))
+                        except ValueError:
+                            pass
+        except OSError:
+            return
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._server is not None:
+            try:
+                self._server.close()
+            except OSError:
+                pass
+
+
+def heartbeat_loop(host: str, port: int, worker_id: int,
+                   interval: float = HEARTBEAT_INTERVAL,
+                   stop: Optional[threading.Event] = None) -> None:
+    """Follower side: beat host 0 forever (daemon thread).  Connection
+    failures retry — host 0 may restart its listener; a follower must
+    not die because the monitor blinked (the monitor's job is to notice
+    *us* dying, not vice versa)."""
+    stop = stop or threading.Event()
+    while not stop.is_set():
+        try:
+            with socket.create_connection((host, port), timeout=5) as s:
+                while not stop.is_set():
+                    s.sendall(f"beat {worker_id}\n".encode())
+                    if stop.wait(interval):
+                        return
+        except OSError:
+            if stop.wait(interval):
+                return
+
+
+def start_heartbeat(host: str, port: int, worker_id: int,
+                    interval: float = HEARTBEAT_INTERVAL
+                    ) -> threading.Event:
+    """Spawn the follower heartbeat daemon; returns its stop event."""
+    stop = threading.Event()
+    threading.Thread(target=heartbeat_loop,
+                     args=(host, port, worker_id, interval, stop),
+                     daemon=True, name="group-health-beat").start()
+    return stop

@@ -88,6 +88,15 @@ class MultihostServeEngine(ServeEngine):
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
         self._plan0 = _plan_shape(self)
+        self.monitor = None          # optional GroupMonitor (host 0)
+        self.group_failed = False    # set by the frontend on degradation
+        self._compiled_ops: set = set()   # (op, shape-key)s already run
+
+    def attach_monitor(self, monitor) -> None:
+        """Step begin/end watchdog hooks (serve/group_health.py): a dead
+        follower leaves every subsequent collective hung; the monitor's
+        watchdog turns that hang into a detected degradation."""
+        self.monitor = monitor
 
     def _send(self, **updates) -> None:
         plan = dict(self._plan0)
@@ -97,46 +106,88 @@ class MultihostServeEngine(ServeEngine):
         _broadcast(plan, is_source=True)
 
     def stop(self) -> None:
-        if jax.process_count() > 1:
+        if jax.process_count() > 1 and self._group_alive():
             self._send(op=np.int32(OP_STOP))
+
+    def _group_alive(self) -> bool:
+        """STOP-broadcast guard: once degraded — via the watchdog OR a
+        collective raising on the scheduling thread — broadcasting would
+        hang/raise in the same dead group."""
+        if self.group_failed:
+            return False
+        return self.monitor is None or self.monitor.degraded is None
+
+    def _watched(self, op_key, send_fn, device_fn):
+        """Run broadcast + device call under the step watchdog.  The
+        window opens BEFORE the plan broadcast — a follower wedged
+        mid-collective (heartbeats still beating) hangs host 0 inside
+        the broadcast itself, and an unwatched broadcast would never
+        degrade.  First occurrence of a program shape gets the compile
+        budget (XLA compilation can dwarf a step)."""
+        if self.monitor is not None:
+            self.monitor.step_begin(
+                compiling=op_key not in self._compiled_ops)
+        try:
+            send_fn()
+            out = device_fn()
+            # The jitted call returns ASYNC values; block so the watchdog
+            # measures the actual collective, not dispatch latency.
+            jax.block_until_ready(out)
+            self._compiled_ops.add(op_key)
+            return out
+        finally:
+            if self.monitor is not None:
+                self.monitor.step_end()
 
     def _prefill_device(self, padded, slot, real_len, sub, temperature,
                         bucket, start_pos=0):
-        if jax.process_count() > 1:
-            tokens = np.zeros(self.max_len, np.int32)
-            tokens[:len(padded)] = padded
-            self._send(
-                op=np.int32(OP_PREFILL),
-                scalars=np.array([slot, real_len, bucket, start_pos],
-                                 np.int32),
-                temp=np.float32(temperature),
-                tokens=tokens,
-                key=np.asarray(sub, np.uint32))
-        return super()._prefill_device(padded, slot, real_len, sub,
-                                       temperature, bucket, start_pos)
+        def send():
+            if jax.process_count() > 1:
+                tokens = np.zeros(self.max_len, np.int32)
+                tokens[:len(padded)] = padded
+                self._send(
+                    op=np.int32(OP_PREFILL),
+                    scalars=np.array([slot, real_len, bucket, start_pos],
+                                     np.int32),
+                    temp=np.float32(temperature),
+                    tokens=tokens,
+                    key=np.asarray(sub, np.uint32))
+        return self._watched(
+            ("prefill", bucket), send,
+            lambda: super(MultihostServeEngine, self)._prefill_device(
+                padded, slot, real_len, sub, temperature, bucket,
+                start_pos))
 
     def _decode_call(self, last, temps, mask, sub):
-        if jax.process_count() > 1:
-            self._send(
-                op=np.int32(OP_DECODE),
-                last=np.asarray(last, np.int32),
-                lens=np.asarray(self.lens, np.int32),
-                temps=np.asarray(temps, np.float32),
-                mask=np.asarray(mask, np.float32),
-                key=np.asarray(sub, np.uint32))
-        return super()._decode_call(last, temps, mask, sub)
+        def send():
+            if jax.process_count() > 1:
+                self._send(
+                    op=np.int32(OP_DECODE),
+                    last=np.asarray(last, np.int32),
+                    lens=np.asarray(self.lens, np.int32),
+                    temps=np.asarray(temps, np.float32),
+                    mask=np.asarray(mask, np.float32),
+                    key=np.asarray(sub, np.uint32))
+        return self._watched(
+            ("decode",), send,
+            lambda: super(MultihostServeEngine, self)._decode_call(
+                last, temps, mask, sub))
 
     def _verify_device(self, toks, ntok, sub, temps, mask):
-        if jax.process_count() > 1:
-            self._send(
-                op=np.int32(OP_VERIFY),
-                vtoks=np.asarray(toks, np.int32),
-                ntok=np.asarray(ntok, np.int32),
-                lens=np.asarray(self.lens, np.int32),
-                temps=np.asarray(temps, np.float32),
-                mask=np.asarray(mask, np.float32),
-                key=np.asarray(sub, np.uint32))
-        return super()._verify_device(toks, ntok, sub, temps, mask)
+        def send():
+            if jax.process_count() > 1:
+                self._send(
+                    op=np.int32(OP_VERIFY),
+                    vtoks=np.asarray(toks, np.int32),
+                    ntok=np.asarray(ntok, np.int32),
+                    lens=np.asarray(self.lens, np.int32),
+                    temps=np.asarray(temps, np.float32),
+                    mask=np.asarray(mask, np.float32),
+                    key=np.asarray(sub, np.uint32))
+        return self._watched(
+            ("verify",), send,
+            lambda: super(MultihostServeEngine, self)._verify_device(
+                toks, ntok, sub, temps, mask))
 
 
 def follower_loop(engine: ServeEngine) -> int:

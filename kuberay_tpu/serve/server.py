@@ -29,27 +29,84 @@ from kuberay_tpu.utils.httpjson import JsonHandler
 
 
 class ServeFrontend:
-    def __init__(self, engine: ServeEngine, max_queue: int = 256):
+    def __init__(self, engine: ServeEngine, max_queue: int = 256,
+                 monitor=None, on_degraded=None):
         self.engine = engine
         self.max_queue = max_queue
+        self.monitor = monitor               # GroupMonitor (host 0) or None
+        self._on_degraded_cb = on_degraded   # e.g. coordinator DEGRADED post
+        self._degraded: Optional[str] = None
         self._lock = threading.Lock()
         self._waiters: Dict[str, threading.Event] = {}
         self._results: Dict[str, Response] = {}
         self._stop = threading.Event()
         self._stats = {"requests": 0, "completed": 0, "rejected": 0,
-                       "tokens_out": 0}
+                       "tokens_out": 0, "failed_degraded": 0}
+        if monitor is not None and hasattr(engine, "attach_monitor"):
+            engine.attach_monitor(monitor)
+            monitor.on_degraded = self._handle_degraded
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="serve-engine-loop")
         self._thread.start()
+
+    # -- degradation -------------------------------------------------------
+
+    @property
+    def degraded(self) -> Optional[str]:
+        return self._degraded
+
+    def _handle_degraded(self, reason: str) -> None:
+        """One-way transition: stop admitting, fail every pending waiter
+        (their collective will never complete — an immediate 503 beats a
+        client-timeout hang), and surface upward.  The engine-loop
+        thread may be permanently stuck inside a dead collective; that
+        is expected — recovery is whole-slice replacement by the
+        TpuService controller, not in-process repair (the same unit the
+        cluster controller repairs, ref raycluster_controller.go:1269)."""
+        with self._lock:
+            if self._degraded is not None:
+                return
+            self._degraded = reason
+            waiters = list(self._waiters.items())
+            self._waiters.clear()
+            self._stats["failed_degraded"] += len(waiters)
+        # Inform the engine (STOP-broadcast guard) and the monitor (so
+        # /stats' group view agrees) even when the signal originated
+        # from an engine exception rather than the watchdog.
+        if hasattr(self.engine, "group_failed"):
+            self.engine.group_failed = True
+        if self.monitor is not None:
+            self.monitor.mark_degraded(reason)
+        for _, ev in waiters:
+            ev.set()                       # submit() sees no result -> None
+        if self._on_degraded_cb is not None:
+            try:
+                self._on_degraded_cb(reason)
+            except Exception:
+                pass
 
     # -- engine loop -------------------------------------------------------
 
     def _loop(self):
         while not self._stop.is_set():
+            if self._degraded is not None:
+                # Parked: device calls would hang/mispair in the dead
+                # group.  Queued requests are failed by _handle_degraded;
+                # the pod is replaced by the controller.
+                self._stop.wait(0.1)
+                continue
             if not self.engine.has_work():
                 self._stop.wait(0.005)
                 continue
-            for resp in self.engine.step():
+            try:
+                responses = self.engine.step()
+            except Exception as e:
+                # The distributed runtime may also surface a dead peer as
+                # an exception from the collective (instead of a hang) —
+                # same degradation, nicer failure mode.
+                self._handle_degraded(f"engine step failed: {e!r}")
+                continue
+            for resp in responses:
                 with self._lock:
                     self._stats["completed"] += 1
                     self._stats["tokens_out"] += len(resp.tokens)
@@ -67,6 +124,9 @@ class ServeFrontend:
         rid = uuid.uuid4().hex
         ev = threading.Event()
         with self._lock:
+            if self._degraded is not None:
+                self._stats["rejected"] += 1
+                return None
             backlog = len(self.engine.queue)
             if backlog >= self.max_queue:
                 self._stats["rejected"] += 1
@@ -84,23 +144,33 @@ class ServeFrontend:
                 self._results.pop(rid, None)
             return None
         with self._lock:
-            return self._results.pop(rid)
+            # No parked result = woken by _handle_degraded, not by a
+            # completion: the request died with the group.
+            return self._results.pop(rid, None)
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:
-            return {**self._stats,
-                    "active_slots": self.engine.num_active,
-                    "queued": len(self.engine.queue),
-                    # Speculative acceptance counters (zeros when off).
-                    **getattr(self.engine, "spec_stats", {}),
-                    # Paged engines expose pool/prefix-cache counters.
-                    **getattr(self.engine, "stats", {})}
+            out = {**self._stats,
+                   "active_slots": self.engine.num_active,
+                   "queued": len(self.engine.queue),
+                   # Speculative acceptance counters (zeros when off).
+                   **getattr(self.engine, "spec_stats", {}),
+                   # Paged engines expose pool/prefix-cache counters.
+                   **getattr(self.engine, "stats", {})}
+        if self._degraded is not None:
+            out["degraded"] = self._degraded
+        if self.monitor is not None:
+            out["group"] = self.monitor.status()
+        return out
 
     def drain(self, timeout: float = 60.0) -> bool:
         """Graceful shutdown step: let the engine loop finish queued +
         in-flight requests (their submit() callers get real responses)
         instead of dropping them mid-roll.  Returns True when fully
-        drained, False on timeout (remaining work is abandoned)."""
+        drained, False on timeout (remaining work is abandoned) or
+        immediately when degraded (stuck collective: nothing drains)."""
+        if self._degraded is not None:
+            return False
         deadline = time.monotonic() + timeout       # wall-clock-step safe
         while time.monotonic() < deadline:
             if not self.engine.has_work():
@@ -112,8 +182,13 @@ class ServeFrontend:
         """Stop the engine loop.  ``timeout=None`` blocks until the
         thread is actually dead — required before a multi-host engine
         may broadcast STOP (a live loop thread could still be issuing
-        collectives, and two threads' broadcasts can mispair)."""
+        collectives, and two threads' broadcasts can mispair).  A
+        degraded group caps the wait: the loop thread may be pinned
+        inside a dead collective forever (it is daemonic; process exit
+        reaps it — and the engine's STOP broadcast is skipped anyway)."""
         self._stop.set()
+        if self._degraded is not None:
+            timeout = 2.0 if timeout is None else min(timeout, 2.0)
         self._thread.join(timeout=timeout)
 
     # -- HTTP --------------------------------------------------------------
@@ -125,6 +200,13 @@ class ServeFrontend:
         class Handler(JsonHandler):
             def do_GET(self):
                 if self.path == "/healthz":
+                    # 503 on degradation: the pod's readiness/liveness
+                    # probe fails, which is the kubelet-visible half of
+                    # slice replacement.
+                    if frontend.degraded is not None:
+                        return self._send(503, {
+                            "status": "degraded",
+                            "reason": frontend.degraded})
                     return self._send(200, {"status": "ok"})
                 if self.path == "/stats":
                     return self._send(200, frontend.stats())
@@ -311,22 +393,54 @@ def main(argv=None):  # pragma: no cover - process wrapper
         from kuberay_tpu.serve.multihost import MultihostServeEngine
         engine_cls, multihost_cls = ServeEngine, MultihostServeEngine
 
+    import os as _os
+    hb_port = int(_os.environ.get("TPU_GROUP_HEALTH_PORT",
+                                  C.PORT_GROUP_HEALTH))
     if jax.process_count() > 1 and jax.process_index() > 0:
         # Follower host: no frontend, no scheduling — replay host 0's
         # device calls until it broadcasts STOP.  Paged followers hold a
-        # pool but no allocator state (tables ride the plan).
+        # pool but no allocator state (tables ride the plan).  A daemon
+        # thread heartbeats host 0 so a follower death is DETECTED there
+        # instead of manifesting only as a hung collective.
+        from kuberay_tpu.serve.group_health import start_heartbeat
         from kuberay_tpu.serve.multihost import follower_loop
         engine = engine_cls(cfg, params, **engine_kw)
+        host0 = ident.hostnames[0] if ident.hostnames else "127.0.0.1"
+        start_heartbeat(host0, hb_port, ident.worker_id)
         print(f"serve follower {jax.process_index()}/"
               f"{jax.process_count()} ready", flush=True)
         follower_loop(engine)
         return
 
+    monitor = None
     if jax.process_count() > 1:
+        from kuberay_tpu.serve.group_health import GroupMonitor
         engine = multihost_cls(cfg, params, **engine_kw)
+        monitor = GroupMonitor(
+            expected=list(range(1, jax.process_count())),
+            miss_timeout=float(_os.environ.get(
+                "TPU_GROUP_MISS_TIMEOUT", "10")),
+            step_timeout=float(_os.environ.get(
+                "TPU_GROUP_STEP_TIMEOUT", "60")))
+        monitor.listen(port=hb_port)
     else:
         engine = engine_cls(cfg, params, **engine_kw)
-    frontend = ServeFrontend(engine)
+
+    def on_degraded(reason: str) -> None:
+        # Surface upward: the TpuService controller maps a DEGRADED app
+        # to the ServeGroupDegraded condition and replaces the slice.
+        print(f"serve: DEGRADED — {reason}", flush=True)
+        if args.coordinator:
+            try:
+                from kuberay_tpu.runtime.coordinator_client import (
+                    CoordinatorClient)
+                CoordinatorClient(args.coordinator).set_serve_app_status(
+                    args.app_name, "DEGRADED", reason)
+            except Exception:
+                pass
+
+    frontend = ServeFrontend(engine, monitor=monitor,
+                             on_degraded=on_degraded)
     srv = frontend.make_server(args.host, args.port)
     if args.coordinator == "auto":
         # Resolve from the operator-injected env (builders/pod.py).

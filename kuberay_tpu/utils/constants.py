@@ -78,6 +78,17 @@ PORT_COORDINATOR = 8476         # jax.distributed coordinator (~GCS 6379)
 PORT_DASHBOARD = 8265           # runtime dashboard / job API (same as Ray's)
 PORT_METRICS = 8080             # Prometheus metrics on every node
 PORT_SERVE = 8000               # inference HTTP
+PORT_GROUP_HEALTH = 8090        # serve-group heartbeat listener (host 0)
+
+# Kube PATCH MIME types, patch_type -> Content-Type (the one table the
+# clients send from and the apiserver inverts; apply is +yaml on the
+# wire, JSON being a YAML subset).
+PATCH_CONTENT_TYPES = {
+    "merge": "application/merge-patch+json",
+    "strategic": "application/strategic-merge-patch+json",
+    "json": "application/json-patch+json",
+    "apply": "application/apply-patch+yaml",
+}
 PORT_MXLA = 8081                # MXLA coordinator (multi-slice samples)
 PORT_CLIENT = 10001
 

@@ -1,0 +1,136 @@
+"""Kill-a-follower e2e worker (spawned by test_group_health.py).
+
+Host 0: MultihostServeEngine + GroupMonitor + ServeFrontend + HTTP
+server; submits a long request, then waits for the group to degrade
+(the parent SIGKILLs the follower mid-decode).  Prints marker lines the
+test asserts on and exits 0 — the real pod would now fail its readiness
+probe and be replaced with its whole slice.
+
+Follower: engine + heartbeat thread + follower_loop (killed by parent).
+
+Env: TPU_GROUP_HEALTH_PORT (parent-chosen), READY_FILE (host 0 touches
+it after the first completed device step so the parent kills the
+follower only once serving is genuinely in flight).
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+
+def main() -> None:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+    from kuberay_tpu.train.launcher import initialize_distributed
+    initialize_distributed()
+    import dataclasses
+
+    from kuberay_tpu.models import llama
+    from kuberay_tpu.serve.engine import ServeEngine
+    from kuberay_tpu.serve.group_health import (
+        GroupMonitor,
+        start_heartbeat,
+    )
+    from kuberay_tpu.serve.multihost import (
+        MultihostServeEngine,
+        follower_loop,
+    )
+    from kuberay_tpu.serve.server import ServeFrontend
+    from kuberay_tpu.serve.sharding import serve_mesh
+
+    cfg = dataclasses.replace(llama.CONFIGS["llama_tiny"],
+                              n_heads=8, n_kv_heads=4)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    mesh = serve_mesh(len(jax.devices()))
+    kw = dict(max_slots=2, max_len=256, mesh=mesh)
+    hb_port = int(os.environ["TPU_GROUP_HEALTH_PORT"])
+
+    if jax.process_index() != 0:
+        follower = ServeEngine(cfg, params, **kw)
+        start_heartbeat("127.0.0.1", hb_port, jax.process_index(),
+                        interval=0.3)
+        print("FOLLOWER_READY", flush=True)
+        follower_loop(follower)
+        print("FOLLOWER_STOPPED", flush=True)
+        return
+
+    eng = MultihostServeEngine(cfg, params, **kw)
+    monitor = GroupMonitor(expected=[1], miss_timeout=3.0,
+                           step_timeout=10.0, grace=120.0)
+    monitor.listen(port=hb_port)
+    frontend = ServeFrontend(
+        eng, monitor=monitor,
+        on_degraded=lambda r: print(f"DEGRADED {r}", flush=True))
+    srv, url = frontend.serve_background()
+
+    ready_file = os.environ["READY_FILE"]
+    results = []
+
+    def long_request():
+        t0 = time.time()
+        resp = frontend.submit([1, 2, 3, 4, 5], max_tokens=2000,
+                               timeout=240.0)
+        results.append((resp, time.time() - t0))
+        print(f"SUBMIT_DONE none={resp is None} "
+              f"secs={time.time() - t0:.1f}", flush=True)
+
+    t = threading.Thread(target=long_request, daemon=True)
+    t.start()
+
+    # Signal the parent once decoding is genuinely in flight.
+    while eng.num_active == 0 and frontend.degraded is None:
+        time.sleep(0.05)
+    time.sleep(1.0)                      # a few decode broadcasts
+    with open(ready_file, "w") as f:
+        f.write("serving\n")
+    print("SERVING_IN_FLIGHT", flush=True)
+
+    deadline = time.time() + 120
+    while frontend.degraded is None and time.time() < deadline:
+        time.sleep(0.2)
+    if frontend.degraded is None:
+        print("NEVER_DEGRADED", flush=True)
+        sys.exit(2)
+
+    # The in-flight submit must fail FAST (drained), not hang to its
+    # 240 s client timeout.
+    t.join(timeout=30)
+    print(f"SUBMIT_FAILED_FAST joined={not t.is_alive()} "
+          f"none={bool(results and results[0][0] is None)}", flush=True)
+
+    # Readiness flips: /healthz must be 503 now.
+    try:
+        urllib.request.urlopen(f"{url}/healthz", timeout=5)
+        print("HEALTHZ_STILL_OK", flush=True)
+    except urllib.error.HTTPError as e:
+        body = json.loads(e.read())
+        print(f"HEALTHZ_503 code={e.code} reason={body.get('reason')!r}",
+              flush=True)
+
+    # New submissions are rejected immediately.
+    t0 = time.time()
+    resp = frontend.submit([1, 2, 3], max_tokens=4, timeout=30.0)
+    print(f"NEW_SUBMIT_REJECTED none={resp is None} "
+          f"secs={time.time() - t0:.2f}", flush=True)
+
+    # Shutdown must not hang on the dead collective.
+    srv.shutdown()
+    frontend.close(timeout=None)
+    eng.stop()                           # skipped broadcast (degraded)
+    monitor.close()
+    print("CLEAN_EXIT", flush=True)
+    sys.stdout.flush()
+    # Skip atexit: jax.distributed's shutdown barrier would fail against
+    # the dead peer (the real pod is SIGKILLed by slice replacement at
+    # this point anyway).
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

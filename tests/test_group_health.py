@@ -1,0 +1,275 @@
+"""Serve-group failure detection (VERDICT r3 item 3): heartbeat
+monitor, step watchdog, frontend drain-on-degraded, ServeGroupDegraded
+condition driving whole-slice replacement, and the kill-a-follower e2e
+on the 2-process CPU harness.
+
+Reference invariant being extended to the serve layer: unhealthy
+multi-host groups are repaired WHOLE, never partially
+(raycluster_controller.go:1269-1289)."""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from kuberay_tpu.serve.group_health import (
+    GroupMonitor,
+    start_heartbeat,
+)
+
+
+def wait_for(fn, timeout=10.0, poll=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(poll)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# monitor unit behavior
+
+
+def test_monitor_detects_missed_heartbeats():
+    m = GroupMonitor(expected=[1, 2], miss_timeout=0.3, grace=0.0)
+    m.beat(1)
+    m.beat(2)
+    assert m.check() is None
+    m.beat(1)
+    time.sleep(0.5)
+    m.beat(1)                      # 1 keeps beating, 2 went silent
+    reason = m.check()
+    assert reason and "[2]" in reason
+    # Sticky: later beats do not resurrect the group.
+    m.beat(2)
+    assert m.check() == reason
+
+
+def test_monitor_step_watchdog():
+    m = GroupMonitor(expected=[], miss_timeout=30.0, step_timeout=0.2)
+    m.step_begin()
+    assert m.check() is None
+    time.sleep(0.4)
+    assert "stuck" in m.check()
+    # step_end clears the clock for healthy groups.
+    m2 = GroupMonitor(expected=[], miss_timeout=30.0, step_timeout=0.2)
+    m2.step_begin()
+    m2.step_end()
+    time.sleep(0.4)
+    assert m2.check() is None
+
+
+def test_monitor_grace_defers_first_beat_deadline():
+    m = GroupMonitor(expected=[1], miss_timeout=0.2, grace=5.0)
+    time.sleep(0.4)                # past miss_timeout, inside grace
+    assert m.check() is None
+
+
+def test_monitor_on_degraded_fires_once():
+    fired = []
+    m = GroupMonitor(expected=[1], miss_timeout=0.1, grace=0.0,
+                     on_degraded=fired.append)
+    time.sleep(0.2)
+    m.check()
+    m.check()
+    assert len(fired) == 1
+
+
+def test_heartbeat_wire_protocol():
+    m = GroupMonitor(expected=[1], miss_timeout=1.0, grace=10.0)
+    port = m.listen(host="127.0.0.1", port=0)
+    stop = start_heartbeat("127.0.0.1", port, 1, interval=0.1)
+    try:
+        assert wait_for(
+            lambda: m.status()["beat_age_seconds"]["1"] < 0.5)
+        # Beats keep the group healthy past the grace-less deadline.
+        time.sleep(1.2)
+        assert m.check() is None
+        # Stop beating -> degradation within miss_timeout.
+        stop.set()
+        assert wait_for(lambda: m.check() is not None, timeout=5)
+        assert "missed heartbeats" in m.check()
+    finally:
+        stop.set()
+        m.close()
+
+
+# ---------------------------------------------------------------------------
+# frontend drain semantics (single-process: monitor injected directly)
+
+
+def test_frontend_fails_pending_and_rejects_on_degraded():
+    import jax
+
+    from kuberay_tpu.models import llama
+    from kuberay_tpu.serve.engine import ServeEngine
+    from kuberay_tpu.serve.server import ServeFrontend
+
+    cfg = llama.CONFIGS["llama_tiny"]
+    eng = ServeEngine(cfg, llama.init_params(cfg, jax.random.PRNGKey(0)),
+                      max_slots=2, max_len=64)
+    reasons = []
+    fe = ServeFrontend(eng, on_degraded=reasons.append)
+    import threading
+    out = []
+    t = threading.Thread(
+        target=lambda: out.append(fe.submit([1, 2, 3], max_tokens=8,
+                                            timeout=30)),
+        daemon=True)
+    # Park the loop BEFORE the request is admitted so the waiter is
+    # pending when degradation hits.
+    fe._handle_degraded("test: follower lost")
+    t.start()
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert out == [None]
+    assert fe.degraded == "test: follower lost"
+    assert reasons == ["test: follower lost"]
+    assert fe.stats()["degraded"] == "test: follower lost"
+    # drain() reports failure instead of waiting out its timeout.
+    t0 = time.time()
+    assert fe.drain(timeout=30) is False
+    assert time.time() - t0 < 1
+    fe.close()
+
+
+def test_frontend_degrades_on_engine_exception():
+    import jax
+
+    from kuberay_tpu.models import llama
+    from kuberay_tpu.serve.engine import ServeEngine
+    from kuberay_tpu.serve.server import ServeFrontend
+
+    cfg = llama.CONFIGS["llama_tiny"]
+    eng = ServeEngine(cfg, llama.init_params(cfg, jax.random.PRNGKey(0)),
+                      max_slots=2, max_len=64)
+
+    def boom():
+        raise RuntimeError("collective aborted: peer disconnected")
+
+    eng.step = boom
+    fe = ServeFrontend(eng)
+    assert fe.submit([1, 2, 3], max_tokens=4, timeout=10) is None
+    assert "collective aborted" in (fe.degraded or "")
+    fe.close()
+
+
+# ---------------------------------------------------------------------------
+# controller: DEGRADED app -> condition + immediate slice replacement
+
+
+def test_service_controller_replaces_on_degraded_app():
+    """A DEGRADED serve app (dead follower) sets ServeGroupDegraded and
+    triggers whole-cluster replacement IMMEDIATELY — no threshold wait —
+    through the full controller stack (cluster controller + kubelet)."""
+    from kuberay_tpu.api.tpuservice import (
+        ServiceConditionType,
+        ServiceStatusName,
+    )
+    from tests.test_service_controller import (
+        ServiceHarness,
+        make_service,
+    )
+
+    h = ServiceHarness()
+    svc = make_service()
+    # Hour-long thresholds prove DEGRADED bypasses them.
+    svc.spec.serviceUnhealthySecondThreshold = 3600
+    svc.spec.deploymentUnhealthySecondThreshold = 3600
+    h.store.create(svc.to_dict())
+    h.settle()
+    s = h.svc()
+    active = s.status.activeServiceStatus.clusterName
+    conds = {c.type: c for c in s.status.conditions}
+    assert conds[ServiceConditionType.SERVE_GROUP_DEGRADED].status == \
+        "False"
+
+    # Follower dies: the serve server posts DEGRADED to the coordinator.
+    h.clients[active].set_serve_app(
+        "llm", ServiceStatusName.DEGRADED,
+        "follower(s) [1] missed heartbeats for >10s")
+    # One reconcile pass: condition up + replacement prepared, BEFORE
+    # the recovery machinery has had time to promote anything.
+    h.svc_ctrl.reconcile("svc", "default")
+    s = h.svc()
+    conds = {c.type: c for c in s.status.conditions}
+    cond = conds[ServiceConditionType.SERVE_GROUP_DEGRADED]
+    assert cond.status == "True"
+    assert "missed heartbeats" in cond.message
+    # Replacement cluster exists (prepared despite the 3600 s threshold).
+    assert any(c["metadata"]["name"] != active
+               for c in h.store.list("TpuCluster", "default"))
+
+    # Replacement comes up, takes over, condition clears.
+    h.settle(rounds=16)
+    s = h.svc()
+    assert s.status.activeServiceStatus.clusterName != active
+    assert s.status.serviceStatus == "Running"
+    conds = {c.type: c for c in s.status.conditions}
+    assert conds[ServiceConditionType.SERVE_GROUP_DEGRADED].status == \
+        "False"
+
+
+# ---------------------------------------------------------------------------
+# e2e: kill a follower mid-decode on the 2-process CPU harness
+
+
+@pytest.mark.timeout(420)
+def test_kill_follower_no_hang_and_degraded(tmp_path):
+    """SIGKILL the follower while host 0 is mid-decode: host 0 must
+    detect (heartbeat loss), fail the in-flight request fast, 503 its
+    health probe, reject new work, and exit cleanly — no hang."""
+    script = os.path.join(os.path.dirname(__file__), "helpers",
+                          "degraded_serve_worker.py")
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    hb_port = sock.getsockname()[1]
+    sock.close()
+    ready_file = str(tmp_path / "ready")
+
+    def spawn(worker_id):
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+            "TPU_WORKER_HOSTNAMES": "localhost,localhost",
+            "TPU_NUM_PROCESSES": "2",
+            "TPU_WORKER_ID": str(worker_id),
+            "TPU_GROUP_HEALTH_PORT": str(hb_port),
+            "READY_FILE": ready_file,
+        })
+        return subprocess.Popen([sys.executable, script], env=env,
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT, text=True)
+
+    host0, follower = spawn(0), spawn(1)
+    try:
+        assert wait_for(lambda: os.path.exists(ready_file), timeout=300,
+                        poll=0.2), "serving never reached in-flight state"
+        follower.send_signal(signal.SIGKILL)
+        follower.wait(timeout=30)
+        out, _ = host0.communicate(timeout=120)
+    finally:
+        for p in (host0, follower):
+            if p.poll() is None:
+                p.kill()
+    assert host0.returncode == 0, out[-3000:]
+    # Either detection path is correct — whichever wins the race: the
+    # collective erroring on the scheduling thread (gloo notices the
+    # closed TCP pair instantly) or the heartbeat monitor (covered in
+    # isolation by test_heartbeat_wire_protocol).
+    assert "DEGRADED " in out
+    assert ("missed heartbeats" in out or "engine step failed" in out)
+    assert "SUBMIT_FAILED_FAST joined=True none=True" in out
+    assert "HEALTHZ_503 code=503" in out
+    assert "NEW_SUBMIT_REJECTED none=True" in out
+    # Rejection was immediate, not a 30 s timeout burn.
+    rej = next(ln for ln in out.splitlines()
+               if ln.startswith("NEW_SUBMIT_REJECTED"))
+    assert float(rej.split("secs=")[1]) < 2.0
+    assert "CLEAN_EXIT" in out
