@@ -46,7 +46,10 @@ from kuberay_tpu.controlplane.expectations import HEAD_GROUP, ScaleExpectations
 from kuberay_tpu.controlplane.store import AlreadyExists, NotFound, ObjectStore
 from kuberay_tpu.utils import constants as C
 from kuberay_tpu.utils.names import head_service_name, spec_hash
-from kuberay_tpu.utils.validation import validate_cluster
+from kuberay_tpu.utils.validation import (
+    validate_cluster,
+    validate_cluster_status,
+)
 
 POD_SPEC_HASH_ANNOTATION = "tpu.dev/pod-template-hash"
 
@@ -109,6 +112,9 @@ class TpuClusterController:
         # every client benefits — ref apiserver ComputeTemplate resolution).
         errs = resolve_compute_templates(cluster, self.store)
         errs += validate_cluster(cluster)
+        # Status sanity (ref ValidateRayClusterStatus :23): mutually
+        # exclusive suspend conditions mean a forged/corrupt status.
+        errs += validate_cluster_status(cluster)
         if errs:
             self.recorder.warning(raw, C.EVENT_INVALID_SPEC, "; ".join(errs))
             self._set_status(cluster, state=ClusterState.FAILED,

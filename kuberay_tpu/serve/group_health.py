@@ -95,7 +95,12 @@ class GroupMonitor:
 
     def beat(self, worker_id: int) -> None:
         with self._lock:
-            self._last_beat[worker_id] = time.monotonic()
+            # Only EXPECTED ids: a stray beat (misconfigured worker id,
+            # stale process from a prior incarnation, any writer on the
+            # unauthenticated port) must not create an entry that goes
+            # stale and trips a bogus degradation.
+            if worker_id in self._last_beat:
+                self._last_beat[worker_id] = time.monotonic()
 
     def step_begin(self, compiling: bool = False) -> None:
         self._step_budget = (self.compile_timeout if compiling

@@ -429,15 +429,28 @@ def main(argv=None):  # pragma: no cover - process wrapper
     def on_degraded(reason: str) -> None:
         # Surface upward: the TpuService controller maps a DEGRADED app
         # to the ServeGroupDegraded condition and replaces the slice.
+        # The transition fires exactly once, so the report RETRIES until
+        # delivered — a transient coordinator blip exactly when a slice
+        # fails must not lose the replacement trigger (the daemon thread
+        # dies with the process once the slice is replaced).
         print(f"serve: DEGRADED — {reason}", flush=True)
-        if args.coordinator:
-            try:
-                from kuberay_tpu.runtime.coordinator_client import (
-                    CoordinatorClient)
-                CoordinatorClient(args.coordinator).set_serve_app_status(
-                    args.app_name, "DEGRADED", reason)
-            except Exception:
-                pass
+        if not args.coordinator:
+            return
+
+        def report_until_delivered():
+            from kuberay_tpu.runtime.coordinator_client import (
+                CoordinatorClient, CoordinatorError)
+            while True:
+                try:
+                    CoordinatorClient(args.coordinator) \
+                        .set_serve_app_status(args.app_name, "DEGRADED",
+                                              reason)
+                    return
+                except CoordinatorError:
+                    time.sleep(5.0)
+
+        threading.Thread(target=report_until_delivered, daemon=True,
+                         name="degraded-report").start()
 
     frontend = ServeFrontend(engine, monitor=monitor,
                              on_degraded=on_degraded)

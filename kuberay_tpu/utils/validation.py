@@ -22,6 +22,11 @@ from kuberay_tpu.utils import features
 from kuberay_tpu.utils.cron import CronError, parse_cron
 
 _DNS1123 = re.compile(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?$")
+# DNS-1035 (must start with a letter): CR names feed Service names, and
+# kube rejects digit-leading Service names (ref IsDNS1035Label checks in
+# ValidateRayClusterMetadata/ValidateRayServiceMetadata).
+_DNS1035 = re.compile(r"^[a-z]([-a-z0-9]*[a-z0-9])?$")
+_QUANTITY = re.compile(r"^[0-9]+(\.[0-9]+)?(Ki|Mi|Gi|Ti|Pi|k|M|G|T)?$")
 
 
 class ValidationError(ValueError):
@@ -37,8 +42,19 @@ def validate_metadata(name: str, errs: List[str], max_len: int = 63):
     _check(bool(name), "metadata.name must be set", errs)
     if name:
         _check(len(name) <= max_len, f"metadata.name {name!r} exceeds {max_len} chars", errs)
-        _check(bool(_DNS1123.match(name)),
-               f"metadata.name {name!r} is not a valid DNS-1123 label", errs)
+        _check(bool(_DNS1035.match(name)),
+               f"metadata.name {name!r} is not a valid DNS-1035 label "
+               "(must start with a letter: derived Service names require it)",
+               errs)
+
+
+def _container_env(template) -> dict:
+    """name -> value for the first container's env (the operator-managed
+    container; ref RayContainerIndex)."""
+    cs = template.spec.containers
+    if not cs:
+        return {}
+    return {e.name: e.value for e in (cs[0].env or [])}
 
 
 def validate_cluster_spec(spec: TpuClusterSpec, errs: List[str]):
@@ -57,8 +73,9 @@ def validate_cluster_spec(spec: TpuClusterSpec, errs: List[str]):
             _check(g.groupName not in seen,
                    f"{prefix}.groupName {g.groupName!r} is duplicated", errs)
             seen.add(g.groupName)
+        chips_per_host = None
         try:
-            g.slice_topology()
+            chips_per_host = g.slice_topology().chips_per_host
         except TopologyError as e:
             errs.append(f"{prefix}: {e}")
         _check(g.replicas >= 0, f"{prefix}.replicas must be >= 0", errs)
@@ -69,12 +86,37 @@ def validate_cluster_spec(spec: TpuClusterSpec, errs: List[str]):
             _check(g.minReplicas <= g.replicas <= g.maxReplicas,
                    f"{prefix}.replicas must be within [minReplicas, maxReplicas] "
                    "when autoscaling is enabled", errs)
+            # Ref validation.go:212-217: a suspended group under the
+            # autoscaler would immediately be resized back up.
+            _check(not g.suspend,
+                   f"{prefix} cannot be suspended with autoscaling enabled",
+                   errs)
+        if g.suspend:
+            # Ref :195-199 (RayJobDeletionPolicy gates worker suspend).
+            _check(features.enabled("DeletionRules"),
+                   f"{prefix}.suspend requires the DeletionRules feature "
+                   "gate", errs)
         _check(bool(g.template.spec.containers),
                f"{prefix}.template must have at least one container", errs)
+        # Conflicting TPU resource declarations (ref
+        # validateRayGroupResources:60): the operator derives
+        # google.com/tpu from the topology; an explicit different value
+        # would silently win and break the slice's ICI assumptions.
+        for c in g.template.spec.containers:
+            for kind in ("requests", "limits"):
+                declared = getattr(c.resources, kind).get("google.com/tpu")
+                if declared is not None and chips_per_host is not None and \
+                        str(declared) != str(chips_per_host):
+                    errs.append(
+                        f"{prefix}: container {c.name!r} {kind} "
+                        f"google.com/tpu={declared} conflicts with "
+                        f"topology-derived {chips_per_host} chips/host — "
+                        "drop the explicit resource (the operator owns it)")
 
     _check(spec.upgradeStrategy in (UpgradeStrategyType.RECREATE, UpgradeStrategyType.NONE),
            f"upgradeStrategy must be Recreate or None, got {spec.upgradeStrategy!r}", errs)
 
+    head_env = _container_env(spec.headGroupSpec.template)
     if spec.headStateOptions is not None:
         hso = spec.headStateOptions
         _check(hso.backend in ("memory", "external", "persistent"),
@@ -83,10 +125,55 @@ def validate_cluster_spec(spec: TpuClusterSpec, errs: List[str]):
             _check(bool(hso.externalStorageAddress),
                    "headStateOptions.externalStorageAddress required for external backend",
                    errs)
+        else:
+            # Ref redis-only field rejection (validation.go:306): fields
+            # of the wrong backend silently doing nothing hides typos.
+            _check(not hso.externalStorageAddress,
+                   "headStateOptions.externalStorageAddress is only valid "
+                   "for backend=external", errs)
         if hso.backend == "persistent":
             _check(features.enabled("CoordinatorPersistentState"),
                    "headStateOptions.backend=persistent requires the "
                    "CoordinatorPersistentState feature gate", errs)
+        else:
+            _check(not hso.storageClassName,
+                   "headStateOptions.storageClassName is only valid for "
+                   "backend=persistent", errs)
+        _check(bool(_QUANTITY.match(hso.storageSize)),
+               f"headStateOptions.storageSize {hso.storageSize!r} is not "
+               "a valid quantity", errs)
+        # Operator-managed env must not be hand-set alongside the options
+        # (ref RAY_REDIS_ADDRESS / REDIS_PASSWORD rejections :158-183).
+        _check("TPU_HEAD_EXTERNAL_STORAGE_ADDRESS" not in head_env,
+               "cannot set TPU_HEAD_EXTERNAL_STORAGE_ADDRESS env in the "
+               "head pod when headStateOptions is set — use "
+               "headStateOptions.externalStorageAddress", errs)
+    else:
+        # Env implying external state without the options block (ref
+        # :156: RAY_REDIS_ADDRESS without GcsFaultToleranceOptions).
+        _check("TPU_HEAD_EXTERNAL_STORAGE_ADDRESS" not in head_env,
+               "TPU_HEAD_EXTERNAL_STORAGE_ADDRESS implies external head "
+               "state; set headStateOptions (backend=external) instead",
+               errs)
+
+    if spec.autoscalerOptions is not None:
+        ao = spec.autoscalerOptions
+        _check(ao.idleTimeoutSeconds >= 0,
+               "autoscalerOptions.idleTimeoutSeconds must be >= 0", errs)
+        _check(ao.upscalingMode in ("Default", "Aggressive", "Conservative"),
+               f"autoscalerOptions.upscalingMode {ao.upscalingMode!r} "
+               "invalid (Default|Aggressive|Conservative)", errs)
+        _check(ao.imagePullPolicy in ("", "Always", "IfNotPresent", "Never"),
+               f"autoscalerOptions.imagePullPolicy "
+               f"{ao.imagePullPolicy!r} invalid", errs)
+
+    if spec.networkPolicy is not None and spec.networkPolicy.enabled:
+        _check(features.enabled("TpuClusterNetworkPolicy"),
+               "spec.networkPolicy requires the TpuClusterNetworkPolicy "
+               "feature gate", errs)
+        _check(spec.networkPolicy.mode in ("DenyAll", "DenyAllEgress"),
+               f"networkPolicy.mode {spec.networkPolicy.mode!r} invalid "
+               "(DenyAll|DenyAllEgress)", errs)
 
     if spec.managedBy:
         _check(spec.managedBy in ("kuberay-tpu-operator", "kueue.x-k8s.io/multikueue"),
@@ -97,7 +184,28 @@ def validate_cluster(cluster: TpuCluster) -> List[str]:
     errs: List[str] = []
     validate_metadata(cluster.metadata.name, errs)
     validate_cluster_spec(cluster.spec, errs)
+    # upgradeStrategy is a direct-user knob: child clusters roll through
+    # their owning CR's machinery (ref ValidateRayClusterUpgradeOptions
+    # :50-56).
+    origin = (cluster.metadata.labels or {}).get(
+        "tpu.dev/originated-from-crd", "")
+    if origin in ("TpuJob", "TpuService") and \
+            cluster.spec.upgradeStrategy != UpgradeStrategyType.NONE:
+        errs.append(f"upgradeStrategy cannot be set on a TpuCluster "
+                    f"created by a {origin}")
     return errs
+
+
+def validate_cluster_status(cluster: TpuCluster) -> List[str]:
+    """Ref ValidateRayClusterStatus (:23): mutually exclusive suspend
+    conditions — both True means a controller bug or a forged status."""
+    from kuberay_tpu.api.tpucluster import ClusterConditionType
+    conds = {c.type: c.status for c in cluster.status.conditions}
+    if conds.get(ClusterConditionType.SUSPENDING) == "True" and \
+            conds.get(ClusterConditionType.SUSPENDED) == "True":
+        return ["status conditions Suspending and Suspended cannot both "
+                "be True"]
+    return []
 
 
 def validate_job(job: TpuJob) -> List[str]:
@@ -128,10 +236,29 @@ def validate_job(job: TpuJob) -> List[str]:
     if spec.submissionMode == JobSubmissionMode.SIDECAR:
         _check(not has_selector,
                "SidecarMode requires clusterSpec (submitter rides the head pod)", errs)
+        # Ref :454-465: the sidecar rides the head pod, so a custom
+        # submitter template cannot apply, and a restarting head would
+        # resubmit.
+        _check(spec.submitterConfig.template is None,
+               "SidecarMode does not support submitterConfig.template "
+               "(the submitter rides the head pod)", errs)
+        if has_spec:
+            rp = spec.clusterSpec.headGroupSpec.template.spec.restartPolicy
+            _check(rp in ("", "Never"),
+                   "head pod restartPolicy must be Never or unset in "
+                   "SidecarMode (a restarted head would resubmit)", errs)
+
+    # Ref :451: a retried interactive job would reuse spec.jobId and jump
+    # straight to Running instead of Waiting.
+    if spec.submissionMode == JobSubmissionMode.INTERACTIVE:
+        _check(spec.backoffLimit == 0,
+               "backoffLimit cannot be used with InteractiveMode", errs)
 
     # Selector-mode constraints (ref validation.go:409,423,438): a job on a
     # pre-existing shared cluster cannot suspend it or retry with fresh ones.
     if has_selector:
+        _check(all(v for v in spec.clusterSelector.values()),
+               "clusterSelector values must not be empty", errs)
         _check(not spec.suspend,
                "suspend cannot be used with clusterSelector", errs)
         _check(spec.backoffLimit == 0,
@@ -153,6 +280,11 @@ def validate_job(job: TpuJob) -> List[str]:
     if spec.deletionStrategy is not None:
         _check(features.enabled("DeletionRules"),
                "deletionStrategy requires the DeletionRules feature gate", errs)
+        autoscaled = (spec.clusterSpec is not None
+                      and spec.clusterSpec.enableInTreeAutoscaling)
+        seen_pairs = set()
+        # (condition -> policy -> ttl) for the ordering check below.
+        ttls: dict = {}
         for i, rule in enumerate(spec.deletionStrategy.rules):
             _check(rule.policy in (
                 DeletionPolicyType.DELETE_CLUSTER, DeletionPolicyType.DELETE_WORKERS,
@@ -162,6 +294,40 @@ def validate_job(job: TpuJob) -> List[str]:
                    f"deletionStrategy.rules[{i}].condition must be Succeeded|Failed", errs)
             _check(rule.ttlSeconds >= 0,
                    f"deletionStrategy.rules[{i}].ttlSeconds must be >= 0", errs)
+            # Ref validateDeletionRules (:659): per-(condition, policy)
+            # uniqueness — a duplicate would make the engine's
+            # most-impactful-rule selection ambiguous.
+            pair = (rule.condition, rule.policy)
+            _check(pair not in seen_pairs,
+                   f"deletionStrategy.rules[{i}] duplicates policy "
+                   f"{rule.policy!r} for condition {rule.condition!r}", errs)
+            seen_pairs.add(pair)
+            # Selector mode shares the cluster: rules may only delete the
+            # job itself (ref :678-681).
+            if has_selector and rule.policy in (
+                    DeletionPolicyType.DELETE_CLUSTER,
+                    DeletionPolicyType.DELETE_WORKERS):
+                errs.append(
+                    f"deletionStrategy.rules[{i}].policy {rule.policy!r} "
+                    "not supported with clusterSelector (shared cluster)")
+            # The autoscaler owns worker deletion (ref :682-685).
+            if autoscaled and rule.policy == DeletionPolicyType.DELETE_WORKERS:
+                errs.append(
+                    f"deletionStrategy.rules[{i}].policy DeleteWorkers "
+                    "not supported with autoscaling enabled")
+            ttls.setdefault(rule.condition, {})[rule.policy] = rule.ttlSeconds
+        # TTL ordering per condition (ref validateTTLConsistency :754):
+        # Workers <= Cluster <= Self — a later stage deleting earlier
+        # would race the earlier stage's resources away.
+        order = (DeletionPolicyType.DELETE_WORKERS,
+                 DeletionPolicyType.DELETE_CLUSTER,
+                 DeletionPolicyType.DELETE_SELF)
+        for cond, by_policy in ttls.items():
+            chain = [(p, by_policy[p]) for p in order if p in by_policy]
+            for (p1, t1), (p2, t2) in zip(chain, chain[1:]):
+                _check(t2 >= t1,
+                       f"deletionStrategy: for condition {cond!r}, "
+                       f"{p2} TTL ({t2}) must be >= {p1} TTL ({t1})", errs)
         if spec.shutdownAfterJobFinishes and spec.deletionStrategy.rules:
             errs.append("deletionStrategy and shutdownAfterJobFinishes are mutually exclusive")
     return errs
@@ -182,25 +348,66 @@ def validate_service(svc: TpuService) -> List[str]:
         if opts is not None:
             _check(0 < opts.stepSizePercent <= 100,
                    "upgradeOptions.stepSizePercent must be in (0, 100]", errs)
+            # Ref ValidateClusterUpgradeOptions (:579): a step larger
+            # than the surge budget could never be applied.
+            _check(opts.stepSizePercent <= opts.maxSurgePercent,
+                   "upgradeOptions.stepSizePercent must be <= "
+                   "maxSurgePercent", errs)
             _check(opts.intervalSeconds > 0,
                    "upgradeOptions.intervalSeconds must be > 0", errs)
             _check(0 <= opts.maxSurgePercent <= 100,
                    "upgradeOptions.maxSurgePercent must be in [0, 100]", errs)
     _check(bool(svc.spec.serveConfig), "serveConfig must be set", errs)
+    # Serve-config shape: applications must be a list of uniquely named
+    # app objects — the controller keys health/status by app name
+    # (ref getAndCheckServeStatus / multi-app status contract).
+    apps = svc.spec.serveConfig.get("applications") \
+        if isinstance(svc.spec.serveConfig, dict) else None
+    if apps is not None:
+        if not isinstance(apps, list):
+            errs.append("serveConfig.applications must be a list")
+        else:
+            app_names = set()
+            for i, app in enumerate(apps):
+                if not isinstance(app, dict) or not app.get("name"):
+                    errs.append(f"serveConfig.applications[{i}] must be "
+                                "an object with a non-empty name")
+                    continue
+                _check(app["name"] not in app_names,
+                       f"serveConfig.applications[{i}].name "
+                       f"{app['name']!r} is duplicated", errs)
+                app_names.add(app["name"])
     _check(svc.spec.clusterDeletionDelaySeconds >= 0,
            "clusterDeletionDelaySeconds must be >= 0", errs)
+    _check(svc.spec.serviceUnhealthySecondThreshold >= 0,
+           "serviceUnhealthySecondThreshold must be >= 0", errs)
+    _check(svc.spec.deploymentUnhealthySecondThreshold >= 0,
+           "deploymentUnhealthySecondThreshold must be >= 0", errs)
     return errs
 
 
 def validate_cronjob(cron: TpuCronJob) -> List[str]:
     errs: List[str] = []
-    validate_metadata(cron.metadata.name, errs)
+    # Bound the name so deterministic child TpuJob names (cron name +
+    # timestamp suffix) stay valid DNS labels (ref
+    # MaxRayCronJobNameLength, validation.go:833).
+    validate_metadata(cron.metadata.name, errs, max_len=52)
     _check(features.enabled("TpuCronJob"),
            "TpuCronJob requires the TpuCronJob feature gate", errs)
+    # Ref :838: embedded TZ/CRON_TZ silently depends on the operator
+    # pod's zoneinfo; reject it outright.
+    _check("TZ" not in cron.spec.schedule,
+           "cannot use TZ or CRON_TZ in schedule", errs)
     try:
         parse_cron(cron.spec.schedule)
     except CronError as e:
         errs.append(f"schedule: {e}")
+    _check(cron.spec.startingDeadlineSeconds >= 0,
+           "startingDeadlineSeconds must be >= 0", errs)
+    _check(cron.spec.successfulJobsHistoryLimit >= 0,
+           "successfulJobsHistoryLimit must be >= 0", errs)
+    _check(cron.spec.failedJobsHistoryLimit >= 0,
+           "failedJobsHistoryLimit must be >= 0", errs)
     _check(cron.spec.concurrencyPolicy in (
         ConcurrencyPolicy.ALLOW, ConcurrencyPolicy.FORBID, ConcurrencyPolicy.REPLACE),
         f"concurrencyPolicy {cron.spec.concurrencyPolicy!r} invalid", errs)

@@ -64,6 +64,19 @@ def test_monitor_step_watchdog():
     assert m2.check() is None
 
 
+def test_monitor_ignores_stray_worker_ids():
+    """A beat from an unexpected id (misconfigured worker, stale prior
+    incarnation, random writer on the open port) must not create a
+    tracked entry that later goes stale and degrades a healthy group."""
+    m = GroupMonitor(expected=[1], miss_timeout=0.3, grace=0.0)
+    m.beat(1)
+    m.beat(7)                      # stray
+    time.sleep(0.4)
+    m.beat(1)
+    assert m.check() is None
+    assert set(m.status()["beat_age_seconds"]) == {"1"}
+
+
 def test_monitor_grace_defers_first_beat_deadline():
     m = GroupMonitor(expected=[1], miss_timeout=0.2, grace=5.0)
     time.sleep(0.4)                # past miss_timeout, inside grace
