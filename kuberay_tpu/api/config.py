@@ -27,6 +27,10 @@ class OperatorConfiguration(Serializable):
     # scheduler-plugins — ref batch-scheduler name in config):
     batchScheduler: str = ""
     enableBatchScheduler: bool = False
+    # OpenShift: expose the head via a Route instead of an Ingress (ref
+    # common/openshift.go BuildRouteForHeadService; the reference flips
+    # on detected cluster type, we take an explicit knob).
+    useOpenShiftRoute: bool = False
     # Injected into every built pod (ref default envs/labels/annotations):
     defaultPodEnv: Dict[str, str] = dataclasses.field(default_factory=dict)
     defaultPodLabels: Dict[str, str] = dataclasses.field(default_factory=dict)

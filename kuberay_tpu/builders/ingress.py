@@ -51,7 +51,10 @@ def build_head_ingress(cluster: TpuCluster,
 
 
 def build_head_route(cluster: TpuCluster) -> Dict[str, Any]:
-    """OpenShift Route projection of the same endpoint (ref openshift.go)."""
+    """OpenShift Route projection of the same endpoint (ref
+    openshift.go:19 BuildRouteForHeadService: weight-100 Service target
+    on the dashboard port, WildcardPolicy None, cluster annotations
+    copied through as the user's route-customization channel)."""
     name = cluster.metadata.name
     return {
         "apiVersion": "route.openshift.io/v1",
@@ -60,10 +63,13 @@ def build_head_route(cluster: TpuCluster) -> Dict[str, Any]:
             "name": truncate_name(f"{name}-head-route"),
             "namespace": cluster.metadata.namespace,
             "labels": {C.LABEL_CLUSTER: name},
+            "annotations": dict(cluster.metadata.annotations or {}),
             "ownerReferences": [cluster_owner_reference(cluster)],
         },
         "spec": {
-            "to": {"kind": "Service", "name": head_service_name(name)},
+            "to": {"kind": "Service", "name": head_service_name(name),
+                   "weight": 100},
             "port": {"targetPort": C.DEFAULT_DASHBOARD_PORT_NAME},
+            "wildcardPolicy": "None",
         },
     }

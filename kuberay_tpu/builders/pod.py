@@ -45,6 +45,63 @@ def _set_env(container: Dict[str, Any], env: Dict[str, str]) -> None:
             container["env"].append({"name": k, "value": v})
 
 
+def _probes_enabled() -> bool:
+    """Ref getEnableProbesInjection (pod.go:406): on unless the env
+    knob opts out."""
+    import os
+    return os.environ.get("ENABLE_PROBES_INJECTION",
+                          "true").lower() not in ("false", "0")
+
+
+def _inject_probes(container: Dict[str, Any], node_type: str,
+                   originated_from_crd: str = "",
+                   host_idx: int = 0) -> None:
+    """Readiness/liveness probes (ref initLivenessAndReadinessProbe
+    pod.go:539): user-set probes always win.
+
+    - head: HTTP GET /api/healthz on the coordinator's dashboard port
+      (the GCS-health analogue — the coordinator IS our GCS role);
+    - worker: exec probe reaching the head's healthz over the injected
+      TPU_COORDINATOR_ADDRESS (``ray health-check`` analogue: healthy =
+      connected to the head);
+    - serve workers (TpuService-owned): readiness ALSO requires the
+      local serve server's /healthz, which returns 503 once the lockstep
+      group degrades — the kubelet-visible half of whole-slice
+      replacement (serve/group_health.py).
+    """
+    if not _probes_enabled():
+        return
+    if node_type == C.NODE_TYPE_HEAD:
+        action = {"httpGet": {"path": "/api/healthz",
+                              "port": C.PORT_DASHBOARD}}
+        ready = {**action}
+    else:
+        check_head = (
+            "python -c \"import urllib.request,os;"
+            "h=os.environ['TPU_COORDINATOR_ADDRESS'].split(':')[0];"
+            f"urllib.request.urlopen(f'http://{{h}}:{C.PORT_DASHBOARD}"
+            "/api/healthz', timeout=3)\"")
+        action = {"exec": {"command": ["sh", "-c", check_head]}}
+        ready = {**action}
+        # Only host 0 of a serve slice runs the HTTP frontend
+        # (serve/server.py: followers replay collectives and serve
+        # nothing locally) — probing PORT_SERVE on a follower would pin
+        # it NotReady forever.
+        if originated_from_crd == C.KIND_SERVICE and host_idx == 0:
+            check_serve = (
+                "python -c \"import urllib.request;"
+                f"urllib.request.urlopen('http://localhost:{C.PORT_SERVE}"
+                "/healthz', timeout=3)\"")
+            ready = {"exec": {"command": [
+                "sh", "-c", f"{check_head} && {check_serve}"]}}
+    container.setdefault("livenessProbe", {
+        **action, "initialDelaySeconds": 30, "periodSeconds": 5,
+        "timeoutSeconds": 5, "failureThreshold": 120})
+    container.setdefault("readinessProbe", {
+        **ready, "initialDelaySeconds": 10, "periodSeconds": 5,
+        "timeoutSeconds": 5, "failureThreshold": 10})
+
+
 def coordinator_address(cluster: TpuCluster) -> str:
     ns = cluster.metadata.namespace
     return (f"{head_service_name(cluster.metadata.name)}.{ns}.svc:"
@@ -107,6 +164,8 @@ def build_head_pod(cluster: TpuCluster,
 
     if cluster.spec.schedulerName and not pod_spec.get("schedulerName"):
         pod_spec["schedulerName"] = cluster.spec.schedulerName
+
+    _inject_probes(head, C.NODE_TYPE_HEAD)
 
     labels = {**tmpl.get("metadata", {}).get("labels", {}),
               **_base_labels(cluster, C.NODE_TYPE_HEAD)}
@@ -211,6 +270,11 @@ def build_worker_pod(cluster: TpuCluster, group: WorkerGroupSpec,
 
     if cluster.spec.schedulerName and not pod_spec.get("schedulerName"):
         pod_spec["schedulerName"] = cluster.spec.schedulerName
+
+    _inject_probes(worker, C.NODE_TYPE_WORKER,
+                   (cluster.metadata.labels or {}).get(
+                       C.LABEL_ORIGINATED_FROM_CRD, ""),
+                   host_idx=host_idx)
 
     labels = {
         **tmpl.get("metadata", {}).get("labels", {}),

@@ -80,13 +80,16 @@ class TpuClusterController:
                  recorder: Optional[EventRecorder] = None,
                  scheduler=None,
                  config_env: Optional[Dict[str, str]] = None,
-                 metrics=None):
+                 metrics=None,
+                 use_openshift_route: bool = False):
         self.store = store
         self.exp = expectations or ScaleExpectations()
         self.recorder = recorder or EventRecorder(store)
         self.scheduler = scheduler        # gang plugin (scheduler/ package)
         self.config_env = config_env or {}
         self.metrics = metrics
+        # OpenShift clusters expose the head via a Route (openshift.go).
+        self.use_openshift_route = use_openshift_route
 
     # ------------------------------------------------------------------
     # entry point
@@ -225,8 +228,12 @@ class TpuClusterController:
         if needs_headless_service(cluster):
             self._ensure(build_headless_service(cluster))
         if cluster.spec.headGroupSpec.enableIngress:
-            from kuberay_tpu.builders.ingress import build_head_ingress
-            self._ensure(build_head_ingress(cluster))
+            if self.use_openshift_route:
+                from kuberay_tpu.builders.ingress import build_head_route
+                self._ensure(build_head_route(cluster))
+            else:
+                from kuberay_tpu.builders.ingress import build_head_ingress
+                self._ensure(build_head_ingress(cluster))
         if cluster.spec.enableTokenAuth:
             # _ensure never rotates: Secrets carry no spec, so the compare
             # is always equal and only the initial create happens.

@@ -40,7 +40,12 @@ from kuberay_tpu.controlplane.warmpool_controller import (
     WarmSlicePoolController,
 )
 from kuberay_tpu.runtime.coordinator_client import default_client_provider
-from kuberay_tpu.scheduler.adapters import KaiAdapter, VolcanoAdapter, YuniKornAdapter
+from kuberay_tpu.scheduler.adapters import (
+    KaiAdapter,
+    SchedulerPluginsAdapter,
+    VolcanoAdapter,
+    YuniKornAdapter,
+)
 from kuberay_tpu.scheduler.gang import GangScheduler
 from kuberay_tpu.scheduler.interface import SchedulerManager
 from kuberay_tpu.utils import constants as C
@@ -65,6 +70,7 @@ class Operator:
         self.schedulers.register(VolcanoAdapter(self.store))
         self.schedulers.register(YuniKornAdapter(self.store))
         self.schedulers.register(KaiAdapter(self.store))
+        self.schedulers.register(SchedulerPluginsAdapter(self.store))
         scheduler = (self.schedulers.get(self.config.batchScheduler)
                      if self.config.enableBatchScheduler else None)
 
@@ -75,7 +81,8 @@ class Operator:
         self.cluster_controller = TpuClusterController(
             self.store, expectations=self.manager.expectations,
             recorder=self.recorder, scheduler=scheduler,
-            config_env=self.config.defaultPodEnv, metrics=self.metrics)
+            config_env=self.config.defaultPodEnv, metrics=self.metrics,
+            use_openshift_route=self.config.useOpenShiftRoute)
         self.job_controller = TpuJobController(
             self.store, recorder=self.recorder,
             client_provider=provider,

@@ -118,6 +118,67 @@ class YuniKornAdapter:
         pass
 
 
+class SchedulerPluginsAdapter:
+    """sigs.k8s.io scheduler-plugins coscheduling adapter (ref
+    scheduler_plugins.go:31-88): a ``scheduling.x-k8s.io/v1alpha1``
+    PodGroup named after the cluster (owner-referenced for GC) plus the
+    ``scheduling.x-k8s.io/pod-group`` label on every pod; the
+    coscheduling plugin gates binding until minMember pods exist."""
+
+    name = "scheduler-plugins"
+    POD_GROUP_LABEL = "scheduling.x-k8s.io/pod-group"
+
+    def __init__(self, store: ObjectStore):
+        self.store = store
+
+    def _pg_name(self, obj) -> str:
+        # Ref createPodGroup: the PodGroup shares the cluster's name.
+        return obj["metadata"]["name"]
+
+    def on_cluster_submission(self, cluster: Dict[str, Any]) -> bool:
+        demand = total_cluster_demand(cluster)
+        md = cluster["metadata"]
+        ns = md.get("namespace", "default")
+        pg = {
+            "apiVersion": "scheduling.x-k8s.io/v1alpha1",
+            "kind": "PodGroup",
+            "metadata": {
+                "name": self._pg_name(cluster), "namespace": ns,
+                # Owner reference -> GC with the cluster (the reference
+                # relies on this instead of CleanupOnCompletion).
+                "ownerReferences": [{
+                    "apiVersion": cluster.get("apiVersion", C.API_VERSION),
+                    "kind": cluster.get("kind", C.KIND_CLUSTER),
+                    "name": md["name"], "uid": md.get("uid", ""),
+                }],
+            },
+            "spec": {
+                "minMember": demand["minMember"],
+                "minResources": {C.RESOURCE_TPU: demand["tpuChips"]},
+            },
+            "status": {},
+        }
+        self.store.ensure(pg)
+        return True    # coscheduling admits at bind time via the PodGroup
+
+    def on_job_submission(self, job: Dict[str, Any]) -> bool:
+        return True
+
+    def add_metadata(self, cluster, pod) -> None:
+        pod["metadata"].setdefault("labels", {})[self.POD_GROUP_LABEL] = \
+            self._pg_name(cluster)
+        pod["spec"]["schedulerName"] = "scheduler-plugins-scheduler"
+
+    def cleanup(self, obj) -> None:
+        # Owner references handle GC; explicit delete keeps parity with
+        # stores lacking cascading GC.
+        try:
+            self.store.delete("PodGroup", self._pg_name(obj),
+                              obj["metadata"].get("namespace", "default"))
+        except NotFound:
+            pass
+
+
 class KaiAdapter:
     name = "kai"
     QUEUE_LABEL = "kai.scheduler/queue"

@@ -138,4 +138,5 @@ CORE_PLURALS = {
     "Pod": "pods", "Service": "services", "Event": "events",
     "PodGroup": "podgroups", "NetworkPolicy": "networkpolicies",
     "Job": "jobs", "Secret": "secrets", "Ingress": "ingresses",
+    "Route": "routes",            # OpenShift head Route (openshift.go)
 }

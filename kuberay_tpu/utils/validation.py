@@ -18,6 +18,7 @@ from kuberay_tpu.api.tpujob import (
 )
 from kuberay_tpu.api.tpuservice import ServiceUpgradeType, TpuService
 from kuberay_tpu.topology import TopologyError
+from kuberay_tpu.utils import constants as C
 from kuberay_tpu.utils import features
 from kuberay_tpu.utils.cron import CronError, parse_cron
 
@@ -104,12 +105,12 @@ def validate_cluster_spec(spec: TpuClusterSpec, errs: List[str]):
         # would silently win and break the slice's ICI assumptions.
         for c in g.template.spec.containers:
             for kind in ("requests", "limits"):
-                declared = getattr(c.resources, kind).get("google.com/tpu")
+                declared = getattr(c.resources, kind).get(C.RESOURCE_TPU)
                 if declared is not None and chips_per_host is not None and \
                         str(declared) != str(chips_per_host):
                     errs.append(
                         f"{prefix}: container {c.name!r} {kind} "
-                        f"google.com/tpu={declared} conflicts with "
+                        f"{C.RESOURCE_TPU}={declared} conflicts with "
                         f"topology-derived {chips_per_host} chips/host — "
                         "drop the explicit resource (the operator owns it)")
 
@@ -188,8 +189,8 @@ def validate_cluster(cluster: TpuCluster) -> List[str]:
     # their owning CR's machinery (ref ValidateRayClusterUpgradeOptions
     # :50-56).
     origin = (cluster.metadata.labels or {}).get(
-        "tpu.dev/originated-from-crd", "")
-    if origin in ("TpuJob", "TpuService") and \
+        C.LABEL_ORIGINATED_FROM_CRD, "")
+    if origin in (C.KIND_JOB, C.KIND_SERVICE) and \
             cluster.spec.upgradeStrategy != UpgradeStrategyType.NONE:
         errs.append(f"upgradeStrategy cannot be set on a TpuCluster "
                     f"created by a {origin}")
@@ -349,10 +350,14 @@ def validate_service(svc: TpuService) -> List[str]:
             _check(0 < opts.stepSizePercent <= 100,
                    "upgradeOptions.stepSizePercent must be in (0, 100]", errs)
             # Ref ValidateClusterUpgradeOptions (:579): a step larger
-            # than the surge budget could never be applied.
-            _check(opts.stepSizePercent <= opts.maxSurgePercent,
-                   "upgradeOptions.stepSizePercent must be <= "
-                   "maxSurgePercent", errs)
+            # than the surge budget could never be applied.  maxSurge=0
+            # is exempt: it means "no surge constraint consumer" here
+            # (the controller steps traffic, not capacity surge), and
+            # stepSizePercent > 0 would make it unsatisfiable.
+            if opts.maxSurgePercent > 0:
+                _check(opts.stepSizePercent <= opts.maxSurgePercent,
+                       "upgradeOptions.stepSizePercent must be <= "
+                       "maxSurgePercent", errs)
             _check(opts.intervalSeconds > 0,
                    "upgradeOptions.intervalSeconds must be > 0", errs)
             _check(0 <= opts.maxSurgePercent <= 100,

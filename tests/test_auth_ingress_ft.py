@@ -77,9 +77,36 @@ def test_ingress_built_when_enabled():
 
 
 def test_openshift_route_shape():
-    route = build_head_route(make_cluster())
+    c = make_cluster()
+    c.metadata.annotations = {"haproxy.router.openshift.io/timeout": "30s"}
+    route = build_head_route(c)
     assert route["kind"] == "Route"
-    assert route["spec"]["to"]["name"] == "demo-head-svc"
+    assert route["spec"]["to"] == {"kind": "Service",
+                                   "name": "demo-head-svc", "weight": 100}
+    assert route["spec"]["wildcardPolicy"] == "None"
+    # Cluster annotations pass through as route customization
+    # (ref openshift.go:28-30).
+    assert route["metadata"]["annotations"][
+        "haproxy.router.openshift.io/timeout"] == "30s"
+
+
+def test_openshift_route_created_by_operator_knob():
+    """config.useOpenShiftRoute flips the ingress seam to emit a Route
+    (ref: the reference switches on detected cluster type)."""
+    from kuberay_tpu.api.config import OperatorConfiguration
+    from kuberay_tpu.operator import Operator
+
+    op = Operator(OperatorConfiguration(useOpenShiftRoute=True),
+                  fake_kubelet=True)
+    c = make_cluster(accelerator="v5e", topology="2x2")
+    c.spec.headGroupSpec.enableIngress = True
+    op.store.create(c.to_dict())
+    for _ in range(4):
+        op.manager.flush_delayed()
+        op.manager.run_until_idle()
+        op.kubelet.step()
+    assert op.store.try_get("Route", "demo-head-route") is not None
+    assert op.store.try_get("Ingress", "demo-head-ingress") is None
 
 
 def test_external_state_cleanup_finalizer_flow():

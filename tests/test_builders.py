@@ -168,3 +168,50 @@ def test_worker_pod_name_determinism_and_length():
     pod2 = build_worker_pod(c, c.spec.workerGroupSpecs[0], 3, 1)
     assert pod1["metadata"]["name"] == pod2["metadata"]["name"]
     assert len(pod1["metadata"]["name"]) <= 63
+
+
+def test_probe_injection():
+    """Ref initLivenessAndReadinessProbe (pod.go:539) +
+    getEnableProbesInjection (:406): head probes the coordinator API,
+    workers exec-check connectivity to the head, TpuService-owned
+    workers additionally gate readiness on the local serve /healthz
+    (which 503s on lockstep-group degradation)."""
+    import json
+    import os
+
+    from kuberay_tpu.builders.pod import build_head_pod, build_worker_pod
+
+    c = make_cluster("demo", accelerator="v5e", topology="2x2")
+    head = build_head_pod(c)["spec"]["containers"][0]
+    assert head["livenessProbe"]["httpGet"]["path"] == "/api/healthz"
+    assert head["readinessProbe"]["httpGet"]["port"] == C.PORT_DASHBOARD
+    w = build_worker_pod(c, c.spec.workerGroupSpecs[0], 0, 0)
+    wp = w["spec"]["containers"][0]
+    assert "TPU_COORDINATOR_ADDRESS" in \
+        " ".join(wp["readinessProbe"]["exec"]["command"])
+    assert "/healthz" not in json.dumps(wp["readinessProbe"]).replace(
+        "/api/healthz", "")
+    # Serve-owned cluster: readiness also requires the serve endpoint.
+    c.metadata.labels = {C.LABEL_ORIGINATED_FROM_CRD: C.KIND_SERVICE}
+    w2 = build_worker_pod(c, c.spec.workerGroupSpecs[0], 0, 0)
+    ready = " ".join(w2["spec"]["containers"][0]["readinessProbe"]
+                     ["exec"]["command"])
+    assert f"localhost:{C.PORT_SERVE}/healthz" in ready
+    # Followers (host > 0) run no HTTP frontend: probing PORT_SERVE
+    # there would pin them NotReady forever.
+    w3 = build_worker_pod(c, c.spec.workerGroupSpecs[0], 0, 1)
+    ready3 = " ".join(w3["spec"]["containers"][0]["readinessProbe"]
+                      ["exec"]["command"])
+    assert f"localhost:{C.PORT_SERVE}" not in ready3
+    # Liveness unchanged (a degraded group must be REPLACED by the
+    # controller, not restart-looped by the kubelet).
+    live = " ".join(w2["spec"]["containers"][0]["livenessProbe"]
+                    ["exec"]["command"])
+    assert f"localhost:{C.PORT_SERVE}" not in live
+    # Opt-out knob (ref ENABLE_PROBES_INJECTION).
+    os.environ["ENABLE_PROBES_INJECTION"] = "false"
+    try:
+        bare = build_head_pod(c)["spec"]["containers"][0]
+        assert "livenessProbe" not in bare
+    finally:
+        del os.environ["ENABLE_PROBES_INJECTION"]

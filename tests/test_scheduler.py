@@ -101,6 +101,34 @@ def test_yunikorn_task_groups():
     assert pod["spec"]["schedulerName"] == "yunikorn"
 
 
+def test_scheduler_plugins_adapter_shapes():
+    """Ref scheduler_plugins.go:48-88: scheduling.x-k8s.io/v1alpha1
+    PodGroup named after the cluster + pod-group label on every pod."""
+    from kuberay_tpu.scheduler.adapters import SchedulerPluginsAdapter
+
+    store = ObjectStore()
+    sp = SchedulerPluginsAdapter(store)
+    cd = cluster_dict()
+    cd["metadata"]["uid"] = "u1"
+    assert sp.on_cluster_submission(cd)
+    pg = store.get("PodGroup", "demo")
+    assert pg["apiVersion"] == "scheduling.x-k8s.io/v1alpha1"
+    # head + workers (ref CalculateDesiredReplicas + 1).
+    assert pg["spec"]["minMember"] >= 2
+    assert C.RESOURCE_TPU in pg["spec"]["minResources"]
+    assert pg["metadata"]["ownerReferences"][0]["uid"] == "u1"
+    pod = {"metadata": {"name": "p"}, "spec": {}}
+    sp.add_metadata(cd, pod)
+    assert pod["metadata"]["labels"]["scheduling.x-k8s.io/pod-group"] == \
+        "demo"
+    assert pod["spec"]["schedulerName"] == "scheduler-plugins-scheduler"
+    # Idempotent resubmission; cleanup removes the PodGroup.
+    assert sp.on_cluster_submission(cd)
+    sp.cleanup(cd)
+    assert store.try_get("PodGroup", "demo") is None
+    sp.cleanup(cd)     # second cleanup is a no-op
+
+
 def test_kai_rejects_k8s_job_mode():
     k = KaiAdapter(ObjectStore())
     assert not k.on_job_submission({"spec": {"submissionMode": "K8sJobMode"}})
