@@ -1,0 +1,69 @@
+"""Standalone API server process (ref apiserver/cmd/main.go role, REST
+instead of gRPC per the V2 decision): fronts either its own durable
+in-memory store (journal-backed etcd-lite) or a remote store URL, with
+optional bearer auth, TLS, and the history server mounted.
+
+    python -m kuberay_tpu.apiserver --port 8765 --journal /data/journal.bin
+    tpu-apiserver --store-url https://kube.example --token-file /etc/t
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="tpu-apiserver")
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=8765)
+    ap.add_argument("--journal", default="",
+                    help="durable store journal path ('' = memory only)")
+    ap.add_argument("--store-url", default="",
+                    help="front a remote REST store instead of an "
+                         "in-process one")
+    ap.add_argument("--token", default="",
+                    help="bearer token required on every API verb")
+    ap.add_argument("--token-file", default="")
+    ap.add_argument("--certfile", default="", help="TLS certificate")
+    ap.add_argument("--keyfile", default="")
+    ap.add_argument("--history-archive", default="",
+                    help="mount /api/history/* from this archive URL")
+    args = ap.parse_args(argv)
+
+    token = args.token
+    if args.token_file:
+        with open(args.token_file) as f:
+            token = f.read().strip()
+
+    if args.store_url:
+        from kuberay_tpu.controlplane.rest_store import RestObjectStore
+        store = RestObjectStore(args.store_url)
+    else:
+        from kuberay_tpu.controlplane.store import ObjectStore
+        store = ObjectStore(journal_path=args.journal)
+
+    history = None
+    if args.history_archive:
+        from kuberay_tpu.history.server import HistoryServer
+        from kuberay_tpu.history.storage import backend_from_url
+        history = HistoryServer(backend_from_url(args.history_archive))
+
+    from kuberay_tpu.apiserver.server import make_server
+    srv = make_server(store, host=args.host, port=args.port,
+                      token=token or None,
+                      certfile=args.certfile or None,
+                      keyfile=args.keyfile or None,
+                      history=history)
+    scheme = "https" if args.certfile else "http"
+    print(f"apiserver listening on {scheme}://{args.host}:{args.port}",
+          flush=True)
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

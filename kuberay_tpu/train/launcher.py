@@ -100,6 +100,37 @@ def build_mesh(tp: Optional[int] = None, sp: int = 1, ep: int = 1,
     return MeshSpec(dp=1, fsdp=-1, tp=tp, sp=sp, ep=ep).build()
 
 
+def _start_metrics_server(port: int):
+    """Prometheus /metrics endpoint for the training process (worker 0).
+    Returns (registry, server); never fatal — a busy port just logs."""
+    from kuberay_tpu.utils.httpjson import JsonHandler, serve_background
+    from kuberay_tpu.utils.metrics import MetricsRegistry
+    from http.server import ThreadingHTTPServer
+    reg = MetricsRegistry()
+    for name, help_text in (
+            ("tpu_train_step", "Last completed optimizer step"),
+            ("tpu_train_loss", "Training loss at the last log interval"),
+            ("tpu_train_tokens_per_sec", "Global training throughput"),
+            ("tpu_train_step_seconds", "Mean step wall time"),
+            ("tpu_train_mfu", "Model flops utilization vs chip peak")):
+        reg.describe(name, help_text)
+
+    class Handler(JsonHandler):
+        def do_GET(self):
+            if self.path == "/metrics":
+                return self._send_text(200, reg.render(),
+                                       "text/plain; version=0.0.4")
+            return self._send_text(404, "unknown path")
+
+    try:
+        srv = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+    except OSError as e:
+        print(f"train metrics server disabled: {e}", flush=True)
+        return reg, None
+    serve_background(srv, "train-metrics")
+    return reg, srv
+
+
 def train(args) -> int:
     from kuberay_tpu.utils.platform import pin_platform_from_env
     pin_platform_from_env()
@@ -154,6 +185,29 @@ def train(args) -> int:
             timeout=2.0)
     job_id = os.environ.get("TPU_JOB_ID", "train")
 
+    # Prometheus exposition on worker 0 (feeds the train Grafana
+    # dashboard, ref config/grafana/train_grafana_dashboard.json):
+    # TPU_TRAIN_METRICS_PORT=0 disables; default PORT_METRICS.
+    prom, prom_srv = None, None
+    mport = int(os.environ.get("TPU_TRAIN_METRICS_PORT", C.PORT_METRICS))
+    if ident.worker_id == 0 and ident.slice_id == 0 and mport > 0:
+        prom, prom_srv = _start_metrics_server(mport)
+    n_params = sum(
+        int(__import__("numpy").prod(x.shape))
+        for x in jax.tree.leaves(state["params"]))
+    peak_tflops = float(os.environ.get("TPU_PEAK_TFLOPS", "0"))
+    if not peak_tflops:
+        gen = os.environ.get(C.ENV_TPU_ACCELERATOR_TYPE, "")
+        if gen:
+            try:
+                # get_generation resolves aliases (v5litepod, trillium,
+                # ...) that GKE-injected env may carry.
+                from kuberay_tpu.topology import get_generation
+                peak_tflops = get_generation(
+                    gen.split("-")[0]).bf16_tflops_per_chip
+            except Exception:
+                peak_tflops = 0.0
+
     start_step = int(state["step"])
     t0 = time.time()
     for i in range(start_step, args.steps):
@@ -167,6 +221,19 @@ def train(args) -> int:
             tok_s = args.batch * args.seq_len * args.log_every / dt
             print(f"step {i + 1} loss {loss:.4f} tok/s {tok_s:.0f}",
                   flush=True)
+            if prom is not None:
+                prom.set_gauge("tpu_train_step", float(i + 1))
+                prom.set_gauge("tpu_train_loss", loss)
+                prom.set_gauge("tpu_train_tokens_per_sec", tok_s)
+                prom.set_gauge("tpu_train_step_seconds",
+                               dt / args.log_every)
+                if peak_tflops > 0:
+                    # MFU = achieved flops / peak: 6N flops per token
+                    # (fwd+bwd dense), per chip.
+                    achieved = 6.0 * n_params * tok_s / 1e12 / max(
+                        1, jax.device_count())
+                    prom.set_gauge("tpu_train_mfu",
+                                   achieved / peak_tflops)
             if event_client is not None:
                 try:
                     event_client.post_events([{

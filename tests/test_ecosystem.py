@@ -235,3 +235,56 @@ def test_cli_create_workergroup(op):
                       "--topology", "2x2")
     assert rc == 1
     run_cli(op, "delete", "cluster", "wg1")
+
+
+def test_grafana_dashboards_reference_real_metrics():
+    """The canned Grafana dashboards (ref config/grafana/*.json in the
+    reference) must only query metric names the code actually exposes —
+    a renamed metric must break this test, not the dashboard."""
+    import json
+    import pathlib
+    import re
+
+    import jax
+
+    from kuberay_tpu.models import llama
+    from kuberay_tpu.serve.paged_engine import PagedServeEngine
+    from kuberay_tpu.serve.server import ServeFrontend
+
+    root = pathlib.Path(__file__).resolve().parent.parent
+
+    # Exposed serve metric names: render /metrics off a live frontend
+    # (paged + speculative so pool/spec counters exist).
+    cfg = llama.CONFIGS["llama_tiny"]
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    eng = PagedServeEngine(cfg, params, max_slots=2, max_len=64,
+                           block_size=8, speculative=2)
+    fe = ServeFrontend(eng)
+    serve_names = {f"tpu_serve_{k}" for k, v in fe.stats().items()
+                   if isinstance(v, (int, float)) and not isinstance(v, bool)}
+    fe.close()
+
+    train_names = {"tpu_train_step", "tpu_train_loss",
+                   "tpu_train_tokens_per_sec", "tpu_train_step_seconds",
+                   "tpu_train_mfu"}   # set in train/launcher.py
+    operator_names_src = (root / "kuberay_tpu/utils/metrics.py").read_text()
+
+    for fname, allowed in (
+            ("serve_grafana_dashboard.json", serve_names),
+            ("train_grafana_dashboard.json", train_names)):
+        doc = json.loads((root / "config/grafana" / fname).read_text())
+        assert doc["panels"], fname
+        for p in doc["panels"]:
+            for t in p["targets"]:
+                for m in re.findall(r"tpu_[a-z_]+", t["expr"]):
+                    base = re.sub(r"_(bucket|sum|count)$", "", m)
+                    assert base in allowed, (fname, p["title"], m)
+
+    # Operator dashboard names must appear in the metrics module.
+    doc = json.loads(
+        (root / "config/grafana/operator-dashboard.json").read_text())
+    for p in doc["panels"]:
+        for t in p["targets"]:
+            for m in re.findall(r"tpu_[a-z_]+", t["expr"]):
+                base = re.sub(r"_(bucket|sum|count)$", "", m)
+                assert base in operator_names_src, (p["title"], m)

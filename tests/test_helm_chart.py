@@ -131,3 +131,119 @@ def test_openapi_spec_current_and_served():
         assert served["info"]["title"] == "kuberay-tpu apiserver"
     finally:
         srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Workload + apiserver charts (ref helm-chart/ray-cluster and
+# helm-chart/kuberay-apiserver; VERDICT r3 item 8)
+
+
+def test_tpu_cluster_chart_renders_admission_valid_cr():
+    """The rendered TpuCluster must pass the framework's OWN admission
+    validation — the chart and the API can never drift apart silently."""
+    from kuberay_tpu.api.tpucluster import TpuCluster
+    from kuberay_tpu.utils.validation import validate_cluster
+
+    docs = render_chart(str(REPO / "helm-chart/tpu-cluster"),
+                        release="demo")
+    (cr,) = docs
+    assert cr["kind"] == "TpuCluster"
+    assert validate_cluster(TpuCluster.from_dict(cr)) == []
+    g = cr["spec"]["workerGroupSpecs"][0]
+    assert g["topology"] == "2x4" and g["maxReplicas"] == 4
+
+
+def test_tpu_cluster_chart_toggles():
+    docs = render_chart(
+        str(REPO / "helm-chart/tpu-cluster"), release="asc",
+        sets=["enableInTreeAutoscaling=true",
+              "gangSchedulingQueue=research",
+              "head.enableIngress=true"])
+    (cr,) = docs
+    assert cr["spec"]["enableInTreeAutoscaling"] is True
+    assert cr["spec"]["gangSchedulingQueue"] == "research"
+    assert cr["spec"]["headGroupSpec"]["enableIngress"] is True
+    from kuberay_tpu.api.tpucluster import TpuCluster
+    from kuberay_tpu.utils.validation import validate_cluster
+    assert validate_cluster(TpuCluster.from_dict(cr)) == []
+
+
+def test_apiserver_chart_shapes():
+    chart = str(REPO / "helm-chart/kuberay-tpu-apiserver")
+    docs = render_chart(chart, release="api")
+    kinds = sorted(d["kind"] for d in docs)
+    assert kinds == ["Deployment", "Service", "ServiceAccount"]
+    dep = by_kind(docs, "Deployment")[0]
+    args = dep["spec"]["template"]["spec"]["containers"][0]["args"]
+    assert "--journal=/data/journal.bin" not in args   # off by default
+    # Persistence + auth wire volumes and args together.
+    docs = render_chart(chart, release="api",
+                        sets=["persistence.enabled=true",
+                              "authSecret=tok"])
+    assert sorted(d["kind"] for d in docs) == [
+        "Deployment", "PersistentVolumeClaim", "Service", "ServiceAccount"]
+    dep = by_kind(docs, "Deployment")[0]
+    ctr = dep["spec"]["template"]["spec"]["containers"][0]
+    assert "--journal=/data/journal.bin" in ctr["args"]
+    assert "--token-file=/etc/apiserver-auth/token" in ctr["args"]
+    mounts = {m["name"] for m in ctr["volumeMounts"]}
+    vols = {v["name"] for v in dep["spec"]["template"]["spec"]["volumes"]}
+    assert mounts == vols == {"data", "auth"}
+    svc = by_kind(docs, "Service")[0]
+    assert svc["spec"]["ports"][0]["port"] == 8765
+
+
+def test_standalone_apiserver_process_boots(tmp_path):
+    """python -m kuberay_tpu.apiserver: boots, serves CRUD, persists
+    through its journal across a restart."""
+    import json as _json
+    import time
+    import urllib.request
+
+    import socket
+    s = socket.socket(); s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]; s.close()
+    journal = str(tmp_path / "journal.bin")
+
+    def boot():
+        return subprocess.Popen(
+            [sys.executable, "-m", "kuberay_tpu.apiserver",
+             "--host", "127.0.0.1", "--port", str(port),
+             "--journal", journal],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+
+    def wait_healthy(proc, timeout=30):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=1)
+                return True
+            except OSError:
+                if proc.poll() is not None:
+                    raise AssertionError(proc.communicate()[0][-2000:])
+                time.sleep(0.1)
+        return False
+
+    p = boot()
+    try:
+        assert wait_healthy(p)
+        from tests.test_api_types import make_cluster
+        body = _json.dumps(make_cluster("persisted").to_dict()).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/apis/tpu.dev/v1/namespaces/default/"
+            "tpuclusters", data=body, method="POST",
+            headers={"Content-Type": "application/json"})
+        assert urllib.request.urlopen(req, timeout=5).status == 201
+    finally:
+        p.terminate(); p.wait(timeout=10)
+    # Restart: the journal replays the CR.
+    p = boot()
+    try:
+        assert wait_healthy(p)
+        got = _json.load(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/apis/tpu.dev/v1/namespaces/default/"
+            "tpuclusters/persisted", timeout=5))
+        assert got["metadata"]["name"] == "persisted"
+    finally:
+        p.terminate(); p.wait(timeout=10)
