@@ -59,7 +59,16 @@ def job_manifest(i: int) -> dict:
     }
 
 
+def vm_rss_mib() -> float:
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith("VmRSS:"):
+                return int(line.split()[1]) / 1024.0
+    return 0.0
+
+
 def run_cluster_scale(n: int, timeout: float) -> dict:
+    rss0 = vm_rss_mib()
     coord = FakeCoordinatorClient()
     op = Operator(OperatorConfiguration(reconcileConcurrency=4),
                   client_provider=lambda s: coord, fake_kubelet=True)
@@ -80,6 +89,7 @@ def run_cluster_scale(n: int, timeout: float) -> dict:
         time.sleep(0.2)
     elapsed = time.time() - t0
     pods = op.store.count("Pod")
+    rss = round(vm_rss_mib() - rss0, 1)
     op.stop()
     return {
         "metric": "tpucluster_scale_all_ready_seconds",
@@ -88,6 +98,11 @@ def run_cluster_scale(n: int, timeout: float) -> dict:
         "detail": {"clusters": n, "ready": ready, "pods": pods,
                    "create_phase_s": round(created, 2),
                    "clusters_per_s": round(n / elapsed, 1),
+                   # Memory is what kills operators at 5000-cluster scale
+                   # (ref memory_benchmark.md:66-80); track it alongside
+                   # latency on every run.
+                   "rss_mib": rss,
+                   "rss_kib_per_cluster": round(rss * 1024 / max(n, 1), 1),
                    "pass": ready >= n,
                    "reference": "BASELINE.md: 100-10000 RayClusters within "
                                 "30m clusterloader2 steps"},
