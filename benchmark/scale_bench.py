@@ -99,8 +99,8 @@ def run_cluster_scale(n: int, timeout: float) -> dict:
                    "create_phase_s": round(created, 2),
                    "clusters_per_s": round(n / elapsed, 1),
                    # Memory is what kills operators at 5000-cluster scale
-                   # (ref memory_benchmark.md:66-80); track it alongside
-                   # latency on every run.
+                   # (reference memory benchmark, see docs/memory_benchmark.md);
+                   # track it alongside latency on every run.
                    "rss_mib": rss,
                    "rss_kib_per_cluster": round(rss * 1024 / max(n, 1), 1),
                    "pass": ready >= n,
@@ -147,13 +147,6 @@ def run_job_scale(n: int, timeout: float) -> dict:
 
 def _memory_experiment(exp: str, timeout: float) -> dict:
     """One 150-pod shape, measured in THIS process via VmRSS delta."""
-    def vm_rss_mib():
-        with open("/proc/self/status") as f:
-            for line in f:
-                if line.startswith("VmRSS:"):
-                    return int(line.split()[1]) / 1024.0
-        return 0.0
-
     baseline = vm_rss_mib()
     coord = FakeCoordinatorClient()
     op = Operator(OperatorConfiguration(reconcileConcurrency=2),

@@ -288,3 +288,47 @@ def test_grafana_dashboards_reference_real_metrics():
             for m in re.findall(r"tpu_[a-z_]+", t["expr"]):
                 base = re.sub(r"_(bucket|sum|count)$", "", m)
                 assert base in operator_names_src, (p["title"], m)
+
+
+def test_docs_tree_consistent_with_cli_and_nav():
+    """Docs drift guards: mkdocs nav entries exist, cross-links resolve,
+    and the tpuctl reference documents every real subcommand."""
+    import pathlib
+    import re
+
+    import yaml
+
+    root = pathlib.Path(__file__).resolve().parent.parent
+    nav = yaml.safe_load((root / "mkdocs.yml").read_text())
+
+    def nav_files(node):
+        if isinstance(node, str):
+            yield node
+        elif isinstance(node, list):
+            for item in node:
+                yield from nav_files(item)
+        elif isinstance(node, dict):
+            for v in node.values():
+                yield from nav_files(v)
+
+    for f in nav_files(nav["nav"]):
+        assert (root / "docs" / f).exists(), f"nav entry missing: {f}"
+
+    for doc in (root / "docs").glob("*.md"):
+        for target in re.findall(r"\]\(([A-Za-z0-9_.\-]+\.md)(?:#[^)]*)?\)",
+                                 doc.read_text()):
+            assert (root / "docs" / target).exists(), (doc.name, target)
+
+    # Every CLI subcommand appears in the tpuctl reference.
+    import kuberay_tpu.cli.__main__ as cli_main
+    src = pathlib.Path(cli_main.__file__).read_text()
+    subcommands = set(re.findall(r'add_parser\(\s*"([a-z-]+)"', src))
+    # Dynamically registered verbs (for name in (...): add_parser(name)).
+    for tup in re.findall(r'for name in \(([^)]*)\):\s*\n\s*'
+                          r'sp = sub\.add_parser\(name\)', src):
+        subcommands |= set(re.findall(r'"([a-z-]+)"', tup))
+    assert {"suspend", "resume"} <= subcommands, subcommands
+    ref = (root / "docs/tpuctl.md").read_text()
+    for cmd in subcommands:
+        assert f"tpuctl {cmd}" in ref or f"`{cmd}`" in ref, \
+            f"tpuctl.md does not document {cmd!r}"
