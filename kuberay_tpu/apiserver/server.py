@@ -35,6 +35,7 @@ from urllib.parse import parse_qs, urlparse
 from kuberay_tpu.controlplane.store import (
     AlreadyExists,
     Conflict,
+    Event,
     Invalid,
     NotFound,
     ObjectStore,
@@ -258,6 +259,17 @@ class ApiHandler(JsonHandler):
         def emit(entry) -> bool:
             return self._write_chunk(json.dumps(entry).encode() + b"\n")
 
+        # For selector-scoped watches, an object LEAVING the selector
+        # must surface as DELETED (the kube watch contract — informers
+        # would otherwise hold a phantom entry forever).  Seed the
+        # in-scope key set with objects matching NOW, so a relabel of a
+        # pre-watch object still produces the synthetic event.
+        in_scope = set()
+        if labels:
+            for obj in self.store.list(kind, ns, labels=labels):
+                md = obj.get("metadata", {})
+                in_scope.add((md.get("namespace"), md.get("name")))
+
         import time as _time
         deadline = _time.time() + timeout
         alive = True
@@ -278,13 +290,24 @@ class ApiHandler(JsonHandler):
                 md = ev.obj.get("metadata", {})
                 if ns is not None and md.get("namespace") != ns:
                     continue
-                if labels and any(md.get("labels", {}).get(k) != v
-                                  for k, v in labels.items()):
-                    continue
+                etype = ev.type
+                if labels:
+                    key = (md.get("namespace"), md.get("name"))
+                    fits = all(md.get("labels", {}).get(k) == v
+                               for k, v in labels.items())
+                    if fits:
+                        in_scope.add(key)
+                        if etype == Event.DELETED:
+                            in_scope.discard(key)
+                    elif key in in_scope:
+                        in_scope.discard(key)
+                        etype = Event.DELETED     # left the selector
+                    else:
+                        continue
                 obj = dict(ev.obj)
                 obj.setdefault("kind", kind)
                 matched = True
-                if not emit({"type": ev.type, "object": obj}):
+                if not emit({"type": etype, "object": obj}):
                     alive = False
                     break
             rv = cur

@@ -105,6 +105,9 @@ def build_submitter_job(job: TpuJob, cluster: TpuCluster) -> Dict[str, Any]:
             "labels": {
                 C.LABEL_ORIGINATED_FROM_CR_NAME: job.metadata.name,
                 C.LABEL_ORIGINATED_FROM_CRD: C.KIND_JOB,
+                # Scoped informer contract (managercache/cache.go:18):
+                # the operator only watches Jobs it created.
+                C.LABEL_CREATED_BY: C.CREATED_BY_OPERATOR,
             },
             "ownerReferences": [owner_reference(
                 C.KIND_JOB, job.metadata.name, job.metadata.uid)],

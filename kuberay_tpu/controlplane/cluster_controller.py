@@ -178,7 +178,8 @@ class TpuClusterController:
                 "apiVersion": "batch/v1", "kind": "Job",
                 "metadata": {
                     "name": job_name, "namespace": ns,
-                    "labels": {C.LABEL_CLUSTER: name},
+                    "labels": {C.LABEL_CLUSTER: name,
+                               C.LABEL_CREATED_BY: C.CREATED_BY_OPERATOR},
                 },
                 "spec": {"template": {"spec": {"containers": [{
                     "name": "cleanup",

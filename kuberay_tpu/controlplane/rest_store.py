@@ -45,6 +45,20 @@ _CORE_PLURALS = C.CORE_PLURALS
 WATCHED_KINDS = ("TpuCluster", "TpuJob", "TpuService", "TpuCronJob",
                  "WarmSlicePool", "Pod", "Service", "Job")
 
+# Label scope per kind for the watch/relist streams (the reference's
+# scoped informer caches, internal/managercache/cache.go:18: only
+# operator-created Pods enter the cache — what bounds operator memory
+# on clusters whose OTHER workloads dwarf ours).  Jobs stay unscoped:
+# they are few (one submitter per TpuJob) and scoping them would blind
+# a restarted operator to Jobs created before the label existed.
+DEFAULT_WATCH_SCOPE = {
+    "Pod": {C.LABEL_CREATED_BY: C.CREATED_BY_OPERATOR},
+}
+
+
+def _selector_str(scope: Dict[str, str]) -> str:
+    return ",".join(f"{k}={v}" for k, v in scope.items())
+
 
 class RestObjectStore:
     def __init__(self, base_url: str, timeout: float = 10.0,
@@ -53,11 +67,15 @@ class RestObjectStore:
                  token: Optional[str] = None,
                  ca_cert: Optional[str] = None,
                  client_cert: Optional[tuple] = None,
-                 insecure_skip_verify: bool = False):
+                 insecure_skip_verify: bool = False,
+                 watch_scope: Optional[Dict[str, Dict[str, str]]] = None):
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
         self.poll_interval = poll_interval
         self.watched_kinds = tuple(watched_kinds)
+        # Per-kind labelSelector on watch/relist streams ({} disables).
+        self.watch_scope = (DEFAULT_WATCH_SCOPE if watch_scope is None
+                            else watch_scope)
         self.token = token
         self._ssl_ctx = None
         if self.base_url.startswith("https"):
@@ -434,7 +452,11 @@ class RestObjectStore:
                 backoff = min(backoff * 2, 30.0)
 
     def _relist_kind(self, kind: str, silent: bool = False) -> str:
-        out = self._list_all(self._path(kind, None))
+        query = {}
+        scope = self._scope(kind)
+        if scope:
+            query["labelSelector"] = _selector_str(scope)
+        out = self._list_all(self._path(kind, None), query or None)
         items = out.get("items", [])
         rv = (out.get("metadata") or {}).get("resourceVersion") \
             or str(out.get("resourceVersion", 0))
@@ -474,9 +496,13 @@ class RestObjectStore:
         stream expires (return None -> caller relists)."""
         import socket
         hold = 30
-        query = urllib.parse.urlencode({
+        params = {
             "watch": "true", "resourceVersion": rv,
-            "timeoutSeconds": str(hold), "allowWatchBookmarks": "true"})
+            "timeoutSeconds": str(hold), "allowWatchBookmarks": "true"}
+        scope = self._scope(kind)
+        if scope:
+            params["labelSelector"] = _selector_str(scope)
+        query = urllib.parse.urlencode(params)
         req = urllib.request.Request(
             self.base_url + self._path(kind, None) + "?" + query,
             headers=self._headers())
@@ -523,13 +549,17 @@ class RestObjectStore:
             return rv                            # idle socket: reconnect
         return rv                                # clean server timeout
 
+    def _scope(self, kind: str) -> Optional[Dict[str, str]]:
+        """Watch-stream label scope for a kind (None = unscoped)."""
+        return self.watch_scope.get(kind) or None
+
     def _prime(self):
         """Seed known-state without emitting events — pre-existing objects
         are intentionally silent, matching in-memory ObjectStore.watch
         (level-triggered consumers list on startup instead)."""
         for kind in self.watched_kinds:
             try:
-                for obj in self.list(kind):
+                for obj in self.list(kind, labels=self._scope(kind)):
                     md = obj["metadata"]
                     self._known[(kind, md["namespace"], md["name"])] = \
                         md.get("resourceVersion", 0)
@@ -542,7 +572,7 @@ class RestObjectStore:
         events: List[Event] = []
         for kind in self.watched_kinds:
             try:
-                items = self.list(kind)
+                items = self.list(kind, labels=self._scope(kind))
             except StoreError:
                 # A transient failure means UNKNOWN state — treating it as
                 # "everything of this kind vanished" would storm the
@@ -663,6 +693,17 @@ class RestObjectStore:
             md = obj.get("metadata", {})
             key = (kind, md.get("namespace", "default"), md.get("name", ""))
             ev = Event(entry.get("type", "MODIFIED"), kind, obj)
+            # Legacy /watch has no labelSelector: enforce the watch
+            # scope client-side.  An object LEAVING scope (label
+            # stripped) becomes a synthetic DELETED — the kube watch
+            # contract for selector-scoped streams — so the cache and
+            # controllers never hold a phantom entry.
+            scope = self._scope(kind)
+            if scope and any((md.get("labels") or {}).get(k) != v
+                             for k, v in scope.items()):
+                if key not in self._known:
+                    continue
+                ev = Event(Event.DELETED, kind, obj)
             if ev.type == Event.DELETED:
                 self._known.pop(key, None)
                 self._last.pop(key, None)

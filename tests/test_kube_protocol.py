@@ -34,9 +34,13 @@ def wait_for(fn, timeout=30.0):
 
 
 def mkpod(name, ns="default", labels=None):
+    # Pod watch streams are scoped to operator-created pods
+    # (managercache analogue) — stamp the label unless the test
+    # overrides it.
+    base = {C.LABEL_CREATED_BY: C.CREATED_BY_OPERATOR}
     return {"apiVersion": "v1", "kind": "Pod",
             "metadata": {"name": name, "namespace": ns,
-                         "labels": labels or {}},
+                         "labels": {**base, **(labels or {})}},
             "spec": {}, "status": {}}
 
 

@@ -147,7 +147,11 @@ def test_rest_store_uses_streaming_watch(remote):
                                        ev.obj["metadata"]["name"])))
     time.sleep(0.3)
     backing.create({"apiVersion": "v1", "kind": "Pod",
-                    "metadata": {"name": "fast", "namespace": "default"},
+                    "metadata": {"name": "fast", "namespace": "default",
+                                 # Pod watches are scoped to
+                                 # operator-created pods (managercache).
+                                 "labels": {C.LABEL_CREATED_BY:
+                                            C.CREATED_BY_OPERATOR}},
                     "spec": {}, "status": {}})
     deadline = time.time() + 3.0     # << poll_interval: must be streamed
     while time.time() < deadline:
@@ -156,3 +160,73 @@ def test_rest_store_uses_streaming_watch(remote):
         time.sleep(0.05)
     store.close()
     assert ("ADDED", "Pod", "fast") in got
+
+
+def test_watch_scope_bounds_pod_streams():
+    """Scoped informers (ref internal/managercache/cache.go:18): only
+    operator-created Pods enter the watch cache — a cluster full of
+    foreign workloads must not inflate the operator's memory.  Jobs are
+    deliberately unscoped (few, and pre-label Jobs must stay visible);
+    explicit list() calls stay unscoped."""
+    from kuberay_tpu.apiserver.server import serve_background
+    from kuberay_tpu.controlplane.store import ObjectStore
+    from kuberay_tpu.utils import constants as C
+
+    store = ObjectStore()
+    srv, url = serve_background(store)
+    try:
+        rs = RestObjectStore(url, watched_kinds=("Pod", "Job"),
+                             poll_interval=0.05)
+        seen = []
+        rs.watch(lambda ev: seen.append(
+            (ev.kind, ev.obj["metadata"]["name"])))
+        mine = {"kind": "Pod", "metadata": {
+            "name": "ours", "namespace": "default",
+            "labels": {C.LABEL_CREATED_BY: C.CREATED_BY_OPERATOR}},
+            "spec": {}}
+        foreign = {"kind": "Pod", "metadata": {
+            "name": "theirs", "namespace": "default",
+            "labels": {"app": "someone-else"}}, "spec": {}}
+        store.create(mine)
+        store.create(foreign)
+        store.create({"kind": "Job", "metadata": {
+            "name": "their-job", "namespace": "default"}, "spec": {}})
+        # (Jobs unscoped by design: their-job WILL be seen below.)
+        deadline = time.time() + 10
+        while time.time() < deadline and ("Pod", "ours") not in seen:
+            time.sleep(0.05)
+        time.sleep(0.5)          # window for any foreign event to leak
+        assert ("Pod", "ours") in seen, seen
+        assert ("Pod", "theirs") not in seen, seen
+        assert ("Job", "their-job") in seen, seen
+        # Direct list() is NOT scoped (controllers pass their own labels).
+        assert {p["metadata"]["name"] for p in rs.list("Pod")} == \
+            {"ours", "theirs"}
+        # Leaving the scope (label stripped) surfaces as DELETED — the
+        # kube contract for selector-scoped watches; the cache must not
+        # keep a phantom entry.
+        before = len(seen)
+        store.patch("Pod", "ours", "default",
+                    {"metadata": {"labels": {C.LABEL_CREATED_BY: None}}})
+        deadline = time.time() + 10
+        while time.time() < deadline and len(seen) == before:
+            time.sleep(0.05)
+        assert seen[before:] == [("Pod", "ours")]
+        assert ("Pod", "ours") not in [
+            (k[0], k[2]) for k in rs._known], "phantom cache entry"
+        # Opt-out restores full streams.
+        rs.close()
+        rs2 = RestObjectStore(url, watched_kinds=("Pod",),
+                              poll_interval=0.05, watch_scope={})
+        seen2 = []
+        rs2.watch(lambda ev: seen2.append(ev.obj["metadata"]["name"]))
+        store.create({"kind": "Pod", "metadata": {
+            "name": "theirs-2", "namespace": "default",
+            "labels": {"app": "x"}}, "spec": {}})
+        deadline = time.time() + 10
+        while time.time() < deadline and "theirs-2" not in seen2:
+            time.sleep(0.05)
+        assert "theirs-2" in seen2
+        rs2.close()
+    finally:
+        srv.shutdown()
