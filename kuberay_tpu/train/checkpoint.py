@@ -31,6 +31,43 @@ def save(directory: str, state: Dict[str, Any], step: int,
     mgr.close()
 
 
+class CheckpointWriter:
+    """Async checkpointing for the training loop.
+
+    ``save()`` (module-level) builds and tears down a CheckpointManager
+    per call AND blocks until bytes are on disk — fine for tests and
+    one-shot final saves, but inside a step loop it stalls the device
+    for the whole serialize+write.  This writer holds ONE manager and
+    uses Orbax's async path: ``save_async`` returns once device arrays
+    are snapshotted to host (so the next step may donate/overwrite
+    them), and the write itself overlaps subsequent compute — the
+    standard large-model TPU training overlap.  Orbax serializes
+    overlapping saves internally (a new save waits for the previous
+    commit), so callers just fire-and-forget per interval and call
+    ``close()`` (or ``wait()``) before exiting.
+    """
+
+    def __init__(self, directory: str, keep: int = 3):
+        self._mgr = _manager(directory, keep)
+
+    def save_async(self, state: Dict[str, Any], step: int) -> None:
+        import orbax.checkpoint as ocp
+        self._mgr.save(step, args=ocp.args.StandardSave(state))
+
+    def wait(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()
+
+    def __enter__(self) -> "CheckpointWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
 def latest_step(directory: str) -> Optional[int]:
     if not os.path.isdir(directory):
         return None

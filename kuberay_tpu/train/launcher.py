@@ -208,13 +208,48 @@ def train(args) -> int:
             except Exception:
                 peak_tflops = 0.0
 
+    # H2D/compute overlap: the NEXT batch is device_put while the
+    # CURRENT step runs on device (dispatch is async, device_put is
+    # non-blocking) — the input pipeline never serializes with the MXU.
+    batch_sharding = getattr(step_fn, "batch_sharding", None)
+
+    def put(raw):
+        b = {"tokens": raw["tokens"], "targets": raw["targets"]}
+        if batch_sharding is not None:
+            return jax.device_put(b, batch_sharding)
+        return {k: jnp.asarray(v) for k, v in b.items()}
+
+    # Async checkpointing: one manager for the whole run; save_async
+    # snapshots to host and writes in the background while training
+    # continues (module-level ckpt.save would stall the step loop).
+    writer = ckpt.CheckpointWriter(args.checkpoint_dir) \
+        if args.checkpoint_dir else None
+    try:
+        return _train_loop(args, ident, state, step_fn, loader, put,
+                           writer, prom, peak_tflops, n_params,
+                           event_client, job_id)
+    finally:
+        if writer is not None:
+            # Drain in-flight async writes on EVERY exit path — an
+            # exception mid-loop must not abandon a half-committed
+            # checkpoint (the crash case async checkpointing exists for).
+            writer.close()
+
+
+def _train_loop(args, ident, state, step_fn, loader, put, writer, prom,
+                peak_tflops, n_params, event_client, job_id) -> int:
+    import time
+    import jax
+    last_saved = -1
+
     start_step = int(state["step"])
     t0 = time.time()
+    next_batch = put(loader.next()) if start_step < args.steps else None
     for i in range(start_step, args.steps):
-        batch = loader.next()
-        state, metrics = step_fn(state, {
-            "tokens": jnp.asarray(batch["tokens"]),
-            "targets": jnp.asarray(batch["targets"])})
+        batch = next_batch
+        state, metrics = step_fn(state, batch)
+        if i + 1 < args.steps:
+            next_batch = put(loader.next())   # overlaps the device step
         if (i + 1) % args.log_every == 0 and ident.worker_id == 0:
             loss = float(metrics["loss"])
             dt = time.time() - t0
@@ -245,10 +280,15 @@ def train(args) -> int:
                 except Exception:
                     event_client = None    # coordinator gone: stop trying
             t0 = time.time()
-        if args.checkpoint_dir and (i + 1) % args.checkpoint_every == 0:
-            ckpt.save(args.checkpoint_dir, state, i + 1)
-    if args.checkpoint_dir:
-        ckpt.save(args.checkpoint_dir, state, args.steps)
+        if writer is not None and (i + 1) % args.checkpoint_every == 0:
+            writer.save_async(state, i + 1)
+            last_saved = i + 1
+    if writer is not None:
+        # Final save unless the last periodic save already covered it or
+        # the run resumed at-or-past the final step (saving then would
+        # label later-step state with an earlier step number).
+        if last_saved != args.steps and start_step < args.steps:
+            writer.save_async(state, args.steps)
     return 0
 
 

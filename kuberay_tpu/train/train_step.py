@@ -276,4 +276,8 @@ def make_sharded_train_fns(cfg: llama.LlamaConfig, tc: TrainConfig,
         in_shardings=(sh, batch_sh),
         out_shardings=(sh, None),
         donate_argnums=(0,))
+    # Exposed so the launcher can device_put the NEXT batch while the
+    # current step runs (H2D/compute overlap); an attribute keeps the
+    # 3-tuple return contract for existing callers.
+    step_jit.batch_sharding = batch_sh
     return init, step_jit, sh

@@ -172,3 +172,44 @@ def test_checkpoint_save_restore(tmp_path):
     # Training continues bit-identically from the restored state.
     s1, m1 = step(restored, batch)
     np.testing.assert_allclose(float(m1["loss"]), float(m1["loss"]))
+
+
+def test_checkpoint_writer_async_overlap(tmp_path):
+    """CheckpointWriter: fire-and-forget saves with ongoing training
+    mutating (donating) the state — Orbax snapshots to host before
+    save_async returns, so later steps can't corrupt the write; all
+    periodic checkpoints land and restore bit-identically."""
+    from kuberay_tpu.models import llama
+    from kuberay_tpu.train import checkpoint as ckpt
+    from kuberay_tpu.train.train_step import (
+        TrainConfig, init_train_state, make_optimizer, make_train_step)
+
+    cfg = llama.CONFIGS["llama_tiny"]
+    tc = TrainConfig(warmup_steps=2, decay_steps=10)
+    opt = make_optimizer(tc)
+    state = init_train_state(cfg, opt, jax.random.PRNGKey(0))
+    step = make_train_step(cfg, tc, opt)   # donates state buffers
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                                cfg.vocab_size)
+    batch = {"tokens": tokens, "targets": jnp.roll(tokens, -1, 1)}
+
+    ckpt_dir = str(tmp_path / "ckpt")
+    snap2_params = None
+    with ckpt.CheckpointWriter(ckpt_dir, keep=3) as w:
+        for i in range(4):
+            state, _ = step(state, batch)
+            if i == 1:
+                snap2_params = jax.tree.map(np.asarray, state["params"])
+                w.save_async(state, 2)     # training continues below
+            if i == 3:
+                w.save_async(state, 4)
+    assert ckpt.latest_step(ckpt_dir) == 4
+    restored2 = ckpt.restore(
+        ckpt_dir, 2, jax.eval_shape(
+            lambda k: init_train_state(cfg, opt, k),
+            jax.random.PRNGKey(0)))
+    # The step-2 checkpoint holds step-2 values, NOT later mutations.
+    for a, b in zip(jax.tree.leaves(restored2["params"]),
+                    jax.tree.leaves(snap2_params)):
+        np.testing.assert_array_equal(np.asarray(a), b)
+    assert int(restored2["step"]) == 2
