@@ -172,6 +172,12 @@ class ServeEngine:
         self._spec_miss = np.zeros(max_slots, dtype=np.int32)
         self._spec_cooldown = np.zeros(max_slots, dtype=np.int32)
         self._spec_index: List[Optional[NgramIndex]] = [None] * max_slots
+        # Streaming hook: called as token_callback(request_id, [tokens])
+        # the moment tokens are emitted (first prefill token, each decode
+        # token, accepted speculative runs) — the serve frontend uses it
+        # for chunked streaming responses.  Runs on the engine thread;
+        # must be cheap and never raise.
+        self.token_callback = None
         self.kv_quant = kv_quant
         # With a mesh the cache materializes sharded below (a flagship
         # cache does not fit one chip); without one, build it here.
@@ -464,7 +470,16 @@ class ServeEngine:
         self.budget[slot] = req.max_new_tokens - 1
         self._spec_miss[slot] = 0
         self._spec_index[slot] = None      # fresh history for the new slot
+        self._emit_tokens(req, [int(tok)])
         self._maybe_finish(slot)
+
+    def _emit_tokens(self, req: Request, tokens: List[int]) -> None:
+        cb = self.token_callback
+        if cb is not None and tokens:
+            try:
+                cb(req.request_id, tokens)
+            except Exception:
+                pass       # a streaming consumer must never stall decode
 
     def _decode_all(self):
         last = np.zeros(self.max_slots, dtype=np.int32)
@@ -490,6 +505,7 @@ class ServeEngine:
             self.lens[i] += 1
             self.generated[i].append(int(toks[i]))
             self.budget[i] -= 1
+            self._emit_tokens(req, [int(toks[i])])
             self._maybe_finish(i)
 
     # -- speculative decoding ------------------------------------------
@@ -572,6 +588,7 @@ class ServeEngine:
                     break
             self.lens[i] += len(take)
             self.generated[i].extend(take)
+            self._emit_tokens(req, take)
             self._maybe_finish(i)
 
     def _verify_device(self, toks, ntok, sub, temps, mask):

@@ -39,9 +39,15 @@ class ServeFrontend:
         self._lock = threading.Lock()
         self._waiters: Dict[str, threading.Event] = {}
         self._results: Dict[str, Response] = {}
+        # rid -> queue of token-list batches for streaming consumers.
+        # Completion is signaled via the rid's waiter Event (the stream
+        # generator then drains the queue and yields the final
+        # Response) — no in-queue sentinel.
+        self._streams: Dict[str, "queue.Queue"] = {}
         self._stop = threading.Event()
         self._stats = {"requests": 0, "completed": 0, "rejected": 0,
                        "tokens_out": 0, "failed_degraded": 0}
+        engine.token_callback = self._on_tokens
         if monitor is not None and hasattr(engine, "attach_monitor"):
             engine.attach_monitor(monitor)
             monitor.on_degraded = self._handle_degraded
@@ -119,23 +125,31 @@ class ServeFrontend:
                 if ev is not None:
                     ev.set()
 
+    def _admit(self, rid, ev, prompt_tokens, max_tokens, temperature,
+               eos_token, stream_queue=None) -> bool:
+        """Shared admission for blocking and streaming submits: one place
+        for the degraded/backlog rejection invariants and stats."""
+        with self._lock:
+            if self._degraded is not None or \
+                    len(self.engine.queue) >= self.max_queue:
+                self._stats["rejected"] += 1
+                return False
+            self._stats["requests"] += 1
+            self._waiters[rid] = ev
+            if stream_queue is not None:
+                self._streams[rid] = stream_queue
+            self.engine.add_request(Request(
+                rid, list(prompt_tokens), max_new_tokens=max_tokens,
+                temperature=temperature, eos_token=eos_token))
+            return True
+
     def submit(self, prompt_tokens, max_tokens=64, temperature=0.0,
                eos_token=None, timeout: float = 300.0) -> Optional[Response]:
         rid = uuid.uuid4().hex
         ev = threading.Event()
-        with self._lock:
-            if self._degraded is not None:
-                self._stats["rejected"] += 1
-                return None
-            backlog = len(self.engine.queue)
-            if backlog >= self.max_queue:
-                self._stats["rejected"] += 1
-                return None
-            self._stats["requests"] += 1
-            self._waiters[rid] = ev
-            self.engine.add_request(Request(
-                rid, list(prompt_tokens), max_new_tokens=max_tokens,
-                temperature=temperature, eos_token=eos_token))
+        if not self._admit(rid, ev, prompt_tokens, max_tokens,
+                           temperature, eos_token):
+            return None
         if not ev.wait(timeout):
             with self._lock:
                 self._waiters.pop(rid, None)
@@ -147,6 +161,63 @@ class ServeFrontend:
             # No parked result = woken by _handle_degraded, not by a
             # completion: the request died with the group.
             return self._results.pop(rid, None)
+
+    # -- streaming ---------------------------------------------------------
+
+    def _on_tokens(self, rid: str, tokens) -> None:
+        """Engine-thread hook: push freshly emitted tokens to a stream."""
+        with self._lock:
+            q = self._streams.get(rid)
+        if q is not None:
+            q.put(list(tokens))
+
+    def submit_stream(self, prompt_tokens, max_tokens=64, temperature=0.0,
+                      eos_token=None, timeout: float = 300.0):
+        """Generator of token batches as the engine emits them, ending
+        with a Response (or None on overload/degraded/timeout) — the
+        vLLM-style streaming surface.  Tokens arrive per engine step:
+        singles for plain decode, runs for accepted speculation."""
+        import queue as _queue
+        rid = uuid.uuid4().hex
+        ev = threading.Event()
+        q: "_queue.Queue" = _queue.Queue()
+        # NEVER yield under self._lock: a generator suspended at a yield
+        # holds the lock across arbitrary consumer work (a slow client's
+        # socket write), which would freeze the engine loop and every
+        # other request.
+        if not self._admit(rid, ev, prompt_tokens, max_tokens,
+                           temperature, eos_token, stream_queue=q):
+            yield None
+            return
+        deadline = time.monotonic() + timeout
+        try:
+            while True:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    yield None
+                    return
+                if ev.is_set():
+                    # Finished (or degraded): drain the queue, then the
+                    # final Response (popped under the lock, yielded
+                    # outside it).
+                    while True:
+                        try:
+                            yield q.get_nowait()
+                        except _queue.Empty:
+                            break
+                    with self._lock:
+                        final = self._results.pop(rid, None)
+                    yield final
+                    return
+                try:
+                    yield q.get(timeout=min(0.1, remaining))
+                except _queue.Empty:
+                    continue
+        finally:
+            with self._lock:
+                self._streams.pop(rid, None)
+                self._waiters.pop(rid, None)
+                self._results.pop(rid, None)
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:
@@ -259,6 +330,10 @@ class ServeFrontend:
                     return self._send(400, {"message": f"bad parameter: {e}"})
                 if max_tokens <= 0:
                     return self._send(400, {"message": "max_tokens must be > 0"})
+                if body.get("stream"):
+                    return self._stream_completion(
+                        prompt, max_tokens, temperature,
+                        body.get("eos_token"), timeout)
                 resp = frontend.submit(
                     prompt, max_tokens=max_tokens, temperature=temperature,
                     eos_token=body.get("eos_token"), timeout=timeout)
@@ -270,6 +345,52 @@ class ServeFrontend:
                     "finish_reason": resp.finish_reason,
                     "prompt_len": resp.prompt_len,
                 })
+
+            def _stream_completion(self, prompt, max_tokens, temperature,
+                                   eos_token, timeout):
+                """Chunked NDJSON streaming ("stream": true): one
+                {"tokens": [...]} line per engine emission (singles for
+                plain decode, runs for accepted speculation), then a
+                final line with finish_reason — or {"error": ...} if
+                the request died (overload/degraded/timeout)."""
+                import json as _json
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def emit(doc) -> bool:
+                    data = _json.dumps(doc).encode() + b"\n"
+                    try:
+                        self.wfile.write(
+                            f"{len(data):x}\r\n".encode() + data + b"\r\n")
+                        self.wfile.flush()
+                        return True
+                    except (BrokenPipeError, ConnectionError, OSError):
+                        return False
+
+                for item in frontend.submit_stream(
+                        prompt, max_tokens=max_tokens,
+                        temperature=temperature, eos_token=eos_token,
+                        timeout=timeout):
+                    if item is None:
+                        emit({"error": "overloaded, degraded, or timed "
+                                       "out"})
+                        break
+                    if isinstance(item, list):
+                        if not emit({"tokens": item}):
+                            return   # client gone; generator cleanup runs
+                    else:
+                        emit({"id": item.request_id,
+                              "finish_reason": item.finish_reason,
+                              "prompt_len": item.prompt_len,
+                              "num_tokens": len(item.tokens)})
+                        break
+                try:
+                    self.wfile.write(b"0\r\n\r\n")
+                    self.wfile.flush()
+                except (BrokenPipeError, ConnectionError, OSError):
+                    pass
 
         srv = ThreadingHTTPServer((host, port), Handler)
         # Non-daemon handler threads: socketserver only tracks (and

@@ -211,3 +211,140 @@ def test_server_sigterm_drains_then_exits():
         assert len(result.get("tokens", [])) == 12, (result, out[-1000:])
     finally:
         srv.kill()
+
+
+def test_streaming_matches_blocking_and_is_incremental():
+    """submit_stream yields exactly the tokens the blocking API returns,
+    and yields them BEFORE completion (true streaming, not a buffered
+    replay)."""
+    import jax
+
+    from kuberay_tpu.models import llama
+    from kuberay_tpu.serve.engine import ServeEngine
+    from kuberay_tpu.serve.server import ServeFrontend
+
+    cfg = llama.CONFIGS["llama_tiny"]
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    eng = ServeEngine(cfg, params, max_slots=2, max_len=64)
+    fe = ServeFrontend(eng)
+    try:
+        want = fe.submit([1, 2, 3, 4], max_tokens=10, timeout=60)
+        assert want is not None
+        batches, final = [], None
+        for item in fe.submit_stream([1, 2, 3, 4], max_tokens=10,
+                                     timeout=60):
+            if isinstance(item, list):
+                batches.append(item)
+            else:
+                final = item
+        streamed = [t for b in batches for t in b]
+        assert streamed == want.tokens
+        assert final is not None and final.tokens == want.tokens
+        assert final.finish_reason == want.finish_reason
+        # Incremental: more than one emission for a 10-token generation.
+        assert len(batches) >= 2, batches
+    finally:
+        fe.close()
+
+
+def test_streaming_speculative_runs_arrive_in_batches():
+    import jax
+
+    from kuberay_tpu.models import llama
+    from kuberay_tpu.serve.engine import ServeEngine
+    from kuberay_tpu.serve.server import ServeFrontend
+
+    cfg = llama.CONFIGS["llama_tiny"]
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    eng = ServeEngine(cfg, params, max_slots=2, max_len=128,
+                      speculative=4)
+    fe = ServeFrontend(eng)
+    try:
+        # Repetitive prompt: prompt-lookup drafts will hit.
+        prompt = [7, 8, 9] * 8
+        want = fe.submit(list(prompt), max_tokens=16, timeout=120)
+        batches = [b for b in fe.submit_stream(list(prompt),
+                                               max_tokens=16, timeout=120)
+                   if isinstance(b, list)]
+        assert [t for b in batches for t in b] == want.tokens
+        assert eng.spec_stats["accepted"] > 0
+        assert any(len(b) > 1 for b in batches), \
+            "accepted speculative runs should stream as multi-token batches"
+    finally:
+        fe.close()
+
+
+def test_streaming_http_ndjson():
+    """POST /v1/completions {"stream": true} answers chunked NDJSON:
+    token lines then a finish line; body matches the blocking call."""
+    import json as _json
+    import urllib.request
+
+    import jax
+
+    from kuberay_tpu.models import llama
+    from kuberay_tpu.serve.engine import ServeEngine
+    from kuberay_tpu.serve.server import ServeFrontend
+
+    cfg = llama.CONFIGS["llama_tiny"]
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    fe = ServeFrontend(ServeEngine(cfg, params, max_slots=2, max_len=64))
+    srv, url = fe.serve_background()
+    try:
+        blocking = _json.load(urllib.request.urlopen(urllib.request.Request(
+            f"{url}/v1/completions",
+            data=_json.dumps({"prompt_tokens": [5, 6, 7],
+                              "max_tokens": 8}).encode(),
+            headers={"Content-Type": "application/json"}), timeout=60))
+        req = urllib.request.Request(
+            f"{url}/v1/completions",
+            data=_json.dumps({"prompt_tokens": [5, 6, 7], "max_tokens": 8,
+                              "stream": True}).encode(),
+            headers={"Content-Type": "application/json"})
+        lines = []
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            assert resp.headers["Content-Type"] == "application/x-ndjson"
+            for line in resp:
+                lines.append(_json.loads(line))
+        toks = [t for ln in lines if "tokens" in ln for t in ln["tokens"]]
+        assert toks == blocking["tokens"]
+        assert lines[-1]["finish_reason"] == blocking["finish_reason"]
+        assert lines[-1]["num_tokens"] == len(blocking["tokens"])
+    finally:
+        srv.shutdown()
+        fe.close()
+
+
+def test_streaming_fails_fast_on_degraded():
+    import threading
+
+    import jax
+
+    from kuberay_tpu.models import llama
+    from kuberay_tpu.serve.engine import ServeEngine
+    from kuberay_tpu.serve.server import ServeFrontend
+
+    cfg = llama.CONFIGS["llama_tiny"]
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    fe = ServeFrontend(ServeEngine(cfg, params, max_slots=2, max_len=64))
+    try:
+        out = []
+
+        def consume():
+            for item in fe.submit_stream([1, 2, 3], max_tokens=500,
+                                         timeout=60):
+                out.append(item)
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        import time as _t
+        _t.sleep(0.3)
+        fe._handle_degraded("test: follower lost")
+        t.join(timeout=10)
+        assert not t.is_alive(), "stream must terminate on degradation"
+        assert out and out[-1] is None     # terminal failure marker
+        # New streams reject immediately.
+        assert list(fe.submit_stream([1], max_tokens=2,
+                                     timeout=5)) == [None]
+    finally:
+        fe.close()
