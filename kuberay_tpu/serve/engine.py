@@ -39,6 +39,8 @@ class Request:
     prompt_tokens: List[int]
     max_new_tokens: int = 64
     temperature: float = 0.0          # 0 = greedy
+    top_p: float = 1.0                # nucleus sampling (1 = off)
+    top_k: int = 0                    # top-k sampling (0 = off)
     eos_token: Optional[int] = None
 
 
@@ -243,11 +245,13 @@ class ServeEngine:
             pf_kw = dc_kw = {"out_shardings": (rep, cs)}
             vf_kw = {"out_shardings": (rep, rep, cs)}
         self._prefill = jax.jit(self._prefill_impl,
-                                static_argnames=("prompt_len",),
+                                static_argnames=("prompt_len", "filtered"),
                                 donate_argnames=("cache",), **pf_kw)
         self._decode = jax.jit(self._decode_impl,
+                               static_argnames=("filtered",),
                                donate_argnames=("cache",), **dc_kw)
         self._verify = jax.jit(self._verify_impl,
+                               static_argnames=("filtered",),
                                donate_argnames=("cache",), **vf_kw)
 
     def _init_cache(self):
@@ -264,7 +268,8 @@ class ServeEngine:
     # ------------------------------------------------------------------
 
     def _prefill_impl(self, params, cache, tokens, slot, real_len, key,
-                      temperature, prompt_len, start_pos=0):
+                      temperature, prompt_len, start_pos=0,
+                      filtered=False):
         """Prefill one chunk of one request into one slot.
         tokens: [prompt_len] padded; start_pos: tokens already in the
         slot's cache (0 for whole-prompt prefill; the chunk offset when
@@ -284,21 +289,23 @@ class ServeEngine:
             self.cfg, params, row, cache, start, write_mask,
             token_mask=token_mask)
         last = logits[slot, real_len - 1]                     # [V]
-        tok = self._sample(last, key, temperature)
+        sample = self._sample if filtered else self._sample_plain
+        tok = sample(last, key, temperature)
         return tok, new_cache
 
     def _decode_impl(self, params, cache, tokens, lens, key, temperatures,
-                     active_mask):
+                     active_mask, filtered=False):
         """One decode step for every active slot.  tokens: [slots]."""
         logits, new_cache = self._forward(
             self.cfg, params, tokens[:, None], cache, lens, active_mask,
             token_mask=active_mask[:, None])
         keys = jax.random.split(key, self.max_slots)
-        toks = jax.vmap(self._sample)(logits[:, 0], keys, temperatures)
+        sample = self._sample if filtered else self._sample_plain
+        toks = jax.vmap(sample)(logits[:, 0], keys, temperatures)
         return toks, new_cache
 
     def _verify_impl(self, params, cache, tokens, lens, ntok, key,
-                     temperatures, active_mask):
+                     temperatures, active_mask, filtered=False):
         """Speculative verify: run T = γ+1 tokens (last emitted + γ draft)
         for every active slot in ONE forward.  greedy[b, j] is the model's
         next token after consuming tokens[b, :j+1] — the host accepts the
@@ -322,10 +329,59 @@ class ServeEngine:
         return greedy, sampled0, new_cache
 
     @staticmethod
-    def _sample(logits, key, temperature):
+    def _samp(req: Request) -> np.ndarray:
+        """Pack a request's sampling params as the [temp, top_p, top_k]
+        row every device call carries (one operand, stable arity through
+        the multihost plan and all engine funnels)."""
+        return np.array([req.temperature, req.top_p, float(req.top_k)],
+                        np.float32)
+
+    @staticmethod
+    def _filters_on(samp) -> bool:
+        """Host-side: does this step need the filtered sampler?  Decides
+        which COMPILED variant runs (static arg), so pure-greedy/plain
+        traffic never pays the full-vocab sort.  Deterministic from the
+        samp arrays alone — multihost followers recompute it from the
+        broadcast plan and trace the same program."""
+        s = np.asarray(samp)
+        if s.ndim == 1:
+            return bool(s[1] < 1.0 or s[2] > 0)
+        return bool(np.any(s[:, 1] < 1.0) or np.any(s[:, 2] > 0))
+
+    @staticmethod
+    def _sample_plain(logits, key, samp):
+        """Greedy / plain-temperature sampling (no filters): argmax plus
+        one categorical — the decode hot path for default traffic."""
+        temperature = samp[0]
         greedy = jnp.argmax(logits, -1).astype(jnp.int32)
         scaled = logits / jnp.maximum(temperature, 1e-6)
         sampled = jax.random.categorical(key, scaled).astype(jnp.int32)
+        return jnp.where(temperature <= 0.0, greedy, sampled)
+
+    @staticmethod
+    def _sample(logits, key, samp):
+        """Greedy / temperature / top-p (nucleus) / top-k sampling.
+        ``samp`` = [temperature, top_p, top_k]; temperature<=0 is greedy
+        regardless of the filters; top_p=1 and top_k=0 disable theirs.
+        Filtering sorts the scaled logits once (full-vocab lax.top_k),
+        masks tokens outside the nucleus/top-k, and samples in sorted
+        space — all static shapes, vmap-able per slot."""
+        temperature, top_p, top_k = samp[0], samp[1], samp[2]
+        greedy = jnp.argmax(logits, -1).astype(jnp.int32)
+        V = logits.shape[-1]
+        scaled = logits / jnp.maximum(temperature, 1e-6)
+        sorted_l, sorted_idx = jax.lax.top_k(scaled, V)
+        probs = jax.nn.softmax(sorted_l, -1)
+        cum = jnp.cumsum(probs, -1)
+        # Nucleus: keep tokens whose cumulative mass BEFORE them is
+        # < top_p (the best token always survives).
+        keep = (cum - probs) < top_p
+        ranks = jnp.arange(V, dtype=jnp.float32)
+        keep &= jnp.where(top_k > 0, ranks < top_k, True)
+        keep = keep.at[0].set(True)
+        filt = jnp.where(keep, sorted_l, -jnp.inf)
+        choice = jax.random.categorical(key, filt)
+        sampled = sorted_idx[choice].astype(jnp.int32)
         return jnp.where(temperature <= 0.0, greedy, sampled)
 
     # ------------------------------------------------------------------
@@ -421,7 +477,7 @@ class ServeEngine:
 
     def _prefill_chunk_call(self, req, slot, off, padded, real_len, sub):
         return self._prefill_device(padded, slot, real_len, sub,
-                                    req.temperature, self.prefill_chunk,
+                                    self._samp(req), self.prefill_chunk,
                                     start_pos=off)
 
     def _prefill_device(self, padded, slot, real_len, sub, temperature,
@@ -432,8 +488,9 @@ class ServeEngine:
         tok, self.cache = self._prefill(
             self.params, self.cache, jnp.asarray(padded),
             jnp.int32(slot), jnp.int32(real_len), sub,
-            jnp.float32(temperature), prompt_len=bucket,
-            start_pos=jnp.int32(start_pos))
+            jnp.asarray(temperature, jnp.float32), prompt_len=bucket,
+            start_pos=jnp.int32(start_pos),
+            filtered=self._filters_on(temperature))
         return tok
 
     def _chunk_finalize(self, req, slot, tok) -> None:
@@ -458,7 +515,7 @@ class ServeEngine:
         padded[:plen] = req.prompt_tokens
         self.key, sub = jax.random.split(self.key)
         tok = self._prefill_device(padded, slot, plen, sub,
-                                   req.temperature, bucket)
+                                   self._samp(req), bucket)
         # Cache now contains bucket tokens for the slot; only plen are real.
         self._finalize_admit(req, slot, tok)
         return True
@@ -483,12 +540,15 @@ class ServeEngine:
 
     def _decode_all(self):
         last = np.zeros(self.max_slots, dtype=np.int32)
-        temps = np.zeros(self.max_slots, dtype=np.float32)
+        # Per-slot [temperature, top_p, top_k] rows; idle slots keep the
+        # no-op defaults (greedy, filters off).
+        temps = np.zeros((self.max_slots, 3), dtype=np.float32)
+        temps[:, 1] = 1.0
         mask = np.zeros(self.max_slots, dtype=np.float32)
         for i, req in enumerate(self.active):
             if req is not None and self.generated[i]:
                 last[i] = self.generated[i][-1]
-                temps[i] = req.temperature
+                temps[i] = self._samp(req)
                 mask[i] = 1.0
         if self.speculative > 0:
             drafts = self._build_drafts()
@@ -596,7 +656,8 @@ class ServeEngine:
         greedy, sampled0, self.cache = self._verify(
             self.params, self.cache, jnp.asarray(toks),
             jnp.asarray(self.lens), jnp.asarray(ntok), sub,
-            jnp.asarray(temps), jnp.asarray(mask))
+            jnp.asarray(temps), jnp.asarray(mask),
+            filtered=self._filters_on(temps))
         return greedy, sampled0
 
     def _decode_call(self, last, temps, mask, sub):
@@ -604,7 +665,7 @@ class ServeEngine:
         toks, self.cache = self._decode(
             self.params, self.cache, jnp.asarray(last),
             jnp.asarray(self.lens), sub, jnp.asarray(temps),
-            jnp.asarray(mask))
+            jnp.asarray(mask), filtered=self._filters_on(temps))
         return toks
 
     def _maybe_finish(self, slot: int):

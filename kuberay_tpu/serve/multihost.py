@@ -50,11 +50,12 @@ def _zero_plan(max_len: int, max_slots: int, gamma: int,
         "op": np.int32(0),
         # slot, real_len, bucket, start_pos
         "scalars": np.zeros(4, np.int32),
-        "temp": np.float32(0.0),
+        # [temperature, top_p, top_k] for prefill's target slot.
+        "temp": np.zeros(3, np.float32),
         "tokens": np.zeros(max_len, np.int32),
         "last": np.zeros(max_slots, np.int32),
         "lens": np.zeros(max_slots, np.int32),
-        "temps": np.zeros(max_slots, np.float32),
+        "temps": np.zeros((max_slots, 3), np.float32),
         "mask": np.zeros(max_slots, np.float32),
         "vtoks": np.zeros((max_slots, gamma + 1), np.int32),
         "ntok": np.zeros(max_slots, np.int32),
@@ -149,11 +150,11 @@ class MultihostServeEngine(ServeEngine):
                     op=np.int32(OP_PREFILL),
                     scalars=np.array([slot, real_len, bucket, start_pos],
                                      np.int32),
-                    temp=np.float32(temperature),
+                    temp=np.asarray(temperature, np.float32),
                     tokens=tokens,
                     key=np.asarray(sub, np.uint32))
         return self._watched(
-            ("prefill", bucket), send,
+            ("prefill", bucket, self._filters_on(temperature)), send,
             lambda: super(MultihostServeEngine, self)._prefill_device(
                 padded, slot, real_len, sub, temperature, bucket,
                 start_pos))
@@ -169,7 +170,7 @@ class MultihostServeEngine(ServeEngine):
                     mask=np.asarray(mask, np.float32),
                     key=np.asarray(sub, np.uint32))
         return self._watched(
-            ("decode",), send,
+            ("decode", self._filters_on(temps)), send,
             lambda: super(MultihostServeEngine, self)._decode_call(
                 last, temps, mask, sub))
 
@@ -185,7 +186,7 @@ class MultihostServeEngine(ServeEngine):
                     mask=np.asarray(mask, np.float32),
                     key=np.asarray(sub, np.uint32))
         return self._watched(
-            ("verify",), send,
+            ("verify", self._filters_on(temps)), send,
             lambda: super(MultihostServeEngine, self)._verify_device(
                 toks, ntok, sub, temps, mask))
 
@@ -215,7 +216,8 @@ def follower_loop(engine: ServeEngine) -> int:
                                                  for x in plan["scalars"])
             padded = np.asarray(plan["tokens"][:bucket])
             engine._prefill_device(padded, slot, real_len, key,
-                                   float(plan["temp"]), bucket, start_pos)
+                                   np.asarray(plan["temp"]), bucket,
+                                   start_pos)
         elif op == OP_DECODE:
             engine.lens[:] = np.asarray(plan["lens"])
             engine._decode_call(np.asarray(plan["last"]),

@@ -100,7 +100,8 @@ class PagedServeEngine(ServeEngine):
     # ------------------------------------------------------------------
 
     def _prefill_impl(self, params, cache, tokens, tables, slot, start,
-                      real_len, key, temperature, prompt_len):
+                      real_len, key, temperature, prompt_len,
+                      filtered=False):
         """Prefill ``real_len`` NEW tokens of one request at cache offset
         ``start`` (start > 0 when a prefix was served from cache)."""
         B = self.max_slots
@@ -113,20 +114,22 @@ class PagedServeEngine(ServeEngine):
             self.cfg, params, row, cache, tables, starts, write_mask,
             token_mask=token_mask)
         last = logits[slot, real_len - 1]
-        tok = self._sample(last, key, temperature)
+        sample = self._sample if filtered else self._sample_plain
+        tok = sample(last, key, temperature)
         return tok, new_cache
 
     def _decode_impl(self, params, cache, tokens, tables, lens, key,
-                     temperatures, active_mask):
+                     temperatures, active_mask, filtered=False):
         logits, new_cache = self._paged_fwd(
             self.cfg, params, tokens[:, None], cache, tables, lens,
             active_mask, token_mask=active_mask[:, None])
         keys = jax.random.split(key, self.max_slots)
-        toks = jax.vmap(self._sample)(logits[:, 0], keys, temperatures)
+        sample = self._sample if filtered else self._sample_plain
+        toks = jax.vmap(sample)(logits[:, 0], keys, temperatures)
         return toks, new_cache
 
     def _verify_impl(self, params, cache, tokens, tables, lens, ntok, key,
-                     temperatures, active_mask):
+                     temperatures, active_mask, filtered=False):
         """Speculative verify over the block-table path.  The per-row
         ``ntok`` write gate is what makes this safe: a position past a
         slot's allocated blocks would resolve through the zero-filled
@@ -141,14 +144,16 @@ class PagedServeEngine(ServeEngine):
             token_mask=token_mask)
         greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         keys = jax.random.split(key, self.max_slots)
-        sampled0 = jax.vmap(self._sample)(logits[:, 0], keys, temperatures)
+        sample = self._sample if filtered else self._sample_plain
+        sampled0 = jax.vmap(sample)(logits[:, 0], keys, temperatures)
         return greedy, sampled0, new_cache
 
     def _verify_device(self, toks, ntok, sub, temps, mask):
         greedy, sampled0, self.cache = self._verify(
             self.params, self.cache, jnp.asarray(toks),
             jnp.asarray(self.tables), jnp.asarray(self.lens),
-            jnp.asarray(ntok), sub, jnp.asarray(temps), jnp.asarray(mask))
+            jnp.asarray(ntok), sub, jnp.asarray(temps), jnp.asarray(mask),
+            filtered=self._filters_on(temps))
         return greedy, sampled0
 
     def _extra_draft_cap(self, slot: int) -> int:
@@ -276,7 +281,7 @@ class PagedServeEngine(ServeEngine):
         padded[:new_tokens] = req.prompt_tokens[ncached:]
         self.key, sub = jax.random.split(self.key)
         tok = self._prefill_device(padded, slot, new_tokens, sub,
-                                   req.temperature, bucket,
+                                   self._samp(req), bucket,
                                    start_pos=ncached)
         self._register_full_prompt(req, slot)
         self._finalize_admit(req, slot, tok)
@@ -298,7 +303,7 @@ class PagedServeEngine(ServeEngine):
 
     def _prefill_chunk_call(self, req, slot, off, padded, real_len, sub):
         return self._prefill_device(padded, slot, real_len, sub,
-                                    req.temperature, self.prefill_chunk,
+                                    self._samp(req), self.prefill_chunk,
                                     start_pos=off)
 
     def _prefill_device(self, padded, slot, real_len, sub, temperature,
@@ -312,7 +317,8 @@ class PagedServeEngine(ServeEngine):
             self.params, self.cache, jnp.asarray(padded),
             jnp.asarray(self.tables), jnp.int32(slot),
             jnp.int32(start_pos), jnp.int32(real_len), sub,
-            jnp.float32(temperature), prompt_len=bucket)
+            jnp.asarray(temperature, jnp.float32), prompt_len=bucket,
+            filtered=self._filters_on(temperature))
         return tok
 
     def _chunk_finalize(self, req, slot, tok) -> None:
@@ -323,7 +329,8 @@ class PagedServeEngine(ServeEngine):
         toks, self.cache = self._decode(
             self.params, self.cache, jnp.asarray(last),
             jnp.asarray(self.tables), jnp.asarray(self.lens), sub,
-            jnp.asarray(temps), jnp.asarray(mask))
+            jnp.asarray(temps), jnp.asarray(mask),
+            filtered=self._filters_on(temps))
         return toks
 
     def _decode_all(self):

@@ -17,6 +17,9 @@ health polling sees RUNNING (runtime/coordinator_server.py PUT
 
 from __future__ import annotations
 
+import itertools
+import json
+import queue
 import threading
 import time
 import uuid
@@ -126,7 +129,7 @@ class ServeFrontend:
                     ev.set()
 
     def _admit(self, rid, ev, prompt_tokens, max_tokens, temperature,
-               eos_token, stream_queue=None) -> bool:
+               eos_token, stream_queue=None, top_p=1.0, top_k=0) -> bool:
         """Shared admission for blocking and streaming submits: one place
         for the degraded/backlog rejection invariants and stats."""
         with self._lock:
@@ -140,15 +143,18 @@ class ServeFrontend:
                 self._streams[rid] = stream_queue
             self.engine.add_request(Request(
                 rid, list(prompt_tokens), max_new_tokens=max_tokens,
-                temperature=temperature, eos_token=eos_token))
+                temperature=temperature, top_p=top_p, top_k=top_k,
+                eos_token=eos_token))
             return True
 
     def submit(self, prompt_tokens, max_tokens=64, temperature=0.0,
-               eos_token=None, timeout: float = 300.0) -> Optional[Response]:
+               eos_token=None, timeout: float = 300.0, top_p: float = 1.0,
+               top_k: int = 0) -> Optional[Response]:
         rid = uuid.uuid4().hex
         ev = threading.Event()
         if not self._admit(rid, ev, prompt_tokens, max_tokens,
-                           temperature, eos_token):
+                           temperature, eos_token, top_p=top_p,
+                           top_k=top_k):
             return None
         if not ev.wait(timeout):
             with self._lock:
@@ -172,21 +178,22 @@ class ServeFrontend:
             q.put(list(tokens))
 
     def submit_stream(self, prompt_tokens, max_tokens=64, temperature=0.0,
-                      eos_token=None, timeout: float = 300.0):
+                      eos_token=None, timeout: float = 300.0,
+                      top_p: float = 1.0, top_k: int = 0):
         """Generator of token batches as the engine emits them, ending
         with a Response (or None on overload/degraded/timeout) — the
         vLLM-style streaming surface.  Tokens arrive per engine step:
         singles for plain decode, runs for accepted speculation."""
-        import queue as _queue
         rid = uuid.uuid4().hex
         ev = threading.Event()
-        q: "_queue.Queue" = _queue.Queue()
+        q: queue.Queue = queue.Queue()
         # NEVER yield under self._lock: a generator suspended at a yield
         # holds the lock across arbitrary consumer work (a slow client's
         # socket write), which would freeze the engine loop and every
         # other request.
         if not self._admit(rid, ev, prompt_tokens, max_tokens,
-                           temperature, eos_token, stream_queue=q):
+                           temperature, eos_token, stream_queue=q,
+                           top_p=top_p, top_k=top_k):
             yield None
             return
         deadline = time.monotonic() + timeout
@@ -203,7 +210,7 @@ class ServeFrontend:
                     while True:
                         try:
                             yield q.get_nowait()
-                        except _queue.Empty:
+                        except queue.Empty:
                             break
                     with self._lock:
                         final = self._results.pop(rid, None)
@@ -211,7 +218,7 @@ class ServeFrontend:
                     return
                 try:
                     yield q.get(timeout=min(0.1, remaining))
-                except _queue.Empty:
+                except queue.Empty:
                     continue
         finally:
             with self._lock:
@@ -322,6 +329,8 @@ class ServeFrontend:
                 try:
                     max_tokens = int(body.get("max_tokens", 64))
                     temperature = float(body.get("temperature", 0.0))
+                    top_p = float(body.get("top_p", 1.0))
+                    top_k = int(body.get("top_k", 0))
                     # Clamped: shutdown joins handler threads, so an
                     # unbounded client timeout would become an unbounded
                     # SIGTERM-to-exit time.
@@ -330,13 +339,18 @@ class ServeFrontend:
                     return self._send(400, {"message": f"bad parameter: {e}"})
                 if max_tokens <= 0:
                     return self._send(400, {"message": "max_tokens must be > 0"})
+                if not 0.0 < top_p <= 1.0:
+                    return self._send(400, {"message": "top_p must be in (0, 1]"})
+                if top_k < 0:
+                    return self._send(400, {"message": "top_k must be >= 0"})
                 if body.get("stream"):
                     return self._stream_completion(
                         prompt, max_tokens, temperature,
-                        body.get("eos_token"), timeout)
+                        body.get("eos_token"), timeout, top_p, top_k)
                 resp = frontend.submit(
                     prompt, max_tokens=max_tokens, temperature=temperature,
-                    eos_token=body.get("eos_token"), timeout=timeout)
+                    eos_token=body.get("eos_token"), timeout=timeout,
+                    top_p=top_p, top_k=top_k)
                 if resp is None:
                     return self._send(503, {"message": "overloaded or timed out"})
                 return self._send(200, {
@@ -347,13 +361,27 @@ class ServeFrontend:
                 })
 
             def _stream_completion(self, prompt, max_tokens, temperature,
-                                   eos_token, timeout):
+                                   eos_token, timeout, top_p=1.0, top_k=0):
                 """Chunked NDJSON streaming ("stream": true): one
                 {"tokens": [...]} line per engine emission (singles for
                 plain decode, runs for accepted speculation), then a
                 final line with finish_reason — or {"error": ...} if
-                the request died (overload/degraded/timeout)."""
-                import json as _json
+                the request died mid-stream.  Admission rejection is
+                decided BEFORE headers go out, so overloaded/degraded
+                streams return the same 503 the blocking path does."""
+                _json = json
+                gen = frontend.submit_stream(
+                    prompt, max_tokens=max_tokens,
+                    temperature=temperature, eos_token=eos_token,
+                    timeout=timeout, top_p=top_p, top_k=top_k)
+                try:
+                    first = next(gen)
+                except StopIteration:
+                    first = None
+                if first is None:
+                    gen.close()
+                    return self._send(503, {"message":
+                                            "overloaded or timed out"})
                 self.send_response(200)
                 self.send_header("Content-Type", "application/x-ndjson")
                 self.send_header("Transfer-Encoding", "chunked")
@@ -369,10 +397,7 @@ class ServeFrontend:
                     except (BrokenPipeError, ConnectionError, OSError):
                         return False
 
-                for item in frontend.submit_stream(
-                        prompt, max_tokens=max_tokens,
-                        temperature=temperature, eos_token=eos_token,
-                        timeout=timeout):
+                for item in itertools.chain([first], gen):
                     if item is None:
                         emit({"error": "overloaded, degraded, or timed "
                                        "out"})

@@ -187,3 +187,61 @@ def test_bucket():
     assert _bucket(5) == 32
     assert _bucket(33) == 64
     assert _bucket(9999) == 2048
+
+
+def test_top_k_and_top_p_sampling_semantics():
+    """top_k=1 is greedy at any temperature; a vanishing top_p nucleus
+    is greedy; disabled filters (top_p=1, top_k=0) reproduce plain
+    temperature sampling's support; filters restrict the support."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kuberay_tpu.serve.engine import ServeEngine
+
+    logits = jnp.asarray([2.0, 1.0, 0.5, -1.0, -3.0])
+    keys = [jax.random.PRNGKey(i) for i in range(200)]
+
+    def draws(temp, top_p=1.0, top_k=0, n=200):
+        samp = jnp.asarray([temp, top_p, float(top_k)], jnp.float32)
+        return {int(ServeEngine._sample(logits, k, samp)) for k in keys[:n]}
+
+    # Greedy regardless of filters.
+    assert draws(0.0) == {0}
+    # top_k=1 == greedy even when sampling.
+    assert draws(1.0, top_k=1) == {0}
+    # Tiny nucleus: only the best token's mass fits.
+    assert draws(1.0, top_p=1e-6) == {0}
+    # Unfiltered sampling at high temperature reaches beyond the top.
+    support = draws(5.0)
+    assert len(support) >= 4
+    # top_k=2 restricts support to the two best tokens.
+    assert draws(5.0, top_k=2) <= {0, 1}
+    # top_p nucleus: with these logits at temp=1, tokens 0+1 hold ~73%
+    # of the mass, so top_p=0.5 keeps {0, 1} at most.
+    assert draws(1.0, top_p=0.5) <= {0, 1}
+
+
+def test_sampled_requests_with_filters_through_engine():
+    """End-to-end: requests with top_p/top_k run through the engine
+    (prefill + decode + HTTP-shaped params) and the same seed + params
+    reproduce identical tokens."""
+    import jax
+
+    from kuberay_tpu.models import llama
+    from kuberay_tpu.serve.engine import Request, ServeEngine
+
+    cfg = llama.CONFIGS["llama_tiny"]
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+
+    def run():
+        eng = ServeEngine(cfg, params, max_slots=2, max_len=64)
+        eng.add_request(Request("r0", [1, 2, 3], max_new_tokens=8,
+                                temperature=0.9, top_p=0.8, top_k=12))
+        eng.add_request(Request("r1", [4, 5], max_new_tokens=8,
+                                temperature=0.0))
+        return {r.request_id: r.tokens for r in eng.run()}
+
+    a, b = run(), run()
+    assert a == b                       # deterministic under fixed seed
+    assert len(a["r0"]) == 8 and len(a["r1"]) == 8

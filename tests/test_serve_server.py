@@ -348,3 +348,38 @@ def test_streaming_fails_fast_on_degraded():
                                      timeout=5)) == [None]
     finally:
         fe.close()
+
+
+def test_streaming_http_rejection_is_503():
+    """A degraded/overloaded streamed request must answer 503 like the
+    blocking path — never 200-with-error-line (load balancers key on
+    the status)."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    import jax
+
+    from kuberay_tpu.models import llama
+    from kuberay_tpu.serve.engine import ServeEngine
+    from kuberay_tpu.serve.server import ServeFrontend
+
+    cfg = llama.CONFIGS["llama_tiny"]
+    fe = ServeFrontend(ServeEngine(cfg, llama.init_params(
+        cfg, jax.random.PRNGKey(0)), max_slots=2, max_len=64))
+    srv, url = fe.serve_background()
+    try:
+        fe._handle_degraded("test: follower lost")
+        req = urllib.request.Request(
+            f"{url}/v1/completions",
+            data=_json.dumps({"prompt_tokens": [1, 2], "max_tokens": 4,
+                              "stream": True}).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            urllib.request.urlopen(req, timeout=10)
+            raise AssertionError("expected 503")
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+    finally:
+        srv.shutdown()
+        fe.close()
