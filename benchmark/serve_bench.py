@@ -122,12 +122,16 @@ def run(args) -> None:
             import jax.numpy as _jnp
             import numpy as _np
             zeros = _np.zeros((args.slots, gamma + 1), _np.int32)
+            # Sampling params travel as per-slot [temp, top_p, top_k]
+            # rows (engine._samp); greedy warmup = zeros with top_p=1.
+            samp = _np.zeros((args.slots, 3), _np.float32)
+            samp[:, 1] = 1.0
             _, _, eng.cache = eng._verify(
                 eng.params, eng.cache, _jnp.asarray(zeros),
                 _jnp.asarray(eng.lens),
                 _jnp.zeros(args.slots, _jnp.int32),     # ntok
                 _jax.random.PRNGKey(0),
-                _jnp.zeros(args.slots, _jnp.float32),
+                _jnp.asarray(samp),
                 _jnp.zeros(args.slots, _jnp.float32))   # all rows masked
         for i in range(args.requests):
             pat = [10 + i, 11 + i, 12 + i]
