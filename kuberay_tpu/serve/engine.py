@@ -145,7 +145,9 @@ class ServeEngine:
                  max_slots: int = 8, max_len: int = 2048,
                  rng_seed: int = 0, prefill_chunk: int = 0,
                  speculative: int = 0, kv_quant: str = "none",
-                 decode_impl: str = "auto", mesh=None):
+                 decode_impl: str = "auto", mesh=None,
+                 weight_quant: str = "none",
+                 donate_params: bool = False):
         self.cfg = cfg
         self.params = params
         # Tensor-parallel serving: a jax.sharding.Mesh with a "tp" axis.
@@ -224,6 +226,28 @@ class ServeEngine:
             # cache must come into existence sharded, never whole.
             self.cache = jax.jit(self._init_cache,
                                  out_shardings=self._cache_sh)()
+        # W8A16 serving: matmul weights live as int8 + per-channel
+        # scales (half the HBM, half the decode weight bandwidth); the
+        # dequant runs inside the jitted forwards where XLA fuses it
+        # into each matmul's operand read.  Applied AFTER the mesh
+        # device_put so sharded trees quantize shard-local.
+        self.weight_quant = weight_quant
+        if weight_quant == "int8":
+            from kuberay_tpu.serve.weight_quant import (
+                make_weight_dequant_forward,
+                quantize_weights,
+            )
+            # donate_params frees the bf16 tree as it quantizes — the
+            # startup-peak fix for models that only fit BECAUSE of int8
+            # (without it the device briefly holds bf16 + int8 + cache).
+            # Off by default: donation invalidates the caller's tree.
+            self.params = jax.jit(
+                quantize_weights,
+                donate_argnums=(0,) if donate_params else ())(self.params)
+            if self.USES_BASE_FORWARD:
+                self._forward = make_weight_dequant_forward(self._forward)
+        elif weight_quant != "none":
+            raise ValueError(f"unknown weight_quant {weight_quant!r}")
         self.key = jax.random.PRNGKey(rng_seed)
 
         # Slot bookkeeping (host side).

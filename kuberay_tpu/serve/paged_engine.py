@@ -47,7 +47,9 @@ class PagedServeEngine(ServeEngine):
                  num_blocks: int = 0, block_size: int = 16,
                  rng_seed: int = 0, decode_impl: str = "auto",
                  prefill_chunk: int = 0, speculative: int = 0,
-                 kv_quant: str = "none", mesh=None):
+                 kv_quant: str = "none", mesh=None,
+                 weight_quant: str = "none",
+                 donate_params: bool = False):
         # Default pool = the dense engine's footprint; callers shrink it
         # to realize the memory win (e.g. slots * expected_len).
         num_blocks = num_blocks or (max_slots * max_len) // block_size
@@ -81,7 +83,15 @@ class PagedServeEngine(ServeEngine):
         super().__init__(cfg, params, max_slots=max_slots, max_len=max_len,
                          rng_seed=rng_seed, prefill_chunk=prefill_chunk,
                          speculative=speculative, kv_quant=kv_quant,
-                         mesh=mesh)
+                         mesh=mesh, weight_quant=weight_quant,
+                         donate_params=donate_params)
+        if weight_quant == "int8":
+            # Paged kernels route through _paged_fwd (USES_BASE_FORWARD
+            # False skipped the base wrap): dequantize outermost here.
+            from kuberay_tpu.serve.weight_quant import (
+                make_weight_dequant_forward,
+            )
+            self._paged_fwd = make_weight_dequant_forward(self._paged_fwd)
         self.allocator = BlockAllocator(num_blocks, block_size)
         self.tables = np.zeros((max_slots, self.max_blocks), dtype=np.int32)
         self.owned: List[List[int]] = [[] for _ in range(max_slots)]

@@ -475,6 +475,11 @@ def main(argv=None):  # pragma: no cover - process wrapper
                          "(dense engine, greedy slots; 0 = off)")
     ap.add_argument("--kv-quant", default="none", choices=["none", "int8"],
                     help="KV cache storage dtype (dense engine)")
+    ap.add_argument("--weight-quant", default="none",
+                    choices=["none", "int8"],
+                    help="W8A16: int8 matmul weights with per-channel "
+                         "scales (half the weight HBM + decode "
+                         "bandwidth); composes with every engine mode")
     ap.add_argument("--tp", type=int, default=1,
                     help="tensor parallelism over the slice's chips "
                          "(0 = all global devices; composes with all "
@@ -520,13 +525,17 @@ def main(argv=None):  # pragma: no cover - process wrapper
                          decode_impl=args.decode_impl,
                          prefill_chunk=args.prefill_chunk,
                          speculative=args.speculative,
-                         kv_quant=args.kv_quant, mesh=mesh)
+                         kv_quant=args.kv_quant, mesh=mesh,
+                         weight_quant=args.weight_quant,
+                         donate_params=args.weight_quant != "none")
     else:
         engine_kw = dict(max_slots=args.max_slots, max_len=args.max_len,
                          prefill_chunk=args.prefill_chunk,
                          speculative=args.speculative,
                          kv_quant=args.kv_quant,
-                         decode_impl=args.decode_impl, mesh=mesh)
+                         decode_impl=args.decode_impl, mesh=mesh,
+                         weight_quant=args.weight_quant,
+                         donate_params=args.weight_quant != "none")
     # ONE class-pair selection for both roles: hosts and followers must
     # construct matching engines or plan pytree shapes diverge (a
     # cross-host hang, not an error).

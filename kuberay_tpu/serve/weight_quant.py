@@ -1,0 +1,123 @@
+"""int8 weight quantization for serving (W8A16).
+
+Weights dominate serving HBM and decode is bandwidth-bound: storing the
+matmul weights as int8 with per-output-channel scales halves both the
+resident footprint (a bigger model fits the chip) and the bytes each
+decode step streams from HBM.  Activations stay bf16 — the dequantize
+(convert + broadcast-multiply) feeds straight into each dot and XLA
+fuses it into the matmul's operand read, so no bf16 weight copy is ever
+materialized.
+
+Scope: the layer matmul weights (attention projections, FFN, lm_head) —
+the bulk of parameters.  Embeddings stay bf16 (they are read by gather,
+not matmul: a fused dequant there buys little, and quantizing the
+gather source would materialize a full dequantized table), as do the
+tiny norm vectors.
+
+Composes with everything: the wrapper has the forward signature
+``(cfg, params, ...)`` shared by the dense forward, the kv-quant
+wrapper, and the paged forwards, so it simply runs outermost and hands
+a dequantized tree down the existing chain.  Under a tp mesh the
+per-output-channel scale reduction follows the weight's sharding (one
+collective at quantize time when the reduction axis is sharded).
+
+Ref parity: vLLM's quantization support (the serving runtime role,
+SURVEY.md §2.3); no reference counterpart in the operator itself.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+# Leaves quantized: matmul weights by name (everything else passes
+# through untouched — norms, embed, biases).
+# Covers llama (wq/wk/wv/wo + FFN + lm_head) and Mixtral's expert FFN
+# (same w_gate/w_up/w_down names, layer+expert stacked).  The Mixtral
+# ROUTER stays bf16 deliberately: it is tiny, and routing decisions are
+# the most quantization-sensitive computation in an MoE.
+_QUANT_LEAVES = frozenset({
+    "wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "lm_head",
+})
+
+
+def _quantize_leaf(w: jax.Array) -> Dict[str, jax.Array]:
+    """Per-output-channel symmetric int8, scaled over the CONTRACTION
+    axis only (w.ndim-2 in the ``x @ w`` layouts used throughout):
+    layer/expert stack axes keep their own scales — one loud layer must
+    not crush another layer's resolution."""
+    axes = (w.ndim - 2,)
+    amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=axes,
+                   keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale),
+                 -127, 127).astype(jnp.int8)
+    return {"q8": q, "s8": scale.astype(jnp.float32)}
+
+
+def _is_quant_leaf(obj: Any) -> bool:
+    return isinstance(obj, dict) and set(obj) == {"q8", "s8"}
+
+
+def quantize_weights(params: Dict[str, Any]) -> Dict[str, Any]:
+    """Returns the params tree with matmul weights replaced by
+    {"q8": int8, "s8": f32 per-channel} pairs.  Jit-compatible; run it
+    once at engine construction (sharded inputs stay sharded)."""
+    def walk(node):
+        if not isinstance(node, dict):
+            return node
+        out = {}
+        for k, v in node.items():
+            if k in _QUANT_LEAVES and not isinstance(v, dict):
+                out[k] = _quantize_leaf(v)
+            else:
+                out[k] = walk(v)
+        return out
+    return walk(params)
+
+
+def dequantize_weights(params: Dict[str, Any],
+                       dtype=jnp.bfloat16) -> Dict[str, Any]:
+    """Inverse transform, applied INSIDE the jitted forward: the
+    convert*scale chain fuses into each consuming matmul."""
+    def walk(node):
+        if _is_quant_leaf(node):
+            return (node["q8"].astype(dtype)
+                    * node["s8"].astype(dtype))
+        if isinstance(node, dict):
+            return {k: walk(v) for k, v in node.items()}
+        return node
+    return walk(params)
+
+
+def make_weight_dequant_forward(base_forward):
+    """Forward adapter: dequantize the weight tree, delegate down the
+    existing chain (kv-quant wrapper, paged forward, base forward all
+    share the ``(cfg, params, ...)`` head)."""
+    def fwd(cfg, params, *args, **kwargs):
+        return base_forward(cfg, dequantize_weights(params), *args,
+                            **kwargs)
+    return fwd
+
+
+def quantization_error(params: Dict[str, Any]) -> float:
+    """Max relative round-trip error over quantized leaves (diagnostic
+    + tests): per-channel int8 should sit near 1/254 of the channel
+    amplitude."""
+    q = quantize_weights(params)
+    d = dequantize_weights(q, dtype=jnp.float32)
+    worst = 0.0
+    flat_o, _ = jax.tree_util.tree_flatten_with_path(params)
+    flat_d = dict(jax.tree_util.tree_flatten_with_path(d)[0])
+    for path, orig in flat_o:
+        deq = flat_d.get(path)
+        if deq is None or orig.shape != getattr(deq, "shape", None):
+            continue
+        amax = float(jnp.max(jnp.abs(orig.astype(jnp.float32))))
+        if amax == 0:
+            continue
+        err = float(jnp.max(jnp.abs(orig.astype(jnp.float32) - deq)))
+        worst = max(worst, err / amax)
+    return worst
