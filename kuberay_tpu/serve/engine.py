@@ -42,6 +42,8 @@ class Request:
     top_p: float = 1.0                # nucleus sampling (1 = off)
     top_k: int = 0                    # top-k sampling (0 = off)
     eos_token: Optional[int] = None
+    # Additional stop tokens (any match ends generation, reason "eos").
+    stop_token_ids: Optional[List[int]] = None
 
 
 @dataclasses.dataclass
@@ -666,8 +668,7 @@ class ServeEngine:
             for t in emitted:
                 take.append(t)
                 self.budget[i] -= 1
-                if self.budget[i] <= 0 or \
-                        (req.eos_token is not None and t == req.eos_token) \
+                if self.budget[i] <= 0 or self._is_stop(req, t) \
                         or self.lens[i] + len(take) + 1 >= self.max_len:
                     break
             self.lens[i] += len(take)
@@ -692,13 +693,19 @@ class ServeEngine:
             jnp.asarray(mask), filtered=self._filters_on(temps))
         return toks
 
+    @staticmethod
+    def _is_stop(req: Request, tok: int) -> bool:
+        if req.eos_token is not None and tok == req.eos_token:
+            return True
+        return bool(req.stop_token_ids) and tok in req.stop_token_ids
+
     def _maybe_finish(self, slot: int):
         req = self.active[slot]
         if req is None:
             return
         gen = self.generated[slot]
         reason = None
-        if req.eos_token is not None and gen and gen[-1] == req.eos_token:
+        if gen and self._is_stop(req, gen[-1]):
             reason = "eos"
         elif self.budget[slot] <= 0:
             reason = "length"

@@ -129,7 +129,8 @@ class ServeFrontend:
                     ev.set()
 
     def _admit(self, rid, ev, prompt_tokens, max_tokens, temperature,
-               eos_token, stream_queue=None, top_p=1.0, top_k=0) -> bool:
+               eos_token, stream_queue=None, top_p=1.0, top_k=0,
+               stop_token_ids=None) -> bool:
         """Shared admission for blocking and streaming submits: one place
         for the degraded/backlog rejection invariants and stats."""
         with self._lock:
@@ -144,17 +145,17 @@ class ServeFrontend:
             self.engine.add_request(Request(
                 rid, list(prompt_tokens), max_new_tokens=max_tokens,
                 temperature=temperature, top_p=top_p, top_k=top_k,
-                eos_token=eos_token))
+                eos_token=eos_token, stop_token_ids=stop_token_ids))
             return True
 
     def submit(self, prompt_tokens, max_tokens=64, temperature=0.0,
                eos_token=None, timeout: float = 300.0, top_p: float = 1.0,
-               top_k: int = 0) -> Optional[Response]:
+               top_k: int = 0, stop_token_ids=None) -> Optional[Response]:
         rid = uuid.uuid4().hex
         ev = threading.Event()
         if not self._admit(rid, ev, prompt_tokens, max_tokens,
                            temperature, eos_token, top_p=top_p,
-                           top_k=top_k):
+                           top_k=top_k, stop_token_ids=stop_token_ids):
             return None
         if not ev.wait(timeout):
             with self._lock:
@@ -179,7 +180,8 @@ class ServeFrontend:
 
     def submit_stream(self, prompt_tokens, max_tokens=64, temperature=0.0,
                       eos_token=None, timeout: float = 300.0,
-                      top_p: float = 1.0, top_k: int = 0):
+                      top_p: float = 1.0, top_k: int = 0,
+                      stop_token_ids=None):
         """Generator of token batches as the engine emits them, ending
         with a Response (or None on overload/degraded/timeout) — the
         vLLM-style streaming surface.  Tokens arrive per engine step:
@@ -193,7 +195,8 @@ class ServeFrontend:
         # other request.
         if not self._admit(rid, ev, prompt_tokens, max_tokens,
                            temperature, eos_token, stream_queue=q,
-                           top_p=top_p, top_k=top_k):
+                           top_p=top_p, top_k=top_k,
+                           stop_token_ids=stop_token_ids):
             yield None
             return
         deadline = time.monotonic() + timeout
@@ -331,6 +334,13 @@ class ServeFrontend:
                     temperature = float(body.get("temperature", 0.0))
                     top_p = float(body.get("top_p", 1.0))
                     top_k = int(body.get("top_k", 0))
+                    stop_ids = body.get("stop_token_ids")
+                    if stop_ids is not None and (
+                            not isinstance(stop_ids, list) or
+                            not all(isinstance(t, int) for t in stop_ids)):
+                        return self._send(400, {
+                            "message": "stop_token_ids must be a list "
+                                       "of token ids"})
                     # Clamped: shutdown joins handler threads, so an
                     # unbounded client timeout would become an unbounded
                     # SIGTERM-to-exit time.
@@ -346,11 +356,12 @@ class ServeFrontend:
                 if body.get("stream"):
                     return self._stream_completion(
                         prompt, max_tokens, temperature,
-                        body.get("eos_token"), timeout, top_p, top_k)
+                        body.get("eos_token"), timeout, top_p, top_k,
+                        stop_ids)
                 resp = frontend.submit(
                     prompt, max_tokens=max_tokens, temperature=temperature,
                     eos_token=body.get("eos_token"), timeout=timeout,
-                    top_p=top_p, top_k=top_k)
+                    top_p=top_p, top_k=top_k, stop_token_ids=stop_ids)
                 if resp is None:
                     return self._send(503, {"message": "overloaded or timed out"})
                 return self._send(200, {
@@ -361,7 +372,8 @@ class ServeFrontend:
                 })
 
             def _stream_completion(self, prompt, max_tokens, temperature,
-                                   eos_token, timeout, top_p=1.0, top_k=0):
+                                   eos_token, timeout, top_p=1.0, top_k=0,
+                                   stop_token_ids=None):
                 """Chunked NDJSON streaming ("stream": true): one
                 {"tokens": [...]} line per engine emission (singles for
                 plain decode, runs for accepted speculation), then a
@@ -373,7 +385,8 @@ class ServeFrontend:
                 gen = frontend.submit_stream(
                     prompt, max_tokens=max_tokens,
                     temperature=temperature, eos_token=eos_token,
-                    timeout=timeout, top_p=top_p, top_k=top_k)
+                    timeout=timeout, top_p=top_p, top_k=top_k,
+                    stop_token_ids=stop_token_ids)
                 try:
                     first = next(gen)
                 except StopIteration:
@@ -459,6 +472,12 @@ def main(argv=None):  # pragma: no cover - process wrapper
     ap.add_argument("--max-len", type=int, default=2048)
     ap.add_argument("--app-name", default="llm")
     ap.add_argument("--coordinator", default="")
+    ap.add_argument("--checkpoint-dir", default="",
+                    help="serve params restored from this TRAIN "
+                         "checkpoint directory (instead of seed-0 "
+                         "init); sharded onto the serve mesh under --tp")
+    ap.add_argument("--checkpoint-step", type=int, default=0,
+                    help="checkpoint step to serve (0 = latest)")
     ap.add_argument("--paged", action="store_true",
                     help="paged KV cache with prefix caching")
     ap.add_argument("--block-size", type=int, default=16)
@@ -509,10 +528,28 @@ def main(argv=None):  # pragma: no cover - process wrapper
 
     cfg = llama.CONFIGS[args.model]
     mesh = None
+    param_sh = None
     if tp > 1:
         from kuberay_tpu.serve.sharding import (
-            init_sharded_params, serve_mesh)
+            init_sharded_params, param_shardings, serve_mesh)
         mesh = serve_mesh(tp, n_kv_heads=cfg.n_kv_heads)
+        param_sh = param_shardings(cfg, mesh)
+    params = None
+    if args.checkpoint_dir:
+        # Train-to-serve handoff: restore the trained params (sharded
+        # straight onto the serve mesh when tp > 1) instead of seed-0
+        # weights.  Missing checkpoint is a hard error — silently
+        # serving random weights would look like a broken model.
+        from kuberay_tpu.train.checkpoint import load_params_for_serving
+        params = load_params_for_serving(
+            args.checkpoint_dir,
+            step=args.checkpoint_step or None,
+            shardings=param_sh, dtype=cfg.dtype)
+        if params is None:
+            ap.error(f"no checkpoint found in {args.checkpoint_dir}")
+        print(f"restored params from {args.checkpoint_dir} "
+              f"(step {args.checkpoint_step or 'latest'})", flush=True)
+    elif tp > 1:
         # Init directly into shards — the flagship model does not fit
         # one chip (checkpoint restore takes the same sharding tree).
         params = init_sharded_params(cfg, jax.random.PRNGKey(0), mesh)

@@ -87,6 +87,60 @@ def restore(directory: str, step: int, abstract_state) -> Dict[str, Any]:
     return out
 
 
+def load_params_for_serving(directory: str, step: Optional[int] = None,
+                            shardings=None, dtype=None):
+    """Restore just the model params from a TRAIN checkpoint for the
+    serve path (the train-to-serve handoff: the serving process needs
+    weights, not optimizer state).
+
+    Restores without an abstract tree (host numpy in the saved
+    structure — host RAM holds the full state briefly, which dwarfs any
+    chip), extracts ``state["params"]``, optionally casts and lays the
+    result out on a serve mesh via ``shardings`` (a params-shaped tree
+    of NamedShardings).  Returns None when no checkpoint exists."""
+    # Validate the step against what exists BEFORE touching orbax state:
+    # an explicit missing step must return None (clean caller error),
+    # not a raw orbax traceback — and a typo'd directory must not be
+    # created as a side effect (the manager runs with create=True).
+    if not os.path.isdir(directory):
+        return None
+    available = latest_step(directory)
+    if available is None:
+        return None
+    if step is None:
+        step = available
+    else:
+        mgr = _manager(directory)
+        steps = set(mgr.all_steps())
+        mgr.close()
+        if step not in steps:
+            return None
+    import orbax.checkpoint as ocp
+    mgr = _manager(directory)
+    # No abstract target: restores host numpy in the saved structure
+    # (safe here — we only extract the params subtree and re-lay it out
+    # below; the train path keeps using the targeted restore()).
+    state = mgr.restore(step, args=ocp.args.StandardRestore())
+    mgr.close()
+    params = state["params"]
+    if dtype is not None:
+        # Cast ON HOST (numpy + ml_dtypes): casting via jnp would place
+        # every leaf on the default device, making one chip briefly hold
+        # the whole model and defeating a sharded tp restore.
+        import numpy as _np
+        np_dtype = _np.dtype(dtype) if dtype != jax.numpy.bfloat16 \
+            else __import__("ml_dtypes").bfloat16
+        params = jax.tree.map(
+            lambda x: _np.asarray(x).astype(np_dtype), params)
+    if shardings is not None:
+        # Sharded device_put from host: each device receives only its
+        # shard — the full model never lands on a single chip.
+        params = jax.device_put(params, shardings)
+    else:
+        params = jax.tree.map(jax.numpy.asarray, params)
+    return params
+
+
 def restore_latest(directory: str, init_fn: Callable, init_key,
                    shardings=None) -> Optional[Dict[str, Any]]:
     """Restore the newest checkpoint, shaped like ``init_fn(init_key)``;

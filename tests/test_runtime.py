@@ -213,3 +213,48 @@ def test_checkpoint_writer_async_overlap(tmp_path):
                     jax.tree.leaves(snap2_params)):
         np.testing.assert_array_equal(np.asarray(a), b)
     assert int(restored2["step"]) == 2
+
+
+def test_load_params_for_serving(tmp_path):
+    """Train-to-serve handoff: restore only the params subtree from a
+    train checkpoint, cast + (optionally) shard for serving."""
+    from kuberay_tpu.models import llama
+    from kuberay_tpu.train import checkpoint as ckpt
+    from kuberay_tpu.train.train_step import (
+        TrainConfig, init_train_state, make_optimizer, make_train_step)
+
+    cfg = llama.CONFIGS["llama_tiny"]
+    tc = TrainConfig(warmup_steps=2, decay_steps=10)
+    opt = make_optimizer(tc)
+    state = init_train_state(cfg, opt, jax.random.PRNGKey(0))
+    step = make_train_step(cfg, tc, opt)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                                cfg.vocab_size)
+    state, _ = step(state, {"tokens": tokens,
+                            "targets": jnp.roll(tokens, -1, 1)})
+    want = jax.tree.map(np.asarray, state["params"])
+    d = str(tmp_path / "ck")
+    ckpt.save(d, state, 1)
+
+    assert ckpt.load_params_for_serving(str(tmp_path / "none")) is None
+    # Missing dir must not be created as a side effect.
+    assert not (tmp_path / "none").exists()
+    # Explicit missing step: clean None, not an orbax traceback.
+    assert ckpt.load_params_for_serving(d, step=999) is None
+    got = ckpt.load_params_for_serving(d, dtype=cfg.dtype)
+    for a, b in zip(jax.tree.leaves(got), jax.tree.leaves(want)):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   rtol=1e-2, atol=1e-2)
+    # Engine serves the restored weights.
+    from kuberay_tpu.serve.engine import Request, ServeEngine
+    eng = ServeEngine(cfg, got, max_slots=2, max_len=64)
+    eng.add_request(Request("r", [1, 2, 3], max_new_tokens=4))
+    assert len(eng.run()[0].tokens) == 4
+    # Sharded restore lands on the serve mesh.
+    from kuberay_tpu.serve.sharding import param_shardings, serve_mesh
+    mesh = serve_mesh(2)
+    sharded = ckpt.load_params_for_serving(
+        d, shardings=param_shardings(cfg, mesh), dtype=cfg.dtype)
+    wq = sharded["layers"]["wq"]
+    assert not wq.sharding.is_fully_replicated

@@ -245,3 +245,47 @@ def test_sampled_requests_with_filters_through_engine():
     a, b = run(), run()
     assert a == b                       # deterministic under fixed seed
     assert len(a["r0"]) == 8 and len(a["r1"]) == 8
+
+
+def test_stop_token_ids():
+    """Any listed stop token ends generation with reason 'eos', in
+    plain decode AND mid-speculative-acceptance."""
+    import jax
+
+    from kuberay_tpu.models import llama
+    from kuberay_tpu.serve.engine import Request, ServeEngine
+
+    cfg = llama.CONFIGS["llama_tiny"]
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+
+    # Discover what the model generates greedily, then stop on the 3rd
+    # generated token.
+    eng = ServeEngine(cfg, params, max_slots=2, max_len=64)
+    eng.add_request(Request("probe", [1, 2, 3], max_new_tokens=10))
+    probe = {r.request_id: r.tokens for r in eng.run()}["probe"]
+    stop_at = probe[2]
+    want = probe[:probe.index(stop_at) + 1]   # stop at FIRST occurrence
+
+    eng = ServeEngine(cfg, params, max_slots=2, max_len=64)
+    eng.add_request(Request("r", [1, 2, 3], max_new_tokens=10,
+                            stop_token_ids=[9999, stop_at]))
+    out = eng.run()
+    assert out[0].tokens == want
+    assert out[0].finish_reason == "eos"
+
+    # Speculative path: same stop honored (repetitive prompt drafts).
+    eng = ServeEngine(cfg, params, max_slots=2, max_len=128,
+                      speculative=4)
+    eng.add_request(Request("probe2", [7, 8, 9] * 8, max_new_tokens=16))
+    probe2 = {r.request_id: r.tokens
+              for r in eng.run()}["probe2"]
+    if len(set(probe2)) > 1:
+        stop2 = probe2[min(4, len(probe2) - 1)]
+        want = probe2[:probe2.index(stop2) + 1]
+        eng = ServeEngine(cfg, params, max_slots=2, max_len=128,
+                          speculative=4)
+        eng.add_request(Request("r2", [7, 8, 9] * 8, max_new_tokens=16,
+                                stop_token_ids=[stop2]))
+        out2 = eng.run()
+        assert out2[0].tokens == want
+        assert out2[0].finish_reason == "eos"
