@@ -123,6 +123,11 @@ class CoordinatorClient:
     def get_serve_apps(self) -> Dict[str, Any]:
         return self._req("GET", "/api/serve/applications/")
 
+    def get_serve_config(self) -> Dict[str, Any]:
+        """The submitted serve config (the TpuService controller's PUT)
+        — what serve pods read their engine settings from."""
+        return self._req("GET", "/api/serve/config")
+
     # device profiling (jax.profiler traces on the head)
     def start_profile(self, duration_s: float = 0.0) -> Dict[str, Any]:
         return self._req("POST", "/api/profile/start",

@@ -493,6 +493,11 @@ class CoordinatorServer:
                     return self._send(200, rec.to_dict())
                 if self.path == "/api/serve/applications/":
                     return self._send(200, dict(coord.serve_apps))
+                if self.path == "/api/serve/config":
+                    # The submitted serve CONFIG (what the TpuService
+                    # controller PUT) — serve pods read their app's
+                    # engine settings from here at startup.
+                    return self._send(200, coord.serve_config or {})
                 if self.path == "/api/profile/":
                     return self._send(200,
                                       {"profiles": coord.list_profiles()})

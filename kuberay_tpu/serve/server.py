@@ -458,6 +458,79 @@ def register_with_coordinator(app_name: str, coordinator_url: str,
         return False
 
 
+# serve-config application keys -> engine CLI args (the
+# serveConfig-to-engine wire: what a TpuService's spec.serveConfig
+# application block may set; explicit CLI flags are overwritten — the
+# controller-submitted config is the source of truth in a managed pod).
+# key -> (coercion, allowed-choices or None): raw JSON/YAML values get
+# the same typing + choices discipline the argparse path enforces, so a
+# string "8" or an invalid kv_quant fails with a clean parameter error
+# instead of a deep engine traceback.
+_CONFIG_KEYS = {
+    "model": (str, None),
+    "paged": (bool, None),
+    "block_size": (int, None),
+    "num_blocks": (int, None),
+    "prefill_chunk": (int, None),
+    "speculative": (int, None),
+    "kv_quant": (str, ("none", "int8")),
+    "weight_quant": (str, ("none", "int8")),
+    "tp": (int, None),
+    "max_slots": (int, None),
+    "max_len": (int, None),
+    "checkpoint_dir": (str, None),
+    "checkpoint_step": (int, None),
+    "decode_impl": (str, ("auto", "pallas", "xla", "pallas_interpret")),
+}
+
+
+def _apply_coordinator_config(args, ap) -> None:
+    """Fetch the submitted serve config and fold this app's settings
+    into ``args`` (bounded wait: the controller PUTs the config only
+    once the cluster reports ready, which may be after pod start)."""
+    import time as _time
+    from kuberay_tpu.runtime.coordinator_client import (
+        CoordinatorClient, CoordinatorError)
+    if not args.coordinator:
+        ap.error("--config-from-coordinator requires --coordinator "
+                 "(or auto with the operator env)")
+    client = CoordinatorClient(args.coordinator)
+    deadline = _time.time() + args.config_wait
+    cfg = None
+    while _time.time() < deadline:
+        try:
+            doc = client.get_serve_config()
+        except CoordinatorError:
+            doc = {}
+        for app in (doc or {}).get("applications", []) or []:
+            if app.get("name") == args.app_name:
+                cfg = app
+                break
+        if cfg is not None:
+            break
+        _time.sleep(1.0)
+    if cfg is None:
+        ap.error(f"serve config for app {args.app_name!r} did not "
+                 f"appear on {args.coordinator} within "
+                 f"{args.config_wait:.0f}s")
+    applied = {}
+    for key, (coerce, choices) in _CONFIG_KEYS.items():
+        if key not in cfg:
+            continue
+        try:
+            val = coerce(cfg[key])
+        except (TypeError, ValueError):
+            ap.error(f"serve config {key}={cfg[key]!r}: not a valid "
+                     f"{coerce.__name__}")
+        if choices is not None and val not in choices:
+            ap.error(f"serve config {key}={val!r}: must be one of "
+                     f"{choices}")
+        setattr(args, key, val)
+        applied[key] = val
+    print(f"serve config applied for app {args.app_name!r}: {applied}",
+          flush=True)
+
+
 def main(argv=None):  # pragma: no cover - process wrapper
     import argparse
     from kuberay_tpu.utils.platform import pin_platform_from_env
@@ -476,8 +549,17 @@ def main(argv=None):  # pragma: no cover - process wrapper
                     help="serve params restored from this TRAIN "
                          "checkpoint directory (instead of seed-0 "
                          "init); sharded onto the serve mesh under --tp")
-    ap.add_argument("--checkpoint-step", type=int, default=0,
-                    help="checkpoint step to serve (0 = latest)")
+    ap.add_argument("--checkpoint-step", type=int, default=-1,
+                    help="checkpoint step to serve (-1 = latest; 0 is "
+                         "a real step)")
+    ap.add_argument("--config-from-coordinator", action="store_true",
+                    help="read this app's engine settings from the "
+                         "coordinator's submitted serve config (what "
+                         "the TpuService controller PUT) before "
+                         "starting — the serveConfig-to-engine wire")
+    ap.add_argument("--config-wait", type=float, default=60.0,
+                    help="seconds to wait for the serve config to "
+                         "appear on the coordinator")
     ap.add_argument("--paged", action="store_true",
                     help="paged KV cache with prefix caching")
     ap.add_argument("--block-size", type=int, default=16)
@@ -508,6 +590,14 @@ def main(argv=None):  # pragma: no cover - process wrapper
                          "contract joins them into one jax.distributed "
                          "group and hosts >0 become lockstep followers")
     args = ap.parse_args(argv)
+    if args.coordinator == "auto":
+        # Resolve from the operator-injected env (builders/pod.py).
+        import os as _os0
+        from kuberay_tpu.runtime.coordinator_client import dashboard_url
+        addr = _os0.environ.get(C.ENV_COORDINATOR_ADDRESS, "")
+        args.coordinator = dashboard_url(addr) if addr else ""
+    if args.config_from_coordinator:
+        _apply_coordinator_config(args, ap)
     # Slice identity: same env contract as the training launcher
     # (TPU_WORKER_ID / TPU_WORKER_HOSTNAMES injected by builders/pod.py).
     from kuberay_tpu.train.launcher import (
@@ -541,14 +631,15 @@ def main(argv=None):  # pragma: no cover - process wrapper
         # weights.  Missing checkpoint is a hard error — silently
         # serving random weights would look like a broken model.
         from kuberay_tpu.train.checkpoint import load_params_for_serving
+        step = None if args.checkpoint_step < 0 else args.checkpoint_step
         params = load_params_for_serving(
-            args.checkpoint_dir,
-            step=args.checkpoint_step or None,
+            args.checkpoint_dir, step=step,
             shardings=param_sh, dtype=cfg.dtype)
         if params is None:
-            ap.error(f"no checkpoint found in {args.checkpoint_dir}")
+            ap.error(f"no checkpoint found in {args.checkpoint_dir}"
+                     + (f" at step {step}" if step is not None else ""))
         print(f"restored params from {args.checkpoint_dir} "
-              f"(step {args.checkpoint_step or 'latest'})", flush=True)
+              f"(step {'latest' if step is None else step})", flush=True)
     elif tp > 1:
         # Init directly into shards — the flagship model does not fit
         # one chip (checkpoint restore takes the same sharding tree).
@@ -647,12 +738,6 @@ def main(argv=None):  # pragma: no cover - process wrapper
     frontend = ServeFrontend(engine, monitor=monitor,
                              on_degraded=on_degraded)
     srv = frontend.make_server(args.host, args.port)
-    if args.coordinator == "auto":
-        # Resolve from the operator-injected env (builders/pod.py).
-        import os as _os
-        from kuberay_tpu.runtime.coordinator_client import dashboard_url
-        addr = _os.environ.get(C.ENV_COORDINATOR_ADDRESS, "")
-        args.coordinator = dashboard_url(addr) if addr else ""
     if args.coordinator:
         register_with_coordinator(args.app_name, args.coordinator)
     print(f"serving {args.model} on {args.host}:{srv.server_address[1]} "

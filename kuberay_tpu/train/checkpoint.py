@@ -104,24 +104,26 @@ def load_params_for_serving(directory: str, step: Optional[int] = None,
     # created as a side effect (the manager runs with create=True).
     if not os.path.isdir(directory):
         return None
-    available = latest_step(directory)
-    if available is None:
-        return None
-    if step is None:
-        step = available
-    else:
-        mgr = _manager(directory)
-        steps = set(mgr.all_steps())
-        mgr.close()
-        if step not in steps:
-            return None
     import orbax.checkpoint as ocp
+    # ONE manager for step resolution + restore (each construction
+    # rescans the directory — on the realistic /ckpt network mount that
+    # latency multiplies per serve-pod start).
     mgr = _manager(directory)
-    # No abstract target: restores host numpy in the saved structure
-    # (safe here — we only extract the params subtree and re-lay it out
-    # below; the train path keeps using the targeted restore()).
-    state = mgr.restore(step, args=ocp.args.StandardRestore())
-    mgr.close()
+    try:
+        steps = set(mgr.all_steps())
+        if not steps:
+            return None
+        if step is None:
+            step = max(steps)
+        elif step not in steps:
+            return None
+        # No abstract target: restores host numpy in the saved
+        # structure (safe here — we only extract the params subtree and
+        # re-lay it out below; the train path keeps using the targeted
+        # restore()).
+        state = mgr.restore(step, args=ocp.args.StandardRestore())
+    finally:
+        mgr.close()
     params = state["params"]
     if dtype is not None:
         # Cast ON HOST (numpy + ml_dtypes): casting via jnp would place
