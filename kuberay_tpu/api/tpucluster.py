@@ -117,6 +117,10 @@ class WorkerGroupSpec(Serializable):
     minReplicas: int = 0
     maxReplicas: int = 1
     suspend: bool = False
+    # Per-group idle scale-down override (ref WorkerGroupSpec.
+    # IdleTimeoutSeconds, autoscaler v2): 0 = inherit
+    # autoscalerOptions.idleTimeoutSeconds.
+    idleTimeoutSeconds: int = 0
     scaleStrategy: ScaleStrategy = dataclasses.field(default_factory=ScaleStrategy)
     template: PodTemplateSpec = dataclasses.field(default_factory=PodTemplateSpec)
     startParams: Dict[str, str] = dataclasses.field(default_factory=dict)

@@ -71,6 +71,9 @@ def decide(cluster: TpuCluster,
         target = cur
         victims: List[str] = []
         reason = ""
+        # Per-group override (ref autoscaler-v2 idleTimeoutSeconds):
+        # 0 inherits the cluster-level timeout.
+        group_idle = g.idleTimeoutSeconds or idle_timeout
 
         if want > cur and upscaling_mode != "Conservative":
             step = (want - cur) if upscaling_mode == "Aggressive" else 1
@@ -80,13 +83,13 @@ def decide(cluster: TpuCluster,
             # Downscale: idle slices beyond demand, newest-idle last.
             idle = sorted(
                 (s for s in by_group.get(g.groupName, [])
-                 if s.ready and s.idle_seconds >= idle_timeout),
+                 if s.ready and s.idle_seconds >= group_idle),
                 key=lambda s: -s.idle_seconds)
             removable = min(len(idle), cur - max(lo, want))
             if removable > 0:
                 victims = [s.name for s in idle[:removable]]
                 target = cur - removable
-                reason = f"{removable} slices idle >= {idle_timeout}s"
+                reason = f"{removable} slices idle >= {group_idle}s"
 
         target = max(lo, min(hi, target))
         if target != cur or victims:

@@ -92,6 +92,13 @@ def validate_cluster_spec(spec: TpuClusterSpec, errs: List[str]):
             _check(not g.suspend,
                    f"{prefix} cannot be suspended with autoscaling enabled",
                    errs)
+        _check(g.idleTimeoutSeconds >= 0,
+               f"{prefix}.idleTimeoutSeconds must be >= 0", errs)
+        if g.idleTimeoutSeconds and not spec.enableInTreeAutoscaling:
+            # Ref validateWorkerGroupIdleTimeout (:868): the field only
+            # means something to the autoscaler.
+            errs.append(f"{prefix}.idleTimeoutSeconds is set but "
+                        "autoscaling is not enabled")
         if g.suspend:
             # Ref :195-199 (RayJobDeletionPolicy gates worker suspend).
             _check(features.enabled("DeletionRules"),

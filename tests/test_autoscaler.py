@@ -185,3 +185,47 @@ def test_builder_sidecar_command_is_runnable():
     assert cmd[:2] == ["python", "-m"]
     mod = importlib.import_module(cmd[2])
     assert hasattr(mod, "main")
+
+
+def test_per_group_idle_timeout_override():
+    """WorkerGroupSpec.idleTimeoutSeconds (ref autoscaler v2): a group
+    with its own timeout scales down on ITS clock; 0 inherits the
+    cluster-level timeout."""
+    from kuberay_tpu.controlplane.autoscaler import SliceInfo, decide
+    from tests.test_api_types import make_cluster
+
+    c = make_cluster(accelerator="v5e", topology="2x2", replicas=2)
+    c.spec.enableInTreeAutoscaling = True
+    g2 = __import__("copy").deepcopy(c.spec.workerGroupSpecs[0])
+    g2.groupName = "fast-reap"
+    g2.idleTimeoutSeconds = 5
+    c.spec.workerGroupSpecs.append(g2)
+
+    slices = [
+        SliceInfo("w-s0", "workers", True, idle_seconds=30),
+        SliceInfo("w-s1", "workers", True, idle_seconds=30),
+        SliceInfo("f-s0", "fast-reap", True, idle_seconds=30),
+        SliceInfo("f-s1", "fast-reap", True, idle_seconds=30),
+    ]
+    # Cluster-level timeout 60: default group NOT idle long enough; the
+    # override group (5s) reaps.
+    out = {d.group: d for d in decide(c, {}, slices, idle_timeout=60.0)}
+    assert "workers" not in out
+    assert out["fast-reap"].replicas == 0
+    assert sorted(out["fast-reap"].slices_to_delete) == ["f-s0", "f-s1"]
+
+
+def test_idle_timeout_validation():
+    from kuberay_tpu.utils.validation import validate_cluster
+    from tests.test_api_types import make_cluster
+
+    c = make_cluster()
+    c.spec.workerGroupSpecs[0].idleTimeoutSeconds = 30
+    assert any("autoscaling is not enabled" in e
+               for e in validate_cluster(c))
+    c.spec.enableInTreeAutoscaling = True
+    c.spec.workerGroupSpecs[0].maxReplicas = 4
+    assert validate_cluster(c) == []
+    c.spec.workerGroupSpecs[0].idleTimeoutSeconds = -1
+    assert any("idleTimeoutSeconds must be >= 0" in e
+               for e in validate_cluster(c))
