@@ -129,6 +129,35 @@ def build_cluster_manifest(args) -> Dict[str, Any]:
     }
 
 
+def build_service_manifest(args) -> Dict[str, Any]:
+    """TpuService with the serveConfig-to-engine wire prewired: the
+    worker command reads its engine settings from the coordinator, so
+    spec.serveConfig is the one source of truth and config edits roll
+    through the normal zero-downtime upgrade."""
+    cluster_spec = build_cluster_manifest(args)["spec"]
+    worker = cluster_spec["workerGroupSpecs"][0]["template"]["spec"][
+        "containers"][0]
+    worker["command"] = ["python", "-m", "kuberay_tpu.serve.server"]
+    worker["args"] = ["--tp", "0", "--coordinator", "auto",
+                      "--app-name", "llm", "--config-from-coordinator"]
+    app: Dict[str, Any] = {
+        "name": "llm", "model": args.model,
+        "max_len": args.max_serve_len,
+    }
+    if args.paged:
+        app["paged"] = True
+    if args.checkpoint_dir:
+        app["checkpoint_dir"] = args.checkpoint_dir
+    return {
+        "apiVersion": C.API_VERSION, "kind": C.KIND_SERVICE,
+        "metadata": {"name": args.name, "namespace": args.namespace},
+        "spec": {
+            "serveConfig": {"applications": [app]},
+            "clusterSpec": cluster_spec,
+        },
+    }
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="tpuctl",
                                  description="TPU pod-slice orchestration CLI")
@@ -153,7 +182,7 @@ def main(argv=None):
 
     cc = sub.add_parser("create",
                         help="create a cluster or add a worker group")
-    cc.add_argument("what", choices=["cluster", "workergroup"])
+    cc.add_argument("what", choices=["cluster", "workergroup", "service"])
     cc.add_argument("name")
     cc.add_argument("--cluster", default="",
                     help="(workergroup) existing TpuCluster to extend")
@@ -167,6 +196,16 @@ def main(argv=None):
     cc.add_argument("--worker-cpu", default="8")
     cc.add_argument("--worker-memory", default="16Gi")
     cc.add_argument("--autoscale", action="store_true")
+    # service-only flags (serveConfig application block).
+    cc.add_argument("--model", default="llama3_8b",
+                    help="(service) model the serve app runs")
+    cc.add_argument("--paged", action="store_true",
+                    help="(service) paged KV cache engine")
+    cc.add_argument("--max-serve-len", type=int, default=2048,
+                    help="(service) engine max sequence length")
+    cc.add_argument("--checkpoint-dir", default="",
+                    help="(service) serve trained weights from this "
+                         "train checkpoint")
 
     sc = sub.add_parser("scale", help="scale a worker group (slice units)")
     sc.add_argument("name")
@@ -401,6 +440,10 @@ def _dispatch(args, client: ApiClient) -> int:
             print("error: --cluster only applies to workergroup",
                   file=sys.stderr)
             return 1
+        if args.what == "service":
+            obj = client.create(build_service_manifest(args))
+            print(f"tpuservice/{obj['metadata']['name']} created")
+            return 0
         obj = client.create(build_cluster_manifest(args))
         print(f"tpucluster/{obj['metadata']['name']} created")
         return 0

@@ -332,3 +332,35 @@ def test_docs_tree_consistent_with_cli_and_nav():
     for cmd in subcommands:
         assert f"tpuctl {cmd}" in ref or f"`{cmd}`" in ref, \
             f"tpuctl.md does not document {cmd!r}"
+
+
+def test_tpuctl_create_service():
+    """tpuctl create service: one command yields a valid TpuService with
+    the serveConfig-to-engine wire prewired (worker pods read engine
+    settings from the coordinator)."""
+    from kuberay_tpu.apiserver.server import serve_background
+    from kuberay_tpu.cli.__main__ import main as tpuctl
+    from kuberay_tpu.controlplane.store import ObjectStore
+    from kuberay_tpu.utils.validation import validate_service
+    from kuberay_tpu.api.tpuservice import TpuService
+
+    store = ObjectStore()
+    srv, url = serve_background(store)
+    try:
+        rc = tpuctl(["--server", url, "create", "service", "chat",
+                     "--tpu", "v5e", "--topology", "4x4", "--slices", "1",
+                     "--model", "llama3_8b", "--paged",
+                     "--checkpoint-dir", "/ckpt"])
+        assert rc == 0
+        obj = store.get("TpuService", "chat")
+        assert validate_service(TpuService.from_dict(obj)) == []
+        app = obj["spec"]["serveConfig"]["applications"][0]
+        assert app == {"name": "llm", "model": "llama3_8b",
+                       "max_len": 2048, "paged": True,
+                       "checkpoint_dir": "/ckpt"}
+        worker = obj["spec"]["clusterSpec"]["workerGroupSpecs"][0][
+            "template"]["spec"]["containers"][0]
+        assert "--config-from-coordinator" in worker["args"]
+        assert worker["command"][-1] == "kuberay_tpu.serve.server"
+    finally:
+        srv.shutdown()
