@@ -42,8 +42,16 @@ def main() -> None:
             eng = MultihostPagedServeEngine(cfg, params, **kw)
         else:
             eng = MultihostServeEngine(cfg, params, **kw)
-        for i, p in enumerate([[1, 2, 3, 4, 5], [9, 8, 7]]):
-            eng.add_request(Request(f"r{i}", p, max_new_tokens=8))
+        reqs = [[1, 2, 3, 4, 5], [9, 8, 7]]
+        for i, p in enumerate(reqs):
+            # r1 samples with filters: the samp row rides the broadcast
+            # plan and BOTH processes must select the filtered compiled
+            # sampler variant (derived from the plan, not local state).
+            eng.add_request(Request(
+                f"r{i}", p, max_new_tokens=8,
+                temperature=0.8 if i == 1 else 0.0,
+                top_p=0.9 if i == 1 else 1.0,
+                top_k=16 if i == 1 else 0))
         out = {r.request_id: r.tokens for r in eng.run()}
         eng.stop()
         print("RESULT " + json.dumps(out), flush=True)

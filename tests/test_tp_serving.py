@@ -158,7 +158,10 @@ def test_multihost_lockstep_two_processes(params):
     ref_params = llama.init_params(cfg, jax.random.PRNGKey(0))
     eng = ServeEngine(cfg, ref_params, max_slots=2, max_len=64)
     for i, p in enumerate([[1, 2, 3, 4, 5], [9, 8, 7]]):
-        eng.add_request(Request(f"r{i}", p, max_new_tokens=8))
+        eng.add_request(Request(f"r{i}", p, max_new_tokens=8,
+                                temperature=0.8 if i == 1 else 0.0,
+                                top_p=0.9 if i == 1 else 1.0,
+                                top_k=16 if i == 1 else 0))
     want = {r.request_id: r.tokens for r in eng.run()}
     assert got == want
 
@@ -272,6 +275,9 @@ def test_multihost_paged_lockstep(params):
     eng = PagedServeEngine(cfg, ref_params, max_slots=2, max_len=64,
                            block_size=8)
     for i, p in enumerate([[1, 2, 3, 4, 5], [9, 8, 7]]):
-        eng.add_request(Request(f"r{i}", p, max_new_tokens=8))
+        eng.add_request(Request(f"r{i}", p, max_new_tokens=8,
+                                temperature=0.8 if i == 1 else 0.0,
+                                top_p=0.9 if i == 1 else 1.0,
+                                top_k=16 if i == 1 else 0))
     want = {r.request_id: r.tokens for r in eng.run()}
     assert got == want
