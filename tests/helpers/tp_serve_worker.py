@@ -9,6 +9,16 @@ import json
 import os
 import sys
 
+# ONE request set shared by the worker and both single-process reference
+# blocks in tests/test_tp_serving.py — drift here fails as an opaque
+# token mismatch, so it must not be copy-pasted.
+LOCKSTEP_REQUESTS = [
+    # (prompt, kwargs)
+    ([1, 2, 3, 4, 5], dict(max_new_tokens=8)),
+    ([9, 8, 7], dict(max_new_tokens=8, temperature=0.8, top_p=0.9,
+                     top_k=16)),
+]
+
 
 def main() -> None:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -42,16 +52,11 @@ def main() -> None:
             eng = MultihostPagedServeEngine(cfg, params, **kw)
         else:
             eng = MultihostServeEngine(cfg, params, **kw)
-        reqs = [[1, 2, 3, 4, 5], [9, 8, 7]]
-        for i, p in enumerate(reqs):
-            # r1 samples with filters: the samp row rides the broadcast
-            # plan and BOTH processes must select the filtered compiled
-            # sampler variant (derived from the plan, not local state).
-            eng.add_request(Request(
-                f"r{i}", p, max_new_tokens=8,
-                temperature=0.8 if i == 1 else 0.0,
-                top_p=0.9 if i == 1 else 1.0,
-                top_k=16 if i == 1 else 0))
+        # r1 samples with filters: the samp row rides the broadcast
+        # plan and BOTH processes must select the filtered compiled
+        # sampler variant (derived from the plan, not local state).
+        for i, (p, kw) in enumerate(LOCKSTEP_REQUESTS):
+            eng.add_request(Request(f"r{i}", p, **kw))
         out = {r.request_id: r.tokens for r in eng.run()}
         eng.stop()
         print("RESULT " + json.dumps(out), flush=True)

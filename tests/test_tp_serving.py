@@ -157,11 +157,9 @@ def test_multihost_lockstep_two_processes(params):
     cfg = dataclasses.replace(CFG, n_heads=8, n_kv_heads=4)
     ref_params = llama.init_params(cfg, jax.random.PRNGKey(0))
     eng = ServeEngine(cfg, ref_params, max_slots=2, max_len=64)
-    for i, p in enumerate([[1, 2, 3, 4, 5], [9, 8, 7]]):
-        eng.add_request(Request(f"r{i}", p, max_new_tokens=8,
-                                temperature=0.8 if i == 1 else 0.0,
-                                top_p=0.9 if i == 1 else 1.0,
-                                top_k=16 if i == 1 else 0))
+    from tests.helpers.tp_serve_worker import LOCKSTEP_REQUESTS
+    for i, (p, kw) in enumerate(LOCKSTEP_REQUESTS):
+        eng.add_request(Request(f"r{i}", p, **kw))
     want = {r.request_id: r.tokens for r in eng.run()}
     assert got == want
 
@@ -274,10 +272,8 @@ def test_multihost_paged_lockstep(params):
     ref_params = llama.init_params(cfg, jax.random.PRNGKey(0))
     eng = PagedServeEngine(cfg, ref_params, max_slots=2, max_len=64,
                            block_size=8)
-    for i, p in enumerate([[1, 2, 3, 4, 5], [9, 8, 7]]):
-        eng.add_request(Request(f"r{i}", p, max_new_tokens=8,
-                                temperature=0.8 if i == 1 else 0.0,
-                                top_p=0.9 if i == 1 else 1.0,
-                                top_k=16 if i == 1 else 0))
+    from tests.helpers.tp_serve_worker import LOCKSTEP_REQUESTS
+    for i, (p, kw) in enumerate(LOCKSTEP_REQUESTS):
+        eng.add_request(Request(f"r{i}", p, **kw))
     want = {r.request_id: r.tokens for r in eng.run()}
     assert got == want
