@@ -13,10 +13,16 @@ for the tpu.dev CRs, and the proxy adds exactly three things:
   bounded by an overall deadline — idempotent and non-idempotent verbs
   alike, because the upstream either never saw the request (connect
   error) or refused it (retryable status);
-- **route scoping**: only the tpu.dev API group, core events pinned to
-  a ``regarding.apiVersion=tpu.dev/v1`` field selector (ref
-  withFieldSelector), and whitelisted sub-resources pass; everything
-  else 404s without touching the upstream.
+- **route scoping**: only the tpu.dev API group and namespaced events
+  pass; events are pinned to a field selector scoping them to tpu.dev
+  objects (ref withFieldSelector) — ``regarding.apiVersion`` on the
+  ``events.k8s.io/v1`` path (the field name that group defines, as the
+  reference proxies) and ``involvedObject.apiVersion`` on the core
+  ``/api/v1`` path (core Events have no ``regarding`` field label).
+  Everything else 404s without touching the upstream.  Paths are
+  normalized (dot segments resolved, encoded dots rejected) before the
+  route check so ``..`` traversal cannot smuggle an out-of-scope path
+  past the prefix match.
 
 Streaming passes through: a ``?watch=true`` upstream response is copied
 chunk-by-chunk, so informers work through the proxy unchanged.
@@ -27,6 +33,7 @@ chunk-by-chunk, so informers work through the proxy unchanged.
 
 from __future__ import annotations
 
+import posixpath
 import threading
 import time
 import urllib.error
@@ -79,16 +86,41 @@ class ReverseProxy:
 
     def _route(self, path: str, query: Dict[str, list]) -> Optional[Dict]:
         """Returns forced-query overrides for an admitted path, or None
-        for a refused one."""
-        if path.startswith("/apis/tpu.dev/v1/"):
+        for a refused one.  ``path`` must already be normalized."""
+        if path == "/apis/tpu.dev/v1" or \
+                path.startswith("/apis/tpu.dev/v1/"):
             return {}
         parts = [p for p in path.split("/") if p]
-        # /api/v1/namespaces/{ns}/events — events ONLY, selector pinned
-        # so the proxy cannot be used to read unrelated cluster events.
+        # Events ONLY, selector pinned so the proxy cannot be used to
+        # read unrelated cluster events.  The field label differs by API
+        # group: events.k8s.io/v1 defines `regarding.*`, core v1 defines
+        # `involvedObject.*` — a regarding selector on the core path
+        # would 400 against a real apiserver.
+        if len(parts) == 6 and parts[0] == "apis" \
+                and parts[1] == "events.k8s.io" and parts[2] == "v1" \
+                and parts[3] == "namespaces" and parts[5] == "events":
+            return {"fieldSelector": "regarding.apiVersion=tpu.dev/v1"}
         if len(parts) == 5 and parts[0] == "api" and parts[1] == "v1" \
                 and parts[2] == "namespaces" and parts[4] == "events":
-            return {"fieldSelector": "regarding.apiVersion=tpu.dev/v1"}
+            return {"fieldSelector":
+                    "involvedObject.apiVersion=tpu.dev/v1"}
         return None
+
+    @staticmethod
+    def _normalize(path: str) -> Optional[str]:
+        """Resolve dot segments before routing (Go's ServeMux cleans
+        paths before matching; urllib forwards them verbatim, so without
+        this `/apis/tpu.dev/v1/../../api/v1/...` would pass the prefix
+        check and reach the upstream with injected credentials).
+        Returns None for paths that must be refused outright."""
+        # Encoded dots could decode to traversal after forwarding —
+        # refuse rather than guess the upstream's decode order.
+        if "%2e" in path.lower():
+            return None
+        norm = posixpath.normpath(path)
+        if not norm.startswith("/") or ".." in norm.split("/"):
+            return None
+        return norm
 
     # -- forwarding -----------------------------------------------------
 
@@ -97,7 +129,9 @@ class ReverseProxy:
         """Returns (status, header-items, body-iterator) or an error
         tuple; retries per the round-tripper policy."""
         q = urllib.parse.parse_qs(query, keep_blank_values=True)
-        forced = self._route(path, q)
+        normed = self._normalize(path)
+        forced = self._route(normed, q) if normed is not None else None
+        path = normed if normed is not None else path
         if forced is None:
             return 404, [("Content-Type", "application/json")], iter(
                 [b'{"kind":"Status","status":"Failure","code":404,'
@@ -174,11 +208,38 @@ class ReverseProxy:
                 status, headers, chunks = fwd(
                     self.command, u.path, u.query,
                     dict(self.headers.items()), body)
+                # 1xx/204/304 MUST NOT carry a body (RFC 7230 §3.3) —
+                # chunked framing on them breaks strict clients.  HEAD
+                # responses are headers-only by definition.
+                bodyless = (100 <= status < 200 or status in (204, 304)
+                            or self.command == "HEAD")
+                upstream_len = next(
+                    (v for k, v in headers
+                     if k.lower() == "content-length"), None)
                 self.send_response(status)
-                self.send_header("Transfer-Encoding", "chunked")
                 for k, v in headers:
                     if k.lower() not in _HOP:
                         self.send_header(k, v)
+                if bodyless:
+                    self.end_headers()
+                    for _ in chunks:  # drain/close the upstream body
+                        pass
+                    return
+                if upstream_len is not None:
+                    # Non-streamed upstream response: preserve its exact
+                    # framing so clients that dislike chunked get plain
+                    # Content-Length delivery.
+                    self.send_header("Content-Length", upstream_len)
+                    self.end_headers()
+                    try:
+                        for chunk in chunks:
+                            if chunk:
+                                self.wfile.write(chunk)
+                        self.wfile.flush()
+                    except (BrokenPipeError, ConnectionError, OSError):
+                        self.close_connection = True
+                    return
+                self.send_header("Transfer-Encoding", "chunked")
                 self.end_headers()
                 try:
                     for chunk in chunks:
@@ -193,6 +254,7 @@ class ReverseProxy:
                     self.close_connection = True
 
             do_GET = do_POST = do_PUT = do_PATCH = do_DELETE = _handle
+            do_HEAD = _handle
 
         return ThreadingHTTPServer((host, port), Handler)
 

@@ -49,6 +49,7 @@ from kuberay_tpu.utils.names import head_service_name, spec_hash
 from kuberay_tpu.utils.validation import (
     validate_cluster,
     validate_cluster_status,
+    waive_create_only,
 )
 
 POD_SPEC_HASH_ANNOTATION = "tpu.dev/pod-template-hash"
@@ -114,7 +115,7 @@ class TpuClusterController:
         # group is validated exactly like an explicit one (server-side, so
         # every client benefits — ref apiserver ComputeTemplate resolution).
         errs = resolve_compute_templates(cluster, self.store)
-        errs += validate_cluster(cluster)
+        errs += waive_create_only(validate_cluster(cluster))
         # Status sanity (ref ValidateRayClusterStatus :23): mutually
         # exclusive suspend conditions mean a forged/corrupt status.
         errs += validate_cluster_status(cluster)

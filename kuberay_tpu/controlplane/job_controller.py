@@ -44,7 +44,7 @@ from kuberay_tpu.utils.names import (
     head_pod_name,
     submitter_job_name,
 )
-from kuberay_tpu.utils.validation import validate_job
+from kuberay_tpu.utils.validation import validate_job, waive_create_only
 
 
 class TpuJobController:
@@ -95,7 +95,7 @@ class TpuJobController:
     # ------------------------------------------------------------------
 
     def _state_new(self, job: TpuJob) -> Optional[float]:
-        errs = validate_job(job)
+        errs = waive_create_only(validate_job(job))
         if errs:
             self.recorder.warning(job.to_dict(), C.EVENT_INVALID_SPEC,
                                   "; ".join(errs))

@@ -131,7 +131,10 @@ def _ptr_get(doc: Any, pointer: str):
 def _ptr_add(doc, tokens, value):
     parent, last = _ptr_walk(doc, tokens)
     if isinstance(parent, list):
-        idx = len(parent) if last == "-" else int(last)
+        try:
+            idx = len(parent) if last == "-" else int(last)
+        except ValueError:
+            raise PatchError(f"bad list index {last!r}") from None
         if not 0 <= idx <= len(parent):
             raise PatchError(f"list index {last} out of range")
         parent.insert(idx, value)
@@ -142,6 +145,8 @@ def _ptr_add(doc, tokens, value):
 
 
 def _ptr_remove(doc, tokens):
+    if not tokens:
+        raise PatchError("cannot remove whole document")
     parent, last = _ptr_walk(doc, tokens)
     if isinstance(parent, list):
         try:
@@ -188,10 +193,14 @@ def json_patch(target: Any, ops: List[Dict[str, Any]]) -> Any:
                 else:
                     parent[last] = copy.deepcopy(op.get("value"))
         elif kind == "move":
-            val = _ptr_remove(doc, _ptr_tokens(op.get("from", "")))
+            if "from" not in op:
+                raise PatchError("move op missing 'from'")
+            val = _ptr_remove(doc, _ptr_tokens(op["from"]))
             _ptr_add(doc, tokens, val)
         elif kind == "copy":
-            val = copy.deepcopy(_ptr_get(doc, op.get("from", "")))
+            if "from" not in op:
+                raise PatchError("copy op missing 'from'")
+            val = copy.deepcopy(_ptr_get(doc, op["from"]))
             _ptr_add(doc, tokens, val)
         elif kind == "test":
             if _ptr_get(doc, path) != op.get("value"):

@@ -37,7 +37,7 @@ from kuberay_tpu.runtime.coordinator_client import CoordinatorError
 from kuberay_tpu.utils import constants as C
 from kuberay_tpu.utils import features
 from kuberay_tpu.utils.names import serve_service_name, spec_hash_without_scale, truncate_name
-from kuberay_tpu.utils.validation import validate_service
+from kuberay_tpu.utils.validation import validate_service, waive_create_only
 
 
 def _fmt_secs(seconds: float) -> str:
@@ -74,7 +74,7 @@ class TpuServiceController:
         if svc.metadata.deletionTimestamp:
             return self._reconcile_deletion(svc)
 
-        errs = validate_service(svc)
+        errs = waive_create_only(validate_service(svc))
         if errs:
             self.recorder.warning(raw, C.EVENT_INVALID_SPEC, "; ".join(errs))
             return None

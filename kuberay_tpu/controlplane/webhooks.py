@@ -16,7 +16,9 @@ from typing import Any, Dict, List
 
 from kuberay_tpu.utils import constants as C
 from kuberay_tpu.utils.httpjson import JsonHandler
-from kuberay_tpu.utils.validation import kind_validators
+from kuberay_tpu.utils.validation import (kind_validators,
+                                          surface_create_only,
+                                          waive_create_only)
 
 _VALIDATORS = kind_validators()
 
@@ -28,6 +30,13 @@ def validate_admission(obj: Dict[str, Any],
     kind = obj.get("kind", "")
     validator = _VALIDATORS.get(kind)
     errs = validator(obj) if validator else []
+    if old_obj is not None:
+        # Create-only rules (currently: DNS-1035 letter-start) are
+        # waived on update so objects that predate a tightened rule do
+        # not become unmodifiable — every PUT/PATCH re-runs admission.
+        errs = waive_create_only(errs)
+    else:
+        errs = surface_create_only(errs)
     if old_obj is not None and kind == C.KIND_CLUSTER:
         old_groups = [g.get("groupName") for g in
                       old_obj.get("spec", {}).get("workerGroupSpecs", [])]

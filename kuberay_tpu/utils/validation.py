@@ -29,6 +29,23 @@ _DNS1123 = re.compile(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?$")
 _DNS1035 = re.compile(r"^[a-z]([-a-z0-9]*[a-z0-9])?$")
 _QUANTITY = re.compile(r"^[0-9]+(\.[0-9]+)?(Ki|Mi|Gi|Ti|Pi|k|M|G|T)?$")
 
+# Marks errors that only apply at CREATE time (admission strips them on
+# updates so legacy objects that predate the rule stay modifiable).
+DNS1035_CREATE_ONLY_PREFIX = "[create-only] "
+
+
+def waive_create_only(errs: List[str]) -> List[str]:
+    """Drop create-only errors — for validation of objects that already
+    exist (updates in admission; every controller re-validation)."""
+    return [e for e in errs if not e.startswith(DNS1035_CREATE_ONLY_PREFIX)]
+
+
+def surface_create_only(errs: List[str]) -> List[str]:
+    """Strip the internal marker for user-facing create errors."""
+    return [e[len(DNS1035_CREATE_ONLY_PREFIX):]
+            if e.startswith(DNS1035_CREATE_ONLY_PREFIX) else e
+            for e in errs]
+
 
 class ValidationError(ValueError):
     pass
@@ -43,10 +60,20 @@ def validate_metadata(name: str, errs: List[str], max_len: int = 63):
     _check(bool(name), "metadata.name must be set", errs)
     if name:
         _check(len(name) <= max_len, f"metadata.name {name!r} exceeds {max_len} chars", errs)
-        _check(bool(_DNS1035.match(name)),
-               f"metadata.name {name!r} is not a valid DNS-1035 label "
-               "(must start with a letter: derived Service names require it)",
-               errs)
+        if not _DNS1035.match(name):
+            # Two distinguishable failures: a digit-leading but otherwise
+            # valid DNS-1123 name only breaks *derived Service* creation,
+            # so admission relaxes it on UPDATE (a pre-existing legacy
+            # object must stay mutable — see validate_admission); any
+            # other shape violation is unconditionally fatal.
+            if _DNS1123.match(name):
+                errs.append(DNS1035_CREATE_ONLY_PREFIX +
+                            f"metadata.name {name!r} must start with a "
+                            "letter (derived Service names require "
+                            "DNS-1035)")
+            else:
+                errs.append(f"metadata.name {name!r} is not a valid "
+                            "DNS-1123 label")
 
 
 def _container_env(template) -> dict:

@@ -110,8 +110,12 @@ def test_route_scoping(stack):
 
 
 def test_events_selector_pinned():
-    """The proxied events route must carry the tpu.dev fieldSelector
-    regardless of what the client asked for (withFieldSelector role)."""
+    """The proxied events routes must carry the tpu.dev fieldSelector
+    regardless of what the client asked for (withFieldSelector role) —
+    with the field label each API group actually defines: core v1 Events
+    support involvedObject.*, events.k8s.io/v1 Events support
+    regarding.* (a regarding selector on the core path 400s against a
+    real apiserver)."""
     seen = {}
 
     class Upstream(BaseHTTPRequestHandler):
@@ -132,9 +136,86 @@ def test_events_selector_pinned():
         proxy = ReverseProxy(f"http://127.0.0.1:{up.server_port}")
         srv, px = serve_background(proxy)
         _req(px, "/api/v1/namespaces/default/events"
+                 "?fieldSelector=involvedObject.kind=Pod")
+        assert "involvedObject.apiVersion%3Dtpu.dev%2Fv1" in seen["path"] \
+            or "involvedObject.apiVersion=tpu.dev%2Fv1" in seen["path"], \
+            seen
+        _req(px, "/apis/events.k8s.io/v1/namespaces/default/events"
                  "?fieldSelector=regarding.kind=Pod")
         assert "regarding.apiVersion%3Dtpu.dev%2Fv1" in seen["path"] or \
             "regarding.apiVersion=tpu.dev%2Fv1" in seen["path"], seen
+        srv.shutdown()
+    finally:
+        up.shutdown()
+
+
+def test_dot_segment_traversal_refused(stack):
+    """A path that normalizes OUT of the tpu.dev scope must 404 before
+    touching the upstream (Go's ServeMux cleans paths; urllib does not,
+    so the proxy normalizes explicitly)."""
+    _, px = stack
+    _req(px, "/apis/tpu.dev/v1/../../api/v1/namespaces/kube-system/"
+             "secrets", expect=404)
+    _req(px, "/apis/tpu.dev/v1/%2e%2e/%2e%2e/api/v1/namespaces/"
+             "kube-system/secrets", expect=404)
+    # Normalization is not over-eager: an in-scope path with a redundant
+    # dot segment still works.
+    lst = _req(px, "/apis/tpu.dev/v1/namespaces/./default/tpuclusters")
+    assert lst["items"] == []
+
+
+def test_bodyless_status_no_chunked_framing():
+    """204/304 upstream responses must pass through without a body or
+    Transfer-Encoding (RFC 7230 §3.3); 200s with Content-Length keep
+    plain framing."""
+    class Upstream(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_DELETE(self):
+            self.send_response(204)
+            self.end_headers()
+
+        def do_GET(self):
+            data = b'{"kind":"TpuClusterList","items":[]}'
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+    up = ThreadingHTTPServer(("127.0.0.1", 0), Upstream)
+    threading.Thread(target=up.serve_forever, daemon=True).start()
+    try:
+        proxy = ReverseProxy(f"http://127.0.0.1:{up.server_port}")
+        srv, px = serve_background(proxy)
+        # Raw socket so we can see the exact framing on the wire.
+        import socket
+        host, port = srv.server_address
+
+        def raw(method, path):
+            s = socket.create_connection((host, port), timeout=10)
+            s.sendall(f"{method} {path} HTTP/1.1\r\nHost: x\r\n"
+                      f"Connection: close\r\n\r\n".encode())
+            out = b""
+            while True:
+                b = s.recv(65536)
+                if not b:
+                    break
+                out += b
+            s.close()
+            return out
+
+        resp = raw("DELETE", BASE + "/x")
+        head = resp.split(b"\r\n\r\n", 1)[0].lower()
+        assert b"204" in resp.split(b"\r\n", 1)[0]
+        assert b"transfer-encoding" not in head, resp
+        assert resp.split(b"\r\n\r\n", 1)[1] == b"", resp
+
+        resp = raw("GET", BASE)
+        head, body = resp.split(b"\r\n\r\n", 1)
+        assert b"content-length" in head.lower(), resp
+        assert b"transfer-encoding" not in head.lower(), resp
+        assert json.loads(body)["kind"] == "TpuClusterList"
         srv.shutdown()
     finally:
         up.shutdown()

@@ -86,6 +86,13 @@ def test_json_patch_escapes_and_errors():
         [{"op": "add", "path": "/list/9", "value": 1}],
         [{"op": "nope", "path": "/a"}],
         {"op": "not-a-list"},
+        # Malformed ops must raise PatchError (-> 400/422 at the API),
+        # never raw ValueError/IndexError (-> 500).
+        [{"op": "move", "path": "/a"}],                  # missing 'from'
+        [{"op": "copy", "path": "/a"}],                  # missing 'from'
+        [{"op": "move", "path": "/a", "from": ""}],      # whole-doc move
+        [{"op": "add", "path": "/list/x", "value": 1}],  # non-numeric idx
+        [{"op": "remove", "path": "/list/x"}],
     ):
         with pytest.raises(PatchError):
             json_patch({"a": 1, "list": []}, bad)

@@ -41,12 +41,30 @@ def test_valid_cluster_passes():
 def test_bad_metadata_name():
     c = make_cluster(name="Bad_Name!")
     errs = validate_cluster(c)
-    assert any("DNS-1035" in e for e in errs)
+    assert any("DNS-1123" in e for e in errs)
     c2 = make_cluster(name="")
     assert any("must be set" in e for e in validate_cluster(c2))
-    # DNS-1035: digit-leading names break derived Service names.
+    # DNS-1035: digit-leading names break derived Service names — but
+    # only at CREATE time (legacy objects must stay modifiable), so the
+    # error carries the create-only marker that admission interprets.
     c3 = make_cluster(name="9cluster")
-    assert any("DNS-1035" in e for e in validate_cluster(c3))
+    errs3 = validate_cluster(c3)
+    assert any("DNS-1035" in e for e in errs3)
+    from kuberay_tpu.utils.validation import waive_create_only
+    assert waive_create_only(errs3) == []
+
+
+def test_dns1035_create_only_in_admission():
+    """A digit-leading name is refused on create but an EXISTING object
+    with such a name stays modifiable (updates re-run admission)."""
+    from kuberay_tpu.controlplane.webhooks import validate_admission
+    doc = make_cluster(name="9legacy").to_dict()
+    create_errs = validate_admission(doc, None)
+    assert any("DNS-1035" in e for e in create_errs)
+    assert not any(e.startswith("[create-only]") for e in create_errs)
+    updated = make_cluster(name="9legacy").to_dict()
+    updated["spec"]["suspend"] = True
+    assert validate_admission(updated, doc) == []
 
 
 def test_duplicate_group_names():
