@@ -43,7 +43,8 @@ from kuberay_tpu.builders.service import (
 )
 from kuberay_tpu.controlplane.events import EventRecorder
 from kuberay_tpu.controlplane.expectations import HEAD_GROUP, ScaleExpectations
-from kuberay_tpu.controlplane.store import AlreadyExists, NotFound, ObjectStore
+from kuberay_tpu.controlplane.store import (AlreadyExists, NotFound,
+                                             ObjectStore, carry_rv)
 from kuberay_tpu.utils import constants as C
 from kuberay_tpu.utils.names import head_service_name, spec_hash
 from kuberay_tpu.utils.validation import (
@@ -485,7 +486,9 @@ class TpuClusterController:
                 g["scaleStrategy"] = ss
                 changed = True
         if changed:
-            obj["metadata"].pop("resourceVersion", None)
+            # obj carries the rv of the fresh read above — a concurrent
+            # writer between that read and this update 409s and requeues
+            # (optimistic concurrency, SURVEY §5.2).
             self.store.update(obj)
 
     # ------------------------------------------------------------------
@@ -575,10 +578,20 @@ class TpuClusterController:
         new = status.to_dict()
         if self._status_equal(prev, new):
             return
+        # Fresh read immediately before the write: our own mid-reconcile
+        # metadata writes (finalizer add, victim clearing) must not
+        # self-conflict, while a FOREIGN write in the read→write window
+        # — the leader-failover overlap — must 409 and requeue rather
+        # than silently clobber the new leader's status (optimistic
+        # concurrency via resourceVersion, SURVEY §5.2; the old
+        # single-writer assumption is gone).
+        cur = self.store.try_get(self.KIND, cluster.metadata.name,
+                                 cluster.metadata.namespace)
+        if cur is None:
+            return
         obj = cluster.to_dict()
         obj["status"] = new
-        obj["metadata"].pop("resourceVersion", None)
-        self.store.update_status(obj)
+        self.store.update_status(carry_rv(obj, cur))
 
     def _set_status(self, cluster: TpuCluster, state: str, reason: str = ""):
         obj = cluster.to_dict()
@@ -587,8 +600,11 @@ class TpuClusterController:
             return
         st["state"] = state
         st["reason"] = reason
-        obj["metadata"].pop("resourceVersion", None)
-        self.store.update_status(obj)
+        cur = self.store.try_get(self.KIND, cluster.metadata.name,
+                                 cluster.metadata.namespace)
+        if cur is None:
+            return
+        self.store.update_status(carry_rv(obj, cur))
 
     @staticmethod
     def _status_equal(a: Dict[str, Any], b: Dict[str, Any]) -> bool:

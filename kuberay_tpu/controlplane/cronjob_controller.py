@@ -14,7 +14,8 @@ from kuberay_tpu.api.tpucronjob import ConcurrencyPolicy, TpuCronJob
 from kuberay_tpu.api.tpujob import JobDeploymentStatus
 from kuberay_tpu.builders.common import owner_reference
 from kuberay_tpu.controlplane.events import EventRecorder
-from kuberay_tpu.controlplane.store import AlreadyExists, NotFound, ObjectStore
+from kuberay_tpu.controlplane.store import (AlreadyExists, NotFound,
+                                             ObjectStore, carry_rv)
 from kuberay_tpu.utils import constants as C
 from kuberay_tpu.utils import features
 from kuberay_tpu.utils.cron import missed_runs, next_run_after
@@ -162,4 +163,6 @@ class TpuCronJobController:
         cur = self.store.try_get(self.KIND, cron.metadata.name,
                                  cron.metadata.namespace)
         if cur is not None and cur.get("status") != obj.get("status"):
-            self.store.update_status(obj)
+            # rv precondition from the pre-write read: a foreign write
+            # in the window 409s and requeues (SURVEY §5.2).
+            self.store.update_status(carry_rv(obj, cur))

@@ -36,7 +36,8 @@ from kuberay_tpu.builders.job import (
     build_submitter_job,
 )
 from kuberay_tpu.controlplane.events import EventRecorder
-from kuberay_tpu.controlplane.store import AlreadyExists, NotFound, ObjectStore
+from kuberay_tpu.controlplane.store import (AlreadyExists, NotFound,
+                                             ObjectStore, carry_rv)
 from kuberay_tpu.runtime.coordinator_client import CoordinatorError
 from kuberay_tpu.utils import constants as C
 from kuberay_tpu.utils.names import (
@@ -493,10 +494,11 @@ class TpuJobController:
 
     def _update(self, job: TpuJob):
         obj = job.to_dict()
-        # Single-writer status: our own finalizer/metadata writes earlier in
-        # the same pass must not conflict with this status write.
-        obj["metadata"].pop("resourceVersion", None)
+        # Fresh rv from the pre-write read: our own finalizer/metadata
+        # writes earlier in the pass can't self-conflict, but a foreign
+        # write in the read→write window (leader-failover overlap) 409s
+        # and requeues instead of clobbering (SURVEY §5.2).
         cur = self.store.try_get(self.KIND, job.metadata.name,
                                  job.metadata.namespace)
         if cur is not None and cur.get("status") != obj.get("status"):
-            self.store.update_status(obj)
+            self.store.update_status(carry_rv(obj, cur))

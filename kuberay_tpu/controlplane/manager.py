@@ -14,15 +14,18 @@ Two execution modes:
 from __future__ import annotations
 
 import heapq
+import logging
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from kuberay_tpu.controlplane.expectations import HEAD_GROUP, ScaleExpectations
-from kuberay_tpu.controlplane.store import Event, ObjectStore
+from kuberay_tpu.controlplane.store import Conflict, Event, ObjectStore
 from kuberay_tpu.utils import constants as C
 
 Key = Tuple[str, str, str]  # (kind, namespace, name)
+
+_LOG = logging.getLogger("kuberay_tpu.manager")
 
 
 class Manager:
@@ -120,9 +123,16 @@ class Manager:
             return
         try:
             requeue = fn(name, ns)
+        except Conflict as e:
+            # Optimistic-concurrency loss (another writer won the rv
+            # race, e.g. leader-failover overlap): routine, not an
+            # error — requeue fast so the reconciler re-reads and
+            # recomputes from fresh state (SURVEY §5.2).
+            _LOG.debug("reconcile %s %s/%s conflicted, requeueing: %s",
+                       kind, ns, name, e)
+            requeue = 0.05
         except Exception as e:   # reconcile errors requeue with backoff
-            import logging
-            logging.getLogger("kuberay_tpu.manager").exception(
+            _LOG.exception(
                 "reconcile %s %s/%s failed: %s", kind, ns, name, e)
             requeue = 5.0
         if requeue:

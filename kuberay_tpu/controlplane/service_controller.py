@@ -32,7 +32,8 @@ from kuberay_tpu.api.tpuservice import (
 from kuberay_tpu.builders.common import attach_cluster_auth, owner_reference
 from kuberay_tpu.builders.service import build_serve_service
 from kuberay_tpu.controlplane.events import EventRecorder
-from kuberay_tpu.controlplane.store import AlreadyExists, NotFound, ObjectStore
+from kuberay_tpu.controlplane.store import (AlreadyExists, NotFound,
+                                             ObjectStore, carry_rv)
 from kuberay_tpu.runtime.coordinator_client import CoordinatorError
 from kuberay_tpu.utils import constants as C
 from kuberay_tpu.utils import features
@@ -573,13 +574,14 @@ class TpuServiceController:
                 if p.get("status", {}).get("phase") == "Running"
                 and p["metadata"]["labels"].get(C.LABEL_SERVE) == "true")
         obj = svc.to_dict()
-        # Status is recomputed idempotently from observed state; drop the
-        # stale resourceVersion so mid-reconcile metadata writes (finalizer
-        # add) don't conflict with our own status write (single-writer).
-        obj["metadata"].pop("resourceVersion", None)
+        # Status is recomputed idempotently from observed state; carry
+        # the rv of the pre-write read so our own mid-reconcile metadata
+        # writes (finalizer add) don't self-conflict while a foreign
+        # write in the read→write window (leader-failover overlap) 409s
+        # and requeues instead of clobbering (SURVEY §5.2).
         cur = self.store.try_get(self.KIND, svc.metadata.name,
                                  svc.metadata.namespace)
         if cur is not None and cur.get("status") != obj.get("status"):
-            self.store.update_status(obj)
+            self.store.update_status(carry_rv(obj, cur))
 
         self.reap_retired_clusters(svc.metadata.namespace)

@@ -42,6 +42,26 @@ class Invalid(StoreError):
     pass
 
 
+def carry_rv(obj: Dict[str, Any], cur: Dict[str, Any]) -> Dict[str, Any]:
+    """Stamp ``obj`` with ``cur``'s resourceVersion so the write carries
+    an optimistic-concurrency precondition (SURVEY §5.2): a foreign
+    write between the ``cur`` read and the update raises Conflict and
+    the reconciler requeues instead of clobbering.
+
+    Loud on a store that omits rv — a missing precondition would
+    silently revert to last-writer-wins, which is exactly the bug class
+    this helper exists to prevent.
+    """
+    rv = cur.get("metadata", {}).get("resourceVersion")
+    if not rv:
+        raise StoreError(
+            f"{cur.get('kind')} {cur.get('metadata', {}).get('name')}: "
+            "store returned no resourceVersion; refusing an unguarded "
+            "status write")
+    obj["metadata"]["resourceVersion"] = rv
+    return obj
+
+
 def _key(kind: str, namespace: str, name: str) -> Tuple[str, str, str]:
     return (kind, namespace, name)
 

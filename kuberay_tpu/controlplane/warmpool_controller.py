@@ -26,7 +26,8 @@ from kuberay_tpu.api.tpucluster import TpuCluster, TpuClusterSpec, WorkerGroupSp
 from kuberay_tpu.builders.common import owner_reference
 from kuberay_tpu.builders.pod import build_slice_pods
 from kuberay_tpu.controlplane.events import EventRecorder
-from kuberay_tpu.controlplane.store import AlreadyExists, NotFound, ObjectStore
+from kuberay_tpu.controlplane.store import (AlreadyExists, NotFound,
+                                             ObjectStore, carry_rv)
 from kuberay_tpu.topology import TopologyError
 from kuberay_tpu.utils import constants as C
 from kuberay_tpu.utils import features
@@ -167,8 +168,12 @@ class WarmSlicePoolController:
                   "readySlices": ready, "hostsPerSlice": hosts}
         if obj.get("status") != status:
             obj["status"] = status
-            obj["metadata"].pop("resourceVersion", None)
-            self.store.update_status(obj)
+            cur = self.store.try_get(self.KIND, name, namespace)
+            if cur is None:
+                return None
+            # rv precondition: a foreign write (leader-failover overlap)
+            # 409s and requeues instead of clobbering (SURVEY §5.2).
+            self.store.update_status(carry_rv(obj, cur))
         return None
 
     def claim(self, name: str, namespace: str = "default") -> Optional[List[str]]:

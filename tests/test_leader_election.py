@@ -91,3 +91,70 @@ def test_two_operators_one_reconciles():
     assert wait_for(lambda: store.get(C.KIND_CLUSTER, "led2").get(
         "status", {}).get("state") == "ready", timeout=20.0)
     op2.stop()
+
+
+def test_failover_overlap_status_write_409s():
+    """The old leader's DELAYED status write must 409, not clobber the
+    new leader's status (optimistic concurrency via resourceVersion —
+    SURVEY §5.2; the controllers no longer strip rv before status
+    writes)."""
+    import copy
+
+    from kuberay_tpu.controlplane.cluster_controller import (
+        TpuClusterController,
+    )
+    from kuberay_tpu.controlplane.fake_kubelet import FakeKubelet
+    from kuberay_tpu.controlplane.manager import Manager
+    from kuberay_tpu.controlplane.store import Conflict
+
+    store = ObjectStore()
+    store.create(make_cluster("ov").to_dict())
+    # The OLD leader read the object here, then paused (GC/network):
+    # everything it does from now on is based on this snapshot.
+    snapshot = store.get(C.KIND_CLUSTER, "ov")
+
+    class PausedLeaderStore:
+        """Delegates to the live store but serves the pre-failover
+        snapshot for the cluster read — exactly what the paused old
+        leader holds in memory when it resumes."""
+
+        def __init__(self, real, snap):
+            self._real, self._snap = real, snap
+
+        def try_get(self, kind, name, namespace="default"):
+            if kind == C.KIND_CLUSTER and name == "ov":
+                return copy.deepcopy(self._snap)
+            return self._real.try_get(kind, name, namespace)
+
+        def __getattr__(self, attr):
+            return getattr(self._real, attr)
+
+    # Meanwhile the NEW leader reconciles and writes status (rv moves).
+    mgr = Manager(store)
+    new_leader = TpuClusterController(store,
+                                      expectations=mgr.expectations)
+    new_leader.reconcile("ov")
+    FakeKubelet(store).step()
+    new_leader.reconcile("ov")
+    after_failover = store.get(C.KIND_CLUSTER, "ov")
+    assert after_failover["metadata"]["resourceVersion"] != \
+        snapshot["metadata"]["resourceVersion"]
+    assert after_failover["status"].get("state") is not None
+
+    # Old leader resumes: its status write carries the stale rv → 409.
+    old_leader = TpuClusterController(PausedLeaderStore(store, snapshot),
+                                      expectations=mgr.expectations)
+    with pytest.raises(Conflict):
+        old_leader.reconcile("ov")
+    # The new leader's status survived untouched.
+    assert store.get(C.KIND_CLUSTER, "ov")["status"] == \
+        after_failover["status"]
+
+    # Through the manager the conflict is routine: swallowed, fast
+    # requeue (re-read + recompute), not an error-backoff.
+    mgr2 = Manager(store)
+    mgr2.register(C.KIND_CLUSTER, old_leader.reconcile)
+    key = (C.KIND_CLUSTER, "default", "ov")
+    mgr2.enqueue(key)
+    mgr2.run_until_idle()
+    assert any(k == key for _, k in mgr2._delayed)
