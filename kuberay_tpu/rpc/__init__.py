@@ -1,0 +1,9 @@
+"""gRPC V1 surface: the versioned, typed RPC contract over the resource
+layer (ref proto/*.proto + apiserver/cmd/main.go:97-147 gRPC services).
+
+- ``schema``: loads the checked-in FileDescriptorSet (schema.binpb) and
+  exposes message classes + dict<->message converters;
+- ``server``: grpc server mapping the five services onto an ObjectStore
+  (admission validation included — same gate as the REST front door);
+- ``client``: typed client wrapper over a grpc channel.
+"""

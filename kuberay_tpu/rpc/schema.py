@@ -1,0 +1,214 @@
+"""Runtime schema for the tpu.v1 RPC contract.
+
+Loads the serialized FileDescriptorSet that scripts/gen_proto.py emitted
+from the checked-in ``proto/tpu/v1/api.proto`` and materializes message
+classes from it via the descriptor pool — no generated ``*_pb2.py``
+gencode, so the contract file is the single artifact and the protobuf
+runtime can move independently (grpc_tools is not available in this
+image; protoc + the runtime pool are).
+
+Also provides the dict<->message bridge the server and client share.
+json_format is deliberately NOT used: its proto3-JSON mapping renders
+int64 as strings and drops/renames in ways that would diverge from the
+K8s-style dicts the resource layer speaks.  The converters here follow
+the same convention as ``Serializable.to_dict`` (kuberay_tpu/api/common):
+scalars always included, empty containers and unset message/optional
+fields pruned.
+"""
+
+from __future__ import annotations
+
+import pathlib
+from typing import Any, Dict
+
+from google.protobuf import descriptor_pb2, descriptor_pool, message_factory
+from google.protobuf.descriptor import FieldDescriptor as FD
+
+_BINPB = pathlib.Path(__file__).resolve().parent / "schema.binpb"
+
+_pool = descriptor_pool.DescriptorPool()
+_fds = descriptor_pb2.FileDescriptorSet.FromString(_BINPB.read_bytes())
+for _file in _fds.file:
+    _pool.Add(_file)
+
+_STRUCT = "google.protobuf.Struct"
+
+
+def message_class(name: str):
+    """Message class for a tpu.v1 (or well-known) type name."""
+    full = name if "." in name else f"tpu.v1.{name}"
+    return message_factory.GetMessageClass(_pool.FindMessageTypeByName(full))
+
+
+def service_descriptor(name: str):
+    return _pool.FindServiceByName(f"tpu.v1.{name}")
+
+
+# ---------------------------------------------------------------------------
+# dict <-> message
+# ---------------------------------------------------------------------------
+
+def _is_map(field) -> bool:
+    return (field.type == FD.TYPE_MESSAGE
+            and field.message_type.GetOptions().map_entry)
+
+
+def _scalar_to_py(field, value):
+    return value
+
+
+def _py_to_scalar(field, value):
+    if field.cpp_type in (FD.CPPTYPE_INT32, FD.CPPTYPE_INT64,
+                          FD.CPPTYPE_UINT32, FD.CPPTYPE_UINT64):
+        return int(value)          # SimKube/etcd-style string rvs coerce
+    if field.cpp_type == FD.CPPTYPE_DOUBLE or \
+            field.cpp_type == FD.CPPTYPE_FLOAT:
+        return float(value)
+    if field.cpp_type == FD.CPPTYPE_BOOL:
+        return bool(value)
+    if field.cpp_type == FD.CPPTYPE_STRING:
+        return value if isinstance(value, str) else str(value)
+    return value
+
+
+def _struct_to_py(struct_msg) -> Any:
+    """google.protobuf.Struct/Value/ListValue -> plain JSON value."""
+    kind = struct_msg.DESCRIPTOR.full_name
+    if kind == "google.protobuf.Struct":
+        return {k: _struct_to_py(v) for k, v in struct_msg.fields.items()}
+    if kind == "google.protobuf.ListValue":
+        return [_struct_to_py(v) for v in struct_msg.values]
+    # Value
+    which = struct_msg.WhichOneof("kind")
+    if which == "null_value" or which is None:
+        return None
+    if which in ("number_value", "string_value", "bool_value"):
+        v = getattr(struct_msg, which)
+        if which == "number_value" and float(v).is_integer():
+            return int(v)
+        return v
+    return _struct_to_py(getattr(struct_msg, which))
+
+
+def _py_to_struct(struct_msg, value):
+    """Fill a Struct message from a plain dict."""
+    struct_msg.Clear()
+    for k, v in (value or {}).items():
+        _fill_value(struct_msg.fields[k], v)
+
+
+def _fill_value(value_msg, v):
+    if v is None:
+        value_msg.null_value = 0
+    elif isinstance(v, bool):
+        value_msg.bool_value = v
+    elif isinstance(v, (int, float)):
+        value_msg.number_value = float(v)
+    elif isinstance(v, str):
+        value_msg.string_value = v
+    elif isinstance(v, dict):
+        for k, inner in v.items():
+            _fill_value(value_msg.struct_value.fields[k], inner)
+        if not v:
+            value_msg.struct_value.SetInParent()
+    elif isinstance(v, (list, tuple)):
+        value_msg.list_value.SetInParent()
+        for inner in v:
+            _fill_value(value_msg.list_value.values.add(), inner)
+    else:
+        value_msg.string_value = str(v)
+
+
+def message_to_dict(msg) -> Dict[str, Any]:
+    """K8s-dict convention: scalars always present, empty containers and
+    unset message/optional fields pruned (mirrors Serializable.to_dict)."""
+    out: Dict[str, Any] = {}
+    for field in msg.DESCRIPTOR.fields:
+        if _is_map(field):
+            m = getattr(msg, field.name)
+            if m:
+                vf = field.message_type.fields_by_name["value"]
+                if vf.type == FD.TYPE_MESSAGE:
+                    out[field.name] = {k: message_to_dict(v)
+                                       for k, v in m.items()}
+                else:
+                    out[field.name] = dict(m)
+            continue
+        if field.is_repeated:
+            seq = getattr(msg, field.name)
+            if not seq:
+                continue
+            if field.type == FD.TYPE_MESSAGE:
+                if field.message_type.full_name == _STRUCT:
+                    out[field.name] = [_struct_to_py(v) for v in seq]
+                else:
+                    out[field.name] = [message_to_dict(v) for v in seq]
+            else:
+                out[field.name] = list(seq)
+            continue
+        if field.type == FD.TYPE_MESSAGE:
+            if not msg.HasField(field.name):
+                continue
+            sub = getattr(msg, field.name)
+            if field.message_type.full_name == _STRUCT:
+                out[field.name] = _struct_to_py(sub)
+            else:
+                out[field.name] = message_to_dict(sub)
+            continue
+        if field.has_presence and not msg.HasField(field.name):
+            continue
+        out[field.name] = _scalar_to_py(field, getattr(msg, field.name))
+    return out
+
+
+def dict_to_message(d: Dict[str, Any], msg, *,
+                    ignore_unknown: bool = False) -> Any:
+    """Fill ``msg`` (instance or tpu.v1 type name) from a K8s-style
+    dict.  Unknown keys raise ValueError by default — the typed contract
+    is the point; a silently-dropped field is a wire bug waiting to be
+    found the hard way (this is what caught the reference-SDK numSlices
+    drop in round 2).  ``ignore_unknown=True`` is for the server's
+    RESPONSE direction only: store objects can carry metadata the
+    contract does not model (e.g. SSA managedFields), and a read must
+    not 500 on them."""
+    if isinstance(msg, str):
+        msg = message_class(msg)()
+    fields = msg.DESCRIPTOR.fields_by_name
+    for key, value in (d or {}).items():
+        field = fields.get(key)
+        if field is None:
+            if ignore_unknown:
+                continue
+            raise ValueError(
+                f"unknown field {key!r} for {msg.DESCRIPTOR.full_name}")
+        if value is None:
+            continue
+        if _is_map(field):
+            vf = field.message_type.fields_by_name["value"]
+            target = getattr(msg, field.name)
+            for k, v in value.items():
+                target[str(k)] = _py_to_scalar(vf, v)
+            continue
+        if field.is_repeated:
+            target = getattr(msg, field.name)
+            for item in value:
+                if field.type == FD.TYPE_MESSAGE:
+                    sub = target.add()
+                    if field.message_type.full_name == _STRUCT:
+                        _py_to_struct(sub, item)
+                    else:
+                        dict_to_message(item, sub,
+                                        ignore_unknown=ignore_unknown)
+                else:
+                    target.append(_py_to_scalar(field, item))
+            continue
+        if field.type == FD.TYPE_MESSAGE:
+            sub = getattr(msg, field.name)
+            if field.message_type.full_name == _STRUCT:
+                _py_to_struct(sub, value)
+                sub.SetInParent()
+            else:
+                dict_to_message(value, sub, ignore_unknown=ignore_unknown)
+            continue
+        setattr(msg, field.name, _py_to_scalar(field, value))
+    return msg

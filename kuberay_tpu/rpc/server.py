@@ -1,0 +1,288 @@
+"""gRPC V1 server: the typed front door over the resource layer
+(ref apiserver/cmd/main.go:97-147 — ClusterServiceServer,
+RayJobServiceServer, RayServeServiceServer registrations; here the five
+tpu.v1 services map onto an ObjectStore, local or REST-backed).
+
+Built with generic handlers resolved from the checked-in descriptor set
+(kuberay_tpu/rpc/schema.py), so there is no generated service gencode to
+drift from the contract.  Behavior parity with the REST front door:
+
+- admission validation runs on create/update (same
+  ``validate_admission`` gate — one validation surface, three front
+  doors now: REST, webhook, gRPC);
+- store errors map onto canonical gRPC codes (NotFound -> NOT_FOUND,
+  AlreadyExists -> ALREADY_EXISTS, Invalid -> INVALID_ARGUMENT,
+  Conflict -> ABORTED, like the reference's grpc-gateway mapping);
+- optional bearer-token auth via call metadata (``authorization: Bearer
+  <token>``), mirroring the REST server's token gate.
+
+Pagination: ``limit``/``continue_token`` slice a name-sorted listing;
+the token is the opaque offset of the next page.
+
+    python -m kuberay_tpu.rpc.server --port 8770 [--token-file ...]
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent import futures
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import grpc
+
+from kuberay_tpu.controlplane.store import (AlreadyExists, Conflict,
+                                            Invalid, NotFound, ObjectStore)
+from kuberay_tpu.controlplane.webhooks import validate_admission
+from kuberay_tpu.api.computetemplate import ComputeTemplate
+from kuberay_tpu.api.tpucluster import TpuCluster
+from kuberay_tpu.api.tpucronjob import TpuCronJob
+from kuberay_tpu.api.tpujob import TpuJob
+from kuberay_tpu.api.tpuservice import TpuService
+from kuberay_tpu.rpc import schema
+from kuberay_tpu.utils import constants as C
+
+# (service, rpc-prefix, request field, kind, apiVersion)
+_SURFACES = (
+    ("TpuClusterService", "Cluster", "cluster", C.KIND_CLUSTER),
+    ("TpuJobService", "Job", "job", C.KIND_JOB),
+    ("TpuServeService", "Service", "service", C.KIND_SERVICE),
+    ("TpuCronJobService", "CronJob", "cronjob", C.KIND_CRONJOB),
+    ("ComputeTemplateService", "ComputeTemplate", "template",
+     "ComputeTemplate"),
+)
+
+_KIND_MSG = {
+    C.KIND_CLUSTER: "TpuCluster",
+    C.KIND_JOB: "TpuJob",
+    C.KIND_SERVICE: "TpuService",
+    C.KIND_CRONJOB: "TpuCronJob",
+    "ComputeTemplate": "ComputeTemplate",
+}
+
+_KIND_CLS = {
+    C.KIND_CLUSTER: TpuCluster,
+    C.KIND_JOB: TpuJob,
+    C.KIND_SERVICE: TpuService,
+    C.KIND_CRONJOB: TpuCronJob,
+    "ComputeTemplate": ComputeTemplate,
+}
+
+
+def _abort(context, exc):
+    if isinstance(exc, NotFound):
+        context.abort(grpc.StatusCode.NOT_FOUND, str(exc))
+    if isinstance(exc, AlreadyExists):
+        context.abort(grpc.StatusCode.ALREADY_EXISTS, str(exc))
+    if isinstance(exc, Invalid) or isinstance(exc, ValueError):
+        context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
+    if isinstance(exc, Conflict):
+        context.abort(grpc.StatusCode.ABORTED, str(exc))
+    raise exc
+
+
+class _KindService:
+    """The six verb implementations for one kind."""
+
+    def __init__(self, store: ObjectStore, kind: str, field: str):
+        self.store = store
+        self.kind = kind
+        self.field = field
+        self.msg_name = _KIND_MSG[kind]
+
+    # -- helpers --------------------------------------------------------
+
+    def _to_msg(self, obj: Dict[str, Any]):
+        # Responses: store objects can carry metadata outside the typed
+        # contract (SSA managedFields) — skip, never 500.  SSA-aware
+        # clients use the REST front door.
+        return schema.dict_to_message(obj, self.msg_name,
+                                      ignore_unknown=True)
+
+    def _obj_from_req(self, request, context) -> Dict[str, Any]:
+        if not request.HasField(self.field):
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          f"request.{self.field} must be set")
+        obj = schema.message_to_dict(getattr(request, self.field))
+        obj.setdefault("apiVersion", C.API_VERSION)
+        obj["kind"] = self.kind
+        md = obj.setdefault("metadata", {})
+        if request.namespace:
+            md["namespace"] = request.namespace
+        md.setdefault("namespace", "default")
+        # Canonicalize through the typed layer: defaults filled, empties
+        # pruned — exactly the shape the REST path stores.  Without this
+        # a get->update round trip densifies the spec and spuriously
+        # bumps metadata.generation (store compares spec dicts).
+        obj = _KIND_CLS[self.kind].from_dict(obj).to_dict()
+        return obj
+
+    # -- verbs ----------------------------------------------------------
+
+    def create(self, request, context):
+        obj = self._obj_from_req(request, context)
+        errs = validate_admission(obj, None)
+        if errs:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, "; ".join(errs))
+        try:
+            return self._to_msg(self.store.create(obj))
+        except Exception as e:  # noqa: BLE001 — mapped to status codes
+            _abort(context, e)
+
+    def get(self, request, context):
+        try:
+            return self._to_msg(self.store.get(
+                self.kind, request.name, request.namespace or "default"))
+        except Exception as e:  # noqa: BLE001
+            _abort(context, e)
+
+    def update(self, request, context):
+        obj = self._obj_from_req(request, context)
+        old = self.store.try_get(self.kind, obj["metadata"].get("name", ""),
+                                 obj["metadata"]["namespace"])
+        errs = validate_admission(obj, old)
+        if errs:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, "; ".join(errs))
+        try:
+            return self._to_msg(self.store.update(obj))
+        except Exception as e:  # noqa: BLE001
+            _abort(context, e)
+
+    def delete(self, request, context):
+        resp = schema.message_class("DeleteResponse")()
+        try:
+            self.store.delete(self.kind, request.name,
+                              request.namespace or "default")
+        except Exception as e:  # noqa: BLE001
+            _abort(context, e)
+        resp.deleted = True
+        return resp
+
+    def _list(self, request, context, namespace: Optional[str]):
+        items: List[Dict[str, Any]] = sorted(
+            self.store.list(self.kind, namespace),
+            key=lambda o: (o["metadata"].get("namespace", ""),
+                           o["metadata"].get("name", "")))
+        if request.limit < 0:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          "limit must be >= 0")
+        start = 0
+        if request.continue_token:
+            try:
+                start = int(request.continue_token)
+            except ValueError:
+                start = -1
+            if start < 0:
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                              "bad continue_token")
+        end = start + request.limit if request.limit else len(items)
+        return items[start:end], (str(end) if end < len(items) else "")
+
+
+class RpcServer:
+    """Five services over one store; grpc.server lifecycle wrapper."""
+
+    def __init__(self, store: ObjectStore, token: str = ""):
+        self.store = store
+        self.token = token
+
+    # -- handler construction -------------------------------------------
+
+    def _handlers(self):
+        out = []
+        for svc_name, rpc_suffix, field, kind in _SURFACES:
+            svc = _KindService(self.store, kind, field)
+            sd = schema.service_descriptor(svc_name)
+            method_impls: Dict[str, Tuple[Callable, Any, Any]] = {}
+            for m in sd.methods:
+                req_cls = schema.message_class(m.input_type.full_name)
+                out_cls = schema.message_class(m.output_type.full_name)
+                fn = self._bind(svc, m.name, rpc_suffix, out_cls)
+                method_impls[m.name] = grpc.unary_unary_rpc_method_handler(
+                    fn, request_deserializer=req_cls.FromString,
+                    response_serializer=lambda msg: msg.SerializeToString())
+            out.append(grpc.method_handlers_generic_handler(
+                f"tpu.v1.{svc_name}", method_impls))
+        return out
+
+    def _bind(self, svc: _KindService, method: str, suffix: str, out_cls):
+        def list_fn(namespace_from_req: bool):
+            def fn(request, context):
+                self._authz(context)
+                ns = (request.namespace or "default") \
+                    if namespace_from_req else None
+                items, cont = svc._list(request, context, ns)
+                resp = out_cls()
+                for obj in items:
+                    schema.dict_to_message(obj, resp.items.add())
+                resp.continue_token = cont
+                return resp
+            return fn
+
+        if method == f"List{suffix}s":
+            return list_fn(True)
+        if method == f"ListAll{suffix}s":
+            return list_fn(False)
+        verb = {f"Create{suffix}": svc.create, f"Get{suffix}": svc.get,
+                f"Update{suffix}": svc.update,
+                f"Delete{suffix}": svc.delete}[method]
+
+        def fn(request, context):
+            self._authz(context)
+            return verb(request, context)
+        return fn
+
+    def _authz(self, context):
+        if not self.token:
+            return
+        md = dict(context.invocation_metadata())
+        if md.get("authorization") != f"Bearer {self.token}":
+            context.abort(grpc.StatusCode.UNAUTHENTICATED,
+                          "missing or invalid bearer token")
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self, host: str = "127.0.0.1", port: int = 0,
+              max_workers: int = 16) -> Tuple[grpc.Server, str]:
+        server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers))
+        for h in self._handlers():
+            server.add_generic_rpc_handlers((h,))
+        bound = server.add_insecure_port(f"{host}:{port}")
+        server.start()
+        return server, f"{host}:{bound}"
+
+
+def serve_background(store: ObjectStore, token: str = "",
+                     host: str = "127.0.0.1", port: int = 0):
+    return RpcServer(store, token=token).start(host=host, port=port)
+
+
+def main(argv=None) -> int:  # pragma: no cover - thin process wrapper
+    import argparse
+    ap = argparse.ArgumentParser(prog="tpu-rpc-server")
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=8770)
+    ap.add_argument("--token", default="")
+    ap.add_argument("--token-file", default="")
+    ap.add_argument("--journal", default="",
+                    help="durable journal path for the backing store")
+    args = ap.parse_args(argv)
+    token = args.token
+    if args.token_file:
+        with open(args.token_file) as f:
+            token = f.read().strip()
+    store = ObjectStore(journal_path=args.journal)
+    server, addr = RpcServer(store, token=token).start(
+        host=args.host, port=args.port)
+    print(f"tpu-rpc-server listening on {addr}", flush=True)
+    stop = threading.Event()
+    try:
+        stop.wait()
+    except KeyboardInterrupt:
+        server.stop(grace=2.0)
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    import sys
+    sys.exit(main())

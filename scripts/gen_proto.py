@@ -1,0 +1,294 @@
+#!/usr/bin/env python3
+"""gRPC V1 contract generator (ref proto/cluster.proto, job.proto,
+serve.proto, config.proto — the reference's versioned RPC schema).
+
+The reference hand-maintains ~1.2k LoC of proto that can drift from its
+Go types; here the message schema is GENERATED from the typed API
+dataclasses (kuberay_tpu/api/*) so the RPC contract and the CRD surface
+cannot diverge — one source of truth, enforced by the drift test in
+tests/test_rpc.py that regenerates and compares.
+
+Emits:
+- proto/tpu/v1/api.proto        — the checked-in, human-reviewable IDL
+- kuberay_tpu/rpc/schema.binpb  — serialized FileDescriptorSet (protoc
+  --include_imports) loaded at runtime by kuberay_tpu/rpc/schema.py; no
+  generated *_pb2.py gencode, so the protobuf runtime version can move
+  without regenerating (grpc_tools is not in this image).
+
+Field numbering is dataclass declaration order.  Wire-compat rule for
+contract evolution: append new dataclass fields LAST — inserting or
+reordering renumbers everything after, which the drift test surfaces as
+a diff on the checked-in .proto for the reviewer to reject.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import pathlib
+import subprocess
+import sys
+import typing
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from kuberay_tpu.api.computetemplate import ComputeTemplate  # noqa: E402
+from kuberay_tpu.api.tpucluster import TpuCluster  # noqa: E402
+from kuberay_tpu.api.tpucronjob import TpuCronJob  # noqa: E402
+from kuberay_tpu.api.tpujob import TpuJob  # noqa: E402
+from kuberay_tpu.api.tpuservice import TpuService  # noqa: E402
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+PROTO_DIR = REPO / "proto" / "tpu" / "v1"
+BINPB = REPO / "kuberay_tpu" / "rpc" / "schema.binpb"
+
+ROOTS = (TpuCluster, TpuJob, TpuService, TpuCronJob, ComputeTemplate)
+
+HEADER = '''\
+// GENERATED from kuberay_tpu/api dataclasses by scripts/gen_proto.py.
+// Do not edit by hand — regenerate and review the diff instead.
+//
+// This is the versioned V1 RPC contract (ref proto/cluster.proto,
+// job.proto, serve.proto): message schema mirrors the tpu.dev/v1 CRD
+// types exactly; services are the typed front door the reference's
+// apiserver exposes over gRPC (cmd/main.go:97-147).
+syntax = "proto3";
+
+package tpu.v1;
+
+import "google/protobuf/struct.proto";
+
+'''
+
+SERVICES = '''\
+// ---- request/response envelopes -------------------------------------------
+
+message GetRequest {
+  string name = 1;
+  string namespace = 2;
+}
+
+message DeleteRequest {
+  string name = 1;
+  string namespace = 2;
+}
+
+// Status echoed for deletes (the reference returns google.protobuf.Empty;
+// a typed acknowledgement survives gateway mapping better).
+message DeleteResponse {
+  bool deleted = 1;
+}
+
+message ListRequest {
+  string namespace = 1;        // ignored by ListAll* RPCs
+  int64 limit = 2;             // 0 = no bound
+  string continue_token = 3;   // opaque, from a previous page
+}
+
+message CreateClusterRequest { TpuCluster cluster = 1; string namespace = 2; }
+message UpdateClusterRequest { TpuCluster cluster = 1; string namespace = 2; }
+message ListClustersResponse { repeated TpuCluster items = 1; string continue_token = 2; }
+
+message CreateJobRequest { TpuJob job = 1; string namespace = 2; }
+message UpdateJobRequest { TpuJob job = 1; string namespace = 2; }
+message ListJobsResponse { repeated TpuJob items = 1; string continue_token = 2; }
+
+message CreateServiceRequest { TpuService service = 1; string namespace = 2; }
+message UpdateServiceRequest { TpuService service = 1; string namespace = 2; }
+message ListServicesResponse { repeated TpuService items = 1; string continue_token = 2; }
+
+message CreateCronJobRequest { TpuCronJob cronjob = 1; string namespace = 2; }
+message UpdateCronJobRequest { TpuCronJob cronjob = 1; string namespace = 2; }
+message ListCronJobsResponse { repeated TpuCronJob items = 1; string continue_token = 2; }
+
+message CreateComputeTemplateRequest { ComputeTemplate template = 1; string namespace = 2; }
+message ListComputeTemplatesResponse { repeated ComputeTemplate items = 1; string continue_token = 2; }
+
+// ---- services (ref ClusterService / RayJobService / RayServeService) ------
+
+service TpuClusterService {
+  rpc CreateCluster(CreateClusterRequest) returns (TpuCluster);
+  rpc GetCluster(GetRequest) returns (TpuCluster);
+  rpc ListClusters(ListRequest) returns (ListClustersResponse);
+  rpc ListAllClusters(ListRequest) returns (ListClustersResponse);
+  rpc UpdateCluster(UpdateClusterRequest) returns (TpuCluster);
+  rpc DeleteCluster(DeleteRequest) returns (DeleteResponse);
+}
+
+service TpuJobService {
+  rpc CreateJob(CreateJobRequest) returns (TpuJob);
+  rpc GetJob(GetRequest) returns (TpuJob);
+  rpc ListJobs(ListRequest) returns (ListJobsResponse);
+  rpc ListAllJobs(ListRequest) returns (ListJobsResponse);
+  rpc UpdateJob(UpdateJobRequest) returns (TpuJob);
+  rpc DeleteJob(DeleteRequest) returns (DeleteResponse);
+}
+
+service TpuServeService {
+  rpc CreateService(CreateServiceRequest) returns (TpuService);
+  rpc GetService(GetRequest) returns (TpuService);
+  rpc ListServices(ListRequest) returns (ListServicesResponse);
+  rpc ListAllServices(ListRequest) returns (ListServicesResponse);
+  rpc UpdateService(UpdateServiceRequest) returns (TpuService);
+  rpc DeleteService(DeleteRequest) returns (DeleteResponse);
+}
+
+service TpuCronJobService {
+  rpc CreateCronJob(CreateCronJobRequest) returns (TpuCronJob);
+  rpc GetCronJob(GetRequest) returns (TpuCronJob);
+  rpc ListCronJobs(ListRequest) returns (ListCronJobsResponse);
+  rpc ListAllCronJobs(ListRequest) returns (ListCronJobsResponse);
+  rpc UpdateCronJob(UpdateCronJobRequest) returns (TpuCronJob);
+  rpc DeleteCronJob(DeleteRequest) returns (DeleteResponse);
+}
+
+service ComputeTemplateService {
+  rpc CreateComputeTemplate(CreateComputeTemplateRequest) returns (ComputeTemplate);
+  rpc GetComputeTemplate(GetRequest) returns (ComputeTemplate);
+  rpc ListComputeTemplates(ListRequest) returns (ListComputeTemplatesResponse);
+  rpc ListAllComputeTemplates(ListRequest) returns (ListComputeTemplatesResponse);
+  rpc DeleteComputeTemplate(DeleteRequest) returns (DeleteResponse);
+}
+'''
+
+
+def _strip_optional(t):
+    if typing.get_origin(t) is typing.Union:
+        args = [a for a in typing.get_args(t) if a is not type(None)]
+        if len(args) == 1:
+            return args[0], True
+    return t, False
+
+
+def _collect(cls, seen: dict):
+    """Topological collection: dependencies before dependents (proto
+    accepts any order, but stable ordering keeps the diff reviewable)."""
+    if cls.__name__ in seen:
+        return
+    seen[cls.__name__] = None          # mark in-progress (cycle guard)
+    hints = typing.get_type_hints(cls)
+    for f in dataclasses.fields(cls):
+        t, _ = _strip_optional(hints[f.name])
+        origin = typing.get_origin(t)
+        if origin in (list, dict):
+            args = typing.get_args(t)
+            t = args[-1] if args else typing.Any
+            t, _ = _strip_optional(t)
+        if dataclasses.is_dataclass(t):
+            _collect(t, seen)
+    seen[cls.__name__] = cls
+
+
+def _field_type(t) -> str:
+    """Python type -> proto type name."""
+    t, _ = _strip_optional(t)
+    if dataclasses.is_dataclass(t):
+        return t.__name__
+    if t is int:
+        return "int64"
+    if t is float:
+        return "double"
+    if t is bool:
+        return "bool"
+    if t is str or (isinstance(t, type) and issubclass(t, str)):
+        return "string"
+    # Any / object / untyped dict -> open JSON value
+    return "google.protobuf.Struct"
+
+
+def _nonzero_default(f) -> bool:
+    """Proto3 cannot distinguish an omitted scalar from its zero value,
+    so any field whose DATACLASS default is not the proto zero must be
+    presence-tracked (`optional`): an unset field then round-trips to
+    the dataclass default, while an explicit zero (e.g.
+    enableTokenAuth=false, default true) survives the wire."""
+    if f.default is dataclasses.MISSING:
+        return False               # default_factory fields are messages/containers
+    return f.default not in (0, 0.0, False, "", None)
+
+
+def _emit_message(cls) -> str:
+    hints = typing.get_type_hints(cls)
+    lines = [f"message {cls.__name__} {{"]
+    for num, f in enumerate(dataclasses.fields(cls), start=1):
+        t, is_optional = _strip_optional(hints[f.name])
+        is_optional = is_optional or _nonzero_default(f)
+        origin = typing.get_origin(t)
+        if origin is list:
+            inner = typing.get_args(t)[0] if typing.get_args(t) else typing.Any
+            inner, _ = _strip_optional(inner)
+            if typing.get_origin(inner) is dict:
+                pt = "google.protobuf.Struct"
+            else:
+                pt = _field_type(inner)
+            lines.append(f"  repeated {pt} {f.name} = {num};")
+        elif origin is dict:
+            args = typing.get_args(t)
+            vt = _field_type(args[1]) if len(args) == 2 else "google.protobuf.Struct"
+            if vt == "google.protobuf.Struct":
+                # map<string, Struct> is legal but map values of
+                # well-known Struct round-trip awkwardly; an open object
+                # is itself just a Struct.
+                lines.append(f"  google.protobuf.Struct {f.name} = {num};")
+            else:
+                lines.append(f"  map<string, {vt}> {f.name} = {num};")
+        else:
+            pt = _field_type(t)
+            prefix = "optional " if (is_optional and not
+                                     dataclasses.is_dataclass(t)) else ""
+            lines.append(f"  {prefix}{pt} {f.name} = {num};")
+    lines.append("}")
+    return "\n".join(lines)
+
+
+def generate() -> str:
+    seen: dict = {}
+    for root in ROOTS:
+        _collect(root, seen)
+    parts = [HEADER]
+    parts.append("// ---- tpu.dev/v1 kinds (generated from "
+                 "kuberay_tpu/api dataclasses) ----\n")
+    for name, cls in seen.items():
+        parts.append(_emit_message(cls))
+        parts.append("")
+    parts.append(SERVICES)
+    return "\n".join(parts)
+
+
+def _compile(proto_path: pathlib.Path, out: pathlib.Path):
+    subprocess.run(
+        ["protoc", f"-I{REPO / 'proto'}",
+         f"--descriptor_set_out={out}", "--include_imports",
+         str(proto_path)], check=True)
+
+
+def main(check: bool = False) -> int:
+    text = generate()
+    proto_path = PROTO_DIR / "api.proto"
+    if check:
+        # Check mode must not mutate the tree: compile to a temp file
+        # and compare BOTH artifacts — a regenerated api.proto with a
+        # stale schema.binpb would pass a text-only check while the
+        # runtime loads the old contract.
+        import tempfile
+        if not proto_path.exists() or proto_path.read_text() != text:
+            print("proto drift: regenerate with scripts/gen_proto.py")
+            return 1
+        with tempfile.NamedTemporaryFile(suffix=".binpb") as tmp:
+            _compile(proto_path, pathlib.Path(tmp.name))
+            if not BINPB.exists() or \
+                    BINPB.read_bytes() != pathlib.Path(tmp.name).read_bytes():
+                print("schema.binpb drift: regenerate with "
+                      "scripts/gen_proto.py")
+                return 1
+        return 0
+    PROTO_DIR.mkdir(parents=True, exist_ok=True)
+    proto_path.write_text(text)
+    print(f"wrote {proto_path.relative_to(REPO)}")
+    BINPB.parent.mkdir(parents=True, exist_ok=True)
+    _compile(proto_path, BINPB)
+    print(f"wrote {BINPB.relative_to(REPO)}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(check="--check" in sys.argv[1:]))

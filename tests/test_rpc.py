@@ -1,0 +1,275 @@
+"""gRPC V1 surface (ref proto/*.proto + apiserver/cmd/main.go:97-147):
+contract drift, dict<->message fidelity, five services round-tripping
+over a real grpc server, error-code mapping, auth, pagination, and the
+RPC front door driving the real operator."""
+
+import pathlib
+
+import pytest
+
+from kuberay_tpu.controlplane.store import (AlreadyExists, Conflict,
+                                            Invalid, NotFound, ObjectStore,
+                                            StoreError)
+from kuberay_tpu.rpc import schema
+from kuberay_tpu.rpc.client import RpcClient
+from kuberay_tpu.rpc.server import serve_background
+from kuberay_tpu.utils import constants as C
+from tests.test_api_types import make_cluster
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(scope="module")
+def stack():
+    from kuberay_tpu.utils import features
+    features.reset()
+    features.set_gates({"TpuCronJob": True})
+    store = ObjectStore()
+    server, addr = serve_background(store, token="tok")
+    rpc = RpcClient(addr, token="tok")
+    yield store, rpc, addr
+    rpc.close()
+    server.stop(None)
+    features.reset()
+
+
+# ---------------------------------------------------------------------------
+# contract
+# ---------------------------------------------------------------------------
+
+def test_proto_contract_in_sync():
+    """The checked-in IDL must match what the api dataclasses generate —
+    message schema and CRD surface cannot diverge — and the serialized
+    descriptor set must match the IDL (a stale schema.binpb would make
+    the runtime speak an old contract while the text check stays
+    green)."""
+    import subprocess
+    import sys
+    out = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "gen_proto.py"),
+         "--check"], capture_output=True, text=True)
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+def test_descriptor_set_loads_all_services():
+    for name in ("TpuClusterService", "TpuJobService", "TpuServeService",
+                 "TpuCronJobService", "ComputeTemplateService"):
+        sd = schema.service_descriptor(name)
+        assert len(sd.methods) >= 5, name
+
+
+def test_dict_message_round_trip_all_kinds():
+    from kuberay_tpu.api.tpucluster import TpuCluster
+    samples = {
+        "TpuCluster": make_cluster("rt").to_dict(),
+        "TpuJob": {"kind": "TpuJob", "metadata": {"name": "j"},
+                   "spec": {"entrypoint": "python x.py",
+                            "runtimeEnv": {"K": "v"},
+                            "clusterSelector": {"a": "b"},
+                            "backoffLimit": 3}},
+        "TpuService": {"kind": "TpuService", "metadata": {"name": "s"},
+                       "spec": {"serveConfig": {
+                           "applications": [{
+                               "name": "a", "route_prefix": "/",
+                               "deployments": [{"name": "d",
+                                                "num_replicas": 2}]}]}}},
+        "TpuCronJob": {"kind": "TpuCronJob", "metadata": {"name": "c"},
+                       "spec": {"schedule": "*/5 * * * *",
+                                "concurrencyPolicy": "Forbid"}},
+        "ComputeTemplate": {
+            "kind": "ComputeTemplate", "metadata": {"name": "t"},
+            "spec": {"accelerator": "v5p", "topology": "4x4x4",
+                     "tolerations": [{"key": "tpu", "value": 1}]}},
+    }
+    for msg_name, d in samples.items():
+        msg = schema.dict_to_message(d, msg_name)
+        back = schema.message_to_dict(msg)
+        for section in ("spec", "metadata"):
+            for k, v in d.get(section, {}).items():
+                assert back[section][k] == v, (msg_name, section, k)
+    # full typed-layer equivalence on the richest kind
+    d = make_cluster("rt").to_dict()
+    back = schema.message_to_dict(schema.dict_to_message(d, "TpuCluster"))
+    assert TpuCluster.from_dict(back).to_dict() == \
+        TpuCluster.from_dict(d).to_dict()
+
+
+def test_unknown_field_rejected_not_dropped():
+    with pytest.raises(ValueError, match="numSlicez"):
+        schema.dict_to_message(
+            {"spec": {"workerGroupSpecs": [{"numSlicez": 2}]}},
+            "TpuCluster")
+
+
+# ---------------------------------------------------------------------------
+# services over the wire
+# ---------------------------------------------------------------------------
+
+def test_cluster_crud_round_trip(stack):
+    store, rpc, _ = stack
+    created = rpc.clusters.create(make_cluster("crud").to_dict())
+    assert created["metadata"]["uid"]
+    assert store.try_get(C.KIND_CLUSTER, "crud") is not None
+    got = rpc.clusters.get("crud")
+    assert got["metadata"]["uid"] == created["metadata"]["uid"]
+    got["spec"]["suspend"] = True
+    updated = rpc.clusters.update(got)
+    assert updated["spec"]["suspend"] is True
+    assert updated["metadata"]["generation"] > got["metadata"]["generation"]
+    assert rpc.clusters.delete("crud") is True
+    with pytest.raises(NotFound):
+        rpc.clusters.get("crud")
+
+
+def test_all_kind_services(stack):
+    _, rpc, _ = stack
+    job = {"kind": "TpuJob", "metadata": {"name": "rpc-job"},
+           "spec": {"entrypoint": "python t.py",
+                    "clusterSpec": make_cluster("x").to_dict()["spec"]}}
+    assert rpc.jobs.create(job)["metadata"]["name"] == "rpc-job"
+    svc = {"kind": "TpuService", "metadata": {"name": "rpc-svc"},
+           "spec": {"clusterSpec": make_cluster("x").to_dict()["spec"],
+                    "serveConfig": {"applications": [
+                        {"name": "app", "route_prefix": "/"}]}}}
+    assert rpc.services.create(svc)["metadata"]["name"] == "rpc-svc"
+    cron = {"kind": "TpuCronJob", "metadata": {"name": "rpc-cron"},
+            "spec": {"schedule": "0 * * * *",
+                     "jobTemplate": job["spec"]}}
+    assert rpc.cronjobs.create(cron)["metadata"]["name"] == "rpc-cron"
+    tmpl = {"kind": "ComputeTemplate", "metadata": {"name": "rpc-tmpl"},
+            "spec": {"accelerator": "v5e", "topology": "2x2"}}
+    assert rpc.compute_templates.create(tmpl)["metadata"]["name"] == \
+        "rpc-tmpl"
+    for kc, name in ((rpc.jobs, "rpc-job"), (rpc.services, "rpc-svc"),
+                     (rpc.cronjobs, "rpc-cron"),
+                     (rpc.compute_templates, "rpc-tmpl")):
+        assert kc.get(name)["metadata"]["name"] == name
+        assert kc.delete(name) is True
+
+
+def test_admission_validation_on_create_and_update(stack):
+    _, rpc, _ = stack
+    bad = make_cluster("Bad_Name!").to_dict()
+    with pytest.raises(Invalid, match="DNS-1123"):
+        rpc.clusters.create(bad)
+    ok = rpc.clusters.create(make_cluster("adm").to_dict())
+    ok["spec"]["workerGroupSpecs"] = []     # group removal is immutable
+    # removing a worker group in place is refused by update admission
+    with pytest.raises(Invalid, match="cannot be removed"):
+        rpc.clusters.update(ok)
+    rpc.clusters.delete("adm")
+
+
+def test_noop_update_does_not_bump_generation(stack):
+    """A get->update round trip with no changes must be a true no-op:
+    the proto round trip may add/drop default-valued keys, but the
+    server canonicalizes through the typed layer so the store's spec
+    comparison sees identical dicts."""
+    store, rpc, _ = stack
+    rpc.clusters.create(make_cluster("noop").to_dict())
+    got = rpc.clusters.get("noop")
+    gen_before = got["metadata"]["generation"]
+    updated = rpc.clusters.update(got)
+    assert updated["metadata"]["generation"] == gen_before
+    rpc.clusters.delete("noop")
+
+
+def test_ssa_managed_object_readable_over_rpc(stack):
+    """Store objects carry metadata the contract does not model (SSA
+    managedFields); reads must skip it, not 500."""
+    store, rpc, _ = stack
+    rpc.clusters.create(make_cluster("ssa").to_dict())
+    store.patch(C.KIND_CLUSTER, "ssa", "default",
+                {"apiVersion": "tpu.dev/v1", "kind": "TpuCluster",
+                 "metadata": {"name": "ssa", "labels": {"own": "er"}}},
+                patch_type="apply", field_manager="kubectl")
+    got = rpc.clusters.get("ssa")
+    assert got["metadata"]["labels"]["own"] == "er"
+    assert "managedFields" not in got["metadata"]
+    rpc.clusters.delete("ssa")
+
+
+def test_pagination_rejects_negative_inputs(stack):
+    _, rpc, _ = stack
+    with pytest.raises(Invalid, match="limit"):
+        rpc.clusters.list(limit=-1)
+    with pytest.raises(Invalid, match="continue_token"):
+        rpc.clusters.list(limit=2, continue_token="-3")
+    with pytest.raises(StoreError):
+        rpc.compute_templates.update({"metadata": {"name": "x"}})
+
+
+def test_error_mapping(stack):
+    _, rpc, _ = stack
+    rpc.clusters.create(make_cluster("dup").to_dict())
+    with pytest.raises(AlreadyExists):
+        rpc.clusters.create(make_cluster("dup").to_dict())
+    stale = rpc.clusters.get("dup")
+    fresh = rpc.clusters.get("dup")
+    fresh["spec"]["suspend"] = True
+    rpc.clusters.update(fresh)
+    stale["spec"]["suspend"] = False        # write with the stale rv
+    with pytest.raises(Conflict):
+        rpc.clusters.update(stale)
+    rpc.clusters.delete("dup")
+
+
+def test_auth_required(stack):
+    _, _, addr = stack
+    anon = RpcClient(addr)
+    with pytest.raises(StoreError, match="UNAUTHENTICATED"):
+        anon.clusters.list()
+    anon.close()
+
+
+def test_pagination(stack):
+    store, rpc, _ = stack
+    for i in range(7):
+        rpc.clusters.create(make_cluster(f"pg-{i}").to_dict())
+    items, tok = rpc.clusters.list(limit=3)
+    assert [i["metadata"]["name"] for i in items] == \
+        ["pg-0", "pg-1", "pg-2"]
+    assert tok
+    items2, tok2 = rpc.clusters.list(limit=3, continue_token=tok)
+    assert [i["metadata"]["name"] for i in items2] == \
+        ["pg-3", "pg-4", "pg-5"]
+    every = rpc.clusters.list_all_pages(page_size=2)
+    assert len(every) == 7
+    # ListAll spans namespaces
+    other = make_cluster("pg-other").to_dict()
+    other["metadata"]["namespace"] = "blue"
+    rpc.clusters.create(other)
+    all_ns = rpc.clusters.list_all_pages(all_namespaces=True)
+    assert len(all_ns) == 8
+    for o in all_ns:
+        rpc.clusters.delete(o["metadata"]["name"],
+                            o["metadata"].get("namespace", "default"))
+
+
+def test_rpc_front_door_drives_operator(stack):
+    """A cluster created over gRPC reconciles through the REAL
+    controller; its status is visible back through gRPC — the typed
+    surface and the operator share one resource layer."""
+    from kuberay_tpu.controlplane.cluster_controller import (
+        TpuClusterController,
+    )
+    from kuberay_tpu.controlplane.fake_kubelet import FakeKubelet
+    from kuberay_tpu.controlplane.manager import Manager, owned_pod_mapper
+
+    store, rpc, _ = stack
+    mgr = Manager(store)
+    ctrl = TpuClusterController(store, expectations=mgr.expectations)
+    mgr.register(C.KIND_CLUSTER, ctrl.reconcile)
+    mgr.map_owned(owned_pod_mapper)
+    kubelet = FakeKubelet(store)
+    rpc.clusters.create(make_cluster("via-rpc").to_dict())
+    for _ in range(5):
+        mgr.flush_delayed()
+        mgr.run_until_idle()
+        kubelet.step()
+    mgr.flush_delayed()
+    mgr.run_until_idle()
+    got = rpc.clusters.get("via-rpc")
+    assert got["status"]["state"] == "ready"
+    assert got["status"]["readySlices"] >= 1
+    rpc.clusters.delete("via-rpc")
