@@ -224,6 +224,14 @@ def main() -> int:
         ("moe_grouped", [py, "-c", MOE_SNIPPET], 400, None),
         ("xent_chunked", [py, "-c", XENT_SNIPPET], 500, None),
         ("quant_decode", [py, "-c", QUANT_DECODE_SNIPPET], 400, None),
+        # Serve engine matrix on-chip: same harness that published the
+        # CPU-relative numbers (benchmark/results/serve_r05.json) —
+        # a tunnel window upgrades them to real tokens/s + TTFT.
+        ("serve_matrix", [py, "benchmark/serve_bench.py", "--matrix",
+                          "--model", "llama_tiny", "--requests", "32",
+                          "--json-out",
+                          "tpu_results/serve_matrix_onchip.json"],
+         560, None),
     ]
     for bq, bkv in ((512, 512), (1024, 512), (512, 1024), (1024, 1024),
                     (256, 512), (1024, 256)):
