@@ -18,7 +18,7 @@ import time
 
 from kuberay_tpu.history.collector import CoordinatorCollector, LogCollector
 from kuberay_tpu.history.server import HistoryServer
-from kuberay_tpu.history.storage import backend_from_url
+from kuberay_tpu.history.storage import backend_from_url, prune_archive
 
 
 def main(argv=None):
@@ -29,6 +29,13 @@ def main(argv=None):
     sp.add_argument("--storage", required=True)
     sp.add_argument("--host", default="0.0.0.0")
     sp.add_argument("--port", type=int, default=8090)
+    sp.add_argument("--retention-days", type=float, default=0,
+                    help="prune cluster archives idle longer than this "
+                         "(0 = keep forever); checked every 6h")
+
+    pp = sub.add_parser("prune", help="one-shot retention pass")
+    pp.add_argument("--storage", required=True)
+    pp.add_argument("--max-age-days", type=float, required=True)
 
     cp = sub.add_parser("collect", help="archive node logs / coordinator")
     cp.add_argument("--storage", required=True)
@@ -44,7 +51,29 @@ def main(argv=None):
     args = ap.parse_args(argv)
     storage = backend_from_url(args.storage)
 
+    if args.cmd == "prune":
+        removed = prune_archive(storage, args.max_age_days * 86400)
+        print(f"pruned {len(removed)} cluster archives"
+              + (": " + ", ".join(removed) if removed else ""))
+        return 0
+
     if args.cmd == "serve":
+        if args.retention_days > 0:
+            import threading
+
+            def _retention_loop():
+                while True:
+                    try:
+                        removed = prune_archive(
+                            storage, args.retention_days * 86400)
+                        if removed:
+                            print(f"retention: pruned {removed}",
+                                  flush=True)
+                    except Exception as e:  # noqa: BLE001 — keep serving
+                        print(f"retention pass failed: {e}", flush=True)
+                    time.sleep(6 * 3600)
+            threading.Thread(target=_retention_loop, daemon=True,
+                             name="history-retention").start()
         srv = HistoryServer(storage).make_server(args.host, args.port)
         print(f"history server on {args.host}:{srv.server_port}")
         try:
@@ -65,13 +94,29 @@ def main(argv=None):
             namespace=args.namespace)
     if log_col is None and coord_col is None:
         ap.error("collect needs --log-dir and/or --coordinator")
+    from kuberay_tpu.history.collector import stamp_collection
     try:
         while True:
             n = 0
-            if log_col is not None:
-                n += log_col.poll_once()
-            if coord_col is not None:
-                n += coord_col.collect_once()
+            # A transient storage/coordinator error must not kill the
+            # sidecar — skip the pass and retry on the next interval
+            # (LogCollector._run has the same policy).
+            try:
+                if log_col is not None:
+                    n += log_col.poll_once()
+                if coord_col is None:
+                    # Coordinator mode stamps inside collect_once;
+                    # log-only mode must stamp too or retention would
+                    # silently exempt these archives forever.
+                    stamp_collection(storage, args.namespace,
+                                     args.cluster)
+                else:
+                    n += coord_col.collect_once()
+            except Exception as e:  # noqa: BLE001 — keep collecting
+                if args.once:
+                    raise
+                print(f"collect pass failed, will retry: {e}",
+                      flush=True)
             if args.once:
                 print(f"archived {n} objects")
                 return 0

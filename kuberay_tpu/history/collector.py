@@ -31,11 +31,21 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time
 import urllib.error
 import urllib.request
 from typing import Dict, Optional, Tuple
 
 from kuberay_tpu.history.storage import StorageBackend
+
+
+def stamp_collection(storage: StorageBackend, namespace: str,
+                     cluster: str) -> None:
+    """Retention stamp: prune_archive ages clusters by their LAST
+    collection, so an actively-collected cluster can never age out.
+    Called by every collection mode (coordinator AND log-only)."""
+    storage.put_doc(f"meta/{namespace}/{cluster}/archived_at.json",
+                    {"ts": time.time()})
 
 
 class LogCollector:
@@ -137,6 +147,7 @@ class CoordinatorCollector:
         archived-object count."""
         n = 0
         meta_prefix = f"meta/{self.namespace}/{self.cluster}"
+        stamp_collection(self.storage, self.namespace, self.cluster)
         raw = self._get("/api/cluster")
         if raw is not None:
             self.storage.put(f"{meta_prefix}/metadata.json", raw)

@@ -27,6 +27,7 @@ SigV4 Authorization header.
 from __future__ import annotations
 
 import datetime
+import gzip
 import hashlib
 import hmac
 import json
@@ -35,6 +36,7 @@ import urllib.error
 import urllib.parse
 import urllib.request
 import xml.etree.ElementTree as ET
+import zlib
 from typing import Any, Dict, List, Optional
 
 
@@ -562,32 +564,142 @@ class AliyunOSSStorage(StorageBackend):
         return sorted(keys)
 
 
+class CompressedBackend(StorageBackend):
+    """gzip wrapper over any backend (ref historyserver/pkg/compression/
+    compression.go:16-28 — payloads compress before object storage).
+
+    Keys are unchanged (no ``.gz`` suffix): the wrapper is a transport
+    codec, not a naming scheme, so dashboards/tools listing the archive
+    see the same layout either way.  ``get`` sniffs the gzip magic and
+    passes non-gzip payloads through untouched — an archive written
+    before compression existed (or with ``?compress=none``) replays
+    transparently, and mixed archives are fine.
+    """
+
+    _MAGIC = b"\x1f\x8b"
+
+    def __init__(self, inner: StorageBackend, level: int = 6,
+                 compress_writes: bool = True):
+        self.inner = inner
+        self.level = level
+        self.compress_writes = compress_writes
+
+    def put(self, key: str, data: bytes) -> None:
+        if not self.compress_writes:
+            self.inner.put(key, data)
+            return
+        self.inner.put(key, gzip.compress(data, compresslevel=self.level))
+
+    def get(self, key: str) -> Optional[bytes]:
+        raw = self.inner.get(key)
+        if raw is None or not raw.startswith(self._MAGIC):
+            return raw
+        try:
+            return gzip.decompress(raw)
+        except (OSError, EOFError, zlib.error):
+            # Magic collision on a raw payload (e.g. a .log.gz uploaded
+            # before compression existed, truncated mid-write): pass
+            # the bytes through untouched.  gzip raises EOFError /
+            # zlib.error here, not just OSError.
+            return raw
+
+    def list(self, prefix: str = "") -> List[str]:
+        return self.inner.list(prefix)
+
+    def delete(self, key: str) -> None:
+        self.inner.delete(key)
+
+
 def backend_from_url(url: str) -> StorageBackend:
     """Factory: ``file:///path``, ``s3://bucket?endpoint=...&region=...``,
-    ``gs://bucket?endpoint=...`` — the collector/server CLI seam."""
+    ``gs://bucket?endpoint=...`` — the collector/server CLI seam.
+
+    Payloads gzip by default before upload (ref historyserver compression
+    layer); ``?compress=none`` opts out, ``?compress_level=N`` tunes.
+    Reads are transparent either way (magic sniffing), so flipping the
+    knob never strands an existing archive.
+    """
     parsed = urllib.parse.urlsplit(url)
     q = dict(urllib.parse.parse_qsl(parsed.query))
+
+    def wrap(backend: StorageBackend) -> StorageBackend:
+        # Read-side decompression is UNCONDITIONAL (magic sniffing):
+        # an archive written compressed must replay correctly even when
+        # a later process opts out of write compression — the knob can
+        # never strand existing data.
+        writes = q.get("compress", "gzip") not in ("none", "0", "false")
+        return CompressedBackend(backend,
+                                 level=int(q.get("compress_level", "6")),
+                                 compress_writes=writes)
+
     if parsed.scheme in ("", "file"):
-        return LocalStorage(parsed.path or url)
+        return wrap(LocalStorage(parsed.path or url.split("?")[0]))
     if parsed.scheme == "s3":
-        return S3Storage(q.get("endpoint", "https://s3.amazonaws.com"),
-                         parsed.netloc, region=q.get("region", "us-east-1"))
+        return wrap(S3Storage(
+            q.get("endpoint", "https://s3.amazonaws.com"),
+            parsed.netloc, region=q.get("region", "us-east-1")))
     if parsed.scheme == "gs":
-        return GCSStorage(parsed.netloc,
-                          endpoint=q.get("endpoint",
-                                         "https://storage.googleapis.com"))
+        return wrap(GCSStorage(
+            parsed.netloc,
+            endpoint=q.get("endpoint", "https://storage.googleapis.com")))
     if parsed.scheme == "azblob":
         # azblob://container?account=myacct[&endpoint=...]; key from
         # AZURE_STORAGE_KEY env.
         if not q.get("account"):
             raise ValueError(
                 "azblob:// URL requires ?account=<storage account>")
-        return AzureBlobStorage(q["account"], parsed.netloc,
-                                endpoint=q.get("endpoint", ""))
+        return wrap(AzureBlobStorage(q["account"], parsed.netloc,
+                                     endpoint=q.get("endpoint", "")))
     if parsed.scheme == "oss":
         # oss://bucket[?endpoint=...&path_style=1]; creds from
         # OSS_ACCESS_KEY_* env.
-        return AliyunOSSStorage(
+        return wrap(AliyunOSSStorage(
             parsed.netloc, endpoint=q.get("endpoint", ""),
-            path_style=q.get("path_style", "") in ("1", "true"))
+            path_style=q.get("path_style", "") in ("1", "true")))
     raise ValueError(f"unknown storage scheme: {parsed.scheme}")
+
+
+def prune_archive(storage: StorageBackend, max_age_seconds: float,
+                  now: Optional[float] = None) -> List[str]:
+    """Retention: delete whole cluster archives whose LAST collection
+    is older than the cutoff (the collector stamps
+    ``meta/{ns}/{cluster}/archived_at.json`` every pass).  Returns the
+    pruned ``ns/cluster`` names.  Archives predating the stamp are kept
+    — retention never guesses at age.
+    """
+    import time as _time
+    now = _time.time() if now is None else now
+    removed: List[str] = []
+    for key in storage.list("meta/"):
+        if not key.endswith("/archived_at.json"):
+            continue
+        parts = key.split("/")
+        if len(parts) != 4:
+            continue
+        _, ns, cluster, _ = parts
+        doc = storage.get_doc(key) or {}
+        ts = doc.get("ts", 0)
+        if not ts or now - ts <= max_age_seconds:
+            continue
+        for prefix in (f"meta/{ns}/{cluster}/", f"logs/{ns}/{cluster}/"):
+            for k in storage.list(prefix):
+                storage.delete(k)
+        # The cluster's own CR snapshot ages out with it, and so do
+        # Job/Service snapshots that reference it via status
+        # (clusterName / active-pending cluster status).  CronJob
+        # snapshots reference no cluster and are kept — crons are
+        # long-lived by design.
+        storage.delete(f"TpuCluster/{ns}/{cluster}.json")
+        for kind in ("TpuJob", "TpuService"):
+            for k in storage.list(f"{kind}/{ns}/"):
+                doc = storage.get_doc(k) or {}
+                st = doc.get("status") or {}
+                refs = {st.get("clusterName")}
+                for css in (st.get("activeServiceStatus"),
+                            st.get("pendingServiceStatus")):
+                    if isinstance(css, dict):
+                        refs.add(css.get("clusterName"))
+                if cluster in refs:
+                    storage.delete(k)
+        removed.append(f"{ns}/{cluster}")
+    return removed

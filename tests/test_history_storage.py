@@ -46,12 +46,23 @@ def test_local_backend_roundtrip(tmp_path):
 
 
 def test_backend_from_url(tmp_path):
-    assert isinstance(backend_from_url(str(tmp_path)), LocalStorage)
+    from kuberay_tpu.history.storage import CompressedBackend
+
+    # Compression wraps by default (ref historyserver/pkg/compression).
+    b = backend_from_url(str(tmp_path))
+    assert isinstance(b, CompressedBackend)
+    assert isinstance(b.inner, LocalStorage)
+    # compress=none skips WRITE compression only — reads keep sniffing
+    # so an existing compressed archive is never stranded.
+    raw = backend_from_url(f"file://{tmp_path}?compress=none")
+    assert isinstance(raw, CompressedBackend) and not raw.compress_writes
     s3 = backend_from_url("s3://bkt?endpoint=http://h:9000&region=eu-west-1")
+    assert isinstance(s3, CompressedBackend)
+    s3 = s3.inner
     assert isinstance(s3, S3Storage)
     assert (s3.bucket, s3.endpoint, s3.region) == \
         ("bkt", "http://h:9000", "eu-west-1")
-    gs = backend_from_url("gs://bkt2?endpoint=http://h:8080")
+    gs = backend_from_url("gs://bkt2?endpoint=http://h:8080").inner
     assert isinstance(gs, GCSStorage)
     assert (gs.bucket, gs.endpoint) == ("bkt2", "http://h:8080")
     with pytest.raises(ValueError):
@@ -194,6 +205,15 @@ class _FakeGCS(BaseHTTPRequestHandler):
             return
         self.send_response(200), self.end_headers()
         self.wfile.write(body)
+
+    def do_DELETE(self):
+        if not self._authed():
+            self.send_response(401), self.end_headers()
+            return
+        name = urllib.request.unquote(
+            self.path.partition("?")[0].rsplit("/o/", 1)[1])
+        _FakeGCS.objects.pop(name, None)
+        self.send_response(204), self.end_headers()
 
     def _json(self, doc):
         body = json.dumps(doc).encode()
@@ -781,16 +801,241 @@ def test_backend_from_url_new_schemes(monkeypatch):
 
     monkeypatch.setenv("AZURE_STORAGE_KEY", "c2VjcmV0LWtleQ==")
     az = backend_from_url("azblob://cont?account=acct&endpoint=http://x:1")
+    az = az.inner
     assert isinstance(az, AzureBlobStorage)
     assert az.container == "cont" and az.account == "acct"
-    oss = backend_from_url("oss://bkt?endpoint=http://y:2")
+    oss = backend_from_url("oss://bkt?endpoint=http://y:2").inner
     assert isinstance(oss, AliyunOSSStorage)
     assert oss.bucket == "bkt" and oss.endpoint == "http://y:2"
     # Virtual-host addressing by default (real OSS rejects path-style).
     assert oss._object_url("k").startswith("http://bkt.y:2/")
     assert backend_from_url(
-        "oss://bkt?endpoint=http://y:2&path_style=1").path_style
+        "oss://bkt?endpoint=http://y:2&path_style=1").inner.path_style
     # Missing Azure key fails fast, not as per-request 403s.
     monkeypatch.delenv("AZURE_STORAGE_KEY")
     with pytest.raises(ValueError, match="account key"):
         backend_from_url("azblob://cont?account=acct")
+
+
+# ---------------------------------------------------------------------------
+# Compression layer (ref historyserver/pkg/compression/compression.go)
+
+
+def _compression_roundtrip(backend):
+    """Shared contract: gzip at rest, transparent replay, raw-payload
+    pass-through (mixed archives), doc helpers inherit the codec."""
+    import gzip as _gzip
+
+    from kuberay_tpu.history.storage import CompressedBackend
+
+    cb = CompressedBackend(backend)
+    payload = b"log line one\nlog line two\n" * 64
+    cb.put("logs/default/c1/head/a.log", payload)
+    # At rest: smaller and gzip-framed.
+    raw = backend.get("logs/default/c1/head/a.log")
+    assert raw.startswith(b"\x1f\x8b") and len(raw) < len(payload)
+    assert _gzip.decompress(raw) == payload
+    # Replay: transparent.
+    assert cb.get("logs/default/c1/head/a.log") == payload
+    # Pre-compression objects (written raw) read through unchanged.
+    backend.put("logs/default/c1/head/old.log", b"plain old log\n")
+    assert cb.get("logs/default/c1/head/old.log") == b"plain old log\n"
+    # Docs go through the same codec.
+    cb.put_doc("TpuCluster/default/c1.json", {"kind": "TpuCluster"})
+    assert cb.get_doc("TpuCluster/default/c1.json") == {
+        "kind": "TpuCluster"}
+    assert backend.get(
+        "TpuCluster/default/c1.json").startswith(b"\x1f\x8b")
+    # list/delete delegate.
+    assert "logs/default/c1/head/a.log" in cb.list("logs/")
+    cb.delete("logs/default/c1/head/a.log")
+    assert cb.get("logs/default/c1/head/a.log") is None
+
+
+def test_compression_roundtrip_local(tmp_path):
+    _compression_roundtrip(LocalStorage(str(tmp_path / "arch")))
+
+
+def test_compression_roundtrip_s3():
+    _FakeS3.objects = {}
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _FakeS3)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        _compression_roundtrip(
+            S3Storage(f"http://127.0.0.1:{srv.server_port}", "bkt",
+                      access_key="AK", secret_key="SK"))
+    finally:
+        srv.shutdown()
+
+
+def test_compression_roundtrip_gcs():
+    _FakeGCS.objects = {}
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _FakeGCS)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        _compression_roundtrip(
+            GCSStorage("bkt", token="tok123",
+                       endpoint=f"http://127.0.0.1:{srv.server_port}"))
+    finally:
+        srv.shutdown()
+
+
+def test_compression_roundtrip_azure():
+    from kuberay_tpu.history.storage import AzureBlobStorage
+
+    _FakeAzure.objects = {}
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _FakeAzure)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        _compression_roundtrip(
+            AzureBlobStorage("acct", "arch",
+                             account_key=_FakeAzure.key_b64,
+                             endpoint=f"http://127.0.0.1:{srv.server_port}"))
+    finally:
+        srv.shutdown()
+
+
+def test_compression_roundtrip_oss():
+    from kuberay_tpu.history.storage import AliyunOSSStorage
+
+    _FakeOSS.objects = {}
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _FakeOSS)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        _compression_roundtrip(
+            AliyunOSSStorage("arch", access_key_id="OSSKEY",
+                             access_key_secret="OSSSECRET",
+                             endpoint=f"http://127.0.0.1:{srv.server_port}",
+                             path_style=True))
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Retention
+
+
+def test_prune_archive_by_last_collection(tmp_path):
+    import time as _time
+
+    from kuberay_tpu.history.storage import prune_archive
+
+    b = LocalStorage(str(tmp_path / "arch"))
+    now = _time.time()
+    # Stale cluster: everything under it ages out, incl. its CR snapshot.
+    b.put_doc("meta/default/old/archived_at.json", {"ts": now - 40 * 86400})
+    b.put("meta/default/old/metadata.json", b"{}")
+    b.put("logs/default/old/head/a.log", b"x")
+    b.put_doc("TpuCluster/default/old.json", {"kind": "TpuCluster"})
+    # Fresh cluster: untouched.
+    b.put_doc("meta/default/new/archived_at.json", {"ts": now - 86400})
+    b.put("logs/default/new/head/a.log", b"y")
+    # Unstamped (pre-retention archive): kept — never guess at age.
+    b.put("meta/default/legacy/metadata.json", b"{}")
+    removed = prune_archive(b, 30 * 86400, now=now)
+    assert removed == ["default/old"]
+    assert b.list("meta/default/old/") == []
+    assert b.list("logs/default/old/") == []
+    assert b.get("TpuCluster/default/old.json") is None
+    assert b.get("logs/default/new/head/a.log") == b"y"
+    assert b.get("meta/default/legacy/metadata.json") == b"{}"
+    # Idempotent.
+    assert prune_archive(b, 30 * 86400, now=now) == []
+
+
+def test_prune_removes_referencing_cr_snapshots(tmp_path):
+    import time as _time
+
+    from kuberay_tpu.history.storage import prune_archive
+
+    b = LocalStorage(str(tmp_path / "arch"))
+    now = _time.time()
+    b.put_doc("meta/default/gone/archived_at.json",
+              {"ts": now - 60 * 86400})
+    b.put_doc("TpuJob/default/train-j1.json",
+              {"kind": "TpuJob", "status": {"clusterName": "gone"}})
+    b.put_doc("TpuJob/default/other-j.json",
+              {"kind": "TpuJob", "status": {"clusterName": "alive"}})
+    b.put_doc("TpuService/default/svc1.json",
+              {"kind": "TpuService", "status": {
+                  "activeServiceStatus": {"clusterName": "gone"}}})
+    b.put_doc("TpuCronJob/default/cron1.json", {"kind": "TpuCronJob"})
+    assert prune_archive(b, 30 * 86400, now=now) == ["default/gone"]
+    assert b.get("TpuJob/default/train-j1.json") is None
+    assert b.get("TpuService/default/svc1.json") is None
+    assert b.get("TpuJob/default/other-j.json") is not None
+    assert b.get("TpuCronJob/default/cron1.json") is not None
+
+
+def test_compress_none_still_reads_compressed_archive(tmp_path):
+    """The knob can never strand data: write compressed, reopen with
+    ?compress=none, replay still works; new writes land raw."""
+    url = f"file://{tmp_path}/arch"
+    backend_from_url(url).put("logs/default/c/x.log", b"payload " * 50)
+    reopened = backend_from_url(url + "?compress=none")
+    assert reopened.get("logs/default/c/x.log") == b"payload " * 50
+    reopened.put("logs/default/c/raw.log", b"raw bytes")
+    at_rest = LocalStorage(str(tmp_path / "arch")).get(
+        "logs/default/c/raw.log")
+    assert at_rest == b"raw bytes"          # not gzip-framed
+
+
+def test_magic_collision_passthrough(tmp_path):
+    """A raw object that BEGINS with the gzip magic but is not a valid
+    stream (truncated .log.gz from before compression existed) must
+    pass through, not 500."""
+    from kuberay_tpu.history.storage import CompressedBackend
+
+    inner = LocalStorage(str(tmp_path / "arch"))
+    truncated = b"\x1f\x8b\x08\x00broken-not-really-gzip"
+    inner.put("logs/default/c/old.log.gz", truncated)
+    cb = CompressedBackend(inner)
+    assert cb.get("logs/default/c/old.log.gz") == truncated
+
+
+def test_log_only_collection_stamps_retention(tmp_path):
+    """collect --log-dir without --coordinator must still stamp
+    archived_at so retention can age the archive (main-loop stamp)."""
+    import os as _os
+
+    from kuberay_tpu.history.__main__ import main as history_main
+
+    logdir = tmp_path / "logs"
+    logdir.mkdir()
+    (logdir / "a.log").write_bytes(b"x")
+    rc = history_main(["collect", "--storage",
+                       f"file://{tmp_path}/arch?compress=none",
+                       "--cluster", "lonely", "--log-dir", str(logdir),
+                       "--once"])
+    assert rc == 0
+    b = LocalStorage(str(tmp_path / "arch"))
+    doc = b.get_doc("meta/default/lonely/archived_at.json")
+    assert doc and doc["ts"] > 0
+
+
+def test_collector_stamps_archived_at(tmp_path):
+    """The coordinator collector writes the retention stamp every pass
+    even when the coordinator is unreachable (stamp precedes scrape)."""
+    from kuberay_tpu.history.collector import CoordinatorCollector
+
+    b = LocalStorage(str(tmp_path / "arch"))
+    col = CoordinatorCollector(b, "http://127.0.0.1:1", cluster="c1")
+    col.collect_once()
+    doc = b.get_doc("meta/default/c1/archived_at.json")
+    assert doc and doc["ts"] > 0
+
+
+def test_prune_cli(tmp_path):
+    import time as _time
+
+    from kuberay_tpu.history.__main__ import main as history_main
+
+    b = LocalStorage(str(tmp_path / "arch"))
+    b.put_doc("meta/default/dead/archived_at.json",
+              {"ts": _time.time() - 90 * 86400})
+    b.put("logs/default/dead/head/x.log", b"x")
+    rc = history_main(["prune", "--storage",
+                       f"file://{tmp_path}/arch?compress=none",
+                       "--max-age-days", "30"])
+    assert rc == 0
+    assert b.list("logs/default/dead/") == []
