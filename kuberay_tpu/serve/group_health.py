@@ -45,18 +45,31 @@ class GroupMonitor:
     replacement, not rejoin.
     """
 
+    # Adaptive-budget shape: once MIN_SAMPLES completed steps have been
+    # observed, the budget becomes multiplier x the rolling p99 (floored
+    # at miss_timeout) instead of the static cold-start default — a
+    # model with legitimately long steps (big chunked-prefill batches)
+    # raises its own budget, and a fast model gets far quicker hang
+    # detection than any one-size constant.
+    WINDOW = 256
+    MIN_SAMPLES = 20
+
     def __init__(self, expected: List[int], miss_timeout: float = 10.0,
                  step_timeout: float = 60.0,
                  on_degraded: Optional[Callable[[str], None]] = None,
-                 grace: float = 30.0, compile_timeout: float = 900.0):
+                 grace: float = 30.0, compile_timeout: float = 900.0,
+                 budget_multiplier: float = 20.0):
         self.expected = list(expected)
         self.miss_timeout = miss_timeout
+        # Cold-start default only: used until the rolling window has
+        # MIN_SAMPLES observations, then the adaptive budget takes over.
         self.step_timeout = step_timeout
         # Budget for steps flagged as compiling (first occurrence of a
         # program shape): XLA compilation of a large model can dwarf
         # step_timeout, and a false DEGRADED here would put the slice in
         # an infinite replace-recompile-replace loop.
         self.compile_timeout = compile_timeout
+        self.budget_multiplier = budget_multiplier
         self.on_degraded = on_degraded
         self._lock = threading.Lock()
         now = time.monotonic()
@@ -66,6 +79,8 @@ class GroupMonitor:
             w: now + grace for w in self.expected}
         self._step_started: Optional[float] = None
         self._step_budget: float = step_timeout
+        self._step_compiling: bool = False
+        self._durations: List[float] = []     # rolling window (WINDOW)
         self._degraded: Optional[str] = None
         self._server: Optional[socket.socket] = None
         self._stop = threading.Event()
@@ -102,13 +117,40 @@ class GroupMonitor:
             if worker_id in self._last_beat:
                 self._last_beat[worker_id] = time.monotonic()
 
+    def current_step_budget(self) -> float:
+        """The live (non-compile) step budget: adaptive once enough
+        steps have been observed, the static cold-start default before
+        that.  Never below miss_timeout — follower death is the
+        heartbeat's job; the step watchdog exists for wedged-but-
+        connected peers, where a few extra seconds is the right price
+        for never degrading a slow-but-alive group."""
+        with self._lock:
+            samples = list(self._durations)
+        if len(samples) < self.MIN_SAMPLES:
+            return self.step_timeout
+        samples.sort()
+        p99 = samples[min(len(samples) - 1, int(len(samples) * 0.99))]
+        return max(self.miss_timeout, self.budget_multiplier * p99)
+
     def step_begin(self, compiling: bool = False) -> None:
         self._step_budget = (self.compile_timeout if compiling
-                             else self.step_timeout)
+                             else self.current_step_budget())
+        self._step_compiling = compiling
         self._step_started = time.monotonic()
 
     def step_end(self) -> None:
+        started = self._step_started
         self._step_started = None
+        # Compile steps stay out of the distribution: one 10-minute XLA
+        # compile would inflate p99 (and thus the budget) for the next
+        # WINDOW steps.
+        if started is not None and not self._step_compiling:
+            dur = time.monotonic() - started
+            with self._lock:
+                self._durations.append(dur)
+                if len(self._durations) > self.WINDOW:
+                    del self._durations[:len(self._durations)
+                                        - self.WINDOW]
 
     def check(self) -> Optional[str]:
         """One watchdog pass; returns the degradation reason (sticky)."""
@@ -133,7 +175,9 @@ class GroupMonitor:
             ages = {str(w): round(max(0.0, now - t), 1)
                     for w, t in self._last_beat.items()}
         return {"degraded": self._degraded, "beat_age_seconds": ages,
-                "followers": self.expected}
+                "followers": self.expected,
+                "step_budget_seconds": round(self.current_step_budget(),
+                                             3)}
 
     # -- wire -----------------------------------------------------------
 

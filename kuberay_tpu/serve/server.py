@@ -481,6 +481,14 @@ _CONFIG_KEYS = {
     "checkpoint_dir": (str, None),
     "checkpoint_step": (int, None),
     "decode_impl": (str, ("auto", "pallas", "xla", "pallas_interpret")),
+    # Group-health watchdog overrides (multi-host slices): the adaptive
+    # budget usually makes these unnecessary, but an app with known
+    # extreme step-time variance can widen its own envelope without
+    # touching operator env.
+    "group_miss_timeout": (float, None),
+    "group_step_timeout": (float, None),
+    "group_compile_timeout": (float, None),
+    "group_budget_multiplier": (float, None),
 }
 
 
@@ -589,6 +597,28 @@ def main(argv=None):  # pragma: no cover - process wrapper
                          "runs this same command; the operator's env "
                          "contract joins them into one jax.distributed "
                          "group and hosts >0 become lockstep followers")
+    import os as _argenv
+    ap.add_argument("--group-miss-timeout", type=float,
+                    default=float(_argenv.environ.get(
+                        "TPU_GROUP_MISS_TIMEOUT", "10")),
+                    help="seconds of missed follower heartbeats before "
+                         "the group degrades")
+    ap.add_argument("--group-step-timeout", type=float,
+                    default=float(_argenv.environ.get(
+                        "TPU_GROUP_STEP_TIMEOUT", "60")),
+                    help="COLD-START device-step budget; after ~20 "
+                         "observed steps the watchdog switches to an "
+                         "adaptive budget (multiplier x rolling p99, "
+                         "floored at the miss timeout)")
+    ap.add_argument("--group-compile-timeout", type=float,
+                    default=float(_argenv.environ.get(
+                        "TPU_GROUP_COMPILE_TIMEOUT", "900")),
+                    help="budget for first-shape (compiling) steps")
+    ap.add_argument("--group-budget-multiplier", type=float,
+                    default=float(_argenv.environ.get(
+                        "TPU_GROUP_BUDGET_MULTIPLIER", "20")),
+                    help="adaptive budget = this x rolling p99 step "
+                         "time")
     args = ap.parse_args(argv)
     if args.coordinator == "auto":
         # Resolve from the operator-injected env (builders/pod.py).
@@ -701,10 +731,10 @@ def main(argv=None):  # pragma: no cover - process wrapper
         engine = multihost_cls(cfg, params, **engine_kw)
         monitor = GroupMonitor(
             expected=list(range(1, jax.process_count())),
-            miss_timeout=float(_os.environ.get(
-                "TPU_GROUP_MISS_TIMEOUT", "10")),
-            step_timeout=float(_os.environ.get(
-                "TPU_GROUP_STEP_TIMEOUT", "60")))
+            miss_timeout=args.group_miss_timeout,
+            step_timeout=args.group_step_timeout,
+            compile_timeout=args.group_compile_timeout,
+            budget_multiplier=args.group_budget_multiplier)
         monitor.listen(port=hb_port)
     else:
         engine = engine_cls(cfg, params, **engine_kw)

@@ -286,3 +286,75 @@ def test_kill_follower_no_hang_and_degraded(tmp_path):
                if ln.startswith("NEW_SUBMIT_REJECTED"))
     assert float(rej.split("secs=")[1]) < 2.0
     assert "CLEAN_EXIT" in out
+
+
+# ---------------------------------------------------------------------------
+# adaptive step budgets (VERDICT r4 item 9: no static constant on the
+# hot path — the budget derives from observed step-time distribution)
+
+
+def test_adaptive_budget_tracks_observed_steps():
+    """Cold start uses the static default; after MIN_SAMPLES completed
+    steps the budget becomes multiplier x rolling p99, floored at the
+    miss timeout."""
+    m = GroupMonitor(expected=[], miss_timeout=0.5, step_timeout=60.0,
+                     budget_multiplier=20.0)
+    assert m.current_step_budget() == 60.0          # cold start
+    # Observe fast steps (~5 ms): budget drops to the miss-timeout
+    # floor — far quicker hang detection than the 60 s constant.
+    for _ in range(m.MIN_SAMPLES):
+        m.step_begin()
+        time.sleep(0.005)
+        m.step_end()
+    fast = m.current_step_budget()
+    assert fast == pytest.approx(0.5, abs=0.01), fast    # floor
+    # A workload shift to slow steps RAISES the budget: p99 follows.
+    for _ in range(30):
+        m._durations.append(0.2)          # 200 ms steps, 20x -> 4 s
+    slow = m.current_step_budget()
+    assert slow == pytest.approx(4.0, rel=0.1), slow
+    assert "step_budget_seconds" in m.status()
+
+
+def test_slow_but_alive_group_never_degrades():
+    """Steps 10x slower than the historical p99 but inside the adaptive
+    budget must NOT degrade the group (the false-DEGRADED this feature
+    exists to prevent: a legit long chunked-prefill batch on a big
+    model would otherwise trip a whole-slice replacement)."""
+    m = GroupMonitor(expected=[], miss_timeout=0.05, step_timeout=0.1,
+                     budget_multiplier=20.0)
+    # History: ~10 ms steps -> p99 10 ms -> budget max(0.05, 0.2)=0.2 s.
+    for _ in range(m.MIN_SAMPLES):
+        m.step_begin()
+        time.sleep(0.01)
+        m.step_end()
+    budget = m.current_step_budget()
+    assert budget >= 0.15, budget
+    # A 0.12 s step (longer than the 0.1 s static default!) survives.
+    m.step_begin()
+    time.sleep(0.12)
+    assert m.check() is None, m.check()
+    m.step_end()
+    assert m.degraded is None
+    # A genuinely stuck step still trips once the budget is exceeded.
+    m.step_begin()
+    time.sleep(budget + 0.1)
+    assert m.check() and "stuck" in m.check()
+
+
+def test_compile_steps_stay_out_of_distribution():
+    """A compile-flagged step must use the compile budget and must NOT
+    inflate the rolling p99 for subsequent steps."""
+    m = GroupMonitor(expected=[], miss_timeout=0.5, step_timeout=60.0,
+                     compile_timeout=300.0, budget_multiplier=20.0)
+    m.step_begin(compiling=True)
+    assert m._step_budget == 300.0
+    time.sleep(0.2)                       # a "long compile"
+    m.step_end()
+    assert m._durations == []             # not recorded
+    for _ in range(m.MIN_SAMPLES):
+        m.step_begin()
+        time.sleep(0.002)
+        m.step_end()
+    # Budget reflects the fast steady state, not the compile outlier.
+    assert m.current_step_budget() == pytest.approx(0.5, abs=0.01)
