@@ -20,6 +20,24 @@ import time
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
 
+def percentile(samples, pct):
+    """Interpolated percentile (statistics.quantiles 'inclusive' method).
+
+    The previous truncating index ``int(n * 0.99) - 1`` collapses
+    small-sample p99 toward p90: for n=21 it picks index 19 and never
+    reports the tail sample at all — exactly the latency outlier a p99
+    exists to surface.  Interpolation uses the full tail: for n=21 over
+    1..21 the p99 is 20.8 (between the two largest samples).
+    """
+    import statistics
+    xs = sorted(samples)
+    if not xs:
+        raise ValueError("percentile() of no samples")
+    if len(xs) == 1:
+        return xs[0]
+    return statistics.quantiles(xs, n=100, method="inclusive")[pct - 1]
+
+
 def run(args) -> None:
     import jax
     from kuberay_tpu.models import llama
@@ -253,8 +271,7 @@ def matrix(args) -> None:
             "ttft_p50_ms": round(
                 statistics.median(ttfts) * 1e3, 2) if ttfts else None,
             "ttft_p99_ms": round(
-                ttfts[max(0, int(len(ttfts) * 0.99) - 1)] * 1e3, 2)
-            if ttfts else None,
+                percentile(ttfts, 99) * 1e3, 2) if ttfts else None,
             "requests": nreq,
             "repeats": args.repeats,
         }

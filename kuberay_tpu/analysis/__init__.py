@@ -1,0 +1,48 @@
+"""kuberay_tpu.analysis: reconcile-invariant static analysis.
+
+The controller invariants this framework's correctness rests on —
+optimistic-concurrency discipline on status writes, lock hygiene in the
+threading-based control plane, atomic slice-unit pod operations, complete
+TPU identity-env injection — are conventions, and conventions regress.
+This package encodes them as executable AST rules (stdlib ``ast`` only,
+no third-party deps) so tier-1 tests block the regression instead of a
+reviewer having to catch it.
+
+Usage:
+
+    python -m kuberay_tpu.analysis [paths...] [--format human|json]
+
+or from tests::
+
+    from kuberay_tpu.analysis import run_paths
+    findings = run_paths(["kuberay_tpu"])
+
+Per-rule suppression, with a justification comment please::
+
+    self._journal.flush()   # kuberay-lint: disable=lock-discipline
+
+See docs/static-analysis.md for each rule's invariant and how to add one.
+"""
+
+from kuberay_tpu.analysis.core import (
+    Finding,
+    Rule,
+    RULES,
+    analyze_file,
+    analyze_source,
+    iter_python_files,
+    run_paths,
+)
+
+# Importing the rules module registers every built-in rule.
+from kuberay_tpu.analysis import rules as _rules  # noqa: F401
+
+__all__ = [
+    "Finding",
+    "Rule",
+    "RULES",
+    "analyze_file",
+    "analyze_source",
+    "iter_python_files",
+    "run_paths",
+]

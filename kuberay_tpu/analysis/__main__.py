@@ -1,0 +1,57 @@
+"""CLI: ``python -m kuberay_tpu.analysis [paths...]``.
+
+Exit code 0 when clean, 1 when findings remain, 2 on usage errors —
+suitable for CI gates and the tools/lint.sh wrapper.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from kuberay_tpu.analysis.core import RULES, run_paths
+from kuberay_tpu.analysis.reporters import (render_human, render_json,
+                                            render_rule_list)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m kuberay_tpu.analysis",
+        description="kuberay-tpu reconcile-invariant static analyzer")
+    ap.add_argument("paths", nargs="*", default=["kuberay_tpu"],
+                    help="files or directories to analyze "
+                         "(default: kuberay_tpu)")
+    ap.add_argument("--format", choices=("human", "json"), default="human")
+    ap.add_argument("--rules", default="",
+                    help="comma-separated subset of rules to run")
+    ap.add_argument("--keep-suppressed", action="store_true",
+                    help="report findings even when a suppression "
+                         "comment matches (audit mode)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="list registered rules and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        print(render_rule_list())
+        return 0
+
+    only = None
+    if args.rules:
+        only = [r.strip() for r in args.rules.split(",") if r.strip()]
+        unknown = [r for r in only if r not in RULES]
+        if unknown:
+            print(f"unknown rule(s): {', '.join(unknown)}; "
+                  f"known: {', '.join(sorted(RULES))}", file=sys.stderr)
+            return 2
+
+    findings = run_paths(args.paths or ["kuberay_tpu"], only=only,
+                         keep_suppressed=args.keep_suppressed)
+    out = (render_json(findings) if args.format == "json"
+           else render_human(findings))
+    print(out)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

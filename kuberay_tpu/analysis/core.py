@@ -1,0 +1,196 @@
+"""Analyzer engine: rule registry, suppression comments, file walking.
+
+A rule is a class with ``NAME``/``DESCRIPTION``/``INVARIANT`` and a
+``check(tree, ctx)`` generator of :class:`Finding`.  Registration is the
+``@rule`` decorator; the CLI and the pytest gate both consume the same
+registry, so a new rule is one class away from being enforced.
+
+Suppressions are source comments, narrowest-scope first:
+
+- ``# kuberay-lint: disable=RULE[,RULE2]`` on the offending line;
+- ``# kuberay-lint: disable-next-line=RULE`` on the line above;
+- ``# kuberay-lint: disable-file=RULE`` anywhere in the file (whole file).
+
+``disable=all`` matches every rule.  A suppression silences the finding
+but the justification comment stays in the source — that is the point.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+import tokenize
+from io import StringIO
+from typing import Dict, Iterable, Iterator, List, Optional, Set
+
+SUPPRESS_RE = re.compile(
+    r"#\s*kuberay-lint:\s*(disable|disable-next-line|disable-file)"
+    r"\s*=\s*([A-Za-z0-9_,\- ]+)")
+
+
+@dataclasses.dataclass
+class Finding:
+    """One rule violation at one source location.  ``end_line`` is the
+    end of the flagged construct: a ``disable`` comment anywhere inside
+    the span suppresses (so the comment can sit on an except-handler's
+    body, not just its header)."""
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    end_line: int = 0
+
+    def to_dict(self) -> Dict[str, object]:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: [{self.rule}] {self.message}"
+
+
+RULES: Dict[str, type] = {}
+
+
+def rule(cls: type) -> type:
+    """Class decorator: register a rule under its ``NAME``."""
+    if not getattr(cls, "NAME", ""):
+        raise ValueError(f"rule {cls!r} has no NAME")
+    RULES[cls.NAME] = cls
+    return cls
+
+
+class Rule:
+    """Base class; subclasses implement ``check``."""
+
+    NAME = ""
+    DESCRIPTION = ""
+    INVARIANT = ""
+
+    def check(self, tree: ast.Module, ctx: "FileContext") -> Iterable[Finding]:
+        raise NotImplementedError
+
+    def finding(self, ctx: "FileContext", node: ast.AST, message: str) -> Finding:
+        line = getattr(node, "lineno", 0)
+        return Finding(rule=self.NAME, path=ctx.path, line=line,
+                       col=getattr(node, "col_offset", 0) + 1,
+                       message=message,
+                       end_line=getattr(node, "end_lineno", None) or line)
+
+
+class FileContext:
+    """Per-file state shared by every rule: path, source, suppressions."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        # line -> set of rule names disabled on that line
+        self.line_disables: Dict[int, Set[str]] = {}
+        self.file_disables: Set[str] = set()
+        self._parse_suppressions()
+
+    def _parse_suppressions(self) -> None:
+        try:
+            tokens = tokenize.generate_tokens(StringIO(self.source).readline)
+            comments = [(t.start[0], t.string) for t in tokens
+                        if t.type == tokenize.COMMENT]
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            # Suppressions are best-effort on files that don't tokenize;
+            # the analyzer itself reports the parse error.
+            return
+        for lineno, text in comments:
+            m = SUPPRESS_RE.search(text)
+            if m is None:
+                continue
+            mode, names = m.group(1), {
+                n.strip() for n in m.group(2).split(",") if n.strip()}
+            if mode == "disable-file":
+                self.file_disables |= names
+            elif mode == "disable-next-line":
+                self.line_disables.setdefault(lineno + 1, set()).update(names)
+            else:
+                self.line_disables.setdefault(lineno, set()).update(names)
+
+    def suppressed(self, finding: Finding) -> bool:
+        def hit(names: Set[str]) -> bool:
+            return "all" in names or finding.rule in names
+        if hit(self.file_disables):
+            return True
+        last = max(finding.line, finding.end_line or finding.line)
+        return any(hit(self.line_disables.get(ln, set()))
+                   for ln in range(finding.line, last + 1))
+
+
+def _selected_rules(only: Optional[Iterable[str]] = None) -> List[Rule]:
+    if only is None:
+        names = sorted(RULES)
+    else:
+        names = list(only)
+        unknown = [n for n in names if n not in RULES]
+        if unknown:
+            raise KeyError(f"unknown rule(s): {', '.join(unknown)}")
+    return [RULES[n]() for n in names]
+
+
+def analyze_source(source: str, path: str = "<string>",
+                   only: Optional[Iterable[str]] = None,
+                   keep_suppressed: bool = False) -> List[Finding]:
+    """Run rules over one source string; returns unsuppressed findings
+    (all findings when ``keep_suppressed``)."""
+    ctx = FileContext(path, source)
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding(rule="parse-error", path=path,
+                        line=e.lineno or 0, col=(e.offset or 0),
+                        message=f"could not parse: {e.msg}")]
+    out: List[Finding] = []
+    for r in _selected_rules(only):
+        for f in r.check(tree, ctx):
+            if keep_suppressed or not ctx.suppressed(f):
+                out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return out
+
+
+def analyze_file(path: str, only: Optional[Iterable[str]] = None,
+                 keep_suppressed: bool = False) -> List[Finding]:
+    with open(path, encoding="utf-8", errors="replace") as fh:
+        source = fh.read()
+    return analyze_source(source, path=path, only=only,
+                          keep_suppressed=keep_suppressed)
+
+
+SKIP_DIRS = {"__pycache__", ".git", ".venv", "node_modules", ".eggs"}
+
+
+def iter_python_files(paths: Iterable[str]) -> Iterator[str]:
+    """Expand files/directories into a sorted, de-duplicated .py list."""
+    seen: Set[str] = set()
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py") and p not in seen:
+                seen.add(p)
+                yield p
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = sorted(d for d in dirs if d not in SKIP_DIRS)
+            for name in sorted(files):
+                if not name.endswith(".py"):
+                    continue
+                full = os.path.join(root, name)
+                if full not in seen:
+                    seen.add(full)
+                    yield full
+
+
+def run_paths(paths: Iterable[str], only: Optional[Iterable[str]] = None,
+              keep_suppressed: bool = False) -> List[Finding]:
+    """Analyze every .py under ``paths``; findings sorted by location."""
+    out: List[Finding] = []
+    for path in iter_python_files(paths):
+        out.extend(analyze_file(path, only=only,
+                                keep_suppressed=keep_suppressed))
+    return out

@@ -1,0 +1,38 @@
+"""Finding reporters: human-readable text and machine-readable JSON."""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List
+
+from kuberay_tpu.analysis.core import RULES, Finding
+
+
+def render_human(findings: List[Finding]) -> str:
+    if not findings:
+        return "kuberay-lint: clean (0 findings)"
+    lines = [f.render() for f in findings]
+    by_rule: Dict[str, int] = {}
+    for f in findings:
+        by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+    summary = ", ".join(f"{name}: {n}" for name, n in sorted(by_rule.items()))
+    lines.append("")
+    lines.append(f"kuberay-lint: {len(findings)} finding(s) ({summary})")
+    return "\n".join(lines)
+
+
+def render_json(findings: List[Finding]) -> str:
+    return json.dumps({
+        "findings": [f.to_dict() for f in findings],
+        "count": len(findings),
+    }, indent=2, sort_keys=True)
+
+
+def render_rule_list() -> str:
+    lines = []
+    for name in sorted(RULES):
+        cls = RULES[name]
+        lines.append(f"{name}: {cls.DESCRIPTION}")
+        if cls.INVARIANT:
+            lines.append(f"    invariant: {cls.INVARIANT}")
+    return "\n".join(lines)

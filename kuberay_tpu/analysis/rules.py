@@ -1,0 +1,617 @@
+"""Built-in rules: the reconcile invariants, as AST checks.
+
+Each rule documents the invariant it guards and the concrete regression
+it exists to block (all were live bugs or advisor findings at the time
+the rule was written — see docs/static-analysis.md).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from kuberay_tpu.analysis.core import FileContext, Finding, Rule, rule
+
+
+# ---------------------------------------------------------------------------
+# shared AST helpers
+# ---------------------------------------------------------------------------
+
+def dotted(node: ast.AST) -> str:
+    """'self.store.try_get' for a Name/Attribute chain; '' otherwise."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def iter_functions(tree: ast.Module):
+    """Yield every (async) function definition, nested ones included."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _is_store_read(call: ast.Call) -> bool:
+    """A call that reads an object from a store: ``<...store...>.try_get(..)``
+    or ``<...store...>.get(..)`` (the receiver chain must mention 'store'
+    so plain dict ``.get`` never matches)."""
+    if not isinstance(call.func, ast.Attribute):
+        return False
+    if call.func.attr not in ("try_get", "get"):
+        return False
+    recv = dotted(call.func.value).lower()
+    return "store" in recv
+
+
+def _const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+# ---------------------------------------------------------------------------
+# 1. rv-precondition
+# ---------------------------------------------------------------------------
+
+@rule
+class RvPreconditionRule(Rule):
+    """Optimistic-concurrency preconditions must come from the read the
+    written data was computed from — the reconcile-start snapshot — not
+    from a re-read performed just before the write.
+
+    The clobber pattern this blocks: a reconciler computes status from
+    snapshot A, then refreshes the object (``try_get``) to pick up its
+    *current* resourceVersion B and writes status(A) with precondition B.
+    A foreign write landing between A and B (leader-failover overlap)
+    then never conflicts — the stale status silently overwrites the new
+    leader's.  Carry the snapshot rv through the pass instead, threading
+    bumps from your own writes via their return values.
+    """
+
+    NAME = "rv-precondition"
+    DESCRIPTION = ("status/spec writes must carry the reconcile-start "
+                   "resourceVersion, not one refreshed by a pre-write re-read")
+    INVARIANT = ("a write's rv precondition derives from the same read "
+                 "its payload was computed from")
+
+    def check(self, tree: ast.Module, ctx: FileContext) -> Iterable[Finding]:
+        for fn in iter_functions(tree):
+            yield from self._check_function(fn, ctx)
+
+    def _check_function(self, fn, ctx: FileContext) -> Iterable[Finding]:
+        reads: Dict[str, ast.Call] = {}       # var -> the store read call
+        derives: Dict[str, Set[str]] = {}     # var -> names its value used
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                tgt = node.targets[0].id
+                if isinstance(node.value, ast.Call) and \
+                        _is_store_read(node.value):
+                    reads[tgt] = node.value
+                derives.setdefault(tgt, set()).update(names_in(node.value))
+
+        if not reads:
+            return
+
+        def derived_from(var: str, src: str) -> bool:
+            seen, stack = set(), [var]
+            while stack:
+                v = stack.pop()
+                if v == src:
+                    return True
+                if v in seen:
+                    continue
+                seen.add(v)
+                stack.extend(derives.get(v, ()))
+            return False
+
+        # (a) carry_rv(obj, cur) where cur is a same-function re-read and
+        # obj was computed from something else entirely.
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Call) and
+                    isinstance(node.func, ast.Name) and
+                    node.func.id == "carry_rv" and len(node.args) == 2):
+                continue
+            cur = node.args[1]
+            if not (isinstance(cur, ast.Name) and cur.id in reads):
+                continue
+            payload_names = names_in(node.args[0])
+            if cur.id in payload_names:
+                continue                      # single read-modify-write: fine
+            if any(derived_from(n, cur.id) for n in payload_names):
+                continue
+            yield self.finding(
+                ctx, node,
+                f"rv for this write comes from re-read '{cur.id}' "
+                "(post-snapshot try_get/get) while the payload was computed "
+                "from the reconcile-start object; carry the snapshot "
+                "resourceVersion through the pass instead")
+
+        # (b) explicit cross-stamp:
+        #     a["metadata"]["resourceVersion"] = <expr using re-read b>
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+                continue
+            tgt = node.targets[0]
+            if not (isinstance(tgt, ast.Subscript) and
+                    _const_str(tgt.slice) == "resourceVersion"):
+                continue
+            base = tgt.value
+            while isinstance(base, ast.Subscript):
+                base = base.value
+            base_name = base.id if isinstance(base, ast.Name) else ""
+            for src in names_in(node.value):
+                if src in reads and src != base_name and \
+                        not derived_from(base_name, src):
+                    yield self.finding(
+                        ctx, node,
+                        f"resourceVersion of '{base_name}' stamped from "
+                        f"re-read '{src}'; carry the reconcile-start rv "
+                        "instead of refreshing it before the write")
+                    break
+
+        # (c) helper re-read RMW: a method that already HOLDS the object
+        # (a parameter whose .metadata is accessed) re-reads the same
+        # kind (store read with a ``self.KIND`` arg) and writes the
+        # re-read copy — decisions made from the held snapshot are
+        # applied under a fresher rv than they were computed from.
+        params = {a.arg for a in fn.args.args if a.arg != "self"}
+        holds_object = any(
+            isinstance(n, ast.Attribute) and n.attr == "metadata" and
+            isinstance(n.value, ast.Name) and n.value.id in params
+            for n in ast.walk(fn))
+        if holds_object:
+            self_kind_reads = {
+                var for var, call in reads.items()
+                if any(dotted(a) == "self.KIND" for a in call.args)}
+            for node in ast.walk(fn):
+                if not (isinstance(node, ast.Call) and
+                        isinstance(node.func, ast.Attribute) and
+                        node.func.attr in ("update", "update_status") and
+                        "store" in dotted(node.func.value).lower()):
+                    continue
+                if node.args and isinstance(node.args[0], ast.Name) and \
+                        node.args[0].id in self_kind_reads:
+                    yield self.finding(
+                        ctx, node,
+                        f"'{node.args[0].id}' was re-read inside a helper "
+                        "that already holds the object; mutate the held "
+                        "snapshot and write with its resourceVersion so a "
+                        "foreign write conflicts instead of being clobbered")
+
+
+# ---------------------------------------------------------------------------
+# lock-region machinery shared by rules 2 and 3
+# ---------------------------------------------------------------------------
+
+_LOCK_FACTORIES = ("Lock", "RLock", "Condition", "Semaphore",
+                   "BoundedSemaphore")
+
+
+class _Access:
+    __slots__ = ("attr", "store", "held", "node", "method")
+
+    def __init__(self, attr, store, held, node, method):
+        self.attr = attr
+        self.store = store
+        self.held = held
+        self.node = node
+        self.method = method
+
+
+class _ClassLockModel:
+    """Per-class model: which ``self.X`` attrs are locks, every attribute
+    access with its lock-held flag, every intra-class call site, plus the
+    interprocedural fixpoint (a method whose every call site holds the
+    lock is itself lock-held; a method only reachable from ``__init__``
+    is construction-time and exempt)."""
+
+    def __init__(self, cls: ast.ClassDef):
+        self.cls = cls
+        self.methods: Dict[str, ast.AST] = {}
+        for node in cls.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.methods[node.name] = node
+        self.lock_attrs = self._find_lock_attrs()
+        self.accesses: List[_Access] = []
+        # callee -> list of (caller, held_at_site)
+        self.call_sites: Dict[str, List[Tuple[str, bool]]] = {}
+        # calls made while holding the lock: (dotted func, node, method)
+        self.held_calls: List[Tuple[str, ast.Call, str]] = []
+        for name, fn in self.methods.items():
+            self._scan_method(name, fn)
+        # init context first: construction-time call sites are neutral in
+        # the lock fixpoint (a method reachable only from __init__ OR
+        # lock-held paths is not a race).
+        self.init_only = self._init_only()
+        self.held_methods = self._fixpoint_held()
+
+    # -- construction ----------------------------------------------------
+
+    def _find_lock_attrs(self) -> Set[str]:
+        out: Set[str] = set()
+        for fn in self.methods.values():
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                    tgt = node.targets[0]
+                    if isinstance(tgt, ast.Attribute) and \
+                            isinstance(tgt.value, ast.Name) and \
+                            tgt.value.id == "self" and \
+                            isinstance(node.value, ast.Call):
+                        fname = dotted(node.value.func)
+                        if fname.split(".")[-1] in _LOCK_FACTORIES:
+                            out.add(tgt.attr)
+        return out
+
+    def _is_lock_expr(self, expr: ast.AST) -> bool:
+        d = dotted(expr)
+        return d.startswith("self.") and d[len("self."):] in self.lock_attrs
+
+    def _scan_method(self, method: str, fn) -> None:
+        lock_attrs = self.lock_attrs
+
+        def walk(node: ast.AST, held: bool) -> None:
+            if isinstance(node, ast.With):
+                inner = held or any(self._is_lock_expr(item.context_expr)
+                                    for item in node.items)
+                for item in node.items:
+                    walk(item.context_expr, held)
+                for child in node.body:
+                    walk(child, inner)
+                return
+            if isinstance(node, ast.Attribute) and \
+                    isinstance(node.value, ast.Name) and \
+                    node.value.id == "self":
+                if node.attr not in lock_attrs and \
+                        node.attr not in self.methods:
+                    self.accesses.append(_Access(
+                        node.attr, isinstance(node.ctx, (ast.Store, ast.Del)),
+                        held, node, method))
+            if isinstance(node, ast.Call):
+                fname = dotted(node.func)
+                if fname.startswith("self.") and \
+                        fname[len("self."):] in self.methods:
+                    self.call_sites.setdefault(
+                        fname[len("self."):], []).append((method, held))
+                if held:
+                    self.held_calls.append((fname, node, method))
+            for child in ast.iter_child_nodes(node):
+                walk(child, held)
+
+        for child in ast.iter_child_nodes(fn):
+            walk(child, False)
+
+    # -- interprocedural context -----------------------------------------
+
+    def _fixpoint_held(self) -> Set[str]:
+        held: Set[str] = set()
+        changed = True
+        while changed:
+            changed = False
+            for name in self.methods:
+                if name in held:
+                    continue
+                sites = [(caller, h)
+                         for caller, h in self.call_sites.get(name, [])
+                         if caller != "__init__"
+                         and caller not in self.init_only]
+                if sites and all(h or caller in held
+                                 for caller, h in sites):
+                    held.add(name)
+                    changed = True
+        return held
+
+    def _init_only(self) -> Set[str]:
+        init_ctx: Set[str] = {"__init__"}
+        changed = True
+        while changed:
+            changed = False
+            for name in self.methods:
+                if name in init_ctx:
+                    continue
+                sites = self.call_sites.get(name, [])
+                if sites and all(caller in init_ctx for caller, _ in sites):
+                    init_ctx.add(name)
+                    changed = True
+        return init_ctx
+
+    def effective_held(self, access_or_method) -> bool:
+        if isinstance(access_or_method, _Access):
+            return access_or_method.held or \
+                access_or_method.method in self.held_methods
+        return access_or_method in self.held_methods
+
+
+def iter_classes(tree: ast.Module):
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            yield node
+
+
+# ---------------------------------------------------------------------------
+# 2. lock-discipline
+# ---------------------------------------------------------------------------
+
+@rule
+class LockDisciplineRule(Rule):
+    """An attribute written under ``with self._lock:`` in one method is
+    part of that lock's protected state; touching it without the lock in
+    another method is a data race (controllers, the manager, expectations
+    and the fake kubelet all run on worker threads).
+
+    Construction (``__init__`` and methods reachable only from it) is
+    single-threaded and exempt.  Methods whose every intra-class call
+    site holds the lock count as lock-held (``_notify``-style helpers).
+    """
+
+    NAME = "lock-discipline"
+    DESCRIPTION = ("attributes assigned under a lock in one method must "
+                   "not be accessed unguarded in another")
+    INVARIANT = "lock-protected state is touched only under its lock"
+
+    def check(self, tree: ast.Module, ctx: FileContext) -> Iterable[Finding]:
+        for cls in iter_classes(tree):
+            model = _ClassLockModel(cls)
+            if not model.lock_attrs:
+                continue
+            guarded: Set[str] = set()
+            guard_methods: Dict[str, Set[str]] = {}
+            for acc in model.accesses:
+                if acc.method in ("__init__",) or \
+                        acc.method in model.init_only:
+                    continue
+                if acc.store and model.effective_held(acc):
+                    guarded.add(acc.attr)
+                    guard_methods.setdefault(acc.attr, set()).add(acc.method)
+            if not guarded:
+                continue
+            reported: Set[Tuple[str, int]] = set()
+            for acc in model.accesses:
+                if acc.attr not in guarded:
+                    continue
+                if acc.method in ("__init__",) or \
+                        acc.method in model.init_only:
+                    continue
+                if model.effective_held(acc):
+                    continue
+                key = (acc.attr, acc.node.lineno)
+                if key in reported:
+                    continue
+                reported.add(key)
+                where = ", ".join(sorted(guard_methods[acc.attr]))
+                yield self.finding(
+                    ctx, acc.node,
+                    f"'self.{acc.attr}' is written under "
+                    f"'{cls.name}' lock in {where}() but accessed here "
+                    f"({acc.method}()) without holding it")
+
+
+# ---------------------------------------------------------------------------
+# 3. blocking-under-lock
+# ---------------------------------------------------------------------------
+
+_BLOCKING_EXACT = {
+    "time.sleep",
+    "socket.create_connection",
+    "socket.getaddrinfo",
+    "urllib.request.urlopen",
+}
+_BLOCKING_PREFIX = ("subprocess.", "requests.", "http.client.")
+_BLOCKING_METHODS = {"recv", "sendall", "accept", "connect", "urlopen"}
+
+
+@rule
+class BlockingUnderLockRule(Rule):
+    """Sleeping or doing network/subprocess I/O while holding a lock
+    serializes every other thread in the process behind that I/O — in a
+    reconciler it turns one slow upstream into a control-plane stall.
+    ``Condition.wait`` is fine (it releases the lock); raw sleeps and
+    socket/HTTP/subprocess calls are not.
+    """
+
+    NAME = "blocking-under-lock"
+    DESCRIPTION = ("no time.sleep / socket / HTTP / subprocess calls "
+                   "inside a held-lock region")
+    INVARIANT = "lock hold times are bounded by computation, not I/O"
+
+    def check(self, tree: ast.Module, ctx: FileContext) -> Iterable[Finding]:
+        for cls in iter_classes(tree):
+            model = _ClassLockModel(cls)
+            if not model.lock_attrs:
+                continue
+            for fname, node, method in model.held_calls:
+                if self._blocking(fname):
+                    yield self.finding(
+                        ctx, node,
+                        f"blocking call '{fname}' while holding the "
+                        f"'{cls.name}' lock in {method}(); move the I/O "
+                        "outside the locked region")
+            # Methods that are lock-held interprocedurally: their direct
+            # blocking calls were recorded with held=False, so re-scan.
+            for acc_name in model.held_methods:
+                fn = model.methods[acc_name]
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Call):
+                        fname = dotted(node.func)
+                        if self._blocking(fname):
+                            yield self.finding(
+                                ctx, node,
+                                f"blocking call '{fname}' in {acc_name}(), "
+                                "which is only ever called with the "
+                                f"'{cls.name}' lock held")
+
+    @staticmethod
+    def _blocking(fname: str) -> bool:
+        if not fname:
+            return False
+        if fname in _BLOCKING_EXACT:
+            return True
+        if any(fname.startswith(p) for p in _BLOCKING_PREFIX):
+            return True
+        leaf = fname.split(".")[-1]
+        return "." in fname and leaf in _BLOCKING_METHODS
+
+
+# ---------------------------------------------------------------------------
+# 4. exception-swallow
+# ---------------------------------------------------------------------------
+
+_LOOPY_NAMES = ("reconcile", "sync", "step", "loop", "worker", "run",
+                "poll", "watch", "process", "drain")
+
+
+@rule
+class ExceptionSwallowRule(Rule):
+    """A bare ``except:`` (or ``except Exception: pass``) inside a
+    reconcile/sync loop hides the very failures level-triggered retry
+    exists to surface — the loop spins forever 'healthy' while doing
+    nothing.  Catch the specific error, or at minimum log before
+    continuing.
+    """
+
+    NAME = "exception-swallow"
+    DESCRIPTION = ("no silent bare/broad excepts inside reconcile/sync "
+                   "loops")
+    INVARIANT = "reconcile loops never discard unexpected exceptions silently"
+
+    def check(self, tree: ast.Module, ctx: FileContext) -> Iterable[Finding]:
+        for fn in iter_functions(tree):
+            loopy_fn = any(tok in fn.name.lower() for tok in _LOOPY_NAMES)
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Try):
+                    continue
+                in_loop = loopy_fn or self._inside_loop(fn, node)
+                if not in_loop:
+                    continue
+                for handler in node.handlers:
+                    if not self._broad(handler):
+                        continue
+                    if self._silent(handler):
+                        yield self.finding(
+                            ctx, handler,
+                            "broad except silently swallowed inside a "
+                            "reconcile/sync loop; catch the specific "
+                            "exception or log before continuing")
+
+    @staticmethod
+    def _broad(handler: ast.ExceptHandler) -> bool:
+        if handler.type is None:
+            return True
+        name = dotted(handler.type)
+        return name in ("Exception", "BaseException")
+
+    @staticmethod
+    def _silent(handler: ast.ExceptHandler) -> bool:
+        return all(isinstance(stmt, (ast.Pass, ast.Continue))
+                   for stmt in handler.body)
+
+    @staticmethod
+    def _inside_loop(fn, target: ast.Try) -> bool:
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.For, ast.While)):
+                for sub in ast.walk(node):
+                    if sub is target:
+                        return True
+        return False
+
+
+# ---------------------------------------------------------------------------
+# 5. tpu-env-completeness
+# ---------------------------------------------------------------------------
+
+_ENV_GROUP = {"TPU_WORKER_ID", "TPU_WORKER_HOSTNAMES", "TPU_TOPOLOGY"}
+_ENV_ATTRS = {"ENV_TPU_WORKER_ID": "TPU_WORKER_ID",
+              "ENV_TPU_WORKER_HOSTNAMES": "TPU_WORKER_HOSTNAMES",
+              "ENV_TPU_TOPOLOGY": "TPU_TOPOLOGY"}
+_SEL_GROUP = {"cloud.google.com/gke-tpu-accelerator",
+              "cloud.google.com/gke-tpu-topology"}
+_SEL_ATTRS = {"NODE_SELECTOR_GKE_ACCELERATOR":
+              "cloud.google.com/gke-tpu-accelerator",
+              "NODE_SELECTOR_GKE_TOPOLOGY":
+              "cloud.google.com/gke-tpu-topology"}
+
+
+@rule
+class TpuEnvCompletenessRule(Rule):
+    """A worker that gets ``TPU_WORKER_ID`` but not
+    ``TPU_WORKER_HOSTNAMES`` (or the GKE accelerator selector without its
+    topology twin) produces a pod that schedules fine and then wedges the
+    whole slice at ICI-mesh bringup — the worst failure mode: N-1 healthy
+    hosts blocked in a collective forever.  Any builder path that sets
+    one member of the identity set must set all of them.
+    """
+
+    NAME = "tpu-env-completeness"
+    DESCRIPTION = ("pod builders setting any TPU identity env/selector "
+                   "must set the complete set")
+    INVARIANT = ("TPU_WORKER_ID, TPU_WORKER_HOSTNAMES and TPU_TOPOLOGY "
+                 "(and both GKE node selectors) travel together")
+
+    def check(self, tree: ast.Module, ctx: FileContext) -> Iterable[Finding]:
+        for fn in iter_functions(tree):
+            env_set, sel_set = self._keys_set(fn)
+            if env_set and env_set != _ENV_GROUP:
+                missing = sorted(_ENV_GROUP - env_set)
+                yield self.finding(
+                    ctx, fn,
+                    f"{fn.name}() sets {sorted(env_set)} but not "
+                    f"{missing}; a partial TPU identity env wedges the "
+                    "slice at ICI-mesh bringup — set all of "
+                    f"{sorted(_ENV_GROUP)}")
+            if sel_set and sel_set != _SEL_GROUP:
+                missing = sorted(_SEL_GROUP - sel_set)
+                yield self.finding(
+                    ctx, fn,
+                    f"{fn.name}() sets node selector(s) {sorted(sel_set)} "
+                    f"without {missing}; accelerator and topology "
+                    "selectors must travel together or pods land on the "
+                    "wrong slice shape")
+
+    def _keys_set(self, fn) -> Tuple[Set[str], Set[str]]:
+        env_set: Set[str] = set()
+        sel_set: Set[str] = set()
+
+        def classify(key: ast.AST) -> Optional[str]:
+            s = _const_str(key)
+            if s is None and isinstance(key, ast.Attribute):
+                s = _ENV_ATTRS.get(key.attr) or _SEL_ATTRS.get(key.attr)
+            if s in _ENV_GROUP:
+                return "env:" + s
+            if s in _SEL_GROUP:
+                return "sel:" + s
+            return None
+
+        def record(tag: Optional[str]) -> None:
+            if tag is None:
+                return
+            kind, _, value = tag.partition(":")
+            (env_set if kind == "env" else sel_set).add(value)
+
+        for node in ast.walk(fn):
+            # {KEY: value} literals
+            if isinstance(node, ast.Dict):
+                for key in node.keys:
+                    if key is not None:
+                        record(classify(key))
+            # x[KEY] = value  (skip os.environ — that's a read-side set)
+            elif isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Subscript) and \
+                            "environ" not in dotted(tgt.value):
+                        record(classify(tgt.slice))
+            # x.setdefault(KEY, value)
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "setdefault" and node.args and \
+                    "environ" not in dotted(node.func.value):
+                record(classify(node.args[0]))
+        return env_set, sel_set

@@ -113,9 +113,15 @@ class ReverseProxy:
         this `/apis/tpu.dev/v1/../../api/v1/...` would pass the prefix
         check and reach the upstream with injected credentials).
         Returns None for paths that must be refused outright."""
-        # Encoded dots could decode to traversal after forwarding —
-        # refuse rather than guess the upstream's decode order.
-        if "%2e" in path.lower():
+        # ANY percent-escape is refused, not just %2e: an encoded slash
+        # (%2f, or double-encoded %252f) passes the prefix check and the
+        # dot-segment normalization here, then a decode-before-route
+        # upstream resolves it into a path separator — traversal with
+        # our injected credentials attached.  K8s API path segments
+        # (group/version/namespace/name) never legitimately contain
+        # percent-escapes, so refusing outright loses nothing and beats
+        # guessing the upstream's decode order.
+        if "%" in path:
             return None
         norm = posixpath.normpath(path)
         if not norm.startswith("/") or ".." in norm.split("/"):

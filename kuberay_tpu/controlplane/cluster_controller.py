@@ -44,7 +44,7 @@ from kuberay_tpu.builders.service import (
 from kuberay_tpu.controlplane.events import EventRecorder
 from kuberay_tpu.controlplane.expectations import HEAD_GROUP, ScaleExpectations
 from kuberay_tpu.controlplane.store import (AlreadyExists, NotFound,
-                                             ObjectStore, carry_rv)
+                                             ObjectStore, StoreError)
 from kuberay_tpu.utils import constants as C
 from kuberay_tpu.utils.names import head_service_name, spec_hash
 from kuberay_tpu.utils.validation import (
@@ -98,7 +98,16 @@ class TpuClusterController:
     # ------------------------------------------------------------------
 
     def reconcile(self, name: str, namespace: str = "default") -> Optional[float]:
-        """Returns requeue-after seconds or None."""
+        """Returns requeue-after seconds or None.
+
+        Optimistic-concurrency contract (SURVEY §5.2): ``raw`` is the
+        reconcile-start snapshot and every decision below derives from
+        it, so every write in the pass carries ITS resourceVersion —
+        threaded through ``cluster.metadata.resourceVersion`` and bumped
+        only by our own writes' return values, never by a pre-write
+        re-read.  A foreign write anywhere in the pass (leader-failover
+        overlap) therefore 409s and requeues instead of being clobbered.
+        """
         raw = self.store.try_get(self.KIND, name, namespace)
         if raw is None:
             self.exp.forget_cluster(namespace, name)
@@ -128,7 +137,7 @@ class TpuClusterController:
 
         self._ensure_finalizer(cluster)
         self._reconcile_services(cluster)
-        requeue = self._reconcile_pods(cluster)
+        requeue = self._reconcile_pods(cluster, raw)
         self._update_status(cluster)
         return requeue
 
@@ -143,9 +152,13 @@ class TpuClusterController:
     def _ensure_finalizer(self, cluster: TpuCluster):
         if self._needs_cleanup_finalizer(cluster):
             if C.FINALIZER_GCS_FT not in cluster.metadata.finalizers:
-                self.store.add_finalizer(self.KIND, cluster.metadata.name,
-                                         cluster.metadata.namespace,
-                                         C.FINALIZER_GCS_FT)
+                out = self.store.add_finalizer(
+                    self.KIND, cluster.metadata.name,
+                    cluster.metadata.namespace, C.FINALIZER_GCS_FT,
+                    rv=cluster.metadata.resourceVersion)
+                cluster.metadata.finalizers.append(C.FINALIZER_GCS_FT)
+                cluster.metadata.resourceVersion = \
+                    out["metadata"]["resourceVersion"]
 
     def _reconcile_deletion(self, cluster: TpuCluster) -> Optional[float]:
         ns, name = cluster.metadata.namespace, cluster.metadata.name
@@ -288,7 +301,8 @@ class TpuClusterController:
             ],
         })
 
-    def _reconcile_pods(self, cluster: TpuCluster) -> Optional[float]:
+    def _reconcile_pods(self, cluster: TpuCluster,
+                        raw: Dict[str, Any]) -> Optional[float]:
         ns, name = cluster.metadata.namespace, cluster.metadata.name
         pods = self._cluster_pods(cluster)
 
@@ -346,7 +360,7 @@ class TpuClusterController:
         # One pod list serves every group (avoids O(groups x pods) store
         # scans); per-group deletions only touch that group's own slices.
         for group in cluster.spec.workerGroupSpecs:
-            r = self._reconcile_worker_group(cluster, group, thash, live)
+            r = self._reconcile_worker_group(cluster, group, thash, live, raw)
             requeue = min(r, requeue) if (r and requeue) else (r or requeue)
         return requeue
 
@@ -368,7 +382,8 @@ class TpuClusterController:
     def _reconcile_worker_group(self, cluster: TpuCluster,
                                 group: WorkerGroupSpec,
                                 thash: str,
-                                live_pods: List[Dict[str, Any]]
+                                live_pods: List[Dict[str, Any]],
+                                raw: Dict[str, Any]
                                 ) -> Optional[float]:
         ns, name = cluster.metadata.namespace, cluster.metadata.name
         if not self.exp.satisfied(ns, name, group.groupName):
@@ -423,7 +438,8 @@ class TpuClusterController:
                     del slices[idx]
                     executed.add(sname)
             if executed:
-                self._clear_executed_victims(cluster, group.groupName, executed)
+                self._clear_executed_victims(cluster, raw,
+                                             group.groupName, executed)
 
         # 4. Diff in slice units (ref :1343-1378).
         desired = max(0, group.replicas)
@@ -468,14 +484,17 @@ class TpuClusterController:
                     f"scaled down slice {group.groupName}/{idx}")
         return None
 
-    def _clear_executed_victims(self, cluster: TpuCluster, group_name: str,
+    def _clear_executed_victims(self, cluster: TpuCluster,
+                                raw: Dict[str, Any], group_name: str,
                                 executed: set):
-        obj = self.store.try_get(self.KIND, cluster.metadata.name,
-                                 cluster.metadata.namespace)
-        if obj is None:
-            return
+        """Mutates the reconcile-start snapshot (``raw`` — the pristine
+        spec, NOT the template-resolved in-memory copy) and writes it
+        under the snapshot's rv: the victims were chosen from that
+        snapshot, so a foreign spec write in the window 409s and the
+        whole pass recomputes, instead of the stale victim list landing
+        on top of it."""
         changed = False
-        for g in obj["spec"].get("workerGroupSpecs", []):
+        for g in raw["spec"].get("workerGroupSpecs", []):
             if g.get("groupName") != group_name:
                 continue
             ss = g.get("scaleStrategy") or {}
@@ -486,10 +505,15 @@ class TpuClusterController:
                 g["scaleStrategy"] = ss
                 changed = True
         if changed:
-            # obj carries the rv of the fresh read above — a concurrent
-            # writer between that read and this update 409s and requeues
-            # (optimistic concurrency, SURVEY §5.2).
-            self.store.update(obj)
+            raw["metadata"]["resourceVersion"] = \
+                cluster.metadata.resourceVersion
+            out = self.store.update(raw)
+            # Thread our own bump so the status write at the end of the
+            # pass doesn't self-conflict.
+            cluster.metadata.resourceVersion = \
+                out["metadata"]["resourceVersion"]
+            raw["metadata"]["resourceVersion"] = \
+                out["metadata"]["resourceVersion"]
 
     # ------------------------------------------------------------------
     # status (ref calculateStatus :1874 + consistency.go throttling)
@@ -578,20 +602,16 @@ class TpuClusterController:
         new = status.to_dict()
         if self._status_equal(prev, new):
             return
-        # Fresh read immediately before the write: our own mid-reconcile
-        # metadata writes (finalizer add, victim clearing) must not
-        # self-conflict, while a FOREIGN write in the read→write window
-        # — the leader-failover overlap — must 409 and requeue rather
-        # than silently clobber the new leader's status (optimistic
-        # concurrency via resourceVersion, SURVEY §5.2; the old
-        # single-writer assumption is gone).
-        cur = self.store.try_get(self.KIND, cluster.metadata.name,
-                                 cluster.metadata.namespace)
-        if cur is None:
-            return
+        # The write carries the reconcile-start resourceVersion (plus
+        # bumps threaded from our own mid-reconcile writes — finalizer
+        # add, victim clearing).  NO pre-write re-read: this status was
+        # computed from the snapshot, so a FOREIGN write anywhere in the
+        # pass — the leader-failover overlap — must 409 and requeue
+        # rather than silently clobber the new leader's status
+        # (optimistic concurrency via resourceVersion, SURVEY §5.2).
         obj = cluster.to_dict()
         obj["status"] = new
-        self.store.update_status(carry_rv(obj, cur))
+        self._write_status(obj)
 
     def _set_status(self, cluster: TpuCluster, state: str, reason: str = ""):
         obj = cluster.to_dict()
@@ -600,11 +620,21 @@ class TpuClusterController:
             return
         st["state"] = state
         st["reason"] = reason
-        cur = self.store.try_get(self.KIND, cluster.metadata.name,
-                                 cluster.metadata.namespace)
-        if cur is None:
+        # Snapshot rv, same contract as _update_status.
+        self._write_status(obj)
+
+    def _write_status(self, obj: Dict[str, Any]):
+        if not obj["metadata"].get("resourceVersion"):
+            # Loud, like carry_rv: an rv-less write silently reverts to
+            # last-writer-wins, the bug class this contract prevents.
+            raise StoreError(
+                f"{self.KIND} {obj['metadata'].get('name')}: snapshot has "
+                "no resourceVersion; refusing an unguarded status write")
+        try:
+            self.store.update_status(obj)
+        except NotFound:
+            # Deleted mid-reconcile: the deletion path owns cleanup.
             return
-        self.store.update_status(carry_rv(obj, cur))
 
     @staticmethod
     def _status_equal(a: Dict[str, Any], b: Dict[str, Any]) -> bool:

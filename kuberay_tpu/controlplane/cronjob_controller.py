@@ -7,6 +7,7 @@ Feature-gated (``TpuCronJob``) like the reference.
 
 from __future__ import annotations
 
+import copy
 import time
 from typing import List, Optional
 
@@ -15,7 +16,7 @@ from kuberay_tpu.api.tpujob import JobDeploymentStatus
 from kuberay_tpu.builders.common import owner_reference
 from kuberay_tpu.controlplane.events import EventRecorder
 from kuberay_tpu.controlplane.store import (AlreadyExists, NotFound,
-                                             ObjectStore, carry_rv)
+                                             ObjectStore)
 from kuberay_tpu.utils import constants as C
 from kuberay_tpu.utils import features
 from kuberay_tpu.utils.cron import missed_runs, next_run_after
@@ -40,6 +41,9 @@ class TpuCronJobController:
         if not features.enabled("TpuCronJob"):
             return None
         cron = TpuCronJob.from_dict(raw)
+        # Snapshot status for the update throttle; the final write
+        # carries the reconcile-start rv (SURVEY §5.2).
+        cron._orig_status = copy.deepcopy(raw.get("status", {}))
         if cron.metadata.deletionTimestamp:
             return None   # child jobs are GC'd via ownerReferences
 
@@ -160,9 +164,14 @@ class TpuCronJobController:
 
     def _update_status(self, cron: TpuCronJob):
         obj = cron.to_dict()
-        cur = self.store.try_get(self.KIND, cron.metadata.name,
-                                 cron.metadata.namespace)
-        if cur is not None and cur.get("status") != obj.get("status"):
-            # rv precondition from the pre-write read: a foreign write
-            # in the window 409s and requeues (SURVEY §5.2).
-            self.store.update_status(carry_rv(obj, cur))
+        # rv precondition = the reconcile-start snapshot (no pre-write
+        # re-read): a foreign write anywhere in the pass 409s and
+        # requeues instead of being clobbered (SURVEY §5.2).
+        if obj.get("status") == getattr(cron, "_orig_status", None):
+            return
+        try:
+            out = self.store.update_status(obj)
+        except NotFound:
+            return      # deleted mid-reconcile
+        cron.metadata.resourceVersion = out["metadata"]["resourceVersion"]
+        cron._orig_status = copy.deepcopy(out.get("status", {}))

@@ -18,6 +18,7 @@ coordinator — ref createK8sJobIfNeed :560 / checkSubmitterAndUpdateStatus
 
 from __future__ import annotations
 
+import copy
 import time
 from typing import Callable, Optional
 
@@ -37,7 +38,7 @@ from kuberay_tpu.builders.job import (
 )
 from kuberay_tpu.controlplane.events import EventRecorder
 from kuberay_tpu.controlplane.store import (AlreadyExists, NotFound,
-                                             ObjectStore, carry_rv)
+                                             ObjectStore)
 from kuberay_tpu.runtime.coordinator_client import CoordinatorError
 from kuberay_tpu.utils import constants as C
 from kuberay_tpu.utils.names import (
@@ -69,6 +70,12 @@ class TpuJobController:
         if raw is None:
             return None
         job = TpuJob.from_dict(raw)
+        # Snapshot status for the update throttle + the snapshot rv
+        # contract: every write in this pass carries the reconcile-start
+        # resourceVersion (threaded through job.metadata by our own
+        # writes' return values), so a foreign write 409s instead of
+        # being clobbered (SURVEY §5.2).
+        job._orig_status = copy.deepcopy(raw.get("status", {}))
 
         if job.spec.managedBy and job.spec.managedBy != C.CREATED_BY_OPERATOR:
             return None
@@ -102,10 +109,16 @@ class TpuJobController:
                                   "; ".join(errs))
             return self._fail(job, JobFailedReason.VALIDATION_FAILED,
                               "; ".join(errs)[:500])
-        self.store.add_finalizer(self.KIND, job.metadata.name,
-                                 job.metadata.namespace, C.FINALIZER_JOB)
-        job = TpuJob.from_dict(self.store.get(
-            self.KIND, job.metadata.name, job.metadata.namespace))
+        # rv precondition = the reconcile-start snapshot; the returned
+        # object threads the bump (no post-write re-read, which would
+        # adopt a foreign writer's rv and mask the conflict).
+        out = self.store.add_finalizer(self.KIND, job.metadata.name,
+                                       job.metadata.namespace,
+                                       C.FINALIZER_JOB,
+                                       rv=job.metadata.resourceVersion)
+        orig_status = job._orig_status
+        job = TpuJob.from_dict(out)
+        job._orig_status = orig_status
         # Attempt-suffixed id: each retry is a distinct submission against a
         # fresh cluster (ref JobId init :887; suffix disambiguates attempts).
         attempt = int(job.status.failed)
@@ -494,11 +507,17 @@ class TpuJobController:
 
     def _update(self, job: TpuJob):
         obj = job.to_dict()
-        # Fresh rv from the pre-write read: our own finalizer/metadata
-        # writes earlier in the pass can't self-conflict, but a foreign
-        # write in the read→write window (leader-failover overlap) 409s
-        # and requeues instead of clobbering (SURVEY §5.2).
-        cur = self.store.try_get(self.KIND, job.metadata.name,
-                                 job.metadata.namespace)
-        if cur is not None and cur.get("status") != obj.get("status"):
-            self.store.update_status(carry_rv(obj, cur))
+        # Throttle against the snapshot status, then write under the
+        # reconcile-start rv (threaded through job.metadata by our own
+        # earlier writes).  NO pre-write re-read: this status was
+        # computed from the snapshot, so a foreign write anywhere in
+        # the pass (leader-failover overlap) 409s and requeues instead
+        # of being clobbered (SURVEY §5.2).
+        if obj.get("status") == getattr(job, "_orig_status", None):
+            return
+        try:
+            out = self.store.update_status(obj)
+        except NotFound:
+            return      # deleted mid-reconcile; deletion path owns cleanup
+        job.metadata.resourceVersion = out["metadata"]["resourceVersion"]
+        job._orig_status = copy.deepcopy(out.get("status", {}))

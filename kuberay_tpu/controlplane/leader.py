@@ -11,6 +11,7 @@ operators need.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 import uuid
@@ -24,6 +25,8 @@ from kuberay_tpu.controlplane.store import (
 )
 
 LEASE_NAME = "kuberay-tpu-operator-leader"
+
+_LOG = logging.getLogger("kuberay_tpu.leader")
 
 
 class LeaderElector:
@@ -95,14 +98,17 @@ class LeaderElector:
                     try:
                         self.on_started_leading()
                     except Exception:
-                        pass   # a callback bug must not kill renewal
+                        # A callback bug must not kill renewal — but it
+                        # must be VISIBLE, or the operator "leads" while
+                        # its reconcilers never started.
+                        _LOG.exception("on_started_leading callback failed")
             elif not leading and self._is_leader:
                 self._is_leader = False
                 if self.on_stopped_leading:
                     try:
                         self.on_stopped_leading()
                     except Exception:
-                        pass
+                        _LOG.exception("on_stopped_leading callback failed")
             stop.wait(self.renew_interval if leading
                       else min(self.renew_interval, 2.0))
 

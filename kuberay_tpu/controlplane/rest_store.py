@@ -23,6 +23,7 @@ endpoint (kubeconfig-style credentials, minus the kubeconfig file).
 from __future__ import annotations
 
 import json
+import logging
 import threading
 import urllib.error
 import urllib.parse
@@ -38,6 +39,8 @@ from kuberay_tpu.controlplane.store import (
     NotFound,
     StoreError,
 )
+
+_LOG = logging.getLogger("kuberay_tpu.rest_store")
 
 _CRD_PLURALS = C.CRD_PLURALS
 _CORE_PLURALS = C.CORE_PLURALS
@@ -261,39 +264,49 @@ class RestObjectStore:
         self._req("DELETE", self._path(kind, namespace, name))
 
     def add_finalizer(self, kind: str, name: str, namespace: str,
-                      finalizer: str):
+                      finalizer: str, rv=None):
         # Strategic set-merge on metadata.finalizers (kube
-        # patchStrategy=merge): union, idempotent, race-free.
-        self.patch(kind, name, namespace,
-                   {"metadata": {"finalizers": [finalizer]}},
-                   patch_type="strategic")
+        # patchStrategy=merge): union, idempotent, race-free.  Returns
+        # the updated object so callers can thread the bumped
+        # resourceVersion; ``rv`` adds a precondition (Conflict on a
+        # foreign write in the window).
+        md: Dict[str, Any] = {"finalizers": [finalizer]}
+        if rv is not None:
+            md["resourceVersion"] = rv
+        return self.patch(kind, name, namespace, {"metadata": md},
+                          patch_type="strategic")
 
     def remove_finalizer(self, kind: str, name: str, namespace: str,
-                         finalizer: str):
+                         finalizer: str, rv=None):
         # Removal needs the full remaining list (merge can't subtract
         # from a set-merge list), so it keeps the rv-guarded RMW — but
-        # via PATCH with a resourceVersion precondition, not PUT.
-        for _ in range(4):
+        # via PATCH with a resourceVersion precondition, not PUT.  With
+        # an explicit ``rv`` the precondition is the caller's snapshot
+        # and a Conflict propagates (no silent retry against it).
+        for _ in range(1 if rv is not None else 4):
             cur = self.try_get(kind, name, namespace)
             if cur is None:
-                return
+                return None
             fins = cur["metadata"].get("finalizers", [])
             if finalizer not in fins:
-                return
+                return cur
             try:
-                self.patch(
+                return self.patch(
                     kind, name, namespace,
                     {"metadata": {
                         "resourceVersion":
-                            cur["metadata"]["resourceVersion"],
+                            rv if rv is not None
+                            else cur["metadata"]["resourceVersion"],
                         "finalizers":
                             [f for f in fins if f != finalizer]}},
                     patch_type="merge")
-                return
             except Conflict:
+                if rv is not None:
+                    raise
                 continue
             except NotFound:
-                return
+                return None
+        return None
 
     def count(self, kind: str) -> int:
         return len(self.list(kind))
@@ -348,12 +361,18 @@ class RestObjectStore:
                         daemon=True, name="rest-watch")
                     self._poll_thread.start()
 
+        # Snapshot under the lock; the sync wait happens OUTSIDE it so a
+        # slow relist doesn't serialize every other store caller.
+        with self._lock:
+            kind_threads = list(self._kind_threads)
+            synced = self._synced
+
         # WaitForCacheSync: block until every kind completed its initial
         # relist — from that point on, any change is guaranteed to reach
         # watchers (each stream resumes from its relist rv), the contract
         # the in-memory store gives for free by synchronous registration.
-        if self._kind_threads:
-            self._synced.wait(timeout=15.0)
+        if kind_threads:
+            synced.wait(timeout=15.0)
 
         def cancel():
             with self._lock:
@@ -362,14 +381,18 @@ class RestObjectStore:
         return cancel
 
     def close(self):
-        self._stop.set()
-        t = self._poll_thread
-        if t is not None:
+        # Detach thread state under the lock; join OUTSIDE it (a wedged
+        # long-poll must not hold up every other store caller).
+        with self._lock:
+            self._stop.set()
+            poll_thread = self._poll_thread
+            self._poll_thread = None
+            kind_threads = self._kind_threads
+            self._kind_threads = []
+        if poll_thread is not None:
+            poll_thread.join(timeout=2.0)
+        for t in kind_threads:
             t.join(timeout=2.0)
-        self._poll_thread = None
-        for t in self._kind_threads:
-            t.join(timeout=2.0)
-        self._kind_threads = []
 
     def _start_kind_threads_locked(self):
         """Start the per-kind k8s watch threads (caller holds _lock)."""
@@ -389,7 +412,10 @@ class RestObjectStore:
                 try:
                     w(ev)
                 except Exception:
-                    pass
+                    # Watcher errors never poison the stream, but a
+                    # controller throwing on every event must be visible.
+                    _LOG.exception("watcher failed on %s %s",
+                                   ev.type, ev.kind)
 
     # -- K8s-native streaming watch ---------------------------------------
 
@@ -605,7 +631,10 @@ class RestObjectStore:
                 try:
                     w(ev)
                 except Exception:
-                    pass
+                    # Watcher errors never poison the stream, but a
+                    # controller throwing on every event must be visible.
+                    _LOG.exception("watcher failed on %s %s",
+                                   ev.type, ev.kind)
 
     def _poll_loop(self, stop: threading.Event, try_legacy: bool = True,
                    reprobe: bool = False):
@@ -653,7 +682,11 @@ class RestObjectStore:
             try:
                 self._poll_once()
             except Exception:
-                pass
+                # Transient server blip: routine for a poller, retried
+                # next interval — logged at debug so a persistent outage
+                # still leaves a trail.
+                _LOG.debug("list-diff poll failed; retrying",
+                           exc_info=True)
             stop.wait(self.poll_interval)
 
     def _resync(self):
@@ -664,7 +697,8 @@ class RestObjectStore:
         try:
             self._poll_once()
         except Exception:
-            pass
+            _LOG.debug("relist during resync failed; stream will retry",
+                       exc_info=True)
         return rv0
 
     def _probe_watch_rv(self):
@@ -714,5 +748,8 @@ class RestObjectStore:
                 try:
                     w(ev)
                 except Exception:
-                    pass
+                    # Watcher errors never poison the stream, but a
+                    # controller throwing on every event must be visible.
+                    _LOG.exception("watcher failed on %s %s",
+                                   ev.type, ev.kind)
         return int(out.get("resourceVersion", rv))

@@ -17,6 +17,7 @@ whole-slice quanta.
 
 from __future__ import annotations
 
+import copy
 import time
 from typing import Callable, Dict, Optional
 
@@ -33,7 +34,7 @@ from kuberay_tpu.builders.common import attach_cluster_auth, owner_reference
 from kuberay_tpu.builders.service import build_serve_service
 from kuberay_tpu.controlplane.events import EventRecorder
 from kuberay_tpu.controlplane.store import (AlreadyExists, NotFound,
-                                             ObjectStore, carry_rv)
+                                             ObjectStore)
 from kuberay_tpu.runtime.coordinator_client import CoordinatorError
 from kuberay_tpu.utils import constants as C
 from kuberay_tpu.utils import features
@@ -71,6 +72,11 @@ class TpuServiceController:
         if raw is None:
             return None
         svc = TpuService.from_dict(raw)
+        # Snapshot status for the update throttle + the snapshot rv
+        # contract: writes in this pass carry the reconcile-start
+        # resourceVersion (bumped only by our own writes' return
+        # values), so a foreign write 409s instead of being clobbered.
+        svc._orig_status = copy.deepcopy(raw.get("status", {}))
 
         if svc.metadata.deletionTimestamp:
             return self._reconcile_deletion(svc)
@@ -81,8 +87,12 @@ class TpuServiceController:
             return None
 
         if C.FINALIZER_SERVICE not in svc.metadata.finalizers:
-            self.store.add_finalizer(self.KIND, name, namespace,
-                                     C.FINALIZER_SERVICE)
+            out = self.store.add_finalizer(self.KIND, name, namespace,
+                                           C.FINALIZER_SERVICE,
+                                           rv=svc.metadata.resourceVersion)
+            svc.metadata.finalizers.append(C.FINALIZER_SERVICE)
+            svc.metadata.resourceVersion = \
+                out["metadata"]["resourceVersion"]
 
         if svc.spec.suspend:
             return self._reconcile_suspend(svc)
@@ -574,14 +584,18 @@ class TpuServiceController:
                 if p.get("status", {}).get("phase") == "Running"
                 and p["metadata"]["labels"].get(C.LABEL_SERVE) == "true")
         obj = svc.to_dict()
-        # Status is recomputed idempotently from observed state; carry
-        # the rv of the pre-write read so our own mid-reconcile metadata
-        # writes (finalizer add) don't self-conflict while a foreign
-        # write in the read→write window (leader-failover overlap) 409s
-        # and requeues instead of clobbering (SURVEY §5.2).
-        cur = self.store.try_get(self.KIND, svc.metadata.name,
-                                 svc.metadata.namespace)
-        if cur is not None and cur.get("status") != obj.get("status"):
-            self.store.update_status(carry_rv(obj, cur))
+        # Status is recomputed idempotently from the reconcile-start
+        # snapshot, so the write carries the SNAPSHOT rv (plus our own
+        # threaded bumps — finalizer add).  NO pre-write re-read: a
+        # foreign write anywhere in the pass (leader-failover overlap)
+        # 409s and requeues instead of being clobbered (SURVEY §5.2).
+        if obj.get("status") != getattr(svc, "_orig_status", None):
+            try:
+                out = self.store.update_status(obj)
+            except NotFound:
+                return      # deleted mid-reconcile
+            svc.metadata.resourceVersion = \
+                out["metadata"]["resourceVersion"]
+            svc._orig_status = copy.deepcopy(out.get("status", {}))
 
         self.reap_retired_clusters(svc.metadata.namespace)

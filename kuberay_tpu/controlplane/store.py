@@ -15,11 +15,14 @@ from __future__ import annotations
 
 import copy
 import json
+import logging
 import os
 import threading
 import time
 import uuid
 from typing import Any, Callable, Dict, List, Optional, Tuple
+
+_LOG = logging.getLogger("kuberay_tpu.store")
 
 
 class StoreError(Exception):
@@ -47,6 +50,14 @@ def carry_rv(obj: Dict[str, Any], cur: Dict[str, Any]) -> Dict[str, Any]:
     an optimistic-concurrency precondition (SURVEY §5.2): a foreign
     write between the ``cur`` read and the update raises Conflict and
     the reconciler requeues instead of clobbering.
+
+    ONLY valid when ``obj``'s payload was computed from ``cur`` itself
+    (single read-modify-write).  Stamping a payload computed from an
+    *earlier* snapshot with a *fresh* read's rv defeats the precondition
+    — the clobber pattern the ``rv-precondition`` lint rule flags
+    (docs/static-analysis.md); reconcilers instead carry the
+    reconcile-start rv through the pass, threading bumps from their own
+    writes' return values.
 
     Loud on a store that omits rv — a missing precondition would
     silently revert to last-writer-wins, which is exactly the bug class
@@ -236,7 +247,7 @@ class ObjectStore:
         flush() after close(), and a compaction swap only closes the old
         engine after draining+syncing it, so frames appended under the
         lock are durable on whichever engine the swap race hands us."""
-        j = self._journal
+        j = self._journal   # kuberay-lint: disable=lock-discipline
         if j is not None:
             j.flush()
 
@@ -314,7 +325,11 @@ class ObjectStore:
             try:
                 w(ev)
             except Exception:
-                pass  # watcher errors never poison the store
+                # Watcher errors never poison the store — but a watcher
+                # that throws on every event is a wedged controller, so
+                # it must show up in logs, not vanish.
+                _LOG.exception("store watcher failed on %s %s",
+                               ev.type, ev.kind)
 
     def watch(self, fn: Callable[[Event], None]) -> Callable[[], None]:
         """Register a watcher; returns an unsubscribe function."""
@@ -605,33 +620,55 @@ class ObjectStore:
         self._journal_ack()
 
     def remove_finalizer(self, kind: str, name: str, namespace: str,
-                         finalizer: str) -> None:
+                         finalizer: str,
+                         rv: Optional[int] = None) -> Optional[Dict[str, Any]]:
+        """Remove a finalizer; returns the updated object (None when the
+        object is gone).  ``rv`` is an optional optimistic-concurrency
+        precondition — pass the reconcile-start resourceVersion so a
+        foreign write in the window raises Conflict instead of being
+        silently raced."""
         with self._lock:
             cur = self._objects.get(_key(kind, namespace, name))
             if cur is None:
-                return
+                return None
+            if rv is not None and cur["metadata"]["resourceVersion"] != rv:
+                raise Conflict(
+                    f"{kind} {namespace}/{name}: resourceVersion {rv} "
+                    f"!= {cur['metadata']['resourceVersion']}")
             fins = cur["metadata"].get("finalizers", [])
             if finalizer in fins:
                 fins.remove(finalizer)
                 cur["metadata"]["resourceVersion"] = self._next_rv()
                 self._journal_put(cur)
                 self._notify(Event(Event.MODIFIED, kind, copy.deepcopy(cur)))
+            out = copy.deepcopy(cur)
         self._maybe_finalize_delete(kind, name, namespace)
         self._journal_ack()
+        return out
 
     def add_finalizer(self, kind: str, name: str, namespace: str,
-                      finalizer: str) -> None:
+                      finalizer: str,
+                      rv: Optional[int] = None) -> Dict[str, Any]:
+        """Add a finalizer; returns the updated object so callers can
+        thread the bumped resourceVersion through the reconcile pass.
+        ``rv``: optional precondition (see :meth:`remove_finalizer`)."""
         with self._lock:
             cur = self._objects.get(_key(kind, namespace, name))
             if cur is None:
                 raise NotFound(f"{kind} {namespace}/{name} not found")
+            if rv is not None and cur["metadata"]["resourceVersion"] != rv:
+                raise Conflict(
+                    f"{kind} {namespace}/{name}: resourceVersion {rv} "
+                    f"!= {cur['metadata']['resourceVersion']}")
             fins = cur["metadata"].setdefault("finalizers", [])
             if finalizer not in fins:
                 fins.append(finalizer)
                 cur["metadata"]["resourceVersion"] = self._next_rv()
                 self._journal_put(cur)
                 self._notify(Event(Event.MODIFIED, kind, copy.deepcopy(cur)))
+            out = copy.deepcopy(cur)
         self._journal_ack()
+        return out
 
     def _maybe_finalize_delete(self, kind: str, name: str, namespace: str):
         """Remove the object if it is terminating with no finalizers, then

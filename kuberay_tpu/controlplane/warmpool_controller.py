@@ -27,7 +27,7 @@ from kuberay_tpu.builders.common import owner_reference
 from kuberay_tpu.builders.pod import build_slice_pods
 from kuberay_tpu.controlplane.events import EventRecorder
 from kuberay_tpu.controlplane.store import (AlreadyExists, NotFound,
-                                             ObjectStore, carry_rv)
+                                             ObjectStore)
 from kuberay_tpu.topology import TopologyError
 from kuberay_tpu.utils import constants as C
 from kuberay_tpu.utils import features
@@ -168,12 +168,14 @@ class WarmSlicePoolController:
                   "readySlices": ready, "hostsPerSlice": hosts}
         if obj.get("status") != status:
             obj["status"] = status
-            cur = self.store.try_get(self.KIND, name, namespace)
-            if cur is None:
-                return None
-            # rv precondition: a foreign write (leader-failover overlap)
-            # 409s and requeues instead of clobbering (SURVEY §5.2).
-            self.store.update_status(carry_rv(obj, cur))
+            # rv precondition = the reconcile-start snapshot already in
+            # ``obj`` (no pre-write re-read): a foreign write in the
+            # pass (leader-failover overlap) 409s and requeues instead
+            # of clobbering (SURVEY §5.2).
+            try:
+                self.store.update_status(obj)
+            except NotFound:
+                return None     # deleted mid-reconcile
         return None
 
     def claim(self, name: str, namespace: str = "default") -> Optional[List[str]]:

@@ -28,6 +28,7 @@ Archive layout (shared with server.py):
 
 from __future__ import annotations
 
+import logging
 import json
 import os
 import threading
@@ -37,6 +38,9 @@ import urllib.request
 from typing import Dict, Optional, Tuple
 
 from kuberay_tpu.history.storage import StorageBackend
+
+
+_LOG = logging.getLogger("kuberay_tpu.history.collector")
 
 
 def stamp_collection(storage: StorageBackend, namespace: str,
@@ -103,7 +107,9 @@ class LogCollector:
             try:
                 self.poll_once()
             except Exception:
-                pass   # storage hiccup: retry next poll
+                # Storage hiccup: retried next poll, but a persistently
+                # failing backend must leave a trail.
+                _LOG.debug("history poll failed; retrying", exc_info=True)
             self._stop.wait(self.poll_interval)
 
     def stop(self):

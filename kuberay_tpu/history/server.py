@@ -24,6 +24,7 @@ directory, S3, or GCS (the reference's storage interface seam).
 
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 import time
@@ -37,6 +38,9 @@ from kuberay_tpu.utils.httpjson import JsonHandler
 
 __all__ = ["HistoryCollector", "HistoryServer", "LocalStorage",
            "StorageBackend"]
+
+_LOG = logging.getLogger("kuberay_tpu.history.server")
+
 
 _ARCHIVED_KINDS = ("TpuCluster", "TpuJob", "TpuService", "TpuCronJob")
 
@@ -91,7 +95,10 @@ class HistoryCollector:
             try:
                 self._archive(ev)
             except Exception:
-                pass   # storage hiccup: drop this snapshot, not the thread
+                # Storage hiccup: drop this snapshot, not the thread —
+                # visibly, or a dead backend looks like a quiet cluster.
+                _LOG.debug("archive failed for %s %s; snapshot dropped",
+                           ev.type, ev.kind, exc_info=True)
 
     def _archive(self, ev: Event):
         if ev.kind not in _ARCHIVED_KINDS:

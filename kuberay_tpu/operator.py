@@ -34,7 +34,7 @@ from kuberay_tpu.controlplane.manager import (
 from kuberay_tpu.controlplane.networkpolicy_controller import NetworkPolicyController
 from kuberay_tpu.controlplane.service_controller import TpuServiceController
 from kuberay_tpu.controlplane.leader import LeaderElector
-from kuberay_tpu.controlplane.store import ObjectStore
+from kuberay_tpu.controlplane.store import ObjectStore, StoreError
 from kuberay_tpu.controlplane.warmpool_controller import (
     KIND_WARM_POOL,
     WarmSlicePoolController,
@@ -262,8 +262,10 @@ class Operator:
                 try:
                     self.store.delete("Event", ev["metadata"]["name"],
                                       ev["metadata"]["namespace"])
-                except Exception:
-                    pass
+                except StoreError:
+                    # Raced another GC / server blip: the event either
+                    # died already or ages out next sweep.
+                    continue
 
     def stop(self):
         # Reconcilers stop BEFORE the lease is released: a successor must

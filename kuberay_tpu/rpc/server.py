@@ -24,6 +24,7 @@ the token is the opaque offset of the next page.
 
 from __future__ import annotations
 
+import hmac
 import threading
 from concurrent import futures
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -235,7 +236,11 @@ class RpcServer:
         if not self.token:
             return
         md = dict(context.invocation_metadata())
-        if md.get("authorization") != f"Bearer {self.token}":
+        # Constant-time compare: a '!=' short-circuits at the first
+        # differing byte, leaking the token prefix length through
+        # response timing (byte-by-byte brute force over the network).
+        if not hmac.compare_digest(md.get("authorization", ""),
+                                   f"Bearer {self.token}"):
             context.abort(grpc.StatusCode.UNAUTHENTICATED,
                           "missing or invalid bearer token")
 

@@ -107,15 +107,20 @@ class RedisBackend(StateBackend):
         self._sock: Optional[socket.socket] = None
 
     def _cmd(self, *parts: bytes):
+        # This lock is a CONNECTION mutex, not shared-state protection:
+        # it serializes request/reply pairs on the single RESP socket
+        # (interleaved writers would mispair replies).  Holding it
+        # across the I/O is the point — every caller is doing network
+        # I/O anyway, and each command carries a 5 s socket timeout.
         with self._lock:
             try:
                 if self._sock is None:
-                    self._sock = socket.create_connection(
+                    self._sock = socket.create_connection(    # kuberay-lint: disable=blocking-under-lock
                         (self.host, self.port), timeout=5)
                 buf = b"*%d\r\n" % len(parts)
                 for p in parts:
                     buf += b"$%d\r\n%s\r\n" % (len(p), p)
-                self._sock.sendall(buf)
+                self._sock.sendall(buf)    # kuberay-lint: disable=blocking-under-lock
                 return self._read_reply(self._sock.makefile("rb"))
             except (OSError, RuntimeError):
                 # A failed/half-read exchange leaves the stream unusable;

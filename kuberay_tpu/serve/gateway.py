@@ -14,6 +14,7 @@ resolves via DNS; embedded/tests inject a name->URL map.
 
 from __future__ import annotations
 
+import logging
 import json
 import random
 import threading
@@ -23,6 +24,9 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from kuberay_tpu.utils import constants as C
 from kuberay_tpu.utils.httpjson import JsonHandler, serve_background
+
+
+_LOG = logging.getLogger("kuberay_tpu.gateway")
 
 
 class WeightedGateway:
@@ -64,7 +68,10 @@ class WeightedGateway:
             try:
                 self._refresh()
             except Exception:
-                pass
+                # Keep last-known-good backends on a refresh blip; a
+                # persistently failing control plane must be loggable.
+                _LOG.debug("route refresh failed; keeping last backends",
+                           exc_info=True)
             self._stop.wait(self.poll_interval)
 
     def close(self):

@@ -35,6 +35,23 @@ from typing import Callable, Dict, List, Optional
 HEARTBEAT_INTERVAL = 1.0
 
 
+def _quantile(samples: List[float], q: float) -> float:
+    """Linear-interpolation quantile (numpy's default / 'inclusive'
+    method).  A truncating index on a small window collapses p99 to the
+    max sample, which is exactly the degenerate estimate that let one
+    slow step dominate the adaptive budget."""
+    xs = sorted(samples)
+    if not xs:
+        return 0.0
+    if len(xs) == 1:
+        return xs[0]
+    pos = q * (len(xs) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(xs) - 1)
+    frac = pos - lo
+    return xs[lo] + (xs[hi] - xs[lo]) * frac
+
+
 class GroupMonitor:
     """Host-0 side: follower liveness + step watchdog.
 
@@ -51,8 +68,20 @@ class GroupMonitor:
     # model with legitimately long steps (big chunked-prefill batches)
     # raises its own budget, and a fast model gets far quicker hang
     # detection than any one-size constant.
+    #
+    # The feedback loop is bounded three ways (a slow-but-alive step
+    # would otherwise enter the window, inflate p99, and ratchet the
+    # budget upward without limit — each near-budget step buying the
+    # next one a bigger allowance):
+    # - samples are clamped to the budget that was in force when the
+    #   step ran (a step can't teach the window more than it was given);
+    # - the small-window p99 is interpolated, not a truncating index
+    #   that collapses to the max sample;
+    # - the adaptive budget is hard-capped at BUDGET_CAP_MULTIPLIER x
+    #   step_timeout (the operator-set order of magnitude stays law).
     WINDOW = 256
     MIN_SAMPLES = 20
+    BUDGET_CAP_MULTIPLIER = 2.0
 
     def __init__(self, expected: List[int], miss_timeout: float = 10.0,
                  step_timeout: float = 60.0,
@@ -89,7 +118,8 @@ class GroupMonitor:
 
     @property
     def degraded(self) -> Optional[str]:
-        return self._degraded
+        with self._lock:
+            return self._degraded
 
     def _mark(self, reason: str) -> None:
         fire = False
@@ -123,58 +153,73 @@ class GroupMonitor:
         that.  Never below miss_timeout — follower death is the
         heartbeat's job; the step watchdog exists for wedged-but-
         connected peers, where a few extra seconds is the right price
-        for never degrading a slow-but-alive group."""
+        for never degrading a slow-but-alive group.  Never above
+        BUDGET_CAP_MULTIPLIER x step_timeout — the adaptive loop must
+        not be able to ratchet itself arbitrarily high (see the class
+        comment)."""
         with self._lock:
             samples = list(self._durations)
         if len(samples) < self.MIN_SAMPLES:
             return self.step_timeout
-        samples.sort()
-        p99 = samples[min(len(samples) - 1, int(len(samples) * 0.99))]
-        return max(self.miss_timeout, self.budget_multiplier * p99)
+        p99 = _quantile(samples, 0.99)
+        budget = min(self.budget_multiplier * p99,
+                     self.BUDGET_CAP_MULTIPLIER * self.step_timeout)
+        return max(self.miss_timeout, budget)
 
     def step_begin(self, compiling: bool = False) -> None:
-        self._step_budget = (self.compile_timeout if compiling
-                             else self.current_step_budget())
-        self._step_compiling = compiling
-        self._step_started = time.monotonic()
+        # Budget computed before taking the lock (current_step_budget
+        # locks internally; threading.Lock is not reentrant).
+        budget = (self.compile_timeout if compiling
+                  else self.current_step_budget())
+        with self._lock:
+            self._step_budget = budget
+            self._step_compiling = compiling
+            self._step_started = time.monotonic()
 
     def step_end(self) -> None:
-        started = self._step_started
-        self._step_started = None
-        # Compile steps stay out of the distribution: one 10-minute XLA
-        # compile would inflate p99 (and thus the budget) for the next
-        # WINDOW steps.
-        if started is not None and not self._step_compiling:
-            dur = time.monotonic() - started
-            with self._lock:
-                self._durations.append(dur)
-                if len(self._durations) > self.WINDOW:
-                    del self._durations[:len(self._durations)
-                                        - self.WINDOW]
+        with self._lock:
+            started = self._step_started
+            budget = self._step_budget
+            compiling = self._step_compiling
+            self._step_started = None
+            # Compile steps stay out of the distribution: one 10-minute
+            # XLA compile would inflate p99 (and thus the budget) for
+            # the next WINDOW steps.
+            if started is None or compiling:
+                return
+            # Clamp at the budget that was in force while the step ran:
+            # a long-but-allowed step must not teach the window a larger
+            # tail than the watchdog had actually granted (the unbounded
+            # feedback loop this clamp + the hard cap exist to prevent).
+            dur = min(time.monotonic() - started, budget)
+            self._durations.append(dur)
+            if len(self._durations) > self.WINDOW:
+                del self._durations[:len(self._durations) - self.WINDOW]
 
     def check(self) -> Optional[str]:
         """One watchdog pass; returns the degradation reason (sticky)."""
-        if self._degraded:
-            return self._degraded
         now = time.monotonic()
         with self._lock:
+            if self._degraded:
+                return self._degraded
             stale = [w for w, t in self._last_beat.items()
                      if now - t > self.miss_timeout]
-        started, budget = self._step_started, self._step_budget
+            started, budget = self._step_started, self._step_budget
         if stale:
             self._mark(f"follower(s) {sorted(stale)} missed heartbeats "
                        f"for >{self.miss_timeout:.0f}s")
         elif started is not None and now - started > budget:
             self._mark(f"device step stuck for >{budget:.0f}s "
                        "(peer dead mid-collective?)")
-        return self._degraded
+        return self.degraded
 
     def status(self) -> Dict[str, object]:
         now = time.monotonic()
         with self._lock:
             ages = {str(w): round(max(0.0, now - t), 1)
                     for w, t in self._last_beat.items()}
-        return {"degraded": self._degraded, "beat_age_seconds": ages,
+            degraded = self._degraded
+        return {"degraded": degraded, "beat_age_seconds": ages,
                 "followers": self.expected,
                 "step_budget_seconds": round(self.current_step_budget(),
                                              3)}

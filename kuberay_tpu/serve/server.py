@@ -62,7 +62,8 @@ class ServeFrontend:
 
     @property
     def degraded(self) -> Optional[str]:
-        return self._degraded
+        with self._lock:
+            return self._degraded
 
     def _handle_degraded(self, reason: str) -> None:
         """One-way transition: stop admitting, fail every pending waiter
@@ -98,7 +99,7 @@ class ServeFrontend:
 
     def _loop(self):
         while not self._stop.is_set():
-            if self._degraded is not None:
+            if self.degraded is not None:
                 # Parked: device calls would hang/mispair in the dead
                 # group.  Queued requests are failed by _handle_degraded;
                 # the pod is replaced by the controller.
@@ -238,8 +239,9 @@ class ServeFrontend:
                    **getattr(self.engine, "spec_stats", {}),
                    # Paged engines expose pool/prefix-cache counters.
                    **getattr(self.engine, "stats", {})}
-        if self._degraded is not None:
-            out["degraded"] = self._degraded
+            degraded = self._degraded
+        if degraded is not None:
+            out["degraded"] = degraded
         if self.monitor is not None:
             out["group"] = self.monitor.status()
         return out
@@ -250,7 +252,7 @@ class ServeFrontend:
         instead of dropping them mid-roll.  Returns True when fully
         drained, False on timeout (remaining work is abandoned) or
         immediately when degraded (stuck collective: nothing drains)."""
-        if self._degraded is not None:
+        if self.degraded is not None:
             return False
         deadline = time.monotonic() + timeout       # wall-clock-step safe
         while time.monotonic() < deadline:
@@ -268,7 +270,7 @@ class ServeFrontend:
         inside a dead collective forever (it is daemonic; process exit
         reaps it — and the engine's STOP broadcast is skipped anyway)."""
         self._stop.set()
-        if self._degraded is not None:
+        if self.degraded is not None:
             timeout = 2.0 if timeout is None else min(timeout, 2.0)
         self._thread.join(timeout=timeout)
 

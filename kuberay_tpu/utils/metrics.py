@@ -111,8 +111,9 @@ class MetricsRegistry:
                 for b, c in zip(h.buckets, h.counts):
                     cum += c
                     le = "+Inf" if b == float("inf") else str(b)
+                    le_label = 'le="%s"' % le
                     lines.append(
-                        f"{name}_bucket{self._fmt_labels(labels, f'le=\"{le}\"')} {cum}")
+                        f"{name}_bucket{self._fmt_labels(labels, le_label)} {cum}")
                 lines.append(f"{name}_sum{self._fmt_labels(labels)} {h.total}")
                 lines.append(f"{name}_count{self._fmt_labels(labels)} {h.n}")
         return "\n".join(lines) + "\n"

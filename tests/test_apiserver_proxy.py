@@ -158,6 +158,16 @@ def test_dot_segment_traversal_refused(stack):
              "secrets", expect=404)
     _req(px, "/apis/tpu.dev/v1/%2e%2e/%2e%2e/api/v1/namespaces/"
              "kube-system/secrets", expect=404)
+    # Encoded slashes (and any other percent-escape, including the
+    # double-encoded form) are refused outright: a decode-before-route
+    # upstream would resolve %2f into a separator AFTER our prefix
+    # check, reaching out-of-scope paths with injected credentials.
+    _req(px, "/apis/tpu.dev/v1/..%2f..%2fapi/v1/namespaces/"
+             "kube-system/secrets", expect=404)
+    _req(px, "/apis/tpu.dev/v1/..%252f..%252fapi/v1/namespaces/"
+             "kube-system/secrets", expect=404)
+    _req(px, "/apis/tpu.dev/v1/namespaces/default/tpuclusters%2Fx",
+         expect=404)
     # Normalization is not over-eager: an in-scope path with a redundant
     # dot segment still works.
     lst = _req(px, "/apis/tpu.dev/v1/namespaces/./default/tpuclusters")

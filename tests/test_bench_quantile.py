@@ -1,0 +1,44 @@
+"""Pin the serve-bench percentile estimator on small samples.
+
+The old truncating index ``int(n * 0.99) - 1`` never reports the tail
+sample at small n (for n=21 it lands on the 20th of 21 values) — the
+exact outlier a p99 exists to surface.  These tests pin the interpolated
+estimate so the benchmark's headline latency number can't silently
+regress back to ~p90.
+"""
+
+import importlib.util
+import os
+
+import pytest
+
+_BENCH = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                      "benchmark", "serve_bench.py")
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location("serve_bench", _BENCH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_p99_n21_is_interpolated_not_truncated():
+    bench = _load_bench()
+    samples = list(range(1, 22))        # n=21: 1..21
+    # Truncating index int(21*0.99)-1 = 19 -> sample 20 (ignores the
+    # tail).  Interpolated p99 sits between the two largest samples.
+    assert bench.percentile(samples, 99) == pytest.approx(20.8)
+    assert bench.percentile(samples, 99) > samples[int(21 * 0.99) - 1]
+    # Order-independent.
+    assert bench.percentile(list(reversed(samples)), 99) == \
+        pytest.approx(20.8)
+
+
+def test_percentile_edges():
+    bench = _load_bench()
+    assert bench.percentile([7.0], 99) == 7.0
+    assert bench.percentile([1.0, 2.0], 50) == pytest.approx(1.5)
+    assert bench.percentile(list(range(1, 22)), 50) == 11
+    with pytest.raises(ValueError):
+        bench.percentile([], 99)

@@ -114,15 +114,22 @@ def test_failover_overlap_status_write_409s():
     snapshot = store.get(C.KIND_CLUSTER, "ov")
 
     class PausedLeaderStore:
-        """Delegates to the live store but serves the pre-failover
-        snapshot for the cluster read — exactly what the paused old
-        leader holds in memory when it resumes."""
+        """Delegates to the live store; only the FIRST cluster read (the
+        reconcile-start snapshot — where the pause happened) serves the
+        pre-failover copy.  Every later try_get returns the CURRENT
+        (post-foreign-write) object, so a controller that refreshes the
+        resourceVersion with a pre-write re-read would adopt the new
+        leader's rv and silently clobber its status — the write must
+        instead carry the snapshot rv and 409."""
 
         def __init__(self, real, snap):
             self._real, self._snap = real, snap
+            self._served_snapshot = False
 
         def try_get(self, kind, name, namespace="default"):
-            if kind == C.KIND_CLUSTER and name == "ov":
+            if kind == C.KIND_CLUSTER and name == "ov" and \
+                    not self._served_snapshot:
+                self._served_snapshot = True
                 return copy.deepcopy(self._snap)
             return self._real.try_get(kind, name, namespace)
 
@@ -151,7 +158,9 @@ def test_failover_overlap_status_write_409s():
         after_failover["status"]
 
     # Through the manager the conflict is routine: swallowed, fast
-    # requeue (re-read + recompute), not an error-backoff.
+    # requeue (re-read + recompute), not an error-backoff.  Replay the
+    # paused-leader read for the manager-driven pass.
+    old_leader.store._served_snapshot = False
     mgr2 = Manager(store)
     mgr2.register(C.KIND_CLUSTER, old_leader.reconcile)
     key = (C.KIND_CLUSTER, "default", "ov")

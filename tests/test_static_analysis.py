@@ -1,0 +1,480 @@
+"""kuberay_tpu.analysis: the reconcile-invariant lint gate.
+
+Two halves:
+
+1. every rule fires on a purpose-built bad fixture (and stays quiet on
+   the matching good one) — the rules' own regression tests;
+2. the FULL rule set runs over the real ``kuberay_tpu/`` tree and must
+   come back clean — the gate that blocks invariant regressions from
+   landing (suppressions carry their justification in the source).
+"""
+
+from __future__ import annotations
+
+import os
+import textwrap
+
+import pytest
+
+from kuberay_tpu.analysis import RULES, analyze_source, run_paths
+from kuberay_tpu.analysis.reporters import render_human, render_json
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _rules_fired(src, only=None, **kw):
+    findings = analyze_source(textwrap.dedent(src), only=only, **kw)
+    return findings, {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# rule registry sanity
+# ---------------------------------------------------------------------------
+
+def test_rule_registry_complete():
+    assert {"rv-precondition", "lock-discipline", "blocking-under-lock",
+            "exception-swallow", "tpu-env-completeness"} <= set(RULES)
+    for cls in RULES.values():
+        assert cls.DESCRIPTION and cls.INVARIANT
+
+
+# ---------------------------------------------------------------------------
+# rv-precondition
+# ---------------------------------------------------------------------------
+
+def test_rv_precondition_flags_pre_write_refresh():
+    findings, fired = _rules_fired("""
+        def _update_status(self, cluster):
+            obj = cluster.to_dict()
+            cur = self.store.try_get(self.KIND, cluster.metadata.name)
+            self.store.update_status(carry_rv(obj, cur))
+    """)
+    assert "rv-precondition" in fired
+    assert "re-read 'cur'" in findings[0].message
+
+
+def test_rv_precondition_flags_explicit_rv_cross_stamp():
+    _, fired = _rules_fired("""
+        def write(self, job):
+            obj = job.to_dict()
+            cur = self.store.try_get("TpuJob", job.metadata.name)
+            obj["metadata"]["resourceVersion"] = \\
+                cur["metadata"]["resourceVersion"]
+            self.store.update_status(obj)
+    """)
+    assert "rv-precondition" in fired
+
+
+def test_rv_precondition_flags_helper_reread_rmw():
+    _, fired = _rules_fired("""
+        def _clear(self, cluster, executed):
+            obj = self.store.try_get(self.KIND, cluster.metadata.name,
+                                     cluster.metadata.namespace)
+            obj["spec"]["slicesToDelete"] = []
+            self.store.update(obj)
+    """)
+    assert "rv-precondition" in fired
+
+
+def test_rv_precondition_allows_single_read_modify_write():
+    # The fake-kubelet shape: one read, mutate, write with ITS rv.
+    _, fired = _rules_fired("""
+        def step(self):
+            pod = self.store.try_get("Pod", "p", "default")
+            pod["status"] = {"phase": "Running"}
+            self.store.update_status(pod)
+    """)
+    assert "rv-precondition" not in fired
+
+
+def test_rv_precondition_allows_carry_rv_from_same_read():
+    _, fired = _rules_fired("""
+        def refresh(self):
+            cur = self.store.try_get(self.KIND, "x")
+            cur["status"] = {}
+            self.store.update_status(carry_rv(cur, cur))
+    """)
+    assert "rv-precondition" not in fired
+
+
+def test_rv_precondition_ignores_plain_dict_get():
+    _, fired = _rules_fired("""
+        def lookup(self, cluster):
+            obj = cluster.to_dict()
+            cur = labels.get("tpu.dev/cluster")
+            self.store.update_status(carry_rv(obj, snapshot))
+    """)
+    assert "rv-precondition" not in fired
+
+
+# ---------------------------------------------------------------------------
+# lock-discipline
+# ---------------------------------------------------------------------------
+
+LOCKED_CLASS_BAD = """
+    import threading
+
+    class Counter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._value = 0
+
+        def bump(self):
+            with self._lock:
+                self._value = self._value + 1
+
+        def read(self):
+            return self._value
+"""
+
+
+def test_lock_discipline_flags_unguarded_access():
+    findings, fired = _rules_fired(LOCKED_CLASS_BAD)
+    assert "lock-discipline" in fired
+    assert "_value" in findings[0].message
+    assert "read()" in findings[0].message
+
+
+def test_lock_discipline_accepts_guarded_access():
+    _, fired = _rules_fired("""
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._value = 0
+
+            def bump(self):
+                with self._lock:
+                    self._value = self._value + 1
+
+            def read(self):
+                with self._lock:
+                    return self._value
+    """)
+    assert "lock-discipline" not in fired
+
+
+def test_lock_discipline_interprocedural_helper_ok():
+    # _notify-style helper: every call site holds the lock.
+    _, fired = _rules_fired("""
+        import threading
+
+        class Store:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._rv = 0
+
+            def _next_rv(self):
+                self._rv = self._rv + 1
+                return self._rv
+
+            def create(self):
+                with self._lock:
+                    return self._next_rv()
+
+            def update(self):
+                with self._lock:
+                    return self._next_rv()
+    """)
+    assert "lock-discipline" not in fired
+
+
+def test_lock_discipline_init_only_helper_ok():
+    # Construction-time helpers are single-threaded.
+    _, fired = _rules_fired("""
+        import threading
+
+        class Store:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._objects = {}
+                self._replay()
+
+            def _replay(self):
+                self._objects = {"seed": 1}
+
+            def put(self, k, v):
+                with self._lock:
+                    self._objects = {**self._objects, k: v}
+    """)
+    assert "lock-discipline" not in fired
+
+
+def test_lock_discipline_condition_counts_as_lock():
+    _, fired = _rules_fired("""
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._lock = threading.RLock()
+                self._cond = threading.Condition(self._lock)
+                self._backlog = []
+
+            def push(self, x):
+                with self._cond:
+                    self._backlog = self._backlog + [x]
+
+            def peek(self):
+                with self._lock:
+                    return self._backlog
+    """)
+    assert "lock-discipline" not in fired
+
+
+# ---------------------------------------------------------------------------
+# blocking-under-lock
+# ---------------------------------------------------------------------------
+
+def test_blocking_under_lock_flags_sleep():
+    findings, fired = _rules_fired("""
+        import threading
+        import time
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def slow(self):
+                with self._lock:
+                    time.sleep(1)
+    """)
+    assert "blocking-under-lock" in fired
+    assert "time.sleep" in findings[0].message
+
+
+def test_blocking_under_lock_flags_interprocedural():
+    _, fired = _rules_fired("""
+        import threading
+        import subprocess
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def _spawn(self):
+                subprocess.run(["true"])
+
+            def locked(self):
+                with self._lock:
+                    self._spawn()
+    """)
+    assert "blocking-under-lock" in fired
+
+
+def test_blocking_under_lock_allows_condition_wait_and_outside_io():
+    _, fired = _rules_fired("""
+        import threading
+        import time
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cond = threading.Condition(self._lock)
+
+            def wait(self):
+                with self._cond:
+                    self._cond.wait(1.0)
+
+            def nap(self):
+                time.sleep(0.1)
+    """)
+    assert "blocking-under-lock" not in fired
+
+
+# ---------------------------------------------------------------------------
+# exception-swallow
+# ---------------------------------------------------------------------------
+
+def test_exception_swallow_flags_bare_except_in_loop():
+    _, fired = _rules_fired("""
+        def fanout(items):
+            for item in items:
+                try:
+                    item()
+                except:
+                    pass
+    """)
+    assert "exception-swallow" in fired
+
+
+def test_exception_swallow_flags_broad_pass_in_reconcile():
+    _, fired = _rules_fired("""
+        def reconcile(self, name):
+            try:
+                self._do(name)
+            except Exception:
+                pass
+    """)
+    assert "exception-swallow" in fired
+
+
+def test_exception_swallow_allows_logged_and_specific():
+    _, fired = _rules_fired("""
+        def reconcile(self, name):
+            try:
+                self._do(name)
+            except Exception:
+                log.exception("reconcile failed")
+            try:
+                self._cleanup(name)
+            except KeyError:
+                pass
+    """)
+    assert "exception-swallow" not in fired
+
+
+def test_exception_swallow_ignores_non_loop_helpers():
+    _, fired = _rules_fired("""
+        def parse(text):
+            try:
+                return int(text)
+            except Exception:
+                pass
+    """)
+    assert "exception-swallow" not in fired
+
+
+# ---------------------------------------------------------------------------
+# tpu-env-completeness
+# ---------------------------------------------------------------------------
+
+def test_tpu_env_flags_partial_identity():
+    findings, fired = _rules_fired("""
+        def build_worker(pod):
+            env = {"TPU_WORKER_ID": "0",
+                   "TPU_WORKER_HOSTNAMES": "a,b"}
+            return env
+    """)
+    assert "tpu-env-completeness" in fired
+    assert "TPU_TOPOLOGY" in findings[0].message
+
+
+def test_tpu_env_flags_lone_selector_setdefault():
+    _, fired = _rules_fired("""
+        def place(spec):
+            sel = spec.setdefault("nodeSelector", {})
+            sel.setdefault("cloud.google.com/gke-tpu-accelerator", "x")
+    """)
+    assert "tpu-env-completeness" in fired
+
+
+def test_tpu_env_accepts_complete_set_and_reads():
+    _, fired = _rules_fired("""
+        import os
+
+        def build_worker(C, topo, host_idx):
+            env = {C.ENV_TPU_WORKER_ID: str(host_idx),
+                   C.ENV_TPU_WORKER_HOSTNAMES: "a,b",
+                   C.ENV_TPU_TOPOLOGY: topo}
+            sel = {}
+            sel.setdefault("cloud.google.com/gke-tpu-accelerator", "x")
+            sel.setdefault("cloud.google.com/gke-tpu-topology", topo)
+            return env, sel
+
+        def launcher():
+            return os.environ["TPU_WORKER_ID"]
+    """)
+    assert "tpu-env-completeness" not in fired
+
+
+# ---------------------------------------------------------------------------
+# suppressions + reporters + parse errors
+# ---------------------------------------------------------------------------
+
+def test_suppression_same_line_and_next_line_and_file():
+    base = """
+        def fanout(items):
+            for item in items:
+                try:
+                    item()
+                except Exception:
+                    pass{inline}
+    """
+    _, fired = _rules_fired(base.format(
+        inline="   # kuberay-lint: disable=exception-swallow"))
+    assert "exception-swallow" not in fired
+
+    _, fired = _rules_fired("""
+        def fanout(items):
+            for item in items:
+                try:
+                    item()
+                # kuberay-lint: disable-next-line=exception-swallow
+                except Exception:
+                    pass
+    """)
+    assert "exception-swallow" not in fired
+
+    _, fired = _rules_fired("""
+        # kuberay-lint: disable-file=exception-swallow
+        def fanout(items):
+            for item in items:
+                try:
+                    item()
+                except Exception:
+                    pass
+    """)
+    assert "exception-swallow" not in fired
+
+
+def test_suppression_audit_mode_keeps_findings():
+    findings, fired = _rules_fired("""
+        def fanout(items):
+            for item in items:
+                try:
+                    item()
+                except Exception:
+                    pass  # kuberay-lint: disable=exception-swallow
+    """, keep_suppressed=True)
+    assert "exception-swallow" in fired
+
+
+def test_parse_error_is_a_finding():
+    findings, fired = _rules_fired("def broken(:\n")
+    assert fired == {"parse-error"}
+
+
+def test_reporters_render():
+    findings, _ = _rules_fired(LOCKED_CLASS_BAD)
+    human = render_human(findings)
+    assert "[lock-discipline]" in human and "finding(s)" in human
+    js = render_json(findings)
+    assert '"lock-discipline"' in js
+    assert render_human([]).startswith("kuberay-lint: clean")
+
+
+def test_cli_exit_codes(tmp_path):
+    from kuberay_tpu.analysis.__main__ import main
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent(LOCKED_CLASS_BAD))
+    good = tmp_path / "good.py"
+    good.write_text("x = 1\n")
+    assert main([str(good)]) == 0
+    assert main([str(bad)]) == 1
+    assert main([str(bad), "--rules", "tpu-env-completeness"]) == 0
+    assert main(["--list-rules"]) == 0
+    assert main([str(bad), "--rules", "no-such-rule"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# the gate: the real tree is clean
+# ---------------------------------------------------------------------------
+
+def test_kuberay_tpu_tree_is_clean():
+    """The full rule set over the shipping package.  A finding here is a
+    real invariant regression (or needs an explicit, justified
+    suppression comment at the site)."""
+    tree = os.path.join(REPO_ROOT, "kuberay_tpu")
+    findings = run_paths([tree])
+    assert findings == [], "\n" + render_human(findings)
+
+
+def test_known_suppressions_are_few_and_intentional():
+    """Audit mode: suppressed findings exist (we suppress with
+    justification rather than weaken rules), but the count is pinned so
+    a drive-by suppression spree shows up in review."""
+    tree = os.path.join(REPO_ROOT, "kuberay_tpu")
+    all_findings = run_paths([tree], keep_suppressed=True)
+    suppressed = len(all_findings) - len(run_paths([tree]))
+    assert suppressed <= 6, render_human(all_findings)

@@ -1,0 +1,14 @@
+#!/usr/bin/env sh
+# Standalone invariant-lint entry point (the same gate tier-1 tests run
+# via tests/test_static_analysis.py).  Exits nonzero on findings, so it
+# drops straight into CI:
+#
+#   tools/lint.sh                      # human output, whole package
+#   tools/lint.sh --format json        # machine-readable (CI annotations)
+#   tools/lint.sh kuberay_tpu/serve    # a subtree
+#   tools/lint.sh --list-rules         # what is enforced, and why
+#
+# See docs/static-analysis.md for the rules and the suppression syntax.
+set -eu
+cd "$(dirname "$0")/.."
+exec python -m kuberay_tpu.analysis "$@"
