@@ -262,8 +262,17 @@ def test_kill_follower_no_hang_and_degraded(tmp_path):
 
     host0, follower = spawn(0), spawn(1)
     try:
-        assert wait_for(lambda: os.path.exists(ready_file), timeout=300,
-                        poll=0.2), "serving never reached in-flight state"
+        # Fail fast if a worker dies during bootstrap: burning the full
+        # readiness timeout on an already-dead subprocess tells us
+        # nothing the traceback doesn't.
+        wait_for(lambda: os.path.exists(ready_file) or
+                 host0.poll() is not None or follower.poll() is not None,
+                 timeout=300, poll=0.2)
+        if not os.path.exists(ready_file):
+            dead = host0 if host0.poll() is not None else follower
+            out, _ = dead.communicate(timeout=30)
+            pytest.fail("serving never reached in-flight state; worker "
+                        f"exited rc={dead.returncode}:\n{out[-3000:]}")
         follower.send_signal(signal.SIGKILL)
         follower.wait(timeout=30)
         out, _ = host0.communicate(timeout=120)
