@@ -10,32 +10,53 @@ Event-driven: pod creations queue their keys via a store watch, so a
 ``step()`` touches only new/failed pods — O(changes), not O(all pods)
 (what makes the 5k/10k-cluster scale benches measure the operator rather
 than the harness).
+
+Fault surface (consumed by tests and kuberay_tpu.sim):
+- ``fail_pod`` / ``fail_slice``: transition one pod / every host of a
+  slice to Failed, MERGING over the existing status so the last-reported
+  ``podIP`` and conditions survive — exactly what a real kubelet reports
+  for a dead container;
+- ``hold_pod``: slow-start injection — the pod stays Pending until the
+  given instant (``now_fn`` domain; the sim passes virtual time);
+- ``resync``: the periodic kubelet relist, which is what recovers pods
+  whose ADDED watch event was dropped by chaos.
+
+Deterministic: batches iterate in sorted key order, so the event history
+of a run is a pure function of the store history and injected faults.
 """
 
 from __future__ import annotations
 
 import itertools
 import threading
-from typing import Set
+import time
+from typing import Callable, Dict, Optional, Set
 
 from kuberay_tpu.controlplane.store import Conflict, Event, NotFound, ObjectStore
+from kuberay_tpu.utils import constants as C
+
+
+def _fail_status(pod: dict) -> dict:
+    """Failed phase merged over the pod's last status: a killed pod still
+    reports its last IP and conditions (the kubelet never wipes them)."""
+    return {**pod.get("status", {}), "phase": "Failed"}
 
 
 class FakeKubelet:
-    def __init__(self, store: ObjectStore, auto_run: bool = True):
+    def __init__(self, store: ObjectStore, auto_run: bool = True,
+                 now_fn: Optional[Callable[[], float]] = None):
         self.store = store
         self.auto_run = auto_run
+        self._now = now_fn or time.time
         self._ip = itertools.count(1)
         self._lock = threading.Lock()
         self._pending: Set[tuple] = set()       # (ns, name)
         self._fail_next: Set[tuple] = set()
+        self._hold_until: Dict[tuple, float] = {}   # (ns, name) -> release
         # Watch FIRST, then backfill — the set dedups the overlap, and the
         # reverse order would lose pods created in the gap.
         self._cancel = store.watch(self._on_event)
-        for pod in store.list("Pod"):
-            md = pod["metadata"]
-            if pod.get("status", {}).get("phase", "Pending") == "Pending":
-                self._pending.add((md["namespace"], md["name"]))
+        self.resync()
 
     def close(self):
         self._cancel()
@@ -51,6 +72,21 @@ class FakeKubelet:
             elif ev.type == Event.DELETED:
                 self._pending.discard(key)
                 self._fail_next.discard(key)
+                self._hold_until.pop(key, None)
+
+    def resync(self) -> int:
+        """Relist Pending pods into the work set (the kubelet's periodic
+        resync): recovers pods whose creation event was lost (dropped
+        watch delivery under chaos, or pods created before this kubelet
+        attached).  Returns how many keys were (re)queued."""
+        n = 0
+        for pod in self.store.list("Pod"):
+            md = pod["metadata"]
+            if pod.get("status", {}).get("phase", "Pending") == "Pending":
+                with self._lock:
+                    self._pending.add((md["namespace"], md["name"]))
+                n += 1
+        return n
 
     def fail_pod(self, name: str, namespace: str = "default"):
         """Inject a failure: the pod transitions to Failed."""
@@ -59,13 +95,38 @@ class FakeKubelet:
             with self._lock:
                 self._fail_next.add((namespace, name))
             return
-        pod["status"] = {**pod.get("status", {}), "phase": "Failed"}
+        pod["status"] = _fail_status(pod)
         self.store.update_status(pod)
+
+    def fail_slice(self, slice_name: str, namespace: str = "default") -> int:
+        """Node-drain analogue: every host of the slice fails together
+        (pods share a node pool; a drained node takes the whole ICI ring
+        down).  Returns pods failed."""
+        pods = self.store.list("Pod", namespace,
+                               labels={C.LABEL_SLICE_NAME: slice_name})
+        for pod in pods:
+            self.fail_pod(pod["metadata"]["name"], namespace)
+        return len(pods)
+
+    def hold_pod(self, name: str, namespace: str = "default",
+                 until: float = float("inf")):
+        """Slow-start injection: the pod stays Pending until ``until``
+        (``now_fn`` clock domain), then runs on a later ``step()``."""
+        with self._lock:
+            self._hold_until[(namespace, name)] = until
+            self._pending.add((namespace, name))
+
+    def next_hold_at(self) -> Optional[float]:
+        """Earliest hold release instant (sim settle loops advance their
+        virtual clock here), or None."""
+        with self._lock:
+            return min(self._hold_until.values()) if self._hold_until else None
 
     def step(self) -> int:
         """Advance queued Pending pods one phase; returns pods touched."""
+        now = self._now()
         with self._lock:
-            batch = list(self._pending)
+            batch = sorted(self._pending)
             self._pending.clear()
             to_fail = set(self._fail_next)
             self._fail_next.clear()
@@ -75,15 +136,20 @@ class FakeKubelet:
             if pod is None or pod["metadata"].get("deletionTimestamp"):
                 continue
             if (ns, name) in to_fail:
-                pod["status"] = {"phase": "Failed"}
+                pod["status"] = _fail_status(pod)
                 to_fail.discard((ns, name))
             elif pod.get("status", {}).get("phase", "Pending") == "Pending":
-                if not self.auto_run:
-                    # Not running pods right now: keep the key so a later
-                    # auto_run=True step can still pick it up.
+                with self._lock:
+                    held = self._hold_until.get((ns, name), 0.0) > now
+                if held or not self.auto_run:
+                    # Not running this pod right now (slow-start hold or
+                    # auto_run off): keep the key so a later step can
+                    # still pick it up.
                     with self._lock:
                         self._pending.add((ns, name))
                     continue
+                with self._lock:
+                    self._hold_until.pop((ns, name), None)
                 n = next(self._ip)
                 pod["status"] = {
                     "phase": "Running",
@@ -103,13 +169,13 @@ class FakeKubelet:
                     self._pending.add((ns, name))
         # Unconsumed failure injections: apply to running pods, re-park the
         # rest (the pod may simply not exist YET — deferred injection).
-        for ns, name in to_fail:
+        for ns, name in sorted(to_fail):
             pod = self.store.try_get("Pod", name, ns)
             if pod is None:
                 with self._lock:
                     self._fail_next.add((ns, name))
                 continue
-            pod["status"] = {"phase": "Failed"}
+            pod["status"] = _fail_status(pod)
             try:
                 self.store.update_status(pod)
                 touched += 1

@@ -30,8 +30,18 @@ _LOG = logging.getLogger("kuberay_tpu.manager")
 
 class Manager:
     def __init__(self, store: ObjectStore,
-                 expectations: Optional[ScaleExpectations] = None):
+                 expectations: Optional[ScaleExpectations] = None,
+                 clock=None, metrics=None):
         self.store = store
+        # ``clock`` is any object with ``.now() -> float`` (duck-typed so
+        # controlplane does not depend on the sim package).  Timed
+        # requeues schedule against it; the deterministic simulation
+        # harness passes a virtual clock (kuberay_tpu.sim.clock) and
+        # advances it to ``next_delayed_at()`` instead of sleeping.
+        self._now = clock.now if clock is not None else time.time
+        # Optional ControlPlaneMetrics: counts requeue-causing Conflict /
+        # Exception outcomes per kind (they were debug-log-only before).
+        self.metrics = metrics
         self.expectations = expectations or ScaleExpectations()
         self._reconcilers: Dict[str, Callable[[str, str], Optional[float]]] = {}
         # kinds whose owned objects (by label) map back to an owner kind:
@@ -88,7 +98,7 @@ class Manager:
     def enqueue(self, key: Key, after: float = 0.0):
         with self._lock:
             if after > 0:
-                heapq.heappush(self._delayed, (time.time() + after, key))
+                heapq.heappush(self._delayed, (self._now() + after, key))
             elif key not in self._queued:
                 self._queued.add(key)
                 self._queue.append(key)
@@ -97,7 +107,7 @@ class Manager:
     def _pop(self, block: bool) -> Optional[Key]:
         with self._lock:
             while True:
-                now = time.time()
+                now = self._now()
                 while self._delayed and self._delayed[0][0] <= now:
                     _, key = heapq.heappop(self._delayed)
                     if key not in self._queued:
@@ -130,13 +140,25 @@ class Manager:
             # recomputes from fresh state (SURVEY §5.2).
             _LOG.debug("reconcile %s %s/%s conflicted, requeueing: %s",
                        kind, ns, name, e)
+            if self.metrics is not None:
+                self.metrics.reconcile_conflict(kind)
             requeue = 0.05
         except Exception as e:   # reconcile errors requeue with backoff
             _LOG.exception(
                 "reconcile %s %s/%s failed: %s", kind, ns, name, e)
+            if self.metrics is not None:
+                self.metrics.reconcile_error(kind)
             requeue = 5.0
         if requeue:
             self.enqueue(key, after=requeue)
+
+    def next_delayed_at(self) -> Optional[float]:
+        """Earliest timed-requeue deadline (clock domain of ``clock.now``),
+        or None when nothing is scheduled.  The sim harness advances its
+        virtual clock exactly here, so backoffs fire at their true
+        instants instead of being promoted en masse."""
+        with self._lock:
+            return self._delayed[0][0] if self._delayed else None
 
     def flush_delayed(self):
         """Promote ALL timed requeues immediately (tests: 'advance time')."""

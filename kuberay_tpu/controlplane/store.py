@@ -113,11 +113,23 @@ class ObjectStore:
 
     def __init__(self, journal_path: str = "",
                  journal_compact_bytes: int = 64 * 1024 * 1024,
-                 journal_engine: str = "auto"):
+                 journal_engine: str = "auto",
+                 uid_factory: Optional[Callable[[], str]] = None):
         self._lock = threading.RLock()
         self._objects: Dict[Tuple[str, str, str], Dict[str, Any]] = {}
         self._rv = 0
         self._watchers: List[Callable[[Event], None]] = []
+        # ``uid_factory``: override uid generation (default uuid4).  The
+        # deterministic simulation passes a counter so replays-by-seed
+        # assign identical uids across processes.
+        self._uid_factory = uid_factory or (lambda: uuid.uuid4().hex)
+        # Fault-injection interposer (kuberay_tpu.sim seam): when set,
+        # consulted before every mutation (may raise Conflict to model a
+        # lost rv race) and on every local watcher dispatch (may drop,
+        # duplicate, or defer the event).  The streaming backlog always
+        # records the true event — chaos applies to the informer path,
+        # exactly where real watch streams lose/reorder.
+        self._interposer = None
         # (label_key, label_value) -> set of object keys
         self._label_index: Dict[Tuple[str, str], set] = {}
         self._journal = None
@@ -321,14 +333,56 @@ class ObjectStore:
         if len(self._backlog) > self._backlog_max:
             del self._backlog[: len(self._backlog) - self._backlog_max]
         self._backlog_cond.notify_all()
-        for w in list(self._watchers):
+        deliveries = [ev]
+        if self._interposer is not None:
+            # Pure computation (seeded rng draw) under the lock; the
+            # interposer may return [] (drop), [ev] (pass), [ev, ev]
+            # (duplicate) or stash the event for deferred redelivery.
+            deliveries = self._interposer.on_event(ev)
+        for dev in deliveries:
+            for w in list(self._watchers):
+                try:
+                    w(dev)
+                except Exception:
+                    # Watcher errors never poison the store — but a watcher
+                    # that throws on every event is a wedged controller, so
+                    # it must show up in logs, not vanish.
+                    _LOG.exception("store watcher failed on %s %s",
+                                   dev.type, dev.kind)
+
+    def set_interposer(self, interposer) -> None:
+        """Install (or clear, with None) the fault-injection interposer.
+
+        The interposer contract (see kuberay_tpu.sim.faults.FaultPlan):
+        ``on_mutation(verb, kind, name, namespace)`` may raise
+        :class:`Conflict`; ``on_event(ev) -> List[Event]`` decides local
+        watcher deliveries.  Both run synchronously on the mutating
+        thread, so a deterministic plan yields deterministic histories.
+        """
+        with self._lock:
+            self._interposer = interposer
+
+    def _interpose(self, verb: str, kind: str, name: str, namespace: str):
+        """Mutation seam: called at the top of every public mutator,
+        before any state changes, so an injected Conflict models a write
+        that lost the optimistic-concurrency race cleanly (nothing
+        committed, no event emitted)."""
+        with self._lock:
+            ip = self._interposer
+        if ip is not None:
+            ip.on_mutation(verb, kind, name, namespace)
+
+    def redeliver(self, ev: Event) -> None:
+        """Dispatch a previously deferred watch event to current
+        watchers (sim seam: delayed-delivery faults).  Bypasses the
+        interposer — a deferred event is redelivered exactly once."""
+        with self._lock:
+            watchers = list(self._watchers)
+        for w in watchers:
             try:
                 w(ev)
             except Exception:
-                # Watcher errors never poison the store — but a watcher
-                # that throws on every event is a wedged controller, so
-                # it must show up in logs, not vanish.
-                _LOG.exception("store watcher failed on %s %s",
+                _LOG.exception("store watcher failed on redelivered %s %s",
                                ev.type, ev.kind)
 
     def watch(self, fn: Callable[[Event], None]) -> Callable[[], None]:
@@ -352,11 +406,12 @@ class ObjectStore:
         if not kind or not name:
             raise Invalid("kind and metadata.name are required")
         md.setdefault("namespace", "default")
+        self._interpose("create", kind, name, ns)
         with self._lock:
             k = _key(kind, ns, name)
             if k in self._objects:
                 raise AlreadyExists(f"{kind} {ns}/{name} already exists")
-            md["uid"] = md.get("uid") or uuid.uuid4().hex
+            md["uid"] = md.get("uid") or self._uid_factory()
             md["creationTimestamp"] = md.get("creationTimestamp") or time.time()
             md["resourceVersion"] = self._next_rv()
             md.setdefault("generation", 1)
@@ -423,6 +478,8 @@ class ObjectStore:
         kind = obj.get("kind")
         md = obj.get("metadata", {})
         name, ns = md.get("name"), md.get("namespace", "default")
+        self._interpose("update_status" if subresource == "status"
+                        else "update", kind, name, ns)
         with self._lock:
             k = _key(kind, ns, name)
             cur = self._objects.get(k)
@@ -483,6 +540,7 @@ class ObjectStore:
         the conflicting paths in the message.
         """
         from kuberay_tpu.controlplane import patch as P
+        self._interpose("patch", kind, name, namespace)
         created = False
         with self._lock:
             k = _key(kind, namespace, name)
@@ -583,6 +641,7 @@ class ObjectStore:
 
     def patch_labels(self, kind: str, name: str, namespace: str,
                      labels: Dict[str, Optional[str]]) -> Dict[str, Any]:
+        self._interpose("patch_labels", kind, name, namespace)
         with self._lock:
             cur = self._objects.get(_key(kind, namespace, name))
             if cur is None:
@@ -606,6 +665,7 @@ class ObjectStore:
     def delete(self, kind: str, name: str, namespace: str = "default") -> None:
         """Graceful delete: sets deletionTimestamp; the object is removed
         once finalizers empty (the K8s finalizer contract)."""
+        self._interpose("delete", kind, name, namespace)
         with self._lock:
             k = _key(kind, namespace, name)
             cur = self._objects.get(k)
@@ -627,6 +687,7 @@ class ObjectStore:
         precondition — pass the reconcile-start resourceVersion so a
         foreign write in the window raises Conflict instead of being
         silently raced."""
+        self._interpose("remove_finalizer", kind, name, namespace)
         with self._lock:
             cur = self._objects.get(_key(kind, namespace, name))
             if cur is None:
@@ -652,6 +713,7 @@ class ObjectStore:
         """Add a finalizer; returns the updated object so callers can
         thread the bumped resourceVersion through the reconcile pass.
         ``rv``: optional precondition (see :meth:`remove_finalizer`)."""
+        self._interpose("add_finalizer", kind, name, namespace)
         with self._lock:
             cur = self._objects.get(_key(kind, namespace, name))
             if cur is None:
@@ -734,6 +796,11 @@ class ObjectStore:
             self.update(cur)
             return True
         return False
+
+    def kinds(self) -> List[str]:
+        """Sorted kinds currently present (sim GC sweep + debugging)."""
+        with self._lock:
+            return sorted({k for (k, _, _) in self._objects})
 
     def count(self, kind: str) -> int:
         with self._lock:

@@ -63,7 +63,7 @@ class Operator:
         self.store = store or ObjectStore()
         self.metrics = ControlPlaneMetrics()
         self.recorder = EventRecorder(self.store)
-        self.manager = Manager(self.store)
+        self.manager = Manager(self.store, metrics=self.metrics)
 
         self.schedulers = SchedulerManager()
         self.schedulers.register(GangScheduler(self.store))

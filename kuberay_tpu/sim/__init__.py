@@ -1,0 +1,38 @@
+"""kuberay_tpu.sim: deterministic chaos simulation for the control plane.
+
+FoundationDB-style simulation testing + Jepsen-style fault/invariant
+checking over the in-process control plane: a seeded
+:class:`~kuberay_tpu.sim.faults.FaultPlan` injects adversarial
+interleavings (write conflicts, watch drop/duplicate/delay, pod kills,
+slice drains, slow starts, delete races, leader failover) into the
+``ObjectStore``/``Manager``/``FakeKubelet`` trio running on a virtual
+clock, and a registry of runtime invariant checkers
+(:mod:`~kuberay_tpu.sim.invariants`) validates every converged state.
+Any violation reproduces from ``--scenario NAME --seed N``.
+
+See docs/chaos-sim.md; CLI: ``python -m kuberay_tpu.sim``.
+"""
+
+from kuberay_tpu.sim.clock import (
+    SIM_EPOCH,
+    TimeShim,
+    VirtualClock,
+    WallClock,
+    patch_time,
+)
+from kuberay_tpu.sim.faults import ALL_FAULTS, DEFAULT_PROFILE, FaultPlan
+from kuberay_tpu.sim.harness import SimHarness, SimResult
+from kuberay_tpu.sim.invariants import (
+    CHECKERS,
+    CheckContext,
+    Violation,
+    run_checkers,
+)
+from kuberay_tpu.sim.scenarios import SCENARIOS, Scenario, get_scenario
+
+__all__ = [
+    "ALL_FAULTS", "CHECKERS", "CheckContext", "DEFAULT_PROFILE",
+    "FaultPlan", "SCENARIOS", "SIM_EPOCH", "Scenario", "SimHarness",
+    "SimResult", "TimeShim", "Violation", "VirtualClock", "WallClock",
+    "get_scenario", "patch_time", "run_checkers",
+]

@@ -1,0 +1,128 @@
+"""CLI: run seeded chaos simulations and replay violations.
+
+    python -m kuberay_tpu.sim --seed 0..9              # all scenarios
+    python -m kuberay_tpu.sim --scenario cronjob-burst --seed 7 --steps 20
+    python -m kuberay_tpu.sim --list-scenarios
+    python -m kuberay_tpu.sim --list-invariants
+
+Exit codes: 0 clean, 1 invariant violation (the failure report includes
+the exact replay command and the journal tail), 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List
+
+from kuberay_tpu.sim.harness import SimHarness, SimResult
+from kuberay_tpu.sim.invariants import DESCRIPTIONS
+from kuberay_tpu.sim.scenarios import SCENARIOS, get_scenario
+
+
+def parse_seeds(spec: str) -> List[int]:
+    """``"7"`` -> [7]; ``"0..9"`` -> [0, 1, ..., 9] (inclusive)."""
+    if ".." in spec:
+        lo, hi = spec.split("..", 1)
+        start, end = int(lo), int(hi)
+        if end < start:
+            raise ValueError(f"empty seed range {spec!r}")
+        return list(range(start, end + 1))
+    return [int(spec)]
+
+
+def _report_violation(result: SimResult, journal_tail: int,
+                      journal: list, out) -> None:
+    print(f"FAIL scenario={result.scenario} seed={result.seed} "
+          f"steps={result.steps}", file=out)
+    for v in result.violations:
+        print(f"  {v}", file=out)
+    print(f"  replay: {result.replay_command()}", file=out)
+    if journal_tail > 0:
+        print(f"  journal tail ({min(journal_tail, len(journal))} of "
+              f"{len(journal)} events):", file=out)
+        for rec in journal[-journal_tail:]:
+            print(f"    {json.dumps(rec, sort_keys=True)}", file=out)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m kuberay_tpu.sim",
+        description="Deterministic chaos simulation for the TPU control "
+                    "plane: seeded fault schedules + invariant checkers.")
+    parser.add_argument("--seed", default="0",
+                        help="single seed (7) or inclusive range (0..9)")
+    parser.add_argument("--steps", type=int, default=0,
+                        help="inject->drain->check cycles per run "
+                             "(default: the scenario's default)")
+    parser.add_argument("--scenario", default="all",
+                        help="scenario name, or 'all' "
+                             f"({', '.join(sorted(SCENARIOS))})")
+    parser.add_argument("--journal-tail", type=int, default=20,
+                        help="journal events to dump on violation "
+                             "(0 disables)")
+    parser.add_argument("--json", action="store_true",
+                        help="one JSON result object per run on stdout")
+    parser.add_argument("--list-scenarios", action="store_true")
+    parser.add_argument("--list-invariants", action="store_true")
+    args = parser.parse_args(argv)
+
+    if args.list_scenarios:
+        for name in sorted(SCENARIOS):
+            s = SCENARIOS[name]
+            print(f"{name}: {s.description} "
+                  f"(default {s.default_steps} steps)")
+        return 0
+    if args.list_invariants:
+        for name in sorted(DESCRIPTIONS):
+            print(f"{name}: {DESCRIPTIONS[name]}")
+        return 0
+
+    try:
+        seeds = parse_seeds(args.seed)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if args.scenario == "all":
+        names = sorted(SCENARIOS)
+    elif args.scenario in SCENARIOS:
+        names = [args.scenario]
+    else:
+        print(f"error: unknown scenario {args.scenario!r}; known: "
+              f"{', '.join(sorted(SCENARIOS))}", file=sys.stderr)
+        return 2
+
+    failed = False
+    for name in names:
+        scenario = get_scenario(name)
+        steps = args.steps or scenario.default_steps
+        for seed in seeds:
+            with SimHarness(seed, scenario=scenario) as h:
+                result = h.run(steps)
+                journal = list(h.journal)
+            if args.json:
+                print(json.dumps({
+                    "scenario": result.scenario, "seed": result.seed,
+                    "steps": result.steps, "ok": result.ok,
+                    "violations": [str(v) for v in result.violations],
+                    "events": result.journal_len,
+                    "journal_hash": result.journal_hash,
+                    "faults": result.faults_injected,
+                }, sort_keys=True))
+            if result.ok:
+                if not args.json:
+                    faults = sum(result.faults_injected.values())
+                    print(f"ok   scenario={result.scenario} seed={seed} "
+                          f"steps={result.steps} events={result.journal_len} "
+                          f"faults={faults} "
+                          f"hash={result.journal_hash[:12]}")
+            else:
+                failed = True
+                _report_violation(result, args.journal_tail, journal,
+                                  sys.stderr)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,126 @@
+"""Virtual time for deterministic simulation.
+
+The control plane's timed behavior — requeue-after, error backoff,
+expectation timeouts, cron schedules, retirement delays — all reads
+``time.time()``.  Under simulation that wall-clock coupling is replaced
+two ways:
+
+- ``Manager`` takes a ``clock`` parameter directly (the tentpole seam:
+  ``enqueue(after=)`` and ``_pop`` schedule against ``clock.now()``), so
+  timed requeues land at exact virtual instants instead of
+  ``flush_delayed()``'s promote-everything distortion;
+- every other controlplane module keeps its plain ``import time`` and is
+  rebound to a :class:`TimeShim` for the duration of a harness run via
+  :func:`patch_time` — reconcilers, the store's creation/deletion
+  timestamps, cron catch-up, and scale expectations all see the same
+  virtual instant, which is what makes a seed replay byte-identical even
+  across processes and minutes apart.
+
+The virtual epoch is fixed (not "now") so minute-aligned cron schedules
+fire at the same virtual boundaries in every run of a seed.
+"""
+
+from __future__ import annotations
+
+import threading
+import time as _real_time
+from typing import Iterable, List, Optional
+
+# Fixed, minute-aligned epoch (2023-11-14T22:13:00Z falls mid-minute —
+# use an exact minute boundary so cron scenarios are phase-stable).
+SIM_EPOCH = 1_700_000_040.0
+
+
+class WallClock:
+    """The live-deployment clock: a thin ``time.time`` wrapper."""
+
+    @staticmethod
+    def now() -> float:
+        return _real_time.time()
+
+
+class VirtualClock:
+    """Monotonic virtual time; advanced explicitly, never by sleeping."""
+
+    def __init__(self, start: float = SIM_EPOCH):
+        self._lock = threading.Lock()
+        self._now = float(start)
+
+    def now(self) -> float:
+        with self._lock:
+            return self._now
+
+    def advance(self, seconds: float) -> float:
+        """Move time forward (negative deltas are ignored: virtual time
+        is monotonic, exactly like the deadline math downstream assumes)."""
+        with self._lock:
+            if seconds > 0:
+                self._now += seconds
+            return self._now
+
+    def advance_to(self, deadline: float) -> float:
+        with self._lock:
+            if deadline > self._now:
+                self._now = deadline
+            return self._now
+
+
+class TimeShim:
+    """Stand-in for the ``time`` module inside patched controlplane
+    modules: ``time()`` reads the virtual clock, ``sleep()`` advances it
+    (a reconciler that sleeps must not stall the single-threaded
+    harness), everything else proxies to the real module."""
+
+    def __init__(self, clock: VirtualClock):
+        self._clock = clock
+
+    def time(self) -> float:
+        return self._clock.now()
+
+    def sleep(self, seconds: float) -> None:
+        self._clock.advance(max(0.0, seconds))
+
+    def __getattr__(self, name):
+        return getattr(_real_time, name)
+
+
+#: Modules whose ``time`` binding the harness virtualizes.  Manager is
+#: absent on purpose — it takes the clock first-class.
+DEFAULT_PATCH_MODULES = (
+    "kuberay_tpu.controlplane.store",
+    "kuberay_tpu.controlplane.cluster_controller",
+    "kuberay_tpu.controlplane.job_controller",
+    "kuberay_tpu.controlplane.service_controller",
+    "kuberay_tpu.controlplane.cronjob_controller",
+    "kuberay_tpu.controlplane.expectations",
+    "kuberay_tpu.controlplane.events",
+)
+
+
+class patch_time:
+    """Context manager rebinding ``module.time`` to a :class:`TimeShim`.
+
+    Restores the real module on exit even when the body raises, so a
+    failing sim run cannot leak virtual time into the rest of the
+    process (other tests share these modules).
+    """
+
+    def __init__(self, clock: VirtualClock,
+                 modules: Iterable[str] = DEFAULT_PATCH_MODULES):
+        self._shim = TimeShim(clock)
+        self._module_names = list(modules)
+        self._saved: List[tuple] = []
+
+    def __enter__(self) -> "patch_time":
+        import importlib
+        for name in self._module_names:
+            mod = importlib.import_module(name)
+            self._saved.append((mod, getattr(mod, "time", None)))
+            mod.time = self._shim
+        return self
+
+    def __exit__(self, *exc) -> Optional[bool]:
+        for mod, orig in reversed(self._saved):
+            mod.time = orig
+        self._saved.clear()
+        return None

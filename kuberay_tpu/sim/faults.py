@@ -1,0 +1,204 @@
+"""Seeded fault schedules: every adversity a control plane meets, drawn
+from one integer.
+
+A :class:`FaultPlan` owns the only RNG in a simulation run, so the full
+fault history — which mutations lose their rv race, which watch events
+drop/duplicate/arrive late, which pods die, which slices drain, when the
+leader fails over — is a pure function of ``seed``.  Replaying a seed
+replays the exact interleaving that produced a violation (the
+FoundationDB-style determinism contract).
+
+Two delivery channels:
+
+- the **store interposer** half (``on_mutation`` / ``on_event``) is
+  installed via ``ObjectStore.set_interposer`` and fires inline on store
+  traffic: injected ``Conflict`` models a lost optimistic-concurrency
+  race; event filtering models informer drop/duplicate/latency;
+- the **step faults** half (``draw_step_faults``) is consumed by the
+  harness between drain rounds: pod kills, whole-slice drains, slow pod
+  starts, delete races, leader failover.
+
+Injection is budgeted per step (armed counts, decremented as consumed),
+never open-ended probabilities — a run must eventually quiesce so the
+invariant checkers examine a converged state.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Dict, List, Optional, Tuple
+
+from kuberay_tpu.controlplane.store import Conflict, Event
+
+# Interposer-channel faults.
+STORE_CONFLICT = "store_conflict"
+WATCH_DROP = "watch_drop"
+WATCH_DUP = "watch_dup"
+WATCH_DELAY = "watch_delay"
+# Step-channel faults (applied by the harness).
+POD_KILL = "pod_kill"
+SLICE_DRAIN = "slice_drain"
+SLOW_START = "slow_start"
+DELETE_RACE = "delete_race"
+LEADER_FAILOVER = "leader_failover"
+
+ALL_FAULTS = (STORE_CONFLICT, WATCH_DROP, WATCH_DUP, WATCH_DELAY,
+              POD_KILL, SLICE_DRAIN, SLOW_START, DELETE_RACE,
+              LEADER_FAILOVER)
+
+STEP_FAULTS = (POD_KILL, SLICE_DRAIN, SLOW_START, DELETE_RACE,
+               LEADER_FAILOVER)
+
+#: Default per-step arming weights; a scenario overrides with its own
+#: profile (fault -> mean injections per step; 0 disables).
+DEFAULT_PROFILE: Dict[str, float] = {
+    STORE_CONFLICT: 0.6,
+    WATCH_DROP: 0.4,
+    WATCH_DUP: 0.4,
+    WATCH_DELAY: 0.4,
+    POD_KILL: 0.5,
+    SLICE_DRAIN: 0.2,
+    SLOW_START: 0.3,
+    DELETE_RACE: 0.3,
+    LEADER_FAILOVER: 0.2,
+}
+
+# Mutations the conflict injector never touches: losing a *delete*'s rv
+# race is modeled by DELETE_RACE instead, and label patches are the warm
+# pool claim path whose caller deliberately has no retry loop.
+_CONFLICT_VERBS = ("create", "update", "update_status", "patch",
+                   "add_finalizer", "remove_finalizer")
+
+# Kinds whose events chaos never filters: Event objects are telemetry,
+# and Lease traffic belongs to the (real-time) elector, not the sim.
+_EVENT_EXEMPT_KINDS = ("Event", "Lease")
+
+
+class FaultPlan:
+    """Seeded, budgeted fault source.  Install on a store with
+    ``store.set_interposer(plan)``; arm each step with ``arm()``; drive
+    step-channel faults from ``draw_step_faults``."""
+
+    def __init__(self, seed: int,
+                 profile: Optional[Dict[str, float]] = None,
+                 watch_delay_seconds: Tuple[float, float] = (0.5, 8.0),
+                 slow_start_seconds: Tuple[float, float] = (1.0, 20.0)):
+        self.seed = seed
+        self.rng = random.Random(seed)
+        self.profile = dict(DEFAULT_PROFILE)
+        if profile is not None:
+            self.profile.update(profile)
+        self.watch_delay_seconds = watch_delay_seconds
+        self.slow_start_seconds = slow_start_seconds
+        self._armed: Dict[str, int] = {f: 0 for f in ALL_FAULTS}
+        self._suspended = False
+        self._deferred: List[Tuple[float, Event]] = []
+        self._now = lambda: 0.0     # bound by the harness (virtual clock)
+        self.injected: Dict[str, int] = {f: 0 for f in ALL_FAULTS}
+        # Observer for every injection (harness exports it as the
+        # ``sim_faults_injected_total{fault}`` counter).
+        self.on_inject = lambda fault: None
+
+    # -- harness wiring ----------------------------------------------------
+
+    def bind_clock(self, now_fn) -> None:
+        self._now = now_fn
+
+    def arm(self) -> List[str]:
+        """Draw this step's fault budget from the profile (Poisson-ish:
+        floor(rate) guaranteed + fractional part as a coin).  Returns the
+        step-channel faults to apply, in draw order; interposer-channel
+        budgets accumulate internally."""
+        step_faults: List[str] = []
+        for fault in ALL_FAULTS:        # fixed order -> deterministic draws
+            rate = self.profile.get(fault, 0.0)
+            count = int(rate)
+            if self.rng.random() < rate - count:
+                count += 1
+            if count <= 0:
+                continue
+            if fault in STEP_FAULTS:
+                step_faults.extend([fault] * count)
+            else:
+                self._armed[fault] += count
+        return step_faults
+
+    def disarm(self) -> None:
+        """Drop remaining interposer budgets (end-of-step quiesce: the
+        settle that follows must converge chaos-free)."""
+        for fault in self._armed:
+            self._armed[fault] = 0
+
+    class _Suspend:
+        def __init__(self, plan: "FaultPlan"):
+            self._plan = plan
+
+        def __enter__(self):
+            self._plan._suspended = True
+            return self
+
+        def __exit__(self, *exc):
+            self._plan._suspended = False
+            return None
+
+    def suspended(self) -> "FaultPlan._Suspend":
+        """Context manager: the harness's own workload writes (scenario
+        spec edits, direct fault application) must not themselves be
+        chaos targets."""
+        return FaultPlan._Suspend(self)
+
+    def _consume(self, fault: str) -> bool:
+        if self._suspended or self._armed.get(fault, 0) <= 0:
+            return False
+        self._armed[fault] -= 1
+        self.injected[fault] += 1
+        self.on_inject(fault)
+        return True
+
+    def record(self, fault: str) -> None:
+        """Count a step-channel injection the harness applied."""
+        self.injected[fault] += 1
+        self.on_inject(fault)
+
+    # -- ObjectStore interposer contract -----------------------------------
+
+    def on_mutation(self, verb: str, kind: str, name: str, namespace: str):
+        if verb not in _CONFLICT_VERBS or kind in _EVENT_EXEMPT_KINDS:
+            return
+        if self._consume(STORE_CONFLICT):
+            raise Conflict(
+                f"sim fault {STORE_CONFLICT}: {verb} {kind} "
+                f"{namespace}/{name} lost the resourceVersion race")
+
+    def on_event(self, ev: Event) -> List[Event]:
+        if ev.kind in _EVENT_EXEMPT_KINDS:
+            return [ev]
+        if self._consume(WATCH_DROP):
+            return []
+        if self._consume(WATCH_DUP):
+            return [ev, ev]
+        if self._consume(WATCH_DELAY):
+            lo, hi = self.watch_delay_seconds
+            self._deferred.append((self._now() + self.rng.uniform(lo, hi),
+                                   ev))
+            return []
+        return [ev]
+
+    # -- deferred (delayed-delivery) events --------------------------------
+
+    def next_deferred_at(self) -> Optional[float]:
+        return min(t for t, _ in self._deferred) if self._deferred else None
+
+    def pop_due_deferred(self, now: float) -> List[Event]:
+        """Remove and return events whose delivery time has arrived, in
+        original emission order (watch streams delay, they never reorder
+        a single key's history here — redelivery order is emission
+        order, which is itself adversarial enough: the state may have
+        moved on)."""
+        due = [ev for t, ev in self._deferred if t <= now]
+        self._deferred = [(t, ev) for t, ev in self._deferred if t > now]
+        return due
+
+    def draw_slow_start(self) -> float:
+        lo, hi = self.slow_start_seconds
+        return self.rng.uniform(lo, hi)

@@ -1,0 +1,478 @@
+"""SimHarness: the deterministic chaos loop.
+
+Wires the existing deterministic trio — ``ObjectStore`` (with the fault
+interposer installed), ``Manager.run_until_idle`` (on a virtual clock),
+``FakeKubelet`` — plus all five controllers (TpuCluster, TpuJob,
+TpuService, TpuCronJob, WarmSlicePool) into an
+
+    inject -> drain -> check
+
+step loop.  Each step: the scenario mutates the workload, the fault plan
+arms and applies its seeded faults interleaved with partial queue
+drains, the harness settles to quiescence in virtual time, and the
+invariant checkers examine the converged state.  Every store event lands
+in an append-only journal whose hash is the run's fingerprint: same seed
+and scenario, same hash — the replay contract.
+
+The harness is a context manager (it rebinds controlplane ``time`` to
+the virtual clock and flips feature gates); always use ``with
+SimHarness(...) as h`` or call ``close()``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import random
+from typing import Any, Dict, List, Optional
+
+from kuberay_tpu.controlplane.cluster_controller import TpuClusterController
+from kuberay_tpu.controlplane.cronjob_controller import TpuCronJobController
+from kuberay_tpu.controlplane.events import EventRecorder
+from kuberay_tpu.controlplane.fake_kubelet import FakeKubelet
+from kuberay_tpu.controlplane.job_controller import TpuJobController
+from kuberay_tpu.controlplane.manager import (
+    Manager,
+    originated_from_mapper,
+    owned_pod_mapper,
+)
+from kuberay_tpu.controlplane.service_controller import TpuServiceController
+from kuberay_tpu.controlplane.store import Conflict, NotFound, ObjectStore
+from kuberay_tpu.controlplane.warmpool_controller import (
+    KIND_WARM_POOL,
+    LABEL_WARM_POOL,
+    WarmSlicePoolController,
+)
+from kuberay_tpu.runtime.coordinator_client import FakeCoordinatorClient
+from kuberay_tpu.sim.clock import VirtualClock, patch_time
+from kuberay_tpu.sim.faults import (
+    DELETE_RACE,
+    LEADER_FAILOVER,
+    POD_KILL,
+    SLICE_DRAIN,
+    SLOW_START,
+    FaultPlan,
+)
+from kuberay_tpu.sim.invariants import CheckContext, Violation, run_checkers
+from kuberay_tpu.utils import constants as C
+from kuberay_tpu.utils import features
+from kuberay_tpu.utils.metrics import ControlPlaneMetrics
+
+#: Kinds the simulated operator reconciles (the five controllers).
+SIM_KINDS = (C.KIND_CLUSTER, C.KIND_JOB, C.KIND_SERVICE, C.KIND_CRONJOB,
+             KIND_WARM_POOL)
+
+#: Journal-excluded kinds: Event names embed uuid4 (telemetry, not
+#: state), so including them would break cross-process hash stability.
+_JOURNAL_SKIP_KINDS = ("Event",)
+
+
+@dataclasses.dataclass
+class SimResult:
+    scenario: str
+    seed: int
+    steps: int
+    violations: List[Violation]
+    journal_len: int
+    journal_hash: str
+    faults_injected: Dict[str, int]
+    converged: bool = True
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def replay_command(self) -> str:
+        return (f"python -m kuberay_tpu.sim --scenario {self.scenario} "
+                f"--seed {self.seed} --steps {self.steps}")
+
+
+def _warm_pod_mapper(ev):
+    """Warm pods carry the pool label; their churn re-reconciles it
+    (same mapper the operator installs)."""
+    if ev.kind != "Pod":
+        return None
+    md = ev.obj.get("metadata", {})
+    pool = md.get("labels", {}).get(LABEL_WARM_POOL)
+    if not pool:
+        return None
+    return (KIND_WARM_POOL, md.get("namespace", "default"), pool)
+
+
+class SimHarness:
+    def __init__(self, seed: int, scenario=None,
+                 fault_profile: Optional[Dict[str, float]] = None,
+                 settle_horizon: float = 45.0,
+                 max_settle_rounds: int = 400):
+        self.seed = seed
+        self.scenario = scenario
+        self.settle_horizon = settle_horizon
+        self.max_settle_rounds = max_settle_rounds
+        self.converged = True
+
+        self.clock = VirtualClock()
+        self._patch = patch_time(self.clock)
+        self._patch.__enter__()
+        features.set_gates({"TpuCronJob": True, "WarmSlicePools": True})
+
+        profile = fault_profile
+        if profile is None and scenario is not None:
+            profile = scenario.profile
+        self.plan = FaultPlan(seed, profile=profile)
+        self.plan.bind_clock(self.clock.now)
+        self.plan.on_inject = lambda fault: self.metrics.registry.inc(
+            "sim_faults_injected_total", {"fault": fault})
+
+        uid_counter = iter(range(1, 1 << 30))
+        self.store = ObjectStore(
+            uid_factory=lambda: f"sim-uid-{next(uid_counter):06d}")
+        self.metrics = ControlPlaneMetrics()
+        self.metrics.registry.describe(
+            "sim_faults_injected_total",
+            "Faults injected by the simulation fault plan, per fault type")
+        self.recorder = EventRecorder(self.store)
+        self.manager = Manager(self.store, clock=self.clock,
+                               metrics=self.metrics)
+
+        self.clients: Dict[str, FakeCoordinatorClient] = {}
+
+        def provider(status_or_name, status=None):
+            # Job controller calls provider(status); service controller
+            # calls provider(cluster_name, status).  Key clients by the
+            # cluster name when given, else by the head service in status.
+            if status is None:
+                status = status_or_name or {}
+                name = status.get("headServiceName", "") or "cluster"
+            else:
+                name = status_or_name
+            return self.clients.setdefault(name, FakeCoordinatorClient())
+
+        self.cluster_controller = TpuClusterController(
+            self.store, expectations=self.manager.expectations,
+            recorder=self.recorder, metrics=self.metrics)
+        self.job_controller = TpuJobController(
+            self.store, recorder=self.recorder,
+            client_provider=lambda status: provider(status),
+            metrics=self.metrics)
+        self.service_controller = TpuServiceController(
+            self.store, recorder=self.recorder,
+            client_provider=lambda cname, status: provider(cname, status))
+        self.cronjob_controller = TpuCronJobController(
+            self.store, recorder=self.recorder)
+        self.warmpool_controller = WarmSlicePoolController(
+            self.store, recorder=self.recorder)
+
+        m = self.manager
+        m.register(C.KIND_CLUSTER, self.cluster_controller.reconcile)
+        m.register(C.KIND_JOB, self.job_controller.reconcile)
+        m.register(C.KIND_SERVICE, self.service_controller.reconcile)
+        m.register(C.KIND_CRONJOB, self.cronjob_controller.reconcile)
+        m.register(KIND_WARM_POOL, self.warmpool_controller.reconcile)
+        m.map_owned(owned_pod_mapper)
+        m.map_owned(originated_from_mapper(C.KIND_JOB))
+        m.map_owned(originated_from_mapper(C.KIND_SERVICE))
+        m.map_owned(originated_from_mapper(C.KIND_CRONJOB))
+        m.map_owned(_warm_pod_mapper)
+
+        self.kubelet = FakeKubelet(self.store, now_fn=self.clock.now)
+        self.store.set_interposer(self.plan)
+
+        self.journal: List[Dict[str, Any]] = []
+        self._journal_rv = 0
+        self._failover_count = 0
+        self._step = 0
+
+        if scenario is not None:
+            with self.plan.suspended():
+                scenario.setup(self)
+            self.settle()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def close(self):
+        self.store.set_interposer(None)
+        self.kubelet.close()
+        features.reset()
+        self._patch.__exit__(None, None, None)
+
+    def __enter__(self) -> "SimHarness":
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return None
+
+    # -- journal -----------------------------------------------------------
+
+    def _drain_journal(self):
+        events, latest, truncated = self.store.events_since(self._journal_rv)
+        if truncated:
+            # Only possible if a settle round emitted >10k events without
+            # draining; record it so the hash can't silently lie.
+            self.journal.append({"type": "JOURNAL-TRUNCATED",
+                                 "rv": latest})
+        for erv, ev in events:
+            if ev.kind in _JOURNAL_SKIP_KINDS:
+                continue
+            md = ev.obj.get("metadata", {})
+            self.journal.append({
+                "type": ev.type, "kind": ev.kind,
+                "ns": md.get("namespace", "default"),
+                "name": md.get("name", ""),
+                "rv": erv, "uid": md.get("uid", ""),
+            })
+        self._journal_rv = latest
+
+    def journal_hash(self) -> str:
+        h = hashlib.sha256()
+        for rec in self.journal:
+            h.update(json.dumps(rec, sort_keys=True).encode())
+            h.update(b"\n")
+        return h.hexdigest()
+
+    # -- convergence -------------------------------------------------------
+
+    def settle(self, horizon: Optional[float] = None) -> int:
+        """Drain to quiescence in virtual time; returns rounds used.
+
+        A round runs the manager queue, steps the kubelet, redelivers
+        due deferred watch events, sweeps orphans (the GC controller's
+        role), and auto-drives serve apps.  When nothing progressed, the
+        virtual clock advances to the next scheduled wakeup (timed
+        requeue, deferred event, slow-start release) within ``horizon``;
+        past the horizon the state is declared converged.  A final
+        full-resync round models the informers' periodic relist — it
+        recovers anything a dropped watch event orphaned."""
+        deadline = self.clock.now() + (horizon if horizon is not None
+                                       else self.settle_horizon)
+        resynced = False
+        rounds = 0
+        while rounds < self.max_settle_rounds:
+            rounds += 1
+            # Progress = journal growth (state-object events; the journal
+            # skips Event telemetry, so a reconciler that only re-emits
+            # warnings forever cannot defeat quiescence detection).
+            journal_before = len(self.journal)
+            self.manager.run_until_idle()
+            self.kubelet.step()
+            due = self.plan.pop_due_deferred(self.clock.now())
+            for ev in due:
+                self.store.redeliver(ev)
+            drove = self._drive_serve_apps()
+            swept = self._gc_orphans()
+            self._drain_journal()
+            if len(self.journal) > journal_before or due or drove or swept:
+                resynced = False
+                continue
+            nxt = self._next_wakeup()
+            if nxt is not None and nxt <= deadline:
+                self.clock.advance_to(nxt + 1e-6)
+                continue
+            if not resynced:
+                # Informer relist: recovers state stranded by dropped
+                # watch events.  One relist per quiet period — a second
+                # quiet relist means the state is truly converged.
+                self._resync_all()
+                self.kubelet.resync()
+                resynced = True
+                continue
+            return rounds
+        self.converged = False
+        return rounds
+
+    def _next_wakeup(self) -> Optional[float]:
+        candidates = [t for t in (self.manager.next_delayed_at(),
+                                  self.plan.next_deferred_at(),
+                                  self.kubelet.next_hold_at())
+                      if t is not None]
+        return min(candidates) if candidates else None
+
+    def _resync_all(self):
+        for kind in SIM_KINDS:
+            for obj in self.store.list(kind):
+                md = obj["metadata"]
+                self.manager.enqueue((kind, md.get("namespace", "default"),
+                                      md.get("name", "")))
+
+    def _gc_orphans(self) -> int:
+        """Owner-reference GC sweep, level-triggered like the real GC
+        controller: cascade deletes interrupted by injected faults are
+        retried here instead of orphaning dependents forever."""
+        live_uids = set()
+        objs = []
+        for kind in self.store.kinds():
+            for obj in self.store.list(kind):
+                live_uids.add(obj["metadata"].get("uid"))
+                objs.append(obj)
+        swept = 0
+        for obj in objs:
+            refs = obj["metadata"].get("ownerReferences") or []
+            if not refs or any(r.get("uid") in live_uids for r in refs):
+                continue
+            try:
+                self.store.delete(obj["kind"],
+                                  obj["metadata"]["name"],
+                                  obj["metadata"].get("namespace", "default"))
+                swept += 1
+            except (NotFound, Conflict):
+                continue    # retried on the next sweep
+        return swept
+
+    def _drive_serve_apps(self) -> bool:
+        """Stand-in for the serve runtime: once a cluster's serve config
+        lands on its coordinator, the app reports RUNNING."""
+        changed = False
+        for name in sorted(self.clients):
+            client = self.clients[name]
+            if client.serve_config is not None and not client.serve_apps:
+                client.set_serve_app("app", "RUNNING")
+                changed = True
+        return changed
+
+    def succeed_jobs(self) -> int:
+        """Scenario helper: every non-terminal submitted job succeeds."""
+        changed = 0
+        for name in sorted(self.clients):
+            client = self.clients[name]
+            for jid in sorted(client.jobs):
+                if client.jobs[jid].status not in ("SUCCEEDED", "FAILED",
+                                                   "STOPPED"):
+                    client.set_job_status(jid, "SUCCEEDED")
+                    changed += 1
+        return changed
+
+    # -- fault application -------------------------------------------------
+
+    def _record_fault(self, fault: str):
+        self.plan.record(fault)
+
+    def _candidate_pods(self, phase: Optional[str] = None) -> List[dict]:
+        pods = [p for p in self.store.list("Pod")
+                if not p["metadata"].get("deletionTimestamp")]
+        if phase is not None:
+            pods = [p for p in pods
+                    if p.get("status", {}).get("phase", "Pending") == phase]
+        return pods
+
+    def _apply_fault(self, fault: str) -> bool:
+        rng = self.plan.rng
+        with self.plan.suspended():
+            if fault == POD_KILL:
+                pods = self._candidate_pods()
+                if not pods:
+                    return False
+                victim = rng.choice(pods)
+                self.kubelet.fail_pod(victim["metadata"]["name"],
+                                      victim["metadata"]["namespace"])
+            elif fault == SLICE_DRAIN:
+                slices = sorted({
+                    (p["metadata"]["namespace"],
+                     p["metadata"]["labels"][C.LABEL_SLICE_NAME])
+                    for p in self._candidate_pods()
+                    if C.LABEL_SLICE_NAME in p["metadata"]["labels"]})
+                if not slices:
+                    return False
+                ns, sname = rng.choice(slices)
+                self.kubelet.fail_slice(sname, ns)
+            elif fault == SLOW_START:
+                pods = self._candidate_pods(phase="Pending")
+                if not pods:
+                    return False
+                victim = rng.choice(pods)
+                self.kubelet.hold_pod(
+                    victim["metadata"]["name"],
+                    victim["metadata"]["namespace"],
+                    until=self.clock.now() + self.plan.draw_slow_start())
+            elif fault == DELETE_RACE:
+                pods = self._candidate_pods()
+                if not pods:
+                    return False
+                victim = rng.choice(pods)
+                try:
+                    self.store.delete("Pod", victim["metadata"]["name"],
+                                      victim["metadata"]["namespace"])
+                except NotFound:
+                    return False
+            elif fault == LEADER_FAILOVER:
+                crs = []
+                for kind in SIM_KINDS:
+                    crs.extend(self.store.list(kind))
+                if not crs:
+                    return False
+                # The new leader's informers replay every object (full
+                # resync) and its first write races the old leader's
+                # in-flight pass — modeled as a foreign no-op metadata
+                # write that bumps the rv under every snapshot.
+                target = rng.choice(crs)
+                self._failover_count += 1
+                md = target["metadata"]
+                try:
+                    self.store.patch(
+                        target["kind"], md["name"],
+                        md.get("namespace", "default"),
+                        {"metadata": {"annotations": {
+                            "tpu.dev/sim-failover":
+                                str(self._failover_count)}}})
+                except (NotFound, Conflict):
+                    return False
+                self._resync_all()
+            else:
+                return False
+        self._record_fault(fault)
+        return True
+
+    def _partial_drain(self):
+        """A bounded slice of work between injections, so faults land
+        mid-convergence (not only at quiescent states)."""
+        rng = self.plan.rng
+        n = rng.randint(0, 12)
+        if n:
+            self.manager.run_until_idle(max_iterations=n)
+        if rng.random() < 0.5:
+            self.kubelet.step()
+
+    # -- the loop ----------------------------------------------------------
+
+    def check(self) -> List[Violation]:
+        self._drain_journal()
+        violations = run_checkers(CheckContext(self.store, self.journal))
+        if not self.converged:
+            violations.append(Violation(
+                "convergence", f"step {self._step}",
+                f"settle did not quiesce within {self.max_settle_rounds} "
+                "rounds"))
+        return violations
+
+    def step(self) -> List[Violation]:
+        """One inject -> drain -> check cycle; returns violations."""
+        self._step += 1
+        if self.scenario is not None:
+            with self.plan.suspended():
+                self.scenario.tick(self, self._step)
+        for fault in self.plan.arm():
+            self._partial_drain()
+            self._apply_fault(fault)
+        self.settle()
+        # Final chaos-free settle: leftover interposer budgets must not
+        # hold the state hostage at check time.
+        self.plan.disarm()
+        self.settle(horizon=10.0)
+        return self.check()
+
+    def run(self, steps: int, stop_on_violation: bool = True) -> SimResult:
+        violations: List[Violation] = []
+        ran = 0
+        for _ in range(steps):
+            ran += 1
+            violations.extend(self.step())
+            if violations and stop_on_violation:
+                break
+        return SimResult(
+            scenario=self.scenario.name if self.scenario else "adhoc",
+            seed=self.seed, steps=ran, violations=violations,
+            journal_len=len(self.journal),
+            journal_hash=self.journal_hash(),
+            faults_injected={k: v for k, v in
+                             sorted(self.plan.injected.items()) if v},
+            converged=self.converged)

@@ -1,0 +1,359 @@
+"""Runtime invariant checkers: what must hold whenever the control plane
+is quiescent, no matter which faults just happened.
+
+Each checker is a pure function over a :class:`CheckContext` (the store
+plus the run's event journal) returning :class:`Violation` records; the
+registry mirrors the static analyzer's rule registry
+(kuberay_tpu.analysis) — same name/description discipline, but these
+fire on *executions*, not source.  The catalog is documented in
+docs/chaos-sim.md and cross-linked from docs/failure_semantics.md.
+
+Checkers run after every settle (see harness.SimHarness.step), i.e. on
+converged states: transient mid-reconcile shapes (a slice mid-repair)
+are legitimate, the same shape *after* convergence is a bug.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional
+
+from kuberay_tpu.api.tpucluster import TpuCluster
+from kuberay_tpu.controlplane.store import ObjectStore
+from kuberay_tpu.controlplane.warmpool_controller import (
+    KIND_WARM_POOL,
+    LABEL_WARM_CLAIMED,
+    LABEL_WARM_POOL,
+)
+from kuberay_tpu.utils import constants as C
+
+
+@dataclasses.dataclass
+class Violation:
+    invariant: str
+    key: str        # "Kind ns/name" (or slice name) the violation anchors to
+    message: str
+
+    def __str__(self) -> str:
+        return f"[{self.invariant}] {self.key}: {self.message}"
+
+
+class CheckContext:
+    """What checkers see: the store and the journal (the harness's record
+    of every store event, in commit order — see harness.JournalRecord)."""
+
+    def __init__(self, store: ObjectStore,
+                 journal: Optional[List[Dict[str, Any]]] = None):
+        self.store = store
+        self.journal = journal or []
+
+    # -- shared traversals -------------------------------------------------
+
+    def live_pods(self, namespace=None, labels=None) -> List[dict]:
+        return [p for p in self.store.list("Pod", namespace, labels=labels)
+                if not p["metadata"].get("deletionTimestamp")]
+
+    def clusters(self) -> List[TpuCluster]:
+        return [TpuCluster.from_dict(o)
+                for o in self.store.list(C.KIND_CLUSTER)]
+
+
+CHECKERS: Dict[str, Callable[[CheckContext], List[Violation]]] = {}
+DESCRIPTIONS: Dict[str, str] = {}
+
+
+def checker(name: str, description: str):
+    def register(fn):
+        CHECKERS[name] = fn
+        DESCRIPTIONS[name] = description
+        return fn
+    return register
+
+
+def run_checkers(ctx: CheckContext,
+                 only: Optional[List[str]] = None) -> List[Violation]:
+    out: List[Violation] = []
+    for name in sorted(CHECKERS):
+        if only is not None and name not in only:
+            continue
+        out.extend(CHECKERS[name](ctx))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _container_env(pod: dict) -> Dict[str, str]:
+    containers = pod.get("spec", {}).get("containers", [])
+    if not containers:
+        return {}
+    return {e.get("name", ""): e.get("value", "")
+            for e in containers[0].get("env", [])}
+
+
+def _pods_by_slice(pods: List[dict]) -> Dict[str, List[dict]]:
+    out: Dict[str, List[dict]] = {}
+    for p in pods:
+        sname = p["metadata"]["labels"].get(C.LABEL_SLICE_NAME)
+        if sname:
+            out.setdefault(sname, []).append(p)
+    return out
+
+
+def _obj_key(kind: str, md: dict) -> str:
+    return f"{kind} {md.get('namespace', 'default')}/{md.get('name', '')}"
+
+
+# ---------------------------------------------------------------------------
+# slice-identity: dense TPU_WORKER_ID + consistent TPU_WORKER_HOSTNAMES
+# ---------------------------------------------------------------------------
+
+@checker("slice-identity",
+         "every slice's pods carry TPU_WORKER_ID dense in 0..n-1 matching "
+         "their host-index label, and an identical n-entry "
+         "TPU_WORKER_HOSTNAMES ring")
+def check_slice_identity(ctx: CheckContext) -> List[Violation]:
+    out: List[Violation] = []
+    for sname, pods in sorted(_pods_by_slice(ctx.live_pods()).items()):
+        ids = []
+        hostnames = set()
+        nproc = set()
+        for p in pods:
+            env = _container_env(p)
+            labels = p["metadata"]["labels"]
+            wid = env.get(C.ENV_TPU_WORKER_ID)
+            if wid is None:
+                out.append(Violation(
+                    "slice-identity", sname,
+                    f"pod {p['metadata']['name']} has no "
+                    f"{C.ENV_TPU_WORKER_ID} env"))
+                continue
+            if wid != labels.get(C.LABEL_HOST_INDEX):
+                out.append(Violation(
+                    "slice-identity", sname,
+                    f"pod {p['metadata']['name']}: {C.ENV_TPU_WORKER_ID}="
+                    f"{wid} != host-index label "
+                    f"{labels.get(C.LABEL_HOST_INDEX)}"))
+            ids.append(wid)
+            hostnames.add(env.get(C.ENV_TPU_WORKER_HOSTNAMES, ""))
+            nproc.add(env.get(C.ENV_NUM_PROCESSES, ""))
+        if len(hostnames) > 1:
+            out.append(Violation(
+                "slice-identity", sname,
+                f"inconsistent {C.ENV_TPU_WORKER_HOSTNAMES} across hosts: "
+                f"{sorted(hostnames)}"))
+        want = {str(i) for i in range(len(pods))}
+        if ids and len(pods) == len(ids) and set(ids) != want and \
+                nproc == {str(len(pods))}:
+            # Only meaningful when the slice is at its full host count
+            # (TPU_NUM_PROCESSES == observed size); short slices are the
+            # atomicity checker's finding, not a sparse-id one.
+            out.append(Violation(
+                "slice-identity", sname,
+                f"TPU_WORKER_ID set {sorted(ids)} is not dense 0..{len(pods) - 1}"))
+        if hostnames and nproc == {str(len(pods))}:
+            ring = next(iter(hostnames))
+            if ring and len(ring.split(",")) != len(pods):
+                out.append(Violation(
+                    "slice-identity", sname,
+                    f"{C.ENV_TPU_WORKER_HOSTNAMES} names "
+                    f"{len(ring.split(','))} hosts, slice has {len(pods)}"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# slice-atomicity: no partial multi-host slice survives convergence
+# ---------------------------------------------------------------------------
+
+@checker("slice-atomicity",
+         "after convergence every multi-host slice of a live worker group "
+         "has all its hosts, with no slice mixing Running and non-Running "
+         "pods")
+def check_slice_atomicity(ctx: CheckContext) -> List[Violation]:
+    out: List[Violation] = []
+    for cluster in ctx.clusters():
+        if cluster.metadata.deletionTimestamp or cluster.spec.suspend:
+            continue
+        ns = cluster.metadata.namespace
+        pods = ctx.live_pods(ns, labels={
+            C.LABEL_CLUSTER: cluster.metadata.name})
+        workers = [p for p in pods if p["metadata"]["labels"].get(
+            C.LABEL_NODE_TYPE) == C.NODE_TYPE_WORKER]
+        for group in cluster.spec.workerGroupSpecs:
+            if group.suspend:
+                continue
+            hosts = group.slice_topology().num_hosts
+            gpods = [p for p in workers if p["metadata"]["labels"].get(
+                C.LABEL_GROUP) == group.groupName]
+            for sname, plist in sorted(_pods_by_slice(gpods).items()):
+                if len(plist) != hosts:
+                    out.append(Violation(
+                        "slice-atomicity", sname,
+                        f"slice has {len(plist)}/{hosts} hosts after "
+                        "convergence"))
+                    continue
+                phases = {p.get("status", {}).get("phase", "Pending")
+                          for p in plist}
+                if "Running" in phases and phases != {"Running"}:
+                    out.append(Violation(
+                        "slice-atomicity", sname,
+                        f"slice partially Running after convergence: "
+                        f"{sorted(phases)}"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# gang-admission: worker capacity moves in whole-slice quanta
+# ---------------------------------------------------------------------------
+
+@checker("gang-admission",
+         "a worker group's pod count is always a whole number of slices "
+         "(all-or-nothing admission) and never exceeds maxReplicas slices")
+def check_gang_admission(ctx: CheckContext) -> List[Violation]:
+    out: List[Violation] = []
+    for cluster in ctx.clusters():
+        if cluster.metadata.deletionTimestamp or cluster.spec.suspend:
+            continue
+        ns = cluster.metadata.namespace
+        pods = ctx.live_pods(ns, labels={
+            C.LABEL_CLUSTER: cluster.metadata.name})
+        for group in cluster.spec.workerGroupSpecs:
+            if group.suspend:
+                continue
+            hosts = group.slice_topology().num_hosts
+            n = sum(1 for p in pods
+                    if p["metadata"]["labels"].get(C.LABEL_GROUP)
+                    == group.groupName)
+            key = _obj_key(C.KIND_CLUSTER, {"namespace": ns,
+                                            "name": cluster.metadata.name})
+            if n % hosts:
+                out.append(Violation(
+                    "gang-admission", key,
+                    f"group {group.groupName}: {n} pods is not a whole "
+                    f"number of {hosts}-host slices"))
+            elif group.maxReplicas and n // hosts > group.maxReplicas:
+                out.append(Violation(
+                    "gang-admission", key,
+                    f"group {group.groupName}: {n // hosts} slices exceeds "
+                    f"maxReplicas {group.maxReplicas}"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# warm-pool-accounting
+# ---------------------------------------------------------------------------
+
+@checker("warm-pool-accounting",
+         "warm pool counts are never negative, ready never exceeds warm, "
+         "status matches the observed unclaimed slices, and no warm pod is "
+         "double-assigned to a cluster while still unclaimed")
+def check_warm_pool_accounting(ctx: CheckContext) -> List[Violation]:
+    out: List[Violation] = []
+    for pool in ctx.store.list(KIND_WARM_POOL):
+        md = pool["metadata"]
+        key = _obj_key(KIND_WARM_POOL, md)
+        status = pool.get("status") or {}
+        warm = status.get("warmSlices")
+        ready = status.get("readySlices")
+        if warm is not None and warm < 0:
+            out.append(Violation("warm-pool-accounting", key,
+                                 f"negative warmSlices {warm}"))
+        if ready is not None and ready < 0:
+            out.append(Violation("warm-pool-accounting", key,
+                                 f"negative readySlices {ready}"))
+        if warm is not None and ready is not None and ready > warm:
+            out.append(Violation(
+                "warm-pool-accounting", key,
+                f"readySlices {ready} > warmSlices {warm}"))
+        unclaimed = [
+            p for p in ctx.live_pods(md.get("namespace", "default"),
+                                     labels={LABEL_WARM_POOL: md["name"]})
+            if not p["metadata"]["labels"].get(LABEL_WARM_CLAIMED)]
+        observed = len({p["metadata"]["labels"].get(C.LABEL_SLICE_INDEX)
+                        for p in unclaimed})
+        if not md.get("deletionTimestamp") and warm is not None and \
+                warm != observed:
+            out.append(Violation(
+                "warm-pool-accounting", key,
+                f"status.warmSlices {warm} != observed unclaimed slices "
+                f"{observed}"))
+        for p in unclaimed:
+            if p["metadata"]["labels"].get(C.LABEL_CLUSTER):
+                out.append(Violation(
+                    "warm-pool-accounting", key,
+                    f"unclaimed warm pod {p['metadata']['name']} is "
+                    "double-assigned (carries a cluster label)"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# service-capacity: upgrades never strand the stable service
+# ---------------------------------------------------------------------------
+
+@checker("service-capacity",
+         "a live TpuService's active/pending cluster references resolve, "
+         "and a service once Running keeps at least one live serving pod "
+         "behind the stable service")
+def check_service_capacity(ctx: CheckContext) -> List[Violation]:
+    out: List[Violation] = []
+    for svc in ctx.store.list(C.KIND_SERVICE):
+        md = svc["metadata"]
+        if md.get("deletionTimestamp") or \
+                svc.get("spec", {}).get("suspend"):
+            continue
+        key = _obj_key(C.KIND_SERVICE, md)
+        ns = md.get("namespace", "default")
+        status = svc.get("status") or {}
+        for role in ("activeServiceStatus", "pendingServiceStatus"):
+            cs = status.get(role)
+            if not cs:
+                continue
+            cname = cs.get("clusterName", "")
+            if cname and ctx.store.try_get(C.KIND_CLUSTER, cname,
+                                           ns) is None:
+                out.append(Violation(
+                    "service-capacity", key,
+                    f"{role} references cluster {cname} which does not "
+                    "exist"))
+        active = status.get("activeServiceStatus")
+        if active and status.get("serviceStatus") == "Running":
+            serving = [
+                p for p in ctx.live_pods(ns, labels={
+                    C.LABEL_CLUSTER: active.get("clusterName", "")})
+                if p.get("status", {}).get("phase") == "Running"]
+            if not serving:
+                out.append(Violation(
+                    "service-capacity", key,
+                    f"service reports Running but active cluster "
+                    f"{active.get('clusterName')} has zero live Running "
+                    "pods"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# no-resurrection: a deleted object's uid never reappears
+# ---------------------------------------------------------------------------
+
+@checker("no-resurrection",
+         "once the journal records DELETED for a uid, no later ADDED or "
+         "MODIFIED event carries that uid (a status write never "
+         "resurrects a deleted object)")
+def check_no_resurrection(ctx: CheckContext) -> List[Violation]:
+    out: List[Violation] = []
+    deleted: Dict[str, str] = {}    # uid -> "Kind ns/name"
+    flagged = set()
+    for rec in ctx.journal:
+        uid = rec.get("uid")
+        if not uid:
+            continue
+        key = f"{rec.get('kind')} {rec.get('ns')}/{rec.get('name')}"
+        if rec.get("type") == "DELETED":
+            deleted[uid] = key
+        elif uid in deleted and uid not in flagged:
+            flagged.add(uid)
+            out.append(Violation(
+                "no-resurrection", key,
+                f"{rec.get('type')} at rv {rec.get('rv')} resurrects uid "
+                f"{uid} deleted earlier as {deleted[uid]}"))
+    return out

@@ -1,0 +1,261 @@
+"""Named simulation scenarios: workload scripts the fault plan attacks.
+
+A scenario owns three things: the initial object graph (``setup``), the
+per-step workload mutation (``tick`` — spec edits a real user would
+make, driven by the harness's seeded rng so replays are exact), and the
+fault profile (mean injections per step, see faults.DEFAULT_PROFILE).
+
+The four shipped scenarios map to the paper's four dynamic guarantees:
+
+- ``scale-up-storm``      -> whole-slice scaling + warm-pool accounting
+- ``rolling-upgrade``     -> RayService-style upgrades never break a ring
+- ``leader-failover``     -> snapshot-rv discipline under takeover races
+- ``cronjob-burst``       -> gang admission under bursty job churn
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Optional
+
+from kuberay_tpu.api.common import (
+    Container,
+    ObjectMeta,
+    PodSpec,
+    PodTemplateSpec,
+)
+from kuberay_tpu.api.tpucluster import (
+    HeadGroupSpec,
+    TpuCluster,
+    TpuClusterSpec,
+    WorkerGroupSpec,
+)
+from kuberay_tpu.controlplane.store import Conflict
+from kuberay_tpu.sim import faults as F
+from kuberay_tpu.utils import constants as C
+
+
+def _template(image: str = "tpu-runtime:v1") -> PodTemplateSpec:
+    return PodTemplateSpec(
+        spec=PodSpec(containers=[Container(name="worker", image=image)]))
+
+
+def make_cluster_obj(name: str = "storm", accelerator: str = "v5p",
+                     topology: str = "2x2x2", replicas: int = 1,
+                     max_replicas: int = 8, image: str = "tpu-runtime:v1"):
+    return TpuCluster(
+        metadata=ObjectMeta(name=name),
+        spec=TpuClusterSpec(
+            headGroupSpec=HeadGroupSpec(template=_template(image)),
+            workerGroupSpecs=[WorkerGroupSpec(
+                groupName="workers", accelerator=accelerator,
+                topology=topology, replicas=replicas,
+                maxReplicas=max_replicas, template=_template(image))],
+        )).to_dict()
+
+
+@dataclasses.dataclass
+class Scenario:
+    name: str
+    description: str
+    profile: Dict[str, float]
+    setup: Callable
+    tick: Callable
+    default_steps: int = 12
+
+
+SCENARIOS: Dict[str, Scenario] = {}
+
+
+def scenario(name: str, description: str, profile: Dict[str, float],
+             default_steps: int = 12):
+    def register(cls):
+        inst = cls()
+        SCENARIOS[name] = Scenario(
+            name=name, description=description, profile=profile,
+            setup=inst.setup, tick=inst.tick, default_steps=default_steps)
+        return cls
+    return register
+
+
+def get_scenario(name: str) -> Optional[Scenario]:
+    return SCENARIOS.get(name)
+
+
+# ---------------------------------------------------------------------------
+# scale-up storm
+# ---------------------------------------------------------------------------
+
+@scenario(
+    "scale-up-storm",
+    "one multi-host cluster + a warm pool under aggressive replica "
+    "thrash, pod kills and slice drains: scaling must stay whole-slice",
+    profile={F.POD_KILL: 0.8, F.SLICE_DRAIN: 0.4, F.DELETE_RACE: 0.5,
+             F.SLOW_START: 0.5, F.STORE_CONFLICT: 0.8, F.WATCH_DROP: 0.5,
+             F.WATCH_DUP: 0.5, F.WATCH_DELAY: 0.5, F.LEADER_FAILOVER: 0.0})
+class _ScaleUpStorm:
+    def setup(self, h):
+        h.store.create(make_cluster_obj("storm", replicas=2))
+        h.store.create({
+            "apiVersion": C.API_VERSION, "kind": "WarmSlicePool",
+            "metadata": {"name": "standby"},
+            "spec": {"accelerator": "v5e", "topology": "4x4",
+                     "poolSize": 2},
+            "status": {},
+        })
+
+    def tick(self, h, step):
+        # A user (or autoscaler) thrashing replicas in whole-slice units.
+        cluster = h.store.try_get(C.KIND_CLUSTER, "storm")
+        if cluster is None:
+            return
+        group = cluster["spec"]["workerGroupSpecs"][0]
+        group["replicas"] = h.plan.rng.randint(0, group["maxReplicas"])
+        try:
+            h.store.update(cluster)
+        except Conflict:
+            # Lost a race with an in-flight controller write: skip this
+            # tick's scale edit, the next tick re-reads fresh state.
+            return
+
+
+# ---------------------------------------------------------------------------
+# rolling upgrade under pod kills
+# ---------------------------------------------------------------------------
+
+@scenario(
+    "rolling-upgrade",
+    "a TpuService whose cluster spec keeps changing (image bumps) while "
+    "pods die: upgrades must never strand the stable service or break a "
+    "serving ring",
+    profile={F.POD_KILL: 1.0, F.SLICE_DRAIN: 0.3, F.DELETE_RACE: 0.3,
+             F.SLOW_START: 0.4, F.STORE_CONFLICT: 0.6, F.WATCH_DROP: 0.3,
+             F.WATCH_DUP: 0.3, F.WATCH_DELAY: 0.4, F.LEADER_FAILOVER: 0.0})
+class _RollingUpgrade:
+    def setup(self, h):
+        cluster_spec = make_cluster_obj("tmpl", replicas=1,
+                                        max_replicas=4)["spec"]
+        h.store.create({
+            "apiVersion": C.API_VERSION, "kind": C.KIND_SERVICE,
+            "metadata": {"name": "inference"},
+            "spec": {
+                "clusterSpec": cluster_spec,
+                "serveConfig": {"applications": [{"name": "app",
+                                                  "rev": 0}]},
+                # Short virtual-time thresholds so self-heal paths run
+                # inside a settle horizon.
+                "serviceUnhealthySecondThreshold": 20,
+                "deploymentUnhealthySecondThreshold": 20,
+                "clusterDeletionDelaySeconds": 5,
+            },
+            "status": {},
+        })
+
+    def tick(self, h, step):
+        svc = h.store.try_get(C.KIND_SERVICE, "inference")
+        if svc is None:
+            return
+        if step % 2 == 0:
+            # Image bump: a real upgrade (hash changes -> pending cluster).
+            rev = step // 2
+            for g in ([svc["spec"]["clusterSpec"].get("headGroupSpec", {})]
+                      + svc["spec"]["clusterSpec"].get("workerGroupSpecs",
+                                                       [])):
+                tmpl = g.get("template", {})
+                for cont in tmpl.get("spec", {}).get("containers", []):
+                    cont["image"] = f"tpu-runtime:v{rev}"
+            try:
+                h.store.update(svc)
+            except Conflict:
+                return
+
+
+# ---------------------------------------------------------------------------
+# leader failover mid-reconcile
+# ---------------------------------------------------------------------------
+
+@scenario(
+    "leader-failover",
+    "cluster + job workload with repeated leader takeovers landing "
+    "mid-drain: every snapshot-rv write must 409 instead of clobbering "
+    "the new leader's state",
+    profile={F.LEADER_FAILOVER: 1.2, F.STORE_CONFLICT: 1.0,
+             F.POD_KILL: 0.5, F.SLICE_DRAIN: 0.2, F.DELETE_RACE: 0.3,
+             F.SLOW_START: 0.3, F.WATCH_DROP: 0.4, F.WATCH_DUP: 0.4,
+             F.WATCH_DELAY: 0.4})
+class _LeaderFailover:
+    def setup(self, h):
+        h.store.create(make_cluster_obj("primary", replicas=2))
+        h.store.create({
+            "apiVersion": C.API_VERSION, "kind": C.KIND_JOB,
+            "metadata": {"name": "train"},
+            "spec": {
+                "entrypoint": "python -m train",
+                "submissionMode": "HTTPMode",
+                "clusterSpec": make_cluster_obj(
+                    "train-cluster", replicas=1)["spec"],
+            },
+            "status": {},
+        })
+
+    def tick(self, h, step):
+        # Jobs complete mid-run so terminal-state transitions interleave
+        # with takeovers; a fresh job arrives every few steps.
+        h.succeed_jobs()
+        if step % 3 == 0:
+            h.store.create({
+                "apiVersion": C.API_VERSION, "kind": C.KIND_JOB,
+                "metadata": {"name": f"train-{step}"},
+                "spec": {
+                    "entrypoint": "python -m train",
+                    "submissionMode": "HTTPMode",
+                    "shutdownAfterJobFinishes": True,
+                    "ttlSecondsAfterFinished": 10,
+                    "clusterSpec": make_cluster_obj(
+                        "ignored", replicas=1)["spec"],
+                },
+                "status": {},
+            })
+
+
+# ---------------------------------------------------------------------------
+# cronjob burst
+# ---------------------------------------------------------------------------
+
+@scenario(
+    "cronjob-burst",
+    "an every-minute TpuCronJob with virtual time jumping minutes per "
+    "step: catch-up, concurrency policy and history pruning under churn",
+    profile={F.POD_KILL: 0.5, F.DELETE_RACE: 0.3, F.SLOW_START: 0.3,
+             F.STORE_CONFLICT: 0.6, F.WATCH_DROP: 0.3, F.WATCH_DUP: 0.3,
+             F.WATCH_DELAY: 0.3, F.SLICE_DRAIN: 0.2,
+             F.LEADER_FAILOVER: 0.2})
+class _CronJobBurst:
+    def setup(self, h):
+        h.store.create({
+            "apiVersion": C.API_VERSION, "kind": C.KIND_CRONJOB,
+            "metadata": {"name": "nightly"},
+            "spec": {
+                "schedule": "* * * * *",
+                "concurrencyPolicy": "Allow",
+                "successfulJobsHistoryLimit": 2,
+                "failedJobsHistoryLimit": 1,
+                "jobTemplate": {
+                    "entrypoint": "python -m batch",
+                    "submissionMode": "HTTPMode",
+                    "shutdownAfterJobFinishes": True,
+                    "ttlSecondsAfterFinished": 30,
+                    "clusterSpec": make_cluster_obj(
+                        "ignored", topology="2x2", accelerator="v5e",
+                        replicas=1)["spec"],
+                },
+            },
+            "status": {},
+        })
+
+    def tick(self, h, step):
+        # Minutes pass between steps: several schedule points fall due,
+        # jobs launch, run, succeed, and get pruned.
+        h.clock.advance(90.0)
+        h.manager.enqueue((C.KIND_CRONJOB, "default", "nightly"))
+        h.succeed_jobs()

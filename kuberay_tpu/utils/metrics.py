@@ -133,6 +133,13 @@ class ControlPlaneMetrics:
         r.describe("tpu_cluster_state", "TpuCluster state gauge (1 = in state)")
         r.describe("tpu_reconcile_total", "Reconcile invocations per kind")
         r.describe("tpu_reconcile_duration_seconds", "Reconcile latency")
+        r.describe("tpu_reconcile_conflicts_total",
+                   "Reconciles lost to an optimistic-concurrency race "
+                   "(Conflict) per kind; routine under contention, a spike "
+                   "means a foreign writer is fighting a controller")
+        r.describe("tpu_reconcile_errors_total",
+                   "Reconciles that raised and were requeued with backoff, "
+                   "per kind")
         r.describe("tpu_slice_ready_duration_seconds",
                    "Seconds from slice creation to all hosts running "
                    "(north-star metric)")
@@ -159,6 +166,12 @@ class ControlPlaneMetrics:
         self.registry.inc("tpu_reconcile_total", {"kind": kind})
         self.registry.observe("tpu_reconcile_duration_seconds", seconds,
                               {"kind": kind})
+
+    def reconcile_conflict(self, kind: str):
+        self.registry.inc("tpu_reconcile_conflicts_total", {"kind": kind})
+
+    def reconcile_error(self, kind: str):
+        self.registry.inc("tpu_reconcile_errors_total", {"kind": kind})
 
     def forget_cluster(self, cluster: str):
         self.registry.drop_labeled("cluster", cluster)

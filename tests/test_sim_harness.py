@@ -1,0 +1,448 @@
+"""Chaos-simulation subsystem gate (kuberay_tpu.sim).
+
+Mirrors test_static_analysis.py's two-half structure:
+
+1. the machinery's own regression tests — virtual clock threading,
+   fault-plan budgets, kubelet fault surface, journal determinism
+   (same seed + scenario => byte-identical journal hash);
+2. every invariant checker proven to FIRE on a hand-built violating
+   store state, plus a seeded-regression drill (slice env injection
+   sabotaged mid-run => a checker catches it with a replayable seed);
+3. a small smoke corpus across all scenarios — the per-PR robustness
+   gate (tools/sim_smoke.sh runs the bigger corpus).
+"""
+
+import pytest
+
+from kuberay_tpu.controlplane.fake_kubelet import FakeKubelet
+from kuberay_tpu.controlplane.manager import Manager
+from kuberay_tpu.controlplane.store import Conflict, ObjectStore
+from kuberay_tpu.sim.clock import VirtualClock
+from kuberay_tpu.sim.faults import (
+    STORE_CONFLICT,
+    WATCH_DROP,
+    FaultPlan,
+)
+from kuberay_tpu.sim.harness import SimHarness
+from kuberay_tpu.sim.invariants import (
+    CHECKERS,
+    DESCRIPTIONS,
+    CheckContext,
+    run_checkers,
+)
+from kuberay_tpu.sim.scenarios import SCENARIOS, get_scenario, make_cluster_obj
+from kuberay_tpu.utils import constants as C
+from kuberay_tpu.utils.metrics import ControlPlaneMetrics
+
+
+# ---------------------------------------------------------------------------
+# virtual clock in the manager
+# ---------------------------------------------------------------------------
+
+def test_manager_timed_requeues_run_on_virtual_clock():
+    clock = VirtualClock(start=1000.0)
+    store = ObjectStore()
+    manager = Manager(store, clock=clock)
+    seen = []
+    manager.register("Thing", lambda name, ns: seen.append(name) or None)
+    manager.enqueue(("Thing", "default", "later"), after=30.0)
+    assert manager.next_delayed_at() == pytest.approx(1030.0)
+    # Virtual time has not reached the deadline: nothing runs.
+    assert manager.run_until_idle() == 0
+    assert seen == []
+    clock.advance(29.0)
+    assert manager.run_until_idle() == 0
+    # Crossing the deadline promotes the key — no flush_delayed needed.
+    clock.advance(1.5)
+    assert manager.run_until_idle() == 1
+    assert seen == ["later"]
+    assert manager.next_delayed_at() is None
+
+
+def test_manager_counts_conflicts_and_errors():
+    store = ObjectStore()
+    metrics = ControlPlaneMetrics()
+    manager = Manager(store, metrics=metrics)
+
+    calls = {"n": 0}
+
+    def flaky(name, ns):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise Conflict("lost the rv race")
+        if calls["n"] == 2:
+            raise RuntimeError("boom")
+        return None
+
+    manager.register("Thing", flaky)
+    manager.enqueue(("Thing", "default", "x"))
+    manager.run_until_idle()            # -> Conflict, requeued
+    manager.flush_delayed()
+    manager.run_until_idle()            # -> RuntimeError, requeued
+    manager.flush_delayed()
+    manager.run_until_idle()            # -> clean
+    text = metrics.render()
+    assert 'tpu_reconcile_conflicts_total{kind="Thing"} 1' in text
+    assert 'tpu_reconcile_errors_total{kind="Thing"} 1' in text
+
+
+# ---------------------------------------------------------------------------
+# fake kubelet fault surface
+# ---------------------------------------------------------------------------
+
+def _make_pod(store, name, labels=None, phase=None):
+    pod = {"apiVersion": "v1", "kind": "Pod",
+           "metadata": {"name": name, "labels": labels or {}},
+           "spec": {"containers": [{"name": "w"}]}}
+    store.create(pod)
+    if phase:
+        cur = store.get("Pod", name)
+        cur["status"] = {"phase": phase}
+        store.update_status(cur)
+
+
+def test_fail_pod_merges_status_keeping_pod_ip():
+    store = ObjectStore()
+    kubelet = FakeKubelet(store)
+    _make_pod(store, "w0")
+    kubelet.step()
+    running = store.get("Pod", "w0")
+    ip = running["status"]["podIP"]
+    assert running["status"]["phase"] == "Running"
+    # Failure injection via the step() queue (the wholesale-overwrite
+    # path this PR fixes), not the direct fail_pod shortcut.
+    with kubelet._lock:
+        kubelet._pending.add(("default", "w0"))
+        kubelet._fail_next.add(("default", "w0"))
+    kubelet.step()
+    failed = store.get("Pod", "w0")
+    assert failed["status"]["phase"] == "Failed"
+    assert failed["status"]["podIP"] == ip            # last IP survives
+    assert failed["status"]["conditions"]             # conditions survive
+    kubelet.close()
+
+
+def test_deferred_fail_injection_merges_status():
+    store = ObjectStore()
+    kubelet = FakeKubelet(store)
+    # Injection BEFORE the pod exists: deferred through _fail_next.
+    kubelet.fail_pod("w1")
+    _make_pod(store, "w1")
+    kubelet.step()      # consumes the queued failure
+    failed = store.get("Pod", "w1")
+    assert failed["status"]["phase"] == "Failed"
+    kubelet.close()
+
+
+def test_hold_pod_delays_start_until_virtual_release():
+    clock = VirtualClock(start=0.0)
+    store = ObjectStore()
+    kubelet = FakeKubelet(store, now_fn=clock.now)
+    _make_pod(store, "slow")
+    kubelet.hold_pod("slow", until=50.0)
+    assert kubelet.next_hold_at() == 50.0
+    kubelet.step()
+    assert store.get("Pod", "slow").get("status", {}).get(
+        "phase", "Pending") == "Pending"
+    clock.advance(51.0)
+    kubelet.step()
+    assert store.get("Pod", "slow")["status"]["phase"] == "Running"
+    assert kubelet.next_hold_at() is None
+    kubelet.close()
+
+
+def test_fail_slice_takes_all_hosts_down():
+    store = ObjectStore()
+    kubelet = FakeKubelet(store)
+    for h in range(2):
+        _make_pod(store, f"s0-{h}",
+                  labels={C.LABEL_SLICE_NAME: "grp-0"})
+    kubelet.step()
+    assert kubelet.fail_slice("grp-0") == 2
+    phases = {p["status"]["phase"] for p in store.list("Pod")}
+    assert phases == {"Failed"}
+    kubelet.close()
+
+
+# ---------------------------------------------------------------------------
+# fault plan
+# ---------------------------------------------------------------------------
+
+def test_fault_plan_budgeted_conflict_injection():
+    plan = FaultPlan(seed=1, profile={f: 0.0 for f in
+                                      FaultPlan(0).profile})
+    plan.profile[STORE_CONFLICT] = 2.0      # exactly two armed per step
+    plan.arm()
+    store = ObjectStore()
+    store.set_interposer(plan)
+    with pytest.raises(Conflict):
+        store.create({"kind": "Pod", "metadata": {"name": "a"}})
+    with pytest.raises(Conflict):
+        store.create({"kind": "Pod", "metadata": {"name": "a"}})
+    # Budget exhausted: the third write lands.
+    store.create({"kind": "Pod", "metadata": {"name": "a"}})
+    assert plan.injected[STORE_CONFLICT] == 2
+    # Suspension shields harness-internal writes.
+    plan.profile[STORE_CONFLICT] = 1.0
+    plan.arm()
+    with plan.suspended():
+        store.create({"kind": "Pod", "metadata": {"name": "b"}})
+    with pytest.raises(Conflict):
+        store.create({"kind": "Pod", "metadata": {"name": "c"}})
+
+
+def test_fault_plan_watch_drop_is_store_level():
+    plan = FaultPlan(seed=3, profile={f: 0.0 for f in
+                                      FaultPlan(0).profile})
+    plan.profile[WATCH_DROP] = 1.0
+    plan.arm()
+    store = ObjectStore()
+    seen = []
+    store.watch(lambda ev: seen.append((ev.type, ev.kind)))
+    store.set_interposer(plan)
+    store.create({"kind": "Pod", "metadata": {"name": "a"}})   # dropped
+    store.create({"kind": "Pod", "metadata": {"name": "b"}})   # delivered
+    assert seen == [("ADDED", "Pod")]
+    # The streaming backlog always has the truth.
+    events, _, _ = store.events_since(0)
+    assert len([e for _, e in events if e.kind == "Pod"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# determinism: same seed + scenario => byte-identical journal hash
+# ---------------------------------------------------------------------------
+
+@pytest.mark.timeout(120)
+def test_same_seed_same_scenario_identical_journal_hash():
+    results = []
+    for _ in range(2):
+        with SimHarness(11, scenario=get_scenario("scale-up-storm")) as h:
+            results.append(h.run(4))
+    assert results[0].journal_hash == results[1].journal_hash
+    assert results[0].journal_len == results[1].journal_len
+    assert results[0].faults_injected == results[1].faults_injected
+    assert results[0].ok, [str(v) for v in results[0].violations]
+
+
+# ---------------------------------------------------------------------------
+# every checker fires on a hand-built violating state
+# ---------------------------------------------------------------------------
+
+def _fired(store, journal=None):
+    return {v.invariant
+            for v in run_checkers(CheckContext(store, journal or []))}
+
+
+def _worker_pod(name, slice_name, host_idx, cluster="demo",
+                env=None, group="workers", extra_labels=None):
+    labels = {
+        C.LABEL_CLUSTER: cluster,
+        C.LABEL_NODE_TYPE: C.NODE_TYPE_WORKER,
+        C.LABEL_GROUP: group,
+        C.LABEL_SLICE_NAME: slice_name,
+        C.LABEL_SLICE_INDEX: slice_name.rsplit("-", 1)[-1],
+        C.LABEL_HOST_INDEX: str(host_idx),
+    }
+    labels.update(extra_labels or {})
+    default_env = {
+        C.ENV_TPU_WORKER_ID: str(host_idx),
+        C.ENV_TPU_WORKER_HOSTNAMES: "h0.svc,h1.svc",
+        C.ENV_NUM_PROCESSES: "2",
+    }
+    if env is not None:
+        default_env = env
+    return {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": name, "labels": labels},
+        "spec": {"containers": [{
+            "name": "w",
+            "env": [{"name": k, "value": v}
+                    for k, v in default_env.items()]}]},
+        "status": {"phase": "Running"},
+    }
+
+
+def _seed_cluster(store, replicas=1):
+    store.create(make_cluster_obj("demo", topology="2x2x2",
+                                  replicas=replicas))
+
+
+def test_registry_covers_the_issue_catalog():
+    assert {"slice-identity", "slice-atomicity", "gang-admission",
+            "warm-pool-accounting", "service-capacity",
+            "no-resurrection"} <= set(CHECKERS)
+    for name in CHECKERS:
+        assert DESCRIPTIONS[name]
+
+
+def test_checker_sparse_worker_ids_fire():
+    store = ObjectStore()
+    _seed_cluster(store)
+    # Two hosts claiming the same TPU_WORKER_ID (sparse set {0, 0}).
+    store.create(_worker_pod("w0", "demo-workers-0", 0))
+    bad = _worker_pod("w1", "demo-workers-0", 1)
+    bad["spec"]["containers"][0]["env"] = [
+        {"name": C.ENV_TPU_WORKER_ID, "value": "0"},
+        {"name": C.ENV_TPU_WORKER_HOSTNAMES, "value": "h0.svc,h1.svc"},
+        {"name": C.ENV_NUM_PROCESSES, "value": "2"},
+    ]
+    store.create(bad)
+    fired = _fired(store)
+    assert "slice-identity" in fired
+
+
+def test_checker_inconsistent_hostnames_fire():
+    store = ObjectStore()
+    _seed_cluster(store)
+    store.create(_worker_pod("w0", "demo-workers-0", 0))
+    store.create(_worker_pod("w1", "demo-workers-0", 1, env={
+        C.ENV_TPU_WORKER_ID: "1",
+        C.ENV_TPU_WORKER_HOSTNAMES: "OTHER.svc,h1.svc",
+        C.ENV_NUM_PROCESSES: "2",
+    }))
+    assert "slice-identity" in _fired(store)
+
+
+def test_checker_missing_env_fire():
+    store = ObjectStore()
+    _seed_cluster(store)
+    store.create(_worker_pod("w0", "demo-workers-0", 0))
+    store.create(_worker_pod("w1", "demo-workers-0", 1, env={}))
+    assert "slice-identity" in _fired(store)
+
+
+def test_checker_partial_slice_fires():
+    store = ObjectStore()
+    _seed_cluster(store)
+    # One host of a 2-host slice: atomicity violation AND a non-whole
+    # slice count (gang).
+    store.create(_worker_pod("w0", "demo-workers-0", 0))
+    fired = _fired(store)
+    assert "slice-atomicity" in fired
+    assert "gang-admission" in fired
+
+
+def test_checker_partially_running_slice_fires():
+    store = ObjectStore()
+    _seed_cluster(store)
+    store.create(_worker_pod("w0", "demo-workers-0", 0))
+    sick = _worker_pod("w1", "demo-workers-0", 1)
+    sick["status"] = {"phase": "Pending"}
+    store.create(sick)
+    assert "slice-atomicity" in _fired(store)
+
+
+def test_checker_warm_pool_accounting_fires():
+    store = ObjectStore()
+    store.create({
+        "apiVersion": C.API_VERSION, "kind": "WarmSlicePool",
+        "metadata": {"name": "standby"},
+        "spec": {"accelerator": "v5e", "topology": "2x2", "poolSize": 1},
+        "status": {"warmSlices": -1, "readySlices": 2,
+                   "hostsPerSlice": 1},
+    })
+    fired = _fired(store)
+    assert "warm-pool-accounting" in fired
+
+
+def test_checker_double_assigned_warm_pod_fires():
+    from kuberay_tpu.controlplane.warmpool_controller import LABEL_WARM_POOL
+    store = ObjectStore()
+    store.create({
+        "apiVersion": C.API_VERSION, "kind": "WarmSlicePool",
+        "metadata": {"name": "standby"},
+        "spec": {"accelerator": "v5e", "topology": "2x2", "poolSize": 1},
+        "status": {"warmSlices": 1, "readySlices": 1, "hostsPerSlice": 1},
+    })
+    # An unclaimed warm pod that ALSO carries a cluster label: assigned
+    # to a consumer without going through claim().
+    store.create(_worker_pod(
+        "warm0", "warmpool-standby-warm-0", 0,
+        extra_labels={LABEL_WARM_POOL: "standby"}))
+    assert "warm-pool-accounting" in _fired(store)
+
+
+def test_checker_service_capacity_fires():
+    store = ObjectStore()
+    store.create({
+        "apiVersion": C.API_VERSION, "kind": C.KIND_SERVICE,
+        "metadata": {"name": "inference"},
+        "spec": {"clusterSpec":
+                 make_cluster_obj("tmpl", replicas=1)["spec"]},
+        "status": {},
+    })
+    svc = store.get(C.KIND_SERVICE, "inference")
+    # Active cluster reference points at nothing: the upgrade deleted the
+    # serving cluster before promotion.
+    svc["status"] = {"serviceStatus": "Running",
+                     "activeServiceStatus": {"clusterName": "gone"}}
+    store.update_status(svc)
+    assert "service-capacity" in _fired(store)
+
+
+def test_checker_no_resurrection_fires():
+    store = ObjectStore()
+    journal = [
+        {"type": "ADDED", "kind": "Pod", "ns": "default", "name": "w0",
+         "rv": 1, "uid": "u1"},
+        {"type": "DELETED", "kind": "Pod", "ns": "default", "name": "w0",
+         "rv": 2, "uid": "u1"},
+        # A status write re-materializing the deleted object's uid.
+        {"type": "MODIFIED", "kind": "Pod", "ns": "default", "name": "w0",
+         "rv": 3, "uid": "u1"},
+    ]
+    assert "no-resurrection" in _fired(store, journal)
+
+
+def test_checkers_quiet_on_healthy_converged_state():
+    with SimHarness(0, scenario=get_scenario("scale-up-storm"),
+                    fault_profile={f: 0.0
+                                   for f in FaultPlan(0).profile}) as h:
+        violations = h.step()
+    assert violations == [], [str(v) for v in violations]
+
+
+# ---------------------------------------------------------------------------
+# seeded regression drill: sabotage env injection mid-run, a checker
+# catches it with a replayable seed in the report
+# ---------------------------------------------------------------------------
+
+@pytest.mark.timeout(120)
+def test_seeded_regression_is_caught_with_replayable_seed(monkeypatch):
+    from kuberay_tpu.builders import pod as pod_builder
+    real = pod_builder.build_worker_pod
+
+    def sabotaged(cluster, group, slice_idx, host_idx, **kw):
+        out = real(cluster, group, slice_idx, host_idx, **kw)
+        if host_idx == 1:       # one slice member loses its identity env
+            env = out["spec"]["containers"][0]["env"]
+            out["spec"]["containers"][0]["env"] = [
+                e for e in env if e["name"] != C.ENV_TPU_WORKER_ID]
+        return out
+
+    monkeypatch.setattr(pod_builder, "build_worker_pod", sabotaged)
+    with SimHarness(5, scenario=get_scenario("scale-up-storm")) as h:
+        result = h.run(3)
+    assert not result.ok
+    assert any(v.invariant == "slice-identity" for v in result.violations)
+    # The failure report names the seed so the run replays exactly.
+    assert "--seed 5" in result.replay_command()
+
+
+# ---------------------------------------------------------------------------
+# smoke corpus: every scenario converges clean on a small fixed seed set
+# ---------------------------------------------------------------------------
+
+@pytest.mark.timeout(300)
+@pytest.mark.parametrize("scenario_name", sorted(SCENARIOS))
+def test_scenario_smoke_corpus(scenario_name):
+    for seed in (0, 1):
+        with SimHarness(seed, scenario=get_scenario(scenario_name)) as h:
+            result = h.run(3)
+        assert result.ok, (
+            f"replay: {result.replay_command()}\n"
+            + "\n".join(str(v) for v in result.violations))
+        assert result.converged
+        # The sim exports its injections as metrics.
+        if sum(result.faults_injected.values()):
+            assert "sim_faults_injected_total" in h.metrics.render()

@@ -1,0 +1,19 @@
+#!/usr/bin/env sh
+# Chaos-simulation smoke corpus: every scenario across a small fixed
+# seed set must converge with zero invariant violations.  This is the
+# standing robustness gate for controller changes — a violation prints
+# the exact replay command (scenario + seed), so failures reproduce
+# deterministically on any machine:
+#
+#   tools/sim_smoke.sh                 # default corpus (seeds 0..4)
+#   SIM_SEEDS=0..9 tools/sim_smoke.sh  # wider sweep
+#   SIM_STEPS=20   tools/sim_smoke.sh  # deeper runs
+#
+# The tier-1 pytest gate (tests/test_sim_harness.py) runs a 2-seed
+# subset of this corpus on every PR; see docs/chaos-sim.md.
+set -eu
+cd "$(dirname "$0")/.."
+exec timeout -k 10 600 env JAX_PLATFORMS=cpu python -m kuberay_tpu.sim \
+    --scenario all \
+    --seed "${SIM_SEEDS:-0..4}" \
+    --steps "${SIM_STEPS:-8}"
