@@ -525,7 +525,98 @@ class ExceptionSwallowRule(Rule):
 
 
 # ---------------------------------------------------------------------------
-# 5. tpu-env-completeness
+# 5. requeue-observability
+# ---------------------------------------------------------------------------
+
+_OBSERVED_ATTRS = {"reconcile_error", "reconcile_conflict", "record_error"}
+_RECONCILE_FN_TOKENS = ("reconcile", "_process")
+
+
+@rule
+class RequeueObservabilityRule(Rule):
+    """An ``except`` path in a controller that requeues without
+    incrementing ``tpu_reconcile_errors_total`` (or its conflict twin)
+    or recording a span error is an invisible retry loop: the object
+    churns forever, the dashboards stay green, and the only evidence is
+    a debug log nobody tails.  Every requeueing handler must leave a
+    metric or span-error trail (docs/observability.md).
+
+    Accepted evidence inside the handler: a call to
+    ``reconcile_error``/``reconcile_conflict``/``record_error``, a
+    ``.error(...)`` on a span/tracer (not a logger), or
+    ``inc("tpu_reconcile_errors_total", ...)``.
+    """
+
+    NAME = "requeue-observability"
+    DESCRIPTION = ("except paths that requeue must increment "
+                   "tpu_reconcile_errors_total or record a span error")
+    INVARIANT = "no invisible retry loops: every requeueing except is counted"
+
+    def check(self, tree: ast.Module, ctx: FileContext) -> Iterable[Finding]:
+        for fn in iter_functions(tree):
+            name = fn.name.lower()
+            if not (any(tok in name for tok in _RECONCILE_FN_TOKENS)
+                    or name.startswith("_state_")):
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Try):
+                    continue
+                for handler in node.handlers:
+                    if self._requeues(handler) and \
+                            not self._observed(handler):
+                        yield self.finding(
+                            ctx, handler,
+                            f"except path in {fn.name}() requeues without "
+                            "incrementing tpu_reconcile_errors_total / "
+                            "tpu_reconcile_conflicts_total or recording a "
+                            "span error; this retry loop would be "
+                            "invisible to operators")
+
+    @staticmethod
+    def _requeues(handler: ast.ExceptHandler) -> bool:
+        for node in ast.walk(handler):
+            # return 2.0 — a requeue-after interval straight out.
+            if isinstance(node, ast.Return) and \
+                    isinstance(node.value, ast.Constant) and \
+                    isinstance(node.value.value, (int, float)) and \
+                    not isinstance(node.value.value, bool):
+                return True
+            # return self._to(job, ..., requeue=0.1) — delegated requeue.
+            if isinstance(node, ast.Return) and \
+                    isinstance(node.value, ast.Call) and \
+                    any(kw.arg == "requeue" for kw in node.value.keywords):
+                return True
+            # requeue = 5.0 — the manager-loop pattern.
+            if isinstance(node, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == "requeue"
+                    for t in node.targets):
+                return True
+        return False
+
+    @staticmethod
+    def _observed(handler: ast.ExceptHandler) -> bool:
+        for node in ast.walk(handler):
+            if not (isinstance(node, ast.Call) and
+                    isinstance(node.func, ast.Attribute)):
+                continue
+            attr = node.func.attr
+            if attr in _OBSERVED_ATTRS:
+                return True
+            # span.error(...) / tracer errors — but never log.error.
+            if attr == "error" and \
+                    "log" not in dotted(node.func.value).lower():
+                return True
+            if attr == "inc" and any(
+                    isinstance(a, ast.Constant) and
+                    isinstance(a.value, str) and
+                    a.value.startswith("tpu_reconcile_errors_total")
+                    for a in node.args):
+                return True
+        return False
+
+
+# ---------------------------------------------------------------------------
+# 6. tpu-env-completeness
 # ---------------------------------------------------------------------------
 
 _ENV_GROUP = {"TPU_WORKER_ID", "TPU_WORKER_HOSTNAMES", "TPU_TOPOLOGY"}

@@ -67,6 +67,8 @@ class ApiHandler(JsonHandler):
     metrics = None
     token: Optional[str] = None         # bearer auth when set
     history = None                      # HistoryServer mount (optional)
+    tracer = None                       # obs.Tracer (optional)
+    flight = None                       # obs.FlightRecorder (optional)
 
     def _error(self, code: int, message: str, reason: str = ""):
         self._send(code, {"kind": "Status", "status": "Failure",
@@ -186,6 +188,42 @@ class ApiHandler(JsonHandler):
                     errors="replace"), "application/json")
         except OSError as e:
             return self._error(502, f"coordinator unreachable: {e}")
+
+    # -- observability debug surface (kuberay_tpu.obs) ---------------------
+
+    def _debug_traces(self):
+        """Span export: every recorded span (``?trace_id=`` filters one
+        chain, ``?tree=1`` nests by parent link).  404 when the operator
+        runs without a tracer, so scrapers can distinguish 'off' from
+        'empty'."""
+        if self.tracer is None:
+            return self._error(404, "tracing not enabled")
+        q = parse_qs(urlparse(self.path).query)
+        trace_id = q.get("trace_id", [None])[0]
+        if q.get("tree", ["0"])[0] in ("1", "true"):
+            from kuberay_tpu.obs.trace import span_tree
+            body = {"traces": span_tree(self.tracer.export(trace_id))}
+        else:
+            body = {"spans": self.tracer.export(trace_id)}
+        return self._send(200, body)
+
+    def _debug_flight(self, path: str):
+        """Flight-recorder timelines: ``/debug/flight`` lists tracked
+        objects; ``/debug/flight/<kind>/<ns>/<name>`` returns one ring."""
+        if self.flight is None:
+            return self._error(404, "flight recorder not enabled")
+        parts = [p for p in path.split("/") if p][2:]   # strip debug/flight
+        if not parts:
+            return self._send(200, {"objects": [
+                {"kind": k, "namespace": ns, "name": n}
+                for k, ns, n in self.flight.keys()]})
+        if len(parts) != 3:
+            return self._error(
+                404, "use /debug/flight/<kind>/<namespace>/<name>")
+        kind, ns, name = parts
+        return self._send(200, {
+            "kind": kind, "namespace": ns, "name": name,
+            "records": self.flight.timeline(kind, ns, name)})
 
     def _label_selector(self) -> Optional[Dict[str, str]]:
         q = parse_qs(urlparse(self.path).query)
@@ -353,6 +391,10 @@ class ApiHandler(JsonHandler):
                                    "application/json")
         if path == "/watch":
             return self._watch()
+        if path == "/debug/traces":
+            return self._debug_traces()
+        if path == "/debug/flight" or path.startswith("/debug/flight/"):
+            return self._debug_flight(path)
         if path.startswith("/api/history/") and self.history is not None:
             r = self.history.route(self.path)
             if r is not None:
@@ -563,15 +605,19 @@ def make_server(store: ObjectStore, host: str = "127.0.0.1", port: int = 0,
                 metrics=None, token: Optional[str] = None,
                 certfile: Optional[str] = None,
                 keyfile: Optional[str] = None,
-                history=None) -> ThreadingHTTPServer:
+                history=None, tracer=None,
+                flight=None) -> ThreadingHTTPServer:
     """``token`` enables bearer auth on every API verb; ``certfile``/
     ``keyfile`` serve TLS (the authenticated-cluster-endpoint stand-in
     RestObjectStore's client auth is tested against).  ``history``: a
     ``history.server.HistoryServer`` to mount at ``/api/history/*`` so
-    the dashboard's history views work without a second endpoint."""
+    the dashboard's history views work without a second endpoint.
+    ``tracer``/``flight`` (kuberay_tpu.obs) mount the ``/debug/traces``
+    and ``/debug/flight/...`` forensics surface."""
     handler = type("BoundApiHandler", (ApiHandler,),
                    {"store": store, "metrics": metrics, "token": token,
-                    "history": history})
+                    "history": history, "tracer": tracer,
+                    "flight": flight})
     if certfile:
         import ssl
         ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
@@ -588,10 +634,12 @@ def make_server(store: ObjectStore, host: str = "127.0.0.1", port: int = 0,
 def serve_background(store: ObjectStore, host: str = "127.0.0.1",
                      port: int = 0, metrics=None, token: Optional[str] = None,
                      certfile: Optional[str] = None,
-                     keyfile: Optional[str] = None, history=None):
+                     keyfile: Optional[str] = None, history=None,
+                     tracer=None, flight=None):
     """Start in a daemon thread; returns (server, base_url)."""
     srv = make_server(store, host, port, metrics, token=token,
-                      certfile=certfile, keyfile=keyfile, history=history)
+                      certfile=certfile, keyfile=keyfile, history=history,
+                      tracer=tracer, flight=flight)
     t = threading.Thread(target=srv.serve_forever, daemon=True,
                          name="tpu-apiserver")
     t.start()

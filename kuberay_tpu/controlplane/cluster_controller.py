@@ -45,6 +45,7 @@ from kuberay_tpu.controlplane.events import EventRecorder
 from kuberay_tpu.controlplane.expectations import HEAD_GROUP, ScaleExpectations
 from kuberay_tpu.controlplane.store import (AlreadyExists, NotFound,
                                              ObjectStore, StoreError)
+from kuberay_tpu.obs.trace import NOOP_TRACER
 from kuberay_tpu.utils import constants as C
 from kuberay_tpu.utils.names import head_service_name, spec_hash
 from kuberay_tpu.utils.validation import (
@@ -83,13 +84,21 @@ class TpuClusterController:
                  scheduler=None,
                  config_env: Optional[Dict[str, str]] = None,
                  metrics=None,
-                 use_openshift_route: bool = False):
+                 use_openshift_route: bool = False,
+                 tracer=None):
         self.store = store
         self.exp = expectations or ScaleExpectations()
         self.recorder = recorder or EventRecorder(store)
         self.scheduler = scheduler        # gang plugin (scheduler/ package)
         self.config_env = config_env or {}
         self.metrics = metrics
+        # Span annotations (store-write, slice-ready) — no-op by default,
+        # passed like ``metrics`` (kuberay_tpu.obs.trace).
+        self.tracer = tracer or NOOP_TRACER
+        # (ns, cluster, group, slice idx) already observed ready: the
+        # slice-ready duration (north-star) is emitted once per
+        # provisioning — a slice that fails and is rebuilt re-observes.
+        self._slices_observed_ready: set = set()
         # OpenShift clusters expose the head via a Route (openshift.go).
         self.use_openshift_route = use_openshift_route
 
@@ -111,6 +120,7 @@ class TpuClusterController:
         raw = self.store.try_get(self.KIND, name, namespace)
         if raw is None:
             self.exp.forget_cluster(namespace, name)
+            self._forget_ready(namespace, name)
             return None
         cluster = TpuCluster.from_dict(raw)
 
@@ -179,9 +189,15 @@ class TpuClusterController:
                 return 5.0
             self.store.remove_finalizer(self.KIND, name, ns, C.FINALIZER_GCS_FT)
         self.exp.forget_cluster(ns, name)
+        self._forget_ready(ns, name)
         if self.scheduler is not None:
             self.scheduler.cleanup(cluster.to_dict())
         return None
+
+    def _forget_ready(self, namespace: str, name: str):
+        self._slices_observed_ready = {
+            k for k in self._slices_observed_ready
+            if not (k[0] == namespace and k[1] == name)}
 
     def _reconcile_cleanup_job(self, cluster: TpuCluster) -> bool:
         ns, name = cluster.metadata.namespace, cluster.metadata.name
@@ -539,9 +555,12 @@ class TpuClusterController:
             topo = group.slice_topology()
             desired = 0 if (group.suspend or cluster.spec.suspend) else group.replicas
             slices = self._group_pods_by_slice(live, group)
-            ready_slices = sum(
-                1 for plist in slices.values()
-                if len(plist) == topo.num_hosts and all(pod_running(p) for p in plist))
+            ready_idx = {idx for idx, plist in slices.items()
+                         if len(plist) == topo.num_hosts
+                         and all(pod_running(p) for p in plist)}
+            self._observe_slice_ready(cluster, group, slices, ready_idx,
+                                      topo.num_hosts)
+            ready_slices = len(ready_idx)
             gs = WorkerGroupStatus(
                 groupName=group.groupName,
                 desiredSlices=desired,
@@ -613,6 +632,37 @@ class TpuClusterController:
         obj["status"] = new
         self._write_status(obj)
 
+    def _observe_slice_ready(self, cluster: TpuCluster,
+                             group: WorkerGroupSpec,
+                             slices: Dict[int, List[Dict[str, Any]]],
+                             ready_idx: set, hosts: int):
+        """Emit the north-star decomposition anchor once per slice
+        provisioning: ``tpu_slice_ready_duration_seconds`` (earliest pod
+        creation -> all hosts Running) plus a ``slice-ready`` span on the
+        cluster's reconcile chain, whose child queue-wait / reconcile /
+        pod-start spans account for where the time went.  A slice that
+        degrades drops out of the observed set, so its rebuild is a new
+        observation."""
+        ns, name = cluster.metadata.namespace, cluster.metadata.name
+        now = time.time()
+        for idx in ready_idx:
+            k = (ns, name, group.groupName, idx)
+            if k in self._slices_observed_ready:
+                continue
+            self._slices_observed_ready.add(k)
+            started = min((p["metadata"].get("creationTimestamp") or now)
+                          for p in slices[idx])
+            if self.metrics is not None:
+                self.metrics.observe_slice_ready(name, group.groupName,
+                                                 now - started)
+            self.tracer.record_for_key(
+                (self.KIND, ns, name), "slice-ready", started, now,
+                group=group.groupName, slice=idx, hosts=hosts)
+        stale = {k for k in self._slices_observed_ready
+                 if k[0] == ns and k[1] == name
+                 and k[2] == group.groupName and k[3] not in ready_idx}
+        self._slices_observed_ready -= stale
+
     def _set_status(self, cluster: TpuCluster, state: str, reason: str = ""):
         obj = cluster.to_dict()
         st = obj.setdefault("status", {})
@@ -630,11 +680,13 @@ class TpuClusterController:
             raise StoreError(
                 f"{self.KIND} {obj['metadata'].get('name')}: snapshot has "
                 "no resourceVersion; refusing an unguarded status write")
-        try:
-            self.store.update_status(obj)
-        except NotFound:
-            # Deleted mid-reconcile: the deletion path owns cleanup.
-            return
+        with self.tracer.span("store-write", kind=self.KIND,
+                              obj=obj["metadata"].get("name", "")):
+            try:
+                self.store.update_status(obj)
+            except NotFound:
+                # Deleted mid-reconcile: the deletion path owns cleanup.
+                return
 
     @staticmethod
     def _status_equal(a: Dict[str, Any], b: Dict[str, Any]) -> bool:

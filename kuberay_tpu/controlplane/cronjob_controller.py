@@ -17,6 +17,7 @@ from kuberay_tpu.builders.common import owner_reference
 from kuberay_tpu.controlplane.events import EventRecorder
 from kuberay_tpu.controlplane.store import (AlreadyExists, NotFound,
                                              ObjectStore)
+from kuberay_tpu.obs.trace import NOOP_TRACER
 from kuberay_tpu.utils import constants as C
 from kuberay_tpu.utils import features
 from kuberay_tpu.utils.cron import missed_runs, next_run_after
@@ -30,9 +31,12 @@ class TpuCronJobController:
     KIND = C.KIND_CRONJOB
 
     def __init__(self, store: ObjectStore,
-                 recorder: Optional[EventRecorder] = None):
+                 recorder: Optional[EventRecorder] = None,
+                 tracer=None):
         self.store = store
         self.recorder = recorder or EventRecorder(store)
+        # Span annotations — no-op by default, passed like ``metrics``.
+        self.tracer = tracer or NOOP_TRACER
 
     def reconcile(self, name: str, namespace: str = "default") -> Optional[float]:
         raw = self.store.try_get(self.KIND, name, namespace)
@@ -169,9 +173,11 @@ class TpuCronJobController:
         # requeues instead of being clobbered (SURVEY §5.2).
         if obj.get("status") == getattr(cron, "_orig_status", None):
             return
-        try:
-            out = self.store.update_status(obj)
-        except NotFound:
-            return      # deleted mid-reconcile
+        with self.tracer.span("store-write", kind=self.KIND,
+                              obj=cron.metadata.name):
+            try:
+                out = self.store.update_status(obj)
+            except NotFound:
+                return      # deleted mid-reconcile
         cron.metadata.resourceVersion = out["metadata"]["resourceVersion"]
         cron._orig_status = copy.deepcopy(out.get("status", {}))

@@ -1,29 +1,51 @@
 """K8s-style Event recording (ref EventRecorder + typed reasons,
 utils/constant.go EventType section).  Events land in the store as
-``Event`` objects so clients/CLI can list them alongside CRs."""
+``Event`` objects so clients/CLI can list them alongside CRs.
+
+Determinism seams (the chaos sim's replay contract): ``clock`` overrides
+the ``eventTime`` source and ``name_factory`` overrides the uuid4 name
+suffix — the sim harness passes its virtual clock and a counter-based
+factory so controller event emission is a pure function of the run
+(identical names/timestamps per (scenario, seed), across processes),
+instead of perturbing timelines with wall time and OS randomness.
+Production keeps the uuid default: names must not collide across
+operator replicas sharing a store.
+"""
 
 from __future__ import annotations
 
 import time
 import uuid
-from typing import Any, Dict
+from typing import Any, Callable, Dict, Optional
 
 from kuberay_tpu.controlplane.store import ObjectStore
 
 
 class EventRecorder:
-    def __init__(self, store: ObjectStore):
+    def __init__(self, store: ObjectStore, clock=None,
+                 name_factory: Optional[Callable[[str], str]] = None):
         self._store = store
+        # Duck-typed .now(); falls back to module-level time.time at CALL
+        # time so the sim's patch_time shim also covers recorders built
+        # before the clock was threaded through.
+        self._clock = clock
+        self._name_factory = name_factory
+
+    def _event_name(self, base: str) -> str:
+        if self._name_factory is not None:
+            return self._name_factory(base)
+        return f"{base}.{uuid.uuid4().hex[:10]}"
 
     def event(self, obj: Dict[str, Any], etype: str, reason: str, message: str):
         """etype: 'Normal' | 'Warning'."""
         md = obj.get("metadata", {})
         name = md.get("name", "unknown")
+        now = self._clock.now() if self._clock is not None else time.time()
         self._store.create({
             "apiVersion": "v1",
             "kind": "Event",
             "metadata": {
-                "name": f"{name}.{uuid.uuid4().hex[:10]}",
+                "name": self._event_name(name),
                 "namespace": md.get("namespace", "default"),
             },
             "type": etype,
@@ -35,7 +57,7 @@ class EventRecorder:
                 "namespace": md.get("namespace", "default"),
                 "uid": md.get("uid"),
             },
-            "eventTime": time.time(),
+            "eventTime": now,
         })
 
     def normal(self, obj, reason, message):

@@ -33,6 +33,7 @@ import time
 from typing import Callable, Dict, Optional, Set
 
 from kuberay_tpu.controlplane.store import Conflict, Event, NotFound, ObjectStore
+from kuberay_tpu.obs.trace import NOOP_TRACER
 from kuberay_tpu.utils import constants as C
 
 
@@ -42,12 +43,30 @@ def _fail_status(pod: dict) -> dict:
     return {**pod.get("status", {}), "phase": "Failed"}
 
 
+def _pod_owner_key(pod: dict):
+    """The reconcile-chain key a pod's lifecycle belongs to: its owning
+    TpuCluster (cluster label) or WarmSlicePool (pool label) — where the
+    tracer parents pod-start spans so slice-ready durations decompose."""
+    labels = pod.get("metadata", {}).get("labels", {}) or {}
+    ns = pod.get("metadata", {}).get("namespace", "default")
+    cluster = labels.get(C.LABEL_CLUSTER)
+    if cluster:
+        return (C.KIND_CLUSTER, ns, cluster)
+    pool = labels.get("tpu.dev/warm-pool")   # warmpool_controller label
+    if pool:
+        return ("WarmSlicePool", ns, pool)
+    return None
+
+
 class FakeKubelet:
     def __init__(self, store: ObjectStore, auto_run: bool = True,
-                 now_fn: Optional[Callable[[], float]] = None):
+                 now_fn: Optional[Callable[[], float]] = None,
+                 tracer=None):
         self.store = store
         self.auto_run = auto_run
         self._now = now_fn or time.time
+        # Span annotations (pod-start) — no-op by default.
+        self.tracer = tracer or NOOP_TRACER
         self._ip = itertools.count(1)
         self._lock = threading.Lock()
         self._pending: Set[tuple] = set()       # (ns, name)
@@ -122,6 +141,26 @@ class FakeKubelet:
         with self._lock:
             return min(self._hold_until.values()) if self._hold_until else None
 
+    def _record_pod_start(self, pod: dict, now: float) -> None:
+        """pod-start span: creation -> Running, parented on the owning
+        CR's reconcile chain — the pod-level share of slice-ready time
+        (scheduling + env injection + kubelet start, and any injected
+        slow-start hold)."""
+        if not self.tracer.enabled:
+            return
+        owner = _pod_owner_key(pod)
+        if owner is None:
+            return
+        md = pod["metadata"]
+        # Clamp: creationTimestamp may come from a different clock
+        # domain than now_fn (wall-time store under a virtual-clock
+        # kubelet); a span must never run backwards.
+        created = min(md.get("creationTimestamp") or now, now)
+        self.tracer.record_for_key(
+            owner, "pod-start", created, now,
+            pod=md.get("name", ""),
+            slice=md.get("labels", {}).get(C.LABEL_SLICE_NAME, ""))
+
     def step(self) -> int:
         """Advance queued Pending pods one phase; returns pods touched."""
         now = self._now()
@@ -135,6 +174,7 @@ class FakeKubelet:
             pod = self.store.try_get("Pod", name, ns)
             if pod is None or pod["metadata"].get("deletionTimestamp"):
                 continue
+            started = False
             if (ns, name) in to_fail:
                 pod["status"] = _fail_status(pod)
                 to_fail.discard((ns, name))
@@ -156,11 +196,14 @@ class FakeKubelet:
                     "podIP": f"10.0.{(n // 256) % 256}.{n % 256}",
                     "conditions": [{"type": "Ready", "status": "True"}],
                 }
+                started = True
             else:
                 continue
             try:
                 self.store.update_status(pod)
                 touched += 1
+                if started:
+                    self._record_pod_start(pod, now)
             except NotFound:
                 pass
             except Conflict:

@@ -39,6 +39,7 @@ from kuberay_tpu.builders.job import (
 from kuberay_tpu.controlplane.events import EventRecorder
 from kuberay_tpu.controlplane.store import (AlreadyExists, NotFound,
                                              ObjectStore)
+from kuberay_tpu.obs.trace import NOOP_TRACER
 from kuberay_tpu.runtime.coordinator_client import CoordinatorError
 from kuberay_tpu.utils import constants as C
 from kuberay_tpu.utils.names import (
@@ -56,12 +57,15 @@ class TpuJobController:
                  recorder: Optional[EventRecorder] = None,
                  client_provider: Optional[Callable] = None,
                  scheduler=None,
-                 metrics=None):
+                 metrics=None,
+                 tracer=None):
         self.store = store
         self.recorder = recorder or EventRecorder(store)
         self.client_provider = client_provider
         self.scheduler = scheduler
         self.metrics = metrics
+        # Span annotations — no-op by default, passed like ``metrics``.
+        self.tracer = tracer or NOOP_TRACER
 
     # ------------------------------------------------------------------
 
@@ -179,6 +183,8 @@ class TpuJobController:
                 client.submit_job(job.status.jobId, job.spec.entrypoint,
                                   job.spec.runtimeEnv, job.spec.metadata)
             except CoordinatorError as e:
+                self.tracer.record_error("coordinator",
+                                         f"submission failed: {e}")
                 self._set_message(job, f"submission failed: {e}")
                 self._update(job)
                 return 2.0
@@ -251,8 +257,10 @@ class TpuJobController:
                 if info.status in JobStatus.TERMINAL:
                     app_status = info.status
                 job.status.message = info.message
-            except CoordinatorError:
+            except CoordinatorError as e:
                 if app_status is None:
+                    self.tracer.record_error("coordinator",
+                                             f"job info poll failed: {e}")
                     self._update(job)
                     return 2.0
 
@@ -515,9 +523,11 @@ class TpuJobController:
         # of being clobbered (SURVEY §5.2).
         if obj.get("status") == getattr(job, "_orig_status", None):
             return
-        try:
-            out = self.store.update_status(obj)
-        except NotFound:
-            return      # deleted mid-reconcile; deletion path owns cleanup
+        with self.tracer.span("store-write", kind=self.KIND,
+                              obj=job.metadata.name):
+            try:
+                out = self.store.update_status(obj)
+            except NotFound:
+                return  # deleted mid-reconcile; deletion path owns cleanup
         job.metadata.resourceVersion = out["metadata"]["resourceVersion"]
         job._orig_status = copy.deepcopy(out.get("status", {}))

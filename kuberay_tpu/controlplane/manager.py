@@ -21,6 +21,7 @@ from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from kuberay_tpu.controlplane.expectations import HEAD_GROUP, ScaleExpectations
 from kuberay_tpu.controlplane.store import Conflict, Event, ObjectStore
+from kuberay_tpu.obs.trace import NOOP_TRACER
 from kuberay_tpu.utils import constants as C
 
 Key = Tuple[str, str, str]  # (kind, namespace, name)
@@ -31,7 +32,7 @@ _LOG = logging.getLogger("kuberay_tpu.manager")
 class Manager:
     def __init__(self, store: ObjectStore,
                  expectations: Optional[ScaleExpectations] = None,
-                 clock=None, metrics=None):
+                 clock=None, metrics=None, tracer=None, flight=None):
         self.store = store
         # ``clock`` is any object with ``.now() -> float`` (duck-typed so
         # controlplane does not depend on the sim package).  Timed
@@ -42,6 +43,13 @@ class Manager:
         # Optional ControlPlaneMetrics: counts requeue-causing Conflict /
         # Exception outcomes per kind (they were debug-log-only before).
         self.metrics = metrics
+        # Observability seams (kuberay_tpu.obs), both no-op-safe: the
+        # tracer mints a TraceContext per reconcile-chain key as events
+        # enter _on_event/enqueue and carries it through _pop/_process
+        # (queue-wait + reconcile spans); the flight recorder keeps the
+        # per-object ring of deliveries/conflicts/requeues.
+        self.tracer = tracer or NOOP_TRACER
+        self.flight = flight
         self.expectations = expectations or ScaleExpectations()
         self._reconcilers: Dict[str, Callable[[str, str], Optional[float]]] = {}
         # kinds whose owned objects (by label) map back to an owner kind:
@@ -69,6 +77,8 @@ class Manager:
 
     def _on_event(self, ev: Event):
         md = ev.obj.get("metadata", {})
+        if self.flight is not None:
+            self.flight.observe_event(ev)
         # Expectations observe pod churn (ref expectations consumption at
         # raycluster_controller.go:974,1035).
         if ev.kind == "Pod":
@@ -96,6 +106,11 @@ class Manager:
                     self.enqueue(key)
 
     def enqueue(self, key: Key, after: float = 0.0):
+        # Trace context attaches at scheduling time, delayed or not: the
+        # eventual queue-wait span must cover requeue backoff (that wait
+        # is real latency the slice-ready decomposition has to account
+        # for).  queued() keeps the earliest pending instant on dedup.
+        self.tracer.queued(key, self._now(), delayed=after > 0)
         with self._lock:
             if after > 0:
                 heapq.heappush(self._delayed, (self._now() + after, key))
@@ -131,25 +146,40 @@ class Manager:
         fn = self._reconcilers.get(kind)
         if fn is None:
             return
-        try:
-            requeue = fn(name, ns)
-        except Conflict as e:
-            # Optimistic-concurrency loss (another writer won the rv
-            # race, e.g. leader-failover overlap): routine, not an
-            # error — requeue fast so the reconciler re-reads and
-            # recomputes from fresh state (SURVEY §5.2).
-            _LOG.debug("reconcile %s %s/%s conflicted, requeueing: %s",
-                       kind, ns, name, e)
-            if self.metrics is not None:
-                self.metrics.reconcile_conflict(kind)
-            requeue = 0.05
-        except Exception as e:   # reconcile errors requeue with backoff
-            _LOG.exception(
-                "reconcile %s %s/%s failed: %s", kind, ns, name, e)
-            if self.metrics is not None:
-                self.metrics.reconcile_error(kind)
-            requeue = 5.0
+        self.tracer.dequeued(key, self._now())
+        with self.tracer.reconcile(key, kind=kind, namespace=ns,
+                                   name=name) as span:
+            try:
+                requeue = fn(name, ns)
+            except Conflict as e:
+                # Optimistic-concurrency loss (another writer won the rv
+                # race, e.g. leader-failover overlap): routine, not an
+                # error — requeue fast so the reconciler re-reads and
+                # recomputes from fresh state (SURVEY §5.2).
+                _LOG.debug("reconcile %s %s/%s conflicted, requeueing: %s",
+                           kind, ns, name, e)
+                if self.metrics is not None:
+                    self.metrics.reconcile_conflict(kind)
+                span.error(f"conflict: {e}")
+                if self.flight is not None:
+                    self.flight.record(kind, ns, name, "conflict", str(e))
+                requeue = 0.05
+            except Exception as e:   # reconcile errors requeue with backoff
+                _LOG.exception(
+                    "reconcile %s %s/%s failed: %s", kind, ns, name, e)
+                if self.metrics is not None:
+                    self.metrics.reconcile_error(kind)
+                span.error(f"{type(e).__name__}: {e}")
+                if self.flight is not None:
+                    self.flight.record(kind, ns, name, "error",
+                                       f"{type(e).__name__}: {e}")
+                requeue = 5.0
+            if requeue:
+                span.set(requeue_after=requeue)
         if requeue:
+            if self.flight is not None:
+                self.flight.record(kind, ns, name, "requeue",
+                                   f"after={requeue}")
             self.enqueue(key, after=requeue)
 
     def next_delayed_at(self) -> Optional[float]:

@@ -35,6 +35,7 @@ from kuberay_tpu.builders.service import build_serve_service
 from kuberay_tpu.controlplane.events import EventRecorder
 from kuberay_tpu.controlplane.store import (AlreadyExists, NotFound,
                                              ObjectStore)
+from kuberay_tpu.obs.trace import NOOP_TRACER
 from kuberay_tpu.runtime.coordinator_client import CoordinatorError
 from kuberay_tpu.utils import constants as C
 from kuberay_tpu.utils import features
@@ -53,10 +54,13 @@ class TpuServiceController:
 
     def __init__(self, store: ObjectStore,
                  recorder: Optional[EventRecorder] = None,
-                 client_provider: Optional[Callable] = None):
+                 client_provider: Optional[Callable] = None,
+                 tracer=None):
         self.store = store
         self.recorder = recorder or EventRecorder(store)
         self.client_provider = client_provider
+        # Span annotations — no-op by default, passed like ``metrics``.
+        self.tracer = tracer or NOOP_TRACER
         # serve config cache per cluster (ref cacheServeConfig): avoids
         # re-PUTting an unchanged config every pass.
         self._submitted: Dict[str, str] = {}
@@ -350,6 +354,8 @@ class TpuServiceController:
                     client.update_serve_apps(svc.spec.serveConfig)
                     self._submitted[cs.clusterName] = cfg_hash
                 except CoordinatorError as e:
+                    self.tracer.record_error("coordinator",
+                                             f"serve config push failed: {e}")
                     self.recorder.warning(svc.to_dict(), "ServeConfigFailed",
                                           str(e))
                     continue
@@ -590,10 +596,12 @@ class TpuServiceController:
         # foreign write anywhere in the pass (leader-failover overlap)
         # 409s and requeues instead of being clobbered (SURVEY §5.2).
         if obj.get("status") != getattr(svc, "_orig_status", None):
-            try:
-                out = self.store.update_status(obj)
-            except NotFound:
-                return      # deleted mid-reconcile
+            with self.tracer.span("store-write", kind=self.KIND,
+                                  obj=svc.metadata.name):
+                try:
+                    out = self.store.update_status(obj)
+                except NotFound:
+                    return      # deleted mid-reconcile
             svc.metadata.resourceVersion = \
                 out["metadata"]["resourceVersion"]
             svc._orig_status = copy.deepcopy(out.get("status", {}))

@@ -28,6 +28,7 @@ from kuberay_tpu.builders.pod import build_slice_pods
 from kuberay_tpu.controlplane.events import EventRecorder
 from kuberay_tpu.controlplane.store import (AlreadyExists, NotFound,
                                              ObjectStore)
+from kuberay_tpu.obs.trace import NOOP_TRACER
 from kuberay_tpu.topology import TopologyError
 from kuberay_tpu.utils import constants as C
 from kuberay_tpu.utils import features
@@ -56,7 +57,9 @@ class WarmSlicePoolController:
     KIND = KIND_WARM_POOL
 
     def __init__(self, store: ObjectStore,
-                 recorder: Optional[EventRecorder] = None):
+                 recorder: Optional[EventRecorder] = None,
+                 tracer=None):
+        self.tracer = tracer or NOOP_TRACER
         self.store = store
         self.recorder = recorder or EventRecorder(store)
 
@@ -172,10 +175,11 @@ class WarmSlicePoolController:
             # ``obj`` (no pre-write re-read): a foreign write in the
             # pass (leader-failover overlap) 409s and requeues instead
             # of clobbering (SURVEY §5.2).
-            try:
-                self.store.update_status(obj)
-            except NotFound:
-                return None     # deleted mid-reconcile
+            with self.tracer.span("store-write", kind=self.KIND, obj=name):
+                try:
+                    self.store.update_status(obj)
+                except NotFound:
+                    return None     # deleted mid-reconcile
         return None
 
     def claim(self, name: str, namespace: str = "default") -> Optional[List[str]]:

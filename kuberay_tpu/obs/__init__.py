@@ -1,0 +1,41 @@
+"""Observability: causal reconcile tracing + per-CR flight recorder.
+
+The third leg of the tooling tripod (docs/observability.md):
+``kuberay_tpu.analysis`` proves invariants statically, ``kuberay_tpu.sim``
+exercises them under seeded chaos, and this package answers "where did
+the time go / what sequence of events produced this state" — in
+production and in sim-violation forensics — from one artifact.
+
+- :mod:`kuberay_tpu.obs.trace`: Dapper-style parent-linked spans with
+  explicit trace-context propagation through the manager's
+  watch -> queue -> reconcile pipeline (queue-wait, reconcile,
+  store-write, pod-start, slice-ready), a bounded in-memory
+  :class:`SpanStore` and JSON export.  ``NOOP_TRACER`` makes every
+  annotation free when tracing is off.
+- :mod:`kuberay_tpu.obs.flight`: fixed-size per-(kind, ns, name) ring
+  buffer of watch deliveries, state transitions, recorded Events,
+  conflicts and requeues, queryable as a timeline
+  (``/debug/flight/<kind>/<ns>/<name>`` on the API server).
+"""
+
+from kuberay_tpu.obs.flight import FlightRecorder
+from kuberay_tpu.obs.trace import (
+    NOOP_TRACER,
+    NoopTracer,
+    Span,
+    SpanStore,
+    TraceContext,
+    Tracer,
+    span_tree,
+)
+
+__all__ = [
+    "FlightRecorder",
+    "NOOP_TRACER",
+    "NoopTracer",
+    "Span",
+    "SpanStore",
+    "TraceContext",
+    "Tracer",
+    "span_tree",
+]

@@ -1,0 +1,111 @@
+"""Per-CR flight recorder: a fixed-size ring buffer of what happened.
+
+The black-box counterpart to the tracer: where spans answer "where did
+the time go", the flight recorder answers "what sequence of events
+produced this state" for one object — watch deliveries, state
+transitions, recorded K8s Events, optimistic-concurrency conflicts,
+reconcile errors and requeues — keyed by (kind, namespace, name) and
+queryable as a timeline (``/debug/flight/<kind>/<ns>/<name>``).
+
+Bounded twice: ``capacity`` records per object (deque ring), and
+``max_objects`` tracked objects (LRU eviction), so a churning cluster
+can never grow it past a fixed footprint.  Purely observational — it
+reads the clock and nothing else, so recording under simulation leaves
+the replay hash untouched.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Any, Dict, List, Optional, Tuple
+
+Key = Tuple[str, str, str]          # (kind, namespace, name)
+
+
+class FlightRecorder:
+    def __init__(self, capacity: int = 256, max_objects: int = 2048,
+                 clock=None):
+        self.capacity = capacity
+        self.max_objects = max_objects
+        self._now = clock.now if clock is not None else time.time
+        self._lock = threading.Lock()
+        self._buffers: "OrderedDict[Key, deque]" = OrderedDict()
+        self._last_state: Dict[Key, str] = {}
+
+    # -- recording ----------------------------------------------------------
+
+    def record(self, kind: str, namespace: str, name: str, rtype: str,
+               detail: str = "", **attrs) -> None:
+        """Append one record to the object's ring.  ``rtype`` is the
+        record class ("watch" | "state" | "event" | "conflict" |
+        "error" | "requeue" | free-form)."""
+        rec: Dict[str, Any] = {"ts": self._now(), "type": rtype,
+                               "detail": detail}
+        rec.update(attrs)
+        key = (kind, namespace, name)
+        with self._lock:
+            buf = self._buffers.get(key)
+            if buf is None:
+                buf = deque(maxlen=self.capacity)
+                self._buffers[key] = buf
+                if len(self._buffers) > self.max_objects:
+                    old_key, _ = self._buffers.popitem(last=False)
+                    self._last_state.pop(old_key, None)
+            else:
+                self._buffers.move_to_end(key)
+            buf.append(rec)
+
+    def observe_event(self, ev) -> None:
+        """Fold a store watch Event into the recorder: K8s Event objects
+        land on their involvedObject's timeline; everything else records
+        the delivery itself plus a synthesized state-transition record
+        when status.state/phase changed since the last delivery."""
+        obj = ev.obj
+        md = obj.get("metadata", {})
+        if ev.kind == "Event":
+            io = obj.get("involvedObject", {}) or {}
+            self.record(io.get("kind", "") or "", io.get("namespace",
+                        md.get("namespace", "default")),
+                        io.get("name", "") or "", "event",
+                        f"{obj.get('type', '')}/{obj.get('reason', '')}: "
+                        f"{obj.get('message', '')}"[:300])
+            return
+        ns = md.get("namespace", "default")
+        name = md.get("name", "")
+        status = obj.get("status") or {}
+        state = str(status.get("state") or
+                    status.get("jobDeploymentStatus") or
+                    status.get("serviceStatus") or
+                    status.get("phase") or "")
+        self.record(ev.kind, ns, name, "watch", ev.type,
+                    rv=md.get("resourceVersion"))
+        key = (ev.kind, ns, name)
+        with self._lock:
+            prev = self._last_state.get(key, "")
+            changed = state != prev
+            if changed:
+                self._last_state[key] = state
+        if changed:
+            self.record(ev.kind, ns, name, "state",
+                        f"{prev or '<none>'} -> {state or '<none>'}")
+
+    # -- querying -----------------------------------------------------------
+
+    def timeline(self, kind: str, namespace: str, name: str
+                 ) -> List[Dict[str, Any]]:
+        with self._lock:
+            buf = self._buffers.get((kind, namespace, name))
+            return list(buf) if buf is not None else []
+
+    def keys(self) -> List[Key]:
+        with self._lock:
+            return list(self._buffers)
+
+    def to_dict(self) -> Dict[str, Any]:
+        """Whole-recorder snapshot (sim failure reports)."""
+        with self._lock:
+            items = [("%s/%s/%s" % k, list(buf))
+                     for k, buf in self._buffers.items()]
+        return {key: records for key, records in items}

@@ -1,0 +1,424 @@
+"""Causal reconcile tracing: explicit trace-context propagation.
+
+The control plane's north-star metric is slice-ready latency, but a
+histogram only says *that* a slice took N seconds.  This module says
+*where* the time went, Dapper-style: a :class:`TraceContext` is minted
+when a watch event enters ``Manager._on_event`` (via ``enqueue``),
+carried through ``_pop``/``_process`` and into controller store writes
+and FakeKubelet actions, producing parent-linked spans:
+
+- ``chain:<kind>/<ns>/<name>`` — the root span of an object's reconcile
+  chain (open-ended; its end extends as children finish);
+- ``queue-wait`` — from when a key was (re)scheduled (including timed
+  requeue backoff) to when a worker picked it up;
+- ``reconcile`` — one reconciler invocation, with its outcome
+  (ok / conflict / error / requeue-after);
+- ``store-write`` — a controller's status/spec write;
+- ``pod-start`` — pod creation to Running (recorded by FakeKubelet
+  against the owning CR's chain);
+- ``slice-ready`` — first pod creation of a slice to all hosts Running
+  (the north-star decomposition anchor).
+
+Everything is observational: the tracer never touches the store, the
+rng, or the clock's state, so a chaos-sim replay hash is byte-identical
+with tracing on and off (the tier-1 contract in tests/test_obs_trace.py).
+
+``NOOP_TRACER`` is the default everywhere a ``tracer`` parameter is
+accepted — annotations cost one attribute lookup when tracing is off.
+Span/trace ids come from a plain counter (not uuid) so traces of a
+deterministic sim run are themselves deterministic.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+Key = Tuple[str, str, str]          # (kind, namespace, name)
+
+
+class TraceContext:
+    """The propagation token: which trace, and which span to parent new
+    children under.  Minted per reconcile-chain key; carried implicitly
+    through the manager queue (keyed maps) and a thread-local stack for
+    code running inside a reconcile."""
+
+    __slots__ = ("trace_id", "span_id")
+
+    def __init__(self, trace_id: str, span_id: str):
+        self.trace_id = trace_id
+        self.span_id = span_id
+
+    def __repr__(self):
+        return f"TraceContext({self.trace_id}, {self.span_id})"
+
+
+class Span:
+    """One timed operation.  ``end is None`` means still open (only the
+    chain roots stay open; everything else is recorded at finish)."""
+
+    __slots__ = ("trace_id", "span_id", "parent_id", "name",
+                 "start", "end", "attrs", "status", "error")
+
+    def __init__(self, trace_id: str, span_id: str, parent_id: str,
+                 name: str, start: float, end: Optional[float] = None,
+                 attrs: Optional[Dict[str, Any]] = None,
+                 status: str = "ok", error: str = ""):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.name = name
+        self.start = start
+        self.end = end
+        self.attrs = attrs or {}
+        self.status = status
+        self.error = error
+
+    @property
+    def duration(self) -> Optional[float]:
+        return None if self.end is None else self.end - self.start
+
+    def to_dict(self) -> Dict[str, Any]:
+        out = {"trace_id": self.trace_id, "span_id": self.span_id,
+               "parent_id": self.parent_id, "name": self.name,
+               "start": self.start, "end": self.end,
+               "duration": self.duration, "status": self.status,
+               "attrs": dict(self.attrs)}
+        if self.error:
+            out["error"] = self.error
+        return out
+
+
+class SpanStore:
+    """Bounded in-memory span sink: oldest spans are dropped (and
+    counted) once ``max_spans`` is exceeded — tracing must never become
+    the memory leak it exists to debug."""
+
+    def __init__(self, max_spans: int = 8192):
+        self.max_spans = max_spans
+        self._lock = threading.Lock()
+        self._spans: List[Span] = []
+        self._dropped = 0
+
+    def add(self, span: Span) -> None:
+        with self._lock:
+            self._spans.append(span)
+            if len(self._spans) > self.max_spans:
+                overflow = len(self._spans) - self.max_spans
+                del self._spans[:overflow]
+                self._dropped += overflow
+
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return self._dropped
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._spans)
+
+    def export(self, trace_id: Optional[str] = None) -> List[Dict[str, Any]]:
+        with self._lock:
+            spans = list(self._spans)
+        return [s.to_dict() for s in spans
+                if trace_id is None or s.trace_id == trace_id]
+
+    def trace_ids(self) -> List[str]:
+        with self._lock:
+            seen: Dict[str, None] = {}
+            for s in self._spans:
+                seen.setdefault(s.trace_id, None)
+        return list(seen)
+
+
+def span_tree(spans: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Nest exported span dicts by parent link: returns the roots, each
+    with a ``children`` list (sorted by start time).  Orphans whose
+    parent was dropped from the bounded store surface as roots."""
+    by_id = {s["span_id"]: {**s, "children": []} for s in spans}
+    roots = []
+    for node in by_id.values():
+        parent = by_id.get(node["parent_id"])
+        if parent is not None and parent is not node:
+            parent["children"].append(node)
+        else:
+            roots.append(node)
+    def sort(nodes):
+        nodes.sort(key=lambda n: (n["start"], n["span_id"]))
+        for n in nodes:
+            sort(n["children"])
+    sort(roots)
+    return roots
+
+
+class _SpanHandle:
+    """The mutable in-flight span yielded by ``tracer.span(...)`` /
+    ``tracer.reconcile(...)``: annotate with ``set``, mark failure with
+    ``error`` — finalized into an immutable :class:`Span` on exit."""
+
+    __slots__ = ("ctx", "span_id", "name", "start", "attrs",
+                 "status", "error_message")
+
+    def __init__(self, ctx: TraceContext, span_id: str, name: str,
+                 start: float):
+        self.ctx = ctx
+        self.span_id = span_id
+        self.name = name
+        self.start = start
+        self.attrs: Dict[str, Any] = {}
+        self.status = "ok"
+        self.error_message = ""
+
+    def set(self, **attrs) -> None:
+        self.attrs.update(attrs)
+
+    def error(self, message: str) -> None:
+        self.status = "error"
+        self.error_message = message
+
+
+class _NoopSpan:
+    __slots__ = ()
+
+    def set(self, **attrs) -> None:
+        pass
+
+    def error(self, message: str) -> None:
+        pass
+
+
+_NOOP_SPAN = _NoopSpan()
+
+
+class NoopTracer:
+    """The default tracer: every hook is a no-op, every span a shared
+    sentinel — controllers and the manager annotate unconditionally and
+    pay nothing when tracing is off."""
+
+    enabled = False
+
+    def context_for(self, key: Key) -> Optional[TraceContext]:
+        return None
+
+    def queued(self, key: Key, ts: Optional[float] = None,
+               delayed: bool = False) -> None:
+        pass
+
+    def dequeued(self, key: Key, ts: Optional[float] = None) -> None:
+        pass
+
+    @contextmanager
+    def reconcile(self, key: Key, **attrs) -> Iterator[_NoopSpan]:
+        yield _NOOP_SPAN
+
+    @contextmanager
+    def span(self, name: str, **attrs) -> Iterator[_NoopSpan]:
+        yield _NOOP_SPAN
+
+    def record_error(self, scope: str, message: str) -> None:
+        pass
+
+    def record_for_key(self, key: Key, name: str, start: float, end: float,
+                       **attrs) -> None:
+        pass
+
+    def current(self) -> Optional[TraceContext]:
+        return None
+
+    def export(self, trace_id: Optional[str] = None) -> List[Dict[str, Any]]:
+        return []
+
+
+NOOP_TRACER = NoopTracer()
+
+
+class Tracer(NoopTracer):
+    """The real tracer.  One *chain* (= one trace) per reconcile key:
+    the chain root is an open span that extends as children finish, so
+    every queue-wait/reconcile/store-write/pod-start of an object links
+    into one causal timeline.  Chains are LRU-bounded; the span sink is
+    size-bounded (:class:`SpanStore`)."""
+
+    enabled = True
+
+    def __init__(self, clock=None, max_spans: int = 8192,
+                 max_chains: int = 2048):
+        # ``clock``: duck-typed .now() (the sim passes its VirtualClock);
+        # defaults to wall time.
+        self._now = clock.now if clock is not None else time.time
+        self.store = SpanStore(max_spans)
+        self._lock = threading.Lock()
+        self._ids = itertools.count(1)
+        self._chains: Dict[Key, TraceContext] = {}      # insertion = LRU
+        self._roots: Dict[str, Span] = {}               # root span_id -> Span
+        self._pending: Dict[Key, Tuple[float, bool]] = {}
+        self._max_chains = max_chains
+        self._tls = threading.local()
+
+    # -- context propagation ----------------------------------------------
+
+    def context_for(self, key: Key) -> TraceContext:
+        """The chain context for a reconcile key, minted on first use."""
+        with self._lock:
+            ctx = self._chains.get(key)
+            if ctx is not None:
+                return ctx
+            tid = f"t{next(self._ids):06d}"
+            sid = f"s{next(self._ids):06d}"
+            root = Span(tid, sid, "", "chain:%s/%s/%s" % key,
+                        start=self._now())
+            self._roots[sid] = root
+            ctx = TraceContext(tid, sid)
+            self._chains[key] = ctx
+            if len(self._chains) > self._max_chains:
+                old_key = next(iter(self._chains))
+                old = self._chains.pop(old_key)
+                self._roots.pop(old.span_id, None)
+                self._pending.pop(old_key, None)
+        self.store.add(root)
+        return ctx
+
+    def _next_span_id(self) -> str:
+        with self._lock:
+            return f"s{next(self._ids):06d}"
+
+    def _extend_root(self, parent_id: str, end: float) -> None:
+        with self._lock:
+            root = self._roots.get(parent_id)
+            if root is not None and (root.end is None or end > root.end):
+                root.end = end
+
+    def _finish(self, ctx: Optional[TraceContext], parent_id: str,
+                name: str, start: float, end: float,
+                attrs: Optional[Dict[str, Any]] = None,
+                status: str = "ok", error: str = "") -> Span:
+        span = Span(ctx.trace_id if ctx else "", self._next_span_id(),
+                    parent_id, name, start, end, attrs, status, error)
+        self.store.add(span)
+        if parent_id:
+            self._extend_root(parent_id, end)
+        return span
+
+    # -- manager hooks ------------------------------------------------------
+
+    def queued(self, key: Key, ts: Optional[float] = None,
+               delayed: bool = False) -> None:
+        """A key entered the work queue (or a timed requeue was
+        scheduled).  The EARLIEST pending instant wins — dedup keeps the
+        first cause, and the eventual queue-wait span covers any backoff
+        delay (that wait is real slice-ready latency)."""
+        ts = self._now() if ts is None else ts
+        self.context_for(key)
+        with self._lock:
+            self._pending.setdefault(key, (ts, delayed))
+
+    def dequeued(self, key: Key, ts: Optional[float] = None) -> None:
+        """A worker picked the key up: emit the queue-wait span."""
+        ts = self._now() if ts is None else ts
+        with self._lock:
+            ctx = self._chains.get(key)
+            pending = self._pending.pop(key, None)
+        if ctx is None or pending is None:
+            return
+        start, delayed = pending
+        self._finish(ctx, ctx.span_id, "queue-wait", start, ts,
+                     attrs={"delayed": delayed} if delayed else None)
+
+    @contextmanager
+    def reconcile(self, key: Key, **attrs) -> Iterator[_SpanHandle]:
+        """The span around one reconciler invocation; installs itself as
+        the thread-local current span so controller ``span()`` calls and
+        ``record_error`` nest under it."""
+        ctx = self.context_for(key)
+        handle = _SpanHandle(ctx, self._next_span_id(), "reconcile",
+                             self._now())
+        handle.attrs.update(attrs)
+        stack = self._stack()
+        stack.append(handle)
+        try:
+            yield handle
+        except BaseException as e:
+            handle.error(f"{type(e).__name__}: {e}")
+            raise
+        finally:
+            stack.pop()
+            self._finalize(handle, parent_id=ctx.span_id)
+
+    @contextmanager
+    def span(self, name: str, **attrs) -> Iterator[_SpanHandle]:
+        """A child span under the thread-local current span (a
+        controller's store-write inside a reconcile); standalone code
+        gets a trace-less root span."""
+        parent = self._stack_top()
+        ctx = parent.ctx if parent is not None else None
+        parent_id = parent.span_id if parent is not None else ""
+        handle = _SpanHandle(ctx, self._next_span_id(), name, self._now())
+        handle.attrs.update(attrs)
+        stack = self._stack()
+        stack.append(handle)
+        try:
+            yield handle
+        except BaseException as e:
+            handle.error(f"{type(e).__name__}: {e}")
+            raise
+        finally:
+            stack.pop()
+            self._finalize(handle, parent_id=parent_id)
+
+    def _finalize(self, handle: _SpanHandle, parent_id: str) -> None:
+        end = self._now()
+        span = Span(handle.ctx.trace_id if handle.ctx else "",
+                    handle.span_id, parent_id, handle.name, handle.start,
+                    end, handle.attrs, handle.status, handle.error_message)
+        self.store.add(span)
+        root_id = handle.ctx.span_id if handle.ctx else parent_id
+        if root_id:
+            self._extend_root(root_id, end)
+
+    # -- annotation from anywhere ------------------------------------------
+
+    def record_error(self, scope: str, message: str) -> None:
+        """Mark the current span as failed (the span-error half of the
+        ``requeue-observability`` lint contract); without an active span
+        a zero-duration error span is recorded so the failure is never
+        silently dropped."""
+        top = self._stack_top()
+        if top is not None:
+            top.error(f"{scope}: {message}")
+            return
+        now = self._now()
+        self._finish(None, "", f"error:{scope}", now, now,
+                     status="error", error=message)
+
+    def record_for_key(self, key: Key, name: str, start: float, end: float,
+                       **attrs) -> None:
+        """Record an externally-measured span (pod-start, slice-ready)
+        against a chain's trace — the seam for components that act on a
+        key's behalf without running inside its reconcile (FakeKubelet)."""
+        ctx = self.context_for(key)
+        self._finish(ctx, ctx.span_id, name, start, end, attrs=attrs)
+
+    def current(self) -> Optional[TraceContext]:
+        top = self._stack_top()
+        return top.ctx if top is not None else None
+
+    def _stack(self) -> List[_SpanHandle]:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
+    def _stack_top(self) -> Optional[_SpanHandle]:
+        stack = getattr(self._tls, "stack", None)
+        return stack[-1] if stack else None
+
+    # -- export -------------------------------------------------------------
+
+    def export(self, trace_id: Optional[str] = None) -> List[Dict[str, Any]]:
+        return self.store.export(trace_id)
+
+    def tree(self, trace_id: Optional[str] = None) -> List[Dict[str, Any]]:
+        return span_tree(self.export(trace_id))

@@ -62,8 +62,15 @@ class Operator:
         features.set_gates(self.config.featureGates)
         self.store = store or ObjectStore()
         self.metrics = ControlPlaneMetrics()
+        # Observability (kuberay_tpu.obs): always on — both are bounded
+        # ring/LRU structures, and the /debug/traces + /debug/flight
+        # surface is the production "where did the time go" story.
+        from kuberay_tpu.obs import FlightRecorder, Tracer
+        self.tracer = Tracer()
+        self.flight = FlightRecorder()
         self.recorder = EventRecorder(self.store)
-        self.manager = Manager(self.store, metrics=self.metrics)
+        self.manager = Manager(self.store, metrics=self.metrics,
+                               tracer=self.tracer, flight=self.flight)
 
         self.schedulers = SchedulerManager()
         self.schedulers.register(GangScheduler(self.store))
@@ -82,19 +89,22 @@ class Operator:
             self.store, expectations=self.manager.expectations,
             recorder=self.recorder, scheduler=scheduler,
             config_env=self.config.defaultPodEnv, metrics=self.metrics,
-            use_openshift_route=self.config.useOpenShiftRoute)
+            use_openshift_route=self.config.useOpenShiftRoute,
+            tracer=self.tracer)
         self.job_controller = TpuJobController(
             self.store, recorder=self.recorder,
             client_provider=provider,
-            scheduler=scheduler, metrics=self.metrics)
+            scheduler=scheduler, metrics=self.metrics,
+            tracer=self.tracer)
         self.service_controller = TpuServiceController(
             self.store, recorder=self.recorder,
-            client_provider=lambda cname, status: provider(status))
+            client_provider=lambda cname, status: provider(status),
+            tracer=self.tracer)
         self.cronjob_controller = TpuCronJobController(
-            self.store, recorder=self.recorder)
+            self.store, recorder=self.recorder, tracer=self.tracer)
         self.networkpolicy_controller = NetworkPolicyController(self.store)
         self.warmpool_controller = WarmSlicePoolController(
-            self.store, recorder=self.recorder)
+            self.store, recorder=self.recorder, tracer=self.tracer)
         self.autoscaler = SliceAutoscaler(self.store)
 
         m = self.manager
@@ -143,7 +153,8 @@ class Operator:
         if features.enabled("TpuClusterNetworkPolicy"):
             self._netpol_watch()
 
-        self.kubelet = FakeKubelet(self.store) if fake_kubelet else None
+        self.kubelet = (FakeKubelet(self.store, tracer=self.tracer)
+                        if fake_kubelet else None)
         self.history_collector = None
         if self.config.historyArchiveURL:
             from kuberay_tpu.history.server import HistoryCollector
@@ -189,7 +200,7 @@ class Operator:
             history = HistoryServer(self.history_collector.storage)
         self.apiserver, self.api_url = serve_background(
             self.store, api_host, api_port, metrics=self.metrics,
-            history=history)
+            history=history, tracer=self.tracer, flight=self.flight)
         if leader_election:
             self.elector = LeaderElector(
                 self.store,

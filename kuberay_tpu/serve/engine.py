@@ -149,9 +149,22 @@ class ServeEngine:
                  speculative: int = 0, kv_quant: str = "none",
                  decode_impl: str = "auto", mesh=None,
                  weight_quant: str = "none",
-                 donate_params: bool = False):
+                 donate_params: bool = False,
+                 metrics=None):
         self.cfg = cfg
         self.params = params
+        # Request-phase latency decomposition: ``metrics`` is a
+        # MetricsRegistry (utils/metrics.py); each finished request
+        # observes tpu_serve_request_duration_seconds once per phase —
+        # queue (enqueue -> admission), prefill (admission -> first
+        # token), decode (first token -> finish) — so a p99 regression
+        # points at the phase that moved, not just "the server is slow".
+        self.metrics = metrics
+        if metrics is not None:
+            metrics.describe(
+                "tpu_serve_request_duration_seconds",
+                "Per-request wall time by phase (queue | prefill | decode)")
+        self._req_phase_ts: Dict[str, Dict[str, float]] = {}
         # Tensor-parallel serving: a jax.sharding.Mesh with a "tp" axis.
         # Params/cache shard over it (serve/sharding.py) and every jitted
         # step runs SPMD; the host scheduling loop is unchanged.
@@ -415,15 +428,51 @@ class ServeEngine:
     # ------------------------------------------------------------------
 
     def add_request(self, req: Request) -> None:
+        self._phase_mark(req.request_id, "queued")
         if len(req.prompt_tokens) >= self.max_len or req.max_new_tokens <= 0:
             self._cancel(req)
             return
         self.queue.append(req)
 
     def _cancel(self, req: Request) -> None:
+        self._req_phase_ts.pop(req.request_id, None)
         self._finished.append(Response(
             req.request_id, [], "cancelled",
             prompt_len=len(req.prompt_tokens), created=time.time()))
+
+    # -- request-phase latency accounting ------------------------------
+
+    def _phase_mark(self, rid: str, phase: str) -> None:
+        if self.metrics is None:
+            return
+        self._req_phase_ts.setdefault(rid, {})[phase] = time.time()
+
+    def _phase_observe(self, rid: str, terminal: bool = True) -> None:
+        """Emit the queue/prefill/decode decomposition for one request.
+        queue+prefill land at first token (so a long-running decode
+        still shows its admission cost live); decode lands at finish."""
+        if self.metrics is None:
+            return
+        ts = self._req_phase_ts.get(rid)
+        if ts is None:
+            return
+        now = time.time()
+        if not terminal:
+            if "queued" in ts and "admitted" in ts:
+                self.metrics.observe(
+                    "tpu_serve_request_duration_seconds",
+                    ts["admitted"] - ts["queued"], {"phase": "queue"})
+            if "admitted" in ts:
+                self.metrics.observe(
+                    "tpu_serve_request_duration_seconds",
+                    now - ts["admitted"], {"phase": "prefill"})
+                ts["first_token"] = now
+            return
+        if "first_token" in ts:
+            self.metrics.observe(
+                "tpu_serve_request_duration_seconds",
+                now - ts["first_token"], {"phase": "decode"})
+        self._req_phase_ts.pop(rid, None)
 
     @property
     def num_active(self) -> int:
@@ -476,6 +525,7 @@ class ServeEngine:
         """Start a chunked admission.  Returns True when the first chunk
         ran, False when blocked (request requeued), None when the request
         was cancelled.  The paged subclass reserves KV blocks here."""
+        self._phase_mark(req.request_id, "admitted")
         self._inflight = (req, slot, 0)
         self._chunk_step()
         return True
@@ -535,6 +585,7 @@ class ServeEngine:
     # ------------------------------------------------------------------
 
     def _admit(self, req: Request, slot: int):
+        self._phase_mark(req.request_id, "admitted")
         plen = len(req.prompt_tokens)
         bucket = _bucket(plen, self.max_len)
         padded = np.zeros(bucket, dtype=np.int32)
@@ -547,6 +598,7 @@ class ServeEngine:
         return True
 
     def _finalize_admit(self, req: Request, slot: int, tok) -> None:
+        self._phase_observe(req.request_id, terminal=False)
         self.lens[slot] = len(req.prompt_tokens)
         self.active[slot] = req
         self.generated[slot] = [int(tok)]
@@ -719,6 +771,7 @@ class ServeEngine:
         slot-teardown bookkeeping lives here; the paged engine hooks it
         to release blocks."""
         req = self.active[slot]
+        self._phase_observe(req.request_id)
         self._finished.append(Response(
             req.request_id, list(self.generated[slot]), reason,
             prompt_len=len(req.prompt_tokens), created=time.time()))

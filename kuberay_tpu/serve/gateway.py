@@ -18,6 +18,7 @@ import logging
 import json
 import random
 import threading
+import time
 import urllib.request
 from http.server import ThreadingHTTPServer
 from typing import Callable, Dict, List, Optional, Tuple
@@ -32,9 +33,18 @@ _LOG = logging.getLogger("kuberay_tpu.gateway")
 class WeightedGateway:
     def __init__(self, store, route_name: str, namespace: str = "default",
                  resolver: Optional[Callable[[str], str]] = None,
-                 poll_interval: float = 1.0):
+                 poll_interval: float = 1.0, metrics=None):
         """``resolver(service_name) -> base_url``; defaults to cluster-DNS
-        (http://<svc>.<ns>.svc:<serve-port>)."""
+        (http://<svc>.<ns>.svc:<serve-port>).  ``metrics`` is an optional
+        MetricsRegistry: forwarded requests observe
+        ``tpu_serve_request_duration_seconds{phase="gateway"}`` (the
+        end-to-end leg in front of the engine's queue/prefill/decode
+        phases) and count ``tpu_gateway_requests_total`` per status code."""
+        self.metrics = metrics
+        if metrics is not None:
+            metrics.describe("tpu_gateway_requests_total",
+                             "Requests forwarded by the weighted gateway, "
+                             "by HTTP status code")
         self.store = store
         self.route_name = route_name
         self.namespace = namespace
@@ -97,6 +107,17 @@ class WeightedGateway:
 
     def forward(self, path: str, body: bytes,
                 timeout: float = 300.0) -> Tuple[int, bytes]:
+        t0 = time.time()
+        code, payload = self._forward(path, body, timeout)
+        if self.metrics is not None:
+            self.metrics.observe("tpu_serve_request_duration_seconds",
+                                 time.time() - t0, {"phase": "gateway"})
+            self.metrics.inc("tpu_gateway_requests_total",
+                             {"code": str(code)})
+        return code, payload
+
+    def _forward(self, path: str, body: bytes,
+                 timeout: float) -> Tuple[int, bytes]:
         url = self.pick_backend()
         if url is None:
             return 503, json.dumps(
@@ -127,6 +148,9 @@ class WeightedGateway:
                     return self._send(200, {"status": "ok"})
                 if self.path == "/stats":
                     return self._send(200, gw.stats())
+                if self.path == "/metrics" and gw.metrics is not None:
+                    return self._send_text(200, gw.metrics.render(),
+                                           "text/plain; version=0.0.4")
                 return self._send(404, {"message": "unknown path"})
 
             def do_POST(self):

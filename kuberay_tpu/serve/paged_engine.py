@@ -49,7 +49,8 @@ class PagedServeEngine(ServeEngine):
                  prefill_chunk: int = 0, speculative: int = 0,
                  kv_quant: str = "none", mesh=None,
                  weight_quant: str = "none",
-                 donate_params: bool = False):
+                 donate_params: bool = False,
+                 metrics=None):
         # Default pool = the dense engine's footprint; callers shrink it
         # to realize the memory win (e.g. slots * expected_len).
         num_blocks = num_blocks or (max_slots * max_len) // block_size
@@ -84,7 +85,7 @@ class PagedServeEngine(ServeEngine):
                          rng_seed=rng_seed, prefill_chunk=prefill_chunk,
                          speculative=speculative, kv_quant=kv_quant,
                          mesh=mesh, weight_quant=weight_quant,
-                         donate_params=donate_params)
+                         donate_params=donate_params, metrics=metrics)
         if weight_quant == "int8":
             # Paged kernels route through _paged_fwd (USES_BASE_FORWARD
             # False skipped the base wrap): dequantize outermost here.

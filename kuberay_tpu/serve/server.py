@@ -311,7 +311,14 @@ class ServeFrontend:
                         kind = "counter" if k in counters else "gauge"
                         lines.append(f"# TYPE {name} {kind}")
                         lines.append(f"{name} {v}")
-                    return self._send_text(200, "\n".join(lines) + "\n",
+                    text = "\n".join(lines) + "\n"
+                    # Engines built with a MetricsRegistry also expose
+                    # the request-phase histograms
+                    # (tpu_serve_request_duration_seconds{phase=...}).
+                    reg = getattr(frontend.engine, "metrics", None)
+                    if reg is not None and hasattr(reg, "render"):
+                        text += reg.render()
+                    return self._send_text(200, text,
                                            "text/plain; version=0.0.4")
                 return self._send(404, {"message": "unknown path"})
 
@@ -696,6 +703,11 @@ def main(argv=None):  # pragma: no cover - process wrapper
                          decode_impl=args.decode_impl, mesh=mesh,
                          weight_quant=args.weight_quant,
                          donate_params=args.weight_quant != "none")
+    # Request-phase histograms (queue | prefill | decode) for the
+    # /metrics surface; host 0 only — followers have no frontend.
+    if jax.process_count() == 1 or jax.process_index() == 0:
+        from kuberay_tpu.utils.metrics import MetricsRegistry
+        engine_kw["metrics"] = MetricsRegistry()
     # ONE class-pair selection for both roles: hosts and followers must
     # construct matching engines or plan pytree shapes diverge (a
     # cross-host hang, not an error).

@@ -62,6 +62,17 @@ def main(argv=None) -> int:
     parser.add_argument("--journal-tail", type=int, default=20,
                         help="journal events to dump on violation "
                              "(0 disables)")
+    parser.add_argument("--trace", action="store_true",
+                        help="record causal spans (kuberay_tpu.obs): "
+                             "queue-wait/reconcile/store-write/pod-start/"
+                             "slice-ready per reconcile chain; the replay "
+                             "hash is unaffected")
+    parser.add_argument("--trace-out", default="",
+                        help="write the trace export (spans + journal + "
+                             "flight timelines) to this JSON file; "
+                             "implies --trace.  With a seed range, the "
+                             "last run wins — use a single seed for "
+                             "forensics")
     parser.add_argument("--json", action="store_true",
                         help="one JSON result object per run on stdout")
     parser.add_argument("--list-scenarios", action="store_true")
@@ -93,14 +104,21 @@ def main(argv=None) -> int:
               f"{', '.join(sorted(SCENARIOS))}", file=sys.stderr)
         return 2
 
+    trace = args.trace or bool(args.trace_out)
     failed = False
     for name in names:
         scenario = get_scenario(name)
         steps = args.steps or scenario.default_steps
         for seed in seeds:
-            with SimHarness(seed, scenario=scenario) as h:
+            with SimHarness(seed, scenario=scenario, trace=trace) as h:
                 result = h.run(steps)
                 journal = list(h.journal)
+                trace_doc = h.export_trace() if trace else None
+            if args.trace_out and trace_doc is not None:
+                with open(args.trace_out, "w") as f:
+                    json.dump(trace_doc, f, sort_keys=True)
+                print(f"trace: {len(trace_doc['spans'])} spans -> "
+                      f"{args.trace_out}")
             if args.json:
                 print(json.dumps({
                     "scenario": result.scenario, "seed": result.seed,
@@ -121,6 +139,11 @@ def main(argv=None) -> int:
                 failed = True
                 _report_violation(result, args.journal_tail, journal,
                                   sys.stderr)
+                if trace_doc is not None:
+                    where = (f"written to {args.trace_out}" if args.trace_out
+                             else "rerun with --trace-out PATH to save")
+                    print(f"  trace: {len(trace_doc['spans'])} causal "
+                          f"spans recorded ({where})", file=sys.stderr)
     return 1 if failed else 0
 
 

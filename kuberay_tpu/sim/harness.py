@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import dataclasses
 import hashlib
+import itertools
 import json
 import random
 from typing import Any, Dict, List, Optional
@@ -44,6 +45,7 @@ from kuberay_tpu.controlplane.warmpool_controller import (
     LABEL_WARM_POOL,
     WarmSlicePoolController,
 )
+from kuberay_tpu.obs import FlightRecorder, NOOP_TRACER, Tracer
 from kuberay_tpu.runtime.coordinator_client import FakeCoordinatorClient
 from kuberay_tpu.sim.clock import VirtualClock, patch_time
 from kuberay_tpu.sim.faults import (
@@ -63,8 +65,12 @@ from kuberay_tpu.utils.metrics import ControlPlaneMetrics
 SIM_KINDS = (C.KIND_CLUSTER, C.KIND_JOB, C.KIND_SERVICE, C.KIND_CRONJOB,
              KIND_WARM_POOL)
 
-#: Journal-excluded kinds: Event names embed uuid4 (telemetry, not
-#: state), so including them would break cross-process hash stability.
+#: Journal-excluded kinds: Events are telemetry, not state (and
+#: excluding them keeps quiescence detection honest — a reconciler
+#: re-emitting warnings forever must not look like progress).  Their
+#: names/timestamps ARE deterministic under sim now (the harness threads
+#: the virtual clock + a counter name-factory into EventRecorder), but
+#: they stay excluded to preserve the PR-2 hash contract.
 _JOURNAL_SKIP_KINDS = ("Event",)
 
 
@@ -104,7 +110,8 @@ class SimHarness:
     def __init__(self, seed: int, scenario=None,
                  fault_profile: Optional[Dict[str, float]] = None,
                  settle_horizon: float = 45.0,
-                 max_settle_rounds: int = 400):
+                 max_settle_rounds: int = 400,
+                 trace: bool = False):
         self.seed = seed
         self.scenario = scenario
         self.settle_horizon = settle_horizon
@@ -131,9 +138,22 @@ class SimHarness:
         self.metrics.registry.describe(
             "sim_faults_injected_total",
             "Faults injected by the simulation fault plan, per fault type")
-        self.recorder = EventRecorder(self.store)
+        # Tracing is observational only (touches neither store nor rng),
+        # so the journal hash is byte-identical with it on or off — the
+        # replay-invariance contract tests/test_obs_trace.py enforces.
+        self.tracer = Tracer(clock=self.clock) if trace else NOOP_TRACER
+        self.flight = FlightRecorder(clock=self.clock) if trace else None
+        # Deterministic event emission (obs satellite): virtual-clock
+        # eventTime + counter names replace wall time and uuid4, so a
+        # seed replays with identical Event objects across processes.
+        self._event_seq = itertools.count(1)
+        self.recorder = EventRecorder(
+            self.store, clock=self.clock,
+            name_factory=lambda base:
+                f"{base}.evt{next(self._event_seq):06d}")
         self.manager = Manager(self.store, clock=self.clock,
-                               metrics=self.metrics)
+                               metrics=self.metrics, tracer=self.tracer,
+                               flight=self.flight)
 
         self.clients: Dict[str, FakeCoordinatorClient] = {}
 
@@ -150,18 +170,20 @@ class SimHarness:
 
         self.cluster_controller = TpuClusterController(
             self.store, expectations=self.manager.expectations,
-            recorder=self.recorder, metrics=self.metrics)
+            recorder=self.recorder, metrics=self.metrics,
+            tracer=self.tracer)
         self.job_controller = TpuJobController(
             self.store, recorder=self.recorder,
             client_provider=lambda status: provider(status),
-            metrics=self.metrics)
+            metrics=self.metrics, tracer=self.tracer)
         self.service_controller = TpuServiceController(
             self.store, recorder=self.recorder,
-            client_provider=lambda cname, status: provider(cname, status))
+            client_provider=lambda cname, status: provider(cname, status),
+            tracer=self.tracer)
         self.cronjob_controller = TpuCronJobController(
-            self.store, recorder=self.recorder)
+            self.store, recorder=self.recorder, tracer=self.tracer)
         self.warmpool_controller = WarmSlicePoolController(
-            self.store, recorder=self.recorder)
+            self.store, recorder=self.recorder, tracer=self.tracer)
 
         m = self.manager
         m.register(C.KIND_CLUSTER, self.cluster_controller.reconcile)
@@ -175,7 +197,8 @@ class SimHarness:
         m.map_owned(originated_from_mapper(C.KIND_CRONJOB))
         m.map_owned(_warm_pod_mapper)
 
-        self.kubelet = FakeKubelet(self.store, now_fn=self.clock.now)
+        self.kubelet = FakeKubelet(self.store, now_fn=self.clock.now,
+                                   tracer=self.tracer)
         self.store.set_interposer(self.plan)
 
         self.journal: List[Dict[str, Any]] = []
@@ -230,6 +253,22 @@ class SimHarness:
             h.update(json.dumps(rec, sort_keys=True).encode())
             h.update(b"\n")
         return h.hexdigest()
+
+    def export_trace(self) -> Dict[str, Any]:
+        """The run's causal timeline as one artifact: every recorded
+        span (parent-linked; empty when tracing is off) plus the state
+        journal as span-events — what a failure report ships so a
+        violation replays WITH its decomposition (docs/observability.md).
+        """
+        return {
+            "scenario": self.scenario.name if self.scenario else "adhoc",
+            "seed": self.seed,
+            "clock": self.clock.now(),
+            "journal_hash": self.journal_hash(),
+            "spans": self.tracer.export(),
+            "events": list(self.journal),
+            "flight": self.flight.to_dict() if self.flight else {},
+        }
 
     # -- convergence -------------------------------------------------------
 

@@ -81,8 +81,23 @@ class MetricsRegistry:
     # -- exposition --------------------------------------------------------
 
     @staticmethod
+    def _escape_label_value(value) -> str:
+        """Prometheus text-format label-value escaping: backslash,
+        double-quote and newline (in that order — escaping the escape
+        character first, or a value containing ``\\"`` corrupts the
+        exposition and the whole scrape fails to parse)."""
+        return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+                .replace("\n", "\\n"))
+
+    @staticmethod
+    def _escape_help(text: str) -> str:
+        """HELP lines escape backslash and newline (quotes are legal)."""
+        return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+    @staticmethod
     def _fmt_labels(label_items: Tuple, extra: str = "") -> str:
-        parts = [f'{k}="{v}"' for k, v in label_items]
+        parts = [f'{k}="{MetricsRegistry._escape_label_value(v)}"'
+                 for k, v in label_items]
         if extra:
             parts.append(extra)
         return "{" + ",".join(parts) + "}" if parts else ""
@@ -96,7 +111,9 @@ class MetricsRegistry:
                 if name not in seen:
                     seen.add(name)
                     if name in self._help:
-                        lines.append(f"# HELP {name} {self._help[name]}")
+                        lines.append(
+                            f"# HELP {name} "
+                            f"{self._escape_help(self._help[name])}")
                     lines.append(f"# TYPE {name} {mtype}")
 
             for (name, labels), v in sorted(self._counters.items()):

@@ -1,0 +1,102 @@
+"""Prometheus text exposition gate for utils/metrics.py.
+
+Golden-output coverage of ``MetricsRegistry.render()`` — counter /
+gauge / histogram ordering, HELP/TYPE headers, cumulative ``le``
+buckets — plus the label-value escaping the text-format spec requires
+(a value containing ``"``, ``\\`` or a newline previously corrupted the
+whole scrape) and ``drop_labeled`` removing all three series types.
+"""
+
+from kuberay_tpu.utils.metrics import ControlPlaneMetrics, MetricsRegistry
+
+
+def test_render_golden_output():
+    r = MetricsRegistry()
+    r.describe("tpu_test_requests_total", "Requests served")
+    r.describe("tpu_test_queue_depth", "Current queue depth")
+    r.describe("tpu_test_latency_seconds", "Request latency")
+    r.inc("tpu_test_requests_total", {"code": "200"}, value=3)
+    r.inc("tpu_test_requests_total", {"code": "500"})
+    r.set_gauge("tpu_test_queue_depth", 7, {"shard": "a"})
+    # Two observations into the first bucket, one into the second:
+    # cumulative le counts must be 2, 3, 3, ... and +Inf == count.
+    r.observe("tpu_test_latency_seconds", 0.2)
+    r.observe("tpu_test_latency_seconds", 0.3)
+    r.observe("tpu_test_latency_seconds", 0.7)
+    text = r.render()
+    lines = text.splitlines()
+
+    assert lines[0] == "# HELP tpu_test_requests_total Requests served"
+    assert lines[1] == "# TYPE tpu_test_requests_total counter"
+    assert lines[2] == 'tpu_test_requests_total{code="200"} 3.0'
+    assert lines[3] == 'tpu_test_requests_total{code="500"} 1.0'
+    assert lines[4] == "# HELP tpu_test_queue_depth Current queue depth"
+    assert lines[5] == "# TYPE tpu_test_queue_depth gauge"
+    assert lines[6] == 'tpu_test_queue_depth{shard="a"} 7'
+    assert lines[7] == "# HELP tpu_test_latency_seconds Request latency"
+    assert lines[8] == "# TYPE tpu_test_latency_seconds histogram"
+    assert lines[9] == 'tpu_test_latency_seconds_bucket{le="0.5"} 2'
+    assert lines[10] == 'tpu_test_latency_seconds_bucket{le="1"} 3'
+    # Every later bucket stays cumulative, +Inf equals the count.
+    assert 'tpu_test_latency_seconds_bucket{le="+Inf"} 3' in lines
+    assert "tpu_test_latency_seconds_sum 1.2" in text
+    assert "tpu_test_latency_seconds_count 3" in text
+    # Histograms render after counters and gauges; each family gets its
+    # TYPE header exactly once.
+    assert text.count("# TYPE tpu_test_latency_seconds histogram") == 1
+    assert text.endswith("\n")
+
+
+def test_label_value_escaping_per_text_format_spec():
+    r = MetricsRegistry()
+    r.inc("tpu_test_total", {"path": 'a\\b"c\nd'})
+    text = r.render()
+    # Escape order matters: backslash first, then quote, then newline.
+    assert 'tpu_test_total{path="a\\\\b\\"c\\nd"} 1.0' in text
+    # The exposition stays one-sample-per-line (no raw newline leaked).
+    for line in text.splitlines():
+        assert line.startswith(("#", "tpu_test_total"))
+
+
+def test_label_escaping_applies_to_histogram_series_too():
+    r = MetricsRegistry()
+    r.observe("tpu_test_seconds", 0.1, {"q": 'say "hi"'})
+    text = r.render()
+    assert 'q="say \\"hi\\""' in text
+    # The synthetic le label composes with escaped user labels.
+    assert 'tpu_test_seconds_bucket{q="say \\"hi\\"",le="0.5"} 1' in text
+
+
+def test_help_text_escaping():
+    r = MetricsRegistry()
+    r.describe("tpu_test_total", "line one\nline two \\ backslash")
+    r.inc("tpu_test_total")
+    text = r.render()
+    assert "# HELP tpu_test_total line one\\nline two \\\\ backslash" in text
+
+
+def test_drop_labeled_removes_counters_gauges_and_histograms():
+    r = MetricsRegistry()
+    for cluster in ("keep", "gone"):
+        labels = {"cluster": cluster}
+        r.inc("tpu_test_total", labels)
+        r.set_gauge("tpu_test_state", 1.0, labels)
+        r.observe("tpu_test_seconds", 1.0, labels)
+    r.drop_labeled("cluster", "gone")
+    text = r.render()
+    assert 'cluster="gone"' not in text
+    assert 'tpu_test_total{cluster="keep"}' in text
+    assert 'tpu_test_state{cluster="keep"}' in text
+    assert 'tpu_test_seconds_count{cluster="keep"}' in text
+
+
+def test_controlplane_metrics_catalog_renders():
+    m = ControlPlaneMetrics()
+    m.observe_slice_ready("demo", "workers", 12.5)
+    m.reconcile_error("TpuCluster")
+    text = m.render()
+    assert ("# HELP tpu_slice_ready_duration_seconds Seconds from slice "
+            "creation to all hosts running (north-star metric)") in text
+    assert ('tpu_slice_ready_duration_seconds_bucket{cluster="demo",'
+            'group="workers",le="30"} 1') in text
+    assert 'tpu_reconcile_errors_total{kind="TpuCluster"} 1.0' in text

@@ -1,0 +1,450 @@
+"""Observability gate (kuberay_tpu.obs): tracer, flight recorder,
+manager wiring, /debug endpoints, serve phase histograms, and the
+sim-level acceptance contract — slice-ready durations decompose into
+queue-wait + reconcile + pod-start child spans that account for the
+virtual-clock total, and the replay hash is byte-identical with tracing
+on and off.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from kuberay_tpu.controlplane.events import EventRecorder
+from kuberay_tpu.controlplane.fake_kubelet import FakeKubelet
+from kuberay_tpu.controlplane.manager import Manager
+from kuberay_tpu.controlplane.store import Conflict, ObjectStore
+from kuberay_tpu.obs import FlightRecorder, NOOP_TRACER, Tracer, span_tree
+from kuberay_tpu.sim.clock import VirtualClock
+from kuberay_tpu.sim.faults import FaultPlan
+from kuberay_tpu.sim.harness import SimHarness
+from kuberay_tpu.sim.scenarios import get_scenario, make_cluster_obj
+from kuberay_tpu.utils import constants as C
+
+
+# ---------------------------------------------------------------------------
+# tracer core
+# ---------------------------------------------------------------------------
+
+def test_span_parenting_and_export():
+    clock = VirtualClock(start=100.0)
+    tracer = Tracer(clock=clock)
+    key = ("TpuCluster", "default", "demo")
+    tracer.queued(key, 100.0)
+    clock.advance(2.0)
+    tracer.dequeued(key, 102.0)
+    with tracer.reconcile(key, kind="TpuCluster") as span:
+        with tracer.span("store-write", obj="demo"):
+            clock.advance(1.0)
+        span.set(requeue_after=5.0)
+    spans = tracer.export()
+    by_name = {s["name"]: s for s in spans}
+    root = by_name["chain:TpuCluster/default/demo"]
+    qw = by_name["queue-wait"]
+    rec = by_name["reconcile"]
+    sw = by_name["store-write"]
+    # One trace; queue-wait and reconcile hang off the chain root; the
+    # store-write nests under the reconcile that issued it.
+    assert {s["trace_id"] for s in spans} == {root["trace_id"]}
+    assert qw["parent_id"] == root["span_id"]
+    assert rec["parent_id"] == root["span_id"]
+    assert sw["parent_id"] == rec["span_id"]
+    assert qw["start"] == 100.0 and qw["end"] == 102.0
+    assert rec["attrs"]["requeue_after"] == 5.0
+    # The open root's end extended to the last finished child.
+    assert root["end"] == pytest.approx(103.0)
+    trees = span_tree(spans)
+    assert len(trees) == 1
+    assert {c["name"] for c in trees[0]["children"]} == {
+        "queue-wait", "reconcile"}
+
+
+def test_tracer_bounded_span_store():
+    tracer = Tracer(clock=VirtualClock(), max_spans=10)
+    key = ("Kind", "ns", "x")
+    for _ in range(50):
+        with tracer.reconcile(key):
+            pass
+    assert len(tracer.store) == 10
+    assert tracer.store.dropped == 41     # 50 reconciles + 1 root - 10 kept
+
+
+def test_record_error_marks_current_span():
+    tracer = Tracer(clock=VirtualClock())
+    with tracer.reconcile(("K", "ns", "n")):
+        tracer.record_error("coordinator", "connection refused")
+    rec = [s for s in tracer.export() if s["name"] == "reconcile"][0]
+    assert rec["status"] == "error"
+    assert "coordinator: connection refused" in rec["error"]
+    # Outside any span the error still lands (zero-duration span).
+    tracer.record_error("orphan", "boom")
+    orphan = [s for s in tracer.export() if s["name"] == "error:orphan"][0]
+    assert orphan["status"] == "error"
+
+
+def test_noop_tracer_is_free_and_silent():
+    t = NOOP_TRACER
+    t.queued(("K", "ns", "n"))
+    t.dequeued(("K", "ns", "n"))
+    with t.reconcile(("K", "ns", "n")) as span:
+        span.set(x=1)
+        span.error("nope")
+    t.record_error("s", "m")
+    t.record_for_key(("K", "ns", "n"), "pod-start", 0.0, 1.0)
+    assert t.export() == []
+    assert t.current() is None
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+def test_flight_recorder_ring_and_eviction():
+    clock = VirtualClock(start=0.0)
+    fr = FlightRecorder(capacity=3, max_objects=2, clock=clock)
+    for i in range(5):
+        fr.record("Pod", "ns", "a", "watch", f"MODIFIED-{i}")
+    assert [r["detail"] for r in fr.timeline("Pod", "ns", "a")] == [
+        "MODIFIED-2", "MODIFIED-3", "MODIFIED-4"]   # ring keeps the tail
+    fr.record("Pod", "ns", "b", "watch", "ADDED")
+    fr.record("Pod", "ns", "c", "watch", "ADDED")   # evicts LRU key 'a'
+    assert fr.timeline("Pod", "ns", "a") == []
+    assert len(fr.keys()) == 2
+
+
+def test_flight_recorder_state_transitions_and_events():
+    from kuberay_tpu.controlplane.store import Event
+    fr = FlightRecorder(clock=VirtualClock())
+    obj = {"kind": "TpuCluster",
+           "metadata": {"name": "demo", "namespace": "ns",
+                        "resourceVersion": 4},
+           "status": {"state": "ready"}}
+    fr.observe_event(Event(Event.MODIFIED, "TpuCluster", obj))
+    fr.observe_event(Event(Event.MODIFIED, "TpuCluster", obj))  # no re-record
+    tl = fr.timeline("TpuCluster", "ns", "demo")
+    assert [r["type"] for r in tl] == ["watch", "state", "watch"]
+    assert tl[1]["detail"] == "<none> -> ready"
+    # K8s Events land on the involved object's timeline.
+    ev_obj = {"kind": "Event", "metadata": {"name": "demo.evt1",
+                                            "namespace": "ns"},
+              "type": "Warning", "reason": "Unhealthy", "message": "bad",
+              "involvedObject": {"kind": "TpuCluster", "name": "demo",
+                                 "namespace": "ns"}}
+    fr.observe_event(Event(Event.ADDED, "Event", ev_obj))
+    tl = fr.timeline("TpuCluster", "ns", "demo")
+    assert tl[-1]["type"] == "event"
+    assert "Warning/Unhealthy" in tl[-1]["detail"]
+
+
+# ---------------------------------------------------------------------------
+# manager wiring: queue-wait + reconcile spans, conflict/requeue records
+# ---------------------------------------------------------------------------
+
+def test_manager_emits_queue_wait_and_reconcile_spans():
+    clock = VirtualClock(start=1000.0)
+    store = ObjectStore()
+    tracer = Tracer(clock=clock)
+    flight = FlightRecorder(clock=clock)
+    manager = Manager(store, clock=clock, tracer=tracer, flight=flight)
+    calls = {"n": 0}
+
+    def flaky(name, ns):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise Conflict("lost the rv race")
+        return None
+
+    manager.register("Thing", flaky)
+    manager.enqueue(("Thing", "default", "x"))
+    manager.run_until_idle()                    # conflict -> requeue 0.05
+    clock.advance(0.06)
+    manager.run_until_idle()                    # clean pass
+    spans = tracer.export()
+    recs = [s for s in spans if s["name"] == "reconcile"]
+    assert len(recs) == 2
+    assert recs[0]["status"] == "error" and "conflict" in recs[0]["error"]
+    assert recs[0]["attrs"]["requeue_after"] == 0.05
+    assert recs[1]["status"] == "ok"
+    # The retry's queue-wait span covers the backoff interval.
+    waits = [s for s in spans if s["name"] == "queue-wait"]
+    assert len(waits) == 2
+    assert waits[1]["duration"] == pytest.approx(0.06)
+    assert waits[1]["attrs"].get("delayed") is True
+    # Flight recorder saw the conflict and the requeue.
+    types = [r["type"] for r in flight.timeline("Thing", "default", "x")]
+    assert "conflict" in types and "requeue" in types
+
+
+def test_manager_watch_events_reach_flight_recorder():
+    store = ObjectStore()
+    flight = FlightRecorder()
+    manager = Manager(store, flight=flight)
+    manager.register("TpuCluster", lambda name, ns: None)
+    store.create({"kind": "TpuCluster", "metadata": {"name": "demo"}})
+    manager.run_until_idle()
+    types = [r["type"] for r in
+             flight.timeline("TpuCluster", "default", "demo")]
+    assert "watch" in types
+
+
+# ---------------------------------------------------------------------------
+# kubelet pod-start spans
+# ---------------------------------------------------------------------------
+
+def test_kubelet_records_pod_start_against_owner_chain():
+    clock = VirtualClock(start=0.0)
+    store = ObjectStore()
+    tracer = Tracer(clock=clock)
+    kubelet = FakeKubelet(store, now_fn=clock.now, tracer=tracer)
+    store.create({"kind": "Pod", "metadata": {
+        "name": "w0", "creationTimestamp": 1.0,
+        "labels": {C.LABEL_CLUSTER: "demo",
+                   C.LABEL_SLICE_NAME: "demo-workers-0"}},
+        "spec": {"containers": [{"name": "w"}]}})
+    clock.advance(1.0)
+    kubelet.hold_pod("w0", until=30.0)
+    kubelet.step()
+    clock.advance(30.0)
+    kubelet.step()
+    starts = [s for s in tracer.export() if s["name"] == "pod-start"]
+    assert len(starts) == 1
+    assert starts[0]["attrs"]["pod"] == "w0"
+    assert starts[0]["duration"] == pytest.approx(30.0)
+    # Parented on the owning cluster's chain.
+    chains = [s for s in tracer.export()
+              if s["name"] == "chain:TpuCluster/default/demo"]
+    assert chains and starts[0]["parent_id"] == chains[0]["span_id"]
+    kubelet.close()
+
+
+# ---------------------------------------------------------------------------
+# deterministic event emission (sim satellite)
+# ---------------------------------------------------------------------------
+
+def test_sim_event_recording_is_deterministic():
+    names = []
+    for _ in range(2):
+        with SimHarness(3, scenario=get_scenario("scale-up-storm")) as h:
+            h.run(2)
+            names.append(sorted(
+                (e["metadata"]["name"], e["eventTime"])
+                for e in h.store.list("Event")))
+    assert names[0], "scenario produced no events — determinism untested"
+    assert names[0] == names[1]
+    # Counter-named, not uuid-suffixed, under the harness.
+    assert all(".evt" in n for n, _ in names[0])
+
+
+def test_event_recorder_custom_clock_and_names():
+    store = ObjectStore()
+    clock = VirtualClock(start=777.0)
+    rec = EventRecorder(store, clock=clock,
+                        name_factory=lambda base: f"{base}.E1")
+    rec.normal({"kind": "TpuCluster", "metadata": {"name": "demo"}},
+               "Created", "hello")
+    ev = store.list("Event")[0]
+    assert ev["metadata"]["name"] == "demo.E1"
+    assert ev["eventTime"] == 777.0
+
+
+# ---------------------------------------------------------------------------
+# /debug endpoints on the API server
+# ---------------------------------------------------------------------------
+
+def test_debug_endpoints_serve_traces_and_flight():
+    from kuberay_tpu.apiserver.server import serve_background
+    store = ObjectStore()
+    tracer = Tracer()
+    flight = FlightRecorder()
+    with tracer.reconcile(("TpuCluster", "default", "demo")):
+        pass
+    flight.record("TpuCluster", "default", "demo", "requeue", "after=5.0")
+    srv, url = serve_background(store, tracer=tracer, flight=flight)
+    try:
+        with urllib.request.urlopen(f"{url}/debug/traces") as resp:
+            doc = json.load(resp)
+        assert any(s["name"] == "reconcile" for s in doc["spans"])
+        with urllib.request.urlopen(f"{url}/debug/traces?tree=1") as resp:
+            tree = json.load(resp)
+        assert tree["traces"][0]["children"]
+        with urllib.request.urlopen(
+                f"{url}/debug/flight/TpuCluster/default/demo") as resp:
+            fdoc = json.load(resp)
+        assert fdoc["records"][0]["type"] == "requeue"
+        with urllib.request.urlopen(f"{url}/debug/flight") as resp:
+            listing = json.load(resp)
+        assert {"kind": "TpuCluster", "namespace": "default",
+                "name": "demo"} in listing["objects"]
+    finally:
+        srv.shutdown()
+
+
+def test_debug_endpoints_404_when_disabled():
+    from kuberay_tpu.apiserver.server import serve_background
+    srv, url = serve_background(ObjectStore())
+    try:
+        for path in ("/debug/traces", "/debug/flight/TpuCluster/d/x"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(f"{url}{path}")
+            assert ei.value.code == 404
+    finally:
+        srv.shutdown()
+
+
+def test_operator_exposes_debug_surface():
+    from kuberay_tpu.operator import Operator
+    op = Operator(fake_kubelet=True)
+    url = op.start(api_port=0)
+    try:
+        op.store.create(make_cluster_obj("demo", topology="2x2x2",
+                                         replicas=1))
+        for _ in range(4):
+            op.run_until_idle()
+        with urllib.request.urlopen(f"{url}/debug/traces") as resp:
+            doc = json.load(resp)
+        names = {s["name"] for s in doc["spans"]}
+        assert "reconcile" in names and "queue-wait" in names
+        assert any(n.startswith("chain:TpuCluster") for n in names)
+        with urllib.request.urlopen(
+                f"{url}/debug/flight/TpuCluster/default/demo") as resp:
+            fdoc = json.load(resp)
+        assert fdoc["records"]
+        # The north-star histogram now actually observes.
+        with urllib.request.urlopen(f"{url}/metrics") as resp:
+            text = resp.read().decode()
+        assert "tpu_slice_ready_duration_seconds_count" in text
+    finally:
+        op.stop()
+
+
+# ---------------------------------------------------------------------------
+# serve engine phase histograms
+# ---------------------------------------------------------------------------
+
+@pytest.mark.timeout(300)
+def test_serve_engine_request_phase_histograms():
+    from kuberay_tpu.models import llama
+    from kuberay_tpu.serve.engine import Request, ServeEngine
+    from kuberay_tpu.utils.metrics import MetricsRegistry
+    import jax
+    cfg = llama.CONFIGS["llama_tiny"]
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    reg = MetricsRegistry()
+    engine = ServeEngine(cfg, params, max_slots=2, max_len=64, metrics=reg)
+    engine.add_request(Request("r1", [1, 2, 3], max_new_tokens=4))
+    engine.run()
+    text = reg.render()
+    for phase in ("queue", "prefill", "decode"):
+        assert (f'tpu_serve_request_duration_seconds_count'
+                f'{{phase="{phase}"}} 1') in text
+    assert engine._req_phase_ts == {}           # accounting fully drained
+
+
+def test_gateway_observes_forward_phase():
+    from kuberay_tpu.serve.gateway import WeightedGateway
+    from kuberay_tpu.utils.metrics import MetricsRegistry
+    store = ObjectStore()
+    reg = MetricsRegistry()
+    gw = WeightedGateway(store, "route", metrics=reg, poll_interval=30.0)
+    try:
+        code, _ = gw.forward("/v1/completions", b"{}")
+        assert code == 503                       # no backends in route
+        text = reg.render()
+        assert ('tpu_serve_request_duration_seconds_count'
+                '{phase="gateway"} 1') in text
+        assert 'tpu_gateway_requests_total{code="503"} 1.0' in text
+    finally:
+        gw.close()
+
+
+# ---------------------------------------------------------------------------
+# acceptance: slice-ready decomposition + replay-hash invariance
+# ---------------------------------------------------------------------------
+
+def _union_length(intervals):
+    total, cur = 0.0, None
+    for a, b in sorted(i for i in intervals if i[1] > i[0]):
+        if cur is None or a > cur[1]:
+            if cur is not None:
+                total += cur[1] - cur[0]
+            cur = [a, b]
+        else:
+            cur[1] = max(cur[1], b)
+    if cur is not None:
+        total += cur[1] - cur[0]
+    return total
+
+
+def _assert_decomposes(spans, require_positive=False):
+    slice_spans = [s for s in spans if s["name"] == "slice-ready"]
+    assert slice_spans, "no slice-ready spans recorded"
+    by_trace = {}
+    for s in spans:
+        by_trace.setdefault(s["trace_id"], []).append(s)
+    for s in slice_spans:
+        trace = by_trace[s["trace_id"]]
+        names = {t["name"] for t in trace}
+        assert {"queue-wait", "reconcile", "pod-start"} <= names, names
+        total = s["end"] - s["start"]
+        if require_positive:
+            assert total > 0
+        window = [(max(t["start"], s["start"]), min(t["end"], s["end"]))
+                  for t in trace
+                  if t["name"] in ("queue-wait", "reconcile", "pod-start")
+                  and t["end"] is not None]
+        covered = _union_length(window)
+        # The children fully account for the slice-ready duration in
+        # virtual time: no more than the total (they live inside the
+        # window) and no unexplained gaps.
+        assert covered <= total + 1e-6
+        assert covered == pytest.approx(total, abs=1e-3)
+
+
+@pytest.mark.timeout(120)
+def test_slice_ready_decomposition_with_slow_start():
+    """Deterministic decomposition: a held pod makes slice-ready take
+    real virtual time, and the span tree accounts for every second."""
+    quiet = {f: 0.0 for f in FaultPlan(0).profile}
+    with SimHarness(0, fault_profile=quiet, trace=True) as h:
+        h.store.create(make_cluster_obj("demo", topology="2x2x2",
+                                        replicas=1))
+        # Pods exist but have not run yet: hold one host 40 virtual
+        # seconds so the slice's readiness is gated on it.
+        h.manager.run_until_idle()
+        pods = [p for p in h.store.list("Pod")
+                if p["metadata"]["labels"].get(C.LABEL_GROUP) == "workers"]
+        assert pods
+        victim = sorted(p["metadata"]["name"] for p in pods)[0]
+        h.kubelet.hold_pod(victim, until=h.clock.now() + 40.0)
+        h.settle(horizon=120.0)
+        spans = h.tracer.export()
+        _assert_decomposes(spans, require_positive=True)
+        slice_span = [s for s in spans if s["name"] == "slice-ready"][0]
+        assert slice_span["end"] - slice_span["start"] >= 40.0
+        metrics_text = h.metrics.render()
+    assert "tpu_slice_ready_duration_seconds_count" in metrics_text
+
+
+@pytest.mark.timeout(300)
+def test_sim_trace_decomposition_and_replay_hash_invariance():
+    """The ISSUE acceptance run: rolling-upgrade seed 0 with tracing
+    produces a decomposing span tree, and the (scenario, seed) journal
+    hash is byte-identical with tracing on and off."""
+    with SimHarness(0, scenario=get_scenario("rolling-upgrade"),
+                    trace=True) as h:
+        traced = h.run(3)
+        spans = h.tracer.export()
+        export = h.export_trace()
+    with SimHarness(0, scenario=get_scenario("rolling-upgrade")) as h:
+        untraced = h.run(3)
+    assert traced.ok and untraced.ok
+    assert traced.journal_hash == untraced.journal_hash
+    assert traced.journal_len == untraced.journal_len
+    _assert_decomposes(spans)
+    # The exported artifact carries spans + the replayable journal.
+    assert export["seed"] == 0
+    assert export["journal_hash"] == traced.journal_hash
+    assert len(export["events"]) == traced.journal_len
+    assert export["spans"] and export["flight"]
+    json.dumps(export)                          # JSON-serializable

@@ -33,7 +33,8 @@ def _rules_fired(src, only=None, **kw):
 
 def test_rule_registry_complete():
     assert {"rv-precondition", "lock-discipline", "blocking-under-lock",
-            "exception-swallow", "tpu-env-completeness"} <= set(RULES)
+            "exception-swallow", "tpu-env-completeness",
+            "requeue-observability"} <= set(RULES)
     for cls in RULES.values():
         assert cls.DESCRIPTION and cls.INVARIANT
 
@@ -455,6 +456,112 @@ def test_cli_exit_codes(tmp_path):
     assert main([str(bad), "--rules", "tpu-env-completeness"]) == 0
     assert main(["--list-rules"]) == 0
     assert main([str(bad), "--rules", "no-such-rule"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# requeue-observability
+# ---------------------------------------------------------------------------
+
+def test_requeue_observability_flags_silent_requeue_return():
+    _, fired = _rules_fired("""
+        class C:
+            def reconcile(self, name, ns):
+                try:
+                    self._do(name)
+                except CoordinatorError as e:
+                    self._set_message(str(e))
+                    return 2.0
+    """)
+    assert "requeue-observability" in fired
+
+
+def test_requeue_observability_flags_silent_requeue_assignment():
+    _, fired = _rules_fired("""
+        class C:
+            def _process(self, key):
+                try:
+                    self._do(key)
+                except Exception as e:
+                    log.debug("failed: %s", e)
+                    requeue = 5.0
+                if requeue:
+                    self.enqueue(key, after=requeue)
+    """)
+    assert "requeue-observability" in fired
+
+
+def test_requeue_observability_flags_delegated_requeue_kwarg():
+    _, fired = _rules_fired("""
+        class C:
+            def _state_running(self, job):
+                try:
+                    self._poll(job)
+                except CoordinatorError:
+                    return self._to(job, "RETRYING", requeue=0.1)
+    """)
+    assert "requeue-observability" in fired
+
+
+def test_requeue_observability_accepts_metric_and_span_evidence():
+    _, fired = _rules_fired("""
+        class C:
+            def reconcile(self, name, ns):
+                try:
+                    self._do(name)
+                except Conflict as e:
+                    self.metrics.reconcile_conflict(self.KIND)
+                    return 0.05
+                except CoordinatorError as e:
+                    self.tracer.record_error("coordinator", str(e))
+                    return 2.0
+                except Exception as e:
+                    self.registry.inc("tpu_reconcile_errors_total",
+                                      {"kind": self.KIND})
+                    return 5.0
+
+            def _process(self, key):
+                try:
+                    self._do(key)
+                except Exception as e:
+                    span.error(str(e))
+                    requeue = 5.0
+    """)
+    assert "requeue-observability" not in fired
+
+
+def test_requeue_observability_ignores_non_requeue_and_log_error():
+    _, fired = _rules_fired("""
+        class C:
+            def reconcile(self, name, ns):
+                try:
+                    self._do(name)
+                except NotFound:
+                    return None
+                except CoordinatorError:
+                    pass
+                return 2.0
+
+            def helper(self):
+                # Not a reconcile-shaped function: out of scope.
+                try:
+                    self._do()
+                except Exception:
+                    return 1.0
+    """)
+    assert "requeue-observability" not in fired
+
+
+def test_requeue_observability_log_error_is_not_evidence():
+    _, fired = _rules_fired("""
+        class C:
+            def reconcile(self, name, ns):
+                try:
+                    self._do(name)
+                except Exception as e:
+                    self._log.error("failed: %s", e)
+                    return 5.0
+    """)
+    assert "requeue-observability" in fired
 
 
 # ---------------------------------------------------------------------------

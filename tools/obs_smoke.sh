@@ -1,0 +1,61 @@
+#!/usr/bin/env sh
+# Observability smoke gate: boot the operator against the fake kubelet,
+# drive a cluster to Ready, scrape /metrics and /debug/traces (+ the
+# flight recorder), and assert both parse — the standing check that the
+# Prometheus exposition and the span export stay machine-readable:
+#
+#   tools/obs_smoke.sh
+#
+# See docs/observability.md for the span model and the metric catalog.
+set -eu
+cd "$(dirname "$0")/.."
+exec timeout -k 10 300 env JAX_PLATFORMS=cpu python - <<'EOF'
+import json
+import re
+import urllib.request
+
+from kuberay_tpu.operator import Operator
+from kuberay_tpu.sim.scenarios import make_cluster_obj
+
+op = Operator(fake_kubelet=True)
+url = op.start(api_port=0)
+try:
+    op.store.create(make_cluster_obj("smoke", topology="2x2x2", replicas=1))
+    for _ in range(6):
+        op.run_until_idle()
+    state = op.store.get("TpuCluster", "smoke").get("status", {}).get("state")
+    assert state == "ready", f"cluster never became ready (state={state!r})"
+
+    # /metrics must parse as Prometheus text exposition: every sample
+    # line is <name>{labels} <value>, every meta line # HELP / # TYPE.
+    with urllib.request.urlopen(f"{url}/metrics") as resp:
+        text = resp.read().decode()
+    sample = re.compile(r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? '
+                        r'[-+0-9.eEinfa]+$')
+    bad = [ln for ln in text.splitlines()
+           if ln and not ln.startswith("#") and not sample.match(ln)]
+    assert not bad, f"unparseable exposition lines: {bad[:3]}"
+    for needed in ("tpu_reconcile_total", "tpu_slice_ready_duration_seconds",
+                   "tpu_cluster_provisioned_duration_seconds"):
+        assert needed in text, f"{needed} missing from /metrics"
+
+    # /debug/traces must parse as JSON and contain the span pipeline.
+    with urllib.request.urlopen(f"{url}/debug/traces") as resp:
+        doc = json.load(resp)
+    names = {s["name"] for s in doc["spans"]}
+    for needed in ("queue-wait", "reconcile", "store-write", "pod-start",
+                   "slice-ready"):
+        assert needed in names, f"{needed} span missing: {sorted(names)}"
+
+    # And the flight recorder answers for the CR.
+    with urllib.request.urlopen(
+            f"{url}/debug/flight/TpuCluster/default/smoke") as resp:
+        flight = json.load(resp)
+    assert flight["records"], "flight recorder empty for the cluster"
+
+    print(f"obs smoke ok: {len(doc['spans'])} spans, "
+          f"{len(text.splitlines())} metric lines, "
+          f"{len(flight['records'])} flight records")
+finally:
+    op.stop()
+EOF
