@@ -616,7 +616,98 @@ class RequeueObservabilityRule(Rule):
 
 
 # ---------------------------------------------------------------------------
-# 6. tpu-env-completeness
+# 6. phase-transition-recorded
+# ---------------------------------------------------------------------------
+
+#: Attribute names that ARE CR state fields wherever they appear.
+_STATE_FIELD_ATTRS = {"jobDeploymentStatus", "serviceStatus"}
+#: Generic state attrs/keys — only counted when written on a status
+#: receiver (``status.state``, ``st["state"]``, ``obj["status"]["phase"]``),
+#: so e.g. ``self.state = backend`` never matches.
+_STATE_GENERIC_NAMES = {"state", "phase"}
+_TRANSITION_EVIDENCE_ATTRS = {"record_transition", "observe_state"}
+
+
+@rule
+class PhaseTransitionRecordedRule(Rule):
+    """Controller code that writes a ``.status.state``/``.status.phase``
+    field must route the transition through the transition recorder
+    (``self.transitions.record(...)`` — the flight/goodput-ledger hook,
+    obs/goodput.py).  A state write that bypasses it is a lifecycle
+    transition the goodput ledger never sees: that object's wall-clock
+    attribution silently stops at the last recorded phase, and the
+    time-loss breakdown (/debug/goodput, the history archive) lies.
+    The rule exists so no future controller escapes attribution.
+    """
+
+    NAME = "phase-transition-recorded"
+    DESCRIPTION = ("status state/phase writes must route through the "
+                   "transition recorder (transitions.record)")
+    INVARIANT = ("every controller state transition is recorded for "
+                 "goodput attribution")
+
+    def check(self, tree: ast.Module, ctx: FileContext) -> Iterable[Finding]:
+        for fn in iter_functions(tree):
+            writes = list(self._state_writes(fn))
+            if not writes:
+                continue
+            if self._has_evidence(fn):
+                continue
+            for node, field in writes:
+                yield self.finding(
+                    ctx, node,
+                    f"{fn.name}() writes the '{field}' state field "
+                    "without routing through the transition recorder; "
+                    "call self.transitions.record(...) (or "
+                    "record_transition/observe_state) so the goodput "
+                    "ledger attributes this phase change")
+
+    @staticmethod
+    def _state_writes(fn):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Assign):
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Attribute):
+                    if tgt.attr in _STATE_FIELD_ATTRS:
+                        yield node, tgt.attr
+                    elif tgt.attr in _STATE_GENERIC_NAMES:
+                        recv = dotted(tgt.value).lower()
+                        if "status" in recv or \
+                                recv.split(".")[-1] == "st":
+                            yield node, tgt.attr
+                elif isinstance(tgt, ast.Subscript):
+                    key = _const_str(tgt.slice)
+                    if key not in _STATE_GENERIC_NAMES:
+                        continue
+                    keys, base = [], tgt.value
+                    while isinstance(base, ast.Subscript):
+                        k = _const_str(base.slice)
+                        if k:
+                            keys.append(k)
+                        base = base.value
+                    recv = dotted(base).lower()
+                    if "status" in keys or "status" in recv or \
+                            recv.split(".")[-1] == "st":
+                        yield node, key
+
+    @staticmethod
+    def _has_evidence(fn) -> bool:
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Call) and
+                    isinstance(node.func, ast.Attribute)):
+                continue
+            attr = node.func.attr
+            if attr in _TRANSITION_EVIDENCE_ATTRS:
+                return True
+            if attr == "record" and \
+                    "transition" in dotted(node.func.value).lower():
+                return True
+        return False
+
+
+# ---------------------------------------------------------------------------
+# 7. tpu-env-completeness
 # ---------------------------------------------------------------------------
 
 _ENV_GROUP = {"TPU_WORKER_ID", "TPU_WORKER_HOSTNAMES", "TPU_TOPOLOGY"}

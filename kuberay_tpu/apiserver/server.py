@@ -69,6 +69,8 @@ class ApiHandler(JsonHandler):
     history = None                      # HistoryServer mount (optional)
     tracer = None                       # obs.Tracer (optional)
     flight = None                       # obs.FlightRecorder (optional)
+    goodput = None                      # obs.GoodputLedger (optional)
+    autoscaler = None                   # autoscaler.DecisionAudit (optional)
 
     def _error(self, code: int, message: str, reason: str = ""):
         self._send(code, {"kind": "Status", "status": "Failure",
@@ -224,6 +226,43 @@ class ApiHandler(JsonHandler):
         return self._send(200, {
             "kind": kind, "namespace": ns, "name": name,
             "records": self.flight.timeline(kind, ns, name)})
+
+    def _debug_goodput(self, path: str):
+        """Goodput ledger: ``/debug/goodput`` lists tracked objects with
+        their current phase + ratio; ``/debug/goodput/<kind>/<ns>/<name>``
+        returns the interval list and the per-phase rollup (intervals
+        partition the object's lifetime — sum(phases) == total)."""
+        if self.goodput is None:
+            return self._error(404, "goodput ledger not enabled")
+        parts = [p for p in path.split("/") if p][2:]  # strip debug/goodput
+        if not parts:
+            rows = []
+            for kind, ns, name in self.goodput.keys():
+                roll = self.goodput.rollup(kind, ns, name)
+                rows.append({
+                    "kind": kind, "namespace": ns, "name": name,
+                    "current_phase": roll["current_phase"] if roll else None,
+                    "goodput_ratio": roll["goodput_ratio"] if roll else 0.0,
+                })
+            return self._send(200, {"objects": rows})
+        if len(parts) != 3:
+            return self._error(
+                404, "use /debug/goodput/<kind>/<namespace>/<name>")
+        kind, ns, name = parts
+        roll = self.goodput.rollup(kind, ns, name)
+        if roll is None:
+            return self._error(404, f"no ledger for {kind} {ns}/{name}")
+        return self._send(200, {
+            "kind": kind, "namespace": ns, "name": name,
+            "intervals": self.goodput.intervals(kind, ns, name),
+            "rollup": roll})
+
+    def _debug_autoscaler(self):
+        """Autoscaler decision audit: the bounded last-N ring of scale
+        decisions with their input signals (newest first)."""
+        if self.autoscaler is None:
+            return self._error(404, "autoscaler audit not enabled")
+        return self._send(200, {"decisions": self.autoscaler.to_list()})
 
     def _label_selector(self) -> Optional[Dict[str, str]]:
         q = parse_qs(urlparse(self.path).query)
@@ -395,6 +434,10 @@ class ApiHandler(JsonHandler):
             return self._debug_traces()
         if path == "/debug/flight" or path.startswith("/debug/flight/"):
             return self._debug_flight(path)
+        if path == "/debug/goodput" or path.startswith("/debug/goodput/"):
+            return self._debug_goodput(path)
+        if path == "/debug/autoscaler":
+            return self._debug_autoscaler()
         if path.startswith("/api/history/") and self.history is not None:
             r = self.history.route(self.path)
             if r is not None:
@@ -606,18 +649,22 @@ def make_server(store: ObjectStore, host: str = "127.0.0.1", port: int = 0,
                 certfile: Optional[str] = None,
                 keyfile: Optional[str] = None,
                 history=None, tracer=None,
-                flight=None) -> ThreadingHTTPServer:
+                flight=None, goodput=None,
+                autoscaler=None) -> ThreadingHTTPServer:
     """``token`` enables bearer auth on every API verb; ``certfile``/
     ``keyfile`` serve TLS (the authenticated-cluster-endpoint stand-in
     RestObjectStore's client auth is tested against).  ``history``: a
     ``history.server.HistoryServer`` to mount at ``/api/history/*`` so
     the dashboard's history views work without a second endpoint.
-    ``tracer``/``flight`` (kuberay_tpu.obs) mount the ``/debug/traces``
-    and ``/debug/flight/...`` forensics surface."""
+    ``tracer``/``flight``/``goodput`` (kuberay_tpu.obs) mount the
+    ``/debug/traces``, ``/debug/flight/...`` and ``/debug/goodput/...``
+    forensics surface; ``autoscaler`` (a ``DecisionAudit``) mounts
+    ``/debug/autoscaler``."""
     handler = type("BoundApiHandler", (ApiHandler,),
                    {"store": store, "metrics": metrics, "token": token,
                     "history": history, "tracer": tracer,
-                    "flight": flight})
+                    "flight": flight, "goodput": goodput,
+                    "autoscaler": autoscaler})
     if certfile:
         import ssl
         ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
@@ -635,11 +682,13 @@ def serve_background(store: ObjectStore, host: str = "127.0.0.1",
                      port: int = 0, metrics=None, token: Optional[str] = None,
                      certfile: Optional[str] = None,
                      keyfile: Optional[str] = None, history=None,
-                     tracer=None, flight=None):
+                     tracer=None, flight=None, goodput=None,
+                     autoscaler=None):
     """Start in a daemon thread; returns (server, base_url)."""
     srv = make_server(store, host, port, metrics, token=token,
                       certfile=certfile, keyfile=keyfile, history=history,
-                      tracer=tracer, flight=flight)
+                      tracer=tracer, flight=flight, goodput=goodput,
+                      autoscaler=autoscaler)
     t = threading.Thread(target=srv.serve_forever, daemon=True,
                          name="tpu-apiserver")
     t.start()

@@ -55,7 +55,10 @@ def main(argv=None) -> int:
                     help="single reconcile pass (tests / cron)")
     args = ap.parse_args(argv)
 
-    from kuberay_tpu.controlplane.autoscaler import SliceAutoscaler
+    import json
+
+    from kuberay_tpu.controlplane.autoscaler import (DecisionAudit,
+                                                     SliceAutoscaler)
     from kuberay_tpu.controlplane.rest_store import RestObjectStore
 
     url = args.apiserver or _default_apiserver()
@@ -63,13 +66,24 @@ def main(argv=None) -> int:
              or _sa_token())
     store = RestObjectStore(url, token=token or None)
     idle_timeout = float(os.environ.get("TPU_AUTOSCALER_IDLE_TIMEOUT", "60"))
-    scaler = SliceAutoscaler(store, idle_timeout=idle_timeout)
+    # Decision audit (same ring the operator mounts at /debug/autoscaler):
+    # the sidecar has no HTTP surface, so each decision — input signals
+    # and verdict — is emitted to the container log as one JSON line.
+    audit = DecisionAudit()
+    scaler = SliceAutoscaler(store, idle_timeout=idle_timeout, audit=audit)
     print(f"autoscaler sidecar: cluster={args.cluster} ns={args.namespace} "
           f"apiserver={url} idle_timeout={idle_timeout}s", flush=True)
 
+    printed = 0
     while True:
         try:
             changed = scaler.reconcile(args.cluster, args.namespace)
+            fresh = min(audit.total - printed, len(audit))
+            if fresh > 0:
+                for entry in reversed(audit.to_list()[:fresh]):
+                    print(f"autoscaler decision: {json.dumps(entry)}",
+                          flush=True)
+            printed = audit.total
             if changed:
                 print(f"autoscaler: patched {args.cluster}", flush=True)
         except Exception as e:  # keep the sidecar alive through API blips

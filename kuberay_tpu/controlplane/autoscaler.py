@@ -18,8 +18,10 @@ inject (builders/pod.py BuildAutoscalerContainer analogue).
 from __future__ import annotations
 
 import dataclasses
+import threading
 import time
-from typing import Dict, List
+from collections import deque
+from typing import Any, Dict, List, Optional
 
 from kuberay_tpu.api.tpucluster import TpuCluster
 from kuberay_tpu.controlplane.store import (
@@ -97,6 +99,63 @@ def decide(cluster: TpuCluster,
     return out
 
 
+class DecisionAudit:
+    """Bounded last-N ring of autoscaler decisions: the input signals
+    (demand, slice idleness, current replicas) next to the verdict
+    (target replicas, named victims, reason) — so "why did it scale?"
+    is answerable after the fact without replaying the loop.  Served at
+    ``/debug/autoscaler``; each record also increments
+    ``tpu_autoscaler_decisions_total{kind,direction}``."""
+
+    def __init__(self, capacity: int = 256, metrics=None, clock=None):
+        self._ring: "deque[Dict[str, Any]]" = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self.metrics = metrics
+        self._now = clock.now if clock is not None else time.time
+        # Lifetime decision count (monotonic; the ring holds the last N).
+        self.total = 0
+
+    def record(self, namespace: str, cluster: str, decision: GroupDecision,
+               *, current: int, demand: Dict[str, int],
+               slices: List[SliceInfo], applied: bool) -> Dict[str, Any]:
+        if decision.replicas > current:
+            direction = "up"
+        elif decision.replicas < current or decision.slices_to_delete:
+            direction = "down"
+        else:
+            direction = "none"
+        entry = {
+            "ts": self._now(),
+            "namespace": namespace, "cluster": cluster,
+            "group": decision.group, "direction": direction,
+            "replicas_before": current, "replicas_after": decision.replicas,
+            "slices_to_delete": list(decision.slices_to_delete),
+            "reason": decision.reason,
+            "applied": applied,
+            "signals": {
+                "demand": demand.get(decision.group, 0),
+                "slices": [{"name": s.name, "ready": s.ready,
+                            "idle_seconds": s.idle_seconds}
+                           for s in slices if s.group == decision.group],
+            },
+        }
+        with self._lock:
+            self._ring.append(entry)
+            self.total += 1
+        if self.metrics is not None:
+            self.metrics.autoscaler_decision(C.KIND_CLUSTER, direction)
+        return entry
+
+    def to_list(self) -> List[Dict[str, Any]]:
+        """Newest-first snapshot of the ring."""
+        with self._lock:
+            return list(reversed(self._ring))
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+
 def apply_decisions(store: ObjectStore, cluster_name: str, namespace: str,
                     decisions: List[GroupDecision]) -> bool:
     """Scale via a single strategic-merge PATCH (workerGroupSpecs merge
@@ -143,9 +202,12 @@ class SliceAutoscaler:
     idleness clock starts when the claim disappears.
     """
 
-    def __init__(self, store: ObjectStore, idle_timeout: float = 60.0):
+    def __init__(self, store: ObjectStore, idle_timeout: float = 60.0,
+                 audit: Optional[DecisionAudit] = None):
         self.store = store
         self.idle_timeout = idle_timeout
+        # Decision audit ring (``/debug/autoscaler``); None = unaudited.
+        self.audit = audit
         # (namespace, cluster, slice-name) -> idle-since timestamp
         self._idle_since: Dict[tuple, float] = {}
 
@@ -231,4 +293,14 @@ class SliceAutoscaler:
         demand = self._demand_for(obj)
         slices = self.observe_slices(obj, demand)
         decisions = decide(cluster, demand, slices, idle_timeout, mode)
-        return apply_decisions(self.store, cluster_name, namespace, decisions)
+        applied = apply_decisions(self.store, cluster_name, namespace,
+                                  decisions)
+        if self.audit is not None and decisions:
+            current = {g.groupName: g.replicas
+                       for g in cluster.spec.workerGroupSpecs}
+            for d in decisions:
+                self.audit.record(namespace, cluster_name, d,
+                                  current=current.get(d.group, 0),
+                                  demand=demand, slices=slices,
+                                  applied=applied)
+        return applied

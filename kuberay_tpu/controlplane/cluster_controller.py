@@ -45,6 +45,7 @@ from kuberay_tpu.controlplane.events import EventRecorder
 from kuberay_tpu.controlplane.expectations import HEAD_GROUP, ScaleExpectations
 from kuberay_tpu.controlplane.store import (AlreadyExists, NotFound,
                                              ObjectStore, StoreError)
+from kuberay_tpu.obs.goodput import NOOP_TRANSITIONS
 from kuberay_tpu.obs.trace import NOOP_TRACER
 from kuberay_tpu.utils import constants as C
 from kuberay_tpu.utils.names import head_service_name, spec_hash
@@ -85,7 +86,8 @@ class TpuClusterController:
                  config_env: Optional[Dict[str, str]] = None,
                  metrics=None,
                  use_openshift_route: bool = False,
-                 tracer=None):
+                 tracer=None,
+                 transitions=None):
         self.store = store
         self.exp = expectations or ScaleExpectations()
         self.recorder = recorder or EventRecorder(store)
@@ -95,6 +97,9 @@ class TpuClusterController:
         # Span annotations (store-write, slice-ready) — no-op by default,
         # passed like ``metrics`` (kuberay_tpu.obs.trace).
         self.tracer = tracer or NOOP_TRACER
+        # State-transition seam (obs.goodput): every .status.state write
+        # routes through it (analysis rule phase-transition-recorded).
+        self.transitions = transitions or NOOP_TRANSITIONS
         # (ns, cluster, group, slice idx) already observed ready: the
         # slice-ready duration (north-star) is emitted once per
         # provisioning — a slice that fails and is rebuilt re-observes.
@@ -609,6 +614,10 @@ class TpuClusterController:
                 reason="AllSlicesReady",
                 observedGeneration=cluster.metadata.generation))
         if new_state and new_state != status.state:
+            self.transitions.record(
+                self.KIND, cluster.metadata.namespace,
+                cluster.metadata.name, new_state,
+                old_state=status.state or "")
             status.stateTransitionTimes[new_state] = time.time()
             if self.metrics is not None and new_state == ClusterState.READY:
                 created = cluster.metadata.creationTimestamp or time.time()
@@ -668,6 +677,9 @@ class TpuClusterController:
         st = obj.setdefault("status", {})
         if st.get("state") == state and st.get("reason") == reason:
             return
+        self.transitions.record(self.KIND, cluster.metadata.namespace,
+                                cluster.metadata.name, state,
+                                old_state=st.get("state") or "")
         st["state"] = state
         st["reason"] = reason
         # Snapshot rv, same contract as _update_status.

@@ -39,6 +39,7 @@ from kuberay_tpu.builders.job import (
 from kuberay_tpu.controlplane.events import EventRecorder
 from kuberay_tpu.controlplane.store import (AlreadyExists, NotFound,
                                              ObjectStore)
+from kuberay_tpu.obs.goodput import NOOP_TRANSITIONS
 from kuberay_tpu.obs.trace import NOOP_TRACER
 from kuberay_tpu.runtime.coordinator_client import CoordinatorError
 from kuberay_tpu.utils import constants as C
@@ -58,7 +59,8 @@ class TpuJobController:
                  client_provider: Optional[Callable] = None,
                  scheduler=None,
                  metrics=None,
-                 tracer=None):
+                 tracer=None,
+                 transitions=None):
         self.store = store
         self.recorder = recorder or EventRecorder(store)
         self.client_provider = client_provider
@@ -66,6 +68,9 @@ class TpuJobController:
         self.metrics = metrics
         # Span annotations — no-op by default, passed like ``metrics``.
         self.tracer = tracer or NOOP_TRACER
+        # State-transition seam (obs.goodput): every jobDeploymentStatus
+        # write routes through it (rule phase-transition-recorded).
+        self.transitions = transitions or NOOP_TRANSITIONS
 
     # ------------------------------------------------------------------
 
@@ -140,10 +145,13 @@ class TpuJobController:
         else:
             job.status.clusterName = cluster_name_for_job(
                 job.metadata.name, int(job.status.failed))
-        if job.spec.suspend:
-            job.status.jobDeploymentStatus = JobDeploymentStatus.SUSPENDED
-        else:
-            job.status.jobDeploymentStatus = JobDeploymentStatus.INITIALIZING
+        nxt = (JobDeploymentStatus.SUSPENDED if job.spec.suspend
+               else JobDeploymentStatus.INITIALIZING)
+        self.transitions.record(self.KIND, job.metadata.namespace,
+                                job.metadata.name, nxt,
+                                old_state=JobDeploymentStatus.NEW)
+        job.status.jobDeploymentStatus = nxt
+        if not job.spec.suspend:
             job.status.startTime = job.status.startTime or time.time()
         self._update(job)
         return 0.1
@@ -300,6 +308,10 @@ class TpuJobController:
     def _state_suspended(self, job: TpuJob) -> Optional[float]:
         if not job.spec.suspend:
             # Resume: back to New with a fresh cluster (ref requeue-to-New).
+            self.transitions.record(self.KIND, job.metadata.namespace,
+                                    job.metadata.name,
+                                    JobDeploymentStatus.NEW,
+                                    old_state=JobDeploymentStatus.SUSPENDED)
             job.status.jobDeploymentStatus = JobDeploymentStatus.NEW
             job.status.jobStatus = ""
             job.status.startTime = 0.0
@@ -309,6 +321,9 @@ class TpuJobController:
 
     def _state_retrying(self, job: TpuJob) -> Optional[float]:
         self._teardown(job)
+        self.transitions.record(self.KIND, job.metadata.namespace,
+                                job.metadata.name, JobDeploymentStatus.NEW,
+                                old_state=JobDeploymentStatus.RETRYING)
         job.status.jobDeploymentStatus = JobDeploymentStatus.NEW
         job.status.jobStatus = ""
         job.status.jobId = ""       # fresh submission id for the new attempt
@@ -489,11 +504,17 @@ class TpuJobController:
 
     def _to(self, job: TpuJob, state: str, requeue: Optional[float] = None
             ) -> Optional[float]:
+        self.transitions.record(self.KIND, job.metadata.namespace,
+                                job.metadata.name, state,
+                                old_state=job.status.jobDeploymentStatus)
         job.status.jobDeploymentStatus = state
         self._update(job)
         return requeue
 
     def _fail(self, job: TpuJob, reason: str, message: str) -> Optional[float]:
+        self.transitions.record(self.KIND, job.metadata.namespace,
+                                job.metadata.name, JobDeploymentStatus.FAILED,
+                                old_state=job.status.jobDeploymentStatus)
         job.status.jobDeploymentStatus = JobDeploymentStatus.FAILED
         job.status.jobStatus = job.status.jobStatus or JobStatus.FAILED
         job.status.reason = reason

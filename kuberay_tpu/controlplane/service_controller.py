@@ -35,6 +35,7 @@ from kuberay_tpu.builders.service import build_serve_service
 from kuberay_tpu.controlplane.events import EventRecorder
 from kuberay_tpu.controlplane.store import (AlreadyExists, NotFound,
                                              ObjectStore)
+from kuberay_tpu.obs.goodput import NOOP_TRANSITIONS
 from kuberay_tpu.obs.trace import NOOP_TRACER
 from kuberay_tpu.runtime.coordinator_client import CoordinatorError
 from kuberay_tpu.utils import constants as C
@@ -55,12 +56,16 @@ class TpuServiceController:
     def __init__(self, store: ObjectStore,
                  recorder: Optional[EventRecorder] = None,
                  client_provider: Optional[Callable] = None,
-                 tracer=None):
+                 tracer=None,
+                 transitions=None):
         self.store = store
         self.recorder = recorder or EventRecorder(store)
         self.client_provider = client_provider
         # Span annotations — no-op by default, passed like ``metrics``.
         self.tracer = tracer or NOOP_TRACER
+        # State-transition seam (obs.goodput): every serviceStatus write
+        # routes through it (rule phase-transition-recorded).
+        self.transitions = transitions or NOOP_TRANSITIONS
         # serve config cache per cluster (ref cacheServeConfig): avoids
         # re-PUTting an unchanged config every pass.
         self._submitted: Dict[str, str] = {}
@@ -549,6 +554,10 @@ class TpuServiceController:
             self._unhealthy_since.pop(cs.clusterName, None)
         st.activeServiceStatus = None
         st.pendingServiceStatus = None
+        if st.serviceStatus != "Suspended":
+            self.transitions.record(self.KIND, svc.metadata.namespace,
+                                    svc.metadata.name, "Suspended",
+                                    old_state=st.serviceStatus)
         st.serviceStatus = "Suspended"
         self._update_status(svc)
         return None
@@ -574,7 +583,12 @@ class TpuServiceController:
         st.observedGeneration = svc.metadata.generation
         ready = self._serve_ready(st.activeServiceStatus)
         if not svc.spec.suspend:
-            st.serviceStatus = "Running" if ready else "WaitForServeDeploymentReady"
+            nxt = "Running" if ready else "WaitForServeDeploymentReady"
+            if nxt != st.serviceStatus:
+                self.transitions.record(self.KIND, svc.metadata.namespace,
+                                        svc.metadata.name, nxt,
+                                        old_state=st.serviceStatus)
+            st.serviceStatus = nxt
         set_condition(st.conditions, Condition(
             type=ServiceConditionType.READY,
             status="True" if ready else "False",

@@ -185,7 +185,14 @@ class CoordinatorCollector:
             new = [e for e in fresh if ekey(e) not in seen]
             if new:
                 merged = existing + new
-                merged.sort(key=lambda e: e.get("ts") or 0)
+                # Order by the coordinator's SERVER-side receive stamps
+                # (received_at + monotonic received_seq); the client
+                # ``ts`` is display-only fallback for events from an
+                # older coordinator — a skewed client clock must not
+                # reorder the archive.
+                merged.sort(key=lambda e: (
+                    e.get("received_at") or e.get("ts") or 0,
+                    e.get("received_seq") or 0))
                 merged = merged[-100_000:]     # archive cap
                 self.storage.put(key,
                                  json.dumps({"events": merged}).encode())

@@ -17,6 +17,7 @@ Components (reference counterparts in parentheses):
   ``GET /api/history/logs/{ns}/{cluster}``      log-file listing
   ``GET /api/history/logs/{ns}/{cluster}/{path}`` log content (text)
   ``GET /api/history/meta/{ns}/{cluster}``      archived metadata docs
+  ``GET /api/history/goodput/{ns}/{cluster}``   archived goodput ledger
 
 All storage goes through ``history.storage.StorageBackend`` — local
 directory, S3, or GCS (the reference's storage interface seam).
@@ -71,9 +72,15 @@ class HistoryCollector:
     otherwise a slow S3/GCS endpoint would stall every store mutation
     (API writes, all reconcilers) behind remote HTTP round-trips."""
 
-    def __init__(self, store: ObjectStore, storage: StorageBackend):
+    def __init__(self, store: ObjectStore, storage: StorageBackend,
+                 goodput=None):
         self.store = store
         self.storage = storage
+        # Optional obs.GoodputLedger: each archived CR snapshot also
+        # persists the object's goodput ledger doc under
+        # ``meta/{ns}/{cluster}/goodput.json`` — the time-loss breakdown
+        # of a deleted cluster stays debuggable post-mortem.
+        self.goodput = goodput
         self._queue: "queue.Queue[Optional[Event]]" = queue.Queue()
         self._worker = threading.Thread(target=self._drain, daemon=True,
                                         name="history-collector")
@@ -132,6 +139,13 @@ class HistoryCollector:
                 if p["metadata"].get("labels", {})
                 .get("tpu.dev/cluster") == name]
         self.storage.put_doc(key, doc)
+        if self.goodput is not None and ev.kind == "TpuCluster":
+            # Refresh the goodput doc on every archived snapshot; the
+            # DELETED pass freezes it (the ledger closes on deletion), so
+            # the time-loss breakdown outlives the cluster.
+            gdoc = self.goodput.to_doc(ev.kind, ns, name)
+            if gdoc is not None:
+                self.storage.put_doc(f"meta/{ns}/{name}/goodput.json", gdoc)
 
 
 class HistoryServer:
@@ -209,6 +223,12 @@ class HistoryServer:
         if head == "events" and len(parts) == 5:
             return 200, {"events": self.task_events(parts[3],
                                                     parts[4])}, False
+        if head == "goodput" and len(parts) == 5:
+            doc = self.storage.get_doc(
+                f"meta/{parts[3]}/{parts[4]}/goodput.json")
+            if doc is None:
+                return 404, {"message": "no goodput ledger archived"}, False
+            return 200, doc, False
         if head == "timeline" and len(parts) == 5:
             doc = self.storage.get_doc(_doc_key("TpuCluster", parts[3],
                                                 parts[4]))

@@ -16,9 +16,21 @@ production and in sim-violation forensics — from one artifact.
   buffer of watch deliveries, state transitions, recorded Events,
   conflicts and requeues, queryable as a timeline
   (``/debug/flight/<kind>/<ns>/<name>`` on the API server).
+- :mod:`kuberay_tpu.obs.goodput`: the goodput/badput ledger — every
+  second of a TpuJob/TpuCluster's lifetime attributed to an exclusive,
+  exhaustive phase set (queued / provisioning / bootstrap / productive
+  / interrupted / recovery / teardown), served live at
+  ``/debug/goodput`` and archived post-mortem by the history server.
 """
 
 from kuberay_tpu.obs.flight import FlightRecorder
+from kuberay_tpu.obs.goodput import (
+    NOOP_TRANSITIONS,
+    PHASES,
+    GoodputLedger,
+    NoopTransitionRecorder,
+    TransitionRecorder,
+)
 from kuberay_tpu.obs.trace import (
     NOOP_TRACER,
     NoopTracer,
@@ -31,11 +43,16 @@ from kuberay_tpu.obs.trace import (
 
 __all__ = [
     "FlightRecorder",
+    "GoodputLedger",
     "NOOP_TRACER",
+    "NOOP_TRANSITIONS",
     "NoopTracer",
+    "NoopTransitionRecorder",
+    "PHASES",
     "Span",
     "SpanStore",
     "TraceContext",
     "Tracer",
+    "TransitionRecorder",
     "span_tree",
 ]

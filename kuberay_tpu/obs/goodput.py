@@ -1,0 +1,508 @@
+"""Goodput/badput ledger: per-job wall-clock attribution.
+
+The canonical TPU-fleet question — of a TPUJob/TpuCluster's total
+wall-clock, how many seconds were productive steps vs. lost to
+queueing, slice provisioning, multi-host bootstrap, interruptions and
+recovery — answered by a per-(kind, namespace, name)
+:class:`GoodputLedger` that attributes **every second** of an object's
+lifetime to exactly one phase of an exclusive, exhaustive set:
+
+- ``queued``        — the CR exists but nothing acts on it yet (also:
+                      suspended/parked objects);
+- ``provisioning``  — the controller has started acting (services,
+                      cluster creation for a job) but no pod exists;
+- ``bootstrap``     — first pod created → every TPU_WORKER_ID of every
+                      slice Running (the multi-host ICI bring-up);
+- ``productive``    — full strength: every expected host Running;
+- ``interrupted``   — any worker of a slice down (a killed host costs
+                      the *whole slice's* step time — this phase makes
+                      that cost visible);
+- ``recovery``      — reprovision/re-bootstrap after an interruption
+                      (failed pods cleared, replacements coming up);
+- ``teardown``      — deletionTimestamp set / suspend drain → gone.
+
+Intervals are constructed so they **partition** the object's lifetime:
+each ``transition`` closes the open interval at the same instant the
+next one opens — no gaps, no overlaps, ``sum(phases) == elapsed`` by
+construction (the chaos-sim exactness gate in tests/test_goodput.py).
+
+Feeds (all stamped with the *server-side* clock — attribution never
+trusts client timestamps):
+
+- store watch events (:meth:`GoodputLedger.observe_event`): CR
+  lifecycle + pod phase accounting for pod-backed kinds (TpuCluster);
+- controller state transitions via :class:`TransitionRecorder`, the
+  single seam every ``.status.state``/phase write routes through
+  (enforced by analysis rule #7 ``phase-transition-recorded``) — the
+  phase authority for pod-less kinds (TpuJob, TpuService);
+- CoordinatorServer job events (``record_events`` → ``received_at``).
+
+Purely observational: the ledger never touches the store, the rng or
+the clock's state, so a chaos-sim journal hash is byte-identical with
+the ledger on or off.  Bounded: ``max_objects`` tracked objects with
+LRU eviction, like the flight recorder.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+from kuberay_tpu.topology import SliceTopology
+from kuberay_tpu.utils import constants as C
+
+Key = Tuple[str, str, str]          # (kind, namespace, name)
+
+PHASE_QUEUED = "queued"
+PHASE_PROVISIONING = "provisioning"
+PHASE_BOOTSTRAP = "bootstrap"
+PHASE_PRODUCTIVE = "productive"
+PHASE_INTERRUPTED = "interrupted"
+PHASE_RECOVERY = "recovery"
+PHASE_TEARDOWN = "teardown"
+
+#: The exclusive, exhaustive phase set, in canonical lifecycle order.
+PHASES = (PHASE_QUEUED, PHASE_PROVISIONING, PHASE_BOOTSTRAP,
+          PHASE_PRODUCTIVE, PHASE_INTERRUPTED, PHASE_RECOVERY,
+          PHASE_TEARDOWN)
+
+#: Kinds whose phase is derived from pod accounting (watch events); a
+#: controller-state transition on these is recorded on the flight ring
+#: but does not drive the ledger (one authority per kind).
+_POD_DRIVEN_KINDS = (C.KIND_CLUSTER,)
+
+#: Controller-state → phase maps for pod-less kinds (the
+#: TransitionRecorder feed).
+_STATE_PHASES: Dict[str, Dict[str, str]] = {
+    C.KIND_JOB: {
+        "New": PHASE_QUEUED,
+        "Initializing": PHASE_PROVISIONING,
+        "Waiting": PHASE_BOOTSTRAP,
+        "Running": PHASE_PRODUCTIVE,
+        "Retrying": PHASE_RECOVERY,
+        "Suspending": PHASE_TEARDOWN,
+        "Suspended": PHASE_QUEUED,
+        "Complete": PHASE_TEARDOWN,
+        "Failed": PHASE_INTERRUPTED,
+    },
+    C.KIND_SERVICE: {
+        "": PHASE_QUEUED,
+        "WaitForServeDeploymentReady": PHASE_BOOTSTRAP,
+        "Running": PHASE_PRODUCTIVE,
+        "Suspended": PHASE_QUEUED,
+    },
+}
+
+#: Pod phases that mean "this host is down" (a Succeeded worker is as
+#: dead to the ICI ring as a Failed one — ref shouldDeletePod).
+_POD_DOWN_PHASES = ("Failed", "Succeeded")
+
+
+def _expected_pods(obj: Dict[str, Any]) -> Optional[int]:
+    """Pods a TpuCluster needs at full strength: 1 head + replicas ×
+    hosts-per-slice per worker group.  None when the spec is unreadable
+    (the machine falls back to all-running heuristics)."""
+    spec = obj.get("spec") or {}
+    if spec.get("suspend"):
+        return 0
+    try:
+        n = 1                                   # head
+        for g in spec.get("workerGroupSpecs") or []:
+            if g.get("suspend"):
+                continue
+            topo = SliceTopology.create(g.get("accelerator", "v5e"),
+                                        g.get("topology", "2x2"))
+            n += max(0, int(g.get("replicas", 1))) * topo.num_hosts
+        return n
+    except Exception:
+        return None
+
+
+class _Entry:
+    """Per-object ledger state.  Intervals are ``[phase, start, end]``
+    with ``end is None`` only on the last (open) interval."""
+
+    __slots__ = ("intervals", "pods", "expected", "reached_productive",
+                 "growing", "closed")
+
+    def __init__(self):
+        self.intervals: List[List[Any]] = []
+        self.pods: Dict[str, str] = {}          # pod name -> phase
+        self.expected: Optional[int] = None
+        self.reached_productive = False
+        self.growing = False
+        self.closed = False
+
+
+class GoodputLedger:
+    def __init__(self, clock=None, metrics=None, max_objects: int = 2048):
+        # ``clock``: duck-typed .now() (the sim passes its VirtualClock);
+        # defaults to wall time.  This is THE timestamp authority: every
+        # transition is stamped server-side, never from client payloads.
+        self._now = clock.now if clock is not None else time.time
+        # Optional ControlPlaneMetrics: closed intervals feed
+        # tpu_goodput_seconds_total{kind,phase}; every transition
+        # refreshes the per-object tpu_goodput_ratio gauge.
+        self.metrics = metrics
+        self.max_objects = max_objects
+        self._lock = threading.Lock()
+        self._objs: "OrderedDict[Key, _Entry]" = OrderedDict()
+
+    # -- core primitive ------------------------------------------------------
+
+    def _entry(self, key: Key) -> _Entry:
+        e = self._objs.get(key)
+        if e is None:
+            e = _Entry()
+            self._objs[key] = e
+            if len(self._objs) > self.max_objects:
+                self._objs.popitem(last=False)
+        else:
+            self._objs.move_to_end(key)
+        return e
+
+    def _current_phase(self, e: _Entry) -> Optional[str]:
+        return e.intervals[-1][0] if e.intervals else None
+
+    def transition(self, kind: str, namespace: str, name: str, phase: str,
+                   ts: Optional[float] = None) -> None:
+        """Close the open interval and open ``phase`` at the same
+        instant.  Idempotent on an unchanged phase; ignored after
+        ``close``.  ``ts`` must come from a server-side clock (defaults
+        to this ledger's); it is clamped so intervals never run
+        backwards."""
+        with self._lock:
+            key = (kind, namespace, name)
+            e = self._entry(key)
+            self._transition_locked(key, e, phase, ts)
+
+    def _transition_locked(self, key: Key, e: _Entry, phase: str,
+                           ts: Optional[float]) -> None:
+        if e.closed or phase not in PHASES:
+            return
+        now = self._now() if ts is None else ts
+        if not e.intervals:
+            e.intervals.append([phase, now, None])
+            self._refresh_gauge(key, e, now)
+            return
+        last = e.intervals[-1]
+        if last[0] == phase:
+            return
+        now = max(now, last[1])                 # monotonic partition
+        last[2] = now
+        self._emit_interval(key, last)
+        e.intervals.append([phase, now, None])
+        self._refresh_gauge(key, e, now)
+
+    def close(self, kind: str, namespace: str, name: str,
+              ts: Optional[float] = None) -> None:
+        """End of life (the object was DELETED): close the open interval
+        and freeze the ledger — the rollup stops extending with the
+        clock, which is what the history archive snapshots."""
+        with self._lock:
+            e = self._objs.get((kind, namespace, name))
+            if e is None or e.closed or not e.intervals:
+                return
+            now = self._now() if ts is None else ts
+            last = e.intervals[-1]
+            if last[2] is None:
+                last[2] = max(now, last[1])
+                self._emit_interval((kind, namespace, name), last)
+            e.closed = True
+            self._refresh_gauge((kind, namespace, name), e, last[2])
+
+    def _emit_interval(self, key: Key, interval: List[Any]) -> None:
+        if self.metrics is not None:
+            self.metrics.goodput_seconds(key[0], interval[0],
+                                         interval[2] - interval[1])
+
+    def _refresh_gauge(self, key: Key, e: _Entry, now: float) -> None:
+        if self.metrics is None:
+            return
+        roll = self._rollup_locked(key, e, now)
+        self.metrics.set_goodput_ratio(key[0], key[1], key[2],
+                                       roll["goodput_ratio"])
+
+    # -- controller-state feed (TransitionRecorder) --------------------------
+
+    def observe_state(self, kind: str, namespace: str, name: str,
+                      state: str, ts: Optional[float] = None) -> None:
+        """Fold a controller ``.status.state`` transition.  Pod-backed
+        kinds are ignored here (their authority is pod accounting via
+        ``observe_event``); pod-less kinds map controller states to
+        phases via ``_STATE_PHASES``."""
+        if kind in _POD_DRIVEN_KINDS:
+            return
+        phase = _STATE_PHASES.get(kind, {}).get(state)
+        if phase is None:
+            return
+        self.transition(kind, namespace, name, phase, ts)
+
+    # -- store watch feed ----------------------------------------------------
+
+    def observe_event(self, ev) -> None:
+        """Store watch hook (install with ``store.watch``).  Reads only;
+        never mutates the event or the store — safe under the store
+        lock, and invisible to the sim journal hash."""
+        kind = ev.kind
+        if kind == "Event":
+            return
+        obj = ev.obj
+        md = obj.get("metadata", {}) or {}
+        ns = md.get("namespace", "default")
+        name = md.get("name", "")
+        now = self._now()
+
+        if kind in _POD_DRIVEN_KINDS:
+            self._observe_tracked_cr(kind, ns, name, ev.type, obj, now)
+            return
+        if kind in _STATE_PHASES or kind == C.KIND_CRONJOB:
+            self._observe_stateful_cr(kind, ns, name, ev.type, obj, now)
+            return
+        if kind == "Pod":
+            self._observe_pod(ev.type, obj, md, ns, now)
+            return
+        # Any other owned object (head Service, Secret, Ingress…)
+        # appearing for a queued cluster means the controller has begun
+        # acting: queued -> provisioning.
+        owner = (md.get("labels", {}) or {}).get(C.LABEL_CLUSTER)
+        if owner and ev.type == "ADDED":
+            with self._lock:
+                key = (C.KIND_CLUSTER, ns, owner)
+                e = self._objs.get(key)
+                if e is not None and \
+                        self._current_phase(e) == PHASE_QUEUED:
+                    self._transition_locked(key, e, PHASE_PROVISIONING, now)
+
+    def _observe_tracked_cr(self, kind: str, ns: str, name: str,
+                            etype: str, obj: Dict[str, Any],
+                            now: float) -> None:
+        with self._lock:
+            key = (kind, ns, name)
+            if etype == "DELETED":
+                e = self._objs.get(key)
+                if e is None:
+                    return
+                self._transition_locked(key, e, PHASE_TEARDOWN, now)
+                if not e.closed and e.intervals:
+                    last = e.intervals[-1]
+                    if last[2] is None:
+                        last[2] = max(now, last[1])
+                        self._emit_interval(key, last)
+                    e.closed = True
+                    self._refresh_gauge(key, e, last[2])
+                return
+            e = self._entry(key)
+            exp = _expected_pods(obj)
+            if etype == "ADDED":
+                e.expected = exp
+                if not e.intervals:
+                    self._transition_locked(key, e, PHASE_QUEUED, now)
+                return
+            # MODIFIED
+            if obj.get("metadata", {}).get("deletionTimestamp"):
+                self._transition_locked(key, e, PHASE_TEARDOWN, now)
+                return
+            if exp is not None and e.expected is not None and \
+                    exp > e.expected and \
+                    self._current_phase(e) == PHASE_PRODUCTIVE:
+                # Capacity growth from full strength is provisioning/
+                # bootstrap of the new slices, not an interruption.
+                e.growing = True
+            e.expected = exp
+            self._recompute_locked(key, e, now)
+
+    def _observe_stateful_cr(self, kind: str, ns: str, name: str,
+                             etype: str, obj: Dict[str, Any],
+                             now: float) -> None:
+        with self._lock:
+            key = (kind, ns, name)
+            if etype == "DELETED":
+                e = self._objs.get(key)
+                if e is None:
+                    return
+                self._transition_locked(key, e, PHASE_TEARDOWN, now)
+                if not e.closed and e.intervals:
+                    last = e.intervals[-1]
+                    if last[2] is None:
+                        last[2] = max(now, last[1])
+                        self._emit_interval(key, last)
+                    e.closed = True
+                return
+            e = self._entry(key)
+            if etype == "ADDED" and not e.intervals:
+                self._transition_locked(key, e, PHASE_QUEUED, now)
+            elif obj.get("metadata", {}).get("deletionTimestamp"):
+                self._transition_locked(key, e, PHASE_TEARDOWN, now)
+
+    def _observe_pod(self, etype: str, obj: Dict[str, Any],
+                     md: Dict[str, Any], ns: str, now: float) -> None:
+        labels = md.get("labels", {}) or {}
+        cluster = labels.get(C.LABEL_CLUSTER)
+        if not cluster:
+            return
+        key = (C.KIND_CLUSTER, ns, cluster)
+        pod_name = md.get("name", "")
+        with self._lock:
+            e = self._objs.get(key)
+            if e is None or e.closed:
+                return
+            if etype == "DELETED":
+                e.pods.pop(pod_name, None)
+            else:
+                e.pods[pod_name] = (obj.get("status", {}) or {}).get(
+                    "phase", "Pending")
+            self._recompute_locked(key, e, now)
+
+    def _recompute_locked(self, key: Key, e: _Entry, now: float) -> None:
+        """The pod-accounting phase machine (TpuCluster)."""
+        if e.closed:
+            return
+        cur = self._current_phase(e)
+        if cur == PHASE_TEARDOWN:
+            return
+        down = any(p in _POD_DOWN_PHASES for p in e.pods.values())
+        n_running = sum(1 for p in e.pods.values() if p == "Running")
+        n_starting = len(e.pods) - n_running - sum(
+            1 for p in e.pods.values() if p in _POD_DOWN_PHASES)
+        exp = e.expected
+        if exp == 0:
+            # Suspend: draining counts as teardown, parked as queued.
+            nxt = PHASE_QUEUED if not e.pods else PHASE_TEARDOWN
+            self._transition_locked(key, e, nxt, now)
+            return
+        full = (n_running > 0 and not down and n_starting == 0
+                and (exp is None or n_running >= exp))
+        if full:
+            e.reached_productive = True
+            e.growing = False
+            nxt = PHASE_PRODUCTIVE
+        elif down:
+            # A host down before first full strength is still bootstrap
+            # (the bring-up has not completed); after it, the whole
+            # slice's step time is lost: interrupted.
+            nxt = (PHASE_INTERRUPTED if e.reached_productive
+                   else PHASE_BOOTSTRAP)
+        elif not e.reached_productive:
+            if not e.pods:
+                return                          # still queued/provisioning
+            nxt = PHASE_BOOTSTRAP
+        elif e.growing:
+            nxt = PHASE_BOOTSTRAP
+        elif cur in (PHASE_INTERRUPTED, PHASE_RECOVERY):
+            # Failed pods cleared, replacements coming up.
+            nxt = PHASE_RECOVERY
+        else:
+            # Capacity silently dropped below full strength (delete
+            # race, vanished pod): the slice is down.
+            nxt = PHASE_INTERRUPTED
+        self._transition_locked(key, e, nxt, now)
+
+    # -- querying ------------------------------------------------------------
+
+    def keys(self) -> List[Key]:
+        with self._lock:
+            return list(self._objs)
+
+    def intervals(self, kind: str, namespace: str, name: str
+                  ) -> List[Dict[str, Any]]:
+        with self._lock:
+            e = self._objs.get((kind, namespace, name))
+            if e is None:
+                return []
+            return [{"phase": p, "start": s, "end": t}
+                    for p, s, t in e.intervals]
+
+    def _rollup_locked(self, key: Key, e: _Entry,
+                       now: Optional[float] = None) -> Dict[str, Any]:
+        now = self._now() if now is None else now
+        phases = {p: 0.0 for p in PHASES}
+        start = e.intervals[0][1] if e.intervals else None
+        end = start
+        for p, s, t in e.intervals:
+            t = s if t is None and now < s else (now if t is None else t)
+            phases[p] += t - s
+            end = t
+        total = (end - start) if start is not None else 0.0
+        productive = phases[PHASE_PRODUCTIVE]
+        return {
+            "kind": key[0], "namespace": key[1], "name": key[2],
+            "phases": phases,
+            "start": start, "end": end, "total": total,
+            "goodput_ratio": (productive / total) if total > 0 else 0.0,
+            "current_phase": self._current_phase(e),
+            "closed": e.closed,
+        }
+
+    def rollup(self, kind: str, namespace: str, name: str,
+               now: Optional[float] = None) -> Optional[Dict[str, Any]]:
+        """Per-phase seconds + goodput ratio.  Open intervals extend to
+        ``now``; ``sum(phases) == total`` by construction."""
+        with self._lock:
+            key = (kind, namespace, name)
+            e = self._objs.get(key)
+            if e is None:
+                return None
+            return self._rollup_locked(key, e, now)
+
+    def to_doc(self, kind: str, namespace: str, name: str
+               ) -> Optional[Dict[str, Any]]:
+        """The archive document (``meta/{ns}/{cluster}/goodput.json``):
+        interval list + rollup, JSON-ready."""
+        roll = self.rollup(kind, namespace, name)
+        if roll is None:
+            return None
+        return {"kind": kind, "namespace": namespace, "name": name,
+                "intervals": self.intervals(kind, namespace, name),
+                "rollup": roll}
+
+    def to_dict(self) -> Dict[str, Any]:
+        """Whole-ledger snapshot (sim failure reports / export_trace)."""
+        out = {}
+        for kind, ns, name in self.keys():
+            doc = self.to_doc(kind, ns, name)
+            if doc is not None:
+                out[f"{kind}/{ns}/{name}"] = doc
+        return out
+
+
+class TransitionRecorder:
+    """The single seam controller ``.status.state``/phase writes route
+    through (analysis rule #7 ``phase-transition-recorded``): records
+    the transition on the flight ring (source=controller, alongside the
+    watch-derived record) and feeds the goodput ledger — with the
+    recorder's server-side clock, never the caller's."""
+
+    enabled = True
+
+    def __init__(self, flight=None, ledger=None, clock=None):
+        self.flight = flight
+        self.ledger = ledger
+        self._now = clock.now if clock is not None else time.time
+
+    def record(self, kind: str, namespace: str, name: str, new_state: str,
+               old_state: str = "") -> None:
+        ts = self._now()
+        if self.flight is not None:
+            self.flight.record(kind, namespace, name, "state",
+                               f"{old_state or '<none>'} -> "
+                               f"{new_state or '<none>'}",
+                               source="controller")
+        if self.ledger is not None:
+            self.ledger.observe_state(kind, namespace, name, new_state, ts)
+
+
+class NoopTransitionRecorder:
+    """Default for every controller ``transitions=`` parameter: the
+    annotation costs one attribute lookup when the ledger is off."""
+
+    enabled = False
+
+    def record(self, kind: str, namespace: str, name: str, new_state: str,
+               old_state: str = "") -> None:
+        pass
+
+
+NOOP_TRANSITIONS = NoopTransitionRecorder()

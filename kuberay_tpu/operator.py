@@ -62,12 +62,20 @@ class Operator:
         features.set_gates(self.config.featureGates)
         self.store = store or ObjectStore()
         self.metrics = ControlPlaneMetrics()
-        # Observability (kuberay_tpu.obs): always on — both are bounded
-        # ring/LRU structures, and the /debug/traces + /debug/flight
-        # surface is the production "where did the time go" story.
-        from kuberay_tpu.obs import FlightRecorder, Tracer
+        # Observability (kuberay_tpu.obs): always on — all bounded
+        # ring/LRU structures; /debug/traces + /debug/flight answer
+        # "where did the time go" per reconcile, /debug/goodput answers
+        # it per job lifetime (productive vs. lost seconds).
+        from kuberay_tpu.obs import (FlightRecorder, GoodputLedger, Tracer,
+                                     TransitionRecorder)
         self.tracer = Tracer()
         self.flight = FlightRecorder()
+        self.goodput = GoodputLedger(metrics=self.metrics)
+        self.transitions = TransitionRecorder(flight=self.flight,
+                                              ledger=self.goodput)
+        # The ledger folds every store event (CR lifecycle + pod phase
+        # accounting); controllers feed state writes via ``transitions``.
+        self._goodput_cancel = self.store.watch(self.goodput.observe_event)
         self.recorder = EventRecorder(self.store)
         self.manager = Manager(self.store, metrics=self.metrics,
                                tracer=self.tracer, flight=self.flight)
@@ -90,22 +98,25 @@ class Operator:
             recorder=self.recorder, scheduler=scheduler,
             config_env=self.config.defaultPodEnv, metrics=self.metrics,
             use_openshift_route=self.config.useOpenShiftRoute,
-            tracer=self.tracer)
+            tracer=self.tracer, transitions=self.transitions)
         self.job_controller = TpuJobController(
             self.store, recorder=self.recorder,
             client_provider=provider,
             scheduler=scheduler, metrics=self.metrics,
-            tracer=self.tracer)
+            tracer=self.tracer, transitions=self.transitions)
         self.service_controller = TpuServiceController(
             self.store, recorder=self.recorder,
             client_provider=lambda cname, status: provider(status),
-            tracer=self.tracer)
+            tracer=self.tracer, transitions=self.transitions)
         self.cronjob_controller = TpuCronJobController(
             self.store, recorder=self.recorder, tracer=self.tracer)
         self.networkpolicy_controller = NetworkPolicyController(self.store)
         self.warmpool_controller = WarmSlicePoolController(
             self.store, recorder=self.recorder, tracer=self.tracer)
-        self.autoscaler = SliceAutoscaler(self.store)
+        from kuberay_tpu.controlplane.autoscaler import DecisionAudit
+        self.autoscaler_audit = DecisionAudit(metrics=self.metrics)
+        self.autoscaler = SliceAutoscaler(self.store,
+                                          audit=self.autoscaler_audit)
 
         m = self.manager
         m.register(C.KIND_CLUSTER, self._timed(C.KIND_CLUSTER,
@@ -160,7 +171,8 @@ class Operator:
             from kuberay_tpu.history.server import HistoryCollector
             from kuberay_tpu.history.storage import backend_from_url
             self.history_collector = HistoryCollector(
-                self.store, backend_from_url(self.config.historyArchiveURL))
+                self.store, backend_from_url(self.config.historyArchiveURL),
+                goodput=self.goodput)
         self._stop = threading.Event()
         self.apiserver = None
         self.api_url = ""
@@ -200,7 +212,8 @@ class Operator:
             history = HistoryServer(self.history_collector.storage)
         self.apiserver, self.api_url = serve_background(
             self.store, api_host, api_port, metrics=self.metrics,
-            history=history, tracer=self.tracer, flight=self.flight)
+            history=history, tracer=self.tracer, flight=self.flight,
+            goodput=self.goodput, autoscaler=self.autoscaler_audit)
         if leader_election:
             self.elector = LeaderElector(
                 self.store,
@@ -284,6 +297,7 @@ class Operator:
         self._stop_reconcilers()
         if self.elector is not None:
             self.elector.stop()
+        self._goodput_cancel()
         if self.history_collector is not None:
             self.history_collector.close()
         if self.apiserver is not None:

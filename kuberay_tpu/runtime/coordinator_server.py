@@ -207,11 +207,16 @@ class CoordinatorServer:
     def __init__(self, state: Optional[StateBackend] = None,
                  log_dir: str = "/tmp/tpu-coordinator-logs",
                  spawn_jobs: bool = True,
-                 auth_token: Optional[str] = None):
+                 auth_token: Optional[str] = None,
+                 goodput=None):
         # Bearer auth (ref cluster token auth): token comes from the
         # operator-minted Secret via the TPU_AUTH_TOKEN env.
         self.auth_token = (auth_token if auth_token is not None
                            else os.environ.get("TPU_AUTH_TOKEN", ""))
+        # Optional obs.GoodputLedger: job lifecycle events feed per-job
+        # wall-clock attribution, stamped with THIS server's clock
+        # (received_at) — never the client's.
+        self.goodput = goodput
         self.state = state or backend_from_env()
         self.log_dir = log_dir
         self.spawn_jobs = spawn_jobs
@@ -315,18 +320,31 @@ class CoordinatorServer:
 
     def record_events(self, events) -> int:
         """Ingest task/step/profile events (a dict or list of dicts).
-        Each gets a server timestamp if it lacks one."""
+
+        Client timestamps (``ts``) are KEPT but never used for ordering
+        or attribution: every event is stamped with a server-side
+        ``received_at`` (this process's clock, overwriting anything the
+        client sent) plus a monotonic ``received_seq`` — the authority
+        downstream consumers (archive merge, goodput attribution) order
+        and attribute by, so a skewed client clock cannot rewrite
+        history."""
         if isinstance(events, dict):
             events = [events]
         n = 0
         now = time.time()
+        feed = []
         with self._lock:
             for ev in events:
                 if not isinstance(ev, dict):
                     continue
-                ev.setdefault("ts", now)
+                ev.setdefault("ts", now)        # client clock, display only
                 ev.setdefault("type", "task")
                 self._event_seq += 1
+                # Server-side stamps are authoritative: overwrite, never
+                # setdefault — a client-supplied received_at is exactly
+                # the clock-skew lie this field exists to prevent.
+                ev["received_at"] = now
+                ev["received_seq"] = self._event_seq
                 # Honor a client-supplied id so a POST retried after a
                 # lost response dedups in the collector's archive instead
                 # of landing twice under distinct server-minted ids.
@@ -335,7 +353,21 @@ class CoordinatorServer:
                 if not (isinstance(ev.get("id"), str) and ev["id"]):
                     ev["id"] = f"{self._event_boot}-{self._event_seq}"
                 self.events.append(ev)
+                if self.goodput is not None and ev.get("job_id"):
+                    feed.append(ev)
                 n += 1
+        # Goodput feed outside the lock (the ledger has its own): job
+        # lifecycle boundaries attributed at the server's receive time.
+        for ev in feed:
+            jid = ev["job_id"]
+            if ev.get("name") == "job_started":
+                self.goodput.transition("CoordinatorJob", "head", jid,
+                                        "productive", ts=ev["received_at"])
+            elif ev.get("name") == "job_finished":
+                self.goodput.transition("CoordinatorJob", "head", jid,
+                                        "teardown", ts=ev["received_at"])
+                self.goodput.close("CoordinatorJob", "head", jid,
+                                   ts=ev["received_at"])
         return n
 
     def list_events(self, job_id: Optional[str] = None,
@@ -351,10 +383,15 @@ class CoordinatorServer:
                metadata=None) -> JobRecord:
         with self._lock:
             if job_id in self.jobs:
+                # Idempotent resubmission: the existing record answers,
+                # and the goodput ledger must NOT regress to queued.
                 return self.jobs[job_id]
             rec = JobRecord(job_id, entrypoint, runtime_env, metadata)
             self.jobs[job_id] = rec
             self._persist_job(rec)
+        if self.goodput is not None:
+            self.goodput.transition("CoordinatorJob", "head", job_id,
+                                    "queued")
         if self.spawn_jobs:
             self._spawn(rec)
         return rec

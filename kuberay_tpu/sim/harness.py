@@ -45,7 +45,13 @@ from kuberay_tpu.controlplane.warmpool_controller import (
     LABEL_WARM_POOL,
     WarmSlicePoolController,
 )
-from kuberay_tpu.obs import FlightRecorder, NOOP_TRACER, Tracer
+from kuberay_tpu.obs import (
+    FlightRecorder,
+    GoodputLedger,
+    NOOP_TRACER,
+    Tracer,
+    TransitionRecorder,
+)
 from kuberay_tpu.runtime.coordinator_client import FakeCoordinatorClient
 from kuberay_tpu.sim.clock import VirtualClock, patch_time
 from kuberay_tpu.sim.faults import (
@@ -111,7 +117,8 @@ class SimHarness:
                  fault_profile: Optional[Dict[str, float]] = None,
                  settle_horizon: float = 45.0,
                  max_settle_rounds: int = 400,
-                 trace: bool = False):
+                 trace: bool = False,
+                 goodput: bool = False):
         self.seed = seed
         self.scenario = scenario
         self.settle_horizon = settle_horizon
@@ -143,6 +150,19 @@ class SimHarness:
         # replay-invariance contract tests/test_obs_trace.py enforces.
         self.tracer = Tracer(clock=self.clock) if trace else NOOP_TRACER
         self.flight = FlightRecorder(clock=self.clock) if trace else None
+        # Goodput ledger (obs.goodput): observational only — it reads
+        # watch events and the virtual clock, never the store or rng, so
+        # the journal hash is byte-identical with the ledger on or off
+        # (the exactness + invariance contract in tests/test_goodput.py).
+        self.goodput = (GoodputLedger(clock=self.clock,
+                                      metrics=self.metrics)
+                        if goodput else None)
+        transitions = (TransitionRecorder(flight=self.flight,
+                                          ledger=self.goodput,
+                                          clock=self.clock)
+                       if goodput else None)
+        self._goodput_cancel = (self.store.watch(self.goodput.observe_event)
+                                if goodput else None)
         # Deterministic event emission (obs satellite): virtual-clock
         # eventTime + counter names replace wall time and uuid4, so a
         # seed replays with identical Event objects across processes.
@@ -171,15 +191,16 @@ class SimHarness:
         self.cluster_controller = TpuClusterController(
             self.store, expectations=self.manager.expectations,
             recorder=self.recorder, metrics=self.metrics,
-            tracer=self.tracer)
+            tracer=self.tracer, transitions=transitions)
         self.job_controller = TpuJobController(
             self.store, recorder=self.recorder,
             client_provider=lambda status: provider(status),
-            metrics=self.metrics, tracer=self.tracer)
+            metrics=self.metrics, tracer=self.tracer,
+            transitions=transitions)
         self.service_controller = TpuServiceController(
             self.store, recorder=self.recorder,
             client_provider=lambda cname, status: provider(cname, status),
-            tracer=self.tracer)
+            tracer=self.tracer, transitions=transitions)
         self.cronjob_controller = TpuCronJobController(
             self.store, recorder=self.recorder, tracer=self.tracer)
         self.warmpool_controller = WarmSlicePoolController(
@@ -215,6 +236,8 @@ class SimHarness:
 
     def close(self):
         self.store.set_interposer(None)
+        if self._goodput_cancel is not None:
+            self._goodput_cancel()
         self.kubelet.close()
         features.reset()
         self._patch.__exit__(None, None, None)
@@ -268,6 +291,7 @@ class SimHarness:
             "spans": self.tracer.export(),
             "events": list(self.journal),
             "flight": self.flight.to_dict() if self.flight else {},
+            "goodput": self.goodput.to_dict() if self.goodput else {},
         }
 
     # -- convergence -------------------------------------------------------

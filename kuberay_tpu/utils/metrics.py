@@ -160,6 +160,18 @@ class ControlPlaneMetrics:
         r.describe("tpu_slice_ready_duration_seconds",
                    "Seconds from slice creation to all hosts running "
                    "(north-star metric)")
+        r.describe("tpu_goodput_seconds_total",
+                   "Wall-clock seconds attributed to each goodput phase "
+                   "per CR kind (queued/provisioning/bootstrap/productive/"
+                   "interrupted/recovery/teardown); fed by closed ledger "
+                   "intervals, so phases sum to attributed lifetime")
+        r.describe("tpu_goodput_ratio",
+                   "Per-object goodput ratio: productive seconds over "
+                   "total attributed lifetime (0..1)")
+        r.describe("tpu_autoscaler_decisions_total",
+                   "Autoscaler scale decisions per kind and direction "
+                   "(up/down); the last-N decision audit ring at "
+                   "/debug/autoscaler carries the input signals")
 
     def observe_provisioned(self, cluster: str, seconds: float):
         self.registry.observe("tpu_cluster_provisioned_duration_seconds",
@@ -178,6 +190,20 @@ class ControlPlaneMetrics:
             self.registry.set_gauge(
                 "tpu_cluster_state", 1.0 if s == state else 0.0,
                 {"cluster": cluster, "state": s or "provisioning"})
+
+    def goodput_seconds(self, kind: str, phase: str, seconds: float):
+        self.registry.inc("tpu_goodput_seconds_total",
+                          {"kind": kind, "phase": phase}, value=seconds)
+
+    def set_goodput_ratio(self, kind: str, namespace: str, name: str,
+                          ratio: float):
+        self.registry.set_gauge("tpu_goodput_ratio", ratio,
+                                {"kind": kind, "namespace": namespace,
+                                 "name": name})
+
+    def autoscaler_decision(self, kind: str, direction: str):
+        self.registry.inc("tpu_autoscaler_decisions_total",
+                          {"kind": kind, "direction": direction})
 
     def reconcile(self, kind: str, seconds: float):
         self.registry.inc("tpu_reconcile_total", {"kind": kind})

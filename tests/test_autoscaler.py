@@ -5,6 +5,7 @@ import pytest
 
 from kuberay_tpu.api.tpucluster import AutoscalerOptions
 from kuberay_tpu.controlplane.autoscaler import (
+    DecisionAudit,
     SliceAutoscaler,
     SliceInfo,
     apply_decisions,
@@ -132,6 +133,69 @@ def test_slice_autoscaler_demand_from_jobs():
     assert h.cluster().spec.workerGroupSpecs[0].replicas == 3
 
 
+def test_decision_audit_records_signals_and_verdict():
+    """Every applied decision lands in the bounded audit ring — input
+    signals (demand, per-slice idleness) next to the verdict — and
+    increments tpu_autoscaler_decisions_total{kind,direction}."""
+    from kuberay_tpu.utils.metrics import ControlPlaneMetrics
+
+    h = Harness()
+    c = make_autoscaling_cluster(replicas=1)
+    h.store.create(c.to_dict())
+    h.settle()
+    h.store.create({
+        "apiVersion": C.API_VERSION, "kind": C.KIND_JOB,
+        "metadata": {"name": "big", "namespace": "default"},
+        "spec": {"entrypoint": "x", "clusterSpec": {
+            "workerGroupSpecs": [{"groupName": "workers", "replicas": 3}]}},
+        "status": {"clusterName": "demo", "jobDeploymentStatus": "Running"},
+    })
+    metrics = ControlPlaneMetrics()
+    audit = DecisionAudit(metrics=metrics)
+    auto = SliceAutoscaler(h.store, audit=audit)
+    assert auto.reconcile("demo")
+    assert len(audit) == 1 and audit.total == 1
+    entry = audit.to_list()[0]
+    assert entry["cluster"] == "demo" and entry["group"] == "workers"
+    assert entry["direction"] == "up"
+    assert entry["replicas_before"] == 1 and entry["replicas_after"] == 2
+    assert entry["applied"] is True
+    assert entry["signals"]["demand"] == 3
+    assert "slices" in entry["signals"]
+    text = metrics.render()
+    assert ('tpu_autoscaler_decisions_total{direction="up",'
+            'kind="TpuCluster"} 1.0') in text
+
+    # Downscale decisions audit with the idle-slice evidence.
+    h.settle()
+    h.store.delete(C.KIND_JOB, "big")
+    cluster = h.cluster()
+    slices = [SliceInfo(f"demo-workers-{i}", "workers", True, 999)
+              for i in range(2)]
+    decisions = decide(cluster, demand={}, slices=slices, idle_timeout=60)
+    for d in decisions:
+        audit.record("default", "demo", d, current=2, demand={},
+                     slices=slices, applied=False)
+    down = audit.to_list()[0]              # newest first
+    assert down["direction"] == "down"
+    assert down["slices_to_delete"]
+    assert down["signals"]["slices"][0]["idle_seconds"] == 999
+    assert ('tpu_autoscaler_decisions_total{direction="down",'
+            'kind="TpuCluster"} 1.0') in metrics.render()
+
+
+def test_decision_audit_ring_is_bounded():
+    audit = DecisionAudit(capacity=4)
+    from kuberay_tpu.controlplane.autoscaler import GroupDecision
+    for i in range(10):
+        audit.record("default", "demo",
+                     GroupDecision("workers", i + 1, [], "test"),
+                     current=i, demand={}, slices=[], applied=False)
+    assert len(audit) == 4 and audit.total == 10
+    newest = audit.to_list()[0]
+    assert newest["replicas_after"] == 10
+
+
 @pytest.mark.timeout(60)
 def test_sidecar_live_process_patches_replicas():
     """The builder's injected command (`python -m
@@ -166,6 +230,8 @@ def test_sidecar_live_process_patches_replicas():
             env={**os.environ, "TPU_AUTOSCALER_IDLE_TIMEOUT": "0"})
         assert out.returncode == 0, out.stdout + out.stderr
         assert "patched demo" in out.stdout, out.stdout + out.stderr
+        # The decision audit emits each verdict as a JSON log line.
+        assert "autoscaler decision:" in out.stdout, out.stdout
         obj = backing.get(C.KIND_CLUSTER, "demo")
         assert obj["spec"]["workerGroupSpecs"][0]["replicas"] == 2
     finally:

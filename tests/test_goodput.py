@@ -1,0 +1,333 @@
+"""Goodput/badput ledger: per-job wall-clock attribution (ISSUE 4).
+
+The acceptance gates:
+
+1. **Sim-gated exactness** — under the virtual clock, seeded fault
+   schedules (kill-a-follower + slow recovery, slow-start bootstrap)
+   produce exact-second attribution: intervals partition the run (no
+   gaps, no overlaps, sum(phases) == elapsed) and interrupted+recovery
+   equals the fault window the schedule implies, to the second.
+2. **Replay invariance** — the journal hash of a chaos run is
+   byte-identical with the ledger on or off.
+3. **Post-mortem survival** — a deleted cluster's goodput doc survives
+   via the history archive and `HistoryServer` GET returns the same
+   rollup.
+4. The live `/debug/goodput` + `/debug/autoscaler` operator surface.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from kuberay_tpu.controlplane.store import ObjectStore
+from kuberay_tpu.history.server import HistoryCollector, HistoryServer
+from kuberay_tpu.history.storage import LocalStorage
+from kuberay_tpu.obs import GoodputLedger, TransitionRecorder
+from kuberay_tpu.obs.goodput import PHASES
+from kuberay_tpu.sim.faults import FaultPlan
+from kuberay_tpu.sim.harness import SimHarness
+from kuberay_tpu.sim.scenarios import get_scenario, make_cluster_obj
+from kuberay_tpu.utils import constants as C
+
+QUIET = {f: 0.0 for f in FaultPlan(0).profile}
+
+
+def _assert_partition(intervals, now, total_expected=None):
+    """Intervals must partition [start, end]: contiguous (each end IS
+    the next start), monotonic, no gaps, no overlaps."""
+    assert intervals, "empty ledger"
+    prev_end = intervals[0]["start"]
+    for iv in intervals:
+        assert iv["start"] == prev_end, \
+            f"gap/overlap at {iv}: start != previous end {prev_end}"
+        end = iv["end"] if iv["end"] is not None else now
+        assert end >= iv["start"]
+        prev_end = iv["end"] if iv["end"] is not None else now
+    if total_expected is not None:
+        assert prev_end - intervals[0]["start"] == \
+            pytest.approx(total_expected, abs=1e-6)
+
+
+def _assert_rollup_exact(roll):
+    """The exclusivity/exhaustiveness contract: every phase key
+    present, sum(phases) == total exactly."""
+    assert set(roll["phases"]) == set(PHASES)
+    assert sum(roll["phases"].values()) == pytest.approx(
+        roll["total"], abs=1e-6)
+    assert 0.0 <= roll["goodput_ratio"] <= 1.0
+
+
+# ---------------------------------------------------------------------------
+# sim-gated exactness
+# ---------------------------------------------------------------------------
+
+@pytest.mark.timeout(120)
+def test_kill_a_follower_exact_second_attribution():
+    """The seeded schedule: kill a follower at t=X, the controller
+    reacts at X+5 (slice deleted + recreated), replacements slow-start
+    +40s.  The ledger must attribute exactly 5s interrupted + 40s
+    recovery — the fault window — and partition the whole run."""
+    with SimHarness(0, fault_profile=QUIET, goodput=True) as h:
+        t0 = h.clock.now()
+        h.store.create(make_cluster_obj("demo", topology="2x2x2",
+                                        replicas=1))
+        h.settle()
+        roll = h.goodput.rollup("TpuCluster", "default", "demo")
+        assert roll["current_phase"] == "productive"
+
+        h.clock.advance(30.0)              # 30 productive seconds
+        t_kill = h.clock.now()
+        workers = sorted(
+            p["metadata"]["name"] for p in h.store.list("Pod")
+            if p["metadata"]["labels"].get(C.LABEL_GROUP) == "workers")
+        assert len(workers) == 2           # 2x2x2 v5p = 2 hosts
+        h.kubelet.fail_pod(workers[1])     # the follower dies at t=X
+
+        h.clock.advance(5.0)               # detection -> reaction delay
+        h.manager.run_until_idle()         # slice deleted + recreated
+        pending = [p["metadata"]["name"] for p in h.store.list("Pod")
+                   if p.get("status", {}).get("phase",
+                                              "Pending") == "Pending"]
+        assert pending                     # replacements exist, not up
+        for name in pending:               # slow-start +40s
+            h.kubelet.hold_pod(name, until=h.clock.now() + 40.0)
+        h.settle(horizon=120.0)
+
+        now = h.clock.now()
+        roll = h.goodput.rollup("TpuCluster", "default", "demo", now=now)
+        intervals = h.goodput.intervals("TpuCluster", "default", "demo")
+
+    _assert_partition(intervals, now, total_expected=now - t0)
+    _assert_rollup_exact(roll)
+    assert roll["total"] == pytest.approx(now - t0, abs=1e-6)
+    # Exact-second attribution of the schedule: 30s productive before
+    # the kill, 5s interrupted (kill -> reaction), 40s recovery
+    # (slow-start hold), productive again after.
+    assert roll["phases"]["interrupted"] == pytest.approx(5.0, abs=1e-3)
+    assert roll["phases"]["recovery"] == pytest.approx(40.0, abs=1e-3)
+    fault_window = roll["phases"]["interrupted"] + roll["phases"]["recovery"]
+    assert fault_window == pytest.approx(45.0, abs=1e-3)
+    assert roll["phases"]["productive"] == pytest.approx(
+        roll["total"] - fault_window, abs=1e-3)
+    assert roll["current_phase"] == "productive"
+    # The phase sequence tells the story in order.
+    seq = [iv["phase"] for iv in intervals]
+    assert seq == ["queued", "provisioning", "bootstrap", "productive",
+                   "interrupted", "recovery", "productive"]
+
+
+@pytest.mark.timeout(120)
+def test_slow_start_bootstrap_attribution():
+    """Slow-start +40s on one host of a fresh slice: the whole 40s is
+    bootstrap (multi-host bring-up gated on the slowest TPU_WORKER_ID),
+    and the run still partitions exactly."""
+    with SimHarness(0, fault_profile=QUIET, goodput=True) as h:
+        t0 = h.clock.now()
+        h.store.create(make_cluster_obj("demo", topology="2x2x2",
+                                        replicas=1))
+        h.manager.run_until_idle()         # pods created, none running
+        workers = sorted(
+            p["metadata"]["name"] for p in h.store.list("Pod")
+            if p["metadata"]["labels"].get(C.LABEL_GROUP) == "workers")
+        h.kubelet.hold_pod(workers[0], until=h.clock.now() + 40.0)
+        h.settle(horizon=120.0)
+
+        now = h.clock.now()
+        roll = h.goodput.rollup("TpuCluster", "default", "demo", now=now)
+        intervals = h.goodput.intervals("TpuCluster", "default", "demo")
+
+        _assert_partition(intervals, now, total_expected=now - t0)
+        _assert_rollup_exact(roll)
+        assert roll["phases"]["bootstrap"] == pytest.approx(40.0, abs=1e-3)
+        assert roll["phases"]["interrupted"] == 0.0
+        assert roll["phases"]["recovery"] == 0.0
+        assert roll["current_phase"] == "productive"
+
+        # Deletion freezes the ledger: teardown closes, the rollup stops
+        # extending with the clock.
+        h.store.delete("TpuCluster", "demo")
+        h.settle()
+        end = h.clock.now()
+        roll = h.goodput.rollup("TpuCluster", "default", "demo")
+        assert roll["closed"] and roll["current_phase"] == "teardown"
+        h.clock.advance(1000.0)
+        assert h.goodput.rollup("TpuCluster", "default",
+                                "demo")["total"] == roll["total"]
+        assert roll["end"] <= end
+
+
+@pytest.mark.timeout(300)
+def test_journal_hash_invariant_with_ledger_on_or_off():
+    """The replay contract: rolling-upgrade seed 0 produces a
+    byte-identical journal hash with the goodput ledger on and off —
+    the ledger is purely observational."""
+    with SimHarness(0, scenario=get_scenario("rolling-upgrade"),
+                    goodput=True) as h:
+        with_ledger = h.run(2)
+        export = h.export_trace()
+    with SimHarness(0, scenario=get_scenario("rolling-upgrade")) as h:
+        without = h.run(2)
+    assert with_ledger.ok and without.ok
+    assert with_ledger.journal_hash == without.journal_hash
+    assert with_ledger.journal_len == without.journal_len
+    # The export artifact carries the ledger snapshot, JSON-ready.
+    assert export["goodput"]
+    json.dumps(export)
+
+
+# ---------------------------------------------------------------------------
+# post-mortem: the history archive round-trip
+# ---------------------------------------------------------------------------
+
+def _pod(name, cluster, phase="Pending"):
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": name, "namespace": "default",
+                         "labels": {C.LABEL_CLUSTER: cluster}},
+            "spec": {}, "status": {"phase": phase}}
+
+
+@pytest.mark.timeout(60)
+def test_deleted_cluster_goodput_survives_history_archive(tmp_path):
+    """Archive -> HistoryServer GET -> same rollup: the time-loss
+    breakdown outlives the cluster."""
+    store = ObjectStore()
+    ledger = GoodputLedger()
+    cancel = store.watch(ledger.observe_event)
+    storage = LocalStorage(str(tmp_path / "arch"))
+    collector = HistoryCollector(store, storage, goodput=ledger)
+    try:
+        store.create(make_cluster_obj("demo", accelerator="v5e",
+                                      topology="2x2", replicas=1))
+        # 2x2 v5e = 1 host -> expected pods = head + 1 worker.
+        for name in ("demo-head", "demo-workers-0-0"):
+            store.create(_pod(name, "demo"))
+            pod = store.get("Pod", name)
+            pod["status"] = {"phase": "Running"}
+            store.update_status(pod)
+        roll_live = ledger.rollup("TpuCluster", "default", "demo")
+        assert roll_live["current_phase"] == "productive"
+        store.delete("TpuCluster", "demo")
+    finally:
+        collector.close()          # drains the archive queue
+        cancel()
+
+    frozen = ledger.rollup("TpuCluster", "default", "demo")
+    assert frozen["closed"]
+
+    hs = HistoryServer(storage)
+    code, body, is_text = hs.route("/api/history/goodput/default/demo")
+    assert code == 200 and not is_text
+    assert body["kind"] == "TpuCluster"
+    # Same rollup as the (closed, frozen) in-memory ledger.
+    assert body["rollup"]["phases"] == frozen["phases"]
+    assert body["rollup"]["total"] == frozen["total"]
+    assert body["rollup"]["closed"]
+    seq = [iv["phase"] for iv in body["intervals"]]
+    assert seq[0] == "queued" and seq[-1] == "teardown"
+    # Also reachable through the generic meta listing.
+    code, meta, _ = hs.route("/api/history/meta/default/demo")
+    assert code == 200 and "goodput.json" in meta
+
+    # Unknown cluster -> 404, not a crash.
+    code, _, _ = hs.route("/api/history/goodput/default/nope")
+    assert code == 404
+
+
+# ---------------------------------------------------------------------------
+# live operator surface
+# ---------------------------------------------------------------------------
+
+@pytest.mark.timeout(120)
+def test_operator_debug_goodput_and_autoscaler_endpoints():
+    from kuberay_tpu.operator import Operator
+
+    op = Operator(fake_kubelet=True)
+    url = op.start(api_port=0)
+    try:
+        op.store.create(make_cluster_obj("smoke", topology="2x2x2",
+                                         replicas=1))
+        for _ in range(6):
+            op.run_until_idle()
+        assert op.store.get("TpuCluster", "smoke")["status"]["state"] == \
+            "ready"
+        with urllib.request.urlopen(f"{url}/debug/goodput") as r:
+            listing = json.load(r)
+        rows = {(o["kind"], o["name"]): o for o in listing["objects"]}
+        assert rows[("TpuCluster", "smoke")]["current_phase"] == "productive"
+        with urllib.request.urlopen(
+                f"{url}/debug/goodput/TpuCluster/default/smoke") as r:
+            doc = json.load(r)
+        _assert_rollup_exact(doc["rollup"])
+        _assert_partition(doc["intervals"], time.time())
+        with urllib.request.urlopen(f"{url}/debug/autoscaler") as r:
+            audit = json.load(r)
+        assert "decisions" in audit
+        # The metric catalog carries the new series.
+        with urllib.request.urlopen(f"{url}/metrics") as r:
+            text = r.read().decode()
+        assert "tpu_goodput_seconds_total" in text
+        assert 'tpu_goodput_ratio{kind="TpuCluster"' in text
+        # Unknown object -> 404.
+        try:
+            urllib.request.urlopen(
+                f"{url}/debug/goodput/TpuCluster/default/nope")
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        op.stop()
+
+
+# ---------------------------------------------------------------------------
+# coordinator feed: server-side timestamps only
+# ---------------------------------------------------------------------------
+
+def test_coordinator_goodput_feed_ignores_client_clocks():
+    from kuberay_tpu.runtime.coordinator_server import (CoordinatorServer,
+                                                        MemoryBackend)
+
+    ledger = GoodputLedger()
+    coord = CoordinatorServer(state=MemoryBackend(), spawn_jobs=False,
+                              goodput=ledger)
+    t0 = time.time()
+    coord.submit("j1", "echo hi")
+    # Client clocks are wildly skewed (past AND future): attribution
+    # must come from the server's receive time regardless.
+    coord.record_events({"job_id": "j1", "name": "job_started",
+                         "ts": 17.0})
+    coord.record_events({"job_id": "j1", "name": "job_finished",
+                         "ts": t0 + 9e9})
+    roll = ledger.rollup("CoordinatorJob", "head", "j1")
+    assert roll["closed"]
+    seq = [iv["phase"] for iv in ledger.intervals("CoordinatorJob",
+                                                  "head", "j1")]
+    assert seq == ["queued", "productive", "teardown"]
+    # Interval stamps are server wall-clock, not the client's 17.0 /
+    # far-future lies.
+    assert t0 - 5 <= roll["start"] <= time.time() + 5
+    assert t0 - 5 <= roll["end"] <= time.time() + 5
+    _assert_rollup_exact(roll)
+
+
+def test_transition_recorder_feeds_ledger_and_flight():
+    from kuberay_tpu.obs import FlightRecorder
+
+    ledger = GoodputLedger()
+    flight = FlightRecorder()
+    rec = TransitionRecorder(flight=flight, ledger=ledger)
+    rec.record("TpuJob", "default", "train", "Initializing",
+               old_state="New")
+    rec.record("TpuJob", "default", "train", "Running",
+               old_state="Initializing")
+    seq = [iv["phase"] for iv in ledger.intervals("TpuJob", "default",
+                                                  "train")]
+    assert seq == ["provisioning", "productive"]
+    records = flight.timeline("TpuJob", "default", "train")
+    assert [r["detail"] for r in records if r["type"] == "state"] == \
+        ["New -> Initializing", "Initializing -> Running"]
+    assert all(r.get("source") == "controller" for r in records
+               if r["type"] == "state")

@@ -90,6 +90,37 @@ def test_drop_labeled_removes_counters_gauges_and_histograms():
     assert 'tpu_test_seconds_count{cluster="keep"}' in text
 
 
+def test_goodput_and_autoscaler_catalog_renders():
+    """Golden exposition for the goodput/autoscaler series: counter +
+    gauge families, sorted labels, HELP/TYPE headers exactly once."""
+    m = ControlPlaneMetrics()
+    m.goodput_seconds("TpuCluster", "productive", 12.5)
+    m.goodput_seconds("TpuCluster", "interrupted", 2.5)
+    m.set_goodput_ratio("TpuCluster", "default", "demo", 0.75)
+    m.autoscaler_decision("TpuCluster", "up")
+    m.autoscaler_decision("TpuCluster", "up")
+    m.autoscaler_decision("TpuCluster", "down")
+    text = m.render()
+    assert "# TYPE tpu_goodput_seconds_total counter" in text
+    assert ('tpu_goodput_seconds_total{kind="TpuCluster",'
+            'phase="productive"} 12.5') in text
+    assert ('tpu_goodput_seconds_total{kind="TpuCluster",'
+            'phase="interrupted"} 2.5') in text
+    assert "# TYPE tpu_goodput_ratio gauge" in text
+    # Labels render sorted: kind, name, namespace.
+    assert ('tpu_goodput_ratio{kind="TpuCluster",name="demo",'
+            'namespace="default"} 0.75') in text
+    assert "# TYPE tpu_autoscaler_decisions_total counter" in text
+    assert ('tpu_autoscaler_decisions_total{direction="up",'
+            'kind="TpuCluster"} 2.0') in text
+    assert ('tpu_autoscaler_decisions_total{direction="down",'
+            'kind="TpuCluster"} 1.0') in text
+    for family in ("tpu_goodput_seconds_total", "tpu_goodput_ratio",
+                   "tpu_autoscaler_decisions_total"):
+        assert text.count(f"# TYPE {family} ") == 1
+        assert f"# HELP {family} " in text
+
+
 def test_controlplane_metrics_catalog_renders():
     m = ControlPlaneMetrics()
     m.observe_slice_ready("demo", "workers", 12.5)

@@ -76,6 +76,31 @@ def test_serve_config_and_status(coord):
     assert client.get_serve_apps()["llm"]["status"] == "RUNNING"
 
 
+def test_record_events_server_side_received_at_beats_skewed_clients():
+    """Regression: every ingested event is stamped with a server-side
+    ``received_at`` + monotonic ``received_seq``; client ``ts`` values
+    (kept for display) and even a client-forged ``received_at`` never
+    drive ordering or attribution."""
+    server = CoordinatorServer(state=MemoryBackend(), spawn_jobs=False)
+    t0 = time.time()
+    # Client A's clock is a day ahead; client B's is decades behind; one
+    # event even forges received_at.
+    n = server.record_events([
+        {"ts": t0 + 86400, "name": "late-clock", "job_id": "j"},
+        {"ts": 17.0, "name": "early-clock", "job_id": "j",
+         "received_at": 1.0, "received_seq": 999999},
+    ])
+    assert n == 2
+    evs = server.list_events(job_id="j")
+    # Arrival order preserved; server stamps overwrite forged ones.
+    assert [e["name"] for e in evs] == ["late-clock", "early-clock"]
+    for e in evs:
+        assert t0 - 5 <= e["received_at"] <= time.time() + 5
+    assert evs[0]["received_seq"] < evs[1]["received_seq"]
+    # Client timestamps survive untouched for display.
+    assert evs[0]["ts"] == t0 + 86400 and evs[1]["ts"] == 17.0
+
+
 def test_head_restart_recovery(tmp_path):
     """File backend: job registry survives a head restart; in-flight jobs
     are marked FAILED (the operator's retry machinery takes over)."""

@@ -34,7 +34,8 @@ def _rules_fired(src, only=None, **kw):
 def test_rule_registry_complete():
     assert {"rv-precondition", "lock-discipline", "blocking-under-lock",
             "exception-swallow", "tpu-env-completeness",
-            "requeue-observability"} <= set(RULES)
+            "requeue-observability",
+            "phase-transition-recorded"} <= set(RULES)
     for cls in RULES.values():
         assert cls.DESCRIPTION and cls.INVARIANT
 
@@ -562,6 +563,76 @@ def test_requeue_observability_log_error_is_not_evidence():
                     return 5.0
     """)
     assert "requeue-observability" in fired
+
+
+# ---------------------------------------------------------------------------
+# phase-transition-recorded
+# ---------------------------------------------------------------------------
+
+def test_phase_transition_flags_unrecorded_state_write():
+    findings, fired = _rules_fired("""
+        class C:
+            def _update_status(self, cluster):
+                status = cluster.status
+                status.state = "ready"
+    """)
+    assert "phase-transition-recorded" in fired
+    assert "'state'" in findings[0].message
+
+
+def test_phase_transition_flags_job_deployment_status():
+    _, fired = _rules_fired("""
+        def _to(self, job, state):
+            job.status.jobDeploymentStatus = state
+            self._update(job)
+    """)
+    assert "phase-transition-recorded" in fired
+
+
+def test_phase_transition_flags_subscript_state_write():
+    _, fired = _rules_fired("""
+        def _set_status(self, obj, state):
+            st = obj.setdefault("status", {})
+            st["state"] = state
+    """)
+    assert "phase-transition-recorded" in fired
+
+
+def test_phase_transition_quiet_when_recorded():
+    _, fired = _rules_fired("""
+        class C:
+            def _update_status(self, cluster):
+                status = cluster.status
+                self.transitions.record(self.KIND, "default",
+                                        cluster.name, "ready",
+                                        old_state=status.state)
+                status.state = "ready"
+    """)
+    assert "phase-transition-recorded" not in fired
+
+
+def test_phase_transition_ignores_non_status_state_attrs():
+    """``self.state = backend`` (the coordinator's state backend) and
+    plain dict writes without a status receiver are not CR phases."""
+    _, fired = _rules_fired("""
+        class Coord:
+            def __init__(self, state):
+                self.state = state or backend_from_env()
+
+            def run(self):
+                d = {}
+                d["state"] = "whatever"
+    """)
+    assert "phase-transition-recorded" not in fired
+
+
+def test_phase_transition_accepts_observe_state_evidence():
+    _, fired = _rules_fired("""
+        def _sync(self, job, ledger):
+            ledger.observe_state("TpuJob", "ns", job.name, "Running")
+            job.status.jobDeploymentStatus = "Running"
+    """)
+    assert "phase-transition-recorded" not in fired
 
 
 # ---------------------------------------------------------------------------

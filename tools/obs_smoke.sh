@@ -1,12 +1,15 @@
 #!/usr/bin/env sh
 # Observability smoke gate: boot the operator against the fake kubelet,
 # drive a cluster to Ready, scrape /metrics and /debug/traces (+ the
-# flight recorder), and assert both parse — the standing check that the
-# Prometheus exposition and the span export stay machine-readable:
+# flight recorder, the goodput ledger and the autoscaler audit), and
+# assert everything parses — the standing check that the Prometheus
+# exposition, the span export and the goodput rollup stay
+# machine-readable:
 #
 #   tools/obs_smoke.sh
 #
-# See docs/observability.md for the span model and the metric catalog.
+# See docs/observability.md for the span model, the goodput phase
+# contract and the metric catalog.
 set -eu
 cd "$(dirname "$0")/.."
 exec timeout -k 10 300 env JAX_PLATFORMS=cpu python - <<'EOF'
@@ -53,9 +56,35 @@ try:
         flight = json.load(resp)
     assert flight["records"], "flight recorder empty for the cluster"
 
+    # Goodput ledger: the rollup parses, phases partition the object's
+    # wall-clock exactly (sum == total), and the cluster is productive.
+    with urllib.request.urlopen(f"{url}/debug/goodput") as resp:
+        listing = json.load(resp)
+    assert any(o["kind"] == "TpuCluster" and o["name"] == "smoke"
+               for o in listing["objects"]), listing
+    with urllib.request.urlopen(
+            f"{url}/debug/goodput/TpuCluster/default/smoke") as resp:
+        good = json.load(resp)
+    roll = good["rollup"]
+    assert roll["current_phase"] == "productive", roll
+    phase_sum = sum(roll["phases"].values())
+    assert abs(phase_sum - roll["total"]) < 1e-6, \
+        f"phases {phase_sum} != elapsed {roll['total']}"
+    assert "tpu_goodput_seconds_total" in text, \
+        "goodput series missing from /metrics"
+
+    # Autoscaler decision audit: mounted and parseable (no decisions
+    # expected for a static cluster, but the ring must answer).
+    with urllib.request.urlopen(f"{url}/debug/autoscaler") as resp:
+        audit = json.load(resp)
+    assert "decisions" in audit, audit
+
     print(f"obs smoke ok: {len(doc['spans'])} spans, "
           f"{len(text.splitlines())} metric lines, "
-          f"{len(flight['records'])} flight records")
+          f"{len(flight['records'])} flight records, "
+          f"goodput ratio {roll['goodput_ratio']:.2f} over "
+          f"{len(good['intervals'])} intervals, "
+          f"{len(audit['decisions'])} autoscaler decisions")
 finally:
     op.stop()
 EOF
