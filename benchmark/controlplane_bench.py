@@ -1,0 +1,267 @@
+"""Control-plane hot-path micro-benchmark: the scale-up storm.
+
+Measures the store -> workqueue -> reconcile pipeline under the
+workload the ROADMAP's 10k-cluster north star cares about: K TpuClusters
+x N hosts created at once, pods run by the in-process fake kubelet,
+REAL worker threads and wall-clock time (no virtual clock) — so the
+numbers isolate exactly the paths the indexed-store/CoW-read/off-lock
+fan-out/workqueue overhaul touches (docs/performance.md).
+
+    python benchmark/controlplane_bench.py --clusters 24 --workers 4
+    python benchmark/controlplane_bench.py --clusters 24 --workers 1 \
+        --dispatch sync
+
+Emits ONE JSON object on stdout:
+
+    {"events_per_sec": ..., "reconciles_per_sec": ...,
+     "reconcile_p50_ms": ..., "reconcile_p99_ms": ...,
+     "store_write_p99_ms": ..., ...}
+
+Runs against older checkouts too (``--dispatch`` degrades gracefully
+when the store predates dispatch modes), which is how the before/after
+table in docs/performance.md was produced.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+
+sys.path.insert(0, ".")
+
+from kuberay_tpu.controlplane.cluster_controller import TpuClusterController  # noqa: E402
+from kuberay_tpu.controlplane.fake_kubelet import FakeKubelet  # noqa: E402
+from kuberay_tpu.controlplane.manager import Manager, owned_pod_mapper  # noqa: E402
+from kuberay_tpu.controlplane.store import ObjectStore  # noqa: E402
+from kuberay_tpu.utils import constants as C  # noqa: E402
+from kuberay_tpu.utils.metrics import ControlPlaneMetrics  # noqa: E402
+
+
+def _template(role: str) -> dict:
+    """A production-shaped pod template (env, resources, annotations):
+    read-path cost scales with object size, so a toy template would
+    flatter whole-object-copy implementations."""
+    return {
+        "metadata": {
+            "labels": {"app.kubernetes.io/part-of": "storm-bench",
+                       "role": role},
+            "annotations": {
+                "prometheus.io/scrape": "true",
+                "prometheus.io/port": "8080",
+                "cluster-autoscaler.kubernetes.io/safe-to-evict": "false",
+            },
+        },
+        "spec": {
+            "containers": [{
+                "name": role, "image": "rt:bench",
+                "command": ["python", "-m", "kuberay_tpu.runtime.worker"],
+                "env": [{"name": f"BENCH_ENV_{j}", "value": f"v{j}"}
+                        for j in range(16)],
+                "ports": [{"name": "grpc", "containerPort": 50051},
+                          {"name": "metrics", "containerPort": 8080}],
+                "resources": {
+                    "requests": {"cpu": "8", "memory": "32Gi",
+                                 "google.com/tpu": "4"},
+                    "limits": {"cpu": "8", "memory": "32Gi",
+                               "google.com/tpu": "4"},
+                },
+            }],
+            "nodeSelector": {"cloud.google.com/gke-spot": "false"},
+            "tolerations": [{"key": "google.com/tpu", "operator": "Exists",
+                             "effect": "NoSchedule"}],
+        },
+    }
+
+
+def cluster_manifest(i: int, topology: str, slices: int) -> dict:
+    return {
+        "apiVersion": C.API_VERSION, "kind": C.KIND_CLUSTER,
+        "metadata": {"name": f"storm-{i:04d}", "namespace": "default"},
+        "spec": {
+            "headGroupSpec": {"template": _template("head")},
+            "workerGroupSpecs": [{
+                "groupName": "workers", "accelerator": "v5p",
+                "topology": topology, "replicas": slices,
+                "maxReplicas": max(slices, 1),
+                "template": _template("worker")}],
+        },
+    }
+
+
+def quantile(sorted_samples, q: float) -> float:
+    """Interpolated quantile (same convention as serve_bench)."""
+    if not sorted_samples:
+        return 0.0
+    idx = q * (len(sorted_samples) - 1)
+    lo, hi = int(idx), min(int(idx) + 1, len(sorted_samples) - 1)
+    frac = idx - lo
+    return sorted_samples[lo] * (1 - frac) + sorted_samples[hi] * frac
+
+
+class _AdmissionScheduler:
+    """Gang-admission stand-in with the latency profile of a real batch
+    scheduler adapter (Volcano/YuniKorn/Kai all do a network round-trip
+    per submission): reconciles that admit clusters BLOCK for
+    ``delay_s``.  This is the component multi-worker reconcile overlaps
+    — a pure-CPU storm is GIL-serialized and hides that win."""
+
+    def __init__(self, delay_s: float):
+        self.delay_s = delay_s
+
+    def on_cluster_submission(self, cluster: dict) -> bool:
+        if self.delay_s > 0:
+            time.sleep(self.delay_s)
+        return True
+
+    def add_metadata(self, cluster: dict, pod: dict) -> None:
+        pass
+
+    def cleanup(self, cluster: dict) -> None:
+        pass
+
+
+class _Timed:
+    """Wall-clock sample collector for a wrapped callable."""
+
+    def __init__(self, fn):
+        self.fn = fn
+        self.samples = []
+        self._lock = threading.Lock()
+
+    def __call__(self, *args, **kwargs):
+        t0 = time.perf_counter()
+        try:
+            return self.fn(*args, **kwargs)
+        finally:
+            dt = time.perf_counter() - t0
+            with self._lock:
+                self.samples.append(dt)
+
+
+def build_store(dispatch: str) -> ObjectStore:
+    try:
+        return ObjectStore(dispatch=dispatch)
+    except TypeError:
+        # Pre-overhaul store (the "before" leg of docs/performance.md):
+        # no dispatch modes, always-inline fan-out.
+        return ObjectStore()
+
+
+def run_storm(clusters: int, slices: int, topology: str, workers: int,
+              dispatch: str, timeout: float,
+              sched_latency_ms: float = 2.0) -> dict:
+    store = build_store(dispatch)
+    metrics = ControlPlaneMetrics()
+    manager = Manager(store, metrics=metrics)
+    controller = TpuClusterController(
+        store, expectations=manager.expectations, metrics=metrics,
+        scheduler=_AdmissionScheduler(sched_latency_ms / 1e3))
+    reconcile = _Timed(controller.reconcile)
+    manager.register(C.KIND_CLUSTER, reconcile)
+    manager.map_owned(owned_pod_mapper)
+    kubelet = FakeKubelet(store)
+
+    # Store-write latency: every mutating verb the storm exercises.
+    writes = _Timed(None)
+
+    def timed(fn):
+        def wrapper(*args, **kwargs):
+            t0 = time.perf_counter()
+            try:
+                return fn(*args, **kwargs)
+            finally:
+                dt = time.perf_counter() - t0
+                with writes._lock:
+                    writes.samples.append(dt)
+        return wrapper
+
+    store.create = timed(store.create)
+    store.update = timed(store.update)          # update_status routes here
+    store.delete = timed(store.delete)
+
+    stop = threading.Event()
+
+    def kubelet_loop():
+        while not stop.is_set():
+            kubelet.step()
+            stop.wait(0.002)
+
+    kt = threading.Thread(target=kubelet_loop, daemon=True,
+                          name="bench-kubelet")
+
+    manager.start(workers=workers)
+    kt.start()
+    t0 = time.perf_counter()
+    for i in range(clusters):
+        store.create(cluster_manifest(i, topology, slices))
+    create_phase = time.perf_counter() - t0
+
+    deadline = t0 + timeout
+    ready = 0
+    while time.perf_counter() < deadline:
+        ready = sum(1 for c in store.list(C.KIND_CLUSTER)
+                    if c.get("status", {}).get("state") == "ready")
+        if ready >= clusters:
+            break
+        time.sleep(0.01)
+    elapsed = time.perf_counter() - t0
+    stop.set()
+    manager.stop()
+    kt.join(timeout=2.0)
+    kubelet.close()
+    if hasattr(store, "close"):
+        store.close()
+
+    rec = sorted(reconcile.samples)
+    wr = sorted(writes.samples)
+    events = store.resource_version()
+    return {
+        "workload": {"clusters": clusters, "slices_per_cluster": slices,
+                     "topology": topology, "pods": store.count("Pod"),
+                     "workers": workers, "dispatch": dispatch,
+                     "sched_latency_ms": sched_latency_ms},
+        "ready_clusters": ready,
+        "converged": ready >= clusters,
+        "elapsed_s": round(elapsed, 3),
+        "create_phase_s": round(create_phase, 3),
+        "events": events,
+        "events_per_sec": round(events / elapsed, 1),
+        "reconciles": len(rec),
+        "reconciles_per_sec": round(len(rec) / elapsed, 1),
+        "reconcile_p50_ms": round(quantile(rec, 0.50) * 1e3, 3),
+        "reconcile_p99_ms": round(quantile(rec, 0.99) * 1e3, 3),
+        "store_writes": len(wr),
+        "store_write_p50_ms": round(quantile(wr, 0.50) * 1e3, 3),
+        "store_write_p99_ms": round(quantile(wr, 0.99) * 1e3, 3),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="scale-up-storm control-plane benchmark")
+    ap.add_argument("--clusters", type=int, default=24)
+    ap.add_argument("--slices", type=int, default=2,
+                    help="worker slices per cluster")
+    ap.add_argument("--topology", default="2x2x2",
+                    help="v5p slice topology (2x2x2 = 2 hosts/slice)")
+    ap.add_argument("--workers", type=int, default=4)
+    ap.add_argument("--dispatch", default="async",
+                    choices=("sync", "async"))
+    ap.add_argument("--timeout", type=float, default=120.0)
+    ap.add_argument("--sched-latency-ms", type=float, default=2.0,
+                    help="blocking gang-admission latency per cluster "
+                         "reconcile (models the batch-scheduler network "
+                         "round-trip; 0 disables)")
+    args = ap.parse_args(argv)
+    result = run_storm(args.clusters, args.slices, args.topology,
+                       args.workers, args.dispatch, args.timeout,
+                       sched_latency_ms=args.sched_latency_ms)
+    print(json.dumps(result, sort_keys=True))
+    return 0 if result["converged"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -707,7 +707,135 @@ class PhaseTransitionRecordedRule(Rule):
 
 
 # ---------------------------------------------------------------------------
-# 7. tpu-env-completeness
+# 7. no-io-under-store-lock
+# ---------------------------------------------------------------------------
+
+_SERIALIZE_CALLS = {"json.dumps", "json.dump"}
+_JOURNAL_IO_ATTRS = {"append", "appendleft", "write", "flush", "fsync"}
+_FANOUT_ITER_TOKENS = ("watcher", "_subs", "subscriber")
+
+
+@rule
+class NoIoUnderStoreLockRule(Rule):
+    """Nothing slow runs inside a store's primary mutex (``self._lock``)
+    critical sections: JSON serialization, journal appends/fsyncs, and
+    watcher-callback dispatch all serialize EVERY reader and writer in
+    the process behind one mutation when they run under the lock — the
+    exact scaling cliff the off-lock fan-out/journal refactor removed
+    (docs/performance.md).  Under the lock a mutator may only mutate
+    maps and append to in-memory queues; serialization, I/O and
+    callbacks drain after release (or on a dispatcher thread).
+
+    Scoped to the attribute ``self._lock`` on purpose: auxiliary locks
+    (``_journal_lock``, ``_dispatch_lock``) exist precisely to serialize
+    that I/O off the hot mutex.
+    """
+
+    NAME = "no-io-under-store-lock"
+    DESCRIPTION = ("no json.dumps / journal append / watcher dispatch "
+                   "inside a ``self._lock`` critical section")
+    INVARIANT = ("store mutation-lock hold times cover map updates only "
+                 "— serialization, journal I/O and watch fan-out run "
+                 "off-lock")
+
+    def check(self, tree: ast.Module, ctx: FileContext) -> Iterable[Finding]:
+        for cls in iter_classes(tree):
+            model = _ClassLockModel(cls)
+            if "_lock" not in model.lock_attrs:
+                continue
+            primary = _PrimaryLockScanner(cls, model)
+            for kind, fname, node, method in primary.held_hits:
+                if kind == "serialize":
+                    yield self.finding(
+                        ctx, node,
+                        f"'{fname}' serializes under the '{cls.name}' "
+                        f"primary lock in {method}(); queue the record "
+                        "and serialize after release")
+                elif kind == "journal":
+                    yield self.finding(
+                        ctx, node,
+                        f"journal I/O '{fname}' under the '{cls.name}' "
+                        f"primary lock in {method}(); append to the "
+                        "journal queue and drain off-lock")
+                else:
+                    yield self.finding(
+                        ctx, node,
+                        f"watcher callback dispatched under the "
+                        f"'{cls.name}' primary lock in {method}(); "
+                        "enqueue the delivery and drain it outside the "
+                        "lock (sync drain or dispatcher thread)")
+
+
+class _PrimaryLockScanner:
+    """Walk a class tracking regions that hold ``self._lock``
+    specifically (unlike :class:`_ClassLockModel`, which treats all lock
+    attrs alike) and record serialization / journal-I/O / watcher-
+    dispatch calls inside them.  Methods whose every call site holds the
+    primary lock (per the model's fixpoint) are scanned as held."""
+
+    def __init__(self, cls: ast.ClassDef, model: _ClassLockModel):
+        self.model = model
+        self.held_hits: List[Tuple[str, str, ast.AST, str]] = []
+        for name, fn in model.methods.items():
+            # The shared fixpoint can't tell WHICH lock wraps every call
+            # site, so only trust it when the primary lock is the
+            # class's sole lock; otherwise require an explicit with.
+            inherited = (name in model.held_methods
+                         and model.lock_attrs == {"_lock"})
+            self._scan(fn, name, inherited)
+
+    def _is_primary(self, expr: ast.AST) -> bool:
+        return dotted(expr) == "self._lock"
+
+    def _scan(self, fn, method: str, held: bool) -> None:
+        def walk(node: ast.AST, held: bool, fanout_vars: frozenset) -> None:
+            if isinstance(node, ast.With):
+                inner = held or any(self._is_primary(item.context_expr)
+                                    for item in node.items)
+                for child in node.body:
+                    walk(child, inner, fanout_vars)
+                return
+            if isinstance(node, ast.For) and held:
+                iter_names = {n.attr.lower() for n in ast.walk(node.iter)
+                              if isinstance(n, ast.Attribute)}
+                iter_names |= {n.id.lower() for n in ast.walk(node.iter)
+                               if isinstance(n, ast.Name)}
+                if any(tok in name for tok in _FANOUT_ITER_TOKENS
+                       for name in iter_names):
+                    bound = {t.id for t in ast.walk(node.target)
+                             if isinstance(t, ast.Name)}
+                    fanout_vars = fanout_vars | frozenset(bound)
+            if isinstance(node, ast.Call) and held:
+                self._check_call(node, method, fanout_vars)
+            for child in ast.iter_child_nodes(node):
+                walk(child, held, fanout_vars)
+
+        for child in ast.iter_child_nodes(fn):
+            walk(child, held, frozenset())
+
+    def _check_call(self, call: ast.Call, method: str,
+                    fanout_vars: frozenset) -> None:
+        fname = dotted(call.func)
+        if fname in _SERIALIZE_CALLS:
+            self.held_hits.append(("serialize", fname, call, method))
+            return
+        if isinstance(call.func, ast.Attribute) and \
+                call.func.attr in _JOURNAL_IO_ATTRS and \
+                "journal" in dotted(call.func.value).lower():
+            self.held_hits.append(("journal", fname, call, method))
+            return
+        # fn(ev) / w(ev) / sub.fn(ev) where the callable came out of a
+        # watchers/subscribers iteration in this held region.
+        base = call.func
+        while isinstance(base, ast.Attribute):
+            base = base.value
+        if isinstance(base, ast.Name) and base.id in fanout_vars:
+            self.held_hits.append(("dispatch", fname or base.id, call,
+                                   method))
+
+
+# ---------------------------------------------------------------------------
+# 8. tpu-env-completeness
 # ---------------------------------------------------------------------------
 
 _ENV_GROUP = {"TPU_WORKER_ID", "TPU_WORKER_HOSTNAMES", "TPU_TOPOLOGY"}

@@ -1,8 +1,13 @@
 """Controller manager: watch -> work queue -> reconcile loops.
 
 Level-triggered like controller-runtime (ref main.go:309-343 registration +
-mgr.Start): store watch events map to (kind, namespace, name) keys, a
-deduplicating work queue feeds reconcilers, requeue-after is honored.
+mgr.Start): store watch events map to (kind, namespace, name) keys, the
+deduplicating per-key-serialized work queue
+(:mod:`~kuberay_tpu.controlplane.workqueue`) feeds reconcilers,
+requeue-after is honored.  Per-key serialization is what makes
+``start(workers=N)`` safe: two workers never reconcile the same key
+concurrently, and a key re-enqueued mid-flight coalesces into exactly
+one more pass.
 
 Two execution modes:
 - ``run_until_idle()``: deterministic draining for tests and embedded use
@@ -13,7 +18,6 @@ Two execution modes:
 
 from __future__ import annotations
 
-import heapq
 import logging
 import threading
 import time
@@ -21,6 +25,7 @@ from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from kuberay_tpu.controlplane.expectations import HEAD_GROUP, ScaleExpectations
 from kuberay_tpu.controlplane.store import Conflict, Event, ObjectStore
+from kuberay_tpu.controlplane.workqueue import WorkQueue
 from kuberay_tpu.obs.trace import NOOP_TRACER
 from kuberay_tpu.utils import constants as C
 
@@ -39,9 +44,11 @@ class Manager:
         # requeues schedule against it; the deterministic simulation
         # harness passes a virtual clock (kuberay_tpu.sim.clock) and
         # advances it to ``next_delayed_at()`` instead of sleeping.
+        self._clock = clock
         self._now = clock.now if clock is not None else time.time
         # Optional ControlPlaneMetrics: counts requeue-causing Conflict /
-        # Exception outcomes per kind (they were debug-log-only before).
+        # Exception outcomes per kind (they were debug-log-only before)
+        # and feeds the workqueue depth/latency series.
         self.metrics = metrics
         # Observability seams (kuberay_tpu.obs), both no-op-safe: the
         # tracer mints a TraceContext per reconcile-chain key as events
@@ -54,13 +61,10 @@ class Manager:
         self._reconcilers: Dict[str, Callable[[str, str], Optional[float]]] = {}
         # kinds whose owned objects (by label) map back to an owner kind:
         self._owned_maps: List[Callable[[Event], Optional[Key]]] = []
-        self._queue: List[Key] = []
-        self._queued: Set[Key] = set()
-        self._delayed: List[Tuple[float, Key]] = []
-        self._lock = threading.Lock()
-        self._wake = threading.Condition(self._lock)
+        self._wq = WorkQueue(now_fn=self._now, metrics=metrics)
         self._threads: List[threading.Thread] = []
         self._stop = False
+        self._stop_event = threading.Event()
         self._cancel_watch = store.watch(self._on_event)
 
     # -- registration ------------------------------------------------------
@@ -111,33 +115,13 @@ class Manager:
         # is real latency the slice-ready decomposition has to account
         # for).  queued() keeps the earliest pending instant on dedup.
         self.tracer.queued(key, self._now(), delayed=after > 0)
-        with self._lock:
-            if after > 0:
-                heapq.heappush(self._delayed, (self._now() + after, key))
-            elif key not in self._queued:
-                self._queued.add(key)
-                self._queue.append(key)
-            self._wake.notify_all()
+        if after > 0:
+            self._wq.add_after(key, after)
+        else:
+            self._wq.add(key)
 
     def _pop(self, block: bool) -> Optional[Key]:
-        with self._lock:
-            while True:
-                now = self._now()
-                while self._delayed and self._delayed[0][0] <= now:
-                    _, key = heapq.heappop(self._delayed)
-                    if key not in self._queued:
-                        self._queued.add(key)
-                        self._queue.append(key)
-                if self._queue:
-                    key = self._queue.pop(0)
-                    self._queued.discard(key)
-                    return key
-                if not block or self._stop:
-                    return None
-                timeout = None
-                if self._delayed:
-                    timeout = max(0.0, self._delayed[0][0] - now)
-                self._wake.wait(timeout=timeout or 1.0)
+        return self._wq.get(block=block)
 
     # -- execution ---------------------------------------------------------
 
@@ -145,37 +129,45 @@ class Manager:
         kind, ns, name = key
         fn = self._reconcilers.get(kind)
         if fn is None:
+            self._wq.done(key)
             return
         self.tracer.dequeued(key, self._now())
-        with self.tracer.reconcile(key, kind=kind, namespace=ns,
-                                   name=name) as span:
-            try:
-                requeue = fn(name, ns)
-            except Conflict as e:
-                # Optimistic-concurrency loss (another writer won the rv
-                # race, e.g. leader-failover overlap): routine, not an
-                # error — requeue fast so the reconciler re-reads and
-                # recomputes from fresh state (SURVEY §5.2).
-                _LOG.debug("reconcile %s %s/%s conflicted, requeueing: %s",
-                           kind, ns, name, e)
-                if self.metrics is not None:
-                    self.metrics.reconcile_conflict(kind)
-                span.error(f"conflict: {e}")
-                if self.flight is not None:
-                    self.flight.record(kind, ns, name, "conflict", str(e))
-                requeue = 0.05
-            except Exception as e:   # reconcile errors requeue with backoff
-                _LOG.exception(
-                    "reconcile %s %s/%s failed: %s", kind, ns, name, e)
-                if self.metrics is not None:
-                    self.metrics.reconcile_error(kind)
-                span.error(f"{type(e).__name__}: {e}")
-                if self.flight is not None:
-                    self.flight.record(kind, ns, name, "error",
-                                       f"{type(e).__name__}: {e}")
-                requeue = 5.0
-            if requeue:
-                span.set(requeue_after=requeue)
+        try:
+            with self.tracer.reconcile(key, kind=kind, namespace=ns,
+                                       name=name) as span:
+                try:
+                    requeue = fn(name, ns)
+                except Conflict as e:
+                    # Optimistic-concurrency loss (another writer won the rv
+                    # race, e.g. leader-failover overlap): routine, not an
+                    # error — requeue fast so the reconciler re-reads and
+                    # recomputes from fresh state (SURVEY §5.2).
+                    _LOG.debug("reconcile %s %s/%s conflicted, requeueing: %s",
+                               kind, ns, name, e)
+                    if self.metrics is not None:
+                        self.metrics.reconcile_conflict(kind)
+                    span.error(f"conflict: {e}")
+                    if self.flight is not None:
+                        self.flight.record(kind, ns, name, "conflict", str(e))
+                    requeue = 0.05
+                except Exception as e:   # reconcile errors requeue with backoff
+                    _LOG.exception(
+                        "reconcile %s %s/%s failed: %s", kind, ns, name, e)
+                    if self.metrics is not None:
+                        self.metrics.reconcile_error(kind)
+                    span.error(f"{type(e).__name__}: {e}")
+                    if self.flight is not None:
+                        self.flight.record(kind, ns, name, "error",
+                                           f"{type(e).__name__}: {e}")
+                    requeue = 5.0
+                if requeue:
+                    span.set(requeue_after=requeue)
+        finally:
+            # Release the key BEFORE scheduling the requeue: done() may
+            # immediately re-queue a dirty key, and an add_after racing
+            # a still-processing key would coalesce into dirty and fire
+            # too early.
+            self._wq.done(key)
         if requeue:
             if self.flight is not None:
                 self.flight.record(kind, ns, name, "requeue",
@@ -187,18 +179,17 @@ class Manager:
         or None when nothing is scheduled.  The sim harness advances its
         virtual clock exactly here, so backoffs fire at their true
         instants instead of being promoted en masse."""
-        with self._lock:
-            return self._delayed[0][0] if self._delayed else None
+        return self._wq.next_delayed_at()
+
+    @property
+    def _delayed(self) -> List[Tuple[float, Key]]:
+        """Scheduled timed requeues as (deadline, key) — introspection
+        for tests; the live heap is the workqueue's."""
+        return self._wq.delayed_items()
 
     def flush_delayed(self):
         """Promote ALL timed requeues immediately (tests: 'advance time')."""
-        with self._lock:
-            while self._delayed:
-                _, key = heapq.heappop(self._delayed)
-                if key not in self._queued:
-                    self._queued.add(key)
-                    self._queue.append(key)
-            self._wake.notify_all()
+        self._wq.flush_delayed()
 
     def run_until_idle(self, max_iterations: int = 1000) -> int:
         """Drain the queue deterministically; returns iterations used.
@@ -240,11 +231,22 @@ class Manager:
                                   md.get("name", "")))
             pending = still
             if pending:
-                time.sleep(delay)
+                self._sleep(delay)
                 delay = min(delay * 2, 30.0)
+
+    def _sleep(self, seconds: float):
+        """Retry backoff that honors the injected clock: a virtual clock
+        (sim) advances instead of stalling the thread, and a real-time
+        wait is interruptible by stop()."""
+        if self._clock is not None and hasattr(self._clock, "sleep"):
+            self._clock.sleep(seconds)
+        else:
+            self._stop_event.wait(seconds)
 
     def start(self, workers: int = 1):
         self._stop = False
+        self._stop_event.clear()
+        self._wq.restart()
         threading.Thread(target=self._resync_until_complete, daemon=True,
                          name="manager-resync").start()
         for i in range(workers):
@@ -261,11 +263,12 @@ class Manager:
 
     def stop(self):
         self._stop = True
-        with self._lock:
-            self._wake.notify_all()
+        self._stop_event.set()
+        self._wq.shutdown()
         for t in self._threads:
             t.join(timeout=2.0)
         self._threads.clear()
+        self._wq.restart()   # run_until_idle and a later start() still work
 
 
 def owned_pod_mapper(ev: Event) -> Optional[Key]:

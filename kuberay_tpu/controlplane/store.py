@@ -9,10 +9,41 @@ Controllers talk to this through the same verbs a K8s client exposes
 (get/list/create/update/patch-status/delete/watch), so a real-cluster
 backend can be slotted behind the same interface later.  Thread-safe:
 reconcilers run on worker threads.
+
+Hot-path design (docs/performance.md):
+
+- **Committed objects are immutable.**  Every mutator builds a new
+  object (sharing unchanged subtrees with the previous revision) and
+  swaps it in under the lock.  Reads return copy-on-write snapshots
+  (:mod:`~kuberay_tpu.controlplane.snapshot`) instead of deep copies;
+  ``deep=True`` opts back into a plain private copy.
+- **Indexed reads.**  Per-kind and per-(kind, namespace) key indexes
+  back ``list``/``count``/``kinds`` (plus the label indexes that play
+  the reference's scoped informer-cache role,
+  internal/managercache/cache.go:18), and an ownerReference uid index
+  makes cascade deletion O(dependents).
+- **Nothing slow under the mutation lock.**  ``_notify`` only appends
+  to the backlog and to per-subscriber bounded delivery queues; journal
+  records queue the same way.  Watch fan-out and journal serialization +
+  append run after the lock is released — inline on the mutating thread
+  (``dispatch="sync"``, the deterministic default the simulation
+  contract requires) or on a dispatcher thread (``dispatch="async"``,
+  the live-operator mode) — so journal fsync and reconcile work no
+  longer serialize every writer (analysis rule ``no-io-under-store-lock``).
+
+``journal_path``: optional etcd-lite durability for the standalone
+operator — every committed state change appends a CRC-framed record
+via the journal engine (native group-commit C++ writer when the
+toolchain is available, Python fallback otherwise — native/journal);
+on construction the journal replays, so CRs (and the level-triggered
+reconcile state they carry) survive operator restarts the same way CR
+status in a real cluster does (SURVEY §5.4).  The journal compacts to
+a snapshot when it grows past ``journal_compact_bytes``.
 """
 
 from __future__ import annotations
 
+import bisect
 import copy
 import json
 import logging
@@ -20,7 +51,10 @@ import os
 import threading
 import time
 import uuid
+from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from kuberay_tpu.controlplane.snapshot import snapshot
 
 _LOG = logging.getLogger("kuberay_tpu.store")
 
@@ -90,22 +124,28 @@ class Event:
         self.obj = obj
 
 
+class _Subscription:
+    """One watcher and its bounded delivery queue.  Entries are
+    ``(seq, Event)``; ``seq`` is the store-wide delivery sequence so the
+    drain can interleave multiple subscribers back into commit order."""
+
+    __slots__ = ("fn", "queue", "dropped")
+
+    def __init__(self, fn: Callable[[Event], None]):
+        self.fn = fn
+        self.queue: deque = deque()
+        self.dropped = 0
+
+
 class ObjectStore:
     """Objects are plain dicts with apiVersion/kind/metadata/spec/status —
     exactly the ``to_dict`` form of the api/ dataclasses.
 
-    Label indexing: lookups on the indexed label keys are O(matches), not
-    O(objects) — the role the reference's scoped informer caches play for
-    10k-cluster scale (internal/managercache/cache.go:18).
-
-    ``journal_path``: optional etcd-lite durability for the standalone
-    operator — every committed state change appends a CRC-framed record
-    via the journal engine (native group-commit C++ writer when the
-    toolchain is available, Python fallback otherwise — native/journal);
-    on construction the journal replays, so CRs (and the level-triggered
-    reconcile state they carry) survive operator restarts the same way CR
-    status in a real cluster does (SURVEY §5.4).  The journal compacts to
-    a snapshot when it grows past ``journal_compact_bytes``.
+    ``dispatch``: ``"sync"`` delivers watch events inline on the
+    mutating thread after the lock is released (deterministic — what
+    the chaos-sim replay contract and ``run_until_idle`` tests rely
+    on); ``"async"`` hands delivery to a dispatcher thread so writers
+    never wait on watcher work at all (the live-operator mode).
     """
 
     INDEXED_LABELS = ("tpu.dev/cluster", "tpu.dev/warm-pool",
@@ -114,11 +154,15 @@ class ObjectStore:
     def __init__(self, journal_path: str = "",
                  journal_compact_bytes: int = 64 * 1024 * 1024,
                  journal_engine: str = "auto",
-                 uid_factory: Optional[Callable[[], str]] = None):
+                 uid_factory: Optional[Callable[[], str]] = None,
+                 dispatch: str = "sync",
+                 watch_queue_max: int = 10000):
+        if dispatch not in ("sync", "async"):
+            raise ValueError(f"dispatch must be 'sync' or 'async', "
+                             f"got {dispatch!r}")
         self._lock = threading.RLock()
         self._objects: Dict[Tuple[str, str, str], Dict[str, Any]] = {}
         self._rv = 0
-        self._watchers: List[Callable[[Event], None]] = []
         # ``uid_factory``: override uid generation (default uuid4).  The
         # deterministic simulation passes a counter so replays-by-seed
         # assign identical uids across processes.
@@ -130,14 +174,43 @@ class ObjectStore:
         # records the true event — chaos applies to the informer path,
         # exactly where real watch streams lose/reorder.
         self._interposer = None
+        # -- read indexes (all maintained by _reindex) --
         # (label_key, label_value) -> set of object keys
         self._label_index: Dict[Tuple[str, str], set] = {}
+        # kind -> set of keys; (kind, namespace) -> set of keys
+        self._kind_index: Dict[str, set] = {}
+        self._kind_ns_index: Dict[Tuple[str, str], set] = {}
+        # owner uid -> insertion-ORDERED dict-as-set of dependent keys.
+        # Ordered on purpose: cascade deletion walks it, and its event
+        # order is part of the deterministic-replay journal hash — the
+        # bucket must preserve the same creation order the old
+        # full-scan (dict iteration) delivered.
+        self._owner_index: Dict[str, Dict[Tuple[str, str, str], None]] = {}
+        # -- watch fan-out --
+        self._dispatch_mode = dispatch
+        self._watch_queue_max = watch_queue_max
+        self._subs: List[_Subscription] = []
+        self._seq = 0
+        self._closed = False
+        # Serializes sync-mode drains so concurrent writers deliver in
+        # commit order; reentrant because a watcher may itself mutate
+        # the store (its nested drain runs inline).
+        self._dispatch_lock = threading.RLock()
+        self._delivery_cond = threading.Condition(self._lock)
+        # -- journal --
         self._journal = None
         self._journal_path = journal_path
         self._journal_engine = journal_engine
         self._journal_compact_bytes = journal_compact_bytes
+        # Commit-ordered journal records, serialized + appended OUTSIDE
+        # the mutation lock (committed objects are immutable, so the
+        # late json.dumps sees exactly the committed revision).
+        self._journal_queue: deque = deque()
+        self._journal_lock = threading.Lock()
         # Bounded event backlog for streaming watches: (rv, Event); rv is
         # the post-commit resourceVersion so clients resume by rv.
+        # Strictly rv-sorted — events_since/wait_for_events bisect to
+        # the resume point instead of scanning.
         self._backlog: List[Tuple[int, Event]] = []
         self._backlog_max = 10000
         self._backlog_cond = threading.Condition(self._lock)
@@ -146,6 +219,12 @@ class ObjectStore:
             self._replay_journal()
             if self._journal is None:   # legacy migration already opened it
                 self._open_journal()
+        self._dispatcher: Optional[threading.Thread] = None
+        if dispatch == "async":
+            self._dispatcher = threading.Thread(
+                target=self._dispatch_loop, daemon=True,
+                name="store-dispatcher")
+            self._dispatcher.start()
 
     # -- durability --------------------------------------------------------
     # CRC-framed binary journal via native/journal.py: the native engine
@@ -160,8 +239,7 @@ class ObjectStore:
         # unreachable to replay (it stops at the first bad frame).  Only
         # meaningful at construction — the post-compaction reopen passes
         # False (the snapshot was just written and synced by this
-        # process; a full CRC re-scan under the store lock would stall
-        # every reader for nothing).
+        # process; a full CRC re-scan would stall appenders for nothing).
         if truncate_tail:
             try:
                 size = os.path.getsize(self._journal_path)
@@ -203,22 +281,23 @@ class ObjectStore:
                 k = _key(obj.get("kind", ""), md.get("namespace", "default"),
                          md.get("name", ""))
                 old = self._objects.get(k)
-                if old is not None:
-                    self._index_remove(k, old)
                 self._objects[k] = obj
-                self._index_add(k, obj)
+                self._reindex(k, old, obj)
                 self._rv = max(self._rv, md.get("resourceVersion", 0))
             elif op == "del":
                 k = tuple(entry["key"])
                 old = self._objects.pop(k, None)
                 if old is not None:
-                    self._index_remove(k, old)
+                    self._reindex(k, old, None)
             elif op == "snapshot":
                 # Snapshot restarts the world (compaction marker); the
                 # recorded rv counter prevents resourceVersion reuse
                 # after deleted-object churn was compacted away.
                 self._objects.clear()
                 self._label_index.clear()
+                self._kind_index.clear()
+                self._kind_ns_index.clear()
+                self._owner_index.clear()
                 self._rv = max(self._rv, entry.get("rv", 0))
                 for obj in entry["objects"]:
                     md = obj.get("metadata", {})
@@ -226,7 +305,7 @@ class ObjectStore:
                              md.get("namespace", "default"),
                              md.get("name", ""))
                     self._objects[k] = obj
-                    self._index_add(k, obj)
+                    self._reindex(k, None, obj)
                     self._rv = max(self._rv,
                                    md.get("resourceVersion", 0))
 
@@ -236,20 +315,40 @@ class ObjectStore:
             self._write_snapshot()
 
     def _journal_put(self, obj):
-        if self._journal is not None:
-            self._journal.append(json.dumps({"op": "put",
-                                             "obj": obj}).encode())
-            self._maybe_compact()
+        if self._journal_path:
+            self._journal_queue.append({"op": "put", "obj": obj})
 
     def _journal_del(self, k):
-        if self._journal is not None:
-            self._journal.append(json.dumps({"op": "del",
-                                             "key": list(k)}).encode())
+        if self._journal_path:
+            self._journal_queue.append({"op": "del", "key": list(k)})
+
+    def _drain_journal(self):
+        """Serialize + append queued records, OUTSIDE the mutation lock.
+
+        Records were queued in commit order under the mutation lock and
+        the deque + journal lock preserve that order on disk; committed
+        objects are immutable, so serializing them late is race-free.  A
+        writer may drain (and thus persist) a concurrent writer's
+        records — the ack barrier below still guarantees each mutator's
+        own record is durable before its call returns.
+        """
+        if not self._journal_path:
+            return
+        with self._journal_lock:
+            while True:
+                try:
+                    rec = self._journal_queue.popleft()
+                except IndexError:
+                    break
+                j = self._journal
+                if j is not None:
+                    j.append(json.dumps(rec).encode())
             self._maybe_compact()
 
     def flush_journal(self):
         """Block until all acknowledged mutations are ON DISK (fdatasync
         via the native group-commit engine / fsync via the fallback)."""
+        self._drain_journal()
         self._journal_ack()
 
     def _journal_ack(self):
@@ -258,14 +357,22 @@ class ObjectStore:
         commit.  Lock-free read of self._journal is safe — engines no-op
         flush() after close(), and a compaction swap only closes the old
         engine after draining+syncing it, so frames appended under the
-        lock are durable on whichever engine the swap race hands us."""
+        journal lock are durable on whichever engine the swap race hands
+        us."""
         j = self._journal   # kuberay-lint: disable=lock-discipline
         if j is not None:
             j.flush()
 
     def _write_snapshot(self):
-        """Atomically replace the journal with one snapshot frame."""
+        """Atomically replace the journal with one snapshot frame.
+        Callers hold the journal lock (or are the single-threaded
+        constructor); only the brief world-copy takes the mutation
+        lock — the objects are immutable, a shallow list is a
+        consistent snapshot."""
         from kuberay_tpu.native.journal import open_journal
+        with self._lock:
+            objects = list(self._objects.values())
+            rv = self._rv
         tmp = self._journal_path + ".tmp"
         try:
             os.remove(tmp)
@@ -273,8 +380,7 @@ class ObjectStore:
             pass
         snap = open_journal(tmp, self._journal_engine)
         snap.append(json.dumps(
-            {"op": "snapshot", "rv": self._rv,
-             "objects": list(self._objects.values())}).encode())
+            {"op": "snapshot", "rv": rv, "objects": objects}).encode())
         snap.flush()
         snap.close()
         old = self._journal
@@ -304,31 +410,89 @@ class ObjectStore:
             return
         self._write_snapshot()
 
-    def _index_add(self, key, obj):
-        labels = obj.get("metadata", {}).get("labels", {}) or {}
-        for lk in self.INDEXED_LABELS:
-            lv = labels.get(lk)
-            if lv is not None:
-                self._label_index.setdefault((lk, lv), set()).add(key)
+    # -- indexes -----------------------------------------------------------
 
-    def _index_remove(self, key, obj):
+    @classmethod
+    def _index_labels(cls, obj) -> List[Tuple[str, str]]:
         labels = obj.get("metadata", {}).get("labels", {}) or {}
-        for lk in self.INDEXED_LABELS:
-            lv = labels.get(lk)
-            if lv is not None:
-                bucket = self._label_index.get((lk, lv))
-                if bucket is not None:
-                    bucket.discard(key)
-                    if not bucket:
-                        del self._label_index[(lk, lv)]
+        return [(lk, labels[lk]) for lk in cls.INDEXED_LABELS
+                if labels.get(lk) is not None]
 
-    # -- helpers -----------------------------------------------------------
+    @staticmethod
+    def _index_owners(obj) -> List[str]:
+        return [ref["uid"] for ref in
+                (obj.get("metadata", {}).get("ownerReferences") or [])
+                if ref.get("uid")]
+
+    def _reindex(self, key, old, new):
+        """Move ``key`` between index buckets to reflect ``old`` -> ``new``
+        (either side may be None for create/delete).  Unchanged
+        memberships are left in place, which both skips work on the
+        common label-free update and preserves each owner bucket's
+        insertion order (the cascade-delete determinism contract)."""
+        if old is not None and new is None:
+            bucket = self._kind_index.get(key[0])
+            if bucket is not None:
+                bucket.discard(key)
+                if not bucket:
+                    del self._kind_index[key[0]]
+            ns_bucket = self._kind_ns_index.get((key[0], key[1]))
+            if ns_bucket is not None:
+                ns_bucket.discard(key)
+                if not ns_bucket:
+                    del self._kind_ns_index[(key[0], key[1])]
+        elif old is None and new is not None:
+            self._kind_index.setdefault(key[0], set()).add(key)
+            self._kind_ns_index.setdefault((key[0], key[1]), set()).add(key)
+
+        old_labels = set(self._index_labels(old)) if old else set()
+        new_labels = set(self._index_labels(new)) if new else set()
+        for lk, lv in old_labels - new_labels:
+            bucket = self._label_index.get((lk, lv))
+            if bucket is not None:
+                bucket.discard(key)
+                if not bucket:
+                    del self._label_index[(lk, lv)]
+        for lk, lv in new_labels - old_labels:
+            self._label_index.setdefault((lk, lv), set()).add(key)
+
+        old_owners = set(self._index_owners(old)) if old else set()
+        new_owners = set(self._index_owners(new)) if new else set()
+        for uid in old_owners - new_owners:
+            bucket = self._owner_index.get(uid)
+            if bucket is not None:
+                bucket.pop(key, None)
+                if not bucket:
+                    del self._owner_index[uid]
+        for uid in new_owners - old_owners:
+            self._owner_index.setdefault(uid, {})[key] = None
+
+    def _commit(self, key, old, new):
+        """Swap the new immutable revision in and record it: indexes,
+        journal queue.  Mutation lock held by the caller."""
+        self._objects[key] = new
+        self._reindex(key, old, new)
+        self._journal_put(new)
+
+    # -- watch fan-out -----------------------------------------------------
 
     def _next_rv(self) -> int:
         self._rv += 1
         return self._rv
 
     def _notify(self, ev: Event):
+        """Record + enqueue one committed event.  Runs under the
+        mutation lock but does NO delivery and NO I/O: it appends to the
+        rv-sorted backlog and to each subscriber's bounded queue
+        (drop-oldest on overflow — a level-triggered subscriber recovers
+        via resync, and ``dropped`` counts the loss).  The actual
+        callbacks run off-lock in :meth:`_drain_deliveries` or the
+        dispatcher thread."""
+        # Consumers get a CoW view, not the committed object: a watcher
+        # (or /watch long-poller) that mutates ev.obj must never reach
+        # committed state.  One shared wrapper per event, like the one
+        # shared deepcopy the old fan-out handed every watcher.
+        ev.obj = snapshot(ev.obj)
         self._backlog.append((self._rv, ev))
         if len(self._backlog) > self._backlog_max:
             del self._backlog[: len(self._backlog) - self._backlog_max]
@@ -340,15 +504,104 @@ class ObjectStore:
             # (duplicate) or stash the event for deferred redelivery.
             deliveries = self._interposer.on_event(ev)
         for dev in deliveries:
-            for w in list(self._watchers):
-                try:
-                    w(dev)
-                except Exception:
-                    # Watcher errors never poison the store — but a watcher
-                    # that throws on every event is a wedged controller, so
-                    # it must show up in logs, not vanish.
-                    _LOG.exception("store watcher failed on %s %s",
-                                   dev.type, dev.kind)
+            self._seq += 1
+            seq = self._seq
+            for sub in self._subs:
+                if len(sub.queue) >= self._watch_queue_max:
+                    sub.queue.popleft()
+                    sub.dropped += 1
+                sub.queue.append((seq, dev))
+        if deliveries and self._subs:
+            self._delivery_cond.notify_all()
+
+    def _next_delivery(self):
+        """Earliest queued (fn, event) across subscribers, or None.
+        Mutation lock held by the caller; the global seq restores commit
+        order across per-subscriber queues."""
+        best_seq = None
+        best_sub = None
+        for sub in self._subs:
+            if sub.queue:
+                seq = sub.queue[0][0]
+                if best_seq is None or seq < best_seq:
+                    best_seq, best_sub = seq, sub
+        if best_sub is None:
+            return None
+        _, ev = best_sub.queue.popleft()
+        return best_sub.fn, ev
+
+    def _deliver(self, fn, ev):
+        try:
+            fn(ev)
+        except Exception:
+            # Watcher errors never poison the store — but a watcher
+            # that throws on every event is a wedged controller, so
+            # it must show up in logs, not vanish.
+            _LOG.exception("store watcher failed on %s %s",
+                           ev.type, ev.kind)
+
+    def _drain_deliveries(self):
+        """Sync-dispatch delivery: the mutating thread drains every
+        queued delivery in commit order, outside the mutation lock.  The
+        dispatch lock is reentrant on purpose — a watcher that mutates
+        the store drains its own events inline, preserving the exact
+        nested delivery order the pre-fan-out store had."""
+        if self._dispatch_mode != "sync":
+            return
+        with self._dispatch_lock:
+            while True:
+                with self._lock:
+                    item = self._next_delivery()
+                if item is None:
+                    return
+                self._deliver(*item)
+
+    def _dispatch_loop(self):
+        """Async-dispatch delivery thread."""
+        while True:
+            with self._lock:
+                item = self._next_delivery()
+                while item is None:
+                    if self._closed:
+                        return
+                    self._delivery_cond.wait(timeout=1.0)
+                    item = self._next_delivery()
+            self._deliver(*item)
+
+    def _finish_write(self):
+        """Post-commit tail of every public mutator, outside the
+        mutation lock: journal serialization + append, sync-mode watch
+        delivery, then the durable-ack barrier."""
+        self._drain_journal()
+        self._drain_deliveries()
+        self._journal_ack()
+
+    def flush_watch(self, timeout: float = 5.0) -> bool:
+        """Wait until every subscriber queue is empty (async-dispatch
+        helper for tests/benchmarks); returns False on timeout."""
+        deadline = time.time() + timeout
+        while True:
+            self._drain_deliveries()
+            with self._lock:
+                if not any(sub.queue for sub in self._subs):
+                    return True
+            if time.time() >= deadline:
+                return False
+            time.sleep(0.001)
+
+    def watch_dropped_total(self) -> int:
+        """Deliveries lost to subscriber-queue overflow (drop-oldest)."""
+        with self._lock:
+            return sum(sub.dropped for sub in self._subs)
+
+    def close(self):
+        """Stop the async dispatcher (no-op for sync stores)."""
+        with self._lock:
+            self._closed = True
+            self._delivery_cond.notify_all()
+        if self._dispatcher is not None:
+            self._dispatcher.join(timeout=2.0)
+            self._dispatcher = None
 
     def set_interposer(self, interposer) -> None:
         """Install (or clear, with None) the fault-injection interposer.
@@ -375,31 +628,33 @@ class ObjectStore:
     def redeliver(self, ev: Event) -> None:
         """Dispatch a previously deferred watch event to current
         watchers (sim seam: delayed-delivery faults).  Bypasses the
-        interposer — a deferred event is redelivered exactly once."""
+        interposer and the delivery queues — a deferred event is
+        redelivered exactly once, immediately."""
         with self._lock:
-            watchers = list(self._watchers)
-        for w in watchers:
+            fns = [sub.fn for sub in self._subs]
+        for fn in fns:
             try:
-                w(ev)
+                fn(ev)
             except Exception:
                 _LOG.exception("store watcher failed on redelivered %s %s",
                                ev.type, ev.kind)
 
     def watch(self, fn: Callable[[Event], None]) -> Callable[[], None]:
         """Register a watcher; returns an unsubscribe function."""
+        sub = _Subscription(fn)
         with self._lock:
-            self._watchers.append(fn)
+            self._subs.append(sub)
 
         def cancel():
             with self._lock:
-                if fn in self._watchers:
-                    self._watchers.remove(fn)
+                if sub in self._subs:
+                    self._subs.remove(sub)
         return cancel
 
     # -- verbs -------------------------------------------------------------
 
     def create(self, obj: Dict[str, Any]) -> Dict[str, Any]:
-        obj = copy.deepcopy(obj)
+        obj = copy.deepcopy(obj)   # materialize caller input (may be a CoW view)
         kind = obj.get("kind")
         md = obj.setdefault("metadata", {})
         name, ns = md.get("name"), md.get("namespace", "default")
@@ -415,44 +670,45 @@ class ObjectStore:
             md["creationTimestamp"] = md.get("creationTimestamp") or time.time()
             md["resourceVersion"] = self._next_rv()
             md.setdefault("generation", 1)
-            self._objects[k] = obj
-            self._index_add(k, obj)
-            self._journal_put(obj)
-            out = copy.deepcopy(obj)
-            self._notify(Event(Event.ADDED, kind, copy.deepcopy(obj)))
-        self._journal_ack()
-        return out
+            self._commit(k, None, obj)
+            self._notify(Event(Event.ADDED, kind, obj))
+        self._finish_write()
+        return snapshot(obj)
 
-    def get(self, kind: str, name: str, namespace: str = "default") -> Dict[str, Any]:
+    def get(self, kind: str, name: str, namespace: str = "default", *,
+            deep: bool = False) -> Dict[str, Any]:
         with self._lock:
             obj = self._objects.get(_key(kind, namespace, name))
             if obj is None:
                 raise NotFound(f"{kind} {namespace}/{name} not found")
-            return copy.deepcopy(obj)
+            return copy.deepcopy(obj) if deep else snapshot(obj)
 
-    def try_get(self, kind: str, name: str, namespace: str = "default"):
+    def try_get(self, kind: str, name: str, namespace: str = "default", *,
+                deep: bool = False):
         try:
-            return self.get(kind, name, namespace)
+            return self.get(kind, name, namespace, deep=deep)
         except NotFound:
             return None
 
     def list(self, kind: str, namespace: Optional[str] = None,
-             labels: Optional[Dict[str, str]] = None) -> List[Dict[str, Any]]:
+             labels: Optional[Dict[str, str]] = None, *,
+             deep: bool = False) -> List[Dict[str, Any]]:
         with self._lock:
-            items = None
+            keys = None
             if labels:
                 for lk, lv in labels.items():
                     if lk in self.INDEXED_LABELS:
-                        bucket = self._label_index.get((lk, lv), set())
-                        items = [self._objects[k] for k in bucket
-                                 if k in self._objects]
+                        keys = self._label_index.get((lk, lv), set())
                         break
-            if items is None:
-                items = [obj for (k, _, _), obj in self._objects.items()
-                         if k == kind]
+            if keys is None:
+                if namespace is not None:
+                    keys = self._kind_ns_index.get((kind, namespace), set())
+                else:
+                    keys = self._kind_index.get(kind, set())
             out = []
-            for obj in items:
-                if obj.get("kind") != kind:
+            for k in keys:
+                obj = self._objects.get(k)
+                if obj is None or k[0] != kind:
                     continue
                 md = obj.get("metadata", {})
                 if namespace is not None and md.get("namespace") != namespace:
@@ -462,7 +718,7 @@ class ObjectStore:
                     if any(obj_labels.get(lk) != lv
                            for lk, lv in labels.items()):
                         continue
-                out.append(copy.deepcopy(obj))
+                out.append(copy.deepcopy(obj) if deep else snapshot(obj))
             out.sort(key=lambda o: (o["metadata"]["namespace"],
                                     o["metadata"]["name"]))
             return out
@@ -490,33 +746,33 @@ class ObjectStore:
                 raise Conflict(
                     f"{kind} {ns}/{name}: resourceVersion {md.get('resourceVersion')} "
                     f"!= {cur_md['resourceVersion']}")
-            new = copy.deepcopy(cur)
+            # New revision shares untouched subtrees with the previous
+            # one (both immutable); replaced sections come from the
+            # entry deepcopy of the caller's object, so they are private.
+            new = dict(cur)
             if subresource == "status":
                 new["status"] = obj.get("status", {})
+                new_md = dict(cur_md)
             else:
                 # Immutable fields preserved; spec/metadata writable.
                 spec_changed = obj.get("spec") != cur.get("spec")
                 new["spec"] = obj.get("spec", cur.get("spec"))
-                new_md = copy.deepcopy(md)
+                new_md = md
                 for field in ("uid", "creationTimestamp", "generation",
                               "deletionTimestamp"):
                     new_md[field] = cur_md.get(field)
-                new["metadata"] = new_md
                 if spec_changed:
-                    new["metadata"]["generation"] = cur_md.get("generation", 1) + 1
+                    new_md["generation"] = cur_md.get("generation", 1) + 1
                 # status only via subresource
                 new["status"] = cur.get("status", {})
-            new["metadata"]["resourceVersion"] = self._next_rv()
-            self._index_remove(k, cur)
-            self._objects[k] = new
-            self._index_add(k, new)
-            self._journal_put(new)
-            out = copy.deepcopy(new)
-            self._notify(Event(Event.MODIFIED, kind, copy.deepcopy(new)))
+            new_md["resourceVersion"] = self._next_rv()
+            new["metadata"] = new_md
+            self._commit(k, cur, new)
+            self._notify(Event(Event.MODIFIED, kind, new))
         # Deleting an object is finalized outside the lock path; check here:
         self._maybe_finalize_delete(kind, name, ns)
-        self._journal_ack()
-        return out
+        self._finish_write()
+        return snapshot(new)
 
     def update_status(self, obj: Dict[str, Any]) -> Dict[str, Any]:
         return self.update(obj, subresource="status")
@@ -555,6 +811,10 @@ class ObjectStore:
                         f"{kind} {namespace}/{name}: resourceVersion "
                         f"{want_rv} != {cur['metadata']['resourceVersion']}")
             try:
+                # The patch helpers never mutate their target: they
+                # build new containers along patched paths and share
+                # untouched subtrees — which is exactly the committed-
+                # immutable discipline, so ``cur`` goes in as-is.
                 if patch_type == "apply":
                     applied = copy.deepcopy(body) if body else {}
                     applied.setdefault("kind", kind)
@@ -565,9 +825,9 @@ class ObjectStore:
                     new = P.apply_ssa(cur, applied, field_manager,
                                       force=force, subresource=subresource)
                 elif patch_type == "merge":
-                    new = P.json_merge_patch(copy.deepcopy(cur), body)
+                    new = P.json_merge_patch(cur, body)
                 elif patch_type == "strategic":
-                    new = P.strategic_merge_patch(copy.deepcopy(cur), body)
+                    new = P.strategic_merge_patch(cur, body)
                 elif patch_type == "json":
                     new = P.json_patch(cur, body)
                 else:
@@ -582,11 +842,14 @@ class ObjectStore:
                 raise Invalid("patch must produce an object, got "
                               f"{type(new).__name__}")
 
-            # Identity and server-owned metadata are not patchable.
+            # Identity and server-owned metadata are not patchable.  The
+            # metadata dict may still BE the committed one (unpatched) —
+            # shallow-copy before stamping server fields.
             new["kind"] = kind
             if cur is not None and cur.get("apiVersion") is not None:
                 new["apiVersion"] = cur["apiVersion"]
-            md = new.setdefault("metadata", {})
+            md = dict(new.get("metadata") or {})
+            new["metadata"] = md
             md["name"], md["namespace"] = name, namespace
             if cur is not None:
                 cur_md = cur["metadata"]
@@ -598,8 +861,9 @@ class ObjectStore:
                         md.pop(f, None)
                 if subresource == "status":
                     # Only status (plus ownership bookkeeping) lands.
-                    kept = copy.deepcopy(cur)
+                    kept = dict(cur)
                     kept["status"] = new.get("status", {})
+                    kept["metadata"] = dict(cur_md)
                     if "managedFields" in md:
                         kept["metadata"]["managedFields"] = \
                             md["managedFields"]
@@ -609,7 +873,11 @@ class ObjectStore:
                     new["status"] = cur.get("status", {})
             else:
                 created = True
-                md["uid"] = uuid.uuid4().hex
+                # Server-side-apply upsert: creation identity goes
+                # through the same seams create() uses — the injected
+                # uid factory and the (sim-shimmable) module clock — so
+                # SSA-created objects replay deterministically.
+                md["uid"] = self._uid_factory()
                 md["creationTimestamp"] = time.time()
                 md.setdefault("generation", 1)
 
@@ -626,41 +894,37 @@ class ObjectStore:
                     new.get("spec") != cur.get("spec"):
                 md["generation"] = cur["metadata"].get("generation", 1) + 1
             md["resourceVersion"] = self._next_rv()
-            if cur is not None:
-                self._index_remove(k, cur)
-            self._objects[k] = new
-            self._index_add(k, new)
-            self._journal_put(new)
-            out = copy.deepcopy(new)
+            self._commit(k, cur, new)
             self._notify(Event(Event.ADDED if created else Event.MODIFIED,
-                               kind, copy.deepcopy(new)))
+                               kind, new))
         if not created:
             self._maybe_finalize_delete(kind, name, namespace)
-        self._journal_ack()
-        return out
+        self._finish_write()
+        return snapshot(new)
 
     def patch_labels(self, kind: str, name: str, namespace: str,
                      labels: Dict[str, Optional[str]]) -> Dict[str, Any]:
         self._interpose("patch_labels", kind, name, namespace)
         with self._lock:
-            cur = self._objects.get(_key(kind, namespace, name))
+            key = _key(kind, namespace, name)
+            cur = self._objects.get(key)
             if cur is None:
                 raise NotFound(f"{kind} {namespace}/{name} not found")
-            key = _key(kind, namespace, name)
-            self._index_remove(key, cur)
-            lab = cur["metadata"].setdefault("labels", {})
-            for k, v in labels.items():
-                if v is None:
-                    lab.pop(k, None)
+            new = dict(cur)
+            new_md = dict(cur["metadata"])
+            lab = dict(new_md.get("labels") or {})
+            for lk, lv in labels.items():
+                if lv is None:
+                    lab.pop(lk, None)
                 else:
-                    lab[k] = v
-            self._index_add(key, cur)
-            cur["metadata"]["resourceVersion"] = self._next_rv()
-            self._journal_put(cur)
-            self._notify(Event(Event.MODIFIED, kind, copy.deepcopy(cur)))
-            out = copy.deepcopy(cur)
-        self._journal_ack()
-        return out
+                    lab[lk] = lv
+            new_md["labels"] = lab
+            new_md["resourceVersion"] = self._next_rv()
+            new["metadata"] = new_md
+            self._commit(key, cur, new)
+            self._notify(Event(Event.MODIFIED, kind, new))
+        self._finish_write()
+        return snapshot(new)
 
     def delete(self, kind: str, name: str, namespace: str = "default") -> None:
         """Graceful delete: sets deletionTimestamp; the object is removed
@@ -672,12 +936,15 @@ class ObjectStore:
             if cur is None:
                 raise NotFound(f"{kind} {namespace}/{name} not found")
             if not cur["metadata"].get("deletionTimestamp"):
-                cur["metadata"]["deletionTimestamp"] = time.time()
-                cur["metadata"]["resourceVersion"] = self._next_rv()
-                self._journal_put(cur)
-                self._notify(Event(Event.MODIFIED, kind, copy.deepcopy(cur)))
+                new = dict(cur)
+                new_md = dict(cur["metadata"])
+                new_md["deletionTimestamp"] = time.time()
+                new_md["resourceVersion"] = self._next_rv()
+                new["metadata"] = new_md
+                self._commit(k, cur, new)
+                self._notify(Event(Event.MODIFIED, kind, new))
         self._maybe_finalize_delete(kind, name, namespace)
-        self._journal_ack()
+        self._finish_write()
 
     def remove_finalizer(self, kind: str, name: str, namespace: str,
                          finalizer: str,
@@ -689,7 +956,8 @@ class ObjectStore:
         silently raced."""
         self._interpose("remove_finalizer", kind, name, namespace)
         with self._lock:
-            cur = self._objects.get(_key(kind, namespace, name))
+            k = _key(kind, namespace, name)
+            cur = self._objects.get(k)
             if cur is None:
                 return None
             if rv is not None and cur["metadata"]["resourceVersion"] != rv:
@@ -698,13 +966,17 @@ class ObjectStore:
                     f"!= {cur['metadata']['resourceVersion']}")
             fins = cur["metadata"].get("finalizers", [])
             if finalizer in fins:
-                fins.remove(finalizer)
-                cur["metadata"]["resourceVersion"] = self._next_rv()
-                self._journal_put(cur)
-                self._notify(Event(Event.MODIFIED, kind, copy.deepcopy(cur)))
-            out = copy.deepcopy(cur)
+                new = dict(cur)
+                new_md = dict(cur["metadata"])
+                new_md["finalizers"] = [f for f in fins if f != finalizer]
+                new_md["resourceVersion"] = self._next_rv()
+                new["metadata"] = new_md
+                self._commit(k, cur, new)
+                self._notify(Event(Event.MODIFIED, kind, new))
+                cur = new
+            out = snapshot(cur)
         self._maybe_finalize_delete(kind, name, namespace)
-        self._journal_ack()
+        self._finish_write()
         return out
 
     def add_finalizer(self, kind: str, name: str, namespace: str,
@@ -715,21 +987,26 @@ class ObjectStore:
         ``rv``: optional precondition (see :meth:`remove_finalizer`)."""
         self._interpose("add_finalizer", kind, name, namespace)
         with self._lock:
-            cur = self._objects.get(_key(kind, namespace, name))
+            k = _key(kind, namespace, name)
+            cur = self._objects.get(k)
             if cur is None:
                 raise NotFound(f"{kind} {namespace}/{name} not found")
             if rv is not None and cur["metadata"]["resourceVersion"] != rv:
                 raise Conflict(
                     f"{kind} {namespace}/{name}: resourceVersion {rv} "
                     f"!= {cur['metadata']['resourceVersion']}")
-            fins = cur["metadata"].setdefault("finalizers", [])
+            fins = cur["metadata"].get("finalizers", [])
             if finalizer not in fins:
-                fins.append(finalizer)
-                cur["metadata"]["resourceVersion"] = self._next_rv()
-                self._journal_put(cur)
-                self._notify(Event(Event.MODIFIED, kind, copy.deepcopy(cur)))
-            out = copy.deepcopy(cur)
-        self._journal_ack()
+                new = dict(cur)
+                new_md = dict(cur["metadata"])
+                new_md["finalizers"] = list(fins) + [finalizer]
+                new_md["resourceVersion"] = self._next_rv()
+                new["metadata"] = new_md
+                self._commit(k, cur, new)
+                self._notify(Event(Event.MODIFIED, kind, new))
+                cur = new
+            out = snapshot(cur)
+        self._finish_write()
         return out
 
     def _maybe_finalize_delete(self, kind: str, name: str, namespace: str):
@@ -742,14 +1019,15 @@ class ObjectStore:
             if (cur is not None and cur["metadata"].get("deletionTimestamp")
                     and not cur["metadata"].get("finalizers")):
                 removed = self._objects.pop(k)
-                self._index_remove(k, removed)
+                self._reindex(k, removed, None)
                 self._journal_del(k)
                 # DELETED gets its own rv, stamped onto the emitted object
                 # (kube-apiserver behavior): it must not share the
                 # preceding MODIFIED's rv or resuming watchers skip it
                 # forever, and clients that resume from the OBJECT's rv
                 # must not regress behind the event and replay it.
-                gone = copy.deepcopy(removed)
+                gone = dict(removed)
+                gone["metadata"] = dict(removed["metadata"])
                 gone["metadata"]["resourceVersion"] = self._next_rv()
                 self._notify(Event(Event.DELETED, kind, gone))
         if removed is not None:
@@ -758,15 +1036,14 @@ class ObjectStore:
     def _cascade_delete(self, owner: Dict[str, Any]):
         uid = owner["metadata"].get("uid")
         ns = owner["metadata"].get("namespace", "default")
-        dependents = []
         with self._lock:
-            for (kind, ons, name), obj in list(self._objects.items()):
-                if ons != ns:
-                    continue
-                for ref in obj["metadata"].get("ownerReferences", []):
-                    if ref.get("uid") == uid:
-                        dependents.append((kind, name))
-                        break
+            # The owner-uid index bucket preserves creation order, so
+            # dependents delete in the same order the old full scan
+            # produced (part of the deterministic-replay event history).
+            dependents = [(kind, name)
+                          for (kind, ons, name) in
+                          self._owner_index.get(uid, {})
+                          if ons == ns]
         for kind, name in dependents:
             try:
                 self.delete(kind, name, ns)
@@ -800,15 +1077,25 @@ class ObjectStore:
     def kinds(self) -> List[str]:
         """Sorted kinds currently present (sim GC sweep + debugging)."""
         with self._lock:
-            return sorted({k for (k, _, _) in self._objects})
+            return sorted(k for k, bucket in self._kind_index.items()
+                          if bucket)
 
     def count(self, kind: str) -> int:
         with self._lock:
-            return sum(1 for (k, _, _) in self._objects if k == kind)
+            return len(self._kind_index.get(kind, ()))
 
     def resource_version(self) -> int:
         with self._lock:
             return self._rv
+
+    def _backlog_since(self, rv: int, kinds):
+        """Backlog entries with rv > given, via bisect (the backlog is
+        strictly rv-sorted).  Mutation lock held by the caller."""
+        start = bisect.bisect_right(self._backlog, rv, key=lambda e: e[0])
+        if kinds is None:
+            return self._backlog[start:]
+        return [(erv, ev) for erv, ev in self._backlog[start:]
+                if ev.kind in kinds]
 
     def wait_for_events(self, rv: int, kinds=None, timeout: float = 25.0):
         """Blocking events_since: waits on the store's condition variable
@@ -817,8 +1104,7 @@ class ObjectStore:
         deadline = time.time() + timeout
         with self._backlog_cond:
             while True:
-                out = [(erv, ev) for erv, ev in self._backlog if erv > rv
-                       and (kinds is None or ev.kind in kinds)]
+                out = self._backlog_since(rv, kinds)
                 truncated = ((bool(self._backlog)
                               and self._backlog[0][0] > rv + 1)
                              or (not self._backlog and rv < self._rv))
@@ -840,6 +1126,4 @@ class ObjectStore:
                 return [], self._rv, False     # idle fast path: no scan
             truncated = ((bool(self._backlog) and self._backlog[0][0] > rv + 1)
                          or (not self._backlog and rv < self._rv))
-            out = [(erv, ev) for erv, ev in self._backlog if erv > rv
-                   and (kinds is None or ev.kind in kinds)]
-            return out, self._rv, truncated
+            return self._backlog_since(rv, kinds), self._rv, truncated

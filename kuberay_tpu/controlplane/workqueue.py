@@ -1,0 +1,183 @@
+"""Deduplicating, per-key-serialized work queue (controller-runtime
+semantics: workqueue.Type's dirty/processing sets).
+
+The contract that makes ``Manager.start(workers=N)`` safe AND fast:
+
+- **Dedup while queued**: adding a key already waiting is a no-op.
+- **Per-key serialization**: a key being processed is never handed to a
+  second worker.  A popped key still held by another worker parks in
+  the *dirty* set and re-queues the moment that worker calls
+  :meth:`done` — so the triggering event is never lost, it is coalesced
+  into one more level-triggered pass.  (Without this, two workers
+  reconcile the same object concurrently and race their status writes —
+  the latent bug the old list+set queue had.)
+- **O(1) pops**: a deque, not ``list.pop(0)``.
+
+Unlike controller-runtime (which parks in-flight re-adds in dirty at
+Add time), a re-added in-flight key here enters the queue immediately
+and the serialization check happens at :meth:`get`.  Single-threaded
+draining (``run_until_idle`` — the deterministic-sim mode) therefore
+processes keys in exactly the order the old dedup queue did, which is
+what keeps chaos-replay journal hashes byte-identical; the observable
+guarantees under concurrency are the same as controller-runtime's.
+
+Timed re-adds (:meth:`add_after`) sit in a heap against the injected
+``now_fn`` clock (the sim's virtual clock or wall time) and promote
+through :meth:`add` when due.
+
+Metrics (fed through the optional ``metrics`` facade —
+``tpu_workqueue_depth`` / ``tpu_workqueue_latency_seconds``) and the
+tracer's ``queued``/``dequeued`` seams stay at the Manager layer; the
+queue itself only tracks per-key enqueue instants so latency is
+measured from the FIRST pending cause (dedup keeps the earliest).
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+Key = Tuple[str, str, str]
+
+
+class WorkQueue:
+    def __init__(self, now_fn: Optional[Callable[[], float]] = None,
+                 metrics=None, name: str = "manager"):
+        self._now = now_fn or time.time
+        self._metrics = metrics
+        self._name = name
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queue: deque = deque()
+        self._queued: Set[Key] = set()       # waiting in self._queue
+        self._dirty: Set[Key] = set()        # needs another pass when done
+        self._processing: Set[Key] = set()   # held by a worker right now
+        self._delayed: List[Tuple[float, Key]] = []
+        self._added_at: Dict[Key, float] = {}
+        self._shutdown = False
+
+    # -- producers ---------------------------------------------------------
+
+    def add(self, key: Key) -> None:
+        with self._cond:
+            self._add_locked(key)
+            self._cond.notify()
+
+    def _add_locked(self, key: Key) -> None:
+        self._added_at.setdefault(key, self._now())
+        if key in self._dirty:
+            return   # already coalesced; done() will requeue it
+        if key not in self._queued:
+            self._queued.add(key)
+            self._queue.append(key)
+            self._report_depth()
+
+    def add_after(self, key: Key, after: float) -> None:
+        if after <= 0:
+            self.add(key)
+            return
+        with self._cond:
+            # (deadline, key) on purpose: equal deadlines pop in key
+            # order — a deterministic tiebreak the sim replay contract
+            # depends on (virtual-clock requeues often share an instant).
+            heapq.heappush(self._delayed, (self._now() + after, key))
+            self._cond.notify()
+
+    # -- consumers ---------------------------------------------------------
+
+    def get(self, block: bool = True) -> Optional[Key]:
+        """Next key, or None (non-blocking empty / shutdown).  The key is
+        marked *processing* until the caller's :meth:`done`."""
+        with self._cond:
+            while True:
+                self._promote_due_locked()
+                while self._queue:
+                    key = self._queue.popleft()
+                    self._queued.discard(key)
+                    if key in self._processing:
+                        # Another worker holds this key: park it dirty;
+                        # done() re-queues it.  Never hand one key to
+                        # two workers.
+                        self._dirty.add(key)
+                        self._report_depth()
+                        continue
+                    self._processing.add(key)
+                    self._report_depth()
+                    added = self._added_at.pop(key, None)
+                    if added is not None and self._metrics is not None:
+                        self._metrics.workqueue_latency(
+                            self._name, max(0.0, self._now() - added))
+                    return key
+                if not block or self._shutdown:
+                    return None
+                timeout = 1.0
+                if self._delayed:
+                    timeout = max(0.0, min(
+                        timeout, self._delayed[0][0] - self._now()))
+                self._cond.wait(timeout=timeout)
+
+    def done(self, key: Key) -> None:
+        """The worker finished this key.  A re-add that arrived while it
+        was in flight (dirty) queues it again — never to two workers at
+        once, never lost."""
+        with self._cond:
+            self._processing.discard(key)
+            if key in self._dirty and key not in self._queued:
+                self._dirty.discard(key)
+                self._queued.add(key)
+                self._queue.append(key)
+                self._report_depth()
+                self._cond.notify()
+
+    # -- timed re-adds -----------------------------------------------------
+
+    def _promote_due_locked(self) -> None:
+        now = self._now()
+        while self._delayed and self._delayed[0][0] <= now:
+            _, key = heapq.heappop(self._delayed)
+            self._add_locked(key)
+
+    def next_delayed_at(self) -> Optional[float]:
+        """Earliest timed-re-add deadline (``now_fn`` clock domain), or
+        None.  The sim harness advances its virtual clock exactly here."""
+        with self._lock:
+            return self._delayed[0][0] if self._delayed else None
+
+    def flush_delayed(self) -> None:
+        """Promote ALL timed re-adds immediately (tests: 'advance time')."""
+        with self._cond:
+            while self._delayed:
+                _, key = heapq.heappop(self._delayed)
+                self._add_locked(key)
+            self._cond.notify_all()
+
+    # -- lifecycle / introspection -----------------------------------------
+
+    def shutdown(self) -> None:
+        with self._cond:
+            self._shutdown = True
+            self._cond.notify_all()
+
+    def restart(self) -> None:
+        with self._cond:
+            self._shutdown = False
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    def delayed_len(self) -> int:
+        with self._lock:
+            return len(self._delayed)
+
+    def delayed_items(self) -> List[Tuple[float, Key]]:
+        """Scheduled (deadline, key) pairs, soonest first (introspection)."""
+        with self._lock:
+            return sorted(self._delayed)
+
+    def _report_depth(self) -> None:
+        if self._metrics is not None:
+            self._metrics.workqueue_depth(self._name, len(self._queue))

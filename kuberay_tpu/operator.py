@@ -57,10 +57,19 @@ class Operator:
     def __init__(self, config: Optional[OperatorConfiguration] = None,
                  store: Optional[ObjectStore] = None,
                  client_provider=None,
-                 fake_kubelet: bool = False):
+                 fake_kubelet: bool = False,
+                 watch_dispatch: str = "sync"):
         self.config = config or OperatorConfiguration()
         features.set_gates(self.config.featureGates)
-        self.store = store or ObjectStore()
+        # ``watch_dispatch`` applies only when the Operator builds its
+        # own store: "async" moves watch fan-out onto the store's
+        # dispatcher thread (writers never wait on reconcile-side
+        # callbacks — the live-operator mode main() selects); "sync"
+        # keeps inline delivery, which embedded/run_until_idle tests
+        # rely on for determinism.
+        self._owns_store = store is None
+        self.store = store if store is not None else \
+            ObjectStore(dispatch=watch_dispatch)
         self.metrics = ControlPlaneMetrics()
         # Observability (kuberay_tpu.obs): always on — all bounded
         # ring/LRU structures; /debug/traces + /debug/flight answer
@@ -302,6 +311,8 @@ class Operator:
             self.history_collector.close()
         if self.apiserver is not None:
             self.apiserver.shutdown()
+        if self._owns_store and hasattr(self.store, "close"):
+            self.store.close()   # stops the async watch dispatcher
 
     # test/demo helper
     def run_until_idle(self):
@@ -342,6 +353,11 @@ def main(argv=None):
     ap.add_argument("--journal", default="",
                     help="journal file for durable standalone state "
                          "(CRs survive operator restarts)")
+    ap.add_argument("--watch-dispatch", default="async",
+                    choices=("sync", "async"),
+                    help="watch fan-out mode: async (dispatcher thread; "
+                         "writers never wait on watcher callbacks — the "
+                         "live default) or sync (inline, deterministic)")
     ap.add_argument("--history-archive", default="",
                     help="archive CR lifecycles for the history server: "
                          "file:///path | s3://bucket?endpoint=... | "
@@ -361,14 +377,16 @@ def main(argv=None):
         from kuberay_tpu.controlplane.rest_store import RestObjectStore
         store = RestObjectStore(args.store_url)
     elif args.journal:
-        store = ObjectStore(journal_path=args.journal)
+        store = ObjectStore(journal_path=args.journal,
+                            dispatch=args.watch_dispatch)
     else:
         store = None
     if args.leader_election and not args.store_url and not args.journal:
         print("warning: --leader-election without --store-url elects "
               "against a private store (every replica wins); pass "
               "--store-url for real multi-replica mode", flush=True)
-    op = Operator(cfg, store=store, fake_kubelet=args.fake_kubelet)
+    op = Operator(cfg, store=store, fake_kubelet=args.fake_kubelet,
+                  watch_dispatch=args.watch_dispatch)
     url = op.start(api_port=args.api_port, api_host=args.api_host,
                    leader_election=args.leader_election)
     print(f"kuberay-tpu operator running; API at {url}", flush=True)

@@ -139,8 +139,19 @@ class SimHarness:
             "sim_faults_injected_total", {"fault": fault})
 
         uid_counter = iter(range(1, 1 << 30))
+        # dispatch="sync" is load-bearing, not a default-by-accident:
+        # inline watch delivery on the mutating thread is what makes a
+        # seed's event history a pure function of the fault plan — the
+        # byte-identical journal-hash contract.  Guarded below so a
+        # future store default flip cannot silently break replays.
         self.store = ObjectStore(
-            uid_factory=lambda: f"sim-uid-{next(uid_counter):06d}")
+            uid_factory=lambda: f"sim-uid-{next(uid_counter):06d}",
+            dispatch="sync")
+        if self.store._dispatch_mode != "sync":
+            raise RuntimeError(
+                "SimHarness requires a sync-dispatch store: async watch "
+                "fan-out would decouple delivery order from the seeded "
+                "fault plan and break journal-hash determinism")
         self.metrics = ControlPlaneMetrics()
         self.metrics.registry.describe(
             "sim_faults_injected_total",

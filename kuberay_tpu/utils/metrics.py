@@ -18,6 +18,11 @@ from typing import Dict, List, Optional, Tuple
 
 _BUCKETS = (0.5, 1, 2, 5, 10, 30, 60, 120, 300, 600, 1800, float("inf"))
 
+# Queue waits are milliseconds on a healthy control plane — the default
+# (reconcile-scale) buckets would collapse them all into the first one.
+_FAST_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                 1, 2.5, 5, 10, float("inf"))
+
 
 class Histogram:
     def __init__(self, buckets=_BUCKETS):
@@ -63,11 +68,14 @@ class MetricsRegistry:
             self._gauges[(name, self._labels_key(labels))] = value
 
     def observe(self, name: str, value: float,
-                labels: Optional[Dict[str, str]] = None):
+                labels: Optional[Dict[str, str]] = None,
+                buckets: Optional[Tuple] = None):
+        """``buckets`` applies on first observation of a series only (a
+        histogram's buckets are fixed for its lifetime)."""
         with self._lock:
             key = (name, self._labels_key(labels))
             if key not in self._hists:
-                self._hists[key] = Histogram()
+                self._hists[key] = Histogram(buckets or _BUCKETS)
             self._hists[key].observe(value)
 
     def drop_labeled(self, label_key: str, label_value: str):
@@ -172,6 +180,14 @@ class ControlPlaneMetrics:
                    "Autoscaler scale decisions per kind and direction "
                    "(up/down); the last-N decision audit ring at "
                    "/debug/autoscaler carries the input signals")
+        r.describe("tpu_workqueue_depth",
+                   "Keys waiting in the reconcile work queue (excludes "
+                   "in-flight and timed requeues); sustained growth means "
+                   "the workers can't keep up")
+        r.describe("tpu_workqueue_latency_seconds",
+                   "Seconds a key waited in the work queue from first "
+                   "enqueue to worker pickup (dedup keeps the earliest "
+                   "cause; includes promoted requeue backoff)")
 
     def observe_provisioned(self, cluster: str, seconds: float):
         self.registry.observe("tpu_cluster_provisioned_duration_seconds",
@@ -209,6 +225,14 @@ class ControlPlaneMetrics:
         self.registry.inc("tpu_reconcile_total", {"kind": kind})
         self.registry.observe("tpu_reconcile_duration_seconds", seconds,
                               {"kind": kind})
+
+    def workqueue_depth(self, queue: str, depth: int):
+        self.registry.set_gauge("tpu_workqueue_depth", float(depth),
+                                {"queue": queue})
+
+    def workqueue_latency(self, queue: str, seconds: float):
+        self.registry.observe("tpu_workqueue_latency_seconds", seconds,
+                              {"queue": queue}, buckets=_FAST_BUCKETS)
 
     def reconcile_conflict(self, kind: str):
         self.registry.inc("tpu_reconcile_conflicts_total", {"kind": kind})

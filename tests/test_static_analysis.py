@@ -35,7 +35,8 @@ def test_rule_registry_complete():
     assert {"rv-precondition", "lock-discipline", "blocking-under-lock",
             "exception-swallow", "tpu-env-completeness",
             "requeue-observability",
-            "phase-transition-recorded"} <= set(RULES)
+            "phase-transition-recorded",
+            "no-io-under-store-lock"} <= set(RULES)
     for cls in RULES.values():
         assert cls.DESCRIPTION and cls.INVARIANT
 
@@ -633,6 +634,86 @@ def test_phase_transition_accepts_observe_state_evidence():
             job.status.jobDeploymentStatus = "Running"
     """)
     assert "phase-transition-recorded" not in fired
+
+
+# ---------------------------------------------------------------------------
+# no-io-under-store-lock
+# ---------------------------------------------------------------------------
+
+def test_no_io_under_store_lock_flags_serialize_journal_dispatch():
+    findings, fired = _rules_fired("""
+        import json, threading
+        class Store:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._journal = None
+                self._watchers = []
+            def put(self, obj):
+                with self._lock:
+                    self._journal.append(json.dumps(obj).encode())
+                    for w in list(self._watchers):
+                        w(obj)
+    """, only=["no-io-under-store-lock"])
+    assert "no-io-under-store-lock" in fired
+    messages = " ".join(f.message for f in findings)
+    assert "serializes" in messages
+    assert "journal I/O" in messages
+    assert "watcher callback" in messages
+
+
+def test_no_io_under_store_lock_quiet_on_queued_offlock_pattern():
+    """The shipped discipline: queue under the primary lock, serialize/
+    append/dispatch under auxiliary locks after release."""
+    _, fired = _rules_fired("""
+        import json, threading
+        class Store:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._journal_lock = threading.Lock()
+                self._journal = None
+                self._pending = []
+                self._subs = []
+            def put(self, obj):
+                with self._lock:
+                    self._pending.append(obj)
+                with self._journal_lock:
+                    self._journal.append(json.dumps(obj).encode())
+                for sub in list(self._subs):
+                    sub.fn(obj)
+    """, only=["no-io-under-store-lock"])
+    assert "no-io-under-store-lock" not in fired
+
+
+def test_no_io_under_store_lock_catches_sub_fn_dispatch():
+    _, fired = _rules_fired("""
+        import threading
+        class Store:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._subscribers = []
+            def put(self, ev):
+                with self._lock:
+                    for sub in self._subscribers:
+                        sub.fn(ev)
+    """, only=["no-io-under-store-lock"])
+    assert "no-io-under-store-lock" in fired
+
+
+def test_no_io_under_store_lock_ignores_other_locks():
+    """Auxiliary locks exist precisely to serialize I/O off the hot
+    mutex — only ``self._lock`` regions count."""
+    _, fired = _rules_fired("""
+        import json, threading
+        class Store:
+            def __init__(self):
+                self._journal_lock = threading.Lock()
+                self._lock = threading.Lock()
+                self._journal = None
+            def drain(self):
+                with self._journal_lock:
+                    self._journal.append(json.dumps({}).encode())
+    """, only=["no-io-under-store-lock"])
+    assert "no-io-under-store-lock" not in fired
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,346 @@
+"""Hot-path store contracts (ISSUE 5): indexed reads return exactly
+what the old full-scan returned, copy-on-write snapshots isolate
+readers without deepcopy, SSA-created objects replay deterministically,
+the rv-sorted backlog bisects correctly, and async dispatch delivers
+everything in commit order off the mutation lock."""
+
+import copy
+import threading
+
+from kuberay_tpu.controlplane.snapshot import CowDict, CowList
+from kuberay_tpu.controlplane.store import Event, ObjectStore
+
+
+def obj(kind, name, ns="default", labels=None, owners=None, spec=None):
+    md = {"name": name, "namespace": ns}
+    if labels:
+        md["labels"] = labels
+    if owners:
+        md["ownerReferences"] = owners
+    return {"apiVersion": "v1", "kind": kind, "metadata": md,
+            "spec": spec or {"x": 1}, "status": {}}
+
+
+def make_mixed_store():
+    """Mixed fixture: three kinds, two namespaces, indexed and
+    unindexed labels."""
+    s = ObjectStore()
+    s.create(obj("Pod", "p0", labels={"tpu.dev/cluster": "c1",
+                                      "role": "head"}))
+    s.create(obj("Pod", "p1", labels={"tpu.dev/cluster": "c1",
+                                      "role": "worker"}))
+    s.create(obj("Pod", "p2", labels={"tpu.dev/cluster": "c2"}))
+    s.create(obj("Pod", "p3", ns="other", labels={"tpu.dev/cluster": "c1"}))
+    s.create(obj("Pod", "p4", ns="other", labels={"role": "worker"}))
+    s.create(obj("TpuCluster", "c1", labels={"tier": "prod"}))
+    s.create(obj("TpuCluster", "c2", ns="other"))
+    s.create(obj("Service", "svc1", labels={"tpu.dev/cluster": "c1"}))
+    return s
+
+
+def scan_list(store, kind, namespace=None, labels=None):
+    """The old implementation: full scan + deepcopy, as the parity
+    oracle."""
+    out = []
+    with store._lock:
+        for (k, _, _), o in store._objects.items():
+            if k != kind or o.get("kind") != kind:
+                continue
+            md = o.get("metadata", {})
+            if namespace is not None and md.get("namespace") != namespace:
+                continue
+            if labels:
+                obj_labels = md.get("labels", {}) or {}
+                if any(obj_labels.get(lk) != lv for lk, lv in labels.items()):
+                    continue
+            out.append(copy.deepcopy(o))
+    out.sort(key=lambda o: (o["metadata"]["namespace"],
+                            o["metadata"]["name"]))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# indexed reads
+# ---------------------------------------------------------------------------
+
+def test_indexed_list_matches_scan_on_mixed_fixture():
+    s = make_mixed_store()
+    cases = [
+        ("Pod", None, None),
+        ("Pod", "default", None),
+        ("Pod", "other", None),
+        ("Pod", "missing-ns", None),
+        ("Pod", None, {"tpu.dev/cluster": "c1"}),
+        ("Pod", "default", {"tpu.dev/cluster": "c1"}),
+        ("Pod", "other", {"tpu.dev/cluster": "c1"}),
+        ("Pod", None, {"role": "worker"}),                 # unindexed label
+        ("Pod", None, {"tpu.dev/cluster": "c1", "role": "head"}),
+        ("Service", None, {"tpu.dev/cluster": "c1"}),
+        ("TpuCluster", None, None),
+        ("TpuCluster", "other", None),
+        ("NoSuchKind", None, None),
+    ]
+    for kind, ns, labels in cases:
+        assert s.list(kind, ns, labels) == scan_list(s, kind, ns, labels), \
+            (kind, ns, labels)
+
+
+def test_indexes_track_update_delete_and_label_moves():
+    s = make_mixed_store()
+    # Label move: p2 migrates to c1 — both index buckets must follow.
+    s.patch_labels("Pod", "p2", "default", {"tpu.dev/cluster": "c1"})
+    assert [p["metadata"]["name"]
+            for p in s.list("Pod", "default",
+                            {"tpu.dev/cluster": "c1"})] == ["p0", "p1", "p2"]
+    assert s.list("Pod", None, {"tpu.dev/cluster": "c2"}) == []
+    # Delete: drops out of every bucket.
+    s.delete("Pod", "p0", "default")
+    assert [p["metadata"]["name"]
+            for p in s.list("Pod", "default",
+                            {"tpu.dev/cluster": "c1"})] == ["p1", "p2"]
+    assert s.count("Pod") == 4
+    assert s.kinds() == ["Pod", "Service", "TpuCluster"]
+    s.delete("Service", "svc1", "default")
+    assert s.kinds() == ["Pod", "TpuCluster"]
+
+
+def test_cascade_delete_uses_owner_index():
+    s = ObjectStore()
+    owner = s.create(obj("TpuCluster", "own"))
+    uid = owner["metadata"]["uid"]
+    ref = [{"kind": "TpuCluster", "name": "own", "uid": uid}]
+    s.create(obj("Pod", "dep-a", owners=ref))
+    s.create(obj("Pod", "dep-b", owners=ref))
+    # Same uid, different namespace: ownerReferences are namespace-local.
+    s.create(obj("Pod", "dep-other-ns", ns="other", owners=ref))
+    s.create(obj("Pod", "unrelated"))
+    s.delete("TpuCluster", "own")
+    names = [p["metadata"]["name"] for p in s.list("Pod")]
+    assert names == ["unrelated", "dep-other-ns"]   # (ns, name) sort order
+    # The owner bucket is gone with its members.
+    assert uid not in s._owner_index or \
+        all(k[1] == "other" for k in s._owner_index[uid])
+
+
+# ---------------------------------------------------------------------------
+# copy-on-write read path
+# ---------------------------------------------------------------------------
+
+def test_snapshot_mutation_never_reaches_committed_state():
+    s = make_mixed_store()
+    snap = s.get("Pod", "p0")
+    assert isinstance(snap, CowDict)
+    # Nested mutation through the wrapper: committed object untouched.
+    snap["metadata"]["labels"]["role"] = "MUTATED"
+    snap["spec"]["x"] = 999
+    snap["status"]["phase"] = "Running"
+    fresh = s.get("Pod", "p0")
+    assert fresh["metadata"]["labels"]["role"] == "head"
+    assert fresh["spec"]["x"] == 1
+    assert fresh.get("status") == {}
+    # And the mutated wrapper round-trips through update as a write.
+    snap2 = s.get("Pod", "p0")
+    snap2["spec"]["x"] = 2
+    s.update(snap2)
+    assert s.get("Pod", "p0")["spec"]["x"] == 2
+
+
+def test_snapshot_list_iteration_wraps_elements():
+    s = ObjectStore()
+    s.create(obj("TpuCluster", "c", spec={
+        "workerGroupSpecs": [{"groupName": "g0", "replicas": 1},
+                             {"groupName": "g1", "replicas": 2}]}))
+    snap = s.get("TpuCluster", "c")
+    groups = snap["spec"]["workerGroupSpecs"]
+    assert isinstance(groups, CowList)
+    for g in groups:
+        g["replicas"] = 99          # element wrappers, not committed dicts
+    assert [g["replicas"] for g in
+            s.get("TpuCluster", "c")["spec"]["workerGroupSpecs"]] == [1, 2]
+
+
+def test_deep_reads_return_plain_private_dicts():
+    s = make_mixed_store()
+    d = s.get("Pod", "p0", deep=True)
+    assert type(d) is dict and type(d["metadata"]) is dict
+    for o in s.list("Pod", deep=True):
+        assert type(o) is dict
+    # deepcopy of a wrapper materializes to plain containers too.
+    m = copy.deepcopy(s.get("Pod", "p0"))
+    assert type(m) is dict and type(m["metadata"]) is dict
+    assert type(m["metadata"]["labels"]) is dict
+
+
+def test_watch_event_objects_are_isolated():
+    s = ObjectStore()
+    got = []
+    s.watch(lambda ev: got.append(ev))
+    s.create(obj("Pod", "p"))
+    got[0].obj["metadata"]["labels"] = {"corrupted": "yes"}
+    assert "labels" not in s.get("Pod", "p")["metadata"] or \
+        s.get("Pod", "p")["metadata"].get("labels") != {"corrupted": "yes"}
+
+
+def test_create_and_update_accept_snapshot_input():
+    s = ObjectStore()
+    s.create(obj("Pod", "src"))
+    snap = s.get("Pod", "src")
+    snap["metadata"]["name"] = "clone"
+    del snap["metadata"]["uid"]
+    snap["metadata"].pop("resourceVersion")
+    s.create(snap)          # wrapper input materializes via entry deepcopy
+    assert s.count("Pod") == 2
+
+
+# ---------------------------------------------------------------------------
+# SSA upsert determinism (satellite: patch() create path)
+# ---------------------------------------------------------------------------
+
+def _ssa_create(store):
+    return store.patch(
+        "TpuCluster", "applied", "default",
+        {"spec": {"suspend": False}}, patch_type="apply",
+        field_manager="kubectl")
+
+
+def test_ssa_created_objects_use_uid_factory():
+    counter = iter(range(1, 100))
+    s = ObjectStore(uid_factory=lambda: f"det-uid-{next(counter):04d}")
+    created = s.create(obj("Pod", "first"))
+    applied = _ssa_create(s)
+    assert created["metadata"]["uid"] == "det-uid-0001"
+    assert applied["metadata"]["uid"] == "det-uid-0002", \
+        "SSA upsert must mint uids through the injected factory " \
+        "(deterministic replay), not uuid4"
+
+
+def test_ssa_create_replays_identically():
+    def run():
+        counter = iter(range(1, 100))
+        s = ObjectStore(uid_factory=lambda: f"sim-uid-{next(counter):06d}")
+        s.create(obj("Pod", "seed"))
+        out = _ssa_create(s)
+        md = out["metadata"]
+        return (md["uid"], md["resourceVersion"], md["generation"])
+
+    assert run() == run()
+
+
+# ---------------------------------------------------------------------------
+# backlog bisect
+# ---------------------------------------------------------------------------
+
+def test_events_since_bisect_matches_full_filter():
+    s = ObjectStore()
+    for i in range(50):
+        s.create(obj("Pod" if i % 2 else "Service", f"o{i:02d}"))
+    latest = s.resource_version()
+    for rv in (0, 1, 7, latest // 2, latest - 1, latest, latest + 5):
+        events, got_latest, truncated = s.events_since(rv)
+        with s._lock:
+            expect = [(erv, ev) for erv, ev in s._backlog if erv > rv]
+        assert events == expect, rv
+        assert got_latest == latest
+        ev_pods, _, _ = s.events_since(rv, kinds=("Pod",))
+        assert ev_pods == [(erv, ev) for erv, ev in expect
+                           if ev.kind == "Pod"]
+
+
+def test_events_since_truncation_contract_survives():
+    s = ObjectStore()
+    s._backlog_max = 10
+    for i in range(30):
+        s.create(obj("Pod", f"p{i:02d}"))
+    events, latest, truncated = s.events_since(1)
+    assert truncated
+    assert len(events) == 10
+    events, _, truncated = s.events_since(latest - 3)
+    assert not truncated and len(events) == 3
+
+
+def test_wait_for_events_returns_immediately_past_rv():
+    s = ObjectStore()
+    s.create(obj("Pod", "p"))
+    events, latest, truncated = s.wait_for_events(0, timeout=0.5)
+    assert events and not truncated
+    events, _, _ = s.wait_for_events(latest, timeout=0.05)
+    assert events == []
+
+
+# ---------------------------------------------------------------------------
+# dispatch modes
+# ---------------------------------------------------------------------------
+
+def test_async_dispatch_delivers_everything_in_commit_order():
+    s = ObjectStore(dispatch="async")
+    try:
+        got = []
+        lock = threading.Lock()
+
+        def watcher(ev):
+            with lock:
+                got.append((ev.type, ev.obj["metadata"]["name"],
+                            ev.obj["metadata"]["resourceVersion"]))
+
+        s.watch(watcher)
+        for i in range(40):
+            s.create(obj("Pod", f"p{i:02d}"))
+        s.delete("Pod", "p00")
+        assert s.flush_watch(timeout=10.0)
+        with lock:
+            rvs = [rv for _, _, rv in got]
+            assert rvs == sorted(rvs), "async delivery must keep commit order"
+            assert len(got) == 42    # 40 ADDED + MODIFIED(dts) + DELETED
+            assert got[-1][0] == Event.DELETED
+    finally:
+        s.close()
+
+
+def test_sync_dispatch_is_default_and_inline():
+    s = ObjectStore()
+    assert s._dispatch_mode == "sync"
+    seen = []
+    s.watch(lambda ev: seen.append(ev.type))
+    s.create(obj("Pod", "p"))
+    assert seen == [Event.ADDED]     # delivered before create() returned
+
+
+def test_watcher_mutating_store_from_callback_does_not_deadlock():
+    """A watcher that writes back into the store (the netpol-mapper
+    pattern) must drain its nested events inline without deadlocking
+    the sync dispatch path."""
+    s = ObjectStore()
+    seen = []
+
+    def reactor(ev):
+        seen.append((ev.type, ev.kind, ev.obj["metadata"]["name"]))
+        if ev.kind == "TpuCluster" and ev.type == Event.ADDED:
+            s.create(obj("NetworkPolicy",
+                         f"np-{ev.obj['metadata']['name']}"))
+
+    s.watch(reactor)
+    s.create(obj("TpuCluster", "c1"))
+    assert ("ADDED", "TpuCluster", "c1") in seen
+    assert ("ADDED", "NetworkPolicy", "np-c1") in seen
+    assert s.count("NetworkPolicy") == 1
+
+
+def test_subscriber_queue_overflow_drops_oldest_and_counts():
+    s = ObjectStore(watch_queue_max=5, dispatch="async")
+    try:
+        got = []
+        gate = threading.Event()
+
+        def slow_watcher(ev):
+            gate.wait(5.0)
+            got.append(ev.obj["metadata"]["name"])
+
+        s.watch(slow_watcher)
+        for i in range(30):
+            s.create(obj("Pod", f"p{i:02d}"))
+        gate.set()
+        s.flush_watch(timeout=10.0)
+        assert s.watch_dropped_total() > 0
+        assert len(got) >= 5         # the bounded tail still lands
+    finally:
+        s.close()

@@ -1,0 +1,30 @@
+#!/usr/bin/env sh
+# Control-plane hot-path benchmark smoke: a small scale-up storm must
+# converge and emit a parseable JSON result with nonzero reconcile
+# throughput.  This is the standing guard for the store/workqueue fast
+# path (docs/performance.md) — the full before/after numbers there were
+# produced by the same harness at --clusters 300:
+#
+#   tools/bench_controlplane.sh                   # smoke (8 clusters)
+#   BENCH_CLUSTERS=300 BENCH_WORKERS=4 tools/bench_controlplane.sh
+#
+# Part of the smoke-script family (tools/sim_smoke.sh, tools/obs_smoke.sh).
+set -eu
+cd "$(dirname "$0")/.."
+out=$(timeout -k 10 300 env JAX_PLATFORMS=cpu python benchmark/controlplane_bench.py \
+    --clusters "${BENCH_CLUSTERS:-8}" \
+    --slices "${BENCH_SLICES:-2}" \
+    --workers "${BENCH_WORKERS:-4}" \
+    --dispatch "${BENCH_DISPATCH:-async}" \
+    --timeout "${BENCH_TIMEOUT:-120}")
+echo "$out"
+BENCH_JSON="$out" python - <<'EOF'
+import json, os
+r = json.loads(os.environ["BENCH_JSON"])
+assert r["converged"], f"storm did not converge: {r}"
+assert r["reconciles_per_sec"] > 0, f"no reconcile throughput: {r}"
+assert r["store_writes"] > 0 and r["events"] > 0, f"no store traffic: {r}"
+print(f"bench smoke ok: {r['reconciles_per_sec']} reconciles/s, "
+      f"{r['events_per_sec']} events/s, "
+      f"store write p99 {r['store_write_p99_ms']} ms")
+EOF
