@@ -8,29 +8,35 @@ numbers isolate exactly the paths the indexed-store/CoW-read/off-lock
 fan-out/workqueue overhaul touches (docs/performance.md).
 
     python benchmark/controlplane_bench.py --clusters 24 --workers 4
-    python benchmark/controlplane_bench.py --clusters 24 --workers 1 \
-        --dispatch sync
+    python benchmark/controlplane_bench.py --clusters 3000 --shards 4 \
+        --template light
 
-Emits ONE JSON object on stdout:
+Emits ONE JSON object on stdout (the ``tpu-bench/v1`` artifact schema
+the scale ladder commits under benchmark/results/ — see
+``ARTIFACT_KEYS``):
 
-    {"events_per_sec": ..., "reconciles_per_sec": ...,
-     "reconcile_p50_ms": ..., "reconcile_p99_ms": ...,
-     "store_write_p99_ms": ..., ...}
+    {"schema": "tpu-bench/v1", "events_per_sec": ...,
+     "reconciles_per_sec": ..., "store_write_p99_ms": ...,
+     "workqueue_depth_max": ..., "workqueue_wait_p99_ms": ...,
+     "rss_peak_mib": ..., ...}
 
-Runs against older checkouts too (``--dispatch`` degrades gracefully
-when the store predates dispatch modes), which is how the before/after
-table in docs/performance.md was produced.
+Runs against older checkouts too (``--dispatch``/``--shards`` degrade
+gracefully when the store/manager predate them), which is how the
+before/after tables in docs/performance.md were produced.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import threading
 import time
 
-sys.path.insert(0, ".")
+# Anchor imports on the repo root (this file's parent's parent), not the
+# CWD — the harness must work from any invocation directory.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from kuberay_tpu.controlplane.cluster_controller import TpuClusterController  # noqa: E402
 from kuberay_tpu.controlplane.fake_kubelet import FakeKubelet  # noqa: E402
@@ -76,19 +82,50 @@ def _template(role: str) -> dict:
     }
 
 
-def cluster_manifest(i: int, topology: str, slices: int) -> dict:
+def _light_template(role: str) -> dict:
+    """Minimal pod template for orchestration-scale rungs (the
+    clusterloader2 shape): at 10k clusters the production template's
+    per-object weight dominates RSS, which is a different experiment —
+    the ladder isolates control-plane throughput."""
+    return {"spec": {"containers": [{"name": role, "image": "rt:bench"}]}}
+
+
+def cluster_manifest(i: int, topology: str, slices: int,
+                     accelerator: str = "v5p",
+                     template: str = "production") -> dict:
+    tmpl = _template if template == "production" else _light_template
     return {
         "apiVersion": C.API_VERSION, "kind": C.KIND_CLUSTER,
-        "metadata": {"name": f"storm-{i:04d}", "namespace": "default"},
+        "metadata": {"name": f"storm-{i:05d}", "namespace": "default"},
         "spec": {
-            "headGroupSpec": {"template": _template("head")},
+            "headGroupSpec": {"template": tmpl("head")},
             "workerGroupSpecs": [{
-                "groupName": "workers", "accelerator": "v5p",
+                "groupName": "workers", "accelerator": accelerator,
                 "topology": topology, "replicas": slices,
                 "maxReplicas": max(slices, 1),
-                "template": _template("worker")}],
+                "template": tmpl("worker")}],
         },
     }
+
+
+def vm_rss_mib() -> float:
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) / 1024.0
+    except OSError:
+        pass
+    return 0.0
+
+
+def rss_peak_mib() -> float:
+    """Process high-water RSS (ru_maxrss is KiB on Linux)."""
+    try:
+        import resource
+        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+    except Exception:
+        return 0.0
 
 
 def quantile(sorted_samples, q: float) -> float:
@@ -141,8 +178,10 @@ class _Timed:
                 self.samples.append(dt)
 
 
-def build_store(dispatch: str) -> ObjectStore:
+def build_store(dispatch: str, backlog_max: int = 0) -> ObjectStore:
     try:
+        if backlog_max:
+            return ObjectStore(dispatch=dispatch, backlog_max=backlog_max)
         return ObjectStore(dispatch=dispatch)
     except TypeError:
         # Pre-overhaul store (the "before" leg of docs/performance.md):
@@ -150,12 +189,47 @@ def build_store(dispatch: str) -> ObjectStore:
         return ObjectStore()
 
 
+class _QueueStats:
+    """Wraps the metrics facade's workqueue hooks to keep raw samples
+    (the registry only has histogram buckets; the artifact wants
+    interpolated quantiles + max depth)."""
+
+    def __init__(self, metrics):
+        self._metrics = metrics
+        self._lock = threading.Lock()
+        self.depth_max = 0
+        self.waits = []
+
+    def __getattr__(self, name):
+        return getattr(self._metrics, name)
+
+    def workqueue_depth(self, queue, depth):
+        with self._lock:
+            if depth > self.depth_max:
+                self.depth_max = depth
+        self._metrics.workqueue_depth(queue, depth)
+
+    def workqueue_latency(self, queue, seconds):
+        with self._lock:
+            self.waits.append(seconds)
+        self._metrics.workqueue_latency(queue, seconds)
+
+
 def run_storm(clusters: int, slices: int, topology: str, workers: int,
               dispatch: str, timeout: float,
-              sched_latency_ms: float = 2.0) -> dict:
-    store = build_store(dispatch)
-    metrics = ControlPlaneMetrics()
-    manager = Manager(store, metrics=metrics)
+              sched_latency_ms: float = 2.0, shards: int = 1,
+              accelerator: str = "v5p",
+              template: str = "production",
+              backlog_max: int = 0) -> dict:
+    rss0 = vm_rss_mib()
+    store = build_store(dispatch, backlog_max=backlog_max)
+    metrics = _QueueStats(ControlPlaneMetrics())
+    try:
+        manager = Manager(store, metrics=metrics, shards=shards)
+    except TypeError:
+        # Pre-sharding manager (older checkout): single pool only.
+        manager = Manager(store, metrics=metrics)
+        shards = 1
     controller = TpuClusterController(
         store, expectations=manager.expectations, metrics=metrics,
         scheduler=_AdmissionScheduler(sched_latency_ms / 1e3))
@@ -196,17 +270,22 @@ def run_storm(clusters: int, slices: int, topology: str, workers: int,
     kt.start()
     t0 = time.perf_counter()
     for i in range(clusters):
-        store.create(cluster_manifest(i, topology, slices))
+        store.create(cluster_manifest(i, topology, slices,
+                                      accelerator=accelerator,
+                                      template=template))
     create_phase = time.perf_counter() - t0
 
     deadline = t0 + timeout
     ready = 0
+    # Readiness polling scales with the rung: a 10 ms full-list poll at
+    # 10k clusters would burn a core in the measuring loop itself.
+    poll = min(0.25, max(0.01, clusters / 20000.0))
     while time.perf_counter() < deadline:
         ready = sum(1 for c in store.list(C.KIND_CLUSTER)
                     if c.get("status", {}).get("state") == "ready")
         if ready >= clusters:
             break
-        time.sleep(0.01)
+        time.sleep(poll)
     elapsed = time.perf_counter() - t0
     stop.set()
     manager.stop()
@@ -217,11 +296,19 @@ def run_storm(clusters: int, slices: int, topology: str, workers: int,
 
     rec = sorted(reconcile.samples)
     wr = sorted(writes.samples)
+    with metrics._lock:
+        waits = sorted(metrics.waits)
+        depth_max = metrics.depth_max
     events = store.resource_version()
+    evictions = (store.backlog_evictions_total()
+                 if hasattr(store, "backlog_evictions_total") else 0)
     return {
+        "schema": "tpu-bench/v1",
         "workload": {"clusters": clusters, "slices_per_cluster": slices,
-                     "topology": topology, "pods": store.count("Pod"),
-                     "workers": workers, "dispatch": dispatch,
+                     "topology": topology, "accelerator": accelerator,
+                     "template": template, "pods": store.count("Pod"),
+                     "workers": workers, "shards": shards,
+                     "dispatch": dispatch,
                      "sched_latency_ms": sched_latency_ms},
         "ready_clusters": ready,
         "converged": ready >= clusters,
@@ -236,7 +323,23 @@ def run_storm(clusters: int, slices: int, topology: str, workers: int,
         "store_writes": len(wr),
         "store_write_p50_ms": round(quantile(wr, 0.50) * 1e3, 3),
         "store_write_p99_ms": round(quantile(wr, 0.99) * 1e3, 3),
+        "workqueue_depth_max": depth_max,
+        "workqueue_wait_p50_ms": round(quantile(waits, 0.50) * 1e3, 3),
+        "workqueue_wait_p99_ms": round(quantile(waits, 0.99) * 1e3, 3),
+        "watch_backlog_evictions": evictions,
+        "rss_mib": round(vm_rss_mib() - rss0, 1),
+        "rss_peak_mib": round(rss_peak_mib(), 1),
     }
+
+
+#: The artifact contract tools/bench_scale.sh asserts: every ladder rung
+#: JSON must carry at least these keys.
+ARTIFACT_KEYS = (
+    "schema", "workload", "ready_clusters", "converged", "elapsed_s",
+    "events", "events_per_sec", "reconciles", "reconciles_per_sec",
+    "store_writes", "store_write_p99_ms", "workqueue_depth_max",
+    "workqueue_wait_p99_ms", "rss_peak_mib",
+)
 
 
 def main(argv=None) -> int:
@@ -247,19 +350,39 @@ def main(argv=None) -> int:
                     help="worker slices per cluster")
     ap.add_argument("--topology", default="2x2x2",
                     help="v5p slice topology (2x2x2 = 2 hosts/slice)")
-    ap.add_argument("--workers", type=int, default=4)
+    ap.add_argument("--workers", type=int, default=4,
+                    help="reconcile worker threads PER SHARD")
+    ap.add_argument("--shards", type=int, default=1,
+                    help="hash-sharded reconcile pools (sharding.py)")
+    ap.add_argument("--accelerator", default="v5p")
+    ap.add_argument("--template", default="production",
+                    choices=("production", "light"),
+                    help="pod template weight: production (16 env vars, "
+                         "resources — honest read cost) or light (the "
+                         "clusterloader2 orchestration-scale shape)")
     ap.add_argument("--dispatch", default="async",
                     choices=("sync", "async"))
+    ap.add_argument("--backlog-max", type=int, default=0,
+                    help="store watch-backlog window (0 = store default)")
     ap.add_argument("--timeout", type=float, default=120.0)
     ap.add_argument("--sched-latency-ms", type=float, default=2.0,
                     help="blocking gang-admission latency per cluster "
                          "reconcile (models the batch-scheduler network "
                          "round-trip; 0 disables)")
+    ap.add_argument("--out", default="",
+                    help="also write the artifact JSON to this path")
     args = ap.parse_args(argv)
     result = run_storm(args.clusters, args.slices, args.topology,
                        args.workers, args.dispatch, args.timeout,
-                       sched_latency_ms=args.sched_latency_ms)
-    print(json.dumps(result, sort_keys=True))
+                       sched_latency_ms=args.sched_latency_ms,
+                       shards=args.shards, accelerator=args.accelerator,
+                       template=args.template,
+                       backlog_max=args.backlog_max)
+    text = json.dumps(result, sort_keys=True)
+    print(text)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
     return 0 if result["converged"] else 1
 
 

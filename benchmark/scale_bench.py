@@ -11,18 +11,30 @@ benchmarks isolate the operator.
 
     python benchmark/scale_bench.py --clusters 1000
     python benchmark/scale_bench.py --jobs 100
+    python benchmark/scale_bench.py --ladder 300,1000,3000,10000 \
+        --ladder-shards 1,4 --out benchmark/results/ladder.json
 
-Outputs one JSON line per phase (compatible with BENCH recording).
+Outputs one JSON line per phase (compatible with BENCH recording);
+``--ladder`` runs the published clusterloader2-shaped rung set — each
+(rung, shards) leg in its own subprocess of
+``controlplane_bench.py`` so every leg gets an independent RSS
+envelope — and writes ONE ``tpu-bench-ladder/v1`` artifact whose rungs
+all carry the ``tpu-bench/v1`` schema (docs/performance.md trendline).
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
 import sys
 import time
 
-sys.path.insert(0, ".")
+# Anchor imports on the repo root, not the CWD — the harness must work
+# from any invocation directory.
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO_ROOT)
 
 from kuberay_tpu.api.config import OperatorConfiguration  # noqa: E402
 from kuberay_tpu.operator import Operator  # noqa: E402
@@ -67,10 +79,20 @@ def vm_rss_mib() -> float:
     return 0.0
 
 
-def run_cluster_scale(n: int, timeout: float) -> dict:
+def rss_peak_mib() -> float:
+    """Process high-water RSS (ru_maxrss is KiB on Linux)."""
+    try:
+        import resource
+        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+    except Exception:
+        return 0.0
+
+
+def run_cluster_scale(n: int, timeout: float, shards: int = 1) -> dict:
     rss0 = vm_rss_mib()
     coord = FakeCoordinatorClient()
-    op = Operator(OperatorConfiguration(reconcileConcurrency=4),
+    op = Operator(OperatorConfiguration(reconcileConcurrency=4,
+                                        shardCount=shards),
                   client_provider=lambda s: coord, fake_kubelet=True)
     op.start(api_port=0)
     t0 = time.time()
@@ -80,21 +102,46 @@ def run_cluster_scale(n: int, timeout: float) -> dict:
 
     deadline = time.time() + timeout
     ready = 0
+    poll = min(0.25, max(0.02, n / 20000.0))
     while time.time() < deadline:
         ready = sum(
             1 for c in op.store.list(C.KIND_CLUSTER)
             if c.get("status", {}).get("state") == "ready")
         if ready >= n:
             break
-        time.sleep(0.2)
+        time.sleep(poll)
     elapsed = time.time() - t0
     pods = op.store.count("Pod")
+    events = op.store.resource_version()
+    # Reconcile count from the operator's own registry (the _timed
+    # wrapper counts tpu_reconcile_total per kind).
+    reconciles = int(sum(
+        v for (name, _), v in op.metrics.registry._counters.items()
+        if name == "tpu_reconcile_total"))
     rss = round(vm_rss_mib() - rss0, 1)
     op.stop()
     return {
         "metric": "tpucluster_scale_all_ready_seconds",
         "value": round(elapsed, 2),
         "unit": "s",
+        # tpu-bench/v1 parity with controlplane_bench.py so ladder
+        # tooling consumes either harness's output unchanged.
+        "schema": "tpu-bench/v1",
+        "workload": {"clusters": n, "slices_per_cluster": 1,
+                     "topology": "2x2", "accelerator": "v5e",
+                     "template": "light", "pods": pods,
+                     "workers": 4, "shards": shards, "dispatch": "sync",
+                     "sched_latency_ms": 0.0},
+        "ready_clusters": ready,
+        "converged": ready >= n,
+        "elapsed_s": round(elapsed, 3),
+        "create_phase_s": round(created, 3),
+        "events": events,
+        "events_per_sec": round(events / elapsed, 1),
+        "reconciles": reconciles,
+        "reconciles_per_sec": round(reconciles / elapsed, 1),
+        "rss_mib": rss,
+        "rss_peak_mib": round(rss_peak_mib(), 1),
         "detail": {"clusters": n, "ready": ready, "pods": pods,
                    "create_phase_s": round(created, 2),
                    "clusters_per_s": round(n / elapsed, 1),
@@ -213,25 +260,100 @@ def run_memory_bench(timeout: float) -> dict:
     }
 
 
+def run_ladder(rungs, shard_list, timeout: float, workers: int = 4,
+               template: str = "light") -> dict:
+    """The published scale ladder: every (rung, shards) leg runs
+    ``controlplane_bench.py`` in its own subprocess (independent RSS
+    envelope per leg, like the memory bench) with the orchestration-
+    scale workload shape — 1 single-host slice per cluster (v5e 2x2),
+    light templates — and a watch backlog sized so the storm itself is
+    resumable (the 10k rung emits far more than the 10k default).
+    """
+    bench = os.path.join(_REPO_ROOT, "benchmark", "controlplane_bench.py")
+    legs = []
+    for n in rungs:
+        for shards in shard_list:
+            cmd = [sys.executable, bench,
+                   "--clusters", str(n), "--shards", str(shards),
+                   "--workers", str(workers), "--slices", "1",
+                   "--topology", "2x2", "--accelerator", "v5e",
+                   "--template", template,
+                   "--backlog-max", str(max(10000, 16 * n)),
+                   "--timeout", str(timeout)]
+            print(f"# ladder leg: clusters={n} shards={shards}",
+                  file=sys.stderr, flush=True)
+            t0 = time.time()
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=timeout + 300)
+            try:
+                leg = json.loads(proc.stdout.strip().splitlines()[-1])
+            except (ValueError, IndexError):
+                leg = {"schema": "tpu-bench/v1", "converged": False,
+                       "error": (proc.stderr or proc.stdout)[-2000:],
+                       "workload": {"clusters": n, "shards": shards}}
+            leg["leg_wall_s"] = round(time.time() - t0, 1)
+            legs.append(leg)
+            print(json.dumps(leg, sort_keys=True), flush=True)
+    return {
+        "schema": "tpu-bench-ladder/v1",
+        "rungs": sorted(rungs),
+        "shards": sorted(shard_list),
+        "workers_per_shard": workers,
+        "legs": legs,
+        "converged": all(leg.get("converged") for leg in legs),
+    }
+
+
+def _int_list(spec: str):
+    return [int(x) for x in spec.split(",") if x.strip()]
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--clusters", type=int, default=0)
+    ap.add_argument("--shards", type=int, default=1,
+                    help="reconcile shard pools for --clusters mode")
     ap.add_argument("--jobs", type=int, default=0)
     ap.add_argument("--memory", action="store_true",
                     help="run the 150-pod operator memory envelope")
     ap.add_argument("--memory-exp", default="",
                     help=argparse.SUPPRESS)   # internal: one experiment
+    ap.add_argument("--ladder", default="",
+                    help="comma-separated rungs, e.g. 300,1000,3000,10000: "
+                         "run the published scale ladder via "
+                         "controlplane_bench subprocesses")
+    ap.add_argument("--ladder-shards", default="1,4",
+                    help="shard counts per rung (comma-separated)")
+    ap.add_argument("--ladder-workers", type=int, default=4,
+                    help="worker threads per shard on each leg")
+    ap.add_argument("--out", default="",
+                    help="write the final JSON artifact to this path")
     ap.add_argument("--timeout", type=float, default=1800.0)
     args = ap.parse_args(argv)
     if args.memory_exp:
         print(json.dumps(_memory_experiment(args.memory_exp, args.timeout)),
               flush=True)
         return
+
+    def emit(doc):
+        print(json.dumps(doc, sort_keys=True), flush=True)
+        if args.out:
+            os.makedirs(os.path.dirname(os.path.abspath(args.out)),
+                        exist_ok=True)
+            with open(args.out, "w") as f:
+                json.dump(doc, f, sort_keys=True, indent=1)
+                f.write("\n")
+
+    if args.ladder:
+        emit(run_ladder(_int_list(args.ladder),
+                        _int_list(args.ladder_shards),
+                        args.timeout, workers=args.ladder_workers))
+        return
     if not args.clusters and not args.jobs and not args.memory:
         args.clusters = 100
     if args.clusters:
-        print(json.dumps(run_cluster_scale(args.clusters, args.timeout)),
-              flush=True)
+        emit(run_cluster_scale(args.clusters, args.timeout,
+                               shards=args.shards))
     if args.jobs:
         print(json.dumps(run_job_scale(args.jobs, args.timeout)), flush=True)
     if args.memory:

@@ -925,3 +925,65 @@ class TpuEnvCompletenessRule(Rule):
                     "environ" not in dotted(node.func.value):
                 record(classify(node.args[0]))
         return env_set, sel_set
+
+
+# ---------------------------------------------------------------------------
+# 9. shard-affinity
+# ---------------------------------------------------------------------------
+
+#: Identifier segments that name a reconcile work pool.  Exact-segment
+#: match (``self._pool.add`` hits, ``used.add`` on a set does not).
+_POOL_SEGMENTS = {"wq", "_wq", "pool", "_pool", "pools", "_pools",
+                  "workqueue", "work_queue"}
+#: Modules allowed to touch pools directly: the queue itself, the shard
+#: router, and the Manager (whose ``enqueue`` IS the router surface).
+_SHARD_ROUTER_PATHS = ("controlplane/workqueue.py",
+                       "controlplane/sharding.py",
+                       "controlplane/manager.py")
+_POOL_TYPES = {"WorkQueue", "ShardedQueuePool"}
+
+
+@rule
+class ShardAffinityRule(Rule):
+    """Every reconcile enqueue must go through the shard router
+    (``Manager.enqueue`` → ``ShardedQueuePool`` → crc32 ``shard_of``).
+    A direct ``.add()``/``.add_after()`` on a work pool — or a privately
+    constructed ``WorkQueue`` — can land a key in the wrong pool, and
+    the moment one key lives in two pools the global per-key
+    serialization guarantee is gone: two workers reconcile the same
+    object and race their status writes, the exact bug class the
+    workqueue overhaul removed (docs/scaling.md).  Only the queue, the
+    router, and the Manager may touch pools directly.
+    """
+
+    NAME = "shard-affinity"
+    DESCRIPTION = ("reconcile enqueues must route through Manager.enqueue "
+                   "(the shard router); no direct pool add/add_after or "
+                   "private WorkQueue outside the router modules")
+    INVARIANT = ("a reconcile key lives in exactly one pool: global "
+                 "per-key serialization survives sharding")
+
+    def check(self, tree: ast.Module, ctx: FileContext) -> Iterable[Finding]:
+        path = ctx.path.replace("\\", "/")
+        if any(path.endswith(allowed) for allowed in _SHARD_ROUTER_PATHS):
+            return
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if isinstance(func, ast.Name) and func.id in _POOL_TYPES:
+                yield self.finding(
+                    ctx, node,
+                    f"{func.id} constructed outside the shard-router "
+                    "modules; a private pool bypasses hash routing — "
+                    "enqueue through Manager.enqueue instead")
+            if isinstance(func, ast.Attribute) and \
+                    func.attr in ("add", "add_after"):
+                segments = dotted(func.value).lower().split(".")
+                if any(seg in _POOL_SEGMENTS for seg in segments):
+                    yield self.finding(
+                        ctx, node,
+                        f"direct pool .{func.attr}() bypasses the shard "
+                        "router: the key may land in a pool its hash "
+                        "does not own, breaking global per-key "
+                        "serialization — use Manager.enqueue")

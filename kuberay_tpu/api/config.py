@@ -18,7 +18,20 @@ class OperatorConfiguration(Serializable):
     probeAddr: str = ":8082"
     enableLeaderElection: bool = True
     leaderElectionNamespace: str = "default"
+    # Workers PER SHARD (each shard pool gets its own reconcile threads):
     reconcileConcurrency: int = 1
+    # Hash-sharded reconcile pools (controlplane/sharding.py): keys
+    # partition across this many worker pools; 1 = the classic single
+    # queue.  Multi-process deployments split ownership via per-shard
+    # leases (--shard-leases), capped at maxOwnedShards per replica
+    # (0 = own every shard you can grab).
+    shardCount: int = 1
+    maxOwnedShards: int = 0
+    # Watch backlog window (events resumable by rv before ExpiredError
+    # forces a relist) and bookmark cadence (BOOKMARK progress event to
+    # subscribers every N committed rvs; 0 = off):
+    watchBacklogMax: int = 10000
+    watchBookmarkInterval: int = 0
     watchNamespaces: List[str] = dataclasses.field(default_factory=list)
     logLevel: str = "info"
     logFile: str = ""

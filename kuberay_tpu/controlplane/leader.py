@@ -7,6 +7,21 @@ A ``Lease`` object in the store is the lock: the holder renews
 through optimistic-concurrency updates, so exactly one candidate can win
 any given transition — the single-writer-per-CR guarantee multi-replica
 operators need.
+
+Two granularities:
+
+- :class:`LeaderElector` — the classic whole-operator lease
+  (``kuberay-tpu-operator-leader``): one replica reconciles, the rest
+  stand by.
+- :class:`ShardLeaseElector` — one lease **per reconcile shard**
+  (``kuberay-tpu-operator-shard-<i>``, sharding.py): N operator
+  processes split the shard set instead of N-1 of them idling.  Each
+  shard still has exactly one holder at a time (same optimistic-update
+  lock), so the global per-key serialization guarantee survives the
+  split: key -> exactly one shard -> exactly one holder -> exactly one
+  worker.  ``max_owned`` caps how many shards one process grabs, which
+  is what makes the split balance instead of first-runner-takes-all
+  (docs/scaling.md).
 """
 
 from __future__ import annotations
@@ -25,8 +40,13 @@ from kuberay_tpu.controlplane.store import (
 )
 
 LEASE_NAME = "kuberay-tpu-operator-leader"
+SHARD_LEASE_PREFIX = "kuberay-tpu-operator-shard-"
 
 _LOG = logging.getLogger("kuberay_tpu.leader")
+
+
+def shard_lease_name(shard: int) -> str:
+    return f"{SHARD_LEASE_PREFIX}{shard}"
 
 
 class LeaderElector:
@@ -35,10 +55,12 @@ class LeaderElector:
                  lease_duration: float = 15.0,
                  renew_interval: float = 5.0,
                  on_started_leading: Optional[Callable[[], None]] = None,
-                 on_stopped_leading: Optional[Callable[[], None]] = None):
+                 on_stopped_leading: Optional[Callable[[], None]] = None,
+                 lease_name: str = LEASE_NAME):
         self.store = store
         self.identity = identity or f"operator-{uuid.uuid4().hex[:8]}"
         self.namespace = namespace
+        self.lease_name = lease_name
         self.lease_duration = lease_duration
         self.renew_interval = renew_interval
         self.on_started_leading = on_started_leading
@@ -55,12 +77,12 @@ class LeaderElector:
 
     def _try_acquire_or_renew(self) -> bool:
         now = time.time()
-        lease = self.store.try_get("Lease", LEASE_NAME, self.namespace)
+        lease = self.store.try_get("Lease", self.lease_name, self.namespace)
         if lease is None:
             try:
                 self.store.create({
                     "apiVersion": "coordination.k8s.io/v1", "kind": "Lease",
-                    "metadata": {"name": LEASE_NAME,
+                    "metadata": {"name": self.lease_name,
                                  "namespace": self.namespace},
                     "spec": {"holderIdentity": self.identity,
                              "renewTime": now,
@@ -126,14 +148,146 @@ class LeaderElector:
         was_leader = self._is_leader
         self._is_leader = False
         if release and was_leader:
-            # Graceful handoff: zero the renew time so a successor takes
-            # over immediately instead of waiting out the lease.
+            self._release_lease()
+
+    def _release_lease(self):
+        # Graceful handoff: zero the renew time so a successor takes
+        # over immediately instead of waiting out the lease.
+        try:
+            lease = self.store.try_get("Lease", self.lease_name,
+                                       self.namespace)
+            if lease is not None and \
+                    lease["spec"].get("holderIdentity") == self.identity:
+                lease["spec"]["renewTime"] = 0.0
+                self.store.update(lease)
+        except (Conflict, NotFound):
+            pass
+
+
+class ShardLeaseElector:
+    """Per-shard lease ownership for a sharded control plane.
+
+    One ``Lease`` per reconcile shard; each tick this process renews the
+    shards it holds and tries to acquire unheld/expired ones, up to
+    ``max_owned``.  The cap is the balancing mechanism: with R replicas
+    and S shards, run each with ``max_owned = ceil(S / R)`` and the
+    fleet converges to an even split — a dead replica's shards expire
+    and are absorbed by survivors (who may exceed their cap only via
+    explicit ``None``).
+
+    ``on_acquired(shard)`` / ``on_released(shard)`` fire on ownership
+    edges, on the elector thread: wire them to
+    :meth:`Manager.acquire_shard` / :meth:`Manager.release_shard` — the
+    release path pauses + drains the pool BEFORE the lease can move, so
+    a successor never overlaps in-flight reconciles.
+    """
+
+    def __init__(self, store: ObjectStore, shards: int,
+                 identity: Optional[str] = None,
+                 namespace: str = "default",
+                 lease_duration: float = 15.0,
+                 renew_interval: float = 5.0,
+                 max_owned: Optional[int] = None,
+                 on_acquired: Optional[Callable[[int], None]] = None,
+                 on_released: Optional[Callable[[int], None]] = None):
+        if shards < 1:
+            raise ValueError(f"shards must be >= 1, got {shards}")
+        self.shards = shards
+        self.identity = identity or f"operator-{uuid.uuid4().hex[:8]}"
+        self.max_owned = max_owned
+        self.renew_interval = renew_interval
+        self.on_acquired = on_acquired
+        self.on_released = on_released
+        # One (thread-less) elector per shard lease: reuses the exact
+        # acquire/renew/takeover optimistic-update logic of the
+        # whole-operator lease.
+        self._electors = [
+            LeaderElector(store, identity=self.identity,
+                          namespace=namespace,
+                          lease_duration=lease_duration,
+                          renew_interval=renew_interval,
+                          lease_name=shard_lease_name(i))
+            for i in range(shards)
+        ]
+        self._owned: set = set()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def owned(self) -> set:
+        with self._lock:
+            return set(self._owned)
+
+    def tick(self):
+        """One acquire/renew pass over every shard lease (also the
+        deterministic test entry point — no thread required)."""
+        for shard, elector in enumerate(self._electors):
+            with self._lock:
+                holding = shard in self._owned
+                at_cap = (self.max_owned is not None
+                          and len(self._owned) >= self.max_owned)
+            if not holding and at_cap:
+                continue   # leave unheld shards for other replicas
             try:
-                lease = self.store.try_get("Lease", LEASE_NAME,
-                                           self.namespace)
-                if lease is not None and \
-                        lease["spec"].get("holderIdentity") == self.identity:
-                    lease["spec"]["renewTime"] = 0.0
-                    self.store.update(lease)
-            except (Conflict, NotFound):
-                pass
+                won = elector._try_acquire_or_renew()
+            except Exception:
+                _LOG.exception("shard %d lease tick failed", shard)
+                won = False
+            if won and not holding:
+                with self._lock:
+                    self._owned.add(shard)
+                self._edge(self.on_acquired, shard, "acquired")
+            elif not won and holding:
+                # Lost the renewal race (or the lease was taken over):
+                # release locally FIRST so the drain happens before we
+                # ever try to re-acquire.
+                with self._lock:
+                    self._owned.discard(shard)
+                self._edge(self.on_released, shard, "released")
+
+    def _edge(self, cb: Optional[Callable[[int], None]], shard: int,
+              what: str):
+        if cb is None:
+            return
+        try:
+            cb(shard)
+        except Exception:
+            # Callback bugs must not kill renewal — but silently
+            # "owning" a shard whose reconcilers never started (or
+            # never drained) is worse than noisy, so log loudly.
+            _LOG.exception("shard %d on_%s callback failed", shard, what)
+
+    def release_shard(self, shard: int):
+        """Voluntarily shed one shard (rebalance / graceful shutdown):
+        local release + zeroed renewTime so a peer absorbs it now."""
+        with self._lock:
+            if shard not in self._owned:
+                return
+            self._owned.discard(shard)
+        self._edge(self.on_released, shard, "released")
+        self._electors[shard]._release_lease()
+
+    def _loop(self, stop: threading.Event):
+        while not stop.is_set():
+            self.tick()
+            stop.wait(self.renew_interval)
+
+    def start(self):
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop,
+                                        args=(self._stop,), daemon=True,
+                                        name="shard-lease-elector")
+        self._thread.start()
+
+    def stop(self, release: bool = True):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+        for shard in sorted(self.owned()):
+            if release:
+                self.release_shard(shard)
+            else:
+                with self._lock:
+                    self._owned.discard(shard)
+                self._edge(self.on_released, shard, "released")

@@ -24,8 +24,13 @@ import time
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from kuberay_tpu.controlplane.expectations import HEAD_GROUP, ScaleExpectations
-from kuberay_tpu.controlplane.store import Conflict, Event, ObjectStore
-from kuberay_tpu.controlplane.workqueue import WorkQueue
+from kuberay_tpu.controlplane.sharding import ShardedQueuePool, ShardSet
+from kuberay_tpu.controlplane.store import (
+    Conflict,
+    Event,
+    ExpiredError,
+    ObjectStore,
+)
 from kuberay_tpu.obs.trace import NOOP_TRACER
 from kuberay_tpu.utils import constants as C
 
@@ -37,7 +42,9 @@ _LOG = logging.getLogger("kuberay_tpu.manager")
 class Manager:
     def __init__(self, store: ObjectStore,
                  expectations: Optional[ScaleExpectations] = None,
-                 clock=None, metrics=None, tracer=None, flight=None):
+                 clock=None, metrics=None, tracer=None, flight=None,
+                 shards: int = 1, shard_of=None,
+                 owned_shards: Optional[set] = None):
         self.store = store
         # ``clock`` is any object with ``.now() -> float`` (duck-typed so
         # controlplane does not depend on the sim package).  Timed
@@ -61,7 +68,28 @@ class Manager:
         self._reconcilers: Dict[str, Callable[[str, str], Optional[float]]] = {}
         # kinds whose owned objects (by label) map back to an owner kind:
         self._owned_maps: List[Callable[[Event], Optional[Key]]] = []
-        self._wq = WorkQueue(now_fn=self._now, metrics=metrics)
+        # ``shards``: hash-partition reconcile keys across N worker
+        # pools (sharding.py).  A key hashes to exactly one pool, so
+        # per-key serialization holds globally; shards=1 is the
+        # single-queue behavior (byte-identical processing order — the
+        # chaos-replay contract).  ``shard_of`` overrides the hash for
+        # tests/custom placement; ``owned_shards`` limits which shards
+        # this process reconciles (per-shard lease mode — the others'
+        # queues accumulate paused).
+        self.shards = max(1, shards)
+        self._pool = ShardedQueuePool(self.shards, now_fn=self._now,
+                                      metrics=metrics,
+                                      **({"shard_fn": shard_of}
+                                         if shard_of is not None else {}))
+        self._owned = ShardSet(self.shards, owned=owned_shards)
+        for i in range(self.shards):
+            if not self._owned.owns(i):
+                self._pool.pause_shard(i)
+        # High-water resourceVersion seen on the watch path (events and
+        # bookmarks).  This is the informer's resume point: after a
+        # disconnect, ``resume()`` replays only events past it — O(delta)
+        # rejoin instead of relisting the world (docs/scaling.md).
+        self._last_rv = 0
         self._threads: List[threading.Thread] = []
         self._stop = False
         self._stop_event = threading.Event()
@@ -80,7 +108,15 @@ class Manager:
     # -- event plumbing ----------------------------------------------------
 
     def _on_event(self, ev: Event):
+        if ev.type == Event.BOOKMARK:
+            # Progress marker, not state: advance the resume point past
+            # spans this informer saw nothing in (backlog-evicted or
+            # filtered), so a later ``resume()`` stays O(delta).
+            rv = ev.obj.get("metadata", {}).get("resourceVersion", 0)
+            self._observe_rv(rv)
+            return
         md = ev.obj.get("metadata", {})
+        self._observe_rv(md.get("resourceVersion", 0))
         if self.flight is not None:
             self.flight.observe_event(ev)
         # Expectations observe pod churn (ref expectations consumption at
@@ -109,19 +145,37 @@ class Manager:
                 if key[0] in self._reconcilers:
                     self.enqueue(key)
 
+    def _observe_rv(self, rv) -> None:
+        """Advance the watch high-water mark (single watch-delivery
+        thread per dispatch mode; a stale concurrent write can only
+        lower the resume point, never corrupt it — resume would just
+        replay a few already-seen events, which level-triggered
+        consumers absorb)."""
+        if isinstance(rv, int) and rv > self._last_rv:
+            self._last_rv = rv
+
     def enqueue(self, key: Key, after: float = 0.0):
         # Trace context attaches at scheduling time, delayed or not: the
         # eventual queue-wait span must cover requeue backoff (that wait
         # is real latency the slice-ready decomposition has to account
         # for).  queued() keeps the earliest pending instant on dedup.
+        # The pool routes by the stable key hash — the ONLY enqueue
+        # path (analysis rule shard-affinity), which is what keeps a
+        # key in exactly one pool.
         self.tracer.queued(key, self._now(), delayed=after > 0)
         if after > 0:
-            self._wq.add_after(key, after)
+            self._pool.add_after(key, after)
         else:
-            self._wq.add(key)
+            self._pool.add(key)
+
+    def shard_of(self, key: Key) -> int:
+        return self._pool.shard_of(key)
 
     def _pop(self, block: bool) -> Optional[Key]:
-        return self._wq.get(block=block)
+        # Deterministic round-robin across pools (single-threaded
+        # drain); worker threads use their pinned-shard get instead.
+        del block
+        return self._pool.get_any()
 
     # -- execution ---------------------------------------------------------
 
@@ -129,7 +183,7 @@ class Manager:
         kind, ns, name = key
         fn = self._reconcilers.get(kind)
         if fn is None:
-            self._wq.done(key)
+            self._pool.done(key)
             return
         self.tracer.dequeued(key, self._now())
         try:
@@ -167,7 +221,7 @@ class Manager:
             # immediately re-queue a dirty key, and an add_after racing
             # a still-processing key would coalesce into dirty and fire
             # too early.
-            self._wq.done(key)
+            self._pool.done(key)
         if requeue:
             if self.flight is not None:
                 self.flight.record(kind, ns, name, "requeue",
@@ -179,17 +233,107 @@ class Manager:
         or None when nothing is scheduled.  The sim harness advances its
         virtual clock exactly here, so backoffs fire at their true
         instants instead of being promoted en masse."""
-        return self._wq.next_delayed_at()
+        return self._pool.next_delayed_at()
 
     @property
     def _delayed(self) -> List[Tuple[float, Key]]:
         """Scheduled timed requeues as (deadline, key) — introspection
-        for tests; the live heap is the workqueue's."""
-        return self._wq.delayed_items()
+        for tests; the live heaps are the pools'."""
+        return self._pool.delayed_items()
 
     def flush_delayed(self):
         """Promote ALL timed requeues immediately (tests: 'advance time')."""
-        self._wq.flush_delayed()
+        self._pool.flush_delayed()
+
+    # -- informer resume (watch bookmark / 410 contract) -------------------
+
+    @property
+    def last_rv(self) -> int:
+        """The watch high-water resourceVersion (events + bookmarks)."""
+        return self._last_rv
+
+    def disconnect_informer(self):
+        """Detach from the store's watch stream (restart/failover seam —
+        the sim's shard-restart scenario and the bookmark tests drive
+        this; a real deployment gets here by crashing)."""
+        self._cancel_watch()
+
+    def reconnect_informer(self) -> Dict[str, object]:
+        """Re-subscribe and catch up; returns the :meth:`resume` report."""
+        self._cancel_watch = self.store.watch(self._on_event)
+        return self.resume()
+
+    def resume(self, rv: Optional[int] = None) -> Dict[str, object]:
+        """Catch up after a watch gap, O(delta) when possible.
+
+        Replays store events past ``rv`` (default: the last seen
+        event/bookmark rv) through the normal event path.  When the
+        span has fallen off the store's bounded backlog
+        (:class:`ExpiredError` — the 410-Gone analogue), falls back to
+        a **scoped relist**: only the registered kinds are listed and
+        enqueued, never the whole store — owned objects (pods, …)
+        re-derive from their owners' level-triggered reconciles, the
+        same contract the startup resync uses.
+
+        Returns ``{"mode": "delta"|"relist", "count": n, "rv": latest}``.
+        """
+        since = self._last_rv if rv is None else rv
+        try:
+            events, latest, _ = self.store.events_since(since, strict=True)
+        except ExpiredError as e:
+            n = self._relist_registered()
+            self._observe_rv(e.latest)
+            return {"mode": "relist", "count": n, "rv": self._last_rv}
+        for _, ev in events:
+            self._on_event(ev)
+        self._observe_rv(latest)
+        return {"mode": "delta", "count": len(events), "rv": self._last_rv}
+
+    def _relist_registered(self, shard: Optional[int] = None) -> int:
+        """Enqueue every object of every registered kind (optionally only
+        keys hashing to ``shard``); returns keys enqueued."""
+        n = 0
+        for kind in sorted(self._reconcilers):
+            try:
+                objs = self.store.list(kind)
+            except Exception:
+                _LOG.exception("relist of %s failed; resync will retry",
+                               kind)
+                continue
+            for o in objs:
+                md = o.get("metadata", {})
+                key = (kind, md.get("namespace", "default"),
+                       md.get("name", ""))
+                if shard is not None and self._pool.shard_of(key) != shard:
+                    continue
+                self.enqueue(key)
+                n += 1
+        return n
+
+    # -- shard ownership (per-shard lease handoff) -------------------------
+
+    def owned_shards(self) -> set:
+        return self._owned.snapshot()
+
+    def acquire_shard(self, shard: int) -> int:
+        """Take ownership: resume the pool and relist this shard's slice
+        of the registered kinds (level-triggered catch-up for events
+        that accumulated while unowned).  Returns keys enqueued, -1 if
+        already owned."""
+        if not self._owned.add(shard):
+            return -1
+        self._pool.resume_shard(shard)
+        return self._relist_registered(shard=shard)
+
+    def release_shard(self, shard: int, drain_timeout: float = 5.0) -> bool:
+        """Give up ownership: pause the pool (events keep accumulating,
+        deduplicated) and wait for in-flight keys to finish, so a
+        successor never overlaps our reconciles.  Returns False when the
+        drain timed out (in-flight work still running)."""
+        if not self._owned.discard(shard):
+            return True
+        self._pool.pause_shard(shard)
+        return self._pool.drain_shard(shard, timeout=drain_timeout)
 
     def run_until_idle(self, max_iterations: int = 1000) -> int:
         """Drain the queue deterministically; returns iterations used.
@@ -244,31 +388,38 @@ class Manager:
             self._stop_event.wait(seconds)
 
     def start(self, workers: int = 1):
+        """Start ``workers`` reconcile threads PER SHARD, each pinned to
+        its pool (a pinned worker can never pull a foreign shard's key,
+        so shard ownership is enforced by construction).  With shards=1
+        this is exactly the historical worker count."""
         self._stop = False
         self._stop_event.clear()
-        self._wq.restart()
+        self._pool.restart()
         threading.Thread(target=self._resync_until_complete, daemon=True,
                          name="manager-resync").start()
-        for i in range(workers):
-            t = threading.Thread(target=self._worker, daemon=True,
-                                 name=f"reconciler-{i}")
-            t.start()
-            self._threads.append(t)
+        for shard in range(self.shards):
+            for i in range(workers):
+                t = threading.Thread(
+                    target=self._worker, args=(shard,), daemon=True,
+                    name=(f"reconciler-{i}" if self.shards == 1
+                          else f"reconciler-s{shard}-{i}"))
+                t.start()
+                self._threads.append(t)
 
-    def _worker(self):
+    def _worker(self, shard: int):
         while not self._stop:
-            key = self._pop(block=True)
+            key = self._pool.get(shard, block=True)
             if key is not None:
                 self._process(key)
 
     def stop(self):
         self._stop = True
         self._stop_event.set()
-        self._wq.shutdown()
+        self._pool.shutdown()
         for t in self._threads:
             t.join(timeout=2.0)
         self._threads.clear()
-        self._wq.restart()   # run_until_idle and a later start() still work
+        self._pool.restart()  # run_until_idle and a later start() still work
 
 
 def owned_pod_mapper(ev: Event) -> Optional[Key]:

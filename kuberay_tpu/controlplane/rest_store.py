@@ -100,6 +100,10 @@ class RestObjectStore:
         self._kind_threads: List[threading.Thread] = []
         self._synced = threading.Event()
         self._sync_pending: set = set()
+        # Per-kind watch resume points (last event/bookmark rv) —
+        # introspection for the O(delta) reconnect contract tests.
+        self._resume_rv: Dict[str, str] = {}
+        self._relists: Dict[str, int] = {}
 
     # -- plumbing ----------------------------------------------------------
 
@@ -461,6 +465,8 @@ class RestObjectStore:
                     # ObjectStore.watch: level-triggered consumers list on
                     # startup); post-410 relists emit the missed diff.
                     rv = self._relist_kind(kind, silent=first)
+                    with self._lock:
+                        self._relists[kind] = self._relists.get(kind, 0) + 1
                     if first:
                         first = False
                         with self._lock:
@@ -468,14 +474,32 @@ class RestObjectStore:
                             if not self._sync_pending:
                                 self._synced.set()
                 rv = self._stream_kind(kind, rv, stop)
+                if rv is not None:
+                    with self._lock:
+                        self._resume_rv[kind] = rv
                 backoff = self.poll_interval
             except Exception:
-                # Exponential backoff per kind: a down/unauthorized server
-                # must not be hammered with a full LIST per poll_interval
-                # per kind (client-go reflector behavior).
-                rv = None
+                # Transient failure (connection reset, 5xx, timeout
+                # mid-stream): keep ``rv`` and reconnect from the last
+                # event/bookmark — an O(delta) rejoin.  Only the
+                # server's 410 Expired (``_stream_kind`` -> None) forces
+                # the O(kind-size) relist; a flaky network no longer
+                # relists the world on every blip.  Exponential backoff
+                # per kind either way (client-go reflector behavior).
                 stop.wait(backoff)
                 backoff = min(backoff * 2, 30.0)
+
+    def watch_resume_points(self) -> Dict[str, str]:
+        """Per-kind last-seen watch rv (event or BOOKMARK) — the resume
+        point a reconnect uses instead of relisting."""
+        with self._lock:
+            return dict(self._resume_rv)
+
+    def relist_counts(self) -> Dict[str, int]:
+        """How many times each kind paid a full relist (initial sync
+        counts once; after that only 410 Expired should add)."""
+        with self._lock:
+            return dict(self._relists)
 
     def _relist_kind(self, kind: str, silent: bool = False) -> str:
         query = {}

@@ -1,0 +1,201 @@
+"""Hash-sharded reconcile pools: the 10k-cluster concurrency substrate.
+
+A single work queue serializes every reconcile through one lock and one
+condition variable; past a few thousand clusters the queue itself (herd
+wakeups, depth bookkeeping, one dirty-set) becomes the contention point
+the scale ladder exposes (docs/scaling.md).  The fix is the classic
+controller-sharding move: partition reconcile **keys** across N
+independent pools by a stable hash.
+
+The invariant that survives the split: **a key hashes to exactly one
+pool**, and each pool keeps the workqueue's per-key serialization — so
+per-key serialization holds *globally*.  Two workers never reconcile
+the same object, no matter how many pools or processes exist, because
+there is never a second pool that could hand the key out.
+
+``shard_of`` is a pure function of the key (crc32, NOT Python's salted
+``hash``), so:
+
+- shard assignment is stable under requeue, restart, and across
+  processes — the property multi-process deployments split per-shard
+  leases on (:class:`~kuberay_tpu.controlplane.leader.ShardLeaseElector`);
+- replays are deterministic: the same seed routes the same keys to the
+  same pools.
+
+:class:`ShardedQueuePool` owns the N :class:`WorkQueue` s and routes
+every producer verb through the hash.  Direct ``WorkQueue.add`` calls
+outside the router modules are a lint error (analysis rule
+``shard-affinity``): an enqueue that bypasses the router can land a key
+in the wrong pool and break the one-pool-per-key invariant.
+"""
+
+from __future__ import annotations
+
+import threading
+import zlib
+from typing import Callable, List, Optional, Set, Tuple
+
+from kuberay_tpu.controlplane.workqueue import WorkQueue
+
+Key = Tuple[str, str, str]  # (kind, namespace, name)
+
+
+def shard_of(key: Key, shards: int) -> int:
+    """Stable shard index for a reconcile key.
+
+    crc32 over ``kind/namespace/name``: deterministic across processes
+    and Python runs (``hash()`` is seed-salted and would re-deal every
+    key on restart, defeating per-shard lease ownership).
+    """
+    if shards <= 1:
+        return 0
+    h = zlib.crc32(f"{key[0]}/{key[1]}/{key[2]}".encode("utf-8"))
+    return h % shards
+
+
+class ShardedQueuePool:
+    """N per-shard work queues behind one routing surface.
+
+    Producers call :meth:`add`/:meth:`add_after` with a key; the pool
+    routes by :func:`shard_of`.  Consumers either bind to one shard
+    (``get(shard=i)`` — worker threads pinned to a pool, the
+    ``start(workers=N)`` mode) or drain round-robin
+    (:meth:`get_any` — the deterministic ``run_until_idle`` mode).
+
+    Ownership: a pool can be *paused* (lease lost) — its queue keeps
+    accumulating and deduplicating keys, but hands nothing out until
+    :meth:`resume_shard`.  :meth:`drain_shard` waits for the in-flight
+    keys of a paused shard to finish — the clean lease-handoff barrier.
+    """
+
+    def __init__(self, shards: int = 1,
+                 now_fn: Optional[Callable[[], float]] = None,
+                 metrics=None, name: str = "manager",
+                 shard_fn: Callable[[Key, int], int] = shard_of):
+        if shards < 1:
+            raise ValueError(f"shards must be >= 1, got {shards}")
+        self.shards = shards
+        self._shard_fn = shard_fn
+        # shards=1 keeps the historical queue name ("manager") so the
+        # workqueue depth/latency series and every existing dashboard
+        # stay continuous; sharded pools label per shard.
+        self.queues: List[WorkQueue] = [
+            WorkQueue(now_fn=now_fn, metrics=metrics,
+                      name=name if shards == 1 else f"{name}-shard-{i}")
+            for i in range(shards)
+        ]
+        self._rr = 0        # round-robin cursor for get_any
+
+    def shard_of(self, key: Key) -> int:
+        return self._shard_fn(key, self.shards)
+
+    def queue_for(self, key: Key) -> WorkQueue:
+        return self.queues[self.shard_of(key)]
+
+    # -- producers (the shard router) --------------------------------------
+
+    def add(self, key: Key) -> None:
+        self.queue_for(key).add(key)
+
+    def add_after(self, key: Key, after: float) -> None:
+        self.queue_for(key).add_after(key, after)
+
+    # -- consumers ---------------------------------------------------------
+
+    def get(self, shard: int, block: bool = True) -> Optional[Key]:
+        return self.queues[shard].get(block=block)
+
+    def get_any(self) -> Optional[Key]:
+        """Non-blocking pop across pools, round-robin from the cursor —
+        deterministic (cursor state is part of the drain order, which is
+        single-threaded in ``run_until_idle`` mode) and fair (a hot
+        shard cannot starve the others)."""
+        for i in range(self.shards):
+            idx = (self._rr + i) % self.shards
+            key = self.queues[idx].get(block=False)
+            if key is not None:
+                self._rr = (idx + 1) % self.shards
+                return key
+        return None
+
+    def done(self, key: Key) -> None:
+        self.queue_for(key).done(key)
+
+    # -- ownership (per-shard lease handoff) -------------------------------
+
+    def pause_shard(self, shard: int) -> None:
+        self.queues[shard].pause()
+
+    def resume_shard(self, shard: int) -> None:
+        self.queues[shard].resume()
+
+    def drain_shard(self, shard: int, timeout: float = 5.0) -> bool:
+        """Wait until the shard has no in-flight keys (pause first, or
+        new pops keep the horizon open).  Returns False on timeout."""
+        return self.queues[shard].wait_idle_processing(timeout=timeout)
+
+    # -- timed requeues / lifecycle (fan-out over pools) -------------------
+
+    def next_delayed_at(self) -> Optional[float]:
+        deadlines = [q.next_delayed_at() for q in self.queues]
+        deadlines = [d for d in deadlines if d is not None]
+        return min(deadlines) if deadlines else None
+
+    def flush_delayed(self) -> None:
+        for q in self.queues:
+            q.flush_delayed()
+
+    def delayed_items(self) -> List[Tuple[float, Key]]:
+        out: List[Tuple[float, Key]] = []
+        for q in self.queues:
+            out.extend(q.delayed_items())
+        return sorted(out)
+
+    def shutdown(self) -> None:
+        for q in self.queues:
+            q.shutdown()
+
+    def restart(self) -> None:
+        for q in self.queues:
+            q.restart()
+
+    def depth(self) -> int:
+        return sum(q.depth() for q in self.queues)
+
+    def delayed_len(self) -> int:
+        return sum(q.delayed_len() for q in self.queues)
+
+
+class ShardSet:
+    """Thread-safe owned-shard set: which shards this process currently
+    reconciles.  ``None``-less by design — a Manager always has an
+    explicit set (default: all shards), so the hot path is a plain
+    membership test."""
+
+    def __init__(self, shards: int, owned: Optional[Set[int]] = None):
+        self._lock = threading.Lock()
+        self.shards = shards
+        self._owned: Set[int] = (set(range(shards)) if owned is None
+                                 else set(owned))
+
+    def owns(self, shard: int) -> bool:
+        with self._lock:
+            return shard in self._owned
+
+    def add(self, shard: int) -> bool:
+        with self._lock:
+            if shard in self._owned:
+                return False
+            self._owned.add(shard)
+            return True
+
+    def discard(self, shard: int) -> bool:
+        with self._lock:
+            if shard not in self._owned:
+                return False
+            self._owned.discard(shard)
+            return True
+
+    def snapshot(self) -> Set[int]:
+        with self._lock:
+            return set(self._owned)

@@ -75,6 +75,21 @@ class Conflict(StoreError):
     """resourceVersion mismatch (optimistic concurrency failure)."""
 
 
+class ExpiredError(StoreError):
+    """The requested resourceVersion has fallen off the bounded watch
+    backlog — the 410-Gone analogue (kube-apiserver ``Expired``): the
+    client cannot be caught up by replay and must relist.  Carries the
+    requested ``rv`` and the store's ``latest`` rv so callers can scope
+    the relist and reset their resume point."""
+
+    def __init__(self, rv: int, latest: int):
+        super().__init__(
+            f"resourceVersion {rv} is too old: the event backlog no "
+            f"longer reaches it (latest {latest}); relist required")
+        self.rv = rv
+        self.latest = latest
+
+
 class Invalid(StoreError):
     pass
 
@@ -115,6 +130,11 @@ class Event:
     ADDED = "ADDED"
     MODIFIED = "MODIFIED"
     DELETED = "DELETED"
+    # Progress marker on the watch fan-out path (kube watch bookmarks):
+    # carries only the high-water resourceVersion so subscribers can
+    # advance their resume point across spans they saw no events in.
+    # Never state, never journaled, never in the backlog.
+    BOOKMARK = "BOOKMARK"
 
     __slots__ = ("type", "kind", "obj")
 
@@ -156,10 +176,15 @@ class ObjectStore:
                  journal_engine: str = "auto",
                  uid_factory: Optional[Callable[[], str]] = None,
                  dispatch: str = "sync",
-                 watch_queue_max: int = 10000):
+                 watch_queue_max: int = 10000,
+                 backlog_max: int = 10000,
+                 bookmark_interval: int = 0,
+                 metrics=None):
         if dispatch not in ("sync", "async"):
             raise ValueError(f"dispatch must be 'sync' or 'async', "
                              f"got {dispatch!r}")
+        if backlog_max < 1:
+            raise ValueError(f"backlog_max must be >= 1, got {backlog_max}")
         self._lock = threading.RLock()
         self._objects: Dict[Tuple[str, str, str], Dict[str, Any]] = {}
         self._rv = 0
@@ -210,10 +235,24 @@ class ObjectStore:
         # Bounded event backlog for streaming watches: (rv, Event); rv is
         # the post-commit resourceVersion so clients resume by rv.
         # Strictly rv-sorted — events_since/wait_for_events bisect to
-        # the resume point instead of scanning.
+        # the resume point instead of scanning.  ``backlog_max`` sizes
+        # the resumable window: at the 10k-cluster rung a single
+        # scale-up storm emits more events than the old hardcoded 10000,
+        # silently forcing full relists on every resume — size it to the
+        # expected event burst (operator --watch-backlog-max).  Evictions
+        # are counted and surfaced (tpu_watch_backlog_evictions_total).
         self._backlog: List[Tuple[int, Event]] = []
-        self._backlog_max = 10000
+        self._backlog_max = backlog_max
+        self._backlog_evictions = 0
+        self._backlog_evictions_reported = 0
         self._backlog_cond = threading.Condition(self._lock)
+        # Watch bookmarks: every ``bookmark_interval`` committed rvs, a
+        # BOOKMARK event (high-water rv only) goes to every subscriber
+        # queue — never to the backlog or journal — so idle-ish
+        # informers keep a fresh resume point (0 disables).
+        self._bookmark_interval = bookmark_interval
+        self._last_bookmark_rv = 0
+        self._metrics = metrics
         self._last_snapshot_bytes = 0
         if journal_path:
             self._replay_journal()
@@ -495,7 +534,13 @@ class ObjectStore:
         ev.obj = snapshot(ev.obj)
         self._backlog.append((self._rv, ev))
         if len(self._backlog) > self._backlog_max:
-            del self._backlog[: len(self._backlog) - self._backlog_max]
+            evicted = len(self._backlog) - self._backlog_max
+            del self._backlog[:evicted]
+            # Counted under the lock, reported to metrics off-lock in
+            # _finish_write: an eviction means some resume point just
+            # expired — at scale this is the signal --watch-backlog-max
+            # is undersized and restarts will pay full relists.
+            self._backlog_evictions += evicted
         self._backlog_cond.notify_all()
         deliveries = [ev]
         if self._interposer is not None:
@@ -503,6 +548,15 @@ class ObjectStore:
             # interposer may return [] (drop), [ev] (pass), [ev, ev]
             # (duplicate) or stash the event for deferred redelivery.
             deliveries = self._interposer.on_event(ev)
+        if self._bookmark_interval and \
+                self._rv - self._last_bookmark_rv >= self._bookmark_interval:
+            # Bookmarks ride the subscriber queues AFTER the interposer
+            # (they are local progress markers, not chaos targets) and
+            # never enter the backlog — the journal hash contract.
+            self._last_bookmark_rv = self._rv
+            deliveries = list(deliveries) + [Event(
+                Event.BOOKMARK, "",
+                {"metadata": {"resourceVersion": self._rv}})]
         for dev in deliveries:
             self._seq += 1
             seq = self._seq
@@ -571,10 +625,36 @@ class ObjectStore:
     def _finish_write(self):
         """Post-commit tail of every public mutator, outside the
         mutation lock: journal serialization + append, sync-mode watch
-        delivery, then the durable-ack barrier."""
+        delivery, eviction accounting, then the durable-ack barrier."""
         self._drain_journal()
         self._drain_deliveries()
+        self._report_evictions()
         self._journal_ack()
+
+    def _report_evictions(self):
+        """Flush backlog-eviction counts to metrics, off the mutation
+        lock (the metrics registry has its own lock — taking it under
+        the store lock would be a lock-order hazard)."""
+        with self._lock:
+            m = self._metrics
+            if m is None:
+                return
+            delta = self._backlog_evictions - self._backlog_evictions_reported
+            self._backlog_evictions_reported = self._backlog_evictions
+        if delta:
+            m.watch_backlog_evictions(delta)
+
+    def set_metrics(self, metrics) -> None:
+        """Attach the ControlPlaneMetrics facade after construction (the
+        operator owns the metrics registry but may receive a pre-built
+        store)."""
+        with self._lock:
+            self._metrics = metrics
+
+    def backlog_evictions_total(self) -> int:
+        """Events evicted from the resumable backlog window so far."""
+        with self._lock:
+            return self._backlog_evictions
 
     def flush_watch(self, timeout: float = 5.0) -> bool:
         """Wait until every subscriber queue is empty (async-dispatch
@@ -1115,15 +1195,22 @@ class ObjectStore:
                     return [], self._rv, False
                 self._backlog_cond.wait(remaining)
 
-    def events_since(self, rv: int, kinds=None):
+    def events_since(self, rv: int, kinds=None, *, strict: bool = False):
         """(events, latest_rv, truncated): backlog entries with rv > given.
         ``truncated`` True when the backlog no longer reaches back to
         ``rv`` — the client must relist (standard watch-resume contract).
         An empty backlog with rv behind the store (journal replay,
-        restart) is also truncation: the missed span is unrecoverable."""
+        restart) is also truncation: the missed span is unrecoverable.
+
+        ``strict=True`` turns truncation into a typed
+        :class:`ExpiredError` (the 410-Gone analogue) instead of a flag
+        — the informer-resume path uses it so an expired resume point
+        cannot be accidentally treated as an empty delta."""
         with self._lock:
             if rv >= self._rv:
                 return [], self._rv, False     # idle fast path: no scan
             truncated = ((bool(self._backlog) and self._backlog[0][0] > rv + 1)
                          or (not self._backlog and rv < self._rv))
+            if truncated and strict:
+                raise ExpiredError(rv, self._rv)
             return self._backlog_since(rv, kinds), self._rv, truncated

@@ -58,6 +58,12 @@ class WorkQueue:
         self._delayed: List[Tuple[float, Key]] = []
         self._added_at: Dict[Key, float] = {}
         self._shutdown = False
+        # Paused queues accumulate (and dedup) but hand nothing out —
+        # the per-shard lease-handoff state (sharding.py): a shard whose
+        # lease moved away keeps absorbing events so a later
+        # re-acquisition resumes level-triggered, but its workers go
+        # idle instead of racing the new owner.
+        self._paused = False
 
     # -- producers ---------------------------------------------------------
 
@@ -94,7 +100,7 @@ class WorkQueue:
         with self._cond:
             while True:
                 self._promote_due_locked()
-                while self._queue:
+                while self._queue and not self._paused:
                     key = self._queue.popleft()
                     self._queued.discard(key)
                     if key in self._processing:
@@ -131,6 +137,39 @@ class WorkQueue:
                 self._queue.append(key)
                 self._report_depth()
                 self._cond.notify()
+            if not self._processing:
+                self._cond.notify_all()   # wake wait_idle_processing
+
+    # -- pause / drain (per-shard lease handoff) ---------------------------
+
+    def pause(self) -> None:
+        """Stop handing keys out.  Adds/dedup/timed requeues keep
+        accumulating; in-flight keys finish normally via :meth:`done`."""
+        with self._cond:
+            self._paused = True
+
+    def resume(self) -> None:
+        with self._cond:
+            self._paused = False
+            self._cond.notify_all()
+
+    @property
+    def paused(self) -> bool:
+        with self._lock:
+            return self._paused
+
+    def wait_idle_processing(self, timeout: float = 5.0) -> bool:
+        """Block until no key is in flight (the lease-handoff drain
+        barrier — pause first or new pops keep the horizon open).
+        Returns False on timeout."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while self._processing:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(timeout=remaining)
+            return True
 
     # -- timed re-adds -----------------------------------------------------
 

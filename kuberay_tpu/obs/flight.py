@@ -64,6 +64,8 @@ class FlightRecorder:
         when status.state/phase changed since the last delivery."""
         obj = ev.obj
         md = obj.get("metadata", {})
+        if ev.type == "BOOKMARK":
+            return   # progress marker: no object, nothing to record
         if ev.kind == "Event":
             io = obj.get("involvedObject", {}) or {}
             self.record(io.get("kind", "") or "", io.get("namespace",

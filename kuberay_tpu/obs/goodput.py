@@ -247,8 +247,8 @@ class GoodputLedger:
         never mutates the event or the store — safe under the store
         lock, and invisible to the sim journal hash."""
         kind = ev.kind
-        if kind == "Event":
-            return
+        if kind == "Event" or ev.type == "BOOKMARK":
+            return   # telemetry / progress markers, not lifecycle state
         obj = ev.obj
         md = obj.get("metadata", {}) or {}
         ns = md.get("namespace", "default")

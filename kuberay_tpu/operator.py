@@ -69,8 +69,14 @@ class Operator:
         # rely on for determinism.
         self._owns_store = store is None
         self.store = store if store is not None else \
-            ObjectStore(dispatch=watch_dispatch)
+            ObjectStore(dispatch=watch_dispatch,
+                        backlog_max=self.config.watchBacklogMax,
+                        bookmark_interval=self.config.watchBookmarkInterval)
         self.metrics = ControlPlaneMetrics()
+        # Backlog-eviction accounting (tpu_watch_backlog_evictions_total)
+        # wants the operator's registry even on a pre-built store.
+        if hasattr(self.store, "set_metrics"):
+            self.store.set_metrics(self.metrics)
         # Observability (kuberay_tpu.obs): always on — all bounded
         # ring/LRU structures; /debug/traces + /debug/flight answer
         # "where did the time go" per reconcile, /debug/goodput answers
@@ -87,7 +93,8 @@ class Operator:
         self._goodput_cancel = self.store.watch(self.goodput.observe_event)
         self.recorder = EventRecorder(self.store)
         self.manager = Manager(self.store, metrics=self.metrics,
-                               tracer=self.tracer, flight=self.flight)
+                               tracer=self.tracer, flight=self.flight,
+                               shards=max(1, self.config.shardCount))
 
         self.schedulers = SchedulerManager()
         self.schedulers.register(GangScheduler(self.store))
@@ -186,6 +193,7 @@ class Operator:
         self.apiserver = None
         self.api_url = ""
         self.elector: Optional[LeaderElector] = None
+        self.shard_elector = None
 
     def _timed(self, kind, fn):
         def wrapped(name, ns):
@@ -208,12 +216,19 @@ class Operator:
     # -- lifecycle ---------------------------------------------------------
 
     def start(self, api_port: int = 0, api_host: str = "127.0.0.1",
-              leader_election: bool = False):
+              leader_election: bool = False, shard_leases: bool = False):
         """Start workers + API server; returns the API base URL.
 
         ``leader_election``: multi-replica mode (ref main.go:232
         'ray-operator-leader') — reconcilers only run while this replica
         holds the Lease; the API server always serves (reads are safe).
+
+        ``shard_leases`` (with ``leader_election`` and ``shardCount>1``):
+        instead of one whole-operator lease, each reconcile shard has
+        its own Lease and replicas SPLIT the shard set (docs/scaling.md)
+        — workers start immediately but every pool begins paused; the
+        :class:`ShardLeaseElector` resumes exactly the pools whose
+        leases this replica holds.
         """
         history = None
         if self.history_collector is not None:
@@ -223,7 +238,20 @@ class Operator:
             self.store, api_host, api_port, metrics=self.metrics,
             history=history, tracer=self.tracer, flight=self.flight,
             goodput=self.goodput, autoscaler=self.autoscaler_audit)
-        if leader_election:
+        if leader_election and shard_leases and self.manager.shards > 1:
+            from kuberay_tpu.controlplane.leader import ShardLeaseElector
+            # Start unowned: every pool paused until its lease is won.
+            for shard in range(self.manager.shards):
+                self.manager.release_shard(shard)
+            self.shard_elector = ShardLeaseElector(
+                self.store, self.manager.shards,
+                namespace=self.config.leaderElectionNamespace,
+                max_owned=self.config.maxOwnedShards or None,
+                on_acquired=self.manager.acquire_shard,
+                on_released=self.manager.release_shard)
+            self._start_reconcilers()
+            self.shard_elector.start()
+        elif leader_election:
             self.elector = LeaderElector(
                 self.store,
                 namespace=self.config.leaderElectionNamespace,
@@ -301,6 +329,11 @@ class Operator:
                     continue
 
     def stop(self):
+        # Per-shard leases release FIRST: each on_released pauses and
+        # drains its pool, so the lease only moves after our in-flight
+        # reconciles for that shard finished.
+        if self.shard_elector is not None:
+            self.shard_elector.stop()
         # Reconcilers stop BEFORE the lease is released: a successor must
         # never overlap with our in-flight reconciles (dual-writer window).
         self._stop_reconcilers()
@@ -340,7 +373,26 @@ def main(argv=None):
     ap.add_argument("--api-host", default="127.0.0.1")
     ap.add_argument("--batch-scheduler", default="",
                     help="gang | volcano | yunikorn | kai")
-    ap.add_argument("--reconcile-concurrency", type=int, default=2)
+    ap.add_argument("--reconcile-concurrency", type=int, default=2,
+                    help="reconcile worker threads PER SHARD")
+    ap.add_argument("--shards", type=int, default=1,
+                    help="hash-shard reconcile keys across N worker pools "
+                         "(per-key serialization holds globally: a key "
+                         "hashes to exactly one pool — docs/scaling.md)")
+    ap.add_argument("--shard-leases", action="store_true",
+                    help="with --leader-election and --shards N: one Lease "
+                         "per shard so multiple operator processes SPLIT "
+                         "the shard set instead of standing by")
+    ap.add_argument("--max-owned-shards", type=int, default=0,
+                    help="cap shards this replica acquires (0 = no cap); "
+                         "set ceil(shards/replicas) for an even split")
+    ap.add_argument("--watch-backlog-max", type=int, default=10000,
+                    help="resumable watch-backlog window in events; "
+                         "undersizing forces full relists on informer "
+                         "resume (tpu_watch_backlog_evictions_total)")
+    ap.add_argument("--watch-bookmark-interval", type=int, default=500,
+                    help="emit a BOOKMARK progress event to subscribers "
+                         "every N committed revisions (0 disables)")
     ap.add_argument("--fake-kubelet", action="store_true",
                     help="run pods with the in-process fake kubelet (demo)")
     ap.add_argument("--leader-election", action="store_true",
@@ -371,6 +423,10 @@ def main(argv=None):
         cfg.batchScheduler = args.batch_scheduler
         cfg.enableBatchScheduler = True
     cfg.reconcileConcurrency = args.reconcile_concurrency
+    cfg.shardCount = max(1, args.shards)
+    cfg.maxOwnedShards = max(0, args.max_owned_shards)
+    cfg.watchBacklogMax = args.watch_backlog_max
+    cfg.watchBookmarkInterval = args.watch_bookmark_interval
     features.parse_and_set(args.feature_gates)
 
     if args.store_url:
@@ -378,7 +434,9 @@ def main(argv=None):
         store = RestObjectStore(args.store_url)
     elif args.journal:
         store = ObjectStore(journal_path=args.journal,
-                            dispatch=args.watch_dispatch)
+                            dispatch=args.watch_dispatch,
+                            backlog_max=cfg.watchBacklogMax,
+                            bookmark_interval=cfg.watchBookmarkInterval)
     else:
         store = None
     if args.leader_election and not args.store_url and not args.journal:
@@ -388,7 +446,8 @@ def main(argv=None):
     op = Operator(cfg, store=store, fake_kubelet=args.fake_kubelet,
                   watch_dispatch=args.watch_dispatch)
     url = op.start(api_port=args.api_port, api_host=args.api_host,
-                   leader_election=args.leader_election)
+                   leader_election=args.leader_election,
+                   shard_leases=args.shard_leases)
     print(f"kuberay-tpu operator running; API at {url}", flush=True)
     try:
         while True:

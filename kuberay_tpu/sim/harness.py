@@ -118,9 +118,15 @@ class SimHarness:
                  settle_horizon: float = 45.0,
                  max_settle_rounds: int = 400,
                  trace: bool = False,
-                 goodput: bool = False):
+                 goodput: bool = False,
+                 shards: Optional[int] = None):
         self.seed = seed
         self.scenario = scenario
+        # Reconcile shard count: explicit arg wins, else the scenario's
+        # (shard-restart runs 4 pools), else the classic single queue —
+        # whose processing order is the byte-identical replay contract.
+        self.shards = (shards if shards is not None
+                       else getattr(scenario, "shards", 1) or 1)
         self.settle_horizon = settle_horizon
         self.max_settle_rounds = max_settle_rounds
         self.converged = True
@@ -184,7 +190,7 @@ class SimHarness:
                 f"{base}.evt{next(self._event_seq):06d}")
         self.manager = Manager(self.store, clock=self.clock,
                                metrics=self.metrics, tracer=self.tracer,
-                               flight=self.flight)
+                               flight=self.flight, shards=self.shards)
 
         self.clients: Dict[str, FakeCoordinatorClient] = {}
 

@@ -188,6 +188,11 @@ class ControlPlaneMetrics:
                    "Seconds a key waited in the work queue from first "
                    "enqueue to worker pickup (dedup keeps the earliest "
                    "cause; includes promoted requeue backoff)")
+        r.describe("tpu_watch_backlog_evictions_total",
+                   "Watch-backlog events evicted past the resumable "
+                   "window (--watch-backlog-max); a nonzero rate means "
+                   "resuming informers will hit ExpiredError and pay a "
+                   "full relist instead of an O(delta) replay")
 
     def observe_provisioned(self, cluster: str, seconds: float):
         self.registry.observe("tpu_cluster_provisioned_duration_seconds",
@@ -233,6 +238,9 @@ class ControlPlaneMetrics:
     def workqueue_latency(self, queue: str, seconds: float):
         self.registry.observe("tpu_workqueue_latency_seconds", seconds,
                               {"queue": queue}, buckets=_FAST_BUCKETS)
+
+    def watch_backlog_evictions(self, n: int = 1):
+        self.registry.inc("tpu_watch_backlog_evictions_total", value=n)
 
     def reconcile_conflict(self, kind: str):
         self.registry.inc("tpu_reconcile_conflicts_total", {"kind": kind})

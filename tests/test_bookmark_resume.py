@@ -1,0 +1,205 @@
+"""Watch bookmark / resume gate (ISSUE 6): the store→informer path.
+
+- ``events_since(strict=True)`` turns backlog truncation into a typed
+  :class:`ExpiredError` (the 410-Gone analogue) carrying rv + latest;
+- backlog evictions are counted and surfaced
+  (``tpu_watch_backlog_evictions_total``);
+- periodic BOOKMARK events carry the high-water rv to subscribers —
+  never entering the backlog or the journal;
+- a reconnecting Manager resumes O(delta): it replays exactly the
+  missed events, and only an expired backlog degrades to a relist
+  scoped to its REGISTERED kinds — never the whole store;
+- sim-gated: a mid-run informer restart + resume converges with a
+  journal byte-identical to the no-restart run.
+"""
+
+import pytest
+
+from kuberay_tpu.controlplane.manager import Manager
+from kuberay_tpu.controlplane.store import Event, ExpiredError, ObjectStore
+from kuberay_tpu.sim.harness import SimHarness
+from kuberay_tpu.sim.scenarios import make_cluster_obj
+from kuberay_tpu.utils import constants as C
+from kuberay_tpu.utils.metrics import ControlPlaneMetrics
+
+
+def _mk(store, kind, name, ns="default"):
+    return store.create({"apiVersion": "v1", "kind": kind,
+                         "metadata": {"name": name, "namespace": ns},
+                         "spec": {}})
+
+
+# ---------------------------------------------------------------------------
+# ExpiredError + eviction accounting
+# ---------------------------------------------------------------------------
+
+def test_events_since_strict_raises_typed_expired():
+    store = ObjectStore(backlog_max=5)
+    for i in range(12):
+        _mk(store, "Thing", f"t-{i}")
+    # Non-strict keeps the flag contract (apiserver compatibility)...
+    events, latest, truncated = store.events_since(1)
+    assert truncated and latest == 12
+    # ...strict turns it into the 410 analogue with resume metadata.
+    with pytest.raises(ExpiredError) as ei:
+        store.events_since(1, strict=True)
+    assert ei.value.rv == 1 and ei.value.latest == 12
+    # A reachable rv never raises.
+    events, latest, truncated = store.events_since(11, strict=True)
+    assert not truncated and [erv for erv, _ in events] == [12]
+
+
+def test_backlog_evictions_counted_and_metered():
+    metrics = ControlPlaneMetrics()
+    store = ObjectStore(backlog_max=4, metrics=metrics)
+    for i in range(10):
+        _mk(store, "Thing", f"t-{i}")
+    assert store.backlog_evictions_total() == 6
+    text = metrics.render()
+    assert "tpu_watch_backlog_evictions_total 6.0" in text
+
+
+def test_backlog_max_is_honored():
+    store = ObjectStore(backlog_max=3)
+    for i in range(8):
+        _mk(store, "Thing", f"t-{i}")
+    events, latest, truncated = store.events_since(0)
+    assert len(events) == 3 and truncated
+    with pytest.raises(ValueError):
+        ObjectStore(backlog_max=0)
+
+
+# ---------------------------------------------------------------------------
+# bookmarks
+# ---------------------------------------------------------------------------
+
+def test_bookmarks_reach_subscribers_but_not_backlog():
+    store = ObjectStore(bookmark_interval=3)
+    seen = []
+    store.watch(lambda ev: seen.append(
+        (ev.type, ev.obj.get("metadata", {}).get("resourceVersion"))))
+    for i in range(7):
+        _mk(store, "Thing", f"t-{i}")
+    bookmarks = [rv for t, rv in seen if t == Event.BOOKMARK]
+    # rv 3 and 6 cross the interval; each bookmark carries the
+    # high-water rv at emission.
+    assert bookmarks == [3, 6]
+    # The backlog holds only real state events (journal-hash contract).
+    events, _, _ = store.events_since(0)
+    assert all(ev.type != Event.BOOKMARK for _, ev in events)
+    assert len(events) == 7
+
+
+def test_bookmark_advances_manager_resume_point_past_dropped_spans():
+    """Chaos drops every delivery, bookmarks still arrive (they bypass
+    the interposer): the manager's resume point keeps advancing, so a
+    resume replays a small tail instead of the whole history."""
+
+    class DropAll:
+        def on_mutation(self, *a):
+            return None
+
+        def on_event(self, ev):
+            return []      # drop every real delivery
+
+    store = ObjectStore(bookmark_interval=5)
+    manager = Manager(store)
+    manager.register("Thing", lambda name, ns: None)
+    store.set_interposer(DropAll())
+    for i in range(23):
+        _mk(store, "Thing", f"t-{i}")
+    store.set_interposer(None)
+    # Deliveries were all dropped, yet the bookmark high-water advanced.
+    assert manager.last_rv == 20
+    report = manager.resume()
+    assert report["mode"] == "delta"
+    assert report["count"] == 3          # only the post-bookmark tail
+    assert manager.last_rv == 23
+
+
+# ---------------------------------------------------------------------------
+# O(delta) resume / scoped relist
+# ---------------------------------------------------------------------------
+
+def test_disconnected_manager_resumes_with_exact_delta():
+    store = ObjectStore()
+    manager = Manager(store)
+    reconciled = []
+    manager.register("Thing", lambda name, ns: reconciled.append(name)
+                     or None)
+    for i in range(50):
+        _mk(store, "Thing", f"t-{i}")
+    manager.run_until_idle()
+    reconciled.clear()
+
+    manager.disconnect_informer()
+    # Three mutations while the informer is down.
+    for name in ("t-3", "t-17", "t-41"):
+        cur = store.get("Thing", name)
+        cur["spec"] = {"rev": 1}
+        store.update(cur)
+    report = manager.reconnect_informer()
+    assert report == {"mode": "delta", "count": 3,
+                      "rv": store.resource_version()}
+    manager.run_until_idle()
+    # O(delta): exactly the touched objects reconciled, not all 50.
+    assert sorted(reconciled) == ["t-17", "t-3", "t-41"]
+
+
+def test_expired_resume_falls_back_to_scoped_relist():
+    """After the delta fell off the backlog, resume relists ONLY the
+    registered kinds: foreign kinds (here 30 Pods) are never enqueued —
+    the restarted shard rejoins in O(subscribed), not O(world)."""
+    store = ObjectStore(backlog_max=8)
+    manager = Manager(store)
+    reconciled = []
+    manager.register("Thing", lambda name, ns: reconciled.append(name)
+                     or None)
+    for i in range(10):
+        _mk(store, "Thing", f"t-{i}")
+    for i in range(30):
+        _mk(store, "Pod", f"p-{i}")      # unregistered kind: out of scope
+    manager.run_until_idle()
+    reconciled.clear()
+
+    manager.disconnect_informer()
+    for i in range(20):                  # blow past backlog_max=8
+        cur = store.get("Thing", "t-0")
+        cur["spec"] = {"rev": i}
+        store.update(cur)
+    report = manager.reconnect_informer()
+    assert report["mode"] == "relist"
+    assert report["count"] == 10         # scoped: Things only, no Pods
+    assert report["rv"] == store.resource_version()
+    manager.run_until_idle()
+    assert sorted(set(reconciled)) == sorted(f"t-{i}" for i in range(10))
+
+
+# ---------------------------------------------------------------------------
+# sim-gated: restart+resume replays to an identical journal
+# ---------------------------------------------------------------------------
+
+def _workload_hash(restart: bool) -> str:
+    with SimHarness(7) as h:
+        h.store.create(make_cluster_obj("alpha", replicas=2,
+                                        max_replicas=4))
+        h.settle()
+        for i, replicas in enumerate((3, 1, 4)):
+            outage = restart and i == 1
+            if outage:
+                h.manager.disconnect_informer()
+            cluster = h.store.get(C.KIND_CLUSTER, "alpha")
+            cluster["spec"]["workerGroupSpecs"][0]["replicas"] = replicas
+            h.store.update(cluster)
+            if outage:
+                report = h.manager.reconnect_informer()
+                assert report["mode"] == "delta"
+                assert report["count"] >= 1
+            h.settle()
+        h._drain_journal()
+        return h.journal_hash()
+
+
+@pytest.mark.timeout(120)
+def test_restart_resume_journal_identical_to_no_restart_run():
+    assert _workload_hash(restart=False) == _workload_hash(restart=True)

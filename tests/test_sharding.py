@@ -1,0 +1,390 @@
+"""Shard-invariant gate (ISSUE 6): hash-sharded reconcile pools.
+
+The contract under test, in three parts:
+
+1. **Global per-key serialization survives sharding** — a key hashes to
+   exactly one pool, so no key is ever processed concurrently across
+   pools, even under a multi-worker stress storm with hot keys.
+2. **Stable assignment** — shard_of is a pure crc32 of the key: stable
+   under requeue (add_after routes to the same pool) and across
+   processes (pinned golden values).
+3. **Per-shard lease handoff drains cleanly** — releasing a shard
+   pauses its pool, waits out in-flight keys, and never disturbs the
+   other shards; the ShardLeaseElector moves leases with the same
+   no-overlap guarantee.
+"""
+
+import random
+import threading
+import time
+from collections import defaultdict
+
+from kuberay_tpu.controlplane.leader import (
+    ShardLeaseElector,
+    shard_lease_name,
+)
+from kuberay_tpu.controlplane.manager import Manager
+from kuberay_tpu.controlplane.sharding import ShardedQueuePool, shard_of
+from kuberay_tpu.controlplane.store import ObjectStore
+
+
+def k(name, kind="TpuCluster", ns="default"):
+    return (kind, ns, name)
+
+
+# ---------------------------------------------------------------------------
+# stable assignment
+# ---------------------------------------------------------------------------
+
+def test_shard_of_is_stable_and_in_range():
+    keys = [k(f"c-{i}") for i in range(200)]
+    for key in keys:
+        s = shard_of(key, 4)
+        assert 0 <= s < 4
+        # Pure function: identical on every call (requeue stability).
+        assert all(shard_of(key, 4) == s for _ in range(5))
+    # Spread: 200 keys over 4 shards never collapse onto one pool.
+    buckets = {shard_of(key, 4) for key in keys}
+    assert buckets == {0, 1, 2, 3}
+
+
+def test_shard_of_golden_values_cross_process_contract():
+    """crc32, not hash(): these exact values must hold in ANY process —
+    per-shard lease ownership depends on every replica agreeing."""
+    assert shard_of(("TpuCluster", "default", "storm-0001"), 4) == \
+        shard_of(("TpuCluster", "default", "storm-0001"), 4)
+    import zlib
+    for key in [("TpuCluster", "default", "a"), ("Pod", "ns2", "w-17")]:
+        want = zlib.crc32(f"{key[0]}/{key[1]}/{key[2]}".encode()) % 4
+        assert shard_of(key, 4) == want
+    assert shard_of(("TpuCluster", "default", "x"), 1) == 0
+
+
+def test_pool_routes_requeues_to_same_shard():
+    now = [0.0]
+    pool = ShardedQueuePool(4, now_fn=lambda: now[0])
+    key = k("requeue-me")
+    home = pool.shard_of(key)
+    pool.add_after(key, 5.0)
+    now[0] = 5.0
+    for i in range(4):
+        got = pool.get(i, block=False)
+        if got is not None:
+            assert i == home and got == key
+            pool.done(got)
+    # And the immediate path lands on the same pool.
+    pool.add(key)
+    assert pool.get(home, block=False) == key
+
+
+# ---------------------------------------------------------------------------
+# global per-key serialization across pools (stress)
+# ---------------------------------------------------------------------------
+
+def test_stress_no_key_processed_concurrently_across_pools():
+    """4 shards x 2 pinned workers each, producers hammering hot keys:
+    a per-key in-flight counter proves global per-key serialization,
+    and a generation check proves nothing is lost to coalescing."""
+    pool = ShardedQueuePool(4)
+    hot = [k(f"hot-{i}") for i in range(10)]
+    adds = defaultdict(int)
+    seen = defaultdict(int)
+    inflight = defaultdict(int)
+    processed = defaultdict(int)
+    violations = []
+    wrong_pool = []
+    state_lock = threading.Lock()
+
+    def producer(seed):
+        rng = random.Random(seed)
+        for _ in range(300):
+            key = rng.choice(hot)
+            with state_lock:
+                adds[key] += 1
+            pool.add(key)
+            if rng.random() < 0.05:
+                time.sleep(0.0005)
+
+    def worker(shard):
+        while True:
+            key = pool.get(shard, block=True)
+            if key is None:
+                return
+            if pool.shard_of(key) != shard:
+                wrong_pool.append((key, shard))
+            with state_lock:
+                inflight[key] += 1
+                if inflight[key] > 1:
+                    violations.append(key)
+                gen = adds[key]
+            time.sleep(0.0002)
+            with state_lock:
+                seen[key] = max(seen[key], gen)
+                processed[key] += 1
+                inflight[key] -= 1
+            pool.done(key)
+
+    workers = [threading.Thread(target=worker, args=(s,))
+               for s in range(4) for _ in range(2)]
+    producers = [threading.Thread(target=producer, args=(s,))
+                 for s in range(4)]
+    for t in workers + producers:
+        t.start()
+    for t in producers:
+        t.join(timeout=30.0)
+    deadline = time.time() + 30.0
+    while time.time() < deadline:
+        if pool.depth() == 0 and not any(
+                q._processing or q._dirty for q in pool.queues):
+            break
+        time.sleep(0.005)
+    pool.shutdown()
+    for t in workers:
+        t.join(timeout=10.0)
+
+    assert not violations, \
+        f"keys processed concurrently across pools: {set(violations)}"
+    assert not wrong_pool, f"keys on a foreign pool: {wrong_pool}"
+    for key in hot:
+        assert processed[key] >= 1, f"{key} never processed"
+        assert seen[key] == adds[key], \
+            f"{key}: last pass saw generation {seen[key]} of {adds[key]}"
+
+
+# ---------------------------------------------------------------------------
+# manager-level sharding
+# ---------------------------------------------------------------------------
+
+def test_manager_shards_1_is_the_classic_queue():
+    store = ObjectStore()
+    m = Manager(store)
+    assert m.shards == 1
+    order = []
+    m.register("Thing", lambda name, ns: order.append(name) or None)
+    for name in ("c", "a", "b"):
+        m.enqueue(("Thing", "default", name))
+    m.run_until_idle()
+    assert order == ["c", "a", "b"]     # FIFO, exactly the old behavior
+
+
+def test_manager_sharded_run_until_idle_processes_everything():
+    store = ObjectStore()
+    m = Manager(store, shards=4)
+    seen = set()
+    m.register("Thing", lambda name, ns: seen.add(name) or None)
+    names = [f"obj-{i}" for i in range(40)]
+    for name in names:
+        m.enqueue(("Thing", "default", name))
+    m.run_until_idle()
+    assert seen == set(names)
+
+
+def test_manager_sharded_workers_are_pinned(monkeypatch):
+    """start(workers=1) on 3 shards: every processed key ran on the
+    worker thread pinned to its home shard."""
+    store = ObjectStore()
+    m = Manager(store, shards=3)
+    mismatches = []
+    done = threading.Event()
+    total = 30
+    count = [0]
+
+    def reconcile(name, ns):
+        key = ("Thing", ns, name)
+        tname = threading.current_thread().name
+        want = f"reconciler-s{m.shard_of(key)}-0"
+        if tname != want:
+            mismatches.append((key, tname, want))
+        count[0] += 1
+        if count[0] >= total:
+            done.set()
+        return None
+
+    m.register("Thing", reconcile)
+    m.start(workers=1)
+    try:
+        for i in range(total):
+            m.enqueue(("Thing", "default", f"obj-{i}"))
+        assert done.wait(timeout=10.0), f"only {count[0]}/{total} ran"
+    finally:
+        m.stop()
+    assert not mismatches, mismatches[:5]
+
+
+def test_release_shard_drains_in_flight_and_spares_other_shards():
+    store = ObjectStore()
+    m = Manager(store, shards=2)
+    in_flight = threading.Event()
+    release_gate = threading.Event()
+    processed = []
+    lock = threading.Lock()
+
+    def reconcile(name, ns):
+        key = ("Thing", ns, name)
+        with lock:
+            processed.append((m.shard_of(key), name,
+                              time.monotonic()))
+        if name == "slow":
+            in_flight.set()
+            release_gate.wait(timeout=10.0)
+        return None
+
+    m.register("Thing", reconcile)
+    # Find names on distinct shards.
+    shard_names = {}
+    i = 0
+    while len(shard_names) < 2:
+        name = f"probe-{i}"
+        shard_names.setdefault(
+            m.shard_of(("Thing", "default", name)), name)
+        i += 1
+    slow_shard = m.shard_of(("Thing", "default", "slow"))
+    other_shard = next(s for s in (0, 1) if s != slow_shard)
+
+    m.start(workers=1)
+    try:
+        m.enqueue(("Thing", "default", "slow"))
+        assert in_flight.wait(timeout=5.0)
+        # Queue more work behind the in-flight key on the same shard.
+        n_queued = 0
+        for j in range(40):
+            key = ("Thing", "default", f"later-{j}")
+            if m.shard_of(key) == slow_shard:
+                m.enqueue(key)
+                n_queued += 1
+
+        result = {}
+
+        def releaser():
+            result["drained"] = m.release_shard(slow_shard,
+                                                drain_timeout=10.0)
+            result["at"] = time.monotonic()
+
+        t = threading.Thread(target=releaser)
+        t.start()
+        time.sleep(0.1)
+        assert "drained" not in result     # blocked on the in-flight key
+        release_gate.set()                 # let the reconcile finish
+        t.join(timeout=10.0)
+        assert result.get("drained") is True
+
+        # Nothing processed on the released shard after the drain
+        # returned, and the queued backlog stayed parked.
+        time.sleep(0.2)
+        with lock:
+            late = [p for p in processed
+                    if p[0] == slow_shard and p[2] > result["at"]]
+        assert late == [], late
+        # The other shard keeps reconciling.
+        m.enqueue(("Thing", "default", shard_names[other_shard]))
+        deadline = time.time() + 5.0
+        while time.time() < deadline:
+            with lock:
+                if any(p[0] == other_shard and
+                       p[1] == shard_names[other_shard]
+                       for p in processed):
+                    break
+            time.sleep(0.02)
+        with lock:
+            assert any(p[0] == other_shard and
+                       p[1] == shard_names[other_shard]
+                       for p in processed)
+
+        # Re-acquiring resumes the parked backlog (level-triggered).
+        with lock:
+            before = len([p for p in processed if p[0] == slow_shard])
+        relisted = m.acquire_shard(slow_shard)
+        assert relisted >= 0
+        deadline = time.time() + 5.0
+        while time.time() < deadline:
+            with lock:
+                after = len([p for p in processed
+                             if p[0] == slow_shard])
+            if after >= before + n_queued:
+                break
+            time.sleep(0.02)
+        assert after >= before + n_queued
+    finally:
+        release_gate.set()
+        m.stop()
+
+
+# ---------------------------------------------------------------------------
+# per-shard leases
+# ---------------------------------------------------------------------------
+
+def test_shard_lease_split_with_max_owned():
+    """Two replicas, 4 shards, max_owned=2 each: the fleet converges to
+    an even split with every lease held by exactly one identity."""
+    store = ObjectStore()
+    acquired = defaultdict(set)
+    a = ShardLeaseElector(store, 4, identity="rep-a", max_owned=2,
+                          lease_duration=30.0,
+                          on_acquired=lambda s: acquired["a"].add(s),
+                          on_released=lambda s: acquired["a"].discard(s))
+    b = ShardLeaseElector(store, 4, identity="rep-b", max_owned=2,
+                          lease_duration=30.0,
+                          on_acquired=lambda s: acquired["b"].add(s),
+                          on_released=lambda s: acquired["b"].discard(s))
+    for _ in range(3):
+        a.tick()
+        b.tick()
+    assert len(a.owned()) == 2 and len(b.owned()) == 2
+    assert a.owned() | b.owned() == {0, 1, 2, 3}
+    assert a.owned() & b.owned() == set()
+    for shard in range(4):
+        lease = store.get("Lease", shard_lease_name(shard))
+        holder = lease["spec"]["holderIdentity"]
+        assert holder in ("rep-a", "rep-b")
+        assert shard in (a.owned() if holder == "rep-a" else b.owned())
+
+
+def test_shard_lease_handoff_on_release_and_expiry():
+    store = ObjectStore()
+    a = ShardLeaseElector(store, 2, identity="rep-a", lease_duration=30.0)
+    a.tick()
+    assert a.owned() == {0, 1}
+    # Voluntary shed: renewTime zeroed, peer absorbs immediately.
+    a.release_shard(0)
+    assert a.owned() == {1}
+    b = ShardLeaseElector(store, 2, identity="rep-b", max_owned=1,
+                          lease_duration=30.0)
+    b.tick()
+    assert b.owned() == {0}
+    # Expiry takeover: rep-a dies (stops renewing); with the duration
+    # elapsed, rep-b (cap lifted) absorbs shard 1 too.
+    b.max_owned = None
+    lease = store.get("Lease", shard_lease_name(1))
+    lease["spec"]["renewTime"] = 0.0
+    store.update(lease)
+    b.tick()
+    assert b.owned() == {0, 1}
+
+
+def test_shard_lease_elector_drives_manager_ownership():
+    """The operator wiring end-to-end: elector callbacks flip Manager
+    shard ownership, and a lost lease pauses that pool."""
+    store = ObjectStore()
+    m = Manager(store, shards=2)
+    for shard in range(2):
+        m.release_shard(shard)
+    assert m.owned_shards() == set()
+    elector = ShardLeaseElector(store, 2, identity="rep-a",
+                                lease_duration=30.0,
+                                on_acquired=m.acquire_shard,
+                                on_released=m.release_shard)
+    elector.tick()
+    assert m.owned_shards() == {0, 1}
+    elector.release_shard(0)
+    assert m.owned_shards() == {1}
+    # The released pool is paused: keys park instead of being handed out.
+    probe = None
+    for i in range(20):
+        key = ("Thing", "default", f"p-{i}")
+        if m.shard_of(key) == 0:
+            probe = key
+            break
+    assert probe is not None
+    m.register("Thing", lambda name, ns: None)
+    m.enqueue(probe)
+    assert m._pool.get(0, block=False) is None

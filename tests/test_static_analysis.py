@@ -36,7 +36,8 @@ def test_rule_registry_complete():
             "exception-swallow", "tpu-env-completeness",
             "requeue-observability",
             "phase-transition-recorded",
-            "no-io-under-store-lock"} <= set(RULES)
+            "no-io-under-store-lock",
+            "shard-affinity"} <= set(RULES)
     for cls in RULES.values():
         assert cls.DESCRIPTION and cls.INVARIANT
 
@@ -714,6 +715,52 @@ def test_no_io_under_store_lock_ignores_other_locks():
                     self._journal.append(json.dumps({}).encode())
     """, only=["no-io-under-store-lock"])
     assert "no-io-under-store-lock" not in fired
+
+
+# ---------------------------------------------------------------------------
+# shard-affinity
+# ---------------------------------------------------------------------------
+
+def test_shard_affinity_flags_direct_pool_add_outside_router():
+    _, fired = _rules_fired("""
+        class TpuThingController:
+            def kick(self, key):
+                self.manager._pool.add(key)
+    """, only=["shard-affinity"],
+        path="kuberay_tpu/controlplane/cluster_controller.py")
+    assert "shard-affinity" in fired
+
+
+def test_shard_affinity_flags_private_workqueue_and_add_after():
+    findings, fired = _rules_fired("""
+        from kuberay_tpu.controlplane.workqueue import WorkQueue
+
+        class Rogue:
+            def __init__(self):
+                self.wq = WorkQueue()
+
+            def later(self, key):
+                self.wq.add_after(key, 5.0)
+    """, only=["shard-affinity"], path="kuberay_tpu/operator.py")
+    assert "shard-affinity" in fired
+    assert len(findings) == 2            # the ctor AND the add_after
+
+
+def test_shard_affinity_quiet_in_router_modules_and_on_plain_sets():
+    _, fired = _rules_fired("""
+        class Manager:
+            def enqueue(self, key):
+                self._pool.add(key)
+    """, only=["shard-affinity"],
+        path="kuberay_tpu/controlplane/manager.py")
+    assert fired == set()
+    _, fired = _rules_fired("""
+        def track(seen, used, key):
+            seen.add(key)        # a set, not a pool
+            used.add(key)
+    """, only=["shard-affinity"],
+        path="kuberay_tpu/controlplane/cluster_controller.py")
+    assert fired == set()
 
 
 # ---------------------------------------------------------------------------

@@ -141,6 +141,60 @@ def test_depth_and_latency_metrics():
 
 
 # ---------------------------------------------------------------------------
+# pause / drain (per-shard lease handoff, ISSUE 6)
+# ---------------------------------------------------------------------------
+
+def test_pause_parks_keys_and_resume_releases_them():
+    wq = WorkQueue()
+    wq.add(k("a"))
+    wq.pause()
+    wq.add(k("b"))                      # accumulates (and dedups) parked
+    wq.add(k("b"))
+    assert wq.get(block=False) is None  # nothing handed out while paused
+    assert wq.depth() == 2              # nothing lost either
+    wq.resume()
+    assert wq.get(block=False) == k("a")
+    wq.done(k("a"))
+    assert wq.get(block=False) == k("b")
+    wq.done(k("b"))
+    assert wq.get(block=False) is None
+
+
+def test_resume_wakes_blocked_getter():
+    wq = WorkQueue()
+    wq.pause()
+    wq.add(k("x"))
+    results = []
+    t = threading.Thread(target=lambda: results.append(wq.get(block=True)))
+    t.start()
+    time.sleep(0.05)
+    assert not results                  # parked behind the pause
+    wq.resume()
+    t.join(timeout=2.0)
+    assert results == [k("x")]
+    wq.done(k("x"))
+
+
+def test_wait_idle_processing_is_the_drain_barrier():
+    wq = WorkQueue()
+    wq.add(k("inflight"))
+    assert wq.get(block=False) == k("inflight")
+    wq.pause()
+    # In flight: the barrier must block (short timeout -> False).
+    assert wq.wait_idle_processing(timeout=0.1) is False
+    done = []
+    t = threading.Thread(
+        target=lambda: done.append(wq.wait_idle_processing(timeout=5.0)))
+    t.start()
+    time.sleep(0.05)
+    wq.done(k("inflight"))              # worker finishes
+    t.join(timeout=2.0)
+    assert done == [True]
+    # Paused + drained: a dirty re-add parked during flight stays parked.
+    assert wq.get(block=False) is None
+
+
+# ---------------------------------------------------------------------------
 # concurrency stress (tier-1 gate: ISSUE 5 acceptance)
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,37 @@
+#!/usr/bin/env sh
+# Scale-ladder smoke: the 300-cluster rung only (CI-shaped; the full
+# published ladder is 300/1k/3k/10k x shards 1,4 — docs/performance.md):
+#
+#   tools/bench_scale.sh                                # 300-rung smoke
+#   BENCH_RUNGS=300,1000 BENCH_SHARDS=1,4 tools/bench_scale.sh
+#
+# Asserts the tpu-bench-ladder/v1 artifact schema: every leg converged
+# and carries the full tpu-bench/v1 key set (ARTIFACT_KEYS), so a
+# refactor can't silently drop a ladder column.  Part of the smoke
+# family (tools/bench_controlplane.sh, tools/sim_smoke.sh).
+set -eu
+cd "$(dirname "$0")/.."
+out="${BENCH_OUT:-/tmp/tpu_bench_ladder_smoke.json}"
+timeout -k 10 900 env JAX_PLATFORMS=cpu python benchmark/scale_bench.py \
+    --ladder "${BENCH_RUNGS:-300}" \
+    --ladder-shards "${BENCH_SHARDS:-1,4}" \
+    --ladder-workers "${BENCH_WORKERS:-1}" \
+    --timeout "${BENCH_TIMEOUT:-600}" \
+    --out "$out" > /dev/null
+BENCH_ARTIFACT="$out" python - <<'EOF'
+import json, os, sys
+sys.path.insert(0, ".")
+from benchmark.controlplane_bench import ARTIFACT_KEYS
+doc = json.load(open(os.environ["BENCH_ARTIFACT"]))
+assert doc.get("schema") == "tpu-bench-ladder/v1", doc.get("schema")
+assert doc["legs"], "ladder produced no legs"
+for leg in doc["legs"]:
+    missing = [k for k in ARTIFACT_KEYS if k not in leg]
+    assert not missing, f"leg missing artifact keys {missing}: {leg}"
+    assert leg["schema"] == "tpu-bench/v1"
+    assert leg["converged"], f"leg did not converge: {leg['workload']}"
+    assert leg["reconciles_per_sec"] > 0
+print("bench_scale smoke ok:", ", ".join(
+    "%(clusters)dx s=%(shards)d" % leg["workload"] +
+    " %.1fs" % leg["elapsed_s"] for leg in doc["legs"]))
+EOF
