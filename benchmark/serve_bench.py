@@ -16,6 +16,7 @@ import json
 import pathlib
 import sys
 import time
+import zlib
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
@@ -312,6 +313,363 @@ def matrix(args) -> None:
         print(f"wrote {args.json_out}", flush=True)
 
 
+# ---------------------------------------------------------------------------
+# Seeded open-loop traffic generator: the fleet-serving gate (PR 7)
+# ---------------------------------------------------------------------------
+
+TRAFFIC_SCHEMA = "tpu-bench-serve/v1"
+# Per-leg keys the smoke gate (tools/bench_serve.sh) asserts on.
+TRAFFIC_LEG_KEYS = (
+    "workload", "seed", "replicas", "affinity", "shedding", "requests",
+    "completed", "shed", "errors", "tokens_per_sec", "ttft_p50_ms",
+    "ttft_p99_ms", "prefix_hit_rate", "gateway_prefix_picks",
+)
+
+
+class _Fleet:
+    """N paged serve replicas behind a WeightedGateway, all in-process.
+
+    Open-loop harness detail: the generator never waits for responses to
+    send the next request (arrival times are a seeded schedule), so
+    overload genuinely queues/sheds instead of self-throttling — the
+    regime closed-loop drivers can't reach.
+    """
+
+    def __init__(self, cfg, params, replicas, *, slots, max_len,
+                 num_blocks, block_size, seed, affinity, shedding,
+                 max_queue=512):
+        import random as _random
+
+        from kuberay_tpu.controlplane.store import ObjectStore
+        from kuberay_tpu.serve.gateway import GatewayConfig, WeightedGateway
+        from kuberay_tpu.serve.paged_engine import PagedServeEngine
+        from kuberay_tpu.serve.server import ServeFrontend
+        from kuberay_tpu.utils.metrics import MetricsRegistry
+
+        self.frontends = []
+        self.servers = []
+        urls = {}
+        for i in range(replicas):
+            eng = PagedServeEngine(cfg, params, max_slots=slots,
+                                   max_len=max_len, num_blocks=num_blocks,
+                                   block_size=block_size)
+            fe = ServeFrontend(eng, max_queue=max_queue)
+            srv, url = fe.serve_background()
+            self.frontends.append(fe)
+            self.servers.append(srv)
+            urls[f"replica-{i}"] = url
+        store = ObjectStore()
+        store.create({
+            "apiVersion": "tpu.dev/v1", "kind": "TrafficRoute",
+            "metadata": {"name": "bench", "namespace": "default"},
+            "spec": {"backends": [{"service": s, "weight": 1}
+                                  for s in urls]},
+            "status": {},
+        })
+        gw_cfg = GatewayConfig(
+            affinity=affinity,
+            # The on-leg isolates scored routing (ε exploration would
+            # re-spray ~5% of hot traffic — its distribution properties
+            # are unit-tested, not re-measured here); the off-leg IS the
+            # weighted-random baseline.
+            epsilon=0.0 if affinity else 1.0,
+            block_size=block_size,
+            # Shedding on: admit at most the fleet's concurrent service
+            # capacity per replica and bound the hold queue; off: admit
+            # everything (backend queues absorb the burst and TTFT pays).
+            max_inflight=(2 * slots) if shedding else 0,
+            max_queue=16 if shedding else 4096,
+            queue_timeout=2.0 if shedding else 600.0)
+        self.metrics = MetricsRegistry()
+        self.gateway = WeightedGateway(
+            store, "bench", resolver=lambda s: urls[s],
+            poll_interval=30.0, metrics=self.metrics, config=gw_cfg,
+            rng=_random.Random(seed))
+
+    def warm(self, prompts):
+        """Compile every program the timed pass hits, once per replica,
+        by routing a warmup prompt straight at each frontend."""
+        for fe in self.frontends:
+            for p in prompts:
+                fe.submit(p, max_tokens=2, timeout=600.0)
+
+    def prefix_stats(self):
+        hits = queries = 0
+        for fe in self.frontends:
+            st = fe.engine.stats
+            hits += st["prefix_hit_tokens"]
+            queries += st["prefix_query_tokens"]
+        return hits, queries
+
+    def reset_counters(self):
+        for fe in self.frontends:
+            a = fe.engine.allocator
+            a.prefix_hits = 0
+            a.prefix_queries = 0
+
+    def close(self):
+        self.gateway.stop()
+        for srv in self.servers:
+            srv.shutdown()
+        for fe in self.frontends:
+            fe.close()
+
+
+def _hot_prompts(prefix_len, hot_prefixes):
+    return [[1000 + 97 * h + j for j in range(prefix_len)]
+            for h in range(hot_prefixes)]
+
+
+def _gen_arrivals(rng, workload, duration_s, base_rate, prefix_len,
+                  block_size, hot_prefixes, hot_fraction,
+                  cold_len=64):
+    """Seeded open-loop schedule: [(t_offset, prompt_tokens)].  Rates:
+    diurnal = sinusoidal ramp peaking mid-run at 2x base; burst = base
+    with a 4x storm in the middle third; hot-prefix = flat base with
+    ``hot_fraction`` of prompts drawn from ``hot_prefixes`` shared
+    prefixes (the prefix-skew regime affinity routing exists for) and
+    SHORT unique cold prompts (``cold_len``) in between — chat turns
+    against long system preambles, not a second long-prefill class that
+    would bury the hit/miss contrast in the tail."""
+    import math
+
+    hots = _hot_prompts(prefix_len, hot_prefixes)
+    arrivals = []
+    t = 0.0
+    n = 0
+    while t < duration_s:
+        if workload == "diurnal":
+            rate = base_rate * (1.0 + math.sin(math.pi * t / duration_s))
+        elif workload == "burst":
+            mid = duration_s / 3 <= t < 2 * duration_s / 3
+            rate = base_rate * (4.0 if mid else 1.0)
+        else:                                      # hot-prefix
+            rate = base_rate
+        t += rng.expovariate(rate)
+        if t >= duration_s:
+            break
+        n += 1
+        if workload == "hot-prefix" and rng.random() < hot_fraction:
+            prompt = list(rng.choice(hots))
+        else:
+            length = cold_len if workload == "hot-prefix" else prefix_len
+            # Cold prompt: unique head so no block-aligned prefix ever
+            # repeats (rng-free of the hot pool).
+            prompt = [50_000 + (n * block_size + j) % 30_000
+                      for j in range(length)]
+        prompt = prompt + [40_000 + n % 9000]      # unique tail token
+        arrivals.append((t, prompt))
+    return arrivals
+
+
+def _drive_open_loop(gateway_url, arrivals, max_new, timeout=120.0):
+    """Replay the schedule against the gateway over real HTTP; returns
+    per-request records."""
+    import concurrent.futures
+    import urllib.error
+    import urllib.request
+
+    records = []
+    lock = __import__("threading").Lock()
+
+    def fire(prompt):
+        body = json.dumps({"prompt_tokens": prompt,
+                           "max_tokens": max_new}).encode()
+        req = urllib.request.Request(
+            f"{gateway_url}/v1/completions", data=body,
+            headers={"Content-Type": "application/json"})
+        t0 = time.perf_counter()
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                doc = json.load(resp)
+                rec = {"code": resp.status,
+                       "latency_s": time.perf_counter() - t0,
+                       "ttft_ms": doc.get("ttft_ms"),
+                       "tokens": len(doc.get("tokens", []))}
+        except urllib.error.HTTPError as e:
+            e.read()
+            rec = {"code": e.code,
+                   "latency_s": time.perf_counter() - t0,
+                   "ttft_ms": None, "tokens": 0}
+        except Exception:
+            rec = {"code": -1, "latency_s": time.perf_counter() - t0,
+                   "ttft_ms": None, "tokens": 0}
+        with lock:
+            records.append(rec)
+
+    start = time.perf_counter()
+    # Enough client threads that the pool NEVER back-pressures the
+    # schedule — an open-loop generator that waits for free workers is
+    # secretly closed-loop exactly when overload makes it matter.
+    with concurrent.futures.ThreadPoolExecutor(max_workers=256) as pool:
+        for t_off, prompt in arrivals:
+            delay = start + t_off - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            pool.submit(fire, prompt)
+    wall = time.perf_counter() - start
+    return records, wall
+
+
+def _gateway_hits(fleet):
+    return sum(
+        float(line.rsplit(" ", 1)[1])
+        for line in fleet.metrics.render().splitlines()
+        if line.startswith("tpu_gateway_prefix_cache_hits_total{"))
+
+
+def _leg_summary(workload, seed, replicas, affinity, shedding, records,
+                 wall, fleet, gw_hits_base=0.0):
+    completed = [r for r in records if r["code"] == 200]
+    shed = sum(1 for r in records if r["code"] == 429)
+    errors = sum(1 for r in records if r["code"] not in (200, 429))
+    ttfts = sorted(r["ttft_ms"] for r in completed
+                   if r["ttft_ms"] is not None)
+    lats = sorted(r["latency_s"] for r in completed)
+    hits, queries = fleet.prefix_stats()
+    gw_hits = _gateway_hits(fleet) - gw_hits_base
+    return {
+        "workload": workload, "seed": seed, "replicas": replicas,
+        "affinity": affinity, "shedding": shedding,
+        "requests": len(records), "completed": len(completed),
+        "shed": shed, "errors": errors,
+        "tokens_per_sec": round(
+            sum(r["tokens"] for r in completed) / wall, 1),
+        "ttft_p50_ms": round(percentile(ttfts, 50), 2) if ttfts else None,
+        "ttft_p99_ms": round(percentile(ttfts, 99), 2) if ttfts else None,
+        "latency_p99_ms": round(
+            percentile(lats, 99) * 1e3, 2) if lats else None,
+        "prefix_hit_rate": round(hits / queries, 3) if queries else 0.0,
+        "gateway_prefix_picks": int(gw_hits),
+        "wall_s": round(wall, 2),
+    }
+
+
+# Per-workload regimes (CPU-calibrated on llama_tiny; the RELATIVE
+# contrasts are the published evidence, the same harness records on-chip
+# numbers through a tunnel window):
+# - hot-prefix: long shared prefixes so a cache miss pays a real prefill,
+#   pool sized so ONE replica cannot hold every hot prefix on top of
+#   live traffic — spraying (affinity off) thrashes, partitioning
+#   (affinity on) fits;
+# - burst: a 4x arrival storm over the middle third against a fleet
+#   provisioned for the base rate — the load-shedding regime;
+# - diurnal: a sinusoidal ramp peaking at 2x base, run at 1 and 2
+#   replicas — TTFT p99 vs replica count for the SLO autoscaler story.
+TRAFFIC_PROFILES = {
+    "hot-prefix": dict(prefix=496, new=8, slots=4, rate=5.0),
+    "burst": dict(prefix=48, new=32, slots=2, rate=18.0),
+    "diurnal": dict(prefix=48, new=32, slots=2, rate=12.0),
+}
+
+HOT_PREFIXES = 8
+HOT_FRACTION = 0.85
+
+
+def traffic(args) -> None:
+    """Seeded open-loop traffic gate: hot-prefix skew (affinity on/off),
+    burst storm (shedding on/off), diurnal ramp (1 vs 2 replicas).  One
+    JSON line per leg; ``--json-out`` writes the tpu-bench-serve/v1
+    artifact (benchmark/results/serve_r07.json)."""
+    import random as _random
+
+    import jax
+    from kuberay_tpu.models import llama
+
+    cfg = llama.CONFIGS[args.model]
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    bs = 16
+
+    workloads = []
+    if args.traffic in ("hot-prefix", "all"):
+        workloads += [("hot-prefix", 2, True, False),
+                      ("hot-prefix", 2, False, False)]
+    if args.traffic in ("burst", "all"):
+        workloads += [("burst", 2, True, True),
+                      ("burst", 2, True, False)]
+    if args.traffic in ("diurnal", "all"):
+        workloads += [("diurnal", 1, True, True),
+                      ("diurnal", 2, True, True)]
+
+    legs = []
+    for seed in args.seeds:
+        for workload, replicas, affinity, shedding in workloads:
+            prof = TRAFFIC_PROFILES[workload]
+            prefix_len = prof["prefix"]
+            new_tokens = prof["new"]
+            slots = prof["slots"]
+            rate = prof["rate"] * args.rate_scale
+            max_len = prefix_len + new_tokens + 16
+            blocks_per_prompt = (max_len + bs - 1) // bs
+            num_blocks = slots * blocks_per_prompt + \
+                (HOT_PREFIXES // 2 + 1) * (prefix_len // bs)
+            fleet = _Fleet(cfg, params, replicas, slots=slots,
+                           max_len=max_len, num_blocks=num_blocks,
+                           block_size=bs, seed=seed, affinity=affinity,
+                           shedding=shedding)
+            try:
+                # Warm every compiled shape OUTSIDE the timed window:
+                # full prefill bucket, cold-prompt bucket, cached-suffix
+                # bucket, decode.
+                warm = [11_111 + j for j in range(prefix_len)]
+                cold_warm = [12_345 + j for j in range(64)]
+                fleet.warm([warm + [7], warm + [8], cold_warm + [9]])
+                gw_srv, gw_url = fleet.gateway.serve_background_http()
+                try:
+                    if workload == "hot-prefix":
+                        # Steady-state measurement: drive every hot
+                        # prefix through the GATEWAY twice so routing
+                        # homes are learned and replica caches warm the
+                        # same way live traffic warms them (first-touch
+                        # compulsory misses are cold-start, not routing,
+                        # and 8 of them would own a 150-request p99).
+                        hots = _hot_prompts(prefix_len, HOT_PREFIXES)
+                        hot_warm = [(0.25 * i, list(p) + [31337])
+                                    for i, p in enumerate(hots * 2)]
+                        _drive_open_loop(gw_url, hot_warm, new_tokens)
+                    fleet.reset_counters()
+                    gw_hits_base = _gateway_hits(fleet)
+                    # zlib.crc32, not hash(): str hashing is salted per
+                    # process and would unseed the schedule.
+                    rng = _random.Random(
+                        (seed << 8)
+                        ^ (zlib.crc32(workload.encode()) & 0xFFFF))
+                    arrivals = _gen_arrivals(
+                        rng, workload, args.duration, rate, prefix_len,
+                        bs, HOT_PREFIXES, hot_fraction=HOT_FRACTION)
+                    records, wall = _drive_open_loop(gw_url, arrivals,
+                                                     new_tokens)
+                finally:
+                    gw_srv.shutdown()
+                leg = _leg_summary(workload, seed, replicas, affinity,
+                                   shedding, records, wall, fleet,
+                                   gw_hits_base=gw_hits_base)
+                legs.append(leg)
+                print(json.dumps(leg), flush=True)
+            finally:
+                fleet.close()
+
+    doc = {
+        "schema": TRAFFIC_SCHEMA,
+        "workload_params": {
+            "model": args.model, "duration_s": args.duration,
+            "rate_scale": args.rate_scale, "block_size": bs,
+            "hot_prefixes": HOT_PREFIXES, "hot_fraction": HOT_FRACTION,
+            "profiles": TRAFFIC_PROFILES,
+        },
+        "seeds": list(args.seeds),
+        "device": str(jax.devices()[0]),
+        "platform": jax.devices()[0].platform,
+        "legs": legs,
+    }
+    if args.json_out:
+        pathlib.Path(args.json_out).parent.mkdir(parents=True,
+                                                 exist_ok=True)
+        pathlib.Path(args.json_out).write_text(
+            json.dumps(doc, indent=2) + "\n")
+        print(f"wrote {args.json_out}", flush=True)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="serve-bench")
     ap.add_argument("--cpu", action="store_true",
@@ -326,8 +684,19 @@ def main(argv=None) -> int:
     ap.add_argument("--matrix", action="store_true",
                     help="run the full engine matrix with TTFT "
                          "percentiles and relative overheads")
+    ap.add_argument("--traffic", default="",
+                    choices=["", "hot-prefix", "burst", "diurnal", "all"],
+                    help="seeded open-loop traffic generator through the "
+                         "prefix-aware gateway (tpu-bench-serve/v1)")
+    ap.add_argument("--seeds", default="0",
+                    help="traffic seeds: single (7) or range (0..2)")
+    ap.add_argument("--duration", type=float, default=20.0,
+                    help="seconds of open-loop traffic per leg")
+    ap.add_argument("--rate-scale", type=float, default=1.0,
+                    help="multiply every traffic profile's base rate "
+                         "(smoke runs shrink with --duration + this)")
     ap.add_argument("--json-out", default="",
-                    help="write matrix results to this JSON file")
+                    help="write matrix/traffic results to this JSON file")
     ap.add_argument("--repeats", type=int, default=3,
                     help="timed rounds per variant; median is published")
     args = ap.parse_args(argv)
@@ -337,7 +706,14 @@ def main(argv=None) -> int:
     else:
         from kuberay_tpu.utils.platform import pin_platform_from_env
         pin_platform_from_env()
-    if args.matrix:
+    if args.traffic:
+        if ".." in args.seeds:
+            lo, hi = args.seeds.split("..", 1)
+            args.seeds = list(range(int(lo), int(hi) + 1))
+        else:
+            args.seeds = [int(args.seeds)]
+        traffic(args)
+    elif args.matrix:
         matrix(args)
     else:
         run(args)

@@ -117,7 +117,8 @@ class DecisionAudit:
 
     def record(self, namespace: str, cluster: str, decision: GroupDecision,
                *, current: int, demand: Dict[str, int],
-               slices: List[SliceInfo], applied: bool) -> Dict[str, Any]:
+               slices: List[SliceInfo], applied: bool,
+               slo: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
         if decision.replicas > current:
             direction = "up"
         elif decision.replicas < current or decision.slices_to_delete:
@@ -139,6 +140,8 @@ class DecisionAudit:
                            for s in slices if s.group == decision.group],
             },
         }
+        if slo is not None:
+            entry["signals"]["slo"] = dict(slo)
         with self._lock:
             self._ring.append(entry)
             self.total += 1
@@ -203,11 +206,21 @@ class SliceAutoscaler:
     """
 
     def __init__(self, store: ObjectStore, idle_timeout: float = 60.0,
-                 audit: Optional[DecisionAudit] = None):
+                 audit: Optional[DecisionAudit] = None,
+                 slo=None, clock=None):
         self.store = store
         self.idle_timeout = idle_timeout
         # Decision audit ring (``/debug/autoscaler``); None = unaudited.
         self.audit = audit
+        # SLO signal path (controlplane/slo.ServeSloSignal): serve TTFT
+        # p99 / queue-depth evaluated into a demand FLOOR for the
+        # signal's policy group — merged max() with job demand, so a
+        # breaching serve fleet scales up even with zero queued jobs and
+        # a held one can't be idle-reaped mid-recovery.
+        self.slo = slo
+        # Injectable clock (object with .now()) so idle bookkeeping and
+        # SLO hysteresis run under the sim VirtualClock in tests.
+        self._now = clock.now if clock is not None else time.time
         # (namespace, cluster, slice-name) -> idle-since timestamp
         self._idle_since: Dict[tuple, float] = {}
 
@@ -242,7 +255,7 @@ class SliceAutoscaler:
             sname = p["metadata"]["labels"].get(C.LABEL_SLICE_NAME)
             if sname:
                 by_slice.setdefault(sname, []).append(p)
-        now = time.time()
+        now = self._now()
         # Idle bookkeeping is keyed per (ns, cluster, slice) so one
         # autoscaler instance can manage many clusters; prune only THIS
         # cluster's vanished slices — a stale entry would leak and make a
@@ -291,6 +304,14 @@ class SliceAutoscaler:
         idle_timeout = opts.idleTimeoutSeconds if opts else self.idle_timeout
         mode = opts.upscalingMode if opts else "Default"
         demand = self._demand_for(obj)
+        slo_info = None
+        if self.slo is not None:
+            group = next((g for g in cluster.spec.workerGroupSpecs
+                          if g.groupName == self.slo.policy.group), None)
+            if group is not None:
+                floor, slo_info = self.slo.demand_floor(group.replicas)
+                gname = group.groupName
+                demand[gname] = max(demand.get(gname, 0), floor)
         slices = self.observe_slices(obj, demand)
         decisions = decide(cluster, demand, slices, idle_timeout, mode)
         applied = apply_decisions(self.store, cluster_name, namespace,
@@ -302,5 +323,5 @@ class SliceAutoscaler:
                 self.audit.record(namespace, cluster_name, d,
                                   current=current.get(d.group, 0),
                                   demand=demand, slices=slices,
-                                  applied=applied)
+                                  applied=applied, slo=slo_info)
         return applied

@@ -1,0 +1,158 @@
+"""SLO-driven autoscaling signal: serve latency histograms -> demand.
+
+Closes the serving loop the resource-driven :mod:`autoscaler` can't see:
+``SliceAutoscaler`` scales on queued-TpuJob demand and slice idleness,
+which says nothing about an inference fleet whose job set is static but
+whose TTFT p99 just blew through its SLO.  :class:`ServeSloSignal` reads
+the ``tpu_serve_request_duration_seconds{phase="ttft"}`` histogram the
+engines observe (serve/engine.py) plus a pluggable queue-depth source
+(the gateway's ``total_queue_depth``), evaluates a windowed p99 against
+the target, and emits a **demand floor** the autoscaler merges with job
+demand:
+
+- sustained breach (>= ``breach_seconds``, outside ``cooldown_seconds``
+  of the last scale verdict) -> floor = current + 1: `decide()` steps
+  one slice up exactly as a queued job would ask it to;
+- breach present but not yet sustained, or clear but not yet for
+  ``clear_seconds`` -> floor = current: the group reads as *claimed*, so
+  the idle reaper can't shrink it mid-recovery (this is the hysteresis:
+  flapping latency never yields scale-down/scale-up oscillation);
+- sustained clear -> floor = 0: the signal releases the group and the
+  existing idle-timeout machinery reaps surplus slices.
+
+Windowed p99 comes from **bucket deltas** between evaluations — the
+histogram is cumulative, so subtracting the previous snapshot isolates
+the requests observed since the last pass; the percentile interpolates
+within the crossing bucket (the same inclusive-style estimate the bench
+quantiles use, quantized to bucket edges).
+
+Everything is clock-injectable (``clock.now``) so the hysteresis state
+machine runs under the sim VirtualClock byte-identically.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+
+TTFT_METRIC = "tpu_serve_request_duration_seconds"
+
+
+@dataclasses.dataclass
+class SloPolicy:
+    group: str = "workers"          # worker group the signal scales
+    ttft_p99_target_s: float = 0.5  # the SLO
+    queue_depth_high: int = 16      # fleet queue depth that alone breaches
+    min_samples: int = 5            # window p99 needs this many requests
+    breach_seconds: float = 15.0    # sustained breach before scale-up
+    clear_seconds: float = 60.0     # sustained clear before release
+    cooldown_seconds: float = 30.0  # min gap between scale-up verdicts
+
+
+def histogram_delta_p99(prev: Optional[Dict], cur: Optional[Dict]
+                        ) -> Tuple[float, int]:
+    """(p99 seconds, samples) of the observations BETWEEN two snapshots
+    of one cumulative histogram (utils.metrics histogram_snapshot
+    layout).  No new samples -> (0.0, 0)."""
+    if cur is None:
+        return 0.0, 0
+    counts = list(cur["counts"])
+    if prev is not None and prev["buckets"] == cur["buckets"]:
+        counts = [c - p for c, p in zip(counts, prev["counts"])]
+    n = sum(counts)
+    if n <= 0:
+        return 0.0, 0
+    rank = 0.99 * n
+    cum = 0
+    lo = 0.0
+    for bound, c in zip(cur["buckets"], counts):
+        if c > 0:
+            if cum + c >= rank:
+                if bound == float("inf"):
+                    return lo, n          # open tail: report the floor
+                frac = (rank - cum) / c
+                return lo + frac * (bound - lo), n
+            cum += c
+        if bound != float("inf"):
+            lo = bound
+    return lo, n
+
+
+class ServeSloSignal:
+    """Hysteresis state machine from serve latency to a demand floor.
+
+    ``registry`` is the MetricsRegistry the serve engines/gateway
+    observe into; ``queue_depth_fn`` (e.g. ``gateway.total_queue_depth``)
+    contributes the load half of the breach predicate.  Thread-safe: the
+    operator's background loop and debug handlers may race.
+    """
+
+    def __init__(self, registry, policy: Optional[SloPolicy] = None,
+                 queue_depth_fn: Optional[Callable[[], int]] = None,
+                 clock=None, phase: str = "ttft"):
+        self.registry = registry
+        self.policy = policy or SloPolicy()
+        self.queue_depth_fn = queue_depth_fn
+        self.phase = phase
+        self._now = clock.now if clock is not None else time.time
+        self._lock = threading.Lock()
+        self._prev_snapshot: Optional[Dict] = None
+        self._breach_since: Optional[float] = None
+        self._clear_since: Optional[float] = None
+        self._last_scale_up = float("-inf")
+
+    def _sample_locked(self) -> Tuple[float, int, int]:
+        cur = self.registry.histogram_snapshot(TTFT_METRIC,
+                                               {"phase": self.phase})
+        p99, n = histogram_delta_p99(self._prev_snapshot, cur)
+        self._prev_snapshot = cur
+        qd = int(self.queue_depth_fn()) if self.queue_depth_fn else 0
+        return p99, n, qd
+
+    def demand_floor(self, current: int) -> Tuple[int, Dict]:
+        """Evaluate once; returns (demand floor for the policy group,
+        signal record for the DecisionAudit ring)."""
+        pol = self.policy
+        now = self._now()
+        with self._lock:
+            p99, n, qd = self._sample_locked()
+            latency_breach = n >= pol.min_samples and \
+                p99 > pol.ttft_p99_target_s
+            queue_breach = qd >= pol.queue_depth_high
+            if latency_breach or queue_breach:
+                self._clear_since = None
+                if self._breach_since is None:
+                    self._breach_since = now
+                sustained = now - self._breach_since >= pol.breach_seconds
+                cooled = now - self._last_scale_up >= pol.cooldown_seconds
+                if sustained and cooled:
+                    self._last_scale_up = now
+                    state, floor = "scale_up", current + 1
+                else:
+                    state, floor = "breaching", current
+            else:
+                self._breach_since = None
+                if self._clear_since is None:
+                    self._clear_since = now
+                if now - self._clear_since >= pol.clear_seconds:
+                    state, floor = "clear", 0
+                else:
+                    state, floor = "holding", current
+            breach_for = (now - self._breach_since
+                          if self._breach_since is not None else 0.0)
+            clear_for = (now - self._clear_since
+                         if self._clear_since is not None else 0.0)
+        return floor, {
+            "state": state,
+            "ttft_p99_s": round(p99, 6),
+            "ttft_p99_target_s": pol.ttft_p99_target_s,
+            "window_samples": n,
+            "queue_depth": qd,
+            "queue_depth_high": pol.queue_depth_high,
+            "breach_for_s": round(breach_for, 3),
+            "clear_for_s": round(clear_for, 3),
+            "floor": floor,
+        }

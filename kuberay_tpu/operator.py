@@ -58,7 +58,8 @@ class Operator:
                  store: Optional[ObjectStore] = None,
                  client_provider=None,
                  fake_kubelet: bool = False,
-                 watch_dispatch: str = "sync"):
+                 watch_dispatch: str = "sync",
+                 slo_signal=None):
         self.config = config or OperatorConfiguration()
         features.set_gates(self.config.featureGates)
         # ``watch_dispatch`` applies only when the Operator builds its
@@ -131,8 +132,12 @@ class Operator:
             self.store, recorder=self.recorder, tracer=self.tracer)
         from kuberay_tpu.controlplane.autoscaler import DecisionAudit
         self.autoscaler_audit = DecisionAudit(metrics=self.metrics)
+        # ``slo_signal`` (controlplane/slo.ServeSloSignal): embedders
+        # serving traffic in-process hand the autoscaler their serve
+        # TTFT/queue-depth SLO signal; None keeps the resource-only path.
         self.autoscaler = SliceAutoscaler(self.store,
-                                          audit=self.autoscaler_audit)
+                                          audit=self.autoscaler_audit,
+                                          slo=slo_signal)
 
         m = self.manager
         m.register(C.KIND_CLUSTER, self._timed(C.KIND_CLUSTER,

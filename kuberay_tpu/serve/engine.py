@@ -31,6 +31,7 @@ from kuberay_tpu.serve.kv_cache import (
     forward_with_cache_mixtral,
     init_kv_cache,
 )
+from kuberay_tpu.utils.metrics import SERVE_LATENCY_BUCKETS
 
 
 @dataclasses.dataclass
@@ -53,6 +54,10 @@ class Response:
     finish_reason: str = "length"     # length|eos|cancelled|preempted
     prompt_len: int = 0
     created: float = 0.0
+    # Exact enqueue->first-token seconds (None for cancelled requests).
+    # Flows through the serve HTTP surface as ``ttft_ms`` so gateway-side
+    # clients and the traffic benchmark measure TTFT without streaming.
+    ttft_s: Optional[float] = None
 
 
 def _bucket(n: int, max_len: int = 2048) -> int:
@@ -272,6 +277,10 @@ class ServeEngine:
         self.budget = np.zeros(max_slots, dtype=np.int32)
         self.queue: List[Request] = []
         self._finished: List[Response] = []
+        # TTFT bookkeeping (always on, metrics or not): enqueue instants
+        # by request id, first-token latency by slot until finish.
+        self._arrival: Dict[str, float] = {}
+        self._ttft: List[Optional[float]] = [None] * max_slots
 
         # With a mesh, pin output shardings so the cache round-trips
         # sharded (no surprise all-gathers) and sampled tokens come back
@@ -428,6 +437,7 @@ class ServeEngine:
     # ------------------------------------------------------------------
 
     def add_request(self, req: Request) -> None:
+        self._arrival[req.request_id] = time.time()
         self._phase_mark(req.request_id, "queued")
         if len(req.prompt_tokens) >= self.max_len or req.max_new_tokens <= 0:
             self._cancel(req)
@@ -435,6 +445,7 @@ class ServeEngine:
         self.queue.append(req)
 
     def _cancel(self, req: Request) -> None:
+        self._arrival.pop(req.request_id, None)
         self._req_phase_ts.pop(req.request_id, None)
         self._finished.append(Response(
             req.request_id, [], "cancelled",
@@ -477,6 +488,15 @@ class ServeEngine:
     @property
     def num_active(self) -> int:
         return sum(1 for r in self.active if r is not None)
+
+    @property
+    def stats(self) -> Dict[str, Any]:
+        """Scheduling-state snapshot: what the serve frontend folds into
+        /stats and reports to the gateway via response headers (the
+        continuous-batching admission feedback).  The paged engine
+        extends this with KV pool occupancy."""
+        return {"queue_depth": len(self.queue),
+                "active_slots": self.num_active}
 
     def has_work(self) -> bool:
         # _finished counts: instantly-cancelled admissions must still be
@@ -599,6 +619,16 @@ class ServeEngine:
 
     def _finalize_admit(self, req: Request, slot: int, tok) -> None:
         self._phase_observe(req.request_id, terminal=False)
+        arrival = self._arrival.pop(req.request_id, None)
+        ttft = (time.time() - arrival) if arrival is not None else None
+        self._ttft[slot] = ttft
+        if self.metrics is not None and ttft is not None:
+            # The SLO autoscaler's primary signal (controlplane/slo.py):
+            # sub-second buckets, unlike the coarse reconcile-scale
+            # defaults the queue/prefill/decode phases use.
+            self.metrics.observe("tpu_serve_request_duration_seconds",
+                                 ttft, {"phase": "ttft"},
+                                 buckets=SERVE_LATENCY_BUCKETS)
         self.lens[slot] = len(req.prompt_tokens)
         self.active[slot] = req
         self.generated[slot] = [int(tok)]
@@ -774,7 +804,9 @@ class ServeEngine:
         self._phase_observe(req.request_id)
         self._finished.append(Response(
             req.request_id, list(self.generated[slot]), reason,
-            prompt_len=len(req.prompt_tokens), created=time.time()))
+            prompt_len=len(req.prompt_tokens), created=time.time(),
+            ttft_s=self._ttft[slot]))
         self.active[slot] = None
         self.generated[slot] = []
         self.lens[slot] = 0
+        self._ttft[slot] = None

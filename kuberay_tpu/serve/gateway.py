@@ -1,28 +1,61 @@
-"""Weighted serve gateway: the Gateway-API consumer for TrafficRoute.
+"""Serve gateway: prefix-cache-aware scheduling over TrafficRoute.
 
 Closes the incremental-upgrade loop (service_controller's
 ``_reconcile_weighted_services`` records backend weights in a
 ``TrafficRoute`` object — ref reconcileGateway/HTTPRoute stepping,
-rayservice_controller.go:920/:976): this process watches the route and
-forwards inference requests to the per-cluster serve backends with
-weighted random choice, so traffic genuinely shifts as the controller
-steps the weights.
+rayservice_controller.go:920/:976) and, since PR 7, routes like a
+scheduler instead of a dice roll:
+
+- **Prefix/session affinity** (SGLang-style cache-aware load
+  balancing): a per-backend :class:`~kuberay_tpu.serve.prefix.PrefixIndex`
+  shadows each replica's paged-KV prefix cache (same block hash chain,
+  serve/prefix.py).  Requests score every weight-eligible backend with
+  ``α·prefix-hit-depth − β·queue-depth`` and land on the max — so
+  prompts sharing a prefix hit the replica that already holds those KV
+  blocks, unless its queue has eaten the saving.
+- **ε-fallback**: with probability ``epsilon`` (and always when
+  affinity is disabled) the pick degrades to the original weighted
+  random choice, which keeps exploring cold replicas and keeps the
+  TrafficRoute weights meaningful in expectation.  Weight-0 backends
+  are NEVER picked regardless of affinity — the controller's upgrade
+  traffic shifts stay authoritative.
+- **Continuous-batching admission**: per-backend in-flight tracking plus
+  engine queue depth / KV occupancy read back from response headers
+  (``X-TPU-Queue-Depth`` etc., serve/server.py).  When every eligible
+  backend is at ``max_inflight``, requests wait in a bounded gateway
+  queue; past ``max_queue`` waiters or the queue deadline they are SHED
+  with 429 + ``Retry-After`` instead of piling onto backend queues —
+  burst storms degrade to bounded p99 + explicit sheds, not fleet-wide
+  timeouts.
+- **Retry-on-connect-failure**: one retry on the next-best backend
+  (failed backend excluded) when the connection itself fails; real HTTP
+  error responses are returned as-is.
 
 Backend resolution is pluggable: in a real cluster the Service name
-resolves via DNS; embedded/tests inject a name->URL map.
+resolves via DNS; embedded/tests inject a name->URL map.  ``rng`` and
+``clock`` are injectable so seeded runs (benchmark/serve_bench.py
+--traffic, sim-adjacent tests) replay exactly.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import json
 import random
 import threading
 import time
+import urllib.error
 import urllib.request
 from http.server import ThreadingHTTPServer
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+from kuberay_tpu.serve.prefix import (
+    PrefixIndex,
+    affinity_score,
+    block_hashes,
+    summarize_backend,
+)
 from kuberay_tpu.utils import constants as C
 from kuberay_tpu.utils.httpjson import JsonHandler, serve_background
 
@@ -30,48 +63,144 @@ from kuberay_tpu.utils.httpjson import JsonHandler, serve_background
 _LOG = logging.getLogger("kuberay_tpu.gateway")
 
 
+@dataclasses.dataclass
+class GatewayConfig:
+    """Routing + admission knobs (docs/serving.md has the full table)."""
+
+    affinity: bool = True          # False = legacy pure weighted random
+    alpha: float = 4.0             # score per prefix-hit block
+    beta: float = 1.0              # score penalty per queued/in-flight req
+    epsilon: float = 0.05          # weighted-random exploration fraction
+    block_size: int = 16           # MUST match the backends' paged block
+    index_capacity: int = 8192     # hashes per backend prefix index
+    max_inflight: int = 0          # per-backend admission cap (0 = off)
+    max_queue: int = 64            # gateway waiters before shedding
+    queue_timeout: float = 10.0    # max seconds a request waits for a slot
+    retry_after: float = 1.0       # Retry-After hint on 429s
+    retry_connect: bool = True     # one retry on next-best backend
+
+
+class _Overloaded(Exception):
+    def __init__(self, reason: str):
+        super().__init__(reason)
+        self.reason = reason
+
+
+class _BackendState:
+    __slots__ = ("service", "url", "weight", "inflight", "queue_depth",
+                 "kv_free_blocks", "kv_total_blocks", "index", "picks")
+
+    def __init__(self, service: str, url: str, index_capacity: int):
+        self.service = service
+        self.url = url
+        self.weight = 0
+        self.inflight = 0
+        self.queue_depth = 0          # last backend-reported engine queue
+        self.kv_free_blocks = 0
+        self.kv_total_blocks = 0
+        self.index = PrefixIndex(index_capacity)
+        self.picks = 0
+
+    @property
+    def load(self) -> float:
+        return self.inflight + self.queue_depth
+
+
 class WeightedGateway:
     def __init__(self, store, route_name: str, namespace: str = "default",
                  resolver: Optional[Callable[[str], str]] = None,
-                 poll_interval: float = 1.0, metrics=None):
+                 poll_interval: float = 1.0, metrics=None,
+                 config: Optional[GatewayConfig] = None,
+                 rng: Optional[random.Random] = None, clock=None):
         """``resolver(service_name) -> base_url``; defaults to cluster-DNS
         (http://<svc>.<ns>.svc:<serve-port>).  ``metrics`` is an optional
         MetricsRegistry: forwarded requests observe
-        ``tpu_serve_request_duration_seconds{phase="gateway"}`` (the
-        end-to-end leg in front of the engine's queue/prefill/decode
-        phases) and count ``tpu_gateway_requests_total`` per status code."""
+        ``tpu_serve_request_duration_seconds{phase="gateway"}`` and count
+        ``tpu_gateway_requests_total{backend,code}``, prefix-affine picks
+        count ``tpu_gateway_prefix_cache_hits_total{backend}``, and shed
+        requests count ``tpu_gateway_shed_total{reason}``.  ``rng`` and
+        ``clock`` (an object with ``.now()``) default to the module
+        ``random``/wall clock; inject both for seeded deterministic
+        runs."""
         self.metrics = metrics
         if metrics is not None:
             metrics.describe("tpu_gateway_requests_total",
-                             "Requests forwarded by the weighted gateway, "
-                             "by HTTP status code")
+                             "Requests forwarded by the serve gateway, "
+                             "by backend service and HTTP status code")
+            metrics.describe("tpu_gateway_prefix_cache_hits_total",
+                             "Requests routed to a backend already "
+                             "holding part of their prompt prefix, by "
+                             "backend service")
+            metrics.describe("tpu_gateway_shed_total",
+                             "Requests shed by gateway admission (429 + "
+                             "Retry-After), by reason (queue_full | "
+                             "deadline)")
         self.store = store
         self.route_name = route_name
         self.namespace = namespace
         self.resolver = resolver or (
             lambda svc: f"http://{svc}.{namespace}.svc:{C.PORT_SERVE}")
         self.poll_interval = poll_interval
+        self.config = config or GatewayConfig()
+        self._rng = rng if rng is not None else random.Random()
+        self._now = clock.now if clock is not None else time.time
         self._lock = threading.Lock()
-        self._backends: List[Tuple[str, int]] = []   # (url, weight)
-        self._stats: Dict[str, int] = {}
+        self._slot_free = threading.Condition(self._lock)
+        self._states: Dict[str, _BackendState] = {}   # service -> state
+        self._active: List[str] = []                  # routed service names
+        self._stats: Dict[str, int] = {}              # url -> picks
+        self._waiting = 0
         self._stop = threading.Event()
         self._refresh()
-        threading.Thread(target=self._watch_loop, daemon=True,
-                         name="gateway-route-watch").start()
+        self._watch_thread = threading.Thread(
+            target=self._watch_loop, daemon=True, name="gateway-route-watch")
+        self._watch_thread.start()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def stop(self):
+        """Stop the route watcher and join its thread (a gateway left
+        unclosed used to leak one daemon thread per test)."""
+        self._stop.set()
+        if self._watch_thread.is_alive() and \
+                self._watch_thread is not threading.current_thread():
+            self._watch_thread.join(timeout=5.0)
+
+    # Back-compat alias (pre-PR-7 callers).
+    def close(self):
+        self.stop()
+
+    def __enter__(self) -> "WeightedGateway":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
 
     # -- route sync --------------------------------------------------------
 
     def _refresh(self):
         route = self.store.try_get("TrafficRoute", self.route_name,
                                    self.namespace)
-        backends = []
+        entries: List[Tuple[str, int]] = []
         if route is not None:
             for b in route.get("spec", {}).get("backends", []):
                 if b.get("weight", 0) > 0:
-                    backends.append((self.resolver(b["service"]),
-                                     int(b["weight"])))
+                    entries.append((b["service"], int(b["weight"])))
         with self._lock:
-            self._backends = backends
+            # Keep prior state (prefix index, load) across weight steps:
+            # an upgrade shifting 10% -> 50% must not cold-start the new
+            # cluster's affinity map at every step.
+            for svc, w in entries:
+                st = self._states.get(svc)
+                if st is None:
+                    st = self._states[svc] = _BackendState(
+                        svc, self.resolver(svc), self.config.index_capacity)
+                st.weight = w
+            active = {svc for svc, _ in entries}
+            for svc, st in self._states.items():
+                if svc not in active:
+                    st.weight = 0
+            self._active = [svc for svc, _ in entries]
 
     def _watch_loop(self):
         while not self._stop.is_set():
@@ -84,58 +213,242 @@ class WeightedGateway:
                            exc_info=True)
             self._stop.wait(self.poll_interval)
 
-    def close(self):
-        self._stop.set()
-
     # -- routing -----------------------------------------------------------
 
-    def pick_backend(self) -> Optional[str]:
-        with self._lock:
-            backends = list(self._backends)
-        if not backends:
-            return None
-        total = sum(w for _, w in backends)
-        r = random.uniform(0, total)
+    def _eligible_locked(self, exclude: Sequence[str]) -> List[_BackendState]:
+        return [self._states[svc] for svc in self._active
+                if self._states[svc].weight > 0
+                and self._states[svc].url not in exclude]
+
+    def _weighted_random_locked(self,
+                                cands: List[_BackendState]) -> _BackendState:
+        total = sum(s.weight for s in cands)
+        r = self._rng.uniform(0, total)
         acc = 0.0
-        for url, w in backends:
-            acc += w
+        for s in cands:
+            acc += s.weight
             if r <= acc:
-                with self._lock:
-                    self._stats[url] = self._stats.get(url, 0) + 1
-                return url
-        return backends[-1][0]
+                return s
+        return cands[-1]
+
+    def _select_locked(self, cands: List[_BackendState],
+                       hashes: Sequence[int]) -> Tuple[_BackendState, int]:
+        """Pick one backend among the weight-eligible candidates.
+        Returns (state, prefix_hit_depth_of_pick)."""
+        cfg = self.config
+        if not cfg.affinity or self._rng.random() < cfg.epsilon:
+            s = self._weighted_random_locked(cands)
+            return s, 0
+        scored = [(affinity_score(s.index.hit_depth(hashes) if hashes else 0,
+                                  s.load, cfg.alpha, cfg.beta), s)
+                  for s in cands]
+        # Recompute each pick's depth only for the winner set (hit_depth
+        # above already touched the LRU; cheap to re-probe).
+        best = max(score for score, _ in scored)
+        top = [s for score, s in scored if score == best]
+        s = top[0] if len(top) == 1 else self._weighted_random_locked(top)
+        depth = s.index.hit_depth(hashes) if hashes else 0
+        return s, depth
+
+    def pick_backend(self, prompt_tokens: Optional[Sequence[int]] = None,
+                     exclude: Sequence[str] = ()) -> Optional[str]:
+        """Route one request (no admission wait): the scored pick when
+        affinity is on, weighted random on the ε-roll / when off.
+        ``exclude`` holds backend URLs already tried (retry path)."""
+        hashes = block_hashes(prompt_tokens, self.config.block_size) \
+            if prompt_tokens else []
+        with self._lock:
+            cands = self._eligible_locked(exclude)
+            if not cands:
+                return None
+            s, _ = self._select_locked(cands, hashes)
+            self._note_pick_locked(s)
+            return s.url
+
+    def _note_pick_locked(self, s: _BackendState) -> None:
+        s.picks += 1
+        self._stats[s.url] = self._stats.get(s.url, 0) + 1
+
+    def _shed(self, reason: str) -> None:
+        if self.metrics is not None:
+            self.metrics.inc("tpu_gateway_shed_total", {"reason": reason})
+        raise _Overloaded(reason)
+
+    def _acquire(self, hashes: Sequence[int], timeout: float,
+                 exclude: Sequence[str]) -> Optional[_BackendState]:
+        """Admission + routing: pick a backend with a free in-flight slot,
+        waiting (bounded queue, bounded time) when all are saturated.
+        Returns None when the route has no eligible backend (503), raises
+        :class:`_Overloaded` on shed (429)."""
+        cfg = self.config
+        deadline = time.monotonic() + min(timeout, cfg.queue_timeout)
+        with self._slot_free:
+            while True:
+                cands = self._eligible_locked(exclude)
+                if not cands:
+                    return None
+                free = [s for s in cands
+                        if cfg.max_inflight <= 0
+                        or s.inflight < cfg.max_inflight]
+                if free:
+                    s, depth = self._select_locked(free, hashes)
+                    s.inflight += 1
+                    self._note_pick_locked(s)
+                    if depth > 0 and self.metrics is not None:
+                        self.metrics.inc(
+                            "tpu_gateway_prefix_cache_hits_total",
+                            {"backend": s.service})
+                    return s
+                # All eligible backends saturated: queue or shed.
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    self._shed("deadline")
+                if self._waiting >= cfg.max_queue:
+                    self._shed("queue_full")
+                self._waiting += 1
+                try:
+                    self._slot_free.wait(min(remaining, 0.05))
+                finally:
+                    self._waiting -= 1
+
+    def _release(self, s: _BackendState) -> None:
+        with self._slot_free:
+            s.inflight -= 1
+            self._slot_free.notify()
+
+    # -- forwarding --------------------------------------------------------
+
+    @staticmethod
+    def _prompt_tokens(body: bytes) -> List[int]:
+        """Best-effort prompt extraction for the affinity hash; anything
+        unparseable routes like a promptless request."""
+        try:
+            doc = json.loads(body or b"{}")
+            toks = doc.get("prompt_tokens")
+            if isinstance(toks, list) and \
+                    all(isinstance(t, int) for t in toks):
+                return toks
+        except Exception:
+            pass
+        return []
 
     def forward(self, path: str, body: bytes,
                 timeout: float = 300.0) -> Tuple[int, bytes]:
-        t0 = time.time()
-        code, payload = self._forward(path, body, timeout)
-        if self.metrics is not None:
-            self.metrics.observe("tpu_serve_request_duration_seconds",
-                                 time.time() - t0, {"phase": "gateway"})
-            self.metrics.inc("tpu_gateway_requests_total",
-                             {"code": str(code)})
+        code, payload, _ = self.forward_ex(path, body, timeout)
         return code, payload
 
-    def _forward(self, path: str, body: bytes,
-                 timeout: float) -> Tuple[int, bytes]:
-        url = self.pick_backend()
-        if url is None:
-            return 503, json.dumps(
-                {"message": "no healthy backends in route"}).encode()
+    def forward_ex(self, path: str, body: bytes, timeout: float = 300.0
+                   ) -> Tuple[int, bytes, Dict[str, str]]:
+        """forward() plus response headers the HTTP surface relays
+        (Retry-After on sheds)."""
+        t0 = self._now()
+        backend = "none"
+        try:
+            code, payload, backend, headers = self._forward(
+                path, body, timeout)
+        except _Overloaded as e:
+            code = 429
+            payload = json.dumps(
+                {"message": f"gateway overloaded ({e.reason}); retry "
+                            f"after {self.config.retry_after:g}s"}).encode()
+            headers = {"Retry-After": f"{self.config.retry_after:g}"}
+        if self.metrics is not None:
+            self.metrics.observe("tpu_serve_request_duration_seconds",
+                                 self._now() - t0, {"phase": "gateway"})
+            self.metrics.inc("tpu_gateway_requests_total",
+                             {"backend": backend, "code": str(code)})
+        return code, payload, headers
+
+    def _forward(self, path: str, body: bytes, timeout: float
+                 ) -> Tuple[int, bytes, str, Dict[str, str]]:
+        prompt = self._prompt_tokens(body)
+        hashes = block_hashes(prompt, self.config.block_size) \
+            if prompt else []
+        tried: List[str] = []
+        attempts = 2 if self.config.retry_connect else 1
+        last_err: Optional[Exception] = None
+        for _ in range(attempts):
+            s = self._acquire(hashes, timeout, exclude=tried)
+            if s is None:
+                if tried:
+                    break                  # every live backend was tried
+                return 503, json.dumps(
+                    {"message": "no healthy backends in route"}).encode(), \
+                    "none", {}
+            try:
+                code, payload, resp_headers = self._request(
+                    s.url, path, body, timeout)
+            except (urllib.error.URLError, ConnectionError, OSError) as e:
+                # Connect/transport failure: this replica may be mid-
+                # replacement — retry ONCE on the next-best backend.
+                last_err = e
+                tried.append(s.url)
+                continue
+            finally:
+                self._release(s)
+            self._observe_backend(s, resp_headers)
+            if hashes and code < 500:
+                with self._lock:
+                    s.index.insert(hashes)
+            return code, payload, s.service, {}
+        return 502, json.dumps(
+            {"message": f"backend error: {last_err}"}).encode(), \
+            (self._service_of(tried[-1]) if tried else "none"), {}
+
+    def _service_of(self, url: str) -> str:
+        with self._lock:
+            for st in self._states.values():
+                if st.url == url:
+                    return st.service
+        return "none"
+
+    def _request(self, base_url: str, path: str, body: bytes,
+                 timeout: float) -> Tuple[int, bytes, Dict[str, str]]:
         req = urllib.request.Request(
-            url + path, data=body,
+            base_url + path, data=body,
             headers={"Content-Type": "application/json"})
         try:
             with urllib.request.urlopen(req, timeout=timeout) as resp:
-                return resp.status, resp.read()
+                return resp.status, resp.read(), dict(resp.headers)
         except urllib.error.HTTPError as e:
-            return e.code, e.read()
-        except Exception as e:
-            return 502, json.dumps({"message": f"backend error: {e}"}).encode()
+            return e.code, e.read(), dict(e.headers or {})
+
+    def _observe_backend(self, s: _BackendState,
+                         headers: Dict[str, str]) -> None:
+        """Continuous-batching feedback: fold the engine's self-reported
+        queue depth / KV occupancy (serve/server.py headers) into the
+        routing state."""
+        def _int(name: str, default: int) -> int:
+            try:
+                return int(headers.get(name, default))
+            except (TypeError, ValueError):
+                return default
+        with self._lock:
+            s.queue_depth = _int("X-TPU-Queue-Depth", s.queue_depth)
+            s.kv_free_blocks = _int("X-TPU-KV-Free-Blocks", s.kv_free_blocks)
+            s.kv_total_blocks = _int("X-TPU-KV-Total-Blocks",
+                                     s.kv_total_blocks)
+
+    # -- introspection -----------------------------------------------------
 
     def stats(self) -> Dict[str, int]:
         with self._lock:
             return dict(self._stats)
+
+    def backend_stats(self) -> List[dict]:
+        """Per-backend routing state (served at GET /backends)."""
+        with self._lock:
+            return [summarize_backend(
+                s.service, s.url, s.weight, s.inflight, s.queue_depth,
+                s.kv_free_blocks, s.kv_total_blocks, len(s.index), s.picks)
+                for s in self._states.values()]
+
+    def total_queue_depth(self) -> int:
+        """Fleet load signal (in-flight + backend-reported queues) — the
+        queue-depth input of the SLO autoscaler (controlplane/slo.py)."""
+        with self._lock:
+            return sum(s.inflight + s.queue_depth
+                       for s in self._states.values())
 
     # -- HTTP --------------------------------------------------------------
 
@@ -148,6 +461,8 @@ class WeightedGateway:
                     return self._send(200, {"status": "ok"})
                 if self.path == "/stats":
                     return self._send(200, gw.stats())
+                if self.path == "/backends":
+                    return self._send(200, {"backends": gw.backend_stats()})
                 if self.path == "/metrics" and gw.metrics is not None:
                     return self._send_text(200, gw.metrics.render(),
                                            "text/plain; version=0.0.4")
@@ -156,9 +471,11 @@ class WeightedGateway:
             def do_POST(self):
                 n = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(n) if n else b"{}"
-                code, payload = gw.forward(self.path, body)
+                code, payload, headers = gw.forward_ex(self.path, body)
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
+                for k, v in headers.items():
+                    self.send_header(k, v)
                 self.send_header("Content-Length", str(len(payload)))
                 self.end_headers()
                 self.wfile.write(payload)

@@ -388,6 +388,7 @@ class PagedServeEngine(ServeEngine):
     def stats(self) -> Dict[str, Any]:
         a = self.allocator
         return {
+            **ServeEngine.stats.fget(self),
             "num_blocks": a.num_blocks,
             "free_blocks": a.num_free,
             "prefix_hit_tokens": a.prefix_hits,

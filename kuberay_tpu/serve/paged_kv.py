@@ -33,6 +33,9 @@ from typing import Dict, List, Optional, Sequence
 import jax
 import jax.numpy as jnp
 
+from kuberay_tpu.serve.prefix import block_hashes as _prefix_block_hashes
+from kuberay_tpu.serve.prefix import chain_hash as _chain_hash
+
 
 # ---------------------------------------------------------------------------
 # Host-side block allocator + prefix cache
@@ -72,16 +75,14 @@ class BlockAllocator:
     # -- hashing ----------------------------------------------------------
 
     def _chain(self, parent: int, block_tokens: Sequence[int]) -> int:
-        return hash((parent, tuple(block_tokens)))
+        return _chain_hash(parent, block_tokens)
 
     def block_hashes(self, tokens: Sequence[int]) -> List[int]:
-        """Hash chain over the FULL blocks of a token sequence."""
-        out, parent = [], 0
-        bs = self.block_size
-        for i in range(0, len(tokens) - len(tokens) % bs, bs):
-            parent = self._chain(parent, tokens[i:i + bs])
-            out.append(parent)
-        return out
+        """Hash chain over the FULL blocks of a token sequence — the
+        SAME chain the gateway's per-backend PrefixIndex keys on
+        (serve/prefix.py), so gateway affinity predictions and replica
+        cache hits agree."""
+        return _prefix_block_hashes(tokens, self.block_size)
 
     # -- allocation -------------------------------------------------------
 

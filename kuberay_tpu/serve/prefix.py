@@ -1,0 +1,123 @@
+"""Prefix-affinity primitives shared by the paged KV cache and the
+serve gateway.
+
+The gateway's routing problem is the replica-level mirror of the
+BlockAllocator's block-level one: a request whose prompt shares a
+block-aligned prefix with earlier traffic should land where those KV
+blocks already live.  Both sides therefore hash prompts the SAME way —
+a chained hash over full ``block_size`` token blocks
+(:func:`block_hashes`, the vLLM/SGLang prefix-cache key) — so the
+gateway's per-backend index is a faithful shadow of what each replica's
+:class:`~kuberay_tpu.serve.paged_kv.BlockAllocator` can actually serve
+from cache.
+
+This module is deliberately jax-free: the gateway imports it without
+pulling the device stack.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Dict, List, Sequence
+
+
+def chain_hash(parent: int, block_tokens: Sequence[int]) -> int:
+    """One link of the prefix hash chain.  Python's tuple-of-int hash is
+    deterministic (PYTHONHASHSEED only salts str/bytes), so two processes
+    hashing the same prompt agree."""
+    return hash((parent, tuple(block_tokens)))
+
+
+def block_hashes(tokens: Sequence[int], block_size: int) -> List[int]:
+    """Hash chain over the FULL blocks of a token sequence (the partial
+    tail block is never cacheable and never hashed)."""
+    out: List[int] = []
+    parent = 0
+    for i in range(0, len(tokens) - len(tokens) % block_size, block_size):
+        parent = chain_hash(parent, tokens[i:i + block_size])
+        out.append(parent)
+    return out
+
+
+class PrefixIndex:
+    """Bounded LRU set of block hashes one backend plausibly holds.
+
+    The gateway inserts a request's prompt hashes after the backend
+    serves it (that replica's allocator has now prefilled + registered
+    those blocks) and probes with :meth:`hit_depth` when routing.  The
+    LRU bound mirrors the replica-side reality that refcount-0 cached
+    blocks are cannibalized least-recently-used first — an index entry
+    older than ``capacity`` insertions is exactly the block the
+    allocator would have evicted.
+    """
+
+    def __init__(self, capacity: int = 8192):
+        self.capacity = capacity
+        self._hashes: "collections.OrderedDict[int, None]" = \
+            collections.OrderedDict()
+
+    def __len__(self) -> int:
+        return len(self._hashes)
+
+    def insert(self, hashes: Sequence[int]) -> None:
+        for h in hashes:
+            if h in self._hashes:
+                self._hashes.move_to_end(h)
+            else:
+                self._hashes[h] = None
+        while len(self._hashes) > self.capacity:
+            self._hashes.popitem(last=False)
+
+    def hit_depth(self, hashes: Sequence[int]) -> int:
+        """Longest PREFIX of ``hashes`` present, in blocks.  Prefix, not
+        membership: a replica serves ``tokens[:k*bs]`` from cache only
+        when every block before ``k`` is cached too (match_prefix walks
+        the chain and stops at the first miss).  Probing touches the LRU
+        order — a hot prefix being routed to stays resident."""
+        depth = 0
+        for h in hashes:
+            if h not in self._hashes:
+                break
+            self._hashes.move_to_end(h)
+            depth += 1
+        return depth
+
+
+def affinity_score(hit_depth: int, queue_depth: float,
+                   alpha: float, beta: float) -> float:
+    """The routing score: ``α·prefix-hit-depth − β·queue-depth``.
+
+    α prices a cached block (prefill compute saved); β prices a queued/
+    in-flight request ahead of this one (HOL latency).  With α/β ≈ the
+    ratio of per-block prefill cost to per-request service time, a deep
+    prefix hit wins until the affine replica's queue eats the saving —
+    which is exactly when spilling to a cold replica is correct
+    (SGLang's cache-aware load balancing tradeoff).
+    """
+    return alpha * hit_depth - beta * queue_depth
+
+
+class BackendSnapshot(dict):
+    """Plain-dict view of one backend's routing state (``/backends``)."""
+
+
+def summarize_backend(service: str, url: str, weight: int, inflight: int,
+                      queue_depth: int, kv_free_blocks: int,
+                      kv_total_blocks: int, index_size: int,
+                      picks: int) -> BackendSnapshot:
+    occ = 0.0
+    if kv_total_blocks > 0:
+        occ = round(1.0 - kv_free_blocks / kv_total_blocks, 4)
+    return BackendSnapshot(
+        service=service, url=url, weight=weight, inflight=inflight,
+        queue_depth=queue_depth, kv_occupancy=occ,
+        prefix_index_size=index_size, picks=picks)
+
+
+def aggregate_queue_depth(states: Dict[str, "object"]) -> int:
+    """Fleet-wide load signal for the SLO autoscaler: requests in flight
+    through the gateway plus backend-reported engine queue depths."""
+    total = 0
+    for s in states.values():
+        total += getattr(s, "inflight", 0) + getattr(s, "queue_depth", 0)
+    return total

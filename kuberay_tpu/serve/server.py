@@ -281,6 +281,19 @@ class ServeFrontend:
         frontend = self
 
         class Handler(JsonHandler):
+            def _load_headers(self):
+                """Continuous-batching feedback for the gateway: engine
+                queue depth + KV-block occupancy ride every completion
+                response (WeightedGateway folds them into its routing
+                score and admission decisions)."""
+                st = frontend.engine.stats
+                h = {"X-TPU-Queue-Depth": str(st.get("queue_depth", 0)),
+                     "X-TPU-Active-Slots": str(st.get("active_slots", 0))}
+                if "num_blocks" in st:
+                    h["X-TPU-KV-Free-Blocks"] = str(st["free_blocks"])
+                    h["X-TPU-KV-Total-Blocks"] = str(st["num_blocks"])
+                return h
+
             def do_GET(self):
                 if self.path == "/healthz":
                     # 503 on degradation: the pod's readiness/liveness
@@ -372,13 +385,17 @@ class ServeFrontend:
                     eos_token=body.get("eos_token"), timeout=timeout,
                     top_p=top_p, top_k=top_k, stop_token_ids=stop_ids)
                 if resp is None:
-                    return self._send(503, {"message": "overloaded or timed out"})
+                    return self._send(503,
+                                      {"message": "overloaded or timed out"},
+                                      headers=self._load_headers())
                 return self._send(200, {
                     "id": resp.request_id,
                     "tokens": resp.tokens,
                     "finish_reason": resp.finish_reason,
                     "prompt_len": resp.prompt_len,
-                })
+                    "ttft_ms": (round(resp.ttft_s * 1e3, 3)
+                                if resp.ttft_s is not None else None),
+                }, headers=self._load_headers())
 
             def _stream_completion(self, prompt, max_tokens, temperature,
                                    eos_token, timeout, top_p=1.0, top_k=0,

@@ -15,10 +15,13 @@ class JsonHandler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):   # quiet by default
         pass
 
-    def _send(self, code: int, body: Any = None):
+    def _send(self, code: int, body: Any = None,
+              headers: Dict[str, str] = None):
         data = (json.dumps(body).encode() if body is not None else b"")
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.send_header("Content-Length", str(len(data)))
         self.end_headers()
         self.wfile.write(data)

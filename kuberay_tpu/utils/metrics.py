@@ -23,6 +23,11 @@ _BUCKETS = (0.5, 1, 2, 5, 10, 30, 60, 120, 300, 600, 1800, float("inf"))
 _FAST_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
                  1, 2.5, 5, 10, float("inf"))
 
+# Serving TTFT lives between the two: ms-scale when healthy, seconds
+# when overloaded — SLO evaluation needs resolution across both regimes.
+SERVE_LATENCY_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                         1, 2.5, 5, 10, 30, 60, float("inf"))
+
 
 class Histogram:
     def __init__(self, buckets=_BUCKETS):
@@ -77,6 +82,20 @@ class MetricsRegistry:
             if key not in self._hists:
                 self._hists[key] = Histogram(buckets or _BUCKETS)
             self._hists[key].observe(value)
+
+    def histogram_snapshot(self, name: str,
+                           labels: Optional[Dict[str, str]] = None
+                           ) -> Optional[Dict[str, object]]:
+        """Point-in-time copy of one histogram series (buckets, per-bucket
+        counts, count, sum) — the read seam the SLO autoscaler's windowed
+        percentile math consumes (controlplane/slo.py delta-p99s two
+        snapshots)."""
+        with self._lock:
+            h = self._hists.get((name, self._labels_key(labels)))
+            if h is None:
+                return None
+            return {"buckets": list(h.buckets), "counts": list(h.counts),
+                    "n": h.n, "sum": h.total}
 
     def drop_labeled(self, label_key: str, label_value: str):
         """Remove every series carrying label=value (CR deletion cleanup)."""

@@ -121,6 +121,67 @@ def test_goodput_and_autoscaler_catalog_renders():
         assert f"# HELP {family} " in text
 
 
+def test_gateway_counter_families_render_golden():
+    """Golden exposition for the PR-7 gateway families: requests_total
+    grew a ``backend`` label, plus the prefix-cache-hit and shed counter
+    families — HELP/TYPE once each, labels sorted, values cumulative."""
+    from kuberay_tpu.controlplane.store import ObjectStore
+    from kuberay_tpu.serve.gateway import WeightedGateway
+
+    r = MetricsRegistry()
+    # The gateway's constructor owns the describes (HELP text is product
+    # code, not test fixture); an empty route keeps it inert.
+    gw = WeightedGateway(ObjectStore(), "no-route", metrics=r,
+                         poll_interval=30.0)
+    try:
+        code, _ = gw.forward("/v1/completions", b"{}")
+        assert code == 503
+    finally:
+        gw.stop()
+    r.inc("tpu_gateway_requests_total", {"backend": "svc-a", "code": "200"},
+          value=4)
+    r.inc("tpu_gateway_prefix_cache_hits_total", {"backend": "svc-a"},
+          value=3)
+    r.inc("tpu_gateway_shed_total", {"reason": "queue_full"})
+    r.inc("tpu_gateway_shed_total", {"reason": "deadline"}, value=2)
+    text = r.render()
+    assert ("# HELP tpu_gateway_requests_total Requests forwarded by the "
+            "serve gateway, by backend service and HTTP status code") in text
+    assert 'tpu_gateway_requests_total{backend="none",code="503"} 1.0' in text
+    assert ('tpu_gateway_requests_total{backend="svc-a",code="200"} 4.0'
+            in text)
+    assert "# TYPE tpu_gateway_prefix_cache_hits_total counter" in text
+    assert ('tpu_gateway_prefix_cache_hits_total{backend="svc-a"} 3.0'
+            in text)
+    assert "# TYPE tpu_gateway_shed_total counter" in text
+    assert 'tpu_gateway_shed_total{reason="deadline"} 2.0' in text
+    assert 'tpu_gateway_shed_total{reason="queue_full"} 1.0' in text
+    for family in ("tpu_gateway_requests_total",
+                   "tpu_gateway_prefix_cache_hits_total",
+                   "tpu_gateway_shed_total"):
+        assert text.count(f"# TYPE {family} ") == 1
+        assert f"# HELP {family} " in text
+
+
+def test_histogram_snapshot_reads_one_series():
+    from kuberay_tpu.utils.metrics import SERVE_LATENCY_BUCKETS
+
+    r = MetricsRegistry()
+    assert r.histogram_snapshot("tpu_test_seconds") is None
+    r.observe("tpu_test_seconds", 0.03, {"phase": "ttft"},
+              buckets=SERVE_LATENCY_BUCKETS)
+    r.observe("tpu_test_seconds", 0.03, {"phase": "ttft"},
+              buckets=SERVE_LATENCY_BUCKETS)
+    snap = r.histogram_snapshot("tpu_test_seconds", {"phase": "ttft"})
+    assert snap["n"] == 2 and abs(snap["sum"] - 0.06) < 1e-9
+    assert snap["buckets"] == list(SERVE_LATENCY_BUCKETS)
+    assert sum(snap["counts"]) == 2
+    # Snapshot is a copy: mutating it never corrupts the live histogram.
+    snap["counts"][0] = 999
+    assert sum(r.histogram_snapshot("tpu_test_seconds",
+                                    {"phase": "ttft"})["counts"]) == 2
+
+
 def test_controlplane_metrics_catalog_renders():
     m = ControlPlaneMetrics()
     m.observe_slice_ready("demo", "workers", 12.5)

@@ -353,7 +353,8 @@ def test_gateway_observes_forward_phase():
         text = reg.render()
         assert ('tpu_serve_request_duration_seconds_count'
                 '{phase="gateway"} 1') in text
-        assert 'tpu_gateway_requests_total{code="503"} 1.0' in text
+        assert ('tpu_gateway_requests_total{backend="none",code="503"} 1.0'
+                in text)
     finally:
         gw.close()
 

@@ -42,6 +42,29 @@ def test_completion_roundtrip(frontend):
     assert len(out["tokens"]) == 4
     assert out["finish_reason"] == "length"
     assert all(isinstance(t, int) for t in out["tokens"])
+    # Exact enqueue->first-token latency rides every completion (the
+    # gateway traffic bench's TTFT source).
+    assert isinstance(out["ttft_ms"], float) and out["ttft_ms"] > 0
+
+
+def test_completion_reports_load_headers(frontend):
+    """Continuous-batching feedback: engine queue depth rides completion
+    responses so the gateway can fold backend load into its routing
+    score without a second round trip."""
+    fe, url = frontend
+    req = urllib.request.Request(
+        f"{url}/v1/completions",
+        data=json.dumps({"prompt_tokens": [1, 2], "max_tokens": 2}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        assert resp.status == 200
+        assert resp.headers["X-TPU-Queue-Depth"].isdigit()
+        assert resp.headers["X-TPU-Active-Slots"].isdigit()
+        json.load(resp)
+    # Dense engines report scheduling state only; paged engines add the
+    # KV pool occupancy (covered in test_serve_config_from_coordinator_e2e).
+    st = fe.engine.stats
+    assert st["queue_depth"] == 0 and st["active_slots"] == 0
 
 
 def test_concurrent_requests_batched(frontend):

@@ -1,0 +1,250 @@
+"""SLO-driven slice autoscaling: serve TTFT/queue-depth histograms ->
+SliceAutoscaler demand floors, under the sim VirtualClock — scale-up on
+a sustained breach, hysteresis hold, idle release back down, every
+verdict in the /debug/autoscaler audit ring."""
+
+import json
+import urllib.request
+
+from kuberay_tpu.api.tpucluster import AutoscalerOptions
+from kuberay_tpu.controlplane.autoscaler import DecisionAudit, SliceAutoscaler
+from kuberay_tpu.controlplane.slo import (
+    ServeSloSignal,
+    SloPolicy,
+    TTFT_METRIC,
+    histogram_delta_p99,
+)
+from kuberay_tpu.sim.clock import VirtualClock
+from kuberay_tpu.utils import constants as C
+from kuberay_tpu.utils.metrics import SERVE_LATENCY_BUCKETS, MetricsRegistry
+from tests.test_api_types import make_cluster
+from tests.test_cluster_controller import Harness
+
+
+# ---------------------------------------------------------------------------
+# windowed p99 math
+# ---------------------------------------------------------------------------
+
+def _snap(reg):
+    return reg.histogram_snapshot(TTFT_METRIC, {"phase": "ttft"})
+
+
+def _observe(reg, values):
+    for v in values:
+        reg.observe(TTFT_METRIC, v, {"phase": "ttft"},
+                    buckets=SERVE_LATENCY_BUCKETS)
+
+
+def test_histogram_delta_p99_windows_between_snapshots():
+    reg = MetricsRegistry()
+    _observe(reg, [0.01] * 100)
+    first = _snap(reg)
+    p99, n = histogram_delta_p99(None, first)
+    assert n == 100 and p99 <= 0.01
+    # Second window is slow — the delta must see ONLY the new samples.
+    _observe(reg, [2.0] * 50)
+    second = _snap(reg)
+    p99, n = histogram_delta_p99(first, second)
+    assert n == 50
+    assert 1.0 < p99 <= 2.5
+    # Empty window: no new observations, no phantom breach.
+    p99, n = histogram_delta_p99(second, _snap(reg))
+    assert (p99, n) == (0.0, 0)
+
+
+def test_histogram_delta_p99_handles_missing_series():
+    assert histogram_delta_p99(None, None) == (0.0, 0)
+
+
+# ---------------------------------------------------------------------------
+# signal state machine (pure, virtual-clocked)
+# ---------------------------------------------------------------------------
+
+def make_signal(reg, clock, **policy):
+    pol = dict(group="workers", ttft_p99_target_s=0.5, queue_depth_high=16,
+               min_samples=3, breach_seconds=15.0, clear_seconds=60.0,
+               cooldown_seconds=30.0)
+    pol.update(policy)
+    return ServeSloSignal(reg, SloPolicy(**pol), clock=clock)
+
+
+def test_breach_must_sustain_before_scale_up():
+    clock = VirtualClock()
+    reg = MetricsRegistry()
+    slo = make_signal(reg, clock)
+    _observe(reg, [2.0] * 10)
+    floor, info = slo.demand_floor(1)
+    assert info["state"] == "breaching" and floor == 1   # not sustained yet
+    clock.advance(16.0)
+    _observe(reg, [2.0] * 10)
+    floor, info = slo.demand_floor(1)
+    assert info["state"] == "scale_up" and floor == 2
+    assert info["ttft_p99_s"] > 0.5
+    # Cooldown: continued breach does NOT immediately re-fire.
+    clock.advance(5.0)
+    _observe(reg, [2.0] * 10)
+    floor, info = slo.demand_floor(2)
+    assert info["state"] == "breaching" and floor == 2
+    # ... but does after the cooldown elapses.
+    clock.advance(30.0)
+    _observe(reg, [2.0] * 10)
+    floor, info = slo.demand_floor(2)
+    assert info["state"] == "scale_up" and floor == 3
+
+
+def test_clear_holds_then_releases():
+    clock = VirtualClock()
+    reg = MetricsRegistry()
+    slo = make_signal(reg, clock)
+    _observe(reg, [0.01] * 10)
+    floor, info = slo.demand_floor(3)
+    assert info["state"] == "holding" and floor == 3     # hysteresis hold
+    clock.advance(61.0)
+    floor, info = slo.demand_floor(3)
+    assert info["state"] == "clear" and floor == 0       # released
+    # A fresh breach restarts the whole ladder.
+    _observe(reg, [2.0] * 10)
+    floor, info = slo.demand_floor(3)
+    assert info["state"] == "breaching" and floor == 3
+
+
+def test_queue_depth_alone_breaches():
+    clock = VirtualClock()
+    reg = MetricsRegistry()
+    depth = [40]
+    slo = ServeSloSignal(
+        reg, SloPolicy(group="workers", queue_depth_high=16,
+                       breach_seconds=10.0, cooldown_seconds=0.0),
+        queue_depth_fn=lambda: depth[0], clock=clock)
+    floor, info = slo.demand_floor(1)
+    assert info["state"] == "breaching" and info["queue_depth"] == 40
+    clock.advance(11.0)
+    floor, info = slo.demand_floor(1)
+    assert info["state"] == "scale_up" and floor == 2
+
+
+def test_flapping_latency_never_oscillates_replicas():
+    """Alternating breach/clear windows shorter than the hysteresis
+    thresholds must keep the floor pinned at current — no up, no
+    release."""
+    clock = VirtualClock()
+    reg = MetricsRegistry()
+    slo = make_signal(reg, clock)
+    for i in range(12):
+        _observe(reg, [2.0 if i % 2 == 0 else 0.01] * 5)
+        floor, info = slo.demand_floor(2)
+        assert info["state"] in ("breaching", "holding")
+        assert floor == 2
+        clock.advance(5.0)
+
+
+# ---------------------------------------------------------------------------
+# end to end: SliceAutoscaler + cluster controller under virtual time
+# ---------------------------------------------------------------------------
+
+def make_serve_cluster(replicas=1, min_r=1, max_r=4, idle_timeout=60):
+    c = make_cluster(accelerator="v5p", topology="2x2x2", replicas=replicas)
+    c.spec.enableInTreeAutoscaling = True
+    c.spec.autoscalerOptions = AutoscalerOptions(
+        idleTimeoutSeconds=idle_timeout)
+    g = c.spec.workerGroupSpecs[0]
+    g.minReplicas, g.maxReplicas = min_r, max_r
+    return c
+
+
+def test_slo_scale_up_and_back_down_sim_clocked():
+    clock = VirtualClock()
+    reg = MetricsRegistry()
+    slo = make_signal(reg, clock)
+    h = Harness()
+    h.store.create(make_serve_cluster().to_dict())
+    h.settle()
+    audit = DecisionAudit(clock=clock)
+    auto = SliceAutoscaler(h.store, audit=audit, slo=slo, clock=clock)
+
+    # Sustained TTFT breach -> one-slice scale-up.
+    _observe(reg, [2.0] * 10)
+    assert not auto.reconcile("demo")            # breaching, not sustained
+    clock.advance(16.0)
+    _observe(reg, [2.0] * 10)
+    assert auto.reconcile("demo")
+    h.settle()
+    assert h.cluster().spec.workerGroupSpecs[0].replicas == 2
+    up = audit.to_list()[0]
+    assert up["direction"] == "up" and up["applied"] is True
+    assert up["signals"]["slo"]["state"] == "scale_up"
+    assert up["signals"]["slo"]["ttft_p99_s"] > 0.5
+    assert up["signals"]["demand"] == 2
+
+    # Latency recovers: hysteresis HOLDS the extra slice (demand floor ==
+    # current keeps the group claimed; idle reaper can't touch it).
+    _observe(reg, [0.01] * 10)
+    clock.advance(10.0)
+    assert not auto.reconcile("demo")
+    assert h.cluster().spec.workerGroupSpecs[0].replicas == 2
+
+    # Sustained clear releases the floor; the slices then age into the
+    # idle timeout and the existing downscale path reaps back to min.
+    clock.advance(61.0)
+    assert not auto.reconcile("demo")            # released; idle clocks start
+    clock.advance(61.0)
+    assert auto.reconcile("demo")                # idle >= 60s -> downscale
+    h.settle()
+    assert h.cluster().spec.workerGroupSpecs[0].replicas == 1
+    down = audit.to_list()[0]
+    assert down["direction"] == "down"
+    assert down["slices_to_delete"]
+    assert down["signals"]["slo"]["state"] == "clear"
+
+
+def test_slo_demand_merges_with_job_demand():
+    """Job demand above the SLO floor wins (max merge) — the SLO path
+    augments the resource path, never suppresses it."""
+    clock = VirtualClock()
+    reg = MetricsRegistry()
+    slo = make_signal(reg, clock)
+    h = Harness()
+    h.store.create(make_serve_cluster().to_dict())
+    h.settle()
+    h.store.create({
+        "apiVersion": C.API_VERSION, "kind": C.KIND_JOB,
+        "metadata": {"name": "big", "namespace": "default"},
+        "spec": {"entrypoint": "x", "clusterSpec": {
+            "workerGroupSpecs": [{"groupName": "workers", "replicas": 3}]}},
+        "status": {"clusterName": "demo", "jobDeploymentStatus": "Running"},
+    })
+    auto = SliceAutoscaler(h.store, slo=slo, clock=clock)
+    assert auto.reconcile("demo")                # job demand 3 -> step up
+    h.settle()
+    assert h.cluster().spec.workerGroupSpecs[0].replicas == 2
+
+
+def test_slo_decisions_visible_at_debug_endpoint():
+    from kuberay_tpu.apiserver.server import serve_background
+    from kuberay_tpu.controlplane.store import ObjectStore
+
+    clock = VirtualClock()
+    reg = MetricsRegistry()
+    slo = make_signal(reg, clock, breach_seconds=0.0, cooldown_seconds=0.0)
+    h = Harness()
+    h.store.create(make_serve_cluster().to_dict())
+    h.settle()
+    audit = DecisionAudit(clock=clock)
+    auto = SliceAutoscaler(h.store, audit=audit, slo=slo, clock=clock)
+    _observe(reg, [2.0] * 10)
+    clock.advance(1.0)
+    _observe(reg, [2.0] * 10)
+    assert auto.reconcile("demo")
+
+    srv, url = serve_background(ObjectStore(), autoscaler=audit)
+    try:
+        doc = json.load(urllib.request.urlopen(f"{url}/debug/autoscaler",
+                                               timeout=5))
+        assert doc["decisions"], "audit ring empty at /debug/autoscaler"
+        entry = doc["decisions"][0]
+        assert entry["direction"] == "up"
+        slo_sig = entry["signals"]["slo"]
+        assert slo_sig["state"] == "scale_up"
+        assert slo_sig["ttft_p99_s"] > slo_sig["ttft_p99_target_s"]
+    finally:
+        srv.shutdown()

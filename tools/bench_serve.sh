@@ -1,0 +1,40 @@
+#!/usr/bin/env sh
+# Serve traffic-generator smoke: a tiny seeded hot-prefix run through the
+# prefix-aware gateway must complete and emit a tpu-bench-serve/v1
+# artifact with the full per-leg schema.  This is the standing guard for
+# the fleet-serving data plane (docs/serving.md) — the published numbers
+# in benchmark/results/serve_r07.json come from the same harness at
+# full scale:
+#
+#   tools/bench_serve.sh                                   # smoke
+#   python benchmark/serve_bench.py --traffic all --seeds 0..2 \
+#       --duration 20 --json-out benchmark/results/serve_r07.json
+#
+# Part of the smoke-script family (tools/bench_controlplane.sh,
+# tools/bench_scale.sh, tools/sim_smoke.sh, tools/obs_smoke.sh).
+set -eu
+cd "$(dirname "$0")/.."
+out="${BENCH_OUT:-/tmp/tpu_bench_serve_smoke.json}"
+timeout -k 10 600 env JAX_PLATFORMS=cpu python benchmark/serve_bench.py \
+    --traffic "${BENCH_TRAFFIC:-hot-prefix}" \
+    --seeds "${BENCH_SEEDS:-0}" \
+    --duration "${BENCH_DURATION:-5}" \
+    --rate-scale "${BENCH_RATE_SCALE:-0.5}" \
+    --json-out "$out"
+BENCH_JSON_PATH="$out" python - <<'EOF'
+import json, os, sys
+sys.path.insert(0, os.getcwd())
+from benchmark.serve_bench import TRAFFIC_LEG_KEYS, TRAFFIC_SCHEMA
+doc = json.load(open(os.environ["BENCH_JSON_PATH"]))
+assert doc["schema"] == TRAFFIC_SCHEMA, doc.get("schema")
+assert doc["legs"], "traffic run produced no legs"
+for leg in doc["legs"]:
+    missing = [k for k in TRAFFIC_LEG_KEYS if k not in leg]
+    assert not missing, f"leg missing keys {missing}: {leg}"
+    assert leg["errors"] == 0, f"transport errors in leg: {leg}"
+    assert leg["completed"] + leg["shed"] == leg["requests"], leg
+    assert leg["completed"] > 0 and leg["tokens_per_sec"] > 0, leg
+print(f"bench serve smoke ok: {len(doc['legs'])} legs, "
+      f"{sum(l['requests'] for l in doc['legs'])} requests, "
+      f"schema {doc['schema']}")
+EOF
