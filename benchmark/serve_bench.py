@@ -407,6 +407,14 @@ class _Fleet:
             a.prefix_hits = 0
             a.prefix_queries = 0
 
+    def set_tracer(self, tracer):
+        """Swap every tracing seam (gateway + each replica engine) on the
+        already-compiled fleet, so tracing-on/off legs share XLA programs
+        and the measured delta is the tracer alone."""
+        self.gateway.tracer = tracer
+        for fe in self.frontends:
+            fe.engine._tracer = tracer
+
     def close(self):
         self.gateway.stop()
         for srv in self.servers:
@@ -670,6 +678,119 @@ def traffic(args) -> None:
         print(f"wrote {args.json_out}", flush=True)
 
 
+def trace_overhead(args) -> None:
+    """--trace: the tracing-overhead gate.  One hot-prefix fleet, two
+    legs over the IDENTICAL seeded arrival schedule — tracing off, then
+    on (gateway spans + traceparent propagation + engine child spans +
+    exemplars) — on the same compiled engines, so the delta is the
+    tracer's cost and nothing else.  tools/bench_serve.sh asserts the
+    throughput overhead stays under its budget (default 5%)."""
+    import random as _random
+
+    import jax
+
+    from kuberay_tpu.models import llama
+    from kuberay_tpu.obs.trace import NOOP_TRACER, Tracer
+
+    cfg = llama.CONFIGS[args.model]
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    bs = 16
+    prof = TRAFFIC_PROFILES["hot-prefix"]
+    prefix_len, new_tokens = prof["prefix"], prof["new"]
+    slots = prof["slots"]
+    rate = prof["rate"] * args.rate_scale
+    max_len = prefix_len + new_tokens + 16
+    blocks_per_prompt = (max_len + bs - 1) // bs
+    num_blocks = slots * blocks_per_prompt + \
+        (HOT_PREFIXES // 2 + 1) * (prefix_len // bs)
+    seed = args.seeds[0]
+    replicas = 2
+
+    fleet = _Fleet(cfg, params, replicas, slots=slots, max_len=max_len,
+                   num_blocks=num_blocks, block_size=bs, seed=seed,
+                   affinity=True, shedding=False)
+    legs = []
+    spans_recorded = 0
+    try:
+        warm = [11_111 + j for j in range(prefix_len)]
+        cold_warm = [12_345 + j for j in range(64)]
+        fleet.warm([warm + [7], warm + [8], cold_warm + [9]])
+        gw_srv, gw_url = fleet.gateway.serve_background_http()
+        try:
+            hots = _hot_prompts(prefix_len, HOT_PREFIXES)
+            hot_warm = [(0.25 * i, list(p) + [31337])
+                        for i, p in enumerate(hots * 2)]
+            _drive_open_loop(gw_url, hot_warm, new_tokens)
+            # Off leg FIRST: it inherits the warmed caches exactly like
+            # the on leg does, and any residual drift (cache aging)
+            # biases AGAINST tracing — an overhead gate that passes
+            # under that bias is conservative.
+            for tracing in (False, True):
+                tracer = Tracer(max_spans=65536) if tracing \
+                    else NOOP_TRACER
+                fleet.set_tracer(tracer)
+                fleet.reset_counters()
+                gw_hits_base = _gateway_hits(fleet)
+                rng = _random.Random(
+                    (seed << 8) ^ (zlib.crc32(b"hot-prefix") & 0xFFFF))
+                arrivals = _gen_arrivals(
+                    rng, "hot-prefix", args.duration, rate, prefix_len,
+                    bs, HOT_PREFIXES, hot_fraction=HOT_FRACTION)
+                records, wall = _drive_open_loop(gw_url, arrivals,
+                                                 new_tokens)
+                leg = _leg_summary("hot-prefix", seed, replicas, True,
+                                   False, records, wall, fleet,
+                                   gw_hits_base=gw_hits_base)
+                leg["tracing"] = tracing
+                if tracing:
+                    spans_recorded = len(tracer.store)
+                    leg["spans_recorded"] = spans_recorded
+                legs.append(leg)
+                print(json.dumps(leg), flush=True)
+        finally:
+            gw_srv.shutdown()
+    finally:
+        fleet.close()
+
+    off, on = legs
+    tps_off, tps_on = off["tokens_per_sec"], on["tokens_per_sec"]
+    overhead = {
+        "tokens_per_sec_off": tps_off,
+        "tokens_per_sec_on": tps_on,
+        "overhead_pct": round((tps_off - tps_on) / tps_off * 100.0, 2)
+        if tps_off else 0.0,
+        "ttft_p99_off_ms": off["ttft_p99_ms"],
+        "ttft_p99_on_ms": on["ttft_p99_ms"],
+        "ttft_p99_delta_ms": round(on["ttft_p99_ms"] - off["ttft_p99_ms"],
+                                   2)
+        if off["ttft_p99_ms"] is not None and on["ttft_p99_ms"] is not None
+        else None,
+        "spans_recorded": spans_recorded,
+    }
+    print(json.dumps({"trace_overhead": overhead}), flush=True)
+
+    doc = {
+        "schema": TRAFFIC_SCHEMA,
+        "workload_params": {
+            "model": args.model, "duration_s": args.duration,
+            "rate_scale": args.rate_scale, "block_size": bs,
+            "hot_prefixes": HOT_PREFIXES, "hot_fraction": HOT_FRACTION,
+            "profiles": {"hot-prefix": TRAFFIC_PROFILES["hot-prefix"]},
+        },
+        "seeds": [seed],
+        "device": str(jax.devices()[0]),
+        "platform": jax.devices()[0].platform,
+        "legs": legs,
+        "trace_overhead": overhead,
+    }
+    if args.json_out:
+        pathlib.Path(args.json_out).parent.mkdir(parents=True,
+                                                 exist_ok=True)
+        pathlib.Path(args.json_out).write_text(
+            json.dumps(doc, indent=2) + "\n")
+        print(f"wrote {args.json_out}", flush=True)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="serve-bench")
     ap.add_argument("--cpu", action="store_true",
@@ -688,6 +809,10 @@ def main(argv=None) -> int:
                     choices=["", "hot-prefix", "burst", "diurnal", "all"],
                     help="seeded open-loop traffic generator through the "
                          "prefix-aware gateway (tpu-bench-serve/v1)")
+    ap.add_argument("--trace", action="store_true",
+                    help="tracing-overhead gate: hot-prefix legs with "
+                         "end-to-end request tracing off vs on, same "
+                         "compiled fleet and arrival schedule")
     ap.add_argument("--seeds", default="0",
                     help="traffic seeds: single (7) or range (0..2)")
     ap.add_argument("--duration", type=float, default=20.0,
@@ -706,13 +831,16 @@ def main(argv=None) -> int:
     else:
         from kuberay_tpu.utils.platform import pin_platform_from_env
         pin_platform_from_env()
-    if args.traffic:
+    if args.traffic or args.trace:
         if ".." in args.seeds:
             lo, hi = args.seeds.split("..", 1)
             args.seeds = list(range(int(lo), int(hi) + 1))
         else:
             args.seeds = [int(args.seeds)]
-        traffic(args)
+        if args.traffic:
+            traffic(args)
+        if args.trace:
+            trace_overhead(args)
     elif args.matrix:
         matrix(args)
     else:

@@ -8,9 +8,12 @@ the rule was written — see docs/static-analysis.md).
 from __future__ import annotations
 
 import ast
+import os
+import re
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
-from kuberay_tpu.analysis.core import FileContext, Finding, Rule, rule
+from kuberay_tpu.analysis.core import (FileContext, Finding, Rule,
+                                       iter_python_files, rule)
 
 
 # ---------------------------------------------------------------------------
@@ -987,3 +990,149 @@ class ShardAffinityRule(Rule):
                         "router: the key may land in a pool its hash "
                         "does not own, breaking global per-key "
                         "serialization — use Manager.enqueue")
+
+
+# ---------------------------------------------------------------------------
+# 10. metric-catalog-sync
+# ---------------------------------------------------------------------------
+
+#: Registry calls that instantiate a metric family when their first
+#: argument is a constant ``tpu_*`` name.
+_METRIC_CALL_ATTRS = {"inc", "observe", "set_gauge", "describe"}
+#: Backtick-quoted family name in the doc; a trailing ``*`` marks a
+#: wildcard row (``tpu_serve_*``), a ``{...}`` label suffix is stripped
+#: by stopping the match at ``{``.
+_METRIC_TOKEN_RE = re.compile(r"`(tpu_[a-z0-9_]*\*?)")
+_CATALOG_DOC = os.path.join("docs", "observability.md")
+_METRICS_ANCHOR = "kuberay_tpu/utils/metrics.py"
+
+
+@rule
+class MetricCatalogSyncRule(Rule):
+    """The metric catalog in docs/observability.md is the operator-facing
+    contract for what ``/metrics`` exposes; a family instantiated in code
+    but absent from the catalog is a dashboard nobody knows to build, and
+    a catalog row with no code behind it is an alert rule that can never
+    fire.  Both directions are enforced: per file, every ``tpu_*`` family
+    passed as a constant to ``inc``/``observe``/``set_gauge``/``describe``
+    must appear (backtick-quoted) in the doc; and — anchored on the
+    registry module so the sweep runs once — every ``tpu_*`` catalog-table
+    row must name a family some package file instantiates.  Wildcard rows
+    (``tpu_serve_*``) cover dynamically-named passthrough families.
+    """
+
+    NAME = "metric-catalog-sync"
+    DESCRIPTION = ("every tpu_* metric family instantiated in code must "
+                   "appear in docs/observability.md's catalog, and every "
+                   "tpu_* catalog row must exist in code")
+    INVARIANT = ("the published metric catalog and the instantiated "
+                 "families never drift")
+
+    #: repo root -> (documented names, wildcard prefixes, table families)
+    _doc_cache: Dict[str, Tuple[Set[str], Set[str], Set[str]]] = {}
+    #: repo root -> every constant tpu_* family in the package
+    _code_cache: Dict[str, Set[str]] = {}
+
+    def check(self, tree: ast.Module, ctx: FileContext) -> Iterable[Finding]:
+        # Synthetic sources (analyze_source snippets) have no repo to
+        # resolve the doc against; the rule only applies to real files.
+        if not os.path.isfile(ctx.path):
+            return
+        root = self._find_root(ctx.path)
+        if root is None:
+            return
+        documented, wildcards, table_families = self._doc_names(root)
+        for name, node in sorted(self._families_in(tree).items()):
+            if name in documented or \
+                    any(name.startswith(w) for w in wildcards):
+                continue
+            yield self.finding(
+                ctx, node,
+                f"metric family '{name}' is instantiated here but missing "
+                "from the docs/observability.md metric catalog; add a "
+                "catalog row (or fold it under a wildcard row) so the "
+                "exposition contract stays complete")
+        # The reverse sweep is repo-global, so it anchors on the registry
+        # module and runs exactly once per lint invocation.
+        if ctx.path.replace("\\", "/").endswith(_METRICS_ANCHOR):
+            code = self._package_families(root)
+            for fam in sorted(table_families):
+                if fam.endswith("*"):
+                    if not any(c.startswith(fam[:-1]) for c in code):
+                        yield self._doc_finding(ctx, fam)
+                elif fam not in code:
+                    yield self._doc_finding(ctx, fam)
+
+    def _doc_finding(self, ctx: FileContext, fam: str) -> Finding:
+        return Finding(
+            rule=self.NAME, path=_CATALOG_DOC, line=1, col=1,
+            message=(f"catalog row '{fam}' names a metric family no "
+                     "package code instantiates; remove the stale row or "
+                     "restore the series"))
+
+    @staticmethod
+    def _families_in(tree: ast.Module) -> Dict[str, ast.AST]:
+        out: Dict[str, ast.AST] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _METRIC_CALL_ATTRS and node.args:
+                name = _const_str(node.args[0])
+                if name and name.startswith("tpu_"):
+                    out.setdefault(name, node)
+        return out
+
+    @staticmethod
+    def _find_root(path: str) -> Optional[str]:
+        d = os.path.dirname(os.path.abspath(path))
+        for _ in range(12):
+            if os.path.isfile(os.path.join(d, _CATALOG_DOC)):
+                return d
+            parent = os.path.dirname(d)
+            if parent == d:
+                return None
+            d = parent
+        return None
+
+    @classmethod
+    def _doc_names(cls, root: str) -> Tuple[Set[str], Set[str], Set[str]]:
+        cached = cls._doc_cache.get(root)
+        if cached is not None:
+            return cached
+        with open(os.path.join(root, _CATALOG_DOC),
+                  encoding="utf-8") as fh:
+            text = fh.read()
+        documented: Set[str] = set()
+        wildcards: Set[str] = set()
+        table_families: Set[str] = set()
+        for line in text.splitlines():
+            tokens = _METRIC_TOKEN_RE.findall(line)
+            for tok in tokens:
+                if tok.endswith("*"):
+                    wildcards.add(tok[:-1])
+                else:
+                    documented.add(tok)
+            # A catalog-table row's FIRST backticked family is the row's
+            # subject; later tokens in the meaning column are prose.
+            if line.lstrip().startswith("|") and tokens:
+                table_families.add(tokens[0])
+        out = (documented, wildcards, table_families)
+        cls._doc_cache[root] = out
+        return out
+
+    @classmethod
+    def _package_families(cls, root: str) -> Set[str]:
+        cached = cls._code_cache.get(root)
+        if cached is not None:
+            return cached
+        fams: Set[str] = set()
+        for path in iter_python_files([os.path.join(root, "kuberay_tpu")]):
+            try:
+                with open(path, encoding="utf-8",
+                          errors="replace") as fh:
+                    tree = ast.parse(fh.read(), filename=path)
+            except SyntaxError:
+                continue
+            fams.update(cls._families_in(tree))
+        cls._code_cache[root] = fams
+        return fams

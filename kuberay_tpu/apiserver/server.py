@@ -71,6 +71,7 @@ class ApiHandler(JsonHandler):
     flight = None                       # obs.FlightRecorder (optional)
     goodput = None                      # obs.GoodputLedger (optional)
     autoscaler = None                   # autoscaler.DecisionAudit (optional)
+    alerts = None                       # obs.AlertEngine (optional)
 
     def _error(self, code: int, message: str, reason: str = ""):
         self._send(code, {"kind": "Status", "status": "Failure",
@@ -264,6 +265,14 @@ class ApiHandler(JsonHandler):
             return self._error(404, "autoscaler audit not enabled")
         return self._send(200, {"decisions": self.autoscaler.to_list()})
 
+    def _debug_alerts(self):
+        """SLO burn-rate alerts (obs/alerts.py): currently-firing alerts,
+        the bounded fired/resolved history ring, and the spec catalog.
+        404 when the operator runs without an alert engine."""
+        if self.alerts is None:
+            return self._error(404, "alerting not enabled")
+        return self._send(200, self.alerts.to_dict())
+
     def _label_selector(self) -> Optional[Dict[str, str]]:
         q = parse_qs(urlparse(self.path).query)
         sel = q.get("labelSelector", [None])[0]
@@ -438,6 +447,8 @@ class ApiHandler(JsonHandler):
             return self._debug_goodput(path)
         if path == "/debug/autoscaler":
             return self._debug_autoscaler()
+        if path == "/debug/alerts":
+            return self._debug_alerts()
         if path.startswith("/api/history/") and self.history is not None:
             r = self.history.route(self.path)
             if r is not None:
@@ -650,7 +661,7 @@ def make_server(store: ObjectStore, host: str = "127.0.0.1", port: int = 0,
                 keyfile: Optional[str] = None,
                 history=None, tracer=None,
                 flight=None, goodput=None,
-                autoscaler=None) -> ThreadingHTTPServer:
+                autoscaler=None, alerts=None) -> ThreadingHTTPServer:
     """``token`` enables bearer auth on every API verb; ``certfile``/
     ``keyfile`` serve TLS (the authenticated-cluster-endpoint stand-in
     RestObjectStore's client auth is tested against).  ``history``: a
@@ -659,12 +670,13 @@ def make_server(store: ObjectStore, host: str = "127.0.0.1", port: int = 0,
     ``tracer``/``flight``/``goodput`` (kuberay_tpu.obs) mount the
     ``/debug/traces``, ``/debug/flight/...`` and ``/debug/goodput/...``
     forensics surface; ``autoscaler`` (a ``DecisionAudit``) mounts
-    ``/debug/autoscaler``."""
+    ``/debug/autoscaler``; ``alerts`` (an ``obs.AlertEngine``) mounts
+    ``/debug/alerts``."""
     handler = type("BoundApiHandler", (ApiHandler,),
                    {"store": store, "metrics": metrics, "token": token,
                     "history": history, "tracer": tracer,
                     "flight": flight, "goodput": goodput,
-                    "autoscaler": autoscaler})
+                    "autoscaler": autoscaler, "alerts": alerts})
     if certfile:
         import ssl
         ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
@@ -683,12 +695,12 @@ def serve_background(store: ObjectStore, host: str = "127.0.0.1",
                      certfile: Optional[str] = None,
                      keyfile: Optional[str] = None, history=None,
                      tracer=None, flight=None, goodput=None,
-                     autoscaler=None):
+                     autoscaler=None, alerts=None):
     """Start in a daemon thread; returns (server, base_url)."""
     srv = make_server(store, host, port, metrics, token=token,
                       certfile=certfile, keyfile=keyfile, history=history,
                       tracer=tracer, flight=flight, goodput=goodput,
-                      autoscaler=autoscaler)
+                      autoscaler=autoscaler, alerts=alerts)
     t = threading.Thread(target=srv.serve_forever, daemon=True,
                          name="tpu-apiserver")
     t.start()

@@ -16,6 +16,10 @@ production and in sim-violation forensics — from one artifact.
   buffer of watch deliveries, state transitions, recorded Events,
   conflicts and requeues, queryable as a timeline
   (``/debug/flight/<kind>/<ns>/<name>`` on the API server).
+- :mod:`kuberay_tpu.obs.alerts`: multi-window multi-burn-rate SLO
+  alerting over ``MetricsRegistry`` snapshot deltas (TTFT p99,
+  availability, goodput-ratio floor), firing into a bounded ring at
+  ``/debug/alerts``.
 - :mod:`kuberay_tpu.obs.goodput`: the goodput/badput ledger — every
   second of a TpuJob/TpuCluster's lifetime attributed to an exclusive,
   exhaustive phase set (queued / provisioning / bootstrap / productive
@@ -23,6 +27,7 @@ production and in sim-violation forensics — from one artifact.
   ``/debug/goodput`` and archived post-mortem by the history server.
 """
 
+from kuberay_tpu.obs.alerts import AlertEngine, SloSpec, default_slos
 from kuberay_tpu.obs.flight import FlightRecorder
 from kuberay_tpu.obs.goodput import (
     NOOP_TRANSITIONS,
@@ -42,6 +47,7 @@ from kuberay_tpu.obs.trace import (
 )
 
 __all__ = [
+    "AlertEngine",
     "FlightRecorder",
     "GoodputLedger",
     "NOOP_TRACER",
@@ -49,10 +55,12 @@ __all__ = [
     "NoopTracer",
     "NoopTransitionRecorder",
     "PHASES",
+    "SloSpec",
     "Span",
     "SpanStore",
     "TraceContext",
     "Tracer",
     "TransitionRecorder",
+    "default_slos",
     "span_tree",
 ]

@@ -1,0 +1,293 @@
+"""Multi-window multi-burn-rate SLO alerting (Google SRE Workbook ch. 5).
+
+The SLO machinery in ``controlplane/slo.py`` drives autoscaling verdicts
+but never tells a human anything is burning.  This module closes that
+gap: declarative :class:`SloSpec` objects (TTFT p99 target, availability
+from shed/error counters, goodput-ratio floor per CR) are evaluated as
+fast/slow burn rates over deltas of ``MetricsRegistry`` snapshots under
+an injectable clock, firing into a bounded alert ring served at
+``/debug/alerts``.
+
+Burn rate is the unit-free core: with an objective of 99%, the error
+budget is 1% of events; a burn rate of 14 means the window consumed
+budget 14x faster than allowed.  Each spec is watched over two windows —
+a short one that pages fast on sharp breaches and a long one that
+catches slow leaks a short window dilutes away.  Alerts clear when the
+breaching events age out of their window.
+
+Everything is observational: the engine reads cumulative snapshots and
+the clock, never the store or the rng, so evaluating under simulation
+leaves the replay hash byte-identical (the same contract the tracer and
+the goodput ledger obey).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+#: Record classes an alert ring entry carries in ``state``.
+FIRING, RESOLVED = "firing", "resolved"
+
+
+@dataclasses.dataclass(frozen=True)
+class SloSpec:
+    """One service-level objective, evaluated as burn rates.
+
+    ``kind`` selects how cumulative (total, bad) event counts are read:
+
+    - ``latency``: a histogram family/labels; bad = observations above
+      ``threshold_s`` (align the threshold to a bucket boundary — the
+      exposition only knows bucket-resolution truth);
+    - ``availability``: bad = every series of ``bad_families`` plus the
+      5xx-coded series of ``total_family``; total = ``total_family``;
+    - ``gauge-floor``: each series of ``gauge_family`` contributes one
+      synthetic event per evaluation tick, bad when the gauge sits below
+      ``floor`` — "spent too much of the window unproductive".
+    """
+
+    name: str
+    kind: str                                  # latency|availability|gauge-floor
+    objective: float = 0.99                    # good-event target (0..1)
+    # latency
+    metric: str = ""
+    labels: Tuple[Tuple[str, str], ...] = ()
+    threshold_s: float = 0.5
+    # availability
+    total_family: str = ""
+    bad_families: Tuple[str, ...] = ()
+    # gauge-floor
+    gauge_family: str = ""
+    floor: float = 0.5
+    # windows (seconds) and their burn-rate thresholds
+    fast_window_s: float = 300.0
+    fast_burn: float = 14.0
+    slow_window_s: float = 3600.0
+    slow_burn: float = 6.0
+    min_samples: int = 5
+
+    @property
+    def budget(self) -> float:
+        return max(1e-9, 1.0 - self.objective)
+
+
+def default_slos(ttft_target_s: float = 0.5,
+                 availability: float = 0.99,
+                 goodput_floor: float = 0.5) -> List[SloSpec]:
+    """The stock catalog the operator mounts (docs/observability.md):
+    serve TTFT p99, serve availability, per-CR goodput-ratio floor."""
+    return [
+        SloSpec(name="serve-ttft", kind="latency",
+                metric="tpu_serve_request_duration_seconds",
+                labels=(("phase", "ttft"),), threshold_s=ttft_target_s,
+                objective=0.99),
+        SloSpec(name="serve-availability", kind="availability",
+                total_family="tpu_gateway_requests_total",
+                bad_families=("tpu_gateway_shed_total",),
+                objective=availability),
+        SloSpec(name="goodput-ratio", kind="gauge-floor",
+                gauge_family="tpu_goodput_ratio", floor=goodput_floor,
+                objective=0.9),
+    ]
+
+
+class AlertEngine:
+    """Evaluates SLO specs against a :class:`MetricsRegistry` and keeps a
+    bounded ring of fired/resolved alerts.
+
+    ``evaluate()`` is the single entry point — the operator calls it from
+    its background tick, the sim harness from its settle loop.  Each call
+    appends one cumulative sample per watched series and re-derives the
+    burn rate of every (spec, series, window); transitions are recorded
+    into the ring.  Alert identity is (spec, series, window): a breach
+    that keeps burning stays one firing alert, it does not re-fire.
+    """
+
+    def __init__(self, registry, specs: Optional[List[SloSpec]] = None,
+                 clock=None, capacity: int = 256,
+                 audit=None, flight=None):
+        self.registry = registry
+        self.specs = list(specs) if specs is not None else default_slos()
+        self._now: Callable[[], float] = (clock.now if clock is not None
+                                          else time.time)
+        self._audit = audit
+        self._flight = flight
+        self._lock = threading.Lock()
+        # (spec.name, series_key) -> deque[(ts, total, bad)]
+        self._samples: Dict[Tuple[str, Tuple], deque] = {}
+        # (spec.name, series_key, window) -> active alert dict
+        self._active: Dict[Tuple[str, Tuple, str], Dict[str, Any]] = {}
+        self._ring: deque = deque(maxlen=capacity)
+        self.evaluations = 0
+
+    # -- cumulative event counts per spec -----------------------------------
+
+    def _latency_counts(self, spec: SloSpec
+                        ) -> List[Tuple[Tuple, float, float]]:
+        snap = self.registry.histogram_snapshot(spec.metric,
+                                                dict(spec.labels))
+        if snap is None:
+            return []
+        good = sum(c for b, c in zip(snap["buckets"], snap["counts"])
+                   if b <= spec.threshold_s)
+        return [(spec.labels, float(snap["n"]), float(snap["n"] - good))]
+
+    def _availability_counts(self, spec: SloSpec
+                             ) -> List[Tuple[Tuple, float, float]]:
+        series = self.registry.family_snapshot(spec.total_family)
+        if not series:
+            return []
+        total = sum(v for _, v in series)
+        bad = sum(v for labels, v in series
+                  if str(labels.get("code", "")).startswith("5"))
+        for fam in spec.bad_families:
+            bad += sum(v for _, v in self.registry.family_snapshot(fam))
+        return [((), total, bad)]
+
+    def _gauge_counts(self, spec: SloSpec
+                      ) -> List[Tuple[Tuple, float, float]]:
+        out = []
+        for labels, value in self.registry.family_snapshot(
+                spec.gauge_family):
+            key = tuple(sorted(labels.items()))
+            prev = self._samples.get((spec.name, key))
+            total = (prev[-1][1] if prev else 0.0) + 1.0
+            bad = (prev[-1][2] if prev else 0.0) + \
+                (1.0 if value < spec.floor else 0.0)
+            out.append((key, total, bad))
+        return out
+
+    def _counts(self, spec: SloSpec) -> List[Tuple[Tuple, float, float]]:
+        if spec.kind == "latency":
+            return self._latency_counts(spec)
+        if spec.kind == "availability":
+            return self._availability_counts(spec)
+        if spec.kind == "gauge-floor":
+            return self._gauge_counts(spec)
+        raise ValueError(f"unknown SLO kind {spec.kind!r}")
+
+    # -- windowed burn rates ------------------------------------------------
+
+    @staticmethod
+    def _anchor(samples: deque, horizon: float
+                ) -> Optional[Tuple[float, float, float]]:
+        """The newest sample at or before the window start — cumulative
+        deltas against it cover exactly the window (plus at most one
+        evaluation interval of slack at the old edge)."""
+        anchor = None
+        for s in samples:
+            if s[0] <= horizon:
+                anchor = s
+            else:
+                break
+        return anchor if anchor is not None else (samples[0]
+                                                  if samples else None)
+
+    def _burn(self, spec: SloSpec, samples: deque, now: float,
+              window: float) -> Tuple[float, float, float]:
+        """(burn_rate, bad_delta, total_delta) over the trailing window."""
+        cur = samples[-1]
+        anchor = self._anchor(samples, now - window)
+        total = cur[1] - anchor[1]
+        bad = cur[2] - anchor[2]
+        if total < spec.min_samples:
+            return 0.0, bad, total
+        return (bad / total) / spec.budget, bad, total
+
+    # -- cross-links --------------------------------------------------------
+
+    def _links(self, spec: SloSpec, series_key: Tuple) -> Dict[str, str]:
+        """Where to look next: the exemplar trace behind a latency
+        breach, the autoscaler decision audit, the flight-recorder ring
+        for the breaching CR."""
+        links: Dict[str, str] = {}
+        if spec.kind == "latency":
+            snap = self.registry.histogram_snapshot(spec.metric,
+                                                    dict(spec.labels)) or {}
+            for bucket, ex in zip(reversed(snap.get("buckets", [])),
+                                  reversed(snap.get("exemplars", []))):
+                if ex is not None and bucket > spec.threshold_s:
+                    links["trace"] = f"/debug/traces?trace_id={ex[0]}"
+                    break
+        if self._audit is not None:
+            links["autoscaler"] = "/debug/autoscaler"
+        if spec.kind == "gauge-floor" and series_key:
+            labels = dict(series_key)
+            if {"kind", "namespace", "name"} <= set(labels):
+                links["flight"] = ("/debug/flight/%s/%s/%s"
+                                   % (labels["kind"], labels["namespace"],
+                                      labels["name"]))
+        return links
+
+    # -- the tick -----------------------------------------------------------
+
+    def evaluate(self) -> List[Dict[str, Any]]:
+        """One evaluation pass; returns alerts that fired this tick."""
+        now = self._now()
+        fired: List[Dict[str, Any]] = []
+        with self._lock:
+            self.evaluations += 1
+            for spec in self.specs:
+                for series_key, total, bad in self._counts(spec):
+                    skey = (spec.name, series_key)
+                    samples = self._samples.setdefault(
+                        skey, deque(maxlen=2048))
+                    samples.append((now, total, bad))
+                    for window_name, window_s, burn_thresh in (
+                            ("fast", spec.fast_window_s, spec.fast_burn),
+                            ("slow", spec.slow_window_s, spec.slow_burn)):
+                        burn, bad_d, total_d = self._burn(
+                            spec, samples, now, window_s)
+                        akey = (spec.name, series_key, window_name)
+                        active = self._active.get(akey)
+                        if burn >= burn_thresh and active is None:
+                            alert = {
+                                "name": spec.name, "window": window_name,
+                                "series": dict(series_key),
+                                "state": FIRING, "since": now,
+                                "burn_rate": round(burn, 3),
+                                "burn_threshold": burn_thresh,
+                                "budget": spec.budget,
+                                "bad": bad_d, "total": total_d,
+                                "links": self._links(spec, series_key),
+                            }
+                            self._active[akey] = alert
+                            self._ring.append(dict(alert))
+                            fired.append(alert)
+                        elif burn >= burn_thresh and active is not None:
+                            active["burn_rate"] = round(burn, 3)
+                            active["bad"], active["total"] = bad_d, total_d
+                        elif burn < burn_thresh and active is not None:
+                            resolved = self._active.pop(akey)
+                            resolved = dict(resolved, state=RESOLVED,
+                                            resolved_at=now,
+                                            burn_rate=round(burn, 3))
+                            self._ring.append(resolved)
+        return fired
+
+    # -- querying -----------------------------------------------------------
+
+    def active(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [dict(a) for a in self._active.values()]
+
+    def to_dict(self) -> Dict[str, Any]:
+        """The /debug/alerts document: active alerts, the bounded
+        fired/resolved history ring, and the spec catalog."""
+        with self._lock:
+            return {
+                "active": [dict(a) for a in self._active.values()],
+                "ring": [dict(a) for a in self._ring],
+                "evaluations": self.evaluations,
+                "specs": [{
+                    "name": s.name, "kind": s.kind,
+                    "objective": s.objective,
+                    "fast": {"window_s": s.fast_window_s,
+                             "burn": s.fast_burn},
+                    "slow": {"window_s": s.slow_window_s,
+                             "burn": s.slow_burn},
+                } for s in self.specs],
+            }

@@ -55,6 +55,30 @@ class TraceContext:
     def __repr__(self):
         return f"TraceContext({self.trace_id}, {self.span_id})"
 
+    # -- cross-process propagation (serve data plane) ----------------------
+
+    def to_traceparent(self) -> str:
+        """W3C-style traceparent header: version 00, sampled flag 01.
+        The ids are this tracer's deterministic counter ids rather than
+        random hex, so a traced sim/bench run replays identically."""
+        return f"00-{self.trace_id}-{self.span_id}-01"
+
+    @classmethod
+    def from_traceparent(cls, header: Optional[str]
+                         ) -> Optional["TraceContext"]:
+        """Parse a traceparent header into the remote parent context;
+        malformed or absent headers yield None (the request simply runs
+        untraced — propagation must never fail a request)."""
+        if not header:
+            return None
+        parts = str(header).strip().split("-")
+        if len(parts) != 4 or parts[0] != "00":
+            return None
+        _, trace_id, span_id, _flags = parts
+        if not trace_id or not span_id:
+            return None
+        return cls(trace_id, span_id)
+
 
 class Span:
     """One timed operation.  ``end is None`` means still open (only the
@@ -93,9 +117,13 @@ class Span:
 
 
 class SpanStore:
-    """Bounded in-memory span sink: oldest spans are dropped (and
-    counted) once ``max_spans`` is exceeded — tracing must never become
-    the memory leak it exists to debug."""
+    """Bounded in-memory span sink with tail-sampling retention: once
+    ``max_spans`` is exceeded, fast successful spans are dropped first —
+    error/shed spans (status != ok), still-open spans, and the slowest
+    decile of durations survive longest, so the traces a p99 exemplar
+    points at are the ones still inspectable at /debug/traces.  Eviction
+    is counted; tracing must never become the memory leak it exists to
+    debug."""
 
     def __init__(self, max_spans: int = 8192):
         self.max_spans = max_spans
@@ -106,10 +134,32 @@ class SpanStore:
     def add(self, span: Span) -> None:
         with self._lock:
             self._spans.append(span)
-            if len(self._spans) > self.max_spans:
-                overflow = len(self._spans) - self.max_spans
-                del self._spans[:overflow]
-                self._dropped += overflow
+            overflow = len(self._spans) - self.max_spans
+            if overflow > 0:
+                # Evict in amortized batches: one O(n log n) retention
+                # pass per ~max/16 adds instead of per add.
+                self._evict_locked(max(overflow, self.max_spans // 16))
+
+    def _evict_locked(self, n: int) -> None:
+        """Drop ``n`` spans, least interesting first: closed ok spans
+        below the p90 duration, then closed ok spans oldest-first, then
+        closed errors, then (only under extreme pressure) open spans."""
+        spans = self._spans
+        ok = [i for i, s in enumerate(spans)
+              if s.end is not None and s.status == "ok"]
+        durs = sorted(spans[i].duration for i in ok)
+        thresh = durs[(len(durs) * 9) // 10] if len(durs) >= 10 \
+            else float("inf")
+        victims = [i for i in ok if spans[i].duration < thresh][:n]
+        if len(victims) < n:
+            chosen = set(victims)
+            rest = [i for i in range(len(spans)) if i not in chosen]
+            rest.sort(key=lambda i: (spans[i].end is None,
+                                     spans[i].status != "ok", i))
+            victims.extend(rest[:n - len(victims)])
+        for i in sorted(victims, reverse=True):
+            del spans[i]
+        self._dropped += len(victims)
 
     @property
     def dropped(self) -> int:
@@ -223,6 +273,21 @@ class NoopTracer:
 
     def record_for_key(self, key: Key, name: str, start: float, end: float,
                        **attrs) -> None:
+        pass
+
+    def start_request(self, name: str, ts: Optional[float] = None,
+                      **attrs) -> Optional[TraceContext]:
+        return None
+
+    def finish_request(self, ctx: Optional[TraceContext],
+                       ts: Optional[float] = None, status: str = "ok",
+                       error: str = "") -> None:
+        pass
+
+    def record_span(self, ctx: Optional[TraceContext], name: str,
+                    start: float, end: float, parent_id: str = "",
+                    status: str = "ok", error: str = "",
+                    **attrs) -> None:
         pass
 
     def current(self) -> Optional[TraceContext]:
@@ -400,6 +465,61 @@ class Tracer(NoopTracer):
         key's behalf without running inside its reconcile (FakeKubelet)."""
         ctx = self.context_for(key)
         self._finish(ctx, ctx.span_id, name, start, end, attrs=attrs)
+
+    # -- per-request serve tracing ------------------------------------------
+    #
+    # Reconcile chains are keyed (one trace per object, LRU-bounded);
+    # serve requests are the opposite shape — a fresh trace per request,
+    # recorded with EXPLICIT contexts because the gateway handler thread,
+    # the replica HTTP thread and the engine loop never share a
+    # thread-local stack.  The context crosses the process boundary as a
+    # traceparent header (TraceContext.to_traceparent).
+
+    def start_request(self, name: str, ts: Optional[float] = None,
+                      **attrs) -> TraceContext:
+        """Mint a fresh trace with an open root span (the serve-request
+        envelope); close it with :meth:`finish_request`."""
+        ts = self._now() if ts is None else ts
+        with self._lock:
+            tid = f"t{next(self._ids):06d}"
+            sid = f"s{next(self._ids):06d}"
+            root = Span(tid, sid, "", name, start=ts,
+                        attrs=dict(attrs) if attrs else None)
+            self._roots[sid] = root
+        self.store.add(root)
+        return TraceContext(tid, sid)
+
+    def finish_request(self, ctx: Optional[TraceContext],
+                       ts: Optional[float] = None, status: str = "ok",
+                       error: str = "") -> None:
+        """Close a request's root span (idempotent; no-op for remote or
+        absent contexts)."""
+        if ctx is None:
+            return
+        ts = self._now() if ts is None else ts
+        with self._lock:
+            root = self._roots.pop(ctx.span_id, None)
+            if root is None:
+                return
+            if root.end is None or ts > root.end:
+                root.end = ts
+            if status != "ok":
+                root.status = status
+                root.error = error
+
+    def record_span(self, ctx: Optional[TraceContext], name: str,
+                    start: float, end: float, parent_id: str = "",
+                    status: str = "ok", error: str = "",
+                    **attrs) -> None:
+        """Record a completed span under an explicit context — the
+        cross-thread seam the serve path uses (gateway-queue,
+        route-decision, forward on the gateway; engine-queue, prefill,
+        decode, kv-alloc on the replica, parented on the traceparent's
+        remote span id)."""
+        if ctx is None:
+            return
+        self._finish(ctx, parent_id or ctx.span_id, name, start, end,
+                     attrs=attrs or None, status=status, error=error)
 
     def current(self) -> Optional[TraceContext]:
         top = self._stack_top()

@@ -132,6 +132,14 @@ class Operator:
             self.store, recorder=self.recorder, tracer=self.tracer)
         from kuberay_tpu.controlplane.autoscaler import DecisionAudit
         self.autoscaler_audit = DecisionAudit(metrics=self.metrics)
+        # SLO burn-rate alerting (obs/alerts.py): evaluated from the
+        # background tick over the same registry everything above feeds;
+        # served at /debug/alerts, cross-linked to the decision audit
+        # and the flight recorder.
+        from kuberay_tpu.obs import AlertEngine
+        self.alerts = AlertEngine(self.metrics.registry,
+                                  audit=self.autoscaler_audit,
+                                  flight=self.flight)
         # ``slo_signal`` (controlplane/slo.ServeSloSignal): embedders
         # serving traffic in-process hand the autoscaler their serve
         # TTFT/queue-depth SLO signal; None keeps the resource-only path.
@@ -242,7 +250,8 @@ class Operator:
         self.apiserver, self.api_url = serve_background(
             self.store, api_host, api_port, metrics=self.metrics,
             history=history, tracer=self.tracer, flight=self.flight,
-            goodput=self.goodput, autoscaler=self.autoscaler_audit)
+            goodput=self.goodput, autoscaler=self.autoscaler_audit,
+            alerts=self.alerts)
         if leader_election and shard_leases and self.manager.shards > 1:
             from kuberay_tpu.controlplane.leader import ShardLeaseElector
             # Start unowned: every pool paused until its lease is won.
@@ -306,6 +315,7 @@ class Operator:
                             (C.KIND_CRONJOB, md["namespace"], md["name"]))
                 if self.kubelet is not None:
                     self.kubelet.step()
+                self.alerts.evaluate()
                 self._gc_events()
             except Exception:
                 log.exception("operator background loop iteration failed")

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from kuberay_tpu.models.llama import LlamaConfig
+from kuberay_tpu.obs.trace import NOOP_TRACER
 from kuberay_tpu.serve.kv_cache import (
     forward_with_cache,
     forward_with_cache_mixtral,
@@ -45,6 +46,11 @@ class Request:
     eos_token: Optional[int] = None
     # Additional stop tokens (any match ends generation, reason "eos").
     stop_token_ids: Optional[List[int]] = None
+    # Distributed-trace context (obs.trace.TraceContext) minted by the
+    # gateway and carried over the replica hop as ``traceparent``; the
+    # engine attaches engine-queue / prefill / decode / kv-alloc child
+    # spans to it.  None = untraced request.
+    trace: Optional[Any] = None
 
 
 @dataclasses.dataclass
@@ -155,7 +161,7 @@ class ServeEngine:
                  decode_impl: str = "auto", mesh=None,
                  weight_quant: str = "none",
                  donate_params: bool = False,
-                 metrics=None):
+                 metrics=None, tracer=None, clock=None):
         self.cfg = cfg
         self.params = params
         # Request-phase latency decomposition: ``metrics`` is a
@@ -165,6 +171,12 @@ class ServeEngine:
         # token), decode (first token -> finish) — so a p99 regression
         # points at the phase that moved, not just "the server is slow".
         self.metrics = metrics
+        # Per-request tracing: requests carrying a TraceContext get
+        # engine-queue / prefill / decode child spans recorded against
+        # the gateway-minted trace.  ``clock`` (an object with .now())
+        # makes phase timestamps and spans virtual-clock exact in sim.
+        self._tracer = tracer if tracer is not None else NOOP_TRACER
+        self._now = clock.now if clock is not None else time.time
         if metrics is not None:
             metrics.describe(
                 "tpu_serve_request_duration_seconds",
@@ -437,7 +449,7 @@ class ServeEngine:
     # ------------------------------------------------------------------
 
     def add_request(self, req: Request) -> None:
-        self._arrival[req.request_id] = time.time()
+        self._arrival[req.request_id] = self._now()
         self._phase_mark(req.request_id, "queued")
         if len(req.prompt_tokens) >= self.max_len or req.max_new_tokens <= 0:
             self._cancel(req)
@@ -449,37 +461,41 @@ class ServeEngine:
         self._req_phase_ts.pop(req.request_id, None)
         self._finished.append(Response(
             req.request_id, [], "cancelled",
-            prompt_len=len(req.prompt_tokens), created=time.time()))
+            prompt_len=len(req.prompt_tokens), created=self._now()))
 
     # -- request-phase latency accounting ------------------------------
 
     def _phase_mark(self, rid: str, phase: str) -> None:
-        if self.metrics is None:
+        # Phase timestamps feed both the metrics decomposition and the
+        # per-request span tree — stamp when either consumer is live.
+        if self.metrics is None and not self._tracer.enabled:
             return
-        self._req_phase_ts.setdefault(rid, {})[phase] = time.time()
+        self._req_phase_ts.setdefault(rid, {})[phase] = self._now()
 
     def _phase_observe(self, rid: str, terminal: bool = True) -> None:
         """Emit the queue/prefill/decode decomposition for one request.
         queue+prefill land at first token (so a long-running decode
         still shows its admission cost live); decode lands at finish."""
-        if self.metrics is None:
+        if self.metrics is None and not self._tracer.enabled:
             return
         ts = self._req_phase_ts.get(rid)
         if ts is None:
             return
-        now = time.time()
+        now = self._now()
         if not terminal:
-            if "queued" in ts and "admitted" in ts:
-                self.metrics.observe(
-                    "tpu_serve_request_duration_seconds",
-                    ts["admitted"] - ts["queued"], {"phase": "queue"})
+            if self.metrics is not None:
+                if "queued" in ts and "admitted" in ts:
+                    self.metrics.observe(
+                        "tpu_serve_request_duration_seconds",
+                        ts["admitted"] - ts["queued"], {"phase": "queue"})
+                if "admitted" in ts:
+                    self.metrics.observe(
+                        "tpu_serve_request_duration_seconds",
+                        now - ts["admitted"], {"phase": "prefill"})
             if "admitted" in ts:
-                self.metrics.observe(
-                    "tpu_serve_request_duration_seconds",
-                    now - ts["admitted"], {"phase": "prefill"})
                 ts["first_token"] = now
             return
-        if "first_token" in ts:
+        if "first_token" in ts and self.metrics is not None:
             self.metrics.observe(
                 "tpu_serve_request_duration_seconds",
                 now - ts["first_token"], {"phase": "decode"})
@@ -619,16 +635,30 @@ class ServeEngine:
 
     def _finalize_admit(self, req: Request, slot: int, tok) -> None:
         self._phase_observe(req.request_id, terminal=False)
+        ts = self._req_phase_ts.get(req.request_id) or {}
         arrival = self._arrival.pop(req.request_id, None)
-        ttft = (time.time() - arrival) if arrival is not None else None
+        # Use the first-token stamp when one exists so the span tree,
+        # the TTFT observation, and its exemplar share one instant —
+        # the virtual-clock exactness contract (tests/test_serve_trace).
+        now = ts.get("first_token", self._now())
+        ttft = (now - arrival) if arrival is not None else None
         self._ttft[slot] = ttft
         if self.metrics is not None and ttft is not None:
             # The SLO autoscaler's primary signal (controlplane/slo.py):
             # sub-second buckets, unlike the coarse reconcile-scale
             # defaults the queue/prefill/decode phases use.
-            self.metrics.observe("tpu_serve_request_duration_seconds",
-                                 ttft, {"phase": "ttft"},
-                                 buckets=SERVE_LATENCY_BUCKETS)
+            self.metrics.observe(
+                "tpu_serve_request_duration_seconds", ttft,
+                {"phase": "ttft"}, buckets=SERVE_LATENCY_BUCKETS,
+                exemplar=(req.trace.trace_id if req.trace is not None
+                          else None),
+                exemplar_ts=now)
+        if req.trace is not None and arrival is not None:
+            admitted = ts.get("admitted", arrival)
+            self._tracer.record_span(req.trace, "engine-queue",
+                                     arrival, admitted)
+            self._tracer.record_span(req.trace, "prefill", admitted, now,
+                                     prompt_len=len(req.prompt_tokens))
         self.lens[slot] = len(req.prompt_tokens)
         self.active[slot] = req
         self.generated[slot] = [int(tok)]
@@ -801,10 +831,16 @@ class ServeEngine:
         slot-teardown bookkeeping lives here; the paged engine hooks it
         to release blocks."""
         req = self.active[slot]
+        ts = self._req_phase_ts.get(req.request_id) or {}
+        now = self._now()
+        if req.trace is not None and "first_token" in ts:
+            self._tracer.record_span(
+                req.trace, "decode", ts["first_token"], now,
+                tokens=len(self.generated[slot]), reason=reason)
         self._phase_observe(req.request_id)
         self._finished.append(Response(
             req.request_id, list(self.generated[slot]), reason,
-            prompt_len=len(req.prompt_tokens), created=time.time(),
+            prompt_len=len(req.prompt_tokens), created=now,
             ttft_s=self._ttft[slot]))
         self.active[slot] = None
         self.generated[slot] = []

@@ -50,6 +50,7 @@ import urllib.request
 from http.server import ThreadingHTTPServer
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+from kuberay_tpu.obs.trace import NOOP_TRACER
 from kuberay_tpu.serve.prefix import (
     PrefixIndex,
     affinity_score,
@@ -111,7 +112,8 @@ class WeightedGateway:
                  resolver: Optional[Callable[[str], str]] = None,
                  poll_interval: float = 1.0, metrics=None,
                  config: Optional[GatewayConfig] = None,
-                 rng: Optional[random.Random] = None, clock=None):
+                 rng: Optional[random.Random] = None, clock=None,
+                 tracer=None, flight=None):
         """``resolver(service_name) -> base_url``; defaults to cluster-DNS
         (http://<svc>.<ns>.svc:<serve-port>).  ``metrics`` is an optional
         MetricsRegistry: forwarded requests observe
@@ -121,8 +123,15 @@ class WeightedGateway:
         requests count ``tpu_gateway_shed_total{reason}``.  ``rng`` and
         ``clock`` (an object with ``.now()``) default to the module
         ``random``/wall clock; inject both for seeded deterministic
-        runs."""
+        runs.  ``tracer`` (obs.trace) mints one trace per request —
+        gateway-queue / route-decision / forward spans, the traceparent
+        header across the replica hop, and the trace id echoed to the
+        client.  ``flight`` (obs.FlightRecorder) records backend
+        lifecycle — weight changes, dead-backend exclusion,
+        retry-failover — keyed ("Backend", ns, service)."""
         self.metrics = metrics
+        self.tracer = tracer if tracer is not None else NOOP_TRACER
+        self.flight = flight
         if metrics is not None:
             metrics.describe("tpu_gateway_requests_total",
                              "Requests forwarded by the serve gateway, "
@@ -186,6 +195,7 @@ class WeightedGateway:
             for b in route.get("spec", {}).get("backends", []):
                 if b.get("weight", 0) > 0:
                     entries.append((b["service"], int(b["weight"])))
+        weight_changes: List[Tuple[str, int, int]] = []
         with self._lock:
             # Keep prior state (prefix index, load) across weight steps:
             # an upgrade shifting 10% -> 50% must not cold-start the new
@@ -195,12 +205,20 @@ class WeightedGateway:
                 if st is None:
                     st = self._states[svc] = _BackendState(
                         svc, self.resolver(svc), self.config.index_capacity)
+                if st.weight != w:
+                    weight_changes.append((svc, st.weight, w))
                 st.weight = w
             active = {svc for svc, _ in entries}
             for svc, st in self._states.items():
                 if svc not in active:
+                    if st.weight != 0:
+                        weight_changes.append((svc, st.weight, 0))
                     st.weight = 0
             self._active = [svc for svc, _ in entries]
+        if self.flight is not None:
+            for svc, old, new in weight_changes:
+                self.flight.record("Backend", self.namespace, svc,
+                                   "weight", f"{old} -> {new}")
 
     def _watch_loop(self):
         while not self._stop.is_set():
@@ -232,13 +250,14 @@ class WeightedGateway:
         return cands[-1]
 
     def _select_locked(self, cands: List[_BackendState],
-                       hashes: Sequence[int]) -> Tuple[_BackendState, int]:
+                       hashes: Sequence[int]
+                       ) -> Tuple[_BackendState, int, bool]:
         """Pick one backend among the weight-eligible candidates.
-        Returns (state, prefix_hit_depth_of_pick)."""
+        Returns (state, prefix_hit_depth_of_pick, epsilon_fallback)."""
         cfg = self.config
         if not cfg.affinity or self._rng.random() < cfg.epsilon:
             s = self._weighted_random_locked(cands)
-            return s, 0
+            return s, 0, cfg.affinity
         scored = [(affinity_score(s.index.hit_depth(hashes) if hashes else 0,
                                   s.load, cfg.alpha, cfg.beta), s)
                   for s in cands]
@@ -248,7 +267,7 @@ class WeightedGateway:
         top = [s for score, s in scored if score == best]
         s = top[0] if len(top) == 1 else self._weighted_random_locked(top)
         depth = s.index.hit_depth(hashes) if hashes else 0
-        return s, depth
+        return s, depth, False
 
     def pick_backend(self, prompt_tokens: Optional[Sequence[int]] = None,
                      exclude: Sequence[str] = ()) -> Optional[str]:
@@ -261,7 +280,7 @@ class WeightedGateway:
             cands = self._eligible_locked(exclude)
             if not cands:
                 return None
-            s, _ = self._select_locked(cands, hashes)
+            s, _, _ = self._select_locked(cands, hashes)
             self._note_pick_locked(s)
             return s.url
 
@@ -275,11 +294,13 @@ class WeightedGateway:
         raise _Overloaded(reason)
 
     def _acquire(self, hashes: Sequence[int], timeout: float,
-                 exclude: Sequence[str]) -> Optional[_BackendState]:
+                 exclude: Sequence[str]
+                 ) -> Optional[Tuple[_BackendState, int, bool]]:
         """Admission + routing: pick a backend with a free in-flight slot,
         waiting (bounded queue, bounded time) when all are saturated.
-        Returns None when the route has no eligible backend (503), raises
-        :class:`_Overloaded` on shed (429)."""
+        Returns (state, hit_depth, epsilon_fallback), or None when the
+        route has no eligible backend (503); raises :class:`_Overloaded`
+        on shed (429)."""
         cfg = self.config
         deadline = time.monotonic() + min(timeout, cfg.queue_timeout)
         with self._slot_free:
@@ -291,14 +312,14 @@ class WeightedGateway:
                         if cfg.max_inflight <= 0
                         or s.inflight < cfg.max_inflight]
                 if free:
-                    s, depth = self._select_locked(free, hashes)
+                    s, depth, eps = self._select_locked(free, hashes)
                     s.inflight += 1
                     self._note_pick_locked(s)
                     if depth > 0 and self.metrics is not None:
                         self.metrics.inc(
                             "tpu_gateway_prefix_cache_hits_total",
                             {"backend": s.service})
-                    return s
+                    return s, depth, eps
                 # All eligible backends saturated: queue or shed.
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
@@ -340,52 +361,91 @@ class WeightedGateway:
     def forward_ex(self, path: str, body: bytes, timeout: float = 300.0
                    ) -> Tuple[int, bytes, Dict[str, str]]:
         """forward() plus response headers the HTTP surface relays
-        (Retry-After on sheds)."""
+        (Retry-After on sheds, traceparent always)."""
         t0 = self._now()
         backend = "none"
+        ctx = self.tracer.start_request("serve-request", ts=t0, path=path)
         try:
             code, payload, backend, headers = self._forward(
-                path, body, timeout)
+                path, body, timeout, ctx)
         except _Overloaded as e:
             code = 429
             payload = json.dumps(
                 {"message": f"gateway overloaded ({e.reason}); retry "
                             f"after {self.config.retry_after:g}s"}).encode()
             headers = {"Retry-After": f"{self.config.retry_after:g}"}
+        if ctx is not None:
+            headers = dict(headers)
+            headers["traceparent"] = ctx.to_traceparent()
+            self.tracer.finish_request(
+                ctx, ts=self._now(),
+                status="ok" if code < 400 else "error",
+                error="" if code < 400 else f"http {code}")
         if self.metrics is not None:
             self.metrics.observe("tpu_serve_request_duration_seconds",
-                                 self._now() - t0, {"phase": "gateway"})
+                                 self._now() - t0, {"phase": "gateway"},
+                                 exemplar=ctx.trace_id if ctx else None)
             self.metrics.inc("tpu_gateway_requests_total",
                              {"backend": backend, "code": str(code)})
         return code, payload, headers
 
-    def _forward(self, path: str, body: bytes, timeout: float
+    def _forward(self, path: str, body: bytes, timeout: float, ctx=None
                  ) -> Tuple[int, bytes, str, Dict[str, str]]:
         prompt = self._prompt_tokens(body)
         hashes = block_hashes(prompt, self.config.block_size) \
             if prompt else []
         tried: List[str] = []
+        failed_svc = ""
         attempts = 2 if self.config.retry_connect else 1
         last_err: Optional[Exception] = None
         for _ in range(attempts):
-            s = self._acquire(hashes, timeout, exclude=tried)
-            if s is None:
+            q0 = self._now()
+            try:
+                picked = self._acquire(hashes, timeout, exclude=tried)
+            except _Overloaded as e:
+                self.tracer.record_span(
+                    ctx, "gateway-queue", q0, self._now(),
+                    status="error", error=f"shed: {e.reason}")
+                raise
+            if picked is None:
                 if tried:
                     break                  # every live backend was tried
                 return 503, json.dumps(
                     {"message": "no healthy backends in route"}).encode(), \
                     "none", {}
+            s, depth, eps = picked
+            q1 = self._now()
+            self.tracer.record_span(ctx, "gateway-queue", q0, q1)
+            self.tracer.record_span(
+                ctx, "route-decision", q1, q1, backend=s.service,
+                hit_depth=depth, queue_depth=s.queue_depth,
+                epsilon_fallback=eps)
+            if failed_svc and self.flight is not None:
+                self.flight.record(
+                    "Backend", self.namespace, s.service, "retry",
+                    f"failover from {failed_svc}")
+            f0 = self._now()
             try:
                 code, payload, resp_headers = self._request(
-                    s.url, path, body, timeout)
+                    s.url, path, body, timeout, trace_ctx=ctx)
             except (urllib.error.URLError, ConnectionError, OSError) as e:
                 # Connect/transport failure: this replica may be mid-
                 # replacement — retry ONCE on the next-best backend.
                 last_err = e
                 tried.append(s.url)
+                failed_svc = s.service
+                self.tracer.record_span(
+                    ctx, "forward", f0, self._now(), backend=s.service,
+                    status="error", error=f"connect: {e}")
+                if self.flight is not None:
+                    self.flight.record(
+                        "Backend", self.namespace, s.service, "exclude",
+                        f"connect-failure: {e}")
                 continue
             finally:
                 self._release(s)
+            self.tracer.record_span(ctx, "forward", f0, self._now(),
+                                    backend=s.service, code=code)
             self._observe_backend(s, resp_headers)
             if hashes and code < 500:
                 with self._lock:
@@ -403,10 +463,13 @@ class WeightedGateway:
         return "none"
 
     def _request(self, base_url: str, path: str, body: bytes,
-                 timeout: float) -> Tuple[int, bytes, Dict[str, str]]:
+                 timeout: float, trace_ctx=None
+                 ) -> Tuple[int, bytes, Dict[str, str]]:
+        headers = {"Content-Type": "application/json"}
+        if trace_ctx is not None:
+            headers["traceparent"] = trace_ctx.to_traceparent()
         req = urllib.request.Request(
-            base_url + path, data=body,
-            headers={"Content-Type": "application/json"})
+            base_url + path, data=body, headers=headers)
         try:
             with urllib.request.urlopen(req, timeout=timeout) as resp:
                 return resp.status, resp.read(), dict(resp.headers)

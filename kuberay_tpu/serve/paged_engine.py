@@ -50,7 +50,7 @@ class PagedServeEngine(ServeEngine):
                  kv_quant: str = "none", mesh=None,
                  weight_quant: str = "none",
                  donate_params: bool = False,
-                 metrics=None):
+                 metrics=None, tracer=None, clock=None):
         # Default pool = the dense engine's footprint; callers shrink it
         # to realize the memory win (e.g. slots * expected_len).
         num_blocks = num_blocks or (max_slots * max_len) // block_size
@@ -85,7 +85,8 @@ class PagedServeEngine(ServeEngine):
                          rng_seed=rng_seed, prefill_chunk=prefill_chunk,
                          speculative=speculative, kv_quant=kv_quant,
                          mesh=mesh, weight_quant=weight_quant,
-                         donate_params=donate_params, metrics=metrics)
+                         donate_params=donate_params, metrics=metrics,
+                         tracer=tracer, clock=clock)
         if weight_quant == "int8":
             # Paged kernels route through _paged_fwd (USES_BASE_FORWARD
             # False skipped the base wrap): dequantize outermost here.
@@ -278,12 +279,18 @@ class PagedServeEngine(ServeEngine):
                 self.owned[slot])
 
     def _admit(self, req: Request, slot: int):
+        a0 = self._now()
         reserved = self._reserve(req, slot)
         if reserved is None:
             return True                     # cancelled; slot stays free
         if reserved is False:
             return False                    # blocked on memory
         ncached = reserved
+        self._phase_mark(req.request_id, "admitted")
+        if req.trace is not None:
+            self._tracer.record_span(
+                req.trace, "kv-alloc", a0, self._now(),
+                cached_tokens=ncached, blocks=len(self.owned[slot]))
         plen = len(req.prompt_tokens)
         new_tokens = plen - ncached
 
@@ -301,11 +308,17 @@ class PagedServeEngine(ServeEngine):
     # -- chunked prefill over the block-table path ----------------------
 
     def _begin_chunked(self, req: Request, slot: int):
+        a0 = self._now()
         reserved = self._reserve(req, slot)
         if reserved is None:
             return None
         if reserved is False:
             return False
+        self._phase_mark(req.request_id, "admitted")
+        if req.trace is not None:
+            self._tracer.record_span(
+                req.trace, "kv-alloc", a0, self._now(),
+                cached_tokens=reserved, blocks=len(self.owned[slot]))
         # Blocks are fully reserved; start past the cache-served prefix
         # (the in-flight offset is absolute into the prompt).
         self._inflight = (req, slot, reserved)

@@ -26,6 +26,7 @@ import uuid
 from http.server import ThreadingHTTPServer
 from typing import Any, Dict, Optional
 
+from kuberay_tpu.obs.trace import TraceContext
 from kuberay_tpu.serve.engine import Request, Response, ServeEngine
 from kuberay_tpu.utils import constants as C
 from kuberay_tpu.utils.httpjson import JsonHandler
@@ -131,7 +132,7 @@ class ServeFrontend:
 
     def _admit(self, rid, ev, prompt_tokens, max_tokens, temperature,
                eos_token, stream_queue=None, top_p=1.0, top_k=0,
-               stop_token_ids=None) -> bool:
+               stop_token_ids=None, trace=None) -> bool:
         """Shared admission for blocking and streaming submits: one place
         for the degraded/backlog rejection invariants and stats."""
         with self._lock:
@@ -146,17 +147,20 @@ class ServeFrontend:
             self.engine.add_request(Request(
                 rid, list(prompt_tokens), max_new_tokens=max_tokens,
                 temperature=temperature, top_p=top_p, top_k=top_k,
-                eos_token=eos_token, stop_token_ids=stop_token_ids))
+                eos_token=eos_token, stop_token_ids=stop_token_ids,
+                trace=trace))
             return True
 
     def submit(self, prompt_tokens, max_tokens=64, temperature=0.0,
                eos_token=None, timeout: float = 300.0, top_p: float = 1.0,
-               top_k: int = 0, stop_token_ids=None) -> Optional[Response]:
+               top_k: int = 0, stop_token_ids=None,
+               trace=None) -> Optional[Response]:
         rid = uuid.uuid4().hex
         ev = threading.Event()
         if not self._admit(rid, ev, prompt_tokens, max_tokens,
                            temperature, eos_token, top_p=top_p,
-                           top_k=top_k, stop_token_ids=stop_token_ids):
+                           top_k=top_k, stop_token_ids=stop_token_ids,
+                           trace=trace):
             return None
         if not ev.wait(timeout):
             with self._lock:
@@ -182,7 +186,7 @@ class ServeFrontend:
     def submit_stream(self, prompt_tokens, max_tokens=64, temperature=0.0,
                       eos_token=None, timeout: float = 300.0,
                       top_p: float = 1.0, top_k: int = 0,
-                      stop_token_ids=None):
+                      stop_token_ids=None, trace=None):
         """Generator of token batches as the engine emits them, ending
         with a Response (or None on overload/degraded/timeout) — the
         vLLM-style streaming surface.  Tokens arrive per engine step:
@@ -197,7 +201,7 @@ class ServeFrontend:
         if not self._admit(rid, ev, prompt_tokens, max_tokens,
                            temperature, eos_token, stream_queue=q,
                            top_p=top_p, top_k=top_k,
-                           stop_token_ids=stop_token_ids):
+                           stop_token_ids=stop_token_ids, trace=trace):
             yield None
             return
         deadline = time.monotonic() + timeout
@@ -375,19 +379,30 @@ class ServeFrontend:
                     return self._send(400, {"message": "top_p must be in (0, 1]"})
                 if top_k < 0:
                     return self._send(400, {"message": "top_k must be >= 0"})
+                # Distributed tracing: adopt the gateway-minted trace
+                # context so the engine's child spans (engine-queue /
+                # prefill / decode / kv-alloc) land in the same trace,
+                # and echo it so direct-replica clients can follow up at
+                # /debug/traces too.
+                trace = TraceContext.from_traceparent(
+                    self.headers.get("traceparent"))
+                resp_headers = self._load_headers()
+                if trace is not None:
+                    resp_headers["traceparent"] = trace.to_traceparent()
                 if body.get("stream"):
                     return self._stream_completion(
                         prompt, max_tokens, temperature,
                         body.get("eos_token"), timeout, top_p, top_k,
-                        stop_ids)
+                        stop_ids, trace)
                 resp = frontend.submit(
                     prompt, max_tokens=max_tokens, temperature=temperature,
                     eos_token=body.get("eos_token"), timeout=timeout,
-                    top_p=top_p, top_k=top_k, stop_token_ids=stop_ids)
+                    top_p=top_p, top_k=top_k, stop_token_ids=stop_ids,
+                    trace=trace)
                 if resp is None:
                     return self._send(503,
                                       {"message": "overloaded or timed out"},
-                                      headers=self._load_headers())
+                                      headers=resp_headers)
                 return self._send(200, {
                     "id": resp.request_id,
                     "tokens": resp.tokens,
@@ -395,11 +410,11 @@ class ServeFrontend:
                     "prompt_len": resp.prompt_len,
                     "ttft_ms": (round(resp.ttft_s * 1e3, 3)
                                 if resp.ttft_s is not None else None),
-                }, headers=self._load_headers())
+                }, headers=resp_headers)
 
             def _stream_completion(self, prompt, max_tokens, temperature,
                                    eos_token, timeout, top_p=1.0, top_k=0,
-                                   stop_token_ids=None):
+                                   stop_token_ids=None, trace=None):
                 """Chunked NDJSON streaming ("stream": true): one
                 {"tokens": [...]} line per engine emission (singles for
                 plain decode, runs for accepted speculation), then a
@@ -412,7 +427,7 @@ class ServeFrontend:
                     prompt, max_tokens=max_tokens,
                     temperature=temperature, eos_token=eos_token,
                     timeout=timeout, top_p=top_p, top_k=top_k,
-                    stop_token_ids=stop_token_ids)
+                    stop_token_ids=stop_token_ids, trace=trace)
                 try:
                     first = next(gen)
                 except StopIteration:

@@ -73,6 +73,10 @@ def main(argv=None) -> int:
                              "implies --trace.  With a seed range, the "
                              "last run wins — use a single seed for "
                              "forensics")
+    parser.add_argument("--alerts", action="store_true",
+                        help="evaluate SLO burn-rate alerts each settle "
+                             "round (kuberay_tpu.obs.alerts); the replay "
+                             "hash is unaffected")
     parser.add_argument("--json", action="store_true",
                         help="one JSON result object per run on stdout")
     parser.add_argument("--list-scenarios", action="store_true")
@@ -110,7 +114,8 @@ def main(argv=None) -> int:
         scenario = get_scenario(name)
         steps = args.steps or scenario.default_steps
         for seed in seeds:
-            with SimHarness(seed, scenario=scenario, trace=trace) as h:
+            with SimHarness(seed, scenario=scenario, trace=trace,
+                            alerts=args.alerts) as h:
                 result = h.run(steps)
                 journal = list(h.journal)
                 trace_doc = h.export_trace() if trace else None

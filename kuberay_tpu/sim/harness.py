@@ -46,6 +46,7 @@ from kuberay_tpu.controlplane.warmpool_controller import (
     WarmSlicePoolController,
 )
 from kuberay_tpu.obs import (
+    AlertEngine,
     FlightRecorder,
     GoodputLedger,
     NOOP_TRACER,
@@ -119,6 +120,7 @@ class SimHarness:
                  max_settle_rounds: int = 400,
                  trace: bool = False,
                  goodput: bool = False,
+                 alerts: bool = False,
                  shards: Optional[int] = None):
         self.seed = seed
         self.scenario = scenario
@@ -167,6 +169,12 @@ class SimHarness:
         # replay-invariance contract tests/test_obs_trace.py enforces.
         self.tracer = Tracer(clock=self.clock) if trace else NOOP_TRACER
         self.flight = FlightRecorder(clock=self.clock) if trace else None
+        # SLO burn-rate alerting (obs.alerts): observational only — it
+        # reads metric snapshots and the virtual clock, never the store
+        # or rng, so the journal hash is byte-identical with the engine
+        # on or off (the invariance contract in tests/test_alerts.py).
+        self.alerts = (AlertEngine(self.metrics.registry, clock=self.clock)
+                       if alerts else None)
         # Goodput ledger (obs.goodput): observational only — it reads
         # watch events and the virtual clock, never the store or rng, so
         # the journal hash is byte-identical with the ledger on or off
@@ -309,6 +317,7 @@ class SimHarness:
             "events": list(self.journal),
             "flight": self.flight.to_dict() if self.flight else {},
             "goodput": self.goodput.to_dict() if self.goodput else {},
+            "alerts": self.alerts.to_dict() if self.alerts else {},
         }
 
     # -- convergence -------------------------------------------------------
@@ -342,6 +351,8 @@ class SimHarness:
             drove = self._drive_serve_apps()
             swept = self._gc_orphans()
             self._drain_journal()
+            if self.alerts is not None:
+                self.alerts.evaluate()
             if len(self.journal) > journal_before or due or drove or swept:
                 resynced = False
                 continue

@@ -14,6 +14,7 @@ raycluster_controller.go:125 cleanup on delete).
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 _BUCKETS = (0.5, 1, 2, 5, 10, 30, 60, 120, 300, 600, 1800, float("inf"))
@@ -33,10 +34,16 @@ class Histogram:
     def __init__(self, buckets=_BUCKETS):
         self.buckets = buckets
         self.counts = [0] * len(buckets)
+        # exemplars[i]: latest (trace_id, value, ts) observed into bucket
+        # i — OpenMetrics links a histogram bucket to one inspectable
+        # trace (rendered only when set; plain Prometheus renders clean).
+        self.exemplars: List[Optional[Tuple[str, float, float]]] = \
+            [None] * len(buckets)
         self.total = 0.0
         self.n = 0
 
-    def observe(self, v: float):
+    def observe(self, v: float, exemplar: Optional[str] = None,
+                exemplar_ts: Optional[float] = None):
         self.n += 1
         self.total += v
         # counts[i] holds observations landing in bucket i alone; render()
@@ -44,6 +51,8 @@ class Histogram:
         for i, b in enumerate(self.buckets):
             if v <= b:
                 self.counts[i] += 1
+                if exemplar is not None:
+                    self.exemplars[i] = (exemplar, v, exemplar_ts)
                 break
 
 
@@ -74,14 +83,22 @@ class MetricsRegistry:
 
     def observe(self, name: str, value: float,
                 labels: Optional[Dict[str, str]] = None,
-                buckets: Optional[Tuple] = None):
+                buckets: Optional[Tuple] = None,
+                exemplar: Optional[str] = None,
+                exemplar_ts: Optional[float] = None):
         """``buckets`` applies on first observation of a series only (a
-        histogram's buckets are fixed for its lifetime)."""
+        histogram's buckets are fixed for its lifetime).  ``exemplar`` is
+        a trace id attached to the bucket this observation lands in,
+        rendered as an OpenMetrics exemplar so a p99 bucket links to an
+        inspectable trace at /debug/traces?trace_id=."""
         with self._lock:
             key = (name, self._labels_key(labels))
             if key not in self._hists:
                 self._hists[key] = Histogram(buckets or _BUCKETS)
-            self._hists[key].observe(value)
+            if exemplar is not None and exemplar_ts is None:
+                exemplar_ts = time.time()
+            self._hists[key].observe(value, exemplar=exemplar,
+                                     exemplar_ts=exemplar_ts)
 
     def histogram_snapshot(self, name: str,
                            labels: Optional[Dict[str, str]] = None
@@ -95,7 +112,30 @@ class MetricsRegistry:
             if h is None:
                 return None
             return {"buckets": list(h.buckets), "counts": list(h.counts),
-                    "n": h.n, "sum": h.total}
+                    "n": h.n, "sum": h.total,
+                    "exemplars": list(h.exemplars)}
+
+    def family_snapshot(self, name: str
+                        ) -> List[Tuple[Dict[str, str], float]]:
+        """All (labels, value) series of a counter or gauge family — the
+        read seam the SLO alert engine's availability/goodput specs sum
+        over (obs/alerts.py)."""
+        out: List[Tuple[Dict[str, str], float]] = []
+        with self._lock:
+            for d in (self._counters, self._gauges):
+                for (n, labels), v in d.items():
+                    if n == name:
+                        out.append((dict(labels), v))
+        return out
+
+    def histogram_names(self, prefix: str = "") -> List[str]:
+        """Distinct histogram family names (optionally prefix-filtered)."""
+        with self._lock:
+            seen: Dict[str, None] = {}
+            for (n, _labels) in self._hists:
+                if n.startswith(prefix):
+                    seen.setdefault(n, None)
+        return list(seen)
 
     def drop_labeled(self, label_key: str, label_value: str):
         """Remove every series carrying label=value (CR deletion cleanup)."""
@@ -152,12 +192,20 @@ class MetricsRegistry:
             for (name, labels), h in sorted(self._hists.items()):
                 header(name, "histogram")
                 cum = 0
-                for b, c in zip(h.buckets, h.counts):
+                for i, (b, c) in enumerate(zip(h.buckets, h.counts)):
                     cum += c
                     le = "+Inf" if b == float("inf") else str(b)
                     le_label = 'le="%s"' % le
-                    lines.append(
-                        f"{name}_bucket{self._fmt_labels(labels, le_label)} {cum}")
+                    line = (f"{name}_bucket"
+                            f"{self._fmt_labels(labels, le_label)} {cum}")
+                    ex = h.exemplars[i]
+                    if ex is not None:
+                        # OpenMetrics exemplar: attached to the le-line of
+                        # the bucket the observation landed in.
+                        tid, val, ts = ex
+                        line += (' # {trace_id="%s"} %s %s'
+                                 % (self._escape_label_value(tid), val, ts))
+                    lines.append(line)
                 lines.append(f"{name}_sum{self._fmt_labels(labels)} {h.total}")
                 lines.append(f"{name}_count{self._fmt_labels(labels)} {h.n}")
         return "\n".join(lines) + "\n"

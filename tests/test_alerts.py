@@ -1,0 +1,272 @@
+"""SLO burn-rate alert engine gate (kuberay_tpu.obs.alerts): scripted
+breaches under a virtual clock fire at EXACT virtual times and clear
+when the breaching events age out of their window, the latency/
+availability/gauge-floor readers count the right events, alerts
+cross-link to trace exemplars and flight rings, the history ring is
+bounded, /debug/alerts serves (and 404s when absent), and evaluating
+under simulation leaves the replay hash byte-identical — the same
+observational contract the tracer obeys.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kuberay_tpu.controlplane.store import ObjectStore
+from kuberay_tpu.obs.alerts import AlertEngine, SloSpec, default_slos
+from kuberay_tpu.sim.clock import VirtualClock
+from kuberay_tpu.sim.harness import SimHarness
+from kuberay_tpu.sim.scenarios import get_scenario
+from kuberay_tpu.utils.metrics import MetricsRegistry
+
+TTFT_BUCKETS = (0.25, 0.5, 1.0, 2.0)
+
+
+def _ttft_spec(**overrides):
+    base = dict(name="serve-ttft", kind="latency",
+                metric="tpu_serve_request_duration_seconds",
+                labels=(("phase", "ttft"),), threshold_s=0.5,
+                objective=0.99)
+    base.update(overrides)
+    return SloSpec(**base)
+
+
+def _observe_ttft(reg, value, n, exemplar=None, exemplar_ts=None):
+    for _ in range(n):
+        reg.observe("tpu_serve_request_duration_seconds", value,
+                    {"phase": "ttft"}, buckets=TTFT_BUCKETS,
+                    exemplar=exemplar, exemplar_ts=exemplar_ts)
+
+
+# ---------------------------------------------------------------------------
+# the scripted-breach acceptance: exact fire and clear times
+# ---------------------------------------------------------------------------
+
+def test_fast_burn_fires_once_at_exact_time_and_clears_on_window():
+    """A scripted TTFT breach: the fast-window alert fires exactly once
+    at the breach's evaluation instant, stays ONE alert while burning,
+    and resolves at the first evaluation after the bad events age past
+    the fast window — all in exact virtual time."""
+    clock = VirtualClock(start=0.0)
+    reg = MetricsRegistry()
+    eng = AlertEngine(reg, specs=[_ttft_spec()], clock=clock)
+
+    _observe_ttft(reg, 0.1, 6)                       # healthy baseline
+    assert eng.evaluate() == []                      # t=0
+
+    clock.advance(10.0)                              # t=10: the breach
+    _observe_ttft(reg, 1.0, 5)
+    fired = eng.evaluate()
+    fast = [a for a in fired if a["window"] == "fast"]
+    assert len(fast) == 1
+    alert = fast[0]
+    assert alert["name"] == "serve-ttft"
+    assert alert["state"] == "firing"
+    assert alert["since"] == 10.0                    # the exact instant
+    # 5 bad of 5 new events against a 1% budget: burn rate 100.
+    assert alert["burn_rate"] == pytest.approx(100.0)
+    assert alert["bad"] == 5 and alert["total"] == 5
+    # The same breach saturates the slow window too (burn 100 >= 6).
+    assert {a["window"] for a in fired} == {"fast", "slow"}
+
+    clock.advance(10.0)                              # t=20: still burning
+    assert eng.evaluate() == []                      # no re-fire
+    assert len([a for a in eng.active()
+                if a["window"] == "fast"]) == 1
+
+    clock.advance(380.0)                             # t=400: bad events
+    assert eng.evaluate() == []                      # aged out of 300s
+    active_windows = {a["window"] for a in eng.active()}
+    assert "fast" not in active_windows              # fast resolved...
+    assert "slow" in active_windows                  # ...slow still burns
+    resolved = [r for r in eng.to_dict()["ring"]
+                if r["state"] == "resolved" and r["window"] == "fast"]
+    assert len(resolved) == 1
+    assert resolved[0]["resolved_at"] == 400.0       # the exact instant
+
+    clock.advance(3600.0)                            # t=4000: slow window
+    eng.evaluate()                                   # drained too
+    assert eng.active() == []
+    states = [(r["window"], r["state"]) for r in eng.to_dict()["ring"]]
+    assert states == [("fast", "firing"), ("slow", "firing"),
+                      ("fast", "resolved"), ("slow", "resolved")]
+
+
+def test_min_samples_guard_never_fires_on_thin_data():
+    clock = VirtualClock(start=0.0)
+    reg = MetricsRegistry()
+    eng = AlertEngine(reg, specs=[_ttft_spec()], clock=clock)
+    _observe_ttft(reg, 2.0, 3)                       # 100% bad, but 3 < 5
+    for _ in range(4):
+        eng.evaluate()
+        clock.advance(30.0)
+    assert eng.active() == [] and eng.to_dict()["ring"] == []
+
+
+# ---------------------------------------------------------------------------
+# the other spec kinds
+# ---------------------------------------------------------------------------
+
+def test_availability_counts_sheds_and_5xx_against_total():
+    clock = VirtualClock(start=0.0)
+    reg = MetricsRegistry()
+    spec = SloSpec(name="serve-availability", kind="availability",
+                   total_family="tpu_gateway_requests_total",
+                   bad_families=("tpu_gateway_shed_total",),
+                   objective=0.99)
+    eng = AlertEngine(reg, specs=[spec], clock=clock)
+    for _ in range(20):
+        reg.inc("tpu_gateway_requests_total",
+                {"backend": "a", "code": "200"})
+    assert eng.evaluate() == []                      # baseline sample
+
+    clock.advance(10.0)
+    for _ in range(5):
+        reg.inc("tpu_gateway_requests_total",
+                {"backend": "a", "code": "200"})
+    for _ in range(2):
+        reg.inc("tpu_gateway_requests_total",
+                {"backend": "a", "code": "500"})
+    for _ in range(3):
+        reg.inc("tpu_gateway_shed_total", {"reason": "queue_full"})
+    fired = eng.evaluate()
+    fast = [a for a in fired if a["window"] == "fast"]
+    assert len(fast) == 1
+    # 5 bad (2 x 5xx + 3 sheds) over 7 new requests, 1% budget.
+    assert fast[0]["bad"] == 5 and fast[0]["total"] == 7
+    assert fast[0]["burn_rate"] == pytest.approx((5 / 7) / 0.01, rel=1e-3)
+
+
+def test_gauge_floor_fires_slow_window_with_flight_link():
+    """The stock goodput-ratio spec (objective 0.9) tops out at burn 10
+    — below the fast threshold (14), above the slow one (6): a starved
+    CR pages through the slow window only, linking to its flight ring."""
+    clock = VirtualClock(start=0.0)
+    reg = MetricsRegistry()
+    spec = [s for s in default_slos() if s.name == "goodput-ratio"][0]
+    eng = AlertEngine(reg, specs=[spec], clock=clock)
+    labels = {"kind": "TpuCluster", "namespace": "default", "name": "demo"}
+    reg.set_gauge("tpu_goodput_ratio", 0.2, labels)
+    fired = []
+    for _ in range(7):
+        fired.extend(eng.evaluate())
+        clock.advance(10.0)
+    assert len(fired) == 1
+    alert = fired[0]
+    assert alert["window"] == "slow"                 # fast can't trigger
+    assert alert["since"] == 50.0                    # 6th tick: 5 deltas
+    assert alert["links"]["flight"] == \
+        "/debug/flight/TpuCluster/default/demo"
+
+    reg.set_gauge("tpu_goodput_ratio", 0.95, labels)     # recovery
+    clock.advance(3700.0)
+    eng.evaluate()
+    assert eng.active() == []
+
+
+def test_latency_alert_links_to_offending_exemplar_trace():
+    clock = VirtualClock(start=0.0)
+    reg = MetricsRegistry()
+    eng = AlertEngine(reg, specs=[_ttft_spec()], clock=clock,
+                      audit=object())
+    _observe_ttft(reg, 0.1, 5)
+    eng.evaluate()
+    clock.advance(10.0)
+    _observe_ttft(reg, 1.5, 5, exemplar="t000777", exemplar_ts=10.0)
+    fired = eng.evaluate()
+    links = [a for a in fired if a["window"] == "fast"][0]["links"]
+    assert links["trace"] == "/debug/traces?trace_id=t000777"
+    assert links["autoscaler"] == "/debug/autoscaler"
+
+
+def test_alert_ring_is_bounded():
+    clock = VirtualClock(start=0.0)
+    reg = MetricsRegistry()
+    # Identical windows so each flap is exactly one fire + one resolve
+    # per window and the flap count is easy to reason about.
+    spec = _ttft_spec(slow_window_s=300.0, slow_burn=14.0)
+    eng = AlertEngine(reg, specs=[spec], clock=clock, capacity=4)
+    _observe_ttft(reg, 0.1, 5)
+    eng.evaluate()
+    for _ in range(5):                               # 5 flaps, 4/flap
+        clock.advance(10.0)
+        _observe_ttft(reg, 1.0, 5)
+        eng.evaluate()
+        clock.advance(400.0)
+        eng.evaluate()
+    doc = eng.to_dict()
+    assert len(doc["ring"]) == 4                     # capacity, not 20
+    assert doc["evaluations"] == 11
+
+
+# ---------------------------------------------------------------------------
+# serving surface
+# ---------------------------------------------------------------------------
+
+def test_debug_alerts_endpoint_serves_and_404s_when_absent():
+    from kuberay_tpu.apiserver.server import serve_background
+    clock = VirtualClock(start=0.0)
+    reg = MetricsRegistry()
+    eng = AlertEngine(reg, specs=[_ttft_spec()], clock=clock)
+    _observe_ttft(reg, 0.1, 5)
+    eng.evaluate()
+    clock.advance(10.0)
+    _observe_ttft(reg, 1.0, 5)
+    eng.evaluate()
+    srv, url = serve_background(ObjectStore(), alerts=eng)
+    try:
+        with urllib.request.urlopen(f"{url}/debug/alerts") as resp:
+            doc = json.load(resp)
+        assert [a["name"] for a in doc["active"]] == \
+            ["serve-ttft", "serve-ttft"]             # fast + slow
+        assert doc["ring"] and doc["evaluations"] == 2
+        assert doc["specs"][0]["fast"] == {"window_s": 300.0, "burn": 14.0}
+    finally:
+        srv.shutdown()
+    srv, url = serve_background(ObjectStore())       # no engine mounted
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{url}/debug/alerts")
+        assert ei.value.code == 404
+    finally:
+        srv.shutdown()
+
+
+def test_operator_mounts_alert_engine_with_stock_catalog():
+    from kuberay_tpu.operator import Operator
+    op = Operator(fake_kubelet=True)
+    url = op.start(api_port=0)
+    try:
+        assert isinstance(op.alerts, AlertEngine)
+        with urllib.request.urlopen(f"{url}/debug/alerts") as resp:
+            doc = json.load(resp)
+        assert {s["name"] for s in doc["specs"]} == {
+            "serve-ttft", "serve-availability", "goodput-ratio"}
+        assert doc["active"] == []                   # healthy at boot
+    finally:
+        op.stop()
+
+
+# ---------------------------------------------------------------------------
+# observational invariance under simulation
+# ---------------------------------------------------------------------------
+
+@pytest.mark.timeout(300)
+def test_sim_replay_hash_invariant_with_tracing_and_alerting():
+    """The acceptance contract: enabling tracing AND alerting changes
+    nothing about a chaos replay — journal hashes stay byte-identical,
+    while the alert engine demonstrably evaluated."""
+    with SimHarness(0, scenario=get_scenario("rolling-upgrade"),
+                    trace=True, alerts=True) as h:
+        observed = h.run(3)
+        assert h.alerts is not None and h.alerts.evaluations > 0
+        export = h.export_trace()
+    with SimHarness(0, scenario=get_scenario("rolling-upgrade")) as h:
+        plain = h.run(3)
+    assert observed.ok and plain.ok
+    assert observed.journal_hash == plain.journal_hash
+    assert observed.journal_len == plain.journal_len
+    assert "active" in export["alerts"]              # artifact carries it
+    json.dumps(export)                               # JSON-serializable

@@ -163,6 +163,64 @@ def test_gateway_counter_families_render_golden():
         assert f"# HELP {family} " in text
 
 
+def test_exemplar_on_landing_bucket_golden():
+    """OpenMetrics exemplar: rides the cumulative le-line of exactly the
+    bucket the observation landed in, as ``# {trace_id="..."} value ts``
+    — so a p99 bucket links to one inspectable trace at
+    /debug/traces?trace_id=."""
+    r = MetricsRegistry()
+    r.observe("tpu_test_latency_seconds", 0.2)
+    r.observe("tpu_test_latency_seconds", 0.7, exemplar="t000042",
+              exemplar_ts=123.5)
+    lines = r.render().splitlines()
+    # The 0.2 observation carried no exemplar: its bucket renders plain.
+    assert 'tpu_test_latency_seconds_bucket{le="0.5"} 1' in lines
+    # The 0.7 observation landed in le="1"; the exemplar rides that line
+    # (raw observed value + timestamp, not the cumulative count).
+    assert ('tpu_test_latency_seconds_bucket{le="1"} 2 '
+            '# {trace_id="t000042"} 0.7 123.5') in lines
+    # Later cumulative buckets count it but do NOT repeat the exemplar.
+    assert 'tpu_test_latency_seconds_bucket{le="+Inf"} 2' in lines
+    assert sum(1 for ln in lines if "# {trace_id=" in ln) == 1
+
+
+def test_exemplar_trace_id_escaped_like_label_values():
+    r = MetricsRegistry()
+    r.observe("tpu_test_seconds", 0.1, exemplar='t"1\\2', exemplar_ts=1.0)
+    text = r.render()
+    # Same escaping contract as label values: backslash first, then quote.
+    assert '# {trace_id="t\\"1\\\\2"} 0.1 1.0' in text
+
+
+def test_exemplar_latest_observation_wins_per_bucket():
+    r = MetricsRegistry()
+    r.observe("tpu_test_seconds", 0.1, exemplar="t000001", exemplar_ts=1.0)
+    r.observe("tpu_test_seconds", 0.2, exemplar="t000002", exemplar_ts=2.0)
+    text = r.render()
+    assert "t000001" not in text
+    assert '# {trace_id="t000002"} 0.2 2.0' in text
+    # An exemplar-less observation into the same bucket keeps the stored
+    # exemplar (untraced traffic must not blank the trace link).
+    r.observe("tpu_test_seconds", 0.3)
+    assert '# {trace_id="t000002"} 0.2 2.0' in r.render()
+
+
+def test_plain_render_unchanged_without_exemplars():
+    """A registry that never receives an exemplar renders classic
+    Prometheus text — no OpenMetrics suffix on any sample line, so
+    pre-exemplar scrapers parse it untouched."""
+    r = MetricsRegistry()
+    r.inc("tpu_test_total", {"code": "200"})
+    r.set_gauge("tpu_test_depth", 3)
+    r.observe("tpu_test_seconds", 0.2)
+    r.observe("tpu_test_seconds", 0.7)
+    text = r.render()
+    assert "# {" not in text
+    for line in text.splitlines():
+        if not line.startswith("#"):
+            assert " # " not in line, line
+
+
 def test_histogram_snapshot_reads_one_series():
     from kuberay_tpu.utils.metrics import SERVE_LATENCY_BUCKETS
 

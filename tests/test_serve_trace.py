@@ -1,0 +1,354 @@
+"""Serve request tracing gate (kuberay_tpu.obs + serve): traceparent
+propagation, explicit-context span recording, virtual-clock exactness
+— the gateway-queue/route-decision/forward and engine-queue/kv-alloc/
+prefill/decode children union-cover the measured latencies exactly
+under an injected clock — tail-sampling retention, backend lifecycle
+flight records, and the end-to-end HTTP contract: one trace id on the
+response header resolves to a tree holding BOTH gateway and engine
+spans.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from kuberay_tpu.controlplane.store import ObjectStore
+from kuberay_tpu.obs import FlightRecorder, NOOP_TRACER, Tracer, span_tree
+from kuberay_tpu.obs.trace import Span, SpanStore, TraceContext
+from kuberay_tpu.sim.clock import VirtualClock
+from kuberay_tpu.utils.metrics import MetricsRegistry
+
+
+def _route_obj(name, backends, namespace="default"):
+    return {"apiVersion": "tpu.dev/v1", "kind": "TrafficRoute",
+            "metadata": {"name": name, "namespace": namespace},
+            "spec": {"backends": backends}, "status": {}}
+
+
+# ---------------------------------------------------------------------------
+# traceparent propagation
+# ---------------------------------------------------------------------------
+
+def test_traceparent_round_trip():
+    ctx = TraceContext("t000001", "s000002")
+    header = ctx.to_traceparent()
+    assert header == "00-t000001-s000002-01"
+    back = TraceContext.from_traceparent(header)
+    assert back.trace_id == "t000001" and back.span_id == "s000002"
+
+
+def test_traceparent_malformed_headers_yield_none():
+    bad = [None, "", "garbage", "00-a-b",          # wrong shape
+           "01-t000001-s000002-01",                # unknown version
+           "00--s000002-01", "00-t000001--01"]     # empty ids
+    for header in bad:
+        assert TraceContext.from_traceparent(header) is None, header
+
+
+def test_noop_tracer_serve_api_is_silent():
+    t = NOOP_TRACER
+    assert t.start_request("serve-request") is None
+    t.record_span(None, "forward", 0.0, 1.0)
+    t.finish_request(None)
+    assert t.export() == []
+
+
+# ---------------------------------------------------------------------------
+# explicit-context request spans
+# ---------------------------------------------------------------------------
+
+def test_request_root_and_explicit_children_virtual_clock():
+    clock = VirtualClock(start=100.0)
+    tracer = Tracer(clock=clock)
+    ctx = tracer.start_request("serve-request", path="/v1/completions")
+    tracer.record_span(ctx, "gateway-queue", 100.0, 101.0)
+    tracer.record_span(ctx, "forward", 101.0, 104.0, backend="replica-0")
+    clock.advance(5.0)
+    tracer.finish_request(ctx, status="error", error="http 503")
+    spans = tracer.export(ctx.trace_id)
+    by_name = {s["name"]: s for s in spans}
+    root = by_name["serve-request"]
+    assert root["parent_id"] == ""
+    assert root["attrs"]["path"] == "/v1/completions"
+    assert root["start"] == 100.0 and root["end"] == 105.0
+    assert root["status"] == "error" and root["error"] == "http 503"
+    for child in ("gateway-queue", "forward"):
+        assert by_name[child]["parent_id"] == root["span_id"]
+    # finish_request is idempotent: a second finish cannot shrink or
+    # re-status the already-closed root.
+    clock.advance(50.0)
+    tracer.finish_request(ctx)
+    root2 = [s for s in tracer.export(ctx.trace_id)
+             if s["name"] == "serve-request"][0]
+    assert root2["end"] == 105.0 and root2["status"] == "error"
+
+
+def test_span_store_tail_sampling_keeps_interesting_spans():
+    store = SpanStore(max_spans=16)
+    for i in range(20):
+        store.add(Span("t1", f"s-warm{i}", "", "fast", 0.0, 0.01))
+    store.add(Span("t1", "s-slow1", "", "slow", 0.0, 5.0))
+    store.add(Span("t1", "s-slow2", "", "slow", 0.0, 6.0))
+    store.add(Span("t1", "s-err", "", "boom", 0.0, 0.1,
+                   status="error", error="x"))
+    store.add(Span("t1", "s-open", "", "open", start=0.0))        # open
+    for i in range(10):
+        store.add(Span("t1", f"s-fast{i}", "", "fast", 0.0, 0.01))
+    assert len(store) == 16
+    assert store.dropped == 18
+    kept = {s["span_id"] for s in store.export()}
+    # Fast successful spans are shed first: the open span, the error
+    # span and the slowest spans all survive the churn.
+    assert {"s-open", "s-err", "s-slow1", "s-slow2"} <= kept
+
+
+# ---------------------------------------------------------------------------
+# gateway spans under a virtual clock
+# ---------------------------------------------------------------------------
+
+def test_gateway_503_still_mints_trace_and_traceparent():
+    from kuberay_tpu.serve.gateway import WeightedGateway
+    clock = VirtualClock(start=0.0)
+    tracer = Tracer(clock=clock)
+    gw = WeightedGateway(ObjectStore(), "route", poll_interval=30.0,
+                         tracer=tracer, clock=clock)
+    try:
+        code, _, hdrs = gw.forward_ex("/v1/completions", b"{}")
+        assert code == 503
+        ctx = TraceContext.from_traceparent(hdrs["traceparent"])
+        assert ctx is not None
+        root = [s for s in tracer.export(ctx.trace_id)
+                if s["name"] == "serve-request"][0]
+        assert root["status"] == "error" and "503" in root["error"]
+    finally:
+        gw.stop()
+
+
+def test_gateway_spans_virtual_clock_exactness():
+    """The forward span measures exactly the backend round-trip in
+    virtual time, and the serve-request root covers its children."""
+    from kuberay_tpu.serve.gateway import WeightedGateway
+    clock = VirtualClock(start=200.0)
+    tracer = Tracer(clock=clock)
+    store = ObjectStore()
+    store.create(_route_obj("route",
+                            [{"service": "replica-0", "weight": 1}]))
+    gw = WeightedGateway(store, "route",
+                         resolver=lambda s: f"http://{s}.test:1",
+                         poll_interval=30.0, tracer=tracer, clock=clock)
+
+    def fake_request(base_url, path, body, timeout, trace_ctx=None):
+        assert trace_ctx is not None          # header crosses the hop
+        clock.advance(3.0)
+        return 200, b"{}", {}
+
+    gw._request = fake_request
+    try:
+        code, _, hdrs = gw.forward_ex("/v1/completions", b"{}")
+        assert code == 200
+        trace_id = hdrs["traceparent"].split("-")[1]
+        by_name = {s["name"]: s for s in tracer.export(trace_id)}
+        root = by_name["serve-request"]
+        fwd = by_name["forward"]
+        route = by_name["route-decision"]
+        assert by_name["gateway-queue"]["parent_id"] == root["span_id"]
+        assert fwd["end"] - fwd["start"] == pytest.approx(3.0)
+        assert fwd["attrs"]["code"] == 200
+        assert route["attrs"]["backend"] == "replica-0"
+        assert root["start"] == 200.0
+        assert root["end"] == pytest.approx(203.0)
+        # Children live inside the root window — the trace decomposes
+        # the request wall-clock with no span leaking outside it.
+        for s in by_name.values():
+            assert s["start"] >= root["start"] - 1e-9
+            assert s["end"] <= root["end"] + 1e-9
+    finally:
+        gw.stop()
+
+
+def test_gateway_flight_records_weight_exclude_retry():
+    """Backend lifecycle lands in the flight recorder keyed
+    ("Backend", ns, service): weight steps at route sync, exclusion on
+    connect failure, retry-failover on the replacement pick."""
+    from kuberay_tpu.serve.gateway import WeightedGateway
+    store = ObjectStore()
+    store.create(_route_obj("route", [{"service": "a", "weight": 1},
+                                      {"service": "b", "weight": 2}]))
+    flight = FlightRecorder()
+    gw = WeightedGateway(store, "route",
+                         resolver=lambda s: f"http://{s}.test:1",
+                         poll_interval=30.0, flight=flight)
+
+    def dead_request(base_url, path, body, timeout, trace_ctx=None):
+        raise ConnectionError("refused")
+
+    gw._request = dead_request
+    try:
+        for svc, weight in (("a", 1), ("b", 2)):
+            recs = flight.timeline("Backend", "default", svc)
+            assert any(r["type"] == "weight"
+                       and r["detail"] == f"0 -> {weight}"
+                       for r in recs), recs
+        code, _, _ = gw.forward_ex("/v1/completions", b"{}", timeout=1.0)
+        assert code == 502
+        all_recs = (flight.timeline("Backend", "default", "a")
+                    + flight.timeline("Backend", "default", "b"))
+        excludes = [r for r in all_recs if r["type"] == "exclude"]
+        retries = [r for r in all_recs if r["type"] == "retry"]
+        assert len(excludes) == 2                # both backends tried+failed
+        assert len(retries) == 1                 # one failover hop
+        assert "failover from" in retries[0]["detail"]
+    finally:
+        gw.stop()
+
+
+# ---------------------------------------------------------------------------
+# engine spans: virtual-clock exactness (the acceptance contract)
+# ---------------------------------------------------------------------------
+
+def _union_length(intervals):
+    total, cur = 0.0, None
+    for a, b in sorted(i for i in intervals if i[1] > i[0]):
+        if cur is None or a > cur[1]:
+            if cur is not None:
+                total += cur[1] - cur[0]
+            cur = [a, b]
+        else:
+            cur[1] = max(cur[1], b)
+    if cur is not None:
+        total += cur[1] - cur[0]
+    return total
+
+
+@pytest.mark.timeout(300)
+def test_engine_spans_union_cover_ttft_exactly_virtual_clock():
+    """Under an injected clock, engine-queue + prefill union-cover the
+    TTFT observation EXACTLY, and the histogram exemplar carries the
+    request's trace id stamped at the same instant the prefill span
+    ends — one consistent story across spans, metric and exemplar."""
+    import jax
+
+    from kuberay_tpu.models import llama
+    from kuberay_tpu.serve.engine import Request, ServeEngine
+
+    cfg = llama.CONFIGS["llama_tiny"]
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    clock = VirtualClock(start=1000.0)
+    tracer = Tracer(clock=clock)
+    reg = MetricsRegistry()
+    engine = ServeEngine(cfg, params, max_slots=1, max_len=32,
+                         metrics=reg, tracer=tracer, clock=clock)
+    ctx = tracer.start_request("serve-request")
+    engine.add_request(Request("r1", [1, 2, 3], max_new_tokens=3,
+                               trace=ctx))
+    clock.advance(2.0)                       # the whole queue wait
+    engine.run()
+    tracer.finish_request(ctx)
+
+    by_name = {s["name"]: s for s in tracer.export(ctx.trace_id)}
+    qspan, pspan, dspan = (by_name["engine-queue"], by_name["prefill"],
+                           by_name["decode"])
+    assert qspan["start"] == 1000.0 and qspan["end"] == 1002.0
+    assert pspan["start"] == 1002.0 and pspan["end"] == 1002.0
+    assert dspan["start"] == 1002.0          # decode begins at first token
+    assert pspan["attrs"]["prompt_len"] == 3
+    assert dspan["attrs"]["tokens"] >= 1
+
+    snap = reg.histogram_snapshot("tpu_serve_request_duration_seconds",
+                                  {"phase": "ttft"})
+    assert snap["n"] == 1
+    ttft = snap["sum"]
+    assert ttft == pytest.approx(2.0)
+    covered = _union_length([(qspan["start"], qspan["end"]),
+                             (pspan["start"], pspan["end"])])
+    assert covered == pytest.approx(ttft, abs=1e-9)
+    # The exemplar on the landing bucket: this trace, stamped at the
+    # prefill span's end (= the first-token instant).
+    exemplars = [e for e in snap["exemplars"] if e is not None]
+    assert exemplars == [(ctx.trace_id, pytest.approx(2.0), 1002.0)]
+
+
+@pytest.mark.timeout(300)
+def test_paged_engine_adds_kv_alloc_span():
+    import jax
+
+    from kuberay_tpu.models import llama
+    from kuberay_tpu.serve.engine import Request
+    from kuberay_tpu.serve.paged_engine import PagedServeEngine
+
+    cfg = llama.CONFIGS["llama_tiny"]
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    clock = VirtualClock(start=0.0)
+    tracer = Tracer(clock=clock)
+    engine = PagedServeEngine(cfg, params, max_slots=1, max_len=48,
+                              block_size=16, tracer=tracer, clock=clock)
+    ctx = tracer.start_request("serve-request")
+    engine.add_request(Request("r1", [1, 2, 3, 4], max_new_tokens=2,
+                               trace=ctx))
+    engine.run()
+    tracer.finish_request(ctx)
+    by_name = {s["name"]: s for s in tracer.export(ctx.trace_id)}
+    assert {"engine-queue", "kv-alloc", "prefill", "decode"} <= \
+        set(by_name)
+    kv = by_name["kv-alloc"]
+    assert kv["parent_id"] == ctx.span_id
+    assert kv["attrs"]["blocks"] >= 1
+    assert kv["attrs"]["cached_tokens"] == 0
+
+
+# ---------------------------------------------------------------------------
+# end to end over real HTTP: gateway -> replica -> engine, one trace
+# ---------------------------------------------------------------------------
+
+@pytest.mark.timeout(300)
+def test_end_to_end_http_trace_union():
+    """The tentpole contract: a completion through gateway + replica
+    sharing one tracer yields ONE trace whose response traceparent
+    resolves to gateway spans AND engine spans, all parented under the
+    serve-request root and contained in its window."""
+    import jax
+
+    from kuberay_tpu.models import llama
+    from kuberay_tpu.serve.gateway import WeightedGateway
+    from kuberay_tpu.serve.paged_engine import PagedServeEngine
+    from kuberay_tpu.serve.server import ServeFrontend
+
+    cfg = llama.CONFIGS["llama_tiny"]
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    tracer = Tracer(max_spans=4096)
+    eng = PagedServeEngine(cfg, params, max_slots=2, max_len=48,
+                           block_size=16, tracer=tracer)
+    fe = ServeFrontend(eng, max_queue=8)
+    srv, replica_url = fe.serve_background()
+    store = ObjectStore()
+    store.create(_route_obj("route",
+                            [{"service": "replica-0", "weight": 1}]))
+    gw = WeightedGateway(store, "route", resolver=lambda s: replica_url,
+                         poll_interval=30.0, tracer=tracer)
+    try:
+        body = json.dumps({"prompt_tokens": [1, 2, 3, 4],
+                           "max_tokens": 4}).encode()
+        code, _, hdrs = gw.forward_ex("/v1/completions", body)
+        assert code == 200
+        trace_id = hdrs["traceparent"].split("-")[1]
+        spans = tracer.export(trace_id)
+        by_name = {s["name"]: s for s in spans}
+        assert {"serve-request", "gateway-queue", "route-decision",
+                "forward", "engine-queue", "kv-alloc", "prefill",
+                "decode"} <= set(by_name), sorted(by_name)
+        root = by_name["serve-request"]
+        # The traceparent parented the REMOTE engine spans directly on
+        # the gateway-minted root: one flat tree, no orphans.
+        for s in spans:
+            if s is not root:
+                assert s["parent_id"] == root["span_id"], s
+            assert s["start"] >= root["start"] - 1e-6
+            assert s["end"] <= root["end"] + 1e-6
+        trees = span_tree(spans)
+        assert len(trees) == 1
+        assert len(trees[0]["children"]) == len(spans) - 1
+    finally:
+        gw.stop()
+        srv.shutdown()
+        fe.close()

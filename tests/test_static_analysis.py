@@ -764,6 +764,86 @@ def test_shard_affinity_quiet_in_router_modules_and_on_plain_sets():
 
 
 # ---------------------------------------------------------------------------
+# metric-catalog-sync
+# ---------------------------------------------------------------------------
+
+def _mini_repo(tmp_path, doc_body, module_body):
+    """A throwaway repo shape the rule can resolve: docs/observability.md
+    plus one package module."""
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "observability.md").write_text(doc_body)
+    pkg = tmp_path / "kuberay_tpu" / "utils"
+    pkg.mkdir(parents=True)
+    mod = pkg / "metrics.py"
+    mod.write_text(textwrap.dedent(module_body))
+    return str(mod)
+
+
+def test_metric_catalog_sync_flags_undocumented_family(tmp_path):
+    from kuberay_tpu.analysis.core import analyze_file
+
+    path = _mini_repo(
+        tmp_path,
+        "| `tpu_known_total` | counter | — | documented |\n",
+        """
+        def hit(registry):
+            registry.inc("tpu_known_total")
+            registry.inc("tpu_mystery_total")
+        """)
+    findings = analyze_file(path, only=["metric-catalog-sync"])
+    assert [f for f in findings if "tpu_mystery_total" in f.message]
+    assert not [f for f in findings if "tpu_known_total" in f.message]
+
+
+def test_metric_catalog_sync_wildcard_row_covers_prefix(tmp_path):
+    from kuberay_tpu.analysis.core import analyze_file
+
+    path = _mini_repo(
+        tmp_path,
+        "| `tpu_serve_*` | counter | — | passthrough |\n",
+        """
+        def hit(registry):
+            registry.set_gauge("tpu_serve_queue_depth", 3)
+        """)
+    assert analyze_file(path, only=["metric-catalog-sync"]) == []
+
+
+def test_metric_catalog_sync_flags_stale_doc_row(tmp_path):
+    from kuberay_tpu.analysis.core import analyze_file
+
+    # The anchor module (utils/metrics.py) triggers the doc->code sweep;
+    # `tpu_ghost_total` has a catalog row but no code behind it.
+    path = _mini_repo(
+        tmp_path,
+        "| `tpu_real_total` | counter | — | lives |\n"
+        "| `tpu_ghost_total` | counter | — | stale |\n",
+        """
+        def hit(registry):
+            registry.inc("tpu_real_total")
+        """)
+    findings = analyze_file(path, only=["metric-catalog-sync"])
+    assert [f for f in findings if "tpu_ghost_total" in f.message]
+    assert not [f for f in findings if "tpu_real_total" in f.message]
+
+
+def test_metric_catalog_sync_skips_synthetic_sources():
+    # analyze_source snippets have no repo to resolve the doc against.
+    _, fired = _rules_fired("""
+        def hit(registry):
+            registry.inc("tpu_definitely_undocumented_total")
+    """, only=["metric-catalog-sync"])
+    assert fired == set()
+
+
+def test_metric_catalog_sync_real_doc_and_tree_agree():
+    """The live contract: the shipping package and the shipping catalog
+    are in sync, both directions (this is what tools/lint.sh enforces)."""
+    findings = run_paths([os.path.join(REPO_ROOT, "kuberay_tpu")],
+                         only=["metric-catalog-sync"])
+    assert findings == [], "\n" + render_human(findings)
+
+
+# ---------------------------------------------------------------------------
 # the gate: the real tree is clean
 # ---------------------------------------------------------------------------
 

@@ -38,3 +38,37 @@ print(f"bench serve smoke ok: {len(doc['legs'])} legs, "
       f"{sum(l['requests'] for l in doc['legs'])} requests, "
       f"schema {doc['schema']}")
 EOF
+
+# Tracing-overhead gate: same fleet + arrival schedule with end-to-end
+# request tracing off vs on; the throughput cost of spans + exemplars
+# must stay inside the budget (docs/observability.md, serve span model).
+trace_out="${BENCH_TRACE_OUT:-/tmp/tpu_bench_serve_trace.json}"
+timeout -k 10 600 env JAX_PLATFORMS=cpu python benchmark/serve_bench.py \
+    --trace \
+    --seeds "${BENCH_SEEDS:-0}" \
+    --duration "${BENCH_DURATION:-5}" \
+    --rate-scale "${BENCH_RATE_SCALE:-0.5}" \
+    --json-out "$trace_out"
+BENCH_JSON_PATH="$trace_out" \
+BENCH_TRACE_MAX_PCT="${BENCH_TRACE_MAX_PCT:-5}" python - <<'EOF'
+import json, os, sys
+sys.path.insert(0, os.getcwd())
+from benchmark.serve_bench import TRAFFIC_SCHEMA
+doc = json.load(open(os.environ["BENCH_JSON_PATH"]))
+assert doc["schema"] == TRAFFIC_SCHEMA, doc.get("schema")
+assert len(doc["legs"]) == 2, f"expected off+on legs: {doc['legs']}"
+off, on = doc["legs"]
+assert off["tracing"] is False and on["tracing"] is True, doc["legs"]
+for leg in doc["legs"]:
+    assert leg["errors"] == 0, f"transport errors in leg: {leg}"
+    assert leg["completed"] > 0 and leg["tokens_per_sec"] > 0, leg
+ov = doc["trace_overhead"]
+assert ov["spans_recorded"] > 0, "tracing-on leg recorded no spans"
+limit = float(os.environ["BENCH_TRACE_MAX_PCT"])
+assert ov["overhead_pct"] < limit, (
+    f"tracing overhead {ov['overhead_pct']}% exceeds {limit}% budget: {ov}")
+print(f"bench serve trace ok: overhead {ov['overhead_pct']}% "
+      f"({ov['tokens_per_sec_off']} -> {ov['tokens_per_sec_on']} tok/s), "
+      f"ttft p99 delta {ov['ttft_p99_delta_ms']} ms, "
+      f"{ov['spans_recorded']} spans")
+EOF

@@ -4,7 +4,11 @@
 # flight recorder, the goodput ledger and the autoscaler audit), and
 # assert everything parses — the standing check that the Prometheus
 # exposition, the span export and the goodput rollup stay
-# machine-readable:
+# machine-readable.  Then the serve half: a gateway + one replica
+# sharing the operator's tracer serve one completion, and the response
+# traceparent's trace must surface at /debug/traces?tree=1 with BOTH
+# gateway and engine spans; /debug/alerts must answer with an empty
+# ring on a healthy cluster.
 #
 #   tools/obs_smoke.sh
 #
@@ -79,12 +83,78 @@ try:
         audit = json.load(resp)
     assert "decisions" in audit, audit
 
+    # SLO burn-rate alert engine: /debug/alerts answers, and a healthy
+    # smoke run fires nothing (empty active set and history ring).
+    with urllib.request.urlopen(f"{url}/debug/alerts") as resp:
+        alerts = json.load(resp)
+    assert alerts["active"] == [], f"unexpected active alerts: {alerts}"
+    assert alerts["ring"] == [], f"unexpected alert history: {alerts}"
+    assert alerts["specs"], "alert engine mounted with no SLO specs"
+
+    # Serve request tracing end-to-end: one completion through a gateway
+    # + replica that share the operator's tracer; the response
+    # traceparent's trace id must resolve at /debug/traces?tree=1 to a
+    # tree containing the gateway spans AND the engine child spans.
+    import jax
+
+    from kuberay_tpu.models import llama
+    from kuberay_tpu.serve.gateway import WeightedGateway
+    from kuberay_tpu.serve.paged_engine import PagedServeEngine
+    from kuberay_tpu.serve.server import ServeFrontend
+
+    cfg = llama.CONFIGS["llama_tiny"]
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    eng = PagedServeEngine(cfg, params, max_slots=2, max_len=48,
+                           block_size=16, tracer=op.tracer)
+    fe = ServeFrontend(eng, max_queue=8)
+    srv, replica_url = fe.serve_background()
+    op.store.create({
+        "apiVersion": "tpu.dev/v1", "kind": "TrafficRoute",
+        "metadata": {"name": "smoke-route", "namespace": "default"},
+        "spec": {"backends": [{"service": "replica-0", "weight": 1}]},
+        "status": {},
+    })
+    gw = WeightedGateway(op.store, "smoke-route",
+                         resolver=lambda s: replica_url,
+                         poll_interval=30.0, tracer=op.tracer,
+                         flight=op.flight)
+    try:
+        body = json.dumps({"prompt_tokens": [1, 2, 3, 4],
+                           "max_tokens": 4}).encode()
+        code, payload, hdrs = gw.forward_ex("/v1/completions", body)
+        assert code == 200, (code, payload)
+        traceparent = hdrs.get("traceparent")
+        assert traceparent, f"no traceparent in response headers: {hdrs}"
+        trace_id = traceparent.split("-")[1]
+        with urllib.request.urlopen(
+                f"{url}/debug/traces?trace_id={trace_id}&tree=1") as resp:
+            tree = json.load(resp)
+
+        def span_names(nodes):
+            out = set()
+            for n in nodes:
+                out.add(n["name"])
+                out |= span_names(n["children"])
+            return out
+
+        got = span_names(tree["traces"])
+        for needed in ("serve-request", "gateway-queue", "route-decision",
+                       "forward", "engine-queue", "prefill", "decode",
+                       "kv-alloc"):
+            assert needed in got, \
+                f"{needed} span missing from trace {trace_id}: {sorted(got)}"
+    finally:
+        gw.stop()
+        srv.shutdown()
+        fe.close()
+
     print(f"obs smoke ok: {len(doc['spans'])} spans, "
           f"{len(text.splitlines())} metric lines, "
           f"{len(flight['records'])} flight records, "
           f"goodput ratio {roll['goodput_ratio']:.2f} over "
           f"{len(good['intervals'])} intervals, "
-          f"{len(audit['decisions'])} autoscaler decisions")
+          f"{len(audit['decisions'])} autoscaler decisions, "
+          f"serve trace {trace_id} spans {sorted(got)}")
 finally:
     op.stop()
 EOF
