@@ -1,0 +1,240 @@
+"""Preemption chaos benchmark: the goodput value of the advance notice.
+
+The claim behind warm-pool pre-replacement (docs/preemption.md): a slice
+kill that arrives WITH an advance warning costs strictly less goodput
+than the same kill arriving unwarned, because the control plane builds
+the replacement while the doomed slice is still serving.  This harness
+measures exactly that, as a seeded regression curve:
+
+- ``warned-warm``: advance notice + a warm pool of one — the controller
+  claims the standby slice and retires the doomed one before the kill;
+- ``warned-cold``: advance notice, no warm pool — the replacement is
+  provisioned cold inside the warning window (maxReplicas headroom);
+- ``unwarned``: the same slice dies at the same virtual time with no
+  warning at all — the classic preemption.
+
+Every run is a fault-free ``SimHarness`` on the virtual clock (wall
+time never enters the numbers), one v5e/4x4 cluster of two slices, one
+kill per run.  Per seed, the notice offset and warning window are drawn
+from ``random.Random(1000 + seed)`` and SHARED across the three modes,
+so the fault windows are equal and the per-seed comparison is paired.
+
+    python benchmark/chaos_bench.py --out benchmark/results/chaos_r10.json
+
+The committed artifact (``tpu-chaos-bench/v1``) is the regression
+fence: tests/test_chaos_bench.py recomputes the curve and asserts that
+for every seed the warned modes spend strictly fewer
+interrupted+recovery seconds and end at a strictly higher goodput
+ratio than the unwarned run — and that the numbers still match the
+committed file exactly (the whole pipeline is deterministic).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+
+# Anchor imports on the repo root, not the CWD — the harness must work
+# from any invocation directory.
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO_ROOT)
+
+from kuberay_tpu.sim.harness import SimHarness  # noqa: E402
+from kuberay_tpu.sim.scenarios import make_cluster_obj  # noqa: E402
+from kuberay_tpu.utils import constants as C  # noqa: E402
+
+SCHEMA = "tpu-chaos-bench/v1"
+MODES = ("warned-warm", "warned-cold", "unwarned")
+NS = "default"
+CLUSTER = "drill"
+#: Observation window after the kill: long enough for the slowest
+#: (unwarned cold rebuild) recovery to complete and amortize into the
+#: ratio, identical across modes so totals stay comparable.
+SETTLE_AFTER = 120.0
+#: Deterministic pod boot time (creation -> Running) on the virtual
+#: clock.  The fake kubelet starts pods instantly by default, which
+#: would price cold provisioning at zero; real TPU hosts take minutes.
+#: Chosen LONGER than every warning window (15-25s) so warned-cold
+#: recovery genuinely overlaps the warning rather than hiding inside
+#: it — the warm pool's whole advantage is skipping this.
+BOOT_S = 30.0
+
+
+def _schedule(seed: int):
+    """Per-seed (notice offset, warning window), shared by all modes so
+    the three runs of a seed see the same fault window."""
+    rnd = random.Random(1000 + seed)
+    offset = 45.0 + rnd.uniform(0.0, 30.0)
+    delta = 15.0 + rnd.uniform(0.0, 10.0)
+    return offset, delta
+
+
+def _warm_pool():
+    return {
+        "apiVersion": C.API_VERSION, "kind": "WarmSlicePool",
+        "metadata": {"name": "reserve", "namespace": NS},
+        "spec": {"accelerator": "v5e", "topology": "4x4", "poolSize": 1},
+        "status": {},
+    }
+
+
+def _victim_slice(h) -> str:
+    """Lowest-indexed live worker slice of the drill cluster —
+    deterministic under the seeded store (uid/name counters)."""
+    best = None
+    for p in h.store.list("Pod", NS, labels={C.LABEL_CLUSTER: CLUSTER}):
+        labels = p["metadata"]["labels"]
+        sname = labels.get(C.LABEL_SLICE_NAME)
+        if not sname or p["metadata"].get("deletionTimestamp"):
+            continue
+        try:
+            idx = int(labels.get(C.LABEL_SLICE_INDEX, "10000"))
+        except ValueError:
+            continue
+        if best is None or (idx, sname) < best:
+            best = (idx, sname)
+    if best is None:
+        raise RuntimeError("no live worker slice to preempt")
+    return best[1]
+
+
+def _install_boot_delay(h):
+    """Every pod takes ``BOOT_S`` virtual seconds from creation to
+    Running (a hold the settle loop's wakeup scan advances through) —
+    the deterministic stand-in for TPU host boot + runtime start."""
+    def on_event(ev):
+        if ev.kind != "Pod" or ev.type != "ADDED":
+            return
+        md = ev.obj.get("metadata", {})
+        h.kubelet.hold_pod(md.get("name", ""),
+                           md.get("namespace", "default"),
+                           until=h.clock.now() + BOOT_S)
+    return h.store.watch(on_event)
+
+
+def run_case(mode: str, seed: int) -> dict:
+    """One (mode, seed) run -> its goodput accounting."""
+    if mode not in MODES:
+        raise ValueError(f"unknown mode {mode!r}")
+    offset, delta = _schedule(seed)
+    # Fault-free plan: the ONLY disturbance is the scripted preemption,
+    # so the curve isolates warned-vs-unwarned (not random chaos).
+    with SimHarness(seed, fault_profile={}, goodput=True) as h:
+        cancel = _install_boot_delay(h)
+        h.store.create(make_cluster_obj(
+            CLUSTER, accelerator="v5e", topology="4x4",
+            replicas=2, max_replicas=4))
+        if mode == "warned-warm":
+            h.store.create(_warm_pool())
+        h.settle()
+        if not h.converged:
+            raise RuntimeError(f"{mode}/seed={seed}: bootstrap did not "
+                               "converge")
+
+        # Idle steady state up to the notice instant.
+        h.clock.advance_to(h.clock.now() + offset)
+        h.settle()
+        sname = _victim_slice(h)
+        base = h.clock.now()
+        kill_at = base + delta
+
+        if mode == "unwarned":
+            # Same kill, zero warning: advance straight to the deadline
+            # and drop the slice.
+            h.clock.advance_to(kill_at)
+            with h.plan.suspended():
+                h.kubelet.fail_slice(sname, NS)
+            h.settle()
+        else:
+            # The harness kills the slice at the deadline itself; the
+            # settle in between is where the controller spends the
+            # warning (drain + claim/pre-provision + retire).
+            h.inject_preemption_notice(NS, sname, delta)
+            h.settle()
+            h.clock.advance_to(kill_at)
+            h.settle()
+
+        # Equal-length observation window after the kill.
+        h.clock.advance_to(kill_at + SETTLE_AFTER)
+        h.settle()
+        if not h.converged:
+            raise RuntimeError(f"{mode}/seed={seed}: recovery did not "
+                               "converge")
+
+        roll = h.goodput.rollup(C.KIND_CLUSTER, NS, CLUSTER)
+        phases = roll["phases"]
+        violations = [str(v) for v in h.check()]
+        cancel()
+        return {
+            "mode": mode, "seed": seed,
+            "notice_offset_s": round(offset, 6),
+            "warning_window_s": round(delta, 6),
+            "goodput_ratio": round(roll["goodput_ratio"], 9),
+            "productive_s": round(phases["productive"], 6),
+            "interrupted_s": round(phases["interrupted"], 6),
+            "recovery_s": round(phases["recovery"], 6),
+            "bootstrap_s": round(phases["bootstrap"], 6),
+            "provisioning_s": round(phases["provisioning"], 6),
+            "total_s": round(roll["total"], 6),
+            "violations": violations,
+        }
+
+
+def run_curve(seeds) -> dict:
+    runs = [run_case(mode, seed) for seed in seeds for mode in MODES]
+    by = {(r["mode"], r["seed"]): r for r in runs}
+    curve = {
+        mode: [by[(mode, s)]["goodput_ratio"] for s in seeds]
+        for mode in MODES
+    }
+    return {
+        "schema": SCHEMA,
+        "scenario": "preemption-drill",
+        "seeds": list(seeds),
+        "settle_after_s": SETTLE_AFTER,
+        "curve": curve,
+        "runs": runs,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="chaos_bench")
+    ap.add_argument("--seeds", default="0,1,2,3,4",
+                    help="comma-separated seed list")
+    ap.add_argument("--out", default="",
+                    help="write the artifact here (default: stdout)")
+    args = ap.parse_args(argv)
+    seeds = [int(s) for s in args.seeds.split(",") if s != ""]
+    doc = run_curve(seeds)
+    payload = json.dumps(doc, indent=2, sort_keys=True) + "\n"
+    if args.out:
+        os.makedirs(os.path.dirname(os.path.abspath(args.out)),
+                    exist_ok=True)
+        with open(args.out, "w") as fh:
+            fh.write(payload)
+        print(f"wrote {args.out}")
+    else:
+        sys.stdout.write(payload)
+    # The bench's own gate: warned must beat unwarned on every seed.
+    for seed in seeds:
+        runs = {r["mode"]: r for r in doc["runs"] if r["seed"] == seed}
+        un = runs["unwarned"]
+        for mode in ("warned-warm", "warned-cold"):
+            w = runs[mode]
+            if not (w["interrupted_s"] + w["recovery_s"]
+                    < un["interrupted_s"] + un["recovery_s"]):
+                print(f"REGRESSION seed={seed} {mode}: downtime not "
+                      "strictly below unwarned", file=sys.stderr)
+                return 1
+            if not w["goodput_ratio"] > un["goodput_ratio"]:
+                print(f"REGRESSION seed={seed} {mode}: goodput ratio not "
+                      "strictly above unwarned", file=sys.stderr)
+                return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

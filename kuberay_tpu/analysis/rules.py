@@ -1136,3 +1136,53 @@ class MetricCatalogSyncRule(Rule):
             fams.update(cls._families_in(tree))
         cls._code_cache[root] = fams
         return fams
+
+
+# ---------------------------------------------------------------------------
+# 11. slice-teardown-through-drain-seam
+# ---------------------------------------------------------------------------
+
+@rule
+class SliceTeardownDrainSeamRule(Rule):
+    """Slice teardown must route through the drain seam.  A controller
+    that owns slice-atomic pod groups funnels every slice deletion
+    through ``_delete_slice``, which drains preemption-noticed pods
+    (checkpoint request + drained stamp) before any pod is deleted and
+    aborts whole — nothing deleted — when the drain write conflicts.  A
+    direct ``self._delete_pod(...)`` inside the group reconcile loop
+    bypasses that seam: a noticed slice gets torn down without its
+    drain-time checkpoint, which is exactly the data-loss window the
+    advance notice exists to close (the sim's ``drain-before-delete``
+    invariant catches the journal-level symptom; this rule catches the
+    code path before it ships).
+    """
+
+    NAME = "slice-teardown-through-drain-seam"
+    DESCRIPTION = ("group reconciles in classes with a _delete_slice "
+                   "drain seam must not call _delete_pod directly")
+    INVARIANT = ("every slice teardown drains noticed pods (checkpoint "
+                 "+ drained stamp) before deleting")
+
+    _SEAM = "_delete_slice"
+    _RECONCILE = "_reconcile_worker_group"
+    _RAW_DELETE = "_delete_pod"
+
+    def check(self, tree: ast.Module, ctx: FileContext) -> Iterable[Finding]:
+        for cls in iter_classes(tree):
+            methods = {n.name: n for n in cls.body
+                       if isinstance(n, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))}
+            if self._SEAM not in methods or self._RECONCILE not in methods:
+                continue
+            for node in ast.walk(methods[self._RECONCILE]):
+                if not isinstance(node, ast.Call):
+                    continue
+                target = dotted(node.func)
+                if target == f"self.{self._RAW_DELETE}":
+                    yield self.finding(
+                        ctx, node,
+                        f"'{cls.name}.{self._RECONCILE}' deletes a pod "
+                        f"directly via {self._RAW_DELETE}(); route slice "
+                        f"teardown through {self._SEAM}() so preemption-"
+                        "noticed pods are drained (checkpoint + stamp) "
+                        "before deletion")

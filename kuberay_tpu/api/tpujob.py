@@ -87,6 +87,23 @@ class DeletionStrategy(Serializable):
 
 
 @dataclasses.dataclass
+class ElasticPolicy(Serializable):
+    """Requeue-vs-shrink when preemption takes slice capacity away and
+    no replacement exists (docs/preemption.md):
+
+    - ``shrink``: step the job's cluster down to the surviving slice
+      count (data-parallel world-size shrink, floored at
+      ``minReplicas``), and restore the original replica count once
+      replacement capacity (a ready warm slice) returns;
+    - ``requeue``: leave replicas alone and ride the controller's
+      replacement provisioning (the default posture without a policy).
+    """
+
+    mode: str = "shrink"              # "shrink" | "requeue"
+    minReplicas: int = 1
+
+
+@dataclasses.dataclass
 class SubmitterConfig(Serializable):
     """Submitter pod knobs (ref SubmitterPodTemplate + backoff)."""
 
@@ -119,6 +136,7 @@ class TpuJobSpec(Serializable):
     preRunningDeadlineSeconds: int = 0  # deadline to *reach* Running (:283)
     backoffLimit: int = 0               # retries with fresh clusters (:213-217)
     deletionStrategy: Optional[DeletionStrategy] = None
+    elastic: Optional[ElasticPolicy] = None
     managedBy: str = ""
     schedulerName: str = ""
     gangSchedulingQueue: str = ""
@@ -129,6 +147,7 @@ class TpuJobSpec(Serializable):
             "clusterSpec": TpuClusterSpec,
             "submitterConfig": SubmitterConfig,
             "deletionStrategy": DeletionStrategy,
+            "elastic": ElasticPolicy,
         }
 
 
@@ -144,6 +163,9 @@ class TpuJobStatus(Serializable):
     endTime: float = 0.0
     succeeded: int = 0
     failed: int = 0                      # retry attempts that failed
+    # Replica count before an elastic shrink (0 = not shrunk): the
+    # restore target once replacement capacity returns.
+    elasticOriginalReplicas: int = 0
     observedGeneration: int = 0
     conditions: List[Condition] = dataclasses.field(default_factory=list)
     clusterStatus: Dict[str, object] = dataclasses.field(default_factory=dict)

@@ -43,11 +43,14 @@ from kuberay_tpu.builders.service import (
 )
 from kuberay_tpu.controlplane.events import EventRecorder
 from kuberay_tpu.controlplane.expectations import HEAD_GROUP, ScaleExpectations
-from kuberay_tpu.controlplane.store import (AlreadyExists, NotFound,
-                                             ObjectStore, StoreError)
+from kuberay_tpu.controlplane.store import (AlreadyExists, Conflict,
+                                             NotFound, ObjectStore,
+                                             StoreError)
+from kuberay_tpu.controlplane.warmpool_controller import KIND_WARM_POOL
 from kuberay_tpu.obs.goodput import NOOP_TRANSITIONS
 from kuberay_tpu.obs.trace import NOOP_TRACER
 from kuberay_tpu.utils import constants as C
+from kuberay_tpu.utils import features
 from kuberay_tpu.utils.names import head_service_name, spec_hash
 from kuberay_tpu.utils.validation import (
     validate_cluster,
@@ -87,7 +90,9 @@ class TpuClusterController:
                  metrics=None,
                  use_openshift_route: bool = False,
                  tracer=None,
-                 transitions=None):
+                 transitions=None,
+                 warmpool=None,
+                 client_provider=None):
         self.store = store
         self.exp = expectations or ScaleExpectations()
         self.recorder = recorder or EventRecorder(store)
@@ -106,6 +111,16 @@ class TpuClusterController:
         self._slices_observed_ready: set = set()
         # OpenShift clusters expose the head via a Route (openshift.go).
         self.use_openshift_route = use_openshift_route
+        # Preemption lifecycle (docs/preemption.md): a WarmSlicePool
+        # controller to claim pre-provisioned replacements from on an
+        # advance notice, and a coordinator-client provider
+        # (status -> client) for the checkpoint-drain hook.
+        self.warmpool = warmpool
+        self.client_provider = client_provider
+        # (ns, cluster, group, slice name) -> first-sight wall clock of an
+        # active preemption notice; closed (warned-recovery observed)
+        # once the slice is gone and the group is back at readiness.
+        self._notice_started: Dict[tuple, float] = {}
 
     # ------------------------------------------------------------------
     # entry point
@@ -178,6 +193,10 @@ class TpuClusterController:
     def _reconcile_deletion(self, cluster: TpuCluster) -> Optional[float]:
         ns, name = cluster.metadata.namespace, cluster.metadata.name
         pods = self._cluster_pods(cluster)
+        # Even a full teardown honors the drain contract: pods under an
+        # active preemption notice get their checkpoint request first.
+        if not self._drain_noticed(cluster, pods):
+            return 1.0
         # Head-pod-first deletion so workers don't thrash reconnecting
         # (ref head-first delete :240-ish), then the rest.
         head = [p for p in pods if p["metadata"]["labels"].get(
@@ -202,6 +221,9 @@ class TpuClusterController:
     def _forget_ready(self, namespace: str, name: str):
         self._slices_observed_ready = {
             k for k in self._slices_observed_ready
+            if not (k[0] == namespace and k[1] == name)}
+        self._notice_started = {
+            k: v for k, v in self._notice_started.items()
             if not (k[0] == namespace and k[1] == name)}
 
     def _reconcile_cleanup_job(self, cluster: TpuCluster) -> bool:
@@ -329,6 +351,8 @@ class TpuClusterController:
 
         # Suspend: delete all (ref :912-927), Kueue-compatible quiescence.
         if cluster.spec.suspend:
+            if not self._drain_noticed(cluster, pods):
+                return 1.0
             for p in pods:
                 self._delete_pod(p)
             return None
@@ -341,6 +365,8 @@ class TpuClusterController:
                      if p["metadata"].get("annotations", {}).get(
                          POD_SPEC_HASH_ANNOTATION) not in (None, thash)]
             if stale:
+                if not self._drain_noticed(cluster, pods):
+                    return 1.0
                 for p in pods:
                     self._delete_pod(p)
                 return 1.0
@@ -413,12 +439,17 @@ class TpuClusterController:
         slices = self._group_pods_by_slice(live_pods, group)
         topo = group.slice_topology()
         hosts = topo.num_hosts
+        requeue: Optional[float] = None
 
         if group.suspend:
             for plist in slices.values():
-                for p in plist:
-                    self._delete_pod(p, group.groupName)
-            return None
+                if not self._delete_slice(cluster, plist, group.groupName):
+                    requeue = 1.0
+            return requeue
+
+        # 0. Advance-notice preemptions: note first sight (metric, event,
+        #    recovery clock) before any teardown/diff decision below.
+        noticed_idx = self._note_preemptions(cluster, group, slices)
 
         # 1. Incomplete slices are useless (no ICI ring): delete whole
         #    (ref :1257-1267).
@@ -426,23 +457,27 @@ class TpuClusterController:
             if idx < 0 or len(plist) != hosts or \
                     len({p["metadata"]["labels"].get(C.LABEL_HOST_INDEX)
                          for p in plist}) != hosts:
-                for p in plist:
-                    self._delete_pod(p, group.groupName)
+                if not self._delete_slice(cluster, plist, group.groupName):
+                    requeue = 1.0
+                    continue
                 self.recorder.warning(
                     cluster.to_dict(), C.EVENT_DELETED_SLICE,
                     f"deleted incomplete slice {group.groupName}/{idx} "
                     f"({len(plist)}/{hosts} hosts)")
                 del slices[idx]
+                noticed_idx.discard(idx)
 
         # 2. Any failed host poisons the whole slice (ref :1269-1289).
         for idx, plist in list(slices.items()):
             if any(pod_failed(p) for p in plist):
-                for p in plist:
-                    self._delete_pod(p, group.groupName)
+                if not self._delete_slice(cluster, plist, group.groupName):
+                    requeue = 1.0
+                    continue
                 self.recorder.warning(
                     cluster.to_dict(), C.EVENT_UNHEALTHY_SLICE,
                     f"deleted unhealthy slice {group.groupName}/{idx}")
                 del slices[idx]
+                noticed_idx.discard(idx)
 
         # 3. Autoscaler-named victims expand to whole slices (ref :1293-1322;
         #    here the contract is already slice-granular).  Executed victims
@@ -454,24 +489,40 @@ class TpuClusterController:
             for idx, plist in list(slices.items()):
                 sname = plist[0]["metadata"]["labels"].get(C.LABEL_SLICE_NAME)
                 if sname in victims:
-                    for p in plist:
-                        self._delete_pod(p, group.groupName)
+                    if not self._delete_slice(cluster, plist,
+                                              group.groupName):
+                        requeue = 1.0
+                        continue
                     del slices[idx]
+                    noticed_idx.discard(idx)
                     executed.add(sname)
             if executed:
                 self._clear_executed_victims(cluster, raw,
                                              group.groupName, executed)
 
-        # 4. Diff in slice units (ref :1343-1378).
+        # 4. Diff in slice units (ref :1343-1378).  Slices under an active
+        #    notice count against a RAISED target (desired + noticed,
+        #    capped at maxReplicas): the replacement is pre-provisioned
+        #    while the doomed slice still runs — slice atomicity holds,
+        #    the old slice stays whole until the new one is Ready.
         desired = max(0, group.replicas)
+        pending = {i for i in noticed_idx if i in slices}
+        target = desired + len(pending)
+        if group.maxReplicas:
+            target = min(target, max(desired, group.maxReplicas))
         have = len(slices)
-        if have < desired:
+        if have < target:
             used = set(slices.keys())
             next_idx = 0
             created = 0
-            while created < desired - have:
+            reason = "preemption" if pending else "scale-up"
+            while created < target - have:
                 if next_idx in used:
                     next_idx += 1
+                    continue
+                if self._claim_warm_slice(cluster, group, next_idx, reason):
+                    used.add(next_idx)
+                    created += 1
                     continue
                 new_pods = build_slice_pods(cluster, group, next_idx,
                                             config_env=self.config_env)
@@ -486,24 +537,198 @@ class TpuClusterController:
                     f"created slice {group.groupName}/{next_idx} ({hosts} hosts)")
                 used.add(next_idx)
                 created += 1
-        elif have > desired:
+        elif have > target:
             # Scale down: autoscaler owns victim choice when enabled
             # (ref :1181-1239); otherwise delete highest indices first
             # (deterministic; ENABLE_RANDOM_POD_DELETE env restores the
-            # reference's random choice).
-            excess = have - desired
+            # reference's random choice).  Noticed slices are never
+            # scale-down victims — their teardown is the retirement path
+            # below, gated on replacement readiness.
+            excess = have - target
             if cluster.spec.enableInTreeAutoscaling and not victims:
-                return None     # wait for slicesToDelete
-            order = sorted(slices.keys(), reverse=True)
+                return requeue  # wait for slicesToDelete
+            order = [i for i in sorted(slices.keys(), reverse=True)
+                     if i not in pending]
             if os.environ.get(C.ENV_ENABLE_RANDOM_POD_DELETE) == "true":
                 random.shuffle(order)
             for idx in order[:excess]:
-                for p in slices[idx]:
-                    self._delete_pod(p, group.groupName)
+                if not self._delete_slice(cluster, slices[idx],
+                                          group.groupName):
+                    requeue = 1.0
+                    continue
                 self.recorder.normal(
                     cluster.to_dict(), C.EVENT_DELETED_SLICE,
                     f"scaled down slice {group.groupName}/{idx}")
-        return None
+                del slices[idx]
+
+        # 5. Retire noticed slices once replacement capacity is Ready:
+        #    the drain (checkpoint request + drained-at stamp) happens
+        #    inside the seam, before the kill deadline lands.
+        if pending:
+            ready_other = sum(
+                1 for idx, plist in slices.items()
+                if idx not in pending and len(plist) == hosts
+                and all(pod_running(p) for p in plist))
+            if ready_other >= desired:
+                for idx in sorted(pending):
+                    if idx not in slices:
+                        continue
+                    if not self._delete_slice(cluster, slices[idx],
+                                              group.groupName):
+                        requeue = 1.0
+                        continue
+                    self.recorder.normal(
+                        cluster.to_dict(), C.EVENT_DELETED_SLICE,
+                        f"retired preempted slice {group.groupName}/{idx} "
+                        "(replacement ready)")
+                    del slices[idx]
+            else:
+                requeue = min(requeue, 1.0) if requeue else 1.0
+        return requeue
+
+    # ------------------------------------------------------------------
+    # preemption lifecycle (docs/preemption.md)
+    # ------------------------------------------------------------------
+
+    def _note_preemptions(self, cluster: TpuCluster, group: WorkerGroupSpec,
+                          slices: Dict[int, List[Dict[str, Any]]]) -> set:
+        """Indices of live slices under an active preemption notice;
+        first sight per slice starts the warned-recovery clock and emits
+        ``tpu_preemption_notices_total`` + a PreemptionNotice event."""
+        ns, name = cluster.metadata.namespace, cluster.metadata.name
+        noticed = set()
+        for idx, plist in slices.items():
+            deadlines = [p["metadata"].get("annotations", {}).get(
+                C.ANNOTATION_PREEMPTION_NOTICE) for p in plist]
+            deadlines = [d for d in deadlines if d]
+            if not deadlines:
+                continue
+            noticed.add(idx)
+            sname = plist[0]["metadata"]["labels"].get(
+                C.LABEL_SLICE_NAME, f"{group.groupName}-{idx}")
+            k = (ns, name, group.groupName, sname)
+            if k in self._notice_started:
+                continue
+            self._notice_started[k] = time.time()
+            if self.metrics is not None:
+                self.metrics.preemption_notice(name, group.groupName)
+            self.recorder.warning(
+                cluster.to_dict(), C.EVENT_PREEMPTION_NOTICE,
+                f"preemption notice on slice {sname} (kill deadline "
+                f"{min(deadlines)}): pre-provisioning replacement")
+        return noticed
+
+    def _delete_slice(self, cluster: TpuCluster,
+                      plist: List[Dict[str, Any]], group_name: str) -> bool:
+        """THE slice-teardown seam (analysis rule
+        slice-teardown-through-drain-seam): every whole-slice delete
+        routes through here, so a slice under an active preemption
+        notice is drained — checkpoint requested via the coordinator,
+        drain acknowledgment stamped — before any of its pods is
+        deleted.  Returns False with NOTHING deleted when the drain
+        write loses its rv race (caller requeues; level-triggered
+        retry)."""
+        if not self._drain_noticed(cluster, plist):
+            return False
+        for p in plist:
+            self._delete_pod(p, group_name)
+        return True
+
+    def _drain_noticed(self, cluster: TpuCluster,
+                       pods: List[Dict[str, Any]]) -> bool:
+        ns = cluster.metadata.namespace
+        noticed = [
+            p for p in pods
+            if p["metadata"].get("annotations", {}).get(
+                C.ANNOTATION_PREEMPTION_NOTICE)
+            and not p["metadata"].get("annotations", {}).get(
+                C.ANNOTATION_DRAINED_AT)]
+        if not noticed:
+            return True
+        for p in noticed:
+            # The drain stamp echoes the notice deadline it acknowledged:
+            # self-describing in production, and deterministic under the
+            # sim clock (a wall-clock stamp would break the replay-hash
+            # contract).
+            deadline = p["metadata"]["annotations"][
+                C.ANNOTATION_PREEMPTION_NOTICE]
+            try:
+                self.store.patch(
+                    "Pod", p["metadata"]["name"], ns,
+                    {"metadata": {"annotations": {
+                        C.ANNOTATION_DRAINED_AT: deadline}}})
+            except NotFound:
+                continue
+            except Conflict:
+                # rv race on the stamp: nothing was deleted yet, so the
+                # caller requeues and the whole drain re-runs (the
+                # drain-before-delete invariant stays intact).
+                return False
+        self._request_checkpoint(cluster, noticed)
+        sname = noticed[0]["metadata"]["labels"].get(C.LABEL_SLICE_NAME, "")
+        self.recorder.normal(
+            cluster.to_dict(), C.EVENT_DRAINED_SLICE,
+            f"drained slice {sname}: checkpoint requested for "
+            f"{len(noticed)} noticed pod(s) before teardown")
+        return True
+
+    def _request_checkpoint(self, cluster: TpuCluster,
+                            pods: List[Dict[str, Any]]):
+        """Checkpoint-drain hook: one request per drained batch, into
+        the coordinator (train.checkpoint CheckpointWriter on the far
+        side).  Best-effort — a severed coordinator (DCN partition) must
+        not wedge teardown; the drained-at stamp is the contract the
+        invariant checker reads."""
+        if self.client_provider is None:
+            return
+        sname = pods[0]["metadata"]["labels"].get(C.LABEL_SLICE_NAME, "")
+        try:
+            client = self.client_provider(cluster.status.to_dict())
+            client.request_checkpoint(tag=f"preempt-{sname}",
+                                      reason="preemption")
+        except Exception:
+            pass
+
+    def _claim_warm_slice(self, cluster: TpuCluster, group: WorkerGroupSpec,
+                          idx: int, reason: str) -> bool:
+        """Warm pre-replacement: adopt a ready warm slice from a
+        matching (accelerator, topology) pool in the namespace instead
+        of a cold build.  Adoption stamps cluster identity onto the
+        claimed pods via label patches (never conflict-injected: the
+        claim deliberately has no retry loop).  Returns True when a
+        slice was adopted as ``group/idx``."""
+        if self.warmpool is None or not features.enabled("WarmSlicePools"):
+            return False
+        ns, name = cluster.metadata.namespace, cluster.metadata.name
+        pools = [o for o in self.store.list(KIND_WARM_POOL, ns)
+                 if o.get("spec", {}).get("accelerator") == group.accelerator
+                 and o.get("spec", {}).get("topology") == group.topology
+                 and not o["metadata"].get("deletionTimestamp")]
+        for pool in sorted(pools, key=lambda o: o["metadata"]["name"]):
+            names = self.warmpool.claim(pool["metadata"]["name"], ns)
+            if not names:
+                continue
+            for pname in names:
+                try:
+                    self.store.patch_labels(
+                        "Pod", pname, ns,
+                        {C.LABEL_CLUSTER: name,
+                         C.LABEL_GROUP: group.groupName,
+                         C.LABEL_SLICE_INDEX: str(idx)})
+                except NotFound:
+                    # Vanished mid-adoption: the incomplete-slice sweep
+                    # cleans the remainder next pass, cold rebuild.
+                    pass
+            if self.metrics is not None:
+                self.metrics.warmpool_claim(reason)
+            self.recorder.normal(
+                cluster.to_dict(), C.EVENT_ADOPTED_WARM_SLICE,
+                f"adopted warm slice from pool {pool['metadata']['name']} "
+                f"as {group.groupName}/{idx} ({reason})")
+            return True
+        if pools and self.metrics is not None:
+            self.metrics.warmpool_claim("miss")
+        return False
 
     def _clear_executed_victims(self, cluster: TpuCluster,
                                 raw: Dict[str, Any], group_name: str,
@@ -566,6 +791,8 @@ class TpuClusterController:
             self._observe_slice_ready(cluster, group, slices, ready_idx,
                                       topo.num_hosts)
             ready_slices = len(ready_idx)
+            self._observe_warned_recovery(cluster, group, slices,
+                                          ready_slices, desired)
             gs = WorkerGroupStatus(
                 groupName=group.groupName,
                 desiredSlices=desired,
@@ -671,6 +898,27 @@ class TpuClusterController:
                  if k[0] == ns and k[1] == name
                  and k[2] == group.groupName and k[3] not in ready_idx}
         self._slices_observed_ready -= stale
+
+    def _observe_warned_recovery(self, cluster: TpuCluster,
+                                 group: WorkerGroupSpec,
+                                 slices: Dict[int, List[Dict[str, Any]]],
+                                 ready_slices: int, desired: int):
+        """Close the warned-recovery clock: once a noticed slice is gone
+        AND the group is back at full readiness, observe
+        ``tpu_preemption_warned_recovery_seconds`` (notice first sight ->
+        capacity restored) exactly once per notice."""
+        ns, name = cluster.metadata.namespace, cluster.metadata.name
+        snames = {plist[0]["metadata"]["labels"].get(C.LABEL_SLICE_NAME)
+                  for plist in slices.values() if plist}
+        for k in list(self._notice_started):
+            if k[0] != ns or k[1] != name or k[2] != group.groupName:
+                continue
+            if k[3] in snames or ready_slices < desired:
+                continue
+            started = self._notice_started.pop(k)
+            if self.metrics is not None:
+                self.metrics.observe_warned_recovery(
+                    name, group.groupName, time.time() - started)
 
     def _set_status(self, cluster: TpuCluster, state: str, reason: str = ""):
         obj = cluster.to_dict()

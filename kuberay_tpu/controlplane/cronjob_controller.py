@@ -64,6 +64,20 @@ class TpuCronJobController:
             last = cron.status.lastScheduleTime or cron.metadata.creationTimestamp
             due = missed_runs(cron.spec.schedule, last, now,
                               horizon_seconds=horizon)
+            if due and self._preemption_active(cron.metadata.namespace):
+                # Backfill hold: while slices in the namespace sit under
+                # an active preemption notice, batch launches would race
+                # the replacement provisioning for capacity.  Keep
+                # lastScheduleTime so the run fires as catch-up (backfill
+                # onto the reclaimed capacity) once the drill clears,
+                # bounded by startingDeadlineSeconds like any miss.
+                self.recorder.normal(
+                    cron.to_dict(), "BackfillHold",
+                    "deferring scheduled run: preemption drill active "
+                    "in namespace")
+                self._prune_history(cron)
+                self._update_status(cron)
+                return 5.0
             if due:
                 # Only the most recent missed run is executed (standard
                 # CronJob catch-up semantics; the rest are logged as missed).
@@ -89,6 +103,20 @@ class TpuCronJobController:
         # double-reconciles cannot double-launch (create is the idempotency
         # barrier).
         return truncate_name(f"{cron.metadata.name}-{int(scheduled) // 60}")
+
+    def _preemption_active(self, namespace: str) -> bool:
+        """Any live (non-deleting) pod in the namespace under an active,
+        undrained preemption notice: its capacity is about to vanish
+        and the replacement claim/build is in flight."""
+        for p in self.store.list("Pod", namespace):
+            md = p.get("metadata", {})
+            if md.get("deletionTimestamp"):
+                continue
+            ann = md.get("annotations", {}) or {}
+            if ann.get(C.ANNOTATION_PREEMPTION_NOTICE) and \
+                    not ann.get(C.ANNOTATION_DRAINED_AT):
+                return True
+        return False
 
     def _refresh_active(self, cron: TpuCronJob):
         active = []

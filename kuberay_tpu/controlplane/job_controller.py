@@ -37,8 +37,9 @@ from kuberay_tpu.builders.job import (
     build_submitter_job,
 )
 from kuberay_tpu.controlplane.events import EventRecorder
-from kuberay_tpu.controlplane.store import (AlreadyExists, NotFound,
-                                             ObjectStore)
+from kuberay_tpu.controlplane.store import (AlreadyExists, Conflict,
+                                             NotFound, ObjectStore)
+from kuberay_tpu.controlplane.warmpool_controller import KIND_WARM_POOL
 from kuberay_tpu.obs.goodput import NOOP_TRANSITIONS
 from kuberay_tpu.obs.trace import NOOP_TRACER
 from kuberay_tpu.runtime.coordinator_client import CoordinatorError
@@ -230,6 +231,11 @@ class TpuJobController:
                               "cluster disappeared while running")
         job.status.clusterStatus = cluster.status.to_dict()
 
+        r = self._reconcile_elastic(job, cluster)
+        if r is not None:
+            self._update(job)
+            return r
+
         app_status = None
         # Submitter (K8s Job) status (ref checkSubmitterAndUpdateStatus :1062).
         if job.spec.submissionMode == JobSubmissionMode.K8S_JOB:
@@ -332,6 +338,71 @@ class TpuJobController:
 
     def _state_terminal(self, job: TpuJob) -> Optional[float]:
         return self._handle_deletion_policy(job)
+
+    # ------------------------------------------------------------------
+    # elastic capacity (spec.elastic, docs/preemption.md)
+    # ------------------------------------------------------------------
+
+    def _reconcile_elastic(self, job: TpuJob,
+                           cluster: TpuCluster) -> Optional[float]:
+        """``shrink`` mode: when preemption takes slice capacity away
+        (a live pod carries a notice, or a slice host already Failed)
+        and no warm replacement stands ready, step the job's own
+        cluster down to the surviving slice count (DP world-size
+        shrink, floored at minReplicas) instead of stalling; restore
+        the original replica count once a ready warm slice returns.
+        Selector-targeted (shared) clusters are never resized."""
+        pol = job.spec.elastic
+        if pol is None or pol.mode != "shrink" or job.spec.clusterSelector:
+            return None
+        ns = job.metadata.namespace
+        raw = self.store.try_get(C.KIND_CLUSTER, cluster.metadata.name, ns)
+        if raw is None or not raw["spec"].get("workerGroupSpecs"):
+            return None
+        group = raw["spec"]["workerGroupSpecs"][0]
+        desired = int(group.get("replicas", 0))
+        gs = cluster.status.groups[0] if cluster.status.groups else None
+        ready = int(gs.readySlices) if gs else 0
+        pods = self.store.list("Pod", ns,
+                               labels={C.LABEL_CLUSTER: cluster.metadata.name})
+        lost = any(
+            p["metadata"].get("annotations", {}).get(
+                C.ANNOTATION_PREEMPTION_NOTICE)
+            or p.get("status", {}).get("phase") == "Failed"
+            for p in pods if not p["metadata"].get("deletionTimestamp"))
+        warm_ready = sum(
+            int((o.get("status") or {}).get("readySlices", 0))
+            for o in self.store.list(KIND_WARM_POOL, ns))
+        orig = int(job.status.elasticOriginalReplicas)
+        try:
+            if lost and warm_ready == 0 and ready < desired:
+                floor = max(1, int(pol.minReplicas))
+                shrunk = max(floor, ready)
+                if shrunk < desired:
+                    if not orig:
+                        job.status.elasticOriginalReplicas = desired
+                    group["replicas"] = shrunk
+                    self.store.update(raw)
+                    self.recorder.normal(
+                        job.to_dict(), "ElasticShrink",
+                        f"no replacement capacity: shrank "
+                        f"{cluster.metadata.name} to {shrunk} slice(s) "
+                        f"(was {desired})")
+                    return 1.0
+            elif orig and desired < orig and warm_ready > 0:
+                group["replicas"] = orig
+                self.store.update(raw)
+                job.status.elasticOriginalReplicas = 0
+                self.recorder.normal(
+                    job.to_dict(), "ElasticRestore",
+                    f"capacity returned: restored {cluster.metadata.name} "
+                    f"to {orig} slice(s)")
+                return 1.0
+        except Conflict:
+            if self.metrics is not None:
+                self.metrics.reconcile_conflict(self.KIND)
+            return 1.0
+        return None
 
     # ------------------------------------------------------------------
     # deletion engine (ref handleDeletionRules :1413)

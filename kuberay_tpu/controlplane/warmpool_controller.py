@@ -19,6 +19,7 @@ pool maintenance loop is functional and slice-granular, behind the
 
 from __future__ import annotations
 
+import threading
 from typing import Any, Dict, List, Optional
 
 from kuberay_tpu.api.common import ObjectMeta, PodTemplateSpec, Serializable
@@ -62,6 +63,10 @@ class WarmSlicePoolController:
         self.tracer = tracer or NOOP_TRACER
         self.store = store
         self.recorder = recorder or EventRecorder(store)
+        # claim() serialization: two simultaneous preemption drains must
+        # not adopt the same warm slice (one wins the warm claim, the
+        # other falls back to a cold build).
+        self._claim_lock = threading.Lock()
 
     def _pool_cluster(self, obj: Dict[str, Any]) -> TpuCluster:
         """A warm pool reuses the slice builders via a synthetic cluster
@@ -185,7 +190,12 @@ class WarmSlicePoolController:
     def claim(self, name: str, namespace: str = "default") -> Optional[List[str]]:
         """Claim one ready warm slice: marks its pods claimed and returns
         their names (the adopter takes over their lifecycle).  Only
-        COMPLETE slices qualify — a partial slice has no ICI ring."""
+        COMPLETE slices qualify — a partial slice has no ICI ring.
+
+        Serialized: the lock plus a fresh per-pod re-read right before
+        the claim stamp makes concurrent claimants (two preemption
+        drains racing for a pool of one) resolve to exactly one winner;
+        the loser gets None and cold-provisions instead."""
         obj = self.store.try_get(self.KIND, name, namespace)
         if obj is None:
             return None
@@ -194,12 +204,24 @@ class WarmSlicePoolController:
                 .slice_topology().num_hosts
         except TopologyError:
             return None
-        for idx, plist in sorted(self._pool_pods(name, namespace).items()):
-            if idx >= 0 and len(plist) == hosts and all(
-                    p.get("status", {}).get("phase") == "Running"
-                    for p in plist):
+        with self._claim_lock:
+            for idx, plist in sorted(self._pool_pods(name, namespace).items()):
+                if idx < 0 or len(plist) != hosts:
+                    continue
+                # Re-read each pod under the lock: the listing above is a
+                # snapshot, and a slice another claimant just stamped (or
+                # a pod that failed/vanished meanwhile) must not be
+                # handed out twice.
+                fresh = [self.store.try_get("Pod", p["metadata"]["name"],
+                                            namespace) for p in plist]
+                if any(p is None
+                       or p["metadata"]["labels"].get(LABEL_WARM_CLAIMED)
+                       or p["metadata"].get("deletionTimestamp")
+                       or p.get("status", {}).get("phase") != "Running"
+                       for p in fresh):
+                    continue
                 names = []
-                for p in plist:
+                for p in fresh:
                     self.store.patch_labels(
                         "Pod", p["metadata"]["name"], namespace,
                         {LABEL_WARM_CLAIMED: "true"})

@@ -373,8 +373,15 @@ class GoodputLedger:
             nxt = PHASE_QUEUED if not e.pods else PHASE_TEARDOWN
             self._transition_locked(key, e, nxt, now)
             return
-        full = (n_running > 0 and not down and n_starting == 0
-                and (exp is None or n_running >= exp))
+        # Full strength: when the expected count is known, surplus
+        # starting pods on top of it (a pre-provisioned preemption
+        # replacement building while the old slice still runs) must not
+        # demote the cluster out of PRODUCTIVE — training is running at
+        # strength the whole time.  Without an expected count, any
+        # starting pod still means bootstrap.
+        full = (n_running > 0 and not down
+                and ((exp is not None and n_running >= exp)
+                     or (exp is None and n_starting == 0)))
         if full:
             e.reached_productive = True
             e.growing = False

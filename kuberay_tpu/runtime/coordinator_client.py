@@ -128,6 +128,14 @@ class CoordinatorClient:
         — what serve pods read their engine settings from."""
         return self._req("GET", "/api/serve/config")
 
+    # checkpoint drain (preemption notice -> save before the kill;
+    # docs/preemption.md): the coordinator fans the request out to the
+    # training loop's CheckpointWriter.
+    def request_checkpoint(self, tag: str = "",
+                           reason: str = "preemption") -> Dict[str, Any]:
+        return self._req("POST", "/api/checkpoint",
+                         {"tag": tag, "reason": reason})
+
     # device profiling (jax.profiler traces on the head)
     def start_profile(self, duration_s: float = 0.0) -> Dict[str, Any]:
         return self._req("POST", "/api/profile/start",
@@ -179,8 +187,20 @@ class FakeCoordinatorClient:
         self.serve_apps: Dict[str, Any] = {}
         self.healthy = True
         self.submit_count = 0
+        # DCN partition simulation: while True, every control-plane RPC
+        # fails as if the head were unreachable (sim/harness
+        # _sync_partitions flips this for the partition window).  Test
+        # helpers (set_job_status, ...) stay usable regardless.
+        self.partitioned = False
+        # Recorded checkpoint-drain requests: [{"tag", "reason"}].
+        self.checkpoint_requests: List[Dict[str, Any]] = []
+
+    def _check_partition(self):
+        if self.partitioned:
+            raise CoordinatorError("dcn partition: coordinator unreachable")
 
     def submit_job(self, job_id, entrypoint, runtime_env=None, metadata=None):
+        self._check_partition()
         with self._lock:
             self.submit_count += 1
             if job_id not in self.jobs:
@@ -188,6 +208,7 @@ class FakeCoordinatorClient:
             return job_id
 
     def get_job_info(self, job_id):
+        self._check_partition()
         with self._lock:
             info = self.jobs.get(job_id)
             if info is None:
@@ -195,9 +216,16 @@ class FakeCoordinatorClient:
             return info
 
     def stop_job(self, job_id):
+        self._check_partition()
         with self._lock:
             if job_id in self.jobs:
                 self.jobs[job_id].status = "STOPPED"
+
+    def request_checkpoint(self, tag="", reason="preemption"):
+        self._check_partition()
+        with self._lock:
+            self.checkpoint_requests.append({"tag": tag, "reason": reason})
+            return {"requested": True, "tag": tag}
 
     def delete_job(self, job_id):
         with self._lock:
@@ -208,6 +236,7 @@ class FakeCoordinatorClient:
             return list(self.jobs.values())
 
     def update_serve_apps(self, config):
+        self._check_partition()
         with self._lock:
             self.serve_config = config
 
@@ -216,7 +245,7 @@ class FakeCoordinatorClient:
             return dict(self.serve_apps)
 
     def healthz(self):
-        return self.healthy
+        return self.healthy and not self.partitioned
 
     # test helpers
     def set_job_status(self, job_id, status, message=""):

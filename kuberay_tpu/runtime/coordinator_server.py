@@ -208,7 +208,8 @@ class CoordinatorServer:
                  log_dir: str = "/tmp/tpu-coordinator-logs",
                  spawn_jobs: bool = True,
                  auth_token: Optional[str] = None,
-                 goodput=None):
+                 goodput=None,
+                 on_checkpoint=None):
         # Bearer auth (ref cluster token auth): token comes from the
         # operator-minted Secret via the TPU_AUTH_TOKEN env.
         self.auth_token = (auth_token if auth_token is not None
@@ -239,7 +240,35 @@ class CoordinatorServer:
         # collector archives it like any node file).
         self.profile_dir = os.path.join(log_dir, "profiles")
         self._profiling: Optional[str] = None
+        # Checkpoint-drain hook (docs/preemption.md): the operator POSTs
+        # /api/checkpoint when a slice gets a preemption notice; the
+        # training harness wires a callback that drives its
+        # CheckpointWriter.  Requests are recorded either way so the
+        # drain is observable even without a hook installed.
+        self.on_checkpoint = on_checkpoint
+        self.checkpoint_requests: list = []
         self._recover()
+
+    # -- checkpoint drain --------------------------------------------------
+
+    def request_checkpoint(self, tag: str = "",
+                           reason: str = "preemption") -> Dict[str, Any]:
+        """Fan a drain-time checkpoint request out to the training loop.
+
+        The hook runs outside the lock (it may block on a real save);
+        its failure is reported to the caller but never raises — the
+        operator's drain path treats checkpointing as best-effort."""
+        req = {"tag": tag, "reason": reason, "received_at": time.time()}
+        with self._lock:
+            self.checkpoint_requests.append(req)
+        hook = self.on_checkpoint
+        if hook is not None:
+            try:
+                hook(tag, reason)
+            except Exception as e:
+                return {"requested": True, "tag": tag,
+                        "error": f"checkpoint hook failed: {e}"}
+        return {"requested": True, "tag": tag}
 
     # -- device profiling --------------------------------------------------
 
@@ -569,6 +598,10 @@ class CoordinatorServer:
                         b.get("entrypoint", ""), b.get("runtime_env"),
                         b.get("metadata"))
                     return self._send(200, {"submission_id": rec.job_id})
+                if self.path == "/api/checkpoint":
+                    b = self._body()
+                    return self._send(200, coord.request_checkpoint(
+                        b.get("tag", ""), b.get("reason", "preemption")))
                 if self.path == "/api/profile/start":
                     out = coord.start_profile(
                         float(self._body().get("duration_s", 0) or 0))

@@ -46,8 +46,18 @@ ALL_FAULTS = (STORE_CONFLICT, WATCH_DROP, WATCH_DUP, WATCH_DELAY,
               POD_KILL, SLICE_DRAIN, SLOW_START, DELETE_RACE,
               LEADER_FAILOVER)
 
+# Extension step faults (preemption lifecycle).  Kept OUT of ALL_FAULTS
+# on purpose: arm() draws one rng sample per ALL_FAULTS entry whether or
+# not the profile enables it, so extending that tuple would shift the
+# rng stream of every existing (scenario, seed) and break the
+# byte-identical replay-hash contract.  EXT_FAULTS are drawn in a second
+# loop only when a profile explicitly enables them.
+PREEMPTION_NOTICE = "preemption_notice"
+DCN_PARTITION = "dcn_partition"
+EXT_FAULTS = (PREEMPTION_NOTICE, DCN_PARTITION)
+
 STEP_FAULTS = (POD_KILL, SLICE_DRAIN, SLOW_START, DELETE_RACE,
-               LEADER_FAILOVER)
+               LEADER_FAILOVER, PREEMPTION_NOTICE, DCN_PARTITION)
 
 #: Default per-step arming weights; a scenario overrides with its own
 #: profile (fault -> mean injections per step; 0 disables).
@@ -82,7 +92,9 @@ class FaultPlan:
     def __init__(self, seed: int,
                  profile: Optional[Dict[str, float]] = None,
                  watch_delay_seconds: Tuple[float, float] = (0.5, 8.0),
-                 slow_start_seconds: Tuple[float, float] = (1.0, 20.0)):
+                 slow_start_seconds: Tuple[float, float] = (1.0, 20.0),
+                 notice_delta_seconds: Tuple[float, float] = (10.0, 25.0),
+                 partition_window_seconds: Tuple[float, float] = (5.0, 15.0)):
         self.seed = seed
         self.rng = random.Random(seed)
         self.profile = dict(DEFAULT_PROFILE)
@@ -90,11 +102,15 @@ class FaultPlan:
             self.profile.update(profile)
         self.watch_delay_seconds = watch_delay_seconds
         self.slow_start_seconds = slow_start_seconds
-        self._armed: Dict[str, int] = {f: 0 for f in ALL_FAULTS}
+        self.notice_delta_seconds = notice_delta_seconds
+        self.partition_window_seconds = partition_window_seconds
+        self._armed: Dict[str, int] = {f: 0
+                                       for f in ALL_FAULTS + EXT_FAULTS}
         self._suspended = False
         self._deferred: List[Tuple[float, Event]] = []
         self._now = lambda: 0.0     # bound by the harness (virtual clock)
-        self.injected: Dict[str, int] = {f: 0 for f in ALL_FAULTS}
+        self.injected: Dict[str, int] = {f: 0
+                                         for f in ALL_FAULTS + EXT_FAULTS}
         # Observer for every injection (harness exports it as the
         # ``sim_faults_injected_total{fault}`` counter).
         self.on_inject = lambda fault: None
@@ -121,6 +137,18 @@ class FaultPlan:
                 step_faults.extend([fault] * count)
             else:
                 self._armed[fault] += count
+        # Extension faults draw ONLY when enabled: a profile that never
+        # names them consumes zero extra rng samples, so pre-extension
+        # scenarios keep their exact replay hashes.
+        for fault in EXT_FAULTS:
+            rate = self.profile.get(fault, 0.0)
+            if rate <= 0.0:
+                continue
+            count = int(rate)
+            if self.rng.random() < rate - count:
+                count += 1
+            if count > 0:
+                step_faults.extend([fault] * count)
         return step_faults
 
     def disarm(self) -> None:
@@ -201,4 +229,14 @@ class FaultPlan:
 
     def draw_slow_start(self) -> float:
         lo, hi = self.slow_start_seconds
+        return self.rng.uniform(lo, hi)
+
+    def draw_notice_delta(self) -> float:
+        """Advance warning a preemption notice gives before the kill."""
+        lo, hi = self.notice_delta_seconds
+        return self.rng.uniform(lo, hi)
+
+    def draw_partition_window(self) -> float:
+        """How long a DCN partition severs cross-slice connectivity."""
+        lo, hi = self.partition_window_seconds
         return self.rng.uniform(lo, hi)

@@ -56,9 +56,11 @@ from kuberay_tpu.obs import (
 from kuberay_tpu.runtime.coordinator_client import FakeCoordinatorClient
 from kuberay_tpu.sim.clock import VirtualClock, patch_time
 from kuberay_tpu.sim.faults import (
+    DCN_PARTITION,
     DELETE_RACE,
     LEADER_FAILOVER,
     POD_KILL,
+    PREEMPTION_NOTICE,
     SLICE_DRAIN,
     SLOW_START,
     FaultPlan,
@@ -213,10 +215,17 @@ class SimHarness:
                 name = status_or_name
             return self.clients.setdefault(name, FakeCoordinatorClient())
 
+        # Warm pool first: the cluster controller claims warm slices from
+        # it on a preemption notice (warm pre-replacement), and fires the
+        # checkpoint-drain hook through the coordinator client provider.
+        self.warmpool_controller = WarmSlicePoolController(
+            self.store, recorder=self.recorder, tracer=self.tracer)
         self.cluster_controller = TpuClusterController(
             self.store, expectations=self.manager.expectations,
             recorder=self.recorder, metrics=self.metrics,
-            tracer=self.tracer, transitions=transitions)
+            tracer=self.tracer, transitions=transitions,
+            warmpool=self.warmpool_controller,
+            client_provider=lambda status: provider(status))
         self.job_controller = TpuJobController(
             self.store, recorder=self.recorder,
             client_provider=lambda status: provider(status),
@@ -227,8 +236,6 @@ class SimHarness:
             client_provider=lambda cname, status: provider(cname, status),
             tracer=self.tracer, transitions=transitions)
         self.cronjob_controller = TpuCronJobController(
-            self.store, recorder=self.recorder, tracer=self.tracer)
-        self.warmpool_controller = WarmSlicePoolController(
             self.store, recorder=self.recorder, tracer=self.tracer)
 
         m = self.manager
@@ -251,6 +258,11 @@ class SimHarness:
         self._journal_rv = 0
         self._failover_count = 0
         self._step = 0
+        # Preemption machinery: (kill deadline, ns, slice) for slices
+        # under an advance notice, and (ns, cluster) -> partition-window
+        # end for clusters whose DCN connectivity is severed.
+        self._pending_kills: List[tuple] = []
+        self._partitioned_until: Dict[tuple, float] = {}
 
         if scenario is not None:
             with self.plan.suspended():
@@ -287,12 +299,22 @@ class SimHarness:
             if ev.kind in _JOURNAL_SKIP_KINDS:
                 continue
             md = ev.obj.get("metadata", {})
-            self.journal.append({
+            rec = {
                 "type": ev.type, "kind": ev.kind,
                 "ns": md.get("namespace", "default"),
                 "name": md.get("name", ""),
                 "rv": erv, "uid": md.get("uid", ""),
-            })
+            }
+            # Preemption lifecycle keys, appended ONLY when present so
+            # runs without notices keep their pre-extension record shape
+            # (and therefore their byte-identical journal hashes).
+            if ev.kind == "Pod":
+                ann = md.get("annotations") or {}
+                if C.ANNOTATION_PREEMPTION_NOTICE in ann:
+                    rec["notice"] = ann[C.ANNOTATION_PREEMPTION_NOTICE]
+                if C.ANNOTATION_DRAINED_AT in ann:
+                    rec["drained"] = ann[C.ANNOTATION_DRAINED_AT]
+            self.journal.append(rec)
         self._journal_rv = latest
 
     def journal_hash(self) -> str:
@@ -345,6 +367,8 @@ class SimHarness:
             journal_before = len(self.journal)
             self.manager.run_until_idle()
             self.kubelet.step()
+            killed = self._fire_due_kills()
+            parted = self._sync_partitions()
             due = self.plan.pop_due_deferred(self.clock.now())
             for ev in due:
                 self.store.redeliver(ev)
@@ -353,7 +377,8 @@ class SimHarness:
             self._drain_journal()
             if self.alerts is not None:
                 self.alerts.evaluate()
-            if len(self.journal) > journal_before or due or drove or swept:
+            if len(self.journal) > journal_before or due or drove or swept \
+                    or killed or parted:
                 resynced = False
                 continue
             nxt = self._next_wakeup()
@@ -375,9 +400,19 @@ class SimHarness:
     def _next_wakeup(self) -> Optional[float]:
         candidates = [t for t in (self.manager.next_delayed_at(),
                                   self.plan.next_deferred_at(),
-                                  self.kubelet.next_hold_at())
+                                  self.kubelet.next_hold_at(),
+                                  self._next_kill_at(),
+                                  self._next_partition_end())
                       if t is not None]
         return min(candidates) if candidates else None
+
+    def _next_kill_at(self) -> Optional[float]:
+        return (min(t for t, _, _ in self._pending_kills)
+                if self._pending_kills else None)
+
+    def _next_partition_end(self) -> Optional[float]:
+        return (min(self._partitioned_until.values())
+                if self._partitioned_until else None)
 
     def _resync_all(self):
         for kind in SIM_KINDS:
@@ -433,6 +468,77 @@ class SimHarness:
                     changed += 1
         return changed
 
+    # -- preemption notices / DCN partitions -------------------------------
+
+    def inject_preemption_notice(self, namespace: str, slice_name: str,
+                                 delta: float) -> float:
+        """Deliver an advance preemption warning for one slice: every
+        pod of the slice gets the notice annotation (deadline = now +
+        ``delta``), and the harness kills the slice at the deadline —
+        the GKE maintenance-notice shape.  Returns the kill deadline."""
+        deadline = self.clock.now() + delta
+        with self.plan.suspended():
+            self._notice_slice(namespace, slice_name, deadline)
+        return deadline
+
+    def _notice_slice(self, ns: str, sname: str, deadline: float) -> int:
+        pods = self.store.list("Pod", ns,
+                               labels={C.LABEL_SLICE_NAME: sname})
+        stamped = 0
+        for pod in pods:
+            try:
+                self.store.patch(
+                    "Pod", pod["metadata"]["name"], ns,
+                    {"metadata": {"annotations": {
+                        C.ANNOTATION_PREEMPTION_NOTICE:
+                            f"{deadline:.3f}"}}})
+                stamped += 1
+            except (NotFound, Conflict):
+                continue
+        if stamped:
+            self._pending_kills.append((deadline, ns, sname))
+        return stamped
+
+    def _fire_due_kills(self) -> int:
+        """Preemption deadlines that have arrived: the warned slice dies
+        now, whether or not the controller finished its drain."""
+        now = self.clock.now()
+        due = sorted(k for k in self._pending_kills if k[0] <= now)
+        if not due:
+            return 0
+        self._pending_kills = [k for k in self._pending_kills
+                               if k[0] > now]
+        with self.plan.suspended():
+            for _, ns, sname in due:
+                self.kubelet.fail_slice(sname, ns)
+        return len(due)
+
+    def _partition_client_keys(self, ns: str, cname: str) -> List[str]:
+        keys = {cname}
+        obj = self.store.try_get(C.KIND_CLUSTER, cname, ns)
+        if obj is not None:
+            head_svc = (obj.get("status") or {}).get("headServiceName")
+            if head_svc:
+                keys.add(head_svc)
+        return sorted(keys)
+
+    def _sync_partitions(self) -> bool:
+        """Reflect active DCN partition windows onto the cluster's
+        coordinator clients (submit/poll/checkpoint raise while severed)
+        and lift expired ones."""
+        now = self.clock.now()
+        changed = False
+        for (ns, cname), until in sorted(self._partitioned_until.items()):
+            severed = until > now
+            for key in self._partition_client_keys(ns, cname):
+                client = self.clients.get(key)
+                if client is not None and client.partitioned != severed:
+                    client.partitioned = severed
+                    changed = True
+        self._partitioned_until = {
+            k: t for k, t in self._partitioned_until.items() if t > now}
+        return changed
+
     # -- fault application -------------------------------------------------
 
     def _record_fault(self, fault: str):
@@ -485,6 +591,45 @@ class SimHarness:
                                       victim["metadata"]["namespace"])
                 except NotFound:
                     return False
+            elif fault == PREEMPTION_NOTICE:
+                noticed = {(t[1], t[2]) for t in self._pending_kills}
+                slices = sorted({
+                    (p["metadata"]["namespace"],
+                     p["metadata"]["labels"][C.LABEL_SLICE_NAME])
+                    for p in self._candidate_pods()
+                    if C.LABEL_SLICE_NAME in p["metadata"]["labels"]
+                    and C.LABEL_CLUSTER in p["metadata"]["labels"]
+                    and C.ANNOTATION_PREEMPTION_NOTICE not in
+                    (p["metadata"].get("annotations") or {})})
+                slices = [s for s in slices if s not in noticed]
+                if not slices:
+                    return False
+                ns, sname = rng.choice(slices)
+                deadline = self.clock.now() + self.plan.draw_notice_delta()
+                if not self._notice_slice(ns, sname, deadline):
+                    return False
+            elif fault == DCN_PARTITION:
+                clusters = sorted(
+                    (c["metadata"].get("namespace", "default"),
+                     c["metadata"]["name"])
+                    for c in self.store.list(C.KIND_CLUSTER)
+                    if not c["metadata"].get("deletionTimestamp"))
+                if not clusters:
+                    return False
+                ns, cname = rng.choice(clusters)
+                until = self.clock.now() + self.plan.draw_partition_window()
+                try:
+                    self.store.patch(
+                        C.KIND_CLUSTER, cname, ns,
+                        {"metadata": {"annotations": {
+                            C.ANNOTATION_DCN_PARTITION_UNTIL:
+                                f"{until:.3f}"}}})
+                except (NotFound, Conflict):
+                    return False
+                key = (ns, cname)
+                self._partitioned_until[key] = max(
+                    until, self._partitioned_until.get(key, 0.0))
+                self._sync_partitions()
             elif fault == LEADER_FAILOVER:
                 crs = []
                 for kind in SIM_KINDS:

@@ -335,6 +335,35 @@ def check_service_capacity(ctx: CheckContext) -> List[Violation]:
 # no-resurrection: a deleted object's uid never reappears
 # ---------------------------------------------------------------------------
 
+@checker("drain-before-delete",
+         "a slice pod deleted while carrying an active preemption notice "
+         "must have been drained (checkpoint requested, drained-at "
+         "stamped) before the delete — teardown routes through the drain "
+         "seam")
+def check_drain_before_delete(ctx: CheckContext) -> List[Violation]:
+    out: List[Violation] = []
+    flagged = set()
+    for rec in ctx.journal:
+        # The harness journals the notice/drained annotations onto every
+        # Pod record that carries them; a DELETED record with a notice
+        # but no drain acknowledgment is a teardown that bypassed the
+        # checkpoint-drain seam.
+        if rec.get("type") != "DELETED" or rec.get("kind") != "Pod":
+            continue
+        if "notice" not in rec or "drained" in rec:
+            continue
+        key = f"Pod {rec.get('ns')}/{rec.get('name')}"
+        if key in flagged:
+            continue
+        flagged.add(key)
+        out.append(Violation(
+            "drain-before-delete", key,
+            f"deleted at rv {rec.get('rv')} under preemption notice "
+            f"(deadline {rec.get('notice')}) with no preceding "
+            "drain/checkpoint acknowledgment"))
+    return out
+
+
 @checker("no-resurrection",
          "once the journal records DELETED for a uid, no later ADDED or "
          "MODIFIED event carries that uid (a status write never "

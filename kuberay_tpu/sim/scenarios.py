@@ -273,6 +273,78 @@ class _ShardRestart:
 
 
 # ---------------------------------------------------------------------------
+# preemption drill: advance-notice kills against a warm standby
+# ---------------------------------------------------------------------------
+
+@scenario(
+    "preemption-drill",
+    "advance-notice preemptions against a cluster with a warm standby "
+    "pool: the controller must drain (checkpoint) and pre-provision the "
+    "replacement before the kill, old slice whole until the new one is "
+    "Ready",
+    # DELETE_RACE stays 0: a raw harness delete of a noticed pod would
+    # bypass the drain seam by construction and false-positive the
+    # drain-before-delete checker; the drill is about the warned path.
+    profile={F.PREEMPTION_NOTICE: 0.7, F.POD_KILL: 0.2, F.SLOW_START: 0.3,
+             F.STORE_CONFLICT: 0.3, F.WATCH_DROP: 0.2, F.WATCH_DUP: 0.2,
+             F.WATCH_DELAY: 0.2, F.DELETE_RACE: 0.0, F.SLICE_DRAIN: 0.0,
+             F.LEADER_FAILOVER: 0.0})
+class _PreemptionDrill:
+    def setup(self, h):
+        # Pool topology matches the worker group (v5e 4x4 = 4 hosts), so
+        # a claimed warm slice is adoptable as-is.
+        h.store.create(make_cluster_obj("drill", accelerator="v5e",
+                                        topology="4x4", replicas=2,
+                                        max_replicas=4))
+        h.store.create({
+            "apiVersion": C.API_VERSION, "kind": "WarmSlicePool",
+            "metadata": {"name": "reserve"},
+            "spec": {"accelerator": "v5e", "topology": "4x4",
+                     "poolSize": 1},
+            "status": {},
+        })
+
+    def tick(self, h, step):
+        # The workload holds still: the adversity is the notice schedule
+        # itself (notice at t, kill at t+delta, warm claim in between).
+        return
+
+
+# ---------------------------------------------------------------------------
+# dcn partition: cross-slice connectivity loss on a multi-slice cluster
+# ---------------------------------------------------------------------------
+
+@scenario(
+    "dcn-partition",
+    "a multi-slice cluster + HTTPMode job whose DCN connectivity drops "
+    "for seeded windows: coordinator calls fail while severed, the job "
+    "must recover when the window lifts, never wedge",
+    profile={F.DCN_PARTITION: 0.6, F.POD_KILL: 0.3, F.SLOW_START: 0.3,
+             F.STORE_CONFLICT: 0.5, F.WATCH_DROP: 0.3, F.WATCH_DUP: 0.3,
+             F.WATCH_DELAY: 0.3, F.DELETE_RACE: 0.0, F.SLICE_DRAIN: 0.0,
+             F.LEADER_FAILOVER: 0.0})
+class _DcnPartition:
+    def setup(self, h):
+        h.store.create({
+            "apiVersion": C.API_VERSION, "kind": C.KIND_JOB,
+            "metadata": {"name": "multislice"},
+            "spec": {
+                "entrypoint": "python -m train",
+                "submissionMode": "HTTPMode",
+                "clusterSpec": make_cluster_obj(
+                    "ignored", accelerator="v5e", topology="2x2",
+                    replicas=2, max_replicas=4)["spec"],
+            },
+            "status": {},
+        })
+
+    def tick(self, h, step):
+        # Jobs finish between partition windows so submit/poll/terminal
+        # transitions interleave with severed coordinator links.
+        h.succeed_jobs()
+
+
+# ---------------------------------------------------------------------------
 # cronjob burst
 # ---------------------------------------------------------------------------
 

@@ -45,21 +45,51 @@ class CheckpointWriter:
     overlapping saves internally (a new save waits for the previous
     commit), so callers just fire-and-forget per interval and call
     ``close()`` (or ``wait()``) before exiting.
+
+    Background failures are sticky: a commit that dies on the write
+    thread only surfaces at the next manager interaction, so a loop
+    whose FINAL save fails would otherwise exit "cleanly" with a
+    missing checkpoint.  The first failure observed is stored and
+    re-raised by ``wait()`` and ``close()`` (which still closes the
+    manager), and ``save_async`` refuses to start a new save on top of
+    an unacknowledged failure.
     """
 
     def __init__(self, directory: str, keep: int = 3):
         self._mgr = _manager(directory, keep)
+        self._error: Optional[BaseException] = None
 
     def save_async(self, state: Dict[str, Any], step: int) -> None:
+        if self._error is not None:
+            raise self._error
         import orbax.checkpoint as ocp
-        self._mgr.save(step, args=ocp.args.StandardSave(state))
+        try:
+            self._mgr.save(step, args=ocp.args.StandardSave(state))
+        except Exception as e:
+            # Orbax raises the PREVIOUS save's background failure here;
+            # keep it so wait()/close() see it too.
+            self._error = e
+            raise
 
     def wait(self) -> None:
-        self._mgr.wait_until_finished()
+        try:
+            self._mgr.wait_until_finished()
+        except Exception as e:
+            if self._error is None:
+                self._error = e
+        if self._error is not None:
+            raise self._error
 
     def close(self) -> None:
-        self._mgr.wait_until_finished()
-        self._mgr.close()
+        try:
+            self._mgr.wait_until_finished()
+        except Exception as e:
+            if self._error is None:
+                self._error = e
+        finally:
+            self._mgr.close()
+        if self._error is not None:
+            raise self._error
 
     def __enter__(self) -> "CheckpointWriter":
         return self

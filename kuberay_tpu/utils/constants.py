@@ -48,6 +48,13 @@ ANNOTATION_FT_DELETION_TIMEOUT = "tpu.dev/ft-deletion-timeout"
 # Cleanup-Job deletion-timeout fallback clock for store backends that omit
 # creationTimestamp (see cluster_controller._reconcile_deletion):
 ANNOTATION_CLEANUP_OBSERVED_AT = "tpu.dev/cleanup-observed-at"
+# Preemption lifecycle (docs/preemption.md): the advance warning a
+# maintenance event / spot reclaim delivers (value = kill deadline,
+# seconds), the drain acknowledgment the controller stamps once the
+# checkpoint request fired, and the cross-slice DCN partition window end.
+ANNOTATION_PREEMPTION_NOTICE = "tpu.dev/preemption-notice"
+ANNOTATION_DRAINED_AT = "tpu.dev/drained-at"
+ANNOTATION_DCN_PARTITION_UNTIL = "tpu.dev/dcn-partition-until"
 
 # --- GKE TPU node selectors (ref kubectl-plugin/pkg/util/constant.go:13-19) --
 NODE_SELECTOR_GKE_ACCELERATOR = "cloud.google.com/gke-tpu-accelerator"
@@ -116,6 +123,9 @@ EVENT_CREATED_SERVICE = "CreatedService"
 EVENT_FAILED_TO_CREATE = "FailedToCreate"
 EVENT_UNHEALTHY_SLICE = "UnhealthySlice"
 EVENT_INVALID_SPEC = "InvalidSpec"
+EVENT_PREEMPTION_NOTICE = "PreemptionNotice"
+EVENT_DRAINED_SLICE = "DrainedSlice"
+EVENT_ADOPTED_WARM_SLICE = "AdoptedWarmSlice"
 
 # --- Behavior knobs (ref §5.6 env escape hatches) ----------------------------
 ENV_ENABLE_RANDOM_POD_DELETE = "ENABLE_RANDOM_POD_DELETE"

@@ -260,6 +260,19 @@ class ControlPlaneMetrics:
                    "window (--watch-backlog-max); a nonzero rate means "
                    "resuming informers will hit ExpiredError and pay a "
                    "full relist instead of an O(delta) replay")
+        r.describe("tpu_preemption_notices_total",
+                   "Advance preemption notices first observed on a live "
+                   "slice, per cluster and group; each starts the "
+                   "warned-recovery clock")
+        r.describe("tpu_preemption_warned_recovery_seconds",
+                   "Seconds from first sight of a preemption notice to "
+                   "the group back at full readiness with the noticed "
+                   "slice retired; the warned-vs-unwarned recovery gap "
+                   "is the advance-notice dividend chaos_bench gates on")
+        r.describe("tpu_warmpool_claims_total",
+                   "Warm-slice claim attempts by outcome reason: "
+                   "preemption / scale-up (adopted) or miss (no ready "
+                   "warm slice; cold build instead)")
 
     def observe_provisioned(self, cluster: str, seconds: float):
         self.registry.observe("tpu_cluster_provisioned_duration_seconds",
@@ -308,6 +321,18 @@ class ControlPlaneMetrics:
 
     def watch_backlog_evictions(self, n: int = 1):
         self.registry.inc("tpu_watch_backlog_evictions_total", value=n)
+
+    def preemption_notice(self, cluster: str, group: str):
+        self.registry.inc("tpu_preemption_notices_total",
+                          {"cluster": cluster, "group": group})
+
+    def observe_warned_recovery(self, cluster: str, group: str,
+                                seconds: float):
+        self.registry.observe("tpu_preemption_warned_recovery_seconds",
+                              seconds, {"cluster": cluster, "group": group})
+
+    def warmpool_claim(self, reason: str):
+        self.registry.inc("tpu_warmpool_claims_total", {"reason": reason})
 
     def reconcile_conflict(self, kind: str):
         self.registry.inc("tpu_reconcile_conflicts_total", {"kind": kind})

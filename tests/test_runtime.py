@@ -66,6 +66,30 @@ def test_job_failure_and_stop(coord):
     assert info.status == "STOPPED"
 
 
+def test_checkpoint_drain_endpoint(coord):
+    """POST /api/checkpoint (the operator's drain hook on a preemption
+    notice): recorded server-side and fanned out to the installed
+    on_checkpoint callback; a hook failure is reported, not raised."""
+    server, url = coord
+    client = CoordinatorClient(url)
+    seen = []
+    server.on_checkpoint = lambda tag, reason: seen.append((tag, reason))
+    out = client.request_checkpoint(tag="preempt-slice-0")
+    assert out == {"requested": True, "tag": "preempt-slice-0"}
+    assert seen == [("preempt-slice-0", "preemption")]
+    assert [r["tag"] for r in server.checkpoint_requests] == \
+        ["preempt-slice-0"]
+
+    def boom(tag, reason):
+        raise RuntimeError("save failed")
+
+    server.on_checkpoint = boom
+    out = client.request_checkpoint(tag="t2", reason="manual")
+    assert out["requested"] is True and "save failed" in out["error"]
+    assert [r["reason"] for r in server.checkpoint_requests] == \
+        ["preemption", "manual"]
+
+
 def test_serve_config_and_status(coord):
     server, url = coord
     client = CoordinatorClient(url)
@@ -238,6 +262,50 @@ def test_checkpoint_writer_async_overlap(tmp_path):
                     jax.tree.leaves(snap2_params)):
         np.testing.assert_array_equal(np.asarray(a), b)
     assert int(restored2["step"]) == 2
+
+
+def test_checkpoint_writer_surfaces_background_failure():
+    """Regression: a commit that died on Orbax's background write
+    thread only surfaced at the NEXT manager interaction — a training
+    loop whose final save failed exited "cleanly" with a missing
+    checkpoint.  The writer must store the failure and re-raise it from
+    wait() and close() (still closing the manager), and refuse a new
+    save on top of an unacknowledged failure."""
+    from kuberay_tpu.train.checkpoint import CheckpointWriter
+
+    class FakeManager:
+        def __init__(self):
+            self.closed = False
+            self.fail_on_wait = None
+
+        def save(self, step, args=None):
+            pass
+
+        def wait_until_finished(self):
+            if self.fail_on_wait is not None:
+                err, self.fail_on_wait = self.fail_on_wait, None
+                raise err
+
+        def close(self):
+            self.closed = True
+
+    # Bypass __init__ (it builds a real Orbax manager); wire the fake.
+    w = CheckpointWriter.__new__(CheckpointWriter)
+    mgr = FakeManager()
+    w._mgr = mgr
+    w._error = None
+
+    mgr.fail_on_wait = RuntimeError("async commit failed")
+    with pytest.raises(RuntimeError, match="async commit failed"):
+        w.wait()
+    # Sticky: close() re-raises the same failure AND closes the manager
+    # (the fake's wait no longer raises — the stored error does).
+    with pytest.raises(RuntimeError, match="async commit failed"):
+        w.close()
+    assert mgr.closed
+    # A new save on top of an unacknowledged failure must refuse too.
+    with pytest.raises(RuntimeError, match="async commit failed"):
+        w.save_async({}, 1)
 
 
 def test_load_params_for_serving(tmp_path):

@@ -270,7 +270,7 @@ def _seed_cluster(store, replicas=1):
 def test_registry_covers_the_issue_catalog():
     assert {"slice-identity", "slice-atomicity", "gang-admission",
             "warm-pool-accounting", "service-capacity",
-            "no-resurrection"} <= set(CHECKERS)
+            "no-resurrection", "drain-before-delete"} <= set(CHECKERS)
     for name in CHECKERS:
         assert DESCRIPTIONS[name]
 
@@ -330,6 +330,22 @@ def test_checker_partially_running_slice_fires():
     sick["status"] = {"phase": "Pending"}
     store.create(sick)
     assert "slice-atomicity" in _fired(store)
+
+
+def test_checker_drain_before_delete_fires():
+    store = ObjectStore()
+    journal = [{"type": "DELETED", "kind": "Pod", "ns": "default",
+                "name": "w0", "rv": 7, "uid": "sim-uid-000001",
+                "notice": "120.000"}]
+    assert "drain-before-delete" in _fired(store, journal)
+
+
+def test_checker_drain_before_delete_quiet_when_drained():
+    store = ObjectStore()
+    journal = [{"type": "DELETED", "kind": "Pod", "ns": "default",
+                "name": "w0", "rv": 7, "uid": "sim-uid-000001",
+                "notice": "120.000", "drained": "120.000"}]
+    assert "drain-before-delete" not in _fired(store, journal)
 
 
 def test_checker_warm_pool_accounting_fires():

@@ -37,7 +37,8 @@ def test_rule_registry_complete():
             "requeue-observability",
             "phase-transition-recorded",
             "no-io-under-store-lock",
-            "shard-affinity"} <= set(RULES)
+            "shard-affinity",
+            "slice-teardown-through-drain-seam"} <= set(RULES)
     for cls in RULES.values():
         assert cls.DESCRIPTION and cls.INVARIANT
 
@@ -841,6 +842,54 @@ def test_metric_catalog_sync_real_doc_and_tree_agree():
     findings = run_paths([os.path.join(REPO_ROOT, "kuberay_tpu")],
                          only=["metric-catalog-sync"])
     assert findings == [], "\n" + render_human(findings)
+
+
+# ---------------------------------------------------------------------------
+# slice-teardown-through-drain-seam
+# ---------------------------------------------------------------------------
+
+def test_drain_seam_flags_direct_delete_in_group_reconcile():
+    findings, fired = _rules_fired("""
+        class Controller:
+            def _delete_slice(self, cluster, plist, group):
+                for p in plist:
+                    self._delete_pod(p, group)
+                return True
+
+            def _reconcile_worker_group(self, cluster, group, pods):
+                for p in pods:
+                    self._delete_pod(p)
+    """, only=["slice-teardown-through-drain-seam"])
+    assert "slice-teardown-through-drain-seam" in fired
+    assert "_delete_slice" in findings[0].message
+
+
+def test_drain_seam_quiet_when_teardown_routes_through_seam():
+    _, fired = _rules_fired("""
+        class Controller:
+            def _delete_slice(self, cluster, plist, group):
+                for p in plist:
+                    self._delete_pod(p, group)
+                return True
+
+            def _reconcile_worker_group(self, cluster, group, slices):
+                for idx, plist in slices.items():
+                    if not self._delete_slice(cluster, plist, group):
+                        return 1.0
+    """, only=["slice-teardown-through-drain-seam"])
+    assert fired == set()
+
+
+def test_drain_seam_ignores_classes_without_the_seam():
+    # No _delete_slice defined: the class predates the drain seam (or
+    # isn't slice-atomic at all); the rule does not apply.
+    _, fired = _rules_fired("""
+        class Legacy:
+            def _reconcile_worker_group(self, cluster, group, pods):
+                for p in pods:
+                    self._delete_pod(p)
+    """, only=["slice-teardown-through-drain-seam"])
+    assert fired == set()
 
 
 # ---------------------------------------------------------------------------

@@ -96,6 +96,78 @@ def test_pool_claim_releases_slice():
     assert len(unclaimed) == 4
 
 
+def test_concurrent_claims_resolve_to_one_winner():
+    """Two claimants racing for a pool of ONE slice (two preemption
+    drains firing together) must serialize: exactly one wins the warm
+    slice, the loser gets None and cold-provisions."""
+    import threading
+
+    store = ObjectStore()
+    kubelet = FakeKubelet(store)
+    ctrl = WarmSlicePoolController(store)
+    make_pool(store, size=1)
+    ctrl.reconcile("pool1")
+    kubelet.step()
+    barrier = threading.Barrier(2)
+    results = []
+
+    def grab():
+        barrier.wait()
+        results.append(ctrl.claim("pool1"))
+
+    threads = [threading.Thread(target=grab) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wins = [r for r in results if r]
+    assert len(wins) == 1
+    assert len([r for r in results if r is None]) == 1
+    # Every pod of the slice is claimed exactly once.
+    claimed = [p for p in store.list("Pod", labels={LABEL_WARM_POOL: "pool1"})
+               if p["metadata"]["labels"].get(LABEL_WARM_CLAIMED)]
+    assert sorted(p["metadata"]["name"] for p in claimed) == sorted(wins[0])
+
+
+def test_simultaneous_notices_serialize_on_pool_of_one():
+    """End to end: BOTH slices of a cluster get a preemption notice in
+    the same instant against a warm pool of one.  The controller must
+    adopt the single warm slice for one replacement, cold-provision the
+    other, and leave warm-pool accounting (and every other invariant)
+    clean after the kills land."""
+    from kuberay_tpu.sim.harness import SimHarness
+    from kuberay_tpu.sim.scenarios import make_cluster_obj
+
+    with SimHarness(0, fault_profile={}) as h:
+        h.store.create(make_cluster_obj(
+            "drill", accelerator="v5e", topology="4x4",
+            replicas=2, max_replicas=4))
+        h.store.create({
+            "apiVersion": C.API_VERSION, "kind": KIND_WARM_POOL,
+            "metadata": {"name": "reserve", "namespace": "default"},
+            "spec": {"accelerator": "v5e", "topology": "4x4",
+                     "poolSize": 1},
+            "status": {},
+        })
+        h.settle()
+        snames = sorted({
+            p["metadata"]["labels"][C.LABEL_SLICE_NAME]
+            for p in h.store.list("Pod",
+                                  labels={C.LABEL_CLUSTER: "drill"})
+            if C.LABEL_SLICE_NAME in p["metadata"]["labels"]})
+        assert len(snames) == 2
+        for sname in snames:
+            h.inject_preemption_notice("default", sname, 40.0)
+        h.settle()
+        text = h.metrics.registry.render()
+        assert 'tpu_warmpool_claims_total{reason="preemption"} 1' in text
+        # Past the kills and through recovery: back to strength, clean.
+        h.clock.advance_to(h.clock.now() + 200.0)
+        h.settle()
+        violations = h.check()
+        assert violations == [], [str(v) for v in violations]
+
+
 def test_pool_gate_off():
     features.reset()
     store = ObjectStore()
