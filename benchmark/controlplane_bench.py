@@ -128,14 +128,9 @@ def rss_peak_mib() -> float:
         return 0.0
 
 
-def quantile(sorted_samples, q: float) -> float:
-    """Interpolated quantile (same convention as serve_bench)."""
-    if not sorted_samples:
-        return 0.0
-    idx = q * (len(sorted_samples) - 1)
-    lo, hi = int(idx), min(int(idx) + 1, len(sorted_samples) - 1)
-    frac = idx - lo
-    return sorted_samples[lo] * (1 - frac) + sorted_samples[hi] * frac
+# Interpolated quantile over a pre-sorted list — the shared estimator
+# (kuberay_tpu/utils/quantiles.py), same convention as serve_bench.
+from kuberay_tpu.utils.quantiles import sorted_quantile as quantile  # noqa: E402
 
 
 class _AdmissionScheduler:

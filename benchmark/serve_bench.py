@@ -20,23 +20,11 @@ import zlib
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
-
-def percentile(samples, pct):
-    """Interpolated percentile (statistics.quantiles 'inclusive' method).
-
-    The previous truncating index ``int(n * 0.99) - 1`` collapses
-    small-sample p99 toward p90: for n=21 it picks index 19 and never
-    reports the tail sample at all — exactly the latency outlier a p99
-    exists to surface.  Interpolation uses the full tail: for n=21 over
-    1..21 the p99 is 20.8 (between the two largest samples).
-    """
-    import statistics
-    xs = sorted(samples)
-    if not xs:
-        raise ValueError("percentile() of no samples")
-    if len(xs) == 1:
-        return xs[0]
-    return statistics.quantiles(xs, n=100, method="inclusive")[pct - 1]
+# Interpolated percentile — the shared inclusive-method estimator
+# (kuberay_tpu/utils/quantiles.py).  A truncating index collapses
+# small-sample p99 toward p90; tests/test_bench_quantile.py pins the
+# interpolated behavior.
+from kuberay_tpu.utils.quantiles import percentile  # noqa: E402
 
 
 def run(args) -> None:

@@ -1,0 +1,140 @@
+"""Step-telemetry overhead benchmark: the microscope must be ~free.
+
+The straggler microscope (obs/steps.py) rides the hot path of every
+sim tick (and, in production, every coordinator heartbeat batch), so
+its cost is gated, not assumed.  Both legs run the SAME seeded
+``straggler-drill`` scenario — heartbeat emission, slow-window
+bookkeeping, and the virtual clock advance all run identically — and
+differ only in what ``h.steps`` points at:
+
+- ``tracker``: the real :class:`StepTracker` (windowed distributions,
+  skew, verdicts, metric/flight/goodput fan-out);
+- ``noop``: :class:`NoopStepTracker` swapped in right after harness
+  construction — same surface, zero work.
+
+The delta between the two legs is therefore the tracker's cost alone.
+Each repetition times the two legs back-to-back (order alternating per
+rep) so load bursts hit both legs of a pair; the per-seed overhead is
+the median paired delta over the median noop wall, which survives
+outlier reps that a min-of-mins estimator does not.  GC is paused
+inside the timed region.  The run self-gates: mean overhead across
+seeds must stay under ``--gate-pct`` (default 5%) or the process exits
+nonzero.  Both legs must also produce byte-identical journal hashes —
+the observational-only contract, re-checked here.
+
+    python benchmark/telemetry_bench.py --out benchmark/results/telemetry_r5.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import gc
+import json
+import os
+import statistics
+import sys
+import time
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO_ROOT)
+
+from kuberay_tpu.obs import NoopStepTracker  # noqa: E402
+from kuberay_tpu.sim.harness import SimHarness  # noqa: E402
+from kuberay_tpu.sim.scenarios import get_scenario  # noqa: E402
+
+SCHEMA = "tpu-telemetry-bench/v1"
+TICKS = 12
+
+
+def _leg(seed: int, noop: bool) -> tuple:
+    """One drill run; returns (wall seconds, journal hash, beats)."""
+    gc.collect()
+    gc.disable()
+    try:
+        t0 = time.perf_counter()
+        with SimHarness(seed, scenario=get_scenario("straggler-drill"),
+                        steps=True, goodput=True) as h:
+            if noop:
+                h.steps = NoopStepTracker()
+            res = h.run(TICKS)
+            # Stop the clock before the read-side accounting below: the
+            # noop leg would answer it for free, skewing the comparison.
+            wall = time.perf_counter() - t0
+            if not res.ok:
+                raise SystemExit(
+                    f"seed {seed} violations: {res.violations}")
+            beats = sum(host["steps_observed"]
+                        for row in h.steps.to_dict()["jobs"]
+                        for host in h.steps.job_doc(row["job"])["hosts"])
+    finally:
+        gc.enable()
+    return wall, res.journal_hash, beats
+
+
+def run(seeds: int, reps: int) -> dict:
+    rows = []
+    for seed in range(seeds):
+        hashes = set()
+        beats = 0
+        deltas = []
+        noop_walls = []
+        tracker_walls = []
+        _leg(seed, False)  # warmup: fill code/alloc caches off the clock
+        for rep in range(reps):
+            order = ((False, True) if rep % 2 == 0 else (True, False))
+            pair = {}
+            for noop in order:
+                wall, jh, n = _leg(seed, noop)
+                hashes.add(jh)
+                beats = max(beats, n)
+                pair[noop] = wall
+            deltas.append(pair[False] - pair[True])
+            tracker_walls.append(pair[False])
+            noop_walls.append(pair[True])
+        if len(hashes) != 1:
+            raise SystemExit(f"seed {seed}: journal hash diverged "
+                             f"between legs: {sorted(hashes)}")
+        base = statistics.median(noop_walls)
+        overhead = statistics.median(deltas) / base * 100.0
+        rows.append({"seed": seed,
+                     "tracker_s": round(statistics.median(tracker_walls), 6),
+                     "noop_s": round(base, 6),
+                     "heartbeats": beats,
+                     "overhead_pct": round(overhead, 3)})
+    return {"schema": SCHEMA, "ticks": TICKS, "reps": reps, "runs": rows}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seeds", type=int, default=5)
+    ap.add_argument("--reps", type=int, default=3,
+                    help="repetitions per leg; min wall time wins")
+    ap.add_argument("--gate-pct", type=float, default=5.0,
+                    help="max mean overhead before the bench fails")
+    ap.add_argument("--out", default="")
+    args = ap.parse_args()
+
+    doc = run(args.seeds, args.reps)
+    mean = sum(r["overhead_pct"] for r in doc["runs"]) / len(doc["runs"])
+    doc["mean_overhead_pct"] = round(mean, 3)
+    doc["gate_pct"] = args.gate_pct
+    doc["gate_ok"] = mean < args.gate_pct
+
+    for r in doc["runs"]:
+        print(f"seed {r['seed']}: tracker {r['tracker_s']:.4f}s  "
+              f"noop {r['noop_s']:.4f}s  "
+              f"({r['heartbeats']} beats)  "
+              f"overhead {r['overhead_pct']:+.2f}%")
+    print(f"mean overhead {mean:+.2f}%  "
+          f"(gate < {args.gate_pct:.1f}%): "
+          f"{'OK' if doc['gate_ok'] else 'FAIL'}")
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(doc, f, indent=2, sort_keys=True)
+        print(f"wrote {args.out}")
+    return 0 if doc["gate_ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -72,6 +72,7 @@ class ApiHandler(JsonHandler):
     goodput = None                      # obs.GoodputLedger (optional)
     autoscaler = None                   # autoscaler.DecisionAudit (optional)
     alerts = None                       # obs.AlertEngine (optional)
+    steps = None                        # obs.StepTracker (optional)
 
     def _error(self, code: int, message: str, reason: str = ""):
         self._send(code, {"kind": "Status", "status": "Failure",
@@ -257,6 +258,24 @@ class ApiHandler(JsonHandler):
             "kind": kind, "namespace": ns, "name": name,
             "intervals": self.goodput.intervals(kind, ns, name),
             "rollup": roll})
+
+    def _debug_steps(self, path: str):
+        """Training-step telemetry (obs/steps.py): ``/debug/steps``
+        lists one summary row per job (hosts, fleet median, worst skew,
+        open stragglers, MFU); ``/debug/steps/<job>`` returns per-host
+        windowed distributions plus the straggler verdict ring.  Job
+        ids may contain slashes (the sim uses ``ns/cluster``), so
+        everything after the prefix is the job id."""
+        if self.steps is None:
+            return self._error(404, "step telemetry not enabled")
+        parts = [p for p in path.split("/") if p][2:]  # strip debug/steps
+        if not parts:
+            return self._send(200, self.steps.to_dict())
+        job_id = "/".join(parts)
+        doc = self.steps.job_doc(job_id)
+        if doc is None:
+            return self._error(404, f"no step telemetry for job {job_id}")
+        return self._send(200, doc)
 
     def _debug_autoscaler(self):
         """Autoscaler decision audit: the bounded last-N ring of scale
@@ -445,6 +464,8 @@ class ApiHandler(JsonHandler):
             return self._debug_flight(path)
         if path == "/debug/goodput" or path.startswith("/debug/goodput/"):
             return self._debug_goodput(path)
+        if path == "/debug/steps" or path.startswith("/debug/steps/"):
+            return self._debug_steps(path)
         if path == "/debug/autoscaler":
             return self._debug_autoscaler()
         if path == "/debug/alerts":
@@ -661,7 +682,8 @@ def make_server(store: ObjectStore, host: str = "127.0.0.1", port: int = 0,
                 keyfile: Optional[str] = None,
                 history=None, tracer=None,
                 flight=None, goodput=None,
-                autoscaler=None, alerts=None) -> ThreadingHTTPServer:
+                autoscaler=None, alerts=None,
+                steps=None) -> ThreadingHTTPServer:
     """``token`` enables bearer auth on every API verb; ``certfile``/
     ``keyfile`` serve TLS (the authenticated-cluster-endpoint stand-in
     RestObjectStore's client auth is tested against).  ``history``: a
@@ -671,12 +693,14 @@ def make_server(store: ObjectStore, host: str = "127.0.0.1", port: int = 0,
     ``/debug/traces``, ``/debug/flight/...`` and ``/debug/goodput/...``
     forensics surface; ``autoscaler`` (a ``DecisionAudit``) mounts
     ``/debug/autoscaler``; ``alerts`` (an ``obs.AlertEngine``) mounts
-    ``/debug/alerts``."""
+    ``/debug/alerts``; ``steps`` (an ``obs.StepTracker``) mounts
+    ``/debug/steps[/<job>]``."""
     handler = type("BoundApiHandler", (ApiHandler,),
                    {"store": store, "metrics": metrics, "token": token,
                     "history": history, "tracer": tracer,
                     "flight": flight, "goodput": goodput,
-                    "autoscaler": autoscaler, "alerts": alerts})
+                    "autoscaler": autoscaler, "alerts": alerts,
+                    "steps": steps})
     if certfile:
         import ssl
         ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
@@ -695,12 +719,12 @@ def serve_background(store: ObjectStore, host: str = "127.0.0.1",
                      certfile: Optional[str] = None,
                      keyfile: Optional[str] = None, history=None,
                      tracer=None, flight=None, goodput=None,
-                     autoscaler=None, alerts=None):
+                     autoscaler=None, alerts=None, steps=None):
     """Start in a daemon thread; returns (server, base_url)."""
     srv = make_server(store, host, port, metrics, token=token,
                       certfile=certfile, keyfile=keyfile, history=history,
                       tracer=tracer, flight=flight, goodput=goodput,
-                      autoscaler=autoscaler, alerts=alerts)
+                      autoscaler=autoscaler, alerts=alerts, steps=steps)
     t = threading.Thread(target=srv.serve_forever, daemon=True,
                          name="tpu-apiserver")
     t.start()

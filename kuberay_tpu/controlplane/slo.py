@@ -37,6 +37,7 @@ import threading
 import time
 from typing import Callable, Dict, Optional, Tuple
 
+from kuberay_tpu.utils.quantiles import histogram_quantile
 
 TTFT_METRIC = "tpu_serve_request_duration_seconds"
 
@@ -62,23 +63,8 @@ def histogram_delta_p99(prev: Optional[Dict], cur: Optional[Dict]
     counts = list(cur["counts"])
     if prev is not None and prev["buckets"] == cur["buckets"]:
         counts = [c - p for c, p in zip(counts, prev["counts"])]
-    n = sum(counts)
-    if n <= 0:
-        return 0.0, 0
-    rank = 0.99 * n
-    cum = 0
-    lo = 0.0
-    for bound, c in zip(cur["buckets"], counts):
-        if c > 0:
-            if cum + c >= rank:
-                if bound == float("inf"):
-                    return lo, n          # open tail: report the floor
-                frac = (rank - cum) / c
-                return lo + frac * (bound - lo), n
-            cum += c
-        if bound != float("inf"):
-            lo = bound
-    return lo, n
+    p99, n = histogram_quantile(cur["buckets"], counts, 0.99)
+    return p99, int(n)
 
 
 class ServeSloSignal:

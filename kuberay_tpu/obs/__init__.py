@@ -25,6 +25,11 @@ production and in sim-violation forensics — from one artifact.
   exhaustive phase set (queued / provisioning / bootstrap / productive
   / interrupted / recovery / teardown), served live at
   ``/debug/goodput`` and archived post-mortem by the history server.
+- :mod:`kuberay_tpu.obs.steps`: the training-step straggler microscope
+  — per-(job, host) heartbeat windows from the coordinator, cross-host
+  skew, K-consecutive-step straggler verdicts, MFU attribution; splits
+  the ledger's PRODUCTIVE into productive vs ``stalled-on-straggler``
+  and serves ``/debug/steps[/<job>]``.
 """
 
 from kuberay_tpu.obs.alerts import AlertEngine, SloSpec, default_slos
@@ -36,6 +41,7 @@ from kuberay_tpu.obs.goodput import (
     NoopTransitionRecorder,
     TransitionRecorder,
 )
+from kuberay_tpu.obs.steps import NOOP_STEPS, NoopStepTracker, StepTracker
 from kuberay_tpu.obs.trace import (
     NOOP_TRACER,
     NoopTracer,
@@ -50,12 +56,15 @@ __all__ = [
     "AlertEngine",
     "FlightRecorder",
     "GoodputLedger",
+    "NOOP_STEPS",
     "NOOP_TRACER",
     "NOOP_TRANSITIONS",
+    "NoopStepTracker",
     "NoopTracer",
     "NoopTransitionRecorder",
     "PHASES",
     "SloSpec",
+    "StepTracker",
     "Span",
     "SpanStore",
     "TraceContext",

@@ -46,7 +46,10 @@ class SloSpec:
       5xx-coded series of ``total_family``; total = ``total_family``;
     - ``gauge-floor``: each series of ``gauge_family`` contributes one
       synthetic event per evaluation tick, bad when the gauge sits below
-      ``floor`` — "spent too much of the window unproductive".
+      ``floor`` — "spent too much of the window unproductive".  With
+      ``above=True`` the comparison inverts (bad when the gauge sits
+      ABOVE ``floor``): the floor doubles as a ceiling for
+      higher-is-worse gauges like the step skew ratio.
     """
 
     name: str
@@ -62,6 +65,7 @@ class SloSpec:
     # gauge-floor
     gauge_family: str = ""
     floor: float = 0.5
+    above: bool = False         # invert: bad when gauge > floor
     # windows (seconds) and their burn-rate thresholds
     fast_window_s: float = 300.0
     fast_burn: float = 14.0
@@ -76,9 +80,13 @@ class SloSpec:
 
 def default_slos(ttft_target_s: float = 0.5,
                  availability: float = 0.99,
-                 goodput_floor: float = 0.5) -> List[SloSpec]:
+                 goodput_floor: float = 0.5,
+                 straggler_skew: float = 1.5) -> List[SloSpec]:
     """The stock catalog the operator mounts (docs/observability.md):
-    serve TTFT p99, serve availability, per-CR goodput-ratio floor."""
+    serve TTFT p99, serve availability, per-CR goodput-ratio floor, and
+    per-(job, host) step-skew ceiling (the straggler microscope's alert
+    face — its gauge labels carry the job's goodput key, so a firing
+    series deep-links to the flight ring and the goodput ledger)."""
     return [
         SloSpec(name="serve-ttft", kind="latency",
                 metric="tpu_serve_request_duration_seconds",
@@ -91,6 +99,9 @@ def default_slos(ttft_target_s: float = 0.5,
         SloSpec(name="goodput-ratio", kind="gauge-floor",
                 gauge_family="tpu_goodput_ratio", floor=goodput_floor,
                 objective=0.9),
+        SloSpec(name="train-straggler", kind="gauge-floor",
+                gauge_family="tpu_train_step_skew_ratio",
+                floor=straggler_skew, above=True, objective=0.9),
     ]
 
 
@@ -154,9 +165,10 @@ class AlertEngine:
                 spec.gauge_family):
             key = tuple(sorted(labels.items()))
             prev = self._samples.get((spec.name, key))
+            breach = (value > spec.floor) if spec.above \
+                else (value < spec.floor)
             total = (prev[-1][1] if prev else 0.0) + 1.0
-            bad = (prev[-1][2] if prev else 0.0) + \
-                (1.0 if value < spec.floor else 0.0)
+            bad = (prev[-1][2] if prev else 0.0) + (1.0 if breach else 0.0)
             out.append((key, total, bad))
         return out
 
@@ -217,9 +229,10 @@ class AlertEngine:
         if spec.kind == "gauge-floor" and series_key:
             labels = dict(series_key)
             if {"kind", "namespace", "name"} <= set(labels):
-                links["flight"] = ("/debug/flight/%s/%s/%s"
-                                   % (labels["kind"], labels["namespace"],
-                                      labels["name"]))
+                triple = (labels["kind"], labels["namespace"],
+                          labels["name"])
+                links["flight"] = "/debug/flight/%s/%s/%s" % triple
+                links["goodput"] = "/debug/goodput/%s/%s/%s" % triple
         return links
 
     # -- the tick -----------------------------------------------------------

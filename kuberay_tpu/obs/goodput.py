@@ -14,6 +14,11 @@ lifetime to exactly one phase of an exclusive, exhaustive set:
 - ``bootstrap``     — first pod created → every TPU_WORKER_ID of every
                       slice Running (the multi-host ICI bring-up);
 - ``productive``    — full strength: every expected host Running;
+- ``stalled-on-straggler`` — full strength on paper, but the step
+                      telemetry (obs/steps.py) has flagged a straggler
+                      host: every synchronous step runs at the slow
+                      host's pace, so these seconds are badput even
+                      though every pod is Running;
 - ``interrupted``   — any worker of a slice down (a killed host costs
                       the *whole slice's* step time — this phase makes
                       that cost visible);
@@ -59,14 +64,15 @@ PHASE_QUEUED = "queued"
 PHASE_PROVISIONING = "provisioning"
 PHASE_BOOTSTRAP = "bootstrap"
 PHASE_PRODUCTIVE = "productive"
+PHASE_STALLED = "stalled-on-straggler"
 PHASE_INTERRUPTED = "interrupted"
 PHASE_RECOVERY = "recovery"
 PHASE_TEARDOWN = "teardown"
 
 #: The exclusive, exhaustive phase set, in canonical lifecycle order.
 PHASES = (PHASE_QUEUED, PHASE_PROVISIONING, PHASE_BOOTSTRAP,
-          PHASE_PRODUCTIVE, PHASE_INTERRUPTED, PHASE_RECOVERY,
-          PHASE_TEARDOWN)
+          PHASE_PRODUCTIVE, PHASE_STALLED, PHASE_INTERRUPTED,
+          PHASE_RECOVERY, PHASE_TEARDOWN)
 
 #: Kinds whose phase is derived from pod accounting (watch events); a
 #: controller-state transition on these is recorded on the flight ring
@@ -125,7 +131,7 @@ class _Entry:
     with ``end is None`` only on the last (open) interval."""
 
     __slots__ = ("intervals", "pods", "expected", "reached_productive",
-                 "growing", "closed")
+                 "growing", "closed", "stalled")
 
     def __init__(self):
         self.intervals: List[List[Any]] = []
@@ -134,6 +140,7 @@ class _Entry:
         self.reached_productive = False
         self.growing = False
         self.closed = False
+        self.stalled = False        # step telemetry flagged a straggler
 
 
 class GoodputLedger:
@@ -224,6 +231,32 @@ class GoodputLedger:
         roll = self._rollup_locked(key, e, now)
         self.metrics.set_goodput_ratio(key[0], key[1], key[2],
                                        roll["goodput_ratio"])
+
+    # -- step-telemetry feed (StepTracker) -----------------------------------
+
+    def set_stalled(self, kind: str, namespace: str, name: str,
+                    stalled: bool, ts: Optional[float] = None) -> None:
+        """Sub-attribution inside full strength (the obs/steps.py
+        feed): while a straggler host is flagged, seconds that would
+        read PRODUCTIVE read ``stalled-on-straggler`` instead — the
+        slice runs, but at the slow host's pace.  The flag persists, so
+        pod-driven recomputes keep honoring it until cleared; the
+        partition discipline is untouched (the phase swap reuses
+        ``_transition_locked``, so intervals still tile the lifetime).
+        ``ts`` lets the caller backdate the edge to the first observed
+        slow step — server-side clocks only, clamped monotonic as
+        always."""
+        with self._lock:
+            key = (kind, namespace, name)
+            e = self._objs.get(key)
+            if e is None or e.closed or e.stalled == bool(stalled):
+                return
+            e.stalled = bool(stalled)
+            cur = self._current_phase(e)
+            if e.stalled and cur == PHASE_PRODUCTIVE:
+                self._transition_locked(key, e, PHASE_STALLED, ts)
+            elif not e.stalled and cur == PHASE_STALLED:
+                self._transition_locked(key, e, PHASE_PRODUCTIVE, ts)
 
     # -- controller-state feed (TransitionRecorder) --------------------------
 
@@ -385,7 +418,7 @@ class GoodputLedger:
         if full:
             e.reached_productive = True
             e.growing = False
-            nxt = PHASE_PRODUCTIVE
+            nxt = PHASE_STALLED if e.stalled else PHASE_PRODUCTIVE
         elif down:
             # A host down before first full strength is still bootstrap
             # (the bring-up has not completed); after it, the whole

@@ -1,0 +1,569 @@
+"""Training-step telemetry: per-host heartbeats -> straggler microscope.
+
+The goodput ledger (obs/goodput.py) marks whole intervals PRODUCTIVE
+the moment pods run; this module sees *inside* those intervals.  Every
+training host posts lightweight per-step heartbeats (step index, step
+wall time, tokens processed, collective wait) through the existing
+``CoordinatorClient`` -> ``CoordinatorServer.record_events`` path —
+server-side ``received_at`` is the timestamp authority, client clocks
+are display-only — and the coordinator feeds them into a per-(job,
+host) :class:`StepTracker` which computes:
+
+- **windowed step-time distributions** per host (p50/p90/mean over the
+  last ``window`` steps, via the shared ``utils.quantiles`` estimator);
+- **cross-host skew**: each host's windowed median over the fleet
+  median of those medians (1.0 = lockstep; synchronous data-parallel
+  training runs at the speed of its slowest host, so skew IS lost
+  goodput);
+- a **straggler verdict**: a host whose step time exceeds the fleet
+  median by ``straggler_ratio`` for ``straggler_steps`` consecutive
+  steps is flagged.  Verdicts backdate to the *first* slow step — the
+  stall began when the host slowed down, not when the evidence
+  finished accumulating — and clear on the first step back under the
+  ratio.  Single-host jobs never flag (no fleet to skew against).
+- **MFU** (model-FLOPs-utilization) from the heartbeat's model config:
+  ``6 * n_params * fleet_tokens_per_sec / 1e12 / device_count /
+  peak_tflops_per_chip`` — the same estimate train/launcher.py
+  publishes locally, now attributed fleet-wide by the coordinator.
+
+Fan-out on every verdict edge: ``tpu_train_*`` metrics (histogram with
+exemplars pointing at the offending heartbeat event id), a straggler
+record in the flight ring under the job's goodput key, and a
+``GoodputLedger.set_stalled`` edge that splits PRODUCTIVE time into
+``productive`` vs ``stalled-on-straggler`` while keeping the
+exclusive+exhaustive interval discipline (sum(phases) == total).
+
+Observational-only contract (the same one tracer/flight/goodput obey):
+the tracker reads timestamps and heartbeats, never touches the store
+or any RNG — a sim run produces byte-identical journal hashes with
+telemetry on or off.  :class:`NoopStepTracker` is the
+bench-measurable zero: same surface, no work.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from kuberay_tpu.utils.quantiles import quantile, sorted_quantile
+
+#: Verdict defaults: flag a host at straggler_ratio x the fleet median
+#: held for straggler_steps consecutive steps.  K=5 keeps one GC pause
+#: from paging anyone while catching a real slow host within seconds.
+STRAGGLER_RATIO = 1.5
+STRAGGLER_STEPS = 5
+
+
+def default_goodput_key(job_id: str) -> Tuple[str, str, str]:
+    """Goodput/flight key for a coordinator job — the same
+    ("CoordinatorJob", "head", job) triple the coordinator's own
+    job_started/job_finished feed uses (runtime/coordinator_server.py),
+    so step attribution lands on the interval it refines."""
+    return ("CoordinatorJob", "head", job_id)
+
+
+class _Host:
+    __slots__ = ("durs", "tokens", "waits", "med_dur", "med_tok",
+                 "dur_uniform", "tok_uniform",
+                 "tok_rate", "last_skew", "last_step", "last_ts",
+                 "last_dur", "steps_observed", "consecutive_slow",
+                 "first_slow_step", "first_slow_ts", "flagged")
+
+    def __init__(self, window: int):
+        self.durs: deque = deque(maxlen=window)
+        self.tokens: deque = deque(maxlen=window)
+        self.waits: deque = deque(maxlen=window)
+        # Windowed medians, cached at append time: the fleet median and
+        # MFU read every host on every heartbeat, and recomputing each
+        # host's quantile there would make ingestion O(hosts * window
+        # log window) per beat (the telemetry bench gates this).
+        self.med_dur = 0.0
+        self.med_tok = 0.0
+        # A window whose min == max has a known median: appending the
+        # same value again keeps it, no re-sort (steady-state training
+        # emits near-constant durations/token counts).
+        self.dur_uniform = False
+        self.tok_uniform = False
+        self.tok_rate = 0.0      # cached med_tok/med_dur contribution
+        self.last_skew = -1.0    # last gauge value emitted (throttle)
+        self.last_step = 0
+        self.last_ts = 0.0
+        self.last_dur = 0.0
+        self.steps_observed = 0
+        self.consecutive_slow = 0
+        self.first_slow_step: Optional[int] = None
+        self.first_slow_ts: Optional[float] = None
+        self.flagged = False
+
+
+class _Job:
+    __slots__ = ("hosts", "n_params", "device_count", "peak_tflops",
+                 "verdicts", "stalled", "fleet_med", "fleet_dirty",
+                 "tok_s_sum", "last_mfu", "gkey")
+
+    def __init__(self, max_hosts: int):
+        self.hosts: "OrderedDict[str, _Host]" = OrderedDict()
+        self.n_params: Optional[float] = None
+        self.device_count: Optional[int] = None
+        self.peak_tflops: Optional[float] = None
+        # Closed + open straggler verdicts, oldest dropped first.
+        self.verdicts: deque = deque(maxlen=64)
+        self.stalled = False        # >=1 host currently flagged
+        # Ingestion-path caches: the fleet median is recomputed only
+        # when some host's cached windowed median actually moved, and
+        # the fleet tokens/s sum is maintained by per-host deltas, so
+        # a steady-state heartbeat costs O(window) for its own host
+        # rather than O(hosts * window) across the fleet.
+        self.fleet_med = 0.0
+        self.fleet_dirty = True
+        self.tok_s_sum = 0.0
+        self.last_mfu = -1.0        # last gauge value emitted (throttle)
+        self.gkey: Optional[Tuple[str, str, str]] = None
+
+
+class StepTracker:
+    """Per-(job, host) step-telemetry aggregator.  Thread-safe; bounded
+    everywhere (LRU jobs, LRU hosts per job, fixed windows, capped
+    verdict ring) — heartbeat floods cannot grow it without bound."""
+
+    def __init__(self, clock=None, metrics=None, flight=None,
+                 goodput=None,
+                 goodput_key: Callable[[str], Tuple[str, str, str]]
+                 = default_goodput_key,
+                 window: int = 64,
+                 straggler_ratio: float = STRAGGLER_RATIO,
+                 straggler_steps: int = STRAGGLER_STEPS,
+                 max_jobs: int = 64, max_hosts: int = 512):
+        self._now = clock.now if clock is not None else time.time
+        self.metrics = metrics
+        self.flight = flight
+        self.goodput = goodput
+        self.goodput_key = goodput_key
+        self.window = window
+        self.straggler_ratio = straggler_ratio
+        self.straggler_steps = straggler_steps
+        self.max_jobs = max_jobs
+        self.max_hosts = max_hosts
+        self._lock = threading.Lock()
+        self._jobs: "OrderedDict[str, _Job]" = OrderedDict()
+
+    # -- ingestion ---------------------------------------------------------
+
+    def observe(self, job_id: str, host: str, step: int, dur_s: float,
+                tokens: float = 0.0, collective_wait_s: float = 0.0,
+                ts: Optional[float] = None,
+                n_params: Optional[float] = None,
+                device_count: Optional[int] = None,
+                peak_tflops: Optional[float] = None,
+                exemplar: Optional[str] = None) -> None:
+        """Ingest one heartbeat.  ``ts`` is the server's ``received_at``
+        (the timestamp authority); ``exemplar`` the coordinator-minted
+        event id, threaded into the duration histogram so a p99 bucket
+        links back to the exact offending heartbeat."""
+        if not job_id or not host or dur_s < 0:
+            return
+        ts = self._now() if ts is None else ts
+        with self._lock:
+            job = self._job_locked(job_id)
+            h = self._host_locked(job, host)
+            self._absorb_beat_locked(job, h, step, dur_s, tokens,
+                                     collective_wait_s, ts)
+            if n_params is not None:
+                job.n_params = float(n_params)
+            if device_count is not None:
+                job.device_count = int(device_count)
+            if peak_tflops is not None:
+                job.peak_tflops = float(peak_tflops)
+            if job.fleet_dirty:
+                job.fleet_med = self._fleet_median_locked(job)
+                job.fleet_dirty = False
+            fleet_median = job.fleet_med
+            skew = (h.last_dur / fleet_median) if fleet_median > 0 else 0.0
+            # A fleet of one (or an empty fleet) has no median to skew
+            # against.  The steady case (not slow, nothing to clear)
+            # skips the verdict machinery entirely.
+            slow = (fleet_median > 0
+                    and dur_s > self.straggler_ratio * fleet_median
+                    and len(job.hosts) >= 2)
+            if slow or h.flagged or h.consecutive_slow:
+                edge = self._verdict_locked(job, host, h, step, dur_s,
+                                            ts, slow)
+            else:
+                edge = None
+            mfu = self._mfu_fast_locked(job)
+            # Gauge throttle: skew/MFU re-emit only when the value
+            # actually moved (>0.5%); a steady-state heartbeat costs
+            # one histogram observe, not three registry round-trips.
+            emit_skew = abs(skew - h.last_skew) > 0.005
+            if emit_skew:
+                h.last_skew = skew
+            emit_mfu = mfu is not None and abs(mfu - job.last_mfu) > \
+                0.005 * max(abs(job.last_mfu), 1e-9)
+            if emit_mfu:
+                job.last_mfu = mfu
+            if job.gkey is None:
+                job.gkey = self.goodput_key(job_id)
+            kind, ns, name = job.gkey
+        # Fan-out outside the tracker lock: metrics/flight/goodput each
+        # take their own locks.
+        m = self.metrics
+        if m is not None:
+            m.observe_train_step(job_id, host, dur_s,
+                                 exemplar=exemplar, exemplar_ts=ts)
+            if emit_skew:
+                m.set_train_skew(job_id, kind, ns, name, host, skew)
+            if emit_mfu:
+                m.set_train_mfu(job_id, kind, ns, name, mfu)
+        if edge is not None:
+            self._fanout_edge(job_id, kind, ns, name, edge)
+
+    def observe_fleet_step(self, job_id: str, step: int,
+                           beats: List[Tuple],
+                           ts: Optional[float] = None,
+                           n_params: Optional[float] = None,
+                           device_count: Optional[int] = None,
+                           peak_tflops: Optional[float] = None) -> None:
+        """One synchronous training step for the whole fleet: ``beats``
+        is ``[(host, dur_s, tokens, collective_wait_s, exemplar), ...]``
+        sharing one step index and one server timestamp — the shape the
+        sim's heartbeat emission produces.  Equivalent to ``observe``
+        per host, but the lock, the fleet-median/MFU recomputes, the
+        model config, and the goodput key amortize across the fleet,
+        and every host's verdict is judged against the same post-step
+        fleet median (cleaner than the per-beat path's incremental
+        view, where earlier hosts see later hosts' previous window)."""
+        if not job_id or not beats:
+            return
+        ts = self._now() if ts is None else ts
+        edges: List[Dict[str, Any]] = []
+        skews: List[Tuple[str, float]] = []
+        with self._lock:
+            job = self._job_locked(job_id)
+            if n_params is not None:
+                job.n_params = float(n_params)
+            if device_count is not None:
+                job.device_count = int(device_count)
+            if peak_tflops is not None:
+                job.peak_tflops = float(peak_tflops)
+            for host, dur_s, tokens, wait, _ in beats:
+                if not host or dur_s < 0:
+                    continue
+                h = self._host_locked(job, host)
+                self._absorb_beat_locked(job, h, step, dur_s, tokens,
+                                         wait, ts)
+            if job.fleet_dirty:
+                job.fleet_med = self._fleet_median_locked(job)
+                job.fleet_dirty = False
+            fm = job.fleet_med
+            judge = len(job.hosts) >= 2 and fm > 0
+            for host, dur_s, tokens, wait, _ in beats:
+                h = job.hosts.get(host)
+                if h is None or dur_s < 0:
+                    continue
+                skew = (h.last_dur / fm) if fm > 0 else 0.0
+                slow = judge and dur_s > self.straggler_ratio * fm
+                if slow or h.flagged or h.consecutive_slow:
+                    edge = self._verdict_locked(job, host, h, step,
+                                                dur_s, ts, slow)
+                    if edge is not None:
+                        edges.append(edge)
+                if abs(skew - h.last_skew) > 0.005:
+                    h.last_skew = skew
+                    skews.append((host, skew))
+            mfu = self._mfu_fast_locked(job)
+            emit_mfu = mfu is not None and abs(mfu - job.last_mfu) > \
+                0.005 * max(abs(job.last_mfu), 1e-9)
+            if emit_mfu:
+                job.last_mfu = mfu
+            if job.gkey is None:
+                job.gkey = self.goodput_key(job_id)
+            kind, ns, name = job.gkey
+        m = self.metrics
+        if m is not None:
+            m.observe_train_steps(
+                job_id,
+                [(host, dur_s, exemplar)
+                 for host, dur_s, tokens, wait, exemplar in beats
+                 if host and dur_s >= 0],
+                ts=ts)
+            for host, skew in skews:
+                m.set_train_skew(job_id, kind, ns, name, host, skew)
+            if emit_mfu:
+                m.set_train_mfu(job_id, kind, ns, name, mfu)
+        for edge in edges:
+            self._fanout_edge(job_id, kind, ns, name, edge)
+
+    # -- internals (under self._lock) --------------------------------------
+
+    def _absorb_beat_locked(self, job: _Job, h: _Host, step: int,
+                            dur_s: float, tokens: float, wait: float,
+                            ts: float) -> None:
+        """Fold one heartbeat into a host's windows + cached medians."""
+        fd = float(dur_s)
+        if h.dur_uniform and h.durs and fd == h.med_dur:
+            h.durs.append(fd)           # median provably unchanged
+        else:
+            old_med = h.med_dur
+            h.durs.append(fd)
+            xs = sorted(h.durs)
+            h.med_dur = sorted_quantile(xs, 0.5)
+            h.dur_uniform = xs[0] == xs[-1]
+            if h.med_dur != old_med:
+                job.fleet_dirty = True
+        if tokens:
+            tv = float(tokens)
+            if h.tok_uniform and h.tokens and tv == h.med_tok:
+                h.tokens.append(tv)
+            else:
+                h.tokens.append(tv)
+                xs = sorted(h.tokens)
+                h.med_tok = sorted_quantile(xs, 0.5)
+                h.tok_uniform = xs[0] == xs[-1]
+        rate = (h.med_tok / h.med_dur
+                if h.tokens and h.med_dur > 0 else 0.0)
+        if rate != h.tok_rate:
+            job.tok_s_sum += rate - h.tok_rate
+            h.tok_rate = rate
+        h.waits.append(float(wait))
+        h.last_step = int(step)
+        h.last_ts = ts
+        h.last_dur = fd
+        h.steps_observed += 1
+
+    def _job_locked(self, job_id: str) -> _Job:
+        job = self._jobs.get(job_id)
+        if job is None:
+            job = self._jobs[job_id] = _Job(self.max_hosts)
+        self._jobs.move_to_end(job_id)
+        while len(self._jobs) > self.max_jobs:
+            self._jobs.popitem(last=False)
+        return job
+
+    def _host_locked(self, job: _Job, host: str) -> _Host:
+        h = job.hosts.get(host)
+        if h is None:
+            h = job.hosts[host] = _Host(self.window)
+        job.hosts.move_to_end(host)
+        while len(job.hosts) > self.max_hosts:
+            _, evicted = job.hosts.popitem(last=False)
+            job.tok_s_sum -= evicted.tok_rate
+            job.fleet_dirty = True
+        return h
+
+    def _fleet_median_locked(self, job: _Job) -> float:
+        meds = [h.med_dur for h in job.hosts.values() if h.durs]
+        return quantile(meds, 0.5) if meds else 0.0
+
+    def _verdict_locked(self, job: _Job, host: str, h: _Host, step: int,
+                        dur_s: float, ts: float,
+                        slow: bool) -> Optional[Dict[str, Any]]:
+        """Advance the consecutive-slow counter; return a fan-out edge
+        dict on flag/clear transitions, else None.  ``slow`` is the
+        caller's ratio-vs-fleet-median judgment (computed inline on the
+        hot path so the steady case never enters this function)."""
+        if slow:
+            if h.consecutive_slow == 0:
+                h.first_slow_step = int(step)
+                h.first_slow_ts = ts
+            h.consecutive_slow += 1
+            if not h.flagged and h.consecutive_slow >= self.straggler_steps:
+                h.flagged = True
+                verdict = {
+                    "host": host,
+                    "first_slow_step": h.first_slow_step,
+                    "first_slow_ts": h.first_slow_ts,
+                    "detected_step": int(step),
+                    "detected_ts": ts,
+                    "skew": round(dur_s / job.fleet_med, 4),
+                    "fleet_median_s": round(job.fleet_med, 6),
+                    "cleared_step": None,
+                    "cleared_ts": None,
+                }
+                job.verdicts.append(verdict)
+                was_stalled = job.stalled
+                job.stalled = True
+                return {"kind": "flagged", "verdict": verdict,
+                        "stall_edge": not was_stalled,
+                        "ts": h.first_slow_ts}
+        else:
+            h.consecutive_slow = 0
+            h.first_slow_step = None
+            h.first_slow_ts = None
+            if h.flagged:
+                h.flagged = False
+                verdict = None
+                for v in reversed(job.verdicts):
+                    if v["host"] == host and v["cleared_step"] is None:
+                        verdict = v
+                        break
+                if verdict is not None:
+                    verdict["cleared_step"] = int(step)
+                    verdict["cleared_ts"] = ts
+                still = any(o.flagged for o in job.hosts.values())
+                job.stalled = still
+                return {"kind": "cleared", "verdict": verdict,
+                        "stall_edge": not still, "ts": ts}
+        return None
+
+    def _mfu_fast_locked(self, job: _Job) -> Optional[float]:
+        """Ingestion-path MFU from the incrementally maintained fleet
+        tokens/s sum (read paths recompute exactly via _mfu_locked)."""
+        if not job.n_params or not job.peak_tflops or job.tok_s_sum <= 0:
+            return None
+        devices = max(1, job.device_count or 1)
+        achieved = 6.0 * job.n_params * job.tok_s_sum / 1e12 / devices
+        return achieved / job.peak_tflops
+
+    def _mfu_locked(self, job: _Job) -> Optional[float]:
+        if not job.n_params or not job.peak_tflops:
+            return None
+        devices = max(1, job.device_count or 1)
+        tok_s = 0.0
+        for h in job.hosts.values():
+            if h.tokens and h.durs and h.med_dur > 0:
+                tok_s += h.med_tok / h.med_dur
+        if tok_s <= 0:
+            return None
+        achieved = 6.0 * job.n_params * tok_s / 1e12 / devices
+        return achieved / job.peak_tflops
+
+    def _fanout_edge(self, job_id: str, kind: str, ns: str, name: str,
+                     edge: Dict[str, Any]) -> None:
+        v = edge["verdict"]
+        if self.metrics is not None and edge["kind"] == "flagged":
+            self.metrics.train_straggler(job_id)
+        if self.flight is not None:
+            if edge["kind"] == "flagged":
+                detail = (f"host {v['host']} {v['skew']:.2f}x fleet "
+                          f"median for "
+                          f"{self.straggler_steps} steps "
+                          f"(since step {v['first_slow_step']})")
+            else:
+                detail = (f"host {v['host']} recovered at step "
+                          f"{v['cleared_step']}")
+            self.flight.record(kind, ns, name, "straggler", detail,
+                               host=v["host"], edge=edge["kind"],
+                               skew=v["skew"])
+        if self.goodput is not None and edge["stall_edge"]:
+            self.goodput.set_stalled(kind, ns, name,
+                                     edge["kind"] == "flagged",
+                                     ts=edge["ts"])
+
+    # -- read side ---------------------------------------------------------
+
+    def jobs(self) -> List[str]:
+        with self._lock:
+            return list(self._jobs)
+
+    def stragglers(self, job_id: Optional[str] = None
+                   ) -> List[Dict[str, Any]]:
+        """All verdicts (open and cleared), oldest first."""
+        with self._lock:
+            out: List[Dict[str, Any]] = []
+            for jid, job in self._jobs.items():
+                if job_id is not None and jid != job_id:
+                    continue
+                for v in job.verdicts:
+                    out.append(dict(v, job=jid))
+            return out
+
+    def to_dict(self) -> Dict[str, Any]:
+        """/debug/steps index: one summary row per job."""
+        with self._lock:
+            jobs = []
+            for jid, job in self._jobs.items():
+                fleet = self._fleet_median_locked(job)
+                worst = 0.0
+                last_step = 0
+                for h in job.hosts.values():
+                    med = quantile(h.durs, 0.5) if h.durs else 0.0
+                    if fleet > 0:
+                        worst = max(worst, med / fleet)
+                    last_step = max(last_step, h.last_step)
+                jobs.append({
+                    "job": jid,
+                    "hosts": len(job.hosts),
+                    "last_step": last_step,
+                    "fleet_median_s": round(fleet, 6),
+                    "max_skew_ratio": round(worst, 4),
+                    "stragglers": [v["host"] for v in job.verdicts
+                                   if v["cleared_step"] is None],
+                    "mfu": self._mfu_locked(job),
+                })
+            return {"jobs": jobs}
+
+    def job_doc(self, job_id: str) -> Optional[Dict[str, Any]]:
+        """/debug/steps/<job>: per-host windowed distributions + the
+        verdict ring."""
+        with self._lock:
+            job = self._jobs.get(job_id)
+            if job is None:
+                return None
+            fleet = self._fleet_median_locked(job)
+            hosts = []
+            for hid, h in job.hosts.items():
+                durs = list(h.durs)
+                med = quantile(durs, 0.5) if durs else 0.0
+                tok_s = 0.0
+                if h.tokens and med > 0:
+                    tok_s = quantile(h.tokens, 0.5) / med
+                wait = quantile(h.waits, 0.5) if h.waits else 0.0
+                hosts.append({
+                    "host": hid,
+                    "last_step": h.last_step,
+                    "last_ts": h.last_ts,
+                    "steps_observed": h.steps_observed,
+                    "window": len(durs),
+                    "p50_s": round(med, 6),
+                    "p90_s": round(quantile(durs, 0.9), 6) if durs
+                    else 0.0,
+                    "mean_s": round(sum(durs) / len(durs), 6) if durs
+                    else 0.0,
+                    "tokens_per_sec": round(tok_s, 2),
+                    "collective_wait_p50_s": round(wait, 6),
+                    "skew_ratio": round(med / fleet, 4) if fleet > 0
+                    else 0.0,
+                    "consecutive_slow": h.consecutive_slow,
+                    "straggler": h.flagged,
+                })
+            return {
+                "job": job_id,
+                "fleet_median_s": round(fleet, 6),
+                "mfu": self._mfu_locked(job),
+                "straggler_ratio": self.straggler_ratio,
+                "straggler_steps": self.straggler_steps,
+                "hosts": hosts,
+                "verdicts": [dict(v) for v in job.verdicts],
+            }
+
+
+class NoopStepTracker:
+    """Surface-compatible zero: the benchmark's overhead leg swaps this
+    in for the real tracker on the same seeded run (gated < 5%)."""
+
+    metrics = None
+    flight = None
+    goodput = None
+
+    def observe(self, *args, **kwargs) -> None:
+        return None
+
+    def observe_fleet_step(self, *args, **kwargs) -> None:
+        return None
+
+    def jobs(self) -> List[str]:
+        return []
+
+    def stragglers(self, job_id=None) -> List[Dict[str, Any]]:
+        return []
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"jobs": []}
+
+    def job_doc(self, job_id) -> Optional[Dict[str, Any]]:
+        return None
+
+
+NOOP_STEPS = NoopStepTracker()

@@ -89,6 +89,12 @@ class Operator:
         self.goodput = GoodputLedger(metrics=self.metrics)
         self.transitions = TransitionRecorder(flight=self.flight,
                                               ledger=self.goodput)
+        # Training-step straggler microscope (obs/steps.py): fed by the
+        # coordinator's step_heartbeat events, fans skew/MFU gauges into
+        # the registry and stall edges into the goodput ledger.
+        from kuberay_tpu.obs import StepTracker
+        self.steps = StepTracker(metrics=self.metrics, flight=self.flight,
+                                 goodput=self.goodput)
         # The ledger folds every store event (CR lifecycle + pod phase
         # accounting); controllers feed state writes via ``transitions``.
         self._goodput_cancel = self.store.watch(self.goodput.observe_event)
@@ -251,7 +257,7 @@ class Operator:
             self.store, api_host, api_port, metrics=self.metrics,
             history=history, tracer=self.tracer, flight=self.flight,
             goodput=self.goodput, autoscaler=self.autoscaler_audit,
-            alerts=self.alerts)
+            alerts=self.alerts, steps=self.steps)
         if leader_election and shard_leases and self.manager.shards > 1:
             from kuberay_tpu.controlplane.leader import ShardLeaseElector
             # Start unowned: every pool paused until its lease is won.

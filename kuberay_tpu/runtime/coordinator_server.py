@@ -33,6 +33,9 @@ from typing import Any, Dict, Optional
 from kuberay_tpu.utils import constants as C
 from kuberay_tpu.utils.httpjson import JsonHandler
 
+#: Checkpoint-drain requests kept for inspection (ring, oldest dropped).
+CHECKPOINT_REQUESTS_MAX = 256
+
 
 class StateBackend:
     """Cluster-metadata persistence seam (§5.3 head-loss recovery)."""
@@ -209,7 +212,8 @@ class CoordinatorServer:
                  spawn_jobs: bool = True,
                  auth_token: Optional[str] = None,
                  goodput=None,
-                 on_checkpoint=None):
+                 on_checkpoint=None,
+                 steps=None):
         # Bearer auth (ref cluster token auth): token comes from the
         # operator-minted Secret via the TPU_AUTH_TOKEN env.
         self.auth_token = (auth_token if auth_token is not None
@@ -218,6 +222,10 @@ class CoordinatorServer:
         # wall-clock attribution, stamped with THIS server's clock
         # (received_at) — never the client's.
         self.goodput = goodput
+        # Optional obs.StepTracker: "step_heartbeat" events feed the
+        # per-(job, host) straggler microscope, attributed at
+        # received_at like the goodput feed.
+        self.steps = steps
         self.state = state or backend_from_env()
         self.log_dir = log_dir
         self.spawn_jobs = spawn_jobs
@@ -246,7 +254,13 @@ class CoordinatorServer:
         # CheckpointWriter.  Requests are recorded either way so the
         # drain is observable even without a hook installed.
         self.on_checkpoint = on_checkpoint
-        self.checkpoint_requests: list = []
+        # Bounded like the event ring and the flight recorder: an
+        # operator stuck in a notice->drain loop must not grow head
+        # memory without bound.  Dropped (oldest) requests are counted
+        # — the count is the signal that the ring was too small.
+        self.checkpoint_requests: "deque[Dict[str, Any]]" = \
+            deque(maxlen=CHECKPOINT_REQUESTS_MAX)
+        self.checkpoint_requests_dropped = 0
         self._recover()
 
     # -- checkpoint drain --------------------------------------------------
@@ -260,6 +274,9 @@ class CoordinatorServer:
         operator's drain path treats checkpointing as best-effort."""
         req = {"tag": tag, "reason": reason, "received_at": time.time()}
         with self._lock:
+            if len(self.checkpoint_requests) == \
+                    self.checkpoint_requests.maxlen:
+                self.checkpoint_requests_dropped += 1
             self.checkpoint_requests.append(req)
         hook = self.on_checkpoint
         if hook is not None:
@@ -362,6 +379,7 @@ class CoordinatorServer:
         n = 0
         now = time.time()
         feed = []
+        beats = []
         with self._lock:
             for ev in events:
                 if not isinstance(ev, dict):
@@ -384,6 +402,10 @@ class CoordinatorServer:
                 self.events.append(ev)
                 if self.goodput is not None and ev.get("job_id"):
                     feed.append(ev)
+                if self.steps is not None and \
+                        ev.get("name") == "step_heartbeat" and \
+                        ev.get("job_id") and ev.get("host"):
+                    beats.append(ev)
                 n += 1
         # Goodput feed outside the lock (the ledger has its own): job
         # lifecycle boundaries attributed at the server's receive time.
@@ -397,6 +419,26 @@ class CoordinatorServer:
                                         "teardown", ts=ev["received_at"])
                 self.goodput.close("CoordinatorJob", "head", jid,
                                    ts=ev["received_at"])
+        # Step-heartbeat feed, also outside the lock (the tracker has
+        # its own) and also attributed at received_at: a skewed host
+        # clock cannot shift its own straggler evidence.
+        for ev in beats:
+            args = ev.get("args") or {}
+            try:
+                self.steps.observe(
+                    ev["job_id"], str(ev["host"]),
+                    step=int(args.get("step", 0)),
+                    dur_s=float(args.get("dur_s", 0.0)),
+                    tokens=float(args.get("tokens", 0.0)),
+                    collective_wait_s=float(
+                        args.get("collective_wait_s", 0.0)),
+                    ts=ev["received_at"],
+                    n_params=args.get("n_params"),
+                    device_count=args.get("device_count"),
+                    peak_tflops=args.get("peak_tflops"),
+                    exemplar=ev["id"])
+            except (TypeError, ValueError):
+                continue        # malformed heartbeat: keep the rest
         return n
 
     def list_events(self, job_id: Optional[str] = None,
@@ -572,6 +614,21 @@ class CoordinatorServer:
                 if self.path == "/api/profile/":
                     return self._send(200,
                                       {"profiles": coord.list_profiles()})
+                if self.path == "/api/steps" or \
+                        self.path.startswith("/api/steps/"):
+                    # The straggler microscope's read side, colocated
+                    # with the heartbeat ingest (same doc the operator
+                    # serves at /debug/steps).
+                    if coord.steps is None:
+                        return self._send(
+                            404, {"message": "step telemetry off"})
+                    jid = self.path[len("/api/steps"):].strip("/")
+                    if not jid:
+                        return self._send(200, coord.steps.to_dict())
+                    doc = coord.steps.job_doc(jid)
+                    if doc is None:
+                        return self._send(404, {"message": "not found"})
+                    return self._send(200, doc)
                 if self.path.split("?", 1)[0] == "/api/events":
                     import urllib.parse
                     q = urllib.parse.parse_qs(
@@ -659,7 +716,8 @@ def main(argv=None):  # pragma: no cover - thin process wrapper
     ap.add_argument("--host", default="0.0.0.0")
     ap.add_argument("--log-dir", default="/tmp/tpu-coordinator-logs")
     args = ap.parse_args(argv)
-    coord = CoordinatorServer(log_dir=args.log_dir)
+    from kuberay_tpu.obs.steps import StepTracker
+    coord = CoordinatorServer(log_dir=args.log_dir, steps=StepTracker())
     srv = coord.make_server(args.host, args.port)
     print(f"coordinator serving on {args.host}:{args.port}", flush=True)
     srv.serve_forever()

@@ -32,24 +32,9 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from kuberay_tpu.utils.quantiles import quantile as _quantile
+
 HEARTBEAT_INTERVAL = 1.0
-
-
-def _quantile(samples: List[float], q: float) -> float:
-    """Linear-interpolation quantile (numpy's default / 'inclusive'
-    method).  A truncating index on a small window collapses p99 to the
-    max sample, which is exactly the degenerate estimate that let one
-    slow step dominate the adaptive budget."""
-    xs = sorted(samples)
-    if not xs:
-        return 0.0
-    if len(xs) == 1:
-        return xs[0]
-    pos = q * (len(xs) - 1)
-    lo = int(pos)
-    hi = min(lo + 1, len(xs) - 1)
-    frac = pos - lo
-    return xs[lo] + (xs[hi] - xs[lo]) * frac
 
 
 class GroupMonitor:

@@ -77,6 +77,11 @@ def main(argv=None) -> int:
                         help="evaluate SLO burn-rate alerts each settle "
                              "round (kuberay_tpu.obs.alerts); the replay "
                              "hash is unaffected")
+    parser.add_argument("--step-telemetry", action="store_true",
+                        help="mount the training-step straggler "
+                             "microscope (kuberay_tpu.obs.steps) on the "
+                             "run's synthetic heartbeats; the replay "
+                             "hash is unaffected")
     parser.add_argument("--json", action="store_true",
                         help="one JSON result object per run on stdout")
     parser.add_argument("--list-scenarios", action="store_true")
@@ -115,7 +120,8 @@ def main(argv=None) -> int:
         steps = args.steps or scenario.default_steps
         for seed in seeds:
             with SimHarness(seed, scenario=scenario, trace=trace,
-                            alerts=args.alerts) as h:
+                            alerts=args.alerts,
+                            steps=args.step_telemetry) as h:
                 result = h.run(steps)
                 journal = list(h.journal)
                 trace_doc = h.export_trace() if trace else None

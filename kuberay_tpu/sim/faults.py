@@ -54,10 +54,12 @@ ALL_FAULTS = (STORE_CONFLICT, WATCH_DROP, WATCH_DUP, WATCH_DELAY,
 # loop only when a profile explicitly enables them.
 PREEMPTION_NOTICE = "preemption_notice"
 DCN_PARTITION = "dcn_partition"
-EXT_FAULTS = (PREEMPTION_NOTICE, DCN_PARTITION)
+SLOW_HOST = "slow_host"
+EXT_FAULTS = (PREEMPTION_NOTICE, DCN_PARTITION, SLOW_HOST)
 
 STEP_FAULTS = (POD_KILL, SLICE_DRAIN, SLOW_START, DELETE_RACE,
-               LEADER_FAILOVER, PREEMPTION_NOTICE, DCN_PARTITION)
+               LEADER_FAILOVER, PREEMPTION_NOTICE, DCN_PARTITION,
+               SLOW_HOST)
 
 #: Default per-step arming weights; a scenario overrides with its own
 #: profile (fault -> mean injections per step; 0 disables).
@@ -94,7 +96,9 @@ class FaultPlan:
                  watch_delay_seconds: Tuple[float, float] = (0.5, 8.0),
                  slow_start_seconds: Tuple[float, float] = (1.0, 20.0),
                  notice_delta_seconds: Tuple[float, float] = (10.0, 25.0),
-                 partition_window_seconds: Tuple[float, float] = (5.0, 15.0)):
+                 partition_window_seconds: Tuple[float, float] = (5.0, 15.0),
+                 slow_host_steps: Tuple[int, int] = (8, 16),
+                 slow_host_factor: float = 3.0):
         self.seed = seed
         self.rng = random.Random(seed)
         self.profile = dict(DEFAULT_PROFILE)
@@ -104,6 +108,8 @@ class FaultPlan:
         self.slow_start_seconds = slow_start_seconds
         self.notice_delta_seconds = notice_delta_seconds
         self.partition_window_seconds = partition_window_seconds
+        self.slow_host_steps = slow_host_steps
+        self.slow_host_factor = slow_host_factor
         self._armed: Dict[str, int] = {f: 0
                                        for f in ALL_FAULTS + EXT_FAULTS}
         self._suspended = False
@@ -240,3 +246,10 @@ class FaultPlan:
         """How long a DCN partition severs cross-slice connectivity."""
         lo, hi = self.partition_window_seconds
         return self.rng.uniform(lo, hi)
+
+    def draw_slow_host_steps(self) -> int:
+        """How many consecutive training steps a slow host stays slow.
+        Step-indexed (not wall-clock) so the straggler microscope's
+        K-consecutive-step verdict has a crisp ground truth to match."""
+        lo, hi = self.slow_host_steps
+        return self.rng.randint(lo, hi)

@@ -50,6 +50,7 @@ from kuberay_tpu.obs import (
     FlightRecorder,
     GoodputLedger,
     NOOP_TRACER,
+    StepTracker,
     Tracer,
     TransitionRecorder,
 )
@@ -62,6 +63,7 @@ from kuberay_tpu.sim.faults import (
     POD_KILL,
     PREEMPTION_NOTICE,
     SLICE_DRAIN,
+    SLOW_HOST,
     SLOW_START,
     FaultPlan,
 )
@@ -123,6 +125,7 @@ class SimHarness:
                  trace: bool = False,
                  goodput: bool = False,
                  alerts: bool = False,
+                 steps: bool = False,
                  shards: Optional[int] = None):
         self.seed = seed
         self.scenario = scenario
@@ -190,6 +193,18 @@ class SimHarness:
                        if goodput else None)
         self._goodput_cancel = (self.store.watch(self.goodput.observe_event)
                                 if goodput else None)
+        # Step-telemetry microscope (obs.steps): observational only —
+        # heartbeats are synthesized by emit_training_steps from state
+        # the harness already owns, the tracker reads only the virtual
+        # clock, so the journal hash is byte-identical with the
+        # microscope on or off (tests/test_sim_steps.py).  Sim job ids
+        # are "ns/cluster", so stall edges land on the cluster's own
+        # goodput/flight key.
+        self.steps = (StepTracker(
+            clock=self.clock, metrics=self.metrics, flight=self.flight,
+            goodput=self.goodput,
+            goodput_key=lambda job_id: (C.KIND_CLUSTER,) + tuple(
+                job_id.split("/", 1))) if steps else None)
         # Deterministic event emission (obs satellite): virtual-clock
         # eventTime + counter names replace wall time and uuid4, so a
         # seed replays with identical Event objects across processes.
@@ -263,6 +278,16 @@ class SimHarness:
         # end for clusters whose DCN connectivity is severed.
         self._pending_kills: List[tuple] = []
         self._partitioned_until: Dict[tuple, float] = {}
+        # Slow-host fault machinery: (ns, cluster, pod) -> remaining
+        # slow training steps, plus the ground-truth log of every window
+        # (first slow heartbeat ts -> first recovered heartbeat ts) the
+        # straggler-detection checker and the goodput-exactness gate
+        # compare the tracker's verdicts against.  Maintained whether or
+        # not telemetry is mounted so the fault plan's rng stream cannot
+        # depend on the telemetry flag.
+        self._slow_hosts: Dict[tuple, int] = {}
+        self._train_step_idx: Dict[tuple, int] = {}
+        self.slow_host_log: List[Dict[str, Any]] = []
 
         if scenario is not None:
             with self.plan.suspended():
@@ -340,6 +365,7 @@ class SimHarness:
             "flight": self.flight.to_dict() if self.flight else {},
             "goodput": self.goodput.to_dict() if self.goodput else {},
             "alerts": self.alerts.to_dict() if self.alerts else {},
+            "steps": self.steps.to_dict() if self.steps else {},
         }
 
     # -- convergence -------------------------------------------------------
@@ -467,6 +493,91 @@ class SimHarness:
                     client.set_job_status(jid, "SUCCEEDED")
                     changed += 1
         return changed
+
+    # -- training-step heartbeats / slow hosts -----------------------------
+
+    def _open_slow_entry(self, ns: str, cluster: str,
+                         host: str) -> Optional[Dict[str, Any]]:
+        for entry in reversed(self.slow_host_log):
+            if (entry["ns"] == ns and entry["cluster"] == cluster
+                    and entry["host"] == host
+                    and entry["clear_ts"] is None):
+                return entry
+        return None
+
+    def emit_training_steps(self, namespace: str, cluster: str,
+                            count: int = 1, base_dur: float = 1.0,
+                            tokens: float = 2048.0) -> int:
+        """Synthesize one synchronous training step per Running host of
+        ``cluster``, ``count`` times: the virtual clock advances by the
+        step's wall time (the slowest host's duration — synchronous
+        data-parallel training runs at straggler speed), then every host
+        reports its heartbeat.
+
+        Runs UNCONDITIONALLY (telemetry on or off): the clock advance
+        and the slow-window bookkeeping must be identical in both modes
+        so the fault plan's rng stream — and therefore the journal
+        hash — cannot depend on whether the tracker is mounted.  Only
+        the ``observe()`` feed is gated.  RNG-free and store-free by
+        construction.  Returns heartbeats emitted."""
+        emitted = 0
+        for _ in range(count):
+            pods = sorted(
+                p["metadata"]["name"]
+                for p in self.store.list("Pod", namespace)
+                if p["metadata"].get("labels", {}).get(C.LABEL_CLUSTER)
+                == cluster
+                and C.LABEL_SLICE_NAME in p["metadata"].get("labels", {})
+                and not p["metadata"].get("deletionTimestamp")
+                and p.get("status", {}).get("phase") == "Running")
+            if not pods:
+                continue
+            key = (namespace, cluster)
+            self._train_step_idx[key] = idx = \
+                self._train_step_idx.get(key, 0) + 1
+            durs = {
+                pod: (base_dur * self.plan.slow_host_factor
+                      if self._slow_hosts.get((namespace, cluster, pod),
+                                              0) > 0
+                      else base_dur)
+                for pod in pods}
+            wall = max(durs.values())
+            self.clock.advance(wall)
+            ts = self.clock.now()
+            beats = []
+            for pod in pods:
+                pkey = (namespace, cluster, pod)
+                dur = durs[pod]
+                remaining = self._slow_hosts.get(pkey, 0)
+                if remaining > 0:
+                    if self._open_slow_entry(namespace, cluster,
+                                             pod) is None:
+                        self.slow_host_log.append({
+                            "ns": namespace, "cluster": cluster,
+                            "host": pod, "first_slow_step": idx,
+                            "first_slow_ts": ts, "clear_step": None,
+                            "clear_ts": None})
+                    if remaining <= 1:
+                        del self._slow_hosts[pkey]
+                    else:
+                        self._slow_hosts[pkey] = remaining - 1
+                else:
+                    entry = self._open_slow_entry(namespace, cluster, pod)
+                    if entry is not None:
+                        entry["clear_step"] = idx
+                        entry["clear_ts"] = ts
+                beats.append((pod, dur, tokens, wall - dur,
+                              f"hb-{cluster}-{idx}-{pod}"))
+                emitted += 1
+            if self.steps is not None:
+                # One fleet-synchronized ingestion call per step (the
+                # batch seam the tracker amortizes its lock and fleet
+                # recomputes across).
+                self.steps.observe_fleet_step(
+                    f"{namespace}/{cluster}", idx, beats, ts=ts,
+                    n_params=1.0e9, device_count=len(pods) * 4,
+                    peak_tflops=197.0)
+        return emitted
 
     # -- preemption notices / DCN partitions -------------------------------
 
@@ -630,6 +741,29 @@ class SimHarness:
                 self._partitioned_until[key] = max(
                     until, self._partitioned_until.get(key, 0.0))
                 self._sync_partitions()
+            elif fault == SLOW_HOST:
+                # One window at a time, and the previous window's
+                # recovery heartbeat must have landed: overlapping
+                # windows would blur the stall interval the
+                # goodput-exactness gate measures.  Both guards read
+                # harness state maintained identically with telemetry
+                # on or off, so the rng stream stays mode-independent.
+                if self._slow_hosts or any(e["clear_ts"] is None
+                                           for e in self.slow_host_log):
+                    return False
+                hosts = sorted(
+                    (p["metadata"]["namespace"],
+                     p["metadata"]["labels"][C.LABEL_CLUSTER],
+                     p["metadata"]["name"])
+                    for p in self._candidate_pods(phase="Running")
+                    if C.LABEL_CLUSTER in p["metadata"].get("labels", {})
+                    and C.LABEL_SLICE_NAME in p["metadata"].get("labels",
+                                                                {}))
+                if not hosts:
+                    return False
+                ns, cname, pname = rng.choice(hosts)
+                self._slow_hosts[(ns, cname, pname)] = \
+                    self.plan.draw_slow_host_steps()
             elif fault == LEADER_FAILOVER:
                 crs = []
                 for kind in SIM_KINDS:
@@ -672,7 +806,9 @@ class SimHarness:
 
     def check(self) -> List[Violation]:
         self._drain_journal()
-        violations = run_checkers(CheckContext(self.store, self.journal))
+        violations = run_checkers(CheckContext(
+            self.store, self.journal, steps=self.steps,
+            slow_host_log=self.slow_host_log))
         if not self.converged:
             violations.append(Violation(
                 "convergence", f"step {self._step}",

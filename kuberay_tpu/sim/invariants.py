@@ -40,12 +40,20 @@ class Violation:
 
 class CheckContext:
     """What checkers see: the store and the journal (the harness's record
-    of every store event, in commit order — see harness.JournalRecord)."""
+    of every store event, in commit order — see harness.JournalRecord).
+    When the harness mounts step telemetry it also hands over the
+    tracker (``steps``) and its ground-truth slow-host fault log
+    (``slow_host_log``) so detection checkers can compare verdicts
+    against what was actually injected."""
 
     def __init__(self, store: ObjectStore,
-                 journal: Optional[List[Dict[str, Any]]] = None):
+                 journal: Optional[List[Dict[str, Any]]] = None,
+                 steps=None,
+                 slow_host_log: Optional[List[Dict[str, Any]]] = None):
         self.store = store
         self.journal = journal or []
+        self.steps = steps
+        self.slow_host_log = slow_host_log or []
 
     # -- shared traversals -------------------------------------------------
 
@@ -361,6 +369,44 @@ def check_drain_before_delete(ctx: CheckContext) -> List[Violation]:
             f"deleted at rv {rec.get('rv')} under preemption notice "
             f"(deadline {rec.get('notice')}) with no preceding "
             "drain/checkpoint acknowledgment"))
+    return out
+
+
+@checker("straggler-detection",
+         "every completed slow-host fault window was flagged by the step "
+         "tracker: a matching verdict names the injected host and detects "
+         "within straggler_steps heartbeats of the first slow step")
+def check_straggler_detection(ctx: CheckContext) -> List[Violation]:
+    # Vacuous without the straggler microscope mounted (telemetry off,
+    # or the benchmark's NoopStepTracker overhead leg) or without
+    # injected slow-host windows to detect.
+    from kuberay_tpu.obs import NoopStepTracker
+    if ctx.steps is None or isinstance(ctx.steps, NoopStepTracker):
+        return []
+    out: List[Violation] = []
+    verdicts = ctx.steps.stragglers()
+    k = getattr(ctx.steps, "straggler_steps", 5)
+    for entry in ctx.slow_host_log:
+        if entry.get("clear_ts") is None:
+            continue    # window still open: detection may be in flight
+        key = f"{entry['ns']}/{entry['cluster']} host {entry['host']}"
+        matches = [v for v in verdicts
+                   if v["host"] == entry["host"]
+                   and v["first_slow_step"] == entry["first_slow_step"]]
+        if not matches:
+            out.append(Violation(
+                "straggler-detection", key,
+                f"slow window injected at step {entry['first_slow_step']} "
+                f"(cleared step {entry['clear_step']}) produced no "
+                "straggler verdict"))
+            continue
+        v = matches[0]
+        if v["detected_step"] - v["first_slow_step"] + 1 > k:
+            out.append(Violation(
+                "straggler-detection", key,
+                f"detected at step {v['detected_step']}, "
+                f"{v['detected_step'] - v['first_slow_step'] + 1} slow "
+                f"steps after onset (budget {k})"))
     return out
 
 

@@ -345,6 +345,47 @@ class _DcnPartition:
 
 
 # ---------------------------------------------------------------------------
+# straggler drill: slow-host windows against the step-telemetry microscope
+# ---------------------------------------------------------------------------
+
+@scenario(
+    "straggler-drill",
+    "a multi-slice training cluster emitting per-host step heartbeats "
+    "while seeded slow-host windows strike one host at a time: the step "
+    "tracker must flag the exact host within straggler_steps heartbeats "
+    "and attribute the stall window to the goodput ledger exactly",
+    # Disruptive faults stay 0: a pod kill mid-window would end the
+    # stall by death rather than recovery and blur the exactness gate;
+    # the drill keeps mild store/watch chaos so detection runs under
+    # realistic reconcile noise.
+    profile={F.SLOW_HOST: 0.45, F.STORE_CONFLICT: 0.3, F.WATCH_DROP: 0.2,
+             F.WATCH_DUP: 0.2, F.WATCH_DELAY: 0.2, F.POD_KILL: 0.0,
+             F.SLICE_DRAIN: 0.0, F.SLOW_START: 0.0, F.DELETE_RACE: 0.0,
+             F.LEADER_FAILOVER: 0.0})
+class _StragglerDrill:
+    #: Heartbeats per sim step and the healthy per-step wall time; the
+    #: slow host runs at plan.slow_host_factor (3x) of this.
+    BEATS_PER_TICK = 3
+    BASE_DUR = 1.0
+
+    def setup(self, h):
+        # v5e 4x4 = 4 hosts/slice, two slices: 8 reporting hosts, so
+        # the fleet median stays at base speed with one straggler.
+        h.store.create(make_cluster_obj("drill-train", accelerator="v5e",
+                                        topology="4x4", replicas=2,
+                                        max_replicas=4))
+
+    def tick(self, h, step):
+        # The workload IS the training loop: every tick the cluster
+        # runs BEATS_PER_TICK synchronous steps, the clock advancing by
+        # each step's wall time (rng-free — replay hashes stay
+        # byte-identical with telemetry on or off).
+        h.emit_training_steps("default", "drill-train",
+                              count=self.BEATS_PER_TICK,
+                              base_dur=self.BASE_DUR)
+
+
+# ---------------------------------------------------------------------------
 # cronjob burst
 # ---------------------------------------------------------------------------
 

@@ -170,14 +170,16 @@ def train(args) -> int:
         loader = TokenShardLoader(path, args.seq_len, args.batch,
                                   seed=args.seed)
 
-    # Step-event reporting: worker 0 posts structured step events to the
-    # colocated coordinator at each log interval (the task/profile event
-    # stream the history server replays, ref eventserver.go:838).  Off
-    # when no coordinator address was injected; never fatal.
+    # Step-event reporting: EVERY worker posts per-step heartbeats to
+    # the colocated coordinator (the straggler microscope's feed,
+    # obs/steps.py — cross-host skew needs every host's step times, not
+    # just the lead's); the lead additionally posts the train_step
+    # summary at each log interval (the task/profile event stream the
+    # history server replays, ref eventserver.go:838).  Off when no
+    # coordinator address was injected; never fatal.
     from kuberay_tpu.utils import constants as C
     event_client = None
-    if ident.worker_id == 0 and ident.slice_id == 0 and \
-            os.environ.get(C.ENV_COORDINATOR_ADDRESS):
+    if os.environ.get(C.ENV_COORDINATOR_ADDRESS):
         from kuberay_tpu.runtime.coordinator_client import (
             CoordinatorClient, dashboard_url)
         event_client = CoordinatorClient(
@@ -241,15 +243,55 @@ def _train_loop(args, ident, state, step_fn, loader, put, writer, prom,
     import time
     import jax
     last_saved = -1
+    is_lead = ident.worker_id == 0 and ident.slice_id == 0
+    # Heartbeat identity + cadence: "s<slice>w<worker>" names the host
+    # fleet-wide; durations buffer locally and batch-post every
+    # --heartbeat-every steps (default: the log interval) so telemetry
+    # adds one HTTP round-trip per interval, not per step.
+    host = f"s{ident.slice_id}w{ident.worker_id}"
+    hb_every = getattr(args, "heartbeat_every", 0) or args.log_every
+    hb_buf = []                       # (step, wall seconds) per step
 
     start_step = int(state["step"])
     t0 = time.time()
+    step_t0 = t0
     next_batch = put(loader.next()) if start_step < args.steps else None
     for i in range(start_step, args.steps):
         batch = next_batch
         state, metrics = step_fn(state, batch)
         if i + 1 < args.steps:
             next_batch = put(loader.next())   # overlaps the device step
+        if event_client is not None:
+            now = time.time()
+            hb_buf.append((i + 1, now - step_t0))
+            step_t0 = now
+            if (i + 1) % hb_every == 0:
+                # One device sync per batch: how long this host waits on
+                # the step's collectives to finish, attributed to the
+                # batch's last step (syncing every step would serialize
+                # the async dispatch pipeline telemetry exists to watch).
+                tw = time.time()
+                jax.block_until_ready(metrics["loss"])
+                wait = time.time() - tw
+                tokens = float(args.batch * args.seq_len)
+                beats = [{
+                    "type": "step", "name": "step_heartbeat",
+                    "job_id": job_id, "host": host,
+                    "args": {"step": s, "dur_s": round(d, 6),
+                             "tokens": tokens,
+                             "collective_wait_s": 0.0},
+                } for s, d in hb_buf]
+                beats[-1]["args"]["collective_wait_s"] = round(wait, 6)
+                beats[-1]["args"]["n_params"] = n_params
+                beats[-1]["args"]["device_count"] = jax.device_count()
+                if peak_tflops > 0:
+                    beats[-1]["args"]["peak_tflops"] = peak_tflops
+                try:
+                    event_client.post_events(beats)
+                except Exception:
+                    event_client = None    # coordinator gone: stop trying
+                hb_buf = []
+                step_t0 = time.time()     # exclude the sync from step 1
         if (i + 1) % args.log_every == 0 and ident.worker_id == 0:
             loss = float(metrics["loss"])
             dt = time.time() - t0
@@ -269,7 +311,7 @@ def _train_loop(args, ident, state, step_fn, loader, put, writer, prom,
                         1, jax.device_count())
                     prom.set_gauge("tpu_train_mfu",
                                    achieved / peak_tflops)
-            if event_client is not None:
+            if is_lead and event_client is not None:
                 try:
                     event_client.post_events([{
                         "type": "step", "name": "train_step",
@@ -316,6 +358,10 @@ def main(argv=None) -> int:
     ap.add_argument("--checkpoint-dir", default="")
     ap.add_argument("--checkpoint-every", type=int, default=500)
     ap.add_argument("--log-every", type=int, default=10)
+    ap.add_argument("--heartbeat-every", type=int, default=0,
+                    help="steps per step-heartbeat batch to the "
+                         "coordinator (straggler microscope); 0 = the "
+                         "log interval")
     args = ap.parse_args(argv)
     for flag in ("param_dtype", "mu_dtype"):
         val = getattr(args, flag)

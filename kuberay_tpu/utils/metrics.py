@@ -13,6 +13,7 @@ raycluster_controller.go:125 cleanup on delete).
 
 from __future__ import annotations
 
+import bisect
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
@@ -28,6 +29,12 @@ _FAST_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
 # when overloaded — SLO evaluation needs resolution across both regimes.
 SERVE_LATENCY_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
                          1, 2.5, 5, 10, 30, 60, float("inf"))
+
+# Training steps span sub-second (small models) to minutes (giant
+# pipelines); straggler forensics needs resolution both around a
+# healthy median and in the 2-5x tail a slow host produces.
+TRAIN_STEP_BUCKETS = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5,
+                      10, 30, 60, 300, float("inf"))
 
 
 class Histogram:
@@ -48,12 +55,14 @@ class Histogram:
         self.total += v
         # counts[i] holds observations landing in bucket i alone; render()
         # produces the cumulative le-series (doing both would double-count).
-        for i, b in enumerate(self.buckets):
-            if v <= b:
-                self.counts[i] += 1
-                if exemplar is not None:
-                    self.exemplars[i] = (exemplar, v, exemplar_ts)
-                break
+        # bisect_left finds the first bound >= v — the bucket the linear
+        # scan would pick — without a Python-level loop (step heartbeats
+        # hit this on every training step).
+        i = bisect.bisect_left(self.buckets, v)
+        if i < len(self.counts):
+            self.counts[i] += 1
+            if exemplar is not None:
+                self.exemplars[i] = (exemplar, v, exemplar_ts)
 
 
 class MetricsRegistry:
@@ -99,6 +108,36 @@ class MetricsRegistry:
                 exemplar_ts = time.time()
             self._hists[key].observe(value, exemplar=exemplar,
                                      exemplar_ts=exemplar_ts)
+
+    def observe_keyed(self, key: Tuple[str, Tuple], value: float,
+                      buckets: Optional[Tuple] = None,
+                      exemplar: Optional[str] = None,
+                      exemplar_ts: Optional[float] = None):
+        """``observe`` with a caller-precomputed ``(name, labels_key)``
+        pair — the per-heartbeat hot path (observe_train_step) caches
+        the key per series instead of rebuilding and re-sorting the
+        label dict on every training step."""
+        with self._lock:
+            h = self._hists.get(key)
+            if h is None:
+                h = self._hists[key] = Histogram(buckets or _BUCKETS)
+            if exemplar is not None and exemplar_ts is None:
+                exemplar_ts = time.time()
+            h.observe(value, exemplar=exemplar, exemplar_ts=exemplar_ts)
+
+    def observe_keyed_many(self, entries, buckets: Optional[Tuple] = None,
+                           exemplar_ts: Optional[float] = None):
+        """Batch of ``observe_keyed`` calls under one lock acquisition:
+        ``entries`` is ``[(key, value, exemplar)]``.  All exemplars share
+        ``exemplar_ts`` (one fleet step, one timestamp)."""
+        with self._lock:
+            for key, value, exemplar in entries:
+                h = self._hists.get(key)
+                if h is None:
+                    h = self._hists[key] = Histogram(buckets or _BUCKETS)
+                if exemplar is not None and exemplar_ts is None:
+                    exemplar_ts = time.time()
+                h.observe(value, exemplar=exemplar, exemplar_ts=exemplar_ts)
 
     def histogram_snapshot(self, name: str,
                            labels: Optional[Dict[str, str]] = None
@@ -217,6 +256,9 @@ class ControlPlaneMetrics:
 
     def __init__(self, registry: Optional[MetricsRegistry] = None):
         self.registry = registry or MetricsRegistry()
+        # (job, host) -> precomputed registry key for the per-heartbeat
+        # step-duration histogram (the one metric on the hot path).
+        self._train_keys: Dict[Tuple[str, str], Tuple] = {}
         r = self.registry
         r.describe("tpu_cluster_provisioned_duration_seconds",
                    "Seconds from TpuCluster creation to all slices ready")
@@ -273,6 +315,22 @@ class ControlPlaneMetrics:
                    "Warm-slice claim attempts by outcome reason: "
                    "preemption / scale-up (adopted) or miss (no ready "
                    "warm slice; cold build instead)")
+        r.describe("tpu_train_step_duration_seconds",
+                   "Per-host training step wall time from coordinator "
+                   "heartbeats (obs/steps.py); exemplars link tail "
+                   "buckets to the offending heartbeat event id")
+        r.describe("tpu_train_step_skew_ratio",
+                   "Host windowed-median step time over the fleet "
+                   "median (1.0 = lockstep); sustained > the straggler "
+                   "ratio flags the host")
+        r.describe("tpu_train_mfu",
+                   "Model-FLOPs-utilization per job, estimated by the "
+                   "step tracker from heartbeat tokens/s and the "
+                   "model config (6*N*tok_s / devices / peak)")
+        r.describe("tpu_train_stragglers_total",
+                   "Straggler verdicts flagged per job (host exceeded "
+                   "the fleet median by the configured ratio for K "
+                   "consecutive steps)")
 
     def observe_provisioned(self, cluster: str, seconds: float):
         self.registry.observe("tpu_cluster_provisioned_duration_seconds",
@@ -333,6 +391,58 @@ class ControlPlaneMetrics:
 
     def warmpool_claim(self, reason: str):
         self.registry.inc("tpu_warmpool_claims_total", {"reason": reason})
+
+    def observe_train_step(self, job: str, host: str, seconds: float,
+                           exemplar: Optional[str] = None,
+                           exemplar_ts: Optional[float] = None):
+        key = self._train_keys.get((job, host))
+        if key is None:
+            if len(self._train_keys) > 4096:    # bounded memo
+                self._train_keys.clear()
+            key = self._train_keys[(job, host)] = (
+                "tpu_train_step_duration_seconds",
+                (("host", host), ("job", job)))   # sorted label order
+        self.registry.observe_keyed(key, seconds,
+                                    buckets=TRAIN_STEP_BUCKETS,
+                                    exemplar=exemplar,
+                                    exemplar_ts=exemplar_ts)
+
+    def observe_train_steps(self, job: str, items, ts: Optional[float] = None):
+        """Batched ``observe_train_step`` for one synchronous fleet step:
+        ``items`` is ``[(host, seconds, exemplar)]`` sharing one timestamp.
+        One registry lock for the whole fleet instead of one per host —
+        the coordinator/sim hot path when every host beats at once."""
+        entries = []
+        for host, seconds, exemplar in items:
+            key = self._train_keys.get((job, host))
+            if key is None:
+                if len(self._train_keys) > 4096:    # bounded memo
+                    self._train_keys.clear()
+                key = self._train_keys[(job, host)] = (
+                    "tpu_train_step_duration_seconds",
+                    (("host", host), ("job", job)))   # sorted label order
+            entries.append((key, seconds, exemplar))
+        self.registry.observe_keyed_many(entries, buckets=TRAIN_STEP_BUCKETS,
+                                         exemplar_ts=ts)
+
+    def set_train_skew(self, job: str, kind: str, namespace: str,
+                       name: str, host: str, ratio: float):
+        # kind/namespace/name mirror the job's goodput key so the alert
+        # engine can deep-link the firing series to /debug/flight and
+        # /debug/goodput (obs/alerts._links).
+        self.registry.set_gauge("tpu_train_step_skew_ratio", ratio,
+                                {"job": job, "kind": kind,
+                                 "namespace": namespace, "name": name,
+                                 "host": host})
+
+    def set_train_mfu(self, job: str, kind: str, namespace: str,
+                      name: str, value: float):
+        self.registry.set_gauge("tpu_train_mfu", value,
+                                {"job": job, "kind": kind,
+                                 "namespace": namespace, "name": name})
+
+    def train_straggler(self, job: str):
+        self.registry.inc("tpu_train_stragglers_total", {"job": job})
 
     def reconcile_conflict(self, kind: str):
         self.registry.inc("tpu_reconcile_conflicts_total", {"kind": kind})

@@ -1,0 +1,97 @@
+"""Shared interpolated-quantile helpers.
+
+Three copies of the same linear-interpolation estimator grew up
+independently — ``benchmark/serve_bench.py`` (percentile over raw
+samples), ``benchmark/controlplane_bench.py`` (quantile over a
+pre-sorted list), and the GroupMonitor's adaptive watchdog budget
+(``serve/group_health.py``) — plus a fourth variant interpolating
+within histogram buckets in ``controlplane/slo.py``.  They all exist
+for the same reason: a truncating index on a small window collapses
+p99 toward p90 (for n=21 it never reports the tail sample at all),
+which is exactly the outlier a p99 exists to surface.  This module is
+the single implementation; the step-telemetry tracker
+(``obs/steps.py``) uses it too.
+
+Conventions (the 'inclusive' method, numpy's default linear
+interpolation): position ``q * (n - 1)`` over the sorted samples,
+linear blend between the two straddling values.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+
+def quantile(samples: Sequence[float], q: float) -> float:
+    """Interpolated quantile, ``q`` in [0, 1].  Sorts internally;
+    returns 0.0 on an empty sample set (callers that need a loud empty
+    case use :func:`percentile`)."""
+    xs = sorted(samples)
+    if not xs:
+        return 0.0
+    if len(xs) == 1:
+        return xs[0]
+    pos = q * (len(xs) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(xs) - 1)
+    frac = pos - lo
+    return xs[lo] + (xs[hi] - xs[lo]) * frac
+
+
+def percentile(samples: Sequence[float], pct: float) -> float:
+    """Interpolated percentile, ``pct`` in (0, 100).  Raises
+    ``ValueError`` on no samples — the benchmark contract, where a
+    silent 0.0 would read as an impossibly good latency."""
+    if not samples:
+        raise ValueError("percentile() of no samples")
+    return quantile(samples, pct / 100.0)
+
+
+def median(samples: Sequence[float]) -> float:
+    return quantile(samples, 0.5)
+
+
+def histogram_quantile(bounds: Sequence[float], counts: Sequence[float],
+                       q: float) -> Tuple[float, int]:
+    """Interpolated quantile from histogram bucket counts.
+
+    ``bounds`` are the buckets' upper bounds (ascending, trailing +inf
+    allowed), ``counts`` the per-bucket (non-cumulative) observation
+    counts.  Returns ``(value, total)``; ``(0.0, 0)`` when the
+    histogram is empty.  Interpolation assumes observations are uniform
+    within the crossing bucket (PromQL's ``histogram_quantile``
+    convention); a rank landing in the open +inf tail reports the
+    tail's floor — the largest claim the data supports.
+    """
+    n = sum(counts)
+    if n <= 0:
+        return 0.0, 0
+    rank = q * n
+    cum = 0
+    lo = 0.0
+    for bound, c in zip(bounds, counts):
+        if c > 0:
+            if cum + c >= rank:
+                if bound == float("inf"):
+                    return lo, n          # open tail: report the floor
+                frac = (rank - cum) / c
+                return lo + frac * (bound - lo), n
+            cum += c
+        if bound != float("inf"):
+            lo = bound
+    return lo, n
+
+
+def sorted_quantile(sorted_samples: List[float], q: float) -> float:
+    """Quantile over an already-sorted list (skips the re-sort; the
+    controlplane bench calls this in a hot report loop)."""
+    xs = sorted_samples
+    if not xs:
+        return 0.0
+    if len(xs) == 1:
+        return xs[0]
+    pos = q * (len(xs) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(xs) - 1)
+    frac = pos - lo
+    return xs[lo] + (xs[hi] - xs[lo]) * frac

@@ -243,10 +243,44 @@ def test_operator_mounts_alert_engine_with_stock_catalog():
         with urllib.request.urlopen(f"{url}/debug/alerts") as resp:
             doc = json.load(resp)
         assert {s["name"] for s in doc["specs"]} == {
-            "serve-ttft", "serve-availability", "goodput-ratio"}
+            "serve-ttft", "serve-availability", "goodput-ratio",
+            "train-straggler"}
         assert doc["active"] == []                   # healthy at boot
     finally:
         op.stop()
+
+
+def test_gauge_ceiling_fires_above_floor_with_goodput_link():
+    """The train-straggler spec inverts the gauge-floor comparison
+    (above=True): a skew ratio sitting ABOVE the 1.5x ceiling burns
+    budget, and the firing series deep-links to both the flight ring
+    and the goodput ledger of the job's CR."""
+    clock = VirtualClock(start=0.0)
+    reg = MetricsRegistry()
+    spec = [s for s in default_slos() if s.name == "train-straggler"][0]
+    assert spec.above and spec.gauge_family == "tpu_train_step_skew_ratio"
+    eng = AlertEngine(reg, specs=[spec], clock=clock)
+    labels = {"job": "default/drill", "kind": "TpuCluster",
+              "namespace": "default", "name": "drill", "host": "s0w3"}
+    reg.set_gauge("tpu_train_step_skew_ratio", 3.0, labels)
+    fired = []
+    for _ in range(7):
+        fired.extend(eng.evaluate())
+        clock.advance(10.0)
+    assert len(fired) == 1                           # slow window only
+    alert = fired[0]
+    assert alert["name"] == "train-straggler"
+    assert alert["series"]["host"] == "s0w3"
+    assert alert["links"]["flight"] == \
+        "/debug/flight/TpuCluster/default/drill"
+    assert alert["links"]["goodput"] == \
+        "/debug/goodput/TpuCluster/default/drill"
+
+    # Back under the ceiling: the gauge is healthy, the alert drains.
+    reg.set_gauge("tpu_train_step_skew_ratio", 1.0, labels)
+    clock.advance(3700.0)
+    eng.evaluate()
+    assert eng.active() == []
 
 
 # ---------------------------------------------------------------------------

@@ -125,6 +125,75 @@ def test_record_events_server_side_received_at_beats_skewed_clients():
     assert evs[0]["ts"] == t0 + 86400 and evs[1]["ts"] == 17.0
 
 
+def test_checkpoint_requests_bounded_with_dropped_count():
+    """Regression: checkpoint_requests grew without bound — a flapping
+    drain loop could OOM the head.  Now a capped deque (oldest evicted)
+    with an explicit dropped counter."""
+    from kuberay_tpu.runtime.coordinator_server import (
+        CHECKPOINT_REQUESTS_MAX)
+    server = CoordinatorServer(state=MemoryBackend(), spawn_jobs=False)
+    for i in range(CHECKPOINT_REQUESTS_MAX + 50):
+        server.request_checkpoint(tag=f"t{i}")
+    assert len(server.checkpoint_requests) == CHECKPOINT_REQUESTS_MAX
+    assert server.checkpoint_requests_dropped == 50
+    # Oldest evicted, newest kept.
+    assert server.checkpoint_requests[0]["tag"] == "t50"
+    assert server.checkpoint_requests[-1]["tag"] == \
+        f"t{CHECKPOINT_REQUESTS_MAX + 49}"
+
+
+def test_record_events_backpressure_bounded_and_ordered():
+    """A multi-host heartbeat burst (8 hosts x 5k events) cannot grow
+    the event ring past its cap, and received_seq stays strictly
+    increasing across batches — the ordering contract downstream
+    consumers (history replay, the step tracker) key on."""
+    server = CoordinatorServer(state=MemoryBackend(), spawn_jobs=False)
+    cap = server.events.maxlen
+    for host in range(8):
+        server.record_events([
+            {"type": "step", "name": "step_heartbeat", "job_id": "j",
+             "host": f"s0w{host}",
+             "args": {"step": i, "dur_s": 0.1}}
+            for i in range(5000)])
+    assert len(server.events) == cap                 # bounded memory
+    seqs = [e["received_seq"] for e in server.events]
+    assert seqs == sorted(seqs)
+    assert len(set(seqs)) == len(seqs)               # strictly increasing
+
+
+def test_record_events_feeds_step_tracker_with_received_at():
+    """step_heartbeat events reach the mounted StepTracker stamped with
+    the server's received_at; malformed heartbeats are skipped without
+    poisoning the batch; non-heartbeat events don't touch the tracker."""
+    from kuberay_tpu.obs.steps import StepTracker
+    tracker = StepTracker(window=8)
+    server = CoordinatorServer(state=MemoryBackend(), spawn_jobs=False,
+                               steps=tracker)
+    t0 = time.time()
+    n = server.record_events([
+        {"type": "step", "name": "step_heartbeat", "job_id": "train",
+         "host": "s0w0", "ts": 1.0,      # skewed client clock: ignored
+         "args": {"step": 7, "dur_s": 0.25, "tokens": 512.0,
+                  "collective_wait_s": 0.02}},
+        {"type": "step", "name": "step_heartbeat", "job_id": "train",
+         "host": "s0w1", "args": {"step": 7, "dur_s": "not-a-float"}},
+        {"type": "step", "name": "train_step", "job_id": "train",
+         "args": {"step": 7, "loss": 2.0}},          # summary, not a beat
+        {"type": "step", "name": "step_heartbeat", "job_id": "train",
+         "args": {"step": 7, "dur_s": 0.3}},         # no host: not a beat
+    ])
+    assert n == 4                                    # all recorded as events
+    doc = server.steps.job_doc("train")
+    assert doc is not None
+    assert [h["host"] for h in doc["hosts"]] == ["s0w0"]
+    h = doc["hosts"][0]
+    assert h["last_step"] == 7 and h["p50_s"] == 0.25
+    # The tracker saw the server's stamp, not the client's ts=1.0.
+    assert h["last_ts"] >= t0 - 5
+    ev = server.list_events(job_id="train")[0]
+    assert h["last_ts"] == ev["received_at"]
+
+
 def test_head_restart_recovery(tmp_path):
     """File backend: job registry survives a head restart; in-flight jobs
     are marked FAILED (the operator's retry machinery takes over)."""

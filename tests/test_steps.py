@@ -1,0 +1,310 @@
+"""Training-step telemetry unit tests (ISSUE 11 tentpole).
+
+The ``StepTracker`` contract, exercised without the sim or the
+coordinator: windowed per-host distributions, cross-host skew, the
+K-consecutive straggler verdict (backdated to the first slow step,
+cleared on recovery), MFU from the heartbeat model config, the
+``tpu_train_*`` metric fan-out with exemplars, flight-ring straggler
+records, goodput ``stalled-on-straggler`` sub-attribution, and the
+bounded-everywhere guarantees (LRU jobs/hosts, malformed-beat guards,
+the Noop surface the benchmark swaps in).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from kuberay_tpu.obs import (FlightRecorder, GoodputLedger, NOOP_STEPS,
+                             NoopStepTracker, StepTracker)
+from kuberay_tpu.obs.goodput import PHASE_PRODUCTIVE, PHASE_STALLED, PHASES
+from kuberay_tpu.obs.steps import default_goodput_key
+from kuberay_tpu.utils.metrics import ControlPlaneMetrics
+
+
+class FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def now(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+def _feed(tr, clock, job, hosts, dur_by_host, step, **kw):
+    """One synchronous step: every host reports, clock ticks once."""
+    clock.advance(max(dur_by_host.values()))
+    for h in hosts:
+        tr.observe(job, h, step=step, dur_s=dur_by_host[h],
+                   tokens=kw.get("tokens", 1000.0),
+                   collective_wait_s=max(dur_by_host.values())
+                   - dur_by_host[h],
+                   ts=clock.now(), **{k: v for k, v in kw.items()
+                                      if k != "tokens"})
+
+
+# ---------------------------------------------------------------------------
+# distributions + skew
+# ---------------------------------------------------------------------------
+
+def test_windowed_distributions_and_skew():
+    clock = FakeClock()
+    tr = StepTracker(clock=clock, window=8)
+    hosts = ["s0w0", "s0w1"]
+    for i in range(1, 13):
+        _feed(tr, clock, "default/train", hosts,
+              {"s0w0": 1.0, "s0w1": 2.0}, step=i)
+    doc = tr.job_doc("default/train")
+    assert doc is not None
+    by = {h["host"]: h for h in doc["hosts"]}
+    # Window is bounded at 8 even after 12 observations.
+    assert by["s0w0"]["window"] == 8
+    assert by["s0w0"]["steps_observed"] == 12
+    assert by["s0w0"]["p50_s"] == pytest.approx(1.0)
+    assert by["s0w1"]["p50_s"] == pytest.approx(2.0)
+    assert by["s0w0"]["mean_s"] == pytest.approx(1.0)
+    # Fleet median = median of per-host medians = median([1, 2]) = 1.5;
+    # skew is each host's median over that.
+    assert doc["fleet_median_s"] == pytest.approx(1.5)
+    assert by["s0w1"]["skew_ratio"] == pytest.approx(2.0 / 1.5, abs=1e-3)
+    # tokens/s = windowed-median tokens over windowed-median duration.
+    assert by["s0w0"]["tokens_per_sec"] == pytest.approx(1000.0)
+    assert by["s0w1"]["tokens_per_sec"] == pytest.approx(500.0)
+    # The fast host waits for the slow one: collective wait == wall - dur.
+    assert by["s0w0"]["collective_wait_p50_s"] == pytest.approx(1.0)
+    assert by["s0w1"]["collective_wait_p50_s"] == pytest.approx(0.0)
+    # Index doc rolls up the same story.
+    row = tr.to_dict()["jobs"][0]
+    assert row["job"] == "default/train"
+    assert row["hosts"] == 2 and row["last_step"] == 12
+    assert row["max_skew_ratio"] == pytest.approx(2.0 / 1.5, abs=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# the straggler verdict
+# ---------------------------------------------------------------------------
+
+def test_k_consecutive_verdict_backdated_and_cleared():
+    clock = FakeClock()
+    tr = StepTracker(clock=clock, straggler_ratio=1.5, straggler_steps=5)
+    hosts = ["a", "b", "c", "d"]
+    even = {h: 1.0 for h in hosts}
+    slow = dict(even, d=3.0)
+    for i in range(1, 7):                       # warm up the windows
+        _feed(tr, clock, "j", hosts, even, step=i)
+    assert tr.stragglers() == []
+    first_slow_ts = None
+    for i in range(7, 12):                      # 5 consecutive slow steps
+        _feed(tr, clock, "j", hosts, slow, step=i)
+        if first_slow_ts is None:
+            first_slow_ts = clock.now()
+        if i < 11:
+            assert tr.stragglers("j") == []     # K not yet reached
+    vs = tr.stragglers("j")
+    assert len(vs) == 1
+    v = vs[0]
+    assert v["host"] == "d" and v["job"] == "j"
+    # Backdated: the verdict points at the FIRST slow step, not the
+    # step where the evidence finished accumulating.
+    assert v["first_slow_step"] == 7
+    assert v["first_slow_ts"] == pytest.approx(first_slow_ts)
+    assert v["detected_step"] == 11
+    assert v["detected_step"] - v["first_slow_step"] + 1 == 5
+    assert v["skew"] == pytest.approx(3.0, abs=0.1)
+    assert v["cleared_step"] is None
+    doc = tr.job_doc("j")
+    d_row = next(h for h in doc["hosts"] if h["host"] == "d")
+    assert d_row["straggler"] and d_row["consecutive_slow"] == 5
+    # Recovery: first step back under the ratio clears the verdict.
+    _feed(tr, clock, "j", hosts, even, step=12)
+    v = tr.stragglers("j")[0]
+    assert v["cleared_step"] == 12 and v["cleared_ts"] is not None
+    assert not tr.job_doc("j")["hosts"][-1]["straggler"]
+
+
+def test_blip_under_k_steps_never_flags():
+    clock = FakeClock()
+    tr = StepTracker(clock=clock, straggler_steps=5)
+    hosts = ["a", "b"]
+    for i in range(1, 5):
+        _feed(tr, clock, "j", hosts, {"a": 1.0, "b": 1.0}, step=i)
+    for i in range(5, 9):                       # 4 slow steps: one short
+        _feed(tr, clock, "j", hosts, {"a": 1.0, "b": 4.0}, step=i)
+    _feed(tr, clock, "j", hosts, {"a": 1.0, "b": 1.0}, step=9)
+    for i in range(10, 14):                     # counter reset: 4 again
+        _feed(tr, clock, "j", hosts, {"a": 1.0, "b": 4.0}, step=i)
+    assert tr.stragglers("j") == []
+
+
+def test_single_host_job_never_flags():
+    clock = FakeClock()
+    tr = StepTracker(clock=clock)
+    for i in range(1, 30):
+        # Wildly varying step times, but no fleet to skew against.
+        tr.observe("solo", "s0w0", step=i, dur_s=1.0 + (i % 7),
+                   ts=clock.advance(1.0))
+    assert tr.stragglers("solo") == []
+    assert tr.to_dict()["jobs"][0]["stragglers"] == []
+
+
+# ---------------------------------------------------------------------------
+# MFU
+# ---------------------------------------------------------------------------
+
+def test_mfu_formula_from_heartbeat_model_config():
+    clock = FakeClock()
+    tr = StepTracker(clock=clock)
+    hosts = ["a", "b"]
+    # No model config yet -> no MFU.
+    _feed(tr, clock, "j", hosts, {"a": 1.0, "b": 1.0}, step=1,
+          tokens=2048.0)
+    assert tr.job_doc("j")["mfu"] is None
+    for i in range(2, 6):
+        _feed(tr, clock, "j", hosts, {"a": 1.0, "b": 1.0}, step=i,
+              tokens=2048.0, n_params=1.0e9, device_count=8,
+              peak_tflops=197.0)
+    # fleet tokens/s = 2 hosts x 2048 tok / 1.0 s; MFU =
+    # 6*N*tok_s / 1e12 / devices / peak.
+    expected = 6.0 * 1.0e9 * (2 * 2048.0) / 1e12 / 8 / 197.0
+    assert tr.job_doc("j")["mfu"] == pytest.approx(expected, rel=1e-6)
+    assert tr.to_dict()["jobs"][0]["mfu"] == pytest.approx(expected,
+                                                           rel=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# fan-out: metrics + flight + goodput
+# ---------------------------------------------------------------------------
+
+def test_fanout_metrics_flight_and_goodput_stall_edges():
+    clock = FakeClock()
+    metrics = ControlPlaneMetrics()
+    flight = FlightRecorder()
+    goodput = GoodputLedger(clock=clock)
+    tr = StepTracker(clock=clock, metrics=metrics, flight=flight,
+                     goodput=goodput, straggler_steps=3)
+    kind, ns, name = default_goodput_key("j1")
+    assert (kind, ns, name) == ("CoordinatorJob", "head", "j1")
+    goodput.transition(kind, ns, name, PHASE_PRODUCTIVE)
+
+    hosts = ["a", "b"]
+    for i in range(1, 4):
+        _feed(tr, clock, "j1", hosts, {"a": 1.0, "b": 1.0}, step=i,
+              exemplar=f"ev-{i}")
+    t_slow_start = None
+    for i in range(4, 7):                       # 3 slow -> flagged
+        _feed(tr, clock, "j1", hosts, {"a": 1.0, "b": 3.0}, step=i)
+        if t_slow_start is None:
+            t_slow_start = clock.now()
+    _feed(tr, clock, "j1", hosts, {"a": 1.0, "b": 1.0}, step=7)
+    t_clear = clock.now()
+    # The stalled interval spans [first slow heartbeat, clearing
+    # heartbeat] — the recovery step's wall time still ran at the
+    # fleet's pace, so it closes the window, not the last slow beat.
+    stall_window = t_clear - t_slow_start
+    clock.advance(5.0)
+
+    # Metrics: histogram + skew gauge + straggler counter, with the
+    # goodput-key labels the alert engine deep-links through.
+    text = metrics.render()
+    assert 'tpu_train_step_duration_seconds_bucket' in text
+    # Exemplar survived (latest observation per bucket wins).
+    assert 'trace_id="ev-3"' in text
+    assert ('tpu_train_step_skew_ratio{host="b",job="j1",'
+            'kind="CoordinatorJob",name="j1",namespace="head"}') in text
+    assert 'tpu_train_stragglers_total{job="j1"} 1' in text
+
+    # Flight ring: one flagged record, one recovered record.
+    recs = [r for r in flight.timeline(kind, ns, name)
+            if r["type"] == "straggler"]
+    assert [r["edge"] for r in recs] == ["flagged", "cleared"]
+    assert all(r["host"] == "b" for r in recs)
+    assert "3 steps" in recs[0]["detail"]
+    assert "recovered at step 7" in recs[1]["detail"]
+
+    # Goodput: PRODUCTIVE split by a backdated stalled-on-straggler
+    # interval covering exactly the slow window, partition intact.
+    roll = goodput.rollup(kind, ns, name)
+    assert set(roll["phases"]) == set(PHASES)
+    assert sum(roll["phases"].values()) == pytest.approx(roll["total"],
+                                                         abs=1e-6)
+    assert roll["phases"][PHASE_STALLED] == pytest.approx(stall_window,
+                                                          abs=1e-6)
+    seq = [iv["phase"] for iv in goodput.intervals(kind, ns, name)]
+    assert seq == [PHASE_PRODUCTIVE, PHASE_STALLED, PHASE_PRODUCTIVE]
+    ivs = goodput.intervals(kind, ns, name)
+    assert ivs[1]["start"] == pytest.approx(t_slow_start)
+    assert ivs[1]["end"] == pytest.approx(t_clear)
+    assert roll["current_phase"] == PHASE_PRODUCTIVE
+
+
+# ---------------------------------------------------------------------------
+# bounds + guards + the Noop surface
+# ---------------------------------------------------------------------------
+
+def test_malformed_beats_ignored():
+    tr = StepTracker()
+    tr.observe("", "h", step=1, dur_s=1.0)
+    tr.observe("j", "", step=1, dur_s=1.0)
+    tr.observe("j", "h", step=1, dur_s=-0.5)
+    assert tr.jobs() == [] and tr.to_dict() == {"jobs": []}
+    assert tr.job_doc("j") is None
+
+
+def test_lru_bounds_jobs_and_hosts():
+    clock = FakeClock()
+    tr = StepTracker(clock=clock, max_jobs=4, max_hosts=8)
+    for j in range(10):
+        for h in range(20):
+            tr.observe(f"job-{j}", f"h-{h}", step=1, dur_s=1.0,
+                       ts=clock.now())
+    jobs = tr.jobs()
+    assert len(jobs) == 4
+    assert jobs == [f"job-{j}" for j in range(6, 10)]   # oldest evicted
+    assert tr.job_doc("job-9")["hosts"][0]["host"] == "h-12"
+    assert len(tr.job_doc("job-9")["hosts"]) == 8
+
+
+def test_noop_tracker_surface_compatible():
+    noop = NoopStepTracker()
+    noop.observe("j", "h", step=1, dur_s=1.0, tokens=5.0,
+                 collective_wait_s=0.1, ts=1.0, exemplar="x")
+    assert noop.jobs() == []
+    assert noop.stragglers() == []
+    assert noop.to_dict() == {"jobs": []}
+    assert noop.job_doc("j") is None
+    assert NOOP_STEPS.to_dict() == {"jobs": []}
+
+
+def test_set_stalled_edge_cases():
+    """The ledger side of the contract: no-op when not productive,
+    when closed, or on a same-state repeat."""
+    clock = FakeClock()
+    g = GoodputLedger(clock=clock)
+    # Unknown object: nothing created, nothing raised.
+    g.set_stalled("CoordinatorJob", "head", "nope", True)
+    assert g.keys() == []
+    key = ("CoordinatorJob", "head", "j")
+    g.transition(*key, "queued")
+    clock.advance(3.0)
+    # Not productive -> the flag latches but no interval swap.
+    g.set_stalled(*key, True)
+    assert [iv["phase"] for iv in g.intervals(*key)] == ["queued"]
+    g.set_stalled(*key, False)
+    clock.advance(2.0)
+    g.transition(*key, PHASE_PRODUCTIVE)
+    clock.advance(4.0)
+    g.set_stalled(*key, True)
+    g.set_stalled(*key, True)                   # same-state repeat: no-op
+    clock.advance(6.0)
+    g.set_stalled(*key, False)
+    g.transition(*key, "teardown")
+    g.close(*key) if hasattr(g, "close") else None
+    roll = g.rollup(*key)
+    assert roll["phases"][PHASE_STALLED] == pytest.approx(6.0, abs=1e-6)
+    assert sum(roll["phases"].values()) == pytest.approx(roll["total"],
+                                                         abs=1e-6)
+    seq = [iv["phase"] for iv in g.intervals(*key)]
+    assert seq == ["queued", PHASE_PRODUCTIVE, PHASE_STALLED,
+                   PHASE_PRODUCTIVE, "teardown"]

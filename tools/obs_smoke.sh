@@ -8,7 +8,12 @@
 # sharing the operator's tracer serve one completion, and the response
 # traceparent's trace must surface at /debug/traces?tree=1 with BOTH
 # gateway and engine spans; /debug/alerts must answer with an empty
-# ring on a healthy cluster.
+# ring on a healthy cluster.  Finally the training-step leg: a fake
+# two-host job posts synthetic step heartbeats (one host 3x slow)
+# through a coordinator sharing the operator's StepTracker, and the
+# straggler must surface — skew at /api/steps and /debug/steps, a
+# verdict with the slow host's name, and the per-host step-duration
+# histogram on the operator's /metrics.
 #
 #   tools/obs_smoke.sh
 #
@@ -148,13 +153,74 @@ try:
         srv.shutdown()
         fe.close()
 
+    # Training-step telemetry end-to-end: a coordinator sharing the
+    # operator's StepTracker ingests synthetic heartbeats for a fake
+    # 2-host job where host b runs 5x slow — with two hosts the fleet
+    # median is the midpoint, so b must exceed 3x a to clear the 1.5
+    # skew ratio — long enough to cross the K-consecutive threshold.
+    import tempfile
+
+    from kuberay_tpu.runtime.coordinator_server import (
+        CoordinatorServer, MemoryBackend)
+
+    coord = CoordinatorServer(state=MemoryBackend(), spawn_jobs=False,
+                              auth_token="",
+                              log_dir=tempfile.mkdtemp(prefix="obs-smoke-"),
+                              steps=op.steps)
+    csrv, curl = coord.serve_background()
+    try:
+        k = op.steps.straggler_steps
+        for step in range(1, k + 3):
+            beats = [{"type": "step", "name": "step_heartbeat",
+                      "job_id": "default/smoke-train", "host": host,
+                      "args": {"step": step, "dur_s": dur,
+                               "tokens": 4096.0,
+                               "collective_wait_s": 0.01,
+                               "n_params": 1.0e9, "device_count": 8,
+                               "peak_tflops": 197.0}}
+                     for host, dur in (("host-a", 0.5), ("host-b", 2.5))]
+            req = urllib.request.Request(
+                f"{curl}/api/events",
+                data=json.dumps({"events": beats}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req) as resp:
+                assert json.load(resp)["recorded"] == 2
+
+        # Read side, coordinator face: the skew and the verdict.
+        with urllib.request.urlopen(
+                f"{curl}/api/steps/default/smoke-train") as resp:
+            sdoc = json.load(resp)
+        hosts = {h["host"]: h for h in sdoc["hosts"]}
+        assert hosts["host-b"]["skew_ratio"] > op.steps.straggler_ratio, sdoc
+        assert hosts["host-b"]["straggler"], sdoc
+        assert not hosts["host-a"]["straggler"], sdoc
+        assert any(v["host"] == "host-b" for v in sdoc["verdicts"]), sdoc
+
+        # Same document from the operator's debug face.
+        with urllib.request.urlopen(
+                f"{url}/debug/steps/default/smoke-train") as resp:
+            ddoc = json.load(resp)
+        assert {h["host"] for h in ddoc["hosts"]} == {"host-a", "host-b"}
+
+        # And the per-host histogram reached the operator's registry.
+        with urllib.request.urlopen(f"{url}/metrics") as resp:
+            mtext = resp.read().decode()
+        assert "tpu_train_step_duration_seconds" in mtext, \
+            "train-step histogram missing from /metrics"
+        assert "tpu_train_stragglers_total" in mtext, \
+            "straggler counter missing from /metrics"
+    finally:
+        csrv.shutdown()
+
     print(f"obs smoke ok: {len(doc['spans'])} spans, "
           f"{len(text.splitlines())} metric lines, "
           f"{len(flight['records'])} flight records, "
           f"goodput ratio {roll['goodput_ratio']:.2f} over "
           f"{len(good['intervals'])} intervals, "
           f"{len(audit['decisions'])} autoscaler decisions, "
-          f"serve trace {trace_id} spans {sorted(got)}")
+          f"serve trace {trace_id} spans {sorted(got)}, "
+          f"straggler host-b skew "
+          f"{hosts['host-b']['skew_ratio']:.2f}")
 finally:
     op.stop()
 EOF

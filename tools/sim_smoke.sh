@@ -13,7 +13,17 @@
 # subset of this corpus on every PR; see docs/chaos-sim.md.
 set -eu
 cd "$(dirname "$0")/.."
-exec timeout -k 10 600 env JAX_PLATFORMS=cpu python -m kuberay_tpu.sim \
+timeout -k 10 600 env JAX_PLATFORMS=cpu python -m kuberay_tpu.sim \
     --scenario all \
     --seed "${SIM_SEEDS:-0..4}" \
     --steps "${SIM_STEPS:-8}"
+# The straggler drill again WITH the step tracker mounted: the corpus
+# above runs every scenario telemetry-off (where the straggler
+# invariant is vacuous); this leg arms the detection checker — a slow
+# host the microscope misses, mis-attributes, or detects late now
+# fails the smoke.
+exec timeout -k 10 600 env JAX_PLATFORMS=cpu python -m kuberay_tpu.sim \
+    --scenario straggler-drill \
+    --seed "${SIM_SEEDS:-0..4}" \
+    --steps "${SIM_STEPS:-12}" \
+    --step-telemetry
