@@ -325,7 +325,8 @@ class _Fleet:
 
     def __init__(self, cfg, params, replicas, *, slots, max_len,
                  num_blocks, block_size, seed, affinity, shedding,
-                 max_queue=512):
+                 max_queue=512, tiers=None, kv_max_blocks=0,
+                 prefill_beta=None):
         import random as _random
 
         from kuberay_tpu.controlplane.store import ObjectStore
@@ -346,12 +347,19 @@ class _Fleet:
             self.frontends.append(fe)
             self.servers.append(srv)
             urls[f"replica-{i}"] = url
+        backends = []
+        for i, s in enumerate(urls):
+            b = {"service": s, "weight": 1}
+            # tiers: one role per replica ("prefill"/"decode") turns the
+            # gateway into the two-hop scheduler; None = colocated.
+            if tiers is not None:
+                b["tier"] = tiers[i]
+            backends.append(b)
         store = ObjectStore()
         store.create({
             "apiVersion": "tpu.dev/v1", "kind": "TrafficRoute",
             "metadata": {"name": "bench", "namespace": "default"},
-            "spec": {"backends": [{"service": s, "weight": 1}
-                                  for s in urls]},
+            "spec": {"backends": backends},
             "status": {},
         })
         gw_cfg = GatewayConfig(
@@ -367,7 +375,15 @@ class _Fleet:
             # everything (backend queues absorb the burst and TTFT pays).
             max_inflight=(2 * slots) if shedding else 0,
             max_queue=16 if shedding else 4096,
-            queue_timeout=2.0 if shedding else 600.0)
+            queue_timeout=2.0 if shedding else 600.0,
+            # Disagg legs budget the KV handoff: past a few blocks the
+            # base64/JSON serialization costs the gateway CPU more than
+            # hop 2 recomputing the tail from the shipped prefix.
+            kv_max_blocks=kv_max_blocks,
+            # Prefill hop spreads bursts across the tier instead of
+            # convoying on the preamble's home replica (the tier's
+            # caches hold the same hot preambles within seconds).
+            prefill_beta=prefill_beta)
         self.metrics = MetricsRegistry()
         self.gateway = WeightedGateway(
             store, "bench", resolver=lambda s: urls[s],
@@ -418,7 +434,7 @@ def _hot_prompts(prefix_len, hot_prefixes):
 
 def _gen_arrivals(rng, workload, duration_s, base_rate, prefix_len,
                   block_size, hot_prefixes, hot_fraction,
-                  cold_len=64):
+                  cold_len=64, lengths=None, length_probs=None):
     """Seeded open-loop schedule: [(t_offset, prompt_tokens)].  Rates:
     diurnal = sinusoidal ramp peaking mid-run at 2x base; burst = base
     with a 4x storm in the middle third; hot-prefix = flat base with
@@ -426,7 +442,12 @@ def _gen_arrivals(rng, workload, duration_s, base_rate, prefix_len,
     prefixes (the prefix-skew regime affinity routing exists for) and
     SHORT unique cold prompts (``cold_len``) in between — chat turns
     against long system preambles, not a second long-prefill class that
-    would bury the hit/miss contrast in the tail."""
+    would bury the hit/miss contrast in the tail; long-prompt = flat
+    base with every prompt = shared hot preamble + unique filler to a
+    length drawn from the heavy-tailed DISCRETE mixture ``lengths`` /
+    ``length_probs`` (discrete so the prefill compile buckets stay
+    bounded and warmable — a continuous tail would put an XLA compile
+    inside the timed window of whichever leg saw that length first)."""
     import math
 
     hots = _hot_prompts(prefix_len, hot_prefixes)
@@ -445,7 +466,18 @@ def _gen_arrivals(rng, workload, duration_s, base_rate, prefix_len,
         if t >= duration_s:
             break
         n += 1
-        if workload == "hot-prefix" and rng.random() < hot_fraction:
+        if workload == "long-prompt":
+            r = rng.random()
+            acc, length = 0.0, lengths[-1]
+            for cand, p in zip(lengths, length_probs):
+                acc += p
+                if r < acc:
+                    length = cand
+                    break
+            prompt = list(hots[rng.randrange(hot_prefixes)])
+            prompt += [50_000 + (n * 331 + j) % 30_000
+                       for j in range(length - prefix_len)]
+        elif workload == "hot-prefix" and rng.random() < hot_fraction:
             prompt = list(rng.choice(hots))
         else:
             length = cold_len if workload == "hot-prefix" else prefix_len
@@ -514,6 +546,20 @@ def _gateway_hits(fleet):
         if line.startswith("tpu_gateway_prefix_cache_hits_total{"))
 
 
+def _kv_transfer_counts(fleet):
+    """(sent, skipped) KV blocks from the gateway's transfer counter —
+    skipped > 0 is the delta-only evidence the r12 artifact publishes."""
+    sent = skipped = 0.0
+    for line in fleet.metrics.render().splitlines():
+        if line.startswith("tpu_serve_kv_transfer_blocks_total{"):
+            val = float(line.rsplit(" ", 1)[1])
+            if 'outcome="sent"' in line:
+                sent += val
+            elif 'outcome="skipped"' in line:
+                skipped += val
+    return sent, skipped
+
+
 def _leg_summary(workload, seed, replicas, affinity, shedding, records,
                  wall, fleet, gw_hits_base=0.0):
     completed = [r for r in records if r["code"] == 200]
@@ -551,11 +597,22 @@ def _leg_summary(workload, seed, replicas, affinity, shedding, records,
 # - burst: a 4x arrival storm over the middle third against a fleet
 #   provisioned for the base rate — the load-shedding regime;
 # - diurnal: a sinusoidal ramp peaking at 2x base, run at 1 and 2
-#   replicas — TTFT p99 vs replica count for the SLO autoscaler story.
+#   replicas — TTFT p99 vs replica count for the SLO autoscaler story;
+# - long-prompt: heavy-tailed prompt lengths (discrete mixture; shared
+#   hot preamble + unique filler) with SHORT decodes — the prefill-bound
+#   regime disaggregation exists for, run colocated (4 mixed) vs disagg
+#   (2 prefill + 2 decode) at equal total replica count.
 TRAFFIC_PROFILES = {
     "hot-prefix": dict(prefix=496, new=8, slots=4, rate=5.0),
     "burst": dict(prefix=48, new=32, slots=2, rate=18.0),
     "diurnal": dict(prefix=48, new=32, slots=2, rate=12.0),
+    # kv_max_blocks budgets the disagg KV handoff (blocks per request);
+    # see GatewayConfig.kv_max_blocks.
+    "long-prompt": dict(prefix=128, new=16, slots=4, rate=8.0,
+                        lengths=[160, 256, 416],
+                        length_probs=[0.55, 0.3, 0.15],
+                        kv_max_blocks=2, cache_prefixes=1,
+                        prefill_beta=8.0),
 }
 
 HOT_PREFIXES = 8
@@ -576,40 +633,74 @@ def traffic(args) -> None:
     params = llama.init_params(cfg, jax.random.PRNGKey(0))
     bs = 16
 
+    # (workload, replicas, affinity, shedding, tiers) — tiers=None is a
+    # colocated fleet; a role list turns on two-hop disaggregation.
     workloads = []
     if args.traffic in ("hot-prefix", "all"):
-        workloads += [("hot-prefix", 2, True, False),
-                      ("hot-prefix", 2, False, False)]
+        workloads += [("hot-prefix", 2, True, False, None),
+                      ("hot-prefix", 2, False, False, None)]
     if args.traffic in ("burst", "all"):
-        workloads += [("burst", 2, True, True),
-                      ("burst", 2, True, False)]
+        workloads += [("burst", 2, True, True, None),
+                      ("burst", 2, True, False, None)]
     if args.traffic in ("diurnal", "all"):
-        workloads += [("diurnal", 1, True, True),
-                      ("diurnal", 2, True, True)]
+        workloads += [("diurnal", 1, True, True, None),
+                      ("diurnal", 2, True, True, None)]
+    if args.traffic == "long-prompt":
+        # Deliberately NOT in "all": the colocated-vs-disagg comparison
+        # is its own gate (tools/bench_serve.sh --disagg leg) and the
+        # "all" artifact's legs stay byte-stable.
+        workloads += [
+            # 3 replicas vs the colocated 4: the per-replica throughput
+            # column is the point — tier separation serves the same
+            # offered load with less hardware (prefill interference off
+            # the decode replica, preamble cache concentrated on fewer
+            # pools), and the prefill-only replicas keep the TTFT tail
+            # free of resident-decode interference.
+            ("long-prompt", 4, True, False, None),
+            ("long-prompt", 3, True, False,
+             ["prefill", "prefill", "decode"]),
+        ]
 
     legs = []
     for seed in args.seeds:
-        for workload, replicas, affinity, shedding in workloads:
+        for workload, replicas, affinity, shedding, tiers in workloads:
             prof = TRAFFIC_PROFILES[workload]
             prefix_len = prof["prefix"]
             new_tokens = prof["new"]
             slots = prof["slots"]
             rate = prof["rate"] * args.rate_scale
-            max_len = prefix_len + new_tokens + 16
+            lengths = prof.get("lengths")
+            longest = max(lengths) if lengths else prefix_len
+            max_len = longest + new_tokens + 16
             blocks_per_prompt = (max_len + bs - 1) // bs
+            # cache_prefixes: how many hot preambles the pool budget
+            # leaves room for beyond the active slots.  long-prompt
+            # runs it tight — cache pressure is where colocated decode
+            # pins (unevictable mid-decode blocks) squeeze the prefix
+            # cache while a prefill tier's transients free immediately.
             num_blocks = slots * blocks_per_prompt + \
-                (HOT_PREFIXES // 2 + 1) * (prefix_len // bs)
+                prof.get("cache_prefixes", HOT_PREFIXES // 2 + 1) * \
+                (prefix_len // bs)
             fleet = _Fleet(cfg, params, replicas, slots=slots,
                            max_len=max_len, num_blocks=num_blocks,
                            block_size=bs, seed=seed, affinity=affinity,
-                           shedding=shedding)
+                           shedding=shedding, tiers=tiers,
+                           kv_max_blocks=(prof.get("kv_max_blocks", 0)
+                                          if tiers else 0),
+                           prefill_beta=(prof.get("prefill_beta")
+                                         if tiers else None))
+            tracer = None
             try:
                 # Warm every compiled shape OUTSIDE the timed window:
                 # full prefill bucket, cold-prompt bucket, cached-suffix
                 # bucket, decode.
                 warm = [11_111 + j for j in range(prefix_len)]
                 cold_warm = [12_345 + j for j in range(64)]
-                fleet.warm([warm + [7], warm + [8], cold_warm + [9]])
+                warm_prompts = [warm + [7], warm + [8], cold_warm + [9]]
+                if lengths:
+                    warm_prompts += [[13_000 + j for j in range(ln)] + [7]
+                                     for ln in lengths]
+                fleet.warm(warm_prompts)
                 gw_srv, gw_url = fleet.gateway.serve_background_http()
                 try:
                     if workload == "hot-prefix":
@@ -623,8 +714,32 @@ def traffic(args) -> None:
                         hot_warm = [(0.25 * i, list(p) + [31337])
                                     for i, p in enumerate(hots * 2)]
                         _drive_open_loop(gw_url, hot_warm, new_tokens)
+                    if workload == "long-prompt":
+                        # Gateway-level warm pass: compiles the cached-
+                        # suffix buckets both legs hit (two-hop decode
+                        # re-prefill on the disagg leg, preamble hits on
+                        # the colocated one) and teaches routing homes —
+                        # an alternate-seed schedule so it never leaks
+                        # the measured arrivals.
+                        wrng = _random.Random(
+                            (seed << 8) ^ 0xD15A ^
+                            (zlib.crc32(workload.encode()) & 0xFFFF))
+                        warm_arr = _gen_arrivals(
+                            wrng, workload, min(5.0, args.duration), rate,
+                            prefix_len, bs, HOT_PREFIXES,
+                            hot_fraction=HOT_FRACTION, lengths=lengths,
+                            length_probs=prof["length_probs"])
+                        _drive_open_loop(gw_url, warm_arr, new_tokens)
                     fleet.reset_counters()
                     gw_hits_base = _gateway_hits(fleet)
+                    kv_base = _kv_transfer_counts(fleet)
+                    if workload == "long-prompt":
+                        # Both legs pay the tracer uniformly; the disagg
+                        # leg's kv-transfer span count is the smoke
+                        # gate's trace evidence.
+                        from kuberay_tpu.obs.trace import Tracer
+                        tracer = Tracer(max_spans=65536)
+                        fleet.set_tracer(tracer)
                     # zlib.crc32, not hash(): str hashing is salted per
                     # process and would unseed the schedule.
                     rng = _random.Random(
@@ -632,7 +747,9 @@ def traffic(args) -> None:
                         ^ (zlib.crc32(workload.encode()) & 0xFFFF))
                     arrivals = _gen_arrivals(
                         rng, workload, args.duration, rate, prefix_len,
-                        bs, HOT_PREFIXES, hot_fraction=HOT_FRACTION)
+                        bs, HOT_PREFIXES, hot_fraction=HOT_FRACTION,
+                        lengths=lengths,
+                        length_probs=prof.get("length_probs"))
                     records, wall = _drive_open_loop(gw_url, arrivals,
                                                      new_tokens)
                 finally:
@@ -640,6 +757,17 @@ def traffic(args) -> None:
                 leg = _leg_summary(workload, seed, replicas, affinity,
                                    shedding, records, wall, fleet,
                                    gw_hits_base=gw_hits_base)
+                if workload == "long-prompt":
+                    leg["mode"] = "disagg" if tiers else "colocated"
+                    leg["tokens_per_sec_per_replica"] = round(
+                        leg["tokens_per_sec"] / replicas, 2)
+                    sent, skipped = _kv_transfer_counts(fleet)
+                    leg["kv_sent_blocks"] = int(sent - kv_base[0])
+                    leg["kv_skipped_blocks"] = int(skipped - kv_base[1])
+                    if tracer is not None:
+                        leg["kv_transfer_spans"] = sum(
+                            1 for s in tracer.store.export()
+                            if s["name"] == "kv-transfer")
                 legs.append(leg)
                 print(json.dumps(leg), flush=True)
             finally:
@@ -794,9 +922,12 @@ def main(argv=None) -> int:
                     help="run the full engine matrix with TTFT "
                          "percentiles and relative overheads")
     ap.add_argument("--traffic", default="",
-                    choices=["", "hot-prefix", "burst", "diurnal", "all"],
+                    choices=["", "hot-prefix", "burst", "diurnal",
+                             "long-prompt", "all"],
                     help="seeded open-loop traffic generator through the "
-                         "prefix-aware gateway (tpu-bench-serve/v1)")
+                         "prefix-aware gateway (tpu-bench-serve/v1); "
+                         "long-prompt runs the colocated-vs-disaggregated "
+                         "comparison")
     ap.add_argument("--trace", action="store_true",
                     help="tracing-overhead gate: hot-prefix legs with "
                          "end-to-end request tracing off vs on, same "

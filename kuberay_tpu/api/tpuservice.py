@@ -69,6 +69,12 @@ class TpuServiceSpec(Serializable):
     # Serve config: model/apps description consumed by the inference engine
     # (analogue of the ref's ServeConfigV2 multi-app YAML blob).
     serveConfig: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # Disaggregated serving role (SERVE_TIERS): "mixed" replicas run
+    # prefill+decode colocated (the default, single-hop gateway path);
+    # "prefill"/"decode" services form a two-tier fleet — the controller
+    # stamps the tier into TrafficRoute backends and the gateway
+    # two-hop-schedules across them (serve/gateway.py).
+    serveTier: str = C.SERVE_TIER_MIXED
     clusterSpec: TpuClusterSpec = dataclasses.field(default_factory=TpuClusterSpec)
     upgradeStrategy: str = ServiceUpgradeType.NEW_CLUSTER
     upgradeOptions: Optional[ClusterUpgradeOptions] = None

@@ -216,7 +216,9 @@ class SliceAutoscaler:
         # p99 / queue-depth evaluated into a demand FLOOR for the
         # signal's policy group — merged max() with job demand, so a
         # breaching serve fleet scales up even with zero queued jobs and
-        # a held one can't be idle-reaped mid-recovery.
+        # a held one can't be idle-reaped mid-recovery.  Accepts one
+        # signal or a list (disaggregated fleets run one per tier, each
+        # bound to its own worker group); floors merge independently.
         self.slo = slo
         # Injectable clock (object with .now()) so idle bookkeeping and
         # SLO hysteresis run under the sim VirtualClock in tests.
@@ -304,14 +306,17 @@ class SliceAutoscaler:
         idle_timeout = opts.idleTimeoutSeconds if opts else self.idle_timeout
         mode = opts.upscalingMode if opts else "Default"
         demand = self._demand_for(obj)
-        slo_info = None
-        if self.slo is not None:
+        slo_infos: Dict[str, dict] = {}
+        signals = self.slo if isinstance(self.slo, (list, tuple)) \
+            else ([self.slo] if self.slo is not None else [])
+        for sig in signals:
             group = next((g for g in cluster.spec.workerGroupSpecs
-                          if g.groupName == self.slo.policy.group), None)
+                          if g.groupName == sig.policy.group), None)
             if group is not None:
-                floor, slo_info = self.slo.demand_floor(group.replicas)
+                floor, info = sig.demand_floor(group.replicas)
                 gname = group.groupName
                 demand[gname] = max(demand.get(gname, 0), floor)
+                slo_infos[gname] = info
         slices = self.observe_slices(obj, demand)
         decisions = decide(cluster, demand, slices, idle_timeout, mode)
         applied = apply_decisions(self.store, cluster_name, namespace,
@@ -320,8 +325,12 @@ class SliceAutoscaler:
             current = {g.groupName: g.replicas
                        for g in cluster.spec.workerGroupSpecs}
             for d in decisions:
+                # Each decision carries ITS group's signal record — a
+                # prefill-tier scale-up must not be attributed to the
+                # decode tier's (quiet) signal in /debug/autoscaler.
                 self.audit.record(namespace, cluster_name, d,
                                   current=current.get(d.group, 0),
                                   demand=demand, slices=slices,
-                                  applied=applied, slo=slo_info)
+                                  applied=applied,
+                                  slo=slo_infos.get(d.group))
         return applied

@@ -532,9 +532,16 @@ class TpuServiceController:
                 self.store.create(per_cluster)
             except AlreadyExists:
                 pass
+            # Disaggregation role rides along with the weight: the gateway
+            # two-hop-schedules routes whose backends span prefill+decode
+            # tiers (serve/gateway.py) and ignores the field otherwise.
+            tier = svc.spec.serveTier
+            if tier not in C.SERVE_TIERS:
+                tier = C.SERVE_TIER_MIXED
             route["spec"]["backends"].append({
                 "service": per_cluster["metadata"]["name"],
                 "weight": cs.trafficWeightPercent,
+                "tier": tier,
             })
         self.store.ensure(route)
 

@@ -78,11 +78,20 @@ class ServeSloSignal:
 
     def __init__(self, registry, policy: Optional[SloPolicy] = None,
                  queue_depth_fn: Optional[Callable[[], int]] = None,
-                 clock=None, phase: str = "ttft"):
+                 clock=None, phase: str = "ttft",
+                 labels: Optional[Dict[str, str]] = None):
+        """``labels`` overrides the histogram series the signal windows
+        (default ``{"phase": phase}``).  A disaggregated fleet runs one
+        signal per tier — e.g. ``{"phase": "gateway-prefill"}`` with
+        ``queue_depth_fn=lambda: gw.tier_queue_depth("prefill")`` scaling
+        the prefill worker group, and the decode twin likewise — so a
+        prompt-heavy burst raises only the tier that is actually
+        breaching."""
         self.registry = registry
         self.policy = policy or SloPolicy()
         self.queue_depth_fn = queue_depth_fn
         self.phase = phase
+        self.labels = dict(labels) if labels is not None else {"phase": phase}
         self._now = clock.now if clock is not None else time.time
         self._lock = threading.Lock()
         self._prev_snapshot: Optional[Dict] = None
@@ -91,8 +100,7 @@ class ServeSloSignal:
         self._last_scale_up = float("-inf")
 
     def _sample_locked(self) -> Tuple[float, int, int]:
-        cur = self.registry.histogram_snapshot(TTFT_METRIC,
-                                               {"phase": self.phase})
+        cur = self.registry.histogram_snapshot(TTFT_METRIC, self.labels)
         p99, n = histogram_delta_p99(self._prev_snapshot, cur)
         self._prev_snapshot = cur
         qd = int(self.queue_depth_fn()) if self.queue_depth_fn else 0
@@ -132,6 +140,8 @@ class ServeSloSignal:
             clear_for = (now - self._clear_since
                          if self._clear_since is not None else 0.0)
         return floor, {
+            "group": pol.group,
+            "series": dict(self.labels),
             "state": state,
             "ttft_p99_s": round(p99, 6),
             "ttft_p99_target_s": pol.ttft_p99_target_s,

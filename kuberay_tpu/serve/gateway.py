@@ -55,6 +55,7 @@ from kuberay_tpu.serve.prefix import (
     PrefixIndex,
     affinity_score,
     block_hashes,
+    decode_score,
     summarize_backend,
 )
 from kuberay_tpu.utils import constants as C
@@ -71,6 +72,13 @@ class GatewayConfig:
     affinity: bool = True          # False = legacy pure weighted random
     alpha: float = 4.0             # score per prefix-hit block
     beta: float = 1.0              # score penalty per queued/in-flight req
+    # Load weight for the disagg prefill hop (None = beta).  A prefill
+    # replica's cache is just the hot preambles — cheap to replicate
+    # across the tier — so spilling a burst to an idle peer costs one
+    # preamble prefill while staying home costs the whole queue; the
+    # prefill hop can afford a far more load-averse score than the
+    # single-hop path, whose spills also fragment decode-resident KV.
+    prefill_beta: Optional[float] = None
     epsilon: float = 0.05          # weighted-random exploration fraction
     block_size: int = 16           # MUST match the backends' paged block
     index_capacity: int = 8192     # hashes per backend prefix index
@@ -79,6 +87,14 @@ class GatewayConfig:
     queue_timeout: float = 10.0    # max seconds a request waits for a slot
     retry_after: float = 1.0       # Retry-After hint on 429s
     retry_connect: bool = True     # one retry on next-best backend
+    kv_weight: float = 2.0         # decode-hop bonus per unit KV-free frac
+    kv_transfer: bool = True       # ship prefill KV deltas on the 2nd hop
+    # Per-request transfer budget in blocks (0 = unlimited).  Shipping
+    # the whole delta serializes float32 pages through base64+JSON on
+    # the gateway's CPU; beyond a few blocks the transfer costs more
+    # than the decode replica recomputing the tail, so cap the shipped
+    # prefix and let hop 2 re-prefill the remainder.
+    kv_max_blocks: int = 0
 
 
 class _Overloaded(Exception):
@@ -87,14 +103,27 @@ class _Overloaded(Exception):
         self.reason = reason
 
 
+class _HopFailed(Exception):
+    """A tier-scoped hop could not produce a backend response; carries
+    the HTTP error the gateway should surface."""
+
+    def __init__(self, code: int, payload: bytes, backend: str = "none"):
+        super().__init__(f"hop failed: http {code}")
+        self.code = code
+        self.payload = payload
+        self.backend = backend
+
+
 class _BackendState:
-    __slots__ = ("service", "url", "weight", "inflight", "queue_depth",
-                 "kv_free_blocks", "kv_total_blocks", "index", "picks")
+    __slots__ = ("service", "url", "weight", "tier", "inflight",
+                 "queue_depth", "kv_free_blocks", "kv_total_blocks",
+                 "index", "picks")
 
     def __init__(self, service: str, url: str, index_capacity: int):
         self.service = service
         self.url = url
         self.weight = 0
+        self.tier = "mixed"           # prefill | decode | mixed
         self.inflight = 0
         self.queue_depth = 0          # last backend-reported engine queue
         self.kv_free_blocks = 0
@@ -144,6 +173,14 @@ class WeightedGateway:
                              "Requests shed by gateway admission (429 + "
                              "Retry-After), by reason (queue_full | "
                              "deadline)")
+            metrics.describe("tpu_serve_kv_transfer_blocks_total",
+                             "Paged-KV blocks handled by the prefill->"
+                             "decode transfer, by outcome (sent = delta "
+                             "blocks shipped, skipped = already resident "
+                             "on the decode replica)")
+            metrics.describe("tpu_serve_kv_transfer_seconds",
+                             "Wall seconds per prefill->decode KV "
+                             "transfer (resident probe + export + import)")
         self.store = store
         self.route_name = route_name
         self.namespace = namespace
@@ -190,17 +227,18 @@ class WeightedGateway:
     def _refresh(self):
         route = self.store.try_get("TrafficRoute", self.route_name,
                                    self.namespace)
-        entries: List[Tuple[str, int]] = []
+        entries: List[Tuple[str, int, str]] = []
         if route is not None:
             for b in route.get("spec", {}).get("backends", []):
                 if b.get("weight", 0) > 0:
-                    entries.append((b["service"], int(b["weight"])))
+                    entries.append((b["service"], int(b["weight"]),
+                                    b.get("tier") or "mixed"))
         weight_changes: List[Tuple[str, int, int]] = []
         with self._lock:
             # Keep prior state (prefix index, load) across weight steps:
             # an upgrade shifting 10% -> 50% must not cold-start the new
             # cluster's affinity map at every step.
-            for svc, w in entries:
+            for svc, w, tier in entries:
                 st = self._states.get(svc)
                 if st is None:
                     st = self._states[svc] = _BackendState(
@@ -208,13 +246,14 @@ class WeightedGateway:
                 if st.weight != w:
                     weight_changes.append((svc, st.weight, w))
                 st.weight = w
-            active = {svc for svc, _ in entries}
+                st.tier = tier
+            active = {svc for svc, _, _ in entries}
             for svc, st in self._states.items():
                 if svc not in active:
                     if st.weight != 0:
                         weight_changes.append((svc, st.weight, 0))
                     st.weight = 0
-            self._active = [svc for svc, _ in entries]
+            self._active = [svc for svc, _, _ in entries]
         if self.flight is not None:
             for svc, old, new in weight_changes:
                 self.flight.record("Backend", self.namespace, svc,
@@ -233,10 +272,18 @@ class WeightedGateway:
 
     # -- routing -----------------------------------------------------------
 
-    def _eligible_locked(self, exclude: Sequence[str]) -> List[_BackendState]:
+    def _eligible_locked(self, exclude: Sequence[str],
+                         tier: Optional[str] = None) -> List[_BackendState]:
         return [self._states[svc] for svc in self._active
                 if self._states[svc].weight > 0
-                and self._states[svc].url not in exclude]
+                and self._states[svc].url not in exclude
+                and (tier is None or self._states[svc].tier == tier)]
+
+    def _disagg_locked(self) -> bool:
+        """True when the route is a two-tier fleet: at least one live
+        prefill backend AND one live decode backend."""
+        tiers = {s.tier for s in self._states.values() if s.weight > 0}
+        return "prefill" in tiers and "decode" in tiers
 
     def _weighted_random_locked(self,
                                 cands: List[_BackendState]) -> _BackendState:
@@ -250,17 +297,32 @@ class WeightedGateway:
         return cands[-1]
 
     def _select_locked(self, cands: List[_BackendState],
-                       hashes: Sequence[int]
+                       hashes: Sequence[int], decode: bool = False,
+                       prefill: bool = False
                        ) -> Tuple[_BackendState, int, bool]:
         """Pick one backend among the weight-eligible candidates.
+        ``decode`` switches the score to the decode-hop variant (KV
+        locality + free-block headroom, serve/prefix.py decode_score);
+        ``prefill`` swaps in the prefill-hop load weight.
         Returns (state, prefix_hit_depth_of_pick, epsilon_fallback)."""
         cfg = self.config
         if not cfg.affinity or self._rng.random() < cfg.epsilon:
             s = self._weighted_random_locked(cands)
             return s, 0, cfg.affinity
-        scored = [(affinity_score(s.index.hit_depth(hashes) if hashes else 0,
-                                  s.load, cfg.alpha, cfg.beta), s)
-                  for s in cands]
+        if decode:
+            scored = [(decode_score(
+                s.index.hit_depth(hashes) if hashes else 0, s.load,
+                s.kv_free_blocks, s.kv_total_blocks,
+                cfg.alpha, cfg.beta, cfg.kv_weight), s)
+                for s in cands]
+        else:
+            beta = cfg.beta
+            if prefill and cfg.prefill_beta is not None:
+                beta = cfg.prefill_beta
+            scored = [(affinity_score(
+                s.index.hit_depth(hashes) if hashes else 0,
+                s.load, cfg.alpha, beta), s)
+                for s in cands]
         # Recompute each pick's depth only for the winner set (hit_depth
         # above already touched the LRU; cheap to re-probe).
         best = max(score for score, _ in scored)
@@ -294,25 +356,28 @@ class WeightedGateway:
         raise _Overloaded(reason)
 
     def _acquire(self, hashes: Sequence[int], timeout: float,
-                 exclude: Sequence[str]
+                 exclude: Sequence[str], tier: Optional[str] = None
                  ) -> Optional[Tuple[_BackendState, int, bool]]:
         """Admission + routing: pick a backend with a free in-flight slot,
         waiting (bounded queue, bounded time) when all are saturated.
-        Returns (state, hit_depth, epsilon_fallback), or None when the
-        route has no eligible backend (503); raises :class:`_Overloaded`
-        on shed (429)."""
+        ``tier`` restricts candidates to one fleet tier (disaggregated
+        two-hop path).  Returns (state, hit_depth, epsilon_fallback), or
+        None when the route has no eligible backend (503); raises
+        :class:`_Overloaded` on shed (429)."""
         cfg = self.config
         deadline = time.monotonic() + min(timeout, cfg.queue_timeout)
         with self._slot_free:
             while True:
-                cands = self._eligible_locked(exclude)
+                cands = self._eligible_locked(exclude, tier)
                 if not cands:
                     return None
                 free = [s for s in cands
                         if cfg.max_inflight <= 0
                         or s.inflight < cfg.max_inflight]
                 if free:
-                    s, depth, eps = self._select_locked(free, hashes)
+                    s, depth, eps = self._select_locked(
+                        free, hashes, decode=(tier == "decode"),
+                        prefill=(tier == "prefill"))
                     s.inflight += 1
                     self._note_pick_locked(s)
                     if depth > 0 and self.metrics is not None:
@@ -394,6 +459,20 @@ class WeightedGateway:
         prompt = self._prompt_tokens(body)
         hashes = block_hashes(prompt, self.config.block_size) \
             if prompt else []
+        if prompt and path.endswith("/completions"):
+            with self._lock:
+                disagg = self._disagg_locked()
+            if disagg:
+                try:
+                    doc = json.loads(body or b"{}")
+                except Exception:
+                    doc = None
+                # Streaming stays single-hop: the prefill/decode splice
+                # below rewrites the token list, which has no incremental
+                # representation over SSE.
+                if isinstance(doc, dict) and not doc.get("stream"):
+                    return self._forward_disagg(
+                        path, timeout, ctx, prompt, hashes, doc)
         tried: List[str] = []
         failed_svc = ""
         attempts = 2 if self.config.retry_connect else 1
@@ -455,6 +534,238 @@ class WeightedGateway:
             {"message": f"backend error: {last_err}"}).encode(), \
             (self._service_of(tried[-1]) if tried else "none"), {}
 
+    # -- disaggregated two-hop path ---------------------------------------
+
+    def _hop(self, tier: str, hashes: Sequence[int], path: str, body: bytes,
+             timeout: float, ctx, span_name: str, pre_forward=None
+             ) -> Tuple[_BackendState, int, bytes]:
+        """One tier-scoped forward with the single-hop path's admission +
+        retry-on-connect semantics.  ``pre_forward(state)`` runs while the
+        slot is held, before the request — the decode hop's KV transfer
+        hook, re-run against the fallback replica on retry.  Returns
+        (state, code, payload); raises :class:`_Overloaded` on shed and
+        :class:`_HopFailed` when no backend produced a response."""
+        tried: List[str] = []
+        failed_svc = ""
+        attempts = 2 if self.config.retry_connect else 1
+        last_err: Optional[Exception] = None
+        for _ in range(attempts):
+            q0 = self._now()
+            try:
+                picked = self._acquire(hashes, timeout, exclude=tried,
+                                       tier=tier)
+            except _Overloaded as e:
+                self.tracer.record_span(
+                    ctx, "gateway-queue", q0, self._now(), tier=tier,
+                    status="error", error=f"shed: {e.reason}")
+                raise
+            if picked is None:
+                if tried:
+                    break
+                raise _HopFailed(503, json.dumps(
+                    {"message": f"no healthy {tier} backends in route"}
+                ).encode())
+            s, depth, eps = picked
+            q1 = self._now()
+            self.tracer.record_span(ctx, "gateway-queue", q0, q1, tier=tier)
+            self.tracer.record_span(
+                ctx, "route-decision", q1, q1, backend=s.service, tier=tier,
+                hit_depth=depth, queue_depth=s.queue_depth,
+                epsilon_fallback=eps)
+            if failed_svc and self.flight is not None:
+                self.flight.record(
+                    "Backend", self.namespace, s.service, "retry",
+                    f"failover from {failed_svc}")
+            if pre_forward is not None:
+                pre_forward(s)
+            f0 = self._now()
+            try:
+                code, payload, resp_headers = self._request(
+                    s.url, path, body, timeout, trace_ctx=ctx)
+            except (urllib.error.URLError, ConnectionError, OSError) as e:
+                last_err = e
+                tried.append(s.url)
+                failed_svc = s.service
+                self.tracer.record_span(
+                    ctx, span_name, f0, self._now(), backend=s.service,
+                    status="error", error=f"connect: {e}")
+                if self.flight is not None:
+                    self.flight.record(
+                        "Backend", self.namespace, s.service, "exclude",
+                        f"connect-failure: {e}")
+                continue
+            finally:
+                self._release(s)
+            self.tracer.record_span(ctx, span_name, f0, self._now(),
+                                    backend=s.service, code=code)
+            self._observe_backend(s, resp_headers)
+            if hashes and code < 500:
+                with self._lock:
+                    s.index.insert(hashes)
+            return s, code, payload
+        raise _HopFailed(502, json.dumps(
+            {"message": f"{tier} backend error: {last_err}"}).encode(),
+            self._service_of(tried[-1]) if tried else "none")
+
+    def _forward_disagg(self, path: str, timeout: float, ctx,
+                        prompt: List[int], hashes: Sequence[int], doc: dict
+                        ) -> Tuple[int, bytes, str, Dict[str, str]]:
+        """Two-hop schedule: hop 1 runs the prefill on the prefill tier
+        (prefix affinity, ``max_tokens=1`` so the replica stops after the
+        first sampled token), then ships the prompt's KV blocks — delta
+        only, resident blocks skipped — into the chosen decode replica,
+        and hop 2 finishes generation there seeded with prompt + first
+        token.  The merged ``ttft_ms`` is the prefill replica's
+        engine-measured enqueue-to-first-token — the same meter a
+        colocated response reports, so mixed/disagg TTFTs compare
+        apples-to-apples; the gateway-measured hop-1 wall (adds the
+        gateway's own scheduling + HTTP time) rides in
+        ``disagg.prefill_hop_ms``."""
+        cfg = self.config
+        t0 = self._now()
+        pre = dict(doc)
+        pre["max_tokens"] = 1
+        pre.pop("stream", None)
+        try:
+            pf, code, payload = self._hop(
+                "prefill", hashes, path, json.dumps(pre).encode(),
+                timeout, ctx, "prefill-forward")
+        except _HopFailed as e:
+            return e.code, e.payload, e.backend, {}
+        if self.metrics is not None:
+            self.metrics.observe("tpu_serve_request_duration_seconds",
+                                 self._now() - t0,
+                                 {"phase": "gateway-prefill"},
+                                 exemplar=ctx.trace_id if ctx else None)
+        if code != 200:
+            return code, payload, pf.service, {}
+        try:
+            pdoc = json.loads(payload)
+        except Exception:
+            return 502, json.dumps(
+                {"message": "unparseable prefill response"}).encode(), \
+                pf.service, {}
+        tok0 = list(pdoc.get("tokens") or [])[:1]
+        ttft_ms = (self._now() - t0) * 1e3
+        try:
+            max_tokens = int(doc.get("max_tokens", 64))
+        except (TypeError, ValueError):
+            max_tokens = 64
+        if max_tokens <= 1 or not tok0:
+            pdoc.setdefault("ttft_ms", round(ttft_ms, 3))
+            pdoc["disagg"] = {"prefill": pf.service, "decode": None,
+                              "prefill_hop_ms": round(ttft_ms, 3),
+                              "kv_sent": 0, "kv_skipped": 0}
+            return 200, json.dumps(pdoc).encode(), pf.service, {}
+
+        xfer = {"sent": 0, "skipped": 0}
+
+        def _pre(de: _BackendState) -> None:
+            if not cfg.kv_transfer or de.url == pf.url:
+                return
+            k0 = self._now()
+            sent = skipped = 0
+            status, err = "ok", ""
+            try:
+                sent, skipped = self._kv_transfer(pf, de, prompt, timeout,
+                                                  ctx)
+            except Exception as e:      # best-effort: decode re-prefills
+                status, err = "error", f"kv-transfer: {e}"
+            k1 = self._now()
+            self.tracer.record_span(
+                ctx, "kv-transfer", k0, k1, src=pf.service, dst=de.service,
+                blocks_sent=sent, blocks_skipped=skipped, status=status,
+                error=err)
+            xfer["sent"], xfer["skipped"] = sent, skipped
+            if self.metrics is not None:
+                if sent:
+                    self.metrics.inc("tpu_serve_kv_transfer_blocks_total",
+                                     {"outcome": "sent"}, sent)
+                if skipped:
+                    self.metrics.inc("tpu_serve_kv_transfer_blocks_total",
+                                     {"outcome": "skipped"}, skipped)
+                self.metrics.observe("tpu_serve_kv_transfer_seconds",
+                                     k1 - k0)
+
+        dec = dict(doc)
+        dec["prompt_tokens"] = list(prompt) + tok0
+        dec["max_tokens"] = max_tokens - 1
+        dec.pop("stream", None)
+        d0 = self._now()
+        try:
+            de, code, payload = self._hop(
+                "decode", hashes, path, json.dumps(dec).encode(),
+                timeout, ctx, "decode-forward", pre_forward=_pre)
+        except _HopFailed as e:
+            return e.code, e.payload, e.backend, {}
+        if self.metrics is not None:
+            self.metrics.observe("tpu_serve_request_duration_seconds",
+                                 self._now() - d0,
+                                 {"phase": "gateway-decode"},
+                                 exemplar=ctx.trace_id if ctx else None)
+        if code != 200:
+            return code, payload, de.service, {}
+        try:
+            ddoc = json.loads(payload)
+        except Exception:
+            return 502, json.dumps(
+                {"message": "unparseable decode response"}).encode(), \
+                de.service, {}
+        merged = dict(ddoc)
+        merged["tokens"] = tok0 + list(ddoc.get("tokens") or [])
+        merged["prompt_len"] = len(prompt)
+        try:
+            merged["ttft_ms"] = round(float(pdoc["ttft_ms"]), 3)
+        except (KeyError, TypeError, ValueError):
+            merged["ttft_ms"] = round(ttft_ms, 3)
+        merged["disagg"] = {"prefill": pf.service, "decode": de.service,
+                            "prefill_hop_ms": round(ttft_ms, 3),
+                            "kv_sent": xfer["sent"],
+                            "kv_skipped": xfer["skipped"]}
+        return 200, json.dumps(merged).encode(), de.service, {}
+
+    def _kv_transfer(self, pf: _BackendState, de: _BackendState,
+                     prompt: List[int], timeout: float, ctx
+                     ) -> Tuple[int, int]:
+        """Delta-only KV handoff keyed by the chained block hashes: probe
+        the decode replica for resident prefix blocks, export only the
+        missing tail from the prefill replica, import it into the decode
+        pool.  Returns (sent, skipped) full-block counts."""
+        probe = json.dumps({"prompt_tokens": list(prompt)}).encode()
+        code, payload, _ = self._request(de.url, "/v1/kv/resident", probe,
+                                         timeout, trace_ctx=ctx)
+        resident = 0
+        if code == 200:
+            try:
+                resident = int(json.loads(payload).get(
+                    "resident_blocks", 0))
+            except Exception:
+                resident = 0
+        total = len(prompt) // self.config.block_size
+        if resident >= total:
+            return 0, resident
+        code, payload, _ = self._request(
+            pf.url, "/v1/kv/export",
+            json.dumps({"prompt_tokens": list(prompt),
+                        "skip_blocks": resident,
+                        "max_blocks": self.config.kv_max_blocks}).encode(),
+            timeout, trace_ctx=ctx)
+        if code != 200:
+            raise RuntimeError(f"export failed: http {code}")
+        blocks = json.loads(payload).get("blocks") or []
+        if not blocks:
+            return 0, resident
+        code, payload, _ = self._request(
+            de.url, "/v1/kv/import",
+            json.dumps({"prompt_tokens": list(prompt),
+                        "blocks": blocks}).encode(),
+            timeout, trace_ctx=ctx)
+        if code != 200:
+            raise RuntimeError(f"import failed: http {code}")
+        rdoc = json.loads(payload)
+        return int(rdoc.get("imported", 0)), int(rdoc.get(
+            "skipped", resident))
+
     def _service_of(self, url: str) -> str:
         with self._lock:
             for st in self._states.values():
@@ -503,7 +814,8 @@ class WeightedGateway:
         with self._lock:
             return [summarize_backend(
                 s.service, s.url, s.weight, s.inflight, s.queue_depth,
-                s.kv_free_blocks, s.kv_total_blocks, len(s.index), s.picks)
+                s.kv_free_blocks, s.kv_total_blocks, len(s.index), s.picks,
+                tier=s.tier)
                 for s in self._states.values()]
 
     def total_queue_depth(self) -> int:
@@ -512,6 +824,14 @@ class WeightedGateway:
         with self._lock:
             return sum(s.inflight + s.queue_depth
                        for s in self._states.values())
+
+    def tier_queue_depth(self, tier: str) -> int:
+        """Per-tier load signal — the queue-depth input of the per-tier
+        SLO signals (controlplane/slo.py, one ServeSloSignal per worker
+        group in a disaggregated fleet)."""
+        with self._lock:
+            return sum(s.inflight + s.queue_depth
+                       for s in self._states.values() if s.tier == tier)
 
     # -- HTTP --------------------------------------------------------------
 

@@ -72,7 +72,12 @@ class GroupMonitor:
                  step_timeout: float = 60.0,
                  on_degraded: Optional[Callable[[str], None]] = None,
                  grace: float = 30.0, compile_timeout: float = 900.0,
-                 budget_multiplier: float = 20.0):
+                 budget_multiplier: float = 20.0, clock=None):
+        # Injectable monotonic clock (object with .now()) for the
+        # timeout arithmetic — tests drive staleness/watchdog math with
+        # a fake clock instead of real sleeps (the wire loops below stay
+        # on real time regardless; they pace I/O, not verdicts).
+        self._now = clock.now if clock is not None else time.monotonic
         self.expected = list(expected)
         self.miss_timeout = miss_timeout
         # Cold-start default only: used until the rolling window has
@@ -86,7 +91,7 @@ class GroupMonitor:
         self.budget_multiplier = budget_multiplier
         self.on_degraded = on_degraded
         self._lock = threading.Lock()
-        now = time.monotonic()
+        now = self._now()
         # Followers get a startup grace: they begin beating only once
         # their engine is constructed (compile time included).
         self._last_beat: Dict[int, float] = {
@@ -130,7 +135,7 @@ class GroupMonitor:
             # unauthenticated port) must not create an entry that goes
             # stale and trips a bogus degradation.
             if worker_id in self._last_beat:
-                self._last_beat[worker_id] = time.monotonic()
+                self._last_beat[worker_id] = self._now()
 
     def current_step_budget(self) -> float:
         """The live (non-compile) step budget: adaptive once enough
@@ -159,7 +164,7 @@ class GroupMonitor:
         with self._lock:
             self._step_budget = budget
             self._step_compiling = compiling
-            self._step_started = time.monotonic()
+            self._step_started = self._now()
 
     def step_end(self) -> None:
         with self._lock:
@@ -176,14 +181,14 @@ class GroupMonitor:
             # a long-but-allowed step must not teach the window a larger
             # tail than the watchdog had actually granted (the unbounded
             # feedback loop this clamp + the hard cap exist to prevent).
-            dur = min(time.monotonic() - started, budget)
+            dur = min(self._now() - started, budget)
             self._durations.append(dur)
             if len(self._durations) > self.WINDOW:
                 del self._durations[:len(self._durations) - self.WINDOW]
 
     def check(self) -> Optional[str]:
         """One watchdog pass; returns the degradation reason (sticky)."""
-        now = time.monotonic()
+        now = self._now()
         with self._lock:
             if self._degraded:
                 return self._degraded
@@ -199,7 +204,7 @@ class GroupMonitor:
         return self.degraded
 
     def status(self) -> Dict[str, object]:
-        now = time.monotonic()
+        now = self._now()
         with self._lock:
             ages = {str(w): round(max(0.0, now - t), 1)
                     for w, t in self._last_beat.items()}

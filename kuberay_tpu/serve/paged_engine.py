@@ -24,7 +24,8 @@ Scheduling changes vs the dense engine:
 
 from __future__ import annotations
 
-from typing import Any, Dict, List
+import base64
+from typing import Any, Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -394,6 +395,130 @@ class PagedServeEngine(ServeEngine):
     def _finish(self, slot: int, reason: str) -> None:
         super()._finish(slot, reason)
         self._release(slot)
+
+    # ------------------------------------------------------------------
+    # KV-block transfer seam (disaggregated prefill/decode serving)
+    # ------------------------------------------------------------------
+    #
+    # A prefill-tier replica exports the registered prefix blocks of a
+    # completed prompt; a decode-tier replica imports them into its own
+    # BlockAllocator + pool, after which its normal admission path
+    # (_reserve -> match_prefix) serves the prompt from cache and only
+    # the partial tail block runs through prefill.  Blocks are keyed by
+    # the chained block hashes (serve/prefix.py) — the same chain the
+    # gateway's PrefixIndex shadows — so the transfer is content-
+    # addressable and delta-only: blocks already resident on the
+    # importer are skipped, never re-shipped.
+    #
+    # NOT thread-safe against a running engine loop: callers must
+    # serialize with step() (ServeFrontend.call_engine does exactly
+    # that) — an import racing a step would lose its pool write when the
+    # step publishes its own new cache array.
+
+    def resident_prefix_blocks(self, prompt_tokens: Sequence[int]) -> int:
+        """Delta probe: longest cached block-aligned prefix (blocks)."""
+        return self.allocator.resident_prefix_blocks(prompt_tokens)
+
+    def export_kv_blocks(self, prompt_tokens: Sequence[int],
+                         skip_blocks: int = 0,
+                         max_blocks: int = 0) -> List[Dict[str, Any]]:
+        """Read the registered prefix blocks of ``prompt_tokens`` out of
+        the pool, skipping the first ``skip_blocks`` (already resident on
+        the importer).  Returns wire records ``{index, hash, k, v}`` with
+        float32 base64 payloads of shape [L, Hkv, block_size, D]; stops
+        at the first block this replica no longer holds (evicted between
+        prefill and export — the importer prefills the remainder).
+        ``max_blocks`` > 0 caps the record count: the importer still
+        holds a contiguous resident prefix (skip + cap blocks) and
+        recomputes the rest, so a transfer-cost budget never breaks the
+        hash-chain invariant."""
+        if self.kv_quant != "none":
+            raise NotImplementedError(
+                "KV-block export requires kv_quant='none' (int8 pools "
+                "carry per-position scales the wire format omits)")
+        bs = self.block_size
+        picks: List[tuple] = []            # (index, hash, block id)
+        for i, h in enumerate(self.allocator.block_hashes(prompt_tokens)):
+            entry = self.allocator.lookup_block(h)
+            if entry is None or \
+                    entry[1] != tuple(prompt_tokens[i * bs:(i + 1) * bs]):
+                break
+            if i >= skip_blocks:
+                picks.append((i, h, entry[0]))
+            if max_blocks > 0 and len(picks) >= max_blocks:
+                break
+        if not picks:
+            return []
+        # One gather per pool: only the exported positions leave the
+        # device, never the whole pool.
+        idx = np.concatenate([np.arange(bid * bs, (bid + 1) * bs)
+                              for _, _, bid in picks])
+        k = np.asarray(self.cache["k"][:, :, idx, :], np.float32)
+        v = np.asarray(self.cache["v"][:, :, idx, :], np.float32)
+        out = []
+        for j, (i, h, _) in enumerate(picks):
+            sl = slice(j * bs, (j + 1) * bs)
+            out.append({
+                "index": i, "hash": h,
+                "k": base64.b64encode(k[:, :, sl, :].tobytes()).decode(),
+                "v": base64.b64encode(v[:, :, sl, :].tobytes()).decode(),
+            })
+        return out
+
+    def import_kv_blocks(self, prompt_tokens: Sequence[int],
+                         blocks: List[Dict[str, Any]]) -> Dict[str, int]:
+        """Adopt shipped prefix blocks into this replica's pool.  Walks
+        the prompt's hash chain from block 0: resident blocks count as
+        ``skipped`` (the delta contract), shipped ones are allocated,
+        written, and published refcount-0 cached; the walk stops at the
+        first chain gap or pool exhaustion (a non-contiguous suffix is
+        unusable — match_prefix only serves contiguous prefixes).
+        Returns ``{"imported": n, "skipped": m}``."""
+        if self.kv_quant != "none":
+            raise NotImplementedError(
+                "KV-block import requires kv_quant='none'")
+        bs = self.block_size
+        shape = (self.cfg.n_layers, self.cfg.n_kv_heads, bs,
+                 self.cfg.head_dim)
+        by_index = {int(b["index"]): b for b in blocks}
+        imported = skipped = 0
+        adopted: List[tuple] = []          # (block id, k array, v array)
+        for i, h in enumerate(self.allocator.block_hashes(prompt_tokens)):
+            toks = tuple(prompt_tokens[i * bs:(i + 1) * bs])
+            entry = self.allocator.lookup_block(h)
+            if entry is not None and entry[1] == toks:
+                skipped += 1
+                continue
+            rec = by_index.get(i)
+            if rec is None or rec.get("hash", h) != h:
+                break
+            try:
+                k = np.frombuffer(base64.b64decode(rec["k"]),
+                                  np.float32).reshape(shape)
+                v = np.frombuffer(base64.b64decode(rec["v"]),
+                                  np.float32).reshape(shape)
+            except (KeyError, ValueError, TypeError):
+                break                      # malformed payload: stop clean
+            bid = self.allocator.import_block(h, toks)
+            if bid is None:
+                break                      # pool exhausted
+            adopted.append((bid, k, v))
+            imported += 1
+        if adopted:
+            pool_dtype = self.cache["k"].dtype
+            idx = np.concatenate([np.arange(bid * bs, (bid + 1) * bs)
+                                  for bid, _, _ in adopted])
+            k_all = np.concatenate([k for _, k, _ in adopted],
+                                   axis=2).astype(pool_dtype)
+            v_all = np.concatenate([v for _, _, v in adopted],
+                                   axis=2).astype(pool_dtype)
+            self.cache["k"] = self.cache["k"].at[:, :, idx, :].set(k_all)
+            self.cache["v"] = self.cache["v"].at[:, :, idx, :].set(v_all)
+            # Content is in the pool: release to refcount-0 cached, the
+            # same state a locally prefilled + finished prompt leaves.
+            for bid, _, _ in adopted:
+                self.allocator.free(bid)
+        return {"imported": imported, "skipped": skipped}
 
     # ------------------------------------------------------------------
 

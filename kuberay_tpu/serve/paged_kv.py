@@ -143,6 +143,45 @@ class BlockAllocator:
                                 n_prompt_tokens % self.block_size)
         self.prefix_hits += n_cached_blocks * self.block_size
 
+    def resident_prefix_blocks(self, tokens: Sequence[int]) -> int:
+        """Longest cached block-aligned prefix, in blocks, WITHOUT
+        increfing (a pure query — the KV-transfer delta probe).  Token
+        verification matches :meth:`match_prefix`: a hash collision
+        reads as non-resident, so the peer ships the real content."""
+        bs = self.block_size
+        depth = 0
+        for i, h in enumerate(self.block_hashes(tokens)):
+            entry = self._hash_to_block.get(h)
+            if entry is None or \
+                    entry[1] != tuple(tokens[i * bs:(i + 1) * bs]):
+                break
+            depth += 1
+        return depth
+
+    def lookup_block(self, h: int) -> Optional[tuple]:
+        """(block id, exact block tokens) registered under a prefix
+        hash, or None — the export side's content-addressable read."""
+        return self._hash_to_block.get(h)
+
+    def import_block(self, h: int, block_tokens: Sequence[int]
+                     ) -> Optional[int]:
+        """Adopt one externally produced prefix block (KV transfer from
+        a prefill-tier peer): allocate a physical block and publish it
+        in the hash table.  The block comes back refcount-1 — the caller
+        writes the shipped KV content into the pool, then ``free``s it,
+        after which it is refcount-0 cached: reusable by the next
+        :meth:`match_prefix` and LRU-evictable exactly like a locally
+        prefilled block.  Returns None when the hash is already resident
+        or the pool is exhausted (the caller skips the block)."""
+        if h in self._hash_to_block:
+            return None                    # already resident; skip
+        bid = self.allocate()
+        if bid is None:
+            return None
+        self._hash_to_block[h] = (bid, tuple(block_tokens))
+        self._block_to_hash[bid] = h
+        return bid
+
     def register_prefix(self, tokens: Sequence[int],
                         block_ids: Sequence[int]) -> None:
         """Publish a request's full blocks into the prefix cache (after

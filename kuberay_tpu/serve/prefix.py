@@ -104,14 +104,26 @@ class BackendSnapshot(dict):
 def summarize_backend(service: str, url: str, weight: int, inflight: int,
                       queue_depth: int, kv_free_blocks: int,
                       kv_total_blocks: int, index_size: int,
-                      picks: int) -> BackendSnapshot:
+                      picks: int, tier: str = "mixed") -> BackendSnapshot:
     occ = 0.0
     if kv_total_blocks > 0:
         occ = round(1.0 - kv_free_blocks / kv_total_blocks, 4)
     return BackendSnapshot(
-        service=service, url=url, weight=weight, inflight=inflight,
-        queue_depth=queue_depth, kv_occupancy=occ,
+        service=service, url=url, weight=weight, tier=tier,
+        inflight=inflight, queue_depth=queue_depth, kv_occupancy=occ,
         prefix_index_size=index_size, picks=picks)
+
+
+def decode_score(hit_depth: int, queue_depth: float, kv_free_blocks: int,
+                 kv_total_blocks: int, alpha: float, beta: float,
+                 kv_weight: float) -> float:
+    """Decode-hop routing score for disaggregated serving: KV locality
+    (blocks this replica would NOT need shipped) priced like a prefix
+    hit, load priced like the prefill hop, plus a free-KV-fraction bonus
+    — a decode replica about to exhaust its pool preempts mid-decode,
+    which costs far more than landing on a slightly colder peer."""
+    free_frac = kv_free_blocks / kv_total_blocks if kv_total_blocks else 0.0
+    return alpha * hit_depth - beta * queue_depth + kv_weight * free_frac
 
 
 def aggregate_queue_depth(states: Dict[str, "object"]) -> int:

@@ -48,6 +48,11 @@ class ServeFrontend:
         # generator then drains the queue and yields the final
         # Response) — no in-queue sentinel.
         self._streams: Dict[str, "queue.Queue"] = {}
+        # Control calls executed BY the engine-loop thread between steps
+        # (KV export/import must serialize with step(): an import racing
+        # a step loses its pool write when the step publishes its own
+        # new cache array).  Each entry: (fn, done event, result box).
+        self._control: list = []
         self._stop = threading.Event()
         self._stats = {"requests": 0, "completed": 0, "rejected": 0,
                        "tokens_out": 0, "failed_degraded": 0}
@@ -106,6 +111,7 @@ class ServeFrontend:
                 # the pod is replaced by the controller.
                 self._stop.wait(0.1)
                 continue
+            self._drain_control()
             if not self.engine.has_work():
                 self._stop.wait(0.005)
                 continue
@@ -129,6 +135,35 @@ class ServeFrontend:
                         self._results[resp.request_id] = resp
                 if ev is not None:
                     ev.set()
+
+    def _drain_control(self):
+        """Run queued control calls on the engine-loop thread."""
+        with self._lock:
+            if not self._control:
+                return
+            batch, self._control = self._control, []
+        for fn, ev, box in batch:
+            try:
+                box["result"] = fn(self.engine)
+            except Exception as e:          # surfaced to the caller
+                box["error"] = e
+            ev.set()
+
+    def call_engine(self, fn, timeout: float = 30.0):
+        """Execute ``fn(engine)`` on the engine-loop thread, serialized
+        with step() — the seam KV-block export/import rides (handler
+        threads must never touch allocator/cache state mid-step).
+        Raises TimeoutError when the loop is wedged or degraded."""
+        ev = threading.Event()
+        box: Dict[str, Any] = {}
+        with self._lock:
+            self._control.append((fn, ev, box))
+        if not ev.wait(timeout):
+            raise TimeoutError("engine loop did not service the call "
+                               f"within {timeout:g}s")
+        if "error" in box:
+            raise box["error"]
+        return box.get("result")
 
     def _admit(self, rid, ev, prompt_tokens, max_tokens, temperature,
                eos_token, stream_queue=None, top_p=1.0, top_k=0,
@@ -340,6 +375,9 @@ class ServeFrontend:
                 return self._send(404, {"message": "unknown path"})
 
             def do_POST(self):
+                if self.path in ("/v1/kv/resident", "/v1/kv/export",
+                                 "/v1/kv/import"):
+                    return self._kv_endpoint()
                 if self.path != "/v1/completions":
                     return self._send(404, {"message": "unknown path"})
                 try:
@@ -411,6 +449,66 @@ class ServeFrontend:
                     "ttft_ms": (round(resp.ttft_s * 1e3, 3)
                                 if resp.ttft_s is not None else None),
                 }, headers=resp_headers)
+
+            def _kv_endpoint(self):
+                """KV-block transfer protocol (disaggregated serving,
+                docs/serving.md): ``resident`` probes the delta,
+                ``export`` reads registered prefix blocks off a prefill
+                replica, ``import`` adopts them on a decode replica.
+                All three serialize with the engine loop via
+                call_engine."""
+                if not hasattr(frontend.engine, "import_kv_blocks"):
+                    return self._send(501, {
+                        "message": "KV-block transfer requires a paged "
+                                   "engine (--paged)"})
+                if frontend.degraded is not None:
+                    return self._send(503, {"message": "degraded"})
+                try:
+                    body = self._body()
+                except Exception as e:
+                    return self._send(400, {"message": f"bad body: {e}"})
+                prompt = body.get("prompt_tokens") \
+                    if isinstance(body, dict) else None
+                if not isinstance(prompt, list) or not prompt or \
+                        not all(isinstance(t, int) for t in prompt):
+                    return self._send(400, {
+                        "message": "prompt_tokens must be a non-empty "
+                                   "list of token ids"})
+                try:
+                    if self.path == "/v1/kv/resident":
+                        n = frontend.call_engine(
+                            lambda e: e.resident_prefix_blocks(prompt))
+                        return self._send(
+                            200, {"resident_blocks": n},
+                            headers=self._load_headers())
+                    if self.path == "/v1/kv/export":
+                        try:
+                            skip = int(body.get("skip_blocks", 0))
+                            cap = int(body.get("max_blocks", 0))
+                        except (TypeError, ValueError):
+                            return self._send(400, {
+                                "message": "skip_blocks/max_blocks must "
+                                           "be ints"})
+                        blocks = frontend.call_engine(
+                            lambda e: e.export_kv_blocks(
+                                prompt, skip_blocks=max(0, skip),
+                                max_blocks=max(0, cap)))
+                        return self._send(
+                            200, {"blocks": blocks,
+                                  "block_size": frontend.engine.block_size},
+                            headers=self._load_headers())
+                    blocks = body.get("blocks")
+                    if not isinstance(blocks, list):
+                        return self._send(400, {
+                            "message": "blocks must be a list"})
+                    counts = frontend.call_engine(
+                        lambda e: e.import_kv_blocks(prompt, blocks))
+                    return self._send(200, counts,
+                                      headers=self._load_headers())
+                except NotImplementedError as e:
+                    return self._send(501, {"message": str(e)})
+                except TimeoutError as e:
+                    return self._send(503, {"message": str(e)})
 
             def _stream_completion(self, prompt, max_tokens, temperature,
                                    eos_token, timeout, top_p=1.0, top_k=0,

@@ -87,6 +87,12 @@ PORT_METRICS = 8080             # Prometheus metrics on every node
 PORT_SERVE = 8000               # inference HTTP
 PORT_GROUP_HEALTH = 8090        # serve-group heartbeat listener (host 0)
 
+# --- Disaggregated serving tiers (TpuServiceSpec.serveTier) ------------------
+SERVE_TIER_MIXED = "mixed"      # prefill+decode colocated (default)
+SERVE_TIER_PREFILL = "prefill"  # prompt processing only (hop 1)
+SERVE_TIER_DECODE = "decode"    # token generation off transferred KV (hop 2)
+SERVE_TIERS = (SERVE_TIER_MIXED, SERVE_TIER_PREFILL, SERVE_TIER_DECODE)
+
 # Kube PATCH MIME types, patch_type -> Content-Type (the one table the
 # clients send from and the apiserver inverts; apply is +yaml on the
 # wire, JSON being a YAML subset).

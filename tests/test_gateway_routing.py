@@ -153,6 +153,49 @@ def test_prefix_index_lru_bound():
     assert idx.hit_depth(b) == 2
 
 
+def test_prefix_index_partial_eviction_returns_surviving_depth():
+    """Tail blocks evicted under capacity pressure: hit_depth must
+    report the SURVIVING prefix depth — routing on a stale full-chain
+    hit would send the request to a replica that re-prefills most of
+    the prompt anyway."""
+    idx = PrefixIndex(capacity=4)
+    a = block_hashes(PROMPT, BS)                       # 4 hashes
+    idx.insert(a)
+    assert idx.hit_depth(a) == 4
+    # A routing probe touches the chain HEAD (hot prefix), then two
+    # fresh entries arrive: the LRU victims are a's tail blocks.
+    idx.hit_depth(a[:2])
+    idx.insert(block_hashes(list(range(500, 500 + 2 * BS)), BS))
+    assert len(idx) == 4
+    assert idx.hit_depth(a) == 2           # surviving prefix, never 4
+
+
+def test_prefix_index_head_eviction_breaks_whole_chain():
+    """Head block evicted while tail blocks remain resident: the chain
+    walk must return 0 (membership of later blocks alone is unservable
+    — match_prefix stops at the first allocator miss)."""
+    idx = PrefixIndex(capacity=3)
+    a = block_hashes(PROMPT, BS)           # 4 hashes -> a[0] evicted
+    idx.insert(a)
+    assert len(idx) == 3
+    assert idx.hit_depth(a) == 0           # despite 3 resident members
+
+
+def test_prefix_index_probed_prefix_survives_cold_churn():
+    """A hot prefix that keeps being probed (routed to) stays resident
+    through sustained cold-traffic churn — the probe's LRU touch is
+    what makes affinity stable under capacity pressure."""
+    idx = PrefixIndex(capacity=6)
+    hot = block_hashes(PROMPT[:2 * BS], BS)
+    idx.insert(hot)
+    for i in range(20):
+        assert idx.hit_depth(hot) == 2     # routing probe, every round
+        cold = list(range(1000 + 64 * i, 1000 + 64 * i + 4 * BS))
+        idx.insert(block_hashes(cold, BS))
+        assert len(idx) <= 6
+    assert idx.hit_depth(hot) == 2
+
+
 # ---------------------------------------------------------------------------
 # ε-fallback + TrafficRoute weight gating
 # ---------------------------------------------------------------------------
@@ -448,3 +491,271 @@ def test_injected_rng_makes_picks_reproducible():
             return [gw.pick_backend() for _ in range(64)]
     assert run(5) == run(5)
     assert run(5) != run(6)
+
+
+# ---------------------------------------------------------------------------
+# disaggregated two-hop scheduling (prefill tier -> KV transfer -> decode)
+# ---------------------------------------------------------------------------
+
+def make_tier_route(store, tiers, name="route"):
+    store.create({
+        "apiVersion": "tpu.dev/v1", "kind": "TrafficRoute",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {"backends": [
+            {"service": svc, "weight": 1, "tier": t}
+            for svc, t in tiers.items()]},
+        "status": {},
+    })
+
+
+class TierBackend:
+    """Jax-free disaggregated serve stand-in: a completions endpoint plus
+    the KV-transfer protocol surface (/v1/kv/resident|export|import),
+    recording every call so tests can assert the two-hop wire order."""
+
+    def __init__(self, name, resident_blocks=0, block_size=BS):
+        self.name = name
+        self.resident_blocks = resident_blocks
+        self.block_size = block_size
+        self.calls = []                   # (path, body-dict), arrival order
+        backend = self
+
+        class Handler(JsonHandler):
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                doc = json.loads(self.rfile.read(n) or b"{}")
+                backend.calls.append((self.path, doc))
+                if self.path == "/v1/kv/resident":
+                    return self._send(
+                        200, {"resident_blocks": backend.resident_blocks})
+                if self.path == "/v1/kv/export":
+                    total = len(doc["prompt_tokens"]) // backend.block_size
+                    skip = int(doc.get("skip_blocks", 0))
+                    blocks = [{"index": i, "hash": i + 1, "k": "", "v": ""}
+                              for i in range(skip, total)]
+                    return self._send(200, {"blocks": blocks})
+                if self.path == "/v1/kv/import":
+                    pre = backend.resident_blocks
+                    blocks = doc.get("blocks", [])
+                    backend.resident_blocks = pre + len(blocks)
+                    return self._send(200, {"imported": len(blocks),
+                                            "skipped": pre})
+                mt = int(doc.get("max_tokens", 8))
+                return self._send(200, {"tokens": [7000 + i
+                                                   for i in range(mt)],
+                                        "served_by": backend.name})
+
+        self.srv, self.url = serve_background(
+            ThreadingHTTPServer(("127.0.0.1", 0), Handler), f"tier-{name}")
+
+    def kv_paths(self):
+        return [p for p, _ in self.calls if p.startswith("/v1/kv")]
+
+    def close(self):
+        self.srv.shutdown()
+
+
+@pytest.fixture
+def tier_fleet():
+    pf, de = TierBackend("pf"), TierBackend("de")
+    store = ObjectStore()
+    make_tier_route(store, {"pf": "prefill", "de": "decode"})
+    urls = {"pf": pf.url, "de": de.url}
+    metrics = MetricsRegistry()
+    gw = WeightedGateway(store, "route", resolver=lambda s: urls[s],
+                         poll_interval=30.0, metrics=metrics,
+                         rng=random.Random(0),
+                         config=GatewayConfig(epsilon=0.0, block_size=BS))
+    yield gw, pf, de, metrics
+    gw.stop()
+    pf.close()
+    de.close()
+
+
+def test_two_hop_prefill_decode_splice(tier_fleet):
+    gw, pf, de, metrics = tier_fleet
+    body = json.dumps({"prompt_tokens": PROMPT, "max_tokens": 6}).encode()
+    code, payload = gw.forward("/v1/completions", body)
+    doc = json.loads(payload)
+    assert code == 200
+    assert len(doc["tokens"]) == 6          # tok0 + 5 decode tokens
+    assert doc["disagg"]["prefill"] == "pf"
+    assert doc["disagg"]["decode"] == "de"
+    assert doc["disagg"]["kv_sent"] == 4
+    assert doc["disagg"]["kv_skipped"] == 0
+    # The gateway's own hop-1 wall rides next to the engine-measured
+    # ttft_ms (the merged TTFT stays comparable with colocated fleets).
+    assert doc["disagg"]["prefill_hop_ms"] >= 0
+    assert doc["ttft_ms"] >= 0
+    # Hop 1 asked the prefill tier for exactly one token; hop 2 seeded
+    # the decode tier with prompt + that token and the remaining budget.
+    pf_gen = next(d for p, d in pf.calls if p.endswith("completions"))
+    de_gen = next(d for p, d in de.calls if p.endswith("completions"))
+    assert pf_gen["max_tokens"] == 1
+    assert de_gen["max_tokens"] == 5
+    assert de_gen["prompt_tokens"] == PROMPT + doc["tokens"][:1]
+    # KV wire order: probe the decode replica, export the delta from
+    # prefill, import into decode.
+    assert de.kv_paths() == ["/v1/kv/resident", "/v1/kv/import"]
+    assert pf.kv_paths() == ["/v1/kv/export"]
+    text = metrics.render()
+    assert 'tpu_serve_kv_transfer_blocks_total{outcome="sent"} 4.0' in text
+    # Per-hop latency lands in per-tier phases (the per-tier SLO input).
+    assert 'phase="gateway-prefill"' in text
+    assert 'phase="gateway-decode"' in text
+
+
+def test_two_hop_delta_only_skips_resident_blocks(tier_fleet):
+    gw, pf, de, metrics = tier_fleet
+    body = json.dumps({"prompt_tokens": PROMPT, "max_tokens": 4}).encode()
+    assert gw.forward("/v1/completions", body)[0] == 200
+    code, payload = gw.forward("/v1/completions", body)
+    doc = json.loads(payload)
+    assert code == 200
+    # Second pass: every block already resident on the decode replica —
+    # the probe short-circuits, nothing is exported or re-imported.
+    assert doc["disagg"]["kv_sent"] == 0
+    assert doc["disagg"]["kv_skipped"] == 4
+    assert pf.kv_paths() == ["/v1/kv/export"]                # first pass only
+    assert de.kv_paths() == ["/v1/kv/resident"] * 2 + ["/v1/kv/import"] \
+        or de.kv_paths() == ["/v1/kv/resident", "/v1/kv/import",
+                             "/v1/kv/resident"]
+    text = metrics.render()
+    assert 'tpu_serve_kv_transfer_blocks_total{outcome="sent"} 4.0' in text
+    assert ('tpu_serve_kv_transfer_blocks_total{outcome="skipped"} 4.0'
+            in text)
+
+
+def test_two_hop_single_token_skips_decode_hop(tier_fleet):
+    gw, pf, de, _ = tier_fleet
+    body = json.dumps({"prompt_tokens": PROMPT, "max_tokens": 1}).encode()
+    code, payload = gw.forward("/v1/completions", body)
+    doc = json.loads(payload)
+    assert code == 200 and len(doc["tokens"]) == 1
+    assert doc["disagg"]["decode"] is None
+    assert de.calls == []                   # decode tier never touched
+
+
+def test_two_hop_promptless_falls_back_single_hop(tier_fleet):
+    gw, pf, de, _ = tier_fleet
+    code, payload = gw.forward("/v1/completions",
+                               json.dumps({"max_tokens": 3}).encode())
+    doc = json.loads(payload)
+    assert code == 200 and "disagg" not in doc
+    assert pf.kv_paths() == [] and de.kv_paths() == []
+
+
+def test_mixed_route_never_two_hops(backends):
+    a = backends("a")
+    store = ObjectStore()
+    make_route(store, {"a": 100})
+    with make_gateway(store, lambda s: a.url, epsilon=0.0,
+                      block_size=BS) as gw:
+        body = json.dumps({"prompt_tokens": PROMPT,
+                           "max_tokens": 4}).encode()
+        code, payload = gw.forward("/v1/completions", body)
+    assert code == 200
+    assert json.loads(payload) == {"served_by": "a"}
+    assert a.hits == 1
+
+
+def test_two_hop_trace_tree_is_connected(tier_fleet):
+    from kuberay_tpu.obs.trace import Tracer, span_tree
+    gw, pf, de, _ = tier_fleet
+    tracer = Tracer()
+    gw.tracer = tracer
+    body = json.dumps({"prompt_tokens": PROMPT, "max_tokens": 6}).encode()
+    code, payload, headers = gw.forward_ex("/v1/completions", body)
+    assert code == 200
+    tid = headers["traceparent"].split("-")[1]
+    mine = [s for s in tracer.export() if s["trace_id"] == tid]
+    roots = span_tree(mine)
+    assert len(roots) == 1                  # ONE connected tree
+    assert roots[0]["name"] == "serve-request"
+    names = [c["name"] for c in roots[0]["children"]]
+    for want in ("prefill-forward", "kv-transfer", "decode-forward"):
+        assert want in names, names
+    kv = next(c for c in roots[0]["children"] if c["name"] == "kv-transfer")
+    assert kv["attrs"]["blocks_sent"] == 4
+    assert kv["attrs"]["src"] == "pf" and kv["attrs"]["dst"] == "de"
+    # The transfer happens between the two forwards.
+    pf_span = next(c for c in roots[0]["children"]
+                   if c["name"] == "prefill-forward")
+    de_span = next(c for c in roots[0]["children"]
+                   if c["name"] == "decode-forward")
+    assert pf_span["end"] <= kv["start"] <= de_span["start"]
+
+
+def test_tier_queue_depth_is_per_tier(tier_fleet):
+    gw, pf, de, _ = tier_fleet
+    with gw._lock:
+        gw._states["pf"].queue_depth = 3
+        gw._states["de"].queue_depth = 5
+        gw._states["de"].inflight = 1
+    assert gw.tier_queue_depth("prefill") == 3
+    assert gw.tier_queue_depth("decode") == 6
+    assert gw.total_queue_depth() == 9
+
+
+def test_backend_stats_reports_tier(tier_fleet):
+    gw, pf, de, _ = tier_fleet
+    tiers = {b["service"]: b["tier"] for b in gw.backend_stats()}
+    assert tiers == {"pf": "prefill", "de": "decode"}
+
+
+def test_export_request_carries_kv_max_blocks():
+    pf, de = TierBackend("pf"), TierBackend("de", resident_blocks=1)
+    store = ObjectStore()
+    make_tier_route(store, {"pf": "prefill", "de": "decode"})
+    urls = {"pf": pf.url, "de": de.url}
+    gw = WeightedGateway(store, "route", resolver=lambda s: urls[s],
+                         poll_interval=30.0, rng=random.Random(0),
+                         config=GatewayConfig(epsilon=0.0, block_size=BS,
+                                              kv_max_blocks=2))
+    try:
+        body = json.dumps({"prompt_tokens": PROMPT,
+                           "max_tokens": 4}).encode()
+        code, _ = gw.forward("/v1/completions", body)
+        assert code == 200
+        # The budget travels with the export request (the exporter
+        # truncates server-side so the capped pages never hit the wire).
+        export = next(d for p, d in pf.calls if p == "/v1/kv/export")
+        assert export["skip_blocks"] == 1
+        assert export["max_blocks"] == 2
+    finally:
+        gw.stop()
+        pf.close()
+        de.close()
+
+
+@pytest.mark.parametrize("prefill_beta,expect", [(None, "pa"), (8.0, "pb")])
+def test_prefill_beta_spreads_bursts_off_the_affine_replica(
+        prefill_beta, expect):
+    # pa holds the whole prompt's prefix (hit depth 4, score 4*4=16)
+    # but reports a queue of 5.  The default load weight (beta=1) keeps
+    # the burst home (16 - 5 > 0); prefill_beta=8 makes the idle peer
+    # win (16 - 40 < 0) — the prefill tier trades a cheap preamble
+    # re-prefill for not convoying.
+    pa, pb, de = TierBackend("pa"), TierBackend("pb"), TierBackend("de")
+    store = ObjectStore()
+    make_tier_route(store, {"pa": "prefill", "pb": "prefill",
+                            "de": "decode"})
+    urls = {"pa": pa.url, "pb": pb.url, "de": de.url}
+    gw = WeightedGateway(store, "route", resolver=lambda s: urls[s],
+                         poll_interval=30.0, rng=random.Random(0),
+                         config=GatewayConfig(epsilon=0.0, block_size=BS,
+                                              prefill_beta=prefill_beta))
+    try:
+        with gw._lock:
+            gw._states["pa"].index.insert(block_hashes(PROMPT, BS))
+            gw._states["pa"].queue_depth = 5
+        body = json.dumps({"prompt_tokens": PROMPT,
+                           "max_tokens": 2}).encode()
+        code, payload = gw.forward("/v1/completions", body)
+        assert code == 200
+        assert json.loads(payload)["disagg"]["prefill"] == expect
+    finally:
+        gw.stop()
+        pa.close()
+        pb.close()
+        de.close()

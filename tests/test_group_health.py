@@ -31,17 +31,34 @@ def wait_for(fn, timeout=10.0, poll=0.05):
     return False
 
 
+class FakeClock:
+    """Deterministic monotonic clock for the monitor's timeout math —
+    the unit tests below advance it explicitly instead of sleeping, so
+    a loaded CI box can't stretch a sleep past a deadline and flake."""
+
+    def __init__(self, t: float = 100.0):
+        self.t = t
+
+    def now(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
 # ---------------------------------------------------------------------------
-# monitor unit behavior
+# monitor unit behavior (fake-clocked: no wall-time dependence)
 
 
 def test_monitor_detects_missed_heartbeats():
-    m = GroupMonitor(expected=[1, 2], miss_timeout=0.3, grace=0.0)
+    clk = FakeClock()
+    m = GroupMonitor(expected=[1, 2], miss_timeout=0.3, grace=0.0,
+                     clock=clk)
     m.beat(1)
     m.beat(2)
     assert m.check() is None
     m.beat(1)
-    time.sleep(0.5)
+    clk.advance(0.5)
     m.beat(1)                      # 1 keeps beating, 2 went silent
     reason = m.check()
     assert reason and "[2]" in reason
@@ -51,16 +68,19 @@ def test_monitor_detects_missed_heartbeats():
 
 
 def test_monitor_step_watchdog():
-    m = GroupMonitor(expected=[], miss_timeout=30.0, step_timeout=0.2)
+    clk = FakeClock()
+    m = GroupMonitor(expected=[], miss_timeout=30.0, step_timeout=0.2,
+                     clock=clk)
     m.step_begin()
     assert m.check() is None
-    time.sleep(0.4)
+    clk.advance(0.4)
     assert "stuck" in m.check()
     # step_end clears the clock for healthy groups.
-    m2 = GroupMonitor(expected=[], miss_timeout=30.0, step_timeout=0.2)
+    m2 = GroupMonitor(expected=[], miss_timeout=30.0, step_timeout=0.2,
+                      clock=clk)
     m2.step_begin()
     m2.step_end()
-    time.sleep(0.4)
+    clk.advance(0.4)
     assert m2.check() is None
 
 
@@ -68,26 +88,32 @@ def test_monitor_ignores_stray_worker_ids():
     """A beat from an unexpected id (misconfigured worker, stale prior
     incarnation, random writer on the open port) must not create a
     tracked entry that later goes stale and degrades a healthy group."""
-    m = GroupMonitor(expected=[1], miss_timeout=0.3, grace=0.0)
+    clk = FakeClock()
+    m = GroupMonitor(expected=[1], miss_timeout=0.3, grace=0.0, clock=clk)
     m.beat(1)
     m.beat(7)                      # stray
-    time.sleep(0.4)
+    clk.advance(0.4)
     m.beat(1)
     assert m.check() is None
     assert set(m.status()["beat_age_seconds"]) == {"1"}
 
 
 def test_monitor_grace_defers_first_beat_deadline():
-    m = GroupMonitor(expected=[1], miss_timeout=0.2, grace=5.0)
-    time.sleep(0.4)                # past miss_timeout, inside grace
+    clk = FakeClock()
+    m = GroupMonitor(expected=[1], miss_timeout=0.2, grace=5.0, clock=clk)
+    clk.advance(0.4)               # past miss_timeout, inside grace
     assert m.check() is None
+    # Past grace + miss_timeout with no beat ever: degraded.
+    clk.advance(5.0)
+    assert m.check() and "missed heartbeats" in m.check()
 
 
 def test_monitor_on_degraded_fires_once():
     fired = []
+    clk = FakeClock()
     m = GroupMonitor(expected=[1], miss_timeout=0.1, grace=0.0,
-                     on_degraded=fired.append)
-    time.sleep(0.2)
+                     on_degraded=fired.append, clock=clk)
+    clk.advance(0.2)
     m.check()
     m.check()
     assert len(fired) == 1
@@ -306,14 +332,15 @@ def test_adaptive_budget_tracks_observed_steps():
     """Cold start uses the static default; after MIN_SAMPLES completed
     steps the budget becomes multiplier x rolling p99, floored at the
     miss timeout."""
+    clk = FakeClock()
     m = GroupMonitor(expected=[], miss_timeout=0.5, step_timeout=60.0,
-                     budget_multiplier=20.0)
+                     budget_multiplier=20.0, clock=clk)
     assert m.current_step_budget() == 60.0          # cold start
-    # Observe fast steps (~5 ms): budget drops to the miss-timeout
+    # Observe fast steps (5 ms): budget drops to the miss-timeout
     # floor — far quicker hang detection than the 60 s constant.
     for _ in range(m.MIN_SAMPLES):
         m.step_begin()
-        time.sleep(0.005)
+        clk.advance(0.005)
         m.step_end()
     fast = m.current_step_budget()
     assert fast == pytest.approx(0.5, abs=0.01), fast    # floor
@@ -330,40 +357,43 @@ def test_slow_but_alive_group_never_degrades():
     budget must NOT degrade the group (the false-DEGRADED this feature
     exists to prevent: a legit long chunked-prefill batch on a big
     model would otherwise trip a whole-slice replacement)."""
+    clk = FakeClock()
     m = GroupMonitor(expected=[], miss_timeout=0.05, step_timeout=0.1,
-                     budget_multiplier=20.0)
-    # History: ~10 ms steps -> p99 10 ms -> budget max(0.05, 0.2)=0.2 s.
+                     budget_multiplier=20.0, clock=clk)
+    # History: 10 ms steps -> p99 10 ms -> budget max(0.05, 0.2)=0.2 s.
     for _ in range(m.MIN_SAMPLES):
         m.step_begin()
-        time.sleep(0.01)
+        clk.advance(0.01)
         m.step_end()
     budget = m.current_step_budget()
     assert budget >= 0.15, budget
     # A 0.12 s step (longer than the 0.1 s static default!) survives.
     m.step_begin()
-    time.sleep(0.12)
+    clk.advance(0.12)
     assert m.check() is None, m.check()
     m.step_end()
     assert m.degraded is None
     # A genuinely stuck step still trips once the budget is exceeded.
     m.step_begin()
-    time.sleep(budget + 0.1)
+    clk.advance(budget + 0.1)
     assert m.check() and "stuck" in m.check()
 
 
 def test_compile_steps_stay_out_of_distribution():
     """A compile-flagged step must use the compile budget and must NOT
     inflate the rolling p99 for subsequent steps."""
+    clk = FakeClock()
     m = GroupMonitor(expected=[], miss_timeout=0.5, step_timeout=60.0,
-                     compile_timeout=300.0, budget_multiplier=20.0)
+                     compile_timeout=300.0, budget_multiplier=20.0,
+                     clock=clk)
     m.step_begin(compiling=True)
     assert m._step_budget == 300.0
-    time.sleep(0.2)                       # a "long compile"
+    clk.advance(0.2)                      # a "long compile"
     m.step_end()
     assert m._durations == []             # not recorded
     for _ in range(m.MIN_SAMPLES):
         m.step_begin()
-        time.sleep(0.002)
+        clk.advance(0.002)
         m.step_end()
     # Budget reflects the fast steady state, not the compile outlier.
     assert m.current_step_budget() == pytest.approx(0.5, abs=0.01)

@@ -315,3 +315,228 @@ def test_int8_paged_mixtral():
     assert all(len(t) == 5 for t in out.values())
     # int8 twin is deterministic.
     assert out == run(kv_quant="int8", decode_impl="xla")
+
+
+# ---------------------------------------------------------------------------
+# KV-block transfer (disaggregated prefill/decode, docs/serving.md)
+# ---------------------------------------------------------------------------
+
+def test_allocator_import_block_lifecycle():
+    """import_block publishes an externally produced block refcount-1;
+    after the caller frees it, it serves match_prefix like a locally
+    prefilled block and stays LRU-evictable."""
+    a = BlockAllocator(num_blocks=3, block_size=4)
+    toks = [1, 2, 3, 4, 5, 6, 7, 8]
+    h0, h1 = a.block_hashes(toks)
+    b0 = a.import_block(h0, toks[:4])
+    b1 = a.import_block(h1, toks[4:8])
+    assert b0 is not None and b1 is not None
+    assert a.refcount[b0] == 1 and a.refcount[b1] == 1
+    assert a.import_block(h0, toks[:4]) is None   # already resident
+    assert a.lookup_block(h0) == (b0, tuple(toks[:4]))
+    # While refcount-1 (content being written) the blocks cannot be
+    # cannibalized: only the one never-imported block is allocatable.
+    assert a.allocate() is not None and a.allocate() is None
+    a.free(b0), a.free(b1)
+    assert a.match_prefix(toks) == [b0, b1]       # now a normal cache hit
+    for b in (b0, b1):
+        a.free(b)
+
+
+def test_allocator_import_block_pool_exhausted():
+    a = BlockAllocator(num_blocks=1, block_size=4)
+    keep = a.allocate()
+    assert a.import_block(12345, [1, 2, 3, 4]) is None
+    a.free(keep)
+
+
+def test_allocator_resident_probe_is_pure():
+    """resident_prefix_blocks never increfs (the delta probe runs on the
+    engine loop against in-flight state) and token-verifies each block."""
+    a = BlockAllocator(num_blocks=2, block_size=4)
+    toks = [1, 2, 3, 4, 5, 6, 7, 8]
+    ids = [a.allocate(), a.allocate()]
+    a.register_prefix(toks, ids)
+    for b in ids:
+        a.free(b)
+    before = list(a.refcount)
+    assert a.resident_prefix_blocks(toks) == 2
+    assert a.resident_prefix_blocks(toks[:4]) == 1
+    assert a.resident_prefix_blocks([9, 9, 9, 9]) == 0
+    # A mid-chain token mismatch stops the walk (collision reads as
+    # non-resident).
+    assert a.resident_prefix_blocks(toks[:4] + [0, 0, 0, 0]) == 1
+    assert list(a.refcount) == before
+
+
+def test_engine_kv_export_import_roundtrip(params):
+    """Prefill on one engine, ship the blocks, decode on another: the
+    importer's output is bit-identical to a cold engine that prefilled
+    the prompt itself, and a second transfer is all-skip (delta-only)."""
+    prompt = list(range(1, 25))                  # 3 full blocks
+    cold = PagedServeEngine(CFG, params, max_slots=1, max_len=64,
+                            block_size=BS)
+    cold.add_request(Request("c", list(prompt), max_new_tokens=6))
+    expected = cold.run()[0].tokens
+
+    pf = PagedServeEngine(CFG, params, max_slots=1, max_len=64,
+                          block_size=BS)
+    pf.add_request(Request("p", list(prompt), max_new_tokens=1))
+    pf.run()
+    assert pf.resident_prefix_blocks(prompt) == 3
+    blocks = pf.export_kv_blocks(prompt)
+    assert [b["index"] for b in blocks] == [0, 1, 2]
+    assert blocks[0]["hash"] == pf.allocator.block_hashes(prompt)[0]
+
+    de = PagedServeEngine(CFG, params, max_slots=1, max_len=64,
+                          block_size=BS)
+    assert de.import_kv_blocks(prompt, blocks) == \
+        {"imported": 3, "skipped": 0}
+    # Re-import is pure skip — the wire carries nothing twice.
+    assert de.import_kv_blocks(prompt, blocks) == \
+        {"imported": 0, "skipped": 3}
+    de.add_request(Request("d", list(prompt), max_new_tokens=6))
+    out = de.run()
+    assert out[0].tokens == expected             # transferred KV == local
+    # 2 of 3 blocks served from the transfer: the engine always
+    # recomputes the prompt's final block so prefill emits real logits.
+    assert de.stats["prefix_hit_tokens"] == 2 * BS
+    # Export honors skip_blocks (the resident-probe delta).
+    assert [b["index"] for b in pf.export_kv_blocks(prompt, skip_blocks=2)] \
+        == [2]
+    assert pf.export_kv_blocks(prompt, skip_blocks=3) == []
+    # max_blocks budgets the transfer but keeps the shipped records a
+    # contiguous resident prefix (the importer recomputes the rest).
+    assert [b["index"] for b in pf.export_kv_blocks(prompt, max_blocks=2)] \
+        == [0, 1]
+    assert [b["index"] for b in pf.export_kv_blocks(prompt, skip_blocks=1,
+                                                    max_blocks=1)] == [1]
+    assert [b["index"] for b in pf.export_kv_blocks(prompt, max_blocks=0)] \
+        == [0, 1, 2]
+
+
+def test_engine_kv_import_rejects_malformed_and_gapped(params):
+    prompt = list(range(1, 17))                  # 2 full blocks
+    pf = PagedServeEngine(CFG, params, max_slots=1, max_len=64,
+                          block_size=BS)
+    pf.add_request(Request("p", list(prompt), max_new_tokens=1))
+    pf.run()
+    blocks = pf.export_kv_blocks(prompt)
+
+    # Tampered hash: the chain walk stops before the bad record.
+    de = PagedServeEngine(CFG, params, max_slots=1, max_len=64,
+                          block_size=BS)
+    bad = [dict(blocks[0], hash=blocks[0]["hash"] + 1), blocks[1]]
+    assert de.import_kv_blocks(prompt, bad) == {"imported": 0, "skipped": 0}
+    # Gap (block 0 missing): a non-contiguous suffix is unusable.
+    assert de.import_kv_blocks(prompt, [blocks[1]]) == \
+        {"imported": 0, "skipped": 0}
+    # Truncated payload: stop clean, nothing adopted.
+    trunc = [dict(blocks[0], k=blocks[0]["k"][:8])]
+    assert de.import_kv_blocks(prompt, trunc) == \
+        {"imported": 0, "skipped": 0}
+    assert de.allocator.num_free == de.num_blocks
+
+
+def test_engine_kv_transfer_requires_unquantized_pool(params):
+    eng = PagedServeEngine(CFG, params, max_slots=1, max_len=64,
+                           block_size=BS, kv_quant="int8",
+                           decode_impl="xla")
+    with pytest.raises(NotImplementedError):
+        eng.export_kv_blocks(list(range(1, 9)))
+    with pytest.raises(NotImplementedError):
+        eng.import_kv_blocks(list(range(1, 9)), [])
+
+
+def test_kv_http_endpoints(params):
+    """/v1/kv/{resident,export,import} over real HTTP: probe, delta
+    export, import, and validation errors — serialized with the engine
+    loop via call_engine."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    from kuberay_tpu.serve.server import ServeFrontend
+
+    prompt = list(range(1, 17))                  # 2 full blocks
+
+    def post(url, path, doc, code=200):
+        req = urllib.request.Request(
+            url + path, data=_json.dumps(doc).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return r.status, _json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, _json.loads(e.read())
+
+    pf_fe = ServeFrontend(PagedServeEngine(CFG, params, max_slots=1,
+                                           max_len=64, block_size=BS))
+    de_fe = ServeFrontend(PagedServeEngine(CFG, params, max_slots=1,
+                                           max_len=64, block_size=BS))
+    pf_srv, pf_url = pf_fe.serve_background()
+    de_srv, de_url = de_fe.serve_background()
+    try:
+        code, doc = post(pf_url, "/v1/completions",
+                         {"prompt_tokens": prompt, "max_tokens": 1})
+        assert code == 200 and len(doc["tokens"]) == 1
+
+        code, doc = post(pf_url, "/v1/kv/resident",
+                         {"prompt_tokens": prompt})
+        assert (code, doc["resident_blocks"]) == (200, 2)
+        code, doc = post(de_url, "/v1/kv/resident",
+                         {"prompt_tokens": prompt})
+        assert (code, doc["resident_blocks"]) == (200, 0)
+
+        code, doc = post(pf_url, "/v1/kv/export",
+                         {"prompt_tokens": prompt, "skip_blocks": 1})
+        assert code == 200 and doc["block_size"] == BS
+        assert [b["index"] for b in doc["blocks"]] == [1]
+        code, full = post(pf_url, "/v1/kv/export",
+                          {"prompt_tokens": prompt})
+        assert code == 200 and len(full["blocks"]) == 2
+
+        code, doc = post(de_url, "/v1/kv/import",
+                         {"prompt_tokens": prompt,
+                          "blocks": full["blocks"]})
+        assert (code, doc) == (200, {"imported": 2, "skipped": 0})
+        code, doc = post(de_url, "/v1/kv/resident",
+                         {"prompt_tokens": prompt})
+        assert doc["resident_blocks"] == 2
+
+        # Validation: bad prompt_tokens / blocks shape -> 400.
+        assert post(de_url, "/v1/kv/resident",
+                    {"prompt_tokens": []})[0] == 400
+        assert post(de_url, "/v1/kv/import",
+                    {"prompt_tokens": prompt, "blocks": "nope"})[0] == 400
+        assert post(pf_url, "/v1/kv/export",
+                    {"prompt_tokens": prompt,
+                     "skip_blocks": "x"})[0] == 400
+    finally:
+        for srv, fe in ((pf_srv, pf_fe), (de_srv, de_fe)):
+            srv.shutdown()
+            fe.close()
+
+
+def test_kv_http_501_for_non_paged_engine(params):
+    """A dense (non-paged) replica advertises the seam as unimplemented,
+    not as an error the gateway would retry."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    from kuberay_tpu.serve.server import ServeFrontend
+
+    fe = ServeFrontend(ServeEngine(CFG, params, max_slots=1, max_len=64))
+    srv, url = fe.serve_background()
+    try:
+        req = urllib.request.Request(
+            url + "/v1/kv/resident",
+            data=_json.dumps({"prompt_tokens": [1, 2, 3]}).encode(),
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30)
+        assert ei.value.code == 501
+    finally:
+        srv.shutdown()
+        fe.close()

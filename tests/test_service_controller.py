@@ -270,3 +270,53 @@ def test_head_pod_serve_label(h):
     assert s.status.numServeEndpoints == len(
         [p for p in workers_running
          if p["status"].get("phase") == "Running"])
+
+
+def test_serve_tier_stamped_into_traffic_route(h):
+    """spec.serveTier flows into every TrafficRoute backend the
+    incremental upgrade writes — the gateway's two-hop scheduler keys
+    off this field — and an unknown tier normalizes to mixed rather
+    than poisoning routing."""
+    features.set_gates({"TpuServiceIncrementalUpgrade": True})
+    svc = make_service()
+    svc.spec.serveTier = C.SERVE_TIER_PREFILL
+    svc.spec.upgradeStrategy = ServiceUpgradeType.INCREMENTAL
+    svc.spec.upgradeOptions = ClusterUpgradeOptions(
+        stepSizePercent=100, intervalSeconds=1)
+    h.store.create(svc.to_dict())
+    h.settle()
+    routes = []
+    h.store.watch(lambda ev: routes.append(ev.obj)
+                  if ev.kind == "TrafficRoute" and ev.type != "DELETED"
+                  else None)
+    obj = h.store.get(C.KIND_SERVICE, "svc")
+    obj["spec"]["clusterSpec"]["workerGroupSpecs"][0]["template"]["spec"][
+        "containers"][0]["image"] = "model:v2"
+    h.store.update(obj)
+    h.settle(rounds=16)
+    backends = [b for r in routes for b in r["spec"]["backends"]]
+    assert backends, "no weighted route observed during the roll"
+    assert all(b["tier"] == C.SERVE_TIER_PREFILL for b in backends)
+
+
+def test_unknown_serve_tier_normalizes_to_mixed(h):
+    features.set_gates({"TpuServiceIncrementalUpgrade": True})
+    svc = make_service()
+    svc.spec.serveTier = "bogus-tier"
+    svc.spec.upgradeStrategy = ServiceUpgradeType.INCREMENTAL
+    svc.spec.upgradeOptions = ClusterUpgradeOptions(
+        stepSizePercent=100, intervalSeconds=1)
+    h.store.create(svc.to_dict())
+    h.settle()
+    routes = []
+    h.store.watch(lambda ev: routes.append(ev.obj)
+                  if ev.kind == "TrafficRoute" and ev.type != "DELETED"
+                  else None)
+    obj = h.store.get(C.KIND_SERVICE, "svc")
+    obj["spec"]["clusterSpec"]["workerGroupSpecs"][0]["template"]["spec"][
+        "containers"][0]["image"] = "model:v2"
+    h.store.update(obj)
+    h.settle(rounds=16)
+    backends = [b for r in routes for b in r["spec"]["backends"]]
+    assert backends
+    assert all(b["tier"] == C.SERVE_TIER_MIXED for b in backends)

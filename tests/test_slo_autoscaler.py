@@ -248,3 +248,82 @@ def test_slo_decisions_visible_at_debug_endpoint():
         assert slo_sig["ttft_p99_s"] > slo_sig["ttft_p99_target_s"]
     finally:
         srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# per-tier signals (disaggregated prefill/decode fleet)
+# ---------------------------------------------------------------------------
+
+def _observe_tier(reg, phase, values):
+    for v in values:
+        reg.observe(TTFT_METRIC, v, {"phase": phase},
+                    buckets=SERVE_LATENCY_BUCKETS)
+
+
+def make_disagg_cluster():
+    """Two worker groups — one per tier — on one serve cluster."""
+    import copy
+
+    c = make_serve_cluster()
+    c.spec.workerGroupSpecs[0].groupName = "prefill"
+    g2 = copy.deepcopy(c.spec.workerGroupSpecs[0])
+    g2.groupName = "decode"
+    c.spec.workerGroupSpecs.append(g2)
+    return c
+
+
+def tier_signal(reg, clock, tier):
+    return ServeSloSignal(
+        reg, SloPolicy(group=tier, ttft_p99_target_s=0.5, min_samples=3,
+                       breach_seconds=15.0, clear_seconds=600.0,
+                       cooldown_seconds=30.0),
+        clock=clock, labels={"phase": f"gateway-{tier}"})
+
+
+def test_per_tier_slo_scales_only_breaching_tier():
+    """A prompt-heavy burst breaches only the prefill-phase histogram:
+    the prefill worker group steps up, the decode group never moves —
+    and vice versa.  Each audit record names its own tier's series."""
+    clock = VirtualClock()
+    reg = MetricsRegistry()
+    h = Harness()
+    h.store.create(make_disagg_cluster().to_dict())
+    h.settle()
+    audit = DecisionAudit(clock=clock)
+    auto = SliceAutoscaler(
+        h.store, audit=audit, clock=clock,
+        slo=[tier_signal(reg, clock, "prefill"),
+             tier_signal(reg, clock, "decode")])
+
+    def replicas():
+        return {g.groupName: g.replicas
+                for g in h.cluster().spec.workerGroupSpecs}
+
+    # Prefill-bound burst: long prompts inflate hop-1 TTFT only.
+    _observe_tier(reg, "gateway-prefill", [2.0] * 10)
+    assert not auto.reconcile("demo")            # not sustained yet
+    clock.advance(16.0)
+    _observe_tier(reg, "gateway-prefill", [2.0] * 10)
+    assert auto.reconcile("demo")
+    h.settle()
+    assert replicas() == {"prefill": 2, "decode": 1}
+    up = [e for e in audit.to_list() if e["direction"] == "up"][0]
+    assert up["group"] == "prefill"
+    assert up["signals"]["slo"]["series"] == {"phase": "gateway-prefill"}
+    assert up["signals"]["slo"]["state"] == "scale_up"
+    # No scale-up was ever attributed to the quiet decode tier.
+    assert all(e["group"] != "decode" or e["direction"] != "up"
+               for e in audit.to_list())
+
+    # Decode-bound burst (long generations): the mirror case.
+    clock.advance(120.0)
+    _observe_tier(reg, "gateway-decode", [3.0] * 10)
+    auto.reconcile("demo")
+    clock.advance(16.0)
+    _observe_tier(reg, "gateway-decode", [3.0] * 10)
+    assert auto.reconcile("demo")
+    h.settle()
+    assert replicas()["decode"] == 2
+    up = audit.to_list()[0]
+    assert up["group"] == "decode" and up["direction"] == "up"
+    assert up["signals"]["slo"]["series"] == {"phase": "gateway-decode"}

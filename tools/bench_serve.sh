@@ -39,6 +39,44 @@ print(f"bench serve smoke ok: {len(doc['legs'])} legs, "
       f"schema {doc['schema']}")
 EOF
 
+# Disaggregated-serving smoke: the colocated-vs-disagg comparison legs
+# (docs/serving.md, "Disaggregated prefill/decode") must complete with
+# the two-hop scheduler live — the disagg leg has to show actual KV
+# handoffs (sent + resident-skipped blocks from
+# tpu_serve_kv_transfer_blocks_total) and at least one kv-transfer span
+# under the gateway trace root.  Full-scale published numbers:
+# benchmark/results/serve_r12.json (seeds 0..2, duration 30).
+disagg_out="${BENCH_DISAGG_OUT:-/tmp/tpu_bench_serve_disagg.json}"
+timeout -k 10 600 env JAX_PLATFORMS=cpu python benchmark/serve_bench.py \
+    --traffic long-prompt \
+    --seeds "${BENCH_SEEDS:-0}" \
+    --duration "${BENCH_DURATION:-5}" \
+    --rate-scale "${BENCH_RATE_SCALE:-0.5}" \
+    --json-out "$disagg_out"
+BENCH_JSON_PATH="$disagg_out" python - <<'EOF'
+import json, os, sys
+sys.path.insert(0, os.getcwd())
+from benchmark.serve_bench import TRAFFIC_LEG_KEYS, TRAFFIC_SCHEMA
+doc = json.load(open(os.environ["BENCH_JSON_PATH"]))
+assert doc["schema"] == TRAFFIC_SCHEMA, doc.get("schema")
+modes = sorted(leg["mode"] for leg in doc["legs"])
+assert modes == ["colocated", "disagg"], modes
+for leg in doc["legs"]:
+    missing = [k for k in TRAFFIC_LEG_KEYS if k not in leg]
+    assert not missing, f"leg missing keys {missing}: {leg}"
+    assert leg["errors"] == 0, f"transport errors in leg: {leg}"
+    assert leg["completed"] > 0 and leg["tokens_per_sec"] > 0, leg
+dis = next(leg for leg in doc["legs"] if leg["mode"] == "disagg")
+assert dis["kv_sent_blocks"] > 0, f"no KV blocks shipped: {dis}"
+assert dis["kv_skipped_blocks"] > 0, \
+    f"delta-only transfer never skipped a resident block: {dis}"
+assert dis["kv_transfer_spans"] > 0, f"no kv-transfer spans traced: {dis}"
+print(f"bench serve disagg ok: {dis['completed']} requests, "
+      f"{dis['kv_sent_blocks']} blocks sent / "
+      f"{dis['kv_skipped_blocks']} resident-skipped, "
+      f"{dis['kv_transfer_spans']} kv-transfer spans")
+EOF
+
 # Tracing-overhead gate: same fleet + arrival schedule with end-to-end
 # request tracing off vs on; the throughput cost of spans + exemplars
 # must stay inside the budget (docs/observability.md, serve span model).
