@@ -907,6 +907,377 @@ def trace_overhead(args) -> None:
         print(f"wrote {args.json_out}", flush=True)
 
 
+# ---------------------------------------------------------------------------
+# Blue/green upgrade gate: burn-rate-gated vs naive timer ramp under a
+# mid-upgrade fault (PR 13, docs/upgrades.md)
+# ---------------------------------------------------------------------------
+
+UPGRADE_SCHEMA = "tpu-bench-upgrade/v1"
+# Per-leg keys the smoke gate (tools/bench_serve.sh upgrade leg) asserts.
+UPGRADE_LEG_KEYS = (
+    "mode", "seed", "requests", "completed", "shed", "errors",
+    "ttft_p50_ms", "ttft_p99_ms", "final_green_weight", "steps",
+    "rollbacks", "rolled_back", "promoted", "prewarm_replayed",
+    "prewarm_hit_rate", "fault_at_weight", "wall_s",
+)
+
+# Small hot-prefix regime: prefixes long enough that the green pre-warm
+# replay has something to cache, short enough that a leg's three ramps
+# fit a smoke duration.
+UPGRADE_PROFILE = dict(prefix=64, new=8, slots=4, rate=8.0)
+
+
+class _UpgradeFleet:
+    """One blue and one green serve replica behind a WeightedGateway,
+    with the TrafficRoute owned by the BENCH's ramp loop: the bench
+    plays service controller, driving the same UpgradeOrchestrator +
+    BurnRateGate decision core the control plane mounts
+    (kuberay_tpu/controlplane/upgrade.py) against real HTTP backends.
+
+    Routing is pure weighted-random (affinity off, epsilon 1.0): the
+    ramp's weight split IS the traffic split, which is the thing under
+    test — affinity scoring would route by prefix residency instead."""
+
+    def __init__(self, cfg, params, *, slots, max_len, num_blocks,
+                 block_size, seed):
+        import random as _random
+
+        from kuberay_tpu.controlplane.store import ObjectStore
+        from kuberay_tpu.serve.gateway import GatewayConfig, WeightedGateway
+        from kuberay_tpu.serve.paged_engine import PagedServeEngine
+        from kuberay_tpu.serve.server import ServeFrontend
+        from kuberay_tpu.utils.metrics import MetricsRegistry
+
+        self.frontends = {}
+        self.servers = {}
+        self.urls = {}
+        for role in ("blue", "green"):
+            eng = PagedServeEngine(cfg, params, max_slots=slots,
+                                   max_len=max_len, num_blocks=num_blocks,
+                                   block_size=block_size)
+            fe = ServeFrontend(eng, max_queue=512)
+            srv, url = fe.serve_background()
+            self.frontends[role] = fe
+            self.servers[role] = srv
+            self.urls[role] = url
+        self.store = ObjectStore()
+        self.store.create({
+            "apiVersion": "tpu.dev/v1", "kind": "TrafficRoute",
+            "metadata": {"name": "bench", "namespace": "default"},
+            "spec": {"backends": [{"service": "blue", "weight": 100},
+                                  {"service": "green", "weight": 0}]},
+            "status": {},
+        })
+        self.metrics = MetricsRegistry()
+        self.gateway = WeightedGateway(
+            self.store, "bench", resolver=lambda s: self.urls[s],
+            poll_interval=30.0, metrics=self.metrics,
+            config=GatewayConfig(affinity=False, epsilon=1.0,
+                                 block_size=block_size, max_queue=4096,
+                                 queue_timeout=600.0),
+            rng=_random.Random(seed))
+
+    def set_weights(self, green: int, *, prewarm: int = 0,
+                    drain: bool = False) -> None:
+        """Write the ramp's weight split and re-sync the gateway — the
+        bench's stand-in for the controller's weighted-route reconcile
+        (which the traffic-weight-through-gate analysis rule pins to the
+        orchestrator seam in the real controller)."""
+        from kuberay_tpu.controlplane.store import Conflict
+
+        blue = {"service": "blue", "weight": 100 - green}
+        grn = {"service": "green", "weight": green}
+        if prewarm:
+            grn["prewarm"] = prewarm
+        if drain:
+            blue["drain"] = True
+        for _ in range(5):
+            route = self.store.get("TrafficRoute", "bench", "default")
+            route["spec"]["backends"] = [blue, grn]
+            try:
+                self.store.update(route)
+                break
+            except Conflict:
+                continue        # gateway ack raced the write; re-read
+        self.gateway._refresh()
+
+    def prewarm_replayed(self) -> int:
+        route = self.store.get("TrafficRoute", "bench", "default")
+        acked = (route.get("status") or {}).get("prewarmed") or {}
+        return int(acked.get("green", 0) or 0)
+
+    def reset_green_counters(self) -> None:
+        a = self.frontends["green"].engine.allocator
+        a.prefix_hits = 0
+        a.prefix_queries = 0
+
+    def green_hit_rate(self):
+        st = self.frontends["green"].engine.stats
+        q = st["prefix_query_tokens"]
+        return round(st["prefix_hit_tokens"] / q, 3) if q else None
+
+    def kill_green(self) -> None:
+        """Mid-upgrade fault: green's endpoint starts refusing
+        connections (the replacement-pod regime).  Rewire its URL at
+        the gateway to a dead port — instant ECONNREFUSED, with no
+        half-open accept backlog for clients to hang on (shutting the
+        real listener leaves OS-backlogged connects waiting forever)."""
+        dead = "http://127.0.0.1:9"         # discard port: refused
+        self.urls["green"] = dead
+        with self.gateway._lock:
+            st = self.gateway._states.get("green")
+            if st is not None:
+                st.url = dead
+
+    def warm(self, prompts) -> None:
+        for fe in self.frontends.values():
+            for p in prompts:
+                fe.submit(p, max_tokens=2, timeout=600.0)
+
+    def close(self) -> None:
+        self.gateway.stop()
+        for srv in self.servers.values():
+            srv.shutdown()
+        for fe in self.frontends.values():
+            fe.close()
+
+
+def _run_upgrade_ramp(fleet, mode, stop_evt, ramp, *, step_size, interval_s,
+                      fault_at, prewarm_n, ttft_target_s, min_samples,
+                      tick=0.2):
+    """Control loop for one ramp leg.  ``gated`` consults the
+    BurnRateGate before every decision; ``naive`` feeds the orchestrator
+    a vacuously-healthy verdict — the open-loop timer ramp this PR
+    replaced, kept as the bench's control arm.  The fault fires the
+    first time green weight reaches ``fault_at``."""
+    from kuberay_tpu.controlplane.upgrade import (
+        ABORT,
+        PROMOTE,
+        ROLLBACK,
+        STEP,
+        BurnRateGate,
+        UpgradeObservation,
+        UpgradeOrchestrator,
+    )
+
+    orch = UpgradeOrchestrator()
+    # min_samples below the controller's default (5): smoke legs run
+    # seconds, not minutes — three bad attempts on a 2-replica fleet is
+    # already a 60%+ error ratio, far past the 14x burn threshold.
+    gate = BurnRateGate(fleet.metrics, ttft_target_s=ttft_target_s,
+                        min_samples=min_samples) \
+        if mode == "gated" else None
+    want_prewarm = prewarm_n if mode == "gated" else 0
+    # First write runs the gateway's prefix replay synchronously inside
+    # _refresh (gated leg); reset green's counters after so the reported
+    # hit rate is real ramp traffic against the pre-warmed cache.
+    fleet.set_weights(0, prewarm=want_prewarm)
+    ramp["prewarm_replayed"] = fleet.prewarm_replayed()
+    fleet.reset_green_counters()
+    while not stop_evt.is_set():
+        if not ramp["faulted"] and ramp["weight"] >= fault_at \
+                and time.time() - ramp["last_step"] >= interval_s:
+            # Fire only after green served a full interval at the fault
+            # weight, so the pre-warm hit-rate evidence reflects real
+            # ramp traffic (and the fault lands between a gate check
+            # and the next step — the worst-case window).
+            fleet.kill_green()
+            ramp["faulted"] = True
+        if ramp["promoted"]:
+            stop_evt.wait(tick)
+            continue
+        healthy, alert = (True, None)
+        if gate is not None:
+            healthy, alert = gate.verdict("green")
+        obs = UpgradeObservation(
+            now=time.time(), green_weight=ramp["weight"],
+            step_size=step_size, interval_s=interval_s,
+            last_step_time=ramp["last_step"],
+            ready_slices=1, desired_slices=1,   # bench rings stay whole
+            gate_healthy=healthy, firing_alert=alert,
+            rollbacks=ramp["rollbacks"], max_rollbacks=1,
+            hold_seconds=3600.0,                # hold for the leg's rest
+            last_rollback_time=ramp["last_rollback"],
+            prewarm_requested=bool(want_prewarm),
+            prewarm_done=ramp["prewarm_replayed"] > 0)
+        dec = orch.decide(obs)
+        if dec.action == STEP:
+            ramp["weight"] = dec.green_weight
+            ramp["last_step"] = time.time()
+            ramp["steps"] += 1
+            fleet.set_weights(ramp["weight"], prewarm=want_prewarm)
+        elif dec.action in (ROLLBACK, ABORT):
+            ramp["weight"] = 0
+            ramp["rollbacks"] += 1
+            ramp["rolled_back"] = True
+            ramp["last_rollback"] = time.time()
+            fleet.set_weights(0, prewarm=want_prewarm)
+        elif dec.action == PROMOTE:
+            ramp["weight"] = 100
+            ramp["promoted"] = True
+            fleet.set_weights(100)
+        stop_evt.wait(tick)
+
+
+def _upgrade_summary(mode, seed, records, wall, ramp):
+    completed = [r for r in records if r["code"] == 200]
+    shed = sum(1 for r in records if r["code"] == 429)
+    errors = sum(1 for r in records if r["code"] not in (200, 429))
+    ttfts = sorted(r["ttft_ms"] for r in completed
+                   if r["ttft_ms"] is not None)
+    return {
+        "mode": mode, "seed": seed,
+        "requests": len(records), "completed": len(completed),
+        "shed": shed, "errors": errors,
+        "ttft_p50_ms": round(percentile(ttfts, 50), 2) if ttfts else None,
+        "ttft_p99_ms": round(percentile(ttfts, 99), 2) if ttfts else None,
+        "final_green_weight": ramp["weight"],
+        "steps": ramp["steps"], "rollbacks": ramp["rollbacks"],
+        "rolled_back": ramp["rolled_back"], "promoted": ramp["promoted"],
+        "prewarm_replayed": ramp["prewarm_replayed"],
+        "prewarm_hit_rate": ramp["prewarm_hit_rate"],
+        "fault_at_weight": ramp["fault_at"],
+        "wall_s": round(wall, 2),
+    }
+
+
+def _upgrade_leg(cfg, params, mode, seed, args) -> dict:
+    import random as _random
+    import threading
+
+    prof = UPGRADE_PROFILE
+    bs = 16
+    prefix_len, new_tokens = prof["prefix"], prof["new"]
+    slots = prof["slots"]
+    rate = prof["rate"] * args.rate_scale
+    max_len = prefix_len + new_tokens + 16
+    blocks_per_prompt = (max_len + bs - 1) // bs
+    num_blocks = slots * blocks_per_prompt + \
+        HOT_PREFIXES * (prefix_len // bs)
+    fleet = _UpgradeFleet(cfg, params, slots=slots, max_len=max_len,
+                          num_blocks=num_blocks, block_size=bs, seed=seed)
+    ramp = {"weight": 0, "steps": 0, "rollbacks": 0, "last_step": 0.0,
+            "last_rollback": 0.0, "rolled_back": False, "promoted": False,
+            "faulted": False, "prewarm_replayed": 0,
+            "prewarm_hit_rate": None, "fault_at": None}
+    try:
+        # Compile every bucket on BOTH replicas outside the timed window:
+        # green's first real request lands mid-ramp where a compile stall
+        # would read as a gate-worthy latency spike.
+        warm = [11_111 + j for j in range(prefix_len)]
+        fleet.warm([warm + [7], warm + [8]])
+        gw_srv, gw_url = fleet.gateway.serve_background_http()
+        try:
+            # Blue-only warm pass through the GATEWAY: teaches the
+            # gateway's HotPrompts tracker the fleet's hot prefixes —
+            # the set the pre-warm replay sends at green.
+            hots = _hot_prompts(prefix_len, HOT_PREFIXES)
+            hot_warm = [(0.2 * i, list(p) + [31337])
+                        for i, p in enumerate(hots * 2)]
+            _drive_open_loop(gw_url, hot_warm, new_tokens)
+            stop = threading.Event()
+            ramp_thread = None
+            if mode != "baseline":
+                ramp["fault_at"] = args.upgrade_fault_at
+                ramp_thread = threading.Thread(
+                    target=_run_upgrade_ramp,
+                    args=(fleet, mode, stop, ramp),
+                    kwargs=dict(step_size=25,
+                                interval_s=args.upgrade_interval,
+                                fault_at=args.upgrade_fault_at,
+                                prewarm_n=HOT_PREFIXES,
+                                ttft_target_s=10.0, min_samples=3),
+                    daemon=True, name=f"upgrade-ramp-{mode}")
+                ramp_thread.start()
+            rng = _random.Random(
+                (seed << 8) ^ (zlib.crc32(b"upgrade") & 0xFFFF))
+            arrivals = _gen_arrivals(
+                rng, "hot-prefix", args.duration, rate, prefix_len, bs,
+                HOT_PREFIXES, hot_fraction=HOT_FRACTION)
+            records, wall = _drive_open_loop(gw_url, arrivals, new_tokens,
+                                             timeout=60.0)
+            stop.set()
+            if ramp_thread is not None:
+                ramp_thread.join(timeout=10.0)
+                # Green serves nothing after the fault, so the end-of-leg
+                # hit rate IS the pre-fault ramp-traffic hit rate: the
+                # pre-warm evidence (gated leg warm, naive leg cold).
+                ramp["prewarm_hit_rate"] = fleet.green_hit_rate()
+        finally:
+            gw_srv.shutdown()
+        return _upgrade_summary(mode, seed, records, wall, ramp)
+    finally:
+        fleet.close()
+
+
+def upgrade(args) -> None:
+    """--upgrade: the zero-downtime upgrade gate.  Per seed, three legs
+    over the same seeded hot-prefix schedule: ``baseline`` (blue only —
+    the TTFT yardstick), ``gated`` (orchestrator ramp, burn-rate gate
+    live, green endpoint dies at ``--upgrade-fault-at``% — must roll
+    back with ZERO client-visible failures), ``naive`` (the pre-PR-13
+    open-loop timer ramp under the same fault — promotes the dead build
+    and fails requests, which is the point).  tools/bench_serve.sh
+    asserts the contrast; full-scale numbers live in
+    benchmark/results/upgrade_r13.json."""
+    import jax
+
+    from kuberay_tpu.models import llama
+
+    cfg = llama.CONFIGS[args.model]
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+
+    legs = []
+    for seed in args.seeds:
+        for mode in ("baseline", "gated", "naive"):
+            leg = _upgrade_leg(cfg, params, mode, seed, args)
+            legs.append(leg)
+            print(json.dumps(leg), flush=True)
+
+    comparisons = []
+    for seed in args.seeds:
+        by = {leg["mode"]: leg for leg in legs if leg["seed"] == seed}
+        base, gated, naive = by["baseline"], by["gated"], by["naive"]
+        inflation = None
+        if base["ttft_p99_ms"] and gated["ttft_p99_ms"] is not None:
+            inflation = round(gated["ttft_p99_ms"] / base["ttft_p99_ms"],
+                              3)
+        comparisons.append({
+            "seed": seed,
+            "gated_errors": gated["errors"],
+            "gated_rolled_back": gated["rolled_back"],
+            "ttft_inflation": inflation,
+            "naive_errors": naive["errors"],
+            "naive_promoted_bad_build": naive["promoted"],
+        })
+        print(json.dumps({"upgrade_comparison": comparisons[-1]}),
+              flush=True)
+
+    doc = {
+        "schema": UPGRADE_SCHEMA,
+        "workload_params": {
+            "model": args.model, "duration_s": args.duration,
+            "rate_scale": args.rate_scale, "block_size": 16,
+            "hot_prefixes": HOT_PREFIXES, "hot_fraction": HOT_FRACTION,
+            "profile": UPGRADE_PROFILE,
+            "step_size": 25, "interval_s": args.upgrade_interval,
+            "fault_at_weight": args.upgrade_fault_at,
+            "ttft_inflation_limit": args.upgrade_ttft_limit,
+        },
+        "seeds": list(args.seeds),
+        "device": str(jax.devices()[0]),
+        "platform": jax.devices()[0].platform,
+        "legs": legs,
+        "comparisons": comparisons,
+    }
+    if args.json_out:
+        pathlib.Path(args.json_out).parent.mkdir(parents=True,
+                                                 exist_ok=True)
+        pathlib.Path(args.json_out).write_text(
+            json.dumps(doc, indent=2) + "\n")
+        print(f"wrote {args.json_out}", flush=True)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="serve-bench")
     ap.add_argument("--cpu", action="store_true",
@@ -932,6 +1303,19 @@ def main(argv=None) -> int:
                     help="tracing-overhead gate: hot-prefix legs with "
                          "end-to-end request tracing off vs on, same "
                          "compiled fleet and arrival schedule")
+    ap.add_argument("--upgrade", action="store_true",
+                    help="blue/green upgrade gate: burn-rate-gated vs "
+                         "naive timer ramp under a mid-upgrade fault "
+                         "(tpu-bench-upgrade/v1)")
+    ap.add_argument("--upgrade-fault-at", type=int, default=50,
+                    help="green weight %% at which the green endpoint "
+                         "starts refusing connections")
+    ap.add_argument("--upgrade-interval", type=float, default=1.2,
+                    help="ramp step interval in seconds")
+    ap.add_argument("--upgrade-ttft-limit", type=float, default=5.0,
+                    help="max gated-leg TTFT p99 as a multiple of the "
+                         "blue-only baseline (recorded in the artifact; "
+                         "tools/bench_serve.sh asserts it)")
     ap.add_argument("--seeds", default="0",
                     help="traffic seeds: single (7) or range (0..2)")
     ap.add_argument("--duration", type=float, default=20.0,
@@ -950,7 +1334,7 @@ def main(argv=None) -> int:
     else:
         from kuberay_tpu.utils.platform import pin_platform_from_env
         pin_platform_from_env()
-    if args.traffic or args.trace:
+    if args.traffic or args.trace or args.upgrade:
         if ".." in args.seeds:
             lo, hi = args.seeds.split("..", 1)
             args.seeds = list(range(int(lo), int(hi) + 1))
@@ -960,6 +1344,8 @@ def main(argv=None) -> int:
             traffic(args)
         if args.trace:
             trace_overhead(args)
+        if args.upgrade:
+            upgrade(args)
     elif args.matrix:
         matrix(args)
     else:

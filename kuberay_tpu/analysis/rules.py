@@ -1186,3 +1186,60 @@ class SliceTeardownDrainSeamRule(Rule):
                         f"teardown through {self._SEAM}() so preemption-"
                         "noticed pods are drained (checkpoint + stamp) "
                         "before deletion")
+
+
+# ---------------------------------------------------------------------------
+# 12. traffic-weight-through-gate
+# ---------------------------------------------------------------------------
+
+@rule
+class TrafficWeightThroughGateRule(Rule):
+    """TrafficRoute weight mutations must route through the upgrade
+    gate.  A controller that runs the burn-rate-gated ramp funnels every
+    ``trafficWeightPercent`` write through ``_apply_upgrade_decision``
+    (downstream of one ``UpgradeOrchestrator.decide``) or the terminal
+    ``_promote`` flip.  A weight assignment anywhere else in the class
+    is a ramp step the gate never sanctioned: it can outrun the
+    fully-Ready ring fraction or advance under a firing fast-burn alert
+    — exactly the two invariants the closed loop exists to enforce (the
+    sim's ``weighted-ring-atomicity`` checker catches the journal-level
+    symptom; this rule catches the code path before it ships).
+    """
+
+    NAME = "traffic-weight-through-gate"
+    DESCRIPTION = ("classes with an _apply_upgrade_decision gate seam "
+                   "must not assign trafficWeightPercent elsewhere")
+    INVARIANT = ("every TrafficRoute weight mutation is downstream of "
+                 "one orchestrator decision (or the terminal promote)")
+
+    _SEAM = "_apply_upgrade_decision"
+    _FIELD = "trafficWeightPercent"
+    _ALLOWED = {"_apply_upgrade_decision", "_promote"}
+
+    def check(self, tree: ast.Module, ctx: FileContext) -> Iterable[Finding]:
+        for cls in iter_classes(tree):
+            methods = {n.name: n for n in cls.body
+                       if isinstance(n, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))}
+            if self._SEAM not in methods:
+                continue
+            for mname, fn in methods.items():
+                if mname in self._ALLOWED:
+                    continue
+                for node in ast.walk(fn):
+                    targets = []
+                    if isinstance(node, ast.Assign):
+                        targets = node.targets
+                    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                        targets = [node.target]
+                    for tgt in targets:
+                        if isinstance(tgt, ast.Attribute) and \
+                                tgt.attr == self._FIELD:
+                            yield self.finding(
+                                ctx, node,
+                                f"'{cls.name}.{mname}' assigns "
+                                f"{self._FIELD} outside the gate seam; "
+                                f"route every ramp weight write through "
+                                f"{self._SEAM}() so it stays downstream "
+                                "of one orchestrator decision (ring cap "
+                                "+ burn-rate verdict)")

@@ -62,6 +62,24 @@ class ClusterUpgradeOptions(Serializable):
     stepSizePercent: int = 10
     intervalSeconds: int = 30
     maxSurgePercent: int = 100          # extra capacity allowed during roll
+    # Closed-loop (burn-rate-gated) ramp budgets.  A rollback snaps the
+    # pending fleet's weight to 0; after ``holdSeconds`` of clean burn the
+    # ramp retries from 0, at most ``maxRollbacks`` times before the
+    # pending cluster is abandoned whole (state Aborted).
+    maxRollbacks: int = 2
+    holdSeconds: int = 60
+    # ICI-atomic wave size: green capacity is provisioned this many
+    # slices at a time and weight never outruns the fully-Ready ring
+    # fraction.  0 = all slices at once (the pre-wave behavior).
+    waveSlices: int = 0
+    # Prefix-cache pre-warm: before the first weight step the gateway
+    # replays up to this many of the active fleet's hottest prompt
+    # prefixes against the green backend.  0 = off.
+    prewarmPrompts: int = 0
+    # Session drain: after the ramp reaches 100 the blue backend is held
+    # at weight 0 until the gateway acks zero in-flight requests, or
+    # this many seconds pass.  0 = promote immediately (no drain).
+    drainTimeoutSeconds: int = 0
 
 
 @dataclasses.dataclass
@@ -115,6 +133,37 @@ class ServiceClusterStatus(Serializable):
         return {"applications": ServeApplicationStatus}
 
 
+class UpgradeState:
+    """Lifecycle of one burn-rate-gated incremental upgrade."""
+
+    PREWARMING = "Prewarming"    # green at weight 0, cache replay pending
+    RAMPING = "Ramping"          # weight stepping under the gate
+    HOLDING = "Holding"          # post-rollback backoff, waiting to retry
+    ROLLED_BACK = "RolledBack"   # fast-burn fired, weight snapped to 0
+    DRAINING = "Draining"        # green at 100, blue finishing in-flight
+    PROMOTED = "Promoted"
+    ABORTED = "Aborted"          # rollback budget exhausted, pending gone
+
+
+@dataclasses.dataclass
+class UpgradeStatus(Serializable):
+    """Observable state of the gated ramp (docs/upgrades.md)."""
+
+    state: str = ""
+    rollbacks: int = 0
+    lastRollbackTime: float = 0.0
+    # The burn-rate alert that forced the last rollback (obs/alerts.py
+    # active() shape: name/window/series/burn_rate/...).
+    lastAlert: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # ICI-ring wave progress of the green cluster.
+    readySlices: int = 0
+    desiredSlices: int = 0
+    # Spec hash whose upgrade exhausted the rollback budget; the
+    # controller refuses to re-prepare a pending cluster for it until
+    # the spec changes again.
+    abortedSpecHash: str = ""
+
+
 @dataclasses.dataclass
 class TpuServiceStatus(Serializable):
     serviceStatus: str = ""
@@ -124,12 +173,14 @@ class TpuServiceStatus(Serializable):
     pendingServiceStatus: Optional[ServiceClusterStatus] = None
     numServeEndpoints: int = 0
     lastUpgradeStepTime: float = 0.0
+    upgrade: Optional[UpgradeStatus] = None
 
     @classmethod
     def _nested_types(cls):
         return {"conditions": Condition,
                 "activeServiceStatus": ServiceClusterStatus,
-                "pendingServiceStatus": ServiceClusterStatus}
+                "pendingServiceStatus": ServiceClusterStatus,
+                "upgrade": UpgradeStatus}
 
 
 @dataclasses.dataclass

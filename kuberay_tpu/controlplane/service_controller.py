@@ -29,12 +29,24 @@ from kuberay_tpu.api.tpuservice import (
     ServiceStatusName,
     ServiceUpgradeType,
     TpuService,
+    UpgradeState,
+    UpgradeStatus,
 )
 from kuberay_tpu.builders.common import attach_cluster_auth, owner_reference
 from kuberay_tpu.builders.service import build_serve_service
 from kuberay_tpu.controlplane.events import EventRecorder
 from kuberay_tpu.controlplane.store import (AlreadyExists, NotFound,
                                              ObjectStore)
+from kuberay_tpu.controlplane.upgrade import (
+    ABORT,
+    PREWARM,
+    PROMOTE,
+    ROLLBACK,
+    STEP,
+    WAIT_DRAIN,
+    UpgradeObservation,
+    UpgradeOrchestrator,
+)
 from kuberay_tpu.obs.goodput import NOOP_TRANSITIONS
 from kuberay_tpu.obs.trace import NOOP_TRACER
 from kuberay_tpu.runtime.coordinator_client import CoordinatorError
@@ -57,7 +69,11 @@ class TpuServiceController:
                  recorder: Optional[EventRecorder] = None,
                  client_provider: Optional[Callable] = None,
                  tracer=None,
-                 transitions=None):
+                 transitions=None,
+                 clock=None,
+                 upgrade_gate=None,
+                 flight=None,
+                 metrics_registry=None):
         self.store = store
         self.recorder = recorder or EventRecorder(store)
         self.client_provider = client_provider
@@ -66,6 +82,24 @@ class TpuServiceController:
         # State-transition seam (obs.goodput): every serviceStatus write
         # routes through it (rule phase-transition-recorded).
         self.transitions = transitions or NOOP_TRANSITIONS
+        # Injectable clock, same idiom as the other controllers: every
+        # threshold/ramp/retirement timer reads this, so upgrade
+        # scenarios replay virtual-clock exact under the sim.
+        self._now: Callable[[], float] = (clock.now if clock is not None
+                                          else time.time)
+        # Burn-rate gate over the green fleet (controlplane.upgrade
+        # .BurnRateGate or anything with .verdict(backend) / .forget);
+        # None = vacuously healthy, keeping the open-loop semantics.
+        self.upgrade_gate = upgrade_gate
+        # Flight ring (obs.FlightRecorder): rollback forensics land next
+        # to the watch/event history of the service.
+        self.flight = flight
+        # MetricsRegistry for the tpu_upgrade_* families; optional.
+        self._metrics = metrics_registry
+        self._orchestrator = UpgradeOrchestrator()
+        # service name -> time the blue drain was requested (bounds
+        # WAIT_DRAIN by drainTimeoutSeconds).
+        self._drain_started: Dict[str, float] = {}
         # serve config cache per cluster (ref cacheServeConfig): avoids
         # re-PUTting an unchanged config every pass.
         self._submitted: Dict[str, str] = {}
@@ -125,7 +159,7 @@ class TpuServiceController:
           promotion path (whole-cluster repair — slices are never patched
           in place).
         """
-        now = time.time()
+        now = self._now()
         st = svc.status
 
         def degraded_apps(cs):
@@ -219,8 +253,18 @@ class TpuServiceController:
         raw = self.store.try_get(C.KIND_CLUSTER, cname, svc.metadata.namespace)
         return TpuCluster.from_dict(raw) if raw else None
 
-    def _create_cluster(self, svc: TpuService, cname: str):
+    def _create_cluster(self, svc: TpuService, cname: str,
+                        wave_slices: int = 0):
         spec = svc.spec.clusterSpec.to_dict()
+        if wave_slices > 0:
+            # First ICI-atomic wave: the green cluster starts with at
+            # most ``waveSlices`` slices per group; _stage_waves raises
+            # replicas toward the spec as whole rings come Ready.
+            for g in spec.get("workerGroupSpecs", []):
+                cap = min(int(g.get("replicas", 0) or 0), wave_slices)
+                g["replicas"] = cap
+                if int(g.get("minReplicas", 0) or 0) > cap:
+                    g["minReplicas"] = cap
         obj = {
             "apiVersion": C.API_VERSION,
             "kind": C.KIND_CLUSTER,
@@ -276,6 +320,12 @@ class TpuServiceController:
                 return None
             if svc.spec.upgradeStrategy == ServiceUpgradeType.NONE:
                 return None
+            # Abort latch: a spec hash whose gated ramp exhausted its
+            # rollback budget is not retried — the operator must change
+            # the spec (or revert) to clear it.
+            if st.upgrade is not None and \
+                    st.upgrade.abortedSpecHash == desired_hash:
+                return None
             # Spec changed: prepare a pending cluster with the new spec
             # (ref shouldPrepareNewCluster :1400).
             if pending is None or st.pendingServiceStatus.specHash != desired_hash:
@@ -285,9 +335,18 @@ class TpuServiceController:
                 if cname == st.activeServiceStatus.clusterName:
                     cname = truncate_name(
                         f"{svc.metadata.name}-cluster-{svc.metadata.generation}-r")
-                self._create_cluster(svc, cname)
+                wave = 0
+                if svc.spec.upgradeStrategy == ServiceUpgradeType.INCREMENTAL \
+                        and features.enabled("TpuServiceIncrementalUpgrade") \
+                        and svc.spec.upgradeOptions is not None:
+                    wave = svc.spec.upgradeOptions.waveSlices
+                self._create_cluster(svc, cname, wave_slices=wave)
                 st.pendingServiceStatus = ServiceClusterStatus(
                     clusterName=cname, specHash=desired_hash)
+                # Fresh ramp, fresh budgets: the new pending starts with
+                # a clean rollback count and hold clock.
+                st.upgrade = None
+                self._drain_started.pop(svc.metadata.name, None)
                 set_condition(svc.status.conditions, Condition(
                     type=ServiceConditionType.UPGRADE_IN_PROGRESS,
                     status="True", reason="SpecChanged"))
@@ -384,7 +443,7 @@ class TpuServiceController:
                 if old and old.status == status and old.message == message:
                     ts = old.lastUpdateTime
                 else:
-                    ts = time.time()
+                    ts = self._now()
                 cs.applications.append(ServeApplicationStatus(
                     name=app_name, status=status, message=message,
                     lastUpdateTime=ts))
@@ -410,23 +469,283 @@ class TpuServiceController:
             and features.enabled("TpuServiceIncrementalUpgrade")
             and st.activeServiceStatus is not None)
         if incremental:
-            opts = svc.spec.upgradeOptions
-            step = opts.stepSizePercent if opts else 10
-            interval = opts.intervalSeconds if opts else 30
-            if time.time() - st.lastUpgradeStepTime < interval:
-                return max(0.5, interval - (time.time() - st.lastUpgradeStepTime))
-            cs = st.pendingServiceStatus
-            cs.trafficWeightPercent = min(100, cs.trafficWeightPercent + step)
-            if st.activeServiceStatus is not None:
-                st.activeServiceStatus.trafficWeightPercent = \
-                    100 - cs.trafficWeightPercent
-            st.lastUpgradeStepTime = time.time()
-            self._reconcile_weighted_services(svc)
-            if cs.trafficWeightPercent < 100:
-                return interval
+            return self._reconcile_gated_upgrade(svc)
         # Full promotion.
         self._promote(svc)
         return None
+
+    # ------------------------------------------------------------------
+    # burn-rate-gated incremental ramp (controlplane.upgrade)
+    # ------------------------------------------------------------------
+
+    def _upgrade_status(self, svc: TpuService) -> UpgradeStatus:
+        if svc.status.upgrade is None:
+            svc.status.upgrade = UpgradeStatus(state=UpgradeState.RAMPING)
+        return svc.status.upgrade
+
+    def _whole_rings(self, svc: TpuService, cname: str) -> Dict[str, int]:
+        """Group name -> count of slices whose whole multi-host ICI ring
+        is Running in ``cname``.  A slice with any member missing or not
+        yet Running is not a ring — it carries no weight."""
+        want_hosts = {g.groupName: g.num_hosts
+                      for g in svc.spec.clusterSpec.workerGroupSpecs}
+        slices: Dict[tuple, list] = {}
+        for p in self.store.list(
+                "Pod", svc.metadata.namespace,
+                labels={C.LABEL_CLUSTER: cname,
+                        C.LABEL_NODE_TYPE: C.NODE_TYPE_WORKER}):
+            lbl = p["metadata"].get("labels", {})
+            key = (lbl.get(C.LABEL_GROUP), lbl.get(C.LABEL_SLICE_NAME))
+            slices.setdefault(key, []).append(p)
+        ready = {g: 0 for g in want_hosts}
+        for (group, _sname), ps in slices.items():
+            want = want_hosts.get(group, 0)
+            if want > 0 and len(ps) >= want and all(
+                    p.get("status", {}).get("phase") == "Running"
+                    for p in ps):
+                ready[group] += 1
+        return ready
+
+    def _ring_progress(self, svc: TpuService, cname: str):
+        """(ready, desired) whole-ring slice counts for the green
+        cluster, measured against the FULL desired spec — weight never
+        outruns ready/desired even while waves are still staging."""
+        desired = sum(int(g.replicas)
+                      for g in svc.spec.clusterSpec.workerGroupSpecs)
+        ready = sum(self._whole_rings(svc, cname).values())
+        return ready, desired
+
+    def _stage_waves(self, svc: TpuService, wave: int):
+        """ICI-atomic waves: the pending cluster's replicas climb
+        ``wave`` slices past the currently-whole rings, so green
+        capacity provisions slice-by-slice instead of all at once."""
+        cname = svc.status.pendingServiceStatus.clusterName
+        obj = self.store.try_get(C.KIND_CLUSTER, cname,
+                                 svc.metadata.namespace)
+        if obj is None:
+            return
+        ready = self._whole_rings(svc, cname)
+        desired = {g.groupName: int(g.replicas)
+                   for g in svc.spec.clusterSpec.workerGroupSpecs}
+        changed = False
+        for g in obj["spec"].get("workerGroupSpecs", []):
+            gname = g.get("groupName")
+            target = min(desired.get(gname, 0),
+                         ready.get(gname, 0) + wave)
+            if target > int(g.get("replicas", 0) or 0):
+                g["replicas"] = target
+                changed = True
+        if changed:
+            self.store.update(obj)
+
+    def _route_acks(self, svc: TpuService) -> Dict:
+        """Gateway handshake state carried on the TrafficRoute's status
+        (store.ensure converges spec only, so acks survive our writes)."""
+        raw = self.store.try_get(
+            "TrafficRoute", truncate_name(f"{svc.metadata.name}-route"),
+            svc.metadata.namespace)
+        return (raw or {}).get("status") or {}
+
+    def _reconcile_gated_upgrade(self, svc: TpuService) -> Optional[float]:
+        st = svc.status
+        cs = st.pendingServiceStatus
+        opts = svc.spec.upgradeOptions
+        step = opts.stepSizePercent if opts else 10
+        interval = opts.intervalSeconds if opts else 30
+        max_rollbacks = opts.maxRollbacks if opts else 2
+        hold_s = opts.holdSeconds if opts else 60
+        wave = opts.waveSlices if opts else 0
+        prewarm_n = opts.prewarmPrompts if opts else 0
+        drain_timeout = opts.drainTimeoutSeconds if opts else 0
+
+        up = self._upgrade_status(svc)
+        if wave > 0:
+            self._stage_waves(svc, wave)
+        ready, desired = self._ring_progress(svc, cs.clusterName)
+        up.readySlices, up.desiredSlices = ready, desired
+
+        green_svc = serve_service_name(cs.clusterName)
+        blue_svc = (serve_service_name(st.activeServiceStatus.clusterName)
+                    if st.activeServiceStatus else "")
+        if self.upgrade_gate is not None:
+            healthy, alert = self.upgrade_gate.verdict(green_svc)
+        else:
+            healthy, alert = True, None
+
+        acks = self._route_acks(svc)
+        drain_requested = (drain_timeout > 0
+                           and st.activeServiceStatus is not None)
+        now = self._now()
+        if drain_requested and cs.trafficWeightPercent >= 100:
+            self._drain_started.setdefault(svc.metadata.name, now)
+        obs = UpgradeObservation(
+            now=now,
+            green_weight=cs.trafficWeightPercent,
+            step_size=step,
+            interval_s=float(interval),
+            last_step_time=st.lastUpgradeStepTime,
+            ready_slices=ready,
+            desired_slices=desired,
+            gate_healthy=healthy,
+            firing_alert=alert,
+            rollbacks=up.rollbacks,
+            max_rollbacks=max_rollbacks,
+            hold_seconds=float(hold_s),
+            last_rollback_time=up.lastRollbackTime,
+            prewarm_requested=prewarm_n > 0,
+            prewarm_done=green_svc in (acks.get("prewarmed") or {}),
+            drain_requested=drain_requested,
+            drain_done=blue_svc in (acks.get("drained") or {}),
+            drain_started_at=self._drain_started.get(svc.metadata.name, 0.0),
+            drain_timeout_s=float(drain_timeout))
+        decision = self._orchestrator.decide(obs)
+        return self._apply_upgrade_decision(svc, decision, obs, green_svc)
+
+    def _apply_upgrade_decision(self, svc: TpuService, decision, obs,
+                                green_svc: str) -> Optional[float]:
+        """THE weight-write seam: every trafficWeightPercent mutation of
+        the incremental ramp happens here (or in _promote), downstream
+        of one orchestrator decision — analysis rule
+        traffic-weight-through-gate holds the controller to it."""
+        st = svc.status
+        up = st.upgrade
+        cs = st.pendingServiceStatus
+        name = svc.metadata.name
+        ns = svc.metadata.namespace
+
+        if decision.action == ABORT:
+            up.state = UpgradeState.ABORTED
+            up.lastAlert = dict(decision.alert or {})
+            up.abortedSpecHash = cs.specHash
+            if st.activeServiceStatus is not None:
+                st.activeServiceStatus.trafficWeightPercent = 100
+            self._drain_started.pop(name, None)
+            self._count_step(name, "abort")
+            self._record_weights(svc)
+            self.recorder.warning(
+                svc.to_dict(), "UpgradeAborted",
+                f"abandoning {cs.clusterName}: {decision.reason}")
+            if self.flight is not None:
+                self.flight.record(
+                    self.KIND, ns, name, "upgrade", detail=decision.reason,
+                    action=decision.action,
+                    alert=(decision.alert or {}).get("name", ""))
+            if self.upgrade_gate is not None:
+                self.upgrade_gate.forget(green_svc)
+            self._abandon_pending(svc)
+            try:
+                self.store.delete("TrafficRoute",
+                                  truncate_name(f"{name}-route"), ns)
+            except NotFound:
+                pass
+            return None
+
+        if decision.action == ROLLBACK:
+            cs.trafficWeightPercent = 0
+            if st.activeServiceStatus is not None:
+                st.activeServiceStatus.trafficWeightPercent = 100
+            up.state = UpgradeState.ROLLED_BACK
+            up.rollbacks += 1
+            up.lastRollbackTime = self._now()
+            up.lastAlert = dict(decision.alert or {})
+            st.lastUpgradeStepTime = self._now()
+            self._drain_started.pop(name, None)
+            self._reconcile_weighted_services(svc)
+            if self._metrics is not None:
+                self._metrics.inc("tpu_upgrade_rollbacks_total",
+                                  {"service": name})
+            self._count_step(name, "down")
+            self._record_weights(svc)
+            self.recorder.warning(
+                svc.to_dict(), "UpgradeRolledBack",
+                f"green weight snapped to 0: {decision.reason}")
+            if self.flight is not None:
+                self.flight.record(
+                    self.KIND, ns, name, "upgrade", detail=decision.reason,
+                    action=decision.action,
+                    alert=(decision.alert or {}).get("name", ""))
+            return decision.requeue_after
+
+        if decision.action == PROMOTE:
+            self._finish_gated(svc, green_svc)
+            return None
+
+        if decision.action == STEP:
+            prev = cs.trafficWeightPercent
+            cs.trafficWeightPercent = decision.green_weight
+            if st.activeServiceStatus is not None:
+                st.activeServiceStatus.trafficWeightPercent = \
+                    100 - decision.green_weight
+            st.lastUpgradeStepTime = self._now()
+            up.state = UpgradeState.RAMPING
+            self._count_step(
+                name, "up" if decision.green_weight >= prev else "down")
+            self._record_weights(svc)
+            if decision.green_weight >= 100:
+                if obs.drain_requested and not obs.drain_done:
+                    # Hold promotion until blue acks an empty in-flight
+                    # set (or the drain timeout expires).
+                    self._drain_started.setdefault(name, self._now())
+                    up.state = UpgradeState.DRAINING
+                    self._reconcile_weighted_services(svc)
+                    return 0.5
+                # Open-loop parity: a step that lands on 100 with no
+                # drain requested promotes in the same reconcile — the
+                # route still sees the terminal weights first.
+                self._reconcile_weighted_services(svc)
+                self._finish_gated(svc, green_svc)
+                return None
+            self._reconcile_weighted_services(svc)
+            return float(obs.interval_s)
+
+        # PREWARM / WAIT_DRAIN / HOLD / WAIT_RING: no weight change,
+        # surface the phase and keep the route (with its prewarm/drain
+        # flags) converged so the gateway sees the request.
+        if decision.action == PREWARM:
+            up.state = UpgradeState.PREWARMING
+        elif decision.action == WAIT_DRAIN:
+            self._drain_started.setdefault(name, self._now())
+            up.state = UpgradeState.DRAINING
+        elif cs.trafficWeightPercent == 0 and up.rollbacks > 0:
+            up.state = (UpgradeState.ROLLED_BACK
+                        if not obs.gate_healthy else UpgradeState.HOLDING)
+        else:
+            up.state = UpgradeState.RAMPING
+        self._reconcile_weighted_services(svc)
+        return decision.requeue_after
+
+    def _finish_gated(self, svc: TpuService, green_svc: str):
+        name = svc.metadata.name
+        self._promote(svc)
+        self.transitions.record(self.KIND, svc.metadata.namespace, name,
+                                UpgradeState.PROMOTED,
+                                old_state=svc.status.upgrade.state)
+        svc.status.upgrade.state = UpgradeState.PROMOTED
+        self._drain_started.pop(name, None)
+        if self.upgrade_gate is not None:
+            self.upgrade_gate.forget(green_svc)
+        self._count_step(name, "promote")
+        self._record_weights(svc)
+
+    def _count_step(self, service: str, direction: str):
+        if self._metrics is not None:
+            self._metrics.inc("tpu_upgrade_steps_total",
+                              {"service": service, "direction": direction})
+
+    def _record_weights(self, svc: TpuService):
+        if self._metrics is None:
+            return
+        st = svc.status
+        green = (st.pendingServiceStatus.trafficWeightPercent
+                 if st.pendingServiceStatus else 0)
+        blue = (st.activeServiceStatus.trafficWeightPercent
+                if st.activeServiceStatus else 0)
+        self._metrics.set_gauge("tpu_upgrade_weight_percent", float(green),
+                                {"service": svc.metadata.name,
+                                 "role": "green"})
+        self._metrics.set_gauge("tpu_upgrade_weight_percent", float(blue),
+                                {"service": svc.metadata.name,
+                                 "role": "blue"})
 
     def _promote(self, svc: TpuService):
         st = svc.status
@@ -458,7 +777,7 @@ class TpuServiceController:
         obj = self.store.try_get(C.KIND_CLUSTER, cname, svc.metadata.namespace)
         if obj is None:
             return
-        retire_at = time.time() + svc.spec.clusterDeletionDelaySeconds
+        retire_at = self._now() + svc.spec.clusterDeletionDelaySeconds
         obj["metadata"].setdefault("annotations", {})[
             "tpu.dev/retire-at"] = str(retire_at)
         self.store.update(obj)
@@ -468,7 +787,7 @@ class TpuServiceController:
         n = 0
         for obj in self.store.list(C.KIND_CLUSTER, namespace):
             at = obj["metadata"].get("annotations", {}).get("tpu.dev/retire-at")
-            if at and time.time() >= float(at):
+            if at and self._now() >= float(at):
                 try:
                     self.store.delete(C.KIND_CLUSTER, obj["metadata"]["name"],
                                       obj["metadata"]["namespace"])
@@ -538,11 +857,23 @@ class TpuServiceController:
             tier = svc.spec.serveTier
             if tier not in C.SERVE_TIERS:
                 tier = C.SERVE_TIER_MIXED
-            route["spec"]["backends"].append({
+            backend = {
                 "service": per_cluster["metadata"]["name"],
                 "weight": cs.trafficWeightPercent,
                 "tier": tier,
-            })
+            }
+            # Gated-ramp handshakes the gateway acts on and acks via the
+            # route's STATUS (which store.ensure preserves): replay the
+            # hottest prefixes into the cold green backend; drain the
+            # blue backend's in-flight set before promotion retires it.
+            opts = svc.spec.upgradeOptions
+            if cs is st.pendingServiceStatus and opts is not None \
+                    and opts.prewarmPrompts > 0:
+                backend["prewarm"] = opts.prewarmPrompts
+            if cs is st.activeServiceStatus and st.upgrade is not None \
+                    and st.upgrade.state == UpgradeState.DRAINING:
+                backend["drain"] = True
+            route["spec"]["backends"].append(backend)
         self.store.ensure(route)
 
     # ------------------------------------------------------------------

@@ -1,0 +1,268 @@
+"""Closed-loop blue/green upgrade decisions: the burn-rate-gated ramp.
+
+The service controller's INCREMENTAL strategy used to be an open-loop
+timer: shift ``stepSizePercent`` of traffic every ``intervalSeconds``
+and hope the green build holds.  This module closes the loop.  The
+:class:`UpgradeOrchestrator` is a pure decision core — it looks at one
+:class:`UpgradeObservation` (green weight, ICI-ring readiness, gate
+verdict, budgets) and returns one :class:`UpgradeDecision`; it never
+touches the store, the clock, or the registry, so the service
+controller, the sim harness, and the serve benchmark all drive the SAME
+ramp logic and a decision table is unit-testable without a control
+plane.
+
+Three properties the decisions enforce (docs/upgrades.md):
+
+- **Gated steps**: weight only advances while the green fleet's
+  fast-window burn rate is clean (:class:`BurnRateGate` wraps a
+  green-scoped :class:`~kuberay_tpu.obs.alerts.AlertEngine`); a firing
+  fast-burn alert snaps green weight to 0 (ROLLBACK) and, past
+  ``maxRollbacks``, abandons the pending cluster whole (ABORT).
+- **ICI-ring atomicity**: weight never outruns the fully-Ready ring
+  fraction of the green cluster — a slice becomes weight-eligible only
+  when its whole multi-host ring is up, so no TrafficRoute ever points
+  traffic at a partially-provisioned slice (the sim's
+  ``weighted-ring-atomicity`` checker holds the line).
+- **Warm starts, drained exits**: the first step waits for the
+  gateway's prefix-cache pre-warm ack (PREWARM), and promotion waits
+  for the blue backend's in-flight drain ack (WAIT_DRAIN) bounded by
+  ``drainTimeoutSeconds``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+from kuberay_tpu.obs.alerts import AlertEngine, SloSpec
+
+# Decision actions, in rough lifecycle order.
+PREWARM = "prewarm"          # hold at 0 until the gateway acks the replay
+STEP = "step"                # advance (or ring-degrade) green weight
+HOLD = "hold"                # interval / post-rollback backoff not elapsed
+WAIT_RING = "wait-ring"      # weight at the ready-ring cap; more rings due
+ROLLBACK = "rollback"        # fast burn fired: snap green weight to 0
+ABORT = "abort"              # rollback budget exhausted: abandon pending
+WAIT_DRAIN = "wait-drain"    # green at 100; blue finishing in-flight work
+PROMOTE = "promote"          # ramp complete and drained: flip the fleets
+
+
+@dataclasses.dataclass(frozen=True)
+class UpgradeObservation:
+    """Everything one ramp decision needs, sampled by the caller."""
+
+    now: float
+    green_weight: int
+    step_size: int = 10
+    interval_s: float = 30.0
+    last_step_time: float = 0.0
+    # ICI-ring wave progress of the green cluster: slices whose whole
+    # multi-host ring is Running vs. slices the spec wants.
+    ready_slices: int = 0
+    desired_slices: int = 0
+    # Burn-rate gate verdict over the green backend (vacuously healthy
+    # when no gate is wired — the open-loop tests keep their semantics).
+    gate_healthy: bool = True
+    firing_alert: Optional[Dict[str, Any]] = None
+    # Rollback/retry budgets (spec.upgradeOptions).
+    rollbacks: int = 0
+    max_rollbacks: int = 2
+    hold_seconds: float = 60.0
+    last_rollback_time: float = 0.0
+    # Prefix-cache pre-warm handshake (gateway ack via TrafficRoute).
+    prewarm_requested: bool = False
+    prewarm_done: bool = False
+    # Blue-session drain handshake.
+    drain_requested: bool = False
+    drain_done: bool = False
+    drain_started_at: float = 0.0
+    drain_timeout_s: float = 0.0
+
+
+@dataclasses.dataclass(frozen=True)
+class UpgradeDecision:
+    action: str
+    green_weight: int
+    reason: str = ""
+    alert: Optional[Dict[str, Any]] = None
+    requeue_after: float = 2.0
+
+
+class UpgradeOrchestrator:
+    """Pure ramp-decision core; one :meth:`decide` call per reconcile."""
+
+    def ring_cap(self, ready_slices: int, desired_slices: int) -> int:
+        """Max green weight the fully-Ready ring fraction supports.  A
+        green cluster with 1 of 2 rings whole may carry at most 50% —
+        pointing more weight at it would route traffic into a
+        partially-provisioned slice."""
+        if desired_slices <= 0:
+            return 100
+        return (100 * min(ready_slices, desired_slices)) // desired_slices
+
+    def decide(self, obs: UpgradeObservation) -> UpgradeDecision:
+        cap = self.ring_cap(obs.ready_slices, obs.desired_slices)
+
+        # Gate breach outranks everything: snap to 0, or abandon whole
+        # once the retry budget is spent.
+        if not obs.gate_healthy:
+            if obs.green_weight > 0:
+                if obs.rollbacks >= obs.max_rollbacks:
+                    return UpgradeDecision(
+                        ABORT, 0, alert=obs.firing_alert,
+                        reason=f"fast burn after {obs.rollbacks} rollbacks "
+                               f"(maxRollbacks={obs.max_rollbacks})")
+                return UpgradeDecision(
+                    ROLLBACK, 0, alert=obs.firing_alert,
+                    reason="fast-window burn rate over threshold on the "
+                           "green fleet")
+            return UpgradeDecision(
+                HOLD, 0, alert=obs.firing_alert,
+                reason="green burn still firing at weight 0",
+                requeue_after=max(2.0, obs.interval_s))
+
+        # Post-rollback backoff: stay at 0 until holdSeconds of clean
+        # burn have passed since the last rollback.
+        if obs.green_weight == 0 and obs.rollbacks > 0:
+            held = obs.now - obs.last_rollback_time
+            if held < obs.hold_seconds:
+                return UpgradeDecision(
+                    HOLD, 0,
+                    reason=f"holding {obs.hold_seconds - held:.0f}s more "
+                           "after rollback",
+                    requeue_after=max(0.5, obs.hold_seconds - held))
+
+        # Cold green fleet: wait for the gateway's prefix replay ack
+        # before the first real request lands.
+        if obs.green_weight == 0 and obs.prewarm_requested \
+                and not obs.prewarm_done:
+            return UpgradeDecision(
+                PREWARM, 0, reason="waiting for prefix-cache pre-warm ack")
+
+        # Ramp complete: drain blue sessions, bounded, then promote.
+        if obs.green_weight >= 100:
+            if obs.drain_requested and not obs.drain_done:
+                waited = obs.now - obs.drain_started_at
+                if waited < obs.drain_timeout_s:
+                    return UpgradeDecision(
+                        WAIT_DRAIN, 100,
+                        reason="blue backend finishing in-flight requests",
+                        requeue_after=min(2.0, max(
+                            0.5, obs.drain_timeout_s - waited)))
+                return UpgradeDecision(
+                    PROMOTE, 100,
+                    reason=f"drain timeout ({obs.drain_timeout_s:.0f}s) "
+                           "expired")
+            return UpgradeDecision(PROMOTE, 100, reason="ramp complete")
+
+        # A ring the weight depends on fell apart (pod kill mid-wave):
+        # retreat to what whole rings can carry, immediately.
+        if cap < obs.green_weight:
+            return UpgradeDecision(
+                STEP, cap,
+                reason=f"ring degraded: {obs.ready_slices}/"
+                       f"{obs.desired_slices} whole rings support "
+                       f"{cap}%")
+
+        # Timer leg of the ramp (unchanged from the open-loop stepper).
+        since_step = obs.now - obs.last_step_time
+        if since_step < obs.interval_s:
+            return UpgradeDecision(
+                HOLD, obs.green_weight, reason="step interval not elapsed",
+                requeue_after=max(0.5, obs.interval_s - since_step))
+
+        target = min(100, obs.green_weight + obs.step_size, cap)
+        if target <= obs.green_weight:
+            return UpgradeDecision(
+                WAIT_RING, obs.green_weight,
+                reason=f"at ring cap {cap}% ({obs.ready_slices}/"
+                       f"{obs.desired_slices} whole rings); next wave "
+                       "still provisioning")
+        return UpgradeDecision(STEP, target,
+                               reason=f"gate clean: {obs.green_weight}% "
+                                      f"-> {target}%")
+
+
+def green_slos(backend: str, ttft_target_s: float = 0.5,
+               availability: float = 0.99,
+               fast_window_s: float = 300.0,
+               fast_burn: float = 14.0,
+               min_samples: int = 5) -> List[SloSpec]:
+    """Burn-rate specs scoped to ONE backend service — the green fleet
+    under upgrade.  Availability counts ATTEMPTS, not client responses:
+    a green connect failure that fails over to blue returns 200 to the
+    client yet still lands an attempt + error on green's own series
+    (gateway._note_attempt), so the gate sees the bad build even while
+    retries keep users whole.  Latency reads the per-backend gateway
+    histogram (``tpu_gateway_backend_latency_seconds{backend=...}``)."""
+    scope = (("backend", backend),)
+    return [
+        SloSpec(name="upgrade-green-ttft", kind="latency",
+                metric="tpu_gateway_backend_latency_seconds",
+                labels=scope, threshold_s=ttft_target_s,
+                fast_window_s=fast_window_s, fast_burn=fast_burn,
+                min_samples=min_samples),
+        SloSpec(name="upgrade-green-availability", kind="availability",
+                total_family="tpu_gateway_backend_attempts_total",
+                bad_families=("tpu_gateway_backend_errors_total",),
+                series_labels=scope, objective=availability,
+                fast_window_s=fast_window_s, fast_burn=fast_burn,
+                min_samples=min_samples),
+    ]
+
+
+class BurnRateGate:
+    """Green-fleet health verdicts for the ramp, one private
+    :class:`AlertEngine` per backend under upgrade.
+
+    Observational like the engine it wraps: reads registry snapshots and
+    the clock only, so mounting it in the sim leaves replay hashes
+    untouched.  ``verdict`` evaluates and answers whether any
+    fast-window alert is firing on the backend's scoped specs — the
+    slow window intentionally does not gate (a ramp holds minutes, not
+    the hours a slow leak needs; the fleet-wide engine still watches
+    it)."""
+
+    def __init__(self, registry, clock=None, ttft_target_s: float = 0.5,
+                 availability: float = 0.99, fast_window_s: float = 300.0,
+                 fast_burn: float = 14.0, min_samples: int = 5):
+        self.registry = registry
+        self._clock = clock
+        self._ttft_target_s = ttft_target_s
+        self._availability = availability
+        self._fast_window_s = fast_window_s
+        self._fast_burn = fast_burn
+        self._min_samples = min_samples
+        self._engines: Dict[str, AlertEngine] = {}
+
+    def _engine(self, backend: str) -> AlertEngine:
+        engine = self._engines.get(backend)
+        if engine is None:
+            engine = AlertEngine(
+                self.registry,
+                specs=green_slos(backend,
+                                 ttft_target_s=self._ttft_target_s,
+                                 availability=self._availability,
+                                 fast_window_s=self._fast_window_s,
+                                 fast_burn=self._fast_burn,
+                                 min_samples=self._min_samples),
+                clock=self._clock)
+            self._engines[backend] = engine
+        return engine
+
+    def verdict(self, backend: str
+                ) -> Tuple[bool, Optional[Dict[str, Any]]]:
+        """(healthy, firing_alert): healthy iff no fast-window alert is
+        active on the backend's green-scoped specs after one evaluation
+        pass."""
+        engine = self._engine(backend)
+        engine.evaluate()
+        fast = [a for a in engine.active() if a["window"] == "fast"]
+        if fast:
+            return False, fast[0]
+        return True, None
+
+    def forget(self, backend: str) -> None:
+        """Drop a backend's engine (after promote/abort) so a later
+        upgrade of the same service starts with fresh windows."""
+        self._engines.pop(backend, None)

@@ -62,6 +62,11 @@ class SloSpec:
     # availability
     total_family: str = ""
     bad_families: Tuple[str, ...] = ()
+    # Optional series scoping: only count total/bad series whose labels
+    # are a superset of these pairs.  Lets one spec watch a single
+    # backend of a labeled family (the upgrade gate scopes availability
+    # to the green fleet this way) without a dedicated metric.
+    series_labels: Tuple[Tuple[str, str], ...] = ()
     # gauge-floor
     gauge_family: str = ""
     floor: float = 0.5
@@ -148,15 +153,24 @@ class AlertEngine:
 
     def _availability_counts(self, spec: SloSpec
                              ) -> List[Tuple[Tuple, float, float]]:
-        series = self.registry.family_snapshot(spec.total_family)
+        scope = dict(spec.series_labels)
+
+        def in_scope(labels: Dict[str, str]) -> bool:
+            return all(labels.get(k) == v for k, v in scope.items())
+
+        series = [(labels, v) for labels, v
+                  in self.registry.family_snapshot(spec.total_family)
+                  if in_scope(labels)]
         if not series:
             return []
         total = sum(v for _, v in series)
         bad = sum(v for labels, v in series
                   if str(labels.get("code", "")).startswith("5"))
         for fam in spec.bad_families:
-            bad += sum(v for _, v in self.registry.family_snapshot(fam))
-        return [((), total, bad)]
+            bad += sum(v for labels, v
+                       in self.registry.family_snapshot(fam)
+                       if in_scope(labels))
+        return [(spec.series_labels, total, bad)]
 
     def _gauge_counts(self, spec: SloSpec
                       ) -> List[Tuple[Tuple, float, float]]:

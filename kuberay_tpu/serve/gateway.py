@@ -52,6 +52,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from kuberay_tpu.obs.trace import NOOP_TRACER
 from kuberay_tpu.serve.prefix import (
+    HotPrompts,
     PrefixIndex,
     affinity_score,
     block_hashes,
@@ -60,6 +61,7 @@ from kuberay_tpu.serve.prefix import (
 )
 from kuberay_tpu.utils import constants as C
 from kuberay_tpu.utils.httpjson import JsonHandler, serve_background
+from kuberay_tpu.utils.metrics import SERVE_LATENCY_BUCKETS
 
 
 _LOG = logging.getLogger("kuberay_tpu.gateway")
@@ -181,6 +183,28 @@ class WeightedGateway:
             metrics.describe("tpu_serve_kv_transfer_seconds",
                              "Wall seconds per prefill->decode KV "
                              "transfer (resident probe + export + import)")
+            metrics.describe("tpu_gateway_backend_attempts_total",
+                             "Forward attempts per backend service, "
+                             "including connect failures that failed "
+                             "over — the denominator of the upgrade "
+                             "gate's green availability SLO")
+            metrics.describe("tpu_gateway_backend_errors_total",
+                             "Failed forward attempts per backend "
+                             "service (connect/transport failure or a "
+                             "5xx response) — the numerator of the "
+                             "upgrade gate's green availability SLO")
+            metrics.describe("tpu_gateway_backend_latency_seconds",
+                             "Per-backend forward latency histogram — "
+                             "the upgrade gate's green TTFT SLO reads "
+                             "this scoped to the green backend")
+            metrics.describe("tpu_upgrade_prewarm_prompts_total",
+                             "Hot prompt prefixes replayed into a cold "
+                             "green backend before its first weight "
+                             "step, by backend service")
+            metrics.describe("tpu_upgrade_drain_seconds",
+                             "Wall seconds from a backend's drain flag "
+                             "appearing on the route to its in-flight "
+                             "set reaching zero")
         self.store = store
         self.route_name = route_name
         self.namespace = namespace
@@ -196,6 +220,12 @@ class WeightedGateway:
         self._active: List[str] = []                  # routed service names
         self._stats: Dict[str, int] = {}              # url -> picks
         self._waiting = 0
+        # Upgrade handshakes (docs/upgrades.md): the fleet's hottest
+        # prompt prefixes (replayed into cold green backends), replay
+        # results per backend, and when each drain flag was first seen.
+        self._hot = HotPrompts()
+        self._replayed: Dict[str, int] = {}
+        self._drain_seen: Dict[str, float] = {}
         self._stop = threading.Event()
         self._refresh()
         self._watch_thread = threading.Thread(
@@ -227,12 +257,19 @@ class WeightedGateway:
     def _refresh(self):
         route = self.store.try_get("TrafficRoute", self.route_name,
                                    self.namespace)
+        if route is None:
+            # Promotion deletes the route (steady state needs no weighted
+            # routing).  Collapse onto the surviving backend — the
+            # highest-weight one we last saw — at weight 100 rather than
+            # zeroing everything out: there must be no window where the
+            # gateway has stale weights or no backends at all.
+            self._fallback_to_survivor()
+            return
         entries: List[Tuple[str, int, str]] = []
-        if route is not None:
-            for b in route.get("spec", {}).get("backends", []):
-                if b.get("weight", 0) > 0:
-                    entries.append((b["service"], int(b["weight"]),
-                                    b.get("tier") or "mixed"))
+        for b in route.get("spec", {}).get("backends", []):
+            if b.get("weight", 0) > 0:
+                entries.append((b["service"], int(b["weight"]),
+                                b.get("tier") or "mixed"))
         weight_changes: List[Tuple[str, int, int]] = []
         with self._lock:
             # Keep prior state (prefix index, load) across weight steps:
@@ -258,6 +295,118 @@ class WeightedGateway:
             for svc, old, new in weight_changes:
                 self.flight.record("Backend", self.namespace, svc,
                                    "weight", f"{old} -> {new}")
+        self._maybe_prewarm(route)
+        self._maybe_drain(route)
+
+    def _fallback_to_survivor(self):
+        changes: List[Tuple[str, int, int]] = []
+        with self._lock:
+            live = [s for s in self._states.values() if s.weight > 0]
+            if not live:
+                return      # cold start, or already collapsed
+            keep = max(live, key=lambda s: (s.weight, s.service))
+            for svc, s in self._states.items():
+                new = 100 if s is keep else 0
+                if s.weight != new:
+                    changes.append((svc, s.weight, new))
+                s.weight = new
+            self._active = [keep.service]
+            self._drain_seen.clear()
+        if self.flight is not None:
+            for svc, old, new in changes:
+                self.flight.record("Backend", self.namespace, svc,
+                                   "weight",
+                                   f"{old} -> {new} (route deleted)")
+
+    # -- upgrade handshakes (prefix pre-warm + session drain) --------------
+
+    def _maybe_prewarm(self, route: dict) -> None:
+        """Backends flagged ``prewarm: N`` on the route get the fleet's
+        hottest prompt prefixes replayed into them (max_tokens=1 — one
+        prefill each), then an ack in the route's status the service
+        controller gates the first weight step on."""
+        acked = (route.get("status") or {}).get("prewarmed") or {}
+        for b in route.get("spec", {}).get("backends", []):
+            svc = b.get("service")
+            n = int(b.get("prewarm", 0) or 0)
+            if not svc or n <= 0 or svc in acked:
+                continue
+            if svc not in self._replayed:
+                self._replayed[svc] = self._replay_prefixes(svc, n)
+            self._ack_route("prewarmed", svc, self._replayed[svc])
+
+    def _replay_prefixes(self, svc: str, n: int) -> int:
+        with self._lock:
+            st = self._states.get(svc)
+            url = st.url if st is not None else self.resolver(svc)
+            prompts = self._hot.hottest(n)
+        ok = 0
+        for p in prompts:
+            body = json.dumps({"prompt_tokens": p, "max_tokens": 1}).encode()
+            try:
+                code, _, _ = self._request(url, "/v1/completions", body, 10.0)
+            except (urllib.error.URLError, ConnectionError, OSError):
+                continue
+            if code == 200:
+                ok += 1
+                hashes = block_hashes(p, self.config.block_size)
+                if hashes and st is not None:
+                    with self._lock:
+                        st.index.insert(hashes)
+                if self.metrics is not None:
+                    self.metrics.inc("tpu_upgrade_prewarm_prompts_total",
+                                     {"backend": svc})
+        if self.flight is not None:
+            self.flight.record("Backend", self.namespace, svc, "prewarm",
+                               f"replayed {ok}/{len(prompts)} hot prefixes")
+        return ok
+
+    def _maybe_drain(self, route: dict) -> None:
+        """Backends flagged ``drain: true`` (blue, at weight 0) are acked
+        in the route's status once their in-flight set reaches zero —
+        the service controller holds promotion (and the blue cluster's
+        retirement) on it, so retiring replicas never cut off admitted
+        requests."""
+        acked = (route.get("status") or {}).get("drained") or {}
+        flagged = {b.get("service") for b in
+                   route.get("spec", {}).get("backends", [])
+                   if b.get("drain")}
+        for svc in list(self._drain_seen):
+            if svc not in flagged:
+                self._drain_seen.pop(svc, None)
+        for svc in flagged:
+            if not svc or svc in acked:
+                continue
+            t0 = self._drain_seen.setdefault(svc, self._now())
+            with self._lock:
+                st = self._states.get(svc)
+                busy = st is not None and st.inflight > 0
+            if busy:
+                continue
+            if self.metrics is not None:
+                self.metrics.observe("tpu_upgrade_drain_seconds",
+                                     self._now() - t0)
+            if self.flight is not None:
+                self.flight.record("Backend", self.namespace, svc,
+                                   "drained",
+                                   f"after {self._now() - t0:.3f}s")
+            self._ack_route("drained", svc, True)
+
+    def _ack_route(self, field: str, svc: str, value) -> None:
+        obj = self.store.try_get("TrafficRoute", self.route_name,
+                                 self.namespace)
+        if obj is None:
+            return
+        slot = obj.setdefault("status", {}).setdefault(field, {})
+        if slot.get(svc) == value:
+            return
+        slot[svc] = value
+        try:
+            self.store.update_status(obj)
+        except Exception:
+            # Conflict/NotFound: the next poll re-acks idempotently.
+            _LOG.debug("route %s ack failed; will retry", field,
+                       exc_info=True)
 
     def _watch_loop(self):
         while not self._stop.is_set():
@@ -454,6 +603,25 @@ class WeightedGateway:
                              {"backend": backend, "code": str(code)})
         return code, payload, headers
 
+    def _note_attempt(self, service: str, t0: float,
+                      code: Optional[int] = None,
+                      connect_failed: bool = False) -> None:
+        """Per-attempt backend health series — the green-scoped burn-rate
+        gate (controlplane.upgrade.green_slos) reads these, so a backend
+        that fails over still shows up as an attempt + error on ITS OWN
+        series even though the client saw the retry succeed."""
+        if self.metrics is None:
+            return
+        self.metrics.inc("tpu_gateway_backend_attempts_total",
+                         {"backend": service})
+        if connect_failed or (code is not None and code >= 500):
+            self.metrics.inc("tpu_gateway_backend_errors_total",
+                             {"backend": service})
+        if not connect_failed:
+            self.metrics.observe("tpu_gateway_backend_latency_seconds",
+                                 self._now() - t0, {"backend": service},
+                                 buckets=SERVE_LATENCY_BUCKETS)
+
     def _forward(self, path: str, body: bytes, timeout: float, ctx=None
                  ) -> Tuple[int, bytes, str, Dict[str, str]]:
         prompt = self._prompt_tokens(body)
@@ -513,6 +681,7 @@ class WeightedGateway:
                 last_err = e
                 tried.append(s.url)
                 failed_svc = s.service
+                self._note_attempt(s.service, f0, connect_failed=True)
                 self.tracer.record_span(
                     ctx, "forward", f0, self._now(), backend=s.service,
                     status="error", error=f"connect: {e}")
@@ -523,12 +692,14 @@ class WeightedGateway:
                 continue
             finally:
                 self._release(s)
+            self._note_attempt(s.service, f0, code=code)
             self.tracer.record_span(ctx, "forward", f0, self._now(),
                                     backend=s.service, code=code)
             self._observe_backend(s, resp_headers)
             if hashes and code < 500:
                 with self._lock:
                     s.index.insert(hashes)
+                    self._hot.record(prompt, self.config.block_size)
             return code, payload, s.service, {}
         return 502, json.dumps(
             {"message": f"backend error: {last_err}"}).encode(), \
@@ -586,6 +757,7 @@ class WeightedGateway:
                 last_err = e
                 tried.append(s.url)
                 failed_svc = s.service
+                self._note_attempt(s.service, f0, connect_failed=True)
                 self.tracer.record_span(
                     ctx, span_name, f0, self._now(), backend=s.service,
                     status="error", error=f"connect: {e}")
@@ -596,6 +768,7 @@ class WeightedGateway:
                 continue
             finally:
                 self._release(s)
+            self._note_attempt(s.service, f0, code=code)
             self.tracer.record_span(ctx, span_name, f0, self._now(),
                                     backend=s.service, code=code)
             self._observe_backend(s, resp_headers)

@@ -83,6 +83,46 @@ class PrefixIndex:
         return depth
 
 
+class HotPrompts:
+    """Bounded LRU of block-aligned prompt prefixes with hit counts.
+
+    The gateway records every successfully-served prompt's leading
+    blocks here; before an upgrade's first weight step it replays the
+    :meth:`hottest` prefixes against the cold green fleet so green
+    replicas start with the same hot KV blocks the blue fleet earned
+    (docs/upgrades.md pre-warm).  Prefixes are capped at ``max_blocks``
+    blocks — the shared preamble is what repeats across requests; the
+    unique tail would just pollute the replay budget.
+    """
+
+    def __init__(self, capacity: int = 512, max_blocks: int = 4):
+        self.capacity = capacity
+        self.max_blocks = max_blocks
+        self._counts: "collections.OrderedDict[tuple, int]" = \
+            collections.OrderedDict()
+
+    def __len__(self) -> int:
+        return len(self._counts)
+
+    def record(self, tokens: Sequence[int], block_size: int) -> None:
+        n = min(len(tokens) - len(tokens) % block_size,
+                self.max_blocks * block_size)
+        if n <= 0:
+            return
+        key = tuple(tokens[:n])
+        self._counts[key] = self._counts.pop(key, 0) + 1
+        while len(self._counts) > self.capacity:
+            self._counts.popitem(last=False)
+
+    def hottest(self, n: int) -> List[List[int]]:
+        """Top-``n`` prefixes by hit count; ties break most-recently-used
+        first (stable sort over reversed LRU order), so the replay order
+        is deterministic for a deterministic request stream."""
+        ranked = sorted(reversed(self._counts.items()),
+                        key=lambda kv: -kv[1])
+        return [list(k) for k, _ in ranked[:max(0, n)]]
+
+
 def affinity_score(hit_depth: int, queue_depth: float,
                    alpha: float, beta: float) -> float:
     """The routing score: ``α·prefix-hit-depth − β·queue-depth``.

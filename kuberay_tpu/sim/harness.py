@@ -38,8 +38,10 @@ from kuberay_tpu.controlplane.manager import (
     originated_from_mapper,
     owned_pod_mapper,
 )
+from kuberay_tpu.api.tpucluster import TpuCluster
 from kuberay_tpu.controlplane.service_controller import TpuServiceController
 from kuberay_tpu.controlplane.store import Conflict, NotFound, ObjectStore
+from kuberay_tpu.controlplane.upgrade import BurnRateGate
 from kuberay_tpu.controlplane.warmpool_controller import (
     KIND_WARM_POOL,
     LABEL_WARM_POOL,
@@ -70,7 +72,11 @@ from kuberay_tpu.sim.faults import (
 from kuberay_tpu.sim.invariants import CheckContext, Violation, run_checkers
 from kuberay_tpu.utils import constants as C
 from kuberay_tpu.utils import features
-from kuberay_tpu.utils.metrics import ControlPlaneMetrics
+from kuberay_tpu.utils.metrics import (
+    SERVE_LATENCY_BUCKETS,
+    ControlPlaneMetrics,
+)
+from kuberay_tpu.utils.names import serve_service_name
 
 #: Kinds the simulated operator reconciles (the five controllers).
 SIM_KINDS = (C.KIND_CLUSTER, C.KIND_JOB, C.KIND_SERVICE, C.KIND_CRONJOB,
@@ -141,7 +147,13 @@ class SimHarness:
         self.clock = VirtualClock()
         self._patch = patch_time(self.clock)
         self._patch.__enter__()
-        features.set_gates({"TpuCronJob": True, "WarmSlicePools": True})
+        # Scenario gates ride on top of the baseline (e.g. upgrade
+        # scenarios flip TpuServiceIncrementalUpgrade); classic
+        # scenarios declare none, so their gate set — and therefore
+        # their journal hashes — are unchanged.
+        features.set_gates({"TpuCronJob": True, "WarmSlicePools": True,
+                            **(getattr(scenario, "extra_gates", None)
+                               or {})})
 
         profile = fault_profile
         if profile is None and scenario is not None:
@@ -246,10 +258,17 @@ class SimHarness:
             client_provider=lambda status: provider(status),
             metrics=self.metrics, tracer=self.tracer,
             transitions=transitions)
+        # Burn-rate gate over the green fleet: observational (registry
+        # snapshots + virtual clock only), fed by the serve-traffic pump
+        # when a scenario mounts it; vacuously healthy otherwise.
+        self.upgrade_gate = BurnRateGate(self.metrics.registry,
+                                         clock=self.clock)
         self.service_controller = TpuServiceController(
             self.store, recorder=self.recorder,
             client_provider=lambda cname, status: provider(cname, status),
-            tracer=self.tracer, transitions=transitions)
+            tracer=self.tracer, transitions=transitions,
+            clock=self.clock, upgrade_gate=self.upgrade_gate,
+            flight=self.flight, metrics_registry=self.metrics.registry)
         self.cronjob_controller = TpuCronJobController(
             self.store, recorder=self.recorder, tracer=self.tracer)
 
@@ -271,6 +290,17 @@ class SimHarness:
 
         self.journal: List[Dict[str, Any]] = []
         self._journal_rv = 0
+        # Upgrade-era observability feeds (invariants.CheckContext):
+        # every TrafficRoute SPEC mutation is logged with the green
+        # ring readiness observed at write time (the watcher is
+        # read-only, so mounting it never perturbs journal hashes), and
+        # the serve-traffic pump appends its per-round client outcomes.
+        # Classic scenarios create no routes: both logs stay empty.
+        self.route_weight_log: List[Dict[str, Any]] = []
+        self.serve_traffic_log: List[Dict[str, Any]] = []
+        self._route_specs: Dict[str, str] = {}
+        self._route_watch_cancel = self.store.watch(
+            self._observe_route_event)
         self._failover_count = 0
         self._step = 0
         # Preemption machinery: (kill deadline, ns, slice) for slices
@@ -300,6 +330,7 @@ class SimHarness:
         self.store.set_interposer(None)
         if self._goodput_cancel is not None:
             self._goodput_cancel()
+        self._route_watch_cancel()
         self.kubelet.close()
         features.reset()
         self._patch.__exit__(None, None, None)
@@ -399,6 +430,11 @@ class SimHarness:
             for ev in due:
                 self.store.redeliver(ev)
             drove = self._drive_serve_apps()
+            # Serve-traffic pump (scenario-gated): its request sends are
+            # observational (metrics + pump log only) so they must NOT
+            # count as progress — only its ack writes do, and those show
+            # up as journal growth like any other store mutation.
+            self._pump_serve_traffic()
             swept = self._gc_orphans()
             self._drain_journal()
             if self.alerts is not None:
@@ -481,6 +517,206 @@ class SimHarness:
                 client.set_serve_app("app", "RUNNING")
                 changed = True
         return changed
+
+    # -- upgrade traffic: route watcher + deterministic serve pump ---------
+
+    def _cluster_for_serve_service(self, ns: str, svc_name: str) -> str:
+        """Resolve a route backend's per-cluster serve Service back to
+        the TpuCluster that owns it (names are derived, not labeled)."""
+        for obj in self.store.list(C.KIND_CLUSTER, ns):
+            cname = obj["metadata"]["name"]
+            if serve_service_name(cname) == svc_name:
+                return cname
+        return ""
+
+    def _whole_ready_rings(self, ns: str, cname: str) -> int:
+        """Fully-Ready ICI rings of a cluster right now: slices whose
+        whole multi-host pod set is Running (the same whole-ring measure
+        the service controller's wave/weight logic reads)."""
+        obj = self.store.try_get(C.KIND_CLUSTER, cname, ns)
+        if obj is None:
+            return 0
+        cluster = TpuCluster.from_dict(obj)
+        hosts_per = {g.groupName: g.slice_topology().num_hosts
+                     for g in cluster.spec.workerGroupSpecs}
+        slices: Dict[tuple, List[dict]] = {}
+        for p in self.store.list("Pod", ns,
+                                 labels={C.LABEL_CLUSTER: cname,
+                                         C.LABEL_NODE_TYPE:
+                                         C.NODE_TYPE_WORKER}):
+            if p["metadata"].get("deletionTimestamp"):
+                continue
+            labels = p["metadata"]["labels"]
+            key = (labels.get(C.LABEL_GROUP),
+                   labels.get(C.LABEL_SLICE_NAME))
+            slices.setdefault(key, []).append(p)
+        ready = 0
+        for (gname, _sname), ps in slices.items():
+            want = hosts_per.get(gname, 0)
+            if want > 0 and len(ps) >= want and all(
+                    p.get("status", {}).get("phase") == "Running"
+                    for p in ps):
+                ready += 1
+        return ready
+
+    def _observe_route_event(self, ev):
+        """Read-only TrafficRoute watcher: snapshot every SPEC mutation
+        together with the ring readiness at write time, for the
+        weighted-ring-atomicity checker.  Status-only writes (gateway
+        acks) are skipped — ring state may legitimately have moved on
+        since the weights were chosen."""
+        if ev.kind != "TrafficRoute":
+            return
+        md = ev.obj.get("metadata", {})
+        name = md.get("name", "")
+        if ev.type == "DELETED":
+            self._route_specs.pop(name, None)
+            return
+        backends = (ev.obj.get("spec") or {}).get("backends") or []
+        sig = json.dumps(backends, sort_keys=True)
+        if self._route_specs.get(name) == sig:
+            return
+        self._route_specs[name] = sig
+        ns = md.get("namespace", "default")
+        svc_name = md.get("labels", {}).get(
+            C.LABEL_ORIGINATED_FROM_CR_NAME, "")
+        pending_cluster = ""
+        desired = 0
+        svc = (self.store.try_get(C.KIND_SERVICE, svc_name, ns)
+               if svc_name else None)
+        if svc is not None:
+            pend = (svc.get("status") or {}).get(
+                "pendingServiceStatus") or {}
+            pending_cluster = pend.get("clusterName", "")
+            desired = sum(
+                int(g.get("replicas", 0) or 0)
+                for g in (svc.get("spec", {}).get("clusterSpec", {})
+                          .get("workerGroupSpecs") or []))
+        entry = {"ts": round(self.clock.now(), 3), "route": name,
+                 "backends": []}
+        for b in backends:
+            bsvc = b.get("service", "")
+            cname = self._cluster_for_serve_service(ns, bsvc)
+            entry["backends"].append({
+                "service": bsvc,
+                "weight": int(b.get("weight", 0) or 0),
+                "role": ("green" if cname and cname == pending_cluster
+                         else "blue"),
+                "ready_rings": (self._whole_ready_rings(ns, cname)
+                                if cname else 0),
+                "desired_rings": desired,
+            })
+        self.route_weight_log.append(entry)
+
+    #: Client requests the pump fires per settle round per route.
+    PUMP_REQUESTS = 4
+
+    def _pump_serve_traffic(self) -> int:
+        """Stand-in for the serve gateway under live load, rng-free:
+        every settle round it splits a fixed request count across the
+        route's backends by weight, lands attempts/errors/latency on the
+        per-backend series the burn-rate gate reads, fails over from a
+        ringless backend to a healthy peer (client-visible failures only
+        when NOBODY can serve), and acks the route's prewarm/drain
+        handshake flags the way the real gateway would.  Mounted only
+        when the scenario opts in (serve_traffic=True); ack writes are
+        store mutations and therefore count as settle progress through
+        the journal."""
+        if self.scenario is None or \
+                not getattr(self.scenario, "serve_traffic", False):
+            return 0
+        acks = 0
+        for route in sorted(
+                self.store.list("TrafficRoute"),
+                key=lambda o: (o["metadata"].get("namespace", "default"),
+                               o["metadata"].get("name", ""))):
+            acks += self._pump_route(route)
+        return acks
+
+    def _pump_route(self, route: dict) -> int:
+        ns = route["metadata"].get("namespace", "default")
+        name = route["metadata"].get("name", "")
+        backends = (route.get("spec") or {}).get("backends") or []
+        if not backends:
+            return 0
+        reg = self.metrics.registry
+        serveable: Dict[str, bool] = {}
+        for b in backends:
+            bsvc = b.get("service", "")
+            cname = self._cluster_for_serve_service(ns, bsvc)
+            serveable[bsvc] = bool(cname) and \
+                self._whole_ready_rings(ns, cname) > 0
+        total_w = sum(int(b.get("weight", 0) or 0) for b in backends)
+        sent = failed = failovers = 0
+        if total_w > 0:
+            # Largest-remainder split of the round's requests by weight,
+            # remainder to earlier (higher-weight-first is the route's
+            # own backend order for the active cluster) — deterministic.
+            counts = [self.PUMP_REQUESTS * int(b.get("weight", 0) or 0)
+                      // total_w for b in backends]
+            pos = [j for j, b in enumerate(backends)
+                   if int(b.get("weight", 0) or 0) > 0]
+            for i in range(self.PUMP_REQUESTS - sum(counts)):
+                counts[pos[i % len(pos)]] += 1
+            for b, n in zip(backends, counts):
+                bsvc = b.get("service", "")
+                for _ in range(n):
+                    sent += 1
+                    reg.inc("tpu_gateway_backend_attempts_total",
+                            {"backend": bsvc})
+                    if serveable.get(bsvc):
+                        reg.observe("tpu_gateway_backend_latency_seconds",
+                                    0.05, {"backend": bsvc},
+                                    buckets=SERVE_LATENCY_BUCKETS)
+                        continue
+                    # The weighted pick cannot serve (no whole ring):
+                    # error lands on ITS series — the gate must see the
+                    # bad backend — then the request fails over.
+                    reg.inc("tpu_gateway_backend_errors_total",
+                            {"backend": bsvc})
+                    peer = next(
+                        (o.get("service", "") for o in sorted(
+                            backends,
+                            key=lambda o: (-int(o.get("weight", 0) or 0),
+                                           o.get("service", "")))
+                         if o.get("service", "") != bsvc
+                         and serveable.get(o.get("service", ""))), None)
+                    if peer is None:
+                        failed += 1
+                        continue
+                    failovers += 1
+                    reg.inc("tpu_gateway_backend_attempts_total",
+                            {"backend": peer})
+                    reg.observe("tpu_gateway_backend_latency_seconds",
+                                0.05, {"backend": peer},
+                                buckets=SERVE_LATENCY_BUCKETS)
+        if sent:
+            self.serve_traffic_log.append({
+                "ts": round(self.clock.now(), 3), "route": name,
+                "requests": sent, "failed": failed,
+                "failovers": failovers})
+        # Gateway-side handshake acks: prewarm immediately (the sim has
+        # no real KV cache to replay into), drain immediately (no real
+        # in-flight set to wait out).
+        status = route.get("status") or {}
+        ack: Dict[str, Dict] = {}
+        for b in backends:
+            bsvc = b.get("service", "")
+            if b.get("prewarm") and \
+                    bsvc not in (status.get("prewarmed") or {}):
+                ack.setdefault("prewarmed", {})[bsvc] = \
+                    int(b.get("prewarm") or 0)
+            if b.get("drain") and \
+                    bsvc not in (status.get("drained") or {}):
+                ack.setdefault("drained", {})[bsvc] = True
+        if not ack:
+            return 0
+        try:
+            self.store.patch("TrafficRoute", name, ns, {"status": ack},
+                             subresource="status")
+        except (NotFound, Conflict):
+            return 0
+        return 1
 
     def succeed_jobs(self) -> int:
         """Scenario helper: every non-terminal submitted job succeeds."""
@@ -808,7 +1044,9 @@ class SimHarness:
         self._drain_journal()
         violations = run_checkers(CheckContext(
             self.store, self.journal, steps=self.steps,
-            slow_host_log=self.slow_host_log))
+            slow_host_log=self.slow_host_log,
+            route_weight_log=self.route_weight_log,
+            serve_traffic_log=self.serve_traffic_log))
         if not self.converged:
             violations.append(Violation(
                 "convergence", f"step {self._step}",

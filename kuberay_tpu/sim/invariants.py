@@ -49,11 +49,19 @@ class CheckContext:
     def __init__(self, store: ObjectStore,
                  journal: Optional[List[Dict[str, Any]]] = None,
                  steps=None,
-                 slow_host_log: Optional[List[Dict[str, Any]]] = None):
+                 slow_host_log: Optional[List[Dict[str, Any]]] = None,
+                 route_weight_log: Optional[List[Dict[str, Any]]] = None,
+                 serve_traffic_log: Optional[List[Dict[str, Any]]] = None):
         self.store = store
         self.journal = journal or []
         self.steps = steps
         self.slow_host_log = slow_host_log or []
+        # Upgrade-era observability feeds (harness-maintained, both
+        # empty unless the scenario mounts them): every TrafficRoute
+        # spec mutation with the ring readiness observed at write time,
+        # and the serve-traffic pump's per-round client outcomes.
+        self.route_weight_log = route_weight_log or []
+        self.serve_traffic_log = serve_traffic_log or []
 
     # -- shared traversals -------------------------------------------------
 
@@ -407,6 +415,67 @@ def check_straggler_detection(ctx: CheckContext) -> List[Violation]:
                 f"detected at step {v['detected_step']}, "
                 f"{v['detected_step'] - v['first_slow_step'] + 1} slow "
                 f"steps after onset (budget {k})"))
+    return out
+
+
+@checker("weighted-ring-atomicity",
+         "a TrafficRoute weight INCREASE on the green (pending) backend "
+         "never outruns its whole-ring capacity: at write time the green "
+         "cluster has at least one fully-Ready multi-host ring and the "
+         "new weight stays within 100*ready/desired — traffic is never "
+         "pointed at a partially-provisioned slice")
+def check_weighted_ring_atomicity(ctx: CheckContext) -> List[Violation]:
+    # Vacuous without the harness's route watcher mounted (classic
+    # scenarios never create TrafficRoutes).  Only weight *increases*
+    # are capped: a ring that degrades under a fault while weight holds
+    # is the ramp's rollback/step-down problem, not a provisioning
+    # atomicity breach.
+    out: List[Violation] = []
+    prev: Dict[tuple, int] = {}
+    for entry in ctx.route_weight_log:
+        for b in entry.get("backends", []):
+            key = (entry.get("route", ""), b.get("service", ""))
+            last = prev.get(key, 0)
+            weight = int(b.get("weight", 0) or 0)
+            prev[key] = weight
+            if b.get("role") != "green" or weight <= last:
+                continue
+            ready = int(b.get("ready_rings", 0) or 0)
+            desired = int(b.get("desired_rings", 0) or 0)
+            vkey = f"TrafficRoute {entry.get('route')}/{b.get('service')}"
+            if ready < 1:
+                out.append(Violation(
+                    "weighted-ring-atomicity", vkey,
+                    f"weight raised {last}% -> {weight}% at ts "
+                    f"{entry.get('ts')} with zero whole green rings"))
+                continue
+            cap = 100 if desired <= 0 else \
+                (100 * min(ready, desired)) // desired
+            if weight > cap:
+                out.append(Violation(
+                    "weighted-ring-atomicity", vkey,
+                    f"weight raised {last}% -> {weight}% at ts "
+                    f"{entry.get('ts')} but {ready}/{desired} whole rings "
+                    f"support only {cap}%"))
+    return out
+
+
+@checker("zero-failed-requests",
+         "no serve-traffic pump request ever fails client-visibly during "
+         "an upgrade: a weighted backend without a whole serving ring "
+         "must fail over to a healthy peer, never surface a 5xx")
+def check_zero_failed_requests(ctx: CheckContext) -> List[Violation]:
+    # Vacuous unless the scenario mounts the pump (serve_traffic=True).
+    out: List[Violation] = []
+    for entry in ctx.serve_traffic_log:
+        failed = int(entry.get("failed", 0) or 0)
+        if failed > 0:
+            out.append(Violation(
+                "zero-failed-requests",
+                f"TrafficRoute {entry.get('route', '')}",
+                f"{failed}/{entry.get('requests')} client requests "
+                f"failed at ts {entry.get('ts')} (failovers="
+                f"{entry.get('failovers', 0)})"))
     return out
 
 

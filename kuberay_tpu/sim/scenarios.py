@@ -66,19 +66,31 @@ class Scenario:
     # the classic single pool; shard-restart exercises the sharded
     # router + bookmark resume).
     shards: int = 1
+    # Mount the deterministic serve-traffic pump (harness
+    # _pump_serve_traffic): synthetic weighted requests against the
+    # TrafficRoute every settle round, feeding the burn-rate gate and
+    # the zero-failed-requests checker.  Off for the classic scenarios
+    # so their journals stay byte-identical.
+    serve_traffic: bool = False
+    # Extra feature gates merged over the harness baseline (e.g. the
+    # incremental-upgrade gate); empty for the classic scenarios.
+    extra_gates: Dict[str, bool] = dataclasses.field(default_factory=dict)
 
 
 SCENARIOS: Dict[str, Scenario] = {}
 
 
 def scenario(name: str, description: str, profile: Dict[str, float],
-             default_steps: int = 12, shards: int = 1):
+             default_steps: int = 12, shards: int = 1,
+             serve_traffic: bool = False,
+             extra_gates: Optional[Dict[str, bool]] = None):
     def register(cls):
         inst = cls()
         SCENARIOS[name] = Scenario(
             name=name, description=description, profile=profile,
             setup=inst.setup, tick=inst.tick, default_steps=default_steps,
-            shards=shards)
+            shards=shards, serve_traffic=serve_traffic,
+            extra_gates=dict(extra_gates or {}))
         return cls
     return register
 
@@ -169,6 +181,77 @@ class _RollingUpgrade:
                 tmpl = g.get("template", {})
                 for cont in tmpl.get("spec", {}).get("containers", []):
                     cont["image"] = f"tpu-runtime:v{rev}"
+            try:
+                h.store.update(svc)
+            except Conflict:
+                return
+
+
+# ---------------------------------------------------------------------------
+# upgrade under fire: burn-rate-gated blue/green ramp + live traffic + faults
+# ---------------------------------------------------------------------------
+
+@scenario(
+    "upgrade-under-fire",
+    "a burn-rate-gated incremental upgrade (waves, pre-warm, drain) with "
+    "live weighted serve traffic while pods die and preemption notices "
+    "land mid-wave: no TrafficRoute may ever weight a partial green "
+    "ring, and no client request may fail",
+    # SLICE_DRAIN/DELETE_RACE stay 0: a raw whole-slice kill of the only
+    # blue ring would zero fleet capacity by construction — the drill is
+    # about the upgrade surviving single-pod deaths and warned
+    # preemptions, not about serving through total capacity loss.
+    profile={F.POD_KILL: 0.5, F.PREEMPTION_NOTICE: 0.4, F.SLOW_START: 0.3,
+             F.STORE_CONFLICT: 0.4, F.WATCH_DROP: 0.2, F.WATCH_DUP: 0.2,
+             F.WATCH_DELAY: 0.3, F.SLICE_DRAIN: 0.0, F.DELETE_RACE: 0.0,
+             F.LEADER_FAILOVER: 0.0},
+    serve_traffic=True,
+    extra_gates={"TpuServiceIncrementalUpgrade": True})
+class _UpgradeUnderFire:
+    def setup(self, h):
+        # v5p 2x2x2 = 2 hosts per ICI ring, two rings: multi-host
+        # atomicity is in play and one pod kill never zeros the fleet.
+        cluster_spec = make_cluster_obj("tmpl", accelerator="v5p",
+                                        topology="2x2x2", replicas=2,
+                                        max_replicas=4)["spec"]
+        h.store.create({
+            "apiVersion": C.API_VERSION, "kind": C.KIND_SERVICE,
+            "metadata": {"name": "fleet"},
+            "spec": {
+                "clusterSpec": cluster_spec,
+                "serveConfig": {"applications": [{"name": "app",
+                                                  "rev": 0}]},
+                "upgradeStrategy":
+                    "NewClusterWithIncrementalUpgrade",
+                # Short virtual-time ramp so a full gated cycle (prewarm
+                # -> waves -> drain -> promote) fits inside a run.
+                "upgradeOptions": {
+                    "stepSizePercent": 25, "intervalSeconds": 5,
+                    "maxRollbacks": 1, "holdSeconds": 10,
+                    "waveSlices": 1, "prewarmPrompts": 4,
+                    "drainTimeoutSeconds": 15,
+                },
+                "serviceUnhealthySecondThreshold": 20,
+                "deploymentUnhealthySecondThreshold": 20,
+                "clusterDeletionDelaySeconds": 5,
+            },
+            "status": {},
+        })
+
+    def tick(self, h, step):
+        svc = h.store.try_get(C.KIND_SERVICE, "fleet")
+        if svc is None:
+            return
+        if step in (2, 8):
+            # Two image bumps per run: the second lands while the fleet
+            # may still be mid-ramp/rolled-back from the first, so the
+            # abandon-pending and fresh-budget paths run under fire too.
+            for g in ([svc["spec"]["clusterSpec"].get("headGroupSpec", {})]
+                      + svc["spec"]["clusterSpec"].get("workerGroupSpecs",
+                                                       [])):
+                tmpl = g.get("template", {})
+                for cont in tmpl.get("spec", {}).get("containers", []):
+                    cont["image"] = f"tpu-runtime:v{step}"
             try:
                 h.store.update(svc)
             except Conflict:

@@ -759,3 +759,150 @@ def test_prefill_beta_spreads_bursts_off_the_affine_replica(
         pa.close()
         pb.close()
         de.close()
+
+
+# ---------------------------------------------------------------------------
+# upgrade handshakes: route-deletion fallback, prefix pre-warm replay,
+# session-drain ack, and the per-backend attempt series the upgrade
+# BurnRateGate reads (docs/upgrades.md)
+# ---------------------------------------------------------------------------
+
+def set_route_backends(store, backend_list, name="route"):
+    obj = store.get("TrafficRoute", name)
+    obj["spec"]["backends"] = backend_list
+    store.update(obj)
+
+
+def test_route_deletion_collapses_onto_survivor(backends):
+    """Promotion deletes the route; the gateway must fall back to the
+    highest-weight backend it last saw at weight 100 — no window with
+    stale weights or zero backends."""
+    a = backends("a")
+    b = backends("b")
+    urls = {"a": a.url, "b": b.url}
+    store = ObjectStore()
+    make_route(store, {"a": 70, "b": 30})
+    gw = WeightedGateway(store, "route", resolver=lambda s: urls[s],
+                         poll_interval=30.0, rng=random.Random(0),
+                         config=GatewayConfig(epsilon=1.0))
+    try:
+        gw._refresh()
+        store.delete("TrafficRoute", "route")
+        gw._refresh()
+        with gw._lock:
+            weights = {s: st.weight for s, st in gw._states.items()}
+        assert weights == {"a": 100, "b": 0}
+        for _ in range(6):
+            code, body = gw.forward(
+                "/v1/completions",
+                json.dumps({"prompt_tokens": [1, 2]}).encode())
+            assert code == 200
+            assert json.loads(body)["served_by"] == "a"
+        assert b.hits == 0
+    finally:
+        gw.stop()
+
+
+def test_prewarm_replays_hottest_prefixes_once_and_acks(backends):
+    blue = backends("blue")
+    green = backends("green")
+    urls = {"blue": blue.url, "green": green.url}
+    store = ObjectStore()
+    make_route(store, {"blue": 100})
+    reg = MetricsRegistry()
+    gw = WeightedGateway(store, "route", resolver=lambda s: urls[s],
+                         poll_interval=30.0, metrics=reg,
+                         rng=random.Random(0),
+                         config=GatewayConfig(block_size=BS))
+    try:
+        # Live blue traffic teaches the hot-prompt tracker two distinct
+        # block-aligned prefixes.
+        for _ in range(3):
+            gw.forward("/v1/completions",
+                       json.dumps({"prompt_tokens": PROMPT}).encode())
+        gw.forward("/v1/completions",
+                   json.dumps({"prompt_tokens": PROMPT[:2 * BS]}).encode())
+        # The controller flags green for pre-warm while it carries no
+        # weight yet.
+        set_route_backends(store, [
+            {"service": "blue", "weight": 100},
+            {"service": "green", "weight": 0, "prewarm": 2}])
+        before = green.hits
+        gw._refresh()
+        assert green.hits == before + 2        # one prefill per prefix
+        route = store.get("TrafficRoute", "route")
+        assert route["status"]["prewarmed"]["green"] == 2
+        gw._refresh()                          # ack is idempotent
+        assert green.hits == before + 2
+        assert ('tpu_upgrade_prewarm_prompts_total{backend="green"} 2.0'
+                in reg.render())
+    finally:
+        gw.stop()
+
+
+def test_drain_acks_only_when_inflight_reaches_zero(backends):
+    a = backends("a")
+    b = backends("b")
+    urls = {"a": a.url, "b": b.url}
+    store = ObjectStore()
+    make_route(store, {"a": 50, "b": 50})
+    reg = MetricsRegistry()
+    gw = WeightedGateway(store, "route", resolver=lambda s: urls[s],
+                         poll_interval=30.0, metrics=reg,
+                         rng=random.Random(0))
+    try:
+        gw._refresh()
+        # Terminal ramp weights: green (b) at 100, blue (a) draining.
+        set_route_backends(store, [
+            {"service": "a", "weight": 0, "drain": True},
+            {"service": "b", "weight": 100}])
+        with gw._lock:
+            gw._states["a"].inflight = 1       # admitted work still running
+        gw._refresh()
+        status = store.get("TrafficRoute", "route").get("status") or {}
+        assert "a" not in (status.get("drained") or {})
+        with gw._lock:
+            gw._states["a"].inflight = 0
+        gw._refresh()
+        status = store.get("TrafficRoute", "route")["status"]
+        assert status["drained"]["a"] is True
+        assert "tpu_upgrade_drain_seconds_count" in reg.render()
+    finally:
+        gw.stop()
+
+
+def test_backend_attempt_series_record_connect_failures(backends):
+    """The BurnRateGate's availability signal: a dead green backend
+    lands attempt + error on its OWN series even though failover keeps
+    every client response a 200."""
+    live = backends("live")
+    urls = {"live": live.url, "green": "http://127.0.0.1:1"}
+    store = ObjectStore()
+    make_route(store, {"live": 50, "green": 50})
+    reg = MetricsRegistry()
+    gw = WeightedGateway(store, "route", resolver=lambda s: urls[s],
+                         poll_interval=30.0, metrics=reg,
+                         rng=random.Random(0),
+                         config=GatewayConfig(epsilon=1.0))
+    try:
+        for _ in range(8):
+            code, _ = gw.forward(
+                "/v1/completions",
+                json.dumps({"prompt_tokens": [1, 2]}).encode())
+            assert code == 200                 # failover keeps users whole
+        attempts = {lbl["backend"]: v for lbl, v in
+                    reg.family_snapshot("tpu_gateway_backend_attempts_total")}
+        errors = {lbl["backend"]: v for lbl, v in
+                  reg.family_snapshot("tpu_gateway_backend_errors_total")}
+        assert attempts.get("green", 0) > 0    # the gate's raw signal
+        assert errors.get("green") == attempts["green"]
+        assert "live" not in errors            # the survivor stays clean
+        # Connect failures never reach the latency histogram: the gate's
+        # TTFT signal only sees real responses.
+        text = reg.render()
+        assert ('tpu_gateway_backend_latency_seconds_count'
+                '{backend="green"}') not in text
+        assert ('tpu_gateway_backend_latency_seconds_count'
+                '{backend="live"}') in text
+    finally:
+        gw.stop()

@@ -320,3 +320,230 @@ def test_unknown_serve_tier_normalizes_to_mixed(h):
     backends = [b for r in routes for b in r["spec"]["backends"]]
     assert backends
     assert all(b["tier"] == C.SERVE_TIER_MIXED for b in backends)
+
+
+# ---------------------------------------------------------------------------
+# burn-rate-gated incremental upgrades (docs/upgrades.md): rollback,
+# abort latch, abandoned pending, prewarm/drain handshakes — all under a
+# virtual clock and a scriptable gate
+# ---------------------------------------------------------------------------
+
+from kuberay_tpu.api.tpuservice import UpgradeState  # noqa: E402
+from kuberay_tpu.sim.clock import VirtualClock  # noqa: E402
+from kuberay_tpu.utils.names import serve_service_name  # noqa: E402
+
+
+class FakeGate:
+    """Scriptable stand-in for controlplane.upgrade.BurnRateGate."""
+
+    def __init__(self):
+        self.healthy = True
+        self.alert = None
+        self.forgotten = []
+
+    def verdict(self, backend):
+        if self.healthy:
+            return True, None
+        return False, dict(self.alert or
+                           {"name": "upgrade-green-availability",
+                            "window": "fast"})
+
+    def forget(self, backend):
+        self.forgotten.append(backend)
+
+
+def gated_harness(**opts):
+    """ServiceHarness wired for the closed-loop ramp: feature gate on, a
+    FakeGate verdict source, and a virtual clock so interval/hold maths
+    are exact instead of wall-time races."""
+    features.set_gates({"TpuServiceIncrementalUpgrade": True})
+    h = ServiceHarness()
+    clock = VirtualClock(start=10_000.0)
+    h.svc_ctrl._now = clock.now
+    gate = FakeGate()
+    h.svc_ctrl.upgrade_gate = gate
+    svc = make_service()
+    svc.spec.upgradeStrategy = ServiceUpgradeType.INCREMENTAL
+    base = dict(stepSizePercent=50, intervalSeconds=3600,
+                maxRollbacks=2, holdSeconds=60)
+    base.update(opts)
+    svc.spec.upgradeOptions = ClusterUpgradeOptions(**base)
+    h.store.create(svc.to_dict())
+    h.settle()
+    return h, clock, gate
+
+
+def bump_image(h, image):
+    obj = h.store.get(C.KIND_SERVICE, "svc")
+    obj["spec"]["clusterSpec"]["workerGroupSpecs"][0]["template"]["spec"][
+        "containers"][0]["image"] = image
+    h.store.update(obj)
+
+
+def green_weight(h):
+    cs = h.svc().status.pendingServiceStatus
+    return None if cs is None else cs.trafficWeightPercent
+
+
+def test_gated_rollback_snaps_weight_then_holds_then_reramps(h):
+    h, clock, gate = gated_harness(prewarmPrompts=4)
+    old_active = h.svc().status.activeServiceStatus.clusterName
+    bump_image(h, "model:v2")
+    h.settle(rounds=6)
+
+    # Pre-warm handshake: the ramp parks at weight 0 until the gateway
+    # acks the prefix replay in the route status.
+    s = h.svc()
+    assert s.status.upgrade.state == UpgradeState.PREWARMING
+    assert green_weight(h) == 0
+    green_svc = serve_service_name(s.status.pendingServiceStatus.clusterName)
+    route = h.store.get("TrafficRoute", "svc-route")
+    route.setdefault("status", {})["prewarmed"] = {green_svc: 4}
+    h.store.update_status(route)
+
+    # First step: interval since lastUpgradeStepTime=0 is long past.
+    h.settle(rounds=2)
+    assert green_weight(h) == 50
+    assert h.svc().status.upgrade.state == UpgradeState.RAMPING
+    # Interval gate holds the next step until the virtual clock moves.
+    h.settle(rounds=2)
+    assert green_weight(h) == 50
+
+    # The green fleet burns: one decision snaps weight to 0.
+    gate.healthy = False
+    gate.alert = {"name": "upgrade-green-ttft", "window": "fast"}
+    h.settle(rounds=2)
+    s = h.svc()
+    assert green_weight(h) == 0
+    assert s.status.activeServiceStatus.trafficWeightPercent == 100
+    assert s.status.upgrade.state == UpgradeState.ROLLED_BACK
+    assert s.status.upgrade.rollbacks == 1
+    assert s.status.upgrade.lastAlert["name"] == "upgrade-green-ttft"
+
+    # Clean burn again, but holdSeconds of backoff must elapse first.
+    gate.healthy = True
+    h.settle(rounds=2)
+    assert green_weight(h) == 0
+    assert h.svc().status.upgrade.state == UpgradeState.HOLDING
+    clock.advance(3600.0)                      # past hold AND interval
+    h.settle(rounds=2)
+    assert green_weight(h) == 50
+    clock.advance(3600.0)
+    h.settle(rounds=4)
+
+    # 100% with no drain gate promotes in the same reconcile.
+    s = h.svc()
+    assert s.status.pendingServiceStatus is None
+    assert s.status.activeServiceStatus.clusterName != old_active
+    assert s.status.upgrade.state == UpgradeState.PROMOTED
+    assert s.status.upgrade.rollbacks == 1     # history survives promote
+    assert green_svc in gate.forgotten         # fresh windows next time
+    assert h.store.list("TrafficRoute") == []
+
+
+def test_gated_abort_latches_spec_hash_until_spec_changes(h):
+    h, clock, gate = gated_harness(maxRollbacks=0)
+    old_active = h.svc().status.activeServiceStatus.clusterName
+    bump_image(h, "model:v2")
+    h.settle(rounds=6)
+    assert green_weight(h) == 50
+
+    # Budget is zero: the first breach at weight > 0 aborts the upgrade.
+    gate.healthy = False
+    h.settle(rounds=2)
+    s = h.svc()
+    assert s.status.upgrade.state == UpgradeState.ABORTED
+    assert s.status.upgrade.abortedSpecHash
+    assert s.status.pendingServiceStatus is None
+    assert s.status.activeServiceStatus.clusterName == old_active
+    assert s.status.activeServiceStatus.trafficWeightPercent == 100
+    assert h.store.list("TrafficRoute") == []
+    aborted_hash = s.status.upgrade.abortedSpecHash
+
+    # The latch: the same bad spec is NOT retried, even with a clean gate.
+    gate.healthy = True
+    h.settle(rounds=4)
+    s = h.svc()
+    assert s.status.pendingServiceStatus is None
+    assert s.status.upgrade.state == UpgradeState.ABORTED
+
+    # A new spec clears it — and the fresh ramp starts with fresh budgets.
+    bump_image(h, "model:v3")
+    h.settle(rounds=6)
+    clock.advance(3600.0)
+    h.settle(rounds=6)
+    clock.advance(3600.0)
+    h.settle(rounds=6)
+    s = h.svc()
+    assert s.status.activeServiceStatus.clusterName != old_active
+    assert s.status.upgrade.state == UpgradeState.PROMOTED
+    assert s.status.upgrade.rollbacks == 0
+    assert s.status.upgrade.abortedSpecHash != aborted_hash
+
+
+def test_abandoned_stale_pending_restarts_with_fresh_budgets(h):
+    """Satellite: a spec change landing mid-upgrade retires the stale-hash
+    pending cluster whole and the next upgrade starts cleanly."""
+    h, clock, gate = gated_harness()
+    old_active = h.svc().status.activeServiceStatus.clusterName
+    bump_image(h, "model:v2")
+    h.settle(rounds=6)
+    assert green_weight(h) == 50
+    stale_pending = h.svc().status.pendingServiceStatus.clusterName
+
+    # Burn once so the in-flight ramp carries spent budget state.
+    gate.healthy = False
+    h.settle(rounds=2)
+    assert h.svc().status.upgrade.rollbacks == 1
+    gate.healthy = True
+
+    # The operator ships v3 while v2's ramp is parked at weight 0.
+    bump_image(h, "model:v3")
+    h.settle(rounds=2)
+    s = h.svc()
+    assert s.status.pendingServiceStatus is not None
+    assert s.status.pendingServiceStatus.clusterName != stale_pending
+    # Stale pending cluster is gone, and the ramp state reset with it.
+    assert h.store.try_get(C.KIND_CLUSTER, stale_pending) is None
+    assert any(c.type == "RollingBack" and c.reason == "PendingAbandoned"
+               for c in s.status.conditions)
+
+    clock.advance(3600.0)
+    h.settle(rounds=6)
+    clock.advance(3600.0)
+    h.settle(rounds=6)
+    s = h.svc()
+    assert s.status.activeServiceStatus.clusterName != old_active
+    assert s.status.upgrade.state == UpgradeState.PROMOTED
+    assert s.status.upgrade.rollbacks == 0     # fresh budgets, not v2's
+    image = h.store.get(C.KIND_CLUSTER,
+                        s.status.activeServiceStatus.clusterName)[
+        "spec"]["workerGroupSpecs"][0]["template"]["spec"][
+        "containers"][0]["image"]
+    assert image == "model:v3"
+
+
+def test_gated_promotion_waits_for_blue_drain_ack(h):
+    h, clock, gate = gated_harness(stepSizePercent=100,
+                                   drainTimeoutSeconds=300)
+    blue = h.svc().status.activeServiceStatus.clusterName
+    bump_image(h, "model:v2")
+    h.settle(rounds=6)
+
+    # Green stepped straight to 100, but blue still has admitted work:
+    # promotion holds in Draining until the gateway acks.
+    s = h.svc()
+    assert green_weight(h) == 100
+    assert s.status.upgrade.state == UpgradeState.DRAINING
+    assert s.status.pendingServiceStatus is not None
+    h.settle(rounds=2)
+    assert h.svc().status.upgrade.state == UpgradeState.DRAINING
+
+    route = h.store.get("TrafficRoute", "svc-route")
+    route.setdefault("status", {})["drained"] = {serve_service_name(blue): True}
+    h.store.update_status(route)
+    h.settle(rounds=4)
+    s = h.svc()
+    assert s.status.pendingServiceStatus is None
+    assert s.status.upgrade.state == UpgradeState.PROMOTED
+    assert s.status.activeServiceStatus.clusterName != blue

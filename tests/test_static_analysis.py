@@ -38,7 +38,8 @@ def test_rule_registry_complete():
             "phase-transition-recorded",
             "no-io-under-store-lock",
             "shard-affinity",
-            "slice-teardown-through-drain-seam"} <= set(RULES)
+            "slice-teardown-through-drain-seam",
+            "traffic-weight-through-gate"} <= set(RULES)
     for cls in RULES.values():
         assert cls.DESCRIPTION and cls.INVARIANT
 
@@ -913,3 +914,47 @@ def test_known_suppressions_are_few_and_intentional():
     all_findings = run_paths([tree], keep_suppressed=True)
     suppressed = len(all_findings) - len(run_paths([tree]))
     assert suppressed <= 6, render_human(all_findings)
+
+
+# ---------------------------------------------------------------------------
+# traffic-weight-through-gate
+# ---------------------------------------------------------------------------
+
+def test_weight_gate_flags_side_channel_write():
+    findings, fired = _rules_fired("""
+    class Controller:
+        def _apply_upgrade_decision(self, svc, decision):
+            svc.status.pendingServiceStatus.trafficWeightPercent = \
+                decision.green_weight
+
+        def _self_heal(self, svc):
+            svc.status.pendingServiceStatus.trafficWeightPercent = 100
+    """, only=["traffic-weight-through-gate"])
+    assert fired == {"traffic-weight-through-gate"}
+    assert "_self_heal" in findings[0].message
+
+
+def test_weight_gate_allows_seam_and_terminal_promote():
+    _, fired = _rules_fired("""
+    class Controller:
+        def _apply_upgrade_decision(self, svc, decision):
+            svc.status.pendingServiceStatus.trafficWeightPercent = \
+                decision.green_weight
+            svc.status.activeServiceStatus.trafficWeightPercent = \
+                100 - decision.green_weight
+
+        def _promote(self, svc):
+            svc.status.activeServiceStatus.trafficWeightPercent = 100
+    """, only=["traffic-weight-through-gate"])
+    assert fired == set()
+
+
+def test_weight_gate_ignores_classes_without_the_seam():
+    # The open-loop timer stepper (no orchestrator seam) is a different
+    # controller shape, not a violation of this one's funnel.
+    _, fired = _rules_fired("""
+    class LegacyTimer:
+        def step(self, svc):
+            svc.status.pendingServiceStatus.trafficWeightPercent = 10
+    """, only=["traffic-weight-through-gate"])
+    assert fired == set()

@@ -1,0 +1,223 @@
+"""Decision-table units for the pure upgrade core (docs/upgrades.md):
+every UpgradeOrchestrator action at its exact trigger, ring-cap math,
+and BurnRateGate verdicts over green-scoped gateway series under a
+virtual clock."""
+
+import pytest
+
+from kuberay_tpu.controlplane.upgrade import (
+    ABORT,
+    HOLD,
+    PREWARM,
+    PROMOTE,
+    ROLLBACK,
+    STEP,
+    WAIT_DRAIN,
+    WAIT_RING,
+    BurnRateGate,
+    UpgradeObservation,
+    UpgradeOrchestrator,
+)
+from kuberay_tpu.sim.clock import VirtualClock
+from kuberay_tpu.utils.metrics import MetricsRegistry
+
+TTFT_BUCKETS = (0.25, 0.5, 1.0, 2.0)
+
+
+def obs(**kw):
+    base = dict(now=100.0, green_weight=0, step_size=10, interval_s=30.0,
+                last_step_time=0.0, ready_slices=2, desired_slices=2)
+    base.update(kw)
+    return UpgradeObservation(**base)
+
+
+@pytest.fixture
+def orch():
+    return UpgradeOrchestrator()
+
+
+# ---------------------------------------------------------------------------
+# ring cap: weight never outruns whole ICI rings
+# ---------------------------------------------------------------------------
+
+def test_ring_cap_math(orch):
+    assert orch.ring_cap(0, 0) == 100      # no rings desired: uncapped
+    assert orch.ring_cap(0, 2) == 0
+    assert orch.ring_cap(1, 2) == 50
+    assert orch.ring_cap(2, 2) == 100
+    assert orch.ring_cap(5, 2) == 100      # ready overshoot clamps
+    assert orch.ring_cap(1, 3) == 33       # floor, never round up
+
+
+def test_step_up_clamped_to_ring_cap(orch):
+    d = orch.decide(obs(green_weight=40, step_size=25,
+                        ready_slices=1, desired_slices=2))
+    assert d.action == STEP and d.green_weight == 50   # not 65
+
+
+def test_wait_ring_at_cap_while_wave_provisions(orch):
+    d = orch.decide(obs(green_weight=50, step_size=25,
+                        ready_slices=1, desired_slices=2))
+    assert d.action == WAIT_RING and d.green_weight == 50
+
+
+def test_ring_degradation_steps_down_ignoring_interval(orch):
+    # A ring died mid-wave: retreat immediately, even though the step
+    # interval has not elapsed.
+    d = orch.decide(obs(green_weight=50, last_step_time=99.0,
+                        ready_slices=0, desired_slices=2))
+    assert d.action == STEP and d.green_weight == 0
+
+
+# ---------------------------------------------------------------------------
+# the gate outranks everything
+# ---------------------------------------------------------------------------
+
+def test_firing_gate_rolls_back_with_alert_attached(orch):
+    alert = {"name": "upgrade-green-availability", "window": "fast"}
+    d = orch.decide(obs(green_weight=30, gate_healthy=False,
+                        firing_alert=alert))
+    assert d.action == ROLLBACK and d.green_weight == 0
+    assert d.alert == alert
+
+
+def test_firing_gate_past_budget_aborts(orch):
+    d = orch.decide(obs(green_weight=30, gate_healthy=False,
+                        rollbacks=2, max_rollbacks=2))
+    assert d.action == ABORT
+
+
+def test_firing_gate_at_weight_zero_holds(orch):
+    d = orch.decide(obs(green_weight=0, gate_healthy=False))
+    assert d.action == HOLD and d.green_weight == 0
+
+
+def test_post_rollback_hold_then_reramp(orch):
+    held = obs(now=100.0, green_weight=0, rollbacks=1,
+               last_rollback_time=90.0, hold_seconds=60.0)
+    d = orch.decide(held)
+    assert d.action == HOLD
+    assert d.requeue_after == pytest.approx(50.0)
+    again = obs(now=151.0, green_weight=0, rollbacks=1,
+                last_rollback_time=90.0, hold_seconds=60.0,
+                last_step_time=0.0)
+    d = orch.decide(again)
+    assert d.action == STEP and d.green_weight == 10
+
+
+# ---------------------------------------------------------------------------
+# prewarm, drain, promote
+# ---------------------------------------------------------------------------
+
+def test_first_step_waits_for_prewarm_ack(orch):
+    d = orch.decide(obs(green_weight=0, prewarm_requested=True,
+                        prewarm_done=False))
+    assert d.action == PREWARM and d.green_weight == 0
+    d = orch.decide(obs(green_weight=0, prewarm_requested=True,
+                        prewarm_done=True))
+    assert d.action == STEP and d.green_weight == 10
+
+
+def test_prewarm_only_gates_weight_zero(orch):
+    # Once traffic flows the replay ack is history, not a gate.
+    d = orch.decide(obs(green_weight=10, prewarm_requested=True,
+                        prewarm_done=False))
+    assert d.action == STEP and d.green_weight == 20
+
+
+def test_promote_waits_for_drain_until_timeout(orch):
+    waiting = obs(now=100.0, green_weight=100, drain_requested=True,
+                  drain_done=False, drain_started_at=95.0,
+                  drain_timeout_s=30.0)
+    assert orch.decide(waiting).action == WAIT_DRAIN
+    acked = obs(now=101.0, green_weight=100, drain_requested=True,
+                drain_done=True, drain_started_at=95.0,
+                drain_timeout_s=30.0)
+    assert orch.decide(acked).action == PROMOTE
+    expired = obs(now=126.0, green_weight=100, drain_requested=True,
+                  drain_done=False, drain_started_at=95.0,
+                  drain_timeout_s=30.0)
+    assert orch.decide(expired).action == PROMOTE
+
+
+def test_no_drain_requested_promotes_at_100(orch):
+    assert orch.decide(obs(green_weight=100)).action == PROMOTE
+
+
+# ---------------------------------------------------------------------------
+# the timer leg survives inside the closed loop
+# ---------------------------------------------------------------------------
+
+def test_interval_not_elapsed_holds(orch):
+    d = orch.decide(obs(now=100.0, green_weight=20, last_step_time=80.0,
+                        interval_s=30.0))
+    assert d.action == HOLD and d.green_weight == 20
+    assert d.requeue_after == pytest.approx(10.0)
+
+
+def test_step_advances_by_step_size_capped_at_100(orch):
+    d = orch.decide(obs(green_weight=95, step_size=25))
+    assert d.action == STEP and d.green_weight == 100
+
+
+# ---------------------------------------------------------------------------
+# BurnRateGate: green-scoped verdicts over the per-backend series
+# ---------------------------------------------------------------------------
+
+def _attempts(reg, backend, n, errors=0):
+    for _ in range(n):
+        reg.inc("tpu_gateway_backend_attempts_total", {"backend": backend})
+    for _ in range(errors):
+        reg.inc("tpu_gateway_backend_errors_total", {"backend": backend})
+
+
+def test_gate_connect_failures_fire_availability(orch):
+    clock = VirtualClock(start=0.0)
+    reg = MetricsRegistry()
+    gate = BurnRateGate(reg, clock=clock)
+    _attempts(reg, "green-svc", 20)
+    _attempts(reg, "blue-svc", 20)
+    healthy, alert = gate.verdict("green-svc")      # baseline sample
+    assert healthy and alert is None
+
+    clock.advance(10.0)
+    _attempts(reg, "green-svc", 6, errors=6)        # the dead build
+    _attempts(reg, "blue-svc", 6)                   # blue stays clean
+    healthy, alert = gate.verdict("green-svc")
+    assert not healthy
+    assert alert["name"] == "upgrade-green-availability"
+    assert alert["window"] == "fast"
+    # Scoping: blue's own series never trips blue's gate.
+    assert gate.verdict("blue-svc") == (True, None)
+
+
+def test_gate_ttft_breach_fires_latency(orch):
+    clock = VirtualClock(start=0.0)
+    reg = MetricsRegistry()
+    gate = BurnRateGate(reg, clock=clock, ttft_target_s=0.5)
+    for _ in range(8):
+        reg.observe("tpu_gateway_backend_latency_seconds", 0.1,
+                    {"backend": "green-svc"}, buckets=TTFT_BUCKETS)
+    assert gate.verdict("green-svc") == (True, None)
+    clock.advance(10.0)
+    for _ in range(6):
+        reg.observe("tpu_gateway_backend_latency_seconds", 1.5,
+                    {"backend": "green-svc"}, buckets=TTFT_BUCKETS)
+    healthy, alert = gate.verdict("green-svc")
+    assert not healthy and alert["name"] == "upgrade-green-ttft"
+
+
+def test_gate_forget_resets_windows(orch):
+    clock = VirtualClock(start=0.0)
+    reg = MetricsRegistry()
+    gate = BurnRateGate(reg, clock=clock)
+    _attempts(reg, "green-svc", 20)
+    gate.verdict("green-svc")
+    clock.advance(10.0)
+    _attempts(reg, "green-svc", 6, errors=6)
+    assert gate.verdict("green-svc")[0] is False
+    # After promote/abort the engine is dropped: a later upgrade of the
+    # same backend name baselines afresh instead of inheriting the old
+    # firing window.
+    gate.forget("green-svc")
+    assert gate.verdict("green-svc") == (True, None)

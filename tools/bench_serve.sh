@@ -110,3 +110,54 @@ print(f"bench serve trace ok: overhead {ov['overhead_pct']}% "
       f"ttft p99 delta {ov['ttft_p99_delta_ms']} ms, "
       f"{ov['spans_recorded']} spans")
 EOF
+
+# Zero-downtime upgrade gate (docs/upgrades.md): per seed, a blue-only
+# baseline, the burn-rate-gated orchestrator ramp, and the legacy naive
+# timer ramp — both ramps hit a connection-refused fault on the green
+# endpoint mid-upgrade.  The gated ramp must roll back with ZERO
+# client-visible failures and bounded TTFT inflation; the naive ramp
+# demonstrates the failure mode it replaced (promotes the dead build
+# and fails requests).  Full-scale published numbers:
+# benchmark/results/upgrade_r13.json (seeds 0..2, duration 12).
+upgrade_out="${BENCH_UPGRADE_OUT:-/tmp/tpu_bench_serve_upgrade.json}"
+timeout -k 10 600 env JAX_PLATFORMS=cpu python benchmark/serve_bench.py \
+    --upgrade \
+    --seeds "${BENCH_SEEDS:-0}" \
+    --duration "${BENCH_UPGRADE_DURATION:-6}" \
+    --rate-scale "${BENCH_RATE_SCALE:-0.5}" \
+    --json-out "$upgrade_out"
+BENCH_JSON_PATH="$upgrade_out" \
+BENCH_UPGRADE_TTFT_LIMIT="${BENCH_UPGRADE_TTFT_LIMIT:-5}" python - <<'EOF'
+import json, os, sys
+sys.path.insert(0, os.getcwd())
+from benchmark.serve_bench import UPGRADE_LEG_KEYS, UPGRADE_SCHEMA
+doc = json.load(open(os.environ["BENCH_JSON_PATH"]))
+assert doc["schema"] == UPGRADE_SCHEMA, doc.get("schema")
+assert doc["legs"] and doc["comparisons"], "upgrade run produced no legs"
+for leg in doc["legs"]:
+    missing = [k for k in UPGRADE_LEG_KEYS if k not in leg]
+    assert not missing, f"leg missing keys {missing}: {leg}"
+    assert leg["completed"] > 0, f"leg completed nothing: {leg}"
+limit = float(os.environ["BENCH_UPGRADE_TTFT_LIMIT"])
+for cmp in doc["comparisons"]:
+    # The tentpole's gate: the burn-rate-gated ramp survives the
+    # mid-upgrade fault with zero failed requests and bounded TTFT...
+    assert cmp["gated_errors"] == 0, f"gated ramp failed requests: {cmp}"
+    assert cmp["gated_rolled_back"], f"gated ramp never rolled back: {cmp}"
+    assert cmp["ttft_inflation"] is not None and \
+        cmp["ttft_inflation"] < limit, (
+        f"gated TTFT inflation {cmp['ttft_inflation']}x over {limit}x: {cmp}")
+    # ...while the naive timer ramp under the identical fault either
+    # fails requests or serves the bad build (it does both: promotes
+    # the dead green fleet, then every request errors).
+    assert cmp["naive_errors"] > 0 or cmp["naive_promoted_bad_build"], (
+        f"naive ramp showed no failure mode: {cmp}")
+gated = [l for l in doc["legs"] if l["mode"] == "gated"]
+assert all(l["prewarm_replayed"] > 0 for l in gated), \
+    "gated legs never pre-warmed the green fleet"
+print(f"bench serve upgrade ok: {len(doc['comparisons'])} seeds, "
+      f"gated errors 0, "
+      f"naive errors {sum(c['naive_errors'] for c in doc['comparisons'])}, "
+      f"ttft inflation "
+      f"{max(c['ttft_inflation'] for c in doc['comparisons'])}x")
+EOF
