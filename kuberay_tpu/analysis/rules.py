@@ -1243,3 +1243,81 @@ class TrafficWeightThroughGateRule(Rule):
                                 f"{self._SEAM}() so it stays downstream "
                                 "of one orchestrator decision (ring cap "
                                 "+ burn-rate verdict)")
+
+
+# ---------------------------------------------------------------------------
+# 13. capacity-through-quota-seam
+# ---------------------------------------------------------------------------
+
+@rule
+class CapacityThroughQuotaSeamRule(Rule):
+    """Capacity claims must route through the admission seam.  A
+    controller that gates pod creation on gang admission funnels every
+    scheduler consultation through ``_admission_verdict`` — the one
+    place the quota ledger is asked, so the all-or-nothing claim, the
+    PodGroup status write, and the ``tpu_gang_admission_total`` count
+    happen exactly once per reconcile.  A direct
+    ``self.scheduler.on_cluster_submission(...)`` elsewhere in the
+    class is a second unaccounted ask (double audit entries, skewed
+    metrics, and a window where a stale verdict gates creation); a pod
+    create inside ``_reconcile_pods`` that does not sit downstream of
+    the seam is capacity taken without a claim — exactly the partial-
+    gang hole the quota ledger exists to close (the sim's
+    ``quota-gang-atomicity`` checker catches the journal-level symptom;
+    this rule catches the code path before it ships).
+    """
+
+    NAME = "capacity-through-quota-seam"
+    DESCRIPTION = ("classes with an _admission_verdict seam must not "
+                   "consult the scheduler or create pods around it")
+    INVARIANT = ("every capacity claim flows through one "
+                 "_admission_verdict call per reconcile, upstream of "
+                 "every pod create")
+
+    _SEAM = "_admission_verdict"
+    _RECONCILE = "_reconcile_pods"
+    _ASKS = ("on_cluster_submission", "on_job_submission")
+    _CREATES = ("_create_pod", "build_head_pod", "build_slice_pods")
+
+    def check(self, tree: ast.Module, ctx: FileContext) -> Iterable[Finding]:
+        for cls in iter_classes(tree):
+            methods = {n.name: n for n in cls.body
+                       if isinstance(n, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))}
+            if self._SEAM not in methods:
+                continue
+            for mname, fn in methods.items():
+                if mname == self._SEAM:
+                    continue
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Call) and \
+                            dotted(node.func).endswith(
+                                tuple(f"scheduler.{a}" for a in self._ASKS)):
+                        yield self.finding(
+                            ctx, node,
+                            f"'{cls.name}.{mname}' consults the scheduler "
+                            f"directly; route the ask through "
+                            f"{self._SEAM}() so the quota claim, PodGroup "
+                            "status, and admission counter stay "
+                            "one-per-reconcile")
+            recon = methods.get(self._RECONCILE)
+            if recon is None:
+                continue  # e.g. the cron controller: seam, no pod loop
+            seam_lines = [n.lineno for n in ast.walk(recon)
+                          if isinstance(n, ast.Call)
+                          and dotted(n.func) == f"self.{self._SEAM}"]
+            first_ask = min(seam_lines) if seam_lines else None
+            for node in ast.walk(recon):
+                if not isinstance(node, ast.Call):
+                    continue
+                target = dotted(node.func)
+                if target in self._CREATES or \
+                        target in tuple(f"self.{c}" for c in self._CREATES):
+                    if first_ask is None or node.lineno < first_ask:
+                        yield self.finding(
+                            ctx, node,
+                            f"'{cls.name}.{self._RECONCILE}' creates pods "
+                            f"with no earlier {self._SEAM}() call; gate "
+                            "every create on the admitted verdict so no "
+                            "gang is ever partially materialized without "
+                            "a quota claim")

@@ -1,0 +1,121 @@
+"""QuotaPool: hierarchical multi-tenant chip budgets for gang admission.
+
+Reference capability: the L0 queueing systems the survey names (Volcano
+queues, YuniKorn hierarchical queues, Kueue ClusterQueue/LocalQueue
+borrowing) — rebuilt TPU-native.  One QuotaPool describes the cluster's
+chip capacity and a tenant -> queue tree of guaranteed / borrowable /
+ceiling budgets, all denominated in **chips** because on TPU the atomic
+schedulable unit is a whole slice and a gang's chip demand is fully
+determined by its (accelerator, topology, replicas) shape.
+
+Semantics (enforced by ``controlplane/quota.py``, documented in
+``docs/scheduling.md``):
+
+- ``guaranteedChips``: capacity a queue can always claim; admission
+  within guarantee may reclaim borrowed capacity from other queues.
+- ``ceilingChips``: hard upper bound for the queue (0 = pool total).
+- ``borrowable``: whether the queue may exceed its guarantee by
+  borrowing idle capacity (borrowed capacity is reclaimable).
+- ``starvationBoundSeconds``: any gang pending longer escalates to the
+  front of its queue with a borrowed-capacity override.
+- ``reclaimNoticeSeconds``: the advance warning an evicted borrower
+  receives (the eviction fires the notice->drain->checkpoint path, so
+  elastic jobs shrink before they die).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List
+
+from kuberay_tpu.api.common import ObjectMeta, Serializable
+
+KIND_QUOTA_POOL = "QuotaPool"
+
+
+@dataclasses.dataclass
+class QuotaQueue(Serializable):
+    name: str = "default"
+    guaranteedChips: int = 0       # always-claimable share
+    ceilingChips: int = 0          # hard cap; 0 = pool total
+    borrowable: bool = True        # may exceed guarantee on idle capacity
+
+
+@dataclasses.dataclass
+class QuotaTenant(Serializable):
+    name: str = ""
+    queues: List[QuotaQueue] = dataclasses.field(default_factory=list)
+
+    @classmethod
+    def _nested_types(cls):
+        return {"queues": QuotaQueue}
+
+
+@dataclasses.dataclass
+class QuotaPoolSpec(Serializable):
+    totalChips: int = 0                    # pool-wide physical capacity
+    starvationBoundSeconds: float = 300.0  # pending-age escalation bound
+    reclaimNoticeSeconds: float = 30.0     # eviction advance warning
+    tenants: List[QuotaTenant] = dataclasses.field(default_factory=list)
+
+    @classmethod
+    def _nested_types(cls):
+        return {"tenants": QuotaTenant}
+
+
+@dataclasses.dataclass
+class QuotaPoolStatus(Serializable):
+    claimedChips: int = 0
+    pendingGangs: int = 0
+    conditions: List[Dict[str, str]] = dataclasses.field(
+        default_factory=list)
+
+
+@dataclasses.dataclass
+class QuotaPool(Serializable):
+    apiVersion: str = "tpu.dev/v1"
+    kind: str = KIND_QUOTA_POOL
+    metadata: ObjectMeta = dataclasses.field(default_factory=ObjectMeta)
+    spec: QuotaPoolSpec = dataclasses.field(default_factory=QuotaPoolSpec)
+    status: QuotaPoolStatus = dataclasses.field(
+        default_factory=QuotaPoolStatus)
+
+    @classmethod
+    def _nested_types(cls):
+        return {"metadata": ObjectMeta, "spec": QuotaPoolSpec,
+                "status": QuotaPoolStatus}
+
+
+def validate_quota_pool(pool: QuotaPool) -> List[str]:
+    errs: List[str] = []
+    if not pool.metadata.name:
+        errs.append("metadata.name is required")
+    if pool.spec.totalChips <= 0:
+        errs.append("spec.totalChips must be > 0")
+    if pool.spec.starvationBoundSeconds <= 0:
+        errs.append("spec.starvationBoundSeconds must be > 0")
+    if pool.spec.reclaimNoticeSeconds < 0:
+        errs.append("spec.reclaimNoticeSeconds must be >= 0")
+    seen = set()
+    for t in pool.spec.tenants:
+        if not t.name:
+            errs.append("tenant name is required")
+        for q in t.queues:
+            key = (t.name, q.name)
+            if key in seen:
+                errs.append(f"duplicate queue {t.name}/{q.name}")
+            seen.add(key)
+            if q.guaranteedChips < 0:
+                errs.append(f"{t.name}/{q.name}: guaranteedChips < 0")
+            if q.ceilingChips < 0:
+                errs.append(f"{t.name}/{q.name}: ceilingChips < 0")
+            if q.ceilingChips and q.guaranteedChips > q.ceilingChips:
+                errs.append(f"{t.name}/{q.name}: guaranteed > ceiling")
+            if q.ceilingChips > pool.spec.totalChips:
+                errs.append(f"{t.name}/{q.name}: ceiling > totalChips")
+    total_guaranteed = sum(q.guaranteedChips for t in pool.spec.tenants
+                           for q in t.queues)
+    if total_guaranteed > pool.spec.totalChips:
+        errs.append(f"sum of guaranteedChips ({total_guaranteed}) exceeds "
+                    f"totalChips ({pool.spec.totalChips})")
+    return errs

@@ -47,6 +47,7 @@ class ClusterConditionType:
     REPLICA_FAILURE = "ReplicaFailure"
     SUSPENDING = "TpuClusterSuspending"
     SUSPENDED = "TpuClusterSuspended"
+    GANG_ADMITTED = "GangAdmitted"             # quota/capacity verdict
 
 
 class UpgradeStrategyType:
@@ -184,6 +185,10 @@ class TpuClusterSpec(Serializable):
     # Gang scheduler selection (ref batchscheduler labels):
     schedulerName: str = ""
     gangSchedulingQueue: str = ""
+    # Multi-tenant quota identity (controlplane/quota.py): empty tenant
+    # bypasses the QuotaPool ledger; higher priority wins reclaim ties.
+    tenant: str = ""
+    priority: int = 0
 
     @classmethod
     def _nested_types(cls):

@@ -140,6 +140,9 @@ class TpuJobSpec(Serializable):
     managedBy: str = ""
     schedulerName: str = ""
     gangSchedulingQueue: str = ""
+    # Multi-tenant quota identity, forwarded onto the created cluster:
+    tenant: str = ""
+    priority: int = 0
 
     @classmethod
     def _nested_types(cls):

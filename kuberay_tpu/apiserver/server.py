@@ -73,6 +73,7 @@ class ApiHandler(JsonHandler):
     autoscaler = None                   # autoscaler.DecisionAudit (optional)
     alerts = None                       # obs.AlertEngine (optional)
     steps = None                        # obs.StepTracker (optional)
+    quota = None                        # controlplane.QuotaManager (optional)
 
     def _error(self, code: int, message: str, reason: str = ""):
         self._send(code, {"kind": "Status", "status": "Failure",
@@ -284,6 +285,15 @@ class ApiHandler(JsonHandler):
             return self._error(404, "autoscaler audit not enabled")
         return self._send(200, {"decisions": self.autoscaler.to_list()})
 
+    def _debug_quota(self):
+        """QuotaManager ledger: pools, per-gang claims, pending gangs
+        (escalation state included), and the bounded last-N admission
+        decision ring (newest first).  404 when the operator runs
+        without a quota manager."""
+        if self.quota is None:
+            return self._error(404, "quota manager not enabled")
+        return self._send(200, self.quota.debug_snapshot())
+
     def _debug_alerts(self):
         """SLO burn-rate alerts (obs/alerts.py): currently-firing alerts,
         the bounded fired/resolved history ring, and the spec catalog.
@@ -470,6 +480,8 @@ class ApiHandler(JsonHandler):
             return self._debug_autoscaler()
         if path == "/debug/alerts":
             return self._debug_alerts()
+        if path == "/debug/quota":
+            return self._debug_quota()
         if path.startswith("/api/history/") and self.history is not None:
             r = self.history.route(self.path)
             if r is not None:
@@ -683,7 +695,7 @@ def make_server(store: ObjectStore, host: str = "127.0.0.1", port: int = 0,
                 history=None, tracer=None,
                 flight=None, goodput=None,
                 autoscaler=None, alerts=None,
-                steps=None) -> ThreadingHTTPServer:
+                steps=None, quota=None) -> ThreadingHTTPServer:
     """``token`` enables bearer auth on every API verb; ``certfile``/
     ``keyfile`` serve TLS (the authenticated-cluster-endpoint stand-in
     RestObjectStore's client auth is tested against).  ``history``: a
@@ -700,7 +712,7 @@ def make_server(store: ObjectStore, host: str = "127.0.0.1", port: int = 0,
                     "history": history, "tracer": tracer,
                     "flight": flight, "goodput": goodput,
                     "autoscaler": autoscaler, "alerts": alerts,
-                    "steps": steps})
+                    "steps": steps, "quota": quota})
     if certfile:
         import ssl
         ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
@@ -719,12 +731,13 @@ def serve_background(store: ObjectStore, host: str = "127.0.0.1",
                      certfile: Optional[str] = None,
                      keyfile: Optional[str] = None, history=None,
                      tracer=None, flight=None, goodput=None,
-                     autoscaler=None, alerts=None, steps=None):
+                     autoscaler=None, alerts=None, steps=None, quota=None):
     """Start in a daemon thread; returns (server, base_url)."""
     srv = make_server(store, host, port, metrics, token=token,
                       certfile=certfile, keyfile=keyfile, history=history,
                       tracer=tracer, flight=flight, goodput=goodput,
-                      autoscaler=autoscaler, alerts=alerts, steps=steps)
+                      autoscaler=autoscaler, alerts=alerts, steps=steps,
+                      quota=quota)
     t = threading.Thread(target=srv.serve_forever, daemon=True,
                          name="tpu-apiserver")
     t.start()

@@ -42,6 +42,7 @@ from kuberay_tpu.builders.service import (
     needs_headless_service,
 )
 from kuberay_tpu.controlplane.events import EventRecorder
+from kuberay_tpu.controlplane.quota import QuotaVerdict
 from kuberay_tpu.controlplane.expectations import HEAD_GROUP, ScaleExpectations
 from kuberay_tpu.controlplane.store import (AlreadyExists, Conflict,
                                              NotFound, ObjectStore,
@@ -372,11 +373,17 @@ class TpuClusterController:
                 return 1.0
 
         # Gang admission (ref DoBatchSchedulingOnSubmission :963-971): the
-        # plugin reserves capacity for the whole cluster before pods appear.
-        if self.scheduler is not None:
-            admitted = self.scheduler.on_cluster_submission(cluster.to_dict())
-            if not admitted:
-                return 5.0
+        # scheduler's quota/capacity oracle reserves the whole cluster
+        # before any pod exists; every create below is gated on the
+        # admitted verdict (analysis rule #13 capacity-through-quota-seam).
+        verdict = self._admission_verdict(cluster)
+        if verdict is not None:
+            if not verdict:
+                return self._hold_for_admission(cluster, pods, verdict)
+            set_condition(cluster.status.conditions, Condition(
+                type=ClusterConditionType.GANG_ADMITTED, status="True",
+                reason="Admitted",
+                observedGeneration=cluster.metadata.generation))
 
         requeue = None
         live = [p for p in pods if not pod_deleting(p)]
@@ -410,6 +417,64 @@ class TpuClusterController:
             r = self._reconcile_worker_group(cluster, group, thash, live, raw)
             requeue = min(r, requeue) if (r and requeue) else (r or requeue)
         return requeue
+
+    def _admission_verdict(self, cluster: TpuCluster):
+        """THE capacity seam (analysis rule #13): the only place the
+        controller consults the gang scheduler's quota/capacity oracle.
+        ``None`` means no scheduler is mounted (admission-free mode);
+        plain-bool oracles from external scheduler adapters are
+        normalized to a QuotaVerdict."""
+        if self.scheduler is None:
+            return None
+        verdict = self.scheduler.on_cluster_submission(cluster.to_dict())
+        if isinstance(verdict, QuotaVerdict):
+            return verdict
+        return QuotaVerdict(bool(verdict),
+                            reason="" if verdict else "capacity-hold")
+
+    def _hold_for_admission(self, cluster: TpuCluster,
+                            pods: List[Dict[str, Any]],
+                            verdict) -> float:
+        """Denied verdict: surface it (condition + event — the
+        scheduler already counted it in tpu_gang_admission_total) and
+        requeue.  ``evict`` means quota reclaim outran the notice
+        window: tear the whole gang down through the drain seam so the
+        gang stays 0-or-full (eviction is a warned preemption — the
+        notices were stamped when reclaim fired, so draining here
+        acks checkpoints, never ambushes them)."""
+        reason = verdict.reason or "capacity-hold"
+        changed = set_condition(cluster.status.conditions, Condition(
+            type=ClusterConditionType.GANG_ADMITTED, status="False",
+            reason="QuotaEvicting" if verdict.evict else "QuotaHeld",
+            message=reason,
+            observedGeneration=cluster.metadata.generation))
+        if changed:
+            self.recorder.warning(
+                cluster.to_dict(), C.EVENT_QUOTA_HELD,
+                f"gang admission denied: {reason}")
+        if not verdict.evict:
+            return 5.0
+        # Re-read: the admission call itself may have just (re)stamped
+        # preemption notices (QuotaManager level-triggers expired
+        # reclaims), and the caller's list predates that write — a
+        # stale view here would skip the drain and ambush the pods.
+        pods = self._cluster_pods(cluster)
+        live = [p for p in pods if not pod_deleting(p)]
+        if not self._drain_noticed(cluster, live):
+            return 1.0
+        for group in cluster.spec.workerGroupSpecs:
+            slices = self._group_pods_by_slice(live, group)
+            for idx in sorted(slices):
+                self._delete_slice(cluster, slices[idx], group.groupName)
+        for p in live:
+            if p["metadata"]["labels"].get(
+                    C.LABEL_NODE_TYPE) == C.NODE_TYPE_HEAD:
+                self._delete_pod(p)
+        if live:
+            self.recorder.warning(
+                cluster.to_dict(), C.EVENT_QUOTA_EVICTED,
+                f"quota reclaim evicted the gang: {reason}")
+        return 1.0
 
     def _group_pods_by_slice(self, pods: List[Dict[str, Any]],
                              group: WorkerGroupSpec

@@ -32,11 +32,15 @@ class TpuCronJobController:
 
     def __init__(self, store: ObjectStore,
                  recorder: Optional[EventRecorder] = None,
-                 tracer=None):
+                 tracer=None, scheduler=None):
         self.store = store
         self.recorder = recorder or EventRecorder(store)
         # Span annotations — no-op by default, passed like ``metrics``.
         self.tracer = tracer or NOOP_TRACER
+        # Gang scheduler: a due run is only launched when its prospective
+        # job would clear quota admission (deadline fleets under
+        # contention hold as catch-up instead of piling on denied jobs).
+        self.scheduler = scheduler
 
     def reconcile(self, name: str, namespace: str = "default") -> Optional[float]:
         raw = self.store.try_get(self.KIND, name, namespace)
@@ -85,8 +89,17 @@ class TpuCronJobController:
                     self.recorder.warning(
                         cron.to_dict(), "MissedRuns",
                         f"{len(due) - 1} scheduled runs were missed")
-                if self._launch(cron, due[-1]):
+                outcome = self._launch(cron, due[-1])
+                if outcome == "launched":
                     cron.status.lastScheduleTime = due[-1]
+                elif outcome == "quota-held":
+                    # Keep lastScheduleTime so the run fires as catch-up
+                    # once quota clears (the pending gang is tracked by
+                    # the QuotaManager's starvation guard), bounded by
+                    # startingDeadlineSeconds like any miss.
+                    self._prune_history(cron)
+                    self._update_status(cron)
+                    return 5.0
                 # Forbid-skipped runs keep lastScheduleTime so the run still
                 # fires once the active job finishes (standard CronJob
                 # behavior), bounded by startingDeadlineSeconds.
@@ -128,14 +141,17 @@ class TpuCronJobController:
                 active.append(jname)
         cron.status.activeJobNames = active
 
-    def _launch(self, cron: TpuCronJob, scheduled: float) -> bool:
-        """Returns True when a job was launched (or already exists)."""
+    def _launch(self, cron: TpuCronJob, scheduled: float) -> str:
+        """-> ``"launched"`` (job created or already exists),
+        ``"skipped"`` (concurrency policy), or ``"quota-held"``
+        (prospective job would be denied admission; caller keeps
+        lastScheduleTime for catch-up)."""
         policy = cron.spec.concurrencyPolicy
         if cron.status.activeJobNames:
             if policy == ConcurrencyPolicy.FORBID:
                 self.recorder.normal(cron.to_dict(), "SkippedRun",
                                      "previous run still active (Forbid)")
-                return False
+                return "skipped"
             if policy == ConcurrencyPolicy.REPLACE:
                 for jname in cron.status.activeJobNames:
                     try:
@@ -162,6 +178,13 @@ class TpuCronJobController:
             "spec": cron.spec.jobTemplate.to_dict(),
             "status": {},
         }
+        verdict = self._admission_verdict(job)
+        if verdict is not None and not verdict:
+            reason = getattr(verdict, "reason", "") or "capacity-hold"
+            self.recorder.normal(
+                cron.to_dict(), C.EVENT_QUOTA_HELD,
+                f"deferring scheduled run {jname}: {reason}")
+            return "quota-held"
         try:
             self.store.create(job)
             cron.status.activeJobNames.append(jname)
@@ -169,7 +192,25 @@ class TpuCronJobController:
                                  f"launched {jname}")
         except AlreadyExists:
             pass
-        return True
+        return "launched"
+
+    def _admission_verdict(self, job):
+        """THE capacity seam (analysis rule #13) for cron launches: the
+        prospective job is probed against the QuotaManager *ledger*
+        directly (no PodGroup side effects for runs that never fire);
+        admission reserves the claim the launched job then re-asserts
+        idempotently.  ``None`` when no quota-backed scheduler is
+        mounted — oracle-only schedulers gate the job itself in
+        Initializing instead."""
+        quota = getattr(self.scheduler, "quota", None)
+        if quota is None:
+            return None
+        from kuberay_tpu.controlplane.quota import (build_demand,
+                                                    job_pseudo_cluster)
+        pseudo = job_pseudo_cluster(job)
+        if pseudo is None:
+            return None
+        return quota.admit(build_demand(pseudo))
 
     def _prune_history(self, cron: TpuCronJob):
         ns = cron.metadata.namespace

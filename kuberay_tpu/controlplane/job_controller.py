@@ -166,9 +166,17 @@ class TpuJobController:
             return self._fail(job, JobFailedReason.DEADLINE_EXCEEDED,
                               "did not reach Running before preRunningDeadlineSeconds")
 
-        # Gang reservation before any pod exists (ref :192-200).
+        # Gang reservation before any pod exists (ref :192-200).  The
+        # quota verdict's reason lands in status.message so "why is my
+        # job Initializing" is answerable from the CR; the scheduler
+        # counts the denial in tpu_gang_admission_total (the hold-off
+        # requeue's observability evidence).
         if self.scheduler is not None and job.spec.clusterSpec is not None:
-            if not self.scheduler.on_job_submission(job.to_dict()):
+            verdict = self.scheduler.on_job_submission(job.to_dict())
+            if not verdict:
+                reason = getattr(verdict, "reason", "") or "capacity-hold"
+                self._set_message(job, f"gang admission held: {reason}")
+                self._update(job)
                 return 5.0
 
         cluster = self._get_or_create_cluster(job)
@@ -508,6 +516,10 @@ class TpuJobController:
             obj["spec"]["schedulerName"] = job.spec.schedulerName
         if job.spec.gangSchedulingQueue:
             obj["spec"]["gangSchedulingQueue"] = job.spec.gangSchedulingQueue
+        if job.spec.tenant:
+            obj["spec"]["tenant"] = job.spec.tenant
+        if job.spec.priority:
+            obj["spec"]["priority"] = job.spec.priority
         try:
             self.store.create(obj)
         except AlreadyExists:

@@ -1,0 +1,678 @@
+"""Hierarchical multi-tenant quota: THE capacity oracle for gang admission.
+
+The survey's L0 queueing layer (Volcano queues, YuniKorn hierarchies,
+Kueue borrowing) rebuilt TPU-native: every TpuCluster / TpuJob /
+TpuService capacity claim flows through one ``admit()`` / ``release()``
+seam, all-or-nothing per gang, denominated in chips because the atomic
+schedulable unit is a whole slice.
+
+Model (config = ``api/quotapool.py``; semantics in docs/scheduling.md):
+
+- A **claim** is the full chip demand of one gang (head + every slice).
+  There is no partial admission: a gang is either fully claimed or fully
+  pending, so the sim invariant "no gang ever partially admitted" is a
+  property of this ledger, not of pod-level luck.
+- A queue may **borrow** idle capacity beyond its guarantee (up to its
+  ceiling).  Borrowed capacity is a loan: when a guaranteed-backed
+  request (or an escalated starving one) cannot fit, the manager
+  **reclaims** from the lowest-priority borrowers — youngest first
+  within a priority tie, which makes the tie deterministic and journal-
+  stable under the seeded sim.
+- Eviction is a *warned* preemption: the preemptor stamps PR 10's
+  ``tpu.dev/preemption-notice`` on the victim's live pods, which fires
+  the notice -> drain -> checkpoint path inside the controllers.  During
+  the notice window the victim stays admitted (``reclaim-notice``) so an
+  elastic job can shrink below its reclaim target and cancel the
+  eviction entirely — elastic jobs shrink before they die.  Only after
+  the window does the verdict flip to denied-with-``evict`` and the
+  owning controller tears the gang down through the drain seam.
+- **Starvation guard**: any gang pending past the pool's bound escalates
+  to the front of its queue — it gets a capacity *reservation* (later
+  *borrowers* cannot take the chips it is waiting for; admission within
+  a guarantee is a pre-sold contract and never queues behind anyone)
+  plus a borrowed-capacity override (it may exceed its guarantee even
+  in a non-borrowable queue, reclaiming from strictly-lower-priority
+  borrowers).  Reservations are ordered by pending age so two escalated
+  gangs cannot deadlock each other.
+
+Thread-safety: one lock guards the ledger (``_claims`` / ``_pending`` /
+``_seq`` / ``_audit`` / ``_last_reason``); the injected clock keeps the
+sim and the benchmark deterministic.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from kuberay_tpu.api.quotapool import QuotaPool, QuotaQueue
+from kuberay_tpu.controlplane.store import Conflict, NotFound, ObjectStore
+from kuberay_tpu.utils import constants as C
+
+DEFAULT_QUEUE = "default"
+
+ClaimKey = Tuple[str, str, str]  # (kind, namespace, workload name)
+
+
+def claim_key(obj: Dict[str, Any]) -> ClaimKey:
+    """Stable ledger key for a workload.
+
+    A TpuJob and the TpuCluster it creates are ONE claim: clusters whose
+    originated-from labels point at a TpuJob resolve to the job's key, so
+    the job-level admission check and the cluster-level one never double
+    count.  Service-managed and standalone clusters claim per cluster
+    (a blue/green upgrade correctly needs both colors through quota).
+    """
+    md = obj.get("metadata", {})
+    ns = md.get("namespace", "default")
+    labels = md.get("labels", {}) or {}
+    if labels.get(C.LABEL_ORIGINATED_FROM_CRD) == C.KIND_JOB and \
+            labels.get(C.LABEL_ORIGINATED_FROM_CR_NAME):
+        return (C.KIND_JOB, ns, labels[C.LABEL_ORIGINATED_FROM_CR_NAME])
+    return (obj.get("kind", C.KIND_CLUSTER), ns, md.get("name", ""))
+
+
+def build_demand(obj: Dict[str, Any]) -> Dict[str, Any]:
+    """Rich gang demand: chip quantum + tenant/queue/priority identity."""
+    from kuberay_tpu.scheduler.interface import total_cluster_demand
+
+    demand = total_cluster_demand(obj)
+    spec = obj.get("spec", {}) or {}
+    md = obj.get("metadata", {})
+    demand.update({
+        "kind": obj.get("kind", C.KIND_CLUSTER),
+        "namespace": md.get("namespace", "default"),
+        "name": md.get("name", ""),
+        "tenant": spec.get("tenant", "") or "",
+        "queue": spec.get("gangSchedulingQueue", "") or DEFAULT_QUEUE,
+        "priority": int(spec.get("priority", 0) or 0),
+        "key": claim_key(obj),
+    })
+    return demand
+
+
+def job_pseudo_cluster(job: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """A TpuJob viewed as the cluster it will create, for admission
+    purposes: the embedded clusterSpec with the job-level quota identity
+    (tenant / priority / queue) overlaid — mirroring the job
+    controller's spec forwarding.  ``None`` when the job brings no
+    clusterSpec (clusterSelector mode claims nothing new)."""
+    spec = job.get("spec", {}).get("clusterSpec")
+    if not spec:
+        return None
+    pseudo_spec = dict(spec)
+    jspec = job.get("spec", {})
+    for field in ("tenant", "gangSchedulingQueue"):
+        if jspec.get(field):
+            pseudo_spec[field] = jspec[field]
+    if jspec.get("priority"):
+        pseudo_spec["priority"] = jspec["priority"]
+    return {"metadata": job["metadata"], "kind": C.KIND_JOB,
+            "spec": pseudo_spec}
+
+
+@dataclasses.dataclass
+class QuotaVerdict:
+    """Admission outcome.  Truthy iff admitted, so plain-bool call sites
+    (``if not scheduler.on_cluster_submission(...)``) keep working."""
+
+    admitted: bool = True
+    reason: str = ""
+    evict: bool = False       # denied AND the holder must tear down now
+    tenant: str = ""
+    queue: str = ""
+    escalated: bool = False   # starvation override active for this gang
+    chips: int = 0
+
+    def __bool__(self) -> bool:
+        return self.admitted
+
+
+class QuotaManager:
+    """Tenant -> queue chip-budget ledger behind the gang-admission seam.
+
+    ``preemptor(victim_claim, deadline)`` overrides how reclaim warns a
+    victim; the default stamps ``tpu.dev/preemption-notice`` on the
+    victim's live pods so the controllers' drain seam takes over.
+    """
+
+    def __init__(self, store: ObjectStore, *, metrics=None,
+                 clock: Callable[[], float] = time.time,
+                 preemptor: Optional[Callable[[Dict[str, Any], float],
+                                              None]] = None,
+                 audit_capacity: int = 256):
+        self.store = store
+        self.metrics = metrics
+        self._clock = clock
+        self._preemptor = preemptor or self._default_preemptor
+        self._lock = threading.Lock()
+        self._claims: Dict[ClaimKey, Dict[str, Any]] = {}
+        self._pending: Dict[ClaimKey, Dict[str, Any]] = {}
+        self._audit: "deque[Dict[str, Any]]" = deque(maxlen=audit_capacity)
+        self._last_reason: Dict[ClaimKey, str] = {}
+        self._seq = 0  # arrival order; breaks priority ties deterministically
+
+    # --- public seam ---------------------------------------------------------
+
+    def admit(self, demand: Dict[str, Any]) -> QuotaVerdict:
+        """All-or-nothing admission for one gang's full chip demand.
+
+        Level-triggered: controllers re-ask on every reconcile, so every
+        path is idempotent and audit entries only record *changes*.
+        """
+        now = self._clock()
+        with self._lock:
+            return self._admit_locked(dict(demand), now)
+
+    def release(self, obj: Dict[str, Any]) -> None:
+        """Drop the workload's claim (CR finished or deleted)."""
+        key = obj.get("key") if isinstance(obj.get("key"), tuple) \
+            else claim_key(obj)
+        now = self._clock()
+        with self._lock:
+            claim = self._claims.pop(key, None)
+            pending = self._pending.pop(key, None)
+            self._last_reason.pop(key, None)
+            if claim is not None or pending is not None:
+                src = claim or pending
+                self._record_locked(now, key, src, "released",
+                                    admitted=False, evict=False)
+                self._publish_locked(src.get("namespace", key[1]))
+
+    def debug_snapshot(self) -> Dict[str, Any]:
+        """Served at ``/debug/quota``: pools + ledger + last-N decisions."""
+        with self._lock:
+            claims = [dict(c) for c in self._claims.values()]
+            pending = [dict(p) for p in self._pending.values()]
+            decisions = list(self._audit)[::-1]
+        for c in claims + pending:
+            c["key"] = list(c["key"])
+        pools = []
+        for p in self.store.list(C.KIND_QUOTA_POOL):
+            pools.append({"namespace": p["metadata"].get("namespace"),
+                          "name": p["metadata"].get("name"),
+                          "spec": p.get("spec", {})})
+        claims.sort(key=lambda c: tuple(c["key"]))
+        pending.sort(key=lambda p: (p["since"], p["seq"]))
+        return {"pools": pools, "claims": claims, "pending": pending,
+                "decisions": decisions}
+
+    # --- admission core (all under self._lock) -------------------------------
+
+    def _admit_locked(self, demand: Dict[str, Any],
+                      now: float) -> QuotaVerdict:
+        ns = demand.get("namespace", "default")
+        chips = int(demand.get("tpuChips", demand.get("chips", 0)))
+        demand.setdefault("chips", chips)
+        key = demand.get("key") or (demand.get("kind", C.KIND_CLUSTER), ns,
+                                    demand.get("name", ""))
+        demand["key"] = key
+        pool = self._resolve_pool(ns)
+        if pool is None:
+            return QuotaVerdict(True, reason="no-quota-pool")
+        tenant = demand.get("tenant", "")
+        if not tenant:
+            # Quota is opt-in per workload: untenanted gangs bypass the
+            # ledger entirely (and never hold chips against any queue).
+            return QuotaVerdict(True, reason="untenanted")
+        queue = demand.get("queue") or DEFAULT_QUEUE
+        qcfg = self._queue_config(pool, tenant, queue)
+        if qcfg is None:
+            # Config error, not contention: no pending entry (it could
+            # never be satisfied, so the starvation guard must not see it).
+            return self._deny_locked(now, pool, demand, qcfg,
+                                     "unknown-tenant-or-queue",
+                                     pending=False)
+
+        self._gc_pending_locked(now, pool)
+        self._nudge_expired_locked(now, pool)
+        claim = self._claims.get(key)
+        if claim is not None and claim["evicting"]:
+            return self._admit_evicting_locked(now, pool, demand, qcfg,
+                                               claim)
+        if claim is not None:
+            return self._admit_resize_locked(now, pool, demand, qcfg, claim)
+        return self._admit_fresh_locked(now, pool, demand, qcfg)
+
+    def _admit_fresh_locked(self, now: float, pool: QuotaPool,
+                            demand: Dict[str, Any],
+                            qcfg: QuotaQueue) -> QuotaVerdict:
+        tenant, queue = demand["tenant"], demand["queue"]
+        chips = demand["chips"]
+        key = demand["key"]
+        escalated = self._pending.get(key, {}).get("escalated", False)
+        ok, reason, shortfall, satisfiable, within_guaranteed = \
+            self._admissible_locked(pool, qcfg, tenant, queue, chips,
+                                    escalated, key)
+        if ok:
+            guaranteed_left = max(
+                0, qcfg.guaranteedChips - self._used_locked(tenant, queue,
+                                                            exclude=key))
+            self._seq += 1
+            self._claims[key] = {
+                "key": key, "kind": demand.get("kind", C.KIND_CLUSTER),
+                "namespace": demand.get("namespace", "default"),
+                "name": demand.get("name", ""),
+                "tenant": tenant, "queue": queue,
+                "priority": demand.get("priority", 0),
+                "chips": chips, "members": demand.get("minMember", 0),
+                "seq": self._seq,
+                "borrowed": max(0, chips - guaranteed_left),
+                "evicting": False, "evicting_since": 0.0,
+                "reclaim_target": 0,
+            }
+            self._pending.pop(key, None)
+            verdict = QuotaVerdict(True, reason="admitted", tenant=tenant,
+                                   queue=queue, escalated=escalated,
+                                   chips=chips)
+            self._record_locked(now, key, demand, "admitted", admitted=True,
+                               evict=False, escalated=escalated)
+            self._count_locked(queue, "admitted")
+            self._publish_locked(demand.get("namespace", "default"))
+            return verdict
+        if not satisfiable:
+            # Larger than the queue ceiling / the pool itself: reject
+            # outright, never pending (it would "starve" forever).
+            return self._deny_locked(now, pool, demand, qcfg, reason,
+                                     pending=False)
+        verdict = self._deny_locked(now, pool, demand, qcfg, reason,
+                                    pending=True,
+                                    guaranteed_backed=within_guaranteed)
+        if shortfall > 0 and (within_guaranteed or verdict.escalated):
+            self._reclaim_locked(now, pool, demand, shortfall,
+                                 escalated_only=not within_guaranteed)
+        return verdict
+
+    def _admit_resize_locked(self, now: float, pool: QuotaPool,
+                             demand: Dict[str, Any], qcfg: QuotaQueue,
+                             claim: Dict[str, Any]) -> QuotaVerdict:
+        tenant, queue = claim["tenant"], claim["queue"]
+        chips = demand["chips"]
+        if chips == claim["chips"]:
+            return QuotaVerdict(True, reason="already-admitted",
+                                tenant=tenant, queue=queue, chips=chips)
+        if chips < claim["chips"]:
+            freed = claim["chips"] - chips
+            claim["chips"] = chips
+            claim["borrowed"] = max(0, claim["borrowed"] - freed)
+            claim["priority"] = demand.get("priority", claim["priority"])
+            self._record_locked(now, claim["key"], claim, "resized-shrink",
+                               admitted=True, evict=False)
+            self._count_locked(queue, "resized")
+            self._publish_locked(claim["namespace"])
+            return QuotaVerdict(True, reason="resized-shrink", tenant=tenant,
+                                queue=queue, chips=chips)
+        # Grow: the delta is a fresh admission decision.
+        delta = chips - claim["chips"]
+        escalated = self._pending.get(claim["key"], {}).get("escalated",
+                                                            False)
+        ok, reason, shortfall, satisfiable, wg = self._admissible_locked(
+            pool, qcfg, tenant, queue, delta, escalated, claim["key"],
+            base=claim["chips"])
+        if not ok:
+            verdict = self._deny_locked(now, pool, demand, qcfg,
+                                        f"grow-denied:{reason}",
+                                        pending=satisfiable,
+                                        guaranteed_backed=wg)
+            if shortfall > 0 and (wg or verdict.escalated):
+                self._reclaim_locked(now, pool, demand, shortfall,
+                                     escalated_only=not wg)
+            return verdict
+        guaranteed_left = max(
+            0, qcfg.guaranteedChips - self._used_locked(tenant, queue))
+        claim["chips"] = chips
+        claim["borrowed"] += max(0, delta - guaranteed_left)
+        claim["priority"] = demand.get("priority", claim["priority"])
+        self._pending.pop(claim["key"], None)
+        self._record_locked(now, claim["key"], claim, "resized-grow",
+                           admitted=True, evict=False)
+        self._count_locked(queue, "resized")
+        self._publish_locked(claim["namespace"])
+        return QuotaVerdict(True, reason="resized-grow", tenant=tenant,
+                            queue=queue, chips=chips)
+
+    def _admit_evicting_locked(self, now: float, pool: QuotaPool,
+                               demand: Dict[str, Any], qcfg: QuotaQueue,
+                               claim: Dict[str, Any]) -> QuotaVerdict:
+        tenant, queue = claim["tenant"], claim["queue"]
+        chips = demand["chips"]
+        if chips < claim["chips"]:
+            # The elastic shrink path: give back what it no longer needs.
+            freed = claim["chips"] - chips
+            claim["chips"] = chips
+            claim["borrowed"] = max(0, claim["borrowed"] - freed)
+            if chips <= claim["reclaim_target"]:
+                # Shrink satisfied the reclaim — eviction cancelled.
+                claim["evicting"] = False
+                claim["evicting_since"] = 0.0
+                claim["reclaim_target"] = 0
+                self._record_locked(now, claim["key"], claim,
+                                    "eviction-cancelled-by-shrink",
+                                    admitted=True, evict=False)
+                self._count_locked(queue, "resized")
+                self._publish_locked(claim["namespace"])
+                return QuotaVerdict(True, reason="resized-shrink",
+                                    tenant=tenant, queue=queue, chips=chips)
+            self._publish_locked(claim["namespace"])
+        deadline = claim["evicting_since"] + pool.spec.reclaimNoticeSeconds
+        if now < deadline:
+            # Notice window: still admitted so the workload can shrink or
+            # checkpoint; the drain seam has already been warned.
+            return QuotaVerdict(True, reason="reclaim-notice", tenant=tenant,
+                                queue=queue, chips=claim["chips"])
+        if self._live_pods(claim) == 0:
+            # Teardown finished (or never materialized): free the claim
+            # and decide afresh — the gang re-queues like any other.
+            self._claims.pop(claim["key"], None)
+            self._record_locked(now, claim["key"], claim, "evicted",
+                               admitted=False, evict=False)
+            self._count_locked(queue, "evicted")
+            self._publish_locked(claim["namespace"])
+            return self._admit_fresh_locked(now, pool, demand, qcfg)
+        self._record_locked(now, claim["key"], claim, "reclaim-evict",
+                           admitted=False, evict=True)
+        self._count_locked(queue, "denied")
+        return QuotaVerdict(False, reason="reclaim-evict", evict=True,
+                            tenant=tenant, queue=queue, chips=claim["chips"])
+
+    def _deny_locked(self, now: float, pool: QuotaPool,
+                     demand: Dict[str, Any], qcfg, reason: str, *,
+                     pending: bool,
+                     guaranteed_backed: bool = False) -> QuotaVerdict:
+        tenant = demand.get("tenant", "")
+        queue = demand.get("queue", DEFAULT_QUEUE)
+        key = demand["key"]
+        escalated = False
+        if pending:
+            entry = self._pending.get(key)
+            if entry is None:
+                self._seq += 1
+                entry = {"key": key, "since": now, "seq": self._seq,
+                         "escalated": False, "chips": demand["chips"],
+                         "tenant": tenant, "queue": queue,
+                         "priority": demand.get("priority", 0),
+                         "namespace": demand.get("namespace", "default"),
+                         "kind": demand.get("kind", C.KIND_CLUSTER),
+                         "name": demand.get("name", ""),
+                         "guaranteed_backed": False,
+                         "last_reason": "", "last_seen": now}
+                self._pending[key] = entry
+            entry["chips"] = demand["chips"]
+            entry["guaranteed_backed"] = guaranteed_backed
+            entry["last_seen"] = now
+            bound = pool.spec.starvationBoundSeconds
+            if not entry["escalated"] and now - entry["since"] >= bound:
+                entry["escalated"] = True
+                self._record_locked(now, key, entry,
+                                    "starvation-escalated", admitted=False,
+                                    evict=False, escalated=True)
+                if self.metrics is not None:
+                    self.metrics.quota_starvation_escalation(queue)
+            escalated = entry["escalated"]
+            entry["last_reason"] = reason
+        if self._last_reason.get(key) != reason:
+            self._last_reason[key] = reason
+            self._record_locked(now, key, demand, reason, admitted=False,
+                               evict=False, escalated=escalated)
+        self._count_locked(queue, "denied")
+        self._publish_locked(demand.get("namespace", "default"))
+        return QuotaVerdict(False, reason=reason, tenant=tenant, queue=queue,
+                            escalated=escalated, chips=demand["chips"])
+
+    def _admissible_locked(self, pool: QuotaPool, qcfg: QuotaQueue,
+                           tenant: str, queue: str, chips: int,
+                           escalated: bool, key: ClaimKey, *,
+                           base: int = 0):
+        """-> (ok, reason, shortfall, satisfiable, within_guaranteed).
+
+        ``base`` is the requester's already-claimed chips (grow path):
+        ceiling/guarantee checks see ``used + base + chips`` while the
+        free-capacity check only needs the ``chips`` delta.
+        """
+        total = pool.spec.totalChips
+        ceiling = qcfg.ceilingChips or total
+        if base + chips > ceiling or base + chips > total:
+            return (False, "gang-exceeds-ceiling", 0, False, False)
+        used_q = self._used_locked(tenant, queue, exclude=key) + base
+        used_total = self._used_locked(None, None, exclude=key) + base
+        if used_q + chips > ceiling:
+            return (False, "queue-ceiling", 0, True, False)
+        within_guaranteed = used_q + chips <= qcfg.guaranteedChips
+        if not within_guaranteed and not qcfg.borrowable and not escalated:
+            return (False, "not-borrowable", 0, True, False)
+        free = total - used_total
+        if free < chips:
+            return (False, "insufficient-capacity", chips - free, True,
+                    within_guaranteed)
+        reserved = self._reservations_locked(key, escalated,
+                                             within_guaranteed)
+        if free - reserved < chips:
+            # Physically fits, but an older starving gang called dibs.
+            return (False, "reserved-for-escalated", 0, True,
+                    within_guaranteed)
+        return (True, "", 0, True, within_guaranteed)
+
+    def _gc_pending_locked(self, now: float, pool: QuotaPool) -> None:
+        """Drop pending entries nobody is re-asking for (a controller
+        that stopped requeueing — deleted CR, abandoned cron catch-up):
+        a live gang re-asks every few seconds, so anything silent for a
+        starvation-bound's worth of time is gone, and its escalation
+        reservation must not starve the living."""
+        stale = max(60.0, pool.spec.starvationBoundSeconds)
+        for key in [k for k, p in self._pending.items()
+                    if now - p["last_seen"] > stale]:
+            self._pending.pop(key, None)
+            self._last_reason.pop(key, None)
+
+    def _reservations_locked(self, key: ClaimKey, escalated: bool,
+                             within_guaranteed: bool = True) -> int:
+        """Chips reserved by *other* pending gangs that outrank this
+        request.
+
+        Reservations constrain **borrowers**, never a request inside its
+        own guarantee: a guarantee is a contract the pool pre-sold, so
+        admission within it must not queue behind anyone (otherwise one
+        starved borrower would invert priority over every tenant and
+        head-of-line-block the whole pool).  Among borrowers, escalated
+        waiters reserve first (only longer-pending escalated ones
+        against an escalated requester — the total order prevents two
+        escalated gangs from reserving each other to death), then
+        guaranteed-backed waiters: reclaim freed those chips to honor a
+        guarantee, so a borrower must not re-take them first (borrowing
+        is a loan)."""
+        if within_guaranteed and not escalated:
+            return 0
+        mine = self._pending.get(key)
+        my_rank = (mine["since"], mine["seq"]) if mine else None
+        reserved = 0
+        for k, p in self._pending.items():
+            if k == key:
+                continue
+            if p["escalated"]:
+                if escalated and my_rank is not None and \
+                        (p["since"], p["seq"]) >= my_rank:
+                    continue
+                reserved += p["chips"]
+            elif not within_guaranteed and p.get("guaranteed_backed"):
+                reserved += p["chips"]
+        return reserved
+
+    def _nudge_expired_locked(self, now: float, pool: QuotaPool) -> None:
+        """Re-warn evicting claims whose notice window has expired but
+        whose pods live on (the controllers' warned-preemption path
+        pre-replaces noticed slices, so a victim can converge holding
+        fresh *un-noticed* pods and never reconcile again).  Re-stamping
+        the notice is a store write, which level-triggers the victim's
+        reconcile -> admission re-ask -> ``reclaim-evict`` teardown; on
+        already-noticed pods the preemptor is a no-op, so this never
+        generates journal churn."""
+        for c in self._claims.values():
+            if not c["evicting"]:
+                continue
+            if now >= c["evicting_since"] + pool.spec.reclaimNoticeSeconds:
+                self._preemptor(dict(c), now)
+
+    def _reclaim_locked(self, now: float, pool: QuotaPool,
+                        demand: Dict[str, Any], shortfall: int, *,
+                        escalated_only: bool) -> None:
+        """Warn the lowest-priority borrowers until ``shortfall`` chips
+        are on their way back.  ``escalated_only`` is the starvation
+        borrow-override: it may only displace strictly-lower-priority
+        borrowers, while a guaranteed-backed request may displace any
+        borrower (the guarantee is a contract)."""
+        requester_priority = demand.get("priority", 0)
+        # Capacity already being reclaimed (victims drain for a notice
+        # window) counts against the shortfall, or every level-triggered
+        # re-ask would warn one more victim and cascade-evict the fleet.
+        in_flight = sum(c["chips"] - c["reclaim_target"]
+                        for c in self._claims.values() if c["evicting"])
+        remaining = shortfall - in_flight
+        if remaining <= 0:
+            return
+        victims = [c for c in self._claims.values()
+                   if not c["evicting"] and c["borrowed"] > 0
+                   and c["key"] != demand["key"]]
+        if escalated_only:
+            victims = [c for c in victims
+                       if c["priority"] < requester_priority]
+        # Lowest priority first; youngest first within a tie (the
+        # deterministic, journal-stable tie-break).
+        victims.sort(key=lambda c: (c["priority"], -c["seq"]))
+        deadline = now + pool.spec.reclaimNoticeSeconds
+        for victim in victims:
+            if remaining <= 0:
+                break
+            take = min(victim["borrowed"], remaining)
+            victim["evicting"] = True
+            victim["evicting_since"] = now
+            victim["reclaim_target"] = victim["chips"] - take
+            remaining -= take
+            self._record_locked(now, victim["key"], victim,
+                                "reclaim-noticed", admitted=True,
+                                evict=False)
+            if self.metrics is not None:
+                self.metrics.quota_reclaim_eviction(victim["queue"])
+            self._preemptor(dict(victim), deadline)
+
+    # --- ledger arithmetic ---------------------------------------------------
+
+    def _used_locked(self, tenant: Optional[str], queue: Optional[str], *,
+                     exclude: Optional[ClaimKey] = None) -> int:
+        """Claimed chips — evicting claims still count (conservation is
+        about capacity *held*, and a victim holds chips until drained)."""
+        total = 0
+        for k, c in self._claims.items():
+            if k == exclude:
+                continue
+            if tenant is not None and c["tenant"] != tenant:
+                continue
+            if queue is not None and c["queue"] != queue:
+                continue
+            total += c["chips"]
+        return total
+
+    def _resolve_pool(self, namespace: str) -> Optional[QuotaPool]:
+        pools = self.store.list(C.KIND_QUOTA_POOL, namespace)
+        if not pools and namespace != "default":
+            pools = self.store.list(C.KIND_QUOTA_POOL, "default")
+        if not pools:
+            return None
+        return QuotaPool.from_dict(pools[0])  # store.list sorts by name
+
+    def _queue_config(self, pool: QuotaPool, tenant: str,
+                      queue: str) -> Optional[QuotaQueue]:
+        for t in pool.spec.tenants:
+            if t.name != tenant:
+                continue
+            for q in t.queues:
+                if q.name == queue:
+                    return q
+        return None
+
+    # --- eviction plumbing ---------------------------------------------------
+
+    def _workload_clusters(self, claim: Dict[str, Any]) -> List[str]:
+        ns = claim["namespace"]
+        if claim["key"][0] == C.KIND_JOB:
+            clusters = self.store.list(C.KIND_CLUSTER, ns, labels={
+                C.LABEL_ORIGINATED_FROM_CR_NAME: claim["key"][2],
+                C.LABEL_ORIGINATED_FROM_CRD: C.KIND_JOB,
+            })
+            return [c["metadata"]["name"] for c in clusters]
+        return [claim["key"][2]]
+
+    def _live_pods(self, claim: Dict[str, Any]) -> int:
+        ns = claim["namespace"]
+        count = 0
+        for cname in self._workload_clusters(claim):
+            for pod in self.store.list("Pod", ns,
+                                       labels={C.LABEL_CLUSTER: cname}):
+                if not pod["metadata"].get("deletionTimestamp"):
+                    count += 1
+        return count
+
+    def _default_preemptor(self, claim: Dict[str, Any],
+                           deadline: float) -> None:
+        """Stamp the advance-notice annotation on the victim's live pods;
+        PR 10's drain seam (checkpoint request + drained-at ack) and the
+        elastic shrink logic take it from there."""
+        ns = claim["namespace"]
+        for cname in self._workload_clusters(claim):
+            for pod in self.store.list("Pod", ns,
+                                       labels={C.LABEL_CLUSTER: cname}):
+                md = pod["metadata"]
+                if md.get("deletionTimestamp"):
+                    continue
+                if C.ANNOTATION_PREEMPTION_NOTICE in (
+                        md.get("annotations") or {}):
+                    continue
+                try:
+                    self.store.patch("Pod", md["name"], ns, {
+                        "metadata": {"annotations": {
+                            C.ANNOTATION_PREEMPTION_NOTICE:
+                                f"{deadline:.3f}"}}})
+                except (NotFound, Conflict):
+                    # Pod raced away or a concurrent writer won; the
+                    # level-triggered admit loop re-warns next pass.
+                    continue
+
+    # --- observability -------------------------------------------------------
+
+    def _record_locked(self, now: float, key: ClaimKey,
+                       src: Dict[str, Any], reason: str, *, admitted: bool,
+                       evict: bool, escalated: bool = False) -> None:
+        self._audit.append({
+            "ts": round(now, 3), "kind": key[0], "namespace": key[1],
+            "name": key[2], "tenant": src.get("tenant", ""),
+            "queue": src.get("queue", ""), "reason": reason,
+            "chips": src.get("chips", 0),
+            "priority": src.get("priority", 0),
+            "admitted": admitted, "evict": evict, "escalated": escalated,
+        })
+
+    def _count_locked(self, queue: str, verdict: str) -> None:
+        if self.metrics is not None:
+            self.metrics.quota_admission(queue, verdict)
+
+    def _publish_locked(self, namespace: str) -> None:
+        if self.metrics is None:
+            return
+        pool = self._resolve_pool(namespace)
+        if pool is None:
+            return
+        pending_by_queue: Dict[Tuple[str, str], int] = {}
+        for p in self._pending.values():
+            k = (p["tenant"], p["queue"])
+            pending_by_queue[k] = pending_by_queue.get(k, 0) + 1
+        for t in pool.spec.tenants:
+            for q in t.queues:
+                used = self._used_locked(t.name, q.name)
+                self.metrics.quota_usage(
+                    t.name, q.name, used=used,
+                    guaranteed=q.guaranteedChips,
+                    ceiling=q.ceilingChips or pool.spec.totalChips)
+                self.metrics.quota_pending(
+                    q.name, pending_by_queue.get((t.name, q.name), 0))

@@ -104,7 +104,14 @@ class Operator:
                                shards=max(1, self.config.shardCount))
 
         self.schedulers = SchedulerManager()
-        self.schedulers.register(GangScheduler(self.store))
+        # Hierarchical multi-tenant quota (controlplane/quota.py): the
+        # capacity oracle behind the builtin gang scheduler's admission
+        # seam.  Workloads without spec.tenant (or namespaces without a
+        # QuotaPool) bypass the ledger, so mounting it is always safe.
+        from kuberay_tpu.controlplane.quota import QuotaManager
+        self.quota = QuotaManager(self.store, metrics=self.metrics)
+        self.schedulers.register(GangScheduler(
+            self.store, quota=self.quota, metrics=self.metrics))
         self.schedulers.register(VolcanoAdapter(self.store))
         self.schedulers.register(YuniKornAdapter(self.store))
         self.schedulers.register(KaiAdapter(self.store))
@@ -132,7 +139,8 @@ class Operator:
             client_provider=lambda cname, status: provider(status),
             tracer=self.tracer, transitions=self.transitions)
         self.cronjob_controller = TpuCronJobController(
-            self.store, recorder=self.recorder, tracer=self.tracer)
+            self.store, recorder=self.recorder, tracer=self.tracer,
+            scheduler=scheduler)
         self.networkpolicy_controller = NetworkPolicyController(self.store)
         self.warmpool_controller = WarmSlicePoolController(
             self.store, recorder=self.recorder, tracer=self.tracer)
@@ -257,7 +265,7 @@ class Operator:
             self.store, api_host, api_port, metrics=self.metrics,
             history=history, tracer=self.tracer, flight=self.flight,
             goodput=self.goodput, autoscaler=self.autoscaler_audit,
-            alerts=self.alerts, steps=self.steps)
+            alerts=self.alerts, steps=self.steps, quota=self.quota)
         if leader_election and shard_leases and self.manager.shards > 1:
             from kuberay_tpu.controlplane.leader import ShardLeaseElector
             # Start unowned: every pool paused until its lease is won.

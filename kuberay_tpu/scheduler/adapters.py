@@ -198,7 +198,9 @@ class KaiAdapter:
         return mode != JobSubmissionMode.K8S_JOB
 
     def add_metadata(self, cluster, pod) -> None:
-        queue = cluster.get("spec", {}).get("gangSchedulingQueue", "default")
+        # KAI requires the queue label; an unset (or empty — the
+        # serialized dataclass default) queue maps to KAI's "default".
+        queue = cluster.get("spec", {}).get("gangSchedulingQueue") or "default"
         pod["metadata"].setdefault("labels", {})[self.QUEUE_LABEL] = queue
         pod["spec"]["schedulerName"] = "kai-scheduler"
 

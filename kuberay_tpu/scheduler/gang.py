@@ -1,41 +1,59 @@
-"""Builtin gang scheduler: PodGroup objects + optional capacity oracle.
+"""Builtin gang scheduler: PodGroup objects + quota / capacity admission.
 
 The slice-atomic equivalent of the reference's Volcano plugin behavior
 (volcano_scheduler.go syncPodGroup :155 / calculatePodGroupParams :200)
 without the external dependency: a ``PodGroup`` object per TpuCluster
-records the all-or-nothing quantum (minMember, TPU chips); admission asks a
-pluggable capacity oracle so tests (and a future quota manager) can model
-finite fleets.  Pods are stamped with the pod-group annotation so any
-PodGroup-aware kube scheduler can enforce the gang.
+records the all-or-nothing quantum (minMember, TPU chips); admission asks
+the hierarchical QuotaManager (``controlplane/quota.py``) when one is
+mounted, else the legacy pluggable capacity oracle, so tests (and finite
+fleets) stay modelable.  Pods are stamped with the pod-group annotation so
+any PodGroup-aware kube scheduler can enforce the gang.
+
+Every verdict is written back to the PodGroup ``status`` (phase, denial
+reason, first-admission timestamp) and counted in
+``tpu_gang_admission_total{verdict}`` — the observability evidence for
+the controllers' hold-off requeue path (analysis rule #6).
 """
 
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, Dict, Optional
 
-from kuberay_tpu.controlplane.store import NotFound, ObjectStore
+from kuberay_tpu.controlplane.quota import (QuotaVerdict, build_demand,
+                                            job_pseudo_cluster)
+from kuberay_tpu.controlplane.store import Conflict, NotFound, ObjectStore
 from kuberay_tpu.builders.common import owner_reference
-from kuberay_tpu.scheduler.interface import total_cluster_demand
 from kuberay_tpu.utils import constants as C
 
 ANNOTATION_POD_GROUP = "tpu.dev/pod-group"
 LABEL_QUEUE = "tpu.dev/queue"
+
+PHASE_ADMITTED = "Admitted"
+PHASE_PENDING = "Pending"
 
 
 class GangScheduler:
     name = "gang"
 
     def __init__(self, store: ObjectStore,
-                 capacity_oracle: Optional[Callable[[Dict[str, Any]], bool]] = None):
+                 capacity_oracle: Optional[Callable[[Dict[str, Any]],
+                                                    Any]] = None,
+                 quota=None, metrics=None,
+                 clock: Callable[[], float] = time.time):
         self.store = store
-        # oracle(demand) -> True when the fleet can host the whole gang now.
+        # Admission order: quota manager (the capacity oracle for
+        # multi-tenant fleets) > legacy oracle(demand) -> bool > admit-all.
+        self.quota = quota
         self.capacity_oracle = capacity_oracle
+        self.metrics = metrics
+        self._clock = clock
 
     def _pod_group_name(self, obj: Dict[str, Any]) -> str:
         return f"pg-{obj['metadata']['name']}"
 
-    def _sync_pod_group(self, cluster: Dict[str, Any]) -> Dict[str, Any]:
-        demand = total_cluster_demand(cluster)
+    def _sync_pod_group(self, cluster: Dict[str, Any],
+                        demand: Dict[str, Any]) -> None:
         ns = cluster["metadata"].get("namespace", "default")
         name = self._pod_group_name(cluster)
         queue = cluster.get("spec", {}).get("gangSchedulingQueue", "")
@@ -57,25 +75,62 @@ class GangScheduler:
             "status": {},
         }
         self.store.ensure(pg)
-        return demand
 
-    def on_cluster_submission(self, cluster: Dict[str, Any]) -> bool:
-        demand = self._sync_pod_group(cluster)
+    def _evaluate(self, demand: Dict[str, Any]) -> QuotaVerdict:
+        if self.quota is not None:
+            return self.quota.admit(demand)
         if self.capacity_oracle is not None:
-            return self.capacity_oracle(demand)
-        return True
+            verdict = self.capacity_oracle(demand)
+            if isinstance(verdict, QuotaVerdict):
+                return verdict
+            return QuotaVerdict(bool(verdict),
+                                reason="capacity-oracle"
+                                if verdict else "capacity-hold")
+        return QuotaVerdict(True, reason="unconstrained")
 
-    def on_job_submission(self, job: Dict[str, Any]) -> bool:
-        spec = job.get("spec", {}).get("clusterSpec")
-        if not spec:
-            return True
-        pseudo = {"metadata": job["metadata"], "kind": C.KIND_JOB,
-                  "spec": spec}
-        demand = total_cluster_demand(pseudo)
-        self._sync_pod_group(pseudo)
-        if self.capacity_oracle is not None:
-            return self.capacity_oracle(demand)
-        return True
+    def _conclude(self, obj: Dict[str, Any],
+                  verdict: QuotaVerdict) -> QuotaVerdict:
+        """Record the verdict where operators can see it: the PodGroup
+        status (phase / reason / first-admission timestamp) and the
+        ``tpu_gang_admission_total{verdict}`` counter."""
+        if self.metrics is not None:
+            self.metrics.gang_admission(
+                "admitted" if verdict.admitted else "denied")
+        ns = obj["metadata"].get("namespace", "default")
+        name = self._pod_group_name(obj)
+        pg = self.store.try_get("PodGroup", name, ns)
+        if pg is None:
+            return verdict
+        status = pg.get("status", {}) or {}
+        phase = PHASE_ADMITTED if verdict.admitted else PHASE_PENDING
+        want = {"phase": phase, "reason": verdict.reason}
+        if verdict.admitted and not status.get("admittedAt"):
+            want["admittedAt"] = round(self._clock(), 3)
+        unchanged = all(status.get(k) == v for k, v in want.items())
+        if not unchanged:
+            try:
+                self.store.patch("PodGroup", name, ns, {"status": want},
+                                 subresource="status")
+            except (NotFound, Conflict):
+                # The group raced away or a concurrent writer won; the
+                # next level-triggered admission pass re-stamps it.
+                pass
+        return verdict
+
+    def on_cluster_submission(self, cluster: Dict[str, Any]) -> QuotaVerdict:
+        demand = build_demand(cluster)
+        self._sync_pod_group(cluster, demand)
+        return self._conclude(cluster, self._evaluate(demand))
+
+    def on_job_submission(self, job: Dict[str, Any]) -> QuotaVerdict:
+        # Job-level quota identity wins over what the embedded cluster
+        # spec carries (mirrors the controller's spec forwarding).
+        pseudo = job_pseudo_cluster(job)
+        if pseudo is None:
+            return QuotaVerdict(True, reason="no-cluster-spec")
+        demand = build_demand(pseudo)
+        self._sync_pod_group(pseudo, demand)
+        return self._conclude(pseudo, self._evaluate(demand))
 
     def add_metadata(self, cluster: Dict[str, Any], pod: Dict[str, Any]) -> None:
         pod["metadata"].setdefault("annotations", {})[ANNOTATION_POD_GROUP] = \
@@ -90,3 +145,5 @@ class GangScheduler:
             self.store.delete("PodGroup", self._pod_group_name(obj), ns)
         except NotFound:
             pass
+        if self.quota is not None:
+            self.quota.release(obj)

@@ -33,6 +33,8 @@ from kuberay_tpu.controlplane.cronjob_controller import TpuCronJobController
 from kuberay_tpu.controlplane.events import EventRecorder
 from kuberay_tpu.controlplane.fake_kubelet import FakeKubelet
 from kuberay_tpu.controlplane.job_controller import TpuJobController
+from kuberay_tpu.controlplane.quota import QuotaManager
+from kuberay_tpu.scheduler.gang import GangScheduler
 from kuberay_tpu.controlplane.manager import (
     Manager,
     originated_from_mapper,
@@ -242,6 +244,20 @@ class SimHarness:
                 name = status_or_name
             return self.clients.setdefault(name, FakeCoordinatorClient())
 
+        # Multi-tenant quota seam: when the scenario opts in, the
+        # QuotaManager (clocked off the virtual clock, so starvation
+        # bounds and reclaim notices replay exactly) backs a
+        # GangScheduler mounted into the cluster/job/cron controllers.
+        # Classic scenarios mount neither, so no PodGroup objects or
+        # verdict writes appear and their journal hashes are unchanged.
+        self.quota = None
+        gang = None
+        if scenario is not None and getattr(scenario, "quota", False):
+            self.quota = QuotaManager(self.store, metrics=self.metrics,
+                                      clock=self.clock.now)
+            gang = GangScheduler(self.store, quota=self.quota,
+                                 metrics=self.metrics,
+                                 clock=self.clock.now)
         # Warm pool first: the cluster controller claims warm slices from
         # it on a preemption notice (warm pre-replacement), and fires the
         # checkpoint-drain hook through the coordinator client provider.
@@ -252,12 +268,13 @@ class SimHarness:
             recorder=self.recorder, metrics=self.metrics,
             tracer=self.tracer, transitions=transitions,
             warmpool=self.warmpool_controller,
+            scheduler=gang,
             client_provider=lambda status: provider(status))
         self.job_controller = TpuJobController(
             self.store, recorder=self.recorder,
             client_provider=lambda status: provider(status),
             metrics=self.metrics, tracer=self.tracer,
-            transitions=transitions)
+            transitions=transitions, scheduler=gang)
         # Burn-rate gate over the green fleet: observational (registry
         # snapshots + virtual clock only), fed by the serve-traffic pump
         # when a scenario mounts it; vacuously healthy otherwise.
@@ -270,7 +287,8 @@ class SimHarness:
             clock=self.clock, upgrade_gate=self.upgrade_gate,
             flight=self.flight, metrics_registry=self.metrics.registry)
         self.cronjob_controller = TpuCronJobController(
-            self.store, recorder=self.recorder, tracer=self.tracer)
+            self.store, recorder=self.recorder, tracer=self.tracer,
+            scheduler=gang)
 
         m = self.manager
         m.register(C.KIND_CLUSTER, self.cluster_controller.reconcile)
@@ -1046,7 +1064,8 @@ class SimHarness:
             self.store, self.journal, steps=self.steps,
             slow_host_log=self.slow_host_log,
             route_weight_log=self.route_weight_log,
-            serve_traffic_log=self.serve_traffic_log))
+            serve_traffic_log=self.serve_traffic_log,
+            quota=self.quota))
         if not self.converged:
             violations.append(Violation(
                 "convergence", f"step {self._step}",

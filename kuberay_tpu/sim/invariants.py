@@ -51,7 +51,8 @@ class CheckContext:
                  steps=None,
                  slow_host_log: Optional[List[Dict[str, Any]]] = None,
                  route_weight_log: Optional[List[Dict[str, Any]]] = None,
-                 serve_traffic_log: Optional[List[Dict[str, Any]]] = None):
+                 serve_traffic_log: Optional[List[Dict[str, Any]]] = None,
+                 quota=None):
         self.store = store
         self.journal = journal or []
         self.steps = steps
@@ -62,6 +63,10 @@ class CheckContext:
         # and the serve-traffic pump's per-round client outcomes.
         self.route_weight_log = route_weight_log or []
         self.serve_traffic_log = serve_traffic_log or []
+        # The QuotaManager when a scenario mounts the quota seam; the
+        # quota-* checkers read its ledger snapshot and are vacuous
+        # without it.
+        self.quota = quota
 
     # -- shared traversals -------------------------------------------------
 
@@ -500,4 +505,158 @@ def check_no_resurrection(ctx: CheckContext) -> List[Violation]:
                 "no-resurrection", key,
                 f"{rec.get('type')} at rv {rec.get('rv')} resurrects uid "
                 f"{uid} deleted earlier as {deleted[uid]}"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# quota-* (vacuous unless the scenario mounts the QuotaManager seam)
+# ---------------------------------------------------------------------------
+
+def _quota_pools(snapshot: Dict[str, Any]) -> List[dict]:
+    return snapshot.get("pools", [])
+
+
+def _pool_for_namespace(pools: List[dict], namespace: str) -> Optional[dict]:
+    # Mirrors QuotaManager._resolve_pool: the namespace's own pool,
+    # falling back to the "default" namespace pool.
+    for ns in ((namespace,) if namespace == "default"
+               else (namespace, "default")):
+        matching = [p for p in pools if p.get("namespace") == ns]
+        if matching:
+            return sorted(matching, key=lambda p: p.get("name", ""))[0]
+    return None
+
+
+def _queue_spec(pool: dict, tenant: str, queue: str) -> Optional[dict]:
+    for t in pool.get("spec", {}).get("tenants", []):
+        if t.get("name") != tenant:
+            continue
+        for q in t.get("queues", []):
+            if q.get("name") == queue:
+                return q
+    return None
+
+
+@checker("quota-gang-atomicity",
+         "(vacuous without the quota seam) every tenanted workload with "
+         "live pods holds a full ledger claim — a gang is never partially "
+         "admitted, and no tenanted pods run outside the ledger")
+def check_quota_gang_atomicity(ctx: CheckContext) -> List[Violation]:
+    if ctx.quota is None:
+        return []
+    out: List[Violation] = []
+    snapshot = ctx.quota.debug_snapshot()
+    pools = _quota_pools(snapshot)
+    claimed = {tuple(c["key"]) for c in snapshot.get("claims", [])}
+    for cluster in ctx.clusters():
+        if not cluster.spec.tenant:
+            continue
+        ns = cluster.metadata.namespace
+        if _pool_for_namespace(pools, ns) is None:
+            continue    # no pool -> quota is a pass-through, no claims
+        pods = ctx.live_pods(ns, labels={
+            C.LABEL_CLUSTER: cluster.metadata.name})
+        if not pods:
+            continue
+        # A job-originated cluster shares the job's claim key (one gang,
+        # one claim) — same resolution as quota.claim_key.
+        labels = cluster.metadata.labels or {}
+        if labels.get(C.LABEL_ORIGINATED_FROM_CRD) == C.KIND_JOB and \
+                labels.get(C.LABEL_ORIGINATED_FROM_CR_NAME):
+            key = (C.KIND_JOB, ns, labels[C.LABEL_ORIGINATED_FROM_CR_NAME])
+        else:
+            key = (C.KIND_CLUSTER, ns, cluster.metadata.name)
+        if key not in claimed:
+            out.append(Violation(
+                "quota-gang-atomicity",
+                _obj_key(C.KIND_CLUSTER, {"namespace": ns,
+                                          "name": cluster.metadata.name}),
+                f"{len(pods)} live pods for tenant "
+                f"{cluster.spec.tenant!r} but no ledger claim under "
+                f"{key} — capacity held outside the quota seam"))
+    for c in snapshot.get("claims", []):
+        if c.get("chips", 0) < 0:
+            out.append(Violation(
+                "quota-gang-atomicity",
+                f"{c['key'][0]} {c['key'][1]}/{c['key'][2]}",
+                f"ledger claim holds negative chips ({c['chips']})"))
+    return out
+
+
+@checker("quota-conservation",
+         "(vacuous without the quota seam) claimed chips never exceed a "
+         "queue's ceiling and the pool totals never exceed totalChips")
+def check_quota_conservation(ctx: CheckContext) -> List[Violation]:
+    if ctx.quota is None:
+        return []
+    out: List[Violation] = []
+    snapshot = ctx.quota.debug_snapshot()
+    pools = _quota_pools(snapshot)
+    used: Dict[tuple, int] = {}     # (pool ns, pool name, tenant, queue)
+    pool_used: Dict[tuple, int] = {}
+    for c in snapshot.get("claims", []):
+        pool = _pool_for_namespace(pools, c["key"][1])
+        if pool is None:
+            out.append(Violation(
+                "quota-conservation",
+                f"{c['key'][0]} {c['key'][1]}/{c['key'][2]}",
+                "ledger claim with no resolvable QuotaPool"))
+            continue
+        pk = (pool["namespace"], pool["name"])
+        used[pk + (c["tenant"], c["queue"])] = \
+            used.get(pk + (c["tenant"], c["queue"]), 0) + c["chips"]
+        pool_used[pk] = pool_used.get(pk, 0) + c["chips"]
+    for pool in pools:
+        pk = (pool["namespace"], pool["name"])
+        total = pool.get("spec", {}).get("totalChips", 0)
+        if pool_used.get(pk, 0) > total:
+            out.append(Violation(
+                "quota-conservation",
+                f"QuotaPool {pk[0]}/{pk[1]}",
+                f"{pool_used[pk]} chips claimed exceeds totalChips "
+                f"{total}"))
+        for key, chips in used.items():
+            if key[:2] != pk:
+                continue
+            q = _queue_spec(pool, key[2], key[3])
+            if q is None:
+                out.append(Violation(
+                    "quota-conservation", f"QuotaPool {pk[0]}/{pk[1]}",
+                    f"claims held under unknown tenant/queue "
+                    f"{key[2]}/{key[3]}"))
+                continue
+            ceiling = q.get("ceilingChips", 0) or total
+            if chips > ceiling:
+                out.append(Violation(
+                    "quota-conservation", f"QuotaPool {pk[0]}/{pk[1]}",
+                    f"queue {key[2]}/{key[3]} holds {chips} chips over "
+                    f"its ceiling {ceiling}"))
+    return out
+
+
+@checker("quota-starvation-bound",
+         "(vacuous without the quota seam) no gang pends past the pool's "
+         "starvation bound without the escalation override engaged")
+def check_quota_starvation_bound(ctx: CheckContext) -> List[Violation]:
+    if ctx.quota is None:
+        return []
+    out: List[Violation] = []
+    snapshot = ctx.quota.debug_snapshot()
+    pools = _quota_pools(snapshot)
+    now = ctx.quota._clock()
+    for p in snapshot.get("pending", []):
+        pool = _pool_for_namespace(pools, p.get("namespace", "default"))
+        if pool is None:
+            continue
+        bound = pool.get("spec", {}).get("starvationBoundSeconds", 300.0)
+        # Escalation is stamped on the *next* level-triggered re-ask
+        # after the bound; controllers requeue within ~5s, so a 15s
+        # grace keeps the checker honest without false-flagging the
+        # re-ask gap.
+        if now - p["since"] > bound + 15.0 and not p.get("escalated"):
+            out.append(Violation(
+                "quota-starvation-bound",
+                f"{p['key'][0]} {p['key'][1]}/{p['key'][2]}",
+                f"pending {now - p['since']:.0f}s exceeds the "
+                f"{bound:.0f}s starvation bound without escalation"))
     return out

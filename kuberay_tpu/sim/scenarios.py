@@ -75,6 +75,11 @@ class Scenario:
     # Extra feature gates merged over the harness baseline (e.g. the
     # incremental-upgrade gate); empty for the classic scenarios.
     extra_gates: Dict[str, bool] = dataclasses.field(default_factory=dict)
+    # Mount the hierarchical QuotaManager + GangScheduler as the
+    # capacity seam for cluster/job/cron admission.  Off for the
+    # classic scenarios so their journals stay byte-identical (no
+    # PodGroup objects, no admission verdict writes).
+    quota: bool = False
 
 
 SCENARIOS: Dict[str, Scenario] = {}
@@ -83,14 +88,15 @@ SCENARIOS: Dict[str, Scenario] = {}
 def scenario(name: str, description: str, profile: Dict[str, float],
              default_steps: int = 12, shards: int = 1,
              serve_traffic: bool = False,
-             extra_gates: Optional[Dict[str, bool]] = None):
+             extra_gates: Optional[Dict[str, bool]] = None,
+             quota: bool = False):
     def register(cls):
         inst = cls()
         SCENARIOS[name] = Scenario(
             name=name, description=description, profile=profile,
             setup=inst.setup, tick=inst.tick, default_steps=default_steps,
             shards=shards, serve_traffic=serve_traffic,
-            extra_gates=dict(extra_gates or {}))
+            extra_gates=dict(extra_gates or {}), quota=quota)
         return cls
     return register
 
@@ -508,4 +514,214 @@ class _CronJobBurst:
         # jobs launch, run, succeed, and get pruned.
         h.clock.advance(90.0)
         h.manager.enqueue((C.KIND_CRONJOB, "default", "nightly"))
+        h.succeed_jobs()
+
+
+# ---------------------------------------------------------------------------
+# quota scenarios: the multi-tenant admission seam under contention
+# ---------------------------------------------------------------------------
+
+def make_quota_pool_obj(name: str, total: int, tenants,
+                        starvation: float = 120.0, notice: float = 15.0):
+    """``tenants`` = [(tenant, [(queue, guaranteed, ceiling, borrowable)])].
+    A ceiling of 0 means "the pool total" (api/quotapool.py)."""
+    return {
+        "apiVersion": C.API_VERSION, "kind": C.KIND_QUOTA_POOL,
+        "metadata": {"name": name},
+        "spec": {
+            "totalChips": total,
+            "starvationBoundSeconds": starvation,
+            "reclaimNoticeSeconds": notice,
+            "tenants": [
+                {"name": tname,
+                 "queues": [{"name": q, "guaranteedChips": g,
+                             "ceilingChips": c, "borrowable": b}
+                            for q, g, c, b in queues]}
+                for tname, queues in tenants
+            ],
+        },
+        "status": {},
+    }
+
+
+def _tenant_job(name: str, tenant: str, priority: int = 0,
+                replicas: int = 1, ttl: int = 30):
+    """A 4-chip (v5e 2x2 per slice) HTTPMode batch job owned by a tenant."""
+    return {
+        "apiVersion": C.API_VERSION, "kind": C.KIND_JOB,
+        "metadata": {"name": name},
+        "spec": {
+            "entrypoint": "python -m batch",
+            "submissionMode": "HTTPMode",
+            "shutdownAfterJobFinishes": True,
+            "ttlSecondsAfterFinished": ttl,
+            "tenant": tenant,
+            "priority": priority,
+            "clusterSpec": make_cluster_obj(
+                "ignored", accelerator="v5e", topology="2x2",
+                replicas=replicas, max_replicas=4)["spec"],
+        },
+        "status": {},
+    }
+
+
+@scenario(
+    "contention-storm",
+    "three tenants flood an 8-chip pool with 4-chip gang jobs (the "
+    "benchmark's 1k-job storm scaled to the sim budget): admission must "
+    "stay all-or-nothing, guarantees reclaim borrowers through the "
+    "notice seam, and nothing starves past the escalation bound",
+    # DELETE_RACE/SLICE_DRAIN stay 0: quota reclaim stamps preemption
+    # notices, and a raw harness delete of a noticed pod would bypass
+    # the drain seam by construction (same rationale as
+    # preemption-drill) — the storm is about admission under churn.
+    profile={F.POD_KILL: 0.3, F.SLOW_START: 0.3, F.STORE_CONFLICT: 0.5,
+             F.WATCH_DROP: 0.3, F.WATCH_DUP: 0.3, F.WATCH_DELAY: 0.3,
+             F.DELETE_RACE: 0.0, F.SLICE_DRAIN: 0.0,
+             F.LEADER_FAILOVER: 0.0},
+    quota=True)
+class _ContentionStorm:
+    TENANTS = ("team-a", "team-b", "team-c")
+
+    def setup(self, h):
+        h.store.create(make_quota_pool_obj(
+            "fleet", total=8,
+            tenants=[("team-a", [("default", 4, 0, True)]),
+                     ("team-b", [("default", 4, 0, True)]),
+                     ("team-c", [("default", 0, 0, True)])],
+            starvation=120.0, notice=15.0))
+
+    def tick(self, h, step):
+        # Minutes of backlog churn per step: jobs finish, claims free,
+        # the next wave of the storm admits strictly through the ledger.
+        h.clock.advance(30.0)
+        rng = h.plan.rng
+        for i in range(2):
+            h.store.create(_tenant_job(
+                f"storm-{step}-{i}",
+                tenant=self.TENANTS[rng.randint(0, 2)],
+                priority=rng.randint(0, 2)))
+        h.succeed_jobs()
+
+
+@scenario(
+    "bursty-tenant",
+    "a zero-guarantee batch tenant borrows the whole pool, then the "
+    "prod tenant's guaranteed demand arrives: reclaim must warn the "
+    "borrower through the notice seam and the borrower's elastic "
+    "shrink must cancel the eviction — shrink before death",
+    profile={F.POD_KILL: 0.2, F.SLOW_START: 0.2, F.STORE_CONFLICT: 0.4,
+             F.WATCH_DROP: 0.2, F.WATCH_DUP: 0.2, F.WATCH_DELAY: 0.2,
+             F.DELETE_RACE: 0.0, F.SLICE_DRAIN: 0.0,
+             F.LEADER_FAILOVER: 0.0},
+    quota=True)
+class _BurstyTenant:
+    def setup(self, h):
+        # Notice window (120s) outlasts a tick + settle horizon so the
+        # scripted elastic shrink lands INSIDE the window — the
+        # eviction-cancelled-by-shrink path, not the teardown path
+        # (contention-storm covers expiry-eviction with its 15s window).
+        h.store.create(make_quota_pool_obj(
+            "fleet", total=32,
+            tenants=[("prod", [("default", 16, 0, True)]),
+                     ("batch", [("default", 0, 0, True)])],
+            starvation=90.0, notice=120.0))
+        batch = make_cluster_obj("batch", accelerator="v5e",
+                                 topology="2x2", replicas=4,
+                                 max_replicas=8)
+        batch["spec"]["tenant"] = "batch"
+        h.store.create(batch)
+
+    def _set_replicas(self, h, name, n):
+        cluster = h.store.try_get(C.KIND_CLUSTER, name)
+        if cluster is None:
+            return
+        cluster["spec"]["workerGroupSpecs"][0]["replicas"] = n
+        try:
+            h.store.update(cluster)
+        except Conflict:
+            return
+
+    def tick(self, h, step):
+        h.clock.advance(15.0)
+        if step == 0:
+            # Burst: borrow everything beyond the zero guarantee.
+            self._set_replicas(h, "batch", 8)
+        elif step == 2:
+            # The guaranteed tenant arrives; its 16-chip demand is
+            # within contract, so reclaim warns the borrower.
+            prod = make_cluster_obj("prod", accelerator="v5e",
+                                    topology="2x2", replicas=4,
+                                    max_replicas=8)
+            prod["spec"]["tenant"] = "prod"
+            prod["spec"]["priority"] = 10
+            h.store.create(prod)
+        elif step == 3:
+            # Elastic response inside the notice window: shrink to the
+            # reclaim target cancels the eviction.
+            self._set_replicas(h, "batch", 4)
+        elif step == 6:
+            # Prod releases half voluntarily (reclaim racing a
+            # voluntary release, ledger-side).
+            self._set_replicas(h, "prod", 2)
+        elif step == 8:
+            # The burster borrows the freed capacity right back.
+            self._set_replicas(h, "batch", 6)
+        elif step == 10:
+            # One borrow too far: this grow stays pending.
+            self._set_replicas(h, "batch", 8)
+
+
+@scenario(
+    "deadline-cron-fleet",
+    "an every-minute guaranteed-tenant cron fleet vs a zero-guarantee "
+    "hog borrowing the whole pool: due runs hold as catch-up instead "
+    "of piling on denied jobs, reclaim evicts the hog through the "
+    "drain seam, and the freed chips are reserved for the guaranteed "
+    "waiter — not re-borrowed",
+    profile={F.POD_KILL: 0.3, F.SLOW_START: 0.3, F.STORE_CONFLICT: 0.5,
+             F.WATCH_DROP: 0.3, F.WATCH_DUP: 0.3, F.WATCH_DELAY: 0.3,
+             F.DELETE_RACE: 0.0, F.SLICE_DRAIN: 0.0,
+             F.LEADER_FAILOVER: 0.0},
+    quota=True)
+class _DeadlineCronFleet:
+    def setup(self, h):
+        h.store.create(make_quota_pool_obj(
+            "fleet", total=8,
+            tenants=[("pipeline", [("default", 4, 0, True)]),
+                     ("adhoc", [("default", 0, 0, True)])],
+            starvation=180.0, notice=10.0))
+        hog = make_cluster_obj("hog", accelerator="v5e", topology="2x2",
+                               replicas=2, max_replicas=4)
+        hog["spec"]["tenant"] = "adhoc"
+        h.store.create(hog)
+        h.store.create({
+            "apiVersion": C.API_VERSION, "kind": C.KIND_CRONJOB,
+            "metadata": {"name": "reports"},
+            "spec": {
+                "schedule": "* * * * *",
+                "concurrencyPolicy": "Allow",
+                "successfulJobsHistoryLimit": 2,
+                "failedJobsHistoryLimit": 1,
+                "jobTemplate": {
+                    "entrypoint": "python -m report",
+                    "submissionMode": "HTTPMode",
+                    "shutdownAfterJobFinishes": True,
+                    "ttlSecondsAfterFinished": 30,
+                    "tenant": "pipeline",
+                    "priority": 5,
+                    "clusterSpec": make_cluster_obj(
+                        "ignored", accelerator="v5e", topology="2x2",
+                        replicas=1)["spec"],
+                },
+            },
+            "status": {},
+        })
+
+    def tick(self, h, step):
+        # Minutes pass between steps (the cronjob-burst cadence): runs
+        # fall due, hold for quota, fire as catch-up once the hog is
+        # reclaimed, succeed, and release their claims.
+        h.clock.advance(90.0)
+        h.manager.enqueue((C.KIND_CRONJOB, "default", "reports"))
         h.succeed_jobs()

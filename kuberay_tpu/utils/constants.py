@@ -17,6 +17,7 @@ KIND_CLUSTER = "TpuCluster"
 KIND_JOB = "TpuJob"
 KIND_SERVICE = "TpuService"
 KIND_CRONJOB = "TpuCronJob"
+KIND_QUOTA_POOL = "QuotaPool"
 
 # --- Labels (ref constant.go:38-48) ------------------------------------------
 LABEL_CLUSTER = "tpu.dev/cluster"                 # ray.io/cluster
@@ -132,6 +133,9 @@ EVENT_INVALID_SPEC = "InvalidSpec"
 EVENT_PREEMPTION_NOTICE = "PreemptionNotice"
 EVENT_DRAINED_SLICE = "DrainedSlice"
 EVENT_ADOPTED_WARM_SLICE = "AdoptedWarmSlice"
+EVENT_QUOTA_HELD = "QuotaHeld"
+EVENT_QUOTA_ADMITTED = "QuotaAdmitted"
+EVENT_QUOTA_EVICTED = "QuotaEvicted"
 
 # --- Behavior knobs (ref §5.6 env escape hatches) ----------------------------
 ENV_ENABLE_RANDOM_POD_DELETE = "ENABLE_RANDOM_POD_DELETE"
@@ -149,6 +153,7 @@ CRD_PLURALS = {
     "WarmSlicePool": "warmslicepools",
     "TrafficRoute": "trafficroutes",
     "ComputeTemplate": "computetemplates",
+    KIND_QUOTA_POOL: "quotapools",
 }
 CORE_PLURALS = {
     "Pod": "pods", "Service": "services", "Event": "events",

@@ -331,6 +331,33 @@ class ControlPlaneMetrics:
                    "Straggler verdicts flagged per job (host exceeded "
                    "the fleet median by the configured ratio for K "
                    "consecutive steps)")
+        r.describe("tpu_gang_admission_total",
+                   "Gang admission verdicts (admitted/denied) evaluated "
+                   "by the batch scheduler; denials are the evidence "
+                   "behind the controllers' hold-off requeues")
+        r.describe("tpu_quota_admissions_total",
+                   "QuotaManager decisions per queue and verdict "
+                   "(admitted/denied/resized/evicted); level-triggered, "
+                   "so a pending gang re-counts a denial every requeue")
+        r.describe("tpu_quota_chips_used",
+                   "Chips currently claimed per tenant queue (evicting "
+                   "claims still count until drained — conservation is "
+                   "about capacity held)")
+        r.describe("tpu_quota_chips_guaranteed",
+                   "Configured guaranteed chip budget per tenant queue")
+        r.describe("tpu_quota_chips_ceiling",
+                   "Configured chip ceiling per tenant queue (pool total "
+                   "when the queue sets none)")
+        r.describe("tpu_quota_reclaim_evictions_total",
+                   "Borrower gangs warned for reclaim per queue (each "
+                   "gets the preemption-notice window to shrink or "
+                   "checkpoint before teardown)")
+        r.describe("tpu_quota_starvation_escalations_total",
+                   "Pending gangs escalated past the starvation bound to "
+                   "the front of their queue with a borrowed-capacity "
+                   "override")
+        r.describe("tpu_quota_pending_gangs",
+                   "Gangs waiting for quota admission per queue")
 
     def observe_provisioned(self, cluster: str, seconds: float):
         self.registry.observe("tpu_cluster_provisioned_duration_seconds",
@@ -391,6 +418,34 @@ class ControlPlaneMetrics:
 
     def warmpool_claim(self, reason: str):
         self.registry.inc("tpu_warmpool_claims_total", {"reason": reason})
+
+    def gang_admission(self, verdict: str):
+        self.registry.inc("tpu_gang_admission_total", {"verdict": verdict})
+
+    def quota_admission(self, queue: str, verdict: str):
+        self.registry.inc("tpu_quota_admissions_total",
+                          {"queue": queue, "verdict": verdict})
+
+    def quota_reclaim_eviction(self, queue: str):
+        self.registry.inc("tpu_quota_reclaim_evictions_total",
+                          {"queue": queue})
+
+    def quota_starvation_escalation(self, queue: str):
+        self.registry.inc("tpu_quota_starvation_escalations_total",
+                          {"queue": queue})
+
+    def quota_usage(self, tenant: str, queue: str, *, used: int,
+                    guaranteed: int, ceiling: int):
+        labels = {"tenant": tenant, "queue": queue}
+        self.registry.set_gauge("tpu_quota_chips_used", float(used), labels)
+        self.registry.set_gauge("tpu_quota_chips_guaranteed",
+                                float(guaranteed), labels)
+        self.registry.set_gauge("tpu_quota_chips_ceiling", float(ceiling),
+                                labels)
+
+    def quota_pending(self, queue: str, count: int):
+        self.registry.set_gauge("tpu_quota_pending_gangs", float(count),
+                                {"queue": queue})
 
     def observe_train_step(self, job: str, host: str, seconds: float,
                            exemplar: Optional[str] = None,

@@ -25,9 +25,12 @@ from __future__ import annotations
 
 import dataclasses
 import pathlib
+import re
 import subprocess
 import sys
 import typing
+
+from google.protobuf.descriptor_pb2 import FieldDescriptorProto as FDP
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
@@ -255,10 +258,119 @@ def generate() -> str:
 
 
 def _compile(proto_path: pathlib.Path, out: pathlib.Path):
-    subprocess.run(
-        ["protoc", f"-I{REPO / 'proto'}",
-         f"--descriptor_set_out={out}", "--include_imports",
-         str(proto_path)], check=True)
+    try:
+        subprocess.run(
+            ["protoc", f"-I{REPO / 'proto'}",
+             f"--descriptor_set_out={out}", "--include_imports",
+             str(proto_path)], check=True)
+    except FileNotFoundError:
+        # No protoc in this image: compile the IDL ourselves.  The
+        # grammar is exactly what generate() emits (messages with
+        # plain/optional/repeated/map fields + services), so a tiny
+        # parser suffices; bytes are deterministic, which is all the
+        # drift test needs.  If a protoc-built binpb is ever committed
+        # from another machine, regenerate here too so check mode
+        # compares like with like.
+        out.write_bytes(_compile_pure(proto_path.read_text()))
+
+
+_SCALARS = {"string": FDP.TYPE_STRING, "int64": FDP.TYPE_INT64,
+            "int32": FDP.TYPE_INT32, "double": FDP.TYPE_DOUBLE,
+            "float": FDP.TYPE_FLOAT, "bool": FDP.TYPE_BOOL}
+
+
+def _set_type(field, type_name: str, package: str):
+    if type_name in _SCALARS:
+        field.type = _SCALARS[type_name]
+    elif type_name.startswith("google.protobuf."):
+        field.type = FDP.TYPE_MESSAGE
+        field.type_name = f".{type_name}"
+    else:
+        field.type = FDP.TYPE_MESSAGE
+        field.type_name = f".{package}.{type_name}"
+
+
+def _compile_pure(text: str) -> bytes:
+    """proto3 text (the subset generate() emits) -> serialized
+    FileDescriptorSet with imports included, protoc-free."""
+    from google.protobuf import descriptor_pb2, struct_pb2
+
+    # Normalize: strip comments, then force every statement/brace onto
+    # its own line so single-line message bodies parse like multi-line.
+    src = "\n".join(ln.split("//")[0] for ln in text.splitlines())
+    for tok in ("{", "}", ";"):
+        src = src.replace(tok, f"{tok}\n")
+    lines = [ln.strip() for ln in src.splitlines() if ln.strip()]
+
+    fd = descriptor_pb2.FileDescriptorProto(
+        name="tpu/v1/api.proto", syntax="proto3")
+    msg = None
+    svc = None
+    for ln in lines:
+        if ln.startswith("syntax"):
+            continue
+        if ln.startswith("package"):
+            fd.package = ln.split()[1].rstrip(";").strip()
+        elif ln.startswith("import"):
+            fd.dependency.append(ln.split('"')[1])
+        elif ln.startswith("message "):
+            msg = fd.message_type.add(name=ln.split()[1])
+        elif ln.startswith("service "):
+            svc = fd.service.add(name=ln.split()[1])
+        elif ln.startswith("rpc "):
+            m = re.match(r"rpc\s+(\w+)\s*\(\s*([\w.]+)\s*\)\s*"
+                         r"returns\s*\(\s*([\w.]+)\s*\)", ln)
+            svc.method.add(name=m.group(1),
+                           input_type=f".{fd.package}.{m.group(2)}",
+                           output_type=f".{fd.package}.{m.group(3)}")
+        elif ln == "}":
+            msg = svc = None
+        elif msg is not None and "=" in ln:
+            decl, num = ln.rstrip(";").rsplit("=", 1)
+            words = decl.split()
+            number = int(num)
+            if words[0] == "map" or decl.lstrip().startswith("map<"):
+                mm = re.match(r"map<\s*string\s*,\s*([\w.]+)\s*>\s+(\w+)",
+                              decl.strip())
+                vt, fname = mm.group(1), mm.group(2)
+                entry_name = "".join(
+                    p[:1].upper() + p[1:] for p in fname.split("_")) + "Entry"
+                entry = msg.nested_type.add(name=entry_name)
+                entry.options.map_entry = True
+                entry.field.add(name="key", number=1,
+                                label=FDP.LABEL_OPTIONAL,
+                                type=FDP.TYPE_STRING)
+                val = entry.field.add(name="value", number=2,
+                                      label=FDP.LABEL_OPTIONAL)
+                _set_type(val, vt, fd.package)
+                field = msg.field.add(
+                    name=fname, number=number, label=FDP.LABEL_REPEATED,
+                    type=FDP.TYPE_MESSAGE,
+                    type_name=f".{fd.package}.{msg.name}.{entry_name}")
+            elif words[0] == "repeated":
+                field = msg.field.add(name=words[2], number=number,
+                                      label=FDP.LABEL_REPEATED)
+                _set_type(field, words[1], fd.package)
+            elif words[0] == "optional":
+                field = msg.field.add(name=words[2], number=number,
+                                      label=FDP.LABEL_OPTIONAL,
+                                      proto3_optional=True)
+                _set_type(field, words[1], fd.package)
+                # proto3 presence = a synthetic one-field oneof.
+                field.oneof_index = len(msg.oneof_decl)
+                msg.oneof_decl.add(name=f"_{words[2]}")
+            else:
+                field = msg.field.add(name=words[1], number=number,
+                                      label=FDP.LABEL_OPTIONAL)
+                _set_type(field, words[0], fd.package)
+
+    fds = descriptor_pb2.FileDescriptorSet()
+    # --include_imports parity: dependencies first, from the runtime's
+    # own copy of the well-known types.
+    dep = fds.file.add()
+    dep.ParseFromString(struct_pb2.DESCRIPTOR.serialized_pb)
+    fds.file.add().CopyFrom(fd)
+    return fds.SerializeToString()
 
 
 def main(check: bool = False) -> int:

@@ -1,0 +1,236 @@
+"""QuotaManager ledger semantics + PodGroup verdict lifecycle.
+
+Edge cases the sim's invariant checkers can't isolate: a
+zero-guaranteed queue borrowing the whole pool, reclaim racing a
+voluntary release, a gang exactly at (and just over) its ceiling, the
+deterministic youngest-first victim tie-break, and the PodGroup status
+/ ``tpu_gang_admission_total`` evidence trail the gang scheduler leaves
+for every verdict.
+"""
+
+from __future__ import annotations
+
+from kuberay_tpu.controlplane.quota import QuotaManager, build_demand
+from kuberay_tpu.controlplane.store import ObjectStore
+from kuberay_tpu.scheduler.gang import GangScheduler
+from kuberay_tpu.sim.scenarios import make_quota_pool_obj
+from kuberay_tpu.utils import constants as C
+from kuberay_tpu.utils.metrics import ControlPlaneMetrics
+from tests.test_api_types import make_cluster
+
+NOTICE_S = 30.0
+BOUND_S = 120.0
+
+
+def mk_quota(tenants, total=16):
+    """(quota, clock, preempts) over a one-pool store with a fake clock
+    and a recording preemptor (no pods exist, so the default preemptor
+    would have nothing to stamp anyway)."""
+    store = ObjectStore()
+    store.create(make_quota_pool_obj("pool", total, tenants,
+                                     starvation=BOUND_S, notice=NOTICE_S))
+    clock = {"t": 100.0}
+    preempts = []
+    quota = QuotaManager(
+        store, clock=lambda: clock["t"],
+        preemptor=lambda claim, deadline: preempts.append(claim["key"]))
+    return quota, clock, preempts
+
+
+def demand(name, tenant, chips, queue="default", priority=0):
+    return {"kind": C.KIND_JOB, "namespace": "default", "name": name,
+            "tpuChips": chips, "chips": chips, "minMember": 1,
+            "tenant": tenant, "queue": queue, "priority": priority,
+            "key": (C.KIND_JOB, "default", name)}
+
+
+def test_zero_guaranteed_queue_borrows_everything():
+    quota, _, _ = mk_quota([("owner", [("default", 16, 0, True)]),
+                            ("free", [("default", 0, 0, True)])])
+    # With the owner idle, the zero-guarantee queue may borrow the
+    # whole pool — borrowing is only bounded by ceiling and capacity.
+    assert quota.admit(demand("f1", "free", 8)).admitted
+    assert quota.admit(demand("f2", "free", 8)).admitted
+    snap = quota.debug_snapshot()
+    assert sum(c["chips"] for c in snap["claims"]) == 16
+    assert all(c["borrowed"] == c["chips"] for c in snap["claims"])
+
+
+def test_gang_exactly_at_ceiling_and_one_over():
+    quota, _, _ = mk_quota([("team", [("default", 4, 8, True)])])
+    # Exactly at the ceiling: admissible (the bound is inclusive).
+    assert quota.admit(demand("fit", "team", 8)).admitted
+    # The queue is now full: a further gang is contention, hence pending.
+    held = quota.admit(demand("more", "team", 4))
+    assert not held.admitted and held.reason == "queue-ceiling"
+    assert [p["name"] for p in quota.debug_snapshot()["pending"]] == ["more"]
+    # Over the ceiling: a config-shaped rejection, never pending (it
+    # could not be satisfied by any amount of waiting).
+    over = quota.admit(demand("big", "team", 12))
+    assert not over.admitted and over.reason == "gang-exceeds-ceiling"
+    assert "big" not in [p["name"] for p in
+                         quota.debug_snapshot()["pending"]]
+
+
+def test_unknown_tenant_is_config_error_not_contention():
+    quota, _, _ = mk_quota([("team", [("default", 4, 8, True)])])
+    v = quota.admit(demand("x", "nobody", 4))
+    assert not v.admitted and v.reason == "unknown-tenant-or-queue"
+    assert quota.debug_snapshot()["pending"] == []
+
+
+def test_reclaim_racing_voluntary_release():
+    quota, _, preempts = mk_quota([("prod", [("default", 16, 0, True)]),
+                                   ("free", [("default", 0, 0, True)])])
+    assert quota.admit(demand("borrower", "free", 16)).admitted
+    # The guaranteed claim can't fit -> pending + reclaim notice fired.
+    assert not quota.admit(demand("pri", "prod", 16)).admitted
+    assert preempts == [(C.KIND_JOB, "default", "borrower")]
+    # The victim releases voluntarily before its notice deadline...
+    quota.release({"key": (C.KIND_JOB, "default", "borrower")})
+    # ...and the freed chips belong to the guaranteed waiter: another
+    # borrower asking first is held off by the reservation.
+    late = quota.admit(demand("opportunist", "free", 8))
+    assert not late.admitted and late.reason == "reserved-for-escalated"
+    assert quota.admit(demand("pri", "prod", 16)).admitted
+    snap = quota.debug_snapshot()
+    assert [c["name"] for c in snap["claims"]] == ["pri"]
+
+
+def test_reclaim_victim_tie_breaks_youngest_first():
+    quota, _, preempts = mk_quota([("prod", [("default", 16, 0, True)]),
+                                   ("free", [("default", 0, 0, True)])])
+    assert quota.admit(demand("older", "free", 8)).admitted
+    assert quota.admit(demand("younger", "free", 8)).admitted
+    assert not quota.admit(demand("pri", "prod", 8)).admitted
+    # Equal priority: the younger borrower is warned, the older lives.
+    assert preempts == [(C.KIND_JOB, "default", "younger")]
+    claims = {c["name"]: c for c in quota.debug_snapshot()["claims"]}
+    assert claims["younger"]["evicting"] and not claims["older"]["evicting"]
+    # Level-triggered re-ask while the victim drains must not cascade
+    # onto the next borrower: the in-flight reclaim covers the shortfall.
+    assert not quota.admit(demand("pri", "prod", 8)).admitted
+    assert len(preempts) == 1
+
+
+def test_elastic_shrink_cancels_eviction():
+    quota, _, _ = mk_quota([("prod", [("default", 16, 0, True)]),
+                            ("free", [("default", 0, 0, True)])])
+    assert quota.admit(demand("elastic", "free", 16)).admitted
+    assert not quota.admit(demand("pri", "prod", 4)).admitted
+    claims = {c["name"]: c for c in quota.debug_snapshot()["claims"]}
+    assert claims["elastic"]["reclaim_target"] == 12
+    # Shrinking to the reclaim target cancels the eviction entirely.
+    v = quota.admit(demand("elastic", "free", 12))
+    assert v.admitted and v.reason == "resized-shrink"
+    claims = {c["name"]: c for c in quota.debug_snapshot()["claims"]}
+    assert not claims["elastic"]["evicting"]
+    assert quota.admit(demand("pri", "prod", 4)).admitted
+
+
+def test_eviction_completes_after_deadline():
+    quota, clock, _ = mk_quota([("prod", [("default", 16, 0, True)]),
+                                ("free", [("default", 0, 0, True)])])
+    assert quota.admit(demand("borrower", "free", 16)).admitted
+    assert not quota.admit(demand("pri", "prod", 16)).admitted
+    # Inside the notice window the victim stays admitted (it may still
+    # shrink or checkpoint).
+    assert quota.admit(demand("borrower", "free", 16)).reason == \
+        "reclaim-notice"
+    clock["t"] += NOTICE_S + 1.0
+    # Past the deadline with no live pods the claim is freed and the
+    # gang re-queues like any other — and loses to the reservation.
+    v = quota.admit(demand("borrower", "free", 16))
+    assert not v.admitted and not v.evict
+    assert quota.admit(demand("pri", "prod", 16)).admitted
+
+
+def test_starvation_escalates_past_bound():
+    quota, clock, _ = mk_quota([("owner", [("default", 16, 0, True)]),
+                                ("free", [("default", 0, 0, True)])])
+    # The pool is full of *guaranteed* (unreclaimable) capacity.
+    assert quota.admit(demand("o1", "owner", 16)).admitted
+    assert not quota.admit(demand("f1", "free", 4)).admitted
+    # Keep re-asking like a live controller (a gang silent for a whole
+    # bound is GC'd as abandoned), crossing the bound on the last ask.
+    clock["t"] += BOUND_S / 2
+    assert not quota.admit(demand("f1", "free", 4)).admitted
+    clock["t"] += BOUND_S / 2 + 1.0
+    v = quota.admit(demand("f1", "free", 4))
+    assert not v.admitted and v.escalated
+    pend = quota.debug_snapshot()["pending"]
+    assert [p["escalated"] for p in pend] == [True]
+    # Once the owner releases, the escalated gang gets the capacity.
+    quota.release({"key": (C.KIND_JOB, "default", "o1")})
+    assert quota.admit(demand("f1", "free", 4)).admitted
+
+
+def _gang_cluster(name, tenant, chips_replicas=1):
+    c = make_cluster(accelerator="v5p", topology="2x2x2",
+                     replicas=chips_replicas)
+    d = c.to_dict()
+    d["metadata"]["name"] = name
+    d["metadata"]["uid"] = f"uid-{name}"
+    d["spec"]["tenant"] = tenant
+    return d
+
+
+def _counter(metrics, name, **labels):
+    key = (name, tuple(sorted(labels.items())))
+    return metrics.registry._counters.get(key, 0.0)
+
+
+def test_pod_group_status_records_every_verdict():
+    store = ObjectStore()
+    store.create(make_quota_pool_obj(
+        "pool", 8, [("team", [("default", 8, 0, True)])],
+        starvation=BOUND_S, notice=NOTICE_S))
+    clock = {"t": 50.0}
+    metrics = ControlPlaneMetrics()
+    quota = QuotaManager(store, metrics=metrics, clock=lambda: clock["t"])
+    gang = GangScheduler(store, quota=quota, metrics=metrics,
+                         clock=lambda: clock["t"])
+
+    first = _gang_cluster("one", "team")        # 8 chips: fills the pool
+    assert gang.on_cluster_submission(first)
+    pg = store.get("PodGroup", "pg-one")
+    assert pg["status"]["phase"] == "Admitted"
+    assert pg["status"]["reason"] == "admitted"
+    admitted_at = pg["status"]["admittedAt"]
+    assert admitted_at == 50.0
+    assert _counter(metrics, "tpu_gang_admission_total",
+                    verdict="admitted") == 1.0
+
+    # Level-triggered re-submission: status stays put, admittedAt is
+    # stamped once (first admission), not rewritten per reconcile.
+    clock["t"] = 60.0
+    assert gang.on_cluster_submission(first)
+    assert store.get("PodGroup", "pg-one")["status"]["admittedAt"] == \
+        admitted_at
+
+    # A denied gang gets a Pending PodGroup with the denial reason and
+    # the denied counter ticks — the operator-visible evidence.
+    second = _gang_cluster("two", "team")
+    assert not gang.on_cluster_submission(second)
+    pg = store.get("PodGroup", "pg-two")
+    assert pg["status"]["phase"] == "Pending"
+    assert pg["status"]["reason"] == "queue-ceiling"
+    assert "admittedAt" not in pg["status"]
+    assert _counter(metrics, "tpu_gang_admission_total",
+                    verdict="denied") == 1.0
+
+    # cleanup() releases the quota claim: the held gang now fits.
+    gang.cleanup(first)
+    assert store.try_get("PodGroup", "pg-one") is None
+    assert gang.on_cluster_submission(second)
+    assert store.get("PodGroup", "pg-two")["status"]["phase"] == "Admitted"
+
+
+def test_build_demand_carries_quota_identity():
+    d = _gang_cluster("idy", "team")
+    d["spec"]["priority"] = 7
+    d["spec"]["gangSchedulingQueue"] = "q1"
+    dem = build_demand(d)
+    assert dem["tenant"] == "team" and dem["priority"] == 7
+    assert dem["queue"] == "q1"
+    assert dem["key"] == (C.KIND_CLUSTER, "default", "idy")

@@ -1,0 +1,150 @@
+"""Golden metadata shapes for the external gang-scheduler adapters.
+
+Each adapter's pod labels/annotations are a wire contract with a
+scheduler we don't control (SURVEY.md §2.1) — the exact key names and
+values are what Volcano / YuniKorn / KAI / coscheduling parse, so these
+tests pin the *complete* stamped metadata as golden dicts (not just
+spot-checked keys) plus the cleanup() lifecycle for every adapter.
+"""
+
+from __future__ import annotations
+
+import json
+
+from kuberay_tpu.controlplane.store import ObjectStore
+from kuberay_tpu.scheduler.adapters import (KaiAdapter,
+                                            SchedulerPluginsAdapter,
+                                            VolcanoAdapter, YuniKornAdapter)
+from kuberay_tpu.scheduler.gang import GangScheduler
+from kuberay_tpu.utils import constants as C
+from tests.test_api_types import make_cluster
+
+
+def _cluster(queue="research"):
+    c = make_cluster(accelerator="v5p", topology="2x2x2", replicas=2)
+    c.spec.workerGroupSpecs[0].maxReplicas = 2
+    d = c.to_dict()
+    d["metadata"]["uid"] = "uid123"
+    if queue:
+        d["spec"]["gangSchedulingQueue"] = queue
+    return d
+
+
+def _worker_pod():
+    return {"metadata": {"name": "p", "labels": {
+        C.LABEL_NODE_TYPE: C.NODE_TYPE_WORKER,
+        C.LABEL_GROUP: "workers"}}, "spec": {}}
+
+
+def _head_pod():
+    return {"metadata": {"name": "h", "labels": {
+        C.LABEL_NODE_TYPE: C.NODE_TYPE_HEAD}}, "spec": {}}
+
+
+def test_volcano_golden_metadata_and_cleanup():
+    store = ObjectStore()
+    v = VolcanoAdapter(store)
+    cd = _cluster()
+    assert v.on_cluster_submission(cd)
+    pod = _worker_pod()
+    v.add_metadata(cd, pod)
+    assert pod["metadata"]["annotations"] == {
+        "scheduling.k8s.io/group-name": "volcano-pg-demo",
+        "scheduling.volcano.sh/queue-name": "research",
+    }
+    assert pod["spec"]["schedulerName"] == "volcano"
+    pg = store.get("PodGroup", "volcano-pg-demo")
+    assert pg["spec"] == {
+        "minMember": 5,  # head + 2 slices x 2 hosts
+        "minResources": {C.RESOURCE_TPU: 16},
+        "queue": "research",
+    }
+    # No queue configured: the queue annotation is omitted entirely
+    # (volcano falls back to its own default queue).
+    bare = _worker_pod()
+    v.add_metadata(_cluster(queue=""), bare)
+    assert "scheduling.volcano.sh/queue-name" not in \
+        bare["metadata"]["annotations"]
+    v.cleanup(cd)
+    assert store.try_get("PodGroup", "volcano-pg-demo") is None
+    v.cleanup(cd)   # idempotent
+
+
+def test_yunikorn_golden_metadata_and_cleanup():
+    store = ObjectStore()
+    y = YuniKornAdapter(store)
+    cd = _cluster()
+    assert y.on_cluster_submission(cd)
+    worker, head = _worker_pod(), _head_pod()
+    y.add_metadata(cd, worker)
+    y.add_metadata(cd, head)
+    assert worker["metadata"]["labels"]["applicationId"] == "demo"
+    assert worker["metadata"]["labels"]["queue"] == "research"
+    assert worker["spec"]["schedulerName"] == "yunikorn"
+    # The task-groups JSON is the gang contract: head singleton plus one
+    # group per worker group sized replicas x hosts.
+    groups = json.loads(
+        worker["metadata"]["annotations"]["yunikorn.apache.org/task-groups"])
+    assert groups == [
+        {"name": "head", "minMember": 1},
+        {"name": "group-workers", "minMember": 4,
+         "minResource": {C.RESOURCE_TPU: "4"}},
+    ]
+    assert worker["metadata"]["annotations"][
+        "yunikorn.apache.org/task-group-name"] == "group-workers"
+    assert head["metadata"]["annotations"][
+        "yunikorn.apache.org/task-group-name"] == "head"
+    y.cleanup(cd)   # stateless: nothing stored, nothing to fail
+
+
+def test_scheduler_plugins_golden_metadata_and_cleanup():
+    store = ObjectStore()
+    sp = SchedulerPluginsAdapter(store)
+    cd = _cluster()
+    assert sp.on_cluster_submission(cd)
+    pg = store.get("PodGroup", "demo")
+    assert pg["apiVersion"] == "scheduling.x-k8s.io/v1alpha1"
+    assert pg["spec"] == {"minMember": 5,
+                          "minResources": {C.RESOURCE_TPU: 16}}
+    assert pg["metadata"]["ownerReferences"][0]["uid"] == "uid123"
+    pod = _worker_pod()
+    sp.add_metadata(cd, pod)
+    assert pod["metadata"]["labels"]["scheduling.x-k8s.io/pod-group"] == \
+        "demo"
+    assert pod["spec"]["schedulerName"] == "scheduler-plugins-scheduler"
+    sp.cleanup(cd)
+    assert store.try_get("PodGroup", "demo") is None
+    sp.cleanup(cd)  # idempotent
+
+
+def test_kai_golden_metadata_and_cleanup():
+    k = KaiAdapter(ObjectStore())
+    pod = _worker_pod()
+    k.add_metadata(_cluster(), pod)
+    assert pod["metadata"]["labels"]["kai.scheduler/queue"] == "research"
+    assert pod["spec"]["schedulerName"] == "kai-scheduler"
+    # No queue -> KAI's literal "default" queue (not omitted: KAI
+    # requires the label).
+    bare = _worker_pod()
+    k.add_metadata(_cluster(queue=""), bare)
+    assert bare["metadata"]["labels"]["kai.scheduler/queue"] == "default"
+    k.cleanup(_cluster())   # stateless no-op
+
+
+def test_builtin_gang_golden_metadata_and_cleanup():
+    store = ObjectStore()
+    gang = GangScheduler(store)
+    cd = _cluster()
+    assert gang.on_cluster_submission(cd)
+    pod = _worker_pod()
+    gang.add_metadata(cd, pod)
+    assert pod["metadata"]["annotations"] == {"tpu.dev/pod-group": "pg-demo"}
+    assert pod["metadata"]["labels"]["tpu.dev/queue"] == "research"
+    pg = store.get("PodGroup", "pg-demo")
+    assert pg["spec"] == {"minMember": 5,
+                          "minResources": {C.RESOURCE_TPU: 16}}
+    assert pg["metadata"]["labels"] == {"tpu.dev/queue": "research"}
+    assert pg["metadata"]["ownerReferences"][0]["uid"] == "uid123"
+    gang.cleanup(cd)
+    assert store.try_get("PodGroup", "pg-demo") is None
+    gang.cleanup(cd)    # idempotent (and quota-less: no release crash)

@@ -39,7 +39,8 @@ def test_rule_registry_complete():
             "no-io-under-store-lock",
             "shard-affinity",
             "slice-teardown-through-drain-seam",
-            "traffic-weight-through-gate"} <= set(RULES)
+            "traffic-weight-through-gate",
+            "capacity-through-quota-seam"} <= set(RULES)
     for cls in RULES.values():
         assert cls.DESCRIPTION and cls.INVARIANT
 
@@ -957,4 +958,72 @@ def test_weight_gate_ignores_classes_without_the_seam():
         def step(self, svc):
             svc.status.pendingServiceStatus.trafficWeightPercent = 10
     """, only=["traffic-weight-through-gate"])
+    assert fired == set()
+
+
+# ---------------------------------------------------------------------------
+# capacity-through-quota-seam
+# ---------------------------------------------------------------------------
+
+def test_quota_seam_flags_direct_scheduler_ask():
+    findings, fired = _rules_fired("""
+    class Controller:
+        def _admission_verdict(self, cluster):
+            return self.scheduler.on_cluster_submission(cluster.to_dict())
+
+        def _fast_path(self, cluster):
+            return self.scheduler.on_cluster_submission(cluster.to_dict())
+    """, only=["capacity-through-quota-seam"])
+    assert "capacity-through-quota-seam" in fired
+    assert "_fast_path" in findings[0].message
+
+
+def test_quota_seam_flags_create_with_no_earlier_verdict():
+    findings, fired = _rules_fired("""
+    class Controller:
+        def _admission_verdict(self, cluster):
+            return self.scheduler.on_cluster_submission(cluster.to_dict())
+
+        def _reconcile_pods(self, cluster, raw):
+            pod = build_head_pod(cluster, self.config_env)
+            self._create_pod(pod, "head")
+            verdict = self._admission_verdict(cluster)
+    """, only=["capacity-through-quota-seam"])
+    assert "capacity-through-quota-seam" in fired
+    assert "no earlier _admission_verdict" in findings[0].message
+
+
+def test_quota_seam_quiet_when_creates_sit_downstream():
+    _, fired = _rules_fired("""
+    class Controller:
+        def _admission_verdict(self, cluster):
+            return self.scheduler.on_cluster_submission(cluster.to_dict())
+
+        def _reconcile_pods(self, cluster, raw):
+            verdict = self._admission_verdict(cluster)
+            if not verdict:
+                return 5.0
+            pod = build_head_pod(cluster, self.config_env)
+            self._create_pod(pod, "head")
+    """, only=["capacity-through-quota-seam"])
+    assert fired == set()
+
+
+def test_quota_seam_ignores_seamless_classes_and_bare_launchers():
+    # The cron-controller shape: a seam but no pod loop (it launches
+    # TpuJobs, not pods) — and a seamless class creating pods is a
+    # different controller shape, not a funnel violation.
+    _, fired = _rules_fired("""
+    class CronController:
+        def _admission_verdict(self, job):
+            return self.scheduler.quota.admit(self._demand(job))
+
+        def _launch(self, cron, job):
+            if not self._admission_verdict(job):
+                return "quota-held"
+
+    class AdmissionFreeController:
+        def _reconcile_pods(self, cluster, raw):
+            self._create_pod(build_head_pod(cluster, None), "head")
+    """, only=["capacity-through-quota-seam"])
     assert fired == set()

@@ -17,6 +17,18 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu python -m kuberay_tpu.sim \
     --scenario all \
     --seed "${SIM_SEEDS:-0..4}" \
     --steps "${SIM_STEPS:-8}"
+# The contention storm again, deeper: the corpus above runs every
+# scenario (including the three quota scenarios) at the default step
+# budget, but the storm's interesting failure modes — reclaim racing a
+# voluntary release, escalation past the starvation bound, pending GC —
+# need enough virtual minutes of backlog churn to surface.  The quota-*
+# invariants are armed (the scenario mounts the quota seam), so a
+# partially-admitted gang, a conservation breach, or an unescalated
+# starving gang fails the smoke here.
+timeout -k 10 600 env JAX_PLATFORMS=cpu python -m kuberay_tpu.sim \
+    --scenario contention-storm \
+    --seed "${SIM_SEEDS:-0..4}" \
+    --steps "${SIM_STEPS:-16}"
 # The straggler drill again WITH the step tracker mounted: the corpus
 # above runs every scenario telemetry-off (where the straggler
 # invariant is vacuous); this leg arms the detection checker — a slow
