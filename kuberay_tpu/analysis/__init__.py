@@ -17,31 +17,40 @@ or from tests::
     from kuberay_tpu.analysis import run_paths
     findings = run_paths(["kuberay_tpu"])
 
-Per-rule suppression, with a justification comment please::
+Per-rule suppression — the justification is mandatory, a bare
+suppression is itself a finding::
 
-    self._journal.flush()   # kuberay-lint: disable=lock-discipline
+    self._journal.flush()   # kuberay-lint: disable=lock-discipline -- snapshot read; worst case one stale flush
 
 See docs/static-analysis.md for each rule's invariant and how to add one.
 """
 
 from kuberay_tpu.analysis.core import (
+    AnalysisReport,
     Finding,
+    ProjectRule,
     Rule,
     RULES,
     analyze_file,
+    analyze_paths,
     analyze_source,
     iter_python_files,
     run_paths,
 )
 
-# Importing the rules module registers every built-in rule.
+# Importing the rule modules registers every built-in rule (per-file
+# rules first, then the whole-program call-graph rules).
 from kuberay_tpu.analysis import rules as _rules  # noqa: F401
+from kuberay_tpu.analysis import wholeprogram as _wholeprogram  # noqa: F401
 
 __all__ = [
+    "AnalysisReport",
     "Finding",
+    "ProjectRule",
     "Rule",
     "RULES",
     "analyze_file",
+    "analyze_paths",
     "analyze_source",
     "iter_python_files",
     "run_paths",

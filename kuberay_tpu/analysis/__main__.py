@@ -2,17 +2,81 @@
 
 Exit code 0 when clean, 1 when findings remain, 2 on usage errors —
 suitable for CI gates and the tools/lint.sh wrapper.
+
+``--changed-only`` lints just the files git reports as modified or
+untracked — *unless* the call graph shows an unchanged file calling
+into a changed one, in which case the whole repo is linted anyway
+(a wrapper you edited may have broken a seam its callers rely on).
+The graph is always built from every file, so whole-program rules see
+full chains either way; only the reported file set narrows.
 """
 
 from __future__ import annotations
 
 import argparse
+import os
+import subprocess
 import sys
-from typing import List, Optional
+from typing import List, Optional, Set
 
-from kuberay_tpu.analysis.core import RULES, run_paths
+from kuberay_tpu.analysis.core import (RULES, analyze_paths,
+                                       iter_python_files)
+from kuberay_tpu.analysis.graph import build_graph, parse_cached
 from kuberay_tpu.analysis.reporters import (render_human, render_json,
                                             render_rule_list)
+
+
+def _git_changed_files() -> Optional[Set[str]]:
+    """Absolute paths of .py files modified vs HEAD or untracked;
+    None when git is unavailable (caller falls back to whole-repo)."""
+    out: Set[str] = set()
+    for cmd in (["git", "diff", "--name-only", "HEAD"],
+                ["git", "ls-files", "--others", "--exclude-standard"]):
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  check=True)
+        except (OSError, subprocess.CalledProcessError):
+            return None
+        for line in proc.stdout.splitlines():
+            line = line.strip()
+            if line.endswith(".py"):
+                out.add(os.path.abspath(line))
+    return out
+
+
+def _changed_restriction(paths: List[str]) -> Optional[Set[str]]:
+    """The file set to report on, or None for whole-repo (no changes
+    is reported as an empty set; the caller exits clean)."""
+    changed_abs = _git_changed_files()
+    if changed_abs is None:
+        print("kuberay-lint: --changed-only: git unavailable, "
+              "linting whole repo", file=sys.stderr)
+        return None
+    all_files = list(iter_python_files(paths))
+    changed = {f for f in all_files if os.path.abspath(f) in changed_abs}
+    if not changed:
+        return set()
+    triples = []
+    for f in all_files:
+        with open(f, encoding="utf-8", errors="replace") as fh:
+            source = fh.read()
+        try:
+            triples.append((f, source, parse_cached(source, f)))
+        except SyntaxError:
+            continue  # analyze_paths reports it
+    graph = build_graph(triples)
+    for qual in sorted(graph.functions):
+        fn = graph.functions[qual]
+        if fn.path not in changed:
+            continue
+        for site in graph.callers(qual):
+            caller = graph.functions[site.caller]
+            if caller.path not in changed:
+                print(f"kuberay-lint: --changed-only: {fn.path} has "
+                      f"callers in unchanged {caller.path}; linting "
+                      "whole repo", file=sys.stderr)
+                return None
+    return changed
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -28,6 +92,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--keep-suppressed", action="store_true",
                     help="report findings even when a suppression "
                          "comment matches (audit mode)")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="report only on git-changed files (falls back "
+                         "to whole-repo when unchanged callers depend "
+                         "on a changed file)")
     ap.add_argument("--list-rules", action="store_true",
                     help="list registered rules and exit")
     args = ap.parse_args(argv)
@@ -45,12 +113,22 @@ def main(argv: Optional[List[str]] = None) -> int:
                   f"known: {', '.join(sorted(RULES))}", file=sys.stderr)
             return 2
 
-    findings = run_paths(args.paths or ["kuberay_tpu"], only=only,
-                         keep_suppressed=args.keep_suppressed)
-    out = (render_json(findings) if args.format == "json"
-           else render_human(findings))
+    paths = args.paths or ["kuberay_tpu"]
+    restrict: Optional[Set[str]] = None
+    if args.changed_only:
+        restrict = _changed_restriction(paths)
+        if restrict is not None and not restrict:
+            print("kuberay-lint: clean (0 findings) [no changed files]")
+            return 0
+
+    report = analyze_paths(paths, only=only,
+                           keep_suppressed=args.keep_suppressed,
+                           restrict_to=restrict)
+    out = (render_json(report.findings, report.suppressed_counts)
+           if args.format == "json"
+           else render_human(report.findings, report.suppressed_counts))
     print(out)
-    return 1 if findings else 0
+    return 1 if report.findings else 0
 
 
 if __name__ == "__main__":

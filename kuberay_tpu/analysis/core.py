@@ -1,18 +1,24 @@
-"""Analyzer engine: rule registry, suppression comments, file walking.
+"""Analyzer engine: rule registry, suppression comments, file walking,
+and the whole-program pass.
 
-A rule is a class with ``NAME``/``DESCRIPTION``/``INVARIANT`` and a
-``check(tree, ctx)`` generator of :class:`Finding`.  Registration is the
-``@rule`` decorator; the CLI and the pytest gate both consume the same
-registry, so a new rule is one class away from being enforced.
+A rule is a class with ``NAME``/``DESCRIPTION``/``INVARIANT``.  File
+rules implement ``check(tree, ctx)``; whole-program rules subclass
+:class:`ProjectRule` and implement ``check_project(project)`` against
+the project-wide symbol table / call graph (``analysis/graph.py``).
+Registration is the ``@rule`` decorator; the CLI and the pytest gate
+both consume the same registry, so a new rule is one class away from
+being enforced.
 
-Suppressions are source comments, narrowest-scope first:
+Suppressions are source comments, narrowest-scope first, and **must
+carry a reason** after ``--`` (a bare suppression is itself a finding —
+the ``suppression-without-reason`` rule):
 
-- ``# kuberay-lint: disable=RULE[,RULE2]`` on the offending line;
-- ``# kuberay-lint: disable-next-line=RULE`` on the line above;
-- ``# kuberay-lint: disable-file=RULE`` anywhere in the file (whole file).
+- ``# kuberay-lint: disable=RULE[,RULE2] -- <why>`` on the offending line;
+- ``# kuberay-lint: disable-next-line=RULE -- <why>`` on the line above;
+- ``# kuberay-lint: disable-file=RULE -- <why>`` anywhere in the file.
 
 ``disable=all`` matches every rule.  A suppression silences the finding
-but the justification comment stays in the source — that is the point.
+but the justification stays in the source — that is the point.
 """
 
 from __future__ import annotations
@@ -23,11 +29,14 @@ import os
 import re
 import tokenize
 from io import StringIO
-from typing import Dict, Iterable, Iterator, List, Optional, Set
+from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
+
+from kuberay_tpu.analysis.graph import ProjectGraph, parse_cached
 
 SUPPRESS_RE = re.compile(
     r"#\s*kuberay-lint:\s*(disable|disable-next-line|disable-file)"
-    r"\s*=\s*([A-Za-z0-9_,\- ]+)")
+    r"\s*=\s*([A-Za-z0-9_,\- ]+?)"
+    r"(?:\s*--\s*(\S.*))?$")
 
 
 @dataclasses.dataclass
@@ -35,7 +44,9 @@ class Finding:
     """One rule violation at one source location.  ``end_line`` is the
     end of the flagged construct: a ``disable`` comment anywhere inside
     the span suppresses (so the comment can sit on an except-handler's
-    body, not just its header)."""
+    body, not just its header).  Whole-program findings carry ``chain``
+    — the call path root → … → sink, one ``{function, path, line}`` dict
+    per hop, rendered as clickable ``via`` lines."""
 
     rule: str
     path: str
@@ -43,12 +54,21 @@ class Finding:
     col: int
     message: str
     end_line: int = 0
+    chain: Optional[List[Dict[str, object]]] = None
 
     def to_dict(self) -> Dict[str, object]:
-        return dataclasses.asdict(self)
+        d = dataclasses.asdict(self)
+        if self.chain is None:
+            d.pop("chain")
+        return d
 
     def render(self) -> str:
-        return f"{self.path}:{self.line}:{self.col}: [{self.rule}] {self.message}"
+        out = f"{self.path}:{self.line}:{self.col}: [{self.rule}] {self.message}"
+        for hop in self.chain or ():
+            note = f"  ({hop['note']})" if hop.get("note") else ""
+            out += (f"\n    via {hop['path']}:{hop['line']}: "
+                    f"{hop['function']}{note}")
+        return out
 
 
 RULES: Dict[str, type] = {}
@@ -63,21 +83,51 @@ def rule(cls: type) -> type:
 
 
 class Rule:
-    """Base class; subclasses implement ``check``."""
+    """Base class for per-file rules; subclasses implement ``check``."""
 
     NAME = ""
     DESCRIPTION = ""
     INVARIANT = ""
+    SCOPE = "file"
 
     def check(self, tree: ast.Module, ctx: "FileContext") -> Iterable[Finding]:
         raise NotImplementedError
 
-    def finding(self, ctx: "FileContext", node: ast.AST, message: str) -> Finding:
+    def finding(self, ctx: "FileContext", node: ast.AST, message: str,
+                chain: Optional[List[Dict[str, object]]] = None) -> Finding:
         line = getattr(node, "lineno", 0)
         return Finding(rule=self.NAME, path=ctx.path, line=line,
                        col=getattr(node, "col_offset", 0) + 1,
                        message=message,
-                       end_line=getattr(node, "end_lineno", None) or line)
+                       end_line=getattr(node, "end_lineno", None) or line,
+                       chain=chain)
+
+
+class ProjectRule(Rule):
+    """A whole-program rule: sees every file at once, plus the call
+    graph.  Implement ``check_project``; ``check`` never runs."""
+
+    SCOPE = "project"
+
+    def check(self, tree: ast.Module, ctx: "FileContext") -> Iterable[Finding]:
+        return ()
+
+    def check_project(self, project: "ProjectContext") -> Iterable[Finding]:
+        raise NotImplementedError
+
+
+@dataclasses.dataclass
+class Suppression:
+    """One parsed suppression comment."""
+
+    line: int                 # the comment's own line
+    mode: str                 # disable | disable-next-line | disable-file
+    names: Set[str]
+    reason: str               # '' when the comment is bare
+
+    @property
+    def target_line(self) -> int:
+        return self.line + 1 if self.mode == "disable-next-line" else self.line
 
 
 class FileContext:
@@ -86,9 +136,7 @@ class FileContext:
     def __init__(self, path: str, source: str):
         self.path = path
         self.source = source
-        # line -> set of rule names disabled on that line
-        self.line_disables: Dict[int, Set[str]] = {}
-        self.file_disables: Set[str] = set()
+        self.suppressions: List[Suppression] = []
         self._parse_suppressions()
 
     def _parse_suppressions(self) -> None:
@@ -104,23 +152,51 @@ class FileContext:
             m = SUPPRESS_RE.search(text)
             if m is None:
                 continue
-            mode, names = m.group(1), {
-                n.strip() for n in m.group(2).split(",") if n.strip()}
-            if mode == "disable-file":
-                self.file_disables |= names
-            elif mode == "disable-next-line":
-                self.line_disables.setdefault(lineno + 1, set()).update(names)
-            else:
-                self.line_disables.setdefault(lineno, set()).update(names)
+            names = {n.strip() for n in m.group(2).split(",") if n.strip()}
+            self.suppressions.append(Suppression(
+                line=lineno, mode=m.group(1), names=names,
+                reason=(m.group(3) or "").strip()))
 
     def suppressed(self, finding: Finding) -> bool:
-        def hit(names: Set[str]) -> bool:
-            return "all" in names or finding.rule in names
-        if hit(self.file_disables):
-            return True
         last = max(finding.line, finding.end_line or finding.line)
-        return any(hit(self.line_disables.get(ln, set()))
-                   for ln in range(finding.line, last + 1))
+        for rec in self.suppressions:
+            if "all" not in rec.names and finding.rule not in rec.names:
+                continue
+            if finding.rule == "suppression-without-reason" and \
+                    not rec.reason:
+                # a bare suppression cannot silence the finding ABOUT
+                # bare suppressions — that would defeat the hygiene rule
+                continue
+            if rec.mode == "disable-file":
+                return True
+            if finding.line <= rec.target_line <= last:
+                return True
+        return False
+
+
+class ProjectContext:
+    """What whole-program rules see: every parsed file (with its
+    suppression context) plus the finalized call graph."""
+
+    def __init__(self, graph: ProjectGraph,
+                 files: List[Tuple[str, str, ast.Module, FileContext]]):
+        self.graph = graph
+        self.files = files
+        self.contexts: Dict[str, FileContext] = {
+            path: ctx for path, _, _, ctx in files}
+
+    def suppressed(self, finding: Finding) -> bool:
+        ctx = self.contexts.get(finding.path)
+        return ctx is not None and ctx.suppressed(finding)
+
+
+@dataclasses.dataclass
+class AnalysisReport:
+    """Findings plus the suppression ledger (per-rule suppressed counts
+    — ``--format json`` exposes these so CI can trend them)."""
+
+    findings: List[Finding]
+    suppressed_counts: Dict[str, int]
 
 
 def _selected_rules(only: Optional[Iterable[str]] = None) -> List[Rule]:
@@ -134,25 +210,63 @@ def _selected_rules(only: Optional[Iterable[str]] = None) -> List[Rule]:
     return [RULES[n]() for n in names]
 
 
+def _split_rules(only):
+    selected = _selected_rules(only)
+    file_rules = [r for r in selected if r.SCOPE == "file"]
+    project_rules = [r for r in selected if r.SCOPE == "project"]
+    return file_rules, project_rules
+
+
+def _sort(findings: List[Finding]) -> List[Finding]:
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule, f.message))
+    return findings
+
+
+def _partition(findings, ctx_lookup, keep_suppressed, counts):
+    out = []
+    for f in findings:
+        if ctx_lookup(f):
+            counts[f.rule] = counts.get(f.rule, 0) + 1
+            if keep_suppressed:
+                out.append(f)
+        else:
+            out.append(f)
+    return out
+
+
 def analyze_source(source: str, path: str = "<string>",
                    only: Optional[Iterable[str]] = None,
                    keep_suppressed: bool = False) -> List[Finding]:
     """Run rules over one source string; returns unsuppressed findings
-    (all findings when ``keep_suppressed``)."""
+    (all findings when ``keep_suppressed``).  Whole-program rules see a
+    single-file project — enough for same-file wrapper fixtures."""
     ctx = FileContext(path, source)
     try:
-        tree = ast.parse(source, filename=path)
+        tree = parse_cached(source, path)
     except SyntaxError as e:
         return [Finding(rule="parse-error", path=path,
                         line=e.lineno or 0, col=(e.offset or 0),
                         message=f"could not parse: {e.msg}")]
-    out: List[Finding] = []
-    for r in _selected_rules(only):
-        for f in r.check(tree, ctx):
-            if keep_suppressed or not ctx.suppressed(f):
-                out.append(f)
-    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
-    return out
+    file_rules, project_rules = _split_rules(only)
+    raw: List[Finding] = []
+    for r in file_rules:
+        raw.extend(r.check(tree, ctx))
+    if project_rules:
+        project = ProjectContext(
+            _build_graph([(path, source, tree)]),
+            [(path, source, tree, ctx)])
+        for r in project_rules:
+            raw.extend(r.check_project(project))
+    out = [f for f in raw if keep_suppressed or not ctx.suppressed(f)]
+    return _sort(out)
+
+
+def _build_graph(triples) -> ProjectGraph:
+    g = ProjectGraph()
+    for path, source, tree in triples:
+        g.add_file(path, source, tree)
+    g.finalize()
+    return g
 
 
 def analyze_file(path: str, only: Optional[Iterable[str]] = None,
@@ -186,11 +300,55 @@ def iter_python_files(paths: Iterable[str]) -> Iterator[str]:
                     yield full
 
 
+def analyze_paths(paths: Iterable[str],
+                  only: Optional[Iterable[str]] = None,
+                  keep_suppressed: bool = False,
+                  restrict_to: Optional[Set[str]] = None) -> AnalysisReport:
+    """The whole-program entry point: parse every .py under ``paths``
+    once (content-hash cached), run file rules per file and project
+    rules over the full graph, and return findings plus the per-rule
+    suppressed-count ledger.
+
+    ``restrict_to`` (absolute or as-walked paths) limits *reporting* to
+    those files — the graph is still built from everything, so chains
+    through unchanged files stay visible (``--changed-only``)."""
+    file_rules, project_rules = _split_rules(only)
+    parsed: List[Tuple[str, str, ast.Module, FileContext]] = []
+    raw: List[Finding] = []
+    for path in iter_python_files(paths):
+        with open(path, encoding="utf-8", errors="replace") as fh:
+            source = fh.read()
+        ctx = FileContext(path, source)
+        try:
+            tree = parse_cached(source, path)
+        except SyntaxError as e:
+            raw.append(Finding(rule="parse-error", path=path,
+                               line=e.lineno or 0, col=(e.offset or 0),
+                               message=f"could not parse: {e.msg}"))
+            continue
+        parsed.append((path, source, tree, ctx))
+        if restrict_to is None or path in restrict_to:
+            for r in file_rules:
+                raw.extend(r.check(tree, ctx))
+    if project_rules and parsed:
+        project = ProjectContext(
+            _build_graph([(p, s, t) for p, s, t, _ in parsed]), parsed)
+        for r in project_rules:
+            for f in r.check_project(project):
+                if restrict_to is None or f.path in restrict_to:
+                    raw.append(f)
+    contexts = {path: ctx for path, _, _, ctx in parsed}
+    counts: Dict[str, int] = {}
+    out = _partition(
+        raw, lambda f: (f.path in contexts and
+                        contexts[f.path].suppressed(f)),
+        keep_suppressed, counts)
+    return AnalysisReport(_sort(out), counts)
+
+
 def run_paths(paths: Iterable[str], only: Optional[Iterable[str]] = None,
               keep_suppressed: bool = False) -> List[Finding]:
-    """Analyze every .py under ``paths``; findings sorted by location."""
-    out: List[Finding] = []
-    for path in iter_python_files(paths):
-        out.extend(analyze_file(path, only=only,
-                                keep_suppressed=keep_suppressed))
-    return out
+    """Analyze every .py under ``paths``; findings sorted by location.
+    (Compatibility face of :func:`analyze_paths`.)"""
+    return analyze_paths(paths, only=only,
+                         keep_suppressed=keep_suppressed).findings

@@ -1,0 +1,316 @@
+"""Dataflow layers over :class:`~kuberay_tpu.analysis.graph.ProjectGraph`.
+
+Three small analyses, each returning *call chains* (root → … → sink)
+so every whole-program finding can print the exact wrapper path that
+defeats a seam:
+
+- :func:`reach` — forward reachability from a root set with parent
+  links, optionally refusing to traverse *through* a set of sanitizer
+  / seam nodes (a path that enters the seam is, by definition, not a
+  bypass);
+- :func:`sink_closure` — for every function, the first chain to a
+  matching call sink (used for transitive blocking-under-lock: the
+  closure is computed once, then consulted at every locked call site);
+- :class:`EscapeAnalysis` — per-function escaping exception types with
+  the raise site and chain, honouring try/except handlers along the
+  way (name-based, with the project class hierarchy and a small
+  builtin table for broad handlers).
+
+All iteration orders are sorted, so analyzer output is byte-stable
+across runs and processes — the same determinism bar the sim journal
+holds itself to.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Callable, Dict, Iterable, List, Optional, Set, Tuple
+
+from kuberay_tpu.analysis.graph import CallSite, FunctionNode, ProjectGraph
+
+__all__ = ["reach", "chain_to", "sink_closure", "EscapeAnalysis", "Hop"]
+
+
+class Hop:
+    """One link of a reported call chain."""
+
+    __slots__ = ("qualname", "path", "line", "note")
+
+    def __init__(self, qualname: str, path: str, line: int, note: str = ""):
+        self.qualname = qualname
+        self.path = path
+        self.line = line
+        self.note = note
+
+    def to_dict(self) -> Dict[str, object]:
+        d = {"function": self.qualname, "path": self.path, "line": self.line}
+        if self.note:
+            d["note"] = self.note
+        return d
+
+
+# ---------------------------------------------------------------------------
+# reachability
+# ---------------------------------------------------------------------------
+
+def reach(graph: ProjectGraph, roots: Iterable[str],
+          avoid: Iterable[str] = ()) -> Dict[str, Optional[CallSite]]:
+    """BFS over the call graph from ``roots``.  Returns
+    ``{reachable qualname: parent CallSite}`` (roots map to ``None``).
+    Nodes in ``avoid`` are never *expanded* (their callees stay
+    unreached through them) — pass seam methods here so "reachable
+    without passing through the seam" falls out directly."""
+    avoid_set = set(avoid)
+    parents: Dict[str, Optional[CallSite]] = {}
+    frontier: List[str] = []
+    for r in sorted(set(roots)):
+        if r in graph.functions and r not in parents:
+            parents[r] = None
+            frontier.append(r)
+    while frontier:
+        nxt: List[str] = []
+        for qual in frontier:
+            if qual in avoid_set:
+                continue
+            for site in graph.callees(qual):
+                if site.callee not in parents:
+                    parents[site.callee] = site
+                    nxt.append(site.callee)
+        frontier = sorted(nxt)
+    return parents
+
+
+def chain_to(graph: ProjectGraph, parents: Dict[str, Optional[CallSite]],
+             target: str) -> List[Hop]:
+    """Reconstruct root → … → target as hops; each hop's ``line`` is
+    where the *next* function is entered (the call site), and the first
+    hop is the root's own definition line."""
+    if target not in parents:
+        return []
+    sites: List[CallSite] = []
+    cur = target
+    while parents.get(cur) is not None:
+        site = parents[cur]
+        sites.append(site)
+        cur = site.caller
+    root_fn = graph.functions[cur]
+    hops = [Hop(cur, root_fn.path, root_fn.line)]
+    for site in reversed(sites):
+        note = "registered callback" if site.kind == "ref" else ""
+        hops.append(Hop(site.callee, site.path, site.line, note))
+    return hops
+
+
+# ---------------------------------------------------------------------------
+# sink closure (transitive blocking etc.)
+# ---------------------------------------------------------------------------
+
+def sink_closure(graph: ProjectGraph,
+                 sink: Callable[[str, FunctionNode], Optional[str]],
+                 kinds: Iterable[str] = ("call", "ref")
+                 ) -> Dict[str, List[Hop]]:
+    """For every function that can reach a *call sink*, the shortest
+    chain ``[... , sink-call hop]``.
+
+    ``sink(normalized_name, fn)`` returns a human label when the named
+    call inside ``fn`` is a sink (else None).  The closure propagates
+    backwards over the edge ``kinds`` given — both by default (a
+    registered callback that blocks still blocks); pass ``("call",)``
+    for properties that do not cross thread/callback boundaries, like
+    lock-hold analysis (a Thread target's I/O does not run under the
+    spawner's lock).  Chains are minimal-length and deterministic
+    (sorted tie-breaks)."""
+    kind_set = set(kinds)
+    chains: Dict[str, List[Hop]] = {}
+    # seed: functions with a direct sink call
+    for qual in sorted(graph.functions):
+        fn = graph.functions[qual]
+        for name, line, _col, _node in fn.raw_calls:
+            label = sink(name, fn)
+            if label is not None:
+                chains[qual] = [Hop(qual, fn.path, line, label)]
+                break
+    # propagate callers-of: BFS layers give shortest chains
+    frontier = sorted(chains)
+    while frontier:
+        nxt: List[str] = []
+        for qual in frontier:
+            for site in sorted(graph.callers(qual),
+                               key=lambda s: (s.caller, s.line)):
+                if site.kind not in kind_set or site.caller in chains:
+                    continue
+                caller_fn = graph.functions[site.caller]
+                chains[site.caller] = \
+                    [Hop(site.caller, caller_fn.path, site.line)] + \
+                    chains[qual]
+                nxt.append(site.caller)
+        frontier = sorted(nxt)
+    return chains
+
+
+# ---------------------------------------------------------------------------
+# exception escape
+# ---------------------------------------------------------------------------
+
+#: Builtin exception subtyping the handler matcher understands.  Keys
+#: are handler names; values are the raised names they also catch.
+_BUILTIN_CATCHES: Dict[str, Set[str]] = {
+    "BaseException": {"*"},
+    "Exception": {"*"},
+    "OSError": {"IOError", "FileNotFoundError", "ConnectionError",
+                "TimeoutError", "PermissionError"},
+    "LookupError": {"KeyError", "IndexError"},
+    "ValueError": {"UnicodeDecodeError"},
+    "ArithmeticError": {"ZeroDivisionError", "OverflowError"},
+    "RuntimeError": {"RecursionError", "NotImplementedError"},
+}
+
+
+class EscapeAnalysis:
+    """Which exception types can escape each function, with the raise
+    site and call chain.
+
+    Explicit ``raise Name(...)`` statements are the sources (library-
+    internal raises are invisible to static analysis and out of scope).
+    A raise escapes its function unless an enclosing ``try`` in the
+    same function has a matching handler; an escape propagates to a
+    caller unless the *call site* is inside a matching ``try``.  Handler
+    matching is name-based, widened by the project class hierarchy
+    (``except StoreError`` catches ``Conflict(StoreError)``) and the
+    builtin table above."""
+
+    def __init__(self, graph: ProjectGraph):
+        self.graph = graph
+        #: exception class name -> its base names (project classes)
+        self._bases: Dict[str, List[str]] = {}
+        for qual in sorted(graph.classes):
+            cls = graph.classes[qual]
+            self._bases.setdefault(cls.name, [b.split(".")[-1]
+                                              for b in cls.bases])
+        #: function -> {exc name: (raise Hop chain tail)}
+        self._escapes: Dict[str, Dict[str, List[Hop]]] = {}
+        self._in_progress: Set[str] = set()
+
+    # -- handler matching -----------------------------------------------
+
+    def _catches(self, handler_name: str, exc_name: str,
+                 _seen: Optional[Set[str]] = None) -> bool:
+        if handler_name in ("", "BaseException", "Exception"):
+            return True
+        if handler_name == exc_name:
+            return True
+        if exc_name in _BUILTIN_CATCHES.get(handler_name, ()):
+            return True
+        # project hierarchy: walk exc's bases up to the handler
+        seen = _seen or set()
+        if exc_name in seen:
+            return False
+        seen.add(exc_name)
+        for base in self._bases.get(exc_name, ()):  # may be builtin names
+            if base == handler_name or \
+                    self._catches(handler_name, base, seen):
+                return True
+        return False
+
+    def _handler_names(self, try_node: ast.Try) -> List[str]:
+        names: List[str] = []
+        for handler in try_node.handlers:
+            if handler.type is None:
+                names.append("")
+            elif isinstance(handler.type, ast.Tuple):
+                for elt in handler.type.elts:
+                    d = _last_name(elt)
+                    if d:
+                        names.append(d)
+            else:
+                d = _last_name(handler.type)
+                if d:
+                    names.append(d)
+        return names
+
+    def _caught_at(self, fn_node, target: ast.AST, exc_name: str) -> bool:
+        """Is ``target`` (a raise or call node) inside a try whose
+        handlers catch ``exc_name``, within this function?"""
+        for try_node in ast.walk(fn_node):
+            if not isinstance(try_node, ast.Try):
+                continue
+            in_body = any(_contains(stmt, target) for stmt in try_node.body)
+            if not in_body:
+                continue
+            for hname in self._handler_names(try_node):
+                if self._catches(hname, exc_name):
+                    return True
+        return False
+
+    # -- per-function escapes -------------------------------------------
+
+    def escapes(self, qualname: str) -> Dict[str, List[Hop]]:
+        """``{exception name: chain of hops ending at the raise site}``
+        for exceptions that can propagate out of ``qualname``."""
+        memo = self._escapes.get(qualname)
+        if memo is not None:
+            return memo
+        if qualname in self._in_progress:      # recursion: assume clean
+            return {}
+        self._in_progress.add(qualname)
+        fn = self.graph.functions.get(qualname)
+        out: Dict[str, List[Hop]] = {}
+        if fn is None:
+            self._in_progress.discard(qualname)
+            self._escapes[qualname] = out
+            return out
+        # (a) explicit raises in this body
+        for node in self.graph._own_nodes(fn.node):
+            if not isinstance(node, ast.Raise) or node.exc is None:
+                continue
+            name = _last_name(node.exc.func if isinstance(node.exc, ast.Call)
+                              else node.exc)
+            if not name:
+                continue
+            if not self._caught_at(fn.node, node, name):
+                out.setdefault(name, [Hop(
+                    qualname, fn.path, node.lineno, f"raises {name}")])
+        # (b) escapes from resolved callees at uncaught call sites
+        for site in sorted(self.graph.callees(qualname),
+                           key=lambda s: (s.line, s.callee)):
+            if site.kind != "call":
+                continue
+            callee_esc = self.escapes(site.callee)
+            if not callee_esc:
+                continue
+            call_node = _call_at(fn.node, site.line, site.callee,
+                                 self.graph)
+            for exc_name in sorted(callee_esc):
+                if exc_name in out:
+                    continue
+                if call_node is not None and \
+                        self._caught_at(fn.node, call_node, exc_name):
+                    continue
+                out[exc_name] = [Hop(qualname, fn.path, site.line)] + \
+                    callee_esc[exc_name]
+        self._in_progress.discard(qualname)
+        self._escapes[qualname] = out
+        return out
+
+
+def _last_name(node: ast.AST) -> str:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return ""
+
+
+def _contains(root: ast.AST, target: ast.AST) -> bool:
+    for sub in ast.walk(root):
+        if sub is target:
+            return True
+    return False
+
+
+def _call_at(fn_node, line: int, callee: str, graph: ProjectGraph
+             ) -> Optional[ast.Call]:
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Call) and node.lineno == line:
+            return node
+    return None

@@ -1,0 +1,534 @@
+"""Whole-program symbol table + call graph for the invariant linter.
+
+The per-file AST rules in :mod:`kuberay_tpu.analysis.rules` see one
+module at a time, so a one-line wrapper function defeats any of the
+seam-funnel rules.  This module gives rules the whole program:
+
+- a **symbol table** of every module-level function, class, and method
+  under the analyzed roots (qualnames are ``module:Class.method`` /
+  ``module:function``);
+- a **call graph** whose edges resolve ``self.method()`` calls through
+  the enclosing class (and its project bases), ``self.attr.method()``
+  through constructor-assigned attribute types, local ``var = Cls()``
+  instances, plain and ``from``-imported module functions, constructor
+  calls, and **bound-method references** passed as call arguments — the
+  ``manager.register(kind, self.cluster_controller.reconcile)`` /
+  ``threading.Thread(target=self._loop)`` registration idiom the
+  controllers and the sim harness are built on;
+- **normalized external call names** per function (import aliases
+  rewritten to real module paths, ``from x import y`` rewritten to
+  ``x.y``), which is what the nondeterminism / blocking sinks match
+  against.
+
+Per-file extraction is cached by content hash (sha256 of the source),
+so the pytest gate, the CLI, and ``--changed-only`` runs share parses
+within a process and whole-repo runs stay fast.
+
+The graph is deliberately conservative in both directions: an edge is
+added only when the target resolves to a project symbol (no guessing),
+and reference edges over-approximate reachability (a callback that is
+registered but never fired still counts as reachable — for determinism
+and seam analysis that is the safe side).
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+__all__ = ["ProjectGraph", "FunctionNode", "ClassNode", "CallSite",
+           "build_graph"]
+
+
+# ---------------------------------------------------------------------------
+# small AST helpers (kept local so graph.py has no import cycle with rules)
+# ---------------------------------------------------------------------------
+
+def _dotted(node: ast.AST) -> str:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _module_name_for(path: str) -> str:
+    """Dotted module name for a file path: the part from the last
+    well-known package root down (``kuberay_tpu.controlplane.store``),
+    falling back to the bare stem for loose fixture files."""
+    norm = path.replace("\\", "/")
+    if norm.endswith(".py"):
+        norm = norm[:-3]
+    parts = [p for p in norm.split("/") if p and p != "."]
+    for anchor in ("kuberay_tpu", "tests", "benchmark", "tools"):
+        if anchor in parts:
+            parts = parts[parts.index(anchor):]
+            break
+    else:
+        parts = parts[-1:]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+# ---------------------------------------------------------------------------
+# data model
+# ---------------------------------------------------------------------------
+
+class CallSite:
+    """One resolved edge: ``caller`` invokes (or references) ``callee``
+    at ``path:line``.  ``kind`` is 'call' for an invocation, 'ref' for a
+    bound-method reference passed as an argument (callback registration)."""
+
+    __slots__ = ("caller", "callee", "path", "line", "col", "kind")
+
+    def __init__(self, caller: str, callee: str, path: str, line: int,
+                 col: int, kind: str = "call"):
+        self.caller = caller
+        self.callee = callee
+        self.path = path
+        self.line = line
+        self.col = col
+        self.kind = kind
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return (f"CallSite({self.caller} -> {self.callee} "
+                f"@ {self.path}:{self.line} [{self.kind}])")
+
+
+class FunctionNode:
+    """A module function, method, or nested function."""
+
+    __slots__ = ("qualname", "name", "module", "path", "line", "node",
+                 "class_qualname", "raw_calls")
+
+    def __init__(self, qualname, name, module, path, line, node,
+                 class_qualname):
+        self.qualname = qualname
+        self.name = name
+        self.module = module
+        self.path = path
+        self.line = line
+        self.node = node
+        self.class_qualname = class_qualname
+        #: normalized external call names: (dotted, line, col, call node)
+        self.raw_calls: List[Tuple[str, int, int, ast.Call]] = []
+
+
+class ClassNode:
+    __slots__ = ("qualname", "name", "module", "path", "line", "bases",
+                 "methods", "attr_types", "class_attrs")
+
+    def __init__(self, qualname, name, module, path, line, bases):
+        self.qualname = qualname
+        self.name = name
+        self.module = module
+        self.path = path
+        self.line = line
+        #: base-class names as written (resolved lazily via imports)
+        self.bases: List[str] = bases
+        #: method name -> function qualname
+        self.methods: Dict[str, str] = {}
+        #: self.<attr> -> class qualname (from ctor assignments)
+        self.attr_types: Dict[str, str] = {}
+        #: names of class-level attributes (KIND etc.)
+        self.class_attrs: Set[str] = set()
+
+
+class _ModuleSummary:
+    """Everything graph construction needs from one file, extracted in a
+    single AST pass and cached by content hash."""
+
+    __slots__ = ("path", "module", "import_aliases", "from_imports",
+                 "functions", "classes", "tree")
+
+    def __init__(self, path: str, module: str, tree: ast.Module):
+        self.path = path
+        self.module = module
+        self.tree = tree
+        #: local alias -> real dotted module ("np" -> "numpy")
+        self.import_aliases: Dict[str, str] = {}
+        #: local name -> (module, attr) for ``from m import a [as b]``
+        self.from_imports: Dict[str, Tuple[str, str]] = {}
+        self.functions: Dict[str, FunctionNode] = {}
+        self.classes: Dict[str, ClassNode] = {}
+        self._extract()
+
+    # -- extraction ------------------------------------------------------
+
+    def _extract(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    self.import_aliases[alias.asname or
+                                        alias.name.split(".")[0]] = \
+                        alias.name if alias.asname else \
+                        alias.name.split(".")[0]
+                    if alias.asname:
+                        self.import_aliases[alias.asname] = alias.name
+            elif isinstance(node, ast.ImportFrom):
+                if node.module is None:
+                    continue
+                for alias in node.names:
+                    self.from_imports[alias.asname or alias.name] = (
+                        node.module, alias.name)
+        self._extract_scope(self.tree.body, prefix="", class_node=None)
+
+    def _extract_scope(self, body, prefix: str,
+                       class_node: Optional[ClassNode]) -> None:
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{self.module}:{prefix}{node.name}"
+                fn = FunctionNode(qual, node.name, self.module, self.path,
+                                  node.lineno, node,
+                                  class_node.qualname if class_node else None)
+                self.functions[qual] = fn
+                if class_node is not None:
+                    class_node.methods.setdefault(node.name, qual)
+                # nested defs get their own nodes (edges resolved later)
+                self._extract_scope(node.body, prefix + node.name + ".",
+                                    class_node=None)
+            elif isinstance(node, ast.ClassDef):
+                qual = f"{self.module}:{prefix}{node.name}"
+                cls = ClassNode(qual, node.name, self.module, self.path,
+                                node.lineno,
+                                [_dotted(b) for b in node.bases if _dotted(b)])
+                self.classes[qual] = cls
+                for stmt in node.body:
+                    if isinstance(stmt, ast.Assign):
+                        for tgt in stmt.targets:
+                            if isinstance(tgt, ast.Name):
+                                cls.class_attrs.add(tgt.id)
+                    elif isinstance(stmt, ast.AnnAssign) and \
+                            isinstance(stmt.target, ast.Name):
+                        cls.class_attrs.add(stmt.target.id)
+                self._extract_scope(node.body, prefix + node.name + ".",
+                                    class_node=cls)
+
+
+#: content-hash -> parsed tree (shared with core.analyze via parse_cached)
+_TREE_CACHE: Dict[str, ast.Module] = {}
+#: (content-hash, path) -> _ModuleSummary.  The path is part of the key:
+#: two identical files at different paths must not share a summary, or
+#: the second one's FunctionNodes would report the first one's location.
+_SUMMARY_CACHE: Dict[Tuple[str, str], _ModuleSummary] = {}
+
+
+def content_hash(source: str) -> str:
+    return hashlib.sha256(source.encode("utf-8", "replace")).hexdigest()
+
+
+def parse_cached(source: str, path: str) -> ast.Module:
+    """``ast.parse`` with a content-hash cache: the pytest gate, the
+    CLI, and repeated whole-program passes share one parse per file
+    version.  Raises ``SyntaxError`` like ``ast.parse``."""
+    key = content_hash(source)
+    tree = _TREE_CACHE.get(key)
+    if tree is None:
+        tree = ast.parse(source, filename=path)
+        _TREE_CACHE[key] = tree
+    return tree
+
+
+def _summarize(path: str, source: str, tree: ast.Module) -> _ModuleSummary:
+    module = _module_name_for(path)
+    key = (content_hash(source), path)
+    summary = _SUMMARY_CACHE.get(key)
+    if summary is None:
+        summary = _ModuleSummary(path, module, tree)
+        _SUMMARY_CACHE[key] = summary
+    return summary
+
+
+# ---------------------------------------------------------------------------
+# the graph
+# ---------------------------------------------------------------------------
+
+class ProjectGraph:
+    """Symbol table + resolved call graph over a set of parsed files."""
+
+    def __init__(self):
+        self.functions: Dict[str, FunctionNode] = {}
+        self.classes: Dict[str, ClassNode] = {}
+        #: caller qualname -> outgoing edges (deterministic order)
+        self.edges: Dict[str, List[CallSite]] = {}
+        #: callee qualname -> incoming edges
+        self.redges: Dict[str, List[CallSite]] = {}
+        self._modules: Dict[str, _ModuleSummary] = {}
+        #: bare class name -> [qualnames] (cross-module resolution)
+        self._class_by_name: Dict[str, List[str]] = {}
+        self._func_by_modname: Dict[Tuple[str, str], str] = {}
+
+    # -- construction ----------------------------------------------------
+
+    def add_file(self, path: str, source: str, tree: ast.Module) -> None:
+        summary = _summarize(path, source, tree)
+        self._modules[summary.module] = summary
+        self.functions.update(summary.functions)
+        self.classes.update(summary.classes)
+        for qual, cls in summary.classes.items():
+            self._class_by_name.setdefault(cls.name, []).append(qual)
+        for qual, fn in summary.functions.items():
+            self._func_by_modname[(fn.module, fn.name)] = qual
+
+    def finalize(self) -> None:
+        """Resolve attribute types, then every call site.  Idempotent
+        per build; call once after the last ``add_file``."""
+        for cls in self.classes.values():
+            self._infer_attr_types(cls)
+        for qual in sorted(self.functions):
+            self._resolve_function(self.functions[qual])
+
+    # -- symbol resolution ----------------------------------------------
+
+    def _lookup_class(self, name: str, module: str) -> Optional[str]:
+        """Resolve a (possibly dotted) class name as seen from
+        ``module`` to a project class qualname."""
+        if not name:
+            return None
+        summary = self._modules.get(module)
+        head, _, rest = name.partition(".")
+        if summary is not None:
+            if head in summary.from_imports and not rest:
+                src_mod, attr = summary.from_imports[head]
+                qual = f"{src_mod}:{attr}"
+                if qual in self.classes:
+                    return qual
+                # from-import of a re-export: fall through to bare-name
+            if head in summary.import_aliases and rest:
+                qual = f"{summary.import_aliases[head]}:{rest}"
+                if qual in self.classes:
+                    return qual
+        qual = f"{module}:{name}"
+        if qual in self.classes:
+            return qual
+        # unique bare name anywhere in the project
+        cands = self._class_by_name.get(name.split(".")[-1], [])
+        if len(cands) == 1:
+            return cands[0]
+        return None
+
+    def resolve_method(self, class_qual: str, method: str,
+                       _seen: Optional[Set[str]] = None) -> Optional[str]:
+        """Method lookup through the project-local MRO (depth-first over
+        declared bases)."""
+        cls = self.classes.get(class_qual)
+        if cls is None:
+            return None
+        if method in cls.methods:
+            return cls.methods[method]
+        seen = _seen or set()
+        seen.add(class_qual)
+        for base in cls.bases:
+            base_qual = self._lookup_class(base, cls.module)
+            if base_qual and base_qual not in seen:
+                hit = self.resolve_method(base_qual, method, seen)
+                if hit:
+                    return hit
+        return None
+
+    def _infer_attr_types(self, cls: ClassNode) -> None:
+        """``self.x = ClassName(...)`` in any method (plus annotated
+        ``self.x: ClassName``) types the attribute for
+        ``self.x.method()`` resolution."""
+        for mname, fq in cls.methods.items():
+            fn = self.functions.get(fq)
+            if fn is None:
+                continue
+            for node in ast.walk(fn.node):
+                target = value = None
+                if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                    target, value = node.targets[0], node.value
+                elif isinstance(node, ast.AnnAssign):
+                    target, value = node.target, node.value
+                if not (isinstance(target, ast.Attribute) and
+                        isinstance(target.value, ast.Name) and
+                        target.value.id == "self"):
+                    continue
+                typ = None
+                if isinstance(value, ast.Call):
+                    typ = self._lookup_class(_dotted(value.func), cls.module)
+                if typ is None and isinstance(node, ast.AnnAssign):
+                    ann = node.annotation
+                    ann_name = _dotted(ann) if not isinstance(
+                        ann, ast.Subscript) else _dotted(ann.value)
+                    if ann_name not in ("Optional", "List", "Dict"):
+                        typ = self._lookup_class(ann_name, cls.module)
+                if typ is not None:
+                    cls.attr_types.setdefault(target.attr, typ)
+
+    # -- call resolution -------------------------------------------------
+
+    def _normalize(self, dotted: str, module: str) -> str:
+        """Rewrite the leading segment through the module's import
+        table: ``_time.sleep`` -> ``time.sleep``, ``dt.now`` ->
+        ``datetime.now``, from-imported ``sleep`` -> ``time.sleep``."""
+        if not dotted:
+            return dotted
+        summary = self._modules.get(module)
+        if summary is None:
+            return dotted
+        head, _, rest = dotted.partition(".")
+        if head in summary.from_imports:
+            src_mod, attr = summary.from_imports[head]
+            base = f"{src_mod}.{attr}"
+            return f"{base}.{rest}" if rest else base
+        if head in summary.import_aliases:
+            real = summary.import_aliases[head]
+            return f"{real}.{rest}" if rest else real
+        return dotted
+
+    def _receiver_type(self, expr: ast.AST, fn: FunctionNode,
+                       local_types: Dict[str, str]) -> Optional[str]:
+        """Class qualname of the value of ``expr`` inside ``fn``:
+        ``self``, ``self.attr[.attr...]``, or a locally-typed name."""
+        if isinstance(expr, ast.Name):
+            if expr.id == "self" and fn.class_qualname:
+                return fn.class_qualname
+            return local_types.get(expr.id)
+        if isinstance(expr, ast.Attribute):
+            base_type = self._receiver_type(expr.value, fn, local_types)
+            if base_type is None:
+                return None
+            cls = self.classes.get(base_type)
+            if cls is None:
+                return None
+            return cls.attr_types.get(expr.attr)
+        return None
+
+    def _resolve_callable_expr(self, expr: ast.AST, fn: FunctionNode,
+                               local_types: Dict[str, str]
+                               ) -> Optional[str]:
+        """Resolve a callable expression to a project function qualname
+        (methods via receiver type, functions via imports, classes to
+        their ``__init__``)."""
+        if isinstance(expr, ast.Attribute):
+            recv_type = self._receiver_type(expr.value, fn, local_types)
+            if recv_type is not None:
+                return self.resolve_method(recv_type, expr.attr)
+            dotted = _dotted(expr)
+            if dotted:
+                norm = self._normalize(dotted, fn.module)
+                # module.func / package.module.Class
+                mod, _, attr = norm.rpartition(".")
+                if mod in self._modules and attr:
+                    hit = self._func_by_modname.get((mod, attr))
+                    if hit:
+                        return hit
+                    cls_qual = f"{mod}:{attr}"
+                    if cls_qual in self.classes:
+                        return self.resolve_method(cls_qual, "__init__")
+            return None
+        if isinstance(expr, ast.Name):
+            name = expr.id
+            summary = self._modules.get(fn.module)
+            # same-module function (non-nested)
+            hit = self._func_by_modname.get((fn.module, name))
+            if hit and self.functions[hit].class_qualname is None:
+                return hit
+            if summary is not None and name in summary.from_imports:
+                src_mod, attr = summary.from_imports[name]
+                hit = self._func_by_modname.get((src_mod, attr))
+                if hit:
+                    return hit
+                cls_qual = f"{src_mod}:{attr}"
+                if cls_qual in self.classes:
+                    return self.resolve_method(cls_qual, "__init__")
+            cls_qual = self._lookup_class(name, fn.module)
+            if cls_qual:
+                return self.resolve_method(cls_qual, "__init__")
+        return None
+
+    def _resolve_function(self, fn: FunctionNode) -> None:
+        # summaries (and their FunctionNodes) are cached across graph
+        # builds, so start from a clean slate rather than appending
+        fn.raw_calls = []
+        local_types: Dict[str, str] = {}
+        # one linear pass for local ``var = ClassName(...)`` types
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                    isinstance(node.targets[0], ast.Name) and \
+                    isinstance(node.value, ast.Call):
+                typ = self._lookup_class(_dotted(node.value.func), fn.module)
+                if typ is not None:
+                    local_types[node.targets[0].id] = typ
+        # annotated parameters: ``def f(self, store: ObjectStore)``
+        args_node = fn.node.args
+        for arg in (list(args_node.args) + list(args_node.kwonlyargs)):
+            if arg.annotation is not None:
+                ann = arg.annotation
+                if isinstance(ann, ast.Subscript):  # Optional[X] etc.
+                    inner = ann.slice
+                    ann_name = _dotted(inner)
+                else:
+                    ann_name = _dotted(ann)
+                typ = self._lookup_class(ann_name, fn.module)
+                if typ is not None:
+                    local_types.setdefault(arg.arg, typ)
+
+        edges: List[CallSite] = []
+        for node in self._own_nodes(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = self._resolve_callable_expr(node.func, fn, local_types)
+            if callee is not None and callee in self.functions:
+                edges.append(CallSite(fn.qualname, callee, fn.path,
+                                      node.lineno, node.col_offset + 1,
+                                      "call"))
+            dotted = _dotted(node.func)
+            if dotted:
+                fn.raw_calls.append((self._normalize(dotted, fn.module),
+                                     node.lineno, node.col_offset + 1, node))
+            # bound-method references in the arguments: registrations,
+            # Thread targets, route callbacks.
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Attribute):
+                    ref = self._resolve_callable_expr(arg, fn, local_types)
+                    if ref is not None and ref in self.functions:
+                        edges.append(CallSite(fn.qualname, ref, fn.path,
+                                              arg.lineno,
+                                              arg.col_offset + 1, "ref"))
+        if edges:
+            self.edges[fn.qualname] = edges
+            for e in edges:
+                self.redges.setdefault(e.callee, []).append(e)
+
+    @staticmethod
+    def _own_nodes(fn_node) -> Iterable[ast.AST]:
+        """Walk a function body WITHOUT descending into nested function
+        or lambda bodies — those are separate graph nodes (a sink inside
+        ``lambda: uuid.uuid4()`` belongs to the lambda, which is only
+        reachable if something calls it)."""
+        stack: List[ast.AST] = list(ast.iter_child_nodes(fn_node))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    # -- queries ---------------------------------------------------------
+
+    def callees(self, qualname: str) -> List[CallSite]:
+        return self.edges.get(qualname, [])
+
+    def callers(self, qualname: str) -> List[CallSite]:
+        return self.redges.get(qualname, [])
+
+    def functions_in_path(self, path: str) -> List[FunctionNode]:
+        return [fn for fn in self.functions.values() if fn.path == path]
+
+
+def build_graph(files: Iterable[Tuple[str, str, ast.Module]]
+                ) -> ProjectGraph:
+    """Build and finalize a graph from ``(path, source, tree)`` triples."""
+    g = ProjectGraph()
+    for path, source, tree in files:
+        g.add_file(path, source, tree)
+    g.finalize()
+    return g

@@ -3,28 +3,43 @@
 from __future__ import annotations
 
 import json
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 from kuberay_tpu.analysis.core import RULES, Finding
 
 
-def render_human(findings: List[Finding]) -> str:
+def _suppressed_total(suppressed: Optional[Dict[str, int]]) -> int:
+    return sum((suppressed or {}).values())
+
+
+def render_human(findings: List[Finding],
+                 suppressed: Optional[Dict[str, int]] = None) -> str:
+    tail = ""
+    if _suppressed_total(suppressed):
+        per = ", ".join(f"{name}: {n}"
+                        for name, n in sorted(suppressed.items()))
+        tail = (f" [{_suppressed_total(suppressed)} suppressed "
+                f"with reason ({per})]")
     if not findings:
-        return "kuberay-lint: clean (0 findings)"
+        return f"kuberay-lint: clean (0 findings){tail}"
     lines = [f.render() for f in findings]
     by_rule: Dict[str, int] = {}
     for f in findings:
         by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
     summary = ", ".join(f"{name}: {n}" for name, n in sorted(by_rule.items()))
     lines.append("")
-    lines.append(f"kuberay-lint: {len(findings)} finding(s) ({summary})")
+    lines.append(f"kuberay-lint: {len(findings)} finding(s) "
+                 f"({summary}){tail}")
     return "\n".join(lines)
 
 
-def render_json(findings: List[Finding]) -> str:
+def render_json(findings: List[Finding],
+                suppressed: Optional[Dict[str, int]] = None) -> str:
     return json.dumps({
         "findings": [f.to_dict() for f in findings],
         "count": len(findings),
+        "suppressed": dict(sorted((suppressed or {}).items())),
+        "suppressed_count": _suppressed_total(suppressed),
     }, indent=2, sort_keys=True)
 
 

@@ -33,10 +33,18 @@ def dotted(node: ast.AST) -> str:
 
 
 def iter_functions(tree: ast.Module):
-    """Yield every (async) function definition, nested ones included."""
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            yield node
+    """Every (async) function definition, nested ones included.
+
+    Memoized on the tree node itself: a dozen rules ask for the same
+    list per file, and the cache's lifetime is exactly the tree's.
+    """
+    cached = getattr(tree, "_krt_functions", None)
+    if cached is None:
+        cached = [node for node in ast.walk(tree)
+                  if isinstance(node, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef))]
+        tree._krt_functions = cached
+    return cached
 
 
 def names_in(node: ast.AST) -> Set[str]:
@@ -335,9 +343,24 @@ class _ClassLockModel:
 
 
 def iter_classes(tree: ast.Module):
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ClassDef):
-            yield node
+    # Memoized on the tree node, same rationale as iter_functions.
+    cached = getattr(tree, "_krt_classes", None)
+    if cached is None:
+        cached = [node for node in ast.walk(tree)
+                  if isinstance(node, ast.ClassDef)]
+        tree._krt_classes = cached
+    return cached
+
+
+def _lock_model(cls: ast.ClassDef) -> "_ClassLockModel":
+    """Memoized _ClassLockModel: four rules build the same per-class
+    lock fixpoint; cache it on the ClassDef node so each class pays for
+    the scan once per parse."""
+    cached = getattr(cls, "_krt_lock_model", None)
+    if cached is None:
+        cached = _ClassLockModel(cls)
+        cls._krt_lock_model = cached
+    return cached
 
 
 # ---------------------------------------------------------------------------
@@ -363,7 +386,7 @@ class LockDisciplineRule(Rule):
 
     def check(self, tree: ast.Module, ctx: FileContext) -> Iterable[Finding]:
         for cls in iter_classes(tree):
-            model = _ClassLockModel(cls)
+            model = _lock_model(cls)
             if not model.lock_attrs:
                 continue
             guarded: Set[str] = set()
@@ -428,7 +451,7 @@ class BlockingUnderLockRule(Rule):
 
     def check(self, tree: ast.Module, ctx: FileContext) -> Iterable[Finding]:
         for cls in iter_classes(tree):
-            model = _ClassLockModel(cls)
+            model = _lock_model(cls)
             if not model.lock_attrs:
                 continue
             for fname, node, method in model.held_calls:
@@ -743,7 +766,7 @@ class NoIoUnderStoreLockRule(Rule):
 
     def check(self, tree: ast.Module, ctx: FileContext) -> Iterable[Finding]:
         for cls in iter_classes(tree):
-            model = _ClassLockModel(cls)
+            model = _lock_model(cls)
             if "_lock" not in model.lock_attrs:
                 continue
             primary = _PrimaryLockScanner(cls, model)
@@ -1321,3 +1344,37 @@ class CapacityThroughQuotaSeamRule(Rule):
                             "every create on the admitted verdict so no "
                             "gang is ever partially materialized without "
                             "a quota claim")
+
+
+# ---------------------------------------------------------------------------
+# 14. suppression-without-reason
+# ---------------------------------------------------------------------------
+
+@rule
+class SuppressionReasonRule(Rule):
+    """A suppression comment is a standing exception to an invariant —
+    the one place where "why is this safe?" must be answered in the
+    source, or the exception outlives everyone who remembers.  Every
+    ``kuberay-lint: disable...`` comment must therefore carry its
+    justification inline: ``# kuberay-lint: disable=<rule> -- <why>``.
+    A bare suppression is itself a finding, and (deliberately) cannot
+    be silenced by another bare suppression.
+    """
+
+    NAME = "suppression-without-reason"
+    DESCRIPTION = ("every kuberay-lint suppression comment must carry "
+                   "an inline '-- <why>' justification")
+    INVARIANT = ("each suppressed finding has a reviewable reason next "
+                 "to it in the source")
+
+    def check(self, tree: ast.Module, ctx: FileContext) -> Iterable[Finding]:
+        for rec in ctx.suppressions:
+            if rec.reason:
+                continue
+            names = ",".join(sorted(rec.names))
+            yield Finding(
+                rule=self.NAME, path=ctx.path, line=rec.line, col=1,
+                message=(f"suppression of '{names}' has no reason; "
+                         "append ' -- <why this is safe>' so the "
+                         "exception stays reviewable"),
+                end_line=rec.line)

@@ -1,0 +1,477 @@
+"""Whole-program rules: seam funnels, determinism taint, lock blocking,
+and exception escape — each checked *through any depth of wrappers*.
+
+The per-file rules in :mod:`kuberay_tpu.analysis.rules` enforce the
+framework's seams where they are declared; a one-line wrapper in another
+function (or another module) defeats every one of them.  These four
+rules re-state the same invariants over the project call graph
+(:mod:`kuberay_tpu.analysis.graph`) and the dataflow layer
+(:mod:`kuberay_tpu.analysis.dataflow`), so a finding is a *path*, not a
+line — and every finding prints that path as clickable ``via
+file:line`` hops.
+
+Division of labour with the per-file rules: a direct violation inside
+the seam-owning function itself (chain length 1) stays the per-file
+rule's finding; the whole-program rules report only chains of length
+≥ 2 — the wrapper bypasses the per-file pass cannot see.  Running both
+therefore never double-reports one construct.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
+
+from kuberay_tpu.analysis.core import (Finding, FileContext, ProjectContext,
+                                       ProjectRule, rule)
+from kuberay_tpu.analysis.dataflow import (EscapeAnalysis, Hop, chain_to,
+                                           reach, sink_closure)
+from kuberay_tpu.analysis.graph import FunctionNode, ProjectGraph
+from kuberay_tpu.analysis.rules import (_BLOCKING_EXACT, _BLOCKING_METHODS,
+                                        _BLOCKING_PREFIX, _lock_model,
+                                        iter_classes)
+
+try:  # the live patch list is the source of truth for the time seam
+    from kuberay_tpu.sim.clock import DEFAULT_PATCH_MODULES as _PATCHED_TIME
+except Exception:  # pragma: no cover - analyzing a tree without the sim
+    _PATCHED_TIME = ()
+
+#: module whose direct stdlib-time/uuid/random use IS the seam
+_CLOCK_MODULE = "kuberay_tpu.sim.clock"
+
+
+# ---------------------------------------------------------------------------
+# root discovery (shared)
+# ---------------------------------------------------------------------------
+
+def _reconcile_roots(graph: ProjectGraph) -> List[str]:
+    """Controller reconcile entry points: ``reconcile`` methods of
+    control-plane classes (or, for fixtures, of any class that declares
+    a ``KIND`` class attribute — the controller registration marker)."""
+    roots: List[str] = []
+    for qual in sorted(graph.classes):
+        cls = graph.classes[qual]
+        target = cls.methods.get("reconcile")
+        if target is None:
+            continue
+        if cls.module.startswith("kuberay_tpu.controlplane") or \
+                "KIND" in cls.class_attrs:
+            roots.append(target)
+    return roots
+
+
+def _sim_roots(graph: ProjectGraph) -> List[str]:
+    """Everything the sim harness package can run is a determinism
+    root (the journal hash covers all of it)."""
+    return [q for q in sorted(graph.functions)
+            if graph.functions[q].module.startswith("kuberay_tpu.sim")]
+
+
+def _hops(chain: List[Hop]) -> List[Dict[str, object]]:
+    return [h.to_dict() for h in chain]
+
+
+def _mk_finding(rule_obj, fn: FunctionNode, line: int, col: int,
+                message: str, chain: List[Hop]) -> Finding:
+    return Finding(rule=rule_obj.NAME, path=fn.path, line=line, col=col,
+                   message=message, end_line=line, chain=_hops(chain))
+
+
+# ---------------------------------------------------------------------------
+# 14. sim-determinism
+# ---------------------------------------------------------------------------
+
+_TIME_SINKS = {
+    "time.time", "time.time_ns", "time.monotonic", "time.monotonic_ns",
+    "time.perf_counter", "time.perf_counter_ns",
+}
+_DATETIME_LEAVES = {"now", "utcnow", "today"}
+_UUID_SINKS = {"uuid.uuid1", "uuid.uuid4"}
+_RANDOM_SANCTIONED = {"Random", "SystemRandom"}
+
+
+def _det_sink(name: str) -> Optional[str]:
+    """Label when ``name`` (normalized dotted call) draws entropy or
+    wall-clock time.  Seeded ``random.Random(...)`` construction is the
+    sanctioned pattern, so it is not a sink; neither are calls on such
+    an instance (their receiver is an attribute, not the module)."""
+    if name in _TIME_SINKS:
+        return "wall-clock time"
+    leaf = name.rsplit(".", 1)[-1]
+    if name.startswith("datetime.") and leaf in _DATETIME_LEAVES:
+        return "wall-clock datetime"
+    if name.startswith("random.") and name.count(".") == 1 and \
+            leaf not in _RANDOM_SANCTIONED:
+        return "unseeded module-level random"
+    if name in _UUID_SINKS:
+        return "random uuid"
+    if name == "os.urandom" or name.startswith("secrets."):
+        return "os entropy"
+    return None
+
+
+@rule
+class SimDeterminismRule(ProjectRule):
+    """The chaos sim's byte-identical journal-hash gate only holds if no
+    code reachable from a controller reconcile path or the sim package
+    draws wall-clock time or entropy outside the sanctioned seams:
+    ``sim/clock.py`` (whose shim virtualizes ``time.time`` in the
+    ``DEFAULT_PATCH_MODULES``), the store's injectable ``uid_factory``,
+    and seeded ``random.Random`` instances.  This rule makes that a
+    static guarantee instead of a 40-run empirical one: it taints every
+    function reachable from those roots and reports each
+    ``time``/``datetime``/``random``/``uuid``/entropy call that does not
+    pass a seam, with the call chain that reaches it.
+    """
+
+    NAME = "sim-determinism"
+    DESCRIPTION = ("code reachable from reconcile paths or the sim "
+                   "harness must draw time/entropy only through the "
+                   "clock seam, uid_factory, or a seeded Random")
+    INVARIANT = ("sim journal hashes are a pure function of "
+                 "(scenario, seed) — statically, not just empirically")
+
+    def check_project(self, project: ProjectContext) -> Iterable[Finding]:
+        graph = project.graph
+        roots = sorted(set(_reconcile_roots(graph)) | set(_sim_roots(graph)))
+        if not roots:
+            return
+        parents = reach(graph, roots)
+        seen: Set[Tuple[str, str]] = set()
+        for qual in sorted(parents):
+            fn = graph.functions[qual]
+            if fn.module == _CLOCK_MODULE or \
+                    fn.module.split(".")[-1] == "clock":
+                continue  # the seam itself
+            for name, line, col, _node in fn.raw_calls:
+                label = _det_sink(name)
+                if label is None:
+                    continue
+                if label == "wall-clock time" and \
+                        fn.module in _PATCHED_TIME:
+                    continue  # virtualized by sim.clock.patch_time
+                if (qual, name) in seen:
+                    continue
+                seen.add((qual, name))
+                chain = chain_to(graph, parents, qual)
+                root = chain[0].qualname if chain else qual
+                yield _mk_finding(
+                    self, fn, line, col,
+                    f"'{name}' ({label}) is reachable from '{root}' "
+                    "without passing a determinism seam; inject the sim "
+                    "clock, a factory, or a seeded random.Random instead",
+                    chain)
+
+
+# ---------------------------------------------------------------------------
+# 15. transitive-seam-bypass
+# ---------------------------------------------------------------------------
+
+class _SeamSpec:
+    """One funnel: a seam-owning class (identified by ``required``
+    methods), the methods wrappers may legitimately end in
+    (``allowed``), which methods root the search, and a sink detector
+    run on every function reachable from those roots without entering
+    the seam."""
+
+    __slots__ = ("label", "required", "allowed", "roots_filter", "why")
+
+    def __init__(self, label: str, required: Set[str], allowed: Set[str],
+                 why: str, roots_filter: Optional[Set[str]] = None):
+        self.label = label
+        self.required = required
+        self.allowed = allowed
+        self.why = why
+        self.roots_filter = roots_filter  # None = every non-allowed method
+
+    def sinks(self, fn: FunctionNode, graph: ProjectGraph,
+              seam_cls) -> Iterator[Tuple[int, int, str]]:
+        raise NotImplementedError
+
+
+class _QuotaSeam(_SeamSpec):
+    _ASKS = ("on_cluster_submission", "on_job_submission")
+
+    def sinks(self, fn, graph, seam_cls):
+        if fn.module.startswith("kuberay_tpu.scheduler"):
+            return  # the scheduler's own internals
+        if fn.class_qualname:
+            owner = graph.classes.get(fn.class_qualname)
+            if owner is not None and \
+                    any(a in owner.methods for a in self._ASKS):
+                return  # a scheduler implementation
+        for name, line, col, _node in fn.raw_calls:
+            if name.rsplit(".", 1)[-1] in self._ASKS:
+                yield line, col, f"scheduler ask '{name}'"
+
+
+class _WeightSeam(_SeamSpec):
+    _FIELD = "trafficWeightPercent"
+
+    def sinks(self, fn, graph, seam_cls):
+        if fn.class_qualname == seam_cls.qualname and \
+                fn.name in self.allowed:
+            return
+        for node in graph._own_nodes(fn.node):
+            targets: List[ast.AST] = []
+            if isinstance(node, ast.Assign):
+                targets = list(node.targets)
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            for tgt in targets:
+                hit = (isinstance(tgt, ast.Attribute) and
+                       tgt.attr == self._FIELD) or \
+                      (isinstance(tgt, ast.Subscript) and
+                       isinstance(tgt.slice, ast.Constant) and
+                       tgt.slice.value == self._FIELD)
+                if hit:
+                    yield (node.lineno, node.col_offset + 1,
+                           f"{self._FIELD} write")
+
+
+class _TeardownSeam(_SeamSpec):
+    _RAW = "_delete_pod"
+
+    def sinks(self, fn, graph, seam_cls):
+        if fn.name in self.allowed or fn.name == self._RAW:
+            return
+        for name, line, col, _node in fn.raw_calls:
+            if name.rsplit(".", 1)[-1] == self._RAW:
+                yield line, col, f"raw pod delete '{name}'"
+
+
+_SEAMS: List[_SeamSpec] = [
+    _QuotaSeam(
+        "quota admission", required={"_admission_verdict"},
+        allowed={"_admission_verdict"},
+        why=("the quota claim, PodGroup status, and admission counter "
+             "must stay one-per-reconcile")),
+    _WeightSeam(
+        "upgrade weight gate", required={"_apply_upgrade_decision"},
+        allowed={"_apply_upgrade_decision", "_promote"},
+        why=("every ramp weight write must stay downstream of one "
+             "orchestrator decision (ring cap + burn-rate verdict)")),
+    _TeardownSeam(
+        "drain seam", required={"_delete_slice", "_reconcile_worker_group"},
+        allowed={"_delete_slice"},
+        why=("preemption-noticed pods must be drained (checkpoint + "
+             "stamp) before any slice pod is deleted"),
+        roots_filter={"_reconcile_worker_group"}),
+]
+
+
+@rule
+class TransitiveSeamBypassRule(ProjectRule):
+    """The three seam-funnel rules (quota admission, the upgrade weight
+    gate, the slice-teardown drain seam) catch *direct* violations in
+    the seam-owning class; a helper wrapper — in the same class or
+    another module — bypasses all of them invisibly.  This rule walks
+    the call graph from every seam-class method, refusing to traverse
+    through the seam itself, and flags any reachable capacity ask,
+    traffic-weight write, or raw pod delete at depth ≥ 2 (depth 1 is
+    the per-file rules' territory), with the wrapper chain.
+    """
+
+    NAME = "transitive-seam-bypass"
+    DESCRIPTION = ("capacity asks, traffic-weight writes, and slice "
+                   "teardown must route through their seams through "
+                   "any depth of wrappers")
+    INVARIANT = ("no call path reaches a seam-guarded effect without "
+                 "passing the seam")
+
+    def check_project(self, project: ProjectContext) -> Iterable[Finding]:
+        graph = project.graph
+        for spec in _SEAMS:
+            for cls_qual in sorted(graph.classes):
+                cls = graph.classes[cls_qual]
+                if not all(m in cls.methods for m in spec.required):
+                    continue
+                avoid = {cls.methods[m] for m in spec.allowed
+                         if m in cls.methods}
+                if spec.roots_filter is None:
+                    roots = [q for m, q in sorted(cls.methods.items())
+                             if m not in spec.allowed]
+                else:
+                    roots = [cls.methods[m] for m in sorted(spec.roots_filter)
+                             if m in cls.methods]
+                parents = reach(graph, roots, avoid=avoid)
+                for qual in sorted(parents):
+                    if qual in avoid:
+                        continue
+                    chain = chain_to(graph, parents, qual)
+                    if len(chain) < 2:
+                        continue  # direct: the per-file rule's finding
+                    fn = graph.functions[qual]
+                    for line, col, what in spec.sinks(fn, graph, cls):
+                        yield _mk_finding(
+                            self, fn, line, col,
+                            f"{what} reached from "
+                            f"'{chain[0].qualname}' without passing the "
+                            f"{spec.label} ('{'/'.join(sorted(spec.allowed))}"
+                            f"'); {spec.why}",
+                            chain)
+
+
+# ---------------------------------------------------------------------------
+# 16. transitive-blocking-under-lock
+# ---------------------------------------------------------------------------
+
+def _blocking_sink(name: str, fn: FunctionNode) -> Optional[str]:
+    """Mirror of the per-file blocking matcher over normalized names,
+    minus ``self.X`` method calls (those resolve to graph edges and are
+    judged by their own bodies) and the sim clock module (its sleeps
+    are virtualized)."""
+    if fn.module == _CLOCK_MODULE or fn.module.split(".")[-1] == "clock":
+        return None
+    if not name:
+        return None
+    if name in _BLOCKING_EXACT:
+        return f"blocking call '{name}'"
+    if any(name.startswith(p) for p in _BLOCKING_PREFIX):
+        return f"blocking call '{name}'"
+    if name.startswith("self.") and name.count(".") == 1:
+        return None
+    leaf = name.rsplit(".", 1)[-1]
+    if "." in name and leaf in _BLOCKING_METHODS:
+        return f"blocking call '{name}'"
+    return None
+
+
+@rule
+class TransitiveBlockingUnderLockRule(ProjectRule):
+    """``blocking-under-lock`` sees one class at a time: a locked call
+    into a helper that sleeps or does socket/HTTP/subprocess I/O — in a
+    different method with unlocked callers, or a different module —
+    stalls every thread behind the lock just the same.  This rule
+    computes the blocking closure of the whole call graph once, then
+    flags every lock-held call site whose resolved callee can reach a
+    blocking sink, printing the path from the locked call to the I/O.
+    """
+
+    NAME = "transitive-blocking-under-lock"
+    DESCRIPTION = ("no lock-held call may reach time.sleep / socket / "
+                   "HTTP / subprocess I/O through any chain of helpers")
+    INVARIANT = ("lock hold times are bounded by computation through "
+                 "the whole call graph, not just the locked body")
+
+    def check_project(self, project: ProjectContext) -> Iterable[Finding]:
+        graph = project.graph
+        # 'call' edges only: a Thread/callback target registered under a
+        # lock runs its I/O on another stack, not under this lock
+        closure = sink_closure(graph, _blocking_sink, kinds=("call",))
+        if not closure:
+            return
+        for path, _source, tree, _ctx in project.files:
+            for cls in iter_classes(tree):
+                model = _lock_model(cls)
+                if not model.lock_attrs:
+                    continue
+                yield from self._check_class(graph, closure, path, cls,
+                                             model)
+
+    def _check_class(self, graph, closure, path, cls, model):
+        held_sites: List[Tuple[str, ast.Call]] = list(
+            (method, node) for _f, node, method in model.held_calls)
+        for method in sorted(model.held_methods):
+            for node in ast.walk(model.methods[method]):
+                if isinstance(node, ast.Call):
+                    held_sites.append((method, node))
+        seen: Set[Tuple[int, int]] = set()
+        for method, node in held_sites:
+            caller_qual = self._method_qual(graph, path, cls.name, method)
+            if caller_qual is None:
+                continue
+            for site in graph.callees(caller_qual):
+                if site.line != node.lineno or \
+                        site.col != node.col_offset + 1 or \
+                        site.kind != "call":
+                    continue
+                chain = closure.get(site.callee)
+                if chain is None:
+                    continue
+                if self._per_file_territory(cls, model, site, chain,
+                                            graph):
+                    continue
+                key = (node.lineno, node.col_offset)
+                if key in seen:
+                    continue
+                seen.add(key)
+                fn = graph.functions[caller_qual]
+                sink_hop = chain[-1]
+                head = Hop(caller_qual, fn.path, node.lineno,
+                           f"holds the '{cls.name}' lock")
+                yield _mk_finding(
+                    self, fn, node.lineno, node.col_offset + 1,
+                    f"call from '{caller_qual}' while holding the "
+                    f"'{cls.name}' lock reaches {sink_hop.note or 'I/O'} "
+                    f"at {sink_hop.path}:{sink_hop.line}; move the I/O "
+                    "outside the locked region",
+                    [head] + chain)
+
+    @staticmethod
+    def _method_qual(graph: ProjectGraph, path: str, cls_name: str,
+                     method: str) -> Optional[str]:
+        for fn in graph.functions_in_path(path):
+            if fn.name == method and fn.class_qualname and \
+                    fn.class_qualname.rsplit(":", 1)[-1] == cls_name:
+                return fn.qualname
+        return None
+
+    @staticmethod
+    def _per_file_territory(cls, model, site, chain, graph) -> bool:
+        """Depth-1 blocking inside a method of this class that the
+        per-file rule already reports (held call sites and held
+        methods) — skip to avoid double findings."""
+        callee = graph.functions.get(site.callee)
+        if callee is None or len(chain) != 1:
+            return False
+        return (callee.class_qualname is not None and
+                callee.class_qualname.rsplit(":", 1)[-1] == cls.name and
+                callee.name in model.held_methods)
+
+
+# ---------------------------------------------------------------------------
+# 17. reconcile-exception-escape
+# ---------------------------------------------------------------------------
+
+#: exceptions the Manager contract converts on purpose: Conflict is the
+#: optimistic-concurrency retry signal (fast requeue + metric).
+_SANCTIONED_ESCAPES = {"Conflict"}
+
+
+@rule
+class ReconcileExceptionEscapeRule(ProjectRule):
+    """An exception that propagates out of a controller's ``reconcile``
+    lands in ``Manager._process``'s blanket ``except Exception`` — a
+    blind 5-second backoff and a ``reconcile_error`` metric, with no
+    status write and no targeted requeue.  Only ``Conflict`` (the rv
+    retry signal, fast-requeued by contract) is meant to escape.  This
+    rule runs the escape analysis over the call graph and reports every
+    other exception type that can reach the Manager from a reconcile
+    entry point, with the raise site and the call chain to it.
+    """
+
+    NAME = "reconcile-exception-escape"
+    DESCRIPTION = ("only Conflict may propagate out of a controller "
+                   "reconcile; other exceptions must become a requeue "
+                   "or status write")
+    INVARIANT = ("reconcile failures are handled decisions, not blind "
+                 "Manager backoff")
+
+    def check_project(self, project: ProjectContext) -> Iterable[Finding]:
+        graph = project.graph
+        analysis = EscapeAnalysis(graph)
+        for root in _reconcile_roots(graph):
+            fn = graph.functions[root]
+            for exc_name in sorted(analysis.escapes(root)):
+                if exc_name in _SANCTIONED_ESCAPES:
+                    continue
+                chain = analysis.escapes(root)[exc_name]
+                raise_hop = chain[-1]
+                yield _mk_finding(
+                    self, fn, chain[0].line, 1,
+                    f"{exc_name} raised at "
+                    f"{raise_hop.path}:{raise_hop.line} can escape "
+                    f"'{root}' to the Manager's blind backoff; catch it "
+                    "and return a requeue or write status instead",
+                    chain)

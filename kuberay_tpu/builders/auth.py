@@ -33,6 +33,7 @@ def build_auth_secret(cluster: TpuCluster) -> Dict[str, Any]:
         "type": "Opaque",
         # stringData: raw value (a real apiserver base64-encodes it into
         # data; raw strings in `data` are rejected as illegal base64).
+        # kuberay-lint: disable-next-line=sim-determinism -- the auth token is a cryptographic credential and MUST come from os entropy; sim scenarios never assert on secret bytes
         "stringData": {"token": secrets.token_urlsafe(32)},
     }
 

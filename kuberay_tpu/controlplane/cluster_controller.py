@@ -93,7 +93,8 @@ class TpuClusterController:
                  tracer=None,
                  transitions=None,
                  warmpool=None,
-                 client_provider=None):
+                 client_provider=None,
+                 pod_delete_rng: Optional[random.Random] = None):
         self.store = store
         self.exp = expectations or ScaleExpectations()
         self.recorder = recorder or EventRecorder(store)
@@ -118,6 +119,10 @@ class TpuClusterController:
         # (status -> client) for the checkpoint-drain hook.
         self.warmpool = warmpool
         self.client_provider = client_provider
+        # Victim-shuffle source for ENV_ENABLE_RANDOM_POD_DELETE: an
+        # injectable instance, so deterministic harnesses can seed it
+        # (module-level random would leak wall-entropy into reconciles).
+        self._pod_delete_rng = pod_delete_rng or random.Random()
         # (ns, cluster, group, slice name) -> first-sight wall clock of an
         # active preemption notice; closed (warned-recovery observed)
         # once the slice is gone and the group is back at readiness.
@@ -156,12 +161,14 @@ class TpuClusterController:
         # group is validated exactly like an explicit one (server-side, so
         # every client benefits — ref apiserver ComputeTemplate resolution).
         errs = resolve_compute_templates(cluster, self.store)
+        # kuberay-lint: disable-next-line=reconcile-exception-escape -- FeatureGateError means a typo'd compile-time gate constant; crashing into backoff is the loudest correct behavior
         errs += waive_create_only(validate_cluster(cluster))
         # Status sanity (ref ValidateRayClusterStatus :23): mutually
         # exclusive suspend conditions mean a forged/corrupt status.
         errs += validate_cluster_status(cluster)
         if errs:
             self.recorder.warning(raw, C.EVENT_INVALID_SPEC, "; ".join(errs))
+            # kuberay-lint: disable-next-line=reconcile-exception-escape -- StoreError (write without resourceVersion) is a programming error in _write_status; it must fail loud, not be swallowed into a requeue
             self._set_status(cluster, state=ClusterState.FAILED,
                              reason="; ".join(errs)[:500])
             return None
@@ -615,7 +622,7 @@ class TpuClusterController:
             order = [i for i in sorted(slices.keys(), reverse=True)
                      if i not in pending]
             if os.environ.get(C.ENV_ENABLE_RANDOM_POD_DELETE) == "true":
-                random.shuffle(order)
+                self._pod_delete_rng.shuffle(order)
             for idx in order[:excess]:
                 if not self._delete_slice(cluster, slices[idx],
                                           group.groupName):

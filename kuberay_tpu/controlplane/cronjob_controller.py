@@ -20,7 +20,7 @@ from kuberay_tpu.controlplane.store import (AlreadyExists, NotFound,
 from kuberay_tpu.obs.trace import NOOP_TRACER
 from kuberay_tpu.utils import constants as C
 from kuberay_tpu.utils import features
-from kuberay_tpu.utils.cron import missed_runs, next_run_after
+from kuberay_tpu.utils.cron import CronError, missed_runs, next_run_after
 from kuberay_tpu.utils.names import truncate_name
 from kuberay_tpu.utils.validation import validate_cronjob
 
@@ -46,6 +46,7 @@ class TpuCronJobController:
         raw = self.store.try_get(self.KIND, name, namespace)
         if raw is None:
             return None
+        # kuberay-lint: disable-next-line=reconcile-exception-escape -- FeatureGateError means a typo'd compile-time gate constant; crashing into backoff is the loudest correct behavior
         if not features.enabled("TpuCronJob"):
             return None
         cron = TpuCronJob.from_dict(raw)
@@ -66,8 +67,16 @@ class TpuCronJobController:
         if not cron.spec.suspend:
             horizon = cron.spec.startingDeadlineSeconds or 86400
             last = cron.status.lastScheduleTime or cron.metadata.creationTimestamp
-            due = missed_runs(cron.spec.schedule, last, now,
-                              horizon_seconds=horizon)
+            try:
+                due = missed_runs(cron.spec.schedule, last, now,
+                                  horizon_seconds=horizon)
+            except CronError as e:
+                # validate_cronjob pre-checks the schedule, but an object
+                # written by an older/looser validator must degrade to an
+                # event, not crash the reconcile worker.
+                self.recorder.warning(raw, C.EVENT_INVALID_SPEC,
+                                      f"schedule: {e}")
+                return None
             if due and self._preemption_active(cron.metadata.namespace):
                 # Backfill hold: while slices in the namespace sit under
                 # an active preemption notice, batch launches would race
@@ -106,7 +115,12 @@ class TpuCronJobController:
 
         self._prune_history(cron)
         self._update_status(cron)
-        nxt = next_run_after(cron.spec.schedule, now)
+        try:
+            nxt = next_run_after(cron.spec.schedule, now)
+        except CronError as e:
+            self.recorder.warning(raw, C.EVENT_INVALID_SPEC,
+                                  f"schedule: {e}")
+            return None
         return max(1.0, nxt - now) if nxt else None
 
     # ------------------------------------------------------------------

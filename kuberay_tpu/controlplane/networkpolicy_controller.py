@@ -99,6 +99,7 @@ class NetworkPolicyController:
         self.store = store
 
     def reconcile(self, name: str, namespace: str = "default") -> Optional[float]:
+        # kuberay-lint: disable-next-line=reconcile-exception-escape -- FeatureGateError means a typo'd compile-time gate constant; crashing into backoff is the loudest correct behavior
         if not features.enabled("TpuClusterNetworkPolicy"):
             return None
         raw = self.store.try_get(self.KIND, name, namespace)

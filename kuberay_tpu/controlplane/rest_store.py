@@ -98,6 +98,7 @@ class RestObjectStore:
         self._stop = threading.Event()
         self._poll_thread: Optional[threading.Thread] = None
         self._kind_threads: List[threading.Thread] = []
+        self._starting = False   # a watch() is probing outside the lock
         self._synced = threading.Event()
         self._sync_pending: set = set()
         # Per-kind watch resume points (last event/bookmark rv) —
@@ -340,30 +341,51 @@ class RestObjectStore:
     #   poll   — list-diff polling (any REST server)
 
     def watch(self, fn: Callable[[Event], None]) -> Callable[[], None]:
+        stop: Optional[threading.Event] = None
         with self._lock:
             self._watchers.append(fn)
-            running = (any(t.is_alive() for t in self._kind_threads)
+            running = (self._starting
+                       or any(t.is_alive() for t in self._kind_threads)
                        or (self._poll_thread is not None
                            and self._poll_thread.is_alive()))
             if not running:
                 self._stop = threading.Event()
+                stop = self._stop
+                self._starting = True
+
+        if stop is not None:
+            # The mode probe and initial relist do network I/O — they run
+            # OUTSIDE the lock so a slow or unreachable server cannot
+            # wedge every other store caller behind watch start-up.
+            # ``_starting`` keeps a concurrent watch() from double-probing;
+            # ``_known`` priming without the lock is safe because only the
+            # poll path (not yet running) reads it.
+            mode = None
+            try:
                 mode, definitive = self._detect_watch_mode()
-                if mode == "k8s":
-                    self._start_kind_threads_locked()
-                else:
+                if mode != "k8s":
                     self._prime()
-                    # The loop captures ITS stop event: a long-poll can
-                    # outlive close()'s join, and a restarted watch must
-                    # not resurrect the old thread via the replaced
-                    # self._stop.  A non-definitive probe (server down)
-                    # makes the poll loop re-probe periodically instead
-                    # of pinning the downgrade forever.
-                    self._poll_thread = threading.Thread(
-                        target=self._poll_loop,
-                        args=(self._stop, mode == "legacy",
-                              not definitive),
-                        daemon=True, name="rest-watch")
-                    self._poll_thread.start()
+            finally:
+                with self._lock:
+                    self._starting = False
+                    if mode is not None and not stop.is_set():
+                        # close() didn't race us and the probe completed.
+                        if mode == "k8s":
+                            self._start_kind_threads_locked()
+                        else:
+                            # The loop captures ITS stop event: a
+                            # long-poll can outlive close()'s join, and a
+                            # restarted watch must not resurrect the old
+                            # thread via the replaced self._stop.  A
+                            # non-definitive probe (server down) makes the
+                            # poll loop re-probe periodically instead of
+                            # pinning the downgrade forever.
+                            self._poll_thread = threading.Thread(
+                                target=self._poll_loop,
+                                args=(stop, mode == "legacy",
+                                      not definitive),
+                                daemon=True, name="rest-watch")
+                            self._poll_thread.start()
 
         # Snapshot under the lock; the sync wait happens OUTSIDE it so a
         # slow relist doesn't serialize every other store caller.

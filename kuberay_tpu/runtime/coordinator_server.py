@@ -118,12 +118,12 @@ class RedisBackend(StateBackend):
         with self._lock:
             try:
                 if self._sock is None:
-                    self._sock = socket.create_connection(    # kuberay-lint: disable=blocking-under-lock
+                    self._sock = socket.create_connection(    # kuberay-lint: disable=blocking-under-lock -- connection mutex: serializing the whole request/reply I/O is the point (see comment above); 5 s socket timeout bounds the hold
                         (self.host, self.port), timeout=5)
                 buf = b"*%d\r\n" % len(parts)
                 for p in parts:
                     buf += b"$%d\r\n%s\r\n" % (len(p), p)
-                self._sock.sendall(buf)    # kuberay-lint: disable=blocking-under-lock
+                self._sock.sendall(buf)    # kuberay-lint: disable=blocking-under-lock -- connection mutex: serializing the whole request/reply I/O is the point (see comment above); 5 s socket timeout bounds the hold
                 return self._read_reply(self._sock.makefile("rb"))
             except (OSError, RuntimeError):
                 # A failed/half-read exchange leaves the stream unusable;

@@ -87,6 +87,7 @@ class TimeShim:
 #: Modules whose ``time`` binding the harness virtualizes.  Manager is
 #: absent on purpose — it takes the clock first-class.
 DEFAULT_PATCH_MODULES = (
+    "kuberay_tpu.api.common",
     "kuberay_tpu.controlplane.store",
     "kuberay_tpu.controlplane.cluster_controller",
     "kuberay_tpu.controlplane.job_controller",

@@ -1,0 +1,27 @@
+"""POSITIVE: determinism taint through wrappers.
+
+``reconcile`` never touches ``time``/``uuid`` itself — the entropy sits
+two hops down, where the per-file pass has no reason to look.  The
+sim-determinism rule must flag both sinks with the chain from
+``reconcile``.
+"""
+
+import time
+import uuid
+
+
+def _fresh_suffix():
+    return uuid.uuid4().hex[:8]
+
+
+def _stamp_started():
+    return time.time()
+
+
+class FixtureTaintedController:
+    KIND = "FixtureTainted"
+
+    def reconcile(self, name, namespace="default"):
+        token = _fresh_suffix()
+        started = _stamp_started()
+        return token, started

@@ -1,0 +1,19 @@
+"""NEGATIVE: the sanctioned determinism seams.
+
+A seeded ``random.Random`` and an injected clock are exactly what the
+rule asks for; this controller must produce zero findings.
+"""
+
+import random
+
+
+class FixtureSeededController:
+    KIND = "FixtureSeeded"
+
+    def __init__(self, seed, clock):
+        self._rng = random.Random(seed)
+        self._clock = clock
+
+    def reconcile(self, name, namespace="default"):
+        jitter = self._rng.random()
+        return self._clock.now() + jitter

@@ -1,0 +1,15 @@
+"""SUPPRESSED: a determinism sink silenced with a justified comment."""
+
+import uuid
+
+
+def _fallback_uid():
+    # kuberay-lint: disable-next-line=sim-determinism -- fixture: exercises the suppressed-with-reason shape the analyzer must honor
+    return uuid.uuid4().hex
+
+
+class FixtureWaivedUidController:
+    KIND = "FixtureWaivedUid"
+
+    def reconcile(self, name, namespace="default"):
+        return _fallback_uid()

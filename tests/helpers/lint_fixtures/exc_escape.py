@@ -1,0 +1,66 @@
+"""Exception-escape fixtures: multi-hop escape (positive), sanctioned
+Conflict, handled (negatives), and suppressed.
+
+The raise sits two calls below ``reconcile``; only the escape analysis
+over the call graph can connect them.
+"""
+
+
+class FixtureError(Exception):
+    pass
+
+
+class Conflict(Exception):
+    pass
+
+
+def _load(store, name):
+    return _fetch(store, name)
+
+
+def _fetch(store, name):
+    if name not in store:
+        raise FixtureError(name)
+    return store[name]
+
+
+class FixtureEscapeController:
+    """POSITIVE: FixtureError escapes reconcile via two wrappers."""
+
+    KIND = "FixtureEscape"
+
+    def reconcile(self, name, namespace="default"):
+        return _load({}, name)
+
+
+class FixtureConflictController:
+    """NEGATIVE: Conflict is the sanctioned rv-retry signal."""
+
+    KIND = "FixtureConflict"
+
+    def reconcile(self, name, namespace="default"):
+        if name == "stale":
+            raise Conflict(name)
+        return None
+
+
+class FixtureHandledController:
+    """NEGATIVE: the escape is caught and converted to a requeue."""
+
+    KIND = "FixtureHandled"
+
+    def reconcile(self, name, namespace="default"):
+        try:
+            return _load({}, name)
+        except FixtureError:
+            return 5.0
+
+
+class FixtureWaivedEscapeController:
+    """SUPPRESSED: the escape is waived with a reason."""
+
+    KIND = "FixtureWaivedEscape"
+
+    def reconcile(self, name, namespace="default"):
+        # kuberay-lint: disable-next-line=reconcile-exception-escape -- fixture: FixtureError here means corrupted state; backoff is the intended handling
+        return _load({}, name)

@@ -1,0 +1,52 @@
+"""Lock fixtures: cross-module blocking under a lock (positive),
+suppressed, clean.
+
+The per-file ``blocking-under-lock`` rule judges one class at a time;
+``push_remote`` lives in ``lock_helpers`` and only its callee sleeps,
+so nothing in THIS file looks blocking without the call graph.
+"""
+
+import threading
+
+from tests.helpers.lint_fixtures.lock_helpers import push_remote
+
+
+class FixtureLockedCache:
+    """POSITIVE: the locked region reaches ``time.sleep`` two modules
+    of wrappers away."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = {}
+
+    def put(self, key, value):
+        with self._lock:
+            self._items = {**self._items, key: value}
+            push_remote(value)
+
+
+class FixtureLockedSuppressed:
+    """SUPPRESSED: same shape, waived with a reason."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = {}
+
+    def put(self, key, value):
+        with self._lock:
+            self._items = {**self._items, key: value}
+            # kuberay-lint: disable-next-line=transitive-blocking-under-lock -- fixture: bounded 50 ms flush, measured acceptable
+            push_remote(value)
+
+
+class FixtureLockedClean:
+    """NEGATIVE: mutate under the lock, flush after release."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = {}
+
+    def put(self, key, value):
+        with self._lock:
+            self._items = {**self._items, key: value}
+        push_remote(value)

@@ -1,0 +1,13 @@
+"""Cross-module blocking helpers for the lock fixtures: the I/O sits
+one module and two calls away from the lock that holds it."""
+
+import time
+
+
+def push_remote(payload):
+    return _post(payload)
+
+
+def _post(payload):
+    time.sleep(0.05)
+    return payload

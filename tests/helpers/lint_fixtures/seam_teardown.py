@@ -1,0 +1,58 @@
+"""Drain-seam fixtures: wrapper bypass (positive), suppressed, clean.
+
+The per-file ``slice-teardown-through-drain-seam`` rule sees direct
+``self._delete_pod`` calls in ``_reconcile_worker_group``;
+``_evict_all`` is the wrapper that defeats it.
+"""
+
+
+class FixtureGroupController:
+    """POSITIVE: group reconcile deletes slice pods via a module-level
+    helper, never entering ``_delete_slice``'s drain protocol."""
+
+    def _delete_slice(self, cluster, plist, group):
+        for p in plist:
+            self._delete_pod(p, group)
+        return True
+
+    def _reconcile_worker_group(self, cluster, group, slices):
+        for idx, plist in slices.items():
+            _evict_all(self, plist)
+
+
+def _evict_all(ctrl, plist):
+    for p in plist:
+        ctrl._delete_pod(p)
+
+
+class FixtureGroupSuppressed:
+    """SUPPRESSED: same shape, waived with a reason."""
+
+    def _delete_slice(self, cluster, plist, group):
+        for p in plist:
+            self._delete_pod(p, group)
+        return True
+
+    def _reconcile_worker_group(self, cluster, group, slices):
+        for idx, plist in slices.items():
+            _purge_failed(self, plist)
+
+
+def _purge_failed(ctrl, plist):
+    for p in plist:
+        # kuberay-lint: disable-next-line=transitive-seam-bypass -- fixture: already-failed pods have nothing left to drain
+        ctrl._delete_pod(p)
+
+
+class FixtureGroupClean:
+    """NEGATIVE: teardown routes through the seam."""
+
+    def _delete_slice(self, cluster, plist, group):
+        for p in plist:
+            self._delete_pod(p, group)
+        return True
+
+    def _reconcile_worker_group(self, cluster, group, slices):
+        for idx, plist in slices.items():
+            if not self._delete_slice(cluster, plist, group):
+                return 1.0

@@ -1,0 +1,52 @@
+"""Weight-gate fixtures: wrapper bypass (positive), suppressed, clean.
+
+The per-file ``traffic-weight-through-gate`` rule checks writes inside
+the orchestrator class; ``_force_green`` lives outside it.
+"""
+
+
+class FixtureUpgradeOrchestrator:
+    """POSITIVE: ``_self_heal`` rewrites the ramp weight through a
+    module-level helper, skipping the burn-rate verdict."""
+
+    def _apply_upgrade_decision(self, svc, decision):
+        svc.status.pendingServiceStatus.trafficWeightPercent = \
+            decision.green_weight
+
+    def _self_heal(self, svc):
+        _force_green(svc)
+
+
+def _force_green(svc):
+    svc.status.pendingServiceStatus.trafficWeightPercent = 100
+
+
+class FixtureUpgradeSuppressed:
+    """SUPPRESSED: same shape, waived with a reason."""
+
+    def _apply_upgrade_decision(self, svc, decision):
+        svc.status.pendingServiceStatus.trafficWeightPercent = \
+            decision.green_weight
+
+    def _rollback_hatch(self, svc):
+        _zero_green(svc)
+
+
+def _zero_green(svc):
+    # kuberay-lint: disable-next-line=transitive-seam-bypass -- fixture: emergency rollback hatch, operator-invoked only
+    svc.status.pendingServiceStatus.trafficWeightPercent = 0
+
+
+class FixtureUpgradeClean:
+    """NEGATIVE: weight writes stay inside the seam and the terminal
+    ``_promote``."""
+
+    def _apply_upgrade_decision(self, svc, decision):
+        svc.status.pendingServiceStatus.trafficWeightPercent = \
+            decision.green_weight
+
+    def _promote(self, svc):
+        svc.status.activeServiceStatus.trafficWeightPercent = 100
+
+    def step(self, svc, decision):
+        self._apply_upgrade_decision(svc, decision)

@@ -1,0 +1,287 @@
+"""The whole-program analyzer: graph-driven rules over the golden
+fixture package, output determinism, the suppression ledger, the
+``--changed-only`` restriction logic, and the docs/rule-catalog drift
+gate.
+
+The fixtures under ``tests/helpers/lint_fixtures/`` are analyzer
+*inputs* (parsed, never imported): per whole-program rule a positive
+multi-hop wrapper bypass the per-file rules cannot see, a
+suppressed-with-reason variant, and a compliant negative.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import textwrap
+
+import pytest
+
+from kuberay_tpu.analysis import RULES, analyze_paths
+from kuberay_tpu.analysis.reporters import (render_human, render_json,
+                                            render_rule_list)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO_ROOT, "tests", "helpers", "lint_fixtures")
+
+WHOLE_PROGRAM_RULES = [
+    "sim-determinism",
+    "transitive-seam-bypass",
+    "transitive-blocking-under-lock",
+    "reconcile-exception-escape",
+    "suppression-without-reason",
+]
+
+_REPORT_CACHE = {}
+
+
+def _fixture_report(keep_suppressed=False):
+    key = keep_suppressed
+    if key not in _REPORT_CACHE:
+        _REPORT_CACHE[key] = analyze_paths(
+            [FIXTURES], only=WHOLE_PROGRAM_RULES,
+            keep_suppressed=keep_suppressed)
+    return _REPORT_CACHE[key]
+
+
+def _findings(rule):
+    return [f for f in _fixture_report().findings if f.rule == rule]
+
+
+def _base(path):
+    return os.path.basename(path)
+
+
+# ---------------------------------------------------------------------------
+# per-rule: positive fires with a multi-hop chain, negative stays clean
+# ---------------------------------------------------------------------------
+
+def test_sim_determinism_catches_wrapped_entropy():
+    found = _findings("sim-determinism")
+    files = {_base(f.path) for f in found}
+    assert files == {"det_bypass.py"}
+    sinks = {f.message.split("'")[1] for f in found}
+    assert sinks == {"uuid.uuid4", "time.time"}
+    for f in found:
+        assert f.chain and len(f.chain) >= 2, f.render()
+        assert "reconcile" in f.chain[0]["function"]
+
+
+def test_seam_bypass_catches_all_three_seams():
+    found = _findings("transitive-seam-bypass")
+    by_file = {_base(f.path): f for f in found}
+    assert set(by_file) == {"seam_quota.py", "seam_weight.py",
+                            "seam_teardown.py"}
+    assert "scheduler ask" in by_file["seam_quota.py"].message
+    assert "trafficWeightPercent write" in by_file["seam_weight.py"].message
+    assert "raw pod delete" in by_file["seam_teardown.py"].message
+    for f in found:
+        # depth >= 2: the wrapper hop is what the per-file rules miss
+        assert f.chain and len(f.chain) >= 2, f.render()
+
+
+def test_transitive_blocking_catches_cross_module_sleep():
+    found = _findings("transitive-blocking-under-lock")
+    assert len(found) == 1
+    f = found[0]
+    assert _base(f.path) == "lock_blocking.py"
+    assert "time.sleep" in f.message
+    # chain crosses into lock_helpers.py and starts at the lock holder
+    assert "lock_helpers.py" in f.chain[-1]["path"]
+    assert "holds the" in f.chain[0]["note"]
+    assert len(f.chain) >= 3
+
+
+def test_exception_escape_catches_multi_hop_raise():
+    found = _findings("reconcile-exception-escape")
+    assert len(found) == 1
+    f = found[0]
+    assert _base(f.path) == "exc_escape.py"
+    assert "FixtureError" in f.message
+    assert "raises FixtureError" in f.chain[-1]["note"]
+    assert len(f.chain) >= 3
+    # Conflict (sanctioned) and the handled controller produced nothing
+    assert "Conflict" not in f.message
+
+
+def test_bare_suppression_is_a_finding():
+    found = _findings("suppression-without-reason")
+    assert len(found) == 1
+    assert _base(found[0].path) == "suppression_bare.py"
+
+
+def test_chain_hops_render_clickable():
+    f = _findings("transitive-blocking-under-lock")[0]
+    rendered = f.render()
+    for hop in f.chain:
+        assert f"via {hop['path']}:{hop['line']}:" in rendered
+
+
+# ---------------------------------------------------------------------------
+# suppressed-with-reason variants are honored and counted
+# ---------------------------------------------------------------------------
+
+def test_suppressed_fixtures_are_silenced_and_ledgered():
+    report = _fixture_report()
+    counts = report.suppressed_counts
+    assert counts == {"reconcile-exception-escape": 1,
+                      "sim-determinism": 1,
+                      "transitive-blocking-under-lock": 1,
+                      "transitive-seam-bypass": 3}
+    # audit mode surfaces them again
+    kept = _fixture_report(keep_suppressed=True).findings
+    assert len(kept) == len(report.findings) + sum(counts.values())
+
+
+def test_justified_suppression_not_flagged_by_hygiene_rule():
+    # suppression_bare.py has one bare and one justified suppression;
+    # only the bare one is a finding.
+    found = _findings("suppression-without-reason")
+    assert len(found) == 1
+
+
+# ---------------------------------------------------------------------------
+# output determinism
+# ---------------------------------------------------------------------------
+
+def test_analyzer_output_is_order_independent():
+    files = sorted(
+        os.path.join(FIXTURES, n) for n in os.listdir(FIXTURES)
+        if n.endswith(".py"))
+    fwd = analyze_paths(files, only=WHOLE_PROGRAM_RULES)
+    rev = analyze_paths(list(reversed(files)), only=WHOLE_PROGRAM_RULES)
+    again = analyze_paths(files, only=WHOLE_PROGRAM_RULES)
+    out_fwd = render_human(fwd.findings, fwd.suppressed_counts)
+    assert out_fwd == render_human(rev.findings, rev.suppressed_counts)
+    assert out_fwd == render_human(again.findings, again.suppressed_counts)
+    assert render_json(fwd.findings, fwd.suppressed_counts) == \
+        render_json(rev.findings, rev.suppressed_counts)
+
+
+# ---------------------------------------------------------------------------
+# reporters carry the ledger
+# ---------------------------------------------------------------------------
+
+def test_json_report_includes_suppressed_counts():
+    import json
+    report = _fixture_report()
+    doc = json.loads(render_json(report.findings, report.suppressed_counts))
+    assert doc["suppressed"] == report.suppressed_counts
+    assert doc["suppressed_count"] == sum(report.suppressed_counts.values())
+    chained = [f for f in doc["findings"] if "chain" in f]
+    assert chained and all(
+        {"function", "path", "line"} <= set(h) for f in chained
+        for h in f["chain"])
+
+
+def test_human_report_mentions_suppression_ledger():
+    report = _fixture_report()
+    out = render_human(report.findings, report.suppressed_counts)
+    assert "suppressed with reason" in out
+    assert "transitive-seam-bypass: 3" in out
+
+
+# ---------------------------------------------------------------------------
+# --changed-only restriction logic
+# ---------------------------------------------------------------------------
+
+def _mini_project(tmp_path):
+    (tmp_path / "caller.py").write_text(textwrap.dedent("""
+        from helper import greet
+
+        def use():
+            return greet()
+    """))
+    (tmp_path / "helper.py").write_text(textwrap.dedent("""
+        def greet():
+            return "hi"
+    """))
+    return tmp_path
+
+
+def test_changed_only_restricts_to_leaf_changes(tmp_path, monkeypatch):
+    import kuberay_tpu.analysis.__main__ as cli
+    proj = _mini_project(tmp_path)
+    caller = str(proj / "caller.py")
+    monkeypatch.setattr(cli, "_git_changed_files",
+                        lambda: {os.path.abspath(caller)})
+    # caller.py has no callers elsewhere: restriction holds
+    assert cli._changed_restriction([str(proj)]) == {caller}
+
+
+def test_changed_only_widens_when_unchanged_callers_exist(tmp_path,
+                                                          monkeypatch,
+                                                          capsys):
+    import kuberay_tpu.analysis.__main__ as cli
+    proj = _mini_project(tmp_path)
+    helper = str(proj / "helper.py")
+    monkeypatch.setattr(cli, "_git_changed_files",
+                        lambda: {os.path.abspath(helper)})
+    # helper.greet is called from unchanged caller.py: whole repo
+    assert cli._changed_restriction([str(proj)]) is None
+    assert "callers in unchanged" in capsys.readouterr().err
+
+
+def test_changed_only_empty_set_and_git_failure(tmp_path, monkeypatch):
+    import kuberay_tpu.analysis.__main__ as cli
+    proj = _mini_project(tmp_path)
+    monkeypatch.setattr(cli, "_git_changed_files", lambda: set())
+    assert cli._changed_restriction([str(proj)]) == set()
+    monkeypatch.setattr(cli, "_git_changed_files", lambda: None)
+    assert cli._changed_restriction([str(proj)]) is None
+
+
+def test_changed_only_cli_exits_clean_on_no_changes(tmp_path, monkeypatch):
+    import kuberay_tpu.analysis.__main__ as cli
+    proj = _mini_project(tmp_path)
+    monkeypatch.setattr(cli, "_git_changed_files", lambda: set())
+    assert cli.main([str(proj), "--changed-only"]) == 0
+
+
+def test_changed_only_restriction_limits_reporting(tmp_path, monkeypatch):
+    # A finding in an unrestricted file is not reported, but the graph
+    # still sees the whole project.
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text(textwrap.dedent("""
+        def fanout(items):
+            for item in items:
+                try:
+                    item()
+                except Exception:
+                    pass
+    """))
+    report = analyze_paths([str(tmp_path)], restrict_to={str(clean)})
+    assert report.findings == []
+    report = analyze_paths([str(tmp_path)], restrict_to={str(dirty)})
+    assert {f.rule for f in report.findings} == {"exception-swallow"}
+
+
+# ---------------------------------------------------------------------------
+# docs drift: --list-rules vs the static-analysis.md catalog
+# ---------------------------------------------------------------------------
+
+def test_rule_catalog_matches_docs():
+    """Every registered rule has a ``### `rule-id` `` heading in
+    docs/static-analysis.md and vice versa (parse-error is synthetic —
+    not in RULES, and must not be documented as one)."""
+    doc = open(os.path.join(REPO_ROOT, "docs", "static-analysis.md"),
+               encoding="utf-8").read()
+    documented = set(re.findall(r"^### `([a-z0-9-]+)`", doc, re.M))
+    registered = set(RULES)
+    assert documented == registered, (
+        f"docs missing: {sorted(registered - documented)}; "
+        f"stale docs: {sorted(documented - registered)}")
+    assert "parse-error" not in documented
+    # --list-rules is generated from the same registry
+    listed = {line.split(":", 1)[0] for line in
+              render_rule_list().splitlines()
+              if line and not line.startswith(" ")}
+    assert listed == registered
+
+
+def test_fixture_package_is_not_importable_as_tests():
+    """The fixtures are analyzer inputs, not collectible test modules."""
+    assert not os.path.exists(os.path.join(FIXTURES, "__init__.py"))
+    assert not any(n.startswith("test_") for n in os.listdir(FIXTURES))

@@ -6,6 +6,9 @@
 #   tools/lint.sh                      # human output, whole package
 #   tools/lint.sh --format json        # machine-readable (CI annotations)
 #   tools/lint.sh kuberay_tpu/serve    # a subtree
+#   tools/lint.sh --changed-only       # git-diff file set (pre-commit;
+#                                      # auto-widens to whole repo when
+#                                      # unchanged callers are affected)
 #   tools/lint.sh --list-rules         # what is enforced, and why
 #
 # See docs/static-analysis.md for the rules and the suppression syntax.
