@@ -326,7 +326,7 @@ class _Fleet:
     def __init__(self, cfg, params, replicas, *, slots, max_len,
                  num_blocks, block_size, seed, affinity, shedding,
                  max_queue=512, tiers=None, kv_max_blocks=0,
-                 prefill_beta=None):
+                 prefill_beta=None, host_blocks=0):
         import random as _random
 
         from kuberay_tpu.controlplane.store import ObjectStore
@@ -341,7 +341,8 @@ class _Fleet:
         for i in range(replicas):
             eng = PagedServeEngine(cfg, params, max_slots=slots,
                                    max_len=max_len, num_blocks=num_blocks,
-                                   block_size=block_size)
+                                   block_size=block_size,
+                                   host_blocks=host_blocks)
             fe = ServeFrontend(eng, max_queue=max_queue)
             srv, url = fe.serve_background()
             self.frontends.append(fe)
@@ -908,6 +909,204 @@ def trace_overhead(args) -> None:
 
 
 # ---------------------------------------------------------------------------
+# Multi-turn session gate: resume-with-tiers vs full-recompute (PR 17,
+# docs/kv-tiers.md)
+# ---------------------------------------------------------------------------
+
+KV_SCHEMA = "tpu-bench-kv/v1"
+# Per-leg keys the smoke gate (tools/bench_serve.sh kv leg) asserts on.
+KV_LEG_KEYS = (
+    "mode", "seed", "sessions", "turns", "requests", "completed",
+    "errors", "device_blocks", "host_blocks", "context_tokens_total",
+    "device_token_capacity", "prefill_tokens_total", "prefill_tokens_p50",
+    "prefill_tokens_p99", "tier_fetch_blocks", "session_resumes",
+)
+
+# Closed-loop regime: sessions' final contexts must dwarf the device
+# pool (so turn N+1 finds its blocks cannibalized and the contrast is
+# tiers-vs-recompute, not cache-vs-cache), while the host tier holds
+# every live chain comfortably.
+MULTI_TURN_PROFILE = dict(
+    sessions=10, rounds=6, init_ctx=40, user_lo=8, user_hi=16,
+    new=8, slots=2, replicas=2, device_blocks=34, host_blocks=256)
+
+
+def _gen_turn_schedule(seed, prof):
+    """The seeded conversation schedule BOTH legs replay: per round a
+    shuffled session order, per turn the user's appended tokens.  Fully
+    materialized up front so the resume and recompute legs see byte-
+    identical prompts (decode is greedy, so outputs — and therefore the
+    grown contexts — match too)."""
+    import random as _random
+    rng = _random.Random((seed << 8) ^ (zlib.crc32(b"multi-turn")
+                                        & 0xFFFF))
+    schedule = []
+    sids = list(range(prof["sessions"]))
+    for _ in range(prof["rounds"]):
+        order = rng.sample(sids, len(sids))
+        for sid in order:
+            n = rng.randint(prof["user_lo"], prof["user_hi"])
+            schedule.append((sid, [rng.randint(1, 255)
+                                   for _ in range(n)]))
+    return schedule
+
+
+def _kv_leg(cfg, params, mode, seed, args, schedule) -> dict:
+    """One closed-loop leg: sequential turns through the gateway, no
+    wall-clock anywhere in the record — TTFT is proxied by the tokens
+    each turn actually prefilled (query minus cache-hit deltas from the
+    replica allocators), which is deterministic and is the quantity the
+    hierarchy exists to shrink."""
+    prof = MULTI_TURN_PROFILE
+    bs = 16
+    tiered = mode == "resume"
+    longest = prof["init_ctx"] + prof["rounds"] * \
+        (prof["user_hi"] + prof["new"])
+    max_len = ((longest + bs - 1) // bs) * bs + bs
+    fleet = _Fleet(cfg, params, prof["replicas"], slots=prof["slots"],
+                   max_len=max_len, num_blocks=prof["device_blocks"],
+                   block_size=bs, seed=seed, affinity=True,
+                   shedding=False,
+                   host_blocks=prof["host_blocks"] if tiered else 0)
+
+    def drain_pump():
+        # The engine pumps demotions a few blocks per step; between
+        # turns the replica is idle, so drain explicitly — this is the
+        # "async demotion off the hot path" contract, virtualized.
+        for fe in fleet.frontends:
+            fe.call_engine(lambda e: e._pump_demotions(1 << 20)
+                           if getattr(e, "tiers", None) else 0)
+
+    def prefill_snapshot():
+        q = h = fetched = 0
+        for fe in fleet.frontends:
+            st = fe.engine.stats
+            q += st["prefix_query_tokens"]
+            h += st["prefix_hit_tokens"]
+            fetched += st.get("tier_fetch_blocks", 0)
+        return q, h, fetched
+
+    contexts = {sid: [20_000 + sid * 64 + j
+                      for j in range(prof["init_ctx"])]
+                for sid in range(prof["sessions"])}
+    per_turn_prefill = []
+    errors = 0
+    try:
+        # One tiny request per replica compiles the decode program; the
+        # artifact carries no wall-clock, so remaining compile stalls
+        # only cost smoke runtime, never numbers.
+        for fe in fleet.frontends:
+            fe.submit([3, 1, 4, 1, 5], max_tokens=2, timeout=600.0)
+        for sid, user_toks in schedule:
+            ctx = contexts[sid]
+            ctx.extend(user_toks)
+            body = {"prompt_tokens": list(ctx),
+                    "max_tokens": prof["new"], "temperature": 0.0}
+            if tiered:
+                body["session"] = f"sess-{seed}-{sid}"
+            q0, h0, f0 = prefill_snapshot()
+            code, payload, _ = fleet.gateway.forward_ex(
+                "/v1/completions", json.dumps(body).encode(), 600.0)
+            q1, h1, f1 = prefill_snapshot()
+            if code != 200:
+                errors += 1
+                continue
+            ctx.extend(json.loads(payload).get("tokens", []))
+            per_turn_prefill.append(
+                {"prefill_tokens": (q1 - q0) - (h1 - h0),
+                 "tier_fetch_blocks": f1 - f0})
+            drain_pump()
+        resumes = 0
+        if tiered:
+            resumes = fleet.gateway.session_stats()["session_resumes"]
+        prefills = sorted(r["prefill_tokens"] for r in per_turn_prefill)
+        return {
+            "mode": mode, "seed": seed,
+            "sessions": prof["sessions"],
+            "turns": prof["rounds"],
+            "requests": len(schedule),
+            "completed": len(per_turn_prefill),
+            "errors": errors,
+            "device_blocks": prof["device_blocks"],
+            "host_blocks": prof["host_blocks"] if tiered else 0,
+            "context_tokens_total": sum(len(c)
+                                        for c in contexts.values()),
+            "device_token_capacity": prof["device_blocks"] * bs,
+            "prefill_tokens_total": sum(prefills),
+            "prefill_tokens_p50": round(percentile(prefills, 50), 1)
+            if prefills else None,
+            "prefill_tokens_p99": round(percentile(prefills, 99), 1)
+            if prefills else None,
+            "tier_fetch_blocks": sum(r["tier_fetch_blocks"]
+                                     for r in per_turn_prefill),
+            "session_resumes": resumes,
+        }
+    finally:
+        fleet.close()
+
+
+def multi_turn(args) -> None:
+    """--traffic multi-turn: the stateful-session gate.  Per seed, the
+    same seeded conversation schedule runs twice — ``resume`` (tiered
+    replicas, gateway sessions; a turn re-enters with its chain parked
+    in the host tier and promotes instead of prefilling) and
+    ``recompute`` (flat device-only fleet, no sessions; the eviction
+    churn makes turn N+1 pay its full context again).  Closed-loop and
+    sequential with zero wall-clock in the artifact, so re-runs are
+    byte-identical (tools/bench_serve.sh kv leg re-runs seed 0 and
+    diffs)."""
+    import jax
+
+    from kuberay_tpu.models import llama
+
+    cfg = llama.CONFIGS[args.model]
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+
+    legs, comparisons = [], []
+    for seed in args.seeds:
+        schedule = _gen_turn_schedule(seed, MULTI_TURN_PROFILE)
+        by = {}
+        for mode in ("resume", "recompute"):
+            leg = _kv_leg(cfg, params, mode, seed, args, schedule)
+            by[mode] = leg
+            legs.append(leg)
+            print(json.dumps(leg), flush=True)
+        cmp_rec = {
+            "seed": seed,
+            "resume_prefill_p99": by["resume"]["prefill_tokens_p99"],
+            "recompute_prefill_p99":
+                by["recompute"]["prefill_tokens_p99"],
+            "prefill_total_ratio": round(
+                by["resume"]["prefill_tokens_total"]
+                / max(1, by["recompute"]["prefill_tokens_total"]), 4),
+            "resume_beats_recompute":
+                by["resume"]["prefill_tokens_p99"] is not None
+                and by["recompute"]["prefill_tokens_p99"] is not None
+                and by["resume"]["prefill_tokens_p99"]
+                < by["recompute"]["prefill_tokens_p99"],
+        }
+        comparisons.append(cmp_rec)
+        print(json.dumps({"kv_comparison": cmp_rec}), flush=True)
+
+    doc = {
+        "schema": KV_SCHEMA,
+        "workload_params": {"model": args.model, "block_size": 16,
+                            "profile": MULTI_TURN_PROFILE},
+        "seeds": list(args.seeds),
+        "device": str(jax.devices()[0]),
+        "platform": jax.devices()[0].platform,
+        "legs": legs,
+        "comparisons": comparisons,
+    }
+    if args.json_out:
+        pathlib.Path(args.json_out).parent.mkdir(parents=True,
+                                                 exist_ok=True)
+        pathlib.Path(args.json_out).write_text(
+            json.dumps(doc, indent=2) + "\n")
+        print(f"wrote {args.json_out}", flush=True)
+
+
+# ---------------------------------------------------------------------------
 # Blue/green upgrade gate: burn-rate-gated vs naive timer ramp under a
 # mid-upgrade fault (PR 13, docs/upgrades.md)
 # ---------------------------------------------------------------------------
@@ -1294,11 +1493,12 @@ def main(argv=None) -> int:
                          "percentiles and relative overheads")
     ap.add_argument("--traffic", default="",
                     choices=["", "hot-prefix", "burst", "diurnal",
-                             "long-prompt", "all"],
+                             "long-prompt", "multi-turn", "all"],
                     help="seeded open-loop traffic generator through the "
                          "prefix-aware gateway (tpu-bench-serve/v1); "
                          "long-prompt runs the colocated-vs-disaggregated "
-                         "comparison")
+                         "comparison; multi-turn runs the closed-loop "
+                         "session gate (tpu-bench-kv/v1, byte-stable)")
     ap.add_argument("--trace", action="store_true",
                     help="tracing-overhead gate: hot-prefix legs with "
                          "end-to-end request tracing off vs on, same "
@@ -1340,7 +1540,9 @@ def main(argv=None) -> int:
             args.seeds = list(range(int(lo), int(hi) + 1))
         else:
             args.seeds = [int(args.seeds)]
-        if args.traffic:
+        if args.traffic == "multi-turn":
+            multi_turn(args)
+        elif args.traffic:
             traffic(args)
         if args.trace:
             trace_overhead(args)

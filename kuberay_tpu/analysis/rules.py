@@ -1347,7 +1347,70 @@ class CapacityThroughQuotaSeamRule(Rule):
 
 
 # ---------------------------------------------------------------------------
-# 14. suppression-without-reason
+# 14. kv-block-through-tier-seam
+# ---------------------------------------------------------------------------
+
+@rule
+class KvBlockThroughTierSeamRule(Rule):
+    """KV-block residency moves must route through the tier-store seam.
+    The content-addressed hierarchy (``KvTierStore``) keeps three
+    ledgers in lockstep on every admit/checkout/discard: the per-tier
+    OrderedDicts, the ``tpu_kv_tier_*`` gauges, and the advert delta
+    log the fleet index replays.  Code that reaches around the seam and
+    pokes the store's underscore internals (``eng.tiers._host.pop(h)``,
+    ``self.tier_store._spill[h] = ...``) mutates one ledger and not the
+    other two: the gateway's fleet index keeps advertising a block that
+    is gone — exactly the stale fleet-fetch the sim's
+    ``no-stale-block`` checker catches at the journal level; this rule
+    catches the code path before it ships.  The store's own methods
+    (the class defining both ``checkout`` and ``admit``) are the one
+    place those internals may be touched.
+    """
+
+    NAME = "kv-block-through-tier-seam"
+    DESCRIPTION = ("tier-store internals (underscore attrs on a "
+                   "tiers/tier_store receiver) must only be touched "
+                   "inside the store class itself")
+    INVARIANT = ("every KV-block residency change flows through the "
+                 "store's checkout/admit/discard seam, keeping tiers, "
+                 "gauges, and the advert log in lockstep")
+
+    _SEAM_METHODS = {"checkout", "admit"}
+    _RECEIVERS = ("tiers", "tier_store", "kv_tiers", "kv_store")
+
+    def check(self, tree: ast.Module, ctx: FileContext) -> Iterable[Finding]:
+        # The store class itself — any class defining BOTH seam methods
+        # — owns its internals; everything under it is exempt.
+        owned: Set[int] = set()
+        for cls in iter_classes(tree):
+            names = {n.name for n in cls.body
+                     if isinstance(n, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef))}
+            if self._SEAM_METHODS <= names:
+                owned.update(id(n) for n in ast.walk(cls))
+        for node in ast.walk(tree):
+            if id(node) in owned or not isinstance(node, ast.Attribute):
+                continue
+            attr = node.attr
+            if not attr.startswith("_") or attr.startswith("__"):
+                continue
+            recv = dotted(node.value)
+            if not recv:
+                continue
+            if not any(part in self._RECEIVERS
+                       for part in recv.lower().split(".")):
+                continue
+            yield self.finding(
+                ctx, node,
+                f"'{recv}.{attr}' touches tier-store internals outside "
+                "the checkout/admit seam; a residency change that "
+                "skips the seam desynchronizes the tier ledger, the "
+                "tpu_kv_tier_* gauges, and the advert log the fleet "
+                "index replays (stale fleet-fetch)")
+
+
+# ---------------------------------------------------------------------------
+# 15. suppression-without-reason
 # ---------------------------------------------------------------------------
 
 @rule

@@ -83,6 +83,25 @@ class ClusterUpgradeOptions(Serializable):
 
 
 @dataclasses.dataclass
+class KvTierOptions(Serializable):
+    """Tiered KV-cache hierarchy knobs (docs/kv-tiers.md).
+
+    ``hostBlocks``/``spillBlocks`` size the per-replica host-DRAM and
+    spill tiers behind the device pool (serve/kv_tiers.py); the
+    controller folds them into every serveConfig application block so
+    replicas mount the hierarchy at boot.  Session fields bound the
+    gateway's session table — resume state is gateway-side metadata
+    (block-hash chain + last backend), never engine state, so these
+    do not reach the engine CLI.
+    """
+
+    hostBlocks: int = 0                 # 0 = tiering off (device only)
+    spillBlocks: int = 0                # bounded third tier behind host
+    sessionCapacity: int = 1024         # max live sessions at the gateway
+    sessionTtlSeconds: int = 600        # idle session expiry
+
+
+@dataclasses.dataclass
 class TpuServiceSpec(Serializable):
     # Serve config: model/apps description consumed by the inference engine
     # (analogue of the ref's ServeConfigV2 multi-app YAML blob).
@@ -93,6 +112,9 @@ class TpuServiceSpec(Serializable):
     # stamps the tier into TrafficRoute backends and the gateway
     # two-hop-schedules across them (serve/gateway.py).
     serveTier: str = C.SERVE_TIER_MIXED
+    # Tiered KV-cache hierarchy (device → host → spill) + gateway
+    # session bounds; None = flat device-only cache.
+    kvTiers: Optional[KvTierOptions] = None
     clusterSpec: TpuClusterSpec = dataclasses.field(default_factory=TpuClusterSpec)
     upgradeStrategy: str = ServiceUpgradeType.NEW_CLUSTER
     upgradeOptions: Optional[ClusterUpgradeOptions] = None
@@ -107,7 +129,8 @@ class TpuServiceSpec(Serializable):
     @classmethod
     def _nested_types(cls):
         return {"clusterSpec": TpuClusterSpec,
-                "upgradeOptions": ClusterUpgradeOptions}
+                "upgradeOptions": ClusterUpgradeOptions,
+                "kvTiers": KvTierOptions}
 
 
 @dataclasses.dataclass

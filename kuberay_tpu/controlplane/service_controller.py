@@ -402,9 +402,32 @@ class TpuServiceController:
         attach_cluster_auth(client, self.store, cluster)
         return client
 
+    @staticmethod
+    def _effective_serve_config(svc: TpuService) -> dict:
+        """serveConfig with ``spec.kvTiers`` folded into every
+        application block (docs/kv-tiers.md): the engine-side tier
+        sizes ride the same serveConfig-to-engine wire as any other
+        app knob, so replicas mount the hierarchy at boot.  A per-app
+        explicit ``host_blocks``/``spill_blocks`` wins over the
+        service-wide default."""
+        cfg = svc.spec.serveConfig
+        kv = svc.spec.kvTiers
+        if kv is None or not (kv.hostBlocks or kv.spillBlocks):
+            return cfg
+        cfg = copy.deepcopy(cfg)
+        for app in cfg.get("applications", []) or []:
+            if not isinstance(app, dict):
+                continue
+            app.setdefault("host_blocks", kv.hostBlocks)
+            app.setdefault("spill_blocks", kv.spillBlocks)
+        return cfg
+
     def _reconcile_serve_config(self, svc: TpuService):
         st = svc.status
-        cfg_hash = spec_hash_without_scale({"serve": svc.spec.serveConfig})
+        serve_cfg = self._effective_serve_config(svc)
+        # Hash the EFFECTIVE config: flipping kvTiers must re-push even
+        # though spec.serveConfig itself is unchanged.
+        cfg_hash = spec_hash_without_scale({"serve": serve_cfg})
         for cs in (st.pendingServiceStatus, st.activeServiceStatus):
             if cs is None:
                 continue
@@ -416,7 +439,7 @@ class TpuServiceController:
                 continue
             if self._submitted.get(cs.clusterName) != cfg_hash:
                 try:
-                    client.update_serve_apps(svc.spec.serveConfig)
+                    client.update_serve_apps(serve_cfg)
                     self._submitted[cs.clusterName] = cfg_hash
                 except CoordinatorError as e:
                     self.tracer.record_error("coordinator",

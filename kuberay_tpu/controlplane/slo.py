@@ -51,6 +51,12 @@ class SloPolicy:
     breach_seconds: float = 15.0    # sustained breach before scale-up
     clear_seconds: float = 60.0     # sustained clear before release
     cooldown_seconds: float = 30.0  # min gap between scale-up verdicts
+    # KV-capacity breach: scale up when the fleet's worst KV tier
+    # (device or host, gateway.kv_tier_headroom) has less than this
+    # fraction of blocks free.  A saturated hierarchy evicts session
+    # blocks, which turns cheap resumes back into full prefills — a
+    # latency cliff the TTFT window only sees after the fact.  0 = off.
+    kv_headroom_low: float = 0.0
 
 
 def histogram_delta_p99(prev: Optional[Dict], cur: Optional[Dict]
@@ -79,7 +85,9 @@ class ServeSloSignal:
     def __init__(self, registry, policy: Optional[SloPolicy] = None,
                  queue_depth_fn: Optional[Callable[[], int]] = None,
                  clock=None, phase: str = "ttft",
-                 labels: Optional[Dict[str, str]] = None):
+                 labels: Optional[Dict[str, str]] = None,
+                 kv_headroom_fn: Optional[
+                     Callable[[], Dict[str, float]]] = None):
         """``labels`` overrides the histogram series the signal windows
         (default ``{"phase": phase}``).  A disaggregated fleet runs one
         signal per tier — e.g. ``{"phase": "gateway-prefill"}`` with
@@ -90,6 +98,9 @@ class ServeSloSignal:
         self.registry = registry
         self.policy = policy or SloPolicy()
         self.queue_depth_fn = queue_depth_fn
+        # e.g. ``gateway.kv_tier_headroom`` -> {"device": frac, "host":
+        # frac}; only consulted when policy.kv_headroom_low > 0.
+        self.kv_headroom_fn = kv_headroom_fn
         self.phase = phase
         self.labels = dict(labels) if labels is not None else {"phase": phase}
         self._now = clock.now if clock is not None else time.time
@@ -111,12 +122,18 @@ class ServeSloSignal:
         signal record for the DecisionAudit ring)."""
         pol = self.policy
         now = self._now()
+        kv_headroom: Dict[str, float] = {}
+        kv_breach = False
+        if pol.kv_headroom_low > 0 and self.kv_headroom_fn is not None:
+            kv_headroom = dict(self.kv_headroom_fn())
+            kv_breach = bool(kv_headroom) and \
+                min(kv_headroom.values()) < pol.kv_headroom_low
         with self._lock:
             p99, n, qd = self._sample_locked()
             latency_breach = n >= pol.min_samples and \
                 p99 > pol.ttft_p99_target_s
             queue_breach = qd >= pol.queue_depth_high
-            if latency_breach or queue_breach:
+            if latency_breach or queue_breach or kv_breach:
                 self._clear_since = None
                 if self._breach_since is None:
                     self._breach_since = now
@@ -148,6 +165,9 @@ class ServeSloSignal:
             "window_samples": n,
             "queue_depth": qd,
             "queue_depth_high": pol.queue_depth_high,
+            "kv_headroom": {t: round(v, 4)
+                            for t, v in sorted(kv_headroom.items())},
+            "kv_headroom_low": pol.kv_headroom_low,
             "breach_for_s": round(breach_for, 3),
             "clear_for_s": round(clear_for, 3),
             "floor": floor,

@@ -51,6 +51,7 @@ from http.server import ThreadingHTTPServer
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from kuberay_tpu.obs.trace import NOOP_TRACER
+from kuberay_tpu.serve.kv_tiers import FleetKvIndex, SessionTable
 from kuberay_tpu.serve.prefix import (
     HotPrompts,
     PrefixIndex,
@@ -97,6 +98,13 @@ class GatewayConfig:
     # than the decode replica recomputing the tail, so cap the shipped
     # prefix and let hop 2 re-prefill the remainder.
     kv_max_blocks: int = 0
+    # Stateful sessions (docs/kv-tiers.md): requests carrying a
+    # "session" id resume their KV chain from the last-seen backend's
+    # tiers, or fleet-fetch it from whichever peer the residency index
+    # says holds it, instead of recomputing prefill.
+    session_capacity: int = 1024   # gateway session objects (LRU bound)
+    session_ttl: float = 600.0     # idle seconds before a session expires
+    fleet_fetch: bool = True       # source missing blocks from a peer
 
 
 class _Overloaded(Exception):
@@ -119,6 +127,7 @@ class _HopFailed(Exception):
 class _BackendState:
     __slots__ = ("service", "url", "weight", "tier", "inflight",
                  "queue_depth", "kv_free_blocks", "kv_total_blocks",
+                 "host_free_blocks", "host_total_blocks",
                  "index", "picks")
 
     def __init__(self, service: str, url: str, index_capacity: int):
@@ -130,6 +139,8 @@ class _BackendState:
         self.queue_depth = 0          # last backend-reported engine queue
         self.kv_free_blocks = 0
         self.kv_total_blocks = 0
+        self.host_free_blocks = 0     # host-DRAM KV tier occupancy
+        self.host_total_blocks = 0
         self.index = PrefixIndex(index_capacity)
         self.picks = 0
 
@@ -205,6 +216,20 @@ class WeightedGateway:
                              "Wall seconds from a backend's drain flag "
                              "appearing on the route to its in-flight "
                              "set reaching zero")
+            metrics.describe("tpu_serve_session_resumes_total",
+                             "Session-carrying requests by where their "
+                             "KV chain came from (local = chosen "
+                             "backend's tiers, fleet = fetched from a "
+                             "named peer, miss = prefill recompute)")
+            metrics.describe("tpu_gateway_sessions",
+                             "Live session objects in the gateway's "
+                             "session table")
+            metrics.describe("tpu_kv_fleet_fetch_blocks_total",
+                             "Paged-KV blocks handled by session fleet "
+                             "fetches, by outcome (sent | skipped)")
+            metrics.describe("tpu_kv_index_invalidations_total",
+                             "Prefix-index entries unlearned on replica "
+                             "eviction adverts, by backend service")
         self.store = store
         self.route_name = route_name
         self.namespace = namespace
@@ -226,6 +251,14 @@ class WeightedGateway:
         self._hot = HotPrompts()
         self._replayed: Dict[str, int] = {}
         self._drain_seen: Dict[str, float] = {}
+        # Stateful sessions + fleet-wide residency (serve/kv_tiers.py):
+        # the session table keys resume requests to their KV chain, the
+        # fleet index folds backend adverts into an exact hash -> tier
+        # map per replica.  Both guarded by self._lock.
+        self._sessions = SessionTable(self.config.session_capacity,
+                                      self.config.session_ttl,
+                                      clock=self._now)
+        self._fleet = FleetKvIndex()
         self._stop = threading.Event()
         self._refresh()
         self._watch_thread = threading.Thread(
@@ -310,6 +343,11 @@ class WeightedGateway:
                 if s.weight != new:
                     changes.append((svc, s.weight, new))
                 s.weight = new
+                if s is not keep:
+                    # Retired with the route: its blocks are gone for
+                    # fleet-fetch purposes, its sessions re-place.
+                    self._fleet.drop_backend(svc)
+                    self._sessions.forget_backend(svc)
             self._active = [keep.service]
             self._drain_seen.clear()
         if self.flight is not None:
@@ -505,14 +543,18 @@ class WeightedGateway:
         raise _Overloaded(reason)
 
     def _acquire(self, hashes: Sequence[int], timeout: float,
-                 exclude: Sequence[str], tier: Optional[str] = None
+                 exclude: Sequence[str], tier: Optional[str] = None,
+                 prefer: str = ""
                  ) -> Optional[Tuple[_BackendState, int, bool]]:
         """Admission + routing: pick a backend with a free in-flight slot,
         waiting (bounded queue, bounded time) when all are saturated.
         ``tier`` restricts candidates to one fleet tier (disaggregated
-        two-hop path).  Returns (state, hit_depth, epsilon_fallback), or
-        None when the route has no eligible backend (503); raises
-        :class:`_Overloaded` on shed (429)."""
+        two-hop path); ``prefer`` names a backend taken over the scored
+        pick whenever it is eligible with a free slot (session
+        stickiness — its tiers hold the chain).  Returns (state,
+        hit_depth, epsilon_fallback), or None when the route has no
+        eligible backend (503); raises :class:`_Overloaded` on shed
+        (429)."""
         cfg = self.config
         deadline = time.monotonic() + min(timeout, cfg.queue_timeout)
         with self._slot_free:
@@ -524,9 +566,16 @@ class WeightedGateway:
                         if cfg.max_inflight <= 0
                         or s.inflight < cfg.max_inflight]
                 if free:
-                    s, depth, eps = self._select_locked(
-                        free, hashes, decode=(tier == "decode"),
-                        prefill=(tier == "prefill"))
+                    sticky = [s for s in free if s.service == prefer] \
+                        if prefer else []
+                    if sticky:
+                        s = sticky[0]
+                        depth = s.index.hit_depth(hashes) if hashes else 0
+                        eps = False
+                    else:
+                        s, depth, eps = self._select_locked(
+                            free, hashes, decode=(tier == "decode"),
+                            prefill=(tier == "prefill"))
                     s.inflight += 1
                     self._note_pick_locked(s)
                     if depth > 0 and self.metrics is not None:
@@ -628,19 +677,23 @@ class WeightedGateway:
         hashes = block_hashes(prompt, self.config.block_size) \
             if prompt else []
         if prompt and path.endswith("/completions"):
-            with self._lock:
-                disagg = self._disagg_locked()
-            if disagg:
-                try:
-                    doc = json.loads(body or b"{}")
-                except Exception:
-                    doc = None
-                # Streaming stays single-hop: the prefill/decode splice
-                # below rewrites the token list, which has no incremental
-                # representation over SSE.
-                if isinstance(doc, dict) and not doc.get("stream"):
+            try:
+                doc = json.loads(body or b"{}")
+            except Exception:
+                doc = None
+            # Streaming stays single-hop/stateless: the prefill/decode
+            # splice and the session chain update both rewrite the token
+            # list, which has no incremental representation over SSE.
+            if isinstance(doc, dict) and not doc.get("stream"):
+                with self._lock:
+                    disagg = self._disagg_locked()
+                if disagg:
                     return self._forward_disagg(
                         path, timeout, ctx, prompt, hashes, doc)
+                sid = doc.get("session")
+                if isinstance(sid, str) and sid:
+                    return self._forward_session(
+                        path, body, timeout, ctx, prompt, hashes, sid)
         tried: List[str] = []
         failed_svc = ""
         attempts = 2 if self.config.retry_connect else 1
@@ -705,17 +758,108 @@ class WeightedGateway:
             {"message": f"backend error: {last_err}"}).encode(), \
             (self._service_of(tried[-1]) if tried else "none"), {}
 
+    # -- stateful session path (docs/kv-tiers.md) -------------------------
+
+    def _forward_session(self, path: str, body: bytes, timeout: float, ctx,
+                         prompt: List[int], hashes: Sequence[int], sid: str
+                         ) -> Tuple[int, bytes, str, Dict[str, str]]:
+        """Resume-aware forward for requests carrying a ``session`` id:
+        look the session up (sticky to its last backend), decide where
+        its KV chain comes from — the chosen backend's own tiers, or a
+        fleet fetch from the peer the residency index names — then
+        forward and extend the chain with the generated tokens.  The
+        trace decomposes into session-lookup / fleet-fetch (when a peer
+        sourced blocks) / forward spans under the serve-request root."""
+        cfg = self.config
+        l0 = self._now()
+        with self._lock:
+            self._sessions.sweep()
+            sess = self._sessions.lookup(sid)
+        self.tracer.record_span(
+            ctx, "session-lookup", l0, self._now(), session_id=sid,
+            known=sess is not None,
+            last_backend=sess.backend if sess is not None else "")
+        fetch = {"source": "miss", "blocks": 0}
+
+        def _pre(s: _BackendState) -> None:
+            if not hashes:
+                return
+            with self._lock:
+                local = self._fleet.resident_depth(s.service, hashes)
+                peer_svc, peer_depth = (None, 0)
+                if cfg.fleet_fetch and cfg.kv_transfer:
+                    peer_svc, peer_depth = self._fleet.best_source(
+                        hashes, exclude=(s.service,))
+                peer = self._states.get(peer_svc) if peer_svc else None
+            if peer is not None and peer_depth > local:
+                k0 = self._now()
+                sent = skipped = 0
+                status, err = "ok", ""
+                try:
+                    sent, skipped = self._kv_transfer(peer, s, prompt,
+                                                      timeout, ctx)
+                except Exception as e:  # best-effort: replica re-prefills
+                    status, err = "error", f"fleet-fetch: {e}"
+                self.tracer.record_span(
+                    ctx, "fleet-fetch", k0, self._now(), src=peer.service,
+                    dst=s.service, blocks_sent=sent, blocks_skipped=skipped,
+                    status=status, error=err)
+                if self.metrics is not None:
+                    if sent:
+                        self.metrics.inc("tpu_kv_fleet_fetch_blocks_total",
+                                         {"outcome": "sent"}, sent)
+                    if skipped:
+                        self.metrics.inc("tpu_kv_fleet_fetch_blocks_total",
+                                         {"outcome": "skipped"}, skipped)
+                if sent:
+                    fetch["source"], fetch["blocks"] = "fleet", sent
+                elif skipped:
+                    fetch["source"] = "local"
+            elif local > 0:
+                fetch["source"] = "local"
+
+        try:
+            s, code, payload = self._hop(
+                None, hashes, path, body, timeout, ctx, "forward",
+                pre_forward=_pre,
+                prefer=sess.backend if sess is not None else "")
+        except _HopFailed as e:
+            return e.code, e.payload, e.backend, {}
+        if code == 200:
+            try:
+                out_tokens = list(json.loads(payload).get("tokens") or [])
+            except Exception:
+                out_tokens = []
+            # The chain covers prompt + response: the next turn's prompt
+            # extends this conversation, so its leading hashes match.
+            full = list(prompt) + out_tokens
+            chain = block_hashes(full, cfg.block_size)
+            with self._lock:
+                self._sessions.touch(sid, chain, len(full), s.service)
+                self._hot.record(prompt, cfg.block_size)
+                nsess = len(self._sessions)
+            if self.metrics is not None:
+                self.metrics.inc("tpu_serve_session_resumes_total",
+                                 {"source": fetch["source"]})
+                self.metrics.set_gauge("tpu_gateway_sessions", float(nsess))
+        return code, payload, s.service, {}
+
     # -- disaggregated two-hop path ---------------------------------------
 
-    def _hop(self, tier: str, hashes: Sequence[int], path: str, body: bytes,
-             timeout: float, ctx, span_name: str, pre_forward=None
+    def _hop(self, tier: Optional[str], hashes: Sequence[int], path: str,
+             body: bytes, timeout: float, ctx, span_name: str,
+             pre_forward=None, prefer: str = ""
              ) -> Tuple[_BackendState, int, bytes]:
         """One tier-scoped forward with the single-hop path's admission +
-        retry-on-connect semantics.  ``pre_forward(state)`` runs while the
+        retry-on-connect semantics (``tier=None`` admits any backend —
+        the session path).  ``pre_forward(state)`` runs while the
         slot is held, before the request — the decode hop's KV transfer
-        hook, re-run against the fallback replica on retry.  Returns
-        (state, code, payload); raises :class:`_Overloaded` on shed and
-        :class:`_HopFailed` when no backend produced a response."""
+        hook and the session path's fleet fetch, re-run against the
+        fallback replica on retry.  ``prefer`` is session stickiness
+        (see _acquire).  Returns (state, code, payload); raises
+        :class:`_Overloaded` on shed and :class:`_HopFailed` when no
+        backend produced a response."""
+        tname = tier or "any"
         tried: List[str] = []
         failed_svc = ""
         attempts = 2 if self.config.retry_connect else 1
@@ -724,23 +868,23 @@ class WeightedGateway:
             q0 = self._now()
             try:
                 picked = self._acquire(hashes, timeout, exclude=tried,
-                                       tier=tier)
+                                       tier=tier, prefer=prefer)
             except _Overloaded as e:
                 self.tracer.record_span(
-                    ctx, "gateway-queue", q0, self._now(), tier=tier,
+                    ctx, "gateway-queue", q0, self._now(), tier=tname,
                     status="error", error=f"shed: {e.reason}")
                 raise
             if picked is None:
                 if tried:
                     break
                 raise _HopFailed(503, json.dumps(
-                    {"message": f"no healthy {tier} backends in route"}
+                    {"message": f"no healthy {tname} backends in route"}
                 ).encode())
             s, depth, eps = picked
             q1 = self._now()
-            self.tracer.record_span(ctx, "gateway-queue", q0, q1, tier=tier)
+            self.tracer.record_span(ctx, "gateway-queue", q0, q1, tier=tname)
             self.tracer.record_span(
-                ctx, "route-decision", q1, q1, backend=s.service, tier=tier,
+                ctx, "route-decision", q1, q1, backend=s.service, tier=tname,
                 hit_depth=depth, queue_depth=s.queue_depth,
                 epsilon_fallback=eps)
             if failed_svc and self.flight is not None:
@@ -777,7 +921,7 @@ class WeightedGateway:
                     s.index.insert(hashes)
             return s, code, payload
         raise _HopFailed(502, json.dumps(
-            {"message": f"{tier} backend error: {last_err}"}).encode(),
+            {"message": f"{tname} backend error: {last_err}"}).encode(),
             self._service_of(tried[-1]) if tried else "none")
 
     def _forward_disagg(self, path: str, timeout: float, ctx,
@@ -946,14 +1090,14 @@ class WeightedGateway:
                     return st.service
         return "none"
 
-    def _request(self, base_url: str, path: str, body: bytes,
-                 timeout: float, trace_ctx=None
+    def _request(self, base_url: str, path: str, body: Optional[bytes],
+                 timeout: float, trace_ctx=None, method: Optional[str] = None
                  ) -> Tuple[int, bytes, Dict[str, str]]:
         headers = {"Content-Type": "application/json"}
         if trace_ctx is not None:
             headers["traceparent"] = trace_ctx.to_traceparent()
         req = urllib.request.Request(
-            base_url + path, data=body, headers=headers)
+            base_url + path, data=body, headers=headers, method=method)
         try:
             with urllib.request.urlopen(req, timeout=timeout) as resp:
                 return resp.status, resp.read(), dict(resp.headers)
@@ -975,6 +1119,40 @@ class WeightedGateway:
             s.kv_free_blocks = _int("X-TPU-KV-Free-Blocks", s.kv_free_blocks)
             s.kv_total_blocks = _int("X-TPU-KV-Total-Blocks",
                                      s.kv_total_blocks)
+            s.host_free_blocks = _int("X-TPU-KV-Host-Free-Blocks",
+                                      s.host_free_blocks)
+            s.host_total_blocks = _int("X-TPU-KV-Host-Total-Blocks",
+                                       s.host_total_blocks)
+            adv = _int("X-TPU-KV-Advert-Seq", -1)
+            stale = adv >= 0 and self._fleet.needs_sync(s.service, adv)
+        if stale:
+            self._sync_advert(s)
+
+    def _sync_advert(self, s: _BackendState) -> None:
+        """Pull the backend's residency-advert delta and fold it into
+        the fleet index; evicted hashes are also UNLEARNED from the
+        routing shadow, so a stale index entry can neither attract
+        affinity traffic nor direct a fleet fetch at a scrubbed block."""
+        since = self._fleet.seq(s.service)
+        try:
+            code, payload, _ = self._request(
+                s.url, f"/v1/kv/advert?since={since}", None, 5.0,
+                method="GET")
+        except (urllib.error.URLError, ConnectionError, OSError):
+            return
+        if code != 200:
+            return
+        try:
+            doc = json.loads(payload)
+            dels = [int(h) for h in doc.get("del", [])]
+        except Exception:
+            return
+        with self._lock:
+            self._fleet.apply(s.service, doc)
+            unlearned = s.index.discard(dels) if dels else 0
+        if unlearned and self.metrics is not None:
+            self.metrics.inc("tpu_kv_index_invalidations_total",
+                             {"backend": s.service}, unlearned)
 
     # -- introspection -----------------------------------------------------
 
@@ -988,7 +1166,8 @@ class WeightedGateway:
             return [summarize_backend(
                 s.service, s.url, s.weight, s.inflight, s.queue_depth,
                 s.kv_free_blocks, s.kv_total_blocks, len(s.index), s.picks,
-                tier=s.tier)
+                tier=s.tier, host_free_blocks=s.host_free_blocks,
+                host_total_blocks=s.host_total_blocks)
                 for s in self._states.values()]
 
     def total_queue_depth(self) -> int:
@@ -1006,6 +1185,29 @@ class WeightedGateway:
             return sum(s.inflight + s.queue_depth
                        for s in self._states.values() if s.tier == tier)
 
+    def kv_tier_headroom(self) -> Dict[str, float]:
+        """Fleet-wide free-block fraction per KV tier (device pool and
+        host-DRAM tier), from the occupancy headers live backends last
+        reported — the capacity input of the SLO autoscaler's KV
+        headroom gate (controlplane/slo.py)."""
+        with self._lock:
+            live = [s for s in self._states.values() if s.weight > 0]
+            out = {}
+            for name, free_attr, total_attr in (
+                    ("device", "kv_free_blocks", "kv_total_blocks"),
+                    ("host", "host_free_blocks", "host_total_blocks")):
+                free = sum(getattr(s, free_attr) for s in live)
+                total = sum(getattr(s, total_attr) for s in live)
+                out[name] = round(free / total, 4) if total else 1.0
+            return out
+
+    def session_stats(self) -> Dict[str, object]:
+        """Session table + fleet residency snapshot (GET /sessions)."""
+        with self._lock:
+            return {**self._sessions.stats(),
+                    "fleet_index_blocks": self._fleet.size(),
+                    "fleet_backends": self._fleet.stats()}
+
     # -- HTTP --------------------------------------------------------------
 
     def make_server(self, host="0.0.0.0", port=C.PORT_SERVE):
@@ -1019,6 +1221,8 @@ class WeightedGateway:
                     return self._send(200, gw.stats())
                 if self.path == "/backends":
                     return self._send(200, {"backends": gw.backend_stats()})
+                if self.path == "/sessions":
+                    return self._send(200, gw.session_stats())
                 if self.path == "/metrics" and gw.metrics is not None:
                     return self._send_text(200, gw.metrics.render(),
                                            "text/plain; version=0.0.4")

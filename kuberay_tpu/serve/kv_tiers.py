@@ -1,0 +1,557 @@
+"""Tiered content-addressed KV block store + stateful session serving.
+
+Three-tier hierarchy for paged-attention KV blocks, keyed by the chained
+block hashes from :mod:`kuberay_tpu.serve.prefix`:
+
+- **device** — the paged pool owned by :class:`BlockAllocator`.  The
+  allocator remains the source of truth; this module only mirrors its
+  membership (via ``note_device``) so tier adverts cover all three tiers.
+- **host** — a bounded LRU of blocks demoted off-device when their last
+  reference dropped.  Payloads are opaque to the store (the engine keeps
+  float32 numpy copies produced by the ``export_kv_blocks`` wire format;
+  the sim keeps raw token tuples).
+- **spill** — a second bounded LRU fed by host-tier pressure.  When it
+  overflows, the LRU block is dropped for good (next miss recomputes).
+
+Every entry is content-addressed: ``checkout`` re-verifies that the
+stored tokens are exactly the tokens the caller hashed, so a hash
+collision or a stale overwrite yields a miss, never wrong KV.  This is
+the invariant the sim's ``no-stale-block`` checker replays.
+
+The store is the *only* sanctioned door to off-device block storage —
+analysis rule ``kv-block-through-tier-seam`` flags code that reaches
+into the underlying tier dicts instead of going through
+``checkout``/``pin``.
+
+Alongside the store:
+
+- :class:`SessionTable` — gateway-side session objects (session id →
+  block-hash chain + last-seen backend) with capacity and TTL bounds,
+  so a multi-turn request resumes by block fetch instead of prefill.
+- :class:`FleetKvIndex` — a fleet-wide content-addressed residency map
+  built from backend adverts (monotonic sequence numbers over the load
+  header channel; deltas fetched from ``/v1/kv/advert``), so placement
+  can score *true* residency and name a peer to source missing blocks.
+
+Everything here is plain Python — no jax imports — so the gateway, the
+control plane, and the sim can all use it.
+
+Thread-safety: the engine mutates its store only on the engine loop
+(``call_engine`` seam); the gateway guards its session table and fleet
+index with the gateway lock.  The store itself takes no locks.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict, deque
+from typing import Any, Callable, Deque, Dict, Iterable, List, Optional, Tuple
+
+import time
+
+__all__ = [
+    "KvTierStore",
+    "SessionTable",
+    "Session",
+    "FleetKvIndex",
+]
+
+TIER_DEVICE = "device"
+TIER_HOST = "host"
+TIER_SPILL = "spill"
+
+
+def _describe_tier_metrics(metrics) -> None:
+    metrics.describe("tpu_kv_tier_blocks",
+                     "KV blocks currently resident per tier")
+    metrics.describe("tpu_kv_tier_capacity_blocks",
+                     "Configured KV block capacity per tier")
+    metrics.describe("tpu_kv_tier_hits_total",
+                     "Tier-store checkouts that returned a block, per tier")
+    metrics.describe("tpu_kv_tier_misses_total",
+                     "Tier-store checkouts that found no block")
+    metrics.describe("tpu_kv_tier_demotions_total",
+                     "Blocks demoted between tiers (src/dst labelled)")
+    metrics.describe("tpu_kv_tier_promotions_total",
+                     "Blocks promoted toward device (source tier labelled)")
+    metrics.describe("tpu_kv_tier_evictions_total",
+                     "Blocks dropped from the bottom of the hierarchy")
+    metrics.describe("tpu_kv_tier_stale_drops_total",
+                     "Checkouts whose stored tokens mismatched the hash "
+                     "(entry dropped instead of served)")
+
+
+class KvTierStore:
+    """Host + spill LRU tiers with capacity accounting and an advert log.
+
+    ``host_blocks``/``spill_blocks`` are capacities in KV blocks; a tier
+    with capacity 0 is disabled.  ``admit`` lands a block in the host
+    tier, demoting host→spill (and spill→gone) under pressure, skipping
+    pinned entries.  ``checkout`` verifies content and promotes
+    spill→host on hit.  Each membership change appends to a bounded
+    advert log; readers poll ``advert_since(seq)`` and get either a
+    delta or, after falling behind the log window, a full snapshot.
+    """
+
+    def __init__(self, host_blocks: int, spill_blocks: int = 0, *,
+                 metrics=None, advert_capacity: int = 4096):
+        self.host_blocks = int(host_blocks)
+        self.spill_blocks = int(spill_blocks)
+        # hash -> (tokens tuple, opaque payload); OrderedDict end = MRU.
+        self._host: "OrderedDict[int, Tuple[Tuple[int, ...], Any]]" = \
+            OrderedDict()
+        self._spill: "OrderedDict[int, Tuple[Tuple[int, ...], Any]]" = \
+            OrderedDict()
+        self._pins: Dict[int, int] = {}
+        # Device-tier mirror (membership only; payloads live in the pool).
+        self._device: Dict[int, None] = {}
+        # Hashes freed on device and awaiting an async device->host copy.
+        self._pending: "OrderedDict[int, None]" = OrderedDict()
+        self._advert: Deque[Tuple[int, str, str, int]] = \
+            deque(maxlen=max(16, int(advert_capacity)))
+        self._seq = 0
+        self._metrics = metrics
+        self.hits = {TIER_HOST: 0, TIER_SPILL: 0}
+        self.misses = 0
+        self.demotions = 0
+        self.promotions = 0
+        self.evictions = 0
+        self.stale_drops = 0
+        if metrics is not None:
+            _describe_tier_metrics(metrics)
+            metrics.set_gauge("tpu_kv_tier_capacity_blocks",
+                              float(self.host_blocks),
+                              {"tier": TIER_HOST})
+            metrics.set_gauge("tpu_kv_tier_capacity_blocks",
+                              float(self.spill_blocks),
+                              {"tier": TIER_SPILL})
+
+    # ---------------------------------------------------------- advert log
+
+    def _record(self, op: str, tier: str, h: int) -> None:
+        self._seq += 1
+        self._advert.append((self._seq, op, tier, h))
+
+    @property
+    def advert_seq(self) -> int:
+        return self._seq
+
+    def advert_since(self, seq: int) -> Dict[str, Any]:
+        """Delta of membership changes after ``seq``, or a snapshot.
+
+        Returns ``{"seq", "reset", "add": [[hash, tier], ...],
+        "del": [hash, ...]}``.  A reader that fell out of the bounded
+        log window (or asks from seq 0) gets ``reset: True`` with the
+        full residency listing across all three tiers.
+        """
+        if seq >= self._seq:
+            return {"seq": self._seq, "reset": False, "add": [], "del": []}
+        oldest = self._advert[0][0] if self._advert else self._seq + 1
+        if seq + 1 < oldest:
+            add = ([[h, TIER_DEVICE] for h in self._device]
+                   + [[h, TIER_HOST] for h in self._host]
+                   + [[h, TIER_SPILL] for h in self._spill])
+            return {"seq": self._seq, "reset": True, "add": add, "del": []}
+        add: List[List[Any]] = []
+        dels: List[int] = []
+        for s, op, tier, h in self._advert:
+            if s <= seq:
+                continue
+            if op == "add":
+                add.append([h, tier])
+            else:
+                dels.append(h)
+        return {"seq": self._seq, "reset": False, "add": add, "del": dels}
+
+    # ------------------------------------------------------- device mirror
+
+    def note_device(self, h: int, present: bool) -> None:
+        """Mirror device-pool membership (called from allocator hooks).
+
+        A block registered on device no longer needs a pending demotion
+        copy; a block evicted from device stays wherever the hierarchy
+        already holds it.
+        """
+        if present:
+            if h not in self._device:
+                self._device[h] = None
+                self._record("add", TIER_DEVICE, h)
+        else:
+            if h in self._device:
+                del self._device[h]
+                self._record("del", TIER_DEVICE, h)
+            self._pending.pop(h, None)
+
+    def note_freed(self, h: int) -> None:
+        """Queue a device-resident block for asynchronous demotion.
+
+        Called when the last sequence reference drops; the engine's step
+        pump later copies the block host-ward (bounded per step) while
+        it is still resident in the pool.
+        """
+        if h in self._host or h in self._spill:
+            return
+        self._pending[h] = None
+        self._pending.move_to_end(h)
+
+    def pop_pending(self) -> Optional[int]:
+        """Next hash awaiting a device->host copy (FIFO), or None."""
+        if not self._pending:
+            return None
+        h, _ = self._pending.popitem(last=False)
+        return h
+
+    def pending_count(self) -> int:
+        return len(self._pending)
+
+    # -------------------------------------------------------------- tiers
+
+    def admit(self, h: int, tokens: Iterable[int], payload: Any) -> bool:
+        """Land a block in the host tier, demoting under pressure.
+
+        Returns False when the host tier is disabled or full of pinned
+        entries (the block is simply not kept).
+        """
+        if self.host_blocks <= 0:
+            return False
+        tokens = tuple(tokens)
+        self._pending.pop(h, None)
+        if h in self._host:
+            self._host.move_to_end(h)
+            return True
+        if h in self._spill:
+            # Re-admission from spill is a promotion within the store.
+            del self._spill[h]
+            self._record("del", TIER_SPILL, h)
+        self._host[h] = (tokens, payload)
+        self._record("add", TIER_HOST, h)
+        self._evict_pressure()
+        if h not in self._host:
+            return False
+        self._gauge()
+        return True
+
+    def _evict_pressure(self) -> None:
+        while len(self._host) > self.host_blocks:
+            victim = self._lru_unpinned(self._host)
+            if victim is None:
+                # Everything pinned: shed the newest admit instead of
+                # blocking (callers treat a failed admit as a drop).
+                victim = next(reversed(self._host))
+            toks, payload = self._host.pop(victim)
+            self._record("del", TIER_HOST, victim)
+            if self.spill_blocks > 0:
+                self._spill[victim] = (toks, payload)
+                self._spill.move_to_end(victim)
+                self._record("add", TIER_SPILL, victim)
+                self.demotions += 1
+                if self._metrics is not None:
+                    self._metrics.inc("tpu_kv_tier_demotions_total",
+                                      {"src": TIER_HOST, "dst": TIER_SPILL})
+            else:
+                self.evictions += 1
+                if self._metrics is not None:
+                    self._metrics.inc("tpu_kv_tier_evictions_total",
+                                      {"tier": TIER_HOST})
+        while len(self._spill) > self.spill_blocks:
+            victim = self._lru_unpinned(self._spill)
+            if victim is None:
+                victim = next(reversed(self._spill))
+            self._spill.pop(victim)
+            self._record("del", TIER_SPILL, victim)
+            self.evictions += 1
+            if self._metrics is not None:
+                self._metrics.inc("tpu_kv_tier_evictions_total",
+                                  {"tier": TIER_SPILL})
+
+    def _lru_unpinned(self, tier: "OrderedDict") -> Optional[int]:
+        for h in tier:
+            if self._pins.get(h, 0) <= 0:
+                return h
+        return None
+
+    def checkout(self, h: int, tokens: Iterable[int]) -> Optional[Any]:
+        """Content-verified read: the payload for ``h``, or None.
+
+        The caller supplies the exact tokens it hashed; a stored entry
+        whose tokens differ is dropped (counted as a stale drop) rather
+        than served — a block served under hash H must contain exactly
+        the tokens that hash to H.  A spill hit is promoted to the host
+        tier on its way out.
+        """
+        tokens = tuple(tokens)
+        for tier_name, tier in ((TIER_HOST, self._host),
+                                (TIER_SPILL, self._spill)):
+            entry = tier.get(h)
+            if entry is None:
+                continue
+            stored_tokens, payload = entry
+            if stored_tokens != tokens:
+                del tier[h]
+                self._record("del", tier_name, h)
+                self.stale_drops += 1
+                if self._metrics is not None:
+                    self._metrics.inc("tpu_kv_tier_stale_drops_total")
+                self._gauge()
+                return None
+            self.hits[tier_name] += 1
+            if self._metrics is not None:
+                self._metrics.inc("tpu_kv_tier_hits_total",
+                                  {"tier": tier_name})
+            if tier_name == TIER_SPILL:
+                del self._spill[h]
+                self._record("del", TIER_SPILL, h)
+                self._host[h] = (stored_tokens, payload)
+                self._record("add", TIER_HOST, h)
+                self.promotions += 1
+                if self._metrics is not None:
+                    self._metrics.inc("tpu_kv_tier_promotions_total",
+                                      {"src": TIER_SPILL})
+                self._evict_pressure()
+            else:
+                self._host.move_to_end(h)
+            self._gauge()
+            return payload
+        self.misses += 1
+        if self._metrics is not None:
+            self._metrics.inc("tpu_kv_tier_misses_total")
+        return None
+
+    def pin(self, h: int) -> None:
+        """Exclude ``h`` from tier eviction until ``unpin``."""
+        self._pins[h] = self._pins.get(h, 0) + 1
+
+    def unpin(self, h: int) -> None:
+        n = self._pins.get(h, 0) - 1
+        if n <= 0:
+            self._pins.pop(h, None)
+        else:
+            self._pins[h] = n
+
+    def tier_of(self, h: int) -> Optional[str]:
+        if h in self._device:
+            return TIER_DEVICE
+        if h in self._host:
+            return TIER_HOST
+        if h in self._spill:
+            return TIER_SPILL
+        return None
+
+    def contains(self, h: int) -> bool:
+        return h in self._host or h in self._spill
+
+    def discard(self, h: int) -> int:
+        """Drop ``h`` from every tier; returns how many tier copies
+        actually left (0 = the hash was not resident)."""
+        n = 0
+        if self._host.pop(h, None) is not None:
+            self._record("del", TIER_HOST, h)
+            n += 1
+        if self._spill.pop(h, None) is not None:
+            self._record("del", TIER_SPILL, h)
+            n += 1
+        self._pending.pop(h, None)
+        self._gauge()
+        return n
+
+    def _gauge(self) -> None:
+        if self._metrics is None:
+            return
+        self._metrics.set_gauge("tpu_kv_tier_blocks",
+                                float(len(self._host)),
+                                {"tier": TIER_HOST})
+        self._metrics.set_gauge("tpu_kv_tier_blocks",
+                                float(len(self._spill)),
+                                {"tier": TIER_SPILL})
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "host_blocks_used": len(self._host),
+            "host_blocks_total": self.host_blocks,
+            "spill_blocks_used": len(self._spill),
+            "spill_blocks_total": self.spill_blocks,
+            "pending_demotions": len(self._pending),
+            "tier_hits_host": self.hits[TIER_HOST],
+            "tier_hits_spill": self.hits[TIER_SPILL],
+            "tier_misses": self.misses,
+            "tier_demotions": self.demotions,
+            "tier_promotions": self.promotions,
+            "tier_evictions": self.evictions,
+            "tier_stale_drops": self.stale_drops,
+            "advert_seq": self._seq,
+        }
+
+
+class Session:
+    """One gateway session: the KV chain a returning user resumes from."""
+
+    __slots__ = ("sid", "hashes", "ntokens", "backend", "last_seen")
+
+    def __init__(self, sid: str, hashes: Tuple[int, ...], ntokens: int,
+                 backend: str, last_seen: float):
+        self.sid = sid
+        self.hashes = hashes
+        self.ntokens = ntokens
+        self.backend = backend
+        self.last_seen = last_seen
+
+
+class SessionTable:
+    """Bounded session-id → block-hash-chain table with TTL eviction.
+
+    ``lookup`` returns a live session without refreshing its TTL;
+    ``touch`` upserts after a successful forward and refreshes it.
+    Capacity overflow evicts the least-recently-touched session.
+    """
+
+    def __init__(self, capacity: int = 1024, ttl: float = 600.0, *,
+                 clock: Optional[Callable[[], float]] = None):
+        self.capacity = max(1, int(capacity))
+        self.ttl = float(ttl)
+        self._clock = clock or time.monotonic
+        self._sessions: "OrderedDict[str, Session]" = OrderedDict()
+        self.resumes = 0
+        self.expired = 0
+        self.evicted = 0
+
+    def lookup(self, sid: str) -> Optional[Session]:
+        sess = self._sessions.get(sid)
+        if sess is None:
+            return None
+        if self.ttl > 0 and self._clock() - sess.last_seen > self.ttl:
+            del self._sessions[sid]
+            self.expired += 1
+            return None
+        self.resumes += 1
+        return sess
+
+    def touch(self, sid: str, hashes: Iterable[int], ntokens: int,
+              backend: str) -> Session:
+        now = self._clock()
+        sess = self._sessions.get(sid)
+        if sess is None:
+            sess = Session(sid, tuple(hashes), int(ntokens), backend, now)
+            self._sessions[sid] = sess
+        else:
+            sess.hashes = tuple(hashes)
+            sess.ntokens = int(ntokens)
+            sess.backend = backend
+            sess.last_seen = now
+            self._sessions.move_to_end(sid)
+        while len(self._sessions) > self.capacity:
+            self._sessions.popitem(last=False)
+            self.evicted += 1
+        return sess
+
+    def sweep(self) -> int:
+        """Drop sessions past their TTL; returns how many went."""
+        if self.ttl <= 0:
+            return 0
+        now = self._clock()
+        dead = [sid for sid, s in self._sessions.items()
+                if now - s.last_seen > self.ttl]
+        for sid in dead:
+            del self._sessions[sid]
+        self.expired += len(dead)
+        return len(dead)
+
+    def forget_backend(self, service: str) -> int:
+        """Detach sessions pinned to a dead backend (chain kept — the
+        blocks may still be resident elsewhere in the fleet)."""
+        n = 0
+        for sess in self._sessions.values():
+            if sess.backend == service:
+                sess.backend = ""
+                n += 1
+        return n
+
+    def __len__(self) -> int:
+        return len(self._sessions)
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "sessions": len(self._sessions),
+            "session_capacity": self.capacity,
+            "session_ttl_seconds": self.ttl,
+            "session_resumes": self.resumes,
+            "session_expired": self.expired,
+            "session_evicted": self.evicted,
+        }
+
+
+class FleetKvIndex:
+    """Fleet-wide content-addressed residency: backend → {hash: tier}.
+
+    Built from backend advert deltas (``KvTierStore.advert_since``
+    payloads relayed through ``/v1/kv/advert``).  Exact, not a shadow:
+    entries leave when the owning replica adverts a ``del`` or the
+    backend itself is dropped, so a stale entry cannot direct a fleet
+    fetch at an evicted block.  Size is bounded by the fleet's actual
+    block capacity (each replica adverts at most device+host+spill
+    blocks), so no separate cap is needed.
+    """
+
+    def __init__(self):
+        self._res: Dict[str, Dict[int, str]] = {}
+        self._seq: Dict[str, int] = {}
+
+    def seq(self, service: str) -> int:
+        return self._seq.get(service, 0)
+
+    def needs_sync(self, service: str, advertised_seq: int) -> bool:
+        return int(advertised_seq) > self._seq.get(service, 0)
+
+    def apply(self, service: str, doc: Dict[str, Any]) -> None:
+        """Fold one ``advert_since`` payload into the index."""
+        res = self._res.setdefault(service, {})
+        if doc.get("reset"):
+            res.clear()
+        for item in doc.get("add", []):
+            h, tier = item[0], item[1]
+            res[int(h)] = str(tier)
+        for h in doc.get("del", []):
+            res.pop(int(h), None)
+        self._seq[service] = max(self._seq.get(service, 0),
+                                 int(doc.get("seq", 0)))
+
+    def resident_depth(self, service: str, hashes: Iterable[int]) -> int:
+        """Leading blocks of ``hashes`` resident on ``service``, any tier."""
+        res = self._res.get(service)
+        if not res:
+            return 0
+        depth = 0
+        for h in hashes:
+            if h not in res:
+                break
+            depth += 1
+        return depth
+
+    def best_source(self, hashes, exclude: Iterable[str] = ()
+                    ) -> Tuple[Optional[str], int]:
+        """Backend holding the deepest prefix of ``hashes``; ties break
+        lexicographically so placement stays deterministic."""
+        hashes = list(hashes)
+        skip = set(exclude)
+        best: Optional[str] = None
+        best_depth = 0
+        for service in sorted(self._res):
+            if service in skip:
+                continue
+            depth = self.resident_depth(service, hashes)
+            if depth > best_depth:
+                best, best_depth = service, depth
+        return best, best_depth
+
+    def drop_backend(self, service: str) -> int:
+        """Forget a replica wholesale (evicted / failed health checks)."""
+        dropped = len(self._res.pop(service, {}))
+        self._seq.pop(service, None)
+        return dropped
+
+    def size(self, service: Optional[str] = None) -> int:
+        if service is not None:
+            return len(self._res.get(service, {}))
+        return sum(len(r) for r in self._res.values())
+
+    def stats(self) -> Dict[str, Any]:
+        return {svc: {"blocks": len(res), "seq": self._seq.get(svc, 0)}
+                for svc, res in self._res.items()}

@@ -33,6 +33,7 @@ import numpy as np
 
 from kuberay_tpu.models.llama import LlamaConfig
 from kuberay_tpu.serve.engine import Request, ServeEngine, _bucket
+from kuberay_tpu.serve.kv_tiers import KvTierStore
 from kuberay_tpu.serve.paged_kv import (
     BlockAllocator,
     init_paged_cache,
@@ -51,7 +52,8 @@ class PagedServeEngine(ServeEngine):
                  kv_quant: str = "none", mesh=None,
                  weight_quant: str = "none",
                  donate_params: bool = False,
-                 metrics=None, tracer=None, clock=None):
+                 metrics=None, tracer=None, clock=None,
+                 host_blocks: int = 0, spill_blocks: int = 0):
         # Default pool = the dense engine's footprint; callers shrink it
         # to realize the memory win (e.g. slots * expected_len).
         num_blocks = num_blocks or (max_slots * max_len) // block_size
@@ -99,6 +101,22 @@ class PagedServeEngine(ServeEngine):
         self.tables = np.zeros((max_slots, self.max_blocks), dtype=np.int32)
         self.owned: List[List[int]] = [[] for _ in range(max_slots)]
         self._wait_state = None        # (request id, num_free) at last block
+        # Optional host/spill tiers behind the device pool: blocks freed
+        # off-device demote asynchronously (step pump), admissions
+        # promote tier-resident prefix blocks back instead of
+        # recomputing them (serve/kv_tiers.py).
+        self.tiers: Optional[KvTierStore] = None
+        self.tier_fetch_blocks = 0
+        self.tier_demoted_blocks = 0
+        if host_blocks > 0 or spill_blocks > 0:
+            if kv_quant != "none":
+                raise ValueError(
+                    "KV tiering requires kv_quant='none' (tier payloads "
+                    "ride the float32 export wire format)")
+            self.tiers = KvTierStore(host_blocks, spill_blocks,
+                                     metrics=metrics)
+            self.allocator.on_register = self._on_device_register
+            self.allocator.on_evict = self._on_device_evict
 
     def _init_cache(self):
         return init_paged_cache(self.cfg, self.num_blocks, self.block_size,
@@ -202,6 +220,12 @@ class PagedServeEngine(ServeEngine):
     def _release(self, slot: int):
         for bid in self.owned[slot]:
             self.allocator.free(bid)
+            if self.tiers is not None and self.allocator.refcount[bid] == 0:
+                h = self.allocator.hash_of(bid)
+                if h is not None:
+                    # Last reference dropped: queue the (still pool-
+                    # resident) block for an async device->host copy.
+                    self.tiers.note_freed(h)
         self.owned[slot] = []
         self.tables[slot] = 0
 
@@ -215,6 +239,103 @@ class PagedServeEngine(ServeEngine):
             bid = self.owned[slot].pop()
             self.tables[slot, len(self.owned[slot])] = 0
             self.allocator.free(bid)
+
+    # ------------------------------------------------------------------
+    # tier hierarchy (device -> host -> spill; serve/kv_tiers.py)
+    # ------------------------------------------------------------------
+
+    def _on_device_register(self, h: int) -> None:
+        self.tiers.note_device(h, True)
+
+    def _on_device_evict(self, h: int) -> None:
+        # The pool slot is being cannibalized; the hash leaves the device
+        # tier.  Host/spill copies (if the pump got to them) survive.
+        self.tiers.note_device(h, False)
+
+    def step(self):
+        out = super().step()
+        if self.tiers is not None:
+            self._pump_demotions()
+        return out
+
+    def _pump_demotions(self, limit: int = 4) -> int:
+        """Copy up to ``limit`` freed blocks device->host per step.
+
+        Bounded so demotion bandwidth never stalls the decode loop; a
+        block evicted from the pool before its turn is simply lost to
+        the hierarchy (next miss recomputes it).  Content is re-read
+        from the allocator at copy time, so a racing eviction or
+        re-registration can never demote stale bytes under a hash.
+        """
+        bs = self.block_size
+        done = 0
+        while done < limit:
+            h = self.tiers.pop_pending()
+            if h is None:
+                break
+            entry = self.allocator.lookup_block(h)
+            if entry is None:
+                continue               # evicted before the copy ran
+            bid, toks = entry
+            sl = slice(bid * bs, (bid + 1) * bs)
+            k = np.asarray(self.cache["k"][:, :, sl, :], np.float32)
+            v = np.asarray(self.cache["v"][:, :, sl, :], np.float32)
+            if self.tiers.admit(h, toks, (k, v)):
+                self.tier_demoted_blocks += 1
+                if self.metrics is not None:
+                    self.metrics.inc("tpu_kv_tier_demotions_total",
+                                     {"src": "device", "dst": "host"})
+            done += 1
+        return done
+
+    def _promote_from_tiers(self, req: Request) -> int:
+        """Import the tier-resident run extending the device-resident
+        prefix back into the pool, so admission's match_prefix serves it
+        without recompute.  Records a ``tier-fetch`` span on the request
+        trace when any block moved."""
+        tokens = req.prompt_tokens
+        bs = self.block_size
+        t0 = self._now()
+        resident = self.allocator.resident_prefix_blocks(tokens)
+        hashes = self.allocator.block_hashes(tokens)
+        promoted: List[tuple] = []         # (block id, (k, v))
+        for i in range(resident, len(hashes)):
+            toks = tuple(tokens[i * bs:(i + 1) * bs])
+            payload = self.tiers.checkout(hashes[i], toks)
+            if payload is None:
+                break
+            bid = self.allocator.import_block(hashes[i], toks)
+            if bid is None:
+                break                      # resident after all / pool full
+            promoted.append((bid, payload))
+        if not promoted:
+            return 0
+        pool_dtype = self.cache["k"].dtype
+        idx = np.concatenate([np.arange(bid * bs, (bid + 1) * bs)
+                              for bid, _ in promoted])
+        k_all = np.concatenate([p[0] for _, p in promoted],
+                               axis=2).astype(pool_dtype)
+        v_all = np.concatenate([p[1] for _, p in promoted],
+                               axis=2).astype(pool_dtype)
+        self.cache["k"] = self.cache["k"].at[:, :, idx, :].set(k_all)
+        self.cache["v"] = self.cache["v"].at[:, :, idx, :].set(v_all)
+        for bid, _ in promoted:
+            self.allocator.free(bid)       # refcount-0 cached, like import
+        self.tier_fetch_blocks += len(promoted)
+        if self.metrics is not None:
+            self.metrics.inc("tpu_kv_tier_promotions_total",
+                             {"src": "host"}, value=len(promoted))
+        if req.trace is not None:
+            self._tracer.record_span(
+                req.trace, "tier-fetch", t0, self._now(),
+                blocks=len(promoted))
+        return len(promoted)
+
+    def kv_advert(self, since: int = 0) -> Dict[str, Any]:
+        """Residency advert for the fleet index (see KvTierStore)."""
+        if self.tiers is None:
+            return {"seq": 0, "reset": False, "add": [], "del": []}
+        return self.tiers.advert_since(int(since))
 
     # ------------------------------------------------------------------
     # scheduling overrides
@@ -239,6 +360,11 @@ class PagedServeEngine(ServeEngine):
         if self._wait_state == (id(req), self.allocator.num_free):
             self.queue.insert(0, req)
             return False
+        # Tier promotion first: blocks demoted to host/spill come back
+        # into the pool so the match below serves them from cache (a
+        # session resume pays a block copy instead of prefill).
+        if self.tiers is not None and self._share_prefixes:
+            self._promote_from_tiers(req)
         # Prefix cache: longest block-aligned cached prefix — but at
         # least one token must run through prefill to produce logits.
         cached = self.allocator.match_prefix(req.prompt_tokens) \
@@ -426,8 +552,9 @@ class PagedServeEngine(ServeEngine):
         the pool, skipping the first ``skip_blocks`` (already resident on
         the importer).  Returns wire records ``{index, hash, k, v}`` with
         float32 base64 payloads of shape [L, Hkv, block_size, D]; stops
-        at the first block this replica no longer holds (evicted between
-        prefill and export — the importer prefills the remainder).
+        at the first block this replica no longer holds in ANY tier
+        (device eviction falls back to the host/spill copy when tiering
+        is on; past that, the importer prefills the remainder).
         ``max_blocks`` > 0 caps the record count: the importer still
         holds a contiguous resident prefix (skip + cap blocks) and
         recomputes the rest, so a transfer-cost budget never breaks the
@@ -437,31 +564,50 @@ class PagedServeEngine(ServeEngine):
                 "KV-block export requires kv_quant='none' (int8 pools "
                 "carry per-position scales the wire format omits)")
         bs = self.block_size
-        picks: List[tuple] = []            # (index, hash, block id)
+        # bid None = the block left the pool but a tier copy serves the
+        # export (the chain stays contiguous across device eviction).
+        picks: List[tuple] = []            # (index, hash, block id | None)
+        tier_payloads: Dict[int, tuple] = {}
         for i, h in enumerate(self.allocator.block_hashes(prompt_tokens)):
+            toks = tuple(prompt_tokens[i * bs:(i + 1) * bs])
             entry = self.allocator.lookup_block(h)
-            if entry is None or \
-                    entry[1] != tuple(prompt_tokens[i * bs:(i + 1) * bs]):
+            if entry is not None and entry[1] == toks:
+                bid: Optional[int] = entry[0]
+            elif self.tiers is not None:
+                payload = self.tiers.checkout(h, toks)
+                if payload is None:
+                    break
+                bid = None
+                tier_payloads[i] = payload
+            else:
                 break
             if i >= skip_blocks:
-                picks.append((i, h, entry[0]))
+                picks.append((i, h, bid))
             if max_blocks > 0 and len(picks) >= max_blocks:
                 break
         if not picks:
             return []
         # One gather per pool: only the exported positions leave the
         # device, never the whole pool.
-        idx = np.concatenate([np.arange(bid * bs, (bid + 1) * bs)
-                              for _, _, bid in picks])
-        k = np.asarray(self.cache["k"][:, :, idx, :], np.float32)
-        v = np.asarray(self.cache["v"][:, :, idx, :], np.float32)
+        dev = [(i, h, bid) for i, h, bid in picks if bid is not None]
+        k = v = None
+        if dev:
+            idx = np.concatenate([np.arange(bid * bs, (bid + 1) * bs)
+                                  for _, _, bid in dev])
+            k = np.asarray(self.cache["k"][:, :, idx, :], np.float32)
+            v = np.asarray(self.cache["v"][:, :, idx, :], np.float32)
+        dev_pos = {i: j for j, (i, _, _) in enumerate(dev)}
         out = []
-        for j, (i, h, _) in enumerate(picks):
-            sl = slice(j * bs, (j + 1) * bs)
+        for i, h, bid in picks:
+            if bid is not None:
+                sl = slice(dev_pos[i] * bs, (dev_pos[i] + 1) * bs)
+                kb, vb = k[:, :, sl, :], v[:, :, sl, :]
+            else:
+                kb, vb = tier_payloads[i]
             out.append({
                 "index": i, "hash": h,
-                "k": base64.b64encode(k[:, :, sl, :].tobytes()).decode(),
-                "v": base64.b64encode(v[:, :, sl, :].tobytes()).decode(),
+                "k": base64.b64encode(kb.tobytes()).decode(),
+                "v": base64.b64encode(vb.tobytes()).decode(),
             })
         return out
 
@@ -525,10 +671,15 @@ class PagedServeEngine(ServeEngine):
     @property
     def stats(self) -> Dict[str, Any]:
         a = self.allocator
-        return {
+        out = {
             **ServeEngine.stats.fget(self),
             "num_blocks": a.num_blocks,
             "free_blocks": a.num_free,
             "prefix_hit_tokens": a.prefix_hits,
             "prefix_query_tokens": a.prefix_queries,
         }
+        if self.tiers is not None:
+            out.update(self.tiers.stats())
+            out["tier_fetch_blocks"] = self.tier_fetch_blocks
+            out["tier_demoted_blocks"] = self.tier_demoted_blocks
+        return out

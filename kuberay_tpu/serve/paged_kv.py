@@ -50,9 +50,15 @@ class BlockAllocator:
     scrubbed (hash entry dropped) when allocation demands it.
     """
 
-    def __init__(self, num_blocks: int, block_size: int):
+    def __init__(self, num_blocks: int, block_size: int, *,
+                 on_register=None, on_evict=None):
         self.num_blocks = num_blocks
         self.block_size = block_size
+        # Device-tier membership hooks (hash registered / hash scrubbed):
+        # the tier store mirrors the pool through these so fleet adverts
+        # cover the device tier without the store reaching into the pool.
+        self.on_register = on_register
+        self.on_evict = on_evict
         self.refcount = [0] * num_blocks
         # Free blocks split by cache status so allocate() is O(1): plain
         # deque for uncached, insertion-ordered dict (= LRU) for
@@ -100,6 +106,8 @@ class BlockAllocator:
             bid, _ = self._free_cached.popitem(last=False)   # LRU evict
             h = self._block_to_hash.pop(bid)
             self._hash_to_block.pop(h, None)
+            if self.on_evict is not None:
+                self.on_evict(h)
         else:
             return None
         self.refcount[bid] = 1
@@ -163,6 +171,16 @@ class BlockAllocator:
         hash, or None — the export side's content-addressable read."""
         return self._hash_to_block.get(h)
 
+    def hash_of(self, bid: int) -> Optional[int]:
+        """Prefix hash published for a block id, or None (private/tail
+        blocks never enter the hash table)."""
+        return self._block_to_hash.get(bid)
+
+    def registered_hashes(self) -> List[int]:
+        """All prefix hashes currently resident in the pool — the
+        device-tier listing an advert snapshot starts from."""
+        return list(self._hash_to_block)
+
     def import_block(self, h: int, block_tokens: Sequence[int]
                      ) -> Optional[int]:
         """Adopt one externally produced prefix block (KV transfer from
@@ -180,6 +198,8 @@ class BlockAllocator:
             return None
         self._hash_to_block[h] = (bid, tuple(block_tokens))
         self._block_to_hash[bid] = h
+        if self.on_register is not None:
+            self.on_register(h)
         return bid
 
     def register_prefix(self, tokens: Sequence[int],
@@ -195,6 +215,8 @@ class BlockAllocator:
                 continue               # block already published
             self._hash_to_block[h] = (bid, tuple(tokens[i * bs:(i + 1) * bs]))
             self._block_to_hash[bid] = h
+            if self.on_register is not None:
+                self.on_register(h)
 
 
 # ---------------------------------------------------------------------------

@@ -82,6 +82,18 @@ class PrefixIndex:
             depth += 1
         return depth
 
+    def discard(self, hashes: Sequence[int]) -> int:
+        """Unlearn: drop hashes the replica adverted as evicted, so a
+        stale shadow entry cannot keep attracting traffic (or direct a
+        fleet fetch) toward a block the allocator scrubbed.  Returns how
+        many entries actually left."""
+        n = 0
+        for h in hashes:
+            if h in self._hashes:
+                del self._hashes[h]
+                n += 1
+        return n
+
 
 class HotPrompts:
     """Bounded LRU of block-aligned prompt prefixes with hit counts.
@@ -144,13 +156,19 @@ class BackendSnapshot(dict):
 def summarize_backend(service: str, url: str, weight: int, inflight: int,
                       queue_depth: int, kv_free_blocks: int,
                       kv_total_blocks: int, index_size: int,
-                      picks: int, tier: str = "mixed") -> BackendSnapshot:
+                      picks: int, tier: str = "mixed",
+                      host_free_blocks: int = 0,
+                      host_total_blocks: int = 0) -> BackendSnapshot:
     occ = 0.0
     if kv_total_blocks > 0:
         occ = round(1.0 - kv_free_blocks / kv_total_blocks, 4)
+    host_occ = 0.0
+    if host_total_blocks > 0:
+        host_occ = round(1.0 - host_free_blocks / host_total_blocks, 4)
     return BackendSnapshot(
         service=service, url=url, weight=weight, tier=tier,
         inflight=inflight, queue_depth=queue_depth, kv_occupancy=occ,
+        kv_host_occupancy=host_occ,
         prefix_index_size=index_size, picks=picks)
 
 

@@ -331,6 +331,15 @@ class ServeFrontend:
                 if "num_blocks" in st:
                     h["X-TPU-KV-Free-Blocks"] = str(st["free_blocks"])
                     h["X-TPU-KV-Total-Blocks"] = str(st["num_blocks"])
+                if "advert_seq" in st:
+                    # Tiered engines piggyback their residency-advert
+                    # cursor + host-tier occupancy; the gateway pulls
+                    # the /v1/kv/advert delta when the cursor moves.
+                    h["X-TPU-KV-Advert-Seq"] = str(st["advert_seq"])
+                    h["X-TPU-KV-Host-Free-Blocks"] = str(
+                        st["host_blocks_total"] - st["host_blocks_used"])
+                    h["X-TPU-KV-Host-Total-Blocks"] = str(
+                        st["host_blocks_total"])
                 return h
 
             def do_GET(self):
@@ -345,6 +354,31 @@ class ServeFrontend:
                     return self._send(200, {"status": "ok"})
                 if self.path == "/stats":
                     return self._send(200, frontend.stats())
+                if self.path.split("?", 1)[0] == "/v1/kv/advert":
+                    # Residency advert delta for the gateway's fleet
+                    # index (serve/kv_tiers.py).  ?since=N returns the
+                    # membership changes after N, or a full snapshot
+                    # when N fell out of the bounded advert log.
+                    if not hasattr(frontend.engine, "kv_advert"):
+                        return self._send(501, {
+                            "message": "KV adverts require a paged "
+                                       "engine (--paged)"})
+                    qs = self.path.partition("?")[2]
+                    since = 0
+                    for part in qs.split("&"):
+                        if part.startswith("since="):
+                            try:
+                                since = int(part[6:])
+                            except ValueError:
+                                return self._send(400, {
+                                    "message": "since must be an int"})
+                    try:
+                        doc = frontend.call_engine(
+                            lambda e: e.kv_advert(since))
+                    except TimeoutError as e:
+                        return self._send(503, {"message": str(e)})
+                    return self._send(200, doc,
+                                      headers=self._load_headers())
                 if self.path == "/metrics":
                     # Prometheus text exposition (the vLLM-server
                     # /metrics role): every numeric stat becomes a
@@ -610,6 +644,8 @@ _CONFIG_KEYS = {
     "paged": (bool, None),
     "block_size": (int, None),
     "num_blocks": (int, None),
+    "host_blocks": (int, None),
+    "spill_blocks": (int, None),
     "prefill_chunk": (int, None),
     "speculative": (int, None),
     "kv_quant": (str, ("none", "int8")),
@@ -712,6 +748,12 @@ def main(argv=None):  # pragma: no cover - process wrapper
     ap.add_argument("--block-size", type=int, default=16)
     ap.add_argument("--num-blocks", type=int, default=0,
                     help="KV pool size in blocks (0 = dense-equivalent)")
+    ap.add_argument("--host-blocks", type=int, default=0,
+                    help="host-DRAM KV tier capacity in blocks (0 = "
+                         "tiering off; paged engines only)")
+    ap.add_argument("--spill-blocks", type=int, default=0,
+                    help="bounded spill KV tier behind the host tier "
+                         "(blocks; 0 = off)")
     ap.add_argument("--decode-impl", default="auto",
                     choices=["auto", "pallas", "xla", "pallas_interpret"],
                     help="decode attention path for the paged and "
@@ -824,7 +866,9 @@ def main(argv=None):  # pragma: no cover - process wrapper
                          speculative=args.speculative,
                          kv_quant=args.kv_quant, mesh=mesh,
                          weight_quant=args.weight_quant,
-                         donate_params=args.weight_quant != "none")
+                         donate_params=args.weight_quant != "none",
+                         host_blocks=args.host_blocks,
+                         spill_blocks=args.spill_blocks)
     else:
         engine_kw = dict(max_slots=args.max_slots, max_len=args.max_len,
                          prefill_chunk=args.prefill_chunk,

@@ -316,6 +316,10 @@ class SimHarness:
         # Classic scenarios create no routes: both logs stay empty.
         self.route_weight_log: List[Dict[str, Any]] = []
         self.serve_traffic_log: List[Dict[str, Any]] = []
+        # KV-tier seam feed (invariants no-stale-block): only the
+        # session-churn scenario appends; classic scenarios leave it
+        # empty so the checker is vacuous and journal hashes hold.
+        self.kv_tier_log: List[Dict[str, Any]] = []
         self._route_specs: Dict[str, str] = {}
         self._route_watch_cancel = self.store.watch(
             self._observe_route_event)
@@ -1065,7 +1069,7 @@ class SimHarness:
             slow_host_log=self.slow_host_log,
             route_weight_log=self.route_weight_log,
             serve_traffic_log=self.serve_traffic_log,
-            quota=self.quota))
+            quota=self.quota, kv_tier_log=self.kv_tier_log))
         if not self.converged:
             violations.append(Violation(
                 "convergence", f"step {self._step}",

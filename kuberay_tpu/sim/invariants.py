@@ -52,7 +52,8 @@ class CheckContext:
                  slow_host_log: Optional[List[Dict[str, Any]]] = None,
                  route_weight_log: Optional[List[Dict[str, Any]]] = None,
                  serve_traffic_log: Optional[List[Dict[str, Any]]] = None,
-                 quota=None):
+                 quota=None,
+                 kv_tier_log: Optional[List[Dict[str, Any]]] = None):
         self.store = store
         self.journal = journal or []
         self.steps = steps
@@ -67,6 +68,12 @@ class CheckContext:
         # quota-* checkers read its ledger snapshot and are vacuous
         # without it.
         self.quota = quota
+        # KV-tier seam ops (session-churn scenario): every admit /
+        # checkout-hit / discard against a real KvTierStore, with the
+        # block tokens and payload that crossed the seam.  Empty for
+        # every classic scenario, so the no-stale-block checker is
+        # vacuous there and journal hashes are untouched.
+        self.kv_tier_log = kv_tier_log or []
 
     # -- shared traversals -------------------------------------------------
 
@@ -659,4 +666,50 @@ def check_quota_starvation_bound(ctx: CheckContext) -> List[Violation]:
                 f"{p['key'][0]} {p['key'][1]}/{p['key'][2]}",
                 f"pending {now - p['since']:.0f}s exceeds the "
                 f"{bound:.0f}s starvation bound without escalation"))
+    return out
+
+
+@checker("no-stale-block",
+         "(vacuous without the kv-tier seam) every checkout hit returns "
+         "the payload whose content hashes to the requested block hash, "
+         "and no discarded hash is served without a re-admit")
+def check_no_stale_block(ctx: CheckContext) -> List[Violation]:
+    """Content-addressing is the tier store's whole safety story: a hash
+    names exactly one token-block, so a hit serving anything other than
+    the content that hashes to it is KV corruption (the served tokens
+    would decode against the wrong prefix).  The session-churn scenario
+    logs every seam crossing with ground truth; this checker recomputes
+    the chain link (prefix.chain_hash) and replays the admit/discard
+    ledger per hash."""
+    if not ctx.kv_tier_log:
+        return []
+    from kuberay_tpu.serve.prefix import chain_hash
+    out: List[Violation] = []
+    live: Dict[int, bool] = {}   # hash -> currently admitted somewhere
+    for i, rec in enumerate(ctx.kv_tier_log):
+        op, h = rec.get("op"), rec.get("hash")
+        if op == "admit":
+            live[h] = True
+        elif op == "discard":
+            live[h] = False
+        elif op == "hit":
+            want = chain_hash(rec.get("parent", 0),
+                              rec.get("block_tokens", ()))
+            if want != h:
+                out.append(Violation(
+                    "no-stale-block", f"op {i} hash {h}",
+                    "checkout hit for a hash that does not match its "
+                    "requested block content (chain link mismatch)"))
+            if list(rec.get("payload", ())) != \
+                    list(rec.get("block_tokens", ())):
+                out.append(Violation(
+                    "no-stale-block", f"op {i} hash {h}",
+                    f"checkout served payload {rec.get('payload')!r} for "
+                    f"block content {rec.get('block_tokens')!r} — stale "
+                    "or corrupted tier entry crossed the seam"))
+            if not live.get(h, False):
+                out.append(Violation(
+                    "no-stale-block", f"op {i} hash {h}",
+                    "checkout hit on a hash with no live admit (served "
+                    "after discard/eviction)"))
     return out

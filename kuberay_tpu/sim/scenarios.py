@@ -725,3 +725,109 @@ class _DeadlineCronFleet:
         h.clock.advance(90.0)
         h.manager.enqueue((C.KIND_CRONJOB, "default", "reports"))
         h.succeed_jobs()
+
+
+# ---------------------------------------------------------------------------
+# session churn: a real KvTierStore under multi-turn session traffic
+# ---------------------------------------------------------------------------
+
+@scenario(
+    "session-churn",
+    "a real KvTierStore (host+spill tiers, raw token payloads) under "
+    "multi-turn session growth, capacity churn, stale re-admits and pod "
+    "kills: a checkout hit must always serve the content its hash names, "
+    "and never a discarded block",
+    profile={F.POD_KILL: 0.5, F.PREEMPTION_NOTICE: 0.4, F.SLOW_START: 0.3,
+             F.STORE_CONFLICT: 0.4, F.WATCH_DROP: 0.2, F.WATCH_DUP: 0.2,
+             F.WATCH_DELAY: 0.3, F.DELETE_RACE: 0.0, F.SLICE_DRAIN: 0.0,
+             F.LEADER_FAILOVER: 0.0})
+class _SessionChurn:
+    BLOCK = 8
+    # Deliberately tight tiers: ~6 sessions of growing chains against 24
+    # host + 8 spill blocks forces demotion-to-spill and hard eviction
+    # every few ticks — the regimes where a stale serve would hide.
+    HOST, SPILL = 24, 8
+    MAX_SESSIONS = 6
+
+    def setup(self, h):
+        from kuberay_tpu.serve.kv_tiers import KvTierStore
+        # The control-plane workload the fault profile bites on (pod
+        # kills / notices need pods); the tier store itself is a data-
+        # plane object the scenario drives directly.
+        h.store.create(make_cluster_obj("churn", accelerator="v5e",
+                                        topology="2x2", replicas=2,
+                                        max_replicas=4))
+        h.kv_store = KvTierStore(self.HOST, self.SPILL)
+        h.kv_sessions = {}      # sid -> token list
+        h.kv_block_tokens = {}  # hash -> (parent, block token tuple)
+
+    def _chain(self, tokens):
+        from kuberay_tpu.serve.prefix import chain_hash
+        out, parent = [], 0
+        for i in range(0, len(tokens) - len(tokens) % self.BLOCK,
+                       self.BLOCK):
+            blk = tuple(tokens[i:i + self.BLOCK])
+            hsh = chain_hash(parent, blk)
+            out.append((hsh, parent, blk))
+            parent = hsh
+        return out
+
+    def tick(self, h, step):
+        rng = h.plan.rng
+        st, sessions = h.kv_store, h.kv_sessions
+        # 1. Grow (or open) a few sessions: each turn appends tokens,
+        #    the replica "frees" the new full blocks (decode moved on),
+        #    and the demotion pump parks them in the host tier.
+        for _ in range(rng.randint(1, 3)):
+            sid = f"s{rng.randint(0, self.MAX_SESSIONS - 1)}"
+            toks = sessions.setdefault(sid, [])
+            toks.extend(rng.randint(1, 255)
+                        for _ in range(rng.randint(4, 20)))
+            for hsh, parent, blk in self._chain(toks):
+                if hsh in h.kv_block_tokens:
+                    continue
+                h.kv_block_tokens[hsh] = (parent, blk)
+                st.note_device(hsh, True)
+                st.note_device(hsh, False)   # device copy cannibalized
+                st.note_freed(hsh)
+        while True:
+            pending = st.pop_pending()
+            if pending is None:
+                break
+            parent, blk = h.kv_block_tokens[pending]
+            st.admit(pending, blk, tuple(blk))
+            h.kv_tier_log.append({"op": "admit", "hash": pending})
+        # 2. A stale re-admit: a buggy peer re-offers an evicted hash
+        #    with a payload whose content it is NOT.  Admit is content-
+        #    blind by design (hashes are the contract between honest
+        #    peers), so the wrong entry lands — checkout's content
+        #    check is the last line and must refuse to serve it.
+        if h.kv_block_tokens and rng.random() < 0.5:
+            victim = rng.choice(sorted(h.kv_block_tokens))
+            if st.discard(victim):
+                h.kv_tier_log.append({"op": "discard", "hash": victim})
+            wrong = tuple(rng.randint(1, 255) for _ in range(self.BLOCK))
+            st.admit(victim, wrong, wrong)
+            h.kv_tier_log.append({"op": "admit", "hash": victim})
+        # 3. Resume a session: walk its chain through checkout exactly
+        #    like the engine's promotion path, logging ground truth for
+        #    the no-stale-block checker.
+        live = [s for s in sorted(sessions) if sessions[s]]
+        if live:
+            sid = rng.choice(live)
+            for hsh, parent, blk in self._chain(sessions[sid]):
+                payload = st.checkout(hsh, blk)
+                if payload is None:
+                    break   # promotion stops at the first tier miss
+                h.kv_tier_log.append({
+                    "op": "hit", "hash": hsh, "parent": parent,
+                    "block_tokens": list(blk), "payload": list(payload),
+                    "tier": st.tier_of(hsh) or "host"})
+        # 4. Churn: a killed pod's sessions end; their blocks are
+        #    discarded (the eviction-notice path PrefixIndex unlearning
+        #    mirrors fleet-side).
+        if live and rng.random() < 0.35:
+            sid = rng.choice(live)
+            for hsh, _, _ in self._chain(sessions.pop(sid)):
+                if st.discard(hsh):
+                    h.kv_tier_log.append({"op": "discard", "hash": hsh})

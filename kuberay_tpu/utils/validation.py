@@ -416,6 +416,18 @@ def validate_service(svc: TpuService) -> List[str]:
                        f"serveConfig.applications[{i}].name "
                        f"{app['name']!r} is duplicated", errs)
                 app_names.add(app["name"])
+    kv = svc.spec.kvTiers
+    if kv is not None:
+        _check(kv.hostBlocks >= 0, "kvTiers.hostBlocks must be >= 0", errs)
+        _check(kv.spillBlocks >= 0, "kvTiers.spillBlocks must be >= 0", errs)
+        # A spill tier with no host tier is unreachable: demotion only
+        # flows device → host → spill (docs/kv-tiers.md).
+        _check(kv.spillBlocks == 0 or kv.hostBlocks > 0,
+               "kvTiers.spillBlocks requires hostBlocks > 0", errs)
+        _check(kv.sessionCapacity > 0,
+               "kvTiers.sessionCapacity must be > 0", errs)
+        _check(kv.sessionTtlSeconds > 0,
+               "kvTiers.sessionTtlSeconds must be > 0", errs)
     _check(svc.spec.clusterDeletionDelaySeconds >= 0,
            "clusterDeletionDelaySeconds must be >= 0", errs)
     _check(svc.spec.serviceUnhealthySecondThreshold >= 0,

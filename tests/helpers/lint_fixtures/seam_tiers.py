@@ -1,0 +1,75 @@
+"""Tier-seam fixtures: internals bypass (positive), suppressed, clean.
+
+The per-file ``kv-block-through-tier-seam`` rule exempts the store
+class itself (any class defining both ``checkout`` and ``admit``);
+everything else touching ``<...tiers...>._underscore`` is a ledger
+desync.
+"""
+
+from collections import OrderedDict
+
+
+class FixtureTierStore:
+    """The seam owner: defines checkout + admit, so its own underscore
+    internals are fair game."""
+
+    def __init__(self):
+        self._host = OrderedDict()
+        self._spill = OrderedDict()
+
+    def admit(self, h, tokens, payload):
+        self._host[h] = (tuple(tokens), payload)
+        return True
+
+    def checkout(self, h, tokens):
+        hit = self._host.get(h)
+        if hit and hit[0] == tuple(tokens):
+            self._host.move_to_end(h)
+            return hit[1]
+        return None
+
+    def discard(self, h):
+        return int(self._host.pop(h, None) is not None)
+
+
+class FixtureEngineBypass:
+    """POSITIVE: frees a block by popping the store's host dict
+    directly — the gauges and advert log never hear about it, so the
+    fleet index keeps advertising the dead block."""
+
+    def __init__(self):
+        self.tiers = FixtureTierStore()
+
+    def _fast_free(self, h):
+        self.tiers._host.pop(h, None)
+
+
+class FixtureEngineSuppressed:
+    """SUPPRESSED: same shape, waived with a reason."""
+
+    def __init__(self):
+        self.tier_store = FixtureTierStore()
+
+    def _debug_dump(self):
+        # kuberay-lint: disable-next-line=kv-block-through-tier-seam -- fixture: read-only introspection in a debug handler, never mutates residency
+        return dict(self.tier_store._host)
+
+
+class FixtureEngineClean:
+    """NEGATIVE: residency changes go through the seam; public stats
+    and non-store underscore attrs stay quiet."""
+
+    def __init__(self):
+        self.tiers = FixtureTierStore()
+        self._pending = []
+
+    def free(self, h):
+        return self.tiers.discard(h)
+
+    def resume(self, h, tokens):
+        return self.tiers.checkout(h, tokens)
+
+    def drain(self):
+        while self._pending:
+            h, blk = self._pending.pop()
+            self.tiers.admit(h, blk, tuple(blk))

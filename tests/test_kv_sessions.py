@@ -1,0 +1,303 @@
+"""Stateful session serving gate (gateway + kv_tiers, docs/kv-tiers.md).
+
+The resume contract through the gateway: a request carrying a
+``session`` id sticks to its last backend and resumes its KV chain from
+the tier hierarchy instead of re-prefilling; when the chain lives on a
+peer, the fleet index directs a fleet fetch; when the owning replica
+evicted it, the advert channel UNLEARNS the index so a stale entry can
+never direct a fetch at a dead block (the PR's regression gate); and
+the whole resume decomposes into session-lookup / (fleet-fetch) /
+tier-fetch / decode spans under one serve-request root at
+/debug/traces?tree=1.
+"""
+
+import json
+import time
+import urllib.request
+
+import jax
+import pytest
+
+from kuberay_tpu.controlplane.store import ObjectStore
+from kuberay_tpu.models import llama
+from kuberay_tpu.obs import Tracer, span_tree
+from kuberay_tpu.serve.gateway import GatewayConfig, WeightedGateway
+from kuberay_tpu.serve.paged_engine import PagedServeEngine
+from kuberay_tpu.serve.prefix import block_hashes
+from kuberay_tpu.serve.server import ServeFrontend
+from kuberay_tpu.utils.metrics import MetricsRegistry
+
+CFG = llama.CONFIGS["llama_tiny"]
+BS = 8
+PROMPT = list(range(1, 25))                      # 3 full blocks, in-vocab
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init_params(CFG, jax.random.PRNGKey(0))
+
+
+def _route(store, weights, name="sess-route"):
+    store.create({
+        "apiVersion": "tpu.dev/v1", "kind": "TrafficRoute",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {"backends": [
+            {"service": svc, "weight": w} for svc, w in weights.items()]},
+        "status": {},
+    })
+
+
+def _set_weights(store, weights, name="sess-route"):
+    obj = store.get("TrafficRoute", name)
+    obj["spec"]["backends"] = [
+        {"service": svc, "weight": w} for svc, w in weights.items()]
+    store.update(obj)
+    time.sleep(0.25)                             # route watch refresh
+
+
+class _Fleet:
+    """N tiered replicas behind one gateway, all sharing one tracer."""
+
+    def __init__(self, params, services, tracer=None, metrics=None,
+                 host_blocks=64, weights=None):
+        self.tracer = tracer
+        self.engines, self.frontends, self.servers, self.urls = {}, {}, {}, {}
+        for svc in services:
+            eng = PagedServeEngine(CFG, params, max_slots=2, max_len=64,
+                                   block_size=BS, host_blocks=host_blocks,
+                                   tracer=tracer)
+            fe = ServeFrontend(eng, max_queue=8)
+            srv, url = fe.serve_background()
+            self.engines[svc], self.frontends[svc] = eng, fe
+            self.servers[svc], self.urls[svc] = srv, url
+        self.store = ObjectStore()
+        _route(self.store, weights or {svc: 1 for svc in services})
+        self.gateway = WeightedGateway(
+            self.store, "sess-route", resolver=lambda s: self.urls[s],
+            poll_interval=0.05, tracer=tracer, metrics=metrics,
+            config=GatewayConfig(block_size=BS))
+        time.sleep(0.1)                          # first route poll
+
+    def turn(self, prompt, sid, max_tokens=4):
+        body = json.dumps({"prompt_tokens": list(prompt),
+                           "max_tokens": max_tokens, "temperature": 0.0,
+                           "session": sid}).encode()
+        code, payload, headers = self.gateway.forward_ex(
+            "/v1/completions", body, 120.0)
+        return code, json.loads(payload), headers
+
+    def drain_pump(self, svc):
+        self.frontends[svc].call_engine(
+            lambda e: e._pump_demotions(limit=1 << 20))
+
+    def evict_device(self, svc):
+        """Cannibalize every cached device block with in-vocab junk
+        posted straight to the replica (the gateway never sees it)."""
+        eng = self.engines[svc]
+        plen = (eng.max_blocks - 1) * BS
+        rounds = eng.num_blocks // (eng.max_blocks - 1) + 1
+        for j in range(rounds):
+            toks = [(30 + j * plen + i) % 231 + 25 for i in range(plen)]
+            req = urllib.request.Request(
+                self.urls[svc] + "/v1/completions",
+                data=json.dumps({"prompt_tokens": toks,
+                                 "max_tokens": 1}).encode(),
+                headers={"Content-Type": "application/json"})
+            urllib.request.urlopen(req, timeout=60).read()
+
+    def prefill_tokens(self, svc):
+        st = self.frontends[svc].call_engine(lambda e: dict(e.stats))
+        return st["prefix_query_tokens"] - st["prefix_hit_tokens"]
+
+    def close(self):
+        self.gateway.close()
+        for svc in self.servers:
+            self.servers[svc].shutdown()
+            self.frontends[svc].close()
+
+
+# ---------------------------------------------------------------------------
+# resume + stickiness
+# ---------------------------------------------------------------------------
+
+@pytest.mark.timeout(300)
+def test_session_resume_sticks_and_skips_prefill(params):
+    fleet = _Fleet(params, ["replica-0"])
+    try:
+        code, doc, _ = fleet.turn(PROMPT, "s1")
+        assert code == 200
+        stats = fleet.gateway.session_stats()
+        assert stats["sessions"] == 1 and stats["session_resumes"] == 0
+        fleet.drain_pump("replica-0")
+
+        turn2 = PROMPT + doc["tokens"] + list(range(30, 38))
+        p0 = fleet.prefill_tokens("replica-0")
+        code, _, _ = fleet.turn(turn2, "s1")
+        assert code == 200
+        stats = fleet.gateway.session_stats()
+        assert stats["session_resumes"] == 1
+        # The chain covers prompt + response: turn 2 re-prefilled only
+        # the unseen tail, never the whole conversation.
+        assert fleet.prefill_tokens("replica-0") - p0 < len(turn2) - BS
+    finally:
+        fleet.close()
+
+
+@pytest.mark.timeout(300)
+def test_session_resume_promotes_from_host_tier(params):
+    """Device eviction between turns: the resume is served by host-tier
+    promotion (tier_fetch_blocks moves), not a full re-prefill."""
+    fleet = _Fleet(params, ["replica-0"])
+    try:
+        code, doc, _ = fleet.turn(PROMPT, "s1")
+        assert code == 200
+        fleet.drain_pump("replica-0")
+        fleet.evict_device("replica-0")
+        eng = fleet.engines["replica-0"]
+        assert fleet.frontends["replica-0"].call_engine(
+            lambda e: e.resident_prefix_blocks(PROMPT)) == 0
+
+        fetched0 = fleet.frontends["replica-0"].call_engine(
+            lambda e: e.tier_fetch_blocks)
+        turn2 = PROMPT + doc["tokens"] + list(range(30, 38))
+        code, _, _ = fleet.turn(turn2, "s1")
+        assert code == 200
+        fetched = fleet.frontends["replica-0"].call_engine(
+            lambda e: e.tier_fetch_blocks)
+        assert fetched - fetched0 >= 3
+    finally:
+        fleet.close()
+
+
+# ---------------------------------------------------------------------------
+# fleet fetch from a peer
+# ---------------------------------------------------------------------------
+
+@pytest.mark.timeout(300)
+def test_session_fleet_fetch_from_peer(params):
+    """The session's backend drains out of the route: the resume lands
+    on the peer, which fleet-fetches the chain from the replica the
+    residency index names instead of recomputing it."""
+    tracer = Tracer(max_spans=8192)
+    metrics = MetricsRegistry()
+    fleet = _Fleet(params, ["replica-a", "replica-b"], tracer=tracer,
+                   metrics=metrics, weights={"replica-a": 1,
+                                             "replica-b": 0})
+    try:
+        code, doc, _ = fleet.turn(PROMPT, "s1")
+        assert code == 200
+        fleet.drain_pump("replica-a")
+        # One more request so the gateway observes replica-a's advert
+        # cursor and syncs the fleet index.
+        assert fleet.turn([1, 2, 3], "warm")[0] == 200
+
+        _set_weights(fleet.store, {"replica-a": 0, "replica-b": 1})
+        turn2 = PROMPT + doc["tokens"] + list(range(30, 38))
+        p0 = fleet.prefill_tokens("replica-b")
+        code, _, hdrs = fleet.turn(turn2, "s1")
+        assert code == 200
+        trace_id = hdrs["traceparent"].split("-")[1]
+        spans = {s["name"]: s for s in tracer.export(trace_id)}
+        ff = spans.get("fleet-fetch")
+        assert ff is not None and ff["attrs"]["blocks_sent"] >= 3
+        assert ff["attrs"]["src"] == "replica-a"
+        assert ff["attrs"]["dst"] == "replica-b"
+        # The shipped chain covered the conversation so far; only the
+        # unseen tail prefilled on the peer.
+        assert fleet.prefill_tokens("replica-b") - p0 < len(turn2) - BS
+        assert "tpu_kv_fleet_fetch_blocks_total" in metrics.render()
+    finally:
+        fleet.close()
+
+
+# ---------------------------------------------------------------------------
+# the regression gate: eviction unlearns the index, no stale fleet fetch
+# ---------------------------------------------------------------------------
+
+@pytest.mark.timeout(300)
+def test_evicted_blocks_cannot_attract_a_fleet_fetch(params):
+    """Satellite #1: once the owning replica evicts a chain from every
+    tier and adverts the deletions, the fleet index forgets it — a
+    resume elsewhere recomputes (no fleet-fetch span, no transfer
+    attempt at dead blocks) and still succeeds."""
+    tracer = Tracer(max_spans=8192)
+    metrics = MetricsRegistry()
+    # Host tier sized below the junk working set, so the junk fill
+    # naturally evicts the session chain from host as well as device.
+    fleet = _Fleet(params, ["replica-a", "replica-b"], tracer=tracer,
+                   metrics=metrics, host_blocks=8,
+                   weights={"replica-a": 1, "replica-b": 0})
+    try:
+        code, doc, _ = fleet.turn(PROMPT, "s1")
+        assert code == 200
+        fleet.drain_pump("replica-a")
+        assert fleet.turn([1, 2, 3], "warm")[0] == 200   # index learns a
+
+        chain = block_hashes(PROMPT + doc["tokens"], BS)
+        # The fill evicts the chain from device AND pressures it out of
+        # the 8-block host tier; the pump demotes junk over it.
+        fleet.evict_device("replica-a")
+        fleet.drain_pump("replica-a")
+        resident = fleet.frontends["replica-a"].call_engine(
+            lambda e: [e.tiers.tier_of(h) for h in chain])
+        assert set(resident) == {None}, resident
+        # Another request to replica-a relays the advert deltas: the
+        # deletions UNLEARN the fleet index (and the affinity shadow).
+        assert fleet.turn([1, 2, 3, 4], "warm2")[0] == 200
+
+        _set_weights(fleet.store, {"replica-a": 0, "replica-b": 1})
+        turn2 = PROMPT + doc["tokens"] + list(range(30, 38))
+        code, _, hdrs = fleet.turn(turn2, "s1")
+        assert code == 200                       # resume still works...
+        trace_id = hdrs["traceparent"].split("-")[1]
+        names = {s["name"] for s in tracer.export(trace_id)}
+        assert "fleet-fetch" not in names, (
+            "stale index entry directed a fleet fetch at evicted blocks")
+        assert "tpu_kv_index_invalidations_total" in metrics.render()
+    finally:
+        fleet.close()
+
+
+# ---------------------------------------------------------------------------
+# acceptance: the resume trace decomposes under one root
+# ---------------------------------------------------------------------------
+
+@pytest.mark.timeout(300)
+def test_resume_trace_tree_at_debug_endpoint(params):
+    """One trace id on the resume response resolves, at
+    /debug/traces?tree=1, to a single serve-request root whose children
+    decompose the resume: session-lookup, the forward hop, and the
+    engine-side tier-fetch + decode spans."""
+    from kuberay_tpu.apiserver.server import serve_background
+
+    tracer = Tracer(max_spans=8192)
+    fleet = _Fleet(params, ["replica-0"], tracer=tracer)
+    api_srv = api_url = None
+    try:
+        code, doc, _ = fleet.turn(PROMPT, "s1")
+        assert code == 200
+        fleet.drain_pump("replica-0")
+        fleet.evict_device("replica-0")
+
+        turn2 = PROMPT + doc["tokens"] + list(range(30, 38))
+        code, _, hdrs = fleet.turn(turn2, "s1")
+        assert code == 200
+        trace_id = hdrs["traceparent"].split("-")[1]
+
+        api_srv, api_url = serve_background(ObjectStore(), tracer=tracer)
+        with urllib.request.urlopen(
+                f"{api_url}/debug/traces?tree=1&trace_id={trace_id}",
+                timeout=30) as resp:
+            trees = json.load(resp)["traces"]
+        assert len(trees) == 1
+        root = trees[0]
+        assert root["name"] == "serve-request"
+        children = {c["name"] for c in root["children"]}
+        assert {"session-lookup", "forward", "tier-fetch",
+                "prefill", "decode"} <= children, sorted(children)
+        # Every span of the resume lives under the one root.
+        assert all(not c["children"] for c in root["children"])
+    finally:
+        if api_srv is not None:
+            api_srv.shutdown()
+        fleet.close()

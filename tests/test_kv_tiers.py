@@ -1,0 +1,391 @@
+"""Tiered KV-cache hierarchy gate (serve/kv_tiers.py + paged engine).
+
+Three layers:
+
+1. the store's own contracts — content-verified checkout, LRU pressure
+   demotion host->spill->gone, pin exclusion, the bounded advert log
+   (delta vs reset snapshot);
+2. the gateway-side session/fleet structures — TTL + capacity bounds,
+   exact unlearning, deterministic best-source scoring;
+3. the paged engine wired through the hierarchy — demotion pump,
+   promotion back into the pool on resume (bit-identical to recompute),
+   tier-served export, and an importer racing the source's eviction.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from kuberay_tpu.models import llama
+from kuberay_tpu.obs import Tracer
+from kuberay_tpu.serve.engine import Request
+from kuberay_tpu.serve.kv_tiers import (
+    TIER_DEVICE,
+    TIER_HOST,
+    TIER_SPILL,
+    FleetKvIndex,
+    KvTierStore,
+    SessionTable,
+)
+from kuberay_tpu.serve.paged_engine import PagedServeEngine
+from kuberay_tpu.serve.prefix import block_hashes
+from kuberay_tpu.utils.metrics import MetricsRegistry
+
+CFG = llama.CONFIGS["llama_tiny"]
+BS = 8
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init_params(CFG, jax.random.PRNGKey(0))
+
+
+def _blk(i):
+    """A distinct full block of tokens for hash ``i``."""
+    return tuple(range(i * 100, i * 100 + 4))
+
+
+# ---------------------------------------------------------------------------
+# KvTierStore
+# ---------------------------------------------------------------------------
+
+def test_store_admit_checkout_roundtrip():
+    st = KvTierStore(host_blocks=4)
+    assert st.admit(11, _blk(1), "payload-1")
+    assert st.checkout(11, _blk(1)) == "payload-1"
+    assert st.tier_of(11) == TIER_HOST and st.contains(11)
+    assert st.checkout(99, _blk(9)) is None
+    s = st.stats()
+    assert (s["tier_hits_host"], s["tier_misses"]) == (1, 1)
+
+
+def test_store_checkout_is_content_verified():
+    """A stored entry whose tokens differ from the requested ones is a
+    stale overwrite — dropped and counted, never served (the invariant
+    the sim's no-stale-block checker replays)."""
+    st = KvTierStore(host_blocks=4)
+    st.admit(11, _blk(1), "stale")
+    assert st.checkout(11, _blk(2)) is None
+    assert st.stale_drops == 1
+    # The poisoned entry is gone: even the original tokens now miss.
+    assert st.checkout(11, _blk(1)) is None
+    assert not st.contains(11)
+
+
+def test_store_pressure_demotes_host_lru_then_drops_spill_lru():
+    st = KvTierStore(host_blocks=2, spill_blocks=1)
+    for i in (1, 2, 3):
+        st.admit(i, _blk(i), f"p{i}")
+    # Host LRU (1) demoted to spill; 2,3 stay host.
+    assert st.tier_of(1) == TIER_SPILL
+    assert st.tier_of(2) == TIER_HOST and st.tier_of(3) == TIER_HOST
+    assert st.demotions == 1
+    st.admit(4, _blk(4), "p4")
+    # 2 demotes host->spill; spill overflows and drops its LRU (1).
+    assert st.tier_of(1) is None and st.tier_of(2) == TIER_SPILL
+    assert st.evictions == 1
+    # Disabled spill: pressure drops straight off the hierarchy.
+    flat = KvTierStore(host_blocks=1)
+    flat.admit(1, _blk(1), "a")
+    flat.admit(2, _blk(2), "b")
+    assert flat.tier_of(1) is None and flat.evictions == 1
+
+
+def test_store_spill_hit_promotes_to_host():
+    st = KvTierStore(host_blocks=2, spill_blocks=2)
+    for i in (1, 2, 3):
+        st.admit(i, _blk(i), f"p{i}")
+    assert st.tier_of(1) == TIER_SPILL
+    assert st.checkout(1, _blk(1)) == "p1"
+    assert st.tier_of(1) == TIER_HOST
+    assert st.promotions == 1 and st.hits[TIER_SPILL] == 1
+
+
+def test_store_pin_excludes_from_eviction():
+    st = KvTierStore(host_blocks=1)
+    st.admit(1, _blk(1), "pinned")
+    st.pin(1)
+    # Everything pinned: the newest admit is shed, not the pinned block.
+    assert not st.admit(2, _blk(2), "shed")
+    assert st.tier_of(1) == TIER_HOST and st.tier_of(2) is None
+    st.unpin(1)
+    assert st.admit(3, _blk(3), "p3")
+    assert st.tier_of(1) is None and st.tier_of(3) == TIER_HOST
+
+
+def test_store_discard_counts_tier_copies():
+    st = KvTierStore(host_blocks=2, spill_blocks=2)
+    for i in (1, 2, 3):
+        st.admit(i, _blk(i), f"p{i}")
+    assert st.discard(1) == 1          # spill copy
+    assert st.discard(2) == 1          # host copy
+    assert st.discard(99) == 0         # never resident
+    assert not st.contains(1) and not st.contains(2)
+
+
+def test_store_admit_readmit_is_content_addressed_noop():
+    """Re-admitting a resident hash refreshes recency but never
+    replaces content — same hash means same bytes by construction."""
+    st = KvTierStore(host_blocks=2)
+    st.admit(1, _blk(1), "original")
+    assert st.admit(1, _blk(9), "imposter")
+    assert st.checkout(1, _blk(1)) == "original"
+
+
+def test_advert_delta_and_reset_snapshot():
+    st = KvTierStore(host_blocks=4, spill_blocks=2, advert_capacity=16)
+    st.note_device(7, True)
+    st.admit(1, _blk(1), "a")
+    seq = st.advert_seq
+    doc = st.advert_since(0)
+    # The log still reaches back to seq 0: a plain delta replays the
+    # full history (reset is only for readers past the window).
+    assert not doc["reset"]
+    assert sorted(doc["add"]) == [[1, TIER_HOST], [7, TIER_DEVICE]]
+    st.admit(2, _blk(2), "b")
+    st.discard(1)
+    delta = st.advert_since(seq)
+    assert not delta["reset"]
+    assert delta["add"] == [[2, TIER_HOST]] and delta["del"] == [1]
+    assert st.advert_since(st.advert_seq) == \
+        {"seq": st.advert_seq, "reset": False, "add": [], "del": []}
+    # Overflow the bounded log: a laggard reader gets reset, not a
+    # silently truncated delta.
+    for i in range(10, 40):
+        st.admit(i, _blk(i), "x")
+    assert st.advert_since(seq)["reset"]
+
+
+def test_store_gauges_and_counters_reach_metrics():
+    m = MetricsRegistry()
+    st = KvTierStore(host_blocks=1, spill_blocks=1, metrics=m)
+    st.admit(1, _blk(1), "a")
+    st.admit(2, _blk(2), "b")          # 1 demoted host->spill
+    st.checkout(1, _blk(1))            # spill hit, promoted
+    st.checkout(9, _blk(9))            # miss
+    out = m.render()
+    for name in ("tpu_kv_tier_blocks", "tpu_kv_tier_capacity_blocks",
+                 "tpu_kv_tier_hits_total", "tpu_kv_tier_misses_total",
+                 "tpu_kv_tier_demotions_total",
+                 "tpu_kv_tier_promotions_total"):
+        assert name in out, name
+
+
+# ---------------------------------------------------------------------------
+# SessionTable
+# ---------------------------------------------------------------------------
+
+def test_session_table_touch_lookup_ttl():
+    now = [0.0]
+    tab = SessionTable(capacity=8, ttl=10.0, clock=lambda: now[0])
+    tab.touch("s1", (11, 22), 16, "replica-0")
+    sess = tab.lookup("s1")
+    assert sess.hashes == (11, 22) and sess.backend == "replica-0"
+    assert tab.resumes == 1
+    now[0] = 11.0
+    assert tab.lookup("s1") is None and tab.expired == 1
+    assert tab.lookup("never") is None
+
+
+def test_session_table_capacity_evicts_lru_and_sweep():
+    now = [0.0]
+    tab = SessionTable(capacity=2, ttl=10.0, clock=lambda: now[0])
+    for sid in ("a", "b", "c"):
+        tab.touch(sid, (1,), 8, "r0")
+    assert len(tab) == 2 and tab.evicted == 1
+    assert tab.lookup("a") is None     # LRU fell off
+    now[0] = 20.0
+    assert tab.sweep() == 2 and len(tab) == 0
+
+
+def test_session_table_forget_backend_keeps_chain():
+    tab = SessionTable(capacity=8, ttl=0)
+    tab.touch("s1", (11, 22), 16, "replica-0")
+    assert tab.forget_backend("replica-0") == 1
+    sess = tab.lookup("s1")
+    # Chain survives — the blocks may be resident elsewhere in the
+    # fleet — but stickiness to the dead replica is gone.
+    assert sess.hashes == (11, 22) and sess.backend == ""
+
+
+# ---------------------------------------------------------------------------
+# FleetKvIndex
+# ---------------------------------------------------------------------------
+
+def test_fleet_index_apply_depth_and_unlearn():
+    idx = FleetKvIndex()
+    idx.apply("a", {"seq": 3, "reset": False,
+                    "add": [[1, "host"], [2, "host"], [3, "spill"]],
+                    "del": []})
+    assert idx.resident_depth("a", [1, 2, 3, 4]) == 3
+    # Leading-prefix semantics: a gap stops the walk even when later
+    # hashes are resident.
+    assert idx.resident_depth("a", [9, 2, 3]) == 0
+    idx.apply("a", {"seq": 4, "reset": False, "add": [], "del": [2]})
+    assert idx.resident_depth("a", [1, 2, 3]) == 1
+    assert idx.seq("a") == 4
+    assert idx.needs_sync("a", 5) and not idx.needs_sync("a", 4)
+    idx.apply("a", {"seq": 9, "reset": True, "add": [[7, "host"]],
+                    "del": []})
+    assert idx.resident_depth("a", [1]) == 0 and idx.size("a") == 1
+
+
+def test_fleet_index_best_source_deterministic_and_droppable():
+    idx = FleetKvIndex()
+    idx.apply("b", {"seq": 1, "reset": False,
+                    "add": [[1, "host"], [2, "host"]], "del": []})
+    idx.apply("a", {"seq": 1, "reset": False,
+                    "add": [[1, "host"], [2, "host"]], "del": []})
+    idx.apply("c", {"seq": 1, "reset": False, "add": [[1, "host"]],
+                    "del": []})
+    # Tie on depth 2 breaks lexicographically: deterministic placement.
+    assert idx.best_source([1, 2, 3]) == ("a", 2)
+    assert idx.best_source([1, 2, 3], exclude=("a",)) == ("b", 2)
+    assert idx.best_source([9]) == (None, 0)
+    assert idx.drop_backend("a") == 2
+    assert idx.best_source([1, 2, 3]) == ("b", 2)
+
+
+# ---------------------------------------------------------------------------
+# engine integration: demote / promote / export through the hierarchy
+# ---------------------------------------------------------------------------
+
+def _engine(params, **kw):
+    kw.setdefault("max_slots", 1)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("block_size", BS)
+    return PagedServeEngine(CFG, params, **kw)
+
+
+def _fill_pool(eng):
+    """Cannibalize every cached device block with disjoint slot-sized
+    junk prompts (each fits one slot; enough of them to walk the free
+    list and then the cached LRU — the blocks under test).  Tokens stay
+    inside llama_tiny's 256-entry vocab: an out-of-range id poisons the
+    logits and every later decode on the engine."""
+    plen = (eng.max_blocks - 1) * BS             # leave the decode block
+    rounds = eng.num_blocks // (eng.max_blocks - 1) + 1
+    for j in range(rounds):
+        start = 30 + j * plen
+        toks = [(start + i) % 231 + 25 for i in range(plen)]
+        eng.add_request(Request(f"junk{j}", toks, max_new_tokens=1))
+        eng.run()
+
+
+def test_engine_demotes_freed_blocks_and_resumes_without_prefill(params):
+    """The resume contract end to end: device eviction loses nothing
+    the pump saved — promotion re-imports the chain and decode is
+    bit-identical to a cold engine that prefilled everything."""
+    prompt = list(range(1, 25))                  # 3 full blocks
+    cold = _engine(params)
+    cold.add_request(Request("c", list(prompt), max_new_tokens=6))
+    expected = cold.run()[0].tokens
+
+    tracer = Tracer()
+    # Host tier sized so the junk prompts' own demotions never pressure
+    # out the blocks under test.
+    eng = _engine(params, max_slots=2, host_blocks=64, tracer=tracer)
+    eng.add_request(Request("p", list(prompt), max_new_tokens=1))
+    eng.run()
+    # The step pump already demoted the freed blocks host-ward (it runs
+    # inside step(), bounded per step); drain any stragglers.
+    eng._pump_demotions(limit=1 << 10)
+    assert eng.tiers.stats()["host_blocks_used"] >= 3
+    _fill_pool(eng)
+    assert eng.resident_prefix_blocks(prompt) == 0   # device copy gone
+
+    ctx = tracer.start_request("serve-request")
+    eng.add_request(Request("r", list(prompt), max_new_tokens=6,
+                            trace=ctx))
+    out = eng.run()
+    tracer.finish_request(ctx)
+    assert out[0].tokens == expected
+    st = eng.stats
+    assert st["tier_fetch_blocks"] >= 2
+    # All but the final block came back from the host tier (the engine
+    # always re-runs the last block through prefill for logits).
+    assert st["prefix_hit_tokens"] >= 2 * BS
+    spans = {s["name"]: s for s in tracer.export(ctx.trace_id)}
+    assert spans["tier-fetch"]["attrs"]["blocks"] >= 2
+
+
+def test_engine_advert_covers_tiers_and_eviction(params):
+    eng = _engine(params, host_blocks=16)
+    prompt = list(range(1, 17))                  # 2 full blocks
+    eng.add_request(Request("p", list(prompt), max_new_tokens=1))
+    eng.run()
+    eng._pump_demotions(limit=1 << 10)
+    doc = eng.kv_advert(0)
+    hashes = set(eng.allocator.block_hashes(prompt))
+    advertised = {h for h, _ in doc["add"]}
+    assert hashes <= advertised
+    seq = doc["seq"]
+    # Tier discard shows up as a delta del — the unlearning signal the
+    # gateway's fleet index folds in.
+    victim = eng.allocator.block_hashes(prompt)[0]
+    eng.tiers.discard(victim)
+    delta = eng.kv_advert(seq)
+    assert victim in delta["del"] and not delta["reset"]
+    # A tier-less engine adverts the empty contract, not an error.
+    assert _engine(params).kv_advert(0) == \
+        {"seq": 0, "reset": False, "add": [], "del": []}
+
+
+def test_export_serves_from_tier_after_device_eviction(params):
+    """The wire chain stays contiguous across device eviction: blocks
+    the pool cannibalized are served from their host-tier copy, and the
+    importer's decode matches a cold prefill bit for bit."""
+    prompt = list(range(1, 25))
+    cold = _engine(params)
+    cold.add_request(Request("c", list(prompt), max_new_tokens=6))
+    expected = cold.run()[0].tokens
+
+    src = _engine(params, max_slots=2, host_blocks=64)
+    src.add_request(Request("p", list(prompt), max_new_tokens=1))
+    src.run()
+    src._pump_demotions(limit=1 << 10)
+    _fill_pool(src)
+    assert src.resident_prefix_blocks(prompt) == 0   # device copy gone
+    blocks = src.export_kv_blocks(prompt)
+    assert [b["index"] for b in blocks] == [0, 1, 2]
+
+    dst = _engine(params)
+    assert dst.import_kv_blocks(prompt, blocks) == \
+        {"imported": 3, "skipped": 0}
+    dst.add_request(Request("d", list(prompt), max_new_tokens=6))
+    assert dst.run()[0].tokens == expected
+
+
+def test_import_racing_source_eviction_keeps_contiguous_prefix(params):
+    """An importer whose source evicts mid-transfer (first batch
+    shipped, remainder gone) ends with a usable contiguous prefix and
+    recomputes the tail — same output, no torn chain."""
+    prompt = list(range(1, 25))
+    cold = _engine(params)
+    cold.add_request(Request("c", list(prompt), max_new_tokens=6))
+    expected = cold.run()[0].tokens
+
+    src = _engine(params)                        # no tiers: eviction is
+    src.add_request(Request("p", list(prompt), max_new_tokens=1))  # final
+    src.run()
+    first = src.export_kv_blocks(prompt, max_blocks=2)
+    assert [b["index"] for b in first] == [0, 1]
+    _fill_pool(src)                              # the race: source evicts
+    assert src.export_kv_blocks(prompt, skip_blocks=2) == []
+
+    dst = _engine(params)
+    assert dst.import_kv_blocks(prompt, first) == \
+        {"imported": 2, "skipped": 0}
+    dst.add_request(Request("d", list(prompt), max_new_tokens=6))
+    assert dst.run()[0].tokens == expected
+    assert dst.stats["prefix_hit_tokens"] == 2 * BS
+
+
+def test_engine_stats_surface_tier_counters(params):
+    eng = _engine(params, host_blocks=16, spill_blocks=4)
+    st = eng.stats
+    for key in ("host_blocks_used", "host_blocks_total",
+                "spill_blocks_total", "pending_demotions",
+                "tier_fetch_blocks", "tier_demoted_blocks", "advert_seq"):
+        assert key in st, key
+    assert st["host_blocks_total"] == 16 and st["spill_blocks_total"] == 4

@@ -41,6 +41,7 @@ def test_rule_registry_complete():
             "slice-teardown-through-drain-seam",
             "traffic-weight-through-gate",
             "capacity-through-quota-seam",
+            "kv-block-through-tier-seam",
             # whole-program (call-graph) rules
             "sim-determinism",
             "transitive-seam-bypass",
@@ -1050,3 +1051,66 @@ def test_quota_seam_ignores_seamless_classes_and_bare_launchers():
             self._create_pod(build_head_pod(cluster, None), "head")
     """, only=["capacity-through-quota-seam"])
     assert fired == set()
+
+
+# ---------------------------------------------------------------------------
+# kv-block-through-tier-seam
+# ---------------------------------------------------------------------------
+
+def test_tier_seam_flags_underscore_poke_on_tiers_receiver():
+    findings, fired = _rules_fired("""
+    class Engine:
+        def _fast_free(self, h):
+            self.tiers._host.pop(h, None)
+    """, only=["kv-block-through-tier-seam"])
+    assert fired == {"kv-block-through-tier-seam"}
+    assert "self.tiers._host" in findings[0].message
+
+
+def test_tier_seam_flags_tier_store_alias_and_deep_chain():
+    findings, fired = _rules_fired("""
+    def drain(eng):
+        eng.tier_store._pending.clear()
+        eng.kv_store._spill[7] = ("blk",)
+    """, only=["kv-block-through-tier-seam"])
+    assert len(findings) == 2
+
+
+def test_tier_seam_exempts_the_store_class_itself():
+    _, fired = _rules_fired("""
+    class KvTierStore:
+        def admit(self, h, tokens, payload):
+            self._host[h] = payload
+
+        def checkout(self, h, tokens):
+            return self._host.get(h)
+
+        def _evict(self):
+            self._spill.popitem(last=False)
+    """, only=["kv-block-through-tier-seam"])
+    assert fired == set()
+
+
+def test_tier_seam_quiet_on_public_api_and_unrelated_receivers():
+    _, fired = _rules_fired("""
+    class Engine:
+        def free(self, h):
+            self.tiers.discard(h)
+            self.tiers.stats()
+            self._pending.pop()
+            self.allocator._free_list.append(h)
+    """, only=["kv-block-through-tier-seam"])
+    assert fired == set()
+
+
+def test_tier_seam_fixture_positive_suppressed_negative():
+    # One live finding (the bypass), one justified suppression (the
+    # debug dump), and the clean class stays quiet.
+    fixdir = os.path.join(REPO_ROOT, "tests", "helpers", "lint_fixtures")
+    report = analyze_paths(
+        [os.path.join(fixdir, "seam_tiers.py")],
+        only=["kv-block-through-tier-seam"])
+    assert len(report.findings) == 1
+    assert "self.tiers._host" in report.findings[0].message
+    assert report.suppressed_counts == {"kv-block-through-tier-seam": 1}, \
+        "the waived _debug_dump poke must be ledgered"

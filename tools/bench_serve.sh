@@ -161,3 +161,51 @@ print(f"bench serve upgrade ok: {len(doc['comparisons'])} seeds, "
       f"ttft inflation "
       f"{max(c['ttft_inflation'] for c in doc['comparisons'])}x")
 EOF
+
+# Stateful-session KV gate (docs/kv-tiers.md): the closed-loop
+# multi-turn schedule runs twice per seed — resume-with-tiers vs
+# full-recompute — with zero wall-clock in the artifact, so a re-run of
+# the same seed must be BYTE-identical (the determinism contract the
+# published benchmark/results/kv_r17.json pins, seeds 0..2).  Resume's
+# prefill-token p99 (the TTFT proxy the hierarchy exists to shrink)
+# must beat recompute's, with session context far exceeding the device
+# pool and zero failures.
+kv_out="${BENCH_KV_OUT:-/tmp/tpu_bench_serve_kv.json}"
+timeout -k 10 600 env JAX_PLATFORMS=cpu python benchmark/serve_bench.py \
+    --traffic multi-turn \
+    --seeds "${BENCH_SEEDS:-0}" \
+    --json-out "$kv_out"
+timeout -k 10 600 env JAX_PLATFORMS=cpu python benchmark/serve_bench.py \
+    --traffic multi-turn \
+    --seeds "${BENCH_SEEDS:-0}" \
+    --json-out "${kv_out}.rerun"
+BENCH_JSON_PATH="$kv_out" python - <<'EOF'
+import json, os, sys
+sys.path.insert(0, os.getcwd())
+from benchmark.serve_bench import KV_LEG_KEYS, KV_SCHEMA
+path = os.environ["BENCH_JSON_PATH"]
+assert open(path, "rb").read() == open(path + ".rerun", "rb").read(), \
+    "multi-turn artifact is not byte-identical across re-runs"
+doc = json.load(open(path))
+assert doc["schema"] == KV_SCHEMA, doc.get("schema")
+assert doc["legs"] and doc["comparisons"], "kv run produced no legs"
+for leg in doc["legs"]:
+    missing = [k for k in KV_LEG_KEYS if k not in leg]
+    assert not missing, f"leg missing keys {missing}: {leg}"
+    assert leg["errors"] == 0, f"failed requests in leg: {leg}"
+    assert leg["completed"] == leg["requests"], leg
+    assert leg["context_tokens_total"] > 2 * leg["device_token_capacity"], (
+        f"session state does not exceed device capacity: {leg}")
+for cmp in doc["comparisons"]:
+    assert cmp["resume_beats_recompute"], (
+        f"resume prefill p99 did not beat recompute: {cmp}")
+resume = [l for l in doc["legs"] if l["mode"] == "resume"]
+assert all(l["session_resumes"] > 0 for l in resume), \
+    "resume legs recorded no session resumes"
+assert all(l["tier_fetch_blocks"] > 0 for l in resume), \
+    "resume legs never promoted a block from the host tier"
+print(f"bench serve kv ok: {len(doc['comparisons'])} seeds byte-stable, "
+      f"prefill p99 resume vs recompute "
+      + ", ".join(f"{c['resume_prefill_p99']}/{c['recompute_prefill_p99']}"
+                  for c in doc["comparisons"]))
+EOF

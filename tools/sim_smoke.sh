@@ -29,6 +29,16 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu python -m kuberay_tpu.sim \
     --scenario contention-storm \
     --seed "${SIM_SEEDS:-0..4}" \
     --steps "${SIM_STEPS:-16}"
+# Session churn, wider and deeper: the corpus above already runs the
+# scenario at the default budget, but the no-stale-block invariant's
+# interesting regimes — spill-tier pressure eviction racing a resume,
+# a stale re-admit offered just before the true block's checkout —
+# need more ticks of chain growth and the full 0..9 seed sweep the
+# KV-tier acceptance gate pins (docs/kv-tiers.md).
+timeout -k 10 600 env JAX_PLATFORMS=cpu python -m kuberay_tpu.sim \
+    --scenario session-churn \
+    --seed "${SIM_SEEDS:-0..9}" \
+    --steps "${SIM_STEPS:-16}"
 # The straggler drill again WITH the step tracker mounted: the corpus
 # above runs every scenario telemetry-off (where the straggler
 # invariant is vacuous); this leg arms the detection checker — a slow
