@@ -192,6 +192,16 @@ def bench_attention_op():
     }))
 
 
+def _profile_out_path() -> str:
+    """Value of --profile-out PATH, or "" (bench.py parses sys.argv
+    directly; no argparse to extend)."""
+    if "--profile-out" in sys.argv:
+        i = sys.argv.index("--profile-out")
+        if i + 1 < len(sys.argv):
+            return sys.argv[i + 1]
+    return ""
+
+
 def main():
     import jax
     if "--cpu" in sys.argv:
@@ -259,13 +269,42 @@ def main():
     # Step N's forward depends on step N-1's full optimizer update, so
     # steady-state inter-fetch time IS the full step time; the median
     # discards stragglers from tunnel round-trips.
+    profile_out = _profile_out_path()
+    tracer = None
+    if profile_out:
+        from kuberay_tpu.obs.trace import Tracer
+        tracer = Tracer(max_spans=8192)
     dts = []
     for _ in range(steps):
         t0 = time.perf_counter()
         state, m = step(state, batch_data)
+        t1 = time.perf_counter()
         float(m["total_loss"])
-        dts.append(time.perf_counter() - t0)
+        t2 = time.perf_counter()
+        if tracer is not None:
+            # Two phases a host can see: dispatch (the jitted call
+            # returning futures) and host-fetch (the loss fetch that
+            # fences the device work — on-chip time lands here).
+            ctx = tracer.start_request("train-step", ts=t0,
+                                       model=model_name)
+            tracer.record_span(ctx, "dispatch", t0, t1)
+            tracer.record_span(ctx, "host-fetch", t1, t2)
+            tracer.finish_request(ctx, ts=t2)
+        dts.append(t2 - t0)
     dt_step = sorted(dts)[len(dts) // 2]
+
+    if tracer is not None:
+        from kuberay_tpu.obs.profile import profile_spans
+        prof_doc = profile_spans(
+            tracer.export(), roots={"train-step": "train"},
+            meta={"source": "bench.py", "model": model_name,
+                  "batch": batch, "seq": seq, "steps": steps,
+                  "device": str(dev)})
+        out_path = pathlib.Path(profile_out)
+        out_path.parent.mkdir(parents=True, exist_ok=True)
+        with open(out_path, "w") as f:
+            json.dump(prof_doc, f, sort_keys=True)
+        print(f"profile -> {profile_out}", file=sys.stderr)
 
     tokens_per_step = batch * seq
     tok_s = tokens_per_step / dt_step

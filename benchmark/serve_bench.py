@@ -909,6 +909,148 @@ def trace_overhead(args) -> None:
 
 
 # ---------------------------------------------------------------------------
+# Critical-path profile gate: per-span-kind decomposition + self-diff (PR 18)
+# ---------------------------------------------------------------------------
+
+PROFILE_BENCH_SCHEMA = "tpu-bench-profile/v1"
+# Per-leg keys the smoke gate (tools/bench_serve.sh profile leg) asserts on.
+PROFILE_LEG_KEYS = (
+    "workload", "seed", "replicas", "tracing", "requests", "completed",
+    "errors", "tokens_per_sec", "requests_per_sec",
+)
+
+
+def profile_gate(args) -> None:
+    """--profile: the critical-path profile gate.  Per seed, one
+    hot-prefix fleet runs the IDENTICAL seeded arrival schedule twice —
+    tracing off (NOOP), then on — on the same compiled engines, like
+    the --trace gate; the on legs' span trees fold into ONE
+    tpu-profile/v1 serve profile (where did the fleet's request time
+    go, per span kind), the profile is diffed against ITSELF (the
+    determinism canary tools/bench_serve.sh asserts reports zero
+    regressions), and the off-vs-on requests/sec delta gates profiling
+    overhead (same <5%% budget as tracing)."""
+    import random as _random
+
+    import jax
+
+    from kuberay_tpu.models import llama
+    from kuberay_tpu.obs.profile import (aggregate, diff_profiles,
+                                         trace_records)
+    from kuberay_tpu.obs.trace import NOOP_TRACER, Tracer
+
+    cfg = llama.CONFIGS[args.model]
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    bs = 16
+    prof = TRAFFIC_PROFILES["hot-prefix"]
+    prefix_len, new_tokens = prof["prefix"], prof["new"]
+    slots = prof["slots"]
+    rate = prof["rate"] * args.rate_scale
+    max_len = prefix_len + new_tokens + 16
+    blocks_per_prompt = (max_len + bs - 1) // bs
+    num_blocks = slots * blocks_per_prompt + \
+        (HOT_PREFIXES // 2 + 1) * (prefix_len // bs)
+    replicas = 2
+
+    legs = []
+    profiled_records = []
+    spans_total = 0
+    for seed in args.seeds:
+        fleet = _Fleet(cfg, params, replicas, slots=slots,
+                       max_len=max_len, num_blocks=num_blocks,
+                       block_size=bs, seed=seed, affinity=True,
+                       shedding=False)
+        try:
+            warm = [11_111 + j for j in range(prefix_len)]
+            cold_warm = [12_345 + j for j in range(64)]
+            fleet.warm([warm + [7], warm + [8], cold_warm + [9]])
+            gw_srv, gw_url = fleet.gateway.serve_background_http()
+            try:
+                hots = _hot_prompts(prefix_len, HOT_PREFIXES)
+                hot_warm = [(0.25 * i, list(p) + [31337])
+                            for i, p in enumerate(hots * 2)]
+                _drive_open_loop(gw_url, hot_warm, new_tokens)
+                # Off leg first, same rationale as trace_overhead: any
+                # cache-aging drift biases AGAINST profiling, so a
+                # passing overhead gate is conservative.
+                for tracing in (False, True):
+                    tracer = Tracer(max_spans=65536) if tracing \
+                        else NOOP_TRACER
+                    fleet.set_tracer(tracer)
+                    fleet.reset_counters()
+                    gw_hits_base = _gateway_hits(fleet)
+                    rng = _random.Random(
+                        (seed << 8) ^ (zlib.crc32(b"hot-prefix") & 0xFFFF))
+                    arrivals = _gen_arrivals(
+                        rng, "hot-prefix", args.duration, rate,
+                        prefix_len, bs, HOT_PREFIXES,
+                        hot_fraction=HOT_FRACTION)
+                    records, wall = _drive_open_loop(gw_url, arrivals,
+                                                     new_tokens)
+                    leg = _leg_summary("hot-prefix", seed, replicas, True,
+                                       False, records, wall, fleet,
+                                       gw_hits_base=gw_hits_base)
+                    leg["tracing"] = tracing
+                    leg["requests_per_sec"] = round(
+                        leg["completed"] / wall, 2) if wall else 0.0
+                    if tracing:
+                        spans = tracer.export()
+                        recs = trace_records(
+                            spans, roots={"serve-request": "serve"})
+                        leg["spans_recorded"] = len(spans)
+                        leg["profiled_windows"] = len(recs)
+                        spans_total += len(spans)
+                        profiled_records.extend(recs)
+                    legs.append(leg)
+                    print(json.dumps(leg), flush=True)
+            finally:
+                gw_srv.shutdown()
+        finally:
+            fleet.close()
+
+    profile = aggregate(profiled_records, meta={
+        "source": "serve_bench --profile", "workload": "hot-prefix",
+        "seeds": list(args.seeds)})
+    self_diff = diff_profiles(profile, profile)
+    offs = [leg for leg in legs if not leg["tracing"]]
+    ons = [leg for leg in legs if leg["tracing"]]
+    rps_off = sum(leg["requests_per_sec"] for leg in offs) / len(offs)
+    rps_on = sum(leg["requests_per_sec"] for leg in ons) / len(ons)
+    overhead = {
+        "requests_per_sec_off": round(rps_off, 2),
+        "requests_per_sec_on": round(rps_on, 2),
+        "overhead_pct": round((rps_off - rps_on) / rps_off * 100.0, 2)
+        if rps_off else 0.0,
+        "spans_recorded": spans_total,
+        "profiled_windows": len(profiled_records),
+    }
+    print(json.dumps({"profile_overhead": overhead}), flush=True)
+
+    doc = {
+        "schema": PROFILE_BENCH_SCHEMA,
+        "workload_params": {
+            "model": args.model, "duration_s": args.duration,
+            "rate_scale": args.rate_scale, "block_size": bs,
+            "hot_prefixes": HOT_PREFIXES, "hot_fraction": HOT_FRACTION,
+            "profiles": {"hot-prefix": TRAFFIC_PROFILES["hot-prefix"]},
+        },
+        "seeds": list(args.seeds),
+        "device": str(jax.devices()[0]),
+        "platform": jax.devices()[0].platform,
+        "legs": legs,
+        "profile": profile,
+        "self_diff": self_diff,
+        "overhead": overhead,
+    }
+    if args.json_out:
+        pathlib.Path(args.json_out).parent.mkdir(parents=True,
+                                                 exist_ok=True)
+        pathlib.Path(args.json_out).write_text(
+            json.dumps(doc, indent=2) + "\n")
+        print(f"wrote {args.json_out}", flush=True)
+
+
+# ---------------------------------------------------------------------------
 # Multi-turn session gate: resume-with-tiers vs full-recompute (PR 17,
 # docs/kv-tiers.md)
 # ---------------------------------------------------------------------------
@@ -1503,6 +1645,11 @@ def main(argv=None) -> int:
                     help="tracing-overhead gate: hot-prefix legs with "
                          "end-to-end request tracing off vs on, same "
                          "compiled fleet and arrival schedule")
+    ap.add_argument("--profile", action="store_true",
+                    help="critical-path profile gate: hot-prefix legs "
+                         "tracer off vs on per seed, folded into one "
+                         "tpu-profile/v1 serve profile + self-diff + "
+                         "requests/sec overhead (tpu-bench-profile/v1)")
     ap.add_argument("--upgrade", action="store_true",
                     help="blue/green upgrade gate: burn-rate-gated vs "
                          "naive timer ramp under a mid-upgrade fault "
@@ -1534,7 +1681,7 @@ def main(argv=None) -> int:
     else:
         from kuberay_tpu.utils.platform import pin_platform_from_env
         pin_platform_from_env()
-    if args.traffic or args.trace or args.upgrade:
+    if args.traffic or args.trace or args.upgrade or args.profile:
         if ".." in args.seeds:
             lo, hi = args.seeds.split("..", 1)
             args.seeds = list(range(int(lo), int(hi) + 1))
@@ -1546,6 +1693,8 @@ def main(argv=None) -> int:
             traffic(args)
         if args.trace:
             trace_overhead(args)
+        if args.profile:
+            profile_gate(args)
         if args.upgrade:
             upgrade(args)
     elif args.matrix:

@@ -74,6 +74,7 @@ class ApiHandler(JsonHandler):
     alerts = None                       # obs.AlertEngine (optional)
     steps = None                        # obs.StepTracker (optional)
     quota = None                        # controlplane.QuotaManager (optional)
+    profiler = None                     # obs.RequestProfiler (optional)
 
     def _error(self, code: int, message: str, reason: str = ""):
         self._send(code, {"kind": "Status", "status": "Failure",
@@ -210,7 +211,33 @@ class ApiHandler(JsonHandler):
             body = {"traces": span_tree(self.tracer.export(trace_id))}
         else:
             body = {"spans": self.tracer.export(trace_id)}
+        # Retention envelope: a reader (or the profiler) can tell a
+        # complete export from one the bounded store already evicted
+        # spans out of — a truncated profile should be detectable.
+        store = getattr(self.tracer, "store", None)
+        if store is not None:
+            body["retention"] = store.stats()
         return self._send(200, body)
+
+    def _debug_profile(self):
+        """Critical-path profile (obs/profile.py) over the span store:
+        per-span-kind exclusive self-time percentiles by trace shape.
+        ``?backend=<svc>`` scopes to serve requests that backend
+        answered (needs the gateway's completion hook).  404 when the
+        operator runs without a tracer."""
+        if self.tracer is None:
+            return self._error(404, "tracing not enabled")
+        q = parse_qs(urlparse(self.path).query)
+        backend = q.get("backend", [None])[0]
+        if self.profiler is not None:
+            doc = self.profiler.snapshot(backend=backend)
+        else:
+            from kuberay_tpu.obs.profile import profile_spans
+            doc = profile_spans(self.tracer.export())
+        store = getattr(self.tracer, "store", None)
+        if store is not None:
+            doc["retention"] = store.stats()
+        return self._send(200, doc)
 
     def _debug_flight(self, path: str):
         """Flight-recorder timelines: ``/debug/flight`` lists tracked
@@ -470,6 +497,8 @@ class ApiHandler(JsonHandler):
             return self._watch()
         if path == "/debug/traces":
             return self._debug_traces()
+        if path == "/debug/profile":
+            return self._debug_profile()
         if path == "/debug/flight" or path.startswith("/debug/flight/"):
             return self._debug_flight(path)
         if path == "/debug/goodput" or path.startswith("/debug/goodput/"):
@@ -695,7 +724,8 @@ def make_server(store: ObjectStore, host: str = "127.0.0.1", port: int = 0,
                 history=None, tracer=None,
                 flight=None, goodput=None,
                 autoscaler=None, alerts=None,
-                steps=None, quota=None) -> ThreadingHTTPServer:
+                steps=None, quota=None,
+                profiler=None) -> ThreadingHTTPServer:
     """``token`` enables bearer auth on every API verb; ``certfile``/
     ``keyfile`` serve TLS (the authenticated-cluster-endpoint stand-in
     RestObjectStore's client auth is tested against).  ``history``: a
@@ -706,13 +736,16 @@ def make_server(store: ObjectStore, host: str = "127.0.0.1", port: int = 0,
     forensics surface; ``autoscaler`` (a ``DecisionAudit``) mounts
     ``/debug/autoscaler``; ``alerts`` (an ``obs.AlertEngine``) mounts
     ``/debug/alerts``; ``steps`` (an ``obs.StepTracker``) mounts
-    ``/debug/steps[/<job>]``."""
+    ``/debug/steps[/<job>]``; ``profiler`` (an ``obs.RequestProfiler``)
+    backs ``/debug/profile``'s per-backend scoping (without it the
+    endpoint still serves the unscoped span-store profile)."""
     handler = type("BoundApiHandler", (ApiHandler,),
                    {"store": store, "metrics": metrics, "token": token,
                     "history": history, "tracer": tracer,
                     "flight": flight, "goodput": goodput,
                     "autoscaler": autoscaler, "alerts": alerts,
-                    "steps": steps, "quota": quota})
+                    "steps": steps, "quota": quota,
+                    "profiler": profiler})
     if certfile:
         import ssl
         ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
@@ -731,13 +764,14 @@ def serve_background(store: ObjectStore, host: str = "127.0.0.1",
                      certfile: Optional[str] = None,
                      keyfile: Optional[str] = None, history=None,
                      tracer=None, flight=None, goodput=None,
-                     autoscaler=None, alerts=None, steps=None, quota=None):
+                     autoscaler=None, alerts=None, steps=None, quota=None,
+                     profiler=None):
     """Start in a daemon thread; returns (server, base_url)."""
     srv = make_server(store, host, port, metrics, token=token,
                       certfile=certfile, keyfile=keyfile, history=history,
                       tracer=tracer, flight=flight, goodput=goodput,
                       autoscaler=autoscaler, alerts=alerts, steps=steps,
-                      quota=quota)
+                      quota=quota, profiler=profiler)
     t = threading.Thread(target=srv.serve_forever, daemon=True,
                          name="tpu-apiserver")
     t.start()

@@ -158,6 +158,87 @@ def build_service_manifest(args) -> Dict[str, Any]:
     }
 
 
+def _profile_summary(doc: Dict[str, Any]) -> str:
+    """Per-shape one-liners for a tpu-profile/v1 document: the top span
+    kinds by total exclusive self time."""
+    lines = []
+    for shape, body in sorted(doc.get("shapes", {}).items()):
+        kinds = sorted(body.get("kinds", {}).items(),
+                       key=lambda kv: -kv[1]["total_s"])[:5]
+        parts = ", ".join(
+            f"{k} {v['total_s']:.3f}s ({v['fraction'] * 100:.0f}%)"
+            for k, v in kinds)
+        lines.append(f"[{shape}] {body['traces']} windows, "
+                     f"p90 {body['duration_p90_s']:.4f}s: {parts}")
+    return "\n".join(lines) or "no profiled windows"
+
+
+def _profile_diff(args) -> int:
+    """`tpuctl profile diff BASELINE CANDIDATE`: noise-gated trace diff
+    of two tpu-profile/v1 artifacts.  Exit 1 when any regression
+    survives the gate — shell-gateable, same engine the upgrade ramp
+    and tools/bench_serve.sh use."""
+    from kuberay_tpu.obs.profile import describe_regression, diff_profiles
+    if len(args.paths) != 2:
+        print("error: profile diff needs exactly two files: "
+              "BASELINE CANDIDATE", file=sys.stderr)
+        return 2
+    docs = []
+    for path in args.paths:
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"error reading {path}: {e}", file=sys.stderr)
+            return 2
+        # tpu-bench-profile/v1 artifacts nest the profile; accept both.
+        if "shapes" not in doc and isinstance(doc.get("profile"), dict):
+            doc = doc["profile"]
+        docs.append(doc)
+    diff = diff_profiles(docs[0], docs[1], min_count=args.min_samples,
+                         rel_threshold=args.threshold)
+    for entry in diff["regressions"]:
+        print(f"REGRESSION [{entry['shape']}] "
+              f"{describe_regression(entry)}")
+    for entry in diff["improvements"]:
+        pct = -entry["rel_change"] * 100.0
+        print(f"improvement [{entry['shape']}] {entry['kind']} "
+              f"{entry['metric']} self {entry['baseline_s']:.4f}s -> "
+              f"{entry['candidate_s']:.4f}s (-{pct:.0f}%)")
+    for entry in diff["skipped"]:
+        print(f"skipped [{entry['shape']}] {entry['kind']}: "
+              f"{entry['reason']}")
+    n = len(diff["regressions"])
+    print(f"{n} regression{'s' if n != 1 else ''}, "
+          f"{len(diff['improvements'])} improvements, "
+          f"{len(diff['skipped'])} skipped "
+          f"(gate: n>={args.min_samples}, rel>={args.threshold})")
+    return 1 if diff["regressions"] else 0
+
+
+def _profile_live(args) -> int:
+    """`tpuctl profile live`: fetch the apiserver's /debug/profile and
+    print the per-shape critical-path summary (full JSON on stdout is
+    one `curl` away; this is the human view)."""
+    import urllib.request
+    url = f"{args.server.rstrip('/')}/debug/profile"
+    if args.backend:
+        url += "?backend=" + urllib.parse.quote(args.backend)
+    try:
+        with urllib.request.urlopen(url, timeout=15) as resp:
+            doc = json.load(resp)
+    except Exception as e:
+        print(f"error: /debug/profile unreachable at {url}: {e}",
+              file=sys.stderr)
+        return 1
+    print(_profile_summary(doc))
+    retention = doc.get("retention")
+    if retention and retention.get("dropped"):
+        print(f"warning: {retention['dropped']} spans dropped by "
+              "tail-sampling retention — the profile window is truncated")
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="tpuctl",
                                  description="TPU pod-slice orchestration CLI")
@@ -269,13 +350,27 @@ def main(argv=None):
     tl.add_argument("cluster")
 
     pf = sub.add_parser("profile",
-                        help="capture a jax.profiler trace on a cluster's "
-                             "coordinator (archived with node logs)")
-    pf.add_argument("cluster")
+                        help="device profiling and critical-path analytics: "
+                             "`profile CLUSTER` captures a jax.profiler "
+                             "trace; `profile live` fetches the apiserver's "
+                             "/debug/profile; `profile diff BASE CAND` "
+                             "compares two tpu-profile/v1 artifacts")
+    pf.add_argument("target",
+                    help="cluster name, or the verbs 'live' / 'diff'")
+    pf.add_argument("paths", nargs="*",
+                    help="(diff) baseline and candidate profile JSON files")
     pf.add_argument("--duration", type=float, default=5.0)
     pf.add_argument("--coordinator", default="",
                     help="coordinator base URL (default: derived from "
                          "cluster status)")
+    pf.add_argument("--backend", default="",
+                    help="(live) scope the profile to one serve backend")
+    pf.add_argument("--min-samples", type=int, default=5,
+                    help="(diff) noise gate: both sides need this many "
+                         "windows per span kind")
+    pf.add_argument("--threshold", type=float, default=0.25,
+                    help="(diff) noise gate: relative change a kind must "
+                         "clear to count as a regression")
 
     for name in ("suspend", "resume"):
         sp = sub.add_parser(name)
@@ -564,12 +659,16 @@ def _dispatch(args, client: ApiClient) -> int:
         return 0
 
     if args.cmd == "profile":
+        if args.target == "diff":
+            return _profile_diff(args)
+        if args.target == "live":
+            return _profile_live(args)
         from kuberay_tpu.runtime.coordinator_client import (
             CoordinatorClient, default_client_provider)
         if args.coordinator:
             coord = CoordinatorClient(args.coordinator)
         else:
-            cluster = client.get(C.KIND_CLUSTER, args.cluster, ns)
+            cluster = client.get(C.KIND_CLUSTER, args.target, ns)
             status = cluster.get("status", {})
             if not status.get("coordinatorAddress"):
                 print("error: no coordinator address known; pass "

@@ -149,6 +149,31 @@ class DecisionAudit:
             self.metrics.autoscaler_decision(C.KIND_CLUSTER, direction)
         return entry
 
+    def record_upgrade(self, namespace: str, service: str, action: str,
+                       *, green_weight: int, reason: str = "",
+                       alert: Optional[Dict[str, Any]] = None,
+                       profile_diff: Optional[Dict[str, Any]] = None
+                       ) -> Dict[str, Any]:
+        """An upgrade-ramp verdict (promote/rollback/abort) in the same
+        audit ring as scale decisions — with the baseline-vs-candidate
+        critical-path trace diff attached when a profiler was wired, so
+        "why did it roll back" names the regressing span kind, not just
+        the alert that fired."""
+        entry: Dict[str, Any] = {
+            "ts": self._now(), "kind": "upgrade",
+            "namespace": namespace, "service": service,
+            "action": action, "green_weight": green_weight,
+            "reason": reason,
+        }
+        if alert:
+            entry["alert"] = dict(alert)
+        if profile_diff is not None:
+            entry["profile_diff"] = profile_diff
+        with self._lock:
+            self._ring.append(entry)
+            self.total += 1
+        return entry
+
     def to_list(self) -> List[Dict[str, Any]]:
         """Newest-first snapshot of the ring."""
         with self._lock:

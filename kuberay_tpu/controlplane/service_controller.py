@@ -46,7 +46,9 @@ from kuberay_tpu.controlplane.upgrade import (
     WAIT_DRAIN,
     UpgradeObservation,
     UpgradeOrchestrator,
+    regression_note,
 )
+from kuberay_tpu.obs.profile import diff_profiles
 from kuberay_tpu.obs.goodput import NOOP_TRANSITIONS
 from kuberay_tpu.obs.trace import NOOP_TRACER
 from kuberay_tpu.runtime.coordinator_client import CoordinatorError
@@ -73,7 +75,9 @@ class TpuServiceController:
                  clock=None,
                  upgrade_gate=None,
                  flight=None,
-                 metrics_registry=None):
+                 metrics_registry=None,
+                 profiler=None,
+                 audit=None):
         self.store = store
         self.recorder = recorder or EventRecorder(store)
         self.client_provider = client_provider
@@ -96,6 +100,12 @@ class TpuServiceController:
         self.flight = flight
         # MetricsRegistry for the tpu_upgrade_* families; optional.
         self._metrics = metrics_registry
+        # obs.RequestProfiler (fed by the gateway): the blue-vs-green
+        # critical-path diff source for promote/rollback audits.
+        self.profiler = profiler
+        # DecisionAudit ring: ramp verdicts land next to the scale
+        # decisions at /debug/autoscaler, trace diff attached.
+        self.audit = audit
         self._orchestrator = UpgradeOrchestrator()
         # service name -> time the blue drain was requested (bounds
         # WAIT_DRAIN by drainTimeoutSeconds).
@@ -625,6 +635,35 @@ class TpuServiceController:
         decision = self._orchestrator.decide(obs)
         return self._apply_upgrade_decision(svc, decision, obs, green_svc)
 
+    def _upgrade_profile_diff(self, svc: TpuService,
+                              green_svc: str) -> Optional[Dict]:
+        """Old-build vs new-build serve profile diff: the blue
+        backend's critical-path profile as baseline, the green
+        candidate's as candidate.  None without a profiler or an
+        active (blue) fleet.  min_count=3: a ramp sees minutes of
+        sampled traffic, not a bench's thousands of requests."""
+        if self.profiler is None:
+            return None
+        st = svc.status
+        if st.activeServiceStatus is None:
+            return None
+        blue_svc = serve_service_name(st.activeServiceStatus.clusterName)
+        if not blue_svc or blue_svc == green_svc:
+            return None
+        baseline = self.profiler.snapshot(backend=blue_svc)
+        candidate = self.profiler.snapshot(backend=green_svc)
+        return diff_profiles(baseline, candidate, min_count=3)
+
+    def _audit_upgrade(self, svc: TpuService, action: str,
+                       green_weight: int, reason: str,
+                       alert=None, profile_diff=None) -> None:
+        if self.audit is None:
+            return
+        self.audit.record_upgrade(
+            svc.metadata.namespace, svc.metadata.name, action,
+            green_weight=green_weight, reason=reason, alert=alert,
+            profile_diff=profile_diff)
+
     def _apply_upgrade_decision(self, svc: TpuService, decision, obs,
                                 green_svc: str) -> Optional[float]:
         """THE weight-write seam: every trafficWeightPercent mutation of
@@ -638,6 +677,9 @@ class TpuServiceController:
         ns = svc.metadata.namespace
 
         if decision.action == ABORT:
+            pdiff = self._upgrade_profile_diff(svc, green_svc)
+            self._audit_upgrade(svc, "abort", 0, decision.reason,
+                                alert=decision.alert, profile_diff=pdiff)
             up.state = UpgradeState.ABORTED
             up.lastAlert = dict(decision.alert or {})
             up.abortedSpecHash = cs.specHash
@@ -665,6 +707,13 @@ class TpuServiceController:
             return None
 
         if decision.action == ROLLBACK:
+            # Diff BEFORE touching weights: the profile is a read-only
+            # snapshot, but the audit should reflect what the ramp saw
+            # when it decided.
+            pdiff = self._upgrade_profile_diff(svc, green_svc)
+            note = regression_note(pdiff)
+            self._audit_upgrade(svc, "rollback", 0, decision.reason,
+                                alert=decision.alert, profile_diff=pdiff)
             cs.trafficWeightPercent = 0
             if st.activeServiceStatus is not None:
                 st.activeServiceStatus.trafficWeightPercent = 100
@@ -682,7 +731,8 @@ class TpuServiceController:
             self._record_weights(svc)
             self.recorder.warning(
                 svc.to_dict(), "UpgradeRolledBack",
-                f"green weight snapped to 0: {decision.reason}")
+                f"green weight snapped to 0: {decision.reason}"
+                + (f"; {note}" if note else ""))
             if self.flight is not None:
                 self.flight.record(
                     self.KIND, ns, name, "upgrade", detail=decision.reason,
@@ -740,6 +790,12 @@ class TpuServiceController:
 
     def _finish_gated(self, svc: TpuService, green_svc: str):
         name = svc.metadata.name
+        # Snapshot the blue-vs-green diff before _promote flips the
+        # active fleet; a clean candidate audits an empty regression
+        # list — the "did it help" half of the ramp's paper trail.
+        pdiff = self._upgrade_profile_diff(svc, green_svc)
+        self._audit_upgrade(svc, "promote", 100, "ramp complete",
+                            profile_diff=pdiff)
         self._promote(svc)
         self.transitions.record(self.KIND, svc.metadata.namespace, name,
                                 UpgradeState.PROMOTED,

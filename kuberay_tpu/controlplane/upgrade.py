@@ -35,6 +35,7 @@ import dataclasses
 from typing import Any, Dict, List, Optional, Tuple
 
 from kuberay_tpu.obs.alerts import AlertEngine, SloSpec
+from kuberay_tpu.obs.profile import describe_regression, worst_regression
 
 # Decision actions, in rough lifecycle order.
 PREWARM = "prewarm"          # hold at 0 until the gateway acks the replay
@@ -209,6 +210,19 @@ def green_slos(backend: str, ttft_target_s: float = 0.5,
                 fast_window_s=fast_window_s, fast_burn=fast_burn,
                 min_samples=min_samples),
     ]
+
+
+def regression_note(profile_diff: Optional[Dict[str, Any]]) -> str:
+    """The ramp's one-line verdict on a build-vs-build trace diff —
+    appended to rollback events and audit reasons so the message names
+    WHERE the candidate got slower ("candidate slower in decode (...)"),
+    not just that the burn-rate gate fired.  Empty when there is no
+    diff or no gated regression survived the noise gate."""
+    worst = worst_regression(profile_diff)
+    if worst is None:
+        return ""
+    return f"candidate slower in {worst['kind']} " \
+           f"({describe_regression(worst)})"
 
 
 class BurnRateGate:

@@ -16,6 +16,11 @@ production and in sim-violation forensics — from one artifact.
   buffer of watch deliveries, state transitions, recorded Events,
   conflicts and requeues, queryable as a timeline
   (``/debug/flight/<kind>/<ns>/<name>`` on the API server).
+- :mod:`kuberay_tpu.obs.profile`: critical-path analytics over the
+  recorded spans — per-span-kind exclusive self-time profiles
+  (``/debug/profile``, ``tpu-profile/v1`` artifacts) and the
+  noise-gated baseline-vs-candidate trace diff the upgrade ramp and
+  the benches use to name the guilty span kind in a regression.
 - :mod:`kuberay_tpu.obs.alerts`: multi-window multi-burn-rate SLO
   alerting over ``MetricsRegistry`` snapshot deltas (TTFT p99,
   availability, goodput-ratio floor), firing into a bounded ring at
@@ -41,6 +46,14 @@ from kuberay_tpu.obs.goodput import (
     NoopTransitionRecorder,
     TransitionRecorder,
 )
+from kuberay_tpu.obs.profile import (
+    PROFILE_SCHEMA,
+    RequestProfiler,
+    diff_profiles,
+    profile_spans,
+    trace_records,
+    worst_regression,
+)
 from kuberay_tpu.obs.steps import NOOP_STEPS, NoopStepTracker, StepTracker
 from kuberay_tpu.obs.trace import (
     NOOP_TRACER,
@@ -63,6 +76,8 @@ __all__ = [
     "NoopTracer",
     "NoopTransitionRecorder",
     "PHASES",
+    "PROFILE_SCHEMA",
+    "RequestProfiler",
     "SloSpec",
     "StepTracker",
     "Span",
@@ -71,5 +86,9 @@ __all__ = [
     "Tracer",
     "TransitionRecorder",
     "default_slos",
+    "diff_profiles",
+    "profile_spans",
     "span_tree",
+    "trace_records",
+    "worst_regression",
 ]

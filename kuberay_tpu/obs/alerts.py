@@ -225,19 +225,28 @@ class AlertEngine:
 
     # -- cross-links --------------------------------------------------------
 
+    def _exemplar(self, spec: SloSpec) -> Optional[Tuple[str, float]]:
+        """The latest above-threshold exemplar of a latency spec:
+        ``(trace_id, observed_value)`` from the highest breaching
+        bucket that carries one, or None."""
+        if spec.kind != "latency":
+            return None
+        snap = self.registry.histogram_snapshot(spec.metric,
+                                                dict(spec.labels)) or {}
+        for bucket, ex in zip(reversed(snap.get("buckets", [])),
+                              reversed(snap.get("exemplars", []))):
+            if ex is not None and bucket > spec.threshold_s:
+                return str(ex[0]), float(ex[1])
+        return None
+
     def _links(self, spec: SloSpec, series_key: Tuple) -> Dict[str, str]:
         """Where to look next: the exemplar trace behind a latency
         breach, the autoscaler decision audit, the flight-recorder ring
         for the breaching CR."""
         links: Dict[str, str] = {}
-        if spec.kind == "latency":
-            snap = self.registry.histogram_snapshot(spec.metric,
-                                                    dict(spec.labels)) or {}
-            for bucket, ex in zip(reversed(snap.get("buckets", [])),
-                                  reversed(snap.get("exemplars", []))):
-                if ex is not None and bucket > spec.threshold_s:
-                    links["trace"] = f"/debug/traces?trace_id={ex[0]}"
-                    break
+        ex = self._exemplar(spec)
+        if ex is not None:
+            links["trace"] = f"/debug/traces?trace_id={ex[0]}&tree=1"
         if self._audit is not None:
             links["autoscaler"] = "/debug/autoscaler"
         if spec.kind == "gauge-floor" and series_key:
@@ -281,6 +290,14 @@ class AlertEngine:
                                 "bad": bad_d, "total": total_d,
                                 "links": self._links(spec, series_key),
                             }
+                            ex = self._exemplar(spec)
+                            if ex is not None:
+                                # The page's "show me one bad request"
+                                # answer: the latest above-threshold
+                                # exemplar, resolvable at the trace
+                                # link above.
+                                alert["exemplar"] = {"trace_id": ex[0],
+                                                     "value": ex[1]}
                             self._active[akey] = alert
                             self._ring.append(dict(alert))
                             fired.append(alert)

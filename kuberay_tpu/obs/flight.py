@@ -26,10 +26,14 @@ Key = Tuple[str, str, str]          # (kind, namespace, name)
 
 class FlightRecorder:
     def __init__(self, capacity: int = 256, max_objects: int = 2048,
-                 clock=None):
+                 clock=None, tracer=None):
         self.capacity = capacity
         self.max_objects = max_objects
         self._now = clock.now if clock is not None else time.time
+        # With a tracer, records made inside an active span are stamped
+        # with its trace_id — a flight timeline row joins straight to
+        # its spans during forensics.  Still purely observational.
+        self._tracer = tracer
         self._lock = threading.Lock()
         self._buffers: "OrderedDict[Key, deque]" = OrderedDict()
         self._last_state: Dict[Key, str] = {}
@@ -44,6 +48,10 @@ class FlightRecorder:
         rec: Dict[str, Any] = {"ts": self._now(), "type": rtype,
                                "detail": detail}
         rec.update(attrs)
+        if self._tracer is not None:
+            ctx = self._tracer.current()
+            if ctx is not None:
+                rec["trace_id"] = ctx.trace_id
         key = (kind, namespace, name)
         with self._lock:
             buf = self._buffers.get(key)

@@ -1,0 +1,365 @@
+"""Critical-path profiles and trace diffs over recorded spans.
+
+The tracer (obs/trace.py) answers "where did THIS request's time go";
+this module answers the aggregate and comparative forms — "where does
+the fleet's time go, per span kind" and "what changed between two
+builds" — the Canopy pattern (Kaldor et al., SOSP'17): turn raw spans
+into per-component profiles that machines, not humans, compare.
+
+Three layers:
+
+- **Extractor** (:func:`trace_records`): walks one trace's spans and
+  attributes every second of a root window (a ``serve-request`` root,
+  a ``slice-ready`` chain segment, a bench ``train-step``) to exactly
+  one span kind's *exclusive self time*.  The attribution is an
+  interval sweep: the window is partitioned at every candidate span
+  boundary and each elementary interval charges the **deepest**
+  covering span (ties: latest start, then span id); intervals no
+  descendant covers charge the root's own kind.  By construction the
+  per-kind self times sum to the root duration exactly — the
+  decomposition invariant tests/test_profile.py holds the line on —
+  even when siblings overlap (a naive duration-minus-children
+  subtraction double-counts there).
+- **Aggregator** (:func:`aggregate` / :func:`profile_spans`): folds
+  many per-trace records into per-span-kind percentile profiles
+  (interpolated quantiles from utils/quantiles.py) grouped by trace
+  shape (``serve`` vs ``control-plane``), with self-time fractions
+  that sum to 1.0 per shape.  Served live at ``/debug/profile`` and
+  exported as a versioned JSON artifact (``tpu-profile/v1``) —
+  byte-identical across re-runs of a seeded sim, because the virtual
+  clock and counter span ids leave no wall-clock residue.
+- **Diff engine** (:func:`diff_profiles`): compares baseline vs
+  candidate profiles per (shape, kind) behind a noise gate — both
+  sides need ``min_count`` samples and the relative change must clear
+  ``rel_threshold`` (plus an optional absolute ``min_delta_s``) — so
+  a regression verdict names the guilty span kind instead of "p99
+  went up".  The upgrade ramp attaches this diff to every
+  promote/rollback audit record; tools/bench_serve.sh runs it against
+  the committed baseline artifact.
+
+Like everything else in obs/, all of it is observational: pure
+functions over exported span dicts, never touching the store, the rng
+or the clock — mounting the profiler in the sim leaves replay hashes
+untouched.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from kuberay_tpu.utils.quantiles import quantile
+
+PROFILE_SCHEMA = "tpu-profile/v1"
+DIFF_SCHEMA = "tpu-profile-diff/v1"
+
+#: Root span name -> trace shape.  ``serve-request`` roots are the
+#: per-request serve shape; ``slice-ready`` spans anchor the
+#: control-plane shape (each one is a window over its reconcile
+#: chain).  Callers profile other shapes by passing their own map
+#: (bench.py uses {"train-step": "train"}).
+DEFAULT_ROOTS: Dict[str, str] = {
+    "serve-request": "serve",
+    "slice-ready": "control-plane",
+}
+
+#: Comparison metric the diff engine reads from each kind's profile.
+DIFF_METRIC = "p90_s"
+
+
+def span_kind(name: str) -> str:
+    """Normalize a span name to its kind: chain roots collapse to
+    ``chain``, ad-hoc error spans to ``error``, everything else (the
+    fixed serve/control-plane vocabulary) is already the kind."""
+    if name.startswith("chain:"):
+        return "chain"
+    if name.startswith("error:"):
+        return "error"
+    return name
+
+
+def _round(x: float) -> float:
+    # Tidy artifact values; 9 decimals keeps ns resolution while
+    # avoiding 0.30000000000000004-style float noise in diffs read by
+    # humans.  Determinism does not depend on this — identical inputs
+    # produce identical floats either way.
+    return round(x, 9)
+
+
+def _depths(spans: List[Dict[str, Any]]) -> Dict[str, int]:
+    """Tree depth per span_id from parent links (orphans and roots are
+    depth 0); cycle-safe because the store can hold orphaned links
+    after eviction."""
+    by_id = {s["span_id"]: s for s in spans}
+    depths: Dict[str, int] = {}
+
+    def depth(sid: str) -> int:
+        d = depths.get(sid)
+        if d is not None:
+            return d
+        depths[sid] = 0          # breaks cycles / missing parents
+        parent = by_id.get(sid, {}).get("parent_id", "")
+        if parent and parent in by_id and parent != sid:
+            depths[sid] = depth(parent) + 1
+        return depths[sid]
+
+    for s in spans:
+        depth(s["span_id"])
+    return depths
+
+
+def _window_self_times(root: Dict[str, Any],
+                       candidates: List[Dict[str, Any]],
+                       depths: Dict[str, int]) -> Dict[str, float]:
+    """Exclusive self time per span kind over the root's window.
+
+    Interval sweep: cut [root.start, root.end] at every candidate
+    boundary; each elementary interval charges the deepest covering
+    candidate (ties: latest start, then span id), or the root's own
+    kind when nothing covers it.  The returned values partition the
+    window — sum(values) == root duration up to float addition."""
+    w0, w1 = root["start"], root["end"]
+    root_kind = span_kind(root["name"])
+    if w1 is None or w1 <= w0:
+        return {root_kind: 0.0}
+    live = [s for s in candidates
+            if s["span_id"] != root["span_id"] and s["end"] is not None
+            and s["end"] > w0 and s["start"] < w1]
+    cuts = {w0, w1}
+    for s in live:
+        cuts.add(max(w0, s["start"]))
+        cuts.add(min(w1, s["end"]))
+    edges = sorted(cuts)
+    self_s: Dict[str, float] = {}
+    for a, b in zip(edges, edges[1:]):
+        if b <= a:
+            continue
+        best = None
+        best_key: Tuple[int, float, str] = (-1, 0.0, "")
+        for s in live:
+            if s["start"] <= a and s["end"] >= b:
+                key = (depths.get(s["span_id"], 0), s["start"],
+                       s["span_id"])
+                if key > best_key:
+                    best, best_key = s, key
+        kind = span_kind(best["name"]) if best is not None else root_kind
+        self_s[kind] = self_s.get(kind, 0.0) + (b - a)
+    return self_s or {root_kind: 0.0}
+
+
+def trace_records(spans: List[Dict[str, Any]],
+                  roots: Optional[Dict[str, str]] = None
+                  ) -> List[Dict[str, Any]]:
+    """Per-window critical-path records from exported span dicts.
+
+    One record per closed span whose name is in ``roots``; candidates
+    for its window are the other spans of the same trace.  For the
+    serve shape that is the whole request tree; for a ``slice-ready``
+    window it includes chain siblings (pod-start, queue-wait,
+    reconcile) that overlap the window — depth decides attribution,
+    uncovered time stays with ``slice-ready`` itself."""
+    roots = DEFAULT_ROOTS if roots is None else roots
+    by_trace: Dict[str, List[Dict[str, Any]]] = {}
+    for s in spans:
+        by_trace.setdefault(s["trace_id"], []).append(s)
+    records: List[Dict[str, Any]] = []
+    for trace_id in sorted(by_trace):
+        tspans = by_trace[trace_id]
+        depths = _depths(tspans)
+        for s in sorted(tspans, key=lambda s: (s["start"], s["span_id"])):
+            if s["name"] not in roots or s["end"] is None:
+                continue
+            self_s = _window_self_times(s, tspans, depths)
+            records.append({
+                "trace_id": trace_id,
+                "root_span_id": s["span_id"],
+                "shape": roots[s["name"]],
+                "duration_s": max(0.0, s["end"] - s["start"]),
+                "self_s": self_s,
+            })
+    return records
+
+
+def aggregate(records: List[Dict[str, Any]],
+              meta: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """Fold per-window records into the ``tpu-profile/v1`` document:
+    per shape, per span kind — sample count, total/mean self seconds,
+    interpolated p50/p90/p99 self time, and the fraction of the
+    shape's total wall time (fractions sum to 1.0 per shape, because
+    each record's self times partition its window)."""
+    by_shape: Dict[str, List[Dict[str, Any]]] = {}
+    for rec in records:
+        by_shape.setdefault(rec["shape"], []).append(rec)
+    shapes: Dict[str, Any] = {}
+    for shape in sorted(by_shape):
+        recs = by_shape[shape]
+        total = sum(r["duration_s"] for r in recs)
+        durs = [r["duration_s"] for r in recs]
+        kinds: Dict[str, Any] = {}
+        for kind in sorted({k for r in recs for k in r["self_s"]}):
+            samples = [r["self_s"][kind] for r in recs
+                       if kind in r["self_s"]]
+            kinds[kind] = {
+                "count": len(samples),
+                "total_s": _round(sum(samples)),
+                "fraction": _round(sum(samples) / total) if total > 0
+                else 0.0,
+                "mean_s": _round(sum(samples) / len(samples)),
+                "p50_s": _round(quantile(samples, 0.50)),
+                "p90_s": _round(quantile(samples, 0.90)),
+                "p99_s": _round(quantile(samples, 0.99)),
+            }
+        shapes[shape] = {
+            "traces": len(recs),
+            "total_s": _round(total),
+            "duration_p50_s": _round(quantile(durs, 0.50)),
+            "duration_p90_s": _round(quantile(durs, 0.90)),
+            "duration_p99_s": _round(quantile(durs, 0.99)),
+            "kinds": kinds,
+        }
+    doc: Dict[str, Any] = {"schema": PROFILE_SCHEMA, "shapes": shapes}
+    if meta:
+        doc["meta"] = dict(meta)
+    return doc
+
+
+def profile_spans(spans: List[Dict[str, Any]],
+                  roots: Optional[Dict[str, str]] = None,
+                  meta: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """Extractor + aggregator in one call: exported span dicts in,
+    ``tpu-profile/v1`` document out."""
+    return aggregate(trace_records(spans, roots), meta=meta)
+
+
+# -- trace-diff engine ------------------------------------------------------
+
+
+def diff_profiles(baseline: Dict[str, Any], candidate: Dict[str, Any],
+                  *, min_count: int = 5, rel_threshold: float = 0.25,
+                  min_delta_s: float = 0.0,
+                  metric: str = DIFF_METRIC) -> Dict[str, Any]:
+    """Compare two profiles per (shape, kind) behind a noise gate.
+
+    A (shape, kind) pair is judged only when both sides carry at least
+    ``min_count`` samples — otherwise it lands in ``skipped`` with the
+    reason.  A judged pair regresses when the candidate's ``metric``
+    grew by more than ``rel_threshold`` relatively AND ``min_delta_s``
+    absolutely (improvements mirror that).  Regressions are sorted
+    worst-absolute-delta first, so ``regressions[0]["kind"]`` names
+    the guilty component."""
+    regressions: List[Dict[str, Any]] = []
+    improvements: List[Dict[str, Any]] = []
+    skipped: List[Dict[str, Any]] = []
+    b_shapes = baseline.get("shapes", {})
+    c_shapes = candidate.get("shapes", {})
+    for shape in sorted(set(b_shapes) | set(c_shapes)):
+        bk = b_shapes.get(shape, {}).get("kinds", {})
+        ck = c_shapes.get(shape, {}).get("kinds", {})
+        for kind in sorted(set(bk) | set(ck)):
+            b, c = bk.get(kind), ck.get(kind)
+            if b is None or c is None:
+                skipped.append({"shape": shape, "kind": kind,
+                                "reason": "missing-side"})
+                continue
+            n = min(b["count"], c["count"])
+            if n < min_count:
+                skipped.append({"shape": shape, "kind": kind,
+                                "reason": f"samples {n} < {min_count}"})
+                continue
+            base, cand = b[metric], c[metric]
+            delta = cand - base
+            # Zero-baseline guard: a kind that cost nothing before and
+            # something now is an arbitrarily large relative change —
+            # clamp the denominator instead of dividing by zero.
+            rel = delta / max(base, 1e-9)
+            entry = {"shape": shape, "kind": kind, "metric": metric,
+                     "baseline_s": base, "candidate_s": cand,
+                     "delta_s": _round(delta), "rel_change": _round(rel),
+                     "samples": n}
+            if rel >= rel_threshold and delta >= max(min_delta_s, 0.0):
+                regressions.append(entry)
+            elif rel <= -rel_threshold and -delta >= max(min_delta_s, 0.0):
+                improvements.append(entry)
+    regressions.sort(key=lambda e: (-e["delta_s"], e["shape"], e["kind"]))
+    improvements.sort(key=lambda e: (e["delta_s"], e["shape"], e["kind"]))
+    return {
+        "schema": DIFF_SCHEMA,
+        "metric": metric,
+        "gate": {"min_count": min_count, "rel_threshold": rel_threshold,
+                 "min_delta_s": min_delta_s},
+        "regressions": regressions,
+        "improvements": improvements,
+        "skipped": skipped,
+    }
+
+
+def worst_regression(diff: Optional[Dict[str, Any]]
+                     ) -> Optional[Dict[str, Any]]:
+    """The largest-absolute-delta regression of a diff, or None."""
+    if not diff:
+        return None
+    regs = diff.get("regressions") or []
+    return regs[0] if regs else None
+
+
+def describe_regression(entry: Dict[str, Any]) -> str:
+    """One human line naming the guilty span kind — rollback events
+    and CLI verdicts both use it."""
+    pct = entry["rel_change"] * 100.0
+    return (f"{entry['kind']} {entry['metric']} self "
+            f"{entry['baseline_s']:.4f}s -> {entry['candidate_s']:.4f}s "
+            f"(+{pct:.0f}%)")
+
+
+# -- live profiling (gateway hook + /debug/profile) -------------------------
+
+
+class RequestProfiler:
+    """The gateway's request-completion hook and the live profile
+    source behind ``/debug/profile`` and the upgrade ramp's
+    build-vs-build diff.
+
+    The gateway calls :meth:`note` with each completed request's trace
+    id and the backend that FINALLY served it (retries/failover can
+    touch several backends' spans in one trace; the hook records the
+    one that answered, so a per-backend profile never charges blue
+    with green's retry debris).  The ring is bounded; snapshots read
+    spans lazily from the tracer's store, so noting a request costs
+    one deque append."""
+
+    def __init__(self, tracer, capacity: int = 1024):
+        self._tracer = tracer
+        self._ring: "deque[Tuple[str, str]]" = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+
+    def note(self, trace_id: str, backend: str = "none") -> None:
+        if not trace_id:
+            return
+        with self._lock:
+            self._ring.append((trace_id, backend))
+
+    def completed(self, backend: Optional[str] = None) -> List[str]:
+        """Noted trace ids, oldest first, optionally scoped to the
+        backend that served them (deduplicated, order-preserving)."""
+        with self._lock:
+            pairs = list(self._ring)
+        seen: Dict[str, None] = {}
+        for tid, b in pairs:
+            if backend is None or b == backend:
+                seen.setdefault(tid, None)
+        return list(seen)
+
+    def snapshot(self, backend: Optional[str] = None,
+                 meta: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        """Live profile document.  Unscoped snapshots cover everything
+        in the span store (serve requests AND control-plane chains);
+        ``backend=`` narrows to the serve traces that backend
+        answered."""
+        spans = self._tracer.export()
+        if backend is None:
+            return profile_spans(spans, meta=meta)
+        ids = set(self.completed(backend))
+        spans = [s for s in spans if s["trace_id"] in ids]
+        return profile_spans(spans, roots={"serve-request": "serve"},
+                             meta=meta)

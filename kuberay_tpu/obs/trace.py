@@ -63,20 +63,34 @@ class TraceContext:
         random hex, so a traced sim/bench run replays identically."""
         return f"00-{self.trace_id}-{self.span_id}-01"
 
+    #: Conservative header-size cap: a real traceparent is ~55 bytes;
+    #: anything past this is garbage and not worth parsing.
+    _MAX_HEADER_LEN = 200
+
     @classmethod
     def from_traceparent(cls, header: Optional[str]
                          ) -> Optional["TraceContext"]:
         """Parse a traceparent header into the remote parent context;
         malformed or absent headers yield None (the request simply runs
-        untraced — propagation must never fail a request)."""
+        untraced — propagation must never fail a request).  Strict on
+        shape: exactly 4 fields, version exactly ``00``, bounded total
+        length, ids lowercase alphanumeric (covering both W3C hex ids
+        and this tracer's ``t000001``/``s000002`` counter ids)."""
         if not header:
             return None
-        parts = str(header).strip().split("-")
+        text = str(header).strip()
+        if len(text) > cls._MAX_HEADER_LEN:
+            return None
+        parts = text.split("-")
         if len(parts) != 4 or parts[0] != "00":
             return None
         _, trace_id, span_id, _flags = parts
-        if not trace_id or not span_id:
-            return None
+        for field in (trace_id, span_id):
+            if not 1 <= len(field) <= 64:
+                return None
+            if not all(c.isascii() and (c.isdigit() or c.islower())
+                       for c in field):
+                return None
         return cls(trace_id, span_id)
 
 
@@ -169,6 +183,15 @@ class SpanStore:
     def __len__(self) -> int:
         with self._lock:
             return len(self._spans)
+
+    def stats(self) -> Dict[str, int]:
+        """Retention envelope for the /debug/traces response: current
+        span count, the cap, and the lifetime eviction count — so a
+        truncated profile is detectable instead of silently biased."""
+        with self._lock:
+            return {"spans": len(self._spans),
+                    "max_spans": self.max_spans,
+                    "dropped": self._dropped}
 
     def export(self, trace_id: Optional[str] = None) -> List[Dict[str, Any]]:
         with self._lock:

@@ -82,10 +82,24 @@ class Operator:
         # ring/LRU structures; /debug/traces + /debug/flight answer
         # "where did the time go" per reconcile, /debug/goodput answers
         # it per job lifetime (productive vs. lost seconds).
-        from kuberay_tpu.obs import (FlightRecorder, GoodputLedger, Tracer,
+        from kuberay_tpu.obs import (FlightRecorder, GoodputLedger,
+                                     RequestProfiler, Tracer,
                                      TransitionRecorder)
         self.tracer = Tracer()
-        self.flight = FlightRecorder()
+        # Flight records made inside an active span carry its trace_id
+        # (timeline row -> span join during forensics).
+        self.flight = FlightRecorder(tracer=self.tracer)
+        # Critical-path profiler over the span store (/debug/profile);
+        # an embedded gateway notes request completions into it.
+        self.profiler = RequestProfiler(self.tracer)
+        # Span-store eviction counter, synced as a delta each background
+        # tick — the tracer itself stays observational.
+        self.metrics.registry.describe(
+            "tpu_trace_spans_dropped_total",
+            "Spans evicted from the bounded trace store by tail-sampling "
+            "retention — nonzero means /debug/profile and /debug/traces "
+            "are working from a truncated window")
+        self._trace_dropped_seen = 0
         self.goodput = GoodputLedger(metrics=self.metrics)
         self.transitions = TransitionRecorder(flight=self.flight,
                                               ledger=self.goodput)
@@ -134,18 +148,19 @@ class Operator:
             client_provider=provider,
             scheduler=scheduler, metrics=self.metrics,
             tracer=self.tracer, transitions=self.transitions)
+        from kuberay_tpu.controlplane.autoscaler import DecisionAudit
+        self.autoscaler_audit = DecisionAudit(metrics=self.metrics)
         self.service_controller = TpuServiceController(
             self.store, recorder=self.recorder,
             client_provider=lambda cname, status: provider(status),
-            tracer=self.tracer, transitions=self.transitions)
+            tracer=self.tracer, transitions=self.transitions,
+            profiler=self.profiler, audit=self.autoscaler_audit)
         self.cronjob_controller = TpuCronJobController(
             self.store, recorder=self.recorder, tracer=self.tracer,
             scheduler=scheduler)
         self.networkpolicy_controller = NetworkPolicyController(self.store)
         self.warmpool_controller = WarmSlicePoolController(
             self.store, recorder=self.recorder, tracer=self.tracer)
-        from kuberay_tpu.controlplane.autoscaler import DecisionAudit
-        self.autoscaler_audit = DecisionAudit(metrics=self.metrics)
         # SLO burn-rate alerting (obs/alerts.py): evaluated from the
         # background tick over the same registry everything above feeds;
         # served at /debug/alerts, cross-linked to the decision audit
@@ -265,7 +280,8 @@ class Operator:
             self.store, api_host, api_port, metrics=self.metrics,
             history=history, tracer=self.tracer, flight=self.flight,
             goodput=self.goodput, autoscaler=self.autoscaler_audit,
-            alerts=self.alerts, steps=self.steps, quota=self.quota)
+            alerts=self.alerts, steps=self.steps, quota=self.quota,
+            profiler=self.profiler)
         if leader_election and shard_leases and self.manager.shards > 1:
             from kuberay_tpu.controlplane.leader import ShardLeaseElector
             # Start unowned: every pool paused until its lease is won.
@@ -330,10 +346,23 @@ class Operator:
                 if self.kubelet is not None:
                     self.kubelet.step()
                 self.alerts.evaluate()
+                self._sync_trace_dropped()
                 self._gc_events()
             except Exception:
                 log.exception("operator background loop iteration failed")
             stop.wait(1.0)
+
+    def _sync_trace_dropped(self):
+        """Mirror the span store's lifetime eviction count into the
+        registry as a cumulative counter (delta per tick) — scrapers
+        learn a profile window got truncated without polling
+        /debug/traces."""
+        dropped = self.tracer.store.dropped
+        delta = dropped - self._trace_dropped_seen
+        if delta > 0:
+            self.metrics.registry.inc("tpu_trace_spans_dropped_total",
+                                      value=float(delta))
+            self._trace_dropped_seen = dropped
 
     _EVENT_TTL_SECONDS = 3600.0
     _EVENT_GC_INTERVAL = 60.0

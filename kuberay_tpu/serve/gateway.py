@@ -155,7 +155,7 @@ class WeightedGateway:
                  poll_interval: float = 1.0, metrics=None,
                  config: Optional[GatewayConfig] = None,
                  rng: Optional[random.Random] = None, clock=None,
-                 tracer=None, flight=None):
+                 tracer=None, flight=None, profiler=None):
         """``resolver(service_name) -> base_url``; defaults to cluster-DNS
         (http://<svc>.<ns>.svc:<serve-port>).  ``metrics`` is an optional
         MetricsRegistry: forwarded requests observe
@@ -170,10 +170,15 @@ class WeightedGateway:
         header across the replica hop, and the trace id echoed to the
         client.  ``flight`` (obs.FlightRecorder) records backend
         lifecycle — weight changes, dead-backend exclusion,
-        retry-failover — keyed ("Backend", ns, service)."""
+        retry-failover — keyed ("Backend", ns, service).  ``profiler``
+        (obs.RequestProfiler) is noted on every request completion
+        with the trace id and the backend that finally answered — the
+        feed behind /debug/profile's per-backend scoping and the
+        upgrade ramp's build-vs-build trace diff."""
         self.metrics = metrics
         self.tracer = tracer if tracer is not None else NOOP_TRACER
         self.flight = flight
+        self.profiler = profiler
         if metrics is not None:
             metrics.describe("tpu_gateway_requests_total",
                              "Requests forwarded by the serve gateway, "
@@ -644,6 +649,8 @@ class WeightedGateway:
                 ctx, ts=self._now(),
                 status="ok" if code < 400 else "error",
                 error="" if code < 400 else f"http {code}")
+            if self.profiler is not None:
+                self.profiler.note(ctx.trace_id, backend)
         if self.metrics is not None:
             self.metrics.observe("tpu_serve_request_duration_seconds",
                                  self._now() - t0, {"phase": "gateway"},

@@ -46,6 +46,23 @@ def _report_violation(result: SimResult, journal_tail: int,
             print(f"    {json.dumps(rec, sort_keys=True)}", file=out)
 
 
+def _report_profile(profile_doc: dict, out) -> None:
+    """Violation forensics: where the violating run's wall time went,
+    per trace shape — the top span kinds by total exclusive self time,
+    so 'which component ate the window' is answered without opening the
+    trace export."""
+    for shape, body in sorted(profile_doc.get("shapes", {}).items()):
+        kinds = sorted(body.get("kinds", {}).items(),
+                       key=lambda kv: -kv[1]["total_s"])[:5]
+        if not kinds:
+            continue
+        parts = ", ".join(
+            f"{k} {v['total_s']:.3f}s ({v['fraction'] * 100:.0f}%)"
+            for k, v in kinds)
+        print(f"  critical path [{shape}] over {body['traces']} "
+              f"windows: {parts}", file=out)
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m kuberay_tpu.sim",
@@ -73,6 +90,12 @@ def main(argv=None) -> int:
                              "implies --trace.  With a seed range, the "
                              "last run wins — use a single seed for "
                              "forensics")
+    parser.add_argument("--profile-out", default="",
+                        help="write the run's critical-path profile "
+                             "(tpu-profile/v1: per-span-kind exclusive "
+                             "self-time percentiles) to this JSON file; "
+                             "implies --trace.  Byte-identical across "
+                             "re-runs of a seed")
     parser.add_argument("--alerts", action="store_true",
                         help="evaluate SLO burn-rate alerts each settle "
                              "round (kuberay_tpu.obs.alerts); the replay "
@@ -113,7 +136,7 @@ def main(argv=None) -> int:
               f"{', '.join(sorted(SCENARIOS))}", file=sys.stderr)
         return 2
 
-    trace = args.trace or bool(args.trace_out)
+    trace = args.trace or bool(args.trace_out) or bool(args.profile_out)
     failed = False
     for name in names:
         scenario = get_scenario(name)
@@ -125,11 +148,19 @@ def main(argv=None) -> int:
                 result = h.run(steps)
                 journal = list(h.journal)
                 trace_doc = h.export_trace() if trace else None
+                profile_doc = h.export_profile() if trace else None
             if args.trace_out and trace_doc is not None:
                 with open(args.trace_out, "w") as f:
                     json.dump(trace_doc, f, sort_keys=True)
                 print(f"trace: {len(trace_doc['spans'])} spans -> "
                       f"{args.trace_out}")
+            if args.profile_out and profile_doc is not None:
+                with open(args.profile_out, "w") as f:
+                    json.dump(profile_doc, f, sort_keys=True)
+                shapes = profile_doc.get("shapes", {})
+                windows = sum(s["traces"] for s in shapes.values())
+                print(f"profile: {windows} windows across "
+                      f"{len(shapes)} shapes -> {args.profile_out}")
             if args.json:
                 print(json.dumps({
                     "scenario": result.scenario, "seed": result.seed,
@@ -155,6 +186,8 @@ def main(argv=None) -> int:
                              else "rerun with --trace-out PATH to save")
                     print(f"  trace: {len(trace_doc['spans'])} causal "
                           f"spans recorded ({where})", file=sys.stderr)
+                if profile_doc is not None:
+                    _report_profile(profile_doc, sys.stderr)
     return 1 if failed else 0
 
 

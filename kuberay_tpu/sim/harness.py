@@ -187,7 +187,10 @@ class SimHarness:
         # so the journal hash is byte-identical with it on or off — the
         # replay-invariance contract tests/test_obs_trace.py enforces.
         self.tracer = Tracer(clock=self.clock) if trace else NOOP_TRACER
-        self.flight = FlightRecorder(clock=self.clock) if trace else None
+        # Flight rows recorded inside an active span carry its trace_id
+        # (observational: the stamp reads the tracer's thread-local).
+        self.flight = (FlightRecorder(clock=self.clock, tracer=self.tracer)
+                       if trace else None)
         # SLO burn-rate alerting (obs.alerts): observational only — it
         # reads metric snapshots and the virtual clock, never the store
         # or rng, so the journal hash is byte-identical with the engine
@@ -420,6 +423,20 @@ class SimHarness:
             "alerts": self.alerts.to_dict() if self.alerts else {},
             "steps": self.steps.to_dict() if self.steps else {},
         }
+
+    def export_profile(self) -> Dict[str, Any]:
+        """Critical-path profile of the run (obs/profile.py): per-span-
+        kind exclusive self-time percentiles over every closed
+        ``slice-ready`` (and, when serve traffic ran, ``serve-request``)
+        window.  Pure function of the recorded spans — with the virtual
+        clock and counter span ids the artifact is byte-identical across
+        re-runs of a seed (tools/obs_smoke.sh holds that line)."""
+        from kuberay_tpu.obs.profile import profile_spans
+        return profile_spans(self.tracer.export(), meta={
+            "scenario": self.scenario.name if self.scenario else "adhoc",
+            "seed": self.seed,
+            "journal_hash": self.journal_hash(),
+        })
 
     # -- convergence -------------------------------------------------------
 

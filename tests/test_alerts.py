@@ -177,7 +177,9 @@ def test_latency_alert_links_to_offending_exemplar_trace():
     _observe_ttft(reg, 1.5, 5, exemplar="t000777", exemplar_ts=10.0)
     fired = eng.evaluate()
     links = [a for a in fired if a["window"] == "fast"][0]["links"]
-    assert links["trace"] == "/debug/traces?trace_id=t000777"
+    # The link lands on the nested view: the exemplar names a trace,
+    # the responder wants its whole span tree.
+    assert links["trace"] == "/debug/traces?trace_id=t000777&tree=1"
     assert links["autoscaler"] == "/debug/autoscaler"
 
 

@@ -449,3 +449,122 @@ def test_sim_trace_decomposition_and_replay_hash_invariance():
     assert len(export["events"]) == traced.journal_len
     assert export["spans"] and export["flight"]
     json.dumps(export)                          # JSON-serializable
+
+
+# ---------------------------------------------------------------------------
+# traceparent parsing: propagation must never fail a request
+# ---------------------------------------------------------------------------
+
+def test_from_traceparent_accepts_w3c_and_counter_ids():
+    from kuberay_tpu.obs.trace import TraceContext
+    ctx = TraceContext.from_traceparent(
+        "00-0af7651916cd43dd8448eb211c80319c-b7ad6b7169203331-01")
+    assert ctx is not None
+    assert ctx.trace_id == "0af7651916cd43dd8448eb211c80319c"
+    assert ctx.span_id == "b7ad6b7169203331"
+    # This tracer's own counter ids round-trip too.
+    ctx = TraceContext.from_traceparent("00-t000001-s000002-01")
+    assert (ctx.trace_id, ctx.span_id) == ("t000001", "s000002")
+    # Surrounding whitespace is tolerated (proxies pad headers).
+    assert TraceContext.from_traceparent("  00-t000001-s000002-01\n") \
+        is not None
+
+
+def test_from_traceparent_rejects_wrong_field_count():
+    from kuberay_tpu.obs.trace import TraceContext
+    assert TraceContext.from_traceparent("00-t000001-s000002") is None
+    assert TraceContext.from_traceparent(
+        "00-t000001-s000002-01-extra") is None
+    assert TraceContext.from_traceparent("00") is None
+    assert TraceContext.from_traceparent("") is None
+    assert TraceContext.from_traceparent(None) is None
+
+
+def test_from_traceparent_rejects_non_hex_ids():
+    from kuberay_tpu.obs.trace import TraceContext
+    for bad in ("00-TRACE001-s000002-01",      # uppercase
+                "00-t00 001-s000002-01",       # embedded space
+                "00-t000001-s0000;2-01",       # punctuation
+                "00-träce-s000002-01",         # non-ascii
+                "00--s000002-01",              # empty trace id
+                "00-t000001--01"):             # empty span id
+        assert TraceContext.from_traceparent(bad) is None, bad
+    # Length bounds on each id: 64 ok, 65 rejected.
+    assert TraceContext.from_traceparent(
+        f"00-{'a' * 64}-s000002-01") is not None
+    assert TraceContext.from_traceparent(
+        f"00-{'a' * 65}-s000002-01") is None
+
+
+def test_from_traceparent_rejects_oversized_header_and_bad_version():
+    from kuberay_tpu.obs.trace import TraceContext
+    assert TraceContext.from_traceparent(
+        "01-t000001-s000002-01") is None     # version != 00
+    assert TraceContext.from_traceparent(
+        "ff-t000001-s000002-01") is None
+    oversized = "00-" + "a" * 300 + "-s000002-01"
+    assert len(oversized) > 200
+    assert TraceContext.from_traceparent(oversized) is None
+
+
+# ---------------------------------------------------------------------------
+# SpanStore tail-sampling: what survives memory pressure
+# ---------------------------------------------------------------------------
+
+def _mk_span(i, *, dur=None, status="ok", name="s"):
+    from kuberay_tpu.obs.trace import Span
+    end = None if dur is None else float(i) + dur
+    return Span(f"t{i:03d}", f"s{i:03d}", "", name, float(i), end,
+                status=status)
+
+
+def test_span_store_evicts_fast_ok_spans_first():
+    from kuberay_tpu.obs.trace import SpanStore
+    store = SpanStore(max_spans=40)
+    # 30 fast ok spans, 4 slow ok spans, 3 errors, 3 still-open spans,
+    # then overflow traffic that forces an eviction pass.
+    for i in range(30):
+        store.add(_mk_span(i, dur=0.01, name="fast"))
+    for i in range(30, 34):
+        store.add(_mk_span(i, dur=9.0, name="slow"))
+    for i in range(34, 37):
+        store.add(_mk_span(i, dur=0.01, status="error", name="err"))
+    for i in range(37, 40):
+        store.add(_mk_span(i, name="open"))
+    assert store.dropped == 0
+    for i in range(40, 50):
+        store.add(_mk_span(i, dur=0.01, name="fast"))
+    stats = store.stats()
+    assert stats["dropped"] > 0
+    assert stats["spans"] <= stats["max_spans"] == 40
+    names = [s["name"] for s in store.export()]
+    # The interesting tail survives: every slow span, every error, and
+    # every still-open span outlive the fast-ok churn.
+    assert names.count("slow") == 4
+    assert names.count("err") == 3
+    assert names.count("open") == 3
+    # And what was dropped came from the fast-ok pool.
+    assert 30 + 10 - names.count("fast") == stats["dropped"]
+
+
+def test_span_store_under_extreme_pressure_keeps_open_spans_longest():
+    from kuberay_tpu.obs.trace import SpanStore
+    store = SpanStore(max_spans=4)
+    store.add(_mk_span(0, name="open-a"))
+    store.add(_mk_span(1, name="open-b"))
+    store.add(_mk_span(2, dur=0.1, status="error", name="err"))
+    store.add(_mk_span(3, dur=0.1, name="ok"))
+    store.add(_mk_span(4, dur=0.1, name="ok2"))     # forces eviction
+    names = [s["name"] for s in store.export()]
+    # Open spans are the last resort; the closed-ok spans go first.
+    assert "open-a" in names and "open-b" in names
+    assert store.stats()["dropped"] >= 1
+
+
+def test_span_store_stats_envelope_shape():
+    from kuberay_tpu.obs.trace import SpanStore
+    store = SpanStore(max_spans=8)
+    assert store.stats() == {"spans": 0, "max_spans": 8, "dropped": 0}
+    for i in range(3):
+        store.add(_mk_span(i, dur=0.5))
+    assert store.stats() == {"spans": 3, "max_spans": 8, "dropped": 0}

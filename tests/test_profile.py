@@ -1,0 +1,410 @@
+"""Critical-path profile gate (kuberay_tpu.obs.profile): the interval
+sweep's decomposition invariant (per-span-kind exclusive self times
+partition every root window, for serve trees AND sim slice-ready
+chains), the aggregator's fraction contract, the noise-gated trace
+diff, the byte-identical sim profile artifact, and the upgrade ramp's
+build-vs-build diff landing in the DecisionAudit with the guilty span
+kind named.
+"""
+
+import json
+
+import pytest
+
+from kuberay_tpu.controlplane.autoscaler import DecisionAudit
+from kuberay_tpu.obs.profile import (
+    DEFAULT_ROOTS,
+    PROFILE_SCHEMA,
+    RequestProfiler,
+    aggregate,
+    describe_regression,
+    diff_profiles,
+    profile_spans,
+    span_kind,
+    trace_records,
+    worst_regression,
+)
+from kuberay_tpu.obs.trace import Tracer
+from kuberay_tpu.sim.clock import VirtualClock
+from kuberay_tpu.sim.faults import FaultPlan
+from kuberay_tpu.sim.harness import SimHarness
+from kuberay_tpu.sim.scenarios import get_scenario, make_cluster_obj
+from kuberay_tpu.utils import constants as C
+
+
+# ---------------------------------------------------------------------------
+# extractor: the interval sweep
+# ---------------------------------------------------------------------------
+
+def _span(trace_id, span_id, parent_id, name, start, end):
+    return {"trace_id": trace_id, "span_id": span_id,
+            "parent_id": parent_id, "name": name,
+            "start": start, "end": end}
+
+
+def test_serve_window_decomposes_exactly():
+    spans = [
+        _span("t1", "root", "", "serve-request", 0.0, 10.0),
+        _span("t1", "q", "root", "gateway-queue", 0.0, 2.0),
+        _span("t1", "f", "root", "forward", 2.0, 9.0),
+        # Engine children nest INSIDE forward; depth charges them, not
+        # the enclosing forward span.
+        _span("t1", "p", "f", "prefill", 2.0, 4.0),
+        _span("t1", "d", "f", "decode", 4.0, 8.0),
+    ]
+    recs = trace_records(spans)
+    assert len(recs) == 1
+    rec = recs[0]
+    assert rec["shape"] == "serve"
+    assert rec["duration_s"] == 10.0
+    # forward's exclusive slice is 8..9 (prefill/decode cover 2..8);
+    # the root keeps the uncovered tail 9..10.
+    assert rec["self_s"] == {
+        "gateway-queue": 2.0, "prefill": 2.0, "decode": 4.0,
+        "forward": 1.0, "serve-request": 1.0}
+    assert sum(rec["self_s"].values()) == pytest.approx(rec["duration_s"])
+
+
+def test_overlapping_siblings_never_double_count():
+    # Two siblings overlap on [3, 6): a naive duration-minus-children
+    # subtraction would charge the window twice.  The sweep charges the
+    # later-starting sibling (tie depth) and the sum stays exact.
+    spans = [
+        _span("t1", "root", "", "serve-request", 0.0, 10.0),
+        _span("t1", "a", "root", "prefill", 1.0, 6.0),
+        _span("t1", "b", "root", "decode", 3.0, 9.0),
+    ]
+    rec = trace_records(spans)[0]
+    assert sum(rec["self_s"].values()) == pytest.approx(10.0)
+    assert rec["self_s"]["prefill"] == pytest.approx(2.0)   # 1..3
+    assert rec["self_s"]["decode"] == pytest.approx(6.0)    # 3..9
+    assert rec["self_s"]["serve-request"] == pytest.approx(2.0)
+
+
+def test_children_clip_to_the_root_window():
+    # A candidate straddling the window boundary only charges the part
+    # inside it; fully-outside spans charge nothing.
+    spans = [
+        _span("t1", "root", "", "slice-ready", 10.0, 20.0),
+        _span("t1", "a", "", "pod-start", 5.0, 14.0),       # clips to 10..14
+        _span("t1", "b", "", "queue-wait", 30.0, 40.0),     # outside
+    ]
+    rec = trace_records(spans, roots={"slice-ready": "control-plane"})[0]
+    assert rec["self_s"] == {"pod-start": 4.0, "slice-ready": 6.0}
+
+
+def test_zero_duration_window_keeps_root_kind():
+    spans = [_span("t1", "root", "", "slice-ready", 5.0, 5.0)]
+    rec = trace_records(spans, roots={"slice-ready": "control-plane"})[0]
+    assert rec["duration_s"] == 0.0
+    assert rec["self_s"] == {"slice-ready": 0.0}
+
+
+def test_span_kind_normalization():
+    assert span_kind("chain:TpuCluster/default/x") == "chain"
+    assert span_kind("error:coordinator") == "error"
+    assert span_kind("decode") == "decode"
+    assert set(DEFAULT_ROOTS) == {"serve-request", "slice-ready"}
+
+
+def test_real_tracer_serve_trace_decomposes():
+    """The decomposition invariant over a REAL tracer's serve tree:
+    per-span-kind self times sum to the root serve-request duration."""
+    clock = VirtualClock(start=50.0)
+    tracer = Tracer(clock=clock)
+    ctx = tracer.start_request("serve-request", ts=50.0)
+    tracer.record_span(ctx, "gateway-queue", 50.0, 50.5)
+    tracer.record_span(ctx, "route-decision", 50.5, 50.6)
+    fwd_ctx = ctx
+    tracer.record_span(fwd_ctx, "forward", 50.6, 53.0)
+    tracer.record_span(fwd_ctx, "engine-queue", 50.7, 51.0)
+    tracer.record_span(fwd_ctx, "prefill", 51.0, 51.8)
+    tracer.record_span(fwd_ctx, "decode", 51.8, 52.9)
+    tracer.finish_request(ctx, ts=53.0)
+    recs = trace_records(tracer.export())
+    assert len(recs) == 1
+    rec = recs[0]
+    assert rec["duration_s"] == pytest.approx(3.0)
+    assert sum(rec["self_s"].values()) == pytest.approx(3.0)
+    for kind in ("gateway-queue", "route-decision", "engine-queue",
+                 "prefill", "decode"):
+        assert kind in rec["self_s"], sorted(rec["self_s"])
+
+
+# ---------------------------------------------------------------------------
+# aggregator
+# ---------------------------------------------------------------------------
+
+def test_aggregate_fractions_sum_to_one_per_shape():
+    spans = []
+    for i in range(4):
+        t0 = 10.0 * i
+        spans += [
+            _span(f"t{i}", f"r{i}", "", "serve-request", t0, t0 + 4.0),
+            _span(f"t{i}", f"p{i}", f"r{i}", "prefill", t0, t0 + 1.0),
+            _span(f"t{i}", f"d{i}", f"r{i}", "decode", t0 + 1.0,
+                  t0 + 3.0 + i * 0.25),
+        ]
+    doc = profile_spans(spans, meta={"source": "unit"})
+    assert doc["schema"] == PROFILE_SCHEMA
+    assert doc["meta"]["source"] == "unit"
+    serve = doc["shapes"]["serve"]
+    assert serve["traces"] == 4
+    frac = sum(k["fraction"] for k in serve["kinds"].values())
+    assert frac == pytest.approx(1.0, abs=1e-9)
+    # Percentiles are per-kind over the self-time samples.
+    assert serve["kinds"]["prefill"]["count"] == 4
+    assert serve["kinds"]["prefill"]["p50_s"] == pytest.approx(1.0)
+    assert serve["kinds"]["decode"]["p99_s"] > \
+        serve["kinds"]["decode"]["p50_s"]
+
+
+def test_aggregate_empty_and_json_stability():
+    assert aggregate([]) == {"schema": PROFILE_SCHEMA, "shapes": {}}
+    spans = [_span("t1", "r", "", "serve-request", 0.0, 1.0)]
+    a = json.dumps(profile_spans(spans), sort_keys=True)
+    b = json.dumps(profile_spans(list(reversed(spans))), sort_keys=True)
+    assert a == b
+
+
+# ---------------------------------------------------------------------------
+# diff engine: the noise gate
+# ---------------------------------------------------------------------------
+
+def _profile_with(kind_metrics, shape="serve"):
+    kinds = {k: {"count": n, "total_s": v * n, "fraction": 0.5,
+                 "mean_s": v, "p50_s": v, "p90_s": v, "p99_s": v}
+             for k, (n, v) in kind_metrics.items()}
+    return {"schema": PROFILE_SCHEMA, "shapes": {shape: {
+        "traces": max((n for n, _ in kind_metrics.values()), default=0),
+        "total_s": 1.0, "duration_p50_s": 0.1, "duration_p90_s": 0.2,
+        "duration_p99_s": 0.3, "kinds": kinds}}}
+
+
+def test_diff_names_the_guilty_kind():
+    base = _profile_with({"prefill": (10, 0.10), "decode": (10, 0.20)})
+    cand = _profile_with({"prefill": (10, 0.11), "decode": (10, 0.45)})
+    diff = diff_profiles(base, cand)
+    assert [e["kind"] for e in diff["regressions"]] == ["decode"]
+    worst = worst_regression(diff)
+    assert worst["kind"] == "decode"
+    assert worst["rel_change"] == pytest.approx(1.25)
+    assert "decode" in describe_regression(worst)
+    assert diff["improvements"] == []
+    # prefill moved 10% — under the 25% gate, so neither bucket.
+    assert all(e["kind"] != "prefill" for e in diff["regressions"])
+
+
+def test_diff_min_count_and_missing_side_skip():
+    base = _profile_with({"decode": (2, 0.1)})
+    cand = _profile_with({"decode": (9, 0.9), "prefill": (9, 0.2)})
+    diff = diff_profiles(base, cand, min_count=5)
+    assert diff["regressions"] == []
+    reasons = {e["kind"]: e["reason"] for e in diff["skipped"]}
+    assert reasons["decode"] == "samples 2 < 5"
+    assert reasons["prefill"] == "missing-side"
+
+
+def test_diff_zero_baseline_and_min_delta_gate():
+    base = _profile_with({"decode": (10, 0.0)})
+    cand = _profile_with({"decode": (10, 0.002)})
+    # Zero baseline: relative change is huge but min_delta_s can gate
+    # the absolute movement.
+    assert diff_profiles(base, cand)["regressions"]
+    assert diff_profiles(base, cand,
+                         min_delta_s=0.01)["regressions"] == []
+
+
+def test_diff_improvements_mirror_regressions():
+    base = _profile_with({"decode": (10, 0.4)})
+    cand = _profile_with({"decode": (10, 0.1)})
+    diff = diff_profiles(base, cand)
+    assert diff["regressions"] == []
+    assert [e["kind"] for e in diff["improvements"]] == ["decode"]
+    assert worst_regression(diff) is None
+    assert worst_regression(None) is None
+
+
+def test_self_diff_is_always_clean():
+    base = _profile_with({"prefill": (10, 0.1), "decode": (10, 0.2)})
+    diff = diff_profiles(base, base)
+    assert diff["regressions"] == [] and diff["improvements"] == []
+
+
+# ---------------------------------------------------------------------------
+# RequestProfiler: per-backend scoping
+# ---------------------------------------------------------------------------
+
+def test_request_profiler_scopes_to_final_backend():
+    tracer = Tracer(clock=VirtualClock(start=0.0))
+    profiler = RequestProfiler(tracer)
+    for backend, decode_s in (("blue", 0.1), ("green", 0.4)):
+        for i in range(3):
+            t0 = float(i) + (100.0 if backend == "green" else 0.0)
+            ctx = tracer.start_request("serve-request", ts=t0)
+            tracer.record_span(ctx, "decode", t0, t0 + decode_s)
+            tracer.finish_request(ctx, ts=t0 + decode_s)
+            profiler.note(ctx.trace_id, backend)
+    blue = profiler.snapshot(backend="blue")
+    green = profiler.snapshot(backend="green")
+    assert blue["shapes"]["serve"]["traces"] == 3
+    assert green["shapes"]["serve"]["kinds"]["decode"]["p90_s"] > \
+        blue["shapes"]["serve"]["kinds"]["decode"]["p90_s"]
+    # Unscoped snapshot covers everything.
+    assert profiler.snapshot()["shapes"]["serve"]["traces"] == 6
+    # Unknown backend: empty profile, not an error.
+    assert profiler.snapshot(backend="nope")["shapes"] == {}
+
+
+# ---------------------------------------------------------------------------
+# sim: nonzero control-plane decomposition + byte-identical artifact
+# ---------------------------------------------------------------------------
+
+@pytest.mark.timeout(120)
+def test_sim_slice_ready_profile_decomposes_with_slow_start():
+    """A held pod stretches the slice-ready window over real virtual
+    time; the control-plane profile must attribute it (pod-start self
+    time dominates) and the per-window invariant must hold exactly."""
+    quiet = {f: 0.0 for f in FaultPlan(0).profile}
+    with SimHarness(0, fault_profile=quiet, trace=True) as h:
+        h.store.create(make_cluster_obj("demo", topology="2x2x2",
+                                        replicas=1))
+        h.manager.run_until_idle()
+        pods = [p for p in h.store.list("Pod")
+                if p["metadata"]["labels"].get(C.LABEL_GROUP) == "workers"]
+        victim = sorted(p["metadata"]["name"] for p in pods)[0]
+        h.kubelet.hold_pod(victim, until=h.clock.now() + 40.0)
+        h.settle(horizon=120.0)
+        spans = h.tracer.export()
+        doc = h.export_profile()
+    recs = [r for r in trace_records(spans) if r["shape"] == "control-plane"]
+    assert recs, "no slice-ready windows extracted"
+    for rec in recs:
+        assert sum(rec["self_s"].values()) == \
+            pytest.approx(rec["duration_s"], abs=1e-6)
+    assert any(rec["duration_s"] >= 40.0 for rec in recs)
+    cp = doc["shapes"]["control-plane"]
+    assert cp["total_s"] >= 40.0
+    assert cp["kinds"]["pod-start"]["total_s"] >= 39.0
+    frac = sum(k["fraction"] for k in cp["kinds"].values())
+    assert frac == pytest.approx(1.0, abs=1e-6)
+
+
+@pytest.mark.timeout(300)
+def test_sim_profile_artifact_byte_identical_and_hash_invariant():
+    """Acceptance: two runs of the same (scenario, seed) export the
+    SAME profile bytes, and mounting the profiler leaves the journal
+    hash untouched (all obs layers stay observational)."""
+    docs, hashes = [], []
+    for _ in range(2):
+        with SimHarness(3, scenario=get_scenario("scale-up-storm"),
+                        trace=True) as h:
+            result = h.run(2)
+            docs.append(json.dumps(h.export_profile(), sort_keys=True))
+            hashes.append(result.journal_hash)
+    assert docs[0] == docs[1]
+    assert hashes[0] == hashes[1]
+    with SimHarness(3, scenario=get_scenario("scale-up-storm")) as h:
+        untraced = h.run(2)
+    assert untraced.journal_hash == hashes[0]
+    doc = json.loads(docs[0])
+    assert doc["schema"] == PROFILE_SCHEMA
+    assert doc["meta"]["journal_hash"] == hashes[0]
+
+
+# ---------------------------------------------------------------------------
+# upgrade gate integration: the diff lands in the DecisionAudit
+# ---------------------------------------------------------------------------
+
+def _wire_profiler(h):
+    from kuberay_tpu.utils.names import serve_service_name
+    tracer = Tracer()
+    profiler = RequestProfiler(tracer)
+    audit = DecisionAudit(capacity=32)
+    h.svc_ctrl.profiler = profiler
+    h.svc_ctrl.audit = audit
+    s = h.svc()
+    blue = serve_service_name(s.status.activeServiceStatus.clusterName)
+    green = serve_service_name(s.status.pendingServiceStatus.clusterName)
+    return tracer, profiler, audit, blue, green
+
+
+def _record_serve_traces(tracer, profiler, backend, *, decode_s,
+                         base_ts, n=5):
+    for i in range(n):
+        t0 = base_ts + 10.0 * i
+        ctx = tracer.start_request("serve-request", ts=t0)
+        tracer.record_span(ctx, "prefill", t0, t0 + 0.05)
+        tracer.record_span(ctx, "decode", t0 + 0.05, t0 + 0.05 + decode_s)
+        tracer.finish_request(ctx, ts=t0 + 0.05 + decode_s)
+        profiler.note(ctx.trace_id, backend)
+
+
+@pytest.fixture(autouse=True)
+def _reset_feature_gates():
+    from kuberay_tpu.utils import features
+    features.reset()
+    yield
+    features.reset()
+
+
+def test_rollback_audit_names_the_decode_regression():
+    from kuberay_tpu.api.tpuservice import UpgradeState
+    from tests.test_service_controller import (bump_image, gated_harness,
+                                               green_weight)
+    h, clock, gate = gated_harness()
+    bump_image(h, "model:v2")
+    h.settle(rounds=6)
+    assert green_weight(h) == 50
+    tracer, profiler, audit, blue, green = _wire_profiler(h)
+    # Candidate build: decode is 8x slower than blue's.
+    _record_serve_traces(tracer, profiler, blue, decode_s=0.05,
+                         base_ts=100.0)
+    _record_serve_traces(tracer, profiler, green, decode_s=0.40,
+                         base_ts=1000.0)
+
+    gate.healthy = False
+    gate.alert = {"name": "upgrade-green-ttft", "window": "fast"}
+    h.settle(rounds=2)
+    assert h.svc().status.upgrade.state == UpgradeState.ROLLED_BACK
+
+    entries = [e for e in audit.to_list()
+               if e.get("kind") == "upgrade" and e["action"] == "rollback"]
+    assert entries, audit.to_list()
+    entry = entries[0]
+    assert entry["green_weight"] == 0
+    diff = entry["profile_diff"]
+    assert diff["regressions"], diff
+    assert diff["regressions"][0]["kind"] == "decode"
+    # The rollback event message names WHERE the candidate got slower.
+    msgs = [e["message"] for e in h.store.list("Event")
+            if e.get("reason") == "UpgradeRolledBack"]
+    assert msgs and any("candidate slower in decode" in m for m in msgs), \
+        msgs
+
+
+def test_clean_candidate_promotes_with_empty_regressions():
+    from kuberay_tpu.api.tpuservice import UpgradeState
+    from tests.test_service_controller import (bump_image, gated_harness,
+                                               green_weight)
+    h, clock, gate = gated_harness()
+    bump_image(h, "model:v2")
+    h.settle(rounds=6)
+    assert green_weight(h) == 50
+    tracer, profiler, audit, blue, green = _wire_profiler(h)
+    # Same shape on both builds: nothing clears the noise gate.
+    _record_serve_traces(tracer, profiler, blue, decode_s=0.10,
+                         base_ts=100.0)
+    _record_serve_traces(tracer, profiler, green, decode_s=0.10,
+                         base_ts=1000.0)
+
+    clock.advance(3600.0)
+    h.settle(rounds=4)
+    assert h.svc().status.upgrade.state == UpgradeState.PROMOTED
+
+    entries = [e for e in audit.to_list()
+               if e.get("kind") == "upgrade" and e["action"] == "promote"]
+    assert entries, audit.to_list()
+    diff = entries[0]["profile_diff"]
+    assert diff["regressions"] == []
+    assert entries[0]["reason"] == "ramp complete"

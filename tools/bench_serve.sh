@@ -111,6 +111,56 @@ print(f"bench serve trace ok: overhead {ov['overhead_pct']}% "
       f"{ov['spans_recorded']} spans")
 EOF
 
+# Critical-path profile gate (docs/observability.md, "Critical-path
+# profiles & trace diff"): tracer off vs on per seed over the identical
+# schedule; the on legs fold into ONE tpu-profile/v1 serve profile whose
+# self-diff must report zero regressions (the determinism canary) and
+# whose requests/sec overhead stays inside the tracing budget.  The
+# committed benchmark/results/profile_r18.json is the full-scale
+# baseline; the candidate-vs-baseline diff is printed informationally
+# only (absolute timings vary across machines — the diff names WHERE
+# they moved, it is not a smoke failure).
+profile_out="${BENCH_PROFILE_OUT:-/tmp/tpu_bench_serve_profile.json}"
+timeout -k 10 600 env JAX_PLATFORMS=cpu python benchmark/serve_bench.py \
+    --profile \
+    --seeds "${BENCH_SEEDS:-0}" \
+    --duration "${BENCH_DURATION:-5}" \
+    --rate-scale "${BENCH_RATE_SCALE:-0.5}" \
+    --json-out "$profile_out"
+BENCH_JSON_PATH="$profile_out" \
+BENCH_TRACE_MAX_PCT="${BENCH_TRACE_MAX_PCT:-5}" python - <<'EOF'
+import json, os, sys
+sys.path.insert(0, os.getcwd())
+from benchmark.serve_bench import PROFILE_BENCH_SCHEMA, PROFILE_LEG_KEYS
+doc = json.load(open(os.environ["BENCH_JSON_PATH"]))
+assert doc["schema"] == PROFILE_BENCH_SCHEMA, doc.get("schema")
+assert doc["legs"], "profile run produced no legs"
+for leg in doc["legs"]:
+    missing = [k for k in PROFILE_LEG_KEYS if k not in leg]
+    assert not missing, f"leg missing keys {missing}: {leg}"
+    assert leg["errors"] == 0, f"transport errors in leg: {leg}"
+    assert leg["completed"] > 0, leg
+prof = doc["profile"]
+assert prof["schema"] == "tpu-profile/v1", prof.get("schema")
+serve = prof["shapes"]["serve"]
+assert serve["traces"] > 0, "no serve windows profiled"
+frac = sum(k["fraction"] for k in serve["kinds"].values())
+assert abs(frac - 1.0) < 1e-6, f"self-time fractions sum to {frac}"
+assert doc["self_diff"]["regressions"] == [], (
+    f"self-diff found regressions: {doc['self_diff']}")
+ov = doc["overhead"]
+limit = float(os.environ["BENCH_TRACE_MAX_PCT"])
+assert ov["overhead_pct"] < limit, (
+    f"profiling overhead {ov['overhead_pct']}% exceeds {limit}%: {ov}")
+print(f"bench serve profile ok: {serve['traces']} windows, "
+      f"kinds {sorted(serve['kinds'])}, overhead {ov['overhead_pct']}% "
+      f"({ov['requests_per_sec_off']} -> {ov['requests_per_sec_on']} req/s)")
+EOF
+if [ -f benchmark/results/profile_r18.json ]; then
+    python -m kuberay_tpu.cli profile diff \
+        benchmark/results/profile_r18.json "$profile_out" || true
+fi
+
 # Zero-downtime upgrade gate (docs/upgrades.md): per seed, a blue-only
 # baseline, the burn-rate-gated orchestrator ramp, and the legacy naive
 # timer ramp — both ramps hit a connection-refused fault on the green

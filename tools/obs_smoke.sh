@@ -13,7 +13,11 @@
 # through a coordinator sharing the operator's StepTracker, and the
 # straggler must surface — skew at /api/steps and /debug/steps, a
 # verdict with the slow host's name, and the per-host step-duration
-# histogram on the operator's /metrics.
+# histogram on the operator's /metrics.  Finally the critical-path
+# profile leg: /debug/profile must decompose the traced serve request
+# (self-time fractions summing to 1.0), and a seeded sim scenario run
+# twice must export a byte-identical tpu-profile/v1 artifact whose
+# self-diff reports zero regressions.
 #
 #   tools/obs_smoke.sh
 #
@@ -21,7 +25,7 @@
 # contract and the metric catalog.
 set -eu
 cd "$(dirname "$0")/.."
-exec timeout -k 10 300 env JAX_PLATFORMS=cpu python - <<'EOF'
+timeout -k 10 300 env JAX_PLATFORMS=cpu python - <<'EOF'
 import json
 import re
 import urllib.request
@@ -51,13 +55,16 @@ try:
                    "tpu_cluster_provisioned_duration_seconds"):
         assert needed in text, f"{needed} missing from /metrics"
 
-    # /debug/traces must parse as JSON and contain the span pipeline.
+    # /debug/traces must parse as JSON and contain the span pipeline,
+    # plus the retention envelope (a truncated window is detectable).
     with urllib.request.urlopen(f"{url}/debug/traces") as resp:
         doc = json.load(resp)
     names = {s["name"] for s in doc["spans"]}
     for needed in ("queue-wait", "reconcile", "store-write", "pod-start",
                    "slice-ready"):
         assert needed in names, f"{needed} span missing: {sorted(names)}"
+    assert "retention" in doc and "dropped" in doc["retention"], \
+        f"no retention stats in /debug/traces envelope: {sorted(doc)}"
 
     # And the flight recorder answers for the CR.
     with urllib.request.urlopen(
@@ -153,6 +160,22 @@ try:
         srv.shutdown()
         fe.close()
 
+    # Critical-path profile: /debug/profile must decompose the serve
+    # request just traced — per-span-kind exclusive self-time fractions
+    # summing to 1.0 over the serve shape, with the engine phases
+    # present — and carry the same retention envelope.
+    with urllib.request.urlopen(f"{url}/debug/profile") as resp:
+        prof = json.load(resp)
+    assert prof["schema"] == "tpu-profile/v1", prof.get("schema")
+    serve_shape = prof["shapes"]["serve"]
+    assert serve_shape["traces"] >= 1, prof["shapes"]
+    frac = sum(k["fraction"] for k in serve_shape["kinds"].values())
+    assert abs(frac - 1.0) < 1e-6, \
+        f"serve self-time fractions sum to {frac}"
+    for needed in ("prefill", "decode"):
+        assert needed in serve_shape["kinds"], sorted(serve_shape["kinds"])
+    assert "retention" in prof, sorted(prof)
+
     # Training-step telemetry end-to-end: a coordinator sharing the
     # operator's StepTracker ingests synthetic heartbeats for a fake
     # 2-host job where host b runs 5x slow — with two hosts the fleet
@@ -219,8 +242,29 @@ try:
           f"{len(good['intervals'])} intervals, "
           f"{len(audit['decisions'])} autoscaler decisions, "
           f"serve trace {trace_id} spans {sorted(got)}, "
+          f"profile shapes {sorted(prof['shapes'])}, "
           f"straggler host-b skew "
           f"{hosts['host-b']['skew_ratio']:.2f}")
 finally:
     op.stop()
 EOF
+
+# Critical-path profile determinism leg: the same seeded sim scenario
+# run twice must export a BYTE-identical tpu-profile/v1 artifact (the
+# virtual clock and counter span ids leave no wall-clock residue), and
+# the noise-gated diff of a run against itself must report zero
+# regressions (exit 1 otherwise — `tpuctl profile diff` is the same
+# engine the upgrade ramp and tools/bench_serve.sh use).
+prof_a="${OBS_PROFILE_A:-/tmp/obs_smoke_profile_a.json}"
+prof_b="${OBS_PROFILE_B:-/tmp/obs_smoke_profile_b.json}"
+timeout -k 10 120 env JAX_PLATFORMS=cpu python -m kuberay_tpu.sim \
+    --scenario scale-up-storm --seed 3 --profile-out "$prof_a" >/dev/null
+timeout -k 10 120 env JAX_PLATFORMS=cpu python -m kuberay_tpu.sim \
+    --scenario scale-up-storm --seed 3 --profile-out "$prof_b" >/dev/null
+cmp "$prof_a" "$prof_b" || {
+    echo "profile artifact not byte-identical across re-runs" >&2
+    exit 1
+}
+timeout -k 10 60 env JAX_PLATFORMS=cpu python -m kuberay_tpu.cli \
+    profile diff "$prof_a" "$prof_b"
+echo "obs profile leg ok: byte-identical sim artifact, self-diff clean"
