@@ -12,7 +12,10 @@ Views:
   #/service/{ns}/{name} drill-down: active/pending pair, traffic weights
                         during a roll, per-app status
   #/new                 create a TpuJob or TpuCluster (form or raw JSON)
-  #/history             archived clusters (history mount), log browser
+  #/incidents           ranked incident bundles (/debug/incidents): id,
+                        trigger, entity, top suspect, verdict, bundle link
+  #/history             archived clusters (history mount), log browser,
+                        per-entity archived incident bundles
 """
 
 DASHBOARD_HTML = r"""<!doctype html>
@@ -44,6 +47,7 @@ DASHBOARD_HTML = r"""<!doctype html>
  <h1>kuberay-tpu</h1>
  <a href="#/overview" id="nav-overview">Overview</a>
  <a href="#/new" id="nav-new">New</a>
+ <a href="#/incidents" id="nav-incidents">Incidents</a>
  <a href="#/history" id="nav-history">History</a>
  <span style="font-size:.85rem">ns:
   <select id="ns" style="padding:.1rem"></select></span>
@@ -275,6 +279,26 @@ function viewNew(el){
   catch(e){document.getElementById('msg').innerHTML=`<span class="bad">bad JSON: ${esc(e.message)}</span>`}};
 }
 
+// Incident forensics index: the operator's /debug/incidents ranked
+// bundles — id, trigger, scoped entity, top suspect and the one-line
+// verdict; each id links to the full tpu-incident/v1 bundle JSON.
+async function viewIncidents(el){
+ const doc=await getj('/debug/incidents');
+ if(!doc){el.innerHTML=`<h2>Incidents</h2>
+  <p class="dim">incident engine not enabled on this server</p>`;return}
+ const rows=doc.incidents||[];
+ el.innerHTML=`<h2>Incidents <span class="dim" style="font-weight:normal;font-size:.8rem">
+  (${rows.length} bundles, ${doc.evaluations||0} evaluations)</span></h2>
+ ${rows.length?`<table>${row(['ID','TRIGGER','ENTITY','TOP SUSPECT','VERDICT','BUNDLE'],1)+
+  rows.map(r=>{const e=r.entity||{};const t=r.top_suspect||{};return row([
+   `<span class="mono">${esc(r.id)}</span>`,esc(r.trigger),
+   e.name?`<span class="mono">${esc(e.namespace)}/${esc(e.name)}</span>`:'—',
+   t.key?`<span class="mono">${esc(t.kind)} ${esc(t.key)}</span> <span class="dim">(${esc(t.lead_s)}s lead)</span>`:'—',
+   esc(r.verdict||''),
+   `<a href="/debug/incidents/${esc(r.id)}">JSON</a>`])}).join('')}</table>`
+  :'<p class="dim">no incidents — nothing has rolled back, breached, straggled or been reclaimed</p>'}`;
+}
+
 // Each path segment URI-encoded, slashes between segments preserved.
 function encPath(...segs){return segs.flatMap(s=>String(s).split('/')).map(encodeURIComponent).join('/')}
 async function viewHistory(el,ns,name){
@@ -292,6 +316,7 @@ async function viewHistory(el,ns,name){
    (doc.events||[]).map(e=>row([esc(e.type),esc(e.reason),esc(e.message)])).join('')}</table>
   ${doc.pods&&doc.pods.length?`<h3>Pods at deletion</h3><table>${row(['POD','PHASE'],1)+
    doc.pods.map(p=>row([esc(p.name),esc(p.phase)])).join('')}</table>`:''}
+  <div id="incidents"></div>
   <div id="taskev"></div>
   <h3>Logs</h3><table>${row(['FILE',''],1)+
    files.map(f=>row([`<span class="mono">${esc(f)}</span>`,
@@ -302,6 +327,15 @@ async function viewHistory(el,ns,name){
    const r=await fetch(`/api/history/logs/${encPath(ns,name,a.dataset.log)}`);
    const v=document.getElementById('logview');
    v.style.display='block';v.textContent=await r.text()});
+  // Archived incident bundles (the forensics engine's post-mortem for
+  // this entity, persisted by the history collector).
+  const inc=((await getj(`/api/history/incidents/${encPath(ns,name)}`))||{}).incidents||[];
+  if(inc.length)document.getElementById('incidents').innerHTML=
+   `<h3>Incidents</h3><table>${row(['ID','TRIGGER','TOP SUSPECT','VERDICT'],1)+
+    inc.map(b=>{const t=(b.suspects||[])[0]||{};return row([
+     `<span class="mono">${esc(b.id)}</span>`,esc(b.trigger),
+     t.key?`<span class="mono">${esc(t.kind)} ${esc(t.key)}</span>`:'—',
+     esc(b.verdict||'')])}).join('')}</table>`;
   // Archived task/step/profile events (post-mortem replay of the
   // coordinator's event stream) + the Perfetto-loadable timeline link.
   const tev=((await getj(`/api/history/events/${encPath(ns,name)}`))||{}).events||[];
@@ -331,7 +365,7 @@ async function render(){
  const el=document.getElementById('main');
  const parts=location.hash.replace(/^#\/?/,'').split('/').filter(Boolean);
  const view=parts[0]||'overview';
- for(const n of ['overview','new','history'])
+ for(const n of ['overview','new','incidents','history'])
   document.getElementById('nav-'+n).className=view===n?'active':'';
  if(timer){clearInterval(timer);timer=null}
  if(view==='cluster'&&parts.length===3){await viewCluster(el,parts[1],parts[2]);
@@ -341,6 +375,8 @@ async function render(){
  else if(view==='service'&&parts.length===3){await viewService(el,parts[1],parts[2]);
   timer=setInterval(()=>viewService(el,parts[1],parts[2]),3000)}
  else if(view==='new')viewNew(el);
+ else if(view==='incidents'){await viewIncidents(el);
+  timer=setInterval(()=>viewIncidents(el),3000)}
  else if(view==='history')await viewHistory(el,parts[1],parts[2]);
  else{await viewOverview(el);timer=setInterval(()=>viewOverview(el),3000)}
  document.getElementById('refresh').textContent='updated '+new Date().toLocaleTimeString();

@@ -75,6 +75,26 @@ class ApiHandler(JsonHandler):
     steps = None                        # obs.StepTracker (optional)
     quota = None                        # controlplane.QuotaManager (optional)
     profiler = None                     # obs.RequestProfiler (optional)
+    incidents = None                    # obs.IncidentEngine (optional)
+
+    #: Default ``?limit=N`` per /debug list endpoint (newest entries
+    #: win); a long-running operator must not serve multi-MB debug
+    #: payloads by default.  Documented in docs/observability.md.
+    _DEBUG_LIMITS = {"traces": 5000, "flight": 256, "alerts": 256,
+                     "autoscaler": 256, "quota": 256, "incidents": 64}
+
+    def _limit(self, endpoint: str) -> int:
+        """Shared ``?limit=N`` bound for /debug list endpoints: the
+        endpoint's default when absent or unparsable, floored at 1."""
+        q = parse_qs(urlparse(self.path).query)
+        raw = q.get("limit", [None])[0]
+        default = self._DEBUG_LIMITS[endpoint]
+        if raw is None:
+            return default
+        try:
+            return max(1, int(raw))
+        except ValueError:
+            return default
 
     def _error(self, code: int, message: str, reason: str = ""):
         self._send(code, {"kind": "Status", "status": "Failure",
@@ -206,11 +226,12 @@ class ApiHandler(JsonHandler):
             return self._error(404, "tracing not enabled")
         q = parse_qs(urlparse(self.path).query)
         trace_id = q.get("trace_id", [None])[0]
+        spans = self.tracer.export(trace_id)[-self._limit("traces"):]
         if q.get("tree", ["0"])[0] in ("1", "true"):
             from kuberay_tpu.obs.trace import span_tree
-            body = {"traces": span_tree(self.tracer.export(trace_id))}
+            body = {"traces": span_tree(spans)}
         else:
-            body = {"spans": self.tracer.export(trace_id)}
+            body = {"spans": spans}
         # Retention envelope: a reader (or the profiler) can tell a
         # complete export from one the bounded store already evicted
         # spans out of — a truncated profile should be detectable.
@@ -245,17 +266,18 @@ class ApiHandler(JsonHandler):
         if self.flight is None:
             return self._error(404, "flight recorder not enabled")
         parts = [p for p in path.split("/") if p][2:]   # strip debug/flight
+        limit = self._limit("flight")
         if not parts:
             return self._send(200, {"objects": [
                 {"kind": k, "namespace": ns, "name": n}
-                for k, ns, n in self.flight.keys()]})
+                for k, ns, n in self.flight.keys()[-limit:]]})
         if len(parts) != 3:
             return self._error(
                 404, "use /debug/flight/<kind>/<namespace>/<name>")
         kind, ns, name = parts
         return self._send(200, {
             "kind": kind, "namespace": ns, "name": name,
-            "records": self.flight.timeline(kind, ns, name)})
+            "records": self.flight.timeline(kind, ns, name)[-limit:]})
 
     def _debug_goodput(self, path: str):
         """Goodput ledger: ``/debug/goodput`` lists tracked objects with
@@ -307,27 +329,55 @@ class ApiHandler(JsonHandler):
 
     def _debug_autoscaler(self):
         """Autoscaler decision audit: the bounded last-N ring of scale
-        decisions with their input signals (newest first)."""
+        decisions with their input signals (newest first;
+        ``?limit=N``)."""
         if self.autoscaler is None:
             return self._error(404, "autoscaler audit not enabled")
-        return self._send(200, {"decisions": self.autoscaler.to_list()})
+        decisions = self.autoscaler.to_list()[:self._limit("autoscaler")]
+        return self._send(200, {"decisions": decisions})
 
     def _debug_quota(self):
         """QuotaManager ledger: pools, per-gang claims, pending gangs
         (escalation state included), and the bounded last-N admission
-        decision ring (newest first).  404 when the operator runs
-        without a quota manager."""
+        decision ring (newest first; ``?limit=N``).  404 when the
+        operator runs without a quota manager."""
         if self.quota is None:
             return self._error(404, "quota manager not enabled")
-        return self._send(200, self.quota.debug_snapshot())
+        doc = self.quota.debug_snapshot()
+        doc["decisions"] = (doc.get("decisions")
+                            or [])[:self._limit("quota")]
+        return self._send(200, doc)
 
     def _debug_alerts(self):
         """SLO burn-rate alerts (obs/alerts.py): currently-firing alerts,
-        the bounded fired/resolved history ring, and the spec catalog.
-        404 when the operator runs without an alert engine."""
+        the bounded fired/resolved history ring (``?limit=N`` bounds
+        it, newest entries win), and the spec catalog.  404 when the
+        operator runs without an alert engine."""
         if self.alerts is None:
             return self._error(404, "alerting not enabled")
-        return self._send(200, self.alerts.to_dict())
+        doc = self.alerts.to_dict()
+        doc["ring"] = doc.get("ring", [])[-self._limit("alerts"):]
+        return self._send(200, doc)
+
+    def _debug_incidents(self, path: str):
+        """Incident forensics (obs/incident.py): ``/debug/incidents``
+        lists one summary row per bundle (newest first; ``?limit=N``);
+        ``/debug/incidents/<id>`` returns the full ``tpu-incident/v1``
+        bundle.  404 when the operator runs without the engine."""
+        if self.incidents is None:
+            return self._error(404, "incident engine not enabled")
+        parts = [p for p in path.split("/") if p][2:]  # strip prefix
+        if not parts:
+            doc = self.incidents.to_dict()
+            doc["incidents"] = \
+                doc["incidents"][:self._limit("incidents")]
+            return self._send(200, doc)
+        if len(parts) != 1:
+            return self._error(404, "use /debug/incidents/<id>")
+        bundle = self.incidents.get(parts[0])
+        if bundle is None:
+            return self._error(404, f"no incident {parts[0]}")
+        return self._send(200, bundle)
 
     def _label_selector(self) -> Optional[Dict[str, str]]:
         q = parse_qs(urlparse(self.path).query)
@@ -509,6 +559,9 @@ class ApiHandler(JsonHandler):
             return self._debug_autoscaler()
         if path == "/debug/alerts":
             return self._debug_alerts()
+        if path == "/debug/incidents" or \
+                path.startswith("/debug/incidents/"):
+            return self._debug_incidents(path)
         if path == "/debug/quota":
             return self._debug_quota()
         if path.startswith("/api/history/") and self.history is not None:
@@ -725,7 +778,7 @@ def make_server(store: ObjectStore, host: str = "127.0.0.1", port: int = 0,
                 flight=None, goodput=None,
                 autoscaler=None, alerts=None,
                 steps=None, quota=None,
-                profiler=None) -> ThreadingHTTPServer:
+                profiler=None, incidents=None) -> ThreadingHTTPServer:
     """``token`` enables bearer auth on every API verb; ``certfile``/
     ``keyfile`` serve TLS (the authenticated-cluster-endpoint stand-in
     RestObjectStore's client auth is tested against).  ``history``: a
@@ -738,14 +791,16 @@ def make_server(store: ObjectStore, host: str = "127.0.0.1", port: int = 0,
     ``/debug/alerts``; ``steps`` (an ``obs.StepTracker``) mounts
     ``/debug/steps[/<job>]``; ``profiler`` (an ``obs.RequestProfiler``)
     backs ``/debug/profile``'s per-backend scoping (without it the
-    endpoint still serves the unscoped span-store profile)."""
+    endpoint still serves the unscoped span-store profile);
+    ``incidents`` (an ``obs.IncidentEngine``) mounts
+    ``/debug/incidents[/<id>]``."""
     handler = type("BoundApiHandler", (ApiHandler,),
                    {"store": store, "metrics": metrics, "token": token,
                     "history": history, "tracer": tracer,
                     "flight": flight, "goodput": goodput,
                     "autoscaler": autoscaler, "alerts": alerts,
                     "steps": steps, "quota": quota,
-                    "profiler": profiler})
+                    "profiler": profiler, "incidents": incidents})
     if certfile:
         import ssl
         ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
@@ -765,13 +820,13 @@ def serve_background(store: ObjectStore, host: str = "127.0.0.1",
                      keyfile: Optional[str] = None, history=None,
                      tracer=None, flight=None, goodput=None,
                      autoscaler=None, alerts=None, steps=None, quota=None,
-                     profiler=None):
+                     profiler=None, incidents=None):
     """Start in a daemon thread; returns (server, base_url)."""
     srv = make_server(store, host, port, metrics, token=token,
                       certfile=certfile, keyfile=keyfile, history=history,
                       tracer=tracer, flight=flight, goodput=goodput,
                       autoscaler=autoscaler, alerts=alerts, steps=steps,
-                      quota=quota, profiler=profiler)
+                      quota=quota, profiler=profiler, incidents=incidents)
     t = threading.Thread(target=srv.serve_forever, daemon=True,
                          name="tpu-apiserver")
     t.start()

@@ -8,6 +8,7 @@ Subcommands mirror `kubectl ray` with TPU flags first-class
     tpuctl create workergroup NAME --cluster C --tpu v5e --topology 2x4
     tpuctl scale NAME --group G --replicas N
     tpuctl submit NAME --tpu ... -- python -m train ...
+    tpuctl incident list|show ID
     tpuctl suspend|resume (cluster|job) NAME
     tpuctl delete (cluster|job|service) NAME
     tpuctl status (cluster|job|service) NAME
@@ -239,6 +240,54 @@ def _profile_live(args) -> int:
     return 0
 
 
+def _incident(args) -> int:
+    """`tpuctl incident list` / `tpuctl incident show ID`: the
+    apiserver's /debug/incidents surface — ranked root-cause bundles
+    for every rollback/breach/straggler/preemption/reclaim the
+    operator's forensics engine has seen."""
+    import urllib.request
+    base = f"{args.server.rstrip('/')}/debug/incidents"
+    if args.verb == "show":
+        if not args.id:
+            print("error: incident show needs an incident id",
+                  file=sys.stderr)
+            return 2
+        url = base + "/" + urllib.parse.quote(args.id)
+        try:
+            with urllib.request.urlopen(url, timeout=15) as resp:
+                bundle = json.load(resp)
+        except Exception as e:
+            print(f"error: {url} unreachable: {e}", file=sys.stderr)
+            return 1
+        print(json.dumps(bundle, indent=2, sort_keys=True))
+        return 0
+    url = base + (f"?limit={args.limit}" if args.limit else "")
+    try:
+        with urllib.request.urlopen(url, timeout=15) as resp:
+            doc = json.load(resp)
+    except Exception as e:
+        print(f"error: {url} unreachable: {e}", file=sys.stderr)
+        return 1
+    rows = []
+    for row in doc.get("incidents", []):
+        ent = row.get("entity") or {}
+        top = row.get("top_suspect") or {}
+        rows.append([
+            row.get("id", ""), row.get("trigger", ""),
+            (f"{ent.get('namespace', '')}/{ent.get('name', '')}"
+             if ent else "-"),
+            (f"{top.get('kind', '')} {top.get('key', '')}"
+             if top else "-"),
+            f"{top.get('lead_s', 0):.1f}s" if top else "-",
+        ])
+    if not rows:
+        print("no incidents")
+        return 0
+    print(_table(rows, ["ID", "TRIGGER", "ENTITY", "TOP-SUSPECT",
+                        "LEAD"]))
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="tpuctl",
                                  description="TPU pod-slice orchestration CLI")
@@ -371,6 +420,18 @@ def main(argv=None):
     pf.add_argument("--threshold", type=float, default=0.25,
                     help="(diff) noise gate: relative change a kind must "
                          "clear to count as a regression")
+
+    inc = sub.add_parser(
+        "incident",
+        help="incident forensics bundles: `incident list` shows the "
+             "ranked index from /debug/incidents, `incident show ID` "
+             "prints one full tpu-incident/v1 bundle")
+    inc.add_argument("verb", choices=["list", "show"])
+    inc.add_argument("id", nargs="?", default="",
+                     help="(show) incident id, e.g. inc000001")
+    inc.add_argument("--limit", type=int, default=0,
+                     help="(list) newest rows to fetch (server default "
+                          "64)")
 
     for name in ("suspend", "resume"):
         sp = sub.add_parser(name)
@@ -657,6 +718,9 @@ def _dispatch(args, client: ApiClient) -> int:
                 if j.get("status", {}).get("clusterName") == args.cluster]
         print(json.dumps(cluster_timeline(cluster, events, jobs)))
         return 0
+
+    if args.cmd == "incident":
+        return _incident(args)
 
     if args.cmd == "profile":
         if args.target == "diff":

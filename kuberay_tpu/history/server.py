@@ -18,6 +18,7 @@ Components (reference counterparts in parentheses):
   ``GET /api/history/logs/{ns}/{cluster}/{path}`` log content (text)
   ``GET /api/history/meta/{ns}/{cluster}``      archived metadata docs
   ``GET /api/history/goodput/{ns}/{cluster}``   archived goodput ledger
+  ``GET /api/history/incidents/{ns}/{cluster}`` archived incident bundles
 
 All storage goes through ``history.storage.StorageBackend`` — local
 directory, S3, or GCS (the reference's storage interface seam).
@@ -73,7 +74,7 @@ class HistoryCollector:
     (API writes, all reconcilers) behind remote HTTP round-trips."""
 
     def __init__(self, store: ObjectStore, storage: StorageBackend,
-                 goodput=None):
+                 goodput=None, incidents=None):
         self.store = store
         self.storage = storage
         # Optional obs.GoodputLedger: each archived CR snapshot also
@@ -81,6 +82,11 @@ class HistoryCollector:
         # ``meta/{ns}/{cluster}/goodput.json`` — the time-loss breakdown
         # of a deleted cluster stays debuggable post-mortem.
         self.goodput = goodput
+        # Optional obs.IncidentEngine: incident bundles scoped to the
+        # archived entity persist under
+        # ``meta/{ns}/{cluster}/incidents.json`` so the post-mortem
+        # still names the top suspect after the cluster is gone.
+        self.incidents = incidents
         self._queue: "queue.Queue[Optional[Event]]" = queue.Queue()
         self._worker = threading.Thread(target=self._drain, daemon=True,
                                         name="history-collector")
@@ -146,6 +152,16 @@ class HistoryCollector:
             gdoc = self.goodput.to_doc(ev.kind, ns, name)
             if gdoc is not None:
                 self.storage.put_doc(f"meta/{ns}/{name}/goodput.json", gdoc)
+        if self.incidents is not None:
+            # Incident bundles scoped to this entity (any kind — the
+            # engine matches on namespace+name): refreshed on every
+            # archived snapshot, frozen by the DELETED pass.
+            bundles = self.incidents.for_entity(ns, name)
+            if bundles:
+                self.storage.put_doc(
+                    f"meta/{ns}/{name}/incidents.json",
+                    {"namespace": ns, "name": name,
+                     "incidents": bundles})
 
 
 class HistoryServer:
@@ -228,6 +244,12 @@ class HistoryServer:
                 f"meta/{parts[3]}/{parts[4]}/goodput.json")
             if doc is None:
                 return 404, {"message": "no goodput ledger archived"}, False
+            return 200, doc, False
+        if head == "incidents" and len(parts) == 5:
+            doc = self.storage.get_doc(
+                f"meta/{parts[3]}/{parts[4]}/incidents.json")
+            if doc is None:
+                return 404, {"message": "no incidents archived"}, False
             return 200, doc, False
         if head == "timeline" and len(parts) == 5:
             doc = self.storage.get_doc(_doc_key("TpuCluster", parts[3],

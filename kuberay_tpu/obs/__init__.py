@@ -35,10 +35,17 @@ production and in sim-violation forensics — from one artifact.
   skew, K-consecutive-step straggler verdicts, MFU attribution; splits
   the ledger's PRODUCTIVE into productive vs ``stalled-on-straggler``
   and serves ``/debug/steps[/<job>]``.
+- :mod:`kuberay_tpu.obs.incident`: the incident forensics engine —
+  any trigger (alert firing, sim invariant violation, upgrade
+  rollback, preemption notice, straggler verdict, quota reclaim)
+  becomes one windowed ``tpu-incident/v1`` bundle spanning every
+  mounted evidence surface, with a deterministic first-deviation /
+  causal-linkage root-cause ranking (``/debug/incidents``).
 """
 
 from kuberay_tpu.obs.alerts import AlertEngine, SloSpec, default_slos
 from kuberay_tpu.obs.flight import FlightRecorder
+from kuberay_tpu.obs.incident import INCIDENT_SCHEMA, IncidentEngine
 from kuberay_tpu.obs.goodput import (
     NOOP_TRANSITIONS,
     PHASES,
@@ -69,6 +76,8 @@ __all__ = [
     "AlertEngine",
     "FlightRecorder",
     "GoodputLedger",
+    "INCIDENT_SCHEMA",
+    "IncidentEngine",
     "NOOP_STEPS",
     "NOOP_TRACER",
     "NOOP_TRANSITIONS",

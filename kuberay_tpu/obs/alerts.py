@@ -124,7 +124,8 @@ class AlertEngine:
 
     def __init__(self, registry, specs: Optional[List[SloSpec]] = None,
                  clock=None, capacity: int = 256,
-                 audit=None, flight=None):
+                 audit=None, flight=None,
+                 state: Optional[Dict[str, Any]] = None):
         self.registry = registry
         self.specs = list(specs) if specs is not None else default_slos()
         self._now: Callable[[], float] = (clock.now if clock is not None
@@ -138,6 +139,44 @@ class AlertEngine:
         self._active: Dict[Tuple[str, Tuple, str], Dict[str, Any]] = {}
         self._ring: deque = deque(maxlen=capacity)
         self.evaluations = 0
+        if state:
+            self._restore(state)
+
+    # -- restart survival ---------------------------------------------------
+
+    def export_state(self) -> Dict[str, Any]:
+        """JSON-ready snapshot of the engine's evaluation state: the
+        cumulative sample windows, the active alerts (with their
+        original ``since``), and the fired/resolved ring.  A restarted
+        operator reconstructs the engine with ``state=`` so a
+        still-burning breach stays ONE firing alert — it must not
+        re-fire with a fresh identity just because the process moved."""
+        with self._lock:
+            return {
+                "samples": [
+                    {"spec": sn, "series": [list(p) for p in sk],
+                     "points": [list(pt) for pt in dq]}
+                    for (sn, sk), dq in self._samples.items()],
+                "active": [
+                    {"spec": sn, "series": [list(p) for p in sk],
+                     "window": w, "alert": dict(a)}
+                    for (sn, sk, w), a in self._active.items()],
+                "ring": [dict(a) for a in self._ring],
+                "evaluations": self.evaluations,
+            }
+
+    def _restore(self, state: Dict[str, Any]) -> None:
+        for s in state.get("samples", []):
+            key = (s["spec"], tuple(tuple(p) for p in s["series"]))
+            self._samples[key] = deque(
+                (tuple(pt) for pt in s["points"]), maxlen=2048)
+        for a in state.get("active", []):
+            key = (a["spec"], tuple(tuple(p) for p in a["series"]),
+                   a["window"])
+            self._active[key] = dict(a["alert"])
+        for a in state.get("ring", []):
+            self._ring.append(dict(a))
+        self.evaluations = int(state.get("evaluations", 0))
 
     # -- cumulative event counts per spec -----------------------------------
 

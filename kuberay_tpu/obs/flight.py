@@ -105,17 +105,23 @@ class FlightRecorder:
 
     def timeline(self, kind: str, namespace: str, name: str
                  ) -> List[Dict[str, Any]]:
+        """Snapshot of one object's ring.  Record dicts are COPIED, not
+        aliased: the debug/incident paths serialize these outside the
+        lock, and a concurrent ``record()`` (ring rotation mutates the
+        deque; attrs land on the dict at append time) must not race or
+        mutate an in-flight JSON response."""
         with self._lock:
             buf = self._buffers.get((kind, namespace, name))
-            return list(buf) if buf is not None else []
+            return [dict(r) for r in buf] if buf is not None else []
 
     def keys(self) -> List[Key]:
         with self._lock:
             return list(self._buffers)
 
     def to_dict(self) -> Dict[str, Any]:
-        """Whole-recorder snapshot (sim failure reports)."""
+        """Whole-recorder snapshot (sim failure reports).  Same copy
+        contract as :meth:`timeline`."""
         with self._lock:
-            items = [("%s/%s/%s" % k, list(buf))
+            items = [("%s/%s/%s" % k, [dict(r) for r in buf])
                      for k, buf in self._buffers.items()]
         return {key: records for key, records in items}

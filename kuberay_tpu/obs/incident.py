@@ -1,0 +1,634 @@
+"""Incident forensics engine: cross-signal capture + root-cause ranking.
+
+PRs 3/4/8/11/18 built six first-class evidence surfaces — traces,
+flight rings, the goodput ledger, burn-rate alerts, step telemetry,
+critical-path profiles — but they are islands: an alert links to one
+exemplar trace and everything else (the autoscaler DecisionAudit, the
+quota audit, upgrade verdicts, straggler verdicts, the profile diff)
+must be correlated by hand.  This module makes the correlation itself a
+subsystem: any trigger — an AlertEngine firing, a sim invariant
+violation, an upgrade rollback, a preemption notice, a straggler
+verdict, a quota reclaim — becomes one self-contained **incident
+bundle** (schema ``tpu-incident/v1``): a windowed snapshot of every
+mounted evidence surface scoped to the affected entity, plus a
+deterministic root-cause ranking.
+
+The ranker keeps a **first-deviation table**: for every signal the
+engine can see (per-backend gateway errors/sheds, upgrade audit
+verdicts, autoscale decisions, straggler verdicts, quota reclaim
+decisions, preemption-notice feeds, active SLO breaches) it remembers
+the first time that signal deviated.  When an incident opens, every
+deviation inside the lookback window becomes a suspect, scored by
+causal linkage to the trigger (shared entity, backend label, host,
+trace ids) and ordered by ``(-linkage, first_ts, kind, key)`` — ties
+broken lexicographically, so the same evidence always yields the same
+byte-identical verdict prose.
+
+Everything is observational: the engine reads the injectable clock and
+the mounted surfaces (registry snapshots, audit rings, logs), never the
+store or the rng — evaluating under simulation leaves the replay hash
+byte-identical (the same contract the tracer, the goodput ledger and
+the alert engine obey).  Incident ids are counters (``inc000001``), no
+wall clock or uuid anywhere, so a (scenario, seed) pair exports the
+same bundle bytes on every run.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+#: Bundle document schema tag.
+INCIDENT_SCHEMA = "tpu-incident/v1"
+
+#: Trigger kinds the engine opens bundles for (docs/observability.md).
+TRIGGERS = ("alert", "rollback", "straggler", "preemption",
+            "quota-reclaim", "violation")
+
+#: Suspects kept per bundle (ranked; the tail is noise by definition).
+MAX_SUSPECTS = 8
+
+#: Upgrade audit actions that open an incident (the ramp gave up).
+_ROLLBACK_ACTIONS = ("abort", "rollback")
+
+#: Quota decision reasons that open an incident (capacity was clawed
+#: back from a running workload).
+_RECLAIM_REASONS = ("reclaim-evict", "reclaim-noticed")
+
+
+def _series_key(series: Dict[str, Any]) -> str:
+    return ",".join(f"{k}={v}" for k, v in sorted(series.items()))
+
+
+def _entity_from_labels(labels: Dict[str, Any]
+                        ) -> Optional[Tuple[str, str, str]]:
+    if {"kind", "namespace", "name"} <= set(labels):
+        return (str(labels["kind"]), str(labels["namespace"]),
+                str(labels["name"]))
+    return None
+
+
+class IncidentEngine:
+    """Turns triggers into ranked, windowed incident bundles.
+
+    ``evaluate()`` is the single entry point — the operator calls it
+    from its background tick right after ``AlertEngine.evaluate()``
+    (passing the freshly fired alerts), the sim harness from its settle
+    loop.  ``observe_violations()`` feeds invariant violations at check
+    time.  All constructor surfaces are optional: an engine with only a
+    clock still produces bundles, just with thinner evidence.
+    """
+
+    def __init__(self, clock=None, *,
+                 registry=None, tracer=None, flight=None, goodput=None,
+                 alerts=None, steps=None, audit=None, quota=None,
+                 lookback_s: float = 120.0, capacity: int = 64):
+        self._now: Callable[[], float] = (clock.now if clock is not None
+                                          else time.time)
+        self.registry = registry
+        self.tracer = tracer
+        self.flight = flight
+        self.goodput = goodput
+        self.alerts = alerts
+        self.steps = steps
+        self.audit = audit
+        self.quota = quota
+        self.lookback_s = lookback_s
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._seq = 0
+        # id -> bundle, insertion-ordered; oldest evicted past capacity.
+        self._bundles: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+        # (kind, key) -> deviation entry; first_ts never moves once set.
+        self._deviations: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        # Trigger identities already bundled (dedupe across ticks).
+        self._seen_triggers: set = set()
+        # External deviation feeds: callables returning dict rows
+        # ({kind, key, ts, summary[, entity][, backend][, host]
+        #   [, trigger]}) — the sim harness mounts its preemption
+        # notice log this way.
+        self._feeds: List[Callable[[], List[Dict[str, Any]]]] = []
+        self.evaluations = 0
+        if self.registry is not None:
+            self.registry.describe(
+                "tpu_incidents_total",
+                "Incident bundles opened by the forensics engine, per "
+                "trigger kind")
+            self.registry.describe(
+                "tpu_incident_bundle_bytes",
+                "Serialized size in bytes of the most recently captured "
+                "incident bundle")
+
+    def add_feed(self, feed: Callable[[], List[Dict[str, Any]]]) -> None:
+        """Mount an external deviation feed (evaluated every tick)."""
+        self._feeds.append(feed)
+
+    # -- first-deviation table ---------------------------------------------
+
+    def _note_deviation(self, kind: str, key: str, ts: float,
+                        summary: str,
+                        entity: Optional[Tuple[str, str, str]] = None,
+                        backend: str = "", host: str = "",
+                        trace_ids: Optional[List[str]] = None) -> None:
+        dkey = (kind, key)
+        entry = self._deviations.get(dkey)
+        if entry is None:
+            self._deviations[dkey] = {
+                "kind": kind, "key": key, "first_ts": ts,
+                "summary": summary, "entity": entity,
+                "backend": backend, "host": host,
+                "trace_ids": set(trace_ids or ()),
+            }
+        else:
+            # First-deviation time is sticky; linkage evidence grows.
+            entry["trace_ids"].update(trace_ids or ())
+
+    def _scan_registry(self, now: float) -> None:
+        """Per-backend gateway error/shed series: the first evaluation
+        tick that sees a series non-zero is its deviation time — under
+        the sim's fixed settle cadence that instant is a pure function
+        of the fault plan."""
+        if self.registry is None:
+            return
+        for labels, value in self.registry.family_snapshot(
+                "tpu_gateway_backend_errors_total"):
+            if value <= 0:
+                continue
+            backend = str(labels.get("backend", ""))
+            self._note_deviation(
+                "backend-errors", backend or _series_key(labels), now,
+                f"gateway errors on backend {backend or '?'}",
+                backend=backend)
+        for labels, value in self.registry.family_snapshot(
+                "tpu_gateway_shed_total"):
+            if value <= 0:
+                continue
+            self._note_deviation(
+                "gateway-shed", _series_key(labels) or "all", now,
+                "gateway load shedding")
+
+    def _scan_audit(self) -> List[Dict[str, Any]]:
+        """Upgrade verdicts + applied scale decisions from the shared
+        DecisionAudit ring; returns the upgrade entries (oldest first)
+        for trigger detection."""
+        if self.audit is None:
+            return []
+        upgrades: List[Dict[str, Any]] = []
+        for entry in reversed(self.audit.to_list()):   # oldest first
+            if entry.get("kind") == "upgrade":
+                ns = entry.get("namespace", "default")
+                svc = entry.get("service", "")
+                # Entity linkage only, deliberately NO backend label:
+                # an upgrade verdict is a consequence of backend health,
+                # not a cause of it — when a rollback trigger carries
+                # the gating alert's backend, the per-backend error
+                # deviation (earlier first_ts, +2 backend) must outrank
+                # the ramp's own audit trail (+2 entity).
+                self._note_deviation(
+                    "upgrade", f"{ns}/{svc}:{entry.get('action', '')}",
+                    float(entry.get("ts", 0.0)),
+                    f"upgrade {entry.get('action', '')} on {svc} at "
+                    f"green weight {entry.get('green_weight', 0)}%",
+                    entity=("TpuService", ns, svc))
+                upgrades.append(entry)
+            elif entry.get("direction") in ("up", "down") \
+                    and entry.get("applied"):
+                ns = entry.get("namespace", "default")
+                cname = entry.get("cluster", "")
+                self._note_deviation(
+                    "autoscale",
+                    f"{ns}/{cname}:{entry.get('group', '')}"
+                    f":{entry.get('direction', '')}",
+                    float(entry.get("ts", 0.0)),
+                    f"autoscale {entry.get('direction', '')} on {cname} "
+                    f"group {entry.get('group', '')}",
+                    entity=("TpuCluster", ns, cname))
+        return upgrades
+
+    def _scan_steps(self) -> List[Dict[str, Any]]:
+        if self.steps is None:
+            return []
+        verdicts = self.steps.stragglers()
+        for v in verdicts:
+            job = str(v.get("job", ""))
+            host = str(v.get("host", ""))
+            ns, _, cname = job.partition("/")
+            self._note_deviation(
+                "straggler", f"{job}:{host}",
+                float(v.get("first_slow_ts") or 0.0),
+                f"host {host} straggling on {job} since step "
+                f"{v.get('first_slow_step')}",
+                entity=(("TpuCluster", ns, cname) if cname else None),
+                host=host)
+        return verdicts
+
+    def _scan_quota(self) -> List[Dict[str, Any]]:
+        if self.quota is None:
+            return []
+        decisions = list(reversed(
+            self.quota.debug_snapshot().get("decisions") or []))
+        for d in decisions:
+            # Deviations: evictions, denials, and reclaim notices (a
+            # notice is admitted=True/evict=False but still the first
+            # observable sign of capacity being clawed back).
+            if not (d.get("evict") or not d.get("admitted", True)
+                    or d.get("reason") in _RECLAIM_REASONS):
+                continue
+            ns = d.get("namespace", "default")
+            name = d.get("name", "")
+            kind = d.get("kind") or "TpuCluster"
+            self._note_deviation(
+                "quota", f"{ns}/{name}:{d.get('reason', '')}",
+                float(d.get("ts", 0.0)),
+                f"quota {d.get('reason', '')} of {name} "
+                f"({d.get('chips', 0)} chips, tenant "
+                f"{d.get('tenant', '')})",
+                entity=(kind, ns, name))
+        return decisions
+
+    def _scan_alerts(self) -> None:
+        if self.alerts is None:
+            return
+        for a in self.alerts.active():
+            series = a.get("series") or {}
+            ex = a.get("exemplar") or {}
+            self._note_deviation(
+                "slo-breach",
+                f"{a.get('name', '')}[{_series_key(series)}]/"
+                f"{a.get('window', '')}",
+                float(a.get("since", 0.0)),
+                f"SLO {a.get('name', '')} {a.get('window', '')}-window "
+                "burn",
+                entity=_entity_from_labels(series),
+                backend=str(series.get("backend", "")),
+                trace_ids=([str(ex["trace_id"])]
+                           if ex.get("trace_id") else None))
+
+    def _scan_feeds(self) -> List[Dict[str, Any]]:
+        rows: List[Dict[str, Any]] = []
+        for feed in self._feeds:
+            for row in feed():
+                entity = row.get("entity")
+                self._note_deviation(
+                    str(row["kind"]), str(row["key"]),
+                    float(row["ts"]), str(row.get("summary", "")),
+                    entity=(tuple(entity) if entity else None),
+                    backend=str(row.get("backend", "")),
+                    host=str(row.get("host", "")))
+                rows.append(row)
+        return rows
+
+    # -- ranking ------------------------------------------------------------
+
+    def _rank(self, trigger_ts: float,
+              entity: Optional[Tuple[str, str, str]],
+              backend: str, host: str,
+              trace_ids: set) -> List[Dict[str, Any]]:
+        start = trigger_ts - self.lookback_s
+        suspects = []
+        for entry in self._deviations.values():
+            ts = entry["first_ts"]
+            if ts < start or ts > trigger_ts:
+                continue
+            linkage = 0
+            if entity is not None and entry["entity"] == entity:
+                linkage += 2
+            if backend and entry["backend"] == backend:
+                linkage += 2
+            if trace_ids and entry["trace_ids"] & trace_ids:
+                linkage += 1
+            if host and entry["host"] == host:
+                linkage += 1
+            suspects.append((linkage, entry))
+        suspects.sort(key=lambda le: (-le[0], le[1]["first_ts"],
+                                      le[1]["kind"], le[1]["key"]))
+        out = []
+        for linkage, entry in suspects[:MAX_SUSPECTS]:
+            out.append({
+                "kind": entry["kind"], "key": entry["key"],
+                "first_ts": round(entry["first_ts"], 3),
+                "lead_s": round(trigger_ts - entry["first_ts"], 3),
+                "linkage": linkage,
+                "summary": entry["summary"],
+                "entity": (list(entry["entity"])
+                           if entry["entity"] else None),
+                "backend": entry["backend"], "host": entry["host"],
+                "trace_ids": sorted(entry["trace_ids"]),
+            })
+        return out
+
+    @staticmethod
+    def _verdict(trigger: str, suspects: List[Dict[str, Any]],
+                 lookback_s: float) -> str:
+        if not suspects:
+            return (f"no correlated deviation found in the "
+                    f"{lookback_s:.0f}s lookback window")
+        top = suspects[0]
+        return (f"{top['summary']} began {top['lead_s']:.1f}s before "
+                f"{trigger}; {top['kind']} {top['key']} is the top "
+                f"suspect")
+
+    # -- evidence capture ---------------------------------------------------
+
+    def _windowed(self, rows: List[Dict[str, Any]], start: float,
+                  end: float, ts_field: str = "ts"
+                  ) -> List[Dict[str, Any]]:
+        return [copy.deepcopy(r) for r in rows
+                if start <= float(r.get(ts_field, 0.0) or 0.0) <= end]
+
+    def _capture_traces(self, trace_ids: set, start: float,
+                        end: float) -> List[Dict[str, Any]]:
+        if self.tracer is None:
+            return []
+        from kuberay_tpu.obs.trace import span_tree
+        ids = set(trace_ids)
+        if not ids:
+            # Fallback exemplar: the latest closed serve-request (or any
+            # root) span inside the window.
+            spans = self.tracer.export()
+            best = None
+            for s in spans:
+                if s["end"] is None or not (start <= s["start"] <= end):
+                    continue
+                if best is None or (s["name"] == "serve-request",
+                                    s["start"], s["span_id"]) > \
+                        (best["name"] == "serve-request", best["start"],
+                         best["span_id"]):
+                    best = s
+            if best is not None:
+                ids = {best["trace_id"]}
+        return [{"trace_id": tid,
+                 "tree": span_tree(self.tracer.export(tid))}
+                for tid in sorted(ids)]
+
+    def _capture_profile_diff(self, start: float) -> Optional[Dict[str, Any]]:
+        """Noise-gated critical-path diff: the incident window's spans
+        vs the pre-incident baseline (everything closed before the
+        window opened)."""
+        if self.tracer is None:
+            return None
+        from kuberay_tpu.obs.profile import diff_profiles, profile_spans
+        spans = [s for s in self.tracer.export() if s["end"] is not None]
+        base = [s for s in spans if s["end"] <= start]
+        window = [s for s in spans if s["end"] > start]
+        if not base or not window:
+            return None
+        base_prof = profile_spans(base)
+        win_prof = profile_spans(window)
+        if not base_prof.get("shapes") or not win_prof.get("shapes"):
+            return None
+        return diff_profiles(base_prof, win_prof)
+
+    def _capture(self, trigger: str, trigger_ts: float, now: float,
+                 entity: Optional[Tuple[str, str, str]], detail: str,
+                 alert: Optional[Dict[str, Any]] = None,
+                 backend: str = "", host: str = "",
+                 trace_ids: Optional[set] = None) -> Dict[str, Any]:
+        start = trigger_ts - self.lookback_s
+        end = max(trigger_ts, now)
+        tids = set(trace_ids or ())
+        suspects = self._rank(trigger_ts, entity, backend, host, tids)
+        for s in suspects:
+            tids.update(s["trace_ids"])
+        evidence: Dict[str, Any] = {}
+        if self.alerts is not None:
+            doc = self.alerts.to_dict()
+            evidence["alerts"] = {
+                "active": copy.deepcopy(doc["active"]),
+                "ring": self._windowed(doc["ring"], start, end, "since"),
+            }
+        traces = self._capture_traces(tids, start, end)
+        if traces:
+            evidence["traces"] = traces
+        if self.flight is not None and entity is not None:
+            evidence["flight"] = {
+                "key": "%s/%s/%s" % entity,
+                "records": [r for r in self.flight.timeline(*entity)
+                            if start <= r.get("ts", 0.0) <= end],
+            }
+        if self.goodput is not None and entity is not None:
+            roll = self.goodput.rollup(*entity)
+            if roll is not None:
+                evidence["goodput"] = {
+                    "intervals": [
+                        iv for iv in self.goodput.intervals(*entity)
+                        if iv["end"] is None or iv["end"] >= start],
+                    "rollup": roll,
+                }
+        if self.audit is not None:
+            evidence["autoscaler"] = self._windowed(
+                self.audit.to_list(), start, end)
+        if self.quota is not None:
+            evidence["quota"] = self._windowed(
+                self.quota.debug_snapshot().get("decisions") or [],
+                start, end)
+        if self.steps is not None:
+            evidence["steps"] = [
+                copy.deepcopy(v) for v in self.steps.stragglers()
+                if start <= float(v.get("first_slow_ts") or 0.0) <= end]
+        diff = self._capture_profile_diff(start)
+        if diff is not None:
+            evidence["profile_diff"] = diff
+        self._seq += 1
+        bundle: Dict[str, Any] = {
+            "schema": INCIDENT_SCHEMA,
+            "id": f"inc{self._seq:06d}",
+            "trigger": trigger,
+            "ts": round(trigger_ts, 3),
+            "window": {"start": round(start, 3), "end": round(end, 3)},
+            "entity": ({"kind": entity[0], "namespace": entity[1],
+                        "name": entity[2]} if entity else None),
+            "detail": detail,
+            "suspects": suspects,
+            "verdict": self._verdict(trigger, suspects, self.lookback_s),
+            "evidence": evidence,
+        }
+        if alert is not None:
+            bundle["alert"] = copy.deepcopy(alert)
+        self._bundles[bundle["id"]] = bundle
+        while len(self._bundles) > self.capacity:
+            self._bundles.popitem(last=False)
+        return bundle
+
+    def _emit_metrics(self, opened: List[Dict[str, Any]]) -> None:
+        """Counter + size gauge for freshly opened bundles; called
+        OUTSIDE the engine lock (serialization is I/O-shaped work the
+        lock must not hold)."""
+        if self.registry is None or not opened:
+            return
+        for bundle in opened:
+            self.registry.inc("tpu_incidents_total",
+                              {"trigger": bundle["trigger"]})
+        self.registry.set_gauge(
+            "tpu_incident_bundle_bytes",
+            float(len(json.dumps(opened[-1], sort_keys=True))))
+
+    # -- the tick -----------------------------------------------------------
+
+    def evaluate(self, fired: Optional[List[Dict[str, Any]]] = None
+                 ) -> List[Dict[str, Any]]:
+        """One pass: refresh the first-deviation table from every
+        mounted surface, then open a bundle for each unseen native
+        trigger (upgrade rollback/abort, straggler verdict, preemption
+        feed row, quota reclaim) and each freshly fired alert.  Returns
+        the bundles opened this tick."""
+        now = self._now()
+        opened: List[Dict[str, Any]] = []
+        with self._lock:
+            self.evaluations += 1
+            self._scan_registry(now)
+            upgrades = self._scan_audit()
+            verdicts = self._scan_steps()
+            feed_rows = self._scan_feeds()
+            decisions = self._scan_quota()
+            self._scan_alerts()
+            for entry in upgrades:
+                if entry.get("action") not in _ROLLBACK_ACTIONS:
+                    continue
+                ident = ("rollback", round(float(entry.get("ts", 0.0)), 6),
+                         entry.get("service", ""), entry.get("action", ""))
+                if ident in self._seen_triggers:
+                    continue
+                self._seen_triggers.add(ident)
+                ns = entry.get("namespace", "default")
+                svc = entry.get("service", "")
+                alert = entry.get("alert")
+                backend = str(((alert or {}).get("series") or {})
+                              .get("backend", ""))
+                ex = (alert or {}).get("exemplar") or {}
+                opened.append(self._capture(
+                    "rollback", float(entry.get("ts", 0.0)), now,
+                    ("TpuService", ns, svc),
+                    f"upgrade {entry.get('action', '')} on {svc}: "
+                    f"{entry.get('reason', '')}",
+                    alert=alert, backend=backend,
+                    trace_ids=({str(ex["trace_id"])}
+                               if ex.get("trace_id") else None)))
+            for v in verdicts:
+                ident = ("straggler", v.get("job", ""),
+                         v.get("host", ""),
+                         round(float(v.get("first_slow_ts") or 0.0), 6))
+                if ident in self._seen_triggers:
+                    continue
+                self._seen_triggers.add(ident)
+                job = str(v.get("job", ""))
+                ns, _, cname = job.partition("/")
+                opened.append(self._capture(
+                    "straggler", float(v.get("first_slow_ts") or 0.0),
+                    now, (("TpuCluster", ns, cname) if cname else None),
+                    f"straggler verdict: host {v.get('host', '')} on "
+                    f"{job} since step {v.get('first_slow_step')}",
+                    host=str(v.get("host", ""))))
+            for row in feed_rows:
+                if not row.get("trigger"):
+                    continue
+                ident = (str(row["kind"]), str(row["key"]),
+                         round(float(row["ts"]), 6))
+                if ident in self._seen_triggers:
+                    continue
+                self._seen_triggers.add(ident)
+                entity = row.get("entity")
+                opened.append(self._capture(
+                    "preemption", float(row["ts"]), now,
+                    (tuple(entity) if entity else None),
+                    str(row.get("summary", "")),
+                    host=str(row.get("host", ""))))
+            for d in decisions:
+                if d.get("reason") not in _RECLAIM_REASONS:
+                    continue
+                ident = ("quota-reclaim",
+                         round(float(d.get("ts", 0.0)), 6),
+                         d.get("name", ""), d.get("reason", ""))
+                if ident in self._seen_triggers:
+                    continue
+                self._seen_triggers.add(ident)
+                ns = d.get("namespace", "default")
+                kind = d.get("kind") or "TpuCluster"
+                opened.append(self._capture(
+                    "quota-reclaim", float(d.get("ts", 0.0)), now,
+                    (kind, ns, d.get("name", "")),
+                    f"quota {d.get('reason', '')} of "
+                    f"{d.get('name', '')} (tenant {d.get('tenant', '')},"
+                    f" {d.get('chips', 0)} chips)"))
+            for a in (fired or []):
+                series = a.get("series") or {}
+                ident = ("alert", a.get("name", ""),
+                         a.get("window", ""), _series_key(series),
+                         round(float(a.get("since", 0.0)), 6))
+                if ident in self._seen_triggers:
+                    continue
+                self._seen_triggers.add(ident)
+                ex = a.get("exemplar") or {}
+                opened.append(self._capture(
+                    "alert", float(a.get("since", now)), now,
+                    _entity_from_labels(series),
+                    f"SLO {a.get('name', '')} {a.get('window', '')}"
+                    f"-window burn {a.get('burn_rate', 0)}x",
+                    alert=a, backend=str(series.get("backend", "")),
+                    trace_ids=({str(ex["trace_id"])}
+                               if ex.get("trace_id") else None)))
+        self._emit_metrics(opened)
+        return opened
+
+    def observe_violations(self, violations) -> List[Dict[str, Any]]:
+        """Sim seam: each invariant violation opens a bundle (deduped on
+        its rendered text, so re-checks don't double-report)."""
+        now = self._now()
+        opened: List[Dict[str, Any]] = []
+        with self._lock:
+            for v in violations:
+                ident = ("violation", str(v))
+                if ident in self._seen_triggers:
+                    continue
+                self._seen_triggers.add(ident)
+                opened.append(self._capture(
+                    "violation", now, now, None, str(v)))
+        self._emit_metrics(opened)
+        return opened
+
+    # -- querying -----------------------------------------------------------
+
+    def get(self, incident_id: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            b = self._bundles.get(incident_id)
+            return copy.deepcopy(b) if b is not None else None
+
+    def bundles(self) -> List[Dict[str, Any]]:
+        """Full bundles, newest first."""
+        with self._lock:
+            return [copy.deepcopy(b)
+                    for b in reversed(self._bundles.values())]
+
+    def for_entity(self, namespace: str, name: str
+                   ) -> List[Dict[str, Any]]:
+        """Bundles whose entity matches (namespace, name), any kind,
+        oldest first — the history archive document body."""
+        with self._lock:
+            return [copy.deepcopy(b) for b in self._bundles.values()
+                    if b["entity"] is not None
+                    and b["entity"]["namespace"] == namespace
+                    and b["entity"]["name"] == name]
+
+    def to_dict(self) -> Dict[str, Any]:
+        """The /debug/incidents index: one summary row per bundle,
+        newest first."""
+        with self._lock:
+            rows = []
+            for b in reversed(self._bundles.values()):
+                top = b["suspects"][0] if b["suspects"] else None
+                rows.append({
+                    "id": b["id"], "trigger": b["trigger"],
+                    "ts": b["ts"], "entity": b["entity"],
+                    "detail": b["detail"],
+                    "top_suspect": ({"kind": top["kind"],
+                                     "key": top["key"],
+                                     "lead_s": top["lead_s"]}
+                                    if top else None),
+                    "verdict": b["verdict"],
+                })
+            return {"incidents": rows, "count": len(rows),
+                    "evaluations": self.evaluations}

@@ -169,6 +169,16 @@ class Operator:
         self.alerts = AlertEngine(self.metrics.registry,
                                   audit=self.autoscaler_audit,
                                   flight=self.flight)
+        # Incident forensics (obs/incident.py): every mounted evidence
+        # surface behind one trigger->bundle engine, evaluated right
+        # after the alert tick; served at /debug/incidents and archived
+        # per-entity by the history collector.
+        from kuberay_tpu.obs import IncidentEngine
+        self.incidents = IncidentEngine(
+            registry=self.metrics.registry, tracer=self.tracer,
+            flight=self.flight, goodput=self.goodput, alerts=self.alerts,
+            steps=self.steps, audit=self.autoscaler_audit,
+            quota=self.quota)
         # ``slo_signal`` (controlplane/slo.ServeSloSignal): embedders
         # serving traffic in-process hand the autoscaler their serve
         # TTFT/queue-depth SLO signal; None keeps the resource-only path.
@@ -230,7 +240,7 @@ class Operator:
             from kuberay_tpu.history.storage import backend_from_url
             self.history_collector = HistoryCollector(
                 self.store, backend_from_url(self.config.historyArchiveURL),
-                goodput=self.goodput)
+                goodput=self.goodput, incidents=self.incidents)
         self._stop = threading.Event()
         self.apiserver = None
         self.api_url = ""
@@ -281,7 +291,7 @@ class Operator:
             history=history, tracer=self.tracer, flight=self.flight,
             goodput=self.goodput, autoscaler=self.autoscaler_audit,
             alerts=self.alerts, steps=self.steps, quota=self.quota,
-            profiler=self.profiler)
+            profiler=self.profiler, incidents=self.incidents)
         if leader_election and shard_leases and self.manager.shards > 1:
             from kuberay_tpu.controlplane.leader import ShardLeaseElector
             # Start unowned: every pool paused until its lease is won.
@@ -345,7 +355,8 @@ class Operator:
                             (C.KIND_CRONJOB, md["namespace"], md["name"]))
                 if self.kubelet is not None:
                     self.kubelet.step()
-                self.alerts.evaluate()
+                fired = self.alerts.evaluate()
+                self.incidents.evaluate(fired)
                 self._sync_trace_dropped()
                 self._gc_events()
             except Exception:

@@ -105,6 +105,19 @@ def main(argv=None) -> int:
                              "microscope (kuberay_tpu.obs.steps) on the "
                              "run's synthetic heartbeats; the replay "
                              "hash is unaffected")
+    parser.add_argument("--incidents", action="store_true",
+                        help="mount the incident forensics engine "
+                             "(kuberay_tpu.obs.incident): rollbacks, "
+                             "preemption notices, straggler verdicts, "
+                             "quota reclaims and invariant violations "
+                             "become ranked tpu-incident/v1 bundles; "
+                             "the replay hash is unaffected")
+    parser.add_argument("--incidents-out", default="",
+                        help="write the run's incident bundles "
+                             "(tpu-incident-export/v1) to this JSON "
+                             "file; implies --incidents.  Byte-identical "
+                             "across re-runs of a seed.  With a seed "
+                             "range, the last run wins")
     parser.add_argument("--json", action="store_true",
                         help="one JSON result object per run on stdout")
     parser.add_argument("--list-scenarios", action="store_true")
@@ -137,6 +150,7 @@ def main(argv=None) -> int:
         return 2
 
     trace = args.trace or bool(args.trace_out) or bool(args.profile_out)
+    incidents = args.incidents or bool(args.incidents_out)
     failed = False
     for name in names:
         scenario = get_scenario(name)
@@ -144,11 +158,13 @@ def main(argv=None) -> int:
         for seed in seeds:
             with SimHarness(seed, scenario=scenario, trace=trace,
                             alerts=args.alerts,
-                            steps=args.step_telemetry) as h:
+                            steps=args.step_telemetry,
+                            incidents=incidents) as h:
                 result = h.run(steps)
                 journal = list(h.journal)
                 trace_doc = h.export_trace() if trace else None
                 profile_doc = h.export_profile() if trace else None
+                incident_doc = h.export_incidents() if incidents else None
             if args.trace_out and trace_doc is not None:
                 with open(args.trace_out, "w") as f:
                     json.dump(trace_doc, f, sort_keys=True)
@@ -161,6 +177,11 @@ def main(argv=None) -> int:
                 windows = sum(s["traces"] for s in shapes.values())
                 print(f"profile: {windows} windows across "
                       f"{len(shapes)} shapes -> {args.profile_out}")
+            if args.incidents_out and incident_doc is not None:
+                with open(args.incidents_out, "w") as f:
+                    json.dump(incident_doc, f, sort_keys=True)
+                print(f"incidents: {len(incident_doc['incidents'])} "
+                      f"bundles -> {args.incidents_out}")
             if args.json:
                 print(json.dumps({
                     "scenario": result.scenario, "seed": result.seed,

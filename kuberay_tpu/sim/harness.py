@@ -134,6 +134,7 @@ class SimHarness:
                  goodput: bool = False,
                  alerts: bool = False,
                  steps: bool = False,
+                 incidents: bool = False,
                  shards: Optional[int] = None):
         self.seed = seed
         self.scenario = scenario
@@ -283,12 +284,20 @@ class SimHarness:
         # when a scenario mounts it; vacuously healthy otherwise.
         self.upgrade_gate = BurnRateGate(self.metrics.registry,
                                          clock=self.clock)
+        # Upgrade/scale decision audit (autoscaler.DecisionAudit),
+        # mounted UNCONDITIONALLY: it is ring-append-only (clock reads,
+        # no store writes, no rng) so journal hashes are unchanged, and
+        # the incident engine's rollback triggers need the ring whether
+        # or not bundles are being captured this run.
+        from kuberay_tpu.controlplane.autoscaler import DecisionAudit
+        self.audit = DecisionAudit(clock=self.clock)
         self.service_controller = TpuServiceController(
             self.store, recorder=self.recorder,
             client_provider=lambda cname, status: provider(cname, status),
             tracer=self.tracer, transitions=transitions,
             clock=self.clock, upgrade_gate=self.upgrade_gate,
-            flight=self.flight, metrics_registry=self.metrics.registry)
+            flight=self.flight, metrics_registry=self.metrics.registry,
+            audit=self.audit)
         self.cronjob_controller = TpuCronJobController(
             self.store, recorder=self.recorder, tracer=self.tracer,
             scheduler=gang)
@@ -343,6 +352,45 @@ class SimHarness:
         self._slow_hosts: Dict[tuple, int] = {}
         self._train_step_idx: Dict[tuple, int] = {}
         self.slow_host_log: List[Dict[str, Any]] = []
+        # Preemption-notice ground truth (every notice delivered, fault-
+        # injected or scripted) — the incident engine's preemption feed.
+        # Maintained whether or not the engine is mounted, so the rng
+        # stream and journal hash cannot depend on the incidents flag.
+        self.notice_log: List[Dict[str, Any]] = []
+        # Scenario-scripted dead backends: the serve pump treats these
+        # services as unable to serve even with ready rings (a dead
+        # green build whose pods run but whose server misbehaves).
+        # Empty for every classic scenario, so their pump behavior —
+        # and journal hashes — are unchanged.
+        self.dead_backends: set = set()
+        # Container images whose serve endpoint is dead on arrival: any
+        # backend whose backing cluster runs one of these images is
+        # unserveable regardless of ring readiness (the dead-green-
+        # upgrade drill — the bad BUILD is the fault, so the marker
+        # follows the image through whatever cluster the upgrade
+        # controller mints for it).  Empty by default: hashes unchanged.
+        self.dead_images: set = set()
+        # Incident forensics engine (obs/incident.py): observational
+        # only — it reads the virtual clock and the mounted evidence
+        # surfaces, never the store or rng, so the journal hash is
+        # byte-identical with the engine on or off (the invariance
+        # contract in tests/test_incident.py).
+        self.incidents = None
+        if incidents:
+            from kuberay_tpu.obs import IncidentEngine
+            self.incidents = IncidentEngine(
+                clock=self.clock, registry=self.metrics.registry,
+                tracer=(self.tracer if trace else None),
+                flight=self.flight, goodput=self.goodput,
+                alerts=self.alerts, steps=self.steps,
+                audit=self.audit, quota=self.quota)
+            self.incidents.add_feed(lambda: [
+                {"kind": "preemption-notice",
+                 "key": f"{e['ns']}/{e['slice']}",
+                 "ts": e["ts"], "trigger": True,
+                 "summary": (f"preemption notice on slice {e['slice']} "
+                             f"(kill deadline {e['deadline']:.1f}s)")}
+                for e in self.notice_log])
 
         if scenario is not None:
             with self.plan.suspended():
@@ -438,6 +486,21 @@ class SimHarness:
             "journal_hash": self.journal_hash(),
         })
 
+    def export_incidents(self) -> Dict[str, Any]:
+        """Every incident bundle the run opened, oldest first, under the
+        run's identity (scenario/seed/journal hash).  With the virtual
+        clock, counter ids and lexicographic tie-breaks the document is
+        byte-identical across re-runs of a (scenario, seed) pair —
+        tools/sim_smoke.sh ``cmp``s two exports to hold that line."""
+        return {
+            "schema": "tpu-incident-export/v1",
+            "scenario": self.scenario.name if self.scenario else "adhoc",
+            "seed": self.seed,
+            "journal_hash": self.journal_hash(),
+            "incidents": (list(reversed(self.incidents.bundles()))
+                          if self.incidents is not None else []),
+        }
+
     # -- convergence -------------------------------------------------------
 
     def settle(self, horizon: Optional[float] = None) -> int:
@@ -476,8 +539,10 @@ class SimHarness:
             self._pump_serve_traffic()
             swept = self._gc_orphans()
             self._drain_journal()
-            if self.alerts is not None:
-                self.alerts.evaluate()
+            fired = (self.alerts.evaluate()
+                     if self.alerts is not None else None)
+            if self.incidents is not None:
+                self.incidents.evaluate(fired)
             if len(self.journal) > journal_before or due or drove or swept \
                     or killed or parted:
                 resynced = False
@@ -567,6 +632,24 @@ class SimHarness:
             if serve_service_name(cname) == svc_name:
                 return cname
         return ""
+
+    def _cluster_runs_dead_image(self, ns: str, cname: str) -> bool:
+        """True when any container image of the cluster's template is in
+        ``dead_images`` (the dead-on-arrival build marker)."""
+        if not self.dead_images:
+            return False
+        obj = self.store.try_get(C.KIND_CLUSTER, cname, ns)
+        if obj is None:
+            return False
+        spec = obj.get("spec") or {}
+        groups = [spec.get("headGroupSpec") or {}] + \
+            list(spec.get("workerGroupSpecs") or [])
+        for g in groups:
+            tmpl = g.get("template") or {}
+            for cont in (tmpl.get("spec") or {}).get("containers", []):
+                if cont.get("image") in self.dead_images:
+                    return True
+        return False
 
     def _whole_ready_rings(self, ns: str, cname: str) -> int:
         """Fully-Ready ICI rings of a cluster right now: slices whose
@@ -684,7 +767,9 @@ class SimHarness:
             bsvc = b.get("service", "")
             cname = self._cluster_for_serve_service(ns, bsvc)
             serveable[bsvc] = bool(cname) and \
-                self._whole_ready_rings(ns, cname) > 0
+                self._whole_ready_rings(ns, cname) > 0 and \
+                bsvc not in self.dead_backends and \
+                not self._cluster_runs_dead_image(ns, cname)
         total_w = sum(int(b.get("weight", 0) or 0) for b in backends)
         sent = failed = failovers = 0
         if total_w > 0:
@@ -883,6 +968,9 @@ class SimHarness:
                 continue
         if stamped:
             self._pending_kills.append((deadline, ns, sname))
+            self.notice_log.append({
+                "ts": round(self.clock.now(), 3), "ns": ns,
+                "slice": sname, "deadline": round(deadline, 3)})
         return stamped
 
     def _fire_due_kills(self) -> int:
@@ -1092,6 +1180,8 @@ class SimHarness:
                 "convergence", f"step {self._step}",
                 f"settle did not quiesce within {self.max_settle_rounds} "
                 "rounds"))
+        if self.incidents is not None and violations:
+            self.incidents.observe_violations(violations)
         return violations
 
     def step(self) -> List[Violation]:

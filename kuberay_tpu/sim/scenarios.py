@@ -265,6 +265,80 @@ class _UpgradeUnderFire:
 
 
 # ---------------------------------------------------------------------------
+# dead green upgrade: a known-bad build behind a clean ramp (incident drill)
+# ---------------------------------------------------------------------------
+
+@scenario(
+    "dead-green-upgrade",
+    "an incremental upgrade whose green build is dead on arrival: rings "
+    "come up, weights ramp, every green-routed request errors until the "
+    "burn-rate gate rolls the ramp back — the forensics drill where the "
+    "injected fault IS the new build, nothing else",
+    # Zero ambient chaos by design: the incident ranker's hard gate is
+    # that the top suspect names the dead green backend, so the drill
+    # must not hand it a competing plausible cause.
+    profile={F.POD_KILL: 0.0, F.PREEMPTION_NOTICE: 0.0, F.SLOW_START: 0.0,
+             F.STORE_CONFLICT: 0.0, F.WATCH_DROP: 0.0, F.WATCH_DUP: 0.0,
+             F.WATCH_DELAY: 0.0, F.SLICE_DRAIN: 0.0, F.DELETE_RACE: 0.0,
+             F.LEADER_FAILOVER: 0.0},
+    serve_traffic=True,
+    extra_gates={"TpuServiceIncrementalUpgrade": True})
+class _DeadGreenUpgrade:
+    #: The known-bad build.  Marked dead in the harness pump BEFORE the
+    #: bump lands, so whatever green cluster the upgrade controller
+    #: mints for it is unserveable from its first routed request.
+    DEAD_IMAGE = "tpu-runtime:v2-dead"
+
+    def setup(self, h):
+        h.dead_images = {self.DEAD_IMAGE}
+        cluster_spec = make_cluster_obj("tmpl", accelerator="v5p",
+                                        topology="2x2x2", replicas=2,
+                                        max_replicas=4)["spec"]
+        h.store.create({
+            "apiVersion": C.API_VERSION, "kind": C.KIND_SERVICE,
+            "metadata": {"name": "fleet"},
+            "spec": {
+                "clusterSpec": cluster_spec,
+                "serveConfig": {"applications": [{"name": "app",
+                                                  "rev": 0}]},
+                "upgradeStrategy":
+                    "NewClusterWithIncrementalUpgrade",
+                "upgradeOptions": {
+                    "stepSizePercent": 25, "intervalSeconds": 5,
+                    "maxRollbacks": 1, "holdSeconds": 10,
+                    "waveSlices": 1, "prewarmPrompts": 4,
+                    "drainTimeoutSeconds": 15,
+                },
+                "serviceUnhealthySecondThreshold": 20,
+                "deploymentUnhealthySecondThreshold": 20,
+                "clusterDeletionDelaySeconds": 5,
+            },
+            "status": {},
+        })
+
+    def tick(self, h, step):
+        if step != 2:
+            return
+        svc = h.store.try_get(C.KIND_SERVICE, "fleet")
+        if svc is None:
+            return
+        # One image bump to the dead build: its pods start fine
+        # (readiness is not the fault) but every request the pump routes
+        # to it errors on the green series (then fails over to blue — no
+        # client-visible failure) until the burn-rate gate trips.
+        for g in ([svc["spec"]["clusterSpec"].get("headGroupSpec", {})]
+                  + svc["spec"]["clusterSpec"].get("workerGroupSpecs",
+                                                   [])):
+            tmpl = g.get("template", {})
+            for cont in tmpl.get("spec", {}).get("containers", []):
+                cont["image"] = self.DEAD_IMAGE
+        try:
+            h.store.update(svc)
+        except Conflict:
+            return
+
+
+# ---------------------------------------------------------------------------
 # leader failover mid-reconcile
 # ---------------------------------------------------------------------------
 

@@ -204,6 +204,55 @@ def test_alert_ring_is_bounded():
 
 
 # ---------------------------------------------------------------------------
+# restart survival
+# ---------------------------------------------------------------------------
+
+def test_alert_identity_survives_engine_reconstruction():
+    """A still-burning breach must stay ONE firing alert across an
+    operator restart: reconstructing the engine from ``export_state()``
+    re-fires nothing, keeps the original ``since``, and still resolves
+    at the exact instant the bad events age out of the window."""
+    clock = VirtualClock(start=0.0)
+    reg = MetricsRegistry()
+    eng = AlertEngine(reg, specs=[_ttft_spec()], clock=clock)
+    _observe_ttft(reg, 0.1, 6)
+    eng.evaluate()                                   # t=0 baseline
+    clock.advance(10.0)
+    _observe_ttft(reg, 1.0, 5)                       # t=10: the breach
+    fired = eng.evaluate()
+    assert {a["window"] for a in fired} == {"fast", "slow"}
+
+    state = eng.export_state()
+    json.dumps(state)                                # JSON-ready
+    # "Restart": same registry (cumulative series survive scrape
+    # targets), fresh engine fed the exported state.
+    eng2 = AlertEngine(reg, specs=[_ttft_spec()], clock=clock,
+                       state=state)
+    clock.advance(10.0)                              # t=20: still burning
+    assert eng2.evaluate() == []                     # NO re-fire
+    active = [a for a in eng2.active() if a["window"] == "fast"]
+    assert len(active) == 1
+    assert active[0]["since"] == 10.0                # original identity
+    assert eng2.evaluations == 3                     # counter carried over
+
+    clock.advance(380.0)                             # t=400: aged out
+    assert eng2.evaluate() == []
+    assert "fast" not in {a["window"] for a in eng2.active()}
+    resolved = [r for r in eng2.to_dict()["ring"]
+                if r["state"] == "resolved" and r["window"] == "fast"]
+    assert len(resolved) == 1
+    assert resolved[0]["since"] == 10.0              # pre-restart birth
+    assert resolved[0]["resolved_at"] == 400.0
+
+    # The contrast: a reconstruction WITHOUT state forgets the breach
+    # ever happened — no active alert, no history — which is exactly
+    # the amnesia the state handoff exists to prevent.
+    eng3 = AlertEngine(reg, specs=[_ttft_spec()], clock=clock)
+    eng3.evaluate()                                  # baseline sample only
+    assert eng3.active() == [] and eng3.to_dict()["ring"] == []
+
+
+# ---------------------------------------------------------------------------
 # serving surface
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,477 @@
+"""Incident forensics engine gate (kuberay_tpu.obs.incident): scripted
+triggers open windowed, ranked bundles; the first-deviation ranker is
+deterministic (ties lexicographic, byte-identical verdicts across
+independent builds); every trigger kind fires from its surface; the
+known-cause drills produce bundles whose TOP suspect names the injected
+fault; the export is byte-identical across re-runs and the journal hash
+is invariant to the engine being mounted; /debug/incidents serves with
+the shared ?limit contract; archived bundles round-trip byte-for-byte
+through the history replay API; and the flight recorder's timeline
+snapshots survive a concurrent-writer hammer (the incident capture path
+serializes them outside the lock).
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kuberay_tpu.controlplane.store import ObjectStore
+from kuberay_tpu.obs.flight import FlightRecorder
+from kuberay_tpu.obs.incident import INCIDENT_SCHEMA, IncidentEngine
+from kuberay_tpu.sim.clock import VirtualClock
+from kuberay_tpu.sim.harness import SimHarness
+from kuberay_tpu.sim.scenarios import get_scenario
+from kuberay_tpu.utils.metrics import MetricsRegistry
+
+
+class _Audit:
+    """DecisionAudit stand-in: newest-first ring, like the real one."""
+
+    def __init__(self):
+        self.entries = []
+
+    def to_list(self):
+        return list(self.entries)
+
+
+class _Steps:
+    def __init__(self, verdicts):
+        self._verdicts = verdicts
+
+    def stragglers(self):
+        return [dict(v) for v in self._verdicts]
+
+
+class _Quota:
+    def __init__(self, decisions):
+        self._decisions = decisions
+
+    def debug_snapshot(self):
+        return {"decisions": [dict(d) for d in self._decisions]}
+
+
+# ---------------------------------------------------------------------------
+# trigger matrix + ranking, scripted
+# ---------------------------------------------------------------------------
+
+def test_alert_trigger_ranks_backend_errors_top_and_dedupes():
+    """A fired alert opens exactly one bundle; the backend whose error
+    series deviated FIRST outranks everything, linked by the backend
+    label; re-delivering the same firing alert opens nothing."""
+    clock = VirtualClock(start=0.0)
+    reg = MetricsRegistry()
+    eng = IncidentEngine(clock=clock, registry=reg)
+    reg.inc("tpu_gateway_backend_errors_total", {"backend": "green-svc"})
+    assert eng.evaluate() == []                      # t=0: deviation noted
+    clock.advance(30.0)
+    alert = {"name": "serve-availability", "window": "fast",
+             "since": 30.0, "burn_rate": 100.0, "state": "firing",
+             "series": {"backend": "green-svc"},
+             "exemplar": {"trace_id": "t000042"}}
+    opened = eng.evaluate(fired=[alert])
+    assert len(opened) == 1
+    b = opened[0]
+    assert b["schema"] == INCIDENT_SCHEMA and b["id"] == "inc000001"
+    assert b["trigger"] == "alert"
+    assert b["window"] == {"start": -90.0, "end": 30.0}   # 120s lookback
+    top = b["suspects"][0]
+    assert top["kind"] == "backend-errors" and top["key"] == "green-svc"
+    assert top["linkage"] == 2 and top["lead_s"] == 30.0
+    assert b["verdict"] == (
+        "gateway errors on backend green-svc began 30.0s before alert; "
+        "backend-errors green-svc is the top suspect")
+    assert b["alert"]["name"] == "serve-availability"
+    assert eng.evaluate(fired=[alert]) == []         # dedupe across ticks
+    # The metric side: one bundle counted, a non-zero size gauge.
+    counts = dict((tuple(sorted(labels.items())), v) for labels, v
+                  in reg.family_snapshot("tpu_incidents_total"))
+    assert counts == {(("trigger", "alert"),): 1.0}
+    sizes = list(reg.family_snapshot("tpu_incident_bundle_bytes"))
+    assert sizes and sizes[0][1] > 100.0
+
+
+def test_rollback_outranks_its_own_audit_trail():
+    """The drill semantics in miniature: the green backend's error
+    series deviates BEFORE the gate rolls the ramp back, so it must top
+    the ranking — the upgrade's own audit entry (same linkage via the
+    entity, later first_ts) stays a consequence, not the cause."""
+    clock = VirtualClock(start=0.0)
+    reg = MetricsRegistry()
+    audit = _Audit()
+    eng = IncidentEngine(clock=clock, registry=reg, audit=audit)
+    clock.advance(40.0)
+    reg.inc("tpu_gateway_backend_errors_total",
+            {"backend": "fleet-green-serve-svc"})
+    assert eng.evaluate() == []                      # t=40: deviation
+    clock.advance(10.0)                              # t=50: the verdict
+    audit.entries.append({
+        "kind": "upgrade", "action": "rollback", "ts": 50.0,
+        "namespace": "default", "service": "fleet", "green_weight": 25,
+        "reason": "fast-burn firing",
+        "alert": {"series": {"backend": "fleet-green-serve-svc"},
+                  "exemplar": {"trace_id": "t000007"}}})
+    opened = eng.evaluate()
+    assert [b["trigger"] for b in opened] == ["rollback"]
+    b = opened[0]
+    assert b["entity"] == {"kind": "TpuService", "namespace": "default",
+                           "name": "fleet"}
+    kinds = [s["kind"] for s in b["suspects"]]
+    assert kinds[0] == "backend-errors"
+    assert b["suspects"][0]["key"] == "fleet-green-serve-svc"
+    assert b["suspects"][0]["lead_s"] == 10.0
+    assert "upgrade" in kinds
+    upgrade = [s for s in b["suspects"] if s["kind"] == "upgrade"][0]
+    # The deliberate design: upgrade deviations carry NO backend label,
+    # so the real cause's +2 backend linkage cannot be matched by the
+    # ramp's own trail.
+    assert upgrade["backend"] == ""
+    assert eng.evaluate() == []                      # same verdict: once
+
+
+def test_ranker_ties_break_lexicographically_byte_identical():
+    """Two deviations with identical linkage and first_ts order by
+    (kind, key); two independently built engines fed the same script
+    emit byte-identical bundles."""
+    def build():
+        clock = VirtualClock(start=0.0)
+        reg = MetricsRegistry()
+        eng = IncidentEngine(clock=clock, registry=reg)
+        reg.inc("tpu_gateway_backend_errors_total", {"backend": "b-svc"})
+        reg.inc("tpu_gateway_backend_errors_total", {"backend": "a-svc"})
+        eng.evaluate()
+        clock.advance(5.0)
+        return eng.evaluate(fired=[{
+            "name": "serve-ttft", "window": "fast", "since": 5.0,
+            "burn_rate": 20.0, "series": {"backend": "other"}}])[0]
+
+    b1, b2 = build(), build()
+    assert [s["key"] for s in b1["suspects"]] == ["a-svc", "b-svc"]
+    assert all(s["linkage"] == 0 for s in b1["suspects"])
+    assert json.dumps(b1, sort_keys=True) == json.dumps(b2, sort_keys=True)
+
+
+def test_straggler_trigger_links_entity_and_host():
+    clock = VirtualClock(start=20.0)
+    eng = IncidentEngine(clock=clock, steps=_Steps([{
+        "job": "default/drill", "host": "h3",
+        "first_slow_ts": 12.0, "first_slow_step": 4}]))
+    opened = eng.evaluate()
+    assert [b["trigger"] for b in opened] == ["straggler"]
+    b = opened[0]
+    assert b["entity"]["name"] == "drill"
+    top = b["suspects"][0]
+    assert top["kind"] == "straggler" and top["host"] == "h3"
+    assert top["linkage"] == 3                       # entity 2 + host 1
+    assert b["evidence"]["steps"][0]["host"] == "h3"
+    assert eng.evaluate() == []
+
+
+def test_quota_reclaim_notice_is_both_trigger_and_suspect():
+    """A reclaim NOTICE is admitted=True/evict=False yet still opens a
+    bundle and ranks as the first deviation — the deadline-cron drill's
+    gate depends on the notice, not just the eventual eviction."""
+    clock = VirtualClock(start=20.0)
+    eng = IncidentEngine(clock=clock, quota=_Quota([{
+        "ts": 15.0, "namespace": "default", "name": "hog",
+        "kind": "TpuCluster", "reason": "reclaim-noticed",
+        "admitted": True, "evict": False, "chips": 8, "tenant": "t1"}]))
+    opened = eng.evaluate()
+    assert [b["trigger"] for b in opened] == ["quota-reclaim"]
+    top = opened[0]["suspects"][0]
+    assert top["kind"] == "quota"
+    assert top["key"] == "default/hog:reclaim-noticed"
+    assert top["linkage"] == 2                       # entity match
+    assert eng.evaluate() == []
+
+
+def test_feed_rows_trigger_preemption_bundles():
+    clock = VirtualClock(start=5.0)
+    eng = IncidentEngine(clock=clock)
+    rows = [{"kind": "preemption-notice", "key": "default/s0",
+             "ts": 3.0, "trigger": True,
+             "summary": "preemption notice on slice s0"}]
+    eng.add_feed(lambda: list(rows))
+    opened = eng.evaluate()
+    assert [b["trigger"] for b in opened] == ["preemption"]
+    top = opened[0]["suspects"][0]
+    assert top["kind"] == "preemption-notice" and top["key"] == "default/s0"
+    assert eng.evaluate() == []                      # feed re-read, no dup
+
+
+def test_violation_trigger_dedupes_and_capacity_evicts_oldest():
+    clock = VirtualClock(start=0.0)
+    eng = IncidentEngine(clock=clock, capacity=2)
+    assert len(eng.observe_violations(["invariant-x broke"])) == 1
+    assert eng.observe_violations(["invariant-x broke"]) == []
+    eng.observe_violations(["invariant-y broke"])
+    eng.observe_violations(["invariant-z broke"])
+    ids = [b["id"] for b in eng.bundles()]
+    assert ids == ["inc000003", "inc000002"]         # newest first, capped
+    assert eng.get("inc000001") is None
+
+
+def test_query_surfaces_return_copies_not_aliases():
+    clock = VirtualClock(start=0.0)
+    eng = IncidentEngine(clock=clock)
+    eng.observe_violations(["inv broke"])
+    b = eng.get("inc000001")
+    b["detail"] = "mutated"
+    b["suspects"].append({"kind": "fake"})
+    assert eng.get("inc000001")["detail"] == "inv broke"
+    assert eng.get("inc000001")["suspects"] == []
+    listing = eng.bundles()
+    listing[0]["trigger"] = "mutated"
+    assert eng.bundles()[0]["trigger"] == "violation"
+
+
+# ---------------------------------------------------------------------------
+# the known-cause drills: the top suspect must name the injected fault
+# ---------------------------------------------------------------------------
+
+@pytest.mark.timeout(300)
+def test_dead_green_drill_byte_identical_export_and_hash_invariance():
+    """The acceptance gate in one place: the dead-green-upgrade drill's
+    rollback bundle top-ranks the dead green backend's error series (not
+    the ramp's own audit trail); the export is byte-identical across
+    re-runs; and mounting the engine leaves the journal hash untouched."""
+    sc = get_scenario("dead-green-upgrade")
+    with SimHarness(3, scenario=sc, incidents=True) as h:
+        r1 = h.run(sc.default_steps)
+        doc1 = h.export_incidents()
+    with SimHarness(3, scenario=sc, incidents=True) as h:
+        r2 = h.run(sc.default_steps)
+        doc2 = h.export_incidents()
+    with SimHarness(3, scenario=sc) as h:             # engine off
+        r3 = h.run(sc.default_steps)
+    assert r1.ok and r2.ok and r3.ok
+    assert json.dumps(doc1, sort_keys=True) == \
+        json.dumps(doc2, sort_keys=True)
+    assert r1.journal_hash == r2.journal_hash == r3.journal_hash
+    assert doc1["schema"] == "tpu-incident-export/v1"
+    rollbacks = [b for b in doc1["incidents"]
+                 if b["trigger"] == "rollback"]
+    assert rollbacks, [b["trigger"] for b in doc1["incidents"]]
+    tops = [b["suspects"][0] for b in rollbacks if b["suspects"]]
+    named = [t for t in tops if t["kind"] == "backend-errors"
+             and "serve-svc" in t["key"]]
+    assert named, [(t["kind"], t["key"]) for t in tops]
+    assert "backend-errors" in \
+        [b for b in rollbacks if b["suspects"]][0]["verdict"]
+
+
+@pytest.mark.timeout(300)
+def test_straggler_drill_incident_names_the_slow_host():
+    sc = get_scenario("straggler-drill")
+    with SimHarness(0, scenario=sc, steps=True, incidents=True) as h:
+        res = h.run(sc.default_steps)
+        doc = h.export_incidents()
+    assert res.ok
+    bundles = [b for b in doc["incidents"] if b["trigger"] == "straggler"]
+    assert bundles, [b["trigger"] for b in doc["incidents"]]
+    top = bundles[0]["suspects"][0]
+    assert top["kind"] == "straggler"
+    assert top["host"] and top["host"] in bundles[0]["detail"]
+
+
+@pytest.mark.timeout(300)
+def test_preemption_drill_incident_tops_the_notice():
+    sc = get_scenario("preemption-drill")
+    with SimHarness(0, scenario=sc, incidents=True) as h:
+        res = h.run(sc.default_steps)
+        doc = h.export_incidents()
+    assert res.ok
+    bundles = [b for b in doc["incidents"]
+               if b["trigger"] == "preemption"]
+    assert bundles, [b["trigger"] for b in doc["incidents"]]
+    top = bundles[0]["suspects"][0]
+    assert top["kind"] == "preemption-notice"
+
+
+@pytest.mark.timeout(300)
+def test_deadline_cron_fleet_incident_tops_the_reclaim():
+    sc = get_scenario("deadline-cron-fleet")
+    with SimHarness(0, scenario=sc, incidents=True) as h:
+        res = h.run(sc.default_steps)
+        doc = h.export_incidents()
+    assert res.ok
+    bundles = [b for b in doc["incidents"]
+               if b["trigger"] == "quota-reclaim"]
+    assert bundles, [b["trigger"] for b in doc["incidents"]]
+    top = bundles[0]["suspects"][0]
+    assert top["kind"] == "quota" and "reclaim" in top["key"]
+
+
+# ---------------------------------------------------------------------------
+# serving surface + the shared ?limit contract
+# ---------------------------------------------------------------------------
+
+def test_debug_incidents_serves_limits_and_404s():
+    from kuberay_tpu.apiserver.server import serve_background
+    clock = VirtualClock(start=0.0)
+    eng = IncidentEngine(clock=clock)
+    for name in ("inv-a", "inv-b", "inv-c"):
+        eng.observe_violations([f"{name} broke"])
+    srv, url = serve_background(ObjectStore(), incidents=eng)
+    try:
+        with urllib.request.urlopen(f"{url}/debug/incidents") as resp:
+            doc = json.load(resp)
+        assert doc["count"] == 3
+        assert [r["id"] for r in doc["incidents"]] == \
+            ["inc000003", "inc000002", "inc000001"]  # newest first
+        assert doc["incidents"][0]["verdict"]
+        with urllib.request.urlopen(
+                f"{url}/debug/incidents/inc000002") as resp:
+            bundle = json.load(resp)
+        assert bundle == eng.get("inc000002")
+        # The shared ?limit contract: N rows, N<1 clamps to 1, a
+        # malformed value falls back to the endpoint default.
+        for query, expect in (("?limit=2", 2), ("?limit=0", 1),
+                              ("?limit=-3", 1), ("?limit=bogus", 3)):
+            with urllib.request.urlopen(
+                    f"{url}/debug/incidents{query}") as resp:
+                assert len(json.load(resp)["incidents"]) == expect, query
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{url}/debug/incidents/nope")
+        assert ei.value.code == 404
+    finally:
+        srv.shutdown()
+    srv, url = serve_background(ObjectStore())       # no engine mounted
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{url}/debug/incidents")
+        assert ei.value.code == 404
+    finally:
+        srv.shutdown()
+
+
+def test_debug_limit_contract_on_alert_ring_and_traces():
+    """The same ?limit=N plumbing bounds the other list endpoints: the
+    alert history ring keeps its NEWEST entries, the trace export its
+    newest spans."""
+    from kuberay_tpu.apiserver.server import serve_background
+    from kuberay_tpu.obs.alerts import AlertEngine, SloSpec
+    from kuberay_tpu.obs.trace import Tracer
+    clock = VirtualClock(start=0.0)
+    reg = MetricsRegistry()
+    spec = SloSpec(name="serve-ttft", kind="latency",
+                   metric="tpu_serve_request_duration_seconds",
+                   labels=(("phase", "ttft"),), threshold_s=0.5,
+                   objective=0.99, slow_window_s=300.0, slow_burn=14.0)
+    eng = AlertEngine(reg, specs=[spec], clock=clock)
+    for _ in range(5):
+        reg.observe("tpu_serve_request_duration_seconds", 0.1,
+                    {"phase": "ttft"}, buckets=(0.25, 0.5, 1.0))
+    eng.evaluate()
+    for _ in range(3):                               # 3 flaps, 4 entries each
+        clock.advance(10.0)
+        for _ in range(5):
+            reg.observe("tpu_serve_request_duration_seconds", 1.0,
+                        {"phase": "ttft"}, buckets=(0.25, 0.5, 1.0))
+        eng.evaluate()
+        clock.advance(400.0)
+        eng.evaluate()
+    tracer = Tracer(clock=clock)
+    for i in range(4):
+        with tracer.span(f"s{i}"):
+            pass
+    srv, url = serve_background(ObjectStore(), alerts=eng, tracer=tracer)
+    try:
+        with urllib.request.urlopen(f"{url}/debug/alerts") as resp:
+            full = json.load(resp)["ring"]
+        assert len(full) == 12
+        with urllib.request.urlopen(
+                f"{url}/debug/alerts?limit=2") as resp:
+            ring = json.load(resp)["ring"]
+        assert ring == full[-2:]                     # newest survive
+        with urllib.request.urlopen(
+                f"{url}/debug/traces?limit=2") as resp:
+            spans = json.load(resp)["spans"]
+        assert [s["name"] for s in spans] == ["s2", "s3"]
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# history archive round-trip: served bytes == archived bytes
+# ---------------------------------------------------------------------------
+
+def test_incident_archive_roundtrips_byte_identical(tmp_path):
+    from kuberay_tpu.history.server import HistoryCollector, HistoryServer
+    from kuberay_tpu.history.storage import LocalStorage
+    from kuberay_tpu.utils import constants as C
+    from tests.test_api_types import make_cluster
+
+    clock = VirtualClock(start=0.0)
+    eng = IncidentEngine(clock=clock, steps=_Steps([{
+        "job": "default/doomed", "host": "h1",
+        "first_slow_ts": 1.0, "first_slow_step": 2}]))
+    assert eng.evaluate()                            # entity default/doomed
+    store = ObjectStore()
+    storage = LocalStorage(str(tmp_path / "arch"))
+    col = HistoryCollector(store, storage, incidents=eng)
+    store.create(make_cluster(name="doomed").to_dict())
+    store.delete(C.KIND_CLUSTER, "doomed")
+    col.close()
+
+    archived = storage.get("meta/default/doomed/incidents.json")
+    assert archived is not None
+    srv, url = HistoryServer(storage).serve_background()
+    try:
+        with urllib.request.urlopen(
+                f"{url}/api/history/incidents/default/doomed") as resp:
+            served = resp.read()
+        assert served == archived                    # byte-for-byte
+        doc = json.loads(served)
+        assert doc["incidents"][0]["trigger"] == "straggler"
+        assert doc["incidents"][0]["entity"]["name"] == "doomed"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"{url}/api/history/incidents/default/nothing")
+        assert ei.value.code == 404
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# flight ring snapshots under concurrent writers (the capture path)
+# ---------------------------------------------------------------------------
+
+def test_flight_timeline_snapshot_survives_concurrent_hammer():
+    """timeline() must hand back COPIES: the incident/debug paths
+    serialize the snapshot outside the recorder lock while writers keep
+    rotating the ring — a live view would race json.dumps or mutate an
+    in-flight response."""
+    fr = FlightRecorder(capacity=64)
+    stop = threading.Event()
+    errors = []
+
+    def hammer():
+        i = 0
+        try:
+            while not stop.is_set():
+                fr.record("TpuCluster", "default", "c", "watch",
+                          f"d{i}", seq=i)
+                i += 1
+        except Exception as e:                       # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=hammer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(300):
+            snap = fr.timeline("TpuCluster", "default", "c")
+            json.dumps(snap)                         # must never race
+            assert all(r["type"] == "watch" for r in snap)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    assert not errors
+    # And the snapshot is a copy, not an alias into the ring.
+    snap = fr.timeline("TpuCluster", "default", "c")
+    snap[0]["type"] = "mutated"
+    assert fr.timeline("TpuCluster", "default", "c")[0]["type"] == "watch"

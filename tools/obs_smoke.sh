@@ -17,7 +17,12 @@
 # profile leg: /debug/profile must decompose the traced serve request
 # (self-time fractions summing to 1.0), and a seeded sim scenario run
 # twice must export a byte-identical tpu-profile/v1 artifact whose
-# self-diff reports zero regressions.
+# self-diff reports zero regressions.  The incident forensics leg rides
+# the serve traffic: the TTFT SLO is tightened to an impossible target
+# so the completions are a REAL breach, and the background tick must
+# open an alert-triggered tpu-incident/v1 bundle at /debug/incidents
+# with a non-empty suspect ranking and an exemplar trace that resolves
+# at /debug/traces?tree=1.
 #
 #   tools/obs_smoke.sh
 #
@@ -114,6 +119,16 @@ try:
     from kuberay_tpu.serve.paged_engine import PagedServeEngine
     from kuberay_tpu.serve.server import ServeFrontend
 
+    # Tighten the serve TTFT SLO to an impossible target BEFORE any
+    # serve traffic exists: the completions below then breach for real,
+    # the background tick fires the alert, and the incident engine must
+    # open a bundle from it (asserted in the forensics leg at the end).
+    import dataclasses
+    op.alerts.specs = [
+        dataclasses.replace(s, threshold_s=1e-9)
+        if getattr(s, "name", "") == "serve-ttft" else s
+        for s in op.alerts.specs]
+
     cfg = llama.CONFIGS["llama_tiny"]
     params = llama.init_params(cfg, jax.random.PRNGKey(0))
     eng = PagedServeEngine(cfg, params, max_slots=2, max_len=48,
@@ -133,9 +148,13 @@ try:
     try:
         body = json.dumps({"prompt_tokens": [1, 2, 3, 4],
                            "max_tokens": 4}).encode()
-        code, payload, hdrs = gw.forward_ex("/v1/completions", body)
-        assert code == 200, (code, payload)
-        traceparent = hdrs.get("traceparent")
+        # Six completions: the alert engine's min_samples, so the
+        # tightened TTFT SLO has enough fast-window evidence to fire.
+        traceparent = None
+        for _ in range(6):
+            code, payload, hdrs = gw.forward_ex("/v1/completions", body)
+            assert code == 200, (code, payload)
+            traceparent = traceparent or hdrs.get("traceparent")
         assert traceparent, f"no traceparent in response headers: {hdrs}"
         trace_id = traceparent.split("-")[1]
         with urllib.request.urlopen(
@@ -235,6 +254,42 @@ try:
     finally:
         csrv.shutdown()
 
+    # Incident forensics leg: the TTFT breach above must have opened an
+    # alert-triggered bundle on a background tick — poll briefly (the
+    # loop runs every second), then assert the ranking is non-empty and
+    # the exemplar trace resolves as a span tree.
+    import time
+
+    bundle, idx = None, {}
+    for _ in range(30):
+        with urllib.request.urlopen(f"{url}/debug/incidents") as resp:
+            idx = json.load(resp)
+        rows = [r for r in idx.get("incidents", [])
+                if r.get("trigger") == "alert"]
+        if rows:
+            with urllib.request.urlopen(
+                    f"{url}/debug/incidents/{rows[0]['id']}") as resp:
+                bundle = json.load(resp)
+            break
+        time.sleep(0.5)
+    assert bundle is not None, \
+        f"no alert-triggered incident bundle after the TTFT breach: {idx}"
+    assert bundle["schema"] == "tpu-incident/v1", bundle.get("schema")
+    assert bundle["suspects"], \
+        f"incident {bundle['id']} ranked no suspects"
+    inc_traces = bundle.get("evidence", {}).get("traces") or []
+    assert inc_traces, f"incident {bundle['id']} carries no exemplar trace"
+    inc_tid = inc_traces[0]["trace_id"]
+    with urllib.request.urlopen(
+            f"{url}/debug/traces?trace_id={inc_tid}&tree=1") as resp:
+        inc_tree = json.load(resp)
+    assert inc_tree["traces"], \
+        f"incident exemplar trace {inc_tid} unresolvable at /debug/traces"
+    # The shared ?limit=N contract holds on the incident index too.
+    with urllib.request.urlopen(f"{url}/debug/incidents?limit=1") as resp:
+        lim = json.load(resp)
+    assert len(lim["incidents"]) <= 1, lim
+
     print(f"obs smoke ok: {len(doc['spans'])} spans, "
           f"{len(text.splitlines())} metric lines, "
           f"{len(flight['records'])} flight records, "
@@ -244,7 +299,9 @@ try:
           f"serve trace {trace_id} spans {sorted(got)}, "
           f"profile shapes {sorted(prof['shapes'])}, "
           f"straggler host-b skew "
-          f"{hosts['host-b']['skew_ratio']:.2f}")
+          f"{hosts['host-b']['skew_ratio']:.2f}, "
+          f"incident {bundle['id']} trigger={bundle['trigger']} "
+          f"suspects={len(bundle['suspects'])}")
 finally:
     op.stop()
 EOF

@@ -39,6 +39,57 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu python -m kuberay_tpu.sim \
     --scenario session-churn \
     --seed "${SIM_SEEDS:-0..9}" \
     --steps "${SIM_STEPS:-16}"
+# Incident forensics drill leg: the dead-green-upgrade scenario ramps
+# onto a green build whose serve endpoint is dead on arrival, the
+# burn-rate gate rolls the ramp back, and the incident engine must
+# (a) open a bundle whose TOP-ranked suspect names the dead green
+# backend's error series — not the ramp's own audit trail — and
+# (b) export a BYTE-identical tpu-incident-export/v1 artifact across
+# two runs of the same (scenario, seed), and (c) leave the journal
+# hash untouched when the engine is mounted (observation must never
+# perturb the timeline).
+inc_a="${SIM_INC_A:-/tmp/sim_smoke_incidents_a.json}"
+inc_b="${SIM_INC_B:-/tmp/sim_smoke_incidents_b.json}"
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -m kuberay_tpu.sim \
+    --scenario dead-green-upgrade --seed 3 \
+    --incidents-out "$inc_a" >/dev/null
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -m kuberay_tpu.sim \
+    --scenario dead-green-upgrade --seed 3 \
+    --incidents-out "$inc_b" >/dev/null
+cmp "$inc_a" "$inc_b" || {
+    echo "incident bundles not byte-identical across re-runs" >&2
+    exit 1
+}
+timeout -k 10 60 env JAX_PLATFORMS=cpu python - "$inc_a" <<'EOF'
+import json
+import sys
+
+doc = json.load(open(sys.argv[1]))
+assert doc["schema"] == "tpu-incident-export/v1", doc.get("schema")
+bundles = doc["incidents"]
+assert bundles, "dead-green-upgrade drill produced no incident bundles"
+tops = [b["suspects"][0] for b in bundles if b.get("suspects")]
+assert any(t["kind"] == "backend-errors" and "serve-svc" in t["key"]
+           for t in tops), (
+    "no bundle's top suspect names the dead green backend's error "
+    f"series: {[(t['kind'], t['key']) for t in tops]}")
+print(f"incident drill ok: {len(bundles)} bundles, "
+      f"tops={[(t['kind'], t['key']) for t in tops]}")
+EOF
+hash_on=$(timeout -k 10 300 env JAX_PLATFORMS=cpu python -m kuberay_tpu.sim \
+    --scenario dead-green-upgrade --seed 3 --incidents --json \
+    | timeout -k 10 30 python -c \
+      'import json,sys; print(json.loads(sys.stdin.read())["journal_hash"])')
+hash_off=$(timeout -k 10 300 env JAX_PLATFORMS=cpu python -m kuberay_tpu.sim \
+    --scenario dead-green-upgrade --seed 3 --json \
+    | timeout -k 10 30 python -c \
+      'import json,sys; print(json.loads(sys.stdin.read())["journal_hash"])')
+[ "$hash_on" = "$hash_off" ] || {
+    echo "journal hash differs with incidents on ($hash_on) vs" \
+         "off ($hash_off): the engine perturbed the timeline" >&2
+    exit 1
+}
+echo "incident hash invariance ok: $hash_on"
 # The straggler drill again WITH the step tracker mounted: the corpus
 # above runs every scenario telemetry-off (where the straggler
 # invariant is vacuous); this leg arms the detection checker — a slow
